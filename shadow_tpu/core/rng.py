@@ -1,0 +1,173 @@
+"""Deterministic, order-independent randomness.
+
+The reference draws per-packet loss decisions from a *sequential* per-host
+xoshiro256++ stream (src/main/host/host.rs:166 `random`, used in
+src/main/core/worker.rs:357-368). A sequential stream is hostile to
+batching: the value drawn for a packet depends on how many draws happened
+before it, i.e. on execution order. Our design replaces every such draw
+with a *counter-based* RNG (threefry2x32, Salmon et al., SC'11 — the same
+family JAX uses natively) keyed by the packet's identity:
+
+    bits = threefry2x32(key=(seed, stream), ctr=(src_host_id, packet_seq))
+
+so the scalar CPU path and the batched TPU path compute bit-identical
+decisions no matter in which order packets are processed. This is the
+keystone of the byte-identical-trace requirement (BASELINE.md).
+
+The same core is implemented once, generically over numpy and jax.numpy;
+`tests/test_rng.py` asserts bit-equality between the two backends and
+against the published threefry2x32 test vectors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Threefry constants (public algorithm specification).
+_PARITY = 0x1BD11BDA
+_ROT_A = (13, 15, 26, 6)
+_ROT_B = (17, 29, 16, 24)
+
+
+def _threefry2x32(xp, k0, k1, c0, c1):
+    """20-round threefry2x32. All inputs/outputs uint32 arrays of one shape.
+
+    `xp` is numpy or jax.numpy; both wrap uint32 arithmetic mod 2**32.
+    """
+    u32 = xp.uint32
+
+    def rotl(x, r):
+        return (x << u32(r)) | (x >> u32(32 - r))
+
+    k2 = k0 ^ k1 ^ u32(_PARITY)
+    ks = (k0, k1, k2)
+    x0 = (c0 + k0).astype(xp.uint32)
+    x1 = (c1 + k1).astype(xp.uint32)
+    for d in range(5):  # 5 groups x 4 rounds = 20
+        for r in _ROT_A if d % 2 == 0 else _ROT_B:
+            x0 = (x0 + x1).astype(xp.uint32)
+            x1 = rotl(x1, r) ^ x0
+        x0 = (x0 + ks[(d + 1) % 3]).astype(xp.uint32)
+        x1 = (x1 + ks[(d + 2) % 3] + u32(d + 1)).astype(xp.uint32)
+    return x0, x1
+
+
+def threefry2x32_np(k0, k1, c0, c1):
+    """Numpy backend; scalar or array uint32 inputs -> (uint32, uint32)."""
+    arrs = [np.asarray(v, dtype=np.uint32) for v in (k0, k1, c0, c1)]
+    with np.errstate(over="ignore"):
+        return _threefry2x32(np, *arrs)
+
+
+def threefry2x32_jax(k0, k1, c0, c1):
+    """JAX backend; traceable, for use inside jitted kernels."""
+    import jax.numpy as jnp
+
+    return _threefry2x32(jnp, k0.astype(jnp.uint32), k1.astype(jnp.uint32),
+                         c0.astype(jnp.uint32), c1.astype(jnp.uint32))
+
+
+# ---------------------------------------------------------------------------
+# Stream identifiers: disjoint key-spaces for independent uses of the seed.
+# ---------------------------------------------------------------------------
+STREAM_PACKET_LOSS = 1
+STREAM_HOST = 2  # per-host general-purpose stream (ports, auxv, jitter)
+STREAM_JITTER = 3
+
+
+def mix_key(seed: int, stream: int):
+    """Fold (seed, stream) into a 2x32 threefry key (host-side, cheap)."""
+    k = (seed * 0x9E3779B97F4A7C15 + stream) & 0xFFFFFFFFFFFFFFFF
+    return (k & 0xFFFFFFFF, k >> 32)
+
+
+def loss_threshold_u32(probability: float) -> int:
+    """Integer comparison threshold for `drop iff bits < threshold`.
+
+    Computed once on the host in float64 so both backends compare the same
+    integer; avoids any float-rounding divergence between CPU and TPU.
+
+    Contract: the returned value is in [0, 2**32] and therefore does NOT
+    fit in uint32 when probability is 1.0 — the comparison must be done in
+    >=33-bit arithmetic. Kernels cast the uint32 bits to int64 before
+    comparing (see ops/propagate.py); host-side Python-int comparison is
+    naturally exact.
+    """
+    if probability <= 0.0:
+        return 0
+    if probability >= 1.0:
+        return 1 << 32
+    return int(probability * float(1 << 32))
+
+
+def threefry2x32_py(k0: int, k1: int, c0: int, c1: int) -> tuple[int, int]:
+    """Pure-Python-int threefry2x32 — bit-identical to the array backends.
+
+    Used by `HostRng` on the scalar hot path: per-draw numpy scalar
+    dispatch costs ~10x more than plain int arithmetic for a 20-round
+    block cipher. Cross-checked against the numpy backend in tests.
+    """
+    M = 0xFFFFFFFF
+    k2 = k0 ^ k1 ^ _PARITY
+    ks = (k0, k1, k2)
+    x0 = (c0 + k0) & M
+    x1 = (c1 + k1) & M
+    for d in range(5):
+        for r in _ROT_A if d % 2 == 0 else _ROT_B:
+            x0 = (x0 + x1) & M
+            x1 = (((x1 << r) & M) | (x1 >> (32 - r))) ^ x0
+        x0 = (x0 + ks[(d + 1) % 3]) & M
+        x1 = (x1 + ks[(d + 2) % 3] + d + 1) & M
+    return x0, x1
+
+
+def packet_loss_bits_np(seed: int, src_host_id, packet_seq):
+    """Loss-decision bits for packets identified by (src_host, seq) (numpy)."""
+    k0, k1 = mix_key(seed, STREAM_PACKET_LOSS)
+    b0, _ = threefry2x32_np(np.uint32(k0), np.uint32(k1),
+                            np.asarray(src_host_id, np.uint32),
+                            np.asarray(packet_seq, np.uint32))
+    return b0
+
+
+class HostRng:
+    """Stateful counter-based stream for one host.
+
+    Replaces the reference's per-host xoshiro256++ (host.rs:166) for
+    host-local randomness (ephemeral ports, app-visible random bytes).
+    State is just (key, counter); cheap to snapshot for checkpointing.
+    """
+
+    __slots__ = ("_k0", "_k1", "_host_id", "_counter")
+
+    def __init__(self, seed: int, host_id: int):
+        k0, k1 = mix_key(seed, STREAM_HOST)
+        self._k0 = k0 ^ (host_id & 0xFFFFFFFF)
+        self._k1 = k1 ^ (host_id >> 32)
+        self._host_id = host_id
+        self._counter = 0
+
+    def next_u64(self) -> int:
+        b0, b1 = threefry2x32_py(self._k0, self._k1,
+                                 self._counter & 0xFFFFFFFF,
+                                 self._counter >> 32)
+        self._counter += 1
+        return (b1 << 32) | b0
+
+    def next_u32(self) -> int:
+        return self.next_u64() & 0xFFFFFFFF
+
+    def uniform(self) -> float:
+        """Float64 in [0, 1). Uses the top 53 bits so the scaled value can
+        never round up to exactly 1.0."""
+        return (self.next_u64() >> 11) * (2.0 ** -53)
+
+    def randrange(self, lo: int, hi: int) -> int:
+        """Integer in [lo, hi); unbiased enough for simulation purposes."""
+        return lo + self.next_u64() % (hi - lo)
+
+    def bytes(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            out += self.next_u64().to_bytes(8, "little")
+        return bytes(out[:n])

@@ -1,0 +1,14 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharded code paths are
+validated on a virtual 8-device CPU mesh instead (same XLA semantics).
+Must run before anything imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

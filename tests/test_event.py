@@ -1,0 +1,42 @@
+"""Event ordering and queue semantics (ref: event.rs / event_queue.rs)."""
+
+import pytest
+
+from shadow_tpu.core.event import Event, EventQueue, KIND_LOCAL, KIND_PACKET, TaskRef
+
+
+def test_total_order_time_then_kind_then_source():
+    # Same time: packets before local tasks; then by (src_host, seq).
+    e_local = Event(100, KIND_LOCAL, 0, 0, None)
+    e_pkt_h2 = Event(100, KIND_PACKET, 2, 0, None)
+    e_pkt_h1a = Event(100, KIND_PACKET, 1, 5, None)
+    e_pkt_h1b = Event(100, KIND_PACKET, 1, 9, None)
+    e_early = Event(99, KIND_LOCAL, 9, 9, None)
+    q = EventQueue()
+    for e in (e_local, e_pkt_h2, e_pkt_h1a, e_pkt_h1b, e_early):
+        q.push(e)
+    order = [q.pop() for _ in range(5)]
+    assert order == [e_early, e_pkt_h1a, e_pkt_h1b, e_pkt_h2, e_local]
+
+
+def test_monotonic_pop_assert():
+    q = EventQueue()
+    q.push(Event(50, KIND_LOCAL, 0, 0, None))
+    q.pop()
+    q.push(Event(10, KIND_LOCAL, 0, 1, None))
+    with pytest.raises(AssertionError):
+        q.pop()
+
+
+def test_taskref_executes_with_host():
+    calls = []
+    t = TaskRef("test", lambda host, x: calls.append((host, x)), 42)
+    t.execute("H")
+    assert calls == [("H", 42)]
+
+
+def test_peek_and_len():
+    q = EventQueue()
+    assert q.peek_time() is None and not q
+    q.push(Event(7, KIND_LOCAL, 0, 0, None))
+    assert q.peek_time() == 7 and len(q) == 1
