@@ -1,0 +1,236 @@
+"""Simulation configuration: YAML schema + CLI overrides.
+
+Mirrors the reference's config surface (src/main/core/configuration.rs;
+docs/shadow_config_spec.md): `general` / `network` / `experimental` /
+`hosts` sections, SI-unit values, `x-` extension keys ignored, YAML merge
+keys honored (pyyaml resolves `<<` natively). The `experimental.scheduler`
+switch grows a `tpu` variant next to the reference's thread-per-core /
+thread-per-host choices (configuration.rs:938) — that switch is the whole
+point of this framework.
+
+Process `path` may name a real binary (interposition backend, later
+rounds) or a *registered internal app* (host/apps.py) — the internal
+traffic-generator workloads used by the benchmark configs resolve there
+first, the way the reference points configs at tgen binaries.
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+from shadow_tpu.net import graph as netgraph
+from shadow_tpu.utils import units
+
+SCHEDULERS = ("thread_per_core", "thread_per_host", "serial", "tpu")
+QDISC_MODES = ("fifo", "round_robin")
+
+
+@dataclass
+class ProcessConfig:
+    path: str
+    args: list[str] = field(default_factory=list)
+    environment: dict[str, str] = field(default_factory=dict)
+    start_time_ns: int = 0
+    shutdown_time_ns: int | None = None
+    shutdown_signal: str = "SIGTERM"
+    expected_final_state: Any = "exited 0"
+
+
+@dataclass
+class HostConfig:
+    name: str
+    network_node_id: int
+    processes: list[ProcessConfig]
+    ip_addr: int | None = None
+    bandwidth_down_bits: int | None = None  # overrides graph-node default
+    bandwidth_up_bits: int | None = None
+    pcap_enabled: bool = False
+    pcap_capture_size: int = 65535
+
+
+@dataclass
+class GeneralConfig:
+    stop_time_ns: int = 0
+    seed: int = 1
+    bootstrap_end_time_ns: int = 0
+    parallelism: int = 0  # 0 = auto (num cores)
+    data_directory: str = "shadow.data"
+    template_directory: str | None = None
+    progress: bool = False
+    heartbeat_interval_ns: int = units.parse_time_ns("1 s")
+    log_level: str = "info"
+    model_unblocked_syscall_latency: bool = False
+
+
+@dataclass
+class NetworkConfig:
+    graph: netgraph.NetworkGraph = None
+    use_shortest_path: bool = True
+
+
+@dataclass
+class ExperimentalConfig:
+    scheduler: str = "thread_per_core"
+    runahead_ns: int | None = None  # None = auto (graph min latency)
+    use_dynamic_runahead: bool = False
+    interface_qdisc: str = "fifo"
+    socket_send_buffer: int = 131_072
+    socket_recv_buffer: int = 174_760
+    socket_send_autotune: bool = True
+    socket_recv_autotune: bool = True
+    strace_logging_mode: str = "off"  # off | standard | deterministic
+    max_unapplied_cpu_latency_ns: int = units.parse_time_ns("1 us")
+    unblocked_syscall_latency_ns: int = units.parse_time_ns("1 us")
+    unblocked_vdso_latency_ns: int = units.parse_time_ns("10 ns")
+    tpu_max_packets_per_round: int = 1 << 20
+    report_errors_to_stderr: bool = True
+
+
+@dataclass
+class ConfigOptions:
+    general: GeneralConfig
+    network: NetworkConfig
+    experimental: ExperimentalConfig
+    hosts: dict[str, HostConfig]
+
+    @classmethod
+    def from_yaml_text(cls, text: str, base_dir: str = ".") -> "ConfigOptions":
+        raw = yaml.safe_load(text)
+        if not isinstance(raw, dict):
+            raise ValueError("config root must be a mapping")
+        return cls.from_dict(raw, base_dir=base_dir)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ConfigOptions":
+        import os
+        with open(path) as f:
+            return cls.from_yaml_text(f.read(), base_dir=os.path.dirname(path) or ".")
+
+    @classmethod
+    def from_dict(cls, raw: dict, base_dir: str = ".") -> "ConfigOptions":
+        raw = {k: v for k, v in raw.items() if not str(k).startswith("x-")}
+        unknown = set(raw) - {"general", "network", "experimental", "hosts"}
+        if unknown:
+            raise ValueError(f"unknown config sections: {sorted(unknown)}")
+
+        g = raw.get("general", {}) or {}
+        general = GeneralConfig(
+            stop_time_ns=units.parse_time_ns(_require(g, "stop_time", "general")),
+            seed=int(g.get("seed", 1)),
+            bootstrap_end_time_ns=units.parse_time_ns(g.get("bootstrap_end_time", 0)),
+            parallelism=int(g.get("parallelism", 0)),
+            data_directory=str(g.get("data_directory", "shadow.data")),
+            template_directory=g.get("template_directory"),
+            progress=bool(g.get("progress", False)),
+            heartbeat_interval_ns=units.parse_time_ns(g.get("heartbeat_interval", "1 s")),
+            log_level=str(g.get("log_level", "info")),
+            model_unblocked_syscall_latency=bool(
+                g.get("model_unblocked_syscall_latency", False)),
+        )
+
+        n = raw.get("network", {}) or {}
+        gspec = _require(n, "graph", "network")
+        network = NetworkConfig(
+            graph=_load_graph(gspec, base_dir),
+            use_shortest_path=bool(n.get("use_shortest_path", True)),
+        )
+
+        e = raw.get("experimental", {}) or {}
+        experimental = ExperimentalConfig()
+        for yaml_key, attr, conv in (
+                ("scheduler", "scheduler", str),
+                ("runahead", "runahead_ns", units.parse_time_ns),
+                ("use_dynamic_runahead", "use_dynamic_runahead", bool),
+                ("interface_qdisc", "interface_qdisc", str),
+                ("socket_send_buffer", "socket_send_buffer", units.parse_bytes),
+                ("socket_recv_buffer", "socket_recv_buffer", units.parse_bytes),
+                ("socket_send_autotune", "socket_send_autotune", bool),
+                ("socket_recv_autotune", "socket_recv_autotune", bool),
+                ("strace_logging_mode", "strace_logging_mode", str),
+                ("max_unapplied_cpu_latency", "max_unapplied_cpu_latency_ns",
+                 units.parse_time_ns),
+                ("unblocked_syscall_latency", "unblocked_syscall_latency_ns",
+                 units.parse_time_ns),
+                ("unblocked_vdso_latency", "unblocked_vdso_latency_ns",
+                 units.parse_time_ns),
+                ("tpu_max_packets_per_round", "tpu_max_packets_per_round", int),
+                ("report_errors_to_stderr", "report_errors_to_stderr", bool)):
+            if yaml_key in e:
+                setattr(experimental, attr, conv(e[yaml_key]))
+        if experimental.scheduler not in SCHEDULERS:
+            raise ValueError(f"unknown scheduler {experimental.scheduler!r}; "
+                             f"expected one of {SCHEDULERS}")
+        if experimental.interface_qdisc not in QDISC_MODES:
+            raise ValueError(f"unknown interface_qdisc "
+                             f"{experimental.interface_qdisc!r}")
+
+        hosts_raw = raw.get("hosts", {}) or {}
+        if not hosts_raw:
+            raise ValueError("config must define at least one host")
+        hosts = {}
+        for name, h in hosts_raw.items():
+            h = h or {}
+            procs = []
+            for p in h.get("processes", []) or []:
+                args = p.get("args", [])
+                if isinstance(args, str):
+                    args = shlex.split(args)
+                procs.append(ProcessConfig(
+                    path=str(_require(p, "path", f"hosts.{name}.processes")),
+                    args=[str(a) for a in args],
+                    environment={str(k): str(v) for k, v in
+                                 (p.get("environment") or {}).items()},
+                    start_time_ns=units.parse_time_ns(p.get("start_time", 0)),
+                    shutdown_time_ns=(units.parse_time_ns(p["shutdown_time"])
+                                      if "shutdown_time" in p else None),
+                    shutdown_signal=str(p.get("shutdown_signal", "SIGTERM")),
+                    expected_final_state=p.get("expected_final_state",
+                                               "exited 0"),
+                ))
+            bw_down = h.get("bandwidth_down")
+            bw_up = h.get("bandwidth_up")
+            hosts[str(name)] = HostConfig(
+                name=str(name),
+                network_node_id=int(_require(h, "network_node_id",
+                                             f"hosts.{name}")),
+                processes=procs,
+                ip_addr=(netgraph.parse_ip(h["ip_addr"])
+                         if "ip_addr" in h else None),
+                bandwidth_down_bits=(units.parse_bandwidth_bits(bw_down)
+                                     if bw_down is not None else None),
+                bandwidth_up_bits=(units.parse_bandwidth_bits(bw_up)
+                                   if bw_up is not None else None),
+                pcap_enabled=bool(h.get("pcap_enabled", False)),
+                pcap_capture_size=units.parse_bytes(
+                    h.get("pcap_capture_size", 65535)),
+            )
+        return cls(general=general, network=network,
+                   experimental=experimental, hosts=hosts)
+
+
+def _require(mapping: dict, key: str, where: str):
+    if key not in mapping:
+        raise ValueError(f"missing required config key {where}.{key}")
+    return mapping[key]
+
+
+def _load_graph(gspec: dict, base_dir: str) -> netgraph.NetworkGraph:
+    gtype = gspec.get("type", "gml")
+    if gtype in netgraph.BUILTIN_GRAPHS:
+        return netgraph.NetworkGraph.named(gtype)
+    if gtype != "gml":
+        raise ValueError(f"unknown graph type {gtype!r}")
+    if "inline" in gspec:
+        return netgraph.NetworkGraph.from_gml(gspec["inline"])
+    if "file" in gspec:
+        import os
+        path = gspec["file"]["path"]
+        if not os.path.isabs(path):
+            path = os.path.join(base_dir, path)
+        with open(path) as f:
+            return netgraph.NetworkGraph.from_gml(f.read())
+    raise ValueError("network.graph needs 'inline' or 'file.path'")

@@ -1,0 +1,297 @@
+"""Manager: build the simulation and run the conservative round loop.
+
+Ref: src/main/core/manager.rs (build + round loop, :228,:415-501) and
+controller.rs:87-113 (window computation). One class covers both here —
+multi-manager was an acknowledged TODO in the reference and our
+multi-device story lives in the scheduler instead.
+
+The loop is the PDES heart: pick the global minimum next-event time,
+open a window [start, start + runahead], let every host execute its
+events inside the window in parallel, exchange the round's packets, and
+reduce the next window start. The *scheduler* decides how hosts execute
+(serial / thread pool) and the *propagator* decides how packets cross
+hosts (scalar CPU / batched TPU kernel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.propagate_scalar import ScalarPropagator
+from shadow_tpu.core.rng import loss_threshold_u32
+from shadow_tpu.host import apps as app_registry
+from shadow_tpu.host.host import Host
+from shadow_tpu.host.process import Process
+from shadow_tpu.host.syscalls import SyscallHandler
+from shadow_tpu.net.dns import Dns
+
+
+@dataclass
+class SimSummary:
+    end_time_ns: int = 0
+    rounds: int = 0
+    events: int = 0
+    packets_sent: int = 0
+    packets_recv: int = 0
+    packets_dropped: int = 0
+    syscalls: int = 0
+    plugin_errors: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.plugin_errors
+
+
+class Runahead:
+    """Round width (ref: src/main/core/runahead.rs:14-117): the smallest
+    latency any packet can experience bounds how far hosts may run
+    without hearing from each other. A config value overrides; dynamic
+    mode lowers it as smaller latencies are actually used."""
+
+    def __init__(self, config_ns: int | None, graph_min_ns: int,
+                 dynamic: bool):
+        self._value = config_ns if config_ns is not None else graph_min_ns
+        self._value = max(int(self._value), 1)
+        self._dynamic = dynamic
+
+    def get(self) -> int:
+        return self._value
+
+    def update_lowest_used_latency(self, latency_ns: int) -> None:
+        if self._dynamic and 0 < latency_ns < self._value:
+            self._value = latency_ns
+
+
+class Manager:
+    def __init__(self, config: ConfigOptions):
+        self.config = config
+        graph = config.network.graph
+        if graph.latency_ns is None:
+            graph.compute_routing(config.network.use_shortest_path)
+        self.graph = graph
+
+        self.dns = Dns()
+        self.syscall_handler = SyscallHandler(
+            send_buf=config.experimental.socket_send_buffer,
+            recv_buf=config.experimental.socket_recv_buffer)
+
+        # Build hosts in sorted-name order: host ids — and with them every
+        # RNG stream and ordering tiebreak — are config-deterministic.
+        from shadow_tpu.net.graph import IpAssignment
+        ipa = IpAssignment()
+        self.hosts: list[Host] = []
+        seed = config.general.seed
+        for host_id, name in enumerate(sorted(config.hosts)):
+            hcfg = config.hosts[name]
+            node = graph.by_gml_id.get(hcfg.network_node_id)
+            if node is None:
+                raise ValueError(f"host {name!r}: unknown network_node_id "
+                                 f"{hcfg.network_node_id}")
+            ip = ipa.assign(node.index, hcfg.ip_addr)
+            bw_down = hcfg.bandwidth_down_bits or node.bandwidth_down_bits
+            bw_up = hcfg.bandwidth_up_bits or node.bandwidth_up_bits
+            if not bw_down or not bw_up:
+                raise ValueError(f"host {name!r}: no bandwidth configured "
+                                 "(host or graph node must provide it)")
+            host = Host(host_id, name, ip, node.index, seed, bw_down, bw_up,
+                        qdisc=config.experimental.interface_qdisc)
+            host.dns = self.dns
+            host.syscall_handler = self.syscall_handler
+            self.dns.register(host_id, ip, name)
+            self.hosts.append(host)
+            for i, pcfg in enumerate(hcfg.processes):
+                self._schedule_spawn(host, i, pcfg)
+
+        # Loss thresholds as an integer matrix: one float->int conversion
+        # at build time, shared verbatim by scalar and batched backends.
+        loss = graph.packet_loss
+        thr = np.zeros(loss.shape, dtype=np.int64)
+        nz = loss > 0
+        if nz.any():
+            thr[nz] = [loss_threshold_u32(p) for p in loss[nz]]
+        self.loss_thresholds = thr
+
+        self.runahead = Runahead(
+            config.experimental.runahead_ns, graph.min_latency_ns(),
+            config.experimental.use_dynamic_runahead)
+
+        sched = config.experimental.scheduler
+        threaded = sched in ("thread_per_core", "thread_per_host")
+        self._per_host_tasks = sched == "thread_per_host"
+        if sched == "tpu":
+            from shadow_tpu.ops.propagate import TpuPropagator
+            self.propagator = TpuPropagator(
+                self.hosts, self.dns, graph.latency_ns, thr, seed,
+                config.general.bootstrap_end_time_ns,
+                max_batch=config.experimental.tpu_max_packets_per_round,
+                runahead=self.runahead)
+        else:
+            self.propagator = ScalarPropagator(
+                self.hosts, self.dns, graph.latency_ns, thr, seed,
+                config.general.bootstrap_end_time_ns, threaded=threaded,
+                runahead=self.runahead)
+        for host in self.hosts:
+            host._send_packet_fn = self.propagator.send
+
+        if threaded:
+            workers = config.general.parallelism or os.cpu_count() or 1
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(workers, len(self.hosts)))
+        else:
+            self._pool = None
+
+    # ------------------------------------------------------------------
+
+    def _schedule_spawn(self, host: Host, index: int, pcfg) -> None:
+        spawned: list = []  # shared between the spawn and shutdown tasks
+
+        def spawn(h, _pcfg=pcfg):
+            factory = app_registry.lookup(_pcfg.path)
+            process = Process(h, f"{_pcfg.path}.{index}", _pcfg.args,
+                              _pcfg.environment,
+                              expected_final_state=_pcfg.expected_final_state)
+            spawned.append(process)
+            if factory is None:
+                process.stderr += (f"[shadow-tpu] unknown app "
+                                   f"{_pcfg.path!r}\n").encode()
+                process.exited = True
+                process.exit_code = 127
+                return
+            process.start(h, factory(process, _pcfg.args))
+
+        from shadow_tpu.core.event import TaskRef
+        host.schedule_task_at(pcfg.start_time_ns, TaskRef("spawn", spawn))
+        if pcfg.shutdown_time_ns is not None:
+            # Internal apps have no signal delivery yet: shutdown = forced
+            # exit of *this* process's still-running threads.
+            def shutdown(h):
+                for proc in spawned:
+                    if not proc.exited:
+                        for t in list(proc.threads):
+                            t._exit(h, 0)
+            host.schedule_task_at(pcfg.shutdown_time_ns,
+                                  TaskRef("shutdown", shutdown))
+
+    # ------------------------------------------------------------------
+    # The round loop (manager.rs:415-501)
+    # ------------------------------------------------------------------
+
+    def _min_next_event(self) -> int | None:
+        best = None
+        for h in self.hosts:
+            t = h.next_event_time()
+            if t is not None and (best is None or t < best):
+                best = t
+        return best
+
+    def _run_hosts(self, until: int) -> None:
+        if self._pool is None:
+            for h in self.hosts:
+                h.execute(until)
+        elif self._per_host_tasks:
+            # thread_per_host (scheduler/thread_per_host.rs): one task per
+            # host, pool-sized by min(cores, hosts).
+            list(self._pool.map(lambda h: h.execute(until), self.hosts))
+        else:
+            # thread_per_core (thread_per_core.rs): contiguous strides per
+            # worker; Python threads serialize CPU work on the GIL, so
+            # this validates the concurrency protocol more than it buys
+            # speed — the TPU scheduler is the performance path.
+            n = self._pool._max_workers
+            chunks = [self.hosts[i::n] for i in range(n)]
+
+            def run_chunk(chunk):
+                for h in chunk:
+                    h.execute(until)
+
+            list(self._pool.map(run_chunk, chunks))
+
+    def run(self) -> SimSummary:
+        stop = self.config.general.stop_time_ns
+        summary = SimSummary()
+        start = self._min_next_event()
+        while start is not None and start < stop:
+            window_end = min(start + self.runahead.get(), stop)
+            self.propagator.begin_round(start, window_end)
+            self._run_hosts(window_end)
+            inflight_min = self.propagator.finish_round()
+            summary.rounds += 1
+            nxt = self._min_next_event()
+            if inflight_min is not None and (nxt is None or inflight_min < nxt):
+                nxt = inflight_min
+            start = nxt
+        summary.end_time_ns = min(start, stop) if start is not None else stop
+
+        # Final accounting (manager.rs:546-569).
+        for h in self.hosts:
+            summary.events += h.counters["events"]
+            summary.packets_sent += h.counters["packets_sent"]
+            summary.packets_recv += h.counters["packets_recv"]
+            summary.packets_dropped += h.counters["packets_dropped"]
+            summary.syscalls += h.counters["syscalls"]
+            for proc in h.processes.values():
+                if not proc.matches_expected_final_state():
+                    state = (f"exited {proc.exit_code}" if proc.exited
+                             else "running")
+                    summary.plugin_errors.append(
+                        f"{h.name}/{proc.name}: expected "
+                        f"{proc.expected_final_state!r}, got {state!r}")
+        if self._pool is not None:
+            self._pool.shutdown()
+        return summary
+
+    # ------------------------------------------------------------------
+    # Outputs
+    # ------------------------------------------------------------------
+
+    def trace_lines(self) -> list[str]:
+        lines = []
+        for h in self.hosts:
+            lines.extend(h.trace_lines())
+        return lines
+
+    def write_data_dir(self, summary: SimSummary) -> None:
+        base = self.config.general.data_directory
+        os.makedirs(base, exist_ok=True)
+        with open(os.path.join(base, "processed-config.yaml"), "w") as f:
+            f.write(f"# shadow_tpu run; seed={self.config.general.seed}\n")
+        for h in self.hosts:
+            hdir = os.path.join(base, "hosts", h.name)
+            os.makedirs(hdir, exist_ok=True)
+            for proc in h.processes.values():
+                stem = os.path.join(hdir, f"{proc.name}.{proc.pid}")
+                with open(stem + ".stdout", "wb") as f:
+                    f.write(bytes(proc.stdout))
+                with open(stem + ".stderr", "wb") as f:
+                    f.write(bytes(proc.stderr))
+        with open(os.path.join(base, "packet-trace.txt"), "w") as f:
+            for line in self.trace_lines():
+                f.write(line + "\n")
+        stats = {
+            "end_time_ns": summary.end_time_ns,
+            "rounds": summary.rounds,
+            "events": summary.events,
+            "packets_sent": summary.packets_sent,
+            "packets_recv": summary.packets_recv,
+            "packets_dropped": summary.packets_dropped,
+            "syscalls": summary.syscalls,
+            "hosts": {h.name: dict(h.counters) for h in self.hosts},
+        }
+        with open(os.path.join(base, "sim-stats.json"), "w") as f:
+            json.dump(stats, f, indent=2, sort_keys=True)
+
+
+def run_simulation(config: ConfigOptions, write_data: bool = False):
+    """run_shadow equivalent (src/main/shadow.rs:30)."""
+    manager = Manager(config)
+    summary = manager.run()
+    if write_data:
+        manager.write_data_dir(summary)
+    return manager, summary

@@ -1,0 +1,96 @@
+"""Scalar (CPU) cross-host packet propagation.
+
+The reference's `Worker::send_packet` hot path (src/main/core/worker.rs:
+324-397): resolve destination, loss decision, latency lookup, clamp
+delivery into the next round, push to the destination queue. This scalar
+backend serves the serial and threaded schedulers and is the semantic
+reference for the batched TPU backend (ops/propagate.py) — the two must
+produce byte-identical traces, which is why every decision here is pure
+integer math on the same matrices and the same counter-based RNG the
+kernel uses.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from shadow_tpu.core.event import Event, KIND_PACKET
+from shadow_tpu.core.rng import (STREAM_PACKET_LOSS, mix_key, threefry2x32_py)
+from shadow_tpu.core.simtime import TIME_NEVER
+from shadow_tpu.net import packet as pkt
+
+
+class ScalarPropagator:
+    def __init__(self, hosts, dns, latency_ns, loss_thresholds, seed: int,
+                 bootstrap_end_ns: int, threaded: bool = False,
+                 runahead=None):
+        self.hosts = hosts
+        self.dns = dns
+        self.latency = latency_ns          # (V,V) int64 ndarray
+        self.thresholds = loss_thresholds  # (V,V) int64 ndarray in [0, 2^32]
+        self.k0, self.k1 = mix_key(seed, STREAM_PACKET_LOSS)
+        self.bootstrap_end = bootstrap_end_ns
+        self.window_end = 0
+        self.min_inflight = None
+        self.runahead = runahead  # dynamic-runahead feedback (runahead.rs:61)
+        self._threaded = threaded
+        if threaded:
+            self._min_lock = threading.Lock()
+
+    def begin_round(self, window_start: int, window_end: int) -> None:
+        self.window_end = window_end
+        self.min_inflight = None
+
+    def finish_round(self):
+        return self.min_inflight
+
+    def send(self, src_host, packet) -> None:
+        now = src_host.now()
+        dst_id = self.dns.host_id_for_ip(packet.dst_ip)
+        if dst_id is None:
+            src_host.trace_drop(packet, "no-route")
+            return
+        dst_host = self.hosts[dst_id]
+        latency = int(self.latency[src_host.node_index, dst_host.node_index])
+        if latency >= TIME_NEVER:
+            src_host.trace_drop(packet, "unreachable")
+            return
+
+        # Event sequence is consumed *before* the loss decision so the
+        # numbering is identical on the batched path (where losses are
+        # decided later, on device).
+        seq = src_host.next_event_seq()
+
+        threshold = int(self.thresholds[src_host.node_index,
+                                        dst_host.node_index])
+        if threshold > 0 and now >= self.bootstrap_end \
+                and not packet.is_empty_control():
+            bits, _ = threefry2x32_py(self.k0, self.k1,
+                                      packet.src_host_id & 0xFFFFFFFF,
+                                      packet.seq & 0xFFFFFFFF)
+            if bits < threshold:
+                packet.record(pkt.ST_INET_DROPPED)
+                src_host.trace_drop(packet, "inet-loss")
+                return
+
+        # Conservative clamp (worker.rs:380-384): delivery may never land
+        # inside the current window — the destination may already have
+        # executed past `now + latency`.
+        deliver = now + latency
+        if deliver < self.window_end:
+            deliver = self.window_end
+        packet.arrival_time = deliver
+        event = Event(deliver, KIND_PACKET, src_host.id, seq, packet)
+        dst_host.deliver_packet_event(event)  # inbox: thread-safe
+
+        if self._threaded:
+            with self._min_lock:
+                if self.min_inflight is None or deliver < self.min_inflight:
+                    self.min_inflight = deliver
+                if self.runahead is not None:
+                    self.runahead.update_lowest_used_latency(latency)
+        else:
+            if self.min_inflight is None or deliver < self.min_inflight:
+                self.min_inflight = deliver
+            if self.runahead is not None:
+                self.runahead.update_lowest_used_latency(latency)

@@ -1,0 +1,126 @@
+"""Internal application registry.
+
+The reference points host configs at real binaries (tgen, iperf, tor);
+until the interposition backend lands, configs name *internal apps* —
+Python generators driven through the same syscall seam (process.py).
+`path: udp-sink` in YAML resolves here.
+
+Apps yield syscall tuples and receive results; OSErrors raise at the
+yield point. They are deliberately written like the C apps they stand in
+for: sockets, blocking calls, no access to simulator internals.
+"""
+
+from __future__ import annotations
+
+APP_REGISTRY: dict = {}
+
+
+def app(name: str):
+    def register(fn):
+        APP_REGISTRY[name] = fn
+        return fn
+    return register
+
+
+def lookup(path: str):
+    return APP_REGISTRY.get(path)
+
+
+# ---------------------------------------------------------------------------
+# UDP workloads (tgen-style file transfer / flood / sink)
+# ---------------------------------------------------------------------------
+
+@app("udp-flood")
+def udp_flood(process, argv):
+    """udp-flood <dst> <port> <count> <size> [interval_ns]"""
+    dst, port, count, size = argv[0], int(argv[1]), int(argv[2]), int(argv[3])
+    interval = int(argv[4]) if len(argv) > 4 else 0
+    fd = yield ("socket", "udp")
+    dst_ip = yield ("resolve", dst)
+    payload = b"x" * size
+    sent = 0
+    for i in range(count):
+        yield ("sendto", fd, payload, (dst_ip, port))
+        sent += size
+        if interval > 0:
+            yield ("nanosleep", interval)
+    yield ("write", 1, f"sent {count} datagrams {sent} bytes\n")
+    yield ("close", fd)
+    return 0
+
+
+@app("udp-sink")
+def udp_sink(process, argv):
+    """udp-sink <port> [expected_bytes] — exits 0 once expected bytes seen;
+    runs forever without the argument (stopped by sim end)."""
+    port = int(argv[0])
+    expect = int(argv[1]) if len(argv) > 1 else None
+    fd = yield ("socket", "udp")
+    yield ("bind", fd, (0, port))
+    got = 0
+    n = 0
+    while expect is None or got < expect:
+        data, src = yield ("recvfrom", fd, 65536)
+        got += len(data)
+        n += 1
+    t = yield ("sim_time",)
+    yield ("write", 1, f"received {n} datagrams {got} bytes t={t}\n")
+    yield ("close", fd)
+    return 0
+
+
+@app("udp-echo-server")
+def udp_echo_server(process, argv):
+    port = int(argv[0])
+    fd = yield ("socket", "udp")
+    yield ("bind", fd, (0, port))
+    while True:
+        data, src = yield ("recvfrom", fd, 65536)
+        yield ("sendto", fd, data, src)
+
+
+@app("udp-pinger")
+def udp_pinger(process, argv):
+    """udp-pinger <dst> <port> <count> — RTT measurement over UDP echo."""
+    dst, port, count = argv[0], int(argv[1]), int(argv[2])
+    fd = yield ("socket", "udp")
+    dst_ip = yield ("resolve", dst)
+    for i in range(count):
+        t0 = yield ("sim_time",)
+        yield ("sendto", fd, b"ping%d" % i, (dst_ip, port))
+        data, src = yield ("recvfrom", fd, 65536)
+        t1 = yield ("sim_time",)
+        yield ("write", 1, f"rtt={t1 - t0}\n")
+    yield ("close", fd)
+    return 0
+
+
+@app("udp-mesh")
+def udp_mesh(process, argv):
+    """udp-mesh <port> <count> <size> <peer1> <peer2> ... — every host
+    floods every peer while sinking its own port; the 100-host benchmark
+    workload (BASELINE config 2)."""
+    port, count, size = int(argv[0]), int(argv[1]), int(argv[2])
+    peers = argv[3:]
+    fd = yield ("socket", "udp")
+    yield ("bind", fd, (0, port))
+
+    def sender():
+        payload = b"m" * size
+        ips = []
+        for peer in peers:
+            ip = yield ("resolve", peer)
+            ips.append(ip)
+        for i in range(count):
+            for ip in ips:
+                yield ("sendto", fd, payload, (ip, port))
+        yield ("write", 1, f"mesh sent {count * len(peers)}\n")
+
+    yield ("spawn_thread", sender)
+    expect = count * len(peers) * size
+    got = 0
+    while got < expect:
+        data, src = yield ("recvfrom", fd, 65536)
+        got += len(data)
+    yield ("write", 1, f"mesh received {got} bytes\n")
+    return 0

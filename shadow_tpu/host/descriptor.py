@@ -1,0 +1,53 @@
+"""Descriptor table (ref: src/main/host/descriptor/descriptor_table.rs).
+
+Maps fds to file objects, allocating the lowest available fd like Linux.
+File objects are StatusOwner subclasses with a `close(host)` method.
+"""
+
+from __future__ import annotations
+
+import errno
+
+
+class DescriptorTable:
+    __slots__ = ("_fds", "_next_hint")
+
+    def __init__(self):
+        self._fds: dict[int, object] = {}
+        self._next_hint = 0
+
+    # fds 0-2 are reserved for stdio (sys_write special-cases 1/2), so
+    # registered files never alias them.
+    def register(self, file, min_fd: int = 3) -> int:
+        fd = min_fd
+        while fd in self._fds:
+            fd += 1
+        self._fds[fd] = file
+        return fd
+
+    def register_at(self, fd: int, file) -> None:
+        self._fds[fd] = file
+
+    def get(self, fd: int):
+        f = self._fds.get(fd)
+        if f is None:
+            raise OSError(errno.EBADF, "bad file descriptor")
+        return f
+
+    def deregister(self, fd: int):
+        f = self._fds.pop(fd, None)
+        if f is None:
+            raise OSError(errno.EBADF, "bad file descriptor")
+        return f
+
+    def close_all(self, host) -> None:
+        for fd in sorted(self._fds, reverse=True):
+            f = self._fds.pop(fd)
+            if hasattr(f, "close"):
+                f.close(host)
+
+    def open_fds(self):
+        return sorted(self._fds)
+
+    def __len__(self):
+        return len(self._fds)

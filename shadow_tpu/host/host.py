@@ -1,0 +1,222 @@
+"""Host: one emulated Linux system (ref: src/main/host/host.rs).
+
+Owns the private event queue, the network devices (lo/eth0 interfaces,
+CoDel router, three bandwidth relays), the deterministic per-host RNG,
+process table, and the canonical packet trace. A host is single-threaded
+by construction — only cross-host packet pushes touch it from outside,
+and only between rounds (TPU scheduler) or under the queue lock (CPU
+scheduler), mirroring the reference's Root-token concurrency argument
+(SURVEY.md section 5.2).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from shadow_tpu.core.event import (Event, EventQueue, KIND_LOCAL, KIND_PACKET,
+                                   TaskRef)
+from shadow_tpu.core.rng import HostRng
+from shadow_tpu.net.graph import LOCALHOST_IP, format_ip
+from shadow_tpu.net.interface import NetworkInterface
+from shadow_tpu.net.packet import PROTO_TCP
+from shadow_tpu.net.relay import Relay
+from shadow_tpu.net.router import Router
+from shadow_tpu.net.token_bucket import TokenBucket
+
+# Canonical trace kinds, in tiebreak order: a packet sent and dropped at
+# the same instant sorts SND before DRP.
+TRACE_SND = 0
+TRACE_DRP = 1
+TRACE_RCV = 2
+_TRACE_NAMES = {TRACE_SND: "SND", TRACE_DRP: "DRP", TRACE_RCV: "RCV"}
+
+
+class Host:
+    def __init__(self, host_id: int, name: str, ip: int, node_index: int,
+                 seed: int, bw_down_bits: int, bw_up_bits: int,
+                 qdisc: str = "fifo", mtu: int = 1500):
+        self.id = host_id
+        self.name = name
+        self.ip = ip
+        self.node_index = node_index
+        self.rng = HostRng(seed, host_id)
+        self.queue = EventQueue()
+        # Cross-host deliveries land in a locked inbox, not the heap: the
+        # owner pops its heap without a lock (heapq is not thread-safe),
+        # and conservative windows guarantee inbox events are never needed
+        # mid-round (their time is >= window end). Drained at execute().
+        self._inbox: deque = deque()
+        self._inbox_lock = threading.Lock()
+        self._now = 0
+        self._event_seq = 0
+        self._packet_seq = 0
+        self.processes: dict[int, object] = {}
+        self._next_pid = 1000
+        self.data_path = None  # set by the manager; per-host output dir
+
+        # Network plane (host.rs:209-344 construction order).
+        self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)
+        self.eth0 = NetworkInterface(ip, "eth0", qdisc)
+        self.router = Router()
+        self.relay_loopback = Relay(
+            "lo", lambda host, now: self.lo.pop_packet(host, now), None)
+        self.relay_inet_out = Relay(
+            "inet-out", lambda host, now: self.eth0.pop_packet(host, now),
+            TokenBucket.for_bandwidth(bw_up_bits, mtu))
+        self.relay_inet_in = Relay(
+            "inet-in", lambda host, now: self.router.pop_inbound(host, now),
+            TokenBucket.for_bandwidth(bw_down_bits, mtu))
+
+        # Set by the scheduler before the first round.
+        self._send_packet_fn = None
+
+        # Canonical packet trace: (time, kind, src_host, pkt_seq, text).
+        self.trace_entries: list = []
+        self.tracing_enabled = True
+
+        # Counters for sim-stats (sim_stats.rs).
+        self.counters = {"events": 0, "packets_sent": 0, "packets_recv": 0,
+                         "packets_dropped": 0, "syscalls": 0}
+
+    # ------------------------------------------------------------------
+    # Clock & scheduling
+    # ------------------------------------------------------------------
+
+    def now(self) -> int:
+        return self._now
+
+    def next_event_seq(self) -> int:
+        s = self._event_seq
+        self._event_seq += 1
+        return s
+
+    def next_packet_seq(self) -> int:
+        s = self._packet_seq
+        self._packet_seq += 1
+        return s
+
+    def schedule_task_at(self, time: int, task: TaskRef) -> None:
+        assert time >= self._now, f"task {task} scheduled in the past"
+        self.queue.push(Event(time, KIND_LOCAL, self.id,
+                              self.next_event_seq(), task))
+
+    def schedule_task(self, delay_ns: int, task: TaskRef) -> None:
+        self.schedule_task_at(self._now + delay_ns, task)
+
+    # ------------------------------------------------------------------
+    # Round execution (host.rs:749-793)
+    # ------------------------------------------------------------------
+
+    def drain_inbox(self) -> None:
+        """Move cross-host deliveries into the heap (owner thread only)."""
+        if not self._inbox:
+            return
+        with self._inbox_lock:
+            events, self._inbox = self._inbox, deque()
+        for ev in events:
+            self.queue.push(ev)
+
+    def execute(self, until: int) -> None:
+        self.drain_inbox()
+        q = self.queue
+        while True:
+            t = q.peek_time()
+            if t is None or t >= until:
+                break
+            ev = q.pop()
+            self._now = ev.time
+            self.counters["events"] += 1
+            if ev.kind == KIND_PACKET:
+                self.router.route_incoming_packet(self, ev.data)
+            else:
+                ev.data.execute(self)
+
+    def next_event_time(self):
+        return self.queue.peek_time()
+
+    # ------------------------------------------------------------------
+    # Packet plane wiring
+    # ------------------------------------------------------------------
+
+    def get_packet_device(self, dst_ip: int):
+        """Where does a packet addressed to `dst_ip` go next?
+        (host.rs:909-917)"""
+        if dst_ip == LOCALHOST_IP:
+            return self.lo
+        if dst_ip == self.eth0.ip:
+            return self.eth0
+        return self.router
+
+    def notify_router_has_packets(self) -> None:
+        self.relay_inet_in.notify(self)
+
+    def notify_interface_has_packets(self, iface) -> None:
+        if iface is self.lo:
+            self.relay_loopback.notify(self)
+        else:
+            self.relay_inet_out.notify(self)
+
+    def send_packet(self, packet) -> None:
+        """Cross-host exit point — the scheduler owns propagation."""
+        self.counters["packets_sent"] += 1
+        self._send_packet_fn(self, packet)
+
+    def deliver_packet_event(self, event) -> None:
+        """Cross-host entry point (any thread): enqueue into the inbox.
+        The event's time is >= the current window end (propagation clamp),
+        so the owner cannot need it before its next drain."""
+        with self._inbox_lock:
+            self._inbox.append(event)
+
+    # ------------------------------------------------------------------
+    # Processes
+    # ------------------------------------------------------------------
+
+    def add_application(self, start_time_ns: int, spawn_fn) -> None:
+        """Schedule a process spawn at its configured start time
+        (host.rs:363-427)."""
+        self.schedule_task_at(start_time_ns, TaskRef("process-spawn", spawn_fn))
+
+    def register_process(self, process) -> int:
+        pid = self._next_pid
+        self._next_pid += 1
+        self.processes[pid] = process
+        return pid
+
+    def processes_running(self) -> int:
+        return sum(1 for p in self.processes.values() if not p.exited)
+
+    # ------------------------------------------------------------------
+    # Canonical packet trace (the determinism gate's byte-diff target)
+    # ------------------------------------------------------------------
+
+    def trace_packet(self, kind: int, packet, extra: str = "") -> None:
+        if not self.tracing_enabled:
+            return
+        proto = "tcp" if packet.protocol == PROTO_TCP else "udp"
+        text = (f"{_TRACE_NAMES[kind]} {proto} "
+                f"{format_ip(packet.src_ip)}:{packet.src_port}>"
+                f"{format_ip(packet.dst_ip)}:{packet.dst_port} "
+                f"len={len(packet.payload)} id={packet.src_host_id}.{packet.seq}"
+                f"{' ' + extra if extra else ''}")
+        self.trace_entries.append(
+            (self._now, kind, packet.src_host_id, packet.seq, text))
+
+    def trace_drop(self, packet, reason: str) -> None:
+        self.counters["packets_dropped"] += 1
+        self.trace_packet(TRACE_DRP, packet, reason)
+
+    def trace_snd(self, packet) -> None:
+        self.trace_packet(TRACE_SND, packet)
+
+    def trace_rcv(self, packet) -> None:
+        self.counters["packets_recv"] += 1
+        self.trace_packet(TRACE_RCV, packet)
+
+    def trace_lines(self) -> list[str]:
+        """Canonically sorted, scheduler-independent trace lines."""
+        out = []
+        for time, kind, src, seq, text in sorted(self.trace_entries):
+            out.append(f"{time} {self.name} {text}")
+        return out

@@ -1,0 +1,174 @@
+"""Processes and threads for *internal* (Python-coroutine) applications.
+
+Structural mirror of the reference's Process/Thread/ManagedThread resume
+chain (src/main/host/process.rs:1188, thread.rs:471-508,
+managed_thread.rs:190-333), re-targeted at in-process Python apps: an app
+is a generator that `yield`s syscall tuples and receives results; the
+Thread drives it exactly like ManagedThread drives a native process over
+IPC — dispatch the syscall, continue on Done, park on Block, re-run the
+*same* syscall after the condition fires (restart protocol,
+handler/mod.rs:127-136).
+
+The interposition backend for real Linux binaries (preload shim + seccomp
+over shmem IPC) plugs in at the same SyscallHandler seam in a later
+round; nothing above this layer changes.
+"""
+
+from __future__ import annotations
+
+import errno
+
+from shadow_tpu.core.event import TaskRef
+
+ST_RUNNABLE = 0
+ST_BLOCKED = 1
+ST_EXITED = 2
+
+
+class ProcessExit(Exception):
+    def __init__(self, code: int = 0):
+        super().__init__(f"exit({code})")
+        self.code = code
+
+
+class Thread:
+    def __init__(self, process, gen, tid: int):
+        self.process = process
+        self.gen = gen
+        self.tid = tid
+        self.state = ST_RUNNABLE
+        self._started = False
+        self._pending_call = None   # syscall to re-run after unblock
+        self._pending_send = None   # result to feed into the generator
+        self._pending_throw = None  # OSError to raise into the generator
+        self.last_condition = None
+
+    def resume(self, host) -> None:
+        """Drive the app generator until it blocks or exits
+        (managed_thread.rs:190-333 event loop)."""
+        if self.state == ST_EXITED:
+            return
+        self.state = ST_RUNNABLE
+        process = self.process
+        while True:
+            if self._pending_call is not None:
+                call, restarted = self._pending_call, True
+                self._pending_call = None
+            else:
+                try:
+                    if self._pending_throw is not None:
+                        exc, self._pending_throw = self._pending_throw, None
+                        call = self.gen.throw(exc)
+                    elif not self._started:
+                        self._started = True
+                        call = next(self.gen)
+                    else:
+                        call, self._pending_send = (
+                            self.gen.send(self._pending_send), None)
+                except StopIteration as si:
+                    self._exit(host, si.value if isinstance(si.value, int) else 0)
+                    return
+                except ProcessExit as pe:
+                    self._exit(host, pe.code)
+                    return
+                except Exception as e:
+                    # The app let an error escape (syscall OSError or its
+                    # own bug): that crashes the *process*, never the
+                    # simulation — like a native segfault under the
+                    # reference (plugin error, run continues).
+                    import traceback
+                    self._crash(host, "".join(traceback.format_exception(e)))
+                    return
+                restarted = False
+            if not isinstance(call, tuple) or not call:
+                self._crash(host, f"app yielded non-syscall {call!r}")
+                return
+            result = host.syscall_handler.dispatch(host, process, self, call,
+                                                   restarted)
+            host.counters["syscalls"] += 1
+            kind = result[0]
+            if kind == "done":
+                self._pending_send = result[1]
+            elif kind == "exit":
+                self._exit(host, result[1])
+                return
+            elif kind == "error":
+                self._pending_throw = result[1]
+            elif kind == "block":
+                condition = result[1]
+                self._pending_call = call
+                self.last_condition = condition
+                self.state = ST_BLOCKED
+                condition.arm(host, self._wakeup)
+                return
+            else:  # pragma: no cover
+                raise AssertionError(f"bad dispatch result {result!r}")
+
+    def _wakeup(self, host) -> None:
+        if self.state == ST_BLOCKED:
+            self.resume(host)
+
+    def _crash(self, host, why: str) -> None:
+        self.process.stderr += f"[shadow-tpu] thread crash: {why}\n".encode()
+        self._exit(host, 101)
+
+    def _exit(self, host, code: int) -> None:
+        if self.state == ST_EXITED:
+            return
+        self.state = ST_EXITED
+        if self.last_condition is not None:
+            self.last_condition.disarm()
+        self.gen.close()
+        self.process.thread_exited(host, self, code)
+
+
+class Process:
+    def __init__(self, host, name: str, argv: list[str],
+                 env: dict[str, str], expected_final_state="exited 0"):
+        self.host = host
+        self.name = name
+        self.argv = argv
+        self.env = env
+        self.pid = host.register_process(self)
+        self.threads: list[Thread] = []
+        self._next_tid = self.pid
+        self.exited = False
+        self.exit_code: int | None = None
+        self.stdout = bytearray()
+        self.stderr = bytearray()
+        self.expected_final_state = expected_final_state
+        self.fds = host_descriptor_table()
+
+    def spawn_thread(self, host, gen) -> Thread:
+        t = Thread(self, gen, self._next_tid)
+        self._next_tid += 1
+        self.threads.append(t)
+        return t
+
+    def start(self, host, gen) -> None:
+        """Create the main thread and run it now (process.rs:944 spawn)."""
+        t = self.spawn_thread(host, gen)
+        t.resume(host)
+
+    def thread_exited(self, host, thread, code: int) -> None:
+        if all(t.state == ST_EXITED for t in self.threads):
+            # Last thread's exit code is the process exit code (like the
+            # main-thread exit in the reference's zombie handling).
+            self.exited = True
+            self.exit_code = code
+            self.fds.close_all(host)
+
+    def matches_expected_final_state(self) -> bool:
+        expected = self.expected_final_state
+        if expected in ("running", "any"):
+            return expected == "any" or not self.exited
+        if isinstance(expected, str) and expected.startswith("exited"):
+            parts = expected.split()
+            want = int(parts[1]) if len(parts) > 1 else 0
+            return self.exited and self.exit_code == want
+        return True
+
+
+def host_descriptor_table():
+    from shadow_tpu.host.descriptor import DescriptorTable
+    return DescriptorTable()

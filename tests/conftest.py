@@ -2,7 +2,11 @@
 
 Multi-chip TPU hardware is not available in CI; sharded code paths are
 validated on a virtual 8-device CPU mesh instead (same XLA semantics).
-Must run before anything imports jax.
+
+The env vars must be set before jax import; the config update must ALSO
+happen because the site's TPU plugin (axon) overrides jax_platforms at
+interpreter startup, and initializing its backend needs a live tunnel —
+tests must never depend on that.
 """
 
 import os
@@ -12,3 +16,7 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

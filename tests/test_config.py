@@ -1,0 +1,91 @@
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+
+BASIC = """
+general:
+  stop_time: 10s
+  seed: 7
+network:
+  graph:
+    type: 1_gbit_switch
+experimental:
+  scheduler: serial
+  runahead: 2 ms
+hosts:
+  client:
+    network_node_id: 0
+    processes:
+      - path: tgen
+        args: client server 1000
+        start_time: 1s
+  server:
+    network_node_id: 0
+    ip_addr: 11.0.0.9
+    bandwidth_down: 10 Mbit
+    processes:
+      - path: tgen
+        args: [server, "80"]
+"""
+
+
+def test_basic_config_parses():
+    cfg = ConfigOptions.from_yaml_text(BASIC)
+    assert cfg.general.stop_time_ns == 10 * 10**9
+    assert cfg.general.seed == 7
+    assert cfg.experimental.scheduler == "serial"
+    assert cfg.experimental.runahead_ns == 2_000_000
+    assert set(cfg.hosts) == {"client", "server"}
+    client = cfg.hosts["client"]
+    assert client.processes[0].args == ["client", "server", "1000"]
+    assert client.processes[0].start_time_ns == 10**9
+    server = cfg.hosts["server"]
+    assert server.ip_addr is not None
+    assert server.bandwidth_down_bits == 10**7
+    assert server.processes[0].args == ["server", "80"]
+
+
+def test_x_extension_keys_ignored_and_merge_keys_work():
+    text = """
+x-common: &proc
+  path: tgen
+  start_time: 2s
+general: { stop_time: 1s }
+network: { graph: { type: 1_gbit_switch } }
+hosts:
+  a:
+    network_node_id: 0
+    processes: [ { <<: *proc, args: hi } ]
+"""
+    cfg = ConfigOptions.from_yaml_text(text)
+    p = cfg.hosts["a"].processes[0]
+    assert p.path == "tgen" and p.start_time_ns == 2 * 10**9
+    assert p.args == ["hi"]
+
+
+def test_missing_stop_time_rejected():
+    with pytest.raises(ValueError, match="stop_time"):
+        ConfigOptions.from_yaml_text(
+            "general: {}\nnetwork: {graph: {type: 1_gbit_switch}}\n"
+            "hosts: {a: {network_node_id: 0}}")
+
+
+def test_unknown_scheduler_rejected():
+    with pytest.raises(ValueError, match="scheduler"):
+        ConfigOptions.from_yaml_text(BASIC.replace("serial", "gpu"))
+
+
+def test_inline_gml_graph():
+    text = """
+general: { stop_time: 1s }
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 ]
+        edge [ source 0 target 0 latency "3 ms" ] ]
+hosts: { a: { network_node_id: 0 } }
+"""
+    cfg = ConfigOptions.from_yaml_text(text)
+    cfg.network.graph.compute_routing()
+    assert cfg.network.graph.latency_ns[0, 0] == 3_000_000

@@ -1,0 +1,87 @@
+import numpy as np
+import pytest
+
+from shadow_tpu.net import graph as netgraph
+
+TRIANGLE = """
+graph [
+  directed 0
+  node [ id 10 label "a" host_bandwidth_down "100 Mbit" host_bandwidth_up "50 Mbit" ]
+  node [ id 20 label "b" ]
+  node [ id 30 label "c" ]
+  edge [ source 10 target 20 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 20 target 30 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 10 target 30 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def test_gml_parse_nodes_edges():
+    g = netgraph.NetworkGraph.from_gml(TRIANGLE)
+    assert g.num_nodes == 3
+    assert not g.directed
+    assert g.nodes[0].bandwidth_down_bits == 10**8
+    assert g.nodes[0].bandwidth_up_bits == 5 * 10**7
+    assert len(g.edges) == 3
+    assert g.edges[0].latency_ns == 10_000_000
+
+
+def test_shortest_path_latency_and_loss():
+    g = netgraph.NetworkGraph.from_gml(TRIANGLE)
+    g.compute_routing(use_shortest_path=True)
+    # a->c goes via b: 20ms < 50ms direct.
+    a, b, c = 0, 1, 2
+    assert g.latency_ns[a, c] == 20_000_000
+    # loss along a-b-c: 1 - 0.9*0.9
+    assert np.isclose(g.packet_loss[a, c], 1 - 0.9 * 0.9)
+    assert g.latency_ns[a, b] == 10_000_000
+    assert np.isclose(g.packet_loss[a, b], 0.1)
+    # symmetric (undirected)
+    assert g.latency_ns[c, a] == 20_000_000
+
+
+def test_direct_paths_only():
+    g = netgraph.NetworkGraph.from_gml(TRIANGLE)
+    g.compute_routing(use_shortest_path=False)
+    assert g.latency_ns[0, 2] == 50_000_000
+    assert g.packet_loss[0, 2] == 0.0
+
+
+def test_self_path_defaults():
+    g = netgraph.NetworkGraph.named("1_gbit_switch")
+    g.compute_routing()
+    assert g.latency_ns[0, 0] == 1_000_000  # explicit self-loop 1ms
+    assert g.min_latency_ns() == 1_000_000
+
+
+def test_unreachable_is_never():
+    from shadow_tpu.core.simtime import TIME_NEVER
+    gml = """graph [ directed 0
+      node [ id 0 ] node [ id 1 ] node [ id 2 ]
+      edge [ source 0 target 1 latency "5 ms" ] ]"""
+    g = netgraph.NetworkGraph.from_gml(gml)
+    g.compute_routing()
+    assert g.latency_ns[0, 2] == TIME_NEVER
+    assert g.latency_ns[0, 1] == 5_000_000
+
+
+def test_zero_latency_rejected():
+    gml = """graph [ node [ id 0 ] edge [ source 0 target 0 latency "0 ms" ] ]"""
+    with pytest.raises(ValueError):
+        netgraph.NetworkGraph.from_gml(gml)
+
+
+def test_ip_assignment_and_parsing():
+    ipa = netgraph.IpAssignment()
+    ip1 = ipa.assign(0)
+    ip2 = ipa.assign(1)
+    assert ip1 != ip2
+    assert netgraph.format_ip(ip1) == "11.0.0.1"
+    assert ipa.node_for_ip(ip1) == 0
+    explicit = netgraph.parse_ip("11.0.5.5")
+    ipa.assign(2, explicit)
+    assert ipa.node_for_ip(explicit) == 2
+    with pytest.raises(ValueError):
+        ipa.assign(3, explicit)  # duplicate
+    with pytest.raises(ValueError):
+        netgraph.parse_ip("300.1.2.3")

@@ -1,0 +1,37 @@
+import pytest
+
+from shadow_tpu.utils import units
+
+
+def test_time_parsing():
+    assert units.parse_time_ns("10 ms") == 10_000_000
+    assert units.parse_time_ns("1s") == 1_000_000_000
+    assert units.parse_time_ns("1.5 s") == 1_500_000_000
+    assert units.parse_time_ns("250 us") == 250_000
+    assert units.parse_time_ns("7 ns") == 7
+    assert units.parse_time_ns("2 min") == 120 * 10**9
+    assert units.parse_time_ns(3) == 3 * 10**9  # bare number = seconds
+    assert units.parse_time_ns("3") == 3 * 10**9
+
+
+def test_bandwidth_parsing():
+    assert units.parse_bandwidth_bits("1 Gbit") == 10**9
+    assert units.parse_bandwidth_bits("100 Mbit") == 10**8
+    assert units.parse_bandwidth_bits("56 kbit") == 56_000
+    assert units.parse_bandwidth_bits("8 bit") == 8
+
+
+def test_bytes_parsing():
+    assert units.parse_bytes("16 MiB") == 16 * 2**20
+    assert units.parse_bytes("131072 B") == 131072
+    assert units.parse_bytes("2 KB") == 2000
+    assert units.parse_bytes(512) == 512
+
+
+def test_rejects_garbage():
+    with pytest.raises(ValueError):
+        units.parse_time_ns("10 parsecs")
+    with pytest.raises(ValueError):
+        units.parse_bandwidth_bits("fast")
+    with pytest.raises(ValueError):
+        units.parse_bytes("12 smoots")
