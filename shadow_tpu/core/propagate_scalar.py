@@ -51,15 +51,16 @@ class ScalarPropagator:
             src_host.trace_drop(packet, "no-route")
             return
         dst_host = self.hosts[dst_id]
+
+        # Event sequence is consumed *before* the reachability and loss
+        # decisions so the numbering is identical on the batched path
+        # (where both are decided later, on device).
+        seq = src_host.next_event_seq()
+
         latency = int(self.latency[src_host.node_index, dst_host.node_index])
         if latency >= TIME_NEVER:
             src_host.trace_drop(packet, "unreachable")
             return
-
-        # Event sequence is consumed *before* the loss decision so the
-        # numbering is identical on the batched path (where losses are
-        # decided later, on device).
-        seq = src_host.next_event_seq()
 
         threshold = int(self.thresholds[src_host.node_index,
                                         dst_host.node_index])

@@ -191,7 +191,8 @@ class Host:
     # Canonical packet trace (the determinism gate's byte-diff target)
     # ------------------------------------------------------------------
 
-    def trace_packet(self, kind: int, packet, extra: str = "") -> None:
+    def trace_packet(self, kind: int, packet, extra: str = "",
+                     at_time: int | None = None) -> None:
         if not self.tracing_enabled:
             return
         proto = "tcp" if packet.protocol == PROTO_TCP else "udp"
@@ -200,12 +201,17 @@ class Host:
                 f"{format_ip(packet.dst_ip)}:{packet.dst_port} "
                 f"len={len(packet.payload)} id={packet.src_host_id}.{packet.seq}"
                 f"{' ' + extra if extra else ''}")
+        t = self._now if at_time is None else at_time
         self.trace_entries.append(
-            (self._now, kind, packet.src_host_id, packet.seq, text))
+            (t, kind, packet.src_host_id, packet.seq, text))
 
-    def trace_drop(self, packet, reason: str) -> None:
+    def trace_drop(self, packet, reason: str,
+                   at_time: int | None = None) -> None:
+        """`at_time` lets the batched propagator record drops at the send
+        instant after the round has moved on; canonical sorting makes the
+        resulting trace identical to the scalar path's."""
         self.counters["packets_dropped"] += 1
-        self.trace_packet(TRACE_DRP, packet, reason)
+        self.trace_packet(TRACE_DRP, packet, reason, at_time=at_time)
 
     def trace_snd(self, packet) -> None:
         self.trace_packet(TRACE_SND, packet)

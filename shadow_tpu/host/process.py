@@ -134,6 +134,7 @@ class Process:
         self._next_tid = self.pid
         self.exited = False
         self.exit_code: int | None = None
+        self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
         self.stderr = bytearray()
         self.expected_final_state = expected_final_state
@@ -151,11 +152,14 @@ class Process:
         t.resume(host)
 
     def thread_exited(self, host, thread, code: int) -> None:
+        if code != 0 and self._nonzero_exit is None:
+            self._nonzero_exit = code
         if all(t.state == ST_EXITED for t in self.threads):
-            # Last thread's exit code is the process exit code (like the
-            # main-thread exit in the reference's zombie handling).
+            # A crashed helper thread must not be masked by a clean main
+            # thread: any nonzero thread exit becomes the process code.
             self.exited = True
-            self.exit_code = code
+            self.exit_code = (self._nonzero_exit
+                              if self._nonzero_exit is not None else code)
             self.fds.close_all(host)
 
     def matches_expected_final_state(self) -> bool:

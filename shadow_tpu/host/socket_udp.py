@@ -32,7 +32,10 @@ class UdpSocket(StatusOwner):
         self.local = None       # (ip, port) after bind
         self.peer = None        # (ip, port) after connect
         self._ifaces = []       # interfaces we're associated on
-        self._send_q: deque = deque()
+        # Separate send queue per interface: the loopback relay must never
+        # drain remote-destined packets (which would bypass the upload
+        # token bucket) and vice versa.
+        self._send_q: dict[str, deque] = {"lo": deque(), "eth0": deque()}
         self._send_bytes = 0
         self._send_max = send_buf
         self._recv_q: deque = deque()
@@ -118,19 +121,21 @@ class UdpSocket(StatusOwner):
         p = pkt.Packet(host.id, seq, self.protocol, src_ip, self.local[1],
                        dst_ip, dst_port, payload=bytes(data))
         p.priority = seq
-        self._send_q.append(p)
-        self._send_bytes += size
         iface = host.lo if dst_ip == LOCALHOST_IP else host.eth0
+        self._send_q[iface.name].append(p)
+        self._send_bytes += size
         iface.notify_socket_has_packets(host, self)
         return len(data)
 
-    def peek_next_packet_priority(self):
-        return self._send_q[0].priority if self._send_q else None
+    def peek_next_packet_priority(self, iface):
+        q = self._send_q[iface.name]
+        return q[0].priority if q else None
 
-    def pull_out_packet(self, host):
-        if not self._send_q:
+    def pull_out_packet(self, host, iface):
+        q = self._send_q[iface.name]
+        if not q:
             return None
-        p = self._send_q.popleft()
+        p = q.popleft()
         self._send_bytes -= p.total_size()
         if not self.has_status(S_CLOSED):
             self.adjust_status(host, S_WRITABLE, 0)
@@ -140,19 +145,20 @@ class UdpSocket(StatusOwner):
     # Receive path
     # ------------------------------------------------------------------
 
-    def push_in_packet(self, host, packet) -> None:
+    def push_in_packet(self, host, packet) -> bool:
         if self.peer is not None and \
                 (packet.src_ip, packet.src_port) != self.peer:
             host.trace_drop(packet, "udp-connected-filter")
-            return
+            return False
         size = packet.total_size()
         if self._recv_bytes + size > self._recv_max:
             self.drops_full_recv += 1
             host.trace_drop(packet, "rcvbuf-full")
-            return
+            return False
         self._recv_q.append(packet)
         self._recv_bytes += size
         self.adjust_status(host, S_READABLE, 0)
+        return True
 
     def recvfrom(self, host, bufsize: int):
         if not self._recv_q:

@@ -236,16 +236,20 @@ class NetworkGraph:
             final_lat = lat
             loss = edge_loss.copy()
 
-        # Self-paths: prefer an explicit self-loop's properties; otherwise
-        # local latency is the minimum outgoing edge latency (the reference
-        # requires a self-loop for hosts on the same node; we degrade
-        # gracefully to 1us to keep runahead positive).
+        # Self-paths (applied uniformly in both routing modes): prefer an
+        # explicit self-loop edge; otherwise use the node's minimum
+        # outgoing edge latency as the local-delivery cost. That keeps
+        # min_latency_ns() — and with it the runahead window — equal to a
+        # *real* edge latency instead of an arbitrary tiny constant, and
+        # a truly isolated node's diagonal stays unreachable.
         for i in range(V):
             if np.isfinite(lat[i, i]) and lat[i, i] > 0:
                 final_lat[i, i] = lat[i, i]
                 loss[i, i] = edge_loss[i, i]
-            elif final_lat[i, i] == 0:
-                final_lat[i, i] = 1_000
+            else:
+                out_edges = np.concatenate([lat[i, :i], lat[i, i + 1:]])
+                finite = out_edges[np.isfinite(out_edges)]
+                final_lat[i, i] = finite.min() if finite.size else np.inf
                 loss[i, i] = 0.0
 
         out = np.where(np.isfinite(final_lat), final_lat, TIME_NEVER)

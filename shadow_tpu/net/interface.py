@@ -74,15 +74,15 @@ class NetworkInterface:
     def notify_socket_has_packets(self, host, socket) -> None:
         if socket in self._queued:
             return
-        if socket.peek_next_packet_priority() is None:
+        if socket.peek_next_packet_priority(self) is None:
             return
         self._queued.add(socket)
         if self.qdisc == QDISC_ROUND_ROBIN:
             self._send_ready.append(socket)
         else:
             heapq.heappush(self._send_heap,
-                           (socket.peek_next_packet_priority(), id(socket),
-                            socket))
+                           (socket.peek_next_packet_priority(self),
+                            id(socket), socket))
         # Kick the relay that drains this interface.
         host.notify_interface_has_packets(self)
 
@@ -92,15 +92,15 @@ class NetworkInterface:
             socket = self._next_queued_socket()
             if socket is None:
                 return None
-            packet = socket.pull_out_packet(host)
+            packet = socket.pull_out_packet(host, self)
             # Re-queue the socket if it still has packets.
-            if socket.peek_next_packet_priority() is not None:
+            if socket.peek_next_packet_priority(self) is not None:
                 self._queued.add(socket)
                 if self.qdisc == QDISC_ROUND_ROBIN:
                     self._send_ready.append(socket)
                 else:
                     heapq.heappush(self._send_heap,
-                                   (socket.peek_next_packet_priority(),
+                                   (socket.peek_next_packet_priority(self),
                                     id(socket), socket))
             if packet is not None:
                 self.packets_sent += 1
@@ -144,6 +144,6 @@ class NetworkInterface:
             # hook here later, matching legacy_tcp behavior).
             host.trace_drop(packet, "no-socket")
             return
-        packet.record(pkt.ST_RCV_DELIVERED)
-        host.trace_rcv(packet)
-        socket.push_in_packet(host, packet)
+        if socket.push_in_packet(host, packet):
+            packet.record(pkt.ST_RCV_DELIVERED)
+            host.trace_rcv(packet)

@@ -1,0 +1,187 @@
+"""Batched cross-host packet propagation — the TPU data path.
+
+This is the north-star kernel (SURVEY.md section 3.4): the reference
+walks every in-flight packet through `Worker::send_packet` — a scalar,
+lock-per-push path doing a latency lookup, a sequential-RNG loss draw,
+and a clamp (src/main/core/worker.rs:324-397). Here a whole round's
+packets, across *all* hosts, become one jitted XLA program:
+
+    latency  = L[src_node, dst_node]          # vectorized gather
+    bits     = threefry2x32(key, (src_host, packet_seq))
+    drop     = bits < T[src_node, dst_node]   # counter-based, order-free
+    deliver  = max(t_send + latency, window_end)
+    barrier  = min(deliver | keep)            # feeds the round reduction
+
+Shapes are padded to power-of-two buckets so XLA compiles a handful of
+programs total; `window_end`/`bootstrap_end` ride as dynamic scalars.
+Byte-identical to the scalar path by construction: same integer latency
+matrix, same integer thresholds, same threefry bits (tests/test_parity).
+
+Multi-device sharding of the host dimension (ops sharded over a Mesh,
+`lax.pmin` barrier) layers on top in shadow_tpu/parallel/.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu.core.event import Event, KIND_PACKET
+from shadow_tpu.core.rng import STREAM_PACKET_LOSS, mix_key, threefry2x32_jax
+from shadow_tpu.core.simtime import TIME_NEVER
+from shadow_tpu.net import packet as pktmod
+
+_I64_MAX = (1 << 63) - 1
+_MIN_BUCKET = 256
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def build_propagate_kernel(latency_ns: np.ndarray, thresholds: np.ndarray,
+                           k0: int, k1: int):
+    """Returns a jitted fn(src_node, dst_node, src_host, pkt_seq, t_send,
+    is_ctl, valid, window_end, after_bootstrap_mask_base) -> arrays.
+
+    The routing matrices are closed over and transferred to the device
+    once; per-round traffic is O(packets), not O(V^2).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    lat = jnp.asarray(latency_ns, dtype=jnp.int64)
+    thr = jnp.asarray(thresholds, dtype=jnp.int64)
+    key0 = jnp.uint32(k0)
+    key1 = jnp.uint32(k1)
+
+    @jax.jit
+    def kernel(src_node, dst_node, src_host, pkt_seq, t_send, is_ctl, valid,
+               window_end, bootstrap_end):
+        latency = lat[src_node, dst_node]
+        reachable = latency < TIME_NEVER
+        bits, _ = threefry2x32_jax(key0, key1, src_host.astype(jnp.uint32),
+                                   pkt_seq)
+        threshold = thr[src_node, dst_node]
+        lossy = (bits.astype(jnp.int64) < threshold) \
+            & jnp.logical_not(is_ctl) & (t_send >= bootstrap_end)
+        deliver = jnp.maximum(t_send + latency, window_end)
+        keep = valid & reachable & jnp.logical_not(lossy)
+        min_deliver = jnp.min(jnp.where(keep, deliver, _I64_MAX))
+        min_latency = jnp.min(
+            jnp.where(valid & reachable, latency, _I64_MAX))
+        return deliver, keep, reachable, lossy, min_deliver, min_latency
+
+    return kernel
+
+
+class TpuPropagator:
+    """Drop-in replacement for ScalarPropagator behind `--scheduler=tpu`.
+
+    send() only buffers metadata; the kernel runs once per round in
+    finish_round(), then kept packets scatter into destination inboxes in
+    outbox order (per-source order preserved => identical event seqs)."""
+
+    def __init__(self, hosts, dns, latency_ns, loss_thresholds, seed: int,
+                 bootstrap_end_ns: int, max_batch: int = 1 << 20,
+                 runahead=None):
+        self.hosts = hosts
+        self.dns = dns
+        k0, k1 = mix_key(seed, STREAM_PACKET_LOSS)
+        self.kernel = build_propagate_kernel(latency_ns, loss_thresholds,
+                                             k0, k1)
+        self.bootstrap_end = bootstrap_end_ns
+        self.max_batch = max_batch
+        self.runahead = runahead
+        self.window_end = 0
+        # Outbox: parallel scalar lists + the packet/event bookkeeping.
+        self._src_node: list[int] = []
+        self._dst_node: list[int] = []
+        self._src_host: list[int] = []
+        self._pkt_seq: list[int] = []
+        self._t_send: list[int] = []
+        self._is_ctl: list[bool] = []
+        self._meta: list = []  # (src_host_obj, dst_host_obj, evt_seq, packet)
+        self.rounds_dispatched = 0
+        self.packets_batched = 0
+
+    def begin_round(self, window_start: int, window_end: int) -> None:
+        self.window_end = window_end
+
+    def send(self, src_host, packet) -> None:
+        dst_id = self.dns.host_id_for_ip(packet.dst_ip)
+        if dst_id is None:
+            src_host.trace_drop(packet, "no-route")
+            return
+        dst_host = self.hosts[dst_id]
+        seq = src_host.next_event_seq()
+        self._src_node.append(src_host.node_index)
+        self._dst_node.append(dst_host.node_index)
+        self._src_host.append(src_host.id)
+        self._pkt_seq.append(packet.seq & 0xFFFFFFFF)
+        self._t_send.append(src_host.now())
+        self._is_ctl.append(packet.is_empty_control())
+        self._meta.append((src_host, dst_host, seq, packet))
+
+    def finish_round(self):
+        n = len(self._meta)
+        if n == 0:
+            return None
+        import jax.numpy as jnp
+
+        b = _bucket(n)
+        pad = b - n
+
+        def arr(lst, dtype):
+            a = np.zeros(b, dtype=dtype)
+            a[:n] = lst
+            return a
+
+        deliver, keep, reachable, lossy, min_deliver, min_latency = \
+            self.kernel(
+                arr(self._src_node, np.int32), arr(self._dst_node, np.int32),
+                arr(self._src_host, np.int64), arr(self._pkt_seq, np.uint32),
+                arr(self._t_send, np.int64), arr(self._is_ctl, bool),
+                np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]),
+                jnp.int64(self.window_end), jnp.int64(self.bootstrap_end))
+
+        deliver = np.asarray(deliver)
+        keep = np.asarray(keep)
+        reachable = np.asarray(reachable)
+        lossy = np.asarray(lossy)
+        self.rounds_dispatched += 1
+        self.packets_batched += n
+
+        # Scatter (outbox order => per-source event order is preserved).
+        meta = self._meta
+        t_send = self._t_send
+        for i in range(n):
+            src_host, dst_host, seq, packet = meta[i]
+            if keep[i]:
+                t = int(deliver[i])
+                packet.arrival_time = t
+                dst_host.deliver_packet_event(
+                    Event(t, KIND_PACKET, src_host.id, seq, packet))
+            elif not reachable[i]:
+                src_host.trace_drop(packet, "unreachable", at_time=t_send[i])
+            elif lossy[i]:
+                packet.record(pktmod.ST_INET_DROPPED)
+                src_host.trace_drop(packet, "inet-loss", at_time=t_send[i])
+
+        if self.runahead is not None:
+            ml = int(min_latency)
+            if ml < _I64_MAX:
+                self.runahead.update_lowest_used_latency(ml)
+
+        self._src_node.clear()
+        self._dst_node.clear()
+        self._src_host.clear()
+        self._pkt_seq.clear()
+        self._t_send.clear()
+        self._is_ctl.clear()
+        self._meta.clear()
+
+        md = int(min_deliver)
+        return md if md < _I64_MAX else None

@@ -1,0 +1,96 @@
+"""The determinism gate: the batched (TPU-path) propagator must produce
+byte-identical packet traces to the scalar CPU path (BASELINE.md: 'byte-
+identical packet-delivery traces'). Runs on the virtual CPU backend in CI;
+the same jitted kernel runs on real TPU hardware unchanged."""
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+
+MULTI_NODE = """
+general: {{ stop_time: 20s, seed: {seed} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 host_bandwidth_down "50 Mbit" host_bandwidth_up "50 Mbit" ]
+        node [ id 1 host_bandwidth_down "20 Mbit" host_bandwidth_up "20 Mbit" ]
+        node [ id 2 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "2 ms" ]
+        edge [ source 0 target 1 latency "30 ms" packet_loss 0.02 ]
+        edge [ source 1 target 2 latency "10 ms" packet_loss 0.1 ]
+        edge [ source 0 target 2 latency "55 ms" ]
+        edge [ source 1 target 1 latency "1 ms" ]
+        edge [ source 2 target 2 latency "1 ms" ]
+      ]
+experimental: {{ scheduler: {scheduler} }}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-flood, args: [bravo, "7000", "80", "900"], start_time: 1s }}
+      - {{ path: udp-sink, args: ["7100"], expected_final_state: running }}
+  bravo:
+    network_node_id: 1
+    processes:
+      - {{ path: udp-sink, args: ["7000"], expected_final_state: running }}
+      - {{ path: udp-flood, args: [charlie, "7200", "60", "700"], start_time: 2s }}
+  charlie:
+    network_node_id: 2
+    processes:
+      - {{ path: udp-sink, args: ["7200"], expected_final_state: running }}
+      - {{ path: udp-flood, args: [alpha, "7100", "40", "500"], start_time: 3s }}
+"""
+
+
+def run(scheduler, seed=11):
+    cfg = ConfigOptions.from_yaml_text(
+        MULTI_NODE.format(scheduler=scheduler, seed=seed))
+    return run_simulation(cfg)
+
+
+def test_tpu_trace_byte_identical_to_serial():
+    m_cpu, s_cpu = run("serial")
+    m_tpu, s_tpu = run("tpu")
+    assert s_cpu.ok and s_tpu.ok
+    cpu_lines = m_cpu.trace_lines()
+    tpu_lines = m_tpu.trace_lines()
+    assert len(cpu_lines) > 100
+    assert cpu_lines == tpu_lines
+    assert s_cpu.rounds == s_tpu.rounds
+    assert s_cpu.packets_recv == s_tpu.packets_recv
+    assert s_cpu.packets_dropped == s_tpu.packets_dropped
+    # Losses actually occurred on the lossy edges (the RNG parity matters).
+    assert any("inet-loss" in l for l in cpu_lines)
+
+
+def test_tpu_trace_byte_identical_across_seeds():
+    for seed in (1, 99):
+        m_cpu, _ = run("serial", seed)
+        m_tpu, _ = run("tpu", seed)
+        assert m_cpu.trace_lines() == m_tpu.trace_lines()
+
+
+def test_tpu_batches_packets():
+    m, s = run("tpu")
+    assert m.propagator.rounds_dispatched > 0
+    assert m.propagator.packets_batched == s.packets_sent
+    # Batching must not change stdout of the apps either.
+    m2, _ = run("serial")
+    out_tpu = {(h.name, p.name): bytes(p.stdout) for h in m.hosts
+               for p in h.processes.values()}
+    out_cpu = {(h.name, p.name): bytes(p.stdout) for h in m2.hosts
+               for p in h.processes.values()}
+    assert out_tpu == out_cpu
+
+
+def test_tpu_bootstrap_period_suppresses_loss():
+    text = MULTI_NODE.format(scheduler="tpu", seed=5).replace(
+        "general: { stop_time: 20s, seed: 5 }",
+        "general: { stop_time: 20s, seed: 5, bootstrap_end_time: 15s }")
+    cfg = ConfigOptions.from_yaml_text(text)
+    m, s = run_simulation(cfg)
+    # All floods finish well before 15s; no loss drops should appear.
+    assert not any("inet-loss" in l for l in m.trace_lines())
