@@ -95,6 +95,69 @@ def udp_pinger(process, argv):
     return 0
 
 
+@app("tgen-server")
+def tgen_server(process, argv):
+    """tgen-server <port> — serves: each connection sends a line
+    'GET <nbytes>', receives that many bytes back, then EOF. The
+    tgen-equivalent file-transfer server (reference test workloads use
+    the real tgen binary the same way)."""
+    port = int(argv[0])
+    fd = yield ("socket", "tcp")
+    yield ("bind", fd, (0, port))
+    yield ("listen", fd, 64)
+
+    def serve(conn_fd):
+        def handler():
+            req = b""
+            while not req.endswith(b"\n"):
+                chunk = yield ("recv", conn_fd, 4096)
+                if chunk == b"":
+                    yield ("close", conn_fd)
+                    return
+                req += chunk
+            n = int(req.decode().split()[1])
+            payload = b"D" * 65536
+            sent = 0
+            while sent < n:
+                take = min(65536, n - sent)
+                sent += yield ("send", conn_fd, payload[:take])
+            yield ("shutdown", conn_fd, "wr")
+            # Drain until the client closes, then release the fd.
+            while (yield ("recv", conn_fd, 4096)) != b"":
+                pass
+            yield ("close", conn_fd)
+        return handler
+
+    while True:
+        conn_fd, peer = yield ("accept", fd)
+        yield ("spawn_thread", serve(conn_fd))
+
+
+@app("tgen-client")
+def tgen_client(process, argv):
+    """tgen-client <server> <port> <nbytes> [count] — performs `count`
+    sequential downloads of nbytes each and reports completion times."""
+    server, port, nbytes = argv[0], int(argv[1]), int(argv[2])
+    count = int(argv[3]) if len(argv) > 3 else 1
+    ip = yield ("resolve", server)
+    for i in range(count):
+        t0 = yield ("sim_time",)
+        fd = yield ("socket", "tcp")
+        yield ("connect", fd, (ip, port))
+        yield ("send", fd, f"GET {nbytes}\n".encode())
+        got = 0
+        while got < nbytes:
+            chunk = yield ("recv", fd, 1 << 16)
+            if chunk == b"":
+                break
+            got += len(chunk)
+        yield ("close", fd)
+        t1 = yield ("sim_time",)
+        ok = "ok" if got == nbytes else f"SHORT {got}"
+        yield ("write", 1, f"transfer {i} {ok} bytes={got} ns={t1 - t0}\n")
+    return 0
+
+
 @app("udp-mesh")
 def udp_mesh(process, argv):
     """udp-mesh <port> <count> <size> <peer1> <peer2> ... — every host

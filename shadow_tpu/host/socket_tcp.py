@@ -1,0 +1,372 @@
+"""TCP sockets: the simulated-kernel adapter around the sans-I/O
+TcpConnection (ref: src/main/host/descriptor/socket/inet/tcp.rs wrapping
+src/lib/tcp — same split: protocol logic in the crate, kernel glue here).
+
+A TcpSocket is either a *listener* (accept queue of handshaking children)
+or a *stream* (one TcpConnection). Children are created on inbound SYN,
+registered under their specific 4-tuple, and surface through accept()
+once established.
+"""
+
+from __future__ import annotations
+
+import errno
+from collections import deque
+
+from shadow_tpu.core.event import TaskRef
+from shadow_tpu.host.condition import SyscallCondition
+from shadow_tpu.host.status import (S_ACTIVE, S_CLOSED, S_READABLE,
+                                    S_WRITABLE, StatusOwner)
+from shadow_tpu.net import packet as pkt
+from shadow_tpu.net.graph import LOCALHOST_IP
+from shadow_tpu.tcp import connection as tcpc
+
+INADDR_ANY = 0
+EPHEMERAL_LO = 32_768
+EPHEMERAL_HI = 65_536
+
+
+class TcpSocket(StatusOwner):
+    def __init__(self, host, send_buf: int, recv_buf: int):
+        super().__init__()
+        self.protocol = pkt.PROTO_TCP
+        self.local = None
+        self.peer = None
+        self.nonblocking = False
+        self._send_buf_max = send_buf
+        self._recv_buf_max = recv_buf
+        self._ifaces = []
+        self._iface = None            # the interface a stream runs on
+        self.conn: tcpc.TcpConnection | None = None
+        # Listener state.
+        self.listening = False
+        self._backlog = 0
+        self._accept_q: deque = deque()
+        self._listener = None         # backref for children
+        self._accept_queued = False
+        # Egress packets ready for the interface, per interface name.
+        self._out_packets: dict[str, deque] = {"lo": deque(), "eth0": deque()}
+        self._timer_deadline: int | None = None
+        self._status = S_ACTIVE
+
+    # ------------------------------------------------------------------
+    # Binding / connecting / listening
+    # ------------------------------------------------------------------
+
+    def _pick_interfaces(self, host, ip: int):
+        if ip == INADDR_ANY:
+            return [host.lo, host.eth0]
+        if ip == LOCALHOST_IP:
+            return [host.lo]
+        if ip == host.eth0.ip:
+            return [host.eth0]
+        raise OSError(errno.EADDRNOTAVAIL, "cannot bind non-local address")
+
+    def bind(self, host, ip: int, port: int) -> None:
+        if self.local is not None:
+            raise OSError(errno.EINVAL, "already bound")
+        ifaces = self._pick_interfaces(host, ip)
+        if port == 0:
+            port = self._ephemeral_port(host, ifaces)
+        else:
+            for iface in ifaces:
+                if iface.is_associated(self.protocol, port):
+                    raise OSError(errno.EADDRINUSE, "address already in use")
+        for iface in ifaces:
+            iface.associate(self, self.protocol, port)
+        self._ifaces = ifaces
+        self.local = (ip, port)
+
+    def _ephemeral_port(self, host, ifaces) -> int:
+        for _ in range(64):
+            port = host.rng.randrange(EPHEMERAL_LO, EPHEMERAL_HI)
+            if not any(i.is_associated(self.protocol, port) for i in ifaces):
+                return port
+        for port in range(EPHEMERAL_LO, EPHEMERAL_HI):
+            if not any(i.is_associated(self.protocol, port) for i in ifaces):
+                return port
+        raise OSError(errno.EADDRINUSE, "no free ephemeral ports")
+
+    def listen(self, host, backlog: int = 128) -> None:
+        if self.local is None:
+            raise OSError(errno.EINVAL, "listen before bind")
+        if self.conn is not None:
+            raise OSError(errno.EISCONN, "already connected")
+        self.listening = True
+        self._backlog = max(1, backlog)
+
+    def connect(self, host, ip: int, port: int):
+        """Active open. Returns 0 when established, a SyscallCondition
+        while the handshake is in flight (caller blocks), raises on
+        failure. Re-entered with the same args after unblock (restart
+        protocol)."""
+        if self.listening:
+            raise OSError(errno.EOPNOTSUPP, "listener cannot connect")
+        if self.conn is not None:
+            if (ip, port) != (self.peer or (None, None)):
+                raise OSError(errno.EISCONN, "already connected")
+            if self.conn.error:
+                code = (errno.ETIMEDOUT if "timed" in self.conn.error
+                        else errno.ECONNREFUSED)
+                raise OSError(code, self.conn.error)
+            if self.conn.state == tcpc.ESTABLISHED:
+                return 0
+            if self.nonblocking:
+                raise OSError(errno.EALREADY, "connect in progress")
+            return SyscallCondition(file=self, mask=S_WRITABLE | S_CLOSED)
+        if self.local is None:
+            dst_local = LOCALHOST_IP if ip == LOCALHOST_IP else host.eth0.ip
+            self.bind(host, dst_local, 0)
+        self.peer = (ip, port)
+        self._iface = host.lo if ip == LOCALHOST_IP else host.eth0
+        # Move from wildcard to the specific 4-tuple so multiple
+        # connections can share a local port.
+        for iface in self._ifaces:
+            iface.disassociate(self.protocol, self.local[1])
+        self._iface.associate(self, self.protocol, self.local[1], ip, port)
+        self._ifaces = [self._iface]
+        self.conn = tcpc.TcpConnection(
+            iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
+            send_buf_max=self._send_buf_max)
+        self.conn.open_active(host.now())
+        self._flush(host)
+        if self.nonblocking:
+            raise OSError(errno.EINPROGRESS, "connect started")
+        return SyscallCondition(file=self, mask=S_WRITABLE | S_CLOSED)
+
+    def accept(self, host):
+        if not self.listening:
+            raise OSError(errno.EINVAL, "not listening")
+        if not self._accept_q:
+            raise BlockingIOError(errno.EWOULDBLOCK, "no pending connection")
+        child = self._accept_q.popleft()
+        if not self._accept_q:
+            self.adjust_status(host, 0, S_READABLE)
+        return child
+
+    # ------------------------------------------------------------------
+    # Data path (app side)
+    # ------------------------------------------------------------------
+
+    def _require_conn(self) -> tcpc.TcpConnection:
+        if self.conn is None:
+            raise OSError(errno.ENOTCONN, "not connected")
+        return self.conn
+
+    def sendto(self, host, data: bytes, dst=None) -> int:
+        conn = self._require_conn()
+        if conn.error:
+            raise OSError(errno.ECONNRESET, conn.error)
+        if conn.state not in (tcpc.ESTABLISHED, tcpc.CLOSE_WAIT):
+            raise OSError(errno.EPIPE, "not established")
+        n = conn.write(data, host.now())
+        self._flush(host)
+        if n == 0:
+            self.adjust_status(host, 0, S_WRITABLE)
+            raise BlockingIOError(errno.EWOULDBLOCK, "send buffer full")
+        return n
+
+    def recvfrom(self, host, bufsize: int):
+        return self.recv(host, bufsize), self.peer
+
+    def recv(self, host, bufsize: int) -> bytes:
+        conn = self._require_conn()
+        if conn.readable_bytes() == 0:
+            if conn.at_eof():
+                return b""
+            if conn.error:
+                raise OSError(errno.ECONNRESET, conn.error)
+            self.adjust_status(host, 0, S_READABLE)
+            raise BlockingIOError(errno.EWOULDBLOCK, "no data")
+        data = conn.read(bufsize, host.now())
+        self._flush(host)
+        if conn.readable_bytes() == 0 and not conn.at_eof():
+            self.adjust_status(host, 0, S_READABLE)
+        return data
+
+    def shutdown(self, host, how: str = "wr") -> None:
+        if self.conn is not None and "w" in how:
+            self.conn.close(host.now())
+            self._flush(host)
+
+    def close(self, host) -> None:
+        if self.listening:
+            self.listening = False  # in-flight children abort on completion
+            for child in self._accept_q:
+                child.close(host)
+            self._accept_q.clear()
+            self._teardown(host)
+            return
+        if self.conn is None:
+            # Bound but never connected: release the port immediately.
+            self._teardown(host)
+            return
+        if self.conn.state not in (tcpc.CLOSED, tcpc.TIME_WAIT):
+            self.conn.close(host.now())
+            self._flush(host)
+        # The association stays alive until the connection fully closes
+        # (TIME_WAIT etc.); _maybe_teardown reaps it from the timer path.
+        self._maybe_teardown(host)
+        self.adjust_status(host, S_CLOSED, S_ACTIVE)
+
+    def _teardown(self, host) -> None:
+        for iface in self._ifaces:
+            if self.local is not None:
+                if self.peer is not None:
+                    iface.disassociate(self.protocol, self.local[1],
+                                       self.peer[0], self.peer[1])
+                else:
+                    iface.disassociate(self.protocol, self.local[1])
+        self._ifaces = []
+        self.adjust_status(host, S_CLOSED, S_ACTIVE | S_READABLE | S_WRITABLE)
+
+    def _maybe_teardown(self, host) -> None:
+        if self.conn is not None and self.conn.state == tcpc.CLOSED \
+                and self._ifaces:
+            self._teardown(host)
+
+    # ------------------------------------------------------------------
+    # Interface protocol (egress)
+    # ------------------------------------------------------------------
+
+    def peek_next_packet_priority(self, iface):
+        q = self._out_packets[iface.name]
+        return q[0].priority if q else None
+
+    def pull_out_packet(self, host, iface):
+        q = self._out_packets[iface.name]
+        return q.popleft() if q else None
+
+    # ------------------------------------------------------------------
+    # Interface protocol (ingress)
+    # ------------------------------------------------------------------
+
+    def push_in_packet(self, host, packet) -> bool:
+        if self.listening:
+            return self._listener_push(host, packet)
+        conn = self.conn
+        if conn is None:
+            host.trace_drop(packet, "tcp-closed")
+            return False
+        conn.on_packet(packet.tcp, packet.payload, host.now())
+        self._flush(host)
+        self._update_status(host)
+        self._maybe_child_established(host)
+        self._maybe_teardown(host)
+        return True
+
+    def _listener_push(self, host, packet) -> bool:
+        hdr = packet.tcp
+        if not (hdr.flags & tcpc.TcpFlags.SYN) or (hdr.flags &
+                                                   tcpc.TcpFlags.ACK):
+            # Stray segment for a dead connection; traced so every packet
+            # reconciles to exactly one RCV or DRP line.
+            host.trace_drop(packet, "tcp-stray")
+            return False
+        if len(self._accept_q) >= self._backlog:
+            host.trace_drop(packet, "accept-backlog-full")
+            return False
+        # Spawn a child socket bound to the specific 4-tuple.
+        child = TcpSocket(host, self._send_buf_max, self._recv_buf_max)
+        child.local = (packet.dst_ip, packet.dst_port)
+        child.peer = (packet.src_ip, packet.src_port)
+        child._listener = self
+        iface = host.lo if packet.dst_ip == LOCALHOST_IP else host.eth0
+        child._iface = iface
+        try:
+            iface.associate(child, pkt.PROTO_TCP, packet.dst_port,
+                            packet.src_ip, packet.src_port)
+        except OSError:
+            host.trace_drop(packet, "tcp-dup-syn")
+            return False  # duplicate SYN for an existing child
+        child._ifaces = [iface]
+        child.conn = tcpc.TcpConnection(
+            iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
+            send_buf_max=self._send_buf_max)
+        child.conn.accept_syn(hdr, host.now())
+        child._flush(host)
+        return True
+
+    def _maybe_child_established(self, host) -> None:
+        if (self._listener is not None and not self._accept_queued
+                and self.conn.state == tcpc.ESTABLISHED):
+            self._accept_queued = True
+            listener = self._listener
+            if not listener.listening:
+                # Listener closed while our SYN-ACK was in flight: the
+                # peer must see a RST, not a half-open black hole.
+                self.conn.abort(host.now())
+                self._flush(host)
+                self._teardown(host)
+                return
+            listener._accept_q.append(self)
+            listener.adjust_status(host, S_READABLE, 0)
+
+    # ------------------------------------------------------------------
+    # Egress drain + timers
+    # ------------------------------------------------------------------
+
+    def _flush(self, host) -> None:
+        conn = self.conn
+        if conn is None:
+            return
+        emitted = False
+        iface = self._iface
+        while conn.outbox:
+            hdr, payload = conn.outbox.popleft()
+            seq = host.next_packet_seq()
+            p = pkt.Packet(host.id, seq, pkt.PROTO_TCP,
+                           self.local[0] if self.local[0] != INADDR_ANY
+                           else iface.ip,
+                           self.local[1], self.peer[0], self.peer[1],
+                           payload=payload, tcp=hdr)
+            p.priority = seq
+            self._out_packets[iface.name].append(p)
+            emitted = True
+        if emitted:
+            iface.notify_socket_has_packets(host, self)
+        self._arm_timer(host)
+        self._update_status(host)
+
+    def _update_status(self, host) -> None:
+        conn = self.conn
+        if conn is None:
+            return
+        set_mask = 0
+        clear_mask = 0
+        if conn.readable_bytes() > 0 or conn.at_eof() or conn.error:
+            set_mask |= S_READABLE
+        else:
+            clear_mask |= S_READABLE
+        if conn.state in (tcpc.ESTABLISHED, tcpc.CLOSE_WAIT) \
+                and conn.send_space() > 0:
+            set_mask |= S_WRITABLE
+        elif conn.state not in (tcpc.ESTABLISHED, tcpc.CLOSE_WAIT):
+            clear_mask |= S_WRITABLE
+        if conn.error or conn.state == tcpc.CLOSED:
+            set_mask |= S_CLOSED
+        self.adjust_status(host, set_mask, clear_mask & ~set_mask)
+
+    def _arm_timer(self, host) -> None:
+        conn = self.conn
+        if conn is None:
+            return
+        deadline = conn.next_timer_expiry()
+        if deadline is None or deadline == self._timer_deadline:
+            return
+        self._timer_deadline = deadline
+        host.schedule_task_at(deadline, TaskRef("tcp-timer", self._on_timer))
+
+    def _on_timer(self, host) -> None:
+        conn = self.conn
+        if conn is None:
+            return
+        deadline = conn.next_timer_expiry()
+        self._timer_deadline = None
+        if deadline is not None and host.now() >= deadline:
+            conn.on_timer(host.now())
+            self._flush(host)
+            self._update_status(host)
+            self._maybe_teardown(host)
+        else:
+            self._arm_timer(host)

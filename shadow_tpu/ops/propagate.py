@@ -70,8 +70,10 @@ def build_propagate_kernel(latency_ns: np.ndarray, thresholds: np.ndarray,
         deliver = jnp.maximum(t_send + latency, window_end)
         keep = valid & reachable & jnp.logical_not(lossy)
         min_deliver = jnp.min(jnp.where(keep, deliver, _I64_MAX))
-        min_latency = jnp.min(
-            jnp.where(valid & reachable, latency, _I64_MAX))
+        # Dynamic-runahead feedback over *delivered* packets only — the
+        # scalar path never observes a dropped packet's latency, and the
+        # two must drive identical window boundaries.
+        min_latency = jnp.min(jnp.where(keep, latency, _I64_MAX))
         return deliver, keep, reachable, lossy, min_deliver, min_latency
 
     return kernel
@@ -126,17 +128,42 @@ class TpuPropagator:
         self._meta.append((src_host, dst_host, seq, packet))
 
     def finish_round(self):
-        n = len(self._meta)
-        if n == 0:
+        total = len(self._meta)
+        if total == 0:
             return None
+        # Honor the configured per-dispatch cap (device-memory bound):
+        # oversized rounds run as several kernel dispatches.
+        global_min_deliver = _I64_MAX
+        global_min_latency = _I64_MAX
+        for lo in range(0, total, self.max_batch):
+            hi = min(lo + self.max_batch, total)
+            md, ml = self._dispatch_chunk(lo, hi)
+            global_min_deliver = min(global_min_deliver, md)
+            global_min_latency = min(global_min_latency, ml)
+        self.packets_batched += total
+
+        if self.runahead is not None and global_min_latency < _I64_MAX:
+            self.runahead.update_lowest_used_latency(global_min_latency)
+
+        self._src_node.clear()
+        self._dst_node.clear()
+        self._src_host.clear()
+        self._pkt_seq.clear()
+        self._t_send.clear()
+        self._is_ctl.clear()
+        self._meta.clear()
+        return global_min_deliver if global_min_deliver < _I64_MAX else None
+
+    def _dispatch_chunk(self, lo: int, hi: int):
         import jax.numpy as jnp
 
+        n = hi - lo
         b = _bucket(n)
         pad = b - n
 
         def arr(lst, dtype):
             a = np.zeros(b, dtype=dtype)
-            a[:n] = lst
+            a[:n] = lst[lo:hi]
             return a
 
         deliver, keep, reachable, lossy, min_deliver, min_latency = \
@@ -152,36 +179,20 @@ class TpuPropagator:
         reachable = np.asarray(reachable)
         lossy = np.asarray(lossy)
         self.rounds_dispatched += 1
-        self.packets_batched += n
 
         # Scatter (outbox order => per-source event order is preserved).
-        meta = self._meta
-        t_send = self._t_send
         for i in range(n):
-            src_host, dst_host, seq, packet = meta[i]
+            src_host, dst_host, seq, packet = self._meta[lo + i]
             if keep[i]:
                 t = int(deliver[i])
                 packet.arrival_time = t
                 dst_host.deliver_packet_event(
                     Event(t, KIND_PACKET, src_host.id, seq, packet))
             elif not reachable[i]:
-                src_host.trace_drop(packet, "unreachable", at_time=t_send[i])
+                src_host.trace_drop(packet, "unreachable",
+                                    at_time=self._t_send[lo + i])
             elif lossy[i]:
                 packet.record(pktmod.ST_INET_DROPPED)
-                src_host.trace_drop(packet, "inet-loss", at_time=t_send[i])
-
-        if self.runahead is not None:
-            ml = int(min_latency)
-            if ml < _I64_MAX:
-                self.runahead.update_lowest_used_latency(ml)
-
-        self._src_node.clear()
-        self._dst_node.clear()
-        self._src_host.clear()
-        self._pkt_seq.clear()
-        self._t_send.clear()
-        self._is_ctl.clear()
-        self._meta.clear()
-
-        md = int(min_deliver)
-        return md if md < _I64_MAX else None
+                src_host.trace_drop(packet, "inet-loss",
+                                    at_time=self._t_send[lo + i])
+        return int(min_deliver), int(min_latency)

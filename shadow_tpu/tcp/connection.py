@@ -1,0 +1,623 @@
+"""TCP connection state machine (sans-I/O).
+
+Covers: three-way handshake (active + passive), bidirectional data
+transfer with flow control (advertised windows), reno congestion control
+(slow start, congestion avoidance, fast retransmit/recovery on 3 dupacks,
+timeout backoff), Jacobson/Karn RTT estimation with integer-ns RTO,
+out-of-order reassembly, graceful close through FIN states, TIME_WAIT,
+and RST on unexpected segments.
+
+Deliberate v1 simplifications (documented for parity tracking against
+the reference's states.rs/connection.rs): no SACK, no window scaling
+(windows cap at 64 KiB), immediate ACKs (no delayed-ACK timer), no
+Nagle, no zero-window persist probe. Each is listed in docs/PARITY.md.
+
+All arithmetic is integer (ns for time, mod-2^32 for sequence space) so
+scalar and batched stepping agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from shadow_tpu.net.packet import TcpFlags, TcpHeader
+
+# States (ref: src/lib/tcp/src/states.rs explicit state types).
+CLOSED = 0
+LISTEN = 1
+SYN_SENT = 2
+SYN_RECEIVED = 3
+ESTABLISHED = 4
+FIN_WAIT_1 = 5
+FIN_WAIT_2 = 6
+CLOSING = 7
+TIME_WAIT = 8
+CLOSE_WAIT = 9
+LAST_ACK = 10
+
+STATE_NAMES = {
+    CLOSED: "closed", LISTEN: "listen", SYN_SENT: "syn-sent",
+    SYN_RECEIVED: "syn-received", ESTABLISHED: "established",
+    FIN_WAIT_1: "fin-wait-1", FIN_WAIT_2: "fin-wait-2", CLOSING: "closing",
+    TIME_WAIT: "time-wait", CLOSE_WAIT: "close-wait", LAST_ACK: "last-ack",
+}
+
+MSS = 1460  # MTU 1500 - 40 header bytes
+MAX_WINDOW = 65_535
+
+INIT_RTO_NS = 1_000_000_000     # RFC 6298 initial
+MIN_RTO_NS = 200_000_000        # Linux-style floor
+MAX_RTO_NS = 60_000_000_000
+TIME_WAIT_NS = 60_000_000_000   # 2 * MSL with MSL=30s
+DUPACK_THRESHOLD = 3
+
+_SEQ_MOD = 1 << 32
+
+
+def seq_add(a: int, b: int) -> int:
+    return (a + b) % _SEQ_MOD
+
+
+def seq_sub(a: int, b: int) -> int:
+    """Signed distance a-b in sequence space."""
+    d = (a - b) % _SEQ_MOD
+    return d - _SEQ_MOD if d >= _SEQ_MOD // 2 else d
+
+
+def seq_lt(a: int, b: int) -> bool:
+    return seq_sub(a, b) < 0
+
+
+def seq_leq(a: int, b: int) -> bool:
+    return seq_sub(a, b) <= 0
+
+
+class TcpConnection:
+    """One direction-pair of TCP state. Emitted segments accumulate in
+    `outbox` as (TcpHeader, payload_bytes); the owner drains it."""
+
+    def __init__(self, iss: int, recv_buf_max: int = 174_760,
+                 send_buf_max: int = 131_072):
+        self.state = CLOSED
+        self.iss = iss % _SEQ_MOD
+
+        # Send side.
+        self.snd_una = self.iss
+        self.snd_nxt = self.iss
+        self.snd_wnd = MSS  # until the peer advertises
+        self.send_buf: deque = deque()   # byte chunks awaiting segmentation
+        self.send_buf_len = 0
+        self.send_buf_max = send_buf_max
+        self.snd_fin_pending = False     # app closed; FIN after data drains
+        self.fin_seq: int | None = None  # seq consumed by our FIN
+        # Retransmission queue: list of [seq, payload, is_fin, sent_at,
+        # retransmitted] — ordered by seq.
+        self.rtx: list = []
+
+        # Receive side.
+        self.irs = 0
+        self.rcv_nxt = 0
+        self.recv_buf: deque = deque()
+        self.recv_buf_len = 0
+        self.recv_buf_max = recv_buf_max
+        self.reassembly: dict[int, bytes] = {}  # seq -> payload (future)
+        self.peer_fin_seq: int | None = None   # set once the FIN is
+        self.pending_fin_seq: int | None = None  # ...processed in order
+
+        # Congestion control (reno; ref: tcp_cong_reno.c behaviorally).
+        self.cwnd = 10 * MSS  # RFC 6928 IW10
+        self.ssthresh = 64 * 1024
+        self.dupacks = 0
+        self.in_fast_recovery = False
+        self.recover = self.iss
+
+        # RTT/RTO (integer ns, Jacobson/Karn). One *timed segment* per
+        # window, BSD-style: sampling from arbitrary cleared rtx entries
+        # would poison srtt after a retransmission repaired a hole (the
+        # cumulative ack clears old segments whose wait includes the
+        # whole stall).
+        self.srtt = 0
+        self.rttvar = 0
+        self.rto = INIT_RTO_NS
+        self.rto_deadline: int | None = None
+        self.time_wait_deadline: int | None = None
+        self._timed_end_seq: int | None = None
+        self._timed_sent_at = 0
+
+        self.outbox: deque = deque()  # (TcpHeader, payload)
+        self.error: str | None = None  # set on RST / fatal
+        self.syn_retries = 0
+
+        # Counters for stats/debug.
+        self.retransmit_count = 0
+        self.segments_sent = 0
+        self.segments_received = 0
+
+    # ------------------------------------------------------------------
+    # App-side API
+    # ------------------------------------------------------------------
+
+    def open_active(self, now: int) -> None:
+        """connect(): emit SYN (states.rs Init->SynSent)."""
+        assert self.state == CLOSED
+        self.state = SYN_SENT
+        self._emit(TcpFlags.SYN, seq=self.iss, payload=b"", now=now,
+                   track=True)
+        self.snd_nxt = seq_add(self.iss, 1)
+
+    def open_passive(self) -> None:
+        assert self.state == CLOSED
+        self.state = LISTEN
+
+    def send_space(self) -> int:
+        return self.send_buf_max - self.send_buf_len
+
+    def write(self, data: bytes, now: int) -> int:
+        """Append app data; returns bytes accepted (0 = would block)."""
+        if self.state not in (ESTABLISHED, CLOSE_WAIT):
+            raise ConnectionError(f"write in {STATE_NAMES[self.state]}")
+        if self.snd_fin_pending:
+            raise ConnectionError("write after close")
+        n = min(len(data), self.send_space())
+        if n > 0:
+            self.send_buf.append(bytes(data[:n]))
+            self.send_buf_len += n
+            self._push_data(now)
+        return n
+
+    def readable_bytes(self) -> int:
+        return self.recv_buf_len
+
+    def at_eof(self) -> bool:
+        return (self.peer_fin_seq is not None and self.recv_buf_len == 0
+                and not self.reassembly)
+
+    def read(self, n: int, now: int) -> bytes:
+        window_before = self._recv_window()
+        out = bytearray()
+        while n > 0 and self.recv_buf:
+            chunk = self.recv_buf[0]
+            if len(chunk) <= n:
+                out += chunk
+                n -= len(chunk)
+                self.recv_buf.popleft()
+            else:
+                out += chunk[:n]
+                self.recv_buf[0] = chunk[n:]
+                n = 0
+        if out:
+            self.recv_buf_len -= len(out)
+            # Window-update ACK only when the window was pinched shut —
+            # an ACK per read() would flood the wire with pure acks.
+            if window_before < MSS and self._recv_window() >= MSS and \
+                    self.state in (ESTABLISHED, FIN_WAIT_1, FIN_WAIT_2):
+                self._emit_ack(now)
+        return bytes(out)
+
+    def close(self, now: int) -> None:
+        """App close: FIN once the send buffer drains
+        (states.rs Established->FinWait1 / CloseWait->LastAck)."""
+        if self.state in (CLOSED, LISTEN):
+            self.state = CLOSED
+            return
+        if self.state == SYN_SENT:
+            self.state = CLOSED
+            self.rto_deadline = None
+            self.rtx.clear()
+            return
+        if self.snd_fin_pending or self.fin_seq is not None:
+            return
+        self.snd_fin_pending = True
+        if self.state == ESTABLISHED:
+            self.state = FIN_WAIT_1
+        elif self.state == CLOSE_WAIT:
+            self.state = LAST_ACK
+        self._push_data(now)
+
+    def abort(self, now: int) -> None:
+        """RST out, state torn down."""
+        if self.state not in (CLOSED, LISTEN, TIME_WAIT):
+            self._emit(TcpFlags.RST | TcpFlags.ACK, seq=self.snd_nxt,
+                       payload=b"", now=now)
+        self.state = CLOSED
+        self.error = self.error or "aborted"
+        self.rto_deadline = None
+
+    # ------------------------------------------------------------------
+    # Timers
+    # ------------------------------------------------------------------
+
+    def next_timer_expiry(self) -> int | None:
+        candidates = [t for t in (self.rto_deadline,
+                                  self.time_wait_deadline) if t is not None]
+        return min(candidates) if candidates else None
+
+    def on_timer(self, now: int) -> None:
+        if self.time_wait_deadline is not None \
+                and now >= self.time_wait_deadline:
+            self.time_wait_deadline = None
+            if self.state == TIME_WAIT:
+                self.state = CLOSED
+        if self.rto_deadline is not None and now >= self.rto_deadline:
+            self._on_rto(now)
+
+    def _on_rto(self, now: int) -> None:
+        """Retransmission timeout (RFC 6298 5.4-5.7 + reno reset)."""
+        if not self.rtx:
+            self.rto_deadline = None
+            return
+        # Handshake gives up after 6 backoffs (Linux tcp_syn_retries):
+        # connecting to a dead/closed port must fail, not hang forever.
+        if self.state in (SYN_SENT, SYN_RECEIVED):
+            self.syn_retries += 1
+            if self.syn_retries > 6:
+                self.error = "connection timed out"
+                self.state = CLOSED
+                self.rto_deadline = None
+                self.rtx.clear()
+                return
+        flight = seq_sub(self.snd_nxt, self.snd_una)
+        self.ssthresh = max(flight // 2, 2 * MSS)
+        self.cwnd = MSS
+        self.dupacks = 0
+        self.in_fast_recovery = False
+        self.rto = min(self.rto * 2, MAX_RTO_NS)
+        seg = self.rtx[0]
+        seg[3] = now
+        seg[4] = True  # Karn: no RTT sample from retransmits
+        self.retransmit_count += 1
+        self._transmit_segment(seg[0], seg[1], seg[2], now)
+        self.rto_deadline = now + self.rto
+
+    # ------------------------------------------------------------------
+    # Packet ingress
+    # ------------------------------------------------------------------
+
+    def on_packet(self, hdr: TcpHeader, payload: bytes, now: int) -> None:
+        self.segments_received += 1
+        if self.state == CLOSED:
+            return
+        if hdr.flags & TcpFlags.RST:
+            self._on_rst(hdr)
+            return
+        if self.state == LISTEN:
+            # Owner (listener socket) is responsible for spawning child
+            # connections; a LISTEN connection itself ignores non-SYN.
+            return
+        if self.state == SYN_SENT:
+            self._on_packet_syn_sent(hdr, now)
+            return
+        # --- synchronized states ---
+        if hdr.flags & TcpFlags.SYN:
+            # Re-sent SYN (our SYN-ACK was lost): re-ACK it.
+            if self.state == SYN_RECEIVED and hdr.seq == seq_sub(
+                    self.rcv_nxt, 1) % _SEQ_MOD:
+                self._emit_synack(now)
+                return
+            self._emit_ack(now)
+            return
+        if not (hdr.flags & TcpFlags.ACK):
+            return
+        self._on_ack(hdr, now, is_pure_ack=not payload
+                     and not (hdr.flags & TcpFlags.FIN))
+        if payload:
+            self._on_data(hdr.seq, payload, now)
+        if hdr.flags & TcpFlags.FIN:
+            self._on_fin(hdr, payload, now)
+
+    def accept_syn(self, hdr: TcpHeader, now: int) -> None:
+        """Passive open: called on a child connection created by a
+        listener for an incoming SYN."""
+        assert self.state in (CLOSED, LISTEN)
+        self.irs = hdr.seq
+        self.rcv_nxt = seq_add(hdr.seq, 1)
+        self.snd_wnd = hdr.window
+        self.state = SYN_RECEIVED
+        self._emit_synack(now)
+        self.snd_nxt = seq_add(self.iss, 1)
+
+    def _emit_synack(self, now: int) -> None:
+        self._emit(TcpFlags.SYN | TcpFlags.ACK, seq=self.iss, payload=b"",
+                   now=now, track=(self.snd_nxt == self.iss))
+
+    def _on_packet_syn_sent(self, hdr: TcpHeader, now: int) -> None:
+        if (hdr.flags & (TcpFlags.SYN | TcpFlags.ACK)) == \
+                (TcpFlags.SYN | TcpFlags.ACK):
+            if hdr.ack != self.snd_nxt:
+                self.abort(now)
+                return
+            self.irs = hdr.seq
+            self.rcv_nxt = seq_add(hdr.seq, 1)
+            self.snd_una = hdr.ack
+            self.snd_wnd = hdr.window
+            self._clear_acked(now)
+            self.state = ESTABLISHED
+            self._emit_ack(now)
+        elif hdr.flags & TcpFlags.SYN:
+            # Simultaneous open: not modeled; reset.
+            self.abort(now)
+
+    def _on_rst(self, hdr: TcpHeader) -> None:
+        self.error = "connection reset"
+        self.state = CLOSED
+        self.rto_deadline = None
+        self.time_wait_deadline = None
+
+    def _on_ack(self, hdr: TcpHeader, now: int,
+                is_pure_ack: bool = True) -> None:
+        ack = hdr.ack
+        if seq_lt(self.snd_nxt, ack):
+            # Acks something we never sent.
+            self._emit_ack(now)
+            return
+        window_changed = hdr.window != self.snd_wnd
+        self.snd_wnd = hdr.window
+        if seq_lt(self.snd_una, ack):
+            self._handle_new_ack(ack, now)
+        elif ack == self.snd_una and self.rtx and is_pure_ack \
+                and not window_changed:
+            # RFC 5681: only payload-free, window-unchanged acks count as
+            # duplicates — a peer streaming its own data repeats our ack
+            # number without implying loss.
+            self._handle_dupack(now)
+        # Handshake completion for passive side.
+        if self.state == SYN_RECEIVED and seq_lt(self.iss, ack):
+            self.state = ESTABLISHED
+        self._advance_close_states(now)
+        self._push_data(now)
+
+    def _handle_new_ack(self, ack: int, now: int) -> None:
+        acked = seq_sub(ack, self.snd_una)
+        self.snd_una = ack
+        self.dupacks = 0
+        sample = self._clear_acked(now)
+        if sample is not None:
+            self._update_rtt(sample)
+        elif self.srtt > 0:
+            # Forward progress undoes exponential RTO backoff even when
+            # Karn's rule yields no sample (the ack was for a retransmit).
+            # Without this, sustained loss walks rto to the 60s cap and
+            # every remaining hole costs a full max-RTO — transfers that
+            # should take seconds take hours.
+            self.rto = min(max(self.srtt + max(4 * self.rttvar, 1_000_000),
+                               MIN_RTO_NS), MAX_RTO_NS)
+        if self.in_fast_recovery:
+            if seq_lt(self.recover, ack) or ack == self.recover:
+                self.in_fast_recovery = False
+                self.cwnd = self.ssthresh
+            else:
+                # Partial ack: retransmit next hole immediately.
+                if self.rtx:
+                    seg = self.rtx[0]
+                    seg[3] = now
+                    seg[4] = True
+                    self.retransmit_count += 1
+                    self._transmit_segment(seg[0], seg[1], seg[2], now)
+        elif self.cwnd < self.ssthresh:
+            self.cwnd += min(acked, MSS)  # slow start
+        else:
+            self.cwnd += max(1, MSS * MSS // self.cwnd)  # AIMD
+        # RTO restart (RFC 6298 5.3).
+        self.rto_deadline = (now + self.rto) if self.rtx else None
+
+    def _handle_dupack(self, now: int) -> None:
+        self.dupacks += 1
+        if self.in_fast_recovery:
+            self.cwnd += MSS  # inflation
+            self._push_data(now)
+        elif self.dupacks == DUPACK_THRESHOLD:
+            flight = seq_sub(self.snd_nxt, self.snd_una)
+            self.ssthresh = max(flight // 2, 2 * MSS)
+            self.cwnd = self.ssthresh + 3 * MSS
+            self.in_fast_recovery = True
+            self.recover = self.snd_nxt
+            if self.rtx:
+                seg = self.rtx[0]
+                seg[3] = now
+                seg[4] = True
+                self.retransmit_count += 1
+                self._transmit_segment(seg[0], seg[1], seg[2], now)
+
+    def _clear_acked(self, now: int):
+        """Drop fully-acked segments from the rtx queue; returns the RTT
+        sample (ns) if the ack covers the timed segment, else None."""
+        while self.rtx:
+            seq, payload, is_fin, sent_at, retransmitted = self.rtx[0]
+            # Sequence space consumed: data bytes, or 1 for SYN/FIN.
+            end = seq_add(seq, len(payload) + (1 if is_fin else 0)
+                          + (1 if payload == b"" and not is_fin else 0))
+            if seq_leq(end, self.snd_una):
+                self.rtx.pop(0)
+            else:
+                break
+        if self._timed_end_seq is not None \
+                and seq_leq(self._timed_end_seq, self.snd_una):
+            sample = now - self._timed_sent_at
+            self._timed_end_seq = None
+            return sample
+        return None
+
+    def _update_rtt(self, sample: int) -> None:
+        if sample <= 0:
+            sample = 1
+        if self.srtt == 0:
+            self.srtt = sample
+            self.rttvar = sample // 2
+        else:
+            err = abs(self.srtt - sample)
+            self.rttvar = (3 * self.rttvar + err) // 4
+            self.srtt = (7 * self.srtt + sample) // 8
+        self.rto = self.srtt + max(4 * self.rttvar, 1_000_000)
+        self.rto = min(max(self.rto, MIN_RTO_NS), MAX_RTO_NS)
+
+    # ------------------------------------------------------------------
+    # Data ingress / reassembly
+    # ------------------------------------------------------------------
+
+    def _recv_window(self) -> int:
+        return min(MAX_WINDOW, max(0, self.recv_buf_max - self.recv_buf_len))
+
+    def _on_data(self, seq: int, payload: bytes, now: int) -> None:
+        if self.state not in (ESTABLISHED, FIN_WAIT_1, FIN_WAIT_2):
+            return
+        # Trim anything already received.
+        offset = seq_sub(self.rcv_nxt, seq)
+        if offset >= len(payload):
+            self._emit_ack(now)  # pure duplicate
+            return
+        if offset > 0:
+            payload = payload[offset:]
+            seq = self.rcv_nxt
+        if seq != self.rcv_nxt:
+            # Future segment: stash (bounded by the advertised window).
+            if seq_sub(seq, self.rcv_nxt) < self.recv_buf_max:
+                self.reassembly.setdefault(seq, payload)
+            self._emit_ack(now)  # dupack → sender fast-retransmits
+            return
+        # In-order: deliver, then drain any contiguous stashed segments.
+        self._deliver(payload)
+        while self.rcv_nxt in self.reassembly:
+            self._deliver(self.reassembly.pop(self.rcv_nxt))
+        # An out-of-order FIN becomes processable once the gap fills.
+        if self.pending_fin_seq == self.rcv_nxt:
+            self._process_fin(now)
+        self._emit_ack(now)
+
+    def _deliver(self, payload: bytes) -> None:
+        space = self.recv_buf_max - self.recv_buf_len
+        take = payload[:space]
+        if take:
+            self.recv_buf.append(take)
+            self.recv_buf_len += len(take)
+            self.rcv_nxt = seq_add(self.rcv_nxt, len(take))
+        # Bytes beyond buffer space are NOT acked; the shrunken advertised
+        # window tells the sender to back off and retransmit later.
+
+    def _on_fin(self, hdr: TcpHeader, payload: bytes, now: int) -> None:
+        if self.peer_fin_seq is not None:
+            # Retransmitted FIN (our ACK was lost, e.g. in TIME_WAIT):
+            # just re-ACK.
+            self._emit_ack(now)
+            return
+        fin_seq = seq_add(hdr.seq, len(payload))
+        if fin_seq != self.rcv_nxt:
+            # FIN beyond data we haven't received: wait for reassembly.
+            self.pending_fin_seq = fin_seq
+            self._emit_ack(now)
+            return
+        self._process_fin(now)
+        self._emit_ack(now)
+
+    def _process_fin(self, now: int) -> None:
+        self.peer_fin_seq = self.rcv_nxt
+        self.pending_fin_seq = None
+        self.rcv_nxt = seq_add(self.rcv_nxt, 1)
+        if self.state == ESTABLISHED:
+            self.state = CLOSE_WAIT
+        elif self.state == FIN_WAIT_1:
+            self.state = CLOSING
+        elif self.state == FIN_WAIT_2:
+            self._enter_time_wait(now)
+        self._advance_close_states(now)
+
+    def _advance_close_states(self, now: int) -> None:
+        fin_acked = (self.fin_seq is not None
+                     and seq_lt(self.fin_seq, self.snd_una))
+        if self.state == FIN_WAIT_1 and fin_acked:
+            self.state = FIN_WAIT_2
+        elif self.state == CLOSING and fin_acked:
+            self._enter_time_wait(now)
+        elif self.state == LAST_ACK and fin_acked:
+            self.state = CLOSED
+            self.rto_deadline = None
+
+    def _enter_time_wait(self, now: int) -> None:
+        self.state = TIME_WAIT
+        self.rto_deadline = None
+        self.time_wait_deadline = now + TIME_WAIT_NS
+
+    # ------------------------------------------------------------------
+    # Segment egress
+    # ------------------------------------------------------------------
+
+    def _flight(self) -> int:
+        return seq_sub(self.snd_nxt, self.snd_una)
+
+    def _push_data(self, now: int) -> None:
+        """Segmentize send_buf within min(cwnd, peer window)."""
+        if self.state not in (ESTABLISHED, CLOSE_WAIT, FIN_WAIT_1,
+                              CLOSING, LAST_ACK):
+            return
+        window = min(self.cwnd, self.snd_wnd)
+        while self.send_buf and self._flight() < window:
+            budget = min(window - self._flight(), MSS)
+            chunk = self._take_from_send_buf(budget)
+            if not chunk:
+                break
+            self._emit(TcpFlags.ACK | TcpFlags.PSH, seq=self.snd_nxt,
+                       payload=chunk, now=now, track=True)
+            self.snd_nxt = seq_add(self.snd_nxt, len(chunk))
+        if self.snd_fin_pending and not self.send_buf \
+                and self.fin_seq is None:
+            self.fin_seq = self.snd_nxt
+            self._emit(TcpFlags.FIN | TcpFlags.ACK, seq=self.snd_nxt,
+                       payload=b"", now=now, track=True, is_fin=True)
+            self.snd_nxt = seq_add(self.snd_nxt, 1)
+
+    def _take_from_send_buf(self, n: int) -> bytes:
+        out = bytearray()
+        while n > 0 and self.send_buf:
+            chunk = self.send_buf[0]
+            if len(chunk) <= n:
+                out += chunk
+                n -= len(chunk)
+                self.send_buf.popleft()
+            else:
+                out += chunk[:n]
+                self.send_buf[0] = chunk[n:]
+                n = 0
+        self.send_buf_len -= len(out)
+        return bytes(out)
+
+    def _transmit_segment(self, seq: int, payload: bytes, is_fin: bool,
+                          now: int) -> None:
+        """Retransmission path only — fresh segments go through _emit."""
+        # Karn: a retransmission in the window invalidates the timed
+        # segment (its eventual ack is ambiguous).
+        self._timed_end_seq = None
+        flags = TcpFlags.ACK
+        if is_fin:
+            flags |= TcpFlags.FIN
+        elif payload == b"" and seq == self.iss:
+            flags = TcpFlags.SYN  # retransmitted SYN
+            if self.state == SYN_RECEIVED:
+                flags = TcpFlags.SYN | TcpFlags.ACK
+        elif payload:
+            flags |= TcpFlags.PSH
+        self.outbox.append((TcpHeader(
+            seq=seq, ack=self.rcv_nxt, flags=flags,
+            window=self._recv_window()), payload))
+        self.segments_sent += 1
+
+    def _emit(self, flags: int, seq: int, payload: bytes, now: int,
+              track: bool = False, is_fin: bool = False) -> None:
+        ack = self.rcv_nxt if (flags & TcpFlags.ACK) else 0
+        self.outbox.append((TcpHeader(
+            seq=seq, ack=ack, flags=flags, window=self._recv_window()),
+            payload))
+        self.segments_sent += 1
+        if track:
+            self.rtx.append([seq, payload, is_fin, now, False])
+            if self.rto_deadline is None:
+                self.rto_deadline = now + self.rto
+            if self._timed_end_seq is None:
+                self._timed_end_seq = seq_add(
+                    seq, len(payload) + (1 if is_fin else 0)
+                    + (1 if payload == b"" and not is_fin else 0))
+                self._timed_sent_at = now
+
+    def _emit_ack(self, now: int) -> None:
+        self.outbox.append((TcpHeader(
+            seq=self.snd_nxt, ack=self.rcv_nxt, flags=TcpFlags.ACK,
+            window=self._recv_window()), b""))
+        self.segments_sent += 1

@@ -1,0 +1,127 @@
+"""End-to-end TCP: tgen-style file transfer through the full simulated
+stack — handshake, congestion control, retransmission under loss, close —
+and scalar/TPU-scheduler parity (the BASELINE config-1 analog over TCP)."""
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+
+CFG = """
+general: {{ stop_time: {stop}, seed: {seed} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "{latency}" packet_loss {loss} ]
+      ]
+experimental: {{ scheduler: {scheduler} }}
+hosts:
+  client:
+    network_node_id: 0
+    processes:
+      - path: tgen-client
+        args: [server, "80", "{nbytes}", "{count}"]
+        start_time: 1s
+  server:
+    network_node_id: 0
+    processes:
+      - path: tgen-server
+        args: ["80"]
+        expected_final_state: running
+"""
+
+
+def cfg(scheduler="serial", nbytes=1_000_000, count=1, loss=0.0,
+        latency="10 ms", seed=1, stop="60s"):
+    return ConfigOptions.from_yaml_text(CFG.format(
+        scheduler=scheduler, nbytes=nbytes, count=count, loss=loss,
+        latency=latency, seed=seed, stop=stop))
+
+
+def client_stdout(manager):
+    client = manager.hosts[0]
+    assert client.name == "client"
+    proc = next(iter(client.processes.values()))
+    return bytes(proc.stdout).decode()
+
+
+def test_tcp_transfer_1mb():
+    m, s = run_simulation(cfg())
+    assert s.ok, s.plugin_errors
+    out = client_stdout(m)
+    assert "transfer 0 ok bytes=1000000" in out
+    # Sanity on timing: 1MB over 100 Mbit with 10ms RTT-ish latency
+    # should take well under 2 simulated seconds but more than 80 ms.
+    ns = int(out.strip().split("ns=")[1])
+    assert 80_000_000 < ns < 2_000_000_000
+
+
+def test_tcp_transfer_with_loss_recovers():
+    m, s = run_simulation(cfg(nbytes=300_000, loss=0.02, seed=7))
+    assert s.ok, s.plugin_errors
+    assert "transfer 0 ok bytes=300000" in client_stdout(m)
+    # Loss was actually exercised.
+    assert any("inet-loss" in l for l in m.trace_lines())
+
+
+def test_tcp_multiple_sequential_transfers():
+    m, s = run_simulation(cfg(nbytes=50_000, count=5))
+    assert s.ok, s.plugin_errors
+    out = client_stdout(m)
+    for i in range(5):
+        assert f"transfer {i} ok bytes=50000" in out
+
+
+def test_tcp_scalar_tpu_parity():
+    m1, s1 = run_simulation(cfg(nbytes=200_000, loss=0.03, seed=3))
+    m2, s2 = run_simulation(cfg(nbytes=200_000, loss=0.03, seed=3,
+                                scheduler="tpu"))
+    assert s1.ok and s2.ok
+    assert client_stdout(m1) == client_stdout(m2)
+    assert m1.trace_lines() == m2.trace_lines()
+
+
+def test_tcp_connect_refused_times_out():
+    text = CFG.format(scheduler="serial", nbytes=100, count=1, loss=0.0,
+                      latency="10 ms", seed=1, stop="600s").replace(
+        'args: ["80"]', 'args: ["81"]')  # server on the wrong port
+    cfg_ = ConfigOptions.from_yaml_text(text)
+    cfg_.hosts["client"].processes[0].expected_final_state = "exited 101"
+    m, s = run_simulation(cfg_)
+    assert s.ok, s.plugin_errors  # client crashed with ETIMEDOUT as expected
+
+
+def test_tcp_two_concurrent_clients():
+    text = """
+general: { stop_time: 60s, seed: 2 }
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "50 Mbit" host_bandwidth_up "50 Mbit" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+      ]
+experimental: { scheduler: serial }
+hosts:
+  c1:
+    network_node_id: 0
+    processes:
+      - { path: tgen-client, args: [srv, "80", "200000"], start_time: 1s }
+  c2:
+    network_node_id: 0
+    processes:
+      - { path: tgen-client, args: [srv, "80", "200000"], start_time: 1s }
+  srv:
+    network_node_id: 0
+    processes:
+      - { path: tgen-server, args: ["80"], expected_final_state: running }
+"""
+    m, s = run_simulation(ConfigOptions.from_yaml_text(text))
+    assert s.ok, s.plugin_errors
+    for h in m.hosts[:2]:
+        proc = next(iter(h.processes.values()))
+        assert b"ok bytes=200000" in bytes(proc.stdout)

@@ -1,0 +1,236 @@
+"""Sans-I/O TCP unit tests with a fake clock — packets shuttled directly
+between two connections, no sockets (the reference's tcp-crate test
+strategy: src/lib/tcp/src/tests/, fake time driver, SURVEY.md section 4)."""
+
+import pytest
+
+from shadow_tpu.tcp import (TcpConnection, CLOSED, ESTABLISHED, TIME_WAIT,
+                            CLOSE_WAIT, FIN_WAIT_2, LAST_ACK)
+from shadow_tpu.tcp.connection import MSS, seq_add, seq_lt, seq_sub
+
+MS = 1_000_000
+
+
+class Wire:
+    """Two connections + a manual clock. Segments delivered in order with
+    optional drop/reorder hooks."""
+
+    def __init__(self, iss_a=1000, iss_b=5000, **kw):
+        self.a = TcpConnection(iss=iss_a, **kw)
+        self.b = TcpConnection(iss=iss_b, **kw)
+        self.now = 0
+        self.drop_fn = None      # (dir, hdr, payload, idx) -> bool
+        self.sent_count = 0
+
+    def handshake(self):
+        self.a.open_active(self.now)
+        hdr, payload = self.a.outbox.popleft()
+        self.b.accept_syn(hdr, self.now)
+        self.pump()
+        assert self.a.state == ESTABLISHED
+        assert self.b.state == ESTABLISHED
+
+    def _deliver(self, src, dst, direction):
+        moved = False
+        while src.outbox:
+            hdr, payload = src.outbox.popleft()
+            idx = self.sent_count
+            self.sent_count += 1
+            if self.drop_fn and self.drop_fn(direction, hdr, payload, idx):
+                continue
+            dst.on_packet(hdr, payload, self.now)
+            moved = True
+        return moved
+
+    def pump(self, max_iters=1000):
+        for _ in range(max_iters):
+            moved = self._deliver(self.a, self.b, "ab")
+            moved |= self._deliver(self.b, self.a, "ba")
+            if not moved:
+                return
+        raise AssertionError("wire did not quiesce")
+
+    def advance_to_next_timer(self):
+        expiries = [t for t in (self.a.next_timer_expiry(),
+                                self.b.next_timer_expiry()) if t is not None]
+        assert expiries, "no timer armed"
+        self.now = min(expiries)
+        self.a.on_timer(self.now)
+        self.b.on_timer(self.now)
+
+
+def transfer(w: Wire, data: bytes, reader="b") -> bytes:
+    src = w.a if reader == "b" else w.b
+    dst = w.b if reader == "b" else w.a
+    got = bytearray()
+    view = memoryview(data)
+    sent = 0
+    for _ in range(10000):
+        if sent < len(data):
+            sent += src.write(view[sent:sent + 65536], w.now)
+        w.pump()
+        got += dst.read(1 << 20, w.now)
+        w.pump()
+        if sent == len(data) and len(got) == len(data):
+            return bytes(got)
+        w.now += MS
+    raise AssertionError(f"transfer stalled: {len(got)}/{len(data)}")
+
+
+def test_handshake():
+    w = Wire()
+    w.handshake()
+
+
+def test_bulk_transfer_and_close():
+    w = Wire()
+    w.handshake()
+    data = bytes(range(256)) * 4096  # 1 MiB
+    assert transfer(w, data) == data
+    w.a.close(w.now)
+    w.pump()
+    got = w.b.read(100, w.now)
+    assert got == b"" and w.b.at_eof()
+    assert w.b.state == CLOSE_WAIT
+    w.b.close(w.now)
+    w.pump()
+    assert w.b.state == CLOSED
+    assert w.a.state == TIME_WAIT
+    w.advance_to_next_timer()
+    assert w.a.state == CLOSED
+
+
+def test_bidirectional():
+    w = Wire()
+    w.handshake()
+    d1 = b"x" * 100_000
+    d2 = b"y" * 80_000
+    assert transfer(w, d1, reader="b") == d1
+    assert transfer(w, d2, reader="a") == d2
+
+
+def test_rto_retransmission_recovers_total_loss():
+    w = Wire()
+    w.handshake()
+    # Drop ALL data segments once, then heal the wire.
+    dropped = []
+    w.drop_fn = lambda d, h, p, i: bool(p) and (dropped.append(i) or True)
+    w.a.write(b"z" * 3000, w.now)
+    w.pump()
+    assert dropped  # data vanished
+    assert w.b.readable_bytes() == 0
+    w.drop_fn = None
+    w.advance_to_next_timer()  # RTO fires, retransmits first segment
+    w.pump()
+    for _ in range(10):
+        if w.b.readable_bytes() == 3000:
+            break
+        w.advance_to_next_timer()
+        w.pump()
+    assert w.b.read(10000, w.now) == b"z" * 3000
+    assert w.a.retransmit_count >= 1
+    # Timeout collapses cwnd to 1 MSS then regrows.
+    assert w.a.cwnd >= MSS
+
+
+def test_fast_retransmit_on_dupacks():
+    w = Wire()
+    w.handshake()
+    # Drop exactly the first data segment; later ones generate dupacks.
+    state = {"dropped": False}
+
+    def drop(d, h, p, i):
+        if d == "ab" and p and not state["dropped"]:
+            state["dropped"] = True
+            return True
+        return False
+
+    w.drop_fn = drop
+    w.a.write(b"q" * (MSS * 6), w.now)
+    w.pump()
+    w.drop_fn = None
+    # Fast retransmit should have repaired the hole without any RTO.
+    assert w.b.read(1 << 20, w.now) == b"q" * (MSS * 6)
+    assert w.a.retransmit_count == 1
+    assert w.a.in_fast_recovery is False  # recovered
+
+
+def test_out_of_order_reassembly():
+    w = Wire()
+    w.handshake()
+    # Swap each adjacent pair of a->b data segments.
+    stash = []
+    orig_on = w.b.on_packet
+
+    def reordering_on_packet(hdr, payload, now):
+        if payload:
+            stash.append((hdr, payload))
+            if len(stash) == 2:
+                for h, p in reversed(stash):
+                    orig_on(h, p, now)
+                stash.clear()
+        else:
+            orig_on(hdr, payload, now)
+
+    w.b.on_packet = reordering_on_packet
+    w.a.write(b"r" * (MSS * 4), w.now)
+    w.pump()
+    w.b.on_packet = orig_on
+    for h, p in stash:
+        orig_on(h, p, w.now)
+    w.pump()
+    assert w.b.read(1 << 20, w.now) == b"r" * (MSS * 4)
+
+
+def test_flow_control_window():
+    w = Wire(recv_buf_max=8 * 1024, send_buf_max=1 << 20)
+    w.handshake()
+    data = b"w" * 50_000
+    sent = w.a.write(data, w.now)
+    w.pump()
+    # Receiver never reads: delivery bounded by its buffer.
+    assert w.b.readable_bytes() <= 8 * 1024
+    assert seq_sub(w.a.snd_nxt, w.a.snd_una) <= 10 * 1024
+    # Reads reopen the window and trigger a window-update ack.
+    got = bytearray()
+    for _ in range(200):
+        got += w.b.read(4096, w.now)
+        w.pump()
+        if sent < len(data):
+            sent += w.a.write(data[sent:], w.now)
+            w.pump()
+        if len(got) == len(data):
+            break
+    assert bytes(got) == data
+
+
+def test_rst_aborts_peer():
+    w = Wire()
+    w.handshake()
+    w.b.abort(w.now)
+    w.pump()
+    assert w.a.state == CLOSED
+    assert w.a.error == "connection reset"
+
+
+def test_sequence_wraparound():
+    w = Wire(iss_a=(1 << 32) - 2000, iss_b=(1 << 32) - 7)
+    w.handshake()
+    data = bytes(range(251)) * 100  # crosses both wrap points
+    assert transfer(w, data) == data
+
+
+def test_seq_arithmetic():
+    assert seq_add((1 << 32) - 1, 2) == 1
+    assert seq_lt((1 << 32) - 10, 5)
+    assert seq_sub(5, (1 << 32) - 10) == 15
+
+
+def test_simultaneous_close():
+    w = Wire()
+    w.handshake()
+    w.a.close(w.now)
+    w.b.close(w.now)
+    w.pump()
+    assert w.a.state in (TIME_WAIT, CLOSED)
+    assert w.b.state in (TIME_WAIT, CLOSED)
