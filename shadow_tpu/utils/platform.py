@@ -1,0 +1,27 @@
+"""JAX platform pinning helper.
+
+The site's TPU plugin (axon) force-sets `jax_platforms` at interpreter
+startup, so the JAX_PLATFORMS env var alone is NOT sufficient to keep a
+process off the TPU tunnel — the config must be re-asserted before any
+backend initializes. Every entry point that honors the env var (tests,
+bench, driver entries) calls this one helper.
+"""
+
+import os
+
+
+def honor_platform_env(default: str | None = None) -> None:
+    """Re-assert JAX_PLATFORMS (or `default`) as the jax_platforms config.
+
+    Call before the first jax.devices()/device_put. No-op if neither the
+    env var nor `default` is set.
+    """
+    want = os.environ.get("JAX_PLATFORMS") or default
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)
+
+
+def force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    honor_platform_env()

@@ -11,12 +11,14 @@ tests must never depend on that.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, not setdefault: the ambient environment exports
+# JAX_PLATFORMS=axon (the TPU tunnel); tests always run on CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402
+from shadow_tpu.utils.platform import honor_platform_env  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+honor_platform_env(default="cpu")
