@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -105,6 +106,15 @@ class Manager:
             host.dns = self.dns
             host.syscall_handler = self.syscall_handler
             self.dns.register(host_id, ip, name)
+            if hcfg.pcap_enabled:
+                from shadow_tpu.utils.pcap import PcapWriter
+                hdir = os.path.join(config.general.data_directory, "hosts",
+                                    name)
+                os.makedirs(hdir, exist_ok=True)
+                for iface in (host.lo, host.eth0):
+                    iface.pcap = PcapWriter(
+                        os.path.join(hdir, f"{iface.name}.pcap"),
+                        hcfg.pcap_capture_size)
             self.hosts.append(host)
             for i, pcfg in enumerate(hcfg.processes):
                 self._schedule_spawn(host, i, pcfg)
@@ -152,11 +162,16 @@ class Manager:
     def _schedule_spawn(self, host: Host, index: int, pcfg) -> None:
         spawned: list = []  # shared between the spawn and shutdown tasks
 
+        strace_mode = self.config.experimental.strace_logging_mode
+        if strace_mode == "off":
+            strace_mode = None
+
         def spawn(h, _pcfg=pcfg):
             factory = app_registry.lookup(_pcfg.path)
             process = Process(h, f"{_pcfg.path}.{index}", _pcfg.args,
                               _pcfg.environment,
                               expected_final_state=_pcfg.expected_final_state)
+            process.strace_mode = strace_mode
             spawned.append(process)
             if factory is None:
                 process.stderr += (f"[shadow-tpu] unknown app "
@@ -214,7 +229,12 @@ class Manager:
             list(self._pool.map(run_chunk, chunks))
 
     def run(self) -> SimSummary:
+        import sys
         stop = self.config.general.stop_time_ns
+        progress = self.config.general.progress
+        heartbeat = self.config.general.heartbeat_interval_ns
+        next_heartbeat = heartbeat
+        wall_start = time.perf_counter()
         summary = SimSummary()
         start = self._min_next_event()
         while start is not None and start < stop:
@@ -223,6 +243,9 @@ class Manager:
             self._run_hosts(window_end)
             inflight_min = self.propagator.finish_round()
             summary.rounds += 1
+            if progress and window_end >= next_heartbeat:
+                self._log_heartbeat(window_end, stop, wall_start, sys.stderr)
+                next_heartbeat = window_end + heartbeat
             nxt = self._min_next_event()
             if inflight_min is not None and (nxt is None or inflight_min < nxt):
                 nxt = inflight_min
@@ -245,7 +268,28 @@ class Manager:
                         f"{proc.expected_final_state!r}, got {state!r}")
         if self._pool is not None:
             self._pool.shutdown()
+        # Flush captures even when the caller never writes a data dir.
+        for h in self.hosts:
+            for iface in (h.lo, h.eth0):
+                if iface.pcap is not None:
+                    iface.pcap.close()
         return summary
+
+    def _log_heartbeat(self, sim_now: int, stop: int, wall_start: float,
+                       out) -> None:
+        """Progress + resource heartbeat (manager.rs:679-721; the format
+        is load-bearing for tornettools-style downstream parsing in the
+        reference, so keep it stable once published)."""
+        wall = time.perf_counter() - wall_start
+        pct = 100.0 * sim_now / stop if stop else 100.0
+        events = sum(h.counters["events"] for h in self.hosts)
+        packets = sum(h.counters["packets_sent"] for h in self.hosts)
+        mem_kb = _rss_kb()
+        rate = (sim_now / 1e9) / wall if wall > 0 else 0.0
+        print(f"[shadow-tpu] heartbeat: sim {sim_now / 1e9:.3f}s / "
+              f"{stop / 1e9:.3f}s ({pct:.1f}%), {rate:.2f} sim-sec/wall-sec, "
+              f"events {events}, packets {packets}, rss {mem_kb} kB",
+              file=out, flush=True)
 
     # ------------------------------------------------------------------
     # Outputs
@@ -262,6 +306,8 @@ class Manager:
         os.makedirs(base, exist_ok=True)
         with open(os.path.join(base, "processed-config.yaml"), "w") as f:
             f.write(f"# shadow_tpu run; seed={self.config.general.seed}\n")
+        with open(os.path.join(base, "hosts.txt"), "w") as f:
+            f.write(self.dns.hosts_file_text())
         for h in self.hosts:
             hdir = os.path.join(base, "hosts", h.name)
             os.makedirs(hdir, exist_ok=True)
@@ -271,6 +317,9 @@ class Manager:
                     f.write(bytes(proc.stdout))
                 with open(stem + ".stderr", "wb") as f:
                     f.write(bytes(proc.stderr))
+                if proc.strace_mode is not None:
+                    with open(stem + ".strace", "wb") as f:
+                        f.write(bytes(proc.strace))
         with open(os.path.join(base, "packet-trace.txt"), "w") as f:
             for line in self.trace_lines():
                 f.write(line + "\n")
@@ -286,6 +335,18 @@ class Manager:
         }
         with open(os.path.join(base, "sim-stats.json"), "w") as f:
             json.dump(stats, f, indent=2, sort_keys=True)
+
+
+def _rss_kb() -> int:
+    """Resident set size from /proc (ref: resource_usage.rs meminfo)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
 
 
 def run_simulation(config: ConfigOptions, write_data: bool = False):

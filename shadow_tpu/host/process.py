@@ -86,6 +86,11 @@ class Thread:
             result = host.syscall_handler.dispatch(host, process, self, call,
                                                    restarted)
             host.counters["syscalls"] += 1
+            if process.strace_mode is not None:
+                from shadow_tpu.host import strace
+                process.strace += strace.format_call(
+                    host.now(), self.tid, call, result,
+                    process.strace_mode).encode()
             kind = result[0]
             if kind == "done":
                 self._pending_send = result[1]
@@ -137,6 +142,8 @@ class Process:
         self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
         self.stderr = bytearray()
+        self.strace = bytearray()
+        self.strace_mode: str | None = None  # set by the manager when on
         self.expected_final_state = expected_final_state
         self.fds = host_descriptor_table()
 

@@ -1,0 +1,46 @@
+"""Per-process strace-style syscall logs.
+
+Ref: src/main/host/syscall/formatter.rs (the `handle!` wrapper writes one
+line per syscall into <process>.strace). `deterministic` mode elides
+payload *contents* (lengths only) so two runs — and two schedulers —
+byte-diff clean even if app data contains run-varying material; the
+reference's deterministic mode elides pointers for the same reason.
+"""
+
+from __future__ import annotations
+
+MODE_OFF = "off"
+MODE_STANDARD = "standard"
+MODE_DETERMINISTIC = "deterministic"
+
+
+def _fmt_value(v, deterministic: bool):
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        if deterministic or len(b) > 32:
+            return f"<{len(b)} bytes>"
+        return repr(b)
+    if isinstance(v, tuple):
+        return "(" + ", ".join(_fmt_value(x, deterministic) for x in v) + ")"
+    if callable(v):
+        return f"<fn {getattr(v, '__name__', 'anon')}>"
+    return repr(v)
+
+
+def format_call(sim_now: int, tid: int, call: tuple, result,
+                mode: str) -> str:
+    deterministic = mode == MODE_DETERMINISTIC
+    name = call[0]
+    args = ", ".join(_fmt_value(a, deterministic) for a in call[1:])
+    kind = result[0]
+    if kind == "done":
+        rendered = _fmt_value(result[1], deterministic)
+    elif kind == "error":
+        e = result[1]
+        rendered = f"-1 ({e.strerror or e.args[-1]}) [errno {e.errno}]"
+    elif kind == "block":
+        rendered = "<blocked>"
+    else:
+        rendered = f"<{kind}>"
+    sec, ns = divmod(sim_now, 10**9)
+    return f"{sec:05d}.{ns:09d} [tid {tid}] {name}({args}) = {rendered}\n"

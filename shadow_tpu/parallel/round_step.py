@@ -103,14 +103,14 @@ def build_sharded_round_step(mesh, latency_ns: np.ndarray,
         fits = keep & (slot_in_dst < C)
         overflow = keep & jnp.logical_not(fits)
 
-        flat = dst_shard * C + slot_in_dst
+        # One-pass scatter, O(B): non-fitting packets write out of bounds
+        # and are dropped.
+        flat = jnp.where(fits, dst_shard * C + slot_in_dst, n_shards * C)
         pkt_ids = jnp.arange(src_node.shape[0], dtype=jnp.int32)
-        send_idx = jnp.where(
-            fits[None, :] & (jnp.arange(n_shards * C)[:, None] == flat[None, :]),
-            pkt_ids[None, :], -1).max(axis=1).reshape(n_shards, C)
-        send_time = jnp.where(
-            fits[None, :] & (jnp.arange(n_shards * C)[:, None] == flat[None, :]),
-            deliver[None, :], _I64_MAX).min(axis=1).reshape(n_shards, C)
+        send_idx = jnp.full(n_shards * C, -1, dtype=jnp.int32) \
+            .at[flat].set(pkt_ids, mode="drop").reshape(n_shards, C)
+        send_time = jnp.full(n_shards * C, _I64_MAX, dtype=jnp.int64) \
+            .at[flat].set(deliver, mode="drop").reshape(n_shards, C)
 
         # all_to_all over the mesh axis (tiled: [n_shards, C] stays
         # [n_shards, C], row j of the result = what shard j sent to us).

@@ -1,0 +1,73 @@
+"""Pcap capture (ref: src/main/utility/pcap_writer.rs, hooked at
+src/main/host/network/interface.rs:45-51).
+
+Writes classic libpcap format (magic 0xA1B2C3D4, LINKTYPE_RAW=101) with
+synthesized IPv4+TCP/UDP headers — enough for wireshark/tcpdump to
+dissect simulated flows. Timestamps are emulated time.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net import packet as pkt
+
+_LINKTYPE_RAW = 101
+
+
+def _ipv4_header(p, total_len: int) -> bytes:
+    header = struct.pack(
+        ">BBHHHBBH4s4s",
+        0x45, 0, total_len, (p.src_host_id * 31 + p.seq) & 0xFFFF,
+        0x4000,  # don't fragment
+        64, p.protocol, 0,
+        p.src_ip.to_bytes(4, "big"), p.dst_ip.to_bytes(4, "big"))
+    checksum = _inet_checksum(header)
+    return header[:10] + struct.pack(">H", checksum) + header[12:]
+
+
+def _inet_checksum(data: bytes) -> int:
+    total = 0
+    for i in range(0, len(data) - 1, 2):
+        total += (data[i] << 8) | data[i + 1]
+    if len(data) % 2:
+        total += data[-1] << 8
+    while total >> 16:
+        total = (total & 0xFFFF) + (total >> 16)
+    return ~total & 0xFFFF
+
+
+def _transport_header(p) -> bytes:
+    if p.protocol == pkt.PROTO_UDP:
+        return struct.pack(">HHHH", p.src_port, p.dst_port,
+                           8 + len(p.payload), 0)
+    t = p.tcp
+    flags = t.flags if t is not None else 0
+    seq = t.seq if t is not None else 0
+    ack = t.ack if t is not None else 0
+    window = t.window if t is not None else 0
+    return struct.pack(">HHIIBBHHH", p.src_port, p.dst_port, seq, ack,
+                       5 << 4, flags & 0xFF, min(window, 0xFFFF), 0, 0)
+
+
+class PcapWriter:
+    def __init__(self, path: str, capture_size: int = 65535):
+        self._f = open(path, "wb")
+        self.capture_size = capture_size
+        self._f.write(struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0,
+                                  capture_size, _LINKTYPE_RAW))
+
+    def write_packet(self, sim_now: int, p) -> None:
+        emu = simtime.emulated_from_sim(sim_now)
+        ip_payload = _transport_header(p) + p.payload
+        frame = _ipv4_header(p, 20 + len(ip_payload)) + ip_payload
+        snap = frame[:self.capture_size]
+        self._f.write(struct.pack("<IIII", emu // simtime.NSEC_PER_SEC,
+                                  (emu % simtime.NSEC_PER_SEC) // 1000,
+                                  len(snap), len(frame)))
+        self._f.write(snap)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
