@@ -1,0 +1,347 @@
+/* The shim: loaded into every managed process via LD_PRELOAD.
+ *
+ * TPU-native rebuild of the reference's interposition stack
+ * (src/lib/shim/shim.c, shim_seccomp.c, shim_api_syscall.c,
+ * shim_sys.c, src/lib/preload-injector/injector.c) collapsed into one
+ * C library:
+ *
+ *  - constructor maps the IPC block (path in SHADOWTPU_IPC), installs a
+ *    SIGSYS handler and a seccomp filter that traps EVERY syscall whose
+ *    instruction pointer is outside the trampoline section;
+ *  - trapped syscalls are either answered locally (time family, from
+ *    the manager-maintained shared sim clock — ref shim_sys.c:35-160)
+ *    or forwarded over the futex channel to the simulator and this
+ *    thread blocks until the response arrives (ref shim_api_syscall.c);
+ *  - DO_NATIVE responses re-issue the original syscall through the
+ *    trampoline (the only IP range the filter allows).
+ *
+ * vDSO bypass: libc routes clock_gettime/gettimeofday/time through the
+ * vDSO, which never executes a syscall instruction, so seccomp cannot
+ * see it.  This library also overrides those libc symbols (it is
+ * preloaded, so its definitions win) — the same job the reference's
+ * patch_vdso.c + preload-libc wrappers do.
+ */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/futex.h>
+#include <linux/seccomp.h>
+#include <signal.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/ucontext.h>
+#include <time.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include "shim_ipc.h"
+
+/* Defined in shim_trampoline.S; section bounds provided by the linker. */
+extern long shadowtpu_raw_syscall(long n, long a1, long a2, long a3,
+                                  long a4, long a5, long a6);
+extern char __start_shim_sys_text[];
+extern char __stop_shim_sys_text[];
+
+static shim_ipc_t *g_ipc = NULL;
+static int g_enabled = 0;
+/* Every Nth locally-answerable time syscall is forwarded anyway so the
+ * manager's CPU-latency model can advance simulated time under
+ * time-polling busy loops (ref: unapplied-cpu-latency accounting,
+ * src/main/host/syscall/handler/mod.rs:271-321). */
+#define LOCAL_TIME_FORWARD_EVERY 1024
+static uint32_t g_local_time_count = 0;
+
+#define raw shadowtpu_raw_syscall
+
+static void shim_die(const char *msg) {
+    size_t n = 0;
+    while (msg[n]) n++;
+    raw(SYS_write, 2, (long)msg, (long)n, 0, 0, 0);
+    raw(SYS_exit_group, 126, 0, 0, 0, 0, 0);
+    __builtin_unreachable();
+}
+
+/* ---------------------------------------------------------------- */
+/* Futex channel (one outstanding message per direction)             */
+/* ---------------------------------------------------------------- */
+
+static void futex_wake_word(ipc_atomic_u32 *word) {
+    raw(SYS_futex, (long)word, FUTEX_WAKE, 1, 0, 0, 0);
+}
+
+static uint32_t futex_wait_word(ipc_atomic_u32 *word, uint32_t seen) {
+    for (;;) {
+        uint32_t now = __atomic_load_n((uint32_t *)word, __ATOMIC_ACQUIRE);
+        if (now != seen)
+            return now;
+        raw(SYS_futex, (long)word, FUTEX_WAIT, (long)seen, 0, 0, 0);
+        /* EINTR/EAGAIN: re-check the word either way. */
+    }
+}
+
+static void slot_send(ipc_slot_t *slot, const shim_event_t *ev) {
+    /* Protocol guarantees the slot is EMPTY when we get here. */
+    memcpy(&slot->ev, ev, sizeof(*ev));
+    __atomic_store_n((uint32_t *)&slot->status, SLOT_READY, __ATOMIC_RELEASE);
+    futex_wake_word(&slot->status);
+}
+
+static void slot_recv(ipc_slot_t *slot, shim_event_t *out) {
+    uint32_t st = __atomic_load_n((uint32_t *)&slot->status, __ATOMIC_ACQUIRE);
+    while (st != SLOT_READY) {
+        if (st == SLOT_CLOSED)
+            shim_die("[shadow-tpu shim] manager closed the channel\n");
+        st = futex_wait_word(&slot->status, st);
+    }
+    memcpy(out, &slot->ev, sizeof(*out));
+    __atomic_store_n((uint32_t *)&slot->status, SLOT_EMPTY, __ATOMIC_RELEASE);
+    futex_wake_word(&slot->status);
+}
+
+/* ---------------------------------------------------------------- */
+/* Syscall emulation path                                            */
+/* ---------------------------------------------------------------- */
+
+static uint64_t shim_sim_now(void) {
+    return __atomic_load_n((uint64_t *)&g_ipc->sim_time_ns, __ATOMIC_ACQUIRE);
+}
+
+static long shim_ipc_syscall(long n, const long args[6]) {
+    shim_event_t ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = EV_SYSCALL;
+    ev.num = n;
+    memcpy(ev.args, args, sizeof(ev.args));
+    slot_send(&g_ipc->to_shadow, &ev);
+    slot_recv(&g_ipc->to_shim, &ev);
+    if (ev.kind == EV_SYSCALL_COMPLETE)
+        return ev.num;
+    if (ev.kind == EV_SYSCALL_DO_NATIVE)
+        return raw(n, args[0], args[1], args[2], args[3], args[4], args[5]);
+    shim_die("[shadow-tpu shim] unexpected response kind\n");
+    return -ENOSYS;
+}
+
+/* Returns 1 if handled locally, placing the result in *ret. */
+static int shim_try_local(long n, const long args[6], long *ret) {
+    switch (n) {
+    case SYS_clock_gettime: {
+        struct timespec *ts = (struct timespec *)args[1];
+        uint64_t now = shim_sim_now();
+        long clk = args[0];
+        if (clk == CLOCK_REALTIME || clk == CLOCK_REALTIME_COARSE ||
+            clk == CLOCK_TAI)
+            now += SHIM_EMU_EPOCH_NS;
+        if (ts) {
+            ts->tv_sec = (time_t)(now / 1000000000ull);
+            ts->tv_nsec = (long)(now % 1000000000ull);
+        }
+        *ret = 0;
+        return 1;
+    }
+    case SYS_clock_getres: {
+        struct timespec *ts = (struct timespec *)args[1];
+        if (ts) { ts->tv_sec = 0; ts->tv_nsec = 1; }
+        *ret = 0;
+        return 1;
+    }
+    case SYS_gettimeofday: {
+        struct timeval *tv = (struct timeval *)args[0];
+        uint64_t now = shim_sim_now() + SHIM_EMU_EPOCH_NS;
+        if (tv) {
+            tv->tv_sec = (time_t)(now / 1000000000ull);
+            tv->tv_usec = (suseconds_t)((now % 1000000000ull) / 1000ull);
+        }
+        if (args[1]) {  /* timezone: UTC */
+            struct timezone *tz = (struct timezone *)args[1];
+            tz->tz_minuteswest = 0;
+            tz->tz_dsttime = 0;
+        }
+        *ret = 0;
+        return 1;
+    }
+    case SYS_time: {
+        uint64_t now = shim_sim_now() + SHIM_EMU_EPOCH_NS;
+        long secs = (long)(now / 1000000000ull);
+        if (args[0])
+            *(time_t *)args[0] = secs;
+        *ret = secs;
+        return 1;
+    }
+    case SYS_getcpu: {
+        if (args[0]) *(unsigned *)args[0] = 0;
+        if (args[1]) *(unsigned *)args[1] = 0;
+        *ret = 0;
+        return 1;
+    }
+    default:
+        return 0;
+    }
+}
+
+/* Central dispatch: the shim-side half of the syscall round trip. */
+static long shim_emulated_syscall(long n, const long args[6]) {
+    long ret;
+    if (shim_try_local(n, args, &ret)) {
+        if (++g_local_time_count % LOCAL_TIME_FORWARD_EVERY != 0)
+            return ret;
+        /* Fall through: let the manager account CPU latency, then
+         * recompute locally (the clock may have advanced). */
+        long lat_args[6] = {0, 0, 0, 0, 0, 0};
+        shim_ipc_syscall(SYS_sched_yield, lat_args);
+        shim_try_local(n, args, &ret);
+        return ret;
+    }
+    return shim_ipc_syscall(n, args);
+}
+
+/* ---------------------------------------------------------------- */
+/* SIGSYS: where trapped application syscalls land                   */
+/* ---------------------------------------------------------------- */
+
+static void sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
+    (void)sig;
+    ucontext_t *ctx = (ucontext_t *)ucontext;
+    greg_t *gregs = ctx->uc_mcontext.gregs;
+    long n = (long)info->si_syscall;
+    long args[6] = {
+        (long)gregs[REG_RDI], (long)gregs[REG_RSI], (long)gregs[REG_RDX],
+        (long)gregs[REG_R10], (long)gregs[REG_R8],  (long)gregs[REG_R9],
+    };
+    gregs[REG_RAX] = (greg_t)shim_emulated_syscall(n, args);
+}
+
+/* ---------------------------------------------------------------- */
+/* Seccomp filter: allow only the trampoline's IP range              */
+/* ---------------------------------------------------------------- */
+
+static void install_seccomp(void) {
+    uint64_t lo = (uint64_t)(uintptr_t)__start_shim_sys_text;
+    uint64_t hi = (uint64_t)(uintptr_t)__stop_shim_sys_text;
+    if ((lo >> 32) != (hi >> 32))
+        shim_die("[shadow-tpu shim] trampoline straddles 4GB boundary\n");
+    uint32_t ip_hi = (uint32_t)(lo >> 32);
+    uint32_t lo32 = (uint32_t)lo, hi32 = (uint32_t)hi;
+
+    struct sock_filter filt[] = {
+        /* [0] arch check */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, arch)),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, AUDIT_ARCH_X86_64, 1, 0),
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS),
+        /* [3] rt_sigreturn must always pass (signal-frame teardown
+         * happens at libc/kernel IPs we cannot enumerate). */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, nr)),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_rt_sigreturn, 5, 0),
+        /* [5] 64-bit IP range test (range fits one 4GB window). */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, instruction_pointer) + 4),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, ip_hi, 0, 4 /*TRAP*/),
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, instruction_pointer)),
+        BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, lo32, 0, 2 /*TRAP*/),
+        BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, hi32, 1 /*TRAP*/, 0),
+        /* [10] ALLOW */
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+        /* [11] TRAP -> SIGSYS */
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_TRAP),
+    };
+    struct sock_fprog prog = {
+        .len = sizeof(filt) / sizeof(filt[0]),
+        .filter = filt,
+    };
+    if (raw(SYS_prctl, PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0, 0) != 0)
+        shim_die("[shadow-tpu shim] PR_SET_NO_NEW_PRIVS failed\n");
+    if (raw(SYS_seccomp, SECCOMP_SET_MODE_FILTER, 0, (long)&prog, 0, 0, 0)
+        != 0)
+        shim_die("[shadow-tpu shim] seccomp install failed\n");
+}
+
+/* ---------------------------------------------------------------- */
+/* vDSO-bypass overrides (preload wins the symbol lookup)            */
+/* ---------------------------------------------------------------- */
+
+int clock_gettime(clockid_t clk, struct timespec *ts) {
+    if (!g_enabled) {
+        long r = raw(SYS_clock_gettime, clk, (long)ts, 0, 0, 0, 0);
+        if (r < 0) { errno = (int)-r; return -1; }
+        return 0;
+    }
+    long args[6] = {clk, (long)ts, 0, 0, 0, 0};
+    long r = shim_emulated_syscall(SYS_clock_gettime, args);
+    if (r < 0) { errno = (int)-r; return -1; }
+    return 0;
+}
+
+int gettimeofday(struct timeval *tv, void *tz) {
+    if (!g_enabled) {
+        long r = raw(SYS_gettimeofday, (long)tv, (long)tz, 0, 0, 0, 0);
+        if (r < 0) { errno = (int)-r; return -1; }
+        return 0;
+    }
+    long args[6] = {(long)tv, (long)tz, 0, 0, 0, 0};
+    long r = shim_emulated_syscall(SYS_gettimeofday, args);
+    if (r < 0) { errno = (int)-r; return -1; }
+    return 0;
+}
+
+time_t time(time_t *tloc) {
+    if (!g_enabled)
+        return (time_t)raw(SYS_time, (long)tloc, 0, 0, 0, 0, 0);
+    long args[6] = {(long)tloc, 0, 0, 0, 0, 0};
+    return (time_t)shim_emulated_syscall(SYS_time, args);
+}
+
+/* ---------------------------------------------------------------- */
+/* Init                                                              */
+/* ---------------------------------------------------------------- */
+
+__attribute__((constructor(65535)))
+static void shim_init(void) {
+    const char *path = getenv("SHADOWTPU_IPC");
+    if (!path || !*path)
+        return;  /* not under the simulator; stay dormant */
+
+    long fd = raw(SYS_openat, AT_FDCWD, (long)path, O_RDWR, 0, 0, 0);
+    if (fd < 0)
+        shim_die("[shadow-tpu shim] cannot open IPC file\n");
+    long addr = raw(SYS_mmap, 0, SHIM_IPC_FILE_SIZE,
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (addr < 0 && addr > -4096)
+        shim_die("[shadow-tpu shim] cannot mmap IPC file\n");
+    raw(SYS_close, fd, 0, 0, 0, 0, 0);
+    g_ipc = (shim_ipc_t *)addr;
+    if (g_ipc->magic != SHIM_IPC_MAGIC || g_ipc->version != SHIM_IPC_VERSION)
+        shim_die("[shadow-tpu shim] IPC magic/version mismatch\n");
+
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = sigsys_handler;
+    sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+    if (sigaction(SIGSYS, &sa, NULL) != 0)
+        shim_die("[shadow-tpu shim] sigaction(SIGSYS) failed\n");
+
+    install_seccomp();
+    g_enabled = 1;
+
+    /* Handshake (ref: managed_thread.rs:138,207-251): announce, then
+     * wait for clearance — the manager releases us at the scheduled
+     * simulated spawn instant. */
+    shim_event_t ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = EV_START_REQ;
+    ev.num = (int64_t)raw(SYS_getpid, 0, 0, 0, 0, 0, 0);
+    slot_send(&g_ipc->to_shadow, &ev);
+    slot_recv(&g_ipc->to_shim, &ev);
+    if (ev.kind != EV_START_RES)
+        shim_die("[shadow-tpu shim] bad start handshake\n");
+}

@@ -1,0 +1,101 @@
+/* Shared-memory IPC ABI between the manager (simulator process) and the
+ * shim loaded into every managed process.
+ *
+ * Structural equivalent of the reference's IPCData channel pair
+ * (src/lib/shadow-shim-helper-rs/src/ipc.rs:14-46) over futex-backed
+ * SPSC channels (src/lib/vasi-sync/src/scchannel.rs), flattened into a
+ * single C struct so the Python manager can address it with plain
+ * offsets over an mmap.  The protocol is strictly alternating
+ * request/response (one outstanding message per direction), which is
+ * all the syscall round-trip needs.
+ *
+ * Layout is fixed and must match shadow_tpu/host/shim_abi.py.
+ */
+#ifndef SHADOWTPU_SHIM_IPC_H
+#define SHADOWTPU_SHIM_IPC_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+#include <atomic>
+typedef std::atomic<uint32_t> ipc_atomic_u32;
+typedef std::atomic<uint64_t> ipc_atomic_u64;
+#else
+#include <stdatomic.h>
+typedef _Atomic uint32_t ipc_atomic_u32;
+typedef _Atomic uint64_t ipc_atomic_u64;
+#endif
+
+#define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
+#define SHIM_IPC_VERSION 1u
+
+/* Slot status values; the status word doubles as the futex word. */
+enum {
+    SLOT_EMPTY  = 0, /* receiver consumed the last message */
+    SLOT_READY  = 1, /* sender published a message          */
+    SLOT_CLOSED = 2, /* peer is gone; never cleared         */
+};
+
+/* Event kinds (ref: shim_event.rs:86-123). */
+enum {
+    EV_NULL      = 0,
+    /* shim -> shadow */
+    EV_START_REQ = 1,  /* process is up, waiting for clearance  */
+    EV_SYSCALL   = 2,  /* num + 6 args, please service          */
+    /* shadow -> shim */
+    EV_START_RES          = 16, /* run the app                  */
+    EV_SYSCALL_COMPLETE   = 17, /* num = return value           */
+    EV_SYSCALL_DO_NATIVE  = 18, /* execute natively, don't ask  */
+};
+
+typedef struct {
+    uint32_t kind;
+    uint32_t _pad;
+    int64_t  num;      /* syscall number, or return value for COMPLETE */
+    int64_t  args[6];
+} shim_event_t;        /* 64 bytes */
+
+typedef struct {
+    ipc_atomic_u32 status; /* futex word */
+    uint32_t       _pad;
+    shim_event_t   ev;
+} ipc_slot_t;              /* 72 bytes */
+
+typedef struct {
+    uint32_t magic;
+    uint32_t version;
+    /* Simulation clock, maintained by the manager before every resume;
+     * the shim answers time syscalls from it without a round trip
+     * (ref: shim_sys.c:35-160 reading host shmem).  Emulated
+     * CLOCK_REALTIME = sim_time_ns + epoch offset (applied shim-side,
+     * EMUTIME_SIMULATION_START in core/simtime.py). */
+    ipc_atomic_u64 sim_time_ns;
+    /* Deterministic bytes for AT_RANDOM-style needs (future use). */
+    uint64_t auxv_random[2];
+    ipc_slot_t to_shadow;
+    ipc_slot_t to_shim;
+} shim_ipc_t;
+
+#define SHIM_IPC_FILE_SIZE 4096
+
+/* Simulated UNIX epoch at sim time 0: 2000-01-01 00:00:00 UTC
+ * (must equal EMUTIME_SIMULATION_START in shadow_tpu/core/simtime.py). */
+#define SHIM_EMU_EPOCH_NS (946684800ull * 1000000000ull)
+
+#ifdef __cplusplus
+static_assert(sizeof(shim_event_t) == 64, "shim_event_t layout");
+static_assert(sizeof(ipc_slot_t) == 72, "ipc_slot_t layout");
+#else
+_Static_assert(sizeof(shim_event_t) == 64, "shim_event_t layout");
+_Static_assert(sizeof(ipc_slot_t) == 72, "ipc_slot_t layout");
+_Static_assert(sizeof(shim_ipc_t) <= SHIM_IPC_FILE_SIZE, "fits in file");
+#endif
+
+/* Offsets the Python side mirrors (checked by tests). */
+#define IPC_OFF_SIM_TIME   8
+#define IPC_OFF_AUXV       16
+#define IPC_OFF_TO_SHADOW  32
+#define IPC_OFF_TO_SHIM    (32 + 72)
+#define IPC_SLOT_EV_OFF    8
+
+#endif /* SHADOWTPU_SHIM_IPC_H */

@@ -36,13 +36,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.show_build_info:
         import shadow_tpu
         print(f"shadow-tpu {shadow_tpu.__version__}")
         return 0
     if args.config is None:
-        build_parser().error("the config argument is required")
+        parser.print_usage(sys.stderr)
+        print("shadow-tpu: error: the config argument is required",
+              file=sys.stderr)
+        return 2
 
     import yaml
 

@@ -82,6 +82,10 @@ class Manager:
         self.syscall_handler = SyscallHandler(
             send_buf=config.experimental.socket_send_buffer,
             recv_buf=config.experimental.socket_recv_buffer)
+        from shadow_tpu.host.syscalls_native import NativeSyscallHandler
+        self.syscall_handler_native = NativeSyscallHandler(
+            send_buf=config.experimental.socket_send_buffer,
+            recv_buf=config.experimental.socket_recv_buffer)
 
         # Build hosts in sorted-name order: host ids — and with them every
         # RNG stream and ordering tiebreak — are config-deterministic.
@@ -105,11 +109,13 @@ class Manager:
                         qdisc=config.experimental.interface_qdisc)
             host.dns = self.dns
             host.syscall_handler = self.syscall_handler
+            host.syscall_handler_native = self.syscall_handler_native
+            host.data_path = os.path.join(config.general.data_directory,
+                                          "hosts", name)
             self.dns.register(host_id, ip, name)
             if hcfg.pcap_enabled:
                 from shadow_tpu.utils.pcap import PcapWriter
-                hdir = os.path.join(config.general.data_directory, "hosts",
-                                    name)
+                hdir = host.data_path
                 os.makedirs(hdir, exist_ok=True)
                 for iface in (host.lo, host.eth0):
                     iface.pcap = PcapWriter(
@@ -168,17 +174,41 @@ class Manager:
 
         def spawn(h, _pcfg=pcfg):
             factory = app_registry.lookup(_pcfg.path)
-            process = Process(h, f"{_pcfg.path}.{index}", _pcfg.args,
-                              _pcfg.environment,
-                              expected_final_state=_pcfg.expected_final_state)
-            process.strace_mode = strace_mode
-            spawned.append(process)
+            if factory is None and "/" in _pcfg.path:
+                # An explicit filesystem path: a real Linux binary, run
+                # under the interposition stack (preload shim + seccomp
+                # over the shmem IPC channel; host/managed.py).  Bare
+                # names never fall through to $PATH — a typo'd internal-
+                # app name must not execute some unrelated host program.
+                from shadow_tpu.host.managed import ManagedProcess
+                base = os.path.basename(_pcfg.path)
+                process = ManagedProcess(
+                    h, f"{base}.{index}",
+                    [_pcfg.path] + list(_pcfg.args),
+                    _pcfg.environment,
+                    expected_final_state=_pcfg.expected_final_state,
+                    work_dir=h.data_path)
+                process.strace_mode = strace_mode
+                spawned.append(process)
+                process.start_native(h, _pcfg.path)
+                return
             if factory is None:
+                process = Process(h, f"{_pcfg.path}.{index}", _pcfg.args,
+                                  _pcfg.environment,
+                                  expected_final_state=_pcfg.
+                                  expected_final_state)
+                process.strace_mode = strace_mode
+                spawned.append(process)
                 process.stderr += (f"[shadow-tpu] unknown app "
                                    f"{_pcfg.path!r}\n").encode()
                 process.exited = True
                 process.exit_code = 127
                 return
+            process = Process(h, f"{_pcfg.path}.{index}", _pcfg.args,
+                              _pcfg.environment,
+                              expected_final_state=_pcfg.expected_final_state)
+            process.strace_mode = strace_mode
+            spawned.append(process)
             process.start(h, factory(process, _pcfg.args))
 
         from shadow_tpu.core.event import TaskRef
@@ -268,6 +298,13 @@ class Manager:
                         f"{proc.expected_final_state!r}, got {state!r}")
         if self._pool is not None:
             self._pool.shutdown()
+        # Tear down any still-running managed (native) processes.
+        from shadow_tpu.host.managed import ManagedProcess
+        for h in self.hosts:
+            for proc in h.processes.values():
+                if isinstance(proc, ManagedProcess) and not proc.exited:
+                    proc.kill_native()
+                    proc.collect_output()
         # Flush captures even when the caller never writes a data dir.
         for h in self.hosts:
             for iface in (h.lo, h.eth0):

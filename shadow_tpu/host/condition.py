@@ -67,3 +67,63 @@ class SyscallCondition:
         # loop, not from inside whatever triggered the status change.
         host.schedule_task_at(host.now(), TaskRef("syscall-wakeup",
                                                   self._wakeup_fn))
+
+
+class MultiSyscallCondition:
+    """poll/select/epoll-style condition: wake when ANY of several files
+    gains a watched status bit, or on timeout — the many-trigger shape
+    the reference builds from one SyscallCondition per status listener
+    plus its timeout (syscall_condition.c:421-480); one object here.
+
+    Same arm/disarm/timed_out interface as SyscallCondition so Thread
+    and ManagedThread treat both uniformly.
+    """
+
+    __slots__ = ("_watches", "_timeout_at", "_armed", "_handles",
+                 "_wakeup_fn", "timed_out")
+
+    def __init__(self, watches: list, timeout_at: int | None = None):
+        """watches: [(file, mask), ...]; may be empty for a pure sleep."""
+        assert watches or timeout_at is not None
+        self._watches = watches
+        self._timeout_at = timeout_at
+        self._armed = False
+        self._handles = []
+        self._wakeup_fn = None
+        self.timed_out = False
+
+    def arm(self, host, wakeup_fn) -> None:
+        assert not self._armed
+        self._armed = True
+        self._wakeup_fn = wakeup_fn
+        for file, mask in self._watches:
+            if file.status & mask:
+                self._fire(host, timed_out=False)
+                return
+        for file, mask in self._watches:
+            self._handles.append(
+                (file, file.add_status_listener(mask, self._on_status)))
+        if self._armed and self._timeout_at is not None:
+            host.schedule_task_at(self._timeout_at,
+                                  TaskRef("condition-timeout",
+                                          self._on_timeout))
+
+    def disarm(self) -> None:
+        self._armed = False
+        for file, handle in self._handles:
+            file.remove_status_listener(handle)
+        self._handles = []
+
+    def _on_status(self, owner, changed, host) -> None:
+        if self._armed:
+            self._fire(host, timed_out=False)
+
+    def _on_timeout(self, host) -> None:
+        if self._armed and host.now() >= self._timeout_at:
+            self._fire(host, timed_out=True)
+
+    def _fire(self, host, timed_out: bool) -> None:
+        self.disarm()
+        self.timed_out = timed_out
+        host.schedule_task_at(host.now(), TaskRef("syscall-wakeup",
+                                                  self._wakeup_fn))

@@ -1,0 +1,389 @@
+"""Managed processes: real, unmodified Linux binaries under the sim.
+
+The manager half of the interposition stack (the in-process half lives
+in native/shim.c).  Mirrors the reference's resume chain — Process →
+Thread → ManagedThread driving the native process over shared-memory
+IPC (src/main/host/managed_thread.rs:97-333, process.rs:944,
+memory_manager/memory_copier.rs) — with the same protocol:
+
+ - spawn at the scheduled sim instant via posix_spawn with LD_PRELOAD;
+ - StartReq/StartRes handshake gates the app's main();
+ - resume(): receive Syscall events, dispatch into the simulated
+   kernel, answer Complete / DoNative, or park on a SyscallCondition
+   and re-run the same syscall when it fires (restart protocol,
+   handler/mod.rs:127-136);
+ - child death is detected by waitpid polling during channel waits
+   (the reference uses a pidfd watcher thread, childpid_watcher.rs;
+   polling keeps the manager single-threaded per host);
+ - an unblocked-syscall CPU-latency model parks the thread every so
+   often so syscall-spinning code advances simulated time
+   (handler/mod.rs:271-321).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import time as _walltime
+
+from shadow_tpu.core.event import TaskRef
+from shadow_tpu.host.process import Process, ST_BLOCKED, ST_EXITED, ST_RUNNABLE
+from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
+                                      EV_START_REQ, EV_START_RES, EV_SYSCALL,
+                                      EV_SYSCALL_COMPLETE,
+                                      EV_SYSCALL_DO_NATIVE)
+
+# CPU-latency model (ref defaults: configuration.rs:464-480 — 1-2us per
+# unblocked syscall, applied in batches).  Applying == parking the
+# thread and resuming via the event queue, which serializes every
+# managed syscall into the deterministic event timeline.
+SYSCALL_LATENCY_NS = 1_000
+MAX_UNAPPLIED_NS = 20_000
+
+_DEATH_POLL_NS = 100_000_000  # 100ms channel-wait slices between waitpid polls
+
+
+class MemoryManager:
+    """Zero-copy-ish access to managed-process memory via /proc/pid/mem
+    (ref: memory_copier.rs; the remapping MemoryMapper optimization is
+    future work)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._fd = os.open(f"/proc/{pid}/mem", os.O_RDWR)
+
+    def read(self, addr: int, n: int) -> bytes:
+        if n <= 0:
+            return b""
+        data = os.pread(self._fd, n, addr)
+        if len(data) != n:
+            raise OSError(14, "short read from managed process memory")
+        return data
+
+    def try_read(self, addr: int, n: int) -> bytes | None:
+        try:
+            return self.read(addr, n)
+        except OSError:
+            return None
+
+    def write(self, addr: int, data: bytes) -> None:
+        if not data:
+            return
+        if os.pwrite(self._fd, data, addr) != len(data):
+            raise OSError(14, "short write to managed process memory")
+
+    def read_cstr(self, addr: int, limit: int = 4096) -> bytes:
+        out = bytearray()
+        while len(out) < limit:
+            chunk_len = min(256, limit - len(out))
+            chunk = self.read(addr + len(out), chunk_len)
+            nul = chunk.find(b"\0")
+            if nul >= 0:
+                out += chunk[:nul]
+                return bytes(out)
+            out += chunk
+        return bytes(out)
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+
+class ManagedProcess(Process):
+    """A Process whose thread drives a real OS process.
+
+    Reuses Process for pid/fd-table/final-state bookkeeping; `stdout`/
+    `stderr` fill from the native redirect files at exit so internal and
+    managed processes look identical to the manager.
+    """
+
+    def __init__(self, host, name, argv, env, expected_final_state="exited 0",
+                 work_dir: str | None = None):
+        super().__init__(host, name, argv, env, expected_final_state)
+        self.work_dir = work_dir or "."
+        self.native_pid: int | None = None
+        self.mem: MemoryManager | None = None
+        self._stdout_path: str | None = None
+        self._stderr_path: str | None = None
+
+    def start_native(self, host, exe_path: str | None = None) -> None:
+        exe = exe_path or (self.argv[0] if self.argv else None)
+        resolved = shutil.which(exe) if exe and "/" not in exe else exe
+        if not resolved or not os.path.exists(resolved):
+            self.stderr += f"[shadow-tpu] no such binary: {exe!r}\n".encode()
+            self.exited = True
+            self.exit_code = 127
+            return
+        try:
+            from shadow_tpu.native import ensure_shim_built
+            shim = ensure_shim_built()
+        except RuntimeError as e:
+            # No toolchain / build failure: a plugin error, not a sim
+            # crash (the run completes and reports it).
+            self.stderr += f"[shadow-tpu] {e}\n".encode()
+            self.exited = True
+            self.exit_code = 127
+            return
+
+        ipc_path = (f"/dev/shm/shadowtpu-{os.getpid()}-"
+                    f"{host.id}-{self.pid}.ipc")
+        ipc = IpcBlock(ipc_path)
+        ipc.set_sim_time(host.now())
+        ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
+
+        env = dict(self.env)
+        preload = shim
+        if env.get("LD_PRELOAD"):
+            preload = shim + ":" + env["LD_PRELOAD"]
+        env["LD_PRELOAD"] = preload
+        env["SHADOWTPU_IPC"] = ipc_path
+        # Eager relocation: keeps ld.so's lazy-binding syscalls out of
+        # the simulated timeline.
+        env.setdefault("LD_BIND_NOW", "1")
+
+        os.makedirs(self.work_dir, exist_ok=True)
+        self._stdout_path = os.path.join(self.work_dir,
+                                         f"{self.name}.{self.pid}.stdout")
+        self._stderr_path = os.path.join(self.work_dir,
+                                         f"{self.name}.{self.pid}.stderr")
+        wflags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+        file_actions = [
+            (os.POSIX_SPAWN_OPEN, 0, "/dev/null", os.O_RDONLY, 0),
+            (os.POSIX_SPAWN_OPEN, 1, self._stdout_path, wflags, 0o644),
+            (os.POSIX_SPAWN_OPEN, 2, self._stderr_path, wflags, 0o644),
+        ]
+        argv = list(self.argv) if self.argv else [resolved]
+        try:
+            self.native_pid = os.posix_spawn(
+                resolved, argv, env, file_actions=file_actions)
+        except OSError as e:
+            ipc.close()
+            self.stderr += (f"[shadow-tpu] spawn failed: {e}\n").encode()
+            self.exited = True
+            self.exit_code = 127
+            return
+        self.mem = MemoryManager(self.native_pid)
+        thread = ManagedThread(self, ipc, self._next_tid)
+        self._next_tid += 1
+        self.threads.append(thread)
+        thread.resume(host)
+
+    def collect_output(self) -> None:
+        for path, buf_name in ((self._stdout_path, "stdout"),
+                               (self._stderr_path, "stderr")):
+            if path and os.path.exists(path):
+                with open(path, "rb") as f:
+                    setattr(self, buf_name,
+                            getattr(self, buf_name) + bytearray(f.read()))
+
+    def kill_native(self) -> None:
+        """Forced teardown (simulation shutdown with the process still
+        running)."""
+        if self.native_pid is not None and not self.exited:
+            try:
+                os.kill(self.native_pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            try:
+                os.waitpid(self.native_pid, 0)
+            except ChildProcessError:
+                pass
+        for t in self.threads:
+            if isinstance(t, ManagedThread):
+                t.teardown()
+
+
+class ManagedThread:
+    """Drives one native thread over its IPC channel
+    (managed_thread.rs:190-333)."""
+
+    def __init__(self, process: ManagedProcess, ipc: IpcBlock, tid: int):
+        self.process = process
+        self.ipc = ipc
+        self.tid = tid
+        self.state = ST_RUNNABLE
+        self.native_tid: int | None = None
+        self._released = False
+        self._pending_response = None  # (kind, value) to send on re-entry
+        self._pending_call = None      # (num, args) to re-dispatch
+        self.last_condition = None
+        self._unapplied_ns = 0
+
+    # -- latency model ------------------------------------------------
+
+    def add_cpu_latency(self, ns: int) -> None:
+        self._unapplied_ns += ns
+
+    # -- channel helpers ----------------------------------------------
+
+    def _recv(self, host):
+        """Next shim event, or None if the child died."""
+        while True:
+            try:
+                return self.ipc.recv_from_shim(timeout_ns=_DEATH_POLL_NS)
+            except ChannelTimeout:
+                if self._poll_death(host):
+                    return None
+            except ChannelClosed:
+                self._poll_death(host, blocking=True)
+                return None
+
+    def _poll_death(self, host, blocking: bool = False) -> bool:
+        pid = self.process.native_pid
+        try:
+            done, status = os.waitpid(pid, 0 if blocking else os.WNOHANG)
+        except ChildProcessError:
+            self._finish(host, 126)
+            return True
+        if done == 0:
+            return False
+        if os.WIFEXITED(status):
+            code = os.WEXITSTATUS(status)
+        else:
+            code = 128 + os.WTERMSIG(status)
+        self._finish(host, code)
+        return True
+
+    # -- the resume loop ----------------------------------------------
+
+    def resume(self, host) -> None:
+        if self.state == ST_EXITED:
+            return
+        self.state = ST_RUNNABLE
+        self.ipc.set_sim_time(host.now())
+
+        if not self._released:
+            ev = self._recv(host)
+            if ev is None:
+                return
+            kind, num, _args = ev
+            if kind != EV_START_REQ:
+                self._protocol_error(host, f"expected StartReq, got {kind}")
+                return
+            self.native_tid = int(num)
+            self.ipc.send_to_shim(EV_START_RES)
+            self._released = True
+
+        if self._pending_response is not None:
+            kind, value = self._pending_response
+            self._pending_response = None
+            self.ipc.send_to_shim(kind, value)
+
+        if self._pending_call is not None:
+            num, args = self._pending_call
+            self._pending_call = None
+            if not self._service(host, num, args, restarted=True):
+                return
+
+        while True:
+            ev = self._recv(host)
+            if ev is None:
+                return
+            kind, num, args = ev
+            if kind != EV_SYSCALL:
+                self._protocol_error(host, f"unexpected event kind {kind}")
+                return
+            if not self._service(host, num, args, restarted=False):
+                return
+
+    def _service(self, host, num: int, args, restarted: bool) -> bool:
+        """Dispatch one syscall; returns True to keep pumping events."""
+        handler = host.syscall_handler_native
+        host.counters["syscalls"] += 1
+        process = self.process
+        result = handler.dispatch(host, process, self, num, args, restarted)
+        if process.strace_mode is not None:
+            from shadow_tpu.host import strace
+            process.strace += strace.format_native_call(
+                host.now(), self.tid, num, args, result,
+                process.strace_mode).encode()
+        kind = result[0]
+
+        if kind == "block":
+            condition = result[1]
+            self._pending_call = (num, tuple(args))
+            self.last_condition = condition
+            self.state = ST_BLOCKED
+            condition.arm(host, self._wakeup)
+            return False
+
+        if kind == "exit":
+            # Short-circuit (managed_thread.rs:268-282): let the native
+            # exit_group run, then reap synchronously.
+            self.ipc.send_to_shim(EV_SYSCALL_DO_NATIVE)
+            deadline = _walltime.monotonic() + 10.0
+            while _walltime.monotonic() < deadline:
+                if self._poll_death(host):
+                    return False
+                _walltime.sleep(0.001)
+            self._protocol_error(host, "child did not exit after exit_group")
+            return False
+
+        if kind == "native":
+            rv_kind, rv_val = EV_SYSCALL_DO_NATIVE, 0
+        elif kind == "done":
+            rv_kind, rv_val = EV_SYSCALL_COMPLETE, int(result[1] or 0)
+        elif kind == "error":
+            err = result[1]
+            rv_kind, rv_val = EV_SYSCALL_COMPLETE, -int(err.errno or 22)
+        else:  # pragma: no cover
+            raise AssertionError(f"bad dispatch result {result!r}")
+
+        self.add_cpu_latency(SYSCALL_LATENCY_NS)
+        if self._unapplied_ns >= MAX_UNAPPLIED_NS:
+            # Apply accumulated CPU time: answer only after the event
+            # queue reaches now + latency (possibly next round).
+            self._pending_response = (rv_kind, rv_val)
+            apply_at = host.now() + self._unapplied_ns
+            self._unapplied_ns = 0
+            host.schedule_task_at(apply_at,
+                                  TaskRef("cpu-latency", self.resume))
+            return False
+
+        self.ipc.send_to_shim(rv_kind, rv_val)
+        return True
+
+    def _wakeup(self, host) -> None:
+        if self.state == ST_BLOCKED:
+            self.resume(host)
+
+    def _protocol_error(self, host, why: str) -> None:
+        self.process.stderr += (
+            f"[shadow-tpu] managed IPC protocol error: {why}\n").encode()
+        try:
+            os.kill(self.process.native_pid, signal.SIGKILL)
+        except (ProcessLookupError, TypeError):
+            pass
+        self._poll_death(host, blocking=True)
+
+    def _finish(self, host, code: int) -> None:
+        if self.state == ST_EXITED:
+            return
+        self.state = ST_EXITED
+        if self.last_condition is not None:
+            self.last_condition.disarm()
+            self.last_condition = None
+        self.teardown()
+        process = self.process
+        if process.mem is not None:
+            process.mem.close()
+        process.collect_output()
+        process.thread_exited(host, self, code)
+
+    def teardown(self) -> None:
+        self.ipc.mark_closed()
+        self.ipc.close()
+
+    # Process.thread_exited checks thread.state via the same constants;
+    # the generator-thread interface ends here.
+    def _exit(self, host, code: int) -> None:
+        """Forced exit (manager shutdown path), mirror of Thread._exit."""
+        if self.state == ST_EXITED:
+            return
+        try:
+            os.kill(self.process.native_pid, signal.SIGKILL)
+        except (ProcessLookupError, TypeError):
+            pass
+        self._poll_death(host, blocking=True)

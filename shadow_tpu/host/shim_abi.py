@@ -1,0 +1,169 @@
+"""Manager-side view of the shim IPC block (mirror of native/shim_ipc.h).
+
+The manager maps the same 4 KiB file the shim maps and speaks the futex
+SPSC protocol directly from Python via `ctypes` — x86-64's total store
+order plus CPython's sequential execution give the release/acquire
+semantics the two-word protocol needs, and the per-message futex
+syscalls dominate the cost anyway.  (Ref: the simulator side of
+src/lib/shadow-shim-helper-rs/src/ipc.rs.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import mmap
+import os
+import struct
+
+# --- constants mirrored from native/shim_ipc.h ---------------------
+MAGIC = 0x53545055
+VERSION = 1
+FILE_SIZE = 4096
+
+SLOT_EMPTY = 0
+SLOT_READY = 1
+SLOT_CLOSED = 2
+
+EV_NULL = 0
+EV_START_REQ = 1
+EV_SYSCALL = 2
+EV_START_RES = 16
+EV_SYSCALL_COMPLETE = 17
+EV_SYSCALL_DO_NATIVE = 18
+
+OFF_MAGIC = 0
+OFF_VERSION = 4
+OFF_SIM_TIME = 8
+OFF_AUXV = 16
+OFF_TO_SHADOW = 32
+OFF_TO_SHIM = 32 + 72
+SLOT_EV_OFF = 8
+EV_STRUCT = struct.Struct("<II7q")  # kind, pad, num, args[6]
+
+_SYS_futex = 202
+FUTEX_WAIT = 0
+FUTEX_WAKE = 1
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+class _Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+def _futex_wait(addr: int, expected: int, timeout_ns: int | None) -> int:
+    """Returns 0 on wake/value-change, -1 with errno on timeout/EINTR."""
+    if timeout_ns is None:
+        ts = None
+    else:
+        ts = ctypes.byref(_Timespec(timeout_ns // 1_000_000_000,
+                                    timeout_ns % 1_000_000_000))
+    r = _libc.syscall(_SYS_futex, ctypes.c_void_p(addr), FUTEX_WAIT,
+                      expected, ts, None, 0)
+    return r
+
+
+def _futex_wake(addr: int) -> None:
+    _libc.syscall(_SYS_futex, ctypes.c_void_p(addr), FUTEX_WAKE, 1,
+                  None, None, 0)
+
+
+class ChannelClosed(Exception):
+    """The peer marked the slot CLOSED (process died / torn down)."""
+
+
+class ChannelTimeout(Exception):
+    """recv timed out (used to poll for child death)."""
+
+
+class IpcBlock:
+    """One managed thread's IPC block, backed by a /dev/shm file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, FILE_SIZE)
+            self._mm = mmap.mmap(fd, FILE_SIZE)
+        finally:
+            os.close(fd)
+        self._addr = ctypes.addressof(
+            ctypes.c_char.from_buffer(self._mm))
+        struct.pack_into("<II", self._mm, 0, MAGIC, VERSION)
+        self.closed = False
+
+    # -- raw words --------------------------------------------------
+
+    def _load_u32(self, off: int) -> int:
+        return struct.unpack_from("<I", self._mm, off)[0]
+
+    def _store_u32(self, off: int, value: int) -> None:
+        struct.pack_into("<I", self._mm, off, value)
+
+    def set_sim_time(self, sim_ns: int) -> None:
+        struct.pack_into("<Q", self._mm, OFF_SIM_TIME, sim_ns)
+
+    def set_auxv_random(self, lo: int, hi: int) -> None:
+        struct.pack_into("<QQ", self._mm, OFF_AUXV, lo, hi)
+
+    # -- channel ops ------------------------------------------------
+
+    def send_to_shim(self, kind: int, num: int = 0,
+                     args: tuple = (0, 0, 0, 0, 0, 0)) -> None:
+        off = OFF_TO_SHIM
+        # Slot must be EMPTY per the alternating protocol.
+        EV_STRUCT.pack_into(self._mm, off + SLOT_EV_OFF, kind, 0, num,
+                            *args)
+        self._store_u32(off, SLOT_READY)
+        _futex_wake(self._addr + off)
+
+    def recv_from_shim(self, timeout_ns: int | None = None):
+        """Block until the shim publishes an event; returns (kind, num,
+        args).  Raises ChannelTimeout after `timeout_ns` so the caller
+        can check for child death, ChannelClosed on CLOSED."""
+        off = OFF_TO_SHADOW
+        while True:
+            st = self._load_u32(off)
+            if st == SLOT_READY:
+                kind, _pad, num, *args = EV_STRUCT.unpack_from(
+                    self._mm, off + SLOT_EV_OFF)
+                self._store_u32(off, SLOT_EMPTY)
+                _futex_wake(self._addr + off)
+                return kind, num, args
+            if st == SLOT_CLOSED:
+                raise ChannelClosed
+            r = _futex_wait(self._addr + off, st, timeout_ns)
+            if r != 0:
+                err = ctypes.get_errno()
+                import errno as _e
+                if err == _e.ETIMEDOUT and timeout_ns is not None:
+                    # Re-check once: the word may have flipped between
+                    # the timeout and now.
+                    if self._load_u32(off) not in (SLOT_READY,
+                                                   SLOT_CLOSED):
+                        raise ChannelTimeout
+                # EAGAIN (value changed) / EINTR: loop and re-check.
+
+    def mark_closed(self) -> None:
+        """Tear down: wake the shim with CLOSED on both slots."""
+        for off in (OFF_TO_SHADOW, OFF_TO_SHIM):
+            self._store_u32(off, SLOT_CLOSED)
+            _futex_wake(self._addr + off)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        # Release the ctypes view before closing the mmap.
+        self._addr = None
+        import gc
+        gc.collect()
+        try:
+            self._mm.close()
+        except BufferError:
+            pass  # a ctypes view still alive somewhere; the OS cleans up
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

@@ -27,6 +27,35 @@ def _fmt_value(v, deterministic: bool):
     return repr(v)
 
 
+def format_native_call(sim_now: int, tid: int, num: int, args, result,
+                       mode: str) -> str:
+    """Native-ABI variant: raw syscall number + 6 register args.
+    Deterministic mode elides the register values (they are pointers
+    into a run-varying address space — same policy as the reference's
+    pointer elision, formatter.rs)."""
+    from shadow_tpu.host.syscalls_native import syscall_name
+    deterministic = mode == MODE_DETERMINISTIC
+    name = syscall_name(num)
+    if deterministic:
+        rendered_args = "..."
+    else:
+        rendered_args = ", ".join(hex(a & (2**64 - 1)) for a in args)
+    kind = result[0]
+    if kind == "done":
+        rendered = str(result[1])
+    elif kind == "error":
+        e = result[1]
+        rendered = f"-1 [errno {e.errno}]"
+    elif kind == "block":
+        rendered = "<blocked>"
+    elif kind == "native":
+        rendered = "<native>"
+    else:
+        rendered = f"<{kind}>"
+    sec, ns = divmod(sim_now, 10**9)
+    return f"{sec:05d}.{ns:09d} [tid {tid}] {name}({rendered_args}) = {rendered}\n"
+
+
 def format_call(sim_now: int, tid: int, call: tuple, result,
                 mode: str) -> str:
     deterministic = mode == MODE_DETERMINISTIC

@@ -1,0 +1,1123 @@
+"""Linux x86-64 ABI syscall dispatch for managed (real-binary) processes.
+
+The manager-side half of the reference's ~170-entry dispatch table
+(src/main/host/syscall/handler/mod.rs:335-642 + the per-family handlers
+in handler/*.rs), re-targeted at our simulated kernel objects.  Calls
+arrive as raw (number, 6 registers); results use the same triad the
+internal-app handler uses, plus "native":
+
+  ("done", rv) | ("error", OSError) | ("block", condition)
+  | ("native",)  — execute in the child through the trampoline
+  | ("exit", code)
+
+Fd-space policy (differs from the reference, which virtualizes every
+fd): descriptors created by the simulated kernel live at EMU_FD_BASE
+and above; anything below routes to the native kernel via DO_NATIVE.
+File I/O therefore stays native (real fs inside the child), while
+sockets, pipes, eventfds, timerfds, epoll, time and randomness are
+simulated.  The base is set low enough that select(2)'s fd_set covers
+emulated fds, high enough that native fds (lowest-free allocation)
+rarely collide; a collision aborts the process rather than
+misbehaving silently.
+"""
+
+from __future__ import annotations
+
+import errno
+import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.host.condition import MultiSyscallCondition, SyscallCondition
+from shadow_tpu.host.epoll import (EPOLL_CTL_ADD, EPOLL_CTL_DEL,
+                                   EPOLL_CTL_MOD, EpollFile)
+from shadow_tpu.host.files import EventFd, PipeEnd, TimerFd, make_pipe
+from shadow_tpu.host.socket_udp import UdpSocket
+from shadow_tpu.host.status import (S_CLOSED, S_ERROR, S_READABLE,
+                                    S_WRITABLE)
+
+EMU_FD_BASE = 400  # leaves room for select() fd_sets (FD_SETSIZE=1024)
+
+# --- x86-64 syscall numbers (linux-api equivalents we dispatch on) ---
+SYS = {
+    0: "read", 1: "write", 3: "close", 7: "poll", 13: "rt_sigaction",
+    16: "ioctl", 19: "readv", 20: "writev", 22: "pipe", 23: "select",
+    24: "sched_yield", 32: "dup", 33: "dup2", 34: "pause", 35: "nanosleep",
+    37: "alarm", 39: "getpid", 41: "socket", 42: "connect", 43: "accept",
+    44: "sendto", 45: "recvfrom", 46: "sendmsg", 47: "recvmsg",
+    48: "shutdown", 49: "bind", 50: "listen", 51: "getsockname",
+    52: "getpeername", 53: "socketpair", 54: "setsockopt",
+    55: "getsockopt", 56: "clone", 57: "fork", 58: "vfork", 59: "execve",
+    60: "exit", 61: "wait4", 62: "kill", 63: "uname", 72: "fcntl",
+    96: "gettimeofday", 99: "sysinfo", 100: "times", 102: "getuid",
+    104: "getgid", 107: "geteuid", 108: "getegid", 110: "getppid",
+    124: "getsid", 157: "prctl", 186: "gettid", 201: "time", 202: "futex",
+    213: "epoll_create", 218: "set_tid_address", 228: "clock_gettime",
+    229: "clock_getres", 230: "clock_nanosleep", 231: "exit_group",
+    232: "epoll_wait", 233: "epoll_ctl", 247: "waitid", 257: "openat",
+    270: "pselect6", 271: "ppoll", 281: "epoll_pwait", 283: "timerfd_create",
+    284: "eventfd", 286: "timerfd_settime", 287: "timerfd_gettime",
+    288: "accept4", 290: "eventfd2", 291: "epoll_create1", 292: "dup3",
+    293: "pipe2", 302: "prlimit64", 317: "seccomp", 318: "getrandom",
+    332: "statx", 435: "clone3",
+}
+_NUM = {name: num for num, name in SYS.items()}
+
+
+def syscall_name(num: int) -> str:
+    return SYS.get(num, f"syscall_{num}")
+
+
+# --- constants -------------------------------------------------------
+AF_INET = 2
+SOCK_STREAM = 1
+SOCK_DGRAM = 2
+SOCK_NONBLOCK = 0o4000
+SOCK_CLOEXEC = 0o2000000
+
+MSG_DONTWAIT = 0x40
+MSG_PEEK = 0x02
+
+POLLIN = 0x001
+POLLPRI = 0x002
+POLLOUT = 0x004
+POLLERR = 0x008
+POLLHUP = 0x010
+POLLNVAL = 0x020
+
+O_NONBLOCK = 0o4000
+
+F_GETFD = 1
+F_SETFD = 2
+F_GETFL = 3
+F_SETFL = 4
+F_DUPFD = 0
+F_DUPFD_CLOEXEC = 1030
+
+FIONREAD = 0x541B
+FIONBIO = 0x5421
+
+SOL_SOCKET = 1
+SO_REUSEADDR = 2
+SO_ERROR = 4
+SO_SNDBUF = 7
+SO_RCVBUF = 8
+SO_ACCEPTCONN = 30
+SO_DOMAIN = 39
+SO_TYPE = 3
+
+TIMER_ABSTIME = 1
+CLOCK_REALTIME = 0
+
+SIGSYS = 31
+
+EFD_SEMAPHORE = 1
+EFD_NONBLOCK = O_NONBLOCK
+TFD_NONBLOCK = O_NONBLOCK
+
+_MAX_IO = 1 << 20  # clamp reads/writes we marshal through the manager
+
+_TIMESPEC = struct.Struct("<qq")
+_TIMEVAL = struct.Struct("<qq")
+_POLLFD = struct.Struct("<ihh")
+_EPOLL_EVENT = struct.Struct("<IQ")  # packed on x86-64
+_IOVEC = struct.Struct("<QQ")
+
+
+def _done(value=0):
+    return ("done", int(value))
+
+
+def _error(code):
+    return ("error", OSError(code, ""))
+
+
+def _native():
+    return ("native",)
+
+
+def _block(condition):
+    return ("block", condition)
+
+
+def _pack_sockaddr_in(ip: int, port: int) -> bytes:
+    return struct.pack("<H", AF_INET) + struct.pack(">H", port) + \
+        int(ip).to_bytes(4, "big") + b"\0" * 8
+
+
+def _unpack_sockaddr_in(raw: bytes):
+    if len(raw) < 8:
+        raise OSError(errno.EINVAL, "short sockaddr")
+    family = struct.unpack_from("<H", raw, 0)[0]
+    if family != AF_INET:
+        raise OSError(errno.EAFNOSUPPORT, f"family {family}")
+    port = struct.unpack_from(">H", raw, 2)[0]
+    ip = int.from_bytes(raw[4:8], "big")
+    return ip, port
+
+
+class NativeSyscallHandler:
+    """One per manager (like the internal-app SyscallHandler)."""
+
+    def __init__(self, send_buf: int = 131_072, recv_buf: int = 174_760):
+        self.send_buf = send_buf
+        self.recv_buf = recv_buf
+
+    # ------------------------------------------------------------------
+
+    def dispatch(self, host, process, thread, num: int, args,
+                 restarted: bool):
+        name = SYS.get(num)
+        if name is None:
+            return _native()
+        method = getattr(self, "sys_" + name, None)
+        if method is None:
+            return _native()
+        try:
+            return method(host, process, thread, restarted, *args)
+        except OSError as e:
+            return _error(e.errno if e.errno else errno.EINVAL)
+
+    # -- fd helpers ----------------------------------------------------
+
+    @staticmethod
+    def _is_emu(fd: int) -> bool:
+        return fd >= EMU_FD_BASE
+
+    @staticmethod
+    def _emu(process, fd: int):
+        return process.fds.get(fd - EMU_FD_BASE)
+
+    @staticmethod
+    def _register(process, obj) -> int:
+        return process.fds.register(obj) + EMU_FD_BASE
+
+    # ------------------------------------------------------------------
+    # Sockets
+    # ------------------------------------------------------------------
+
+    def sys_socket(self, host, process, thread, restarted, domain, type_,
+                   protocol, *_):
+        domain &= 0xffffffff
+        base_type = type_ & 0xff
+        if domain != AF_INET or base_type not in (SOCK_STREAM, SOCK_DGRAM):
+            # Unix/netlink/etc. stay native: they never cross the
+            # simulated network.  (The reference emulates these too —
+            # socket/{unix,netlink}.rs — future work.)
+            return _native()
+        if base_type == SOCK_DGRAM:
+            sock = UdpSocket(host, self.send_buf, self.recv_buf)
+        else:
+            from shadow_tpu.host.socket_tcp import TcpSocket
+            sock = TcpSocket(host, self.send_buf, self.recv_buf)
+        sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
+        return _done(self._register(process, sock))
+
+    def sys_bind(self, host, process, thread, restarted, fd, addr_ptr,
+                 addrlen, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        raw = process.mem.read(addr_ptr, min(addrlen, 128))
+        ip, port = _unpack_sockaddr_in(raw)
+        sock.bind(host, ip, port)
+        return _done(0)
+
+    def sys_connect(self, host, process, thread, restarted, fd, addr_ptr,
+                    addrlen, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        raw = process.mem.read(addr_ptr, min(addrlen, 128))
+        ip, port = _unpack_sockaddr_in(raw)
+        # connect() is restart-safe: re-entry with the same args returns
+        # 0 once established / raises the handshake error.
+        result = sock.connect(host, ip, port)
+        if isinstance(result, SyscallCondition):
+            return _block(result)
+        return _done(0)
+
+    def sys_listen(self, host, process, thread, restarted, fd, backlog, *_):
+        if not self._is_emu(fd):
+            return _native()
+        self._emu(process, fd).listen(host, backlog or 128)
+        return _done(0)
+
+    def _accept_common(self, host, process, fd, addr_ptr, len_ptr, flags):
+        sock = self._emu(process, fd)
+        try:
+            child = sock.accept(host)
+        except BlockingIOError:
+            if sock.nonblocking:
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=sock, mask=S_READABLE))
+        child.nonblocking = bool(flags & SOCK_NONBLOCK)
+        newfd = self._register(process, child)
+        if addr_ptr and child.peer is not None:
+            sa = _pack_sockaddr_in(*child.peer)
+            if len_ptr:
+                want = struct.unpack(
+                    "<I", process.mem.read(len_ptr, 4))[0]
+                process.mem.write(addr_ptr, sa[:want])
+                process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+            else:
+                process.mem.write(addr_ptr, sa)
+        return _done(newfd)
+
+    def sys_accept(self, host, process, thread, restarted, fd, addr_ptr,
+                   len_ptr, *_):
+        if not self._is_emu(fd):
+            return _native()
+        return self._accept_common(host, process, fd, addr_ptr, len_ptr, 0)
+
+    def sys_accept4(self, host, process, thread, restarted, fd, addr_ptr,
+                    len_ptr, flags, *_):
+        if not self._is_emu(fd):
+            return _native()
+        return self._accept_common(host, process, fd, addr_ptr, len_ptr,
+                                   flags)
+
+    def _sock_send(self, host, process, sock, data: bytes, dst, flags: int):
+        try:
+            n = sock.sendto(host, data, dst)
+        except BlockingIOError:
+            if sock.nonblocking or (flags & MSG_DONTWAIT):
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=sock, mask=S_WRITABLE))
+        return _done(n)
+
+    def sys_sendto(self, host, process, thread, restarted, fd, buf_ptr,
+                   length, flags, addr_ptr, addrlen):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        data = process.mem.read(buf_ptr, min(length, _MAX_IO))
+        dst = None
+        if addr_ptr and addrlen:
+            dst = _unpack_sockaddr_in(
+                process.mem.read(addr_ptr, min(addrlen, 128)))
+        return self._sock_send(host, process, sock, data, dst, flags)
+
+    def sys_recvfrom(self, host, process, thread, restarted, fd, buf_ptr,
+                     length, flags, addr_ptr, len_ptr):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        try:
+            data, peer = self._sock_recv(host, sock, min(length, _MAX_IO))
+        except BlockingIOError:
+            if sock.nonblocking or (flags & MSG_DONTWAIT):
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=sock, mask=S_READABLE))
+        process.mem.write(buf_ptr, data)
+        if addr_ptr and peer is not None:
+            sa = _pack_sockaddr_in(*peer)
+            process.mem.write(addr_ptr, sa)
+            if len_ptr:
+                process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+        return _done(len(data))
+
+    @staticmethod
+    def _sock_recv(host, sock, bufsize: int):
+        """Uniform recv across UDP (datagram+peer) and TCP (stream)."""
+        result = sock.recvfrom(host, bufsize)
+        if isinstance(result, tuple):
+            return result
+        return result, getattr(sock, "peer", None)
+
+    def sys_sendmsg(self, host, process, thread, restarted, fd, msg_ptr,
+                    flags, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        name_ptr, namelen, iov_ptr, iovlen = self._read_msghdr(process,
+                                                               msg_ptr)
+        data = self._gather_iov(process, iov_ptr, iovlen)
+        dst = None
+        if name_ptr and namelen:
+            dst = _unpack_sockaddr_in(
+                process.mem.read(name_ptr, min(namelen, 128)))
+        return self._sock_send(host, process, sock, data, dst, flags)
+
+    def sys_recvmsg(self, host, process, thread, restarted, fd, msg_ptr,
+                    flags, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        name_ptr, _namelen, iov_ptr, iovlen = self._read_msghdr(process,
+                                                                msg_ptr)
+        total = sum(l for _p, l in self._iovecs(process, iov_ptr, iovlen))
+        try:
+            data, peer = self._sock_recv(host, sock, min(total, _MAX_IO))
+        except BlockingIOError:
+            if sock.nonblocking or (flags & MSG_DONTWAIT):
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=sock, mask=S_READABLE))
+        self._scatter_iov(process, iov_ptr, iovlen, data)
+        if name_ptr and peer is not None:
+            sa = _pack_sockaddr_in(*peer)
+            process.mem.write(name_ptr, sa)
+            process.mem.write(msg_ptr + 8, struct.pack("<I", len(sa)))
+        return _done(len(data))
+
+    @staticmethod
+    def _read_msghdr(process, msg_ptr):
+        raw = process.mem.read(msg_ptr, 56)
+        name_ptr, namelen = struct.unpack_from("<QI", raw, 0)
+        iov_ptr, iovlen = struct.unpack_from("<QQ", raw, 16)
+        return name_ptr, namelen, iov_ptr, iovlen
+
+    @staticmethod
+    def _iovecs(process, iov_ptr, iovlen):
+        iovlen = min(iovlen, 64)
+        raw = process.mem.read(iov_ptr, 16 * iovlen) if iovlen else b""
+        return [_IOVEC.unpack_from(raw, i * 16) for i in range(iovlen)]
+
+    def _gather_iov(self, process, iov_ptr, iovlen) -> bytes:
+        out = bytearray()
+        for base, length in self._iovecs(process, iov_ptr, iovlen):
+            if len(out) >= _MAX_IO:
+                break
+            out += process.mem.read(base, min(length, _MAX_IO - len(out)))
+        return bytes(out)
+
+    def _scatter_iov(self, process, iov_ptr, iovlen, data: bytes) -> int:
+        off = 0
+        for base, length in self._iovecs(process, iov_ptr, iovlen):
+            if off >= len(data):
+                break
+            chunk = data[off:off + length]
+            process.mem.write(base, chunk)
+            off += len(chunk)
+        return off
+
+    def sys_getsockname(self, host, process, thread, restarted, fd,
+                        addr_ptr, len_ptr, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        local = sock.local or (0, 0)
+        ip = local[0]
+        if ip == 0 and getattr(sock, "peer", None):
+            ip = host.eth0.ip
+        sa = _pack_sockaddr_in(ip, local[1])
+        process.mem.write(addr_ptr, sa)
+        if len_ptr:
+            process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+        return _done(0)
+
+    def sys_getpeername(self, host, process, thread, restarted, fd,
+                        addr_ptr, len_ptr, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        if sock.peer is None:
+            return _error(errno.ENOTCONN)
+        sa = _pack_sockaddr_in(*sock.peer)
+        process.mem.write(addr_ptr, sa)
+        if len_ptr:
+            process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+        return _done(0)
+
+    def sys_setsockopt(self, host, process, thread, restarted, fd, level,
+                       optname, optval, optlen):
+        if not self._is_emu(fd):
+            return _native()
+        # Recorded-but-inert options (REUSEADDR, NODELAY, buffer sizing
+        # hints...) — enough surface for common clients/servers.
+        return _done(0)
+
+    def sys_getsockopt(self, host, process, thread, restarted, fd, level,
+                       optname, optval_ptr, optlen_ptr):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        value = 0
+        if level == SOL_SOCKET:
+            if optname == SO_ERROR:
+                value = getattr(sock, "so_error", 0) or 0
+                sock.so_error = 0
+            elif optname == SO_SNDBUF:
+                value = self.send_buf
+            elif optname == SO_RCVBUF:
+                value = self.recv_buf
+            elif optname == SO_TYPE:
+                from shadow_tpu.net.packet import PROTO_TCP
+                value = (SOCK_STREAM if sock.protocol == PROTO_TCP
+                         else SOCK_DGRAM)
+            elif optname == SO_DOMAIN:
+                value = AF_INET
+            elif optname == SO_ACCEPTCONN:
+                value = 1 if getattr(sock, "listening", False) else 0
+        process.mem.write(optval_ptr, struct.pack("<i", value))
+        if optlen_ptr:
+            process.mem.write(optlen_ptr, struct.pack("<I", 4))
+        return _done(0)
+
+    def sys_shutdown(self, host, process, thread, restarted, fd, how, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        how_s = {0: "rd", 1: "wr", 2: "rdwr"}.get(how)
+        if how_s is None:
+            return _error(errno.EINVAL)
+        if hasattr(sock, "shutdown"):
+            sock.shutdown(host, how_s)
+        return _done(0)
+
+    def sys_socketpair(self, host, process, thread, restarted, domain,
+                       type_, protocol, sv_ptr, *_):
+        return _native()  # AF_UNIX pairs stay native
+
+    # ------------------------------------------------------------------
+    # Generic fd I/O
+    # ------------------------------------------------------------------
+
+    def _file_read(self, host, process, file, n: int):
+        if isinstance(file, PipeEnd):
+            return file.read_bytes(host, n)
+        if isinstance(file, EventFd):
+            if n < 8:
+                raise OSError(errno.EINVAL, "eventfd read < 8 bytes")
+            return struct.pack("<Q", file.read_value(host))
+        if isinstance(file, TimerFd):
+            if n < 8:
+                raise OSError(errno.EINVAL, "timerfd read < 8 bytes")
+            return struct.pack("<Q", file.read_expirations(host))
+        data, _peer = self._sock_recv(host, file, n)
+        return data
+
+    def _file_write(self, host, process, file, data: bytes) -> int:
+        if isinstance(file, PipeEnd):
+            return file.write_bytes(host, data)
+        if isinstance(file, EventFd):
+            if len(data) < 8:
+                raise OSError(errno.EINVAL, "eventfd write < 8 bytes")
+            file.write_value(host, struct.unpack("<Q", data[:8])[0])
+            return 8
+        return file.sendto(host, data, None)
+
+    def sys_read(self, host, process, thread, restarted, fd, buf_ptr,
+                 count, *_):
+        if not self._is_emu(fd):
+            return _native()
+        file = self._emu(process, fd)
+        try:
+            data = self._file_read(host, process, file, min(count, _MAX_IO))
+        except BlockingIOError:
+            if getattr(file, "nonblocking", False):
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=file, mask=S_READABLE))
+        process.mem.write(buf_ptr, data)
+        return _done(len(data))
+
+    def sys_write(self, host, process, thread, restarted, fd, buf_ptr,
+                  count, *_):
+        if not self._is_emu(fd):
+            return _native()
+        file = self._emu(process, fd)
+        data = process.mem.read(buf_ptr, min(count, _MAX_IO))
+        try:
+            return _done(self._file_write(host, process, file, data))
+        except BlockingIOError:
+            if getattr(file, "nonblocking", False):
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=file, mask=S_WRITABLE))
+
+    def sys_readv(self, host, process, thread, restarted, fd, iov_ptr,
+                  iovlen, *_):
+        if not self._is_emu(fd):
+            return _native()
+        file = self._emu(process, fd)
+        total = sum(l for _b, l in self._iovecs(process, iov_ptr, iovlen))
+        try:
+            data = self._file_read(host, process, file, min(total, _MAX_IO))
+        except BlockingIOError:
+            if getattr(file, "nonblocking", False):
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=file, mask=S_READABLE))
+        return _done(self._scatter_iov(process, iov_ptr, iovlen, data))
+
+    def sys_writev(self, host, process, thread, restarted, fd, iov_ptr,
+                   iovlen, *_):
+        if not self._is_emu(fd):
+            return _native()
+        file = self._emu(process, fd)
+        data = self._gather_iov(process, iov_ptr, iovlen)
+        try:
+            return _done(self._file_write(host, process, file, data))
+        except BlockingIOError:
+            if getattr(file, "nonblocking", False):
+                return _error(errno.EWOULDBLOCK)
+            return _block(SyscallCondition(file=file, mask=S_WRITABLE))
+
+    def sys_close(self, host, process, thread, restarted, fd, *_):
+        if not self._is_emu(fd):
+            return _native()
+        f = process.fds.deregister(fd - EMU_FD_BASE)
+        if hasattr(f, "close"):
+            f.close(host)
+        return _done(0)
+
+    def sys_dup(self, host, process, thread, restarted, fd, *_):
+        if not self._is_emu(fd):
+            return _native()
+        return _done(self._register(process, self._emu(process, fd)))
+
+    def sys_dup2(self, host, process, thread, restarted, oldfd, newfd, *_):
+        if not self._is_emu(oldfd):
+            return _native()
+        if not self._is_emu(newfd):
+            return _error(errno.EINVAL)  # cross-space dup unsupported
+        obj = self._emu(process, oldfd)
+        try:
+            old = process.fds.deregister(newfd - EMU_FD_BASE)
+            if hasattr(old, "close"):
+                old.close(host)
+        except OSError:
+            pass
+        process.fds.register_at(newfd - EMU_FD_BASE, obj)
+        return _done(newfd)
+
+    def sys_dup3(self, host, process, thread, restarted, oldfd, newfd,
+                 flags, *_):
+        return self.sys_dup2(host, process, thread, restarted, oldfd, newfd)
+
+    def sys_fcntl(self, host, process, thread, restarted, fd, cmd, arg, *_):
+        if not self._is_emu(fd):
+            return _native()
+        file = self._emu(process, fd)
+        if cmd == F_GETFL:
+            return _done(O_NONBLOCK if getattr(file, "nonblocking", False)
+                         else 0)
+        if cmd == F_SETFL:
+            file.nonblocking = bool(arg & O_NONBLOCK)
+            return _done(0)
+        if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
+            return _done(self._register(process, file))
+        if cmd in (F_GETFD, F_SETFD):
+            return _done(0)
+        return _error(errno.EINVAL)
+
+    def sys_ioctl(self, host, process, thread, restarted, fd, req, argp, *_):
+        if not self._is_emu(fd):
+            return _native()
+        file = self._emu(process, fd)
+        if req == FIONBIO:
+            val = struct.unpack("<i", process.mem.read(argp, 4))[0]
+            file.nonblocking = bool(val)
+            return _done(0)
+        if req == FIONREAD:
+            avail = 0
+            if isinstance(file, PipeEnd):
+                avail = file.bytes_available()
+            elif hasattr(file, "bytes_available"):
+                avail = file.bytes_available()
+            elif hasattr(file, "_recv_q"):
+                avail = sum(len(p.payload) for p in file._recv_q)
+            process.mem.write(argp, struct.pack("<i", avail))
+            return _done(0)
+        return _error(errno.ENOTTY)
+
+    # ------------------------------------------------------------------
+    # pipes / eventfd / timerfd / epoll
+    # ------------------------------------------------------------------
+
+    def _pipe_common(self, host, process, fds_ptr, flags):
+        r, w = make_pipe()
+        r.nonblocking = w.nonblocking = bool(flags & O_NONBLOCK)
+        rfd = self._register(process, r)
+        wfd = self._register(process, w)
+        process.mem.write(fds_ptr, struct.pack("<ii", rfd, wfd))
+        return _done(0)
+
+    def sys_pipe(self, host, process, thread, restarted, fds_ptr, *_):
+        return self._pipe_common(host, process, fds_ptr, 0)
+
+    def sys_pipe2(self, host, process, thread, restarted, fds_ptr, flags,
+                  *_):
+        return self._pipe_common(host, process, fds_ptr, flags)
+
+    def _eventfd_common(self, host, process, initval, flags):
+        ef = EventFd(initval, semaphore=bool(flags & EFD_SEMAPHORE))
+        ef.nonblocking = bool(flags & EFD_NONBLOCK)
+        return _done(self._register(process, ef))
+
+    def sys_eventfd(self, host, process, thread, restarted, initval, *_):
+        return self._eventfd_common(host, process, initval, 0)
+
+    def sys_eventfd2(self, host, process, thread, restarted, initval,
+                     flags, *_):
+        return self._eventfd_common(host, process, initval, flags)
+
+    def sys_timerfd_create(self, host, process, thread, restarted, clockid,
+                           flags, *_):
+        tf = TimerFd()
+        tf.nonblocking = bool(flags & TFD_NONBLOCK)
+        return _done(self._register(process, tf))
+
+    def sys_timerfd_settime(self, host, process, thread, restarted, fd,
+                            flags, new_ptr, old_ptr, *_):
+        if not self._is_emu(fd):
+            return _native()
+        tf = self._emu(process, fd)
+        if not isinstance(tf, TimerFd):
+            return _error(errno.EINVAL)
+        raw = process.mem.read(new_ptr, 32)
+        int_s, int_ns, val_s, val_ns = struct.unpack("<qqqq", raw)
+        interval = int_s * 10**9 + int_ns
+        value = val_s * 10**9 + val_ns
+        absolute = bool(flags & TIMER_ABSTIME)
+        if absolute and value:
+            # timerfd absolute times are CLOCK_REALTIME/MONOTONIC-based;
+            # both map onto sim time (REALTIME shifted by the epoch).
+            emu = value - simtime.EMUTIME_SIMULATION_START
+            value = emu if emu >= 0 else value
+        if old_ptr:
+            self._write_itimerspec(process, old_ptr, tf, host)
+        tf.arm(host, value, interval, absolute=absolute)
+        return _done(0)
+
+    def sys_timerfd_gettime(self, host, process, thread, restarted, fd,
+                            cur_ptr, *_):
+        if not self._is_emu(fd):
+            return _native()
+        tf = self._emu(process, fd)
+        if not isinstance(tf, TimerFd):
+            return _error(errno.EINVAL)
+        self._write_itimerspec(process, cur_ptr, tf, host)
+        return _done(0)
+
+    @staticmethod
+    def _write_itimerspec(process, ptr, tf: TimerFd, host) -> None:
+        next_ns, interval = tf.disarm_remaining()
+        remaining = max(next_ns - host.now(), 0) if next_ns else 0
+        process.mem.write(ptr, struct.pack(
+            "<qqqq", interval // 10**9, interval % 10**9,
+            remaining // 10**9, remaining % 10**9))
+
+    def _epoll_create(self, host, process):
+        return _done(self._register(process, EpollFile()))
+
+    def sys_epoll_create(self, host, process, thread, restarted, size, *_):
+        return self._epoll_create(host, process)
+
+    def sys_epoll_create1(self, host, process, thread, restarted, flags,
+                          *_):
+        return self._epoll_create(host, process)
+
+    def sys_epoll_ctl(self, host, process, thread, restarted, epfd, op, fd,
+                      event_ptr, *_):
+        if not self._is_emu(epfd):
+            return _native()
+        ep = self._emu(process, epfd)
+        if not isinstance(ep, EpollFile):
+            return _error(errno.EINVAL)
+        if not self._is_emu(fd):
+            # Native fds can't feed a simulated epoll; the reference
+            # virtualizes all fds so this can't happen there.
+            return _error(errno.EPERM)
+        target = self._emu(process, fd)
+        interest, data = 0, 0
+        if event_ptr:
+            interest, data = _EPOLL_EVENT.unpack(
+                process.mem.read(event_ptr, 12))
+        ep.ctl(host, op, fd, target, interest, data)
+        return _done(0)
+
+    def _epoll_wait_common(self, host, process, thread, restarted, epfd,
+                           events_ptr, maxevents, timeout_ns):
+        if not self._is_emu(epfd):
+            return _native()
+        ep = self._emu(process, epfd)
+        if not isinstance(ep, EpollFile):
+            return _error(errno.EINVAL)
+        maxevents = max(1, min(maxevents, 1024))
+        ready = ep.collect_ready(host, maxevents)
+        if ready:
+            out = b"".join(_EPOLL_EVENT.pack(ev, data) for ev, data in ready)
+            process.mem.write(events_ptr, out)
+            return _done(len(ready))
+        if restarted and thread.last_condition is not None and \
+                thread.last_condition.timed_out:
+            return _done(0)
+        if timeout_ns == 0:
+            return _done(0)
+        timeout_at = None if timeout_ns is None or timeout_ns < 0 \
+            else host.now() + timeout_ns
+        return _block(MultiSyscallCondition([(ep, S_READABLE)],
+                                            timeout_at=timeout_at))
+
+    def sys_epoll_wait(self, host, process, thread, restarted, epfd,
+                       events_ptr, maxevents, timeout_ms, *_):
+        timeout_ns = None if _sext32(timeout_ms) < 0 \
+            else _sext32(timeout_ms) * 10**6
+        return self._epoll_wait_common(host, process, thread, restarted,
+                                       epfd, events_ptr, maxevents,
+                                       timeout_ns)
+
+    def sys_epoll_pwait(self, host, process, thread, restarted, epfd,
+                        events_ptr, maxevents, timeout_ms, sigmask, *_):
+        return self.sys_epoll_wait(host, process, thread, restarted, epfd,
+                                   events_ptr, maxevents, timeout_ms)
+
+    # ------------------------------------------------------------------
+    # poll / select
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _poll_events_from_status(status: int, want: int) -> int:
+        ev = 0
+        if status & S_READABLE:
+            ev |= POLLIN
+        if status & S_WRITABLE:
+            ev |= POLLOUT
+        if status & S_CLOSED:
+            ev |= POLLHUP | POLLIN
+        if status & S_ERROR:
+            ev |= POLLERR
+        return ev & (want | POLLERR | POLLHUP)
+
+    @staticmethod
+    def _status_mask_from_poll(want: int) -> int:
+        mask = S_CLOSED | S_ERROR
+        if want & (POLLIN | POLLPRI):
+            mask |= S_READABLE
+        if want & POLLOUT:
+            mask |= S_WRITABLE
+        return mask
+
+    def _poll_common(self, host, process, thread, restarted, fds_ptr, nfds,
+                     timeout_ns):
+        nfds = min(nfds, 4096)
+        raw = process.mem.read(fds_ptr, _POLLFD.size * nfds)
+        entries = [_POLLFD.unpack_from(raw, i * _POLLFD.size)
+                   for i in range(nfds)]
+        if not any(self._is_emu(fd) for fd, _e, _r in entries if fd >= 0):
+            return _native()
+        ready = 0
+        out = bytearray(raw)
+        watches = []
+        for i, (fd, events, _rev) in enumerate(entries):
+            revents = 0
+            if fd >= 0:
+                if self._is_emu(fd):
+                    try:
+                        file = self._emu(process, fd)
+                    except OSError:
+                        revents = POLLNVAL
+                    else:
+                        revents = self._poll_events_from_status(file.status,
+                                                                events)
+                        watches.append(
+                            (file, self._status_mask_from_poll(events)))
+                # Native fds in a mixed set: treated as never-ready (the
+                # hybrid fd-space limitation; see module docstring).
+            struct.pack_into("<h", out, i * _POLLFD.size + 6, revents)
+            if revents:
+                ready += 1
+        if ready or timeout_ns == 0:
+            process.mem.write(fds_ptr, bytes(out))
+            return _done(ready)
+        if restarted and thread.last_condition is not None and \
+                thread.last_condition.timed_out:
+            process.mem.write(fds_ptr, bytes(out))
+            return _done(0)
+        timeout_at = None if timeout_ns is None or timeout_ns < 0 \
+            else host.now() + timeout_ns
+        return _block(MultiSyscallCondition(watches, timeout_at=timeout_at))
+
+    def sys_poll(self, host, process, thread, restarted, fds_ptr, nfds,
+                 timeout_ms, *_):
+        t = _sext32(timeout_ms)
+        timeout_ns = None if t < 0 else t * 10**6
+        return self._poll_common(host, process, thread, restarted, fds_ptr,
+                                 nfds, timeout_ns)
+
+    def sys_ppoll(self, host, process, thread, restarted, fds_ptr, nfds,
+                  ts_ptr, sigmask, *_):
+        timeout_ns = None
+        if ts_ptr:
+            sec, nsec = _TIMESPEC.unpack(process.mem.read(ts_ptr, 16))
+            timeout_ns = sec * 10**9 + nsec
+        return self._poll_common(host, process, thread, restarted, fds_ptr,
+                                 nfds, timeout_ns)
+
+    def _select_common(self, host, process, thread, restarted, nfds,
+                       rfds_ptr, wfds_ptr, efds_ptr, timeout_ns):
+        nfds = min(nfds, 1024)
+        nbytes = (nfds + 7) // 8
+
+        def read_set(ptr):
+            if not ptr or nbytes == 0:
+                return set()
+            raw = process.mem.read(ptr, nbytes)
+            return {fd for fd in range(nfds)
+                    if raw[fd // 8] & (1 << (fd % 8))}
+
+        rset, wset, eset = (read_set(p) for p in
+                            (rfds_ptr, wfds_ptr, efds_ptr))
+        all_fds = rset | wset | eset
+        if not any(self._is_emu(fd) for fd in all_fds):
+            return _native()
+
+        r_ready, w_ready, e_ready = set(), set(), set()
+        watches = []
+        for fd in sorted(all_fds):
+            if not self._is_emu(fd):
+                continue  # hybrid limitation: native fds never ready
+            try:
+                file = self._emu(process, fd)
+            except OSError:
+                return _error(errno.EBADF)
+            st = file.status
+            if fd in rset:
+                if st & (S_READABLE | S_CLOSED):
+                    r_ready.add(fd)
+                watches.append((file, S_READABLE | S_CLOSED))
+            if fd in wset:
+                if st & (S_WRITABLE | S_CLOSED):
+                    w_ready.add(fd)
+                watches.append((file, S_WRITABLE | S_CLOSED))
+            if fd in eset and st & S_ERROR:
+                e_ready.add(fd)
+
+        total = len(r_ready) + len(w_ready) + len(e_ready)
+        timed_out = (restarted and thread.last_condition is not None
+                     and thread.last_condition.timed_out)
+        if total or timeout_ns == 0 or timed_out:
+            def write_set(ptr, ready):
+                if not ptr:
+                    return
+                buf = bytearray(nbytes)
+                for fd in ready:
+                    buf[fd // 8] |= 1 << (fd % 8)
+                process.mem.write(ptr, bytes(buf))
+            write_set(rfds_ptr, r_ready)
+            write_set(wfds_ptr, w_ready)
+            write_set(efds_ptr, e_ready)
+            return _done(total)
+        timeout_at = None if timeout_ns is None \
+            else host.now() + timeout_ns
+        return _block(MultiSyscallCondition(watches, timeout_at=timeout_at))
+
+    def sys_select(self, host, process, thread, restarted, nfds, rfds,
+                   wfds, efds, tv_ptr, *_):
+        timeout_ns = None
+        if tv_ptr:
+            sec, usec = _TIMEVAL.unpack(process.mem.read(tv_ptr, 16))
+            timeout_ns = sec * 10**9 + usec * 10**3
+        return self._select_common(host, process, thread, restarted, nfds,
+                                   rfds, wfds, efds, timeout_ns)
+
+    def sys_pselect6(self, host, process, thread, restarted, nfds, rfds,
+                     wfds, efds, ts_ptr, sigmask):
+        timeout_ns = None
+        if ts_ptr:
+            sec, nsec = _TIMESPEC.unpack(process.mem.read(ts_ptr, 16))
+            timeout_ns = sec * 10**9 + nsec
+        return self._select_common(host, process, thread, restarted, nfds,
+                                   rfds, wfds, efds, timeout_ns)
+
+    # ------------------------------------------------------------------
+    # Time
+    # ------------------------------------------------------------------
+
+    def sys_clock_gettime(self, host, process, thread, restarted, clockid,
+                          ts_ptr, *_):
+        now = host.now()
+        if clockid in (0, 5, 11):  # REALTIME, REALTIME_COARSE, TAI
+            now += simtime.EMUTIME_SIMULATION_START
+        if ts_ptr:
+            process.mem.write(ts_ptr, _TIMESPEC.pack(now // 10**9,
+                                                     now % 10**9))
+        return _done(0)
+
+    def sys_clock_getres(self, host, process, thread, restarted, clockid,
+                         ts_ptr, *_):
+        if ts_ptr:
+            process.mem.write(ts_ptr, _TIMESPEC.pack(0, 1))
+        return _done(0)
+
+    def sys_gettimeofday(self, host, process, thread, restarted, tv_ptr,
+                         tz_ptr, *_):
+        now = host.now() + simtime.EMUTIME_SIMULATION_START
+        if tv_ptr:
+            process.mem.write(tv_ptr, _TIMEVAL.pack(now // 10**9,
+                                                    (now % 10**9) // 1000))
+        if tz_ptr:
+            process.mem.write(tz_ptr, struct.pack("<ii", 0, 0))
+        return _done(0)
+
+    def sys_time(self, host, process, thread, restarted, tloc_ptr, *_):
+        secs = (host.now() + simtime.EMUTIME_SIMULATION_START) // 10**9
+        if tloc_ptr:
+            process.mem.write(tloc_ptr, struct.pack("<q", secs))
+        return _done(secs)
+
+    def sys_nanosleep(self, host, process, thread, restarted, req_ptr,
+                      rem_ptr, *_):
+        if restarted:
+            if rem_ptr:
+                process.mem.write(rem_ptr, _TIMESPEC.pack(0, 0))
+            return _done(0)
+        sec, nsec = _TIMESPEC.unpack(process.mem.read(req_ptr, 16))
+        duration = sec * 10**9 + nsec
+        if duration <= 0:
+            return _done(0)
+        return _block(SyscallCondition(timeout_at=host.now() + duration))
+
+    def sys_clock_nanosleep(self, host, process, thread, restarted, clockid,
+                            flags, req_ptr, rem_ptr, *_):
+        if restarted:
+            if rem_ptr and not (flags & TIMER_ABSTIME):
+                process.mem.write(rem_ptr, _TIMESPEC.pack(0, 0))
+            return _done(0)
+        sec, nsec = _TIMESPEC.unpack(process.mem.read(req_ptr, 16))
+        when = sec * 10**9 + nsec
+        if flags & TIMER_ABSTIME:
+            if clockid == CLOCK_REALTIME:
+                when -= simtime.EMUTIME_SIMULATION_START
+            target = when
+        else:
+            target = host.now() + when
+        if target <= host.now():
+            return _done(0)
+        return _block(SyscallCondition(timeout_at=target))
+
+    def sys_alarm(self, host, process, thread, restarted, seconds, *_):
+        # No emulated signal delivery yet; accepted and ignored (alarm
+        # is almost always paired with a handler we don't deliver).
+        return _done(0)
+
+    def sys_pause(self, host, process, thread, restarted, *_):
+        # Sleep until an (unsupported) signal: park forever — the
+        # process's shutdown_time or sim end tears it down.
+        return _block(SyscallCondition(timeout_at=simtime.TIME_NEVER - 1))
+
+    # ------------------------------------------------------------------
+    # Identity / misc
+    # ------------------------------------------------------------------
+
+    def sys_getpid(self, host, process, thread, restarted, *_):
+        return _done(process.pid)
+
+    def sys_gettid(self, host, process, thread, restarted, *_):
+        return _done(thread.tid)
+
+    def sys_getppid(self, host, process, thread, restarted, *_):
+        return _done(1)
+
+    def sys_getsid(self, host, process, thread, restarted, *_):
+        return _done(1)
+
+    def sys_getuid(self, host, process, thread, restarted, *_):
+        return _done(1000)
+
+    def sys_geteuid(self, host, process, thread, restarted, *_):
+        return _done(1000)
+
+    def sys_getgid(self, host, process, thread, restarted, *_):
+        return _done(1000)
+
+    def sys_getegid(self, host, process, thread, restarted, *_):
+        return _done(1000)
+
+    def sys_uname(self, host, process, thread, restarted, buf_ptr, *_):
+        def field(s: str) -> bytes:
+            b = s.encode()[:64]
+            return b + b"\0" * (65 - len(b))
+        data = (field("Linux") + field(host.name) +
+                field("5.15.0-shadowtpu") +
+                field("#1 SMP shadow-tpu simulated") + field("x86_64") +
+                field("(none)"))
+        process.mem.write(buf_ptr, data)
+        return _done(0)
+
+    def sys_sysinfo(self, host, process, thread, restarted, info_ptr, *_):
+        up = host.now() // 10**9
+        gib = 1 << 30
+        data = struct.pack("<q3Q", up, 0, 0, 0)          # uptime, loads
+        data += struct.pack("<6Q", 16 * gib, 8 * gib, 0, 0, 0, 0)
+        data += struct.pack("<HH", 1, 0)                  # procs, pad
+        data += struct.pack("<QQI", 0, 0, 1)              # high mem, unit
+        data += b"\0" * (112 - len(data))
+        process.mem.write(info_ptr, data[:112])
+        return _done(0)
+
+    def sys_times(self, host, process, thread, restarted, buf_ptr, *_):
+        ticks = host.now() // 10_000_000  # 100 Hz clock ticks
+        if buf_ptr:
+            process.mem.write(buf_ptr, struct.pack("<4q", ticks, 0, 0, 0))
+        return _done(ticks)
+
+    def sys_getrandom(self, host, process, thread, restarted, buf_ptr,
+                      count, flags, *_):
+        n = min(count, _MAX_IO)
+        process.mem.write(buf_ptr, host.rng.bytes(n))
+        return _done(n)
+
+    def sys_sched_yield(self, host, process, thread, restarted, *_):
+        # The shim forwards one of these per LOCAL_TIME_FORWARD_EVERY
+        # locally-answered time reads; bill the batch so time-polling
+        # loops advance the clock (handler/mod.rs:271-321).
+        thread.add_cpu_latency(25_000)
+        return _done(0)
+
+    # ------------------------------------------------------------------
+    # Guard rails
+    # ------------------------------------------------------------------
+
+    def sys_rt_sigaction(self, host, process, thread, restarted, signum,
+                         act_ptr, old_ptr, sigsetsize, *_):
+        if signum == SIGSYS and act_ptr:
+            # Protect the shim's SIGSYS handler; pretend success.
+            return _done(0)
+        return _native()
+
+    def sys_kill(self, host, process, thread, restarted, pid, sig, *_):
+        # Signals to self are the only meaningful target in-sim.
+        if pid in (process.pid, 0) and sig == 0:
+            return _done(0)
+        return _error(errno.EPERM)
+
+    def sys_prctl(self, host, process, thread, restarted, option, *rest):
+        PR_SET_SECCOMP = 22
+        if option == PR_SET_SECCOMP:
+            return _error(errno.EPERM)
+        return _native()
+
+    def sys_seccomp(self, host, process, thread, restarted, *_):
+        return _error(errno.EPERM)  # one filter is enough
+
+    def sys_clone(self, host, process, thread, restarted, *_):
+        return _error(errno.ENOSYS)  # managed threads: future round
+
+    def sys_clone3(self, host, process, thread, restarted, *_):
+        return _error(errno.ENOSYS)
+
+    def sys_fork(self, host, process, thread, restarted, *_):
+        return _error(errno.ENOSYS)
+
+    def sys_vfork(self, host, process, thread, restarted, *_):
+        return _error(errno.ENOSYS)
+
+    def sys_execve(self, host, process, thread, restarted, *_):
+        return _error(errno.ENOSYS)
+
+    def sys_wait4(self, host, process, thread, restarted, *_):
+        return _error(errno.ECHILD)
+
+    def sys_waitid(self, host, process, thread, restarted, *_):
+        return _error(errno.ECHILD)
+
+    def sys_exit(self, host, process, thread, restarted, code, *_):
+        return ("exit", code & 0xff)
+
+    def sys_exit_group(self, host, process, thread, restarted, code, *_):
+        return ("exit", code & 0xff)
+
+
+def _sext32(v: int) -> int:
+    """Register values arrive zero-extended; poll timeouts are i32."""
+    v &= 0xffffffff
+    return v - (1 << 32) if v & (1 << 31) else v

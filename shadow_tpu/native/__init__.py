@@ -1,0 +1,52 @@
+"""Native components: build-on-demand + path discovery.
+
+The shim (`libshadowtpu_shim.so`) is C compiled from `native/` at the
+repo root; it is LD_PRELOADed into managed processes and must NEVER be
+loaded into the simulator process (its constructor installs a seccomp
+filter).  The manager talks to it purely through the mmap'd IPC block
+(shadow_tpu/host/shim_abi.py), so no host-side native library is
+required.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+LIB_DIR = os.path.join(_PKG_DIR, "lib")
+
+SHIM_SO = os.path.join(LIB_DIR, "libshadowtpu_shim.so")
+
+
+def _stale(target: str, sources: list[str]) -> bool:
+    if not os.path.exists(target):
+        return True
+    t = os.path.getmtime(target)
+    return any(os.path.getmtime(s) > t for s in sources
+               if os.path.exists(s))
+
+
+def ensure_shim_built() -> str:
+    """Build the shim if missing or out of date; return its path.
+
+    Raises RuntimeError (with the compiler output) when the toolchain is
+    unavailable or the build fails, so callers can surface a clear error
+    instead of a confusing spawn failure.
+    """
+    sources = [os.path.join(_SRC_DIR, f)
+               for f in ("shim.c", "shim_trampoline.S", "shim_ipc.h",
+                         "Makefile")]
+    if not _stale(SHIM_SO, sources):
+        return SHIM_SO
+    if not os.path.isdir(_SRC_DIR):
+        raise RuntimeError(f"native sources not found at {_SRC_DIR}")
+    proc = subprocess.run(["make", "-C", _SRC_DIR, "all"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0 or not os.path.exists(SHIM_SO):
+        raise RuntimeError(
+            f"shim build failed (exit {proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return SHIM_SO

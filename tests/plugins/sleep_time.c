@@ -1,0 +1,23 @@
+/* Dual-target test plugin: time + sleep + identity determinism.
+ * Under the sim: elapsed is exactly the simulated sleep, pid is the
+ * virtual pid, wall clock starts at the simulated epoch (2000-01-01). */
+#include <stdio.h>
+#include <time.h>
+#include <unistd.h>
+#include <sys/utsname.h>
+
+int main(void) {
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    struct timespec req = {2, 500000000};
+    nanosleep(&req, NULL);
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    long long el = (t1.tv_sec - t0.tv_sec) * 1000000000LL +
+                   (t1.tv_nsec - t0.tv_nsec);
+    printf("pid=%d elapsed_ns=%lld\n", getpid(), el);
+    printf("wall=%ld\n", (long)time(NULL));
+    struct utsname u;
+    uname(&u);
+    printf("nodename=%s\n", u.nodename);
+    return 0;
+}

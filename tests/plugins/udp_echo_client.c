@@ -1,0 +1,66 @@
+/* UDP echo client: send N datagrams, await each echo, check RTT.
+ * Under the sim the RTT is exactly 2x the configured link latency plus
+ * deterministic syscall-latency epsilon. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 5) {
+        fprintf(stderr, "usage: %s <server-ip> <port> <count> <size>\n",
+                argv[0]);
+        return 2;
+    }
+    const char *ip = argv[1];
+    int port = atoi(argv[2]);
+    int count = atoi(argv[3]);
+    int size = atoi(argv[4]);
+    if (size > 1400) size = 1400;
+
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in dst;
+    memset(&dst, 0, sizeof(dst));
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons((unsigned short)port);
+    if (inet_pton(AF_INET, ip, &dst.sin_addr) != 1) {
+        fprintf(stderr, "bad ip %s\n", ip);
+        return 2;
+    }
+    char *payload = malloc((size_t)size);
+    memset(payload, 'x', (size_t)size);
+    long long min_rtt = -1, max_rtt = -1;
+    for (int i = 0; i < count; i++) {
+        long long t0 = now_ns();
+        if (sendto(fd, payload, (size_t)size, 0, (struct sockaddr *)&dst,
+                   sizeof(dst)) != size) {
+            perror("sendto");
+            return 1;
+        }
+        char buf[2048];
+        ssize_t n = recvfrom(fd, buf, sizeof(buf), 0, NULL, NULL);
+        if (n != size) {
+            fprintf(stderr, "bad echo len %zd\n", n);
+            return 1;
+        }
+        long long rtt = now_ns() - t0;
+        if (min_rtt < 0 || rtt < min_rtt) min_rtt = rtt;
+        if (rtt > max_rtt) max_rtt = rtt;
+    }
+    printf("completed %d echoes size %d min_rtt_ns=%lld max_rtt_ns=%lld\n",
+           count, size, min_rtt, max_rtt);
+    free(payload);
+    close(fd);
+    return 0;
+}

@@ -1,0 +1,48 @@
+/* UDP echo server: bind, echo N datagrams back to their sender, report.
+ * Exercises socket/bind/recvfrom/sendto + blocking recv under the sim. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <port> <count>\n", argv[0]);
+        return 2;
+    }
+    int port = atoi(argv[1]);
+    int count = atoi(argv[2]);
+
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((unsigned short)port);
+    if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        perror("bind");
+        return 1;
+    }
+    long bytes = 0;
+    for (int i = 0; i < count; i++) {
+        char buf[2048];
+        struct sockaddr_in src;
+        socklen_t slen = sizeof(src);
+        ssize_t n = recvfrom(fd, buf, sizeof(buf), 0,
+                             (struct sockaddr *)&src, &slen);
+        if (n < 0) { perror("recvfrom"); return 1; }
+        bytes += n;
+        if (sendto(fd, buf, (size_t)n, 0, (struct sockaddr *)&src,
+                   slen) != n) {
+            perror("sendto");
+            return 1;
+        }
+    }
+    printf("echoed %d datagrams %ld bytes\n", count, bytes);
+    close(fd);
+    return 0;
+}

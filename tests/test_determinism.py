@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import pytest
 
 CONFIG = """
@@ -94,7 +96,7 @@ def test_cli_end_to_end(tmp_path):
     result = subprocess.run(
         [sys.executable, "-m", "shadow_tpu", str(cfg_path), "--progress"],
         capture_output=True, text=True, timeout=120, env=env,
-        cwd="/root/repo")
+        cwd=REPO_ROOT)
     assert result.returncode == 0, result.stderr
     assert "done: simulated" in result.stderr
     assert "heartbeat" in result.stderr
@@ -112,7 +114,7 @@ def test_cli_reports_plugin_errors(tmp_path):
     result = subprocess.run(
         [sys.executable, "-m", "shadow_tpu", str(cfg_path)],
         capture_output=True, text=True, timeout=120, env=env,
-        cwd="/root/repo")
+        cwd=REPO_ROOT)
     assert result.returncode == 1
     assert "plugin error" in result.stderr
 
