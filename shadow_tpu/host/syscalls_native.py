@@ -277,6 +277,15 @@ class NativeSyscallHandler:
                                    flags)
 
     def _sock_send(self, host, process, sock, data: bytes, dst, flags: int):
+        # Port-53 interception must also catch the connect()+send()
+        # shape libc's resolver uses (dst comes from the socket peer).
+        effective_dst = dst if dst is not None else getattr(sock, "peer",
+                                                            None)
+        if effective_dst is not None and effective_dst[1] == 53 and \
+                isinstance(sock, UdpSocket):
+            handled = self._try_answer_dns(host, sock, data, effective_dst)
+            if handled is not None:
+                return handled
         try:
             n = sock.sendto(host, data, dst)
         except BlockingIOError:
@@ -314,6 +323,28 @@ class NativeSyscallHandler:
             process.mem.write(addr_ptr, sa)
             if len_ptr:
                 process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+        return _done(len(data))
+
+    @staticmethod
+    def _try_answer_dns(host, sock, data: bytes, dst):
+        """Port-53 interception: answer A queries from the sim DNS
+        (net/dns_wire.py) by dropping the response straight into the
+        socket's receive queue, as if the resolver replied instantly.
+        Returns a dispatch result or None to let the datagram travel
+        the simulated network normally."""
+        from shadow_tpu.net import dns_wire
+        from shadow_tpu.net import packet as pkt
+        resp = dns_wire.answer_query(
+            data, lambda name: host.dns.ip_for_name(name))
+        if resp is None:
+            return None
+        if sock.local is None:
+            sock.bind(host, 0, 0)  # INADDR_ANY, ephemeral
+        local_ip = sock.local[0] or host.eth0.ip
+        reply = pkt.Packet(host.id, host.next_packet_seq(), pkt.PROTO_UDP,
+                           dst[0], 53, local_ip, sock.local[1],
+                           payload=resp)
+        sock.push_in_packet(host, reply)
         return _done(len(data))
 
     @staticmethod
@@ -419,7 +450,7 @@ class NativeSyscallHandler:
         return _done(0)
 
     def sys_setsockopt(self, host, process, thread, restarted, fd, level,
-                       optname, optval, optlen):
+                       optname, optval, optlen, *_):
         if not self._is_emu(fd):
             return _native()
         # Recorded-but-inert options (REUSEADDR, NODELAY, buffer sizing
@@ -427,7 +458,7 @@ class NativeSyscallHandler:
         return _done(0)
 
     def sys_getsockopt(self, host, process, thread, restarted, fd, level,
-                       optname, optval_ptr, optlen_ptr):
+                       optname, optval_ptr, optlen_ptr, *_):
         if not self._is_emu(fd):
             return _native()
         sock = self._emu(process, fd)

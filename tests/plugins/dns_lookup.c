@@ -1,0 +1,53 @@
+/* Resolve simulated host names through unmodified libc getaddrinfo:
+ * the shim traps the resolver's UDP port-53 query and the simulator
+ * answers it from the in-sim DNS table, then send a datagram to the
+ * resolved peer to prove the address is live. */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <hostname> <port>\n", argv[0]);
+        return 2;
+    }
+    const char *hostname = argv[1];
+    const char *port = argv[2];
+
+    struct addrinfo hints, *res = NULL;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_DGRAM;
+    int rc = getaddrinfo(hostname, port, &hints, &res);
+    if (rc != 0) {
+        fprintf(stderr, "getaddrinfo(%s): %s\n", hostname,
+                gai_strerror(rc));
+        return 1;
+    }
+    struct sockaddr_in *sin = (struct sockaddr_in *)res->ai_addr;
+    char ip[64];
+    inet_ntop(AF_INET, &sin->sin_addr, ip, sizeof(ip));
+    printf("resolved %s -> %s\n", hostname, ip);
+
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    const char *msg = "hello-by-name";
+    if (sendto(fd, msg, strlen(msg), 0, res->ai_addr,
+               res->ai_addrlen) != (ssize_t)strlen(msg)) {
+        perror("sendto");
+        return 1;
+    }
+    char buf[2048];
+    ssize_t n = recvfrom(fd, buf, sizeof(buf) - 1, 0, NULL, NULL);
+    if (n < 0) { perror("recvfrom"); return 1; }
+    buf[n] = 0;
+    printf("echo via name: %s\n", buf);
+    freeaddrinfo(res);
+    close(fd);
+    return 0;
+}

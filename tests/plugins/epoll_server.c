@@ -1,0 +1,100 @@
+/* Epoll-based UDP echo server: nonblocking socket + epoll_wait loop,
+ * plus a timerfd in the same epoll set for a periodic tick.
+ * Exercises epoll_create1/ctl/wait, timerfd, fcntl(O_NONBLOCK). */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/timerfd.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <port> <count>\n", argv[0]);
+        return 2;
+    }
+    int port = atoi(argv[1]);
+    int count = atoi(argv[2]);
+
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((unsigned short)port);
+    if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        perror("bind");
+        return 1;
+    }
+
+    int tfd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
+    if (tfd < 0) { perror("timerfd_create"); return 1; }
+    struct itimerspec its;
+    memset(&its, 0, sizeof(its));
+    its.it_value.tv_nsec = 250000000;     /* first tick at 250ms */
+    its.it_interval.tv_nsec = 250000000;  /* then every 250ms */
+    if (timerfd_settime(tfd, 0, &its, NULL) != 0) {
+        perror("timerfd_settime");
+        return 1;
+    }
+
+    int ep = epoll_create1(0);
+    if (ep < 0) { perror("epoll_create1"); return 1; }
+    struct epoll_event ev;
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) != 0) {
+        perror("epoll_ctl sock");
+        return 1;
+    }
+    ev.events = EPOLLIN;
+    ev.data.fd = tfd;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, tfd, &ev) != 0) {
+        perror("epoll_ctl timer");
+        return 1;
+    }
+
+    int echoed = 0;
+    long ticks = 0;
+    while (echoed < count) {
+        struct epoll_event evs[8];
+        int n = epoll_wait(ep, evs, 8, 5000);
+        if (n < 0) { perror("epoll_wait"); return 1; }
+        if (n == 0) { fprintf(stderr, "epoll timeout\n"); return 1; }
+        for (int i = 0; i < n; i++) {
+            if (evs[i].data.fd == tfd) {
+                unsigned long long expir = 0;
+                if (read(tfd, &expir, sizeof(expir)) == sizeof(expir))
+                    ticks += (long)expir;
+                continue;
+            }
+            for (;;) {
+                char buf[2048];
+                struct sockaddr_in src;
+                socklen_t slen = sizeof(src);
+                ssize_t r = recvfrom(fd, buf, sizeof(buf), 0,
+                                     (struct sockaddr *)&src, &slen);
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+                    perror("recvfrom");
+                    return 1;
+                }
+                sendto(fd, buf, (size_t)r, 0, (struct sockaddr *)&src,
+                       slen);
+                echoed++;
+            }
+        }
+    }
+    printf("epoll server echoed %d ticks=%ld\n", echoed, ticks);
+    close(tfd);
+    close(fd);
+    close(ep);
+    return 0;
+}

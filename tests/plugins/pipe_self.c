@@ -1,0 +1,63 @@
+/* Single-process pipe + eventfd + poll self-test (no network).
+ * Exercises pipe2, read/write on pipes, eventfd counters, poll with
+ * mixed readiness, FIONREAD. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <sys/ioctl.h>
+#include <unistd.h>
+
+int main(void) {
+    int p[2];
+    if (pipe2(p, 0) != 0) { perror("pipe2"); return 1; }
+
+    const char *msg = "through the simulated pipe";
+    if (write(p[1], msg, strlen(msg)) != (ssize_t)strlen(msg)) {
+        perror("write pipe");
+        return 1;
+    }
+    int avail = 0;
+    if (ioctl(p[0], FIONREAD, &avail) != 0) { perror("FIONREAD"); return 1; }
+
+    int efd = eventfd(3, 0);
+    if (efd < 0) { perror("eventfd"); return 1; }
+    unsigned long long add = 4;
+    if (write(efd, &add, sizeof(add)) != sizeof(add)) {
+        perror("write eventfd");
+        return 1;
+    }
+
+    struct pollfd fds[2] = {
+        {p[0], POLLIN, 0},
+        {efd, POLLIN, 0},
+    };
+    int n = poll(fds, 2, 1000);
+    if (n != 2 || !(fds[0].revents & POLLIN) || !(fds[1].revents & POLLIN)) {
+        fprintf(stderr, "poll: n=%d r0=%x r1=%x\n", n, fds[0].revents,
+                fds[1].revents);
+        return 1;
+    }
+
+    char buf[128];
+    ssize_t r = read(p[0], buf, sizeof(buf) - 1);
+    if (r <= 0) { perror("read pipe"); return 1; }
+    buf[r] = 0;
+    unsigned long long val = 0;
+    if (read(efd, &val, sizeof(val)) != sizeof(val)) {
+        perror("read eventfd");
+        return 1;
+    }
+
+    /* EOF semantics: close the write end, read must return 0. */
+    close(p[1]);
+    ssize_t eof = read(p[0], buf, sizeof(buf));
+
+    printf("pipe avail=%d msg=%s efd=%llu eof=%zd\n", avail, buf, val, eof);
+    close(p[0]);
+    close(efd);
+    return 0;
+}

@@ -1,0 +1,65 @@
+/* TCP client: connect, stream N bytes, half-close, await the server's
+ * summary line.  Exercises connect (blocking handshake), large writes
+ * through cwnd/flow control, shutdown(WR), recv-until-EOF. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 4) {
+        fprintf(stderr, "usage: %s <ip> <port> <bytes>\n", argv[0]);
+        return 2;
+    }
+    const char *ip = argv[1];
+    int port = atoi(argv[2]);
+    long long goal = atoll(argv[3]);
+
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in dst;
+    memset(&dst, 0, sizeof(dst));
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons((unsigned short)port);
+    if (inet_pton(AF_INET, ip, &dst.sin_addr) != 1) {
+        fprintf(stderr, "bad ip\n");
+        return 2;
+    }
+    long long t0 = now_ns();
+    if (connect(fd, (struct sockaddr *)&dst, sizeof(dst)) != 0) {
+        perror("connect");
+        return 1;
+    }
+    long long t_conn = now_ns() - t0;
+
+    char buf[16384];
+    memset(buf, 'y', sizeof(buf));
+    long long sent = 0;
+    while (sent < goal) {
+        size_t want = sizeof(buf);
+        if (goal - sent < (long long)want) want = (size_t)(goal - sent);
+        ssize_t n = send(fd, buf, want, 0);
+        if (n <= 0) { perror("send"); return 1; }
+        sent += n;
+    }
+    shutdown(fd, SHUT_WR);
+    char reply[256];
+    ssize_t rn = recv(fd, reply, sizeof(reply) - 1, 0);
+    if (rn <= 0) { perror("recv reply"); return 1; }
+    reply[rn] = 0;
+    long long elapsed = now_ns() - t0;
+    printf("sent %lld bytes connect_ns=%lld elapsed_ns=%lld reply: %s",
+           sent, t_conn, elapsed, reply);
+    close(fd);
+    return 0;
+}

@@ -1,0 +1,54 @@
+/* TCP server: accept one connection, receive until EOF, echo byte count.
+ * Exercises socket/bind/listen/accept/recv/send + blocking semantics. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <port>\n", argv[0]);
+        return 2;
+    }
+    int port = atoi(argv[1]);
+    int ls = socket(AF_INET, SOCK_STREAM, 0);
+    if (ls < 0) { perror("socket"); return 1; }
+    int one = 1;
+    setsockopt(ls, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((unsigned short)port);
+    if (bind(ls, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        perror("bind");
+        return 1;
+    }
+    if (listen(ls, 8) != 0) { perror("listen"); return 1; }
+    struct sockaddr_in peer;
+    socklen_t plen = sizeof(peer);
+    int cs = accept(ls, (struct sockaddr *)&peer, &plen);
+    if (cs < 0) { perror("accept"); return 1; }
+    char pbuf[64];
+    inet_ntop(AF_INET, &peer.sin_addr, pbuf, sizeof(pbuf));
+    printf("accepted from %s\n", pbuf);
+
+    long long total = 0;
+    char buf[16384];
+    for (;;) {
+        ssize_t n = recv(cs, buf, sizeof(buf), 0);
+        if (n < 0) { perror("recv"); return 1; }
+        if (n == 0) break;  /* peer sent FIN */
+        total += n;
+    }
+    char reply[64];
+    int rl = snprintf(reply, sizeof(reply), "got %lld bytes\n", total);
+    if (send(cs, reply, (size_t)rl, 0) != rl) { perror("send"); return 1; }
+    printf("received %lld bytes total\n", total);
+    close(cs);
+    close(ls);
+    return 0;
+}
