@@ -77,19 +77,25 @@ class EventQueue:
     __slots__ = ("_heap", "_last_popped_time")
 
     def __init__(self):
-        self._heap: list[Event] = []
+        # Heap entries are (time, kind, src_host_id, seq, event) tuples:
+        # heapq then compares native ints instead of calling
+        # Event.__lt__ (millions of Python-level calls per run).  The
+        # (src_host_id, seq) pair is unique per source, so comparison
+        # never falls through to the Event object itself.
+        self._heap: list[tuple] = []
         self._last_popped_time = 0
 
     def push(self, event: Event) -> None:
-        heapq.heappush(self._heap, event)
+        heapq.heappush(self._heap, (event.time, event.kind,
+                                    event.src_host_id, event.seq, event))
 
     def peek_time(self) -> Optional[int]:
-        return self._heap[0].time if self._heap else None
+        return self._heap[0][0] if self._heap else None
 
     def pop(self) -> Optional[Event]:
         if not self._heap:
             return None
-        ev = heapq.heappop(self._heap)
+        ev = heapq.heappop(self._heap)[4]
         # Determinism guard (event_queue.rs:33): time must never go backwards.
         assert ev.time >= self._last_popped_time, (
             f"event time moved backwards: {ev} after t={self._last_popped_time}")

@@ -298,13 +298,15 @@ class Manager:
                         f"{proc.expected_final_state!r}, got {state!r}")
         if self._pool is not None:
             self._pool.shutdown()
-        # Tear down any still-running managed (native) processes.
+        # Tear down any still-running managed (native) processes; flush
+        # streamed strace files for processes that never exited.
         from shadow_tpu.host.managed import ManagedProcess
         for h in self.hosts:
             for proc in h.processes.values():
                 if isinstance(proc, ManagedProcess) and not proc.exited:
                     proc.kill_native()
                     proc.collect_output()
+                proc.strace_close()
         # Flush captures even when the caller never writes a data dir.
         for h in self.hosts:
             for iface in (h.lo, h.eth0):
@@ -354,9 +356,8 @@ class Manager:
                     f.write(bytes(proc.stdout))
                 with open(stem + ".stderr", "wb") as f:
                     f.write(bytes(proc.stderr))
-                if proc.strace_mode is not None:
-                    with open(stem + ".strace", "wb") as f:
-                        f.write(bytes(proc.strace))
+                # Strace files stream directly into the host data dir
+                # during the run (Process.strace_write); nothing to copy.
         with open(os.path.join(base, "packet-trace.txt"), "w") as f:
             for line in self.trace_lines():
                 f.write(line + "\n")

@@ -296,9 +296,9 @@ class ManagedThread:
         result = handler.dispatch(host, process, self, num, args, restarted)
         if process.strace_mode is not None:
             from shadow_tpu.host import strace
-            process.strace += strace.format_native_call(
+            process.strace_write(strace.format_native_call(
                 host.now(), self.tid, num, args, result,
-                process.strace_mode).encode()
+                process.strace_mode).encode())
         kind = result[0]
 
         if kind == "block":

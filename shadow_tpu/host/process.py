@@ -88,9 +88,9 @@ class Thread:
             host.counters["syscalls"] += 1
             if process.strace_mode is not None:
                 from shadow_tpu.host import strace
-                process.strace += strace.format_call(
+                process.strace_write(strace.format_call(
                     host.now(), self.tid, call, result,
-                    process.strace_mode).encode()
+                    process.strace_mode).encode())
             kind = result[0]
             if kind == "done":
                 self._pending_send = result[1]
@@ -142,10 +142,48 @@ class Process:
         self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
         self.stderr = bytearray()
-        self.strace = bytearray()
         self.strace_mode: str | None = None  # set by the manager when on
+        # Strace lines stream to a file in the host data dir (bounded
+        # memory, survives crashes — the reference writes per-process
+        # .strace files the same way); the in-memory buffer is the
+        # fallback when the host has no data dir.
+        self._strace_buf = bytearray()
+        self._strace_file = None
         self.expected_final_state = expected_final_state
         self.fds = host_descriptor_table()
+
+    def strace_write(self, data: bytes) -> None:
+        if self._strace_file is None:
+            data_path = getattr(self.host, "data_path", None)
+            if data_path:
+                import os
+                os.makedirs(data_path, exist_ok=True)
+                self._strace_file = open(
+                    os.path.join(data_path,
+                                 f"{self.name}.{self.pid}.strace"), "wb")
+            else:
+                self._strace_buf += data
+                return
+        self._strace_file.write(data)
+
+    def strace_close(self) -> None:
+        if self._strace_file is not None:
+            self._strace_file.close()
+            self._strace_file = None
+
+    @property
+    def strace(self) -> bytes:
+        """The full strace contents (reads back the streamed file)."""
+        if self._strace_file is not None:
+            self._strace_file.flush()
+        data_path = getattr(self.host, "data_path", None)
+        if data_path:
+            import os
+            path = os.path.join(data_path, f"{self.name}.{self.pid}.strace")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    return f.read()
+        return bytes(self._strace_buf)
 
     def spawn_thread(self, host, gen) -> Thread:
         t = Thread(self, gen, self._next_tid)
@@ -168,6 +206,7 @@ class Process:
             self.exit_code = (self._nonzero_exit
                               if self._nonzero_exit is not None else code)
             self.fds.close_all(host)
+            self.strace_close()
 
     def matches_expected_final_state(self) -> bool:
         expected = self.expected_final_state

@@ -83,7 +83,7 @@ def set_status_tracing(enabled: bool) -> None:
 class Packet:
     __slots__ = ("src_host_id", "seq", "protocol", "src_ip", "src_port",
                  "dst_ip", "dst_port", "payload", "tcp", "priority",
-                 "statuses", "arrival_time")
+                 "statuses", "arrival_time", "_total_size")
 
     def __init__(self, src_host_id: int, seq: int, protocol: int,
                  src_ip: int, src_port: int, dst_ip: int, dst_port: int,
@@ -100,6 +100,11 @@ class Packet:
         self.priority = 0       # FIFO stamp assigned at interface enqueue
         self.statuses = None
         self.arrival_time = 0   # set by the propagation phase
+        # Hot-path cache: headers and payload never change after
+        # construction, and total_size() is called several times per
+        # packet in the queue/relay path.
+        self._total_size = IPV4_HEADER_SIZE + len(payload) + (
+            TCP_HEADER_SIZE if protocol == PROTO_TCP else UDP_HEADER_SIZE)
         if _trace_enabled:
             self.statuses = [ST_CREATED]
 
@@ -112,7 +117,7 @@ class Packet:
             TCP_HEADER_SIZE if self.protocol == PROTO_TCP else UDP_HEADER_SIZE)
 
     def total_size(self) -> int:
-        return self.header_size() + len(self.payload)
+        return self._total_size
 
     def is_empty_control(self) -> bool:
         """Control packets (no payload) are exempt from random loss, like

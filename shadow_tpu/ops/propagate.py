@@ -88,14 +88,22 @@ class TpuPropagator:
 
     def __init__(self, hosts, dns, latency_ns, loss_thresholds, seed: int,
                  bootstrap_end_ns: int, max_batch: int = 1 << 20,
-                 runahead=None):
+                 runahead=None, min_device_batch: int = 2048):
         self.hosts = hosts
         self.dns = dns
         k0, k1 = mix_key(seed, STREAM_PACKET_LOSS)
+        self._keys = (k0, k1)
         self.kernel = build_propagate_kernel(latency_ns, loss_thresholds,
                                              k0, k1)
+        self._lat_np = np.asarray(latency_ns, dtype=np.int64)
+        self._thr_np = np.asarray(loss_thresholds, dtype=np.int64)
         self.bootstrap_end = bootstrap_end_ns
         self.max_batch = max_batch
+        # Rounds smaller than this run the same integer math on the host
+        # CPU (numpy threefry — bit-identical to the device kernel by
+        # construction) instead of paying a device dispatch round trip;
+        # only batches big enough to amortize the transfer go to the TPU.
+        self.min_device_batch = min_device_batch
         self.runahead = runahead
         self.window_end = 0
         # Outbox: parallel scalar lists + the packet/event bookkeeping.
@@ -155,29 +163,13 @@ class TpuPropagator:
         return global_min_deliver if global_min_deliver < _I64_MAX else None
 
     def _dispatch_chunk(self, lo: int, hi: int):
-        import jax.numpy as jnp
-
         n = hi - lo
-        b = _bucket(n)
-        pad = b - n
-
-        def arr(lst, dtype):
-            a = np.zeros(b, dtype=dtype)
-            a[:n] = lst[lo:hi]
-            return a
-
-        deliver, keep, reachable, lossy, min_deliver, min_latency = \
-            self.kernel(
-                arr(self._src_node, np.int32), arr(self._dst_node, np.int32),
-                arr(self._src_host, np.int64), arr(self._pkt_seq, np.uint32),
-                arr(self._t_send, np.int64), arr(self._is_ctl, bool),
-                np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]),
-                jnp.int64(self.window_end), jnp.int64(self.bootstrap_end))
-
-        deliver = np.asarray(deliver)
-        keep = np.asarray(keep)
-        reachable = np.asarray(reachable)
-        lossy = np.asarray(lossy)
+        if n < self.min_device_batch:
+            deliver, keep, reachable, lossy, min_deliver, min_latency = \
+                self._compute_host(lo, hi)
+        else:
+            deliver, keep, reachable, lossy, min_deliver, min_latency = \
+                self._compute_device(lo, hi)
         self.rounds_dispatched += 1
 
         # Scatter (outbox order => per-source event order is preserved).
@@ -196,3 +188,56 @@ class TpuPropagator:
                 src_host.trace_drop(packet, "inet-loss",
                                     at_time=self._t_send[lo + i])
         return int(min_deliver), int(min_latency)
+
+    def _compute_device(self, lo: int, hi: int):
+        import jax.numpy as jnp
+
+        n = hi - lo
+        b = _bucket(n)
+        pad = b - n
+
+        def arr(lst, dtype):
+            a = np.zeros(b, dtype=dtype)
+            a[:n] = lst[lo:hi]
+            return a
+
+        deliver, keep, reachable, lossy, min_deliver, min_latency = \
+            self.kernel(
+                arr(self._src_node, np.int32), arr(self._dst_node, np.int32),
+                arr(self._src_host, np.int64), arr(self._pkt_seq, np.uint32),
+                arr(self._t_send, np.int64), arr(self._is_ctl, bool),
+                np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]),
+                jnp.int64(self.window_end), jnp.int64(self.bootstrap_end))
+        return (np.asarray(deliver), np.asarray(keep),
+                np.asarray(reachable), np.asarray(lossy),
+                int(min_deliver), int(min_latency))
+
+    def _compute_host(self, lo: int, hi: int):
+        """Same integer math as the device kernel, in numpy — used for
+        rounds too small to amortize a device dispatch.  Bit-identical
+        by construction (same matrices, same threefry bits; the parity
+        tests cover all three paths: scalar, host-batch, device)."""
+        from shadow_tpu.core.rng import threefry2x32_np
+
+        src_node = np.asarray(self._src_node[lo:hi], dtype=np.int32)
+        dst_node = np.asarray(self._dst_node[lo:hi], dtype=np.int32)
+        src_host = np.asarray(self._src_host[lo:hi], dtype=np.int64)
+        pkt_seq = np.asarray(self._pkt_seq[lo:hi], dtype=np.uint32)
+        t_send = np.asarray(self._t_send[lo:hi], dtype=np.int64)
+        is_ctl = np.asarray(self._is_ctl[lo:hi], dtype=bool)
+
+        latency = self._lat_np[src_node, dst_node]
+        reachable = latency < TIME_NEVER
+        k0, k1 = self._keys
+        bits, _ = threefry2x32_np(np.uint32(k0), np.uint32(k1),
+                                  src_host.astype(np.uint32), pkt_seq)
+        threshold = self._thr_np[src_node, dst_node]
+        lossy = (bits.astype(np.int64) < threshold) & ~is_ctl \
+            & (t_send >= self.bootstrap_end)
+        deliver = np.maximum(t_send + latency, self.window_end)
+        keep = reachable & ~lossy
+        min_deliver = int(np.min(np.where(keep, deliver, _I64_MAX),
+                                 initial=_I64_MAX))
+        min_latency = int(np.min(np.where(keep, latency, _I64_MAX),
+                                 initial=_I64_MAX))
+        return deliver, keep, reachable, lossy, min_deliver, min_latency
