@@ -87,6 +87,9 @@ class ExperimentalConfig:
     unblocked_syscall_latency_ns: int = units.parse_time_ns("1 us")
     unblocked_vdso_latency_ns: int = units.parse_time_ns("10 ns")
     tpu_max_packets_per_round: int = 1 << 20
+    # Below this, propagation always runs the numpy host path; above,
+    # the online cost model measures host vs device and routes.
+    tpu_min_device_batch: int = 2048
     report_errors_to_stderr: bool = True
 
 
@@ -158,6 +161,7 @@ class ConfigOptions:
                 ("unblocked_vdso_latency", "unblocked_vdso_latency_ns",
                  units.parse_time_ns),
                 ("tpu_max_packets_per_round", "tpu_max_packets_per_round", int),
+                ("tpu_min_device_batch", "tpu_min_device_batch", int),
                 ("report_errors_to_stderr", "report_errors_to_stderr", bool)):
             if yaml_key in e:
                 setattr(experimental, attr, conv(e[yaml_key]))

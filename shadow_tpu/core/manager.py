@@ -147,6 +147,7 @@ class Manager:
                 self.hosts, self.dns, graph.latency_ns, thr, seed,
                 config.general.bootstrap_end_time_ns,
                 max_batch=config.experimental.tpu_max_packets_per_round,
+                min_device_batch=config.experimental.tpu_min_device_batch,
                 runahead=self.runahead)
         else:
             self.propagator = ScalarPropagator(

@@ -171,20 +171,6 @@ class Process:
             self._strace_file.close()
             self._strace_file = None
 
-    @property
-    def strace(self) -> bytes:
-        """The full strace contents (reads back the streamed file)."""
-        if self._strace_file is not None:
-            self._strace_file.flush()
-        data_path = getattr(self.host, "data_path", None)
-        if data_path:
-            import os
-            path = os.path.join(data_path, f"{self.name}.{self.pid}.strace")
-            if os.path.exists(path):
-                with open(path, "rb") as f:
-                    return f.read()
-        return bytes(self._strace_buf)
-
     def spawn_thread(self, host, gen) -> Thread:
         t = Thread(self, gen, self._next_tid)
         self._next_tid += 1

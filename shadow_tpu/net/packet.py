@@ -103,8 +103,7 @@ class Packet:
         # Hot-path cache: headers and payload never change after
         # construction, and total_size() is called several times per
         # packet in the queue/relay path.
-        self._total_size = IPV4_HEADER_SIZE + len(payload) + (
-            TCP_HEADER_SIZE if protocol == PROTO_TCP else UDP_HEADER_SIZE)
+        self._total_size = self.header_size() + len(payload)
         if _trace_enabled:
             self.statuses = [ST_CREATED]
 

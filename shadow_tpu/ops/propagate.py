@@ -99,23 +99,27 @@ class TpuPropagator:
         self._thr_np = np.asarray(loss_thresholds, dtype=np.int64)
         self.bootstrap_end = bootstrap_end_ns
         self.max_batch = max_batch
-        # Rounds smaller than this run the same integer math on the host
-        # CPU (numpy threefry — bit-identical to the device kernel by
-        # construction) instead of paying a device dispatch round trip;
-        # only batches big enough to amortize the transfer go to the TPU.
+        # Rounds smaller than this always run the same integer math on the
+        # host CPU (numpy threefry — bit-identical to the device kernel by
+        # construction) instead of paying a device dispatch round trip.
+        # Above it, an online cost model decides: both paths produce
+        # identical bits, so routing is purely a performance choice, and
+        # device latency varies wildly between a local chip and a
+        # tunneled one — measure, don't guess.
         self.min_device_batch = min_device_batch
         self.runahead = runahead
         self.window_end = 0
-        # Outbox: parallel scalar lists + the packet/event bookkeeping.
-        self._src_node: list[int] = []
-        self._dst_node: list[int] = []
-        self._src_host: list[int] = []
-        self._pkt_seq: list[int] = []
-        self._t_send: list[int] = []
-        self._is_ctl: list[bool] = []
-        self._meta: list = []  # (src_host_obj, dst_host_obj, evt_seq, packet)
+        # Outbox: one tuple per packet (hot path = a single list append).
+        # (src_host_obj, dst_host_obj, evt_seq, packet, t_send, is_ctl)
+        self._outbox: list = []
         self.rounds_dispatched = 0
         self.packets_batched = 0
+        # Online cost model: EWMA ns/packet for the numpy-host path and
+        # EWMA ns/dispatch for the device at each bucket size.
+        self._host_ns_per_pkt = None
+        self._dev_ns_by_bucket: dict[int, float] = {}
+        self._dev_probe_countdown: dict[int, int] = {}
+        self._dev_compiled: set[int] = set()
 
     def begin_round(self, window_start: int, window_end: int) -> None:
         self.window_end = window_end
@@ -125,18 +129,12 @@ class TpuPropagator:
         if dst_id is None:
             src_host.trace_drop(packet, "no-route")
             return
-        dst_host = self.hosts[dst_id]
-        seq = src_host.next_event_seq()
-        self._src_node.append(src_host.node_index)
-        self._dst_node.append(dst_host.node_index)
-        self._src_host.append(src_host.id)
-        self._pkt_seq.append(packet.seq & 0xFFFFFFFF)
-        self._t_send.append(src_host.now())
-        self._is_ctl.append(packet.is_empty_control())
-        self._meta.append((src_host, dst_host, seq, packet))
+        self._outbox.append((src_host, self.hosts[dst_id],
+                             src_host.next_event_seq(), packet,
+                             src_host.now(), packet.is_empty_control()))
 
     def finish_round(self):
-        total = len(self._meta)
+        total = len(self._outbox)
         if total == 0:
             return None
         # Honor the configured per-dispatch cap (device-memory bound):
@@ -153,59 +151,125 @@ class TpuPropagator:
         if self.runahead is not None and global_min_latency < _I64_MAX:
             self.runahead.update_lowest_used_latency(global_min_latency)
 
-        self._src_node.clear()
-        self._dst_node.clear()
-        self._src_host.clear()
-        self._pkt_seq.clear()
-        self._t_send.clear()
-        self._is_ctl.clear()
-        self._meta.clear()
+        self._outbox.clear()
         return global_min_deliver if global_min_deliver < _I64_MAX else None
 
-    def _dispatch_chunk(self, lo: int, hi: int):
-        n = hi - lo
+    # How often to re-probe the device at a bucket size the cost model
+    # currently routes to the host path (keeps the model honest if device
+    # latency improves mid-run, e.g. a tunnel warming up).
+    _DEV_REPROBE_EVERY = 64
+
+    def _use_device(self, n: int, b: int) -> bool:
+        """Online routing choice: both paths are bit-identical, so pick
+        the one the measured cost model says is cheaper for this size.
+        Probe order: host first (cheap, bounded ~µs/packet — also the
+        only way to ever measure it when all rounds are large), then
+        device, then compare."""
+        if self.min_device_batch <= 0:
+            return True  # forced-device mode (parity tests, debugging)
         if n < self.min_device_batch:
+            return False
+        if self._host_ns_per_pkt is None:
+            return False  # host probe
+        dev = self._dev_ns_by_bucket.get(b)
+        if dev is None:
+            return True  # device probe
+        if dev <= self._host_ns_per_pkt * n:
+            return True
+        # Device currently losing at this size: re-probe occasionally.
+        left = self._dev_probe_countdown.get(b, self._DEV_REPROBE_EVERY) - 1
+        if left <= 0:
+            self._dev_probe_countdown[b] = self._DEV_REPROBE_EVERY
+            return True
+        self._dev_probe_countdown[b] = left
+        return False
+
+    def _dispatch_chunk(self, lo: int, hi: int):
+        import time as _time
+
+        n = hi - lo
+        b = _bucket(n)
+        t0 = _time.perf_counter_ns()
+        if self._use_device(n, b):
             deliver, keep, reachable, lossy, min_deliver, min_latency = \
-                self._compute_host(lo, hi)
+                self._compute_device(lo, hi, b)
+            dt = _time.perf_counter_ns() - t0
+            if b not in self._dev_compiled:
+                # First dispatch at this bucket size pays one-time JIT
+                # compilation; recording it would poison the estimate
+                # for thousands of rounds.
+                self._dev_compiled.add(b)
+            else:
+                prev = self._dev_ns_by_bucket.get(b)
+                host = self._host_ns_per_pkt
+                if prev is None or (host is not None and prev > host * n):
+                    # First real sample, or a re-probe while routed away
+                    # from the device: trust the fresh measurement over
+                    # the stale average so recovery is immediate.
+                    self._dev_ns_by_bucket[b] = dt
+                else:
+                    self._dev_ns_by_bucket[b] = 0.7 * prev + 0.3 * dt
         else:
             deliver, keep, reachable, lossy, min_deliver, min_latency = \
-                self._compute_device(lo, hi)
+                self._compute_host(lo, hi)
+            dt = (_time.perf_counter_ns() - t0) / n
+            prev = self._host_ns_per_pkt
+            self._host_ns_per_pkt = dt if prev is None \
+                else 0.7 * prev + 0.3 * dt
         self.rounds_dispatched += 1
 
         # Scatter (outbox order => per-source event order is preserved).
+        # ndarray.tolist() up front: per-element python-int access is far
+        # cheaper than indexing numpy scalars in the loop.
+        deliver_l = deliver.tolist()
+        keep_l = keep.tolist()
+        outbox = self._outbox
         for i in range(n):
-            src_host, dst_host, seq, packet = self._meta[lo + i]
-            if keep[i]:
-                t = int(deliver[i])
+            src_host, dst_host, seq, packet, t_send, _ = outbox[lo + i]
+            if keep_l[i]:
+                t = deliver_l[i]
                 packet.arrival_time = t
                 dst_host.deliver_packet_event(
                     Event(t, KIND_PACKET, src_host.id, seq, packet))
             elif not reachable[i]:
-                src_host.trace_drop(packet, "unreachable",
-                                    at_time=self._t_send[lo + i])
+                src_host.trace_drop(packet, "unreachable", at_time=t_send)
             elif lossy[i]:
                 packet.record(pktmod.ST_INET_DROPPED)
-                src_host.trace_drop(packet, "inet-loss",
-                                    at_time=self._t_send[lo + i])
+                src_host.trace_drop(packet, "inet-loss", at_time=t_send)
         return int(min_deliver), int(min_latency)
 
-    def _compute_device(self, lo: int, hi: int):
+    def _chunk_columns(self, lo: int, hi: int):
+        """Transpose the outbox slice into numpy columns."""
+        src_h, dst_h, _seq, pkts, t_send, is_ctl = \
+            zip(*self._outbox[lo:hi])
+        src_node = np.fromiter((h.node_index for h in src_h), np.int32,
+                               hi - lo)
+        dst_node = np.fromiter((h.node_index for h in dst_h), np.int32,
+                               hi - lo)
+        src_host = np.fromiter((h.id for h in src_h), np.int64, hi - lo)
+        pkt_seq = np.fromiter((p.seq & 0xFFFFFFFF for p in pkts), np.uint32,
+                              hi - lo)
+        t_send = np.asarray(t_send, dtype=np.int64)
+        is_ctl = np.asarray(is_ctl, dtype=bool)
+        return src_node, dst_node, src_host, pkt_seq, t_send, is_ctl
+
+    def _compute_device(self, lo: int, hi: int, b: int):
         import jax.numpy as jnp
 
         n = hi - lo
-        b = _bucket(n)
         pad = b - n
+        src_node, dst_node, src_host, pkt_seq, t_send, is_ctl = \
+            self._chunk_columns(lo, hi)
 
-        def arr(lst, dtype):
-            a = np.zeros(b, dtype=dtype)
-            a[:n] = lst[lo:hi]
+        def arr(col):
+            a = np.zeros(b, dtype=col.dtype)
+            a[:n] = col
             return a
 
         deliver, keep, reachable, lossy, min_deliver, min_latency = \
             self.kernel(
-                arr(self._src_node, np.int32), arr(self._dst_node, np.int32),
-                arr(self._src_host, np.int64), arr(self._pkt_seq, np.uint32),
-                arr(self._t_send, np.int64), arr(self._is_ctl, bool),
+                arr(src_node), arr(dst_node), arr(src_host), arr(pkt_seq),
+                arr(t_send), arr(is_ctl),
                 np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]),
                 jnp.int64(self.window_end), jnp.int64(self.bootstrap_end))
         return (np.asarray(deliver), np.asarray(keep),
@@ -219,12 +283,8 @@ class TpuPropagator:
         tests cover all three paths: scalar, host-batch, device)."""
         from shadow_tpu.core.rng import threefry2x32_np
 
-        src_node = np.asarray(self._src_node[lo:hi], dtype=np.int32)
-        dst_node = np.asarray(self._dst_node[lo:hi], dtype=np.int32)
-        src_host = np.asarray(self._src_host[lo:hi], dtype=np.int64)
-        pkt_seq = np.asarray(self._pkt_seq[lo:hi], dtype=np.uint32)
-        t_send = np.asarray(self._t_send[lo:hi], dtype=np.int64)
-        is_ctl = np.asarray(self._is_ctl[lo:hi], dtype=bool)
+        src_node, dst_node, src_host, pkt_seq, t_send, is_ctl = \
+            self._chunk_columns(lo, hi)
 
         latency = self._lat_np[src_node, dst_node]
         reachable = latency < TIME_NEVER

@@ -45,9 +45,11 @@ hosts:
 """
 
 
-def run(scheduler, seed=11):
+def run(scheduler, seed=11, min_device_batch=None):
     cfg = ConfigOptions.from_yaml_text(
         MULTI_NODE.format(scheduler=scheduler, seed=seed))
+    if min_device_batch is not None:
+        cfg.experimental.tpu_min_device_batch = min_device_batch
     return run_simulation(cfg)
 
 
@@ -71,6 +73,21 @@ def test_tpu_trace_byte_identical_across_seeds():
         m_cpu, _ = run("serial", seed)
         m_tpu, _ = run("tpu", seed)
         assert m_cpu.trace_lines() == m_tpu.trace_lines()
+
+
+def test_device_kernel_trace_byte_identical_to_serial():
+    """Force every dispatch through the *jitted device kernel* (the online
+    cost model would otherwise keep small CI rounds on the numpy host
+    path, and a kernel regression could hide behind host-path parity)."""
+    m_cpu, s_cpu = run("serial")
+    m_dev, s_dev = run("tpu", min_device_batch=0)
+    assert s_cpu.ok and s_dev.ok
+    # Every dispatched chunk must actually have hit the device kernel.
+    assert m_dev.propagator._dev_compiled, "device kernel never ran"
+    assert m_dev.propagator._host_ns_per_pkt is None, \
+        "a chunk leaked onto the numpy host path"
+    assert m_cpu.trace_lines() == m_dev.trace_lines()
+    assert s_cpu.packets_dropped == s_dev.packets_dropped
 
 
 def test_tpu_batches_packets():
