@@ -47,17 +47,33 @@
 /* Defined in shim_trampoline.S; section bounds provided by the linker. */
 extern long shadowtpu_raw_syscall(long n, long a1, long a2, long a3,
                                   long a4, long a5, long a6);
+extern long shadowtpu_clone_trampoline(long flags, long stack, long ptid,
+                                       long ctid, long tls, void *chan);
 extern char __start_shim_sys_text[];
 extern char __stop_shim_sys_text[];
 
+/* The trampoline hardcodes the clone_regs offset (it cannot include a
+ * header with C typedefs). */
+_Static_assert(__builtin_offsetof(ipc_chan_t, clone_regs) == 144,
+               "clone_regs offset drifted from shim_trampoline.S");
+
 static shim_ipc_t *g_ipc = NULL;
 static int g_enabled = 0;
+/* Each thread speaks over its own channel pair; channel 0 is the main
+ * thread's, others are bound during the clone dance.  initial-exec TLS:
+ * resolved at load time, safe to touch from the SIGSYS handler. */
+static __thread ipc_chan_t *g_chan
+    __attribute__((tls_model("initial-exec"))) = NULL;
 /* Every Nth locally-answerable time syscall is forwarded anyway so the
  * manager's CPU-latency model can advance simulated time under
  * time-polling busy loops (ref: unapplied-cpu-latency accounting,
  * src/main/host/syscall/handler/mod.rs:271-321). */
 #define LOCAL_TIME_FORWARD_EVERY 1024
-static uint32_t g_local_time_count = 0;
+/* Per-thread: SIGSYS handlers on different threads must not race on a
+ * shared counter (and per-thread accounting matches the per-thread
+ * channel design). */
+static __thread uint32_t g_local_time_count
+    __attribute__((tls_model("initial-exec"))) = 0;
 
 #define raw shadowtpu_raw_syscall
 
@@ -120,14 +136,92 @@ static long shim_ipc_syscall(long n, const long args[6]) {
     ev.kind = EV_SYSCALL;
     ev.num = n;
     memcpy(ev.args, args, sizeof(ev.args));
-    slot_send(&g_ipc->to_shadow, &ev);
-    slot_recv(&g_ipc->to_shim, &ev);
+    slot_send(&g_chan->to_shadow, &ev);
+    slot_recv(&g_chan->to_shim, &ev);
     if (ev.kind == EV_SYSCALL_COMPLETE)
         return ev.num;
     if (ev.kind == EV_SYSCALL_DO_NATIVE)
         return raw(n, args[0], args[1], args[2], args[3], args[4], args[5]);
     shim_die("[shadow-tpu shim] unexpected response kind\n");
     return -ENOSYS;
+}
+
+/* ---------------------------------------------------------------- */
+/* Thread-creation clone                                             */
+/* ---------------------------------------------------------------- */
+
+/* Child half of the clone dance: runs first thing on the new thread's
+ * stack (called from shadowtpu_clone_trampoline).  Binds this thread's
+ * channel, announces itself, and blocks until the manager's event queue
+ * reaches the thread-start task — so a new thread enters the simulated
+ * timeline deterministically, not whenever the kernel felt like
+ * scheduling it. */
+__attribute__((visibility("hidden")))
+void shadowtpu_child_entry(ipc_chan_t *chan) {
+    g_chan = chan;
+    shim_event_t ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = EV_START_REQ;
+    ev.num = raw(SYS_gettid, 0, 0, 0, 0, 0, 0);
+    slot_send(&chan->to_shadow, &ev);
+    slot_recv(&chan->to_shim, &ev);
+    if (ev.kind != EV_START_RES)
+        shim_die("[shadow-tpu shim] bad thread-start handshake\n");
+}
+
+/* Parent half.  Forwards the trapped clone to the manager; a plain
+ * COMPLETE response is an error to report (e.g. unsupported flags),
+ * CLONE_RES carries a channel index for the child and means "actually
+ * create it".  (Ref: managed_thread.rs:359 native_clone.) */
+static void shim_handle_clone(greg_t *gregs) {
+    long args[6] = {
+        (long)gregs[REG_RDI], (long)gregs[REG_RSI], (long)gregs[REG_RDX],
+        (long)gregs[REG_R10], (long)gregs[REG_R8],  (long)gregs[REG_R9],
+    };
+    shim_event_t ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = EV_SYSCALL;
+    ev.num = SYS_clone;
+    memcpy(ev.args, args, sizeof(ev.args));
+    slot_send(&g_chan->to_shadow, &ev);
+    slot_recv(&g_chan->to_shim, &ev);
+    if (ev.kind == EV_SYSCALL_COMPLETE) {
+        gregs[REG_RAX] = (greg_t)ev.num;
+        return;
+    }
+    if (ev.kind != EV_CLONE_RES)
+        shim_die("[shadow-tpu shim] unexpected clone response\n");
+
+    ipc_chan_t *child_chan = &g_ipc->chans[ev.num];
+    uint64_t *r = child_chan->clone_regs;
+    r[CLONE_REG_RIP] = (uint64_t)gregs[REG_RIP];
+    r[CLONE_REG_RBX] = (uint64_t)gregs[REG_RBX];
+    r[CLONE_REG_RBP] = (uint64_t)gregs[REG_RBP];
+    r[CLONE_REG_R12] = (uint64_t)gregs[REG_R12];
+    r[CLONE_REG_R13] = (uint64_t)gregs[REG_R13];
+    r[CLONE_REG_R14] = (uint64_t)gregs[REG_R14];
+    r[CLONE_REG_R15] = (uint64_t)gregs[REG_R15];
+    r[CLONE_REG_RDI] = (uint64_t)gregs[REG_RDI];
+    r[CLONE_REG_RSI] = (uint64_t)gregs[REG_RSI];
+    r[CLONE_REG_RDX] = (uint64_t)gregs[REG_RDX];
+    r[CLONE_REG_RCX] = (uint64_t)gregs[REG_RCX];
+    r[CLONE_REG_R8]  = (uint64_t)gregs[REG_R8];
+    r[CLONE_REG_R9]  = (uint64_t)gregs[REG_R9];
+    r[CLONE_REG_R10] = (uint64_t)gregs[REG_R10];
+    r[CLONE_REG_R11] = (uint64_t)gregs[REG_R11];
+    child_chan->clone_chan_idx = (uint64_t)ev.num;
+
+    long rv = shadowtpu_clone_trampoline(args[0], args[1], args[2],
+                                         args[3], args[4], child_chan);
+
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = EV_CLONE_DONE;
+    ev.num = rv;
+    slot_send(&g_chan->to_shadow, &ev);
+    slot_recv(&g_chan->to_shim, &ev);
+    if (ev.kind != EV_SYSCALL_COMPLETE)
+        shim_die("[shadow-tpu shim] bad clone completion\n");
+    gregs[REG_RAX] = (greg_t)ev.num;
 }
 
 /* Returns 1 if handled locally, placing the result in *ret. */
@@ -212,6 +306,11 @@ static void sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
     ucontext_t *ctx = (ucontext_t *)ucontext;
     greg_t *gregs = ctx->uc_mcontext.gregs;
     long n = (long)info->si_syscall;
+    if (n == SYS_clone) {
+        /* Needs the full trapped context (the child resumes from it). */
+        shim_handle_clone(gregs);
+        return;
+    }
     long args[6] = {
         (long)gregs[REG_RDI], (long)gregs[REG_RSI], (long)gregs[REG_RDX],
         (long)gregs[REG_R10], (long)gregs[REG_R8],  (long)gregs[REG_R9],
@@ -322,6 +421,7 @@ static void shim_init(void) {
     g_ipc = (shim_ipc_t *)addr;
     if (g_ipc->magic != SHIM_IPC_MAGIC || g_ipc->version != SHIM_IPC_VERSION)
         shim_die("[shadow-tpu shim] IPC magic/version mismatch\n");
+    g_chan = &g_ipc->chans[0];
 
     struct sigaction sa;
     memset(&sa, 0, sizeof(sa));
@@ -340,8 +440,8 @@ static void shim_init(void) {
     memset(&ev, 0, sizeof(ev));
     ev.kind = EV_START_REQ;
     ev.num = (int64_t)raw(SYS_getpid, 0, 0, 0, 0, 0, 0);
-    slot_send(&g_ipc->to_shadow, &ev);
-    slot_recv(&g_ipc->to_shim, &ev);
+    slot_send(&g_chan->to_shadow, &ev);
+    slot_recv(&g_chan->to_shim, &ev);
     if (ev.kind != EV_START_RES)
         shim_die("[shadow-tpu shim] bad start handshake\n");
 }

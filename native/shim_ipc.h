@@ -9,6 +9,12 @@
  * request/response (one outstanding message per direction), which is
  * all the syscall round-trip needs.
  *
+ * One block carries IPC_N_CHANS channel pairs: channel 0 belongs to the
+ * process's main thread; further channels are handed out by the manager
+ * when the process clones threads (the reference allocates a fresh
+ * IPCData block per ManagedThread, managed_thread.rs:113; a fixed
+ * in-block array keeps the Python side to a single mmap).
+ *
  * Layout is fixed and must match shadow_tpu/host/shim_abi.py.
  */
 #ifndef SHADOWTPU_SHIM_IPC_H
@@ -27,7 +33,7 @@ typedef _Atomic uint64_t ipc_atomic_u64;
 #endif
 
 #define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
-#define SHIM_IPC_VERSION 1u
+#define SHIM_IPC_VERSION 2u
 
 /* Slot status values; the status word doubles as the futex word. */
 enum {
@@ -40,12 +46,14 @@ enum {
 enum {
     EV_NULL      = 0,
     /* shim -> shadow */
-    EV_START_REQ = 1,  /* process is up, waiting for clearance  */
-    EV_SYSCALL   = 2,  /* num + 6 args, please service          */
+    EV_START_REQ  = 1, /* thread is up, waiting for clearance       */
+    EV_SYSCALL    = 2, /* num + 6 args, please service              */
+    EV_CLONE_DONE = 3, /* num = new native tid, or -errno           */
     /* shadow -> shim */
-    EV_START_RES          = 16, /* run the app                  */
-    EV_SYSCALL_COMPLETE   = 17, /* num = return value           */
-    EV_SYSCALL_DO_NATIVE  = 18, /* execute natively, don't ask  */
+    EV_START_RES          = 16, /* run the app                      */
+    EV_SYSCALL_COMPLETE   = 17, /* num = return value               */
+    EV_SYSCALL_DO_NATIVE  = 18, /* execute natively, don't ask      */
+    EV_CLONE_RES          = 19, /* num = channel index for the child */
 };
 
 typedef struct {
@@ -61,6 +69,30 @@ typedef struct {
     shim_event_t   ev;
 } ipc_slot_t;              /* 72 bytes */
 
+/* Saved parent register state a cloned child restores before jumping
+ * back into application code (shim-side clone dance; the reference's
+ * equivalent lives in src/lib/shim/src/clone.rs).  Index order is
+ * baked into shim_trampoline.S. */
+enum {
+    CLONE_REG_RIP = 0,
+    CLONE_REG_RBX, CLONE_REG_RBP, CLONE_REG_R12, CLONE_REG_R13,
+    CLONE_REG_R14, CLONE_REG_R15, CLONE_REG_RDI, CLONE_REG_RSI,
+    CLONE_REG_RDX, CLONE_REG_RCX, CLONE_REG_R8,  CLONE_REG_R9,
+    CLONE_REG_R10, CLONE_REG_R11,
+    CLONE_NREGS
+};
+
+typedef struct {
+    ipc_slot_t to_shadow;
+    ipc_slot_t to_shim;
+    uint64_t   clone_regs[CLONE_NREGS]; /* written by the parent thread */
+    uint64_t   clone_chan_idx;          /* this channel's own index     */
+    uint8_t    _pad[320 - 2 * 72 - 8 * (CLONE_NREGS + 1)];
+} ipc_chan_t;               /* 320 bytes */
+
+#define IPC_N_CHANS    64
+#define IPC_CHANS_OFF  64   /* header padded to 64 bytes */
+
 typedef struct {
     uint32_t magic;
     uint32_t version;
@@ -72,30 +104,35 @@ typedef struct {
     ipc_atomic_u64 sim_time_ns;
     /* Deterministic bytes for AT_RANDOM-style needs (future use). */
     uint64_t auxv_random[2];
-    ipc_slot_t to_shadow;
-    ipc_slot_t to_shim;
+    uint8_t  _hdr_pad[IPC_CHANS_OFF - 32];
+    ipc_chan_t chans[IPC_N_CHANS];
 } shim_ipc_t;
 
-#define SHIM_IPC_FILE_SIZE 4096
+#define SHIM_IPC_FILE_SIZE 24576
 
 /* Simulated UNIX epoch at sim time 0: 2000-01-01 00:00:00 UTC
  * (must equal EMUTIME_SIMULATION_START in shadow_tpu/core/simtime.py). */
 #define SHIM_EMU_EPOCH_NS (946684800ull * 1000000000ull)
 
-#ifdef __cplusplus
-static_assert(sizeof(shim_event_t) == 64, "shim_event_t layout");
-static_assert(sizeof(ipc_slot_t) == 72, "ipc_slot_t layout");
-#else
-_Static_assert(sizeof(shim_event_t) == 64, "shim_event_t layout");
-_Static_assert(sizeof(ipc_slot_t) == 72, "ipc_slot_t layout");
-_Static_assert(sizeof(shim_ipc_t) <= SHIM_IPC_FILE_SIZE, "fits in file");
-#endif
-
 /* Offsets the Python side mirrors (checked by tests). */
 #define IPC_OFF_SIM_TIME   8
 #define IPC_OFF_AUXV       16
-#define IPC_OFF_TO_SHADOW  32
-#define IPC_OFF_TO_SHIM    (32 + 72)
+#define IPC_CHAN_STRIDE    320
+#define IPC_CHAN_TO_SHADOW 0
+#define IPC_CHAN_TO_SHIM   72
+#define IPC_CHAN_CLONE_REGS (2 * 72)
 #define IPC_SLOT_EV_OFF    8
+
+#ifdef __cplusplus
+static_assert(sizeof(shim_event_t) == 64, "shim_event_t layout");
+static_assert(sizeof(ipc_slot_t) == 72, "ipc_slot_t layout");
+static_assert(sizeof(ipc_chan_t) == IPC_CHAN_STRIDE, "ipc_chan_t layout");
+static_assert(sizeof(shim_ipc_t) <= SHIM_IPC_FILE_SIZE, "fits in file");
+#else
+_Static_assert(sizeof(shim_event_t) == 64, "shim_event_t layout");
+_Static_assert(sizeof(ipc_slot_t) == 72, "ipc_slot_t layout");
+_Static_assert(sizeof(ipc_chan_t) == IPC_CHAN_STRIDE, "ipc_chan_t layout");
+_Static_assert(sizeof(shim_ipc_t) <= SHIM_IPC_FILE_SIZE, "fits in file");
+#endif
 
 #endif /* SHADOWTPU_SHIM_IPC_H */

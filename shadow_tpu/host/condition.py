@@ -69,6 +69,54 @@ class SyscallCondition:
                                                   self._wakeup_fn))
 
 
+class ManualCondition:
+    """A condition fired explicitly by simulator code (plus an optional
+    timeout) — the shape futex waits need: there is no file whose status
+    changes, just another thread's FUTEX_WAKE (ref: the futex trigger
+    arm of syscall_condition.c:48).  Same arm/disarm/timed_out interface
+    as SyscallCondition."""
+
+    __slots__ = ("_timeout_at", "_armed", "_wakeup_fn", "timed_out",
+                 "on_disarm")
+
+    def __init__(self, timeout_at: int | None = None):
+        self._timeout_at = timeout_at
+        self._armed = False
+        self._wakeup_fn = None
+        self.timed_out = False
+        self.on_disarm = None  # cleanup hook (e.g. drop the futex waiter)
+
+    def arm(self, host, wakeup_fn) -> None:
+        assert not self._armed
+        self._armed = True
+        self._wakeup_fn = wakeup_fn
+        if self._timeout_at is not None:
+            host.schedule_task_at(self._timeout_at,
+                                  TaskRef("condition-timeout",
+                                          self._on_timeout))
+
+    def disarm(self) -> None:
+        self._armed = False
+        if self.on_disarm is not None:
+            hook, self.on_disarm = self.on_disarm, None
+            hook()
+
+    def fire(self, host) -> None:
+        """External trigger (e.g. FUTEX_WAKE)."""
+        if self._armed:
+            self._fire(host, timed_out=False)
+
+    def _on_timeout(self, host) -> None:
+        if self._armed and host.now() >= self._timeout_at:
+            self._fire(host, timed_out=True)
+
+    def _fire(self, host, timed_out: bool) -> None:
+        self.disarm()
+        self.timed_out = timed_out
+        host.schedule_task_at(host.now(), TaskRef("syscall-wakeup",
+                                                  self._wakeup_fn))
+
+
 class MultiSyscallCondition:
     """poll/select/epoll-style condition: wake when ANY of several files
     gains a watched status bit, or on timeout — the many-trigger shape

@@ -28,8 +28,10 @@ import signal
 import time as _walltime
 
 from shadow_tpu.core.event import TaskRef
+from shadow_tpu.host.futex import FutexTable
 from shadow_tpu.host.process import Process, ST_BLOCKED, ST_EXITED, ST_RUNNABLE
 from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
+                                      EV_CLONE_DONE, EV_CLONE_RES,
                                       EV_START_REQ, EV_START_RES, EV_SYSCALL,
                                       EV_SYSCALL_COMPLETE,
                                       EV_SYSCALL_DO_NATIVE)
@@ -105,8 +107,13 @@ class ManagedProcess(Process):
         self.work_dir = work_dir or "."
         self.native_pid: int | None = None
         self.mem: MemoryManager | None = None
+        self.ipc_block: IpcBlock | None = None
+        self.futex_table = FutexTable()
         self._stdout_path: str | None = None
         self._stderr_path: str | None = None
+
+    def live_managed_threads(self) -> int:
+        return sum(1 for t in self.threads if t.state != ST_EXITED)
 
     def start_native(self, host, exe_path: str | None = None) -> None:
         exe = exe_path or (self.argv[0] if self.argv else None)
@@ -165,7 +172,8 @@ class ManagedProcess(Process):
             self.exit_code = 127
             return
         self.mem = MemoryManager(self.native_pid)
-        thread = ManagedThread(self, ipc, self._next_tid)
+        self.ipc_block = ipc
+        thread = ManagedThread(self, ipc, ipc.channel(0), self._next_tid)
         self._next_tid += 1
         self.threads.append(thread)
         thread.resume(host)
@@ -199,12 +207,16 @@ class ManagedThread:
     """Drives one native thread over its IPC channel
     (managed_thread.rs:190-333)."""
 
-    def __init__(self, process: ManagedProcess, ipc: IpcBlock, tid: int):
+    def __init__(self, process: ManagedProcess, block: IpcBlock, chan,
+                 tid: int):
         self.process = process
-        self.ipc = ipc
+        self.block = block
+        self.chan = chan
         self.tid = tid
         self.state = ST_RUNNABLE
         self.native_tid: int | None = None
+        self.ctid_addr: int | None = None  # CLONE_CHILD_CLEARTID / set_tid_address
+        self.futex_waiter = None           # outcome carrier for FUTEX_WAIT restarts
         self._released = False
         self._pending_response = None  # (kind, value) to send on re-entry
         self._pending_call = None      # (num, args) to re-dispatch
@@ -222,7 +234,7 @@ class ManagedThread:
         """Next shim event, or None if the child died."""
         while True:
             try:
-                return self.ipc.recv_from_shim(timeout_ns=_DEATH_POLL_NS)
+                return self.chan.recv_from_shim(timeout_ns=_DEATH_POLL_NS)
             except ChannelTimeout:
                 if self._poll_death(host):
                     return None
@@ -252,7 +264,7 @@ class ManagedThread:
         if self.state == ST_EXITED:
             return
         self.state = ST_RUNNABLE
-        self.ipc.set_sim_time(host.now())
+        self.block.set_sim_time(host.now())
 
         if not self._released:
             ev = self._recv(host)
@@ -263,13 +275,13 @@ class ManagedThread:
                 self._protocol_error(host, f"expected StartReq, got {kind}")
                 return
             self.native_tid = int(num)
-            self.ipc.send_to_shim(EV_START_RES)
+            self.chan.send_to_shim(EV_START_RES)
             self._released = True
 
         if self._pending_response is not None:
             kind, value = self._pending_response
             self._pending_response = None
-            self.ipc.send_to_shim(kind, value)
+            self.chan.send_to_shim(kind, value)
 
         if self._pending_call is not None:
             num, args = self._pending_call
@@ -309,10 +321,35 @@ class ManagedThread:
             condition.arm(host, self._wakeup)
             return False
 
+        if kind == "clone":
+            return self._do_clone(host, result[1], result[2])
+
+        if kind == "thread_exit":
+            # A secondary thread exiting (SYS_exit with siblings alive):
+            # let the native thread die, then emulate the kernel's
+            # CLONE_CHILD_CLEARTID contract against OUR futex table so a
+            # pthread_join blocked in the emulated FUTEX_WAIT wakes.
+            code = result[1]
+            self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
+            self._await_native_thread_gone()
+            self.state = ST_EXITED
+            if self.last_condition is not None:
+                self.last_condition.disarm()
+                self.last_condition = None
+            self.block.free_channel(self.chan.index)
+            if self.ctid_addr:
+                # The kernel already wrote 0 (we waited for thread
+                # teardown above); deliver the wake to emulated waiters.
+                self.process.futex_table.wake(host, self.ctid_addr, 1)
+            # Record the exit code (a crashed helper thread must not be
+            # masked by a clean main thread — process.py invariant).
+            self.process.thread_exited(host, self, code)
+            return False
+
         if kind == "exit":
             # Short-circuit (managed_thread.rs:268-282): let the native
             # exit_group run, then reap synchronously.
-            self.ipc.send_to_shim(EV_SYSCALL_DO_NATIVE)
+            self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
             deadline = _walltime.monotonic() + 10.0
             while _walltime.monotonic() < deadline:
                 if self._poll_death(host):
@@ -342,8 +379,69 @@ class ManagedThread:
                                   TaskRef("cpu-latency", self.resume))
             return False
 
-        self.ipc.send_to_shim(rv_kind, rv_val)
+        self.chan.send_to_shim(rv_kind, rv_val)
         return True
+
+    # -- clone protocol (managed_thread.rs:359 native_clone) ----------
+
+    def _do_clone(self, host, flags: int, ctid: int) -> bool:
+        """Three-way handshake: hand the shim a channel index, let it
+        run the real clone (child parks immediately), register the new
+        ManagedThread, and schedule its start through the event queue so
+        thread birth is a deterministic simulation event."""
+        idx = self.block.alloc_channel()
+        if idx is None:
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -11)  # EAGAIN
+            return True
+        self.chan.send_to_shim(EV_CLONE_RES, idx)
+        ev = self._recv(host)
+        if ev is None:
+            return False
+        kind, child_tid, _args = ev
+        if kind != EV_CLONE_DONE:
+            self._protocol_error(host, f"expected CloneDone, got {kind}")
+            return False
+        child_tid = int(child_tid)
+        if child_tid < 0:
+            self.block.free_channel(idx)
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, child_tid)
+            return True
+        process = self.process
+        child = ManagedThread(process, self.block, self.block.channel(idx),
+                              process._next_tid)
+        process._next_tid += 1
+        child.native_tid = child_tid
+        _CLONE_CHILD_CLEARTID = 0x200000
+        if flags & _CLONE_CHILD_CLEARTID:
+            child.ctid_addr = ctid
+        process.threads.append(child)
+        host.schedule_task_at(host.now(), TaskRef("thread-start",
+                                                  child.resume))
+        self.chan.send_to_shim(EV_SYSCALL_COMPLETE, child_tid)
+        return True
+
+    def _await_native_thread_gone(self) -> None:
+        """Busy-poll until the kernel has fully torn the thread down —
+        only then has CLONE_CHILD_CLEARTID been honored and the thread
+        stack gone quiescent (a joiner may free it the moment it sees
+        tid==0).  The thread-group leader's /proc task entry persists as
+        a zombie until the whole process exits, so accept state Z/X
+        there, not just disappearance."""
+        path = (f"/proc/{self.process.native_pid}/task/"
+                f"{self.native_tid}/stat")
+        deadline = _walltime.monotonic() + 5.0
+        while _walltime.monotonic() < deadline:
+            try:
+                with open(path) as f:
+                    stat = f.read()
+            except OSError:
+                return  # task entry gone
+            # State is the field after the parenthesized comm.
+            state = stat.rpartition(")")[2].lstrip()[:1]
+            if state in ("Z", "X", ""):
+                return
+            _walltime.sleep(0.0002)
+        # Degraded but not fatal: proceed; the joiner may spin longer.
 
     def _wakeup(self, host) -> None:
         if self.state == ST_BLOCKED:
@@ -359,22 +457,27 @@ class ManagedThread:
         self._poll_death(host, blocking=True)
 
     def _finish(self, host, code: int) -> None:
+        """The native *process* is gone (waitpid reaped it): every
+        thread is dead, not just this one."""
         if self.state == ST_EXITED:
             return
-        self.state = ST_EXITED
-        if self.last_condition is not None:
-            self.last_condition.disarm()
-            self.last_condition = None
-        self.teardown()
         process = self.process
+        for t in process.threads:
+            if isinstance(t, ManagedThread) and t.state != ST_EXITED:
+                t.state = ST_EXITED
+                if t.last_condition is not None:
+                    t.last_condition.disarm()
+                    t.last_condition = None
+        self.teardown()
         if process.mem is not None:
             process.mem.close()
         process.collect_output()
         process.thread_exited(host, self, code)
 
     def teardown(self) -> None:
-        self.ipc.mark_closed()
-        self.ipc.close()
+        """Close the whole process's IPC block (idempotent)."""
+        self.block.mark_closed()
+        self.block.close()
 
     # Process.thread_exited checks thread.state via the same constants;
     # the generator-thread interface ends here.

@@ -1,11 +1,16 @@
 """Manager-side view of the shim IPC block (mirror of native/shim_ipc.h).
 
-The manager maps the same 4 KiB file the shim maps and speaks the futex
-SPSC protocol directly from Python via `ctypes` — x86-64's total store
-order plus CPython's sequential execution give the release/acquire
-semantics the two-word protocol needs, and the per-message futex
-syscalls dominate the cost anyway.  (Ref: the simulator side of
+The manager maps the same file the shim maps and speaks the futex SPSC
+protocol directly from Python via `ctypes` — x86-64's total store order
+plus CPython's sequential execution give the release/acquire semantics
+the two-word protocol needs, and the per-message futex syscalls dominate
+the cost anyway.  (Ref: the simulator side of
 src/lib/shadow-shim-helper-rs/src/ipc.rs.)
+
+One block carries IPC_N_CHANS channel pairs: channel 0 is the process's
+main thread, the rest are allocated as the process clones threads (the
+reference shmallocs a fresh IPCData per ManagedThread,
+managed_thread.rs:113).
 """
 
 from __future__ import annotations
@@ -18,8 +23,14 @@ import struct
 
 # --- constants mirrored from native/shim_ipc.h ---------------------
 MAGIC = 0x53545055
-VERSION = 1
-FILE_SIZE = 4096
+VERSION = 2
+FILE_SIZE = 24576
+
+N_CHANS = 64
+CHANS_OFF = 64
+CHAN_STRIDE = 320
+CHAN_TO_SHADOW = 0
+CHAN_TO_SHIM = 72
 
 SLOT_EMPTY = 0
 SLOT_READY = 1
@@ -28,16 +39,16 @@ SLOT_CLOSED = 2
 EV_NULL = 0
 EV_START_REQ = 1
 EV_SYSCALL = 2
+EV_CLONE_DONE = 3
 EV_START_RES = 16
 EV_SYSCALL_COMPLETE = 17
 EV_SYSCALL_DO_NATIVE = 18
+EV_CLONE_RES = 19
 
 OFF_MAGIC = 0
 OFF_VERSION = 4
 OFF_SIM_TIME = 8
 OFF_AUXV = 16
-OFF_TO_SHADOW = 32
-OFF_TO_SHIM = 32 + 72
 SLOT_EV_OFF = 8
 EV_STRUCT = struct.Struct("<II7q")  # kind, pad, num, args[6]
 
@@ -77,8 +88,66 @@ class ChannelTimeout(Exception):
     """recv timed out (used to poll for child death)."""
 
 
+class Channel:
+    """One thread's request/response slot pair inside an IpcBlock."""
+
+    __slots__ = ("block", "index", "_to_shadow", "_to_shim")
+
+    def __init__(self, block: "IpcBlock", index: int):
+        self.block = block
+        self.index = index
+        base = CHANS_OFF + index * CHAN_STRIDE
+        self._to_shadow = base + CHAN_TO_SHADOW
+        self._to_shim = base + CHAN_TO_SHIM
+
+    def send_to_shim(self, kind: int, num: int = 0,
+                     args: tuple = (0, 0, 0, 0, 0, 0)) -> None:
+        blk = self.block
+        off = self._to_shim
+        # Slot must be EMPTY per the alternating protocol.
+        EV_STRUCT.pack_into(blk._mm, off + SLOT_EV_OFF, kind, 0, num, *args)
+        blk._store_u32(off, SLOT_READY)
+        _futex_wake(blk._addr + off)
+
+    def recv_from_shim(self, timeout_ns: int | None = None):
+        """Block until the shim publishes an event; returns (kind, num,
+        args).  Raises ChannelTimeout after `timeout_ns` so the caller
+        can check for child death, ChannelClosed on CLOSED."""
+        blk = self.block
+        off = self._to_shadow
+        while True:
+            st = blk._load_u32(off)
+            if st == SLOT_READY:
+                kind, _pad, num, *args = EV_STRUCT.unpack_from(
+                    blk._mm, off + SLOT_EV_OFF)
+                blk._store_u32(off, SLOT_EMPTY)
+                _futex_wake(blk._addr + off)
+                return kind, num, args
+            if st == SLOT_CLOSED:
+                raise ChannelClosed
+            r = _futex_wait(blk._addr + off, st, timeout_ns)
+            if r != 0:
+                err = ctypes.get_errno()
+                import errno as _e
+                if err == _e.ETIMEDOUT and timeout_ns is not None:
+                    # Re-check once: the word may have flipped between
+                    # the timeout and now.
+                    if blk._load_u32(off) not in (SLOT_READY, SLOT_CLOSED):
+                        raise ChannelTimeout
+                # EAGAIN (value changed) / EINTR: loop and re-check.
+
+    def mark_closed(self) -> None:
+        """Wake the shim thread with CLOSED on both slots."""
+        blk = self.block
+        if blk.closed:
+            return
+        for off in (self._to_shadow, self._to_shim):
+            blk._store_u32(off, SLOT_CLOSED)
+            _futex_wake(blk._addr + off)
+
+
 class IpcBlock:
-    """One managed thread's IPC block, backed by a /dev/shm file."""
+    """One managed process's IPC block, backed by a /dev/shm file."""
 
     def __init__(self, path: str):
         self.path = path
@@ -92,6 +161,22 @@ class IpcBlock:
             ctypes.c_char.from_buffer(self._mm))
         struct.pack_into("<II", self._mm, 0, MAGIC, VERSION)
         self.closed = False
+        self._chan_used = [False] * N_CHANS
+        self._chan_used[0] = True  # main thread
+
+    def channel(self, index: int) -> Channel:
+        return Channel(self, index)
+
+    def alloc_channel(self) -> int | None:
+        """Reserve a channel index for a newly cloned thread."""
+        for i, used in enumerate(self._chan_used):
+            if not used:
+                self._chan_used[i] = True
+                return i
+        return None
+
+    def free_channel(self, index: int) -> None:
+        self._chan_used[index] = False
 
     # -- raw words --------------------------------------------------
 
@@ -107,49 +192,12 @@ class IpcBlock:
     def set_auxv_random(self, lo: int, hi: int) -> None:
         struct.pack_into("<QQ", self._mm, OFF_AUXV, lo, hi)
 
-    # -- channel ops ------------------------------------------------
-
-    def send_to_shim(self, kind: int, num: int = 0,
-                     args: tuple = (0, 0, 0, 0, 0, 0)) -> None:
-        off = OFF_TO_SHIM
-        # Slot must be EMPTY per the alternating protocol.
-        EV_STRUCT.pack_into(self._mm, off + SLOT_EV_OFF, kind, 0, num,
-                            *args)
-        self._store_u32(off, SLOT_READY)
-        _futex_wake(self._addr + off)
-
-    def recv_from_shim(self, timeout_ns: int | None = None):
-        """Block until the shim publishes an event; returns (kind, num,
-        args).  Raises ChannelTimeout after `timeout_ns` so the caller
-        can check for child death, ChannelClosed on CLOSED."""
-        off = OFF_TO_SHADOW
-        while True:
-            st = self._load_u32(off)
-            if st == SLOT_READY:
-                kind, _pad, num, *args = EV_STRUCT.unpack_from(
-                    self._mm, off + SLOT_EV_OFF)
-                self._store_u32(off, SLOT_EMPTY)
-                _futex_wake(self._addr + off)
-                return kind, num, args
-            if st == SLOT_CLOSED:
-                raise ChannelClosed
-            r = _futex_wait(self._addr + off, st, timeout_ns)
-            if r != 0:
-                err = ctypes.get_errno()
-                import errno as _e
-                if err == _e.ETIMEDOUT and timeout_ns is not None:
-                    # Re-check once: the word may have flipped between
-                    # the timeout and now.
-                    if self._load_u32(off) not in (SLOT_READY,
-                                                   SLOT_CLOSED):
-                        raise ChannelTimeout
-                # EAGAIN (value changed) / EINTR: loop and re-check.
+    # -- teardown ---------------------------------------------------
 
     def mark_closed(self) -> None:
-        """Tear down: wake the shim with CLOSED on both slots."""
-        for off in (OFF_TO_SHADOW, OFF_TO_SHIM):
-            self._store_u32(off, SLOT_CLOSED)
-            _futex_wake(self._addr + off)
+        """Tear down: wake every thread with CLOSED on every slot."""
+        for i in range(N_CHANS):
+            self.channel(i).mark_closed()
 
     def close(self) -> None:
         if self.closed:

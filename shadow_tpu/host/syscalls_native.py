@@ -1120,11 +1120,28 @@ class NativeSyscallHandler:
     def sys_seccomp(self, host, process, thread, restarted, *_):
         return _error(errno.EPERM)  # one filter is enough
 
-    def sys_clone(self, host, process, thread, restarted, *_):
-        return _error(errno.ENOSYS)  # managed threads: future round
+    # -- threads (clone/futex; ref handler/clone.rs, futex.rs) ---------
+
+    _CLONE_VM = 0x100
+    _CLONE_SETTLS = 0x80000
+    _CLONE_THREAD = 0x10000
+    _CLONE_CHILD_CLEARTID = 0x200000
+
+    def sys_clone(self, host, process, thread, restarted, flags, stack,
+                  ptid, ctid, tls, *_):
+        """Thread-creation clone: the ManagedThread runs the three-way
+        channel handshake (managed.py _do_clone); fork-style clones are
+        unsupported (the reference emulates full fork; future round).
+        CLONE_SETTLS is required: the shim's per-thread channel pointer
+        lives in fs-relative TLS, so a child sharing the parent's fs
+        base would clobber the parent's channel binding."""
+        if (flags & self._CLONE_THREAD) and (flags & self._CLONE_VM) \
+                and (flags & self._CLONE_SETTLS):
+            return ("clone", flags, ctid)
+        return _error(errno.ENOSYS)
 
     def sys_clone3(self, host, process, thread, restarted, *_):
-        return _error(errno.ENOSYS)
+        return _error(errno.ENOSYS)  # glibc falls back to clone
 
     def sys_fork(self, host, process, thread, restarted, *_):
         return _error(errno.ENOSYS)
@@ -1135,6 +1152,105 @@ class NativeSyscallHandler:
     def sys_execve(self, host, process, thread, restarted, *_):
         return _error(errno.ENOSYS)
 
+    def sys_set_tid_address(self, host, process, thread, restarted, addr,
+                            *_):
+        thread.ctid_addr = addr
+        return _done(thread.native_tid or thread.tid)
+
+    def sys_set_robust_list(self, host, process, thread, restarted, *_):
+        # Robust-mutex recovery after thread death is out of scope; the
+        # kernel-side list walk never happens for emulated futexes anyway.
+        return _done(0)
+
+    def sys_get_robust_list(self, host, process, thread, restarted, *_):
+        return _error(errno.ENOSYS)
+
+    def sys_rseq(self, host, process, thread, restarted, *_):
+        return _error(errno.ENOSYS)  # glibc degrades gracefully
+
+    # futex ops (uapi/linux/futex.h)
+    _FUTEX_WAIT = 0
+    _FUTEX_WAKE = 1
+    _FUTEX_REQUEUE = 3
+    _FUTEX_CMP_REQUEUE = 4
+    _FUTEX_WAKE_OP = 5
+    _FUTEX_WAIT_BITSET = 9
+    _FUTEX_WAKE_BITSET = 10
+    _FUTEX_PRIVATE = 128
+    _FUTEX_CLOCK_REALTIME = 256
+
+    def sys_futex(self, host, process, thread, restarted, addr, op, val,
+                  timeout_or_val2, addr2, val3):
+        """Emulated futexes (ref: futex_table.rs, futex.c, and the futex
+        trigger of syscall_condition.c).  Every waiter parks on the
+        simulated timeline; wakes come from sibling threads' emulated
+        FUTEX_WAKE — never from the native kernel, whose futex queue the
+        managed threads bypass entirely."""
+        from shadow_tpu.host.condition import ManualCondition
+
+        cmd = op & ~(self._FUTEX_PRIVATE | self._FUTEX_CLOCK_REALTIME)
+        table = process.futex_table
+
+        if cmd in (self._FUTEX_WAIT, self._FUTEX_WAIT_BITSET):
+            if restarted:
+                waiter, thread.futex_waiter = thread.futex_waiter, None
+                if waiter is not None and waiter.woken:
+                    return _done(0)
+                if (thread.last_condition is not None
+                        and thread.last_condition.timed_out):
+                    return _error(errno.ETIMEDOUT)
+                return _done(0)  # spurious wake: apps must re-check anyway
+            val &= 0xFFFFFFFF
+            cur = process.mem.try_read(addr, 4)
+            if cur is None:
+                return _error(errno.EFAULT)
+            if int.from_bytes(cur, "little") != val:
+                return _error(errno.EAGAIN)
+            timeout_at = None
+            if timeout_or_val2:
+                ts = process.mem.try_read(timeout_or_val2, 16)
+                if ts is None:
+                    return _error(errno.EFAULT)
+                sec, nsec = struct.unpack("<qq", ts)
+                t = sec * 1_000_000_000 + nsec
+                if cmd == self._FUTEX_WAIT:
+                    timeout_at = host.now() + t  # relative
+                else:
+                    # WAIT_BITSET: absolute, in the flagged clock.
+                    if op & self._FUTEX_CLOCK_REALTIME:
+                        t -= simtime.EMUTIME_SIMULATION_START
+                    timeout_at = max(t, host.now())
+            bitset = (val3 & 0xFFFFFFFF) \
+                if cmd == self._FUTEX_WAIT_BITSET else 0xFFFFFFFF
+            if bitset == 0:
+                return _error(errno.EINVAL)
+            cond = ManualCondition(timeout_at=timeout_at)
+            thread.futex_waiter = table.add_waiter(addr, cond, bitset)
+            return ("block", cond)
+
+        if cmd in (self._FUTEX_WAKE, self._FUTEX_WAKE_BITSET):
+            bitset = (val3 & 0xFFFFFFFF) \
+                if cmd == self._FUTEX_WAKE_BITSET else 0xFFFFFFFF
+            if bitset == 0:
+                return _error(errno.EINVAL)
+            return _done(table.wake(host, addr, _sext32(val), bitset))
+
+        if cmd in (self._FUTEX_REQUEUE, self._FUTEX_CMP_REQUEUE):
+            if cmd == self._FUTEX_CMP_REQUEUE:
+                cur = process.mem.try_read(addr, 4)
+                if cur is None:
+                    return _error(errno.EFAULT)
+                if int.from_bytes(cur, "little") != (val3 & 0xFFFFFFFF):
+                    return _error(errno.EAGAIN)
+            woken, moved = table.requeue(host, addr, _sext32(val),
+                                         _sext32(timeout_or_val2), addr2)
+            if cmd == self._FUTEX_CMP_REQUEUE:
+                return _done(woken + moved)
+            return _done(woken)
+
+        # PI / WAKE_OP and friends: no in-tree consumer yet.
+        return _error(errno.ENOSYS)
+
     def sys_wait4(self, host, process, thread, restarted, *_):
         return _error(errno.ECHILD)
 
@@ -1142,6 +1258,10 @@ class NativeSyscallHandler:
         return _error(errno.ECHILD)
 
     def sys_exit(self, host, process, thread, restarted, code, *_):
+        from shadow_tpu.host.managed import ManagedProcess
+        if isinstance(process, ManagedProcess) \
+                and process.live_managed_threads() > 1:
+            return ("thread_exit", code & 0xff)
         return ("exit", code & 0xff)
 
     def sys_exit_group(self, host, process, thread, restarted, code, *_):

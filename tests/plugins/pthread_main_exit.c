@@ -1,0 +1,23 @@
+/* main() pthread_exits while a worker still runs: the thread-group
+ * leader becomes a zombie (its /proc task entry persists), and the
+ * process must keep running until the worker finishes.  Covers the
+ * leader-exit branch of the managed thread_exit path. */
+#include <pthread.h>
+#include <stdio.h>
+#include <time.h>
+
+static void *worker(void *arg) {
+    (void)arg;
+    struct timespec req = {0, 500000000};
+    nanosleep(&req, NULL);
+    printf("worker done\n");
+    fflush(stdout);
+    return NULL;
+}
+
+int main(void) {
+    pthread_t t;
+    if (pthread_create(&t, NULL, worker, NULL) != 0)
+        return 2;
+    pthread_exit(NULL);  /* leader exits first; process survives */
+}

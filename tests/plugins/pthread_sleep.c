@@ -1,0 +1,33 @@
+/* Eight threads each nanosleep one second.  Under the simulator the
+ * sleeps are emulated timeouts on the event queue, so the threads sleep
+ * CONCURRENTLY in simulated time: total elapsed must be ~1s, not ~8s.
+ * Run natively this also holds (kernel parallel sleep) — the dual-
+ * target assertion is the same, which is the point of the pattern
+ * (ref: src/test/sleep). */
+#include <pthread.h>
+#include <stdio.h>
+#include <time.h>
+
+#define NTHREADS 8
+
+static void *worker(void *arg) {
+    (void)arg;
+    struct timespec req = {1, 0};
+    nanosleep(&req, NULL);
+    return NULL;
+}
+
+int main(void) {
+    struct timespec a, b;
+    clock_gettime(CLOCK_MONOTONIC, &a);
+    pthread_t t[NTHREADS];
+    for (long i = 0; i < NTHREADS; i++)
+        if (pthread_create(&t[i], NULL, worker, (void *)i) != 0)
+            return 2;
+    for (int i = 0; i < NTHREADS; i++)
+        pthread_join(t[i], NULL);
+    clock_gettime(CLOCK_MONOTONIC, &b);
+    long ms = (b.tv_sec - a.tv_sec) * 1000 + (b.tv_nsec - a.tv_nsec) / 1000000;
+    printf("elapsed_ms=%ld\n", ms);
+    return (ms >= 1000 && ms < 3000) ? 0 : 1;
+}

@@ -1,0 +1,54 @@
+/* Multithreaded managed-process plugin: pthread_create/join, mutex,
+ * condvar turn-taking.  Exercises the clone channel handshake, emulated
+ * futex WAIT/WAKE (mutex + condvar + join's CLEARTID wait), and
+ * deterministic thread start ordering.  Output is fully deterministic:
+ * the condvar turn variable forces id order. */
+#include <pthread.h>
+#include <stdio.h>
+
+#define NTHREADS 4
+#define ITERS 1000
+
+static pthread_mutex_t lock = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t cond = PTHREAD_COND_INITIALIZER;
+static long counter = 0;
+static long turn = 0;
+
+static void *worker(void *arg) {
+    long id = (long)arg;
+    for (int i = 0; i < ITERS; i++) {
+        pthread_mutex_lock(&lock);
+        counter++;
+        pthread_mutex_unlock(&lock);
+    }
+    pthread_mutex_lock(&lock);
+    while (turn != id)
+        pthread_cond_wait(&cond, &lock);
+    printf("thread %ld done\n", id);
+    fflush(stdout);
+    turn++;
+    pthread_cond_broadcast(&cond);
+    pthread_mutex_unlock(&lock);
+    return (void *)(id * 10);
+}
+
+int main(void) {
+    pthread_t t[NTHREADS];
+    for (long i = 0; i < NTHREADS; i++) {
+        if (pthread_create(&t[i], NULL, worker, (void *)i) != 0) {
+            perror("pthread_create");
+            return 2;
+        }
+    }
+    long sum = 0;
+    for (int i = 0; i < NTHREADS; i++) {
+        void *rv;
+        if (pthread_join(t[i], &rv) != 0)
+            return 4;
+        sum += (long)rv;
+    }
+    printf("counter=%ld sum=%ld\n", counter, sum);
+    if (counter != (long)NTHREADS * ITERS || sum != 60)
+        return 3;
+    return 0;
+}

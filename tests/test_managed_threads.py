@@ -1,0 +1,93 @@
+"""Multithreaded managed (real-binary) processes.
+
+Exercises the clone dance (per-thread IPC channels, shim trampoline,
+deterministic thread start via the event queue), emulated futexes
+(mutex, condvar, pthread_join's CLEARTID wait), and concurrent
+simulated-time sleeps across threads.  Dual-target where meaningful
+(ref pattern: src/test/CMakeLists.txt:33-140; thread runtime smoke
+tests like src/test/golang mirror this shape).
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from tests.test_managed_process import run_one_host
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+
+def _have_toolchain():
+    return shutil.which("cc") is not None
+
+
+pytestmark = pytest.mark.skipif(not _have_toolchain(),
+                                reason="no C toolchain")
+
+
+@pytest.fixture(scope="module")
+def plugin(tmp_path_factory):
+    out_dir = tmp_path_factory.mktemp("plugins")
+
+    def build(name: str) -> str:
+        src = os.path.join(PLUGIN_DIR, name + ".c")
+        out = os.path.join(out_dir, name)
+        subprocess.run(["cc", "-O1", "-pthread", "-o", out, src],
+                       check=True)
+        return out
+
+    return build
+
+
+def test_pthread_mutex_condvar_join(plugin):
+    exe = plugin("pthread_threads")
+    # Dual target: the binary asserts its own invariants natively too.
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stderr
+
+    _m, summary, proc = run_one_host(exe, stop="30s")
+    assert summary.ok, summary.plugin_errors
+    out = bytes(proc.stdout).decode()
+    # Condvar turn-taking forces deterministic thread order.
+    assert out == ("thread 0 done\nthread 1 done\nthread 2 done\n"
+                   "thread 3 done\ncounter=4000 sum=60\n"), out
+    assert proc.exit_code == 0
+
+
+def test_pthread_sleeps_run_concurrently_in_sim_time(plugin):
+    exe = plugin("pthread_sleep")
+    _m, summary, proc = run_one_host(exe, stop="30s")
+    assert summary.ok, summary.plugin_errors
+    out = bytes(proc.stdout).decode()
+    assert out.startswith("elapsed_ms="), out
+    elapsed = int(out.strip().split("=")[1])
+    # 8 threads x 1s sleep, concurrent in simulated time: ~1s total.
+    assert 1000 <= elapsed < 3000, out
+    assert proc.exit_code == 0
+
+
+def test_main_thread_exits_before_workers(plugin):
+    """The thread-group leader pthread_exits first; its /proc task entry
+    lingers as a zombie, which must not stall or kill the process."""
+    import time
+    exe = plugin("pthread_main_exit")
+    t0 = time.perf_counter()
+    _m, summary, proc = run_one_host(exe, stop="30s")
+    wall = time.perf_counter() - t0
+    assert summary.ok, summary.plugin_errors
+    assert bytes(proc.stdout).decode() == "worker done\n"
+    assert proc.exit_code == 0
+    # The leader-zombie wait must detect state Z, not spin its 5s cap.
+    assert wall < 4.0, f"leader teardown stalled ({wall:.1f}s)"
+
+
+def test_pthread_output_deterministic_across_runs(plugin):
+    exe = plugin("pthread_threads")
+    outs = []
+    for _ in range(2):
+        _m, summary, proc = run_one_host(exe, stop="30s")
+        assert summary.ok
+        outs.append(bytes(proc.stdout).decode())
+    assert outs[0] == outs[1]
