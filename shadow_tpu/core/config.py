@@ -90,6 +90,12 @@ class ExperimentalConfig:
     # Below this, propagation always runs the numpy host path; above,
     # the online cost model measures host vs device and routes.
     tpu_min_device_batch: int = 2048
+    # Pin worker threads to distinct CPUs (ref: affinity.c, on by
+    # default; docs/parallel_sims.md reports ~3x cost when off).
+    use_cpu_pinning: bool = True
+    # perf_timers cargo-feature equivalent: per-host execution wall time
+    # in sim-stats.json (ref: utility/perf_timer.rs).
+    use_perf_timers: bool = False
     report_errors_to_stderr: bool = True
 
 
@@ -162,6 +168,8 @@ class ConfigOptions:
                  units.parse_time_ns),
                 ("tpu_max_packets_per_round", "tpu_max_packets_per_round", int),
                 ("tpu_min_device_batch", "tpu_min_device_batch", int),
+                ("use_cpu_pinning", "use_cpu_pinning", bool),
+                ("use_perf_timers", "use_perf_timers", bool),
                 ("report_errors_to_stderr", "report_errors_to_stderr", bool)):
             if yaml_key in e:
                 setattr(experimental, attr, conv(e[yaml_key]))

@@ -157,10 +157,22 @@ class Manager:
         for host in self.hosts:
             host._send_packet_fn = self.propagator.send
 
+        self._perf_timers = config.experimental.use_perf_timers
+        if self._perf_timers and threaded:
+            # Per-host timing is only meaningful serially (threads share
+            # the GIL); don't build a pool that would sit idle.
+            import sys as _sys
+            print("[shadow-tpu] use_perf_timers forces serial host "
+                  "execution; parallelism ignored", file=_sys.stderr)
+            threaded = False
         if threaded:
             workers = config.general.parallelism or os.cpu_count() or 1
-            self._pool = ThreadPoolExecutor(
-                max_workers=min(workers, len(self.hosts)))
+            n_workers = min(workers, len(self.hosts))
+            initializer = None
+            if config.experimental.use_cpu_pinning:
+                initializer = _make_pinner()
+            self._pool = ThreadPoolExecutor(max_workers=n_workers,
+                                            initializer=initializer)
         else:
             self._pool = None
 
@@ -238,6 +250,15 @@ class Manager:
         return best
 
     def _run_hosts(self, until: int) -> None:
+        if self._perf_timers:
+            # perf_timers feature (perf_timer.rs; host.rs:680-688): time
+            # each host's event execution.  Serial-only measurement keeps
+            # the numbers meaningful (threads share the GIL).
+            for h in self.hosts:
+                t0 = time.perf_counter_ns()
+                h.execute(until)
+                h.perf_exec_ns += time.perf_counter_ns() - t0
+            return
         if self._pool is None:
             for h in self.hosts:
                 h.execute(until)
@@ -266,6 +287,15 @@ class Manager:
         heartbeat = self.config.general.heartbeat_interval_ns
         next_heartbeat = heartbeat
         wall_start = time.perf_counter()
+        status = None
+        heartbeat_lines = progress
+        if progress:
+            from shadow_tpu.utils.status_bar import StatusBar, make_status
+            status = make_status(stop)
+            # A \r-redrawing bar and newline heartbeats garble each other
+            # on one TTY; the bar subsumes the heartbeat there.
+            heartbeat_lines = not isinstance(status, StatusBar)
+        next_status_wall = 0.0
         summary = SimSummary()
         start = self._min_next_event()
         while start is not None and start < stop:
@@ -274,14 +304,21 @@ class Manager:
             self._run_hosts(window_end)
             inflight_min = self.propagator.finish_round()
             summary.rounds += 1
-            if progress and window_end >= next_heartbeat:
+            if heartbeat_lines and window_end >= next_heartbeat:
                 self._log_heartbeat(window_end, stop, wall_start, sys.stderr)
                 next_heartbeat = window_end + heartbeat
+            if status is not None:
+                wall = time.perf_counter()
+                if wall >= next_status_wall:  # throttle redraws
+                    status.update(window_end)
+                    next_status_wall = wall + 0.2
             nxt = self._min_next_event()
             if inflight_min is not None and (nxt is None or inflight_min < nxt):
                 nxt = inflight_min
             start = nxt
         summary.end_time_ns = min(start, stop) if start is not None else stop
+        if status is not None:
+            status.finish(summary.end_time_ns)
 
         # Final accounting (manager.rs:546-569).
         for h in self.hosts:
@@ -362,6 +399,10 @@ class Manager:
         with open(os.path.join(base, "packet-trace.txt"), "w") as f:
             for line in self.trace_lines():
                 f.write(line + "\n")
+        syscall_hist: dict[str, int] = {}
+        for h in self.hosts:
+            for name, n in h.syscall_counts.items():
+                syscall_hist[name] = syscall_hist.get(name, 0) + n
         stats = {
             "end_time_ns": summary.end_time_ns,
             "rounds": summary.rounds,
@@ -370,10 +411,43 @@ class Manager:
             "packets_recv": summary.packets_recv,
             "packets_dropped": summary.packets_dropped,
             "syscalls": summary.syscalls,
+            "syscalls_by_name": syscall_hist,
             "hosts": {h.name: dict(h.counters) for h in self.hosts},
         }
+        if self._perf_timers:
+            stats["perf"] = {"host_exec_ns":
+                             {h.name: h.perf_exec_ns for h in self.hosts}}
         with open(os.path.join(base, "sim-stats.json"), "w") as f:
             json.dump(stats, f, indent=2, sort_keys=True)
+
+
+def _make_pinner():
+    """Round-robin worker-thread CPU pinning (ref: affinity.c — the
+    reference parses /sys topology for NUMA-aware choices; the allowed-
+    CPU list in creation order approximates that and keeps threads from
+    migrating, which is where the reported ~3x cost of unpinned runs
+    comes from, docs/parallel_sims.md:14-16)."""
+    import itertools
+    import threading
+
+    try:
+        cpus = sorted(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return None
+    if not cpus:
+        return None
+    counter = itertools.count()
+    lock = threading.Lock()
+
+    def pin():
+        with lock:
+            i = next(counter)
+        try:
+            os.sched_setaffinity(0, {cpus[i % len(cpus)]})
+        except OSError:
+            pass
+
+    return pin
 
 
 def _rss_kb() -> int:

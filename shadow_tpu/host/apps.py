@@ -158,6 +158,56 @@ def tgen_client(process, argv):
     return 0
 
 
+@app("phold")
+def phold(process, argv):
+    """phold <port> <my_index> <n_init> <mean_delay_ns> <peer...> — the
+    classic PHOLD PDES benchmark (ref: src/test/phold): each host seeds
+    `n_init` messages; every received message triggers one new message
+    to a pseudo-random peer after a pseudo-exponential delay.  Runs
+    until the simulation ends (expected_final_state: running).  All
+    randomness is a per-host deterministic LCG, so traces are
+    byte-identical across schedulers and runs."""
+    port, my_index, n_init = int(argv[0]), int(argv[1]), int(argv[2])
+    mean_delay = int(argv[3])
+    peers = argv[4:]
+    if not peers:
+        yield ("write", 2, "phold: no peers configured\n")
+        return 1
+
+    state = [(my_index * 2654435761 + 12345) & 0xFFFFFFFF]
+
+    def rnd() -> int:
+        state[0] = (state[0] * 1664525 + 1013904223) & 0xFFFFFFFF
+        return state[0]
+
+    def exp_delay() -> int:
+        # Pseudo-exponential via summed uniforms (integer-only).
+        u = (rnd() % 1000) + (rnd() % 1000) + 1
+        return max(1, (u * mean_delay) // 1000)
+
+    fd = yield ("socket", "udp")
+    yield ("bind", fd, (0, port))
+    ips = []
+    for peer in peers:
+        ip = yield ("resolve", peer)
+        ips.append(ip)
+
+    def fire():
+        yield ("nanosleep", exp_delay())
+        yield ("sendto", fd, b"phold", (ips[rnd() % len(ips)], port))
+
+    def seeder():
+        for _ in range(n_init):
+            yield from fire()
+
+    yield ("spawn_thread", seeder)
+    n = 0
+    while True:
+        _data, _src = yield ("recvfrom", fd, 64)
+        n += 1
+        yield from fire()
+
+
 @app("udp-mesh")
 def udp_mesh(process, argv):
     """udp-mesh <port> <count> <size> <peer1> <peer2> ... — every host

@@ -78,6 +78,18 @@ class Host:
         # Counters for sim-stats (sim_stats.rs).
         self.counters = {"events": 0, "packets_sent": 0, "packets_recv": 0,
                          "packets_dropped": 0, "syscalls": 0}
+        # Per-syscall-name histogram (sim_stats.rs syscall counts; merged
+        # into sim-stats.json by the manager).
+        self.syscall_counts: dict[str, int] = {}
+        # perf_timers feature (perf_timer.rs): cumulative wall ns spent
+        # executing this host's events; filled by the manager when
+        # experimental.use_perf_timers is on.
+        self.perf_exec_ns = 0
+
+    def count_syscall(self, name: str) -> None:
+        self.counters["syscalls"] += 1
+        counts = self.syscall_counts
+        counts[name] = counts.get(name, 0) + 1
 
     # ------------------------------------------------------------------
     # Clock & scheduling

@@ -35,6 +35,7 @@ from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
                                       EV_START_REQ, EV_START_RES, EV_SYSCALL,
                                       EV_SYSCALL_COMPLETE,
                                       EV_SYSCALL_DO_NATIVE)
+from shadow_tpu.host.syscalls_native import syscall_name
 
 # CPU-latency model (ref defaults: configuration.rs:464-480 — 1-2us per
 # unblocked syscall, applied in batches).  Applying == parking the
@@ -303,7 +304,7 @@ class ManagedThread:
     def _service(self, host, num: int, args, restarted: bool) -> bool:
         """Dispatch one syscall; returns True to keep pumping events."""
         handler = host.syscall_handler_native
-        host.counters["syscalls"] += 1
+        host.count_syscall(syscall_name(num))
         process = self.process
         result = handler.dispatch(host, process, self, num, args, restarted)
         if process.strace_mode is not None:

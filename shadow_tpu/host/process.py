@@ -85,7 +85,7 @@ class Thread:
                 return
             result = host.syscall_handler.dispatch(host, process, self, call,
                                                    restarted)
-            host.counters["syscalls"] += 1
+            host.count_syscall(call[0])
             if process.strace_mode is not None:
                 from shadow_tpu.host import strace
                 process.strace_write(strace.format_call(

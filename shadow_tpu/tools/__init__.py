@@ -1,0 +1,91 @@
+"""Config-construction helpers — the shadowtools equivalent.
+
+Ref: shadowtools/src/shadowtools/config.py — typed helpers for building
+simulation configs programmatically.  TypedDicts are plain dicts at
+runtime (feed them straight to `ConfigOptions.from_dict` or dump with
+yaml), while letting mypy/pyright check call sites.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, TypedDict, Union
+
+
+class Graph(TypedDict, total=False):
+    type: str            # "gml" or a builtin like "1_gbit_switch"
+    inline: str
+    file: Dict[str, str]  # {"path": ...}
+
+
+class Network(TypedDict, total=False):
+    graph: Graph
+    use_shortest_path: bool
+
+
+class General(TypedDict, total=False):
+    stop_time: Union[str, int]
+    seed: int
+    parallelism: int
+    bootstrap_end_time: Union[str, int]
+    data_directory: str
+    progress: bool
+    heartbeat_interval: Union[str, int]
+
+
+class Process(TypedDict, total=False):
+    path: str
+    args: List[str]
+    environment: Dict[str, str]
+    start_time: Union[str, int]
+    shutdown_time: Union[str, int]
+    expected_final_state: str
+
+
+class Host(TypedDict, total=False):
+    network_node_id: int
+    ip_addr: str
+    bandwidth_down: Union[str, int]
+    bandwidth_up: Union[str, int]
+    pcap_enabled: bool
+    processes: List[Process]
+
+
+class Experimental(TypedDict, total=False):
+    scheduler: str
+    runahead: Union[str, int]
+    use_dynamic_runahead: bool
+    interface_qdisc: str
+    strace_logging_mode: str
+    socket_send_buffer: int
+    socket_recv_buffer: int
+    use_cpu_pinning: bool
+    use_perf_timers: bool
+    tpu_max_packets_per_round: int
+    tpu_min_device_batch: int
+
+
+class Config(TypedDict, total=False):
+    general: General
+    network: Network
+    experimental: Experimental
+    hosts: Dict[str, Host]
+
+
+def one_host_config(path: str, args: List[str] | None = None,
+                    stop_time: str = "1h",
+                    environment: Dict[str, str] | None = None,
+                    seed: int = 1) -> Config:
+    """A single host on a 1 Gbit switch running one process — the shape
+    `shadow-exec` uses (ref: shadowtools/shadow_exec.py)."""
+    return Config(
+        general=General(stop_time=stop_time, seed=seed),
+        network=Network(graph=Graph(type="1_gbit_switch")),
+        hosts={
+            "host": Host(
+                network_node_id=0,
+                processes=[Process(path=path, args=list(args or []),
+                                   environment=dict(environment or {}),
+                                   expected_final_state="any")],
+            )
+        },
+    )

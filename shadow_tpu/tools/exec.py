@@ -1,0 +1,81 @@
+"""One-shot single-host runner — the `shadow-exec` equivalent.
+
+Ref: shadowtools/src/shadowtools/shadow_exec.py.  Runs one command
+under the simulator on a single 1 Gbit host and relays its stdout/
+stderr and exit code, so quick determinism experiments don't need a
+YAML file:
+
+    python -m shadow_tpu.tools.exec -- /bin/date
+    python -m shadow_tpu.tools.exec --stop-time 30s -- ./my_binary arg
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="shadow-exec",
+        description="run one command under the simulator")
+    parser.add_argument("--stop-time", default="1h")
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--keep", metavar="DIR",
+                        help="keep the data directory at DIR")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- command [args...]")
+    args = parser.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given")
+
+    exe = cmd[0]
+    if "/" not in exe:
+        # Internal app names pass through; external commands resolve on
+        # PATH here, explicitly (the simulator itself never searches
+        # PATH — a typo must not run an unrelated binary).
+        import shutil
+        resolved = shutil.which(exe)
+        if resolved is not None:
+            exe = resolved
+
+    import tempfile
+
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools import one_host_config
+
+    cfg_dict = one_host_config(exe, cmd[1:], stop_time=args.stop_time,
+                               seed=args.seed)
+    data_dir = args.keep or tempfile.mkdtemp(prefix="shadow-exec-")
+    cfg_dict["general"]["data_directory"] = data_dir
+    config = ConfigOptions.from_dict(dict(cfg_dict))
+    manager, summary = run_simulation(config, write_data=bool(args.keep))
+
+    host = manager.hosts[0]
+    proc = next(iter(host.processes.values()))
+    sys.stdout.buffer.write(bytes(proc.stdout))
+    sys.stdout.flush()
+    sys.stderr.buffer.write(bytes(proc.stderr))
+    sys.stderr.flush()
+    if not args.keep:
+        import shutil as _sh
+        _sh.rmtree(data_dir, ignore_errors=True)
+    if not summary.ok:
+        for err in summary.plugin_errors:
+            print(f"[shadow-exec] {err}", file=sys.stderr)
+        return 1
+    if proc.exit_code is None:
+        # Never exited (deadlock / ran past stop_time).
+        print("[shadow-exec] process still running at stop_time",
+              file=sys.stderr)
+        return 1
+    return proc.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

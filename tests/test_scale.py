@@ -1,0 +1,121 @@
+"""Simulation-scale / PDES tests.
+
+Ref: src/test/phold (classic PHOLD benchmark as a Shadow sim, serial +
+parallel variants, src/test/phold/CMakeLists.txt:1-30) and the
+BASELINE.md scale ladder (100-host mesh -> 1k-host 3-tier).  Asserts
+(1) the PDES engine sustains bouncing-message workloads, (2) traces are
+byte-identical across serial / thread_per_core / tpu schedulers, and
+(3) a 3-tier latency/loss graph at hundreds of hosts works end-to-end.
+"""
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+
+
+def phold_config(scheduler: str, n_hosts: int = 20, n_init: int = 4,
+                 stop: str = "5s", seed: int = 13):
+    names = [f"lp{i:03d}" for i in range(n_hosts)]
+    hosts = {}
+    for i, name in enumerate(names):
+        peers = [p for p in names if p != name]
+        hosts[name] = {
+            "network_node_id": 0,
+            "processes": [{
+                "path": "phold",
+                "args": ["7000", str(i), str(n_init), "20000000"] + peers,
+                "start_time": "100ms",
+                "expected_final_state": "running",
+            }],
+        }
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "5 ms" ] ]"""}},
+        "experimental": {"scheduler": scheduler},
+        "hosts": hosts})
+
+
+THREE_TIER_GML = """
+graph [ directed 0
+  node [ id 0 host_bandwidth_down "10 Gbit" host_bandwidth_up "10 Gbit" ]
+  node [ id 1 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  node [ id 2 host_bandwidth_down "100 Mbit" host_bandwidth_up "50 Mbit" ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.002 ]
+  edge [ source 1 target 1 latency "5 ms" packet_loss 0.001 ]
+  edge [ source 1 target 2 latency "25 ms" packet_loss 0.005 ]
+  edge [ source 2 target 2 latency "40 ms" packet_loss 0.01 ]
+  edge [ source 0 target 2 latency "35 ms" packet_loss 0.008 ]
+]"""
+
+
+def three_tier_config(scheduler: str, n_hosts: int = 300,
+                      stop: str = "10s"):
+    """BASELINE config 3 shape: hosts spread over a 3-tier latency/loss
+    graph, core hosts serving transfers to edge clients."""
+    hosts = {}
+    n_servers = max(1, n_hosts // 10)
+    for i in range(n_servers):
+        hosts[f"srv{i:03d}"] = {
+            "network_node_id": 0,
+            "processes": [{
+                "path": "tgen-server", "args": ["80"],
+                "expected_final_state": "running",
+            }],
+        }
+    for i in range(n_hosts - n_servers):
+        hosts[f"cli{i:04d}"] = {
+            "network_node_id": 1 + (i % 2),
+            "processes": [{
+                "path": "tgen-client",
+                "args": [f"srv{i % n_servers:03d}", "80", "20000"],
+                "start_time": f"{100 + (i % 20) * 37}ms",
+                "expected_final_state": "any",
+            }],
+        }
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": stop, "seed": 7},
+        "network": {"graph": {"type": "gml", "inline": THREE_TIER_GML}},
+        "experimental": {"scheduler": scheduler},
+        "hosts": hosts})
+
+
+def test_phold_bounces_messages():
+    m, s = run_simulation(phold_config("serial"))
+    assert s.ok
+    # 20 LPs x 4 initial messages bouncing for ~5 simulated seconds over
+    # 5 ms links + ~20 ms mean holds: thousands of packet events.
+    assert s.packets_sent > 2000
+    assert s.rounds > 100
+
+
+@pytest.mark.parametrize("scheduler", ["thread_per_core", "tpu"])
+def test_phold_trace_identical_across_schedulers(scheduler):
+    m_ser, s_ser = run_simulation(phold_config("serial"))
+    m_alt, s_alt = run_simulation(phold_config(scheduler))
+    assert s_ser.ok and s_alt.ok
+    assert s_ser.packets_sent == s_alt.packets_sent
+    assert m_ser.trace_lines() == m_alt.trace_lines()
+
+
+def test_three_tier_300_hosts():
+    m, s = run_simulation(three_tier_config("tpu"))
+    assert s.ok, s.plugin_errors[:3]
+    # Clients on lossy edges: transfers complete despite drops (TCP
+    # retransmission), and the lossy edges actually dropped something.
+    assert s.packets_dropped > 0
+    done = sum(1 for h in m.hosts for p in h.processes.values()
+               if b"transfer 0 ok" in bytes(p.stdout))
+    assert done > 200
+
+
+def test_three_tier_trace_identical_serial_vs_tpu():
+    m_a, s_a = run_simulation(three_tier_config("serial", n_hosts=60,
+                                                stop="6s"))
+    m_b, s_b = run_simulation(three_tier_config("tpu", n_hosts=60,
+                                                stop="6s"))
+    assert s_a.ok and s_b.ok
+    assert m_a.trace_lines() == m_b.trace_lines()

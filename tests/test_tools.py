@@ -1,0 +1,72 @@
+"""shadowtools-equivalent helpers, shadow-exec, status bar, sim-stats
+extras (syscall histogram, perf timers)."""
+
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from shadow_tpu.tools import one_host_config
+
+
+def test_one_host_config_runs_internal_app():
+    cfg = one_host_config("udp-sink", ["9999"], stop_time="2s")
+    cfg["hosts"]["host"]["processes"][0]["expected_final_state"] = "running"
+    m, s = run_simulation(ConfigOptions.from_dict(dict(cfg)))
+    assert s.ok
+
+
+@pytest.mark.skipif(shutil.which("cc") is None, reason="no C toolchain")
+def test_shadow_exec_runs_real_binary_at_sim_epoch():
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.tools.exec", "--", "/bin/date"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    # Simulated CLOCK_REALTIME starts at the 2000-01-01 epoch.
+    assert "2000" in r.stdout
+
+
+def test_sim_stats_syscall_histogram_and_perf(tmp_path):
+    cfg = one_host_config("udp-sink", ["9999"], stop_time="2s")
+    cfg["hosts"]["host"]["processes"][0]["expected_final_state"] = "running"
+    cfg["general"]["data_directory"] = str(tmp_path)
+    cfg["experimental"] = {"use_perf_timers": True, "scheduler": "serial"}
+    m, s = run_simulation(ConfigOptions.from_dict(dict(cfg)),
+                          write_data=True)
+    stats = json.loads((tmp_path / "sim-stats.json").read_text())
+    assert stats["syscalls_by_name"].get("socket") == 1
+    assert stats["syscalls_by_name"].get("bind") == 1
+    assert "host" in stats["perf"]["host_exec_ns"]
+
+
+def test_status_bar_renders():
+    from shadow_tpu.utils.status_bar import StatusBar, StatusPrinter
+
+    buf = io.StringIO()
+    bar = StatusBar(10_000_000_000, buf)
+    bar.update(2_500_000_000)
+    bar.finish(10_000_000_000)
+    out = buf.getvalue()
+    assert "25.0%" in out and "100.0%" in out and out.endswith("\n")
+
+    buf2 = io.StringIO()
+    printer = StatusPrinter(10_000_000_000, buf2)
+    printer.update(5_000_000_000)
+    assert "50.0%" in buf2.getvalue()
+
+
+def test_progress_flag_uses_status(monkeypatch, capsys):
+    cfg = one_host_config("udp-sink", ["9999"], stop_time="2s")
+    cfg["hosts"]["host"]["processes"][0]["expected_final_state"] = "running"
+    cfg["general"]["progress"] = True
+    m, s = run_simulation(ConfigOptions.from_dict(dict(cfg)))
+    err = capsys.readouterr().err
+    assert "sim-sec/wall-sec" in err or "sim-s/s" in err
