@@ -7,10 +7,18 @@ timeout backoff), Jacobson/Karn RTT estimation with integer-ns RTO,
 out-of-order reassembly, graceful close through FIN states, TIME_WAIT,
 and RST on unexpected segments.
 
-Deliberate v1 simplifications (documented for parity tracking against
-the reference's states.rs/connection.rs): no SACK, no window scaling
-(windows cap at 64 KiB), immediate ACKs (no delayed-ACK timer), no
-Nagle, no zero-window persist probe. Each is listed in docs/PARITY.md.
+Also modeled: window scaling (RFC 7323, ref window_scaling.rs), SACK
+(RFC 2018: receiver reports reassembly runs, sender skips sacked
+segments — ref the reference's C tcp.c SACK handling +
+tcp_retransmit_tally.cc), MSS clamping from the peer's SYN option, and
+a pluggable congestion-control seam with reno as the in-tree algorithm
+(ref: tcp_cong.c/tcp_cong_reno.c — the reference likewise ships only
+reno behind its ops table).
+
+Deliberate simplifications (documented for parity tracking against the
+reference's states.rs/connection.rs): immediate ACKs (no delayed-ACK
+timer), no Nagle, no zero-window persist probe. Each is listed in
+docs/PARITY.md.
 
 All arithmetic is integer (ns for time, mod-2^32 for sequence space) so
 scalar and batched stepping agree bit-for-bit.
@@ -44,6 +52,8 @@ STATE_NAMES = {
 
 MSS = 1460  # MTU 1500 - 40 header bytes
 MAX_WINDOW = 65_535
+WINDOW_SCALE = 7                # our advertised shift (RFC 7323 max 14)
+MAX_SACK_BLOCKS = 3             # with timestamps elided, 3 fit on wire
 
 INIT_RTO_NS = 1_000_000_000     # RFC 6298 initial
 MIN_RTO_NS = 200_000_000        # Linux-style floor
@@ -52,6 +62,41 @@ TIME_WAIT_NS = 60_000_000_000   # 2 * MSL with MSL=30s
 DUPACK_THRESHOLD = 3
 
 _SEQ_MOD = 1 << 32
+
+
+class RenoCongestion:
+    """NewReno ops behind the pluggable seam (ref: tcp_cong.c ops table
+    + tcp_cong_reno.c).  Owns cwnd/ssthresh; the connection reports ack
+    and loss events."""
+
+    name = "reno"
+
+    def __init__(self):
+        self.cwnd = 10 * MSS  # RFC 6928 IW10
+        self.ssthresh = 64 * 1024
+
+    def on_new_ack(self, acked: int) -> None:
+        if self.cwnd < self.ssthresh:
+            self.cwnd += min(acked, MSS)  # slow start
+        else:
+            self.cwnd += max(1, MSS * MSS // self.cwnd)  # AIMD
+
+    def on_fast_retransmit(self, flight: int) -> None:
+        self.ssthresh = max(flight // 2, 2 * MSS)
+        self.cwnd = self.ssthresh + 3 * MSS
+
+    def on_recovery_dupack(self) -> None:
+        self.cwnd += MSS  # inflation
+
+    def on_exit_recovery(self) -> None:
+        self.cwnd = self.ssthresh
+
+    def on_rto(self, flight: int) -> None:
+        self.ssthresh = max(flight // 2, 2 * MSS)
+        self.cwnd = MSS
+
+
+CONGESTION_ALGOS = {"reno": RenoCongestion}
 
 
 def seq_add(a: int, b: int) -> int:
@@ -77,7 +122,7 @@ class TcpConnection:
     `outbox` as (TcpHeader, payload_bytes); the owner drains it."""
 
     def __init__(self, iss: int, recv_buf_max: int = 174_760,
-                 send_buf_max: int = 131_072):
+                 send_buf_max: int = 131_072, congestion: str = "reno"):
         self.state = CLOSED
         self.iss = iss % _SEQ_MOD
 
@@ -91,7 +136,7 @@ class TcpConnection:
         self.snd_fin_pending = False     # app closed; FIN after data drains
         self.fin_seq: int | None = None  # seq consumed by our FIN
         # Retransmission queue: list of [seq, payload, is_fin, sent_at,
-        # retransmitted] — ordered by seq.
+        # retransmitted, sacked] — ordered by seq.
         self.rtx: list = []
 
         # Receive side.
@@ -104,9 +149,14 @@ class TcpConnection:
         self.peer_fin_seq: int | None = None   # set once the FIN is
         self.pending_fin_seq: int | None = None  # ...processed in order
 
-        # Congestion control (reno; ref: tcp_cong_reno.c behaviorally).
-        self.cwnd = 10 * MSS  # RFC 6928 IW10
-        self.ssthresh = 64 * 1024
+        # Window scaling (RFC 7323; ref window_scaling.rs): we always
+        # offer WINDOW_SCALE; active only if the peer's SYN offers too.
+        self.our_wscale = 0    # shift applied to windows we advertise
+        self.peer_wscale = 0   # shift applied to windows we receive
+        self.eff_mss = MSS     # clamped by the peer's MSS option
+
+        # Congestion control behind the pluggable seam (tcp_cong.c).
+        self.cong = CONGESTION_ALGOS[congestion]()
         self.dupacks = 0
         self.in_fast_recovery = False
         self.recover = self.iss
@@ -132,6 +182,16 @@ class TcpConnection:
         self.retransmit_count = 0
         self.segments_sent = 0
         self.segments_received = 0
+
+    # Congestion variables live on the algorithm object; these views
+    # keep call sites and tests readable.
+    @property
+    def cwnd(self) -> int:
+        return self.cong.cwnd
+
+    @property
+    def ssthresh(self) -> int:
+        return self.cong.ssthresh
 
     # ------------------------------------------------------------------
     # App-side API
@@ -257,8 +317,7 @@ class TcpConnection:
                 self.rtx.clear()
                 return
         flight = seq_sub(self.snd_nxt, self.snd_una)
-        self.ssthresh = max(flight // 2, 2 * MSS)
-        self.cwnd = MSS
+        self.cong.on_rto(flight)
         self.dupacks = 0
         self.in_fast_recovery = False
         self.rto = min(self.rto * 2, MAX_RTO_NS)
@@ -384,7 +443,7 @@ class TcpConnection:
         if self.in_fast_recovery:
             if seq_lt(self.recover, ack) or ack == self.recover:
                 self.in_fast_recovery = False
-                self.cwnd = self.ssthresh
+                self.cong.on_exit_recovery()
             else:
                 # Partial ack: retransmit next hole immediately.
                 if self.rtx:
@@ -393,22 +452,19 @@ class TcpConnection:
                     seg[4] = True
                     self.retransmit_count += 1
                     self._transmit_segment(seg[0], seg[1], seg[2], now)
-        elif self.cwnd < self.ssthresh:
-            self.cwnd += min(acked, MSS)  # slow start
         else:
-            self.cwnd += max(1, MSS * MSS // self.cwnd)  # AIMD
+            self.cong.on_new_ack(acked)
         # RTO restart (RFC 6298 5.3).
         self.rto_deadline = (now + self.rto) if self.rtx else None
 
     def _handle_dupack(self, now: int) -> None:
         self.dupacks += 1
         if self.in_fast_recovery:
-            self.cwnd += MSS  # inflation
+            self.cong.on_recovery_dupack()
             self._push_data(now)
         elif self.dupacks == DUPACK_THRESHOLD:
             flight = seq_sub(self.snd_nxt, self.snd_una)
-            self.ssthresh = max(flight // 2, 2 * MSS)
-            self.cwnd = self.ssthresh + 3 * MSS
+            self.cong.on_fast_retransmit(flight)
             self.in_fast_recovery = True
             self.recover = self.snd_nxt
             if self.rtx:
