@@ -50,6 +50,12 @@ def main(argv=None) -> int:
 
     import yaml
 
+    # Honor JAX_PLATFORMS before any backend initializes: the site TPU
+    # plugin force-sets jax_platforms at interpreter startup, so the env
+    # var alone cannot keep a CLI run on CPU (utils/platform.py).
+    from shadow_tpu.utils.platform import honor_platform_env
+    honor_platform_env()
+
     from shadow_tpu.core.config import ConfigOptions
     from shadow_tpu.core.manager import run_simulation
     from shadow_tpu.utils import units

@@ -90,6 +90,15 @@ class ExperimentalConfig:
     # Below this, propagation always runs the numpy host path; above,
     # the online cost model measures host vs device and routes.
     tpu_min_device_batch: int = 2048
+    # Host shards for the multi-device mesh backend: >1 partitions hosts
+    # across that many devices (jax.sharding.Mesh over the 'hosts' axis)
+    # and runs the SPMD round step (parallel/round_step.py). 1 = single
+    # device (TpuPropagator).
+    tpu_shards: int = 1
+    # Fixed per-shard-pair packet capacity of the all_to_all exchange
+    # (static shape). Overflow is delivered host-side — a performance
+    # fallback, never a correctness one.
+    tpu_exchange_capacity: int = 1 << 12
     # Pin worker threads to distinct CPUs (ref: affinity.c, on by
     # default; docs/parallel_sims.md reports ~3x cost when off).
     use_cpu_pinning: bool = True
@@ -168,6 +177,8 @@ class ConfigOptions:
                  units.parse_time_ns),
                 ("tpu_max_packets_per_round", "tpu_max_packets_per_round", int),
                 ("tpu_min_device_batch", "tpu_min_device_batch", int),
+                ("tpu_shards", "tpu_shards", int),
+                ("tpu_exchange_capacity", "tpu_exchange_capacity", int),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
                 ("use_perf_timers", "use_perf_timers", bool),
                 ("report_errors_to_stderr", "report_errors_to_stderr", bool)):

@@ -141,7 +141,16 @@ class Manager:
         sched = config.experimental.scheduler
         threaded = sched in ("thread_per_core", "thread_per_host")
         self._per_host_tasks = sched == "thread_per_host"
-        if sched == "tpu":
+        if sched == "tpu" and config.experimental.tpu_shards > 1:
+            from shadow_tpu.parallel.mesh_propagator import MeshPropagator
+            self.propagator = MeshPropagator(
+                self.hosts, self.dns, graph.latency_ns, thr, seed,
+                config.general.bootstrap_end_time_ns,
+                n_shards=config.experimental.tpu_shards,
+                exchange_capacity=config.experimental.tpu_exchange_capacity,
+                max_batch=config.experimental.tpu_max_packets_per_round,
+                runahead=self.runahead)
+        elif sched == "tpu":
             from shadow_tpu.ops.propagate import TpuPropagator
             self.propagator = TpuPropagator(
                 self.hosts, self.dns, graph.latency_ns, thr, seed,
@@ -297,6 +306,10 @@ class Manager:
             heartbeat_lines = not isinstance(status, StatusBar)
         next_status_wall = 0.0
         summary = SimSummary()
+        # A propagator with `provides_barrier` computes the global
+        # min-next-event reduction itself (lax.pmin over the mesh in the
+        # sharded backend) — the Python-side host scan is bypassed.
+        device_barrier = getattr(self.propagator, "provides_barrier", False)
         start = self._min_next_event()
         while start is not None and start < stop:
             window_end = min(start + self.runahead.get(), stop)
@@ -312,10 +325,16 @@ class Manager:
                 if wall >= next_status_wall:  # throttle redraws
                     status.update(window_end)
                     next_status_wall = wall + 0.2
-            nxt = self._min_next_event()
-            if inflight_min is not None and (nxt is None or inflight_min < nxt):
-                nxt = inflight_min
-            start = nxt
+            if device_barrier:
+                # finish_round already reduced host next-event times and
+                # in-flight deliveries globally (pmin).
+                start = inflight_min
+            else:
+                nxt = self._min_next_event()
+                if inflight_min is not None and (nxt is None
+                                                 or inflight_min < nxt):
+                    nxt = inflight_min
+                start = nxt
         summary.end_time_ns = min(start, stop) if start is not None else stop
         if status is not None:
             status.finish(summary.end_time_ns)

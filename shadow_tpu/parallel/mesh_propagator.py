@@ -1,0 +1,232 @@
+"""MeshPropagator: hosts sharded across a device mesh.
+
+The multi-device propagation backend behind `--scheduler=tpu` with
+`experimental.tpu_shards > 1`. It is the TPU-native analog of the
+reference's scale-out story (worker threads over locked per-host event
+queues, src/main/core/worker.rs:597-607 + manager.rs:447-487): hosts are
+partitioned into contiguous shards, one device per shard; each round
+
+  1. every host's emitted packets are buffered into its shard's outbox
+     (the only `send()` cost is a list append);
+  2. one jitted SPMD step (parallel/round_step.py) computes latency,
+     counter-based loss, and clamped arrival times shard-locally, routes
+     each packet's metadata to its destination shard with `lax.all_to_all`
+     over the ICI, and reduces the conservative barrier's global
+     min-next-event-time with `lax.pmin`;
+  3. the host runtime consumes the exchanged (index, time) pairs to
+     enqueue packet events into destination-shard host inboxes; packets
+     that exceeded the fixed exchange capacity are delivered host-side
+     (a performance fallback, never a correctness one).
+
+Determinism: the loss RNG is threefry keyed by (src_host, packet_seq) —
+independent of shard layout and execution order — and events carry
+(src_host, seq) tiebreaks, so the packet trace is byte-identical to the
+serial scalar scheduler (tests/test_mesh_sim.py, __graft_entry__'s
+dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu.core.event import Event, KIND_PACKET
+from shadow_tpu.core.rng import STREAM_PACKET_LOSS, mix_key
+from shadow_tpu.net import packet as pktmod
+from shadow_tpu.ops.propagate import _bucket
+from shadow_tpu.parallel.round_step import HOST_AXIS, build_sharded_round_step
+
+_I64_MAX = (1 << 63) - 1
+
+
+class MeshPropagator:
+    """Drop-in for ScalarPropagator/TpuPropagator over a device mesh.
+
+    `finish_round()` returns the *global* next-event time (the `pmin`
+    barrier over local host events and in-flight deliveries), so the
+    Manager's Python-side min-reduction is bypassed entirely —
+    `provides_barrier` tells it so.
+    """
+
+    provides_barrier = True
+
+    def __init__(self, hosts, dns, latency_ns, loss_thresholds, seed: int,
+                 bootstrap_end_ns: int, n_shards: int,
+                 exchange_capacity: int = 1 << 12, runahead=None,
+                 devices=None, max_batch: int = 1 << 20):
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) < n_shards:
+            raise ValueError(
+                f"tpu_shards={n_shards} but only {len(devices)} devices "
+                f"visible; lower tpu_shards or add devices")
+        self.mesh = Mesh(np.array(devices[:n_shards]), (HOST_AXIS,))
+        self.hosts = hosts
+        self.dns = dns
+        self.n_shards = n_shards
+        # Contiguous partition: shard s owns hosts [s*H, (s+1)*H).
+        self.hosts_per_shard = -(-len(hosts) // n_shards)
+        self.exchange_capacity = exchange_capacity
+        k0, k1 = mix_key(seed, STREAM_PACKET_LOSS)
+        self.step = build_sharded_round_step(
+            self.mesh, np.asarray(latency_ns, dtype=np.int64),
+            np.asarray(loss_thresholds, dtype=np.int64), k0, k1,
+            exchange_capacity)
+        self.bootstrap_end = bootstrap_end_ns
+        self.runahead = runahead
+        # Device-memory bound: per-shard batch width per dispatch, sized
+        # so one dispatch never exceeds ~max_batch packets globally.
+        self.max_shard_batch = max(1, max_batch // n_shards)
+        self.window_end = 0
+        self._outboxes: list[list] = [[] for _ in range(n_shards)]
+        # Observability (mirrors TpuPropagator's counters).
+        self.rounds_dispatched = 0
+        self.packets_batched = 0
+        self.packets_exchanged = 0
+        self.packets_overflowed = 0
+
+    # ------------------------------------------------------------------
+
+    def begin_round(self, window_start: int, window_end: int) -> None:
+        self.window_end = window_end
+
+    def send(self, src_host, packet) -> None:
+        dst_id = self.dns.host_id_for_ip(packet.dst_ip)
+        if dst_id is None:
+            src_host.trace_drop(packet, "no-route")
+            return
+        self._outboxes[src_host.id // self.hosts_per_shard].append(
+            (src_host, self.hosts[dst_id], src_host.next_event_seq(),
+             packet, src_host.now(), packet.is_empty_control()))
+
+    # ------------------------------------------------------------------
+
+    def _host_next_events(self) -> np.ndarray:
+        """Per-host local next-event times, padded to [S, H] with +inf.
+
+        Safe to read host-side here: in mesh mode nothing is delivered
+        mid-round (send() only buffers), so each heap is quiescent
+        between `Host.execute` returning and this call.
+        """
+        S, H = self.n_shards, self.hosts_per_shard
+        hne = np.full((S, H), _I64_MAX, dtype=np.int64)
+        for h in self.hosts:
+            t = h.next_event_time()
+            if t is not None:
+                hne[h.id // H, h.id % H] = t
+        return hne
+
+    def finish_round(self):
+        """Run the SPMD round step and deliver its outputs.
+
+        Returns the global min next-event time (int) or None when no
+        events remain anywhere — the round loop's next window start.
+        """
+        outboxes = self._outboxes
+        total = sum(len(ob) for ob in outboxes)
+        hne = self._host_next_events()
+        if total == 0:
+            m = int(hne.min())
+            return m if m < _I64_MAX else None
+
+        # Honor the device-memory bound: oversized rounds dispatch as
+        # several column chunks of the per-shard outboxes; chunk order
+        # preserves per-source emission order, so determinism holds.
+        widest = max(len(ob) for ob in outboxes)
+        barrier = _I64_MAX
+        for lo in range(0, widest, self.max_shard_batch):
+            bm = self._dispatch(
+                [ob[lo:lo + self.max_shard_batch] for ob in outboxes], hne)
+            barrier = min(barrier, bm)
+        for ob in outboxes:
+            ob.clear()
+        self.packets_batched += total
+        return barrier if barrier < _I64_MAX else None
+
+    def _dispatch(self, outboxes: list[list], hne: np.ndarray) -> int:
+        S = self.n_shards
+        B = _bucket(max(len(ob) for ob in outboxes))
+        src_node = np.zeros((S, B), dtype=np.int32)
+        dst_node = np.zeros((S, B), dtype=np.int32)
+        dst_shard = np.zeros((S, B), dtype=np.int32)
+        src_host = np.zeros((S, B), dtype=np.int64)
+        pkt_seq = np.zeros((S, B), dtype=np.uint32)
+        t_send = np.zeros((S, B), dtype=np.int64)
+        is_ctl = np.zeros((S, B), dtype=bool)
+        valid = np.zeros((S, B), dtype=bool)
+        H = self.hosts_per_shard
+        for s, ob in enumerate(outboxes):
+            n = len(ob)
+            if n == 0:
+                continue
+            src_h, dst_h, _seq, pkts, ts, ctl = zip(*ob)
+            src_node[s, :n] = np.fromiter(
+                (h.node_index for h in src_h), np.int32, n)
+            dst_node[s, :n] = np.fromiter(
+                (h.node_index for h in dst_h), np.int32, n)
+            dst_shard[s, :n] = np.fromiter(
+                (h.id // H for h in dst_h), np.int32, n)
+            src_host[s, :n] = np.fromiter((h.id for h in src_h), np.int64, n)
+            pkt_seq[s, :n] = np.fromiter(
+                (p.seq & 0xFFFFFFFF for p in pkts), np.uint32, n)
+            t_send[s, :n] = ts
+            is_ctl[s, :n] = ctl
+            valid[s, :n] = True
+
+        out = self.step(src_node, dst_node, dst_shard, src_host, pkt_seq,
+                        t_send, is_ctl, valid, hne,
+                        np.int64(self.window_end),
+                        np.int64(self.bootstrap_end))
+        (deliver, keep, overflow, reachable, lossy, recv_idx, recv_time,
+         barrier_min, min_latency) = (np.asarray(o) for o in out)
+        self.rounds_dispatched += 1
+
+        ml = int(min_latency.min())
+        if self.runahead is not None and ml < _I64_MAX:
+            self.runahead.update_lowest_used_latency(ml)
+
+        # Exchanged deliveries: recv_idx[s, j, c] = index into shard j's
+        # outbox of a packet destined for shard s (slot order preserves
+        # per-source emission order). argwhere over the sparse sentinel
+        # buffer, then plain-int access (numpy scalar indexing in the
+        # loop is the slow path — see ops/propagate.py's .tolist() note).
+        hits = np.argwhere(recv_idx >= 0)
+        if hits.size:
+            idx_hit = recv_idx[hits[:, 0], hits[:, 1], hits[:, 2]].tolist()
+            time_hit = recv_time[hits[:, 0], hits[:, 1], hits[:, 2]].tolist()
+            src_shard_hit = hits[:, 1].tolist()
+            for j, i, t in zip(src_shard_hit, idx_hit, time_hit):
+                src_h, dst_h, seq, pkt, _ts, _ = outboxes[j][i]
+                pkt.arrival_time = t
+                dst_h.deliver_packet_event(
+                    Event(t, KIND_PACKET, src_h.id, seq, pkt))
+            self.packets_exchanged += len(idx_hit)
+
+        # Host-side paths: capacity overflow (delivered anyway — the
+        # docstring's promise) and drop tracing.
+        for s, ob in enumerate(outboxes):
+            if not ob:
+                continue
+            n = len(ob)
+            keep_l = keep[s, :n].tolist()
+            over_l = overflow[s, :n].tolist()
+            deliver_l = deliver[s, :n].tolist()
+            reach_l = reachable[s, :n].tolist()
+            lossy_l = lossy[s, :n].tolist()
+            for i, (src_h, dst_h, seq, pkt, ts, _) in enumerate(ob):
+                if over_l[i]:
+                    t = deliver_l[i]
+                    pkt.arrival_time = t
+                    dst_h.deliver_packet_event(
+                        Event(t, KIND_PACKET, src_h.id, seq, pkt))
+                    self.packets_overflowed += 1
+                elif not keep_l[i]:
+                    if not reach_l[i]:
+                        src_h.trace_drop(pkt, "unreachable", at_time=ts)
+                    elif lossy_l[i]:
+                        pkt.record(pktmod.ST_INET_DROPPED)
+                        src_h.trace_drop(pkt, "inet-loss", at_time=ts)
+
+        return int(barrier_min.min())

@@ -50,8 +50,11 @@ def build_sharded_round_step(mesh, latency_ns: np.ndarray,
     Returns:
       deliver  : int64[S, B] arrival times (computed on owner shard)
       keep     : bool[S, B]
-      xch_*    : exchanged packet index/time per destination shard
-      barrier_min : int64[1] global min next event (pmin over shards)
+      overflow : bool[S, B]  kept but exceeded the exchange capacity
+      reachable, lossy : bool[S, B]  drop diagnostics for tracing
+      recv_idx, recv_time : exchanged packet index/time per source shard
+      barrier_min : int64[S] global min next event (pmin over shards)
+      min_latency : int64[S] global min kept latency (dynamic runahead)
     """
     import jax
     import jax.numpy as jnp
@@ -123,13 +126,18 @@ def build_sharded_round_step(mesh, latency_ns: np.ndarray,
             jnp.min(host_next_event),
             jnp.min(jnp.where(keep, deliver, _I64_MAX)))
         barrier_min = lax.pmin(local_min, HOST_AXIS)
+        # Dynamic-runahead feedback: smallest latency any *delivered*
+        # packet used this round, reduced globally (runahead.rs:61).
+        min_latency = lax.pmin(
+            jnp.min(jnp.where(keep, latency, _I64_MAX)), HOST_AXIS)
 
-        return (deliver[None], keep[None], overflow[None], recv_idx[None],
-                recv_time[None], barrier_min[None])
+        return (deliver[None], keep[None], overflow[None], reachable[None],
+                lossy[None], recv_idx[None], recv_time[None],
+                barrier_min[None], min_latency[None])
 
     specs = P(HOST_AXIS)
     in_specs = (specs,) * 9 + (P(), P())
-    out_specs = (specs, specs, specs, specs, specs, P(HOST_AXIS))
+    out_specs = (specs,) * 7 + (P(HOST_AXIS), P(HOST_AXIS))
     fn = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs)
     return jax.jit(fn)

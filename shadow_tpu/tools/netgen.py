@@ -1,0 +1,160 @@
+"""Deterministic network/workload config generation.
+
+The reference ships tornettools/tgen-generated YAML for its scale
+configs (SURVEY.md section 6); this module is the in-tree equivalent
+used by the multi-chip dry run, the mesh-scheduler tests, and bench.py's
+BASELINE configs — everything is derived from (n_hosts, seed) with pure
+integer arithmetic so two processes generate byte-identical configs.
+"""
+
+from __future__ import annotations
+
+
+def full_mesh_gml(n_nodes: int, bw: str = "100 Mbit",
+                  base_latency_us: int = 2000, step_us: int = 500,
+                  loss: float = 0.02) -> str:
+    """Fully-connected GML graph with varied latencies and a sprinkling
+    of lossy edges (every edge with (i+j) % 5 == 0), plus self-edges."""
+    lines = ["graph [ directed 0"]
+    for i in range(n_nodes):
+        lines.append(f'  node [ id {i} host_bandwidth_down "{bw}" '
+                     f'host_bandwidth_up "{bw}" ]')
+    for i in range(n_nodes):
+        lines.append(f'  edge [ source {i} target {i} latency "500 us" ]')
+    for i in range(n_nodes):
+        for j in range(i + 1, n_nodes):
+            lat = base_latency_us + ((i * 7 + j * 13) % 17) * step_us
+            lossy = f" packet_loss {loss}" if loss and (i + j) % 5 == 0 else ""
+            lines.append(f'  edge [ source {i} target {j} '
+                         f'latency "{lat} us"{lossy} ]')
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def three_tier_gml(n_core: int = 4, n_mid: int = 8, n_leaf: int = 40,
+                   loss: float = 0.01) -> str:
+    """BASELINE config 3's '3-tier latency/loss graph': core routers in
+    a full mesh (low latency, high bw), mid-tier nodes homed on cores,
+    leaf nodes homed on mids (the tier hosts attach to)."""
+    lines = ["graph [ directed 0"]
+    nid = 0
+    cores = []
+    for i in range(n_core):
+        lines.append(f'  node [ id {nid} host_bandwidth_down "10 Gbit" '
+                     f'host_bandwidth_up "10 Gbit" ]')
+        cores.append(nid)
+        nid += 1
+    mids = []
+    for i in range(n_mid):
+        lines.append(f'  node [ id {nid} host_bandwidth_down "1 Gbit" '
+                     f'host_bandwidth_up "1 Gbit" ]')
+        mids.append(nid)
+        nid += 1
+    leaves = []
+    for i in range(n_leaf):
+        lines.append(f'  node [ id {nid} host_bandwidth_down "100 Mbit" '
+                     f'host_bandwidth_up "50 Mbit" ]')
+        leaves.append(nid)
+        nid += 1
+    for n in cores + mids + leaves:
+        lines.append(f'  edge [ source {n} target {n} latency "200 us" ]')
+    for a in range(n_core):
+        for b in range(a + 1, n_core):
+            lat = 2000 + ((a * 3 + b) % 5) * 1000
+            lines.append(f'  edge [ source {cores[a]} target {cores[b]} '
+                         f'latency "{lat} us" ]')
+    for i, m in enumerate(mids):
+        lat = 5000 + (i % 4) * 2500
+        lines.append(f'  edge [ source {m} target {cores[i % n_core]} '
+                     f'latency "{lat} us" ]')
+    for i, lf in enumerate(leaves):
+        lat = 10000 + (i % 8) * 3000
+        lossy = f" packet_loss {loss}" if loss and i % 4 == 0 else ""
+        lines.append(f'  edge [ source {lf} target {mids[i % n_mid]} '
+                     f'latency "{lat} us"{lossy} ]')
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def _indent(text: str, pad: str) -> str:
+    return "\n".join(pad + line for line in text.splitlines())
+
+
+def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
+                  count: int = 6, size: int = 600, stop_time: str = "10s",
+                  seed: int = 1, scheduler: str = "serial",
+                  experimental_extra: dict | None = None,
+                  gml: str | None = None) -> str:
+    """N-host UDP traffic mesh: every host runs one udp-sink (runs until
+    sim end) and `floods_per_host` udp-flood senders at staggered starts.
+    Final process states are loss-independent (floods always exit 0), so
+    the byte-diff gate is the packet trace alone."""
+    if gml is None:
+        gml = full_mesh_gml(n_nodes)
+    exp_lines = [f"  scheduler: {scheduler}"]
+    for k, v in (experimental_extra or {}).items():
+        exp_lines.append(f"  {k}: {v}")
+    names = [f"host{i:05d}" for i in range(n_hosts)]
+    offsets = (1, 5, 11, 23, 47, 95)[:floods_per_host]
+    host_blocks = []
+    for i, name in enumerate(names):
+        procs = [f'      - {{ path: udp-sink, args: ["9000"], '
+                 f'expected_final_state: running }}']
+        for k, off in enumerate(offsets):
+            peer = names[(i + off) % n_hosts]
+            start_ms = 1000 + ((i * 31 + k * 157) % 1000)
+            procs.append(
+                f'      - {{ path: udp-flood, '
+                f'args: [{peer}, "9000", "{count}", "{size}"], '
+                f'start_time: {start_ms} ms }}')
+        host_blocks.append(
+            f"  {name}:\n    network_node_id: {i % n_nodes}\n"
+            f"    processes:\n" + "\n".join(procs))
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+            f"network:\n  graph:\n    type: gml\n    inline: |\n"
+            f"{_indent(gml, '      ')}\n"
+            f"experimental:\n" + "\n".join(exp_lines) + "\n"
+            f"hosts:\n" + "\n".join(host_blocks) + "\n")
+
+
+def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
+                   nbytes: int = 100_000, count: int = 1,
+                   stop_time: str = "60s", seed: int = 1,
+                   scheduler: str = "serial",
+                   experimental_extra: dict | None = None,
+                   n_core: int = 4, n_mid: int = 8,
+                   n_leaf: int = 40) -> str:
+    """BASELINE config 3: tgen-style TCP transfers on the 3-tier graph.
+    Servers live on mid-tier nodes; clients on leaves download
+    `count` x `nbytes` from a deterministic server choice."""
+    gml = three_tier_gml(n_core=n_core, n_mid=n_mid, n_leaf=n_leaf)
+    if n_servers is None:
+        n_servers = max(1, n_hosts // 50)
+    exp_lines = [f"  scheduler: {scheduler}"]
+    for k, v in (experimental_extra or {}).items():
+        exp_lines.append(f"  {k}: {v}")
+    blocks = []
+    server_names = [f"server{i:03d}" for i in range(n_servers)]
+    for i, name in enumerate(server_names):
+        blocks.append(
+            f"  {name}:\n    network_node_id: {n_core + (i % n_mid)}\n"
+            f"    processes:\n"
+            f'      - {{ path: tgen-server, args: ["8080"], '
+            f'expected_final_state: running }}')
+    n_clients = n_hosts - n_servers
+    for i in range(n_clients):
+        name = f"client{i:05d}"
+        server = server_names[i % n_servers]
+        node = n_core + n_mid + (i % n_leaf)
+        start_ms = 1000 + (i * 37) % 5000
+        blocks.append(
+            f"  {name}:\n    network_node_id: {node}\n"
+            f"    processes:\n"
+            f'      - {{ path: tgen-client, '
+            f'args: [{server}, "8080", "{nbytes}", "{count}"], '
+            f'start_time: {start_ms} ms }}')
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+            f"network:\n  graph:\n    type: gml\n    inline: |\n"
+            f"{_indent(gml, '      ')}\n"
+            f"experimental:\n" + "\n".join(exp_lines) + "\n"
+            f"hosts:\n" + "\n".join(blocks) + "\n")

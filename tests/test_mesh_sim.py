@@ -1,0 +1,96 @@
+"""The multi-device gate: a REAL simulation with hosts sharded across
+the virtual 8-device CPU mesh (lax.all_to_all exchange + lax.pmin
+barrier, parallel/mesh_propagator.py) must produce a packet trace
+byte-identical to the serial scalar scheduler — the same determinism
+contract the single-device TPU path is held to (test_parity_tpu.py).
+
+Ref analog: the scheduler/worker scale-out, src/main/core/worker.rs:597-607
+and manager.rs:447-487 — cross-host pushes + the round min-reduction.
+"""
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from shadow_tpu.parallel.mesh_propagator import MeshPropagator
+from shadow_tpu.tools.netgen import udp_mesh_yaml
+
+
+def run(scheduler, n_hosts=24, seed=3, **extra):
+    text = udp_mesh_yaml(n_hosts, n_nodes=6, floods_per_host=2, count=4,
+                         size=500, stop_time="8s", seed=seed,
+                         scheduler=scheduler,
+                         experimental_extra=extra or None)
+    cfg = ConfigOptions.from_yaml_text(text)
+    return run_simulation(cfg)
+
+
+def test_mesh_sim_trace_byte_identical_to_serial():
+    m_cpu, s_cpu = run("serial")
+    m_mesh, s_mesh = run("tpu", tpu_shards=8)
+    assert s_cpu.ok and s_mesh.ok
+    assert isinstance(m_mesh.propagator, MeshPropagator)
+    # The exchange really carried packets between shards.
+    assert m_mesh.propagator.packets_exchanged > 0
+    assert m_mesh.propagator.rounds_dispatched > 0
+    cpu_lines = m_cpu.trace_lines()
+    mesh_lines = m_mesh.trace_lines()
+    assert len(cpu_lines) > 100
+    assert cpu_lines == mesh_lines
+    assert s_cpu.rounds == s_mesh.rounds
+    assert s_cpu.packets_recv == s_mesh.packets_recv
+    assert s_cpu.packets_dropped == s_mesh.packets_dropped
+    # Loss edges fired (RNG parity is load-bearing, not vacuous).
+    assert any("inet-loss" in l for l in cpu_lines)
+
+
+def test_mesh_sim_across_seeds():
+    for seed in (1, 42):
+        m_cpu, _ = run("serial", seed=seed)
+        m_mesh, _ = run("tpu", seed=seed, tpu_shards=8)
+        assert m_cpu.trace_lines() == m_mesh.trace_lines()
+
+
+def test_mesh_overflow_fallback_delivers():
+    """Exchange capacity 1 forces nearly every packet onto the host-side
+    overflow path; delivery and the trace must be unaffected (VERDICT
+    round-1: overflow flag was never consumed by an integration)."""
+    m_cpu, _ = run("serial")
+    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_exchange_capacity=1)
+    assert s_mesh.ok
+    assert m_mesh.propagator.packets_overflowed > 0
+    assert m_mesh.propagator.packets_exchanged > 0  # capacity still used
+    assert m_cpu.trace_lines() == m_mesh.trace_lines()
+
+
+def test_mesh_chunked_dispatch():
+    """tpu_max_packets_per_round bounds one dispatch; oversized rounds
+    split into ordered column chunks with the trace unchanged."""
+    m_cpu, _ = run("serial")
+    m_full, _ = run("tpu", tpu_shards=8)
+    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_max_packets_per_round=16)
+    assert s_mesh.ok
+    assert m_mesh.propagator.max_shard_batch == 2
+    # Same rounds, strictly more dispatches = chunking actually happened.
+    assert (m_mesh.propagator.rounds_dispatched
+            > m_full.propagator.rounds_dispatched)
+    assert m_cpu.trace_lines() == m_mesh.trace_lines()
+
+
+def test_mesh_uneven_host_partition():
+    """Host count not divisible by the shard count: the last shard is
+    short; padding rows must never fabricate events."""
+    m_cpu, s_cpu = run("serial", n_hosts=21)
+    m_mesh, s_mesh = run("tpu", n_hosts=21, tpu_shards=8)
+    assert s_cpu.ok and s_mesh.ok
+    assert m_cpu.trace_lines() == m_mesh.trace_lines()
+
+
+def test_mesh_stdout_matches_serial():
+    m_mesh, _ = run("tpu", tpu_shards=8)
+    m_cpu, _ = run("serial")
+    out_mesh = {(h.name, p.name): bytes(p.stdout) for h in m_mesh.hosts
+                for p in h.processes.values()}
+    out_cpu = {(h.name, p.name): bytes(p.stdout) for h in m_cpu.hosts
+               for p in h.processes.values()}
+    assert out_mesh == out_cpu

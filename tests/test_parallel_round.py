@@ -31,7 +31,8 @@ def test_sharded_round_step_runs_and_reduces(mesh):
                batch["src_host"], batch["pkt_seq"], batch["t_send"],
                batch["is_ctl"], batch["valid"], batch["host_next_event"],
                window_end, np.int64(0))
-    deliver, keep, overflow, recv_idx, recv_time, barrier_min = out
+    (deliver, keep, overflow, reachable, lossy, recv_idx, recv_time,
+     barrier_min, min_latency) = out
     deliver = np.asarray(deliver)
     keep = np.asarray(keep)
     # No loss configured: every valid packet kept.
@@ -58,7 +59,8 @@ def test_sharded_exchange_routes_to_dst_shard(mesh):
                batch["src_host"], batch["pkt_seq"], batch["t_send"],
                batch["is_ctl"], batch["valid"], batch["host_next_event"],
                np.int64(1_100_000_000), np.int64(0))
-    deliver, keep, overflow, recv_idx, recv_time, barrier_min = out
+    (deliver, keep, overflow, reachable, lossy, recv_idx, recv_time,
+     barrier_min, min_latency) = out
     recv_idx = np.asarray(recv_idx)    # [S, n_shards, C]
     assert not np.asarray(overflow).any()
     # Shard s receives packets only in row (s-1): the neighbor that
@@ -86,7 +88,7 @@ def test_overflow_flagged_not_lost(mesh):
                batch["src_host"], batch["pkt_seq"], batch["t_send"],
                batch["is_ctl"], batch["valid"], batch["host_next_event"],
                np.int64(1_100_000_000), np.int64(0))
-    _, keep, overflow, recv_idx, _, _ = out
+    _, keep, overflow, _, _, recv_idx, _, _, _ = out
     overflow = np.asarray(overflow)
     # 8 - 2 = 6 overflow per shard, still marked kept for host fallback.
     assert overflow.sum() == S * (B - C)
