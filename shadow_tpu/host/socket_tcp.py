@@ -33,6 +33,7 @@ class TcpSocket(StatusOwner):
         self.local = None
         self.peer = None
         self.nonblocking = False
+        self.nodelay = False          # TCP_NODELAY, propagated to conns
         self._send_buf_max = send_buf
         self._recv_buf_max = recv_buf
         self._ifaces = []
@@ -128,6 +129,7 @@ class TcpSocket(StatusOwner):
         self.conn = tcpc.TcpConnection(
             iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
             send_buf_max=self._send_buf_max)
+        self.conn.nodelay = self.nodelay
         self.conn.open_active(host.now())
         self._flush(host)
         if self.nonblocking:
@@ -283,6 +285,8 @@ class TcpSocket(StatusOwner):
         child.conn = tcpc.TcpConnection(
             iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
             send_buf_max=self._send_buf_max)
+        child.nodelay = self.nodelay
+        child.conn.nodelay = self.nodelay
         child.conn.accept_syn(hdr, host.now())
         child._flush(host)
         return True

@@ -453,8 +453,20 @@ class NativeSyscallHandler:
                        optname, optval, optlen, *_):
         if not self._is_emu(fd):
             return _native()
-        # Recorded-but-inert options (REUSEADDR, NODELAY, buffer sizing
-        # hints...) — enough surface for common clients/servers.
+        sock = self._emu(process, fd)
+        # TCP_NODELAY (IPPROTO_TCP=6, optname 1) reaches the connection's
+        # Nagle switch; other options (REUSEADDR, buffer sizing hints...)
+        # are recorded-but-inert — enough surface for common apps.
+        if level == 6 and optname == 1 and optlen >= 4:
+            val = struct.unpack("<i", process.mem.read(optval, 4))[0]
+            sock.nodelay = bool(val)
+            conn = getattr(sock, "conn", None)
+            if conn is not None:
+                conn.nodelay = bool(val)
+                if conn.nodelay:
+                    # Linux flushes Nagle-held data on TCP_NODELAY.
+                    conn._push_data(host.now())
+                    sock._flush(host)
         return _done(0)
 
     def sys_getsockopt(self, host, process, thread, restarted, fd, level,

@@ -7,18 +7,26 @@ timeout backoff), Jacobson/Karn RTT estimation with integer-ns RTO,
 out-of-order reassembly, graceful close through FIN states, TIME_WAIT,
 and RST on unexpected segments.
 
-Also modeled: window scaling (RFC 7323, ref window_scaling.rs), SACK
-(RFC 2018: receiver reports reassembly runs, sender skips sacked
-segments — ref the reference's C tcp.c SACK handling +
-tcp_retransmit_tally.cc), MSS clamping from the peer's SYN option, and
+Also modeled: window scaling (RFC 7323, ref window_scaling.rs)
+negotiated via SYN options and applied to both advertised and received
+windows; MSS clamping from the peer's SYN option; SACK (RFC 2018:
+receiver reports reassembly runs in pure ACKs, sender marks covered
+retransmit-queue entries and skips them on fast retransmit / partial
+ack / RTO — ref the reference's C tcp.c SACK handling +
+tcp_retransmit_tally.cc); delayed ACKs (ack every second in-order
+segment or after a 40ms timer, immediate on out-of-order/FIN — Linux
+quickack-style, off switch `delayed_ack=False`); Nagle (sub-MSS data
+held while unacked data is in flight, off switch `nagle=False` or the
+`nodelay` attribute, i.e. TCP_NODELAY); zero-window persist probes
+(1-byte probe on exponential backoff while the peer advertises 0); and
 a pluggable congestion-control seam with reno as the in-tree algorithm
 (ref: tcp_cong.c/tcp_cong_reno.c — the reference likewise ships only
 reno behind its ops table).
 
 Deliberate simplifications (documented for parity tracking against the
-reference's states.rs/connection.rs): immediate ACKs (no delayed-ACK
-timer), no Nagle, no zero-window persist probe. Each is listed in
-docs/PARITY.md.
+reference's states.rs/connection.rs): no timestamps (RFC 7323 TSopt) —
+RTT sampling is one-timed-segment BSD style; no simultaneous open; no
+urgent data. Each is listed in docs/PARITY.md.
 
 All arithmetic is integer (ns for time, mod-2^32 for sequence space) so
 scalar and batched stepping agree bit-for-bit.
@@ -60,6 +68,7 @@ MIN_RTO_NS = 200_000_000        # Linux-style floor
 MAX_RTO_NS = 60_000_000_000
 TIME_WAIT_NS = 60_000_000_000   # 2 * MSL with MSL=30s
 DUPACK_THRESHOLD = 3
+DELACK_NS = 40_000_000          # Linux TCP_DELACK_MIN
 
 _SEQ_MOD = 1 << 32
 
@@ -71,29 +80,30 @@ class RenoCongestion:
 
     name = "reno"
 
-    def __init__(self):
-        self.cwnd = 10 * MSS  # RFC 6928 IW10
+    def __init__(self, mss: int = MSS):
+        self.mss = mss
+        self.cwnd = 10 * mss  # RFC 6928 IW10
         self.ssthresh = 64 * 1024
 
     def on_new_ack(self, acked: int) -> None:
         if self.cwnd < self.ssthresh:
-            self.cwnd += min(acked, MSS)  # slow start
+            self.cwnd += min(acked, self.mss)  # slow start
         else:
-            self.cwnd += max(1, MSS * MSS // self.cwnd)  # AIMD
+            self.cwnd += max(1, self.mss * self.mss // self.cwnd)  # AIMD
 
     def on_fast_retransmit(self, flight: int) -> None:
-        self.ssthresh = max(flight // 2, 2 * MSS)
-        self.cwnd = self.ssthresh + 3 * MSS
+        self.ssthresh = max(flight // 2, 2 * self.mss)
+        self.cwnd = self.ssthresh + 3 * self.mss
 
     def on_recovery_dupack(self) -> None:
-        self.cwnd += MSS  # inflation
+        self.cwnd += self.mss  # inflation
 
     def on_exit_recovery(self) -> None:
         self.cwnd = self.ssthresh
 
     def on_rto(self, flight: int) -> None:
-        self.ssthresh = max(flight // 2, 2 * MSS)
-        self.cwnd = MSS
+        self.ssthresh = max(flight // 2, 2 * self.mss)
+        self.cwnd = self.mss
 
 
 CONGESTION_ALGOS = {"reno": RenoCongestion}
@@ -122,7 +132,8 @@ class TcpConnection:
     `outbox` as (TcpHeader, payload_bytes); the owner drains it."""
 
     def __init__(self, iss: int, recv_buf_max: int = 174_760,
-                 send_buf_max: int = 131_072, congestion: str = "reno"):
+                 send_buf_max: int = 131_072, congestion: str = "reno",
+                 delayed_ack: bool = True, nagle: bool = True):
         self.state = CLOSED
         self.iss = iss % _SEQ_MOD
 
@@ -155,6 +166,17 @@ class TcpConnection:
         self.peer_wscale = 0   # shift applied to windows we receive
         self.eff_mss = MSS     # clamped by the peer's MSS option
 
+        # Delayed ACK (RFC 1122 4.2.3.2) + Nagle (RFC 896).
+        self.delayed_ack = delayed_ack
+        self.nagle = nagle
+        self.nodelay = False           # TCP_NODELAY
+        self._delack_deadline: int | None = None
+        self._segs_since_ack = 0
+
+        # Zero-window persist probing.
+        self._persist_deadline: int | None = None
+        self._persist_interval = 0
+
         # Congestion control behind the pluggable seam (tcp_cong.c).
         self.cong = CONGESTION_ALGOS[congestion]()
         self.dupacks = 0
@@ -182,6 +204,7 @@ class TcpConnection:
         self.retransmit_count = 0
         self.segments_sent = 0
         self.segments_received = 0
+        self.sacked_skip_count = 0  # retransmissions avoided via SACK
 
     # Congestion variables live on the algorithm object; these views
     # keep call sites and tests readable.
@@ -198,11 +221,13 @@ class TcpConnection:
     # ------------------------------------------------------------------
 
     def open_active(self, now: int) -> None:
-        """connect(): emit SYN (states.rs Init->SynSent)."""
+        """connect(): emit SYN (states.rs Init->SynSent). The SYN offers
+        our MSS and window-scale options (RFC 7323: the scale only
+        activates if the peer's SYN offers one too)."""
         assert self.state == CLOSED
         self.state = SYN_SENT
         self._emit(TcpFlags.SYN, seq=self.iss, payload=b"", now=now,
-                   track=True)
+                   track=True, mss=MSS, window_scale=WINDOW_SCALE)
         self.snd_nxt = seq_add(self.iss, 1)
 
     def open_passive(self) -> None:
@@ -282,6 +307,8 @@ class TcpConnection:
         self.state = CLOSED
         self.error = self.error or "aborted"
         self.rto_deadline = None
+        self._delack_deadline = None
+        self._persist_deadline = None
 
     # ------------------------------------------------------------------
     # Timers
@@ -289,7 +316,9 @@ class TcpConnection:
 
     def next_timer_expiry(self) -> int | None:
         candidates = [t for t in (self.rto_deadline,
-                                  self.time_wait_deadline) if t is not None]
+                                  self.time_wait_deadline,
+                                  self._delack_deadline,
+                                  self._persist_deadline) if t is not None]
         return min(candidates) if candidates else None
 
     def on_timer(self, now: int) -> None:
@@ -298,8 +327,32 @@ class TcpConnection:
             self.time_wait_deadline = None
             if self.state == TIME_WAIT:
                 self.state = CLOSED
+        if self._delack_deadline is not None \
+                and now >= self._delack_deadline:
+            if self.state in (CLOSED, LISTEN):
+                self._delack_deadline = None
+            else:
+                self._emit_ack(now)  # clears the deadline
+        if self._persist_deadline is not None \
+                and now >= self._persist_deadline:
+            self._on_persist(now)
         if self.rto_deadline is not None and now >= self.rto_deadline:
             self._on_rto(now)
+
+    def _on_persist(self, now: int) -> None:
+        """Zero-window probe: 1 byte of new data past the window edge.
+        Linux-style exponential backoff; the probe is tracked in the rtx
+        queue so an opening window acks it normally."""
+        self._persist_deadline = None
+        if self.snd_wnd > 0 or not self.send_buf or self.rtx:
+            return
+        chunk = self._take_from_send_buf(1)
+        self._emit(TcpFlags.ACK | TcpFlags.PSH, seq=self.snd_nxt,
+                   payload=chunk, now=now, track=True)
+        self.snd_nxt = seq_add(self.snd_nxt, 1)
+        self._persist_interval = min(self._persist_interval * 2
+                                     or self.rto, MAX_RTO_NS)
+        self._persist_deadline = now + self._persist_interval
 
     def _on_rto(self, now: int) -> None:
         """Retransmission timeout (RFC 6298 5.4-5.7 + reno reset)."""
@@ -321,11 +374,7 @@ class TcpConnection:
         self.dupacks = 0
         self.in_fast_recovery = False
         self.rto = min(self.rto * 2, MAX_RTO_NS)
-        seg = self.rtx[0]
-        seg[3] = now
-        seg[4] = True  # Karn: no RTT sample from retransmits
-        self.retransmit_count += 1
-        self._transmit_segment(seg[0], seg[1], seg[2], now)
+        self._retransmit_one(now)  # Karn: marks the entry, no RTT sample
         self.rto_deadline = now + self.rto
 
     # ------------------------------------------------------------------
@@ -366,18 +415,33 @@ class TcpConnection:
 
     def accept_syn(self, hdr: TcpHeader, now: int) -> None:
         """Passive open: called on a child connection created by a
-        listener for an incoming SYN."""
+        listener for an incoming SYN. Negotiates MSS and window scaling
+        from the SYN's options (windows in SYN segments are unscaled,
+        RFC 7323 2.2)."""
         assert self.state in (CLOSED, LISTEN)
         self.irs = hdr.seq
         self.rcv_nxt = seq_add(hdr.seq, 1)
         self.snd_wnd = hdr.window
+        self._negotiate_options(hdr)
         self.state = SYN_RECEIVED
         self._emit_synack(now)
         self.snd_nxt = seq_add(self.iss, 1)
 
+    def _negotiate_options(self, hdr: TcpHeader) -> None:
+        if hdr.mss is not None:
+            self.eff_mss = min(MSS, hdr.mss)
+            # Negotiation happens before any data flows: rebuild the
+            # congestion state so IW10/ssthresh are sized for the real
+            # MSS rather than the 1460-byte default.
+            self.cong = type(self.cong)(mss=self.eff_mss)
+        if hdr.window_scale is not None:
+            self.our_wscale = WINDOW_SCALE
+            self.peer_wscale = min(hdr.window_scale, 14)
+
     def _emit_synack(self, now: int) -> None:
         self._emit(TcpFlags.SYN | TcpFlags.ACK, seq=self.iss, payload=b"",
-                   now=now, track=(self.snd_nxt == self.iss))
+                   now=now, track=(self.snd_nxt == self.iss), mss=MSS,
+                   window_scale=(WINDOW_SCALE if self.our_wscale else None))
 
     def _on_packet_syn_sent(self, hdr: TcpHeader, now: int) -> None:
         if (hdr.flags & (TcpFlags.SYN | TcpFlags.ACK)) == \
@@ -389,6 +453,7 @@ class TcpConnection:
             self.rcv_nxt = seq_add(hdr.seq, 1)
             self.snd_una = hdr.ack
             self.snd_wnd = hdr.window
+            self._negotiate_options(hdr)
             self._clear_acked(now)
             self.state = ESTABLISHED
             self._emit_ack(now)
@@ -401,6 +466,8 @@ class TcpConnection:
         self.state = CLOSED
         self.rto_deadline = None
         self.time_wait_deadline = None
+        self._delack_deadline = None
+        self._persist_deadline = None
 
     def _on_ack(self, hdr: TcpHeader, now: int,
                 is_pure_ack: bool = True) -> None:
@@ -409,8 +476,16 @@ class TcpConnection:
             # Acks something we never sent.
             self._emit_ack(now)
             return
-        window_changed = hdr.window != self.snd_wnd
-        self.snd_wnd = hdr.window
+        # Post-handshake windows arrive scaled (RFC 7323 2.2: every
+        # segment except the SYN itself).
+        wnd = hdr.window << self.peer_wscale
+        window_changed = wnd != self.snd_wnd
+        self.snd_wnd = wnd
+        if wnd > 0 and self._persist_deadline is not None:
+            self._persist_deadline = None
+            self._persist_interval = 0
+        if hdr.sack_blocks:
+            self._mark_sacked(hdr.sack_blocks)
         if seq_lt(self.snd_una, ack):
             self._handle_new_ack(ack, now)
         elif ack == self.snd_una and self.rtx and is_pure_ack \
@@ -446,12 +521,7 @@ class TcpConnection:
                 self.cong.on_exit_recovery()
             else:
                 # Partial ack: retransmit next hole immediately.
-                if self.rtx:
-                    seg = self.rtx[0]
-                    seg[3] = now
-                    seg[4] = True
-                    self.retransmit_count += 1
-                    self._transmit_segment(seg[0], seg[1], seg[2], now)
+                self._retransmit_one(now)
         else:
             self.cong.on_new_ack(acked)
         # RTO restart (RFC 6298 5.3).
@@ -467,18 +537,42 @@ class TcpConnection:
             self.cong.on_fast_retransmit(flight)
             self.in_fast_recovery = True
             self.recover = self.snd_nxt
-            if self.rtx:
-                seg = self.rtx[0]
-                seg[3] = now
-                seg[4] = True
-                self.retransmit_count += 1
-                self._transmit_segment(seg[0], seg[1], seg[2], now)
+            self._retransmit_one(now)
+
+    # --- SACK scoreboard (RFC 2018; ref tcp_retransmit_tally.cc) ---
+
+    def _mark_sacked(self, blocks) -> None:
+        """Mark rtx entries wholly covered by a reported block. Blocks
+        are (start, end) in the peer's receive-sequence space."""
+        for seg in self.rtx:
+            if seg[5]:
+                continue
+            seq = seg[0]
+            end = seq_add(seq, len(seg[1]) + (1 if seg[2] else 0)
+                          + (1 if seg[1] == b"" and not seg[2] else 0))
+            for start, stop in blocks:
+                if seq_leq(start, seq) and seq_leq(end, stop):
+                    seg[5] = True
+                    self.sacked_skip_count += 1
+                    break
+
+    def _retransmit_one(self, now: int) -> None:
+        """Retransmit the first hole: the earliest rtx entry the peer has
+        not SACKed (falling back to the head if everything is marked —
+        the peer may have renegged)."""
+        if not self.rtx:
+            return
+        seg = next((s for s in self.rtx if not s[5]), self.rtx[0])
+        seg[3] = now
+        seg[4] = True
+        self.retransmit_count += 1
+        self._transmit_segment(seg[0], seg[1], seg[2], now)
 
     def _clear_acked(self, now: int):
         """Drop fully-acked segments from the rtx queue; returns the RTT
         sample (ns) if the ack covers the timed segment, else None."""
         while self.rtx:
-            seq, payload, is_fin, sent_at, retransmitted = self.rtx[0]
+            seq, payload, is_fin, sent_at, retransmitted, sacked = self.rtx[0]
             # Sequence space consumed: data bytes, or 1 for SYN/FIN.
             end = seq_add(seq, len(payload) + (1 if is_fin else 0)
                           + (1 if payload == b"" and not is_fin else 0))
@@ -511,7 +605,52 @@ class TcpConnection:
     # ------------------------------------------------------------------
 
     def _recv_window(self) -> int:
-        return min(MAX_WINDOW, max(0, self.recv_buf_max - self.recv_buf_len))
+        """True receive window in bytes, bounded by what the negotiated
+        scale can represent on the wire."""
+        cap = MAX_WINDOW << self.our_wscale
+        return min(cap, max(0, self.recv_buf_max - self.recv_buf_len))
+
+    def _wire_window(self, flags: int) -> int:
+        """The 16-bit window field: scaled except in SYN segments."""
+        win = self._recv_window()
+        if flags & TcpFlags.SYN:
+            return min(win, MAX_WINDOW)
+        return min(win >> self.our_wscale, MAX_WINDOW)
+
+    def _sack_blocks(self) -> tuple:
+        """Contiguous runs held in reassembly, as (start, end) pairs in
+        ascending sequence order, capped at MAX_SACK_BLOCKS (RFC 2018).
+        Deterministic: derived purely from the reassembly map."""
+        if not self.reassembly:
+            return ()
+        seqs = sorted(self.reassembly, key=lambda s: seq_sub(s, self.rcv_nxt))
+        blocks = []
+        start = end = None
+        for s in seqs:
+            e = seq_add(s, len(self.reassembly[s]))
+            if start is None:
+                start, end = s, e
+            elif seq_leq(s, end):
+                if seq_lt(end, e):
+                    end = e
+            else:
+                blocks.append((start, end))
+                start, end = s, e
+        blocks.append((start, end))
+        return tuple(blocks[:MAX_SACK_BLOCKS])
+
+    def _ack_data(self, now: int, force: bool = False) -> None:
+        """Ack in-order data: immediately every second segment (or when
+        anything unusual is pending — holes, a gap just filled, FIN, a
+        pinched window), else arm the 40ms delack timer (RFC 1122
+        4.2.3.2; off switch delayed_ack=False)."""
+        self._segs_since_ack += 1
+        if (force or not self.delayed_ack or self._segs_since_ack >= 2
+                or self.reassembly or self.peer_fin_seq is not None
+                or self._recv_window() < self.eff_mss):
+            self._emit_ack(now)
+        elif self._delack_deadline is None:
+            self._delack_deadline = now + DELACK_NS
 
     def _on_data(self, seq: int, payload: bytes, now: int) -> None:
         if self.state not in (ESTABLISHED, FIN_WAIT_1, FIN_WAIT_2):
@@ -531,13 +670,14 @@ class TcpConnection:
             self._emit_ack(now)  # dupack → sender fast-retransmits
             return
         # In-order: deliver, then drain any contiguous stashed segments.
+        had_holes = bool(self.reassembly)
         self._deliver(payload)
         while self.rcv_nxt in self.reassembly:
             self._deliver(self.reassembly.pop(self.rcv_nxt))
         # An out-of-order FIN becomes processable once the gap fills.
         if self.pending_fin_seq == self.rcv_nxt:
             self._process_fin(now)
-        self._emit_ack(now)
+        self._ack_data(now, force=had_holes)
 
     def _deliver(self, payload: bytes) -> None:
         space = self.recv_buf_max - self.recv_buf_len
@@ -600,19 +740,30 @@ class TcpConnection:
         return seq_sub(self.snd_nxt, self.snd_una)
 
     def _push_data(self, now: int) -> None:
-        """Segmentize send_buf within min(cwnd, peer window)."""
+        """Segmentize send_buf within min(cwnd, peer window), in
+        eff_mss-sized segments. Nagle (RFC 896): hold sub-MSS data while
+        anything is unacked, unless nodelay or a FIN is pending."""
         if self.state not in (ESTABLISHED, CLOSE_WAIT, FIN_WAIT_1,
                               CLOSING, LAST_ACK):
             return
         window = min(self.cwnd, self.snd_wnd)
         while self.send_buf and self._flight() < window:
-            budget = min(window - self._flight(), MSS)
+            budget = min(window - self._flight(), self.eff_mss)
+            if (self.nagle and not self.nodelay and not self.snd_fin_pending
+                    and self.send_buf_len < min(budget, self.eff_mss)
+                    and self._flight() > 0):
+                break
             chunk = self._take_from_send_buf(budget)
             if not chunk:
                 break
             self._emit(TcpFlags.ACK | TcpFlags.PSH, seq=self.snd_nxt,
                        payload=chunk, now=now, track=True)
             self.snd_nxt = seq_add(self.snd_nxt, len(chunk))
+        if self.snd_wnd == 0 and self.send_buf and not self.rtx \
+                and self._persist_deadline is None \
+                and self.state in (ESTABLISHED, CLOSE_WAIT, FIN_WAIT_1):
+            self._persist_interval = self.rto
+            self._persist_deadline = now + self._persist_interval
         if self.snd_fin_pending and not self.send_buf \
                 and self.fin_seq is None:
             self.fin_seq = self.snd_nxt
@@ -642,28 +793,43 @@ class TcpConnection:
         # segment (its eventual ack is ambiguous).
         self._timed_end_seq = None
         flags = TcpFlags.ACK
+        mss = None
+        window_scale = None
         if is_fin:
             flags |= TcpFlags.FIN
         elif payload == b"" and seq == self.iss:
-            flags = TcpFlags.SYN  # retransmitted SYN
+            # Retransmitted SYN / SYN-ACK must carry the same options as
+            # the original, else a lost SYN-ACK leaves the two sides
+            # disagreeing about window scaling.
+            flags = TcpFlags.SYN
+            mss = MSS
+            window_scale = WINDOW_SCALE
             if self.state == SYN_RECEIVED:
                 flags = TcpFlags.SYN | TcpFlags.ACK
+                window_scale = WINDOW_SCALE if self.our_wscale else None
         elif payload:
             flags |= TcpFlags.PSH
         self.outbox.append((TcpHeader(
             seq=seq, ack=self.rcv_nxt, flags=flags,
-            window=self._recv_window()), payload))
+            window=self._wire_window(flags), mss=mss,
+            window_scale=window_scale,
+            sack_blocks=self._sack_blocks()), payload))
         self.segments_sent += 1
+        self._note_ack_sent()
 
     def _emit(self, flags: int, seq: int, payload: bytes, now: int,
-              track: bool = False, is_fin: bool = False) -> None:
+              track: bool = False, is_fin: bool = False,
+              mss: int | None = None,
+              window_scale: int | None = None) -> None:
         ack = self.rcv_nxt if (flags & TcpFlags.ACK) else 0
         self.outbox.append((TcpHeader(
-            seq=seq, ack=ack, flags=flags, window=self._recv_window()),
-            payload))
+            seq=seq, ack=ack, flags=flags, window=self._wire_window(flags),
+            mss=mss, window_scale=window_scale), payload))
         self.segments_sent += 1
+        if flags & TcpFlags.ACK:
+            self._note_ack_sent()
         if track:
-            self.rtx.append([seq, payload, is_fin, now, False])
+            self.rtx.append([seq, payload, is_fin, now, False, False])
             if self.rto_deadline is None:
                 self.rto_deadline = now + self.rto
             if self._timed_end_seq is None:
@@ -672,8 +838,16 @@ class TcpConnection:
                     + (1 if payload == b"" and not is_fin else 0))
                 self._timed_sent_at = now
 
+    def _note_ack_sent(self) -> None:
+        """Any segment carrying our current rcv_nxt satisfies a pending
+        delayed ack (piggybacking)."""
+        self._segs_since_ack = 0
+        self._delack_deadline = None
+
     def _emit_ack(self, now: int) -> None:
         self.outbox.append((TcpHeader(
             seq=self.snd_nxt, ack=self.rcv_nxt, flags=TcpFlags.ACK,
-            window=self._recv_window()), b""))
+            window=self._wire_window(TcpFlags.ACK),
+            sack_blocks=self._sack_blocks()), b""))
         self.segments_sent += 1
+        self._note_ack_sent()

@@ -95,7 +95,11 @@ def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
     for k, v in (experimental_extra or {}).items():
         exp_lines.append(f"  {k}: {v}")
     names = [f"host{i:05d}" for i in range(n_hosts)]
-    offsets = (1, 5, 11, 23, 47, 95)[:floods_per_host]
+    base_offsets = (1, 5, 11, 23, 47, 95)
+    if floods_per_host > len(base_offsets):
+        raise ValueError(f"floods_per_host > {len(base_offsets)} "
+                         f"not supported (got {floods_per_host})")
+    offsets = base_offsets[:floods_per_host]
     host_blocks = []
     for i, name in enumerate(names):
         procs = [f'      - {{ path: udp-sink, args: ["9000"], '

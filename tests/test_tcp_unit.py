@@ -74,6 +74,10 @@ def transfer(w: Wire, data: bytes, reader="b") -> bytes:
         if sent == len(data) and len(got) == len(data):
             return bytes(got)
         w.now += MS
+        # Fire any due timers (delayed-ack, RTO, persist) as the clock
+        # advances — the event loop would.
+        w.a.on_timer(w.now)
+        w.b.on_timer(w.now)
     raise AssertionError(f"transfer stalled: {len(got)}/{len(data)}")
 
 
@@ -234,3 +238,149 @@ def test_simultaneous_close():
     w.pump()
     assert w.a.state in (TIME_WAIT, CLOSED)
     assert w.b.state in (TIME_WAIT, CLOSED)
+
+
+def test_option_negotiation_wscale_and_mss():
+    w = Wire()
+    w.handshake()
+    # Both offered: scale active on both sides, MSS clamped to the min.
+    from shadow_tpu.tcp.connection import WINDOW_SCALE
+    assert w.a.our_wscale == WINDOW_SCALE and w.a.peer_wscale == WINDOW_SCALE
+    assert w.b.our_wscale == WINDOW_SCALE and w.b.peer_wscale == WINDOW_SCALE
+    assert w.a.eff_mss == MSS and w.b.eff_mss == MSS
+    # The true receive window (174760 default) now exceeds the unscaled
+    # 16-bit cap and is visible to the peer.
+    w.a.write(b"s" * 1000, w.now)
+    w.pump()
+    w.advance_to_next_timer()  # release b's delayed ack
+    w.pump()
+    assert w.a.snd_wnd > 65_535
+
+
+def test_no_wscale_when_peer_does_not_offer():
+    from shadow_tpu.net.packet import TcpHeader, TcpFlags
+    w = Wire()
+    w.a.open_active(w.now)
+    hdr, payload = w.a.outbox.popleft()
+    # Strip the peer's options, as a legacy stack would.
+    stripped = TcpHeader(seq=hdr.seq, ack=hdr.ack, flags=hdr.flags,
+                         window=hdr.window)
+    w.b.accept_syn(stripped, w.now)
+    w.pump()
+    assert w.a.state == ESTABLISHED
+    assert w.b.our_wscale == 0 and w.b.peer_wscale == 0
+    # a negotiated nothing either, since b's SYN-ACK offered no scale.
+    assert w.a.our_wscale == 0 and w.a.peer_wscale == 0
+    # Windows stay within the unscaled 16-bit range.
+    w.a.write(b"t" * 1000, w.now)
+    w.pump()
+    assert w.a.snd_wnd <= 65_535
+
+
+def test_sack_reduces_retransmits_on_burst_loss():
+    """Drop several non-adjacent segments from one window: SACK lets the
+    sender retransmit only the holes."""
+    def run(sack: bool):
+        w = Wire()
+        w.handshake()
+        if not sack:
+            # Disable SACK generation on the receiver.
+            w.b._sack_blocks = lambda: ()
+        drops = {1, 3, 5}
+        seen = {"n": -1}
+
+        def drop(d, h, p, i):
+            if d == "ab" and p:
+                seen["n"] += 1
+                return seen["n"] in drops
+            return False
+
+        w.drop_fn = drop
+        data = b"u" * (MSS * 10)
+        got = transfer(w, data)
+        assert got == data
+        return w.a.retransmit_count
+
+    with_sack = run(sack=True)
+    without = run(sack=False)
+    assert with_sack <= without
+    assert with_sack <= 4  # only the 3 holes (+ slack for an RTO edge)
+
+
+def test_delayed_ack_halves_pure_acks():
+    w = Wire()                       # delayed_ack on by default
+    w2 = Wire(delayed_ack=False)
+    for wire in (w, w2):
+        wire.handshake()
+        wire.a.write(b"v" * (MSS * 8), wire.now)
+        wire.pump()
+    # Receiver acked every 2nd segment vs every segment.
+    assert w.b.segments_sent < w2.b.segments_sent
+
+
+def test_delayed_ack_timer_fires_for_lone_segment():
+    w = Wire()
+    w.handshake()
+    w.a.write(b"k" * 100, w.now)
+    w.pump()
+    assert w.b.readable_bytes() == 100
+    # No ack yet: it is delayed.
+    assert w.a.snd_una != w.a.snd_nxt
+    w.advance_to_next_timer()   # 40ms delack
+    w.pump()
+    assert w.a.snd_una == w.a.snd_nxt
+
+
+def test_nagle_coalesces_small_writes():
+    w = Wire()
+    w.handshake()
+    sent_before = w.a.segments_sent
+    for _ in range(20):
+        w.a.write(b"ab", w.now)   # no pump: acks not yet back
+    # First write flies immediately; the rest coalesce while it is
+    # unacked.
+    assert w.a.segments_sent == sent_before + 1
+    w.pump()
+    w.advance_to_next_timer()  # receiver's delack releases the rest
+    w.pump()
+    for _ in range(5):
+        if w.b.readable_bytes() == 40:
+            break
+        w.advance_to_next_timer()
+        w.pump()
+    assert w.b.read(100, w.now) == b"ab" * 20
+    # Far fewer than 20 data segments crossed the wire.
+    assert w.a.segments_sent - sent_before < 8
+
+
+def test_nodelay_disables_nagle():
+    w = Wire()
+    w.handshake()
+    w.a.nodelay = True
+    sent_before = w.a.segments_sent
+    for _ in range(5):
+        w.a.write(b"cd", w.now)
+    assert w.a.segments_sent == sent_before + 5
+
+
+def test_zero_window_persist_probe():
+    w = Wire(recv_buf_max=2048, send_buf_max=1 << 20)
+    w.handshake()
+    w.a.write(b"p" * 8192, w.now)
+    w.pump()
+    # Receiver's buffer is full; sender is blocked on a zero window.
+    assert w.b.readable_bytes() == 2048
+    assert w.a.snd_wnd == 0
+    assert w.a._persist_deadline is not None
+    # The window-update ack after a read is LOST: without a persist
+    # probe the connection would deadlock.
+    w.b.read(2048, w.now)
+    while w.b.outbox:
+        w.b.outbox.popleft()   # drop the window update
+    for _ in range(40):
+        if w.b.readable_bytes() >= 1460:
+            break
+        w.advance_to_next_timer()
+        w.pump()
+    # The probe elicited an ack with the open window; data flowed again.
+    assert w.b.readable_bytes() >= 1460
