@@ -130,6 +130,48 @@ static uint64_t shim_sim_now(void) {
     return __atomic_load_n((uint64_t *)&g_ipc->sim_time_ns, __ATOMIC_ACQUIRE);
 }
 
+/* Emulated signal delivery (ref: shim/src/signals.rs).  The manager
+ * sends EV_SIGNAL in place of a response while this thread is parked in
+ * recv; we invoke the app's handler right here — i.e. on this thread's
+ * stack at a syscall boundary, which is where the kernel would deliver
+ * it — answer EV_SIGNAL_DONE, and go back to waiting for the real
+ * response.  Handler syscalls trap SIGSYS nested (SA_NODEFER on the
+ * trap handler) and are serviced by the manager before it sees DONE. */
+#define SHIM_SA_SIGINFO 0x00000004
+
+static void shim_run_signal_handler(const shim_event_t *ev) {
+    int signum = (int)ev->num;
+    void *handler = (void *)(uintptr_t)ev->args[0];
+    long flags = (long)ev->args[1];
+    if (flags & SHIM_SA_SIGINFO) {
+        siginfo_t si;
+        ucontext_t uc;
+        memset(&si, 0, sizeof(si));
+        memset(&uc, 0, sizeof(uc));
+        si.si_signo = signum;
+        si.si_code = (int)ev->args[2]; /* SI_USER / SI_KERNEL / CLD_* */
+        si.si_pid = (int)ev->args[3];
+        ((void (*)(int, siginfo_t *, void *))handler)(signum, &si, &uc);
+    } else {
+        ((void (*)(int))handler)(signum);
+    }
+}
+
+/* Receive the manager's next message on this thread's response slot,
+ * transparently running any emulated signal handlers it interleaves. */
+static void shim_recv_response(shim_event_t *ev) {
+    for (;;) {
+        slot_recv(&g_chan->to_shim, ev);
+        if (ev->kind != EV_SIGNAL)
+            return;
+        shim_run_signal_handler(ev);
+        shim_event_t done;
+        memset(&done, 0, sizeof(done));
+        done.kind = EV_SIGNAL_DONE;
+        slot_send(&g_chan->to_shadow, &done);
+    }
+}
+
 static long shim_ipc_syscall(long n, const long args[6]) {
     shim_event_t ev;
     memset(&ev, 0, sizeof(ev));
@@ -137,7 +179,7 @@ static long shim_ipc_syscall(long n, const long args[6]) {
     ev.num = n;
     memcpy(ev.args, args, sizeof(ev.args));
     slot_send(&g_chan->to_shadow, &ev);
-    slot_recv(&g_chan->to_shim, &ev);
+    shim_recv_response(&ev);
     if (ev.kind == EV_SYSCALL_COMPLETE)
         return ev.num;
     if (ev.kind == EV_SYSCALL_DO_NATIVE)
@@ -164,7 +206,7 @@ void shadowtpu_child_entry(ipc_chan_t *chan) {
     ev.kind = EV_START_REQ;
     ev.num = raw(SYS_gettid, 0, 0, 0, 0, 0, 0);
     slot_send(&chan->to_shadow, &ev);
-    slot_recv(&chan->to_shim, &ev);
+    shim_recv_response(&ev);
     if (ev.kind != EV_START_RES)
         shim_die("[shadow-tpu shim] bad thread-start handshake\n");
 }
@@ -184,7 +226,7 @@ static void shim_handle_clone(greg_t *gregs) {
     ev.num = SYS_clone;
     memcpy(ev.args, args, sizeof(ev.args));
     slot_send(&g_chan->to_shadow, &ev);
-    slot_recv(&g_chan->to_shim, &ev);
+    shim_recv_response(&ev);
     if (ev.kind == EV_SYSCALL_COMPLETE) {
         gregs[REG_RAX] = (greg_t)ev.num;
         return;
@@ -218,7 +260,7 @@ static void shim_handle_clone(greg_t *gregs) {
     ev.kind = EV_CLONE_DONE;
     ev.num = rv;
     slot_send(&g_chan->to_shadow, &ev);
-    slot_recv(&g_chan->to_shim, &ev);
+    shim_recv_response(&ev);
     if (ev.kind != EV_SYSCALL_COMPLETE)
         shim_die("[shadow-tpu shim] bad clone completion\n");
     gregs[REG_RAX] = (greg_t)ev.num;
@@ -441,7 +483,7 @@ static void shim_init(void) {
     ev.kind = EV_START_REQ;
     ev.num = (int64_t)raw(SYS_getpid, 0, 0, 0, 0, 0, 0);
     slot_send(&g_chan->to_shadow, &ev);
-    slot_recv(&g_chan->to_shim, &ev);
+    shim_recv_response(&ev);
     if (ev.kind != EV_START_RES)
         shim_die("[shadow-tpu shim] bad start handshake\n");
 }

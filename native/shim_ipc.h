@@ -33,7 +33,7 @@ typedef _Atomic uint64_t ipc_atomic_u64;
 #endif
 
 #define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
-#define SHIM_IPC_VERSION 2u
+#define SHIM_IPC_VERSION 3u
 
 /* Slot status values; the status word doubles as the futex word. */
 enum {
@@ -49,11 +49,19 @@ enum {
     EV_START_REQ  = 1, /* thread is up, waiting for clearance       */
     EV_SYSCALL    = 2, /* num + 6 args, please service              */
     EV_CLONE_DONE = 3, /* num = new native tid, or -errno           */
+    EV_SIGNAL_DONE = 4, /* emulated signal handler returned         */
     /* shadow -> shim */
     EV_START_RES          = 16, /* run the app                      */
     EV_SYSCALL_COMPLETE   = 17, /* num = return value               */
     EV_SYSCALL_DO_NATIVE  = 18, /* execute natively, don't ask      */
     EV_CLONE_RES          = 19, /* num = channel index for the child */
+    /* Emulated signal delivery (ref: shim/src/signals.rs — handlers
+     * run inside the managed process).  Sent in place of a syscall
+     * response while the thread is parked in recv; num = signum,
+     * args[0] = handler address, args[1] = sa_flags.  The shim invokes
+     * the handler, replies EV_SIGNAL_DONE, and resumes waiting for the
+     * real response of the interrupted syscall. */
+    EV_SIGNAL             = 20,
 };
 
 typedef struct {

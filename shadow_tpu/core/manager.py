@@ -236,13 +236,17 @@ class Manager:
         from shadow_tpu.core.event import TaskRef
         host.schedule_task_at(pcfg.start_time_ns, TaskRef("spawn", spawn))
         if pcfg.shutdown_time_ns is not None:
-            # Internal apps have no signal delivery yet: shutdown = forced
-            # exit of *this* process's still-running threads.
+            # Deliver the configured shutdown signal through the emulated
+            # signal path (ref: configuration.rs host process spec) — a
+            # managed process with a handler exits through it; default
+            # disposition terminates.
+            from shadow_tpu.host.signals import parse_signal
+            shutdown_sig = parse_signal(pcfg.shutdown_signal or "SIGTERM")
+
             def shutdown(h):
                 for proc in spawned:
                     if not proc.exited:
-                        for t in list(proc.threads):
-                            t._exit(h, 0)
+                        proc.raise_signal(h, shutdown_sig)
             host.schedule_task_at(pcfg.shutdown_time_ns,
                                   TaskRef("shutdown", shutdown))
 

@@ -28,11 +28,13 @@ import signal
 import time as _walltime
 
 from shadow_tpu.core.event import TaskRef
+from shadow_tpu.host import signals as sigmod
 from shadow_tpu.host.futex import FutexTable
 from shadow_tpu.host.process import Process, ST_BLOCKED, ST_EXITED, ST_RUNNABLE
 from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
-                                      EV_CLONE_DONE, EV_CLONE_RES,
-                                      EV_START_REQ, EV_START_RES, EV_SYSCALL,
+                                      EV_CLONE_DONE, EV_CLONE_RES, EV_SIGNAL,
+                                      EV_SIGNAL_DONE, EV_START_REQ,
+                                      EV_START_RES, EV_SYSCALL,
                                       EV_SYSCALL_COMPLETE,
                                       EV_SYSCALL_DO_NATIVE)
 from shadow_tpu.host.syscalls_native import syscall_name
@@ -187,6 +189,83 @@ class ManagedProcess(Process):
                     setattr(self, buf_name,
                             getattr(self, buf_name) + bytearray(f.read()))
 
+    # -- emulated signals (ref: process.rs signal ingest,
+    #    shim/src/signals.rs) --------------------------------------------
+
+    def raise_signal(self, host, sig: int, target_tid: int | None = None,
+                     si_code: int = 0) -> None:
+        """Queue `sig` for delivery (kill/tgkill/itimer/shutdown_signal).
+
+        Delivery is deterministic: the chosen thread gets the signal at
+        its next syscall response point, and a thread parked in an
+        interruptible blocking syscall is woken through the event queue
+        to take it (-EINTR / SA_RESTART protocol)."""
+        if self.exited or sig <= 0 or sig >= sigmod.NSIG:
+            return
+        sigs = self.signals
+        if sigs.disposition(sig) == "ignore":
+            return  # discarded at generation time, even if blocked
+        if sig == sigmod.SIGKILL:
+            self.terminate_by_signal(host, sig)
+            return
+        live = [t for t in self.threads if t.state != ST_EXITED]
+        if not live:
+            return
+        if target_tid is not None:
+            target = next((t for t in live if t.tid == target_tid), None)
+            if target is None:
+                return
+        else:
+            unblocked = [t for t in live
+                         if not (t.sig_mask & sigmod.bit(sig))]
+            if not unblocked:
+                sigs.pending_process.add(sig)
+                return
+            target = min(unblocked, key=lambda t: t.tid)
+        target.sig_pending.add(sig)
+        if target.sig_mask & sigmod.bit(sig):
+            return  # stays pending until the thread unblocks it
+        # A sigtimedwait-style waiter consumes the signal directly
+        # (no handler runs).
+        if getattr(target, "_sigwait_set", 0) & sigmod.bit(sig) and \
+                target.state == ST_BLOCKED:
+            target.sig_pending.discard(sig)
+            target._sigwait_got = sig
+            if target.last_condition is not None:
+                target.last_condition.fire(host)
+            return
+        if sigs.disposition(sig) == "terminate":
+            self.terminate_by_signal(host, sig)
+            return
+        if target.state == ST_BLOCKED:
+            target._sig_interrupted = True
+            cond = target.last_condition
+            if cond is not None and getattr(cond, "_armed", False):
+                cond.disarm()
+                target.last_condition = None
+                host.schedule_task_at(host.now(),
+                                      TaskRef("signal-wake", target._wakeup))
+            # else: the condition already fired and a wakeup task is
+            # queued; that resume will deliver the signal first.
+        # Runnable threads take it at their next response point.
+
+    def terminate_by_signal(self, host, sig: int) -> None:
+        """Default-action termination (uncaught fatal signal)."""
+        if self.exited:
+            return
+        self.term_signal = sig
+        if self.native_pid is not None:
+            try:
+                os.kill(self.native_pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            for t in self.threads:
+                if isinstance(t, ManagedThread):
+                    t._poll_death(host, blocking=True)
+                    return
+        self.exited = True
+        self.exit_code = 128 + sig
+
     def kill_native(self) -> None:
         """Forced teardown (simulation shutdown with the process still
         running)."""
@@ -223,6 +302,14 @@ class ManagedThread:
         self._pending_call = None      # (num, args) to re-dispatch
         self.last_condition = None
         self._unapplied_ns = 0
+        # Emulated signal state (ref thread.rs:533+ pending signals).
+        self.sig_mask = 0              # blocked-signal bitmask
+        self.sig_pending: set[int] = set()
+        self._sig_interrupted = False  # a signal disarmed our condition
+        self._post_handler = []        # continuations parked during handlers
+        self._suspend_restore = None   # rt_sigsuspend saved mask
+        self._sigwait_set = 0          # rt_sigtimedwait watch set
+        self._sigwait_got = None
 
     # -- latency model ------------------------------------------------
 
@@ -279,6 +366,30 @@ class ManagedThread:
             self.chan.send_to_shim(EV_START_RES)
             self._released = True
 
+        # Emulated signal delivery at the resume boundary: a signal that
+        # interrupted a blocked syscall, or arrived while parked for CPU
+        # latency, is delivered (handler invoked shim-side) before the
+        # owed response goes out.
+        if self.process.signals.has_deliverable(self):
+            interrupted, self._sig_interrupted = self._sig_interrupted, False
+            if interrupted and self._pending_call is not None:
+                r = self._deliver_signals(host, self._interrupted_cont)
+            elif self._pending_response is not None:
+                k, v = self._pending_response
+                self._pending_response = None
+                r = self._deliver_signals(host, ("resp", k, v, None))
+                if r == "none":
+                    # Every pending signal turned out ignorable (its
+                    # disposition flipped while we were parked): the
+                    # owed response must still go out below.
+                    self._pending_response = (k, v)
+            else:
+                r = "none"  # no owed response: next response point takes it
+            if r == "dead":
+                return
+        else:
+            self._sig_interrupted = False
+
         if self._pending_response is not None:
             kind, value = self._pending_response
             self._pending_response = None
@@ -290,16 +401,95 @@ class ManagedThread:
             if not self._service(host, num, args, restarted=True):
                 return
 
+        self._pump(host)
+
+    def _pump(self, host) -> None:
         while True:
             ev = self._recv(host)
             if ev is None:
                 return
             kind, num, args = ev
+            if kind == EV_SIGNAL_DONE:
+                if not self._handler_returned(host):
+                    return
+                continue
             if kind != EV_SYSCALL:
                 self._protocol_error(host, f"unexpected event kind {kind}")
                 return
             if not self._service(host, num, args, restarted=False):
                 return
+
+    # -- emulated signal delivery -------------------------------------
+
+    def _interrupted_cont(self, sig: int):
+        """Continuation for the blocked syscall `sig` interrupted:
+        SA_RESTART re-runs restartable calls, everything else -EINTR
+        (handler/mod.rs restart protocol; man 7 signal)."""
+        import errno as _errno
+        num, args = self._pending_call
+        self._pending_call = None
+        self._sigwait_set = 0
+        act = self.process.signals.action(sig)
+        name = syscall_name(num)
+        if (act.flags & sigmod.SA_RESTART) and name in sigmod.RESTARTABLE:
+            return ("call", num, args)
+        restore = None
+        if name == "rt_sigsuspend":
+            restore, self._suspend_restore = self._suspend_restore, None
+        return ("resp", EV_SYSCALL_COMPLETE, -_errno.EINTR, restore)
+
+    def _deliver_signals(self, host, cont):
+        """Deliver the next deliverable pending signal; `cont` (a tuple,
+        or a callable sig->tuple) is what to do once the handler
+        returns.  Returns "sent" (EV_SIGNAL dispatched, cont parked),
+        "dead" (default action terminated the process), or "none"
+        (nothing deliverable — caller proceeds with cont itself)."""
+        sigs = self.process.signals
+        while True:
+            sig = sigs.take_deliverable(self)
+            if sig is None:
+                return "none"
+            disp = sigs.disposition(sig)
+            if disp == "ignore":
+                continue
+            if disp == "terminate":
+                self.process.terminate_by_signal(host, sig)
+                return "dead"
+            act = sigs.action(sig)
+            saved_mask = self.sig_mask
+            self.sig_mask |= act.mask
+            if not (act.flags & sigmod.SA_NODEFER):
+                self.sig_mask |= sigmod.bit(sig)
+            if act.flags & sigmod.SA_RESETHAND:
+                sigs.actions.pop(sig, None)
+            resolved = cont(sig) if callable(cont) else cont
+            self._post_handler.append((resolved, saved_mask))
+            self.chan.send_to_shim(EV_SIGNAL, sig,
+                                   (act.handler, act.flags, 0, 0, 0, 0))
+            return "sent"
+
+    def _handler_returned(self, host) -> bool:
+        """EV_SIGNAL_DONE: restore the mask, deliver any further pending
+        signal, then run the parked continuation.  Returns False when
+        the pump must stop (process died / re-blocked)."""
+        if not self._post_handler:
+            self._protocol_error(host, "SIGNAL_DONE without handler")
+            return False
+        cont, saved_mask = self._post_handler.pop()
+        self.sig_mask = saved_mask
+        r = self._deliver_signals(host, cont)
+        if r == "sent":
+            return True
+        if r == "dead":
+            return False
+        if cont[0] == "resp":
+            _k, rk, rv, restore = cont
+            self.chan.send_to_shim(rk, rv)
+            if restore is not None:
+                self.sig_mask = restore
+            return True
+        _k, num, args = cont  # ("call", ...) — SA_RESTART re-dispatch
+        return self._service(host, num, args, restarted=False)
 
     def _service(self, host, num: int, args, restarted: bool) -> bool:
         """Dispatch one syscall; returns True to keep pumping events."""
@@ -368,6 +558,24 @@ class ManagedThread:
             rv_kind, rv_val = EV_SYSCALL_COMPLETE, -int(err.errno or 22)
         else:  # pragma: no cover
             raise AssertionError(f"bad dispatch result {result!r}")
+
+        # The dispatch may have terminated this very process (a
+        # self-directed fatal signal): the channel is gone, stop pumping.
+        if self.state == ST_EXITED or process.exited:
+            return False
+
+        # Response point: emulated signals are delivered before the
+        # response reaches the app (the kernel's return-to-user check).
+        if process.signals.has_deliverable(self):
+            restore = None
+            if syscall_name(num) == "rt_sigsuspend":
+                restore, self._suspend_restore = self._suspend_restore, None
+            r = self._deliver_signals(
+                host, ("resp", rv_kind, rv_val, restore))
+            if r == "sent":
+                return True
+            if r == "dead":
+                return False
 
         self.add_cpu_latency(SYSCALL_LATENCY_NS)
         if self._unapplied_ns >= MAX_UNAPPLIED_NS:
@@ -463,6 +671,10 @@ class ManagedThread:
         if self.state == ST_EXITED:
             return
         process = self.process
+        if process.term_signal is not None:
+            # Killed by an *emulated* fatal signal (the native reap saw
+            # our SIGKILL; report the simulated signal instead).
+            code = 128 + process.term_signal
         for t in process.threads:
             if isinstance(t, ManagedThread) and t.state != ST_EXITED:
                 t.state = ST_EXITED

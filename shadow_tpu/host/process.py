@@ -42,6 +42,8 @@ class Thread:
         self._pending_send = None   # result to feed into the generator
         self._pending_throw = None  # OSError to raise into the generator
         self.last_condition = None
+        self.sig_mask = 0
+        self.sig_pending: set[int] = set()
 
     def resume(self, host) -> None:
         """Drive the app generator until it blocks or exits
@@ -139,6 +141,9 @@ class Process:
         self._next_tid = self.pid
         self.exited = False
         self.exit_code: int | None = None
+        self.term_signal: int | None = None  # fatal emulated signal
+        from shadow_tpu.host.signals import ProcessSignals
+        self.signals = ProcessSignals()
         self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
         self.stderr = bytearray()
@@ -194,6 +199,20 @@ class Process:
             self.fds.close_all(host)
             self.strace_close()
 
+    def raise_signal(self, host, sig: int, target_tid=None,
+                     si_code: int = 0) -> None:
+        """Internal (Python) apps have no handler mechanism: non-ignored
+        signals apply the default action — terminate (man 7 signal).
+        ManagedProcess overrides this with full handler delivery."""
+        from shadow_tpu.host.signals import NSIG
+        if self.exited or sig <= 0 or sig >= NSIG:
+            return
+        if self.signals.disposition(sig) == "ignore":
+            return
+        self.term_signal = sig
+        for t in list(self.threads):
+            t._exit(host, 128 + sig)
+
     def matches_expected_final_state(self) -> bool:
         expected = self.expected_final_state
         if expected in ("running", "any"):
@@ -201,7 +220,14 @@ class Process:
         if isinstance(expected, str) and expected.startswith("exited"):
             parts = expected.split()
             want = int(parts[1]) if len(parts) > 1 else 0
-            return self.exited and self.exit_code == want
+            return self.exited and self.exit_code == want \
+                and self.term_signal is None
+        if isinstance(expected, str) and expected.startswith("signaled"):
+            from shadow_tpu.host.signals import parse_signal
+            parts = expected.split()
+            if self.term_signal is None:
+                return False
+            return len(parts) < 2 or self.term_signal == parse_signal(parts[1])
         return True
 
 

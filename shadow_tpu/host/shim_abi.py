@@ -23,7 +23,7 @@ import struct
 
 # --- constants mirrored from native/shim_ipc.h ---------------------
 MAGIC = 0x53545055
-VERSION = 2
+VERSION = 3
 FILE_SIZE = 24576
 
 N_CHANS = 64
@@ -40,10 +40,12 @@ EV_NULL = 0
 EV_START_REQ = 1
 EV_SYSCALL = 2
 EV_CLONE_DONE = 3
+EV_SIGNAL_DONE = 4
 EV_START_RES = 16
 EV_SYSCALL_COMPLETE = 17
 EV_SYSCALL_DO_NATIVE = 18
 EV_CLONE_RES = 19
+EV_SIGNAL = 20
 
 OFF_MAGIC = 0
 OFF_VERSION = 4

@@ -40,8 +40,10 @@ EMU_FD_BASE = 400  # leaves room for select() fd_sets (FD_SETSIZE=1024)
 # --- x86-64 syscall numbers (linux-api equivalents we dispatch on) ---
 SYS = {
     0: "read", 1: "write", 3: "close", 7: "poll", 13: "rt_sigaction",
+    14: "rt_sigprocmask", 15: "rt_sigreturn",
     16: "ioctl", 19: "readv", 20: "writev", 22: "pipe", 23: "select",
     24: "sched_yield", 32: "dup", 33: "dup2", 34: "pause", 35: "nanosleep",
+    36: "getitimer", 38: "setitimer",
     37: "alarm", 39: "getpid", 41: "socket", 42: "connect", 43: "accept",
     44: "sendto", 45: "recvfrom", 46: "sendmsg", 47: "recvmsg",
     48: "shutdown", 49: "bind", 50: "listen", 51: "getsockname",
@@ -50,7 +52,10 @@ SYS = {
     60: "exit", 61: "wait4", 62: "kill", 63: "uname", 72: "fcntl",
     96: "gettimeofday", 99: "sysinfo", 100: "times", 102: "getuid",
     104: "getgid", 107: "geteuid", 108: "getegid", 110: "getppid",
-    124: "getsid", 157: "prctl", 186: "gettid", 201: "time", 202: "futex",
+    124: "getsid", 127: "rt_sigpending", 128: "rt_sigtimedwait",
+    130: "rt_sigsuspend", 131: "sigaltstack", 157: "prctl",
+    186: "gettid", 200: "tkill", 201: "time", 202: "futex",
+    234: "tgkill",
     213: "epoll_create", 218: "set_tid_address", 228: "clock_gettime",
     229: "clock_getres", 230: "clock_nanosleep", 231: "exit_group",
     232: "epoll_wait", 233: "epoll_ctl", 247: "waitid", 257: "openat",
@@ -1027,9 +1032,99 @@ class NativeSyscallHandler:
             return _done(0)
         return _block(SyscallCondition(timeout_at=target))
 
+    # -- ITIMER_REAL / alarm: SIGALRM at a simulated deadline ---------
+
+    @staticmethod
+    def _itimer_remaining_ns(host, process) -> int:
+        fire_at = getattr(process, "itimer_fire_at", None)
+        if fire_at is None:
+            return 0
+        return max(0, fire_at - host.now())
+
+    @staticmethod
+    def _itimer_schedule(host, process, when: int) -> None:
+        """Queue a wakeup at `when` unless an already-queued one covers
+        it (re-arming alarm(N) per request must not accumulate one dead
+        task per call in the event queue — the hot path)."""
+        from shadow_tpu.core.event import TaskRef
+        wakes = process.__dict__.setdefault("_itimer_wakes", [])
+        if any(w <= when for w in wakes):
+            return  # an earlier task will re-check fire_at and re-park
+        wakes.append(when)
+        host.schedule_task_at(when, TaskRef(
+            "itimer",
+            lambda h, w=when: NativeSyscallHandler._itimer_fire(
+                h, process, w)))
+
+    @staticmethod
+    def _itimer_fire(host, process, when: int) -> None:
+        from shadow_tpu.host.signals import SIGALRM
+        wakes = process.__dict__.setdefault("_itimer_wakes", [])
+        try:
+            wakes.remove(when)
+        except ValueError:
+            pass
+        if process.exited:
+            return
+        target = getattr(process, "itimer_fire_at", None)
+        if target is None:
+            return  # disarmed since this task was queued
+        if host.now() < target:
+            NativeSyscallHandler._itimer_schedule(host, process, target)
+            return  # re-armed to a later deadline; re-park once
+        if getattr(process, "itimer_interval", 0):
+            process.itimer_fire_at = host.now() + process.itimer_interval
+            NativeSyscallHandler._itimer_schedule(host, process,
+                                                  process.itimer_fire_at)
+        else:
+            process.itimer_fire_at = None
+        process.raise_signal(host, SIGALRM)
+
+    @staticmethod
+    def _itimer_set(host, process, value_ns: int, interval_ns: int) -> None:
+        process.itimer_interval = interval_ns
+        if value_ns <= 0:
+            process.itimer_fire_at = None
+            return
+        process.itimer_fire_at = host.now() + value_ns
+        NativeSyscallHandler._itimer_schedule(host, process,
+                                              process.itimer_fire_at)
+
     def sys_alarm(self, host, process, thread, restarted, seconds, *_):
-        # No emulated signal delivery yet; accepted and ignored (alarm
-        # is almost always paired with a handler we don't deliver).
+        remaining = self._itimer_remaining_ns(host, process)
+        self._itimer_set(host, process, int(seconds) * 10**9, 0)
+        return _done((remaining + 10**9 - 1) // 10**9)
+
+    _ITIMERVAL = struct.Struct("<qqqq")  # interval sec/usec, value sec/usec
+
+    def sys_setitimer(self, host, process, thread, restarted, which,
+                      new_ptr, old_ptr, *_):
+        if which != 0:  # ITIMER_REAL only (VIRTUAL/PROF need cpu time)
+            return _error(errno.ENOSYS)
+        if old_ptr:
+            rem = self._itimer_remaining_ns(host, process)
+            iv = getattr(process, "itimer_interval", 0)
+            process.mem.write(old_ptr, self._ITIMERVAL.pack(
+                iv // 10**9, (iv % 10**9) // 1000,
+                rem // 10**9, (rem % 10**9) // 1000))
+        if new_ptr:
+            isec, iusec, vsec, vusec = self._ITIMERVAL.unpack(
+                process.mem.read(new_ptr, 32))
+            self._itimer_set(host, process,
+                             vsec * 10**9 + vusec * 1000,
+                             isec * 10**9 + iusec * 1000)
+        return _done(0)
+
+    def sys_getitimer(self, host, process, thread, restarted, which,
+                      curr_ptr, *_):
+        if which != 0:
+            return _error(errno.ENOSYS)
+        if curr_ptr:
+            rem = self._itimer_remaining_ns(host, process)
+            iv = getattr(process, "itimer_interval", 0)
+            process.mem.write(curr_ptr, self._ITIMERVAL.pack(
+                iv // 10**9, (iv % 10**9) // 1000,
+                rem // 10**9, (rem % 10**9) // 1000))
         return _done(0)
 
     def sys_pause(self, host, process, thread, restarted, *_):
@@ -1110,18 +1205,161 @@ class NativeSyscallHandler:
     # Guard rails
     # ------------------------------------------------------------------
 
+    # -- signals (ref: handler/signal.rs + shim/src/signals.rs; our
+    #    delivery machinery lives in host/signals.py + managed.py) ----
+
+    _SIG_BLOCK, _SIG_UNBLOCK, _SIG_SETMASK = 0, 1, 2
+
     def sys_rt_sigaction(self, host, process, thread, restarted, signum,
                          act_ptr, old_ptr, sigsetsize, *_):
-        if signum == SIGSYS and act_ptr:
-            # Protect the shim's SIGSYS handler; pretend success.
-            return _done(0)
-        return _native()
+        from shadow_tpu.host import signals as S
+        if signum < 1 or signum >= S.NSIG or \
+                (act_ptr and signum in (S.SIGKILL, S.SIGSTOP)):
+            return _error(errno.EINVAL)
+        sigs = process.signals
+        old = sigs.action(signum)
+        if act_ptr:
+            handler, flags, restorer, mask = struct.unpack(
+                "<QQQQ", process.mem.read(act_ptr, 32))
+            sigs.actions[signum] = S.SigAction(handler, flags, restorer,
+                                               mask)
+        if old_ptr:
+            process.mem.write(old_ptr, struct.pack(
+                "<QQQQ", old.handler, old.flags, old.restorer, old.mask))
+        # Hardware-fault handlers are ALSO installed natively so a real
+        # fault in managed code (e.g. a GC's intentional SIGSEGV)
+        # reaches the app handler; SIGSYS stays the shim's.
+        if act_ptr and signum in S.FAULT_SIGNALS:
+            return _native()
+        return _done(0)
+
+    def sys_rt_sigprocmask(self, host, process, thread, restarted, how,
+                           set_ptr, old_ptr, sigsetsize, *_):
+        from shadow_tpu.host import signals as S
+        old = thread.sig_mask
+        if old_ptr:
+            process.mem.write(old_ptr, struct.pack("<Q", old))
+        if set_ptr:
+            (m,) = struct.unpack("<Q", process.mem.read(set_ptr, 8))
+            if how == self._SIG_BLOCK:
+                new = old | m
+            elif how == self._SIG_UNBLOCK:
+                new = old & ~m
+            elif how == self._SIG_SETMASK:
+                new = m
+            else:
+                return _error(errno.EINVAL)
+            thread.sig_mask = new & ~(S.bit(S.SIGKILL) | S.bit(S.SIGSTOP))
+        # Newly unblocked pending signals are picked up at this response
+        # point by the ManagedThread delivery check.
+        return _done(0)
+
+    def sys_rt_sigpending(self, host, process, thread, restarted, set_ptr,
+                          sigsetsize, *_):
+        if set_ptr:
+            mask = process.signals.pending_mask(thread) & thread.sig_mask
+            process.mem.write(set_ptr, struct.pack("<Q", mask))
+        return _done(0)
+
+    def sys_rt_sigsuspend(self, host, process, thread, restarted, mask_ptr,
+                          sigsetsize, *_):
+        from shadow_tpu.core import simtime
+        from shadow_tpu.host import signals as S
+        if restarted:  # spurious resume without a signal: keep waiting
+            return _block(SyscallCondition(
+                timeout_at=simtime.TIME_NEVER - 1))
+        (m,) = struct.unpack("<Q", process.mem.read(mask_ptr, 8))
+        thread._suspend_restore = thread.sig_mask
+        thread.sig_mask = m & ~(S.bit(S.SIGKILL) | S.bit(S.SIGSTOP))
+        if process.signals.has_deliverable(thread):
+            # Deliverable immediately: the response-point check runs the
+            # handler, then this EINTR goes out with the mask restored.
+            return _error(errno.EINTR)
+        return _block(SyscallCondition(timeout_at=simtime.TIME_NEVER - 1))
+
+    def sys_rt_sigtimedwait(self, host, process, thread, restarted,
+                            set_ptr, info_ptr, ts_ptr, sigsetsize, *_):
+        from shadow_tpu.host import signals as S
+        (want,) = struct.unpack("<Q", process.mem.read(set_ptr, 8))
+        if restarted:
+            got, thread._sigwait_got = thread._sigwait_got, None
+            thread._sigwait_set = 0
+            if got is None:
+                return _error(errno.EAGAIN)  # timed out
+            if info_ptr:
+                process.mem.write(info_ptr, struct.pack(
+                    "<iii", got, 0, 0) + b"\0" * 116)
+            return _done(got)
+        # Already pending?
+        pending = sorted(thread.sig_pending |
+                         process.signals.pending_process)
+        for s in pending:
+            if want & S.bit(s):
+                thread.sig_pending.discard(s)
+                process.signals.pending_process.discard(s)
+                if info_ptr:
+                    process.mem.write(info_ptr, struct.pack(
+                        "<iii", s, 0, 0) + b"\0" * 116)
+                return _done(s)
+        timeout_at = None
+        if ts_ptr:
+            sec, nsec = _TIMESPEC.unpack(process.mem.read(ts_ptr, 16))
+            if sec == 0 and nsec == 0:
+                return _error(errno.EAGAIN)
+            timeout_at = host.now() + sec * 10**9 + nsec
+        else:
+            from shadow_tpu.core import simtime
+            timeout_at = simtime.TIME_NEVER - 1
+        thread._sigwait_set = want
+        from shadow_tpu.host.condition import ManualCondition
+        return _block(ManualCondition(timeout_at=timeout_at))
+
+    def sys_sigaltstack(self, host, process, thread, restarted, *_):
+        return _native()  # only affects native (fault) delivery
+
+    def sys_rt_sigreturn(self, host, process, thread, restarted, *_):
+        return _native()  # seccomp always allows it; defensive
+
+    def _signal_target(self, host, process, pid: int):
+        if pid > 0:
+            return host.processes.get(pid)
+        if pid in (0, -1):
+            # Process groups collapse to the caller's own process (each
+            # emulated process is its own group/session).
+            return process
+        return host.processes.get(-pid)
 
     def sys_kill(self, host, process, thread, restarted, pid, sig, *_):
-        # Signals to self are the only meaningful target in-sim.
-        if pid in (process.pid, 0) and sig == 0:
+        from shadow_tpu.host import signals as S
+        if sig < 0 or sig >= S.NSIG:
+            return _error(errno.EINVAL)
+        target = self._signal_target(host, process, pid)
+        if target is None:
+            return _error(errno.ESRCH)
+        if sig == 0:
             return _done(0)
-        return _error(errno.EPERM)
+        target.raise_signal(host, sig)
+        return _done(0)
+
+    def sys_tkill(self, host, process, thread, restarted, tid, sig, *_):
+        return self.sys_tgkill(host, process, thread, restarted,
+                               process.pid, tid, sig)
+
+    def sys_tgkill(self, host, process, thread, restarted, tgid, tid, sig,
+                   *_):
+        from shadow_tpu.host import signals as S
+        if sig < 0 or sig >= S.NSIG:
+            return _error(errno.EINVAL)
+        from shadow_tpu.host.process import ST_EXITED
+        target = host.processes.get(tgid)
+        if target is None or not any(
+                t.tid == tid and t.state != ST_EXITED
+                for t in target.threads):
+            return _error(errno.ESRCH)
+        if sig == 0:
+            return _done(0)
+        target.raise_signal(host, sig, target_tid=tid)
+        return _done(0)
 
     def sys_prctl(self, host, process, thread, restarted, option, *rest):
         PR_SET_SECCOMP = 22

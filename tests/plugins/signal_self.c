@@ -1,0 +1,64 @@
+/* Emulated signal semantics, dual-target (native vs simulated):
+ *  1. kill(self) delivers synchronously before kill() returns;
+ *  2. a blocked signal stays pending (sigpending sees it) and is
+ *     delivered by sigprocmask(SIG_UNBLOCK);
+ *  3. alarm() interrupts pause() after exactly 2 (simulated) seconds;
+ *  4. nanosleep() interrupted by SIGALRM returns -1/EINTR.
+ */
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t got_usr1, got_usr2, got_alrm;
+static void h_usr1(int s) { (void)s; got_usr1 = 1; }
+static void h_usr2(int s) { (void)s; got_usr2 = 1; }
+static void h_alrm(int s) { (void)s; got_alrm = 1; }
+
+static long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000L + ts.tv_nsec;
+}
+
+int main(void) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = h_usr1; sigaction(SIGUSR1, &sa, 0);
+    sa.sa_handler = h_usr2; sigaction(SIGUSR2, &sa, 0);
+    sa.sa_handler = h_alrm; sigaction(SIGALRM, &sa, 0);
+
+    kill(getpid(), SIGUSR1);
+    if (!got_usr1) { puts("FAIL usr1-sync"); return 1; }
+
+    sigset_t set, pend;
+    sigemptyset(&set);
+    sigaddset(&set, SIGUSR2);
+    sigprocmask(SIG_BLOCK, &set, 0);
+    kill(getpid(), SIGUSR2);
+    if (got_usr2) { puts("FAIL usr2-early"); return 2; }
+    sigpending(&pend);
+    if (!sigismember(&pend, SIGUSR2)) { puts("FAIL usr2-pending"); return 3; }
+    sigprocmask(SIG_UNBLOCK, &set, 0);
+    if (!got_usr2) { puts("FAIL usr2-unblock"); return 4; }
+
+    long t0 = now_ns();
+    alarm(2);
+    pause();
+    long dt = now_ns() - t0;
+    if (!got_alrm) { puts("FAIL alrm"); return 5; }
+    printf("alarm_dt_ns=%ld\n", dt);
+
+    got_alrm = 0;
+    alarm(1);
+    struct timespec req = {5, 0};
+    int r = nanosleep(&req, 0);
+    if (r == 0 || errno != EINTR || !got_alrm) {
+        puts("FAIL eintr");
+        return 6;
+    }
+    puts("OK");
+    return 0;
+}

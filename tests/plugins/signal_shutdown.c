@@ -1,0 +1,30 @@
+/* shutdown_signal delivery: with argv[1]=="handle" installs a SIGTERM
+ * handler and exits gracefully (code 0) when the manager delivers the
+ * configured shutdown signal at shutdown_time; with "default" it has no
+ * handler, so the default disposition (terminate) applies and the final
+ * state is signaled:SIGTERM. */
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t stop;
+static void on_term(int s) { (void)s; stop = 1; }
+
+int main(int argc, char **argv) {
+    if (argc > 1 && strcmp(argv[1], "handle") == 0) {
+        struct sigaction sa;
+        memset(&sa, 0, sizeof(sa));
+        sa.sa_handler = on_term;
+        sigaction(SIGTERM, &sa, 0);
+    }
+    while (!stop) {
+        struct timespec req = {3600, 0};
+        nanosleep(&req, 0);  /* interrupted by SIGTERM */
+    }
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    printf("graceful_exit_at_s=%ld\n", (long)ts.tv_sec);
+    return 0;
+}

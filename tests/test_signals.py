@@ -1,0 +1,139 @@
+"""Emulated POSIX signals for managed processes.
+
+Ref parity: src/lib/shim/src/signals.rs (shim-side handler invocation),
+src/main/host/syscall/handler/signal.rs (sigaction/procmask/kill), and
+the shutdown_signal contract of the host process spec
+(src/main/core/configuration.rs).  Dual-target where it can be: the
+plugin runs natively first and must pass its own assertions there too.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C toolchain")
+
+
+@pytest.fixture(scope="module")
+def plugin(tmp_path_factory):
+    out_dir = tmp_path_factory.mktemp("plugins")
+
+    def build(name: str) -> str:
+        src = os.path.join(PLUGIN_DIR, name + ".c")
+        out = os.path.join(out_dir, name)
+        subprocess.run(["cc", "-O1", "-o", out, src], check=True)
+        return out
+
+    return build
+
+
+def run_host_yaml(binary, args=(), stop="20s", start="1s",
+                  shutdown_time=None, shutdown_signal=None,
+                  expected="exited 0", data_dir="/tmp/shadowtpu-test-sig"):
+    extra = ""
+    if shutdown_time is not None:
+        extra += f"\n        shutdown_time: {shutdown_time}"
+    if shutdown_signal is not None:
+        extra += f"\n        shutdown_signal: {shutdown_signal}"
+    yaml = f"""
+general:
+  stop_time: {stop}
+  seed: 1
+  data_directory: {data_dir}
+experimental:
+  strace_logging_mode: deterministic
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+      ]
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - path: {binary}
+        args: {list(args)!r}
+        start_time: {start}
+        expected_final_state: {expected}{extra}
+"""
+    cfg = ConfigOptions.from_yaml_text(yaml)
+    manager, summary = run_simulation(cfg)
+    proc = next(iter(manager.hosts[0].processes.values()))
+    return manager, summary, proc
+
+
+def test_signal_self_native(plugin):
+    exe = plugin("signal_self")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+
+
+def test_signal_self_simulated(plugin):
+    exe = plugin("signal_self")
+    _, _, proc = run_host_yaml(exe)
+    assert proc.exited and proc.exit_code == 0, bytes(proc.stderr)
+    out = bytes(proc.stdout)
+    assert b"OK" in out
+    # pause() interrupted by alarm(2) after EXACTLY 2 simulated seconds
+    assert b"alarm_dt_ns=2000000000" in out
+
+
+def test_shutdown_signal_graceful(plugin):
+    exe = plugin("signal_shutdown")
+    _, _, proc = run_host_yaml(exe, args=("handle",), shutdown_time="5s")
+    assert proc.exited and proc.exit_code == 0, bytes(proc.stderr)
+    # SIGTERM delivered at shutdown_time=5s; handler path exits cleanly.
+    assert b"graceful_exit_at_s=" in bytes(proc.stdout)
+    assert proc.term_signal is None
+    assert proc.matches_expected_final_state()
+
+
+def test_shutdown_signal_default_terminates(plugin):
+    exe = plugin("signal_shutdown")
+    _, _, proc = run_host_yaml(exe, args=("default",), shutdown_time="5s",
+                               expected="signaled SIGTERM")
+    assert proc.exited
+    assert proc.term_signal == 15
+    assert proc.matches_expected_final_state()
+
+
+def test_shutdown_signal_configurable(plugin):
+    # shutdown_signal: SIGKILL is uncatchable even with a handler set.
+    exe = plugin("signal_shutdown")
+    _, _, proc = run_host_yaml(exe, args=("handle",), shutdown_time="5s",
+                               shutdown_signal="SIGKILL",
+                               expected="signaled 9")
+    assert proc.exited
+    assert proc.term_signal == 9
+    assert proc.matches_expected_final_state()
+
+
+def test_signal_delivery_deterministic(plugin, tmp_path):
+    """Two runs produce byte-identical strace logs (delivery order and
+    timing are simulation events, not wall-clock artifacts)."""
+    exe = plugin("signal_self")
+    traces = []
+    for i in range(2):
+        d = str(tmp_path / f"run{i}")
+        _, _, proc = run_host_yaml(exe, data_dir=d)
+        assert proc.exit_code == 0
+        strace_files = []
+        for root, _dirs, files in os.walk(d):
+            for f in sorted(files):
+                if f.endswith(".strace"):
+                    with open(os.path.join(root, f), "rb") as fh:
+                        strace_files.append(fh.read())
+        traces.append(strace_files)
+    assert traces[0] == traces[1]
+    assert traces[0]  # non-empty
