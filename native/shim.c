@@ -28,6 +28,7 @@
 #include <linux/filter.h>
 #include <linux/futex.h>
 #include <linux/seccomp.h>
+#include <sched.h>
 #include <signal.h>
 #include <stddef.h>
 #include <stdint.h>
@@ -172,6 +173,89 @@ static void shim_recv_response(shim_event_t *ev) {
     }
 }
 
+/* ---------------------------------------------------------------- */
+/* fork (ref: process.rs fork path) and execve env re-export         */
+/* ---------------------------------------------------------------- */
+
+static void shim_rebind(const char *path) {
+    long fd = raw(SYS_openat, AT_FDCWD, (long)path, O_RDWR, 0, 0, 0);
+    if (fd < 0)
+        shim_die("[shadow-tpu shim] cannot open fork IPC file\n");
+    long addr = raw(SYS_mmap, 0, SHIM_IPC_FILE_SIZE,
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (addr < 0 && addr > -4096)
+        shim_die("[shadow-tpu shim] cannot mmap fork IPC file\n");
+    raw(SYS_close, fd, 0, 0, 0, 0, 0);
+    /* The inherited mapping of the parent's block belongs to the
+     * parent's protocol state; drop it before rebinding. */
+    raw(SYS_munmap, (long)g_ipc, SHIM_IPC_FILE_SIZE, 0, 0, 0, 0);
+    g_ipc = (shim_ipc_t *)addr;
+    g_chan = &g_ipc->chans[0];
+}
+
+/* The manager answered a fork/vfork/fork-style-clone with EV_FORK_RES:
+ * it created a fresh IPC block (path in the header's fork_path) and
+ * expects us to run the real clone.  CLONE_PARENT makes the child a
+ * child of the MANAGER (our parent), so the manager can waitpid it like
+ * any top-level managed process. */
+static long shim_finish_fork(void) {
+    char path[IPC_PATH_MAX];
+    memcpy(path, (const void *)g_ipc->fork_path, IPC_PATH_MAX);
+    path[IPC_PATH_MAX - 1] = 0;
+    long rv = raw(SYS_clone, SIGCHLD | CLONE_PARENT, 0, 0, 0, 0, 0);
+    if (rv == 0) {
+        /* Child: rebind to the fresh block and handshake; the manager
+         * releases us when the simulated fork instant is reached. */
+        shim_rebind(path);
+        shim_event_t ev;
+        memset(&ev, 0, sizeof(ev));
+        ev.kind = EV_START_REQ;
+        ev.num = raw(SYS_getpid, 0, 0, 0, 0, 0, 0);
+        slot_send(&g_chan->to_shadow, &ev);
+        shim_recv_response(&ev);
+        if (ev.kind != EV_START_RES)
+            shim_die("[shadow-tpu shim] bad fork-child handshake\n");
+        return 0;
+    }
+    shim_event_t ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = EV_FORK_DONE;
+    ev.num = rv; /* native child pid, or -errno */
+    slot_send(&g_chan->to_shadow, &ev);
+    shim_recv_response(&ev);
+    if (ev.kind != EV_SYSCALL_COMPLETE)
+        shim_die("[shadow-tpu shim] bad fork completion\n");
+    return ev.num; /* emulated child pid */
+}
+
+/* execve with SHADOWTPU_IPC / LD_PRELOAD re-exported so the new image
+ * initializes under the same manager process (the manager spawns the
+ * replacement image itself; this path only runs if it ever answers
+ * DO_NATIVE, kept for completeness). */
+static long shim_do_execve(const long args[6]) {
+    static char *new_envp[1024];
+    static char ipc_var[IPC_PATH_MAX + 16] = "SHADOWTPU_IPC=";
+    static char preload_var[IPC_PATH_MAX + 16] = "LD_PRELOAD=";
+    static char bind_var[] = "LD_BIND_NOW=1";
+    memcpy(ipc_var + 14, (const void *)g_ipc->self_path, IPC_PATH_MAX);
+    memcpy(preload_var + 11, (const void *)g_ipc->preload_path,
+           IPC_PATH_MAX);
+    char *const *envp = (char *const *)args[2];
+    int n = 0;
+    for (int i = 0; envp && envp[i] && n < 1019; i++) {
+        if (!strncmp(envp[i], "SHADOWTPU_IPC=", 14) ||
+            !strncmp(envp[i], "LD_PRELOAD=", 11) ||
+            !strncmp(envp[i], "LD_BIND_NOW=", 12))
+            continue;
+        new_envp[n++] = envp[i];
+    }
+    new_envp[n++] = ipc_var;
+    new_envp[n++] = preload_var;
+    new_envp[n++] = bind_var;
+    new_envp[n] = NULL;
+    return raw(SYS_execve, args[0], args[1], (long)new_envp, 0, 0, 0);
+}
+
 static long shim_ipc_syscall(long n, const long args[6]) {
     shim_event_t ev;
     memset(&ev, 0, sizeof(ev));
@@ -182,8 +266,13 @@ static long shim_ipc_syscall(long n, const long args[6]) {
     shim_recv_response(&ev);
     if (ev.kind == EV_SYSCALL_COMPLETE)
         return ev.num;
-    if (ev.kind == EV_SYSCALL_DO_NATIVE)
+    if (ev.kind == EV_FORK_RES)
+        return shim_finish_fork();
+    if (ev.kind == EV_SYSCALL_DO_NATIVE) {
+        if (n == SYS_execve)
+            return shim_do_execve(args);
         return raw(n, args[0], args[1], args[2], args[3], args[4], args[5]);
+    }
     shim_die("[shadow-tpu shim] unexpected response kind\n");
     return -ENOSYS;
 }
@@ -229,6 +318,12 @@ static void shim_handle_clone(greg_t *gregs) {
     shim_recv_response(&ev);
     if (ev.kind == EV_SYSCALL_COMPLETE) {
         gregs[REG_RAX] = (greg_t)ev.num;
+        return;
+    }
+    if (ev.kind == EV_FORK_RES) {
+        /* A fork-style clone (no CLONE_THREAD): new process, not a
+         * new thread. */
+        gregs[REG_RAX] = (greg_t)shim_finish_fork();
         return;
     }
     if (ev.kind != EV_CLONE_RES)

@@ -33,7 +33,7 @@ typedef _Atomic uint64_t ipc_atomic_u64;
 #endif
 
 #define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
-#define SHIM_IPC_VERSION 3u
+#define SHIM_IPC_VERSION 4u
 
 /* Slot status values; the status word doubles as the futex word. */
 enum {
@@ -50,6 +50,7 @@ enum {
     EV_SYSCALL    = 2, /* num + 6 args, please service              */
     EV_CLONE_DONE = 3, /* num = new native tid, or -errno           */
     EV_SIGNAL_DONE = 4, /* emulated signal handler returned         */
+    EV_FORK_DONE  = 5, /* num = native child pid, or -errno         */
     /* shadow -> shim */
     EV_START_RES          = 16, /* run the app                      */
     EV_SYSCALL_COMPLETE   = 17, /* num = return value               */
@@ -62,6 +63,14 @@ enum {
      * the handler, replies EV_SIGNAL_DONE, and resumes waiting for the
      * real response of the interrupted syscall. */
     EV_SIGNAL             = 20,
+    /* fork/vfork/fork-style-clone (ref: process.rs fork path).  The
+     * manager created a fresh IPC block for the child and wrote its
+     * path into the header's fork_path; the shim runs the real
+     * clone(SIGCHLD|CLONE_PARENT) through the trampoline (CLONE_PARENT
+     * so the manager — already the parent of every top-level managed
+     * process — can waitpid the child directly), the child rebinds to
+     * the new block and handshakes, the parent replies EV_FORK_DONE. */
+    EV_FORK_RES           = 21,
 };
 
 typedef struct {
@@ -99,7 +108,8 @@ typedef struct {
 } ipc_chan_t;               /* 320 bytes */
 
 #define IPC_N_CHANS    64
-#define IPC_CHANS_OFF  64   /* header padded to 64 bytes */
+#define IPC_CHANS_OFF  512  /* header padded to 512 bytes */
+#define IPC_PATH_MAX   160
 
 typedef struct {
     uint32_t magic;
@@ -112,7 +122,16 @@ typedef struct {
     ipc_atomic_u64 sim_time_ns;
     /* Deterministic bytes for AT_RANDOM-style needs (future use). */
     uint64_t auxv_random[2];
-    uint8_t  _hdr_pad[IPC_CHANS_OFF - 32];
+    /* This block's own /dev/shm path: the shim re-exports it as
+     * SHADOWTPU_IPC when the app calls execve, so the new image's
+     * constructor rebinds to the same process. */
+    char self_path[IPC_PATH_MAX];
+    /* Transient: path of a forked child's fresh block, written by the
+     * manager immediately before EV_FORK_RES. */
+    char fork_path[IPC_PATH_MAX];
+    /* LD_PRELOAD value to re-export across execve. */
+    char preload_path[IPC_PATH_MAX];
+    /* 32 + 3*160 == IPC_CHANS_OFF exactly (asserted below). */
     ipc_chan_t chans[IPC_N_CHANS];
 } shim_ipc_t;
 
@@ -125,6 +144,9 @@ typedef struct {
 /* Offsets the Python side mirrors (checked by tests). */
 #define IPC_OFF_SIM_TIME   8
 #define IPC_OFF_AUXV       16
+#define IPC_OFF_SELF_PATH  32
+#define IPC_OFF_FORK_PATH  (32 + IPC_PATH_MAX)
+#define IPC_OFF_PRELOAD    (32 + 2 * IPC_PATH_MAX)
 #define IPC_CHAN_STRIDE    320
 #define IPC_CHAN_TO_SHADOW 0
 #define IPC_CHAN_TO_SHIM   72
@@ -141,6 +163,8 @@ _Static_assert(sizeof(shim_event_t) == 64, "shim_event_t layout");
 _Static_assert(sizeof(ipc_slot_t) == 72, "ipc_slot_t layout");
 _Static_assert(sizeof(ipc_chan_t) == IPC_CHAN_STRIDE, "ipc_chan_t layout");
 _Static_assert(sizeof(shim_ipc_t) <= SHIM_IPC_FILE_SIZE, "fits in file");
+_Static_assert(__builtin_offsetof(shim_ipc_t, chans) == IPC_CHANS_OFF,
+               "header layout");
 #endif
 
 #endif /* SHADOWTPU_SHIM_IPC_H */

@@ -2,6 +2,12 @@
 
 Maps fds to file objects, allocating the lowest available fd like Linux.
 File objects are StatusOwner subclasses with a `close(host)` method.
+
+Open file descriptions are refcounted on the object (`_open_refs`):
+dup() aliases within one table and fork() shares across tables both
+bump the count, and the underlying object only really closes when the
+last fd referring to it goes away — the same lifecycle the reference
+gets from its CompatFile refcounts (descriptor/mod.rs).
 """
 
 from __future__ import annotations
@@ -9,24 +15,43 @@ from __future__ import annotations
 import errno
 
 
+def _incref(file) -> None:
+    file._open_refs = getattr(file, "_open_refs", 0) + 1
+
+
+def _decref(file, host) -> None:
+    refs = getattr(file, "_open_refs", 1) - 1
+    file._open_refs = refs
+    if refs <= 0 and hasattr(file, "close"):
+        file.close(host)
+
+
 class DescriptorTable:
-    __slots__ = ("_fds", "_next_hint")
+    __slots__ = ("_fds", "_cloexec", "_next_hint")
 
     def __init__(self):
         self._fds: dict[int, object] = {}
+        self._cloexec: set[int] = set()
         self._next_hint = 0
 
     # fds 0-2 are reserved for stdio (sys_write special-cases 1/2), so
     # registered files never alias them.
-    def register(self, file, min_fd: int = 3) -> int:
+    def register(self, file, min_fd: int = 3, cloexec: bool = False) -> int:
         fd = min_fd
         while fd in self._fds:
             fd += 1
         self._fds[fd] = file
+        if cloexec:
+            self._cloexec.add(fd)
+        _incref(file)
         return fd
 
-    def register_at(self, fd: int, file) -> None:
+    def register_at(self, fd: int, file, cloexec: bool = False) -> None:
+        assert fd not in self._fds, "register_at over a live fd"
         self._fds[fd] = file
+        if cloexec:
+            self._cloexec.add(fd)
+        _incref(file)
 
     def get(self, fd: int):
         f = self._fds.get(fd)
@@ -34,17 +59,42 @@ class DescriptorTable:
             raise OSError(errno.EBADF, "bad file descriptor")
         return f
 
-    def deregister(self, fd: int):
+    def close_fd(self, host, fd: int) -> None:
         f = self._fds.pop(fd, None)
+        self._cloexec.discard(fd)
         if f is None:
             raise OSError(errno.EBADF, "bad file descriptor")
-        return f
+        _decref(f, host)
+
+    def set_cloexec(self, fd: int, on: bool) -> None:
+        if fd in self._fds:
+            (self._cloexec.add if on else self._cloexec.discard)(fd)
+
+    def get_cloexec(self, fd: int) -> bool:
+        return fd in self._cloexec
 
     def close_all(self, host) -> None:
         for fd in sorted(self._fds, reverse=True):
-            f = self._fds.pop(fd)
-            if hasattr(f, "close"):
-                f.close(host)
+            _decref(self._fds.pop(fd), host)
+        self._cloexec.clear()
+
+    def close_cloexec(self, host) -> None:
+        """execve: close close-on-exec fds, keep the rest."""
+        for fd in sorted(self._cloexec, reverse=True):
+            f = self._fds.pop(fd, None)
+            if f is not None:
+                _decref(f, host)
+        self._cloexec.clear()
+
+    def fork_copy(self) -> "DescriptorTable":
+        """Child's table after fork: same open file descriptions,
+        independently closable fds (process.rs fork path)."""
+        child = DescriptorTable()
+        child._fds = dict(self._fds)
+        child._cloexec = set(self._cloexec)
+        for f in child._fds.values():
+            _incref(f)
+        return child
 
     def open_fds(self):
         return sorted(self._fds)

@@ -32,7 +32,8 @@ from shadow_tpu.host import signals as sigmod
 from shadow_tpu.host.futex import FutexTable
 from shadow_tpu.host.process import Process, ST_BLOCKED, ST_EXITED, ST_RUNNABLE
 from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
-                                      EV_CLONE_DONE, EV_CLONE_RES, EV_SIGNAL,
+                                      EV_CLONE_DONE, EV_CLONE_RES,
+                                      EV_FORK_DONE, EV_FORK_RES, EV_SIGNAL,
                                       EV_SIGNAL_DONE, EV_START_REQ,
                                       EV_START_RES, EV_SYSCALL,
                                       EV_SYSCALL_COMPLETE,
@@ -79,15 +80,21 @@ class MemoryManager:
             raise OSError(14, "short write to managed process memory")
 
     def read_cstr(self, addr: int, limit: int = 4096) -> bytes:
+        """NUL-terminated string; chunk reads may come back short when
+        the string sits near the end of a mapping (argv/env strings
+        live at the very top of the stack), so accept partial chunks
+        and only fault if the NUL is genuinely unreachable."""
         out = bytearray()
         while len(out) < limit:
             chunk_len = min(256, limit - len(out))
-            chunk = self.read(addr + len(out), chunk_len)
+            chunk = os.pread(self._fd, chunk_len, addr + len(out))
             nul = chunk.find(b"\0")
             if nul >= 0:
                 out += chunk[:nul]
                 return bytes(out)
             out += chunk
+            if len(chunk) < chunk_len:
+                raise OSError(14, "unterminated string at mapping end")
         return bytes(out)
 
     def close(self) -> None:
@@ -118,6 +125,74 @@ class ManagedProcess(Process):
     def live_managed_threads(self) -> int:
         return sum(1 for t in self.threads if t.state != ST_EXITED)
 
+    def _spawn_image(self, host, resolved: str, argv: list,
+                     env: dict, truncate_output: bool) -> "ManagedThread":
+        """Shared native-image spawn (process start AND execve
+        replacement): build/locate the shim, create a fresh IPC block,
+        wire LD_PRELOAD / SHADOWTPU_IPC / LD_BIND_NOW, posix_spawn with
+        stdio redirected to the process's output files, and register
+        the new main thread.  Raises RuntimeError (shim build) or
+        OSError (spawn) without touching this process's live state."""
+        from shadow_tpu.native import ensure_shim_built
+        shim = ensure_shim_built()
+        self._exec_count = getattr(self, "_exec_count", 0) + 1
+        ipc_path = (f"/dev/shm/shadowtpu-{os.getpid()}-"
+                    f"{host.id}-{self.pid}-{self._exec_count}.ipc")
+        ipc = IpcBlock(ipc_path)
+        ipc.set_sim_time(host.now())
+        ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
+        ipc.set_self_path(ipc_path)
+
+        env = dict(env)
+        # Prepend the shim exactly once (an exec'd app passes through
+        # its environ, which already carries it).
+        extra = [p for p in env.get("LD_PRELOAD", "").split(":")
+                 if p and p != shim]
+        preload = ":".join([shim] + extra)
+        env["LD_PRELOAD"] = preload
+        env["SHADOWTPU_IPC"] = ipc_path
+        # Eager relocation: keeps ld.so's lazy-binding syscalls out of
+        # the simulated timeline.
+        env.setdefault("LD_BIND_NOW", "1")
+        ipc.set_preload(preload)
+
+        # Always O_APPEND: a fork child's exec'd image opens its own
+        # file description on the shared output file, and only append
+        # semantics keep concurrent writers from overwriting each other.
+        # Process start truncates explicitly instead of O_TRUNC.
+        if truncate_output:
+            for p in (self._stdout_path, self._stderr_path):
+                if p:
+                    open(p, "wb").close()
+        wflags = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+        file_actions = [(os.POSIX_SPAWN_OPEN, 0, "/dev/null",
+                         os.O_RDONLY, 0)]
+        if self._stdout_path:
+            file_actions.append((os.POSIX_SPAWN_OPEN, 1,
+                                 self._stdout_path, wflags, 0o644))
+        if self._stderr_path:
+            file_actions.append((os.POSIX_SPAWN_OPEN, 2,
+                                 self._stderr_path, wflags, 0o644))
+        argv = list(argv) if argv else [resolved]
+        try:
+            pid = os.posix_spawn(resolved, argv, env,
+                                 file_actions=file_actions)
+        except OSError:
+            ipc.close()
+            raise
+        # Commit: replace identity state only after the spawn succeeded.
+        self.native_pid = pid
+        if self.mem is not None:
+            self.mem.close()
+        self.mem = MemoryManager(pid)
+        self.ipc_block = ipc
+        self.argv = argv
+        self._preload = preload
+        thread = ManagedThread(self, ipc, ipc.channel(0), self._next_tid)
+        self._next_tid += 1
+        self.threads.append(thread)
+        return thread
+
     def start_native(self, host, exe_path: str | None = None) -> None:
         exe = exe_path or (self.argv[0] if self.argv else None)
         resolved = shutil.which(exe) if exe and "/" not in exe else exe
@@ -126,62 +201,26 @@ class ManagedProcess(Process):
             self.exited = True
             self.exit_code = 127
             return
-        try:
-            from shadow_tpu.native import ensure_shim_built
-            shim = ensure_shim_built()
-        except RuntimeError as e:
-            # No toolchain / build failure: a plugin error, not a sim
-            # crash (the run completes and reports it).
-            self.stderr += f"[shadow-tpu] {e}\n".encode()
-            self.exited = True
-            self.exit_code = 127
-            return
-
-        ipc_path = (f"/dev/shm/shadowtpu-{os.getpid()}-"
-                    f"{host.id}-{self.pid}.ipc")
-        ipc = IpcBlock(ipc_path)
-        ipc.set_sim_time(host.now())
-        ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
-
-        env = dict(self.env)
-        preload = shim
-        if env.get("LD_PRELOAD"):
-            preload = shim + ":" + env["LD_PRELOAD"]
-        env["LD_PRELOAD"] = preload
-        env["SHADOWTPU_IPC"] = ipc_path
-        # Eager relocation: keeps ld.so's lazy-binding syscalls out of
-        # the simulated timeline.
-        env.setdefault("LD_BIND_NOW", "1")
-
         os.makedirs(self.work_dir, exist_ok=True)
         self._stdout_path = os.path.join(self.work_dir,
                                          f"{self.name}.{self.pid}.stdout")
         self._stderr_path = os.path.join(self.work_dir,
                                          f"{self.name}.{self.pid}.stderr")
-        wflags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC
-        file_actions = [
-            (os.POSIX_SPAWN_OPEN, 0, "/dev/null", os.O_RDONLY, 0),
-            (os.POSIX_SPAWN_OPEN, 1, self._stdout_path, wflags, 0o644),
-            (os.POSIX_SPAWN_OPEN, 2, self._stderr_path, wflags, 0o644),
-        ]
-        argv = list(self.argv) if self.argv else [resolved]
         try:
-            self.native_pid = os.posix_spawn(
-                resolved, argv, env, file_actions=file_actions)
-        except OSError as e:
-            ipc.close()
-            self.stderr += (f"[shadow-tpu] spawn failed: {e}\n").encode()
+            thread = self._spawn_image(host, resolved, self.argv,
+                                       self.env, truncate_output=True)
+        except (RuntimeError, OSError) as e:
+            # No toolchain / build / spawn failure: a plugin error, not
+            # a sim crash (the run completes and reports it).
+            self.stderr += f"[shadow-tpu] {e}\n".encode()
             self.exited = True
             self.exit_code = 127
             return
-        self.mem = MemoryManager(self.native_pid)
-        self.ipc_block = ipc
-        thread = ManagedThread(self, ipc, ipc.channel(0), self._next_tid)
-        self._next_tid += 1
-        self.threads.append(thread)
         thread.resume(host)
 
     def collect_output(self) -> None:
+        if not getattr(self, "_owns_output", True):
+            return  # a fork child writing into its parent's files
         for path, buf_name in ((self._stdout_path, "stdout"),
                                (self._stderr_path, "stderr")):
             if path and os.path.exists(path):
@@ -515,6 +554,12 @@ class ManagedThread:
         if kind == "clone":
             return self._do_clone(host, result[1], result[2])
 
+        if kind == "fork":
+            return self._do_fork(host)
+
+        if kind == "execve":
+            return self._do_execve(host, result[1], result[2], result[3])
+
         if kind == "thread_exit":
             # A secondary thread exiting (SYS_exit with siblings alive):
             # let the native thread die, then emulate the kernel's
@@ -628,6 +673,153 @@ class ManagedThread:
                                                   child.resume))
         self.chan.send_to_shim(EV_SYSCALL_COMPLETE, child_tid)
         return True
+
+    # -- fork / execve (ref: process.rs:297,944 spawn_mthread_for_exec,
+    #    clone-handler fork path) -------------------------------------
+
+    def _do_fork(self, host) -> bool:
+        """fork/vfork/fork-style clone: create the child ManagedProcess
+        and its fresh IPC block, hand the path to the shim (EV_FORK_RES),
+        let it run clone(SIGCHLD|CLONE_PARENT) — CLONE_PARENT so the
+        manager stays the waitpid()-able parent of every native process
+        — then register the child thread on our side."""
+        parent = self.process
+        child = ManagedProcess(
+            host, f"{parent.name}.f", list(parent.argv), dict(parent.env),
+            expected_final_state="any", work_dir=parent.work_dir)
+        ipc_path = (f"/dev/shm/shadowtpu-{os.getpid()}-"
+                    f"{host.id}-{child.pid}.ipc")
+        try:
+            ipc = IpcBlock(ipc_path)
+        except OSError:
+            host.processes.pop(child.pid, None)
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -11)  # EAGAIN
+            return True
+        ipc.set_sim_time(host.now())
+        ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
+        ipc.set_self_path(ipc_path)
+        preload = getattr(parent, "_preload", "")
+        if preload:
+            ipc.set_preload(preload)
+        child._preload = preload
+        child.ipc_block = ipc
+
+        self.block.set_fork_path(ipc_path)
+        self.chan.send_to_shim(EV_FORK_RES)
+        ev = self._recv(host)
+        if ev is None:
+            ipc.close()
+            host.processes.pop(child.pid, None)
+            return False
+        kind, native_pid, _args = ev
+        if kind != EV_FORK_DONE:
+            ipc.close()
+            host.processes.pop(child.pid, None)
+            self._protocol_error(host, f"expected ForkDone, got {kind}")
+            return False
+        native_pid = int(native_pid)
+        if native_pid < 0:
+            ipc.close()
+            host.processes.pop(child.pid, None)
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, native_pid)
+            return True
+
+        child.native_pid = native_pid
+        child.mem = MemoryManager(native_pid)
+        child.fds = parent.fds.fork_copy()
+        child.signals = parent.signals.clone()
+        child.parent_pid = parent.pid
+        child.strace_mode = parent.strace_mode
+        # The child shares the parent's native stdout/stderr fds; it
+        # remembers the paths (an exec'd image re-opens them O_APPEND)
+        # but only the parent collects them (no double-read).
+        child._stdout_path = parent._stdout_path
+        child._stderr_path = parent._stderr_path
+        child._owns_output = False
+        thread = ManagedThread(child, ipc, ipc.channel(0), child._next_tid)
+        child._next_tid += 1
+        thread.sig_mask = self.sig_mask  # fork inherits the caller's mask
+        child.threads.append(thread)
+        host.schedule_task_at(host.now(), TaskRef("fork-start",
+                                                  thread.resume))
+        self.chan.send_to_shim(EV_SYSCALL_COMPLETE, child.pid)
+        return True
+
+    def _do_execve(self, host, path: str, argv: list, envp: list) -> bool:
+        """execve replaces the native process outright: the inherited
+        seccomp filter would SIGSYS-kill a fresh image before its shim
+        constructor installs a handler, so (like the reference's
+        spawn_mthread_for_exec) we posix_spawn the new image against a
+        fresh IPC block, and only once that succeeds kill the old
+        native process — spawn failures (ENOENT/EACCES/ENOEXEC) return
+        to the caller like a failed execve should.  The emulated
+        process identity (pid, fd table, parent) is preserved."""
+        import errno as _errno
+        process = self.process
+        # /proc/self in the CALLER's context, not the manager's.
+        if path == "/proc/self/exe":
+            try:
+                path = os.readlink(f"/proc/{process.native_pid}/exe")
+            except OSError:
+                pass
+        elif path.startswith("/proc/self/"):
+            path = f"/proc/{process.native_pid}/" + path[11:]
+        resolved = shutil.which(path) if "/" not in path else path
+        if not resolved or not os.path.exists(resolved):
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.ENOENT)
+            return True
+        if not os.access(resolved, os.X_OK):
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.EACCES)
+            return True
+
+        env = {}
+        for item in envp:
+            k, _sep, v = item.partition("=")
+            env[k] = v
+        old_pid = process.native_pid
+        old_block = process.ipc_block
+        try:
+            new_thread = process._spawn_image(host, resolved,
+                                              list(argv) or [resolved],
+                                              env, truncate_output=False)
+        except (RuntimeError, OSError) as e:
+            code = e.errno if isinstance(e, OSError) and e.errno \
+                else _errno.ENOEXEC
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -code)
+            return True
+
+        # Point of no return: retire the old image.  All its threads
+        # die on exec; no response is owed to it.
+        for t in process.threads:
+            if isinstance(t, ManagedThread) and t is not new_thread \
+                    and t.state != ST_EXITED:
+                if t.last_condition is not None:
+                    t.last_condition.disarm()
+                    t.last_condition = None
+                t.state = ST_EXITED
+        try:
+            os.kill(old_pid, signal.SIGKILL)
+        except (ProcessLookupError, TypeError):
+            pass
+        try:
+            os.waitpid(old_pid, 0)
+        except (ChildProcessError, OSError):
+            pass
+        # Closed only after the kill: a live shim seeing CLOSED would
+        # print a channel-teardown complaint into the shared stderr.
+        old_block.mark_closed()
+        old_block.close()
+
+        # POSIX exec semantics on the emulated state.
+        process.fds.close_cloexec(host)
+        process.signals.actions = {
+            s: a for s, a in process.signals.actions.items()
+            if a.handler == 1}  # SIG_IGN survives, handlers reset
+        process.futex_table = FutexTable()
+        new_thread.sig_mask = self.sig_mask  # exec preserves the mask
+        host.schedule_task_at(host.now(), TaskRef("exec-start",
+                                                  new_thread.resume))
+        return False  # the old image's pump ends here
 
     def _await_native_thread_gone(self) -> None:
         """Busy-poll until the kernel has fully torn the thread down —

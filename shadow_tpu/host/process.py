@@ -144,6 +144,10 @@ class Process:
         self.term_signal: int | None = None  # fatal emulated signal
         from shadow_tpu.host.signals import ProcessSignals
         self.signals = ProcessSignals()
+        # fork/wait bookkeeping (ref: process.rs zombies & reaping)
+        self.parent_pid: int | None = None
+        self.zombies: list[int] = []      # exited, unreaped child pids
+        self._wait_conds: list = []       # parked wait4 conditions
         self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
         self.stderr = bytearray()
@@ -198,6 +202,20 @@ class Process:
                               if self._nonzero_exit is not None else code)
             self.fds.close_all(host)
             self.strace_close()
+            if self.parent_pid is not None:
+                parent = host.processes.get(self.parent_pid)
+                if parent is not None and not parent.exited:
+                    parent.child_exited(host, self)
+
+    def child_exited(self, host, child) -> None:
+        """A child became a zombie: wake parked wait4()s, raise SIGCHLD
+        (default-ignored unless the app installed a handler)."""
+        self.zombies.append(child.pid)
+        waiters, self._wait_conds = self._wait_conds, []
+        for cond in waiters:
+            cond.fire(host)
+        from shadow_tpu.host.signals import SIGCHLD
+        self.raise_signal(host, SIGCHLD)
 
     def raise_signal(self, host, sig: int, target_tid=None,
                      si_code: int = 0) -> None:

@@ -23,14 +23,15 @@ import struct
 
 # --- constants mirrored from native/shim_ipc.h ---------------------
 MAGIC = 0x53545055
-VERSION = 3
+VERSION = 4
 FILE_SIZE = 24576
 
 N_CHANS = 64
-CHANS_OFF = 64
+CHANS_OFF = 512
 CHAN_STRIDE = 320
 CHAN_TO_SHADOW = 0
 CHAN_TO_SHIM = 72
+PATH_MAX = 160
 
 SLOT_EMPTY = 0
 SLOT_READY = 1
@@ -41,16 +42,21 @@ EV_START_REQ = 1
 EV_SYSCALL = 2
 EV_CLONE_DONE = 3
 EV_SIGNAL_DONE = 4
+EV_FORK_DONE = 5
 EV_START_RES = 16
 EV_SYSCALL_COMPLETE = 17
 EV_SYSCALL_DO_NATIVE = 18
 EV_CLONE_RES = 19
 EV_SIGNAL = 20
+EV_FORK_RES = 21
 
 OFF_MAGIC = 0
 OFF_VERSION = 4
 OFF_SIM_TIME = 8
 OFF_AUXV = 16
+OFF_SELF_PATH = 32
+OFF_FORK_PATH = 32 + PATH_MAX
+OFF_PRELOAD = 32 + 2 * PATH_MAX
 SLOT_EV_OFF = 8
 EV_STRUCT = struct.Struct("<II7q")  # kind, pad, num, args[6]
 
@@ -193,6 +199,22 @@ class IpcBlock:
 
     def set_auxv_random(self, lo: int, hi: int) -> None:
         struct.pack_into("<QQ", self._mm, OFF_AUXV, lo, hi)
+
+    def _write_cstr(self, off: int, value: str) -> None:
+        data = value.encode()
+        if len(data) >= PATH_MAX:
+            raise ValueError(f"IPC path/value too long ({len(data)} >= "
+                             f"{PATH_MAX}): {value!r}")
+        self._mm[off:off + len(data) + 1] = data + b"\0"
+
+    def set_self_path(self, path: str) -> None:
+        self._write_cstr(OFF_SELF_PATH, path)
+
+    def set_fork_path(self, path: str) -> None:
+        self._write_cstr(OFF_FORK_PATH, path)
+
+    def set_preload(self, value: str) -> None:
+        self._write_cstr(OFF_PRELOAD, value)
 
     # -- teardown ---------------------------------------------------
 

@@ -157,6 +157,14 @@ class ProcessSignals:
         act = self.actions.get(sig)
         return act if act is not None else SigAction()
 
+    def clone(self) -> "ProcessSignals":
+        """fork: child inherits the action table, not the pending set."""
+        child = ProcessSignals()
+        child.actions = {
+            s: SigAction(a.handler, a.flags, a.restorer, a.mask)
+            for s, a in self.actions.items()}
+        return child
+
     def disposition(self, sig: int) -> str:
         """'handler' | 'ignore' | 'terminate'."""
         if sig == SIGKILL:

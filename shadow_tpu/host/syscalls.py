@@ -165,9 +165,7 @@ class SyscallHandler:
         return _done((process.fds.register(child), child.peer))
 
     def sys_close(self, host, process, thread, restarted, fd):
-        f = process.fds.deregister(fd)
-        if hasattr(f, "close"):
-            f.close(host)
+        process.fds.close_fd(host, fd)
         return _done(0)
 
     def sys_set_nonblocking(self, host, process, thread, restarted, fd,

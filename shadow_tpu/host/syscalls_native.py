@@ -90,6 +90,8 @@ POLLHUP = 0x010
 POLLNVAL = 0x020
 
 O_NONBLOCK = 0o4000
+O_CLOEXEC = 0o2000000
+FD_CLOEXEC = 1
 
 F_GETFD = 1
 F_SETFD = 2
@@ -193,8 +195,8 @@ class NativeSyscallHandler:
         return process.fds.get(fd - EMU_FD_BASE)
 
     @staticmethod
-    def _register(process, obj) -> int:
-        return process.fds.register(obj) + EMU_FD_BASE
+    def _register(process, obj, cloexec: bool = False) -> int:
+        return process.fds.register(obj, cloexec=cloexec) + EMU_FD_BASE
 
     # ------------------------------------------------------------------
     # Sockets
@@ -215,7 +217,8 @@ class NativeSyscallHandler:
             from shadow_tpu.host.socket_tcp import TcpSocket
             sock = TcpSocket(host, self.send_buf, self.recv_buf)
         sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
-        return _done(self._register(process, sock))
+        return _done(self._register(process, sock,
+                                    cloexec=bool(type_ & SOCK_CLOEXEC)))
 
     def sys_bind(self, host, process, thread, restarted, fd, addr_ptr,
                  addrlen, *_):
@@ -256,7 +259,8 @@ class NativeSyscallHandler:
                 return _error(errno.EWOULDBLOCK)
             return _block(SyscallCondition(file=sock, mask=S_READABLE))
         child.nonblocking = bool(flags & SOCK_NONBLOCK)
-        newfd = self._register(process, child)
+        newfd = self._register(process, child,
+                               cloexec=bool(flags & SOCK_CLOEXEC))
         if addr_ptr and child.peer is not None:
             sa = _pack_sockaddr_in(*child.peer)
             if len_ptr:
@@ -601,9 +605,7 @@ class NativeSyscallHandler:
     def sys_close(self, host, process, thread, restarted, fd, *_):
         if not self._is_emu(fd):
             return _native()
-        f = process.fds.deregister(fd - EMU_FD_BASE)
-        if hasattr(f, "close"):
-            f.close(host)
+        process.fds.close_fd(host, fd - EMU_FD_BASE)
         return _done(0)
 
     def sys_dup(self, host, process, thread, restarted, fd, *_):
@@ -611,24 +613,28 @@ class NativeSyscallHandler:
             return _native()
         return _done(self._register(process, self._emu(process, fd)))
 
-    def sys_dup2(self, host, process, thread, restarted, oldfd, newfd, *_):
+    def sys_dup2(self, host, process, thread, restarted, oldfd, newfd, *_,
+                 cloexec: bool = False):
         if not self._is_emu(oldfd):
             return _native()
         if not self._is_emu(newfd):
             return _error(errno.EINVAL)  # cross-space dup unsupported
-        obj = self._emu(process, oldfd)
+        obj = self._emu(process, oldfd)  # validates oldfd (EBADF)
+        if oldfd == newfd:
+            return _done(newfd)  # Linux dup2(fd, fd) is a no-op
         try:
-            old = process.fds.deregister(newfd - EMU_FD_BASE)
-            if hasattr(old, "close"):
-                old.close(host)
+            process.fds.close_fd(host, newfd - EMU_FD_BASE)
         except OSError:
             pass
-        process.fds.register_at(newfd - EMU_FD_BASE, obj)
+        process.fds.register_at(newfd - EMU_FD_BASE, obj, cloexec=cloexec)
         return _done(newfd)
 
     def sys_dup3(self, host, process, thread, restarted, oldfd, newfd,
                  flags, *_):
-        return self.sys_dup2(host, process, thread, restarted, oldfd, newfd)
+        if oldfd == newfd:
+            return _error(errno.EINVAL)  # dup3 requires distinct fds
+        return self.sys_dup2(host, process, thread, restarted, oldfd,
+                             newfd, cloexec=bool(flags & O_CLOEXEC))
 
     def sys_fcntl(self, host, process, thread, restarted, fd, cmd, arg, *_):
         if not self._is_emu(fd):
@@ -641,8 +647,14 @@ class NativeSyscallHandler:
             file.nonblocking = bool(arg & O_NONBLOCK)
             return _done(0)
         if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
-            return _done(self._register(process, file))
-        if cmd in (F_GETFD, F_SETFD):
+            return _done(self._register(process, file,
+                                        cloexec=cmd == F_DUPFD_CLOEXEC))
+        if cmd == F_GETFD:
+            cx = process.fds.get_cloexec(fd - EMU_FD_BASE)
+            return _done(FD_CLOEXEC if cx else 0)
+        if cmd == F_SETFD:
+            process.fds.set_cloexec(fd - EMU_FD_BASE,
+                                    bool(arg & FD_CLOEXEC))
             return _done(0)
         return _error(errno.EINVAL)
 
@@ -673,8 +685,9 @@ class NativeSyscallHandler:
     def _pipe_common(self, host, process, fds_ptr, flags):
         r, w = make_pipe()
         r.nonblocking = w.nonblocking = bool(flags & O_NONBLOCK)
-        rfd = self._register(process, r)
-        wfd = self._register(process, w)
+        cloexec = bool(flags & O_CLOEXEC)
+        rfd = self._register(process, r, cloexec=cloexec)
+        wfd = self._register(process, w, cloexec=cloexec)
         process.mem.write(fds_ptr, struct.pack("<ii", rfd, wfd))
         return _done(0)
 
@@ -688,7 +701,8 @@ class NativeSyscallHandler:
     def _eventfd_common(self, host, process, initval, flags):
         ef = EventFd(initval, semaphore=bool(flags & EFD_SEMAPHORE))
         ef.nonblocking = bool(flags & EFD_NONBLOCK)
-        return _done(self._register(process, ef))
+        return _done(self._register(process, ef,
+                                    cloexec=bool(flags & O_CLOEXEC)))
 
     def sys_eventfd(self, host, process, thread, restarted, initval, *_):
         return self._eventfd_common(host, process, initval, 0)
@@ -701,7 +715,8 @@ class NativeSyscallHandler:
                            flags, *_):
         tf = TimerFd()
         tf.nonblocking = bool(flags & TFD_NONBLOCK)
-        return _done(self._register(process, tf))
+        return _done(self._register(process, tf,
+                                    cloexec=bool(flags & O_CLOEXEC)))
 
     def sys_timerfd_settime(self, host, process, thread, restarted, fd,
                             flags, new_ptr, old_ptr, *_):
@@ -743,15 +758,16 @@ class NativeSyscallHandler:
             "<qqqq", interval // 10**9, interval % 10**9,
             remaining // 10**9, remaining % 10**9))
 
-    def _epoll_create(self, host, process):
-        return _done(self._register(process, EpollFile()))
+    def _epoll_create(self, host, process, cloexec: bool = False):
+        return _done(self._register(process, EpollFile(), cloexec=cloexec))
 
     def sys_epoll_create(self, host, process, thread, restarted, size, *_):
         return self._epoll_create(host, process)
 
     def sys_epoll_create1(self, host, process, thread, restarted, flags,
                           *_):
-        return self._epoll_create(host, process)
+        return self._epoll_create(host, process,
+                                  cloexec=bool(flags & O_CLOEXEC))
 
     def sys_epoll_ctl(self, host, process, thread, restarted, epfd, op, fd,
                       event_ptr, *_):
@@ -1143,7 +1159,7 @@ class NativeSyscallHandler:
         return _done(thread.tid)
 
     def sys_getppid(self, host, process, thread, restarted, *_):
-        return _done(1)
+        return _done(process.parent_pid if process.parent_pid else 1)
 
     def sys_getsid(self, host, process, thread, restarted, *_):
         return _done(1)
@@ -1373,20 +1389,34 @@ class NativeSyscallHandler:
     # -- threads (clone/futex; ref handler/clone.rs, futex.rs) ---------
 
     _CLONE_VM = 0x100
+    _CLONE_FILES = 0x400
+    _CLONE_VFORK = 0x4000
     _CLONE_SETTLS = 0x80000
     _CLONE_THREAD = 0x10000
     _CLONE_CHILD_CLEARTID = 0x200000
 
     def sys_clone(self, host, process, thread, restarted, flags, stack,
                   ptid, ctid, tls, *_):
-        """Thread-creation clone: the ManagedThread runs the three-way
-        channel handshake (managed.py _do_clone); fork-style clones are
-        unsupported (the reference emulates full fork; future round).
-        CLONE_SETTLS is required: the shim's per-thread channel pointer
-        lives in fs-relative TLS, so a child sharing the parent's fs
-        base would clobber the parent's channel binding."""
-        if (flags & self._CLONE_THREAD) and (flags & self._CLONE_VM) \
-                and (flags & self._CLONE_SETTLS):
+        """Thread-creation clone runs the three-way channel handshake
+        (managed.py _do_clone); a clone WITHOUT CLONE_THREAD is a fork
+        (glibc fork(), posix_spawn()'s CLONE_VM|CLONE_VFORK clone) and
+        routes to the fork protocol — the shim runs a plain
+        clone(SIGCHLD|CLONE_PARENT), so posix_spawn's shared-VM
+        optimization degrades to copy-on-write (its exec-failure errno
+        reporting through shared memory is lost; the exec path itself
+        works).  CLONE_SETTLS is required for threads: the shim's
+        per-thread channel pointer lives in fs-relative TLS."""
+        if not (flags & self._CLONE_THREAD):
+            # Shared-state clones that COW fork semantics cannot honor
+            # are refused rather than silently diverging: CLONE_FILES
+            # (shared fd table) always; CLONE_VM only in its vfork-like
+            # exec idiom (posix_spawn), where the sharing is unobserved.
+            if flags & self._CLONE_FILES:
+                return _error(errno.ENOSYS)
+            if (flags & self._CLONE_VM) and not (flags & self._CLONE_VFORK):
+                return _error(errno.ENOSYS)
+            return ("fork",)
+        if (flags & self._CLONE_VM) and (flags & self._CLONE_SETTLS):
             return ("clone", flags, ctid)
         return _error(errno.ENOSYS)
 
@@ -1394,13 +1424,36 @@ class NativeSyscallHandler:
         return _error(errno.ENOSYS)  # glibc falls back to clone
 
     def sys_fork(self, host, process, thread, restarted, *_):
-        return _error(errno.ENOSYS)
+        return ("fork",)
 
     def sys_vfork(self, host, process, thread, restarted, *_):
-        return _error(errno.ENOSYS)
+        # Emulated as fork: the child gets a COW copy instead of the
+        # parent's suspended address space.  Safe for the fork+exec
+        # pattern vfork exists for.
+        return ("fork",)
 
-    def sys_execve(self, host, process, thread, restarted, *_):
-        return _error(errno.ENOSYS)
+    def sys_execve(self, host, process, thread, restarted, path_ptr,
+                   argv_ptr, envp_ptr, *_):
+        """Read path/argv/envp out of the old image, then let the
+        ManagedThread replace the native process (managed.py
+        _do_execve; ref process.rs:297 spawn_mthread_for_exec)."""
+        path = process.mem.read_cstr(path_ptr, 4096).decode(
+            errors="surrogateescape")
+
+        def read_ptr_vec(ptr, limit=1024):
+            out = []
+            for i in range(limit):
+                (p,) = struct.unpack(
+                    "<Q", process.mem.read(ptr + 8 * i, 8))
+                if p == 0:
+                    break
+                out.append(process.mem.read_cstr(p, 1 << 17).decode(
+                    errors="surrogateescape"))
+            return out
+
+        argv = read_ptr_vec(argv_ptr) if argv_ptr else []
+        envp = read_ptr_vec(envp_ptr) if envp_ptr else []
+        return ("execve", path, argv, envp)
 
     def sys_set_tid_address(self, host, process, thread, restarted, addr,
                             *_):
@@ -1501,11 +1554,99 @@ class NativeSyscallHandler:
         # PI / WAKE_OP and friends: no in-tree consumer yet.
         return _error(errno.ENOSYS)
 
-    def sys_wait4(self, host, process, thread, restarted, *_):
-        return _error(errno.ECHILD)
+    _WNOHANG = 1
 
-    def sys_waitid(self, host, process, thread, restarted, *_):
-        return _error(errno.ECHILD)
+    def _reap_zombie(self, host, process, pid: int):
+        """Pop a matching zombie child; returns (child_pid, status) or
+        None.  pid semantics: -1/0 any child, >0 that child, <-1 any
+        (process groups collapse to the caller's own)."""
+        for zpid in process.zombies:
+            if pid > 0 and zpid != pid:
+                continue
+            process.zombies.remove(zpid)
+            child = host.processes[zpid]
+            if child.term_signal is not None:
+                status = child.term_signal & 0x7f
+            else:
+                status = (int(child.exit_code or 0) & 0xff) << 8
+            return zpid, status
+        return None
+
+    def _has_children(self, host, process, pid: int) -> bool:
+        """Waitable children: live ones plus unreaped zombies (an
+        exited-and-reaped child no longer counts — ECHILD)."""
+        for p in host.processes.values():
+            if p.parent_pid != process.pid:
+                continue
+            if pid > 0 and p.pid != pid:
+                continue
+            if not p.exited or p.pid in process.zombies:
+                return True
+        return False
+
+    def sys_wait4(self, host, process, thread, restarted, pid, status_ptr,
+                  options, rusage_ptr, *_):
+        pid = _sext32(pid)
+        reaped = self._reap_zombie(host, process, pid)
+        if reaped is not None:
+            zpid, status = reaped
+            if status_ptr:
+                process.mem.write(status_ptr, struct.pack("<i", status))
+            if rusage_ptr:
+                process.mem.write(rusage_ptr, b"\0" * 144)
+            return _done(zpid)
+        if not self._has_children(host, process, pid):
+            return _error(errno.ECHILD)
+        if options & self._WNOHANG:
+            return _done(0)
+        from shadow_tpu.host.condition import ManualCondition
+        cond = ManualCondition()
+        process._wait_conds.append(cond)
+
+        def drop():
+            if cond in process._wait_conds:
+                process._wait_conds.remove(cond)
+        cond.on_disarm = drop
+        return _block(cond)
+
+    def sys_waitid(self, host, process, thread, restarted, idtype, id_,
+                   info_ptr, options, rusage_ptr, *_):
+        P_ALL, P_PID = 0, 1
+        if idtype == P_ALL:
+            pid = -1
+        elif idtype == P_PID:
+            pid = int(id_)
+        else:
+            return _error(errno.EINVAL)
+        reaped = self._reap_zombie(host, process, pid)
+        if reaped is not None:
+            zpid, status = reaped
+            if info_ptr:
+                CLD_EXITED, CLD_KILLED = 1, 2
+                if status & 0x7f:
+                    code, st = CLD_KILLED, status & 0x7f
+                else:
+                    code, st = CLD_EXITED, (status >> 8) & 0xff
+                from shadow_tpu.host.signals import SIGCHLD
+                info = struct.pack("<iii", SIGCHLD, 0, code)
+                info += b"\0" * 4 + struct.pack("<iii", zpid, 1000, st)
+                process.mem.write(info_ptr, info + b"\0" * (128 - len(info)))
+            return _done(0)
+        if not self._has_children(host, process, pid):
+            return _error(errno.ECHILD)
+        if options & self._WNOHANG:
+            if info_ptr:
+                process.mem.write(info_ptr, b"\0" * 128)
+            return _done(0)
+        from shadow_tpu.host.condition import ManualCondition
+        cond = ManualCondition()
+        process._wait_conds.append(cond)
+
+        def drop():
+            if cond in process._wait_conds:
+                process._wait_conds.remove(cond)
+        cond.on_disarm = drop
+        return _block(cond)
 
     def sys_exit(self, host, process, thread, restarted, code, *_):
         from shadow_tpu.host.managed import ManagedProcess

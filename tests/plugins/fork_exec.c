@@ -1,0 +1,52 @@
+/* fork + wait4 + execve, dual-target:
+ *  1. fork(); child reports pid/ppid and _exits(7); parent waitpid()s
+ *     the exact status;
+ *  2. fork(); child execs /bin/echo; parent reaps exit 0;
+ *  3. waitpid with no children left returns ECHILD.
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+    pid_t pid = fork();
+    if (pid < 0) { puts("FAIL fork"); return 1; }
+    if (pid == 0) {
+        printf("child pid=%d ppid=%d\n", (int)getpid(), (int)getppid());
+        fflush(stdout);
+        _exit(7);
+    }
+    printf("parent pid=%d forked=%d\n", (int)getpid(), (int)pid);
+    int status = 0;
+    pid_t r = waitpid(pid, &status, 0);
+    if (r != pid || !WIFEXITED(status) || WEXITSTATUS(status) != 7) {
+        printf("FAIL wait r=%d status=%x\n", (int)r, status);
+        return 2;
+    }
+    puts("wait_ok");
+
+    pid = fork();
+    if (pid < 0) { puts("FAIL fork2"); return 3; }
+    if (pid == 0) {
+        char *argv[] = {"/bin/echo", "echo_ran_under_sim", NULL};
+        execv("/bin/echo", argv);
+        _exit(99);
+    }
+    r = waitpid(pid, &status, 0);
+    if (r != pid || !WIFEXITED(status) || WEXITSTATUS(status) != 0) {
+        printf("FAIL execwait r=%d status=%x\n", (int)r, status);
+        return 4;
+    }
+    puts("exec_wait_ok");
+
+    errno = 0;
+    r = waitpid(-1, &status, 0);
+    if (r != -1 || errno != ECHILD) {
+        printf("FAIL echild r=%d errno=%d\n", (int)r, errno);
+        return 5;
+    }
+    puts("fork_exec_ok");
+    return 0;
+}
