@@ -237,3 +237,46 @@ def udp_mesh(process, argv):
         got += len(data)
     yield ("write", 1, f"mesh received {got} bytes\n")
     return 0
+
+
+@app("http-server")
+def http_server(process, argv):
+    """http-server <port> [nbytes] — minimal HTTP/1.1 server for the
+    real-app gating tests (ref: examples/apps/{curl,wget2} run real
+    clients against an in-sim server the same way).  Serves a fixed
+    'X'*nbytes body with Content-Length and closes the connection."""
+    port = int(argv[0])
+    nbytes = int(argv[1]) if len(argv) > 1 else 1024
+    fd = yield ("socket", "tcp")
+    yield ("bind", fd, (0, port))
+    yield ("listen", fd, 64)
+
+    def serve(conn_fd):
+        def handler():
+            req = b""
+            while b"\r\n\r\n" not in req and b"\n\n" not in req:
+                chunk = yield ("recv", conn_fd, 4096)
+                if chunk == b"":
+                    yield ("close", conn_fd)
+                    return
+                req += chunk
+            line = req.split(b"\r\n", 1)[0].decode(errors="replace")
+            yield ("write", 1, f"request: {line}\n")
+            body = b"X" * nbytes
+            head = (f"HTTP/1.1 200 OK\r\n"
+                    f"Content-Type: text/plain\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    f"Connection: close\r\n\r\n").encode()
+            data = head + body
+            sent = 0
+            while sent < len(data):
+                sent += yield ("send", conn_fd, data[sent:sent + 65536])
+            yield ("shutdown", conn_fd, "wr")
+            while (yield ("recv", conn_fd, 4096)) != b"":
+                pass
+            yield ("close", conn_fd)
+        return handler
+
+    while True:
+        conn_fd, peer = yield ("accept", fd)
+        yield ("spawn_thread", serve(conn_fd))

@@ -139,6 +139,16 @@ class ManagedProcess(Process):
         ipc_path = (f"/dev/shm/shadowtpu-{os.getpid()}-"
                     f"{host.id}-{self.pid}-{self._exec_count}.ipc")
         ipc = IpcBlock(ipc_path)
+        try:
+            return self._spawn_image_with(host, ipc, ipc_path, shim,
+                                          resolved, argv, env,
+                                          truncate_output)
+        except Exception:
+            ipc.close()
+            raise
+
+    def _spawn_image_with(self, host, ipc, ipc_path, shim, resolved,
+                          argv, env, truncate_output) -> "ManagedThread":
         ipc.set_sim_time(host.now())
         ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
         ipc.set_self_path(ipc_path)
@@ -209,9 +219,10 @@ class ManagedProcess(Process):
         try:
             thread = self._spawn_image(host, resolved, self.argv,
                                        self.env, truncate_output=True)
-        except (RuntimeError, OSError) as e:
-            # No toolchain / build / spawn failure: a plugin error, not
-            # a sim crash (the run completes and reports it).
+        except (RuntimeError, OSError, ValueError) as e:
+            # No toolchain / build / spawn failure / oversized preload:
+            # a plugin error, not a sim crash (the run completes and
+            # reports it).
             self.stderr += f"[shadow-tpu] {e}\n".encode()
             self.exited = True
             self.exit_code = 127
@@ -219,14 +230,22 @@ class ManagedProcess(Process):
         thread.resume(host)
 
     def collect_output(self) -> None:
-        if not getattr(self, "_owns_output", True):
-            return  # a fork child writing into its parent's files
-        for path, buf_name in ((self._stdout_path, "stdout"),
-                               (self._stderr_path, "stderr")):
+        """Fold new file content into the owning process's buffers.
+        Fork children share the parent's output files, so collection
+        always happens on the root owner, incrementally — a child that
+        outlives its parent still gets its late writes reported."""
+        owner = getattr(self, "_output_owner", None) or self
+        offsets = owner.__dict__.setdefault("_out_offsets", {})
+        for path, buf_name in ((owner._stdout_path, "stdout"),
+                               (owner._stderr_path, "stderr")):
             if path and os.path.exists(path):
                 with open(path, "rb") as f:
-                    setattr(self, buf_name,
-                            getattr(self, buf_name) + bytearray(f.read()))
+                    f.seek(offsets.get(buf_name, 0))
+                    data = f.read()
+                offsets[buf_name] = offsets.get(buf_name, 0) + len(data)
+                if data:
+                    setattr(owner, buf_name,
+                            getattr(owner, buf_name) + bytearray(data))
 
     # -- emulated signals (ref: process.rs signal ingest,
     #    shim/src/signals.rs) --------------------------------------------
@@ -704,23 +723,24 @@ class ManagedThread:
         child._preload = preload
         child.ipc_block = ipc
 
+        def abort_fork():
+            ipc.close()
+            host.processes.pop(child.pid, None)
+
         self.block.set_fork_path(ipc_path)
         self.chan.send_to_shim(EV_FORK_RES)
         ev = self._recv(host)
         if ev is None:
-            ipc.close()
-            host.processes.pop(child.pid, None)
+            abort_fork()
             return False
         kind, native_pid, _args = ev
         if kind != EV_FORK_DONE:
-            ipc.close()
-            host.processes.pop(child.pid, None)
+            abort_fork()
             self._protocol_error(host, f"expected ForkDone, got {kind}")
             return False
         native_pid = int(native_pid)
         if native_pid < 0:
-            ipc.close()
-            host.processes.pop(child.pid, None)
+            abort_fork()
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, native_pid)
             return True
 
@@ -732,10 +752,11 @@ class ManagedThread:
         child.strace_mode = parent.strace_mode
         # The child shares the parent's native stdout/stderr fds; it
         # remembers the paths (an exec'd image re-opens them O_APPEND)
-        # but only the parent collects them (no double-read).
+        # while collection folds incrementally into the root owner.
         child._stdout_path = parent._stdout_path
         child._stderr_path = parent._stderr_path
-        child._owns_output = False
+        child._output_owner = getattr(parent, "_output_owner",
+                                      None) or parent
         thread = ManagedThread(child, ipc, ipc.channel(0), child._next_tid)
         child._next_tid += 1
         thread.sig_mask = self.sig_mask  # fork inherits the caller's mask
@@ -764,7 +785,20 @@ class ManagedThread:
                 pass
         elif path.startswith("/proc/self/"):
             path = f"/proc/{process.native_pid}/" + path[11:]
-        resolved = shutil.which(path) if "/" not in path else path
+        if "/" not in path:
+            # The kernel does not PATH-search execve (that's execvp's
+            # userspace job).
+            resolved = None
+        elif not path.startswith("/"):
+            # Relative to the CALLER's cwd (chdir runs natively in the
+            # managed process, so the manager's cwd is unrelated).
+            try:
+                cwd = os.readlink(f"/proc/{process.native_pid}/cwd")
+            except OSError:
+                cwd = "/"
+            resolved = os.path.normpath(os.path.join(cwd, path))
+        else:
+            resolved = path
         if not resolved or not os.path.exists(resolved):
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.ENOENT)
             return True
@@ -782,9 +816,13 @@ class ManagedThread:
             new_thread = process._spawn_image(host, resolved,
                                               list(argv) or [resolved],
                                               env, truncate_output=False)
-        except (RuntimeError, OSError) as e:
-            code = e.errno if isinstance(e, OSError) and e.errno \
-                else _errno.ENOEXEC
+        except (RuntimeError, OSError, ValueError) as e:
+            if isinstance(e, OSError) and e.errno:
+                code = e.errno
+            elif isinstance(e, ValueError):  # oversized env/preload
+                code = _errno.E2BIG
+            else:
+                code = _errno.ENOEXEC
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -code)
             return True
 

@@ -168,10 +168,10 @@ class TcpSocket(StatusOwner):
             raise BlockingIOError(errno.EWOULDBLOCK, "send buffer full")
         return n
 
-    def recvfrom(self, host, bufsize: int):
-        return self.recv(host, bufsize), self.peer
+    def recvfrom(self, host, bufsize: int, peek: bool = False):
+        return self.recv(host, bufsize, peek=peek), self.peer
 
-    def recv(self, host, bufsize: int) -> bytes:
+    def recv(self, host, bufsize: int, peek: bool = False) -> bytes:
         conn = self._require_conn()
         if conn.readable_bytes() == 0:
             if conn.at_eof():
@@ -180,6 +180,8 @@ class TcpSocket(StatusOwner):
                 raise OSError(errno.ECONNRESET, conn.error)
             self.adjust_status(host, 0, S_READABLE)
             raise BlockingIOError(errno.EWOULDBLOCK, "no data")
+        if peek:
+            return conn.peek(bufsize)
         data = conn.read(bufsize, host.now())
         self._flush(host)
         if conn.readable_bytes() == 0 and not conn.at_eof():

@@ -160,9 +160,12 @@ class UdpSocket(StatusOwner):
         self.adjust_status(host, S_READABLE, 0)
         return True
 
-    def recvfrom(self, host, bufsize: int):
+    def recvfrom(self, host, bufsize: int, peek: bool = False):
         if not self._recv_q:
             raise BlockingIOError(errno.EWOULDBLOCK, "no data")
+        if peek:
+            p = self._recv_q[0]
+            return p.payload[:bufsize], (p.src_ip, p.src_port)
         p = self._recv_q.popleft()
         self._recv_bytes -= p.total_size()
         if not self._recv_q:

@@ -62,6 +62,7 @@ SYS = {
     270: "pselect6", 271: "ppoll", 281: "epoll_pwait", 283: "timerfd_create",
     284: "eventfd", 286: "timerfd_settime", 287: "timerfd_gettime",
     288: "accept4", 290: "eventfd2", 291: "epoll_create1", 292: "dup3",
+    299: "recvmmsg", 307: "sendmmsg",
     293: "pipe2", 302: "prlimit64", 317: "seccomp", 318: "getrandom",
     332: "statx", 435: "clone3",
 }
@@ -321,7 +322,8 @@ class NativeSyscallHandler:
             return _native()
         sock = self._emu(process, fd)
         try:
-            data, peer = self._sock_recv(host, sock, min(length, _MAX_IO))
+            data, peer = self._sock_recv(host, sock, min(length, _MAX_IO),
+                                         peek=bool(flags & MSG_PEEK))
         except BlockingIOError:
             if sock.nonblocking or (flags & MSG_DONTWAIT):
                 return _error(errno.EWOULDBLOCK)
@@ -357,9 +359,9 @@ class NativeSyscallHandler:
         return _done(len(data))
 
     @staticmethod
-    def _sock_recv(host, sock, bufsize: int):
+    def _sock_recv(host, sock, bufsize: int, peek: bool = False):
         """Uniform recv across UDP (datagram+peer) and TCP (stream)."""
-        result = sock.recvfrom(host, bufsize)
+        result = sock.recvfrom(host, bufsize, peek=peek)
         if isinstance(result, tuple):
             return result
         return result, getattr(sock, "peer", None)
@@ -378,6 +380,81 @@ class NativeSyscallHandler:
                 process.mem.read(name_ptr, min(namelen, 128)))
         return self._sock_send(host, process, sock, data, dst, flags)
 
+    def sys_sendmmsg(self, host, process, thread, restarted, fd, vec_ptr,
+                     vlen, flags, *_):
+        """glibc's resolver sends the A and AAAA queries in one
+        sendmmsg (res_send.c) — without this the port-53 interception
+        never sees the queries.  mmsghdr = msghdr (56) + msg_len (4) +
+        pad (4)."""
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        vlen = min(int(vlen), 64)
+        sent = 0
+        for i in range(vlen):
+            msg_ptr = vec_ptr + i * 64
+            name_ptr, namelen, iov_ptr, iovlen = self._read_msghdr(
+                process, msg_ptr)
+            data = self._gather_iov(process, iov_ptr, iovlen)
+            dst = None
+            if name_ptr and namelen:
+                dst = _unpack_sockaddr_in(
+                    process.mem.read(name_ptr, min(namelen, 128)))
+            result = self._sock_send(host, process, sock, data, dst,
+                                     flags)
+            if result[0] != "done":
+                # Error/blocked mid-batch: report what already went out
+                # (Linux semantics), else surface the first failure.
+                return _done(sent) if sent else result
+            process.mem.write(msg_ptr + 56,
+                              struct.pack("<I", int(result[1])))
+            sent += 1
+        return _done(sent)
+
+    def sys_recvmmsg(self, host, process, thread, restarted, fd, vec_ptr,
+                     vlen, flags, timeout_ptr, *_):
+        if not self._is_emu(fd):
+            return _native()
+        sock = self._emu(process, fd)
+        vlen = min(int(vlen), 64)
+        got = 0
+        for i in range(vlen):
+            msg_ptr = vec_ptr + i * 64
+            name_ptr, _namelen, iov_ptr, iovlen = self._read_msghdr(
+                process, msg_ptr)
+            total = sum(l for _p, l in self._iovecs(process, iov_ptr,
+                                                    iovlen))
+            try:
+                data, peer = self._sock_recv(host, sock,
+                                             min(total, _MAX_IO),
+                                             peek=bool(flags & MSG_PEEK))
+            except BlockingIOError:
+                if got:
+                    return _done(got)
+                if sock.nonblocking or (flags & MSG_DONTWAIT) \
+                        or restarted:
+                    # restarted = the condition fired (data or timeout);
+                    # no data now means the timeout won.
+                    return _error(errno.EWOULDBLOCK)
+                timeout_at = None
+                if timeout_ptr:
+                    sec, nsec = _TIMESPEC.unpack(
+                        process.mem.read(timeout_ptr, 16))
+                    timeout_at = host.now() + sec * 10**9 + nsec
+                return _block(SyscallCondition(file=sock,
+                                               mask=S_READABLE,
+                                               timeout_at=timeout_at))
+            self._scatter_iov(process, iov_ptr, iovlen, data)
+            if name_ptr and peer is not None:
+                sa = _pack_sockaddr_in(*peer)
+                process.mem.write(name_ptr, sa)
+                process.mem.write(msg_ptr + 8,
+                                  struct.pack("<I", len(sa)))
+            process.mem.write(msg_ptr + 56,
+                              struct.pack("<I", len(data)))
+            got += 1
+        return _done(got)
+
     def sys_recvmsg(self, host, process, thread, restarted, fd, msg_ptr,
                     flags, *_):
         if not self._is_emu(fd):
@@ -387,7 +464,8 @@ class NativeSyscallHandler:
                                                                 msg_ptr)
         total = sum(l for _p, l in self._iovecs(process, iov_ptr, iovlen))
         try:
-            data, peer = self._sock_recv(host, sock, min(total, _MAX_IO))
+            data, peer = self._sock_recv(host, sock, min(total, _MAX_IO),
+                                         peek=bool(flags & MSG_PEEK))
         except BlockingIOError:
             if sock.nonblocking or (flags & MSG_DONTWAIT):
                 return _error(errno.EWOULDBLOCK)
@@ -1599,6 +1677,11 @@ class NativeSyscallHandler:
             return _error(errno.ECHILD)
         if options & self._WNOHANG:
             return _done(0)
+        return self._park_wait(process)
+
+    @staticmethod
+    def _park_wait(process):
+        """Block until a child exits (child_exited fires the cond)."""
         from shadow_tpu.host.condition import ManualCondition
         cond = ManualCondition()
         process._wait_conds.append(cond)
@@ -1615,6 +1698,8 @@ class NativeSyscallHandler:
         if idtype == P_ALL:
             pid = -1
         elif idtype == P_PID:
+            if int(id_) <= 0:
+                return _error(errno.EINVAL)
             pid = int(id_)
         else:
             return _error(errno.EINVAL)
@@ -1638,15 +1723,7 @@ class NativeSyscallHandler:
             if info_ptr:
                 process.mem.write(info_ptr, b"\0" * 128)
             return _done(0)
-        from shadow_tpu.host.condition import ManualCondition
-        cond = ManualCondition()
-        process._wait_conds.append(cond)
-
-        def drop():
-            if cond in process._wait_conds:
-                process._wait_conds.remove(cond)
-        cond.on_disarm = drop
-        return _block(cond)
+        return self._park_wait(process)
 
     def sys_exit(self, host, process, thread, restarted, code, *_):
         from shadow_tpu.host.managed import ManagedProcess

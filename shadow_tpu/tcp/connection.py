@@ -257,6 +257,18 @@ class TcpConnection:
         return (self.peer_fin_seq is not None and self.recv_buf_len == 0
                 and not self.reassembly)
 
+    def peek(self, n: int) -> bytes:
+        """MSG_PEEK: copy up to n readable bytes without consuming
+        (header sniffing — wget peeks the HTTP response)."""
+        out = bytearray()
+        for chunk in self.recv_buf:
+            if n <= 0:
+                break
+            take = chunk[:n]
+            out += take
+            n -= len(take)
+        return bytes(out)
+
     def read(self, n: int, now: int) -> bytes:
         window_before = self._recv_window()
         out = bytearray()
