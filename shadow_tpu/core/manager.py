@@ -141,6 +141,7 @@ class Manager:
         sched = config.experimental.scheduler
         threaded = sched in ("thread_per_core", "thread_per_host")
         self._per_host_tasks = sched == "thread_per_host"
+        self._next_times: dict[int, int | None] = {}
         if sched == "tpu" and config.experimental.tpu_shards > 1:
             from shadow_tpu.parallel.mesh_propagator import MeshPropagator
             self.propagator = MeshPropagator(
@@ -255,12 +256,42 @@ class Manager:
     # ------------------------------------------------------------------
 
     def _min_next_event(self) -> int | None:
+        """One pass over hosts: the global minimum for the barrier, and
+        a cached per-host next-event snapshot that _run_hosts reuses for
+        its idle filter (avoids a second full peek scan per round).
+        Snapshot staleness is safe: events only appear between the scan
+        and the next round via inbox deliveries, which the idle filter
+        checks directly."""
         best = None
+        times = self._next_times
+        times.clear()
         for h in self.hosts:
-            t = h.next_event_time()
+            t = h.queue.peek_time()
+            times[h.id] = t
             if t is not None and (best is None or t < best):
                 best = t
         return best
+
+    def _active_hosts(self, until: int) -> list:
+        """Hosts whose `execute(until)` would do work: an inbox delivery
+        pending, or a heap event inside the window (from the snapshot
+        taken by the last _min_next_event scan).  At scale most hosts
+        are idle most rounds; skipping them is a pure win because the
+        barrier already covers in-flight packets via the propagator's
+        finish_round min (a mid-round inbox append just runs next
+        round, exactly as if the host had executed)."""
+        times = self._next_times
+        if not times:
+            return self.hosts
+        out = []
+        for h in self.hosts:
+            if h._inbox:
+                out.append(h)
+            else:
+                t = times.get(h.id)
+                if t is not None and t < until:
+                    out.append(h)
+        return out
 
     def _run_hosts(self, until: int) -> None:
         if self._perf_timers:
@@ -272,20 +303,21 @@ class Manager:
                 h.execute(until)
                 h.perf_exec_ns += time.perf_counter_ns() - t0
             return
+        active = self._active_hosts(until)
         if self._pool is None:
-            for h in self.hosts:
+            for h in active:
                 h.execute(until)
         elif self._per_host_tasks:
             # thread_per_host (scheduler/thread_per_host.rs): one task per
             # host, pool-sized by min(cores, hosts).
-            list(self._pool.map(lambda h: h.execute(until), self.hosts))
+            list(self._pool.map(lambda h: h.execute(until), active))
         else:
             # thread_per_core (thread_per_core.rs): contiguous strides per
             # worker; Python threads serialize CPU work on the GIL, so
             # this validates the concurrency protocol more than it buys
             # speed — the TPU scheduler is the performance path.
             n = self._pool._max_workers
-            chunks = [self.hosts[i::n] for i in range(n)]
+            chunks = [active[i::n] for i in range(n)]
 
             def run_chunk(chunk):
                 for h in chunk:
@@ -315,6 +347,11 @@ class Manager:
         # sharded backend) — the Python-side host scan is bypassed.
         device_barrier = getattr(self.propagator, "provides_barrier", False)
         start = self._min_next_event()
+        if device_barrier:
+            # The mesh backend computes the barrier itself and this loop
+            # never rescans hosts, so the per-host snapshot would go
+            # stale — drop it and run every host each round.
+            self._next_times.clear()
         while start is not None and start < stop:
             window_end = min(start + self.runahead.get(), stop)
             self.propagator.begin_round(start, window_end)

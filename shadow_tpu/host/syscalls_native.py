@@ -432,9 +432,10 @@ class NativeSyscallHandler:
                 if got:
                     return _done(got)
                 if sock.nonblocking or (flags & MSG_DONTWAIT) \
-                        or restarted:
-                    # restarted = the condition fired (data or timeout);
-                    # no data now means the timeout won.
+                        or (restarted and timeout_ptr):
+                    # restarted with a timeout armed = the condition
+                    # fired; no data now means the timeout won.  With a
+                    # NULL timeout a spurious wake just re-blocks.
                     return _error(errno.EWOULDBLOCK)
                 timeout_at = None
                 if timeout_ptr:
