@@ -37,6 +37,7 @@ from shadow_tpu.net.dns import Dns
 @dataclass
 class SimSummary:
     end_time_ns: int = 0
+    busy_end_ns: int = 0  # window end of the last round that ran events
     rounds: int = 0
     events: int = 0
     packets_sent: int = 0
@@ -358,6 +359,7 @@ class Manager:
             self._run_hosts(window_end)
             inflight_min = self.propagator.finish_round()
             summary.rounds += 1
+            summary.busy_end_ns = window_end
             if heartbeat_lines and window_end >= next_heartbeat:
                 self._log_heartbeat(window_end, stop, wall_start, sys.stderr)
                 next_heartbeat = window_end + heartbeat

@@ -1,0 +1,386 @@
+"""SoA transport stepping on device — the receive-chain kernel.
+
+The north star (SURVEY.md §7.6) is transport state as struct-of-arrays
+stepped by vectorized JAX functions.  This module is the beachhead: the
+per-host *receive chain* — router CoDel AQM (RFC 8289) followed by the
+inet-in token-bucket relay (download bandwidth) — stepped for a whole
+batch of arrivals across all hosts in one `vmap(lax.scan)` program.
+
+Semantics are extracted, instant for instant, from the object path
+(net/codel.py `CoDelQueue.pop`, net/token_bucket.py, net/relay.py
+`Relay._forward_until_blocked`; ref codel_queue.rs:65-303,
+token_bucket.rs, relay/mod.rs:201-273):
+
+ - the relay loop runs at discrete activation instants (an arrival when
+   idle, a refill wakeup when a packet is parked); every CoDel pop in
+   one activation shares that activation's `now`;
+ - packet i's pop instant is `max(e_i, f_{i-1})` where `f_{i-1}` is the
+   instant the previous packet finished (forwarded or dropped);
+ - whenever the queue drains (`e_i > f_{i-1}`), the empty-dequeue reset
+   fires (`first_above = 0`, `dropping = False`);
+ - CoDel's drop machine is per-dequeue: a three-phase automaton (fresh
+   pop / inside the drop-while-loop / the dequeue following an entry
+   drop) carried packet-to-packet;
+ - a forwarded packet conforms to the token bucket at its pop instant
+   or at the first refill boundary with enough balance (closed form of
+   the park/wakeup loop; capacity >= MTU guarantees convergence).
+
+`receive_chain_scalar` is the Python-int twin; `build_receive_chain`
+returns the jitted device program producing bit-identical integers.
+The object path stays authoritative for the simulator until the
+integration flips; differential tests drive all three against each
+other (tests/test_transport_step.py).
+
+Known contract bounds (callers must respect):
+ - arrivals are presented FIFO (sorted by enqueue instant per host);
+ - the CoDel hard limit (1000 queued) is NOT modeled — callers check
+   the returned pop instants for occupancy and fall back to the object
+   path for saturated hosts;
+ - batch boundaries must be *drain points*: every arrival in batch N+1
+   must be strictly later than every pop/forward instant of batch N
+   (i.e. the queue emptied and the relay went idle in between).  CoDel's
+   queued-bytes test looks across the whole queue, so a pop that would
+   interleave with later-batch arrivals needs those arrivals in the
+   same batch.  Callers detect a non-drained host (`state.f_prev >=`
+   the next batch's first arrival) and either merge batches or fall
+   back to the object path for it.
+"""
+
+from __future__ import annotations
+
+from math import isqrt
+
+from shadow_tpu.net.codel import INTERVAL_NS, TARGET_NS
+from shadow_tpu.net.packet import MTU
+
+PHASE_FRESH = 0
+PHASE_INLOOP = 1   # inside pop()'s drop-while-loop
+PHASE_ENTER2 = 2   # the dequeue right after an entry drop
+
+
+def _control_time(t: int, count: int) -> int:
+    """next drop time = t + INTERVAL / sqrt(count) (integer ns)."""
+    return t + (INTERVAL_NS << 16) // isqrt(count << 32)
+
+
+class ChainState:
+    """Per-host receive-chain state carried between batches."""
+
+    __slots__ = ("f_prev", "phase", "dropping", "count", "last_count",
+                 "first_above", "drop_next", "balance", "next_refill",
+                 "capacity", "refill_size", "refill_interval")
+
+    def __init__(self, capacity: int, refill_size: int,
+                 refill_interval: int):
+        self.f_prev = 0
+        self.phase = PHASE_FRESH
+        self.dropping = False
+        self.count = 0
+        self.last_count = 0
+        self.first_above = 0
+        self.drop_next = 0
+        self.balance = capacity
+        self.next_refill = 0
+        self.capacity = capacity
+        self.refill_size = refill_size
+        self.refill_interval = refill_interval
+
+
+def receive_chain_scalar(state: ChainState, arrivals, sizes):
+    """Step one batch through CoDel + token bucket for one host.
+
+    arrivals: enqueue instants, sorted ascending; sizes: packet bytes.
+    Returns (dropped, fwd_time, pop_now) lists; mutates `state`.
+    """
+    n = len(arrivals)
+    prefix = [0] * (n + 1)
+    for i, s in enumerate(sizes):
+        prefix[i + 1] = prefix[i] + s
+
+    dropped = [False] * n
+    fwd = [0] * n
+    pops = [0] * n
+
+    for i in range(n):
+        e, size = arrivals[i], sizes[i]
+        pop_now = e if e > state.f_prev else state.f_prev
+        if e > state.f_prev:
+            # Queue drained since the previous packet: empty-dequeue
+            # reset (codel.py _dequeue_raw empty branch + pop()).
+            state.first_above = 0
+            state.dropping = False
+            state.phase = PHASE_FRESH
+        pops[i] = pop_now
+
+        # _dequeue_raw(pop_now) for this packet.
+        # Bytes still queued after removing it: arrivals j>i with
+        # e_j <= pop_now.
+        hi = i + 1
+        while hi < n and arrivals[hi] <= pop_now:
+            hi += 1
+        bytes_after = prefix[hi] - prefix[i + 1]
+        sojourn = pop_now - e
+        if sojourn < TARGET_NS or bytes_after <= MTU:
+            state.first_above = 0
+            ok = False
+        elif state.first_above == 0:
+            state.first_above = pop_now + INTERVAL_NS
+            ok = False
+        else:
+            ok = pop_now >= state.first_above
+
+        # pop()'s drop machine, one dequeue at a time.
+        drop = False
+        phase = state.phase
+        if phase == PHASE_FRESH:
+            if state.dropping:
+                if not ok:
+                    state.dropping = False
+                elif pop_now >= state.drop_next:
+                    drop = True
+                    state.count += 1
+                    state.phase = PHASE_INLOOP
+            elif ok and (pop_now - state.drop_next < INTERVAL_NS or
+                         pop_now - state.first_above >= INTERVAL_NS):
+                drop = True
+                state.phase = PHASE_ENTER2
+        elif phase == PHASE_INLOOP:
+            if not ok:
+                state.dropping = False
+            else:
+                state.drop_next = _control_time(state.drop_next,
+                                                state.count)
+                if pop_now >= state.drop_next:
+                    drop = True
+                    state.count += 1
+                    state.phase = PHASE_INLOOP
+        else:  # PHASE_ENTER2
+            state.dropping = True
+            if pop_now - state.drop_next < INTERVAL_NS:
+                state.count = (state.count - state.last_count
+                               if state.count > 2 else 1)
+            else:
+                state.count = 1
+            state.last_count = state.count
+            state.drop_next = _control_time(pop_now, state.count)
+
+        if drop:
+            dropped[i] = True
+            state.f_prev = pop_now
+            continue
+        state.phase = PHASE_FRESH
+
+        # Token bucket (token_bucket.py _advance/try_remove + the
+        # relay's park/wakeup loop, in closed form).
+        if state.next_refill == 0:
+            state.next_refill = pop_now + state.refill_interval
+        elif pop_now >= state.next_refill:
+            k = 1 + (pop_now - state.next_refill) // state.refill_interval
+            state.balance = min(state.capacity,
+                                state.balance + k * state.refill_size)
+            state.next_refill += k * state.refill_interval
+        if size <= state.balance:
+            state.balance -= size
+            t_fwd = pop_now
+        else:
+            need = size - state.balance
+            k = -(-need // state.refill_size)  # ceil
+            t_fwd = state.next_refill + (k - 1) * state.refill_interval
+            state.balance = min(state.capacity,
+                                state.balance + k * state.refill_size) \
+                - size
+            state.next_refill += k * state.refill_interval
+        fwd[i] = t_fwd
+        state.f_prev = t_fwd
+
+    return dropped, fwd, pops
+
+
+def build_receive_chain(max_slots: int):
+    """Jitted device program: step `max_slots` arrival slots for H hosts.
+
+    Inputs (int64 unless noted):
+      e[H,S] sorted arrival instants (TIME_NEVER-padded), size[H,S],
+      valid[H,S] bool, plus the ChainState arrays (f_prev, phase,
+      dropping, count, last_count, first_above, drop_next, balance,
+      next_refill)[H] and bucket config (capacity, refill_size,
+      refill_interval)[H].
+
+    Returns (dropped[H,S] bool, fwd[H,S], pop[H,S], new state tuple) —
+    bit-identical to receive_chain_scalar.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    target = jnp.int64(TARGET_NS)
+    interval = jnp.int64(INTERVAL_NS)
+    mtu = jnp.int64(MTU)
+
+    def _isqrt(x):
+        """Exact floor-sqrt for 0 < x < 2^52 in integer ops (the CPU
+        twin uses math.isqrt; the control law must match bit-for-bit)."""
+        g = jnp.maximum(
+            jnp.int64(1),
+            jnp.sqrt(x.astype(jnp.float32)).astype(jnp.int64))
+        for _ in range(4):
+            g = (g + x // g) >> 1
+        g = jnp.where(g * g > x, g - 1, g)
+        g = jnp.where((g + 1) * (g + 1) <= x, g + 1, g)
+        g = jnp.where(g * g > x, g - 1, g)
+        return g
+
+    def _control(t, count):
+        # count is clamped: the FRESH branch computes this speculatively
+        # even when count==0, and integer division by zero is undefined
+        # per XLA backend.
+        return t + (interval << 16) // _isqrt(
+            jnp.maximum(count, 1) << 32)
+
+    def host_scan(e, size, valid, f_prev, phase, dropping, count,
+                  last_count, first_above, drop_next, balance,
+                  next_refill, capacity, refill_size, refill_interval):
+        prefix = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int64),
+             jnp.cumsum(jnp.where(valid, size, 0))])
+
+        def step(carry, xs):
+            (f_prev, phase, dropping, count, last_count, first_above,
+             drop_next, balance, next_refill) = carry
+            e_i, size_i, valid_i, i = xs
+
+            fresh_arrival = e_i > f_prev
+            pop_now = jnp.maximum(e_i, f_prev)
+            first_above = jnp.where(fresh_arrival, 0, first_above)
+            dropping = jnp.where(fresh_arrival, False, dropping)
+            phase = jnp.where(fresh_arrival, PHASE_FRESH, phase)
+
+            hi = jnp.searchsorted(e, pop_now, side="right")
+            bytes_after = prefix[hi] - prefix[i + 1]
+            sojourn = pop_now - e_i
+            below = (sojourn < target) | (bytes_after <= mtu)
+            fa_zero = first_above == 0
+            first_above = jnp.where(
+                below, 0,
+                jnp.where(fa_zero, pop_now + interval, first_above))
+            ok = jnp.logical_not(below) & jnp.logical_not(fa_zero) \
+                & (pop_now >= first_above)
+
+            # Drop machine.
+            is_fresh = phase == PHASE_FRESH
+            is_inloop = phase == PHASE_INLOOP
+            is_enter2 = phase == PHASE_ENTER2
+
+            # FRESH
+            fresh_drop = jnp.where(
+                dropping,
+                ok & (pop_now >= drop_next),
+                ok & ((pop_now - drop_next < interval) |
+                      (pop_now - first_above >= interval)))
+            fresh_phase = jnp.where(
+                fresh_drop,
+                jnp.where(dropping, PHASE_INLOOP, PHASE_ENTER2),
+                PHASE_FRESH)
+            fresh_dropping = jnp.where(dropping & jnp.logical_not(ok),
+                                       False, dropping)
+            fresh_count = jnp.where(dropping & fresh_drop, count + 1,
+                                    count)
+
+            # INLOOP
+            in_dn = _control(drop_next, count)
+            in_drop = ok & (pop_now >= in_dn)
+            in_dropping = jnp.where(jnp.logical_not(ok), False, dropping)
+            in_count = jnp.where(in_drop, count + 1, count)
+            in_drop_next = jnp.where(ok, in_dn, drop_next)
+
+            # ENTER2
+            en_count = jnp.where(
+                pop_now - drop_next < interval,
+                jnp.where(count > 2, count - last_count, 1),
+                jnp.int64(1))
+            en_drop_next = _control(pop_now, en_count)
+
+            drop = jnp.where(is_fresh, fresh_drop,
+                             jnp.where(is_inloop, in_drop, False))
+            count = jnp.where(is_fresh, fresh_count,
+                              jnp.where(is_inloop, in_count, en_count))
+            last_count = jnp.where(is_enter2, en_count, last_count)
+            drop_next = jnp.where(is_fresh, drop_next,
+                                  jnp.where(is_inloop, in_drop_next,
+                                            en_drop_next))
+            dropping = jnp.where(is_fresh, fresh_dropping,
+                                 jnp.where(is_inloop, in_dropping, True))
+            phase = jnp.where(is_fresh, fresh_phase,
+                              jnp.where(is_inloop,
+                                        jnp.where(in_drop, PHASE_INLOOP,
+                                                  PHASE_FRESH),
+                                        PHASE_FRESH))
+
+            # Token bucket for forwarded packets.
+            anchor = next_refill == 0
+            adv = jnp.logical_not(anchor) & (pop_now >= next_refill)
+            k_adv = jnp.where(
+                adv, 1 + (pop_now - next_refill) // refill_interval, 0)
+            balance_adv = jnp.where(
+                adv,
+                jnp.minimum(capacity, balance + k_adv * refill_size),
+                balance)
+            next_refill_adv = jnp.where(
+                anchor, pop_now + refill_interval,
+                next_refill + k_adv * refill_interval)
+
+            conforms = size_i <= balance_adv
+            need = size_i - balance_adv
+            k = jnp.where(conforms, 0,
+                          -((-need) // refill_size))  # ceil for need>0
+            t_fwd = jnp.where(
+                conforms, pop_now,
+                next_refill_adv + (k - 1) * refill_interval)
+            balance_fwd = jnp.where(
+                conforms, balance_adv - size_i,
+                jnp.minimum(capacity, balance_adv + k * refill_size)
+                - size_i)
+            next_refill_fwd = next_refill_adv + k * refill_interval
+
+            fwd_taken = valid_i & jnp.logical_not(drop)
+            balance = jnp.where(fwd_taken, balance_fwd, balance)
+            next_refill = jnp.where(fwd_taken, next_refill_fwd,
+                                    next_refill)
+            phase = jnp.where(fwd_taken, PHASE_FRESH, phase)
+            f_prev_new = jnp.where(fwd_taken, t_fwd, pop_now)
+
+            # Padding slots: pass everything through untouched.
+            def keep(new, old):
+                return jnp.where(valid_i, new, old)
+
+            carry_out = (keep(f_prev_new, f_prev), keep(phase, carry[1]),
+                         keep(dropping, carry[2]), keep(count, carry[3]),
+                         keep(last_count, carry[4]),
+                         keep(first_above, carry[5]),
+                         keep(drop_next, carry[6]),
+                         keep(balance, carry[7]),
+                         keep(next_refill, carry[8]))
+            out = (valid_i & drop,
+                   jnp.where(fwd_taken, t_fwd, 0),
+                   jnp.where(valid_i, pop_now, 0))
+            return carry_out, out
+
+        idx = jnp.arange(e.shape[0], dtype=jnp.int64)
+        carry0 = (f_prev, phase, dropping, count, last_count,
+                  first_above, drop_next, balance, next_refill)
+        carry, (dropped, fwd, pops) = jax.lax.scan(
+            step, carry0, (e, size, valid, idx))
+        return dropped, fwd, pops, carry
+
+    vmapped = jax.vmap(host_scan)
+
+    @jax.jit
+    def program(e, size, valid, state, bucket_cfg):
+        (f_prev, phase, dropping, count, last_count, first_above,
+         drop_next, balance, next_refill) = state
+        capacity, refill_size, refill_interval = bucket_cfg
+        return vmapped(e, size, valid, f_prev, phase, dropping, count,
+                       last_count, first_above, drop_next, balance,
+                       next_refill, capacity, refill_size,
+                       refill_interval)
+
+    return program

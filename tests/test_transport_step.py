@@ -1,0 +1,227 @@
+"""SoA receive-chain stepping: three-way differential tests.
+
+object path (CoDelQueue + TokenBucket + Relay driven by a mini event
+loop) == scalar twin (ops/transport_step.receive_chain_scalar) ==
+device program (build_receive_chain, vmap(lax.scan)) — bit-identical
+forward instants and drop verdicts, which is the determinism contract
+vectorization must keep (SURVEY.md §7.6; ref codel_queue.rs,
+token_bucket.rs, relay/mod.rs).
+"""
+
+import heapq
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.event import TaskRef
+from shadow_tpu.net.codel import CoDelQueue
+from shadow_tpu.net.packet import MTU
+from shadow_tpu.net.relay import Relay
+from shadow_tpu.net.token_bucket import TokenBucket
+from shadow_tpu.ops.transport_step import (ChainState, build_receive_chain,
+                                           receive_chain_scalar)
+
+
+class FakePacket:
+    __slots__ = ("idx", "size", "dst_ip")
+
+    def __init__(self, idx, size):
+        self.idx = idx
+        self.size = size
+        self.dst_ip = 0
+
+    def total_size(self):
+        return self.size
+
+    def record(self, status):
+        pass
+
+
+class MiniHost:
+    """Just enough host surface for Router-style CoDel + Relay."""
+
+    def __init__(self):
+        self._now = 0
+        self._heap = []
+        self._seq = 0
+        self.delivered = []   # (packet_idx, time)
+        self.dropped = []     # (packet_idx, time)
+
+    def now(self):
+        return self._now
+
+    def schedule_task_at(self, t, task):
+        assert t >= self._now
+        heapq.heappush(self._heap, (t, self._seq, task))
+        self._seq += 1
+
+    def get_packet_device(self, dst_ip):
+        return self
+
+    def push(self, host, packet):
+        self.delivered.append((packet.idx, self._now))
+
+    def trace_drop(self, packet, reason):
+        self.dropped.append((packet.idx, self._now))
+
+    def run(self):
+        while self._heap:
+            t, _seq, task = heapq.heappop(self._heap)
+            self._now = t
+            task.execute(self)
+
+
+def drive_objects(arrivals, sizes, capacity, refill, interval):
+    """The authoritative object path: arrivals enqueue into a CoDel
+    queue and notify an inet-in relay, exactly like Host wiring."""
+    host = MiniHost()
+    codel = CoDelQueue()
+    bucket = TokenBucket(capacity, refill, interval)
+    relay = Relay("in", lambda h, now: codel.pop(
+        now, lambda p: h.trace_drop(p, "codel")), bucket)
+
+    for i, (t, size) in enumerate(zip(arrivals, sizes)):
+        p = FakePacket(i, size)
+
+        def arrive(h, p=p):
+            codel.push(p, h.now(), lambda q: h.trace_drop(q, "limit"))
+            relay.notify(h)
+
+        host.schedule_task_at(t, TaskRef("arrival", arrive))
+    host.run()
+    fwd = {idx: t for idx, t in host.delivered}
+    dropped = {idx for idx, _t in host.dropped}
+    return dropped, fwd
+
+
+def gen_case(rng, n, congested):
+    """Random arrival schedule; `congested` pushes sustained overload so
+    CoDel's drop machine actually engages."""
+    if congested:
+        gaps = rng.integers(10_000, 120_000, size=n)     # ~1500B/60us
+    else:
+        gaps = rng.integers(50_000, 3_000_000, size=n)
+    arrivals = np.cumsum(gaps).astype(np.int64)
+    sizes = rng.integers(64, MTU, size=n).astype(np.int64)
+    return arrivals.tolist(), sizes.tolist()
+
+
+CONFIGS = [
+    # (capacity, refill) for 100 Mbit and 10 Mbit download links, 1ms.
+    (max(12_500, MTU), 12_500, 1_000_000),
+    (max(1_250, MTU), 1_250, 1_000_000),
+]
+
+
+@pytest.mark.parametrize("cap,refill,interval", CONFIGS)
+@pytest.mark.parametrize("congested", [False, True])
+def test_scalar_twin_matches_objects(cap, refill, interval, congested):
+    rng = np.random.default_rng(42 + congested)
+    for trial in range(6):
+        arrivals, sizes = gen_case(rng, 400, congested)
+        obj_dropped, obj_fwd = drive_objects(arrivals, sizes, cap,
+                                             refill, interval)
+        state = ChainState(cap, refill, interval)
+        dropped, fwd, _pops = receive_chain_scalar(state, arrivals, sizes)
+        tw_dropped = {i for i, d in enumerate(dropped) if d}
+        tw_fwd = {i: fwd[i] for i in range(len(arrivals))
+                  if not dropped[i]}
+        assert tw_dropped == obj_dropped, \
+            f"trial {trial}: drop sets differ " \
+            f"({tw_dropped ^ obj_dropped})"
+        assert tw_fwd == obj_fwd, f"trial {trial}: forward times differ"
+
+
+def test_scalar_state_carries_across_batches():
+    """Splitting a stream at drain points (the documented batch-boundary
+    contract) must equal one big batch."""
+    rng = np.random.default_rng(7)
+    arrivals, sizes = gen_case(rng, 600, congested=True)
+    cap, refill, interval = CONFIGS[1]
+
+    whole = ChainState(cap, refill, interval)
+    d_all, f_all, p_all = receive_chain_scalar(whole, arrivals, sizes)
+
+    # Valid split points: the chain fully drained before the arrival
+    # (every earlier pop/forward instant is < the arrival).
+    busy_until = 0
+    drain_points = []
+    for i in range(1, 600):
+        busy_until = max(busy_until, p_all[i - 1], f_all[i - 1])
+        if arrivals[i] > busy_until:
+            drain_points.append(i)
+    # Use a handful of spread-out drain points as batch boundaries.
+    cuts = [0] + drain_points[:: max(1, len(drain_points) // 5)] + [600]
+    cuts = sorted(set(cuts))
+    assert len(cuts) >= 4, "workload produced too few drain points"
+
+    split = ChainState(cap, refill, interval)
+    d_parts, f_parts = [], []
+    for lo, hi in zip(cuts, cuts[1:]):
+        d, f, _ = receive_chain_scalar(split, arrivals[lo:hi],
+                                       sizes[lo:hi])
+        d_parts += d
+        f_parts += f
+    assert d_parts == d_all
+    assert f_parts == f_all
+    assert split.f_prev == whole.f_prev
+    assert split.balance == whole.balance
+    assert split.drop_next == whole.drop_next
+
+
+@pytest.mark.parametrize("congested", [False, True])
+def test_device_program_matches_scalar(congested):
+    """vmap(lax.scan) over an 8-host batch == the scalar twin, bit for
+    bit, including the integer-isqrt control law."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11 + congested)
+    H, S = 8, 256
+    e = np.full((H, S), np.iinfo(np.int64).max // 2, dtype=np.int64)
+    sz = np.zeros((H, S), dtype=np.int64)
+    valid = np.zeros((H, S), dtype=bool)
+    counts = rng.integers(S // 2, S + 1, size=H)
+    cases = []
+    for h in range(H):
+        n = int(counts[h])
+        arrivals, sizes = gen_case(rng, n, congested=(h % 2 == congested))
+        e[h, :n] = arrivals
+        sz[h, :n] = sizes
+        valid[h, :n] = True
+        cases.append((n, arrivals, sizes))
+
+    cfgs = [CONFIGS[h % 2] for h in range(H)]
+    cap = np.array([c[0] for c in cfgs], dtype=np.int64)
+    refill = np.array([c[1] for c in cfgs], dtype=np.int64)
+    interval = np.array([c[2] for c in cfgs], dtype=np.int64)
+
+    program = build_receive_chain(S)
+    state0 = (np.zeros(H, np.int64),            # f_prev
+              np.zeros(H, np.int64),            # phase
+              np.zeros(H, bool),                # dropping
+              np.zeros(H, np.int64),            # count
+              np.zeros(H, np.int64),            # last_count
+              np.zeros(H, np.int64),            # first_above
+              np.zeros(H, np.int64),            # drop_next
+              cap.copy(),                       # balance
+              np.zeros(H, np.int64))            # next_refill
+    dropped, fwd, pops, state1 = program(
+        jnp.asarray(e), jnp.asarray(sz), jnp.asarray(valid),
+        tuple(jnp.asarray(a) for a in state0),
+        (jnp.asarray(cap), jnp.asarray(refill), jnp.asarray(interval)))
+    dropped = np.asarray(dropped)
+    fwd = np.asarray(fwd)
+    pops = np.asarray(pops)
+    state1 = [np.asarray(a) for a in state1]
+
+    for h, (n, arrivals, sizes) in enumerate(cases):
+        st = ChainState(int(cap[h]), int(refill[h]), int(interval[h]))
+        d_ref, f_ref, p_ref = receive_chain_scalar(st, arrivals, sizes)
+        assert dropped[h, :n].tolist() == d_ref, f"host {h} drops"
+        assert fwd[h, :n].tolist() == f_ref, f"host {h} fwd times"
+        assert pops[h, :n].tolist() == p_ref, f"host {h} pop instants"
+        assert int(state1[0][h]) == st.f_prev
+        assert int(state1[3][h]) == st.count
+        assert int(state1[6][h]) == st.drop_next
+        assert int(state1[7][h]) == st.balance
+        assert int(state1[8][h]) == st.next_refill
