@@ -435,6 +435,93 @@ static long shim_emulated_syscall(long n, const long args[6]) {
 }
 
 /* ---------------------------------------------------------------- */
+/* rdtsc/rdtscp emulation (ref: shim_rdtsc.c + src/lib/tsc)          */
+/* ---------------------------------------------------------------- */
+
+/* seccomp cannot trap rdtsc; PR_SET_TSC(PR_TSC_SIGSEGV) makes every
+ * rdtsc/rdtscp fault, and this SIGSEGV handler decodes and emulates
+ * them against the simulated clock.  The emulated TSC runs at a fixed
+ * 1 GHz (cycles == simulated nanoseconds): deterministic across
+ * machines, unlike the reference's measured-host-frequency Tsc. */
+
+static int is_rdtsc(const unsigned char *insn) {
+    return insn[0] == 0x0f && insn[1] == 0x31;
+}
+
+static int is_rdtscp(const unsigned char *insn) {
+    return insn[0] == 0x0f && insn[1] == 0x01 && insn[2] == 0xf9;
+}
+
+static void sigsegv_handler(int sig, siginfo_t *info, void *ucontext) {
+    (void)sig;
+    ucontext_t *ctx = (ucontext_t *)ucontext;
+    greg_t *regs = ctx->uc_mcontext.gregs;
+    /* An unmapped-region fault has SEGV_MAPERR; only a privileged-
+     * instruction style fault can be rdtsc (and reading the insn bytes
+     * is then safe — RIP is executable and mapped). */
+    if (info->si_code != SEGV_MAPERR) {
+        const unsigned char *insn = (const unsigned char *)regs[REG_RIP];
+        int tsc = is_rdtsc(insn);
+        int tscp = !tsc && is_rdtscp(insn);
+        if (tsc || tscp) {
+            /* Through the emulated-syscall path, not a bare clock
+             * read: the every-Nth forward keeps rdtsc-polling spin
+             * loops advancing simulated time (CPU-latency model). */
+            struct timespec ts;
+            long args[6] = {CLOCK_MONOTONIC, (long)&ts, 0, 0, 0, 0};
+            shim_emulated_syscall(SYS_clock_gettime, args);
+            uint64_t nanos = (uint64_t)ts.tv_sec * 1000000000ull +
+                             (uint64_t)ts.tv_nsec;
+            regs[REG_RAX] = (greg_t)(nanos & 0xffffffffull);
+            regs[REG_RDX] = (greg_t)(nanos >> 32);
+            if (tscp) {
+                regs[REG_RCX] = 0; /* IA32_TSC_AUX: cpu 0, node 0 */
+                regs[REG_RIP] += 3;
+            } else {
+                regs[REG_RIP] += 2;
+            }
+            return;
+        }
+    }
+    /* A real fault: chain to the app's emulated SIGSEGV handler if it
+     * installed one (the manager never installs app SIGSEGV actions
+     * natively — this handler owns the native slot for rdtsc), else
+     * restore the default action and refault so the kernel terminates
+     * the process normally (a crashed plugin, not a sim failure). */
+    uint64_t app = __atomic_load_n(
+        (uint64_t *)&g_ipc->app_sigsegv_handler, __ATOMIC_ACQUIRE);
+    if (app > 1) {
+        uint64_t flags = __atomic_load_n(
+            (uint64_t *)&g_ipc->app_sigsegv_flags, __ATOMIC_ACQUIRE);
+        if (flags & SHIM_SA_SIGINFO)
+            ((void (*)(int, siginfo_t *, void *))(uintptr_t)app)(
+                SIGSEGV, info, ucontext);
+        else
+            ((void (*)(int))(uintptr_t)app)(SIGSEGV);
+        return;
+    }
+    if (app == 1)
+        return; /* SIG_IGN (questionable for a real fault, but explicit) */
+    struct sigaction dfl;
+    memset(&dfl, 0, sizeof(dfl));
+    dfl.sa_handler = SIG_DFL;
+    sigaction(SIGSEGV, &dfl, NULL);
+}
+
+static void install_rdtsc_trap(void) {
+#ifdef PR_SET_TSC
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = sigsegv_handler;
+    sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+    if (sigaction(SIGSEGV, &sa, NULL) != 0)
+        shim_die("[shadow-tpu shim] sigaction(SIGSEGV) failed\n");
+    if (raw(SYS_prctl, PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0, 0) != 0)
+        shim_die("[shadow-tpu shim] PR_SET_TSC failed\n");
+#endif
+}
+
+/* ---------------------------------------------------------------- */
 /* SIGSYS: where trapped application syscalls land                   */
 /* ---------------------------------------------------------------- */
 
@@ -567,6 +654,7 @@ static void shim_init(void) {
     if (sigaction(SIGSYS, &sa, NULL) != 0)
         shim_die("[shadow-tpu shim] sigaction(SIGSYS) failed\n");
 
+    install_rdtsc_trap();
     install_seccomp();
     g_enabled = 1;
 

@@ -33,7 +33,7 @@ typedef _Atomic uint64_t ipc_atomic_u64;
 #endif
 
 #define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
-#define SHIM_IPC_VERSION 4u
+#define SHIM_IPC_VERSION 5u
 
 /* Slot status values; the status word doubles as the futex word. */
 enum {
@@ -108,7 +108,7 @@ typedef struct {
 } ipc_chan_t;               /* 320 bytes */
 
 #define IPC_N_CHANS    64
-#define IPC_CHANS_OFF  512  /* header padded to 512 bytes */
+#define IPC_CHANS_OFF  576  /* header padded to 576 bytes */
 #define IPC_PATH_MAX   160
 
 typedef struct {
@@ -122,6 +122,12 @@ typedef struct {
     ipc_atomic_u64 sim_time_ns;
     /* Deterministic bytes for AT_RANDOM-style needs (future use). */
     uint64_t auxv_random[2];
+    /* The app's emulated SIGSEGV sigaction, maintained by the manager
+     * (rt_sigaction is NOT installed natively for SIGSEGV — the shim
+     * owns the native handler for rdtsc emulation and chains real
+     * faults to this address; ref shim_rdtsc.c + signals.rs). */
+    ipc_atomic_u64 app_sigsegv_handler; /* 0 = SIG_DFL, 1 = SIG_IGN */
+    ipc_atomic_u64 app_sigsegv_flags;
     /* This block's own /dev/shm path: the shim re-exports it as
      * SHADOWTPU_IPC when the app calls execve, so the new image's
      * constructor rebinds to the same process. */
@@ -131,7 +137,7 @@ typedef struct {
     char fork_path[IPC_PATH_MAX];
     /* LD_PRELOAD value to re-export across execve. */
     char preload_path[IPC_PATH_MAX];
-    /* 32 + 3*160 == IPC_CHANS_OFF exactly (asserted below). */
+    uint8_t _hdr_pad[IPC_CHANS_OFF - 48 - 3 * IPC_PATH_MAX];
     ipc_chan_t chans[IPC_N_CHANS];
 } shim_ipc_t;
 
@@ -144,9 +150,10 @@ typedef struct {
 /* Offsets the Python side mirrors (checked by tests). */
 #define IPC_OFF_SIM_TIME   8
 #define IPC_OFF_AUXV       16
-#define IPC_OFF_SELF_PATH  32
-#define IPC_OFF_FORK_PATH  (32 + IPC_PATH_MAX)
-#define IPC_OFF_PRELOAD    (32 + 2 * IPC_PATH_MAX)
+#define IPC_OFF_SIGSEGV    32
+#define IPC_OFF_SELF_PATH  48
+#define IPC_OFF_FORK_PATH  (48 + IPC_PATH_MAX)
+#define IPC_OFF_PRELOAD    (48 + 2 * IPC_PATH_MAX)
 #define IPC_CHAN_STRIDE    320
 #define IPC_CHAN_TO_SHADOW 0
 #define IPC_CHAN_TO_SHIM   72

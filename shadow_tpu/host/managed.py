@@ -103,6 +103,50 @@ class MemoryManager:
             self._fd = -1
 
 
+def _elf_missing_interp(path: str, _depth: int = 0) -> bool:
+    """True when the preload shim cannot ride into `path`: a static
+    64-bit ELF (no PT_INTERP), a 32-bit ELF (the shim is 64-bit; ld.so
+    would skip it with only a warning and the process would run
+    UN-interposed), or a shebang script whose interpreter fails the
+    same check (the kernel loads the interpreter directly — there is no
+    later execve to catch it).  The reference rejects the static case
+    identically ('not a dynamically linked ELF', src/test/static-bin
+    asserts that error).  Unreadable/corrupt files return False: the
+    kernel's own ENOEXEC path produces the clearer error."""
+    import struct as _struct
+    try:
+        with open(path, "rb") as f:
+            hdr = f.read(64)
+            if hdr[:2] == b"#!" and _depth < 4:
+                line = (hdr + f.read(192)).split(b"\n", 1)[0][2:]
+                interp = line.strip().split()
+                if not interp:
+                    return False
+                return _elf_missing_interp(
+                    interp[0].decode(errors="replace"), _depth + 1)
+            if len(hdr) < 64 or hdr[:4] != b"\x7fELF":
+                return False  # not an ELF; let the kernel decide
+            if hdr[4] != 2:
+                return True   # 32-bit: the 64-bit shim can't load
+            phoff = _struct.unpack_from("<Q", hdr, 32)[0]
+            phentsize = _struct.unpack_from("<H", hdr, 54)[0]
+            phnum = _struct.unpack_from("<H", hdr, 56)[0]
+            if phnum == 0 or phentsize < 56:
+                return True
+            f.seek(phoff)
+            phdrs = f.read(phentsize * min(phnum, 128))
+            PT_INTERP = 3
+            for i in range(min(phnum, 128)):
+                if (i + 1) * phentsize > len(phdrs):
+                    break  # truncated program headers
+                if _struct.unpack_from("<I", phdrs,
+                                       i * phentsize)[0] == PT_INTERP:
+                    return False
+            return True
+    except (OSError, _struct.error):
+        return False
+
+
 class ManagedProcess(Process):
     """A Process whose thread drives a real OS process.
 
@@ -208,6 +252,12 @@ class ManagedProcess(Process):
         resolved = shutil.which(exe) if exe and "/" not in exe else exe
         if not resolved or not os.path.exists(resolved):
             self.stderr += f"[shadow-tpu] no such binary: {exe!r}\n".encode()
+            self.exited = True
+            self.exit_code = 127
+            return
+        if _elf_missing_interp(resolved):
+            self.stderr += (f"[shadow-tpu] '{resolved}' is not a "
+                            f"dynamically linked ELF\n").encode()
             self.exited = True
             self.exit_code = 127
             return
@@ -748,6 +798,9 @@ class ManagedThread:
         child.mem = MemoryManager(native_pid)
         child.fds = parent.fds.fork_copy()
         child.signals = parent.signals.clone()
+        seg = child.signals.action(sigmod.SIGSEGV)
+        if seg.handler:
+            ipc.set_sigsegv_action(seg.handler, seg.flags)
         child.parent_pid = parent.pid
         child.strace_mode = parent.strace_mode
         # The child shares the parent's native stdout/stderr fds; it
@@ -805,6 +858,11 @@ class ManagedThread:
         if not os.access(resolved, os.X_OK):
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.EACCES)
             return True
+        if _elf_missing_interp(resolved):
+            # Static ELF: the shim cannot ride into it (see
+            # _elf_missing_interp); refuse like a bad format.
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.ENOEXEC)
+            return True
 
         env = {}
         for item in envp:
@@ -853,6 +911,9 @@ class ManagedThread:
         process.signals.actions = {
             s: a for s, a in process.signals.actions.items()
             if a.handler == 1}  # SIG_IGN survives, handlers reset
+        seg = process.signals.action(sigmod.SIGSEGV)
+        if seg.handler:
+            process.ipc_block.set_sigsegv_action(seg.handler, seg.flags)
         process.futex_table = FutexTable()
         new_thread.sig_mask = self.sig_mask  # exec preserves the mask
         host.schedule_task_at(host.now(), TaskRef("exec-start",

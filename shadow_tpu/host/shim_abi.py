@@ -23,11 +23,11 @@ import struct
 
 # --- constants mirrored from native/shim_ipc.h ---------------------
 MAGIC = 0x53545055
-VERSION = 4
+VERSION = 5
 FILE_SIZE = 24576
 
 N_CHANS = 64
-CHANS_OFF = 512
+CHANS_OFF = 576
 CHAN_STRIDE = 320
 CHAN_TO_SHADOW = 0
 CHAN_TO_SHIM = 72
@@ -54,9 +54,10 @@ OFF_MAGIC = 0
 OFF_VERSION = 4
 OFF_SIM_TIME = 8
 OFF_AUXV = 16
-OFF_SELF_PATH = 32
-OFF_FORK_PATH = 32 + PATH_MAX
-OFF_PRELOAD = 32 + 2 * PATH_MAX
+OFF_SIGSEGV = 32
+OFF_SELF_PATH = 48
+OFF_FORK_PATH = 48 + PATH_MAX
+OFF_PRELOAD = 48 + 2 * PATH_MAX
 SLOT_EV_OFF = 8
 EV_STRUCT = struct.Struct("<II7q")  # kind, pad, num, args[6]
 
@@ -215,6 +216,12 @@ class IpcBlock:
 
     def set_preload(self, value: str) -> None:
         self._write_cstr(OFF_PRELOAD, value)
+
+    def set_sigsegv_action(self, handler: int, flags: int) -> None:
+        """Publish the app's emulated SIGSEGV sigaction for the shim's
+        chaining fault handler (the shim owns the native SIGSEGV slot
+        for rdtsc emulation)."""
+        struct.pack_into("<QQ", self._mm, OFF_SIGSEGV, handler, flags)
 
     # -- teardown ---------------------------------------------------
 

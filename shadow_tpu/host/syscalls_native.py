@@ -1322,8 +1322,16 @@ class NativeSyscallHandler:
             process.mem.write(old_ptr, struct.pack(
                 "<QQQQ", old.handler, old.flags, old.restorer, old.mask))
         # Hardware-fault handlers are ALSO installed natively so a real
-        # fault in managed code (e.g. a GC's intentional SIGSEGV)
-        # reaches the app handler; SIGSYS stays the shim's.
+        # fault in managed code reaches the app handler — except
+        # SIGSEGV, whose native slot belongs to the shim's rdtsc trap:
+        # the app's action is published through the IPC header and the
+        # shim chains real faults to it.  SIGSYS stays the shim's.
+        if act_ptr and signum == S.SIGSEGV:
+            block = getattr(process, "ipc_block", None)
+            if block is not None:
+                act = sigs.action(signum)
+                block.set_sigsegv_action(act.handler, act.flags)
+            return _done(0)
         if act_ptr and signum in S.FAULT_SIGNALS:
             return _native()
         return _done(0)

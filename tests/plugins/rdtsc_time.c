@@ -1,0 +1,40 @@
+/* rdtsc/rdtscp under the simulator: PR_SET_TSC(SIGSEGV) decode must
+ * return the simulated clock at a fixed 1 GHz (cycles == sim ns), so
+ * two reads straddling a nanosleep differ by exactly the slept span
+ * (plus the modeled syscall latency, which is deterministic). */
+#include <stdint.h>
+#include <stdio.h>
+#include <time.h>
+
+static inline uint64_t rdtsc(void) {
+    uint32_t lo, hi;
+    __asm__ volatile("rdtsc" : "=a"(lo), "=d"(hi));
+    return ((uint64_t)hi << 32) | lo;
+}
+
+static inline uint64_t rdtscp(uint32_t *aux) {
+    uint32_t lo, hi;
+    __asm__ volatile("rdtscp" : "=a"(lo), "=d"(hi), "=c"(*aux));
+    return ((uint64_t)hi << 32) | lo;
+}
+
+int main(void) {
+    uint64_t t0 = rdtsc();
+    uint32_t aux = 99;
+    uint64_t t1 = rdtscp(&aux);
+    if (t1 < t0) {
+        puts("FAIL non-monotonic");
+        return 1;
+    }
+    struct timespec req = {1, 500000000};  /* 1.5s */
+    nanosleep(&req, 0);
+    uint64_t t2 = rdtsc();
+    printf("aux=%u slept_cycles=%lu\n", aux,
+           (unsigned long)(t2 - t1));
+    if (t2 - t1 < 1500000000ull) {
+        puts("FAIL slept too few cycles");
+        return 2;
+    }
+    puts("rdtsc_ok");
+    return 0;
+}
