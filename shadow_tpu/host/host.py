@@ -54,6 +54,9 @@ class Host:
         self.processes: dict[int, object] = {}
         self._next_pid = 1000
         self.data_path = None  # set by the manager; per-host output dir
+        # AF_UNIX name table: fs paths + '@'-prefixed abstract namespace
+        # (ref: abstract_unix_ns.rs; paths never touch the real fs).
+        self.unix_ns: dict[str, object] = {}
 
         # Network plane (host.rs:209-344 construction order).
         self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)

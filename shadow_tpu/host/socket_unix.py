@@ -1,0 +1,220 @@
+"""AF_UNIX sockets, fully emulated (ref: socket/unix.rs, 2,419 LoC,
+plus abstract_unix_ns.rs).
+
+Unix sockets must be emulated, not passed native: a native blocking
+read would park the real OS thread inside the kernel, stalling the
+manager's event pump on wall-clock time — the same reason inet sockets
+and pipes are simulated.  Transfers are host-local buffer moves with no
+network latency, like the reference.
+
+Namespace: per-host (`host.unix_ns`), holding both filesystem-style
+paths and the Linux abstract namespace (leading NUL).  Filesystem bind
+does NOT create a real directory entry — file I/O is native in our
+split, but socket files only matter to other in-sim sockets, and a
+phantom fs entry would leak across hosts.  An app stat()ing its own
+socket file is the known divergence.
+
+SCM_RIGHTS fd passing is not modeled (sendmsg with control data fails
+EINVAL rather than silently dropping fds).
+"""
+
+from __future__ import annotations
+
+import errno
+
+from shadow_tpu.host.status import (S_ACTIVE, S_CLOSED, S_READABLE,
+                                    S_SOCKET_ALLOWING_CONNECT, S_WRITABLE)
+from shadow_tpu.host.status import StatusOwner
+
+BUF_MAX = 212_992  # net.core.wmem_default'ish
+
+
+class UnixSocket(StatusOwner):
+    """One AF_UNIX endpoint: stream or dgram, bindable, connectable.
+
+    Stream data lives in the RECEIVER's `_recv_buf`; dgram in a
+    datagram queue with source addresses.
+    """
+
+    def __init__(self, host, stream: bool):
+        super().__init__()
+        self.host = host
+        self.stream = stream
+        self.nonblocking = False
+        self.bound_name: str | None = None   # "@name" for abstract
+        self.peer: "UnixSocket | None" = None
+        self.listening = False
+        self._backlog = 0
+        self._pending: list = []             # listener: accepted peers
+        self._recv_buf = bytearray()         # stream bytes
+        self._dgrams: list = []              # (data, src_name)
+        self._dgram_waiters: list = []       # senders parked on our queue
+        self._eof = False
+        self._status = S_ACTIVE | (0 if stream else S_WRITABLE)
+
+    # -- address book --------------------------------------------------
+
+    def bind(self, host, name: str) -> None:
+        if self.bound_name is not None:
+            raise OSError(errno.EINVAL, "already bound")
+        ns = host.unix_ns
+        if name in ns:
+            raise OSError(errno.EADDRINUSE, name)
+        ns[name] = self
+        self.bound_name = name
+
+    def listen(self, host, backlog: int) -> None:
+        if not self.stream:
+            raise OSError(errno.EOPNOTSUPP, "dgram listen")
+        if self.bound_name is None:
+            raise OSError(errno.EINVAL, "listen on unbound socket")
+        self.listening = True
+        self._backlog = max(1, backlog)
+        self.adjust_status(host, S_SOCKET_ALLOWING_CONNECT, 0)
+
+    # -- stream connection setup --------------------------------------
+
+    def connect(self, host, name: str) -> None:
+        if self.peer is not None:
+            raise OSError(errno.EISCONN, "already connected")
+        target = host.unix_ns.get(name)
+        if target is None:
+            raise OSError(errno.ECONNREFUSED
+                          if self.stream else errno.ENOENT, name)
+        if self.stream:
+            if not target.listening:
+                raise OSError(errno.ECONNREFUSED, name)
+            if len(target._pending) >= target._backlog:
+                raise OSError(errno.EAGAIN, "backlog full")
+            server = UnixSocket(host, stream=True)
+            server.bound_name = target.bound_name
+            server.peer = self
+            self.peer = server
+            server.adjust_status(host, S_WRITABLE, 0)
+            self.adjust_status(host, S_WRITABLE, 0)
+            target._pending.append(server)
+            target.adjust_status(host, S_READABLE, 0)
+        else:
+            # Dgram connect just fixes the default destination.
+            self.peer = target
+
+    def accept(self, host) -> "UnixSocket":
+        if not self.listening:
+            raise OSError(errno.EINVAL, "not listening")
+        if not self._pending:
+            raise BlockingIOError(errno.EWOULDBLOCK, "no pending")
+        child = self._pending.pop(0)
+        if not self._pending:
+            self.adjust_status(host, 0, S_READABLE)
+        return child
+
+    # -- data plane ----------------------------------------------------
+
+    def sendto(self, host, data: bytes, dest_name: str | None):
+        if self.stream:
+            peer = self.peer
+            if peer is None:
+                raise OSError(errno.ENOTCONN, "not connected")
+            if peer.has_status(S_CLOSED) or peer._eof:
+                raise OSError(errno.EPIPE, "peer closed")
+            room = BUF_MAX - len(peer._recv_buf)
+            if room <= 0:
+                self.adjust_status(host, 0, S_WRITABLE)
+                raise BlockingIOError(errno.EWOULDBLOCK, "buffer full")
+            take = data[:room]
+            peer._recv_buf += take
+            peer.adjust_status(host, S_READABLE, 0)
+            if len(peer._recv_buf) >= BUF_MAX:
+                self.adjust_status(host, 0, S_WRITABLE)
+            return len(take)
+        # dgram
+        if dest_name is not None:
+            target = host.unix_ns.get(dest_name)
+            if target is None:
+                raise OSError(errno.ENOENT, dest_name)
+        else:
+            target = self.peer
+            if target is None:
+                raise OSError(errno.ENOTCONN, "no destination")
+        if target.has_status(S_CLOSED):
+            raise OSError(errno.ECONNREFUSED, "peer closed")
+        queued = sum(len(d) for d, _s in target._dgrams)
+        if queued + len(data) > BUF_MAX:
+            # Park on our own WRITABLE bit; the receiver wakes us when
+            # it drains (without this the permanently-set bit would
+            # re-fire the blocked syscall forever at the same instant).
+            self.adjust_status(host, 0, S_WRITABLE)
+            if self not in target._dgram_waiters:
+                target._dgram_waiters.append(self)
+            raise BlockingIOError(errno.EWOULDBLOCK, "receiver full")
+        target._dgrams.append((bytes(data), self.bound_name))
+        target.adjust_status(host, S_READABLE, 0)
+        return len(data)
+
+    def recvfrom(self, host, bufsize: int, peek: bool = False):
+        if self.stream:
+            if not self._recv_buf:
+                if self._eof or (self.peer is not None
+                                 and self.peer.has_status(S_CLOSED)):
+                    return b"", None
+                raise BlockingIOError(errno.EWOULDBLOCK, "empty")
+            if peek:
+                return bytes(self._recv_buf[:bufsize]), None
+            out = bytes(self._recv_buf[:bufsize])
+            del self._recv_buf[:bufsize]
+            if not self._recv_buf and not self._eof:
+                self.adjust_status(host, 0, S_READABLE)
+            peer = self.peer
+            if peer is not None and not peer.has_status(S_CLOSED):
+                peer.adjust_status(host, S_WRITABLE, 0)
+            return out, None
+        if not self._dgrams:
+            raise BlockingIOError(errno.EWOULDBLOCK, "empty")
+        if peek:
+            data, src = self._dgrams[0]
+            return data[:bufsize], src
+        data, src = self._dgrams.pop(0)
+        if not self._dgrams:
+            self.adjust_status(host, 0, S_READABLE)
+        if self._dgram_waiters:
+            waiters, self._dgram_waiters = self._dgram_waiters, []
+            for w in waiters:
+                if not w.has_status(S_CLOSED):
+                    w.adjust_status(host, S_WRITABLE, 0)
+        return data[:bufsize], src
+
+    def shutdown(self, host, how: str = "wr") -> None:
+        peer = self.peer
+        if how in ("wr", "rdwr") and peer is not None:
+            peer._eof = True
+            peer.adjust_status(host, S_READABLE, 0)
+        if how in ("rd", "rdwr"):
+            self._eof = True
+
+    def close(self, host) -> None:
+        if self.bound_name is not None and \
+                host.unix_ns.get(self.bound_name) is self:
+            del host.unix_ns[self.bound_name]
+        peer = self.peer
+        self.adjust_status(host, S_CLOSED,
+                           S_ACTIVE | S_READABLE | S_WRITABLE |
+                           S_SOCKET_ALLOWING_CONNECT)
+        if peer is not None and self.stream:
+            peer._eof = True
+            # EOF is readable; writers notice EPIPE via the wake.
+            peer.adjust_status(host, S_READABLE | S_WRITABLE, 0)
+        for child in self._pending:
+            child._eof = True
+            child.adjust_status(host, S_READABLE, 0)
+        self._pending.clear()
+
+
+def unix_socketpair(host, stream: bool):
+    """socketpair(AF_UNIX): two mutually-connected unnamed endpoints."""
+    a = UnixSocket(host, stream)
+    b = UnixSocket(host, stream)
+    a.peer = b
+    b.peer = a
+    a._status |= S_WRITABLE
+    b._status |= S_WRITABLE
+    return a, b

@@ -31,7 +31,9 @@ from shadow_tpu.host.condition import MultiSyscallCondition, SyscallCondition
 from shadow_tpu.host.epoll import (EPOLL_CTL_ADD, EPOLL_CTL_DEL,
                                    EPOLL_CTL_MOD, EpollFile)
 from shadow_tpu.host.files import EventFd, PipeEnd, TimerFd, make_pipe
+from shadow_tpu.host.socket_netlink import NetlinkSocket
 from shadow_tpu.host.socket_udp import UdpSocket
+from shadow_tpu.host.socket_unix import UnixSocket, unix_socketpair
 from shadow_tpu.host.status import (S_CLOSED, S_ERROR, S_READABLE,
                                     S_WRITABLE)
 
@@ -74,9 +76,12 @@ def syscall_name(num: int) -> str:
 
 
 # --- constants -------------------------------------------------------
+AF_UNIX = 1
 AF_INET = 2
+AF_NETLINK = 16
 SOCK_STREAM = 1
 SOCK_DGRAM = 2
+SOCK_SEQPACKET = 5
 SOCK_NONBLOCK = 0o4000
 SOCK_CLOEXEC = 0o2000000
 
@@ -152,6 +157,40 @@ def _pack_sockaddr_in(ip: int, port: int) -> bytes:
         int(ip).to_bytes(4, "big") + b"\0" * 8
 
 
+def _unix_name(raw: bytes) -> str:
+    """sockaddr_un -> namespace key ('@...' = abstract, '' = unnamed);
+    `raw` is already trimmed to addrlen, which delimits abstract names."""
+    path = raw[2:]
+    if not path:
+        return ""
+    if path[0] == 0:
+        return "@" + path[1:].rstrip(b"\0").decode(errors="surrogateescape")
+    return path.split(b"\0", 1)[0].decode(errors="surrogateescape")
+
+
+def _pack_sockaddr_un(name) -> bytes:
+    if not name:
+        return struct.pack("<H", AF_UNIX)
+    if name.startswith("@"):
+        return struct.pack("<H", AF_UNIX) + b"\0" + \
+            name[1:].encode(errors="surrogateescape")
+    return struct.pack("<H", AF_UNIX) + \
+        name.encode(errors="surrogateescape") + b"\0"
+
+
+def _pack_peer_addr(peer):
+    """Family-aware source-address rendering for recvfrom/recvmsg."""
+    if peer is None:
+        return None
+    if isinstance(peer, str):
+        return _pack_sockaddr_un(peer)
+    if isinstance(peer, tuple) and peer and peer[0] == "netlink":
+        return struct.pack("<HHII", AF_NETLINK, 0, 0, 0)
+    if isinstance(peer, tuple) and len(peer) == 2:
+        return _pack_sockaddr_in(*peer)
+    return None
+
+
 def _unpack_sockaddr_in(raw: bytes):
     if len(raw) < 8:
         raise OSError(errno.EINVAL, "short sockaddr")
@@ -207,10 +246,22 @@ class NativeSyscallHandler:
                    protocol, *_):
         domain &= 0xffffffff
         base_type = type_ & 0xff
+        cloexec = bool(type_ & SOCK_CLOEXEC)
+        if domain == AF_UNIX and base_type in (SOCK_STREAM, SOCK_DGRAM,
+                                               SOCK_SEQPACKET):
+            # Emulated (socket/unix.rs parity): a native blocking unix
+            # read would park the OS thread in the kernel and stall the
+            # event pump on wall-clock time.
+            sock = UnixSocket(host, stream=base_type != SOCK_DGRAM)
+            sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
+            return _done(self._register(process, sock, cloexec=cloexec))
+        if domain == AF_NETLINK:
+            if protocol != 0:  # only NETLINK_ROUTE is modeled
+                return _error(errno.EPROTONOSUPPORT)
+            sock = NetlinkSocket(host)
+            sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
+            return _done(self._register(process, sock, cloexec=cloexec))
         if domain != AF_INET or base_type not in (SOCK_STREAM, SOCK_DGRAM):
-            # Unix/netlink/etc. stay native: they never cross the
-            # simulated network.  (The reference emulates these too —
-            # socket/{unix,netlink}.rs — future work.)
             return _native()
         if base_type == SOCK_DGRAM:
             sock = UdpSocket(host, self.send_buf, self.recv_buf)
@@ -227,6 +278,14 @@ class NativeSyscallHandler:
             return _native()
         sock = self._emu(process, fd)
         raw = process.mem.read(addr_ptr, min(addrlen, 128))
+        if isinstance(sock, UnixSocket):
+            sock.bind(host, _unix_name(raw))
+            return _done(0)
+        if isinstance(sock, NetlinkSocket):
+            nl_pid = struct.unpack_from("<I", raw, 4)[0] \
+                if len(raw) >= 8 else 0
+            sock.bind(host, nl_pid)
+            return _done(0)
         ip, port = _unpack_sockaddr_in(raw)
         sock.bind(host, ip, port)
         return _done(0)
@@ -237,6 +296,11 @@ class NativeSyscallHandler:
             return _native()
         sock = self._emu(process, fd)
         raw = process.mem.read(addr_ptr, min(addrlen, 128))
+        if isinstance(sock, UnixSocket):
+            sock.connect(host, _unix_name(raw))  # host-local: immediate
+            return _done(0)
+        if isinstance(sock, NetlinkSocket):
+            return _done(0)
         ip, port = _unpack_sockaddr_in(raw)
         # connect() is restart-safe: re-entry with the same args returns
         # 0 once established / raises the handshake error.
@@ -262,6 +326,19 @@ class NativeSyscallHandler:
         child.nonblocking = bool(flags & SOCK_NONBLOCK)
         newfd = self._register(process, child,
                                cloexec=bool(flags & SOCK_CLOEXEC))
+        if isinstance(child, UnixSocket):
+            if addr_ptr:
+                peer_name = child.peer.bound_name if child.peer else None
+                sa = _pack_sockaddr_un(peer_name or "")
+                if len_ptr:
+                    want = struct.unpack(
+                        "<I", process.mem.read(len_ptr, 4))[0]
+                    process.mem.write(addr_ptr, sa[:want])
+                    process.mem.write(len_ptr,
+                                      struct.pack("<I", len(sa)))
+                else:
+                    process.mem.write(addr_ptr, sa)
+            return _done(newfd)
         if addr_ptr and child.peer is not None:
             sa = _pack_sockaddr_in(*child.peer)
             if len_ptr:
@@ -287,15 +364,19 @@ class NativeSyscallHandler:
                                    flags)
 
     def _sock_send(self, host, process, sock, data: bytes, dst, flags: int):
-        # Port-53 interception must also catch the connect()+send()
-        # shape libc's resolver uses (dst comes from the socket peer).
-        effective_dst = dst if dst is not None else getattr(sock, "peer",
-                                                            None)
-        if effective_dst is not None and effective_dst[1] == 53 and \
-                isinstance(sock, UdpSocket):
-            handled = self._try_answer_dns(host, sock, data, effective_dst)
-            if handled is not None:
-                return handled
+        """Uniform send: inet (dst = (ip, port)), unix (dst = name str),
+        netlink (dst ignored)."""
+        if isinstance(sock, UdpSocket):
+            # Port-53 interception must also catch the connect()+send()
+            # shape libc's resolver uses (dst comes from the socket
+            # peer).
+            effective_dst = dst if dst is not None \
+                else getattr(sock, "peer", None)
+            if effective_dst is not None and effective_dst[1] == 53:
+                handled = self._try_answer_dns(host, sock, data,
+                                               effective_dst)
+                if handled is not None:
+                    return handled
         try:
             n = sock.sendto(host, data, dst)
         except BlockingIOError:
@@ -310,6 +391,13 @@ class NativeSyscallHandler:
             return _native()
         sock = self._emu(process, fd)
         data = process.mem.read(buf_ptr, min(length, _MAX_IO))
+        if isinstance(sock, (UnixSocket, NetlinkSocket)):
+            dest = None
+            if addr_ptr and addrlen and isinstance(sock, UnixSocket):
+                dest = _unix_name(
+                    process.mem.read(addr_ptr, min(addrlen, 128)))
+            return self._sock_send(host, process, sock, data, dest,
+                                   flags)
         dst = None
         if addr_ptr and addrlen:
             dst = _unpack_sockaddr_in(
@@ -329,11 +417,13 @@ class NativeSyscallHandler:
                 return _error(errno.EWOULDBLOCK)
             return _block(SyscallCondition(file=sock, mask=S_READABLE))
         process.mem.write(buf_ptr, data)
-        if addr_ptr and peer is not None:
-            sa = _pack_sockaddr_in(*peer)
-            process.mem.write(addr_ptr, sa)
-            if len_ptr:
-                process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+        if addr_ptr:
+            sa = _pack_peer_addr(peer)
+            if sa is not None:
+                process.mem.write(addr_ptr, sa)
+                if len_ptr:
+                    process.mem.write(len_ptr,
+                                      struct.pack("<I", len(sa)))
         return _done(len(data))
 
     @staticmethod
@@ -374,6 +464,19 @@ class NativeSyscallHandler:
         name_ptr, namelen, iov_ptr, iovlen = self._read_msghdr(process,
                                                                msg_ptr)
         data = self._gather_iov(process, iov_ptr, iovlen)
+        if isinstance(sock, (UnixSocket, NetlinkSocket)):
+            (controllen,) = struct.unpack(
+                "<Q", process.mem.read(msg_ptr + 40, 8))
+            if controllen and isinstance(sock, UnixSocket):
+                # SCM_RIGHTS fd passing is not modeled; failing loudly
+                # beats silently dropping the fds.
+                return _error(errno.EINVAL)
+            dest = None
+            if name_ptr and namelen and isinstance(sock, UnixSocket):
+                dest = _unix_name(
+                    process.mem.read(name_ptr, min(namelen, 128)))
+            return self._sock_send(host, process, sock, data, dest,
+                                   flags)
         dst = None
         if name_ptr and namelen:
             dst = _unpack_sockaddr_in(
@@ -446,11 +549,12 @@ class NativeSyscallHandler:
                                                mask=S_READABLE,
                                                timeout_at=timeout_at))
             self._scatter_iov(process, iov_ptr, iovlen, data)
-            if name_ptr and peer is not None:
-                sa = _pack_sockaddr_in(*peer)
-                process.mem.write(name_ptr, sa)
-                process.mem.write(msg_ptr + 8,
-                                  struct.pack("<I", len(sa)))
+            if name_ptr:
+                sa = _pack_peer_addr(peer)
+                if sa is not None:
+                    process.mem.write(name_ptr, sa)
+                    process.mem.write(msg_ptr + 8,
+                                      struct.pack("<I", len(sa)))
             process.mem.write(msg_ptr + 56,
                               struct.pack("<I", len(data)))
             got += 1
@@ -472,10 +576,12 @@ class NativeSyscallHandler:
                 return _error(errno.EWOULDBLOCK)
             return _block(SyscallCondition(file=sock, mask=S_READABLE))
         self._scatter_iov(process, iov_ptr, iovlen, data)
-        if name_ptr and peer is not None:
-            sa = _pack_sockaddr_in(*peer)
-            process.mem.write(name_ptr, sa)
-            process.mem.write(msg_ptr + 8, struct.pack("<I", len(sa)))
+        if name_ptr:
+            sa = _pack_peer_addr(peer)
+            if sa is not None:
+                process.mem.write(name_ptr, sa)
+                process.mem.write(msg_ptr + 8,
+                                  struct.pack("<I", len(sa)))
         return _done(len(data))
 
     @staticmethod
@@ -514,11 +620,16 @@ class NativeSyscallHandler:
         if not self._is_emu(fd):
             return _native()
         sock = self._emu(process, fd)
-        local = sock.local or (0, 0)
-        ip = local[0]
-        if ip == 0 and getattr(sock, "peer", None):
-            ip = host.eth0.ip
-        sa = _pack_sockaddr_in(ip, local[1])
+        if isinstance(sock, UnixSocket):
+            sa = _pack_sockaddr_un(sock.bound_name or "")
+        elif isinstance(sock, NetlinkSocket):
+            sa = struct.pack("<HHII", AF_NETLINK, 0, sock.nl_pid, 0)
+        else:
+            local = sock.local or (0, 0)
+            ip = local[0]
+            if ip == 0 and getattr(sock, "peer", None):
+                ip = host.eth0.ip
+            sa = _pack_sockaddr_in(ip, local[1])
         process.mem.write(addr_ptr, sa)
         if len_ptr:
             process.mem.write(len_ptr, struct.pack("<I", len(sa)))
@@ -529,9 +640,18 @@ class NativeSyscallHandler:
         if not self._is_emu(fd):
             return _native()
         sock = self._emu(process, fd)
-        if sock.peer is None:
+        if isinstance(sock, NetlinkSocket):
+            sa = struct.pack("<HHII", AF_NETLINK, 0, 0, 0)  # the kernel
+            process.mem.write(addr_ptr, sa)
+            if len_ptr:
+                process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+            return _done(0)
+        if getattr(sock, "peer", None) is None:
             return _error(errno.ENOTCONN)
-        sa = _pack_sockaddr_in(*sock.peer)
+        if isinstance(sock, UnixSocket):
+            sa = _pack_sockaddr_un(sock.peer.bound_name or "")
+        else:
+            sa = _pack_sockaddr_in(*sock.peer)
         process.mem.write(addr_ptr, sa)
         if len_ptr:
             process.mem.write(len_ptr, struct.pack("<I", len(sa)))
@@ -572,11 +692,21 @@ class NativeSyscallHandler:
             elif optname == SO_RCVBUF:
                 value = self.recv_buf
             elif optname == SO_TYPE:
-                from shadow_tpu.net.packet import PROTO_TCP
-                value = (SOCK_STREAM if sock.protocol == PROTO_TCP
-                         else SOCK_DGRAM)
+                if isinstance(sock, UnixSocket):
+                    value = (SOCK_STREAM if sock.stream else SOCK_DGRAM)
+                elif isinstance(sock, NetlinkSocket):
+                    value = SOCK_DGRAM
+                else:
+                    from shadow_tpu.net.packet import PROTO_TCP
+                    value = (SOCK_STREAM if sock.protocol == PROTO_TCP
+                             else SOCK_DGRAM)
             elif optname == SO_DOMAIN:
-                value = AF_INET
+                if isinstance(sock, UnixSocket):
+                    value = AF_UNIX
+                elif isinstance(sock, NetlinkSocket):
+                    value = AF_NETLINK
+                else:
+                    value = AF_INET
             elif optname == SO_ACCEPTCONN:
                 value = 1 if getattr(sock, "listening", False) else 0
         process.mem.write(optval_ptr, struct.pack("<i", value))
@@ -597,7 +727,17 @@ class NativeSyscallHandler:
 
     def sys_socketpair(self, host, process, thread, restarted, domain,
                        type_, protocol, sv_ptr, *_):
-        return _native()  # AF_UNIX pairs stay native
+        base_type = type_ & 0xff
+        if domain != AF_UNIX or base_type not in (SOCK_STREAM, SOCK_DGRAM,
+                                                  SOCK_SEQPACKET):
+            return _error(errno.EOPNOTSUPP)
+        a, b = unix_socketpair(host, stream=base_type != SOCK_DGRAM)
+        a.nonblocking = b.nonblocking = bool(type_ & SOCK_NONBLOCK)
+        cx = bool(type_ & SOCK_CLOEXEC)
+        fd1 = self._register(process, a, cloexec=cx)
+        fd2 = self._register(process, b, cloexec=cx)
+        process.mem.write(sv_ptr, struct.pack("<ii", fd1, fd2))
+        return _done(0)
 
     # ------------------------------------------------------------------
     # Generic fd I/O

@@ -1,0 +1,37 @@
+/* getifaddrs() runs on rtnetlink RTM_GETLINK/RTM_GETADDR dumps — the
+ * emulated NETLINK_ROUTE socket answers them from the simulated
+ * interface table. */
+#include <arpa/inet.h>
+#include <ifaddrs.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+
+int main(void) {
+    struct ifaddrs *ifa0;
+    if (getifaddrs(&ifa0) != 0) {
+        puts("FAIL getifaddrs");
+        return 1;
+    }
+    int saw_lo = 0, saw_eth = 0;
+    for (struct ifaddrs *ifa = ifa0; ifa; ifa = ifa->ifa_next) {
+        if (!ifa->ifa_addr || ifa->ifa_addr->sa_family != AF_INET)
+            continue;
+        char addr[64];
+        inet_ntop(AF_INET,
+                  &((struct sockaddr_in *)ifa->ifa_addr)->sin_addr,
+                  addr, sizeof addr);
+        printf("%s %s\n", ifa->ifa_name, addr);
+        if (!strcmp(ifa->ifa_name, "lo") && !strcmp(addr, "127.0.0.1"))
+            saw_lo = 1;
+        if (!strcmp(ifa->ifa_name, "eth0"))
+            saw_eth = 1;
+    }
+    freeifaddrs(ifa0);
+    if (!saw_lo || !saw_eth) {
+        puts("FAIL missing interfaces");
+        return 2;
+    }
+    puts("ifaddrs_ok");
+    return 0;
+}

@@ -1,0 +1,101 @@
+/* Emulated AF_UNIX sockets: socketpair, abstract-namespace stream
+ * server/client across fork, and dgram sendto/recvfrom by name. */
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static int abstract_addr(struct sockaddr_un *sa, const char *name,
+                         socklen_t *len) {
+    memset(sa, 0, sizeof(*sa));
+    sa->sun_family = AF_UNIX;
+    sa->sun_path[0] = '\0';
+    strcpy(sa->sun_path + 1, name);
+    *len = (socklen_t)(sizeof(sa_family_t) + 1 + strlen(name));
+    return 0;
+}
+
+int main(void) {
+    /* 1: socketpair */
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        puts("FAIL socketpair");
+        return 1;
+    }
+    if (write(sv[0], "ping", 4) != 4) { puts("FAIL sp-write"); return 2; }
+    char buf[64];
+    if (read(sv[1], buf, sizeof buf) != 4 || memcmp(buf, "ping", 4)) {
+        puts("FAIL sp-read");
+        return 3;
+    }
+    close(sv[0]);
+    if (read(sv[1], buf, sizeof buf) != 0) {  /* EOF after peer close */
+        puts("FAIL sp-eof");
+        return 4;
+    }
+    close(sv[1]);
+    puts("socketpair_ok");
+
+    /* 2: abstract-namespace stream across fork */
+    int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+    struct sockaddr_un sa;
+    socklen_t slen;
+    abstract_addr(&sa, "shadowtpu-test", &slen);
+    if (bind(srv, (struct sockaddr *)&sa, slen) != 0 ||
+        listen(srv, 4) != 0) {
+        puts("FAIL bind/listen");
+        return 5;
+    }
+    pid_t pid = fork();
+    if (pid == 0) {
+        int cli = socket(AF_UNIX, SOCK_STREAM, 0);
+        if (connect(cli, (struct sockaddr *)&sa, slen) != 0)
+            _exit(10);
+        if (write(cli, "hello", 5) != 5)
+            _exit(11);
+        char rb[16];
+        ssize_t n = read(cli, rb, sizeof rb);
+        if (n != 5 || memcmp(rb, "HELLO", 5))
+            _exit(12);
+        close(cli);
+        _exit(0);
+    }
+    int conn = accept(srv, 0, 0);
+    if (conn < 0) { puts("FAIL accept"); return 6; }
+    ssize_t n = read(conn, buf, sizeof buf);
+    if (n != 5 || memcmp(buf, "hello", 5)) { puts("FAIL srv-read"); return 7; }
+    if (write(conn, "HELLO", 5) != 5) { puts("FAIL srv-write"); return 8; }
+    int status;
+    waitpid(pid, &status, 0);
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) {
+        printf("FAIL child status=%x\n", status);
+        return 9;
+    }
+    close(conn);
+    close(srv);
+    puts("stream_ok");
+
+    /* 3: dgram by abstract name */
+    int d1 = socket(AF_UNIX, SOCK_DGRAM, 0);
+    int d2 = socket(AF_UNIX, SOCK_DGRAM, 0);
+    struct sockaddr_un da;
+    socklen_t dlen;
+    abstract_addr(&da, "shadowtpu-dgram", &dlen);
+    if (bind(d2, (struct sockaddr *)&da, dlen) != 0) {
+        puts("FAIL dgram-bind");
+        return 10;
+    }
+    if (sendto(d1, "dg", 2, 0, (struct sockaddr *)&da, dlen) != 2) {
+        puts("FAIL dgram-send");
+        return 11;
+    }
+    struct sockaddr_un src;
+    socklen_t srclen = sizeof src;
+    n = recvfrom(d2, buf, sizeof buf, 0, (struct sockaddr *)&src,
+                 &srclen);
+    if (n != 2 || memcmp(buf, "dg", 2)) { puts("FAIL dgram-recv"); return 12; }
+    puts("dgram_ok");
+    return 0;
+}
