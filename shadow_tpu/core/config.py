@@ -81,8 +81,12 @@ class ExperimentalConfig:
     socket_send_buffer: int = 131_072
     socket_recv_buffer: int = 174_760
     strace_logging_mode: str = "off"  # off | standard | deterministic
-    max_unapplied_cpu_latency_ns: int = units.parse_time_ns("1 us")
+    max_unapplied_cpu_latency_ns: int = units.parse_time_ns("20 us")
     unblocked_syscall_latency_ns: int = units.parse_time_ns("1 us")
+    # Host CPU model (ref cpu.rs; off by default like sim_config.rs:246)
+    host_cpu_threshold_ns: int | None = None
+    host_cpu_precision_ns: int | None = None
+    host_cpu_event_cost_ns: int = 0  # modeled CPU ns charged per event
     unblocked_vdso_latency_ns: int = units.parse_time_ns("10 ns")
     tpu_max_packets_per_round: int = 1 << 20
     # Below this, propagation always runs the numpy host path; above,
@@ -170,6 +174,12 @@ class ConfigOptions:
                 ("unblocked_syscall_latency", "unblocked_syscall_latency_ns",
                  units.parse_time_ns),
                 ("unblocked_vdso_latency", "unblocked_vdso_latency_ns",
+                 units.parse_time_ns),
+                ("host_cpu_threshold", "host_cpu_threshold_ns",
+                 units.parse_time_ns),
+                ("host_cpu_precision", "host_cpu_precision_ns",
+                 units.parse_time_ns),
+                ("host_cpu_event_cost", "host_cpu_event_cost_ns",
                  units.parse_time_ns),
                 ("tpu_max_packets_per_round", "tpu_max_packets_per_round", int),
                 ("tpu_min_device_batch", "tpu_min_device_batch", int),

@@ -108,6 +108,17 @@ class Manager:
                                  "(host or graph node must provide it)")
             host = Host(host_id, name, ip, node.index, seed, bw_down, bw_up,
                         qdisc=config.experimental.interface_qdisc)
+            if config.experimental.host_cpu_threshold_ns is not None:
+                from shadow_tpu.host.cpu import Cpu
+                host.cpu = Cpu(
+                    threshold=config.experimental.host_cpu_threshold_ns,
+                    precision=config.experimental.host_cpu_precision_ns)
+                host.cpu_event_cost_ns = \
+                    config.experimental.host_cpu_event_cost_ns
+            host.syscall_latency_ns = \
+                config.experimental.unblocked_syscall_latency_ns
+            host.max_unapplied_ns = \
+                config.experimental.max_unapplied_cpu_latency_ns
             host.dns = self.dns
             host.syscall_handler = self.syscall_handler
             host.syscall_handler_native = self.syscall_handler_native

@@ -57,6 +57,14 @@ class Host:
         # AF_UNIX name table: fs paths + '@'-prefixed abstract namespace
         # (ref: abstract_unix_ns.rs; paths never touch the real fs).
         self.unix_ns: dict[str, object] = {}
+        # Host CPU model (cpu.rs): None unless host_cpu_threshold is
+        # configured, so the hot loop pays nothing by default.
+        self.cpu = None
+        self.cpu_event_cost_ns = 0
+        # Unblocked-syscall latency model knobs (configuration.rs:464-480
+        # analogs; overridden by the manager from experimental config).
+        self.syscall_latency_ns = 1_000
+        self.max_unapplied_ns = 20_000
 
         # Network plane (host.rs:209-344 construction order).
         self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)
@@ -135,17 +143,33 @@ class Host:
     def execute(self, until: int) -> None:
         self.drain_inbox()
         q = self.queue
+        cpu = self.cpu
         while True:
             t = q.peek_time()
             if t is None or t >= until:
                 break
             ev = q.pop()
+            if cpu is not None:
+                # CPU-model push-back (cpu.rs + host.rs:760-777): while
+                # the modeled CPU is saturated, events slip forward.
+                cpu.update_time(ev.time)
+                d = cpu.delay()
+                if d > 0:
+                    ev.time += d
+                    q.push(ev)
+                    continue
             self._now = ev.time
             self.counters["events"] += 1
             if ev.kind == KIND_PACKET:
                 self.router.route_incoming_packet(self, ev.data)
             else:
                 ev.data.execute(self)
+            if cpu is not None and self.cpu_event_cost_ns:
+                # Deterministic event-cost feed: a flooded host's CPU
+                # saturates and later events slip (the reference feeds
+                # native wall time here — nondeterministic, perf_timers
+                # gated; a fixed modeled cost keeps runs bit-identical).
+                cpu.add_delay(self.cpu_event_cost_ns)
 
     def next_event_time(self):
         return self.queue.peek_time()

@@ -40,12 +40,11 @@ from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
                                       EV_SYSCALL_DO_NATIVE)
 from shadow_tpu.host.syscalls_native import syscall_name
 
-# CPU-latency model (ref defaults: configuration.rs:464-480 — 1-2us per
-# unblocked syscall, applied in batches).  Applying == parking the
-# thread and resuming via the event queue, which serializes every
-# managed syscall into the deterministic event timeline.
-SYSCALL_LATENCY_NS = 1_000
-MAX_UNAPPLIED_NS = 20_000
+# The unblocked-syscall CPU-latency model (ref configuration.rs:464-480
+# — ~1us per syscall, applied in batches by parking the thread, which
+# serializes managed syscalls into the deterministic event timeline)
+# reads its values from Host.syscall_latency_ns / Host.max_unapplied_ns,
+# set from experimental config.
 
 _DEATH_POLL_NS = 100_000_000  # 100ms channel-wait slices between waitpid polls
 
@@ -691,8 +690,11 @@ class ManagedThread:
             if r == "dead":
                 return False
 
-        self.add_cpu_latency(SYSCALL_LATENCY_NS)
-        if self._unapplied_ns >= MAX_UNAPPLIED_NS:
+        lat = host.syscall_latency_ns
+        self.add_cpu_latency(lat)
+        if host.cpu is not None:
+            host.cpu.add_delay(lat)  # feeds the host CPU model (cpu.rs)
+        if self._unapplied_ns >= host.max_unapplied_ns:
             # Apply accumulated CPU time: answer only after the event
             # queue reaches now + latency (possibly next round).
             self._pending_response = (rv_kind, rv_val)

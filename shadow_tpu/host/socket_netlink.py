@@ -150,6 +150,9 @@ class NetlinkSocket(StatusOwner):
                 self.adjust_status(host, 0, S_READABLE)
         return bytes(out), ("netlink", 0)
 
+    def bytes_available(self) -> int:
+        return len(self._recv_q[0]) if self._recv_q else 0
+
     def close(self, host) -> None:
         self.adjust_status(host, S_CLOSED,
                            S_ACTIVE | S_READABLE | S_WRITABLE)

@@ -85,7 +85,12 @@ class UnixSocket(StatusOwner):
             if not target.listening:
                 raise OSError(errno.ECONNREFUSED, name)
             if len(target._pending) >= target._backlog:
-                raise OSError(errno.EAGAIN, "backlog full")
+                # Blocking connect waits for accept-queue room; the
+                # caller parks on the LISTENER's allowing-connect bit.
+                target.adjust_status(host, 0, S_SOCKET_ALLOWING_CONNECT)
+                err = BlockingIOError(errno.EAGAIN, "backlog full")
+                err.listener = target
+                raise err
             server = UnixSocket(host, stream=True)
             server.bound_name = target.bound_name
             server.peer = self
@@ -106,6 +111,8 @@ class UnixSocket(StatusOwner):
         child = self._pending.pop(0)
         if not self._pending:
             self.adjust_status(host, 0, S_READABLE)
+        # Queue room again: wake blocked connect()ers.
+        self.adjust_status(host, S_SOCKET_ALLOWING_CONNECT, 0)
         return child
 
     # -- data plane ----------------------------------------------------
@@ -147,7 +154,7 @@ class UnixSocket(StatusOwner):
             if self not in target._dgram_waiters:
                 target._dgram_waiters.append(self)
             raise BlockingIOError(errno.EWOULDBLOCK, "receiver full")
-        target._dgrams.append((bytes(data), self.bound_name))
+        target._dgrams.append((bytes(data), self.bound_name or ""))
         target.adjust_status(host, S_READABLE, 0)
         return len(data)
 
@@ -183,6 +190,11 @@ class UnixSocket(StatusOwner):
                     w.adjust_status(host, S_WRITABLE, 0)
         return data[:bufsize], src
 
+    def bytes_available(self) -> int:
+        if self.stream:
+            return len(self._recv_buf)
+        return len(self._dgrams[0][0]) if self._dgrams else 0
+
     def shutdown(self, host, how: str = "wr") -> None:
         peer = self.peer
         if how in ("wr", "rdwr") and peer is not None:
@@ -204,9 +216,20 @@ class UnixSocket(StatusOwner):
             # EOF is readable; writers notice EPIPE via the wake.
             peer.adjust_status(host, S_READABLE | S_WRITABLE, 0)
         for child in self._pending:
+            # Never-accepted connections: tear down BOTH ends so the
+            # client sees EOF/EPIPE instead of blocking forever.
             child._eof = True
-            child.adjust_status(host, S_READABLE, 0)
+            child.adjust_status(host, S_CLOSED, S_ACTIVE)
+            client = child.peer
+            if client is not None:
+                client._eof = True
+                client.adjust_status(host, S_READABLE | S_WRITABLE, 0)
         self._pending.clear()
+        if self._dgram_waiters:
+            # Parked senders retry and get ENOENT/ECONNREFUSED.
+            waiters, self._dgram_waiters = self._dgram_waiters, []
+            for w in waiters:
+                w.adjust_status(host, S_WRITABLE, 0)
 
 
 def unix_socketpair(host, stream: bool):

@@ -35,7 +35,7 @@ from shadow_tpu.host.socket_netlink import NetlinkSocket
 from shadow_tpu.host.socket_udp import UdpSocket
 from shadow_tpu.host.socket_unix import UnixSocket, unix_socketpair
 from shadow_tpu.host.status import (S_CLOSED, S_ERROR, S_READABLE,
-                                    S_WRITABLE)
+                                    S_SOCKET_ALLOWING_CONNECT, S_WRITABLE)
 
 EMU_FD_BASE = 400  # leaves room for select() fd_sets (FD_SETSIZE=1024)
 
@@ -178,6 +178,20 @@ def _pack_sockaddr_un(name) -> bytes:
         name.encode(errors="surrogateescape") + b"\0"
 
 
+def _write_addr(process, addr_ptr, len_ptr, sa) -> None:
+    """Write a sockaddr clamped to the caller's buffer length (the
+    kernel truncates; sockaddr_un is variable-length so an unclamped
+    write could clobber plugin memory past a short buffer)."""
+    if not addr_ptr or sa is None:
+        return
+    if len_ptr:
+        want = struct.unpack("<I", process.mem.read(len_ptr, 4))[0]
+        process.mem.write(addr_ptr, sa[:want])
+        process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+    else:
+        process.mem.write(addr_ptr, sa)
+
+
 def _pack_peer_addr(peer):
     """Family-aware source-address rendering for recvfrom/recvmsg."""
     if peer is None:
@@ -247,11 +261,11 @@ class NativeSyscallHandler:
         domain &= 0xffffffff
         base_type = type_ & 0xff
         cloexec = bool(type_ & SOCK_CLOEXEC)
-        if domain == AF_UNIX and base_type in (SOCK_STREAM, SOCK_DGRAM,
-                                               SOCK_SEQPACKET):
+        if domain == AF_UNIX and base_type in (SOCK_STREAM, SOCK_DGRAM):
             # Emulated (socket/unix.rs parity): a native blocking unix
             # read would park the OS thread in the kernel and stall the
-            # event pump on wall-clock time.
+            # event pump on wall-clock time.  SEQPACKET is refused (a
+            # stream emulation would silently lose record boundaries).
             sock = UnixSocket(host, stream=base_type != SOCK_DGRAM)
             sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
             return _done(self._register(process, sock, cloexec=cloexec))
@@ -297,7 +311,14 @@ class NativeSyscallHandler:
         sock = self._emu(process, fd)
         raw = process.mem.read(addr_ptr, min(addrlen, 128))
         if isinstance(sock, UnixSocket):
-            sock.connect(host, _unix_name(raw))  # host-local: immediate
+            try:
+                sock.connect(host, _unix_name(raw))  # host-local
+            except BlockingIOError as e:
+                if sock.nonblocking:
+                    return _error(errno.EAGAIN)
+                # Blocking connect waits for accept-queue room.
+                return _block(SyscallCondition(
+                    file=e.listener, mask=S_SOCKET_ALLOWING_CONNECT))
             return _done(0)
         if isinstance(sock, NetlinkSocket):
             return _done(0)
@@ -327,17 +348,9 @@ class NativeSyscallHandler:
         newfd = self._register(process, child,
                                cloexec=bool(flags & SOCK_CLOEXEC))
         if isinstance(child, UnixSocket):
-            if addr_ptr:
-                peer_name = child.peer.bound_name if child.peer else None
-                sa = _pack_sockaddr_un(peer_name or "")
-                if len_ptr:
-                    want = struct.unpack(
-                        "<I", process.mem.read(len_ptr, 4))[0]
-                    process.mem.write(addr_ptr, sa[:want])
-                    process.mem.write(len_ptr,
-                                      struct.pack("<I", len(sa)))
-                else:
-                    process.mem.write(addr_ptr, sa)
+            peer_name = child.peer.bound_name if child.peer else None
+            _write_addr(process, addr_ptr, len_ptr,
+                        _pack_sockaddr_un(peer_name or ""))
             return _done(newfd)
         if addr_ptr and child.peer is not None:
             sa = _pack_sockaddr_in(*child.peer)
@@ -417,13 +430,7 @@ class NativeSyscallHandler:
                 return _error(errno.EWOULDBLOCK)
             return _block(SyscallCondition(file=sock, mask=S_READABLE))
         process.mem.write(buf_ptr, data)
-        if addr_ptr:
-            sa = _pack_peer_addr(peer)
-            if sa is not None:
-                process.mem.write(addr_ptr, sa)
-                if len_ptr:
-                    process.mem.write(len_ptr,
-                                      struct.pack("<I", len(sa)))
+        _write_addr(process, addr_ptr, len_ptr, _pack_peer_addr(peer))
         return _done(len(data))
 
     @staticmethod
@@ -552,7 +559,7 @@ class NativeSyscallHandler:
             if name_ptr:
                 sa = _pack_peer_addr(peer)
                 if sa is not None:
-                    process.mem.write(name_ptr, sa)
+                    process.mem.write(name_ptr, sa[:_namelen])
                     process.mem.write(msg_ptr + 8,
                                       struct.pack("<I", len(sa)))
             process.mem.write(msg_ptr + 56,
@@ -579,7 +586,7 @@ class NativeSyscallHandler:
         if name_ptr:
             sa = _pack_peer_addr(peer)
             if sa is not None:
-                process.mem.write(name_ptr, sa)
+                process.mem.write(name_ptr, sa[:_namelen])
                 process.mem.write(msg_ptr + 8,
                                   struct.pack("<I", len(sa)))
         return _done(len(data))
@@ -630,9 +637,7 @@ class NativeSyscallHandler:
             if ip == 0 and getattr(sock, "peer", None):
                 ip = host.eth0.ip
             sa = _pack_sockaddr_in(ip, local[1])
-        process.mem.write(addr_ptr, sa)
-        if len_ptr:
-            process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+        _write_addr(process, addr_ptr, len_ptr, sa)
         return _done(0)
 
     def sys_getpeername(self, host, process, thread, restarted, fd,
@@ -642,9 +647,7 @@ class NativeSyscallHandler:
         sock = self._emu(process, fd)
         if isinstance(sock, NetlinkSocket):
             sa = struct.pack("<HHII", AF_NETLINK, 0, 0, 0)  # the kernel
-            process.mem.write(addr_ptr, sa)
-            if len_ptr:
-                process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+            _write_addr(process, addr_ptr, len_ptr, sa)
             return _done(0)
         if getattr(sock, "peer", None) is None:
             return _error(errno.ENOTCONN)
@@ -652,9 +655,7 @@ class NativeSyscallHandler:
             sa = _pack_sockaddr_un(sock.peer.bound_name or "")
         else:
             sa = _pack_sockaddr_in(*sock.peer)
-        process.mem.write(addr_ptr, sa)
-        if len_ptr:
-            process.mem.write(len_ptr, struct.pack("<I", len(sa)))
+        _write_addr(process, addr_ptr, len_ptr, sa)
         return _done(0)
 
     def sys_setsockopt(self, host, process, thread, restarted, fd, level,
@@ -728,8 +729,7 @@ class NativeSyscallHandler:
     def sys_socketpair(self, host, process, thread, restarted, domain,
                        type_, protocol, sv_ptr, *_):
         base_type = type_ & 0xff
-        if domain != AF_UNIX or base_type not in (SOCK_STREAM, SOCK_DGRAM,
-                                                  SOCK_SEQPACKET):
+        if domain != AF_UNIX or base_type not in (SOCK_STREAM, SOCK_DGRAM):
             return _error(errno.EOPNOTSUPP)
         a, b = unix_socketpair(host, stream=base_type != SOCK_DGRAM)
         a.nonblocking = b.nonblocking = bool(type_ & SOCK_NONBLOCK)
@@ -1434,6 +1434,8 @@ class NativeSyscallHandler:
         # locally-answered time reads; bill the batch so time-polling
         # loops advance the clock (handler/mod.rs:271-321).
         thread.add_cpu_latency(25_000)
+        if host.cpu is not None:
+            host.cpu.add_delay(25_000)
         return _done(0)
 
     # ------------------------------------------------------------------
