@@ -502,10 +502,17 @@ static void sigsegv_handler(int sig, siginfo_t *info, void *ucontext) {
     }
     if (app == 1)
         return; /* SIG_IGN (questionable for a real fault, but explicit) */
-    struct sigaction dfl;
-    memset(&dfl, 0, sizeof(dfl));
-    dfl.sa_handler = SIG_DFL;
-    sigaction(SIGSEGV, &dfl, NULL);
+    /* Raw rt_sigaction through the trampoline: the libc wrapper would
+     * trap into the manager, which treats app SIGSEGV actions as
+     * emulated-only and never installs them natively — an infinite
+     * refault loop. */
+    struct {
+        void *handler;
+        unsigned long flags;
+        void *restorer;
+        unsigned long mask;
+    } ksa = {0};
+    raw(SYS_rt_sigaction, SIGSEGV, (long)&ksa, 0, 8, 0, 0);
 }
 
 static void install_rdtsc_trap(void) {

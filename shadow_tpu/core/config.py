@@ -110,12 +110,104 @@ class ExperimentalConfig:
     report_errors_to_stderr: bool = True
 
 
+def _ns(v: int | None):
+    return None if v is None else f"{int(v)} ns"
+
+
 @dataclass
 class ConfigOptions:
     general: GeneralConfig
     network: NetworkConfig
     experimental: ExperimentalConfig
     hosts: dict[str, HostConfig]
+
+    def to_processed_dict(self) -> dict:
+        """The fully-resolved options as a re-loadable YAML structure —
+        written into the data dir for reproducibility (ref:
+        manager.rs:183-194 re-serializes the processed config the same
+        way).  Every value is explicit, defaults included; time values
+        render as '<n> ns' so from_yaml_text() round-trips."""
+        g, e = self.general, self.experimental
+        out = {
+            "general": {
+                "stop_time": _ns(g.stop_time_ns),
+                "seed": g.seed,
+                "bootstrap_end_time": _ns(g.bootstrap_end_time_ns),
+                "parallelism": g.parallelism,
+                "data_directory": g.data_directory,
+                "template_directory": g.template_directory,
+                "progress": g.progress,
+                "heartbeat_interval": _ns(g.heartbeat_interval_ns),
+                "log_level": g.log_level,
+                "model_unblocked_syscall_latency":
+                    g.model_unblocked_syscall_latency,
+            },
+            "network": {
+                "graph": {"type": "gml",
+                          "inline": self.network.graph.gml_text},
+                "use_shortest_path": self.network.use_shortest_path,
+            },
+            "experimental": {
+                "scheduler": e.scheduler,
+                "runahead": _ns(e.runahead_ns),
+                "use_dynamic_runahead": e.use_dynamic_runahead,
+                "interface_qdisc": e.interface_qdisc,
+                "socket_send_buffer": e.socket_send_buffer,
+                "socket_recv_buffer": e.socket_recv_buffer,
+                "strace_logging_mode": e.strace_logging_mode,
+                "max_unapplied_cpu_latency":
+                    _ns(e.max_unapplied_cpu_latency_ns),
+                "unblocked_syscall_latency":
+                    _ns(e.unblocked_syscall_latency_ns),
+                "unblocked_vdso_latency": _ns(e.unblocked_vdso_latency_ns),
+                "host_cpu_threshold": _ns(e.host_cpu_threshold_ns),
+                "host_cpu_precision": _ns(e.host_cpu_precision_ns),
+                "host_cpu_event_cost": _ns(e.host_cpu_event_cost_ns),
+                "tpu_max_packets_per_round": e.tpu_max_packets_per_round,
+                "tpu_min_device_batch": e.tpu_min_device_batch,
+                "tpu_shards": e.tpu_shards,
+                "tpu_exchange_capacity": e.tpu_exchange_capacity,
+                "use_cpu_pinning": e.use_cpu_pinning,
+                "use_perf_timers": e.use_perf_timers,
+                "report_errors_to_stderr": e.report_errors_to_stderr,
+            },
+            "hosts": {},
+        }
+        for name in sorted(self.hosts):
+            h = self.hosts[name]
+            procs = []
+            for p in h.processes:
+                procs.append({
+                    "path": p.path,
+                    "args": list(p.args),
+                    "environment": dict(p.environment),
+                    "start_time": _ns(p.start_time_ns),
+                    "shutdown_time": _ns(p.shutdown_time_ns),
+                    "shutdown_signal": p.shutdown_signal,
+                    "expected_final_state": p.expected_final_state,
+                })
+            out["hosts"][name] = {
+                "network_node_id": h.network_node_id,
+                "ip_addr": (netgraph.format_ip(h.ip_addr)
+                            if h.ip_addr is not None else None),
+                "bandwidth_down": h.bandwidth_down_bits,
+                "bandwidth_up": h.bandwidth_up_bits,
+                "pcap_enabled": h.pcap_enabled,
+                "pcap_capture_size": h.pcap_capture_size,
+                "processes": procs,
+            }
+
+        def prune(x):
+            # Omit None values: absent and null are not equivalent to
+            # the loader (e.g. shutdown_time's presence check).
+            if isinstance(x, dict):
+                return {k: prune(v) for k, v in x.items()
+                        if v is not None}
+            if isinstance(x, list):
+                return [prune(v) for v in x]
+            return x
+
+        return prune(out)
 
     @classmethod
     def from_yaml_text(cls, text: str, base_dir: str = ".") -> "ConfigOptions":

@@ -346,12 +346,19 @@ class Manager:
         wall_start = time.perf_counter()
         status = None
         heartbeat_lines = progress
+        from shadow_tpu.utils.shadow_log import LOG
+        LOG.set_level(self.config.general.log_level)
+        status_throttle = 0.2
         if progress:
             from shadow_tpu.utils.status_bar import StatusBar, make_status
             status = make_status(stop)
             # A \r-redrawing bar and newline heartbeats garble each other
-            # on one TTY; the bar subsumes the heartbeat there.
+            # on one TTY; the bar subsumes the heartbeat there.  On a
+            # non-TTY every update is a permanent log line, so throttle
+            # far harder (the heartbeat already covers cadence).
             heartbeat_lines = not isinstance(status, StatusBar)
+            if heartbeat_lines:
+                status_throttle = 1.0
         next_status_wall = 0.0
         summary = SimSummary()
         # A propagator with `provides_barrier` computes the global
@@ -378,7 +385,7 @@ class Manager:
                 wall = time.perf_counter()
                 if wall >= next_status_wall:  # throttle redraws
                     status.update(window_end)
-                    next_status_wall = wall + 0.2
+                    next_status_wall = wall + status_throttle
             if device_barrier:
                 # finish_round already reduced host next-event times and
                 # in-flight deliveries globally (pmin).
@@ -454,8 +461,13 @@ class Manager:
     def write_data_dir(self, summary: SimSummary) -> None:
         base = self.config.general.data_directory
         os.makedirs(base, exist_ok=True)
+        # Full re-serialization of the resolved options (defaults and
+        # all), re-loadable by from_yaml_text — the reproducibility
+        # artifact (manager.rs:183-194).
+        import yaml as _yaml
         with open(os.path.join(base, "processed-config.yaml"), "w") as f:
-            f.write(f"# shadow_tpu run; seed={self.config.general.seed}\n")
+            _yaml.safe_dump(self.config.to_processed_dict(), f,
+                            sort_keys=False, default_flow_style=False)
         with open(os.path.join(base, "hosts.txt"), "w") as f:
             f.write(self.dns.hosts_file_text())
         for h in self.hosts:

@@ -12,9 +12,10 @@ memory_manager/memory_copier.rs) — with the same protocol:
    kernel, answer Complete / DoNative, or park on a SyscallCondition
    and re-run the same syscall when it fires (restart protocol,
    handler/mod.rs:127-136);
- - child death is detected by waitpid polling during channel waits
-   (the reference uses a pidfd watcher thread, childpid_watcher.rs;
-   polling keeps the manager single-threaded per host);
+ - child death is detected by the ChildWatcher thread closing the dead
+   process's IPC block (child_watcher.py; the reference's
+   childpid_watcher.rs makes the same close-channel-on-death move with
+   pidfd+epoll), with a long-interval waitpid poll as the safety net;
  - an unblocked-syscall CPU-latency model parks the thread every so
    often so syscall-spinning code advances simulated time
    (handler/mod.rs:271-321).
@@ -29,6 +30,7 @@ import time as _walltime
 
 from shadow_tpu.core.event import TaskRef
 from shadow_tpu.host import signals as sigmod
+from shadow_tpu.host.child_watcher import WATCHER
 from shadow_tpu.host.futex import FutexTable
 from shadow_tpu.host.process import Process, ST_BLOCKED, ST_EXITED, ST_RUNNABLE
 from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
@@ -46,7 +48,11 @@ from shadow_tpu.host.syscalls_native import syscall_name
 # reads its values from Host.syscall_latency_ns / Host.max_unapplied_ns,
 # set from experimental config.
 
-_DEATH_POLL_NS = 100_000_000  # 100ms channel-wait slices between waitpid polls
+# Channel-wait slice between waitpid fallback polls.  Child death is
+# normally detected by the ChildWatcher thread closing the IPC block
+# (child_watcher.py); this poll is only a safety net, so it can be
+# long without costing latency.
+_DEATH_POLL_NS = 2_000_000_000
 
 
 class MemoryManager:
@@ -241,6 +247,7 @@ class ManagedProcess(Process):
         self.ipc_block = ipc
         self.argv = argv
         self._preload = preload
+        WATCHER.register(pid, ipc)
         thread = ManagedThread(self, ipc, ipc.channel(0), self._next_tid)
         self._next_tid += 1
         self.threads.append(thread)
@@ -450,6 +457,10 @@ class ManagedThread:
             code = os.WEXITSTATUS(status)
         else:
             code = 128 + os.WTERMSIG(status)
+            if self.process.term_signal is None:
+                # A NATIVE fatal signal (segfault etc.) is this
+                # process's final state, same as an emulated one.
+                self.process.term_signal = os.WTERMSIG(status)
         self._finish(host, code)
         return True
 
@@ -635,7 +646,14 @@ class ManagedThread:
             # pthread_join blocked in the emulated FUTEX_WAIT wakes.
             code = result[1]
             self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
-            self._await_native_thread_gone()
+            if not self._await_native_thread_gone():
+                # Delivering the CLEARTID wake while ctid may still be
+                # nonzero would let a joiner re-park forever; failing
+                # the process loudly beats a silent deadlock.
+                self._protocol_error(
+                    host, f"native tid {self.native_tid} did not tear "
+                          f"down within 5s of thread exit")
+                return False
             self.state = ST_EXITED
             if self.last_condition is not None:
                 self.last_condition.disarm()
@@ -798,6 +816,7 @@ class ManagedThread:
 
         child.native_pid = native_pid
         child.mem = MemoryManager(native_pid)
+        WATCHER.register(native_pid, ipc)
         child.fds = parent.fds.fork_copy()
         child.signals = parent.signals.clone()
         seg = child.signals.action(sigmod.SIGSEGV)
@@ -903,6 +922,7 @@ class ManagedThread:
             os.waitpid(old_pid, 0)
         except (ChildProcessError, OSError):
             pass
+        WATCHER.unregister(old_pid)
         # Closed only after the kill: a live shim seeing CLOSED would
         # print a channel-teardown complaint into the shared stderr.
         old_block.mark_closed()
@@ -922,13 +942,14 @@ class ManagedThread:
                                                   new_thread.resume))
         return False  # the old image's pump ends here
 
-    def _await_native_thread_gone(self) -> None:
+    def _await_native_thread_gone(self) -> bool:
         """Busy-poll until the kernel has fully torn the thread down —
         only then has CLONE_CHILD_CLEARTID been honored and the thread
         stack gone quiescent (a joiner may free it the moment it sees
         tid==0).  The thread-group leader's /proc task entry persists as
         a zombie until the whole process exits, so accept state Z/X
-        there, not just disappearance."""
+        there, not just disappearance.  False on timeout (the caller
+        fails the process rather than risking a lost-wake deadlock)."""
         path = (f"/proc/{self.process.native_pid}/task/"
                 f"{self.native_tid}/stat")
         deadline = _walltime.monotonic() + 5.0
@@ -937,13 +958,13 @@ class ManagedThread:
                 with open(path) as f:
                     stat = f.read()
             except OSError:
-                return  # task entry gone
+                return True  # task entry gone
             # State is the field after the parenthesized comm.
             state = stat.rpartition(")")[2].lstrip()[:1]
             if state in ("Z", "X", ""):
-                return
+                return True
             _walltime.sleep(0.0002)
-        # Degraded but not fatal: proceed; the joiner may spin longer.
+        return False
 
     def _wakeup(self, host) -> None:
         if self.state == ST_BLOCKED:
@@ -982,6 +1003,7 @@ class ManagedThread:
 
     def teardown(self) -> None:
         """Close the whole process's IPC block (idempotent)."""
+        WATCHER.unregister(self.process.native_pid)
         self.block.mark_closed()
         self.block.close()
 

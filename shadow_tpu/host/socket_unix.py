@@ -208,6 +208,10 @@ class UnixSocket(StatusOwner):
                 host.unix_ns.get(self.bound_name) is self:
             del host.unix_ns[self.bound_name]
         peer = self.peer
+        if self.listening:
+            # Wake connect()ers parked on backlog room; their retry
+            # sees the dead listener and fails ECONNREFUSED.
+            self.adjust_status(host, S_SOCKET_ALLOWING_CONNECT, 0)
         self.adjust_status(host, S_CLOSED,
                            S_ACTIVE | S_READABLE | S_WRITABLE |
                            S_SOCKET_ALLOWING_CONNECT)

@@ -269,6 +269,10 @@ class NativeSyscallHandler:
             sock = UnixSocket(host, stream=base_type != SOCK_DGRAM)
             sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
             return _done(self._register(process, sock, cloexec=cloexec))
+        if domain == AF_UNIX:
+            # SEQPACKET etc.: refuse rather than fall through to a
+            # native socket (blocking hazard + wrong namespace).
+            return _error(errno.ESOCKTNOSUPPORT)
         if domain == AF_NETLINK:
             if protocol != 0:  # only NETLINK_ROUTE is modeled
                 return _error(errno.EPROTONOSUPPORT)
@@ -1780,7 +1784,16 @@ class NativeSyscallHandler:
                 return _done(woken + moved)
             return _done(woken)
 
-        # PI / WAKE_OP and friends: no in-tree consumer yet.
+        # PI / WAKE_OP and friends: no in-tree consumer yet.  Binaries
+        # using PI mutexes or raw WAKE_OP may hang on the ENOSYS, so
+        # surface the gap once, visibly (ADVICE parity note).
+        from shadow_tpu.utils.shadow_log import LOG
+        LOG.warn_once(
+            f"futex-op-{cmd}",
+            f"unsupported futex op {cmd} from pid {process.pid} "
+            f"({process.name}): returning ENOSYS — PI mutexes / "
+            f"FUTEX_WAKE_OP are not emulated",
+            sim_ns=host.now(), host=host.name)
         return _error(errno.ENOSYS)
 
     _WNOHANG = 1

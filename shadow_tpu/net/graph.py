@@ -150,9 +150,16 @@ class NetworkGraph:
         self.by_gml_id = {n.gml_id: n for n in nodes}
         self.latency_ns: np.ndarray | None = None
         self.packet_loss: np.ndarray | None = None
+        self.gml_text: str = ""  # original source, for processed-config
 
     @classmethod
     def from_gml(cls, text: str) -> "NetworkGraph":
+        graph = cls._from_gml_parsed(text)
+        graph.gml_text = text
+        return graph
+
+    @classmethod
+    def _from_gml_parsed(cls, text: str) -> "NetworkGraph":
         g = parse_gml(text)["graph"]
         directed = bool(g.get("directed", 0))
         nodes = []

@@ -1,0 +1,85 @@
+"""C/Python IPC ABI mirror test.
+
+native/shim_ipc.h and shadow_tpu/host/shim_abi.py describe the same
+shared-memory layout from two languages; this parses the header's
+#defines and enums and asserts the Python constants match, so drift is
+caught by CI instead of by a corrupted futex word at runtime.  (The
+compiler-side layout is additionally guarded by the header's own
+_Static_asserts.)
+"""
+
+import os
+import re
+
+from shadow_tpu.host import shim_abi
+
+HDR = os.path.join(os.path.dirname(__file__), os.pardir, "native",
+                   "shim_ipc.h")
+
+
+def parse_header():
+    text = open(HDR).read()
+    defines = {}
+    for name, value in re.findall(r"^#define\s+(\w+)\s+(.+)$", text, re.M):
+        value = re.sub(r"/\*.*?\*/", "", value).strip()
+        value = re.sub(r"(?<=[0-9a-fA-F])[uUlL]+\b", "", value)
+        try:
+            defines[name] = eval(value, {}, defines)  # arithmetic of ints
+        except Exception:
+            pass
+    enums = {}
+    for body in re.findall(r"enum\s*\{(.*?)\};", text, re.S):
+        body = re.sub(r"/\*.*?\*/", "", body, flags=re.S)
+        next_val = 0
+        for entry in body.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" in entry:
+                name, _, val = entry.partition("=")
+                next_val = int(val.strip(), 0)
+                name = name.strip()
+            else:
+                name = entry
+            enums[name] = next_val
+            next_val += 1
+    return defines, enums
+
+
+def test_layout_constants_match():
+    d, _ = parse_header()
+    assert shim_abi.MAGIC == d["SHIM_IPC_MAGIC"] & 0xffffffff
+    assert shim_abi.VERSION == d["SHIM_IPC_VERSION"]
+    assert shim_abi.FILE_SIZE == d["SHIM_IPC_FILE_SIZE"]
+    assert shim_abi.N_CHANS == d["IPC_N_CHANS"]
+    assert shim_abi.CHANS_OFF == d["IPC_CHANS_OFF"]
+    assert shim_abi.CHAN_STRIDE == d["IPC_CHAN_STRIDE"]
+    assert shim_abi.CHAN_TO_SHADOW == d["IPC_CHAN_TO_SHADOW"]
+    assert shim_abi.CHAN_TO_SHIM == d["IPC_CHAN_TO_SHIM"]
+    assert shim_abi.SLOT_EV_OFF == d["IPC_SLOT_EV_OFF"]
+    assert shim_abi.OFF_SIM_TIME == d["IPC_OFF_SIM_TIME"]
+    assert shim_abi.OFF_AUXV == d["IPC_OFF_AUXV"]
+    assert shim_abi.OFF_SIGSEGV == d["IPC_OFF_SIGSEGV"]
+    assert shim_abi.OFF_SELF_PATH == d["IPC_OFF_SELF_PATH"]
+    assert shim_abi.OFF_FORK_PATH == d["IPC_OFF_FORK_PATH"]
+    assert shim_abi.OFF_PRELOAD == d["IPC_OFF_PRELOAD"]
+    assert shim_abi.PATH_MAX == d["IPC_PATH_MAX"]
+
+
+def test_event_kinds_match():
+    _, e = parse_header()
+    for name in ("EV_NULL", "EV_START_REQ", "EV_SYSCALL", "EV_CLONE_DONE",
+                 "EV_SIGNAL_DONE", "EV_FORK_DONE", "EV_START_RES",
+                 "EV_SYSCALL_COMPLETE", "EV_SYSCALL_DO_NATIVE",
+                 "EV_CLONE_RES", "EV_SIGNAL", "EV_FORK_RES"):
+        assert getattr(shim_abi, name) == e[name], name
+    for name in ("SLOT_EMPTY", "SLOT_READY", "SLOT_CLOSED"):
+        assert getattr(shim_abi, name) == e[name], name
+
+
+def test_thread_cap_documented():
+    """IPC_N_CHANS bounds concurrently-live threads per process at
+    N_CHANS-1 (channel 0 is the main thread); pthread_create beyond
+    that fails EAGAIN.  This test pins the number so a change updates
+    the docs knowingly."""
+    assert shim_abi.N_CHANS == 64

@@ -89,3 +89,45 @@ hosts: { a: { network_node_id: 0 } }
     cfg = ConfigOptions.from_yaml_text(text)
     cfg.network.graph.compute_routing()
     assert cfg.network.graph.latency_ns[0, 0] == 3_000_000
+
+
+def test_processed_config_round_trips():
+    """to_processed_dict -> YAML -> from_yaml_text -> to_processed_dict
+    is a fixed point (the reproducibility contract of
+    processed-config.yaml; ref manager.rs:183-194)."""
+    import yaml
+    from shadow_tpu.core.config import ConfigOptions
+    text = """
+general:
+  stop_time: 5s
+  seed: 42
+experimental:
+  scheduler: serial
+  host_cpu_threshold: 10 us
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.01 ]
+      ]
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - path: udp-sink
+        args: ["7000"]
+        start_time: 1s
+        shutdown_time: 4s
+        shutdown_signal: SIGINT
+        expected_final_state: running
+"""
+    cfg = ConfigOptions.from_yaml_text(text)
+    d1 = cfg.to_processed_dict()
+    reloaded = ConfigOptions.from_yaml_text(yaml.safe_dump(d1))
+    d2 = reloaded.to_processed_dict()
+    assert d1 == d2
+    assert d1["hosts"]["alpha"]["processes"][0]["shutdown_signal"] == \
+        "SIGINT"
+    assert d1["experimental"]["host_cpu_threshold"] == "10000 ns"

@@ -53,15 +53,25 @@ def run_sim(tmp_path, name, scheduler, parallelism=1):
 
 
 def collect(dirpath):
+    import re
     out = {}
     for root, _, files in os.walk(dirpath):
         for fn in files:
             p = os.path.join(root, fn)
             rel = os.path.relpath(p, dirpath)
-            if fn == "processed-config.yaml":
-                continue
             with open(p, "rb") as f:
-                out[rel] = f.read()
+                data = f.read()
+            if fn == "processed-config.yaml":
+                # Runs legitimately differ only in output path and (for
+                # the cross-scheduler gate) the scheduler knob itself;
+                # everything else must be byte-identical.
+                data = re.sub(rb"data_directory: .*",
+                              b"data_directory: <normalized>", data)
+                data = re.sub(rb"scheduler: .*",
+                              b"scheduler: <normalized>", data)
+                data = re.sub(rb"parallelism: .*",
+                              b"parallelism: <normalized>", data)
+            out[rel] = data
     return out
 
 
