@@ -60,6 +60,10 @@ _Static_assert(__builtin_offsetof(ipc_chan_t, clone_regs) == 144,
 
 static shim_ipc_t *g_ipc = NULL;
 static int g_enabled = 0;
+/* Per-process shimlog (ref: src/lib/logger writing .shimlog files):
+ * path from SHADOWTPU_SHIMLOG; opened lazily per message so the fd
+ * table stays untouched.  Messages also go to stderr. */
+static const char *g_shimlog_path = NULL;
 /* Each thread speaks over its own channel pair; channel 0 is the main
  * thread's, others are bound during the clone dance.  initial-exec TLS:
  * resolved at load time, safe to touch from the SIGSYS handler. */
@@ -78,10 +82,22 @@ static __thread uint32_t g_local_time_count
 
 #define raw shadowtpu_raw_syscall
 
-static void shim_die(const char *msg) {
+static void shim_log_msg(const char *msg) {
     size_t n = 0;
     while (msg[n]) n++;
+    if (g_shimlog_path) {
+        long fd = raw(SYS_openat, AT_FDCWD, (long)g_shimlog_path,
+                      O_WRONLY | O_CREAT | O_APPEND, 0644, 0, 0);
+        if (fd >= 0) {
+            raw(SYS_write, fd, (long)msg, (long)n, 0, 0, 0);
+            raw(SYS_close, fd, 0, 0, 0, 0, 0);
+        }
+    }
     raw(SYS_write, 2, (long)msg, (long)n, 0, 0, 0);
+}
+
+static void shim_die(const char *msg) {
+    shim_log_msg(msg);
     raw(SYS_exit_group, 126, 0, 0, 0, 0, 0);
     __builtin_unreachable();
 }
@@ -640,6 +656,9 @@ static void shim_init(void) {
     const char *path = getenv("SHADOWTPU_IPC");
     if (!path || !*path)
         return;  /* not under the simulator; stay dormant */
+    g_shimlog_path = getenv("SHADOWTPU_SHIMLOG");
+    if (g_shimlog_path && !*g_shimlog_path)
+        g_shimlog_path = NULL;
 
     long fd = raw(SYS_openat, AT_FDCWD, (long)path, O_RDWR, 0, 0, 0);
     if (fd < 0)

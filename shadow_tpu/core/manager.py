@@ -73,6 +73,8 @@ class Runahead:
 
 class Manager:
     def __init__(self, config: ConfigOptions):
+        from shadow_tpu.utils import object_counter
+        object_counter.reset()
         self.config = config
         graph = config.network.graph
         if graph.latency_ns is None:
@@ -424,6 +426,12 @@ class Manager:
                 if isinstance(proc, ManagedProcess) and not proc.exited:
                     proc.kill_native()
                     proc.collect_output()
+                if not proc.exited:
+                    # Forced teardown releases the fd table too, so the
+                    # object-lifecycle accounting distinguishes real fd
+                    # leaks from a server simply still running at
+                    # stop_time.
+                    proc.fds.close_all(h)
                 proc.strace_close()
         # Flush captures even when the caller never writes a data dir.
         for h in self.hosts:
@@ -484,6 +492,12 @@ class Manager:
         with open(os.path.join(base, "packet-trace.txt"), "w") as f:
             for line in self.trace_lines():
                 f.write(line + "\n")
+        from shadow_tpu.utils import object_counter
+        from shadow_tpu.utils.shadow_log import LOG
+        for kind, delta in object_counter.leaks().items():
+            LOG.warning(f"object leak: {delta} {kind} object(s) "
+                        f"allocated but never closed")
+        LOG.flush()
         syscall_hist: dict[str, int] = {}
         for h in self.hosts:
             for name, n in h.syscall_counts.items():
@@ -497,6 +511,7 @@ class Manager:
             "packets_dropped": summary.packets_dropped,
             "syscalls": summary.syscalls,
             "syscalls_by_name": syscall_hist,
+            "objects": object_counter.snapshot(),
             "hosts": {h.name: dict(h.counters) for h in self.hosts},
         }
         if self._perf_timers:

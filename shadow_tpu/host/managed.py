@@ -210,6 +210,11 @@ class ManagedProcess(Process):
         preload = ":".join([shim] + extra)
         env["LD_PRELOAD"] = preload
         env["SHADOWTPU_IPC"] = ipc_path
+        # Per-process shim diagnostics (ref: .shimlog files).  Absolute:
+        # the shim re-resolves the path per message, and the app may
+        # chdir at any point.
+        env["SHADOWTPU_SHIMLOG"] = os.path.abspath(os.path.join(
+            self.work_dir, f"{self.name}.{self.pid}.shimlog"))
         # Eager relocation: keeps ld.so's lazy-binding syscalls out of
         # the simulated timeline.
         env.setdefault("LD_BIND_NOW", "1")

@@ -24,6 +24,8 @@ class StatusOwner:
     def __init__(self):
         self._status = 0
         self._listeners: list = []  # (mask, callback) pairs
+        from shadow_tpu.utils.object_counter import count_alloc
+        count_alloc(type(self).__name__)
 
     @property
     def status(self) -> int:
@@ -51,6 +53,11 @@ class StatusOwner:
         if new == old:
             return
         self._status = new
+        if (new & S_CLOSED) and not (old & S_CLOSED):
+            # First close transition = object deallocation for the
+            # lifecycle counters (every close path raises S_CLOSED).
+            from shadow_tpu.utils.object_counter import count_dealloc
+            count_dealloc(type(self).__name__)
         changed = old ^ new
         # Copy: callbacks may add/remove listeners reentrantly.
         for handle in list(self._listeners):

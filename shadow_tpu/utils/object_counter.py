@@ -1,0 +1,50 @@
+"""Object lifecycle accounting (ref: src/main/utility/counter.rs's
+ObjectCounter + manager.rs:553-565 leak report at exit).
+
+Every pollable simulated object (StatusOwner subclass: sockets, pipes,
+eventfds, timerfds, epolls) counts its allocation at construction and
+its deallocation the first time it transitions to S_CLOSED (every
+close path goes through adjust_status).  The manager writes the table
+to sim-stats.json and warns about classes with alloc != dealloc — in a
+GC'd runtime a "leak" means a descriptor that was never close()d,
+which is exactly the fd-lifecycle bug class the reference's counter
+exists to catch.  Counters are lock-protected: host threads under the
+thread-pool schedulers allocate concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_alloc: dict[str, int] = {}
+_dealloc: dict[str, int] = {}
+
+
+def count_alloc(kind: str) -> None:
+    with _lock:
+        _alloc[kind] = _alloc.get(kind, 0) + 1
+
+
+def count_dealloc(kind: str) -> None:
+    with _lock:
+        _dealloc[kind] = _dealloc.get(kind, 0) + 1
+
+
+def snapshot() -> dict:
+    return {kind: {"allocated": _alloc.get(kind, 0),
+                   "deallocated": _dealloc.get(kind, 0)}
+            for kind in sorted(set(_alloc) | set(_dealloc))}
+
+
+def leaks() -> dict[str, int]:
+    return {kind: v["allocated"] - v["deallocated"]
+            for kind, v in snapshot().items()
+            if v["allocated"] != v["deallocated"]}
+
+
+def reset() -> None:
+    """Fresh accounting for a new simulation (tests run many)."""
+    with _lock:
+        _alloc.clear()
+        _dealloc.clear()

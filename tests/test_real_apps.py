@@ -84,6 +84,61 @@ def test_wget_fetch(tmp_path):
     assert open(out, "rb").read() == b"X" * 100_000
 
 
+SYS_PYTHON = "/usr/bin/python3.11"
+
+
+@pytest.mark.skipif(CURL is None or not os.path.exists(SYS_PYTHON),
+                    reason="no curl or system python")
+def test_cpython_http_server(tmp_path):
+    """Unmodified CPython runs as an in-sim server: curl fetches a file
+    from `python -m http.server`, and the server's access log carries
+    the SIMULATED date — the whole interpreter (threads, selectors,
+    mmap-arena malloc, getrandom hashing seed) lives on the simulated
+    clock."""
+    docroot = tmp_path / "docroot"
+    os.makedirs(docroot)
+    (docroot / "index.html").write_text("python-served-payload\n")
+    out = str(tmp_path / "fetched")
+    yaml = f"""
+general:
+  stop_time: 30s
+  seed: 4
+  data_directory: {tmp_path / 'data'}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: {SYS_PYTHON}
+        args: ["-m", "http.server", "--directory", "{docroot}", "80"]
+        start_time: 1s
+        expected_final_state: running
+  client:
+    network_node_id: 0
+    processes:
+      - path: {CURL}
+        args: ["-s", "-o", "{out}", "http://server/index.html"]
+        start_time: 5s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+"""
+    cfg = ConfigOptions.from_yaml_text(yaml)
+    manager, summary = run_simulation(cfg)
+    assert summary.ok, summary.plugin_errors
+    assert open(out).read() == "python-served-payload\n"
+    server_host = next(h for h in manager.hosts if h.name == "server")
+    server = next(iter(server_host.processes.values()))
+    # http.server logs request time from the (simulated) wall clock:
+    # sim epoch 2000-01-01 + 5s start offset.
+    assert b"[01/Jan/2000 00:00:05]" in bytes(server.stderr) + \
+        bytes(server.stdout)
+
+
 @pytest.mark.skipif(CURL is None, reason="no curl binary")
 def test_curl_deterministic_packet_trace(tmp_path):
     """The same curl fetch twice produces byte-identical packet traces
