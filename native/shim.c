@@ -79,8 +79,20 @@ static __thread ipc_chan_t *g_chan
  * channel design). */
 static __thread uint32_t g_local_time_count
     __attribute__((tls_model("initial-exec"))) = 0;
+/* Execution-context flag (ref ExecutionContext): nonzero while this
+ * thread runs shim code (channel conversation in flight).  The
+ * preemption handler must not inject a yield then — it would violate
+ * the one-outstanding-message channel protocol. */
+static __thread int g_in_shim
+    __attribute__((tls_model("initial-exec"))) = 0;
+/* Simulated ns billed per preemption, from SHADOWTPU_PREEMPT_SIM_NS. */
+static long g_preempt_sim_ns = 0;
+/* Custom pseudo-syscall (ref shadow_syscalls.rs shadow_yield). */
+#define SHADOWTPU_SYS_YIELD 0x53544001L
 
 #define raw shadowtpu_raw_syscall
+
+static void install_preemption(void);
 
 static void shim_log_msg(const char *msg) {
     size_t n = 0;
@@ -221,8 +233,11 @@ static long shim_finish_fork(void) {
     long rv = raw(SYS_clone, SIGCHLD | CLONE_PARENT, 0, 0, 0, 0, 0);
     if (rv == 0) {
         /* Child: rebind to the fresh block and handshake; the manager
-         * releases us when the simulated fork instant is reached. */
+         * releases us when the simulated fork instant is reached.
+         * POSIX resets interval timers across fork — re-arm native
+         * preemption so forked workers' spin loops still progress. */
         shim_rebind(path);
+        install_preemption();
         shim_event_t ev;
         memset(&ev, 0, sizeof(ev));
         ev.kind = EV_START_REQ;
@@ -306,6 +321,7 @@ static long shim_ipc_syscall(long n, const long args[6]) {
 __attribute__((visibility("hidden")))
 void shadowtpu_child_entry(ipc_chan_t *chan) {
     g_chan = chan;
+    g_in_shim++;
     shim_event_t ev;
     memset(&ev, 0, sizeof(ev));
     ev.kind = EV_START_REQ;
@@ -314,6 +330,7 @@ void shadowtpu_child_entry(ipc_chan_t *chan) {
     shim_recv_response(&ev);
     if (ev.kind != EV_START_RES)
         shim_die("[shadow-tpu shim] bad thread-start handshake\n");
+    g_in_shim--;
 }
 
 /* Parent half.  Forwards the trapped clone to the manager; a plain
@@ -437,17 +454,65 @@ static int shim_try_local(long n, const long args[6], long *ret) {
 /* Central dispatch: the shim-side half of the syscall round trip. */
 static long shim_emulated_syscall(long n, const long args[6]) {
     long ret;
+    g_in_shim++;
     if (shim_try_local(n, args, &ret)) {
-        if (++g_local_time_count % LOCAL_TIME_FORWARD_EVERY != 0)
+        if (++g_local_time_count % LOCAL_TIME_FORWARD_EVERY != 0) {
+            g_in_shim--;
             return ret;
+        }
         /* Fall through: let the manager account CPU latency, then
          * recompute locally (the clock may have advanced). */
         long lat_args[6] = {0, 0, 0, 0, 0, 0};
         shim_ipc_syscall(SYS_sched_yield, lat_args);
         shim_try_local(n, args, &ret);
+        g_in_shim--;
         return ret;
     }
-    return shim_ipc_syscall(n, args);
+    ret = shim_ipc_syscall(n, args);
+    g_in_shim--;
+    return ret;
+}
+
+/* ---------------------------------------------------------------- */
+/* Native preemption (ref: shim/src/preempt.rs, off by default)      */
+/* ---------------------------------------------------------------- */
+
+/* SIGVTALRM from ITIMER_VIRTUAL: the process burned a slice of real
+ * CPU time without returning control.  Bill simulated time and yield
+ * to the manager — this is how pure CPU spin loops (no syscalls) make
+ * simulated progress instead of hanging the round.  NOTE: makes event
+ * timing depend on native CPU speed, i.e. NON-deterministic; the knob
+ * is off by default exactly like the reference's. */
+static void sigvtalrm_handler(int sig, siginfo_t *info, void *ucontext) {
+    (void)sig; (void)info; (void)ucontext;
+    if (g_in_shim || !g_enabled || !g_chan)
+        return; /* mid-conversation or a cloned thread whose channel is
+                 * not bound yet; the repeating timer refires */
+    long args[6] = {g_preempt_sim_ns, 0, 0, 0, 0, 0};
+    shim_emulated_syscall(SHADOWTPU_SYS_YIELD, args);
+}
+
+static void install_preemption(void) {
+    const char *native_us = getenv("SHADOWTPU_PREEMPT_NATIVE_US");
+    const char *sim_ns = getenv("SHADOWTPU_PREEMPT_SIM_NS");
+    if (!native_us || !sim_ns)
+        return;
+    long us = atol(native_us);
+    g_preempt_sim_ns = atol(sim_ns);
+    if (us <= 0 || g_preempt_sim_ns <= 0)
+        return;
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = sigvtalrm_handler;
+    sa.sa_flags = SA_SIGINFO | SA_RESTART;
+    if (sigaction(SIGVTALRM, &sa, NULL) != 0)
+        shim_die("[shadow-tpu shim] sigaction(SIGVTALRM) failed\n");
+    struct itimerval itv;
+    itv.it_interval.tv_sec = us / 1000000;
+    itv.it_interval.tv_usec = us % 1000000;
+    itv.it_value = itv.it_interval;
+    if (setitimer(ITIMER_VIRTUAL, &itv, NULL) != 0)
+        shim_die("[shadow-tpu shim] setitimer(ITIMER_VIRTUAL) failed\n");
 }
 
 /* ---------------------------------------------------------------- */
@@ -555,7 +620,9 @@ static void sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
     long n = (long)info->si_syscall;
     if (n == SYS_clone) {
         /* Needs the full trapped context (the child resumes from it). */
+        g_in_shim++;
         shim_handle_clone(gregs);
+        g_in_shim--;
         return;
     }
     long args[6] = {
@@ -681,7 +748,11 @@ static void shim_init(void) {
         shim_die("[shadow-tpu shim] sigaction(SIGSYS) failed\n");
 
     install_rdtsc_trap();
+    /* Before seccomp: its sigaction/setitimer must run natively, not
+     * trap into a manager that hasn't completed the handshake. */
+    install_preemption();
     install_seccomp();
+    g_in_shim++;
     g_enabled = 1;
 
     /* Handshake (ref: managed_thread.rs:138,207-251): announce, then
@@ -695,4 +766,5 @@ static void shim_init(void) {
     shim_recv_response(&ev);
     if (ev.kind != EV_START_RES)
         shim_die("[shadow-tpu shim] bad start handshake\n");
+    g_in_shim--;
 }

@@ -87,6 +87,13 @@ class ExperimentalConfig:
     host_cpu_threshold_ns: int | None = None
     host_cpu_precision_ns: int | None = None
     host_cpu_event_cost_ns: int = 0  # modeled CPU ns charged per event
+    # Native preemption (ref preempt.rs + configuration.rs:510-527):
+    # regain control from managed code spinning on pure CPU.  Makes
+    # event timing depend on native CPU speed — NON-deterministic —
+    # hence off by default, like the reference.
+    native_preemption_enabled: bool = False
+    native_preemption_native_interval_ns: int = units.parse_time_ns("10 ms")
+    native_preemption_sim_interval_ns: int = units.parse_time_ns("10 ms")
     unblocked_vdso_latency_ns: int = units.parse_time_ns("10 ns")
     tpu_max_packets_per_round: int = 1 << 20
     # Below this, propagation always runs the numpy host path; above,
@@ -163,6 +170,11 @@ class ConfigOptions:
                 "host_cpu_threshold": _ns(e.host_cpu_threshold_ns),
                 "host_cpu_precision": _ns(e.host_cpu_precision_ns),
                 "host_cpu_event_cost": _ns(e.host_cpu_event_cost_ns),
+                "native_preemption_enabled": e.native_preemption_enabled,
+                "native_preemption_native_interval":
+                    _ns(e.native_preemption_native_interval_ns),
+                "native_preemption_sim_interval":
+                    _ns(e.native_preemption_sim_interval_ns),
                 "tpu_max_packets_per_round": e.tpu_max_packets_per_round,
                 "tpu_min_device_batch": e.tpu_min_device_batch,
                 "tpu_shards": e.tpu_shards,
@@ -272,6 +284,14 @@ class ConfigOptions:
                 ("host_cpu_precision", "host_cpu_precision_ns",
                  units.parse_time_ns),
                 ("host_cpu_event_cost", "host_cpu_event_cost_ns",
+                 units.parse_time_ns),
+                ("native_preemption_enabled", "native_preemption_enabled",
+                 bool),
+                ("native_preemption_native_interval",
+                 "native_preemption_native_interval_ns",
+                 units.parse_time_ns),
+                ("native_preemption_sim_interval",
+                 "native_preemption_sim_interval_ns",
                  units.parse_time_ns),
                 ("tpu_max_packets_per_round", "tpu_max_packets_per_round", int),
                 ("tpu_min_device_batch", "tpu_min_device_batch", int),

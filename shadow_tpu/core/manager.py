@@ -119,6 +119,11 @@ class Manager:
                     config.experimental.host_cpu_event_cost_ns
             host.syscall_latency_ns = \
                 config.experimental.unblocked_syscall_latency_ns
+            if config.experimental.native_preemption_enabled:
+                host.preempt_native_ns = \
+                    config.experimental.native_preemption_native_interval_ns
+                host.preempt_sim_ns = \
+                    config.experimental.native_preemption_sim_interval_ns
             host.max_unapplied_ns = \
                 config.experimental.max_unapplied_cpu_latency_ns
             host.dns = self.dns
@@ -155,7 +160,7 @@ class Manager:
         sched = config.experimental.scheduler
         threaded = sched in ("thread_per_core", "thread_per_host")
         self._per_host_tasks = sched == "thread_per_host"
-        self._next_times: dict[int, int | None] = {}
+        self._next_times: list = []  # per-host next-event snapshot
         if sched == "tpu" and config.experimental.tpu_shards > 1:
             from shadow_tpu.parallel.mesh_propagator import MeshPropagator
             self.propagator = MeshPropagator(
@@ -277,13 +282,13 @@ class Manager:
         and the next round via inbox deliveries, which the idle filter
         checks directly."""
         best = None
-        times = self._next_times
-        times.clear()
+        times = []
         for h in self.hosts:
             t = h.queue.peek_time()
-            times[h.id] = t
+            times.append(t)
             if t is not None and (best is None or t < best):
                 best = t
+        self._next_times = times
         return best
 
     def _active_hosts(self, until: int) -> list:
@@ -298,13 +303,9 @@ class Manager:
         if not times:
             return self.hosts
         out = []
-        for h in self.hosts:
-            if h._inbox:
+        for h, t in zip(self.hosts, times):
+            if h._inbox or (t is not None and t < until):
                 out.append(h)
-            else:
-                t = times.get(h.id)
-                if t is not None and t < until:
-                    out.append(h)
         return out
 
     def _run_hosts(self, until: int) -> None:

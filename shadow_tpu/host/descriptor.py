@@ -24,6 +24,8 @@ def _decref(file, host) -> None:
     file._open_refs = refs
     if refs <= 0 and hasattr(file, "close"):
         file.close(host)
+        from shadow_tpu.utils.object_counter import mark_dealloc
+        mark_dealloc(file)
 
 
 class DescriptorTable:

@@ -65,6 +65,9 @@ class Host:
         # analogs; overridden by the manager from experimental config).
         self.syscall_latency_ns = 1_000
         self.max_unapplied_ns = 20_000
+        # Native preemption (preempt.rs): 0 = disabled.
+        self.preempt_native_ns = 0
+        self.preempt_sim_ns = 0
 
         # Network plane (host.rs:209-344 construction order).
         self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)

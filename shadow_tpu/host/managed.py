@@ -215,6 +215,10 @@ class ManagedProcess(Process):
         # chdir at any point.
         env["SHADOWTPU_SHIMLOG"] = os.path.abspath(os.path.join(
             self.work_dir, f"{self.name}.{self.pid}.shimlog"))
+        if getattr(host, "preempt_native_ns", 0) > 0:
+            env["SHADOWTPU_PREEMPT_NATIVE_US"] = \
+                str(max(1, host.preempt_native_ns // 1000))
+            env["SHADOWTPU_PREEMPT_SIM_NS"] = str(host.preempt_sim_ns)
         # Eager relocation: keeps ld.so's lazy-binding syscalls out of
         # the simulated timeline.
         env.setdefault("LD_BIND_NOW", "1")

@@ -198,6 +198,8 @@ class TcpSocket(StatusOwner):
             self.listening = False  # in-flight children abort on completion
             for child in self._accept_q:
                 child.close(host)
+                from shadow_tpu.utils.object_counter import mark_dealloc
+                mark_dealloc(child)
             self._accept_q.clear()
             self._teardown(host)
             return

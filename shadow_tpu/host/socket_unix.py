@@ -219,11 +219,13 @@ class UnixSocket(StatusOwner):
             peer._eof = True
             # EOF is readable; writers notice EPIPE via the wake.
             peer.adjust_status(host, S_READABLE | S_WRITABLE, 0)
+        from shadow_tpu.utils.object_counter import mark_dealloc
         for child in self._pending:
             # Never-accepted connections: tear down BOTH ends so the
             # client sees EOF/EPIPE instead of blocking forever.
             child._eof = True
             child.adjust_status(host, S_CLOSED, S_ACTIVE)
+            mark_dealloc(child)
             client = child.peer
             if client is not None:
                 client._eof = True

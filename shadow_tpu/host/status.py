@@ -53,11 +53,6 @@ class StatusOwner:
         if new == old:
             return
         self._status = new
-        if (new & S_CLOSED) and not (old & S_CLOSED):
-            # First close transition = object deallocation for the
-            # lifecycle counters (every close path raises S_CLOSED).
-            from shadow_tpu.utils.object_counter import count_dealloc
-            count_dealloc(type(self).__name__)
         changed = old ^ new
         # Copy: callbacks may add/remove listeners reentrantly.
         for handle in list(self._listeners):

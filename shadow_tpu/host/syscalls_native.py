@@ -67,6 +67,9 @@ SYS = {
     299: "recvmmsg", 307: "sendmmsg",
     293: "pipe2", 302: "prlimit64", 317: "seccomp", 318: "getrandom",
     332: "statx", 435: "clone3",
+    # Custom pseudo-syscalls (ref shadow_syscalls.rs): the shim's
+    # preemption handler yields with this number.
+    0x53544001: "shadow_yield",
 }
 _NUM = {name: num for num, name in SYS.items()}
 
@@ -1432,6 +1435,18 @@ class NativeSyscallHandler:
         n = min(count, _MAX_IO)
         process.mem.write(buf_ptr, host.rng.bytes(n))
         return _done(n)
+
+    def sys_shadow_yield(self, host, process, thread, restarted,
+                         sim_ns, *_):
+        """Native preemption (preempt.rs): the managed thread burned a
+        native CPU slice without syscalls; bill the configured simulated
+        interval so the spin loop makes simulated progress (and the
+        thread parks until the event queue catches up)."""
+        ns = int(sim_ns) if sim_ns > 0 else host.preempt_sim_ns
+        thread.add_cpu_latency(ns)
+        if host.cpu is not None:
+            host.cpu.add_delay(ns)
+        return _done(0)
 
     def sys_sched_yield(self, host, process, thread, restarted, *_):
         # The shim forwards one of these per LOCAL_TIME_FORWARD_EVERY

@@ -3,8 +3,7 @@ ObjectCounter + manager.rs:553-565 leak report at exit).
 
 Every pollable simulated object (StatusOwner subclass: sockets, pipes,
 eventfds, timerfds, epolls) counts its allocation at construction and
-its deallocation the first time it transitions to S_CLOSED (every
-close path goes through adjust_status).  The manager writes the table
+its deallocation when the last reference releases it (mark_dealloc).  The manager writes the table
 to sim-stats.json and warns about classes with alloc != dealloc — in a
 GC'd runtime a "leak" means a descriptor that was never close()d,
 which is exactly the fd-lifecycle bug class the reference's counter
@@ -29,6 +28,19 @@ def count_alloc(kind: str) -> None:
 def count_dealloc(kind: str) -> None:
     with _lock:
         _dealloc[kind] = _dealloc.get(kind, 0) + 1
+
+
+def mark_dealloc(obj) -> None:
+    """Count `obj` deallocated exactly once — called when its last fd
+    reference releases it (descriptor.py) or when simulator code
+    destroys a never-registered object (e.g. a listener tearing down
+    never-accepted children).  Keyed off real release, NOT the S_CLOSED
+    status bit: a RST'd TCP socket is CLOSED-readable while the app
+    still leaks the fd, and that leak must stay visible."""
+    if getattr(obj, "_oc_dead", False):
+        return
+    obj._oc_dead = True
+    count_dealloc(type(obj).__name__)
 
 
 def snapshot() -> dict:

@@ -1,0 +1,23 @@
+/* Pure CPU spin with NO syscalls: without native preemption this makes
+ * zero simulated progress; with it, SIGVTALRM-driven yields bill
+ * simulated time.  Prints the simulated span covering the spin. */
+#include <stdio.h>
+#include <time.h>
+
+static long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000L + ts.tv_nsec;
+}
+
+int main(void) {
+    long t0 = now_ns();
+    volatile unsigned long acc = 1;
+    /* ~200ms+ of real CPU on any modern machine; no syscalls inside. */
+    for (unsigned long i = 0; i < 800000000UL; i++)
+        acc = acc * 2862933555777941757UL + 3037000493UL;
+    long t1 = now_ns();
+    printf("acc=%lu spin_sim_ns=%ld\n", acc, t1 - t0);
+    puts("spin_done");
+    return 0;
+}

@@ -1,0 +1,75 @@
+"""Native preemption (ref: src/lib/shim/src/preempt.rs).
+
+A managed process spinning on pure CPU (no syscalls) makes no simulated
+progress; with native_preemption_enabled, ITIMER_VIRTUAL-driven
+SIGVTALRM yields bill simulated time so the timeline moves.  Like the
+reference, the feature is explicitly NON-deterministic (event timing
+depends on native CPU speed) and off by default.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C toolchain")
+
+
+def run_spin(tmp_path, preempt: bool):
+    exe = str(tmp_path / "spin_loop")
+    if not os.path.exists(exe):
+        subprocess.run(["cc", "-O0", "-o", exe,
+                        os.path.join(PLUGIN_DIR, "spin_loop.c")],
+                       check=True)
+    extra = ""
+    if preempt:
+        extra = ("\nexperimental:"
+                 "\n  native_preemption_enabled: true"
+                 "\n  native_preemption_native_interval: 5 ms"
+                 "\n  native_preemption_sim_interval: 10 ms")
+    yaml = f"""
+general:
+  stop_time: 120s
+  seed: 1
+  data_directory: {tmp_path / ('on' if preempt else 'off')}{extra}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - path: {exe}
+        start_time: 1s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+"""
+    cfg = ConfigOptions.from_yaml_text(yaml)
+    manager, _ = run_simulation(cfg)
+    proc = next(iter(manager.hosts[0].processes.values()))
+    assert proc.exited and proc.exit_code == 0, bytes(proc.stderr)
+    out = bytes(proc.stdout)
+    assert b"spin_done" in out
+    return int(out.split(b"spin_sim_ns=")[1].split()[0])
+
+
+def test_preemption_advances_spin_loop_time(tmp_path):
+    # Preemption off (default): the spin covers (almost) no simulated
+    # time — only the two clock reads' modeled latency.
+    off = run_spin(tmp_path, preempt=False)
+    assert off < 1_000_000, off  # < 1ms simulated
+
+    # Preemption on: every 5ms of native CPU bills 10ms simulated, so a
+    # multi-hundred-ms spin must cover at least one full interval.
+    on = run_spin(tmp_path, preempt=True)
+    assert on >= 10_000_000, on  # >= one sim interval
