@@ -87,6 +87,7 @@ static __thread int g_in_shim
     __attribute__((tls_model("initial-exec"))) = 0;
 /* Simulated ns billed per preemption, from SHADOWTPU_PREEMPT_SIM_NS. */
 static long g_preempt_sim_ns = 0;
+static long g_preempt_native_us = 0;
 /* Custom pseudo-syscall (ref shadow_syscalls.rs shadow_yield). */
 #define SHADOWTPU_SYS_YIELD 0x53544001L
 
@@ -221,6 +222,20 @@ static void shim_rebind(const char *path) {
     g_chan = &g_ipc->chans[0];
 }
 
+/* Re-arm the preemption itimer with raw syscalls only: a fork child
+ * runs under the inherited seccomp filter BEFORE its start handshake,
+ * so a libc setitimer would trap and corrupt the channel protocol.
+ * (The SIGVTALRM handler itself survives fork.) */
+static void rearm_preemption_raw(void) {
+    if (g_preempt_native_us <= 0 || g_preempt_sim_ns <= 0)
+        return;
+    struct itimerval itv;
+    itv.it_interval.tv_sec = g_preempt_native_us / 1000000;
+    itv.it_interval.tv_usec = g_preempt_native_us % 1000000;
+    itv.it_value = itv.it_interval;
+    raw(SYS_setitimer, ITIMER_VIRTUAL, (long)&itv, 0, 0, 0, 0);
+}
+
 /* The manager answered a fork/vfork/fork-style-clone with EV_FORK_RES:
  * it created a fresh IPC block (path in the header's fork_path) and
  * expects us to run the real clone.  CLONE_PARENT makes the child a
@@ -237,7 +252,7 @@ static long shim_finish_fork(void) {
          * POSIX resets interval timers across fork — re-arm native
          * preemption so forked workers' spin loops still progress. */
         shim_rebind(path);
-        install_preemption();
+        rearm_preemption_raw();
         shim_event_t ev;
         memset(&ev, 0, sizeof(ev));
         ev.kind = EV_START_REQ;
@@ -501,6 +516,7 @@ static void install_preemption(void) {
     g_preempt_sim_ns = atol(sim_ns);
     if (us <= 0 || g_preempt_sim_ns <= 0)
         return;
+    g_preempt_native_us = us;
     struct sigaction sa;
     memset(&sa, 0, sizeof(sa));
     sa.sa_sigaction = sigvtalrm_handler;

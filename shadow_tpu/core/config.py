@@ -80,6 +80,10 @@ class ExperimentalConfig:
     interface_qdisc: str = "fifo"
     socket_send_buffer: int = 131_072
     socket_recv_buffer: int = 174_760
+    # Dynamic buffer sizing (ref configuration.rs:564-566, default on;
+    # algorithm from tcp.c _tcp_autotuneReceiveBuffer/SendBuffer).
+    socket_send_autotune: bool = True
+    socket_recv_autotune: bool = True
     strace_logging_mode: str = "off"  # off | standard | deterministic
     max_unapplied_cpu_latency_ns: int = units.parse_time_ns("20 us")
     unblocked_syscall_latency_ns: int = units.parse_time_ns("1 us")
@@ -161,6 +165,8 @@ class ConfigOptions:
                 "interface_qdisc": e.interface_qdisc,
                 "socket_send_buffer": e.socket_send_buffer,
                 "socket_recv_buffer": e.socket_recv_buffer,
+                "socket_send_autotune": e.socket_send_autotune,
+                "socket_recv_autotune": e.socket_recv_autotune,
                 "strace_logging_mode": e.strace_logging_mode,
                 "max_unapplied_cpu_latency":
                     _ns(e.max_unapplied_cpu_latency_ns),
@@ -272,6 +278,8 @@ class ConfigOptions:
                 ("interface_qdisc", "interface_qdisc", str),
                 ("socket_send_buffer", "socket_send_buffer", units.parse_bytes),
                 ("socket_recv_buffer", "socket_recv_buffer", units.parse_bytes),
+                ("socket_send_autotune", "socket_send_autotune", bool),
+                ("socket_recv_autotune", "socket_recv_autotune", bool),
                 ("strace_logging_mode", "strace_logging_mode", str),
                 ("max_unapplied_cpu_latency", "max_unapplied_cpu_latency_ns",
                  units.parse_time_ns),
@@ -302,15 +310,6 @@ class ConfigOptions:
                 ("report_errors_to_stderr", "report_errors_to_stderr", bool)):
             if yaml_key in e:
                 setattr(experimental, attr, conv(e[yaml_key]))
-        # Buffer autotuning is not implemented: reject rather than
-        # silently accept-and-ignore (the buffers are fixed at the
-        # socket_*_buffer sizes; see docs/PARITY.md).
-        for knob in ("socket_send_autotune", "socket_recv_autotune"):
-            if e.get(knob):
-                raise ValueError(
-                    f"{knob}: true is not supported (buffer autotuning is "
-                    f"not implemented; set socket_send_buffer/"
-                    f"socket_recv_buffer explicitly)")
         if experimental.scheduler not in SCHEDULERS:
             raise ValueError(f"unknown scheduler {experimental.scheduler!r}; "
                              f"expected one of {SCHEDULERS}")

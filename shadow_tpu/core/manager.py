@@ -84,11 +84,15 @@ class Manager:
         self.dns = Dns()
         self.syscall_handler = SyscallHandler(
             send_buf=config.experimental.socket_send_buffer,
-            recv_buf=config.experimental.socket_recv_buffer)
+            recv_buf=config.experimental.socket_recv_buffer,
+            send_autotune=config.experimental.socket_send_autotune,
+            recv_autotune=config.experimental.socket_recv_autotune)
         from shadow_tpu.host.syscalls_native import NativeSyscallHandler
         self.syscall_handler_native = NativeSyscallHandler(
             send_buf=config.experimental.socket_send_buffer,
-            recv_buf=config.experimental.socket_recv_buffer)
+            recv_buf=config.experimental.socket_recv_buffer,
+            send_autotune=config.experimental.socket_send_autotune,
+            recv_autotune=config.experimental.socket_recv_autotune)
 
         # Build hosts in sorted-name order: host ids — and with them every
         # RNG stream and ordering tiebreak — are config-deterministic.

@@ -39,6 +39,8 @@ class Host:
         self.id = host_id
         self.name = name
         self.ip = ip
+        self.bw_down_bits = bw_down_bits
+        self.bw_up_bits = bw_up_bits
         self.node_index = node_index
         self.rng = HostRng(seed, host_id)
         self.queue = EventQueue()

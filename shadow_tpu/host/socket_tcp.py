@@ -26,8 +26,16 @@ EPHEMERAL_LO = 32_768
 EPHEMERAL_HI = 65_536
 
 
+# Linux sysctl analogs the autotuner clamps against
+# (ref definitions.h CONFIG_TCP_WMEM_MAX / CONFIG_TCP_RMEM_MAX;
+# tcpc.RMEM_CEILING = 10 * RMEM_MAX is the matching scale ceiling).
+WMEM_MAX = 4_194_304
+RMEM_MAX = 6_291_456
+
+
 class TcpSocket(StatusOwner):
-    def __init__(self, host, send_buf: int, recv_buf: int):
+    def __init__(self, host, send_buf: int, recv_buf: int,
+                 send_autotune: bool = True, recv_autotune: bool = True):
         super().__init__()
         self.protocol = pkt.PROTO_TCP
         self.local = None
@@ -36,6 +44,13 @@ class TcpSocket(StatusOwner):
         self.nodelay = False          # TCP_NODELAY, propagated to conns
         self._send_buf_max = send_buf
         self._recv_buf_max = recv_buf
+        # Dynamic buffer sizing (ref tcp.c _tcp_autotune*Buffer):
+        # grow-only, clamped to the bandwidth-delay product.
+        self.send_autotune = send_autotune
+        self.recv_autotune = recv_autotune
+        self._at_bytes_copied = 0
+        self._at_space = 0
+        self._at_last_adjust = 0
         self._ifaces = []
         self._iface = None            # the interface a stream runs on
         self.conn: tcpc.TcpConnection | None = None
@@ -45,6 +60,7 @@ class TcpSocket(StatusOwner):
         self._accept_q: deque = deque()
         self._listener = None         # backref for children
         self._accept_queued = False
+        self._delivered = False       # handed to the app via accept()
         # Egress packets ready for the interface, per interface name.
         self._out_packets: dict[str, deque] = {"lo": deque(), "eth0": deque()}
         self._timer_deadline: int | None = None
@@ -128,7 +144,9 @@ class TcpSocket(StatusOwner):
         self._ifaces = [self._iface]
         self.conn = tcpc.TcpConnection(
             iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
-            send_buf_max=self._send_buf_max)
+            send_buf_max=self._send_buf_max,
+            window_ceiling=(tcpc.RMEM_CEILING if self.recv_autotune
+                            else None))
         self.conn.nodelay = self.nodelay
         self.conn.open_active(host.now())
         self._flush(host)
@@ -142,6 +160,7 @@ class TcpSocket(StatusOwner):
         if not self._accept_q:
             raise BlockingIOError(errno.EWOULDBLOCK, "no pending connection")
         child = self._accept_q.popleft()
+        child._delivered = True  # the app owns it now (fd lifecycle)
         if not self._accept_q:
             self.adjust_status(host, 0, S_READABLE)
         return child
@@ -183,6 +202,8 @@ class TcpSocket(StatusOwner):
         if peek:
             return conn.peek(bufsize)
         data = conn.read(bufsize, host.now())
+        if self.recv_autotune and data:
+            self._autotune_recv(host, conn, len(data))
         self._flush(host)
         if conn.readable_bytes() == 0 and not conn.at_eof():
             self.adjust_status(host, 0, S_READABLE)
@@ -225,6 +246,12 @@ class TcpSocket(StatusOwner):
                     iface.disassociate(self.protocol, self.local[1])
         self._ifaces = []
         self.adjust_status(host, S_CLOSED, S_ACTIVE | S_READABLE | S_WRITABLE)
+        if self._listener is not None and not self._delivered:
+            # Pre-accept child dying (listener closed mid-handshake,
+            # RST in SYN_RCVD, accept-queue purge): the app never owned
+            # it, so this teardown IS its deallocation.
+            from shadow_tpu.utils.object_counter import mark_dealloc
+            mark_dealloc(self)
 
     def _maybe_teardown(self, host) -> None:
         if self.conn is not None and self.conn.state == tcpc.CLOSED \
@@ -255,6 +282,10 @@ class TcpSocket(StatusOwner):
             host.trace_drop(packet, "tcp-closed")
             return False
         conn.on_packet(packet.tcp, packet.payload, host.now())
+        if self.send_autotune and conn.srtt > 0:
+            # ACK processing updated cwnd/RTT: grow the send buffer to
+            # keep the congestion window fed (tcp.c autotune-on-ack).
+            self._autotune_send(host, conn)
         self._flush(host)
         self._update_status(host)
         self._maybe_child_established(host)
@@ -273,7 +304,9 @@ class TcpSocket(StatusOwner):
             host.trace_drop(packet, "accept-backlog-full")
             return False
         # Spawn a child socket bound to the specific 4-tuple.
-        child = TcpSocket(host, self._send_buf_max, self._recv_buf_max)
+        child = TcpSocket(host, self._send_buf_max, self._recv_buf_max,
+                          send_autotune=self.send_autotune,
+                          recv_autotune=self.recv_autotune)
         child.local = (packet.dst_ip, packet.dst_port)
         child.peer = (packet.src_ip, packet.src_port)
         child._listener = self
@@ -288,7 +321,9 @@ class TcpSocket(StatusOwner):
         child._ifaces = [iface]
         child.conn = tcpc.TcpConnection(
             iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
-            send_buf_max=self._send_buf_max)
+            send_buf_max=self._send_buf_max,
+            window_ceiling=(tcpc.RMEM_CEILING if self.recv_autotune
+                            else None))
         child.nodelay = self.nodelay
         child.conn.nodelay = self.nodelay
         child.conn.accept_syn(hdr, host.now())
@@ -313,6 +348,45 @@ class TcpSocket(StatusOwner):
     # ------------------------------------------------------------------
     # Egress drain + timers
     # ------------------------------------------------------------------
+
+    @staticmethod
+    def _max_mem(host, rtt_ns: int, is_recv: bool) -> int:
+        """BDP-derived ceiling, clamped to [X, 10X] of the Linux-default
+        sysctl max (tcp.c _tcp_computeMaxRMEM/WMEM)."""
+        bw_bits = host.bw_down_bits if is_recv else host.bw_up_bits
+        mem = bw_bits * rtt_ns // (8 * 10**9)
+        base = RMEM_MAX if is_recv else WMEM_MAX
+        return min(max(mem, base), base * 10)
+
+    def _autotune_recv(self, host, conn, bytes_copied: int) -> None:
+        """Receiver-side DRS (tcp.c _tcp_autotuneReceiveBuffer): track
+        bytes the app drained per sRTT window; advertise space for
+        twice that, grow-only, BDP-capped."""
+        self._at_bytes_copied += bytes_copied
+        space = 2 * self._at_bytes_copied
+        if space > self._at_space:
+            self._at_space = space
+        cur = conn.recv_buf_max
+        if self._at_space > cur:
+            new = min(self._at_space, self._max_mem(host, conn.srtt, True))
+            if new > cur:
+                conn.recv_buf_max = new
+        now = host.now()
+        if self._at_last_adjust == 0:
+            self._at_last_adjust = now
+        elif conn.srtt > 0 and now - self._at_last_adjust > conn.srtt:
+            self._at_last_adjust = now
+            self._at_bytes_copied = 0
+
+    def _autotune_send(self, host, conn) -> None:
+        """Sender side (tcp.c _tcp_autotuneSendBuffer): room for twice
+        the congestion window's worth of the kernel's per-segment
+        overhead estimate, grow-only, BDP-capped."""
+        demanded = max(1, conn.cwnd // max(conn.eff_mss, 1))
+        new = min(2404 * 2 * demanded,
+                  self._max_mem(host, conn.srtt, False))
+        if new > conn.send_buf_max:
+            conn.send_buf_max = new
 
     def _flush(self, host) -> None:
         conn = self.conn

@@ -52,9 +52,12 @@ class SyscallHandler:
     """One instance per manager; stateless w.r.t. hosts (buffer-size
     defaults come from config, configuration.rs:348-592)."""
 
-    def __init__(self, send_buf: int = 131_072, recv_buf: int = 174_760):
+    def __init__(self, send_buf: int = 131_072, recv_buf: int = 174_760,
+                 send_autotune: bool = True, recv_autotune: bool = True):
         self.send_buf = send_buf
         self.recv_buf = recv_buf
+        self.send_autotune = send_autotune
+        self.recv_autotune = recv_autotune
 
     def dispatch(self, host, process, thread, call, restarted: bool):
         name = call[0]
@@ -85,7 +88,9 @@ class SyscallHandler:
             except ImportError:
                 return _error(errno.EPROTONOSUPPORT,
                               "TCP sockets not available yet")
-            sock = TcpSocket(host, self.send_buf, self.recv_buf)
+            sock = TcpSocket(host, self.send_buf, self.recv_buf,
+                             send_autotune=self.send_autotune,
+                             recv_autotune=self.recv_autotune)
         else:
             return _error(errno.EINVAL, f"bad socket kind {kind!r}")
         sock.nonblocking = bool(nonblocking)

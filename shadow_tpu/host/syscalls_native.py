@@ -222,9 +222,12 @@ def _unpack_sockaddr_in(raw: bytes):
 class NativeSyscallHandler:
     """One per manager (like the internal-app SyscallHandler)."""
 
-    def __init__(self, send_buf: int = 131_072, recv_buf: int = 174_760):
+    def __init__(self, send_buf: int = 131_072, recv_buf: int = 174_760,
+                 send_autotune: bool = True, recv_autotune: bool = True):
         self.send_buf = send_buf
         self.recv_buf = recv_buf
+        self.send_autotune = send_autotune
+        self.recv_autotune = recv_autotune
 
     # ------------------------------------------------------------------
 
@@ -288,7 +291,9 @@ class NativeSyscallHandler:
             sock = UdpSocket(host, self.send_buf, self.recv_buf)
         else:
             from shadow_tpu.host.socket_tcp import TcpSocket
-            sock = TcpSocket(host, self.send_buf, self.recv_buf)
+            sock = TcpSocket(host, self.send_buf, self.recv_buf,
+                             send_autotune=self.send_autotune,
+                             recv_autotune=self.recv_autotune)
         sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
         return _done(self._register(process, sock,
                                     cloexec=bool(type_ & SOCK_CLOEXEC)))

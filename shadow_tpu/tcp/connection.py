@@ -60,7 +60,23 @@ STATE_NAMES = {
 
 MSS = 1460  # MTU 1500 - 40 header bytes
 MAX_WINDOW = 65_535
-WINDOW_SCALE = 7                # our advertised shift (RFC 7323 max 14)
+# The receive autotuner's upper bound (10x the Linux-default
+# tcp_rmem max; ref definitions.h CONFIG_TCP_RMEM_MAX) — the window
+# ceiling a dynamically-sized connection advertises scale for.
+RMEM_CEILING = 6_291_456 * 10
+
+
+def choose_window_scale(window_ceiling: int) -> int:
+    """RFC 7323 shift chosen at SYN time, Linux-style: the smallest
+    scale that can advertise the LARGEST window this buffer could ever
+    reach (the autotuner's ceiling when dynamic sizing is on) — the
+    scale cannot change after the handshake.  Small fixed buffers get
+    scale 0 and byte-granular windows."""
+    scale = 0
+    while window_ceiling > MAX_WINDOW and scale < 14:
+        window_ceiling >>= 1
+        scale += 1
+    return scale
 MAX_SACK_BLOCKS = 3             # with timestamps elided, 3 fit on wire
 
 INIT_RTO_NS = 1_000_000_000     # RFC 6298 initial
@@ -83,11 +99,16 @@ class RenoCongestion:
     def __init__(self, mss: int = MSS):
         self.mss = mss
         self.cwnd = 10 * mss  # RFC 6928 IW10
-        self.ssthresh = 64 * 1024
+        # Infinite until the first loss event (ref tcp_cong_reno.c
+        # ca_reno_init_: INT32_MAX; Linux TCP_INFINITE_SSTHRESH) —
+        # slow start must not stop at an arbitrary ceiling.
+        self.ssthresh = (1 << 31) - 1
 
     def on_new_ack(self, acked: int) -> None:
         if self.cwnd < self.ssthresh:
-            self.cwnd += min(acked, self.mss)  # slow start
+            # Slow start with ABC (RFC 3465, L=2*SMSS): delayed acks
+            # covering two segments still double cwnd per RTT.
+            self.cwnd += min(acked, 2 * self.mss)
         else:
             self.cwnd += max(1, self.mss * self.mss // self.cwnd)  # AIMD
 
@@ -133,9 +154,14 @@ class TcpConnection:
 
     def __init__(self, iss: int, recv_buf_max: int = 174_760,
                  send_buf_max: int = 131_072, congestion: str = "reno",
-                 delayed_ack: bool = True, nagle: bool = True):
+                 delayed_ack: bool = True, nagle: bool = True,
+                 window_ceiling: int | None = None):
         self.state = CLOSED
         self.iss = iss % _SEQ_MOD
+        # SYN-time scale choice covers the largest window the receive
+        # buffer can ever grow to (autotuning ceiling when enabled).
+        self._wscale_offer = choose_window_scale(
+            window_ceiling if window_ceiling is not None else recv_buf_max)
 
         # Send side.
         self.snd_una = self.iss
@@ -161,7 +187,7 @@ class TcpConnection:
         self.pending_fin_seq: int | None = None  # ...processed in order
 
         # Window scaling (RFC 7323; ref window_scaling.rs): we always
-        # offer WINDOW_SCALE; active only if the peer's SYN offers too.
+        # offer our chosen scale; active only if the peer offers too.
         self.our_wscale = 0    # shift applied to windows we advertise
         self.peer_wscale = 0   # shift applied to windows we receive
         self.eff_mss = MSS     # clamped by the peer's MSS option
@@ -227,7 +253,7 @@ class TcpConnection:
         assert self.state == CLOSED
         self.state = SYN_SENT
         self._emit(TcpFlags.SYN, seq=self.iss, payload=b"", now=now,
-                   track=True, mss=MSS, window_scale=WINDOW_SCALE)
+                   track=True, mss=MSS, window_scale=self._wscale_offer)
         self.snd_nxt = seq_add(self.iss, 1)
 
     def open_passive(self) -> None:
@@ -447,13 +473,14 @@ class TcpConnection:
             # MSS rather than the 1460-byte default.
             self.cong = type(self.cong)(mss=self.eff_mss)
         if hdr.window_scale is not None:
-            self.our_wscale = WINDOW_SCALE
+            self.our_wscale = self._wscale_offer
             self.peer_wscale = min(hdr.window_scale, 14)
 
     def _emit_synack(self, now: int) -> None:
         self._emit(TcpFlags.SYN | TcpFlags.ACK, seq=self.iss, payload=b"",
                    now=now, track=(self.snd_nxt == self.iss), mss=MSS,
-                   window_scale=(WINDOW_SCALE if self.our_wscale else None))
+                   window_scale=(self._wscale_offer if self.our_wscale
+                                 else None))
 
     def _on_packet_syn_sent(self, hdr: TcpHeader, now: int) -> None:
         if (hdr.flags & (TcpFlags.SYN | TcpFlags.ACK)) == \
@@ -815,10 +842,11 @@ class TcpConnection:
             # disagreeing about window scaling.
             flags = TcpFlags.SYN
             mss = MSS
-            window_scale = WINDOW_SCALE
+            window_scale = self._wscale_offer
             if self.state == SYN_RECEIVED:
                 flags = TcpFlags.SYN | TcpFlags.ACK
-                window_scale = WINDOW_SCALE if self.our_wscale else None
+                window_scale = (self._wscale_offer if self.our_wscale
+                                else None)
         elif payload:
             flags |= TcpFlags.PSH
         self.outbox.append((TcpHeader(

@@ -119,3 +119,15 @@ def test_three_tier_trace_identical_serial_vs_tpu():
                                                 stop="6s"))
     assert s_a.ok and s_b.ok
     assert m_a.trace_lines() == m_b.trace_lines()
+
+
+def test_three_tier_2000_hosts():
+    """Scale ladder checkpoint (BASELINE: 1k-host 3-tier is config 3;
+    a 10k-host run of this shape completes in ~30s wall at ~535MB RSS).
+    Kept at 2k hosts for CI cost."""
+    m, s = run_simulation(three_tier_config("tpu", n_hosts=2000,
+                                            stop="15s"))
+    assert s.ok, s.plugin_errors[:3]
+    done = sum(1 for h in m.hosts for p in h.processes.values()
+               if b"transfer 0 ok" in bytes(p.stdout))
+    assert done > 1700

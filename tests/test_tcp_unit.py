@@ -244,9 +244,13 @@ def test_option_negotiation_wscale_and_mss():
     w = Wire()
     w.handshake()
     # Both offered: scale active on both sides, MSS clamped to the min.
-    from shadow_tpu.tcp.connection import WINDOW_SCALE
-    assert w.a.our_wscale == WINDOW_SCALE and w.a.peer_wscale == WINDOW_SCALE
-    assert w.b.our_wscale == WINDOW_SCALE and w.b.peer_wscale == WINDOW_SCALE
+    # The scale is chosen from the buffer/ceiling at SYN time
+    # (choose_window_scale): the default 174760-byte buffer needs 2.
+    from shadow_tpu.tcp.connection import choose_window_scale
+    want = choose_window_scale(w.a.recv_buf_max)
+    assert want > 0
+    assert w.a.our_wscale == want and w.a.peer_wscale == want
+    assert w.b.our_wscale == want and w.b.peer_wscale == want
     assert w.a.eff_mss == MSS and w.b.eff_mss == MSS
     # The true receive window (174760 default) now exceeds the unscaled
     # 16-bit cap and is visible to the peer.
