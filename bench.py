@@ -151,6 +151,18 @@ def run_once(build, scheduler: str):
     return summary, wall
 
 
+def run_best(build, scheduler: str, trials: int = 2):
+    """Best-of-N wall time: machine noise (co-tenants, allocator state)
+    swings single runs by 10-20%, which would dominate the recorded
+    ratio."""
+    best_summary, best_wall = None, None
+    for _ in range(trials):
+        summary, wall = run_once(build, scheduler)
+        if best_wall is None or wall < best_wall:
+            best_summary, best_wall = summary, wall
+    return best_summary, best_wall
+
+
 def main() -> None:
     if not tpu_available():
         from shadow_tpu.utils.platform import force_cpu
@@ -159,9 +171,9 @@ def main() -> None:
               file=sys.stderr)
 
     # Secondary: the 100-host UDP mesh where propagation dominates.
-    mesh_base, mesh_base_wall = run_once(mesh_config, "thread_per_core")
-    run_once(mesh_config, "tpu")
-    mesh_tpu, mesh_tpu_wall = run_once(mesh_config, "tpu")
+    mesh_base, mesh_base_wall = run_best(mesh_config, "thread_per_core")
+    run_once(mesh_config, "tpu")  # warmup: compiles the batch buckets
+    mesh_tpu, mesh_tpu_wall = run_best(mesh_config, "tpu")
     print(f"bench[mesh-100]: tpu "
           f"{mesh_tpu.packets_sent / mesh_tpu_wall:.0f} pkts/s, "
           f"thread_per_core "
@@ -169,9 +181,8 @@ def main() -> None:
           f"ratio {mesh_base_wall / mesh_tpu_wall:.3f}", file=sys.stderr)
 
     # Headline: BASELINE config 3 (1k-host 3-tier tgen TCP).
-    base_summary, base_wall = run_once(config3, "thread_per_core")
-    run_once(config3, "tpu")
-    tpu_summary, tpu_wall = run_once(config3, "tpu")
+    base_summary, base_wall = run_best(config3, "thread_per_core")
+    tpu_summary, tpu_wall = run_best(config3, "tpu")
 
     assert tpu_summary.packets_sent == base_summary.packets_sent, \
         "schedulers disagreed on workload size"

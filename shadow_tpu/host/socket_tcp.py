@@ -26,11 +26,10 @@ EPHEMERAL_LO = 32_768
 EPHEMERAL_HI = 65_536
 
 
-# Linux sysctl analogs the autotuner clamps against
-# (ref definitions.h CONFIG_TCP_WMEM_MAX / CONFIG_TCP_RMEM_MAX;
-# tcpc.RMEM_CEILING = 10 * RMEM_MAX is the matching scale ceiling).
-WMEM_MAX = 4_194_304
-RMEM_MAX = 6_291_456
+# Autotuner clamps live in the connection module (single source of
+# truth with the SYN-time window-scale ceiling).
+WMEM_MAX = tcpc.WMEM_MAX
+RMEM_MAX = tcpc.RMEM_MAX
 
 
 class TcpSocket(StatusOwner):
@@ -246,10 +245,13 @@ class TcpSocket(StatusOwner):
                     iface.disassociate(self.protocol, self.local[1])
         self._ifaces = []
         self.adjust_status(host, S_CLOSED, S_ACTIVE | S_READABLE | S_WRITABLE)
-        if self._listener is not None and not self._delivered:
+        if self._listener is not None and not self._delivered \
+                and self not in self._listener._accept_q:
             # Pre-accept child dying (listener closed mid-handshake,
-            # RST in SYN_RCVD, accept-queue purge): the app never owned
-            # it, so this teardown IS its deallocation.
+            # RST in SYN_RCVD): the app never owned it and never will,
+            # so this teardown IS its deallocation.  A child still in
+            # the accept queue can yet reach the app via accept() — its
+            # lifecycle then ends at the fd table like any other fd.
             from shadow_tpu.utils.object_counter import mark_dealloc
             mark_dealloc(self)
 

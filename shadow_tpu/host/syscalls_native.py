@@ -66,7 +66,7 @@ SYS = {
     288: "accept4", 290: "eventfd2", 291: "epoll_create1", 292: "dup3",
     299: "recvmmsg", 307: "sendmmsg",
     293: "pipe2", 302: "prlimit64", 317: "seccomp", 318: "getrandom",
-    332: "statx", 435: "clone3",
+    332: "statx", 435: "clone3", 436: "close_range",
     # Custom pseudo-syscalls (ref shadow_syscalls.rs): the shim's
     # preemption handler yields with this number.
     0x53544001: "shadow_yield",
@@ -701,9 +701,13 @@ class NativeSyscallHandler:
                 value = getattr(sock, "so_error", 0) or 0
                 sock.so_error = 0
             elif optname == SO_SNDBUF:
-                value = self.send_buf
+                conn = getattr(sock, "conn", None)
+                value = (conn.send_buf_max if conn is not None
+                         else self.send_buf)
             elif optname == SO_RCVBUF:
-                value = self.recv_buf
+                conn = getattr(sock, "conn", None)
+                value = (conn.recv_buf_max if conn is not None
+                         else self.recv_buf)
             elif optname == SO_TYPE:
                 if isinstance(sock, UnixSocket):
                     value = (SOCK_STREAM if sock.stream else SOCK_DGRAM)
@@ -838,6 +842,21 @@ class NativeSyscallHandler:
             return _native()
         process.fds.close_fd(host, fd - EMU_FD_BASE)
         return _done(0)
+
+    def sys_close_range(self, host, process, thread, restarted, first,
+                        last, flags, *_):
+        """Close/mark the emulated fds in range, then run the native
+        close_range too (DO_NATIVE) for the native portion — the two fd
+        spaces are disjoint by construction (EMU_FD_BASE split)."""
+        CLOSE_RANGE_CLOEXEC = 4
+        last = min(last, 1 << 20)
+        for fd in [f + EMU_FD_BASE for f in process.fds.open_fds()]:
+            if first <= fd <= last:
+                if flags & CLOSE_RANGE_CLOEXEC:
+                    process.fds.set_cloexec(fd - EMU_FD_BASE, True)
+                else:
+                    process.fds.close_fd(host, fd - EMU_FD_BASE)
+        return _native()
 
     def sys_dup(self, host, process, thread, restarted, fd, *_):
         if not self._is_emu(fd):

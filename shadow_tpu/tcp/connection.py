@@ -60,10 +60,14 @@ STATE_NAMES = {
 
 MSS = 1460  # MTU 1500 - 40 header bytes
 MAX_WINDOW = 65_535
-# The receive autotuner's upper bound (10x the Linux-default
-# tcp_rmem max; ref definitions.h CONFIG_TCP_RMEM_MAX) — the window
-# ceiling a dynamically-sized connection advertises scale for.
-RMEM_CEILING = 6_291_456 * 10
+# Linux-default sysctl maxima the buffer autotuner clamps against
+# (ref definitions.h CONFIG_TCP_WMEM_MAX / CONFIG_TCP_RMEM_MAX), and
+# the derived ceiling (10x) a dynamically-sized connection both grows
+# toward and advertises window scale for — single source of truth so
+# the scale can always represent the buffer.
+WMEM_MAX = 4_194_304
+RMEM_MAX = 6_291_456
+RMEM_CEILING = 10 * RMEM_MAX
 
 
 def choose_window_scale(window_ceiling: int) -> int:
