@@ -182,6 +182,7 @@ def main() -> None:
 
     # Headline: BASELINE config 3 (1k-host 3-tier tgen TCP).
     base_summary, base_wall = run_best(config3, "thread_per_core")
+    run_once(config3, "tpu")  # warmup: JIT-compiles the batch buckets
     tpu_summary, tpu_wall = run_best(config3, "tpu")
 
     assert tpu_summary.packets_sent == base_summary.packets_sent, \

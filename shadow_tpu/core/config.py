@@ -243,7 +243,8 @@ class ConfigOptions:
     @classmethod
     def from_dict(cls, raw: dict, base_dir: str = ".") -> "ConfigOptions":
         raw = {k: v for k, v in raw.items() if not str(k).startswith("x-")}
-        unknown = set(raw) - {"general", "network", "experimental", "hosts"}
+        unknown = set(raw) - {"general", "network", "experimental",
+                              "hosts", "host_option_defaults"}
         if unknown:
             raise ValueError(f"unknown config sections: {sorted(unknown)}")
 
@@ -320,9 +321,29 @@ class ConfigOptions:
         hosts_raw = raw.get("hosts", {}) or {}
         if not hosts_raw:
             raise ValueError("config must define at least one host")
+        # host_option_defaults (configuration.rs:594 HostDefaultOptions):
+        # simulation-wide defaults each host may override in its own
+        # host_options block.  Only implemented options are accepted —
+        # a typo'd or unsupported key must fail, not silently no-op.
+        _HOST_OPTION_KEYS = {"pcap_enabled", "pcap_capture_size"}
+
+        def _host_options(section: str, d: dict) -> dict:
+            unknown = set(d) - _HOST_OPTION_KEYS
+            if unknown:
+                raise ValueError(f"{section}: unsupported option(s) "
+                                 f"{sorted(unknown)}")
+            return d
+
+        defaults_raw = _host_options(
+            "host_option_defaults",
+            raw.get("host_option_defaults", {}) or {})
+
         hosts = {}
         for name, h in hosts_raw.items():
             h = h or {}
+            opt = dict(defaults_raw)
+            opt.update(_host_options(f"hosts.{name}.host_options",
+                                     h.get("host_options", {}) or {}))
             procs = []
             for p in h.get("processes", []) or []:
                 args = p.get("args", [])
@@ -353,9 +374,11 @@ class ConfigOptions:
                                      if bw_down is not None else None),
                 bandwidth_up_bits=(units.parse_bandwidth_bits(bw_up)
                                    if bw_up is not None else None),
-                pcap_enabled=bool(h.get("pcap_enabled", False)),
+                pcap_enabled=bool(h.get("pcap_enabled",
+                                        opt.get("pcap_enabled", False))),
                 pcap_capture_size=units.parse_bytes(
-                    h.get("pcap_capture_size", 65535)),
+                    h.get("pcap_capture_size",
+                          opt.get("pcap_capture_size", 65535))),
             )
         return cls(general=general, network=network,
                    experimental=experimental, hosts=hosts)

@@ -848,14 +848,26 @@ class NativeSyscallHandler:
         """Close/mark the emulated fds in range, then run the native
         close_range too (DO_NATIVE) for the native portion — the two fd
         spaces are disjoint by construction (EMU_FD_BASE split)."""
+        CLOSE_RANGE_UNSHARE = 2
         CLOSE_RANGE_CLOEXEC = 4
-        last = min(last, 1 << 20)
-        for fd in [f + EMU_FD_BASE for f in process.fds.open_fds()]:
-            if first <= fd <= last:
-                if flags & CLOSE_RANGE_CLOEXEC:
-                    process.fds.set_cloexec(fd - EMU_FD_BASE, True)
-                else:
-                    process.fds.close_fd(host, fd - EMU_FD_BASE)
+        if flags & ~(CLOSE_RANGE_UNSHARE | CLOSE_RANGE_CLOEXEC):
+            # Validate BEFORE touching any fd (Linux returns EINVAL
+            # with nothing closed).
+            return _error(errno.EINVAL)
+        if first > last:
+            return _error(errno.EINVAL)
+        if not (flags & CLOSE_RANGE_UNSHARE):
+            # UNSHARE privatizes the caller's table before closing so
+            # sibling threads keep their fds; our emulated table is
+            # process-shared (CLONE_FILES threads), so the emulated
+            # half is left untouched under UNSHARE (the native
+            # syscall still unshares the native table).
+            for fd in [f + EMU_FD_BASE for f in process.fds.open_fds()]:
+                if first <= fd <= last:
+                    if flags & CLOSE_RANGE_CLOEXEC:
+                        process.fds.set_cloexec(fd - EMU_FD_BASE, True)
+                    else:
+                        process.fds.close_fd(host, fd - EMU_FD_BASE)
         return _native()
 
     def sys_dup(self, host, process, thread, restarted, fd, *_):

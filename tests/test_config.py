@@ -131,3 +131,40 @@ hosts:
     assert d1["hosts"]["alpha"]["processes"][0]["shutdown_signal"] == \
         "SIGINT"
     assert d1["experimental"]["host_cpu_threshold"] == "10000 ns"
+
+
+def test_host_option_defaults():
+    """host_option_defaults (ref configuration.rs:594) apply to every
+    host unless overridden per-host; unsupported keys fail loudly."""
+    import pytest
+    from shadow_tpu.core.config import ConfigOptions
+    base = """
+general: { stop_time: 1s }
+host_option_defaults:
+  pcap_enabled: true
+  pcap_capture_size: 100
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+hosts:
+  a:
+    network_node_id: 0
+    processes: [ { path: udp-sink, args: ["1"], expected_final_state: running } ]
+  b:
+    network_node_id: 0
+    host_options: { pcap_enabled: false }
+    processes: [ { path: udp-sink, args: ["1"], expected_final_state: running } ]
+"""
+    cfg = ConfigOptions.from_yaml_text(base)
+    assert cfg.hosts["a"].pcap_enabled is True
+    assert cfg.hosts["a"].pcap_capture_size == 100
+    assert cfg.hosts["b"].pcap_enabled is False
+
+    with pytest.raises(ValueError, match="unsupported option"):
+        ConfigOptions.from_yaml_text(base.replace(
+            "pcap_enabled: true", "bogus_option: 1"))

@@ -94,3 +94,59 @@ def test_mesh_stdout_matches_serial():
     out_cpu = {(h.name, p.name): bytes(p.stdout) for h in m_cpu.hosts
                for p in h.processes.values()}
     assert out_mesh == out_cpu
+
+
+def test_mesh_sim_with_managed_binaries(tmp_path):
+    """Real (managed) binaries under the SHARDED multi-device backend:
+    curl fetches from the in-sim HTTP server while hosts are partitioned
+    across the 8-device mesh — the syscall-emulation plane and the
+    device exchange compose."""
+    import os
+    import shutil
+    CURL = shutil.which("curl")
+    if CURL is None or shutil.which("cc") is None:
+        pytest.skip("no curl / toolchain")
+    out = str(tmp_path / "fetched")
+    yaml = f"""
+general:
+  stop_time: 30s
+  seed: 11
+  data_directory: {tmp_path / 'data'}
+experimental:
+  scheduler: tpu
+  tpu_shards: 8
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - {{ path: http-server, args: ["80", "40000"],
+           expected_final_state: running }}
+  client:
+    network_node_id: 0
+    processes:
+      - {{ path: {CURL}, args: ["-s", "-o", "{out}", "http://server/"],
+           start_time: 2s }}
+  filler1:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-sink, args: ["7000"],
+           expected_final_state: running }}
+  filler2:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-sink, args: ["7000"],
+           expected_final_state: running }}
+"""
+    cfg = ConfigOptions.from_yaml_text(yaml)
+    manager, summary = run_simulation(cfg)
+    assert summary.ok, summary.plugin_errors
+    assert isinstance(manager.propagator, MeshPropagator)
+    assert os.path.getsize(out) == 40000
