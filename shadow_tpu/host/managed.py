@@ -832,6 +832,8 @@ class ManagedThread:
         if seg.handler:
             ipc.set_sigsegv_action(seg.handler, seg.flags)
         child.parent_pid = parent.pid
+        child.pgid = parent.pgid  # fork inherits process group/session
+        child.sid = parent.sid
         child.strace_mode = parent.strace_mode
         # The child shares the parent's native stdout/stderr fds; it
         # remembers the paths (an exec'd image re-opens them O_APPEND)

@@ -148,6 +148,10 @@ class Process:
         self.parent_pid: int | None = None
         self.zombies: list[int] = []      # exited, unreaped child pids
         self._wait_conds: list = []       # parked wait4 conditions
+        # Job control: top-level processes lead their own group/session;
+        # fork children inherit the parent's (managed.py _do_fork).
+        self.pgid = self.pid
+        self.sid = self.pid
         self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
         self.stderr = bytearray()

@@ -54,6 +54,7 @@ SYS = {
     60: "exit", 61: "wait4", 62: "kill", 63: "uname", 72: "fcntl",
     96: "gettimeofday", 99: "sysinfo", 100: "times", 102: "getuid",
     104: "getgid", 107: "geteuid", 108: "getegid", 110: "getppid",
+    109: "setpgid", 111: "getpgrp", 112: "setsid", 121: "getpgid",
     124: "getsid", 127: "rt_sigpending", 128: "rt_sigtimedwait",
     130: "rt_sigsuspend", 131: "sigaltstack", 157: "prctl",
     186: "gettid", 200: "tkill", 201: "time", 202: "futex",
@@ -1423,8 +1424,49 @@ class NativeSyscallHandler:
     def sys_getppid(self, host, process, thread, restarted, *_):
         return _done(process.parent_pid if process.parent_pid else 1)
 
-    def sys_getsid(self, host, process, thread, restarted, *_):
-        return _done(1)
+    def sys_getsid(self, host, process, thread, restarted, pid=0, *_):
+        target = host.processes.get(pid) if pid else process
+        if target is None:
+            return _error(errno.ESRCH)
+        return _done(target.sid)
+
+    def sys_setsid(self, host, process, thread, restarted, *_):
+        """New session (daemonize step 2): fails for a group leader,
+        exactly like Linux (ref handler/sched-family)."""
+        if process.pgid == process.pid:
+            return _error(errno.EPERM)
+        process.pgid = process.pid
+        process.sid = process.pid
+        return _done(process.sid)
+
+    def sys_setpgid(self, host, process, thread, restarted, pid, pgid, *_):
+        target = host.processes.get(pid) if pid else process
+        if target is None:
+            return _error(errno.ESRCH)
+        if target is not process and target.parent_pid != process.pid:
+            return _error(errno.ESRCH)  # only self or own children
+        if target.sid == target.pid:
+            return _error(errno.EPERM)  # session leaders are immovable
+        if target.sid != process.sid:
+            return _error(errno.EPERM)  # child already in another session
+        pgid = pgid or target.pid
+        # Joining an existing group requires it to live in our session.
+        owner = next((p for p in host.processes.values()
+                      if p.pgid == pgid and not p.exited), None)
+        if pgid != target.pid and (owner is None
+                                   or owner.sid != process.sid):
+            return _error(errno.EPERM)
+        target.pgid = pgid
+        return _done(0)
+
+    def sys_getpgid(self, host, process, thread, restarted, pid=0, *_):
+        target = host.processes.get(pid) if pid else process
+        if target is None:
+            return _error(errno.ESRCH)
+        return _done(target.pgid)
+
+    def sys_getpgrp(self, host, process, thread, restarted, *_):
+        return _done(process.pgid)
 
     def sys_getuid(self, host, process, thread, restarted, *_):
         return _done(1000)
@@ -1620,25 +1662,34 @@ class NativeSyscallHandler:
     def sys_rt_sigreturn(self, host, process, thread, restarted, *_):
         return _native()  # seccomp always allows it; defensive
 
-    def _signal_target(self, host, process, pid: int):
+    def _signal_targets(self, host, process, pid: int):
+        """kill(2) addressing: pid > 0 one process; 0 the caller's
+        process group; -1 everything except the caller (Linux excludes
+        it from broadcast); -pgid that group.  Zombies are included —
+        an unreaped member keeps its group alive for existence probes —
+        but raise_signal no-ops on them."""
         if pid > 0:
-            return host.processes.get(pid)
-        if pid in (0, -1):
-            # Process groups collapse to the caller's own process (each
-            # emulated process is its own group/session).
-            return process
-        return host.processes.get(-pid)
+            t = host.processes.get(pid)
+            return [t] if t is not None else []
+        if pid == 0:
+            return [p for p in host.processes.values()
+                    if p.pgid == process.pgid]
+        if pid == -1:
+            return [p for p in host.processes.values() if p is not process]
+        return [p for p in host.processes.values() if p.pgid == -pid]
 
     def sys_kill(self, host, process, thread, restarted, pid, sig, *_):
         from shadow_tpu.host import signals as S
+        pid = _sext32(pid)
         if sig < 0 or sig >= S.NSIG:
             return _error(errno.EINVAL)
-        target = self._signal_target(host, process, pid)
-        if target is None:
+        targets = self._signal_targets(host, process, pid)
+        if not targets:
             return _error(errno.ESRCH)
         if sig == 0:
             return _done(0)
-        target.raise_signal(host, sig)
+        for target in targets:
+            target.raise_signal(host, sig)
         return _done(0)
 
     def sys_tkill(self, host, process, thread, restarted, tid, sig, *_):
@@ -1849,12 +1900,24 @@ class NativeSyscallHandler:
 
     _WNOHANG = 1
 
+    @staticmethod
+    def _wait_matches(host, process, pid: int, child) -> bool:
+        """waitpid addressing: -1 any child; > 0 that child; 0 children
+        in the CALLER's process group; < -1 children in group |pid|."""
+        if pid == -1:
+            return True
+        if pid > 0:
+            return child.pid == pid
+        if pid == 0:
+            return child.pgid == process.pgid
+        return child.pgid == -pid
+
     def _reap_zombie(self, host, process, pid: int):
         """Pop a matching zombie child; returns (child_pid, status) or
-        None.  pid semantics: -1/0 any child, >0 that child, <-1 any
-        (process groups collapse to the caller's own)."""
+        None."""
         for zpid in process.zombies:
-            if pid > 0 and zpid != pid:
+            if not self._wait_matches(host, process, pid,
+                                      host.processes[zpid]):
                 continue
             process.zombies.remove(zpid)
             child = host.processes[zpid]
@@ -1871,7 +1934,7 @@ class NativeSyscallHandler:
         for p in host.processes.values():
             if p.parent_pid != process.pid:
                 continue
-            if pid > 0 and p.pid != pid:
+            if not self._wait_matches(host, process, pid, p):
                 continue
             if not p.exited or p.pid in process.zombies:
                 return True

@@ -1,0 +1,52 @@
+/* Sessions + process groups: setsid fails for a group leader, succeeds
+ * after fork (daemonize step), and kill(0) targets only the caller's
+ * own (new) process group. */
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t got;
+static void h(int s) { (void)s; got = 1; }
+
+int main(int argc, char **argv) {
+    if (argc > 1 && strcmp(argv[1], "leader") == 0) {
+        /* Under the simulator a top-level process leads its own group,
+         * so setsid must fail EPERM; natively we are a child of the
+         * test runner's shell and would succeed, so the check is
+         * opt-in. */
+        if (setsid() != -1) {
+            puts("FAIL leader-setsid-succeeded");
+            return 1;
+        }
+    }
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = h;
+    sigaction(SIGUSR1, &sa, 0);
+
+    pid_t pid = fork();
+    if (pid == 0) {
+        pid_t sid = setsid();  /* not a leader anymore: must succeed */
+        if (sid != getpid() || getpgrp() != getpid() ||
+            getsid(0) != getpid())
+            _exit(21);
+        kill(0, SIGUSR1);      /* own (new) group only */
+        if (!got)
+            _exit(22);
+        _exit(0);
+    }
+    int status;
+    waitpid(pid, &status, 0);
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) {
+        printf("FAIL child status=%x\n", status);
+        return 2;
+    }
+    if (got) {
+        puts("FAIL group signal leaked to the parent's group");
+        return 3;
+    }
+    puts("session_ok");
+    return 0;
+}

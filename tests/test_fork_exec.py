@@ -36,7 +36,7 @@ def plugin(tmp_path_factory):
     return build
 
 
-def run_one(binary, data_dir, stop="10s"):
+def run_one(binary, data_dir, stop="10s", args=()):
     yaml = f"""
 general:
   stop_time: {stop}
@@ -57,6 +57,7 @@ hosts:
     network_node_id: 0
     processes:
       - path: {binary}
+        args: {list(args)!r}
         start_time: 1s
 """
     cfg = ConfigOptions.from_yaml_text(yaml)
@@ -108,3 +109,16 @@ def test_fork_exec_deterministic(plugin, tmp_path):
         traces.append(blobs)
     assert traces[0] == traces[1]
     assert traces[0]
+
+
+def test_sessions_and_process_groups(plugin, tmp_path):
+    """setsid/setpgid/getpgrp + group-targeted kill(0)
+    (daemonization's job-control surface)."""
+    exe = plugin("session_group")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _, _, procs = run_one(exe, str(tmp_path / "d"), args=("leader",))
+    main = procs[0]
+    assert main.exited and main.exit_code == 0, \
+        bytes(main.stdout) + bytes(main.stderr)
+    assert b"session_ok" in bytes(main.stdout)
