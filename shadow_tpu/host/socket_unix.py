@@ -14,8 +14,12 @@ split, but socket files only matter to other in-sim sockets, and a
 phantom fs entry would leak across hosts.  An app stat()ing its own
 socket file is the known divergence.
 
-SCM_RIGHTS fd passing is not modeled (sendmsg with control data fails
-EINVAL rather than silently dropping fds).
+SCM_RIGHTS fd passing is modeled for EMULATED fds: the transferred
+object rides the message and is registered into the receiver's fd
+table at recvmsg (cross-process works because fd objects are manager-
+side).  Native fds cannot cross (EINVAL — pidfd_getfd plumbing would
+be needed).  Stream ancillary attaches at the sender's byte watermark
+and is delivered with the read that reaches it.
 """
 
 from __future__ import annotations
@@ -47,8 +51,16 @@ class UnixSocket(StatusOwner):
         self._backlog = 0
         self._pending: list = []             # listener: accepted peers
         self._recv_buf = bytearray()         # stream bytes
-        self._dgrams: list = []              # (data, src_name)
+        self._dgrams: list = []              # (data, src_name, anc)
         self._dgram_waiters: list = []       # senders parked on our queue
+        # SCM_RIGHTS in flight: stream ancillary as (watermark, objs)
+        # against the total-bytes counters; dgram ancillary rides the
+        # datagram tuple.  take_ancillary() drains what a recvmsg
+        # delivery reached.
+        self._anc_stream: list = []
+        self._rx_total = 0                   # bytes ever buffered
+        self._rx_read = 0                    # bytes ever consumed
+        self._last_anc: list = []
         self._eof = False
         self._status = S_ACTIVE | (0 if stream else S_WRITABLE)
 
@@ -117,7 +129,8 @@ class UnixSocket(StatusOwner):
 
     # -- data plane ----------------------------------------------------
 
-    def sendto(self, host, data: bytes, dest_name: str | None):
+    def sendto(self, host, data: bytes, dest_name: str | None,
+               anc: list | None = None):
         if self.stream:
             peer = self.peer
             if peer is None:
@@ -129,7 +142,20 @@ class UnixSocket(StatusOwner):
                 self.adjust_status(host, 0, S_WRITABLE)
                 raise BlockingIOError(errno.EWOULDBLOCK, "buffer full")
             take = data[:room]
+            if not take:
+                # Zero-length stream send transfers nothing — including
+                # ancillary fds (Linux queues no skb).
+                if anc:
+                    from shadow_tpu.host.descriptor import _decref
+                    for obj in anc:
+                        _decref(obj, host)
+                return 0
+            if anc:
+                # Attach at the current watermark: delivered with the
+                # read that reaches this byte position.
+                peer._anc_stream.append((peer._rx_total, list(anc)))
             peer._recv_buf += take
+            peer._rx_total += len(take)
             peer.adjust_status(host, S_READABLE, 0)
             if len(peer._recv_buf) >= BUF_MAX:
                 self.adjust_status(host, 0, S_WRITABLE)
@@ -154,7 +180,8 @@ class UnixSocket(StatusOwner):
             if self not in target._dgram_waiters:
                 target._dgram_waiters.append(self)
             raise BlockingIOError(errno.EWOULDBLOCK, "receiver full")
-        target._dgrams.append((bytes(data), self.bound_name or ""))
+        target._dgrams.append((bytes(data), self.bound_name or "",
+                               list(anc) if anc else []))
         target.adjust_status(host, S_READABLE, 0)
         return len(data)
 
@@ -167,8 +194,24 @@ class UnixSocket(StatusOwner):
                 raise BlockingIOError(errno.EWOULDBLOCK, "empty")
             if peek:
                 return bytes(self._recv_buf[:bufsize]), None
-            out = bytes(self._recv_buf[:bufsize])
-            del self._recv_buf[:bufsize]
+            limit = bufsize
+            ws = self._anc_stream
+            if ws:
+                # Linux never returns bytes spanning two SCM scopes: a
+                # read stops before the first boundary (plain data
+                # first), and a read that consumed a boundary stops
+                # before the next one.
+                first = ws[0][0]
+                if self._rx_read < first:
+                    limit = min(limit, first - self._rx_read)
+                elif len(ws) > 1:
+                    limit = min(limit, ws[1][0] - self._rx_read)
+            out = bytes(self._recv_buf[:limit])
+            del self._recv_buf[:limit]
+            self._rx_read += len(out)
+            while self._anc_stream and self._anc_stream[0][0] < \
+                    self._rx_read:
+                self._last_anc.extend(self._anc_stream.pop(0)[1])
             if not self._recv_buf and not self._eof:
                 self.adjust_status(host, 0, S_READABLE)
             peer = self.peer
@@ -178,9 +221,10 @@ class UnixSocket(StatusOwner):
         if not self._dgrams:
             raise BlockingIOError(errno.EWOULDBLOCK, "empty")
         if peek:
-            data, src = self._dgrams[0]
+            data, src, _anc = self._dgrams[0]
             return data[:bufsize], src
-        data, src = self._dgrams.pop(0)
+        data, src, anc = self._dgrams.pop(0)
+        self._last_anc.extend(anc)
         if not self._dgrams:
             self.adjust_status(host, 0, S_READABLE)
         if self._dgram_waiters:
@@ -189,6 +233,13 @@ class UnixSocket(StatusOwner):
                 if not w.has_status(S_CLOSED):
                     w.adjust_status(host, S_WRITABLE, 0)
         return data[:bufsize], src
+
+    def take_ancillary(self) -> list:
+        """Objects delivered by the reads since the last call —
+        consumed by recvmsg; a plain recv discards them (like Linux
+        closing unclaimed SCM_RIGHTS fds)."""
+        out, self._last_anc = self._last_anc, []
+        return out
 
     def bytes_available(self) -> int:
         if self.stream:
@@ -207,6 +258,20 @@ class UnixSocket(StatusOwner):
         if self.bound_name is not None and \
                 host.unix_ns.get(self.bound_name) is self:
             del host.unix_ns[self.bound_name]
+        # Release in-flight SCM_RIGHTS references (Linux closes fds
+        # still riding a destroyed socket) — without this a carried
+        # pipe end never reaches refcount 0 and its reader never sees
+        # EOF.
+        from shadow_tpu.host.descriptor import _decref
+        pending = list(self._last_anc)
+        for _w, objs in self._anc_stream:
+            pending.extend(objs)
+        for _d, _s, objs in self._dgrams:
+            pending.extend(objs)
+        self._last_anc = []
+        self._anc_stream = []
+        for obj in pending:
+            _decref(obj, host)
         peer = self.peer
         if self.listening:
             # Wake connect()ers parked on backlog room; their retry

@@ -443,6 +443,7 @@ class NativeSyscallHandler:
                 return _error(errno.EWOULDBLOCK)
             return _block(SyscallCondition(file=sock, mask=S_READABLE))
         process.mem.write(buf_ptr, data)
+        self._discard_ancillary(host, sock)
         _write_addr(process, addr_ptr, len_ptr, _pack_peer_addr(peer))
         return _done(len(data))
 
@@ -469,6 +470,17 @@ class NativeSyscallHandler:
         return _done(len(data))
 
     @staticmethod
+    def _discard_ancillary(host, sock) -> None:
+        """A plain recv/read consumed bytes carrying SCM_RIGHTS the
+        caller gave no control buffer for: Linux closes those fds."""
+        if isinstance(sock, UnixSocket):
+            objs = sock.take_ancillary()
+            if objs:
+                from shadow_tpu.host.descriptor import _decref
+                for obj in objs:
+                    _decref(obj, host)
+
+    @staticmethod
     def _sock_recv(host, sock, bufsize: int, peek: bool = False):
         """Uniform recv across UDP (datagram+peer) and TCP (stream)."""
         result = sock.recvfrom(host, bufsize, peek=peek)
@@ -485,16 +497,30 @@ class NativeSyscallHandler:
                                                                msg_ptr)
         data = self._gather_iov(process, iov_ptr, iovlen)
         if isinstance(sock, (UnixSocket, NetlinkSocket)):
-            (controllen,) = struct.unpack(
-                "<Q", process.mem.read(msg_ptr + 40, 8))
+            control_ptr, controllen = struct.unpack(
+                "<QQ", process.mem.read(msg_ptr + 32, 16))
+            anc = None
             if controllen and isinstance(sock, UnixSocket):
-                # SCM_RIGHTS fd passing is not modeled; failing loudly
-                # beats silently dropping the fds.
-                return _error(errno.EINVAL)
+                anc = self._parse_scm_rights(process, control_ptr,
+                                             controllen)
+                if anc is None:
+                    return _error(errno.EINVAL)
             dest = None
             if name_ptr and namelen and isinstance(sock, UnixSocket):
                 dest = _unix_name(
                     process.mem.read(name_ptr, min(namelen, 128)))
+            if anc:
+                try:
+                    n = sock.sendto(host, data, dest, anc=anc)
+                except BlockingIOError:
+                    from shadow_tpu.host.descriptor import _decref
+                    for obj in anc:
+                        _decref(obj, host)
+                    if sock.nonblocking or (flags & MSG_DONTWAIT):
+                        return _error(errno.EWOULDBLOCK)
+                    return _block(SyscallCondition(file=sock,
+                                                   mask=S_WRITABLE))
+                return _done(n)
             return self._sock_send(host, process, sock, data, dest,
                                    flags)
         dst = None
@@ -569,6 +595,7 @@ class NativeSyscallHandler:
                                                mask=S_READABLE,
                                                timeout_at=timeout_at))
             self._scatter_iov(process, iov_ptr, iovlen, data)
+            self._discard_ancillary(host, sock)
             if name_ptr:
                 sa = _pack_peer_addr(peer)
                 if sa is not None:
@@ -579,6 +606,70 @@ class NativeSyscallHandler:
                               struct.pack("<I", len(data)))
             got += 1
         return _done(got)
+
+    def _parse_scm_rights(self, process, control_ptr, controllen):
+        """cmsghdr walk: returns the transferred file objects (each
+        incref'd for the in-flight reference), or None on EINVAL —
+        non-SCM_RIGHTS control or a native fd (which cannot ride our
+        channel; pidfd_getfd plumbing would be required)."""
+        from shadow_tpu.host.descriptor import _incref
+        SOL_SOCKET_C, SCM_RIGHTS = 1, 1
+        if controllen > 4096:  # > SCM_MAX_FD-worth: refuse, don't clip
+            return None
+        raw = process.mem.read(control_ptr, controllen)
+        objs = []
+        off = 0
+        while off + 16 <= len(raw):
+            clen, level, ctype = struct.unpack_from("<QII", raw, off)
+            if clen < 16 or off + clen > len(raw) + 7:
+                return None
+            if level != SOL_SOCKET_C or ctype != SCM_RIGHTS:
+                return None
+            nfds = (min(clen, len(raw) - off) - 16) // 4
+            for i in range(nfds):
+                (fd,) = struct.unpack_from("<i", raw, off + 16 + 4 * i)
+                if not self._is_emu(fd):
+                    return None
+                try:
+                    objs.append(self._emu(process, fd))
+                except OSError:
+                    return None
+            off += (clen + 7) & ~7  # CMSG_ALIGN
+        for obj in objs:
+            _incref(obj)
+        return objs
+
+    def _deliver_scm_rights(self, host, process, msg_ptr, objs) -> None:
+        """Register the transferred objects as fresh fds in the
+        receiver and write one SCM_RIGHTS cmsg; discards (like Linux
+        closing unclaimed fds) when no/too-small control buffer, with
+        MSG_CTRUNC in msg_flags."""
+        from shadow_tpu.host.descriptor import _decref
+        MSG_CTRUNC = 0x8
+        control_ptr, controllen = struct.unpack(
+            "<QQ", process.mem.read(msg_ptr + 32, 16))
+        nfit = 0
+        if control_ptr and controllen >= 20:
+            nfit = min(len(objs), (controllen - 16) // 4)
+        # Linux delivers as many fds as fit and truncates the rest.
+        for obj in objs[nfit:]:
+            _decref(obj, host)
+        if nfit == 0:
+            process.mem.write(msg_ptr + 48,
+                              struct.pack("<i", MSG_CTRUNC))
+            process.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
+            return
+        fds = []
+        for obj in objs[:nfit]:
+            fds.append(self._register(process, obj))
+            _decref(obj, host)  # table registration took its own ref
+        cmsg = struct.pack("<QII", 16 + 4 * nfit, 1, 1)
+        cmsg += b"".join(struct.pack("<i", fd) for fd in fds)
+        process.mem.write(control_ptr, cmsg)
+        process.mem.write(msg_ptr + 40, struct.pack("<Q", len(cmsg)))
+        if nfit < len(objs):
+            process.mem.write(msg_ptr + 48,
+                              struct.pack("<i", MSG_CTRUNC))
 
     def sys_recvmsg(self, host, process, thread, restarted, fd, msg_ptr,
                     flags, *_):
@@ -602,6 +693,12 @@ class NativeSyscallHandler:
                 process.mem.write(name_ptr, sa[:_namelen])
                 process.mem.write(msg_ptr + 8,
                                   struct.pack("<I", len(sa)))
+        if isinstance(sock, UnixSocket):
+            objs = sock.take_ancillary()
+            if objs:
+                self._deliver_scm_rights(host, process, msg_ptr, objs)
+            else:
+                process.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
         return _done(len(data))
 
     @staticmethod
@@ -772,6 +869,7 @@ class NativeSyscallHandler:
                 raise OSError(errno.EINVAL, "timerfd read < 8 bytes")
             return struct.pack("<Q", file.read_expirations(host))
         data, _peer = self._sock_recv(host, file, n)
+        self._discard_ancillary(host, file)
         return data
 
     def _file_write(self, host, process, file, data: bytes) -> int:
@@ -1425,6 +1523,9 @@ class NativeSyscallHandler:
         return _done(process.parent_pid if process.parent_pid else 1)
 
     def sys_getsid(self, host, process, thread, restarted, pid=0, *_):
+        pid = _sext32(pid)
+        if pid < 0:
+            return _error(errno.ESRCH)
         target = host.processes.get(pid) if pid else process
         if target is None:
             return _error(errno.ESRCH)
@@ -1440,6 +1541,9 @@ class NativeSyscallHandler:
         return _done(process.sid)
 
     def sys_setpgid(self, host, process, thread, restarted, pid, pgid, *_):
+        pid, pgid = _sext32(pid), _sext32(pgid)
+        if pid < 0 or pgid < 0:
+            return _error(errno.EINVAL)
         target = host.processes.get(pid) if pid else process
         if target is None:
             return _error(errno.ESRCH)
@@ -1460,6 +1564,9 @@ class NativeSyscallHandler:
         return _done(0)
 
     def sys_getpgid(self, host, process, thread, restarted, pid=0, *_):
+        pid = _sext32(pid)
+        if pid < 0:
+            return _error(errno.ESRCH)
         target = host.processes.get(pid) if pid else process
         if target is None:
             return _error(errno.ESRCH)

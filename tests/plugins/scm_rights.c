@@ -1,0 +1,90 @@
+/* SCM_RIGHTS over an emulated unix socketpair: pass one end of a PIPE
+ * to a forked child through sendmsg ancillary data; the child writes
+ * through the received fd and the parent reads it from the pipe. */
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static int send_fd(int sock, int fd) {
+    char data = 'F';
+    struct iovec iov = {.iov_base = &data, .iov_len = 1};
+    union {
+        char buf[CMSG_SPACE(sizeof(int))];
+        struct cmsghdr align;
+    } u;
+    memset(&u, 0, sizeof(u));
+    struct msghdr msg = {.msg_iov = &iov, .msg_iovlen = 1,
+                         .msg_control = u.buf,
+                         .msg_controllen = sizeof(u.buf)};
+    struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+    c->cmsg_level = SOL_SOCKET;
+    c->cmsg_type = SCM_RIGHTS;
+    c->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(c), &fd, sizeof(int));
+    return sendmsg(sock, &msg, 0) == 1 ? 0 : -1;
+}
+
+static int recv_fd(int sock) {
+    char data;
+    struct iovec iov = {.iov_base = &data, .iov_len = 1};
+    union {
+        char buf[CMSG_SPACE(sizeof(int))];
+        struct cmsghdr align;
+    } u;
+    memset(&u, 0, sizeof(u));
+    struct msghdr msg = {.msg_iov = &iov, .msg_iovlen = 1,
+                         .msg_control = u.buf,
+                         .msg_controllen = sizeof(u.buf)};
+    if (recvmsg(sock, &msg, 0) != 1)
+        return -1;
+    struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+    if (!c || c->cmsg_level != SOL_SOCKET || c->cmsg_type != SCM_RIGHTS)
+        return -1;
+    int fd;
+    memcpy(&fd, CMSG_DATA(c), sizeof(int));
+    return fd;
+}
+
+int main(void) {
+    int sv[2];
+    int pfd[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0 || pipe(pfd) != 0) {
+        puts("FAIL setup");
+        return 1;
+    }
+    pid_t pid = fork();
+    if (pid == 0) {
+        close(sv[0]);
+        close(pfd[0]);
+        close(pfd[1]);  /* child's own pipe fds gone: only SCM can help */
+        int wfd = recv_fd(sv[1]);
+        if (wfd < 0)
+            _exit(21);
+        if (write(wfd, "via-scm", 7) != 7)
+            _exit(22);
+        close(wfd);
+        _exit(0);
+    }
+    close(sv[1]);
+    if (send_fd(sv[0], pfd[1]) != 0) {
+        puts("FAIL send_fd");
+        return 2;
+    }
+    close(pfd[1]);  /* our copy; the in-flight/child copy keeps it open */
+    int status;
+    waitpid(pid, &status, 0);
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) {
+        printf("FAIL child status=%x\n", status);
+        return 3;
+    }
+    char buf[16];
+    ssize_t n = read(pfd[0], buf, sizeof buf);
+    if (n != 7 || memcmp(buf, "via-scm", 7)) {
+        printf("FAIL pipe read n=%zd\n", (ssize_t)n);
+        return 4;
+    }
+    puts("scm_ok");
+    return 0;
+}

@@ -154,3 +154,55 @@ def test_curl_deterministic_packet_trace(tmp_path):
         traces.append("\n".join(manager.trace_lines()))
     assert traces[0] == traces[1]
     assert len(traces[0]) > 0
+
+
+@pytest.mark.skipif(not os.path.exists(SYS_PYTHON),
+                    reason="no system python")
+def test_cpython_threads_deterministic(tmp_path):
+    """A threaded CPython program (pthreads, GIL futexes, per-thread
+    channels, emulated sleeps) completes in exact simulated time with
+    identical output across runs."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import threading, time\n"
+        "results = []\n"
+        "lock = threading.Lock()\n"
+        "def work(i):\n"
+        "    time.sleep(0.1 * (i + 1))\n"
+        "    with lock:\n"
+        "        results.append(i)\n"
+        "ts = [threading.Thread(target=work, args=(i,)) "
+        "for i in range(8)]\n"
+        "t0 = time.monotonic()\n"
+        "for t in ts: t.start()\n"
+        "for t in ts: t.join()\n"
+        "dt = time.monotonic() - t0\n"
+        "print('order:', results, 'elapsed:', round(dt, 3))\n")
+    outs = []
+    for i in range(2):
+        yaml = f"""
+general:
+  stop_time: 20s
+  seed: 1
+  data_directory: {tmp_path / f'd{i}'}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - {{ path: {SYS_PYTHON}, args: ["{script}"], start_time: 1s }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+"""
+        cfg = ConfigOptions.from_yaml_text(yaml)
+        manager, summary = run_simulation(cfg)
+        assert summary.ok, summary.plugin_errors
+        proc = next(iter(manager.hosts[0].processes.values()))
+        outs.append(bytes(proc.stdout))
+    assert b"order: [0, 1, 2, 3, 4, 5, 6, 7] elapsed: 0.8" in outs[0]
+    assert outs[0] == outs[1]

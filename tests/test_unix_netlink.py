@@ -92,3 +92,16 @@ def test_getifaddrs_simulated(plugin):
     sim_ip = str(ipaddress.ip_address(host.eth0.ip))
     assert f"eth0 {sim_ip}".encode() in out
     assert b"lo 127.0.0.1" in out
+
+
+def test_scm_rights_fd_passing(plugin):
+    """SCM_RIGHTS across fork: a pipe write-end rides sendmsg ancillary
+    data through an emulated socketpair into the child's fd table; the
+    child writes through it and the parent reads the bytes."""
+    exe = plugin("scm_rights")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _host, proc = run_one(exe)
+    assert proc.exited and proc.exit_code == 0, \
+        bytes(proc.stdout) + bytes(proc.stderr)
+    assert b"scm_ok" in bytes(proc.stdout)
