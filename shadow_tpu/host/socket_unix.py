@@ -171,7 +171,7 @@ class UnixSocket(StatusOwner):
                 raise OSError(errno.ENOTCONN, "no destination")
         if target.has_status(S_CLOSED):
             raise OSError(errno.ECONNREFUSED, "peer closed")
-        queued = sum(len(d) for d, _s in target._dgrams)
+        queued = sum(len(d) for d, _s, _a in target._dgrams)
         if queued + len(data) > BUF_MAX:
             # Park on our own WRITABLE bit; the receiver wakes us when
             # it drains (without this the permanently-set bit would

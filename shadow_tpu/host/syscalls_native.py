@@ -41,7 +41,8 @@ EMU_FD_BASE = 400  # leaves room for select() fd_sets (FD_SETSIZE=1024)
 
 # --- x86-64 syscall numbers (linux-api equivalents we dispatch on) ---
 SYS = {
-    0: "read", 1: "write", 3: "close", 7: "poll", 13: "rt_sigaction",
+    0: "read", 1: "write", 3: "close", 5: "fstat", 7: "poll",
+    8: "lseek", 13: "rt_sigaction",
     14: "rt_sigprocmask", 15: "rt_sigreturn",
     16: "ioctl", 19: "readv", 20: "writev", 22: "pipe", 23: "select",
     24: "sched_yield", 32: "dup", 33: "dup2", 34: "pause", 35: "nanosleep",
@@ -64,7 +65,7 @@ SYS = {
     232: "epoll_wait", 233: "epoll_ctl", 247: "waitid", 257: "openat",
     270: "pselect6", 271: "ppoll", 281: "epoll_pwait", 283: "timerfd_create",
     284: "eventfd", 286: "timerfd_settime", 287: "timerfd_gettime",
-    288: "accept4", 290: "eventfd2", 291: "epoll_create1", 292: "dup3",
+    262: "newfstatat", 288: "accept4", 290: "eventfd2", 291: "epoll_create1", 292: "dup3",
     299: "recvmmsg", 307: "sendmmsg",
     293: "pipe2", 302: "prlimit64", 317: "seccomp", 318: "getrandom",
     332: "statx", 435: "clone3", 436: "close_range",
@@ -520,6 +521,13 @@ class NativeSyscallHandler:
                         return _error(errno.EWOULDBLOCK)
                     return _block(SyscallCondition(file=sock,
                                                    mask=S_WRITABLE))
+                except OSError:
+                    # EPIPE/ENOTCONN/...: the in-flight refs must not
+                    # outlive the failed send.
+                    from shadow_tpu.host.descriptor import _decref
+                    for obj in anc:
+                        _decref(obj, host)
+                    raise
                 return _done(n)
             return self._sock_send(host, process, sock, data, dest,
                                    flags)
@@ -595,7 +603,12 @@ class NativeSyscallHandler:
                                                mask=S_READABLE,
                                                timeout_at=timeout_at))
             self._scatter_iov(process, iov_ptr, iovlen, data)
-            self._discard_ancillary(host, sock)
+            if isinstance(sock, UnixSocket):
+                # recvmmsg does not deliver ancillary; close unclaimed
+                # fds and tell the app its control buffer is empty.
+                self._discard_ancillary(host, sock)
+                process.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
+                process.mem.write(msg_ptr + 48, struct.pack("<i", 0))
             if name_ptr:
                 sa = _pack_peer_addr(peer)
                 if sa is not None:
@@ -667,9 +680,8 @@ class NativeSyscallHandler:
         cmsg += b"".join(struct.pack("<i", fd) for fd in fds)
         process.mem.write(control_ptr, cmsg)
         process.mem.write(msg_ptr + 40, struct.pack("<Q", len(cmsg)))
-        if nfit < len(objs):
-            process.mem.write(msg_ptr + 48,
-                              struct.pack("<i", MSG_CTRUNC))
+        process.mem.write(msg_ptr + 48, struct.pack(
+            "<i", MSG_CTRUNC if nfit < len(objs) else 0))
 
     def sys_recvmsg(self, host, process, thread, restarted, fd, msg_ptr,
                     flags, *_):
@@ -698,7 +710,10 @@ class NativeSyscallHandler:
             if objs:
                 self._deliver_scm_rights(host, process, msg_ptr, objs)
             else:
+                # Linux rewrites controllen AND msg_flags every return;
+                # a reused msghdr must not keep a stale MSG_CTRUNC.
                 process.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
+                process.mem.write(msg_ptr + 48, struct.pack("<i", 0))
         return _done(len(data))
 
     @staticmethod
@@ -941,6 +956,77 @@ class NativeSyscallHandler:
             return _native()
         process.fds.close_fd(host, fd - EMU_FD_BASE)
         return _done(0)
+
+    @staticmethod
+    def _emu_stat_mode(f) -> int:
+        from shadow_tpu.host.files import PipeEnd
+        S_IFIFO, S_IFSOCK = 0o010000, 0o140000
+        if isinstance(f, PipeEnd):
+            return S_IFIFO | 0o600
+        return S_IFSOCK | 0o777  # sockets + anon inodes
+
+    def _write_emu_stat(self, process, f, fd, stat_ptr) -> None:
+        """x86-64 struct stat (144 bytes) for an emulated fd."""
+        st = struct.pack(
+            "<QQQIIIIQqqq",
+            0x53,                 # st_dev
+            0x1000 + fd,          # st_ino: stable per fd
+            1,                    # st_nlink
+            self._emu_stat_mode(f), 1000, 1000, 0,  # mode, uid, gid, pad
+            0,                    # st_rdev
+            0, 4096, 0)           # size, blksize, blocks
+        st += struct.pack("<qqqqqq", 0, 0, 0, 0, 0, 0)  # a/m/ctime
+        process.mem.write(stat_ptr, st + b"\0" * (144 - len(st)))
+
+    def sys_fstat(self, host, process, thread, restarted, fd, stat_ptr,
+                  *_):
+        """Apps fstat sockets/pipes to learn the file type; a native
+        fstat on our fd numbers would be EBADF."""
+        if not self._is_emu(fd):
+            return _native()
+        self._write_emu_stat(process, self._emu(process, fd), fd,
+                             stat_ptr)
+        return _done(0)
+
+    def sys_newfstatat(self, host, process, thread, restarted, dirfd,
+                       path_ptr, stat_ptr, flags, *_):
+        """glibc's fstat() is newfstatat(fd, "", buf, AT_EMPTY_PATH)
+        on modern kernels — route the emulated-fd shape here, leave
+        real path lookups native."""
+        dirfd = _sext32(dirfd)
+        if not self._is_emu(dirfd):
+            return _native()
+        path = process.mem.read_cstr(path_ptr, 256) if path_ptr else b""
+        if path:
+            return _error(errno.ENOTDIR)  # emulated fds aren't dirs
+        self._write_emu_stat(process, self._emu(process, dirfd), dirfd,
+                             stat_ptr)
+        return _done(0)
+
+    def sys_statx(self, host, process, thread, restarted, dirfd,
+                  path_ptr, flags, mask, statx_ptr, *_):
+        dirfd = _sext32(dirfd)
+        if not self._is_emu(dirfd):
+            return _native()
+        path = process.mem.read_cstr(path_ptr, 256) if path_ptr else b""
+        if path:
+            return _error(errno.ENOTDIR)
+        f = self._emu(process, dirfd)
+        STATX_BASIC_STATS = 0x7ff
+        # statx layout: mask(4) blksize(4) attributes(8) nlink(4)
+        # uid(4) gid(4) mode(2) pad(2) ino(8) size(8) blocks(8)
+        # attributes_mask(8); timestamps and dev fields stay zeroed.
+        buf = struct.pack(
+            "<IIQIIIHHQQQQ",
+            STATX_BASIC_STATS, 4096, 0, 1, 1000, 1000,
+            self._emu_stat_mode(f), 0, 0x1000 + dirfd, 0, 0, 0)
+        process.mem.write(statx_ptr, buf + b"\0" * (256 - len(buf)))
+        return _done(0)
+
+    def sys_lseek(self, host, process, thread, restarted, fd, *_):
+        if not self._is_emu(fd):
+            return _native()
+        return _error(errno.ESPIPE)  # sockets/pipes are not seekable
 
     def sys_close_range(self, host, process, thread, restarted, first,
                         last, flags, *_):

@@ -1,0 +1,15 @@
+#include <stdio.h>
+#include <sys/stat.h>
+#include <sys/socket.h>
+#include <unistd.h>
+int main(void) {
+    int s = socket(AF_INET, SOCK_DGRAM, 0);
+    struct stat st;
+    if (fstat(s, &st) != 0) { puts("FAIL fstat"); return 1; }
+    if (!S_ISSOCK(st.st_mode)) { puts("FAIL not-sock"); return 2; }
+    int p[2]; pipe(p);
+    if (fstat(p[0], &st) != 0 || !S_ISFIFO(st.st_mode)) { puts("FAIL fifo"); return 3; }
+    if (lseek(s, 0, 0) != -1) { puts("FAIL lseek"); return 4; }
+    puts("fstat_ok");
+    return 0;
+}

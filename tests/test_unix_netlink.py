@@ -105,3 +105,15 @@ def test_scm_rights_fd_passing(plugin):
     assert proc.exited and proc.exit_code == 0, \
         bytes(proc.stdout) + bytes(proc.stderr)
     assert b"scm_ok" in bytes(proc.stdout)
+
+
+def test_fstat_on_emulated_fds(plugin):
+    """fstat/newfstatat on emulated fds reports S_IFSOCK/S_IFIFO (a
+    native fstat on our fd numbers would be EBADF); lseek is ESPIPE."""
+    exe = plugin("fstat_types")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _host, proc = run_one(exe)
+    assert proc.exited and proc.exit_code == 0, \
+        bytes(proc.stdout) + bytes(proc.stderr)
+    assert b"fstat_ok" in bytes(proc.stdout)
