@@ -63,7 +63,11 @@ class GeneralConfig:
     progress: bool = False
     heartbeat_interval_ns: int = units.parse_time_ns("1 s")
     log_level: str = "info"
-    model_unblocked_syscall_latency: bool = False
+    # Divergence from the reference's default (false): our managed-
+    # process timing baselines are built on the model being active,
+    # and it is what serializes syscall-spinning code into the
+    # deterministic timeline.  Set false to disable.
+    model_unblocked_syscall_latency: bool = True
 
 
 @dataclass
@@ -260,7 +264,7 @@ class ConfigOptions:
             heartbeat_interval_ns=units.parse_time_ns(g.get("heartbeat_interval", "1 s")),
             log_level=str(g.get("log_level", "info")),
             model_unblocked_syscall_latency=bool(
-                g.get("model_unblocked_syscall_latency", False)),
+                g.get("model_unblocked_syscall_latency", True)),
         )
 
         n = raw.get("network", {}) or {}

@@ -121,8 +121,9 @@ class Manager:
                     precision=config.experimental.host_cpu_precision_ns)
                 host.cpu_event_cost_ns = \
                     config.experimental.host_cpu_event_cost_ns
-            host.syscall_latency_ns = \
+            host.syscall_latency_ns = (
                 config.experimental.unblocked_syscall_latency_ns
+                if config.general.model_unblocked_syscall_latency else 0)
             if config.experimental.native_preemption_enabled:
                 host.preempt_native_ns = \
                     config.experimental.native_preemption_native_interval_ns

@@ -604,11 +604,17 @@ class NativeSyscallHandler:
                                                timeout_at=timeout_at))
             self._scatter_iov(process, iov_ptr, iovlen, data)
             if isinstance(sock, UnixSocket):
-                # recvmmsg does not deliver ancillary; close unclaimed
-                # fds and tell the app its control buffer is empty.
-                self._discard_ancillary(host, sock)
-                process.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
-                process.mem.write(msg_ptr + 48, struct.pack("<i", 0))
+                # recvmmsg is recvmsg in a loop: ancillary delivers per
+                # message through the same path.
+                objs = sock.take_ancillary()
+                if objs:
+                    self._deliver_scm_rights(host, process, msg_ptr,
+                                             objs)
+                else:
+                    process.mem.write(msg_ptr + 40,
+                                      struct.pack("<Q", 0))
+                    process.mem.write(msg_ptr + 48,
+                                      struct.pack("<i", 0))
             if name_ptr:
                 sa = _pack_peer_addr(peer)
                 if sa is not None:
@@ -959,18 +965,34 @@ class NativeSyscallHandler:
 
     @staticmethod
     def _emu_stat_mode(f) -> int:
-        from shadow_tpu.host.files import PipeEnd
+        from shadow_tpu.host.files import EventFd, PipeEnd, TimerFd
+        from shadow_tpu.host.epoll import EpollFile
         S_IFIFO, S_IFSOCK = 0o010000, 0o140000
         if isinstance(f, PipeEnd):
             return S_IFIFO | 0o600
-        return S_IFSOCK | 0o777  # sockets + anon inodes
+        if isinstance(f, (EventFd, TimerFd, EpollFile)):
+            return 0o600  # anon inodes: no file-type bits (like Linux)
+        return S_IFSOCK | 0o777
+
+    _emu_ino_counter = [0x1000]
+
+    @classmethod
+    def _emu_ino(cls, f) -> int:
+        """Stable per-OBJECT inode: dup'd / SCM-transferred fds naming
+        the same open file must compare st_ino-equal."""
+        ino = getattr(f, "_emu_ino", None)
+        if ino is None:
+            cls._emu_ino_counter[0] += 1
+            ino = cls._emu_ino_counter[0]
+            f._emu_ino = ino
+        return ino
 
     def _write_emu_stat(self, process, f, fd, stat_ptr) -> None:
         """x86-64 struct stat (144 bytes) for an emulated fd."""
         st = struct.pack(
             "<QQQIIIIQqqq",
             0x53,                 # st_dev
-            0x1000 + fd,          # st_ino: stable per fd
+            self._emu_ino(f),     # st_ino: stable per open file
             1,                    # st_nlink
             self._emu_stat_mode(f), 1000, 1000, 0,  # mode, uid, gid, pad
             0,                    # st_rdev
@@ -1019,7 +1041,7 @@ class NativeSyscallHandler:
         buf = struct.pack(
             "<IIQIIIHHQQQQ",
             STATX_BASIC_STATS, 4096, 0, 1, 1000, 1000,
-            self._emu_stat_mode(f), 0, 0x1000 + dirfd, 0, 0, 0)
+            self._emu_stat_mode(f), 0, self._emu_ino(f), 0, 0, 0)
         process.mem.write(statx_ptr, buf + b"\0" * (256 - len(buf)))
         return _done(0)
 
@@ -1722,10 +1744,12 @@ class NativeSyscallHandler:
     def sys_sched_yield(self, host, process, thread, restarted, *_):
         # The shim forwards one of these per LOCAL_TIME_FORWARD_EVERY
         # locally-answered time reads; bill the batch so time-polling
-        # loops advance the clock (handler/mod.rs:271-321).
-        thread.add_cpu_latency(25_000)
-        if host.cpu is not None:
-            host.cpu.add_delay(25_000)
+        # loops advance the clock (handler/mod.rs:271-321).  Scaled by
+        # the configured per-syscall latency (0 = model disabled).
+        batch_ns = 25 * host.syscall_latency_ns
+        thread.add_cpu_latency(batch_ns)
+        if host.cpu is not None and batch_ns:
+            host.cpu.add_delay(batch_ns)
         return _done(0)
 
     # ------------------------------------------------------------------
