@@ -203,3 +203,59 @@ class TimerFd(StatusOwner):
     def close(self, host) -> None:
         self._generation += 1
         self.adjust_status(host, S_CLOSED, S_ACTIVE | S_READABLE)
+
+
+class SignalFd(StatusOwner):
+    """signalfd(2): queued signals read as signalfd_siginfo records
+    instead of interrupting execution (ref: the reference routes this
+    through its signal plumbing the same way).  Readable whenever the
+    owning process has a pending signal inside the watch mask; reads
+    consume from the pending sets.  Callers typically block the
+    signals first — delivery preference is unchanged (an UNBLOCKED
+    pending signal still interrupts / runs handlers)."""
+
+    def __init__(self, process, mask: int):
+        super().__init__()
+        self.process = process
+        self.mask = mask
+        self.nonblocking = False
+        self._status = S_ACTIVE
+        process.signal_fds.append(self)
+
+    def matching_pending(self):
+        from shadow_tpu.host import signals as S
+        sigs = self.process.signals
+        pend = set(sigs.pending_process)
+        for t in self.process.threads:
+            pend |= getattr(t, "sig_pending", set())
+        return sorted(s for s in pend if self.mask & S.bit(s))
+
+    def refresh(self, host) -> None:
+        if self.matching_pending():
+            self.adjust_status(host, S_READABLE, 0)
+        else:
+            self.adjust_status(host, 0, S_READABLE)
+
+    def read_infos(self, host, max_records: int):
+        import struct as _struct
+        matched = self.matching_pending()[:max_records]
+        if not matched:
+            raise BlockingIOError(11, "no signals pending")
+        out = bytearray()
+        sigs = self.process.signals
+        for signo in matched:
+            sigs.pending_process.discard(signo)
+            for t in self.process.threads:
+                getattr(t, "sig_pending", set()).discard(signo)
+            # signalfd_siginfo: ssi_signo u32 at 0; rest zeroed is
+            # enough for the common "which signal" consumers.
+            rec = _struct.pack("<I", signo) + b"\0" * 124
+            out += rec
+        self.refresh(host)
+        return bytes(out)
+
+    def close(self, host) -> None:
+        if self in self.process.signal_fds:
+            self.process.signal_fds.remove(self)
+        self.adjust_status(host, S_CLOSED,
+                           S_ACTIVE | S_READABLE | S_WRITABLE)

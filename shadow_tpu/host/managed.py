@@ -326,8 +326,6 @@ class ManagedProcess(Process):
         if self.exited or sig <= 0 or sig >= sigmod.NSIG:
             return
         sigs = self.signals
-        if sigs.disposition(sig) == "ignore":
-            return  # discarded at generation time, even if blocked
         if sig == sigmod.SIGKILL:
             self.terminate_by_signal(host, sig)
             return
@@ -342,10 +340,21 @@ class ManagedProcess(Process):
             unblocked = [t for t in live
                          if not (t.sig_mask & sigmod.bit(sig))]
             if not unblocked:
+                # BLOCKED signals queue regardless of disposition
+                # (kernel sig_ignored() is false for blocked signals) —
+                # the sd-event pattern relies on a blocked, default-
+                # ignored SIGCHLD staying pending for signalfd.
                 sigs.pending_process.add(sig)
+                for sfd in self.signal_fds:
+                    sfd.refresh(host)
                 return
             target = min(unblocked, key=lambda t: t.tid)
+        if not (target.sig_mask & sigmod.bit(sig)) and \
+                sigs.disposition(sig) == "ignore":
+            return  # deliverable now and ignored: discarded
         target.sig_pending.add(sig)
+        for sfd in self.signal_fds:
+            sfd.refresh(host)
         if target.sig_mask & sigmod.bit(sig):
             return  # stays pending until the thread unblocks it
         # A sigtimedwait-style waiter consumes the signal directly
@@ -353,6 +362,8 @@ class ManagedProcess(Process):
         if getattr(target, "_sigwait_set", 0) & sigmod.bit(sig) and \
                 target.state == ST_BLOCKED:
             target.sig_pending.discard(sig)
+            for sfd in self.signal_fds:
+                sfd.refresh(host)
             target._sigwait_got = sig
             if target.last_condition is not None:
                 target.last_condition.fire(host)
@@ -576,6 +587,8 @@ class ManagedThread:
             sig = sigs.take_deliverable(self)
             if sig is None:
                 return "none"
+            for sfd in self.process.signal_fds:
+                sfd.refresh(host)
             disp = sigs.disposition(sig)
             if disp == "ignore":
                 continue

@@ -152,6 +152,7 @@ class Process:
         # fork children inherit the parent's (managed.py _do_fork).
         self.pgid = self.pid
         self.sid = self.pid
+        self.signal_fds: list = []  # signalfd(2) watchers
         self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
         self.stderr = bytearray()

@@ -65,7 +65,8 @@ SYS = {
     232: "epoll_wait", 233: "epoll_ctl", 247: "waitid", 257: "openat",
     270: "pselect6", 271: "ppoll", 281: "epoll_pwait", 283: "timerfd_create",
     284: "eventfd", 286: "timerfd_settime", 287: "timerfd_gettime",
-    262: "newfstatat", 288: "accept4", 290: "eventfd2", 291: "epoll_create1", 292: "dup3",
+    262: "newfstatat", 282: "signalfd", 288: "accept4",
+    289: "signalfd4", 290: "eventfd2", 291: "epoll_create1", 292: "dup3",
     299: "recvmmsg", 307: "sendmmsg",
     293: "pipe2", 302: "prlimit64", 317: "seccomp", 318: "getrandom",
     332: "statx", 435: "clone3", 436: "close_range",
@@ -889,6 +890,11 @@ class NativeSyscallHandler:
             if n < 8:
                 raise OSError(errno.EINVAL, "timerfd read < 8 bytes")
             return struct.pack("<Q", file.read_expirations(host))
+        from shadow_tpu.host.files import SignalFd
+        if isinstance(file, SignalFd):
+            if n < 128:
+                raise OSError(errno.EINVAL, "signalfd read < 128 bytes")
+            return file.read_infos(host, n // 128)
         data, _peer = self._sock_recv(host, file, n)
         self._discard_ancillary(host, file)
         return data
@@ -970,29 +976,32 @@ class NativeSyscallHandler:
         S_IFIFO, S_IFSOCK = 0o010000, 0o140000
         if isinstance(f, PipeEnd):
             return S_IFIFO | 0o600
-        if isinstance(f, (EventFd, TimerFd, EpollFile)):
+        from shadow_tpu.host.files import SignalFd
+        if isinstance(f, (EventFd, TimerFd, EpollFile, SignalFd)):
             return 0o600  # anon inodes: no file-type bits (like Linux)
         return S_IFSOCK | 0o777
 
-    _emu_ino_counter = [0x1000]
-
-    @classmethod
-    def _emu_ino(cls, f) -> int:
+    @staticmethod
+    def _emu_ino(f, host) -> int:
         """Stable per-OBJECT inode: dup'd / SCM-transferred fds naming
-        the same open file must compare st_ino-equal."""
+        the same open file must compare st_ino-equal.  Allocated from a
+        per-HOST counter (hosts are single-threaded, so assignment
+        order — and with it every inode value — is deterministic even
+        under the thread-pool schedulers)."""
         ino = getattr(f, "_emu_ino", None)
         if ino is None:
-            cls._emu_ino_counter[0] += 1
-            ino = cls._emu_ino_counter[0]
+            nxt = getattr(host, "_emu_ino_next", 0x1000) + 1
+            host._emu_ino_next = nxt
+            ino = nxt
             f._emu_ino = ino
         return ino
 
-    def _write_emu_stat(self, process, f, fd, stat_ptr) -> None:
+    def _write_emu_stat(self, host, process, f, fd, stat_ptr) -> None:
         """x86-64 struct stat (144 bytes) for an emulated fd."""
         st = struct.pack(
             "<QQQIIIIQqqq",
             0x53,                 # st_dev
-            self._emu_ino(f),     # st_ino: stable per open file
+            self._emu_ino(f, host),  # st_ino: stable per open file
             1,                    # st_nlink
             self._emu_stat_mode(f), 1000, 1000, 0,  # mode, uid, gid, pad
             0,                    # st_rdev
@@ -1006,7 +1015,7 @@ class NativeSyscallHandler:
         fstat on our fd numbers would be EBADF."""
         if not self._is_emu(fd):
             return _native()
-        self._write_emu_stat(process, self._emu(process, fd), fd,
+        self._write_emu_stat(host, process, self._emu(process, fd), fd,
                              stat_ptr)
         return _done(0)
 
@@ -1021,8 +1030,8 @@ class NativeSyscallHandler:
         path = process.mem.read_cstr(path_ptr, 256) if path_ptr else b""
         if path:
             return _error(errno.ENOTDIR)  # emulated fds aren't dirs
-        self._write_emu_stat(process, self._emu(process, dirfd), dirfd,
-                             stat_ptr)
+        self._write_emu_stat(host, process, self._emu(process, dirfd),
+                             dirfd, stat_ptr)
         return _done(0)
 
     def sys_statx(self, host, process, thread, restarted, dirfd,
@@ -1041,7 +1050,7 @@ class NativeSyscallHandler:
         buf = struct.pack(
             "<IIQIIIHHQQQQ",
             STATX_BASIC_STATS, 4096, 0, 1, 1000, 1000,
-            self._emu_stat_mode(f), 0, self._emu_ino(f), 0, 0, 0)
+            self._emu_stat_mode(f), 0, self._emu_ino(f, host), 0, 0, 0)
         process.mem.write(statx_ptr, buf + b"\0" * (256 - len(buf)))
         return _done(0)
 
@@ -1856,6 +1865,8 @@ class NativeSyscallHandler:
             if want & S.bit(s):
                 thread.sig_pending.discard(s)
                 process.signals.pending_process.discard(s)
+                for sfd in process.signal_fds:
+                    sfd.refresh(host)
                 if info_ptr:
                     process.mem.write(info_ptr, struct.pack(
                         "<iii", s, 0, 0) + b"\0" * 116)
@@ -1872,6 +1883,34 @@ class NativeSyscallHandler:
         thread._sigwait_set = want
         from shadow_tpu.host.condition import ManualCondition
         return _block(ManualCondition(timeout_at=timeout_at))
+
+    def sys_signalfd4(self, host, process, thread, restarted, fd,
+                      mask_ptr, sizemask, flags, *_):
+        """signalfd(2): pending signals as readable records (event-loop
+        daemons' signal plumbing).  fd == -1 creates; otherwise the
+        mask of an existing signalfd is replaced."""
+        from shadow_tpu.host.files import SignalFd
+        (mask,) = struct.unpack("<Q", process.mem.read(mask_ptr, 8))
+        fd = _sext32(fd)
+        if fd != -1:
+            if not self._is_emu(fd):
+                return _error(errno.EINVAL)
+            sfd = self._emu(process, fd)
+            if not isinstance(sfd, SignalFd):
+                return _error(errno.EINVAL)
+            sfd.mask = mask
+            sfd.refresh(host)
+            return _done(fd)
+        sfd = SignalFd(process, mask)
+        sfd.nonblocking = bool(flags & O_NONBLOCK)
+        sfd.refresh(host)  # signals may already be pending
+        return _done(self._register(process, sfd,
+                                    cloexec=bool(flags & O_CLOEXEC)))
+
+    def sys_signalfd(self, host, process, thread, restarted, fd,
+                     mask_ptr, sizemask, *_):
+        return self.sys_signalfd4(host, process, thread, restarted, fd,
+                                  mask_ptr, sizemask, 0)
 
     def sys_sigaltstack(self, host, process, thread, restarted, *_):
         return _native()  # only affects native (fault) delivery

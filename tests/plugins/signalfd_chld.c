@@ -1,0 +1,44 @@
+/* The sd-event daemon pattern: block SIGCHLD, watch it via signalfd in
+ * epoll, fork a worker, reap on the signalfd event. */
+#include <stdio.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/signalfd.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+    sigset_t mask;
+    sigemptyset(&mask);
+    sigaddset(&mask, SIGCHLD);
+    sigprocmask(SIG_BLOCK, &mask, 0);
+    int sfd = signalfd(-1, &mask, 0);
+    int ep = epoll_create1(0);
+    struct epoll_event ev = {.events = EPOLLIN, .data.fd = sfd};
+    epoll_ctl(ep, EPOLL_CTL_ADD, sfd, &ev);
+
+    pid_t pid = fork();
+    if (pid == 0) {
+        usleep(50000);
+        _exit(7);
+    }
+    struct epoll_event out;
+    if (epoll_wait(ep, &out, 1, 5000) != 1) {
+        puts("FAIL epoll");
+        return 1;
+    }
+    struct signalfd_siginfo si;
+    if (read(sfd, &si, sizeof si) != sizeof si ||
+        si.ssi_signo != SIGCHLD) {
+        puts("FAIL read");
+        return 2;
+    }
+    int status;
+    if (waitpid(pid, &status, 0) != pid || !WIFEXITED(status) ||
+        WEXITSTATUS(status) != 7) {
+        puts("FAIL reap");
+        return 3;
+    }
+    puts("chld_ok");
+    return 0;
+}

@@ -1,0 +1,38 @@
+/* signalfd(2): block SIGTERM+SIGUSR1, read them as records through an
+ * epoll-driven fd — the event-loop daemon pattern. */
+#include <stdio.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/signalfd.h>
+#include <unistd.h>
+
+int main(void) {
+    sigset_t mask;
+    sigemptyset(&mask);
+    sigaddset(&mask, SIGUSR1);
+    sigaddset(&mask, SIGTERM);
+    sigprocmask(SIG_BLOCK, &mask, 0);
+    int sfd = signalfd(-1, &mask, 0);
+    if (sfd < 0) { puts("FAIL signalfd"); return 1; }
+
+    int ep = epoll_create1(0);
+    struct epoll_event ev = {.events = EPOLLIN, .data.fd = sfd};
+    epoll_ctl(ep, EPOLL_CTL_ADD, sfd, &ev);
+
+    kill(getpid(), SIGUSR1);   /* blocked -> pending -> readable */
+
+    struct epoll_event out;
+    if (epoll_wait(ep, &out, 1, 5000) != 1 || out.data.fd != sfd) {
+        puts("FAIL epoll");
+        return 2;
+    }
+    struct signalfd_siginfo si;
+    if (read(sfd, &si, sizeof si) != sizeof si ||
+        si.ssi_signo != SIGUSR1) {
+        printf("FAIL read signo=%u\n", si.ssi_signo);
+        return 3;
+    }
+    printf("got=%u\n", si.ssi_signo);
+    puts("signalfd_ok");
+    return 0;
+}

@@ -137,3 +137,28 @@ def test_signal_delivery_deterministic(plugin, tmp_path):
         traces.append(strace_files)
     assert traces[0] == traces[1]
     assert traces[0]  # non-empty
+
+
+def test_signalfd_event_loop(plugin):
+    """signalfd + epoll: blocked signals surface as readable records —
+    the event-loop daemon pattern (sd-event style)."""
+    exe = plugin("signalfd_loop")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _, _, proc = run_host_yaml(exe)
+    assert proc.exited and proc.exit_code == 0, \
+        bytes(proc.stdout) + bytes(proc.stderr)
+    assert b"signalfd_ok" in bytes(proc.stdout)
+
+
+def test_signalfd_sigchld_reaping(plugin):
+    """Blocked, default-ignored SIGCHLD must stay pending (kernel
+    sig_ignored() is false for blocked signals) so the sd-event
+    fork/reap-via-signalfd pattern works."""
+    exe = plugin("signalfd_chld")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _, _, proc = run_host_yaml(exe)
+    assert proc.exited and proc.exit_code == 0, \
+        bytes(proc.stdout) + bytes(proc.stderr)
+    assert b"chld_ok" in bytes(proc.stdout)
