@@ -207,55 +207,64 @@ class TimerFd(StatusOwner):
 
 class SignalFd(StatusOwner):
     """signalfd(2): queued signals read as signalfd_siginfo records
-    instead of interrupting execution (ref: the reference routes this
-    through its signal plumbing the same way).  Readable whenever the
-    owning process has a pending signal inside the watch mask; reads
-    consume from the pending sets.  Callers typically block the
-    signals first — delivery preference is unchanged (an UNBLOCKED
-    pending signal still interrupts / runs handlers)."""
+    instead of interrupting execution.  Kernel semantics where they
+    bite: a read drains the READER's pending state (the shared process
+    queue plus the reading thread's own private queue — never another
+    thread's tgkill-directed signal), and an inherited signalfd after
+    fork reads the forked process's signals, not the creator's.
+    Level-triggered readiness tracks the shared queues of every process
+    holding the fd (one status word approximates the kernel's
+    per-caller poll)."""
 
     def __init__(self, process, mask: int):
         super().__init__()
-        self.process = process
+        self.processes = [process]  # every process holding this fd
         self.mask = mask
         self.nonblocking = False
         self._status = S_ACTIVE
         process.signal_fds.append(self)
 
-    def matching_pending(self):
+    def attach(self, process) -> None:
+        """fork: the child holds the same open file description."""
+        if process not in self.processes:
+            self.processes.append(process)
+            process.signal_fds.append(self)
+
+    def _shared_pending(self, process):
         from shadow_tpu.host import signals as S
-        sigs = self.process.signals
-        pend = set(sigs.pending_process)
-        for t in self.process.threads:
-            pend |= getattr(t, "sig_pending", set())
-        return sorted(s for s in pend if self.mask & S.bit(s))
+        return sorted(s for s in process.signals.pending_process
+                      if self.mask & S.bit(s))
 
     def refresh(self, host) -> None:
-        if self.matching_pending():
+        if any(self._shared_pending(p) for p in self.processes
+               if not p.exited):
             self.adjust_status(host, S_READABLE, 0)
         else:
             self.adjust_status(host, 0, S_READABLE)
 
-    def read_infos(self, host, max_records: int):
+    def read_infos(self, host, process, thread, max_records: int):
         import struct as _struct
-        matched = self.matching_pending()[:max_records]
+        from shadow_tpu.host import signals as S
+        pend = set(self._shared_pending(process))
+        tpend = getattr(thread, "sig_pending", set())
+        pend |= {s for s in tpend if self.mask & S.bit(s)}
+        matched = sorted(pend)[:max_records]
         if not matched:
             raise BlockingIOError(11, "no signals pending")
         out = bytearray()
-        sigs = self.process.signals
         for signo in matched:
-            sigs.pending_process.discard(signo)
-            for t in self.process.threads:
-                getattr(t, "sig_pending", set()).discard(signo)
+            process.signals.pending_process.discard(signo)
+            tpend.discard(signo)
             # signalfd_siginfo: ssi_signo u32 at 0; rest zeroed is
             # enough for the common "which signal" consumers.
-            rec = _struct.pack("<I", signo) + b"\0" * 124
-            out += rec
-        self.refresh(host)
+            out += _struct.pack("<I", signo) + b"\0" * 124
+        process.refresh_signal_fds(host)
         return bytes(out)
 
     def close(self, host) -> None:
-        if self in self.process.signal_fds:
-            self.process.signal_fds.remove(self)
+        for p in self.processes:
+            if self in p.signal_fds:
+                p.signal_fds.remove(self)
+        self.processes = []
         self.adjust_status(host, S_CLOSED,
                            S_ACTIVE | S_READABLE | S_WRITABLE)

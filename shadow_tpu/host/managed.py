@@ -345,16 +345,14 @@ class ManagedProcess(Process):
                 # the sd-event pattern relies on a blocked, default-
                 # ignored SIGCHLD staying pending for signalfd.
                 sigs.pending_process.add(sig)
-                for sfd in self.signal_fds:
-                    sfd.refresh(host)
+                self.refresh_signal_fds(host)
                 return
             target = min(unblocked, key=lambda t: t.tid)
         if not (target.sig_mask & sigmod.bit(sig)) and \
                 sigs.disposition(sig) == "ignore":
             return  # deliverable now and ignored: discarded
         target.sig_pending.add(sig)
-        for sfd in self.signal_fds:
-            sfd.refresh(host)
+        self.refresh_signal_fds(host)
         if target.sig_mask & sigmod.bit(sig):
             return  # stays pending until the thread unblocks it
         # A sigtimedwait-style waiter consumes the signal directly
@@ -362,8 +360,7 @@ class ManagedProcess(Process):
         if getattr(target, "_sigwait_set", 0) & sigmod.bit(sig) and \
                 target.state == ST_BLOCKED:
             target.sig_pending.discard(sig)
-            for sfd in self.signal_fds:
-                sfd.refresh(host)
+            self.refresh_signal_fds(host)
             target._sigwait_got = sig
             if target.last_condition is not None:
                 target.last_condition.fire(host)
@@ -436,6 +433,7 @@ class ManagedThread:
         self._pending_call = None      # (num, args) to re-dispatch
         self.last_condition = None
         self._unapplied_ns = 0
+        self.cpu_total_ns = 0  # cumulative modeled CPU (getrusage)
         # Emulated signal state (ref thread.rs:533+ pending signals).
         self.sig_mask = 0              # blocked-signal bitmask
         self.sig_pending: set[int] = set()
@@ -449,6 +447,7 @@ class ManagedThread:
 
     def add_cpu_latency(self, ns: int) -> None:
         self._unapplied_ns += ns
+        self.cpu_total_ns += ns
 
     # -- channel helpers ----------------------------------------------
 
@@ -587,8 +586,7 @@ class ManagedThread:
             sig = sigs.take_deliverable(self)
             if sig is None:
                 return "none"
-            for sfd in self.process.signal_fds:
-                sfd.refresh(host)
+            self.process.refresh_signal_fds(host)
             disp = sigs.disposition(sig)
             if disp == "ignore":
                 continue
@@ -840,6 +838,10 @@ class ManagedThread:
         child.mem = MemoryManager(native_pid)
         WATCHER.register(native_pid, ipc)
         child.fds = parent.fds.fork_copy()
+        from shadow_tpu.host.files import SignalFd
+        for f in child.fds._fds.values():
+            if isinstance(f, SignalFd):
+                f.attach(child)
         child.signals = parent.signals.clone()
         seg = child.signals.action(sigmod.SIGSEGV)
         if seg.handler:

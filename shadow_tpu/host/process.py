@@ -212,6 +212,12 @@ class Process:
                 if parent is not None and not parent.exited:
                     parent.child_exited(host, self)
 
+    def refresh_signal_fds(self, host) -> None:
+        """Re-evaluate level-triggered signalfd readiness after any
+        pending-set mutation (single invariant point)."""
+        for sfd in self.signal_fds:
+            sfd.refresh(host)
+
     def child_exited(self, host, child) -> None:
         """A child became a zombie: wake parked wait4()s, raise SIGCHLD
         (default-ignored unless the app installed a handler)."""

@@ -53,7 +53,7 @@ SYS = {
     52: "getpeername", 53: "socketpair", 54: "setsockopt",
     55: "getsockopt", 56: "clone", 57: "fork", 58: "vfork", 59: "execve",
     60: "exit", 61: "wait4", 62: "kill", 63: "uname", 72: "fcntl",
-    96: "gettimeofday", 99: "sysinfo", 100: "times", 102: "getuid",
+    96: "gettimeofday", 98: "getrusage", 99: "sysinfo", 100: "times", 102: "getuid",
     104: "getgid", 107: "geteuid", 108: "getegid", 110: "getppid",
     109: "setpgid", 111: "getpgrp", 112: "setsid", 121: "getpgid",
     124: "getsid", 127: "rt_sigpending", 128: "rt_sigtimedwait",
@@ -879,7 +879,7 @@ class NativeSyscallHandler:
     # Generic fd I/O
     # ------------------------------------------------------------------
 
-    def _file_read(self, host, process, file, n: int):
+    def _file_read(self, host, process, file, n: int, thread=None):
         if isinstance(file, PipeEnd):
             return file.read_bytes(host, n)
         if isinstance(file, EventFd):
@@ -894,7 +894,7 @@ class NativeSyscallHandler:
         if isinstance(file, SignalFd):
             if n < 128:
                 raise OSError(errno.EINVAL, "signalfd read < 128 bytes")
-            return file.read_infos(host, n // 128)
+            return file.read_infos(host, process, thread, n // 128)
         data, _peer = self._sock_recv(host, file, n)
         self._discard_ancillary(host, file)
         return data
@@ -915,7 +915,8 @@ class NativeSyscallHandler:
             return _native()
         file = self._emu(process, fd)
         try:
-            data = self._file_read(host, process, file, min(count, _MAX_IO))
+            data = self._file_read(host, process, file,
+                                   min(count, _MAX_IO), thread=thread)
         except BlockingIOError:
             if getattr(file, "nonblocking", False):
                 return _error(errno.EWOULDBLOCK)
@@ -943,7 +944,8 @@ class NativeSyscallHandler:
         file = self._emu(process, fd)
         total = sum(l for _b, l in self._iovecs(process, iov_ptr, iovlen))
         try:
-            data = self._file_read(host, process, file, min(total, _MAX_IO))
+            data = self._file_read(host, process, file,
+                                   min(total, _MAX_IO), thread=thread)
         except BlockingIOError:
             if getattr(file, "nonblocking", False):
                 return _error(errno.EWOULDBLOCK)
@@ -1715,6 +1717,32 @@ class NativeSyscallHandler:
         process.mem.write(buf_ptr, data)
         return _done(0)
 
+    def sys_getrusage(self, host, process, thread, restarted, who,
+                      usage_ptr, *_):
+        """Deterministic rusage: a native getrusage would leak real
+        CPU times and fault counts into the simulation.  User time is
+        the modeled CPU the latency model billed; all else is zero
+        except a fixed maxrss."""
+        RUSAGE_SELF, RUSAGE_CHILDREN, RUSAGE_THREAD = 0, -1, 1
+        who = _sext32(who)
+        if who == RUSAGE_SELF:
+            billed = sum(getattr(t, "cpu_total_ns", 0)
+                         for t in process.threads)
+        elif who == RUSAGE_THREAD:
+            billed = getattr(thread, "cpu_total_ns", 0)
+        elif who == RUSAGE_CHILDREN:
+            billed = 0  # reaped-children usage is not accumulated
+        else:
+            return _error(errno.EINVAL)
+        utime_us = billed // 1000
+        # struct rusage: ru_utime, ru_stime (timevals), then 14 longs.
+        buf = struct.pack("<qqqq", utime_us // 10**6, utime_us % 10**6,
+                          0, 0)
+        buf += struct.pack("<q", 16384)  # ru_maxrss (kB), fixed
+        buf += b"\0" * (8 * 13)
+        process.mem.write(usage_ptr, buf)
+        return _done(0)
+
     def sys_sysinfo(self, host, process, thread, restarted, info_ptr, *_):
         up = host.now() // 10**9
         gib = 1 << 30
@@ -1865,8 +1893,7 @@ class NativeSyscallHandler:
             if want & S.bit(s):
                 thread.sig_pending.discard(s)
                 process.signals.pending_process.discard(s)
-                for sfd in process.signal_fds:
-                    sfd.refresh(host)
+                process.refresh_signal_fds(host)
                 if info_ptr:
                     process.mem.write(info_ptr, struct.pack(
                         "<iii", s, 0, 0) + b"\0" * 116)
