@@ -101,5 +101,17 @@ class DescriptorTable:
     def open_fds(self):
         return sorted(self._fds)
 
+    def items(self):
+        """(fd, file) pairs — the public iteration surface."""
+        return list(self._fds.items())
+
+    def replace(self, fd: int, new_file) -> None:
+        """Swap the object behind an fd (fork-time per-process clones
+        like SignalFd); ref accounting moves with it."""
+        old = self._fds[fd]
+        self._fds[fd] = new_file
+        _incref(new_file)
+        _decref(old, None)
+
     def __len__(self):
         return len(self._fds)

@@ -206,38 +206,41 @@ class TimerFd(StatusOwner):
 
 
 class SignalFd(StatusOwner):
-    """signalfd(2): queued signals read as signalfd_siginfo records
-    instead of interrupting execution.  Kernel semantics where they
-    bite: a read drains the READER's pending state (the shared process
-    queue plus the reading thread's own private queue — never another
-    thread's tgkill-directed signal), and an inherited signalfd after
-    fork reads the forked process's signals, not the creator's.
-    Level-triggered readiness tracks the shared queues of every process
-    holding the fd (one status word approximates the kernel's
-    per-caller poll)."""
+    """signalfd(2): queued signals read as signalfd_siginfo records.
+
+    Scope model (one approximation, chosen to be safe): each SignalFd
+    serves exactly ONE process (fork clones the object into the child,
+    diverging from the kernel's shared description only for post-fork
+    mask updates).  Readiness tracks the process's SHARED pending queue
+    only; a read drains the shared queue plus the reading thread's own
+    private queue.  A tgkill-directed blocked signal therefore never
+    shows as poll-readable (the kernel shows it readable to that one
+    thread) — the conservative miss, preferred over either cross-thread
+    signal stealing or a shared status word asserting readability the
+    blocked reader cannot drain (a same-instant wake livelock).
+    """
 
     def __init__(self, process, mask: int):
         super().__init__()
-        self.processes = [process]  # every process holding this fd
+        self.process = process
         self.mask = mask
         self.nonblocking = False
         self._status = S_ACTIVE
         process.signal_fds.append(self)
 
-    def attach(self, process) -> None:
-        """fork: the child holds the same open file description."""
-        if process not in self.processes:
-            self.processes.append(process)
-            process.signal_fds.append(self)
+    def clone_for(self, process) -> "SignalFd":
+        """fork: the child gets its own view bound to itself."""
+        child = SignalFd(process, self.mask)
+        child.nonblocking = self.nonblocking
+        return child
 
-    def _shared_pending(self, process):
+    def _shared_pending(self):
         from shadow_tpu.host import signals as S
-        return sorted(s for s in process.signals.pending_process
+        return sorted(s for s in self.process.signals.pending_process
                       if self.mask & S.bit(s))
 
     def refresh(self, host) -> None:
-        if any(self._shared_pending(p) for p in self.processes
-               if not p.exited):
+        if self._shared_pending():
             self.adjust_status(host, S_READABLE, 0)
         else:
             self.adjust_status(host, 0, S_READABLE)
@@ -245,7 +248,7 @@ class SignalFd(StatusOwner):
     def read_infos(self, host, process, thread, max_records: int):
         import struct as _struct
         from shadow_tpu.host import signals as S
-        pend = set(self._shared_pending(process))
+        pend = set(self._shared_pending())
         tpend = getattr(thread, "sig_pending", set())
         pend |= {s for s in tpend if self.mask & S.bit(s)}
         matched = sorted(pend)[:max_records]
@@ -253,18 +256,16 @@ class SignalFd(StatusOwner):
             raise BlockingIOError(11, "no signals pending")
         out = bytearray()
         for signo in matched:
-            process.signals.pending_process.discard(signo)
+            self.process.signals.pending_process.discard(signo)
             tpend.discard(signo)
             # signalfd_siginfo: ssi_signo u32 at 0; rest zeroed is
             # enough for the common "which signal" consumers.
             out += _struct.pack("<I", signo) + b"\0" * 124
-        process.refresh_signal_fds(host)
+        self.process.refresh_signal_fds(host)
         return bytes(out)
 
     def close(self, host) -> None:
-        for p in self.processes:
-            if self in p.signal_fds:
-                p.signal_fds.remove(self)
-        self.processes = []
+        if self in self.process.signal_fds:
+            self.process.signal_fds.remove(self)
         self.adjust_status(host, S_CLOSED,
                            S_ACTIVE | S_READABLE | S_WRITABLE)

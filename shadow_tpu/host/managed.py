@@ -839,9 +839,11 @@ class ManagedThread:
         WATCHER.register(native_pid, ipc)
         child.fds = parent.fds.fork_copy()
         from shadow_tpu.host.files import SignalFd
-        for f in child.fds._fds.values():
+        for cfd, f in child.fds.items():
             if isinstance(f, SignalFd):
-                f.attach(child)
+                # Each SignalFd serves one process: the child gets its
+                # own view bound to itself (files.py scope model).
+                child.fds.replace(cfd, f.clone_for(child))
         child.signals = parent.signals.clone()
         seg = child.signals.action(sigmod.SIGSEGV)
         if seg.handler:

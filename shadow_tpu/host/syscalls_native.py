@@ -58,7 +58,8 @@ SYS = {
     109: "setpgid", 111: "getpgrp", 112: "setsid", 121: "getpgid",
     124: "getsid", 127: "rt_sigpending", 128: "rt_sigtimedwait",
     130: "rt_sigsuspend", 131: "sigaltstack", 157: "prctl",
-    186: "gettid", 200: "tkill", 201: "time", 202: "futex",
+    186: "gettid", 200: "tkill", 203: "sched_setaffinity",
+    204: "sched_getaffinity", 201: "time", 202: "futex",
     234: "tgkill",
     213: "epoll_create", 218: "set_tid_address", 228: "clock_gettime",
     229: "clock_getres", 230: "clock_nanosleep", 231: "exit_group",
@@ -1776,6 +1777,27 @@ class NativeSyscallHandler:
         thread.add_cpu_latency(ns)
         if host.cpu is not None:
             host.cpu.add_delay(ns)
+        return _done(0)
+
+    def sys_sched_getaffinity(self, host, process, thread, restarted,
+                              tid, cpusetsize, mask_ptr, *_):
+        """One simulated CPU (ref handler/sched.rs): a native answer
+        would leak the real machine's core count, which apps use to
+        size thread pools — nondeterministic across machines."""
+        tid = _sext32(tid)
+        if tid and not any(t.tid == tid for t in process.threads):
+            return _error(errno.ESRCH)
+        if cpusetsize < 8:
+            return _error(errno.EINVAL)
+        process.mem.write(mask_ptr, struct.pack("<Q", 1))
+        return _done(8)  # bytes written, like the kernel
+
+    def sys_sched_setaffinity(self, host, process, thread, restarted,
+                              tid, cpusetsize, mask_ptr, *_):
+        tid = _sext32(tid)
+        if tid and not any(t.tid == tid for t in process.threads):
+            return _error(errno.ESRCH)
+        # Otherwise accepted and inert: one simulated CPU.
         return _done(0)
 
     def sys_sched_yield(self, host, process, thread, restarted, *_):
