@@ -181,6 +181,7 @@ static void shim_run_signal_handler(const shim_event_t *ev) {
         si.si_signo = signum;
         si.si_code = (int)ev->args[2]; /* SI_USER / SI_KERNEL / CLD_* */
         si.si_pid = (int)ev->args[3];
+        si.si_status = (int)ev->args[4]; /* CLD_*: exit code / signal */
         ((void (*)(int, siginfo_t *, void *))handler)(signum, &si, &uc);
     } else {
         ((void (*)(int))handler)(signum);

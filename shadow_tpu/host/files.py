@@ -258,9 +258,14 @@ class SignalFd(StatusOwner):
         for signo in matched:
             self.process.signals.pending_process.discard(signo)
             tpend.discard(signo)
-            # signalfd_siginfo: ssi_signo u32 at 0; rest zeroed is
-            # enough for the common "which signal" consumers.
-            out += _struct.pack("<I", signo) + b"\0" * 124
+            code, pid, status = self.process.signals.take_info(signo)
+            # signalfd_siginfo: ssi_signo u32@0, ssi_errno i32@4,
+            # ssi_code i32@8, ssi_pid u32@12, ssi_uid u32@16,
+            # ssi_fd i32@20, ssi_tid u32@24, ssi_band u32@28,
+            # ssi_overrun u32@32, ssi_trapno u32@36, ssi_status i32@40.
+            out += _struct.pack("<IiiII", signo, 0, code, pid & 0xFFFFFFFF,
+                                0) + b"\0" * 20 + \
+                _struct.pack("<i", status) + b"\0" * 84
         self.process.refresh_signal_fds(host)
         return bytes(out)
 

@@ -31,6 +31,7 @@ import time as _walltime
 from shadow_tpu.core.event import TaskRef
 from shadow_tpu.host import signals as sigmod
 from shadow_tpu.host.child_watcher import WATCHER
+from shadow_tpu.host.condition import SyscallCondition
 from shadow_tpu.host.futex import FutexTable
 from shadow_tpu.host.process import Process, ST_BLOCKED, ST_EXITED, ST_RUNNABLE
 from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
@@ -316,7 +317,8 @@ class ManagedProcess(Process):
     #    shim/src/signals.rs) --------------------------------------------
 
     def raise_signal(self, host, sig: int, target_tid: int | None = None,
-                     si_code: int = 0) -> None:
+                     si_code: int = 0, si_pid: int = 0,
+                     si_status: int = 0) -> None:
         """Queue `sig` for delivery (kill/tgkill/itimer/shutdown_signal).
 
         Delivery is deterministic: the chosen thread gets the signal at
@@ -326,6 +328,7 @@ class ManagedProcess(Process):
         if self.exited or sig <= 0 or sig >= sigmod.NSIG:
             return
         sigs = self.signals
+        siginfo = (si_code, si_pid, si_status)
         if sig == sigmod.SIGKILL:
             self.terminate_by_signal(host, sig)
             return
@@ -344,6 +347,7 @@ class ManagedProcess(Process):
                 # (kernel sig_ignored() is false for blocked signals) —
                 # the sd-event pattern relies on a blocked, default-
                 # ignored SIGCHLD staying pending for signalfd.
+                self._queue_siginfo(sig, siginfo)
                 sigs.pending_process.add(sig)
                 self.refresh_signal_fds(host)
                 return
@@ -351,6 +355,7 @@ class ManagedProcess(Process):
         if not (target.sig_mask & sigmod.bit(sig)) and \
                 sigs.disposition(sig) == "ignore":
             return  # deliverable now and ignored: discarded
+        self._queue_siginfo(sig, siginfo, target)
         target.sig_pending.add(sig)
         self.refresh_signal_fds(host)
         if target.sig_mask & sigmod.bit(sig):
@@ -362,6 +367,7 @@ class ManagedProcess(Process):
             target.sig_pending.discard(sig)
             self.refresh_signal_fds(host)
             target._sigwait_got = sig
+            target._sigwait_info = sigs.take_info(sig)
             if target.last_condition is not None:
                 target.last_condition.fire(host)
             return
@@ -379,6 +385,16 @@ class ManagedProcess(Process):
             # else: the condition already fired and a wakeup task is
             # queued; that resume will deliver the signal first.
         # Runnable threads take it at their next response point.
+
+    def _queue_siginfo(self, sig: int, info: tuple, target=None) -> None:
+        """Kernel semantics for standard signals: one pending instance;
+        the FIRST raiser's siginfo is kept until delivery consumes it —
+        a second raise while pending is merged away."""
+        pending = sig in self.signals.pending_process or \
+            (target is not None and sig in target.sig_pending) or \
+            any(sig in t.sig_pending for t in self.threads)
+        if not pending:
+            self.signals.info[sig] = info
 
     def terminate_by_signal(self, host, sig: int) -> None:
         """Default-action termination (uncaught fatal signal)."""
@@ -442,6 +458,7 @@ class ManagedThread:
         self._suspend_restore = None   # rt_sigsuspend saved mask
         self._sigwait_set = 0          # rt_sigtimedwait watch set
         self._sigwait_got = None
+        self._sigwait_info = (0, 0, 0)
 
     # -- latency model ------------------------------------------------
 
@@ -602,8 +619,13 @@ class ManagedThread:
                 sigs.actions.pop(sig, None)
             resolved = cont(sig) if callable(cont) else cont
             self._post_handler.append((resolved, saved_mask))
+            si_code, si_pid, si_status = sigs.take_info(sig)
+            # The shim builds the handler's siginfo from args[2..4]
+            # (si_code, si_pid, si_status); the ucontext stays zeroed
+            # (docs/PARITY.md).
             self.chan.send_to_shim(EV_SIGNAL, sig,
-                                   (act.handler, act.flags, 0, 0, 0, 0))
+                                   (act.handler, act.flags, si_code,
+                                    si_pid, si_status, 0))
             return "sent"
 
     def _handler_returned(self, host) -> bool:
@@ -629,6 +651,14 @@ class ManagedThread:
         _k, num, args = cont  # ("call", ...) — SA_RESTART re-dispatch
         return self._service(host, num, args, restarted=False)
 
+    def _park(self, host, condition, num: int, args) -> None:
+        """Block this thread on `condition`, re-running (num, args) on
+        wakeup — the single home of the blocking bookkeeping."""
+        self._pending_call = (num, tuple(args))
+        self.last_condition = condition
+        self.state = ST_BLOCKED
+        condition.arm(host, self._wakeup)
+
     def _service(self, host, num: int, args, restarted: bool) -> bool:
         """Dispatch one syscall; returns True to keep pumping events."""
         handler = host.syscall_handler_native
@@ -643,11 +673,7 @@ class ManagedThread:
         kind = result[0]
 
         if kind == "block":
-            condition = result[1]
-            self._pending_call = (num, tuple(args))
-            self.last_condition = condition
-            self.state = ST_BLOCKED
-            condition.arm(host, self._wakeup)
+            self._park(host, result[1], num, args)
             return False
 
         if kind == "clone":
@@ -726,6 +752,17 @@ class ManagedThread:
             if r == "sent":
                 return True
             if r == "dead":
+                return False
+            if restore is not None:
+                # rt_sigsuspend with every pending signal consumed as
+                # ignored (disposition flipped while blocked): no handler
+                # ran, so the kernel would keep waiting with the
+                # temporary mask — re-park instead of returning EINTR,
+                # and keep the saved mask for the eventual real wakeup.
+                from shadow_tpu.core import simtime
+                self._suspend_restore = restore
+                self._park(host, SyscallCondition(
+                    timeout_at=simtime.TIME_NEVER - 1), num, args)
                 return False
 
         lat = host.syscall_latency_ns

@@ -225,11 +225,18 @@ class Process:
         waiters, self._wait_conds = self._wait_conds, []
         for cond in waiters:
             cond.fire(host)
-        from shadow_tpu.host.signals import SIGCHLD
-        self.raise_signal(host, SIGCHLD)
+        from shadow_tpu.host.signals import (CLD_EXITED, CLD_KILLED,
+                                             SIGCHLD)
+        if child.term_signal is not None:
+            code, status = CLD_KILLED, child.term_signal
+        else:
+            code, status = CLD_EXITED, child.exit_code or 0
+        self.raise_signal(host, SIGCHLD, si_code=code, si_pid=child.pid,
+                          si_status=status)
 
     def raise_signal(self, host, sig: int, target_tid=None,
-                     si_code: int = 0) -> None:
+                     si_code: int = 0, si_pid: int = 0,
+                     si_status: int = 0) -> None:
         """Internal (Python) apps have no handler mechanism: non-ignored
         signals apply the default action — terminate (man 7 signal).
         ManagedProcess overrides this with full handler delivery."""

@@ -59,6 +59,14 @@ SIGSYS = 31
 
 NSIG = 64
 
+# siginfo si_code values (asm-generic/siginfo.h) delivered to
+# SA_SIGINFO handlers through the shim's EV_SIGNAL args.
+SI_USER = 0        # kill(2)
+SI_KERNEL = 0x80   # kernel-generated (itimer SIGALRM, ...)
+SI_TKILL = -6      # tgkill(2)
+CLD_EXITED = 1     # child exited normally
+CLD_KILLED = 2     # child terminated by signal
+
 _NAMES = {
     "SIGHUP": SIGHUP, "SIGINT": SIGINT, "SIGQUIT": SIGQUIT,
     "SIGILL": SIGILL, "SIGTRAP": SIGTRAP, "SIGABRT": SIGABRT,
@@ -146,12 +154,15 @@ class ProcessSignals:
     """Per-process emulated signal state (actions are process-wide,
     masks are per-thread and live on the thread objects)."""
 
-    __slots__ = ("actions", "pending_process", "warned_stop")
+    __slots__ = ("actions", "pending_process", "warned_stop", "info")
 
     def __init__(self):
         self.actions: dict[int, SigAction] = {}
         self.pending_process: set[int] = set()
         self.warned_stop = False
+        # Per-pending-signal siginfo: sig -> (si_code, si_pid, si_status).
+        # Standard (non-RT) signals carry one instance, like the kernel.
+        self.info: dict[int, tuple] = {}
 
     def action(self, sig: int) -> SigAction:
         act = self.actions.get(sig)
@@ -195,6 +206,10 @@ class ProcessSignals:
         thread.sig_pending.discard(sig)
         self.pending_process.discard(sig)
         return sig
+
+    def take_info(self, sig: int) -> tuple:
+        """Pop the queued siginfo for `sig`: (si_code, si_pid, si_status)."""
+        return self.info.pop(sig, (0, 0, 0))
 
     def has_deliverable(self, thread) -> bool:
         mask = getattr(thread, "sig_mask", 0)

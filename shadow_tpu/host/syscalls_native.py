@@ -164,6 +164,14 @@ def _pack_sockaddr_in(ip: int, port: int) -> bytes:
         int(ip).to_bytes(4, "big") + b"\0" * 8
 
 
+def _pack_siginfo(signo: int, si_code: int = 0, si_pid: int = 0,
+                  si_status: int = 0) -> bytes:
+    """x86-64 siginfo_t (128 bytes): si_signo@0, si_errno@4, si_code@8,
+    si_pid@16, si_uid@20, si_status@24 (the CLD_* union arm)."""
+    return struct.pack("<iiiiiii", signo, 0, si_code, 0, si_pid, 0,
+                       si_status) + b"\0" * 100
+
+
 def _unix_name(raw: bytes) -> str:
     """sockaddr_un -> namespace key ('@...' = abstract, '' = unnamed);
     `raw` is already trimmed to addrlen, which delimits abstract names."""
@@ -557,8 +565,13 @@ class NativeSyscallHandler:
             data = self._gather_iov(process, iov_ptr, iovlen)
             dst = None
             if name_ptr and namelen:
-                dst = _unpack_sockaddr_in(
-                    process.mem.read(name_ptr, min(namelen, 128)))
+                raw = process.mem.read(name_ptr, min(namelen, 128))
+                # Same family split as sys_sendto/sys_sendmsg: a unix
+                # dgram destination is a namespace key, not (ip, port).
+                if isinstance(sock, UnixSocket):
+                    dst = _unix_name(raw)
+                else:
+                    dst = _unpack_sockaddr_in(raw)
             result = self._sock_send(host, process, sock, data, dst,
                                      flags)
             if result[0] != "done":
@@ -1575,7 +1588,8 @@ class NativeSyscallHandler:
                                                   process.itimer_fire_at)
         else:
             process.itimer_fire_at = None
-        process.raise_signal(host, SIGALRM)
+        from shadow_tpu.host.signals import SI_KERNEL
+        process.raise_signal(host, SIGALRM, si_code=SI_KERNEL)
 
     @staticmethod
     def _itimer_set(host, process, value_ns: int, interval_ns: int) -> None:
@@ -1596,8 +1610,17 @@ class NativeSyscallHandler:
 
     def sys_setitimer(self, host, process, thread, restarted, which,
                       new_ptr, old_ptr, *_):
-        if which != 0:  # ITIMER_REAL only (VIRTUAL/PROF need cpu time)
-            return _error(errno.ENOSYS)
+        if which > 2 or which < 0:
+            return _error(errno.EINVAL)  # Linux: EINVAL for bad `which`
+        if which != 0:  # ITIMER_VIRTUAL/PROF need modeled cpu time
+            from shadow_tpu.utils.shadow_log import LOG
+            LOG.warn_once(f"setitimer-{which}",
+                          f"setitimer(which={which}) accepted but not "
+                          "modeled (no per-process CPU clock); the timer "
+                          "never fires")
+            if old_ptr:  # Linux always fills *old_value on success
+                process.mem.write(old_ptr, self._ITIMERVAL.pack(0, 0, 0, 0))
+            return _done(0)
         if old_ptr:
             rem = self._itimer_remaining_ns(host, process)
             iv = getattr(process, "itimer_interval", 0)
@@ -1614,8 +1637,12 @@ class NativeSyscallHandler:
 
     def sys_getitimer(self, host, process, thread, restarted, which,
                       curr_ptr, *_):
-        if which != 0:
-            return _error(errno.ENOSYS)
+        if which > 2 or which < 0:
+            return _error(errno.EINVAL)
+        if which != 0:  # VIRTUAL/PROF: accepted-but-unmodeled => disarmed
+            if curr_ptr:
+                process.mem.write(curr_ptr, self._ITIMERVAL.pack(0, 0, 0, 0))
+            return _done(0)
         if curr_ptr:
             rem = self._itimer_remaining_ns(host, process)
             iv = getattr(process, "itimer_interval", 0)
@@ -1905,8 +1932,8 @@ class NativeSyscallHandler:
             if got is None:
                 return _error(errno.EAGAIN)  # timed out
             if info_ptr:
-                process.mem.write(info_ptr, struct.pack(
-                    "<iii", got, 0, 0) + b"\0" * 116)
+                process.mem.write(info_ptr, _pack_siginfo(
+                    got, *thread._sigwait_info))
             return _done(got)
         # Already pending?
         pending = sorted(thread.sig_pending |
@@ -1917,8 +1944,8 @@ class NativeSyscallHandler:
                 process.signals.pending_process.discard(s)
                 process.refresh_signal_fds(host)
                 if info_ptr:
-                    process.mem.write(info_ptr, struct.pack(
-                        "<iii", s, 0, 0) + b"\0" * 116)
+                    process.mem.write(info_ptr, _pack_siginfo(
+                        s, *process.signals.take_info(s)))
                 return _done(s)
         timeout_at = None
         if ts_ptr:
@@ -1994,7 +2021,8 @@ class NativeSyscallHandler:
         if sig == 0:
             return _done(0)
         for target in targets:
-            target.raise_signal(host, sig)
+            target.raise_signal(host, sig, si_code=S.SI_USER,
+                                si_pid=process.pid)
         return _done(0)
 
     def sys_tkill(self, host, process, thread, restarted, tid, sig, *_):
@@ -2014,7 +2042,8 @@ class NativeSyscallHandler:
             return _error(errno.ESRCH)
         if sig == 0:
             return _done(0)
-        target.raise_signal(host, sig, target_tid=tid)
+        target.raise_signal(host, sig, target_tid=tid, si_code=S.SI_TKILL,
+                            si_pid=process.pid)
         return _done(0)
 
     def sys_prctl(self, host, process, thread, restarted, option, *rest):
@@ -2080,19 +2109,24 @@ class NativeSyscallHandler:
         path = process.mem.read_cstr(path_ptr, 4096).decode(
             errors="surrogateescape")
 
-        def read_ptr_vec(ptr, limit=1024):
+        def read_ptr_vec(ptr, limit=8192):
             out = []
             for i in range(limit):
                 (p,) = struct.unpack(
                     "<Q", process.mem.read(ptr + 8 * i, 8))
                 if p == 0:
-                    break
+                    return out
                 out.append(process.mem.read_cstr(p, 1 << 17).decode(
                     errors="surrogateescape"))
-            return out
+            # Vector larger than we model: refuse loudly (Linux E2BIG)
+            # rather than exec with a silently clipped argv/environment.
+            raise OSError(errno.E2BIG, "argv/envp exceeds limit")
 
-        argv = read_ptr_vec(argv_ptr) if argv_ptr else []
-        envp = read_ptr_vec(envp_ptr) if envp_ptr else []
+        try:
+            argv = read_ptr_vec(argv_ptr) if argv_ptr else []
+            envp = read_ptr_vec(envp_ptr) if envp_ptr else []
+        except OSError as e:
+            return _error(e.errno)
         return ("execve", path, argv, envp)
 
     def sys_set_tid_address(self, host, process, thread, restarted, addr,

@@ -33,6 +33,14 @@ int main(void) {
         puts("FAIL read");
         return 2;
     }
+    /* The record must carry real reaping info (CLD_EXITED, the child
+     * pid, and its exit status) — the sd-event pattern keys on these. */
+    if (si.ssi_code != CLD_EXITED || (pid_t)si.ssi_pid != pid ||
+        si.ssi_status != 7) {
+        printf("FAIL info code=%d pid=%d status=%d\n",
+               (int)si.ssi_code, (int)si.ssi_pid, (int)si.ssi_status);
+        return 4;
+    }
     int status;
     if (waitpid(pid, &status, 0) != pid || !WIFEXITED(status) ||
         WEXITSTATUS(status) != 7) {

@@ -162,3 +162,16 @@ def test_signalfd_sigchld_reaping(plugin):
     assert proc.exited and proc.exit_code == 0, \
         bytes(proc.stdout) + bytes(proc.stderr)
     assert b"chld_ok" in bytes(proc.stdout)
+
+
+def test_siginfo_fields(plugin):
+    """SA_SIGINFO handlers see real si_code/si_pid/si_status: SI_USER +
+    sender pid for kill(2), CLD_EXITED + child pid + exit code for
+    SIGCHLD (advisor round-2 finding: these were always zero)."""
+    exe = plugin("siginfo_chld")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _, _, proc = run_host_yaml(exe)
+    assert proc.exited and proc.exit_code == 0, \
+        bytes(proc.stdout) + bytes(proc.stderr)
+    assert b"OK siginfo" in bytes(proc.stdout)
