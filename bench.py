@@ -144,7 +144,7 @@ def run_once(build, scheduler: str):
 
     manager = Manager(build(scheduler))
     for h in manager.hosts:
-        h.tracing_enabled = False
+        h.set_tracing(False)
     t0 = time.perf_counter()
     summary = manager.run()
     wall = time.perf_counter() - t0

@@ -1,0 +1,2640 @@
+/* netplane: the native (C++) per-host network data plane.
+ *
+ * Port of shadow_tpu's Python data plane — tcp/connection.py,
+ * host/socket_tcp.py, host/socket_udp.py, net/{codel,token_bucket,
+ * relay,interface,router}.py — behind one CPython extension module.
+ * The Python object path stays the semantic reference; this engine is
+ * the performance path (scheduler=tpu), and the cross-scheduler
+ * byte-diff determinism gates are exactly the parity proof between the
+ * two implementations.
+ *
+ * Reference parity citations live in the Python twins; this file cites
+ * the twin, not the reference, because it is a port of OUR design
+ * (sans-I/O connection + engine-owned timer heap), not of the
+ * reference's C stack (src/main/host/descriptor/tcp.c has a completely
+ * different structure: legacy buffers, priority_queue.c, selectable
+ * events).
+ *
+ * Contract with the Python side (host/plane.py):
+ *  - the engine owns the inet data plane per host: CoDel router queue,
+ *    token-bucket relays, interfaces, TCP/UDP sockets, TCP timers, the
+ *    packet store, and the packet trace;
+ *  - the per-host event-seq and packet-seq counters live HERE; Python's
+ *    Host delegates, so scheduling order (the (time, kind, src, seq)
+ *    total order) is bit-identical to the pure-Python plane;
+ *  - engine-internal timers (TCP, relay refills) form a deadline heap
+ *    merged by Host.execute against the Python event heap;
+ *  - on any socket status change the engine synchronously calls back
+ *    into Python (listeners fire exactly where the object path fires
+ *    them); child-socket birth/death callbacks keep the Python-side
+ *    proxy registry and object-lifecycle accounting in step;
+ *  - host RNG draws (ephemeral ports, ISS) call back into Python so the
+ *    one deterministic per-host stream stays shared.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+/* ---------------- constants (mirror the Python modules) ----------- */
+
+constexpr int PROTO_TCP = 6;
+constexpr int PROTO_UDP = 17;
+constexpr int64_t MTU = 1500;
+constexpr int64_t IPV4_HDR = 20;
+constexpr int64_t UDP_HDR = 8;
+constexpr int64_t TCP_HDR = 20;
+constexpr uint32_t LOCALHOST_IP = (127u << 24) | 1u;  // 127.0.0.1
+constexpr uint32_t INADDR_ANY_ = 0;
+
+constexpr int MSS = 1460;
+constexpr int64_t MAX_WINDOW = 65535;
+constexpr int64_t WMEM_MAX = 4194304;
+constexpr int64_t RMEM_MAX = 6291456;
+constexpr int64_t RMEM_CEILING = 10 * RMEM_MAX;
+constexpr int MAX_SACK_BLOCKS = 3;
+constexpr int64_t INIT_RTO_NS = 1000000000LL;
+constexpr int64_t MIN_RTO_NS = 200000000LL;
+constexpr int64_t MAX_RTO_NS = 60000000000LL;
+constexpr int64_t TIME_WAIT_NS = 60000000000LL;
+constexpr int DUPACK_THRESHOLD = 3;
+constexpr int64_t DELACK_NS = 40000000LL;
+
+constexpr int64_t CODEL_TARGET_NS = 5000000LL;
+constexpr int64_t CODEL_INTERVAL_NS = 100000000LL;
+constexpr size_t CODEL_HARD_LIMIT = 1000;
+constexpr int64_t REFILL_INTERVAL_NS = 1000000LL;
+
+constexpr int EPHEMERAL_LO = 32768;
+constexpr int EPHEMERAL_HI = 65536;
+
+/* status.py bits */
+constexpr uint32_t S_ACTIVE = 1u << 0;
+constexpr uint32_t S_READABLE = 1u << 1;
+constexpr uint32_t S_WRITABLE = 1u << 2;
+constexpr uint32_t S_CLOSED = 1u << 3;
+
+/* TCP flags (net/packet.py TcpFlags) */
+constexpr int F_FIN = 0x01;
+constexpr int F_SYN = 0x02;
+constexpr int F_RST = 0x04;
+constexpr int F_PSH = 0x08;
+constexpr int F_ACK = 0x10;
+
+/* connection.py states */
+enum {
+  ST_CLOSED = 0, ST_LISTEN, ST_SYN_SENT, ST_SYN_RECEIVED, ST_ESTABLISHED,
+  ST_FIN_WAIT_1, ST_FIN_WAIT_2, ST_CLOSING, ST_TIME_WAIT, ST_CLOSE_WAIT,
+  ST_LAST_ACK,
+};
+
+/* host.py trace kinds */
+constexpr int TRACE_SND = 0;
+constexpr int TRACE_DRP = 1;
+constexpr int TRACE_RCV = 2;
+
+/* engine -> Python callback kinds */
+constexpr int CB_STATUS = 0;       // (tok, set_mask, clear_mask)
+constexpr int CB_CHILD_BORN = 1;   // (listener_tok, child_tok)
+constexpr int CB_CHILD_DEAD = 2;   // (tok, 0) pre-accept teardown
+
+/* timer-heap entry kinds */
+constexpr int TK_RELAY = 0;  // target = relay index (0 lo, 1 out, 2 in)
+constexpr int TK_TCP = 1;    // target = socket token
+
+/* sequence-space arithmetic (connection.py seq_*) */
+inline uint32_t seq_add(uint32_t a, int64_t b) {
+  return (uint32_t)(a + (uint64_t)b);
+}
+inline int64_t seq_sub(uint32_t a, uint32_t b) {
+  int64_t d = (int64_t)((uint32_t)(a - b));
+  return d >= (1LL << 31) ? d - (1LL << 32) : d;
+}
+inline bool seq_lt(uint32_t a, uint32_t b) { return seq_sub(a, b) < 0; }
+inline bool seq_leq(uint32_t a, uint32_t b) { return seq_sub(a, b) <= 0; }
+
+inline int64_t isqrt64(int64_t x) {
+  /* floor sqrt via Newton on 64-bit; exact for x < 2^62 (math.isqrt
+   * twin for the CoDel control law). */
+  if (x < 2) return x;
+  int64_t g = (int64_t)std::sqrt((double)x);
+  while (g > 0 && g * g > x) --g;
+  while ((g + 1) * (g + 1) <= x) ++g;
+  return g;
+}
+
+/* choose_window_scale (connection.py) */
+inline int choose_window_scale(int64_t ceiling) {
+  int scale = 0;
+  while (ceiling > MAX_WINDOW && scale < 14) { ceiling >>= 1; ++scale; }
+  return scale;
+}
+
+/* ---------------- packets & trace -------------------------------- */
+
+struct SackBlock { uint32_t start, end; };
+
+struct TcpHdrN {
+  uint32_t seq = 0, ack = 0;
+  int flags = 0;
+  int64_t window = 0;
+  int32_t wscale = -1;  // -1 = option absent
+  int32_t mss = -1;     // -1 = option absent
+  SackBlock sacks[MAX_SACK_BLOCKS];
+  int n_sacks = 0;
+};
+
+struct PacketN {
+  int src_host = -1;
+  uint64_t seq = 0;          // per-source packet seq (trace identity)
+  int proto = PROTO_UDP;
+  uint32_t src_ip = 0, dst_ip = 0;
+  int src_port = 0, dst_port = 0;
+  std::string payload;
+  bool has_tcp = false;
+  TcpHdrN tcp;
+  int64_t priority = 0;
+  uint32_t gen = 0;          // generation for stale-handle detection
+  bool live = false;
+
+  int64_t header_size() const {
+    return IPV4_HDR + (proto == PROTO_TCP ? TCP_HDR : UDP_HDR);
+  }
+  int64_t total_size() const {
+    return header_size() + (int64_t)payload.size();
+  }
+  bool is_empty_control() const { return payload.empty(); }
+};
+
+/* Global (per-Engine) packet store with generation-checked handles:
+ * id = gen<<32 | slot.  Single-owner lifecycle — freed at terminal
+ * points (payload consumed / packet dropped). */
+struct PacketStore {
+  std::vector<PacketN> slots;
+  std::vector<uint32_t> free_list;
+
+  uint64_t alloc() {
+    uint32_t slot;
+    if (!free_list.empty()) { slot = free_list.back(); free_list.pop_back(); }
+    else { slot = (uint32_t)slots.size(); slots.emplace_back(); }
+    PacketN &p = slots[slot];
+    p.live = true;
+    return ((uint64_t)p.gen << 32) | slot;
+  }
+  PacketN *get(uint64_t id) {
+    uint32_t slot = (uint32_t)id, gen = (uint32_t)(id >> 32);
+    if (slot >= slots.size()) return nullptr;
+    PacketN &p = slots[slot];
+    if (!p.live || p.gen != gen) return nullptr;
+    return &p;
+  }
+  void free_pkt(uint64_t id) {
+    PacketN *p = get(id);
+    if (!p) return;
+    p->live = false;
+    p->gen++;
+    p->payload.clear();
+    p->payload.shrink_to_fit();
+    p->has_tcp = false;
+    p->tcp = TcpHdrN{};
+    free_list.push_back((uint32_t)id);
+  }
+};
+
+/* One canonical-trace record; text assembled lazily on export.  The
+ * packet's identity fields are copied so the packet itself can die. */
+struct TraceRec {
+  int64_t time;
+  int kind;           // TRACE_SND/DRP/RCV (tiebreak order)
+  int src_host;
+  uint64_t pkt_seq;
+  int proto;
+  uint32_t src_ip, dst_ip;
+  int src_port, dst_port;
+  int64_t len;
+  const char *extra;  // interned reason or "" (never owned)
+};
+
+/* Interned drop reasons (stable storage for TraceRec.extra). */
+const char *intern_reason(const std::string &s) {
+  static std::unordered_map<std::string, std::unique_ptr<std::string>> tbl;
+  auto it = tbl.find(s);
+  if (it == tbl.end())
+    it = tbl.emplace(s, std::make_unique<std::string>(s)).first;
+  return it->second->c_str();
+}
+
+/* ---------------- TCP connection (tcp/connection.py port) --------- */
+
+struct RtxSeg {
+  uint32_t seq;
+  std::string payload;
+  bool is_fin;
+  int64_t sent_at;
+  bool retransmitted;
+  bool sacked;
+};
+
+struct OutSeg { TcpHdrN hdr; std::string payload; };
+
+/* Byte deque: list of chunks + running length (send_buf/recv_buf). */
+struct ByteDeque {
+  std::deque<std::string> chunks;
+  int64_t len = 0;
+
+  void append(std::string s) { len += (int64_t)s.size(); chunks.push_back(std::move(s)); }
+  /* take up to n bytes from the front (connection.py _take_from_send_buf
+   * / read inner loop). */
+  std::string take(int64_t n) {
+    std::string out;
+    while (n > 0 && !chunks.empty()) {
+      std::string &c = chunks.front();
+      if ((int64_t)c.size() <= n) {
+        n -= (int64_t)c.size();
+        out += c;
+        chunks.pop_front();
+      } else {
+        out.append(c, 0, (size_t)n);
+        c.erase(0, (size_t)n);
+        n = 0;
+      }
+    }
+    len -= (int64_t)out.size();
+    return out;
+  }
+  std::string peek(int64_t n) const {
+    std::string out;
+    for (const auto &c : chunks) {
+      if (n <= 0) break;
+      size_t take = std::min((size_t)n, c.size());
+      out.append(c, 0, take);
+      n -= (int64_t)take;
+    }
+    return out;
+  }
+};
+
+struct TcpConn {
+  int state = ST_CLOSED;
+  uint32_t iss;
+  int wscale_offer;
+
+  /* send side */
+  uint32_t snd_una, snd_nxt;
+  int64_t snd_wnd = MSS;
+  ByteDeque send_buf;
+  int64_t send_buf_max;
+  bool snd_fin_pending = false;
+  int64_t fin_seq = -1;       // -1 = none, else u32 seq
+  std::deque<RtxSeg> rtx;
+
+  /* receive side */
+  uint32_t irs = 0, rcv_nxt = 0;
+  ByteDeque recv_buf;
+  int64_t recv_buf_max;
+  std::unordered_map<uint32_t, std::string> reassembly;
+  int64_t peer_fin_seq = -1, pending_fin_seq = -1;
+
+  int our_wscale = 0, peer_wscale = 0;
+  int eff_mss = MSS;
+
+  bool delayed_ack = true, nagle = true, nodelay = false;
+  int64_t delack_deadline = -1;
+  int segs_since_ack = 0;
+
+  int64_t persist_deadline = -1;
+  int64_t persist_interval = 0;
+
+  /* reno (connection.py RenoCongestion inlined — the only in-tree
+   * algorithm, same as the twin's registry) */
+  int cong_mss = MSS;
+  int64_t cwnd = 10 * MSS;
+  int64_t ssthresh = (1LL << 31) - 1;
+  int dupacks = 0;
+  bool in_fast_recovery = false;
+  uint32_t recover;
+
+  int64_t srtt = 0, rttvar = 0, rto = INIT_RTO_NS;
+  int64_t rto_deadline = -1, time_wait_deadline = -1;
+  int64_t timed_end_seq = -1;  // -1 = none, else u32 seq
+  int64_t timed_sent_at = 0;
+
+  std::deque<OutSeg> outbox;
+  std::string error;  // empty = none
+  int syn_retries = 0;
+
+  int64_t retransmit_count = 0, segments_sent = 0, segments_received = 0,
+          sacked_skip_count = 0;
+
+  TcpConn(uint32_t iss_, int64_t recv_max, int64_t send_max,
+          int64_t window_ceiling /* -1 = use recv_max */)
+      : iss(iss_),
+        wscale_offer(choose_window_scale(
+            window_ceiling >= 0 ? window_ceiling : recv_max)),
+        snd_una(iss_), snd_nxt(iss_),
+        send_buf_max(send_max), recv_buf_max(recv_max),
+        recover(iss_) {}
+
+  /* -- reno ops -- */
+  void cong_reinit(int mss) {
+    cong_mss = mss;
+    cwnd = 10LL * mss;
+    ssthresh = (1LL << 31) - 1;
+  }
+  void cong_on_new_ack(int64_t acked) {
+    if (cwnd < ssthresh) cwnd += std::min(acked, (int64_t)2 * cong_mss);
+    else cwnd += std::max((int64_t)1, (int64_t)cong_mss * cong_mss / cwnd);
+  }
+  void cong_on_fast_retransmit(int64_t flight) {
+    ssthresh = std::max(flight / 2, (int64_t)2 * cong_mss);
+    cwnd = ssthresh + 3LL * cong_mss;
+  }
+  void cong_on_recovery_dupack() { cwnd += cong_mss; }
+  void cong_on_exit_recovery() { cwnd = ssthresh; }
+  void cong_on_rto(int64_t flight) {
+    ssthresh = std::max(flight / 2, (int64_t)2 * cong_mss);
+    cwnd = cong_mss;
+  }
+
+  /* -- app-side API -- */
+  void open_active(int64_t now) {
+    state = ST_SYN_SENT;
+    emit(F_SYN, iss, "", now, /*track=*/true, /*is_fin=*/false, MSS,
+         wscale_offer);
+    snd_nxt = seq_add(iss, 1);
+  }
+
+  int64_t send_space() const { return send_buf_max - send_buf.len; }
+
+  int64_t write(const char *data, int64_t n_in, int64_t now) {
+    /* caller guarantees state/closed checks like socket_tcp.sendto */
+    int64_t n = std::min(n_in, send_space());
+    if (n > 0) {
+      send_buf.append(std::string(data, (size_t)n));
+      push_data(now);
+    }
+    return n;
+  }
+
+  int64_t readable_bytes() const { return recv_buf.len; }
+  bool at_eof() const {
+    return peer_fin_seq >= 0 && recv_buf.len == 0 && reassembly.empty();
+  }
+
+  std::string read(int64_t n, int64_t now) {
+    int64_t window_before = recv_window();
+    std::string out = recv_buf.take(n);
+    if (!out.empty()) {
+      if (window_before < MSS && recv_window() >= MSS &&
+          (state == ST_ESTABLISHED || state == ST_FIN_WAIT_1 ||
+           state == ST_FIN_WAIT_2))
+        emit_ack(now);
+    }
+    return out;
+  }
+
+  void close(int64_t now) {
+    if (state == ST_CLOSED || state == ST_LISTEN) { state = ST_CLOSED; return; }
+    if (state == ST_SYN_SENT) {
+      state = ST_CLOSED;
+      rto_deadline = -1;
+      rtx.clear();
+      return;
+    }
+    if (snd_fin_pending || fin_seq >= 0) return;
+    snd_fin_pending = true;
+    if (state == ST_ESTABLISHED) state = ST_FIN_WAIT_1;
+    else if (state == ST_CLOSE_WAIT) state = ST_LAST_ACK;
+    push_data(now);
+  }
+
+  void abort(int64_t now) {
+    if (state != ST_CLOSED && state != ST_LISTEN && state != ST_TIME_WAIT)
+      emit(F_RST | F_ACK, snd_nxt, "", now);
+    state = ST_CLOSED;
+    if (error.empty()) error = "aborted";
+    rto_deadline = -1;
+    delack_deadline = -1;
+    persist_deadline = -1;
+  }
+
+  /* -- timers -- */
+  int64_t next_timer_expiry() const {
+    int64_t m = -1;
+    for (int64_t t : {rto_deadline, time_wait_deadline, delack_deadline,
+                      persist_deadline})
+      if (t >= 0 && (m < 0 || t < m)) m = t;
+    return m;  // -1 = none
+  }
+
+  void on_timer(int64_t now) {
+    if (time_wait_deadline >= 0 && now >= time_wait_deadline) {
+      time_wait_deadline = -1;
+      if (state == ST_TIME_WAIT) state = ST_CLOSED;
+    }
+    if (delack_deadline >= 0 && now >= delack_deadline) {
+      if (state == ST_CLOSED || state == ST_LISTEN) delack_deadline = -1;
+      else emit_ack(now);
+    }
+    if (persist_deadline >= 0 && now >= persist_deadline) on_persist(now);
+    if (rto_deadline >= 0 && now >= rto_deadline) on_rto(now);
+  }
+
+  void on_persist(int64_t now) {
+    persist_deadline = -1;
+    if (snd_wnd > 0 || send_buf.len == 0 || !rtx.empty()) return;
+    std::string chunk = send_buf.take(1);
+    emit(F_ACK | F_PSH, snd_nxt, chunk, now, /*track=*/true);
+    snd_nxt = seq_add(snd_nxt, 1);
+    persist_interval = std::min(
+        persist_interval > 0 ? persist_interval * 2 : rto, MAX_RTO_NS);
+    persist_deadline = now + persist_interval;
+  }
+
+  void on_rto(int64_t now) {
+    if (rtx.empty()) { rto_deadline = -1; return; }
+    if (state == ST_SYN_SENT || state == ST_SYN_RECEIVED) {
+      if (++syn_retries > 6) {
+        error = "connection timed out";
+        state = ST_CLOSED;
+        rto_deadline = -1;
+        rtx.clear();
+        return;
+      }
+    }
+    int64_t flight = seq_sub(snd_nxt, snd_una);
+    cong_on_rto(flight);
+    dupacks = 0;
+    in_fast_recovery = false;
+    rto = std::min(rto * 2, MAX_RTO_NS);
+    retransmit_one(now);
+    rto_deadline = now + rto;
+  }
+
+  /* -- packet ingress -- */
+  void on_packet(const TcpHdrN &hdr, const std::string &payload,
+                 int64_t now) {
+    segments_received++;
+    if (state == ST_CLOSED) return;
+    if (hdr.flags & F_RST) { on_rst(); return; }
+    if (state == ST_LISTEN) return;
+    if (state == ST_SYN_SENT) { on_packet_syn_sent(hdr, now); return; }
+    if (hdr.flags & F_SYN) {
+      if (state == ST_SYN_RECEIVED &&
+          hdr.seq == (uint32_t)seq_add(rcv_nxt, -1)) {
+        emit_synack(now);
+        return;
+      }
+      emit_ack(now);
+      return;
+    }
+    if (!(hdr.flags & F_ACK)) return;
+    bool pure = payload.empty() && !(hdr.flags & F_FIN);
+    on_ack(hdr, now, pure);
+    if (!payload.empty()) on_data(hdr.seq, payload, now);
+    if (hdr.flags & F_FIN) on_fin(hdr, payload, now);
+  }
+
+  void accept_syn(const TcpHdrN &hdr, int64_t now) {
+    irs = hdr.seq;
+    rcv_nxt = seq_add(hdr.seq, 1);
+    snd_wnd = hdr.window;
+    negotiate_options(hdr);
+    state = ST_SYN_RECEIVED;
+    emit_synack(now);
+    snd_nxt = seq_add(iss, 1);
+  }
+
+  void negotiate_options(const TcpHdrN &hdr) {
+    if (hdr.mss >= 0) {
+      eff_mss = std::min(MSS, (int)hdr.mss);
+      cong_reinit(eff_mss);
+    }
+    if (hdr.wscale >= 0) {
+      our_wscale = wscale_offer;
+      peer_wscale = std::min((int)hdr.wscale, 14);
+    }
+  }
+
+  void emit_synack(int64_t now) {
+    emit(F_SYN | F_ACK, iss, "", now, /*track=*/(snd_nxt == iss),
+         /*is_fin=*/false, MSS, our_wscale ? wscale_offer : -1);
+  }
+
+  void on_packet_syn_sent(const TcpHdrN &hdr, int64_t now) {
+    if ((hdr.flags & (F_SYN | F_ACK)) == (F_SYN | F_ACK)) {
+      if (hdr.ack != snd_nxt) { abort(now); return; }
+      irs = hdr.seq;
+      rcv_nxt = seq_add(hdr.seq, 1);
+      snd_una = hdr.ack;
+      snd_wnd = hdr.window;
+      negotiate_options(hdr);
+      clear_acked(now);
+      state = ST_ESTABLISHED;
+      emit_ack(now);
+    } else if (hdr.flags & F_SYN) {
+      abort(now);  // simultaneous open: not modeled (PARITY.md)
+    }
+  }
+
+  void on_rst() {
+    error = "connection reset";
+    state = ST_CLOSED;
+    rto_deadline = -1;
+    time_wait_deadline = -1;
+    delack_deadline = -1;
+    persist_deadline = -1;
+  }
+
+  void on_ack(const TcpHdrN &hdr, int64_t now, bool is_pure_ack) {
+    uint32_t ack = hdr.ack;
+    if (seq_lt(snd_nxt, ack)) { emit_ack(now); return; }
+    int64_t wnd = hdr.window << peer_wscale;
+    bool window_changed = wnd != snd_wnd;
+    snd_wnd = wnd;
+    if (wnd > 0 && persist_deadline >= 0) {
+      persist_deadline = -1;
+      persist_interval = 0;
+    }
+    if (hdr.n_sacks) mark_sacked(hdr);
+    if (seq_lt(snd_una, ack)) {
+      handle_new_ack(ack, now);
+    } else if (ack == snd_una && !rtx.empty() && is_pure_ack &&
+               !window_changed) {
+      handle_dupack(now);
+    }
+    if (state == ST_SYN_RECEIVED && seq_lt(iss, ack)) state = ST_ESTABLISHED;
+    advance_close_states(now);
+    push_data(now);
+  }
+
+  void handle_new_ack(uint32_t ack, int64_t now) {
+    int64_t acked = seq_sub(ack, snd_una);
+    snd_una = ack;
+    dupacks = 0;
+    int64_t sample = clear_acked(now);
+    if (sample >= 0) {
+      update_rtt(sample);
+    } else if (srtt > 0) {
+      rto = std::min(std::max(srtt + std::max(4 * rttvar, (int64_t)1000000),
+                              MIN_RTO_NS), MAX_RTO_NS);
+    }
+    if (in_fast_recovery) {
+      if (seq_lt(recover, ack) || ack == recover) {
+        in_fast_recovery = false;
+        cong_on_exit_recovery();
+      } else {
+        retransmit_one(now);
+      }
+    } else {
+      cong_on_new_ack(acked);
+    }
+    rto_deadline = rtx.empty() ? -1 : now + rto;
+  }
+
+  void handle_dupack(int64_t now) {
+    dupacks++;
+    if (in_fast_recovery) {
+      cong_on_recovery_dupack();
+      push_data(now);
+    } else if (dupacks == DUPACK_THRESHOLD) {
+      int64_t flight = seq_sub(snd_nxt, snd_una);
+      cong_on_fast_retransmit(flight);
+      in_fast_recovery = true;
+      recover = snd_nxt;
+      retransmit_one(now);
+    }
+  }
+
+  static uint32_t seg_end(const RtxSeg &s) {
+    return seq_add(s.seq, (int64_t)s.payload.size() + (s.is_fin ? 1 : 0) +
+                            (s.payload.empty() && !s.is_fin ? 1 : 0));
+  }
+
+  void mark_sacked(const TcpHdrN &hdr) {
+    for (auto &seg : rtx) {
+      if (seg.sacked) continue;
+      uint32_t end = seg_end(seg);
+      for (int i = 0; i < hdr.n_sacks; i++) {
+        if (seq_leq(hdr.sacks[i].start, seg.seq) &&
+            seq_leq(end, hdr.sacks[i].end)) {
+          seg.sacked = true;
+          sacked_skip_count++;
+          break;
+        }
+      }
+    }
+  }
+
+  void retransmit_one(int64_t now) {
+    if (rtx.empty()) return;
+    RtxSeg *seg = nullptr;
+    for (auto &s : rtx) if (!s.sacked) { seg = &s; break; }
+    if (!seg) seg = &rtx.front();
+    seg->sent_at = now;
+    seg->retransmitted = true;
+    retransmit_count++;
+    transmit_segment(seg->seq, seg->payload, seg->is_fin);
+  }
+
+  /* returns RTT sample ns, or -1 when Karn yields none */
+  int64_t clear_acked(int64_t now) {
+    while (!rtx.empty()) {
+      uint32_t end = seg_end(rtx.front());
+      if (seq_leq(end, snd_una)) rtx.pop_front();
+      else break;
+    }
+    if (timed_end_seq >= 0 && seq_leq((uint32_t)timed_end_seq, snd_una)) {
+      int64_t sample = now - timed_sent_at;
+      timed_end_seq = -1;
+      return sample;
+    }
+    return -1;
+  }
+
+  void update_rtt(int64_t sample) {
+    if (sample <= 0) sample = 1;
+    if (srtt == 0) {
+      srtt = sample;
+      rttvar = sample / 2;
+    } else {
+      int64_t err = std::abs(srtt - sample);
+      rttvar = (3 * rttvar + err) / 4;
+      srtt = (7 * srtt + sample) / 8;
+    }
+    rto = srtt + std::max(4 * rttvar, (int64_t)1000000);
+    rto = std::min(std::max(rto, MIN_RTO_NS), MAX_RTO_NS);
+  }
+
+  /* -- data ingress / reassembly -- */
+  int64_t recv_window() const {
+    int64_t cap = MAX_WINDOW << our_wscale;
+    return std::min(cap, std::max((int64_t)0,
+                                  recv_buf_max - recv_buf.len));
+  }
+
+  int64_t wire_window(int flags) const {
+    int64_t win = recv_window();
+    if (flags & F_SYN) return std::min(win, MAX_WINDOW);
+    return std::min(win >> our_wscale, MAX_WINDOW);
+  }
+
+  void sack_blocks(TcpHdrN &hdr) const {
+    hdr.n_sacks = 0;
+    if (reassembly.empty()) return;
+    std::vector<uint32_t> seqs;
+    seqs.reserve(reassembly.size());
+    for (auto &kv : reassembly) seqs.push_back(kv.first);
+    uint32_t base = rcv_nxt;
+    std::sort(seqs.begin(), seqs.end(), [base](uint32_t a, uint32_t b) {
+      return seq_sub(a, base) < seq_sub(b, base);
+    });
+    std::vector<SackBlock> blocks;
+    bool have = false;
+    uint32_t start = 0, end = 0;
+    for (uint32_t s : seqs) {
+      uint32_t e = seq_add(s, (int64_t)reassembly.at(s).size());
+      if (!have) { start = s; end = e; have = true; }
+      else if (seq_leq(s, end)) { if (seq_lt(end, e)) end = e; }
+      else { blocks.push_back({start, end}); start = s; end = e; }
+    }
+    blocks.push_back({start, end});
+    hdr.n_sacks = (int)std::min((size_t)MAX_SACK_BLOCKS, blocks.size());
+    for (int i = 0; i < hdr.n_sacks; i++) hdr.sacks[i] = blocks[i];
+  }
+
+  void ack_data(int64_t now, bool force) {
+    segs_since_ack++;
+    if (force || !delayed_ack || segs_since_ack >= 2 ||
+        !reassembly.empty() || peer_fin_seq >= 0 ||
+        recv_window() < eff_mss) {
+      emit_ack(now);
+    } else if (delack_deadline < 0) {
+      delack_deadline = now + DELACK_NS;
+    }
+  }
+
+  void on_data(uint32_t seq, const std::string &payload_in, int64_t now) {
+    if (state != ST_ESTABLISHED && state != ST_FIN_WAIT_1 &&
+        state != ST_FIN_WAIT_2)
+      return;
+    std::string trimmed;
+    const std::string *payload = &payload_in;
+    int64_t offset = seq_sub(rcv_nxt, seq);
+    if (offset >= (int64_t)payload_in.size()) { emit_ack(now); return; }
+    if (offset > 0) {
+      trimmed = payload_in.substr((size_t)offset);
+      payload = &trimmed;
+      seq = rcv_nxt;
+    }
+    if (seq != rcv_nxt) {
+      if (seq_sub(seq, rcv_nxt) < recv_buf_max)
+        reassembly.emplace(seq, *payload);  // setdefault: keep first
+      emit_ack(now);
+      return;
+    }
+    bool had_holes = !reassembly.empty();
+    deliver(*payload);
+    for (auto it = reassembly.find(rcv_nxt); it != reassembly.end();
+         it = reassembly.find(rcv_nxt)) {
+      std::string chunk = std::move(it->second);
+      reassembly.erase(it);
+      deliver(chunk);
+    }
+    if (pending_fin_seq >= 0 && (uint32_t)pending_fin_seq == rcv_nxt)
+      process_fin(now);
+    ack_data(now, had_holes);
+  }
+
+  void deliver(const std::string &payload) {
+    int64_t space = recv_buf_max - recv_buf.len;
+    int64_t take = std::min(space, (int64_t)payload.size());
+    if (take > 0) {
+      recv_buf.append(payload.substr(0, (size_t)take));
+      rcv_nxt = seq_add(rcv_nxt, take);
+    }
+  }
+
+  void on_fin(const TcpHdrN &hdr, const std::string &payload, int64_t now) {
+    if (peer_fin_seq >= 0) { emit_ack(now); return; }
+    uint32_t fseq = seq_add(hdr.seq, (int64_t)payload.size());
+    if (fseq != rcv_nxt) {
+      pending_fin_seq = fseq;
+      emit_ack(now);
+      return;
+    }
+    process_fin(now);
+    emit_ack(now);
+  }
+
+  void process_fin(int64_t now) {
+    peer_fin_seq = rcv_nxt;
+    pending_fin_seq = -1;
+    rcv_nxt = seq_add(rcv_nxt, 1);
+    if (state == ST_ESTABLISHED) state = ST_CLOSE_WAIT;
+    else if (state == ST_FIN_WAIT_1) state = ST_CLOSING;
+    else if (state == ST_FIN_WAIT_2) enter_time_wait(now);
+    advance_close_states(now);
+  }
+
+  void advance_close_states(int64_t now) {
+    bool fin_acked = fin_seq >= 0 && seq_lt((uint32_t)fin_seq, snd_una);
+    if (state == ST_FIN_WAIT_1 && fin_acked) state = ST_FIN_WAIT_2;
+    else if (state == ST_CLOSING && fin_acked) enter_time_wait(now);
+    else if (state == ST_LAST_ACK && fin_acked) {
+      state = ST_CLOSED;
+      rto_deadline = -1;
+    }
+  }
+
+  void enter_time_wait(int64_t now) {
+    state = ST_TIME_WAIT;
+    rto_deadline = -1;
+    time_wait_deadline = now + TIME_WAIT_NS;
+  }
+
+  /* -- segment egress -- */
+  int64_t flight() const { return seq_sub(snd_nxt, snd_una); }
+
+  void push_data(int64_t now) {
+    if (state != ST_ESTABLISHED && state != ST_CLOSE_WAIT &&
+        state != ST_FIN_WAIT_1 && state != ST_CLOSING &&
+        state != ST_LAST_ACK)
+      return;
+    int64_t window = std::min(cwnd, snd_wnd);
+    while (send_buf.len > 0 && flight() < window) {
+      int64_t budget = std::min(window - flight(), (int64_t)eff_mss);
+      if (nagle && !nodelay && !snd_fin_pending &&
+          send_buf.len < std::min(budget, (int64_t)eff_mss) &&
+          flight() > 0)
+        break;
+      std::string chunk = send_buf.take(budget);
+      if (chunk.empty()) break;
+      int64_t n = (int64_t)chunk.size();
+      emit(F_ACK | F_PSH, snd_nxt, chunk, now, /*track=*/true);
+      snd_nxt = seq_add(snd_nxt, n);
+    }
+    if (snd_wnd == 0 && send_buf.len > 0 && rtx.empty() &&
+        persist_deadline < 0 &&
+        (state == ST_ESTABLISHED || state == ST_CLOSE_WAIT ||
+         state == ST_FIN_WAIT_1)) {
+      persist_interval = rto;
+      persist_deadline = now + persist_interval;
+    }
+    if (snd_fin_pending && send_buf.len == 0 && fin_seq < 0) {
+      fin_seq = snd_nxt;
+      emit(F_FIN | F_ACK, snd_nxt, "", now, /*track=*/true, /*is_fin=*/true);
+      snd_nxt = seq_add(snd_nxt, 1);
+    }
+  }
+
+  void transmit_segment(uint32_t seq, const std::string &payload,
+                        bool is_fin) {
+    timed_end_seq = -1;  // Karn
+    int flags = F_ACK;
+    int mss_opt = -1, ws_opt = -1;
+    if (is_fin) {
+      flags |= F_FIN;
+    } else if (payload.empty() && seq == iss) {
+      flags = F_SYN;
+      mss_opt = MSS;
+      ws_opt = wscale_offer;
+      if (state == ST_SYN_RECEIVED) {
+        flags = F_SYN | F_ACK;
+        ws_opt = our_wscale ? wscale_offer : -1;
+      }
+    } else if (!payload.empty()) {
+      flags |= F_PSH;
+    }
+    OutSeg seg;
+    seg.hdr.seq = seq;
+    seg.hdr.ack = rcv_nxt;
+    seg.hdr.flags = flags;
+    seg.hdr.window = wire_window(flags);
+    seg.hdr.mss = mss_opt;
+    seg.hdr.wscale = ws_opt;
+    sack_blocks(seg.hdr);
+    seg.payload = payload;
+    outbox.push_back(std::move(seg));
+    segments_sent++;
+    note_ack_sent();
+  }
+
+  void emit(int flags, uint32_t seq, const std::string &payload, int64_t now,
+            bool track = false, bool is_fin = false, int mss_opt = -1,
+            int ws_opt = -1) {
+    OutSeg seg;
+    seg.hdr.seq = seq;
+    seg.hdr.ack = (flags & F_ACK) ? rcv_nxt : 0;
+    seg.hdr.flags = flags;
+    seg.hdr.window = wire_window(flags);
+    seg.hdr.mss = mss_opt;
+    seg.hdr.wscale = ws_opt;
+    seg.payload = payload;
+    outbox.push_back(std::move(seg));
+    segments_sent++;
+    if (flags & F_ACK) note_ack_sent();
+    if (track) {
+      rtx.push_back({seq, payload, is_fin, now, false, false});
+      if (rto_deadline < 0) rto_deadline = now + rto;
+      if (timed_end_seq < 0) {
+        timed_end_seq = seq_add(seq, (int64_t)payload.size() +
+                                          (is_fin ? 1 : 0) +
+                                          (payload.empty() && !is_fin ? 1 : 0));
+        timed_sent_at = now;
+      }
+    }
+  }
+
+  void note_ack_sent() {
+    segs_since_ack = 0;
+    delack_deadline = -1;
+  }
+
+  void emit_ack(int64_t now) {
+    (void)now;
+    OutSeg seg;
+    seg.hdr.seq = snd_nxt;
+    seg.hdr.ack = rcv_nxt;
+    seg.hdr.flags = F_ACK;
+    seg.hdr.window = wire_window(F_ACK);
+    sack_blocks(seg.hdr);
+    outbox.push_back(std::move(seg));
+    segments_sent++;
+    note_ack_sent();
+  }
+};
+
+/* ---------------- token bucket (net/token_bucket.py) -------------- */
+
+struct TokenBucketN {
+  int64_t capacity = 0, refill_size = 0, refill_interval = REFILL_INTERVAL_NS;
+  int64_t balance = 0, next_refill = 0;
+  bool unlimited = true;  // loopback relay has no bucket
+
+  void config_for_bandwidth(int64_t bits_per_sec, int64_t mtu) {
+    int64_t per = (bits_per_sec * REFILL_INTERVAL_NS) / (8 * 1000000000LL);
+    refill_size = std::max(per, (int64_t)1);
+    capacity = std::max(refill_size, mtu);
+    balance = capacity;
+    unlimited = false;
+  }
+  void advance(int64_t now) {
+    if (next_refill == 0) { next_refill = now + refill_interval; return; }
+    if (now >= next_refill) {
+      int64_t k = 1 + (now - next_refill) / refill_interval;
+      balance = std::min(capacity, balance + k * refill_size);
+      next_refill += k * refill_interval;
+    }
+  }
+  /* try_remove: ok => true; else *when = next refill time */
+  bool try_remove(int64_t size, int64_t now, int64_t *when) {
+    advance(now);
+    if (size <= balance) { balance -= size; return true; }
+    *when = next_refill;
+    return false;
+  }
+};
+
+/* ---------------- CoDel (net/codel.py) ---------------------------- */
+
+struct HostPlane;  // fwd
+struct Engine;     // fwd
+
+struct CoDelN {
+  std::deque<std::pair<uint64_t, int64_t>> q;  // (pkt id, enqueue time)
+  int64_t bytes = 0;
+  bool dropping = false;
+  int64_t count = 0, last_count = 0;
+  int64_t first_above = 0, drop_next = 0;
+  int64_t dropped_count = 0;
+
+  static int64_t control_time(int64_t t, int64_t count) {
+    return t + ((CODEL_INTERVAL_NS << 16) / isqrt64(count << 32));
+  }
+  /* push returns false only at the hard limit (caller drops+traces) */
+  bool push(uint64_t id, int64_t size, int64_t now) {
+    if (q.size() >= CODEL_HARD_LIMIT) { dropped_count++; return false; }
+    q.emplace_back(id, now);
+    bytes += size;
+    return true;
+  }
+  /* dequeue_raw: returns pkt id or UINT64_MAX; *ok = drop-state flag */
+  uint64_t dequeue_raw(int64_t now, PacketStore &store, bool *ok) {
+    if (q.empty()) { first_above = 0; *ok = false; return UINT64_MAX; }
+    auto [id, enq] = q.front();
+    q.pop_front();
+    bytes -= store.get(id)->total_size();
+    int64_t sojourn = now - enq;
+    if (sojourn < CODEL_TARGET_NS || bytes <= MTU) {
+      first_above = 0; *ok = false; return id;
+    }
+    if (first_above == 0) {
+      first_above = now + CODEL_INTERVAL_NS; *ok = false; return id;
+    }
+    *ok = now >= first_above;
+    return id;
+  }
+};
+
+/* ---------------- sockets ---------------------------------------- */
+
+struct TcpSocketN;
+struct UdpSocketN;
+
+struct SocketN {
+  int proto;
+  int host;           // host id
+  uint32_t tok = 0;   // own token (index in Engine::socks)
+  bool has_local = false; uint32_t local_ip = 0; int local_port = 0;
+  bool has_peer = false; uint32_t peer_ip = 0; int peer_port = 0;
+  bool nonblocking = false;
+  uint32_t status = S_ACTIVE;
+  uint8_t ifaces_mask = 0;  // association mask: bit0 lo, bit1 eth0
+  bool queued[2] = {false, false};
+  explicit SocketN(int proto_, int host_) : proto(proto_), host(host_) {}
+  virtual ~SocketN() = default;
+};
+
+struct TcpSocketN : SocketN {
+  bool nodelay = false;
+  int64_t send_buf_max, recv_buf_max;
+  bool send_autotune, recv_autotune;
+  int64_t at_bytes_copied = 0, at_space = 0, at_last_adjust = 0;
+  int iface = -1;  // stream iface: 0 lo, 1 eth0
+  std::unique_ptr<TcpConn> conn;
+  bool listening = false;
+  int backlog = 0;
+  std::deque<uint32_t> accept_q;  // child tokens
+  int32_t listener = -1;          // backref token
+  bool accept_queued = false, delivered = false;
+  bool app_closed = false;        // fd released by the app
+  std::deque<uint64_t> out_packets[2];
+  int64_t timer_deadline = -1;
+
+  TcpSocketN(int host_, int64_t sb, int64_t rb, bool sat, bool rat)
+      : SocketN(PROTO_TCP, host_), send_buf_max(sb), recv_buf_max(rb),
+        send_autotune(sat), recv_autotune(rat) {}
+};
+
+struct UdpSocketN : SocketN {
+  std::deque<uint64_t> send_q[2];
+  int64_t send_bytes = 0, send_max;
+  std::deque<uint64_t> recv_q;
+  int64_t recv_bytes = 0, recv_max;
+  int64_t drops_full_recv = 0;
+
+  UdpSocketN(int host_, int64_t sb, int64_t rb)
+      : SocketN(PROTO_UDP, host_), send_max(sb), recv_max(rb) {
+    status = S_ACTIVE | S_WRITABLE;
+  }
+};
+
+/* ---------------- interface (net/interface.py) -------------------- */
+
+struct AssocKey {
+  uint32_t ip, peer_ip;
+  uint16_t port, peer_port;
+  uint8_t proto;
+  bool operator==(const AssocKey &o) const {
+    return ip == o.ip && peer_ip == o.peer_ip && port == o.port &&
+           peer_port == o.peer_port && proto == o.proto;
+  }
+};
+struct AssocHash {
+  size_t operator()(const AssocKey &k) const {
+    uint64_t a = ((uint64_t)k.ip << 32) | k.peer_ip;
+    uint64_t b = ((uint64_t)k.proto << 32) | ((uint64_t)k.port << 16) |
+                 k.peer_port;
+    a ^= b + 0x9e3779b97f4a7c15ULL + (a << 6) + (a >> 2);
+    return (size_t)a;
+  }
+};
+
+struct IfaceN {
+  uint32_t ip;
+  int idx;  // 0 lo, 1 eth0
+  std::unordered_map<AssocKey, uint32_t, AssocHash> assoc;  // -> token
+  /* fifo qdisc: min-heap on (priority, token). Priorities are per-host
+   * packet seqs (unique), so ties cannot happen — matching the Python
+   * heap whose id(socket) tiebreak is therefore never consulted. */
+  std::vector<std::pair<int64_t, uint32_t>> send_heap;
+  std::deque<uint32_t> send_ready;  // round_robin order
+  int64_t packets_sent = 0, packets_received = 0;
+  int64_t bytes_sent = 0, bytes_received = 0;
+
+  static bool heap_less(const std::pair<int64_t, uint32_t> &a,
+                        const std::pair<int64_t, uint32_t> &b) {
+    return a.first > b.first;  // min-heap via greater
+  }
+  void heap_push(int64_t prio, uint32_t tok) {
+    send_heap.emplace_back(prio, tok);
+    std::push_heap(send_heap.begin(), send_heap.end(), heap_less);
+  }
+  uint32_t heap_pop() {
+    std::pop_heap(send_heap.begin(), send_heap.end(), heap_less);
+    uint32_t tok = send_heap.back().second;
+    send_heap.pop_back();
+    return tok;
+  }
+};
+
+/* ---------------- relay (net/relay.py) ---------------------------- */
+
+constexpr int RELAY_IDLE = 0;
+constexpr int RELAY_PENDING = 1;
+
+struct RelayN {
+  int state = RELAY_IDLE;
+  uint64_t pending = UINT64_MAX;  // parked packet id
+  TokenBucketN bucket;            // unlimited for loopback
+  int src;                        // 0: lo iface, 1: eth iface, 2: router
+};
+
+/* ---------------- per-host plane ---------------------------------- */
+
+struct TimerEnt {
+  int64_t time;
+  uint64_t seq;
+  int kind;         // TK_RELAY / TK_TCP
+  uint32_t target;  // relay index or socket token
+};
+struct TimerLess {
+  bool operator()(const TimerEnt &a, const TimerEnt &b) const {
+    return a.time != b.time ? a.time > b.time : a.seq > b.seq;  // min-heap
+  }
+};
+
+struct HostPlane {
+  int id = -1;
+  uint32_t eth_ip = 0;
+  int qdisc = 0;  // 0 fifo, 1 round_robin
+  int64_t bw_up_bits = 0, bw_down_bits = 0;
+  uint64_t event_seq = 0, packet_seq = 0;
+  IfaceN lo, eth;
+  CoDelN codel;
+  RelayN relays[3];  // 0 loopback, 1 inet-out, 2 inet-in
+  std::vector<TimerEnt> theap;
+  std::vector<uint64_t> outgoing;  // cross-host packets this call
+  std::vector<TraceRec> trace;
+  bool tracing = true;
+  int64_t pkts_sent = 0, pkts_recv = 0, pkts_dropped = 0;
+
+  void tpush(TimerEnt e) {
+    theap.push_back(e);
+    std::push_heap(theap.begin(), theap.end(), TimerLess());
+  }
+  TimerEnt tpop() {
+    std::pop_heap(theap.begin(), theap.end(), TimerLess());
+    TimerEnt e = theap.back();
+    theap.pop_back();
+    return e;
+  }
+};
+
+/* ---------------- engine ------------------------------------------ */
+
+struct Engine {
+  PacketStore store;
+  std::vector<std::unique_ptr<HostPlane>> hosts;
+  std::vector<std::unique_ptr<SocketN>> socks;  // token -> socket
+  PyObject *cb_event = nullptr;  // (kind, host, tok, a, b)
+  PyObject *cb_rng = nullptr;    // (host) -> u64
+  bool in_error = false;         // a callback raised; unwind
+
+  HostPlane *plane(int hid) {
+    return (hid >= 0 && (size_t)hid < hosts.size()) ? hosts[hid].get()
+                                                    : nullptr;
+  }
+  TcpSocketN *tcp(uint32_t tok) {
+    return tok < socks.size() ? dynamic_cast<TcpSocketN *>(socks[tok].get())
+                              : nullptr;
+  }
+  UdpSocketN *udp(uint32_t tok) {
+    return tok < socks.size() ? dynamic_cast<UdpSocketN *>(socks[tok].get())
+                              : nullptr;
+  }
+  SocketN *sock(uint32_t tok) {
+    return tok < socks.size() ? socks[tok].get() : nullptr;
+  }
+
+  /* -- callbacks into Python ------------------------------------- */
+
+  void fire_event(int kind, int hid, uint32_t tok, uint32_t a, uint32_t b) {
+    if (!cb_event || in_error) return;
+    PyObject *r = PyObject_CallFunction(cb_event, "iiIII", kind, hid,
+                                        (unsigned int)tok, (unsigned int)a,
+                                        (unsigned int)b);
+    if (!r) { in_error = true; return; }
+    Py_DECREF(r);
+  }
+
+  uint64_t rng_u64(int hid) {
+    if (!cb_rng || in_error) return 0;
+    PyObject *r = PyObject_CallFunction(cb_rng, "i", hid);
+    if (!r) { in_error = true; return 0; }
+    uint64_t v = PyLong_AsUnsignedLongLong(r);
+    Py_DECREF(r);
+    if (PyErr_Occurred()) { in_error = true; return 0; }
+    return v;
+  }
+
+  /* adjust_status twin (status.py): only effective changes call out */
+  void adjust_status(SocketN *s, uint32_t set_mask, uint32_t clear_mask) {
+    clear_mask &= ~set_mask;
+    uint32_t nw = (s->status | set_mask) & ~clear_mask;
+    if (nw == s->status) return;
+    s->status = nw;
+    fire_event(CB_STATUS, s->host, s->tok, set_mask, clear_mask);
+  }
+
+  /* -- trace ------------------------------------------------------ */
+
+  void trace_packet(HostPlane *hp, int kind, const PacketN *p,
+                    const char *extra, int64_t at_time) {
+    if (!hp->tracing) return;
+    hp->trace.push_back({at_time, kind, p->src_host, p->seq, p->proto,
+                         p->src_ip, p->dst_ip, p->src_port, p->dst_port,
+                         (int64_t)p->payload.size(), extra});
+  }
+  void trace_drop(HostPlane *hp, const PacketN *p, const char *reason,
+                  int64_t at_time) {
+    hp->pkts_dropped++;
+    trace_packet(hp, TRACE_DRP, p, reason, at_time);
+  }
+  void trace_rcv(HostPlane *hp, const PacketN *p, int64_t now) {
+    hp->pkts_recv++;
+    trace_packet(hp, TRACE_RCV, p, "", now);
+  }
+
+  /* ================= the data-plane chain ======================== */
+
+  /* get_packet_device (host.py): returns 0 lo-receive, 1 eth-receive,
+   * 2 router(outgoing) */
+  int packet_device(HostPlane *hp, uint32_t dst_ip) {
+    if (dst_ip == LOCALHOST_IP) return 0;
+    if (dst_ip == hp->eth_ip) return 1;
+    return 2;
+  }
+
+  void device_push(HostPlane *hp, int dev, uint64_t id, int64_t now) {
+    if (dev == 2) {
+      /* router.route_outgoing_packet -> host.send_packet */
+      hp->pkts_sent++;
+      hp->outgoing.push_back(id);
+      return;
+    }
+    iface_receive(hp, dev == 0 ? hp->lo : hp->eth, id, now);
+  }
+
+  /* interface.push (receive path) */
+  void iface_receive(HostPlane *hp, IfaceN &ifc, uint64_t id, int64_t now) {
+    PacketN *p = store.get(id);
+    ifc.packets_received++;
+    ifc.bytes_received += p->total_size();
+    AssocKey k{ifc.ip, p->src_ip, (uint16_t)p->dst_port,
+               (uint16_t)p->src_port, (uint8_t)p->proto};
+    auto it = ifc.assoc.find(k);
+    if (it == ifc.assoc.end()) {
+      k.peer_ip = 0; k.peer_port = 0;
+      it = ifc.assoc.find(k);
+    }
+    if (it == ifc.assoc.end()) {
+      trace_drop(hp, p, "no-socket", now);
+      store.free_pkt(id);
+      return;
+    }
+    SocketN *s = socks[it->second].get();
+    bool delivered;
+    if (s->proto == PROTO_TCP)
+      delivered = tcp_push_in(hp, static_cast<TcpSocketN *>(s), it->second,
+                              id, now);
+    else
+      delivered = udp_push_in(hp, static_cast<UdpSocketN *>(s), id, now);
+    if (delivered) trace_rcv(hp, store.get(id), now);
+    if (s->proto == PROTO_TCP || !delivered)
+      store.free_pkt(id);  // TCP consumes payload; UDP keeps delivered pkts
+  }
+
+  /* interface.pop_packet: pull next packet for the draining relay */
+  uint64_t iface_pop(HostPlane *hp, IfaceN &ifc, int64_t now) {
+    for (;;) {
+      uint32_t tok = UINT32_MAX;
+      if (hp->qdisc == 1) {
+        while (!ifc.send_ready.empty()) {
+          uint32_t t = ifc.send_ready.front();
+          ifc.send_ready.pop_front();
+          if (socks[t]->queued[ifc.idx]) {
+            socks[t]->queued[ifc.idx] = false;
+            tok = t;
+            break;
+          }
+        }
+      } else {
+        while (!ifc.send_heap.empty()) {
+          uint32_t t = ifc.heap_pop();
+          if (socks[t]->queued[ifc.idx]) {
+            socks[t]->queued[ifc.idx] = false;
+            tok = t;
+            break;
+          }
+        }
+      }
+      if (tok == UINT32_MAX) return UINT64_MAX;
+      SocketN *s = socks[tok].get();
+      uint64_t id = pull_out_packet(s, ifc);
+      /* re-queue if it still has packets */
+      int64_t prio = peek_priority(s, ifc);
+      if (prio >= 0) {
+        s->queued[ifc.idx] = true;
+        if (hp->qdisc == 1) ifc.send_ready.push_back(tok);
+        else ifc.heap_push(prio, tok);
+      }
+      if (id != UINT64_MAX) {
+        PacketN *p = store.get(id);
+        ifc.packets_sent++;
+        ifc.bytes_sent += p->total_size();
+        trace_packet(hp, TRACE_SND, p, "", now);
+        return id;
+      }
+    }
+  }
+
+  int64_t peek_priority(SocketN *s, IfaceN &ifc) {
+    /* -1 = none (Python returns None) */
+    if (s->proto == PROTO_TCP) {
+      auto &q = static_cast<TcpSocketN *>(s)->out_packets[ifc.idx];
+      return q.empty() ? -1 : store.get(q.front())->priority;
+    }
+    auto &q = static_cast<UdpSocketN *>(s)->send_q[ifc.idx];
+    return q.empty() ? -1 : store.get(q.front())->priority;
+  }
+
+  uint64_t pull_out_packet(SocketN *s, IfaceN &ifc) {
+    if (s->proto == PROTO_TCP) {
+      auto &q = static_cast<TcpSocketN *>(s)->out_packets[ifc.idx];
+      if (q.empty()) return UINT64_MAX;
+      uint64_t id = q.front();
+      q.pop_front();
+      return id;
+    }
+    UdpSocketN *u = static_cast<UdpSocketN *>(s);
+    auto &q = u->send_q[ifc.idx];
+    if (q.empty()) return UINT64_MAX;
+    uint64_t id = q.front();
+    q.pop_front();
+    u->send_bytes -= store.get(id)->total_size();
+    if (!(u->status & S_CLOSED)) adjust_status(u, S_WRITABLE, 0);
+    return id;
+  }
+
+  /* interface.notify_socket_has_packets */
+  void notify_socket_has_packets(HostPlane *hp, IfaceN &ifc, uint32_t tok,
+                                 int64_t now) {
+    SocketN *s = socks[tok].get();
+    if (s->queued[ifc.idx]) return;
+    int64_t prio = peek_priority(s, ifc);
+    if (prio < 0) return;
+    s->queued[ifc.idx] = true;
+    if (hp->qdisc == 1) ifc.send_ready.push_back(tok);
+    else ifc.heap_push(prio, tok);
+    /* host.notify_interface_has_packets */
+    relay_notify(hp, ifc.idx == 0 ? 0 : 1, now);
+  }
+
+  /* relay.notify / _wakeup / _forward_until_blocked */
+  void relay_notify(HostPlane *hp, int ridx, int64_t now) {
+    RelayN &r = hp->relays[ridx];
+    if (r.state == RELAY_PENDING) return;
+    relay_forward(hp, ridx, now);
+  }
+
+  void relay_forward(HostPlane *hp, int ridx, int64_t now) {
+    RelayN &r = hp->relays[ridx];
+    for (;;) {
+      uint64_t id = r.pending;
+      r.pending = UINT64_MAX;
+      if (id == UINT64_MAX) {
+        if (r.src == 2) {
+          /* router.pop_inbound = CoDel pop with drop tracing */
+          id = codel_pop(hp, now);
+        } else {
+          id = iface_pop(hp, r.src == 0 ? hp->lo : hp->eth, now);
+        }
+      }
+      if (id == UINT64_MAX) return;
+      PacketN *p = store.get(id);
+      if (!r.bucket.unlimited) {
+        int64_t when = 0;
+        if (!r.bucket.try_remove(p->total_size(), now, &when)) {
+          r.pending = id;
+          r.state = RELAY_PENDING;
+          hp->tpush({when, hp->event_seq++, TK_RELAY, (uint32_t)ridx});
+          return;
+        }
+      }
+      int dev = packet_device(hp, p->dst_ip);
+      device_push(hp, dev, id, now);
+    }
+  }
+
+  uint64_t codel_pop(HostPlane *hp, int64_t now) {
+    /* codel.pop with the host's "codel" drop trace */
+    CoDelN &c = hp->codel;
+    bool ok;
+    uint64_t id = c.dequeue_raw(now, store, &ok);
+    if (id == UINT64_MAX) { c.dropping = false; return UINT64_MAX; }
+    if (c.dropping) {
+      if (!ok) {
+        c.dropping = false;
+      } else {
+        while (now >= c.drop_next && c.dropping) {
+          c.dropped_count++;
+          trace_drop(hp, store.get(id), "codel", now);
+          store.free_pkt(id);
+          c.count++;
+          id = c.dequeue_raw(now, store, &ok);
+          if (id == UINT64_MAX) { c.dropping = false; return UINT64_MAX; }
+          if (!ok) c.dropping = false;
+          else c.drop_next = CoDelN::control_time(c.drop_next, c.count);
+        }
+      }
+    } else if (ok && (now - c.drop_next < CODEL_INTERVAL_NS ||
+                      now - c.first_above >= CODEL_INTERVAL_NS)) {
+      c.dropped_count++;
+      trace_drop(hp, store.get(id), "codel", now);
+      store.free_pkt(id);
+      id = c.dequeue_raw(now, store, &ok);
+      if (id == UINT64_MAX) { c.dropping = false; return UINT64_MAX; }
+      c.dropping = true;
+      if (now - c.drop_next < CODEL_INTERVAL_NS)
+        c.count = c.count > 2 ? c.count - c.last_count : 1;
+      else
+        c.count = 1;
+      c.last_count = c.count;
+      c.drop_next = CoDelN::control_time(now, c.count);
+    }
+    return id;
+  }
+
+  /* router.route_incoming_packet: cross-host arrival */
+  void deliver(int hid, uint64_t id, int64_t now) {
+    HostPlane *hp = plane(hid);
+    PacketN *p = store.get(id);
+    if (!p) return;
+    if (!hp->codel.push(id, p->total_size(), now)) {
+      trace_drop(hp, p, "rtr-limit", now);
+      store.free_pkt(id);
+      return;
+    }
+    relay_notify(hp, 2, now);  // notify_router_has_packets
+  }
+
+  /* fire one due engine deadline (head of theap) */
+  void fire(int hid, int64_t now) {
+    HostPlane *hp = plane(hid);
+    if (hp->theap.empty()) return;
+    TimerEnt e = hp->tpop();
+    if (e.kind == TK_RELAY) {
+      RelayN &r = hp->relays[e.target];
+      r.state = RELAY_IDLE;  // relay._wakeup
+      relay_forward(hp, e.target, now);
+    } else {
+      tcp_on_timer(hp, tcp(e.target), e.target, now);
+    }
+  }
+
+  /* ============== TCP socket glue (host/socket_tcp.py) =========== */
+
+  IfaceN &iface_of(HostPlane *hp, int idx) { return idx == 0 ? hp->lo : hp->eth; }
+
+  /* _max_mem: BDP-derived ceiling */
+  int64_t max_mem(HostPlane *hp, int64_t rtt_ns, bool is_recv) {
+    int64_t bw = is_recv ? hp->bw_down_bits : hp->bw_up_bits;
+    int64_t mem = bw * rtt_ns / (8 * 1000000000LL);
+    int64_t base = is_recv ? RMEM_MAX : WMEM_MAX;
+    return std::min(std::max(mem, base), base * 10);
+  }
+
+  void autotune_recv(HostPlane *hp, TcpSocketN *s, int64_t bytes_copied,
+                     int64_t now) {
+    TcpConn *c = s->conn.get();
+    s->at_bytes_copied += bytes_copied;
+    int64_t space = 2 * s->at_bytes_copied;
+    if (space > s->at_space) s->at_space = space;
+    int64_t cur = c->recv_buf_max;
+    if (s->at_space > cur) {
+      int64_t nw = std::min(s->at_space, max_mem(hp, c->srtt, true));
+      if (nw > cur) c->recv_buf_max = nw;
+    }
+    if (s->at_last_adjust == 0) {
+      s->at_last_adjust = now;
+    } else if (c->srtt > 0 && now - s->at_last_adjust > c->srtt) {
+      s->at_last_adjust = now;
+      s->at_bytes_copied = 0;
+    }
+  }
+
+  void autotune_send(HostPlane *hp, TcpSocketN *s) {
+    TcpConn *c = s->conn.get();
+    int64_t demanded = std::max((int64_t)1,
+                                c->cwnd / std::max(c->eff_mss, 1));
+    int64_t nw = std::min(2404 * 2 * demanded, max_mem(hp, c->srtt, false));
+    if (nw > c->send_buf_max) c->send_buf_max = nw;
+  }
+
+  void tcp_flush(HostPlane *hp, TcpSocketN *s, uint32_t tok, int64_t now) {
+    TcpConn *c = s->conn.get();
+    if (!c) return;
+    bool emitted = false;
+    IfaceN &ifc = iface_of(hp, s->iface);
+    while (!c->outbox.empty()) {
+      OutSeg seg = std::move(c->outbox.front());
+      c->outbox.pop_front();
+      uint64_t id = store.alloc();
+      PacketN *p = store.get(id);
+      uint64_t pseq = hp->packet_seq++;
+      p->src_host = hp->id;
+      p->seq = pseq;
+      p->proto = PROTO_TCP;
+      p->src_ip = s->local_ip != INADDR_ANY_ ? s->local_ip : ifc.ip;
+      p->src_port = s->local_port;
+      p->dst_ip = s->peer_ip;
+      p->dst_port = s->peer_port;
+      p->payload = std::move(seg.payload);
+      p->has_tcp = true;
+      p->tcp = seg.hdr;
+      p->priority = (int64_t)pseq;
+      s->out_packets[s->iface].push_back(id);
+      emitted = true;
+    }
+    if (emitted) notify_socket_has_packets(hp, ifc, tok, now);
+    tcp_arm_timer(hp, s, tok);
+    tcp_update_status(s);
+  }
+
+  void tcp_update_status(TcpSocketN *s) {
+    TcpConn *c = s->conn.get();
+    if (!c) return;
+    uint32_t set = 0, clear = 0;
+    if (c->readable_bytes() > 0 || c->at_eof() || !c->error.empty())
+      set |= S_READABLE;
+    else
+      clear |= S_READABLE;
+    if ((c->state == ST_ESTABLISHED || c->state == ST_CLOSE_WAIT) &&
+        c->send_space() > 0)
+      set |= S_WRITABLE;
+    else if (c->state != ST_ESTABLISHED && c->state != ST_CLOSE_WAIT)
+      clear |= S_WRITABLE;
+    if (!c->error.empty() || c->state == ST_CLOSED) set |= S_CLOSED;
+    adjust_status(s, set, clear & ~set);
+  }
+
+  void tcp_arm_timer(HostPlane *hp, TcpSocketN *s, uint32_t tok) {
+    TcpConn *c = s->conn.get();
+    if (!c) return;
+    int64_t deadline = c->next_timer_expiry();
+    if (deadline < 0 || deadline == s->timer_deadline) return;
+    s->timer_deadline = deadline;
+    hp->tpush({deadline, hp->event_seq++, TK_TCP, tok});
+  }
+
+  void tcp_on_timer(HostPlane *hp, TcpSocketN *s, uint32_t tok,
+                    int64_t now) {
+    if (!s) return;
+    TcpConn *c = s->conn.get();
+    if (!c) return;
+    int64_t deadline = c->next_timer_expiry();
+    s->timer_deadline = -1;
+    if (deadline >= 0 && now >= deadline) {
+      c->on_timer(now);
+      tcp_flush(hp, s, tok, now);
+      tcp_update_status(s);
+      tcp_maybe_teardown(hp, s, tok);
+    } else {
+      tcp_arm_timer(hp, s, tok);
+    }
+  }
+
+  /* association helpers (interface.associate / disassociate) */
+  bool assoc_add(IfaceN &ifc, uint8_t proto, int port, uint32_t peer_ip,
+                 int peer_port, uint32_t tok) {
+    AssocKey k{ifc.ip, peer_ip, (uint16_t)port, (uint16_t)peer_port, proto};
+    return ifc.assoc.emplace(k, tok).second;
+  }
+  void assoc_del(IfaceN &ifc, uint8_t proto, int port, uint32_t peer_ip,
+                 int peer_port) {
+    AssocKey k{ifc.ip, peer_ip, (uint16_t)port, (uint16_t)peer_port, proto};
+    ifc.assoc.erase(k);
+  }
+  bool is_associated(IfaceN &ifc, uint8_t proto, int port) {
+    AssocKey k{ifc.ip, 0, (uint16_t)port, 0, proto};
+    return ifc.assoc.count(k) > 0;
+  }
+
+  void tcp_teardown(HostPlane *hp, SocketN *s, uint32_t tok) {
+    /* socket_tcp._teardown */
+    for (int i = 0; i < 2; i++) {
+      if (!(s->ifaces_mask & (1 << i))) continue;
+      IfaceN &ifc = iface_of(hp, i);
+      if (s->has_local) {
+        if (s->has_peer)
+          assoc_del(ifc, (uint8_t)s->proto, s->local_port, s->peer_ip,
+                    s->peer_port);
+        else
+          assoc_del(ifc, (uint8_t)s->proto, s->local_port, 0, 0);
+      }
+    }
+    s->ifaces_mask = 0;
+    adjust_status(s, S_CLOSED, S_ACTIVE | S_READABLE | S_WRITABLE);
+    TcpSocketN *t = dynamic_cast<TcpSocketN *>(s);
+    bool dead_child = false;
+    if (t && t->listener >= 0 && !t->delivered) {
+      TcpSocketN *l = tcp((uint32_t)t->listener);
+      bool in_q = l && std::find(l->accept_q.begin(), l->accept_q.end(),
+                                 tok) != l->accept_q.end();
+      if (!in_q) {
+        fire_event(CB_CHILD_DEAD, s->host, tok, 0, 0);
+        dead_child = true;  // no app will ever own it
+      }
+    }
+    if (t && (t->app_closed || dead_child)) release_tcp(t);
+  }
+
+  /* Free the heavy per-connection state once the app closed the fd AND
+   * the network side finished (teardown ran).  The out_packets queues
+   * stay — a closed socket's already-queued egress still drains through
+   * the interface, exactly like the object path, and the SocketN shell
+   * itself stays so stale timer-heap entries resolve harmlessly. */
+  void release_tcp(TcpSocketN *t) {
+    t->conn.reset();
+    t->accept_q.clear();
+    t->accept_q.shrink_to_fit();
+  }
+
+  void tcp_maybe_teardown(HostPlane *hp, TcpSocketN *s, uint32_t tok) {
+    if (s->conn && s->conn->state == ST_CLOSED && s->ifaces_mask)
+      tcp_teardown(hp, s, tok);
+  }
+
+  void tcp_maybe_child_established(HostPlane *hp, TcpSocketN *s,
+                                   uint32_t tok, int64_t now) {
+    if (s->listener < 0 || s->accept_queued ||
+        s->conn->state != ST_ESTABLISHED)
+      return;
+    s->accept_queued = true;
+    TcpSocketN *l = tcp((uint32_t)s->listener);
+    if (!l || !l->listening) {
+      /* listener closed while our SYN-ACK was in flight */
+      s->conn->abort(now);
+      tcp_flush(hp, s, tok, now);
+      tcp_teardown(hp, s, tok);
+      return;
+    }
+    l->accept_q.push_back(tok);
+    adjust_status(l, S_READABLE, 0);
+  }
+
+  /* push_in_packet for TCP (stream or listener) */
+  bool tcp_push_in(HostPlane *hp, TcpSocketN *s, uint32_t tok, uint64_t id,
+                   int64_t now) {
+    PacketN *p = store.get(id);
+    if (s->listening) return tcp_listener_push(hp, s, tok, id, now);
+    TcpConn *c = s->conn.get();
+    if (!c) {
+      trace_drop(hp, p, "tcp-closed", now);
+      return false;
+    }
+    c->on_packet(p->tcp, p->payload, now);
+    if (s->send_autotune && c->srtt > 0) autotune_send(hp, s);
+    tcp_flush(hp, s, tok, now);
+    tcp_update_status(s);
+    tcp_maybe_child_established(hp, s, tok, now);
+    tcp_maybe_teardown(hp, s, tok);
+    return true;
+  }
+
+  bool tcp_listener_push(HostPlane *hp, TcpSocketN *s, uint32_t ltok,
+                         uint64_t id, int64_t now) {
+    PacketN *p = store.get(id);
+    const TcpHdrN &hdr = p->tcp;
+    if (!(hdr.flags & F_SYN) || (hdr.flags & F_ACK)) {
+      trace_drop(hp, p, "tcp-stray", now);
+      return false;
+    }
+    if ((int)s->accept_q.size() >= s->backlog) {
+      trace_drop(hp, p, "accept-backlog-full", now);
+      return false;
+    }
+    /* spawn a child bound to the specific 4-tuple */
+    int ifidx = p->dst_ip == LOCALHOST_IP ? 0 : 1;
+    IfaceN &ifc = iface_of(hp, ifidx);
+    /* duplicate SYN? associate fails */
+    if (!assoc_add(ifc, PROTO_TCP, p->dst_port, p->src_ip, p->src_port,
+                   (uint32_t)socks.size())) {
+      trace_drop(hp, p, "tcp-dup-syn", now);
+      return false;
+    }
+    uint32_t ctok = (uint32_t)socks.size();
+    auto child = std::make_unique<TcpSocketN>(
+        hp->id, s->send_buf_max, s->recv_buf_max, s->send_autotune,
+        s->recv_autotune);
+    child->has_local = true;
+    child->local_ip = p->dst_ip;
+    child->local_port = p->dst_port;
+    child->has_peer = true;
+    child->peer_ip = p->src_ip;
+    child->peer_port = p->src_port;
+    child->listener = (int32_t)ltok;
+    child->iface = ifidx;
+    child->ifaces_mask = (uint8_t)(1 << ifidx);
+    child->nodelay = s->nodelay;
+    child->tok = ctok;
+    uint32_t iss = (uint32_t)rng_u64(hp->id);  // host.rng.next_u32
+    child->conn = std::make_unique<TcpConn>(
+        iss, s->recv_buf_max, s->send_buf_max,
+        s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
+    child->conn->nodelay = s->nodelay;
+    socks.push_back(std::move(child));
+    fire_event(CB_CHILD_BORN, hp->id, ltok, ctok, 0);
+    TcpSocketN *cs = tcp(ctok);
+    cs->conn->accept_syn(hdr, now);
+    tcp_flush(hp, cs, ctok, now);
+    return true;
+  }
+
+  /* push_in_packet for UDP */
+  bool udp_push_in(HostPlane *hp, UdpSocketN *s, uint64_t id, int64_t now) {
+    PacketN *p = store.get(id);
+    if (s->has_peer &&
+        (p->src_ip != s->peer_ip || p->src_port != s->peer_port)) {
+      trace_drop(hp, p, "udp-connected-filter", now);
+      return false;
+    }
+    int64_t size = p->total_size();
+    if (s->recv_bytes + size > s->recv_max) {
+      s->drops_full_recv++;
+      trace_drop(hp, p, "rcvbuf-full", now);
+      return false;
+    }
+    s->recv_q.push_back(id);
+    s->recv_bytes += size;
+    adjust_status(s, S_READABLE, 0);
+    return true;
+  }
+
+  /* ============== syscall-facing ops ============================= */
+  /* Return convention: >= 0 success, < 0 is -errno (the Python proxy
+   * translates to OSError / BlockingIOError / SyscallCondition). */
+
+  static constexpr int E_AGAIN = 11, E_INVAL = 22, E_PIPE = 32,
+                       E_ADDRINUSE = 98, E_ADDRNOTAVAIL = 99,
+                       E_ISCONN = 106, E_NOTCONN = 107,
+                       E_OPNOTSUPP = 95, E_ALREADY = 114,
+                       E_INPROGRESS = 115, E_CONNRESET = 104,
+                       E_TIMEDOUT = 110, E_CONNREFUSED = 111,
+                       E_MSGSIZE = 90, E_DESTADDRREQ = 89;
+  static constexpr int R_BLOCK = 1000000;  // proxy: park on a condition
+
+  uint32_t new_tcp(int hid, int64_t sb, int64_t rb, bool sat, bool rat) {
+    uint32_t tok = (uint32_t)socks.size();
+    socks.push_back(std::make_unique<TcpSocketN>(hid, sb, rb, sat, rat));
+    socks.back()->tok = tok;
+    return tok;
+  }
+  uint32_t new_udp(int hid, int64_t sb, int64_t rb) {
+    uint32_t tok = (uint32_t)socks.size();
+    socks.push_back(std::make_unique<UdpSocketN>(hid, sb, rb));
+    socks.back()->tok = tok;
+    return tok;
+  }
+
+  /* _pick_interfaces: returns mask or 0 on EADDRNOTAVAIL */
+  uint8_t pick_ifaces(HostPlane *hp, uint32_t ip) {
+    if (ip == INADDR_ANY_) return 3;
+    if (ip == LOCALHOST_IP) return 1;
+    if (ip == hp->eth_ip) return 2;
+    return 0;
+  }
+
+  /* bind (TcpSocket.bind / UdpSocket.bind are the same shape) */
+  int generic_bind(HostPlane *hp, SocketN *s, uint32_t tok, uint32_t ip,
+                   int port) {
+    if (s->has_local) return -E_INVAL;
+    uint8_t mask = pick_ifaces(hp, ip);
+    if (!mask) return -E_ADDRNOTAVAIL;
+    if (port == 0) {
+      port = ephemeral_port(hp, (uint8_t)s->proto, mask);
+      if (port < 0) return port;
+    } else {
+      for (int i = 0; i < 2; i++)
+        if ((mask & (1 << i)) &&
+            is_associated(iface_of(hp, i), (uint8_t)s->proto, port))
+          return -E_ADDRINUSE;
+    }
+    for (int i = 0; i < 2; i++)
+      if (mask & (1 << i))
+        assoc_add(iface_of(hp, i), (uint8_t)s->proto, port, 0, 0, tok);
+    s->ifaces_mask = mask;
+    s->has_local = true;
+    s->local_ip = ip;
+    s->local_port = port;
+    return port;
+  }
+
+  int ephemeral_port(HostPlane *hp, uint8_t proto, uint8_t mask) {
+    auto in_use = [&](int port) {
+      for (int i = 0; i < 2; i++)
+        if ((mask & (1 << i)) &&
+            is_associated(iface_of(hp, i), proto, port))
+          return true;
+      return false;
+    };
+    for (int tries = 0; tries < 64; tries++) {
+      int port = EPHEMERAL_LO +
+                 (int)(rng_u64(hp->id) % (EPHEMERAL_HI - EPHEMERAL_LO));
+      if (in_error) return -E_INVAL;
+      if (!in_use(port)) return port;
+    }
+    for (int port = EPHEMERAL_LO; port < EPHEMERAL_HI; port++)
+      if (!in_use(port)) return port;
+    return -E_ADDRINUSE;
+  }
+
+  int tcp_listen(TcpSocketN *s, int backlog) {
+    if (!s->has_local) return -E_INVAL;
+    if (s->conn) return -E_ISCONN;
+    s->listening = true;
+    s->backlog = std::max(1, backlog);
+    return 0;
+  }
+
+  int tcp_connect(HostPlane *hp, TcpSocketN *s, uint32_t tok, uint32_t ip,
+                  int port, int64_t now) {
+    if (s->listening) return -E_OPNOTSUPP;
+    if (s->conn) {
+      if (!s->has_peer || ip != s->peer_ip || port != s->peer_port)
+        return -E_ISCONN;
+      if (!s->conn->error.empty())
+        return s->conn->error.find("timed") != std::string::npos
+                   ? -E_TIMEDOUT : -E_CONNREFUSED;
+      if (s->conn->state == ST_ESTABLISHED) return 0;
+      if (s->nonblocking) return -E_ALREADY;
+      return R_BLOCK;
+    }
+    if (!s->has_local) {
+      uint32_t dst_local = ip == LOCALHOST_IP ? LOCALHOST_IP : hp->eth_ip;
+      int r = generic_bind(hp, s, tok, dst_local, 0);
+      if (r < 0) return r;
+    }
+    s->has_peer = true;
+    s->peer_ip = ip;
+    s->peer_port = port;
+    s->iface = ip == LOCALHOST_IP ? 0 : 1;
+    /* move from wildcard to the specific 4-tuple */
+    for (int i = 0; i < 2; i++)
+      if (s->ifaces_mask & (1 << i))
+        assoc_del(iface_of(hp, i), PROTO_TCP, s->local_port, 0, 0);
+    assoc_add(iface_of(hp, s->iface), PROTO_TCP, s->local_port, ip, port,
+              tok);
+    s->ifaces_mask = (uint8_t)(1 << s->iface);
+    uint32_t iss = (uint32_t)rng_u64(hp->id);
+    s->conn = std::make_unique<TcpConn>(
+        iss, s->recv_buf_max, s->send_buf_max,
+        s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
+    s->conn->nodelay = s->nodelay;
+    s->conn->open_active(now);
+    tcp_flush(hp, s, tok, now);
+    if (s->nonblocking) return -E_INPROGRESS;
+    return R_BLOCK;
+  }
+
+  /* returns child token or -errno */
+  int64_t tcp_accept(HostPlane *hp, TcpSocketN *s, int64_t now) {
+    (void)hp; (void)now;
+    if (!s->listening) return -E_INVAL;
+    if (s->accept_q.empty()) return -E_AGAIN;
+    uint32_t ctok = s->accept_q.front();
+    s->accept_q.pop_front();
+    tcp(ctok)->delivered = true;
+    if (s->accept_q.empty()) adjust_status(s, 0, S_READABLE);
+    return (int64_t)ctok;
+  }
+
+  int64_t tcp_sendto(HostPlane *hp, TcpSocketN *s, uint32_t tok,
+                     const char *data, int64_t n, int64_t now) {
+    TcpConn *c = s->conn.get();
+    if (!c) return -E_NOTCONN;
+    if (!c->error.empty()) return -E_CONNRESET;
+    if (c->state != ST_ESTABLISHED && c->state != ST_CLOSE_WAIT)
+      return -E_PIPE;
+    if (c->snd_fin_pending) return -E_INVAL;  // "write after close"
+    int64_t wrote = c->write(data, n, now);
+    tcp_flush(hp, s, tok, now);
+    if (wrote == 0) {
+      adjust_status(s, 0, S_WRITABLE);
+      return -E_AGAIN;
+    }
+    return wrote;
+  }
+
+  /* returns 0 data-in-out, -errno; out may be empty (EOF) */
+  int tcp_recv(HostPlane *hp, TcpSocketN *s, uint32_t tok, int64_t bufsize,
+               bool peek, int64_t now, std::string *out) {
+    TcpConn *c = s->conn.get();
+    if (!c) return -E_NOTCONN;
+    if (c->readable_bytes() == 0) {
+      if (c->at_eof()) { out->clear(); return 0; }
+      if (!c->error.empty()) return -E_CONNRESET;
+      adjust_status(s, 0, S_READABLE);
+      return -E_AGAIN;
+    }
+    if (peek) { *out = c->recv_buf.peek(bufsize); return 0; }
+    *out = c->read(bufsize, now);
+    if (s->recv_autotune && !out->empty())
+      autotune_recv(hp, s, (int64_t)out->size(), now);
+    tcp_flush(hp, s, tok, now);
+    if (c->readable_bytes() == 0 && !c->at_eof())
+      adjust_status(s, 0, S_READABLE);
+    return 0;
+  }
+
+  void tcp_shutdown_wr(HostPlane *hp, TcpSocketN *s, uint32_t tok,
+                       int64_t now) {
+    if (s->conn) {
+      s->conn->close(now);
+      tcp_flush(hp, s, tok, now);
+    }
+  }
+
+  void tcp_close(HostPlane *hp, TcpSocketN *s, uint32_t tok, int64_t now) {
+    s->app_closed = true;
+    if (s->listening) {
+      s->listening = false;
+      for (uint32_t ctok : s->accept_q) {
+        tcp_close(hp, tcp(ctok), ctok, now);
+        fire_event(CB_CHILD_DEAD, hp->id, ctok, 0, 0);
+        tcp(ctok)->delivered = true;  // accounting done (twin comment)
+      }
+      s->accept_q.clear();
+      tcp_teardown(hp, s, tok);
+      return;
+    }
+    if (!s->conn) {
+      tcp_teardown(hp, s, tok);
+      return;
+    }
+    if (s->conn->state != ST_CLOSED && s->conn->state != ST_TIME_WAIT) {
+      s->conn->close(now);
+      tcp_flush(hp, s, tok, now);
+    }
+    tcp_maybe_teardown(hp, s, tok);
+    adjust_status(s, S_CLOSED, S_ACTIVE);
+  }
+
+  /* -- UDP ops -- */
+
+  int64_t udp_sendto(HostPlane *hp, UdpSocketN *s, uint32_t tok,
+                     const char *data, int64_t n, int64_t has_dst,
+                     uint32_t dst_ip, int dst_port, int64_t now) {
+    if (!has_dst) {
+      if (!s->has_peer) return -E_DESTADDRREQ;
+      dst_ip = s->peer_ip;
+      dst_port = s->peer_port;
+    }
+    if (n > MTU - IPV4_HDR - UDP_HDR) return -E_MSGSIZE;
+    if (!s->has_local) {
+      int r = generic_bind(hp, s, tok, INADDR_ANY_, 0);
+      if (r < 0) return r;
+    }
+    int64_t size = n + UDP_HDR + IPV4_HDR;
+    if (s->send_bytes + size > s->send_max) {
+      adjust_status(s, 0, S_WRITABLE);
+      return -E_AGAIN;
+    }
+    uint32_t src_ip = s->local_ip;
+    if (src_ip == INADDR_ANY_)
+      src_ip = dst_ip == LOCALHOST_IP ? LOCALHOST_IP : hp->eth_ip;
+    uint64_t id = store.alloc();
+    PacketN *p = store.get(id);
+    uint64_t pseq = hp->packet_seq++;
+    p->src_host = hp->id;
+    p->seq = pseq;
+    p->proto = PROTO_UDP;
+    p->src_ip = src_ip;
+    p->src_port = s->local_port;
+    p->dst_ip = dst_ip;
+    p->dst_port = dst_port;
+    p->payload.assign(data, (size_t)n);
+    p->priority = (int64_t)pseq;
+    int ifidx = dst_ip == LOCALHOST_IP ? 0 : 1;
+    s->send_q[ifidx].push_back(id);
+    s->send_bytes += size;
+    notify_socket_has_packets(hp, iface_of(hp, ifidx), tok, now);
+    return n;
+  }
+
+  /* returns 0 ok (-errno otherwise); fills out/src */
+  int udp_recvfrom(UdpSocketN *s, int64_t bufsize, bool peek,
+                   std::string *out, uint32_t *src_ip, int *src_port) {
+    if (s->recv_q.empty()) return -E_AGAIN;
+    uint64_t id = s->recv_q.front();
+    PacketN *p = store.get(id);
+    *out = p->payload.substr(0, (size_t)std::min(
+        bufsize, (int64_t)p->payload.size()));
+    *src_ip = p->src_ip;
+    *src_port = p->src_port;
+    if (peek) return 0;
+    s->recv_q.pop_front();
+    s->recv_bytes -= p->total_size();
+    store.free_pkt(id);
+    if (s->recv_q.empty()) adjust_status(s, 0, S_READABLE);
+    return 0;
+  }
+
+  /* the dns_wire reply path: craft a datagram straight into recv_q */
+  void udp_push_reply(HostPlane *hp, UdpSocketN *s, const char *data,
+                      int64_t n, uint32_t src_ip, int src_port,
+                      int64_t now) {
+    uint64_t id = store.alloc();
+    PacketN *p = store.get(id);
+    p->src_host = hp->id;
+    p->seq = hp->packet_seq++;
+    p->proto = PROTO_UDP;
+    p->src_ip = src_ip;
+    p->src_port = src_port;
+    p->dst_ip = s->local_ip ? s->local_ip : hp->eth_ip;
+    p->dst_port = s->local_port;
+    p->payload.assign(data, (size_t)n);
+    udp_push_in(hp, s, id, now);
+  }
+
+  void udp_close(HostPlane *hp, UdpSocketN *s) {
+    for (int i = 0; i < 2; i++)
+      if (s->ifaces_mask & (1 << i))
+        assoc_del(iface_of(hp, i), PROTO_UDP, s->local_port, 0, 0);
+    s->ifaces_mask = 0;
+    adjust_status(s, S_CLOSED, S_ACTIVE | S_READABLE | S_WRITABLE);
+    /* Queued SEND packets stay: the relay still drains them after
+     * close, exactly like the Python plane (close only disassociates).
+     * Undelivered RECV packets die with the fd. */
+    for (uint64_t id : s->recv_q) store.free_pkt(id);
+    s->recv_q.clear();
+    s->recv_bytes = 0;
+  }
+};
+
+/* ================= CPython bindings =============================== */
+
+struct EngineObj {
+  PyObject_HEAD
+  Engine *eng;
+};
+
+/* Propagate a callback-raised Python exception out of the entry call. */
+#define CHECK_CB(self)                         \
+  do {                                         \
+    if ((self)->eng->in_error) {               \
+      (self)->eng->in_error = false;           \
+      return nullptr;                          \
+    }                                          \
+  } while (0)
+
+PyObject *format_trace_text(const TraceRec &r) {
+  char buf[192];
+  const char *name = r.kind == TRACE_SND ? "SND"
+                     : r.kind == TRACE_DRP ? "DRP" : "RCV";
+  const char *proto = r.proto == PROTO_TCP ? "tcp" : "udp";
+  uint32_t a = r.src_ip, b = r.dst_ip;
+  int n = snprintf(
+      buf, sizeof buf,
+      "%s %s %u.%u.%u.%u:%d>%u.%u.%u.%u:%d len=%lld id=%d.%llu%s%s",
+      name, proto, a >> 24 & 255, a >> 16 & 255, a >> 8 & 255, a & 255,
+      r.src_port, b >> 24 & 255, b >> 16 & 255, b >> 8 & 255, b & 255,
+      r.dst_port, (long long)r.len, r.src_host,
+      (unsigned long long)r.pkt_seq, r.extra[0] ? " " : "", r.extra);
+  return PyUnicode_FromStringAndSize(buf, n);
+}
+
+static PyObject *eng_add_host(EngineObj *self, PyObject *args) {
+  int hid, qdisc_rr;
+  unsigned int ip;
+  long long up, down, mtu;
+  if (!PyArg_ParseTuple(args, "iILLpL", &hid, &ip, &up, &down, &qdisc_rr,
+                        &mtu))
+    return nullptr;
+  auto &hosts = self->eng->hosts;
+  if ((size_t)hid >= hosts.size()) hosts.resize(hid + 1);
+  hosts[hid] = std::make_unique<HostPlane>();
+  HostPlane *hp = hosts[hid].get();
+  hp->id = hid;
+  hp->eth_ip = ip;
+  hp->qdisc = qdisc_rr;
+  hp->bw_up_bits = up;
+  hp->bw_down_bits = down;
+  hp->lo.ip = LOCALHOST_IP;
+  hp->lo.idx = 0;
+  hp->eth.ip = ip;
+  hp->eth.idx = 1;
+  hp->relays[0].src = 0;                       // loopback (unlimited)
+  hp->relays[1].src = 1;                       // inet-out
+  hp->relays[1].bucket.config_for_bandwidth(up, mtu);
+  hp->relays[2].src = 2;                       // inet-in
+  hp->relays[2].bucket.config_for_bandwidth(down, mtu);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_set_callbacks(EngineObj *self, PyObject *args) {
+  PyObject *ev, *rng;
+  if (!PyArg_ParseTuple(args, "OO", &ev, &rng)) return nullptr;
+  Py_XINCREF(ev);
+  Py_XINCREF(rng);
+  Py_XDECREF(self->eng->cb_event);
+  Py_XDECREF(self->eng->cb_rng);
+  self->eng->cb_event = ev;
+  self->eng->cb_rng = rng;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_set_tracing(EngineObj *self, PyObject *args) {
+  int hid, flag;
+  if (!PyArg_ParseTuple(args, "ip", &hid, &flag)) return nullptr;
+  self->eng->plane(hid)->tracing = flag;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_next_event_seq(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  return PyLong_FromUnsignedLongLong(self->eng->plane(hid)->event_seq++);
+}
+
+static PyObject *eng_next_packet_seq(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  return PyLong_FromUnsignedLongLong(self->eng->plane(hid)->packet_seq++);
+}
+
+static PyObject *eng_peek_deadline(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  if (hp->theap.empty()) Py_RETURN_NONE;
+  const TimerEnt &e = hp->theap.front();
+  return Py_BuildValue("LK", (long long)e.time, (unsigned long long)e.seq);
+}
+
+static PyObject *eng_fire(EngineObj *self, PyObject *args) {
+  int hid;
+  long long now;
+  if (!PyArg_ParseTuple(args, "iL", &hid, &now)) return nullptr;
+  self->eng->fire(hid, now);
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_deliver(EngineObj *self, PyObject *args) {
+  int hid;
+  unsigned long long id;
+  long long now;
+  if (!PyArg_ParseTuple(args, "iKL", &hid, &id, &now)) return nullptr;
+  self->eng->deliver(hid, id, now);
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_take_outgoing(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  if (hp->outgoing.empty()) Py_RETURN_NONE;
+  PyObject *lst = PyList_New((Py_ssize_t)hp->outgoing.size());
+  for (size_t i = 0; i < hp->outgoing.size(); i++) {
+    uint64_t id = hp->outgoing[i];
+    PacketN *p = self->eng->store.get(id);
+    PyList_SET_ITEM(
+        lst, (Py_ssize_t)i,
+        Py_BuildValue("KIKi", (unsigned long long)id, (unsigned int)p->dst_ip,
+                      (unsigned long long)p->seq,
+                      p->is_empty_control() ? 1 : 0));
+  }
+  hp->outgoing.clear();
+  return lst;
+}
+
+static PyObject *eng_tcp_socket(EngineObj *self, PyObject *args) {
+  int hid, sat, rat;
+  long long sb, rb;
+  if (!PyArg_ParseTuple(args, "iLLpp", &hid, &sb, &rb, &sat, &rat))
+    return nullptr;
+  return PyLong_FromUnsignedLong(self->eng->new_tcp(hid, sb, rb, sat, rat));
+}
+
+static PyObject *eng_udp_socket(EngineObj *self, PyObject *args) {
+  int hid;
+  long long sb, rb;
+  if (!PyArg_ParseTuple(args, "iLL", &hid, &sb, &rb)) return nullptr;
+  return PyLong_FromUnsignedLong(self->eng->new_udp(hid, sb, rb));
+}
+
+static PyObject *eng_sock_bind(EngineObj *self, PyObject *args) {
+  unsigned int tok, ip;
+  int port;
+  if (!PyArg_ParseTuple(args, "IIi", &tok, &ip, &port)) return nullptr;
+  SocketN *s = self->eng->sock(tok);
+  int r = self->eng->generic_bind(self->eng->plane(s->host), s, tok, ip,
+                                  port);
+  CHECK_CB(self);
+  return PyLong_FromLong(r);
+}
+
+static PyObject *eng_tcp_listen(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  int backlog;
+  if (!PyArg_ParseTuple(args, "Ii", &tok, &backlog)) return nullptr;
+  return PyLong_FromLong(self->eng->tcp_listen(self->eng->tcp(tok),
+                                               backlog));
+}
+
+static PyObject *eng_tcp_connect(EngineObj *self, PyObject *args) {
+  unsigned int tok, ip;
+  int port;
+  long long now;
+  if (!PyArg_ParseTuple(args, "IIiL", &tok, &ip, &port, &now))
+    return nullptr;
+  TcpSocketN *s = self->eng->tcp(tok);
+  int r = self->eng->tcp_connect(self->eng->plane(s->host), s, tok, ip,
+                                 port, now);
+  CHECK_CB(self);
+  return PyLong_FromLong(r);
+}
+
+static PyObject *eng_tcp_accept(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  long long now;
+  if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
+  TcpSocketN *s = self->eng->tcp(tok);
+  int64_t r = self->eng->tcp_accept(self->eng->plane(s->host), s, now);
+  CHECK_CB(self);
+  return PyLong_FromLongLong((long long)r);
+}
+
+static PyObject *eng_tcp_sendto(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  Py_buffer data;
+  long long now;
+  if (!PyArg_ParseTuple(args, "Iy*L", &tok, &data, &now)) return nullptr;
+  TcpSocketN *s = self->eng->tcp(tok);
+  int64_t r = self->eng->tcp_sendto(self->eng->plane(s->host), s, tok,
+                                    (const char *)data.buf,
+                                    (int64_t)data.len, now);
+  PyBuffer_Release(&data);
+  CHECK_CB(self);
+  return PyLong_FromLongLong((long long)r);
+}
+
+static PyObject *eng_tcp_recv(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  long long bufsize, now;
+  int peek;
+  if (!PyArg_ParseTuple(args, "ILpL", &tok, &bufsize, &peek, &now))
+    return nullptr;
+  TcpSocketN *s = self->eng->tcp(tok);
+  std::string out;
+  int r = self->eng->tcp_recv(self->eng->plane(s->host), s, tok, bufsize,
+                              peek, now, &out);
+  CHECK_CB(self);
+  if (r < 0) return PyLong_FromLong(r);
+  return PyBytes_FromStringAndSize(out.data(), (Py_ssize_t)out.size());
+}
+
+static PyObject *eng_tcp_shutdown(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  long long now;
+  if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
+  TcpSocketN *s = self->eng->tcp(tok);
+  self->eng->tcp_shutdown_wr(self->eng->plane(s->host), s, tok, now);
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_sock_close(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  long long now;
+  if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
+  SocketN *s = self->eng->sock(tok);
+  if (s->proto == PROTO_TCP)
+    self->eng->tcp_close(self->eng->plane(s->host),
+                         static_cast<TcpSocketN *>(s), tok, now);
+  else
+    self->eng->udp_close(self->eng->plane(s->host),
+                         static_cast<UdpSocketN *>(s));
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_udp_sendto(EngineObj *self, PyObject *args) {
+  unsigned int tok, dst_ip;
+  Py_buffer data;
+  int has_dst, dst_port;
+  long long now;
+  if (!PyArg_ParseTuple(args, "Iy*pIiL", &tok, &data, &has_dst, &dst_ip,
+                        &dst_port, &now))
+    return nullptr;
+  UdpSocketN *s = self->eng->udp(tok);
+  int64_t r = self->eng->udp_sendto(self->eng->plane(s->host), s, tok,
+                                    (const char *)data.buf,
+                                    (int64_t)data.len, has_dst, dst_ip,
+                                    dst_port, now);
+  PyBuffer_Release(&data);
+  CHECK_CB(self);
+  return PyLong_FromLongLong((long long)r);
+}
+
+static PyObject *eng_udp_recvfrom(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  long long bufsize;
+  int peek;
+  if (!PyArg_ParseTuple(args, "ILp", &tok, &bufsize, &peek)) return nullptr;
+  UdpSocketN *s = self->eng->udp(tok);
+  std::string out;
+  uint32_t src_ip = 0;
+  int src_port = 0;
+  int r = self->eng->udp_recvfrom(s, bufsize, peek, &out, &src_ip,
+                                  &src_port);
+  CHECK_CB(self);
+  if (r < 0) return PyLong_FromLong(r);
+  return Py_BuildValue("y#Ii", out.data(), (Py_ssize_t)out.size(),
+                       (unsigned int)src_ip, src_port);
+}
+
+static PyObject *eng_udp_connect(EngineObj *self, PyObject *args) {
+  unsigned int tok, ip;
+  int port;
+  if (!PyArg_ParseTuple(args, "IIi", &tok, &ip, &port)) return nullptr;
+  UdpSocketN *s = self->eng->udp(tok);
+  s->has_peer = true;
+  s->peer_ip = ip;
+  s->peer_port = port;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_udp_push_reply(EngineObj *self, PyObject *args) {
+  unsigned int tok, src_ip;
+  Py_buffer data;
+  int src_port;
+  long long now;
+  if (!PyArg_ParseTuple(args, "Iy*IiL", &tok, &data, &src_ip, &src_port,
+                        &now))
+    return nullptr;
+  UdpSocketN *s = self->eng->udp(tok);
+  self->eng->udp_push_reply(self->eng->plane(s->host), s,
+                            (const char *)data.buf, (int64_t)data.len,
+                            src_ip, src_port, now);
+  PyBuffer_Release(&data);
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_sock_set(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  const char *name;
+  int value;
+  if (!PyArg_ParseTuple(args, "Isi", &tok, &name, &value)) return nullptr;
+  SocketN *s = self->eng->sock(tok);
+  if (!strcmp(name, "nonblocking")) {
+    s->nonblocking = value;
+  } else {
+    PyErr_Format(PyExc_ValueError, "unknown sock option %s", name);
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_tcp_set_nodelay(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  int value;
+  long long now;
+  if (!PyArg_ParseTuple(args, "IiL", &tok, &value, &now)) return nullptr;
+  TcpSocketN *t = self->eng->tcp(tok);
+  if (t) {
+    t->nodelay = value;
+    if (t->conn) {
+      t->conn->nodelay = value;
+      if (value && now >= 0) {
+        /* Linux flushes Nagle-held data on TCP_NODELAY (object-path
+         * twin: sys_setsockopt's push_data + flush).  now < 0 =
+         * attribute-style set with no clock in hand (pre-connect). */
+        t->conn->push_data(now);
+        self->eng->tcp_flush(self->eng->plane(t->host), t, tok, now);
+      }
+    }
+  }
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_tcp_bufs(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  if (!PyArg_ParseTuple(args, "I", &tok)) return nullptr;
+  TcpSocketN *t = self->eng->tcp(tok);
+  if (!t || !t->conn) Py_RETURN_NONE;
+  return Py_BuildValue("LL", (long long)t->conn->send_buf_max,
+                       (long long)t->conn->recv_buf_max);
+}
+
+static PyObject *eng_sock_status(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  if (!PyArg_ParseTuple(args, "I", &tok)) return nullptr;
+  return PyLong_FromUnsignedLong(self->eng->sock(tok)->status);
+}
+
+static PyObject *eng_sock_addr(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  if (!PyArg_ParseTuple(args, "I", &tok)) return nullptr;
+  SocketN *s = self->eng->sock(tok);
+  return Py_BuildValue("(iIi)(iIi)", s->has_local ? 1 : 0,
+                       (unsigned int)s->local_ip, s->local_port,
+                       s->has_peer ? 1 : 0, (unsigned int)s->peer_ip,
+                       s->peer_port);
+}
+
+static PyObject *eng_tcp_info(EngineObj *self, PyObject *args) {
+  unsigned int tok;
+  if (!PyArg_ParseTuple(args, "I", &tok)) return nullptr;
+  TcpSocketN *s = self->eng->tcp(tok);
+  if (!s || !s->conn) Py_RETURN_NONE;
+  TcpConn *c = s->conn.get();
+  return Py_BuildValue("isLLLLLi", c->state, c->error.c_str(),
+                       (long long)c->srtt, (long long)c->cwnd,
+                       (long long)c->rto, (long long)c->retransmit_count,
+                       (long long)c->sacked_skip_count, c->eff_mss);
+}
+
+static PyObject *eng_drop_packet(EngineObj *self, PyObject *args) {
+  int hid;
+  unsigned long long id;
+  const char *reason;
+  long long at_time;
+  if (!PyArg_ParseTuple(args, "iKsL", &hid, &id, &reason, &at_time))
+    return nullptr;
+  Engine *e = self->eng;
+  PacketN *p = e->store.get(id);
+  if (p) {
+    e->trace_drop(e->plane(hid), p, intern_reason(reason), at_time);
+    e->store.free_pkt(id);
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_free_packet(EngineObj *self, PyObject *args) {
+  unsigned long long id;
+  if (!PyArg_ParseTuple(args, "K", &id)) return nullptr;
+  self->eng->store.free_pkt(id);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_packet_fields(EngineObj *self, PyObject *args) {
+  unsigned long long id;
+  if (!PyArg_ParseTuple(args, "K", &id)) return nullptr;
+  PacketN *p = self->eng->store.get(id);
+  if (!p) Py_RETURN_NONE;
+  PyObject *tcp;
+  if (p->has_tcp) {
+    PyObject *sacks = PyTuple_New(p->tcp.n_sacks);
+    for (int i = 0; i < p->tcp.n_sacks; i++)
+      PyTuple_SET_ITEM(sacks, i,
+                       Py_BuildValue("II", p->tcp.sacks[i].start,
+                                     p->tcp.sacks[i].end));
+    tcp = Py_BuildValue("IIiLiiN", p->tcp.seq, p->tcp.ack, p->tcp.flags,
+                        (long long)p->tcp.window, (int)p->tcp.wscale,
+                        (int)p->tcp.mss, sacks);
+  } else {
+    tcp = Py_None;
+    Py_INCREF(tcp);
+  }
+  return Py_BuildValue("iKiIiIiy#N", p->src_host,
+                       (unsigned long long)p->seq, p->proto,
+                       (unsigned int)p->src_ip, p->src_port,
+                       (unsigned int)p->dst_ip, p->dst_port,
+                       p->payload.data(), (Py_ssize_t)p->payload.size(),
+                       tcp);
+}
+
+static PyObject *eng_intern_packet(EngineObj *self, PyObject *args) {
+  int src_host, proto, src_port, dst_port;
+  unsigned long long seq;
+  unsigned int src_ip, dst_ip;
+  Py_buffer payload;
+  PyObject *tcp;
+  if (!PyArg_ParseTuple(args, "iKiIiIiy*O", &src_host, &seq, &proto,
+                        &src_ip, &src_port, &dst_ip, &dst_port, &payload,
+                        &tcp))
+    return nullptr;
+  Engine *e = self->eng;
+  uint64_t id = e->store.alloc();
+  PacketN *p = e->store.get(id);
+  p->src_host = src_host;
+  p->seq = seq;
+  p->proto = proto;
+  p->src_ip = src_ip;
+  p->src_port = src_port;
+  p->dst_ip = dst_ip;
+  p->dst_port = dst_port;
+  p->payload.assign((const char *)payload.buf, (size_t)payload.len);
+  PyBuffer_Release(&payload);
+  if (tcp != Py_None) {
+    p->has_tcp = true;
+    long long window;
+    int wscale, mss;
+    PyObject *sacks;
+    if (!PyArg_ParseTuple(tcp, "IIiLiiO", &p->tcp.seq, &p->tcp.ack,
+                          &p->tcp.flags, &window, &wscale, &mss, &sacks)) {
+      e->store.free_pkt(id);
+      return nullptr;
+    }
+    p->tcp.window = window;
+    p->tcp.wscale = wscale;
+    p->tcp.mss = mss;
+    Py_ssize_t ns = PyTuple_GET_SIZE(sacks);
+    p->tcp.n_sacks = (int)std::min(ns, (Py_ssize_t)MAX_SACK_BLOCKS);
+    for (int i = 0; i < p->tcp.n_sacks; i++) {
+      PyObject *blk = PyTuple_GET_ITEM(sacks, i);
+      p->tcp.sacks[i].start =
+          (uint32_t)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(blk, 0));
+      p->tcp.sacks[i].end =
+          (uint32_t)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(blk, 1));
+    }
+  }
+  return PyLong_FromUnsignedLongLong(id);
+}
+
+static PyObject *eng_trace_entries(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  PyObject *lst = PyList_New((Py_ssize_t)hp->trace.size());
+  for (size_t i = 0; i < hp->trace.size(); i++) {
+    const TraceRec &r = hp->trace[i];
+    PyList_SET_ITEM(lst, (Py_ssize_t)i,
+                    Py_BuildValue("LiiKN", (long long)r.time, r.kind,
+                                  r.src_host, (unsigned long long)r.pkt_seq,
+                                  format_trace_text(r)));
+  }
+  return lst;
+}
+
+static PyObject *eng_counters(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  return Py_BuildValue("LLL", (long long)hp->pkts_sent,
+                       (long long)hp->pkts_recv,
+                       (long long)hp->pkts_dropped);
+}
+
+static PyMethodDef eng_methods[] = {
+    {"add_host", (PyCFunction)eng_add_host, METH_VARARGS, nullptr},
+    {"set_callbacks", (PyCFunction)eng_set_callbacks, METH_VARARGS, nullptr},
+    {"set_tracing", (PyCFunction)eng_set_tracing, METH_VARARGS, nullptr},
+    {"next_event_seq", (PyCFunction)eng_next_event_seq, METH_VARARGS,
+     nullptr},
+    {"next_packet_seq", (PyCFunction)eng_next_packet_seq, METH_VARARGS,
+     nullptr},
+    {"peek_deadline", (PyCFunction)eng_peek_deadline, METH_VARARGS, nullptr},
+    {"fire", (PyCFunction)eng_fire, METH_VARARGS, nullptr},
+    {"deliver", (PyCFunction)eng_deliver, METH_VARARGS, nullptr},
+    {"take_outgoing", (PyCFunction)eng_take_outgoing, METH_VARARGS, nullptr},
+    {"tcp_socket", (PyCFunction)eng_tcp_socket, METH_VARARGS, nullptr},
+    {"udp_socket", (PyCFunction)eng_udp_socket, METH_VARARGS, nullptr},
+    {"sock_bind", (PyCFunction)eng_sock_bind, METH_VARARGS, nullptr},
+    {"tcp_listen", (PyCFunction)eng_tcp_listen, METH_VARARGS, nullptr},
+    {"tcp_connect", (PyCFunction)eng_tcp_connect, METH_VARARGS, nullptr},
+    {"tcp_accept", (PyCFunction)eng_tcp_accept, METH_VARARGS, nullptr},
+    {"tcp_sendto", (PyCFunction)eng_tcp_sendto, METH_VARARGS, nullptr},
+    {"tcp_recv", (PyCFunction)eng_tcp_recv, METH_VARARGS, nullptr},
+    {"tcp_shutdown", (PyCFunction)eng_tcp_shutdown, METH_VARARGS, nullptr},
+    {"sock_close", (PyCFunction)eng_sock_close, METH_VARARGS, nullptr},
+    {"udp_sendto", (PyCFunction)eng_udp_sendto, METH_VARARGS, nullptr},
+    {"udp_recvfrom", (PyCFunction)eng_udp_recvfrom, METH_VARARGS, nullptr},
+    {"udp_connect", (PyCFunction)eng_udp_connect, METH_VARARGS, nullptr},
+    {"udp_push_reply", (PyCFunction)eng_udp_push_reply, METH_VARARGS,
+     nullptr},
+    {"sock_set", (PyCFunction)eng_sock_set, METH_VARARGS, nullptr},
+    {"tcp_set_nodelay", (PyCFunction)eng_tcp_set_nodelay, METH_VARARGS,
+     nullptr},
+    {"tcp_bufs", (PyCFunction)eng_tcp_bufs, METH_VARARGS, nullptr},
+    {"sock_status", (PyCFunction)eng_sock_status, METH_VARARGS, nullptr},
+    {"sock_addr", (PyCFunction)eng_sock_addr, METH_VARARGS, nullptr},
+    {"tcp_info", (PyCFunction)eng_tcp_info, METH_VARARGS, nullptr},
+    {"drop_packet", (PyCFunction)eng_drop_packet, METH_VARARGS, nullptr},
+    {"free_packet", (PyCFunction)eng_free_packet, METH_VARARGS, nullptr},
+    {"packet_fields", (PyCFunction)eng_packet_fields, METH_VARARGS, nullptr},
+    {"intern_packet", (PyCFunction)eng_intern_packet, METH_VARARGS, nullptr},
+    {"trace_entries", (PyCFunction)eng_trace_entries, METH_VARARGS, nullptr},
+    {"counters", (PyCFunction)eng_counters, METH_VARARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static void eng_dealloc(EngineObj *self) {
+  Py_XDECREF(self->eng->cb_event);
+  Py_XDECREF(self->eng->cb_rng);
+  delete self->eng;
+  Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *eng_new(PyTypeObject *type, PyObject *, PyObject *) {
+  EngineObj *self = (EngineObj *)type->tp_alloc(type, 0);
+  if (self) self->eng = new Engine();
+  return (PyObject *)self;
+}
+
+static PyTypeObject EngineType = [] {
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_netplane.Engine";
+  t.tp_basicsize = sizeof(EngineObj);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_new = eng_new;
+  t.tp_dealloc = (destructor)eng_dealloc;
+  t.tp_methods = eng_methods;
+  return t;
+}();
+
+static PyModuleDef netplane_module = {
+    PyModuleDef_HEAD_INIT, "_netplane",
+    "Native per-host network data plane (C++ port of the Python plane)",
+    -1, nullptr, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__netplane(void) {
+  if (PyType_Ready(&EngineType) < 0) return nullptr;
+  PyObject *m = PyModule_Create(&netplane_module);
+  if (!m) return nullptr;
+  Py_INCREF(&EngineType);
+  PyModule_AddObject(m, "Engine", (PyObject *)&EngineType);
+  PyModule_AddIntConstant(m, "R_BLOCK", Engine::R_BLOCK);
+  PyModule_AddIntConstant(m, "TRACE_SND", TRACE_SND);
+  PyModule_AddIntConstant(m, "TRACE_DRP", TRACE_DRP);
+  PyModule_AddIntConstant(m, "TRACE_RCV", TRACE_RCV);
+  PyModule_AddIntConstant(m, "CB_STATUS", CB_STATUS);
+  PyModule_AddIntConstant(m, "CB_CHILD_BORN", CB_CHILD_BORN);
+  PyModule_AddIntConstant(m, "CB_CHILD_DEAD", CB_CHILD_DEAD);
+  PyModule_AddIntConstant(m, "ST_ESTABLISHED", ST_ESTABLISHED);
+  PyModule_AddIntConstant(m, "ST_CLOSED", ST_CLOSED);
+  PyModule_AddIntConstant(m, "ST_TIME_WAIT", ST_TIME_WAIT);
+  return m;
+}

@@ -12,7 +12,7 @@ bench.run_once(bench.config3, sched)
 
 manager = Manager(bench.config3(sched))
 for h in manager.hosts:
-    h.tracing_enabled = False
+    h.set_tracing(False)
 pr = cProfile.Profile()
 pr.enable()
 manager.run()

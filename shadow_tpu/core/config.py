@@ -116,6 +116,13 @@ class ExperimentalConfig:
     # (static shape). Overflow is delivered host-side — a performance
     # fallback, never a correctness one.
     tpu_exchange_capacity: int = 1 << 12
+    # Native (C++) data plane for scheduler=tpu: "auto" uses it when the
+    # extension builds, "on" requires it (error if unavailable), "off"
+    # forces the pure-Python object path.  Hosts with pcap capture or a
+    # CPU model fall back to the object path individually; traces are
+    # byte-identical either way (the cross-scheduler determinism gates
+    # are the parity proof).
+    native_dataplane: str = "auto"
     # Pin worker threads to distinct CPUs (ref: affinity.c, on by
     # default; docs/parallel_sims.md reports ~3x cost when off).
     use_cpu_pinning: bool = True
@@ -189,6 +196,7 @@ class ConfigOptions:
                 "tpu_min_device_batch": e.tpu_min_device_batch,
                 "tpu_shards": e.tpu_shards,
                 "tpu_exchange_capacity": e.tpu_exchange_capacity,
+                "native_dataplane": e.native_dataplane,
                 "use_cpu_pinning": e.use_cpu_pinning,
                 "use_perf_timers": e.use_perf_timers,
                 "report_errors_to_stderr": e.report_errors_to_stderr,
@@ -310,6 +318,7 @@ class ConfigOptions:
                 ("tpu_min_device_batch", "tpu_min_device_batch", int),
                 ("tpu_shards", "tpu_shards", int),
                 ("tpu_exchange_capacity", "tpu_exchange_capacity", int),
+                ("native_dataplane", "native_dataplane", str),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
                 ("use_perf_timers", "use_perf_timers", bool),
                 ("report_errors_to_stderr", "report_errors_to_stderr", bool)):

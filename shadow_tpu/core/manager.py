@@ -165,7 +165,31 @@ class Manager:
         sched = config.experimental.scheduler
         threaded = sched in ("thread_per_core", "thread_per_host")
         self._per_host_tasks = sched == "thread_per_host"
-        self._next_times: list = []  # per-host next-event snapshot
+        self._nt: list = []          # shared per-host next-event snapshot
+        self._run_all_hosts = False  # device-barrier mode: no idle filter
+
+        # Native (C++) data plane: the performance path behind
+        # scheduler=tpu.  Per-host opt-out keeps pcap capture and the
+        # CPU model on the object path; both planes interop through the
+        # propagator (cross-plane packet conversion).
+        self.plane = None
+        native_mode = config.experimental.native_dataplane
+        if sched == "tpu" and native_mode != "off" \
+                and config.experimental.tpu_shards == 1:
+            from shadow_tpu.native import plane as native_plane
+            if native_plane.native_available():
+                self.plane = native_plane.NativePlane(self.hosts)
+                qdisc_rr = config.experimental.interface_qdisc == \
+                    "round_robin"
+                for host in self.hosts:
+                    if host.cpu is None and not \
+                            config.hosts[host.name].pcap_enabled:
+                        self.plane.add_host(host, qdisc_rr)
+            elif native_mode == "on":
+                raise RuntimeError(
+                    f"native_dataplane=on but the engine is unavailable: "
+                    f"{native_plane.load_error()}")
+
         if sched == "tpu" and config.experimental.tpu_shards > 1:
             from shadow_tpu.parallel.mesh_propagator import MeshPropagator
             self.propagator = MeshPropagator(
@@ -190,6 +214,8 @@ class Manager:
                 runahead=self.runahead)
         for host in self.hosts:
             host._send_packet_fn = self.propagator.send
+            if host.plane is not None:
+                host._send_native_fn = self.propagator.send_native
 
         self._perf_timers = config.experimental.use_perf_timers
         if self._perf_timers and threaded:
@@ -279,39 +305,38 @@ class Manager:
     # The round loop (manager.rs:415-501)
     # ------------------------------------------------------------------
 
-    def _min_next_event(self) -> int | None:
-        """One pass over hosts: the global minimum for the barrier, and
-        a cached per-host next-event snapshot that _run_hosts reuses for
-        its idle filter (avoids a second full peek scan per round).
-        Snapshot staleness is safe: events only appear between the scan
-        and the next round via inbox deliveries, which the idle filter
-        checks directly."""
-        best = None
-        times = []
+    def _init_next_times(self) -> None:
+        """Build the shared next-event snapshot (one slot per host).
+        After this, maintenance is incremental: each host writes its own
+        slot at the end of execute(), and cross-host deliveries lower
+        the destination slot under the inbox lock — the per-round
+        barrier is one min() over a flat list instead of 2N queue peeks
+        (the reference reduces per-thread minimums the same lazy way,
+        manager.rs:447-487)."""
+        from shadow_tpu.core.simtime import TIME_NEVER
+        nt = []
         for h in self.hosts:
-            t = h.queue.peek_time()
-            times.append(t)
-            if t is not None and (best is None or t < best):
-                best = t
-        self._next_times = times
-        return best
+            t = h.next_event_time()
+            nt.append(TIME_NEVER if t is None else t)
+        self._nt = nt
+        for h in self.hosts:
+            h._nt_list = nt
+
+    def _min_next_event(self) -> int | None:
+        from shadow_tpu.core.simtime import TIME_NEVER
+        best = min(self._nt)
+        return None if best >= TIME_NEVER else best
 
     def _active_hosts(self, until: int) -> list:
         """Hosts whose `execute(until)` would do work: an inbox delivery
-        pending, or a heap event inside the window (from the snapshot
-        taken by the last _min_next_event scan).  At scale most hosts
-        are idle most rounds; skipping them is a pure win because the
-        barrier already covers in-flight packets via the propagator's
-        finish_round min (a mid-round inbox append just runs next
-        round, exactly as if the host had executed)."""
-        times = self._next_times
-        if not times:
-            return self.hosts
-        out = []
-        for h, t in zip(self.hosts, times):
-            if h._inbox or (t is not None and t < until):
-                out.append(h)
-        return out
+        pending, or an event inside the window per the shared snapshot.
+        At scale most hosts are idle most rounds; skipping them is a
+        pure win because the barrier already covers in-flight packets
+        via the propagator's finish_round min (a mid-round inbox append
+        just runs next round, exactly as if the host had executed)."""
+        nt = self._nt
+        return [h for h in self.hosts
+                if nt[h.id] < until or h._inbox]
 
     def _run_hosts(self, until: int) -> None:
         if self._perf_timers:
@@ -323,7 +348,8 @@ class Manager:
                 h.execute(until)
                 h.perf_exec_ns += time.perf_counter_ns() - t0
             return
-        active = self._active_hosts(until)
+        active = self.hosts if self._run_all_hosts \
+            else self._active_hosts(until)
         if self._pool is None:
             for h in active:
                 h.execute(until)
@@ -373,12 +399,14 @@ class Manager:
         # min-next-event reduction itself (lax.pmin over the mesh in the
         # sharded backend) — the Python-side host scan is bypassed.
         device_barrier = getattr(self.propagator, "provides_barrier", False)
+        self._init_next_times()
         start = self._min_next_event()
         if device_barrier:
-            # The mesh backend computes the barrier itself and this loop
-            # never rescans hosts, so the per-host snapshot would go
-            # stale — drop it and run every host each round.
-            self._next_times.clear()
+            # The mesh backend computes the barrier itself (pmin) and
+            # delivers exchange overflow outside deliver_packet_event,
+            # so the incremental snapshot cannot be trusted — run every
+            # host each round until the mesh path maintains it.
+            self._run_all_hosts = True
         while start is not None and start < stop:
             window_end = min(start + self.runahead.get(), stop)
             self.propagator.begin_round(start, window_end)
@@ -410,6 +438,7 @@ class Manager:
 
         # Final accounting (manager.rs:546-569).
         for h in self.hosts:
+            h.merge_native_counters()
             summary.events += h.counters["events"]
             summary.packets_sent += h.counters["packets_sent"]
             summary.packets_recv += h.counters["packets_recv"]
@@ -453,6 +482,8 @@ class Manager:
         reference, so keep it stable once published)."""
         wall = time.perf_counter() - wall_start
         pct = 100.0 * sim_now / stop if stop else 100.0
+        for h in self.hosts:
+            h.merge_native_counters()
         events = sum(h.counters["events"] for h in self.hosts)
         packets = sum(h.counters["packets_sent"] for h in self.hosts)
         mem_kb = _rss_kb()

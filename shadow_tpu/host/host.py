@@ -17,6 +17,7 @@ from collections import deque
 from shadow_tpu.core.event import (Event, EventQueue, KIND_LOCAL, KIND_PACKET,
                                    TaskRef)
 from shadow_tpu.core.rng import HostRng
+from shadow_tpu.core.simtime import TIME_NEVER
 from shadow_tpu.net.graph import LOCALHOST_IP, format_ip
 from shadow_tpu.net.interface import NetworkInterface
 from shadow_tpu.net.packet import PROTO_TCP
@@ -87,6 +88,21 @@ class Host:
         # Set by the scheduler before the first round.
         self._send_packet_fn = None
 
+        # Native data plane (shadow_tpu/native/plane.py): when attached,
+        # the C++ engine owns this host's inet sockets/queues/timers and
+        # the event/packet seq counters; None = pure-Python object path.
+        self.plane = None
+        self._nsocks: dict[int, object] = {}  # engine token -> proxy
+        self._send_native_fn = None           # propagator.send_native
+        self._native_merged = (0, 0, 0)       # counters merged so far
+
+        # Shared next-event snapshot (manager._nt): each host writes its
+        # own slot at the end of execute(); cross-host deliveries lower
+        # the destination slot under the inbox lock.  The manager's
+        # barrier is then one min() over the list instead of a peek
+        # into every host's queues each round.
+        self._nt_list = None
+
         # Canonical packet trace: (time, kind, src_host, pkt_seq, text).
         self.trace_entries: list = []
         self.tracing_enabled = True
@@ -115,11 +131,18 @@ class Host:
         return self._now
 
     def next_event_seq(self) -> int:
+        if self.plane is not None:
+            # One shared counter: engine-internal draws (timer arms,
+            # relay parks) interleave with Python draws exactly as the
+            # object path would.
+            return self.plane.engine.next_event_seq(self.id)
         s = self._event_seq
         self._event_seq += 1
         return s
 
     def next_packet_seq(self) -> int:
+        if self.plane is not None:
+            return self.plane.engine.next_packet_seq(self.id)
         s = self._packet_seq
         self._packet_seq += 1
         return s
@@ -146,6 +169,9 @@ class Host:
             self.queue.push(ev)
 
     def execute(self, until: int) -> None:
+        if self.plane is not None:
+            self._execute_native(until)
+            return
         self.drain_inbox()
         q = self.queue
         cpu = self.cpu
@@ -175,9 +201,73 @@ class Host:
                 # native wall time here — nondeterministic, perf_timers
                 # gated; a fixed modeled cost keeps runs bit-identical).
                 cpu.add_delay(self.cpu_event_cost_ns)
+        self._update_nt_slot()
+
+    def _execute_native(self, until: int) -> None:
+        """Round execution with the native plane: merge the Python event
+        heap with the engine's internal deadline heap under the one
+        total order (time, kind, src, seq) — engine entries are always
+        KIND_LOCAL from this host, with seqs drawn from the shared
+        counter, so the merged dispatch order is bit-identical to the
+        object path's single heap."""
+        self.drain_inbox()
+        q = self.queue
+        heap = q._heap
+        eng = self.plane.engine
+        hid = self.id
+        counters = self.counters
+        deliver = eng.deliver
+        fire = eng.fire
+        take = eng.take_outgoing
+        send = self._send_native_fn
+        while True:
+            d = eng.peek_deadline(hid)
+            use_eng = False
+            if heap:
+                t = heap[0][0]
+                if d is not None and (d[0], KIND_LOCAL, hid, d[1]) \
+                        < heap[0][:4]:
+                    t = d[0]
+                    use_eng = True
+            elif d is not None:
+                t = d[0]
+                use_eng = True
+            else:
+                break
+            if t >= until:
+                break
+            self._now = t
+            counters["events"] += 1
+            if use_eng:
+                fire(hid, t)
+            else:
+                ev = q.pop()
+                data = ev.data
+                if ev.kind == KIND_PACKET:
+                    if type(data) is int:
+                        deliver(hid, data, t)
+                    else:
+                        self.router.route_incoming_packet(self, data)
+                else:
+                    data.execute(self)
+            out = take(hid)
+            if out is not None:
+                for pkt_id, dst_ip, pkt_seq, is_ctl in out:
+                    send(self, pkt_id, dst_ip, pkt_seq, is_ctl)
+        self._update_nt_slot()
+
+    def _update_nt_slot(self) -> None:
+        if self._nt_list is not None:
+            t = self.next_event_time()
+            self._nt_list[self.id] = TIME_NEVER if t is None else t
 
     def next_event_time(self):
-        return self.queue.peek_time()
+        t = self.queue.peek_time()
+        if self.plane is not None:
+            d = self.plane.engine.peek_deadline(self.id)
+            if d is not None and (t is None or d[0] < t):
+                return d[0]
+        return t
 
     # ------------------------------------------------------------------
     # Packet plane wiring
@@ -212,6 +302,9 @@ class Host:
         so the owner cannot need it before its next drain."""
         with self._inbox_lock:
             self._inbox.append(event)
+            nt = self._nt_list
+            if nt is not None and event.time < nt[self.id]:
+                nt[self.id] = event.time
 
     # ------------------------------------------------------------------
     # Processes
@@ -264,9 +357,29 @@ class Host:
         self.counters["packets_recv"] += 1
         self.trace_packet(TRACE_RCV, packet)
 
+    def merge_native_counters(self) -> None:
+        """Fold the engine's packet counters into self.counters
+        (incremental: safe to call from heartbeats and final stats)."""
+        if self.plane is None:
+            return
+        sent, recv, dropped = self.plane.engine.counters(self.id)
+        ps, pr, pd = self._native_merged
+        self.counters["packets_sent"] += sent - ps
+        self.counters["packets_recv"] += recv - pr
+        self.counters["packets_dropped"] += dropped - pd
+        self._native_merged = (sent, recv, dropped)
+
+    def set_tracing(self, enabled: bool) -> None:
+        self.tracing_enabled = enabled
+        if self.plane is not None:
+            self.plane.engine.set_tracing(self.id, enabled)
+
     def trace_lines(self) -> list[str]:
         """Canonically sorted, scheduler-independent trace lines."""
+        entries = self.trace_entries
+        if self.plane is not None:
+            entries = entries + self.plane.engine.trace_entries(self.id)
         out = []
-        for time, kind, src, seq, text in sorted(self.trace_entries):
+        for time, kind, src, seq, text in sorted(entries):
             out.append(f"{time} {self.name} {text}")
         return out

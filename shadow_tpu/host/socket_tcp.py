@@ -220,6 +220,9 @@ class TcpSocket(StatusOwner):
                 child.close(host)
                 from shadow_tpu.utils.object_counter import mark_dealloc
                 mark_dealloc(child)
+                # Accounting done here; the eventual teardown (once the
+                # FIN exchange completes) must not mark a second time.
+                child._delivered = True
             self._accept_q.clear()
             self._teardown(host)
             return

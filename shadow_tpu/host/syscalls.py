@@ -80,17 +80,26 @@ class SyscallHandler:
 
     def sys_socket(self, host, process, thread, restarted, kind: str,
                    nonblocking: bool = False):
+        native = host.plane is not None
         if kind in ("udp", "dgram"):
-            sock = UdpSocket(host, self.send_buf, self.recv_buf)
+            if native:
+                from shadow_tpu.host.socket_native import \
+                    UdpSocket as NativeUdp
+                sock = NativeUdp(host, self.send_buf, self.recv_buf)
+            else:
+                sock = UdpSocket(host, self.send_buf, self.recv_buf)
         elif kind in ("tcp", "stream"):
-            try:
+            if native:
+                from shadow_tpu.host.socket_native import \
+                    TcpSocket as NativeTcp
+                sock = NativeTcp(host, self.send_buf, self.recv_buf,
+                                 send_autotune=self.send_autotune,
+                                 recv_autotune=self.recv_autotune)
+            else:
                 from shadow_tpu.host.socket_tcp import TcpSocket
-            except ImportError:
-                return _error(errno.EPROTONOSUPPORT,
-                              "TCP sockets not available yet")
-            sock = TcpSocket(host, self.send_buf, self.recv_buf,
-                             send_autotune=self.send_autotune,
-                             recv_autotune=self.recv_autotune)
+                sock = TcpSocket(host, self.send_buf, self.recv_buf,
+                                 send_autotune=self.send_autotune,
+                                 recv_autotune=self.recv_autotune)
         else:
             return _error(errno.EINVAL, f"bad socket kind {kind!r}")
         sock.nonblocking = bool(nonblocking)

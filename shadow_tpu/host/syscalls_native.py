@@ -299,8 +299,20 @@ class NativeSyscallHandler:
             return _done(self._register(process, sock, cloexec=cloexec))
         if domain != AF_INET or base_type not in (SOCK_STREAM, SOCK_DGRAM):
             return _native()
+        native = host.plane is not None
         if base_type == SOCK_DGRAM:
-            sock = UdpSocket(host, self.send_buf, self.recv_buf)
+            if native:
+                from shadow_tpu.host.socket_native import \
+                    UdpSocket as NativeUdp
+                sock = NativeUdp(host, self.send_buf, self.recv_buf)
+            else:
+                sock = UdpSocket(host, self.send_buf, self.recv_buf)
+        elif native:
+            from shadow_tpu.host.socket_native import \
+                TcpSocket as NativeTcp
+            sock = NativeTcp(host, self.send_buf, self.recv_buf,
+                             send_autotune=self.send_autotune,
+                             recv_autotune=self.recv_autotune)
         else:
             from shadow_tpu.host.socket_tcp import TcpSocket
             sock = TcpSocket(host, self.send_buf, self.recv_buf,
@@ -403,7 +415,7 @@ class NativeSyscallHandler:
     def _sock_send(self, host, process, sock, data: bytes, dst, flags: int):
         """Uniform send: inet (dst = (ip, port)), unix (dst = name str),
         netlink (dst ignored)."""
-        if isinstance(sock, UdpSocket):
+        if getattr(sock, "protocol", None) == 17:  # UDP, either plane
             # Port-53 interception must also catch the connect()+send()
             # shape libc's resolver uses (dst comes from the socket
             # peer).
@@ -473,6 +485,9 @@ class NativeSyscallHandler:
             return None
         if sock.local is None:
             sock.bind(host, 0, 0)  # INADDR_ANY, ephemeral
+        if hasattr(sock, "push_reply"):  # native-plane UDP proxy
+            sock.push_reply(host, resp, dst[0], 53)
+            return _done(len(data))
         local_ip = sock.local[0] or host.eth0.ip
         reply = pkt.Packet(host.id, host.next_packet_seq(), pkt.PROTO_UDP,
                            dst[0], 53, local_ip, sock.local[1],
@@ -814,6 +829,9 @@ class NativeSyscallHandler:
         # are recorded-but-inert — enough surface for common apps.
         if level == 6 and optname == 1 and optlen >= 4:
             val = struct.unpack("<i", process.mem.read(optval, 4))[0]
+            if hasattr(sock, "set_nodelay"):  # native-plane proxy
+                sock.set_nodelay(host, bool(val))
+                return _done(0)
             sock.nodelay = bool(val)
             conn = getattr(sock, "conn", None)
             if conn is not None:

@@ -43,7 +43,7 @@ def ensure_shim_built() -> str:
         return SHIM_SO
     if not os.path.isdir(_SRC_DIR):
         raise RuntimeError(f"native sources not found at {_SRC_DIR}")
-    proc = subprocess.run(["make", "-C", _SRC_DIR, "all"],
+    proc = subprocess.run(["make", "-C", _SRC_DIR, "shim"],
                           capture_output=True, text=True)
     if proc.returncode != 0 or not os.path.exists(SHIM_SO):
         raise RuntimeError(

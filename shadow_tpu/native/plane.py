@@ -1,0 +1,123 @@
+"""Native data-plane loader + per-simulation wrapper.
+
+The engine (`_netplane`, built from native/netplane.cpp) owns every
+host's inet data plane; this module builds/loads the extension and wires
+the engine's callbacks back into the Python simulation:
+
+ - status changes   -> proxy StatusOwner.adjust_status (listeners fire
+                       at exactly the object path's instants);
+ - child born/died  -> proxy registry + object-lifecycle accounting;
+ - RNG draws        -> the host's one deterministic stream.
+
+One NativePlane per Manager; hosts share the engine (cross-host packet
+handles stay valid end to end).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from shadow_tpu.native import LIB_DIR, _SRC_DIR, _stale
+
+R_BLOCK = 1000000  # engine "park on a condition" return (netplane.cpp)
+
+_mod = None
+_load_error: str | None = None
+
+
+def load_netplane():
+    """Import (building if stale) the _netplane extension; returns the
+    module or None (with the failure recorded for error surfaces)."""
+    global _mod, _load_error
+    if _mod is not None:
+        return _mod
+    if _load_error is not None:
+        return None
+    import sysconfig
+    ext = sysconfig.get_config_var("EXT_SUFFIX")
+    target = os.path.join(LIB_DIR, f"_netplane{ext}")
+    sources = [os.path.join(_SRC_DIR, f)
+               for f in ("netplane.cpp", "Makefile")]
+    if _stale(target, sources):
+        proc = subprocess.run(["make", "-C", _SRC_DIR, "netplane"],
+                              capture_output=True, text=True)
+        if proc.returncode != 0 or not os.path.exists(target):
+            _load_error = (f"netplane build failed (exit "
+                           f"{proc.returncode}): {proc.stderr[-2000:]}")
+            return None
+    if LIB_DIR not in sys.path:
+        sys.path.insert(0, LIB_DIR)
+    try:
+        import _netplane
+    except ImportError as e:  # pragma: no cover
+        _load_error = f"netplane import failed: {e}"
+        return None
+    _mod = _netplane
+    return _mod
+
+
+def native_available() -> bool:
+    return load_netplane() is not None
+
+
+def load_error() -> str | None:
+    return _load_error
+
+
+class NativePlane:
+    """Engine + callback bridge for one simulation."""
+
+    def __init__(self, hosts):
+        import weakref
+        mod = load_netplane()
+        if mod is None:
+            raise RuntimeError(_load_error or "netplane unavailable")
+        self.mod = mod
+        self.engine = mod.Engine()
+        self._hosts = hosts  # host_id -> Host (list)
+        # The engine strong-refs its callbacks; closing the loop with
+        # bound methods would make an uncollectable C-held cycle
+        # (engine -> method -> plane -> engine).  Weakref trampolines
+        # keep the engine's refs pointing away from the plane.
+        wself = weakref.ref(self)
+
+        def on_event(kind, hid, tok, a, b):
+            p = wself()
+            if p is not None:
+                p._on_event(kind, hid, tok, a, b)
+
+        def rng_u64(hid):
+            p = wself()
+            return p._rng_u64(hid) if p is not None else 0
+
+        self.engine.set_callbacks(on_event, rng_u64)
+
+    def add_host(self, host, qdisc_rr: bool, mtu: int = 1500) -> None:
+        self.engine.add_host(host.id, host.ip, host.bw_up_bits,
+                             host.bw_down_bits, qdisc_rr, mtu)
+        host.plane = self
+
+    # -- callbacks (invoked synchronously from inside engine calls) ----
+
+    def _on_event(self, kind: int, hid: int, tok: int, a: int,
+                  b: int) -> None:
+        host = self._hosts[hid]
+        if kind == self.mod.CB_STATUS:
+            sock = host._nsocks.get(tok)
+            if sock is not None:
+                sock.apply_status(host, a, b)
+        elif kind == self.mod.CB_CHILD_BORN:
+            # tok = listener, a = child: create the proxy at birth so
+            # lifecycle accounting and status mirroring start here.
+            from shadow_tpu.host.socket_native import TcpSocket
+            TcpSocket(host, 0, 0, _tok=a)  # registers itself
+        else:  # CB_CHILD_DEAD: pre-accept teardown = its deallocation
+            sock = host._nsocks.pop(tok, None)
+            if sock is not None:
+                from shadow_tpu.utils.object_counter import mark_dealloc
+                mark_dealloc(sock)
+
+    def _rng_u64(self, hid: int) -> int:
+        return self._hosts[hid].rng.next_u64()

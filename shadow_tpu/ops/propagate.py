@@ -34,6 +34,39 @@ _I64_MAX = (1 << 63) - 1
 _MIN_BUCKET = 256
 
 
+def _export_native_packet(plane, pkt_id: int):
+    """Materialize an engine packet as a Python Packet (mixed-plane
+    delivery to an object-path host) and free the native slot."""
+    (src_host, seq, proto, src_ip, sport, dst_ip, dport, payload,
+     tcp) = plane.engine.packet_fields(pkt_id)
+    hdr = None
+    if tcp is not None:
+        tseq, ack, flags, window, wscale, mss, sacks = tcp
+        hdr = pktmod.TcpHeader(
+            seq=tseq, ack=ack, flags=flags, window=window,
+            window_scale=None if wscale < 0 else wscale,
+            mss=None if mss < 0 else mss, sack_blocks=tuple(sacks))
+    p = pktmod.Packet(src_host, seq, proto, src_ip, sport, dst_ip, dport,
+                      payload=payload, tcp=hdr)
+    p.priority = seq
+    plane.engine.free_packet(pkt_id)
+    return p
+
+
+def _intern_python_packet(plane, p) -> int:
+    """Opposite direction: object-path packet delivered to an engine
+    host becomes a native store entry."""
+    tcp = None
+    if p.tcp is not None:
+        h = p.tcp
+        tcp = (h.seq, h.ack, h.flags, h.window,
+               -1 if h.window_scale is None else h.window_scale,
+               -1 if h.mss is None else h.mss, tuple(h.sack_blocks))
+    return plane.engine.intern_packet(
+        p.src_host_id, p.seq, p.protocol, p.src_ip, p.src_port, p.dst_ip,
+        p.dst_port, p.payload, tcp)
+
+
 def _bucket(n: int) -> int:
     b = _MIN_BUCKET
     while b < n:
@@ -110,7 +143,8 @@ class TpuPropagator:
         self.runahead = runahead
         self.window_end = 0
         # Outbox: one tuple per packet (hot path = a single list append).
-        # (src_host_obj, dst_host_obj, evt_seq, packet, t_send, is_ctl)
+        # (src_host_obj, dst_host_obj, evt_seq, packet_or_native_id,
+        #  pkt_seq, t_send, is_ctl)
         self._outbox: list = []
         self.rounds_dispatched = 0
         self.packets_batched = 0
@@ -130,8 +164,21 @@ class TpuPropagator:
             src_host.trace_drop(packet, "no-route")
             return
         self._outbox.append((src_host, self.hosts[dst_id],
-                             src_host.next_event_seq(), packet,
+                             src_host.next_event_seq(), packet, packet.seq,
                              src_host.now(), packet.is_empty_control()))
+
+    def send_native(self, src_host, pkt_id: int, dst_ip: int, pkt_seq: int,
+                    is_ctl: int) -> None:
+        """Native-plane twin of send(): metadata came from the engine's
+        outgoing drain; the packet stays in the C++ store."""
+        dst_id = self.dns.host_id_for_ip(dst_ip)
+        if dst_id is None:
+            src_host.plane.engine.drop_packet(src_host.id, pkt_id,
+                                              "no-route", src_host.now())
+            return
+        self._outbox.append((src_host, self.hosts[dst_id],
+                             src_host.next_event_seq(), pkt_id, pkt_seq,
+                             src_host.now(), bool(is_ctl)))
 
     def finish_round(self):
         total = len(self._outbox)
@@ -225,29 +272,54 @@ class TpuPropagator:
         keep_l = keep.tolist()
         outbox = self._outbox
         for i in range(n):
-            src_host, dst_host, seq, packet, t_send, _ = outbox[lo + i]
+            src_host, dst_host, seq, packet, _pseq, t_send, _ = \
+                outbox[lo + i]
+            native = type(packet) is int
             if keep_l[i]:
                 t = deliver_l[i]
-                packet.arrival_time = t
+                if native:
+                    packet = self._cross_plane(src_host, dst_host, packet)
+                elif dst_host.plane is not None:
+                    packet = _intern_python_packet(dst_host.plane, packet)
+                if type(packet) is not int:
+                    packet.arrival_time = t
                 dst_host.deliver_packet_event(
                     Event(t, KIND_PACKET, src_host.id, seq, packet))
             elif not reachable[i]:
-                src_host.trace_drop(packet, "unreachable", at_time=t_send)
+                if native:
+                    src_host.plane.engine.drop_packet(
+                        src_host.id, packet, "unreachable", t_send)
+                else:
+                    src_host.trace_drop(packet, "unreachable",
+                                        at_time=t_send)
             elif lossy[i]:
-                packet.record(pktmod.ST_INET_DROPPED)
-                src_host.trace_drop(packet, "inet-loss", at_time=t_send)
+                if native:
+                    src_host.plane.engine.drop_packet(
+                        src_host.id, packet, "inet-loss", t_send)
+                else:
+                    packet.record(pktmod.ST_INET_DROPPED)
+                    src_host.trace_drop(packet, "inet-loss", at_time=t_send)
         return int(min_deliver), int(min_latency)
+
+    @staticmethod
+    def _cross_plane(src_host, dst_host, pkt_id: int):
+        """Native packet heading to a destination host: stays a handle
+        when the destination is on the engine too (the common case —
+        they share the store), else materializes as a Python Packet."""
+        if dst_host.plane is not None:
+            return pkt_id
+        return _export_native_packet(src_host.plane, pkt_id)
 
     def _chunk_columns(self, lo: int, hi: int):
         """Transpose the outbox slice into numpy columns."""
-        src_h, dst_h, _seq, pkts, t_send, is_ctl = \
+        src_h, dst_h, _seq, _pkts, pseqs, t_send, is_ctl = \
             zip(*self._outbox[lo:hi])
         src_node = np.fromiter((h.node_index for h in src_h), np.int32,
                                hi - lo)
         dst_node = np.fromiter((h.node_index for h in dst_h), np.int32,
                                hi - lo)
         src_host = np.fromiter((h.id for h in src_h), np.int64, hi - lo)
-        pkt_seq = np.fromiter((p.seq & 0xFFFFFFFF for p in pkts), np.uint32,
+        pkt_seq = np.fromiter((s & 0xFFFFFFFF for s in pseqs), np.uint32,
                               hi - lo)
         t_send = np.asarray(t_send, dtype=np.int64)
         is_ctl = np.asarray(is_ctl, dtype=bool)

@@ -1,0 +1,133 @@
+"""Native (C++) data-plane parity gates.
+
+The cross-scheduler determinism tests already byte-diff the tpu
+scheduler (native plane) against the CPU schedulers (object path);
+these tests pin the equivalence down directly — same scheduler, plane
+on vs off — on configs chosen to reach the corners: token-bucket
+parking, CoDel dropping, random loss with SACK/retransmit, listener
+backlogs, UDP saturation, and mixed-plane sims (a pcap host on the
+object path talking to engine hosts).
+"""
+
+import os
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.native.plane import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="netplane unavailable")
+
+LOSSY_GML = """
+graph [ directed 0
+  node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+  node [ id 1 host_bandwidth_down "2 Mbit" host_bandwidth_up "1 Mbit" ]
+  edge [ source 0 target 0 latency "5 ms" packet_loss 0.0 ]
+  edge [ source 0 target 1 latency "30 ms" packet_loss 0.02 ]
+  edge [ source 1 target 1 latency "50 ms" packet_loss 0.01 ]
+]"""
+
+
+def _run(cfg_dict, native):
+    cfg = ConfigOptions.from_dict(cfg_dict)
+    cfg.experimental.native_dataplane = native
+    m = Manager(cfg)
+    summary = m.run()
+    return m, summary
+
+
+def _both(cfg_dict):
+    m_off, s_off = _run(cfg_dict, "off")
+    m_on, s_on = _run(cfg_dict, "on")
+    assert any(h.plane is not None for h in m_on.hosts), \
+        "native plane did not attach"
+    assert all(h.plane is None for h in m_off.hosts)
+    assert m_off.trace_lines() == m_on.trace_lines()
+    assert (s_off.packets_sent, s_off.packets_recv, s_off.packets_dropped) \
+        == (s_on.packets_sent, s_on.packets_recv, s_on.packets_dropped)
+    assert s_off.events == s_on.events
+    return m_on, s_on
+
+
+def test_tcp_lossy_saturated_parity():
+    """Slow asymmetric links + loss: bucket parking, retransmits, SACK,
+    persist all on the table."""
+    hosts = {"srv": {"network_node_id": 0, "processes": [
+        {"path": "tgen-server", "args": ["80"],
+         "expected_final_state": "running"}]}}
+    for i in range(4):
+        hosts[f"c{i}"] = {"network_node_id": 1, "processes": [
+            {"path": "tgen-client", "args": ["srv", "80", "200000", "2"],
+             "start_time": f"{50 + i * 13}ms",
+             "expected_final_state": "any"}]}
+    m, s = _both({
+        "general": {"stop_time": "40s", "seed": 11},
+        "network": {"graph": {"type": "gml", "inline": LOSSY_GML}},
+        "experimental": {"scheduler": "tpu"},
+        "hosts": hosts})
+    assert s.packets_dropped > 0  # the lossy corner actually exercised
+    assert s.ok, s.plugin_errors
+
+
+def test_udp_flood_parity():
+    """UDP at a 1 Mbit bottleneck: send-buffer blocking + recv drops."""
+    hosts = {
+        "sink": {"network_node_id": 1, "processes": [
+            {"path": "udp-sink", "args": ["9000"],
+             "expected_final_state": "running"}]},
+        "src": {"network_node_id": 0, "processes": [
+            {"path": "udp-flood", "args": ["sink", "9000", "400", "900"],
+             "start_time": "100ms", "expected_final_state": "any"}]},
+    }
+    m, s = _both({
+        "general": {"stop_time": "20s", "seed": 3},
+        "network": {"graph": {"type": "gml", "inline": LOSSY_GML}},
+        "experimental": {"scheduler": "tpu"},
+        "hosts": hosts})
+    assert s.packets_sent >= 400
+
+
+def test_mixed_plane_interop(tmp_path):
+    """A pcap-enabled host falls back to the object path; packets cross
+    between the engine store and Python packets in both directions and
+    the trace still matches an all-object-path run."""
+    hosts = {
+        "srv": {"network_node_id": 0,
+                "pcap_enabled": True,  # forces object path for this host
+                "processes": [{"path": "tgen-server", "args": ["80"],
+                               "expected_final_state": "running"}]},
+        "cli": {"network_node_id": 1, "processes": [
+            {"path": "tgen-client", "args": ["srv", "80", "60000", "2"],
+             "start_time": "100ms", "expected_final_state": "any"}]},
+    }
+    cfg = {
+        "general": {"stop_time": "30s", "seed": 9,
+                    "data_directory": str(tmp_path / "d")},
+        "network": {"graph": {"type": "gml", "inline": LOSSY_GML}},
+        "experimental": {"scheduler": "tpu"},
+        "hosts": hosts}
+    m_on, s_on = _run(cfg, "on")
+    assert m_on.hosts[1].plane is None  # srv (sorted: cli=0, srv=1)
+    assert m_on.hosts[0].plane is not None
+    cfg["general"]["data_directory"] = str(tmp_path / "d2")
+    m_off, s_off = _run(cfg, "off")
+    assert m_on.trace_lines() == m_off.trace_lines()
+    assert s_on.ok, s_on.plugin_errors
+
+
+def test_native_on_requires_engine(monkeypatch):
+    """native_dataplane=on errors out loudly when the engine is
+    unavailable instead of silently running the object path."""
+    from shadow_tpu.native import plane as plane_mod
+    monkeypatch.setattr(plane_mod, "_mod", None)
+    monkeypatch.setattr(plane_mod, "_load_error", "forced for test")
+    hosts = {"a": {"network_node_id": 0, "processes": []}}
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "1s", "seed": 1},
+        "network": {"graph": {"type": "gml", "inline": LOSSY_GML}},
+        "experimental": {"scheduler": "tpu", "native_dataplane": "on"},
+        "hosts": hosts})
+    with pytest.raises(RuntimeError, match="native_dataplane=on"):
+        Manager(cfg)
