@@ -36,6 +36,7 @@
 #include <Python.h>
 
 #include <algorithm>
+#include <array>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -234,6 +235,32 @@ const char *intern_reason(const std::string &s) {
   if (it == tbl.end())
     it = tbl.emplace(s, std::make_unique<std::string>(s)).first;
   return it->second->c_str();
+}
+
+/* ---------------- threefry2x32 (core/rng.py twin) ----------------- */
+/* Bit-identical to the Python/numpy/jax backends: the loss decision
+ * for packet (src_host, seq) must not depend on which plane computes
+ * it (tests cross-check all implementations). */
+
+constexpr uint32_t TF_PARITY = 0x1BD11BDA;
+
+inline void threefry2x32(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1,
+                         uint32_t *o0, uint32_t *o1) {
+  static const int rot_a[4] = {13, 15, 26, 6};
+  static const int rot_b[4] = {17, 29, 16, 24};
+  uint32_t ks[3] = {k0, k1, (uint32_t)(k0 ^ k1 ^ TF_PARITY)};
+  uint32_t x0 = c0 + k0, x1 = c1 + k1;
+  for (int d = 0; d < 5; d++) {
+    const int *rot = (d % 2 == 0) ? rot_a : rot_b;
+    for (int i = 0; i < 4; i++) {
+      x0 += x1;
+      x1 = ((x1 << rot[i]) | (x1 >> (32 - rot[i]))) ^ x0;
+    }
+    x0 += ks[(d + 1) % 3];
+    x1 += ks[(d + 2) % 3] + (uint32_t)(d + 1);
+  }
+  *o0 = x0;
+  *o1 = x1;
 }
 
 /* ---------------- TCP connection (tcp/connection.py port) --------- */
@@ -1117,20 +1144,39 @@ struct TimerLess {
   }
 };
 
+/* Engine-side inbox entry: a cross-host packet awaiting its arrival
+ * instant (the engine twin of the Python host's locked inbox). */
+struct InboxEnt {
+  int64_t time;
+  int src_host;
+  uint64_t seq;  // source event seq (the cross-host tiebreak)
+  uint64_t pkt;
+};
+struct InboxLess {
+  bool operator()(const InboxEnt &a, const InboxEnt &b) const {
+    if (a.time != b.time) return a.time > b.time;  // min-heap
+    if (a.src_host != b.src_host) return a.src_host > b.src_host;
+    return a.seq > b.seq;
+  }
+};
+
 struct HostPlane {
   int id = -1;
   uint32_t eth_ip = 0;
   int qdisc = 0;  // 0 fifo, 1 round_robin
   int64_t bw_up_bits = 0, bw_down_bits = 0;
   uint64_t event_seq = 0, packet_seq = 0;
+  int64_t now = 0;
   IfaceN lo, eth;
   CoDelN codel;
   RelayN relays[3];  // 0 loopback, 1 inet-out, 2 inet-in
   std::vector<TimerEnt> theap;
-  std::vector<uint64_t> outgoing;  // cross-host packets this call
+  std::vector<InboxEnt> inbox;
+  std::vector<uint64_t> outgoing;  // legacy per-call drain (mixed paths)
   std::vector<TraceRec> trace;
   bool tracing = true;
   int64_t pkts_sent = 0, pkts_recv = 0, pkts_dropped = 0;
+  int64_t events_run = 0;
 
   void tpush(TimerEnt e) {
     theap.push_back(e);
@@ -1142,17 +1188,55 @@ struct HostPlane {
     theap.pop_back();
     return e;
   }
+  void ipush(InboxEnt e) {
+    inbox.push_back(e);
+    std::push_heap(inbox.begin(), inbox.end(), InboxLess());
+  }
+  InboxEnt ipop() {
+    std::pop_heap(inbox.begin(), inbox.end(), InboxLess());
+    InboxEnt e = inbox.back();
+    inbox.pop_back();
+    return e;
+  }
 };
 
 /* ---------------- engine ------------------------------------------ */
+
+/* One cross-host send awaiting the round's propagation phase. */
+struct RoundOut {
+  int src_host, dst_host;
+  uint64_t evt_seq;
+  uint64_t pkt;
+  uint32_t pkt_seq;
+  int64_t t_send;
+  bool is_ctl;
+};
 
 struct Engine {
   PacketStore store;
   std::vector<std::unique_ptr<HostPlane>> hosts;
   std::vector<std::unique_ptr<SocketN>> socks;  // token -> socket
-  PyObject *cb_event = nullptr;  // (kind, host, tok, a, b)
+  PyObject *cb_event = nullptr;  // (kind, host, tok, a, b, t)
   PyObject *cb_rng = nullptr;    // (host) -> u64
   bool in_error = false;         // a callback raised; unwind
+  bool cb_fired = false;         // any event-callback ran (batch break)
+
+  /* Routing state (set_routing): the propagation phase twin of
+   * ops/propagate.py's host/numpy path, bit-identical by construction
+   * (same integer matrices, same threefry bits). */
+  std::vector<int32_t> host_node;             // host id -> graph node
+  std::unordered_map<uint32_t, int32_t> ip_to_host;
+  std::vector<int64_t> latm, thrm;            // node x node
+  int32_t n_nodes = 0;
+  uint32_t key0 = 0, key1 = 0;
+  int64_t bootstrap_end = 0;
+  int64_t time_never = (1LL << 62);
+  std::vector<RoundOut> round_outbox;
+  /* Shared next-event snapshot (a writable view into the manager's
+   * numpy array; engine lowers destination slots on delivery). */
+  Py_buffer nt_buf{};
+  int64_t *nt = nullptr;
+  Py_ssize_t nt_len = 0;
 
   HostPlane *plane(int hid) {
     return (hid >= 0 && (size_t)hid < hosts.size()) ? hosts[hid].get()
@@ -1173,10 +1257,12 @@ struct Engine {
   /* -- callbacks into Python ------------------------------------- */
 
   void fire_event(int kind, int hid, uint32_t tok, uint32_t a, uint32_t b) {
+    cb_fired = true;
     if (!cb_event || in_error) return;
-    PyObject *r = PyObject_CallFunction(cb_event, "iiIII", kind, hid,
-                                        (unsigned int)tok, (unsigned int)a,
-                                        (unsigned int)b);
+    HostPlane *hp = plane(hid);
+    PyObject *r = PyObject_CallFunction(
+        cb_event, "iiIIIL", kind, hid, (unsigned int)tok, (unsigned int)a,
+        (unsigned int)b, (long long)(hp ? hp->now : 0));
     if (!r) { in_error = true; return; }
     Py_DECREF(r);
   }
@@ -1231,9 +1317,20 @@ struct Engine {
 
   void device_push(HostPlane *hp, int dev, uint64_t id, int64_t now) {
     if (dev == 2) {
-      /* router.route_outgoing_packet -> host.send_packet */
+      /* router.route_outgoing_packet -> host.send_packet ->
+       * propagator.send: resolve the destination and queue for the
+       * round's batched propagation phase (finish_round). */
       hp->pkts_sent++;
-      hp->outgoing.push_back(id);
+      PacketN *p = store.get(id);
+      auto it = ip_to_host.find(p->dst_ip);
+      if (it == ip_to_host.end()) {
+        trace_drop(hp, p, "no-route", now);
+        store.free_pkt(id);
+        return;
+      }
+      round_outbox.push_back({hp->id, it->second, hp->event_seq++, id,
+                              (uint32_t)(p->seq & 0xFFFFFFFF), now,
+                              p->is_empty_control()});
       return;
     }
     iface_receive(hp, dev == 0 ? hp->lo : hp->eth, id, now);
@@ -1434,6 +1531,7 @@ struct Engine {
     HostPlane *hp = plane(hid);
     PacketN *p = store.get(id);
     if (!p) return;
+    hp->now = now;
     if (!hp->codel.push(id, p->total_size(), now)) {
       trace_drop(hp, p, "rtr-limit", now);
       store.free_pkt(id);
@@ -1446,6 +1544,7 @@ struct Engine {
   void fire(int hid, int64_t now) {
     HostPlane *hp = plane(hid);
     if (hp->theap.empty()) return;
+    hp->now = now;
     TimerEnt e = hp->tpop();
     if (e.kind == TK_RELAY) {
       RelayN &r = hp->relays[e.target];
@@ -1454,6 +1553,135 @@ struct Engine {
     } else {
       tcp_on_timer(hp, tcp(e.target), e.target, now);
     }
+  }
+
+  /* Batched event execution: run engine-internal events (packet
+   * arrivals from the inbox + relay/TCP deadlines) in their total
+   * order while they stay below both the caller's limit key (the
+   * Python heap's head) and the window end.  Breaks whenever a
+   * callback ran, because the callback may have scheduled a Python
+   * task that now precedes the engine's next event.  Returns
+   * (events_run, last_time). */
+  std::pair<int64_t, int64_t> run_until(int hid, int64_t lt, int lk,
+                                        int lsrc, uint64_t lseq,
+                                        int64_t until) {
+    HostPlane *hp = plane(hid);
+    cb_fired = false;
+    int64_t n = 0, last = 0;
+    for (;;) {
+      bool has_i = !hp->inbox.empty(), has_t = !hp->theap.empty();
+      if (!has_i && !has_t) break;
+      bool pick_inbox;
+      if (has_i && has_t) {
+        const InboxEnt &i = hp->inbox.front();
+        const TimerEnt &t = hp->theap.front();
+        /* inbox key (t, PACKET, src, seq) vs timer key (t, LOCAL, hid,
+         * seq); packets sort first at equal times. */
+        pick_inbox = i.time != t.time ? i.time < t.time : true;
+      } else {
+        pick_inbox = has_i;
+      }
+      int64_t et;
+      int ek, esrc;
+      uint64_t eseq;
+      if (pick_inbox) {
+        const InboxEnt &i = hp->inbox.front();
+        et = i.time; ek = 0; esrc = i.src_host; eseq = i.seq;
+      } else {
+        const TimerEnt &t = hp->theap.front();
+        et = t.time; ek = 1; esrc = hp->id; eseq = t.seq;
+      }
+      if (et >= until) break;
+      /* compare (et, ek, esrc, eseq) >= (lt, lk, lsrc, lseq)? */
+      if (et > lt || (et == lt && (ek > lk || (ek == lk &&
+          (esrc > lsrc || (esrc == lsrc && eseq >= lseq))))))
+        break;
+      hp->now = et;
+      last = et;
+      n++;
+      if (pick_inbox) {
+        InboxEnt i = hp->ipop();
+        PacketN *p = store.get(i.pkt);
+        if (p) {
+          if (!hp->codel.push(i.pkt, p->total_size(), et)) {
+            trace_drop(hp, p, "rtr-limit", et);
+            store.free_pkt(i.pkt);
+          } else {
+            relay_notify(hp, 2, et);
+          }
+        }
+      } else {
+        TimerEnt e = hp->tpop();
+        if (e.kind == TK_RELAY) {
+          RelayN &r = hp->relays[e.target];
+          r.state = RELAY_IDLE;
+          relay_forward(hp, e.target, et);
+        } else {
+          tcp_on_timer(hp, tcp(e.target), e.target, et);
+        }
+      }
+      if (cb_fired || in_error) break;
+    }
+    return {n, last};
+  }
+
+  void push_inbox(int hid, int64_t time, int src, uint64_t seq,
+                  uint64_t pkt) {
+    HostPlane *hp = plane(hid);
+    hp->ipush({time, src, seq, pkt});
+    if (nt && hid < nt_len && time < nt[hid]) nt[hid] = time;
+  }
+
+  /* The round's propagation phase for all engine-origin sends: the
+   * scalar/numpy twin of ops/propagate.py, entirely in C++.  Returns
+   * min_deliver/min_latency over kept packets and the list of packets
+   * destined to object-path hosts (mixed sims) for Python to convert.
+   * `exports` carries (pkt, dst_host, evt_seq, deliver, src_host). */
+  struct FinishResult {
+    int64_t n = 0;
+    int64_t min_deliver;
+    int64_t min_latency;
+    std::vector<std::array<int64_t, 5>> exports;
+  };
+
+  FinishResult finish_round(int64_t window_end) {
+    FinishResult r;
+    r.min_deliver = time_never;
+    r.min_latency = time_never;
+    r.n = (int64_t)round_outbox.size();
+    for (const RoundOut &e : round_outbox) {
+      int64_t lat = latm[(size_t)host_node[e.src_host] * n_nodes +
+                         host_node[e.dst_host]];
+      bool reachable = lat < time_never;
+      uint32_t b0, b1;
+      threefry2x32(key0, key1, (uint32_t)e.src_host, e.pkt_seq, &b0, &b1);
+      int64_t thr = thrm[(size_t)host_node[e.src_host] * n_nodes +
+                         host_node[e.dst_host]];
+      bool lossy = (int64_t)b0 < thr && !e.is_ctl &&
+                   e.t_send >= bootstrap_end;
+      HostPlane *src = plane(e.src_host);
+      if (!reachable) {
+        trace_drop(src, store.get(e.pkt), "unreachable", e.t_send);
+        store.free_pkt(e.pkt);
+        continue;
+      }
+      if (lossy) {
+        trace_drop(src, store.get(e.pkt), "inet-loss", e.t_send);
+        store.free_pkt(e.pkt);
+        continue;
+      }
+      int64_t deliver = std::max(e.t_send + lat, window_end);
+      if (deliver < r.min_deliver) r.min_deliver = deliver;
+      if (lat < r.min_latency) r.min_latency = lat;
+      if (plane(e.dst_host)) {
+        push_inbox(e.dst_host, deliver, e.src_host, e.evt_seq, e.pkt);
+      } else {
+        r.exports.push_back({(int64_t)e.pkt, e.dst_host,
+                             (int64_t)e.evt_seq, deliver, e.src_host});
+      }
+    }
+    round_outbox.clear();
+    return r;
   }
 
   /* ============== TCP socket glue (host/socket_tcp.py) =========== */
@@ -2136,6 +2364,197 @@ static PyObject *eng_peek_deadline(EngineObj *self, PyObject *args) {
   return Py_BuildValue("LK", (long long)e.time, (unsigned long long)e.seq);
 }
 
+static PyObject *eng_peek_next(EngineObj *self, PyObject *args) {
+  /* Earliest engine-internal event: (time, kind, src, seq) or None —
+   * inbox packets and deadlines under the one total order. */
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  bool has_i = !hp->inbox.empty(), has_t = !hp->theap.empty();
+  if (!has_i && !has_t) Py_RETURN_NONE;
+  bool pick_i = has_i &&
+      (!has_t || hp->inbox.front().time <= hp->theap.front().time);
+  if (pick_i) {
+    const InboxEnt &i = hp->inbox.front();
+    return Py_BuildValue("LiiK", (long long)i.time, 0, i.src_host,
+                         (unsigned long long)i.seq);
+  }
+  const TimerEnt &t = hp->theap.front();
+  return Py_BuildValue("LiiK", (long long)t.time, 1, hid,
+                       (unsigned long long)t.seq);
+}
+
+static PyObject *eng_run_until(EngineObj *self, PyObject *args) {
+  int hid, lk, lsrc;
+  long long lt, until;
+  unsigned long long lseq;
+  if (!PyArg_ParseTuple(args, "iLiiKL", &hid, &lt, &lk, &lsrc, &lseq,
+                        &until))
+    return nullptr;
+  auto [n, last] = self->eng->run_until(hid, lt, lk, lsrc, lseq, until);
+  CHECK_CB(self);
+  return Py_BuildValue("LL", (long long)n, (long long)last);
+}
+
+static PyObject *eng_push_inbox(EngineObj *self, PyObject *args) {
+  int hid, src;
+  long long time;
+  unsigned long long seq, pkt;
+  if (!PyArg_ParseTuple(args, "iLiKK", &hid, &time, &src, &seq, &pkt))
+    return nullptr;
+  self->eng->push_inbox(hid, time, src, seq, pkt);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_set_routing(EngineObj *self, PyObject *args) {
+  /* (host_node int32[H], ips uint32[H], lat int64[N*N], thr int64[N*N],
+   *  n_nodes, key0, key1, bootstrap_end, time_never) */
+  Py_buffer hn, ips, lat, thr;
+  int n_nodes;
+  unsigned int k0, k1;
+  long long bootstrap, tnever;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*iIILL", &hn, &ips, &lat, &thr,
+                        &n_nodes, &k0, &k1, &bootstrap, &tnever))
+    return nullptr;
+  Engine *e = self->eng;
+  size_t nh = hn.len / sizeof(int32_t);
+  e->host_node.assign((const int32_t *)hn.buf,
+                      (const int32_t *)hn.buf + nh);
+  const uint32_t *ip = (const uint32_t *)ips.buf;
+  e->ip_to_host.clear();
+  for (size_t i = 0; i < nh; i++) e->ip_to_host[ip[i]] = (int32_t)i;
+  e->latm.assign((const int64_t *)lat.buf,
+                 (const int64_t *)lat.buf + lat.len / 8);
+  e->thrm.assign((const int64_t *)thr.buf,
+                 (const int64_t *)thr.buf + thr.len / 8);
+  e->n_nodes = n_nodes;
+  e->key0 = k0;
+  e->key1 = k1;
+  e->bootstrap_end = bootstrap;
+  e->time_never = tnever;
+  PyBuffer_Release(&hn);
+  PyBuffer_Release(&ips);
+  PyBuffer_Release(&lat);
+  PyBuffer_Release(&thr);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_set_nt(EngineObj *self, PyObject *args) {
+  PyObject *arr;
+  if (!PyArg_ParseTuple(args, "O", &arr)) return nullptr;
+  Engine *e = self->eng;
+  if (e->nt) {
+    PyBuffer_Release(&e->nt_buf);
+    e->nt = nullptr;
+  }
+  if (arr != Py_None) {
+    if (PyObject_GetBuffer(arr, &e->nt_buf, PyBUF_WRITABLE) < 0)
+      return nullptr;
+    e->nt = (int64_t *)e->nt_buf.buf;
+    e->nt_len = e->nt_buf.len / 8;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *finish_result_to_py(Engine::FinishResult &&r) {
+  PyObject *exports;
+  if (r.exports.empty()) {
+    exports = Py_None;
+    Py_INCREF(exports);
+  } else {
+    exports = PyList_New((Py_ssize_t)r.exports.size());
+    for (size_t i = 0; i < r.exports.size(); i++) {
+      const auto &x = r.exports[i];
+      PyList_SET_ITEM(exports, (Py_ssize_t)i,
+                      Py_BuildValue("KLKLL", (unsigned long long)x[0],
+                                    (long long)x[1],
+                                    (unsigned long long)x[2],
+                                    (long long)x[3], (long long)x[4]));
+    }
+  }
+  return Py_BuildValue("LLLN", (long long)r.n, (long long)r.min_deliver,
+                       (long long)r.min_latency, exports);
+}
+
+static PyObject *eng_finish_round(EngineObj *self, PyObject *args) {
+  long long window_end;
+  if (!PyArg_ParseTuple(args, "L", &window_end)) return nullptr;
+  return finish_result_to_py(self->eng->finish_round(window_end));
+}
+
+static PyObject *eng_round_size(EngineObj *self, PyObject *) {
+  return PyLong_FromSize_t(self->eng->round_outbox.size());
+}
+
+static PyObject *eng_export_round(EngineObj *self, PyObject *) {
+  /* Columns for the device kernel: (src_node i32, dst_node i32,
+   * src_host i64, pkt_seq u32, t_send i64, is_ctl u8) as bytes. */
+  Engine *e = self->eng;
+  size_t n = e->round_outbox.size();
+  std::vector<int32_t> sn(n), dn(n);
+  std::vector<int64_t> sh(n), ts(n);
+  std::vector<uint32_t> ps(n);
+  std::vector<uint8_t> ctl(n);
+  for (size_t i = 0; i < n; i++) {
+    const RoundOut &o = e->round_outbox[i];
+    sn[i] = e->host_node[o.src_host];
+    dn[i] = e->host_node[o.dst_host];
+    sh[i] = o.src_host;
+    ps[i] = o.pkt_seq;
+    ts[i] = o.t_send;
+    ctl[i] = o.is_ctl;
+  }
+  return Py_BuildValue(
+      "y#y#y#y#y#y#", (const char *)sn.data(), (Py_ssize_t)(n * 4),
+      (const char *)dn.data(), (Py_ssize_t)(n * 4),
+      (const char *)sh.data(), (Py_ssize_t)(n * 8),
+      (const char *)ps.data(), (Py_ssize_t)(n * 4),
+      (const char *)ts.data(), (Py_ssize_t)(n * 8),
+      (const char *)ctl.data(), (Py_ssize_t)n);
+}
+
+static PyObject *eng_scatter_round(EngineObj *self, PyObject *args) {
+  /* Device-path scatter: decisions computed by the jax kernel
+   * (bit-identical to finish_round's own math); the engine applies
+   * deliveries/drops from the provided arrays. */
+  Py_buffer keep, deliver, reachable, lossy;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*", &keep, &deliver, &reachable,
+                        &lossy))
+    return nullptr;
+  Engine *e = self->eng;
+  const uint8_t *kp = (const uint8_t *)keep.buf;
+  const int64_t *dl = (const int64_t *)deliver.buf;
+  const uint8_t *rc = (const uint8_t *)reachable.buf;
+  Engine::FinishResult r;
+  r.min_deliver = e->time_never;
+  r.min_latency = e->time_never;
+  r.n = (int64_t)e->round_outbox.size();
+  for (size_t i = 0; i < e->round_outbox.size(); i++) {
+    const RoundOut &o = e->round_outbox[i];
+    HostPlane *src = e->plane(o.src_host);
+    if (kp[i]) {
+      if (e->plane(o.dst_host)) {
+        e->push_inbox(o.dst_host, dl[i], o.src_host, o.evt_seq, o.pkt);
+      } else {
+        r.exports.push_back({(int64_t)o.pkt, o.dst_host,
+                             (int64_t)o.evt_seq, dl[i], o.src_host});
+      }
+    } else if (!rc[i]) {
+      e->trace_drop(src, e->store.get(o.pkt), "unreachable", o.t_send);
+      e->store.free_pkt(o.pkt);
+    } else {
+      e->trace_drop(src, e->store.get(o.pkt), "inet-loss", o.t_send);
+      e->store.free_pkt(o.pkt);
+    }
+  }
+  e->round_outbox.clear();
+  PyBuffer_Release(&keep);
+  PyBuffer_Release(&deliver);
+  PyBuffer_Release(&reachable);
+  PyBuffer_Release(&lossy);
+  return finish_result_to_py(std::move(r));
+}
+
 static PyObject *eng_fire(EngineObj *self, PyObject *args) {
   int hid;
   long long now;
@@ -2215,6 +2634,7 @@ static PyObject *eng_tcp_connect(EngineObj *self, PyObject *args) {
   if (!PyArg_ParseTuple(args, "IIiL", &tok, &ip, &port, &now))
     return nullptr;
   TcpSocketN *s = self->eng->tcp(tok);
+  self->eng->plane(s->host)->now = now;
   int r = self->eng->tcp_connect(self->eng->plane(s->host), s, tok, ip,
                                  port, now);
   CHECK_CB(self);
@@ -2226,6 +2646,7 @@ static PyObject *eng_tcp_accept(EngineObj *self, PyObject *args) {
   long long now;
   if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
   TcpSocketN *s = self->eng->tcp(tok);
+  self->eng->plane(s->host)->now = now;
   int64_t r = self->eng->tcp_accept(self->eng->plane(s->host), s, now);
   CHECK_CB(self);
   return PyLong_FromLongLong((long long)r);
@@ -2237,6 +2658,7 @@ static PyObject *eng_tcp_sendto(EngineObj *self, PyObject *args) {
   long long now;
   if (!PyArg_ParseTuple(args, "Iy*L", &tok, &data, &now)) return nullptr;
   TcpSocketN *s = self->eng->tcp(tok);
+  self->eng->plane(s->host)->now = now;
   int64_t r = self->eng->tcp_sendto(self->eng->plane(s->host), s, tok,
                                     (const char *)data.buf,
                                     (int64_t)data.len, now);
@@ -2252,6 +2674,7 @@ static PyObject *eng_tcp_recv(EngineObj *self, PyObject *args) {
   if (!PyArg_ParseTuple(args, "ILpL", &tok, &bufsize, &peek, &now))
     return nullptr;
   TcpSocketN *s = self->eng->tcp(tok);
+  self->eng->plane(s->host)->now = now;
   std::string out;
   int r = self->eng->tcp_recv(self->eng->plane(s->host), s, tok, bufsize,
                               peek, now, &out);
@@ -2265,6 +2688,7 @@ static PyObject *eng_tcp_shutdown(EngineObj *self, PyObject *args) {
   long long now;
   if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
   TcpSocketN *s = self->eng->tcp(tok);
+  self->eng->plane(s->host)->now = now;
   self->eng->tcp_shutdown_wr(self->eng->plane(s->host), s, tok, now);
   CHECK_CB(self);
   Py_RETURN_NONE;
@@ -2275,6 +2699,7 @@ static PyObject *eng_sock_close(EngineObj *self, PyObject *args) {
   long long now;
   if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
   SocketN *s = self->eng->sock(tok);
+  self->eng->plane(s->host)->now = now;
   if (s->proto == PROTO_TCP)
     self->eng->tcp_close(self->eng->plane(s->host),
                          static_cast<TcpSocketN *>(s), tok, now);
@@ -2294,6 +2719,7 @@ static PyObject *eng_udp_sendto(EngineObj *self, PyObject *args) {
                         &dst_port, &now))
     return nullptr;
   UdpSocketN *s = self->eng->udp(tok);
+  self->eng->plane(s->host)->now = now;
   int64_t r = self->eng->udp_sendto(self->eng->plane(s->host), s, tok,
                                     (const char *)data.buf,
                                     (int64_t)data.len, has_dst, dst_ip,
@@ -2340,6 +2766,7 @@ static PyObject *eng_udp_push_reply(EngineObj *self, PyObject *args) {
                         &now))
     return nullptr;
   UdpSocketN *s = self->eng->udp(tok);
+  self->eng->plane(s->host)->now = now;
   self->eng->udp_push_reply(self->eng->plane(s->host), s,
                             (const char *)data.buf, (int64_t)data.len,
                             src_ip, src_port, now);
@@ -2554,6 +2981,16 @@ static PyMethodDef eng_methods[] = {
     {"next_packet_seq", (PyCFunction)eng_next_packet_seq, METH_VARARGS,
      nullptr},
     {"peek_deadline", (PyCFunction)eng_peek_deadline, METH_VARARGS, nullptr},
+    {"peek_next", (PyCFunction)eng_peek_next, METH_VARARGS, nullptr},
+    {"run_until", (PyCFunction)eng_run_until, METH_VARARGS, nullptr},
+    {"push_inbox", (PyCFunction)eng_push_inbox, METH_VARARGS, nullptr},
+    {"set_routing", (PyCFunction)eng_set_routing, METH_VARARGS, nullptr},
+    {"set_nt", (PyCFunction)eng_set_nt, METH_VARARGS, nullptr},
+    {"finish_round", (PyCFunction)eng_finish_round, METH_VARARGS, nullptr},
+    {"round_size", (PyCFunction)eng_round_size, METH_NOARGS, nullptr},
+    {"export_round", (PyCFunction)eng_export_round, METH_NOARGS, nullptr},
+    {"scatter_round", (PyCFunction)eng_scatter_round, METH_VARARGS,
+     nullptr},
     {"fire", (PyCFunction)eng_fire, METH_VARARGS, nullptr},
     {"deliver", (PyCFunction)eng_deliver, METH_VARARGS, nullptr},
     {"take_outgoing", (PyCFunction)eng_take_outgoing, METH_VARARGS, nullptr},
@@ -2591,6 +3028,7 @@ static PyMethodDef eng_methods[] = {
 static void eng_dealloc(EngineObj *self) {
   Py_XDECREF(self->eng->cb_event);
   Py_XDECREF(self->eng->cb_rng);
+  if (self->eng->nt) PyBuffer_Release(&self->eng->nt_buf);
   delete self->eng;
   Py_TYPE(self)->tp_free((PyObject *)self);
 }

@@ -214,8 +214,24 @@ class Manager:
                 runahead=self.runahead)
         for host in self.hosts:
             host._send_packet_fn = self.propagator.send
-            if host.plane is not None:
-                host._send_native_fn = self.propagator.send_native
+        if self.plane is not None:
+            # Register the propagation phase's routing state with the
+            # engine: sends from native hosts batch engine-side and
+            # finish_round runs the scalar twin (or the device kernel)
+            # without per-packet Python.
+            from shadow_tpu.core.rng import STREAM_PACKET_LOSS, mix_key
+            from shadow_tpu.core.simtime import TIME_NEVER
+            k0, k1 = mix_key(seed, STREAM_PACKET_LOSS)
+            lat = np.ascontiguousarray(graph.latency_ns, dtype=np.int64)
+            self.plane.engine.set_routing(
+                np.ascontiguousarray(
+                    [h.node_index for h in self.hosts], dtype=np.int32),
+                np.ascontiguousarray([h.ip for h in self.hosts],
+                                     dtype=np.uint32),
+                lat, np.ascontiguousarray(thr, dtype=np.int64),
+                lat.shape[0], k0, k1,
+                config.general.bootstrap_end_time_ns, TIME_NEVER)
+            self.propagator.engine = self.plane.engine
 
         self._perf_timers = config.experimental.use_perf_timers
         if self._perf_timers and threaded:
@@ -314,29 +330,29 @@ class Manager:
         (the reference reduces per-thread minimums the same lazy way,
         manager.rs:447-487)."""
         from shadow_tpu.core.simtime import TIME_NEVER
-        nt = []
+        nt = np.empty(len(self.hosts), dtype=np.int64)
         for h in self.hosts:
             t = h.next_event_time()
-            nt.append(TIME_NEVER if t is None else t)
+            nt[h.id] = TIME_NEVER if t is None else t
         self._nt = nt
         for h in self.hosts:
             h._nt_list = nt
+        if self.plane is not None:
+            self.plane.engine.set_nt(nt)
 
     def _min_next_event(self) -> int | None:
         from shadow_tpu.core.simtime import TIME_NEVER
-        best = min(self._nt)
+        best = int(self._nt.min())
         return None if best >= TIME_NEVER else best
 
     def _active_hosts(self, until: int) -> list:
-        """Hosts whose `execute(until)` would do work: an inbox delivery
-        pending, or an event inside the window per the shared snapshot.
-        At scale most hosts are idle most rounds; skipping them is a
-        pure win because the barrier already covers in-flight packets
-        via the propagator's finish_round min (a mid-round inbox append
-        just runs next round, exactly as if the host had executed)."""
-        nt = self._nt
-        return [h for h in self.hosts
-                if nt[h.id] < until or h._inbox]
+        """Hosts whose `execute(until)` would do work per the shared
+        snapshot (which inbox deliveries and engine pushes keep
+        current).  At scale most hosts are idle most rounds; skipping
+        them is a pure win because the barrier already covers in-flight
+        packets via the propagator's finish_round min."""
+        hosts = self.hosts
+        return [hosts[i] for i in np.flatnonzero(self._nt < until)]
 
     def _run_hosts(self, until: int) -> None:
         if self._perf_timers:

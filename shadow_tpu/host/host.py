@@ -51,6 +51,7 @@ class Host:
         # mid-round (their time is >= window end). Drained at execute().
         self._inbox: deque = deque()
         self._inbox_lock = threading.Lock()
+        self._inbox_min = TIME_NEVER  # earliest undrained delivery
         self._now = 0
         self._event_seq = 0
         self._packet_seq = 0
@@ -165,6 +166,7 @@ class Host:
             return
         with self._inbox_lock:
             events, self._inbox = self._inbox, deque()
+            self._inbox_min = TIME_NEVER
         for ev in events:
             self.queue.push(ev)
 
@@ -204,67 +206,68 @@ class Host:
         self._update_nt_slot()
 
     def _execute_native(self, until: int) -> None:
-        """Round execution with the native plane: merge the Python event
-        heap with the engine's internal deadline heap under the one
-        total order (time, kind, src, seq) — engine entries are always
-        KIND_LOCAL from this host, with seqs drawn from the shared
-        counter, so the merged dispatch order is bit-identical to the
-        object path's single heap."""
+        """Round execution with the native plane: the engine runs whole
+        batches of its own events (inbox packet arrivals + relay/TCP
+        deadlines) in one C call, bounded by the Python heap's head key
+        and the window end, under the one total order (time, kind, src,
+        seq).  A batch breaks whenever an engine event called back into
+        Python (a status change may have scheduled a task that now
+        precedes the engine's next event), so the merged dispatch order
+        stays bit-identical to the object path's single heap."""
         self.drain_inbox()
         q = self.queue
         heap = q._heap
         eng = self.plane.engine
         hid = self.id
-        counters = self.counters
-        deliver = eng.deliver
-        fire = eng.fire
-        take = eng.take_outgoing
-        send = self._send_native_fn
+        run_until = eng.run_until
+        n_total = 0
         while True:
-            d = eng.peek_deadline(hid)
-            use_eng = False
             if heap:
-                t = heap[0][0]
-                if d is not None and (d[0], KIND_LOCAL, hid, d[1]) \
-                        < heap[0][:4]:
-                    t = d[0]
-                    use_eng = True
-            elif d is not None:
-                t = d[0]
-                use_eng = True
+                lt, lk, lsrc, lseq = heap[0][:4]
             else:
+                lt, lk, lsrc, lseq = until, 1, 0, 0
+            n, last = run_until(hid, lt, lk, lsrc, lseq, until)
+            if n:
+                n_total += n
+                if last > self._now:
+                    self._now = last
+                continue  # re-evaluate: a callback may have scheduled
+            if not heap or heap[0][0] >= until:
                 break
-            if t >= until:
-                break
-            self._now = t
-            counters["events"] += 1
-            if use_eng:
-                fire(hid, t)
-            else:
-                ev = q.pop()
-                data = ev.data
-                if ev.kind == KIND_PACKET:
-                    if type(data) is int:
-                        deliver(hid, data, t)
-                    else:
-                        self.router.route_incoming_packet(self, data)
+            ev = q.pop()
+            self._now = ev.time
+            n_total += 1
+            data = ev.data
+            if ev.kind == KIND_PACKET:
+                # Mixed-plane only: a packet object from an object-path
+                # host (engine-origin packets ride the engine inbox).
+                if type(data) is int:
+                    eng.deliver(hid, data, ev.time)
                 else:
-                    data.execute(self)
-            out = take(hid)
-            if out is not None:
-                for pkt_id, dst_ip, pkt_seq, is_ctl in out:
-                    send(self, pkt_id, dst_ip, pkt_seq, is_ctl)
+                    self.router.route_incoming_packet(self, data)
+            else:
+                data.execute(self)
+        self.counters["events"] += n_total
         self._update_nt_slot()
 
     def _update_nt_slot(self) -> None:
         if self._nt_list is not None:
             t = self.next_event_time()
-            self._nt_list[self.id] = TIME_NEVER if t is None else t
+            if t is None:
+                t = TIME_NEVER
+            # Under the threaded CPU schedulers another host's execute
+            # can deliver into our inbox concurrently; folding the
+            # locked inbox minimum in keeps the slot from going stale-
+            # high (losing an event until some later round).
+            with self._inbox_lock:
+                if self._inbox_min < t:
+                    t = self._inbox_min
+                self._nt_list[self.id] = t
 
     def next_event_time(self):
         t = self.queue.peek_time()
         if self.plane is not None:
-            d = self.plane.engine.peek_deadline(self.id)
+            d = self.plane.engine.peek_next(self.id)
             if d is not None and (t is None or d[0] < t):
                 return d[0]
         return t
@@ -302,6 +305,8 @@ class Host:
         so the owner cannot need it before its next drain."""
         with self._inbox_lock:
             self._inbox.append(event)
+            if event.time < self._inbox_min:
+                self._inbox_min = event.time
             nt = self._nt_list
             if nt is not None and event.time < nt[self.id]:
                 nt[self.id] = event.time

@@ -83,10 +83,10 @@ class NativePlane:
         # keep the engine's refs pointing away from the plane.
         wself = weakref.ref(self)
 
-        def on_event(kind, hid, tok, a, b):
+        def on_event(kind, hid, tok, a, b, t):
             p = wself()
             if p is not None:
-                p._on_event(kind, hid, tok, a, b)
+                p._on_event(kind, hid, tok, a, b, t)
 
         def rng_u64(hid):
             p = wself()
@@ -102,8 +102,15 @@ class NativePlane:
     # -- callbacks (invoked synchronously from inside engine calls) ----
 
     def _on_event(self, kind: int, hid: int, tok: int, a: int,
-                  b: int) -> None:
+                  b: int, t: int) -> None:
         host = self._hosts[hid]
+        # During a batched engine run the Python-side clock lags; the
+        # callback carries the engine's current instant so listeners
+        # (conditions scheduling wakeups at now()) see the right time.
+        # max(): in syscall context the engine's clock may be stale
+        # instead, and per-host sim time is monotonic.
+        if t > host._now:
+            host._now = t
         if kind == self.mod.CB_STATUS:
             sock = host._nsocks.get(tok)
             if sock is not None:

@@ -142,6 +142,7 @@ class TpuPropagator:
         self.min_device_batch = min_device_batch
         self.runahead = runahead
         self.window_end = 0
+        self.engine = None  # native plane engine (set by the Manager)
         # Outbox: one tuple per packet (hot path = a single list append).
         # (src_host_obj, dst_host_obj, evt_seq, packet_or_native_id,
         #  pkt_seq, t_send, is_ctl)
@@ -167,39 +168,103 @@ class TpuPropagator:
                              src_host.next_event_seq(), packet, packet.seq,
                              src_host.now(), packet.is_empty_control()))
 
-    def send_native(self, src_host, pkt_id: int, dst_ip: int, pkt_seq: int,
-                    is_ctl: int) -> None:
-        """Native-plane twin of send(): metadata came from the engine's
-        outgoing drain; the packet stays in the C++ store."""
-        dst_id = self.dns.host_id_for_ip(dst_ip)
-        if dst_id is None:
-            src_host.plane.engine.drop_packet(src_host.id, pkt_id,
-                                              "no-route", src_host.now())
-            return
-        self._outbox.append((src_host, self.hosts[dst_id],
-                             src_host.next_event_seq(), pkt_id, pkt_seq,
-                             src_host.now(), bool(is_ctl)))
-
     def finish_round(self):
-        total = len(self._outbox)
-        if total == 0:
-            return None
-        # Honor the configured per-dispatch cap (device-memory bound):
-        # oversized rounds run as several kernel dispatches.
         global_min_deliver = _I64_MAX
         global_min_latency = _I64_MAX
-        for lo in range(0, total, self.max_batch):
-            hi = min(lo + self.max_batch, total)
-            md, ml = self._dispatch_chunk(lo, hi)
-            global_min_deliver = min(global_min_deliver, md)
-            global_min_latency = min(global_min_latency, ml)
-        self.packets_batched += total
+        # Object-path sends (CPU-plane hosts in mixed sims).
+        total = len(self._outbox)
+        if total:
+            for lo in range(0, total, self.max_batch):
+                hi = min(lo + self.max_batch, total)
+                md, ml = self._dispatch_chunk(lo, hi)
+                global_min_deliver = min(global_min_deliver, md)
+                global_min_latency = min(global_min_latency, ml)
+            self.packets_batched += total
+            self._outbox.clear()
+        # Engine-batched sends (native-plane hosts): the whole
+        # propagation phase — threefry loss, latency, clamp, delivery
+        # into destination inboxes — runs in one engine call (or on
+        # device above the cost-model threshold via export/scatter).
+        eng = self.engine
+        if eng is not None:
+            n = eng.round_size()
+            if n:
+                md, ml = self._engine_round(n)
+                global_min_deliver = min(global_min_deliver, md)
+                global_min_latency = min(global_min_latency, ml)
+                self.packets_batched += n
 
         if self.runahead is not None and global_min_latency < _I64_MAX:
             self.runahead.update_lowest_used_latency(global_min_latency)
-
-        self._outbox.clear()
         return global_min_deliver if global_min_deliver < _I64_MAX else None
+
+    def _engine_round(self, n: int):
+        import time as _time
+
+        eng = self.engine
+        b = _bucket(n)
+        t0 = _time.perf_counter_ns()
+        if self._use_device(n, b):
+            md, ml, exports = self._engine_device_round(n, b)
+            dt = _time.perf_counter_ns() - t0
+            if b not in self._dev_compiled:
+                self._dev_compiled.add(b)
+            else:
+                prev = self._dev_ns_by_bucket.get(b)
+                host = self._host_ns_per_pkt
+                if prev is None or (host is not None and prev > host * n):
+                    self._dev_ns_by_bucket[b] = dt
+                else:
+                    self._dev_ns_by_bucket[b] = 0.7 * prev + 0.3 * dt
+        else:
+            _nf, md, ml, exports = eng.finish_round(self.window_end)
+            dt = (_time.perf_counter_ns() - t0) / n
+            prev = self._host_ns_per_pkt
+            self._host_ns_per_pkt = dt if prev is None \
+                else 0.7 * prev + 0.3 * dt
+        self.rounds_dispatched += 1
+        if exports is not None:
+            self._deliver_exports(exports)
+        return (md if md < _I64_MAX else _I64_MAX,
+                ml if ml < _I64_MAX else _I64_MAX)
+
+    def _engine_device_round(self, n: int, b: int):
+        """Device path over engine-exported columns: same jitted kernel,
+        decisions scattered back by the engine."""
+        import jax.numpy as jnp
+
+        eng = self.engine
+        sn_b, dn_b, sh_b, ps_b, ts_b, ctl_b = eng.export_round()
+
+        def pad(buf, dtype, width):
+            col = np.frombuffer(buf, dtype=dtype)
+            a = np.zeros(b, dtype=dtype)
+            a[:n] = col
+            return a
+
+        valid = np.concatenate([np.ones(n, bool), np.zeros(b - n, bool)])
+        deliver, keep, reachable, lossy, md, ml = self.kernel(
+            pad(sn_b, np.int32, 4), pad(dn_b, np.int32, 4),
+            pad(sh_b, np.int64, 8), pad(ps_b, np.uint32, 4),
+            pad(ts_b, np.int64, 8), pad(ctl_b, np.bool_, 1), valid,
+            jnp.int64(self.window_end), jnp.int64(self.bootstrap_end))
+        _nf, _md2, _ml2, exports = eng.scatter_round(
+            np.ascontiguousarray(np.asarray(keep)[:n], dtype=np.uint8),
+            np.ascontiguousarray(np.asarray(deliver)[:n], dtype=np.int64),
+            np.ascontiguousarray(np.asarray(reachable)[:n],
+                                 dtype=np.uint8),
+            np.ascontiguousarray(np.asarray(lossy)[:n], dtype=np.uint8))
+        return int(md), int(ml), exports
+
+    def _deliver_exports(self, exports) -> None:
+        """Engine-origin packets whose destination host runs the object
+        path (mixed sims): materialize and deliver as Python events."""
+        for pkt_id, dst_host, evt_seq, deliver, src in exports:
+            plane = self.hosts[src].plane
+            p = _export_native_packet(plane, pkt_id)
+            p.arrival_time = deliver
+            self.hosts[dst_host].deliver_packet_event(
+                Event(deliver, KIND_PACKET, src, evt_seq, p))
 
     # How often to re-probe the device at a bucket size the cost model
     # currently routes to the host path (keeps the model honest if device
@@ -274,41 +339,24 @@ class TpuPropagator:
         for i in range(n):
             src_host, dst_host, seq, packet, _pseq, t_send, _ = \
                 outbox[lo + i]
-            native = type(packet) is int
             if keep_l[i]:
                 t = deliver_l[i]
-                if native:
-                    packet = self._cross_plane(src_host, dst_host, packet)
-                elif dst_host.plane is not None:
-                    packet = _intern_python_packet(dst_host.plane, packet)
-                if type(packet) is not int:
+                if dst_host.plane is not None:
+                    # Object-path origin, engine destination: intern the
+                    # packet into the store and ride the engine inbox.
+                    pid = _intern_python_packet(dst_host.plane, packet)
+                    dst_host.plane.engine.push_inbox(
+                        dst_host.id, t, src_host.id, seq, pid)
+                else:
                     packet.arrival_time = t
-                dst_host.deliver_packet_event(
-                    Event(t, KIND_PACKET, src_host.id, seq, packet))
+                    dst_host.deliver_packet_event(
+                        Event(t, KIND_PACKET, src_host.id, seq, packet))
             elif not reachable[i]:
-                if native:
-                    src_host.plane.engine.drop_packet(
-                        src_host.id, packet, "unreachable", t_send)
-                else:
-                    src_host.trace_drop(packet, "unreachable",
-                                        at_time=t_send)
+                src_host.trace_drop(packet, "unreachable", at_time=t_send)
             elif lossy[i]:
-                if native:
-                    src_host.plane.engine.drop_packet(
-                        src_host.id, packet, "inet-loss", t_send)
-                else:
-                    packet.record(pktmod.ST_INET_DROPPED)
-                    src_host.trace_drop(packet, "inet-loss", at_time=t_send)
+                packet.record(pktmod.ST_INET_DROPPED)
+                src_host.trace_drop(packet, "inet-loss", at_time=t_send)
         return int(min_deliver), int(min_latency)
-
-    @staticmethod
-    def _cross_plane(src_host, dst_host, pkt_id: int):
-        """Native packet heading to a destination host: stays a handle
-        when the destination is on the engine too (the common case —
-        they share the store), else materializes as a Python Packet."""
-        if dst_host.plane is not None:
-            return pkt_id
-        return _export_native_packet(src_host.plane, pkt_id)
 
     def _chunk_columns(self, lo: int, hi: int):
         """Transpose the outbox slice into numpy columns."""
