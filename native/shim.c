@@ -697,6 +697,147 @@ static void install_seccomp(void) {
 }
 
 /* ---------------------------------------------------------------- */
+/* vDSO patching (ref: src/lib/shim/patch_vdso.c:1-274)              */
+/*                                                                   */
+/* The libc symbol overrides below cover callers that route time     */
+/* calls through libc, but a runtime that calls the vDSO directly    */
+/* (Go's runtime resolves __vdso_clock_gettime from the auxv ELF     */
+/* and calls it with no libc in between) would read the real clock.  */
+/* Rewrite every exported vDSO time function's entry to              */
+/*     mov eax, <NR> ; syscall ; ret                                 */
+/* The syscall instruction sits in the vDSO mapping — outside the    */
+/* trampoline IP window — so the seccomp filter traps it and the     */
+/* SIGSYS handler answers from the shared sim clock like any other   */
+/* interposed time syscall.  Must run before install_seccomp (the    */
+/* mprotect calls here execute natively).                            */
+/* ---------------------------------------------------------------- */
+
+#include <elf.h>
+#include <sys/auxv.h>
+
+static const struct { const char *name; int nr; } VDSO_PATCHES[] = {
+    {"clock_gettime",        SYS_clock_gettime},
+    {"__vdso_clock_gettime", SYS_clock_gettime},
+    {"gettimeofday",         SYS_gettimeofday},
+    {"__vdso_gettimeofday",  SYS_gettimeofday},
+    {"time",                 SYS_time},
+    {"__vdso_time",          SYS_time},
+    {"clock_getres",         SYS_clock_getres},
+    {"__vdso_clock_getres",  SYS_clock_getres},
+    {"getcpu",               SYS_getcpu},
+    {"__vdso_getcpu",        SYS_getcpu},
+};
+
+static int vdso_nr_for(const char *name) {
+    for (size_t i = 0; i < sizeof(VDSO_PATCHES) / sizeof(*VDSO_PATCHES); i++)
+        if (strcmp(VDSO_PATCHES[i].name, name) == 0)
+            return VDSO_PATCHES[i].nr;
+    return -1;
+}
+
+static void patch_vdso(void) {
+    uintptr_t base = (uintptr_t)getauxval(AT_SYSINFO_EHDR);
+    if (!base)
+        return;  /* no vDSO (unusual); libc overrides still apply */
+    const Elf64_Ehdr *eh = (const Elf64_Ehdr *)base;
+    if (memcmp(eh->e_ident, ELFMAG, SELFMAG) != 0) {
+        shim_log_msg("[shadow-tpu shim] vdso: bad ELF magic; "
+                     "direct-vdso callers will see the real clock\n");
+        return;
+    }
+
+    /* Runtime view only: program headers -> load bias + PT_DYNAMIC.
+     * (Section headers also happen to be mapped for the vDSO, but the
+     * dynamic segment is the contract every loader relies on.) */
+    const Elf64_Phdr *ph = (const Elf64_Phdr *)(base + eh->e_phoff);
+    uintptr_t bias = 0;
+    const Elf64_Phdr *dynph = NULL;
+    int have_load = 0;
+    for (int i = 0; i < eh->e_phnum; i++) {
+        if (ph[i].p_type == PT_LOAD && !have_load) {
+            bias = base - (uintptr_t)ph[i].p_vaddr;
+            have_load = 1;
+        } else if (ph[i].p_type == PT_DYNAMIC) {
+            dynph = &ph[i];
+        }
+    }
+    if (!have_load || !dynph) {
+        shim_log_msg("[shadow-tpu shim] vdso: no PT_LOAD/PT_DYNAMIC; "
+                     "direct-vdso callers will see the real clock\n");
+        return;
+    }
+
+    const Elf64_Sym *symtab = NULL;
+    const char *strtab = NULL;
+    const uint32_t *hash = NULL;
+    const Elf64_Dyn *dyn = (const Elf64_Dyn *)(bias + dynph->p_vaddr);
+    for (; dyn->d_tag != DT_NULL; dyn++) {
+        uintptr_t v = (uintptr_t)dyn->d_un.d_ptr;
+        if (v < base)
+            v += bias;  /* some kernels emit unrelocated d_ptr values */
+        switch (dyn->d_tag) {
+        case DT_SYMTAB: symtab = (const Elf64_Sym *)v; break;
+        case DT_STRTAB: strtab = (const char *)v; break;
+        case DT_HASH:   hash = (const uint32_t *)v; break;
+        }
+    }
+    if (!symtab || !strtab || !hash) {
+        shim_log_msg("[shadow-tpu shim] vdso: dynamic section lacks "
+                     "DT_SYMTAB/DT_STRTAB/DT_HASH; direct-vdso callers "
+                     "will see the real clock\n");
+        return;
+    }
+    uint32_t nsyms = hash[1];  /* nchain == total symbol count */
+
+    /* One RWX window over the whole image while stubs go in: from the
+     * ELF header through the highest PT_LOAD end. */
+    long psz = 4096;
+    uintptr_t img_end = base;
+    for (int i = 0; i < eh->e_phnum; i++)
+        if (ph[i].p_type == PT_LOAD) {
+            uintptr_t e = bias + ph[i].p_vaddr + ph[i].p_memsz;
+            if (e > img_end)
+                img_end = e;
+        }
+    uintptr_t lo = base & ~(uintptr_t)(psz - 1);
+    uintptr_t len = ((img_end - lo) + psz - 1) & ~(uintptr_t)(psz - 1);
+    if (raw(SYS_mprotect, (long)lo, (long)len,
+            PROT_READ | PROT_WRITE | PROT_EXEC, 0, 0, 0) != 0) {
+        shim_log_msg("[shadow-tpu shim] vdso mprotect(rwx) failed; "
+                     "direct-vdso callers will see the real clock\n");
+        return;
+    }
+
+    int patched = 0;
+    for (uint32_t i = 0; i < nsyms; i++) {
+        const Elf64_Sym *s = &symtab[i];
+        if (s->st_value == 0 ||
+            ELF64_ST_TYPE(s->st_info) != STT_FUNC)
+            continue;
+        int nr = vdso_nr_for(strtab + s->st_name);
+        if (nr < 0)
+            continue;
+        uint8_t *entry = (uint8_t *)(bias + s->st_value);
+        /* mov eax, imm32 ; syscall ; ret  (8 bytes) */
+        entry[0] = 0xb8;
+        entry[1] = (uint8_t)(nr & 0xff);
+        entry[2] = (uint8_t)((nr >> 8) & 0xff);
+        entry[3] = (uint8_t)((nr >> 16) & 0xff);
+        entry[4] = (uint8_t)((nr >> 24) & 0xff);
+        entry[5] = 0x0f;
+        entry[6] = 0x05;
+        entry[7] = 0xc3;
+        patched++;
+    }
+    if (raw(SYS_mprotect, (long)lo, (long)len, PROT_READ | PROT_EXEC,
+            0, 0, 0) != 0)
+        shim_log_msg("[shadow-tpu shim] vdso: mprotect(rx) restore "
+                     "failed; vdso image left writable\n");
+    if (!patched)
+        shim_log_msg("[shadow-tpu shim] vdso: no time symbols found\n");
+}
+
+/* ---------------------------------------------------------------- */
 /* vDSO-bypass overrides (preload wins the symbol lookup)            */
 /* ---------------------------------------------------------------- */
 
@@ -765,8 +906,10 @@ static void shim_init(void) {
         shim_die("[shadow-tpu shim] sigaction(SIGSYS) failed\n");
 
     install_rdtsc_trap();
-    /* Before seccomp: its sigaction/setitimer must run natively, not
-     * trap into a manager that hasn't completed the handshake. */
+    /* Before seccomp: patch_vdso's mprotect and preemption's
+     * sigaction/setitimer must run natively, not trap into a manager
+     * that hasn't completed the handshake. */
+    patch_vdso();
     install_preemption();
     install_seccomp();
     g_in_shim++;

@@ -1,0 +1,94 @@
+/* Calls vDSO time functions DIRECTLY, bypassing libc entirely — the
+ * same resolution path the Go runtime uses (parse the vDSO ELF from
+ * auxv, call the function pointer).  Under the simulator the shim must
+ * have rewritten these entry points so the calls land in the seccomp
+ * trap and read the simulated clock; without the patch this program
+ * would print the real wall clock.
+ *
+ * Ref gate analog: src/test/golang/ (no Go toolchain in this image, so
+ * this C program exercises the identical mechanism). */
+#define _GNU_SOURCE
+#include <elf.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/auxv.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+typedef int (*clock_gettime_fn)(clockid_t, struct timespec *);
+typedef time_t (*time_fn)(time_t *);
+
+static void *vdso_sym(const char *want) {
+    uintptr_t base = (uintptr_t)getauxval(AT_SYSINFO_EHDR);
+    if (!base)
+        return NULL;
+    const Elf64_Ehdr *eh = (const Elf64_Ehdr *)base;
+    const Elf64_Phdr *ph = (const Elf64_Phdr *)(base + eh->e_phoff);
+    uintptr_t bias = 0;
+    const Elf64_Phdr *dynph = NULL;
+    int have_load = 0;
+    for (int i = 0; i < eh->e_phnum; i++) {
+        if (ph[i].p_type == PT_LOAD && !have_load) {
+            bias = base - ph[i].p_vaddr;
+            have_load = 1;
+        } else if (ph[i].p_type == PT_DYNAMIC) {
+            dynph = &ph[i];
+        }
+    }
+    if (!have_load || !dynph)
+        return NULL;
+    const Elf64_Sym *symtab = NULL;
+    const char *strtab = NULL;
+    const uint32_t *hash = NULL;
+    for (const Elf64_Dyn *d = (const Elf64_Dyn *)(bias + dynph->p_vaddr);
+         d->d_tag != DT_NULL; d++) {
+        uintptr_t v = (uintptr_t)d->d_un.d_ptr;
+        if (v < base)
+            v += bias;
+        if (d->d_tag == DT_SYMTAB)
+            symtab = (const Elf64_Sym *)v;
+        else if (d->d_tag == DT_STRTAB)
+            strtab = (const char *)v;
+        else if (d->d_tag == DT_HASH)
+            hash = (const uint32_t *)v;
+    }
+    if (!symtab || !strtab || !hash)
+        return NULL;
+    for (uint32_t i = 0; i < hash[1]; i++) {
+        if (symtab[i].st_value &&
+            strcmp(strtab + symtab[i].st_name, want) == 0)
+            return (void *)(bias + symtab[i].st_value);
+    }
+    return NULL;
+}
+
+int main(void) {
+    clock_gettime_fn vcg = (clock_gettime_fn)vdso_sym("__vdso_clock_gettime");
+    time_fn vtime = (time_fn)vdso_sym("__vdso_time");
+    if (!vcg || !vtime) {
+        printf("no-vdso\n");
+        return 2;
+    }
+    for (int i = 0; i < 3; i++) {
+        struct timespec direct, via_sys;
+        if (vcg(CLOCK_REALTIME, &direct) != 0) {
+            printf("vdso-call-failed\n");
+            return 3;
+        }
+        syscall(SYS_clock_gettime, CLOCK_REALTIME, &via_sys);
+        long skew_ns = (via_sys.tv_sec - direct.tv_sec) * 1000000000L +
+                       (via_sys.tv_nsec - direct.tv_nsec);
+        /* Direct-vdso and syscall reads a few instructions apart must
+         * agree to within the syscall-latency model's billing. */
+        printf("sample=%d direct=%lld.%09ld skew_ok=%d\n", i,
+               (long long)direct.tv_sec, direct.tv_nsec,
+               skew_ns >= 0 && skew_ns < 50000000);
+        struct timespec ts = {0, 200 * 1000 * 1000};
+        nanosleep(&ts, NULL);
+    }
+    time_t t = vtime(NULL);
+    printf("vdso_time=%lld\n", (long long)t);
+    return 0;
+}
