@@ -873,6 +873,110 @@ time_t time(time_t *tloc) {
 }
 
 /* ---------------------------------------------------------------- */
+/* OpenSSL RNG interposition (ref: src/lib/preload-openssl/rng.c)    */
+/*                                                                   */
+/* libcrypto taps entropy sources seccomp cannot see (RDRAND via     */
+/* CPUID-gated fast paths).  Two layers make OpenSSL-linked apps     */
+/* deterministic: the manager exports OPENSSL_ia32cap to mask the    */
+/* RDRAND/RDSEED feature bits (OpenSSL 3's provider DRBG then seeds  */
+/* through the trapped getrandom syscall), and these preload-winning  */
+/* overrides route the classic RAND_* API straight to emulated       */
+/* getrandom for 1.1-style callers.  Seeding/entropy management      */
+/* no-ops: the simulated kernel is the only entropy source.          */
+/* ---------------------------------------------------------------- */
+
+static int shim_rand_fill(unsigned char *buf, size_t n) {
+    if (!buf)
+        return 0;
+    /* getrandom may return short (manager clamps emulated reads to
+     * 1 MiB; real reads >256 bytes can be signal-interrupted) — loop
+     * until the buffer is full. */
+    while (n > 0) {
+        long r;
+        if (!g_enabled) {
+            r = raw(SYS_getrandom, (long)buf, (long)n, 0, 0, 0, 0);
+            if (r == -EINTR)
+                continue;
+        } else {
+            long args[6] = {(long)buf, (long)n, 0, 0, 0, 0};
+            r = shim_emulated_syscall(SYS_getrandom, args);
+        }
+        if (r <= 0)
+            return 0;
+        buf += r;
+        n -= (size_t)r;
+    }
+    return 1;
+}
+
+int RAND_bytes(unsigned char *buf, int num) {
+    return num >= 0 ? shim_rand_fill(buf, (size_t)num) : 0;
+}
+
+int RAND_priv_bytes(unsigned char *buf, int num) {
+    return RAND_bytes(buf, num);
+}
+
+int RAND_pseudo_bytes(unsigned char *buf, int num) {
+    return RAND_bytes(buf, num);
+}
+
+int RAND_DRBG_bytes(void *drbg, unsigned char *out, size_t outlen) {
+    (void)drbg;
+    return shim_rand_fill(out, outlen);
+}
+
+int RAND_DRBG_generate(void *drbg, unsigned char *out, size_t outlen,
+                       int prediction_resistance,
+                       const unsigned char *adin, size_t adinlen) {
+    (void)drbg; (void)prediction_resistance; (void)adin; (void)adinlen;
+    return shim_rand_fill(out, outlen);
+}
+
+void RAND_seed(const void *buf, int num) { (void)buf; (void)num; }
+void RAND_add(const void *buf, int num, double entropy) {
+    (void)buf; (void)num; (void)entropy;
+}
+int RAND_poll(void) { return 1; }
+void RAND_cleanup(void) {}
+int RAND_status(void) { return 1; }
+
+/* Static method table for callers that fetch the RAND_METHOD and call
+ * through it.  Field order is the OpenSSL ABI (seed, bytes, cleanup,
+ * add, pseudorand, status); the return-type drift across OpenSSL
+ * versions is absorbed by x86-64's caller-saved rax convention. */
+struct shim_rand_method {
+    int (*seed)(const void *buf, int num);
+    int (*bytes)(unsigned char *buf, int num);
+    void (*cleanup)(void);
+    int (*add)(const void *buf, int num, double entropy);
+    int (*pseudorand)(unsigned char *buf, int num);
+    int (*status)(void);
+};
+
+static int shim_rand_seed_noop(const void *buf, int num) {
+    (void)buf; (void)num;
+    return 1;
+}
+static int shim_rand_add_noop(const void *buf, int num, double entropy) {
+    (void)buf; (void)num; (void)entropy;
+    return 1;
+}
+
+static const struct shim_rand_method SHIM_RAND_METHOD = {
+    .seed = shim_rand_seed_noop,
+    .bytes = RAND_bytes,
+    .cleanup = RAND_cleanup,
+    .add = shim_rand_add_noop,
+    .pseudorand = RAND_pseudo_bytes,
+    .status = RAND_status,
+};
+
+const void *RAND_get_rand_method(void) { return &SHIM_RAND_METHOD; }
+const void *RAND_OpenSSL(void) { return &SHIM_RAND_METHOD; }
+int RAND_set_rand_method(const void *meth) { (void)meth; return 1; }
+
+/* ---------------------------------------------------------------- */
 /* Init                                                              */
 /* ---------------------------------------------------------------- */
 

@@ -23,9 +23,11 @@ memory_manager/memory_copier.rs) — with the same protocol:
 
 from __future__ import annotations
 
+import ctypes
 import os
 import shutil
 import signal
+import threading
 import time as _walltime
 
 from shadow_tpu.core.event import TaskRef
@@ -54,6 +56,37 @@ from shadow_tpu.host.syscalls_native import syscall_name
 # (child_watcher.py); this poll is only a safety net, so it can be
 # long without costing latency.
 _DEATH_POLL_NS = 2_000_000_000
+
+# personality(2) flag: children inherit it through fork+exec, so setting
+# it in the spawning thread gives every managed process a non-randomized
+# address space (ref: shadow.rs:429 disable_aslr).  Address-derived
+# values otherwise leak real entropy into simulations — OpenSSL's DRBG
+# nonce includes pthread_self(), a TCB address, which made TLS
+# handshakes differ across byte-identical runs.  personality is a
+# per-TASK (thread) attribute and posix_spawn forks from the calling
+# thread, so this must run on every scheduler worker thread that
+# spawns, not once per process.
+_ADDR_NO_RANDOMIZE = 0x0040000
+_aslr_tls = threading.local()
+
+
+def _disable_aslr_once() -> None:
+    if getattr(_aslr_tls, "done", False):
+        return
+    _aslr_tls.done = True
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        cur = libc.personality(0xFFFFFFFF)
+        if cur < 0:
+            raise OSError(ctypes.get_errno(), "personality query")
+        if not (cur & _ADDR_NO_RANDOMIZE):
+            if libc.personality(cur | _ADDR_NO_RANDOMIZE) < 0:
+                raise OSError(ctypes.get_errno(), "personality")
+    except Exception as exc:  # pragma: no cover - sandbox-dependent
+        import warnings
+        warnings.warn(f"could not disable ASLR ({exc}); address-derived "
+                      f"values in managed processes (e.g. OpenSSL DRBG "
+                      f"nonces) may be nondeterministic")
 
 
 class MemoryManager:
@@ -199,6 +232,7 @@ class ManagedProcess(Process):
 
     def _spawn_image_with(self, host, ipc, ipc_path, shim, resolved,
                           argv, env, truncate_output) -> "ManagedThread":
+        _disable_aslr_once()
         ipc.set_sim_time(host.now())
         ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
         ipc.set_self_path(ipc_path)
@@ -223,6 +257,13 @@ class ManagedProcess(Process):
         # Eager relocation: keeps ld.so's lazy-binding syscalls out of
         # the simulated timeline.
         env.setdefault("LD_BIND_NOW", "1")
+        # OpenSSL determinism (ref: src/lib/preload-openssl/rng.c).  The
+        # shim interposes the RAND_* symbols for 1.1-style callers; for
+        # OpenSSL 3's provider DRBG — which seeds itself from CPU
+        # entropy when available — mask the RDRAND/RDSEED CPUID bits so
+        # seeding falls back to the getrandom syscall, which seccomp
+        # traps and the manager answers from the host's seeded RNG.
+        env.setdefault("OPENSSL_ia32cap", "~0x4000000000000000:~0x40000")
         ipc.set_preload(preload)
 
         # Always O_APPEND: a fork child's exec'd image opens its own

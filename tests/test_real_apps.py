@@ -139,6 +139,91 @@ network:
         bytes(server.stdout)
 
 
+OPENSSL = shutil.which("openssl")
+
+
+@pytest.mark.skipif(CURL is None or OPENSSL is None or
+                    not os.path.exists(SYS_PYTHON),
+                    reason="needs curl + openssl + system python")
+def test_curl_tls_fetch_deterministic(tmp_path):
+    """curl fetches over TLS from an in-sim HTTPS server, twice, and
+    the client's pcap — full packet bytes, TLS handshake included — is
+    byte-identical across runs.  This is the OpenSSL-determinism gate
+    (ref: src/lib/preload-openssl/rng.c): ClientHello/ServerHello
+    randoms, ECDHE keys, and session tickets all come from OpenSSL's
+    DRBG, which under the shim seeds from emulated getrandom (RDRAND
+    masked via OPENSSL_ia32cap, RAND_* interposed), so the handshake
+    bytes repeat exactly.  Without the RNG discipline the first 32
+    bytes of the ClientHello would differ every run."""
+    import subprocess
+    cert, key = str(tmp_path / "cert.pem"), str(tmp_path / "key.pem")
+    subprocess.run(
+        [OPENSSL, "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-subj", "/CN=server",
+         "-days", "3650"],
+        check=True, capture_output=True)
+    docroot = tmp_path / "docroot"
+    os.makedirs(docroot)
+    (docroot / "index.html").write_text("tls-served-payload\n")
+    server_py = tmp_path / "https_server.py"
+    server_py.write_text(
+        "import functools, http.server, ssl, sys\n"
+        "cert, key, docroot = sys.argv[1:4]\n"
+        "handler = functools.partial("
+        "http.server.SimpleHTTPRequestHandler, directory=docroot)\n"
+        "httpd = http.server.HTTPServer(('0.0.0.0', 443), handler)\n"
+        "ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)\n"
+        "ctx.load_cert_chain(cert, key)\n"
+        "httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)\n"
+        "httpd.serve_forever()\n")
+
+    pcaps = []
+    for i in range(2):
+        d = tmp_path / f"run{i}"
+        os.makedirs(d)
+        out = str(d / "fetched")
+        yaml = f"""
+general:
+  stop_time: 30s
+  seed: 11
+  data_directory: {d / 'data'}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: {SYS_PYTHON}
+        args: ["{server_py}", "{cert}", "{key}", "{docroot}"]
+        start_time: 1s
+        expected_final_state: running
+  client:
+    network_node_id: 0
+    pcap_enabled: true
+    processes:
+      - path: {CURL}
+        args: ["-k", "-s", "-S", "-o", "{out}", "https://server/index.html"]
+        start_time: 5s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+"""
+        cfg = ConfigOptions.from_yaml_text(yaml)
+        manager, summary = run_simulation(cfg)
+        client_host = next(h for h in manager.hosts if h.name == "client")
+        proc = next(iter(client_host.processes.values()))
+        assert proc.exited and proc.exit_code == 0, bytes(proc.stderr)
+        assert open(out).read() == "tls-served-payload\n"
+        pcap = os.path.join(str(d / "data"), "hosts", "client",
+                            "eth0.pcap")
+        pcaps.append(open(pcap, "rb").read())
+    assert len(pcaps[0]) > 2000  # handshake + data actually captured
+    assert pcaps[0] == pcaps[1]
+
+
 @pytest.mark.skipif(CURL is None, reason="no curl binary")
 def test_curl_deterministic_packet_trace(tmp_path):
     """The same curl fetch twice produces byte-identical packet traces
