@@ -36,6 +36,7 @@
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/prctl.h>
+#include <sys/socket.h>
 #include <sys/syscall.h>
 #include <sys/time.h>
 #include <sys/ucontext.h>
@@ -88,12 +89,34 @@ static __thread int g_in_shim
 /* Simulated ns billed per preemption, from SHADOWTPU_PREEMPT_SIM_NS. */
 static long g_preempt_sim_ns = 0;
 static long g_preempt_native_us = 0;
+/* Simulated ns per KiB of DO_NATIVE file I/O (SHADOWTPU_IO_NS_PER_KIB;
+ * 0 = don't model).  Native file reads otherwise cost zero simulated
+ * time, letting disk-bound phases collapse out of the timeline (ref:
+ * the unblocked-syscall latency model, handler/mod.rs:271-321). */
+static long g_io_ns_per_kib = 0;
+/* Transfer socket for native-fd SCM_RIGHTS delivery (dup2'd to a
+ * reserved fd by the manager's posix_spawn; SHADOWTPU_XFER_FD). */
+static long g_xfer_fd = -1;
+/* Fd-split headroom (manager side keeps EMU_FD_BASE=400): native fds
+ * the kernel allocates INSIDE the emulated window [400, floor) are
+ * immediately F_DUPFD'd to >= floor and the original closed, so an app
+ * holding hundreds of files never collides with emulated fd numbers
+ * (ref fully virtualizes fds, descriptor_table.rs:18-260; the split +
+ * move keeps our native-passthrough design).  0 = rlimit too small to
+ * carve a window; computed at init after raising the soft NOFILE
+ * limit to the hard one. */
+static long g_fd_move_floor = 0;
+#define SHIM_EMU_FD_BASE 400
+/* OPENSSL_ia32cap value captured at init (RDRAND mask; re-exported
+ * across execve even if the app unsets it). */
+static char g_ia32cap[80] = "";
 /* Custom pseudo-syscall (ref shadow_syscalls.rs shadow_yield). */
 #define SHADOWTPU_SYS_YIELD 0x53544001L
 
 #define raw shadowtpu_raw_syscall
 
 static void install_preemption(void);
+static long shim_collect_fds(long nfds);
 
 static void shim_log_msg(const char *msg) {
     size_t n = 0;
@@ -279,26 +302,59 @@ static long shim_finish_fork(void) {
  * initializes under the same manager process (the manager spawns the
  * replacement image itself; this path only runs if it ever answers
  * DO_NATIVE, kept for completeness). */
+static void shim_fmt_long(char *dst, long v) {
+    char tmp[24];
+    int i = 0;
+    if (v < 0) { *dst++ = '-'; v = -v; }
+    do { tmp[i++] = (char)('0' + v % 10); v /= 10; } while (v);
+    while (i > 0) *dst++ = tmp[--i];
+    *dst = 0;
+}
+
 static long shim_do_execve(const long args[6]) {
     static char *new_envp[1024];
     static char ipc_var[IPC_PATH_MAX + 16] = "SHADOWTPU_IPC=";
     static char preload_var[IPC_PATH_MAX + 16] = "LD_PRELOAD=";
     static char bind_var[] = "LD_BIND_NOW=1";
+    static char xfer_var[48] = "SHADOWTPU_XFER_FD=";
+    static char io_var[48] = "SHADOWTPU_IO_NS_PER_KIB=";
+    /* Captured at init: losing the RDRAND mask across an execve with a
+     * constructed envp would silently break OpenSSL determinism. */
+    static char ia32cap_var[96] = "OPENSSL_ia32cap=";
     memcpy(ipc_var + 14, (const void *)g_ipc->self_path, IPC_PATH_MAX);
     memcpy(preload_var + 11, (const void *)g_ipc->preload_path,
            IPC_PATH_MAX);
+    shim_fmt_long(xfer_var + 18, g_xfer_fd);
+    shim_fmt_long(io_var + 24, g_io_ns_per_kib);
+    const char *cap = g_ia32cap[0] ? g_ia32cap : NULL;
+    if (cap) {
+        size_t cl = strlen(cap);
+        if (cl > 79)
+            cl = 79;
+        memcpy(ia32cap_var + 16, cap, cl);
+        ia32cap_var[16 + cl] = 0;
+    }
     char *const *envp = (char *const *)args[2];
     int n = 0;
-    for (int i = 0; envp && envp[i] && n < 1019; i++) {
+    for (int i = 0; envp && envp[i] && n < 1016; i++) {
         if (!strncmp(envp[i], "SHADOWTPU_IPC=", 14) ||
             !strncmp(envp[i], "LD_PRELOAD=", 11) ||
-            !strncmp(envp[i], "LD_BIND_NOW=", 12))
+            !strncmp(envp[i], "LD_BIND_NOW=", 12) ||
+            !strncmp(envp[i], "SHADOWTPU_XFER_FD=", 18) ||
+            !strncmp(envp[i], "SHADOWTPU_IO_NS_PER_KIB=", 24) ||
+            (cap && !strncmp(envp[i], "OPENSSL_ia32cap=", 16)))
             continue;
         new_envp[n++] = envp[i];
     }
     new_envp[n++] = ipc_var;
     new_envp[n++] = preload_var;
     new_envp[n++] = bind_var;
+    if (g_xfer_fd >= 0)
+        new_envp[n++] = xfer_var;
+    if (g_io_ns_per_kib > 0)
+        new_envp[n++] = io_var;
+    if (cap)
+        new_envp[n++] = ia32cap_var;
     new_envp[n] = NULL;
     return raw(SYS_execve, args[0], args[1], (long)new_envp, 0, 0, 0);
 }
@@ -313,12 +369,69 @@ static long shim_ipc_syscall(long n, const long args[6]) {
     shim_recv_response(&ev);
     if (ev.kind == EV_SYSCALL_COMPLETE)
         return ev.num;
+    if (ev.kind == EV_SYSCALL_COMPLETE_FDXFER) {
+        /* Pull the native fds off the transfer socket and patch them
+         * into the app's cmsg buffer, then wait for the real result. */
+        long st = shim_collect_fds(ev.num);
+        shim_event_t done;
+        memset(&done, 0, sizeof(done));
+        done.kind = EV_XFER_DONE;
+        done.num = st;
+        slot_send(&g_chan->to_shadow, &done);
+        shim_recv_response(&ev);
+        if (ev.kind != EV_SYSCALL_COMPLETE)
+            shim_die("[shadow-tpu shim] bad fd-transfer completion\n");
+        return ev.num;
+    }
     if (ev.kind == EV_FORK_RES)
         return shim_finish_fork();
     if (ev.kind == EV_SYSCALL_DO_NATIVE) {
         if (n == SYS_execve)
             return shim_do_execve(args);
-        return raw(n, args[0], args[1], args[2], args[3], args[4], args[5]);
+        long rv = raw(n, args[0], args[1], args[2], args[3], args[4],
+                      args[5]);
+        /* Newly created native fds that landed in the emulated fd
+         * window move above it (cloexec preserved). */
+        if (g_fd_move_floor > 0 && rv >= SHIM_EMU_FD_BASE &&
+            rv < g_fd_move_floor) {
+            switch (n) {
+            case SYS_open: case SYS_openat: case SYS_creat:
+            case SYS_openat2: case SYS_memfd_create: case SYS_dup: {
+                long fl = raw(SYS_fcntl, rv, F_GETFD, 0, 0, 0, 0);
+                long cmd = (fl > 0 && (fl & FD_CLOEXEC))
+                               ? F_DUPFD_CLOEXEC : F_DUPFD;
+                long moved = raw(SYS_fcntl, rv, cmd, g_fd_move_floor,
+                                 0, 0, 0);
+                if (moved >= 0) {
+                    raw(SYS_close, rv, 0, 0, 0, 0, 0);
+                    rv = moved;
+                }
+                break;
+            }
+            default:
+                break;
+            }
+        }
+        /* Byte-I/O syscalls accrue simulated time proportional to the
+         * bytes actually moved; the manager drains the accumulator at
+         * the next event on this channel. */
+        if (g_io_ns_per_kib > 0 && rv > 0) {
+            switch (n) {
+            case SYS_read: case SYS_write:
+            case SYS_pread64: case SYS_pwrite64:
+            case SYS_readv: case SYS_writev:
+            case SYS_preadv: case SYS_pwritev:
+            case SYS_preadv2: case SYS_pwritev2:
+            case SYS_getdents64: case SYS_copy_file_range:
+            case SYS_sendfile:
+                g_chan->unapplied_ns +=
+                    ((uint64_t)rv * (uint64_t)g_io_ns_per_kib) >> 10;
+                break;
+            default:
+                break;
+            }
+        }
+        return rv;
     }
     shim_die("[shadow-tpu shim] unexpected response kind\n");
     return -ENOSYS;
@@ -465,6 +578,60 @@ static int shim_try_local(long n, const long args[6], long *ret) {
     default:
         return 0;
     }
+}
+
+/* Collect native fds the manager queued on the transfer socket and
+ * patch their numbers into the app's cmsg buffer.  The dgram payload
+ * is nfds u64 app-memory addresses paired 1:1 with the ancillary fds
+ * (manager side: socket.send_fds in managed.py).  Returns 0 or
+ * -errno. */
+#define XFER_MAX_FDS 64
+static long shim_collect_fds(long nfds) {
+    if (g_xfer_fd < 0)
+        return -EBADF;
+    /* ALWAYS drain the datagram, even on a bad count — a stale
+     * message left queued would desync every later transfer (and
+     * patch stale app addresses). */
+    uint64_t addrs[XFER_MAX_FDS];
+    char cbuf[CMSG_SPACE(sizeof(int) * XFER_MAX_FDS)];
+    struct iovec iov = { addrs, sizeof(addrs) };
+    struct msghdr mh;
+    memset(&mh, 0, sizeof(mh));
+    mh.msg_iov = &iov;
+    mh.msg_iovlen = 1;
+    mh.msg_control = cbuf;
+    mh.msg_controllen = sizeof(cbuf);
+    long r = raw(SYS_recvmsg, g_xfer_fd, (long)&mh, MSG_DONTWAIT, 0, 0, 0);
+    if (r < 0)
+        return r;
+    struct cmsghdr *c = CMSG_FIRSTHDR(&mh);
+    if (!c || c->cmsg_level != SOL_SOCKET || c->cmsg_type != SCM_RIGHTS)
+        return -EPROTO;
+    int *fds = (int *)CMSG_DATA(c);
+    long got = (long)((c->cmsg_len - CMSG_LEN(0)) / sizeof(int));
+    long naddr = r / 8;
+    if (nfds <= 0 || nfds > XFER_MAX_FDS || got != nfds ||
+        naddr != nfds) {
+        for (long i = 0; i < got; i++)
+            raw(SYS_close, fds[i], 0, 0, 0, 0, 0);
+        return -EPROTO;
+    }
+    for (long i = 0; i < nfds; i++) {
+        int fd = fds[i];
+        /* Keep delivered fds out of the emulated window, like
+         * DO_NATIVE open results. */
+        if (g_fd_move_floor > 0 && fd >= SHIM_EMU_FD_BASE &&
+            fd < g_fd_move_floor) {
+            long moved = raw(SYS_fcntl, fd, F_DUPFD, g_fd_move_floor,
+                             0, 0, 0);
+            if (moved >= 0) {
+                raw(SYS_close, fd, 0, 0, 0, 0, 0);
+                fd = (int)moved;
+            }
+        }
+        *(int *)(uintptr_t)addrs[i] = fd;
+    }
+    return 0;
 }
 
 /* Central dispatch: the shim-side half of the syscall round trip. */
@@ -988,6 +1155,38 @@ static void shim_init(void) {
     g_shimlog_path = getenv("SHADOWTPU_SHIMLOG");
     if (g_shimlog_path && !*g_shimlog_path)
         g_shimlog_path = NULL;
+    const char *io_ns = getenv("SHADOWTPU_IO_NS_PER_KIB");
+    if (io_ns && *io_ns)
+        g_io_ns_per_kib = atol(io_ns);
+    const char *xfer = getenv("SHADOWTPU_XFER_FD");
+    if (xfer && *xfer)
+        g_xfer_fd = atol(xfer);
+    const char *cap0 = getenv("OPENSSL_ia32cap");
+    if (cap0 && *cap0) {
+        size_t cl = strlen(cap0);
+        if (cl > sizeof(g_ia32cap) - 1)
+            cl = sizeof(g_ia32cap) - 1;
+        memcpy(g_ia32cap, cap0, cl);
+        g_ia32cap[cl] = 0;
+    }
+
+    /* Raise the soft NOFILE limit to the hard one and pick the floor
+     * native fds get moved past when they stray into the emulated
+     * window (see g_fd_move_floor). */
+    {
+        struct { uint64_t cur, max; } rl = {0, 0};
+        if (raw(SYS_prlimit64, 0, 7 /*RLIMIT_NOFILE*/, 0,
+                (long)&rl, 0, 0) == 0 && rl.max > 0) {
+            if (rl.cur < rl.max) {
+                struct { uint64_t cur, max; } nrl = {rl.max, rl.max};
+                raw(SYS_prlimit64, 0, 7, (long)&nrl, 0, 0, 0);
+            }
+            if (rl.max >= 131072)
+                g_fd_move_floor = 65536;
+            else if (rl.max >= 4096)
+                g_fd_move_floor = 2048;
+        }
+    }
 
     long fd = raw(SYS_openat, AT_FDCWD, (long)path, O_RDWR, 0, 0, 0);
     if (fd < 0)

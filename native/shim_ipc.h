@@ -33,7 +33,7 @@ typedef _Atomic uint64_t ipc_atomic_u64;
 #endif
 
 #define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
-#define SHIM_IPC_VERSION 5u
+#define SHIM_IPC_VERSION 6u
 
 /* Slot status values; the status word doubles as the futex word. */
 enum {
@@ -51,6 +51,7 @@ enum {
     EV_CLONE_DONE = 3, /* num = new native tid, or -errno           */
     EV_SIGNAL_DONE = 4, /* emulated signal handler returned         */
     EV_FORK_DONE  = 5, /* num = native child pid, or -errno         */
+    EV_XFER_DONE  = 6, /* native-fd collection done; num = 0/-errno */
     /* shadow -> shim */
     EV_START_RES          = 16, /* run the app                      */
     EV_SYSCALL_COMPLETE   = 17, /* num = return value               */
@@ -71,6 +72,14 @@ enum {
      * process — can waitpid the child directly), the child rebinds to
      * the new block and handshakes, the parent replies EV_FORK_DONE. */
     EV_FORK_RES           = 21,
+    /* SCM_RIGHTS carrying NATIVE fds (ref: socket/unix.rs fd passing):
+     * the manager sent the real fds over this process's transfer
+     * socket (SHADOWTPU_XFER_FD, dup2'd in at spawn) with a payload of
+     * app-memory addresses; the shim recvmsg's them, patches each fd
+     * number into the app's cmsg buffer at the paired address, replies
+     * EV_XFER_DONE, and then waits for the real syscall completion.
+     * num = expected fd count. */
+    EV_SYSCALL_COMPLETE_FDXFER = 22,
 };
 
 typedef struct {
@@ -104,7 +113,13 @@ typedef struct {
     ipc_slot_t to_shim;
     uint64_t   clone_regs[CLONE_NREGS]; /* written by the parent thread */
     uint64_t   clone_chan_idx;          /* this channel's own index     */
-    uint8_t    _pad[320 - 2 * 72 - 8 * (CLONE_NREGS + 1)];
+    /* Simulated ns this thread accrued in DO_NATIVE byte I/O since the
+     * last event the manager consumed (ref: the unapplied-CPU-latency
+     * batching, handler/mod.rs:271-321).  Written by the shim between
+     * messages, read-and-cleared by the manager at the next event —
+     * the alternating slot protocol orders the accesses. */
+    uint64_t   unapplied_ns;
+    uint8_t    _pad[320 - 2 * 72 - 8 * (CLONE_NREGS + 2)];
 } ipc_chan_t;               /* 320 bytes */
 
 #define IPC_N_CHANS    64
@@ -158,6 +173,7 @@ typedef struct {
 #define IPC_CHAN_TO_SHADOW 0
 #define IPC_CHAN_TO_SHIM   72
 #define IPC_CHAN_CLONE_REGS (2 * 72)
+#define IPC_CHAN_UNAPPLIED (2 * 72 + 8 * (CLONE_NREGS + 1))
 #define IPC_SLOT_EV_OFF    8
 
 #ifdef __cplusplus

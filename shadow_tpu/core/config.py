@@ -102,6 +102,11 @@ class ExperimentalConfig:
     native_preemption_enabled: bool = False
     native_preemption_native_interval_ns: int = units.parse_time_ns("10 ms")
     native_preemption_sim_interval_ns: int = units.parse_time_ns("10 ms")
+    # Modeled bandwidth for native file I/O in managed processes (file
+    # reads/writes execute on the real fs but bill simulated CPU time
+    # at this rate so disk-bound phases shape the timeline; active only
+    # while model_unblocked_syscall_latency is on; 0 disables).
+    native_file_io_bandwidth_bps: int = units.parse_bytes("1 GiB")
     unblocked_vdso_latency_ns: int = units.parse_time_ns("10 ns")
     tpu_max_packets_per_round: int = 1 << 20
     # Below this, propagation always runs the numpy host path; above,
@@ -192,6 +197,8 @@ class ConfigOptions:
                     _ns(e.native_preemption_native_interval_ns),
                 "native_preemption_sim_interval":
                     _ns(e.native_preemption_sim_interval_ns),
+                "native_file_io_bandwidth":
+                    f"{e.native_file_io_bandwidth_bps} B",
                 "tpu_max_packets_per_round": e.tpu_max_packets_per_round,
                 "tpu_min_device_batch": e.tpu_min_device_batch,
                 "tpu_shards": e.tpu_shards,
@@ -314,6 +321,8 @@ class ConfigOptions:
                 ("native_preemption_sim_interval",
                  "native_preemption_sim_interval_ns",
                  units.parse_time_ns),
+                ("native_file_io_bandwidth", "native_file_io_bandwidth_bps",
+                 units.parse_bytes),
                 ("tpu_max_packets_per_round", "tpu_max_packets_per_round", int),
                 ("tpu_min_device_batch", "tpu_min_device_batch", int),
                 ("tpu_shards", "tpu_shards", int),

@@ -131,6 +131,11 @@ class Manager:
                     config.experimental.native_preemption_sim_interval_ns
             host.max_unapplied_ns = \
                 config.experimental.max_unapplied_cpu_latency_ns
+            bw = config.experimental.native_file_io_bandwidth_bps
+            if config.general.model_unblocked_syscall_latency and bw > 0:
+                # ns per KiB at the modeled disk bandwidth.
+                host.native_io_ns_per_kib = max(
+                    1, (1_000_000_000 * 1024) // bw)
             host.dns = self.dns
             host.syscall_handler = self.syscall_handler
             host.syscall_handler_native = self.syscall_handler_native

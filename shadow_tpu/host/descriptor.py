@@ -19,6 +19,33 @@ def _incref(file) -> None:
     file._open_refs = getattr(file, "_open_refs", 0) + 1
 
 
+class NativeFdRef:
+    """A manager-held duplicate (pidfd_getfd) of a managed process's
+    NATIVE fd, in flight over an emulated unix socket via SCM_RIGHTS.
+    Delivery hands the real fd to the receiving process over its
+    transfer socket (ref: socket/unix.rs fd passing; our fd-split
+    design keeps file fds native, so passing one crosses the real
+    kernel).  Carries `_open_refs` like any descriptor object so the
+    in-flight queue's incref/decref lifecycle closes the manager's dup
+    exactly once."""
+
+    __slots__ = ("mgr_fd", "_open_refs", "_oc_dead")
+
+    def __init__(self, mgr_fd: int):
+        self.mgr_fd = mgr_fd
+        from shadow_tpu.utils.object_counter import count_alloc
+        count_alloc("NativeFdRef")
+
+    def close(self, host) -> None:
+        import os
+        if self.mgr_fd >= 0:
+            try:
+                os.close(self.mgr_fd)
+            except OSError:
+                pass
+            self.mgr_fd = -1
+
+
 def _decref(file, host) -> None:
     refs = getattr(file, "_open_refs", 1) - 1
     file._open_refs = refs

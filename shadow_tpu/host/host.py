@@ -72,6 +72,9 @@ class Host:
         # Native preemption (preempt.rs): 0 = disabled.
         self.preempt_native_ns = 0
         self.preempt_sim_ns = 0
+        # Native file I/O billing: simulated ns per KiB moved by
+        # DO_NATIVE byte-I/O syscalls (0 = not modeled).
+        self.native_io_ns_per_kib = 0
 
         # Network plane (host.rs:209-344 construction order).
         self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)

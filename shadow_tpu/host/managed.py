@@ -27,6 +27,8 @@ import ctypes
 import os
 import shutil
 import signal
+import socket as _socket
+import struct
 import threading
 import time as _walltime
 
@@ -42,7 +44,8 @@ from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
                                       EV_SIGNAL_DONE, EV_START_REQ,
                                       EV_START_RES, EV_SYSCALL,
                                       EV_SYSCALL_COMPLETE,
-                                      EV_SYSCALL_DO_NATIVE)
+                                      EV_SYSCALL_COMPLETE_FDXFER,
+                                      EV_SYSCALL_DO_NATIVE, EV_XFER_DONE)
 from shadow_tpu.host.syscalls_native import syscall_name
 
 # The unblocked-syscall CPU-latency model (ref configuration.rs:464-480
@@ -56,6 +59,11 @@ from shadow_tpu.host.syscalls_native import syscall_name
 # (child_watcher.py); this poll is only a safety net, so it can be
 # long without costing latency.
 _DEATH_POLL_NS = 2_000_000_000
+
+# Reserved native fd for the manager<->process transfer socket (native
+# SCM_RIGHTS delivery), parked just under EMU_FD_BASE so it never
+# collides with the kernel's lowest-free allocation in practice.
+XFER_FD = 399
 
 # personality(2) flag: children inherit it through fork+exec, so setting
 # it in the spawning thread gives every managed process a non-randomized
@@ -254,6 +262,22 @@ class ManagedProcess(Process):
             env["SHADOWTPU_PREEMPT_NATIVE_US"] = \
                 str(max(1, host.preempt_native_ns // 1000))
             env["SHADOWTPU_PREEMPT_SIM_NS"] = str(host.preempt_sim_ns)
+        if getattr(host, "native_io_ns_per_kib", 0) > 0:
+            env["SHADOWTPU_IO_NS_PER_KIB"] = \
+                str(host.native_io_ns_per_kib)
+        # Transfer socket for native-fd SCM_RIGHTS delivery: the child
+        # gets one end dup2'd to a reserved fd just under EMU_FD_BASE;
+        # the manager keeps the other to sendmsg real fds at delivery
+        # time (the shim collects and patches the app's cmsg buffer).
+        if getattr(self, "_xfer_child_end", None) is None:
+            old = getattr(self, "_xfer_sock", None)
+            if old is not None:
+                old.close()
+            mgr_end, child_end = _socket.socketpair(
+                _socket.AF_UNIX, _socket.SOCK_DGRAM)
+            self._xfer_sock = mgr_end
+            self._xfer_child_end = child_end
+        env["SHADOWTPU_XFER_FD"] = str(XFER_FD)
         # Eager relocation: keeps ld.so's lazy-binding syscalls out of
         # the simulated timeline.
         env.setdefault("LD_BIND_NOW", "1")
@@ -283,6 +307,9 @@ class ManagedProcess(Process):
         if self._stderr_path:
             file_actions.append((os.POSIX_SPAWN_OPEN, 2,
                                  self._stderr_path, wflags, 0o644))
+        # dup2 clears FD_CLOEXEC, so the transfer end survives the exec.
+        file_actions.append((os.POSIX_SPAWN_DUP2,
+                             self._xfer_child_end.fileno(), XFER_FD))
         argv = list(argv) if argv else [resolved]
         try:
             pid = os.posix_spawn(resolved, argv, env,
@@ -513,7 +540,13 @@ class ManagedThread:
         """Next shim event, or None if the child died."""
         while True:
             try:
-                return self.chan.recv_from_shim(timeout_ns=_DEATH_POLL_NS)
+                ev = self.chan.recv_from_shim(timeout_ns=_DEATH_POLL_NS)
+                # Native-I/O latency the shim accrued since its last
+                # event; flows into the standard unapplied-CPU model.
+                ns = self.chan.take_unapplied_ns()
+                if ns:
+                    self.add_cpu_latency(ns)
+                return ev
             except ChannelTimeout:
                 if self._poll_death(host):
                     return None
@@ -767,6 +800,14 @@ class ManagedThread:
             self._protocol_error(host, "child did not exit after exit_group")
             return False
 
+        if kind == "done_fdxfer":
+            # Native fds in an SCM_RIGHTS delivery: run the transfer
+            # dance (sendmsg on the xfer socket + shim collection)
+            # before the ordinary completion below.
+            if not self._do_fdxfer(host, *result[2:]):
+                return False
+            kind, result = "done", ("done", result[1])
+
         if kind == "native":
             rv_kind, rv_val = EV_SYSCALL_DO_NATIVE, 0
         elif kind == "done":
@@ -864,6 +905,59 @@ class ManagedThread:
     # -- fork / execve (ref: process.rs:297,944 spawn_mthread_for_exec,
     #    clone-handler fork path) -------------------------------------
 
+    def _do_fdxfer(self, host, pairs, refs, msg_ptr, control_ptr,
+                   emu_fds) -> bool:
+        """Deliver native fds for an SCM_RIGHTS recvmsg: send the real
+        fds (manager-held dups) over the process's transfer socket with
+        their cmsg slot addresses as payload, tell the shim to collect
+        and patch, and wait for EV_XFER_DONE.  On any failure the cmsg
+        is rewritten to carry only the already-registered emulated fds
+        (never a -1 hole) with MSG_CTRUNC — like Linux closing
+        unclaimed fds.  Returns False if the process died mid-dance."""
+        from shadow_tpu.host.descriptor import _decref
+        proc = self.process
+        sock = getattr(proc, "_xfer_sock", None)
+        status = -1
+        if sock is not None:
+            payload = b"".join(struct.pack("<Q", a) for a, _f in pairs)
+            try:
+                _socket.send_fds(sock, [payload],
+                                 [f for _a, f in pairs])
+            except OSError:
+                sock = None
+        if sock is not None:
+            self.chan.send_to_shim(EV_SYSCALL_COMPLETE_FDXFER, len(pairs))
+            ev = self._recv(host)
+            if ev is None:
+                for r in refs:
+                    _decref(r, host)
+                return False
+            ev_kind, num, _args = ev
+            if ev_kind != EV_XFER_DONE:
+                for r in refs:
+                    _decref(r, host)
+                self._protocol_error(
+                    host, f"expected XferDone, got {ev_kind}")
+                return False
+            status = int(num)
+        for r in refs:
+            _decref(r, host)
+        if status != 0:
+            # Rewrite the cmsg keeping the emulated fds the receiver
+            # already owns; dropping them would orphan live table
+            # entries the app could never close.
+            MSG_CTRUNC = 0x8
+            if emu_fds:
+                cmsg = struct.pack("<QII", 16 + 4 * len(emu_fds), 1, 1)
+                cmsg += b"".join(struct.pack("<i", f) for f in emu_fds)
+                proc.mem.write(control_ptr, cmsg)
+                proc.mem.write(msg_ptr + 40,
+                               struct.pack("<Q", len(cmsg)))
+            else:
+                proc.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
+            proc.mem.write(msg_ptr + 48, struct.pack("<i", MSG_CTRUNC))
+        return True
+
     def _do_fork(self, host) -> bool:
         """fork/vfork/fork-style clone: create the child ManagedProcess
         and its fresh IPC block, hand the path to the shim (EV_FORK_RES),
@@ -937,6 +1031,12 @@ class ManagedThread:
         child._stderr_path = parent._stderr_path
         child._output_owner = getattr(parent, "_output_owner",
                                       None) or parent
+        # The forked child's fd 399 is the parent's transfer socket
+        # (same open description); give the manager an independent
+        # handle so each side's teardown closes only its own.
+        pxfer = getattr(parent, "_xfer_sock", None)
+        if pxfer is not None:
+            child._xfer_sock = pxfer.dup()
         thread = ManagedThread(child, ipc, ipc.channel(0), child._next_tid)
         child._next_tid += 1
         thread.sig_mask = self.sig_mask  # fork inherits the caller's mask
@@ -1112,6 +1212,22 @@ class ManagedThread:
         WATCHER.unregister(self.process.native_pid)
         self.block.mark_closed()
         self.block.close()
+        process = self.process
+        for attr in ("_xfer_sock", "_xfer_child_end"):
+            s = getattr(process, attr, None)
+            if s is not None:
+                setattr(process, attr, None)
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        pidfd = getattr(process, "_pidfd", None)
+        if pidfd is not None:
+            process._pidfd = None
+            try:
+                os.close(pidfd)
+            except OSError:
+                pass
 
     # Process.thread_exited checks thread.state via the same constants;
     # the generator-thread interface ends here.

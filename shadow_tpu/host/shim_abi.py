@@ -23,7 +23,7 @@ import struct
 
 # --- constants mirrored from native/shim_ipc.h ---------------------
 MAGIC = 0x53545055
-VERSION = 5
+VERSION = 6
 FILE_SIZE = 24576
 
 N_CHANS = 64
@@ -31,6 +31,7 @@ CHANS_OFF = 576
 CHAN_STRIDE = 320
 CHAN_TO_SHADOW = 0
 CHAN_TO_SHIM = 72
+CHAN_UNAPPLIED = 2 * 72 + 8 * 16  # after clone_regs[15] + clone_chan_idx
 PATH_MAX = 160
 
 SLOT_EMPTY = 0
@@ -43,12 +44,14 @@ EV_SYSCALL = 2
 EV_CLONE_DONE = 3
 EV_SIGNAL_DONE = 4
 EV_FORK_DONE = 5
+EV_XFER_DONE = 6
 EV_START_RES = 16
 EV_SYSCALL_COMPLETE = 17
 EV_SYSCALL_DO_NATIVE = 18
 EV_CLONE_RES = 19
 EV_SIGNAL = 20
 EV_FORK_RES = 21
+EV_SYSCALL_COMPLETE_FDXFER = 22
 
 OFF_MAGIC = 0
 OFF_VERSION = 4
@@ -100,7 +103,7 @@ class ChannelTimeout(Exception):
 class Channel:
     """One thread's request/response slot pair inside an IpcBlock."""
 
-    __slots__ = ("block", "index", "_to_shadow", "_to_shim")
+    __slots__ = ("block", "index", "_to_shadow", "_to_shim", "_unapplied")
 
     def __init__(self, block: "IpcBlock", index: int):
         self.block = block
@@ -108,6 +111,7 @@ class Channel:
         base = CHANS_OFF + index * CHAN_STRIDE
         self._to_shadow = base + CHAN_TO_SHADOW
         self._to_shim = base + CHAN_TO_SHIM
+        self._unapplied = base + CHAN_UNAPPLIED
 
     def send_to_shim(self, kind: int, num: int = 0,
                      args: tuple = (0, 0, 0, 0, 0, 0)) -> None:
@@ -144,6 +148,16 @@ class Channel:
                     if blk._load_u32(off) not in (SLOT_READY, SLOT_CLOSED):
                         raise ChannelTimeout
                 # EAGAIN (value changed) / EINTR: loop and re-check.
+
+    def take_unapplied_ns(self) -> int:
+        """Drain the shim-accumulated native-I/O latency (safe while the
+        shim is parked awaiting our response — the slot protocol orders
+        the accesses)."""
+        mm = self.block._mm
+        (ns,) = struct.unpack_from("<Q", mm, self._unapplied)
+        if ns:
+            struct.pack_into("<Q", mm, self._unapplied, 0)
+        return ns
 
     def mark_closed(self) -> None:
         """Wake the shim thread with CLOSED on both slots."""

@@ -14,12 +14,14 @@ split, but socket files only matter to other in-sim sockets, and a
 phantom fs entry would leak across hosts.  An app stat()ing its own
 socket file is the known divergence.
 
-SCM_RIGHTS fd passing is modeled for EMULATED fds: the transferred
-object rides the message and is registered into the receiver's fd
-table at recvmsg (cross-process works because fd objects are manager-
-side).  Native fds cannot cross (EINVAL — pidfd_getfd plumbing would
-be needed).  Stream ancillary attaches at the sender's byte watermark
-and is delivered with the read that reaches it.
+SCM_RIGHTS fd passing is modeled for both fd spaces: EMULATED fds ride
+the message as objects and register into the receiver's table at
+recvmsg (cross-process works because fd objects are manager-side);
+NATIVE fds are pulled from the sender with pidfd_getfd and delivered
+through the receiver's transfer socket (see managed.py _do_fdxfer),
+preserving the shared open file description.  Stream ancillary
+attaches at the sender's byte watermark and is delivered with the
+read that reaches it.
 """
 
 from __future__ import annotations

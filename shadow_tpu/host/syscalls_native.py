@@ -23,7 +23,9 @@ misbehaving silently.
 
 from __future__ import annotations
 
+import ctypes
 import errno
+import os as _os
 import struct
 
 from shadow_tpu.core import simtime
@@ -38,6 +40,30 @@ from shadow_tpu.host.status import (S_CLOSED, S_ERROR, S_READABLE,
                                     S_SOCKET_ALLOWING_CONNECT, S_WRITABLE)
 
 EMU_FD_BASE = 400  # leaves room for select() fd_sets (FD_SETSIZE=1024)
+
+# pidfd_getfd(2): duplicate a managed process's native fd into the
+# manager (allowed: every managed process is the manager's direct
+# child, so Yama's descendant rule passes).  Python 3.12 exposes
+# pidfd_open but not pidfd_getfd.
+_SYS_pidfd_getfd = 438
+_libc_syscall = ctypes.CDLL(None, use_errno=True).syscall
+
+
+def _pidfd_pull(process, fd: int):
+    """Duplicate `fd` out of `process` into the manager; returns the
+    manager-side fd or None (bad fd / no pidfd support)."""
+    pid = getattr(process, "native_pid", None)
+    if pid is None:
+        return None
+    pidfd = getattr(process, "_pidfd", None)
+    if pidfd is None:
+        try:
+            pidfd = _os.pidfd_open(pid)
+        except OSError:
+            return None
+        process._pidfd = pidfd
+    r = _libc_syscall(_SYS_pidfd_getfd, pidfd, fd, 0)
+    return r if r >= 0 else None
 
 # --- x86-64 syscall numbers (linux-api equivalents we dispatch on) ---
 SYS = {
@@ -639,7 +665,7 @@ class NativeSyscallHandler:
                 objs = sock.take_ancillary()
                 if objs:
                     self._deliver_scm_rights(host, process, msg_ptr,
-                                             objs)
+                                             objs, allow_native=False)
                 else:
                     process.mem.write(msg_ptr + 40,
                                       struct.pack("<Q", 0))
@@ -658,42 +684,66 @@ class NativeSyscallHandler:
 
     def _parse_scm_rights(self, process, control_ptr, controllen):
         """cmsghdr walk: returns the transferred file objects (each
-        incref'd for the in-flight reference), or None on EINVAL —
-        non-SCM_RIGHTS control or a native fd (which cannot ride our
-        channel; pidfd_getfd plumbing would be required)."""
-        from shadow_tpu.host.descriptor import _incref
+        incref'd for the in-flight reference), or None on EINVAL.
+        Emulated fds resolve to their table objects; NATIVE fds are
+        pulled out of the sender with pidfd_getfd and ride the queue
+        as NativeFdRef wrappers (ref: socket/unix.rs fd passing)."""
+        from shadow_tpu.host.descriptor import NativeFdRef, _incref
         SOL_SOCKET_C, SCM_RIGHTS = 1, 1
         if controllen > 4096:  # > SCM_MAX_FD-worth: refuse, don't clip
             return None
         raw = process.mem.read(control_ptr, controllen)
         objs = []
+
+        def bail():
+            from shadow_tpu.utils.object_counter import mark_dealloc
+            for o in objs:
+                if isinstance(o, NativeFdRef):
+                    o.close(None)
+                    mark_dealloc(o)
+            return None
+
         off = 0
         while off + 16 <= len(raw):
             clen, level, ctype = struct.unpack_from("<QII", raw, off)
             if clen < 16 or off + clen > len(raw) + 7:
-                return None
+                return bail()
             if level != SOL_SOCKET_C or ctype != SCM_RIGHTS:
-                return None
+                return bail()
             nfds = (min(clen, len(raw) - off) - 16) // 4
             for i in range(nfds):
                 (fd,) = struct.unpack_from("<i", raw, off + 16 + 4 * i)
-                if not self._is_emu(fd):
-                    return None
-                try:
-                    objs.append(self._emu(process, fd))
-                except OSError:
-                    return None
+                if self._is_emu(fd):
+                    try:
+                        objs.append(self._emu(process, fd))
+                    except OSError:
+                        return bail()
+                else:
+                    mgr_fd = _pidfd_pull(process, fd)
+                    if mgr_fd is None:
+                        return bail()
+                    objs.append(NativeFdRef(mgr_fd))
             off += (clen + 7) & ~7  # CMSG_ALIGN
         for obj in objs:
             _incref(obj)
         return objs
 
-    def _deliver_scm_rights(self, host, process, msg_ptr, objs) -> None:
+    def _deliver_scm_rights(self, host, process, msg_ptr, objs,
+                            allow_native: bool = True):
         """Register the transferred objects as fresh fds in the
         receiver and write one SCM_RIGHTS cmsg; discards (like Linux
         closing unclaimed fds) when no/too-small control buffer, with
-        MSG_CTRUNC in msg_flags."""
-        from shadow_tpu.host.descriptor import _decref
+        MSG_CTRUNC in msg_flags.
+
+        Emulated objects register into the table directly.  NativeFdRef
+        objects cannot: the real fd must materialize inside the
+        receiving process, so their cmsg slots get a -1 placeholder and
+        the return value is ("fdxfer", pairs, refs, msg_ptr) — the
+        ManagedThread then ships the real fds over the process's
+        transfer socket and the shim patches the placeholders (pairs =
+        [(app_addr_of_slot, mgr_fd)]).  Returns None when no transfer
+        is needed."""
+        from shadow_tpu.host.descriptor import NativeFdRef, _decref
         MSG_CTRUNC = 0x8
         control_ptr, controllen = struct.unpack(
             "<QQ", process.mem.read(msg_ptr + 32, 16))
@@ -701,23 +751,55 @@ class NativeSyscallHandler:
         if control_ptr and controllen >= 20:
             nfit = min(len(objs), (controllen - 16) // 4)
         # Linux delivers as many fds as fit and truncates the rest.
+        truncated = nfit < len(objs)
         for obj in objs[nfit:]:
             _decref(obj, host)
         if nfit == 0:
             process.mem.write(msg_ptr + 48,
                               struct.pack("<i", MSG_CTRUNC))
             process.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
-            return
-        fds = []
+            return None
+        # The transfer dance carries at most the shim's XFER_MAX_FDS in
+        # one datagram; beyond that, surplus native fds truncate (the
+        # kernel's own ceiling is SCM_MAX_FD=253 per message).
+        XFER_MAX_FDS = 64
+        fds = []     # fd numbers written into the cmsg (compacted)
+        emu_fds = [] # the emulated subset, for failure-path rewrite
+        pairs = []   # (app address of the int slot, manager-side fd)
+        refs = []    # NativeFdRefs to release after the transfer
         for obj in objs[:nfit]:
-            fds.append(self._register(process, obj))
-            _decref(obj, host)  # table registration took its own ref
-        cmsg = struct.pack("<QII", 16 + 4 * nfit, 1, 1)
+            if isinstance(obj, NativeFdRef):
+                if not allow_native or len(pairs) >= XFER_MAX_FDS:
+                    # recvmmsg batch path / over-cap: no transfer
+                    # available; drop the fd like a truncation (Linux
+                    # shortens the array — never delivers a hole).
+                    _decref(obj, host)
+                    truncated = True
+                    continue
+                # Slot index = position in the COMPACTED array.
+                pairs.append((control_ptr + 16 + 4 * len(fds),
+                              obj.mgr_fd))
+                refs.append(obj)
+                fds.append(-1)  # patched by the shim after transfer
+            else:
+                fds.append(self._register(process, obj))
+                emu_fds.append(fds[-1])
+                _decref(obj, host)  # table registration took its own ref
+        if not fds:
+            process.mem.write(msg_ptr + 48,
+                              struct.pack("<i", MSG_CTRUNC))
+            process.mem.write(msg_ptr + 40, struct.pack("<Q", 0))
+            return None
+        cmsg = struct.pack("<QII", 16 + 4 * len(fds), 1, 1)
         cmsg += b"".join(struct.pack("<i", fd) for fd in fds)
         process.mem.write(control_ptr, cmsg)
         process.mem.write(msg_ptr + 40, struct.pack("<Q", len(cmsg)))
         process.mem.write(msg_ptr + 48, struct.pack(
-            "<i", MSG_CTRUNC if nfit < len(objs) else 0))
+            "<i", MSG_CTRUNC if truncated else 0))
+        if pairs:
+            return ("fdxfer", pairs, refs, msg_ptr, control_ptr,
+                    emu_fds)
+        return None
 
     def sys_recvmsg(self, host, process, thread, restarted, fd, msg_ptr,
                     flags, *_):
@@ -744,7 +826,13 @@ class NativeSyscallHandler:
         if isinstance(sock, UnixSocket):
             objs = sock.take_ancillary()
             if objs:
-                self._deliver_scm_rights(host, process, msg_ptr, objs)
+                xfer = self._deliver_scm_rights(host, process, msg_ptr,
+                                                objs)
+                if xfer is not None:
+                    # Native fds ride the transfer socket: the service
+                    # loop runs the shim-side collection dance before
+                    # completing the syscall.
+                    return ("done_fdxfer", len(data)) + xfer[1:]
             else:
                 # Linux rewrites controllen AND msg_flags every return;
                 # a reused msghdr must not keep a stale MSG_CTRUNC.

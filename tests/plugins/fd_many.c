@@ -1,0 +1,45 @@
+/* Opens many native file fds — enough to overflow the fd-split's
+ * emulated window start (400) — and reports whether any native fd
+ * landed inside the emulated window.  Under the simulator the shim
+ * moves strays above the floor, so an app holding hundreds of files
+ * coexists with emulated fds; an emulated socket still lands at 400
+ * and select() still covers it. */
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    const char *path = argc > 1 ? argv[1] : "/etc/hostname";
+    int count = argc > 2 ? atoi(argv[2]) : 700;
+    int in_window = 0, min_fd = 1 << 30, max_fd = -1, opened = 0;
+    static int fds[4096];
+    for (int i = 0; i < count && i < 4096; i++) {
+        int fd = open(path, O_RDONLY);
+        if (fd < 0)
+            break;
+        fds[opened++] = fd;
+        if (fd >= 400 && fd < 2048)
+            in_window++;
+        if (fd < min_fd)
+            min_fd = fd;
+        if (fd > max_fd)
+            max_fd = fd;
+    }
+    int sock = socket(AF_UNIX, SOCK_STREAM, 0);
+    int sel_ok = -1;
+    if (sock >= 0 && sock < FD_SETSIZE) {
+        fd_set w;
+        FD_ZERO(&w);
+        FD_SET(sock, &w);
+        struct timeval tv = {0, 0};
+        sel_ok = select(sock + 1, NULL, &w, NULL, &tv) >= 0;
+    }
+    printf("opened=%d in_window=%d min=%d max=%d sock=%d sel_ok=%d\n",
+           opened, in_window, min_fd, max_fd, sock, sel_ok);
+    for (int i = 0; i < opened; i++)
+        close(fds[i]);
+    return opened == count ? 0 : 1;
+}

@@ -1,0 +1,41 @@
+/* Reads a file to EOF and reports bytes + elapsed simulated time.
+ * Under the native-file-I/O latency model the elapsed time must be
+ * ~bytes/bandwidth; with the model off it is ~0 (file I/O is native
+ * and costs no simulated time). */
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <path>\n", argv[0]);
+        return 2;
+    }
+    int fd = open(argv[1], O_RDONLY);
+    if (fd < 0) {
+        perror("open");
+        return 1;
+    }
+    static char buf[1 << 16];
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    long total = 0;
+    for (;;) {
+        ssize_t r = read(fd, buf, sizeof(buf));
+        if (r < 0) {
+            perror("read");
+            return 1;
+        }
+        if (r == 0)
+            break;
+        total += r;
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    close(fd);
+    long long elapsed = (t1.tv_sec - t0.tv_sec) * 1000000000LL +
+                        (t1.tv_nsec - t0.tv_nsec);
+    printf("bytes=%ld elapsed_ns=%lld\n", total, elapsed);
+    return 0;
+}

@@ -1,0 +1,96 @@
+/* SCM_RIGHTS carrying a NATIVE (regular-file) fd over an emulated unix
+ * socketpair: the parent opens a real file, advances its offset, and
+ * passes the fd to a forked child; the child (after closing its
+ * inherited copy) receives a fresh fd number and reads from the SHARED
+ * offset — proving the delivered fd aliases the same open file
+ * description, exactly like kernel SCM_RIGHTS.  Under the simulator
+ * the fd crosses via pidfd_getfd + the shim transfer socket. */
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static int send_fd(int sock, int fd) {
+    char data = 'F';
+    struct iovec iov = {.iov_base = &data, .iov_len = 1};
+    union {
+        char buf[CMSG_SPACE(sizeof(int))];
+        struct cmsghdr align;
+    } u;
+    memset(&u, 0, sizeof(u));
+    struct msghdr msg = {.msg_iov = &iov, .msg_iovlen = 1,
+                         .msg_control = u.buf,
+                         .msg_controllen = sizeof(u.buf)};
+    struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+    c->cmsg_level = SOL_SOCKET;
+    c->cmsg_type = SCM_RIGHTS;
+    c->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(c), &fd, sizeof(int));
+    return sendmsg(sock, &msg, 0) == 1 ? 0 : -1;
+}
+
+static int recv_fd(int sock) {
+    char data;
+    struct iovec iov = {.iov_base = &data, .iov_len = 1};
+    union {
+        char buf[CMSG_SPACE(sizeof(int))];
+        struct cmsghdr align;
+    } u;
+    memset(&u, 0, sizeof(u));
+    struct msghdr msg = {.msg_iov = &iov, .msg_iovlen = 1,
+                         .msg_control = u.buf,
+                         .msg_controllen = sizeof(u.buf)};
+    if (recvmsg(sock, &msg, 0) != 1)
+        return -1;
+    struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+    if (!c || c->cmsg_level != SOL_SOCKET || c->cmsg_type != SCM_RIGHTS)
+        return -1;
+    int fd;
+    memcpy(&fd, CMSG_DATA(c), sizeof(int));
+    return fd;
+}
+
+int main(int argc, char **argv) {
+    const char *path = argc > 1 ? argv[1] : "/tmp/scm_native_test.dat";
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        puts("FAIL socketpair");
+        return 1;
+    }
+    int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0 || write(fd, "0123456789", 10) != 10 ||
+        lseek(fd, 4, SEEK_SET) != 4) {
+        puts("FAIL setup");
+        return 1;
+    }
+    pid_t pid = fork();
+    if (pid == 0) {
+        close(sv[0]);
+        close(fd);  /* drop the fork-inherited copy: the transfer must
+                     * deliver its own */
+        int rfd = recv_fd(sv[1]);
+        if (rfd < 0) {
+            puts("child FAIL recv");
+            return 1;
+        }
+        char buf[16];
+        ssize_t r = read(rfd, buf, sizeof(buf));
+        printf("child fd_native=%d read=%.*s\n", rfd < 400 ? 1 : 0,
+               (int)r, buf);
+        return r == 6 && memcmp(buf, "456789", 6) == 0 ? 0 : 1;
+    }
+    close(sv[1]);
+    if (send_fd(sv[0], fd) != 0) {
+        puts("parent FAIL send");
+        return 1;
+    }
+    int st;
+    waitpid(pid, &st, 0);
+    /* The child read through the shared description: our offset moved. */
+    long pos = lseek(fd, 0, SEEK_CUR);
+    printf("parent child_ok=%d shared_offset=%ld\n",
+           WIFEXITED(st) && WEXITSTATUS(st) == 0, pos);
+    return WIFEXITED(st) && WEXITSTATUS(st) == 0 && pos == 10 ? 0 : 1;
+}

@@ -1,0 +1,102 @@
+"""Native file I/O bills simulated time.
+
+File reads/writes execute on the real filesystem (fd-split design), but
+each DO_NATIVE byte-I/O syscall accrues simulated CPU latency at the
+configured disk bandwidth, draining through the standard unapplied-CPU
+model — so a disk-bound phase occupies simulated time instead of
+collapsing to zero.  Ref: the unblocked-syscall latency model,
+src/main/host/syscall/handler/mod.rs:271-321.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from tests.test_managed_process import plugin  # noqa: F401
+
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C toolchain")
+
+MIB = 1 << 20
+
+
+def run_reader(tmp_path, exe, path, tag, extra_general="",
+               extra_experimental=""):
+    yaml = f"""
+general:
+  stop_time: 30s
+  seed: 2
+  data_directory: {tmp_path / ('data_' + tag)}
+{extra_general}
+experimental: {{ {extra_experimental} }}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - path: {exe}
+        args: ["{path}"]
+        start_time: 1s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+"""
+    cfg = ConfigOptions.from_yaml_text(yaml)
+    manager, summary = run_simulation(cfg)
+    assert summary.ok, summary.plugin_errors
+    proc = next(iter(manager.hosts[0].processes.values()))
+    assert proc.exit_code == 0, bytes(proc.stderr)
+    out = bytes(proc.stdout).decode()
+    fields = dict(kv.split("=") for kv in out.split())
+    return int(fields["bytes"]), int(fields["elapsed_ns"])
+
+
+def test_large_read_advances_sim_clock(plugin, tmp_path):  # noqa: F811
+    exe = plugin("file_read_time")
+    big = tmp_path / "big.bin"
+    big.write_bytes(b"\xab" * (64 * MIB))
+    nbytes, elapsed = run_reader(tmp_path, exe, big, "on")
+    assert nbytes == 64 * MIB
+    # 64 MiB at the default 1 GiB/s ≈ 62.5 ms of simulated time (plus
+    # per-syscall latency); anything in [50ms, 150ms] means the clock
+    # moved with the bytes.
+    assert 50_000_000 < elapsed < 150_000_000, elapsed
+
+
+def test_bandwidth_knob_scales_elapsed(plugin, tmp_path):  # noqa: F811
+    exe = plugin("file_read_time")
+    big = tmp_path / "big.bin"
+    big.write_bytes(b"\xcd" * (16 * MIB))
+    _, fast = run_reader(tmp_path, exe, big, "fast",
+                         extra_experimental='native_file_io_bandwidth: "4 GiB"')
+    _, slow = run_reader(tmp_path, exe, big, "slow",
+                         extra_experimental='native_file_io_bandwidth: "256 MiB"')
+    # 16x bandwidth ratio => ~16x elapsed ratio (loose bounds: the
+    # constant per-syscall latency dilutes it slightly).
+    assert slow > 8 * fast, (fast, slow)
+
+
+def test_model_off_costs_nothing(plugin, tmp_path):  # noqa: F811
+    exe = plugin("file_read_time")
+    big = tmp_path / "big.bin"
+    big.write_bytes(b"\xef" * (64 * MIB))
+    _, elapsed = run_reader(
+        tmp_path, exe, big, "off",
+        extra_general="  model_unblocked_syscall_latency: false")
+    assert elapsed == 0, elapsed
+
+
+def test_read_billing_deterministic(plugin, tmp_path):  # noqa: F811
+    exe = plugin("file_read_time")
+    big = tmp_path / "big.bin"
+    big.write_bytes(b"\x11" * (8 * MIB))
+    a = run_reader(tmp_path, exe, big, "d1")
+    b = run_reader(tmp_path, exe, big, "d2")
+    assert a == b

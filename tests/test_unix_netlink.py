@@ -36,7 +36,7 @@ def plugin(tmp_path_factory):
 
 
 def run_one(binary, data_dir="/tmp/shadowtpu-test-unix", stop="10s",
-            host_ip_out=False):
+            host_ip_out=False, args=()):
     yaml = f"""
 general:
   stop_time: {stop}
@@ -55,6 +55,7 @@ hosts:
     network_node_id: 0
     processes:
       - path: {binary}
+        args: {list(args)!r}
         start_time: 1s
 """
     cfg = ConfigOptions.from_yaml_text(yaml)
@@ -105,6 +106,44 @@ def test_scm_rights_fd_passing(plugin):
     assert proc.exited and proc.exit_code == 0, \
         bytes(proc.stdout) + bytes(proc.stderr)
     assert b"scm_ok" in bytes(proc.stdout)
+
+
+def test_scm_rights_native_fd_passing(plugin, tmp_path):
+    """SCM_RIGHTS carrying a NATIVE regular-file fd (ref: socket/
+    unix.rs fd passing; our pidfd_getfd + transfer-socket path): the
+    child receives a fresh native fd aliasing the sender's open file
+    description — it reads from the shared offset, and the parent sees
+    the offset advance."""
+    exe = plugin("scm_rights_native")
+    native = subprocess.run([exe, str(tmp_path / "native.dat")],
+                            capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _host, proc = run_one(exe, args=[str(tmp_path / "sim.dat")])
+    out = bytes(proc.stdout) + bytes(proc.stderr)
+    assert proc.exited and proc.exit_code == 0, out
+    assert b"child fd_native=1 read=456789" in out
+    assert b"parent child_ok=1 shared_offset=10" in out
+
+
+def test_native_fd_headroom(plugin):
+    """700 native file fds coexist with emulated fds: the shim moves
+    kernel-allocated fds that stray into the emulated window [400,
+    floor) above the floor, so heavy file users never collide with
+    emulated numbering (ref virtualizes all fds,
+    descriptor_table.rs:18-260).  The emulated socket still lands at
+    400 and select() still covers it."""
+    exe = plugin("fd_many")
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert native.returncode == 0, native.stdout + native.stderr
+    _host, proc = run_one(exe)
+    out = bytes(proc.stdout).decode()
+    assert proc.exited and proc.exit_code == 0, out
+    fields = dict(kv.split("=") for kv in out.split())
+    assert int(fields["opened"]) == 700
+    assert int(fields["in_window"]) == 0, out   # none in [400, 2048)
+    assert int(fields["max"]) >= 2048, out      # strays moved high
+    assert 400 <= int(fields["sock"]) < 408, out  # emulated base intact
+    assert int(fields["sel_ok"]) == 1, out
 
 
 def test_fstat_on_emulated_fds(plugin):
