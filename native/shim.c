@@ -593,14 +593,17 @@ static long shim_collect_fds(long nfds) {
      * message left queued would desync every later transfer (and
      * patch stale app addresses). */
     uint64_t addrs[XFER_MAX_FDS];
-    char cbuf[CMSG_SPACE(sizeof(int) * XFER_MAX_FDS)];
+    union {
+        char buf[CMSG_SPACE(sizeof(int) * XFER_MAX_FDS)];
+        struct cmsghdr align;
+    } cbuf;
     struct iovec iov = { addrs, sizeof(addrs) };
     struct msghdr mh;
     memset(&mh, 0, sizeof(mh));
     mh.msg_iov = &iov;
     mh.msg_iovlen = 1;
-    mh.msg_control = cbuf;
-    mh.msg_controllen = sizeof(cbuf);
+    mh.msg_control = cbuf.buf;
+    mh.msg_controllen = sizeof(cbuf.buf);
     long r = raw(SYS_recvmsg, g_xfer_fd, (long)&mh, MSG_DONTWAIT, 0, 0, 0);
     if (r < 0)
         return r;

@@ -318,6 +318,15 @@ class ManagedProcess(Process):
             ipc.close()
             raise
         # Commit: replace identity state only after the spawn succeeded.
+        # The cached pidfd (native-fd SCM_RIGHTS pulls) refers to the
+        # OLD native process — drop it or every post-exec pull fails.
+        old_pidfd = getattr(self, "_pidfd", None)
+        if old_pidfd is not None:
+            self._pidfd = None
+            try:
+                os.close(old_pidfd)
+            except OSError:
+                pass
         self.native_pid = pid
         if self.mem is not None:
             self.mem.close()
@@ -929,6 +938,20 @@ class ManagedThread:
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE_FDXFER, len(pairs))
             ev = self._recv(host)
             if ev is None:
+                # Receiver died before collecting: drain the queued
+                # datagram via our handle on the child-side end, or a
+                # fork sibling's next transfer would pop it (and patch
+                # stale addresses).
+                ce = getattr(proc, "_xfer_child_end", None)
+                if ce is not None:
+                    try:
+                        ce.setblocking(False)
+                        _msg, stale_fds, _fl, _ad = _socket.recv_fds(
+                            ce, 4096, 64)
+                        for f in stale_fds:
+                            os.close(f)
+                    except OSError:
+                        pass
                 for r in refs:
                     _decref(r, host)
                 return False
@@ -1037,6 +1060,9 @@ class ManagedThread:
         pxfer = getattr(parent, "_xfer_sock", None)
         if pxfer is not None:
             child._xfer_sock = pxfer.dup()
+        pxce = getattr(parent, "_xfer_child_end", None)
+        if pxce is not None:
+            child._xfer_child_end = pxce.dup()
         thread = ManagedThread(child, ipc, ipc.channel(0), child._next_tid)
         child._next_tid += 1
         thread.sig_mask = self.sig_mask  # fork inherits the caller's mask

@@ -40,6 +40,12 @@ from shadow_tpu.host.status import (S_CLOSED, S_ERROR, S_READABLE,
                                     S_SOCKET_ALLOWING_CONNECT, S_WRITABLE)
 
 EMU_FD_BASE = 400  # leaves room for select() fd_sets (FD_SETSIZE=1024)
+# Upper edge of the emulated window: the shim relocates native fds
+# that land in [EMU_FD_BASE, EMU_FD_LIMIT) to >= its move floor (which
+# is always >= EMU_FD_LIMIT), so numbers past the limit are native
+# again.  Emulated registration refuses to grow past the window
+# (EMFILE) rather than alias relocated native fds.
+EMU_FD_LIMIT = 2048
 
 # pidfd_getfd(2): duplicate a managed process's native fd into the
 # manager (allowed: every managed process is the manager's direct
@@ -286,7 +292,7 @@ class NativeSyscallHandler:
 
     @staticmethod
     def _is_emu(fd: int) -> bool:
-        return fd >= EMU_FD_BASE
+        return EMU_FD_BASE <= fd < EMU_FD_LIMIT
 
     @staticmethod
     def _emu(process, fd: int):
@@ -294,7 +300,17 @@ class NativeSyscallHandler:
 
     @staticmethod
     def _register(process, obj, cloexec: bool = False) -> int:
-        return process.fds.register(obj, cloexec=cloexec) + EMU_FD_BASE
+        fd = process.fds.register(obj, cloexec=cloexec) + EMU_FD_BASE
+        if fd >= EMU_FD_LIMIT:
+            # Window exhausted: unregister and refuse like a full
+            # kernel fd table (aliasing a relocated native fd would
+            # corrupt dispatch).
+            try:
+                process.fds.close_fd(None, fd - EMU_FD_BASE)
+            except Exception:
+                pass
+            raise OSError(errno.EMFILE, "emulated fd window exhausted")
+        return fd
 
     # ------------------------------------------------------------------
     # Sockets

@@ -415,6 +415,13 @@ class TcpConnection:
         self.cong.on_rto(flight)
         self.dupacks = 0
         self.in_fast_recovery = False
+        # SACK reneging (RFC 2018 8; ref tcp.c clears its scoreboard):
+        # after an RTO the receiver may have discarded data it SACKed,
+        # so forget every mark and retransmit from the head — a mark
+        # kept here could skip a hole the receiver no longer holds,
+        # stalling the transfer forever.
+        for seg in self.rtx:
+            seg[5] = False
         self.rto = min(self.rto * 2, MAX_RTO_NS)
         self._retransmit_one(now)  # Karn: marks the entry, no RTT sample
         self.rto_deadline = now + self.rto
@@ -439,9 +446,23 @@ class TcpConnection:
             return
         # --- synchronized states ---
         if hdr.flags & TcpFlags.SYN:
-            # Re-sent SYN (our SYN-ACK was lost): re-ACK it.
+            if self.state == SYN_RECEIVED and \
+                    (hdr.flags & TcpFlags.ACK) and \
+                    hdr.ack == self.snd_nxt:
+                # Simultaneous open completing: the peer's SYN-ACK acks
+                # our SYN.  Handle inline — _on_ack would scale the
+                # window, but SYN segments carry UNSCALED windows
+                # (RFC 7323 2.2), same as _on_packet_syn_sent.
+                self.snd_una = hdr.ack
+                self.snd_wnd = hdr.window
+                self._clear_acked(now)
+                self.state = ESTABLISHED
+                self._emit_ack(now)
+                self._push_data(now)
+                return
             if self.state == SYN_RECEIVED and hdr.seq == seq_sub(
                     self.rcv_nxt, 1) % _SEQ_MOD:
+                # Re-sent SYN (our SYN-ACK was lost): re-answer it.
                 self._emit_synack(now)
                 return
             self._emit_ack(now)
@@ -501,8 +522,18 @@ class TcpConnection:
             self.state = ESTABLISHED
             self._emit_ack(now)
         elif hdr.flags & TcpFlags.SYN:
-            # Simultaneous open: not modeled; reset.
-            self.abort(now)
+            # Simultaneous open (RFC 793 fig. 8; ref states.rs models
+            # SynSent -> SynReceived): both ends sent SYNs that crossed.
+            # Adopt the peer's ISN, answer SYN-ACK, and wait in
+            # SYN_RECEIVED for the ack of our own SYN.  Our original
+            # SYN stays on the rtx queue: if this SYN-ACK is lost, the
+            # bare-SYN retransmit re-triggers the peer's own re-ack.
+            self.irs = hdr.seq
+            self.rcv_nxt = seq_add(hdr.seq, 1)
+            self.snd_wnd = hdr.window
+            self._negotiate_options(hdr)
+            self.state = SYN_RECEIVED
+            self._emit_synack(now)
 
     def _on_rst(self, hdr: TcpHeader) -> None:
         self.error = "connection reset"

@@ -37,9 +37,17 @@ int main(int argc, char **argv) {
         struct timeval tv = {0, 0};
         sel_ok = select(sock + 1, NULL, &w, NULL, &tv) >= 0;
     }
-    printf("opened=%d in_window=%d min=%d max=%d sock=%d sel_ok=%d\n",
-           opened, in_window, min_fd, max_fd, sock, sel_ok);
+    /* Relocated fds must WORK, not just exist: read through the
+     * highest one and close everything without error. */
+    char c;
+    int read_ok = opened > 0 && read(fds[opened - 1], &c, 1) == 1;
+    int close_fail = 0;
     for (int i = 0; i < opened; i++)
-        close(fds[i]);
-    return opened == count ? 0 : 1;
+        if (close(fds[i]) != 0)
+            close_fail++;
+    printf("opened=%d in_window=%d min=%d max=%d sock=%d sel_ok=%d "
+           "read_ok=%d close_fail=%d\n",
+           opened, in_window, min_fd, max_fd, sock, sel_ok, read_ok,
+           close_fail);
+    return opened == count && read_ok && close_fail == 0 ? 0 : 1;
 }

@@ -388,3 +388,101 @@ def test_zero_window_persist_probe():
         w.pump()
     # The probe elicited an ack with the open window; data flowed again.
     assert w.b.readable_bytes() >= 1460
+
+
+def test_simultaneous_open():
+    """RFC 793 fig. 8 (ref states.rs SynSent->SynReceived): both ends
+    actively connect and the SYNs cross; both must reach ESTABLISHED
+    and pass data."""
+    w = Wire()
+    w.a.open_active(w.now)
+    w.b.open_active(w.now)
+    # Cross-deliver the two SYNs (don't use accept_syn — no listener).
+    syn_a = w.a.outbox.popleft()
+    syn_b = w.b.outbox.popleft()
+    w.b.on_packet(syn_a[0], syn_a[1], w.now)
+    w.a.on_packet(syn_b[0], syn_b[1], w.now)
+    w.pump()
+    assert w.a.state == ESTABLISHED, w.a.state
+    assert w.b.state == ESTABLISHED, w.b.state
+    # Data flows both ways afterwards.
+    assert transfer(w, b"x" * 5000, reader="b") == b"x" * 5000
+    assert transfer(w, b"y" * 5000, reader="a") == b"y" * 5000
+
+
+def test_simultaneous_open_synack_lost():
+    """Simultaneous open with one SYN-ACK lost: the bare-SYN
+    retransmit re-triggers the peer's answer and both sides still
+    establish."""
+    w = Wire()
+    w.a.open_active(w.now)
+    w.b.open_active(w.now)
+    syn_a = w.a.outbox.popleft()
+    syn_b = w.b.outbox.popleft()
+    w.b.on_packet(syn_a[0], syn_a[1], w.now)
+    w.a.on_packet(syn_b[0], syn_b[1], w.now)
+    # Drop b's SYN-ACK once; a's timers then drive recovery.
+    dropped = []
+
+    def drop(direction, hdr, payload, idx):
+        if direction == "ba" and not dropped:
+            dropped.append(idx)
+            return True
+        return False
+
+    w.drop_fn = drop
+    w.pump()
+    w.drop_fn = None
+    for _ in range(8):
+        if w.a.state == ESTABLISHED and w.b.state == ESTABLISHED:
+            break
+        w.advance_to_next_timer()
+        w.pump()
+    assert w.a.state == ESTABLISHED and w.b.state == ESTABLISHED
+
+
+def test_sack_reneging_rto_clears_scoreboard():
+    """RFC 2018 8 (ref tcp.c scoreboard clear): an RTO forgets all
+    SACK marks — the receiver may have discarded SACKed data — and
+    the transfer still completes from the head."""
+    w = Wire()
+    w.handshake()
+    # Persistently lose the first data segment (original AND its fast
+    # retransmit) so the hole survives to the RTO while SACKs mark the
+    # tail.
+    state = {"seq": None}
+
+    def drop(direction, hdr, payload, idx):
+        if direction == "ab" and payload:
+            if state["seq"] is None:
+                state["seq"] = hdr.seq
+            return hdr.seq == state["seq"]
+        return False
+
+    w.drop_fn = drop
+    data = b"z" * (MSS * 6)
+    view = memoryview(data)
+    sent = 0
+    while sent < len(data):
+        n = w.a.write(view[sent:], w.now)
+        if n == 0:
+            break
+        sent += n
+    w.pump()
+    # Tail segments should be SACK-marked now, the head still missing.
+    assert any(seg[5] for seg in w.a.rtx), "expected SACKed entries"
+    w.drop_fn = None
+    # Fire the RTO: every mark must clear (reneging assumption).
+    w.advance_to_next_timer()
+    assert all(not seg[5] for seg in w.a.rtx), \
+        "RTO must clear the SACK scoreboard"
+    # And the transfer still completes.
+    got = bytearray()
+    for _ in range(200):
+        w.pump()
+        got += w.b.read(1 << 20, w.now)
+        if len(got) >= len(data):
+            break
+        if w.a.rtx or w.a.send_buf:
+            w.advance_to_next_timer()
+    assert bytes(got) == data

@@ -144,6 +144,8 @@ def test_native_fd_headroom(plugin):
     assert int(fields["max"]) >= 2048, out      # strays moved high
     assert 400 <= int(fields["sock"]) < 408, out  # emulated base intact
     assert int(fields["sel_ok"]) == 1, out
+    assert int(fields["read_ok"]) == 1, out     # moved fds are usable
+    assert int(fields["close_fail"]) == 0, out  # and closable (native)
 
 
 def test_fstat_on_emulated_fds(plugin):
