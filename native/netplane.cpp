@@ -340,6 +340,7 @@ struct TcpConn {
   bool delayed_ack = true, nagle = true, nodelay = false;
   int64_t delack_deadline = -1;
   int segs_since_ack = 0;
+  bool dbg = false;  // SHADOWTPU_TCPDBG port match: log ack decisions
 
   int64_t persist_deadline = -1;
   int64_t persist_interval = 0;
@@ -423,6 +424,10 @@ struct TcpConn {
   std::string read(int64_t n, int64_t now) {
     int64_t window_before = recv_window();
     std::string out = recv_buf.take(n);
+    if (dbg && !out.empty())
+      fprintf(stderr, "[ENG read] now=%lld n=%zu before=%lld after=%lld\n",
+              (long long)now, out.size(), (long long)window_before,
+              (long long)recv_window());
     if (!out.empty()) {
       if (window_before < MSS && recv_window() >= MSS &&
           (state == ST_ESTABLISHED || state == ST_FIN_WAIT_1 ||
@@ -505,6 +510,9 @@ struct TcpConn {
     cong_on_rto(flight);
     dupacks = 0;
     in_fast_recovery = false;
+    /* SACK reneging (RFC 2018 8): forget every mark on RTO and
+     * retransmit from the head (connection.py twin). */
+    for (auto &seg : rtx) seg.sacked = false;
     rto = std::min(rto * 2, MAX_RTO_NS);
     retransmit_one(now);
     rto_deadline = now + rto;
@@ -519,6 +527,20 @@ struct TcpConn {
     if (state == ST_LISTEN) return;
     if (state == ST_SYN_SENT) { on_packet_syn_sent(hdr, now); return; }
     if (hdr.flags & F_SYN) {
+      if (state == ST_SYN_RECEIVED && (hdr.flags & F_ACK) &&
+          hdr.ack == snd_nxt) {
+        /* Simultaneous open completing: the peer's SYN-ACK acks our
+         * SYN.  Inline — SYN segments carry UNSCALED windows
+         * (RFC 7323 2.2), so on_ack must not shift (twin of
+         * connection.py's handling). */
+        snd_una = hdr.ack;
+        snd_wnd = hdr.window;
+        clear_acked(now);
+        state = ST_ESTABLISHED;
+        emit_ack(now);
+        push_data(now);
+        return;
+      }
       if (state == ST_SYN_RECEIVED &&
           hdr.seq == (uint32_t)seq_add(rcv_nxt, -1)) {
         emit_synack(now);
@@ -571,8 +593,20 @@ struct TcpConn {
       clear_acked(now);
       state = ST_ESTABLISHED;
       emit_ack(now);
+    } else if ((hdr.flags & F_ACK) && hdr.ack != snd_nxt) {
+      /* RFC 793 SYN-SENT: unacceptable ACK (no SYN) answers
+       * <SEQ=SEG.ACK><CTL=RST>, state unchanged — kills a stale peer
+       * conn squatting on a reused 4-tuple (connection.py twin). */
+      emit(F_RST, hdr.ack, "", now);
     } else if (hdr.flags & F_SYN) {
-      abort(now);  // simultaneous open: not modeled (PARITY.md)
+      /* Simultaneous open (RFC 793 fig. 8): adopt the peer ISN,
+       * answer SYN-ACK, wait in SYN_RECEIVED (connection.py twin). */
+      irs = hdr.seq;
+      rcv_nxt = seq_add(hdr.seq, 1);
+      snd_wnd = hdr.window;
+      negotiate_options(hdr);
+      state = ST_SYN_RECEIVED;
+      emit_synack(now);
     }
   }
 
@@ -744,9 +778,17 @@ struct TcpConn {
 
   void ack_data(int64_t now, bool force) {
     segs_since_ack++;
-    if (force || !delayed_ack || segs_since_ack >= 2 ||
+    bool fire = force || !delayed_ack || segs_since_ack >= 2 ||
         !reassembly.empty() || peer_fin_seq >= 0 ||
-        recv_window() < eff_mss) {
+        recv_window() < eff_mss;
+    if (dbg)
+      fprintf(stderr,
+              "[ENG ackdata] now=%lld force=%d ssa=%d reasm=%zu "
+              "win=%lld mss=%d fire=%d\n",
+              (long long)now, (int)force, segs_since_ack,
+              reassembly.size(), (long long)recv_window(), eff_mss,
+              (int)fire);
+    if (fire) {
       emit_ack(now);
     } else if (delack_deadline < 0) {
       delack_deadline = now + DELACK_NS;
@@ -896,6 +938,9 @@ struct TcpConn {
     seg.payload = payload;
     outbox.push_back(std::move(seg));
     segments_sent++;
+    if (dbg)
+      fprintf(stderr, "[ENG xmit] flags=%d seq=%u len=%zu\n",
+              seg.hdr.flags, seg.hdr.seq, payload.size());
     note_ack_sent();
   }
 
@@ -912,6 +957,9 @@ struct TcpConn {
     seg.payload = payload;
     outbox.push_back(std::move(seg));
     segments_sent++;
+    if (dbg)
+      fprintf(stderr, "[ENG emit] flags=%d seq=%u len=%zu\n",
+              flags, seq, payload.size());
     if (flags & F_ACK) note_ack_sent();
     if (track) {
       rtx.push_back({seq, payload, is_fin, now, false, false});
@@ -932,6 +980,9 @@ struct TcpConn {
 
   void emit_ack(int64_t now) {
     (void)now;
+    if (dbg)
+      fprintf(stderr, "[ENG emitack] now=%lld rcv_nxt=%u win=%lld\n",
+              (long long)now, rcv_nxt, (long long)recv_window());
     OutSeg seg;
     seg.hdr.seq = snd_nxt;
     seg.hdr.ack = rcv_nxt;
@@ -1027,6 +1078,7 @@ struct SocketN {
   uint32_t tok = 0;   // own token (index in Engine::socks)
   bool has_local = false; uint32_t local_ip = 0; int local_port = 0;
   bool has_peer = false; uint32_t peer_ip = 0; int peer_port = 0;
+  bool reuseaddr = false;  // SO_REUSEADDR bind-time semantics
   bool nonblocking = false;
   uint32_t status = S_ACTIVE;
   uint8_t ifaces_mask = 0;  // association mask: bit0 lo, bit1 eth0
@@ -1094,6 +1146,11 @@ struct IfaceN {
   uint32_t ip;
   int idx;  // 0 lo, 1 eth0
   std::unordered_map<AssocKey, uint32_t, AssocHash> assoc;  // -> token
+  /* (proto<<16)|port -> live association count (wildcard AND 4-tuple):
+   * the ephemeral picker consults this so a port with a connection
+   * still tearing down is never handed out again (interface.py
+   * _port_use twin). */
+  std::unordered_map<uint32_t, int> port_use;
   /* fifo qdisc: min-heap on (priority, token). Priorities are per-host
    * packet seqs (unique), so ties cannot happen — matching the Python
    * heap whose id(socket) tiebreak is therefore never consulted. */
@@ -1800,16 +1857,26 @@ struct Engine {
   bool assoc_add(IfaceN &ifc, uint8_t proto, int port, uint32_t peer_ip,
                  int peer_port, uint32_t tok) {
     AssocKey k{ifc.ip, peer_ip, (uint16_t)port, (uint16_t)peer_port, proto};
-    return ifc.assoc.emplace(k, tok).second;
+    if (!ifc.assoc.emplace(k, tok).second) return false;
+    ifc.port_use[((uint32_t)proto << 16) | (uint32_t)port]++;
+    return true;
   }
   void assoc_del(IfaceN &ifc, uint8_t proto, int port, uint32_t peer_ip,
                  int peer_port) {
     AssocKey k{ifc.ip, peer_ip, (uint16_t)port, (uint16_t)peer_port, proto};
-    ifc.assoc.erase(k);
+    if (ifc.assoc.erase(k) > 0) {
+      uint32_t pk = ((uint32_t)proto << 16) | (uint32_t)port;
+      auto it = ifc.port_use.find(pk);
+      if (it != ifc.port_use.end() && --it->second <= 0)
+        ifc.port_use.erase(it);
+    }
   }
   bool is_associated(IfaceN &ifc, uint8_t proto, int port) {
     AssocKey k{ifc.ip, 0, (uint16_t)port, 0, proto};
     return ifc.assoc.count(k) > 0;
+  }
+  bool port_in_use(IfaceN &ifc, uint8_t proto, int port) {
+    return ifc.port_use.count(((uint32_t)proto << 16) | (uint32_t)port) > 0;
   }
 
   void tcp_teardown(HostPlane *hp, SocketN *s, uint32_t tok) {
@@ -2006,10 +2073,18 @@ struct Engine {
     if (port == 0) {
       port = ephemeral_port(hp, (uint8_t)s->proto, mask);
       if (port < 0) return port;
-    } else {
+    } else if (s->reuseaddr) {
+      /* SO_REUSEADDR: only an exact wildcard collision blocks. */
       for (int i = 0; i < 2; i++)
         if ((mask & (1 << i)) &&
             is_associated(iface_of(hp, i), (uint8_t)s->proto, port))
+          return -E_ADDRINUSE;
+    } else {
+      /* Linux refuses a port with ANY live association (TIME_WAIT
+       * 4-tuples included) without SO_REUSEADDR. */
+      for (int i = 0; i < 2; i++)
+        if ((mask & (1 << i)) &&
+            port_in_use(iface_of(hp, i), (uint8_t)s->proto, port))
           return -E_ADDRINUSE;
     }
     for (int i = 0; i < 2; i++)
@@ -2026,7 +2101,7 @@ struct Engine {
     auto in_use = [&](int port) {
       for (int i = 0; i < 2; i++)
         if ((mask & (1 << i)) &&
-            is_associated(iface_of(hp, i), proto, port))
+            port_in_use(iface_of(hp, i), proto, port))
           return true;
       return false;
     };
@@ -2071,7 +2146,16 @@ struct Engine {
     s->peer_ip = ip;
     s->peer_port = port;
     s->iface = ip == LOCALHOST_IP ? 0 : 1;
-    /* move from wildcard to the specific 4-tuple */
+    /* move from wildcard to the specific 4-tuple; a collision means
+     * this exact 4-tuple is already connected (socket_tcp.py raises
+     * EADDRINUSE at the same point). */
+    if (iface_of(hp, s->iface)
+            .assoc.count(AssocKey{iface_of(hp, s->iface).ip, ip,
+                                  (uint16_t)s->local_port, (uint16_t)port,
+                                  PROTO_TCP})) {
+      s->has_peer = false;
+      return -E_ADDRINUSE;
+    }
     for (int i = 0; i < 2; i++)
       if (s->ifaces_mask & (1 << i))
         assoc_del(iface_of(hp, i), PROTO_TCP, s->local_port, 0, 0);
@@ -2082,6 +2166,10 @@ struct Engine {
     s->conn = std::make_unique<TcpConn>(
         iss, s->recv_buf_max, s->send_buf_max,
         s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
+    {
+      const char *dp = getenv("SHADOWTPU_TCPDBG");
+      if (dp && atoi(dp) == s->local_port) s->conn->dbg = true;
+    }
     s->conn->nodelay = s->nodelay;
     s->conn->open_active(now);
     tcp_flush(hp, s, tok, now);
@@ -2783,6 +2871,8 @@ static PyObject *eng_sock_set(EngineObj *self, PyObject *args) {
   SocketN *s = self->eng->sock(tok);
   if (!strcmp(name, "nonblocking")) {
     s->nonblocking = value;
+  } else if (!strcmp(name, "reuseaddr")) {
+    s->reuseaddr = value;
   } else {
     PyErr_Format(PyExc_ValueError, "unknown sock option %s", name);
     return nullptr;

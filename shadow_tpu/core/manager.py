@@ -171,7 +171,6 @@ class Manager:
         threaded = sched in ("thread_per_core", "thread_per_host")
         self._per_host_tasks = sched == "thread_per_host"
         self._nt: list = []          # shared per-host next-event snapshot
-        self._run_all_hosts = False  # device-barrier mode: no idle filter
 
         # Native (C++) data plane: the performance path behind
         # scheduler=tpu.  Per-host opt-out keeps pcap capture and the
@@ -369,8 +368,7 @@ class Manager:
                 h.execute(until)
                 h.perf_exec_ns += time.perf_counter_ns() - t0
             return
-        active = self.hosts if self._run_all_hosts \
-            else self._active_hosts(until)
+        active = self._active_hosts(until)
         if self._pool is None:
             for h in active:
                 h.execute(until)
@@ -423,11 +421,13 @@ class Manager:
         self._init_next_times()
         start = self._min_next_event()
         if device_barrier:
-            # The mesh backend computes the barrier itself (pmin) and
-            # delivers exchange overflow outside deliver_packet_event,
-            # so the incremental snapshot cannot be trusted — run every
-            # host each round until the mesh path maintains it.
-            self._run_all_hosts = True
+            # The mesh backend folds local next-event times into its
+            # pmin barrier: hand it the shared snapshot so its per-round
+            # input is O(1) instead of an O(N) host scan, and the
+            # idle-host filter composes (every delivery path — host
+            # slot writes, inbox deliveries, engine pushes — maintains
+            # the snapshot incrementally).
+            self.propagator.set_nt(self._nt)
         while start is not None and start < stop:
             window_end = min(start + self.runahead.get(), stop)
             self.propagator.begin_round(start, window_end)

@@ -73,6 +73,15 @@ class _NativeSocket(StatusOwner):
         self._status = initial_status
         host._nsocks[tok] = self
 
+    @property
+    def reuseaddr(self) -> bool:
+        return getattr(self, "_reuseaddr", False)
+
+    @reuseaddr.setter
+    def reuseaddr(self, v: bool) -> None:
+        self._reuseaddr = bool(v)
+        self.plane.engine.sock_set(self.tok, "reuseaddr", 1 if v else 0)
+
     # Engine-pushed status change (plane callback CB_STATUS).
     def apply_status(self, host, set_mask: int, clear_mask: int) -> None:
         self.adjust_status(host, set_mask, clear_mask)

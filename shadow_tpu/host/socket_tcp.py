@@ -41,6 +41,7 @@ class TcpSocket(StatusOwner):
         self.peer = None
         self.nonblocking = False
         self.nodelay = False          # TCP_NODELAY, propagated to conns
+        self.reuseaddr = False        # SO_REUSEADDR, bind-time semantics
         self._send_buf_max = send_buf
         self._recv_buf_max = recv_buf
         # Dynamic buffer sizing (ref tcp.c _tcp_autotune*Buffer):
@@ -84,10 +85,21 @@ class TcpSocket(StatusOwner):
         ifaces = self._pick_interfaces(host, ip)
         if port == 0:
             port = self._ephemeral_port(host, ifaces)
-        else:
+        elif getattr(self, "reuseaddr", False):
+            # SO_REUSEADDR: only an exact wildcard collision blocks
+            # (TIME_WAIT 4-tuples on the port are fine — Linux's
+            # server-restart pattern).
             for iface in ifaces:
                 if iface.is_associated(self.protocol, port):
-                    raise OSError(errno.EADDRINUSE, "address already in use")
+                    raise OSError(errno.EADDRINUSE,
+                                  "address already in use")
+        else:
+            # Without SO_REUSEADDR, Linux refuses a port with ANY live
+            # association, including TIME_WAIT 4-tuples.
+            for iface in ifaces:
+                if iface.port_in_use(self.protocol, port):
+                    raise OSError(errno.EADDRINUSE,
+                                  "address already in use")
         for iface in ifaces:
             iface.associate(self, self.protocol, port)
         self._ifaces = ifaces
@@ -96,10 +108,10 @@ class TcpSocket(StatusOwner):
     def _ephemeral_port(self, host, ifaces) -> int:
         for _ in range(64):
             port = host.rng.randrange(EPHEMERAL_LO, EPHEMERAL_HI)
-            if not any(i.is_associated(self.protocol, port) for i in ifaces):
+            if not any(i.port_in_use(self.protocol, port) for i in ifaces):
                 return port
         for port in range(EPHEMERAL_LO, EPHEMERAL_HI):
-            if not any(i.is_associated(self.protocol, port) for i in ifaces):
+            if not any(i.port_in_use(self.protocol, port) for i in ifaces):
                 return port
         raise OSError(errno.EADDRINUSE, "no free ephemeral ports")
 
@@ -136,7 +148,13 @@ class TcpSocket(StatusOwner):
         self.peer = (ip, port)
         self._iface = host.lo if ip == LOCALHOST_IP else host.eth0
         # Move from wildcard to the specific 4-tuple so multiple
-        # connections can share a local port.
+        # connections can share a local port.  Check BEFORE mutating:
+        # an exact-4-tuple collision (explicit bind + reconnect to the
+        # same peer) must fail cleanly, not leave the socket headless.
+        if self._iface.is_associated(self.protocol, self.local[1],
+                                     ip, port):
+            self.peer = None
+            raise OSError(errno.EADDRINUSE, "address already in use")
         for iface in self._ifaces:
             iface.disassociate(self.protocol, self.local[1])
         self._iface.associate(self, self.protocol, self.local[1], ip, port)

@@ -44,6 +44,7 @@ class UdpSocket(StatusOwner):
         self.drops_full_recv = 0
         self._status = S_ACTIVE | S_WRITABLE
         self.nonblocking = False
+        self.reuseaddr = False
 
     # ------------------------------------------------------------------
     # Binding
@@ -64,10 +65,21 @@ class UdpSocket(StatusOwner):
         ifaces = self._pick_interfaces(host, ip)
         if port == 0:
             port = self._ephemeral_port(host, ifaces)
-        else:
+        elif getattr(self, "reuseaddr", False):
+            # SO_REUSEADDR: only an exact wildcard collision blocks
+            # (TIME_WAIT 4-tuples on the port are fine — Linux's
+            # server-restart pattern).
             for iface in ifaces:
                 if iface.is_associated(self.protocol, port):
-                    raise OSError(errno.EADDRINUSE, "address already in use")
+                    raise OSError(errno.EADDRINUSE,
+                                  "address already in use")
+        else:
+            # Without SO_REUSEADDR, Linux refuses a port with ANY live
+            # association, including TIME_WAIT 4-tuples.
+            for iface in ifaces:
+                if iface.port_in_use(self.protocol, port):
+                    raise OSError(errno.EADDRINUSE,
+                                  "address already in use")
         for iface in ifaces:
             iface.associate(self, self.protocol, port)
         self._ifaces = ifaces
@@ -78,11 +90,11 @@ class UdpSocket(StatusOwner):
         # (reference: udp.rs uses the host RNG the same way).
         for _ in range(64):
             port = host.rng.randrange(EPHEMERAL_LO, EPHEMERAL_HI)
-            if not any(i.is_associated(self.protocol, port) for i in ifaces):
+            if not any(i.port_in_use(self.protocol, port) for i in ifaces):
                 return port
         # Dense occupancy: linear probe, still deterministic.
         for port in range(EPHEMERAL_LO, EPHEMERAL_HI):
-            if not any(i.is_associated(self.protocol, port) for i in ifaces):
+            if not any(i.port_in_use(self.protocol, port) for i in ifaces):
                 return port
         raise OSError(errno.EADDRINUSE, "no free ephemeral ports")
 

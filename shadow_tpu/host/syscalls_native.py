@@ -929,8 +929,13 @@ class NativeSyscallHandler:
             return _native()
         sock = self._emu(process, fd)
         # TCP_NODELAY (IPPROTO_TCP=6, optname 1) reaches the connection's
-        # Nagle switch; other options (REUSEADDR, buffer sizing hints...)
-        # are recorded-but-inert — enough surface for common apps.
+        # Nagle switch; SO_REUSEADDR drives bind-time port semantics;
+        # other options (buffer sizing hints...) are recorded-but-inert
+        # — enough surface for common apps.
+        if level == SOL_SOCKET and optname == SO_REUSEADDR and optlen >= 4:
+            val = struct.unpack("<i", process.mem.read(optval, 4))[0]
+            sock.reuseaddr = bool(val)
+            return _done(0)
         if level == 6 and optname == 1 and optlen >= 4:
             val = struct.unpack("<i", process.mem.read(optval, 4))[0]
             if hasattr(sock, "set_nodelay"):  # native-plane proxy

@@ -20,7 +20,8 @@ QDISC_ROUND_ROBIN = "round_robin"
 
 
 class NetworkInterface:
-    __slots__ = ("ip", "name", "qdisc", "_assoc", "_send_ready", "_send_heap",
+    __slots__ = ("ip", "name", "qdisc", "_assoc", "_port_use",
+                 "_send_ready", "_send_heap",
                  "_queued", "pcap", "packets_sent", "packets_received",
                  "bytes_sent", "bytes_received")
 
@@ -31,6 +32,12 @@ class NetworkInterface:
         # (proto, local_ip, local_port, peer_ip, peer_port) -> socket.
         # Wildcard peer is (0, 0).
         self._assoc: dict = {}
+        # (proto, local_port) -> live association count (wildcard AND
+        # 4-tuple).  The ephemeral-port picker consults this: a port
+        # whose old connection is still tearing down (FIN/TIME_WAIT
+        # holds a 4-tuple assoc) must not be handed out again — reuse
+        # against the same peer collides the 4-tuple.
+        self._port_use: dict = {}
         self._send_ready: deque = deque()  # round-robin order
         self._send_heap: list = []         # fifo order by packet priority
         self._queued: set = set()          # sockets currently queued
@@ -48,16 +55,30 @@ class NetworkInterface:
                   peer_ip: int = 0, peer_port: int = 0) -> None:
         key = (proto, self.ip, local_port, peer_ip, peer_port)
         if key in self._assoc:
-            raise OSError("address already in use")
+            import errno
+            raise OSError(errno.EADDRINUSE, "address already in use")
         self._assoc[key] = socket
+        pk = (proto, local_port)
+        self._port_use[pk] = self._port_use.get(pk, 0) + 1
 
     def disassociate(self, proto: int, local_port: int,
                      peer_ip: int = 0, peer_port: int = 0) -> None:
-        self._assoc.pop((proto, self.ip, local_port, peer_ip, peer_port), None)
+        key = (proto, self.ip, local_port, peer_ip, peer_port)
+        if self._assoc.pop(key, None) is not None:
+            pk = (proto, local_port)
+            n = self._port_use.get(pk, 0) - 1
+            if n <= 0:
+                self._port_use.pop(pk, None)
+            else:
+                self._port_use[pk] = n
 
     def is_associated(self, proto: int, local_port: int,
                       peer_ip: int = 0, peer_port: int = 0) -> bool:
         return (proto, self.ip, local_port, peer_ip, peer_port) in self._assoc
+
+    def port_in_use(self, proto: int, local_port: int) -> bool:
+        """Any live association (wildcard or 4-tuple) on this port."""
+        return (proto, local_port) in self._port_use
 
     def lookup(self, proto: int, local_port: int, peer_ip: int,
                peer_port: int):

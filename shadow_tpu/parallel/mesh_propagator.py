@@ -103,20 +103,36 @@ class MeshPropagator:
 
     # ------------------------------------------------------------------
 
+    def set_nt(self, nt: np.ndarray) -> None:
+        """Adopt the Manager's shared next-event snapshot (one int64
+        slot per host, incrementally maintained by host execute-end
+        writes, inbox deliveries, and engine pushes).  Turns the
+        per-round barrier input from an O(N) Python host scan into one
+        vectorized copy, and lets the Manager's idle-host filter stay
+        on in mesh mode."""
+        self._nt = nt
+
     def _host_next_events(self) -> np.ndarray:
         """Per-host local next-event times, padded to [S, H] with +inf.
 
-        Safe to read host-side here: in mesh mode nothing is delivered
-        mid-round (send() only buffers), so each heap is quiescent
-        between `Host.execute` returning and this call.
-        """
+        Safe to read here: in mesh mode nothing is delivered mid-round
+        (send() only buffers), so the snapshot is quiescent between
+        `Host.execute` returning and this call."""
+        from shadow_tpu.core.simtime import TIME_NEVER
         S, H = self.n_shards, self.hosts_per_shard
-        hne = np.full((S, H), _I64_MAX, dtype=np.int64)
-        for h in self.hosts:
-            t = h.next_event_time()
-            if t is not None:
-                hne[h.id // H, h.id % H] = t
-        return hne
+        hne = np.full(S * H, _I64_MAX, dtype=np.int64)
+        nt = getattr(self, "_nt", None)
+        if nt is None:
+            # Standalone use (tests build the propagator directly).
+            for h in self.hosts:
+                t = h.next_event_time()
+                if t is not None:
+                    hne[h.id] = t
+        else:
+            n = len(nt)
+            hne[:n] = nt
+            hne[:n][hne[:n] >= TIME_NEVER] = _I64_MAX
+        return hne.reshape(S, H)
 
     def finish_round(self):
         """Run the SPMD round step and deliver its outputs.

@@ -1,0 +1,39 @@
+"""Mesh backend at 1000 hosts (VERDICT r2: no mesh run had ever
+executed at the scale the backend exists for).
+
+A 1k-host UDP mesh sharded 8 ways over the virtual CPU device mesh
+must byte-match the serial trace, with the idle-host filter ACTIVE
+(mesh mode previously forced every host to run every round) and the
+barrier input fed from the shared O(1) snapshot instead of an O(N)
+Python scan per round.
+"""
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from shadow_tpu.parallel.mesh_propagator import MeshPropagator
+from shadow_tpu.tools.netgen import udp_mesh_yaml
+
+N_HOSTS = 1000
+
+
+def run(scheduler, **extra):
+    text = udp_mesh_yaml(N_HOSTS, n_nodes=8, floods_per_host=1, count=3,
+                         size=400, stop_time="6s", seed=5,
+                         scheduler=scheduler,
+                         experimental_extra=extra or None)
+    cfg = ConfigOptions.from_yaml_text(text)
+    return run_simulation(cfg)
+
+
+def test_mesh_1k_hosts_trace_byte_identical():
+    m_ser, s_ser = run("serial")
+    m_mesh, s_mesh = run("tpu", tpu_shards=8)
+    assert s_ser.ok and s_mesh.ok
+    prop = m_mesh.propagator
+    assert isinstance(prop, MeshPropagator)
+    assert prop.packets_exchanged > 1000  # the exchange really ran
+    a, b = m_ser.trace_lines(), m_mesh.trace_lines()
+    assert len(a) > 2000
+    assert a == b
+    assert s_ser.rounds == s_mesh.rounds
+    assert s_ser.packets_recv == s_mesh.packets_recv

@@ -1,0 +1,76 @@
+"""Ephemeral-port reuse against a connection still tearing down.
+
+Round-3 determinism bug found at 4k hosts: the ephemeral picker
+checked only the WILDCARD association, so after enough sequential
+connections a client could draw the port of its own previous
+connection to the same server while that connection's 4-tuple
+association still existed (FIN teardown) — the object path crashed
+the app with EADDRINUSE mid-`connect`, the engine path silently
+collided the association, and the two traces diverged.  The picker
+now consults per-port live-association counts (wildcard AND 4-tuple)
+on both planes.
+"""
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from shadow_tpu.host import socket_tcp
+from shadow_tpu.net.interface import NetworkInterface
+from shadow_tpu.net.packet import PROTO_TCP
+
+
+def test_port_in_use_counts_4tuple_associations():
+    iface = NetworkInterface(0x0B000001, "eth0", "fifo")
+    sock = object()
+    iface.associate(sock, PROTO_TCP, 50000, peer_ip=0x0B000002,
+                    peer_port=80)
+    # Wildcard lookup says free; the picker predicate must not.
+    assert not iface.is_associated(PROTO_TCP, 50000)
+    assert iface.port_in_use(PROTO_TCP, 50000)
+    iface.disassociate(PROTO_TCP, 50000, peer_ip=0x0B000002, peer_port=80)
+    assert not iface.port_in_use(PROTO_TCP, 50000)
+
+
+def test_sequential_reconnects_survive_port_pressure(monkeypatch,
+                                                     tmp_path):
+    """With the ephemeral range squeezed to 16 ports, 8 back-to-back
+    transfers to the same server guarantee the picker repeatedly lands
+    on ports whose previous connections are still in TIME_WAIT (the
+    client initiated every close, so each finished connection parks a
+    4-tuple association for 2MSL).  Before the fix the picker handed
+    those out and the client app crashed with EADDRINUSE."""
+    monkeypatch.setattr(socket_tcp, "EPHEMERAL_LO", 50000)
+    monkeypatch.setattr(socket_tcp, "EPHEMERAL_HI", 50016)
+    yaml = f"""
+general:
+  stop_time: 60s
+  seed: 9
+  data_directory: {tmp_path / 'data'}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-server, args: ["80"], expected_final_state: running }}
+  client:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-client, args: [server, "80", "2000", "8"],
+           start_time: 1s }}
+"""
+    cfg = ConfigOptions.from_yaml_text(yaml)
+    manager, summary = run_simulation(cfg)
+    assert summary.ok, summary.plugin_errors
+    client = next(h for h in manager.hosts if h.name == "client")
+    proc = next(iter(client.processes.values()))
+    assert proc.exit_code == 0, bytes(proc.stderr)
+    out = bytes(proc.stdout).decode()
+    assert out.count("ok bytes=2000") == 8, out

@@ -53,10 +53,14 @@ for i in range(HOSTS - RELAYS):
             "expected_final_state": "any",
         }],
     }
+exp = {"scheduler": SCHED}
+for kv in sys.argv[4:]:
+    k, _, v = kv.partition("=")
+    exp[k] = int(v) if v.lstrip("-").isdigit() else v
 cfg = ConfigOptions.from_dict({
     "general": {"stop_time": STOP, "seed": 7},
     "network": {"graph": {"type": "gml", "inline": THREE_TIER_GML}},
-    "experimental": {"scheduler": SCHED},
+    "experimental": exp,
     "hosts": hosts})
 
 t0 = time.perf_counter()
