@@ -1,19 +1,21 @@
 #!/usr/bin/env python
-"""Benchmark: 1000-host 3-tier tgen TCP transfers (BASELINE.md config 3).
+"""Benchmark: the BASELINE.md scale ladder, headline = 10k-host tgen TCP.
 
-Runs the same workload under the reference-style thread-per-core
+Runs the same workloads under the reference-style thread-per-core
 scheduler (baseline) and the batched `--scheduler=tpu` backend, and
 prints ONE JSON line:
 
     {"metric": ..., "value": <tpu sim-seconds/wallclock-sec>,
      "unit": ..., "vs_baseline": <tpu rate / thread_per_core rate>}
 
-Shape matches the reference's scale ladder (BASELINE.md): ~100 tgen
-servers on the core tier serve repeated 50 KB transfers to ~900 clients
-behind lossy mid/leaf tiers, so the run exercises TCP retransmission,
-CoDel, token buckets, and the cross-host propagation path for the whole
-simulated window.  The secondary 100-host UDP mesh number (the round-1
-headline) is reported on stderr.
+Headline (BASELINE config 4 shape): a 10,000-host Tor-class config —
+500 relay-tier servers on the core serve repeated 25 KB transfers to
+9,500 clients behind lossy mid/leaf tiers — exercising TCP
+retransmission, CoDel, token buckets, and cross-host propagation for
+the whole simulated window.  Secondary numbers on stderr: the 1k-host
+3-tier config (round-2's headline) and the 100-host UDP mesh
+(round-1's).  Both schedulers must agree on exact packet counts
+(byte-identical traces are gated in tests/ at 1k and mesh scale).
 
 The TPU run is executed twice and the second (warm, jit-cached) run is
 measured. If no accelerator platform initializes within the watchdog
@@ -28,6 +30,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+HOSTS_10K = 10_000
+SIM_SECONDS_10K = 10
 
 HOSTS = 1000
 SERVERS = HOSTS // 10
@@ -113,6 +118,38 @@ def config3(scheduler: str):
         "hosts": hosts})
 
 
+def config_10k(scheduler: str):
+    """BASELINE config 4 shape: 10k hosts, tornettools-ish tiers (5%
+    relay servers on the core, clients behind lossy mid/leaf edges)."""
+    from shadow_tpu.core.config import ConfigOptions
+
+    relays = HOSTS_10K // 20
+    hosts = {}
+    for i in range(relays):
+        hosts[f"relay{i:04d}"] = {
+            "network_node_id": 0,
+            "processes": [{
+                "path": "tgen-server", "args": ["80"],
+                "expected_final_state": "running",
+            }],
+        }
+    for i in range(HOSTS_10K - relays):
+        hosts[f"cli{i:05d}"] = {
+            "network_node_id": 1 + (i % 2),
+            "processes": [{
+                "path": "tgen-client",
+                "args": [f"relay{i % relays:04d}", "80", "25000", "3"],
+                "start_time": f"{100 + (i % 50) * 17}ms",
+                "expected_final_state": "any",
+            }],
+        }
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": f"{SIM_SECONDS_10K}s", "seed": 7},
+        "network": {"graph": {"type": "gml", "inline": THREE_TIER_GML}},
+        "experimental": {"scheduler": scheduler},
+        "hosts": hosts})
+
+
 def mesh_config(scheduler: str):
     """Round-1 secondary: 100-host UDP mesh (BASELINE config 2)."""
     from shadow_tpu.core.config import ConfigOptions
@@ -180,10 +217,24 @@ def main() -> None:
           f"{mesh_base.packets_sent / mesh_base_wall:.0f} pkts/s, "
           f"ratio {mesh_base_wall / mesh_tpu_wall:.3f}", file=sys.stderr)
 
-    # Headline: BASELINE config 3 (1k-host 3-tier tgen TCP).
-    base_summary, base_wall = run_best(config3, "thread_per_core")
+    # Secondary: the 1k-host 3-tier config (round-2's headline).
+    base1k, base1k_wall = run_best(config3, "thread_per_core")
     run_once(config3, "tpu")  # warmup: JIT-compiles the batch buckets
-    tpu_summary, tpu_wall = run_best(config3, "tpu")
+    tpu1k, tpu1k_wall = run_best(config3, "tpu")
+    assert tpu1k.packets_sent == base1k.packets_sent, \
+        "schedulers disagreed on 1k workload size"
+    print(f"bench[3tier-1k]: {tpu1k.packets_sent} packets, tpu "
+          f"{tpu1k.busy_end_ns / 1e9 / tpu1k_wall:.2f} sim-s/wall-s "
+          f"({tpu1k_wall:.1f}s wall), thread_per_core "
+          f"{base1k.busy_end_ns / 1e9 / base1k_wall:.2f} "
+          f"({base1k_wall:.1f}s wall), ratio "
+          f"{base1k_wall / tpu1k_wall:.3f}", file=sys.stderr)
+
+    # Headline: the 10k-host Tor-class ladder rung (BASELINE config 4).
+    # thread_per_core at this scale runs once (minutes); the tpu run is
+    # best-of-two after the 1k warmup primed the kernels.
+    base_summary, base_wall = run_once(config_10k, "thread_per_core")
+    tpu_summary, tpu_wall = run_best(config_10k, "tpu")
 
     assert tpu_summary.packets_sent == base_summary.packets_sent, \
         "schedulers disagreed on workload size"
@@ -195,15 +246,15 @@ def main() -> None:
     # tail up to stop_time is free for every scheduler).
     sim_seconds = tpu_summary.busy_end_ns / 1e9
     sim_per_wall = sim_seconds / tpu_wall
-    print(f"bench[3tier-1k]: {tpu_summary.packets_sent} packets, tpu "
+    print(f"bench[10k]: {tpu_summary.packets_sent} packets, tpu "
           f"{tpu_summary.packets_sent / tpu_wall:.0f} pkts/s "
           f"({tpu_wall:.1f}s wall), thread_per_core "
           f"{base_summary.packets_sent / base_wall:.0f} pkts/s "
           f"({base_wall:.1f}s wall)", file=sys.stderr)
 
     print(json.dumps({
-        "metric": f"sim-seconds/wallclock-sec, {HOSTS}-host 3-tier tgen "
-                  f"TCP (scheduler=tpu vs thread_per_core)",
+        "metric": f"sim-seconds/wallclock-sec, {HOSTS_10K}-host Tor-class "
+                  f"tgen TCP (scheduler=tpu vs thread_per_core)",
         "value": round(sim_per_wall, 3),
         "unit": "sim-s/wall-s",
         "vs_baseline": round(base_wall / tpu_wall, 3),

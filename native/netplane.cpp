@@ -583,8 +583,15 @@ struct TcpConn {
   }
 
   void on_packet_syn_sent(const TcpHdrN &hdr, int64_t now) {
+    if ((hdr.flags & F_ACK) && hdr.ack != snd_nxt) {
+      /* RFC 793 SYN-SENT first check: unacceptable ACK — with or
+       * without SYN (delayed SYN-ACK from a previous incarnation of a
+       * reused 4-tuple) — answers <SEQ=SEG.ACK><CTL=RST>, state
+       * unchanged (connection.py twin). */
+      emit(F_RST, hdr.ack, "", now);
+      return;
+    }
     if ((hdr.flags & (F_SYN | F_ACK)) == (F_SYN | F_ACK)) {
-      if (hdr.ack != snd_nxt) { abort(now); return; }
       irs = hdr.seq;
       rcv_nxt = seq_add(hdr.seq, 1);
       snd_una = hdr.ack;
@@ -593,11 +600,6 @@ struct TcpConn {
       clear_acked(now);
       state = ST_ESTABLISHED;
       emit_ack(now);
-    } else if ((hdr.flags & F_ACK) && hdr.ack != snd_nxt) {
-      /* RFC 793 SYN-SENT: unacceptable ACK (no SYN) answers
-       * <SEQ=SEG.ACK><CTL=RST>, state unchanged — kills a stale peer
-       * conn squatting on a reused 4-tuple (connection.py twin). */
-      emit(F_RST, hdr.ack, "", now);
     } else if (hdr.flags & F_SYN) {
       /* Simultaneous open (RFC 793 fig. 8): adopt the peer ISN,
        * answer SYN-ACK, wait in SYN_RECEIVED (connection.py twin). */
@@ -936,11 +938,11 @@ struct TcpConn {
     seg.hdr.wscale = ws_opt;
     sack_blocks(seg.hdr);
     seg.payload = payload;
-    outbox.push_back(std::move(seg));
-    segments_sent++;
     if (dbg)
       fprintf(stderr, "[ENG xmit] flags=%d seq=%u len=%zu\n",
               seg.hdr.flags, seg.hdr.seq, payload.size());
+    outbox.push_back(std::move(seg));
+    segments_sent++;
     note_ack_sent();
   }
 
@@ -2001,6 +2003,10 @@ struct Engine {
     child->conn = std::make_unique<TcpConn>(
         iss, s->recv_buf_max, s->send_buf_max,
         s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
+    {
+      const char *dp = getenv("SHADOWTPU_TCPDBG");
+      if (dp && atoi(dp) == child->local_port) child->conn->dbg = true;
+    }
     child->conn->nodelay = s->nodelay;
     socks.push_back(std::move(child));
     fire_event(CB_CHILD_BORN, hp->id, ltok, ctok, 0);

@@ -85,21 +85,9 @@ class TcpSocket(StatusOwner):
         ifaces = self._pick_interfaces(host, ip)
         if port == 0:
             port = self._ephemeral_port(host, ifaces)
-        elif getattr(self, "reuseaddr", False):
-            # SO_REUSEADDR: only an exact wildcard collision blocks
-            # (TIME_WAIT 4-tuples on the port are fine — Linux's
-            # server-restart pattern).
-            for iface in ifaces:
-                if iface.is_associated(self.protocol, port):
-                    raise OSError(errno.EADDRINUSE,
-                                  "address already in use")
         else:
-            # Without SO_REUSEADDR, Linux refuses a port with ANY live
-            # association, including TIME_WAIT 4-tuples.
-            for iface in ifaces:
-                if iface.port_in_use(self.protocol, port):
-                    raise OSError(errno.EADDRINUSE,
-                                  "address already in use")
+            from shadow_tpu.net.interface import check_bind_port
+            check_bind_port(ifaces, self.protocol, port, self.reuseaddr)
         for iface in ifaces:
             iface.associate(self, self.protocol, port)
         self._ifaces = ifaces

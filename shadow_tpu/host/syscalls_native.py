@@ -961,6 +961,8 @@ class NativeSyscallHandler:
             if optname == SO_ERROR:
                 value = getattr(sock, "so_error", 0) or 0
                 sock.so_error = 0
+            elif optname == SO_REUSEADDR:
+                value = 1 if getattr(sock, "reuseaddr", False) else 0
             elif optname == SO_SNDBUF:
                 conn = getattr(sock, "conn", None)
                 value = (conn.send_buf_max if conn is not None

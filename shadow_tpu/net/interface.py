@@ -168,3 +168,17 @@ class NetworkInterface:
         if socket.push_in_packet(host, packet):
             packet.record(pkt.ST_RCV_DELIVERED)
             host.trace_rcv(packet)
+
+
+def check_bind_port(ifaces, proto: int, port: int,
+                    reuseaddr: bool) -> None:
+    """Shared explicit-port bind check (TCP + UDP sockets): without
+    SO_REUSEADDR, Linux refuses a port with ANY live association —
+    TIME_WAIT 4-tuples included; with it, only an exact wildcard
+    collision blocks (the server-restart pattern).  Twin:
+    netplane.cpp generic_bind."""
+    import errno
+    for iface in ifaces:
+        if (iface.port_in_use(proto, port) if not reuseaddr
+                else iface.is_associated(proto, port)):
+            raise OSError(errno.EADDRINUSE, "address already in use")

@@ -508,11 +508,16 @@ class TcpConnection:
                                  else None))
 
     def _on_packet_syn_sent(self, hdr: TcpHeader, now: int) -> None:
+        if (hdr.flags & TcpFlags.ACK) and hdr.ack != self.snd_nxt:
+            # RFC 793 SYN-SENT first check: an unacceptable ACK —
+            # with OR without SYN (a delayed SYN-ACK from a previous
+            # incarnation of a reused 4-tuple) — answers
+            # <SEQ=SEG.ACK><CTL=RST>; our state is unchanged so the
+            # handshake can still complete on retry.
+            self._emit(TcpFlags.RST, seq=hdr.ack, payload=b"", now=now)
+            return
         if (hdr.flags & (TcpFlags.SYN | TcpFlags.ACK)) == \
                 (TcpFlags.SYN | TcpFlags.ACK):
-            if hdr.ack != self.snd_nxt:
-                self.abort(now)
-                return
             self.irs = hdr.seq
             self.rcv_nxt = seq_add(hdr.seq, 1)
             self.snd_una = hdr.ack
@@ -521,14 +526,6 @@ class TcpConnection:
             self._clear_acked(now)
             self.state = ESTABLISHED
             self._emit_ack(now)
-        elif (hdr.flags & TcpFlags.ACK) and hdr.ack != self.snd_nxt:
-            # RFC 793 SYN-SENT: an unacceptable ACK (no SYN) answers
-            # <SEQ=SEG.ACK><CTL=RST> and our state is unchanged.  This
-            # is what kills a STALE peer connection squatting on a
-            # reused 4-tuple (e.g. the server's previous conn stuck in
-            # LAST_ACK challenge-acking our handshake): the RST tears
-            # it down so a handshake retry can reach the listener.
-            self._emit(TcpFlags.RST, seq=hdr.ack, payload=b"", now=now)
         elif hdr.flags & TcpFlags.SYN:
             # Simultaneous open (RFC 793 fig. 8; ref states.rs models
             # SynSent -> SynReceived): both ends sent SYNs that crossed.
