@@ -114,6 +114,19 @@ constexpr int CB_CHILD_DEAD = 2;   // (tok, 0) pre-accept teardown
 /* timer-heap entry kinds */
 constexpr int TK_RELAY = 0;  // target = relay index (0 lo, 1 out, 2 in)
 constexpr int TK_TCP = 1;    // target = socket token
+constexpr int TK_APP = 2;    // target = engine-app index
+
+/* Engine-app syscall names, counted exactly where the Python twin's
+ * dispatch would count (host.count_syscall) so sim-stats agree. */
+enum {
+  ASYS_SIM_TIME = 0, ASYS_SOCKET, ASYS_CONNECT, ASYS_SEND, ASYS_RECV,
+  ASYS_CLOSE, ASYS_WRITE, ASYS_RESOLVE, ASYS_BIND, ASYS_LISTEN,
+  ASYS_ACCEPT, ASYS_SPAWN_THREAD, ASYS_SHUTDOWN, ASYS_N
+};
+static const char *ASYS_NAMES[ASYS_N] = {
+  "sim_time", "socket", "connect", "send", "recv", "close", "write",
+  "resolve", "bind", "listen", "accept", "spawn_thread", "shutdown",
+};
 
 /* sequence-space arithmetic (connection.py seq_*) */
 inline uint32_t seq_add(uint32_t a, int64_t b) {
@@ -1085,6 +1098,10 @@ struct SocketN {
   uint32_t status = S_ACTIVE;
   uint8_t ifaces_mask = 0;  // association mask: bit0 lo, bit1 eth0
   bool queued[2] = {false, false};
+  /* -1 = Python-owned (status fires CB_STATUS); >=0 = engine-app index
+   * (status wakes the app's stepper); -2 = engine-internal (pre-accept
+   * child of an app listener: silent). */
+  int32_t app_owner = -1;
   explicit SocketN(int proto_, int host_) : proto(proto_), host(host_) {}
   virtual ~SocketN() = default;
 };
@@ -1236,6 +1253,7 @@ struct HostPlane {
   bool tracing = true;
   int64_t pkts_sent = 0, pkts_recv = 0, pkts_dropped = 0;
   int64_t events_run = 0;
+  int64_t app_sys[ASYS_N] = {0};  // engine-app syscall counters
 
   void tpush(TimerEnt e) {
     theap.push_back(e);
@@ -1259,6 +1277,47 @@ struct HostPlane {
   }
 };
 
+/* Engine-resident internal applications (tgen-server / tgen-client):
+ * C++ twins of the Python coroutine apps in host/apps.py, advanced by
+ * TK_APP events that consume the same shared per-host event-seq
+ * counter a Python wake task would, so the merged event order — and
+ * therefore the packet trace — is byte-identical to running the
+ * Python apps on any scheduler. */
+struct AppXfer { int64_t t0, t1, got; bool ok; };
+
+struct AppN {
+  int kind;           // 0 tgen-server (listener), 1 tgen-client, 2 handler
+  int hid;
+  int state = 0;
+  uint32_t wait_mask = 0;    // status bits the stepper parks on
+  bool wake_pending = false; // a TK_APP event is queued
+  bool exited = false;
+  int exit_code = 0;
+  int64_t exit_time = 0;
+  int64_t sock = -1;         // listener / client conn / handler conn
+  /* socket() parameters (mirror the SyscallHandler config) */
+  int64_t send_buf = 0, recv_buf = 0;
+  bool sat = true, rat = true;
+  /* server */
+  int port = 0;
+  /* client */
+  uint32_t dst_ip = 0;
+  int dst_port = 0;
+  int64_t nbytes = 0;
+  int count = 0, xfer_i = 0;
+  int64_t got = 0, t0 = 0;
+  std::vector<AppXfer> xfers;
+  /* handler */
+  std::string req;
+  int64_t resp_n = -1, sent = 0;
+};
+
+constexpr int APP_SERVER = 0, APP_CLIENT = 1, APP_HANDLER = 2;
+/* client transfer states */
+constexpr int CL_CONNECTING = 1, CL_RECV = 3;
+/* handler states */
+constexpr int H_REQ = 0, H_SEND = 1, H_DRAIN = 2;
+
 /* ---------------- engine ------------------------------------------ */
 
 /* One cross-host send awaiting the round's propagation phase. */
@@ -1275,6 +1334,12 @@ struct Engine {
   PacketStore store;
   std::vector<std::unique_ptr<HostPlane>> hosts;
   std::vector<std::unique_ptr<SocketN>> socks;  // token -> socket
+  std::vector<AppN> apps;                       // engine-resident apps
+  int dbg_port = -1;  // SHADOWTPU_TCPDBG, resolved once at construction
+  Engine() {
+    const char *dp = getenv("SHADOWTPU_TCPDBG");
+    if (dp && *dp) dbg_port = atoi(dp);
+  }
   PyObject *cb_event = nullptr;  // (kind, host, tok, a, b, t)
   PyObject *cb_rng = nullptr;    // (host) -> u64
   bool in_error = false;         // a callback raised; unwind
@@ -1341,8 +1406,30 @@ struct Engine {
     clear_mask &= ~set_mask;
     uint32_t nw = (s->status | set_mask) & ~clear_mask;
     if (nw == s->status) return;
+    uint32_t changed = s->status ^ nw;
     s->status = nw;
-    fire_event(CB_STATUS, s->host, s->tok, set_mask, clear_mask);
+    if (s->app_owner == -1)
+      fire_event(CB_STATUS, s->host, s->tok, set_mask, clear_mask);
+    else if (s->app_owner >= 0)
+      /* Python listeners fire on CHANGED bits (set OR clear
+       * transitions, status.py adjust_status) — the blocked syscall
+       * re-dispatches and may simply re-block; matching this keeps
+       * the wake/re-run pattern (and syscall counts) identical. */
+      app_wake(s->app_owner, changed);
+    /* -2: pre-accept child of an app listener — silent */
+  }
+
+  /* Wake an engine app the way a status listener wakes a parked
+   * Python thread: schedule a LOCAL event at `now` with a fresh seq
+   * from the shared counter (same draw the Python condition's task
+   * would have made). */
+  void app_wake(int aidx, uint32_t set_mask) {
+    AppN &a = apps[(size_t)aidx];
+    if (a.wake_pending || a.exited) return;
+    if (!(set_mask & a.wait_mask)) return;
+    a.wake_pending = true;
+    HostPlane *hp = plane(a.hid);
+    hp->tpush({hp->now, hp->event_seq++, TK_APP, (uint32_t)aidx});
   }
 
   /* -- trace ------------------------------------------------------ */
@@ -1609,6 +1696,8 @@ struct Engine {
       RelayN &r = hp->relays[e.target];
       r.state = RELAY_IDLE;  // relay._wakeup
       relay_forward(hp, e.target, now);
+    } else if (e.kind == TK_APP) {
+      app_step((int)e.target, now);
     } else {
       tcp_on_timer(hp, tcp(e.target), e.target, now);
     }
@@ -1675,6 +1764,8 @@ struct Engine {
           RelayN &r = hp->relays[e.target];
           r.state = RELAY_IDLE;
           relay_forward(hp, e.target, et);
+        } else if (e.kind == TK_APP) {
+          app_step((int)e.target, et);
         } else {
           tcp_on_timer(hp, tcp(e.target), e.target, et);
         }
@@ -1689,6 +1780,253 @@ struct Engine {
     HostPlane *hp = plane(hid);
     hp->ipush({time, src, seq, pkt});
     if (nt && hid < nt_len && time < nt[hid]) nt[hid] = time;
+  }
+
+  /* ---------------- engine-resident apps -------------------------- */
+
+  /* Twin of host/apps.py tgen_server/tgen_client, advanced from TK_APP
+   * events.  Every operation attempt counts a syscall at the exact
+   * points the Python dispatch would (including blocked attempts and
+   * their post-wake re-runs — the restart protocol re-dispatches).
+   * Steppers are index-based: spawning a handler app may reallocate
+   * the apps vector. */
+
+  void asys(HostPlane *hp, int which) { hp->app_sys[which]++; }
+
+  const char *dpayload() {
+    static std::string d(65536, 'D');
+    return d.data();
+  }
+
+  int app_spawn(int hid, int kind, int64_t a, int64_t b, int64_t c,
+                int64_t d, int64_t sb, int64_t rb, int sat, int rat,
+                int64_t now) {
+    int aidx = (int)apps.size();
+    apps.emplace_back();
+    {
+      AppN &ap = apps.back();
+      ap.kind = kind;
+      ap.hid = hid;
+      ap.send_buf = sb;
+      ap.recv_buf = rb;
+      ap.sat = sat;
+      ap.rat = rat;
+    }
+    HostPlane *hp = plane(hid);
+    if (kind == APP_SERVER) {
+      apps[(size_t)aidx].port = (int)a;
+      asys(hp, ASYS_SOCKET);
+      uint32_t tok = new_tcp(hid, sb, rb, sat, rat);
+      tcp(tok)->app_owner = aidx;
+      apps[(size_t)aidx].sock = (int64_t)tok;
+      asys(hp, ASYS_BIND);
+      generic_bind(hp, tcp(tok), tok, 0 /*INADDR_ANY*/, (int)a);
+      asys(hp, ASYS_LISTEN);
+      tcp_listen(tcp(tok), 64);
+      app_step_server(aidx, now);
+    } else {
+      AppN &ap = apps[(size_t)aidx];
+      ap.dst_ip = (uint32_t)a;
+      ap.dst_port = (int)b;
+      ap.nbytes = c;
+      ap.count = (int)d;
+      asys(hp, ASYS_RESOLVE);
+      app_client_begin(aidx, now);
+    }
+    return aidx;
+  }
+
+  void app_die(int aidx, int code, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    if (a.sock >= 0 && a.kind != APP_SERVER) {
+      TcpSocketN *s = tcp((uint32_t)a.sock);
+      if (s && !s->app_closed)
+        tcp_close(plane(a.hid), s, (uint32_t)a.sock, now);
+      if (s) s->app_owner = -2;
+    }
+    a.exited = true;
+    a.exit_code = code;
+    a.exit_time = now;
+    a.wait_mask = 0;
+  }
+
+  void app_step(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    a.wake_pending = false;
+    /* Python's condition DISARMS at fire and re-arms only when the
+     * re-dispatched syscall blocks again — status changes caused by
+     * the running syscall itself are unobserved.  Clearing the wait
+     * mask for the stepper's duration is the same window. */
+    a.wait_mask = 0;
+    if (a.exited) return;
+    if (a.kind == APP_SERVER) app_step_server(aidx, now);
+    else if (a.kind == APP_CLIENT) app_client_resume(aidx, now);
+    else app_step_handler(aidx, now);
+  }
+
+  void app_step_server(int aidx, int64_t now) {
+    for (;;) {
+      AppN &a = apps[(size_t)aidx];  // re-fetch: loop body may realloc
+      HostPlane *hp = plane(a.hid);
+      TcpSocketN *l = tcp((uint32_t)a.sock);
+      asys(hp, ASYS_ACCEPT);
+      int64_t r = tcp_accept(hp, l, now);
+      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r < 0) { app_die(aidx, 101, now); return; }
+      /* spawn_thread(serve(conn)): handler app + its start event, the
+       * same task the Python sys_spawn_thread schedules. */
+      asys(hp, ASYS_SPAWN_THREAD);
+      uint32_t ctok = (uint32_t)r;
+      int hidx = (int)apps.size();
+      int hid = a.hid;
+      apps.emplace_back();  // may invalidate `a`
+      AppN &h = apps.back();
+      h.kind = APP_HANDLER;
+      h.hid = hid;
+      h.state = H_REQ;
+      h.sock = (int64_t)ctok;
+      h.wake_pending = true;  // start event below; no double-wake
+      tcp(ctok)->app_owner = hidx;
+      HostPlane *hp2 = plane(hid);
+      hp2->tpush({now, hp2->event_seq++, TK_APP, (uint32_t)hidx});
+    }
+  }
+
+  void app_client_begin(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    asys(hp, ASYS_SIM_TIME);
+    a.t0 = now;
+    a.got = 0;
+    asys(hp, ASYS_SOCKET);
+    uint32_t tok = new_tcp(a.hid, a.send_buf, a.recv_buf, a.sat, a.rat);
+    tcp(tok)->app_owner = aidx;
+    a.sock = (int64_t)tok;
+    a.state = CL_CONNECTING;
+    app_client_resume(aidx, now);
+  }
+
+  void app_client_resume(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    TcpSocketN *s = tcp((uint32_t)a.sock);
+    uint32_t tok = (uint32_t)a.sock;
+    if (a.state == CL_CONNECTING) {
+      asys(hp, ASYS_CONNECT);
+      int r = tcp_connect(hp, s, tok, a.dst_ip, a.dst_port, now);
+      if (r == R_BLOCK) { a.wait_mask = S_WRITABLE | S_CLOSED; return; }
+      if (r < 0 && r != -E_INPROGRESS) { app_die(aidx, 101, now); return; }
+      char line[32];
+      int n = snprintf(line, sizeof(line), "GET %lld\n",
+                       (long long)a.nbytes);
+      asys(hp, ASYS_SEND);
+      int64_t w = tcp_sendto(hp, s, tok, line, n, now);
+      if (w < 0) { app_die(aidx, 101, now); return; }
+      a.state = CL_RECV;
+    }
+    /* recv loop (64 KiB reads, Python twin) */
+    std::string out;
+    while (a.got < a.nbytes) {
+      asys(hp, ASYS_RECV);
+      int r = tcp_recv(hp, s, tok, 1 << 16, false, now, &out);
+      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r < 0) { app_die(aidx, 101, now); return; }
+      if (out.empty()) break;  // EOF short
+      a.got += (int64_t)out.size();
+    }
+    asys(hp, ASYS_CLOSE);
+    tcp_close(hp, s, tok, now);
+    s->app_owner = -2;  // closed: teardown status must not wake us
+    asys(hp, ASYS_SIM_TIME);
+    asys(hp, ASYS_WRITE);
+    a.xfers.push_back({a.t0, now, a.got, a.got == a.nbytes});
+    a.xfer_i++;
+    a.sock = -1;
+    if (a.xfer_i < a.count) {
+      app_client_begin(aidx, now);
+      return;
+    }
+    a.exited = true;
+    a.exit_code = 0;
+    a.exit_time = now;
+    a.wait_mask = 0;
+  }
+
+  void app_step_handler(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    TcpSocketN *s = tcp((uint32_t)a.sock);
+    uint32_t tok = (uint32_t)a.sock;
+    std::string out;
+    if (a.state == H_REQ) {
+      for (;;) {
+        asys(hp, ASYS_RECV);
+        int r = tcp_recv(hp, s, tok, 4096, false, now, &out);
+        if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+        if (r < 0) { app_die(aidx, 101, now); return; }
+        if (out.empty()) {  // EOF before a full request: close, done
+          asys(hp, ASYS_CLOSE);
+          tcp_close(hp, s, tok, now);
+          s->app_owner = -2;
+          a.exited = true;
+          a.exit_time = now;
+          return;
+        }
+        a.req += out;
+        if (!a.req.empty() && a.req.back() == '\n') break;  // endswith
+      }
+      /* Python twin: int(req.split()[1]) — a malformed request
+       * (missing field, non-numeric, trailing junk) crashes the
+       * handler thread with exit 101; mirror exactly. */
+      {
+        std::vector<std::string> parts;
+        size_t i = 0;
+        while (i < a.req.size()) {
+          while (i < a.req.size() && isspace((unsigned char)a.req[i])) i++;
+          size_t j = i;
+          while (j < a.req.size() && !isspace((unsigned char)a.req[j])) j++;
+          if (j > i) parts.emplace_back(a.req.substr(i, j - i));
+          i = j;
+        }
+        if (parts.size() < 2) { app_die(aidx, 101, now); return; }
+        const std::string &num = parts[1];
+        char *end = nullptr;
+        long long v = strtoll(num.c_str(), &end, 10);
+        if (num.empty() || end != num.c_str() + num.size() || v < 0) {
+          app_die(aidx, 101, now);
+          return;
+        }
+        a.resp_n = v;
+      }
+      a.sent = 0;
+      a.state = H_SEND;
+    }
+    if (a.state == H_SEND) {
+      while (a.sent < a.resp_n) {
+        int64_t take = std::min<int64_t>(65536, a.resp_n - a.sent);
+        asys(hp, ASYS_SEND);
+        int64_t w = tcp_sendto(hp, s, tok, dpayload(), take, now);
+        if (w == -E_AGAIN) { a.wait_mask = S_WRITABLE; return; }
+        if (w < 0) { app_die(aidx, 101, now); return; }
+        a.sent += w;
+      }
+      asys(hp, ASYS_SHUTDOWN);
+      tcp_shutdown_wr(hp, s, tok, now);
+      a.state = H_DRAIN;
+    }
+    for (;;) {  // drain until the client closes
+      asys(hp, ASYS_RECV);
+      int r = tcp_recv(hp, s, tok, 4096, false, now, &out);
+      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r < 0) { app_die(aidx, 101, now); return; }
+      if (out.empty()) break;  // client closed
+    }
+    asys(hp, ASYS_CLOSE);
+    tcp_close(hp, s, tok, now);
+    s->app_owner = -2;
+    a.exited = true;
+    a.exit_time = now;
+    a.wait_mask = 0;
   }
 
   /* The round's propagation phase for all engine-origin sends: the
@@ -1903,7 +2241,8 @@ struct Engine {
       bool in_q = l && std::find(l->accept_q.begin(), l->accept_q.end(),
                                  tok) != l->accept_q.end();
       if (!in_q) {
-        fire_event(CB_CHILD_DEAD, s->host, tok, 0, 0);
+        if (s->app_owner == -1)
+          fire_event(CB_CHILD_DEAD, s->host, tok, 0, 0);
         dead_child = true;  // no app will ever own it
       }
     }
@@ -2003,14 +2342,15 @@ struct Engine {
     child->conn = std::make_unique<TcpConn>(
         iss, s->recv_buf_max, s->send_buf_max,
         s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
-    {
-      const char *dp = getenv("SHADOWTPU_TCPDBG");
-      if (dp && atoi(dp) == child->local_port) child->conn->dbg = true;
-    }
+    if (dbg_port >= 0 && dbg_port == child->local_port)
+      child->conn->dbg = true;
     child->conn->nodelay = s->nodelay;
     socks.push_back(std::move(child));
-    fire_event(CB_CHILD_BORN, hp->id, ltok, ctok, 0);
     TcpSocketN *cs = tcp(ctok);
+    if (s->app_owner == -1)
+      fire_event(CB_CHILD_BORN, hp->id, ltok, ctok, 0);
+    else
+      cs->app_owner = -2;  // silent until the app accepts it
     cs->conn->accept_syn(hdr, now);
     tcp_flush(hp, cs, ctok, now);
     return true;
@@ -2172,10 +2512,7 @@ struct Engine {
     s->conn = std::make_unique<TcpConn>(
         iss, s->recv_buf_max, s->send_buf_max,
         s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
-    {
-      const char *dp = getenv("SHADOWTPU_TCPDBG");
-      if (dp && atoi(dp) == s->local_port) s->conn->dbg = true;
-    }
+    if (dbg_port >= 0 && dbg_port == s->local_port) s->conn->dbg = true;
     s->conn->nodelay = s->nodelay;
     s->conn->open_active(now);
     tcp_flush(hp, s, tok, now);
@@ -2247,7 +2584,8 @@ struct Engine {
       s->listening = false;
       for (uint32_t ctok : s->accept_q) {
         tcp_close(hp, tcp(ctok), ctok, now);
-        fire_event(CB_CHILD_DEAD, hp->id, ctok, 0, 0);
+        if (s->app_owner == -1)
+          fire_event(CB_CHILD_DEAD, hp->id, ctok, 0, 0);
         tcp(ctok)->delivered = true;  // accounting done (twin comment)
       }
       s->accept_q.clear();
@@ -2647,6 +2985,53 @@ static PyObject *eng_scatter_round(EngineObj *self, PyObject *args) {
   PyBuffer_Release(&reachable);
   PyBuffer_Release(&lossy);
   return finish_result_to_py(std::move(r));
+}
+
+static PyObject *eng_app_spawn(EngineObj *self, PyObject *args) {
+  int hid, kind, sat, rat;
+  long long a, b, c, d, sb, rb, now;
+  if (!PyArg_ParseTuple(args, "iiLLLLLLiiL", &hid, &kind, &a, &b, &c, &d,
+                        &sb, &rb, &sat, &rat, &now))
+    return nullptr;
+  int idx = self->eng->app_spawn(hid, kind, a, b, c, d, sb, rb, sat, rat,
+                                 now);
+  CHECK_CB(self);
+  return PyLong_FromLong(idx);
+}
+
+static PyObject *eng_app_poll(EngineObj *self, PyObject *args) {
+  int idx;
+  if (!PyArg_ParseTuple(args, "i", &idx)) return nullptr;
+  if (idx < 0 || (size_t)idx >= self->eng->apps.size()) {
+    PyErr_SetString(PyExc_IndexError, "bad app index");
+    return nullptr;
+  }
+  AppN &a = self->eng->apps[(size_t)idx];
+  PyObject *xf = PyList_New((Py_ssize_t)a.xfers.size());
+  for (size_t i = 0; i < a.xfers.size(); i++) {
+    AppXfer &x = a.xfers[i];
+    PyList_SET_ITEM(xf, (Py_ssize_t)i,
+                    Py_BuildValue("LLLO", (long long)x.t0,
+                                  (long long)x.t1, (long long)x.got,
+                                  x.ok ? Py_True : Py_False));
+  }
+  PyObject *r = Py_BuildValue("OiLN", a.exited ? Py_True : Py_False,
+                              a.exit_code, (long long)a.exit_time, xf);
+  return r;
+}
+
+static PyObject *eng_app_syscalls(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  PyObject *d = PyDict_New();
+  for (int i = 0; i < ASYS_N; i++) {
+    if (!hp->app_sys[i]) continue;
+    PyObject *v = PyLong_FromLongLong(hp->app_sys[i]);
+    PyDict_SetItemString(d, ASYS_NAMES[i], v);
+    Py_DECREF(v);
+  }
+  return d;
 }
 
 static PyObject *eng_fire(EngineObj *self, PyObject *args) {
@@ -3088,6 +3473,9 @@ static PyMethodDef eng_methods[] = {
     {"scatter_round", (PyCFunction)eng_scatter_round, METH_VARARGS,
      nullptr},
     {"fire", (PyCFunction)eng_fire, METH_VARARGS, nullptr},
+    {"app_spawn", (PyCFunction)eng_app_spawn, METH_VARARGS, nullptr},
+    {"app_poll", (PyCFunction)eng_app_poll, METH_VARARGS, nullptr},
+    {"app_syscalls", (PyCFunction)eng_app_syscalls, METH_VARARGS, nullptr},
     {"deliver", (PyCFunction)eng_deliver, METH_VARARGS, nullptr},
     {"take_outgoing", (PyCFunction)eng_take_outgoing, METH_VARARGS, nullptr},
     {"tcp_socket", (PyCFunction)eng_tcp_socket, METH_VARARGS, nullptr},

@@ -266,6 +266,28 @@ class Manager:
             strace_mode = None
 
         def spawn(h, _pcfg=pcfg):
+            # Engine-resident tgen apps: when the host lives on the
+            # native plane (and nothing needs the Python process
+            # machinery — no strace, no shutdown signal), the whole
+            # app/syscall/TCP path runs in C++ with a byte-identical
+            # packet trace (host/engine_app.py).
+            if (h.plane is not None and strace_mode is None
+                    and pcfg.shutdown_time_ns is None):
+                from shadow_tpu.host.engine_app import (EngineAppProcess,
+                                                        engine_app_args)
+                spec = engine_app_args(_pcfg, h, self.dns)
+                if spec is not None:
+                    kind, a, b, c, d = spec
+                    sh = self.syscall_handler
+                    process = EngineAppProcess(
+                        h, f"{_pcfg.path}.{index}",
+                        expected_final_state=_pcfg.expected_final_state)
+                    spawned.append(process)
+                    process.app_idx = h.plane.engine.app_spawn(
+                        h.id, kind, a, b, c, d, sh.send_buf, sh.recv_buf,
+                        int(sh.send_autotune), int(sh.recv_autotune),
+                        h.now())
+                    return
             factory = app_registry.lookup(_pcfg.path)
             if factory is None and "/" in _pcfg.path:
                 # An explicit filesystem path: a real Linux binary, run

@@ -1,0 +1,101 @@
+"""Engine-resident internal applications.
+
+The tgen traffic apps (host/apps.py) have C++ twins inside the native
+data plane (netplane.cpp AppN): the same socket-operation sequence at
+the same instants, advanced by engine-local events that draw from the
+same shared per-host event-seq counter a Python wake task would — so
+the packet trace is byte-identical to running the Python coroutine
+apps, while the whole app/syscall/TCP path stays in C++.
+
+This module holds the Python-side bookkeeping proxy the Manager keeps
+in `host.processes`: lazily polls the engine for exit state and
+formats the same stdout lines the Python app would have written.
+"""
+
+from __future__ import annotations
+
+# (config path, argv shape) -> engine app kind
+KIND_SERVER = 0
+KIND_CLIENT = 1
+
+
+class _FdTableStub:
+    def close_all(self, host) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return 0
+
+
+class EngineAppProcess:
+    """Duck-typed stand-in for host/process.py Process, backed by an
+    engine-resident app."""
+
+    def __init__(self, host, name: str, expected_final_state: str):
+        self.host = host
+        self.name = name
+        self.pid = host.register_process(self)
+        self.expected_final_state = expected_final_state
+        self.app_idx: int | None = None   # set right after app_spawn
+        self.term_signal = None
+        self.stderr = bytearray()
+        self.fds = _FdTableStub()
+
+    # -- engine state ---------------------------------------------------
+
+    def _poll(self):
+        return self.host.plane.engine.app_poll(self.app_idx)
+
+    @property
+    def exited(self) -> bool:
+        return bool(self._poll()[0])
+
+    @property
+    def exit_code(self):
+        exited, code, _t, _x = self._poll()
+        return code if exited else None
+
+    @property
+    def stdout(self) -> bytearray:
+        _e, _c, _t, xfers = self._poll()
+        out = []
+        for i, (t0, t1, got, ok) in enumerate(xfers):
+            tag = "ok" if ok else f"SHORT {got}"
+            out.append(f"transfer {i} {tag} bytes={got} ns={t1 - t0}\n")
+        return bytearray("".join(out).encode())
+
+    # -- Process interface the Manager touches --------------------------
+
+    def matches_expected_final_state(self) -> bool:
+        expected = self.expected_final_state
+        if expected in ("running", "any"):
+            return expected == "any" or not self.exited
+        if isinstance(expected, str) and expected.startswith("exited"):
+            parts = expected.split()
+            want = int(parts[1]) if len(parts) > 1 else 0
+            return self.exited and self.exit_code == want
+        if isinstance(expected, str) and expected.startswith("signaled"):
+            return False  # engine apps never die by signal
+        return False
+
+    def strace_close(self) -> None:
+        pass
+
+
+def engine_app_args(pcfg, host, dns):
+    """(kind, a, b, c, d) for engine.app_spawn, or None when `pcfg`
+    isn't an engine-runnable tgen app."""
+    args = list(pcfg.args)
+    if pcfg.path == "tgen-server":
+        if len(args) != 1:
+            return None
+        return (KIND_SERVER, int(args[0]), 0, 0, 0)
+    if pcfg.path == "tgen-client":
+        if len(args) not in (3, 4):
+            return None
+        ip = dns.ip_for_name(args[0])
+        if ip is None:
+            return None
+        count = int(args[3]) if len(args) > 3 else 1
+        return (KIND_CLIENT, ip, int(args[1]), int(args[2]), count)
+    return None

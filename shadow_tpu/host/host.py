@@ -99,6 +99,7 @@ class Host:
         self._nsocks: dict[int, object] = {}  # engine token -> proxy
         self._send_native_fn = None           # propagator.send_native
         self._native_merged = (0, 0, 0)       # counters merged so far
+        self._app_sys_merged: dict = {}       # engine-app syscalls merged
 
         # Shared next-event snapshot (manager._nt): each host writes its
         # own slot at the end of execute(); cross-host deliveries lower
@@ -376,6 +377,20 @@ class Host:
         self.counters["packets_recv"] += recv - pr
         self.counters["packets_dropped"] += dropped - pd
         self._native_merged = (sent, recv, dropped)
+        # Engine-app syscalls (counted C++-side at the exact points the
+        # Python dispatch would) fold into the same histograms.
+        app_sys = self.plane.engine.app_syscalls(self.id)
+        if app_sys:
+            prev = self._app_sys_merged
+            total = 0
+            for name, n in app_sys.items():
+                delta = n - prev.get(name, 0)
+                if delta:
+                    self.syscall_counts[name] = \
+                        self.syscall_counts.get(name, 0) + delta
+                    total += delta
+            self.counters["syscalls"] += total
+            self._app_sys_merged = dict(app_sys)
 
     def set_tracing(self, enabled: bool) -> None:
         self.tracing_enabled = enabled

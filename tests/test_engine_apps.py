@@ -1,0 +1,103 @@
+"""Engine-resident tgen apps (netplane.cpp AppN).
+
+On native-plane hosts the tgen traffic apps run as C++ state machines
+twinned line-for-line with the Python coroutine apps (host/apps.py):
+same socket-operation sequence, same wake rules (status listeners fire
+on CHANGED bits, disarmed during the dispatch), same shared event-seq
+draws.  Gates: byte-identical packet traces vs the serial scheduler
+(which runs the Python apps), identical stdout transfer lines, and
+identical per-name syscall histograms.
+"""
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from shadow_tpu.host.engine_app import EngineAppProcess
+
+
+def run(tmp_path, sched):
+    yaml = f"""
+general: {{ stop_time: 30s, seed: 7, data_directory: {tmp_path / sched} }}
+experimental: {{ scheduler: {sched} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.01 ] ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-server, args: ["80"], expected_final_state: running }}
+  c1:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-client, args: [server, "80", "30000", "4"],
+           start_time: 1s }}
+  c2:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-client, args: [server, "80", "12345", "2"],
+           start_time: 1200ms }}
+"""
+    return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+
+def _hist(m):
+    out = {}
+    for h in m.hosts:
+        for k, v in h.syscall_counts.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def test_engine_apps_byte_identical_to_python_apps(tmp_path):
+    m_ser, s_ser = run(tmp_path, "serial")
+    m_tpu, s_tpu = run(tmp_path, "tpu")
+    assert s_ser.ok and s_tpu.ok, (s_ser.plugin_errors,
+                                   s_tpu.plugin_errors)
+    # The tpu run actually used engine apps (plane present, no strace).
+    n_engine = sum(1 for h in m_tpu.hosts
+                   for p in h.processes.values()
+                   if isinstance(p, EngineAppProcess))
+    assert n_engine == 3, n_engine
+    assert m_ser.trace_lines() == m_tpu.trace_lines()
+    assert s_ser.packets_sent == s_tpu.packets_sent
+    # stdout transfer lines format-identical (incl. per-transfer ns).
+    for name in ("c1", "c2"):
+        ps = next(iter(next(h for h in m_ser.hosts
+                            if h.name == name).processes.values()))
+        pt = next(iter(next(h for h in m_tpu.hosts
+                            if h.name == name).processes.values()))
+        assert bytes(ps.stdout) == bytes(pt.stdout)
+        assert pt.exited and pt.exit_code == 0
+    # Per-name syscall histograms agree exactly (sim-stats parity).
+    assert _hist(m_ser) == _hist(m_tpu)
+
+
+def test_engine_apps_strace_falls_back_to_python(tmp_path):
+    """strace needs the Python process machinery: engine apps must not
+    engage when strace logging is on."""
+    yaml = f"""
+general: {{ stop_time: 10s, seed: 3, data_directory: {tmp_path / 'st'} }}
+experimental: {{ scheduler: tpu, strace_logging_mode: deterministic }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ] ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-server, args: ["80"], expected_final_state: running }}
+  client:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-client, args: [server, "80", "5000"], start_time: 1s }}
+"""
+    m, s = run_simulation(ConfigOptions.from_yaml_text(yaml))
+    assert s.ok, s.plugin_errors
+    assert not any(isinstance(p, EngineAppProcess)
+                   for h in m.hosts for p in h.processes.values())
