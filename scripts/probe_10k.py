@@ -8,9 +8,10 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from shadow_tpu.utils.platform import force_cpu
-force_cpu()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if not os.environ.get("PROBE_REAL_TPU"):
+    from shadow_tpu.utils.platform import force_cpu
+    force_cpu()
 
 from shadow_tpu.core.config import ConfigOptions
 from shadow_tpu.core.manager import Manager

@@ -65,6 +65,33 @@ _DEATH_POLL_NS = 2_000_000_000
 # collides with the kernel's lowest-free allocation in practice.
 XFER_FD = 399
 
+# pidfd_open(2) flag: a pidfd for one THREAD (Linux 6.9+), readable
+# when the task exits — the event-driven replacement for /proc stat
+# polling during thread teardown.
+_PIDFD_THREAD = 0x80  # == O_EXCL
+
+
+def _pidfd_wait(tid: int, flags: int, timeout_s: float):
+    """Block until the process/thread exits (pidfd becomes readable).
+    True = exited (or already gone); False = timed out; None = the
+    kernel lacks pidfd support for this request (caller must fall back
+    to polling).  Uses poll(2) — the manager can hold >1024 fds, which
+    overflows select()'s fd_set."""
+    import errno as _e
+    import select as _select
+    try:
+        fd = os.pidfd_open(tid, flags)
+    except OSError as e:
+        if e.errno == _e.ESRCH:
+            return True  # gone already
+        return None      # EINVAL/ENOSYS: unsupported kernel/filter
+    try:
+        p = _select.poll()
+        p.register(fd, _select.POLLIN)
+        return bool(p.poll(timeout_s * 1000.0))
+    finally:
+        os.close(fd)
+
 # personality(2) flag: children inherit it through fork+exec, so setting
 # it in the spawning thread gives every managed process a non-randomized
 # address space (ref: shadow.rs:429 disable_aslr).  Address-derived
@@ -799,13 +826,22 @@ class ManagedThread:
 
         if kind == "exit":
             # Short-circuit (managed_thread.rs:268-282): let the native
-            # exit_group run, then reap synchronously.
+            # exit_group run, then reap synchronously.  The wait is
+            # event-driven (poll on the process pidfd), not a
+            # wall-clock slice loop.
             self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
-            deadline = _walltime.monotonic() + 10.0
-            while _walltime.monotonic() < deadline:
-                if self._poll_death(host):
-                    return False
-                _walltime.sleep(0.001)
+            waited = _pidfd_wait(self.process.native_pid, 0, 10.0)
+            if waited is None:
+                # No pidfd support: fall back to the timed slice poll.
+                deadline = _walltime.monotonic() + 10.0
+                while _walltime.monotonic() < deadline:
+                    if self._poll_death(host):
+                        return False
+                    _walltime.sleep(0.001)
+            elif self._poll_death(host):
+                return False
+            if self._poll_death(host):
+                return False
             self._protocol_error(host, "child did not exit after exit_group")
             return False
 
@@ -1182,6 +1218,11 @@ class ManagedThread:
         a zombie until the whole process exits, so accept state Z/X
         there, not just disappearance.  False on timeout (the caller
         fails the process rather than risking a lost-wake deadlock)."""
+        # Mostly event-driven: a thread pidfd (PIDFD_THREAD, Linux
+        # 6.9+) becomes readable when the task exits — but a ZOMBIE
+        # thread-group leader (main thread gone, workers alive) parks
+        # in Z without signalling its pidfd, so interleave short pidfd
+        # waits with /proc state checks instead of busy-polling.
         path = (f"/proc/{self.process.native_pid}/task/"
                 f"{self.native_tid}/stat")
         deadline = _walltime.monotonic() + 5.0
@@ -1195,7 +1236,14 @@ class ManagedThread:
             state = stat.rpartition(")")[2].lstrip()[:1]
             if state in ("Z", "X", ""):
                 return True
-            _walltime.sleep(0.0002)
+            waited = _pidfd_wait(self.native_tid, _PIDFD_THREAD, 0.05)
+            if waited:
+                return True
+            if waited is None:
+                # Pre-6.9 kernel (no PIDFD_THREAD): the /proc check
+                # above is the only signal — keep the old short sleep
+                # instead of spinning.
+                _walltime.sleep(0.0002)
         return False
 
     def _wakeup(self, host) -> None:

@@ -154,6 +154,7 @@ class TpuPropagator:
         self._host_ns_per_pkt = None
         self._dev_ns_by_bucket: dict[int, float] = {}
         self._dev_probe_countdown: dict[int, int] = {}
+        self._dev_probe_interval: dict[int, int] = {}  # backoff per bucket
         self._dev_compiled: set[int] = set()
 
     def begin_round(self, window_start: int, window_end: int) -> None:
@@ -266,10 +267,14 @@ class TpuPropagator:
             self.hosts[dst_host].deliver_packet_event(
                 Event(deliver, KIND_PACKET, src, evt_seq, p))
 
-    # How often to re-probe the device at a bucket size the cost model
-    # currently routes to the host path (keeps the model honest if device
-    # latency improves mid-run, e.g. a tunnel warming up).
+    # Initial re-probe cadence at a bucket size the cost model routes
+    # to the host path (keeps the model honest if device latency
+    # improves mid-run, e.g. a tunnel warming up).  Each losing
+    # re-probe doubles the interval up to the cap: over a tunnelled
+    # device every probe costs a round trip, and a persistently-losing
+    # device should not tax thousands of rounds at a fixed cadence.
     _DEV_REPROBE_EVERY = 64
+    _DEV_REPROBE_CAP = 4096
 
     def _use_device(self, n: int, b: int) -> bool:
         """Online routing choice: both paths are bit-identical, so pick
@@ -287,11 +292,24 @@ class TpuPropagator:
         if dev is None:
             return True  # device probe
         if dev <= self._host_ns_per_pkt * n:
+            # Winning: fully reset the backoff (interval AND countdown —
+            # a stale countdown would defer the next losing-side probe
+            # by thousands of rounds).
+            self._dev_probe_interval.pop(b, None)
+            self._dev_probe_countdown.pop(b, None)
             return True
-        # Device currently losing at this size: re-probe occasionally.
-        left = self._dev_probe_countdown.get(b, self._DEV_REPROBE_EVERY) - 1
+        # Device currently losing at this size: re-probe with backoff.
+        # A catastrophic loss (tunnelled device: ~100ms+ round trips vs
+        # ~ms of numpy) jumps straight to the cap — every probe costs a
+        # full round trip, and 16x slower does not drift back to parity.
+        interval = self._dev_probe_interval.get(b, self._DEV_REPROBE_EVERY)
+        left = self._dev_probe_countdown.get(b, interval) - 1
         if left <= 0:
-            self._dev_probe_countdown[b] = self._DEV_REPROBE_EVERY
+            nxt = (self._DEV_REPROBE_CAP
+                   if dev > 16 * self._host_ns_per_pkt * n
+                   else min(interval * 2, self._DEV_REPROBE_CAP))
+            self._dev_probe_interval[b] = nxt
+            self._dev_probe_countdown[b] = nxt
             return True
         self._dev_probe_countdown[b] = left
         return False
