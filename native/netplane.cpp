@@ -121,11 +121,13 @@ constexpr int TK_APP = 2;    // target = engine-app index
 enum {
   ASYS_SIM_TIME = 0, ASYS_SOCKET, ASYS_CONNECT, ASYS_SEND, ASYS_RECV,
   ASYS_CLOSE, ASYS_WRITE, ASYS_RESOLVE, ASYS_BIND, ASYS_LISTEN,
-  ASYS_ACCEPT, ASYS_SPAWN_THREAD, ASYS_SHUTDOWN, ASYS_N
+  ASYS_ACCEPT, ASYS_SPAWN_THREAD, ASYS_SHUTDOWN, ASYS_SENDTO,
+  ASYS_RECVFROM, ASYS_NANOSLEEP, ASYS_N
 };
 static const char *ASYS_NAMES[ASYS_N] = {
   "sim_time", "socket", "connect", "send", "recv", "close", "write",
   "resolve", "bind", "listen", "accept", "spawn_thread", "shutdown",
+  "sendto", "recvfrom", "nanosleep",
 };
 
 /* sequence-space arithmetic (connection.py seq_*) */
@@ -1283,8 +1285,6 @@ struct HostPlane {
  * counter a Python wake task would, so the merged event order — and
  * therefore the packet trace — is byte-identical to running the
  * Python apps on any scheduler. */
-struct AppXfer { int64_t t0, t1, got; bool ok; };
-
 struct AppN {
   int kind;           // 0 tgen-server (listener), 1 tgen-client, 2 handler
   int hid;
@@ -1306,13 +1306,19 @@ struct AppN {
   int64_t nbytes = 0;
   int count = 0, xfer_i = 0;
   int64_t got = 0, t0 = 0;
-  std::vector<AppXfer> xfers;
   /* handler */
   std::string req;
   int64_t resp_n = -1, sent = 0;
+  /* udp-flood / udp-sink */
+  int64_t size = 0, interval = 0, expect = -1;
+  int64_t sent_i = 0, got_n = 0;
+  /* process stdout, built with the exact bytes the Python app would
+   * have written */
+  std::string out;
 };
 
-constexpr int APP_SERVER = 0, APP_CLIENT = 1, APP_HANDLER = 2;
+constexpr int APP_SERVER = 0, APP_CLIENT = 1, APP_HANDLER = 2,
+              APP_UDP_FLOOD = 3, APP_UDP_SINK = 4;
 /* client transfer states */
 constexpr int CL_CONNECTING = 1, CL_RECV = 3;
 /* handler states */
@@ -1799,8 +1805,8 @@ struct Engine {
   }
 
   int app_spawn(int hid, int kind, int64_t a, int64_t b, int64_t c,
-                int64_t d, int64_t sb, int64_t rb, int sat, int rat,
-                int64_t now) {
+                int64_t d, int64_t e, int64_t sb, int64_t rb, int sat,
+                int rat, int64_t now) {
     int aidx = (int)apps.size();
     apps.emplace_back();
     {
@@ -1824,7 +1830,7 @@ struct Engine {
       asys(hp, ASYS_LISTEN);
       tcp_listen(tcp(tok), 64);
       app_step_server(aidx, now);
-    } else {
+    } else if (kind == APP_CLIENT) {
       AppN &ap = apps[(size_t)aidx];
       ap.dst_ip = (uint32_t)a;
       ap.dst_port = (int)b;
@@ -1832,6 +1838,33 @@ struct Engine {
       ap.count = (int)d;
       asys(hp, ASYS_RESOLVE);
       app_client_begin(aidx, now);
+    } else if (kind == APP_UDP_FLOOD) {
+      AppN &ap = apps[(size_t)aidx];
+      ap.dst_ip = (uint32_t)a;
+      ap.dst_port = (int)b;
+      ap.count = (int)c;
+      ap.size = d;
+      ap.interval = e;
+      asys(hp, ASYS_SOCKET);
+      uint32_t tok = new_udp(hid, sb, rb);
+      sock(tok)->app_owner = aidx;
+      ap.sock = (int64_t)tok;
+      asys(hp, ASYS_RESOLVE);
+      app_step_flood(aidx, now);
+    } else {  /* APP_UDP_SINK */
+      AppN &ap = apps[(size_t)aidx];
+      ap.port = (int)a;
+      ap.expect = b;
+      asys(hp, ASYS_SOCKET);
+      uint32_t tok = new_udp(hid, sb, rb);
+      sock(tok)->app_owner = aidx;
+      ap.sock = (int64_t)tok;
+      asys(hp, ASYS_BIND);
+      if (generic_bind(hp, sock(tok), tok, 0, ap.port) < 0) {
+        app_die(aidx, 101, now);  // Python twin: bind raises, app crashes
+      } else {
+        app_step_sink(aidx, now);
+      }
     }
     return aidx;
   }
@@ -1839,10 +1872,11 @@ struct Engine {
   void app_die(int aidx, int code, int64_t now) {
     AppN &a = apps[(size_t)aidx];
     if (a.sock >= 0 && a.kind != APP_SERVER) {
-      TcpSocketN *s = tcp((uint32_t)a.sock);
-      if (s && !s->app_closed)
-        tcp_close(plane(a.hid), s, (uint32_t)a.sock, now);
-      if (s) s->app_owner = -2;
+      SocketN *s = sock((uint32_t)a.sock);
+      if (s) {
+        sock_close_any(plane(a.hid), (uint32_t)a.sock, now);
+        s->app_owner = -2;
+      }
     }
     a.exited = true;
     a.exit_code = code;
@@ -1861,6 +1895,8 @@ struct Engine {
     if (a.exited) return;
     if (a.kind == APP_SERVER) app_step_server(aidx, now);
     else if (a.kind == APP_CLIENT) app_client_resume(aidx, now);
+    else if (a.kind == APP_UDP_FLOOD) app_step_flood(aidx, now);
+    else if (a.kind == APP_UDP_SINK) app_step_sink(aidx, now);
     else app_step_handler(aidx, now);
   }
 
@@ -1939,13 +1975,108 @@ struct Engine {
     s->app_owner = -2;  // closed: teardown status must not wake us
     asys(hp, ASYS_SIM_TIME);
     asys(hp, ASYS_WRITE);
-    a.xfers.push_back({a.t0, now, a.got, a.got == a.nbytes});
+    {
+      char line[96];
+      if (a.got == a.nbytes)
+        snprintf(line, sizeof(line),
+                 "transfer %d ok bytes=%lld ns=%lld\n", a.xfer_i,
+                 (long long)a.got, (long long)(now - a.t0));
+      else
+        snprintf(line, sizeof(line),
+                 "transfer %d SHORT %lld bytes=%lld ns=%lld\n",
+                 a.xfer_i, (long long)a.got, (long long)a.got,
+                 (long long)(now - a.t0));
+      a.out += line;
+    }
     a.xfer_i++;
     a.sock = -1;
     if (a.xfer_i < a.count) {
       app_client_begin(aidx, now);
       return;
     }
+    a.exited = true;
+    a.exit_code = 0;
+    a.exit_time = now;
+    a.wait_mask = 0;
+  }
+
+  void sock_close_any(HostPlane *hp, uint32_t tok, int64_t now) {
+    SocketN *s = sock(tok);
+    if (s->proto == PROTO_TCP)
+      tcp_close(hp, static_cast<TcpSocketN *>(s), tok, now);
+    else
+      udp_close(hp, static_cast<UdpSocketN *>(s));
+  }
+
+  /* udp-flood <dst> <port> <count> <size> [interval_ns] twin */
+  void app_step_flood(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    uint32_t tok = (uint32_t)a.sock;
+    if (a.state == 1) {
+      /* nanosleep wake: the restarted dispatch counts again */
+      asys(hp, ASYS_NANOSLEEP);
+      a.state = 0;
+    }
+    static std::string xpay;
+    if ((int64_t)xpay.size() < a.size) xpay.assign((size_t)a.size, 'x');
+    while (a.sent_i < a.count) {
+      asys(hp, ASYS_SENDTO);
+      int64_t w = udp_sendto(hp, s, tok, xpay.data(), a.size, 1,
+                             a.dst_ip, a.dst_port, now);
+      if (w == -E_AGAIN) { a.wait_mask = S_WRITABLE; return; }
+      if (w < 0) { app_die(aidx, 101, now); return; }
+      a.sent_i++;
+      if (a.interval > 0) {
+        asys(hp, ASYS_NANOSLEEP);
+        a.state = 1;  // resume as a nanosleep restart
+        a.wake_pending = true;
+        hp->tpush({now + a.interval, hp->event_seq++, TK_APP,
+                   (uint32_t)aidx});
+        return;
+      }
+    }
+    char line[64];
+    snprintf(line, sizeof(line), "sent %lld datagrams %lld bytes\n",
+             (long long)a.count, (long long)(a.count * a.size));
+    asys(hp, ASYS_WRITE);
+    a.out += line;
+    asys(hp, ASYS_CLOSE);
+    sock_close_any(hp, tok, now);
+    sock((uint32_t)a.sock)->app_owner = -2;
+    a.exited = true;
+    a.exit_code = 0;
+    a.exit_time = now;
+    a.wait_mask = 0;
+  }
+
+  /* udp-sink <port> [expected_bytes] twin */
+  void app_step_sink(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    std::string data;
+    uint32_t sip;
+    int sport;
+    while (a.expect < 0 || a.got < a.expect) {
+      asys(hp, ASYS_RECVFROM);
+      int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
+      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r < 0) { app_die(aidx, 101, now); return; }
+      a.got += (int64_t)data.size();
+      a.got_n++;
+    }
+    asys(hp, ASYS_SIM_TIME);
+    char line[96];
+    snprintf(line, sizeof(line),
+             "received %lld datagrams %lld bytes t=%lld\n",
+             (long long)a.got_n, (long long)a.got, (long long)now);
+    asys(hp, ASYS_WRITE);
+    a.out += line;
+    asys(hp, ASYS_CLOSE);
+    sock_close_any(hp, (uint32_t)a.sock, now);
+    sock((uint32_t)a.sock)->app_owner = -2;
     a.exited = true;
     a.exit_code = 0;
     a.exit_time = now;
@@ -2989,12 +3120,12 @@ static PyObject *eng_scatter_round(EngineObj *self, PyObject *args) {
 
 static PyObject *eng_app_spawn(EngineObj *self, PyObject *args) {
   int hid, kind, sat, rat;
-  long long a, b, c, d, sb, rb, now;
-  if (!PyArg_ParseTuple(args, "iiLLLLLLiiL", &hid, &kind, &a, &b, &c, &d,
-                        &sb, &rb, &sat, &rat, &now))
+  long long a, b, c, d, e, sb, rb, now;
+  if (!PyArg_ParseTuple(args, "iiLLLLLLLiiL", &hid, &kind, &a, &b, &c, &d,
+                        &e, &sb, &rb, &sat, &rat, &now))
     return nullptr;
-  int idx = self->eng->app_spawn(hid, kind, a, b, c, d, sb, rb, sat, rat,
-                                 now);
+  int idx = self->eng->app_spawn(hid, kind, a, b, c, d, e, sb, rb, sat,
+                                 rat, now);
   CHECK_CB(self);
   return PyLong_FromLong(idx);
 }
@@ -3007,17 +3138,9 @@ static PyObject *eng_app_poll(EngineObj *self, PyObject *args) {
     return nullptr;
   }
   AppN &a = self->eng->apps[(size_t)idx];
-  PyObject *xf = PyList_New((Py_ssize_t)a.xfers.size());
-  for (size_t i = 0; i < a.xfers.size(); i++) {
-    AppXfer &x = a.xfers[i];
-    PyList_SET_ITEM(xf, (Py_ssize_t)i,
-                    Py_BuildValue("LLLO", (long long)x.t0,
-                                  (long long)x.t1, (long long)x.got,
-                                  x.ok ? Py_True : Py_False));
-  }
-  PyObject *r = Py_BuildValue("OiLN", a.exited ? Py_True : Py_False,
-                              a.exit_code, (long long)a.exit_time, xf);
-  return r;
+  return Py_BuildValue("OiLy#", a.exited ? Py_True : Py_False,
+                       a.exit_code, (long long)a.exit_time,
+                       a.out.data(), (Py_ssize_t)a.out.size());
 }
 
 static PyObject *eng_app_syscalls(EngineObj *self, PyObject *args) {

@@ -277,16 +277,16 @@ class Manager:
                                                         engine_app_args)
                 spec = engine_app_args(_pcfg, h, self.dns)
                 if spec is not None:
-                    kind, a, b, c, d = spec
+                    kind, a, b, c, d, e = spec
                     sh = self.syscall_handler
                     process = EngineAppProcess(
                         h, f"{_pcfg.path}.{index}",
                         expected_final_state=_pcfg.expected_final_state)
                     spawned.append(process)
                     process.app_idx = h.plane.engine.app_spawn(
-                        h.id, kind, a, b, c, d, sh.send_buf, sh.recv_buf,
-                        int(sh.send_autotune), int(sh.recv_autotune),
-                        h.now())
+                        h.id, kind, a, b, c, d, e, sh.send_buf,
+                        sh.recv_buf, int(sh.send_autotune),
+                        int(sh.recv_autotune), h.now())
                     return
             factory = app_registry.lookup(_pcfg.path)
             if factory is None and "/" in _pcfg.path:

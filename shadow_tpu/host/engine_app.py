@@ -17,6 +17,8 @@ from __future__ import annotations
 # (config path, argv shape) -> engine app kind
 KIND_SERVER = 0
 KIND_CLIENT = 1
+KIND_UDP_FLOOD = 3
+KIND_UDP_SINK = 4
 
 
 class _FdTableStub:
@@ -57,12 +59,10 @@ class EngineAppProcess:
 
     @property
     def stdout(self) -> bytearray:
-        _e, _c, _t, xfers = self._poll()
-        out = []
-        for i, (t0, t1, got, ok) in enumerate(xfers):
-            tag = "ok" if ok else f"SHORT {got}"
-            out.append(f"transfer {i} {tag} bytes={got} ns={t1 - t0}\n")
-        return bytearray("".join(out).encode())
+        # The engine builds the exact bytes the Python app would have
+        # written as it goes.
+        _e, _c, _t, out = self._poll()
+        return bytearray(out)
 
     # -- Process interface the Manager touches --------------------------
 
@@ -83,13 +83,13 @@ class EngineAppProcess:
 
 
 def engine_app_args(pcfg, host, dns):
-    """(kind, a, b, c, d) for engine.app_spawn, or None when `pcfg`
-    isn't an engine-runnable tgen app."""
+    """(kind, a, b, c, d, e) for engine.app_spawn, or None when `pcfg`
+    isn't an engine-runnable app."""
     args = list(pcfg.args)
     if pcfg.path == "tgen-server":
         if len(args) != 1:
             return None
-        return (KIND_SERVER, int(args[0]), 0, 0, 0)
+        return (KIND_SERVER, int(args[0]), 0, 0, 0, 0)
     if pcfg.path == "tgen-client":
         if len(args) not in (3, 4):
             return None
@@ -97,5 +97,19 @@ def engine_app_args(pcfg, host, dns):
         if ip is None:
             return None
         count = int(args[3]) if len(args) > 3 else 1
-        return (KIND_CLIENT, ip, int(args[1]), int(args[2]), count)
+        return (KIND_CLIENT, ip, int(args[1]), int(args[2]), count, 0)
+    if pcfg.path == "udp-flood":
+        if len(args) not in (4, 5):
+            return None
+        ip = dns.ip_for_name(args[0])
+        if ip is None:
+            return None
+        interval = int(args[4]) if len(args) > 4 else 0
+        return (KIND_UDP_FLOOD, ip, int(args[1]), int(args[2]),
+                int(args[3]), interval)
+    if pcfg.path == "udp-sink":
+        if len(args) not in (1, 2):
+            return None
+        expect = int(args[1]) if len(args) > 1 else -1
+        return (KIND_UDP_SINK, int(args[0]), expect, 0, 0, 0)
     return None

@@ -830,16 +830,13 @@ class ManagedThread:
             # event-driven (poll on the process pidfd), not a
             # wall-clock slice loop.
             self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
-            waited = _pidfd_wait(self.process.native_pid, 0, 10.0)
-            if waited is None:
+            if _pidfd_wait(self.process.native_pid, 0, 10.0) is None:
                 # No pidfd support: fall back to the timed slice poll.
                 deadline = _walltime.monotonic() + 10.0
                 while _walltime.monotonic() < deadline:
                     if self._poll_death(host):
                         return False
                     _walltime.sleep(0.001)
-            elif self._poll_death(host):
-                return False
             if self._poll_death(host):
                 return False
             self._protocol_error(host, "child did not exit after exit_group")

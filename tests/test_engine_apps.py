@@ -75,6 +75,51 @@ def test_engine_apps_byte_identical_to_python_apps(tmp_path):
     assert _hist(m_ser) == _hist(m_tpu)
 
 
+def test_engine_udp_apps_byte_identical(tmp_path):
+    """udp-flood / udp-sink twins: trace, stdout, and syscall
+    histograms identical to the Python apps, including the paced
+    (nanosleep) flood variant."""
+    def run_udp(sched):
+        yaml = f"""
+general: {{ stop_time: 20s, seed: 5, data_directory: {tmp_path / ('u' + sched)} }}
+experimental: {{ scheduler: {sched} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.02 ] ]
+hosts:
+  sink:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-sink, args: ["9000"], expected_final_state: running }}
+  flood:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-flood, args: [sink, "9000", "20", "400"],
+           start_time: 1s }}
+      - {{ path: udp-flood, args: [sink, "9000", "5", "200", "50000000"],
+           start_time: 2s }}
+"""
+        return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+    m_ser, s_ser = run_udp("serial")
+    m_tpu, s_tpu = run_udp("tpu")
+    assert s_ser.ok and s_tpu.ok
+    n_engine = sum(1 for h in m_tpu.hosts for p in h.processes.values()
+                   if isinstance(p, EngineAppProcess))
+    assert n_engine == 3, n_engine
+    assert m_ser.trace_lines() == m_tpu.trace_lines()
+    for hname in ("sink", "flood"):
+        hs = next(h for h in m_ser.hosts if h.name == hname)
+        ht = next(h for h in m_tpu.hosts if h.name == hname)
+        for ps, pt in zip(hs.processes.values(), ht.processes.values()):
+            assert bytes(ps.stdout) == bytes(pt.stdout), (hname,
+                                                          ps.name)
+    assert _hist(m_ser) == _hist(m_tpu)
+
+
 def test_engine_apps_strace_falls_back_to_python(tmp_path):
     """strace needs the Python process machinery: engine apps must not
     engage when strace logging is on."""
