@@ -1854,7 +1854,11 @@ struct Engine {
     } else {  /* APP_UDP_SINK */
       AppN &ap = apps[(size_t)aidx];
       ap.port = (int)a;
-      ap.expect = b;
+      /* c!=0: an expected-bytes arg was given (0 or negative values
+       * exit immediately, exactly like the Python `got < expect`);
+       * c==0: run forever. */
+      ap.expect = c ? b : -1;
+      ap.interval = c;  // reuse as has_expect flag
       asys(hp, ASYS_SOCKET);
       uint32_t tok = new_udp(hid, sb, rb);
       sock(tok)->app_owner = aidx;
@@ -2028,6 +2032,7 @@ struct Engine {
       if (w == -E_AGAIN) { a.wait_mask = S_WRITABLE; return; }
       if (w < 0) { app_die(aidx, 101, now); return; }
       a.sent_i++;
+      a.got += a.size;  // reuse as the Python app's `sent` accumulator
       if (a.interval > 0) {
         asys(hp, ASYS_NANOSLEEP);
         a.state = 1;  // resume as a nanosleep restart
@@ -2039,7 +2044,7 @@ struct Engine {
     }
     char line[64];
     snprintf(line, sizeof(line), "sent %lld datagrams %lld bytes\n",
-             (long long)a.count, (long long)(a.count * a.size));
+             (long long)a.count, (long long)a.got);
     asys(hp, ASYS_WRITE);
     a.out += line;
     asys(hp, ASYS_CLOSE);
@@ -2059,7 +2064,7 @@ struct Engine {
     std::string data;
     uint32_t sip;
     int sport;
-    while (a.expect < 0 || a.got < a.expect) {
+    while (a.interval == 0 /*no expect arg*/ || a.got < a.expect) {
       asys(hp, ASYS_RECVFROM);
       int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
       if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }

@@ -253,6 +253,8 @@ class Manager:
                 initializer = _make_pinner()
             self._pool = ThreadPoolExecutor(max_workers=n_workers,
                                             initializer=initializer)
+            import threading as _threading
+            self._steal_lock = _threading.Lock()
         else:
             self._pool = None
 
@@ -399,18 +401,31 @@ class Manager:
             # host, pool-sized by min(cores, hosts).
             list(self._pool.map(lambda h: h.execute(until), active))
         else:
-            # thread_per_core (thread_per_core.rs): contiguous strides per
-            # worker; Python threads serialize CPU work on the GIL, so
+            # thread_per_core (thread_per_core.rs:17-60): workers claim
+            # blocks off one shared cursor, so a thread that drew cheap
+            # hosts steals the remainder of an expensive neighbor's
+            # share — the same load-balance property as the reference's
+            # per-thread ArrayQueue stealing, in the shape the GIL
+            # rewards (one atomic claim per block, not per task).
+            # Python threads still serialize CPU work on the GIL, so
             # this validates the concurrency protocol more than it buys
             # speed — the TPU scheduler is the performance path.
             n = self._pool._max_workers
-            chunks = [active[i::n] for i in range(n)]
+            block = max(1, len(active) // (n * 8))
+            cursor = [0]
+            lock = self._steal_lock
 
-            def run_chunk(chunk):
-                for h in chunk:
-                    h.execute(until)
+            def run_worker(_):
+                while True:
+                    with lock:
+                        i = cursor[0]
+                        cursor[0] = i + block
+                    if i >= len(active):
+                        return
+                    for h in active[i:i + block]:
+                        h.execute(until)
 
-            list(self._pool.map(run_chunk, chunks))
+            list(self._pool.map(run_worker, range(n)))
 
     def run(self) -> SimSummary:
         import sys

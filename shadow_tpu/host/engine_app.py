@@ -110,6 +110,7 @@ def engine_app_args(pcfg, host, dns):
     if pcfg.path == "udp-sink":
         if len(args) not in (1, 2):
             return None
-        expect = int(args[1]) if len(args) > 1 else -1
-        return (KIND_UDP_SINK, int(args[0]), expect, 0, 0, 0)
+        expect = int(args[1]) if len(args) > 1 else 0
+        has_expect = 1 if len(args) > 1 else 0
+        return (KIND_UDP_SINK, int(args[0]), expect, has_expect, 0, 0)
     return None
