@@ -525,6 +525,9 @@ class Manager:
                     # leaks from a server simply still running at
                     # stop_time.
                     proc.fds.close_all(h)
+                    plow = getattr(proc, "fds_low", None)
+                    if plow is not None:
+                        plow.close_all(h)
                 proc.strace_close()
         # Flush captures even when the caller never writes a data dir.
         for h in self.hosts:

@@ -82,6 +82,10 @@ class DescriptorTable:
             self._cloexec.add(fd)
         _incref(file)
 
+    def get_opt(self, fd: int):
+        """Like get() but returns None instead of raising EBADF."""
+        return self._fds.get(fd)
+
     def get(self, fd: int):
         f = self._fds.get(fd)
         if f is None:

@@ -1066,6 +1066,9 @@ class ManagedThread:
         child.mem = MemoryManager(native_pid)
         WATCHER.register(native_pid, ipc)
         child.fds = parent.fds.fork_copy()
+        plow = getattr(parent, "fds_low", None)
+        if plow is not None:
+            child.fds_low = plow.fork_copy()
         from shadow_tpu.host.files import SignalFd
         for cfd, f in child.fds.items():
             if isinstance(f, SignalFd):
@@ -1195,6 +1198,9 @@ class ManagedThread:
 
         # POSIX exec semantics on the emulated state.
         process.fds.close_cloexec(host)
+        plow = getattr(process, "fds_low", None)
+        if plow is not None:
+            plow.close_cloexec(host)
         process.signals.actions = {
             s: a for s, a in process.signals.actions.items()
             if a.handler == 1}  # SIG_IGN survives, handlers reset

@@ -206,6 +206,9 @@ class Process:
             self.exit_code = (self._nonzero_exit
                               if self._nonzero_exit is not None else code)
             self.fds.close_all(host)
+            low = getattr(self, "fds_low", None)
+            if low is not None:
+                low.close_all(host)
             self.strace_close()
             if self.parent_pid is not None:
                 parent = host.processes.get(self.parent_pid)

@@ -136,6 +136,8 @@ POLLNVAL = 0x020
 
 O_NONBLOCK = 0o4000
 O_CLOEXEC = 0o2000000
+O_WRONLY = 0o1
+O_RDWR = 0o2
 FD_CLOEXEC = 1
 
 F_GETFD = 1
@@ -291,11 +293,23 @@ class NativeSyscallHandler:
     # -- fd helpers ----------------------------------------------------
 
     @staticmethod
-    def _is_emu(fd: int) -> bool:
-        return EMU_FD_BASE <= fd < EMU_FD_LIMIT
+    def _is_emu(process, fd: int) -> bool:
+        if EMU_FD_BASE <= fd < EMU_FD_LIMIT:
+            return True
+        # Low emulated fds: an emulated object dup2'd onto a native fd
+        # number (shells/git redirect emulated pipes onto the child's
+        # stdio before exec).
+        low = getattr(process, "fds_low", None)
+        return low is not None and low.get_opt(fd) is not None
 
     @staticmethod
     def _emu(process, fd: int):
+        if fd < EMU_FD_BASE:
+            low = getattr(process, "fds_low", None)
+            obj = low.get_opt(fd) if low is not None else None
+            if obj is None:
+                raise OSError(errno.EBADF, "bad low emulated fd")
+            return obj
         return process.fds.get(fd - EMU_FD_BASE)
 
     @staticmethod
@@ -366,7 +380,7 @@ class NativeSyscallHandler:
 
     def sys_bind(self, host, process, thread, restarted, fd, addr_ptr,
                  addrlen, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         raw = process.mem.read(addr_ptr, min(addrlen, 128))
@@ -384,7 +398,7 @@ class NativeSyscallHandler:
 
     def sys_connect(self, host, process, thread, restarted, fd, addr_ptr,
                     addrlen, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         raw = process.mem.read(addr_ptr, min(addrlen, 128))
@@ -409,7 +423,7 @@ class NativeSyscallHandler:
         return _done(0)
 
     def sys_listen(self, host, process, thread, restarted, fd, backlog, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         self._emu(process, fd).listen(host, backlog or 128)
         return _done(0)
@@ -443,13 +457,13 @@ class NativeSyscallHandler:
 
     def sys_accept(self, host, process, thread, restarted, fd, addr_ptr,
                    len_ptr, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         return self._accept_common(host, process, fd, addr_ptr, len_ptr, 0)
 
     def sys_accept4(self, host, process, thread, restarted, fd, addr_ptr,
                     len_ptr, flags, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         return self._accept_common(host, process, fd, addr_ptr, len_ptr,
                                    flags)
@@ -478,7 +492,7 @@ class NativeSyscallHandler:
 
     def sys_sendto(self, host, process, thread, restarted, fd, buf_ptr,
                    length, flags, addr_ptr, addrlen):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         data = process.mem.read(buf_ptr, min(length, _MAX_IO))
@@ -497,7 +511,7 @@ class NativeSyscallHandler:
 
     def sys_recvfrom(self, host, process, thread, restarted, fd, buf_ptr,
                      length, flags, addr_ptr, len_ptr):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         try:
@@ -558,7 +572,7 @@ class NativeSyscallHandler:
 
     def sys_sendmsg(self, host, process, thread, restarted, fd, msg_ptr,
                     flags, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         name_ptr, namelen, iov_ptr, iovlen = self._read_msghdr(process,
@@ -610,7 +624,7 @@ class NativeSyscallHandler:
         sendmmsg (res_send.c) — without this the port-53 interception
         never sees the queries.  mmsghdr = msghdr (56) + msg_len (4) +
         pad (4)."""
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         vlen = min(int(vlen), 64)
@@ -642,7 +656,7 @@ class NativeSyscallHandler:
 
     def sys_recvmmsg(self, host, process, thread, restarted, fd, vec_ptr,
                      vlen, flags, timeout_ptr, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         vlen = min(int(vlen), 64)
@@ -729,7 +743,7 @@ class NativeSyscallHandler:
             nfds = (min(clen, len(raw) - off) - 16) // 4
             for i in range(nfds):
                 (fd,) = struct.unpack_from("<i", raw, off + 16 + 4 * i)
-                if self._is_emu(fd):
+                if self._is_emu(process, fd):
                     try:
                         objs.append(self._emu(process, fd))
                     except OSError:
@@ -819,7 +833,7 @@ class NativeSyscallHandler:
 
     def sys_recvmsg(self, host, process, thread, restarted, fd, msg_ptr,
                     flags, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         name_ptr, _namelen, iov_ptr, iovlen = self._read_msghdr(process,
@@ -889,7 +903,7 @@ class NativeSyscallHandler:
 
     def sys_getsockname(self, host, process, thread, restarted, fd,
                         addr_ptr, len_ptr, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         if isinstance(sock, UnixSocket):
@@ -907,7 +921,7 @@ class NativeSyscallHandler:
 
     def sys_getpeername(self, host, process, thread, restarted, fd,
                         addr_ptr, len_ptr, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         if isinstance(sock, NetlinkSocket):
@@ -925,7 +939,7 @@ class NativeSyscallHandler:
 
     def sys_setsockopt(self, host, process, thread, restarted, fd, level,
                        optname, optval, optlen, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         # TCP_NODELAY (IPPROTO_TCP=6, optname 1) reaches the connection's
@@ -953,7 +967,7 @@ class NativeSyscallHandler:
 
     def sys_getsockopt(self, host, process, thread, restarted, fd, level,
                        optname, optval_ptr, optlen_ptr, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         value = 0
@@ -995,7 +1009,7 @@ class NativeSyscallHandler:
         return _done(0)
 
     def sys_shutdown(self, host, process, thread, restarted, fd, how, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         sock = self._emu(process, fd)
         how_s = {0: "rd", 1: "wr", 2: "rdwr"}.get(how)
@@ -1054,7 +1068,7 @@ class NativeSyscallHandler:
 
     def sys_read(self, host, process, thread, restarted, fd, buf_ptr,
                  count, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         file = self._emu(process, fd)
         try:
@@ -1069,7 +1083,7 @@ class NativeSyscallHandler:
 
     def sys_write(self, host, process, thread, restarted, fd, buf_ptr,
                   count, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         file = self._emu(process, fd)
         data = process.mem.read(buf_ptr, min(count, _MAX_IO))
@@ -1082,7 +1096,7 @@ class NativeSyscallHandler:
 
     def sys_readv(self, host, process, thread, restarted, fd, iov_ptr,
                   iovlen, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         file = self._emu(process, fd)
         total = sum(l for _b, l in self._iovecs(process, iov_ptr, iovlen))
@@ -1097,7 +1111,7 @@ class NativeSyscallHandler:
 
     def sys_writev(self, host, process, thread, restarted, fd, iov_ptr,
                    iovlen, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         file = self._emu(process, fd)
         data = self._gather_iov(process, iov_ptr, iovlen)
@@ -1109,8 +1123,18 @@ class NativeSyscallHandler:
             return _block(SyscallCondition(file=file, mask=S_WRITABLE))
 
     def sys_close(self, host, process, thread, restarted, fd, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
+        if fd < EMU_FD_BASE:
+            getattr(process, "fds_low").close_fd(host, fd)
+            if fd <= 2:
+                # stdio always exists kernel-side; close it too.
+                return _native()
+            # No guarantee a kernel fd sits at this number (dup2 only
+            # registered the shadow) — succeed emulated rather than
+            # surface the kernel's spurious EBADF; a shadowed kernel
+            # fd, if any, closes at exec/exit.
+            return _done(0)
         process.fds.close_fd(host, fd - EMU_FD_BASE)
         return _done(0)
 
@@ -1158,7 +1182,7 @@ class NativeSyscallHandler:
                   *_):
         """Apps fstat sockets/pipes to learn the file type; a native
         fstat on our fd numbers would be EBADF."""
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         self._write_emu_stat(host, process, self._emu(process, fd), fd,
                              stat_ptr)
@@ -1170,7 +1194,7 @@ class NativeSyscallHandler:
         on modern kernels — route the emulated-fd shape here, leave
         real path lookups native."""
         dirfd = _sext32(dirfd)
-        if not self._is_emu(dirfd):
+        if not self._is_emu(process, dirfd):
             return _native()
         path = process.mem.read_cstr(path_ptr, 256) if path_ptr else b""
         if path:
@@ -1182,7 +1206,7 @@ class NativeSyscallHandler:
     def sys_statx(self, host, process, thread, restarted, dirfd,
                   path_ptr, flags, mask, statx_ptr, *_):
         dirfd = _sext32(dirfd)
-        if not self._is_emu(dirfd):
+        if not self._is_emu(process, dirfd):
             return _native()
         path = process.mem.read_cstr(path_ptr, 256) if path_ptr else b""
         if path:
@@ -1200,7 +1224,7 @@ class NativeSyscallHandler:
         return _done(0)
 
     def sys_lseek(self, host, process, thread, restarted, fd, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         return _error(errno.ESPIPE)  # sockets/pipes are not seekable
 
@@ -1229,22 +1253,53 @@ class NativeSyscallHandler:
                         process.fds.set_cloexec(fd - EMU_FD_BASE, True)
                     else:
                         process.fds.close_fd(host, fd - EMU_FD_BASE)
+            low = getattr(process, "fds_low", None)
+            if low is not None:
+                for fd in list(low.open_fds()):
+                    if first <= fd <= last:
+                        if flags & CLOSE_RANGE_CLOEXEC:
+                            low.set_cloexec(fd, True)
+                        else:
+                            low.close_fd(host, fd)
         return _native()
 
     def sys_dup(self, host, process, thread, restarted, fd, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         return _done(self._register(process, self._emu(process, fd)))
 
+    @staticmethod
+    def _low_table(process):
+        low = getattr(process, "fds_low", None)
+        if low is None:
+            from shadow_tpu.host.descriptor import DescriptorTable
+            low = process.fds_low = DescriptorTable()
+        return low
+
     def sys_dup2(self, host, process, thread, restarted, oldfd, newfd, *_,
                  cloexec: bool = False):
-        if not self._is_emu(oldfd):
+        if not self._is_emu(process, oldfd):
+            # A native fd dup2'd over a low EMULATED slot restores the
+            # native mapping: drop our shadow entry, let the kernel dup.
+            low = getattr(process, "fds_low", None)
+            if low is not None and low.get_opt(newfd) is not None:
+                low.close_fd(host, newfd)
             return _native()
-        if not self._is_emu(newfd):
-            return _error(errno.EINVAL)  # cross-space dup unsupported
         obj = self._emu(process, oldfd)  # validates oldfd (EBADF)
         if oldfd == newfd:
             return _done(newfd)  # Linux dup2(fd, fd) is a no-op
+        if not self._is_emu(process, newfd) and newfd >= EMU_FD_BASE:
+            return _error(errno.EINVAL)  # into the relocated-native zone
+        if newfd < EMU_FD_BASE:
+            # Emulated object onto a native fd number (stdio
+            # redirection before exec — git/shell pipelines).  The
+            # kernel-side fd keeps pointing wherever it did; every
+            # emulated syscall on `newfd` now routes to `obj`.
+            low = self._low_table(process)
+            if low.get_opt(newfd) is not None:
+                low.close_fd(host, newfd)
+            low.register_at(newfd, obj, cloexec=cloexec)
+            return _done(newfd)
         try:
             process.fds.close_fd(host, newfd - EMU_FD_BASE)
         except OSError:
@@ -1260,12 +1315,25 @@ class NativeSyscallHandler:
                              newfd, cloexec=bool(flags & O_CLOEXEC))
 
     def sys_fcntl(self, host, process, thread, restarted, fd, cmd, arg, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         file = self._emu(process, fd)
+        table, slot = ((self._low_table(process), fd)
+                       if fd < EMU_FD_BASE
+                       else (process.fds, fd - EMU_FD_BASE))
         if cmd == F_GETFL:
-            return _done(O_NONBLOCK if getattr(file, "nonblocking", False)
-                         else 0)
+            # Include the access mode: fdopen() validates it against
+            # the requested stream mode (a write-side pipe reported as
+            # O_RDONLY makes fdopen(fd, "w") fail EINVAL — git does
+            # exactly this on its remote-helper pipes).
+            from shadow_tpu.host.files import PipeEnd
+            if isinstance(file, PipeEnd):
+                acc = O_WRONLY if file.is_writer else 0  # O_RDONLY
+            else:
+                acc = O_RDWR  # sockets, eventfds, timerfds, epoll
+            return _done(acc | (O_NONBLOCK
+                                if getattr(file, "nonblocking", False)
+                                else 0))
         if cmd == F_SETFL:
             file.nonblocking = bool(arg & O_NONBLOCK)
             return _done(0)
@@ -1273,16 +1341,14 @@ class NativeSyscallHandler:
             return _done(self._register(process, file,
                                         cloexec=cmd == F_DUPFD_CLOEXEC))
         if cmd == F_GETFD:
-            cx = process.fds.get_cloexec(fd - EMU_FD_BASE)
-            return _done(FD_CLOEXEC if cx else 0)
+            return _done(FD_CLOEXEC if table.get_cloexec(slot) else 0)
         if cmd == F_SETFD:
-            process.fds.set_cloexec(fd - EMU_FD_BASE,
-                                    bool(arg & FD_CLOEXEC))
+            table.set_cloexec(slot, bool(arg & FD_CLOEXEC))
             return _done(0)
         return _error(errno.EINVAL)
 
     def sys_ioctl(self, host, process, thread, restarted, fd, req, argp, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         file = self._emu(process, fd)
         if req == FIONBIO:
@@ -1343,7 +1409,7 @@ class NativeSyscallHandler:
 
     def sys_timerfd_settime(self, host, process, thread, restarted, fd,
                             flags, new_ptr, old_ptr, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         tf = self._emu(process, fd)
         if not isinstance(tf, TimerFd):
@@ -1365,7 +1431,7 @@ class NativeSyscallHandler:
 
     def sys_timerfd_gettime(self, host, process, thread, restarted, fd,
                             cur_ptr, *_):
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             return _native()
         tf = self._emu(process, fd)
         if not isinstance(tf, TimerFd):
@@ -1394,12 +1460,12 @@ class NativeSyscallHandler:
 
     def sys_epoll_ctl(self, host, process, thread, restarted, epfd, op, fd,
                       event_ptr, *_):
-        if not self._is_emu(epfd):
+        if not self._is_emu(process, epfd):
             return _native()
         ep = self._emu(process, epfd)
         if not isinstance(ep, EpollFile):
             return _error(errno.EINVAL)
-        if not self._is_emu(fd):
+        if not self._is_emu(process, fd):
             # Native fds can't feed a simulated epoll; the reference
             # virtualizes all fds so this can't happen there.
             return _error(errno.EPERM)
@@ -1413,7 +1479,7 @@ class NativeSyscallHandler:
 
     def _epoll_wait_common(self, host, process, thread, restarted, epfd,
                            events_ptr, maxevents, timeout_ns):
-        if not self._is_emu(epfd):
+        if not self._is_emu(process, epfd):
             return _native()
         ep = self._emu(process, epfd)
         if not isinstance(ep, EpollFile):
@@ -1479,7 +1545,7 @@ class NativeSyscallHandler:
         raw = process.mem.read(fds_ptr, _POLLFD.size * nfds)
         entries = [_POLLFD.unpack_from(raw, i * _POLLFD.size)
                    for i in range(nfds)]
-        if not any(self._is_emu(fd) for fd, _e, _r in entries if fd >= 0):
+        if not any(self._is_emu(process, fd) for fd, _e, _r in entries if fd >= 0):
             return _native()
         ready = 0
         out = bytearray(raw)
@@ -1487,7 +1553,7 @@ class NativeSyscallHandler:
         for i, (fd, events, _rev) in enumerate(entries):
             revents = 0
             if fd >= 0:
-                if self._is_emu(fd):
+                if self._is_emu(process, fd):
                     try:
                         file = self._emu(process, fd)
                     except OSError:
@@ -1544,13 +1610,13 @@ class NativeSyscallHandler:
         rset, wset, eset = (read_set(p) for p in
                             (rfds_ptr, wfds_ptr, efds_ptr))
         all_fds = rset | wset | eset
-        if not any(self._is_emu(fd) for fd in all_fds):
+        if not any(self._is_emu(process, fd) for fd in all_fds):
             return _native()
 
         r_ready, w_ready, e_ready = set(), set(), set()
         watches = []
         for fd in sorted(all_fds):
-            if not self._is_emu(fd):
+            if not self._is_emu(process, fd):
                 continue  # hybrid limitation: native fds never ready
             try:
                 file = self._emu(process, fd)
@@ -2098,7 +2164,7 @@ class NativeSyscallHandler:
         (mask,) = struct.unpack("<Q", process.mem.read(mask_ptr, 8))
         fd = _sext32(fd)
         if fd != -1:
-            if not self._is_emu(fd):
+            if not self._is_emu(process, fd):
                 return _error(errno.EINVAL)
             sfd = self._emu(process, fd)
             if not isinstance(sfd, SignalFd):

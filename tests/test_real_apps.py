@@ -164,6 +164,70 @@ def test_curl_fetch_lossy_link(tmp_path):
     assert traces[0] == traces[1]
 
 
+GIT = shutil.which("git")
+
+
+@pytest.mark.skipif(GIT is None or not os.path.exists(SYS_PYTHON),
+                    reason="needs git + system python")
+def test_git_clone_over_simulated_network(tmp_path):
+    """A real git binary clones a repository over the simulated
+    network (dumb HTTP from an in-sim CPython server).  This exercises
+    the deepest managed-process machinery in one gate: git forks
+    git-remote-http, dup2s EMULATED pipes onto the child's stdio (the
+    low-emulated-fd table), fdopen validates F_GETFL access modes, the
+    child execs and speaks HTTP over emulated TCP with wire DNS.
+    Deterministic: two runs, byte-identical packet traces and
+    identical clone contents."""
+    import subprocess
+    src = tmp_path / "srv" / "repo"
+    os.makedirs(src)
+    env = dict(os.environ)
+    subprocess.run([GIT, "init", "-q", str(src)], check=True)
+    (src / "file.txt").write_text("hello simulated world\n")
+    for cmd in (["add", "-A"],
+                ["-c", "user.email=t@t", "-c", "user.name=t", "commit",
+                 "-qm", "c1"],
+                ["update-server-info"]):
+        subprocess.run([GIT, "-C", str(src)] + cmd, check=True, env=env)
+
+    traces = []
+    for i in range(2):
+        d = tmp_path / f"run{i}"
+        clone = d / "clone"
+        yaml = f"""
+general: {{ stop_time: 60s, seed: 3, data_directory: {d / 'data'} }}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: {SYS_PYTHON}
+        args: ["-m", "http.server", "--directory", "{tmp_path / 'srv'}", "80"]
+        start_time: 1s
+        expected_final_state: running
+  client:
+    network_node_id: 0
+    processes:
+      - path: {GIT}
+        args: ["clone", "-q", "http://server/repo/.git", "{clone}"]
+        start_time: 5s
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+"""
+        cfg = ConfigOptions.from_yaml_text(yaml)
+        manager, summary = run_simulation(cfg)
+        assert summary.ok, summary.plugin_errors
+        assert (clone / "file.txt").read_text() == \
+            "hello simulated world\n"
+        traces.append("\n".join(manager.trace_lines()))
+    assert traces[0] == traces[1]
+
+
 OPENSSL = shutil.which("openssl")
 
 
