@@ -553,6 +553,35 @@ class Manager:
               f"{stop / 1e9:.3f}s ({pct:.1f}%), {rate:.2f} sim-sec/wall-sec, "
               f"events {events}, packets {packets}, rss {mem_kb} kB",
               file=out, flush=True)
+        # tornettools-parseable resource lines, format-compatible with
+        # the reference's (manager.rs:696-721; tornettools
+        # parse_rusage.py matches on these exact phrases).
+        import resource as _resource
+        ru = _resource.getrusage(_resource.RUSAGE_SELF)
+        print(f"Process resource usage at simtime {sim_now} reported by "
+              f"getrusage(): "
+              f"ru_maxrss={ru.ru_maxrss / (1024 * 1024):.03f} GiB, "
+              f"ru_utime={ru.ru_utime / 60:.03f} minutes, "
+              f"ru_stime={ru.ru_stime / 60:.03f} minutes, "
+              f"ru_nvcsw={ru.ru_nvcsw}, "
+              f"ru_nivcsw={ru.ru_nivcsw}",
+              file=out, flush=True)
+        try:
+            mem = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    parts = v.split()
+                    if parts and parts[0].isdigit():
+                        n = int(parts[0])
+                        if len(parts) > 1 and parts[1] == "kB":
+                            n *= 1024  # ref converts everything to bytes
+                        mem[k.strip()] = n
+            print(f"System memory usage in bytes at simtime {sim_now} ns "
+                  f"reported by /proc/meminfo: {json.dumps(mem)}",
+                  file=out, flush=True)
+        except OSError:
+            pass
 
     # ------------------------------------------------------------------
     # Outputs

@@ -1075,6 +1075,11 @@ class ManagedThread:
                 # Each SignalFd serves one process: the child gets its
                 # own view bound to itself (files.py scope model).
                 child.fds.replace(cfd, f.clone_for(child))
+        clow = getattr(child, "fds_low", None)
+        if clow is not None:
+            for cfd, f in clow.items():
+                if isinstance(f, SignalFd):
+                    clow.replace(cfd, f.clone_for(child))
         child.signals = parent.signals.clone()
         seg = child.signals.action(sigmod.SIGSEGV)
         if seg.handler:

@@ -1126,14 +1126,13 @@ class NativeSyscallHandler:
         if not self._is_emu(process, fd):
             return _native()
         if fd < EMU_FD_BASE:
+            close_kernel_side = self._native_fd_exists(process, fd)
             getattr(process, "fds_low").close_fd(host, fd)
-            if fd <= 2:
-                # stdio always exists kernel-side; close it too.
-                return _native()
-            # No guarantee a kernel fd sits at this number (dup2 only
-            # registered the shadow) — succeed emulated rather than
-            # surface the kernel's spurious EBADF; a shadowed kernel
-            # fd, if any, closes at exec/exit.
+            if close_kernel_side:
+                return _native()  # close the shadowed kernel fd too
+            # dup2 only registered the shadow; no kernel fd exists at
+            # this number — succeed emulated rather than surface the
+            # kernel's spurious EBADF.
             return _done(0)
         process.fds.close_fd(host, fd - EMU_FD_BASE)
         return _done(0)
@@ -1269,6 +1268,13 @@ class NativeSyscallHandler:
         return _done(self._register(process, self._emu(process, fd)))
 
     @staticmethod
+    def _native_fd_exists(process, fd: int) -> bool:
+        pid = getattr(process, "native_pid", None)
+        if pid is None:
+            return False
+        return _os.path.exists(f"/proc/{pid}/fd/{fd}")
+
+    @staticmethod
     def _low_table(process):
         low = getattr(process, "fds_low", None)
         if low is None:
@@ -1281,8 +1287,12 @@ class NativeSyscallHandler:
         if not self._is_emu(process, oldfd):
             # A native fd dup2'd over a low EMULATED slot restores the
             # native mapping: drop our shadow entry, let the kernel dup.
+            # POSIX: a FAILED dup2 must leave newfd untouched — verify
+            # the native oldfd exists before mutating the shadow.
             low = getattr(process, "fds_low", None)
             if low is not None and low.get_opt(newfd) is not None:
+                if not self._native_fd_exists(process, oldfd):
+                    return _error(errno.EBADF)
                 low.close_fd(host, newfd)
             return _native()
         obj = self._emu(process, oldfd)  # validates oldfd (EBADF)
@@ -1326,7 +1336,6 @@ class NativeSyscallHandler:
             # the requested stream mode (a write-side pipe reported as
             # O_RDONLY makes fdopen(fd, "w") fail EINVAL — git does
             # exactly this on its remote-helper pipes).
-            from shadow_tpu.host.files import PipeEnd
             if isinstance(file, PipeEnd):
                 acc = O_WRONLY if file.is_writer else 0  # O_RDONLY
             else:
