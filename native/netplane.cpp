@@ -1781,6 +1781,33 @@ struct Engine {
     return {n, last};
   }
 
+  /* Batch round execution for hosts whose pending work is entirely
+   * engine-side (no Python heap entries, no Python inbox): one C call
+   * runs every listed host to the window end, updates the shared
+   * next-event snapshot, and accumulates per-host event counts.
+   * Returns the index of the first host whose execution fired a
+   * Python callback mid-batch (caller finishes that host and the rest
+   * through the slow path), or -1 when the whole batch completed. */
+  int64_t run_hosts(const uint32_t *ids, int64_t n_ids, int64_t until) {
+    for (int64_t i = 0; i < n_ids; i++) {
+      int hid = (int)ids[i];
+      auto [n, last] = run_until(hid, until, 1, 0, 0, until);
+      HostPlane *hp = plane(hid);
+      hp->events_run += n;
+      (void)last;
+      /* refresh the shared snapshot slot from the engine's own view */
+      if (nt && hid < nt_len) {
+        int64_t best = INT64_MAX;
+        if (!hp->inbox.empty()) best = hp->inbox.front().time;
+        if (!hp->theap.empty() && hp->theap.front().time < best)
+          best = hp->theap.front().time;
+        nt[hid] = best;
+      }
+      if (cb_fired || in_error) return i;
+    }
+    return -1;
+  }
+
   void push_inbox(int hid, int64_t time, int src, uint64_t seq,
                   uint64_t pkt) {
     HostPlane *hp = plane(hid);
@@ -2964,6 +2991,17 @@ static PyObject *eng_run_until(EngineObj *self, PyObject *args) {
   return Py_BuildValue("LL", (long long)n, (long long)last);
 }
 
+static PyObject *eng_run_hosts(EngineObj *self, PyObject *args) {
+  Py_buffer ids;
+  long long until;
+  if (!PyArg_ParseTuple(args, "y*L", &ids, &until)) return nullptr;
+  int64_t n = (int64_t)(ids.len / 4);
+  int64_t stop = self->eng->run_hosts((const uint32_t *)ids.buf, n, until);
+  PyBuffer_Release(&ids);
+  CHECK_CB(self);
+  return PyLong_FromLongLong((long long)stop);
+}
+
 static PyObject *eng_push_inbox(EngineObj *self, PyObject *args) {
   int hid, src;
   long long time;
@@ -3576,9 +3614,10 @@ static PyObject *eng_counters(EngineObj *self, PyObject *args) {
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
   HostPlane *hp = self->eng->plane(hid);
-  return Py_BuildValue("LLL", (long long)hp->pkts_sent,
+  return Py_BuildValue("LLLL", (long long)hp->pkts_sent,
                        (long long)hp->pkts_recv,
-                       (long long)hp->pkts_dropped);
+                       (long long)hp->pkts_dropped,
+                       (long long)hp->events_run);
 }
 
 static PyMethodDef eng_methods[] = {
@@ -3592,6 +3631,7 @@ static PyMethodDef eng_methods[] = {
     {"peek_deadline", (PyCFunction)eng_peek_deadline, METH_VARARGS, nullptr},
     {"peek_next", (PyCFunction)eng_peek_next, METH_VARARGS, nullptr},
     {"run_until", (PyCFunction)eng_run_until, METH_VARARGS, nullptr},
+    {"run_hosts", (PyCFunction)eng_run_hosts, METH_VARARGS, nullptr},
     {"push_inbox", (PyCFunction)eng_push_inbox, METH_VARARGS, nullptr},
     {"set_routing", (PyCFunction)eng_set_routing, METH_VARARGS, nullptr},
     {"set_nt", (PyCFunction)eng_set_nt, METH_VARARGS, nullptr},

@@ -394,8 +394,35 @@ class Manager:
             return
         active = self._active_hosts(until)
         if self._pool is None:
-            for h in active:
-                h.execute(until)
+            if self.plane is not None:
+                # Batch path: hosts whose pending work is entirely
+                # engine-side (no Python heap entries, no undrained
+                # Python inbox) run the whole window in ONE C call —
+                # at 100k hosts the per-host Python wrapper and the
+                # C-call crossings are the round loop's main cost.
+                eng = self.plane.engine
+                fast: list = []
+                slow: list = []
+                for h in active:
+                    if h.plane is not None and not h.queue._heap \
+                            and not h._inbox:
+                        fast.append(h.id)
+                    else:
+                        slow.append(h)
+                if fast:
+                    arr = np.asarray(fast, dtype=np.uint32)
+                    stop = eng.run_hosts(arr, until)
+                    if stop >= 0:
+                        # A Python callback fired mid-batch: finish
+                        # that host and the remainder via the full
+                        # merge loop (which services callbacks).
+                        for hid in fast[stop:]:
+                            self.hosts[hid].execute(until)
+                for h in slow:
+                    h.execute(until)
+            else:
+                for h in active:
+                    h.execute(until)
         elif self._per_host_tasks:
             # thread_per_host (scheduler/thread_per_host.rs): one task per
             # host, pool-sized by min(cores, hosts).

@@ -98,7 +98,7 @@ class Host:
         self.plane = None
         self._nsocks: dict[int, object] = {}  # engine token -> proxy
         self._send_native_fn = None           # propagator.send_native
-        self._native_merged = (0, 0, 0)       # counters merged so far
+        self._native_merged = (0, 0, 0, 0)    # counters merged so far
         self._app_sys_merged: dict = {}       # engine-app syscalls merged
 
         # Shared next-event snapshot (manager._nt): each host writes its
@@ -371,12 +371,15 @@ class Host:
         (incremental: safe to call from heartbeats and final stats)."""
         if self.plane is None:
             return
-        sent, recv, dropped = self.plane.engine.counters(self.id)
-        ps, pr, pd = self._native_merged
+        sent, recv, dropped, ev = self.plane.engine.counters(self.id)
+        ps, pr, pd, pe = self._native_merged
         self.counters["packets_sent"] += sent - ps
         self.counters["packets_recv"] += recv - pr
         self.counters["packets_dropped"] += dropped - pd
-        self._native_merged = (sent, recv, dropped)
+        # Events executed by the engine's batch path (run_hosts); the
+        # Python wrapper path counts its own.
+        self.counters["events"] += ev - pe
+        self._native_merged = (sent, recv, dropped, ev)
         # Engine-app syscalls (counted C++-side at the exact points the
         # Python dispatch would) fold into the same histograms.
         app_sys = self.plane.engine.app_syscalls(self.id)

@@ -1070,16 +1070,15 @@ class ManagedThread:
         if plow is not None:
             child.fds_low = plow.fork_copy()
         from shadow_tpu.host.files import SignalFd
-        for cfd, f in child.fds.items():
-            if isinstance(f, SignalFd):
-                # Each SignalFd serves one process: the child gets its
-                # own view bound to itself (files.py scope model).
-                child.fds.replace(cfd, f.clone_for(child))
-        clow = getattr(child, "fds_low", None)
-        if clow is not None:
-            for cfd, f in clow.items():
+        for table in (child.fds, getattr(child, "fds_low", None)):
+            if table is None:
+                continue
+            for cfd, f in table.items():
                 if isinstance(f, SignalFd):
-                    clow.replace(cfd, f.clone_for(child))
+                    # Each SignalFd serves one process: the child gets
+                    # its own view bound to itself (files.py scope
+                    # model).
+                    table.replace(cfd, f.clone_for(child))
         child.signals = parent.signals.clone()
         seg = child.signals.action(sigmod.SIGSEGV)
         if seg.handler:
