@@ -1244,6 +1244,12 @@ struct HostPlane {
   int qdisc = 0;  // 0 fifo, 1 round_robin
   int64_t bw_up_bits = 0, bw_down_bits = 0;
   uint64_t event_seq = 0, packet_seq = 0;
+  /* Host RNG stream (core/rng.py HostRng twin): threefry2x32 over an
+   * incrementing counter.  Owned engine-side once the plane registers
+   * it; Python-side draws delegate here so there is ONE counter. */
+  uint32_t rng_k0 = 0, rng_k1 = 0;
+  uint64_t rng_counter = 0;
+  bool rng_native = false;
   int64_t now = 0;
   IfaceN lo, eth;
   CoDelN codel;
@@ -1398,6 +1404,15 @@ struct Engine {
   }
 
   uint64_t rng_u64(int hid) {
+    HostPlane *hp = plane(hid);
+    if (hp->rng_native) {
+      uint32_t b0, b1;
+      threefry2x32(hp->rng_k0, hp->rng_k1,
+                   (uint32_t)(hp->rng_counter & 0xFFFFFFFFu),
+                   (uint32_t)(hp->rng_counter >> 32), &b0, &b1);
+      hp->rng_counter++;
+      return ((uint64_t)b1 << 32) | b0;
+    }
     if (!cb_rng || in_error) return 0;
     PyObject *r = PyObject_CallFunction(cb_rng, "i", hid);
     if (!r) { in_error = true; return 0; }
@@ -2991,6 +3006,26 @@ static PyObject *eng_run_until(EngineObj *self, PyObject *args) {
   return Py_BuildValue("LL", (long long)n, (long long)last);
 }
 
+static PyObject *eng_set_host_rng(EngineObj *self, PyObject *args) {
+  int hid;
+  unsigned int k0, k1;
+  unsigned long long counter;
+  if (!PyArg_ParseTuple(args, "iIIK", &hid, &k0, &k1, &counter))
+    return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  hp->rng_k0 = k0;
+  hp->rng_k1 = k1;
+  hp->rng_counter = counter;
+  hp->rng_native = true;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_rng_next(EngineObj *self, PyObject *args) {
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  return PyLong_FromUnsignedLongLong(self->eng->rng_u64(hid));
+}
+
 static PyObject *eng_run_hosts(EngineObj *self, PyObject *args) {
   Py_buffer ids;
   long long until;
@@ -3632,6 +3667,8 @@ static PyMethodDef eng_methods[] = {
     {"peek_next", (PyCFunction)eng_peek_next, METH_VARARGS, nullptr},
     {"run_until", (PyCFunction)eng_run_until, METH_VARARGS, nullptr},
     {"run_hosts", (PyCFunction)eng_run_hosts, METH_VARARGS, nullptr},
+    {"set_host_rng", (PyCFunction)eng_set_host_rng, METH_VARARGS, nullptr},
+    {"rng_next", (PyCFunction)eng_rng_next, METH_VARARGS, nullptr},
     {"push_inbox", (PyCFunction)eng_push_inbox, METH_VARARGS, nullptr},
     {"set_routing", (PyCFunction)eng_set_routing, METH_VARARGS, nullptr},
     {"set_nt", (PyCFunction)eng_set_nt, METH_VARARGS, nullptr},

@@ -138,7 +138,7 @@ class HostRng:
     State is just (key, counter); cheap to snapshot for checkpointing.
     """
 
-    __slots__ = ("_k0", "_k1", "_host_id", "_counter")
+    __slots__ = ("_k0", "_k1", "_host_id", "_counter", "_engine")
 
     def __init__(self, seed: int, host_id: int):
         k0, k1 = mix_key(seed, STREAM_HOST)
@@ -146,8 +146,17 @@ class HostRng:
         self._k1 = k1 ^ (host_id >> 32)
         self._host_id = host_id
         self._counter = 0
+        self._engine = None  # native-plane delegate (ONE shared counter)
+
+    def attach_engine(self, engine, hid: int) -> None:
+        """Delegate draws to the data-plane engine's native threefry:
+        the engine registered (key, counter) via set_host_rng, and from
+        here on it owns the stream position."""
+        self._engine = engine
 
     def next_u64(self) -> int:
+        if self._engine is not None:
+            return self._engine.rng_next(self._host_id)
         b0, b1 = threefry2x32_py(self._k0, self._k1,
                                  self._counter & 0xFFFFFFFF,
                                  self._counter >> 32)

@@ -98,6 +98,13 @@ class NativePlane:
         self.engine.add_host(host.id, host.ip, host.bw_up_bits,
                              host.bw_down_bits, qdisc_rr, mtu)
         host.plane = self
+        # Move the host RNG stream engine-side (native threefry): the
+        # engine draws locally instead of calling back into Python per
+        # u64, and Python-side draws delegate through rng_next so the
+        # ONE counter keeps the stream identical to the object path.
+        rng = host.rng
+        self.engine.set_host_rng(host.id, rng._k0, rng._k1, rng._counter)
+        rng.attach_engine(self.engine, host.id)
 
     # -- callbacks (invoked synchronously from inside engine calls) ----
 
