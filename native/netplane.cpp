@@ -3129,10 +3129,12 @@ static PyObject *eng_round_size(EngineObj *self, PyObject *) {
 
 static PyObject *eng_export_round(EngineObj *self, PyObject *) {
   /* Columns for the device kernel: (src_node i32, dst_node i32,
-   * src_host i64, pkt_seq u32, t_send i64, is_ctl u8) as bytes. */
+   * dst_host i32, src_host i64, pkt_seq u32, t_send i64, is_ctl u8) as
+   * bytes.  dst_host lets the sharded backend compute destination
+   * shards (dst_host / hosts_per_shard) for the all_to_all exchange. */
   Engine *e = self->eng;
   size_t n = e->round_outbox.size();
-  std::vector<int32_t> sn(n), dn(n);
+  std::vector<int32_t> sn(n), dn(n), dh(n);
   std::vector<int64_t> sh(n), ts(n);
   std::vector<uint32_t> ps(n);
   std::vector<uint8_t> ctl(n);
@@ -3140,14 +3142,16 @@ static PyObject *eng_export_round(EngineObj *self, PyObject *) {
     const RoundOut &o = e->round_outbox[i];
     sn[i] = e->host_node[o.src_host];
     dn[i] = e->host_node[o.dst_host];
+    dh[i] = o.dst_host;
     sh[i] = o.src_host;
     ps[i] = o.pkt_seq;
     ts[i] = o.t_send;
     ctl[i] = o.is_ctl;
   }
   return Py_BuildValue(
-      "y#y#y#y#y#y#", (const char *)sn.data(), (Py_ssize_t)(n * 4),
+      "y#y#y#y#y#y#y#", (const char *)sn.data(), (Py_ssize_t)(n * 4),
       (const char *)dn.data(), (Py_ssize_t)(n * 4),
+      (const char *)dh.data(), (Py_ssize_t)(n * 4),
       (const char *)sh.data(), (Py_ssize_t)(n * 8),
       (const char *)ps.data(), (Py_ssize_t)(n * 4),
       (const char *)ts.data(), (Py_ssize_t)(n * 8),
@@ -3510,6 +3514,27 @@ static PyObject *eng_sock_status(EngineObj *self, PyObject *args) {
   return PyLong_FromUnsignedLong(self->eng->sock(tok)->status);
 }
 
+static PyObject *eng_sock_inq(EngineObj *self, PyObject *args) {
+  /* FIONREAD/SIOCINQ, matching Linux and the object path
+   * (syscalls_native.sys_ioctl): TCP = in-order recv-buffer bytes;
+   * UDP = size of the NEXT pending datagram (udp.c
+   * first_packet_length), not the queue total. */
+  unsigned int tok;
+  if (!PyArg_ParseTuple(args, "I", &tok)) return nullptr;
+  SocketN *s = self->eng->sock(tok);
+  long long avail = 0;
+  if (s->proto == PROTO_TCP) {
+    TcpSocketN *t = static_cast<TcpSocketN *>(s);
+    if (t->conn) avail = t->conn->readable_bytes();
+  } else {
+    UdpSocketN *u = static_cast<UdpSocketN *>(s);
+    if (!u->recv_q.empty())
+      avail = (long long)self->eng->store.get(u->recv_q.front())
+                  ->payload.size();
+  }
+  return PyLong_FromLongLong(avail);
+}
+
 static PyObject *eng_sock_addr(EngineObj *self, PyObject *args) {
   unsigned int tok;
   if (!PyArg_ParseTuple(args, "I", &tok)) return nullptr;
@@ -3703,6 +3728,7 @@ static PyMethodDef eng_methods[] = {
      nullptr},
     {"tcp_bufs", (PyCFunction)eng_tcp_bufs, METH_VARARGS, nullptr},
     {"sock_status", (PyCFunction)eng_sock_status, METH_VARARGS, nullptr},
+    {"sock_inq", (PyCFunction)eng_sock_inq, METH_VARARGS, nullptr},
     {"sock_addr", (PyCFunction)eng_sock_addr, METH_VARARGS, nullptr},
     {"tcp_info", (PyCFunction)eng_tcp_info, METH_VARARGS, nullptr},
     {"drop_packet", (PyCFunction)eng_drop_packet, METH_VARARGS, nullptr},

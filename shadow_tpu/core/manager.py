@@ -178,8 +178,7 @@ class Manager:
         # propagator (cross-plane packet conversion).
         self.plane = None
         native_mode = config.experimental.native_dataplane
-        if sched == "tpu" and native_mode != "off" \
-                and config.experimental.tpu_shards == 1:
+        if sched == "tpu" and native_mode != "off":
             from shadow_tpu.native import plane as native_plane
             if native_plane.native_available():
                 self.plane = native_plane.NativePlane(self.hosts)
@@ -202,6 +201,7 @@ class Manager:
                 n_shards=config.experimental.tpu_shards,
                 exchange_capacity=config.experimental.tpu_exchange_capacity,
                 max_batch=config.experimental.tpu_max_packets_per_round,
+                min_device_batch=config.experimental.tpu_min_device_batch,
                 runahead=self.runahead)
         elif sched == "tpu":
             from shadow_tpu.ops.propagate import TpuPropagator

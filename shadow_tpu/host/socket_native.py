@@ -86,6 +86,11 @@ class _NativeSocket(StatusOwner):
     def apply_status(self, host, set_mask: int, clear_mask: int) -> None:
         self.adjust_status(host, set_mask, clear_mask)
 
+    def bytes_available(self) -> int:
+        """FIONREAD/SIOCINQ (glibc's resolver sizes its second DNS read
+        with this — zero here breaks name resolution)."""
+        return self.plane.engine.sock_inq(self.tok)
+
     def _refresh_addr(self) -> None:
         (hl, lip, lport), (hp_, pip, pport) = self.plane.engine.sock_addr(
             self.tok)

@@ -195,6 +195,11 @@ class TcpSocket(StatusOwner):
     def recvfrom(self, host, bufsize: int, peek: bool = False):
         return self.recv(host, bufsize, peek=peek), self.peer
 
+    def bytes_available(self) -> int:
+        """FIONREAD/SIOCINQ: in-order readable bytes (twin:
+        Engine sock_inq in native/netplane.cpp)."""
+        return self.conn.readable_bytes() if self.conn is not None else 0
+
     def recv(self, host, bufsize: int, peek: bool = False) -> bytes:
         conn = self._require_conn()
         if conn.readable_bytes() == 0:

@@ -1371,7 +1371,10 @@ class NativeSyscallHandler:
             elif hasattr(file, "bytes_available"):
                 avail = file.bytes_available()
             elif hasattr(file, "_recv_q"):
-                avail = sum(len(p.payload) for p in file._recv_q)
+                # UDP SIOCINQ: size of the NEXT pending datagram (Linux
+                # udp.c first_packet_length), not the queue total.
+                q = file._recv_q
+                avail = len(q[0].payload) if q else 0
             process.mem.write(argp, struct.pack("<i", avail))
             return _done(0)
         return _error(errno.ENOTTY)

@@ -74,6 +74,123 @@ def _bucket(n: int) -> int:
     return b
 
 
+def deliver_to_host(dst_host, t: int, src_id: int, seq: int, pkt) -> None:
+    """Deliver a kept object-path packet to its destination on either
+    plane: engine hosts get the packet interned into the native store
+    and pushed into the engine inbox; object-path hosts get a Python
+    packet event.  The single definition keeps the byte-identical-trace
+    contract in one place."""
+    if dst_host.plane is not None:
+        pid = _intern_python_packet(dst_host.plane, pkt)
+        dst_host.plane.engine.push_inbox(dst_host.id, t, src_id, seq, pid)
+    else:
+        pkt.arrival_time = t
+        dst_host.deliver_packet_event(Event(t, KIND_PACKET, src_id, seq, pkt))
+
+
+def deliver_engine_exports(hosts, exports) -> None:
+    """Engine-origin packets whose destination host runs the object
+    path (mixed sims): materialize and deliver as Python events."""
+    for pkt_id, dst, evt_seq, t, src in exports:
+        plane = hosts[src].plane
+        p = _export_native_packet(plane, pkt_id)
+        p.arrival_time = t
+        hosts[dst].deliver_packet_event(Event(t, KIND_PACKET, src,
+                                              evt_seq, p))
+
+
+class DeviceRouteModel:
+    """Online device-vs-host dispatch routing.
+
+    Both paths produce bit-identical decisions (same integer matrices,
+    same threefry bits), so routing is purely a performance choice —
+    and device latency varies wildly between a local chip and a
+    tunnelled one, so measure, don't guess.  EWMA ns/packet for the
+    host path, EWMA ns/dispatch per bucket size for the device; when
+    the device is losing at a size, re-probe with exponential backoff
+    (a catastrophic loss jumps straight to the cap: over a tunnel every
+    probe costs a ~100ms round trip).
+    """
+
+    # Initial re-probe cadence at a bucket size the model routes to the
+    # host path (keeps the model honest if device latency improves
+    # mid-run, e.g. a tunnel warming up).
+    REPROBE_EVERY = 64
+    REPROBE_CAP = 4096
+
+    def __init__(self, min_device_batch: int):
+        self.min_device_batch = min_device_batch
+        self.host_ns_per_pkt: float | None = None
+        self._dev_ns_by_bucket: dict[int, float] = {}
+        self._probe_countdown: dict[int, int] = {}
+        self._probe_interval: dict[int, int] = {}
+        self._compiled: set[int] = set()
+
+    def use_device(self, n: int, b: int) -> bool:
+        """Routing choice for a round of n packets at bucket size b.
+        Probe order: host first (cheap, bounded ~µs/packet — also the
+        only way to ever measure it when all rounds are large), then
+        device, then compare."""
+        if self.min_device_batch <= 0:
+            return True  # forced-device mode (parity tests, audits)
+        if n < self.min_device_batch:
+            return False
+        if self.host_ns_per_pkt is None:
+            return False  # host probe
+        dev = self._dev_ns_by_bucket.get(b)
+        if dev is None:
+            return True  # device probe
+        if dev <= self.host_ns_per_pkt * n:
+            # Winning: fully reset the backoff (interval AND countdown —
+            # a stale countdown would defer the next losing-side probe
+            # by thousands of rounds).
+            self._probe_interval.pop(b, None)
+            self._probe_countdown.pop(b, None)
+            return True
+        # Device currently losing at this size: re-probe with backoff.
+        interval = self._probe_interval.get(b, self.REPROBE_EVERY)
+        left = self._probe_countdown.get(b, interval) - 1
+        if left <= 0:
+            nxt = (self.REPROBE_CAP
+                   if dev > 16 * self.host_ns_per_pkt * n
+                   else min(interval * 2, self.REPROBE_CAP))
+            self._probe_interval[b] = nxt
+            self._probe_countdown[b] = nxt
+            return True
+        self._probe_countdown[b] = left
+        return False
+
+    def record_device(self, b: int, dt_ns: float, n: int,
+                      fresh_compile: bool | None = None) -> None:
+        """Record a measured device dispatch.  A dispatch that paid a
+        one-time XLA compile must not be recorded — it would poison the
+        estimate for thousands of rounds.  By default that is detected
+        by the first-use of bucket `b`; callers whose compiled shapes
+        are NOT keyed by `b` (the sharded step compiles per chunk
+        bucket) pass `fresh_compile` explicitly."""
+        if fresh_compile is None:
+            fresh_compile = b not in self._compiled
+        if b not in self._compiled:
+            self._compiled.add(b)
+        if fresh_compile:
+            return
+        prev = self._dev_ns_by_bucket.get(b)
+        host = self.host_ns_per_pkt
+        if prev is None or (host is not None and prev > host * n):
+            # First real sample, or a re-probe while routed away from
+            # the device: trust the fresh measurement over the stale
+            # average so recovery is immediate.
+            self._dev_ns_by_bucket[b] = dt_ns
+        else:
+            self._dev_ns_by_bucket[b] = 0.7 * prev + 0.3 * dt_ns
+
+    def record_host(self, dt_ns: float, n: int) -> None:
+        per_pkt = dt_ns / max(n, 1)
+        prev = self.host_ns_per_pkt
+        self.host_ns_per_pkt = per_pkt if prev is None \
+            else 0.7 * prev + 0.3 * per_pkt
+
+
 def build_propagate_kernel(latency_ns: np.ndarray, thresholds: np.ndarray,
                            k0: int, k1: int):
     """Returns a jitted fn(src_node, dst_node, src_host, pkt_seq, t_send,
@@ -132,14 +249,12 @@ class TpuPropagator:
         self._thr_np = np.asarray(loss_thresholds, dtype=np.int64)
         self.bootstrap_end = bootstrap_end_ns
         self.max_batch = max_batch
-        # Rounds smaller than this always run the same integer math on the
-        # host CPU (numpy threefry — bit-identical to the device kernel by
-        # construction) instead of paying a device dispatch round trip.
-        # Above it, an online cost model decides: both paths produce
-        # identical bits, so routing is purely a performance choice, and
-        # device latency varies wildly between a local chip and a
-        # tunneled one — measure, don't guess.
-        self.min_device_batch = min_device_batch
+        # Rounds smaller than min_device_batch always run the same
+        # integer math on the host CPU (numpy threefry — bit-identical
+        # to the device kernel by construction) instead of paying a
+        # device dispatch round trip.  Above it, the online cost model
+        # decides (DeviceRouteModel).
+        self.route = DeviceRouteModel(min_device_batch)
         self.runahead = runahead
         self.window_end = 0
         self.engine = None  # native plane engine (set by the Manager)
@@ -149,13 +264,10 @@ class TpuPropagator:
         self._outbox: list = []
         self.rounds_dispatched = 0
         self.packets_batched = 0
-        # Online cost model: EWMA ns/packet for the numpy-host path and
-        # EWMA ns/dispatch for the device at each bucket size.
-        self._host_ns_per_pkt = None
-        self._dev_ns_by_bucket: dict[int, float] = {}
-        self._dev_probe_countdown: dict[int, int] = {}
-        self._dev_probe_interval: dict[int, int] = {}  # backoff per bucket
-        self._dev_compiled: set[int] = set()
+        # Auditability (VERDICT r3): how much propagation actually ran
+        # on the accelerator vs the bit-identical host path.
+        self.rounds_device = 0
+        self.packets_device = 0
 
     def begin_round(self, window_start: int, window_end: int) -> None:
         self.window_end = window_end
@@ -205,24 +317,14 @@ class TpuPropagator:
         eng = self.engine
         b = _bucket(n)
         t0 = _time.perf_counter_ns()
-        if self._use_device(n, b):
+        if self.route.use_device(n, b):
             md, ml, exports = self._engine_device_round(n, b)
-            dt = _time.perf_counter_ns() - t0
-            if b not in self._dev_compiled:
-                self._dev_compiled.add(b)
-            else:
-                prev = self._dev_ns_by_bucket.get(b)
-                host = self._host_ns_per_pkt
-                if prev is None or (host is not None and prev > host * n):
-                    self._dev_ns_by_bucket[b] = dt
-                else:
-                    self._dev_ns_by_bucket[b] = 0.7 * prev + 0.3 * dt
+            self.route.record_device(b, _time.perf_counter_ns() - t0, n)
+            self.rounds_device += 1
+            self.packets_device += n
         else:
             _nf, md, ml, exports = eng.finish_round(self.window_end)
-            dt = (_time.perf_counter_ns() - t0) / n
-            prev = self._host_ns_per_pkt
-            self._host_ns_per_pkt = dt if prev is None \
-                else 0.7 * prev + 0.3 * dt
+            self.route.record_host(_time.perf_counter_ns() - t0, n)
         self.rounds_dispatched += 1
         if exports is not None:
             self._deliver_exports(exports)
@@ -235,7 +337,7 @@ class TpuPropagator:
         import jax.numpy as jnp
 
         eng = self.engine
-        sn_b, dn_b, sh_b, ps_b, ts_b, ctl_b = eng.export_round()
+        sn_b, dn_b, _dh_b, sh_b, ps_b, ts_b, ctl_b = eng.export_round()
 
         def pad(buf, dtype, width):
             col = np.frombuffer(buf, dtype=dtype)
@@ -258,61 +360,7 @@ class TpuPropagator:
         return int(md), int(ml), exports
 
     def _deliver_exports(self, exports) -> None:
-        """Engine-origin packets whose destination host runs the object
-        path (mixed sims): materialize and deliver as Python events."""
-        for pkt_id, dst_host, evt_seq, deliver, src in exports:
-            plane = self.hosts[src].plane
-            p = _export_native_packet(plane, pkt_id)
-            p.arrival_time = deliver
-            self.hosts[dst_host].deliver_packet_event(
-                Event(deliver, KIND_PACKET, src, evt_seq, p))
-
-    # Initial re-probe cadence at a bucket size the cost model routes
-    # to the host path (keeps the model honest if device latency
-    # improves mid-run, e.g. a tunnel warming up).  Each losing
-    # re-probe doubles the interval up to the cap: over a tunnelled
-    # device every probe costs a round trip, and a persistently-losing
-    # device should not tax thousands of rounds at a fixed cadence.
-    _DEV_REPROBE_EVERY = 64
-    _DEV_REPROBE_CAP = 4096
-
-    def _use_device(self, n: int, b: int) -> bool:
-        """Online routing choice: both paths are bit-identical, so pick
-        the one the measured cost model says is cheaper for this size.
-        Probe order: host first (cheap, bounded ~µs/packet — also the
-        only way to ever measure it when all rounds are large), then
-        device, then compare."""
-        if self.min_device_batch <= 0:
-            return True  # forced-device mode (parity tests, debugging)
-        if n < self.min_device_batch:
-            return False
-        if self._host_ns_per_pkt is None:
-            return False  # host probe
-        dev = self._dev_ns_by_bucket.get(b)
-        if dev is None:
-            return True  # device probe
-        if dev <= self._host_ns_per_pkt * n:
-            # Winning: fully reset the backoff (interval AND countdown —
-            # a stale countdown would defer the next losing-side probe
-            # by thousands of rounds).
-            self._dev_probe_interval.pop(b, None)
-            self._dev_probe_countdown.pop(b, None)
-            return True
-        # Device currently losing at this size: re-probe with backoff.
-        # A catastrophic loss (tunnelled device: ~100ms+ round trips vs
-        # ~ms of numpy) jumps straight to the cap — every probe costs a
-        # full round trip, and 16x slower does not drift back to parity.
-        interval = self._dev_probe_interval.get(b, self._DEV_REPROBE_EVERY)
-        left = self._dev_probe_countdown.get(b, interval) - 1
-        if left <= 0:
-            nxt = (self._DEV_REPROBE_CAP
-                   if dev > 16 * self._host_ns_per_pkt * n
-                   else min(interval * 2, self._DEV_REPROBE_CAP))
-            self._dev_probe_interval[b] = nxt
-            self._dev_probe_countdown[b] = nxt
-            return True
-        self._dev_probe_countdown[b] = left
-        return False
+        deliver_engine_exports(self.hosts, exports)
 
     def _dispatch_chunk(self, lo: int, hi: int):
         import time as _time
@@ -320,32 +368,16 @@ class TpuPropagator:
         n = hi - lo
         b = _bucket(n)
         t0 = _time.perf_counter_ns()
-        if self._use_device(n, b):
+        if self.route.use_device(n, b):
             deliver, keep, reachable, lossy, min_deliver, min_latency = \
                 self._compute_device(lo, hi, b)
-            dt = _time.perf_counter_ns() - t0
-            if b not in self._dev_compiled:
-                # First dispatch at this bucket size pays one-time JIT
-                # compilation; recording it would poison the estimate
-                # for thousands of rounds.
-                self._dev_compiled.add(b)
-            else:
-                prev = self._dev_ns_by_bucket.get(b)
-                host = self._host_ns_per_pkt
-                if prev is None or (host is not None and prev > host * n):
-                    # First real sample, or a re-probe while routed away
-                    # from the device: trust the fresh measurement over
-                    # the stale average so recovery is immediate.
-                    self._dev_ns_by_bucket[b] = dt
-                else:
-                    self._dev_ns_by_bucket[b] = 0.7 * prev + 0.3 * dt
+            self.route.record_device(b, _time.perf_counter_ns() - t0, n)
+            self.rounds_device += 1
+            self.packets_device += n
         else:
             deliver, keep, reachable, lossy, min_deliver, min_latency = \
                 self._compute_host(lo, hi)
-            dt = (_time.perf_counter_ns() - t0) / n
-            prev = self._host_ns_per_pkt
-            self._host_ns_per_pkt = dt if prev is None \
-                else 0.7 * prev + 0.3 * dt
+            self.route.record_host(_time.perf_counter_ns() - t0, n)
         self.rounds_dispatched += 1
 
         # Scatter (outbox order => per-source event order is preserved).
@@ -358,17 +390,8 @@ class TpuPropagator:
             src_host, dst_host, seq, packet, _pseq, t_send, _ = \
                 outbox[lo + i]
             if keep_l[i]:
-                t = deliver_l[i]
-                if dst_host.plane is not None:
-                    # Object-path origin, engine destination: intern the
-                    # packet into the store and ride the engine inbox.
-                    pid = _intern_python_packet(dst_host.plane, packet)
-                    dst_host.plane.engine.push_inbox(
-                        dst_host.id, t, src_host.id, seq, pid)
-                else:
-                    packet.arrival_time = t
-                    dst_host.deliver_packet_event(
-                        Event(t, KIND_PACKET, src_host.id, seq, packet))
+                deliver_to_host(dst_host, deliver_l[i], src_host.id, seq,
+                                packet)
             elif not reachable[i]:
                 src_host.trace_drop(packet, "unreachable", at_time=t_send)
             elif lossy[i]:

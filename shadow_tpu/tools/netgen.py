@@ -84,7 +84,8 @@ def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
                   count: int = 6, size: int = 600, stop_time: str = "10s",
                   seed: int = 1, scheduler: str = "serial",
                   experimental_extra: dict | None = None,
-                  gml: str | None = None) -> str:
+                  gml: str | None = None, pcap_hosts: int = 0,
+                  data_directory: str | None = None) -> str:
     """N-host UDP traffic mesh: every host runs one udp-sink (runs until
     sim end) and `floods_per_host` udp-flood senders at staggered starts.
     Final process states are loss-independent (floods always exit 0), so
@@ -111,10 +112,13 @@ def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
                 f'      - {{ path: udp-flood, '
                 f'args: [{peer}, "9000", "{count}", "{size}"], '
                 f'start_time: {start_ms} ms }}')
+        pcap = ("    pcap_enabled: true\n" if i < pcap_hosts else "")
         host_blocks.append(
-            f"  {name}:\n    network_node_id: {i % n_nodes}\n"
+            f"  {name}:\n    network_node_id: {i % n_nodes}\n" + pcap +
             f"    processes:\n" + "\n".join(procs))
-    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+    datadir = (f', data_directory: "{data_directory}"'
+               if data_directory else "")
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed}{datadir} }}\n"
             f"network:\n  graph:\n    type: gml\n    inline: |\n"
             f"{_indent(gml, '      ')}\n"
             f"experimental:\n" + "\n".join(exp_lines) + "\n"

@@ -27,7 +27,10 @@ def run(scheduler, **extra):
 
 def test_mesh_1k_hosts_trace_byte_identical():
     m_ser, s_ser = run("serial")
-    m_mesh, s_mesh = run("tpu", tpu_shards=8)
+    # Forced-device: the exchange assertion below is the point of this
+    # test; the cost model would route engine rounds to the C++ twin
+    # on a virtual CPU mesh.
+    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_min_device_batch=0)
     assert s_ser.ok and s_mesh.ok
     prop = m_mesh.propagator
     assert isinstance(prop, MeshPropagator)

@@ -27,7 +27,10 @@ def run(scheduler, n_hosts=24, seed=3, **extra):
 
 def test_mesh_sim_trace_byte_identical_to_serial():
     m_cpu, s_cpu = run("serial")
-    m_mesh, s_mesh = run("tpu", tpu_shards=8)
+    # Force every round through the device step (the cost model would
+    # otherwise route engine rounds to the bit-identical C++ twin on a
+    # virtual CPU mesh, where a device dispatch always loses).
+    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_min_device_batch=0)
     assert s_cpu.ok and s_mesh.ok
     assert isinstance(m_mesh.propagator, MeshPropagator)
     # The exchange really carried packets between shards.
@@ -56,7 +59,8 @@ def test_mesh_overflow_fallback_delivers():
     overflow path; delivery and the trace must be unaffected (VERDICT
     round-1: overflow flag was never consumed by an integration)."""
     m_cpu, _ = run("serial")
-    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_exchange_capacity=1)
+    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_exchange_capacity=1,
+                         tpu_min_device_batch=0)
     assert s_mesh.ok
     assert m_mesh.propagator.packets_overflowed > 0
     assert m_mesh.propagator.packets_exchanged > 0  # capacity still used
@@ -67,8 +71,9 @@ def test_mesh_chunked_dispatch():
     """tpu_max_packets_per_round bounds one dispatch; oversized rounds
     split into ordered column chunks with the trace unchanged."""
     m_cpu, _ = run("serial")
-    m_full, _ = run("tpu", tpu_shards=8)
-    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_max_packets_per_round=16)
+    m_full, _ = run("tpu", tpu_shards=8, tpu_min_device_batch=0)
+    m_mesh, s_mesh = run("tpu", tpu_shards=8, tpu_max_packets_per_round=16,
+                         tpu_min_device_batch=0)
     assert s_mesh.ok
     assert m_mesh.propagator.max_shard_batch == 2
     # Same rounds, strictly more dispatches = chunking actually happened.
@@ -150,3 +155,55 @@ hosts:
     assert summary.ok, summary.plugin_errors
     assert isinstance(manager.propagator, MeshPropagator)
     assert os.path.getsize(out) == 40000
+
+
+def test_mesh_engine_fusion_participates():
+    """tpu_shards>1 no longer excludes the C++ engine (VERDICT r3 item
+    1): engine-resident hosts batch their sends engine-side and those
+    columns ride the same sharded SPMD step (all_to_all + pmin) as the
+    object path's."""
+    m_mesh, s_mesh = run("tpu", tpu_shards=8)
+    assert s_mesh.ok
+    if m_mesh.plane is None:  # no C++ toolchain in this env
+        import pytest
+        pytest.skip("native plane unavailable")
+    prop = m_mesh.propagator
+    assert prop.packets_engine > 0
+    # This workload is pure engine apps: every batched packet must have
+    # come off the engine, none through the per-packet Python outbox.
+    assert prop.packets_engine == prop.packets_batched
+    # Default cost model on a virtual CPU mesh routes engine rounds to
+    # the bit-identical C++ twin; forced-device must push those same
+    # engine columns through the sharded SPMD step itself.
+    m_dev, s_dev = run("tpu", tpu_shards=8, tpu_min_device_batch=0)
+    assert s_dev.ok
+    dprop = m_dev.propagator
+    assert dprop.packets_engine > 0
+    assert dprop.rounds_device > 0, "engine columns never rode the step"
+    assert dprop.rounds_device == dprop.rounds_dispatched
+    assert m_dev.trace_lines() == m_mesh.trace_lines()
+
+
+def test_mesh_mixed_planes_byte_identical(tmp_path):
+    """Cross-plane traffic under the sharded backend: pcap hosts stay
+    on the Python object path while the rest run engine-side, so
+    deliveries cross in BOTH directions (engine exports -> object
+    events; object packets interned -> engine inboxes) and the trace
+    must stay byte-identical to serial."""
+    text = udp_mesh_yaml(24, n_nodes=6, floods_per_host=2, count=4,
+                         size=500, stop_time="8s", seed=3,
+                         scheduler="tpu",
+                         experimental_extra={"tpu_shards": 8},
+                         pcap_hosts=2,
+                         data_directory=str(tmp_path / "mesh-data"))
+    cfg = ConfigOptions.from_yaml_text(text)
+    m_mesh, s_mesh = run_simulation(cfg)
+    assert s_mesh.ok
+    m_cpu, s_cpu = run("serial")
+    assert s_cpu.ok
+    if m_mesh.plane is not None:
+        # Both planes really participated.
+        assert m_mesh.propagator.packets_engine > 0
+        assert (m_mesh.propagator.packets_batched
+                > m_mesh.propagator.packets_engine)
+    assert m_cpu.trace_lines() == m_mesh.trace_lines()

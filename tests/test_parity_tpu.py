@@ -83,9 +83,11 @@ def test_device_kernel_trace_byte_identical_to_serial():
     m_dev, s_dev = run("tpu", min_device_batch=0)
     assert s_cpu.ok and s_dev.ok
     # Every dispatched chunk must actually have hit the device kernel.
-    assert m_dev.propagator._dev_compiled, "device kernel never ran"
-    assert m_dev.propagator._host_ns_per_pkt is None, \
+    assert m_dev.propagator.rounds_device > 0, "device kernel never ran"
+    assert m_dev.propagator.route.host_ns_per_pkt is None, \
         "a chunk leaked onto the numpy host path"
+    assert (m_dev.propagator.rounds_device
+            == m_dev.propagator.rounds_dispatched)
     assert m_cpu.trace_lines() == m_dev.trace_lines()
     assert s_cpu.packets_dropped == s_dev.packets_dropped
 
