@@ -118,7 +118,8 @@ def config3(scheduler: str):
         "hosts": hosts})
 
 
-def config_10k(scheduler: str):
+def config_10k(scheduler: str, stop_s: int = SIM_SECONDS_10K,
+               **exp_extra):
     """BASELINE config 4 shape: 10k hosts, tornettools-ish tiers (5%
     relay servers on the core, clients behind lossy mid/leaf edges)."""
     from shadow_tpu.core.config import ConfigOptions
@@ -143,10 +144,12 @@ def config_10k(scheduler: str):
                 "expected_final_state": "any",
             }],
         }
+    exp = {"scheduler": scheduler}
+    exp.update(exp_extra)
     return ConfigOptions.from_dict({
-        "general": {"stop_time": f"{SIM_SECONDS_10K}s", "seed": 7},
+        "general": {"stop_time": f"{stop_s}s", "seed": 7},
         "network": {"graph": {"type": "gml", "inline": THREE_TIER_GML}},
-        "experimental": {"scheduler": scheduler},
+        "experimental": exp,
         "hosts": hosts})
 
 
@@ -176,7 +179,7 @@ graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
         "hosts": hosts})
 
 
-def run_once(build, scheduler: str):
+def run_once(build, scheduler: str, report_routes: str | None = None):
     from shadow_tpu.core.manager import Manager
 
     manager = Manager(build(scheduler))
@@ -185,16 +188,35 @@ def run_once(build, scheduler: str):
     t0 = time.perf_counter()
     summary = manager.run()
     wall = time.perf_counter() - t0
+    if report_routes is not None:
+        print(f"bench[{report_routes}]: {route_split(manager)}",
+              file=sys.stderr)
     return summary, wall
 
 
-def run_best(build, scheduler: str, trials: int = 2):
+def route_split(manager) -> str:
+    """Device-vs-host dispatch split (VERDICT r3: make the accelerator
+    claim auditable — how much propagation actually ran on the device
+    vs the bit-identical host/C++ path)."""
+    prop = manager.propagator
+    rd = getattr(prop, "rounds_device", 0)
+    pd = getattr(prop, "packets_device", 0)
+    tot_r = getattr(prop, "rounds_dispatched", 0)
+    tot_p = getattr(prop, "packets_batched", 0)
+    return (f"dispatch split: {rd}/{tot_r} rounds on device, "
+            f"{pd}/{tot_p} packets on device "
+            f"({100.0 * pd / tot_p if tot_p else 0.0:.1f}%)")
+
+
+def run_best(build, scheduler: str, trials: int = 2,
+             report_routes: str | None = None):
     """Best-of-N wall time: machine noise (co-tenants, allocator state)
     swings single runs by 10-20%, which would dominate the recorded
     ratio."""
     best_summary, best_wall = None, None
     for _ in range(trials):
-        summary, wall = run_once(build, scheduler)
+        summary, wall = run_once(build, scheduler,
+                                 report_routes=report_routes)
         if best_wall is None or wall < best_wall:
             best_summary, best_wall = summary, wall
     return best_summary, best_wall
@@ -202,6 +224,13 @@ def run_best(build, scheduler: str, trials: int = 2):
 
 def main() -> None:
     if not tpu_available():
+        # 8 virtual CPU devices so the sharded rung below can run even
+        # when the accelerator is down (must be set before the first
+        # backend init in this process).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
         from shadow_tpu.utils.platform import force_cpu
         force_cpu()
         print("bench: accelerator unavailable; kernel on CPU backend",
@@ -231,10 +260,52 @@ def main() -> None:
           f"{base1k_wall / tpu1k_wall:.3f}", file=sys.stderr)
 
     # Headline: the 10k-host Tor-class ladder rung (BASELINE config 4).
+    # TWO baselines (VERDICT r3): the reference-faithful pure-Python
+    # thread_per_core (GIL-bound — overstates the win), and the HONEST
+    # engine-backed thread_per_core (real OS threads over C++ engine
+    # hosts, no GIL in the hot loop) — the recorded vs_baseline.
     # thread_per_core at this scale runs once (minutes); the tpu run is
     # best-of-two after the 1k warmup primed the kernels.
     base_summary, base_wall = run_once(config_10k, "thread_per_core")
-    tpu_summary, tpu_wall = run_best(config_10k, "tpu")
+    baseE_summary, baseE_wall = run_once(
+        lambda s: config_10k(s, native_dataplane="on"), "thread_per_core")
+    tpu_summary, tpu_wall = run_best(config_10k, "tpu",
+                                     report_routes="10k")
+    assert baseE_summary.packets_sent == base_summary.packets_sent, \
+        "engine baseline disagreed on workload size"
+    print(f"bench[10k-baselines]: thread_per_core python "
+          f"{base_summary.busy_end_ns / 1e9 / base_wall:.3f} sim-s/wall-s "
+          f"({base_wall:.1f}s), thread_per_core engine "
+          f"{baseE_summary.busy_end_ns / 1e9 / baseE_wall:.3f} sim-s/wall-s "
+          f"({baseE_wall:.1f}s)", file=sys.stderr)
+
+    # Forced-device audit rung: every propagation round through the
+    # jitted device kernel (tpu_min_device_batch=0), short window — on
+    # a tunnelled chip each dispatch pays a full round trip, and this
+    # number shows what the accelerator itself delivers vs the cost
+    # model's blended route above.
+    fd_summary, fd_wall = run_once(
+        lambda s: config_10k(s, stop_s=2, tpu_min_device_batch=0),
+        "tpu", report_routes="10k-forced-device")
+    print(f"bench[10k-forced-device]: {fd_summary.packets_sent} packets "
+          f"in {fd_wall:.1f}s wall over {fd_summary.busy_end_ns / 1e9:.2f} "
+          f"sim-s = {fd_summary.busy_end_ns / 1e9 / fd_wall:.3f} "
+          f"sim-s/wall-s (2 sim-s window)", file=sys.stderr)
+
+    # Sharded rung: the same 10k workload over an 8-shard host mesh
+    # (engine-fused MeshPropagator; trace byte-identity vs serial is
+    # gated in tests/ and was verified at this scale by SHA-256).
+    import jax
+    if len(jax.devices()) >= 8:
+        sh_summary, sh_wall = run_once(
+            lambda s: config_10k(s, tpu_shards=8), "tpu",
+            report_routes="10k-sharded")
+        print(f"bench[10k-sharded]: {sh_summary.packets_sent} packets, "
+              f"{sh_summary.busy_end_ns / 1e9 / sh_wall:.3f} sim-s/wall-s "
+              f"({sh_wall:.1f}s wall, tpu_shards=8)", file=sys.stderr)
+    else:
+        print(f"bench[10k-sharded]: skipped (needs 8 devices, have "
+              f"{len(jax.devices())})", file=sys.stderr)
 
     assert tpu_summary.packets_sent == base_summary.packets_sent, \
         "schedulers disagreed on workload size"
@@ -248,16 +319,18 @@ def main() -> None:
     sim_per_wall = sim_seconds / tpu_wall
     print(f"bench[10k]: {tpu_summary.packets_sent} packets, tpu "
           f"{tpu_summary.packets_sent / tpu_wall:.0f} pkts/s "
-          f"({tpu_wall:.1f}s wall), thread_per_core "
-          f"{base_summary.packets_sent / base_wall:.0f} pkts/s "
-          f"({base_wall:.1f}s wall)", file=sys.stderr)
+          f"({tpu_wall:.1f}s wall), ratio vs python thread_per_core "
+          f"{base_wall / tpu_wall:.2f}x, vs ENGINE thread_per_core "
+          f"{baseE_wall / tpu_wall:.2f}x", file=sys.stderr)
 
     print(json.dumps({
         "metric": f"sim-seconds/wallclock-sec, {HOSTS_10K}-host Tor-class "
-                  f"tgen TCP (scheduler=tpu vs thread_per_core)",
+                  f"tgen TCP (scheduler=tpu vs engine-backed "
+                  f"thread_per_core; python-baseline ratio "
+                  f"{round(base_wall / tpu_wall, 2)}x on stderr)",
         "value": round(sim_per_wall, 3),
         "unit": "sim-s/wall-s",
-        "vs_baseline": round(base_wall / tpu_wall, 3),
+        "vs_baseline": round(baseE_wall / tpu_wall, 3),
     }))
 
 

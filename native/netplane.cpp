@@ -37,17 +37,51 @@
 
 #include <algorithm>
 #include <array>
+#include <atomic>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+
+/* Append-only storage with stable element addresses and lock-free
+ * reads, for state shared across the run_hosts_mt worker threads
+ * (packet slots, sockets, apps).  Elements live in fixed 4096-slot
+ * chunks; the chunk-pointer table is preallocated so readers never
+ * observe a moving array.  Appends serialize on a mutex (rare relative
+ * to reads); readers may index any published slot without
+ * synchronization — size() uses acquire ordering, so an index a thread
+ * legitimately holds implies a constructed element. */
+template <typename T>
+struct StableVec {
+  static constexpr size_t CB = 12, CHUNK = (size_t)1 << CB,
+                          MAXC = (size_t)1 << 15;  // 128M elements
+  std::unique_ptr<std::unique_ptr<T[]>[]> chunks{
+      new std::unique_ptr<T[]>[MAXC]};
+  std::atomic<size_t> count{0};
+  std::mutex mu;
+
+  size_t size() const { return count.load(std::memory_order_acquire); }
+  T &operator[](size_t i) { return chunks[i >> CB][i & (CHUNK - 1)]; }
+  T &back() { return (*this)[size() - 1]; }
+  size_t append() {  // default-construct one element; returns its index
+    std::lock_guard<std::mutex> g(mu);
+    size_t i = count.load(std::memory_order_relaxed);
+    if (i / CHUNK >= MAXC) std::abort();  // 128M elements: config error
+    if ((i & (CHUNK - 1)) == 0) chunks[i >> CB].reset(new T[CHUNK]());
+    count.store(i + 1, std::memory_order_release);
+    return i;
+  }
+};
 
 /* ---------------- constants (mirror the Python modules) ----------- */
 
@@ -198,13 +232,26 @@ struct PacketN {
  * id = gen<<32 | slot.  Single-owner lifecycle — freed at terminal
  * points (payload consumed / packet dropped). */
 struct PacketStore {
-  std::vector<PacketN> slots;
+  /* Thread-safety contract (run_hosts_mt): alloc/free serialize on
+   * `mu`; get() is lock-free — a packet id is only ever held by the
+   * one thread running its owner host within a round (cross-host
+   * handoff happens in the single-threaded propagation phase), and
+   * slot reuse is published through the mutex. */
+  StableVec<PacketN> slots;
   std::vector<uint32_t> free_list;
+  std::mutex mu;
 
   uint64_t alloc() {
     uint32_t slot;
-    if (!free_list.empty()) { slot = free_list.back(); free_list.pop_back(); }
-    else { slot = (uint32_t)slots.size(); slots.emplace_back(); }
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (!free_list.empty()) {
+        slot = free_list.back();
+        free_list.pop_back();
+      } else {
+        slot = (uint32_t)slots.append();
+      }
+    }
     PacketN &p = slots[slot];
     p.live = true;
     return ((uint64_t)p.gen << 32) | slot;
@@ -225,6 +272,7 @@ struct PacketStore {
     p->payload.shrink_to_fit();
     p->has_tcp = false;
     p->tcp = TcpHdrN{};
+    std::lock_guard<std::mutex> g(mu);
     free_list.push_back((uint32_t)id);
   }
 };
@@ -1259,6 +1307,10 @@ struct HostPlane {
   std::vector<uint64_t> outgoing;  // legacy per-call drain (mixed paths)
   std::vector<TraceRec> trace;
   bool tracing = true;
+  /* Sticky: a Python-owned socket was ever created on this host.
+   * Such hosts may fire CB_STATUS/CB_CHILD callbacks mid-event, so
+   * run_hosts_mt keeps them on the GIL-held serial path. */
+  bool has_py_socks = false;
   int64_t pkts_sent = 0, pkts_recv = 0, pkts_dropped = 0;
   int64_t events_run = 0;
   int64_t app_sys[ASYS_N] = {0};  // engine-app syscall counters
@@ -1342,11 +1394,16 @@ struct RoundOut {
   bool is_ctl;
 };
 
+/* Per-worker cross-host outbox for run_hosts_mt: when set, device_push
+ * buffers sends here instead of the engine's shared round_outbox (the
+ * vectors merge, in block order, after the parallel section). */
+thread_local std::vector<RoundOut> *tl_round_outbox = nullptr;
+
 struct Engine {
   PacketStore store;
   std::vector<std::unique_ptr<HostPlane>> hosts;
-  std::vector<std::unique_ptr<SocketN>> socks;  // token -> socket
-  std::vector<AppN> apps;                       // engine-resident apps
+  StableVec<std::unique_ptr<SocketN>> socks;  // token -> socket
+  StableVec<AppN> apps;                       // engine-resident apps
   int dbg_port = -1;  // SHADOWTPU_TCPDBG, resolved once at construction
   Engine() {
     const char *dp = getenv("SHADOWTPU_TCPDBG");
@@ -1354,8 +1411,11 @@ struct Engine {
   }
   PyObject *cb_event = nullptr;  // (kind, host, tok, a, b, t)
   PyObject *cb_rng = nullptr;    // (host) -> u64
-  bool in_error = false;         // a callback raised; unwind
-  bool cb_fired = false;         // any event-callback ran (batch break)
+  /* atomic: run_hosts_mt workers reset/read these concurrently (for
+   * MT-eligible hosts they never become true — eligibility excludes
+   * every callback source). */
+  std::atomic<bool> in_error{false};  // a callback raised; unwind
+  std::atomic<bool> cb_fired{false};  // any event-callback ran
 
   /* Routing state (set_routing): the propagation phase twin of
    * ops/propagate.py's host/numpy path, bit-identical by construction
@@ -1495,9 +1555,10 @@ struct Engine {
         store.free_pkt(id);
         return;
       }
-      round_outbox.push_back({hp->id, it->second, hp->event_seq++, id,
-                              (uint32_t)(p->seq & 0xFFFFFFFF), now,
-                              p->is_empty_control()});
+      (tl_round_outbox ? *tl_round_outbox : round_outbox)
+          .push_back({hp->id, it->second, hp->event_seq++, id,
+                      (uint32_t)(p->seq & 0xFFFFFFFF), now,
+                      p->is_empty_control()});
       return;
     }
     iface_receive(hp, dev == 0 ? hp->lo : hp->eth, id, now);
@@ -1823,6 +1884,122 @@ struct Engine {
     return -1;
   }
 
+  /* Multithreaded batch round execution — the engine-backed
+   * thread_per_core scheduler's hot loop, and the honest baseline for
+   * the accelerator ratio (real OS threads over C++ hosts, no GIL).
+   * Only callback-free hosts may be listed (no Python-owned sockets,
+   * native RNG): within a round hosts are independent (cross-host
+   * sends buffer into per-thread outboxes, merged after the join;
+   * outbox order is not semantically load-bearing — deliveries land
+   * in per-host heaps keyed by (time, src, seq), and loss draws are
+   * counter-keyed), so per-host state is touched by exactly one
+   * thread.  Shared allocators (packet store, socket/app tables)
+   * serialize on their own mutexes with stable element addresses.
+   * Call WITHOUT the GIL held. */
+  int64_t mt_batches = 0;   // observability: parallel sections run
+  int64_t mt_hosts_run = 0; // observability: hosts executed MT
+
+  /* Persistent worker pool: run_hosts_mt fires once per scheduling
+   * round, and spawning/joining fresh threads each time would cost
+   * ~0.1-1ms/round — real money over thousands of rounds, and a skew
+   * on the honest-baseline ratio this path exists to make accurate.
+   * Workers park on a condition variable between rounds; work is
+   * published under mt_mu (gen bump) and completion is counted back
+   * down. */
+  std::vector<std::thread> mt_threads;
+  std::mutex mt_mu;
+  std::condition_variable mt_cv, mt_cv_done;
+  uint64_t mt_gen = 0;
+  int mt_active = 0;
+  bool mt_shutdown = false;
+  const uint32_t *mt_ids = nullptr;
+  int64_t mt_n = 0, mt_until = 0, mt_per = 0;
+  std::vector<std::vector<RoundOut>> mt_outs;
+
+  void mt_run_block(const uint32_t *ids, int64_t lo, int64_t hi,
+                    int64_t until, std::vector<RoundOut> *out) {
+    tl_round_outbox = out;
+    for (int64_t i = lo; i < hi; i++) {
+      int hid = (int)ids[i];
+      HostPlane *hp = plane(hid);
+      auto [cnt, last] = run_until(hid, until, 1, 0, 0, until);
+      hp->events_run += cnt;
+      (void)last;
+      if (nt && hid < nt_len) {
+        int64_t best = INT64_MAX;
+        if (!hp->inbox.empty()) best = hp->inbox.front().time;
+        if (!hp->theap.empty() && hp->theap.front().time < best)
+          best = hp->theap.front().time;
+        nt[hid] = best;
+      }
+    }
+    tl_round_outbox = nullptr;
+  }
+
+  void mt_worker(int t) {
+    uint64_t seen = 0;
+    for (;;) {
+      std::unique_lock<std::mutex> lk(mt_mu);
+      mt_cv.wait(lk, [&] { return mt_shutdown || mt_gen != seen; });
+      if (mt_shutdown) return;
+      seen = mt_gen;
+      lk.unlock();
+      int64_t lo = (int64_t)t * mt_per;
+      int64_t hi = std::min<int64_t>(mt_n, lo + mt_per);
+      if (lo < hi)
+        mt_run_block(mt_ids, lo, hi, mt_until,
+                     &mt_outs[(size_t)t]);
+      lk.lock();
+      if (--mt_active == 0) mt_cv_done.notify_all();
+    }
+  }
+
+  void run_hosts_mt(const uint32_t *ids, int64_t n, int64_t until,
+                    int nthreads) {
+    if (n == 0) return;
+    if (nthreads > (int)n) nthreads = (int)n;
+    if (nthreads < 1) nthreads = 1;
+    mt_batches++;
+    mt_hosts_run += n;
+    if (nthreads == 1) {
+      /* No point waking a pool; run inline (sends go straight to the
+       * shared round_outbox). */
+      mt_run_block(ids, 0, n, until, nullptr);
+      return;
+    }
+    while ((int)mt_threads.size() < nthreads) {
+      int t = (int)mt_threads.size();
+      mt_threads.emplace_back([this, t]() { mt_worker(t); });
+    }
+    {
+      std::lock_guard<std::mutex> g(mt_mu);
+      mt_ids = ids;
+      mt_n = n;
+      mt_until = until;
+      mt_per = (n + nthreads - 1) / nthreads;
+      mt_outs.clear();
+      mt_outs.resize(mt_threads.size());
+      mt_active = (int)mt_threads.size();
+      mt_gen++;
+    }
+    mt_cv.notify_all();
+    {
+      std::unique_lock<std::mutex> lk(mt_mu);
+      mt_cv_done.wait(lk, [&] { return mt_active == 0; });
+    }
+    for (auto &ob : mt_outs)
+      round_outbox.insert(round_outbox.end(), ob.begin(), ob.end());
+  }
+
+  ~Engine() {
+    {
+      std::lock_guard<std::mutex> g(mt_mu);
+      mt_shutdown = true;
+    }
+    mt_cv.notify_all();
+    for (auto &t : mt_threads) t.join();
+  }
+
   void push_inbox(int hid, int64_t time, int src, uint64_t seq,
                   uint64_t pkt) {
     HostPlane *hp = plane(hid);
@@ -1849,10 +2026,9 @@ struct Engine {
   int app_spawn(int hid, int kind, int64_t a, int64_t b, int64_t c,
                 int64_t d, int64_t e, int64_t sb, int64_t rb, int sat,
                 int rat, int64_t now) {
-    int aidx = (int)apps.size();
-    apps.emplace_back();
+    int aidx = (int)apps.append();
     {
-      AppN &ap = apps.back();
+      AppN &ap = apps[(size_t)aidx];
       ap.kind = kind;
       ap.hid = hid;
       ap.send_buf = sb;
@@ -1948,7 +2124,7 @@ struct Engine {
 
   void app_step_server(int aidx, int64_t now) {
     for (;;) {
-      AppN &a = apps[(size_t)aidx];  // re-fetch: loop body may realloc
+      AppN &a = apps[(size_t)aidx];
       HostPlane *hp = plane(a.hid);
       TcpSocketN *l = tcp((uint32_t)a.sock);
       asys(hp, ASYS_ACCEPT);
@@ -1959,10 +2135,9 @@ struct Engine {
        * same task the Python sys_spawn_thread schedules. */
       asys(hp, ASYS_SPAWN_THREAD);
       uint32_t ctok = (uint32_t)r;
-      int hidx = (int)apps.size();
       int hid = a.hid;
-      apps.emplace_back();  // may invalidate `a`
-      AppN &h = apps.back();
+      int hidx = (int)apps.append();  // stable storage: `a` stays valid
+      AppN &h = apps[(size_t)hidx];
       h.kind = APP_HANDLER;
       h.hid = hid;
       h.state = H_REQ;
@@ -2492,16 +2667,18 @@ struct Engine {
       trace_drop(hp, p, "accept-backlog-full", now);
       return false;
     }
-    /* spawn a child bound to the specific 4-tuple */
+    /* spawn a child bound to the specific 4-tuple.  The token slot is
+     * reserved up front (stable storage; a dup-SYN abort leaves a dead
+     * null slot, which every tok lookup already tolerates). */
     int ifidx = p->dst_ip == LOCALHOST_IP ? 0 : 1;
     IfaceN &ifc = iface_of(hp, ifidx);
+    uint32_t ctok = (uint32_t)socks.append();
     /* duplicate SYN? associate fails */
     if (!assoc_add(ifc, PROTO_TCP, p->dst_port, p->src_ip, p->src_port,
-                   (uint32_t)socks.size())) {
+                   ctok)) {
       trace_drop(hp, p, "tcp-dup-syn", now);
       return false;
     }
-    uint32_t ctok = (uint32_t)socks.size();
     auto child = std::make_unique<TcpSocketN>(
         hp->id, s->send_buf_max, s->recv_buf_max, s->send_autotune,
         s->recv_autotune);
@@ -2523,7 +2700,7 @@ struct Engine {
     if (dbg_port >= 0 && dbg_port == child->local_port)
       child->conn->dbg = true;
     child->conn->nodelay = s->nodelay;
-    socks.push_back(std::move(child));
+    socks[ctok] = std::move(child);
     TcpSocketN *cs = tcp(ctok);
     if (s->app_owner == -1)
       fire_event(CB_CHILD_BORN, hp->id, ltok, ctok, 0);
@@ -2568,15 +2745,15 @@ struct Engine {
   static constexpr int R_BLOCK = 1000000;  // proxy: park on a condition
 
   uint32_t new_tcp(int hid, int64_t sb, int64_t rb, bool sat, bool rat) {
-    uint32_t tok = (uint32_t)socks.size();
-    socks.push_back(std::make_unique<TcpSocketN>(hid, sb, rb, sat, rat));
-    socks.back()->tok = tok;
+    uint32_t tok = (uint32_t)socks.append();
+    socks[tok] = std::make_unique<TcpSocketN>(hid, sb, rb, sat, rat);
+    socks[tok]->tok = tok;
     return tok;
   }
   uint32_t new_udp(int hid, int64_t sb, int64_t rb) {
-    uint32_t tok = (uint32_t)socks.size();
-    socks.push_back(std::make_unique<UdpSocketN>(hid, sb, rb));
-    socks.back()->tok = tok;
+    uint32_t tok = (uint32_t)socks.append();
+    socks[tok] = std::make_unique<UdpSocketN>(hid, sb, rb);
+    socks[tok]->tok = tok;
     return tok;
   }
 
@@ -3037,6 +3214,44 @@ static PyObject *eng_run_hosts(EngineObj *self, PyObject *args) {
   return PyLong_FromLongLong((long long)stop);
 }
 
+static PyObject *eng_run_hosts_mt(EngineObj *self, PyObject *args) {
+  /* (ids u32[], until, nthreads) -> stop.  Callback-free hosts run on
+   * OS threads with the GIL released; the rest run serially under the
+   * GIL afterwards.  stop < 0: all done; else an index into `ids`
+   * such that re-executing ids[stop:] host-side finishes the batch
+   * (hosts already run re-execute as no-ops). */
+  Py_buffer ids;
+  long long until;
+  int nthreads;
+  if (!PyArg_ParseTuple(args, "y*Li", &ids, &until, &nthreads))
+    return nullptr;
+  Engine *e = self->eng;
+  int64_t n = (int64_t)(ids.len / 4);
+  const uint32_t *id32 = (const uint32_t *)ids.buf;
+  std::vector<uint32_t> mt, rest;
+  std::vector<int64_t> rest_pos;
+  mt.reserve((size_t)n);
+  for (int64_t i = 0; i < n; i++) {
+    HostPlane *hp = e->plane((int)id32[i]);
+    if (hp != nullptr && !hp->has_py_socks && hp->rng_native) {
+      mt.push_back(id32[i]);
+    } else {
+      rest.push_back(id32[i]);
+      rest_pos.push_back(i);
+    }
+  }
+  Py_BEGIN_ALLOW_THREADS
+  e->run_hosts_mt(mt.data(), (int64_t)mt.size(), until, nthreads);
+  Py_END_ALLOW_THREADS
+  int64_t stop = -1;
+  if (!rest.empty())
+    stop = e->run_hosts(rest.data(), (int64_t)rest.size(), until);
+  PyBuffer_Release(&ids);
+  CHECK_CB(self);
+  return PyLong_FromLongLong(
+      stop < 0 ? -1LL : (long long)rest_pos[(size_t)stop]);
+}
+
 static PyObject *eng_push_inbox(EngineObj *self, PyObject *args) {
   int hid, src;
   long long time;
@@ -3282,6 +3497,7 @@ static PyObject *eng_tcp_socket(EngineObj *self, PyObject *args) {
   long long sb, rb;
   if (!PyArg_ParseTuple(args, "iLLpp", &hid, &sb, &rb, &sat, &rat))
     return nullptr;
+  self->eng->plane(hid)->has_py_socks = true;  // keep off the MT path
   return PyLong_FromUnsignedLong(self->eng->new_tcp(hid, sb, rb, sat, rat));
 }
 
@@ -3289,6 +3505,7 @@ static PyObject *eng_udp_socket(EngineObj *self, PyObject *args) {
   int hid;
   long long sb, rb;
   if (!PyArg_ParseTuple(args, "iLL", &hid, &sb, &rb)) return nullptr;
+  self->eng->plane(hid)->has_py_socks = true;  // keep off the MT path
   return PyLong_FromUnsignedLong(self->eng->new_udp(hid, sb, rb));
 }
 
@@ -3680,6 +3897,11 @@ static PyObject *eng_counters(EngineObj *self, PyObject *args) {
                        (long long)hp->events_run);
 }
 
+static PyObject *eng_mt_stats(EngineObj *self, PyObject *) {
+  return Py_BuildValue("LL", (long long)self->eng->mt_batches,
+                       (long long)self->eng->mt_hosts_run);
+}
+
 static PyMethodDef eng_methods[] = {
     {"add_host", (PyCFunction)eng_add_host, METH_VARARGS, nullptr},
     {"set_callbacks", (PyCFunction)eng_set_callbacks, METH_VARARGS, nullptr},
@@ -3692,6 +3914,8 @@ static PyMethodDef eng_methods[] = {
     {"peek_next", (PyCFunction)eng_peek_next, METH_VARARGS, nullptr},
     {"run_until", (PyCFunction)eng_run_until, METH_VARARGS, nullptr},
     {"run_hosts", (PyCFunction)eng_run_hosts, METH_VARARGS, nullptr},
+    {"run_hosts_mt", (PyCFunction)eng_run_hosts_mt, METH_VARARGS, nullptr},
+    {"mt_stats", (PyCFunction)eng_mt_stats, METH_NOARGS, nullptr},
     {"set_host_rng", (PyCFunction)eng_set_host_rng, METH_VARARGS, nullptr},
     {"rng_next", (PyCFunction)eng_rng_next, METH_VARARGS, nullptr},
     {"push_inbox", (PyCFunction)eng_push_inbox, METH_VARARGS, nullptr},

@@ -86,6 +86,15 @@ static __thread uint32_t g_local_time_count
  * the one-outstanding-message channel protocol. */
 static __thread int g_in_shim
     __attribute__((tls_model("initial-exec"))) = 0;
+/* The kernel ucontext of the innermost trap frame (SIGSYS / SIGSEGV /
+ * SIGVTALRM) on this thread, or NULL outside any trap.  Emulated
+ * signal delivery copies it into the handler's third argument — the
+ * interrupted app registers are exactly what the kernel would show —
+ * and copies mcontext edits back so longjmp-style handlers and
+ * register-patching handlers behave (ref: shim/src/signals.rs builds
+ * the same frame). */
+static __thread ucontext_t *g_trap_uc
+    __attribute__((tls_model("initial-exec"))) = NULL;
 /* Simulated ns billed per preemption, from SHADOWTPU_PREEMPT_SIM_NS. */
 static long g_preempt_sim_ns = 0;
 static long g_preempt_native_us = 0;
@@ -205,7 +214,27 @@ static void shim_run_signal_handler(const shim_event_t *ev) {
         si.si_code = (int)ev->args[2]; /* SI_USER / SI_KERNEL / CLD_* */
         si.si_pid = (int)ev->args[3];
         si.si_status = (int)ev->args[4]; /* CLD_*: exit code / signal */
+        /* Real ucontext (ref: shim/src/signals.rs): delivery happens
+         * at a syscall boundary inside the SIGSYS trap, so the
+         * interrupted app registers are the trap frame's.  uc_sigmask
+         * carries the EMULATED blocked set at delivery (args[5]) —
+         * the native mask would be the shim's, a lie under
+         * emulation. */
+        if (g_trap_uc != NULL)
+            memcpy(&uc, g_trap_uc, sizeof(uc));
+        sigemptyset(&uc.uc_sigmask);
+        uint64_t mask = (uint64_t)ev->args[5];
+        for (int s = 1; s <= 64; s++)
+            if (mask & (1ULL << (s - 1)))
+                sigaddset(&uc.uc_sigmask, s);
         ((void (*)(int, siginfo_t *, void *))handler)(signum, &si, &uc);
+        /* Kernel sigreturn semantics: mcontext edits made by the
+         * handler take effect when the interrupted context resumes.
+         * (A later syscall-result write to RAX still wins, exactly as
+         * a real interrupted syscall's return value does.) */
+        if (g_trap_uc != NULL)
+            memcpy(&g_trap_uc->uc_mcontext, &uc.uc_mcontext,
+                   sizeof(uc.uc_mcontext));
     } else {
         ((void (*)(int))handler)(signum);
     }
@@ -388,6 +417,32 @@ static long shim_ipc_syscall(long n, const long args[6]) {
     if (ev.kind == EV_SYSCALL_DO_NATIVE) {
         if (n == SYS_execve)
             return shim_do_execve(args);
+        /* The reserved transfer fd (SCM_RIGHTS delivery channel) is
+         * shim-internal and invisible to the app's virtual fd view:
+         * a blanket close_range(3, ~0) must not sever it (a real
+         * daemon-init loop would otherwise break every later native-
+         * fd passing), and close() on its number answers EBADF
+         * exactly as the app's view dictates. */
+        if (g_xfer_fd >= 0 && n == SYS_close_range) {
+            /* The kernel reads fd/max_fd as u32 (sign-extended -1 is
+             * a real daemon idiom for "everything"); compare in the
+             * kernel's domain or the guard is bypassed. */
+            unsigned long lo32 = (unsigned long)(unsigned int)args[0];
+            unsigned long hi32 = (unsigned long)(unsigned int)args[1];
+            unsigned long xfer = (unsigned long)g_xfer_fd;
+            if (lo32 <= xfer && xfer <= hi32) {
+                long rv2 = 0;
+                if (lo32 < xfer)
+                    rv2 = raw(SYS_close_range, (long)lo32,
+                              (long)(xfer - 1), args[2], 0, 0, 0);
+                if (rv2 >= 0 && xfer < hi32)
+                    rv2 = raw(SYS_close_range, (long)(xfer + 1),
+                              (long)hi32, args[2], 0, 0, 0);
+                return rv2;
+            }
+        }
+        if (g_xfer_fd >= 0 && n == SYS_close && args[0] == g_xfer_fd)
+            return -EBADF;
         long rv = raw(n, args[0], args[1], args[2], args[3], args[4],
                       args[5]);
         /* Newly created native fds that landed in the emulated fd
@@ -621,10 +676,12 @@ static long shim_collect_fds(long nfds) {
     }
     for (long i = 0; i < nfds; i++) {
         int fd = fds[i];
-        /* Keep delivered fds out of the emulated window, like
-         * DO_NATIVE open results. */
-        if (g_fd_move_floor > 0 && fd >= SHIM_EMU_FD_BASE &&
-            fd < g_fd_move_floor) {
+        /* Delivered fds always move ABOVE the emulated window: the
+         * kernel hands out the lowest free native number, which may
+         * collide with an emulated fd — either the [400, floor)
+         * window or a low slot occupied by an emulated dup2 (the
+         * kernel cannot see those, so "lowest free" lies). */
+        if (g_fd_move_floor > 0 && fd < g_fd_move_floor) {
             long moved = raw(SYS_fcntl, fd, F_DUPFD, g_fd_move_floor,
                              0, 0, 0);
             if (moved >= 0) {
@@ -670,12 +727,15 @@ static long shim_emulated_syscall(long n, const long args[6]) {
  * timing depend on native CPU speed, i.e. NON-deterministic; the knob
  * is off by default exactly like the reference's. */
 static void sigvtalrm_handler(int sig, siginfo_t *info, void *ucontext) {
-    (void)sig; (void)info; (void)ucontext;
+    (void)sig; (void)info;
     if (g_in_shim || !g_enabled || !g_chan)
         return; /* mid-conversation or a cloned thread whose channel is
                  * not bound yet; the repeating timer refires */
+    ucontext_t *prev_uc = g_trap_uc;
+    g_trap_uc = (ucontext_t *)ucontext;
     long args[6] = {g_preempt_sim_ns, 0, 0, 0, 0, 0};
     shim_emulated_syscall(SHADOWTPU_SYS_YIELD, args);
+    g_trap_uc = prev_uc;
 }
 
 static void install_preemption(void) {
@@ -737,7 +797,10 @@ static void sigsegv_handler(int sig, siginfo_t *info, void *ucontext) {
              * loops advancing simulated time (CPU-latency model). */
             struct timespec ts;
             long args[6] = {CLOCK_MONOTONIC, (long)&ts, 0, 0, 0, 0};
+            ucontext_t *prev_uc = g_trap_uc;
+            g_trap_uc = ctx;
             shim_emulated_syscall(SYS_clock_gettime, args);
+            g_trap_uc = prev_uc;
             uint64_t nanos = (uint64_t)ts.tv_sec * 1000000000ull +
                              (uint64_t)ts.tv_nsec;
             regs[REG_RAX] = (greg_t)(nanos & 0xffffffffull);
@@ -804,12 +867,17 @@ static void sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
     (void)sig;
     ucontext_t *ctx = (ucontext_t *)ucontext;
     greg_t *gregs = ctx->uc_mcontext.gregs;
+    /* Publish the trap frame for emulated signal delivery (nested
+     * traps — a handler's own syscalls — shadow and restore it). */
+    ucontext_t *prev_uc = g_trap_uc;
+    g_trap_uc = ctx;
     long n = (long)info->si_syscall;
     if (n == SYS_clone) {
         /* Needs the full trapped context (the child resumes from it). */
         g_in_shim++;
         shim_handle_clone(gregs);
         g_in_shim--;
+        g_trap_uc = prev_uc;
         return;
     }
     long args[6] = {
@@ -817,6 +885,7 @@ static void sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
         (long)gregs[REG_R10], (long)gregs[REG_R8],  (long)gregs[REG_R9],
     };
     gregs[REG_RAX] = (greg_t)shim_emulated_syscall(n, args);
+    g_trap_uc = prev_uc;
 }
 
 /* ---------------------------------------------------------------- */

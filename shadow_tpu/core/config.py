@@ -327,7 +327,12 @@ class ConfigOptions:
                 ("tpu_min_device_batch", "tpu_min_device_batch", int),
                 ("tpu_shards", "tpu_shards", int),
                 ("tpu_exchange_capacity", "tpu_exchange_capacity", int),
-                ("native_dataplane", "native_dataplane", str),
+                # YAML 1.1 reads bare on/off as booleans; accept both
+                # spellings (`native_dataplane: on` is the documented
+                # form).
+                ("native_dataplane", "native_dataplane",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
                 ("use_perf_timers", "use_perf_timers", bool),
                 ("report_errors_to_stderr", "report_errors_to_stderr", bool)):

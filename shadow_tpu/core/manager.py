@@ -178,7 +178,14 @@ class Manager:
         # propagator (cross-plane packet conversion).
         self.plane = None
         native_mode = config.experimental.native_dataplane
-        if sched == "tpu" and native_mode != "off":
+        # tpu: engine on by default (auto).  thread_per_core: engine on
+        # explicit opt-in only (native_dataplane: on) — that mode is
+        # the honest baseline comparator (real OS threads over C++
+        # engine hosts, run_hosts_mt), and the default must stay the
+        # reference-faithful pure-Python scheduler.
+        want_plane = (sched == "tpu" and native_mode != "off") or \
+            (sched == "thread_per_core" and native_mode == "on")
+        if want_plane:
             from shadow_tpu.native import plane as native_plane
             if native_plane.native_available():
                 self.plane = native_plane.NativePlane(self.hosts)
@@ -236,6 +243,11 @@ class Manager:
                 lat.shape[0], k0, k1,
                 config.general.bootstrap_end_time_ns, TIME_NEVER)
             self.propagator.engine = self.plane.engine
+
+        # OS-thread width for the engine's run_hosts_mt parallel
+        # sections (any scheduler with the plane active).
+        self._mt_threads = (config.general.parallelism
+                            or os.cpu_count() or 1)
 
         self._perf_timers = config.experimental.use_perf_timers
         if self._perf_timers and threaded:
@@ -382,6 +394,34 @@ class Manager:
         hosts = self.hosts
         return [hosts[i] for i in np.flatnonzero(self._nt < until)]
 
+    def _run_engine_batch(self, active: list, until: int,
+                          nthreads: int) -> list:
+        """Engine fast path: hosts whose pending work is entirely
+        engine-side (no Python heap entries, no undrained Python
+        inbox) run the whole window in ONE C call; callback-free hosts
+        inside that call fan out over OS threads (run_hosts_mt, GIL
+        released).  Returns the hosts that still need the Python
+        path."""
+        eng = self.plane.engine
+        fast: list = []
+        slow: list = []
+        for h in active:
+            if h.plane is not None and not h.queue._heap \
+                    and not h._inbox:
+                fast.append(h.id)
+            else:
+                slow.append(h)
+        if fast:
+            arr = np.asarray(fast, dtype=np.uint32)
+            stop = eng.run_hosts_mt(arr, until, nthreads)
+            if stop >= 0:
+                # A Python callback fired in the serial tail: finish
+                # that host and the remainder via the full merge loop
+                # (already-run hosts re-execute as no-ops).
+                for hid in fast[stop:]:
+                    self.hosts[hid].execute(until)
+        return slow
+
     def _run_hosts(self, until: int) -> None:
         if self._perf_timers:
             # perf_timers feature (perf_timer.rs; host.rs:680-688): time
@@ -395,30 +435,12 @@ class Manager:
         active = self._active_hosts(until)
         if self._pool is None:
             if self.plane is not None:
-                # Batch path: hosts whose pending work is entirely
-                # engine-side (no Python heap entries, no undrained
-                # Python inbox) run the whole window in ONE C call —
-                # at 100k hosts the per-host Python wrapper and the
-                # C-call crossings are the round loop's main cost.
-                eng = self.plane.engine
-                fast: list = []
-                slow: list = []
-                for h in active:
-                    if h.plane is not None and not h.queue._heap \
-                            and not h._inbox:
-                        fast.append(h.id)
-                    else:
-                        slow.append(h)
-                if fast:
-                    arr = np.asarray(fast, dtype=np.uint32)
-                    stop = eng.run_hosts(arr, until)
-                    if stop >= 0:
-                        # A Python callback fired mid-batch: finish
-                        # that host and the remainder via the full
-                        # merge loop (which services callbacks).
-                        for hid in fast[stop:]:
-                            self.hosts[hid].execute(until)
-                for h in slow:
+                # At 100k hosts the per-host Python wrapper and the
+                # C-call crossings are the round loop's main cost;
+                # host-level OS-thread parallelism is orthogonal to
+                # where the propagation phase runs.
+                for h in self._run_engine_batch(active, until,
+                                                self._mt_threads):
                     h.execute(until)
             else:
                 for h in active:
@@ -428,6 +450,15 @@ class Manager:
             # host, pool-sized by min(cores, hosts).
             list(self._pool.map(lambda h: h.execute(until), active))
         else:
+            if self.plane is not None:
+                # Engine-backed thread_per_core: the honest reference-
+                # style baseline the accelerator ratio is measured
+                # against; leftovers run through the Python stealing
+                # pool below.
+                active = self._run_engine_batch(
+                    active, until, self._pool._max_workers)
+            if not active:
+                return
             # thread_per_core (thread_per_core.rs:17-60): workers claim
             # blocks off one shared cursor, so a thread that drew cheap
             # hosts steals the remainder of an expensive neighbor's

@@ -34,6 +34,7 @@ class ScalarPropagator:
         self.min_inflight = None
         self.runahead = runahead  # dynamic-runahead feedback (runahead.rs:61)
         self._threaded = threaded
+        self.engine = None  # native plane engine (set by the Manager)
         if threaded:
             self._min_lock = threading.Lock()
 
@@ -42,7 +43,22 @@ class ScalarPropagator:
         self.min_inflight = None
 
     def finish_round(self):
-        return self.min_inflight
+        m = self.min_inflight
+        eng = self.engine
+        if eng is not None and eng.round_size():
+            # Engine-batched sends (engine-backed thread_per_core):
+            # the C++ propagation twin — bit-identical loss/latency
+            # math — delivers into engine inboxes and exports packets
+            # bound for object-path hosts.
+            from shadow_tpu.ops.propagate import deliver_engine_exports
+            _nf, md, ml, exports = eng.finish_round(self.window_end)
+            if exports is not None:
+                deliver_engine_exports(self.hosts, exports)
+            if self.runahead is not None and ml < TIME_NEVER:
+                self.runahead.update_lowest_used_latency(ml)
+            if md < TIME_NEVER and (m is None or md < m):
+                m = md
+        return m
 
     def send(self, src_host, packet) -> None:
         now = src_host.now()
@@ -80,9 +96,14 @@ class ScalarPropagator:
         deliver = now + latency
         if deliver < self.window_end:
             deliver = self.window_end
-        packet.arrival_time = deliver
-        event = Event(deliver, KIND_PACKET, src_host.id, seq, packet)
-        dst_host.deliver_packet_event(event)  # inbox: thread-safe
+        if dst_host.plane is not None:
+            # Mixed planes: object-path origin, engine destination.
+            from shadow_tpu.ops.propagate import deliver_to_host
+            deliver_to_host(dst_host, deliver, src_host.id, seq, packet)
+        else:
+            packet.arrival_time = deliver
+            event = Event(deliver, KIND_PACKET, src_host.id, seq, packet)
+            dst_host.deliver_packet_event(event)  # inbox: thread-safe
 
         if self._threaded:
             with self._min_lock:

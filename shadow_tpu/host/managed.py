@@ -731,11 +731,15 @@ class ManagedThread:
             self._post_handler.append((resolved, saved_mask))
             si_code, si_pid, si_status = sigs.take_info(sig)
             # The shim builds the handler's siginfo from args[2..4]
-            # (si_code, si_pid, si_status); the ucontext stays zeroed
-            # (docs/PARITY.md).
+            # (si_code, si_pid, si_status) and its ucontext from the
+            # live trap frame + args[5] = the emulated blocked mask at
+            # delivery (what uc_sigmask restores after the handler —
+            # Linux semantics; the native mask would be the shim's).
+            mask_i64 = saved_mask - (1 << 64) \
+                if saved_mask >= (1 << 63) else saved_mask
             self.chan.send_to_shim(EV_SIGNAL, sig,
                                    (act.handler, act.flags, si_code,
-                                    si_pid, si_status, 0))
+                                    si_pid, si_status, mask_i64))
             return "sent"
 
     def _handler_returned(self, host) -> bool:

@@ -243,6 +243,23 @@ class UnixSocket(StatusOwner):
         out, self._last_anc = self._last_anc, []
         return out
 
+    def next_read_has_native_fds(self) -> bool:
+        """Would the next read surface NativeFdRef ancillary?  Lets
+        recvmmsg stop a batch BEFORE consuming such a message (the
+        fd-transfer dance patches one cmsg per syscall, so the message
+        must head its own batch).  Stream case: ancillary surfaces only
+        when the next read starts at/past its SCM-scope watermark (the
+        read limiter stops earlier reads at the boundary)."""
+        from shadow_tpu.host.descriptor import NativeFdRef
+        if self.stream:
+            ws = self._anc_stream
+            if not ws or ws[0][0] > self._rx_read:
+                return False
+            return any(isinstance(o, NativeFdRef) for o in ws[0][1])
+        if not self._dgrams:
+            return False
+        return any(isinstance(o, NativeFdRef) for o in self._dgrams[0][2])
+
     def bytes_available(self) -> int:
         if self.stream:
             return len(self._recv_buf)

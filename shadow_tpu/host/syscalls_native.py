@@ -667,6 +667,13 @@ class NativeSyscallHandler:
                 process, msg_ptr)
             total = sum(l for _p, l in self._iovecs(process, iov_ptr,
                                                     iovlen))
+            if got and isinstance(sock, UnixSocket) \
+                    and sock.next_read_has_native_fds():
+                # A native-fd message must head its own batch (one
+                # cmsg transfer dance per syscall): stop here, the
+                # next recvmmsg/recvmsg delivers it with the fds
+                # intact.  Linux legally returns short batches.
+                return _done(got)
             try:
                 data, peer = self._sock_recv(host, sock,
                                              min(total, _MAX_IO),
@@ -689,13 +696,18 @@ class NativeSyscallHandler:
                                                mask=S_READABLE,
                                                timeout_at=timeout_at))
             self._scatter_iov(process, iov_ptr, iovlen, data)
+            xfer = None
             if isinstance(sock, UnixSocket):
                 # recvmmsg is recvmsg in a loop: ancillary delivers per
-                # message through the same path.
+                # message through the same path.  Native fds are only
+                # possible on the batch's FIRST message (the guard
+                # above stops before consuming one later).
                 objs = sock.take_ancillary()
                 if objs:
-                    self._deliver_scm_rights(host, process, msg_ptr,
-                                             objs, allow_native=False)
+                    xfer = self._deliver_scm_rights(host, process,
+                                                    msg_ptr, objs,
+                                                    allow_native=(got
+                                                                  == 0))
                 else:
                     process.mem.write(msg_ptr + 40,
                                       struct.pack("<Q", 0))
@@ -710,6 +722,10 @@ class NativeSyscallHandler:
             process.mem.write(msg_ptr + 56,
                               struct.pack("<I", len(data)))
             got += 1
+            if xfer is not None:
+                # Close the batch at 1: the transfer dance patches this
+                # message's cmsg placeholders after the syscall result.
+                return ("done_fdxfer", got) + xfer[1:]
         return _done(got)
 
     def _parse_scm_rights(self, process, control_ptr, controllen):

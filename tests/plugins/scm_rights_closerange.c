@@ -1,0 +1,180 @@
+/* Native-fd SCM_RIGHTS hardening gates (VERDICT r3 item 9):
+ *
+ * mode "closerange": the receiving child runs close_range(3, ~0)
+ * first — a daemon-init idiom that previously severed the shim's
+ * reserved transfer fd and degraded fd delivery to MSG_CTRUNC.  The
+ * shim now splits the native close_range around its reserved fd, so
+ * the transfer must still deliver a working fd.
+ *
+ * mode "recvmmsg": the fd rides the FIRST datagram of a recvmmsg
+ * batch (previously the batch path truncated native fds
+ * unconditionally).  A second plain datagram queued behind it must
+ * arrive in a separate batch (the fd message closes its batch).
+ *
+ * Dual-target: native Linux prints the same verdict lines. */
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static int send_fd(int sock, int fd) {
+    char data = 'F';
+    struct iovec iov = {.iov_base = &data, .iov_len = 1};
+    union {
+        char buf[CMSG_SPACE(sizeof(int))];
+        struct cmsghdr align;
+    } u;
+    memset(&u, 0, sizeof(u));
+    struct msghdr msg = {.msg_iov = &iov, .msg_iovlen = 1,
+                         .msg_control = u.buf,
+                         .msg_controllen = sizeof(u.buf)};
+    struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+    c->cmsg_level = SOL_SOCKET;
+    c->cmsg_type = SCM_RIGHTS;
+    c->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(c), &fd, sizeof(int));
+    return sendmsg(sock, &msg, 0) == 1 ? 0 : -1;
+}
+
+static int child_closerange(int sock) {
+    /* The daemon-init idiom: park the one needed fd at a low number,
+     * then blanket-close everything above stdio. */
+    if (dup2(sock, 3) != 3) {
+        puts("child FAIL dup2");
+        return 1;
+    }
+    close(sock);
+    sock = 3;
+    if (syscall(SYS_close_range, 4U, ~0U, 0) != 0) {
+        puts("child FAIL close_range");
+        return 1;
+    }
+    char data;
+    struct iovec iov = {.iov_base = &data, .iov_len = 1};
+    union {
+        char buf[CMSG_SPACE(sizeof(int))];
+        struct cmsghdr align;
+    } u;
+    memset(&u, 0, sizeof(u));
+    struct msghdr msg = {.msg_iov = &iov, .msg_iovlen = 1,
+                         .msg_control = u.buf,
+                         .msg_controllen = sizeof(u.buf)};
+    if (recvmsg(sock, &msg, 0) != 1) {
+        puts("child FAIL recvmsg");
+        return 1;
+    }
+    if (msg.msg_flags & MSG_CTRUNC) {
+        puts("child FAIL ctrunc");
+        return 1;
+    }
+    struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+    if (!c || c->cmsg_type != SCM_RIGHTS) {
+        puts("child FAIL no-fd");
+        return 1;
+    }
+    int rfd;
+    memcpy(&rfd, CMSG_DATA(c), sizeof(int));
+    char buf[8];
+    ssize_t r = read(rfd, buf, 4);
+    printf("closerange read=%zd data=%.4s\n", r, buf);
+    return 0;
+}
+
+static int child_recvmmsg(int sock) {
+    struct mmsghdr vec[2];
+    char d0, d1;
+    struct iovec iov0 = {.iov_base = &d0, .iov_len = 1};
+    struct iovec iov1 = {.iov_base = &d1, .iov_len = 1};
+    union {
+        char buf[CMSG_SPACE(sizeof(int))];
+        struct cmsghdr align;
+    } u0, u1;
+    memset(vec, 0, sizeof(vec));
+    memset(&u0, 0, sizeof(u0));
+    memset(&u1, 0, sizeof(u1));
+    vec[0].msg_hdr.msg_iov = &iov0;
+    vec[0].msg_hdr.msg_iovlen = 1;
+    vec[0].msg_hdr.msg_control = u0.buf;
+    vec[0].msg_hdr.msg_controllen = sizeof(u0.buf);
+    vec[1].msg_hdr.msg_iov = &iov1;
+    vec[1].msg_hdr.msg_iovlen = 1;
+    vec[1].msg_hdr.msg_control = u1.buf;
+    vec[1].msg_hdr.msg_controllen = sizeof(u1.buf);
+    int got = recvmmsg(sock, vec, 2, 0, NULL);
+    if (got < 1) {
+        puts("child FAIL recvmmsg");
+        return 1;
+    }
+    if (vec[0].msg_hdr.msg_flags & MSG_CTRUNC) {
+        puts("child FAIL ctrunc");
+        return 1;
+    }
+    struct cmsghdr *c = CMSG_FIRSTHDR(&vec[0].msg_hdr);
+    if (!c || c->cmsg_type != SCM_RIGHTS) {
+        puts("child FAIL no-fd");
+        return 1;
+    }
+    int rfd;
+    memcpy(&rfd, CMSG_DATA(c), sizeof(int));
+    char buf[8];
+    ssize_t r = read(rfd, buf, 4);
+    /* The trailing plain datagram arrives in this batch natively
+     * (got=2) or the next one under the sim (got=1 + second recv) —
+     * both are valid recvmmsg outcomes; just prove it arrives. */
+    if (got == 1) {
+        struct iovec iov = {.iov_base = &d1, .iov_len = 1};
+        struct msghdr m2 = {.msg_iov = &iov, .msg_iovlen = 1};
+        if (recvmsg(sock, &m2, 0) != 1) {
+            puts("child FAIL second-dgram");
+            return 1;
+        }
+    }
+    printf("recvmmsg read=%zd data=%.4s second=%c\n", r, buf, d1);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    const char *mode = argc > 1 ? argv[1] : "closerange";
+    const char *path = argc > 2 ? argv[2] : "/tmp/scm_cr_test.dat";
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_DGRAM, 0, sv) != 0) {
+        puts("FAIL socketpair");
+        return 1;
+    }
+    int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0 || write(fd, "WXYZ", 4) != 4 || lseek(fd, 0, SEEK_SET) != 0) {
+        puts("FAIL setup");
+        return 1;
+    }
+    pid_t pid = fork();
+    if (pid == 0) {
+        close(sv[0]);
+        close(fd);
+        int rc = strcmp(mode, "recvmmsg") == 0 ? child_recvmmsg(sv[1])
+                                               : child_closerange(sv[1]);
+        fflush(stdout);
+        _exit(rc);
+    }
+    close(sv[1]);
+    if (send_fd(sv[0], fd) != 0) {
+        puts("FAIL send_fd");
+        return 1;
+    }
+    if (strcmp(mode, "recvmmsg") == 0) {
+        char extra = 'E';
+        if (send(sv[0], &extra, 1, 0) != 1) {
+            puts("FAIL send extra");
+            return 1;
+        }
+    }
+    int st = 0;
+    waitpid(pid, &st, 0);
+    printf("parent child_ok=%d\n",
+           WIFEXITED(st) && WEXITSTATUS(st) == 0);
+    fflush(stdout);
+    return WIFEXITED(st) && WEXITSTATUS(st) == 0 ? 0 : 1;
+}

@@ -77,8 +77,12 @@ int main(int argc, char **argv) {
         }
         char buf[16];
         ssize_t r = read(rfd, buf, sizeof(buf));
-        printf("child fd_native=%d read=%.*s\n", rfd < 400 ? 1 : 0,
-               (int)r, buf);
+        /* The delivered fd must sit OUTSIDE the emulated window
+         * [400, 2000): natively the kernel hands out a low number;
+         * under the sim the shim parks it above the floor so it can
+         * never collide with an emulated slot. */
+        printf("child fd_native=%d read=%.*s\n",
+               (rfd < 400 || rfd >= 2000) ? 1 : 0, (int)r, buf);
         return r == 6 && memcmp(buf, "456789", 6) == 0 ? 0 : 1;
     }
     close(sv[1]);

@@ -1,0 +1,52 @@
+/* Gate: emulated SA_SIGINFO delivery passes a REAL ucontext.
+ *
+ * The handler must see (a) the interrupted context's registers — a
+ * nonzero RIP/RSP snapshot, like the kernel provides — and (b) the
+ * EMULATED blocked-signal mask at delivery in uc_sigmask (SIGUSR1 was
+ * blocked before the signal fired; SIGUSR2 was not).  Dual-target:
+ * native Linux and the simulator must both print the same verdict
+ * line. */
+#define _GNU_SOURCE
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/ucontext.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile int fired = 0;
+
+static void handler(int sig, siginfo_t *si, void *ucv) {
+    ucontext_t *uc = (ucontext_t *)ucv;
+    long rip = (long)uc->uc_mcontext.gregs[REG_RIP];
+    long rsp = (long)uc->uc_mcontext.gregs[REG_RSP];
+    int usr1 = sigismember(&uc->uc_sigmask, SIGUSR1);
+    int usr2 = sigismember(&uc->uc_sigmask, SIGUSR2);
+    printf("UCONTEXT sig=%d rip=%d rsp=%d usr1=%d usr2=%d\n", sig,
+           rip != 0, rsp != 0, usr1, usr2);
+    (void)si;
+    fired = 1;
+}
+
+int main(void) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = handler;
+    sa.sa_flags = SA_SIGINFO;
+    if (sigaction(SIGTERM, &sa, NULL) != 0) return 2;
+
+    sigset_t blk;
+    sigemptyset(&blk);
+    sigaddset(&blk, SIGUSR1);
+    if (sigprocmask(SIG_BLOCK, &blk, NULL) != 0) return 3;
+
+    kill(getpid(), SIGTERM);
+    /* Delivery happens at a syscall boundary; give it one. */
+    struct timespec ts = {0, 1000000};
+    nanosleep(&ts, NULL);
+    if (!fired) return 4;
+    printf("DONE\n");
+    fflush(stdout);
+    return 0;
+}

@@ -1,0 +1,78 @@
+"""Engine-backed thread_per_core: the honest baseline scheduler.
+
+VERDICT r3 weakness 1: the headline accelerator ratio was measured
+against GIL-bound Python threads.  `scheduler: thread_per_core` with
+`native_dataplane: on` runs engine hosts on real OS threads inside one
+C call per round (`Engine::run_hosts_mt`, GIL released) — a
+reference-style multicore CPU simulator.  Its trace must stay
+byte-identical to serial (host-parallel rounds are Shadow's core
+soundness claim, manager.rs:415-501), and the parallel path must
+actually run.
+"""
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from shadow_tpu.tools.netgen import tgen_tier_yaml, udp_mesh_yaml
+
+
+def run_mesh(scheduler, **extra):
+    text = udp_mesh_yaml(24, n_nodes=6, floods_per_host=2, count=4,
+                         size=500, stop_time="8s", seed=3,
+                         scheduler=scheduler,
+                         experimental_extra=extra or None)
+    return run_simulation(ConfigOptions.from_yaml_text(text))
+
+
+def run_tier(scheduler, **extra):
+    text = tgen_tier_yaml(64, n_servers=8, nbytes=20_000, count=2,
+                          stop_time="15s", seed=7, scheduler=scheduler,
+                          experimental_extra=extra or None)
+    return run_simulation(ConfigOptions.from_yaml_text(text))
+
+
+def _require_plane(manager):
+    if manager.plane is None:
+        pytest.skip("native plane unavailable (no C++ toolchain)")
+
+
+def test_engine_tpc_udp_trace_byte_identical():
+    m_ser, s_ser = run_mesh("serial")
+    m_tpc, s_tpc = run_mesh("thread_per_core", native_dataplane="on")
+    assert s_ser.ok and s_tpc.ok
+    _require_plane(m_tpc)
+    batches, hosts_run = m_tpc.plane.engine.mt_stats()
+    assert batches > 0, "parallel section never ran"
+    assert hosts_run > 0
+    assert m_ser.trace_lines() == m_tpc.trace_lines()
+    assert s_ser.packets_recv == s_tpc.packets_recv
+
+
+def test_engine_tpc_tcp_trace_byte_identical():
+    m_ser, s_ser = run_tier("serial")
+    m_tpc, s_tpc = run_tier("thread_per_core", native_dataplane="on")
+    assert s_ser.ok and s_tpc.ok
+    _require_plane(m_tpc)
+    batches, _ = m_tpc.plane.engine.mt_stats()
+    assert batches > 0
+    assert m_ser.trace_lines() == m_tpc.trace_lines()
+    assert s_ser.packets_dropped == s_tpc.packets_dropped
+
+
+def test_engine_tpc_matches_tpu_scheduler_trace():
+    """All three execution strategies — serial object path, OS-thread
+    engine hosts, cost-model tpu backend — one trace."""
+    m_tpc, s_tpc = run_mesh("thread_per_core", native_dataplane="on")
+    m_tpu, s_tpu = run_mesh("tpu")
+    assert s_tpc.ok and s_tpu.ok
+    assert m_tpc.trace_lines() == m_tpu.trace_lines()
+
+
+def test_engine_tpc_default_stays_pure_python():
+    """Without the explicit opt-in the baseline scheduler must remain
+    the reference-faithful pure-Python path (the ratio's denominator
+    semantics depend on it)."""
+    m_tpc, s_tpc = run_mesh("thread_per_core")
+    assert s_tpc.ok
+    assert m_tpc.plane is None
