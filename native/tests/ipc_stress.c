@@ -1,0 +1,165 @@
+/* ThreadSanitizer stress gate for the shim IPC channel protocol.
+ *
+ * The reference model-checks its futex channel under loom
+ * (vasi-sync/src/sync.rs); this is our stand-in: the EXACT
+ * slot_send/slot_recv protocol from native/shim.c (one-outstanding-
+ * message, status word doubling as the futex word, release-store /
+ * acquire-load pairing ordering the plain-memory event payload) run
+ * under TSan with N channel pairs x M messages and the nested
+ * EV_SIGNAL interleave (manager injects a signal event in place of a
+ * response; shim answers SIGNAL_DONE and re-waits) plus a SIGALRM
+ * storm hitting the shim threads mid-protocol.
+ *
+ * Any missing ordering on the payload bytes (e.g. relaxed status
+ * store) is a data race TSan reports; the payload sequence check
+ * catches lost/duplicated wakeups.
+ *
+ * Build: cc -fsanitize=thread -O1 -pthread ipc_stress.c
+ * (tests/test_ipc_stress.py drives it; prints CLEAN on success).
+ */
+#define _GNU_SOURCE
+#include <linux/futex.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include "../shim_ipc.h"
+
+#define N_PAIRS 8
+#define N_MSGS 20000
+#define SIGNAL_EVERY 97 /* inject EV_SIGNAL before every 97th response */
+
+static ipc_chan_t chans[N_PAIRS];
+
+/* --- the protocol under test: byte-for-byte the shim.c versions --- */
+
+static void futex_wake_word(ipc_atomic_u32 *word) {
+    syscall(SYS_futex, (uint32_t *)word, FUTEX_WAKE, 1, NULL, NULL, 0);
+}
+
+static uint32_t futex_wait_word(ipc_atomic_u32 *word, uint32_t seen) {
+    syscall(SYS_futex, (uint32_t *)word, FUTEX_WAIT, seen, NULL, NULL, 0);
+    return __atomic_load_n((uint32_t *)word, __ATOMIC_ACQUIRE);
+}
+
+static void slot_send(ipc_slot_t *slot, const shim_event_t *ev) {
+    memcpy(&slot->ev, ev, sizeof(*ev));
+    __atomic_store_n((uint32_t *)&slot->status, SLOT_READY,
+                     __ATOMIC_RELEASE);
+    futex_wake_word(&slot->status);
+}
+
+static void slot_recv(ipc_slot_t *slot, shim_event_t *out) {
+    uint32_t st =
+        __atomic_load_n((uint32_t *)&slot->status, __ATOMIC_ACQUIRE);
+    while (st != SLOT_READY) {
+        if (st == SLOT_CLOSED) {
+            fprintf(stderr, "unexpected CLOSED\n");
+            exit(3);
+        }
+        st = futex_wait_word(&slot->status, st);
+    }
+    memcpy(out, &slot->ev, sizeof(*out));
+    __atomic_store_n((uint32_t *)&slot->status, SLOT_EMPTY,
+                     __ATOMIC_RELEASE);
+    futex_wake_word(&slot->status);
+}
+
+/* ------------------------------------------------------------------ */
+
+static void alarm_handler(int sig) { (void)sig; }
+
+static void *shim_thread(void *arg) {
+    ipc_chan_t *ch = (ipc_chan_t *)arg;
+    shim_event_t ev, resp;
+    for (long i = 0; i < N_MSGS; i++) {
+        memset(&ev, 0, sizeof(ev));
+        ev.kind = EV_SYSCALL;
+        ev.num = i;
+        ev.args[0] = i * 3 + 1; /* payload the manager echoes back */
+        slot_send(&ch->to_shadow, &ev);
+        for (;;) {
+            slot_recv(&ch->to_shim, &resp);
+            if (resp.kind == EV_SIGNAL) {
+                /* nested delivery: acknowledge, keep waiting for the
+                 * real response (shim_recv_response's loop shape) */
+                shim_event_t done;
+                memset(&done, 0, sizeof(done));
+                done.kind = EV_SIGNAL_DONE;
+                slot_send(&ch->to_shadow, &done);
+                continue;
+            }
+            break;
+        }
+        if (resp.kind != EV_SYSCALL_COMPLETE || resp.num != i ||
+            resp.args[0] != i * 3 + 2) {
+            fprintf(stderr, "shim: bad response at %ld (kind %u num "
+                            "%lld)\n",
+                    i, resp.kind, (long long)resp.num);
+            exit(4);
+        }
+    }
+    return NULL;
+}
+
+static void *manager_thread(void *arg) {
+    ipc_chan_t *ch = (ipc_chan_t *)arg;
+    shim_event_t ev, resp;
+    for (long i = 0; i < N_MSGS; i++) {
+        slot_recv(&ch->to_shadow, &ev);
+        if (ev.kind != EV_SYSCALL || ev.num != i ||
+            ev.args[0] != i * 3 + 1) {
+            fprintf(stderr, "mgr: bad event at %ld (kind %u num %lld)\n",
+                    i, ev.kind, (long long)ev.num);
+            exit(5);
+        }
+        if (i % SIGNAL_EVERY == 0) {
+            memset(&resp, 0, sizeof(resp));
+            resp.kind = EV_SIGNAL;
+            resp.num = 10; /* SIGUSR1, say */
+            slot_send(&ch->to_shim, &resp);
+            slot_recv(&ch->to_shadow, &resp);
+            if (resp.kind != EV_SIGNAL_DONE) {
+                fprintf(stderr, "mgr: expected SIGNAL_DONE, got %u\n",
+                        resp.kind);
+                exit(6);
+            }
+        }
+        memset(&resp, 0, sizeof(resp));
+        resp.kind = EV_SYSCALL_COMPLETE;
+        resp.num = i;
+        resp.args[0] = i * 3 + 2;
+        slot_send(&ch->to_shim, &resp);
+    }
+    return NULL;
+}
+
+int main(void) {
+    /* SIGALRM storm: EINTR-wakes futex waits mid-protocol on every
+     * thread (the kernel restarts FUTEX_WAIT; the protocol must not
+     * care). */
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = alarm_handler;
+    sigaction(SIGALRM, &sa, NULL);
+    struct itimerval itv = {{0, 2000}, {0, 2000}};
+    setitimer(ITIMER_REAL, &itv, NULL);
+
+    memset(chans, 0, sizeof(chans));
+    pthread_t shims[N_PAIRS], mgrs[N_PAIRS];
+    for (int i = 0; i < N_PAIRS; i++) {
+        pthread_create(&mgrs[i], NULL, manager_thread, &chans[i]);
+        pthread_create(&shims[i], NULL, shim_thread, &chans[i]);
+    }
+    for (int i = 0; i < N_PAIRS; i++) {
+        pthread_join(shims[i], NULL);
+        pthread_join(mgrs[i], NULL);
+    }
+    printf("CLEAN %d pairs x %d msgs\n", N_PAIRS, N_MSGS);
+    return 0;
+}

@@ -436,6 +436,27 @@ class ManagedProcess(Process):
         if sig == sigmod.SIGKILL:
             self.terminate_by_signal(host, sig)
             return
+        if sig == sigmod.SIGCONT:
+            # The continue side-effect fires at generation time
+            # regardless of disposition/blocking (kernel semantics);
+            # a SIGCONT handler then delivers through the normal path.
+            self.continue_process(host)
+        elif self.stopped:
+            # The stop shields everything but KILL/CONT until the
+            # continue: queue as process-pending; it surfaces at the
+            # first response point after SIGCONT.
+            if sigs.disposition(sig) not in ("ignore", "stop"):
+                self._queue_siginfo(sig, siginfo)
+                sigs.pending_process.add(sig)
+                self.refresh_signal_fds(host)
+            return
+        elif sigs.disposition(sig) == "stop":
+            # SIGSTOP is unblockable; TSTP/TTIN/TTOU with default
+            # disposition stop too (a blocked TSTP would queue, but
+            # stop-at-generation matches the kernel's wake-and-stop
+            # behavior closely enough for a terminal-less sim).
+            self.stop_process(host, sig)
+            return
         live = [t for t in self.threads if t.state != ST_EXITED]
         if not live:
             return
@@ -615,6 +636,11 @@ class ManagedThread:
     def resume(self, host) -> None:
         if self.state == ST_EXITED:
             return
+        if self.process.stopped:
+            # Job control: defer until SIGCONT (the native process
+            # stays parked in its channel recv meanwhile).
+            self.process._stopped_resumes.append(self.resume)
+            return
         self.state = ST_RUNNABLE
         self.block.set_sim_time(host.now())
 
@@ -653,6 +679,12 @@ class ManagedThread:
                 return
         else:
             self._sig_interrupted = False
+
+        if self.process.stopped:
+            # A signal delivered above froze the process: everything
+            # owed (response, call re-run, the pump) waits for SIGCONT.
+            self.process._stopped_resumes.append(self.resume)
+            return
 
         if self._pending_response is not None:
             kind, value = self._pending_response
@@ -717,6 +749,13 @@ class ManagedThread:
             disp = sigs.disposition(sig)
             if disp == "ignore":
                 continue
+            if disp == "stop":
+                # A pending stop signal whose action reverted to
+                # default: freeze the process and stop delivering —
+                # the caller's response point parks the owed response
+                # (_send_response_or_park) until SIGCONT.
+                self.process.stop_process(host, sig)
+                return "none"
             if disp == "terminate":
                 self.process.terminate_by_signal(host, sig)
                 return "dead"
@@ -758,10 +797,14 @@ class ManagedThread:
             return False
         if cont[0] == "resp":
             _k, rk, rv, restore = cont
-            self.chan.send_to_shim(rk, rv)
             if restore is not None:
                 self.sig_mask = restore
-            return True
+            return self._send_response_or_park(host, rk, rv)
+        if self.process.stopped:
+            # Stop delivered above: defer the SA_RESTART re-dispatch.
+            self._pending_call = (cont[1], tuple(cont[2]))
+            self.process._stopped_resumes.append(self.resume)
+            return False
         _k, num, args = cont  # ("call", ...) — SA_RESTART re-dispatch
         return self._service(host, num, args, restarted=False)
 
@@ -907,6 +950,19 @@ class ManagedThread:
                                   TaskRef("cpu-latency", self.resume))
             return False
 
+        return self._send_response_or_park(host, rv_kind, rv_val)
+
+    def _send_response_or_park(self, host, rv_kind, rv_val) -> bool:
+        """Send a syscall response — unless the process stopped while
+        servicing it (a self-directed SIGSTOP, or a stop delivered at
+        this response point): the kernel returns from the interrupted
+        syscall only after the continue, so park the owed response and
+        re-arm through the deferred-resume list.  Returns True to keep
+        pumping."""
+        if self.process.stopped:
+            self._pending_response = (rv_kind, rv_val)
+            self.process._stopped_resumes.append(self.resume)
+            return False
         self.chan.send_to_shim(rv_kind, rv_val)
         return True
 

@@ -50,6 +50,11 @@ class Thread:
         (managed_thread.rs:190-333 event loop)."""
         if self.state == ST_EXITED:
             return
+        if self.process.stopped:
+            # Job control: the process is stopped — park this resume
+            # until SIGCONT flushes it.
+            self.process._stopped_resumes.append(self.resume)
+            return
         self.state = ST_RUNNABLE
         process = self.process
         while True:
@@ -152,6 +157,20 @@ class Process:
         # fork children inherit the parent's (managed.py _do_fork).
         self.pgid = self.pid
         self.sid = self.pid
+        # Stop/continue state (ref: process.rs stop/continue handling):
+        # a stopped process consumes no events — thread resumes defer
+        # into _stopped_resumes until SIGCONT flushes them.  stop_report
+        # / continue_report feed wait4's WUNTRACED/WCONTINUED exactly
+        # once per transition.
+        self.stopped = False
+        self._stopped_resumes: list = []
+        self.stop_report: int | None = None
+        self.continue_report = False
+        # Signals (other than KILL/CONT) raised while stopped: Linux
+        # keeps them pending until the continue — the stop shields even
+        # fatal defaults (signal.c: only SIGKILL/SIGCONT wake a stopped
+        # task).
+        self._stopped_sigs: list = []
         self.signal_fds: list = []  # signalfd(2) watchers
         self._nonzero_exit: int | None = None  # first failing thread wins
         self.stdout = bytearray()
@@ -237,16 +256,93 @@ class Process:
         self.raise_signal(host, SIGCHLD, si_code=code, si_pid=child.pid,
                           si_status=status)
 
+    # -- job control (ref: process.rs stop/continue handling) ---------
+
+    def stop_process(self, host, sig: int) -> None:
+        """SIGSTOP/SIGTSTP default action: freeze — subsequent thread
+        resumes defer until SIGCONT; the parent is notified (SIGCHLD
+        CLD_STOPPED unless SA_NOCLDSTOP) and parked wait4s re-check."""
+        if self.exited or self.stopped:
+            return
+        self.stopped = True
+        self.stop_report = sig
+        self.continue_report = False
+        # kernel prepare_signal(): generating a stop signal discards
+        # pending SIGCONT.
+        from shadow_tpu.host.signals import CLD_STOPPED, SIGCONT
+        self.signals.pending_process.discard(SIGCONT)
+        for t in self.threads:
+            getattr(t, "sig_pending", set()).discard(SIGCONT)
+        self._notify_parent_jobctl(host, CLD_STOPPED, sig)
+
+    def continue_process(self, host) -> None:
+        """SIGCONT side-effect (fires at raise time regardless of the
+        signal's disposition, like the kernel): flush every deferred
+        resume back onto the event queue."""
+        if self.exited or not self.stopped:
+            return
+        self.stopped = False
+        self.stop_report = None
+        self.continue_report = True
+        # kernel prepare_signal(): SIGCONT discards pending stop sigs.
+        from shadow_tpu.host.signals import (_STOP_SIGNALS, CLD_CONTINUED,
+                                             SIGCONT)
+        self.signals.pending_process.difference_update(_STOP_SIGNALS)
+        for t in self.threads:
+            getattr(t, "sig_pending", set()).difference_update(
+                _STOP_SIGNALS)
+        resumes, self._stopped_resumes = self._stopped_resumes, []
+        from shadow_tpu.core.event import TaskRef
+        for r in resumes:
+            host.schedule_task_at(
+                host.now(), TaskRef("sigcont-resume",
+                                    lambda h, _r=r: _r(h)))
+        self._notify_parent_jobctl(host, CLD_CONTINUED, SIGCONT)
+        # Signals the stop shielded deliver now, in raise order.
+        shielded, self._stopped_sigs = self._stopped_sigs, []
+        for sig, code, pid, status in shielded:
+            self.raise_signal(host, sig, si_code=code, si_pid=pid,
+                              si_status=status)
+
+    def _notify_parent_jobctl(self, host, code: int, sig: int) -> None:
+        from shadow_tpu.host.signals import (SA_NOCLDSTOP, SIGCHLD)
+        parent = host.processes.get(self.parent_pid) \
+            if self.parent_pid is not None else None
+        if parent is None or parent.exited:
+            return
+        waiters, parent._wait_conds = parent._wait_conds, []
+        for cond in waiters:
+            cond.fire(host)
+        act = parent.signals.action(SIGCHLD)
+        if not (act.flags & SA_NOCLDSTOP):
+            parent.raise_signal(host, SIGCHLD, si_code=code,
+                                si_pid=self.pid, si_status=sig)
+
     def raise_signal(self, host, sig: int, target_tid=None,
                      si_code: int = 0, si_pid: int = 0,
                      si_status: int = 0) -> None:
         """Internal (Python) apps have no handler mechanism: non-ignored
-        signals apply the default action — terminate (man 7 signal).
-        ManagedProcess overrides this with full handler delivery."""
-        from shadow_tpu.host.signals import NSIG
+        signals apply the default action — terminate, stop, or continue
+        (man 7 signal).  ManagedProcess overrides this with full
+        handler delivery."""
+        from shadow_tpu.host.signals import NSIG, SIGCONT, SIGKILL
         if self.exited or sig <= 0 or sig >= NSIG:
             return
-        if self.signals.disposition(sig) == "ignore":
+        if sig == SIGCONT:
+            self.continue_process(host)
+            return  # default SIGCONT action beyond the continue: ignore
+        disp = self.signals.disposition(sig)
+        if self.stopped and sig != SIGKILL:
+            # The stop shields everything but KILL/CONT until the
+            # continue (signal.c: stopped tasks don't wake for them).
+            if disp not in ("ignore", "stop"):
+                self._stopped_sigs.append((sig, si_code, si_pid,
+                                           si_status))
+            return
+        if disp == "ignore":
+            return
+        if disp == "stop":
+            self.stop_process(host, sig)
             return
         self.term_signal = sig
         for t in list(self.threads):

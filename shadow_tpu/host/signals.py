@@ -106,11 +106,20 @@ def bit(sig: int) -> int:
     return 1 << (sig - 1)
 
 
-# Default dispositions (man 7 signal).  Stop/continue job control is not
-# modeled (the simulation has no terminal): stop signals are discarded
-# with a one-shot warning, SIGCONT's default (continue) is a no-op.
+# Default dispositions (man 7 signal).  Stop/continue job control IS
+# modeled at the process level (stopped processes consume no events
+# until SIGCONT; wait4 reports via WUNTRACED/WCONTINUED); there is no
+# controlling terminal, so SIGTTIN/SIGTTOU only arrive via explicit
+# kill.  SIGCONT's continue side-effect fires at raise time regardless
+# of disposition (kernel semantics), so its default action here is
+# "ignore".
 _DEFAULT_IGNORE = frozenset({SIGCHLD, SIGURG, SIGWINCH, SIGCONT})
 _STOP_SIGNALS = frozenset({SIGSTOP, SIGTSTP, SIGTTIN, SIGTTOU})
+
+# SIGCHLD si_code values for job control (uapi/asm-generic/siginfo.h;
+# CLD_EXITED/CLD_KILLED live with the other si_code constants above).
+CLD_STOPPED, CLD_CONTINUED = 5, 6
+SA_NOCLDSTOP = 0x00000001
 
 # Hardware-fault signals: the app's sigaction is additionally installed
 # natively so a *real* fault in managed code (e.g. a GC's intentional
@@ -154,12 +163,11 @@ class ProcessSignals:
     """Per-process emulated signal state (actions are process-wide,
     masks are per-thread and live on the thread objects)."""
 
-    __slots__ = ("actions", "pending_process", "warned_stop", "info")
+    __slots__ = ("actions", "pending_process", "info")
 
     def __init__(self):
         self.actions: dict[int, SigAction] = {}
         self.pending_process: set[int] = set()
-        self.warned_stop = False
         # Per-pending-signal siginfo: sig -> (si_code, si_pid, si_status).
         # Standard (non-RT) signals carry one instance, like the kernel.
         self.info: dict[int, tuple] = {}
@@ -177,13 +185,15 @@ class ProcessSignals:
         return child
 
     def disposition(self, sig: int) -> str:
-        """'handler' | 'ignore' | 'terminate'."""
+        """'handler' | 'ignore' | 'terminate' | 'stop'."""
         if sig == SIGKILL:
             return "terminate"
-        if sig in _STOP_SIGNALS:
-            return "ignore"  # job control not modeled
+        if sig == SIGSTOP:
+            return "stop"  # uncatchable, unblockable
         act = self.actions.get(sig)
         if act is None or act.handler == SIG_DFL:
+            if sig in _STOP_SIGNALS:
+                return "stop"
             return "ignore" if sig in _DEFAULT_IGNORE else "terminate"
         if act.handler == SIG_IGN:
             return "ignore"

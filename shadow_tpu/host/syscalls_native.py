@@ -2502,10 +2502,36 @@ class NativeSyscallHandler:
                 return True
         return False
 
+    _WUNTRACED = 2
+    _WCONTINUED = 8
+
+    def _jobctl_report(self, host, process, pid: int, options: int):
+        """WUNTRACED/WCONTINUED: one report per stop/continue
+        transition (Linux wait semantics); returns (child_pid, status)
+        or None.  Iteration over host.processes is pid-ordered —
+        deterministic."""
+        if not (options & (self._WUNTRACED | self._WCONTINUED)):
+            return None
+        for p in host.processes.values():
+            if p.exited or p.parent_pid != process.pid or \
+                    not self._wait_matches(host, process, pid, p):
+                continue
+            if (options & self._WUNTRACED) and p.stopped \
+                    and p.stop_report is not None:
+                sig = p.stop_report
+                p.stop_report = None
+                return p.pid, (sig << 8) | 0x7F
+            if (options & self._WCONTINUED) and p.continue_report:
+                p.continue_report = False
+                return p.pid, 0xFFFF
+        return None
+
     def sys_wait4(self, host, process, thread, restarted, pid, status_ptr,
                   options, rusage_ptr, *_):
         pid = _sext32(pid)
         reaped = self._reap_zombie(host, process, pid)
+        if reaped is None:
+            reaped = self._jobctl_report(host, process, pid, options)
         if reaped is not None:
             zpid, status = reaped
             if status_ptr:

@@ -91,3 +91,22 @@ def test_pthread_output_deterministic_across_runs(plugin):
         assert summary.ok
         outs.append(bytes(proc.stdout).decode())
     assert outs[0] == outs[1]
+
+
+def test_pthread_storm_native(plugin):
+    exe = plugin("pthread_storm")
+    native = subprocess.run([exe], capture_output=True, text=True,
+                            timeout=120)
+    assert native.returncode == 0, native.stdout + native.stderr
+    assert "storm threads=8 bad=0 signals=1" in native.stdout
+
+
+def test_pthread_storm_simulated(plugin):
+    """8 threads x 400 channel-bound syscalls with SIGUSR1 volleys
+    interleaved: the per-thread IPC channels and the signal-delivery
+    protocol survive real thread/signal pressure (VERDICT r3 item 10,
+    the in-sim half of the loom stand-in)."""
+    exe = plugin("pthread_storm")
+    _, _, proc = run_one_host(exe, stop="30s")
+    assert proc.exited and proc.exit_code == 0, bytes(proc.stderr)
+    assert b"storm threads=8 bad=0 signals=1" in bytes(proc.stdout)

@@ -195,3 +195,43 @@ def test_sig_ucontext_simulated(plugin):
     out = bytes(proc.stdout)
     assert b"UCONTEXT sig=15 rip=1 rsp=1 usr1=1 usr2=0" in out
     assert b"DONE" in out
+
+
+def test_job_control_native(plugin):
+    exe = plugin("job_control")
+    native = subprocess.run([exe], capture_output=True, text=True,
+                            timeout=120)
+    assert native.returncode == 0, native.stdout + native.stderr
+    assert "jobctl stopped=1 continued=1 terminated=1" in native.stdout
+
+
+def test_job_control_simulated(plugin):
+    """SIGSTOP freezes the child (no event consumption), waitpid
+    observes it via WUNTRACED, SIGCONT resumes the deferred wakeups and
+    reports via WCONTINUED, and the final SIGTERM reaps normally
+    (VERDICT r3 missing item 6; ref process.rs stop/continue)."""
+    exe = plugin("job_control")
+    _, _, proc = run_host_yaml(exe, stop="30s")
+    assert proc.exited and proc.exit_code == 0, \
+        bytes(proc.stdout) + bytes(proc.stderr)
+    assert b"jobctl stopped=1 continued=1 terminated=1" in \
+        bytes(proc.stdout)
+
+
+@pytest.mark.parametrize("mode,verdict", [
+    ("selfstop", b"selfstop stopped=1 exited=1"),
+    ("shield", b"shield stopped=1 held=1 terminated=1"),
+])
+def test_job_control_edge_modes(plugin, mode, verdict):
+    """raise(SIGSTOP) freezes INSIDE the kill syscall (response parked
+    until SIGCONT), and a stopped process shields non-KILL fatal
+    signals until the continue — both dual-target."""
+    exe = plugin("job_control")
+    native = subprocess.run([exe, mode], capture_output=True, text=True,
+                            timeout=120)
+    assert native.returncode == 0, native.stdout + native.stderr
+    assert verdict.decode() in native.stdout
+    _, _, proc = run_host_yaml(exe, args=(mode,), stop="30s")
+    assert proc.exited and proc.exit_code == 0, \
+        bytes(proc.stdout) + bytes(proc.stderr)
+    assert verdict in bytes(proc.stdout)
