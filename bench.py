@@ -283,14 +283,16 @@ def main() -> None:
     # jitted device kernel (tpu_min_device_batch=0), short window — on
     # a tunnelled chip each dispatch pays a full round trip, and this
     # number shows what the accelerator itself delivers vs the cost
-    # model's blended route above.
+    # model's blended route above.  0.15 sim-s ≈ 100+ dispatches: a
+    # statistically solid per-dispatch sample without taxing the bench
+    # budget (2 sim-s through a tunnel was ~15 min of wall).
     fd_summary, fd_wall = run_once(
-        lambda s: config_10k(s, stop_s=2, tpu_min_device_batch=0),
+        lambda s: config_10k(s, stop_s="0.15", tpu_min_device_batch=0),
         "tpu", report_routes="10k-forced-device")
     print(f"bench[10k-forced-device]: {fd_summary.packets_sent} packets "
           f"in {fd_wall:.1f}s wall over {fd_summary.busy_end_ns / 1e9:.2f} "
           f"sim-s = {fd_summary.busy_end_ns / 1e9 / fd_wall:.3f} "
-          f"sim-s/wall-s (2 sim-s window)", file=sys.stderr)
+          f"sim-s/wall-s (0.15 sim-s window)", file=sys.stderr)
 
     # Sharded rung: the same 10k workload over an 8-shard host mesh
     # (engine-fused MeshPropagator; trace byte-identity vs serial is

@@ -127,7 +127,16 @@ def _disable_aslr_once() -> None:
 class MemoryManager:
     """Zero-copy-ish access to managed-process memory via /proc/pid/mem
     (ref: memory_copier.rs; the remapping MemoryMapper optimization is
-    future work)."""
+    future work — the aggregate accounting below is the measured basis
+    for that decision, docs/PARITY.md)."""
+
+    # Aggregate copier accounting across all managed processes
+    # (read in sim-stats and by scripts/measure_memcopy.py).
+    total_read_bytes = 0
+    total_read_ns = 0
+    total_write_bytes = 0
+    total_write_ns = 0
+    total_calls = 0
 
     def __init__(self, pid: int):
         self.pid = pid
@@ -136,7 +145,12 @@ class MemoryManager:
     def read(self, addr: int, n: int) -> bytes:
         if n <= 0:
             return b""
+        t0 = _walltime.perf_counter_ns()
         data = os.pread(self._fd, n, addr)
+        cls = MemoryManager
+        cls.total_read_ns += _walltime.perf_counter_ns() - t0
+        cls.total_read_bytes += len(data)
+        cls.total_calls += 1
         if len(data) != n:
             raise OSError(14, "short read from managed process memory")
         return data
@@ -150,7 +164,13 @@ class MemoryManager:
     def write(self, addr: int, data: bytes) -> None:
         if not data:
             return
-        if os.pwrite(self._fd, data, addr) != len(data):
+        t0 = _walltime.perf_counter_ns()
+        r = os.pwrite(self._fd, data, addr)
+        cls = MemoryManager
+        cls.total_write_ns += _walltime.perf_counter_ns() - t0
+        cls.total_write_bytes += len(data)
+        cls.total_calls += 1
+        if r != len(data):
             raise OSError(14, "short write to managed process memory")
 
     def read_cstr(self, addr: int, limit: int = 4096) -> bytes:
@@ -443,12 +463,15 @@ class ManagedProcess(Process):
             self.continue_process(host)
         elif self.stopped:
             # The stop shields everything but KILL/CONT until the
-            # continue: queue as process-pending; it surfaces at the
-            # first response point after SIGCONT.
-            if sigs.disposition(sig) not in ("ignore", "stop"):
-                self._queue_siginfo(sig, siginfo)
-                sigs.pending_process.add(sig)
-                self.refresh_signal_fds(host)
+            # continue.  Defer the ENTIRE raise — thread targeting,
+            # blocked-pending semantics, condition interrupts — to be
+            # re-run by continue_process; re-implementing any slice of
+            # it here would drop invariants (signalfd's blocked-stays-
+            # pending, tgkill's per-thread pending set, EINTR wakes of
+            # still-blocked threads).
+            if sigs.disposition(sig) != "stop":  # already stopped
+                self._stopped_sigs.append((sig, target_tid, si_code,
+                                           si_pid, si_status))
             return
         elif sigs.disposition(sig) == "stop":
             # SIGSTOP is unblockable; TSTP/TTIN/TTOU with default

@@ -298,11 +298,13 @@ class Process:
                 host.now(), TaskRef("sigcont-resume",
                                     lambda h, _r=r: _r(h)))
         self._notify_parent_jobctl(host, CLD_CONTINUED, SIGCONT)
-        # Signals the stop shielded deliver now, in raise order.
+        # Signals the stop shielded are re-raised now, in raise order,
+        # through the full raise path (thread targeting, blocked
+        # queueing, condition interrupts all re-run).
         shielded, self._stopped_sigs = self._stopped_sigs, []
-        for sig, code, pid, status in shielded:
-            self.raise_signal(host, sig, si_code=code, si_pid=pid,
-                              si_status=status)
+        for sig, tid, code, pid, status in shielded:
+            self.raise_signal(host, sig, target_tid=tid, si_code=code,
+                              si_pid=pid, si_status=status)
 
     def _notify_parent_jobctl(self, host, code: int, sig: int) -> None:
         from shadow_tpu.host.signals import (SA_NOCLDSTOP, SIGCHLD)
@@ -336,8 +338,8 @@ class Process:
             # The stop shields everything but KILL/CONT until the
             # continue (signal.c: stopped tasks don't wake for them).
             if disp not in ("ignore", "stop"):
-                self._stopped_sigs.append((sig, si_code, si_pid,
-                                           si_status))
+                self._stopped_sigs.append((sig, target_tid, si_code,
+                                           si_pid, si_status))
             return
         if disp == "ignore":
             return

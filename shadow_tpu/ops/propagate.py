@@ -191,14 +191,32 @@ class DeviceRouteModel:
             else 0.7 * prev + 0.3 * per_pkt
 
 
+_KERNEL_CACHE: dict = {}
+
+
 def build_propagate_kernel(latency_ns: np.ndarray, thresholds: np.ndarray,
                            k0: int, k1: int):
     """Returns a jitted fn(src_node, dst_node, src_host, pkt_seq, t_send,
     is_ctl, valid, window_end, after_bootstrap_mask_base) -> arrays.
 
     The routing matrices are closed over and transferred to the device
-    once; per-round traffic is O(packets), not O(V^2).
+    once; per-round traffic is O(packets), not O(V^2).  Kernels are
+    cached per (matrices, keys): a fresh Manager for the same config
+    (bench trials, repeated sims in one process) reuses the jitted
+    function — and with it XLA's compiled executables — instead of
+    paying a recompile per run (through a tunnelled device that tax is
+    seconds per trial).
     """
+    import hashlib
+
+    lat_c = np.ascontiguousarray(latency_ns, dtype=np.int64)
+    thr_c = np.ascontiguousarray(thresholds, dtype=np.int64)
+    key = (lat_c.shape, hashlib.sha1(lat_c.tobytes()).hexdigest(),
+           hashlib.sha1(thr_c.tobytes()).hexdigest(), int(k0), int(k1))
+    cached = _KERNEL_CACHE.get(key)
+    if cached is not None:
+        return cached
+
     import jax
     import jax.numpy as jnp
 
@@ -226,6 +244,7 @@ def build_propagate_kernel(latency_ns: np.ndarray, thresholds: np.ndarray,
         min_latency = jnp.min(jnp.where(keep, latency, _I64_MAX))
         return deliver, keep, reachable, lossy, min_deliver, min_latency
 
+    _KERNEL_CACHE[key] = kernel
     return kernel
 
 

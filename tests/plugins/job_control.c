@@ -65,11 +65,40 @@ static int mode_shield(void) {
     return stopped_ok && still_stopped && term_ok ? 0 : 1;
 }
 
+static int mode_shieldblock(void) {
+    /* The child is parked in a blocking read (no timer self-wake):
+     * after STOP -> TERM -> CONT, the shielded SIGTERM must interrupt
+     * the still-blocked read and kill the child promptly. */
+    int pfd[2];
+    if (pipe(pfd) != 0) return 2;
+    pid_t pid = fork();
+    if (pid == 0) {
+        char b;
+        read(pfd[0], &b, 1); /* blocks forever */
+        _exit(7);
+    }
+    struct timespec ts = {0, 200 * 1000 * 1000};
+    nanosleep(&ts, NULL);
+    kill(pid, SIGSTOP);
+    int st = 0;
+    pid_t r = waitpid(pid, &st, WUNTRACED);
+    int stopped_ok = r == pid && WIFSTOPPED(st);
+    kill(pid, SIGTERM);
+    kill(pid, SIGCONT);
+    r = waitpid(pid, &st, 0);
+    int term_ok = r == pid && WIFSIGNALED(st) && WTERMSIG(st) == SIGTERM;
+    printf("shieldblock stopped=%d terminated=%d\n", stopped_ok, term_ok);
+    fflush(stdout);
+    return stopped_ok && term_ok ? 0 : 1;
+}
+
 int main(int argc, char **argv) {
     if (argc > 1 && strcmp(argv[1], "selfstop") == 0)
         return mode_selfstop();
     if (argc > 1 && strcmp(argv[1], "shield") == 0)
         return mode_shield();
+    if (argc > 1 && strcmp(argv[1], "shieldblock") == 0)
+        return mode_shieldblock();
     pid_t pid = fork();
     if (pid == 0) {
         for (;;) {
