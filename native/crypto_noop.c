@@ -1,0 +1,61 @@
+/* Opt-in crypto no-op preload (ref: src/lib/preload-openssl/crypto.c —
+ * the Tor-simulation perf hack).  Preloaded AFTER the shim, only when
+ * `experimental.openssl_crypto_noop: true`: symmetric-cipher work in
+ * the managed process becomes an identity transform, trading crypto
+ * fidelity for wall time in sims whose packet payloads are opaque to
+ * the measurement (relay traffic).
+ *
+ * Deliberate difference from the reference: it additionally no-ops
+ * EVP_EncryptUpdate for non-libssl callers, identified by a fragile
+ * backtrace walk; we skip EVP_EncryptUpdate entirely and keep no
+ * caller heuristics.  AES_*, the ctr128 mode loops, and EVP_Cipher —
+ * the hot onion-relay path the hack exists for — are covered.  Like
+ * the reference's lib, enabling this breaks ALL real symmetric
+ * crypto, including TLS record protection: a sim doing genuine TLS
+ * handshakes/transfers must not set openssl_crypto_noop.
+ *
+ * This lib must do nothing clever: no constructor, no dlsym, no state.
+ * The symbols simply shadow libcrypto's when the lib is present. */
+#include <stddef.h>
+#include <string.h>
+
+void AES_encrypt(const unsigned char *in, unsigned char *out,
+                 const void *key) {
+    (void)in; (void)out; (void)key;
+}
+
+void AES_decrypt(const unsigned char *in, unsigned char *out,
+                 const void *key) {
+    (void)in; (void)out; (void)key;
+}
+
+void AES_ctr128_encrypt(const unsigned char *in, unsigned char *out,
+                        size_t len, const void *key, unsigned char *ivec,
+                        unsigned char *ecount_buf, unsigned int *num) {
+    (void)key; (void)ivec; (void)ecount_buf; (void)num;
+    memmove(out, in, len);
+}
+
+void CRYPTO_ctr128_encrypt(const unsigned char *in, unsigned char *out,
+                           size_t len, const void *key,
+                           unsigned char *ivec, unsigned char *ecount_buf,
+                           unsigned int *num, void *block) {
+    (void)key; (void)ivec; (void)ecount_buf; (void)num; (void)block;
+    memmove(out, in, len);
+}
+
+void CRYPTO_ctr128_encrypt_ctr32(const unsigned char *in,
+                                 unsigned char *out, size_t len,
+                                 const void *key, unsigned char *ivec,
+                                 unsigned char *ecount_buf,
+                                 unsigned int *num, void *func) {
+    (void)key; (void)ivec; (void)ecount_buf; (void)num; (void)func;
+    memmove(out, in, len);
+}
+
+int EVP_Cipher(void *ctx, unsigned char *out, const unsigned char *in,
+               unsigned int inl) {
+    (void)ctx;
+    memmove(out, in, (size_t)inl);
+    return 1;
+}

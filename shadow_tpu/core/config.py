@@ -131,6 +131,12 @@ class ExperimentalConfig:
     # Pin worker threads to distinct CPUs (ref: affinity.c, on by
     # default; docs/parallel_sims.md reports ~3x cost when off).
     use_cpu_pinning: bool = True
+    # Opt-in crypto no-op preload for managed processes (ref:
+    # preload-openssl/crypto.c, the Tor-sim perf hack): AES/ctr128
+    # symmetric-cipher work becomes an identity transform.  Breaks real
+    # crypto correctness by design; off unless a sim explicitly trades
+    # fidelity for wall time.
+    openssl_crypto_noop: bool = False
     # perf_timers cargo-feature equivalent: per-host execution wall time
     # in sim-stats.json (ref: utility/perf_timer.rs).
     use_perf_timers: bool = False
@@ -204,6 +210,7 @@ class ConfigOptions:
                 "tpu_shards": e.tpu_shards,
                 "tpu_exchange_capacity": e.tpu_exchange_capacity,
                 "native_dataplane": e.native_dataplane,
+                "openssl_crypto_noop": e.openssl_crypto_noop,
                 "use_cpu_pinning": e.use_cpu_pinning,
                 "use_perf_timers": e.use_perf_timers,
                 "report_errors_to_stderr": e.report_errors_to_stderr,
@@ -334,6 +341,7 @@ class ConfigOptions:
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
+                ("openssl_crypto_noop", "openssl_crypto_noop", bool),
                 ("use_perf_timers", "use_perf_timers", bool),
                 ("report_errors_to_stderr", "report_errors_to_stderr", bool)):
             if yaml_key in e:

@@ -94,6 +94,14 @@ class Manager:
             send_autotune=config.experimental.socket_send_autotune,
             recv_autotune=config.experimental.socket_recv_autotune)
 
+        # Opt-in crypto no-op preload: built ONCE here (worker threads
+        # spawning concurrently must not race make) and handed to
+        # hosts as a path.
+        crypto_noop_path = None
+        if config.experimental.openssl_crypto_noop:
+            from shadow_tpu.native import ensure_crypto_noop_built
+            crypto_noop_path = ensure_crypto_noop_built()
+
         # Build hosts in sorted-name order: host ids — and with them every
         # RNG stream and ordering tiebreak — are config-deterministic.
         from shadow_tpu.net.graph import IpAssignment
@@ -131,6 +139,7 @@ class Manager:
                     config.experimental.native_preemption_sim_interval_ns
             host.max_unapplied_ns = \
                 config.experimental.max_unapplied_cpu_latency_ns
+            host.crypto_noop = crypto_noop_path  # lib path or None
             bw = config.experimental.native_file_io_bandwidth_bps
             if config.general.model_unblocked_syscall_latency and bw > 0:
                 # ns per KiB at the modeled disk bandwidth.
@@ -706,12 +715,72 @@ class Manager:
             json.dump(stats, f, indent=2, sort_keys=True)
 
 
+def _topology_cpu_order(cpus: list[int]) -> list[int]:
+    """NUMA/SMT-aware worker CPU ordering (ref: affinity.c:1-464 —
+    the reference parses /sys topology to pick "good" worker CPUs).
+
+    Order: one logical CPU per PHYSICAL core first (hyperthread
+    siblings share execution units — two workers on one core is the
+    last resort), physical cores interleaved round-robin across NUMA
+    nodes (spreads memory traffic over controllers), then the
+    remaining SMT siblings in the same node-interleaved order.
+    Falls back to the input order when /sys is unreadable."""
+    def read_int(path: str) -> int:
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return 0
+
+    # cpu -> NUMA node (node directories own cpuN symlinks; reverse
+    # lookup via .../cpuN/node* is not always present, so scan).
+    cpu_node: dict[int, int] = {}
+    try:
+        for entry in os.listdir("/sys/devices/system/node"):
+            if not entry.startswith("node") or not entry[4:].isdigit():
+                continue
+            node = int(entry[4:])
+            for sub in os.listdir(f"/sys/devices/system/node/{entry}"):
+                if sub.startswith("cpu") and sub[3:].isdigit():
+                    cpu_node[int(sub[3:])] = node
+    except OSError:
+        pass
+
+    core_seen: set[tuple] = set()
+    primaries: list[tuple] = []   # (node, pkg, core, cpu)
+    siblings: list[tuple] = []
+    for cpu in cpus:
+        base = f"/sys/devices/system/cpu/cpu{cpu}/topology"
+        pkg = read_int(f"{base}/physical_package_id")
+        core = read_int(f"{base}/core_id")
+        key = (pkg, core)
+        row = (cpu_node.get(cpu, 0), pkg, core, cpu)
+        if key in core_seen:
+            siblings.append(row)
+        else:
+            core_seen.add(key)
+            primaries.append(row)
+
+    def node_interleave(rows: list[tuple]) -> list[int]:
+        by_node: dict[int, list[int]] = {}
+        for node, _pkg, _core, cpu in sorted(rows):
+            by_node.setdefault(node, []).append(cpu)
+        out: list[int] = []
+        queues = [by_node[n] for n in sorted(by_node)]
+        while any(queues):
+            for q in queues:
+                if q:
+                    out.append(q.pop(0))
+        return out
+
+    ordered = node_interleave(primaries) + node_interleave(siblings)
+    return ordered if ordered else cpus
+
+
 def _make_pinner():
-    """Round-robin worker-thread CPU pinning (ref: affinity.c — the
-    reference parses /sys topology for NUMA-aware choices; the allowed-
-    CPU list in creation order approximates that and keeps threads from
-    migrating, which is where the reported ~3x cost of unpinned runs
-    comes from, docs/parallel_sims.md:14-16)."""
+    """Worker-thread CPU pinning (ref: affinity.c; unpinned runs cost
+    up to ~3x, docs/parallel_sims.md:14-16).  Workers claim CPUs in
+    the topology-aware order above."""
     import itertools
     import threading
 
@@ -721,6 +790,7 @@ def _make_pinner():
         return None
     if not cpus:
         return None
+    cpus = _topology_cpu_order(cpus)
     counter = itertools.count()
     lock = threading.Lock()
 

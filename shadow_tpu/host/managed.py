@@ -294,10 +294,15 @@ class ManagedProcess(Process):
 
         env = dict(env)
         # Prepend the shim exactly once (an exec'd app passes through
-        # its environ, which already carries it).
+        # its environ, which already carries it).  The opt-in crypto
+        # no-op lib (ref preload-openssl/crypto.c) rides after it.
+        chain = [shim]
+        crypto_noop = getattr(host, "crypto_noop", None)
+        if crypto_noop:  # lib path, resolved once by the Manager
+            chain.append(crypto_noop)
         extra = [p for p in env.get("LD_PRELOAD", "").split(":")
-                 if p and p != shim]
-        preload = ":".join([shim] + extra)
+                 if p and p not in chain]
+        preload = ":".join(chain + extra)
         env["LD_PRELOAD"] = preload
         env["SHADOWTPU_IPC"] = ipc_path
         # Per-process shim diagnostics (ref: .shimlog files).  Absolute:

@@ -50,3 +50,24 @@ def ensure_shim_built() -> str:
             f"shim build failed (exit {proc.returncode}):\n"
             f"{proc.stdout}\n{proc.stderr}")
     return SHIM_SO
+
+
+CRYPTO_NOOP_SO = os.path.join(LIB_DIR, "libshadowtpu_crypto_noop.so")
+
+
+def ensure_crypto_noop_built() -> str:
+    """Build the opt-in crypto no-op preload (ref
+    preload-openssl/crypto.c) if missing/stale; return its path."""
+    sources = [os.path.join(_SRC_DIR, f)
+               for f in ("crypto_noop.c", "Makefile")]
+    if not _stale(CRYPTO_NOOP_SO, sources):
+        return CRYPTO_NOOP_SO
+    if not os.path.isdir(_SRC_DIR):
+        raise RuntimeError(f"native sources not found at {_SRC_DIR}")
+    proc = subprocess.run(["make", "-C", _SRC_DIR, "crypto_noop"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0 or not os.path.exists(CRYPTO_NOOP_SO):
+        raise RuntimeError(
+            f"crypto_noop build failed (exit {proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return CRYPTO_NOOP_SO

@@ -1,0 +1,31 @@
+/* Probe for the opt-in crypto no-op preload (ref
+ * preload-openssl/crypto.c): AES-encrypt a zero block and report
+ * whether the output differs from the input.  Real libcrypto produces
+ * ciphertext ("real"); under the no-op preload the output buffer is
+ * untouched ("noop").  Headers are absent in this image, so the two
+ * libcrypto symbols are declared by hand (AES_KEY is ≤244 bytes on
+ * every OpenSSL; 512 is safe). */
+#include <stdio.h>
+#include <string.h>
+
+typedef struct { unsigned char opaque[512]; } AES_KEY_BUF;
+extern int AES_set_encrypt_key(const unsigned char *userKey, int bits,
+                               AES_KEY_BUF *key);
+extern void AES_encrypt(const unsigned char *in, unsigned char *out,
+                        const AES_KEY_BUF *key);
+
+int main(void) {
+    AES_KEY_BUF key;
+    memset(&key, 0, sizeof(key));
+    unsigned char k[16] = {1, 2, 3};
+    if (AES_set_encrypt_key(k, 128, &key) != 0) {
+        puts("FAIL set_key");
+        return 1;
+    }
+    unsigned char in[16] = {0}, out[16] = {0};
+    AES_encrypt(in, out, &key);
+    int changed = memcmp(in, out, 16) != 0;
+    printf("aes=%s\n", changed ? "real" : "noop");
+    fflush(stdout);
+    return 0;
+}

@@ -132,3 +132,27 @@ def test_cpu_pushback_deterministic(tmp_path):
     assert max(runs[0]) > max(base)
     # Deterministic: the feed is modeled cost, not wall time.
     assert runs[0] == runs[1]
+
+
+def test_topology_cpu_order_properties():
+    """NUMA/SMT-aware pinning order (ref affinity.c): a permutation of
+    the input, with one-CPU-per-physical-core preferred (on this box's
+    real /sys topology) and a graceful fallback for unknown CPUs."""
+    from shadow_tpu.core.manager import _topology_cpu_order
+    import os
+    cpus = sorted(os.sched_getaffinity(0))
+    order = _topology_cpu_order(cpus)
+    assert sorted(order) == cpus            # permutation, nothing lost
+    # Primary block: no two entries share a physical core until every
+    # distinct core has appeared once.
+    def core_of(c):
+        base = f"/sys/devices/system/cpu/cpu{c}/topology"
+        try:
+            pkg = int(open(f"{base}/physical_package_id").read())
+            core = int(open(f"{base}/core_id").read())
+            return (pkg, core)
+        except OSError:
+            return (0, c)
+    cores = {core_of(c) for c in cpus}
+    primary = order[:len(cores)]
+    assert len({core_of(c) for c in primary}) == len(cores)

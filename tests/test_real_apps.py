@@ -380,3 +380,61 @@ network:
         outs.append(bytes(proc.stdout))
     assert b"order: [0, 1, 2, 3, 4, 5, 6, 7] elapsed: 0.8" in outs[0]
     assert outs[0] == outs[1]
+
+
+@pytest.mark.skipif(shutil.which("cc") is None, reason="no C toolchain")
+def test_crypto_noop_preload(tmp_path):
+    """experimental.openssl_crypto_noop (ref preload-openssl/crypto.c):
+    AES becomes an identity transform under the opt-in preload, stays
+    real without it — same binary, flag-controlled."""
+    import subprocess
+
+    src = os.path.join(os.path.dirname(__file__), "plugins",
+                       "crypto_noop_probe.c")
+    exe = str(tmp_path / "probe")
+    # No -dev symlink in this image: link the versioned runtime lib.
+    lib = None
+    for cand in ("/lib/x86_64-linux-gnu/libcrypto.so.3",
+                 "/usr/lib/x86_64-linux-gnu/libcrypto.so.3",
+                 "/usr/lib/libcrypto.so.3"):
+        if os.path.exists(cand):
+            lib = cand
+            break
+    if lib is None:
+        pytest.skip("no libcrypto runtime found")
+    r = subprocess.run(["cc", "-O1", "-o", exe, src, lib],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("libcrypto not linkable: " + r.stderr[-200:])
+    native = subprocess.run([exe], capture_output=True, text=True)
+    assert "aes=real" in native.stdout
+
+    def run(extra_exp=""):
+        yaml = f"""
+general:
+  stop_time: 10s
+  seed: 1
+  data_directory: {tmp_path}/d{len(extra_exp)}
+experimental:
+  scheduler: serial{extra_exp}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - {{ path: {exe}, args: [], start_time: 1s }}
+"""
+        cfg = ConfigOptions.from_yaml_text(yaml)
+        manager, _ = run_simulation(cfg)
+        proc = next(iter(manager.hosts[0].processes.values()))
+        return bytes(proc.stdout)
+
+    assert b"aes=real" in run()
+    assert b"aes=noop" in run("\n  openssl_crypto_noop: true")
