@@ -29,45 +29,35 @@ def _stale(target: str, sources: list[str]) -> bool:
                if os.path.exists(s))
 
 
-def ensure_shim_built() -> str:
-    """Build the shim if missing or out of date; return its path.
-
-    Raises RuntimeError (with the compiler output) when the toolchain is
-    unavailable or the build fails, so callers can surface a clear error
-    instead of a confusing spawn failure.
-    """
-    sources = [os.path.join(_SRC_DIR, f)
-               for f in ("shim.c", "shim_trampoline.S", "shim_ipc.h",
-                         "Makefile")]
-    if not _stale(SHIM_SO, sources):
-        return SHIM_SO
+def _ensure_built(so_path: str, target: str, source_names: list[str]) -> str:
+    """Build a native component if missing or out of date; return its
+    path.  Raises RuntimeError (with the compiler output) when the
+    toolchain is unavailable or the build fails, so callers can surface
+    a clear error instead of a confusing spawn failure."""
+    sources = [os.path.join(_SRC_DIR, f) for f in source_names]
+    if not _stale(so_path, sources):
+        return so_path
     if not os.path.isdir(_SRC_DIR):
         raise RuntimeError(f"native sources not found at {_SRC_DIR}")
-    proc = subprocess.run(["make", "-C", _SRC_DIR, "shim"],
+    proc = subprocess.run(["make", "-C", _SRC_DIR, target],
                           capture_output=True, text=True)
-    if proc.returncode != 0 or not os.path.exists(SHIM_SO):
+    if proc.returncode != 0 or not os.path.exists(so_path):
         raise RuntimeError(
-            f"shim build failed (exit {proc.returncode}):\n"
+            f"{target} build failed (exit {proc.returncode}):\n"
             f"{proc.stdout}\n{proc.stderr}")
-    return SHIM_SO
+    return so_path
+
+
+def ensure_shim_built() -> str:
+    return _ensure_built(SHIM_SO, "shim",
+                         ["shim.c", "shim_trampoline.S", "shim_ipc.h",
+                          "Makefile"])
 
 
 CRYPTO_NOOP_SO = os.path.join(LIB_DIR, "libshadowtpu_crypto_noop.so")
 
 
 def ensure_crypto_noop_built() -> str:
-    """Build the opt-in crypto no-op preload (ref
-    preload-openssl/crypto.c) if missing/stale; return its path."""
-    sources = [os.path.join(_SRC_DIR, f)
-               for f in ("crypto_noop.c", "Makefile")]
-    if not _stale(CRYPTO_NOOP_SO, sources):
-        return CRYPTO_NOOP_SO
-    if not os.path.isdir(_SRC_DIR):
-        raise RuntimeError(f"native sources not found at {_SRC_DIR}")
-    proc = subprocess.run(["make", "-C", _SRC_DIR, "crypto_noop"],
-                          capture_output=True, text=True)
-    if proc.returncode != 0 or not os.path.exists(CRYPTO_NOOP_SO):
-        raise RuntimeError(
-            f"crypto_noop build failed (exit {proc.returncode}):\n"
-            f"{proc.stdout}\n{proc.stderr}")
-    return CRYPTO_NOOP_SO
+    """Opt-in crypto no-op preload (ref preload-openssl/crypto.c)."""
+    return _ensure_built(CRYPTO_NOOP_SO, "crypto_noop",
+                         ["crypto_noop.c", "Makefile"])

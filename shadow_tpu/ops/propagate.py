@@ -125,6 +125,11 @@ class DeviceRouteModel:
         self._probe_countdown: dict[int, int] = {}
         self._probe_interval: dict[int, int] = {}
         self._compiled: set[int] = set()
+        # Smallest measured device dispatch time at ANY bucket: the
+        # round-trip floor (tunnel RTT, driver overhead) is bucket-
+        # independent, so one catastrophic probe teaches us about all
+        # sizes — without this, every bucket pays its own ~RTT probe.
+        self.dev_floor_ns: float | None = None
 
     def use_device(self, n: int, b: int) -> bool:
         """Routing choice for a round of n packets at bucket size b.
@@ -139,7 +144,14 @@ class DeviceRouteModel:
             return False  # host probe
         dev = self._dev_ns_by_bucket.get(b)
         if dev is None:
-            return True  # device probe
+            # Unmeasured bucket: only probe when even the cross-bucket
+            # dispatch FLOOR could win at this round size — through a
+            # ~100ms tunnel that one check saves a probe per bucket.
+            floor = self.dev_floor_ns
+            if floor is not None and floor > self.host_ns_per_pkt * n:
+                dev = floor  # treat as losing; fall into backoff below
+            else:
+                return True  # device probe
         if dev <= self.host_ns_per_pkt * n:
             # Winning: fully reset the backoff (interval AND countdown —
             # a stale countdown would defer the next losing-side probe
@@ -174,6 +186,8 @@ class DeviceRouteModel:
             self._compiled.add(b)
         if fresh_compile:
             return
+        if self.dev_floor_ns is None or dt_ns < self.dev_floor_ns:
+            self.dev_floor_ns = dt_ns
         prev = self._dev_ns_by_bucket.get(b)
         host = self.host_ns_per_pkt
         if prev is None or (host is not None and prev > host * n):

@@ -146,13 +146,17 @@ def test_topology_cpu_order_properties():
     # Primary block: no two entries share a physical core until every
     # distinct core has appeared once.
     def core_of(c):
+        # Same fallback as the implementation's read_int (unreadable
+        # or empty topology entries collapse to 0), so the two agree
+        # on partially populated /sys trees.
         base = f"/sys/devices/system/cpu/cpu{c}/topology"
-        try:
-            pkg = int(open(f"{base}/physical_package_id").read())
-            core = int(open(f"{base}/core_id").read())
-            return (pkg, core)
-        except OSError:
-            return (0, c)
+        def rd(name):
+            try:
+                with open(f"{base}/{name}") as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                return 0
+        return (rd("physical_package_id"), rd("core_id"))
     cores = {core_of(c) for c in cpus}
     primary = order[:len(cores)]
     assert len({core_of(c) for c in primary}) == len(cores)

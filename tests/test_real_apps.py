@@ -392,14 +392,19 @@ def test_crypto_noop_preload(tmp_path):
     src = os.path.join(os.path.dirname(__file__), "plugins",
                        "crypto_noop_probe.c")
     exe = str(tmp_path / "probe")
-    # No -dev symlink in this image: link the versioned runtime lib.
+    # No -dev symlink in this image: link the versioned runtime lib,
+    # located portably (multiarch dirs differ per architecture).
+    import ctypes.util
+    name = ctypes.util.find_library("crypto")
     lib = None
-    for cand in ("/lib/x86_64-linux-gnu/libcrypto.so.3",
-                 "/usr/lib/x86_64-linux-gnu/libcrypto.so.3",
-                 "/usr/lib/libcrypto.so.3"):
-        if os.path.exists(cand):
-            lib = cand
-            break
+    if name:
+        for prefix in ("/lib", "/usr/lib"):
+            for root, _dirs, files in os.walk(prefix):
+                if name in files:
+                    lib = os.path.join(root, name)
+                    break
+            if lib:
+                break
     if lib is None:
         pytest.skip("no libcrypto runtime found")
     r = subprocess.run(["cc", "-O1", "-o", exe, src, lib],
