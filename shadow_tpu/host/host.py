@@ -107,6 +107,10 @@ class Host:
         # barrier is then one min() over the list instead of a peek
         # into every host's queues each round.
         self._nt_list = None
+        # Shared bool slot (Manager array): True while this host has
+        # Python-side work (heap entries / undrained inbox) and so must
+        # skip the engine-only fast path.
+        self._py_work_arr = None
 
         # Canonical packet trace: (time, kind, src_host, pkt_seq, text).
         self.trace_entries: list = []
@@ -156,6 +160,8 @@ class Host:
         assert time >= self._now, f"task {task} scheduled in the past"
         self.queue.push(Event(time, KIND_LOCAL, self.id,
                               self.next_event_seq(), task))
+        if self._py_work_arr is not None:
+            self._py_work_arr[self.id] = True
 
     def schedule_task(self, delay_ns: int, task: TaskRef) -> None:
         self.schedule_task_at(self._now + delay_ns, task)
@@ -267,6 +273,14 @@ class Host:
                 if self._inbox_min < t:
                     t = self._inbox_min
                 self._nt_list[self.id] = t
+                if self._py_work_arr is not None:
+                    # Partition-flag recompute must share this lock: a
+                    # concurrent deliverer sets the flag True under it,
+                    # and an unlocked False store here could land last
+                    # and strand the delivered event on the engine-only
+                    # fast path.
+                    self._py_work_arr[self.id] = \
+                        bool(self.queue._heap) or bool(self._inbox)
 
     def next_event_time(self):
         t = self.queue.peek_time()
@@ -314,6 +328,8 @@ class Host:
             nt = self._nt_list
             if nt is not None and event.time < nt[self.id]:
                 nt[self.id] = event.time
+            if self._py_work_arr is not None:
+                self._py_work_arr[self.id] = True
 
     # ------------------------------------------------------------------
     # Processes
