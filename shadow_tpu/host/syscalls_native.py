@@ -2474,14 +2474,15 @@ class NativeSyscallHandler:
             return child.pgid == process.pgid
         return child.pgid == -pid
 
-    def _reap_zombie(self, host, process, pid: int):
-        """Pop a matching zombie child; returns (child_pid, status) or
-        None."""
+    def _reap_zombie(self, host, process, pid: int, consume: bool = True):
+        """Pop (or, under waitid's WNOWAIT, peek) a matching zombie
+        child; returns (child_pid, status) or None."""
         for zpid in process.zombies:
             if not self._wait_matches(host, process, pid,
                                       host.processes[zpid]):
                 continue
-            process.zombies.remove(zpid)
+            if consume:
+                process.zombies.remove(zpid)
             child = host.processes[zpid]
             if child.term_signal is not None:
                 status = child.term_signal & 0x7f
@@ -2505,10 +2506,12 @@ class NativeSyscallHandler:
     _WUNTRACED = 2
     _WCONTINUED = 8
 
-    def _jobctl_report(self, host, process, pid: int, options: int):
+    def _jobctl_report(self, host, process, pid: int, options: int,
+                       consume: bool = True):
         """WUNTRACED/WCONTINUED: one report per stop/continue
-        transition (Linux wait semantics); returns (child_pid, status)
-        or None.  Iteration over host.processes is pid-ordered —
+        transition (Linux wait semantics; waitid's WNOWAIT peeks
+        without clearing); returns (child_pid, status) or None.
+        Iteration over host.processes is pid-ordered —
         deterministic."""
         if not (options & (self._WUNTRACED | self._WCONTINUED)):
             return None
@@ -2519,10 +2522,12 @@ class NativeSyscallHandler:
             if (options & self._WUNTRACED) and p.stopped \
                     and p.stop_report is not None:
                 sig = p.stop_report
-                p.stop_report = None
+                if consume:
+                    p.stop_report = None
                 return p.pid, (sig << 8) | 0x7F
             if (options & self._WCONTINUED) and p.continue_report:
-                p.continue_report = False
+                if consume:
+                    p.continue_report = False
                 return p.pid, 0xFFFF
         return None
 
@@ -2561,6 +2566,11 @@ class NativeSyscallHandler:
     def sys_waitid(self, host, process, thread, restarted, idtype, id_,
                    info_ptr, options, rusage_ptr, *_):
         P_ALL, P_PID = 0, 1
+        W_EXITED, W_STOPPED, W_CONTINUED = 4, 2, 8
+        W_NOWAIT = 0x01000000
+        if not (options & (W_EXITED | W_STOPPED | W_CONTINUED)):
+            return _error(errno.EINVAL)  # Linux: must name a state set
+        consume = not (options & W_NOWAIT)  # WNOWAIT: peek, stay waitable
         if idtype == P_ALL:
             pid = -1
         elif idtype == P_PID:
@@ -2569,19 +2579,40 @@ class NativeSyscallHandler:
             pid = int(id_)
         else:
             return _error(errno.EINVAL)
-        reaped = self._reap_zombie(host, process, pid)
-        if reaped is not None:
-            zpid, status = reaped
+
+        from shadow_tpu.host.signals import (CLD_CONTINUED, CLD_STOPPED,
+                                             SIGCHLD, SIGCONT)
+
+        def write_info(zpid, code, st):
+            info = struct.pack("<iii", SIGCHLD, 0, code)
+            info += b"\0" * 4 + struct.pack("<iii", zpid, 1000, st)
+            process.mem.write(info_ptr,
+                              info + b"\0" * (128 - len(info)))
+
+        if options & W_EXITED:
+            reaped = self._reap_zombie(host, process, pid,
+                                       consume=consume)
+            if reaped is not None:
+                zpid, status = reaped
+                if info_ptr:
+                    CLD_EXITED, CLD_KILLED = 1, 2
+                    if status & 0x7f:
+                        code, st = CLD_KILLED, status & 0x7f
+                    else:
+                        code, st = CLD_EXITED, (status >> 8) & 0xff
+                    write_info(zpid, code, st)
+                return _done(0)
+        jc_opts = (self._WUNTRACED if options & W_STOPPED else 0) \
+            | (self._WCONTINUED if options & W_CONTINUED else 0)
+        jc = self._jobctl_report(host, process, pid, jc_opts,
+                                 consume=consume)
+        if jc is not None:
+            zpid, status = jc
             if info_ptr:
-                CLD_EXITED, CLD_KILLED = 1, 2
-                if status & 0x7f:
-                    code, st = CLD_KILLED, status & 0x7f
+                if status == 0xFFFF:
+                    write_info(zpid, CLD_CONTINUED, SIGCONT)
                 else:
-                    code, st = CLD_EXITED, (status >> 8) & 0xff
-                from shadow_tpu.host.signals import SIGCHLD
-                info = struct.pack("<iii", SIGCHLD, 0, code)
-                info += b"\0" * 4 + struct.pack("<iii", zpid, 1000, st)
-                process.mem.write(info_ptr, info + b"\0" * (128 - len(info)))
+                    write_info(zpid, CLD_STOPPED, (status >> 8) & 0xFF)
             return _done(0)
         if not self._has_children(host, process, pid):
             return _error(errno.ECHILD)

@@ -92,9 +92,50 @@ static int mode_shieldblock(void) {
     return stopped_ok && term_ok ? 0 : 1;
 }
 
+static int mode_waitid(void) {
+    /* waitid(2) with WSTOPPED/WCONTINUED: siginfo carries
+     * CLD_STOPPED/CLD_CONTINUED and the precipitating signal. */
+    pid_t pid = fork();
+    if (pid == 0) {
+        for (;;) {
+            struct timespec ts = {0, 50 * 1000 * 1000};
+            nanosleep(&ts, NULL);
+        }
+    }
+    struct timespec ts = {0, 200 * 1000 * 1000};
+    nanosleep(&ts, NULL);
+    kill(pid, SIGSTOP);
+    siginfo_t si;
+    memset(&si, 0, sizeof(si));
+    int r = waitid(P_PID, (id_t)pid, &si, WSTOPPED);
+    int stop_ok = r == 0 && si.si_code == CLD_STOPPED &&
+                  si.si_pid == pid && si.si_status == SIGSTOP;
+    kill(pid, SIGCONT);
+    memset(&si, 0, sizeof(si));
+    r = waitid(P_PID, (id_t)pid, &si, WCONTINUED);
+    int cont_ok = r == 0 && si.si_code == CLD_CONTINUED &&
+                  si.si_pid == pid;
+    kill(pid, SIGKILL);
+    /* WNOWAIT peek must leave the child waitable for the real reap. */
+    memset(&si, 0, sizeof(si));
+    r = waitid(P_PID, (id_t)pid, &si, WEXITED | WNOWAIT);
+    int peek_ok = r == 0 && si.si_code == CLD_KILLED &&
+                  si.si_status == SIGKILL;
+    memset(&si, 0, sizeof(si));
+    r = waitid(P_PID, (id_t)pid, &si, WEXITED);
+    int kill_ok = r == 0 && si.si_code == CLD_KILLED &&
+                  si.si_status == SIGKILL;
+    printf("waitid stopped=%d continued=%d peeked=%d killed=%d\n",
+           stop_ok, cont_ok, peek_ok, kill_ok);
+    fflush(stdout);
+    return stop_ok && cont_ok && peek_ok && kill_ok ? 0 : 1;
+}
+
 int main(int argc, char **argv) {
     if (argc > 1 && strcmp(argv[1], "selfstop") == 0)
         return mode_selfstop();
+    if (argc > 1 && strcmp(argv[1], "waitid") == 0)
+        return mode_waitid();
     if (argc > 1 && strcmp(argv[1], "shield") == 0)
         return mode_shield();
     if (argc > 1 && strcmp(argv[1], "shieldblock") == 0)

@@ -76,3 +76,32 @@ def test_engine_tpc_default_stays_pure_python():
     m_tpc, s_tpc = run_mesh("thread_per_core")
     assert s_tpc.ok
     assert m_tpc.plane is None
+
+
+def test_engine_tpc_mt_two_runs_byte_identical():
+    """Two runs of engine thread_per_core with parallelism=4 (4 OS
+    threads inside run_hosts_mt, even on a 1-core box the kernel
+    interleaves them) must byte-match each other AND the serial
+    trace — the system-level race detector for the MT engine
+    (determinism-as-race-detection, ref docs/testing_determinism.md)."""
+    m_ser, s_ser = run_mesh("serial")
+    assert s_ser.ok
+    runs = []
+    for _ in range(2):
+        text = udp_mesh_yaml(24, n_nodes=6, floods_per_host=2, count=4,
+                             size=500, stop_time="8s", seed=3,
+                             scheduler="thread_per_core",
+                             experimental_extra={"native_dataplane":
+                                                 "on"})
+        cfg = ConfigOptions.from_yaml_text(text)
+        cfg.general.parallelism = 4
+        m, s = run_simulation(cfg)
+        assert s.ok
+        runs.append(m)
+    if runs[0].plane is None:
+        pytest.skip("native plane unavailable")
+    batches, _ = runs[0].plane.engine.mt_stats()
+    assert batches > 0
+    t0, t1 = runs[0].trace_lines(), runs[1].trace_lines()
+    assert t0 == t1
+    assert t0 == m_ser.trace_lines()

@@ -222,6 +222,7 @@ def test_job_control_simulated(plugin):
     ("selfstop", b"selfstop stopped=1 exited=1"),
     ("shield", b"shield stopped=1 held=1 terminated=1"),
     ("shieldblock", b"shieldblock stopped=1 terminated=1"),
+    ("waitid", b"waitid stopped=1 continued=1 peeked=1 killed=1"),
 ])
 def test_job_control_edge_modes(plugin, mode, verdict):
     """raise(SIGSTOP) freezes INSIDE the kill syscall (response parked
