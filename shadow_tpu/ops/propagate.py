@@ -118,8 +118,12 @@ class DeviceRouteModel:
     REPROBE_EVERY = 64
     REPROBE_CAP = 4096
 
-    def __init__(self, min_device_batch: int):
+    def __init__(self, min_device_batch: int, kind: str = "single"):
         self.min_device_batch = min_device_batch
+        # Dispatch kind for the process-wide floor: a sharded SPMD
+        # step's time (all_to_all included) is not comparable to a
+        # single-chip dispatch, so floors share only within a kind.
+        self.kind = kind
         self.host_ns_per_pkt: float | None = None
         self._dev_ns_by_bucket: dict[int, float] = {}
         self._probe_countdown: dict[int, int] = {}
@@ -130,6 +134,19 @@ class DeviceRouteModel:
         # independent, so one catastrophic probe teaches us about all
         # sizes — without this, every bucket pays its own ~RTT probe.
         self.dev_floor_ns: float | None = None
+
+    # The floor is a property of the PLATFORM (per dispatch kind), not
+    # of one simulation: share it across model instances so a warm
+    # process (bench trials, repeated sims) stops re-paying the
+    # discovery probe.  Routing never affects traces (both paths are
+    # bit-identical); it only moves perf and the audit counters.
+    # Tests reset this (conftest) so audit assertions stay
+    # order-independent.
+    _shared_floor: dict = {}
+
+    @classmethod
+    def reset_shared(cls) -> None:
+        cls._shared_floor.clear()
 
     def use_device(self, n: int, b: int) -> bool:
         """Routing choice for a round of n packets at bucket size b.
@@ -148,6 +165,8 @@ class DeviceRouteModel:
             # dispatch FLOOR could win at this round size — through a
             # ~100ms tunnel that one check saves a probe per bucket.
             floor = self.dev_floor_ns
+            if floor is None:
+                floor = DeviceRouteModel._shared_floor.get(self.kind)
             if floor is not None and floor > self.host_ns_per_pkt * n:
                 dev = floor  # treat as losing; fall into backoff below
             else:
@@ -188,6 +207,10 @@ class DeviceRouteModel:
             return
         if self.dev_floor_ns is None or dt_ns < self.dev_floor_ns:
             self.dev_floor_ns = dt_ns
+        shared = DeviceRouteModel._shared_floor
+        prev = shared.get(self.kind)
+        if prev is None or dt_ns < prev:
+            shared[self.kind] = dt_ns
         prev = self._dev_ns_by_bucket.get(b)
         host = self.host_ns_per_pkt
         if prev is None or (host is not None and prev > host * n):

@@ -93,7 +93,8 @@ class MeshPropagator:
         # barrier): the C++ engine's own finish_round is bit-identical
         # to the sharded step, so routing between them is purely a
         # performance choice (ops/propagate.DeviceRouteModel).
-        self.route = DeviceRouteModel(min_device_batch)
+        self.route = DeviceRouteModel(min_device_batch,
+                                      kind=f"mesh{n_shards}")
         # Chunk bucket sizes the sharded step has already XLA-compiled:
         # the route model's timing must not record a dispatch whose
         # chunk shape compiled inside the timed region (the model keys

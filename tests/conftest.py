@@ -22,3 +22,17 @@ if "xla_force_host_platform_device_count" not in flags:
 from shadow_tpu.utils.platform import honor_platform_env  # noqa: E402
 
 honor_platform_env(default="cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_device_route_floor():
+    """The process-wide dispatch-floor cache makes routing (and the
+    device/host audit counters) adapt across runs — desirable in a
+    long-lived process, order-dependent in a test session.  Reset per
+    test."""
+    from shadow_tpu.ops.propagate import DeviceRouteModel
+    DeviceRouteModel.reset_shared()
+    yield

@@ -168,3 +168,45 @@ hosts:
     with pytest.raises(ValueError, match="unsupported option"):
         ConfigOptions.from_yaml_text(base.replace(
             "pcap_enabled: true", "bogus_option: 1"))
+
+
+def test_extended_yaml_merge_keys_and_extension_fields():
+    """Extended-YAML config surface (ref shadow.rs:368-387): `<<` merge
+    keys with anchors defined under top-level `x-` extension fields
+    resolve into host blocks, and the x- fields themselves are ignored
+    rather than rejected — the tornettools-style config idiom."""
+    text = """
+x-host-defaults: &defaults
+  network_node_id: 0
+x-proc: &sink
+  path: udp-sink
+  args: ["9000"]
+  expected_final_state: running
+general: { stop_time: 2s, seed: 1 }
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "1 Mbit" host_bandwidth_up "1 Mbit" ]
+        edge [ source 0 target 0 latency "1 ms" ]
+      ]
+hosts:
+  alpha:
+    <<: *defaults
+    processes:
+      - *sink
+  beta:
+    <<: *defaults
+    processes:
+      - <<: *sink
+        args: ["9001"]
+"""
+    cfg = ConfigOptions.from_yaml_text(text)
+    assert set(cfg.hosts) == {"alpha", "beta"}
+    assert cfg.hosts["alpha"].network_node_id == 0
+    assert cfg.hosts["alpha"].processes[0].path == "udp-sink"
+    assert cfg.hosts["beta"].processes[0].args == ["9001"]
+    from shadow_tpu.core.manager import run_simulation
+    _m, s = run_simulation(cfg)
+    assert s.ok
