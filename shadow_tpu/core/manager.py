@@ -605,8 +605,13 @@ class Manager:
                     if plow is not None:
                         plow.close_all(h)
                 proc.strace_close()
-        # Flush captures even when the caller never writes a data dir.
+        # Flush captures even when the caller never writes a data dir
+        # (skip hosts whose lazy net plane never built — engine hosts
+        # have no Python ifaces, and touching them here would build
+        # 100k of them just to find no pcap).
         for h in self.hosts:
+            if not h.net_built():
+                continue
             for iface in (h.lo, h.eth0):
                 if iface.pcap is not None:
                     iface.pcap.close()

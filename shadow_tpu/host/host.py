@@ -76,18 +76,12 @@ class Host:
         # DO_NATIVE byte-I/O syscalls (0 = not modeled).
         self.native_io_ns_per_kib = 0
 
-        # Network plane (host.rs:209-344 construction order).
-        self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)
-        self.eth0 = NetworkInterface(ip, "eth0", qdisc)
-        self.router = Router()
-        self.relay_loopback = Relay(
-            "lo", lambda host, now: self.lo.pop_packet(host, now), None)
-        self.relay_inet_out = Relay(
-            "inet-out", lambda host, now: self.eth0.pop_packet(host, now),
-            TokenBucket.for_bandwidth(bw_up_bits, mtu))
-        self.relay_inet_in = Relay(
-            "inet-in", lambda host, now: self.router.pop_inbound(host, now),
-            TokenBucket.for_bandwidth(bw_down_bits, mtu))
+        # Network plane (host.rs:209-344 construction order) — built
+        # LAZILY via __getattr__ on first touch of any of the six
+        # objects: engine-resident hosts never use them, and at 100k
+        # hosts their construction was the bulk of Manager build time.
+        self._net_qdisc = qdisc
+        self._net_mtu = mtu
 
         # Set by the scheduler before the first round.
         self._send_packet_fn = None
@@ -155,6 +149,36 @@ class Host:
         s = self._packet_seq
         self._packet_seq += 1
         return s
+
+    _NET_ATTRS = frozenset({"lo", "eth0", "router", "relay_loopback",
+                            "relay_inet_out", "relay_inet_in"})
+
+    def __getattr__(self, name):
+        # Lazy network-plane construction (only ever reached when the
+        # attribute is missing, i.e. before the first build; afterwards
+        # normal instance-attribute lookup wins with zero overhead).
+        if name in Host._NET_ATTRS:
+            self._build_net_plane()
+            return object.__getattribute__(self, name)
+        raise AttributeError(name)
+
+    def net_built(self) -> bool:
+        return "lo" in self.__dict__
+
+    def _build_net_plane(self) -> None:
+        qdisc, mtu = self._net_qdisc, self._net_mtu
+        self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)
+        self.eth0 = NetworkInterface(self.ip, "eth0", qdisc)
+        self.router = Router()
+        self.relay_loopback = Relay(
+            "lo", lambda host, now: self.lo.pop_packet(host, now), None)
+        self.relay_inet_out = Relay(
+            "inet-out", lambda host, now: self.eth0.pop_packet(host, now),
+            TokenBucket.for_bandwidth(self.bw_up_bits, mtu))
+        self.relay_inet_in = Relay(
+            "inet-in",
+            lambda host, now: self.router.pop_inbound(host, now),
+            TokenBucket.for_bandwidth(self.bw_down_bits, mtu))
 
     def schedule_task_at(self, time: int, task: TaskRef) -> None:
         assert time >= self._now, f"task {task} scheduled in the past"

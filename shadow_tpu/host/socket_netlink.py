@@ -123,7 +123,7 @@ class NetlinkSocket(StatusOwner):
             self._recv_q.append(_addr_msg(seq, pid, 1, "lo",
                                           LOCALHOST, 8))
             self._recv_q.append(_addr_msg(seq, pid, 2, "eth0",
-                                          self.host.eth0.ip, 24))
+                                          self.host.ip, 24))
             self._recv_q.append(_nlmsg(NLMSG_DONE, NLM_F_MULTI, seq,
                                        pid, struct.pack("<i", 0)))
         else:

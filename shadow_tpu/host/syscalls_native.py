@@ -544,7 +544,7 @@ class NativeSyscallHandler:
         if hasattr(sock, "push_reply"):  # native-plane UDP proxy
             sock.push_reply(host, resp, dst[0], 53)
             return _done(len(data))
-        local_ip = sock.local[0] or host.eth0.ip
+        local_ip = sock.local[0] or host.ip  # == eth0.ip
         reply = pkt.Packet(host.id, host.next_packet_seq(), pkt.PROTO_UDP,
                            dst[0], 53, local_ip, sock.local[1],
                            payload=resp)
@@ -930,7 +930,7 @@ class NativeSyscallHandler:
             local = sock.local or (0, 0)
             ip = local[0]
             if ip == 0 and getattr(sock, "peer", None):
-                ip = host.eth0.ip
+                ip = host.ip  # == eth0.ip; avoid the lazy plane build
             sa = _pack_sockaddr_in(ip, local[1])
         _write_addr(process, addr_ptr, len_ptr, sa)
         return _done(0)

@@ -29,6 +29,37 @@ def _stale(target: str, sources: list[str]) -> bool:
                if os.path.exists(s))
 
 
+def _cpu_fingerprint() -> str:
+    """ISA identity for -march=native artifacts: a prebuilt engine
+    carried to a different CPU (docker cache, copied checkout) must
+    rebuild, not SIGILL at the first call."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    import hashlib
+                    return hashlib.sha1(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+    return platform.machine()
+
+
+def isa_stale(target: str) -> bool:
+    """True when `target` was built on a CPU with different ISA flags
+    (sidecar written by mark_isa)."""
+    try:
+        with open(target + ".cpu") as f:
+            return f.read().strip() != _cpu_fingerprint()
+    except OSError:
+        return os.path.exists(target)  # artifact without provenance
+
+
+def mark_isa(target: str) -> None:
+    with open(target + ".cpu", "w") as f:
+        f.write(_cpu_fingerprint())
+
+
 def _ensure_built(so_path: str, target: str, source_names: list[str]) -> str:
     """Build a native component if missing or out of date; return its
     path.  Raises RuntimeError (with the compiler output) when the

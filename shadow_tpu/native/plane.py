@@ -19,7 +19,7 @@ import os
 import subprocess
 import sys
 
-from shadow_tpu.native import LIB_DIR, _SRC_DIR, _stale
+from shadow_tpu.native import LIB_DIR, _SRC_DIR, _stale, isa_stale, mark_isa
 
 R_BLOCK = 1000000  # engine "park on a condition" return (netplane.cpp)
 
@@ -40,13 +40,18 @@ def load_netplane():
     target = os.path.join(LIB_DIR, f"_netplane{ext}")
     sources = [os.path.join(_SRC_DIR, f)
                for f in ("netplane.cpp", "Makefile")]
-    if _stale(target, sources):
+    if _stale(target, sources) or isa_stale(target):
+        # isa_stale: the engine builds with -march=native; an artifact
+        # from a different CPU must rebuild, not SIGILL.
+        if os.path.exists(target):
+            os.utime(os.path.join(_SRC_DIR, "netplane.cpp"))  # force make
         proc = subprocess.run(["make", "-C", _SRC_DIR, "netplane"],
                               capture_output=True, text=True)
         if proc.returncode != 0 or not os.path.exists(target):
             _load_error = (f"netplane build failed (exit "
                            f"{proc.returncode}): {proc.stderr[-2000:]}")
             return None
+        mark_isa(target)
     if LIB_DIR not in sys.path:
         sys.path.insert(0, LIB_DIR)
     try:
