@@ -1370,13 +1370,19 @@ struct AppN {
   /* udp-flood / udp-sink */
   int64_t size = 0, interval = 0, expect = -1;
   int64_t sent_i = 0, got_n = 0;
+  /* udp-mesh: peer IPs; the sibling app index (main <-> sender) and
+   * per-thread completion flags for the joint process exit */
+  std::vector<uint32_t> peers;
+  int32_t mesh_peer = -1;
+  bool part_done = false;
   /* process stdout, built with the exact bytes the Python app would
    * have written */
   std::string out;
 };
 
 constexpr int APP_SERVER = 0, APP_CLIENT = 1, APP_HANDLER = 2,
-              APP_UDP_FLOOD = 3, APP_UDP_SINK = 4;
+              APP_UDP_FLOOD = 3, APP_UDP_SINK = 4, APP_UDP_MESH = 5,
+              APP_UDP_MESH_SND = 6;
 /* client transfer states */
 constexpr int CL_CONNECTING = 1, CL_RECV = 3;
 /* handler states */
@@ -1491,12 +1497,19 @@ struct Engine {
     s->status = nw;
     if (s->app_owner == -1)
       fire_event(CB_STATUS, s->host, s->tok, set_mask, clear_mask);
-    else if (s->app_owner >= 0)
+    else if (s->app_owner >= 0) {
       /* Python listeners fire on CHANGED bits (set OR clear
        * transitions, status.py adjust_status) — the blocked syscall
        * re-dispatches and may simply re-block; matching this keeps
        * the wake/re-run pattern (and syscall counts) identical. */
       app_wake(s->app_owner, changed);
+      /* udp-mesh: TWO threads park on one socket (main: readable;
+       * sender: writable).  Registration order — main blocked first —
+       * is owner-then-sibling; the masks are disjoint, so at most one
+       * actually wakes per change. */
+      int sib = apps[(size_t)s->app_owner].mesh_peer;
+      if (sib >= 0) app_wake(sib, changed);
+    }
     /* -2: pre-accept child of an app listener — silent */
   }
 
@@ -2025,7 +2038,8 @@ struct Engine {
 
   int app_spawn(int hid, int kind, int64_t a, int64_t b, int64_t c,
                 int64_t d, int64_t e, int64_t sb, int64_t rb, int sat,
-                int rat, int64_t now) {
+                int rat, int64_t now, const uint32_t *peer_ips = nullptr,
+                int64_t n_peers = 0) {
     int aidx = (int)apps.append();
     {
       AppN &ap = apps[(size_t)aidx];
@@ -2069,6 +2083,46 @@ struct Engine {
       ap.sock = (int64_t)tok;
       asys(hp, ASYS_RESOLVE);
       app_step_flood(aidx, now);
+    } else if (kind == APP_UDP_MESH) {
+      /* udp-mesh <port> <count> <size> <peers...> (apps.py udp_mesh):
+       * socket + bind, spawn_thread(sender) — which consumes the
+       * start-task event seq exactly like sys_spawn_thread's
+       * schedule_task_at — then the MAIN thread sinks until
+       * count*npeers*size bytes arrived. */
+      {
+        AppN &ap = apps[(size_t)aidx];
+        ap.port = (int)a;
+        ap.count = (int)b;
+        ap.size = c;
+        ap.peers.assign(peer_ips, peer_ips + n_peers);
+      }
+      asys(hp, ASYS_SOCKET);
+      uint32_t tok = new_udp(hid, sb, rb);
+      sock(tok)->app_owner = aidx;
+      apps[(size_t)aidx].sock = (int64_t)tok;
+      asys(hp, ASYS_BIND);
+      if (generic_bind(hp, sock(tok), tok, 0, (int)a) < 0) {
+        app_die(aidx, 101, now);
+      } else {
+        asys(hp, ASYS_SPAWN_THREAD);
+        int sidx = (int)apps.append();
+        {
+          AppN &sn = apps[(size_t)sidx];
+          const AppN &m = apps[(size_t)aidx];
+          sn.kind = APP_UDP_MESH_SND;
+          sn.hid = hid;
+          sn.sock = m.sock;
+          sn.port = m.port;
+          sn.count = m.count;
+          sn.size = m.size;
+          sn.peers = m.peers;
+          sn.mesh_peer = aidx;
+          sn.wake_pending = true;  // start event below; no double-wake
+        }
+        apps[(size_t)aidx].mesh_peer = sidx;
+        hp->tpush({now, hp->event_seq++, TK_APP, (uint32_t)sidx});
+        app_step_mesh(aidx, now);
+      }
     } else {  /* APP_UDP_SINK */
       AppN &ap = apps[(size_t)aidx];
       ap.port = (int)a;
@@ -2119,6 +2173,8 @@ struct Engine {
     else if (a.kind == APP_CLIENT) app_client_resume(aidx, now);
     else if (a.kind == APP_UDP_FLOOD) app_step_flood(aidx, now);
     else if (a.kind == APP_UDP_SINK) app_step_sink(aidx, now);
+    else if (a.kind == APP_UDP_MESH) app_step_mesh(aidx, now);
+    else if (a.kind == APP_UDP_MESH_SND) app_step_mesh_snd(aidx, now);
     else app_step_handler(aidx, now);
   }
 
@@ -2240,7 +2296,9 @@ struct Engine {
       asys(hp, ASYS_NANOSLEEP);
       a.state = 0;
     }
-    static std::string xpay;
+    /* thread_local: steppers run inside run_hosts_mt workers — a
+     * shared static here would be a cross-thread race on the buffer */
+    static thread_local std::string xpay;
     if ((int64_t)xpay.size() < a.size) xpay.assign((size_t)a.size, 'x');
     while (a.sent_i < a.count) {
       asys(hp, ASYS_SENDTO);
@@ -2303,6 +2361,98 @@ struct Engine {
     a.exit_code = 0;
     a.exit_time = now;
     a.wait_mask = 0;
+  }
+
+  /* udp-mesh MAIN thread (apps.py udp_mesh): sink the expected
+   * count*npeers*size bytes, then write the verdict line; the process
+   * exits only when the sender thread finished too. */
+  void app_step_mesh(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    int64_t expect = (int64_t)a.count * (int64_t)a.peers.size() * a.size;
+    std::string data;
+    uint32_t sip;
+    int sport;
+    while (a.got < expect) {
+      asys(hp, ASYS_RECVFROM);
+      int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
+      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r < 0) { app_die(aidx, 101, now); return; }
+      a.got += (int64_t)data.size();
+    }
+    char line[64];
+    snprintf(line, sizeof(line), "mesh received %lld bytes\n",
+             (long long)a.got);
+    asys(hp, ASYS_WRITE);
+    a.out += line;
+    a.part_done = true;
+    a.wait_mask = 0;
+    mesh_try_exit(aidx, now);
+  }
+
+  /* udp-mesh SENDER thread: resolve every peer, then count rounds of
+   * one datagram per peer, then the sent line (written into the MAIN
+   * app's out — one process stdout, append order = execution order). */
+  void app_step_mesh_snd(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    uint32_t tok = (uint32_t)a.sock;
+    if (a.state == 0) {
+      for (size_t i = 0; i < a.peers.size(); i++)
+        asys(hp, ASYS_RESOLVE);
+      a.state = 1;
+    }
+    static thread_local std::string mpay;
+    if ((int64_t)mpay.size() < a.size) mpay.assign((size_t)a.size, 'm');
+    int64_t total = (int64_t)a.count * (int64_t)a.peers.size();
+    while (a.sent_i < total) {
+      asys(hp, ASYS_SENDTO);
+      uint32_t ip =
+          a.peers[(size_t)(a.sent_i % (int64_t)a.peers.size())];
+      int64_t w = udp_sendto(hp, s, tok, mpay.data(), a.size, 1, ip,
+                             a.port, now);
+      if (w == -E_AGAIN) { a.wait_mask = S_WRITABLE; return; }
+      if (w < 0) {
+        /* Python twin: a crashed sender THREAD exits alone; the
+         * shared fd stays open (fds close only at full process exit)
+         * and the main thread keeps waiting until sim teardown.
+         * app_die would close the shared socket and diverge. */
+        a.exited = true;
+        a.exit_code = 101;
+        a.exit_time = now;
+        a.wait_mask = 0;
+        return;
+      }
+      a.sent_i++;
+    }
+    char line[64];
+    snprintf(line, sizeof(line), "mesh sent %lld\n", (long long)total);
+    asys(hp, ASYS_WRITE);
+    apps[(size_t)a.mesh_peer].out += line;
+    a.part_done = true;
+    a.exited = true;  // thread exit; process exit belongs to MAIN
+    a.exit_code = 0;
+    a.exit_time = now;
+    a.wait_mask = 0;
+    mesh_try_exit(a.mesh_peer, now);
+  }
+
+  void mesh_try_exit(int main_idx, int64_t now) {
+    AppN &m = apps[(size_t)main_idx];
+    if (!m.part_done || m.mesh_peer < 0 ||
+        !apps[(size_t)m.mesh_peer].part_done)
+      return;
+    /* Process exit (process.py thread_exited -> fds.close_all): the
+     * socket closes WITHOUT a counted syscall — the app never yields
+     * close. */
+    sock_close_any(plane(m.hid), (uint32_t)m.sock, now);
+    sock((uint32_t)m.sock)->app_owner = -2;
+    m.exited = true;
+    m.exit_code = 0;
+    m.exit_time = now;
+    m.wait_mask = 0;
   }
 
   void app_step_handler(int aidx, int64_t now) {
@@ -3418,11 +3568,16 @@ static PyObject *eng_scatter_round(EngineObj *self, PyObject *args) {
 static PyObject *eng_app_spawn(EngineObj *self, PyObject *args) {
   int hid, kind, sat, rat;
   long long a, b, c, d, e, sb, rb, now;
-  if (!PyArg_ParseTuple(args, "iiLLLLLLLiiL", &hid, &kind, &a, &b, &c, &d,
-                        &e, &sb, &rb, &sat, &rat, &now))
+  Py_buffer peers{};
+  if (!PyArg_ParseTuple(args, "iiLLLLLLLiiL|y*", &hid, &kind, &a, &b, &c,
+                        &d, &e, &sb, &rb, &sat, &rat, &now, &peers))
     return nullptr;
+  const uint32_t *pp =
+      peers.buf ? (const uint32_t *)peers.buf : nullptr;
+  int64_t np = peers.buf ? (int64_t)(peers.len / 4) : 0;
   int idx = self->eng->app_spawn(hid, kind, a, b, c, d, e, sb, rb, sat,
-                                 rat, now);
+                                 rat, now, pp, np);
+  if (peers.buf) PyBuffer_Release(&peers);
   CHECK_CB(self);
   return PyLong_FromLong(idx);
 }

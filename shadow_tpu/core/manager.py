@@ -300,7 +300,8 @@ class Manager:
                                                         engine_app_args)
                 spec = engine_app_args(_pcfg, h, self.dns)
                 if spec is not None:
-                    kind, a, b, c, d, e = spec
+                    kind, a, b, c, d, e = spec[:6]
+                    extra = spec[6:]  # e.g. the udp-mesh peer buffer
                     sh = self.syscall_handler
                     process = EngineAppProcess(
                         h, f"{_pcfg.path}.{index}",
@@ -309,7 +310,7 @@ class Manager:
                     process.app_idx = h.plane.engine.app_spawn(
                         h.id, kind, a, b, c, d, e, sh.send_buf,
                         sh.recv_buf, int(sh.send_autotune),
-                        int(sh.recv_autotune), h.now())
+                        int(sh.recv_autotune), h.now(), *extra)
                     return
             factory = app_registry.lookup(_pcfg.path)
             if factory is None and "/" in _pcfg.path:

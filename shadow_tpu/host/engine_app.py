@@ -19,6 +19,7 @@ KIND_SERVER = 0
 KIND_CLIENT = 1
 KIND_UDP_FLOOD = 3
 KIND_UDP_SINK = 4
+KIND_UDP_MESH = 5
 
 
 class _FdTableStub:
@@ -113,4 +114,20 @@ def engine_app_args(pcfg, host, dns):
         expect = int(args[1]) if len(args) > 1 else 0
         has_expect = 1 if len(args) > 1 else 0
         return (KIND_UDP_SINK, int(args[0]), expect, has_expect, 0, 0)
+    if pcfg.path == "udp-mesh":
+        # udp-mesh <port> <count> <size> <peer...>: peer IPs ride a
+        # trailing u32 buffer (variable length; the 5 scalar slots
+        # carry port/count/size).
+        if len(args) < 4:
+            return None
+        import struct as _struct
+        ips = []
+        for peer in args[3:]:
+            ip = dns.ip_for_name(peer)
+            if ip is None:
+                return None
+            ips.append(ip)
+        peers = b"".join(_struct.pack("<I", ip) for ip in ips)
+        return (KIND_UDP_MESH, int(args[0]), int(args[1]), int(args[2]),
+                0, 0, peers)
     return None

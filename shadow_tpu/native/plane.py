@@ -43,15 +43,30 @@ def load_netplane():
     if _stale(target, sources) or isa_stale(target):
         # isa_stale: the engine builds with -march=native; an artifact
         # from a different CPU must rebuild, not SIGILL.
-        if os.path.exists(target):
-            os.utime(os.path.join(_SRC_DIR, "netplane.cpp"))  # force make
+        try:
+            if os.path.exists(target):
+                os.utime(os.path.join(_SRC_DIR, "netplane.cpp"))
+        except OSError:
+            pass  # read-only checkout: let make decide
         proc = subprocess.run(["make", "-C", _SRC_DIR, "netplane"],
                               capture_output=True, text=True)
         if proc.returncode != 0 or not os.path.exists(target):
-            _load_error = (f"netplane build failed (exit "
-                           f"{proc.returncode}): {proc.stderr[-2000:]}")
-            return None
-        mark_isa(target)
+            if os.path.exists(target) and not _stale(target, sources):
+                # Unbuildable environment but a source-fresh artifact
+                # exists (read-only checkout without a sidecar): trust
+                # it over hard-failing — a wrong-ISA artifact still
+                # fails fast at import/first call below.
+                pass
+            else:
+                _load_error = (f"netplane build failed (exit "
+                               f"{proc.returncode}): "
+                               f"{proc.stderr[-2000:]}")
+                return None
+        else:
+            try:
+                mark_isa(target)
+            except OSError:
+                pass  # read-only lib dir: rebuilt next process, fine
     if LIB_DIR not in sys.path:
         sys.path.insert(0, LIB_DIR)
     try:

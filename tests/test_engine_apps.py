@@ -146,3 +146,51 @@ hosts:
     assert s.ok, s.plugin_errors
     assert not any(isinstance(p, EngineAppProcess)
                    for h in m.hosts for p in h.processes.values())
+
+
+def test_udp_mesh_engine_twin_byte_identical(tmp_path):
+    """udp-mesh (the 100-host benchmark workload) as an engine twin:
+    TWO app threads share one socket (main sinks, a spawned sender
+    floods every peer) — spawn-thread event-seq draw, dual-waiter
+    wakes, shared stdout in execution order, silent close at joint
+    process exit.  Byte-identical trace/stdout/histogram vs the Python
+    coroutine under serial."""
+
+    def run_mesh(sched):
+        names = [f"h{i}" for i in range(6)]
+        blocks = []
+        for i, n in enumerate(names):
+            peers = ", ".join(p for p in names if p != n)
+            blocks.append(f"""  {n}:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-mesh, args: ["9000", "5", "700", {peers}],
+           start_time: 1s }}""")
+        yaml = (f"general: {{ stop_time: 30s, seed: 5 }}\n"
+                f"experimental: {{ scheduler: {sched} }}\n"
+                "network:\n  graph:\n    type: gml\n    inline: |\n"
+                "      graph [ node [ id 0 host_bandwidth_down \"50 Mbit\""
+                " host_bandwidth_up \"50 Mbit\" ]\n"
+                "        edge [ source 0 target 0 latency \"10 ms\""
+                " packet_loss 0.0 ] ]\n"
+                "hosts:\n" + "\n".join(blocks) + "\n")
+        return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+    m_ser, s_ser = run_mesh("serial")
+    m_tpu, s_tpu = run_mesh("tpu")
+    assert s_ser.ok and s_tpu.ok, (s_ser.plugin_errors,
+                                   s_tpu.plugin_errors)
+    if m_tpu.plane is not None:
+        n_engine = sum(
+            1 for h in m_tpu.hosts for p in h.processes.values()
+            if isinstance(p, EngineAppProcess))
+        assert n_engine == 6, "udp-mesh did not run engine-resident"
+    assert m_ser.trace_lines() == m_tpu.trace_lines()
+    out_ser = {(h.name, p.name): bytes(p.stdout) for h in m_ser.hosts
+               for p in h.processes.values()}
+    out_tpu = {(h.name, p.name): bytes(p.stdout) for h in m_tpu.hosts
+               for p in h.processes.values()}
+    assert out_ser == out_tpu
+    assert any(b"mesh sent 25" in v and b"mesh received 17500 bytes" in v
+               for v in out_ser.values())
+    assert _hist(m_ser) == _hist(m_tpu)
