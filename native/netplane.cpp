@@ -1307,6 +1307,25 @@ struct HostPlane {
   std::vector<uint64_t> outgoing;  // legacy per-call drain (mixed paths)
   std::vector<TraceRec> trace;
   bool tracing = true;
+  /* Engine-side pcap capture (utils/pcap.py twin): per-iface flag +
+   * a drained-per-round record log.  Off unless the host's config
+   * enables pcap — the payload copies cost nothing otherwise. */
+  bool pcap_on[2] = {false, false};
+  struct PcapRec {
+    int64_t t;
+    uint8_t iface;
+    int src_host;
+    uint64_t pkt_seq;
+    uint8_t proto;
+    uint32_t src_ip, dst_ip;
+    int src_port, dst_port;
+    bool has_tcp;
+    uint32_t tseq, tack;
+    int tflags;
+    int64_t twindow;
+    std::string payload;
+  };
+  std::vector<PcapRec> pcap_log;
   /* Sticky: a Python-owned socket was ever created on this host.
    * Such hosts may fire CB_STATUS/CB_CHILD callbacks mid-event, so
    * run_hosts_mt keeps them on the GIL-held serial path. */
@@ -1577,11 +1596,41 @@ struct Engine {
     iface_receive(hp, dev == 0 ? hp->lo : hp->eth, id, now);
   }
 
+  /* pcap capture twin (interface.py writes at send-pop and at inbound
+   * push, BEFORE demux — undeliverable packets are captured too). */
+  void pcap_capture(HostPlane *hp, int ifidx, const PacketN *p,
+                    int64_t now) {
+    HostPlane::PcapRec r;
+    r.t = now;
+    r.iface = (uint8_t)ifidx;
+    r.src_host = p->src_host;
+    r.pkt_seq = p->seq;
+    r.proto = (uint8_t)p->proto;
+    r.src_ip = p->src_ip;
+    r.dst_ip = p->dst_ip;
+    r.src_port = p->src_port;
+    r.dst_port = p->dst_port;
+    r.has_tcp = p->has_tcp;
+    if (p->has_tcp) {
+      r.tseq = p->tcp.seq;
+      r.tack = p->tcp.ack;
+      r.tflags = p->tcp.flags;
+      r.twindow = p->tcp.window;
+    } else {
+      r.tseq = r.tack = 0;
+      r.tflags = 0;
+      r.twindow = 0;
+    }
+    r.payload = p->payload;
+    hp->pcap_log.push_back(std::move(r));
+  }
+
   /* interface.push (receive path) */
   void iface_receive(HostPlane *hp, IfaceN &ifc, uint64_t id, int64_t now) {
     PacketN *p = store.get(id);
     ifc.packets_received++;
     ifc.bytes_received += p->total_size();
+    if (hp->pcap_on[ifc.idx]) pcap_capture(hp, ifc.idx, p, now);
     AssocKey k{ifc.ip, p->src_ip, (uint16_t)p->dst_port,
                (uint16_t)p->src_port, (uint8_t)p->proto};
     auto it = ifc.assoc.find(k);
@@ -1644,6 +1693,7 @@ struct Engine {
         PacketN *p = store.get(id);
         ifc.packets_sent++;
         ifc.bytes_sent += p->total_size();
+        if (hp->pcap_on[ifc.idx]) pcap_capture(hp, ifc.idx, p, now);
         trace_packet(hp, TRACE_SND, p, "", now);
         return id;
       }
@@ -4057,6 +4107,48 @@ static PyObject *eng_mt_stats(EngineObj *self, PyObject *) {
                        (long long)self->eng->mt_hosts_run);
 }
 
+static PyObject *eng_set_pcap(EngineObj *self, PyObject *args) {
+  int hid, ifidx, flag;
+  if (!PyArg_ParseTuple(args, "iip", &hid, &ifidx, &flag)) return nullptr;
+  self->eng->plane(hid)->pcap_on[ifidx & 1] = flag;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_pcap_take(EngineObj *self, PyObject *args) {
+  /* Drain this host's pcap records: list of (iface, t, src_host,
+   * pkt_seq, proto, sip, sport, dip, dport, payload, tcp|None) where
+   * tcp = (seq, ack, flags, window). */
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  PyObject *out = PyList_New((Py_ssize_t)hp->pcap_log.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < hp->pcap_log.size(); i++) {
+    const HostPlane::PcapRec &r = hp->pcap_log[i];
+    PyObject *tcp;
+    if (r.has_tcp) {
+      tcp = Py_BuildValue("IIiL", (unsigned int)r.tseq,
+                          (unsigned int)r.tack, r.tflags,
+                          (long long)r.twindow);
+    } else {
+      tcp = Py_None;
+      Py_INCREF(tcp);
+    }
+    PyObject *rec = Py_BuildValue(
+        "iLiKBIiIiy#N", (int)r.iface, (long long)r.t, r.src_host,
+        (unsigned long long)r.pkt_seq, (unsigned char)r.proto,
+        (unsigned int)r.src_ip, r.src_port, (unsigned int)r.dst_ip,
+        r.dst_port, r.payload.data(), (Py_ssize_t)r.payload.size(), tcp);
+    if (!rec) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, rec);
+  }
+  hp->pcap_log.clear();
+  return out;
+}
+
 static PyMethodDef eng_methods[] = {
     {"add_host", (PyCFunction)eng_add_host, METH_VARARGS, nullptr},
     {"set_callbacks", (PyCFunction)eng_set_callbacks, METH_VARARGS, nullptr},
@@ -4071,6 +4163,8 @@ static PyMethodDef eng_methods[] = {
     {"run_hosts", (PyCFunction)eng_run_hosts, METH_VARARGS, nullptr},
     {"run_hosts_mt", (PyCFunction)eng_run_hosts_mt, METH_VARARGS, nullptr},
     {"mt_stats", (PyCFunction)eng_mt_stats, METH_NOARGS, nullptr},
+    {"set_pcap", (PyCFunction)eng_set_pcap, METH_VARARGS, nullptr},
+    {"pcap_take", (PyCFunction)eng_pcap_take, METH_VARARGS, nullptr},
     {"set_host_rng", (PyCFunction)eng_set_host_rng, METH_VARARGS, nullptr},
     {"rng_next", (PyCFunction)eng_rng_next, METH_VARARGS, nullptr},
     {"push_inbox", (PyCFunction)eng_push_inbox, METH_VARARGS, nullptr},

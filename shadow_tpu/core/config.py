@@ -50,6 +50,10 @@ class HostConfig:
     bandwidth_up_bits: int | None = None
     pcap_enabled: bool = False
     pcap_capture_size: int = 65535
+    # Per-host engine opt-out: False pins this host to the pure-Python
+    # object path (debugging aid; traces are byte-identical either way
+    # — the cross-plane interop gates are the proof).
+    native_dataplane: bool = True
 
 
 @dataclass
@@ -238,6 +242,7 @@ class ConfigOptions:
                 "bandwidth_up": h.bandwidth_up_bits,
                 "pcap_enabled": h.pcap_enabled,
                 "pcap_capture_size": h.pcap_capture_size,
+                "native_dataplane": h.native_dataplane,
                 "processes": procs,
             }
 
@@ -360,7 +365,8 @@ class ConfigOptions:
         # simulation-wide defaults each host may override in its own
         # host_options block.  Only implemented options are accepted —
         # a typo'd or unsupported key must fail, not silently no-op.
-        _HOST_OPTION_KEYS = {"pcap_enabled", "pcap_capture_size"}
+        _HOST_OPTION_KEYS = {"pcap_enabled", "pcap_capture_size",
+                             "native_dataplane"}
 
         def _host_options(section: str, d: dict) -> dict:
             unknown = set(d) - _HOST_OPTION_KEYS
@@ -414,6 +420,9 @@ class ConfigOptions:
                 pcap_capture_size=units.parse_bytes(
                     h.get("pcap_capture_size",
                           opt.get("pcap_capture_size", 65535))),
+                native_dataplane=bool(
+                    h.get("native_dataplane",
+                          opt.get("native_dataplane", True))),
             )
         return cls(general=general, network=network,
                    experimental=experimental, hosts=hosts)

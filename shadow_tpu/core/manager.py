@@ -151,14 +151,6 @@ class Manager:
             host.data_path = os.path.join(config.general.data_directory,
                                           "hosts", name)
             self.dns.register(host_id, ip, name)
-            if hcfg.pcap_enabled:
-                from shadow_tpu.utils.pcap import PcapWriter
-                hdir = host.data_path
-                os.makedirs(hdir, exist_ok=True)
-                for iface in (host.lo, host.eth0):
-                    iface.pcap = PcapWriter(
-                        os.path.join(hdir, f"{iface.name}.pcap"),
-                        hcfg.pcap_capture_size)
             self.hosts.append(host)
             for i, pcfg in enumerate(hcfg.processes):
                 self._schedule_spawn(host, i, pcfg)
@@ -201,13 +193,35 @@ class Manager:
                 qdisc_rr = config.experimental.interface_qdisc == \
                     "round_robin"
                 for host in self.hosts:
-                    if host.cpu is None and not \
-                            config.hosts[host.name].pcap_enabled:
+                    if host.cpu is None and \
+                            config.hosts[host.name].native_dataplane:
                         self.plane.add_host(host, qdisc_rr)
             elif native_mode == "on":
                 raise RuntimeError(
                     f"native_dataplane=on but the engine is unavailable: "
                     f"{native_plane.load_error()}")
+
+        # Pcap capture: engine hosts record in C++ (drained per round
+        # into the same frame builder — files byte-identical to the
+        # object path's); object-path hosts hook the Python ifaces.
+        self._pcap_engine: list = []  # (host, writer_lo, writer_eth)
+        for host in self.hosts:
+            hcfg = config.hosts[host.name]
+            if not hcfg.pcap_enabled:
+                continue
+            from shadow_tpu.utils.pcap import PcapWriter
+            hdir = host.data_path
+            os.makedirs(hdir, exist_ok=True)
+            writers = tuple(
+                PcapWriter(os.path.join(hdir, f"{name}.pcap"),
+                           hcfg.pcap_capture_size)
+                for name in ("lo", "eth0"))
+            if host.plane is not None:
+                for ifidx in (0, 1):
+                    self.plane.engine.set_pcap(host.id, ifidx, True)
+                self._pcap_engine.append((host,) + writers)
+            else:
+                host.lo.pcap, host.eth0.pcap = writers
 
         if sched == "tpu" and config.experimental.tpu_shards > 1:
             from shadow_tpu.parallel.mesh_propagator import MeshPropagator
@@ -439,6 +453,15 @@ class Manager:
         hosts = self.hosts
         return [hosts[i] for i in slow.tolist()]
 
+    def _drain_engine_pcap(self) -> None:
+        eng = self.plane.engine
+        for host, w_lo, w_eth in self._pcap_engine:
+            for (ifidx, t, src, seq, proto, sip, sport, dip, dport,
+                 payload, tcp) in eng.pcap_take(host.id):
+                w = w_lo if ifidx == 0 else w_eth
+                w.write_fields(t, src, seq, proto, sip, sport, dip,
+                               dport, payload, tcp)
+
     def _run_hosts(self, until: int) -> None:
         if self._perf_timers:
             # perf_timers feature (perf_timer.rs; host.rs:680-688): time
@@ -547,6 +570,8 @@ class Manager:
             self.propagator.begin_round(start, window_end)
             self._run_hosts(window_end)
             inflight_min = self.propagator.finish_round()
+            if self._pcap_engine:
+                self._drain_engine_pcap()  # stream, don't buffer a sim
             summary.rounds += 1
             summary.busy_end_ns = window_end
             if heartbeat_lines and window_end >= next_heartbeat:
@@ -616,6 +641,11 @@ class Manager:
             for iface in (h.lo, h.eth0):
                 if iface.pcap is not None:
                     iface.pcap.close()
+        if self._pcap_engine:
+            self._drain_engine_pcap()
+            for _h, w_lo, w_eth in self._pcap_engine:
+                w_lo.close()
+                w_eth.close()
         return summary
 
     def _log_heartbeat(self, sim_now: int, stop: int, wall_start: float,

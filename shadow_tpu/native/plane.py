@@ -51,11 +51,13 @@ def load_netplane():
         proc = subprocess.run(["make", "-C", _SRC_DIR, "netplane"],
                               capture_output=True, text=True)
         if proc.returncode != 0 or not os.path.exists(target):
-            if os.path.exists(target) and not _stale(target, sources):
+            if os.path.exists(target) and not _stale(target, sources) \
+                    and not isa_stale(target):
                 # Unbuildable environment but a source-fresh artifact
-                # exists (read-only checkout without a sidecar): trust
-                # it over hard-failing — a wrong-ISA artifact still
-                # fails fast at import/first call below.
+                # whose ISA sidecar matches this CPU: trust it.  An
+                # artifact of UNVERIFIABLE ISA is never imported — a
+                # -march=native mismatch dies by SIGILL, not a clean
+                # exception, so the safe degrade is the object path.
                 pass
             else:
                 _load_error = (f"netplane build failed (exit "

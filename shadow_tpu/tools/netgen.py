@@ -85,6 +85,7 @@ def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
                   seed: int = 1, scheduler: str = "serial",
                   experimental_extra: dict | None = None,
                   gml: str | None = None, pcap_hosts: int = 0,
+                  object_hosts: int = 0,
                   data_directory: str | None = None) -> str:
     """N-host UDP traffic mesh: every host runs one udp-sink (runs until
     sim end) and `floods_per_host` udp-flood senders at staggered starts.
@@ -112,10 +113,14 @@ def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
                 f'      - {{ path: udp-flood, '
                 f'args: [{peer}, "9000", "{count}", "{size}"], '
                 f'start_time: {start_ms} ms }}')
-        pcap = ("    pcap_enabled: true\n" if i < pcap_hosts else "")
+        extra_opts = ""
+        if i < pcap_hosts:
+            extra_opts += "    pcap_enabled: true\n"
+        if i < object_hosts:
+            extra_opts += "    native_dataplane: false\n"
         host_blocks.append(
-            f"  {name}:\n    network_node_id: {i % n_nodes}\n" + pcap +
-            f"    processes:\n" + "\n".join(procs))
+            f"  {name}:\n    network_node_id: {i % n_nodes}\n"
+            + extra_opts + f"    processes:\n" + "\n".join(procs))
     datadir = (f', data_directory: "{data_directory}"'
                if data_directory else "")
     return (f"general: {{ stop_time: {stop_time}, seed: {seed}{datadir} }}\n"

@@ -51,6 +51,35 @@ def _transport_header(p) -> bytes:
                        5 << 4, flags & 0xFF, min(window, 0xFFFF), 0, 0)
 
 
+class _Fields:
+    """Duck-typed packet view for write_fields (what _ipv4_header /
+    _transport_header read)."""
+
+    __slots__ = ("src_host_id", "seq", "protocol", "src_ip", "src_port",
+                 "dst_ip", "dst_port", "payload", "tcp")
+
+    class _Tcp:
+        __slots__ = ("seq", "ack", "flags", "window")
+
+        def __init__(self, seq, ack, flags, window):
+            self.seq = seq
+            self.ack = ack
+            self.flags = flags
+            self.window = window
+
+    def __init__(self, src_host_id, seq, proto, src_ip, src_port,
+                 dst_ip, dst_port, payload, tcp):
+        self.src_host_id = src_host_id
+        self.seq = seq
+        self.protocol = proto
+        self.src_ip = src_ip
+        self.src_port = src_port
+        self.dst_ip = dst_ip
+        self.dst_port = dst_port
+        self.payload = payload
+        self.tcp = None if tcp is None else self._Tcp(*tcp)
+
+
 class PcapWriter:
     def __init__(self, path: str, capture_size: int = 65535):
         self._f = open(path, "wb")
@@ -59,6 +88,19 @@ class PcapWriter:
                                   capture_size, _LINKTYPE_RAW))
 
     def write_packet(self, sim_now: int, p) -> None:
+        self._write(sim_now, p)
+
+    def write_fields(self, sim_now: int, src_host_id: int, seq: int,
+                     proto: int, src_ip: int, src_port: int, dst_ip: int,
+                     dst_port: int, payload: bytes, tcp) -> None:
+        """Field-level entry point: the engine's pcap records (no
+        Packet object) ride the same frame builder as write_packet, so
+        engine-captured and object-path files are byte-identical."""
+        self._write(sim_now, _Fields(src_host_id, seq, proto, src_ip,
+                                     src_port, dst_ip, dst_port,
+                                     payload, tcp))
+
+    def _write(self, sim_now: int, p) -> None:
         emu = simtime.emulated_from_sim(sim_now)
         ip_payload = _transport_header(p) + p.payload
         frame = _ipv4_header(p, 20 + len(ip_payload)) + ip_payload

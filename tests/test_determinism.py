@@ -148,3 +148,21 @@ def test_pcap_is_valid(tmp_path):
         assert incl <= orig
     assert off == len(blob)
     assert records > 100  # a 2x150KB transfer is many segments
+
+
+def test_pcap_engine_byte_identical_to_object_path(tmp_path):
+    """pcap hosts no longer fall off the C++ engine: the engine records
+    captures at the same two interface instants (send-pop, inbound push
+    before demux) and the Python writer builds identical frames — the
+    .pcap FILES must be byte-for-byte equal between scheduler=tpu
+    (engine capture) and serial (object-path capture)."""
+    data_tpu = run_sim(tmp_path, "pcap-eng", "tpu")
+    data_ser = run_sim(tmp_path, "pcap-ser", "serial")
+    for iface in ("eth0", "lo"):
+        a = open(os.path.join(data_tpu, "hosts", "alice",
+                              f"{iface}.pcap"), "rb").read()
+        b = open(os.path.join(data_ser, "hosts", "alice",
+                              f"{iface}.pcap"), "rb").read()
+        assert a == b, f"{iface}.pcap diverged ({len(a)} vs {len(b)}B)"
+    assert len(open(os.path.join(data_tpu, "hosts", "alice",
+                                 "eth0.pcap"), "rb").read()) > 10_000

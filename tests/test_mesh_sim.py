@@ -185,16 +185,17 @@ def test_mesh_engine_fusion_participates():
 
 
 def test_mesh_mixed_planes_byte_identical(tmp_path):
-    """Cross-plane traffic under the sharded backend: pcap hosts stay
-    on the Python object path while the rest run engine-side, so
-    deliveries cross in BOTH directions (engine exports -> object
-    events; object packets interned -> engine inboxes) and the trace
-    must stay byte-identical to serial."""
+    """Cross-plane traffic under the sharded backend: hosts opted out
+    via per-host `native_dataplane: false` stay on the Python object
+    path while the rest run engine-side, so deliveries cross in BOTH
+    directions (engine exports -> object events; object packets
+    interned -> engine inboxes) and the trace must stay byte-identical
+    to serial."""
     text = udp_mesh_yaml(24, n_nodes=6, floods_per_host=2, count=4,
                          size=500, stop_time="8s", seed=3,
                          scheduler="tpu",
                          experimental_extra={"tpu_shards": 8},
-                         pcap_hosts=2,
+                         object_hosts=2,
                          data_directory=str(tmp_path / "mesh-data"))
     cfg = ConfigOptions.from_yaml_text(text)
     m_mesh, s_mesh = run_simulation(cfg)

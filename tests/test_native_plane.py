@@ -90,12 +90,13 @@ def test_udp_flood_parity():
 
 
 def test_mixed_plane_interop(tmp_path):
-    """A pcap-enabled host falls back to the object path; packets cross
-    between the engine store and Python packets in both directions and
-    the trace still matches an all-object-path run."""
+    """A host opted out via per-host `native_dataplane: false` runs the
+    object path; packets cross between the engine store and Python
+    packets in both directions and the trace still matches an
+    all-object-path run."""
     hosts = {
         "srv": {"network_node_id": 0,
-                "pcap_enabled": True,  # forces object path for this host
+                "native_dataplane": False,  # pin to the object path
                 "processes": [{"path": "tgen-server", "args": ["80"],
                                "expected_final_state": "running"}]},
         "cli": {"network_node_id": 1, "processes": [
