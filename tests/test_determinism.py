@@ -39,7 +39,8 @@ hosts:
 """
 
 
-def run_sim(tmp_path, name, scheduler, parallelism=1):
+def run_sim(tmp_path, name, scheduler, parallelism=1,
+            want_manager=False):
     from shadow_tpu.core.config import ConfigOptions
     from shadow_tpu.core.manager import run_simulation
 
@@ -49,7 +50,7 @@ def run_sim(tmp_path, name, scheduler, parallelism=1):
     cfg.general.parallelism = parallelism
     manager, summary = run_simulation(cfg, write_data=True)
     assert summary.ok, summary.plugin_errors
-    return data
+    return (data, manager) if want_manager else data
 
 
 def collect(dirpath):
@@ -156,7 +157,11 @@ def test_pcap_engine_byte_identical_to_object_path(tmp_path):
     before demux) and the Python writer builds identical frames — the
     .pcap FILES must be byte-for-byte equal between scheduler=tpu
     (engine capture) and serial (object-path capture)."""
-    data_tpu = run_sim(tmp_path, "pcap-eng", "tpu")
+    import pytest
+    data_tpu, m_tpu = run_sim(tmp_path, "pcap-eng", "tpu",
+                              want_manager=True)
+    if not m_tpu._pcap_engine:
+        pytest.skip("native engine unavailable: engine capture unexercised")
     data_ser = run_sim(tmp_path, "pcap-ser", "serial")
     for iface in ("eth0", "lo"):
         a = open(os.path.join(data_tpu, "hosts", "alice",

@@ -124,3 +124,29 @@ def test_engine_tcp_tier_across_seeds(seed):
     assert m_eng.propagator.packets_batched > 0
     assert m_ser.trace_lines() == m_eng.trace_lines()
     assert s_ser.packets_dropped == s_eng.packets_dropped
+
+
+@pytest.mark.parametrize("qdisc,loss,seed", [
+    ("fifo", 0.0, 11),
+    ("round_robin", 0.0, 12),
+    ("fifo", 0.03, 13),
+    ("round_robin", 0.02, 14),
+])
+def test_differential_matrix(qdisc, loss, seed):
+    """Catch-all differential: qdisc x loss x seed combinations of a
+    mixed UDP workload must byte-match between serial and the engine
+    (each combination exercises a different engine code path mix:
+    round-robin iface scheduling, loss-RNG draws, retry wakeups)."""
+    from shadow_tpu.tools.netgen import full_mesh_gml
+    gml = full_mesh_gml(4, loss=loss)
+    text = udp_mesh_yaml(12, n_nodes=4, floods_per_host=2, count=5,
+                         size=600, stop_time="8s", seed=seed,
+                         scheduler="serial", gml=gml,
+                         experimental_extra={"interface_qdisc": qdisc})
+    m_ser, s_ser = run_simulation(ConfigOptions.from_yaml_text(text))
+    text = text.replace("scheduler: serial", "scheduler: tpu")
+    m_eng, s_eng = run_simulation(ConfigOptions.from_yaml_text(text))
+    assert s_ser.ok and s_eng.ok
+    _require_plane(m_eng)  # vacuous without the engine
+    assert m_ser.trace_lines() == m_eng.trace_lines()
+    assert s_ser.packets_dropped == s_eng.packets_dropped
