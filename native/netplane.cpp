@@ -1398,6 +1398,14 @@ struct AppN {
   std::vector<uint32_t> peers;
   int32_t mesh_peer = -1;
   bool part_done = false;
+  /* Job control (Process.stop_process twin): while stopped the
+   * steppers consume no events — a wake that fires parks instead
+   * (stop_wake) and re-arms on continue; socket/TCP timers keep
+   * running exactly like a SIGSTOPped real process's kernel state.
+   * (Shielded-signal bookkeeping lives Python-side in
+   * EngineAppProcess — one source of truth.) */
+  bool stopped = false;
+  bool stop_wake = false;
   /* process stdout, built with the exact bytes the Python app would
    * have written */
   std::string out;
@@ -2217,6 +2225,12 @@ struct Engine {
   void app_step(int aidx, int64_t now) {
     AppN &a = apps[(size_t)aidx];
     a.wake_pending = false;
+    if (a.stopped) {
+      /* Park the wake (Python defers the thread resume into
+       * _stopped_resumes); continue re-arms it with a fresh seq. */
+      a.stop_wake = true;
+      return;
+    }
     /* Python's condition DISARMS at fire and re-arms only when the
      * re-dispatched syscall blocks again — status changes caused by
      * the running syscall itself are unobserved.  Clearing the wait
@@ -2337,6 +2351,125 @@ struct Engine {
       tcp_close(hp, static_cast<TcpSocketN *>(s), tok, now);
     else
       udp_close(hp, static_cast<UdpSocketN *>(s));
+  }
+
+  /* Terminate an engine app by (default-disposition) signal — the
+   * twin of the Python process terminate path: every fd of the
+   * process closes (fds.close_all — orderly TCP close semantics, no
+   * counted syscalls), threads die with 128+sig.  A tgen-server's
+   * handler threads belong to the same process, so they die with it;
+   * a udp-mesh's sibling thread likewise. */
+  /* Live handler threads accepted from `srv`'s listener — they belong
+   * to the same PROCESS, so every process-wide action (kill / stop /
+   * continue) must cover them. */
+  template <typename F>
+  void for_each_live_handler(const AppN &srv, F fn) {
+    if (srv.kind != APP_SERVER || srv.sock < 0) return;
+    uint32_t ltok = (uint32_t)srv.sock;
+    for (size_t i = 0; i < apps.size(); i++) {
+      AppN &h = apps[i];
+      if (h.exited || h.kind != APP_HANDLER || h.sock < 0 ||
+          h.hid != srv.hid)
+        continue;
+      TcpSocketN *c = tcp((uint32_t)h.sock);
+      if (c != nullptr && c->listener == (int32_t)ltok) fn((int)i, h);
+    }
+  }
+
+  void app_kill(int aidx, int sig, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    if (a.exited) return;
+    HostPlane *hp = plane(a.hid);
+    for_each_live_handler(a, [&](int, AppN &h) {
+      sock_close_any(hp, (uint32_t)h.sock, now);
+      sock((uint32_t)h.sock)->app_owner = -2;
+      h.exited = true;
+      h.exit_code = 128 + sig;
+      h.exit_time = now;
+      h.wait_mask = 0;
+    });
+    if (a.sock >= 0 && sock((uint32_t)a.sock)->app_owner != -2) {
+      sock_close_any(hp, (uint32_t)a.sock, now);
+      sock((uint32_t)a.sock)->app_owner = -2;
+    }
+    a.exited = true;
+    a.exit_code = 128 + sig;
+    a.exit_time = now;
+    a.wait_mask = 0;
+    if (a.mesh_peer >= 0) app_kill(a.mesh_peer, sig, now);
+  }
+
+  /* End-of-simulation teardown for a still-running engine app — the
+   * twin of the manager's `proc.fds.close_all(host)` sweep: every
+   * socket of the process closes (emitting FINs for mid-stream
+   * connections, traced at the host's current instant) WITHOUT
+   * touching exit state — the process still reports 'running'. */
+  void app_teardown(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    if (a.exited) return;
+    HostPlane *hp = plane(a.hid);
+    for_each_live_handler(a, [&](int, AppN &h2) {
+      sock_close_any(hp, (uint32_t)h2.sock, now);
+      sock((uint32_t)h2.sock)->app_owner = -2;
+    });
+    if (a.sock >= 0 && sock((uint32_t)a.sock)->app_owner != -2) {
+      sock_close_any(hp, (uint32_t)a.sock, now);
+      sock((uint32_t)a.sock)->app_owner = -2;
+    }
+    /* One-way only (main -> sender): mesh_peer links are
+     * bidirectional and this function sets no visited flag. */
+    if (a.mesh_peer >= 0 && a.kind == APP_UDP_MESH)
+      app_teardown(a.mesh_peer, now);
+  }
+
+  /* Thread-table view for kill/tgkill addressing: the process's app
+   * indices in SPAWN order (main, then accepted handlers INCLUDING
+   * exited ones — tid positions are stable — then the mesh sender). */
+  std::vector<int> app_threads(int aidx) {
+    std::vector<int> out{aidx};
+    AppN &a = apps[(size_t)aidx];
+    if (a.kind == APP_SERVER && a.sock >= 0) {
+      uint32_t ltok = (uint32_t)a.sock;
+      for (size_t i = 0; i < apps.size(); i++) {
+        AppN &h = apps[i];
+        if (h.kind != APP_HANDLER || h.sock < 0 || h.hid != a.hid)
+          continue;
+        TcpSocketN *c = tcp((uint32_t)h.sock);
+        if (c != nullptr && c->listener == (int32_t)ltok)
+          out.push_back((int)i);
+      }
+    }
+    if (a.mesh_peer >= 0 && a.kind == APP_UDP_MESH)
+      out.push_back(a.mesh_peer);
+    return out;
+  }
+
+  /* SIGSTOP/SIGTSTP default action on an engine app: process-wide —
+   * mesh sibling AND server handler threads freeze too. */
+  void app_stop(int aidx) {
+    AppN &a = apps[(size_t)aidx];
+    if (a.exited || a.stopped) return;
+    a.stopped = true;
+    for_each_live_handler(a, [&](int hidx, AppN &) { app_stop(hidx); });
+    if (a.mesh_peer >= 0) app_stop(a.mesh_peer);
+  }
+
+  /* SIGCONT: release parked wakes with fresh event seqs (the Python
+   * continue re-schedules each deferred resume the same way). */
+  void app_continue(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    if (a.exited || !a.stopped) return;
+    a.stopped = false;
+    if (a.stop_wake) {
+      a.stop_wake = false;
+      a.wake_pending = true;
+      HostPlane *hp = plane(a.hid);
+      hp->tpush({now, hp->event_seq++, TK_APP, (uint32_t)aidx});
+    }
+    for_each_live_handler(a, [&](int hidx, AppN &) {
+      app_continue(hidx, now);
+    });
+    if (a.mesh_peer >= 0) app_continue(a.mesh_peer, now);
   }
 
   /* udp-flood <dst> <port> <count> <size> [interval_ns] twin */
@@ -3649,6 +3782,82 @@ static PyObject *eng_app_poll(EngineObj *self, PyObject *args) {
                        a.out.data(), (Py_ssize_t)a.out.size());
 }
 
+static PyObject *eng_app_kill(EngineObj *self, PyObject *args) {
+  int idx, sig;
+  long long now;
+  if (!PyArg_ParseTuple(args, "iiL", &idx, &sig, &now)) return nullptr;
+  if (idx < 0 || (size_t)idx >= self->eng->apps.size()) {
+    PyErr_SetString(PyExc_IndexError, "bad app index");
+    return nullptr;
+  }
+  self->eng->app_kill(idx, sig, now);
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_app_stop(EngineObj *self, PyObject *args) {
+  int idx;
+  if (!PyArg_ParseTuple(args, "i", &idx)) return nullptr;
+  if (idx < 0 || (size_t)idx >= self->eng->apps.size()) {
+    PyErr_SetString(PyExc_IndexError, "bad app index");
+    return nullptr;
+  }
+  self->eng->app_stop(idx);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_app_threads(EngineObj *self, PyObject *args) {
+  int idx;
+  if (!PyArg_ParseTuple(args, "i", &idx)) return nullptr;
+  if (idx < 0 || (size_t)idx >= self->eng->apps.size()) {
+    PyErr_SetString(PyExc_IndexError, "bad app index");
+    return nullptr;
+  }
+  std::vector<int> t = self->eng->app_threads(idx);
+  PyObject *out = PyList_New((Py_ssize_t)t.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < t.size(); i++)
+    PyList_SET_ITEM(out, (Py_ssize_t)i, PyLong_FromLong(t[i]));
+  return out;
+}
+
+static PyObject *eng_advance_clocks(EngineObj *self, PyObject *args) {
+  /* End-of-simulation: pin every host's clock to the canonical end
+   * instant so teardown emissions timestamp identically across
+   * schedulers and planes. */
+  long long t;
+  if (!PyArg_ParseTuple(args, "L", &t)) return nullptr;
+  for (auto &hp : self->eng->hosts)
+    if (hp && hp->now < t) hp->now = t;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_app_teardown(EngineObj *self, PyObject *args) {
+  int idx;
+  long long now;
+  if (!PyArg_ParseTuple(args, "iL", &idx, &now)) return nullptr;
+  if (idx < 0 || (size_t)idx >= self->eng->apps.size()) {
+    PyErr_SetString(PyExc_IndexError, "bad app index");
+    return nullptr;
+  }
+  self->eng->app_teardown(idx, now);
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_app_continue(EngineObj *self, PyObject *args) {
+  int idx;
+  long long now;
+  if (!PyArg_ParseTuple(args, "iL", &idx, &now)) return nullptr;
+  if (idx < 0 || (size_t)idx >= self->eng->apps.size()) {
+    PyErr_SetString(PyExc_IndexError, "bad app index");
+    return nullptr;
+  }
+  self->eng->app_continue(idx, now);
+  CHECK_CB(self);
+  Py_RETURN_NONE;
+}
+
 static PyObject *eng_app_syscalls(EngineObj *self, PyObject *args) {
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
@@ -4182,6 +4391,15 @@ static PyMethodDef eng_methods[] = {
     {"fire", (PyCFunction)eng_fire, METH_VARARGS, nullptr},
     {"app_spawn", (PyCFunction)eng_app_spawn, METH_VARARGS, nullptr},
     {"app_poll", (PyCFunction)eng_app_poll, METH_VARARGS, nullptr},
+    {"app_kill", (PyCFunction)eng_app_kill, METH_VARARGS, nullptr},
+    {"app_stop", (PyCFunction)eng_app_stop, METH_VARARGS, nullptr},
+    {"app_teardown", (PyCFunction)eng_app_teardown, METH_VARARGS,
+     nullptr},
+    {"advance_clocks", (PyCFunction)eng_advance_clocks, METH_VARARGS,
+     nullptr},
+    {"app_threads", (PyCFunction)eng_app_threads, METH_VARARGS, nullptr},
+    {"app_continue", (PyCFunction)eng_app_continue, METH_VARARGS,
+     nullptr},
     {"app_syscalls", (PyCFunction)eng_app_syscalls, METH_VARARGS, nullptr},
     {"deliver", (PyCFunction)eng_deliver, METH_VARARGS, nullptr},
     {"take_outgoing", (PyCFunction)eng_take_outgoing, METH_VARARGS, nullptr},

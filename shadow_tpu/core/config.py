@@ -399,8 +399,9 @@ class ConfigOptions:
                     shutdown_time_ns=(units.parse_time_ns(p["shutdown_time"])
                                       if "shutdown_time" in p else None),
                     shutdown_signal=str(p.get("shutdown_signal", "SIGTERM")),
-                    expected_final_state=p.get("expected_final_state",
-                                               "exited 0"),
+                    expected_final_state=_validate_final_state(
+                        p.get("expected_final_state", "exited 0"),
+                        f"hosts.{name}.processes[{len(procs)}]"),
                 ))
             bw_down = h.get("bandwidth_down")
             bw_up = h.get("bandwidth_up")
@@ -432,6 +433,31 @@ def _require(mapping: dict, key: str, where: str):
     if key not in mapping:
         raise ValueError(f"missing required config key {where}.{key}")
     return mapping[key]
+
+
+def _validate_final_state(v, where: str):
+    """Fail loudly on malformed expected_final_state (a typo would
+    otherwise change run outcomes — and could do so differently per
+    backend)."""
+    if isinstance(v, str):
+        if v in ("running", "any"):
+            return v
+        parts = v.split()
+        try:
+            if parts and parts[0] == "exited" and len(parts) <= 2:
+                if len(parts) == 2:
+                    int(parts[1])
+                return v
+            if parts and parts[0] == "signaled" and len(parts) <= 2:
+                if len(parts) == 2:
+                    from shadow_tpu.host.signals import parse_signal
+                    parse_signal(parts[1])
+                return v
+        except ValueError:
+            pass
+    raise ValueError(
+        f"{where}: invalid expected_final_state {v!r} (expected "
+        f"'running', 'any', 'exited [code]', or 'signaled [SIG]')")
 
 
 def _load_graph(gspec: dict, base_dir: str) -> netgraph.NetworkGraph:

@@ -304,12 +304,14 @@ class Manager:
 
         def spawn(h, _pcfg=pcfg):
             # Engine-resident tgen apps: when the host lives on the
-            # native plane (and nothing needs the Python process
-            # machinery — no strace, no shutdown signal), the whole
-            # app/syscall/TCP path runs in C++ with a byte-identical
-            # packet trace (host/engine_app.py).
-            if (h.plane is not None and strace_mode is None
-                    and pcfg.shutdown_time_ns is None):
+            # native plane and nothing needs the Python process
+            # machinery (no strace), the whole app/syscall/TCP path
+            # runs in C++ with a byte-identical packet trace
+            # (host/engine_app.py) — including default-disposition
+            # signal delivery (terminate / stop / continue) for
+            # shutdown_time configs and kill(2) from co-resident
+            # processes.
+            if h.plane is not None and strace_mode is None:
                 from shadow_tpu.host.engine_app import (EngineAppProcess,
                                                         engine_app_args)
                 spec = engine_app_args(_pcfg, h, self.dns)
@@ -613,6 +615,16 @@ class Manager:
                         f"{proc.expected_final_state!r}, got {state!r}")
         if self._pool is not None:
             self._pool.shutdown()
+        # Teardown happens at one canonical instant — the simulation
+        # end — on every host and plane: the closes below emit packets
+        # (FINs of mid-stream connections), and per-host "last event"
+        # clocks are scheduler-dependent state that must not leak into
+        # the trace.
+        for h in self.hosts:
+            if h._now < summary.end_time_ns:
+                h._now = summary.end_time_ns
+        if self.plane is not None:
+            self.plane.engine.advance_clocks(summary.end_time_ns)
         # Tear down any still-running managed (native) processes; flush
         # streamed strace files for processes that never exited.
         from shadow_tpu.host.managed import ManagedProcess

@@ -22,12 +22,43 @@ KIND_UDP_SINK = 4
 KIND_UDP_MESH = 5
 
 
-class _FdTableStub:
+class _EngineFdView:
+    """Fd-table view for the manager's teardown sweep: `close_all` on
+    a still-running engine app closes its engine-side sockets exactly
+    like the object path's fds.close_all (FINs for mid-stream
+    connections, traced at the host's current instant)."""
+
+    __slots__ = ("_proc",)
+
+    def __init__(self, proc):
+        self._proc = proc
+
     def close_all(self, host) -> None:
-        pass
+        p = self._proc
+        if p.app_idx is not None and not p.exited:
+            host.plane.engine.app_teardown(p.app_idx, host.now())
 
     def __len__(self) -> int:
         return 0
+
+
+class _AppThreadView:
+    """Thread-table entry the kill/tgkill addressing paths read
+    (tid + liveness), polling the ENGINE app that backs the thread."""
+
+    __slots__ = ("tid", "_proc", "_app_idx")
+
+    def __init__(self, tid: int, proc, app_idx: int):
+        self.tid = tid
+        self._proc = proc
+        self._app_idx = app_idx
+
+    @property
+    def state(self):
+        from shadow_tpu.host.process import ST_EXITED, ST_RUNNABLE
+        exited, _c, _t, _o = self._proc.host.plane.engine.app_poll(
+            self._app_idx)
+        return ST_EXITED if exited else ST_RUNNABLE
 
 
 class EngineAppProcess:
@@ -42,9 +73,32 @@ class EngineAppProcess:
         self.app_idx: int | None = None   # set right after app_spawn
         self.term_signal = None
         self.stderr = bytearray()
-        self.fds = _FdTableStub()
+        self.fds = _EngineFdView(self)
+        # Process-interface attributes that host-wide machinery (kill
+        # addressing, wait4 scans over host.processes) reads on every
+        # process, engine-backed or not.
+        self.parent_pid: int | None = None
+        self.pgid = self.pid
+        self.sid = self.pid
+        self.zombies: list = []
+        self.stop_report: int | None = None
+        self.continue_report = False
+        self._stopped = False
+        self._shielded: list[int] = []
 
     # -- engine state ---------------------------------------------------
+
+    @property
+    def threads(self) -> tuple:
+        """Live thread-table view: the engine enumerates the process's
+        app threads in spawn order (main, accepted handlers — exited
+        ones keep their tid slot — then the mesh sender), so tgkill
+        addressing matches the Python twin's tid numbering."""
+        if self.app_idx is None:
+            return ()
+        idxs = self.host.plane.engine.app_threads(self.app_idx)
+        return tuple(_AppThreadView(self.pid + i, self, idx)
+                     for i, idx in enumerate(idxs))
 
     def _poll(self):
         return self.host.plane.engine.app_poll(self.app_idx)
@@ -67,17 +121,57 @@ class EngineAppProcess:
 
     # -- Process interface the Manager touches --------------------------
 
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def raise_signal(self, host, sig: int, target_tid=None,
+                     si_code: int = 0, si_pid: int = 0,
+                     si_status: int = 0) -> None:
+        """Engine apps install no handlers: apply the DEFAULT action
+        — terminate, stop (steppers park; socket timers keep running,
+        like a SIGSTOPped real process's kernel state), continue, or
+        ignore.  The stop shields non-KILL fatal signals until the
+        continue, mirroring Process.raise_signal."""
+        from shadow_tpu.host import signals as sigmod
+        if self.exited or sig <= 0 or sig >= sigmod.NSIG:
+            return
+        eng = self.host.plane.engine
+        if sig == sigmod.SIGCONT:
+            if self._stopped:
+                self._stopped = False
+                self.stop_report = None
+                self.continue_report = True
+                eng.app_continue(self.app_idx, host.now())
+                shielded, self._shielded = self._shielded, []
+                for s in shielded:
+                    self.raise_signal(host, s)
+            return
+        disp = sigmod.ProcessSignals().disposition(sig)
+        if sig == sigmod.SIGKILL:
+            self.term_signal = sig
+            eng.app_kill(self.app_idx, sig, host.now())
+            return
+        if self._stopped:
+            if disp not in ("ignore", "stop"):
+                self._shielded.append(sig)
+            return
+        if disp == "stop":
+            self._stopped = True
+            self.stop_report = sig
+            self.continue_report = False
+            eng.app_stop(self.app_idx)
+            return
+        if disp != "terminate":
+            return
+        self.term_signal = sig
+        eng.app_kill(self.app_idx, sig, host.now())
+
     def matches_expected_final_state(self) -> bool:
-        expected = self.expected_final_state
-        if expected in ("running", "any"):
-            return expected == "any" or not self.exited
-        if isinstance(expected, str) and expected.startswith("exited"):
-            parts = expected.split()
-            want = int(parts[1]) if len(parts) > 1 else 0
-            return self.exited and self.exit_code == want
-        if isinstance(expected, str) and expected.startswith("signaled"):
-            return False  # engine apps never die by signal
-        return False
+        from shadow_tpu.host.process import matches_final_state
+        return matches_final_state(self.expected_final_state,
+                                   self.exited, self.exit_code,
+                                   self.term_signal)
 
     def strace_close(self) -> None:
         pass

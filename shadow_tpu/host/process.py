@@ -351,21 +351,31 @@ class Process:
             t._exit(host, 128 + sig)
 
     def matches_expected_final_state(self) -> bool:
-        expected = self.expected_final_state
-        if expected in ("running", "any"):
-            return expected == "any" or not self.exited
-        if isinstance(expected, str) and expected.startswith("exited"):
-            parts = expected.split()
-            want = int(parts[1]) if len(parts) > 1 else 0
-            return self.exited and self.exit_code == want \
-                and self.term_signal is None
-        if isinstance(expected, str) and expected.startswith("signaled"):
-            from shadow_tpu.host.signals import parse_signal
-            parts = expected.split()
-            if self.term_signal is None:
-                return False
-            return len(parts) < 2 or self.term_signal == parse_signal(parts[1])
-        return True
+        return matches_final_state(self.expected_final_state,
+                                   self.exited, self.exit_code,
+                                   self.term_signal)
+
+
+def matches_final_state(expected, exited: bool, exit_code,
+                        term_signal) -> bool:
+    """The ONE expected_final_state matcher, shared by Process and
+    EngineAppProcess so serial and engine backends can never disagree
+    on run success.  Unknown shapes are rejected at config parse
+    (core/config._validate_final_state); the permissive True fallback
+    here only covers non-config constructions."""
+    if expected in ("running", "any"):
+        return expected == "any" or not exited
+    if isinstance(expected, str) and expected.startswith("exited"):
+        parts = expected.split()
+        want = int(parts[1]) if len(parts) > 1 else 0
+        return exited and exit_code == want and term_signal is None
+    if isinstance(expected, str) and expected.startswith("signaled"):
+        from shadow_tpu.host.signals import parse_signal
+        parts = expected.split()
+        if term_signal is None:
+            return False
+        return len(parts) < 2 or term_signal == parse_signal(parts[1])
+    return True
 
 
 def host_descriptor_table():

@@ -194,3 +194,140 @@ def test_udp_mesh_engine_twin_byte_identical(tmp_path):
     assert any(b"mesh sent 25" in v and b"mesh received 17500 bytes" in v
                for v in out_ser.values())
     assert _hist(m_ser) == _hist(m_tpu)
+
+
+def test_engine_app_shutdown_signal(tmp_path):
+    """Processes with a shutdown_time now run engine-resident (the
+    tornettools idiom: stop clients/servers mid-run): at the shutdown
+    instant the default SIGTERM action terminates the whole app —
+    server handler threads die with it, every socket closes with
+    orderly TCP semantics — byte-identical to the Python coroutine
+    path, and final states report `signaled SIGTERM`."""
+
+    def run(sched):
+        yaml = f"""
+general: {{ stop_time: 30s, seed: 13, data_directory: {tmp_path / sched}-sd }}
+experimental: {{ scheduler: {sched} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ] ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-server, args: ["80"], shutdown_time: 6s,
+           expected_final_state: signaled SIGTERM }}
+  sink:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-sink, args: ["7000"], shutdown_time: 5s,
+           expected_final_state: signaled SIGTERM }}
+  client:
+    network_node_id: 0
+    processes:
+      # 60 MB at 100 Mbit ~ 5s: the transfer SPANS the 6s shutdown, so
+      # a live handler thread dies with the server (its connection
+      # closes mid-stream) — the handler-kill path, not a no-op sweep.
+      - {{ path: tgen-client, args: [server, "80", "60000000", "1"],
+           start_time: 1s, expected_final_state: any }}
+"""
+        return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+    m_ser, s_ser = run("serial")
+    m_tpu, s_tpu = run("tpu")
+    assert s_ser.ok, s_ser.plugin_errors
+    assert s_tpu.ok, s_tpu.plugin_errors
+    if m_tpu.plane is not None:
+        n_engine = sum(
+            1 for h in m_tpu.hosts for p in h.processes.values()
+            if isinstance(p, EngineAppProcess))
+        assert n_engine == 3, "shutdown_time apps fell off the engine"
+    assert m_ser.trace_lines() == m_tpu.trace_lines()
+    assert _hist(m_ser) == _hist(m_tpu)
+
+
+def test_engine_app_sigstop_shutdown(tmp_path):
+    """shutdown_signal SIGSTOP on an engine app: the app freezes at the
+    shutdown instant (steppers park, TCP/socket timers keep running —
+    a SIGSTOPped real process's kernel keeps ACKing) and never exits —
+    byte-identical to the Python coroutine path."""
+
+    def run(sched):
+        yaml = f"""
+general: {{ stop_time: 20s, seed: 17, data_directory: {tmp_path / sched}-st }}
+experimental: {{ scheduler: {sched} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ] ]
+hosts:
+  flood:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-flood, args: [sink, "7000", "400", "600", "30000000"],
+           start_time: 1s, shutdown_time: 4s, shutdown_signal: SIGSTOP,
+           expected_final_state: running }}
+  sink:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-sink, args: ["7000"],
+           expected_final_state: running }}
+"""
+        return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+    m_ser, s_ser = run("serial")
+    m_tpu, s_tpu = run("tpu")
+    assert s_ser.ok, s_ser.plugin_errors
+    assert s_tpu.ok, s_tpu.plugin_errors
+    # The flood froze mid-run: far fewer than 400 datagrams made it.
+    assert 0 < s_ser.packets_sent < 400
+    assert s_ser.packets_sent == s_tpu.packets_sent
+    assert m_ser.trace_lines() == m_tpu.trace_lines()
+    assert _hist(m_ser) == _hist(m_tpu)
+
+
+def test_engine_server_sigstop_with_live_handler(tmp_path):
+    """SIGSTOP on an engine tgen-server while a handler is mid-transfer:
+    the stop is PROCESS-wide — the handler thread freezes with the
+    listener (the round-4 review's reproduced divergence), while the
+    socket's TCP state keeps ACKing like a real stopped process.  After
+    SIGCONT (via a second shutdown? config has one signal — instead the
+    frozen server simply never finishes) the trace must byte-match the
+    Python coroutine path."""
+
+    def run(sched):
+        yaml = f"""
+general: {{ stop_time: 20s, seed: 23, data_directory: {tmp_path / sched}-ss }}
+experimental: {{ scheduler: {sched} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ] ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-server, args: ["80"], shutdown_time: 3s,
+           shutdown_signal: SIGSTOP, expected_final_state: running }}
+  client:
+    network_node_id: 0
+    processes:
+      - {{ path: tgen-client, args: [server, "80", "60000000", "1"],
+           start_time: 1s, expected_final_state: any }}
+"""
+        return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+    m_ser, s_ser = run("serial")
+    m_tpu, s_tpu = run("tpu")
+    assert s_ser.ok, s_ser.plugin_errors
+    assert s_tpu.ok, s_tpu.plugin_errors
+    assert s_ser.packets_sent == s_tpu.packets_sent
+    assert m_ser.trace_lines() == m_tpu.trace_lines()
+    assert _hist(m_ser) == _hist(m_tpu)
