@@ -1406,6 +1406,7 @@ struct AppN {
    * EngineAppProcess — one source of truth.) */
   bool stopped = false;
   bool stop_wake = false;
+  int64_t stop_seq = -1;  // park order (Python _stopped_resumes order)
   /* process stdout, built with the exact bytes the Python app would
    * have written */
   std::string out;
@@ -2227,8 +2228,14 @@ struct Engine {
     a.wake_pending = false;
     if (a.stopped) {
       /* Park the wake (Python defers the thread resume into
-       * _stopped_resumes); continue re-arms it with a fresh seq. */
-      a.stop_wake = true;
+       * _stopped_resumes, in fire order); continue re-arms it with a
+       * fresh seq.  The wait mask disarms exactly like the fired
+       * Python condition — further status changes draw no events. */
+      if (!a.stop_wake) {
+        a.stop_wake = true;
+        a.stop_seq = stop_park_counter++;
+      }
+      a.wait_mask = 0;
       return;
     }
     /* Python's condition DISARMS at fire and re-arms only when the
@@ -2359,22 +2366,30 @@ struct Engine {
    * counted syscalls), threads die with 128+sig.  A tgen-server's
    * handler threads belong to the same process, so they die with it;
    * a udp-mesh's sibling thread likewise. */
-  /* Live handler threads accepted from `srv`'s listener — they belong
-   * to the same PROCESS, so every process-wide action (kill / stop /
-   * continue) must cover them. */
+  /* Handler threads accepted from `srv`'s listener — they belong to
+   * the same PROCESS, so every process-wide action (kill / stop /
+   * continue / tid enumeration) must cover them.  One enumerator so
+   * the match predicate can never diverge between those actions. */
   template <typename F>
-  void for_each_live_handler(const AppN &srv, F fn) {
+  void for_each_handler(const AppN &srv, bool include_exited, F fn) {
     if (srv.kind != APP_SERVER || srv.sock < 0) return;
     uint32_t ltok = (uint32_t)srv.sock;
     for (size_t i = 0; i < apps.size(); i++) {
       AppN &h = apps[i];
-      if (h.exited || h.kind != APP_HANDLER || h.sock < 0 ||
-          h.hid != srv.hid)
+      if ((h.exited && !include_exited) || h.kind != APP_HANDLER ||
+          h.sock < 0 || h.hid != srv.hid)
         continue;
       TcpSocketN *c = tcp((uint32_t)h.sock);
       if (c != nullptr && c->listener == (int32_t)ltok) fn((int)i, h);
     }
   }
+
+  template <typename F>
+  void for_each_live_handler(const AppN &srv, F fn) {
+    for_each_handler(srv, /*include_exited=*/false, fn);
+  }
+
+  int64_t stop_park_counter = 0;  // process-stop park ordering
 
   void app_kill(int aidx, int sig, int64_t now) {
     AppN &a = apps[(size_t)aidx];
@@ -2428,17 +2443,8 @@ struct Engine {
   std::vector<int> app_threads(int aidx) {
     std::vector<int> out{aidx};
     AppN &a = apps[(size_t)aidx];
-    if (a.kind == APP_SERVER && a.sock >= 0) {
-      uint32_t ltok = (uint32_t)a.sock;
-      for (size_t i = 0; i < apps.size(); i++) {
-        AppN &h = apps[i];
-        if (h.kind != APP_HANDLER || h.sock < 0 || h.hid != a.hid)
-          continue;
-        TcpSocketN *c = tcp((uint32_t)h.sock);
-        if (c != nullptr && c->listener == (int32_t)ltok)
-          out.push_back((int)i);
-      }
-    }
+    for_each_handler(a, /*include_exited=*/true,
+                     [&](int i, AppN &) { out.push_back(i); });
     if (a.mesh_peer >= 0 && a.kind == APP_UDP_MESH)
       out.push_back(a.mesh_peer);
     return out;
@@ -2447,29 +2453,34 @@ struct Engine {
   /* SIGSTOP/SIGTSTP default action on an engine app: process-wide —
    * mesh sibling AND server handler threads freeze too. */
   void app_stop(int aidx) {
-    AppN &a = apps[(size_t)aidx];
-    if (a.exited || a.stopped) return;
-    a.stopped = true;
-    for_each_live_handler(a, [&](int hidx, AppN &) { app_stop(hidx); });
-    if (a.mesh_peer >= 0) app_stop(a.mesh_peer);
+    for (int t : app_threads(aidx)) {
+      AppN &x = apps[(size_t)t];
+      if (!x.exited) x.stopped = true;
+    }
   }
 
-  /* SIGCONT: release parked wakes with fresh event seqs (the Python
-   * continue re-schedules each deferred resume the same way). */
+  /* SIGCONT: release parked wakes with fresh event seqs IN PARK ORDER
+   * (the Python continue replays _stopped_resumes in the order the
+   * deferred resumes fired). */
   void app_continue(int aidx, int64_t now) {
     AppN &a = apps[(size_t)aidx];
     if (a.exited || !a.stopped) return;
-    a.stopped = false;
-    if (a.stop_wake) {
-      a.stop_wake = false;
-      a.wake_pending = true;
-      HostPlane *hp = plane(a.hid);
-      hp->tpush({now, hp->event_seq++, TK_APP, (uint32_t)aidx});
+    std::vector<std::pair<int64_t, int>> parked;
+    for (int t : app_threads(aidx)) {
+      AppN &x = apps[(size_t)t];
+      if (x.exited || !x.stopped) continue;
+      x.stopped = false;
+      if (x.stop_wake) {
+        x.stop_wake = false;
+        parked.push_back({x.stop_seq, t});
+      }
     }
-    for_each_live_handler(a, [&](int hidx, AppN &) {
-      app_continue(hidx, now);
-    });
-    if (a.mesh_peer >= 0) app_continue(a.mesh_peer, now);
+    std::sort(parked.begin(), parked.end());
+    HostPlane *hp = plane(a.hid);
+    for (auto &p : parked) {
+      apps[(size_t)p.second].wake_pending = true;
+      hp->tpush({now, hp->event_seq++, TK_APP, (uint32_t)p.second});
+    }
   }
 
   /* udp-flood <dst> <port> <count> <size> [interval_ns] twin */

@@ -450,8 +450,11 @@ def _validate_final_state(v, where: str):
                 return v
             if parts and parts[0] == "signaled" and len(parts) <= 2:
                 if len(parts) == 2:
-                    from shadow_tpu.host.signals import parse_signal
-                    parse_signal(parts[1])
+                    from shadow_tpu.host.signals import (NSIG,
+                                                         parse_signal)
+                    sig = parse_signal(parts[1])
+                    if not 0 < sig < NSIG:
+                        raise ValueError(f"signal {sig} out of range")
                 return v
         except ValueError:
             pass

@@ -1,0 +1,25 @@
+/* kill_peer <pid> <sig> [tgkill] — sends a signal to a co-resident
+ * simulated process (internal-app pids are deterministic: first
+ * process on a host is 1000).  Gates the engine-app signal surface
+ * from the REAL syscall path. */
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 3) return 2;
+    int pid = atoi(argv[1]);
+    int sig = atoi(argv[2]);
+    int r;
+    if (argc > 3 && strcmp(argv[3], "tgkill") == 0)
+        r = (int)syscall(SYS_tgkill, pid, pid, sig);
+    else
+        r = kill(pid, sig);
+    printf("kill rc=%d errno=%d\n", r, r == 0 ? 0 : errno);
+    fflush(stdout);
+    return 0;
+}

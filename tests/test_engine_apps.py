@@ -9,6 +9,7 @@ draws.  Gates: byte-identical packet traces vs the serial scheduler
 identical per-name syscall histograms.
 """
 
+import os
 from shadow_tpu.core.config import ConfigOptions
 from shadow_tpu.core.manager import run_simulation
 from shadow_tpu.host.engine_app import EngineAppProcess
@@ -331,3 +332,62 @@ hosts:
     assert s_ser.packets_sent == s_tpu.packets_sent
     assert m_ser.trace_lines() == m_tpu.trace_lines()
     assert _hist(m_ser) == _hist(m_tpu)
+
+
+def test_managed_binary_kills_engine_app(tmp_path):
+    """kill(2)/tgkill(2) from a REAL managed binary targeting an
+    engine-resident app (deterministic pid 1000): the app dies by the
+    default SIGTERM action with identical traces and final states
+    under serial (Python app) and tpu (engine app)."""
+    import shutil
+    import subprocess
+    if shutil.which("cc") is None:
+        import pytest
+        pytest.skip("no C toolchain")
+    exe = str(tmp_path / "kill_peer")
+    subprocess.run(
+        ["cc", "-O1", "-o", exe,
+         os.path.join(os.path.dirname(__file__), "plugins",
+                      "kill_peer.c")], check=True)
+
+    def run(sched, mode):
+        extra = ', "tgkill"' if mode == "tgkill" else ""
+        yaml = f"""
+general: {{ stop_time: 15s, seed: 29,
+            data_directory: {tmp_path / sched}-{mode} }}
+experimental: {{ scheduler: {sched} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ] ]
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-sink, args: ["7000"],
+           expected_final_state: signaled SIGTERM }}
+      - {{ path: {exe}, args: ["1000", "15"{extra}], start_time: 3s }}
+  feeder:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-flood, args: [alpha, "7000", "100", "400", "80000000"],
+           start_time: 1s, expected_final_state: any }}
+"""
+        return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+    for mode in ("kill", "tgkill"):
+        m_ser, s_ser = run("serial", mode)
+        m_tpu, s_tpu = run("tpu", mode)
+        assert s_ser.ok, (mode, s_ser.plugin_errors)
+        assert s_tpu.ok, (mode, s_tpu.plugin_errors)
+        assert m_ser.trace_lines() == m_tpu.trace_lines(), mode
+        out_ser = next(bytes(p.stdout) for h in m_ser.hosts
+                       for p in h.processes.values()
+                       if "kill_peer" in p.name)
+        out_tpu = next(bytes(p.stdout) for h in m_tpu.hosts
+                       for p in h.processes.values()
+                       if "kill_peer" in p.name)
+        assert out_ser == out_tpu == b"kill rc=0 errno=0\n", \
+            (mode, out_ser, out_tpu)
