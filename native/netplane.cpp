@@ -149,6 +149,12 @@ constexpr int CB_CHILD_DEAD = 2;   // (tok, 0) pre-accept teardown
 constexpr int TK_RELAY = 0;  // target = relay index (0 lo, 1 out, 2 in)
 constexpr int TK_TCP = 1;    // target = socket token
 constexpr int TK_APP = 2;    // target = engine-app index
+/* Python's timeout-based sleeps are TWO-stage: the condition-timeout
+ * task (seq drawn at ARM) fires and schedules the syscall-wakeup task
+ * with a FRESH seq — so a same-instant packet arrival's wakeup (drawn
+ * during the packet event, which sorts first) precedes the sleeper's.
+ * TK_APP_TIMEOUT mirrors stage one; it re-queues a TK_APP. */
+constexpr int TK_APP_TIMEOUT = 3;
 
 /* Engine-app syscall names, counted exactly where the Python twin's
  * dispatch would count (host.count_syscall) so sim-stats agree. */
@@ -1407,6 +1413,13 @@ struct AppN {
   bool stopped = false;
   bool stop_wake = false;
   int64_t stop_seq = -1;  // park order (Python _stopped_resumes order)
+  int64_t wait_seq = -1;  // blocked-park order (listener registration)
+  /* phold: LCG state shared by the process's threads (lives in the
+   * MAIN AppN; the seeder reads it via mesh_peer backref), and the
+   * pre-drawn send target (Python evaluates the sendto args once —
+   * an EAGAIN retry must not re-draw). */
+  uint32_t lcg = 0;
+  uint32_t phold_target = 0;
   /* process stdout, built with the exact bytes the Python app would
    * have written */
   std::string out;
@@ -1414,7 +1427,7 @@ struct AppN {
 
 constexpr int APP_SERVER = 0, APP_CLIENT = 1, APP_HANDLER = 2,
               APP_UDP_FLOOD = 3, APP_UDP_SINK = 4, APP_UDP_MESH = 5,
-              APP_UDP_MESH_SND = 6;
+              APP_UDP_MESH_SND = 6, APP_PHOLD = 7, APP_PHOLD_SEED = 8;
 /* client transfer states */
 constexpr int CL_CONNECTING = 1, CL_RECV = 3;
 /* handler states */
@@ -1534,13 +1547,28 @@ struct Engine {
        * transitions, status.py adjust_status) — the blocked syscall
        * re-dispatches and may simply re-block; matching this keeps
        * the wake/re-run pattern (and syscall counts) identical. */
-      app_wake(s->app_owner, changed);
-      /* udp-mesh: TWO threads park on one socket (main: readable;
-       * sender: writable).  Registration order — main blocked first —
-       * is owner-then-sibling; the masks are disjoint, so at most one
-       * actually wakes per change. */
+      /* TWO threads of one process can park on one socket (udp-mesh
+       * main/sender, phold main/seeder — both may even wait on the
+       * SAME bits under send-buffer saturation).  Python fires the
+       * status listeners in registration = block order; replay it. */
       int sib = apps[(size_t)s->app_owner].mesh_peer;
-      if (sib >= 0) app_wake(sib, changed);
+      if (sib >= 0) {
+        AppN &o = apps[(size_t)s->app_owner];
+        AppN &b = apps[(size_t)sib];
+        bool ow = !o.wake_pending && !o.exited && !o.stopped &&
+                  (changed & o.wait_mask);
+        bool bw = !b.wake_pending && !b.exited && !b.stopped &&
+                  (changed & b.wait_mask);
+        if (ow && bw && b.wait_seq < o.wait_seq) {
+          app_wake(sib, changed);
+          app_wake(s->app_owner, changed);
+        } else {
+          app_wake(s->app_owner, changed);
+          app_wake(sib, changed);
+        }
+      } else {
+        app_wake(s->app_owner, changed);
+      }
     }
     /* -2: pre-accept child of an app listener — silent */
   }
@@ -1922,6 +1950,9 @@ struct Engine {
           RelayN &r = hp->relays[e.target];
           r.state = RELAY_IDLE;
           relay_forward(hp, e.target, et);
+        } else if (e.kind == TK_APP_TIMEOUT) {
+          /* stage two: the wakeup draws its seq NOW */
+          hp->tpush({et, hp->event_seq++, TK_APP, e.target});
         } else if (e.kind == TK_APP) {
           app_step((int)e.target, et);
         } else {
@@ -2186,6 +2217,43 @@ struct Engine {
         hp->tpush({now, hp->event_seq++, TK_APP, (uint32_t)sidx});
         app_step_mesh(aidx, now);
       }
+    } else if (kind == APP_PHOLD) {
+      /* phold <port> <my_index> <n_init> <mean_delay> <peers...> */
+      {
+        AppN &ap = apps[(size_t)aidx];
+        ap.port = (int)a;
+        ap.count = (int)c;      // n_init (the seeder's budget)
+        ap.interval = d;        // mean_delay_ns
+        ap.lcg = (uint32_t)((b * 2654435761ll + 12345) & 0xFFFFFFFFll);
+        ap.peers.assign(peer_ips, peer_ips + n_peers);
+      }
+      asys(hp, ASYS_SOCKET);
+      uint32_t tok = new_udp(hid, sb, rb);
+      sock(tok)->app_owner = aidx;
+      apps[(size_t)aidx].sock = (int64_t)tok;
+      asys(hp, ASYS_BIND);
+      if (generic_bind(hp, sock(tok), tok, 0, (int)a) < 0) {
+        app_die(aidx, 101, now);
+      } else {
+        for (int64_t i = 0; i < n_peers; i++) asys(hp, ASYS_RESOLVE);
+        asys(hp, ASYS_SPAWN_THREAD);
+        int sidx = (int)apps.append();
+        {
+          AppN &sn = apps[(size_t)sidx];
+          const AppN &m = apps[(size_t)aidx];
+          sn.kind = APP_PHOLD_SEED;
+          sn.hid = hid;
+          sn.sock = m.sock;
+          sn.port = m.port;
+          sn.count = m.count;
+          sn.interval = m.interval;
+          sn.mesh_peer = aidx;
+          sn.wake_pending = true;
+        }
+        apps[(size_t)aidx].mesh_peer = sidx;
+        hp->tpush({now, hp->event_seq++, TK_APP, (uint32_t)sidx});
+        app_step_phold(aidx, now);
+      }
     } else {  /* APP_UDP_SINK */
       AppN &ap = apps[(size_t)aidx];
       ap.port = (int)a;
@@ -2250,6 +2318,8 @@ struct Engine {
     else if (a.kind == APP_UDP_SINK) app_step_sink(aidx, now);
     else if (a.kind == APP_UDP_MESH) app_step_mesh(aidx, now);
     else if (a.kind == APP_UDP_MESH_SND) app_step_mesh_snd(aidx, now);
+    else if (a.kind == APP_PHOLD) app_step_phold(aidx, now);
+    else if (a.kind == APP_PHOLD_SEED) app_step_phold_seed(aidx, now);
     else app_step_handler(aidx, now);
   }
 
@@ -2260,7 +2330,7 @@ struct Engine {
       TcpSocketN *l = tcp((uint32_t)a.sock);
       asys(hp, ASYS_ACCEPT);
       int64_t r = tcp_accept(hp, l, now);
-      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r == -E_AGAIN) { park(a, S_READABLE); return; }
       if (r < 0) { app_die(aidx, 101, now); return; }
       /* spawn_thread(serve(conn)): handler app + its start event, the
        * same task the Python sys_spawn_thread schedules. */
@@ -2302,7 +2372,7 @@ struct Engine {
     if (a.state == CL_CONNECTING) {
       asys(hp, ASYS_CONNECT);
       int r = tcp_connect(hp, s, tok, a.dst_ip, a.dst_port, now);
-      if (r == R_BLOCK) { a.wait_mask = S_WRITABLE | S_CLOSED; return; }
+      if (r == R_BLOCK) { park(a, S_WRITABLE | S_CLOSED); return; }
       if (r < 0 && r != -E_INPROGRESS) { app_die(aidx, 101, now); return; }
       char line[32];
       int n = snprintf(line, sizeof(line), "GET %lld\n",
@@ -2317,7 +2387,7 @@ struct Engine {
     while (a.got < a.nbytes) {
       asys(hp, ASYS_RECV);
       int r = tcp_recv(hp, s, tok, 1 << 16, false, now, &out);
-      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r == -E_AGAIN) { park(a, S_READABLE); return; }
       if (r < 0) { app_die(aidx, 101, now); return; }
       if (out.empty()) break;  // EOF short
       a.got += (int64_t)out.size();
@@ -2390,6 +2460,17 @@ struct Engine {
   }
 
   int64_t stop_park_counter = 0;  // process-stop park ordering
+  int64_t wait_park_counter = 0;  // blocked-stepper park ordering
+
+  /* Park a stepper on status bits, recording the BLOCK ORDER: when
+   * two threads of one process wait on the same socket (phold main +
+   * seeder both writable-blocked under saturation), Python resumes
+   * them in the order they blocked (listener registration order) —
+   * the wake fan-out below replays that order. */
+  void park(AppN &a, uint32_t mask) {
+    a.wait_mask = mask;
+    a.wait_seq = wait_park_counter++;
+  }
 
   void app_kill(int aidx, int sig, int64_t now) {
     AppN &a = apps[(size_t)aidx];
@@ -2431,9 +2512,10 @@ struct Engine {
       sock_close_any(hp, (uint32_t)a.sock, now);
       sock((uint32_t)a.sock)->app_owner = -2;
     }
-    /* One-way only (main -> sender): mesh_peer links are
+    /* One-way only (main -> sibling): mesh_peer links are
      * bidirectional and this function sets no visited flag. */
-    if (a.mesh_peer >= 0 && a.kind == APP_UDP_MESH)
+    if (a.mesh_peer >= 0 &&
+        (a.kind == APP_UDP_MESH || a.kind == APP_PHOLD))
       app_teardown(a.mesh_peer, now);
   }
 
@@ -2445,7 +2527,8 @@ struct Engine {
     AppN &a = apps[(size_t)aidx];
     for_each_handler(a, /*include_exited=*/true,
                      [&](int i, AppN &) { out.push_back(i); });
-    if (a.mesh_peer >= 0 && a.kind == APP_UDP_MESH)
+    if (a.mesh_peer >= 0 &&
+        (a.kind == APP_UDP_MESH || a.kind == APP_PHOLD))
       out.push_back(a.mesh_peer);
     return out;
   }
@@ -2502,7 +2585,7 @@ struct Engine {
       asys(hp, ASYS_SENDTO);
       int64_t w = udp_sendto(hp, s, tok, xpay.data(), a.size, 1,
                              a.dst_ip, a.dst_port, now);
-      if (w == -E_AGAIN) { a.wait_mask = S_WRITABLE; return; }
+      if (w == -E_AGAIN) { park(a, S_WRITABLE); return; }
       if (w < 0) { app_die(aidx, 101, now); return; }
       a.sent_i++;
       a.got += a.size;  // reuse as the Python app's `sent` accumulator
@@ -2510,7 +2593,7 @@ struct Engine {
         asys(hp, ASYS_NANOSLEEP);
         a.state = 1;  // resume as a nanosleep restart
         a.wake_pending = true;
-        hp->tpush({now + a.interval, hp->event_seq++, TK_APP,
+        hp->tpush({now + a.interval, hp->event_seq++, TK_APP_TIMEOUT,
                    (uint32_t)aidx});
         return;
       }
@@ -2540,7 +2623,7 @@ struct Engine {
     while (a.interval == 0 /*no expect arg*/ || a.got < a.expect) {
       asys(hp, ASYS_RECVFROM);
       int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
-      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r == -E_AGAIN) { park(a, S_READABLE); return; }
       if (r < 0) { app_die(aidx, 101, now); return; }
       a.got += (int64_t)data.size();
       a.got_n++;
@@ -2575,7 +2658,7 @@ struct Engine {
     while (a.got < expect) {
       asys(hp, ASYS_RECVFROM);
       int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
-      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r == -E_AGAIN) { park(a, S_READABLE); return; }
       if (r < 0) { app_die(aidx, 101, now); return; }
       a.got += (int64_t)data.size();
     }
@@ -2611,7 +2694,7 @@ struct Engine {
           a.peers[(size_t)(a.sent_i % (int64_t)a.peers.size())];
       int64_t w = udp_sendto(hp, s, tok, mpay.data(), a.size, 1, ip,
                              a.port, now);
-      if (w == -E_AGAIN) { a.wait_mask = S_WRITABLE; return; }
+      if (w == -E_AGAIN) { park(a, S_WRITABLE); return; }
       if (w < 0) {
         /* Python twin: a crashed sender THREAD exits alone; the
          * shared fd stays open (fds close only at full process exit)
@@ -2653,6 +2736,112 @@ struct Engine {
     m.wait_mask = 0;
   }
 
+  /* phold (apps.py phold twin): shared-LCG pseudo-exponential message
+   * relay — each message triggers sleep(exp) then send to a random
+   * peer; a seeder thread injects n_init initial messages. */
+  static uint32_t phold_rnd(AppN &owner) {
+    owner.lcg = owner.lcg * 1664525u + 1013904223u;
+    return owner.lcg;
+  }
+
+  int64_t phold_exp_delay(AppN &owner, int64_t mean) {
+    int64_t u = (int64_t)(phold_rnd(owner) % 1000)
+        + (int64_t)(phold_rnd(owner) % 1000) + 1;
+    int64_t d = (u * mean) / 1000;
+    return d < 1 ? 1 : d;
+  }
+
+  /* Common fire tail: called at SLEEP initiation (draws the delay,
+   * arms the timer, bumps the nanosleep count) — the target draw
+   * happens at SEND time, matching the Python evaluation order. */
+  void phold_arm_sleep(int aidx, AppN &a, AppN &owner, int64_t now) {
+    HostPlane *hp = plane(a.hid);
+    asys(hp, ASYS_NANOSLEEP);
+    int64_t d = phold_exp_delay(owner, a.interval /*mean_delay*/);
+    a.state = 1;  // resume as a nanosleep restart
+    a.wake_pending = true;
+    hp->tpush({now + d, hp->event_seq++, TK_APP_TIMEOUT,
+               (uint32_t)aidx});
+  }
+
+  /* Returns true when the send completed (false = parked on
+   * writable). */
+  bool phold_send(int aidx, AppN &a, AppN &owner, int64_t now) {
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    if (a.state != 3) {
+      /* fresh send: draw the target once (Python builds the sendto
+       * args once; retries reuse them) */
+      a.phold_target =
+          owner.peers[phold_rnd(owner) % (uint32_t)owner.peers.size()];
+      a.state = 3;
+    }
+    asys(hp, ASYS_SENDTO);
+    int64_t w = udp_sendto(hp, s, (uint32_t)a.sock, "phold", 5, 1,
+                           a.phold_target, a.port, now);
+    if (w == -E_AGAIN) {
+      park(a, S_WRITABLE);
+      return false;
+    }
+    if (w < 0) {
+      app_die(aidx, 101, now);
+      return false;
+    }
+    a.state = 0;
+    return true;
+  }
+
+  void app_step_phold(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    if (a.state == 1) {
+      /* nanosleep wake: the restarted dispatch counts again */
+      asys(hp, ASYS_NANOSLEEP);
+      a.state = 2;
+    }
+    if (a.state == 2 || a.state == 3) {
+      if (!phold_send(aidx, a, a, now)) return;
+    }
+    std::string data;
+    uint32_t sip;
+    int sport;
+    asys(hp, ASYS_RECVFROM);
+    int r = udp_recvfrom(s, 64, false, &data, &sip, &sport);
+    if (r == -E_AGAIN) {
+      park(a, S_READABLE);
+      return;
+    }
+    if (r < 0) {
+      app_die(aidx, 101, now);
+      return;
+    }
+    a.got_n++;
+    phold_arm_sleep(aidx, a, a, now);
+  }
+
+  void app_step_phold_seed(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    AppN &owner = apps[(size_t)a.mesh_peer];
+    if (a.state == 1) {
+      asys(hp, ASYS_NANOSLEEP);
+      a.state = 2;
+    }
+    if (a.state == 2 || a.state == 3) {
+      if (!phold_send(aidx, a, owner, now)) return;
+      a.sent_i++;
+    }
+    if (a.sent_i >= a.count) {
+      a.exited = true;  // seeder thread done (process keeps running)
+      a.exit_code = 0;
+      a.exit_time = now;
+      a.wait_mask = 0;
+      return;
+    }
+    phold_arm_sleep(aidx, a, owner, now);
+  }
+
   void app_step_handler(int aidx, int64_t now) {
     AppN &a = apps[(size_t)aidx];
     HostPlane *hp = plane(a.hid);
@@ -2663,7 +2852,7 @@ struct Engine {
       for (;;) {
         asys(hp, ASYS_RECV);
         int r = tcp_recv(hp, s, tok, 4096, false, now, &out);
-        if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+        if (r == -E_AGAIN) { park(a, S_READABLE); return; }
         if (r < 0) { app_die(aidx, 101, now); return; }
         if (out.empty()) {  // EOF before a full request: close, done
           asys(hp, ASYS_CLOSE);
@@ -2707,7 +2896,7 @@ struct Engine {
         int64_t take = std::min<int64_t>(65536, a.resp_n - a.sent);
         asys(hp, ASYS_SEND);
         int64_t w = tcp_sendto(hp, s, tok, dpayload(), take, now);
-        if (w == -E_AGAIN) { a.wait_mask = S_WRITABLE; return; }
+        if (w == -E_AGAIN) { park(a, S_WRITABLE); return; }
         if (w < 0) { app_die(aidx, 101, now); return; }
         a.sent += w;
       }
@@ -2718,7 +2907,7 @@ struct Engine {
     for (;;) {  // drain until the client closes
       asys(hp, ASYS_RECV);
       int r = tcp_recv(hp, s, tok, 4096, false, now, &out);
-      if (r == -E_AGAIN) { a.wait_mask = S_READABLE; return; }
+      if (r == -E_AGAIN) { park(a, S_READABLE); return; }
       if (r < 0) { app_die(aidx, 101, now); return; }
       if (out.empty()) break;  // client closed
     }

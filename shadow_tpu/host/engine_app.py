@@ -20,6 +20,7 @@ KIND_CLIENT = 1
 KIND_UDP_FLOOD = 3
 KIND_UDP_SINK = 4
 KIND_UDP_MESH = 5
+KIND_PHOLD = 7
 
 
 class _EngineFdView:
@@ -214,14 +215,32 @@ def engine_app_args(pcfg, host, dns):
         # carry port/count/size).
         if len(args) < 4:
             return None
-        import struct as _struct
-        ips = []
-        for peer in args[3:]:
-            ip = dns.ip_for_name(peer)
-            if ip is None:
-                return None
-            ips.append(ip)
-        peers = b"".join(_struct.pack("<I", ip) for ip in ips)
+        peers = _pack_peers(dns, args[3:])
+        if peers is None:
+            return None
         return (KIND_UDP_MESH, int(args[0]), int(args[1]), int(args[2]),
                 0, 0, peers)
+    if pcfg.path == "phold":
+        # phold <port> <my_index> <n_init> <mean_delay_ns> <peers...>
+        if len(args) < 5:
+            return None
+        peers = _pack_peers(dns, args[4:])
+        if peers is None:
+            return None
+        return (KIND_PHOLD, int(args[0]), int(args[1]), int(args[2]),
+                int(args[3]), 0, peers)
     return None
+
+
+def _pack_peers(dns, names):
+    """Resolve peer names into the u32 IP buffer app_spawn takes; None
+    when any name is unresolvable (the caller falls back to the Python
+    coroutine app, which reports the error the same way)."""
+    import struct as _struct
+    out = []
+    for peer in names:
+        ip = dns.ip_for_name(peer)
+        if ip is None:
+            return None
+        out.append(ip)
+    return b"".join(_struct.pack("<I", ip) for ip in out)

@@ -208,7 +208,7 @@ class DeviceRouteModel:
                 if not isinstance(prev, (int, float)) or ns < prev:
                     merged[kind] = ns
             data[cls._platform()] = merged
-            tmp = path + ".tmp"
+            tmp = f"{path}.{os.getpid()}.tmp"  # unique per writer
             with open(tmp, "w") as f:
                 json.dump(data, f)
             os.replace(tmp, path)
