@@ -1427,7 +1427,8 @@ struct AppN {
 
 constexpr int APP_SERVER = 0, APP_CLIENT = 1, APP_HANDLER = 2,
               APP_UDP_FLOOD = 3, APP_UDP_SINK = 4, APP_UDP_MESH = 5,
-              APP_UDP_MESH_SND = 6, APP_PHOLD = 7, APP_PHOLD_SEED = 8;
+              APP_UDP_MESH_SND = 6, APP_PHOLD = 7, APP_PHOLD_SEED = 8,
+              APP_UDP_ECHO = 9, APP_UDP_PING = 10;
 /* client transfer states */
 constexpr int CL_CONNECTING = 1, CL_RECV = 3;
 /* handler states */
@@ -2254,6 +2255,29 @@ struct Engine {
         hp->tpush({now, hp->event_seq++, TK_APP, (uint32_t)sidx});
         app_step_phold(aidx, now);
       }
+    } else if (kind == APP_UDP_ECHO) {
+      AppN &ap = apps[(size_t)aidx];
+      ap.port = (int)a;
+      asys(hp, ASYS_SOCKET);
+      uint32_t tok = new_udp(hid, sb, rb);
+      sock(tok)->app_owner = aidx;
+      ap.sock = (int64_t)tok;
+      asys(hp, ASYS_BIND);
+      if (generic_bind(hp, sock(tok), tok, 0, ap.port) < 0)
+        app_die(aidx, 101, now);
+      else
+        app_step_echo(aidx, now);
+    } else if (kind == APP_UDP_PING) {
+      AppN &ap = apps[(size_t)aidx];
+      ap.dst_ip = (uint32_t)a;
+      ap.dst_port = (int)b;
+      ap.count = (int)c;
+      asys(hp, ASYS_SOCKET);
+      uint32_t tok = new_udp(hid, sb, rb);
+      sock(tok)->app_owner = aidx;
+      ap.sock = (int64_t)tok;
+      asys(hp, ASYS_RESOLVE);
+      app_step_ping(aidx, now);
     } else {  /* APP_UDP_SINK */
       AppN &ap = apps[(size_t)aidx];
       ap.port = (int)a;
@@ -2320,6 +2344,8 @@ struct Engine {
     else if (a.kind == APP_UDP_MESH_SND) app_step_mesh_snd(aidx, now);
     else if (a.kind == APP_PHOLD) app_step_phold(aidx, now);
     else if (a.kind == APP_PHOLD_SEED) app_step_phold_seed(aidx, now);
+    else if (a.kind == APP_UDP_ECHO) app_step_echo(aidx, now);
+    else if (a.kind == APP_UDP_PING) app_step_ping(aidx, now);
     else app_step_handler(aidx, now);
   }
 
@@ -2840,6 +2866,85 @@ struct Engine {
       return;
     }
     phold_arm_sleep(aidx, a, owner, now);
+  }
+
+  /* udp-echo-server <port> twin: bounce every datagram to its
+   * sender.  (The loop is real: recv -> send -> recv until EAGAIN.) */
+  void app_step_echo(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    uint32_t tok = (uint32_t)a.sock;
+    for (;;) {
+      if (a.state == 3) {  // pending echo (payload in req, dst saved)
+        asys(hp, ASYS_SENDTO);
+        int64_t w = udp_sendto(hp, s, tok, a.req.data(),
+                               (int64_t)a.req.size(), 1, a.phold_target,
+                               a.dst_port, now);
+        if (w == -E_AGAIN) { park(a, S_WRITABLE); return; }
+        if (w < 0) { app_die(aidx, 101, now); return; }
+        a.state = 0;
+      }
+      std::string data;
+      uint32_t sip;
+      int sport;
+      asys(hp, ASYS_RECVFROM);
+      int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
+      if (r == -E_AGAIN) { park(a, S_READABLE); return; }
+      if (r < 0) { app_die(aidx, 101, now); return; }
+      a.req = data;
+      a.phold_target = sip;
+      a.dst_port = sport;
+      a.state = 3;
+    }
+  }
+
+  /* udp-pinger <dst> <port> <count> twin: RTT probe over UDP echo.
+   * sim_time yields are answered locally in the Python dispatcher and
+   * draw no syscall count — mirrored by reading `now` directly. */
+  void app_step_ping(int aidx, int64_t now) {
+    AppN &a = apps[(size_t)aidx];
+    HostPlane *hp = plane(a.hid);
+    UdpSocketN *s = udp((uint32_t)a.sock);
+    uint32_t tok = (uint32_t)a.sock;
+    for (;;) {
+      if (a.state == 0) {  // send ping i (t0 = sim_time, uncounted)
+        a.t0 = now;
+        char pay[24];
+        int n = snprintf(pay, sizeof(pay), "ping%lld",
+                         (long long)a.sent_i);
+        asys(hp, ASYS_SENDTO);
+        int64_t w = udp_sendto(hp, s, tok, pay, n, 1, a.dst_ip,
+                               a.dst_port, now);
+        if (w == -E_AGAIN) { park(a, S_WRITABLE); return; }
+        if (w < 0) { app_die(aidx, 101, now); return; }
+        a.state = 2;
+      }
+      std::string data;
+      uint32_t sip;
+      int sport;
+      asys(hp, ASYS_RECVFROM);
+      int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
+      if (r == -E_AGAIN) { park(a, S_READABLE); return; }
+      if (r < 0) { app_die(aidx, 101, now); return; }
+      char line[48];
+      snprintf(line, sizeof(line), "rtt=%lld\n",
+               (long long)(now - a.t0));
+      asys(hp, ASYS_WRITE);
+      a.out += line;
+      a.sent_i++;
+      a.state = 0;
+      if (a.sent_i >= a.count) {
+        asys(hp, ASYS_CLOSE);
+        sock_close_any(hp, tok, now);
+        sock(tok)->app_owner = -2;
+        a.exited = true;
+        a.exit_code = 0;
+        a.exit_time = now;
+        a.wait_mask = 0;
+        return;
+      }
+    }
   }
 
   void app_step_handler(int aidx, int64_t now) {

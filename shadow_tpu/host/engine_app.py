@@ -21,6 +21,8 @@ KIND_UDP_FLOOD = 3
 KIND_UDP_SINK = 4
 KIND_UDP_MESH = 5
 KIND_PHOLD = 7
+KIND_UDP_ECHO = 9
+KIND_UDP_PING = 10
 
 
 class _EngineFdView:
@@ -220,6 +222,17 @@ def engine_app_args(pcfg, host, dns):
             return None
         return (KIND_UDP_MESH, int(args[0]), int(args[1]), int(args[2]),
                 0, 0, peers)
+    if pcfg.path == "udp-echo-server":
+        if len(args) != 1:
+            return None
+        return (KIND_UDP_ECHO, int(args[0]), 0, 0, 0, 0)
+    if pcfg.path == "udp-pinger":
+        if len(args) != 3:
+            return None
+        ip = dns.ip_for_name(args[0])
+        if ip is None:
+            return None
+        return (KIND_UDP_PING, ip, int(args[1]), int(args[2]), 0, 0)
     if pcfg.path == "phold":
         # phold <port> <my_index> <n_init> <mean_delay_ns> <peers...>
         if len(args) < 5:

@@ -391,3 +391,50 @@ hosts:
                        if "kill_peer" in p.name)
         assert out_ser == out_tpu == b"kill rc=0 errno=0\n", \
             (mode, out_ser, out_tpu)
+
+
+def test_udp_echo_pinger_engine_twins(tmp_path):
+    """udp-echo-server + udp-pinger as engine twins (completing the
+    internal-app roster): RTT lines, traces, and syscall histograms
+    byte-identical to the Python coroutines."""
+
+    def run(sched):
+        yaml = f"""
+general: {{ stop_time: 20s, seed: 19, data_directory: {tmp_path / sched}-ep }}
+experimental: {{ scheduler: {sched} }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "15 ms" ] ]
+hosts:
+  echo:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-echo-server, args: ["7000"],
+           expected_final_state: running }}
+  pinger:
+    network_node_id: 0
+    processes:
+      - {{ path: udp-pinger, args: [echo, "7000", "12"], start_time: 1s }}
+"""
+        return run_simulation(ConfigOptions.from_yaml_text(yaml))
+
+    m_ser, s_ser = run("serial")
+    m_tpu, s_tpu = run("tpu")
+    assert s_ser.ok, s_ser.plugin_errors
+    assert s_tpu.ok, s_tpu.plugin_errors
+    if m_tpu.plane is not None:
+        n_engine = sum(1 for h in m_tpu.hosts
+                       for p in h.processes.values()
+                       if isinstance(p, EngineAppProcess))
+        assert n_engine == 2, "echo/pinger fell off the engine"
+    assert m_ser.trace_lines() == m_tpu.trace_lines()
+    out_ser = {(h.name, p.name): bytes(p.stdout) for h in m_ser.hosts
+               for p in h.processes.values()}
+    out_tpu = {(h.name, p.name): bytes(p.stdout) for h in m_tpu.hosts
+               for p in h.processes.values()}
+    assert out_ser == out_tpu
+    assert any(v.count(b"rtt=") == 12 for v in out_ser.values())
+    assert _hist(m_ser) == _hist(m_tpu)
