@@ -1556,9 +1556,13 @@ struct Engine {
       if (sib >= 0) {
         AppN &o = apps[(size_t)s->app_owner];
         AppN &b = apps[(size_t)sib];
-        bool ow = !o.wake_pending && !o.exited && !o.stopped &&
+        /* Ordering must ignore `stopped`: stop-parking preserves
+         * event-fire order (Python records _stopped_resumes in
+         * listener-fire = block order), so a SIGSTOPped sibling that
+         * blocked first still wakes first. */
+        bool ow = !o.wake_pending && !o.exited &&
                   (changed & o.wait_mask);
-        bool bw = !b.wake_pending && !b.exited && !b.stopped &&
+        bool bw = !b.wake_pending && !b.exited &&
                   (changed & b.wait_mask);
         if (ow && bw && b.wait_seq < o.wait_seq) {
           app_wake(sib, changed);
@@ -2325,7 +2329,7 @@ struct Engine {
        * Python condition — further status changes draw no events. */
       if (!a.stop_wake) {
         a.stop_wake = true;
-        a.stop_seq = stop_park_counter++;
+        a.stop_seq = stop_park_counter.fetch_add(1, std::memory_order_relaxed);
       }
       a.wait_mask = 0;
       return;
@@ -2485,8 +2489,13 @@ struct Engine {
     for_each_handler(srv, /*include_exited=*/false, fn);
   }
 
-  int64_t stop_park_counter = 0;  // process-stop park ordering
-  int64_t wait_park_counter = 0;  // blocked-stepper park ordering
+  /* Park-order counters run inside run_hosts_mt worker threads
+   * (every EAGAIN park and every stopped-branch step), so they must
+   * be atomic; relaxed is enough because seqs are only compared
+   * among parks of the same host, which a single worker owns within
+   * a round. */
+  std::atomic<int64_t> stop_park_counter{0};  // process-stop park ordering
+  std::atomic<int64_t> wait_park_counter{0};  // blocked-stepper park ordering
 
   /* Park a stepper on status bits, recording the BLOCK ORDER: when
    * two threads of one process wait on the same socket (phold main +
@@ -2495,7 +2504,7 @@ struct Engine {
    * the wake fan-out below replays that order. */
   void park(AppN &a, uint32_t mask) {
     a.wait_mask = mask;
-    a.wait_seq = wait_park_counter++;
+    a.wait_seq = wait_park_counter.fetch_add(1, std::memory_order_relaxed);
   }
 
   void app_kill(int aidx, int sig, int64_t now) {
@@ -2900,16 +2909,34 @@ struct Engine {
   }
 
   /* udp-pinger <dst> <port> <count> twin: RTT probe over UDP echo.
-   * sim_time yields are answered locally in the Python dispatcher and
-   * draw no syscall count — mirrored by reading `now` directly. */
+   * sim_time yields read `now` directly but still bill into the
+   * histogram — the Python dispatcher counts every yielded syscall,
+   * including sim_time (host.count_syscall in process dispatch). */
   void app_step_ping(int aidx, int64_t now) {
     AppN &a = apps[(size_t)aidx];
     HostPlane *hp = plane(a.hid);
     UdpSocketN *s = udp((uint32_t)a.sock);
     uint32_t tok = (uint32_t)a.sock;
     for (;;) {
-      if (a.state == 0) {  // send ping i (t0 = sim_time, uncounted)
+      if (a.sent_i >= a.count) {  // count<=0: exit before any send,
+                                  // like Python's `for i in range(count)`
+        asys(hp, ASYS_CLOSE);
+        sock_close_any(hp, tok, now);
+        sock(tok)->app_owner = -2;
+        a.exited = true;
+        a.exit_code = 0;
+        a.exit_time = now;
+        a.wait_mask = 0;
+        return;
+      }
+      if (a.state == 0) {  // t0 = sim_time (billed once per ping)
+        asys(hp, ASYS_SIM_TIME);
         a.t0 = now;
+        a.state = 1;
+      }
+      if (a.state == 1) {  // send ping i; a blocked sendto re-enters
+                           // HERE (Python re-dispatches only the
+                           // blocked syscall — t0 keeps its value)
         char pay[24];
         int n = snprintf(pay, sizeof(pay), "ping%lld",
                          (long long)a.sent_i);
@@ -2927,6 +2954,7 @@ struct Engine {
       int r = udp_recvfrom(s, 65536, false, &data, &sip, &sport);
       if (r == -E_AGAIN) { park(a, S_READABLE); return; }
       if (r < 0) { app_die(aidx, 101, now); return; }
+      asys(hp, ASYS_SIM_TIME);  // t1 = sim_time
       char line[48];
       snprintf(line, sizeof(line), "rtt=%lld\n",
                (long long)(now - a.t0));
@@ -2934,16 +2962,7 @@ struct Engine {
       a.out += line;
       a.sent_i++;
       a.state = 0;
-      if (a.sent_i >= a.count) {
-        asys(hp, ASYS_CLOSE);
-        sock_close_any(hp, tok, now);
-        sock(tok)->app_owner = -2;
-        a.exited = true;
-        a.exit_code = 0;
-        a.exit_time = now;
-        a.wait_mask = 0;
-        return;
-      }
+      // loop head closes + exits once sent_i reaches count
     }
   }
 

@@ -87,7 +87,7 @@ class EngineAppProcess:
         self.stop_report: int | None = None
         self.continue_report = False
         self._stopped = False
-        self._shielded: list[int] = []
+        self._shielded: list[tuple] = []
 
     # -- engine state ---------------------------------------------------
 
@@ -147,8 +147,8 @@ class EngineAppProcess:
                 self.continue_report = True
                 eng.app_continue(self.app_idx, host.now())
                 shielded, self._shielded = self._shielded, []
-                for s in shielded:
-                    self.raise_signal(host, s)
+                for s, ttid, scode, spid, sstatus in shielded:
+                    self.raise_signal(host, s, ttid, scode, spid, sstatus)
             return
         disp = sigmod.ProcessSignals().disposition(sig)
         if sig == sigmod.SIGKILL:
@@ -157,7 +157,11 @@ class EngineAppProcess:
             return
         if self._stopped:
             if disp not in ("ignore", "stop"):
-                self._shielded.append(sig)
+                # Full siginfo tuple, like Process._stopped_sigs: the
+                # replay must carry target_tid/si_* so a tgkill-targeted
+                # signal keeps its provenance through the stop.
+                self._shielded.append(
+                    (sig, target_tid, si_code, si_pid, si_status))
             return
         if disp == "stop":
             self._stopped = True

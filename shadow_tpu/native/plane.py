@@ -40,14 +40,20 @@ def load_netplane():
     target = os.path.join(LIB_DIR, f"_netplane{ext}")
     sources = [os.path.join(_SRC_DIR, f)
                for f in ("netplane.cpp", "Makefile")]
+    rebuilt = False
     if _stale(target, sources) or isa_stale(target):
         # isa_stale: the engine builds with -march=native; an artifact
-        # from a different CPU must rebuild, not SIGILL.
+        # from a different CPU must rebuild, not SIGILL.  Remove the
+        # stale artifact (and its ISA sidecar) rather than touching the
+        # source: mutating source mtimes races with concurrent builders
+        # and perturbs staleness decisions for every other consumer.
         try:
             if os.path.exists(target):
-                os.utime(os.path.join(_SRC_DIR, "netplane.cpp"))
+                os.unlink(target)
+            if os.path.exists(target + ".cpu"):
+                os.unlink(target + ".cpu")
         except OSError:
-            pass  # read-only checkout: let make decide
+            pass  # read-only lib dir: let make decide
         proc = subprocess.run(["make", "-C", _SRC_DIR, "netplane"],
                               capture_output=True, text=True)
         if proc.returncode != 0 or not os.path.exists(target):
@@ -65,10 +71,20 @@ def load_netplane():
                                f"{proc.stderr[-2000:]}")
                 return None
         else:
+            rebuilt = True
             try:
                 mark_isa(target)
             except OSError:
                 pass  # read-only lib dir: rebuilt next process, fine
+    if not rebuilt and os.path.exists(target) and isa_stale(target):
+        # Read-only lib dir can leave the wrong-ISA artifact in place
+        # (unlink failed, make saw it fresh and no-opped).  A
+        # -march=native mismatch dies by SIGILL, not a clean exception,
+        # so never import it — degrade to the object path instead.
+        # (`rebuilt` exempts a build we just made here: it is native to
+        # this CPU even when the sidecar could not be written.)
+        _load_error = "netplane artifact ISA-stale and not rebuildable"
+        return None
     if LIB_DIR not in sys.path:
         sys.path.insert(0, LIB_DIR)
     try:
