@@ -212,11 +212,14 @@ def run_best(build, scheduler: str, trials: int = 2,
              report_routes: str | None = None):
     """Best-of-N wall time: machine noise (co-tenants, allocator state)
     swings single runs by 10-20%, which would dominate the recorded
-    ratio."""
+    ratio.  The route split prints once (last trial).  The headline 10k
+    comparison does NOT use this helper — it interleaves baseline and
+    tpu trials itself so drift cannot favor a side."""
     best_summary, best_wall = None, None
-    for _ in range(trials):
-        summary, wall = run_once(build, scheduler,
-                                 report_routes=report_routes)
+    for i in range(trials):
+        summary, wall = run_once(
+            build, scheduler,
+            report_routes=report_routes if i == trials - 1 else None)
         if best_wall is None or wall < best_wall:
             best_summary, best_wall = summary, wall
     return best_summary, best_wall
@@ -267,10 +270,24 @@ def main() -> None:
     # thread_per_core at this scale runs once (minutes); the tpu run is
     # best-of-two after the 1k warmup primed the kernels.
     base_summary, base_wall = run_once(config_10k, "thread_per_core")
-    baseE_summary, baseE_wall = run_once(
-        lambda s: config_10k(s, native_dataplane="on"), "thread_per_core")
-    tpu_summary, tpu_wall = run_best(config_10k, "tpu",
-                                     report_routes="10k")
+    # The engine baseline and the tpu run get SYMMETRIC treatment:
+    # interleaved trials (E,T,E,T,E,T), best wall on each side.  A
+    # single-trial baseline vs best-of-N challenger — or back-to-back
+    # blocks on a shared box with ±10% drift — would let noise and
+    # run order decide the recorded ratio.
+    buildE = lambda s: config_10k(s, native_dataplane="on")  # noqa: E731
+    baseE_summary = baseE_wall = None
+    tpu_summary = tpu_wall = None
+    tpu_walls = []
+    for trial in range(3):
+        sE, wE = run_once(buildE, "thread_per_core")
+        if baseE_wall is None or wE < baseE_wall:
+            baseE_summary, baseE_wall = sE, wE
+        sT, wT = run_once(config_10k, "tpu",
+                          report_routes="10k" if trial == 2 else None)
+        tpu_walls.append(wT)
+        if tpu_wall is None or wT < tpu_wall:
+            tpu_summary, tpu_wall = sT, wT
     assert baseE_summary.packets_sent == base_summary.packets_sent, \
         "engine baseline disagreed on workload size"
     print(f"bench[10k-baselines]: thread_per_core python "
@@ -333,6 +350,11 @@ def main() -> None:
         "value": round(sim_per_wall, 3),
         "unit": "sim-s/wall-s",
         "vs_baseline": round(baseE_wall / tpu_wall, 3),
+        # Cold-start wall (first tpu trial: cold caches, any in-window
+        # compile/probe cost) recorded alongside the warm best-of-N —
+        # cold start is real user experience, not just narration.
+        "cold_wall_s": round(tpu_walls[0], 3),
+        "warm_wall_s": round(tpu_wall, 3),
     }))
 
 

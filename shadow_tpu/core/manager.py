@@ -615,6 +615,9 @@ class Manager:
                         f"{proc.expected_final_state!r}, got {state!r}")
         if self._pool is not None:
             self._pool.shutdown()
+        closer = getattr(self.propagator, "close", None)
+        if closer is not None:
+            closer()  # stop async route probes; never blocks
         # Teardown happens at one canonical instant — the simulation
         # end — on every host and plane: the closes below emit packets
         # (FINs of mid-stream connections), and per-host "last event"

@@ -59,7 +59,7 @@ class _AppThreadView:
     @property
     def state(self):
         from shadow_tpu.host.process import ST_EXITED, ST_RUNNABLE
-        exited, _c, _t, _o = self._proc.host.plane.engine.app_poll(
+        exited, _c, _t = self._proc.host.plane.engine.app_status(
             self._app_idx)
         return ST_EXITED if exited else ST_RUNNABLE
 
@@ -108,11 +108,14 @@ class EngineAppProcess:
 
     @property
     def exited(self) -> bool:
-        return bool(self._poll()[0])
+        # app_status: no stdout copy (exited checks run per signal and
+        # per process at final accounting — app_poll's bytes copy for
+        # each was ~10% of a 10k run).
+        return bool(self.host.plane.engine.app_status(self.app_idx)[0])
 
     @property
     def exit_code(self):
-        exited, code, _t, _x = self._poll()
+        exited, code, _t = self.host.plane.engine.app_status(self.app_idx)
         return code if exited else None
 
     @property
@@ -176,8 +179,9 @@ class EngineAppProcess:
 
     def matches_expected_final_state(self) -> bool:
         from shadow_tpu.host.process import matches_final_state
-        return matches_final_state(self.expected_final_state,
-                                   self.exited, self.exit_code,
+        exited, code, _t = self.host.plane.engine.app_status(self.app_idx)
+        return matches_final_state(self.expected_final_state, exited,
+                                   code if exited else None,
                                    self.term_signal)
 
     def strace_close(self) -> None:

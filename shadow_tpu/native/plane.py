@@ -42,6 +42,10 @@ def load_netplane():
                for f in ("netplane.cpp", "Makefile")]
     rebuilt = False
     if _stale(target, sources) or isa_stale(target):
+        try:
+            pre_mtime = os.path.getmtime(target)
+        except OSError:
+            pre_mtime = None
         # isa_stale: the engine builds with -march=native; an artifact
         # from a different CPU must rebuild, not SIGILL.  Remove the
         # stale artifact (and its ISA sidecar) rather than touching the
@@ -71,11 +75,20 @@ def load_netplane():
                                f"{proc.stderr[-2000:]}")
                 return None
         else:
-            rebuilt = True
+            # "Rebuilt" must mean make actually relinked: on a
+            # read-only lib dir the unlink above fails silently, make
+            # sees a fresh target and no-ops with exit 0 — trusting
+            # that would import a wrong-ISA artifact.  A real rebuild
+            # changes the target's mtime (or creates it).
             try:
-                mark_isa(target)
+                rebuilt = os.path.getmtime(target) != pre_mtime
             except OSError:
-                pass  # read-only lib dir: rebuilt next process, fine
+                rebuilt = False
+            if rebuilt:
+                try:
+                    mark_isa(target)
+                except OSError:
+                    pass  # read-only lib dir: rebuilt next process, fine
     if not rebuilt and os.path.exists(target) and isa_stale(target):
         # Read-only lib dir can leave the wrong-ISA artifact in place
         # (unlink failed, make saw it fresh and no-opped).  A
