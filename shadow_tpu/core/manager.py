@@ -66,9 +66,19 @@ class Runahead:
     def get(self) -> int:
         return self._value
 
+    @property
+    def dynamic(self) -> bool:
+        return self._dynamic
+
     def update_lowest_used_latency(self, latency_ns: int) -> None:
         if self._dynamic and 0 < latency_ns < self._value:
             self._value = latency_ns
+
+    def sync_from_span(self, value_ns: int) -> None:
+        """Adopt the (only ever lowered) width the engine's span loop
+        computed with the same update rule."""
+        if 0 < value_ns < self._value:
+            self._value = int(value_ns)
 
 
 class Manager:
@@ -567,7 +577,69 @@ class Manager:
             # slot writes, inbox deliveries, engine pushes — maintains
             # the snapshot incrementally).
             self.propagator.set_nt(self._nt)
+        # Multi-round spans (netplane.cpp run_span; SURVEY §7 hard part
+        # (3)): behind scheduler=tpu, engine-pure stretches of the sim
+        # iterate whole conservative windows inside one C call — the
+        # host twin of the device-resident multi-round loop.  The
+        # thread_per_core baseline keeps the reference's per-round
+        # architecture (manager.rs:415-501).
+        route = getattr(self.propagator, "route", None)
+        span_ok = (self.config.experimental.scheduler == "tpu"
+                   and self.plane is not None and not device_barrier
+                   and not self._perf_timers
+                   # Forced-device mode (min_device_batch<=0) is the
+                   # parity/audit path: every round must go through the
+                   # jitted kernel, so spans (whose propagation runs
+                   # the C++ twin) stay out of the way.
+                   and route is not None and route.min_device_batch > 0)
+        from shadow_tpu.core.simtime import TIME_NEVER
         while start is not None and start < stop:
+            if span_ok and not self._py_work.any() \
+                    and not getattr(self.propagator, "_outbox", None) \
+                    and self.propagator.span_gate():
+                limit = stop
+                if heartbeat_lines:
+                    limit = min(limit, next_heartbeat)
+                # With engine-side pcap, cap the span so capture
+                # buffers hold at most ~64 rounds of packets before
+                # the drain below (per-round streams; spans must not
+                # buffer a whole sim).
+                max_rounds = 64 if self._pcap_engine else 1024
+                res = self.plane.engine.run_span(
+                    start, stop, limit, self.runahead.get(),
+                    int(self.runahead.dynamic), max_rounds,
+                    self._mt_threads)
+                if res is None:
+                    span_ok = False  # callback-capable host: per-round
+                else:
+                    rounds, busy_rounds, pkts, next_start, busy_end, \
+                        ra = res
+                    if rounds:
+                        summary.rounds += rounds
+                        summary.busy_end_ns = busy_end
+                        self.runahead.sync_from_span(ra)
+                        prop = self.propagator
+                        # Audit split counts dispatches the way the
+                        # per-round path does: only rounds that
+                        # propagated packets.
+                        prop.rounds_dispatched += busy_rounds
+                        prop.packets_batched += pkts
+                        if self._pcap_engine:
+                            self._drain_engine_pcap()
+                        if heartbeat_lines and busy_end >= next_heartbeat:
+                            self._log_heartbeat(busy_end, stop, wall_start,
+                                                sys.stderr)
+                            next_heartbeat = busy_end + heartbeat
+                        if status is not None:
+                            wall = time.perf_counter()
+                            if wall >= next_status_wall:
+                                status.update(busy_end)
+                                next_status_wall = wall + status_throttle
+                        start = (None if next_start >= TIME_NEVER
+                                 else next_start)
+                        continue
+                    # rounds == 0 (e.g. heartbeat boundary due now):
+                    # fall through to one per-round iteration.
             window_end = min(start + self.runahead.get(), stop)
             self.propagator.begin_round(start, window_end)
             self._run_hosts(window_end)

@@ -284,20 +284,28 @@ class DeviceRouteModel:
                 # full interval from now (the budget grows with wall).
                 self._probe_countdown[b] = interval
                 return ROUTE_HOST
-            nxt = (self.REPROBE_CAP
-                   if dev > 16 * self.host_ns_per_pkt * n
-                   else min(interval * 2, self.REPROBE_CAP))
-            self._probe_interval[b] = nxt
-            self._probe_countdown[b] = nxt
+            # Ask again next round unless a probe actually starts —
+            # the backoff advances in probe_started(), so a declined
+            # probe (one already in flight) cannot rail the interval
+            # to the cap with zero measurements taken.
+            self._probe_countdown[b] = 1
             return ROUTE_PROBE
         self._probe_countdown[b] = left
         return ROUTE_HOST
 
-    def probe_declined(self, b: int) -> None:
-        """The caller could not run the probe decide() asked for (one
-        already in flight): re-arm the countdown so the next eligible
-        round asks again instead of waiting out the doubled interval."""
-        self._probe_countdown[b] = 1
+    def probe_started(self, b: int, n: int) -> None:
+        """A probe for bucket b was actually submitted: advance the
+        re-probe backoff (decide() leaves it untouched so declined
+        probes retry immediately instead of doubling toward the cap)."""
+        dev = self._dev_ns_by_bucket.get(b)
+        host = self.host_ns_per_pkt
+        interval = self._probe_interval.get(b, self.REPROBE_EVERY)
+        nxt = (self.REPROBE_CAP
+               if dev is not None and host is not None
+               and dev > 16 * host * n
+               else min(interval * 2, self.REPROBE_CAP))
+        self._probe_interval[b] = nxt
+        self._probe_countdown[b] = nxt
 
     def _probe_allowed(self, expected_ns: float | None) -> bool:
         """Cap measurement overhead at PROBE_BUDGET_FRAC of elapsed
@@ -328,6 +336,10 @@ class DeviceRouteModel:
         if b not in self._compiled:
             self._compiled.add(b)
         if fresh_compile:
+            # A compile is pure measurement cost — debit the probe
+            # budget (it is the most expensive probe there is) but
+            # record no estimate.
+            self.probe_spent_ns += dt_ns
             return
         if self.dev_floor_ns is None or dt_ns < self.dev_floor_ns:
             self.dev_floor_ns = dt_ns
@@ -345,6 +357,12 @@ class DeviceRouteModel:
             self._dev_ns_by_bucket[b] = dt_ns
         else:
             self._dev_ns_by_bucket[b] = 0.7 * prev + 0.3 * dt_ns
+        # A dispatch that loses to the host path was by definition a
+        # measurement, whoever made it (async worker or a sync caller
+        # like the sharded backend) — debit the probe budget so the
+        # 1%-of-wall cap closes for every probing path.
+        if host is not None and self._dev_ns_by_bucket[b] > host * n:
+            self.probe_spent_ns += dt_ns
 
     def record_host(self, dt_ns: float, n: int) -> None:
         per_pkt = dt_ns / max(n, 1)
@@ -449,12 +467,15 @@ class TpuPropagator:
         # on the accelerator vs the bit-identical host path.
         self.rounds_device = 0
         self.packets_device = 0
-        # Async probe worker (one in flight): measurement dispatches run
-        # here on copied columns while the host path serves the round.
-        self._probe_pool = None
+        # Async probe worker (one in flight, daemon thread): measurement
+        # dispatches run on copied columns while the host path serves
+        # the round.
         self._probe_pending = False
         self._probe_closed = False
         self.probes_async = 0
+        # Last engine-round size/decision: the Manager's span gate asks
+        # whether a measured-winning device should preempt C++ spans.
+        self._last_engine_n = 0
 
     def begin_round(self, window_start: int, window_end: int) -> None:
         self.window_end = window_end
@@ -503,6 +524,7 @@ class TpuPropagator:
 
         eng = self.engine
         b = _bucket(n)
+        self._last_engine_n = n
         t0 = _time.perf_counter_ns()
         route = self.route.decide(n, b)
         if route == ROUTE_DEVICE and self._probe_pending:
@@ -546,16 +568,12 @@ class TpuPropagator:
         the timing feeds the route model.  One probe in flight: a probe
         through a slow tunnel must not queue up behind itself."""
         if self._probe_pending or self._probe_closed:
-            # One probe in flight; re-arm the backoff so the next
-            # eligible round asks again instead of waiting out the
-            # doubled interval this decline just consumed.
-            self.route.probe_declined(b)
+            # One probe in flight: decline.  decide() left the backoff
+            # un-advanced (countdown 1), so the next eligible round
+            # simply asks again.
             return
         self._probe_pending = True
-        if self._probe_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self._probe_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="route-probe")
+        self.route.probe_started(b, n)
         window_end = self.window_end
         bootstrap_end = self.bootstrap_end
         kernel = self.kernel
@@ -580,23 +598,40 @@ class TpuPropagator:
                 out = kernel(*padded, valid, jnp.int64(window_end),
                              jnp.int64(bootstrap_end))
                 jax.block_until_ready(out)
-                dt = _time.perf_counter_ns() - t0
-                route.probe_spent_ns += dt  # budget: compiles included
-                route.record_device(b, dt, n)
+                # record_device debits the probe budget (compiles and
+                # losing dispatches both count as measurement spend).
+                route.record_device(b, _time.perf_counter_ns() - t0, n)
                 self.probes_async += 1
             except Exception:
                 pass  # a failed probe just leaves the bucket unmeasured
             finally:
                 self._probe_pending = False
 
-        self._probe_pool.submit(job)
+        import threading
+        # A daemon thread, not an executor: concurrent.futures joins
+        # its non-daemon workers at interpreter exit, so a hung tunnel
+        # dispatch would hang process shutdown.
+        threading.Thread(target=job, name="route-probe",
+                         daemon=True).start()
+
+    def span_gate(self) -> bool:
+        """May the Manager serve the next rounds with the C++ span loop?
+        False when the route model has MEASURED the device winning at
+        the typical engine-round size — a measured-winning accelerator
+        must keep getting per-round dispatches, not be silently
+        preempted by the host twin.  (Probes stay reachable because
+        spawn-phase and post-span rounds still run per-round.)"""
+        n = self._last_engine_n
+        route = self.route
+        if not n or route.host_ns_per_pkt is None:
+            return True
+        dev = route._dev_ns_by_bucket.get(_bucket(n))
+        return dev is None or dev > route.host_ns_per_pkt * n
 
     def close(self) -> None:
-        """Stop accepting probes; don't block on one in flight."""
+        """Stop accepting probes; an in-flight one runs out on its
+        daemon thread and cannot block interpreter exit."""
         self._probe_closed = True
-        if self._probe_pool is not None:
-            self._probe_pool.shutdown(wait=False)
-            self._probe_pool = None
 
     def _engine_device_round(self, n: int, b: int):
         """Device path over engine-exported columns: same jitted kernel,
