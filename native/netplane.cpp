@@ -3168,6 +3168,133 @@ struct Engine {
     return r;
   }
 
+  /* ====== PHOLD device-span state export / import ================
+   * The device-resident multi-round loop (ops/phold_span.py) steps
+   * PHOLD-pure simulations — every host: one APP_PHOLD + one
+   * APP_PHOLD_SEED over a single bound UDP socket — as struct-of-
+   * arrays on the accelerator (SURVEY.md:19-23).  The engine stays
+   * the source of truth: export is read-only, import overwrites, and
+   * an aborted device span simply never imports (transactional).
+   * Field-for-field the device model mirrors run_until + the UDP
+   * data-plane chain above; the byte-identity gates in
+   * tests/test_phold_span.py enforce the twin contract. */
+
+  struct PholdShape {
+    std::vector<int32_t> main_idx, seed_idx;  // per host app indices
+    size_t n_peers_max = 0;
+  };
+
+  /* Returns false unless EVERY host is phold-shaped and quiescent
+   * enough for the SoA model (no stops, no lo/pcap traffic, no
+   * foreign sockets holding packets). */
+  bool phold_shape(PholdShape *sh) {
+    size_t H = hosts.size();
+    sh->main_idx.assign(H, -1);
+    sh->seed_idx.assign(H, -1);
+    for (size_t i = 0; i < apps.size(); i++) {
+      AppN &a = apps[i];
+      if (a.kind == APP_PHOLD) {
+        if (a.hid < 0 || (size_t)a.hid >= H) return false;
+        if (sh->main_idx[a.hid] >= 0) return false;  // one LP per host
+        sh->main_idx[a.hid] = (int32_t)i;
+      } else if (a.kind == APP_PHOLD_SEED) {
+        if (a.hid < 0 || (size_t)a.hid >= H) return false;
+        if (sh->seed_idx[a.hid] >= 0) return false;
+        sh->seed_idx[a.hid] = (int32_t)i;
+      } else {
+        return false;  // any non-phold app: not a phold sim
+      }
+    }
+    for (size_t h = 0; h < H; h++) {
+      HostPlane *hp = hosts[h].get();
+      if (sh->main_idx[h] < 0 || sh->seed_idx[h] < 0) return false;
+      AppN &m = apps[(size_t)sh->main_idx[h]];
+      AppN &s = apps[(size_t)sh->seed_idx[h]];
+      if (m.stopped || s.stopped || m.exited) return false;
+      if (m.sock < 0 || s.mesh_peer != sh->main_idx[h]) return false;
+      if (m.port == 53) return false;  // dns_wire answers: modelled out
+      UdpSocketN *u = udp((uint32_t)m.sock);
+      if (u == nullptr || u->has_peer || !u->has_local) return false;
+      if (!u->send_q[0].empty()) return false;  // no loopback traffic
+      if (hp->pcap_on[0] || hp->pcap_on[1]) return false;
+      if (hp->relays[0].state == RELAY_PENDING ||
+          hp->relays[0].pending != UINT64_MAX)
+        return false;
+      if (m.peers.size() > sh->n_peers_max)
+        sh->n_peers_max = m.peers.size();
+      /* theap entries must all be modellable kinds owned by this
+       * host's two apps / relays 1,2 */
+      for (const TimerEnt &t : hp->theap) {
+        if (t.kind == TK_RELAY) {
+          if (t.target == 0) return false;
+        } else if (t.kind == TK_APP || t.kind == TK_APP_TIMEOUT) {
+          if ((int32_t)t.target != sh->main_idx[h] &&
+              (int32_t)t.target != sh->seed_idx[h])
+            return false;
+        } else {
+          return false;  // TCP timers: not a phold sim
+        }
+      }
+    }
+    /* foreign (closed) sockets may exist but must hold no packets */
+    for (size_t t = 0; t < socks.size(); t++) {
+      SocketN *s = socks[t].get();
+      if (s == nullptr || s->proto != PROTO_UDP) continue;
+      UdpSocketN *u = static_cast<UdpSocketN *>(s);
+      bool is_main = s->host >= 0 && (size_t)s->host < hosts.size() &&
+                     sh->main_idx[s->host] >= 0 &&
+                     apps[(size_t)sh->main_idx[s->host]].sock == (int64_t)t;
+      if (!is_main && (!u->send_q[0].empty() || !u->send_q[1].empty() ||
+                       !u->recv_q.empty() || u->queued[0] || u->queued[1]))
+        return false;
+    }
+    return true;
+  }
+
+  /* Packet identity fields the device carries (payload is always
+   * "phold", 5 bytes — only sizes and headers matter). */
+  struct PkCols {
+    std::vector<int32_t> src_host;
+    std::vector<int64_t> pseq;
+    std::vector<uint32_t> sip, dip;
+    std::vector<int32_t> sport, dport;
+    std::vector<int64_t> size;
+    void push(const PacketN *p) {
+      src_host.push_back(p->src_host);
+      pseq.push_back((int64_t)p->seq);
+      sip.push_back(p->src_ip);
+      dip.push_back(p->dst_ip);
+      sport.push_back(p->src_port);
+      dport.push_back(p->dst_port);
+      size.push_back(p->total_size());
+    }
+    void push_empty() {
+      src_host.push_back(0);
+      pseq.push_back(0);
+      sip.push_back(0);
+      dip.push_back(0);
+      sport.push_back(0);
+      dport.push_back(0);
+      size.push_back(0);
+    }
+  };
+
+  uint64_t pk_alloc(int32_t src_host_, int64_t pseq_, uint32_t sip_,
+                    int32_t sport_, uint32_t dip_, int32_t dport_) {
+    uint64_t id = store.alloc();
+    PacketN *p = store.get(id);
+    p->src_host = src_host_;
+    p->seq = (uint64_t)pseq_;
+    p->proto = PROTO_UDP;
+    p->src_ip = sip_;
+    p->src_port = sport_;
+    p->dst_ip = dip_;
+    p->dst_port = dport_;
+    p->payload.assign("phold", 5);
+    p->priority = pseq_;
+    return id;
+  }
+
   /* ============== TCP socket glue (host/socket_tcp.py) =========== */
 
   IfaceN &iface_of(HostPlane *hp, int idx) { return idx == 0 ? hp->lo : hp->eth; }
@@ -3946,6 +4073,632 @@ static PyObject *eng_run_hosts(EngineObj *self, PyObject *args) {
   PyBuffer_Release(&ids);
   CHECK_CB(self);
   return PyLong_FromLongLong((long long)stop);
+}
+
+/* ---- PHOLD device-span export/import wrappers ------------------- */
+
+static PyObject *bytes_of(const void *p, size_t n) {
+  return PyBytes_FromStringAndSize((const char *)p, (Py_ssize_t)n);
+}
+template <typename T>
+static PyObject *bytes_vec(const std::vector<T> &v) {
+  return bytes_of(v.data(), v.size() * sizeof(T));
+}
+static int dict_set(PyObject *d, const char *k, PyObject *v) {
+  if (v == nullptr) return -1;
+  int r = PyDict_SetItemString(d, k, v);
+  Py_DECREF(v);
+  return r;
+}
+
+static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
+  /* (I, T, R, S, C, P) capacity caps -> dict of column bytes, or None
+   * when the sim is not phold-shaped or state exceeds the caps (the
+   * caller falls back to the C++ span loop).  Read-only. */
+  long long I, T, R, S, C, P;
+  if (!PyArg_ParseTuple(args, "LLLLLL", &I, &T, &R, &S, &C, &P))
+    return nullptr;
+  Engine *e = self->eng;
+  Engine::PholdShape sh;
+  /* None = structurally not a phold sim (permanent for this run);
+   * int 1 = transiently beyond the caps (retry later / fall back). */
+  if (!e->phold_shape(&sh)) Py_RETURN_NONE;
+  if ((long long)sh.n_peers_max > P) Py_RETURN_NONE;
+  /* Pad peers to the tightest power of two, not the ceiling: the
+   * column crosses the device link every span. */
+  {
+    long long pp = 8;
+    while (pp < (long long)sh.n_peers_max) pp <<= 1;
+    P = pp;
+  }
+  size_t H = e->hosts.size();
+
+  std::vector<int64_t> now(H), event_seq(H), packet_seq(H);
+  std::vector<uint32_t> eth_ip(H), status(H), local_ip(H);
+  std::vector<uint8_t> queued(H);
+  std::vector<int64_t> recv_bytes(H), recv_max(H), send_bytes(H),
+      send_max(H);
+  std::vector<int32_t> rq_len(H), sq_len(H), cq_len(H), ib_len(H),
+      th_len(H), n_peers(H);
+  Engine::PkCols rq, sq, cq, ib, r1pk, r2pk;
+  std::vector<int64_t> cq_enq(H * C, 0);
+  std::vector<int64_t> ib_time(H * I, 0), ib_seq(H * I, 0);
+  std::vector<int32_t> ib_src(H * I, 0);
+  std::vector<int64_t> th_time(H * T, 0), th_seq(H * T, 0);
+  std::vector<uint8_t> th_kind(H * T, 0), th_tgt(H * T, 0);
+  std::vector<int64_t> codel_bytes(H), codel_count(H),
+      codel_last_count(H), codel_first_above(H), codel_drop_next(H),
+      codel_dropped(H);
+  std::vector<uint8_t> codel_dropping(H);
+  std::vector<uint8_t> r_pending[3], r_unlimited[3], r_pk_valid[3];
+  std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3];
+  for (int r = 1; r <= 2; r++) {
+    r_pending[r].assign(H, 0);
+    r_unlimited[r].assign(H, 0);
+    r_pk_valid[r].assign(H, 0);
+    r_bal[r].assign(H, 0);
+    r_next[r].assign(H, 0);
+    r_refill[r].assign(H, 0);
+    r_cap[r].assign(H, 0);
+  }
+  std::vector<uint8_t> m_state(H), m_wakep(H), s_state(H), s_wakep(H),
+      s_exited(H);
+  std::vector<uint32_t> m_waitmask(H), s_waitmask(H), m_lcg(H),
+      m_target(H), s_target(H);
+  std::vector<int64_t> m_waitseq(H), s_waitseq(H), m_gotn(H), m_mean(H),
+      s_senti(H), s_count(H), s_exit_time(H);
+  std::vector<int32_t> m_port(H);
+  std::vector<uint32_t> peers(H * P, 0);
+  std::vector<int64_t> app_sys(H * ASYS_N), pkts_sent(H), pkts_recv(H),
+      pkts_dropped(H), events_run(H);
+  std::vector<int64_t> eth_psent(H), eth_precv(H), eth_bsent(H),
+      eth_brecv(H);
+
+  /* rings are exported packed at offset h*cap (head at 0) */
+  auto pk_pad = [](Engine::PkCols &c, size_t upto) {
+    while (c.src_host.size() < upto) c.push_empty();
+  };
+  for (size_t h = 0; h < H; h++) {
+    HostPlane *hp = e->hosts[h].get();
+    AppN &m = e->apps[(size_t)sh.main_idx[h]];
+    AppN &s = e->apps[(size_t)sh.seed_idx[h]];
+    UdpSocketN *u = e->udp((uint32_t)m.sock);
+    if ((long long)u->recv_q.size() > R / 2 ||
+        (long long)u->send_q[1].size() > S / 2 ||
+        (long long)hp->codel.q.size() > C / 2 ||
+        (long long)hp->inbox.size() > I / 2 ||
+        (long long)hp->theap.size() > T - 8)
+      return PyLong_FromLong(1);  // transiently over caps, not un-phold
+    now[h] = hp->now;
+    event_seq[h] = (int64_t)hp->event_seq;
+    packet_seq[h] = (int64_t)hp->packet_seq;
+    eth_ip[h] = hp->eth_ip;
+    status[h] = u->status;
+    local_ip[h] = u->local_ip;
+    queued[h] = u->queued[1] ? 1 : 0;
+    recv_bytes[h] = u->recv_bytes;
+    recv_max[h] = u->recv_max;
+    send_bytes[h] = u->send_bytes;
+    send_max[h] = u->send_max;
+    rq_len[h] = (int32_t)u->recv_q.size();
+    for (uint64_t id : u->recv_q) rq.push(e->store.get(id));
+    pk_pad(rq, (h + 1) * (size_t)R);
+    sq_len[h] = (int32_t)u->send_q[1].size();
+    for (uint64_t id : u->send_q[1]) sq.push(e->store.get(id));
+    pk_pad(sq, (h + 1) * (size_t)S);
+    cq_len[h] = (int32_t)hp->codel.q.size();
+    {
+      size_t j = 0;
+      for (auto &[id, enq] : hp->codel.q) {
+        cq.push(e->store.get(id));
+        cq_enq[h * (size_t)C + j++] = enq;
+      }
+      pk_pad(cq, (h + 1) * (size_t)C);
+    }
+    codel_bytes[h] = hp->codel.bytes;
+    codel_dropping[h] = hp->codel.dropping ? 1 : 0;
+    codel_count[h] = hp->codel.count;
+    codel_last_count[h] = hp->codel.last_count;
+    codel_first_above[h] = hp->codel.first_above;
+    codel_drop_next[h] = hp->codel.drop_next;
+    codel_dropped[h] = hp->codel.dropped_count;
+    for (int r = 1; r <= 2; r++) {
+      RelayN &rl = hp->relays[r];
+      r_pending[r][h] = rl.state == RELAY_PENDING ? 1 : 0;
+      r_unlimited[r][h] = rl.bucket.unlimited ? 1 : 0;
+      r_bal[r][h] = rl.bucket.balance;
+      r_next[r][h] = rl.bucket.next_refill;
+      r_refill[r][h] = rl.bucket.refill_size;
+      r_cap[r][h] = rl.bucket.capacity;
+      Engine::PkCols &pc = r == 1 ? r1pk : r2pk;
+      if (rl.pending != UINT64_MAX) {
+        r_pk_valid[r][h] = 1;
+        pc.push(e->store.get(rl.pending));
+      } else {
+        pc.push_empty();
+      }
+    }
+    /* inbox/theap: copy, sorted ascending by their heap orders */
+    {
+      std::vector<InboxEnt> iv(hp->inbox);
+      std::sort(iv.begin(), iv.end(), [](const InboxEnt &a,
+                                         const InboxEnt &b) {
+        if (a.time != b.time) return a.time < b.time;
+        if (a.src_host != b.src_host) return a.src_host < b.src_host;
+        return a.seq < b.seq;
+      });
+      ib_len[h] = (int32_t)iv.size();
+      for (size_t j = 0; j < iv.size(); j++) {
+        ib_time[h * (size_t)I + j] = iv[j].time;
+        ib_src[h * (size_t)I + j] = iv[j].src_host;
+        ib_seq[h * (size_t)I + j] = (int64_t)iv[j].seq;
+        ib.push(e->store.get(iv[j].pkt));
+      }
+      pk_pad(ib, (h + 1) * (size_t)I);
+      th_len[h] = (int32_t)hp->theap.size();
+      std::vector<TimerEnt> tv(hp->theap);
+      std::sort(tv.begin(), tv.end(), [](const TimerEnt &a,
+                                         const TimerEnt &b) {
+        return a.time != b.time ? a.time < b.time : a.seq < b.seq;
+      });
+      for (size_t j = 0; j < tv.size(); j++) {
+        th_time[h * (size_t)T + j] = tv[j].time;
+        th_seq[h * (size_t)T + j] = (int64_t)tv[j].seq;
+        th_kind[h * (size_t)T + j] = (uint8_t)tv[j].kind;
+        th_tgt[h * (size_t)T + j] =
+            tv[j].kind == TK_RELAY
+                ? (uint8_t)tv[j].target
+                : ((int32_t)tv[j].target == sh.seed_idx[h] ? 1 : 0);
+      }
+    }
+    m_state[h] = (uint8_t)m.state;
+    m_wakep[h] = m.wake_pending ? 1 : 0;
+    m_waitmask[h] = m.wait_mask;
+    m_waitseq[h] = m.wait_seq;
+    m_gotn[h] = m.got_n;
+    m_lcg[h] = m.lcg;
+    m_target[h] = m.phold_target;
+    m_port[h] = m.port;
+    m_mean[h] = m.interval;
+    s_state[h] = (uint8_t)s.state;
+    s_wakep[h] = s.wake_pending ? 1 : 0;
+    s_waitmask[h] = s.wait_mask;
+    s_waitseq[h] = s.wait_seq;
+    s_senti[h] = s.sent_i;
+    s_count[h] = s.count;
+    s_exited[h] = s.exited ? 1 : 0;
+    s_exit_time[h] = s.exit_time;
+    s_target[h] = s.phold_target;
+    n_peers[h] = (int32_t)m.peers.size();
+    for (size_t j = 0; j < m.peers.size(); j++)
+      peers[h * (size_t)P + j] = m.peers[j];
+    for (int j = 0; j < ASYS_N; j++)
+      app_sys[h * ASYS_N + j] = hp->app_sys[j];
+    pkts_sent[h] = hp->pkts_sent;
+    pkts_recv[h] = hp->pkts_recv;
+    pkts_dropped[h] = hp->pkts_dropped;
+    events_run[h] = hp->events_run;
+    eth_psent[h] = hp->eth.packets_sent;
+    eth_precv[h] = hp->eth.packets_received;
+    eth_bsent[h] = hp->eth.bytes_sent;
+    eth_brecv[h] = hp->eth.bytes_received;
+  }
+
+  PyObject *d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  bool ok = true;
+  auto put = [&](const char *k, PyObject *v) {
+    if (dict_set(d, k, v) < 0) ok = false;
+  };
+  put("now", bytes_vec(now));
+  put("event_seq", bytes_vec(event_seq));
+  put("packet_seq", bytes_vec(packet_seq));
+  put("eth_ip", bytes_vec(eth_ip));
+  put("status", bytes_vec(status));
+  put("local_ip", bytes_vec(local_ip));
+  put("queued", bytes_vec(queued));
+  put("recv_bytes", bytes_vec(recv_bytes));
+  put("recv_max", bytes_vec(recv_max));
+  put("send_bytes", bytes_vec(send_bytes));
+  put("send_max", bytes_vec(send_max));
+  auto put_pk = [&](const char *prefix, Engine::PkCols &c) {
+    std::string p(prefix);
+    put((p + "_srchost").c_str(), bytes_vec(c.src_host));
+    put((p + "_pseq").c_str(), bytes_vec(c.pseq));
+    put((p + "_sip").c_str(), bytes_vec(c.sip));
+    put((p + "_sport").c_str(), bytes_vec(c.sport));
+    put((p + "_dip").c_str(), bytes_vec(c.dip));
+    put((p + "_dport").c_str(), bytes_vec(c.dport));
+    put((p + "_size").c_str(), bytes_vec(c.size));
+  };
+  put("rq_len", bytes_vec(rq_len));
+  put_pk("rq", rq);
+  put("sq_len", bytes_vec(sq_len));
+  put_pk("sq", sq);
+  put("cq_len", bytes_vec(cq_len));
+  put_pk("cq", cq);
+  put("cq_enq", bytes_vec(cq_enq));
+  put("codel_bytes", bytes_vec(codel_bytes));
+  put("codel_dropping", bytes_vec(codel_dropping));
+  put("codel_count", bytes_vec(codel_count));
+  put("codel_last_count", bytes_vec(codel_last_count));
+  put("codel_first_above", bytes_vec(codel_first_above));
+  put("codel_drop_next", bytes_vec(codel_drop_next));
+  put("codel_dropped", bytes_vec(codel_dropped));
+  for (int r = 1; r <= 2; r++) {
+    std::string p = r == 1 ? "r1" : "r2";
+    put((p + "_pending").c_str(), bytes_vec(r_pending[r]));
+    put((p + "_unlimited").c_str(), bytes_vec(r_unlimited[r]));
+    put((p + "_bal").c_str(), bytes_vec(r_bal[r]));
+    put((p + "_next").c_str(), bytes_vec(r_next[r]));
+    put((p + "_refill").c_str(), bytes_vec(r_refill[r]));
+    put((p + "_cap").c_str(), bytes_vec(r_cap[r]));
+    put((p + "_pk_valid").c_str(), bytes_vec(r_pk_valid[r]));
+    put_pk((p + "_pk").c_str(), r == 1 ? r1pk : r2pk);
+  }
+  put("ib_len", bytes_vec(ib_len));
+  put("ib_time", bytes_vec(ib_time));
+  put("ib_src", bytes_vec(ib_src));
+  put("ib_seq", bytes_vec(ib_seq));
+  put_pk("ib", ib);
+  put("th_len", bytes_vec(th_len));
+  put("th_time", bytes_vec(th_time));
+  put("th_seq", bytes_vec(th_seq));
+  put("th_kind", bytes_vec(th_kind));
+  put("th_tgt", bytes_vec(th_tgt));
+  put("m_state", bytes_vec(m_state));
+  put("m_wakep", bytes_vec(m_wakep));
+  put("m_waitmask", bytes_vec(m_waitmask));
+  put("m_waitseq", bytes_vec(m_waitseq));
+  put("m_gotn", bytes_vec(m_gotn));
+  put("m_lcg", bytes_vec(m_lcg));
+  put("m_target", bytes_vec(m_target));
+  put("m_port", bytes_vec(m_port));
+  put("m_mean", bytes_vec(m_mean));
+  put("s_state", bytes_vec(s_state));
+  put("s_wakep", bytes_vec(s_wakep));
+  put("s_waitmask", bytes_vec(s_waitmask));
+  put("s_waitseq", bytes_vec(s_waitseq));
+  put("s_senti", bytes_vec(s_senti));
+  put("s_count", bytes_vec(s_count));
+  put("s_exited", bytes_vec(s_exited));
+  put("s_exit_time", bytes_vec(s_exit_time));
+  put("s_target", bytes_vec(s_target));
+  put("peers", bytes_vec(peers));
+  put("n_peers", bytes_vec(n_peers));
+  put("app_sys", bytes_vec(app_sys));
+  put("pkts_sent", bytes_vec(pkts_sent));
+  put("pkts_recv", bytes_vec(pkts_recv));
+  put("pkts_dropped", bytes_vec(pkts_dropped));
+  put("events_run", bytes_vec(events_run));
+  put("eth_psent", bytes_vec(eth_psent));
+  put("eth_precv", bytes_vec(eth_precv));
+  put("eth_bsent", bytes_vec(eth_bsent));
+  put("eth_brecv", bytes_vec(eth_brecv));
+  if (!ok) {
+    Py_DECREF(d);
+    return nullptr;
+  }
+  return d;
+}
+
+/* Typed view into a dict entry of packed column bytes. */
+template <typename T>
+static const T *col(PyObject *d, const char *k, size_t need,
+                    bool *ok) {
+  PyObject *v = PyDict_GetItemString(d, k);  // borrowed
+  if (v == nullptr || !PyBytes_Check(v) ||
+      (size_t)PyBytes_GET_SIZE(v) != need * sizeof(T)) {
+    PyErr_Format(PyExc_ValueError, "span import: bad column %s", k);
+    *ok = false;
+    return nullptr;
+  }
+  return (const T *)PyBytes_AS_STRING(v);
+}
+
+static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
+  /* (dict, I, T, R, S, C, P, traces_or_None) -> None.  Overwrites the
+   * engine's phold state with the device span's result; trace records
+   * append to the owning hosts.  Only called after a CLEAN device
+   * span (no abort), so state is consistent by construction. */
+  PyObject *d, *traces;
+  long long I, T, R, S, C, P;
+  if (!PyArg_ParseTuple(args, "OLLLLLLO", &d, &I, &T, &R, &S, &C, &P,
+                        &traces))
+    return nullptr;
+  Engine *e = self->eng;
+  Engine::PholdShape sh;
+  if (!e->phold_shape(&sh)) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "span import: sim no longer phold-shaped");
+    return nullptr;
+  }
+  size_t H = e->hosts.size();
+  bool ok = true;
+  const int64_t *now = col<int64_t>(d, "now", H, &ok);
+  const int64_t *event_seq = col<int64_t>(d, "event_seq", H, &ok);
+  const int64_t *packet_seq = col<int64_t>(d, "packet_seq", H, &ok);
+  const uint32_t *status = col<uint32_t>(d, "status", H, &ok);
+  const uint8_t *queued = col<uint8_t>(d, "queued", H, &ok);
+  const int64_t *recv_bytes = col<int64_t>(d, "recv_bytes", H, &ok);
+  const int64_t *send_bytes = col<int64_t>(d, "send_bytes", H, &ok);
+  const int32_t *rq_len = col<int32_t>(d, "rq_len", H, &ok);
+  const int32_t *sq_len = col<int32_t>(d, "sq_len", H, &ok);
+  const int32_t *cq_len = col<int32_t>(d, "cq_len", H, &ok);
+  const int32_t *ib_len = col<int32_t>(d, "ib_len", H, &ok);
+  const int32_t *th_len = col<int32_t>(d, "th_len", H, &ok);
+  struct Pk {
+    const int32_t *srchost;
+    const int64_t *pseq;
+    const uint32_t *sip, *dip;
+    const int32_t *sport, *dport;
+    const int64_t *size;
+  };
+  auto get_pk = [&](const char *prefix, size_t n) {
+    std::string p(prefix);
+    Pk c;
+    c.srchost = col<int32_t>(d, (p + "_srchost").c_str(), n, &ok);
+    c.pseq = col<int64_t>(d, (p + "_pseq").c_str(), n, &ok);
+    c.sip = col<uint32_t>(d, (p + "_sip").c_str(), n, &ok);
+    c.sport = col<int32_t>(d, (p + "_sport").c_str(), n, &ok);
+    c.dip = col<uint32_t>(d, (p + "_dip").c_str(), n, &ok);
+    c.dport = col<int32_t>(d, (p + "_dport").c_str(), n, &ok);
+    c.size = col<int64_t>(d, (p + "_size").c_str(), n, &ok);
+    return c;
+  };
+  Pk rq = get_pk("rq", H * R), sq = get_pk("sq", H * S),
+     cq = get_pk("cq", H * C), ib = get_pk("ib", H * I),
+     r1pk = get_pk("r1_pk", H), r2pk = get_pk("r2_pk", H);
+  const int64_t *cq_enq = col<int64_t>(d, "cq_enq", H * C, &ok);
+  const int64_t *codel_bytes = col<int64_t>(d, "codel_bytes", H, &ok);
+  const uint8_t *codel_dropping =
+      col<uint8_t>(d, "codel_dropping", H, &ok);
+  const int64_t *codel_count = col<int64_t>(d, "codel_count", H, &ok);
+  const int64_t *codel_last_count =
+      col<int64_t>(d, "codel_last_count", H, &ok);
+  const int64_t *codel_first_above =
+      col<int64_t>(d, "codel_first_above", H, &ok);
+  const int64_t *codel_drop_next =
+      col<int64_t>(d, "codel_drop_next", H, &ok);
+  const int64_t *codel_dropped =
+      col<int64_t>(d, "codel_dropped", H, &ok);
+  const uint8_t *r_pending[3] = {nullptr, nullptr, nullptr};
+  const uint8_t *r_pk_valid[3] = {nullptr, nullptr, nullptr};
+  const int64_t *r_bal[3], *r_next[3];
+  for (int r = 1; r <= 2; r++) {
+    std::string p = r == 1 ? "r1" : "r2";
+    r_pending[r] = col<uint8_t>(d, (p + "_pending").c_str(), H, &ok);
+    r_pk_valid[r] = col<uint8_t>(d, (p + "_pk_valid").c_str(), H, &ok);
+    r_bal[r] = col<int64_t>(d, (p + "_bal").c_str(), H, &ok);
+    r_next[r] = col<int64_t>(d, (p + "_next").c_str(), H, &ok);
+  }
+  const int64_t *ib_time = col<int64_t>(d, "ib_time", H * I, &ok);
+  const int32_t *ib_src = col<int32_t>(d, "ib_src", H * I, &ok);
+  const int64_t *ib_seq = col<int64_t>(d, "ib_seq", H * I, &ok);
+  const int64_t *th_time = col<int64_t>(d, "th_time", H * T, &ok);
+  const int64_t *th_seq = col<int64_t>(d, "th_seq", H * T, &ok);
+  const uint8_t *th_kind = col<uint8_t>(d, "th_kind", H * T, &ok);
+  const uint8_t *th_tgt = col<uint8_t>(d, "th_tgt", H * T, &ok);
+  const uint8_t *m_state = col<uint8_t>(d, "m_state", H, &ok);
+  const uint8_t *m_wakep = col<uint8_t>(d, "m_wakep", H, &ok);
+  const uint32_t *m_waitmask = col<uint32_t>(d, "m_waitmask", H, &ok);
+  const int64_t *m_waitseq = col<int64_t>(d, "m_waitseq", H, &ok);
+  const int64_t *m_gotn = col<int64_t>(d, "m_gotn", H, &ok);
+  const uint32_t *m_lcg = col<uint32_t>(d, "m_lcg", H, &ok);
+  const uint32_t *m_target = col<uint32_t>(d, "m_target", H, &ok);
+  const uint8_t *s_state = col<uint8_t>(d, "s_state", H, &ok);
+  const uint8_t *s_wakep = col<uint8_t>(d, "s_wakep", H, &ok);
+  const uint32_t *s_waitmask = col<uint32_t>(d, "s_waitmask", H, &ok);
+  const int64_t *s_waitseq = col<int64_t>(d, "s_waitseq", H, &ok);
+  const int64_t *s_senti = col<int64_t>(d, "s_senti", H, &ok);
+  const uint8_t *s_exited = col<uint8_t>(d, "s_exited", H, &ok);
+  const int64_t *s_exit_time = col<int64_t>(d, "s_exit_time", H, &ok);
+  const uint32_t *s_target = col<uint32_t>(d, "s_target", H, &ok);
+  const int64_t *app_sys = col<int64_t>(d, "app_sys", H * ASYS_N, &ok);
+  const int64_t *pkts_sent = col<int64_t>(d, "pkts_sent", H, &ok);
+  const int64_t *pkts_recv = col<int64_t>(d, "pkts_recv", H, &ok);
+  const int64_t *pkts_dropped = col<int64_t>(d, "pkts_dropped", H, &ok);
+  const int64_t *events_run = col<int64_t>(d, "events_run", H, &ok);
+  const int64_t *eth_psent = col<int64_t>(d, "eth_psent", H, &ok);
+  const int64_t *eth_precv = col<int64_t>(d, "eth_precv", H, &ok);
+  const int64_t *eth_bsent = col<int64_t>(d, "eth_bsent", H, &ok);
+  const int64_t *eth_brecv = col<int64_t>(d, "eth_brecv", H, &ok);
+  if (!ok) return nullptr;
+
+  /* Lengths are read from an arbitrary Python dict: validate against
+   * the caps before any indexing (a rogue length would read past the
+   * per-host slice and the bytes buffer). */
+  for (size_t h = 0; h < H; h++) {
+    if (rq_len[h] < 0 || rq_len[h] > R || sq_len[h] < 0 ||
+        sq_len[h] > S || cq_len[h] < 0 || cq_len[h] > C ||
+        ib_len[h] < 0 || ib_len[h] > I || th_len[h] < 0 ||
+        th_len[h] > T) {
+      PyErr_SetString(PyExc_ValueError, "span import: length over cap");
+      return nullptr;
+    }
+  }
+
+  for (size_t h = 0; h < H; h++) {
+    HostPlane *hp = e->hosts[h].get();
+    AppN &m = e->apps[(size_t)sh.main_idx[h]];
+    AppN &s = e->apps[(size_t)sh.seed_idx[h]];
+    UdpSocketN *u = e->udp((uint32_t)m.sock);
+    bool was_queued = u->queued[1];
+    /* free live engine packets; the device result replaces them */
+    for (uint64_t id : u->recv_q) e->store.free_pkt(id);
+    u->recv_q.clear();
+    for (uint64_t id : u->send_q[1]) e->store.free_pkt(id);
+    u->send_q[1].clear();
+    for (auto &[id, enq] : hp->codel.q) e->store.free_pkt(id);
+    hp->codel.q.clear();
+    for (int r = 1; r <= 2; r++) {
+      if (hp->relays[r].pending != UINT64_MAX) {
+        e->store.free_pkt(hp->relays[r].pending);
+        hp->relays[r].pending = UINT64_MAX;
+      }
+    }
+    for (const InboxEnt &ie : hp->inbox) e->store.free_pkt(ie.pkt);
+    hp->inbox.clear();
+    hp->theap.clear();
+
+    hp->now = now[h];
+    hp->event_seq = (uint64_t)event_seq[h];
+    hp->packet_seq = (uint64_t)packet_seq[h];
+    u->status = status[h];
+    u->queued[1] = queued[h] != 0;
+    u->recv_bytes = recv_bytes[h];
+    u->send_bytes = send_bytes[h];
+    auto mk = [&](const Pk &c, size_t j) {
+      return e->pk_alloc(c.srchost[j], c.pseq[j], c.sip[j], c.sport[j],
+                         c.dip[j], c.dport[j]);
+    };
+    for (int32_t j = 0; j < rq_len[h]; j++)
+      u->recv_q.push_back(mk(rq, h * (size_t)R + (size_t)j));
+    for (int32_t j = 0; j < sq_len[h]; j++)
+      u->send_q[1].push_back(mk(sq, h * (size_t)S + (size_t)j));
+    /* queued means "token registered in the iface qdisc" — if the
+     * device span set it while the engine-side heap has no entry, a
+     * stranded send queue would never drain (notify early-returns on
+     * the flag). */
+    if (u->queued[1] && !was_queued && !u->send_q[1].empty()) {
+      uint32_t tok = (uint32_t)m.sock;
+      if (hp->qdisc == 1)
+        hp->eth.send_ready.push_back(tok);
+      else
+        hp->eth.heap_push(e->store.get(u->send_q[1].front())->priority,
+                          tok);
+    }
+    for (int32_t j = 0; j < cq_len[h]; j++)
+      hp->codel.q.emplace_back(mk(cq, h * (size_t)C + (size_t)j),
+                               cq_enq[h * (size_t)C + (size_t)j]);
+    hp->codel.bytes = codel_bytes[h];
+    hp->codel.dropping = codel_dropping[h] != 0;
+    hp->codel.count = codel_count[h];
+    hp->codel.last_count = codel_last_count[h];
+    hp->codel.first_above = codel_first_above[h];
+    hp->codel.drop_next = codel_drop_next[h];
+    hp->codel.dropped_count = codel_dropped[h];
+    for (int r = 1; r <= 2; r++) {
+      RelayN &rl = hp->relays[r];
+      rl.state = r_pending[r][h] ? RELAY_PENDING : RELAY_IDLE;
+      rl.bucket.balance = r_bal[r][h];
+      rl.bucket.next_refill = r_next[r][h];
+      if (r_pk_valid[r][h])
+        rl.pending = mk(r == 1 ? r1pk : r2pk, h);
+    }
+    for (int32_t j = 0; j < ib_len[h]; j++) {
+      size_t k = h * (size_t)I + (size_t)j;
+      hp->ipush({ib_time[k], ib_src[k], (uint64_t)ib_seq[k],
+                 mk(ib, k)});
+    }
+    for (int32_t j = 0; j < th_len[h]; j++) {
+      size_t k = h * (size_t)T + (size_t)j;
+      uint32_t tgt;
+      if (th_kind[k] == TK_RELAY)
+        tgt = th_tgt[k];
+      else
+        tgt = (uint32_t)(th_tgt[k] == 1 ? sh.seed_idx[h]
+                                        : sh.main_idx[h]);
+      hp->tpush({th_time[k], (uint64_t)th_seq[k], (int)th_kind[k],
+                 tgt});
+    }
+    m.state = m_state[h];
+    m.wake_pending = m_wakep[h] != 0;
+    m.wait_mask = m_waitmask[h];
+    m.got_n = m_gotn[h];
+    m.lcg = m_lcg[h];
+    m.phold_target = m_target[h];
+    s.state = s_state[h];
+    s.wake_pending = s_wakep[h] != 0;
+    s.wait_mask = s_waitmask[h];
+    s.sent_i = s_senti[h];
+    s.phold_target = s_target[h];
+    if (s_exited[h] && !s.exited) {
+      s.exited = true;
+      s.exit_code = 0;
+      s.exit_time = s_exit_time[h];
+      s.wait_mask = 0;
+    }
+    /* park order: device wait_seqs are per-host-relative; map into the
+     * global counter preserving relative order (seqs are only ever
+     * compared between one host's sibling apps). */
+    if (m.wait_mask && s.wait_mask) {
+      bool m_first = m_waitseq[h] <= s_waitseq[h];
+      int64_t a = e->wait_park_counter.fetch_add(
+          2, std::memory_order_relaxed);
+      m.wait_seq = m_first ? a : a + 1;
+      s.wait_seq = m_first ? a + 1 : a;
+    } else if (m.wait_mask) {
+      m.wait_seq = e->wait_park_counter.fetch_add(
+          1, std::memory_order_relaxed);
+    } else if (s.wait_mask) {
+      s.wait_seq = e->wait_park_counter.fetch_add(
+          1, std::memory_order_relaxed);
+    }
+    for (int j = 0; j < ASYS_N; j++)
+      hp->app_sys[j] = app_sys[h * ASYS_N + j];
+    hp->pkts_sent = pkts_sent[h];
+    hp->pkts_recv = pkts_recv[h];
+    hp->pkts_dropped = pkts_dropped[h];
+    hp->events_run = events_run[h];
+    hp->eth.packets_sent = eth_psent[h];
+    hp->eth.packets_received = eth_precv[h];
+    hp->eth.bytes_sent = eth_bsent[h];
+    hp->eth.bytes_received = eth_brecv[h];
+    /* refresh the shared next-event snapshot */
+    if (e->nt && (int64_t)h < e->nt_len) {
+      int64_t best = INT64_MAX;
+      if (!hp->inbox.empty()) best = hp->inbox.front().time;
+      if (!hp->theap.empty() && hp->theap.front().time < best)
+        best = hp->theap.front().time;
+      e->nt[h] = best;
+    }
+  }
+
+  /* trace records: (t i64, kind u8, srchost i32, pseq i64, sip u32,
+   * sport i32, dip u32, dport i32, size i64, reason u8, owner i32)
+   * column bytes + count, or None when tracing was off. */
+  if (traces != Py_None) {
+    static const char *REASONS[] = {"",
+                                    "codel",
+                                    "rtr-limit",
+                                    "rcvbuf-full",
+                                    "no-socket",
+                                    "no-route",
+                                    "inet-loss",
+                                    "unreachable",
+                                    "udp-connected-filter"};
+    PyObject *tn = PyDict_GetItemString(traces, "n");
+    if (tn == nullptr) {
+      PyErr_SetString(PyExc_ValueError, "span import: traces missing n");
+      return nullptr;
+    }
+    size_t n = (size_t)PyLong_AsLongLong(tn);
+    bool tok = true;
+    const int64_t *t = col<int64_t>(traces, "t", n, &tok);
+    const uint8_t *kind = col<uint8_t>(traces, "kind", n, &tok);
+    const int32_t *srchost = col<int32_t>(traces, "srchost", n, &tok);
+    const int64_t *pseq = col<int64_t>(traces, "pseq", n, &tok);
+    const uint32_t *sip = col<uint32_t>(traces, "sip", n, &tok);
+    const int32_t *sport = col<int32_t>(traces, "sport", n, &tok);
+    const uint32_t *dip = col<uint32_t>(traces, "dip", n, &tok);
+    const int32_t *dport = col<int32_t>(traces, "dport", n, &tok);
+    const int64_t *size = col<int64_t>(traces, "size", n, &tok);
+    const uint8_t *reason = col<uint8_t>(traces, "reason", n, &tok);
+    const int32_t *owner = col<int32_t>(traces, "owner", n, &tok);
+    if (!tok) return nullptr;
+    for (size_t j = 0; j < n; j++) {
+      if (owner[j] < 0 || (size_t)owner[j] >= H) continue;
+      HostPlane *hp = e->hosts[(size_t)owner[j]].get();
+      if (!hp->tracing) continue;
+      if (reason[j] >= sizeof(REASONS) / sizeof(REASONS[0])) continue;
+      hp->trace.push_back({t[j], (int)kind[j], srchost[j],
+                           (uint64_t)pseq[j], PROTO_UDP, sip[j], dip[j],
+                           sport[j], dport[j], size[j],
+                           REASONS[reason[j]]});
+    }
+  }
+  Py_RETURN_NONE;
 }
 
 static PyObject *eng_run_span(EngineObj *self, PyObject *args) {
@@ -4814,6 +5567,10 @@ static PyMethodDef eng_methods[] = {
     {"run_hosts", (PyCFunction)eng_run_hosts, METH_VARARGS, nullptr},
     {"run_hosts_mt", (PyCFunction)eng_run_hosts_mt, METH_VARARGS, nullptr},
     {"run_span", (PyCFunction)eng_run_span, METH_VARARGS, nullptr},
+    {"span_export_phold", (PyCFunction)eng_span_export_phold,
+     METH_VARARGS, nullptr},
+    {"span_import_phold", (PyCFunction)eng_span_import_phold,
+     METH_VARARGS, nullptr},
     {"mt_stats", (PyCFunction)eng_mt_stats, METH_NOARGS, nullptr},
     {"set_pcap", (PyCFunction)eng_set_pcap, METH_VARARGS, nullptr},
     {"pcap_take", (PyCFunction)eng_pcap_take, METH_VARARGS, nullptr},

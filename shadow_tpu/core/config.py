@@ -132,6 +132,12 @@ class ExperimentalConfig:
     # byte-identical either way (the cross-scheduler determinism gates
     # are the parity proof).
     native_dataplane: str = "auto"
+    # Device-resident multi-round spans (ops/phold_span.py): whole
+    # conservative windows step ON DEVICE as struct-of-arrays for
+    # eligible (PHOLD-pure) sims.  "auto" measures device vs C++ span
+    # throughput and routes; "force" always takes the device when
+    # eligible (parity gates, demonstrations); "off" disables.
+    tpu_device_spans: str = "auto"
     # Pin worker threads to distinct CPUs (ref: affinity.c, on by
     # default; docs/parallel_sims.md reports ~3x cost when off).
     use_cpu_pinning: bool = True
@@ -214,6 +220,7 @@ class ConfigOptions:
                 "tpu_shards": e.tpu_shards,
                 "tpu_exchange_capacity": e.tpu_exchange_capacity,
                 "native_dataplane": e.native_dataplane,
+                "tpu_device_spans": e.tpu_device_spans,
                 "openssl_crypto_noop": e.openssl_crypto_noop,
                 "use_cpu_pinning": e.use_cpu_pinning,
                 "use_perf_timers": e.use_perf_timers,
@@ -343,6 +350,9 @@ class ConfigOptions:
                 # spellings (`native_dataplane: on` is the documented
                 # form).
                 ("native_dataplane", "native_dataplane",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
+                ("tpu_device_spans", "tpu_device_spans",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),

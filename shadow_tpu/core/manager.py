@@ -592,6 +592,17 @@ class Manager:
                    # jitted kernel, so spans (whose propagation runs
                    # the C++ twin) stay out of the way.
                    and route is not None and route.min_device_batch > 0)
+        # Device-resident multi-round spans (ops/phold_span.py): for
+        # eligible sims whole windows step ON DEVICE; "auto" measures
+        # device vs C++ span throughput per round and routes, "force"
+        # always takes the device (parity gates), "off" disables.
+        dev_mode = self.config.experimental.tpu_device_spans
+        dev_span_on = span_ok and dev_mode in ("auto", "force", "on")
+        self._dev_span = None
+        dev_ns_round = None   # EWMA wall ns/round, device spans
+        cpp_ns_round = None   # EWMA wall ns/round, C++ spans
+        dev_probe_countdown = 0
+        dev_aborts_row = 0
         from shadow_tpu.core.simtime import TIME_NEVER
         while start is not None and start < stop:
             if span_ok and not self._py_work.any() \
@@ -605,6 +616,85 @@ class Manager:
                 # the drain below (per-round streams; spans must not
                 # buffer a whole sim).
                 max_rounds = 64 if self._pcap_engine else 1024
+
+                def account_span(res):
+                    """Book one completed span (C++ or device) and
+                    advance the loop.  Returns the next window start
+                    (None = simulation drained)."""
+                    rounds, busy_rounds, pkts, next_start, busy_end, \
+                        ra = res
+                    summary.rounds += rounds
+                    summary.busy_end_ns = busy_end
+                    self.runahead.sync_from_span(ra)
+                    prop = self.propagator
+                    # Audit split counts dispatches the way the
+                    # per-round path does: only rounds that propagated
+                    # packets.
+                    prop.rounds_dispatched += busy_rounds
+                    prop.packets_batched += pkts
+                    if self._pcap_engine:
+                        self._drain_engine_pcap()
+                    nonlocal next_heartbeat, next_status_wall
+                    if heartbeat_lines and busy_end >= next_heartbeat:
+                        self._log_heartbeat(busy_end, stop, wall_start,
+                                            sys.stderr)
+                        next_heartbeat = busy_end + heartbeat
+                    if status is not None:
+                        wall = time.perf_counter()
+                        if wall >= next_status_wall:
+                            status.update(busy_end)
+                            next_status_wall = wall + status_throttle
+                    return (None if next_start >= TIME_NEVER
+                            else next_start)
+
+                # ---- device-resident span (ops/phold_span.py) ----
+                use_dev = False
+                if dev_span_on:
+                    if dev_mode in ("force", "on"):
+                        use_dev = True
+                    elif dev_ns_round is not None \
+                            and cpp_ns_round is not None:
+                        use_dev = dev_ns_round < cpp_ns_round
+                    elif dev_ns_round is None:
+                        # Unmeasured: probing pays the device loop's
+                        # XLA compile (tens of seconds on a slow
+                        # backend), so only long runs earn it — the
+                        # same 1%-of-wall budget the route model uses.
+                        elapsed = time.perf_counter() - wall_start
+                        use_dev = (dev_probe_countdown <= 0
+                                   and elapsed * 0.01 >= 5.0)
+                if use_dev:
+                    t0 = time.perf_counter_ns()
+                    res = self._device_span(start, stop, limit,
+                                            max_rounds)
+                    if res is not None:
+                        dev_aborts_row = 0
+                        if self._dev_span.last_was_cold:
+                            # Compile-tainted wall: discard the sample
+                            # and re-measure warm on the next attempt.
+                            dev_probe_countdown = 0
+                        else:
+                            dt = time.perf_counter_ns() - t0
+                            per = dt / max(res[0], 1)
+                            dev_ns_round = per if dev_ns_round is None \
+                                else 0.7 * dev_ns_round + 0.3 * per
+                            dev_probe_countdown = 16
+                        start = account_span(res)
+                        continue
+                    if self._dev_span is None \
+                            or self._dev_span.ineligible:
+                        dev_span_on = False  # not a phold-shaped sim
+                    else:
+                        # abort or transient over-caps: back off, and
+                        # give up only after repeated failures
+                        dev_aborts_row += 1
+                        dev_probe_countdown = 16 * dev_aborts_row
+                        if dev_aborts_row >= 3:
+                            dev_span_on = False
+                elif dev_span_on:
+                    dev_probe_countdown -= 1
+
+                t0 = time.perf_counter_ns()
                 res = self.plane.engine.run_span(
                     start, stop, limit, self.runahead.get(),
                     int(self.runahead.dynamic), max_rounds,
@@ -612,31 +702,12 @@ class Manager:
                 if res is None:
                     span_ok = False  # callback-capable host: per-round
                 else:
-                    rounds, busy_rounds, pkts, next_start, busy_end, \
-                        ra = res
+                    rounds = res[0]
                     if rounds:
-                        summary.rounds += rounds
-                        summary.busy_end_ns = busy_end
-                        self.runahead.sync_from_span(ra)
-                        prop = self.propagator
-                        # Audit split counts dispatches the way the
-                        # per-round path does: only rounds that
-                        # propagated packets.
-                        prop.rounds_dispatched += busy_rounds
-                        prop.packets_batched += pkts
-                        if self._pcap_engine:
-                            self._drain_engine_pcap()
-                        if heartbeat_lines and busy_end >= next_heartbeat:
-                            self._log_heartbeat(busy_end, stop, wall_start,
-                                                sys.stderr)
-                            next_heartbeat = busy_end + heartbeat
-                        if status is not None:
-                            wall = time.perf_counter()
-                            if wall >= next_status_wall:
-                                status.update(busy_end)
-                                next_status_wall = wall + status_throttle
-                        start = (None if next_start >= TIME_NEVER
-                                 else next_start)
+                        per = (time.perf_counter_ns() - t0) / rounds
+                        cpp_ns_round = per if cpp_ns_round is None \
+                            else 0.7 * cpp_ns_round + 0.3 * per
+                        start = account_span(res)
                         continue
                     # rounds == 0 (e.g. heartbeat boundary due now):
                     # fall through to one per-round iteration.
@@ -734,6 +805,27 @@ class Manager:
                 w_lo.close()
                 w_eth.close()
         return summary
+
+    def _device_span(self, start: int, stop: int, limit: int,
+                     max_rounds: int):
+        """Attempt one device-resident multi-round span (lazily builds
+        the PholdSpanRunner).  None = ineligible or aborted (the engine
+        state is untouched either way — transactional)."""
+        if self._dev_span is None:
+            from shadow_tpu.ops.phold_span import PholdSpanRunner
+            tracing = any(h.tracing_enabled for h in self.hosts)
+            self._dev_span = PholdSpanRunner(
+                self.plane.engine, self.graph.latency_ns,
+                self.loss_thresholds,
+                np.ascontiguousarray(
+                    [h.node_index for h in self.hosts], dtype=np.int32),
+                np.ascontiguousarray([h.ip for h in self.hosts],
+                                     dtype=np.uint32),
+                self.config.general.seed,
+                self.config.general.bootstrap_end_time_ns, tracing)
+        return self._dev_span.try_span(
+            start, stop, limit, self.runahead.get(),
+            self.runahead.dynamic, max_rounds)
 
     def _log_heartbeat(self, sim_now: int, stop: int, wall_start: float,
                        out) -> None:

@@ -1,0 +1,1137 @@
+"""Device-resident multi-round loop for PHOLD-pure simulations.
+
+The blueprint's core promise (SURVEY.md:19-23): socket/app state becomes
+struct-of-arrays stepped by vectorized JAX functions, and whole
+conservative windows iterate ON DEVICE (`lax.while_loop`) — propagation,
+the min barrier, inbox merge, and app stepping in one dispatch, so the
+host<->device round trip amortizes over K rounds instead of being paid
+per round (VERDICT r4 missing #1/#2).
+
+Scope: PHOLD (the classic PDES benchmark, ref src/test/phold) — every
+host one APP_PHOLD LP + one APP_PHOLD_SEED over a single bound UDP
+socket.  The model is a field-for-field twin of the engine's event loop
+(netplane.cpp run_until + the UDP data-plane chain): same event total
+order (time, packet-before-local, (src, seq)), same event-seq draw
+points, same token-bucket/CoDel/recv-buffer arithmetic, same status-
+change wake fan-out — so packet traces and sim-stats are byte-identical
+to the serial/engine paths (gated in tests/test_phold_span.py).
+
+Transactional: the engine exports a read-only snapshot
+(span_export_phold), the device steps K windows, and the result imports
+back ONLY on a clean run (no capacity/validity abort).  An aborted span
+costs nothing — the engine re-runs those rounds on the C++ path, so
+rare-path divergence degrades to fallback, never to corruption.
+
+The micro-op interpreter: per while-iteration each host advances ONE
+micro-op — pop its next due event, or continue a relay drain / app
+stepper continuation.  This flattens the engine's nested control flow
+(app step -> relay forward -> bucket park) into a vectorized state
+machine with no data-dependent Python control flow inside jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu.core.rng import STREAM_PACKET_LOSS, mix_key, threefry2x32_jax
+from shadow_tpu.core.simtime import TIME_NEVER
+
+I64_MAX = np.int64(1 << 62)  # "no event" sentinel (== TIME_NEVER)
+
+# Continuations (one per host).
+C_IDLE = 0
+C_R1 = 1      # relay inet-out drain
+C_R2 = 2      # relay inet-in drain
+C_M_STEP = 3  # main app stepper entry (sleep-restart + send)
+C_S_STEP = 4  # seeder stepper entry
+C_M_RECV = 5  # main recv phase (after a send's relay drain returns)
+C_S_POST = 6  # seeder post-send bookkeeping
+
+# Timer kinds / status bits / syscall slots (netplane.cpp).
+TK_RELAY = 0
+TK_APP = 2
+TK_APP_TIMEOUT = 3
+S_READABLE = 1 << 1
+S_WRITABLE = 1 << 2
+ASYS_SENDTO = 13
+ASYS_RECVFROM = 14
+ASYS_NANOSLEEP = 15
+ASYS_N = 16
+
+PKT_SIZE = 33   # 5-byte "phold" payload + UDP(8) + IPv4(20) headers
+PAYLOAD_LEN = 5  # trace records carry the payload length, not total
+MTU = 1500
+CODEL_TARGET_NS = 5_000_000
+REFILL_NS = 1_000_000
+
+# Trace kinds / drop reason codes (span_import_phold REASONS order).
+TR_SND = 0
+TR_DRP = 1
+TR_RCV = 2
+RSN_NONE = 0
+RSN_RCVBUF = 3
+RSN_NOSOCK = 4
+RSN_NOROUTE = 5
+RSN_LOSS = 6
+RSN_UNREACH = 7
+
+PK_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
+
+# Abort reason bits: trace/outbox overflows are capacity problems the
+# driver fixes by growing the buffer and retrying; structural bits mean
+# the state left the modelled domain (fall back to the C++ path).
+AB_TRACE = 1
+AB_OUT = 2
+AB_STRUCT = 4
+
+
+# Compiled step cache: repeated sims of the same shape (bench trials,
+# gates running serial-vs-device pairs) must not re-trace/re-compile the
+# large while_loop body per Manager.
+_FN_CACHE: dict = {}
+
+
+class PholdSpanRunner:
+    """Builds and drives the jitted multi-round device loop for one
+    simulation.  One instance per Manager."""
+
+    # Ring capacities (compile-time; export refuses state beyond half
+    # of each, and the device aborts transactionally on overflow).
+    CAP_I = 64    # inbox
+    CAP_T = 16    # timer heap
+    CAP_R = 64    # socket recv queue
+    CAP_S = 64    # socket send queue
+    CAP_C = 64    # CoDel ring
+    CAP_P = 4096  # peers
+    MAX_ROUNDS = 256
+
+    def __init__(self, engine, latency_ns, thresholds, host_node,
+                 host_ips, seed, bootstrap_end, tracing: bool):
+        self.engine = engine
+        self.tracing = bool(tracing)
+        k0, k1 = mix_key(seed, STREAM_PACKET_LOSS)
+        self._k = (np.uint32(k0), np.uint32(k1))
+        self._lat = np.ascontiguousarray(latency_ns, dtype=np.int64)
+        self._thr = np.ascontiguousarray(thresholds, dtype=np.int64)
+        self._node = np.ascontiguousarray(host_node, dtype=np.int32)
+        ips = np.ascontiguousarray(host_ips, dtype=np.uint32)
+        order = np.argsort(ips)
+        self._ips_sorted = ips[order]
+        self._ips_perm = order.astype(np.int32)
+        self.bootstrap_end = int(bootstrap_end)
+        self._fn = None
+        self._H = len(host_ips)
+        self.cap_out = max(512, 16 * self._H)
+        self.cap_tr = max(1 << 14, 64 * self._H)
+        self.spans = 0
+        self.rounds = 0
+        self.aborts = 0
+        self.ineligible = 0
+        self.over_caps = 0
+        # First successful span pays the while_loop's XLA compile; its
+        # wall time must not poison the auto-router's estimate.
+        self.compiled = False
+        self.last_was_cold = False
+
+    # ------------------------------------------------------------------
+    # Export bytes <-> numpy state
+    # ------------------------------------------------------------------
+
+    def _to_arrays(self, d: dict) -> dict:
+        H = self._H
+        I, T, R, S, C = (self.CAP_I, self.CAP_T, self.CAP_R,
+                         self.CAP_S, self.CAP_C)
+
+        def f(k, dt, shape=None):
+            a = np.frombuffer(d[k], dtype=dt)
+            a = a.reshape(shape) if shape is not None else a
+            return a.copy()
+
+        st = {}
+        for k in ("now", "event_seq", "packet_seq", "recv_bytes",
+                  "recv_max", "send_bytes", "send_max", "codel_bytes",
+                  "codel_dropped", "m_waitseq", "m_gotn", "m_mean",
+                  "s_waitseq", "s_senti", "s_count", "s_exit_time"):
+            st[k] = f(k, np.int64)
+        st["app_pkts_sent"] = f("pkts_sent", np.int64)
+        st["app_pkts_recv"] = f("pkts_recv", np.int64)
+        st["app_pkts_dropped"] = f("pkts_dropped", np.int64)
+        for k in ("events_run", "eth_psent", "eth_precv", "eth_bsent",
+                  "eth_brecv"):
+            st[k] = f(k, np.int64)
+        for k in ("eth_ip", "status", "m_waitmask", "s_waitmask",
+                  "m_lcg", "m_target", "s_target"):
+            st[k] = f(k, np.uint32)
+        for k in ("queued", "m_state", "m_wakep", "s_state", "s_wakep",
+                  "s_exited"):
+            st[k] = f(k, np.uint8).astype(np.int32)
+        # codel AQM bookkeeping rides along untouched; the device only
+        # runs while the queue is quiescent (abort otherwise).
+        st["codel_dropping"] = f("codel_dropping", np.uint8).astype(
+            np.int32)
+        st["codel_first_above"] = f("codel_first_above", np.int64)
+        for k in ("codel_count", "codel_last_count", "codel_drop_next"):
+            st[k] = f(k, np.int64)
+        st["m_port"] = f("m_port", np.int32)
+        st["n_peers"] = f("n_peers", np.int32)
+        P = len(np.frombuffer(d["peers"], np.uint32)) // H
+        st["peers"] = f("peers", np.uint32, (H, P))
+        st["app_sys"] = f("app_sys", np.int64, (H, ASYS_N))
+        for pfx, cap in (("rq", R), ("sq", S), ("cq", C), ("ib", I)):
+            for kk, dt in (("srchost", np.int32), ("pseq", np.int64),
+                           ("sip", np.uint32), ("sport", np.int32),
+                           ("dip", np.uint32), ("dport", np.int32)):
+                st[f"{pfx}_{kk}"] = f(f"{pfx}_{kk}", dt, (H, cap))
+            st[f"{pfx}_len"] = f(f"{pfx}_len", np.int32)
+        st["cq_enq"] = f("cq_enq", np.int64, (H, C))
+        st["ib_time"] = f("ib_time", np.int64, (H, I))
+        st["ib_src"] = f("ib_src", np.int32, (H, I))
+        st["ib_seq"] = f("ib_seq", np.int64, (H, I))
+        st["th_time"] = f("th_time", np.int64, (H, T))
+        st["th_seq"] = f("th_seq", np.int64, (H, T))
+        st["th_kind"] = f("th_kind", np.uint8, (H, T)).astype(np.int32)
+        st["th_tgt"] = f("th_tgt", np.uint8, (H, T)).astype(np.int32)
+        st["th_valid"] = (np.arange(T)[None, :]
+                          < f("th_len", np.int32)[:, None])
+        for r in (1, 2):
+            st[f"r{r}_pending"] = f(f"r{r}_pending", np.uint8).astype(
+                np.int32)
+            st[f"r{r}_unlimited"] = f(f"r{r}_unlimited",
+                                      np.uint8).astype(np.int32)
+            for k in ("bal", "next", "refill", "cap"):
+                st[f"r{r}_{k}"] = f(f"r{r}_{k}", np.int64)
+            st[f"r{r}_pk_valid"] = f(f"r{r}_pk_valid",
+                                     np.uint8).astype(np.int32)
+            for kk, dt in (("srchost", np.int32), ("pseq", np.int64),
+                           ("sip", np.uint32), ("sport", np.int32),
+                           ("dip", np.uint32), ("dport", np.int32)):
+                st[f"r{r}_pk_{kk}"] = f(f"r{r}_pk_{kk}", dt)
+        for k in ("rq_pos", "sq_pos", "cq_pos", "ib_pos"):
+            st[k] = np.zeros(H, np.int32)
+        st["cont"] = np.zeros(H, np.int32)
+        st["then"] = np.zeros(H, np.int32)
+        st["park_ctr"] = np.maximum(st["m_waitseq"],
+                                    st["s_waitseq"]) + 1
+        # padded-slot invariants the sort/argmin tricks rely on
+        st["ib_time"][np.arange(I)[None, :] >= st["ib_len"][:, None]] \
+            = I64_MAX
+        return st
+
+    def _from_arrays(self, st: dict) -> dict:
+        """Back to the engine's packed-byte import layout (rings
+        re-packed from their head positions)."""
+        H = self._H
+        out = {}
+
+        def npv(k):
+            return np.asarray(st[k])
+
+        def ring(pfx, cap, pos_k, len_k, modulo, extra=()):
+            pos = npv(pos_k).astype(np.int64)
+            ln = npv(len_k).astype(np.int64)
+            ar = np.arange(cap, dtype=np.int64)[None, :]
+            idx = (pos[:, None] + ar) % cap if modulo \
+                else np.minimum(pos[:, None] + ar, cap - 1)
+            for kk in PK_KEYS:
+                a = np.take_along_axis(npv(f"{pfx}_{kk}"), idx, axis=1)
+                out[f"{pfx}_{kk}"] = np.ascontiguousarray(a).tobytes()
+            for kk in extra:
+                a = np.take_along_axis(npv(kk), idx, axis=1)
+                out[kk] = np.ascontiguousarray(a).tobytes()
+            out[len_k] = (ln - pos).astype(np.int32).tobytes()
+            out[f"{pfx}_size"] = np.full((H, cap), PKT_SIZE,
+                                         np.int64).tobytes()
+
+        ring("rq", self.CAP_R, "rq_pos", "rq_len", True)
+        ring("sq", self.CAP_S, "sq_pos", "sq_len", True)
+        ring("cq", self.CAP_C, "cq_pos", "cq_len", True,
+             extra=("cq_enq",))
+        # inbox is linear (pos resets to 0 at each round's merge)
+        ring("ib", self.CAP_I, "ib_pos", "ib_len", False,
+             extra=("ib_time", "ib_src", "ib_seq"))
+        # timer heap: compact valid entries to the front
+        tv = npv("th_valid")
+        order = np.argsort(~tv, axis=1, kind="stable")
+        for k in ("th_time", "th_seq"):
+            a = np.take_along_axis(npv(k), order, axis=1)
+            out[k] = np.ascontiguousarray(a).tobytes()
+        for k in ("th_kind", "th_tgt"):
+            a = np.take_along_axis(npv(k), order, axis=1)
+            out[k] = np.ascontiguousarray(a.astype(np.uint8)).tobytes()
+        out["th_len"] = tv.sum(axis=1).astype(np.int32).tobytes()
+        for k in ("now", "event_seq", "packet_seq", "recv_bytes",
+                  "send_bytes", "codel_bytes", "codel_count",
+                  "codel_last_count", "codel_first_above",
+                  "codel_drop_next", "codel_dropped", "m_waitseq",
+                  "m_gotn", "s_waitseq", "s_senti", "s_exit_time"):
+            out[k] = npv(k).astype(np.int64).tobytes()
+        out["pkts_sent"] = npv("app_pkts_sent").astype(np.int64).tobytes()
+        out["pkts_recv"] = npv("app_pkts_recv").astype(np.int64).tobytes()
+        out["pkts_dropped"] = npv("app_pkts_dropped").astype(
+            np.int64).tobytes()
+        for k in ("events_run", "eth_psent", "eth_precv", "eth_bsent",
+                  "eth_brecv"):
+            out[k] = npv(k).astype(np.int64).tobytes()
+        for k in ("status", "m_waitmask", "s_waitmask", "m_lcg",
+                  "m_target", "s_target"):
+            out[k] = npv(k).astype(np.uint32).tobytes()
+        for k in ("queued", "m_state", "m_wakep", "s_state", "s_wakep",
+                  "s_exited", "codel_dropping"):
+            out[k] = npv(k).astype(np.uint8).tobytes()
+        for r in (1, 2):
+            out[f"r{r}_pending"] = npv(f"r{r}_pending").astype(
+                np.uint8).tobytes()
+            out[f"r{r}_pk_valid"] = npv(f"r{r}_pk_valid").astype(
+                np.uint8).tobytes()
+            out[f"r{r}_bal"] = npv(f"r{r}_bal").astype(
+                np.int64).tobytes()
+            out[f"r{r}_next"] = npv(f"r{r}_next").astype(
+                np.int64).tobytes()
+            for kk in PK_KEYS:
+                out[f"r{r}_pk_{kk}"] = np.ascontiguousarray(
+                    npv(f"r{r}_pk_{kk}")).tobytes()
+            out[f"r{r}_pk_size"] = np.full(H, PKT_SIZE,
+                                           np.int64).tobytes()
+        out["app_sys"] = npv("app_sys").astype(np.int64).tobytes()
+        return out
+
+    # ------------------------------------------------------------------
+    # The jitted multi-round step
+    # ------------------------------------------------------------------
+
+    def _cached_build(self, P: int):
+        key = (self._H, P, self._lat.shape, self.CAP_I, self.CAP_T,
+               self.CAP_R, self.CAP_S, self.CAP_C, self.cap_out,
+               self.cap_tr, self.tracing)
+        fn = _FN_CACHE.get(key)
+        if fn is None:
+            fn = _FN_CACHE[key] = self._build(P)
+        return fn
+
+    def _build(self, P: int):
+        import jax
+        import jax.numpy as jnp
+
+        H = self._H
+        I, T, R, S, C = (self.CAP_I, self.CAP_T, self.CAP_R,
+                         self.CAP_S, self.CAP_C)
+        O = self.cap_out
+        TR = self.cap_tr
+        tracing = self.tracing
+        hidx = jnp.arange(H, dtype=jnp.int32)
+        OOB = jnp.int32(H + 1)  # mode="drop" sink for masked-out lanes
+
+        def mrows(mask):
+            return jnp.where(mask, hidx, OOB)
+
+        # -------- primitive helpers ------------------------------
+
+        def mark_abort(st, cond, bit):
+            st = dict(st)
+            st["abort_code"] = st["abort_code"] | jnp.where(
+                cond, jnp.int32(bit), jnp.int32(0))
+            return st
+
+        def th_push(st, mask, time, seq, kind, tgt):
+            free = jnp.argmin(st["th_valid"], axis=1)
+            overflow = mask & st["th_valid"].all(axis=1)
+            mask = mask & ~overflow
+            rows = mrows(mask)
+            st = dict(st)
+            for key, v in (("th_time", time), ("th_seq", seq)):
+                st[key] = st[key].at[rows, free].set(v, mode="drop")
+            st["th_kind"] = st["th_kind"].at[rows, free].set(
+                kind, mode="drop")
+            st["th_tgt"] = st["th_tgt"].at[rows, free].set(
+                tgt, mode="drop")
+            st["th_valid"] = st["th_valid"].at[rows, free].set(
+                True, mode="drop")
+            return mark_abort(st, overflow.any(), AB_STRUCT)
+
+        def th_min(st):
+            t = jnp.where(st["th_valid"], st["th_time"], I64_MAX)
+            best_t = t.min(axis=1)
+            s = jnp.where(t == best_t[:, None], st["th_seq"], I64_MAX)
+            slot = jnp.argmin(s, axis=1)
+            return (best_t, st["th_kind"][hidx, slot],
+                    st["th_tgt"][hidx, slot], slot)
+
+        def draw_seq(st, mask):
+            v = st["event_seq"]
+            st = dict(st)
+            st["event_seq"] = jnp.where(mask, v + 1, v)
+            return st, v
+
+        def lcg_next(st, mask):
+            v = st["m_lcg"]
+            nv = v * jnp.uint32(1664525) + jnp.uint32(1013904223)
+            st = dict(st)
+            st["m_lcg"] = jnp.where(mask, nv, v)
+            return st, nv
+
+        def seq_append(st, prefix, cap_total, mask, cols: dict,
+                       count_key, abort_bit):
+            """Ordered multi-append into a flat buffer (outbox/trace):
+            lanes rank by host index — order among same-iteration
+            emitters is not semantically load-bearing (see netplane.cpp
+            run_hosts_mt outbox-merge comment)."""
+            st = dict(st)
+            n = st[count_key]
+            rank = jnp.cumsum(mask) - 1
+            slot = jnp.where(mask, n + rank, cap_total + 8)
+            for key, v in cols.items():
+                st[key] = st[key].at[slot].set(v, mode="drop")
+            total = n + mask.sum()
+            st[count_key] = total
+            return mark_abort(st, total > cap_total - H, abort_bit)
+
+        def tr_append(st, mask, time, kind, pk, reason):
+            if not tracing:
+                return st
+            return seq_append(
+                st, "tr", TR, mask,
+                {"tr_t": time,
+                 "tr_kind": jnp.full(H, kind, jnp.int32),
+                 "tr_srchost": pk["srchost"], "tr_pseq": pk["pseq"],
+                 "tr_sip": pk["sip"], "tr_sport": pk["sport"],
+                 "tr_dip": pk["dip"], "tr_dport": pk["dport"],
+                 "tr_reason": jnp.full(H, reason, jnp.int32),
+                 "tr_owner": hidx}, "tr_n", AB_TRACE)
+
+        def wake_check(st, changed_bits, time):
+            """adjust_status's app_wake fan-out, ordered by wait_seq
+            when both siblings qualify."""
+            m_ok = ((st["m_wakep"] == 0)
+                    & ((changed_bits & st["m_waitmask"]) != 0))
+            s_ok = ((st["s_wakep"] == 0) & (st["s_exited"] == 0)
+                    & ((changed_bits & st["s_waitmask"]) != 0))
+            both = m_ok & s_ok
+            first_is_s = (both & (st["s_waitseq"] < st["m_waitseq"])) \
+                | (s_ok & ~m_ok)
+            first = m_ok | s_ok
+            st, sq1 = draw_seq(st, first)
+            st = th_push(st, first & first_is_s, time, sq1, TK_APP, 1)
+            st = th_push(st, first & ~first_is_s, time, sq1, TK_APP, 0)
+            st = dict(st)
+            st["s_wakep"] = jnp.where(first & first_is_s, 1,
+                                      st["s_wakep"])
+            st["m_wakep"] = jnp.where(first & ~first_is_s, 1,
+                                      st["m_wakep"])
+            st, sq2 = draw_seq(st, both)
+            st = th_push(st, both & first_is_s, time, sq2, TK_APP, 0)
+            st = th_push(st, both & ~first_is_s, time, sq2, TK_APP, 1)
+            st = dict(st)
+            st["m_wakep"] = jnp.where(both & first_is_s, 1,
+                                      st["m_wakep"])
+            st["s_wakep"] = jnp.where(both & ~first_is_s, 1,
+                                      st["s_wakep"])
+            return st
+
+        def set_status(st, set_bits, clear_bits, mask, time):
+            cur = st["status"]
+            nw = (cur | set_bits) & ~clear_bits
+            changed = jnp.where(mask, cur ^ nw, jnp.uint32(0))
+            st = dict(st)
+            st["status"] = jnp.where(mask, nw, cur)
+            return wake_check(st, changed, time)
+
+        def bucket_try(st, r, now, mask):
+            bal = st[f"r{r}_bal"]
+            nxt = st[f"r{r}_next"]
+            refill = st[f"r{r}_refill"]
+            cap = st[f"r{r}_cap"]
+            unlimited = st[f"r{r}_unlimited"] == 1
+            first = nxt == 0
+            k = jnp.maximum(np.int64(0),
+                            1 + (now - nxt) // np.int64(REFILL_NS))
+            do_ref = ~first & (now >= nxt)
+            bal2 = jnp.where(do_ref, jnp.minimum(cap, bal + k * refill),
+                             bal)
+            nxt2 = jnp.where(first, now + np.int64(REFILL_NS),
+                             jnp.where(do_ref,
+                                       nxt + k * np.int64(REFILL_NS),
+                                       nxt))
+            ok = unlimited | (PKT_SIZE <= bal2)
+            bal3 = jnp.where(~unlimited & ok, bal2 - PKT_SIZE, bal2)
+            st = dict(st)
+            st[f"r{r}_bal"] = jnp.where(mask, bal3, bal)
+            st[f"r{r}_next"] = jnp.where(mask, nxt2, nxt)
+            return st, ok, nxt2
+
+        # -------- micro-op: relay drains -------------------------
+
+        def op_relay(st, r, mask):
+            now = st["now"]
+            pend_valid = st[f"r{r}_pk_valid"] == 1
+            use_pend = mask & pend_valid
+            if r == 1:
+                src_avail = mask & (st["queued"] == 1) & (
+                    st["sq_len"] > st["sq_pos"])
+                pos = st["sq_pos"] % S
+                pk = {kk: jnp.where(use_pend, st[f"r1_pk_{kk}"],
+                                    st[f"sq_{kk}"][hidx, pos])
+                      for kk in PK_KEYS}
+            else:
+                src_avail = mask & (st["cq_len"] > st["cq_pos"])
+                pos = st["cq_pos"] % C
+                pk = {kk: jnp.where(use_pend, st[f"r2_pk_{kk}"],
+                                    st[f"cq_{kk}"][hidx, pos])
+                      for kk in PK_KEYS}
+                enq = st["cq_enq"][hidx, pos]
+            pop = mask & ~use_pend & src_avail
+            none = mask & ~use_pend & ~src_avail
+
+            st = dict(st)
+            st[f"r{r}_pk_valid"] = jnp.where(use_pend, 0,
+                                             st[f"r{r}_pk_valid"])
+            if r == 1:
+                # iface_pop twin: dequeue, writable status, SND trace
+                st["sq_pos"] = jnp.where(pop, st["sq_pos"] + 1,
+                                         st["sq_pos"])
+                st["send_bytes"] = jnp.where(
+                    pop, st["send_bytes"] - PKT_SIZE, st["send_bytes"])
+                st["queued"] = jnp.where(
+                    pop, (st["sq_len"] > st["sq_pos"]).astype(jnp.int32),
+                    st["queued"])
+                st = set_status(st, jnp.uint32(S_WRITABLE),
+                                jnp.uint32(0), pop, now)
+                st = dict(st)
+                st["eth_psent"] = jnp.where(pop, st["eth_psent"] + 1,
+                                            st["eth_psent"])
+                st["eth_bsent"] = jnp.where(
+                    pop, st["eth_bsent"] + PKT_SIZE, st["eth_bsent"])
+                st = tr_append(st, pop, now, TR_SND, pk, RSN_NONE)
+            else:
+                # codel dequeue, quiescent path only (AQM-active state
+                # is outside the modelled domain -> abort, fall back)
+                st["cq_pos"] = jnp.where(pop, st["cq_pos"] + 1,
+                                         st["cq_pos"])
+                st["codel_bytes"] = jnp.where(
+                    pop, st["codel_bytes"] - PKT_SIZE,
+                    st["codel_bytes"])
+                active = pop & ((now - enq) >= CODEL_TARGET_NS) & (
+                    st["codel_bytes"] > MTU)
+                st = mark_abort(st, active.any(), AB_STRUCT)
+                st = dict(st)
+                st["codel_first_above"] = jnp.where(
+                    pop | none, 0, st["codel_first_above"])
+                st["codel_dropping"] = jnp.where(none, 0,
+                                                 st["codel_dropping"])
+
+            has_pkt = use_pend | pop
+            st, ok, when = bucket_try(st, r, now, has_pkt)
+            throttled = has_pkt & ~ok
+            st = dict(st)
+            st[f"r{r}_pending"] = jnp.where(throttled, 1,
+                                            st[f"r{r}_pending"])
+            st[f"r{r}_pk_valid"] = jnp.where(throttled, 1,
+                                             st[f"r{r}_pk_valid"])
+            for kk in PK_KEYS:
+                st[f"r{r}_pk_{kk}"] = jnp.where(throttled, pk[kk],
+                                                st[f"r{r}_pk_{kk}"])
+            st, sq = draw_seq(st, throttled)
+            st = th_push(st, throttled, when, sq, TK_RELAY, r)
+            st = dict(st)
+
+            fwd = has_pkt & ok
+            if r == 1:
+                # device_push(dev=2): cross-host send into the outbox
+                dslot = jnp.minimum(
+                    jnp.searchsorted(st["_ips_sorted"], pk["dip"]),
+                    H - 1)
+                found = st["_ips_sorted"][dslot] == pk["dip"]
+                dst = st["_ips_perm"][dslot]
+                st["app_pkts_sent"] = jnp.where(
+                    fwd, st["app_pkts_sent"] + 1, st["app_pkts_sent"])
+                miss = fwd & ~found
+                st["app_pkts_dropped"] = jnp.where(
+                    miss, st["app_pkts_dropped"] + 1,
+                    st["app_pkts_dropped"])
+                st = tr_append(st, miss, now, TR_DRP, pk, RSN_NOROUTE)
+                hit = fwd & found
+                st, sq = draw_seq(st, hit)
+                st = seq_append(
+                    st, "out", O, hit,
+                    {"out_src": hidx, "out_dst": dst, "out_seq": sq,
+                     "out_pseq": pk["pseq"], "out_sip": pk["sip"],
+                     "out_sport": pk["sport"], "out_dip": pk["dip"],
+                     "out_dport": pk["dport"], "out_t": now}, "out_n",
+                    AB_OUT)
+            else:
+                # iface_receive -> udp_push_in
+                st["eth_precv"] = jnp.where(fwd, st["eth_precv"] + 1,
+                                            st["eth_precv"])
+                st["eth_brecv"] = jnp.where(
+                    fwd, st["eth_brecv"] + PKT_SIZE, st["eth_brecv"])
+                wrong = fwd & (pk["dport"] != st["m_port"])
+                st["app_pkts_dropped"] = jnp.where(
+                    wrong, st["app_pkts_dropped"] + 1,
+                    st["app_pkts_dropped"])
+                st = tr_append(st, wrong, now, TR_DRP, pk, RSN_NOSOCK)
+                st = dict(st)
+                deliver = fwd & ~wrong
+                full = deliver & (st["recv_bytes"] + PKT_SIZE
+                                  > st["recv_max"])
+                st["app_pkts_dropped"] = jnp.where(
+                    full, st["app_pkts_dropped"] + 1,
+                    st["app_pkts_dropped"])
+                st = tr_append(st, full, now, TR_DRP, pk, RSN_RCVBUF)
+                st = dict(st)
+                good = deliver & ~full
+                st = mark_abort(st, (good & (st["rq_len"] - st["rq_pos"]
+                                              >= R - 1)).any(), AB_STRUCT)
+                st = dict(st)
+                tail = st["rq_len"] % R
+                rows = mrows(good)
+                for kk in PK_KEYS:
+                    st[f"rq_{kk}"] = st[f"rq_{kk}"].at[rows, tail].set(
+                        pk[kk], mode="drop")
+                st["rq_len"] = jnp.where(good, st["rq_len"] + 1,
+                                         st["rq_len"])
+                st["recv_bytes"] = jnp.where(
+                    good, st["recv_bytes"] + PKT_SIZE,
+                    st["recv_bytes"])
+                st = set_status(st, jnp.uint32(S_READABLE),
+                                jnp.uint32(0), good, now)
+                st = dict(st)
+                st["app_pkts_recv"] = jnp.where(
+                    good, st["app_pkts_recv"] + 1, st["app_pkts_recv"])
+                st = tr_append(st, good, now, TR_RCV, pk, RSN_NONE)
+                st = dict(st)
+
+            done = none | throttled
+            st["cont"] = jnp.where(done, st["then"], st["cont"])
+            st["then"] = jnp.where(done, C_IDLE, st["then"])
+            return st
+
+        # -------- micro-op: app steppers -------------------------
+
+        def phold_send_phase(st, mask, is_seed):
+            """One phold_send attempt; returns (st, sent, parked,
+            notify_relay1)."""
+            now = st["now"]
+            state_k = "s_state" if is_seed else "m_state"
+            tgt_k = "s_target" if is_seed else "m_target"
+            fresh = mask & (st[state_k] != 3)
+            st, rnd = lcg_next(st, fresh)
+            npeers = jnp.maximum(st["n_peers"], 1).astype(jnp.uint32)
+            pick = st["peers"][hidx, (rnd % npeers).astype(jnp.int32)]
+            st = dict(st)
+            st[tgt_k] = jnp.where(fresh, pick, st[tgt_k])
+            st[state_k] = jnp.where(fresh, 3, st[state_k])
+            st["app_sys"] = st["app_sys"].at[:, ASYS_SENDTO].add(
+                jnp.where(mask, 1, 0))
+            over = mask & (st["send_bytes"] + PKT_SIZE
+                           > st["send_max"])
+            st = set_status(st, jnp.uint32(0), jnp.uint32(S_WRITABLE),
+                            over, now)
+            st = dict(st)
+            wm_k = "s_waitmask" if is_seed else "m_waitmask"
+            ws_k = "s_waitseq" if is_seed else "m_waitseq"
+            st[wm_k] = jnp.where(over, jnp.uint32(S_WRITABLE),
+                                 st[wm_k])
+            st[ws_k] = jnp.where(over, st["park_ctr"], st[ws_k])
+            st["park_ctr"] = jnp.where(over, st["park_ctr"] + 1,
+                                       st["park_ctr"])
+            sent = mask & ~over
+            pseq = st["packet_seq"]
+            st["packet_seq"] = jnp.where(sent, pseq + 1,
+                                         st["packet_seq"])
+            st = mark_abort(st, (sent & (st["sq_len"] - st["sq_pos"]
+                                         >= S - 1)).any(), AB_STRUCT)
+            st = dict(st)
+            tail = st["sq_len"] % S
+            rows = mrows(sent)
+            vals = {"srchost": hidx, "pseq": pseq, "sip": st["eth_ip"],
+                    "sport": st["m_port"], "dip": st[tgt_k],
+                    "dport": st["m_port"]}
+            for kk in PK_KEYS:
+                st[f"sq_{kk}"] = st[f"sq_{kk}"].at[rows, tail].set(
+                    vals[kk], mode="drop")
+            st["sq_len"] = jnp.where(sent, st["sq_len"] + 1,
+                                     st["sq_len"])
+            st["send_bytes"] = jnp.where(
+                sent, st["send_bytes"] + PKT_SIZE, st["send_bytes"])
+            st[state_k] = jnp.where(sent, 0, st[state_k])
+            newly = sent & (st["queued"] == 0)
+            st["queued"] = jnp.where(newly, 1, st["queued"])
+            notify = newly & (st["r1_pending"] == 0)
+            return st, sent, over, notify
+
+        def arm_sleep(st, mask, is_seed):
+            now = st["now"]
+            st = dict(st)
+            st["app_sys"] = st["app_sys"].at[:, ASYS_NANOSLEEP].add(
+                jnp.where(mask, 1, 0))
+            st, r1 = lcg_next(st, mask)
+            st, r2 = lcg_next(st, mask)
+            u = ((r1 % jnp.uint32(1000)).astype(jnp.int64)
+                 + (r2 % jnp.uint32(1000)).astype(jnp.int64) + 1)
+            d = jnp.maximum(1, (u * st["m_mean"]) // 1000)
+            state_k = "s_state" if is_seed else "m_state"
+            wake_k = "s_wakep" if is_seed else "m_wakep"
+            st = dict(st)
+            st[state_k] = jnp.where(mask, 1, st[state_k])
+            st[wake_k] = jnp.where(mask, 1, st[wake_k])
+            st, sq = draw_seq(st, mask)
+            return th_push(st, mask, now + d, sq, TK_APP_TIMEOUT,
+                           1 if is_seed else 0)
+
+        def op_step(st, mask, is_seed):
+            """C_M_STEP / C_S_STEP micro-op."""
+            state_k = "s_state" if is_seed else "m_state"
+            st = dict(st)
+            restart = mask & (st[state_k] == 1)
+            st["app_sys"] = st["app_sys"].at[:, ASYS_NANOSLEEP].add(
+                jnp.where(restart, 1, 0))
+            st[state_k] = jnp.where(restart, 2, st[state_k])
+            has_send = mask & ((st[state_k] == 2)
+                               | (st[state_k] == 3))
+            st, sent, parked, notify = phold_send_phase(st, has_send,
+                                                        is_seed)
+            st = dict(st)
+            if is_seed:
+                st["s_senti"] = jnp.where(sent, st["s_senti"] + 1,
+                                          st["s_senti"])
+            nxt = C_S_POST if is_seed else C_M_RECV
+            to_next = (mask & ~has_send) | sent
+            go_drain = notify & sent
+            st["cont"] = jnp.where(
+                go_drain, C_R1, jnp.where(to_next, nxt,
+                                          jnp.where(parked, C_IDLE,
+                                                    st["cont"])))
+            st["then"] = jnp.where(go_drain, nxt, st["then"])
+            return st
+
+        def op_stage2(st, mask):
+            """C_M_RECV / C_S_POST micro-op."""
+            now = st["now"]
+            m_recv = mask & (st["cont"] == C_M_RECV)
+            s_post = mask & (st["cont"] == C_S_POST)
+            st = dict(st)
+            st["app_sys"] = st["app_sys"].at[:, ASYS_RECVFROM].add(
+                jnp.where(m_recv, 1, 0))
+            empty = m_recv & (st["rq_len"] <= st["rq_pos"])
+            st["m_waitmask"] = jnp.where(empty, jnp.uint32(S_READABLE),
+                                         st["m_waitmask"])
+            st["m_waitseq"] = jnp.where(empty, st["park_ctr"],
+                                        st["m_waitseq"])
+            st["park_ctr"] = jnp.where(empty, st["park_ctr"] + 1,
+                                       st["park_ctr"])
+            st["cont"] = jnp.where(empty, C_IDLE, st["cont"])
+            got = m_recv & ~empty
+            st["rq_pos"] = jnp.where(got, st["rq_pos"] + 1,
+                                     st["rq_pos"])
+            st["recv_bytes"] = jnp.where(
+                got, st["recv_bytes"] - PKT_SIZE, st["recv_bytes"])
+            now_empty = got & (st["rq_len"] <= st["rq_pos"])
+            st = set_status(st, jnp.uint32(0), jnp.uint32(S_READABLE),
+                            now_empty, now)
+            st = dict(st)
+            st["m_gotn"] = jnp.where(got, st["m_gotn"] + 1,
+                                     st["m_gotn"])
+            st = arm_sleep(st, got, False)
+            st = dict(st)
+            st["cont"] = jnp.where(got, C_IDLE, st["cont"])
+
+            done = s_post & (st["s_senti"] >= st["s_count"])
+            st["s_exited"] = jnp.where(done, 1, st["s_exited"])
+            st["s_exit_time"] = jnp.where(done, now,
+                                          st["s_exit_time"])
+            st["s_waitmask"] = jnp.where(done, jnp.uint32(0),
+                                         st["s_waitmask"])
+            st["cont"] = jnp.where(done, C_IDLE, st["cont"])
+            more = s_post & ~done
+            st = arm_sleep(st, more, True)
+            st = dict(st)
+            st["cont"] = jnp.where(more, C_IDLE, st["cont"])
+            return st
+
+        # -------- micro-op: event pop ----------------------------
+
+        def next_event_time(st):
+            pos = st["ib_pos"]
+            safe = jnp.minimum(pos, I - 1)
+            ib_t = jnp.where(st["ib_len"] > pos,
+                             st["ib_time"][hidx, safe], I64_MAX)
+            th_t = jnp.where(st["th_valid"], st["th_time"],
+                             I64_MAX).min(axis=1)
+            return ib_t, th_t
+
+        def op_pop_event(st, mask, window_end):
+            pos = st["ib_pos"]
+            safe = jnp.minimum(pos, I - 1)
+            ib_t, _ = next_event_time(st)
+            tmin, tkind, ttgt, tslot = th_min(st)
+            pick_ib = jnp.where(ib_t != tmin, ib_t < tmin,
+                                ib_t < I64_MAX)
+            et = jnp.minimum(ib_t, tmin)
+            due = mask & (et < window_end)
+            st = dict(st)
+            st["now"] = jnp.where(due, et, st["now"])
+            st["events_run"] = jnp.where(due, st["events_run"] + 1,
+                                         st["events_run"])
+
+            # arrival: inbox -> codel -> relay 2
+            arr = due & pick_ib
+            st["ib_pos"] = jnp.where(arr, pos + 1, pos)
+            st = mark_abort(st, (arr & (st["cq_len"] - st["cq_pos"]
+                                        >= C - 1)).any(), AB_STRUCT)
+            st = dict(st)
+            tail = st["cq_len"] % C
+            rows = mrows(arr)
+            for kk in PK_KEYS:
+                st[f"cq_{kk}"] = st[f"cq_{kk}"].at[rows, tail].set(
+                    st[f"ib_{kk}"][hidx, safe], mode="drop")
+            st["cq_enq"] = st["cq_enq"].at[rows, tail].set(
+                et, mode="drop")
+            st["cq_len"] = jnp.where(arr, st["cq_len"] + 1,
+                                     st["cq_len"])
+            st["codel_bytes"] = jnp.where(
+                arr, st["codel_bytes"] + PKT_SIZE, st["codel_bytes"])
+            go2 = arr & (st["r2_pending"] == 0)
+            st["cont"] = jnp.where(go2, C_R2, st["cont"])
+            st["then"] = jnp.where(go2, C_IDLE, st["then"])
+
+            # timer
+            tim = due & ~pick_ib
+            st["th_valid"] = st["th_valid"].at[mrows(tim), tslot].set(
+                False, mode="drop")
+            is_relay = tim & (tkind == TK_RELAY)
+            for r in (1, 2):
+                rw = is_relay & (ttgt == r)
+                # relay._wakeup: state -> idle; the parked packet stays
+                st[f"r{r}_pending"] = jnp.where(rw, 0,
+                                                st[f"r{r}_pending"])
+                st["cont"] = jnp.where(rw, C_R1 if r == 1 else C_R2,
+                                       st["cont"])
+                st["then"] = jnp.where(rw, C_IDLE, st["then"])
+
+            is_to = tim & (tkind == TK_APP_TIMEOUT)
+            st, sq = draw_seq(st, is_to)
+            st = th_push(st, is_to & (ttgt == 0), et, sq, TK_APP, 0)
+            st = th_push(st, is_to & (ttgt == 1), et, sq, TK_APP, 1)
+            st = dict(st)
+
+            is_app = tim & (tkind == TK_APP)
+            m_app = is_app & (ttgt == 0)
+            s_app = is_app & (ttgt == 1)
+            st["m_wakep"] = jnp.where(m_app, 0, st["m_wakep"])
+            st["s_wakep"] = jnp.where(s_app, 0, st["s_wakep"])
+            st["m_waitmask"] = jnp.where(m_app, jnp.uint32(0),
+                                         st["m_waitmask"])
+            st["s_waitmask"] = jnp.where(s_app, jnp.uint32(0),
+                                         st["s_waitmask"])
+            s_live = s_app & (st["s_exited"] == 0)
+            st["cont"] = jnp.where(m_app, C_M_STEP,
+                                   jnp.where(s_live, C_S_STEP,
+                                             st["cont"]))
+            return st
+
+        # -------- per-iteration dispatcher -----------------------
+
+        def micro_iter(carry):
+            st, window_end, iters = carry
+            # snapshot: each host advances ONE micro-op per iteration
+            # (a host another op just moved waits for the next one) —
+            # matching the engine's one-op-at-a-time per host order;
+            # order BETWEEN hosts is free (hosts are independent
+            # within a round, netplane.cpp run_hosts_mt).
+            cont0 = st["cont"]
+            st = op_relay(st, 1, cont0 == C_R1)
+            st = op_relay(st, 2, cont0 == C_R2)
+            st = op_step(st, cont0 == C_M_STEP, False)
+            st = op_step(st, cont0 == C_S_STEP, True)
+            st = op_stage2(st, (cont0 == C_M_RECV)
+                           | (cont0 == C_S_POST))
+            st = op_pop_event(st, cont0 == C_IDLE, window_end)
+            st = mark_abort(st, iters > (np.int64(1) << 22), AB_STRUCT)
+            return st, window_end, iters + 1
+
+        def micro_cond(carry):
+            st, window_end, iters = carry
+            ib_t, th_t = next_event_time(st)
+            due = jnp.minimum(ib_t, th_t) < window_end
+            busy = st["cont"] != C_IDLE
+            return (busy | due).any() & (st["abort_code"] == 0)
+
+        # -------- round end: propagation + inbox merge -----------
+
+        def propagate(st, window_end):
+            n = st["out_n"]
+            valid = jnp.arange(O) < n
+            src = st["out_src"]
+            dst = st["out_dst"]
+            node = st["_node"]
+            latency = st["_lat"][node[src], node[dst]]
+            reachable = latency < TIME_NEVER
+            bits, _ = threefry2x32_jax(
+                st["_k0"], st["_k1"], src.astype(jnp.uint32),
+                (st["out_pseq"] & 0xFFFFFFFF).astype(jnp.uint32))
+            thr_v = st["_thr"][node[src], node[dst]]
+            lossy = ((bits.astype(jnp.int64) < thr_v)
+                     & (st["out_t"] >= st["_bootstrap"]))
+            deliver = jnp.maximum(st["out_t"] + latency, window_end)
+            keep = valid & reachable & ~lossy
+            min_lat = jnp.min(jnp.where(keep, latency, I64_MAX))
+            st = dict(st)
+            for miss, rsn in ((valid & ~reachable, RSN_UNREACH),
+                              (valid & reachable & lossy, RSN_LOSS)):
+                st["app_pkts_dropped"] = st["app_pkts_dropped"].at[
+                    jnp.where(miss, src, OOB)].add(1, mode="drop")
+                if tracing:
+                    nt_ = st["tr_n"]
+                    rank = jnp.cumsum(miss) - 1
+                    slot = jnp.where(miss, nt_ + rank, TR + 8)
+                    for key, v in (
+                            ("tr_t", st["out_t"]),
+                            ("tr_kind", jnp.full(O, TR_DRP, jnp.int32)),
+                            ("tr_srchost", src),
+                            ("tr_pseq", st["out_pseq"]),
+                            ("tr_sip", st["out_sip"]),
+                            ("tr_sport", st["out_sport"]),
+                            ("tr_dip", st["out_dip"]),
+                            ("tr_dport", st["out_dport"]),
+                            ("tr_reason",
+                             jnp.full(O, rsn, jnp.int32)),
+                            ("tr_owner", src)):
+                        st[key] = st[key].at[slot].set(v, mode="drop")
+                    tot = nt_ + miss.sum()
+                    st["tr_n"] = tot
+                    st = mark_abort(st, tot > TR - O, AB_TRACE)
+                    st = dict(st)
+
+            # scatter kept packets into destination inboxes: compact
+            # the un-consumed remainder, append arrivals per dst, then
+            # re-sort each row by (time, src, seq) — the inbox heap's
+            # total order.
+            rem = (st["ib_len"] - st["ib_pos"]).astype(jnp.int32)
+            shift = jnp.minimum(
+                st["ib_pos"][:, None] + jnp.arange(I)[None, :], I - 1)
+            live = jnp.arange(I)[None, :] < rem[:, None]
+
+            def compact(a, fill):
+                return jnp.where(live,
+                                 jnp.take_along_axis(a, shift, axis=1),
+                                 fill)
+
+            ib_time = compact(st["ib_time"], I64_MAX)
+            ib_src = compact(st["ib_src"], 0)
+            ib_seq = compact(st["ib_seq"], I64_MAX)
+            ib_pk = {kk: compact(st[f"ib_{kk}"], 0) for kk in PK_KEYS}
+            # stable per-destination rank in outbox order
+            seg = jnp.where(keep, dst, H)
+            order = jnp.argsort(seg.astype(jnp.int64) * (O + 1)
+                                + jnp.arange(O))
+            sseg = seg[order]
+            rank0 = jnp.arange(O) - jnp.searchsorted(sseg, sseg,
+                                                     side="left")
+            rank = jnp.zeros(O, jnp.int32).at[order].set(
+                rank0.astype(jnp.int32))
+            slot = rem[jnp.minimum(seg, H - 1)] + rank
+            ok_slot = keep & (slot < I - 1)
+            st = mark_abort(st, (keep & (slot >= I - 1)).any(),
+                            AB_STRUCT)
+            st = dict(st)
+            rows = jnp.where(ok_slot, dst, OOB)
+            new = {"srchost": src, "pseq": st["out_pseq"],
+                   "sip": st["out_sip"], "sport": st["out_sport"],
+                   "dip": st["out_dip"], "dport": st["out_dport"]}
+            ib_time = ib_time.at[rows, slot].set(deliver, mode="drop")
+            ib_src = ib_src.at[rows, slot].set(src, mode="drop")
+            ib_seq = ib_seq.at[rows, slot].set(st["out_seq"],
+                                               mode="drop")
+            for kk in PK_KEYS:
+                ib_pk[kk] = ib_pk[kk].at[rows, slot].set(new[kk],
+                                                         mode="drop")
+            add = jnp.zeros(H, jnp.int32).at[rows].add(1, mode="drop")
+            sort_idx = jnp.lexsort((ib_seq, ib_src, ib_time), axis=1)
+            take = jnp.take_along_axis
+            st["ib_time"] = take(ib_time, sort_idx, axis=1)
+            st["ib_src"] = take(ib_src, sort_idx, axis=1)
+            st["ib_seq"] = take(ib_seq, sort_idx, axis=1)
+            for kk in PK_KEYS:
+                st[f"ib_{kk}"] = take(ib_pk[kk], sort_idx, axis=1)
+            st["ib_pos"] = jnp.zeros(H, jnp.int32)
+            st["ib_len"] = rem + add
+            st["out_n"] = jnp.int64(0)
+            return st, n, min_lat
+
+        # -------- the multi-round while loop ---------------------
+
+        def round_cond(carry):
+            (st, start, runahead, rounds, busy_rounds, packets,
+             busy_end, stop, limit, max_rounds) = carry
+            return ((rounds < max_rounds) & (start < limit)
+                    & (start < stop) & (st["abort_code"] == 0))
+
+        def round_body(carry):
+            (st, start, runahead, rounds, busy_rounds, packets,
+             busy_end, stop, limit, max_rounds) = carry
+            window_end = jnp.minimum(start + runahead, stop)
+            st, _we, _it = jax.lax.while_loop(
+                micro_cond, micro_iter,
+                (st, window_end, jnp.int64(0)))
+            st, n_out, min_lat = propagate(st, window_end)
+            runahead = jnp.where(
+                (min_lat > 0) & (min_lat < runahead), min_lat,
+                runahead)
+            ib_t, th_t = next_event_time(st)
+            start = jnp.minimum(ib_t, th_t).min()
+            return (st, start, runahead, rounds + 1,
+                    busy_rounds + (n_out > 0).astype(jnp.int64),
+                    packets + n_out, window_end, stop, limit,
+                    max_rounds)
+
+        @jax.jit
+        def run(st, lat, thr, node, ips_sorted, ips_perm, k0, k1,
+                bootstrap_end, start, stop, limit, runahead,
+                max_rounds):
+            st = dict(st)
+            st["_lat"] = lat
+            st["_thr"] = thr
+            st["_node"] = node
+            st["_ips_sorted"] = ips_sorted
+            st["_ips_perm"] = ips_perm
+            st["_k0"] = k0
+            st["_k1"] = k1
+            st["_bootstrap"] = bootstrap_end
+            st["abort_code"] = jnp.int32(0)
+            st["out_n"] = jnp.int64(0)
+            for k, dt in (("out_src", jnp.int32), ("out_dst", jnp.int32),
+                          ("out_seq", jnp.int64),
+                          ("out_pseq", jnp.int64),
+                          ("out_sip", jnp.uint32),
+                          ("out_sport", jnp.int32),
+                          ("out_dip", jnp.uint32),
+                          ("out_dport", jnp.int32),
+                          ("out_t", jnp.int64)):
+                st[k] = jnp.zeros(O, dt)
+            if tracing:
+                st["tr_n"] = jnp.int64(0)
+                for k, dt in (("tr_t", jnp.int64),
+                              ("tr_kind", jnp.int32),
+                              ("tr_srchost", jnp.int32),
+                              ("tr_pseq", jnp.int64),
+                              ("tr_sip", jnp.uint32),
+                              ("tr_sport", jnp.int32),
+                              ("tr_dip", jnp.uint32),
+                              ("tr_dport", jnp.int32),
+                              ("tr_reason", jnp.int32),
+                              ("tr_owner", jnp.int32)):
+                    st[k] = jnp.zeros(TR, dt)
+            # AQM-active CoDel state is outside the modelled domain
+            st = mark_abort(st, (st["codel_dropping"] == 1).any()
+                            | (st["codel_first_above"] != 0).any(),
+                            AB_STRUCT)
+            carry = (st, jnp.int64(start), jnp.int64(runahead),
+                     jnp.int64(0), jnp.int64(0), jnp.int64(0),
+                     jnp.int64(start), jnp.int64(stop),
+                     jnp.int64(limit), jnp.int64(max_rounds))
+            (st, start, runahead, rounds, busy_rounds, packets,
+             busy_end, _s, _l, _m) = jax.lax.while_loop(
+                round_cond, round_body, carry)
+            # Only mutated columns go back over the device link: the
+            # routing tables, peer lists, and static socket/app config
+            # are inputs the host already has.
+            drop = {"peers", "n_peers", "m_port", "m_mean", "s_count",
+                    "eth_ip", "recv_max", "send_max", "cont", "then",
+                    "park_ctr", "r1_refill", "r1_cap", "r1_unlimited",
+                    "r2_refill", "r2_cap", "r2_unlimited"}
+            st = {k: v for k, v in st.items()
+                  if not k.startswith("_") and k not in drop}
+            return (st, start, runahead, rounds, busy_rounds, packets,
+                    busy_end)
+
+        return run
+
+    # ------------------------------------------------------------------
+    # Driver
+    # ------------------------------------------------------------------
+
+    def try_span(self, start: int, stop: int, limit: int,
+                 runahead: int, dynamic: bool,
+                 max_rounds: int | None = None):
+        """Export -> device span -> import.  Returns (rounds,
+        busy_rounds, packets, next_start, busy_end, runahead) or None
+        when ineligible / zero-progress / aborted."""
+        d = self.engine.span_export_phold(
+            self.CAP_I, self.CAP_T, self.CAP_R, self.CAP_S,
+            self.CAP_C, self.CAP_P)
+        if d is None:
+            # structurally not a phold sim — permanent for this run
+            self.ineligible += 1
+            return None
+        if isinstance(d, int):
+            # transiently beyond the ring caps (burst): retry later
+            self.over_caps += 1
+            return None
+        st = self._to_arrays(d)
+        if self._fn is None:
+            self._fn = self._cached_build(st["peers"].shape[1])
+        mr = self.MAX_ROUNDS if max_rounds is None else max_rounds
+        for _grow in range(4):
+            out = self._fn(
+                st, self._lat, self._thr, self._node,
+                self._ips_sorted, self._ips_perm,
+                np.uint32(self._k[0]), np.uint32(self._k[1]),
+                np.int64(self.bootstrap_end), start, stop, limit,
+                runahead, mr)
+            (st_out, next_start, ra, rounds, busy_rounds, packets,
+             busy_end) = out
+            st_np = {k: np.asarray(v) for k, v in st_out.items()}
+            code = int(st_np["abort_code"])
+            if code == 0:
+                break
+            if code & AB_STRUCT:
+                self.aborts += 1
+                return None
+            # Trace/outbox overflow: a capacity problem, not a domain
+            # problem — grow the buffer and re-run the span (the input
+            # state was never mutated; export is read-only).
+            if code & AB_TRACE:
+                self.cap_tr *= 4
+            if code & AB_OUT:
+                self.cap_out *= 4
+            self._fn = self._cached_build(st["peers"].shape[1])
+        else:
+            self.aborts += 1
+            return None
+        if int(rounds) == 0:
+            return None
+        traces = None
+        if self.tracing:
+            n = int(st_np["tr_n"])
+            traces = {
+                "n": n,
+                "t": st_np["tr_t"][:n].astype(np.int64).tobytes(),
+                "kind": st_np["tr_kind"][:n].astype(
+                    np.uint8).tobytes(),
+                "srchost": st_np["tr_srchost"][:n].astype(
+                    np.int32).tobytes(),
+                "pseq": st_np["tr_pseq"][:n].astype(
+                    np.int64).tobytes(),
+                "sip": st_np["tr_sip"][:n].astype(
+                    np.uint32).tobytes(),
+                "sport": st_np["tr_sport"][:n].astype(
+                    np.int32).tobytes(),
+                "dip": st_np["tr_dip"][:n].astype(np.uint32).tobytes(),
+                "dport": st_np["tr_dport"][:n].astype(
+                    np.int32).tobytes(),
+                "size": np.full(n, PAYLOAD_LEN, np.int64).tobytes(),
+                "reason": st_np["tr_reason"][:n].astype(
+                    np.uint8).tobytes(),
+                "owner": st_np["tr_owner"][:n].astype(
+                    np.int32).tobytes(),
+            }
+        back = self._from_arrays(st_np)
+        self.engine.span_import_phold(
+            back, self.CAP_I, self.CAP_T, self.CAP_R, self.CAP_S,
+            self.CAP_C, self.CAP_P, traces)
+        self.last_was_cold = not self.compiled
+        self.compiled = True
+        self.spans += 1
+        self.rounds += int(rounds)
+        ra_out = int(ra) if dynamic else runahead
+        return (int(rounds), int(busy_rounds), int(packets),
+                int(next_start), int(busy_end), ra_out)

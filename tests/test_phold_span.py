@@ -1,0 +1,145 @@
+"""Device-resident multi-round loop gates (ops/phold_span.py).
+
+The twin contract (SURVEY.md:19-23, VERDICT r4 missing #1/#2): for
+PHOLD-pure sims, whole conservative windows step on the accelerator as
+struct-of-arrays — and the result must be byte-identical to the serial
+object path in packet traces, syscall histograms, and every counter.
+The gates force the device path (`tpu_device_spans: force`) and assert
+the spans actually ran (a silent fallback to the C++ span would pass
+trace identity without testing the device model).
+"""
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+
+
+def phold_cfg(scheduler: str, n_hosts: int = 8, n_init: int = 3,
+              mean: str = "20000000", bw: str = "1 Gbit",
+              loss: float = 0.0, stop: str = "2s", seed: int = 13,
+              device_spans: str | None = None):
+    names = [f"lp{i:03d}" for i in range(n_hosts)]
+    hosts = {}
+    for i, name in enumerate(names):
+        peers = [p for p in names if p != name]
+        hosts[name] = {
+            "network_node_id": 0,
+            "processes": [{
+                "path": "phold",
+                "args": ["7000", str(i), str(n_init), mean] + peers,
+                "start_time": "100ms",
+                "expected_final_state": "running",
+            }],
+        }
+    loss_s = f" packet_loss {loss}" if loss else ""
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": f"""
+graph [ node [ id 0 host_bandwidth_down "{bw}" host_bandwidth_up "{bw}" ]
+  edge [ source 0 target 0 latency "5 ms"{loss_s} ] ]"""}},
+        "experimental": {"scheduler": scheduler},
+        "hosts": hosts})
+    if device_spans is not None:
+        cfg.experimental.tpu_device_spans = device_spans
+    return cfg
+
+
+def _hist(m):
+    out = {}
+    for h in m.hosts:
+        h.merge_native_counters()
+        for k, v in h.syscall_counts.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _counters(s):
+    return (s.events, s.packets_sent, s.packets_recv,
+            s.packets_dropped, s.syscalls)
+
+
+def test_phold_device_span_byte_identical():
+    """The headline twin gate: serial object path vs forced device
+    spans — traces, histograms, and counters identical, with >=50% of
+    rounds actually stepped on the device."""
+    m_ser, s_ser = run_simulation(phold_cfg("serial"))
+    m_dev, s_dev = run_simulation(phold_cfg("tpu",
+                                            device_spans="force"))
+    assert s_ser.ok and s_dev.ok
+    r = m_dev._dev_span
+    assert r is not None and r.spans > 0, "device span never ran"
+    assert r.aborts == 0, "device span aborted (fell back silently)"
+    assert r.rounds * 2 >= s_dev.rounds, \
+        f"only {r.rounds}/{s_dev.rounds} rounds on device"
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    assert _hist(m_ser) == _hist(m_dev)
+    assert _counters(s_ser) == _counters(s_dev)
+
+
+def test_phold_device_span_lossy():
+    """Propagation drops (threefry loss draws) decided on device are
+    trace-identical, including the drop breadcrumbs."""
+    kw = dict(n_hosts=8, loss=0.05, stop="3s")
+    m_ser, s_ser = run_simulation(phold_cfg("serial", **kw))
+    m_dev, s_dev = run_simulation(phold_cfg("tpu", device_spans="force",
+                                            **kw))
+    assert s_dev.packets_dropped == s_ser.packets_dropped > 0
+    r = m_dev._dev_span
+    assert r.spans > 0 and r.aborts == 0
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    assert _hist(m_ser) == _hist(m_dev)
+
+
+def test_phold_device_span_token_bucket_throttled():
+    """Tiny bandwidth forces token-bucket parks and TK_RELAY wakeup
+    draws inside the device loop; the event-seq streams must still
+    match the engine exactly."""
+    kw = dict(n_hosts=6, n_init=8, mean="100000", bw="200 Kbit",
+              stop="1s")
+    m_ser, s_ser = run_simulation(phold_cfg("serial", **kw))
+    m_dev, s_dev = run_simulation(phold_cfg("tpu", device_spans="force",
+                                            **kw))
+    assert s_ser.packets_sent == s_dev.packets_sent > 2000
+    r = m_dev._dev_span
+    assert r.spans > 0 and r.aborts == 0
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    assert _hist(m_ser) == _hist(m_dev)
+
+
+def test_phold_device_span_burst_with_loss():
+    """Bursty seeding + moderate bandwidth + loss: recv-queue backlogs,
+    relay pending chains, and loss draws together."""
+    kw = dict(n_hosts=10, n_init=12, mean="1000000", bw="10 Mbit",
+              loss=0.01, stop="2s")
+    m_ser, s_ser = run_simulation(phold_cfg("serial", **kw))
+    m_dev, s_dev = run_simulation(phold_cfg("tpu", device_spans="force",
+                                            **kw))
+    assert s_ser.packets_sent == s_dev.packets_sent
+    r = m_dev._dev_span
+    assert r.spans > 0 and r.aborts == 0
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    assert _hist(m_ser) == _hist(m_dev)
+
+
+def test_non_phold_sim_disables_device_spans_cleanly():
+    """A tgen (TCP) sim under scheduler=tpu with device spans forced:
+    the exporter reports ineligible once and the sim completes on the
+    C++ span path with correct results."""
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "2s", "seed": 5},
+        "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "5 ms" ] ]"""}},
+        "experimental": {"scheduler": "tpu",
+                         "tpu_device_spans": "force"},
+        "hosts": {
+            "srv": {"network_node_id": 0, "processes": [{
+                "path": "tgen-server", "args": ["80"],
+                "expected_final_state": "running"}]},
+            "cli": {"network_node_id": 0, "processes": [{
+                "path": "tgen-client", "args": ["srv", "80", "30000"],
+                "start_time": "100ms",
+                "expected_final_state": "any"}]},
+        }})
+    m, s = run_simulation(cfg)
+    assert s.ok
+    assert m._dev_span is None or m._dev_span.spans == 0
