@@ -225,6 +225,83 @@ def run_best(build, scheduler: str, trials: int = 2,
     return best_summary, best_wall
 
 
+def phold_rung() -> None:
+    """PHOLD-1k with the device-resident multi-round loop forced: the
+    classic PDES benchmark stepping whole windows on the accelerator
+    (ops/phold_span.py), device-round share reported.  Also prints the
+    C++-span comparator (scheduler=tpu default)."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import Manager
+    from shadow_tpu.tools.netgen import phold_yaml
+
+    def run(device_spans=None):
+        text = phold_yaml(1000, n_init=2, mean_delay_ns=20_000_000,
+                          stop_time="0.5s", seed=13, scheduler="tpu",
+                          device_spans=device_spans)
+        manager = Manager(ConfigOptions.from_yaml_text(text))
+        for h in manager.hosts:
+            h.set_tracing(False)
+        t0 = time.perf_counter()
+        summary = manager.run()
+        return manager, summary, time.perf_counter() - t0
+
+    _m, s_cpp, w_cpp = run()
+    m_dev, s_dev, w_dev = run("force")
+    r = m_dev._dev_span
+    msgs = s_dev.packets_sent
+    share = 100.0 * r.rounds / max(s_dev.rounds, 1)
+    print(f"bench[phold-1k]: {msgs} messages; device multi-round "
+          f"{r.rounds}/{s_dev.rounds} rounds on device ({share:.0f}%, "
+          f"{r.spans} dispatches, aborts {r.aborts}) in {w_dev:.1f}s; "
+          f"C++ span path {s_cpp.packets_sent} msgs in {w_cpp:.1f}s "
+          f"({s_cpp.packets_sent / max(w_cpp, 1e-9):.0f} msgs/s)",
+          file=sys.stderr)
+
+
+def sharded_rung_subprocess() -> None:
+    """10k-host sharded rung on a virtual 8-device CPU mesh, run in a
+    SUBPROCESS so the parent's real single-chip backend is untouched
+    (a process can only initialize one platform)."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sharded-10k"],
+            env=env, capture_output=True, text=True, timeout=1800)
+    except subprocess.TimeoutExpired:
+        print("bench[10k-sharded-virtual]: timed out (1800s)",
+              file=sys.stderr)
+        return
+    out = (proc.stderr or "").strip().splitlines()
+    for line in reversed(out):
+        if "bench[10k-sharded" in line and "sim-s/wall-s" in line:
+            print(line, file=sys.stderr)
+            return
+    print(f"bench[10k-sharded-virtual]: failed "
+          f"(exit {proc.returncode}): {out[-1] if out else ''}",
+          file=sys.stderr)
+
+
+def sharded_10k_main() -> None:
+    """--sharded-10k entry (subprocess): run the 10k workload with
+    tpu_shards=8 on whatever 8-device backend this process has."""
+    import jax
+    n = len(jax.devices())
+    sh_summary, sh_wall = run_once(
+        lambda s: config_10k(s, tpu_shards=min(8, n)), "tpu",
+        report_routes="10k-sharded")
+    kind = ("real" if jax.devices()[0].platform != "cpu"
+            else "virtual-8-cpu")
+    print(f"bench[10k-sharded]: {sh_summary.packets_sent} packets, "
+          f"{sh_summary.busy_end_ns / 1e9 / sh_wall:.3f} sim-s/wall-s "
+          f"({sh_wall:.1f}s wall, tpu_shards=8, {kind} devices)",
+          file=sys.stderr)
+
+
 def main() -> None:
     if not tpu_available():
         # 8 virtual CPU devices so the sharded rung below can run even
@@ -316,15 +393,15 @@ def main() -> None:
     # gated in tests/ and was verified at this scale by SHA-256).
     import jax
     if len(jax.devices()) >= 8:
-        sh_summary, sh_wall = run_once(
-            lambda s: config_10k(s, tpu_shards=8), "tpu",
-            report_routes="10k-sharded")
-        print(f"bench[10k-sharded]: {sh_summary.packets_sent} packets, "
-              f"{sh_summary.busy_end_ns / 1e9 / sh_wall:.3f} sim-s/wall-s "
-              f"({sh_wall:.1f}s wall, tpu_shards=8)", file=sys.stderr)
+        sharded_10k_main()
     else:
-        print(f"bench[10k-sharded]: skipped (needs 8 devices, have "
-              f"{len(jax.devices())})", file=sys.stderr)
+        # Standing sharded-perf artifact (VERDICT r4 #7): with fewer
+        # than 8 real devices the rung still runs — on a virtual
+        # 8-device CPU mesh in a subprocess.
+        sharded_rung_subprocess()
+
+    # PHOLD multi-round rung (VERDICT r4 #2).
+    phold_rung()
 
     assert tpu_summary.packets_sent == base_summary.packets_sent, \
         "schedulers disagreed on workload size"
@@ -359,4 +436,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--sharded-10k" in sys.argv:
+        from shadow_tpu.utils.platform import honor_platform_env
+        honor_platform_env()
+        sharded_10k_main()
+    else:
+        main()

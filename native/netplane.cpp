@@ -3258,7 +3258,6 @@ struct Engine {
     std::vector<int64_t> pseq;
     std::vector<uint32_t> sip, dip;
     std::vector<int32_t> sport, dport;
-    std::vector<int64_t> size;
     void push(const PacketN *p) {
       src_host.push_back(p->src_host);
       pseq.push_back((int64_t)p->seq);
@@ -3266,7 +3265,6 @@ struct Engine {
       dip.push_back(p->dst_ip);
       sport.push_back(p->src_port);
       dport.push_back(p->dst_port);
-      size.push_back(p->total_size());
     }
     void push_empty() {
       src_host.push_back(0);
@@ -3275,7 +3273,6 @@ struct Engine {
       dip.push_back(0);
       sport.push_back(0);
       dport.push_back(0);
-      size.push_back(0);
     }
   };
 
@@ -4309,7 +4306,6 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     put((p + "_sport").c_str(), bytes_vec(c.sport));
     put((p + "_dip").c_str(), bytes_vec(c.dip));
     put((p + "_dport").c_str(), bytes_vec(c.dport));
-    put((p + "_size").c_str(), bytes_vec(c.size));
   };
   put("rq_len", bytes_vec(rq_len));
   put_pk("rq", rq);
@@ -4432,7 +4428,6 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     const int64_t *pseq;
     const uint32_t *sip, *dip;
     const int32_t *sport, *dport;
-    const int64_t *size;
   };
   auto get_pk = [&](const char *prefix, size_t n) {
     std::string p(prefix);
@@ -4443,7 +4438,6 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     c.sport = col<int32_t>(d, (p + "_sport").c_str(), n, &ok);
     c.dip = col<uint32_t>(d, (p + "_dip").c_str(), n, &ok);
     c.dport = col<int32_t>(d, (p + "_dport").c_str(), n, &ok);
-    c.size = col<int64_t>(d, (p + "_size").c_str(), n, &ok);
     return c;
   };
   Pk rq = get_pk("rq", H * R), sq = get_pk("sq", H * S),

@@ -81,6 +81,11 @@ class Runahead:
             self._value = int(value_ns)
 
 
+# Sentinel: a device span that legitimately made no progress (window
+# boundary), distinct from a failed/aborted one.
+ZERO_PROGRESS = object()
+
+
 class Manager:
     def __init__(self, config: ConfigOptions):
         from shadow_tpu.utils import object_counter
@@ -598,7 +603,9 @@ class Manager:
         # always takes the device (parity gates), "off" disables.
         dev_mode = self.config.experimental.tpu_device_spans
         dev_span_on = span_ok and dev_mode in ("auto", "force", "on")
-        self._dev_span = None
+        # A caller may pre-seed a runner (e.g. the multichip dryrun
+        # injects one with a device mesh attached) — keep it.
+        self._dev_span = getattr(self, "_dev_span", None)
         dev_ns_round = None   # EWMA wall ns/round, device spans
         cpp_ns_round = None   # EWMA wall ns/round, C++ spans
         dev_probe_countdown = 0
@@ -667,7 +674,12 @@ class Manager:
                     t0 = time.perf_counter_ns()
                     res = self._device_span(start, stop, limit,
                                             max_rounds)
-                    if res is not None:
+                    if res is not None and res[0] == 0:
+                        # Zero progress (e.g. heartbeat boundary due
+                        # now): benign — the C++/per-round path below
+                        # handles the boundary.  Not a failure.
+                        res = ZERO_PROGRESS
+                    if res is not None and res is not ZERO_PROGRESS:
                         dev_aborts_row = 0
                         if self._dev_span.last_was_cold:
                             # Compile-tainted wall: discard the sample
@@ -681,10 +693,10 @@ class Manager:
                             dev_probe_countdown = 16
                         start = account_span(res)
                         continue
-                    if self._dev_span is None \
-                            or self._dev_span.ineligible:
+                    if res is None and (self._dev_span is None
+                                        or self._dev_span.ineligible):
                         dev_span_on = False  # not a phold-shaped sim
-                    else:
+                    elif res is None:
                         # abort or transient over-caps: back off, and
                         # give up only after repeated failures
                         dev_aborts_row += 1

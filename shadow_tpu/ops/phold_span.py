@@ -132,6 +132,12 @@ class PholdSpanRunner:
         # wall time must not poison the auto-router's estimate.
         self.compiled = False
         self.last_was_cold = False
+        # Optional jax.sharding.Mesh with a "hosts" axis: state shards
+        # over it (H-major arrays -> PartitionSpec("hosts"), the rest
+        # replicated) and GSPMD partitions the whole multi-round loop —
+        # XLA inserts the cross-shard collectives for the inbox
+        # scatter.  Requires H % mesh size == 0.
+        self.mesh = None
 
     # ------------------------------------------------------------------
     # Export bytes <-> numpy state
@@ -239,8 +245,6 @@ class PholdSpanRunner:
                 a = np.take_along_axis(npv(kk), idx, axis=1)
                 out[kk] = np.ascontiguousarray(a).tobytes()
             out[len_k] = (ln - pos).astype(np.int32).tobytes()
-            out[f"{pfx}_size"] = np.full((H, cap), PKT_SIZE,
-                                         np.int64).tobytes()
 
         ring("rq", self.CAP_R, "rq_pos", "rq_len", True)
         ring("sq", self.CAP_S, "sq_pos", "sq_len", True)
@@ -290,8 +294,7 @@ class PholdSpanRunner:
             for kk in PK_KEYS:
                 out[f"r{r}_pk_{kk}"] = np.ascontiguousarray(
                     npv(f"r{r}_pk_{kk}")).tobytes()
-            out[f"r{r}_pk_size"] = np.full(H, PKT_SIZE,
-                                           np.int64).tobytes()
+
         out["app_sys"] = npv("app_sys").astype(np.int64).tobytes()
         return out
 
@@ -1069,6 +1072,16 @@ class PholdSpanRunner:
         st = self._to_arrays(d)
         if self._fn is None:
             self._fn = self._cached_build(st["peers"].shape[1])
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            shard = NamedSharding(self.mesh, PartitionSpec("hosts"))
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            H = self._H
+            st = {k: jax.device_put(
+                      v, shard if (getattr(v, "ndim", 0) >= 1
+                                   and v.shape[0] == H) else repl)
+                  for k, v in st.items()}
         mr = self.MAX_ROUNDS if max_rounds is None else max_rounds
         for _grow in range(4):
             out = self._fn(
@@ -1098,7 +1111,10 @@ class PholdSpanRunner:
             self.aborts += 1
             return None
         if int(rounds) == 0:
-            return None
+            # Legitimate zero progress (start at/past the limit
+            # boundary): nothing changed, nothing to import — NOT a
+            # failure.  Callers distinguish this from None.
+            return (0, 0, 0, int(start), int(start), int(runahead))
         traces = None
         if self.tracing:
             n = int(st_np["tr_n"])

@@ -130,6 +130,35 @@ def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
             f"hosts:\n" + "\n".join(host_blocks) + "\n")
 
 
+def phold_yaml(n_hosts: int, n_init: int = 3,
+               mean_delay_ns: int = 20_000_000, stop_time: str = "2s",
+               seed: int = 13, scheduler: str = "serial",
+               device_spans: str | None = None,
+               bandwidth: str = "1 Gbit", latency: str = "5 ms") -> str:
+    """Classic PHOLD (ref: src/test/phold): every host one LP bouncing
+    messages to pseudo-random peers after pseudo-exponential holds."""
+    names = [f"lp{i:04d}" for i in range(n_hosts)]
+    blocks = []
+    for i, name in enumerate(names):
+        peers = " ".join(p for p in names if p != name)
+        blocks.append(
+            f"  {name}:\n    network_node_id: 0\n    processes:\n"
+            f'      - {{ path: phold, args: "7000 {i} {n_init} '
+            f'{mean_delay_ns} {peers}", start_time: 100ms, '
+            f"expected_final_state: running }}")
+    exp = [f"  scheduler: {scheduler}"]
+    if device_spans is not None:
+        exp.append(f"  tpu_device_spans: {device_spans}")
+    gml = (f'graph [ node [ id 0 host_bandwidth_down "{bandwidth}" '
+           f'host_bandwidth_up "{bandwidth}" ] '
+           f'edge [ source 0 target 0 latency "{latency}" ] ]')
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+            f"network:\n  graph:\n    type: gml\n    inline: |\n"
+            f"{_indent(gml, '      ')}\n"
+            f"experimental:\n" + "\n".join(exp) + "\n"
+            f"hosts:\n" + "\n".join(blocks) + "\n")
+
+
 def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
                    nbytes: int = 100_000, count: int = 1,
                    stop_time: str = "60s", seed: int = 1,
