@@ -210,6 +210,10 @@ struct TcpHdrN {
   int32_t mss = -1;     // -1 = option absent
   SackBlock sacks[MAX_SACK_BLOCKS];
   int n_sacks = 0;
+  /* RFC 7323 timestamps (ref legacy tcp.c:141-142): ts_val = sender's
+   * clock at emission; ts_ecr = echo of the last ts_val received
+   * (0 = absent). */
+  int64_t ts_val = 0, ts_ecr = 0;
 };
 
 struct PacketN {
@@ -427,10 +431,12 @@ struct TcpConn {
   bool in_fast_recovery = false;
   uint32_t recover;
 
+  /* RTT via RFC 7323 timestamps (connection.py twin): every acked
+   * segment samples, suppressed during RTO backoff (Karn). */
   int64_t srtt = 0, rttvar = 0, rto = INIT_RTO_NS;
   int64_t rto_deadline = -1, time_wait_deadline = -1;
-  int64_t timed_end_seq = -1;  // -1 = none, else u32 seq
-  int64_t timed_sent_at = 0;
+  int64_t ts_recent = 0;  // last timestamp value received
+  int rto_backoff = 0;    // doublings since last forward progress
 
   std::deque<OutSeg> outbox;
   std::string error;  // empty = none
@@ -587,6 +593,7 @@ struct TcpConn {
      * retransmit from the head (connection.py twin). */
     for (auto &seg : rtx) seg.sacked = false;
     rto = std::min(rto * 2, MAX_RTO_NS);
+    rto_backoff++;  // suppress RTT sampling until forward progress
     retransmit_one(now);
     rto_deadline = now + rto;
   }
@@ -597,6 +604,20 @@ struct TcpConn {
     segments_received++;
     if (state == ST_CLOSED) return;
     if (hdr.flags & F_RST) { on_rst(); return; }
+    /* RFC 7323 timestamp processing on EVERY segment (ref
+     * tcp.c:2356-2358 + the RFC's TS.Recent update rule: only a
+     * segment covering the last ack point may update the echo value,
+     * so a late old duplicate cannot wind it back and poison srtt).
+     * Values are stamped now+1 (0 = option absent). */
+    if (hdr.ts_val) {
+      int64_t span = std::max((int64_t)payload.size(), (int64_t)1) +
+                     ((hdr.flags & F_FIN) ? 1 : 0);
+      if (seq_leq(hdr.seq, rcv_nxt) &&
+          seq_lt(rcv_nxt, seq_add(hdr.seq, span)))
+        ts_recent = hdr.ts_val;
+    }
+    if (hdr.ts_ecr && rto_backoff == 0)
+      update_rtt(now - (hdr.ts_ecr - 1));
     if (state == ST_LISTEN) return;
     if (state == ST_SYN_SENT) { on_packet_syn_sent(hdr, now); return; }
     if (hdr.flags & F_SYN) {
@@ -608,7 +629,7 @@ struct TcpConn {
          * connection.py's handling). */
         snd_una = hdr.ack;
         snd_wnd = hdr.window;
-        clear_acked(now);
+        clear_acked();
         state = ST_ESTABLISHED;
         emit_ack(now);
         push_data(now);
@@ -670,7 +691,7 @@ struct TcpConn {
       snd_una = hdr.ack;
       snd_wnd = hdr.window;
       negotiate_options(hdr);
-      clear_acked(now);
+      clear_acked();
       state = ST_ESTABLISHED;
       emit_ack(now);
     } else if (hdr.flags & F_SYN) {
@@ -720,10 +741,9 @@ struct TcpConn {
     int64_t acked = seq_sub(ack, snd_una);
     snd_una = ack;
     dupacks = 0;
-    int64_t sample = clear_acked(now);
-    if (sample >= 0) {
-      update_rtt(sample);
-    } else if (srtt > 0) {
+    clear_acked();
+    rto_backoff = 0;  // forward progress re-enables sampling
+    if (srtt > 0) {
       rto = std::min(std::max(srtt + std::max(4 * rttvar, (int64_t)1000000),
                               MIN_RTO_NS), MAX_RTO_NS);
     }
@@ -782,22 +802,16 @@ struct TcpConn {
     seg->sent_at = now;
     seg->retransmitted = true;
     retransmit_count++;
-    transmit_segment(seg->seq, seg->payload, seg->is_fin);
+    transmit_segment(seg->seq, seg->payload, seg->is_fin, now);
   }
 
-  /* returns RTT sample ns, or -1 when Karn yields none */
-  int64_t clear_acked(int64_t now) {
+  /* drop fully-acked rtx entries (RTT comes from timestamp echoes) */
+  void clear_acked() {
     while (!rtx.empty()) {
       uint32_t end = seg_end(rtx.front());
       if (seq_leq(end, snd_una)) rtx.pop_front();
       else break;
     }
-    if (timed_end_seq >= 0 && seq_leq((uint32_t)timed_end_seq, snd_una)) {
-      int64_t sample = now - timed_sent_at;
-      timed_end_seq = -1;
-      return sample;
-    }
-    return -1;
   }
 
   void update_rtt(int64_t sample) {
@@ -984,9 +998,16 @@ struct TcpConn {
     }
   }
 
+  int64_t take_ts_echo() {
+    /* one echo per received value — an outdated echo is never resent
+     * (ref tcp.c:2433-2434) */
+    int64_t t = ts_recent;
+    ts_recent = 0;
+    return t;
+  }
+
   void transmit_segment(uint32_t seq, const std::string &payload,
-                        bool is_fin) {
-    timed_end_seq = -1;  // Karn
+                        bool is_fin, int64_t now) {
     int flags = F_ACK;
     int mss_opt = -1, ws_opt = -1;
     if (is_fin) {
@@ -1010,6 +1031,8 @@ struct TcpConn {
     seg.hdr.mss = mss_opt;
     seg.hdr.wscale = ws_opt;
     sack_blocks(seg.hdr);
+    seg.hdr.ts_val = now + 1;
+    seg.hdr.ts_ecr = take_ts_echo();
     seg.payload = payload;
     if (dbg)
       fprintf(stderr, "[ENG xmit] flags=%d seq=%u len=%zu\n",
@@ -1029,6 +1052,8 @@ struct TcpConn {
     seg.hdr.window = wire_window(flags);
     seg.hdr.mss = mss_opt;
     seg.hdr.wscale = ws_opt;
+    seg.hdr.ts_val = now + 1;
+    seg.hdr.ts_ecr = take_ts_echo();
     seg.payload = payload;
     outbox.push_back(std::move(seg));
     segments_sent++;
@@ -1039,12 +1064,6 @@ struct TcpConn {
     if (track) {
       rtx.push_back({seq, payload, is_fin, now, false, false});
       if (rto_deadline < 0) rto_deadline = now + rto;
-      if (timed_end_seq < 0) {
-        timed_end_seq = seq_add(seq, (int64_t)payload.size() +
-                                          (is_fin ? 1 : 0) +
-                                          (payload.empty() && !is_fin ? 1 : 0));
-        timed_sent_at = now;
-      }
     }
   }
 
@@ -1054,7 +1073,6 @@ struct TcpConn {
   }
 
   void emit_ack(int64_t now) {
-    (void)now;
     if (dbg)
       fprintf(stderr, "[ENG emitack] now=%lld rcv_nxt=%u win=%lld\n",
               (long long)now, rcv_nxt, (long long)recv_window());
@@ -1064,6 +1082,8 @@ struct TcpConn {
     seg.hdr.flags = F_ACK;
     seg.hdr.window = wire_window(F_ACK);
     sack_blocks(seg.hdr);
+    seg.hdr.ts_val = now + 1;
+    seg.hdr.ts_ecr = take_ts_echo();
     outbox.push_back(std::move(seg));
     segments_sent++;
     note_ack_sent();
@@ -5412,9 +5432,11 @@ static PyObject *eng_packet_fields(EngineObj *self, PyObject *args) {
       PyTuple_SET_ITEM(sacks, i,
                        Py_BuildValue("II", p->tcp.sacks[i].start,
                                      p->tcp.sacks[i].end));
-    tcp = Py_BuildValue("IIiLiiN", p->tcp.seq, p->tcp.ack, p->tcp.flags,
-                        (long long)p->tcp.window, (int)p->tcp.wscale,
-                        (int)p->tcp.mss, sacks);
+    tcp = Py_BuildValue("IIiLiiNLL", p->tcp.seq, p->tcp.ack,
+                        p->tcp.flags, (long long)p->tcp.window,
+                        (int)p->tcp.wscale, (int)p->tcp.mss, sacks,
+                        (long long)p->tcp.ts_val,
+                        (long long)p->tcp.ts_ecr);
   } else {
     tcp = Py_None;
     Py_INCREF(tcp);
@@ -5451,17 +5473,20 @@ static PyObject *eng_intern_packet(EngineObj *self, PyObject *args) {
   PyBuffer_Release(&payload);
   if (tcp != Py_None) {
     p->has_tcp = true;
-    long long window;
+    long long window, ts_val, ts_ecr;
     int wscale, mss;
     PyObject *sacks;
-    if (!PyArg_ParseTuple(tcp, "IIiLiiO", &p->tcp.seq, &p->tcp.ack,
-                          &p->tcp.flags, &window, &wscale, &mss, &sacks)) {
+    if (!PyArg_ParseTuple(tcp, "IIiLiiOLL", &p->tcp.seq, &p->tcp.ack,
+                          &p->tcp.flags, &window, &wscale, &mss, &sacks,
+                          &ts_val, &ts_ecr)) {
       e->store.free_pkt(id);
       return nullptr;
     }
     p->tcp.window = window;
     p->tcp.wscale = wscale;
     p->tcp.mss = mss;
+    p->tcp.ts_val = ts_val;
+    p->tcp.ts_ecr = ts_ecr;
     Py_ssize_t ns = PyTuple_GET_SIZE(sacks);
     p->tcp.n_sacks = (int)std::min(ns, (Py_ssize_t)MAX_SACK_BLOCKS);
     for (int i = 0; i < p->tcp.n_sacks; i++) {

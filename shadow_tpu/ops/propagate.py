@@ -47,11 +47,13 @@ def _export_native_packet(plane, pkt_id: int):
      tcp) = plane.engine.packet_fields(pkt_id)
     hdr = None
     if tcp is not None:
-        tseq, ack, flags, window, wscale, mss, sacks = tcp
+        tseq, ack, flags, window, wscale, mss, sacks, ts_val, \
+            ts_ecr = tcp
         hdr = pktmod.TcpHeader(
             seq=tseq, ack=ack, flags=flags, window=window,
             window_scale=None if wscale < 0 else wscale,
-            mss=None if mss < 0 else mss, sack_blocks=tuple(sacks))
+            mss=None if mss < 0 else mss, sack_blocks=tuple(sacks),
+            timestamp=ts_val, timestamp_echo=ts_ecr)
     p = pktmod.Packet(src_host, seq, proto, src_ip, sport, dst_ip, dport,
                       payload=payload, tcp=hdr)
     p.priority = seq
@@ -67,7 +69,8 @@ def _intern_python_packet(plane, p) -> int:
         h = p.tcp
         tcp = (h.seq, h.ack, h.flags, h.window,
                -1 if h.window_scale is None else h.window_scale,
-               -1 if h.mss is None else h.mss, tuple(h.sack_blocks))
+               -1 if h.mss is None else h.mss, tuple(h.sack_blocks),
+               h.timestamp or 0, h.timestamp_echo or 0)
     return plane.engine.intern_packet(
         p.src_host_id, p.seq, p.protocol, p.src_ip, p.src_port, p.dst_ip,
         p.dst_port, p.payload, tcp)

@@ -23,10 +23,12 @@ a pluggable congestion-control seam with reno as the in-tree algorithm
 (ref: tcp_cong.c/tcp_cong_reno.c — the reference likewise ships only
 reno behind its ops table).
 
-Deliberate simplifications (documented for parity tracking against the
-reference's states.rs/connection.rs): no timestamps (RFC 7323 TSopt) —
-RTT sampling is one-timed-segment BSD style; no simultaneous open; no
-urgent data. Each is listed in docs/PARITY.md.
+Timestamps (RFC 7323 TSopt, ref legacy tcp.c:141-142): every segment
+carries its send time and echoes the last value received, so RTT
+updates on every acked segment (suppressed during RTO backoff — Karn).
+Simultaneous open is modeled (states below).  Deliberate
+simplifications (documented for parity tracking in docs/PARITY.md):
+no urgent data.
 
 All arithmetic is integer (ns for time, mod-2^32 for sequence space) so
 scalar and batched stepping agree bit-for-bit.
@@ -213,18 +215,19 @@ class TcpConnection:
         self.in_fast_recovery = False
         self.recover = self.iss
 
-        # RTT/RTO (integer ns, Jacobson/Karn). One *timed segment* per
-        # window, BSD-style: sampling from arbitrary cleared rtx entries
-        # would poison srtt after a retransmission repaired a hole (the
-        # cumulative ack clears old segments whose wait includes the
-        # whole stall).
+        # RTT/RTO (integer ns, RFC 6298 + RFC 7323 timestamps).  Every
+        # segment carries its send time; the receiver echoes the last
+        # value it saw, so ANY acked segment yields an RTT sample —
+        # the reference's legacy-stack behavior (tcp.c:141-142,
+        # 2356-2358: per-segment timestampValue/timestampEcho, sampling
+        # suppressed while in RTO backoff, Karn via the echo discipline).
         self.srtt = 0
         self.rttvar = 0
         self.rto = INIT_RTO_NS
         self.rto_deadline: int | None = None
         self.time_wait_deadline: int | None = None
-        self._timed_end_seq: int | None = None
-        self._timed_sent_at = 0
+        self._ts_recent = 0      # last timestamp value received
+        self._rto_backoff = 0    # RTO doublings since last fwd progress
 
         self.outbox: deque = deque()  # (TcpHeader, payload)
         self.error: str | None = None  # set on RST / fatal
@@ -423,7 +426,8 @@ class TcpConnection:
         for seg in self.rtx:
             seg[5] = False
         self.rto = min(self.rto * 2, MAX_RTO_NS)
-        self._retransmit_one(now)  # Karn: marks the entry, no RTT sample
+        self._rto_backoff += 1  # suppress RTT sampling until fwd progress
+        self._retransmit_one(now)
         self.rto_deadline = now + self.rto
 
     # ------------------------------------------------------------------
@@ -437,6 +441,22 @@ class TcpConnection:
         if hdr.flags & TcpFlags.RST:
             self._on_rst(hdr)
             return
+        # RFC 7323 timestamp processing on EVERY segment (ref
+        # tcp.c:2356-2358, plus the TS.Recent update rule the RFC adds:
+        # only a segment covering the last ack point may update the
+        # value to echo — a late-arriving old duplicate must not wind
+        # ts_recent back, or its dup-ack's echo would feed an
+        # RTO-stall-sized sample into srtt).  Values are stamped as
+        # now+1 so a segment sent at sim time 0 still carries the
+        # option (0 = absent).
+        if hdr.timestamp:
+            seg_span = max(len(payload), 1) \
+                + (1 if hdr.flags & TcpFlags.FIN else 0)
+            if seq_leq(hdr.seq, self.rcv_nxt) and \
+                    seq_lt(self.rcv_nxt, seq_add(hdr.seq, seg_span)):
+                self._ts_recent = hdr.timestamp
+        if hdr.timestamp_echo and self._rto_backoff == 0:
+            self._update_rtt(now - (hdr.timestamp_echo - 1))
         if self.state == LISTEN:
             # Owner (listener socket) is responsible for spawning child
             # connections; a LISTEN connection itself ignores non-SYN.
@@ -455,7 +475,7 @@ class TcpConnection:
                 # (RFC 7323 2.2), same as _on_packet_syn_sent.
                 self.snd_una = hdr.ack
                 self.snd_wnd = hdr.window
-                self._clear_acked(now)
+                self._clear_acked()
                 self.state = ESTABLISHED
                 self._emit_ack(now)
                 self._push_data(now)
@@ -523,7 +543,7 @@ class TcpConnection:
             self.snd_una = hdr.ack
             self.snd_wnd = hdr.window
             self._negotiate_options(hdr)
-            self._clear_acked(now)
+            self._clear_acked()
             self.state = ESTABLISHED
             self._emit_ack(now)
         elif hdr.flags & TcpFlags.SYN:
@@ -583,14 +603,12 @@ class TcpConnection:
         acked = seq_sub(ack, self.snd_una)
         self.snd_una = ack
         self.dupacks = 0
-        sample = self._clear_acked(now)
-        if sample is not None:
-            self._update_rtt(sample)
-        elif self.srtt > 0:
-            # Forward progress undoes exponential RTO backoff even when
-            # Karn's rule yields no sample (the ack was for a retransmit).
-            # Without this, sustained loss walks rto to the 60s cap and
-            # every remaining hole costs a full max-RTO — transfers that
+        self._clear_acked()
+        self._rto_backoff = 0  # forward progress re-enables sampling
+        if self.srtt > 0:
+            # Forward progress undoes exponential RTO backoff.  Without
+            # this, sustained loss walks rto to the 60s cap and every
+            # remaining hole costs a full max-RTO — transfers that
             # should take seconds take hours.
             self.rto = min(max(self.srtt + max(4 * self.rttvar, 1_000_000),
                                MIN_RTO_NS), MAX_RTO_NS)
@@ -647,9 +665,9 @@ class TcpConnection:
         self.retransmit_count += 1
         self._transmit_segment(seg[0], seg[1], seg[2], now)
 
-    def _clear_acked(self, now: int):
-        """Drop fully-acked segments from the rtx queue; returns the RTT
-        sample (ns) if the ack covers the timed segment, else None."""
+    def _clear_acked(self) -> None:
+        """Drop fully-acked segments from the rtx queue.  (RTT comes
+        from timestamp echoes, not from rtx entries.)"""
         while self.rtx:
             seq, payload, is_fin, sent_at, retransmitted, sacked = self.rtx[0]
             # Sequence space consumed: data bytes, or 1 for SYN/FIN.
@@ -659,12 +677,6 @@ class TcpConnection:
                 self.rtx.pop(0)
             else:
                 break
-        if self._timed_end_seq is not None \
-                and seq_leq(self._timed_end_seq, self.snd_una):
-            sample = now - self._timed_sent_at
-            self._timed_end_seq = None
-            return sample
-        return None
 
     def _update_rtt(self, sample: int) -> None:
         if sample <= 0:
@@ -867,10 +879,10 @@ class TcpConnection:
 
     def _transmit_segment(self, seq: int, payload: bytes, is_fin: bool,
                           now: int) -> None:
-        """Retransmission path only — fresh segments go through _emit."""
-        # Karn: a retransmission in the window invalidates the timed
-        # segment (its eventual ack is ambiguous).
-        self._timed_end_seq = None
+        """Retransmission path only — fresh segments go through _emit.
+        Karn under timestamps: a retransmitted segment carries a FRESH
+        timestamp, so its echo measures the retransmission, never the
+        ambiguous original; sampling also pauses during RTO backoff."""
         flags = TcpFlags.ACK
         mss = None
         window_scale = None
@@ -893,9 +905,18 @@ class TcpConnection:
             seq=seq, ack=self.rcv_nxt, flags=flags,
             window=self._wire_window(flags), mss=mss,
             window_scale=window_scale,
-            sack_blocks=self._sack_blocks()), payload))
+            sack_blocks=self._sack_blocks(),
+            timestamp=now + 1,
+            timestamp_echo=self._take_ts_echo()), payload))
         self.segments_sent += 1
         self._note_ack_sent()
+
+    def _take_ts_echo(self) -> int:
+        """The echo for an outgoing segment: the last timestamp value
+        received, cleared after one use so an outdated echo is never
+        resent (ref tcp.c:2433-2434)."""
+        ts, self._ts_recent = self._ts_recent, 0
+        return ts
 
     def _emit(self, flags: int, seq: int, payload: bytes, now: int,
               track: bool = False, is_fin: bool = False,
@@ -904,7 +925,9 @@ class TcpConnection:
         ack = self.rcv_nxt if (flags & TcpFlags.ACK) else 0
         self.outbox.append((TcpHeader(
             seq=seq, ack=ack, flags=flags, window=self._wire_window(flags),
-            mss=mss, window_scale=window_scale), payload))
+            mss=mss, window_scale=window_scale,
+            timestamp=now + 1,
+            timestamp_echo=self._take_ts_echo()), payload))
         self.segments_sent += 1
         if flags & TcpFlags.ACK:
             self._note_ack_sent()
@@ -912,11 +935,6 @@ class TcpConnection:
             self.rtx.append([seq, payload, is_fin, now, False, False])
             if self.rto_deadline is None:
                 self.rto_deadline = now + self.rto
-            if self._timed_end_seq is None:
-                self._timed_end_seq = seq_add(
-                    seq, len(payload) + (1 if is_fin else 0)
-                    + (1 if payload == b"" and not is_fin else 0))
-                self._timed_sent_at = now
 
     def _note_ack_sent(self) -> None:
         """Any segment carrying our current rcv_nxt satisfies a pending
@@ -928,6 +946,8 @@ class TcpConnection:
         self.outbox.append((TcpHeader(
             seq=self.snd_nxt, ack=self.rcv_nxt, flags=TcpFlags.ACK,
             window=self._wire_window(TcpFlags.ACK),
-            sack_blocks=self._sack_blocks()), b""))
+            sack_blocks=self._sack_blocks(),
+            timestamp=now + 1,
+            timestamp_echo=self._take_ts_echo()), b""))
         self.segments_sent += 1
         self._note_ack_sent()

@@ -486,3 +486,74 @@ def test_sack_reneging_rto_clears_scoreboard():
         if w.a.rtx or w.a.send_buf:
             w.advance_to_next_timer()
     assert bytes(got) == data
+
+
+def test_timestamp_rtt_every_acked_segment():
+    """RFC 7323 timestamps (ref legacy tcp.c:141-142, 2356-2358): every
+    segment carries ts_val and echoes the peer's last value, so RTT
+    updates on every acked segment — not once per window."""
+    w = Wire()
+    w.handshake()
+    # Deliver with a manual 7ms one-way delay so samples are nonzero:
+    # hold segments, advance the clock, then deliver.
+    delay = 7 * MS
+    samples = []
+    orig = w.a._update_rtt
+
+    def spy(sample):
+        samples.append(sample)
+        orig(sample)
+    w.a._update_rtt = spy
+
+    for i in range(4):
+        w.a.write(b"x" * 100, w.now)
+        held = []
+        while w.a.outbox:
+            held.append(w.a.outbox.popleft())
+        w.now += delay
+        for hdr, payload in held:
+            w.b.on_packet(hdr, payload, w.now)
+        held = []
+        while w.b.outbox:
+            held.append(w.b.outbox.popleft())
+        w.now += delay
+        for hdr, payload in held:
+            w.a.on_packet(hdr, payload, w.now)
+        w.b.read(1 << 20, w.now)
+        w.now += 50 * MS  # let delayed acks fire
+        w.a.on_timer(w.now)
+        w.b.on_timer(w.now)
+        w.pump()
+    # A sample per ack carrying an echo (delayed acks may coalesce two
+    # segments into one ack), each covering at least the full round
+    # trip — per-segment sampling, not once-per-window.
+    assert len(samples) >= 3, samples
+    assert all(s >= 2 * delay for s in samples), samples
+    assert w.a.srtt >= 2 * delay
+
+
+def test_timestamp_sampling_paused_during_rto_backoff():
+    """Karn under timestamps: while in RTO backoff no samples are taken
+    (an echo may measure a retransmitted segment's original)."""
+    w = Wire()
+    w.handshake()
+    w.drop_fn = lambda d, hdr, payload, idx: d == "ab" and bool(payload)
+    w.a.write(b"y" * 200, w.now)
+    w.pump()
+    assert w.a.rtx
+    w.advance_to_next_timer()   # RTO fires; backoff begins
+    assert w.a._rto_backoff == 1
+    w.drop_fn = None
+    samples = []
+    orig = w.a._update_rtt
+    w.a._update_rtt = lambda s: (samples.append(s), orig(s))
+    w.pump()                    # retransmission delivered
+    w.now += 50 * MS            # let the peer's delayed ack fire
+    w.a.on_timer(w.now)
+    w.b.on_timer(w.now)
+    w.pump()
+    # Forward progress clears the backoff; the ack that cleared it
+    # arrived while backoff was still set, so it took no sample.
+    assert w.a._rto_backoff == 0
+    assert w.a.snd_una == w.a.snd_nxt
+    assert samples == []
