@@ -609,14 +609,18 @@ struct TcpConn {
      * segment covering the last ack point may update the echo value,
      * so a late old duplicate cannot wind it back and poison srtt).
      * Values are stamped now+1 (0 = option absent). */
-    if (hdr.ts_val) {
-      int64_t span = std::max((int64_t)payload.size(), (int64_t)1) +
+    if (hdr.ts_val && state != ST_SYN_SENT) {
+      /* (SYN_SENT records in its handler, after rcv_nxt exists.) */
+      int64_t span = (int64_t)payload.size() +
                      ((hdr.flags & F_FIN) ? 1 : 0);
+      if (span == 0) span = 1;  /* pure ACK sits at the ack point */
       if (seq_leq(hdr.seq, rcv_nxt) &&
           seq_lt(rcv_nxt, seq_add(hdr.seq, span)))
         ts_recent = hdr.ts_val;
     }
-    if (hdr.ts_ecr && rto_backoff == 0)
+    /* RTTM: sample only from a segment acknowledging NEW data. */
+    if (hdr.ts_ecr && rto_backoff == 0 && (hdr.flags & F_ACK) &&
+        seq_lt(snd_una, hdr.ack) && seq_leq(hdr.ack, snd_nxt))
       update_rtt(now - (hdr.ts_ecr - 1));
     if (state == ST_LISTEN) return;
     if (state == ST_SYN_SENT) { on_packet_syn_sent(hdr, now); return; }
@@ -653,6 +657,7 @@ struct TcpConn {
   void accept_syn(const TcpHdrN &hdr, int64_t now) {
     irs = hdr.seq;
     rcv_nxt = seq_add(hdr.seq, 1);
+    if (hdr.ts_val) ts_recent = hdr.ts_val;  // echo in the SYN-ACK
     snd_wnd = hdr.window;
     negotiate_options(hdr);
     state = ST_SYN_RECEIVED;
@@ -688,6 +693,7 @@ struct TcpConn {
     if ((hdr.flags & (F_SYN | F_ACK)) == (F_SYN | F_ACK)) {
       irs = hdr.seq;
       rcv_nxt = seq_add(hdr.seq, 1);
+      if (hdr.ts_val) ts_recent = hdr.ts_val;
       snd_una = hdr.ack;
       snd_wnd = hdr.window;
       negotiate_options(hdr);
@@ -699,6 +705,7 @@ struct TcpConn {
        * answer SYN-ACK, wait in SYN_RECEIVED (connection.py twin). */
       irs = hdr.seq;
       rcv_nxt = seq_add(hdr.seq, 1);
+      if (hdr.ts_val) ts_recent = hdr.ts_val;
       snd_wnd = hdr.window;
       negotiate_options(hdr);
       state = ST_SYN_RECEIVED;

@@ -449,13 +449,22 @@ class TcpConnection:
         # RTO-stall-sized sample into srtt).  Values are stamped as
         # now+1 so a segment sent at sim time 0 still carries the
         # option (0 = absent).
-        if hdr.timestamp:
-            seg_span = max(len(payload), 1) \
+        if hdr.timestamp and self.state != SYN_SENT:
+            # (SYN_SENT records in its handler, after rcv_nxt exists.)
+            seg_span = len(payload) \
                 + (1 if hdr.flags & TcpFlags.FIN else 0)
+            if seg_span == 0:
+                seg_span = 1  # pure ACK sits at the ack point
             if seq_leq(hdr.seq, self.rcv_nxt) and \
                     seq_lt(self.rcv_nxt, seq_add(hdr.seq, seg_span)):
                 self._ts_recent = hdr.timestamp
-        if hdr.timestamp_echo and self._rto_backoff == 0:
+        # RTTM rule: sample only from a segment that ACKNOWLEDGES NEW
+        # DATA — an echo held across an application-idle gap must not
+        # feed an idle-sized sample into srtt.
+        if hdr.timestamp_echo and self._rto_backoff == 0 \
+                and (hdr.flags & TcpFlags.ACK) \
+                and seq_lt(self.snd_una, hdr.ack) \
+                and seq_leq(hdr.ack, self.snd_nxt):
             self._update_rtt(now - (hdr.timestamp_echo - 1))
         if self.state == LISTEN:
             # Owner (listener socket) is responsible for spawning child
@@ -504,6 +513,8 @@ class TcpConnection:
         assert self.state in (CLOSED, LISTEN)
         self.irs = hdr.seq
         self.rcv_nxt = seq_add(hdr.seq, 1)
+        if hdr.timestamp:
+            self._ts_recent = hdr.timestamp  # SYN's value: echo in SYN-ACK
         self.snd_wnd = hdr.window
         self._negotiate_options(hdr)
         self.state = SYN_RECEIVED
@@ -540,6 +551,8 @@ class TcpConnection:
                 (TcpFlags.SYN | TcpFlags.ACK):
             self.irs = hdr.seq
             self.rcv_nxt = seq_add(hdr.seq, 1)
+            if hdr.timestamp:
+                self._ts_recent = hdr.timestamp
             self.snd_una = hdr.ack
             self.snd_wnd = hdr.window
             self._negotiate_options(hdr)
@@ -555,6 +568,8 @@ class TcpConnection:
             # bare-SYN retransmit re-triggers the peer's own re-ack.
             self.irs = hdr.seq
             self.rcv_nxt = seq_add(hdr.seq, 1)
+            if hdr.timestamp:
+                self._ts_recent = hdr.timestamp
             self.snd_wnd = hdr.window
             self._negotiate_options(hdr)
             self.state = SYN_RECEIVED

@@ -11,15 +11,15 @@ IPC each), asserts they all complete correctly, and byte-diffs two runs
 """
 
 import os
+import shutil
 import subprocess
 
 import pytest
 
 PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
 
-pytestmark = pytest.mark.skipif(
-    subprocess.run(["which", "cc"], capture_output=True).returncode != 0,
-    reason="no C toolchain for the shim")
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C toolchain for the shim")
 
 N_SERVERS = 8
 N_CLIENTS = 120
