@@ -1512,6 +1512,12 @@ struct Engine {
   Py_buffer nt_buf{};
   int64_t *nt = nullptr;
   Py_ssize_t nt_len = 0;
+  /* Shared Python-work flags (read-only view of the manager's bool
+   * array): run_span must never execute a flagged host — its nt slot
+   * carries a PYTHON-heap time the engine-side refresh would wipe. */
+  Py_buffer pw_buf{};
+  const uint8_t *pw = nullptr;
+  Py_ssize_t pw_len = 0;
 
   HostPlane *plane(int hid) {
     return (hid >= 0 && (size_t)hid < hosts.size()) ? hosts[hid].get()
@@ -3104,6 +3110,10 @@ struct Engine {
   };
 
   bool span_eligible() {
+    /* EVERY slot of the shared snapshot must be an engine host: a
+     * mixed sim (object-path hosts) would make run_span touch null
+     * hosts and silently drop engine->object exports. */
+    if ((Py_ssize_t)hosts.size() != (Py_ssize_t)nt_len) return false;
     for (auto &up : hosts) {
       HostPlane *hp = up.get();
       if (hp == nullptr || hp->has_py_socks || !hp->rng_native)
@@ -3127,6 +3137,16 @@ struct Engine {
     while (r.rounds < max_rounds && start < limit && start < stop) {
       int64_t window_end = start + r.runahead;
       if (window_end > stop) window_end = stop;
+      /* A mid-span delivery can lower a py-flagged host's nt into the
+       * next window; that host needs Python execution (its slot holds
+       * a Python-heap time the refresh below would wipe).  Stop the
+       * span BEFORE any window touches one. */
+      if (pw != nullptr) {
+        bool touch = false;
+        for (int64_t i = 0; i < nt_len && i < pw_len; i++)
+          if (pw[i] && nt[i] < window_end) { touch = true; break; }
+        if (touch) break;
+      }
       ids.clear();
       for (int64_t i = 0; i < nt_len; i++)
         if (nt[i] < window_end) ids.push_back((uint32_t)i);
@@ -4846,6 +4866,23 @@ static PyObject *eng_set_nt(EngineObj *self, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+static PyObject *eng_set_py_work(EngineObj *self, PyObject *args) {
+  PyObject *arr;
+  if (!PyArg_ParseTuple(args, "O", &arr)) return nullptr;
+  Engine *e = self->eng;
+  if (e->pw) {
+    PyBuffer_Release(&e->pw_buf);
+    e->pw = nullptr;
+  }
+  if (arr != Py_None) {
+    if (PyObject_GetBuffer(arr, &e->pw_buf, PyBUF_SIMPLE) < 0)
+      return nullptr;
+    e->pw = (const uint8_t *)e->pw_buf.buf;
+    e->pw_len = e->pw_buf.len;
+  }
+  Py_RETURN_NONE;
+}
+
 static PyObject *finish_result_to_py(Engine::FinishResult &&r) {
   PyObject *exports;
   if (r.exports.empty()) {
@@ -5605,6 +5642,7 @@ static PyMethodDef eng_methods[] = {
     {"push_inbox", (PyCFunction)eng_push_inbox, METH_VARARGS, nullptr},
     {"set_routing", (PyCFunction)eng_set_routing, METH_VARARGS, nullptr},
     {"set_nt", (PyCFunction)eng_set_nt, METH_VARARGS, nullptr},
+    {"set_py_work", (PyCFunction)eng_set_py_work, METH_VARARGS, nullptr},
     {"finish_round", (PyCFunction)eng_finish_round, METH_VARARGS, nullptr},
     {"round_size", (PyCFunction)eng_round_size, METH_NOARGS, nullptr},
     {"export_round", (PyCFunction)eng_export_round, METH_NOARGS, nullptr},
@@ -5662,6 +5700,7 @@ static void eng_dealloc(EngineObj *self) {
   Py_XDECREF(self->eng->cb_event);
   Py_XDECREF(self->eng->cb_rng);
   if (self->eng->nt) PyBuffer_Release(&self->eng->nt_buf);
+  if (self->eng->pw) PyBuffer_Release(&self->eng->pw_buf);
   delete self->eng;
   Py_TYPE(self)->tp_free((PyObject *)self);
 }

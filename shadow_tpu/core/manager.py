@@ -429,6 +429,10 @@ class Manager:
         self._py_work = pw
         if self.plane is not None:
             self.plane.engine.set_nt(nt)
+            # Span loop safety: the engine must know which hosts carry
+            # Python-side work (their nt slots hold Python-heap times
+            # the engine-side refresh would wipe).
+            self.plane.engine.set_py_work(pw)
 
     def _min_next_event(self) -> int | None:
         from shadow_tpu.core.simtime import TIME_NEVER
@@ -610,14 +614,40 @@ class Manager:
         cpp_ns_round = None   # EWMA wall ns/round, C++ spans
         dev_probe_countdown = 0
         dev_aborts_row = 0
+        all_plane = all(h.plane is not None for h in self.hosts)
         from shadow_tpu.core.simtime import TIME_NEVER
         while start is not None and start < stop:
-            if span_ok and not self._py_work.any() \
-                    and not getattr(self.propagator, "_outbox", None) \
-                    and self.propagator.span_gate():
+            span_now = span_ok and \
+                not getattr(self.propagator, "_outbox", None) and \
+                self.propagator.span_gate()
+            py_limit = None
+            if span_now and self._py_work.any():
+                # Python-side work pending somewhere.  When EVERY host
+                # is engine-resident the flags are transient (heap
+                # tasks like spawns/shutdowns), and spans may still
+                # serve the stretch UP TO the earliest window that
+                # could touch one: a window [s, s+ra) with
+                # s <= py_min - ra keeps window_end <= py_min, so the
+                # Python event can never fall inside a C++-served
+                # window (dynamic runahead only shrinks).  In a MIXED
+                # sim an object-path host is py-flagged permanently
+                # and can RECEIVE from engine hosts in any window
+                # (exports the span cannot deliver) — no spans there.
+                if not all_plane:
+                    span_now = False
+                else:
+                    py_min = int(self._nt[self._py_work].min())
+                    ra = self.runahead.get()
+                    if start > py_min - ra:
+                        span_now = False
+                    else:
+                        py_limit = py_min - ra + 1
+            if span_now:
                 limit = stop
                 if heartbeat_lines:
                     limit = min(limit, next_heartbeat)
+                if py_limit is not None:
+                    limit = min(limit, py_limit)
                 # With engine-side pcap, cap the span so capture
                 # buffers hold at most ~64 rounds of packets before
                 # the drain below (per-round streams; spans must not
@@ -655,8 +685,13 @@ class Manager:
                             else next_start)
 
                 # ---- device-resident span (ops/phold_span.py) ----
+                # Only in the fully-pure case: span_import_phold
+                # recomputes every nt slot from engine state, which
+                # would wipe a py-flagged host's Python-heap time (the
+                # C++ span protects those via the shared pw flags; the
+                # device import cannot).
                 use_dev = False
-                if dev_span_on:
+                if dev_span_on and py_limit is None:
                     if dev_mode in ("force", "on"):
                         use_dev = True
                     elif dev_ns_round is not None \
