@@ -257,6 +257,30 @@ def phold_rung() -> None:
           f"({s_cpp.packets_sent / max(w_cpp, 1e-9):.0f} msgs/s)",
           file=sys.stderr)
 
+    # udp-mesh family on the device loop (dual-thread apps, saturated
+    # send buffers, loss) — a paced 24-host mesh so the sim spans many
+    # windows (the full bench[mesh-100] burst collapses into a handful
+    # of giant rounds, which the C++ engine already serves best).
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    try:
+        from test_phold_span import mesh_cfg
+    except ImportError as e:
+        print(f"bench[mesh-dev]: skipped ({e})", file=sys.stderr)
+        return
+    t0 = time.perf_counter()
+    mgr = Manager(mesh_cfg("tpu", n=24, device_spans="force"))
+    for h in mgr.hosts:
+        h.set_tracing(False)
+    sm = mgr.run()
+    w = time.perf_counter() - t0
+    r = mgr._dev_span
+    share = 100.0 * r.rounds / max(sm.rounds, 1)
+    print(f"bench[mesh-dev]: 24-host udp-mesh, {sm.packets_sent} "
+          f"packets; device multi-round {r.rounds}/{sm.rounds} rounds "
+          f"on device ({share:.0f}%, {r.spans} dispatches, aborts "
+          f"{r.aborts}) in {w:.1f}s", file=sys.stderr)
+
 
 def sharded_rung_subprocess() -> None:
     """10k-host sharded rung on a virtual 8-device CPU mesh, run in a

@@ -3229,44 +3229,60 @@ struct Engine {
   struct PholdShape {
     std::vector<int32_t> main_idx, seed_idx;  // per host app indices
     size_t n_peers_max = 0;
+    int family = 0;        // 0 = phold, 1 = udp-mesh
+    int64_t pay_size = 5;  // uniform payload bytes ("phold" or 'm'*size)
   };
 
-  /* Returns false unless EVERY host is phold-shaped and quiescent
-   * enough for the SoA model (no stops, no lo/pcap traffic, no
-   * foreign sockets holding packets). */
+  /* Returns false unless EVERY host is span-shaped (one phold LP +
+   * seeder, or one udp-mesh main + sender — uniform family and
+   * payload size) and quiescent enough for the SoA model (no stops,
+   * no lo/pcap traffic, no foreign sockets holding packets). */
   bool phold_shape(PholdShape *sh) {
     size_t H = hosts.size();
     sh->main_idx.assign(H, -1);
     sh->seed_idx.assign(H, -1);
+    int fam = -1;
     for (size_t i = 0; i < apps.size(); i++) {
       AppN &a = apps[i];
-      if (a.kind == APP_PHOLD) {
-        if (a.hid < 0 || (size_t)a.hid >= H) return false;
-        if (sh->main_idx[a.hid] >= 0) return false;  // one LP per host
-        sh->main_idx[a.hid] = (int32_t)i;
-      } else if (a.kind == APP_PHOLD_SEED) {
-        if (a.hid < 0 || (size_t)a.hid >= H) return false;
-        if (sh->seed_idx[a.hid] >= 0) return false;
-        sh->seed_idx[a.hid] = (int32_t)i;
-      } else {
-        return false;  // any non-phold app: not a phold sim
-      }
+      int f, is_main;
+      if (a.kind == APP_PHOLD) { f = 0; is_main = 1; }
+      else if (a.kind == APP_PHOLD_SEED) { f = 0; is_main = 0; }
+      else if (a.kind == APP_UDP_MESH) { f = 1; is_main = 1; }
+      else if (a.kind == APP_UDP_MESH_SND) { f = 1; is_main = 0; }
+      else return false;  // any other app: not a span-shaped sim
+      if (fam < 0) fam = f;
+      if (f != fam) return false;  // mixed families: keep it simple
+      if (a.hid < 0 || (size_t)a.hid >= H) return false;
+      auto &slot = is_main ? sh->main_idx : sh->seed_idx;
+      if (slot[a.hid] >= 0) return false;  // one pair per host
+      slot[a.hid] = (int32_t)i;
     }
+    sh->family = fam < 0 ? 0 : fam;
     for (size_t h = 0; h < H; h++) {
       HostPlane *hp = hosts[h].get();
       if (sh->main_idx[h] < 0 || sh->seed_idx[h] < 0) return false;
       AppN &m = apps[(size_t)sh->main_idx[h]];
       AppN &s = apps[(size_t)sh->seed_idx[h]];
-      if (m.stopped || s.stopped || m.exited) return false;
+      if (m.stopped || s.stopped) return false;
+      if (sh->family == 0 && m.exited) return false;
       if (m.sock < 0 || s.mesh_peer != sh->main_idx[h]) return false;
       if (m.port == 53) return false;  // dns_wire answers: modelled out
       UdpSocketN *u = udp((uint32_t)m.sock);
-      if (u == nullptr || u->has_peer || !u->has_local) return false;
+      if (u == nullptr || u->has_peer) return false;
+      if (!m.exited && !u->has_local) return false;
       if (!u->send_q[0].empty()) return false;  // no loopback traffic
       if (hp->pcap_on[0] || hp->pcap_on[1]) return false;
       if (hp->relays[0].state == RELAY_PENDING ||
           hp->relays[0].pending != UINT64_MAX)
         return false;
+      if (sh->family == 1) {
+        int64_t pay = m.size;
+        if (pay <= 0 || pay > MTU - IPV4_HDR - UDP_HDR) return false;
+        if (h == 0) sh->pay_size = pay;
+        else if (pay != sh->pay_size) return false;  // uniform sizes
+      } else if (h == 0) {
+        sh->pay_size = 5;
+      }
       if (m.peers.size() > sh->n_peers_max)
         sh->n_peers_max = m.peers.size();
       /* theap entries must all be modellable kinds owned by this
@@ -3324,7 +3340,8 @@ struct Engine {
   };
 
   uint64_t pk_alloc(int32_t src_host_, int64_t pseq_, uint32_t sip_,
-                    int32_t sport_, uint32_t dip_, int32_t dport_) {
+                    int32_t sport_, uint32_t dip_, int32_t dport_,
+                    int family, int64_t pay_size) {
     uint64_t id = store.alloc();
     PacketN *p = store.get(id);
     p->src_host = src_host_;
@@ -3334,7 +3351,10 @@ struct Engine {
     p->src_port = sport_;
     p->dst_ip = dip_;
     p->dst_port = dport_;
-    p->payload.assign("phold", 5);
+    if (family == 0)
+      p->payload.assign("phold", 5);
+    else
+      p->payload.assign((size_t)pay_size, 'm');
     p->priority = pseq_;
     return id;
   }
@@ -4186,7 +4206,9 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     r_cap[r].assign(H, 0);
   }
   std::vector<uint8_t> m_state(H), m_wakep(H), s_state(H), s_wakep(H),
-      s_exited(H);
+      s_exited(H), m_exited(H), m_partdone(H), s_partdone(H),
+      sock_closed(H);
+  std::vector<int64_t> m_exit_time(H);
   std::vector<uint32_t> m_waitmask(H), s_waitmask(H), m_lcg(H),
       m_target(H), s_target(H);
   std::vector<int64_t> m_waitseq(H), s_waitseq(H), m_gotn(H), m_mean(H),
@@ -4296,20 +4318,27 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
       }
     }
     m_state[h] = (uint8_t)m.state;
+    m_exited[h] = m.exited ? 1 : 0;
+    m_exit_time[h] = m.exit_time;
+    m_partdone[h] = m.part_done ? 1 : 0;
+    s_partdone[h] = s.part_done ? 1 : 0;
+    sock_closed[h] = (u->status & S_CLOSED) ? 1 : 0;
     m_wakep[h] = m.wake_pending ? 1 : 0;
     m_waitmask[h] = m.wait_mask;
     m_waitseq[h] = m.wait_seq;
-    m_gotn[h] = m.got_n;
+    m_gotn[h] = sh.family == 1 ? m.got : m.got_n;
     m_lcg[h] = m.lcg;
     m_target[h] = m.phold_target;
     m_port[h] = m.port;
-    m_mean[h] = m.interval;
+    m_mean[h] = sh.family == 1 ? m.size : m.interval;
     s_state[h] = (uint8_t)s.state;
     s_wakep[h] = s.wake_pending ? 1 : 0;
     s_waitmask[h] = s.wait_mask;
     s_waitseq[h] = s.wait_seq;
     s_senti[h] = s.sent_i;
-    s_count[h] = s.count;
+    s_count[h] = sh.family == 1
+                     ? (int64_t)s.count * (int64_t)s.peers.size()
+                     : s.count;
     s_exited[h] = s.exited ? 1 : 0;
     s_exit_time[h] = s.exit_time;
     s_target[h] = s.phold_target;
@@ -4407,6 +4436,17 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   put("s_exited", bytes_vec(s_exited));
   put("s_exit_time", bytes_vec(s_exit_time));
   put("s_target", bytes_vec(s_target));
+  put("m_exited", bytes_vec(m_exited));
+  put("m_exit_time", bytes_vec(m_exit_time));
+  put("m_partdone", bytes_vec(m_partdone));
+  put("s_partdone", bytes_vec(s_partdone));
+  put("sock_closed", bytes_vec(sock_closed));
+  {
+    std::vector<uint8_t> fam(1, (uint8_t)sh.family);
+    std::vector<int64_t> ps(1, sh.pay_size);
+    put("family", bytes_vec(fam));
+    put("pay_size", bytes_vec(ps));
+  }
   put("peers", bytes_vec(peers));
   put("n_peers", bytes_vec(n_peers));
   put("app_sys", bytes_vec(app_sys));
@@ -4535,6 +4575,12 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
   const uint8_t *s_exited = col<uint8_t>(d, "s_exited", H, &ok);
   const int64_t *s_exit_time = col<int64_t>(d, "s_exit_time", H, &ok);
   const uint32_t *s_target = col<uint32_t>(d, "s_target", H, &ok);
+  const uint8_t *m_exited = col<uint8_t>(d, "m_exited", H, &ok);
+  const int64_t *m_exit_time = col<int64_t>(d, "m_exit_time", H, &ok);
+  const uint8_t *m_partdone = col<uint8_t>(d, "m_partdone", H, &ok);
+  const uint8_t *s_partdone = col<uint8_t>(d, "s_partdone", H, &ok);
+  const uint8_t *sock_closed = col<uint8_t>(d, "sock_closed", H, &ok);
+  const uint8_t *out_first = col<uint8_t>(d, "out_first", H, &ok);
   const int64_t *app_sys = col<int64_t>(d, "app_sys", H * ASYS_N, &ok);
   const int64_t *pkts_sent = col<int64_t>(d, "pkts_sent", H, &ok);
   const int64_t *pkts_recv = col<int64_t>(d, "pkts_recv", H, &ok);
@@ -4565,6 +4611,7 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     AppN &s = e->apps[(size_t)sh.seed_idx[h]];
     UdpSocketN *u = e->udp((uint32_t)m.sock);
     bool was_queued = u->queued[1];
+    bool was_closed = (u->status & S_CLOSED) != 0;
     /* free live engine packets; the device result replaces them */
     for (uint64_t id : u->recv_q) e->store.free_pkt(id);
     u->recv_q.clear();
@@ -4591,7 +4638,7 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     u->send_bytes = send_bytes[h];
     auto mk = [&](const Pk &c, size_t j) {
       return e->pk_alloc(c.srchost[j], c.pseq[j], c.sip[j], c.sport[j],
-                         c.dip[j], c.dport[j]);
+                         c.dip[j], c.dport[j], sh.family, sh.pay_size);
     };
     for (int32_t j = 0; j < rq_len[h]; j++)
       u->recv_q.push_back(mk(rq, h * (size_t)R + (size_t)j));
@@ -4646,9 +4693,52 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     m.state = m_state[h];
     m.wake_pending = m_wakep[h] != 0;
     m.wait_mask = m_waitmask[h];
-    m.got_n = m_gotn[h];
+    if (sh.family == 1) m.got = m_gotn[h];
+    else m.got_n = m_gotn[h];
     m.lcg = m_lcg[h];
     m.phold_target = m_target[h];
+    /* mesh completion: stdout lines append in the order the device
+     * recorded; close applies once when the process exits. */
+    if (sh.family == 1) {
+      bool new_m = m_partdone[h] && !m.part_done;
+      bool new_s = s_partdone[h] && !s.part_done;
+      char line_m[64], line_s[64];
+      snprintf(line_m, sizeof(line_m), "mesh received %lld bytes\n",
+               (long long)m_gotn[h]);
+      snprintf(line_s, sizeof(line_s), "mesh sent %lld\n",
+               (long long)((int64_t)s.count * (int64_t)s.peers.size()));
+      if (new_m && new_s) {
+        if (out_first[h] == 2) {
+          m.out += line_s;
+          m.out += line_m;
+        } else {
+          m.out += line_m;
+          m.out += line_s;
+        }
+      } else if (new_m) {
+        m.out += line_m;
+      } else if (new_s) {
+        m.out += line_s;
+      }
+      m.part_done = m_partdone[h] != 0;
+      s.part_done = s_partdone[h] != 0;
+      if (sock_closed[h] && !was_closed) {
+        /* process exit closed the fd on device: disassociate (the
+         * send queue keeps draining; status/recv arrive as fields) */
+        for (int i = 0; i < 2; i++)
+          if (u->ifaces_mask & (1 << i))
+            e->assoc_del(e->iface_of(hp, i), PROTO_UDP, u->local_port,
+                         0, 0);
+        u->ifaces_mask = 0;
+        u->app_owner = -2;
+      }
+      if (m_exited[h] && !m.exited) {
+        m.exited = true;
+        m.exit_code = 0;
+        m.exit_time = m_exit_time[h];
+        m.wait_mask = 0;
+      }
+    }
     s.state = s_state[h];
     s.wake_pending = s_wakep[h] != 0;
     s.wait_mask = s_waitmask[h];

@@ -62,6 +62,7 @@ PKT_SIZE = 33   # 5-byte "phold" payload + UDP(8) + IPv4(20) headers
 PAYLOAD_LEN = 5  # trace records carry the payload length, not total
 MTU = 1500
 CODEL_TARGET_NS = 5_000_000
+CODEL_HARD_LIMIT = 1000
 REFILL_NS = 1_000_000
 
 # Trace kinds / drop reason codes (span_import_phold REASONS order).
@@ -99,9 +100,10 @@ class PholdSpanRunner:
     # of each, and the device aborts transactionally on overflow).
     CAP_I = 64    # inbox
     CAP_T = 16    # timer heap
-    CAP_R = 64    # socket recv queue
-    CAP_S = 64    # socket send queue
-    CAP_C = 64    # CoDel ring
+    CAP_R = 256   # socket recv queue (mesh backlogs run deep)
+    CAP_S = 256   # socket send queue (ring ops are indexed, not
+    #               scanned, so the larger caps cost ~nothing)
+    CAP_C = 2048  # CoDel ring (covers the engine's 1000-entry hard limit)
     CAP_P = 4096  # peers
     MAX_ROUNDS = 256
 
@@ -138,6 +140,8 @@ class PholdSpanRunner:
         # XLA inserts the cross-shard collectives for the inbox
         # scatter.  Requires H % mesh size == 0.
         self.mesh = None
+        self.family = 0      # 0 phold, 1 udp-mesh (set from export)
+        self._pay = 5        # uniform payload bytes (set from export)
 
     # ------------------------------------------------------------------
     # Export bytes <-> numpy state
@@ -169,8 +173,15 @@ class PholdSpanRunner:
                   "m_lcg", "m_target", "s_target"):
             st[k] = f(k, np.uint32)
         for k in ("queued", "m_state", "m_wakep", "s_state", "s_wakep",
-                  "s_exited"):
+                  "s_exited", "m_exited", "m_partdone", "s_partdone",
+                  "sock_closed"):
             st[k] = f(k, np.uint8).astype(np.int32)
+        st["m_exit_time"] = f("m_exit_time", np.int64)
+        st["out_first"] = np.zeros(H, np.int32)
+        st["cd_chain"] = np.zeros(H, np.int32)
+        st["cd_sniff"] = np.zeros(H, np.int32)
+        self.family = int(np.frombuffer(d["family"], np.uint8)[0])
+        self._pay = int(np.frombuffer(d["pay_size"], np.int64)[0])
         # codel AQM bookkeeping rides along untouched; the device only
         # runs while the queue is quiescent (abort otherwise).
         st["codel_dropping"] = f("codel_dropping", np.uint8).astype(
@@ -280,8 +291,12 @@ class PholdSpanRunner:
                   "m_target", "s_target"):
             out[k] = npv(k).astype(np.uint32).tobytes()
         for k in ("queued", "m_state", "m_wakep", "s_state", "s_wakep",
-                  "s_exited", "codel_dropping"):
+                  "s_exited", "codel_dropping", "m_exited",
+                  "m_partdone", "s_partdone", "sock_closed",
+                  "out_first"):
             out[k] = npv(k).astype(np.uint8).tobytes()
+        out["m_exit_time"] = npv("m_exit_time").astype(
+            np.int64).tobytes()
         for r in (1, 2):
             out[f"r{r}_pending"] = npv(f"r{r}_pending").astype(
                 np.uint8).tobytes()
@@ -305,7 +320,7 @@ class PholdSpanRunner:
     def _cached_build(self, P: int):
         key = (self._H, P, self._lat.shape, self.CAP_I, self.CAP_T,
                self.CAP_R, self.CAP_S, self.CAP_C, self.cap_out,
-               self.cap_tr, self.tracing)
+               self.cap_tr, self.tracing, self.family)
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build(P)
@@ -321,6 +336,7 @@ class PholdSpanRunner:
         O = self.cap_out
         TR = self.cap_tr
         tracing = self.tracing
+        family = self.family  # static: compiled per family
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)  # mode="drop" sink for masked-out lanes
 
@@ -404,7 +420,7 @@ class PholdSpanRunner:
         def wake_check(st, changed_bits, time):
             """adjust_status's app_wake fan-out, ordered by wait_seq
             when both siblings qualify."""
-            m_ok = ((st["m_wakep"] == 0)
+            m_ok = ((st["m_wakep"] == 0) & (st["m_exited"] == 0)
                     & ((changed_bits & st["m_waitmask"]) != 0))
             s_ok = ((st["s_wakep"] == 0) & (st["s_exited"] == 0)
                     & ((changed_bits & st["s_waitmask"]) != 0))
@@ -454,8 +470,8 @@ class PholdSpanRunner:
                              jnp.where(do_ref,
                                        nxt + k * np.int64(REFILL_NS),
                                        nxt))
-            ok = unlimited | (PKT_SIZE <= bal2)
-            bal3 = jnp.where(~unlimited & ok, bal2 - PKT_SIZE, bal2)
+            ok = unlimited | (st["_psize"] <= bal2)
+            bal3 = jnp.where(~unlimited & ok, bal2 - st["_psize"], bal2)
             st = dict(st)
             st[f"r{r}_bal"] = jnp.where(mask, bal3, bal)
             st[f"r{r}_next"] = jnp.where(mask, nxt2, nxt)
@@ -492,34 +508,135 @@ class PholdSpanRunner:
                 st["sq_pos"] = jnp.where(pop, st["sq_pos"] + 1,
                                          st["sq_pos"])
                 st["send_bytes"] = jnp.where(
-                    pop, st["send_bytes"] - PKT_SIZE, st["send_bytes"])
+                    pop, st["send_bytes"] - st["_psize"], st["send_bytes"])
                 st["queued"] = jnp.where(
                     pop, (st["sq_len"] > st["sq_pos"]).astype(jnp.int32),
                     st["queued"])
+                # pull_out_packet guards the writable set with
+                # !(status & S_CLOSED) — a closed (process-exited)
+                # socket's draining queue must not re-set the bit
                 st = set_status(st, jnp.uint32(S_WRITABLE),
-                                jnp.uint32(0), pop, now)
+                                jnp.uint32(0),
+                                pop & (st["sock_closed"] == 0), now)
                 st = dict(st)
                 st["eth_psent"] = jnp.where(pop, st["eth_psent"] + 1,
                                             st["eth_psent"])
                 st["eth_bsent"] = jnp.where(
-                    pop, st["eth_bsent"] + PKT_SIZE, st["eth_bsent"])
+                    pop, st["eth_bsent"] + st["_psize"], st["eth_bsent"])
                 st = tr_append(st, pop, now, TR_SND, pk, RSN_NONE)
             else:
-                # codel dequeue, quiescent path only (AQM-active state
-                # is outside the modelled domain -> abort, fall back)
+                # full CoDel (codel_pop twin, netplane.cpp): one
+                # dequeue_raw per micro-op; the drop while-loop and the
+                # leading-drop sniff unroll across micro-ops via the
+                # cd_chain / cd_sniff substates.
                 st["cq_pos"] = jnp.where(pop, st["cq_pos"] + 1,
                                          st["cq_pos"])
                 st["codel_bytes"] = jnp.where(
-                    pop, st["codel_bytes"] - PKT_SIZE,
+                    pop, st["codel_bytes"] - st["_psize"],
                     st["codel_bytes"])
-                active = pop & ((now - enq) >= CODEL_TARGET_NS) & (
-                    st["codel_bytes"] > MTU)
-                st = mark_abort(st, active.any(), AB_STRUCT)
-                st = dict(st)
+                # dequeue_raw's ok/first_above law
+                sojourn = now - enq
+                quiet = pop & ((sojourn < CODEL_TARGET_NS)
+                               | (st["codel_bytes"] <= MTU))
+                above = pop & ~quiet
+                arm = above & (st["codel_first_above"] == 0)
+                cok = above & ~arm & (now >= st["codel_first_above"])
                 st["codel_first_above"] = jnp.where(
-                    pop | none, 0, st["codel_first_above"])
+                    quiet | none, 0,
+                    jnp.where(arm, now + np.int64(100_000_000),
+                              st["codel_first_above"]))
                 st["codel_dropping"] = jnp.where(none, 0,
                                                  st["codel_dropping"])
+                st["cd_chain"] = jnp.where(none, 0, st["cd_chain"])
+                st["cd_sniff"] = jnp.where(none, 0, st["cd_sniff"])
+
+                def control_time(t, count):
+                    v = count << 32
+                    g = jnp.sqrt(v.astype(jnp.float64)).astype(jnp.int64)
+                    g = jnp.where(g * g > v, g - 1, g)
+                    g = jnp.where(g * g > v, g - 1, g)
+                    g = jnp.where((g + 1) * (g + 1) <= v, g + 1, g)
+                    g = jnp.where((g + 1) * (g + 1) <= v, g + 1, g)
+                    g = jnp.maximum(g, 1)
+                    return t + (np.int64(100_000_000) << 16) // g
+
+                in_sniff = st["cd_sniff"] == 1
+                in_chain = (st["cd_chain"] == 1) & ~in_sniff
+                top = pop & ~in_sniff & ~in_chain
+
+                # --- sniff resolution (the dequeue after a leading
+                # drop): becomes the drop-state entry, id delivered
+                # regardless of its own ok bit.
+                sg = pop & in_sniff
+                cnt_new = jnp.where(
+                    now - st["codel_drop_next"] < np.int64(100_000_000),
+                    jnp.where(st["codel_count"] > 2,
+                              st["codel_count"] - st["codel_last_count"],
+                              1), 1)
+                st["codel_dropping"] = jnp.where(sg, 1,
+                                                 st["codel_dropping"])
+                st["codel_count"] = jnp.where(sg, cnt_new,
+                                              st["codel_count"])
+                st["codel_last_count"] = jnp.where(
+                    sg, cnt_new, st["codel_last_count"])
+                st["codel_drop_next"] = jnp.where(
+                    sg, control_time(now, cnt_new),
+                    st["codel_drop_next"])
+                st["cd_sniff"] = jnp.where(sg, 0, st["cd_sniff"])
+
+                # --- chain continuation: post-dequeue drop_next update
+                # (engine does it after each ok re-dequeue), then the
+                # while condition decides drop-or-deliver.
+                cg = pop & in_chain
+                cg_exit = cg & ~cok
+                st["codel_dropping"] = jnp.where(cg_exit, 0,
+                                                 st["codel_dropping"])
+                st["cd_chain"] = jnp.where(cg_exit, 0, st["cd_chain"])
+                cg_ok = cg & cok
+                dn2 = control_time(st["codel_drop_next"],
+                                   st["codel_count"])
+                st["codel_drop_next"] = jnp.where(
+                    cg_ok, dn2, st["codel_drop_next"])
+                cg_drop = cg_ok & (now >= st["codel_drop_next"])
+                cg_deliver = cg_ok & ~cg_drop
+                st["cd_chain"] = jnp.where(cg_deliver, 0,
+                                           st["cd_chain"])
+
+                # --- top entry while in drop state
+                td = top & (st["codel_dropping"] == 1)
+                td_exit = td & ~cok
+                st["codel_dropping"] = jnp.where(td_exit, 0,
+                                                 st["codel_dropping"])
+                td_ok = td & cok
+                td_drop = td_ok & (now >= st["codel_drop_next"])
+                st["cd_chain"] = jnp.where(td_drop, 1, st["cd_chain"])
+
+                # --- leading-edge drop (AQM trigger).  `~td`: a lane
+                # that ENTERED this dequeue in drop-state took the
+                # if-branch (engine's else-if) even when it just
+                # cleared dropping.
+                tl = top & ~td & cok & (
+                    (now - st["codel_drop_next"] < np.int64(100_000_000))
+                    | (now - st["codel_first_above"]
+                       >= np.int64(100_000_000)))
+                st["cd_sniff"] = jnp.where(tl, 1, st["cd_sniff"])
+
+                codel_drop = cg_drop | td_drop | tl
+                # chain drops advance count; the leading drop does not
+                st["codel_count"] = jnp.where(
+                    cg_drop | td_drop, st["codel_count"] + 1,
+                    st["codel_count"])
+                st["codel_dropped"] = jnp.where(
+                    codel_drop, st["codel_dropped"] + 1,
+                    st["codel_dropped"])
+                st["app_pkts_dropped"] = jnp.where(
+                    codel_drop, st["app_pkts_dropped"] + 1,
+                    st["app_pkts_dropped"])
+                st = tr_append(st, codel_drop, now, TR_DRP, pk, 1)
+                st = dict(st)
+                # dropped lanes stay in the drain (next micro-op
+                # re-dequeues); delivered lanes carry on below
+                pop = pop & ~codel_drop
 
             has_pkt = use_pend | pop
             st, ok, when = bucket_try(st, r, now, has_pkt)
@@ -565,15 +682,16 @@ class PholdSpanRunner:
                 st["eth_precv"] = jnp.where(fwd, st["eth_precv"] + 1,
                                             st["eth_precv"])
                 st["eth_brecv"] = jnp.where(
-                    fwd, st["eth_brecv"] + PKT_SIZE, st["eth_brecv"])
-                wrong = fwd & (pk["dport"] != st["m_port"])
+                    fwd, st["eth_brecv"] + st["_psize"], st["eth_brecv"])
+                wrong = fwd & ((pk["dport"] != st["m_port"])
+                               | (st["sock_closed"] == 1))
                 st["app_pkts_dropped"] = jnp.where(
                     wrong, st["app_pkts_dropped"] + 1,
                     st["app_pkts_dropped"])
                 st = tr_append(st, wrong, now, TR_DRP, pk, RSN_NOSOCK)
                 st = dict(st)
                 deliver = fwd & ~wrong
-                full = deliver & (st["recv_bytes"] + PKT_SIZE
+                full = deliver & (st["recv_bytes"] + st["_psize"]
                                   > st["recv_max"])
                 st["app_pkts_dropped"] = jnp.where(
                     full, st["app_pkts_dropped"] + 1,
@@ -592,7 +710,7 @@ class PholdSpanRunner:
                 st["rq_len"] = jnp.where(good, st["rq_len"] + 1,
                                          st["rq_len"])
                 st["recv_bytes"] = jnp.where(
-                    good, st["recv_bytes"] + PKT_SIZE,
+                    good, st["recv_bytes"] + st["_psize"],
                     st["recv_bytes"])
                 st = set_status(st, jnp.uint32(S_READABLE),
                                 jnp.uint32(0), good, now)
@@ -624,7 +742,7 @@ class PholdSpanRunner:
             st[state_k] = jnp.where(fresh, 3, st[state_k])
             st["app_sys"] = st["app_sys"].at[:, ASYS_SENDTO].add(
                 jnp.where(mask, 1, 0))
-            over = mask & (st["send_bytes"] + PKT_SIZE
+            over = mask & (st["send_bytes"] + st["_psize"]
                            > st["send_max"])
             st = set_status(st, jnp.uint32(0), jnp.uint32(S_WRITABLE),
                             over, now)
@@ -654,7 +772,7 @@ class PholdSpanRunner:
             st["sq_len"] = jnp.where(sent, st["sq_len"] + 1,
                                      st["sq_len"])
             st["send_bytes"] = jnp.where(
-                sent, st["send_bytes"] + PKT_SIZE, st["send_bytes"])
+                sent, st["send_bytes"] + st["_psize"], st["send_bytes"])
             st[state_k] = jnp.where(sent, 0, st[state_k])
             newly = sent & (st["queued"] == 0)
             st["queued"] = jnp.where(newly, 1, st["queued"])
@@ -680,8 +798,150 @@ class PholdSpanRunner:
             return th_push(st, mask, now + d, sq, TK_APP_TIMEOUT,
                            1 if is_seed else 0)
 
+        def mesh_try_exit(st, mask):
+            """mesh_try_exit twin: when both thread parts are done,
+            the process exits — fd closes WITHOUT a counted syscall
+            (fds.close_all), recv queue dies with it, send queue keeps
+            draining."""
+            now = st["now"]
+            both = mask & (st["m_partdone"] == 1) \
+                & (st["s_partdone"] == 1) & (st["sock_closed"] == 0)
+            st = dict(st)
+            st["sock_closed"] = jnp.where(both, 1, st["sock_closed"])
+            # udp_close's adjust_status: set CLOSED, clear
+            # ACTIVE|READABLE|WRITABLE (no wakes: both parts done)
+            st = set_status(st, jnp.uint32(1 << 3),
+                            jnp.uint32((1 << 0) | S_READABLE
+                                       | S_WRITABLE), both, now)
+            st = dict(st)
+            st["rq_pos"] = jnp.where(both, st["rq_len"], st["rq_pos"])
+            st["recv_bytes"] = jnp.where(both, 0, st["recv_bytes"])
+            st["m_exited"] = jnp.where(both, 1, st["m_exited"])
+            st["m_exit_time"] = jnp.where(both, now,
+                                          st["m_exit_time"])
+            return st
+
+        def op_step_mesh(st, mask, is_seed):
+            """udp-mesh micro-ops (app_step_mesh / app_step_mesh_snd
+            twins): the sender streams one datagram per micro-op
+            (engine: one udp_sendto per loop pass, each notifying the
+            relay synchronously); the main sinks one datagram per
+            micro-op."""
+            now = st["now"]
+            st = dict(st)
+            if is_seed:
+                first = mask & (st["s_state"] == 0)
+                st["app_sys"] = st["app_sys"].at[:, 7].add(
+                    jnp.where(first, st["n_peers"], 0))  # ASYS_RESOLVE
+                st["s_state"] = jnp.where(first, 1, st["s_state"])
+                sending = mask & (st["s_senti"] < st["s_count"])
+                st["app_sys"] = st["app_sys"].at[:, ASYS_SENDTO].add(
+                    jnp.where(sending, 1, 0))
+                over = sending & (st["send_bytes"] + st["_psize"]
+                                  > st["send_max"])
+                st = set_status(st, jnp.uint32(0),
+                                jnp.uint32(S_WRITABLE), over, now)
+                st = dict(st)
+                st["s_waitmask"] = jnp.where(over,
+                                             jnp.uint32(S_WRITABLE),
+                                             st["s_waitmask"])
+                st["s_waitseq"] = jnp.where(over, st["park_ctr"],
+                                            st["s_waitseq"])
+                st["park_ctr"] = jnp.where(over, st["park_ctr"] + 1,
+                                           st["park_ctr"])
+                st["cont"] = jnp.where(over, C_IDLE, st["cont"])
+                sent = sending & ~over
+                pseq = st["packet_seq"]
+                st["packet_seq"] = jnp.where(sent, pseq + 1,
+                                             st["packet_seq"])
+                st = mark_abort(st, (sent & (st["sq_len"] - st["sq_pos"]
+                                             >= S - 1)).any(), AB_STRUCT)
+                st = dict(st)
+                npeers = jnp.maximum(st["n_peers"], 1)
+                pick = st["peers"][
+                    hidx, (st["s_senti"]
+                           % npeers.astype(jnp.int64)).astype(jnp.int32)]
+                tail = st["sq_len"] % S
+                rows = mrows(sent)
+                vals = {"srchost": hidx, "pseq": pseq,
+                        "sip": st["eth_ip"], "sport": st["m_port"],
+                        "dip": pick, "dport": st["m_port"]}
+                for kk in PK_KEYS:
+                    st[f"sq_{kk}"] = st[f"sq_{kk}"].at[rows, tail].set(
+                        vals[kk], mode="drop")
+                st["sq_len"] = jnp.where(sent, st["sq_len"] + 1,
+                                         st["sq_len"])
+                st["send_bytes"] = jnp.where(
+                    sent, st["send_bytes"] + st["_psize"],
+                    st["send_bytes"])
+                st["s_senti"] = jnp.where(sent, st["s_senti"] + 1,
+                                          st["s_senti"])
+                newly = sent & (st["queued"] == 0)
+                st["queued"] = jnp.where(newly, 1, st["queued"])
+                notify = newly & (st["r1_pending"] == 0)
+                # keep sending (possibly via a relay drain first)
+                st["cont"] = jnp.where(notify, C_R1,
+                                       jnp.where(sent, C_S_STEP,
+                                                 st["cont"]))
+                st["then"] = jnp.where(notify, C_S_STEP, st["then"])
+                done = mask & ~sending
+                st["app_sys"] = st["app_sys"].at[:, 6].add(
+                    jnp.where(done, 1, 0))  # ASYS_WRITE ("mesh sent")
+                st["out_first"] = jnp.where(
+                    done & (st["out_first"] == 0), 2, st["out_first"])
+                st["s_partdone"] = jnp.where(done, 1,
+                                             st["s_partdone"])
+                st["s_exited"] = jnp.where(done, 1, st["s_exited"])
+                st["s_exit_time"] = jnp.where(done, now,
+                                              st["s_exit_time"])
+                st["s_waitmask"] = jnp.where(done, jnp.uint32(0),
+                                             st["s_waitmask"])
+                st["cont"] = jnp.where(done, C_IDLE, st["cont"])
+                st = mesh_try_exit(st, done)
+            else:
+                expect = st["s_count"] * st["_pay"]
+                st["app_sys"] = st["app_sys"].at[:, ASYS_RECVFROM].add(
+                    jnp.where(mask, 1, 0))
+                empty = mask & (st["rq_len"] <= st["rq_pos"])
+                st["m_waitmask"] = jnp.where(empty,
+                                             jnp.uint32(S_READABLE),
+                                             st["m_waitmask"])
+                st["m_waitseq"] = jnp.where(empty, st["park_ctr"],
+                                            st["m_waitseq"])
+                st["park_ctr"] = jnp.where(empty, st["park_ctr"] + 1,
+                                           st["park_ctr"])
+                st["cont"] = jnp.where(empty, C_IDLE, st["cont"])
+                got = mask & ~empty
+                st["rq_pos"] = jnp.where(got, st["rq_pos"] + 1,
+                                         st["rq_pos"])
+                st["recv_bytes"] = jnp.where(
+                    got, st["recv_bytes"] - st["_psize"],
+                    st["recv_bytes"])
+                now_empty = got & (st["rq_len"] <= st["rq_pos"])
+                st = set_status(st, jnp.uint32(0),
+                                jnp.uint32(S_READABLE), now_empty, now)
+                st = dict(st)
+                st["m_gotn"] = jnp.where(got,
+                                         st["m_gotn"] + st["_pay"],
+                                         st["m_gotn"])
+                more = got & (st["m_gotn"] < expect)
+                st["cont"] = jnp.where(more, C_M_STEP, st["cont"])
+                fin = got & ~more
+                st["app_sys"] = st["app_sys"].at[:, 6].add(
+                    jnp.where(fin, 1, 0))  # ASYS_WRITE ("mesh received")
+                st["out_first"] = jnp.where(
+                    fin & (st["out_first"] == 0), 1, st["out_first"])
+                st["m_partdone"] = jnp.where(fin, 1, st["m_partdone"])
+                st["m_waitmask"] = jnp.where(fin, jnp.uint32(0),
+                                             st["m_waitmask"])
+                st["cont"] = jnp.where(fin, C_IDLE, st["cont"])
+                st = mesh_try_exit(st, fin)
+            return st
+
         def op_step(st, mask, is_seed):
             """C_M_STEP / C_S_STEP micro-op."""
+            if family == 1:
+                return op_step_mesh(st, mask, is_seed)
             state_k = "s_state" if is_seed else "m_state"
             st = dict(st)
             restart = mask & (st[state_k] == 1)
@@ -707,7 +967,10 @@ class PholdSpanRunner:
             return st
 
         def op_stage2(st, mask):
-            """C_M_RECV / C_S_POST micro-op."""
+            """C_M_RECV / C_S_POST micro-op (phold only; mesh
+            steppers never use these continuations)."""
+            if family == 1:
+                return st
             now = st["now"]
             m_recv = mask & (st["cont"] == C_M_RECV)
             s_post = mask & (st["cont"] == C_S_POST)
@@ -726,7 +989,7 @@ class PholdSpanRunner:
             st["rq_pos"] = jnp.where(got, st["rq_pos"] + 1,
                                      st["rq_pos"])
             st["recv_bytes"] = jnp.where(
-                got, st["recv_bytes"] - PKT_SIZE, st["recv_bytes"])
+                got, st["recv_bytes"] - st["_psize"], st["recv_bytes"])
             now_empty = got & (st["rq_len"] <= st["rq_pos"])
             st = set_status(st, jnp.uint32(0), jnp.uint32(S_READABLE),
                             now_empty, now)
@@ -775,9 +1038,23 @@ class PholdSpanRunner:
             st["events_run"] = jnp.where(due, st["events_run"] + 1,
                                          st["events_run"])
 
-            # arrival: inbox -> codel -> relay 2
+            # arrival: inbox -> codel -> relay 2.  At the engine's
+            # hard limit CoDelN::push refuses and the arrival drops
+            # with an rtr-limit breadcrumb (run_until twin).
             arr = due & pick_ib
             st["ib_pos"] = jnp.where(arr, pos + 1, pos)
+            limit_full = arr & (st["cq_len"] - st["cq_pos"]
+                                >= CODEL_HARD_LIMIT)
+            st["codel_dropped"] = jnp.where(
+                limit_full, st["codel_dropped"] + 1,
+                st["codel_dropped"])
+            st["app_pkts_dropped"] = jnp.where(
+                limit_full, st["app_pkts_dropped"] + 1,
+                st["app_pkts_dropped"])
+            pk_arr = {kk: st[f"ib_{kk}"][hidx, safe] for kk in PK_KEYS}
+            st = tr_append(st, limit_full, et, TR_DRP, pk_arr, 2)
+            st = dict(st)
+            arr = arr & ~limit_full
             st = mark_abort(st, (arr & (st["cq_len"] - st["cq_pos"]
                                         >= C - 1)).any(), AB_STRUCT)
             st = dict(st)
@@ -791,7 +1068,7 @@ class PholdSpanRunner:
             st["cq_len"] = jnp.where(arr, st["cq_len"] + 1,
                                      st["cq_len"])
             st["codel_bytes"] = jnp.where(
-                arr, st["codel_bytes"] + PKT_SIZE, st["codel_bytes"])
+                arr, st["codel_bytes"] + st["_psize"], st["codel_bytes"])
             go2 = arr & (st["r2_pending"] == 0)
             st["cont"] = jnp.where(go2, C_R2, st["cont"])
             st["then"] = jnp.where(go2, C_IDLE, st["then"])
@@ -826,7 +1103,8 @@ class PholdSpanRunner:
             st["s_waitmask"] = jnp.where(s_app, jnp.uint32(0),
                                          st["s_waitmask"])
             s_live = s_app & (st["s_exited"] == 0)
-            st["cont"] = jnp.where(m_app, C_M_STEP,
+            m_live = m_app & (st["m_exited"] == 0)
+            st["cont"] = jnp.where(m_live, C_M_STEP,
                                    jnp.where(s_live, C_S_STEP,
                                              st["cont"]))
             return st
@@ -988,9 +1266,11 @@ class PholdSpanRunner:
 
         @jax.jit
         def run(st, lat, thr, node, ips_sorted, ips_perm, k0, k1,
-                bootstrap_end, start, stop, limit, runahead,
+                bootstrap_end, pay, start, stop, limit, runahead,
                 max_rounds):
             st = dict(st)
+            st["_pay"] = jnp.int64(pay)
+            st["_psize"] = jnp.int64(pay) + 28
             st["_lat"] = lat
             st["_thr"] = thr
             st["_node"] = node
@@ -1023,10 +1303,7 @@ class PholdSpanRunner:
                               ("tr_reason", jnp.int32),
                               ("tr_owner", jnp.int32)):
                     st[k] = jnp.zeros(TR, dt)
-            # AQM-active CoDel state is outside the modelled domain
-            st = mark_abort(st, (st["codel_dropping"] == 1).any()
-                            | (st["codel_first_above"] != 0).any(),
-                            AB_STRUCT)
+
             carry = (st, jnp.int64(start), jnp.int64(runahead),
                      jnp.int64(0), jnp.int64(0), jnp.int64(0),
                      jnp.int64(start), jnp.int64(stop),
@@ -1069,7 +1346,7 @@ class PholdSpanRunner:
             # transiently beyond the ring caps (burst): retry later
             self.over_caps += 1
             return None
-        st = self._to_arrays(d)
+        st = self._to_arrays(d)  # also sets self.family/_pay
         if self._fn is None:
             self._fn = self._cached_build(st["peers"].shape[1])
         if self.mesh is not None:
@@ -1088,8 +1365,8 @@ class PholdSpanRunner:
                 st, self._lat, self._thr, self._node,
                 self._ips_sorted, self._ips_perm,
                 np.uint32(self._k[0]), np.uint32(self._k[1]),
-                np.int64(self.bootstrap_end), start, stop, limit,
-                runahead, mr)
+                np.int64(self.bootstrap_end), np.int64(self._pay),
+                start, stop, limit, runahead, mr)
             (st_out, next_start, ra, rounds, busy_rounds, packets,
              busy_end) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
@@ -1134,7 +1411,7 @@ class PholdSpanRunner:
                 "dip": st_np["tr_dip"][:n].astype(np.uint32).tobytes(),
                 "dport": st_np["tr_dport"][:n].astype(
                     np.int32).tobytes(),
-                "size": np.full(n, PAYLOAD_LEN, np.int64).tobytes(),
+                "size": np.full(n, self._pay, np.int64).tobytes(),
                 "reason": st_np["tr_reason"][:n].astype(
                     np.uint8).tobytes(),
                 "owner": st_np["tr_owner"][:n].astype(

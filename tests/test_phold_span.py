@@ -143,3 +143,106 @@ graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
     m, s = run_simulation(cfg)
     assert s.ok
     assert m._dev_span is None or m._dev_span.spans == 0
+
+
+def mesh_cfg(scheduler: str, n: int = 8, count: int = 30,
+             size: int = 400, bw: str = "1 Mbit", loss: float = 0.02,
+             sbuf: str = "8 KiB", seed: int = 29,
+             device_spans: str | None = None):
+    """udp-mesh family: every host one main sink + one sender thread
+    over a shared bound socket (the round-1 benchmark workload),
+    paced by tight bandwidth so the sim spans many windows."""
+    names = [f"m{i:02d}" for i in range(n)]
+    hosts = {}
+    for i, name in enumerate(names):
+        peers = " ".join(p for p in names if p != name)
+        hosts[name] = {"network_node_id": 0, "processes": [{
+            "path": "udp-mesh", "args": f"9000 {count} {size} {peers}",
+            "start_time": "100ms", "expected_final_state": "any"}]}
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "30s", "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": f"""
+graph [ node [ id 0 host_bandwidth_down "{bw}" host_bandwidth_up "{bw}" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss {loss} ] ]"""}},
+        "experimental": {"scheduler": scheduler,
+                         "socket_send_buffer": sbuf},
+        "hosts": hosts})
+    if device_spans is not None:
+        cfg.experimental.tpu_device_spans = device_spans
+    return cfg
+
+
+def _stdout(m):
+    return sorted((p.name, bytes(p.stdout))
+                  for h in m.hosts for p in h.processes.values())
+
+
+def test_udp_mesh_device_span_byte_identical():
+    """The udp-mesh family on the device loop: dual-thread apps
+    (sender EAGAIN-parks on a saturated buffer, wake ordering by
+    wait_seq), loss draws, process exit with socket close and ordered
+    stdout lines — all stepped on-device, byte-identical to serial."""
+    m_ser, s_ser = run_simulation(mesh_cfg("serial"))
+    m_dev, s_dev = run_simulation(mesh_cfg("tpu", device_spans="force"))
+    assert s_ser.ok and s_dev.ok
+    r = m_dev._dev_span
+    assert r is not None and r.family == 1
+    assert r.spans > 0 and r.aborts == 0, (r.spans, r.aborts)
+    assert r.rounds * 2 >= s_dev.rounds, \
+        f"only {r.rounds}/{s_dev.rounds} rounds on device"
+    assert s_dev.packets_dropped == s_ser.packets_dropped > 0
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    assert _hist(m_ser) == _hist(m_dev)
+    assert _stdout(m_ser) == _stdout(m_dev)
+
+
+def test_udp_mesh_device_span_second_seed():
+    kw = dict(seed=63)
+    m_ser, s_ser = run_simulation(mesh_cfg("serial", **kw))
+    m_dev, s_dev = run_simulation(mesh_cfg("tpu", device_spans="force",
+                                           **kw))
+    r = m_dev._dev_span
+    assert r.spans > 0 and r.aborts == 0
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    assert _hist(m_ser) == _hist(m_dev)
+    assert _stdout(m_ser) == _stdout(m_dev)
+
+
+def test_udp_mesh_device_span_codel_active():
+    """Sustained overload (fast up, slow down) drives CoDel into its
+    ACTIVE regime — leading drops, drop chains with the control-law
+    interval (isqrt), state re-entry — all stepped on-device and
+    byte-identical to serial, including every 'codel' breadcrumb."""
+    def build(scheduler, force=False):
+        n, count, size = 10, 60, 900
+        names = [f"m{i:02d}" for i in range(n)]
+        hosts = {}
+        for i, name in enumerate(names):
+            peers = " ".join(p for p in names if p != name)
+            hosts[name] = {"network_node_id": 0, "processes": [{
+                "path": "udp-mesh",
+                "args": f"9000 {count} {size} {peers}",
+                "start_time": "100ms", "expected_final_state": "any"}]}
+        cfg = ConfigOptions.from_dict({
+            "general": {"stop_time": "60s", "seed": 41},
+            "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "400 Kbit" host_bandwidth_up "10 Mbit" ]
+  edge [ source 0 target 0 latency "10 ms" ] ]"""}},
+            "experimental": {"scheduler": scheduler,
+                             "socket_send_buffer": "64 KiB"},
+            "hosts": hosts})
+        if force:
+            cfg.experimental.tpu_device_spans = "force"
+        return cfg
+
+    m_ser, s_ser = run_simulation(build("serial"))
+    codel = sum(1 for ln in m_ser.trace_lines()
+                if ln.endswith("codel"))
+    assert codel > 1000, f"config no longer AQM-active ({codel})"
+    m_dev, s_dev = run_simulation(build("tpu", force=True))
+    r = m_dev._dev_span
+    assert r.spans > 0 and r.aborts == 0, (r.spans, r.aborts)
+    assert r.rounds * 2 >= s_dev.rounds
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    assert _hist(m_ser) == _hist(m_dev)
+    assert _stdout(m_ser) == _stdout(m_dev)
