@@ -159,6 +159,40 @@ def phold_yaml(n_hosts: int, n_init: int = 3,
             f"hosts:\n" + "\n".join(blocks) + "\n")
 
 
+def mesh_family_yaml(n_hosts: int, count: int = 30, size: int = 400,
+                     bw_down: str = "1 Mbit", bw_up: str = "1 Mbit",
+                     loss: float = 0.02, latency: str = "10 ms",
+                     sbuf: str = "8 KiB", seed: int = 29,
+                     stop_time: str = "30s", scheduler: str = "serial",
+                     device_spans: str | None = None) -> str:
+    """Paced udp-mesh: every host ONE udp-mesh process (main sink +
+    sender thread over a shared bound socket), bandwidth-paced so the
+    sim spans many windows — the device-span mesh-family workload
+    (tests/test_phold_span.py and the multichip dryrun share it)."""
+    names = [f"m{i:02d}" for i in range(n_hosts)]
+    blocks = []
+    for name in names:
+        peers = " ".join(p for p in names if p != name)
+        blocks.append(
+            f"  {name}:\n    network_node_id: 0\n    processes:\n"
+            f'      - {{ path: udp-mesh, args: "9000 {count} {size} '
+            f'{peers}", start_time: 100ms, '
+            f"expected_final_state: any }}")
+    exp = [f"  scheduler: {scheduler}",
+           f"  socket_send_buffer: {sbuf}"]
+    if device_spans is not None:
+        exp.append(f"  tpu_device_spans: {device_spans}")
+    loss_s = f" packet_loss {loss}" if loss else ""
+    gml = (f'graph [ node [ id 0 host_bandwidth_down "{bw_down}" '
+           f'host_bandwidth_up "{bw_up}" ] '
+           f'edge [ source 0 target 0 latency "{latency}"{loss_s} ] ]')
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+            f"network:\n  graph:\n    type: gml\n    inline: |\n"
+            f"{_indent(gml, '      ')}\n"
+            f"experimental:\n" + "\n".join(exp) + "\n"
+            f"hosts:\n" + "\n".join(blocks) + "\n")
+
+
 def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
                    nbytes: int = 100_000, count: int = 1,
                    stop_time: str = "60s", seed: int = 1,

@@ -149,27 +149,12 @@ def mesh_cfg(scheduler: str, n: int = 8, count: int = 30,
              size: int = 400, bw: str = "1 Mbit", loss: float = 0.02,
              sbuf: str = "8 KiB", seed: int = 29,
              device_spans: str | None = None):
-    """udp-mesh family: every host one main sink + one sender thread
-    over a shared bound socket (the round-1 benchmark workload),
-    paced by tight bandwidth so the sim spans many windows."""
-    names = [f"m{i:02d}" for i in range(n)]
-    hosts = {}
-    for i, name in enumerate(names):
-        peers = " ".join(p for p in names if p != name)
-        hosts[name] = {"network_node_id": 0, "processes": [{
-            "path": "udp-mesh", "args": f"9000 {count} {size} {peers}",
-            "start_time": "100ms", "expected_final_state": "any"}]}
-    cfg = ConfigOptions.from_dict({
-        "general": {"stop_time": "30s", "seed": seed},
-        "network": {"graph": {"type": "gml", "inline": f"""
-graph [ node [ id 0 host_bandwidth_down "{bw}" host_bandwidth_up "{bw}" ]
-  edge [ source 0 target 0 latency "10 ms" packet_loss {loss} ] ]"""}},
-        "experimental": {"scheduler": scheduler,
-                         "socket_send_buffer": sbuf},
-        "hosts": hosts})
-    if device_spans is not None:
-        cfg.experimental.tpu_device_spans = device_spans
-    return cfg
+    """udp-mesh family workload (shared generator: netgen)."""
+    from shadow_tpu.tools.netgen import mesh_family_yaml
+    return ConfigOptions.from_yaml_text(mesh_family_yaml(
+        n, count=count, size=size, bw_down=bw, bw_up=bw, loss=loss,
+        sbuf=sbuf, seed=seed, scheduler=scheduler,
+        device_spans=device_spans))
 
 
 def _stdout(m):
