@@ -853,23 +853,29 @@ class Manager:
                 w_eth.close()
         return summary
 
+    def make_dev_span_runner(self):
+        """Construct the device-span runner for this simulation (the
+        one place its arguments are derived — the multichip dryrun
+        reuses this and attaches a device mesh before the run)."""
+        from shadow_tpu.ops.phold_span import PholdSpanRunner
+        tracing = any(h.tracing_enabled for h in self.hosts)
+        return PholdSpanRunner(
+            self.plane.engine, self.graph.latency_ns,
+            self.loss_thresholds,
+            np.ascontiguousarray(
+                [h.node_index for h in self.hosts], dtype=np.int32),
+            np.ascontiguousarray([h.ip for h in self.hosts],
+                                 dtype=np.uint32),
+            self.config.general.seed,
+            self.config.general.bootstrap_end_time_ns, tracing)
+
     def _device_span(self, start: int, stop: int, limit: int,
                      max_rounds: int):
         """Attempt one device-resident multi-round span (lazily builds
         the PholdSpanRunner).  None = ineligible or aborted (the engine
         state is untouched either way — transactional)."""
         if self._dev_span is None:
-            from shadow_tpu.ops.phold_span import PholdSpanRunner
-            tracing = any(h.tracing_enabled for h in self.hosts)
-            self._dev_span = PholdSpanRunner(
-                self.plane.engine, self.graph.latency_ns,
-                self.loss_thresholds,
-                np.ascontiguousarray(
-                    [h.node_index for h in self.hosts], dtype=np.int32),
-                np.ascontiguousarray([h.ip for h in self.hosts],
-                                     dtype=np.uint32),
-                self.config.general.seed,
-                self.config.general.bootstrap_end_time_ns, tracing)
+            self._dev_span = self.make_dev_span_runner()
         return self._dev_span.try_span(
             start, stop, limit, self.runahead.get(),
             self.runahead.dynamic, max_rounds)
