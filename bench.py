@@ -179,18 +179,31 @@ graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
         "hosts": hosts})
 
 
-def run_once(build, scheduler: str, report_routes: str | None = None):
+def run_once(build, scheduler: str, report_routes: str | None = None,
+             devcap: bool = False):
     from shadow_tpu.core.manager import Manager
 
     manager = Manager(build(scheduler))
     for h in manager.hosts:
         h.set_tracing(False)
+    if devcap and manager.plane is not None:
+        # Opt-in per-round probe: how much of the run sat inside the
+        # TCP device-span family's structural domain (ISSUE 1).  Off
+        # by default — the scan costs ~1% at 10k hosts and must not
+        # taint the other trials' walls.
+        manager.plane.engine.set_devcap_probe(1)
     t0 = time.perf_counter()
     summary = manager.run()
     wall = time.perf_counter() - t0
     if report_routes is not None:
         print(f"bench[{report_routes}]: {route_split(manager)}",
               file=sys.stderr)
+    if devcap and manager.plane is not None:
+        rt, rf, steps, ok = manager.plane.engine.devcap_counters()
+        frac = 100.0 * ok / steps if steps else 0.0
+        print(f"bench[{report_routes or 'devcap'}]: TCP device-capable "
+              f"rounds {rf}/{rt} fully, {frac:.1f}% of round-host "
+              f"steps in-domain", file=sys.stderr)
     return summary, wall
 
 
@@ -257,6 +270,40 @@ def phold_rung() -> None:
           f"({s_cpp.packets_sent / max(w_cpp, 1e-9):.0f} msgs/s)",
           file=sys.stderr)
 
+    # Device-span scaling rung above 1k LPs (VERDICT r5 weak #2): the
+    # same PHOLD workload at 8k hosts, with the measured per-dispatch
+    # floor printed at both scales — the host-count crossover vs C++
+    # spans becomes a modelled number, not a guess.
+    def run8k(device_spans=None):
+        text = phold_yaml(8192, n_init=1, mean_delay_ns=50_000_000,
+                          stop_time="0.3s", seed=13, scheduler="tpu",
+                          device_spans=device_spans)
+        manager = Manager(ConfigOptions.from_yaml_text(text))
+        for h in manager.hosts:
+            h.set_tracing(False)
+        t0 = time.perf_counter()
+        summary = manager.run()
+        return manager, summary, time.perf_counter() - t0
+
+    _m8c, s8_cpp, w8_cpp = run8k()
+    m8, s8, w8 = run8k("force")
+    r8 = m8._dev_span
+    if r8 is not None and r8.spans > 0:
+        per_dispatch_ms = 1e3 * w8 / r8.spans
+        per_round_us = 1e6 * w8 / max(r8.rounds, 1)
+        per_dispatch_1k = 1e3 * w_dev / max(r.spans, 1)
+        print(f"bench[phold-8k]: {s8.packets_sent} messages, device "
+              f"{r8.rounds}/{s8.rounds} rounds "
+              f"({r8.spans} dispatches, aborts {r8.aborts}) in "
+              f"{w8:.1f}s vs C++ span {w8_cpp:.1f}s; per-dispatch "
+              f"floor {per_dispatch_ms:.1f} ms @8k vs "
+              f"{per_dispatch_1k:.1f} ms @1k, device per-round "
+              f"{per_round_us:.0f} us @8k", file=sys.stderr)
+    else:
+        print(f"bench[phold-8k]: device spans did not run "
+              f"(spans={getattr(r8, 'spans', 0)}, "
+              f"aborts={getattr(r8, 'aborts', 0)})", file=sys.stderr)
+
     # udp-mesh family on the device loop (dual-thread apps, saturated
     # send buffers, loss) — a paced 24-host mesh so the sim spans many
     # windows (the full bench[mesh-100] burst collapses into a handful
@@ -280,6 +327,46 @@ def phold_rung() -> None:
           f"packets; device multi-round {r.rounds}/{sm.rounds} rounds "
           f"on device ({share:.0f}%, {r.spans} dispatches, aborts "
           f"{r.aborts}) in {w:.1f}s", file=sys.stderr)
+
+
+def tcp_dev_rung() -> None:
+    """TCP steady-stream device-span rung (ISSUE 1 tentpole): the
+    fixed-connection tgen tier with forced device spans — whole
+    conservative windows of per-connection TCP state (cwnd, SACK,
+    RTO/delack timers) stepped inside the lax.while_loop, reported as
+    the device-round share."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import Manager
+    from shadow_tpu.tools.netgen import tcp_stream_yaml
+
+    def run(device_spans=None):
+        text = tcp_stream_yaml(64, n_servers=8, nbytes=50_000_000,
+                               loss=0.005, stop_time="2s", seed=11,
+                               scheduler="tpu",
+                               device_spans=device_spans)
+        manager = Manager(ConfigOptions.from_yaml_text(text))
+        for h in manager.hosts:
+            h.set_tracing(False)
+        t0 = time.perf_counter()
+        summary = manager.run()
+        return manager, summary, time.perf_counter() - t0
+
+    _mc, s_cpp, w_cpp = run()
+    m, s, w = run("force")
+    r = m._dev_span_tcp
+    if r is None or r.spans == 0:
+        print(f"bench[tcp-dev]: device spans did not run "
+              f"(spans={getattr(r, 'spans', 0)}, aborts="
+              f"{getattr(r, 'aborts', 0)}, transient="
+              f"{getattr(r, 'over_caps', 0)})", file=sys.stderr)
+        return
+    share = 100.0 * r.rounds / max(s.rounds, 1)
+    print(f"bench[tcp-dev]: 64-host TCP stream tier, "
+          f"{s.packets_sent} packets ({s.packets_dropped} dropped on "
+          f"lossy edges); device multi-round {r.rounds}/{s.rounds} "
+          f"rounds on device ({share:.0f}%, {r.spans} dispatches, "
+          f"aborts {r.aborts}) in {w:.1f}s; C++ span path "
+          f"{s_cpp.packets_sent} pkts in {w_cpp:.1f}s", file=sys.stderr)
 
 
 def sharded_rung_subprocess() -> None:
@@ -355,20 +442,41 @@ def managed_rung() -> None:
             subprocess.run(["cc", "-O1", "-o", out, src], check=True)
             bins[name] = out
         from shadow_tpu.core.manager import run_simulation
-        t0 = time.perf_counter()
-        manager, summary = run_simulation(tms.scale_config(bins))
-        wall = time.perf_counter() - t0
+
+        def run_managed(scheduler, native):
+            cfg = tms.scale_config(bins)
+            cfg.experimental.scheduler = scheduler
+            cfg.experimental.native_dataplane = native
+            t0 = time.perf_counter()
+            manager, summary = run_simulation(cfg)
+            return manager, summary, time.perf_counter() - t0
+
+        # Comparator (VERDICT r5 missing #3): the SAME emulation
+        # workload under python thread_per_core and the engine-backed
+        # variant, so the emulator path's perf can ratchet instead of
+        # floating as a single uncomparable number.
+        _mb, sb, wall_base = run_managed("thread_per_core", "off")
+        manager, summary, wall = run_managed("thread_per_core", "on")
         n_procs = sum(len(h.processes) for h in manager.hosts)
-        ok = summary.ok
+        ok = summary.ok and sb.ok
         sim_s = summary.busy_end_ns / 1e9
         print(f"bench[managed-128]: {n_procs} real processes under the "
               f"shim, {summary.packets_sent} packets, "
-              f"{summary.syscalls} syscalls emulated, "
-              f"{sim_s / wall:.3f} sim-s/wall-s ({wall:.1f}s wall, "
-              f"ok={ok})", file=sys.stderr)
+              f"{summary.syscalls} syscalls emulated, engine-tpc "
+              f"{sim_s / wall:.3f} sim-s/wall-s ({wall:.1f}s wall), "
+              f"python-tpc {sb.busy_end_ns / 1e9 / wall_base:.3f} "
+              f"sim-s/wall-s ({wall_base:.1f}s wall), vs_baseline "
+              f"{wall_base / wall:.3f}, ok={ok}", file=sys.stderr)
 
 
 def main() -> None:
+    # Persistent XLA compile cache: the device-span kernels (PHOLD and
+    # especially the TCP family's multi-round while_loop) cost minutes
+    # of compile on the CPU backend; repeated bench runs must not pay
+    # it every time.  Harmless on accelerators (same mechanism).
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.expanduser("~/.cache/shadow_tpu_xla"))
     if not tpu_available():
         # 8 virtual CPU devices so the sharded rung below can run even
         # when the accelerator is down (must be set before the first
@@ -422,8 +530,10 @@ def main() -> None:
     baseE_summary = baseE_wall = None
     tpu_summary = tpu_wall = None
     tpu_walls = []
+    baseE_walls = []
     for trial in range(3):
         sE, wE = run_once(buildE, "thread_per_core")
+        baseE_walls.append(wE)
         if baseE_wall is None or wE < baseE_wall:
             baseE_summary, baseE_wall = sE, wE
         sT, wT = run_once(config_10k, "tpu",
@@ -431,6 +541,11 @@ def main() -> None:
         tpu_walls.append(wT)
         if tpu_wall is None or wT < tpu_wall:
             tpu_summary, tpu_wall = sT, wT
+    # Device-capability probe on a SEPARATE, non-recorded run: the
+    # per-round domain scan costs ~1% at 10k hosts and must not taint
+    # any trial that feeds the recorded walls/spread.
+    run_once(config_10k, "tpu", report_routes="10k-devcap",
+             devcap=True)
     assert baseE_summary.packets_sent == base_summary.packets_sent, \
         "engine baseline disagreed on workload size"
     print(f"bench[10k-baselines]: thread_per_core python "
@@ -473,6 +588,12 @@ def main() -> None:
     # The headline JSON prints BEFORE the auxiliary rungs: a tunnel
     # stall inside an optional rung must not cost the recorded result
     # (the driver reads stdout's JSON; rungs write stderr only).
+    def spread(walls):
+        ws = sorted(walls)
+        return {"min_s": round(ws[0], 3),
+                "median_s": round(ws[len(ws) // 2], 3),
+                "max_s": round(ws[-1], 3)}
+
     print(json.dumps({
         "metric": f"sim-seconds/wallclock-sec, {HOSTS_10K}-host Tor-class "
                   f"tgen TCP (scheduler=tpu vs engine-backed "
@@ -486,6 +607,11 @@ def main() -> None:
         # cold start is real user experience, not just narration.
         "cold_wall_s": round(tpu_walls[0], 3),
         "warm_wall_s": round(tpu_wall, 3),
+        # Full >=3-trial spread for BOTH sides of the headline ratio
+        # (VERDICT r5 weak #3): the recorded margin is ~6%, which a
+        # single interleaved pair cannot reproduce from the artifact.
+        "tpu_trials": spread(tpu_walls),
+        "engine_baseline_trials": spread(baseE_walls),
     }), flush=True)
 
     # Auxiliary rungs (stderr only).  A failure must not cost the
@@ -496,6 +622,7 @@ def main() -> None:
     for rung in ((sharded_10k_main if len(jax.devices()) >= 8
                   else sharded_rung_subprocess),
                  phold_rung,      # VERDICT r4 #2 (device multi-round)
+                 tcp_dev_rung,    # ISSUE 1: TCP device-span family
                  managed_rung):   # VERDICT r4 #3/#4 (real processes)
         try:
             rung()

@@ -3150,6 +3150,7 @@ struct Engine {
       ids.clear();
       for (int64_t i = 0; i < nt_len; i++)
         if (nt[i] < window_end) ids.push_back((uint32_t)i);
+      if (devcap_probe) devcap_count_round(ids.data(), (int64_t)ids.size());
       run_hosts_mt(ids.data(), (int64_t)ids.size(), window_end, nthreads);
       FinishResult f = finish_round(window_end);
       r.packets += f.n;
@@ -3312,6 +3313,234 @@ struct Engine {
         return false;
     }
     return true;
+  }
+
+  /* ====== TCP device-span shape (ops/tcp_span.py) ================
+   * The tgen steady-stream domain: every app is a tgen server
+   * (parked in accept, no churn), a tgen client mid-receive, or a
+   * server handler mid-send; every live connection ESTABLISHED and
+   * bulk-transferring (no handshake, no FIN/RST, uniform 'D'
+   * payloads so lengths reconstruct contents).  Everything outside
+   * the domain returns transient=1 — the caller falls back to the
+   * C++ span path for that stretch (ISSUE 1 tentpole; the fixed-
+   * connection rung in __graft_entry__ lives entirely inside it
+   * after the handshake prefix). */
+
+  struct TcpShape {
+    std::vector<int32_t> conn_host;  // per conn: owning host
+    std::vector<uint32_t> conn_tok;  // per conn: socket token
+    std::vector<int32_t> conn_app;   // per conn: owning app index
+    std::vector<uint8_t> conn_role;  // 0 = client (recv), 1 = handler
+    std::vector<int32_t> tok2conn;   // socket token -> conn idx or -1
+    std::vector<int32_t> app2conn;   // app idx -> conn idx or -1
+  };
+
+  static bool payload_pure(const std::string &p) {
+    return p.find_first_not_of('D') == std::string::npos;
+  }
+
+  /* One in-flight packet inside the modelled domain: an ESTABLISHED-
+   * state TCP segment (data or pure ack), options-free. */
+  bool tcp_pkt_in_domain(const PacketN *p) {
+    if (p == nullptr || p->proto != PROTO_TCP || !p->has_tcp)
+      return false;
+    const TcpHdrN &h = p->tcp;
+    if (h.flags & (F_SYN | F_FIN | F_RST)) return false;
+    if (!(h.flags & F_ACK)) return false;
+    if (h.mss >= 0 || h.wscale >= 0) return false;
+    return payload_pure(p->payload);
+  }
+
+  /* Connection-level domain check (content checks optional: the
+   * devcap probe runs per round and skips the O(bytes) scans). */
+  bool tcp_conn_in_domain(const TcpSocketN *s, bool check_content) {
+    const TcpConn *c = s->conn.get();
+    if (c == nullptr || c->state != ST_ESTABLISHED) return false;
+    if (!c->error.empty() || c->syn_retries != 0) return false;
+    if (c->snd_fin_pending || c->fin_seq >= 0) return false;
+    if (c->peer_fin_seq >= 0 || c->pending_fin_seq >= 0) return false;
+    if (c->time_wait_deadline >= 0) return false;
+    if (s->iface != 1 || !s->has_local || !s->has_peer) return false;
+    if (!s->out_packets[0].empty()) return false;  // no loopback
+    if (s->listening) return false;
+    if (!check_content) return true;
+    for (const RtxSeg &seg : c->rtx) {
+      if (seg.is_fin || seg.payload.empty()) return false;
+      if (!payload_pure(seg.payload)) return false;
+    }
+    for (const auto &ch : c->send_buf.chunks)
+      if (!payload_pure(ch)) return false;
+    for (const auto &ch : c->recv_buf.chunks)
+      if (!payload_pure(ch)) return false;
+    for (const auto &kv : c->reassembly)
+      if (!payload_pure(kv.second)) return false;
+    for (int i = 0; i < 2; i++)
+      for (uint64_t id : s->out_packets[i])
+        if (!tcp_pkt_in_domain(store.get(id))) return false;
+    return true;
+  }
+
+  /* 0 = in the tgen steady-stream domain, 1 = transiently outside
+   * it, 2 = structurally not a tgen-TCP sim.  Fills *sh on 0. */
+#define TCP_SHAPE_BAIL(code, what)                                     \
+  do {                                                                 \
+    if (getenv("SHADOWTPU_TCPSPAN_DBG"))                               \
+      fprintf(stderr, "[tcp_shape bail %d] %s\n", code, what);         \
+    return code;                                                       \
+  } while (0)
+  int tcp_shape(TcpShape *sh, bool check_content = true) {
+    size_t H = hosts.size();
+    sh->conn_host.clear();
+    sh->conn_tok.clear();
+    sh->conn_app.clear();
+    sh->conn_role.clear();
+    sh->tok2conn.assign(socks.size(), -1);
+    sh->app2conn.assign(apps.size(), -1);
+    for (size_t i = 0; i < apps.size(); i++) {
+      AppN &a = apps[i];
+      if (a.kind != APP_SERVER && a.kind != APP_CLIENT &&
+          a.kind != APP_HANDLER)
+        TCP_SHAPE_BAIL(2, "non-tgen app");
+      if (a.stopped) TCP_SHAPE_BAIL(1, "stopped app");
+      if (a.exited) continue;  // its socket is vetted below
+      if (a.hid < 0 || (size_t)a.hid >= H) TCP_SHAPE_BAIL(1, "bad hid");
+      if (a.kind == APP_SERVER) {
+        if (a.sock < 0) TCP_SHAPE_BAIL(1, "server no sock");
+        TcpSocketN *l = tcp((uint32_t)a.sock);
+        if (l == nullptr || !l->listening || !l->accept_q.empty())
+          TCP_SHAPE_BAIL(1, "listener state");
+        if (a.wake_pending) TCP_SHAPE_BAIL(1, "accept wake queued");
+        continue;
+      }
+      if (a.sock < 0) TCP_SHAPE_BAIL(1, "app no sock");
+      TcpSocketN *s = tcp((uint32_t)a.sock);
+      if (s == nullptr || s->conn == nullptr) TCP_SHAPE_BAIL(1, "no conn");
+      if (a.kind == APP_CLIENT) {
+        if (a.state != CL_RECV) TCP_SHAPE_BAIL(1, "client not in recv");
+        if (a.got >= a.nbytes) TCP_SHAPE_BAIL(1, "client done");
+        /* GET fully acked: the only client->server payload bytes are
+         * out of flight, so lengths reconstruct every buffer. */
+        if (!s->conn->rtx.empty() || s->conn->send_buf.len > 0)
+          TCP_SHAPE_BAIL(1, "client GET in flight");
+        sh->conn_role.push_back(0);
+      } else {  // APP_HANDLER
+        if (a.state != H_SEND || a.resp_n < 0 || a.sent >= a.resp_n)
+          TCP_SHAPE_BAIL(1, "handler not mid-send");
+        /* request consumed; nothing left to read */
+        if (s->conn->recv_buf.len > 0 || !s->conn->reassembly.empty())
+          TCP_SHAPE_BAIL(1, "handler unread data");
+        sh->conn_role.push_back(1);
+      }
+      if (!tcp_conn_in_domain(s, check_content)) {
+        sh->conn_role.pop_back();
+        TCP_SHAPE_BAIL(1, "conn out of domain");
+      }
+      sh->tok2conn[(size_t)a.sock] = (int32_t)sh->conn_host.size();
+      sh->app2conn[i] = (int32_t)sh->conn_host.size();
+      sh->conn_host.push_back(a.hid);
+      sh->conn_tok.push_back((uint32_t)a.sock);
+      sh->conn_app.push_back((int32_t)i);
+    }
+    /* sockets not owned by an in-domain app must be inert shells */
+    for (size_t t = 0; t < socks.size(); t++) {
+      SocketN *s = socks[t].get();
+      if (s == nullptr) continue;
+      if (s->proto != PROTO_TCP) TCP_SHAPE_BAIL(2, "stray UDP sock");
+      if (sh->tok2conn[t] >= 0) continue;
+      TcpSocketN *ts = static_cast<TcpSocketN *>(s);
+      if (ts->listening) continue;  // vetted via its server app
+      if (ts->conn != nullptr) TCP_SHAPE_BAIL(1, "un-owned live conn");
+      if (!ts->out_packets[0].empty() || !ts->out_packets[1].empty() ||
+          ts->queued[0] || ts->queued[1])
+        TCP_SHAPE_BAIL(1, "closed shell draining");
+    }
+    for (size_t h = 0; h < H; h++) {
+      HostPlane *hp = hosts[h].get();
+      if (hp == nullptr) TCP_SHAPE_BAIL(1, "null host");
+      if (hp->pcap_on[0] || hp->pcap_on[1]) TCP_SHAPE_BAIL(1, "pcap on");
+      if (hp->relays[0].state == RELAY_PENDING ||
+          hp->relays[0].pending != UINT64_MAX)
+        TCP_SHAPE_BAIL(1, "lo relay busy");
+      for (const TimerEnt &t : hp->theap) {
+        if (t.kind == TK_RELAY) {
+          if (t.target == 0) TCP_SHAPE_BAIL(1, "lo relay timer");
+        } else if (t.kind == TK_TCP) {
+          if (t.target >= sh->tok2conn.size() ||
+              sh->tok2conn[t.target] < 0)
+            TCP_SHAPE_BAIL(1, "tcp timer on foreign sock");
+        } else if (t.kind == TK_APP) {
+          if (t.target >= sh->app2conn.size() ||
+              sh->app2conn[t.target] < 0)
+            TCP_SHAPE_BAIL(1, "app wake for server app");
+        } else {
+          TCP_SHAPE_BAIL(1, "timeout timer kind");
+        }
+      }
+      if (check_content) {
+        for (const auto &[id, enq] : hp->codel.q)
+          if (!tcp_pkt_in_domain(store.get(id))) TCP_SHAPE_BAIL(1, "codel pkt");
+        for (const InboxEnt &ie : hp->inbox)
+          if (!tcp_pkt_in_domain(store.get(ie.pkt))) TCP_SHAPE_BAIL(1, "inbox pkt");
+        for (int r = 1; r <= 2; r++)
+          if (hp->relays[r].pending != UINT64_MAX &&
+              !tcp_pkt_in_domain(store.get(hp->relays[r].pending)))
+            TCP_SHAPE_BAIL(1, "relay pending pkt");
+      }
+    }
+    return 0;
+  }
+
+  /* Device-capability probe (opt-in; bench --report-routes): per
+   * run_span round, how many active hosts sit inside the TCP device
+   * family's domain, and how many whole rounds were globally
+   * eligible.  Content scans skipped — this measures the structural
+   * domain, not the O(bytes) purity checks. */
+  bool devcap_probe = false;
+  int64_t devcap_rounds_total = 0;   // rounds probed
+  int64_t devcap_rounds_full = 0;    // rounds with every active host ok
+  int64_t devcap_steps_total = 0;    // (round, active host) pairs
+  int64_t devcap_steps_ok = 0;       // ...of which in-domain
+
+  void devcap_count_round(const uint32_t *ids, int64_t n) {
+    std::vector<uint8_t> bad(hosts.size(), 0);
+    for (size_t i = 0; i < apps.size(); i++) {
+      AppN &a = apps[i];
+      if (a.hid < 0 || (size_t)a.hid >= hosts.size()) continue;
+      if (a.kind != APP_SERVER && a.kind != APP_CLIENT &&
+          a.kind != APP_HANDLER) {
+        bad[a.hid] = 1;
+        continue;
+      }
+      if (a.stopped) { bad[a.hid] = 1; continue; }
+      if (a.exited) continue;
+      bool ok = false;
+      if (a.kind == APP_SERVER) {
+        TcpSocketN *l = a.sock >= 0 ? tcp((uint32_t)a.sock) : nullptr;
+        ok = l != nullptr && l->listening && l->accept_q.empty() &&
+             !a.wake_pending;
+      } else if (a.sock >= 0) {
+        TcpSocketN *s = tcp((uint32_t)a.sock);
+        if (s != nullptr && s->conn != nullptr &&
+            tcp_conn_in_domain(s, /*check_content=*/false)) {
+          if (a.kind == APP_CLIENT)
+            ok = a.state == CL_RECV && a.got < a.nbytes &&
+                 s->conn->rtx.empty() && s->conn->send_buf.len == 0;
+          else
+            ok = a.state == H_SEND && a.resp_n >= 0 &&
+                 a.sent < a.resp_n && s->conn->recv_buf.len == 0;
+        }
+      }
+      if (!ok) bad[a.hid] = 1;
+    }
+    bool all_ok = true;
+    for (int64_t i = 0; i < n; i++) {
+      uint32_t h = ids[i];
+      devcap_steps_total++;
+      if (h < bad.size() && !bad[h]) devcap_steps_ok++;
+      else all_ok = false;
+    }
+    devcap_rounds_total++;
+    if (all_ok && n > 0) devcap_rounds_full++;
   }
 
   /* Packet identity fields the device carries (payload is always
@@ -4832,6 +5061,936 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+/* ====== TCP device-span export / import (ops/tcp_span.py) ======= */
+
+/* Full TCP packet identity: routing fields + the header the device
+ * state machine interprets.  Payloads are uniform 'D' bytes in the
+ * modelled domain, so plen reconstructs contents. */
+struct TPkCols {
+  std::vector<int32_t> srchost, sport, dport, tflags, plen, nsk;
+  std::vector<int64_t> pseq, twin, tsv, tse;
+  std::vector<uint32_t> sip, dip, tseq, tack;
+  std::vector<uint32_t> sk[6];  // sack block starts/ends, 3 pairs
+
+  void push(const PacketN *p) {
+    srchost.push_back(p->src_host);
+    pseq.push_back((int64_t)p->seq);
+    sip.push_back(p->src_ip);
+    sport.push_back(p->src_port);
+    dip.push_back(p->dst_ip);
+    dport.push_back(p->dst_port);
+    tseq.push_back(p->tcp.seq);
+    tack.push_back(p->tcp.ack);
+    tflags.push_back(p->tcp.flags);
+    twin.push_back(p->tcp.window);
+    tsv.push_back(p->tcp.ts_val);
+    tse.push_back(p->tcp.ts_ecr);
+    plen.push_back((int32_t)p->payload.size());
+    nsk.push_back(p->tcp.n_sacks);
+    for (int i = 0; i < 3; i++) {
+      sk[2 * i].push_back(i < p->tcp.n_sacks ? p->tcp.sacks[i].start : 0);
+      sk[2 * i + 1].push_back(i < p->tcp.n_sacks ? p->tcp.sacks[i].end
+                                                 : 0);
+    }
+  }
+  void push_empty() {
+    srchost.push_back(0);
+    pseq.push_back(0);
+    sip.push_back(0);
+    sport.push_back(0);
+    dip.push_back(0);
+    dport.push_back(0);
+    tseq.push_back(0);
+    tack.push_back(0);
+    tflags.push_back(0);
+    twin.push_back(0);
+    tsv.push_back(0);
+    tse.push_back(0);
+    plen.push_back(0);
+    nsk.push_back(0);
+    for (int i = 0; i < 6; i++) sk[i].push_back(0);
+  }
+  void pad(size_t upto) {
+    while (srchost.size() < upto) push_empty();
+  }
+};
+
+static const char *TPK_SK[6] = {"sk0s", "sk0e", "sk1s",
+                                "sk1e", "sk2s", "sk2e"};
+
+static void put_tpk(PyObject *d, const char *prefix, TPkCols &c,
+                    bool *ok) {
+  std::string p(prefix);
+  auto put = [&](const std::string &k, PyObject *v) {
+    if (dict_set(d, k.c_str(), v) < 0) *ok = false;
+  };
+  put(p + "_srchost", bytes_vec(c.srchost));
+  put(p + "_pseq", bytes_vec(c.pseq));
+  put(p + "_sip", bytes_vec(c.sip));
+  put(p + "_sport", bytes_vec(c.sport));
+  put(p + "_dip", bytes_vec(c.dip));
+  put(p + "_dport", bytes_vec(c.dport));
+  put(p + "_tseq", bytes_vec(c.tseq));
+  put(p + "_tack", bytes_vec(c.tack));
+  put(p + "_tflags", bytes_vec(c.tflags));
+  put(p + "_twin", bytes_vec(c.twin));
+  put(p + "_tsv", bytes_vec(c.tsv));
+  put(p + "_tse", bytes_vec(c.tse));
+  put(p + "_plen", bytes_vec(c.plen));
+  put(p + "_nsk", bytes_vec(c.nsk));
+  for (int i = 0; i < 6; i++)
+    put(p + "_" + TPK_SK[i], bytes_vec(c.sk[i]));
+}
+
+/* Typed reader for import (mirrors put_tpk). */
+struct TPkIn {
+  const int32_t *srchost, *sport, *dport, *tflags, *plen, *nsk;
+  const int64_t *pseq, *twin, *tsv, *tse;
+  const uint32_t *sip, *dip, *tseq, *tack;
+  const uint32_t *sk[6];
+};
+
+static TPkIn get_tpk(PyObject *d, const char *prefix, size_t n,
+                     bool *ok) {
+  std::string p(prefix);
+  TPkIn c;
+  c.srchost = col<int32_t>(d, (p + "_srchost").c_str(), n, ok);
+  c.pseq = col<int64_t>(d, (p + "_pseq").c_str(), n, ok);
+  c.sip = col<uint32_t>(d, (p + "_sip").c_str(), n, ok);
+  c.sport = col<int32_t>(d, (p + "_sport").c_str(), n, ok);
+  c.dip = col<uint32_t>(d, (p + "_dip").c_str(), n, ok);
+  c.dport = col<int32_t>(d, (p + "_dport").c_str(), n, ok);
+  c.tseq = col<uint32_t>(d, (p + "_tseq").c_str(), n, ok);
+  c.tack = col<uint32_t>(d, (p + "_tack").c_str(), n, ok);
+  c.tflags = col<int32_t>(d, (p + "_tflags").c_str(), n, ok);
+  c.twin = col<int64_t>(d, (p + "_twin").c_str(), n, ok);
+  c.tsv = col<int64_t>(d, (p + "_tsv").c_str(), n, ok);
+  c.tse = col<int64_t>(d, (p + "_tse").c_str(), n, ok);
+  c.plen = col<int32_t>(d, (p + "_plen").c_str(), n, ok);
+  c.nsk = col<int32_t>(d, (p + "_nsk").c_str(), n, ok);
+  for (int i = 0; i < 6; i++)
+    c.sk[i] = col<uint32_t>(d, (p + "_" + TPK_SK[i]).c_str(), n, ok);
+  return c;
+}
+
+static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
+  /* (I, T, CQ, RT, RA, OP) ring caps -> dict of column bytes, None
+   * when the sim is structurally not a tgen-TCP sim, or int 1 when
+   * transiently outside the steady-stream domain / over the caps.
+   * Read-only (transactional: an aborted device span never imports). */
+  long long I, T, CQ, RT, RA, OP;
+  if (!PyArg_ParseTuple(args, "LLLLLL", &I, &T, &CQ, &RT, &RA, &OP))
+    return nullptr;
+  Engine *e = self->eng;
+  Engine::TcpShape sh;
+  int r = e->tcp_shape(&sh, /*check_content=*/true);
+  if (r == 2) Py_RETURN_NONE;
+  if (r == 1) return PyLong_FromLong(1);
+  size_t H = e->hosts.size();
+  size_t N = sh.conn_host.size();
+  size_t CC = 8;
+  while (CC < N) CC <<= 1;
+
+  /* transient cap checks before building anything */
+  bool dbg = getenv("SHADOWTPU_TCPSPAN_DBG") != nullptr;
+  for (size_t h = 0; h < H; h++) {
+    HostPlane *hp = e->hosts[h].get();
+    if ((long long)hp->inbox.size() > I / 2 ||
+        (long long)hp->theap.size() > T - 8 ||
+        (long long)hp->codel.q.size() > CQ / 2) {
+      if (dbg)
+        fprintf(stderr,
+                "[tcp_export over-cap] host %zu inbox=%zu theap=%zu "
+                "codel=%zu\n",
+                h, hp->inbox.size(), hp->theap.size(),
+                hp->codel.q.size());
+      return PyLong_FromLong(1);
+    }
+  }
+  for (size_t j = 0; j < N; j++) {
+    TcpSocketN *s = e->tcp(sh.conn_tok[j]);
+    TcpConn *c = s->conn.get();
+    if ((long long)c->rtx.size() > RT / 2 ||
+        (long long)c->reassembly.size() > RA / 2 ||
+        (long long)s->out_packets[1].size() > OP / 2) {
+      if (dbg)
+        fprintf(stderr,
+                "[tcp_export over-cap] conn %zu rtx=%zu reasm=%zu "
+                "outp=%zu\n",
+                j, c->rtx.size(), c->reassembly.size(),
+                s->out_packets[1].size());
+      return PyLong_FromLong(1);
+    }
+  }
+
+  /* ---- host-major ---- */
+  std::vector<int64_t> now(H), event_seq(H), packet_seq(H);
+  std::vector<uint32_t> eth_ip(H);
+  std::vector<int64_t> bw_up(H), bw_down(H);
+  std::vector<int32_t> cq_len(H), ib_len(H), th_len(H);
+  TPkCols cq, ib, r1pk, r2pk;
+  std::vector<int64_t> cq_enq(H * (size_t)CQ, 0);
+  std::vector<int64_t> ib_time(H * (size_t)I, 0), ib_seq(H * (size_t)I, 0);
+  std::vector<int32_t> ib_src(H * (size_t)I, 0);
+  std::vector<int64_t> th_time(H * (size_t)T, 0), th_seq(H * (size_t)T, 0);
+  std::vector<uint8_t> th_kind(H * (size_t)T, 0);
+  std::vector<int32_t> th_tgt(H * (size_t)T, 0);
+  std::vector<int64_t> codel_bytes(H), codel_count(H),
+      codel_last_count(H), codel_first_above(H), codel_drop_next(H),
+      codel_dropped(H);
+  std::vector<uint8_t> codel_dropping(H);
+  std::vector<uint8_t> r_pending[3], r_unlimited[3], r_pk_valid[3];
+  std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3];
+  for (int ri = 1; ri <= 2; ri++) {
+    r_pending[ri].assign(H, 0);
+    r_unlimited[ri].assign(H, 0);
+    r_pk_valid[ri].assign(H, 0);
+    r_bal[ri].assign(H, 0);
+    r_next[ri].assign(H, 0);
+    r_refill[ri].assign(H, 0);
+    r_cap[ri].assign(H, 0);
+  }
+  std::vector<int64_t> app_sys(H * ASYS_N), pkts_sent(H), pkts_recv(H),
+      pkts_dropped(H), events_run(H);
+  std::vector<int64_t> eth_psent(H), eth_precv(H), eth_bsent(H),
+      eth_brecv(H);
+
+  for (size_t h = 0; h < H; h++) {
+    HostPlane *hp = e->hosts[h].get();
+    now[h] = hp->now;
+    event_seq[h] = (int64_t)hp->event_seq;
+    packet_seq[h] = (int64_t)hp->packet_seq;
+    eth_ip[h] = hp->eth_ip;
+    bw_up[h] = hp->bw_up_bits;
+    bw_down[h] = hp->bw_down_bits;
+    cq_len[h] = (int32_t)hp->codel.q.size();
+    {
+      size_t j = 0;
+      for (auto &[id, enq] : hp->codel.q) {
+        cq.push(e->store.get(id));
+        cq_enq[h * (size_t)CQ + j++] = enq;
+      }
+      cq.pad((h + 1) * (size_t)CQ);
+    }
+    codel_bytes[h] = hp->codel.bytes;
+    codel_dropping[h] = hp->codel.dropping ? 1 : 0;
+    codel_count[h] = hp->codel.count;
+    codel_last_count[h] = hp->codel.last_count;
+    codel_first_above[h] = hp->codel.first_above;
+    codel_drop_next[h] = hp->codel.drop_next;
+    codel_dropped[h] = hp->codel.dropped_count;
+    for (int ri = 1; ri <= 2; ri++) {
+      RelayN &rl = hp->relays[ri];
+      r_pending[ri][h] = rl.state == RELAY_PENDING ? 1 : 0;
+      r_unlimited[ri][h] = rl.bucket.unlimited ? 1 : 0;
+      r_bal[ri][h] = rl.bucket.balance;
+      r_next[ri][h] = rl.bucket.next_refill;
+      r_refill[ri][h] = rl.bucket.refill_size;
+      r_cap[ri][h] = rl.bucket.capacity;
+      TPkCols &pc = ri == 1 ? r1pk : r2pk;
+      if (rl.pending != UINT64_MAX) {
+        r_pk_valid[ri][h] = 1;
+        pc.push(e->store.get(rl.pending));
+      } else {
+        pc.push_empty();
+      }
+    }
+    {
+      std::vector<InboxEnt> iv(hp->inbox);
+      std::sort(iv.begin(), iv.end(), [](const InboxEnt &a,
+                                         const InboxEnt &b) {
+        if (a.time != b.time) return a.time < b.time;
+        if (a.src_host != b.src_host) return a.src_host < b.src_host;
+        return a.seq < b.seq;
+      });
+      ib_len[h] = (int32_t)iv.size();
+      for (size_t j = 0; j < iv.size(); j++) {
+        ib_time[h * (size_t)I + j] = iv[j].time;
+        ib_src[h * (size_t)I + j] = iv[j].src_host;
+        ib_seq[h * (size_t)I + j] = (int64_t)iv[j].seq;
+        ib.push(e->store.get(iv[j].pkt));
+      }
+      ib.pad((h + 1) * (size_t)I);
+      th_len[h] = (int32_t)hp->theap.size();
+      std::vector<TimerEnt> tv(hp->theap);
+      std::sort(tv.begin(), tv.end(), [](const TimerEnt &a,
+                                         const TimerEnt &b) {
+        return a.time != b.time ? a.time < b.time : a.seq < b.seq;
+      });
+      for (size_t j = 0; j < tv.size(); j++) {
+        th_time[h * (size_t)T + j] = tv[j].time;
+        th_seq[h * (size_t)T + j] = (int64_t)tv[j].seq;
+        th_kind[h * (size_t)T + j] = (uint8_t)tv[j].kind;
+        th_tgt[h * (size_t)T + j] =
+            tv[j].kind == TK_RELAY
+                ? (int32_t)tv[j].target
+                : (tv[j].kind == TK_TCP ? sh.tok2conn[tv[j].target]
+                                        : sh.app2conn[tv[j].target]);
+      }
+    }
+    for (int j = 0; j < ASYS_N; j++)
+      app_sys[h * ASYS_N + j] = hp->app_sys[j];
+    pkts_sent[h] = hp->pkts_sent;
+    pkts_recv[h] = hp->pkts_recv;
+    pkts_dropped[h] = hp->pkts_dropped;
+    events_run[h] = hp->events_run;
+    eth_psent[h] = hp->eth.packets_sent;
+    eth_precv[h] = hp->eth.packets_received;
+    eth_bsent[h] = hp->eth.bytes_sent;
+    eth_brecv[h] = hp->eth.bytes_received;
+  }
+
+  /* ---- conn-major ---- */
+  std::vector<int32_t> c_host(CC, 0), c_lport(CC, 0), c_pport(CC, 0),
+      c_ourws(CC, 0), c_peerws(CC, 0), c_effmss(CC, 0), c_wsoff(CC, 0),
+      c_ssa(CC, 0), c_congmss(CC, 0), c_dupacks(CC, 0),
+      c_rtobackoff(CC, 0), c_axfer(CC, 0), c_acount(CC, 0);
+  std::vector<uint8_t> c_role(CC, 0), c_nodelay(CC, 0), c_fastrec(CC, 0),
+      c_queued(CC, 0), c_sat(CC, 0), c_rat(CC, 0), c_wakep(CC, 0);
+  std::vector<uint32_t> c_lip(CC, 0), c_pip(CC, 0), c_iss(CC, 0),
+      c_irs(CC, 0), c_snduna(CC, 0), c_sndnxt(CC, 0), c_rcvnxt(CC, 0),
+      c_recover(CC, 0), c_status(CC, 0), c_await(CC, 0);
+  std::vector<int64_t> c_sndwnd(CC, 0), c_sblen(CC, 0), c_sbmax(CC, 0),
+      c_rblen(CC, 0), c_rbmax(CC, 0), c_delackdl(CC, -1),
+      c_persistdl(CC, -1), c_persistiv(CC, 0), c_cwnd(CC, 0),
+      c_ssthresh(CC, 0), c_srtt(CC, 0), c_rttvar(CC, 0), c_rto(CC, 0),
+      c_rtodl(CC, -1), c_tsrecent(CC, 0), c_segssent(CC, 0),
+      c_segsrecv(CC, 0), c_rtxcount(CC, 0), c_sackskip(CC, 0),
+      c_tmrdl(CC, -1), c_atcopied(CC, 0), c_atspace(CC, 0),
+      c_atlast(CC, 0), c_awaitseq(CC, 0), c_agot(CC, 0),
+      c_atotal(CC, 0), c_at0(CC, 0);
+  std::vector<int32_t> rtx_len(CC, 0), ra_len(CC, 0), op_len(CC, 0);
+  std::vector<uint32_t> rtx_seq(CC * (size_t)RT, 0),
+      ra_seq(CC * (size_t)RA, 0);
+  std::vector<int32_t> rtx_plen(CC * (size_t)RT, 0),
+      ra_plen(CC * (size_t)RA, 0);
+  std::vector<uint8_t> rtx_rtxed(CC * (size_t)RT, 0),
+      rtx_sacked(CC * (size_t)RT, 0);
+  std::vector<int64_t> rtx_sent(CC * (size_t)RT, 0);
+  TPkCols op;
+
+  for (size_t j = 0; j < N; j++) {
+    TcpSocketN *s = e->tcp(sh.conn_tok[j]);
+    TcpConn *c = s->conn.get();
+    AppN &a = e->apps[(size_t)sh.conn_app[j]];
+    c_host[j] = sh.conn_host[j];
+    c_role[j] = sh.conn_role[j];
+    c_lip[j] = s->local_ip;
+    c_lport[j] = s->local_port;
+    c_pip[j] = s->peer_ip;
+    c_pport[j] = s->peer_port;
+    c_iss[j] = c->iss;
+    c_irs[j] = c->irs;
+    c_wsoff[j] = c->wscale_offer;
+    c_snduna[j] = c->snd_una;
+    c_sndnxt[j] = c->snd_nxt;
+    c_sndwnd[j] = c->snd_wnd;
+    c_rcvnxt[j] = c->rcv_nxt;
+    c_sblen[j] = c->send_buf.len;
+    c_sbmax[j] = c->send_buf_max;
+    c_rblen[j] = c->recv_buf.len;
+    c_rbmax[j] = c->recv_buf_max;
+    c_ourws[j] = c->our_wscale;
+    c_peerws[j] = c->peer_wscale;
+    c_effmss[j] = c->eff_mss;
+    c_nodelay[j] = c->nodelay ? 1 : 0;
+    c_delackdl[j] = c->delack_deadline;
+    c_ssa[j] = c->segs_since_ack;
+    c_persistdl[j] = c->persist_deadline;
+    c_persistiv[j] = c->persist_interval;
+    c_cwnd[j] = c->cwnd;
+    c_ssthresh[j] = c->ssthresh;
+    c_congmss[j] = c->cong_mss;
+    c_dupacks[j] = c->dupacks;
+    c_fastrec[j] = c->in_fast_recovery ? 1 : 0;
+    c_recover[j] = c->recover;
+    c_srtt[j] = c->srtt;
+    c_rttvar[j] = c->rttvar;
+    c_rto[j] = c->rto;
+    c_rtodl[j] = c->rto_deadline;
+    c_tsrecent[j] = c->ts_recent;
+    c_rtobackoff[j] = c->rto_backoff;
+    c_segssent[j] = c->segments_sent;
+    c_segsrecv[j] = c->segments_received;
+    c_rtxcount[j] = c->retransmit_count;
+    c_sackskip[j] = c->sacked_skip_count;
+    c_tmrdl[j] = s->timer_deadline;
+    c_status[j] = s->status;
+    c_queued[j] = s->queued[1] ? 1 : 0;
+    c_atcopied[j] = s->at_bytes_copied;
+    c_atspace[j] = s->at_space;
+    c_atlast[j] = s->at_last_adjust;
+    c_sat[j] = s->send_autotune ? 1 : 0;
+    c_rat[j] = s->recv_autotune ? 1 : 0;
+    c_await[j] = a.wait_mask;
+    c_awaitseq[j] = a.wait_seq;
+    c_wakep[j] = a.wake_pending ? 1 : 0;
+    c_agot[j] = sh.conn_role[j] == 0 ? a.got : a.sent;
+    c_atotal[j] = sh.conn_role[j] == 0 ? a.nbytes : a.resp_n;
+    c_at0[j] = a.t0;
+    c_axfer[j] = a.xfer_i;
+    c_acount[j] = a.count;
+    rtx_len[j] = (int32_t)c->rtx.size();
+    {
+      size_t k = 0;
+      for (const RtxSeg &seg : c->rtx) {
+        rtx_seq[j * (size_t)RT + k] = seg.seq;
+        rtx_plen[j * (size_t)RT + k] = (int32_t)seg.payload.size();
+        rtx_rtxed[j * (size_t)RT + k] = seg.retransmitted ? 1 : 0;
+        rtx_sacked[j * (size_t)RT + k] = seg.sacked ? 1 : 0;
+        rtx_sent[j * (size_t)RT + k] = seg.sent_at;
+        k++;
+      }
+    }
+    ra_len[j] = (int32_t)c->reassembly.size();
+    {
+      std::vector<uint32_t> seqs;
+      for (auto &kv : c->reassembly) seqs.push_back(kv.first);
+      uint32_t base = c->rcv_nxt;
+      std::sort(seqs.begin(), seqs.end(),
+                [base](uint32_t x, uint32_t y) {
+                  return seq_sub(x, base) < seq_sub(y, base);
+                });
+      for (size_t k = 0; k < seqs.size(); k++) {
+        ra_seq[j * (size_t)RA + k] = seqs[k];
+        ra_plen[j * (size_t)RA + k] =
+            (int32_t)c->reassembly.at(seqs[k]).size();
+      }
+    }
+    op_len[j] = (int32_t)s->out_packets[1].size();
+    for (uint64_t id : s->out_packets[1]) op.push(e->store.get(id));
+    op.pad((j + 1) * (size_t)OP);
+  }
+  op.pad(CC * (size_t)OP);
+
+  PyObject *d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  bool ok = true;
+  auto put = [&](const char *k, PyObject *v) {
+    if (dict_set(d, k, v) < 0) ok = false;
+  };
+  {
+    std::vector<int64_t> nconns(1, (int64_t)N);
+    put("n_conns", bytes_vec(nconns));
+  }
+  put("now", bytes_vec(now));
+  put("event_seq", bytes_vec(event_seq));
+  put("packet_seq", bytes_vec(packet_seq));
+  put("eth_ip", bytes_vec(eth_ip));
+  put("bw_up", bytes_vec(bw_up));
+  put("bw_down", bytes_vec(bw_down));
+  put("cq_len", bytes_vec(cq_len));
+  put_tpk(d, "cq", cq, &ok);
+  put("cq_enq", bytes_vec(cq_enq));
+  put("codel_bytes", bytes_vec(codel_bytes));
+  put("codel_dropping", bytes_vec(codel_dropping));
+  put("codel_count", bytes_vec(codel_count));
+  put("codel_last_count", bytes_vec(codel_last_count));
+  put("codel_first_above", bytes_vec(codel_first_above));
+  put("codel_drop_next", bytes_vec(codel_drop_next));
+  put("codel_dropped", bytes_vec(codel_dropped));
+  for (int ri = 1; ri <= 2; ri++) {
+    std::string p = ri == 1 ? "r1" : "r2";
+    put((p + "_pending").c_str(), bytes_vec(r_pending[ri]));
+    put((p + "_unlimited").c_str(), bytes_vec(r_unlimited[ri]));
+    put((p + "_bal").c_str(), bytes_vec(r_bal[ri]));
+    put((p + "_next").c_str(), bytes_vec(r_next[ri]));
+    put((p + "_refill").c_str(), bytes_vec(r_refill[ri]));
+    put((p + "_cap").c_str(), bytes_vec(r_cap[ri]));
+    put((p + "_pk_valid").c_str(), bytes_vec(r_pk_valid[ri]));
+    put_tpk(d, (p + "_pk").c_str(), ri == 1 ? r1pk : r2pk, &ok);
+  }
+  put("ib_len", bytes_vec(ib_len));
+  put("ib_time", bytes_vec(ib_time));
+  put("ib_src", bytes_vec(ib_src));
+  put("ib_seq", bytes_vec(ib_seq));
+  put_tpk(d, "ib", ib, &ok);
+  put("th_len", bytes_vec(th_len));
+  put("th_time", bytes_vec(th_time));
+  put("th_seq", bytes_vec(th_seq));
+  put("th_kind", bytes_vec(th_kind));
+  put("th_tgt", bytes_vec(th_tgt));
+  put("app_sys", bytes_vec(app_sys));
+  put("pkts_sent", bytes_vec(pkts_sent));
+  put("pkts_recv", bytes_vec(pkts_recv));
+  put("pkts_dropped", bytes_vec(pkts_dropped));
+  put("events_run", bytes_vec(events_run));
+  put("eth_psent", bytes_vec(eth_psent));
+  put("eth_precv", bytes_vec(eth_precv));
+  put("eth_bsent", bytes_vec(eth_bsent));
+  put("eth_brecv", bytes_vec(eth_brecv));
+  put("c_host", bytes_vec(c_host));
+  put("c_role", bytes_vec(c_role));
+  put("c_lip", bytes_vec(c_lip));
+  put("c_lport", bytes_vec(c_lport));
+  put("c_pip", bytes_vec(c_pip));
+  put("c_pport", bytes_vec(c_pport));
+  put("c_iss", bytes_vec(c_iss));
+  put("c_irs", bytes_vec(c_irs));
+  put("c_wsoff", bytes_vec(c_wsoff));
+  put("c_snduna", bytes_vec(c_snduna));
+  put("c_sndnxt", bytes_vec(c_sndnxt));
+  put("c_sndwnd", bytes_vec(c_sndwnd));
+  put("c_rcvnxt", bytes_vec(c_rcvnxt));
+  put("c_sblen", bytes_vec(c_sblen));
+  put("c_sbmax", bytes_vec(c_sbmax));
+  put("c_rblen", bytes_vec(c_rblen));
+  put("c_rbmax", bytes_vec(c_rbmax));
+  put("c_ourws", bytes_vec(c_ourws));
+  put("c_peerws", bytes_vec(c_peerws));
+  put("c_effmss", bytes_vec(c_effmss));
+  put("c_nodelay", bytes_vec(c_nodelay));
+  put("c_delackdl", bytes_vec(c_delackdl));
+  put("c_ssa", bytes_vec(c_ssa));
+  put("c_persistdl", bytes_vec(c_persistdl));
+  put("c_persistiv", bytes_vec(c_persistiv));
+  put("c_cwnd", bytes_vec(c_cwnd));
+  put("c_ssthresh", bytes_vec(c_ssthresh));
+  put("c_congmss", bytes_vec(c_congmss));
+  put("c_dupacks", bytes_vec(c_dupacks));
+  put("c_fastrec", bytes_vec(c_fastrec));
+  put("c_recover", bytes_vec(c_recover));
+  put("c_srtt", bytes_vec(c_srtt));
+  put("c_rttvar", bytes_vec(c_rttvar));
+  put("c_rto", bytes_vec(c_rto));
+  put("c_rtodl", bytes_vec(c_rtodl));
+  put("c_tsrecent", bytes_vec(c_tsrecent));
+  put("c_rtobackoff", bytes_vec(c_rtobackoff));
+  put("c_segssent", bytes_vec(c_segssent));
+  put("c_segsrecv", bytes_vec(c_segsrecv));
+  put("c_rtxcount", bytes_vec(c_rtxcount));
+  put("c_sackskip", bytes_vec(c_sackskip));
+  put("c_tmrdl", bytes_vec(c_tmrdl));
+  put("c_status", bytes_vec(c_status));
+  put("c_queued", bytes_vec(c_queued));
+  put("c_atcopied", bytes_vec(c_atcopied));
+  put("c_atspace", bytes_vec(c_atspace));
+  put("c_atlast", bytes_vec(c_atlast));
+  put("c_sat", bytes_vec(c_sat));
+  put("c_rat", bytes_vec(c_rat));
+  put("c_await", bytes_vec(c_await));
+  put("c_awaitseq", bytes_vec(c_awaitseq));
+  put("c_wakep", bytes_vec(c_wakep));
+  put("c_agot", bytes_vec(c_agot));
+  put("c_atotal", bytes_vec(c_atotal));
+  put("c_at0", bytes_vec(c_at0));
+  put("c_axfer", bytes_vec(c_axfer));
+  put("c_acount", bytes_vec(c_acount));
+  put("rtx_len", bytes_vec(rtx_len));
+  put("rtx_seq", bytes_vec(rtx_seq));
+  put("rtx_plen", bytes_vec(rtx_plen));
+  put("rtx_rtxed", bytes_vec(rtx_rtxed));
+  put("rtx_sacked", bytes_vec(rtx_sacked));
+  put("rtx_sent", bytes_vec(rtx_sent));
+  put("ra_len", bytes_vec(ra_len));
+  put("ra_seq", bytes_vec(ra_seq));
+  put("ra_plen", bytes_vec(ra_plen));
+  put("op_len", bytes_vec(op_len));
+  put_tpk(d, "op", op, &ok);
+  if (!ok) {
+    Py_DECREF(d);
+    return nullptr;
+  }
+  return d;
+}
+
+static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
+  /* (dict, I, T, CQ, RT, RA, OP, traces_or_None) -> None.  Overwrites
+   * the engine's tgen-TCP state with the device span's result.  Only
+   * called after a CLEAN device span. */
+  PyObject *d, *traces;
+  long long I, T, CQ, RT, RA, OP;
+  if (!PyArg_ParseTuple(args, "OLLLLLLO", &d, &I, &T, &CQ, &RT, &RA,
+                        &OP, &traces))
+    return nullptr;
+  Engine *e = self->eng;
+  Engine::TcpShape sh;
+  if (e->tcp_shape(&sh, /*check_content=*/false) != 0) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "span import: sim no longer tgen-TCP-shaped");
+    return nullptr;
+  }
+  size_t H = e->hosts.size();
+  size_t N = sh.conn_host.size();
+  size_t CC = 8;
+  while (CC < N) CC <<= 1;
+  bool ok = true;
+  const int64_t *now = col<int64_t>(d, "now", H, &ok);
+  const int64_t *event_seq = col<int64_t>(d, "event_seq", H, &ok);
+  const int64_t *packet_seq = col<int64_t>(d, "packet_seq", H, &ok);
+  const int32_t *cq_len = col<int32_t>(d, "cq_len", H, &ok);
+  const int32_t *ib_len = col<int32_t>(d, "ib_len", H, &ok);
+  const int32_t *th_len = col<int32_t>(d, "th_len", H, &ok);
+  TPkIn cq = get_tpk(d, "cq", H * (size_t)CQ, &ok);
+  TPkIn ib = get_tpk(d, "ib", H * (size_t)I, &ok);
+  TPkIn r1pk = get_tpk(d, "r1_pk", H, &ok);
+  TPkIn r2pk = get_tpk(d, "r2_pk", H, &ok);
+  TPkIn op = get_tpk(d, "op", CC * (size_t)OP, &ok);
+  const int64_t *cq_enq = col<int64_t>(d, "cq_enq", H * (size_t)CQ, &ok);
+  const int64_t *codel_bytes = col<int64_t>(d, "codel_bytes", H, &ok);
+  const uint8_t *codel_dropping =
+      col<uint8_t>(d, "codel_dropping", H, &ok);
+  const int64_t *codel_count = col<int64_t>(d, "codel_count", H, &ok);
+  const int64_t *codel_last_count =
+      col<int64_t>(d, "codel_last_count", H, &ok);
+  const int64_t *codel_first_above =
+      col<int64_t>(d, "codel_first_above", H, &ok);
+  const int64_t *codel_drop_next =
+      col<int64_t>(d, "codel_drop_next", H, &ok);
+  const int64_t *codel_dropped =
+      col<int64_t>(d, "codel_dropped", H, &ok);
+  const uint8_t *r_pending[3] = {nullptr, nullptr, nullptr};
+  const uint8_t *r_pk_valid[3] = {nullptr, nullptr, nullptr};
+  const int64_t *r_bal[3], *r_next[3];
+  for (int ri = 1; ri <= 2; ri++) {
+    std::string p = ri == 1 ? "r1" : "r2";
+    r_pending[ri] = col<uint8_t>(d, (p + "_pending").c_str(), H, &ok);
+    r_pk_valid[ri] = col<uint8_t>(d, (p + "_pk_valid").c_str(), H, &ok);
+    r_bal[ri] = col<int64_t>(d, (p + "_bal").c_str(), H, &ok);
+    r_next[ri] = col<int64_t>(d, (p + "_next").c_str(), H, &ok);
+  }
+  const int64_t *ib_time = col<int64_t>(d, "ib_time", H * (size_t)I, &ok);
+  const int32_t *ib_src = col<int32_t>(d, "ib_src", H * (size_t)I, &ok);
+  const int64_t *ib_seq = col<int64_t>(d, "ib_seq", H * (size_t)I, &ok);
+  const int64_t *th_time = col<int64_t>(d, "th_time", H * (size_t)T, &ok);
+  const int64_t *th_seq = col<int64_t>(d, "th_seq", H * (size_t)T, &ok);
+  const uint8_t *th_kind = col<uint8_t>(d, "th_kind", H * (size_t)T, &ok);
+  const int32_t *th_tgt = col<int32_t>(d, "th_tgt", H * (size_t)T, &ok);
+  const int64_t *app_sys = col<int64_t>(d, "app_sys", H * ASYS_N, &ok);
+  const int64_t *pkts_sent = col<int64_t>(d, "pkts_sent", H, &ok);
+  const int64_t *pkts_recv = col<int64_t>(d, "pkts_recv", H, &ok);
+  const int64_t *pkts_dropped = col<int64_t>(d, "pkts_dropped", H, &ok);
+  const int64_t *events_run = col<int64_t>(d, "events_run", H, &ok);
+  const int64_t *eth_psent = col<int64_t>(d, "eth_psent", H, &ok);
+  const int64_t *eth_precv = col<int64_t>(d, "eth_precv", H, &ok);
+  const int64_t *eth_bsent = col<int64_t>(d, "eth_bsent", H, &ok);
+  const int64_t *eth_brecv = col<int64_t>(d, "eth_brecv", H, &ok);
+  const uint32_t *c_snduna = col<uint32_t>(d, "c_snduna", CC, &ok);
+  const uint32_t *c_sndnxt = col<uint32_t>(d, "c_sndnxt", CC, &ok);
+  const int64_t *c_sndwnd = col<int64_t>(d, "c_sndwnd", CC, &ok);
+  const uint32_t *c_rcvnxt = col<uint32_t>(d, "c_rcvnxt", CC, &ok);
+  const int64_t *c_sblen = col<int64_t>(d, "c_sblen", CC, &ok);
+  const int64_t *c_sbmax = col<int64_t>(d, "c_sbmax", CC, &ok);
+  const int64_t *c_rblen = col<int64_t>(d, "c_rblen", CC, &ok);
+  const int64_t *c_rbmax = col<int64_t>(d, "c_rbmax", CC, &ok);
+  const int64_t *c_delackdl = col<int64_t>(d, "c_delackdl", CC, &ok);
+  const int32_t *c_ssa = col<int32_t>(d, "c_ssa", CC, &ok);
+  const int64_t *c_persistdl = col<int64_t>(d, "c_persistdl", CC, &ok);
+  const int64_t *c_persistiv = col<int64_t>(d, "c_persistiv", CC, &ok);
+  const int64_t *c_cwnd = col<int64_t>(d, "c_cwnd", CC, &ok);
+  const int64_t *c_ssthresh = col<int64_t>(d, "c_ssthresh", CC, &ok);
+  const int32_t *c_dupacks = col<int32_t>(d, "c_dupacks", CC, &ok);
+  const uint8_t *c_fastrec = col<uint8_t>(d, "c_fastrec", CC, &ok);
+  const uint32_t *c_recover = col<uint32_t>(d, "c_recover", CC, &ok);
+  const int64_t *c_srtt = col<int64_t>(d, "c_srtt", CC, &ok);
+  const int64_t *c_rttvar = col<int64_t>(d, "c_rttvar", CC, &ok);
+  const int64_t *c_rto = col<int64_t>(d, "c_rto", CC, &ok);
+  const int64_t *c_rtodl = col<int64_t>(d, "c_rtodl", CC, &ok);
+  const int64_t *c_tsrecent = col<int64_t>(d, "c_tsrecent", CC, &ok);
+  const int32_t *c_rtobackoff = col<int32_t>(d, "c_rtobackoff", CC, &ok);
+  const int64_t *c_segssent = col<int64_t>(d, "c_segssent", CC, &ok);
+  const int64_t *c_segsrecv = col<int64_t>(d, "c_segsrecv", CC, &ok);
+  const int64_t *c_rtxcount = col<int64_t>(d, "c_rtxcount", CC, &ok);
+  const int64_t *c_sackskip = col<int64_t>(d, "c_sackskip", CC, &ok);
+  const int64_t *c_tmrdl = col<int64_t>(d, "c_tmrdl", CC, &ok);
+  const uint32_t *c_status = col<uint32_t>(d, "c_status", CC, &ok);
+  const uint8_t *c_queued = col<uint8_t>(d, "c_queued", CC, &ok);
+  const int64_t *c_atcopied = col<int64_t>(d, "c_atcopied", CC, &ok);
+  const int64_t *c_atspace = col<int64_t>(d, "c_atspace", CC, &ok);
+  const int64_t *c_atlast = col<int64_t>(d, "c_atlast", CC, &ok);
+  const uint32_t *c_await = col<uint32_t>(d, "c_await", CC, &ok);
+  const int64_t *c_awaitseq = col<int64_t>(d, "c_awaitseq", CC, &ok);
+  const uint8_t *c_wakep = col<uint8_t>(d, "c_wakep", CC, &ok);
+  const int64_t *c_agot = col<int64_t>(d, "c_agot", CC, &ok);
+  const int32_t *rtx_len = col<int32_t>(d, "rtx_len", CC, &ok);
+  const uint32_t *rtx_seq =
+      col<uint32_t>(d, "rtx_seq", CC * (size_t)RT, &ok);
+  const int32_t *rtx_plen =
+      col<int32_t>(d, "rtx_plen", CC * (size_t)RT, &ok);
+  const uint8_t *rtx_rtxed =
+      col<uint8_t>(d, "rtx_rtxed", CC * (size_t)RT, &ok);
+  const uint8_t *rtx_sacked =
+      col<uint8_t>(d, "rtx_sacked", CC * (size_t)RT, &ok);
+  const int64_t *rtx_sent =
+      col<int64_t>(d, "rtx_sent", CC * (size_t)RT, &ok);
+  const int32_t *ra_len = col<int32_t>(d, "ra_len", CC, &ok);
+  const uint32_t *ra_seq =
+      col<uint32_t>(d, "ra_seq", CC * (size_t)RA, &ok);
+  const int32_t *ra_plen =
+      col<int32_t>(d, "ra_plen", CC * (size_t)RA, &ok);
+  const int32_t *op_len = col<int32_t>(d, "op_len", CC, &ok);
+  if (!ok) return nullptr;
+
+  for (size_t h = 0; h < H; h++) {
+    if (cq_len[h] < 0 || cq_len[h] > CQ || ib_len[h] < 0 ||
+        ib_len[h] > I || th_len[h] < 0 || th_len[h] > T) {
+      PyErr_SetString(PyExc_ValueError, "span import: length over cap");
+      return nullptr;
+    }
+  }
+  for (size_t j = 0; j < N; j++) {
+    if (rtx_len[j] < 0 || rtx_len[j] > RT || ra_len[j] < 0 ||
+        ra_len[j] > RA || op_len[j] < 0 || op_len[j] > OP) {
+      PyErr_SetString(PyExc_ValueError, "span import: length over cap");
+      return nullptr;
+    }
+  }
+
+  auto mk = [&](const TPkIn &c, size_t j) {
+    uint64_t id = e->store.alloc();
+    PacketN *p = e->store.get(id);
+    p->src_host = c.srchost[j];
+    p->seq = (uint64_t)c.pseq[j];
+    p->proto = PROTO_TCP;
+    p->src_ip = c.sip[j];
+    p->src_port = c.sport[j];
+    p->dst_ip = c.dip[j];
+    p->dst_port = c.dport[j];
+    p->payload.assign((size_t)c.plen[j], 'D');
+    p->has_tcp = true;
+    p->tcp = TcpHdrN{};
+    p->tcp.seq = c.tseq[j];
+    p->tcp.ack = c.tack[j];
+    p->tcp.flags = c.tflags[j];
+    p->tcp.window = c.twin[j];
+    p->tcp.ts_val = c.tsv[j];
+    p->tcp.ts_ecr = c.tse[j];
+    p->tcp.n_sacks = (int)std::min<int32_t>(c.nsk[j], 3);
+    for (int i = 0; i < p->tcp.n_sacks; i++) {
+      p->tcp.sacks[i].start = c.sk[2 * i][j];
+      p->tcp.sacks[i].end = c.sk[2 * i + 1][j];
+    }
+    p->priority = c.pseq[j];
+    return id;
+  };
+
+  /* ---- host-major state ---- */
+  for (size_t h = 0; h < H; h++) {
+    HostPlane *hp = e->hosts[h].get();
+    for (auto &[id, enq] : hp->codel.q) e->store.free_pkt(id);
+    hp->codel.q.clear();
+    for (int ri = 1; ri <= 2; ri++) {
+      if (hp->relays[ri].pending != UINT64_MAX) {
+        e->store.free_pkt(hp->relays[ri].pending);
+        hp->relays[ri].pending = UINT64_MAX;
+      }
+    }
+    for (const InboxEnt &ie : hp->inbox) e->store.free_pkt(ie.pkt);
+    hp->inbox.clear();
+    hp->theap.clear();
+
+    hp->now = now[h];
+    hp->event_seq = (uint64_t)event_seq[h];
+    hp->packet_seq = (uint64_t)packet_seq[h];
+    for (int32_t j = 0; j < cq_len[h]; j++)
+      hp->codel.q.emplace_back(mk(cq, h * (size_t)CQ + (size_t)j),
+                               cq_enq[h * (size_t)CQ + (size_t)j]);
+    hp->codel.bytes = codel_bytes[h];
+    hp->codel.dropping = codel_dropping[h] != 0;
+    hp->codel.count = codel_count[h];
+    hp->codel.last_count = codel_last_count[h];
+    hp->codel.first_above = codel_first_above[h];
+    hp->codel.drop_next = codel_drop_next[h];
+    hp->codel.dropped_count = codel_dropped[h];
+    for (int ri = 1; ri <= 2; ri++) {
+      RelayN &rl = hp->relays[ri];
+      rl.state = r_pending[ri][h] ? RELAY_PENDING : RELAY_IDLE;
+      rl.bucket.balance = r_bal[ri][h];
+      rl.bucket.next_refill = r_next[ri][h];
+      if (r_pk_valid[ri][h])
+        rl.pending = mk(ri == 1 ? r1pk : r2pk, h);
+    }
+    for (int32_t j = 0; j < ib_len[h]; j++) {
+      size_t k = h * (size_t)I + (size_t)j;
+      hp->ipush({ib_time[k], ib_src[k], (uint64_t)ib_seq[k], mk(ib, k)});
+    }
+    for (int32_t j = 0; j < th_len[h]; j++) {
+      size_t k = h * (size_t)T + (size_t)j;
+      uint32_t tgt;
+      if (th_kind[k] == TK_RELAY) {
+        tgt = (uint32_t)th_tgt[k];
+      } else if (th_tgt[k] < 0 || (size_t)th_tgt[k] >= N) {
+        continue;  // device dropped the target: stale entry
+      } else if (th_kind[k] == TK_TCP) {
+        tgt = sh.conn_tok[th_tgt[k]];
+      } else {
+        tgt = (uint32_t)sh.conn_app[th_tgt[k]];
+      }
+      hp->tpush({th_time[k], (uint64_t)th_seq[k], (int)th_kind[k], tgt});
+    }
+    for (int j = 0; j < ASYS_N; j++)
+      hp->app_sys[j] = app_sys[h * ASYS_N + j];
+    hp->pkts_sent = pkts_sent[h];
+    hp->pkts_recv = pkts_recv[h];
+    hp->pkts_dropped = pkts_dropped[h];
+    hp->events_run = events_run[h];
+    hp->eth.packets_sent = eth_psent[h];
+    hp->eth.packets_received = eth_precv[h];
+    hp->eth.bytes_sent = eth_bsent[h];
+    hp->eth.bytes_received = eth_brecv[h];
+  }
+
+  /* ---- conn-major state ---- */
+  for (size_t j = 0; j < N; j++) {
+    TcpSocketN *s = e->tcp(sh.conn_tok[j]);
+    TcpConn *c = s->conn.get();
+    AppN &a = e->apps[(size_t)sh.conn_app[j]];
+    HostPlane *hp = e->hosts[(size_t)sh.conn_host[j]].get();
+    bool was_queued = s->queued[1];
+    for (uint64_t id : s->out_packets[1]) e->store.free_pkt(id);
+    s->out_packets[1].clear();
+    for (int32_t k = 0; k < op_len[j]; k++)
+      s->out_packets[1].push_back(mk(op, j * (size_t)OP + (size_t)k));
+    c->snd_una = c_snduna[j];
+    c->snd_nxt = c_sndnxt[j];
+    c->snd_wnd = c_sndwnd[j];
+    c->rcv_nxt = c_rcvnxt[j];
+    c->send_buf.chunks.clear();
+    c->send_buf.len = 0;
+    if (c_sblen[j] > 0)
+      c->send_buf.append(std::string((size_t)c_sblen[j], 'D'));
+    c->send_buf_max = c_sbmax[j];
+    c->recv_buf.chunks.clear();
+    c->recv_buf.len = 0;
+    if (c_rblen[j] > 0)
+      c->recv_buf.append(std::string((size_t)c_rblen[j], 'D'));
+    c->recv_buf_max = c_rbmax[j];
+    c->delack_deadline = c_delackdl[j];
+    c->segs_since_ack = c_ssa[j];
+    c->persist_deadline = c_persistdl[j];
+    c->persist_interval = c_persistiv[j];
+    c->cwnd = c_cwnd[j];
+    c->ssthresh = c_ssthresh[j];
+    c->dupacks = c_dupacks[j];
+    c->in_fast_recovery = c_fastrec[j] != 0;
+    c->recover = c_recover[j];
+    c->srtt = c_srtt[j];
+    c->rttvar = c_rttvar[j];
+    c->rto = c_rto[j];
+    c->rto_deadline = c_rtodl[j];
+    c->ts_recent = c_tsrecent[j];
+    c->rto_backoff = c_rtobackoff[j];
+    c->segments_sent = c_segssent[j];
+    c->segments_received = c_segsrecv[j];
+    c->retransmit_count = c_rtxcount[j];
+    c->sacked_skip_count = c_sackskip[j];
+    c->rtx.clear();
+    for (int32_t k = 0; k < rtx_len[j]; k++) {
+      size_t kk = j * (size_t)RT + (size_t)k;
+      c->rtx.push_back({rtx_seq[kk],
+                        std::string((size_t)rtx_plen[kk], 'D'), false,
+                        rtx_sent[kk], rtx_rtxed[kk] != 0,
+                        rtx_sacked[kk] != 0});
+    }
+    c->reassembly.clear();
+    for (int32_t k = 0; k < ra_len[j]; k++) {
+      size_t kk = j * (size_t)RA + (size_t)k;
+      c->reassembly.emplace(ra_seq[kk],
+                            std::string((size_t)ra_plen[kk], 'D'));
+    }
+    s->timer_deadline = c_tmrdl[j];
+    s->status = c_status[j];
+    s->queued[1] = c_queued[j] != 0;
+    s->at_bytes_copied = c_atcopied[j];
+    s->at_space = c_atspace[j];
+    s->at_last_adjust = c_atlast[j];
+    if (s->queued[1] && !was_queued && !s->out_packets[1].empty()) {
+      if (hp->qdisc == 1)
+        hp->eth.send_ready.push_back(sh.conn_tok[j]);
+      else
+        hp->eth.heap_push(
+            e->store.get(s->out_packets[1].front())->priority,
+            sh.conn_tok[j]);
+    }
+    a.wait_mask = c_await[j];
+    a.wake_pending = c_wakep[j] != 0;
+    if (sh.conn_role[j] == 0) a.got = c_agot[j];
+    else a.sent = c_agot[j];
+  }
+  /* park order: device wait_seqs are per-host-relative; map into the
+   * global counter preserving each host's relative order. */
+  {
+    std::vector<std::tuple<int32_t, int64_t, size_t>> parked;
+    for (size_t j = 0; j < N; j++) {
+      AppN &a = e->apps[(size_t)sh.conn_app[j]];
+      if (a.wait_mask) parked.push_back({sh.conn_host[j],
+                                         c_awaitseq[j], j});
+    }
+    std::sort(parked.begin(), parked.end());
+    for (auto &[host, seq, j] : parked)
+      e->apps[(size_t)sh.conn_app[j]].wait_seq =
+          e->wait_park_counter.fetch_add(1, std::memory_order_relaxed);
+  }
+  /* refresh the shared next-event snapshot */
+  for (size_t h = 0; h < H; h++) {
+    HostPlane *hp = e->hosts[h].get();
+    if (e->nt && (int64_t)h < e->nt_len) {
+      int64_t best = INT64_MAX;
+      if (!hp->inbox.empty()) best = hp->inbox.front().time;
+      if (!hp->theap.empty() && hp->theap.front().time < best)
+        best = hp->theap.front().time;
+      e->nt[h] = best;
+    }
+  }
+
+  if (traces != Py_None) {
+    static const char *REASONS[] = {"",
+                                    "codel",
+                                    "rtr-limit",
+                                    "rcvbuf-full",
+                                    "no-socket",
+                                    "no-route",
+                                    "inet-loss",
+                                    "unreachable",
+                                    "udp-connected-filter"};
+    PyObject *tn = PyDict_GetItemString(traces, "n");
+    if (tn == nullptr) {
+      PyErr_SetString(PyExc_ValueError, "span import: traces missing n");
+      return nullptr;
+    }
+    size_t n = (size_t)PyLong_AsLongLong(tn);
+    bool tok = true;
+    const int64_t *t = col<int64_t>(traces, "t", n, &tok);
+    const uint8_t *kind = col<uint8_t>(traces, "kind", n, &tok);
+    const int32_t *srchost = col<int32_t>(traces, "srchost", n, &tok);
+    const int64_t *pseq = col<int64_t>(traces, "pseq", n, &tok);
+    const uint32_t *sip = col<uint32_t>(traces, "sip", n, &tok);
+    const int32_t *sport = col<int32_t>(traces, "sport", n, &tok);
+    const uint32_t *dip = col<uint32_t>(traces, "dip", n, &tok);
+    const int32_t *dport = col<int32_t>(traces, "dport", n, &tok);
+    const int64_t *size = col<int64_t>(traces, "size", n, &tok);
+    const uint8_t *reason = col<uint8_t>(traces, "reason", n, &tok);
+    const int32_t *owner = col<int32_t>(traces, "owner", n, &tok);
+    if (!tok) return nullptr;
+    for (size_t j = 0; j < n; j++) {
+      if (owner[j] < 0 || (size_t)owner[j] >= H) continue;
+      HostPlane *hp = e->hosts[(size_t)owner[j]].get();
+      if (!hp->tracing) continue;
+      if (reason[j] >= sizeof(REASONS) / sizeof(REASONS[0])) continue;
+      hp->trace.push_back({t[j], (int)kind[j], srchost[j],
+                           (uint64_t)pseq[j], PROTO_TCP, sip[j], dip[j],
+                           sport[j], dport[j], size[j],
+                           REASONS[reason[j]]});
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_set_devcap_probe(EngineObj *self, PyObject *args) {
+  int on;
+  if (!PyArg_ParseTuple(args, "i", &on)) return nullptr;
+  self->eng->devcap_probe = on != 0;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_devcap_counters(EngineObj *self, PyObject *) {
+  Engine *e = self->eng;
+  return Py_BuildValue("(LLLL)", (long long)e->devcap_rounds_total,
+                       (long long)e->devcap_rounds_full,
+                       (long long)e->devcap_steps_total,
+                       (long long)e->devcap_steps_ok);
+}
+
 static PyObject *eng_run_span(EngineObj *self, PyObject *args) {
   /* (start, stop, limit, runahead, dynamic, max_rounds, nthreads) ->
    * (rounds, packets, next_start, busy_end, runahead) or None when the
@@ -5724,6 +6883,14 @@ static PyMethodDef eng_methods[] = {
      METH_VARARGS, nullptr},
     {"span_import_phold", (PyCFunction)eng_span_import_phold,
      METH_VARARGS, nullptr},
+    {"span_export_tcp", (PyCFunction)eng_span_export_tcp,
+     METH_VARARGS, nullptr},
+    {"span_import_tcp", (PyCFunction)eng_span_import_tcp,
+     METH_VARARGS, nullptr},
+    {"set_devcap_probe", (PyCFunction)eng_set_devcap_probe,
+     METH_VARARGS, nullptr},
+    {"devcap_counters", (PyCFunction)eng_devcap_counters,
+     METH_NOARGS, nullptr},
     {"mt_stats", (PyCFunction)eng_mt_stats, METH_NOARGS, nullptr},
     {"set_pcap", (PyCFunction)eng_set_pcap, METH_VARARGS, nullptr},
     {"pcap_take", (PyCFunction)eng_pcap_take, METH_VARARGS, nullptr},

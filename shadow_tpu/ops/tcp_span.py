@@ -1,0 +1,1961 @@
+"""Device-resident multi-round loop for the tgen steady-stream TCP
+family (ISSUE 1 tentpole).
+
+Per-connection TCP control state — cwnd/ssthresh, RTO + backoff, the
+SACK scoreboard, send/recv buffer cursors, delack/persist timers —
+exports as struct-of-arrays (netplane.cpp span_export_tcp), steps
+inside the same conservative-window `lax.while_loop` shape as
+ops/phold_span.py, and imports back transactionally.  The modelled
+domain is the fixed-connection bulk-transfer stretch (no handshake, no
+FIN/RST, no accept churn — netgen.tcp_stream_yaml): every live
+connection ESTABLISHED, every client app mid-receive, every handler
+mid-send.  Anything else aborts the span (AB_STRUCT) and the engine's
+C++ path re-runs those rounds — fallback, never corruption.
+
+Layout: host-major arrays carry the shared per-host machinery (event
+seqs, CoDel, token-bucket relays, timer heap, inbox) exactly like the
+PHOLD kernel; connection-major arrays carry the TCP state machine,
+indexed through a per-host `cur` register (a host advances ONE micro-op
+at a time, so two lanes never touch one connection).  Packets carry
+their full TCP header through every ring (20 columns) because the
+receiver's state machine — not a fixed-size twin — interprets them.
+
+The twin contract is byte-identical packet-delivery traces against the
+serial object path, including lossy edges and retransmission
+(tests/test_tcp_span.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu.core.rng import STREAM_PACKET_LOSS, mix_key, threefry2x32_jax
+from shadow_tpu.core.simtime import TIME_NEVER
+
+I64_MAX = np.int64(1 << 62)
+SEQ_HALF = np.int64(1 << 31)
+SEQ_MOD = np.int64(1 << 32)
+
+# Continuations (one per host lane).
+C_IDLE = 0
+C_R1 = 1       # relay inet-out drain (one packet per micro-op)
+C_R2 = 2       # relay inet-in drain
+C_TCPIN = 3    # on_packet minus the push_data / reassembly loops
+C_DRAIN = 4    # reassembly drain (one chunk per micro-op)
+C_ACKDATA = 5  # ack_data decision after in-order delivery
+C_PUSH = 6     # push_data (one segment per micro-op)
+C_FLUSH = 7    # tcp_flush's notify decision
+C_ARM = 8      # tcp_flush's arm-timer + update-status tail
+C_APP = 9      # app stepper (client recv / handler send)
+C_TMR = 10     # TK_TCP timer fire
+
+# Timer kinds / status bits (netplane.cpp).
+TK_RELAY = 0
+TK_TCP = 1
+TK_APP = 2
+S_READABLE = 1 << 1
+S_WRITABLE = 1 << 2
+ASYS_SEND = 3
+ASYS_RECV = 4
+ASYS_N = 16
+
+# TCP constants (tcp/connection.py twins).
+F_FIN = 0x01
+F_SYN = 0x02
+F_RST = 0x04
+F_PSH = 0x08
+F_ACK = 0x10
+MSS = 1460
+MAX_WINDOW = 65_535
+TCP_TOTAL_HDR = 40  # IPv4 20 + TCP 20; options are not size-modelled
+MIN_RTO_NS = 200_000_000
+MAX_RTO_NS = 60_000_000_000
+DELACK_NS = 40_000_000
+WMEM_MAX = 4_194_304
+RMEM_MAX = 6_291_456
+
+MTU = 1500
+CODEL_TARGET_NS = 5_000_000
+CODEL_HARD_LIMIT = 1000
+REFILL_NS = 1_000_000
+
+TR_SND = 0
+TR_DRP = 1
+TR_RCV = 2
+RSN_CODEL = 1
+RSN_RTRLIMIT = 2
+RSN_LOSS = 6
+RSN_UNREACH = 7
+
+# Packet columns: routing identity + the TCP header.
+ROUTE_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
+TCP_KEYS = ("tseq", "tack", "tflags", "twin", "tsv", "tse", "plen",
+            "nsk", "sk0s", "sk0e", "sk1s", "sk1e", "sk2s", "sk2e")
+PK_KEYS = ROUTE_KEYS + TCP_KEYS
+PK_DTYPES = {
+    "srchost": np.int32, "pseq": np.int64, "sip": np.uint32,
+    "sport": np.int32, "dip": np.uint32, "dport": np.int32,
+    "tseq": np.uint32, "tack": np.uint32, "tflags": np.int32,
+    "twin": np.int64, "tsv": np.int64, "tse": np.int64,
+    "plen": np.int32, "nsk": np.int32,
+    "sk0s": np.uint32, "sk0e": np.uint32, "sk1s": np.uint32,
+    "sk1e": np.uint32, "sk2s": np.uint32, "sk2e": np.uint32,
+}
+
+# Abort reason bits (phold_span twin semantics).
+AB_TRACE = 1
+AB_OUT = 2
+AB_STRUCT = 4
+
+_FN_CACHE: dict = {}
+
+
+class TcpSpanRunner:
+    """Builds and drives the jitted multi-round device loop for the
+    tgen steady-stream TCP family.  One instance per Manager."""
+
+    # Ring capacities (compile-time; export refuses state beyond half
+    # of each, and the device aborts transactionally on overflow).
+    CAP_I = 512    # inbox (one window's arrivals can be a full cwnd)
+    # Timer heap: EVERY new ack restarts the RTO deadline, and the
+    # engine (like the kernel) pushes a fresh heap entry per change —
+    # stale entries only drain as their times pop, so the heap carries
+    # roughly one RTO's worth of ack churn (hundreds per busy server).
+    CAP_T = 4096
+    CAP_CQ = 2048  # CoDel ring (covers the 1000-entry hard limit)
+    CAP_RT = 256   # rtx queue (>= the max in-flight segment count)
+    CAP_RA = 256   # reassembly (an early hole strands ~a window)
+    CAP_OP = 256   # socket egress ring
+    MAX_ROUNDS = 256
+
+    def __init__(self, engine, latency_ns, thresholds, host_node,
+                 host_ips, seed, bootstrap_end, tracing: bool):
+        self.engine = engine
+        self.tracing = bool(tracing)
+        k0, k1 = mix_key(seed, STREAM_PACKET_LOSS)
+        self._k = (np.uint32(k0), np.uint32(k1))
+        self._lat = np.ascontiguousarray(latency_ns, dtype=np.int64)
+        self._thr = np.ascontiguousarray(thresholds, dtype=np.int64)
+        self._node = np.ascontiguousarray(host_node, dtype=np.int32)
+        ips = np.ascontiguousarray(host_ips, dtype=np.uint32)
+        order = np.argsort(ips)
+        self._ips_sorted = ips[order]
+        self._ips_perm = order.astype(np.int32)
+        self.bootstrap_end = int(bootstrap_end)
+        self._fn = None
+        self._H = len(host_ips)
+        self._CC = 0          # conn capacity (set from export)
+        # A round can carry a full congestion window from EVERY conn
+        # (~120 segments at the default 174 KiB windows), and traces
+        # accumulate across the whole span — pre-size so the grow-and-
+        # recompile abort path stays the rare case, not the norm.
+        self.cap_out = max(4096, 128 * self._H)
+        self.cap_tr = max(1 << 18, 1024 * self._H)
+        self.spans = 0
+        self.rounds = 0
+        self.aborts = 0
+        self.ineligible = 0
+        self.over_caps = 0
+        self.compiled = False
+        self.last_was_cold = False
+        # True right after an export that was transiently out of the
+        # domain: the span router shortens the following C++ span so
+        # the device is retried soon (a full-length C++ span would
+        # serve the whole sim and the device would never get a shot).
+        self.last_transient = False
+        self.mesh = None  # optional jax.sharding.Mesh ("hosts" axis)
+
+    def _caps(self):
+        return (self.CAP_I, self.CAP_T, self.CAP_CQ, self.CAP_RT,
+                self.CAP_RA, self.CAP_OP)
+
+    # ------------------------------------------------------------------
+    # Export bytes <-> numpy state
+    # ------------------------------------------------------------------
+
+    def _to_arrays(self, d: dict) -> dict:
+        H = self._H
+        I, T, CQ, RT, RA, OP = self._caps()
+
+        def f(k, dt, shape=None):
+            a = np.frombuffer(d[k], dtype=dt)
+            a = a.reshape(shape) if shape is not None else a
+            return a.copy()
+
+        n_conns = int(np.frombuffer(d["n_conns"], np.int64)[0])
+        CC = 8
+        while CC < n_conns:
+            CC <<= 1
+        self._CC = CC
+        st = {"_n_conns": n_conns}
+
+        def pk(prefix, shape):
+            for kk in PK_KEYS:
+                a = f(f"{prefix}_{kk}", PK_DTYPES[kk], shape)
+                if a.dtype == np.int32 and kk in ("tflags", "nsk"):
+                    a = a.astype(np.int32)
+                st[f"{prefix}_{kk}"] = a
+
+        for k in ("now", "event_seq", "packet_seq", "bw_up", "bw_down",
+                  "codel_bytes", "codel_count", "codel_last_count",
+                  "codel_first_above", "codel_drop_next",
+                  "codel_dropped", "pkts_sent", "pkts_recv",
+                  "pkts_dropped", "events_run", "eth_psent",
+                  "eth_precv", "eth_bsent", "eth_brecv"):
+            st[k] = f(k, np.int64)
+        st["eth_ip"] = f("eth_ip", np.uint32)
+        st["codel_dropping"] = f("codel_dropping", np.uint8).astype(
+            np.int32)
+        st["cq_len"] = f("cq_len", np.int32)
+        pk("cq", (H, CQ))
+        st["cq_enq"] = f("cq_enq", np.int64, (H, CQ))
+        for r in (1, 2):
+            st[f"r{r}_pending"] = f(f"r{r}_pending", np.uint8).astype(
+                np.int32)
+            st[f"r{r}_unlimited"] = f(f"r{r}_unlimited",
+                                      np.uint8).astype(np.int32)
+            for k in ("bal", "next", "refill", "cap"):
+                st[f"r{r}_{k}"] = f(f"r{r}_{k}", np.int64)
+            st[f"r{r}_pk_valid"] = f(f"r{r}_pk_valid",
+                                     np.uint8).astype(np.int32)
+            pk(f"r{r}_pk", None)
+        st["ib_len"] = f("ib_len", np.int32)
+        st["ib_time"] = f("ib_time", np.int64, (H, I))
+        st["ib_src"] = f("ib_src", np.int32, (H, I))
+        st["ib_seq"] = f("ib_seq", np.int64, (H, I))
+        pk("ib", (H, I))
+        st["th_time"] = f("th_time", np.int64, (H, T))
+        st["th_seq"] = f("th_seq", np.int64, (H, T))
+        st["th_kind"] = f("th_kind", np.uint8, (H, T)).astype(np.int32)
+        st["th_tgt"] = f("th_tgt", np.int32, (H, T))
+        st["th_valid"] = (np.arange(T)[None, :]
+                          < f("th_len", np.int32)[:, None])
+        st["app_sys"] = f("app_sys", np.int64, (H, ASYS_N))
+
+        # conn-major
+        for k, dt in (("c_host", np.int32), ("c_lport", np.int32),
+                      ("c_pport", np.int32), ("c_ourws", np.int32),
+                      ("c_peerws", np.int32), ("c_effmss", np.int32),
+                      ("c_wsoff", np.int32), ("c_ssa", np.int32),
+                      ("c_congmss", np.int32), ("c_dupacks", np.int32),
+                      ("c_rtobackoff", np.int32)):
+            st[k] = f(k, dt)
+        for k in ("c_lip", "c_pip", "c_iss", "c_irs", "c_snduna",
+                  "c_sndnxt", "c_rcvnxt", "c_recover", "c_status"):
+            st[k] = f(k, np.uint32)
+        st["c_await"] = f("c_await", np.uint32)
+        for k in ("c_role", "c_nodelay", "c_fastrec", "c_queued",
+                  "c_sat", "c_rat", "c_wakep"):
+            st[k] = f(k, np.uint8).astype(np.int32)
+        for k in ("c_sndwnd", "c_sblen", "c_sbmax", "c_rblen",
+                  "c_rbmax", "c_delackdl", "c_persistdl",
+                  "c_persistiv", "c_cwnd", "c_ssthresh", "c_srtt",
+                  "c_rttvar", "c_rto", "c_rtodl", "c_tsrecent",
+                  "c_segssent", "c_segsrecv", "c_rtxcount",
+                  "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
+                  "c_atlast", "c_awaitseq", "c_agot", "c_atotal"):
+            st[k] = f(k, np.int64)
+        st["rtx_len"] = f("rtx_len", np.int32)
+        st["rtx_seq"] = f("rtx_seq", np.uint32, (CC, RT))
+        st["rtx_plen"] = f("rtx_plen", np.int32, (CC, RT))
+        st["rtx_rtxed"] = f("rtx_rtxed", np.uint8, (CC, RT)).astype(
+            np.int32)
+        st["rtx_sacked"] = f("rtx_sacked", np.uint8, (CC, RT)).astype(
+            np.int32)
+        st["rtx_sent"] = f("rtx_sent", np.int64, (CC, RT))
+        st["ra_plen"] = f("ra_plen", np.int32, (CC, RA))
+        st["ra_seq"] = f("ra_seq", np.uint32, (CC, RA))
+        st["ra_valid"] = (np.arange(RA)[None, :]
+                          < f("ra_len", np.int32)[:, None])
+        st["op_len"] = f("op_len", np.int32)
+        pk("op", (CC, OP))
+
+        for k in ("cq_pos", "ib_pos", "rtx_pos", "op_pos"):
+            st[k] = np.zeros(H if k in ("cq_pos", "ib_pos") else CC,
+                             np.int32)
+        for k in ("cont", "then", "ret", "cur"):
+            st[k] = np.full(H, C_IDLE if k in ("cont", "then", "ret")
+                            else -1, np.int32)
+        # per-host chain registers
+        st["eflag"] = np.zeros(H, np.int32)     # emitted since flush
+        st["parkp"] = np.zeros(H, np.int32)     # sendto EAGAIN pending
+        st["had_holes"] = np.zeros(H, np.int32)
+        # arrival register (the packet C_TCPIN is processing)
+        for kk in PK_KEYS:
+            st[f"ar_{kk}"] = np.zeros(H, PK_DTYPES[kk])
+        # park-order counter: per-host relative (import remaps)
+        park0 = np.zeros(H, np.int64)
+        np.maximum.at(park0, st["c_host"][:n_conns],
+                      st["c_awaitseq"][:n_conns] + 1)
+        st["park_ctr"] = park0
+        # padded-slot invariants
+        st["ib_time"][np.arange(I)[None, :] >= st["ib_len"][:, None]] \
+            = I64_MAX
+        # conn lanes beyond n_conns must never match: park their host
+        # at an impossible id
+        st["c_host"][n_conns:] = -1
+        return st
+
+    def _from_arrays(self, st: dict) -> dict:
+        """Back to the engine's packed-byte import layout (rings
+        re-packed from their head positions)."""
+        H = self._H
+        I, T, CQ, RT, RA, OP = self._caps()
+        CC = self._CC
+        out = {}
+
+        def npv(k):
+            return np.asarray(st[k])
+
+        out["n_conns"] = np.int64(st["_n_conns"]).tobytes()
+
+        def ring(pfx, cap, pos_k, len_k, modulo, rows, extra=()):
+            pos = npv(pos_k).astype(np.int64)
+            ln = npv(len_k).astype(np.int64)
+            ar = np.arange(cap, dtype=np.int64)[None, :]
+            idx = (pos[:, None] + ar) % cap if modulo \
+                else np.minimum(pos[:, None] + ar, cap - 1)
+            for kk in PK_KEYS:
+                a = np.take_along_axis(npv(f"{pfx}_{kk}"), idx, axis=1)
+                out[f"{pfx}_{kk}"] = np.ascontiguousarray(a).tobytes()
+            for kk in extra:
+                a = np.take_along_axis(npv(kk), idx, axis=1)
+                out[kk] = np.ascontiguousarray(a).tobytes()
+            out[len_k] = (ln - pos).astype(np.int32).tobytes()
+
+        ring("cq", CQ, "cq_pos", "cq_len", True, H, extra=("cq_enq",))
+        ring("ib", I, "ib_pos", "ib_len", False, H,
+             extra=("ib_time", "ib_src", "ib_seq"))
+        ring("op", OP, "op_pos", "op_len", True, CC)
+        # rtx ring: non-PK columns, same pos/len repack
+        pos = npv("rtx_pos").astype(np.int64)
+        ln = npv("rtx_len").astype(np.int64)
+        ar = np.arange(RT, dtype=np.int64)[None, :]
+        idx = (pos[:, None] + ar) % RT
+        for kk, dt in (("rtx_seq", np.uint32), ("rtx_plen", np.int32),
+                       ("rtx_sent", np.int64)):
+            a = np.take_along_axis(npv(kk), idx, axis=1)
+            out[kk] = np.ascontiguousarray(a.astype(dt)).tobytes()
+        for kk in ("rtx_rtxed", "rtx_sacked"):
+            a = np.take_along_axis(npv(kk), idx, axis=1)
+            out[kk] = np.ascontiguousarray(a.astype(np.uint8)).tobytes()
+        out["rtx_len"] = (ln - pos).astype(np.int32).tobytes()
+        # reassembly: compact valid entries
+        rv = npv("ra_valid")
+        order = np.argsort(~rv, axis=1, kind="stable")
+        for kk, dt in (("ra_seq", np.uint32), ("ra_plen", np.int32)):
+            a = np.take_along_axis(npv(kk), order, axis=1)
+            out[kk] = np.ascontiguousarray(a.astype(dt)).tobytes()
+        out["ra_len"] = rv.sum(axis=1).astype(np.int32).tobytes()
+        # timer heap: compact valid entries
+        tv = npv("th_valid")
+        order = np.argsort(~tv, axis=1, kind="stable")
+        for k, dt in (("th_time", np.int64), ("th_seq", np.int64),
+                      ("th_tgt", np.int32)):
+            a = np.take_along_axis(npv(k), order, axis=1)
+            out[k] = np.ascontiguousarray(a.astype(dt)).tobytes()
+        a = np.take_along_axis(npv("th_kind"), order, axis=1)
+        out["th_kind"] = np.ascontiguousarray(
+            a.astype(np.uint8)).tobytes()
+        out["th_len"] = tv.sum(axis=1).astype(np.int32).tobytes()
+
+        for k in ("now", "event_seq", "packet_seq", "codel_bytes",
+                  "codel_count", "codel_last_count",
+                  "codel_first_above", "codel_drop_next",
+                  "codel_dropped", "pkts_sent", "pkts_recv",
+                  "pkts_dropped", "events_run", "eth_psent",
+                  "eth_precv", "eth_bsent", "eth_brecv"):
+            out[k] = npv(k).astype(np.int64).tobytes()
+        out["codel_dropping"] = npv("codel_dropping").astype(
+            np.uint8).tobytes()
+        for r in (1, 2):
+            out[f"r{r}_pending"] = npv(f"r{r}_pending").astype(
+                np.uint8).tobytes()
+            out[f"r{r}_pk_valid"] = npv(f"r{r}_pk_valid").astype(
+                np.uint8).tobytes()
+            out[f"r{r}_bal"] = npv(f"r{r}_bal").astype(
+                np.int64).tobytes()
+            out[f"r{r}_next"] = npv(f"r{r}_next").astype(
+                np.int64).tobytes()
+            for kk in PK_KEYS:
+                out[f"r{r}_pk_{kk}"] = np.ascontiguousarray(
+                    npv(f"r{r}_pk_{kk}").astype(
+                        PK_DTYPES[kk])).tobytes()
+        out["app_sys"] = npv("app_sys").astype(np.int64).tobytes()
+        for k, dt in (("c_snduna", np.uint32), ("c_sndnxt", np.uint32),
+                      ("c_rcvnxt", np.uint32), ("c_recover", np.uint32),
+                      ("c_status", np.uint32), ("c_await", np.uint32)):
+            out[k] = npv(k).astype(dt).tobytes()
+        for k in ("c_sndwnd", "c_sblen", "c_sbmax", "c_rblen",
+                  "c_rbmax", "c_delackdl", "c_persistdl",
+                  "c_persistiv", "c_cwnd", "c_ssthresh", "c_srtt",
+                  "c_rttvar", "c_rto", "c_rtodl", "c_tsrecent",
+                  "c_segssent", "c_segsrecv", "c_rtxcount",
+                  "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
+                  "c_atlast", "c_awaitseq", "c_agot"):
+            out[k] = npv(k).astype(np.int64).tobytes()
+        for k in ("c_ssa", "c_dupacks", "c_rtobackoff"):
+            out[k] = npv(k).astype(np.int32).tobytes()
+        for k in ("c_fastrec", "c_queued", "c_wakep"):
+            out[k] = npv(k).astype(np.uint8).tobytes()
+        return out
+
+    # ------------------------------------------------------------------
+    # The jitted multi-round step
+    # ------------------------------------------------------------------
+
+    def _cached_build(self):
+        key = (self._H, self._CC, self._caps(), self.cap_out,
+               self.cap_tr, self.tracing)
+        fn = _FN_CACHE.get(key)
+        if fn is None:
+            fn = _FN_CACHE[key] = self._build()
+        return fn
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        H = self._H
+        CC = self._CC
+        I, T, CQ, RT, RA, OP = self._caps()
+        O = self.cap_out
+        TR = self.cap_tr
+        tracing = self.tracing
+        hidx = jnp.arange(H, dtype=jnp.int32)
+        OOB = jnp.int32(H + 1)
+        COOB = jnp.int32(CC + 1)
+
+        def mrows(mask):
+            return jnp.where(mask, hidx, OOB)
+
+        def s_i64(a):
+            return a.astype(jnp.int64)
+
+        def s_sub(a, b):
+            d = (s_i64(a) - s_i64(b)) & jnp.int64(0xFFFFFFFF)
+            return d - jnp.where(d >= SEQ_HALF, SEQ_MOD, jnp.int64(0))
+
+        def s_add(a, n):
+            return (s_i64(a) + s_i64(n)).astype(jnp.uint32)
+
+        def s_lt(a, b):
+            return s_sub(a, b) < 0
+
+        def s_leq(a, b):
+            return s_sub(a, b) <= 0
+
+        def mark_abort(st, cond, bit, site=0):
+            st = dict(st)
+            hit = cond if getattr(cond, "ndim", 0) == 0 else cond.any()
+            st["abort_code"] = st["abort_code"] | jnp.where(
+                hit, jnp.int32(bit), jnp.int32(0))
+            st["abort_site"] = jnp.where(
+                hit & (st["abort_site"] == 0), jnp.int32(site),
+                st["abort_site"])
+            return st
+
+        def draw_seq(st, mask):
+            v = st["event_seq"]
+            st = dict(st)
+            st["event_seq"] = jnp.where(mask, v + 1, v)
+            return st, v
+
+        def th_push(st, mask, time, seq, kind, tgt):
+            free = jnp.argmin(st["th_valid"], axis=1)
+            overflow = mask & st["th_valid"].all(axis=1)
+            mask = mask & ~overflow
+            rows = mrows(mask)
+            st = dict(st)
+            st["th_time"] = st["th_time"].at[rows, free].set(
+                time, mode="drop")
+            st["th_seq"] = st["th_seq"].at[rows, free].set(
+                seq, mode="drop")
+            st["th_kind"] = st["th_kind"].at[rows, free].set(
+                jnp.full(H, kind, jnp.int32) if np.isscalar(kind)
+                else kind, mode="drop")
+            st["th_tgt"] = st["th_tgt"].at[rows, free].set(
+                tgt, mode="drop")
+            st["th_valid"] = st["th_valid"].at[rows, free].set(
+                True, mode="drop")
+            return mark_abort(st, overflow.any(), AB_STRUCT, 1)
+
+        def th_min(st):
+            t = jnp.where(st["th_valid"], st["th_time"], I64_MAX)
+            best_t = t.min(axis=1)
+            s = jnp.where(t == best_t[:, None], st["th_seq"], I64_MAX)
+            slot = jnp.argmin(s, axis=1)
+            return (best_t, st["th_kind"][hidx, slot],
+                    st["th_tgt"][hidx, slot], slot)
+
+        # -------- conn gather/scatter via the per-host cur register --
+
+        def cg(st, key):
+            return st[key][jnp.clip(st["cur"], 0, CC - 1)]
+
+        def crows(st, mask):
+            return jnp.where(mask & (st["cur"] >= 0), st["cur"], COOB)
+
+        def cset(st, mask, **vals):
+            rows = crows(st, mask)
+            st = dict(st)
+            for key, v in vals.items():
+                st[key] = st[key].at[rows].set(v, mode="drop")
+            return st
+
+        # -------- trace / outbox appends (flat buffers) --------------
+
+        def seq_append(st, cap_total, mask, cols, count_key, abort_bit):
+            st = dict(st)
+            n = st[count_key]
+            rank = jnp.cumsum(mask) - 1
+            slot = jnp.where(mask, n + rank, cap_total + 8)
+            for key, v in cols.items():
+                st[key] = st[key].at[slot].set(v, mode="drop")
+            total = n + mask.sum()
+            st[count_key] = total
+            return mark_abort(st, total > cap_total - H, abort_bit)
+
+        def tr_append(st, mask, time, kind, pk, reason):
+            if not tracing:
+                return st
+            return seq_append(
+                st, TR, mask,
+                {"tr_t": time,
+                 "tr_kind": jnp.full(H, kind, jnp.int32),
+                 "tr_srchost": pk["srchost"], "tr_pseq": pk["pseq"],
+                 "tr_sip": pk["sip"], "tr_sport": pk["sport"],
+                 "tr_dip": pk["dip"], "tr_dport": pk["dport"],
+                 "tr_plen": pk["plen"],
+                 "tr_reason": jnp.full(H, reason, jnp.int32),
+                 "tr_owner": hidx}, "tr_n", AB_TRACE)
+
+        # -------- TCP helpers (connection.py twins, lane-vectorized) --
+
+        def recv_window(st):
+            cap = s_i64(jnp.int64(MAX_WINDOW)) << cg(st, "c_ourws")
+            space = jnp.maximum(jnp.int64(0),
+                                cg(st, "c_rbmax") - cg(st, "c_rblen"))
+            return jnp.minimum(cap, space)
+
+        def wire_window(st):
+            # non-SYN segments only in-domain: always scaled
+            return jnp.minimum(recv_window(st) >> cg(st, "c_ourws"),
+                               jnp.int64(MAX_WINDOW))
+
+        def sack_blocks(st):
+            """Merged reassembly runs for the host lanes' cur conns:
+            (nsk, s0,e0,s1,e1,s2,e2) — connection.py _sack_blocks."""
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            valid = st["ra_valid"][cur]                     # (H, RA)
+            seq = st["ra_seq"][cur]
+            plen = st["ra_plen"][cur]
+            base = cg(st, "c_rcvnxt")[:, None]
+            rel = jnp.where(valid, s_sub(seq, base), I64_MAX)
+            order = jnp.argsort(rel, axis=1)
+            take = jnp.take_along_axis
+            rs = take(rel, order, axis=1)                   # starts
+            re = rs + take(jnp.where(valid, plen, 0), order,
+                           axis=1).astype(jnp.int64)        # ends
+            sv = take(valid, order, axis=1)
+            # merged-run boundaries: start beyond the running max end
+            prev_end = jnp.concatenate(
+                [jnp.full((H, 1), -I64_MAX),
+                 jax.lax.cummax(re, axis=1)[:, :-1]], axis=1)
+            newrun = sv & (rs > prev_end)
+            run_id = jnp.cumsum(newrun, axis=1)             # 1-based
+            run_end = jax.lax.cummax(jnp.where(sv, re, -I64_MAX),
+                                     axis=1)
+            nsk = jnp.minimum(run_id.max(axis=1), 3).astype(jnp.int32)
+            outs = []
+            for r in range(3):
+                inr = sv & (run_id == r + 1)
+                srel = jnp.min(jnp.where(newrun & (run_id == r + 1),
+                                         rs, I64_MAX), axis=1)
+                erel = jnp.max(jnp.where(inr, run_end, -I64_MAX),
+                               axis=1)
+                has = inr.any(axis=1)
+                s_abs = jnp.where(has, s_add(cg(st, "c_rcvnxt"), srel),
+                                  jnp.uint32(0))
+                e_abs = jnp.where(has, s_add(cg(st, "c_rcvnxt"), erel),
+                                  jnp.uint32(0))
+                outs += [s_abs, e_abs]
+            return (nsk,) + tuple(outs)
+
+        def take_ts_echo(st, mask):
+            tse = cg(st, "c_tsrecent")
+            st = cset(st, mask, c_tsrecent=jnp.where(
+                mask, jnp.int64(0), cg(st, "c_tsrecent")))
+            return st, tse
+
+        def emit(st, mask, tseq, plen, flags, with_sacks, track):
+            """One segment from each masked lane's cur conn into its
+            egress ring — the outbox+flush collapse: emission order IS
+            flush order, so pseq assignment at emission is identical.
+            All in-domain emissions carry ACK (note_ack_sent)."""
+            now = st["now"]
+            win = wire_window(st)
+            if with_sacks:
+                nsk, s0, e0, s1, e1, s2, e2 = sack_blocks(st)
+            else:
+                z = jnp.zeros(H, jnp.uint32)
+                nsk = jnp.zeros(H, jnp.int32)
+                s0 = e0 = s1 = e1 = s2 = e2 = z
+            st, tse = take_ts_echo(st, mask)
+            pseq = st["packet_seq"]
+            st = dict(st)
+            st["packet_seq"] = jnp.where(mask, pseq + 1, pseq)
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            tail = (st["op_len"][cur] % OP).astype(jnp.int32)
+            over = mask & (st["op_len"][cur] - st["op_pos"][cur]
+                           >= OP - 1)
+            st = mark_abort(st, over.any(), AB_STRUCT, 2)
+            st = dict(st)
+            rows = crows(st, mask)
+            vals = {"srchost": hidx, "pseq": pseq,
+                    "sip": cg(st, "c_lip"), "sport": cg(st, "c_lport"),
+                    "dip": cg(st, "c_pip"), "dport": cg(st, "c_pport"),
+                    "tseq": tseq, "tack": cg(st, "c_rcvnxt"),
+                    "tflags": jnp.full(H, flags, jnp.int32),
+                    "twin": win, "tsv": now + 1, "tse": tse,
+                    "plen": plen.astype(jnp.int32), "nsk": nsk,
+                    "sk0s": s0, "sk0e": e0, "sk1s": s1, "sk1e": e1,
+                    "sk2s": s2, "sk2e": e2}
+            for kk in PK_KEYS:
+                st[f"op_{kk}"] = st[f"op_{kk}"].at[rows, tail].set(
+                    vals[kk], mode="drop")
+            st["op_len"] = st["op_len"].at[rows].add(1, mode="drop")
+            st["c_segssent"] = st["c_segssent"].at[rows].add(
+                1, mode="drop")
+            # note_ack_sent: segs_since_ack=0, delack cleared
+            st["c_ssa"] = st["c_ssa"].at[rows].set(0, mode="drop")
+            st["c_delackdl"] = st["c_delackdl"].at[rows].set(
+                jnp.int64(-1), mode="drop")
+            st["eflag"] = jnp.where(mask, 1, st["eflag"])
+            if track:
+                rtail = (st["rtx_len"][cur] % RT).astype(jnp.int32)
+                rover = mask & (st["rtx_len"][cur]
+                                - st["rtx_pos"][cur] >= RT - 1)
+                st = mark_abort(st, rover.any(), AB_STRUCT, 3)
+                st = dict(st)
+                st["rtx_seq"] = st["rtx_seq"].at[rows, rtail].set(
+                    tseq, mode="drop")
+                st["rtx_plen"] = st["rtx_plen"].at[rows, rtail].set(
+                    plen.astype(jnp.int32), mode="drop")
+                st["rtx_rtxed"] = st["rtx_rtxed"].at[rows, rtail].set(
+                    0, mode="drop")
+                st["rtx_sacked"] = st["rtx_sacked"].at[rows, rtail].set(
+                    0, mode="drop")
+                st["rtx_sent"] = st["rtx_sent"].at[rows, rtail].set(
+                    now, mode="drop")
+                st["rtx_len"] = st["rtx_len"].at[rows].add(
+                    1, mode="drop")
+                # emit(track): arm RTO if not armed
+                arm = mask & (cg(st, "c_rtodl") < 0)
+                st = cset(st, arm, c_rtodl=now + cg(st, "c_rto"))
+            return st
+
+        def emit_ack(st, mask):
+            return emit(st, mask, cg(st, "c_sndnxt"),
+                        jnp.zeros(H, jnp.int64), F_ACK,
+                        with_sacks=True, track=False)
+
+        # -------- token bucket / relays ------------------------------
+
+        def bucket_try(st, r, now, mask, size):
+            bal = st[f"r{r}_bal"]
+            nxt = st[f"r{r}_next"]
+            refill = st[f"r{r}_refill"]
+            cap = st[f"r{r}_cap"]
+            unlimited = st[f"r{r}_unlimited"] == 1
+            first = nxt == 0
+            k = jnp.maximum(np.int64(0),
+                            1 + (now - nxt) // np.int64(REFILL_NS))
+            do_ref = ~first & (now >= nxt)
+            bal2 = jnp.where(do_ref, jnp.minimum(cap, bal + k * refill),
+                             bal)
+            nxt2 = jnp.where(first, now + np.int64(REFILL_NS),
+                             jnp.where(do_ref,
+                                       nxt + k * np.int64(REFILL_NS),
+                                       nxt))
+            ok = unlimited | (size <= bal2)
+            bal3 = jnp.where(~unlimited & ok, bal2 - size, bal2)
+            st = dict(st)
+            st[f"r{r}_bal"] = jnp.where(mask, bal3, bal)
+            st[f"r{r}_next"] = jnp.where(mask, nxt2, nxt)
+            return st, ok, nxt2
+
+        def control_time(t, count):
+            v = count << 32
+            g = jnp.sqrt(v.astype(jnp.float64)).astype(jnp.int64)
+            g = jnp.where(g * g > v, g - 1, g)
+            g = jnp.where(g * g > v, g - 1, g)
+            g = jnp.where((g + 1) * (g + 1) <= v, g + 1, g)
+            g = jnp.where((g + 1) * (g + 1) <= v, g + 1, g)
+            g = jnp.maximum(g, 1)
+            return t + (np.int64(100_000_000) << 16) // g
+
+        def op_relay1(st, mask):
+            """inet-out drain: iface_pop over the host's queued conns
+            (min head priority = the engine's per-iface qdisc heap),
+            SND trace, token bucket, cross-host outbox."""
+            now = st["now"]
+            use_pend = mask & (st["r1_pk_valid"] == 1)
+            # qdisc selection: min head-pseq among queued conns
+            head = (st["op_pos"] % OP).astype(jnp.int32)
+            cidx = jnp.arange(CC, dtype=jnp.int32)
+            nonempty = st["op_len"] > st["op_pos"]
+            eligible = (st["c_queued"] == 1) & nonempty \
+                & (st["c_host"] >= 0)
+            head_prio = st["op_pseq"][cidx, head]
+            chost_safe = jnp.where(st["c_host"] >= 0, st["c_host"], H)
+            best = jnp.full(H + 1, I64_MAX, jnp.int64).at[
+                chost_safe].min(jnp.where(eligible, head_prio,
+                                          I64_MAX))[:H]
+            src_avail = mask & ~use_pend & (best < I64_MAX)
+            sel_match = eligible & (head_prio == best[chost_safe
+                                                      .clip(0, H - 1)])
+            sel = jnp.full(H + 1, -1, jnp.int32).at[chost_safe].max(
+                jnp.where(sel_match, cidx, -1))[:H]
+            sel_safe = jnp.clip(sel, 0, CC - 1)
+            hsel = head[sel_safe]
+            pk = {kk: jnp.where(use_pend, st[f"r1_pk_{kk}"],
+                                st[f"op_{kk}"][sel_safe, hsel])
+                  for kk in PK_KEYS}
+            pop = src_avail
+            st = dict(st)
+            st["r1_pk_valid"] = jnp.where(use_pend, 0,
+                                          st["r1_pk_valid"])
+            # iface_pop: dequeue + requeue-if-more + SND trace + eth
+            rows = jnp.where(pop, sel, COOB)
+            st["op_pos"] = st["op_pos"].at[rows].add(1, mode="drop")
+            still = st["op_len"][sel_safe] > st["op_pos"][sel_safe]
+            st["c_queued"] = st["c_queued"].at[rows].set(
+                jnp.where(still, 1, 0), mode="drop")
+            size = s_i64(pk["plen"]) + TCP_TOTAL_HDR
+            st["eth_psent"] = jnp.where(pop, st["eth_psent"] + 1,
+                                        st["eth_psent"])
+            st["eth_bsent"] = jnp.where(pop, st["eth_bsent"] + size,
+                                        st["eth_bsent"])
+            st = tr_append(st, pop, now, TR_SND, pk, 0)
+            st = dict(st)
+
+            has_pkt = use_pend | pop
+            st, ok, when = bucket_try(st, 1, now, has_pkt, size)
+            throttled = has_pkt & ~ok
+            st = dict(st)
+            st["r1_pending"] = jnp.where(throttled, 1,
+                                         st["r1_pending"])
+            st["r1_pk_valid"] = jnp.where(throttled, 1,
+                                          st["r1_pk_valid"])
+            for kk in PK_KEYS:
+                st[f"r1_pk_{kk}"] = jnp.where(throttled, pk[kk],
+                                              st[f"r1_pk_{kk}"])
+            st, sq = draw_seq(st, throttled)
+            st = th_push(st, throttled, when, sq, TK_RELAY,
+                         jnp.full(H, 1, jnp.int32))
+            st = dict(st)
+
+            fwd = has_pkt & ok
+            # device_push(dev=2): dst must be a remote engine host
+            dslot = jnp.minimum(
+                jnp.searchsorted(st["_ips_sorted"], pk["dip"]), H - 1)
+            found = st["_ips_sorted"][dslot] == pk["dip"]
+            dst = st["_ips_perm"][dslot]
+            bad = fwd & (~found | (dst == hidx))
+            st = mark_abort(st, bad.any(), AB_STRUCT, 4)
+            st = dict(st)
+            st["pkts_sent"] = jnp.where(fwd, st["pkts_sent"] + 1,
+                                        st["pkts_sent"])
+            hit = fwd & found
+            st, sq = draw_seq(st, hit)
+            cols = {"out_src": hidx, "out_dst": dst, "out_seq": sq,
+                    "out_t": now}
+            for kk in PK_KEYS:
+                cols[f"out_{kk}"] = pk[kk]
+            st = seq_append(st, O, hit, cols, "out_n", AB_OUT)
+            st = dict(st)
+            done = mask & ~has_pkt | throttled
+            st["cont"] = jnp.where(done, st["then"], st["cont"])
+            return st
+
+        def op_relay2(st, mask):
+            """inet-in drain: CoDel pop -> token bucket ->
+            iface_receive -> conn match -> hand to C_TCPIN."""
+            now = st["now"]
+            use_pend = mask & (st["r2_pk_valid"] == 1)
+            src_avail = mask & ~use_pend & (st["cq_len"]
+                                            > st["cq_pos"])
+            pos = st["cq_pos"] % CQ
+            pk = {kk: jnp.where(use_pend, st[f"r2_pk_{kk}"],
+                                st[f"cq_{kk}"][hidx, pos])
+                  for kk in PK_KEYS}
+            enq = st["cq_enq"][hidx, pos]
+            pop = mask & ~use_pend & src_avail
+            none = mask & ~use_pend & ~src_avail
+            size = s_i64(pk["plen"]) + TCP_TOTAL_HDR
+
+            st = dict(st)
+            st["r2_pk_valid"] = jnp.where(use_pend, 0,
+                                          st["r2_pk_valid"])
+            st["cq_pos"] = jnp.where(pop, st["cq_pos"] + 1,
+                                     st["cq_pos"])
+            st["codel_bytes"] = jnp.where(
+                pop, st["codel_bytes"] - size, st["codel_bytes"])
+            # dequeue_raw's ok/first_above law (netplane codel_pop)
+            sojourn = now - enq
+            quiet = pop & ((sojourn < CODEL_TARGET_NS)
+                           | (st["codel_bytes"] <= MTU))
+            above = pop & ~quiet
+            arm = above & (st["codel_first_above"] == 0)
+            cok = above & ~arm & (now >= st["codel_first_above"])
+            st["codel_first_above"] = jnp.where(
+                quiet | none, 0,
+                jnp.where(arm, now + np.int64(100_000_000),
+                          st["codel_first_above"]))
+            st["codel_dropping"] = jnp.where(none, 0,
+                                             st["codel_dropping"])
+            st["cd_chain"] = jnp.where(none, 0, st["cd_chain"])
+            st["cd_sniff"] = jnp.where(none, 0, st["cd_sniff"])
+
+            in_sniff = st["cd_sniff"] == 1
+            in_chain = (st["cd_chain"] == 1) & ~in_sniff
+            top = pop & ~in_sniff & ~in_chain
+
+            sg = pop & in_sniff
+            cnt_new = jnp.where(
+                now - st["codel_drop_next"] < np.int64(100_000_000),
+                jnp.where(st["codel_count"] > 2,
+                          st["codel_count"] - st["codel_last_count"],
+                          1), 1)
+            st["codel_dropping"] = jnp.where(sg, 1,
+                                             st["codel_dropping"])
+            st["codel_count"] = jnp.where(sg, cnt_new,
+                                          st["codel_count"])
+            st["codel_last_count"] = jnp.where(
+                sg, cnt_new, st["codel_last_count"])
+            st["codel_drop_next"] = jnp.where(
+                sg, control_time(now, cnt_new), st["codel_drop_next"])
+            st["cd_sniff"] = jnp.where(sg, 0, st["cd_sniff"])
+
+            cg_ = pop & in_chain
+            cg_exit = cg_ & ~cok
+            st["codel_dropping"] = jnp.where(cg_exit, 0,
+                                             st["codel_dropping"])
+            st["cd_chain"] = jnp.where(cg_exit, 0, st["cd_chain"])
+            cg_ok = cg_ & cok
+            dn2 = control_time(st["codel_drop_next"],
+                               st["codel_count"])
+            st["codel_drop_next"] = jnp.where(cg_ok, dn2,
+                                              st["codel_drop_next"])
+            cg_drop = cg_ok & (now >= st["codel_drop_next"])
+            cg_deliver = cg_ok & ~cg_drop
+            st["cd_chain"] = jnp.where(cg_deliver, 0, st["cd_chain"])
+
+            td = top & (st["codel_dropping"] == 1)
+            td_exit = td & ~cok
+            st["codel_dropping"] = jnp.where(td_exit, 0,
+                                             st["codel_dropping"])
+            td_ok = td & cok
+            td_drop = td_ok & (now >= st["codel_drop_next"])
+            st["cd_chain"] = jnp.where(td_drop, 1, st["cd_chain"])
+
+            tl = top & ~td & cok & (
+                (now - st["codel_drop_next"] < np.int64(100_000_000))
+                | (now - st["codel_first_above"]
+                   >= np.int64(100_000_000)))
+            st["cd_sniff"] = jnp.where(tl, 1, st["cd_sniff"])
+
+            codel_drop = cg_drop | td_drop | tl
+            st["codel_count"] = jnp.where(
+                cg_drop | td_drop, st["codel_count"] + 1,
+                st["codel_count"])
+            st["codel_dropped"] = jnp.where(
+                codel_drop, st["codel_dropped"] + 1,
+                st["codel_dropped"])
+            st["pkts_dropped"] = jnp.where(
+                codel_drop, st["pkts_dropped"] + 1,
+                st["pkts_dropped"])
+            st = tr_append(st, codel_drop, now, TR_DRP, pk, RSN_CODEL)
+            st = dict(st)
+            pop = pop & ~codel_drop
+
+            has_pkt = use_pend | pop
+            st, ok, when = bucket_try(st, 2, now, has_pkt, size)
+            throttled = has_pkt & ~ok
+            st = dict(st)
+            st["r2_pending"] = jnp.where(throttled, 1,
+                                         st["r2_pending"])
+            st["r2_pk_valid"] = jnp.where(throttled, 1,
+                                          st["r2_pk_valid"])
+            for kk in PK_KEYS:
+                st[f"r2_pk_{kk}"] = jnp.where(throttled, pk[kk],
+                                              st[f"r2_pk_{kk}"])
+            st, sq = draw_seq(st, throttled)
+            st = th_push(st, throttled, when, sq, TK_RELAY,
+                         jnp.full(H, 2, jnp.int32))
+            st = dict(st)
+
+            fwd = has_pkt & ok
+            # iface_receive: eth counters, then the association match
+            st["eth_precv"] = jnp.where(fwd, st["eth_precv"] + 1,
+                                        st["eth_precv"])
+            st["eth_brecv"] = jnp.where(fwd, st["eth_brecv"] + size,
+                                        st["eth_brecv"])
+            st = mark_abort(st, (fwd & (pk["dip"]
+                                        != st["eth_ip"])).any(),
+                            AB_STRUCT, 5)
+            st = dict(st)
+            # conn lookup: (dsthost, src-ip-host, sport) key
+            sslot = jnp.minimum(
+                jnp.searchsorted(st["_ips_sorted"], pk["sip"]), H - 1)
+            sfound = st["_ips_sorted"][sslot] == pk["sip"]
+            sidx = st["_ips_perm"][sslot]
+            akey = (s_i64(hidx) * H + s_i64(sidx)) * 65536 \
+                + s_i64(pk["sport"])
+            kslot = jnp.minimum(
+                jnp.searchsorted(st["_ckeys"], akey), CC - 1)
+            kfound = sfound & (st["_ckeys"][kslot] == akey)
+            conn = st["_ckperm"][kslot]
+            good_port = kfound & (st["c_lport"][conn] == pk["dport"])
+            st = mark_abort(st, (fwd & ~good_port).any(), AB_STRUCT, 6)
+            st = dict(st)
+            hit = fwd & good_port
+            # delivered: trace RCV at arrival (sort key separates it
+            # from same-instant SND/DRP lines; append order is free)
+            st["pkts_recv"] = jnp.where(hit, st["pkts_recv"] + 1,
+                                        st["pkts_recv"])
+            st = tr_append(st, hit, now, TR_RCV, pk, 0)
+            st = dict(st)
+            # hand to the state machine: C_TCPIN on this conn
+            st["cur"] = jnp.where(hit, conn, st["cur"])
+            for kk in PK_KEYS:
+                st[f"ar_{kk}"] = jnp.where(hit, pk[kk],
+                                           st[f"ar_{kk}"])
+            st["ret"] = jnp.where(hit, C_R2, st["ret"])
+            st["cont"] = jnp.where(hit, C_TCPIN, st["cont"])
+            # r2 drains only ever start from an event (arrival /
+            # TK_RELAY wake), so the return is always idle — `then`
+            # stays r1's register (the nested flush->r1 drains inside
+            # this chain would clobber a shared one).
+            done = none | throttled
+            st["cont"] = jnp.where(done, C_IDLE, st["cont"])
+            return st
+
+        # -------- TCP state machine ----------------------------------
+
+        def update_rtt(st, mask, sample):
+            sample = jnp.maximum(sample, 1)
+            srtt = cg(st, "c_srtt")
+            rttvar = cg(st, "c_rttvar")
+            first = srtt == 0
+            n_srtt = jnp.where(first, sample,
+                               (7 * srtt + sample) // 8)
+            err = jnp.abs(srtt - sample)
+            n_var = jnp.where(first, sample // 2,
+                              (3 * rttvar + err) // 4)
+            rto = n_srtt + jnp.maximum(4 * n_var,
+                                       jnp.int64(1_000_000))
+            rto = jnp.clip(rto, MIN_RTO_NS, MAX_RTO_NS)
+            return cset(st, mask, c_srtt=n_srtt, c_rttvar=n_var,
+                        c_rto=rto)
+
+        def rtx_rows(st):
+            """Gathered rtx rings for the cur conns: (H, RT) views in
+            ring order plus the valid mask."""
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            pos = st["rtx_pos"][cur][:, None]
+            ln = st["rtx_len"][cur][:, None]
+            ar = jnp.arange(RT, dtype=jnp.int32)[None, :]
+            idx = ((pos + ar) % RT).astype(jnp.int32)
+            take = jnp.take_along_axis
+            rows = {k: take(st[k][cur], idx, axis=1)
+                    for k in ("rtx_seq", "rtx_plen", "rtx_rtxed",
+                              "rtx_sacked", "rtx_sent")}
+            rows["valid"] = ar < (ln - pos)
+            rows["idx"] = idx
+            return rows
+
+        def rtx_scatter(st, mask, rows, keys):
+            st = dict(st)
+            rmask = crows(st, mask)[:, None]  # broadcasts with idx
+            for k in keys:
+                st[k] = st[k].at[rmask, rows["idx"]].set(
+                    rows[k], mode="drop")
+            return st
+
+        def clear_acked(st, mask):
+            """Pop leading fully-acked rtx entries (ring-order run)."""
+            rows = rtx_rows(st)
+            end = s_add(rows["rtx_seq"], rows["rtx_plen"])
+            una = cg(st, "c_snduna")[:, None]
+            covered = rows["valid"] & s_leq(end, una)
+            lead = jnp.cumprod(covered.astype(jnp.int32), axis=1)
+            pops = lead.sum(axis=1).astype(jnp.int32)
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            # pos/len grow monotonically (mod applied at access, like
+            # every other ring here): popping only advances pos
+            new_pos = st["rtx_pos"][cur] + pops
+            st = dict(st)
+            r = crows(st, mask)
+            st["rtx_pos"] = st["rtx_pos"].at[r].set(new_pos,
+                                                    mode="drop")
+            return st
+
+        def retransmit_one(st, mask):
+            """First non-SACKed rtx entry (head fallback), re-stamped
+            and re-emitted with the current scoreboard attached."""
+            now = st["now"]
+            rows = rtx_rows(st)
+            ar = jnp.arange(RT)[None, :]
+            cand = rows["valid"] & (rows["rtx_sacked"] == 0)
+            first = jnp.where(cand.any(axis=1),
+                              jnp.argmax(cand, axis=1), 0)
+            has = mask & rows["valid"].any(axis=1)
+            sel = first
+            seq = jnp.take_along_axis(rows["rtx_seq"], sel[:, None],
+                                      axis=1)[:, 0]
+            plen = jnp.take_along_axis(rows["rtx_plen"], sel[:, None],
+                                       axis=1)[:, 0]
+            slot = jnp.take_along_axis(rows["idx"], sel[:, None],
+                                       axis=1)[:, 0]
+            r = crows(st, has)
+            st = dict(st)
+            st["rtx_sent"] = st["rtx_sent"].at[r, slot].set(
+                now, mode="drop")
+            st["rtx_rtxed"] = st["rtx_rtxed"].at[r, slot].set(
+                1, mode="drop")
+            st["c_rtxcount"] = st["c_rtxcount"].at[r].add(
+                1, mode="drop")
+            del ar
+            return emit(st, has, seq, s_i64(plen), F_ACK | F_PSH,
+                        with_sacks=True, track=False)
+
+        def op_tcpin(st, mask):
+            """on_packet minus the push_data / reassembly-drain loops
+            (those continue as C_PUSH / C_DRAIN)."""
+            now = st["now"]
+            pk = {kk: st[f"ar_{kk}"] for kk in PK_KEYS}
+            plen = s_i64(pk["plen"])
+            st = cset(st, mask,
+                      c_segsrecv=cg(st, "c_segsrecv")
+                      + jnp.where(mask, 1, 0))
+            # in-domain wire: synchronized-state segments only
+            bad = mask & (((pk["tflags"] & (F_SYN | F_FIN | F_RST))
+                           != 0) | ((pk["tflags"] & F_ACK) == 0))
+            # a data segment arriving at a sender (or acking unsent
+            # data) leaves the modelled tgen roles
+            bad |= mask & (plen > 0) & (cg(st, "c_role") == 1)
+            bad |= mask & s_lt(cg(st, "c_sndnxt"), pk["tack"])
+            st = mark_abort(st, bad.any(), AB_STRUCT, 7)
+            st = dict(st)
+            # RFC 7323 ts_recent update (covering the ack point)
+            span = jnp.maximum(plen, 1)
+            upd = mask & (pk["tsv"] != 0) \
+                & s_leq(pk["tseq"], cg(st, "c_rcvnxt")) \
+                & s_lt(cg(st, "c_rcvnxt"), s_add(pk["tseq"], span))
+            st = cset(st, upd, c_tsrecent=jnp.where(upd, pk["tsv"],
+                                                    cg(st,
+                                                       "c_tsrecent")))
+            # RTTM: sample only from a segment acking NEW data
+            samp = mask & (pk["tse"] != 0) \
+                & (cg(st, "c_rtobackoff") == 0) \
+                & s_lt(cg(st, "c_snduna"), pk["tack"]) \
+                & s_leq(pk["tack"], cg(st, "c_sndnxt"))
+            st = update_rtt(st, samp, now - (pk["tse"] - 1))
+            # ---- on_ack ----
+            ack = pk["tack"]
+            wnd = pk["twin"] << cg(st, "c_peerws")
+            wchanged = wnd != cg(st, "c_sndwnd")
+            st = cset(st, mask, c_sndwnd=jnp.where(
+                mask, wnd, cg(st, "c_sndwnd")))
+            open_persist = mask & (wnd > 0) \
+                & (cg(st, "c_persistdl") >= 0)
+            st = cset(st, open_persist,
+                      c_persistdl=jnp.int64(-1),
+                      c_persistiv=jnp.int64(0))
+            # SACK scoreboard marks
+            have_sack = mask & (pk["nsk"] > 0)
+            rows = rtx_rows(st)
+            end = s_add(rows["rtx_seq"], rows["rtx_plen"])
+            cov = jnp.zeros((H, RT), bool)
+            for b in range(3):
+                bs = pk[f"sk{b}s"][:, None]
+                be = pk[f"sk{b}e"][:, None]
+                bv = (pk["nsk"] > b)[:, None]
+                cov |= bv & s_leq(bs, rows["rtx_seq"]) \
+                    & s_leq(end, be)
+            newly = have_sack[:, None] & rows["valid"] \
+                & (rows["rtx_sacked"] == 0) & cov
+            rows["rtx_sacked"] = jnp.where(newly, 1,
+                                           rows["rtx_sacked"])
+            st = rtx_scatter(st, have_sack, rows, ("rtx_sacked",))
+            st = cset(st, have_sack,
+                      c_sackskip=cg(st, "c_sackskip")
+                      + newly.sum(axis=1))
+            # new ack / dupack
+            rtx_nonempty = (st["rtx_len"][jnp.clip(st["cur"], 0,
+                                                   CC - 1)]
+                            > st["rtx_pos"][jnp.clip(st["cur"], 0,
+                                                     CC - 1)])
+            new_ack = mask & s_lt(cg(st, "c_snduna"), ack)
+            pure = (plen == 0)
+            dup = mask & ~new_ack & (ack == cg(st, "c_snduna")) \
+                & rtx_nonempty & pure & ~wchanged
+            # handle_new_ack
+            acked = s_sub(ack, cg(st, "c_snduna"))
+            st = cset(st, new_ack,
+                      c_snduna=jnp.where(new_ack, ack,
+                                         cg(st, "c_snduna")),
+                      c_dupacks=jnp.int32(0),
+                      c_rtobackoff=jnp.int32(0))
+            st = clear_acked(st, new_ack)
+            has_srtt = new_ack & (cg(st, "c_srtt") > 0)
+            rto2 = jnp.clip(cg(st, "c_srtt")
+                            + jnp.maximum(4 * cg(st, "c_rttvar"),
+                                          jnp.int64(1_000_000)),
+                            MIN_RTO_NS, MAX_RTO_NS)
+            st = cset(st, has_srtt, c_rto=rto2)
+            in_rec = new_ack & (cg(st, "c_fastrec") == 1)
+            rec_exit = in_rec & (s_lt(cg(st, "c_recover"), ack)
+                                 | (ack == cg(st, "c_recover")))
+            st = cset(st, rec_exit, c_fastrec=jnp.int32(0),
+                      c_cwnd=cg(st, "c_ssthresh"))
+            partial = in_rec & ~rec_exit
+            st = retransmit_one(st, partial)
+            # reno on_new_ack (not in recovery)
+            plain = new_ack & ~in_rec
+            mss_c = s_i64(cg(st, "c_congmss"))
+            cwnd = cg(st, "c_cwnd")
+            ss = plain & (cwnd < cg(st, "c_ssthresh"))
+            cwnd2 = jnp.where(ss, cwnd + jnp.minimum(acked, 2 * mss_c),
+                              cwnd + jnp.maximum(jnp.int64(1),
+                                                 mss_c * mss_c
+                                                 // jnp.maximum(cwnd,
+                                                                1)))
+            st = cset(st, plain, c_cwnd=jnp.where(plain, cwnd2, cwnd))
+            # RTO restart
+            rtx_ne2 = (st["rtx_len"][jnp.clip(st["cur"], 0, CC - 1)]
+                       > st["rtx_pos"][jnp.clip(st["cur"], 0,
+                                                CC - 1)])
+            st = cset(st, new_ack,
+                      c_rtodl=jnp.where(rtx_ne2, now + cg(st, "c_rto"),
+                                        jnp.int64(-1)))
+            # handle_dupack
+            st = cset(st, dup, c_dupacks=cg(st, "c_dupacks")
+                      + jnp.where(dup, 1, 0))
+            d_rec = dup & (cg(st, "c_fastrec") == 1)
+            st = cset(st, d_rec, c_cwnd=cg(st, "c_cwnd")
+                      + s_i64(cg(st, "c_congmss")))
+            d_thr = dup & ~d_rec & (cg(st, "c_dupacks") == 3)
+            flight = s_sub(cg(st, "c_sndnxt"), cg(st, "c_snduna"))
+            st = cset(st, d_thr,
+                      c_ssthresh=jnp.maximum(flight // 2,
+                                             2 * s_i64(
+                                                 cg(st, "c_congmss"))),
+                      c_fastrec=jnp.int32(1),
+                      c_recover=cg(st, "c_sndnxt"))
+            st = cset(st, d_thr, c_cwnd=cg(st, "c_ssthresh")
+                      + 3 * s_i64(cg(st, "c_congmss")))
+            st = retransmit_one(st, d_thr)
+            # ---- on_data (receiver side; plen > 0) ----
+            data = mask & (plen > 0)
+            offset = s_sub(cg(st, "c_rcvnxt"), pk["tseq"])
+            dup_data = data & (offset >= plen)
+            st = emit_ack(st, dup_data)
+            live = data & ~dup_data
+            eff_seq = jnp.where(offset > 0, cg(st, "c_rcvnxt"),
+                                pk["tseq"])
+            eff_len = jnp.where(offset > 0, plen - offset, plen)
+            future = live & (s_sub(eff_seq, cg(st, "c_rcvnxt")) != 0)
+            # reassembly setdefault (bounded by the receive buffer)
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            rav = st["ra_valid"][cur]
+            ras = st["ra_seq"][cur]
+            exists = (rav & (ras == eff_seq[:, None])).any(axis=1)
+            store_it = future \
+                & (s_sub(eff_seq, cg(st, "c_rcvnxt"))
+                   < cg(st, "c_rbmax")) & ~exists
+            free = jnp.argmin(rav, axis=1)
+            ra_over = store_it & rav.all(axis=1)
+            st = mark_abort(st, ra_over.any(), AB_STRUCT, 8)
+            st = dict(st)
+            rrows = crows(st, store_it & ~ra_over)
+            st["ra_seq"] = st["ra_seq"].at[rrows, free].set(
+                eff_seq, mode="drop")
+            st["ra_plen"] = st["ra_plen"].at[rrows, free].set(
+                eff_len.astype(jnp.int32), mode="drop")
+            st["ra_valid"] = st["ra_valid"].at[rrows, free].set(
+                True, mode="drop")
+            st = emit_ack(st, future)
+            # in-order delivery
+            inord = live & ~future
+            had_holes = rav.any(axis=1)
+            st = dict(st)
+            st["had_holes"] = jnp.where(inord,
+                                        had_holes.astype(jnp.int32),
+                                        st["had_holes"])
+            space = cg(st, "c_rbmax") - cg(st, "c_rblen")
+            take = jnp.minimum(space, eff_len)
+            take = jnp.maximum(take, 0)
+            st = cset(st, inord,
+                      c_rblen=cg(st, "c_rblen")
+                      + jnp.where(inord, take, 0),
+                      c_rcvnxt=jnp.where(
+                          inord, s_add(cg(st, "c_rcvnxt"), take),
+                          cg(st, "c_rcvnxt")))
+            # ---- continuation ----
+            st = dict(st)
+            nxt = jnp.where(
+                inord, C_DRAIN,
+                jnp.where(data, C_FLUSH, C_PUSH))
+            st["cont"] = jnp.where(mask, nxt, st["cont"])
+            return st
+
+        def op_drain(st, mask):
+            """One reassembly chunk per micro-op (connection.py's
+            while-rcv_nxt-in-reassembly loop)."""
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            rav = st["ra_valid"][cur]
+            ras = st["ra_seq"][cur]
+            rap = st["ra_plen"][cur]
+            match = rav & (ras == cg(st, "c_rcvnxt")[:, None])
+            has = mask & match.any(axis=1)
+            slot = jnp.argmax(match, axis=1)
+            plen = jnp.take_along_axis(rap, slot[:, None],
+                                       axis=1)[:, 0]
+            space = cg(st, "c_rbmax") - cg(st, "c_rblen")
+            take = jnp.clip(jnp.minimum(space, s_i64(plen)), 0, None)
+            st = cset(st, has,
+                      c_rblen=cg(st, "c_rblen")
+                      + jnp.where(has, take, 0),
+                      c_rcvnxt=jnp.where(
+                          has, s_add(cg(st, "c_rcvnxt"), take),
+                          cg(st, "c_rcvnxt")))
+            st = dict(st)
+            rr = crows(st, has)
+            st["ra_valid"] = st["ra_valid"].at[rr, slot].set(
+                False, mode="drop")
+            st["cont"] = jnp.where(mask & ~has, C_ACKDATA,
+                                   st["cont"])
+            return st
+
+        def op_ackdata(st, mask):
+            """ack_data: every second in-order segment acks now; holes
+            or a pinched window force it; else the 40ms delack."""
+            now = st["now"]
+            st = cset(st, mask, c_ssa=cg(st, "c_ssa")
+                      + jnp.where(mask, 1, 0))
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            fire = mask & ((st["had_holes"] == 1)
+                           | (cg(st, "c_ssa") >= 2)
+                           | st["ra_valid"][cur].any(axis=1)
+                           | (recv_window(st)
+                              < s_i64(cg(st, "c_effmss"))))
+            st = emit_ack(st, fire)
+            arm = mask & ~fire & (cg(st, "c_delackdl") < 0)
+            st = cset(st, arm, c_delackdl=now + DELACK_NS)
+            st = dict(st)
+            st["had_holes"] = jnp.where(mask, 0, st["had_holes"])
+            st["cont"] = jnp.where(mask, C_FLUSH, st["cont"])
+            return st
+
+        def op_push(st, mask):
+            """push_data: one eff_mss segment per micro-op within
+            min(cwnd, peer window); Nagle holds a sub-MSS tail."""
+            now = st["now"]
+            window = jnp.minimum(cg(st, "c_cwnd"), cg(st, "c_sndwnd"))
+            flight = s_sub(cg(st, "c_sndnxt"), cg(st, "c_snduna"))
+            can = mask & (cg(st, "c_sblen") > 0) & (flight < window)
+            budget = jnp.minimum(window - flight,
+                                 s_i64(cg(st, "c_effmss")))
+            nagle_hold = can & (cg(st, "c_nodelay") == 0) \
+                & (cg(st, "c_sblen") < budget) & (flight > 0)
+            chunk = jnp.minimum(cg(st, "c_sblen"), budget)
+            do = can & ~nagle_hold & (chunk > 0)
+            st = emit(st, do, cg(st, "c_sndnxt"), chunk,
+                      F_ACK | F_PSH, with_sacks=False, track=True)
+            st = cset(st, do,
+                      c_sblen=cg(st, "c_sblen")
+                      - jnp.where(do, chunk, 0),
+                      c_sndnxt=jnp.where(
+                          do, s_add(cg(st, "c_sndnxt"), chunk),
+                          cg(st, "c_sndnxt")))
+            stop = mask & ~do
+            # zero-window persist arming
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            rtx_empty = ~(st["rtx_len"][cur] > st["rtx_pos"][cur])
+            parm = stop & (cg(st, "c_sndwnd") == 0) \
+                & (cg(st, "c_sblen") > 0) & rtx_empty \
+                & (cg(st, "c_persistdl") < 0)
+            st = cset(st, parm, c_persistiv=cg(st, "c_rto"),
+                      c_persistdl=now + cg(st, "c_rto"))
+            st = dict(st)
+            st["cont"] = jnp.where(stop, C_FLUSH, st["cont"])
+            return st
+
+        def op_flush(st, mask):
+            """tcp_flush's notify: register the socket with the iface
+            qdisc and kick the inet-out relay if it is idle."""
+            need = mask & (st["eflag"] == 1) \
+                & (cg(st, "c_queued") == 0)
+            st = cset(st, need, c_queued=jnp.int32(1))
+            st = dict(st)
+            st["eflag"] = jnp.where(mask, 0, st["eflag"])
+            kick = need & (st["r1_pending"] == 0)
+            st["cont"] = jnp.where(mask, C_ARM, st["cont"])
+            st["cont"] = jnp.where(kick, C_R1, st["cont"])
+            st["then"] = jnp.where(kick, C_ARM, st["then"])
+            return st
+
+        def op_arm(st, mask):
+            """tcp_arm_timer + tcp_update_status (+ the deferred
+            sendto-EAGAIN park)."""
+            now = st["now"]
+            dls = [cg(st, "c_rtodl"), cg(st, "c_delackdl"),
+                   cg(st, "c_persistdl")]
+            nxt = jnp.full(H, I64_MAX, jnp.int64)
+            for d in dls:
+                nxt = jnp.where((d >= 0) & (d < nxt), d, nxt)
+            have = nxt < I64_MAX
+            arm = mask & have & (nxt != cg(st, "c_tmrdl"))
+            st = cset(st, arm, c_tmrdl=jnp.where(arm, nxt,
+                                                 cg(st, "c_tmrdl")))
+            st, sq = draw_seq(st, arm)
+            st = th_push(st, arm, nxt, sq,
+                         jnp.full(H, TK_TCP, jnp.int32), st["cur"])
+            # update_status (ESTABLISHED lanes only in-domain)
+            readable = cg(st, "c_rblen") > 0
+            space = (cg(st, "c_sbmax") - cg(st, "c_sblen")) > 0
+            old = cg(st, "c_status")
+            set_bits = jnp.where(readable, jnp.uint32(S_READABLE),
+                                 jnp.uint32(0)) \
+                | jnp.where(space, jnp.uint32(S_WRITABLE),
+                            jnp.uint32(0))
+            clear_bits = jnp.where(~readable, jnp.uint32(S_READABLE),
+                                   jnp.uint32(0)) & ~set_bits
+            new = (old | set_bits) & ~clear_bits
+            changed = jnp.where(mask, old ^ new, jnp.uint32(0))
+            st = cset(st, mask, c_status=jnp.where(mask, new, old))
+            wake = mask & ((changed & cg(st, "c_await")) != 0) \
+                & (cg(st, "c_wakep") == 0)
+            st, sq = draw_seq(st, wake)
+            st = th_push(st, wake, now, sq,
+                         jnp.full(H, TK_APP, jnp.int32), st["cur"])
+            st = cset(st, wake, c_wakep=jnp.int32(1))
+            # deferred sendto-EAGAIN: clear WRITABLE, park the stepper
+            park = mask & (st["parkp"] == 1)
+            st = cset(st, park,
+                      c_status=cg(st, "c_status")
+                      & ~jnp.uint32(S_WRITABLE),
+                      c_await=jnp.uint32(S_WRITABLE),
+                      c_awaitseq=st["park_ctr"])
+            st = dict(st)
+            st["park_ctr"] = jnp.where(park, st["park_ctr"] + 1,
+                                       st["park_ctr"])
+            st["parkp"] = jnp.where(park, 0, st["parkp"])
+            st["cont"] = jnp.where(mask, jnp.where(park, C_IDLE,
+                                                   st["ret"]),
+                                   st["cont"])
+            return st
+
+        # -------- app steppers / timers ------------------------------
+
+        def max_mem(bw, rtt, base):
+            mem = bw * rtt // np.int64(8 * 1_000_000_000)
+            return jnp.clip(mem, base, 10 * base)
+
+        def op_app(st, mask):
+            """One tcp_recv (client) / tcp_sendto (handler) per
+            micro-op — the engine app loop with syscalls counted at
+            the same points."""
+            now = st["now"]
+            client = mask & (cg(st, "c_role") == 0)
+            handler = mask & (cg(st, "c_role") == 1)
+            st = dict(st)
+            st["app_sys"] = st["app_sys"].at[:, ASYS_RECV].add(
+                jnp.where(client, 1, 0))
+            st["app_sys"] = st["app_sys"].at[:, ASYS_SEND].add(
+                jnp.where(handler, 1, 0))
+            # ---- client: recv 64 KiB or park ----
+            empty = client & (cg(st, "c_rblen") == 0)
+            st = cset(st, empty, c_await=jnp.uint32(S_READABLE),
+                      c_awaitseq=st["park_ctr"])
+            st["park_ctr"] = jnp.where(empty, st["park_ctr"] + 1,
+                                       st["park_ctr"])
+            st["cont"] = jnp.where(empty, C_IDLE, st["cont"])
+            got = client & ~empty
+            take = jnp.minimum(cg(st, "c_rblen"),
+                               jnp.int64(1 << 16))
+            win_before = recv_window(st)
+            st = cset(st, got, c_rblen=cg(st, "c_rblen")
+                      - jnp.where(got, take, 0))
+            winupd = got & (win_before < MSS) \
+                & (recv_window(st) >= MSS)
+            st = emit_ack(st, winupd)
+            # autotune_recv (socket_tcp.py twin)
+            at = got & (cg(st, "c_rat") == 1)
+            copied = cg(st, "c_atcopied") + jnp.where(at, take, 0)
+            space2 = 2 * copied
+            at_space = jnp.maximum(cg(st, "c_atspace"), space2)
+            grow = at & (at_space > cg(st, "c_rbmax"))
+            nw = jnp.minimum(at_space,
+                             max_mem(st["bw_down"], cg(st, "c_srtt"),
+                                     np.int64(RMEM_MAX)))
+            st = cset(st, at, c_atcopied=copied, c_atspace=at_space)
+            st = cset(st, grow & (nw > cg(st, "c_rbmax")),
+                      c_rbmax=nw)
+            fresh = at & (cg(st, "c_atlast") == 0)
+            st = cset(st, fresh, c_atlast=now)
+            roll = at & ~fresh & (cg(st, "c_srtt") > 0) \
+                & (now - cg(st, "c_atlast") > cg(st, "c_srtt"))
+            st = cset(st, roll, c_atlast=now,
+                      c_atcopied=jnp.int64(0))
+            ngot = cg(st, "c_agot") + jnp.where(got, take, 0)
+            st = cset(st, got, c_agot=ngot)
+            # transfer completion leaves the modelled domain (close)
+            st = mark_abort(st, (got & (ngot >= cg(st, "c_atotal"))
+                                 ).any(), AB_STRUCT, 9)
+            st = dict(st)
+            st["ret"] = jnp.where(got, C_APP, st["ret"])
+            st["cont"] = jnp.where(got, C_FLUSH, st["cont"])
+            # ---- handler: send up to 64 KiB or park ----
+            want = jnp.minimum(jnp.int64(1 << 16),
+                               cg(st, "c_atotal") - cg(st, "c_agot"))
+            space = cg(st, "c_sbmax") - cg(st, "c_sblen")
+            w = jnp.clip(jnp.minimum(want, space), 0, None)
+            blocked = handler & (w == 0)
+            st = dict(st)
+            st["parkp"] = jnp.where(blocked, 1, st["parkp"])
+            st["ret"] = jnp.where(handler, C_APP, st["ret"])
+            st["cont"] = jnp.where(blocked, C_FLUSH, st["cont"])
+            wrote = handler & ~blocked
+            nsent = cg(st, "c_agot") + jnp.where(wrote, w, 0)
+            st = cset(st, wrote,
+                      c_sblen=cg(st, "c_sblen")
+                      + jnp.where(wrote, w, 0),
+                      c_agot=nsent)
+            # send completion -> shutdown_wr: out of the domain
+            st = mark_abort(st, (wrote & (nsent >= cg(st, "c_atotal"))
+                                 ).any(), AB_STRUCT, 10)
+            st = dict(st)
+            st["cont"] = jnp.where(wrote, C_PUSH, st["cont"])
+            return st
+
+        def op_tmr(st, mask):
+            """TK_TCP fire: tcp_on_timer — stale entries re-arm; due
+            deadlines run delack/persist/RTO in the engine's fixed
+            order, then the flush chain."""
+            now = st["now"]
+            st = cset(st, mask, c_tmrdl=jnp.int64(-1))
+            dls = [cg(st, "c_rtodl"), cg(st, "c_delackdl"),
+                   cg(st, "c_persistdl")]
+            nxt = jnp.full(H, I64_MAX, jnp.int64)
+            for d in dls:
+                nxt = jnp.where((d >= 0) & (d < nxt), d, nxt)
+            have = nxt < I64_MAX
+            fire = mask & have & (now >= nxt)
+            stale = mask & ~fire
+            rearm = stale & have
+            st = cset(st, rearm, c_tmrdl=jnp.where(rearm, nxt,
+                                                   jnp.int64(-1)))
+            st, sq = draw_seq(st, rearm)
+            st = th_push(st, rearm, nxt, sq,
+                         jnp.full(H, TK_TCP, jnp.int32), st["cur"])
+            st = dict(st)
+            st["cont"] = jnp.where(stale, C_IDLE, st["cont"])
+            # ---- on_timer (fire lanes) ----
+            d_f = fire & (cg(st, "c_delackdl") >= 0) \
+                & (now >= cg(st, "c_delackdl"))
+            st = emit_ack(st, d_f)
+            p_f = fire & (cg(st, "c_persistdl") >= 0) \
+                & (now >= cg(st, "c_persistdl"))
+            st = cset(st, p_f, c_persistdl=jnp.int64(-1))
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            rtx_ne = st["rtx_len"][cur] > st["rtx_pos"][cur]
+            probe = p_f & (cg(st, "c_sndwnd") == 0) \
+                & (cg(st, "c_sblen") > 0) & ~rtx_ne
+            st = emit(st, probe, cg(st, "c_sndnxt"),
+                      jnp.ones(H, jnp.int64), F_ACK | F_PSH,
+                      with_sacks=False, track=True)
+            st = cset(st, probe,
+                      c_sblen=cg(st, "c_sblen")
+                      - jnp.where(probe, 1, 0),
+                      c_sndnxt=jnp.where(
+                          probe, s_add(cg(st, "c_sndnxt"),
+                                       jnp.int64(1)),
+                          cg(st, "c_sndnxt")))
+            niv = jnp.minimum(
+                jnp.where(cg(st, "c_persistiv") > 0,
+                          2 * cg(st, "c_persistiv"),
+                          cg(st, "c_rto")), MAX_RTO_NS)
+            st = cset(st, probe, c_persistiv=niv,
+                      c_persistdl=now + niv)
+            # RTO
+            r_f = fire & (cg(st, "c_rtodl") >= 0) \
+                & (now >= cg(st, "c_rtodl"))
+            cur = jnp.clip(st["cur"], 0, CC - 1)
+            rtx_ne = st["rtx_len"][cur] > st["rtx_pos"][cur]
+            r_empty = r_f & ~rtx_ne
+            st = cset(st, r_empty, c_rtodl=jnp.int64(-1))
+            r_go = r_f & rtx_ne
+            flight = s_sub(cg(st, "c_sndnxt"), cg(st, "c_snduna"))
+            st = cset(st, r_go,
+                      c_ssthresh=jnp.maximum(
+                          flight // 2,
+                          2 * s_i64(cg(st, "c_congmss"))),
+                      c_cwnd=s_i64(cg(st, "c_congmss")),
+                      c_dupacks=jnp.int32(0),
+                      c_fastrec=jnp.int32(0))
+            # SACK reneging: forget every mark on RTO
+            rows = rtx_rows(st)
+            rows["rtx_sacked"] = jnp.where(
+                r_go[:, None], 0, rows["rtx_sacked"])
+            st = rtx_scatter(st, r_go, rows, ("rtx_sacked",))
+            st = cset(st, r_go,
+                      c_rto=jnp.minimum(2 * cg(st, "c_rto"),
+                                        MAX_RTO_NS),
+                      c_rtobackoff=cg(st, "c_rtobackoff") + 1)
+            st = retransmit_one(st, r_go)
+            st = cset(st, r_go, c_rtodl=now + cg(st, "c_rto"))
+            st = dict(st)
+            st["ret"] = jnp.where(fire, C_IDLE, st["ret"])
+            st["cont"] = jnp.where(fire, C_FLUSH, st["cont"])
+            return st
+
+        # -------- event pop ------------------------------------------
+
+        def next_event_time(st):
+            pos = st["ib_pos"]
+            safe = jnp.minimum(pos, I - 1)
+            ib_t = jnp.where(st["ib_len"] > pos,
+                             st["ib_time"][hidx, safe], I64_MAX)
+            th_t = jnp.where(st["th_valid"], st["th_time"],
+                             I64_MAX).min(axis=1)
+            return ib_t, th_t
+
+        def op_pop_event(st, mask, window_end):
+            pos = st["ib_pos"]
+            safe = jnp.minimum(pos, I - 1)
+            ib_t, _ = next_event_time(st)
+            tmin, tkind, ttgt, tslot = th_min(st)
+            pick_ib = jnp.where(ib_t != tmin, ib_t < tmin,
+                                ib_t < I64_MAX)
+            et = jnp.minimum(ib_t, tmin)
+            due = mask & (et < window_end)
+            st = dict(st)
+            st["now"] = jnp.where(due, et, st["now"])
+            st["events_run"] = jnp.where(due, st["events_run"] + 1,
+                                         st["events_run"])
+            # arrival: inbox -> codel -> relay 2
+            arr = due & pick_ib
+            st["ib_pos"] = jnp.where(arr, pos + 1, pos)
+            pk_arr = {kk: st[f"ib_{kk}"][hidx, safe]
+                      for kk in PK_KEYS}
+            size = s_i64(pk_arr["plen"]) + TCP_TOTAL_HDR
+            limit_full = arr & (st["cq_len"] - st["cq_pos"]
+                                >= CODEL_HARD_LIMIT)
+            st["codel_dropped"] = jnp.where(
+                limit_full, st["codel_dropped"] + 1,
+                st["codel_dropped"])
+            st["pkts_dropped"] = jnp.where(
+                limit_full, st["pkts_dropped"] + 1,
+                st["pkts_dropped"])
+            st = tr_append(st, limit_full, et, TR_DRP, pk_arr,
+                           RSN_RTRLIMIT)
+            st = dict(st)
+            arr = arr & ~limit_full
+            st = mark_abort(st, (arr & (st["cq_len"] - st["cq_pos"]
+                                        >= CQ - 1)).any(), AB_STRUCT, 11)
+            st = dict(st)
+            tail = st["cq_len"] % CQ
+            rows = mrows(arr)
+            for kk in PK_KEYS:
+                st[f"cq_{kk}"] = st[f"cq_{kk}"].at[rows, tail].set(
+                    pk_arr[kk], mode="drop")
+            st["cq_enq"] = st["cq_enq"].at[rows, tail].set(
+                et, mode="drop")
+            st["cq_len"] = jnp.where(arr, st["cq_len"] + 1,
+                                     st["cq_len"])
+            st["codel_bytes"] = jnp.where(
+                arr, st["codel_bytes"] + size, st["codel_bytes"])
+            go2 = arr & (st["r2_pending"] == 0)
+            st["cont"] = jnp.where(go2, C_R2, st["cont"])
+            st["then"] = jnp.where(go2, C_IDLE, st["then"])
+
+            # timer
+            tim = due & ~pick_ib
+            st["th_valid"] = st["th_valid"].at[mrows(tim), tslot].set(
+                False, mode="drop")
+            is_relay = tim & (tkind == TK_RELAY)
+            for r in (1, 2):
+                rw = is_relay & (ttgt == r)
+                st[f"r{r}_pending"] = jnp.where(rw, 0,
+                                                st[f"r{r}_pending"])
+                st["cont"] = jnp.where(rw, C_R1 if r == 1 else C_R2,
+                                       st["cont"])
+                st["then"] = jnp.where(rw, C_IDLE, st["then"])
+            bad_tgt = tim & (tkind != TK_RELAY) & (ttgt < 0)
+            st = mark_abort(st, bad_tgt.any(), AB_STRUCT, 12)
+            st = dict(st)
+            is_tcp = tim & (tkind == TK_TCP) & (ttgt >= 0)
+            st["cur"] = jnp.where(is_tcp | (tim & (tkind == TK_APP)
+                                            & (ttgt >= 0)),
+                                  ttgt, st["cur"])
+            st["cont"] = jnp.where(is_tcp, C_TMR, st["cont"])
+            st["ret"] = jnp.where(is_tcp, C_IDLE, st["ret"])
+            is_app = tim & (tkind == TK_APP) & (ttgt >= 0)
+            st = cset(st, is_app, c_wakep=jnp.int32(0),
+                      c_await=jnp.uint32(0))
+            st = dict(st)
+            st["cont"] = jnp.where(is_app, C_APP, st["cont"])
+            st["ret"] = jnp.where(is_app, C_APP, st["ret"])
+            return st
+
+        # -------- per-iteration dispatcher ---------------------------
+
+        def micro_iter(carry):
+            st, window_end, iters = carry
+            cont0 = st["cont"]
+            st = op_relay1(st, cont0 == C_R1)
+            st = op_relay2(st, cont0 == C_R2)
+            st = op_tcpin(st, cont0 == C_TCPIN)
+            st = op_drain(st, cont0 == C_DRAIN)
+            st = op_ackdata(st, cont0 == C_ACKDATA)
+            st = op_push(st, cont0 == C_PUSH)
+            st = op_flush(st, cont0 == C_FLUSH)
+            st = op_arm(st, cont0 == C_ARM)
+            st = op_app(st, cont0 == C_APP)
+            st = op_tmr(st, cont0 == C_TMR)
+            st = op_pop_event(st, cont0 == C_IDLE, window_end)
+            # Per-round runaway valve: a legitimate hot round is a few
+            # thousand micro-iterations; a continuation-cycle bug must
+            # abort in minutes, not hours (each iteration is a full
+            # vectorized body on the CPU backend).
+            st = mark_abort(st, iters > (np.int64(1) << 17), AB_STRUCT,
+                            13)
+            return st, window_end, iters + 1
+
+        def micro_cond(carry):
+            st, window_end, iters = carry
+            ib_t, th_t = next_event_time(st)
+            due = jnp.minimum(ib_t, th_t) < window_end
+            busy = st["cont"] != C_IDLE
+            return (busy | due).any() & (st["abort_code"] == 0)
+
+        # -------- round end: propagation + inbox merge ---------------
+
+        def propagate(st, window_end):
+            n = st["out_n"]
+            valid = jnp.arange(O) < n
+            src = st["out_src"]
+            dst = st["out_dst"]
+            node = st["_node"]
+            latency = st["_lat"][node[src], node[dst]]
+            reachable = latency < TIME_NEVER
+            bits, _ = threefry2x32_jax(
+                st["_k0"], st["_k1"], src.astype(jnp.uint32),
+                (st["out_pseq"] & 0xFFFFFFFF).astype(jnp.uint32))
+            thr_v = st["_thr"][node[src], node[dst]]
+            # pure acks are empty-control packets: never lossy
+            lossy = ((bits.astype(jnp.int64) < thr_v)
+                     & (st["out_plen"] > 0)
+                     & (st["out_t"] >= st["_bootstrap"]))
+            deliver = jnp.maximum(st["out_t"] + latency, window_end)
+            keep = valid & reachable & ~lossy
+            min_lat = jnp.min(jnp.where(keep, latency, I64_MAX))
+            st = dict(st)
+            for miss, rsn in ((valid & ~reachable, RSN_UNREACH),
+                              (valid & reachable & lossy, RSN_LOSS)):
+                st["pkts_dropped"] = st["pkts_dropped"].at[
+                    jnp.where(miss, src, OOB)].add(1, mode="drop")
+                if tracing:
+                    nt_ = st["tr_n"]
+                    rank = jnp.cumsum(miss) - 1
+                    slot = jnp.where(miss, nt_ + rank, TR + 8)
+                    cols = (("tr_t", st["out_t"]),
+                            ("tr_kind", jnp.full(O, TR_DRP,
+                                                 jnp.int32)),
+                            ("tr_srchost", st["out_srchost"]),
+                            ("tr_pseq", st["out_pseq"]),
+                            ("tr_sip", st["out_sip"]),
+                            ("tr_sport", st["out_sport"]),
+                            ("tr_dip", st["out_dip"]),
+                            ("tr_dport", st["out_dport"]),
+                            ("tr_plen", st["out_plen"]),
+                            ("tr_reason", jnp.full(O, rsn,
+                                                   jnp.int32)),
+                            ("tr_owner", src))
+                    for key, v in cols:
+                        st[key] = st[key].at[slot].set(v, mode="drop")
+                    tot = nt_ + miss.sum()
+                    st["tr_n"] = tot
+                    st = mark_abort(st, tot > TR - O, AB_TRACE)
+                    st = dict(st)
+
+            rem = (st["ib_len"] - st["ib_pos"]).astype(jnp.int32)
+            shift = jnp.minimum(
+                st["ib_pos"][:, None] + jnp.arange(I)[None, :], I - 1)
+            live = jnp.arange(I)[None, :] < rem[:, None]
+
+            def compact(a, fill):
+                return jnp.where(live,
+                                 jnp.take_along_axis(a, shift, axis=1),
+                                 fill)
+
+            ib_time = compact(st["ib_time"], I64_MAX)
+            ib_src = compact(st["ib_src"], 0)
+            ib_seq = compact(st["ib_seq"], I64_MAX)
+            ib_pk = {kk: compact(st[f"ib_{kk}"],
+                                 np.zeros((), PK_DTYPES[kk]))
+                     for kk in PK_KEYS}
+            seg = jnp.where(keep, dst, H)
+            order = jnp.argsort(seg.astype(jnp.int64) * (O + 1)
+                                + jnp.arange(O))
+            sseg = seg[order]
+            rank0 = jnp.arange(O) - jnp.searchsorted(sseg, sseg,
+                                                     side="left")
+            rank = jnp.zeros(O, jnp.int32).at[order].set(
+                rank0.astype(jnp.int32))
+            slot = rem[jnp.minimum(seg, H - 1)] + rank
+            ok_slot = keep & (slot < I - 1)
+            st = mark_abort(st, (keep & (slot >= I - 1)).any(),
+                            AB_STRUCT, 14)
+            st = dict(st)
+            rows = jnp.where(ok_slot, dst, OOB)
+            ib_time = ib_time.at[rows, slot].set(deliver, mode="drop")
+            ib_src = ib_src.at[rows, slot].set(src, mode="drop")
+            ib_seq = ib_seq.at[rows, slot].set(st["out_seq"],
+                                               mode="drop")
+            for kk in PK_KEYS:
+                ib_pk[kk] = ib_pk[kk].at[rows, slot].set(
+                    st[f"out_{kk}"], mode="drop")
+            add = jnp.zeros(H, jnp.int32).at[rows].add(1, mode="drop")
+            sort_idx = jnp.lexsort((ib_seq, ib_src, ib_time), axis=1)
+            take = jnp.take_along_axis
+            st["ib_time"] = take(ib_time, sort_idx, axis=1)
+            st["ib_src"] = take(ib_src, sort_idx, axis=1)
+            st["ib_seq"] = take(ib_seq, sort_idx, axis=1)
+            for kk in PK_KEYS:
+                st[f"ib_{kk}"] = take(ib_pk[kk], sort_idx, axis=1)
+            st["ib_pos"] = jnp.zeros(H, jnp.int32)
+            st["ib_len"] = rem + add
+            st["out_n"] = jnp.int64(0)
+            return st, n, min_lat
+
+        # -------- the multi-round while loop -------------------------
+
+        def round_cond(carry):
+            (st, start, runahead, rounds, busy_rounds, packets,
+             busy_end, stop, limit, max_rounds) = carry
+            return ((rounds < max_rounds) & (start < limit)
+                    & (start < stop) & (st["abort_code"] == 0))
+
+        def round_body(carry):
+            (st, start, runahead, rounds, busy_rounds, packets,
+             busy_end, stop, limit, max_rounds) = carry
+            window_end = jnp.minimum(start + runahead, stop)
+            st, _we, _it = jax.lax.while_loop(
+                micro_cond, micro_iter,
+                (st, window_end, jnp.int64(0)))
+            st, n_out, min_lat = propagate(st, window_end)
+            runahead = jnp.where(
+                (min_lat > 0) & (min_lat < runahead), min_lat,
+                runahead)
+            ib_t, th_t = next_event_time(st)
+            start = jnp.minimum(ib_t, th_t).min()
+            return (st, start, runahead, rounds + 1,
+                    busy_rounds + (n_out > 0).astype(jnp.int64),
+                    packets + n_out, window_end, stop, limit,
+                    max_rounds)
+
+        @jax.jit
+        def run(st, lat, thr, node, ips_sorted, ips_perm, k0, k1,
+                bootstrap_end, start, stop, limit, runahead,
+                max_rounds):
+            st = dict(st)
+            st["_lat"] = lat
+            st["_thr"] = thr
+            st["_node"] = node
+            st["_ips_sorted"] = ips_sorted
+            st["_ips_perm"] = ips_perm
+            st["_k0"] = k0
+            st["_k1"] = k1
+            st["_bootstrap"] = bootstrap_end
+            st["abort_code"] = jnp.int32(0)
+            st["abort_site"] = jnp.int32(0)
+            st["cd_chain"] = jnp.zeros(H, jnp.int32)
+            st["cd_sniff"] = jnp.zeros(H, jnp.int32)
+            # conn lookup keys: (host, peer-ip-index, peer-port)
+            pslot = jnp.minimum(
+                jnp.searchsorted(ips_sorted, st["c_pip"]), H - 1)
+            pidx = ips_perm[pslot].astype(jnp.int64)
+            ckey = (st["c_host"].astype(jnp.int64) * H + pidx) \
+                * 65536 + st["c_pport"].astype(jnp.int64)
+            ckey = jnp.where(st["c_host"] >= 0, ckey,
+                             I64_MAX - jnp.arange(CC))
+            order = jnp.argsort(ckey)
+            st["_ckeys"] = ckey[order]
+            st["_ckperm"] = order.astype(jnp.int32)
+            st["out_n"] = jnp.int64(0)
+            st["out_src"] = jnp.zeros(O, jnp.int32)
+            st["out_dst"] = jnp.zeros(O, jnp.int32)
+            st["out_seq"] = jnp.zeros(O, jnp.int64)
+            st["out_t"] = jnp.zeros(O, jnp.int64)
+            for kk in PK_KEYS:
+                st[f"out_{kk}"] = jnp.zeros(O, PK_DTYPES[kk])
+            if tracing:
+                st["tr_n"] = jnp.int64(0)
+                for k, dt in (("tr_t", jnp.int64),
+                              ("tr_kind", jnp.int32),
+                              ("tr_srchost", jnp.int32),
+                              ("tr_pseq", jnp.int64),
+                              ("tr_sip", jnp.uint32),
+                              ("tr_sport", jnp.int32),
+                              ("tr_dip", jnp.uint32),
+                              ("tr_dport", jnp.int32),
+                              ("tr_plen", jnp.int32),
+                              ("tr_reason", jnp.int32),
+                              ("tr_owner", jnp.int32)):
+                    st[k] = jnp.zeros(TR, dt)
+
+            carry = (st, jnp.int64(start), jnp.int64(runahead),
+                     jnp.int64(0), jnp.int64(0), jnp.int64(0),
+                     jnp.int64(start), jnp.int64(stop),
+                     jnp.int64(limit), jnp.int64(max_rounds))
+            (st, start, runahead, rounds, busy_rounds, packets,
+             busy_end, _s, _l, _m) = jax.lax.while_loop(
+                round_cond, round_body, carry)
+            # Only mutated columns go back over the device link.
+            drop = {"c_host", "c_role", "c_lip", "c_lport", "c_pip",
+                    "c_pport", "c_iss", "c_irs", "c_wsoff", "c_ourws",
+                    "c_peerws", "c_effmss", "c_nodelay", "c_congmss",
+                    "c_sat", "c_rat", "c_atotal", "c_at0", "c_axfer",
+                    "c_acount", "bw_up", "bw_down", "eth_ip",
+                    "cont", "then", "ret", "cur", "eflag", "parkp",
+                    "had_holes", "park_ctr", "cd_chain", "cd_sniff",
+                    "r1_refill", "r1_cap", "r1_unlimited",
+                    "r2_refill", "r2_cap", "r2_unlimited"}
+            drop |= {f"ar_{kk}" for kk in PK_KEYS}
+            st = {k: v for k, v in st.items()
+                  if not k.startswith("_") and k not in drop}
+            return (st, start, runahead, rounds, busy_rounds, packets,
+                    busy_end)
+
+        return run
+
+    # ------------------------------------------------------------------
+    # Driver
+    # ------------------------------------------------------------------
+
+    def try_span(self, start: int, stop: int, limit: int,
+                 runahead: int, dynamic: bool,
+                 max_rounds: int | None = None):
+        """Export -> device span -> import.  Returns (rounds,
+        busy_rounds, packets, next_start, busy_end, runahead) or None
+        when ineligible / transiently out of domain / aborted."""
+        self.last_transient = False
+        d = self.engine.span_export_tcp(*self._caps())
+        if d is None:
+            self.ineligible += 1
+            return None
+        if isinstance(d, int):
+            # transiently outside the steady-stream domain (handshake,
+            # close, over-caps): the router retries soon
+            self.over_caps += 1
+            self.last_transient = True
+            return None
+        st = self._to_arrays(d)  # also sets self._CC
+        n_conns = st.pop("_n_conns")
+        import os
+        import sys
+        import time as _time
+        dbg = os.environ.get("SHADOWTPU_TCPSPAN_DBG")
+        if dbg:
+            print(f"[tcp_span] export ok: {n_conns} conns, "
+                  f"CC={self._CC}, start={start}", file=sys.stderr,
+                  flush=True)
+            _t0 = _time.perf_counter()
+        self._fn = self._cached_build()
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            shard = NamedSharding(self.mesh, PartitionSpec("hosts"))
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            H = self._H
+            st = {k: jax.device_put(
+                      v, shard if (getattr(v, "ndim", 0) >= 1
+                                   and v.shape[0] == H) else repl)
+                  for k, v in st.items()}
+        # Clamp span length: the flat trace buffer accumulates across
+        # the whole span, and TCP rounds carry ~100x phold's traffic.
+        mr = self.MAX_ROUNDS if max_rounds is None \
+            else min(max_rounds, self.MAX_ROUNDS)
+        for _grow in range(4):
+            out = self._fn(
+                st, self._lat, self._thr, self._node,
+                self._ips_sorted, self._ips_perm,
+                np.uint32(self._k[0]), np.uint32(self._k[1]),
+                np.int64(self.bootstrap_end),
+                start, stop, limit, runahead, mr)
+            (st_out, next_start, ra, rounds, busy_rounds, packets,
+             busy_end) = out
+            st_np = {k: np.asarray(v) for k, v in st_out.items()}
+            code = int(st_np["abort_code"])
+            if dbg:
+                print(f"[tcp_span] span done in "
+                      f"{_time.perf_counter() - _t0:.1f}s: "
+                      f"rounds={int(rounds)} abort={code} "
+                      f"site={int(st_np.get('abort_site', 0))}",
+                      file=sys.stderr, flush=True)
+            if code == 0:
+                break
+            if code & AB_STRUCT:
+                self.aborts += 1
+                return None
+            if code & AB_TRACE:
+                self.cap_tr *= 4
+            if code & AB_OUT:
+                self.cap_out *= 4
+            self._fn = self._cached_build()
+        else:
+            self.aborts += 1
+            return None
+        if int(rounds) == 0:
+            return (0, 0, 0, int(start), int(start), int(runahead))
+        traces = None
+        if self.tracing:
+            n = int(st_np["tr_n"])
+            traces = {
+                "n": n,
+                "t": st_np["tr_t"][:n].astype(np.int64).tobytes(),
+                "kind": st_np["tr_kind"][:n].astype(
+                    np.uint8).tobytes(),
+                "srchost": st_np["tr_srchost"][:n].astype(
+                    np.int32).tobytes(),
+                "pseq": st_np["tr_pseq"][:n].astype(
+                    np.int64).tobytes(),
+                "sip": st_np["tr_sip"][:n].astype(
+                    np.uint32).tobytes(),
+                "sport": st_np["tr_sport"][:n].astype(
+                    np.int32).tobytes(),
+                "dip": st_np["tr_dip"][:n].astype(np.uint32).tobytes(),
+                "dport": st_np["tr_dport"][:n].astype(
+                    np.int32).tobytes(),
+                "size": st_np["tr_plen"][:n].astype(
+                    np.int64).tobytes(),
+                "reason": st_np["tr_reason"][:n].astype(
+                    np.uint8).tobytes(),
+                "owner": st_np["tr_owner"][:n].astype(
+                    np.int32).tobytes(),
+            }
+        st_np["_n_conns"] = n_conns
+        back = self._from_arrays(st_np)
+        self.engine.span_import_tcp(back, *self._caps(), traces)
+        self.last_was_cold = not self.compiled
+        self.compiled = True
+        self.spans += 1
+        self.rounds += int(rounds)
+        ra_out = int(ra) if dynamic else runahead
+        return (int(rounds), int(busy_rounds), int(packets),
+                int(next_start), int(busy_end), ra_out)

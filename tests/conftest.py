@@ -36,3 +36,10 @@ def _reset_device_route_floor():
     from shadow_tpu.ops.propagate import DeviceRouteModel
     DeviceRouteModel.reset_shared()
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (-m 'not slow'); device-kernel "
+        "XLA compiles take minutes on the CPU backend")

@@ -62,6 +62,14 @@ def collect(dirpath):
             rel = os.path.relpath(p, dirpath)
             with open(p, "rb") as f:
                 data = f.read()
+            if fn == "sim-stats.json":
+                # The dispatch block is scheduler TELEMETRY (span vs
+                # device vs per-round split) — it measures the
+                # scheduler, so the cross-scheduler gate must not
+                # byte-diff it.  Simulation state stays covered.
+                data = re.sub(rb'"dispatch": \{.*?\n  \},?',
+                              b'"dispatch": "<normalized>",', data,
+                              flags=re.S)
             if fn == "processed-config.yaml":
                 # Runs legitimately differ only in output path and (for
                 # the cross-scheduler gate) the scheduler knob itself;

@@ -120,10 +120,13 @@ def test_phold_device_span_burst_with_loss():
     assert _hist(m_ser) == _hist(m_dev)
 
 
-def test_non_phold_sim_disables_device_spans_cleanly():
-    """A tgen (TCP) sim under scheduler=tpu with device spans forced:
-    the exporter reports ineligible once and the sim completes on the
-    C++ span path with correct results."""
+def test_non_span_sim_disables_device_spans_cleanly():
+    """A sim that fits NO device-span family (udp-flood/sink — not
+    phold-shaped, not tgen-TCP) under scheduler=tpu with device spans
+    forced: both exporters report ineligible and the sim completes on
+    the C++ span path with correct results.  (tgen-TCP sims no longer
+    exercise this path — they route to the TCP family,
+    tests/test_tcp_span.py.)"""
     cfg = ConfigOptions.from_dict({
         "general": {"stop_time": "2s", "seed": 5},
         "network": {"graph": {"type": "gml", "inline": """
@@ -132,17 +135,19 @@ graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
         "experimental": {"scheduler": "tpu",
                          "tpu_device_spans": "force"},
         "hosts": {
-            "srv": {"network_node_id": 0, "processes": [{
-                "path": "tgen-server", "args": ["80"],
-                "expected_final_state": "running"}]},
-            "cli": {"network_node_id": 0, "processes": [{
-                "path": "tgen-client", "args": ["srv", "80", "30000"],
+            "sink": {"network_node_id": 0, "processes": [{
+                "path": "udp-sink", "args": ["9000", "6400"],
+                "expected_final_state": "any"}]},
+            "src": {"network_node_id": 0, "processes": [{
+                "path": "udp-flood",
+                "args": ["sink", "9000", "100", "64"],
                 "start_time": "100ms",
                 "expected_final_state": "any"}]},
         }})
     m, s = run_simulation(cfg)
     assert s.ok
     assert m._dev_span is None or m._dev_span.spans == 0
+    assert m._dev_span_tcp is None or m._dev_span_tcp.spans == 0
 
 
 def mesh_cfg(scheduler: str, n: int = 8, count: int = 30,
