@@ -469,7 +469,23 @@ def managed_rung() -> None:
               f"{wall_base / wall:.3f}, ok={ok}", file=sys.stderr)
 
 
+def lint_preflight() -> None:
+    """One-line twin-contract gate: a benchmark artifact recorded from
+    a tree with twin drift would compare a C++ engine against a Python
+    kernel that no longer computes the same thing."""
+    from shadow_tpu.analysis import run_all
+    violations, _ = run_all(os.path.dirname(os.path.abspath(__file__)))
+    if violations:
+        print(f"lint: FAIL ({len(violations)} violation(s); "
+              f"run scripts/lint)", file=sys.stderr)
+        for v in violations[:10]:
+            print(f"  {v.render()}", file=sys.stderr)
+        sys.exit(1)
+    print("lint: ok", file=sys.stderr)
+
+
 def main() -> None:
+    lint_preflight()
     # Persistent XLA compile cache: the device-span kernels (PHOLD and
     # especially the TCP family's multi-round while_loop) cost minutes
     # of compile on the CPU backend; repeated bench runs must not pay

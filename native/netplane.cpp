@@ -4407,7 +4407,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   size_t H = e->hosts.size();
 
   std::vector<int64_t> now(H), event_seq(H), packet_seq(H);
-  std::vector<uint32_t> eth_ip(H), status(H), local_ip(H);
+  std::vector<uint32_t> eth_ip(H), status(H);
   std::vector<uint8_t> queued(H);
   std::vector<int64_t> recv_bytes(H), recv_max(H), send_bytes(H),
       send_max(H);
@@ -4469,7 +4469,6 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     packet_seq[h] = (int64_t)hp->packet_seq;
     eth_ip[h] = hp->eth_ip;
     status[h] = u->status;
-    local_ip[h] = u->local_ip;
     queued[h] = u->queued[1] ? 1 : 0;
     recv_bytes[h] = u->recv_bytes;
     recv_max[h] = u->recv_max;
@@ -4597,7 +4596,6 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   put("packet_seq", bytes_vec(packet_seq));
   put("eth_ip", bytes_vec(eth_ip));
   put("status", bytes_vec(status));
-  put("local_ip", bytes_vec(local_ip));
   put("queued", bytes_vec(queued));
   put("recv_bytes", bytes_vec(recv_bytes));
   put("recv_max", bytes_vec(recv_max));
@@ -5344,7 +5342,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   std::vector<int32_t> c_host(CC, 0), c_lport(CC, 0), c_pport(CC, 0),
       c_ourws(CC, 0), c_peerws(CC, 0), c_effmss(CC, 0), c_wsoff(CC, 0),
       c_ssa(CC, 0), c_congmss(CC, 0), c_dupacks(CC, 0),
-      c_rtobackoff(CC, 0), c_axfer(CC, 0), c_acount(CC, 0);
+      c_rtobackoff(CC, 0);
   std::vector<uint8_t> c_role(CC, 0), c_nodelay(CC, 0), c_fastrec(CC, 0),
       c_queued(CC, 0), c_sat(CC, 0), c_rat(CC, 0), c_wakep(CC, 0);
   std::vector<uint32_t> c_lip(CC, 0), c_pip(CC, 0), c_iss(CC, 0),
@@ -5358,7 +5356,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
       c_segsrecv(CC, 0), c_rtxcount(CC, 0), c_sackskip(CC, 0),
       c_tmrdl(CC, -1), c_atcopied(CC, 0), c_atspace(CC, 0),
       c_atlast(CC, 0), c_awaitseq(CC, 0), c_agot(CC, 0),
-      c_atotal(CC, 0), c_at0(CC, 0);
+      c_atotal(CC, 0);
   std::vector<int32_t> rtx_len(CC, 0), ra_len(CC, 0), op_len(CC, 0);
   std::vector<uint32_t> rtx_seq(CC * (size_t)RT, 0),
       ra_seq(CC * (size_t)RA, 0);
@@ -5427,9 +5425,6 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     c_wakep[j] = a.wake_pending ? 1 : 0;
     c_agot[j] = sh.conn_role[j] == 0 ? a.got : a.sent;
     c_atotal[j] = sh.conn_role[j] == 0 ? a.nbytes : a.resp_n;
-    c_at0[j] = a.t0;
-    c_axfer[j] = a.xfer_i;
-    c_acount[j] = a.count;
     rtx_len[j] = (int32_t)c->rtx.size();
     {
       size_t k = 0;
@@ -5573,9 +5568,6 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("c_wakep", bytes_vec(c_wakep));
   put("c_agot", bytes_vec(c_agot));
   put("c_atotal", bytes_vec(c_atotal));
-  put("c_at0", bytes_vec(c_at0));
-  put("c_axfer", bytes_vec(c_axfer));
-  put("c_acount", bytes_vec(c_acount));
   put("rtx_len", bytes_vec(rtx_len));
   put("rtx_seq", bytes_vec(rtx_seq));
   put("rtx_plen", bytes_vec(rtx_plen));

@@ -46,6 +46,20 @@
 
 #include "shim_ipc.h"
 
+/* Older kernel headers (pre-5.6 / pre-5.9) lack these syscall numbers;
+ * the numbers are ABI-stable on x86_64, so define them directly.  The
+ * shim only ever *intercepts* them — a kernel without the syscall just
+ * returns ENOSYS to the managed process, same as without the shim. */
+#ifndef SYS_memfd_create
+#define SYS_memfd_create 319
+#endif
+#ifndef SYS_close_range
+#define SYS_close_range 436
+#endif
+#ifndef SYS_openat2
+#define SYS_openat2 437
+#endif
+
 /* Defined in shim_trampoline.S; section bounds provided by the linker. */
 extern long shadowtpu_raw_syscall(long n, long a1, long a2, long a3,
                                   long a4, long a5, long a6);

@@ -1,0 +1,50 @@
+"""Twin-contract & determinism static analysis.
+
+The determinism guarantee rests on *duplicated* definitions staying in
+lockstep: every constant, SoA column layout, and RNG parameter that
+exists both in native/netplane.cpp and in its Python twins is a
+silent-divergence hazard that otherwise only surfaces at runtime as a
+span abort or a byte-mismatch after minutes of XLA compile.  This
+package catches that drift in seconds, before the differential gates
+(docs/PARITY.md) ever run:
+
+- pass 1 (`twin_constants`): extract named constants from the C++
+  engine and diff them against the Python twin modules;
+- pass 2 (`soa_layout`): extract the span_export/span_import column
+  schemas from the C++ engine and verify the Python codecs consume
+  and produce exactly those columns with the same dtypes;
+- pass 3 (`determinism`): AST lint over shadow_tpu/ for
+  nondeterminism hazards (wall clocks, unseeded RNGs, set iteration,
+  host mutation inside jitted bodies, np-vs-jnp confusion).
+
+Passes 1-2 need no JAX (pure parsing); the whole run is a tier-1 gate
+(tests/test_twin_contract.py) and a CLI: `python -m shadow_tpu.tools.lint`
+or `scripts/lint`.  Rule catalogue and pragma syntax: docs/LINT.md.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.analysis.report import Violation, format_report
+
+__all__ = ["Violation", "format_report", "run_all"]
+
+
+def run_all(repo_root: str, passes=("twin", "layout", "det")):
+    """Run the requested passes; returns (violations, per-pass counts)."""
+    from shadow_tpu.analysis import determinism, soa_layout, twin_constants
+
+    violations: list[Violation] = []
+    counts: dict[str, int] = {}
+    if "twin" in passes:
+        v = twin_constants.check(repo_root)
+        counts["twin"] = len(v)
+        violations += v
+    if "layout" in passes:
+        v = soa_layout.check(repo_root)
+        counts["layout"] = len(v)
+        violations += v
+    if "det" in passes:
+        v = determinism.check(repo_root)
+        counts["det"] = len(v)
+        violations += v
+    return violations, counts

@@ -1,0 +1,396 @@
+"""Lightweight extractors over native/netplane.cpp.
+
+Not a C++ parser — a disciplined family of regex/brace scanners that
+understand exactly the idioms the engine uses for twin-relevant
+definitions:
+
+- `constexpr <type> NAME = <int expr>;` (possibly several declarators
+  per statement, values referencing earlier constants);
+- anonymous `enum { A = 0, B, C };` blocks with implicit increments;
+- `static const int name[N] = {..};` / `static const char *NAME[] =
+  {"..", ..};` literal arrays;
+- the span_export_* / span_import_* column traffic: `put("key",
+  bytes_vec(var))`, helper expansions (`put_pk`, `put_tpk` /
+  `get_tpk`), the r1/r2 relay loop, and `col<T>(d, "key", ..)` reads.
+
+Everything returns plain dicts so the mutation self-test can perturb
+the *text* and assert the downstream pass bites.  If the engine ever
+adopts an idiom these scanners don't recognize, the contract tests
+fail closed (missing name / missing column), not open.
+"""
+
+from __future__ import annotations
+
+import re
+
+# C++ element type -> numpy dtype name used by the Python codecs.
+CTYPE_TO_DTYPE = {
+    "int64_t": "int64",
+    "uint64_t": "uint64",
+    "int32_t": "int32",
+    "uint32_t": "uint32",
+    "int16_t": "int16",
+    "uint16_t": "uint16",
+    "int8_t": "int8",
+    "uint8_t": "uint8",
+}
+
+_INT_SUFFIX = re.compile(r"(?<=[0-9a-fA-F])(?:[uU][lL]{0,2}|[lL]{1,2}[uU]?)\b")
+
+
+def strip_comments(text: str) -> str:
+    """Remove /* */ and // comments, preserving line structure."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        if text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append("\n" * text.count("\n", i, j))
+            i = j
+        elif text.startswith("//", i):
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+        elif text[i] in "\"'":
+            q = text[i]
+            j = i + 1
+            while j < n and text[j] != q:
+                j += 2 if text[j] == "\\" else 1
+            out.append(text[i:j + 1])
+            i = j + 1
+        else:
+            out.append(text[i])
+            i += 1
+    return "".join(out)
+
+
+def _eval_int(expr: str, env: dict) -> int | None:
+    """Evaluate a C++ integer constant expression against known names."""
+    e = _INT_SUFFIX.sub("", expr)
+    # strip C casts like (int64_t)X and (uint32_t)X
+    e = re.sub(r"\(\s*(?:u?int(?:8|16|32|64)_t|size_t|int|long|unsigned)"
+               r"\s*\)", "", e)
+    if not re.fullmatch(r"[\w\s()+\-*/%<>|&^~x0-9]+", e):
+        return None
+    try:
+        return int(eval(e, {"__builtins__": {}}, dict(env)))  # noqa: S307
+    except Exception:
+        return None
+
+
+def extract_constants(text: str) -> dict:
+    """All integer `constexpr` definitions and anonymous-enum members."""
+    text = strip_comments(text)
+    env: dict = {}
+
+    # constexpr <type> A = expr, B = expr, ...;
+    for m in re.finditer(
+            r"\bconstexpr\s+(?:u?int(?:8|16|32|64)_t|size_t|int|long long|"
+            r"long|unsigned)\s+([^;]+);", text):
+        for decl in _split_top(m.group(1), ","):
+            dm = re.match(r"\s*(\w+)\s*=\s*(.+)$", decl, re.S)
+            if not dm:
+                continue
+            val = _eval_int(dm.group(2), env)
+            if val is not None:
+                env[dm.group(1)] = val
+
+    # anonymous enums: enum { A = 0, B, C, ... };
+    for m in re.finditer(r"\benum\s*\{([^}]*)\}\s*;", text):
+        nxt = 0
+        for decl in _split_top(m.group(1), ","):
+            decl = decl.strip()
+            if not decl:
+                continue
+            dm = re.match(r"(\w+)\s*(?:=\s*(.+))?$", decl, re.S)
+            if not dm:
+                continue
+            if dm.group(2) is not None:
+                val = _eval_int(dm.group(2), env)
+                if val is None:
+                    continue
+            else:
+                val = nxt
+            env[dm.group(1)] = val
+            nxt = val + 1
+    return env
+
+
+def extract_int_arrays(text: str) -> dict:
+    """`static const int name[N] = {..};` -> {name: (ints..)}."""
+    text = strip_comments(text)
+    out = {}
+    for m in re.finditer(
+            r"\bstatic\s+(?:constexpr\s+)?const\s+int\s+(\w+)\s*\[\s*\d*\s*\]"
+            r"\s*=\s*\{([^}]*)\}", text):
+        vals = []
+        for tok in m.group(2).split(","):
+            tok = tok.strip()
+            if tok:
+                vals.append(int(_INT_SUFFIX.sub("", tok), 0))
+        out[m.group(1)] = tuple(vals)
+    return out
+
+
+def extract_string_arrays(text: str) -> dict:
+    """`static const char *NAME[..] = {"a", "b"};` -> {name: [(strs..)]}.
+
+    A name may be defined more than once (the two span_import REASONS
+    tables); every occurrence is kept so callers can assert agreement.
+    """
+    text = strip_comments(text)
+    out: dict = {}
+    for m in re.finditer(
+            r"\bstatic\s+const\s+char\s*\*\s*(\w+)\s*\[[^\]]*\]\s*=\s*\{",
+            text):
+        body = _balanced(text, m.end() - 1, "{", "}")
+        strs = tuple(re.findall(r'"((?:[^"\\]|\\.)*)"', body))
+        out.setdefault(m.group(1), []).append(strs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SoA layout extraction (span_export_* / span_import_*)
+# ---------------------------------------------------------------------------
+
+def _split_top(s: str, sep: str):
+    """Split on `sep` at paren/brace/bracket depth 0."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def _balanced(text: str, open_idx: int, op: str, cl: str) -> str:
+    """Body between the braces starting at text[open_idx] (exclusive)."""
+    assert text[open_idx] == op
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == op:
+            depth += 1
+        elif text[i] == cl:
+            depth -= 1
+            if depth == 0:
+                return text[open_idx + 1:i]
+    raise ValueError("unbalanced braces")
+
+
+def function_body(text: str, name: str) -> str:
+    """Brace-matched body of `name(...) { ... }` (first definition)."""
+    m = re.search(r"\b" + re.escape(name) + r"\s*\([^;{)]*\)\s*\{", text)
+    if m is None:
+        raise KeyError(f"function {name} not found")
+    return _balanced(text, m.end() - 1, "{", "}")
+
+
+def _vector_decls(body: str) -> dict:
+    """Map variable name -> dtype for std::vector<T> declarations
+    (multi-declarator statements and T name[3] arrays included)."""
+    out = {}
+    for m in re.finditer(r"std::vector<(\w+)>\s+([^;]+);", body):
+        dt = CTYPE_TO_DTYPE.get(m.group(1))
+        if dt is None:
+            continue
+        for decl in _split_top(m.group(2), ","):
+            dm = re.match(r"\s*(\w+)", decl)
+            if dm:
+                out[dm.group(1)] = dt
+    return out
+
+
+def _struct_members(text: str, struct_name: str) -> dict:
+    """Member name -> dtype for the std::vector members of a struct."""
+    m = re.search(r"\bstruct\s+" + re.escape(struct_name) + r"\s*\{", text)
+    if m is None:
+        raise KeyError(f"struct {struct_name} not found")
+    body = _balanced(text, m.end() - 1, "{", "}")
+    members = {}
+    for vm in re.finditer(r"std::vector<(\w+)>\s+([^;()]+);", body):
+        dt = CTYPE_TO_DTYPE.get(vm.group(1))
+        if dt is None:
+            continue
+        for decl in _split_top(vm.group(2), ","):
+            dm = re.match(r"\s*(\w+)", decl)
+            if dm:
+                members[dm.group(1)] = dt
+    # sk[6] style arrays keep their base name
+    return members
+
+
+def _pk_helper_schema(helper_body: str, members: dict,
+                      sk_names=None) -> list:
+    """(suffix, dtype) pairs a put_pk/put_tpk/get_tpk-style helper
+    emits, parsed from its own body so member renames are caught."""
+    pairs = []
+    # put-style: put((p + "_x").c_str(), bytes_vec(c.member)) or
+    #            put(p + "_x", bytes_vec(c.member))
+    for m in re.finditer(
+            r'\(?p\s*\+\s*"(_\w+)"\)?(?:\.c_str\(\))?\s*,\s*'
+            r'bytes_vec\(c\.(\w+)\)', helper_body):
+        dt = members.get(m.group(2))
+        if dt:
+            pairs.append((m.group(1), dt))
+    # col-style reads: c.member = col<T>(d, (p + "_x").c_str(), ...)
+    for m in re.finditer(
+            r'c\.(\w+)(?:\[\w+\])?\s*=\s*col<(\w+)>\s*\(\s*d\s*,\s*'
+            r'\(p\s*\+\s*"(_\w+)"\)\.c_str\(\)', helper_body):
+        dt = CTYPE_TO_DTYPE.get(m.group(2))
+        if dt:
+            pairs.append((m.group(3), dt))
+    # TPK_SK loop: put(p + "_" + TPK_SK[i], bytes_vec(c.sk[i]))
+    #          or: c.sk[i] = col<uint32_t>(d, (p + "_" + TPK_SK[i])...)
+    skm = re.search(r'p\s*\+\s*"_"\s*\+\s*TPK_SK\[i\]', helper_body)
+    if skm and sk_names:
+        dt = members.get("sk")
+        cm = re.search(r"col<(\w+)>\s*\(\s*d\s*,\s*\(p\s*\+\s*\"_\"\s*\+"
+                       r"\s*TPK_SK", helper_body)
+        if cm:
+            dt = CTYPE_TO_DTYPE.get(cm.group(1), dt)
+        for nm in sk_names:
+            pairs.append(("_" + nm, dt))
+    return pairs
+
+
+def _mask_lambda_bodies(body: str) -> str:
+    """Replace the bodies of in-function lambdas with blanks so the
+    direct put/col scans don't re-match a helper's own internals (the
+    helper schema is expanded separately at its call sites)."""
+    out = body
+    for lam in re.finditer(r"=\s*\[&\]\([^)]*\)\s*(?:->\s*[\w:<>]+\s*)?\{",
+                           body):
+        inner = _balanced(body, lam.end() - 1, "{", "}")
+        out = out.replace(inner, " " * len(inner), 1)
+    return out
+
+
+def _relay_prefixes(body: str) -> list:
+    """The r1/r2 loop binds `std::string p = <cond> ? "r1" : "r2";`."""
+    m = re.search(r'std::string\s+p\s*=\s*\w+\s*==\s*\d+\s*\?\s*"(\w+)"'
+                  r'\s*:\s*"(\w+)"', body)
+    return [m.group(1), m.group(2)] if m else []
+
+
+def extract_export_layout(text: str, func: str) -> dict:
+    """Column key -> dtype for a span_export_* function.
+
+    Handles: put("key", bytes_vec(var)); put((p + "_sfx").c_str(), ..)
+    inside the r1/r2 loop; put_pk / put_tpk helper expansion.
+    """
+    text = strip_comments(text)
+    body = function_body(text, func)
+    decls = _vector_decls(body)
+    layout: dict = {}
+
+    sk_names = None
+    sarr = extract_string_arrays(text)
+    if "TPK_SK" in sarr:
+        sk_names = sarr["TPK_SK"][0]
+
+    # helper schemas: in-function lambda put_pk, file-level put_tpk
+    helpers = {}
+    lam = re.search(r"auto\s+put_pk\s*=\s*\[&\]\([^)]*\)\s*\{", body)
+    if lam:
+        hb = _balanced(body, lam.end() - 1, "{", "}")
+        helpers["put_pk"] = _pk_helper_schema(
+            hb, _struct_members(text, "PkCols"))
+    fm = re.search(r"\bvoid\s+put_tpk\s*\([^)]*\)\s*\{", text)
+    if fm:
+        hb = _balanced(text, fm.end() - 1, "{", "}")
+        helpers["put_tpk"] = _pk_helper_schema(
+            hb, _struct_members(text, "TPkCols"), sk_names=sk_names)
+
+    prefixes = _relay_prefixes(body)
+    scan = _mask_lambda_bodies(body)
+
+    # direct puts: put("key", bytes_vec(var))
+    for m in re.finditer(r'put\(\s*"(\w+)"\s*,\s*bytes_vec\((\w+)', scan):
+        dt = decls.get(m.group(2))
+        if dt:
+            layout[m.group(1)] = dt
+    # relay-loop puts: put((p + "_sfx").c_str(), bytes_vec(var[ri]))
+    for m in re.finditer(
+            r'put\(\s*\(p\s*\+\s*"(_\w+)"\)\.c_str\(\)\s*,\s*'
+            r'bytes_vec\((\w+)', scan):
+        dt = decls.get(m.group(2))
+        if dt:
+            for p in prefixes:
+                layout[p + m.group(1)] = dt
+    # helper calls with a literal prefix: put_pk("rq", rq) /
+    # put_tpk(d, "cq", cq, &ok)
+    for hname, schema in helpers.items():
+        for m in re.finditer(
+                re.escape(hname) + r'\(\s*(?:d\s*,\s*)?"(\w+)"', body):
+            for sfx, dt in schema:
+                layout[m.group(1) + sfx] = dt
+        # helper calls with the relay prefix: put_pk((p + "_pk").c_str(),..)
+        for m in re.finditer(
+                re.escape(hname) +
+                r'\(\s*(?:d\s*,\s*)?\(p\s*\+\s*"(_\w+)"\)\.c_str\(\)',
+                body):
+            for p in prefixes:
+                for sfx, dt in schema:
+                    layout[p + m.group(1) + sfx] = dt
+    return layout
+
+
+def extract_import_layout(text: str, func: str) -> dict:
+    """Column key -> dtype for a span_import_* function (col<T> reads,
+    the r1/r2 loop, rd_pk-style lambdas and get_tpk expansion)."""
+    text = strip_comments(text)
+    body = function_body(text, func)
+    layout: dict = {}
+
+    sk_names = None
+    sarr = extract_string_arrays(text)
+    if "TPK_SK" in sarr:
+        sk_names = sarr["TPK_SK"][0]
+
+    helpers = {}
+    for lam in re.finditer(r"auto\s+(\w+)\s*=\s*\[&\]\([^)]*\)\s*(?:->\s*"
+                           r"[\w:<>]+\s*)?\{", body):
+        hb = _balanced(body, lam.end() - 1, "{", "}")
+        schema = _pk_helper_schema(hb, {}, sk_names=sk_names)
+        if schema:
+            helpers[lam.group(1)] = schema
+    fm = re.search(r"\bTPkIn\s+get_tpk\s*\([^)]*\)\s*\{", text)
+    if fm:
+        hb = _balanced(text, fm.end() - 1, "{", "}")
+        helpers["get_tpk"] = _pk_helper_schema(
+            hb, _struct_members(text, "TPkIn"), sk_names=sk_names)
+
+    prefixes = _relay_prefixes(body)
+    scan = _mask_lambda_bodies(body)
+
+    for m in re.finditer(r'col<(\w+)>\s*\(\s*d\s*,\s*"(\w+)"', scan):
+        dt = CTYPE_TO_DTYPE.get(m.group(1))
+        if dt:
+            layout[m.group(2)] = dt
+    for m in re.finditer(
+            r'col<(\w+)>\s*\(\s*d\s*,\s*\(p\s*\+\s*"(_\w+)"\)\.c_str\(\)',
+            scan):
+        dt = CTYPE_TO_DTYPE.get(m.group(1))
+        if dt:
+            for p in prefixes:
+                layout[p + m.group(2)] = dt
+    for hname, schema in helpers.items():
+        for m in re.finditer(
+                re.escape(hname) + r'\(\s*(?:d\s*,\s*)?"(\w+)"', body):
+            for sfx, dt in schema:
+                layout[m.group(1) + sfx] = dt
+        for m in re.finditer(
+                re.escape(hname) +
+                r'\(\s*(?:d\s*,\s*)?\(p\s*\+\s*"(_\w+)"\)\.c_str\(\)',
+                body):
+            for p in prefixes:
+                for sfx, dt in schema:
+                    layout[p + m.group(1) + sfx] = dt
+    return layout

@@ -1,0 +1,353 @@
+"""Pass 3: JAX determinism/purity lint over shadow_tpu/.
+
+Byte-identical traces tolerate zero ambient nondeterminism in anything
+that feeds simulation state.  This pass walks every module's AST and
+flags the hazard patterns; sanctioned exceptions carry an inline
+pragma with a reason:
+
+    x = time.perf_counter()  # shadow-lint: allow[wall-clock] pacing only
+
+Rules (catalogue + rationale in docs/LINT.md):
+
+  py-random      stdlib `random` (global, seed-order dependent)
+  np-random      `np.random` anywhere — the sanctioned RNG is the
+                 counter-based threefry in core/rng.py; even seeded
+                 RandomStates are sequential (draw-order dependent)
+  wall-clock     time.time/monotonic/perf_counter, datetime.now, ...
+  set-iter       iterating a set (unordered -> order-dependent traces)
+  host-mutation  global/nonlocal writes or closure-object mutation
+                 inside a jitted/traced function body
+  tracer-leak    attribute writes (obj.attr = ..) inside a jitted/
+                 traced function body — traced values escaping to host
+                 objects outlive the trace and go stale
+  np-in-jit      np.* calls inside a jitted/traced body where jnp is
+                 required (host math on traced values breaks tracing
+                 or silently constant-folds)
+
+"Jitted/traced bodies" = functions decorated with jit/jax.jit/
+partial(jax.jit, ..), functions passed to lax.while_loop/scan/cond/
+fori_loop/switch or shard_map, plus everything nested inside them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from shadow_tpu.analysis.report import Violation
+
+RULES = ("py-random", "np-random", "wall-clock", "set-iter",
+         "host-mutation", "tracer-leak", "np-in-jit")
+
+_PRAGMA = re.compile(
+    r"#\s*shadow-lint:\s*allow\[([\w\-,\s]+)\]\s*(\S.*)?$")
+
+_WALL_CLOCK_ATTRS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("time", "perf_counter_ns"), ("time", "process_time"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("date", "today"), ("os", "times"),
+}
+
+# names that are wall-clock reads when imported bare
+# (`from time import perf_counter`)
+_WALL_CLOCK_FROM = {
+    "time": {"time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns", "process_time"},
+    "os": {"times"},
+}
+
+_LAX_HOF = {"while_loop", "scan", "cond", "fori_loop", "switch",
+            "shard_map", "pmap", "vmap_with_state"}
+
+# np.* calls that are pure scalar/dtype constructors — fine at trace
+# time inside a jitted body (they cannot touch a tracer)
+_NP_TRACE_SAFE = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+                  "uint32", "uint64", "float32", "float64", "bool_",
+                  "dtype", "iinfo", "finfo"}
+
+
+def _pragma_allows(lines, lineno: int, rule: str) -> bool:
+    """True if the line (or the line above) carries a matching pragma
+    with a non-empty reason."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = _PRAGMA.search(lines[ln - 1])
+            if m and m.group(2):
+                allowed = {r.strip() for r in m.group(1).split(",")}
+                if rule in allowed or "*" in allowed:
+                    return True
+    return False
+
+
+def _is_set_expr(node) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("set", "frozenset"):
+        return True
+    return False
+
+
+class _DeviceFnFinder(ast.NodeVisitor):
+    """Collects FunctionDef/Lambda nodes that run under jit/trace."""
+
+    def __init__(self):
+        self.device_fns: set = set()
+        self._local_defs: dict = {}
+
+    def visit_FunctionDef(self, node):
+        self._local_defs[node.name] = node
+        for dec in node.decorator_list:
+            if self._is_jit(dec):
+                self.device_fns.add(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _is_jit(dec) -> bool:
+        # @jit / @jax.jit / @partial(jax.jit, ..) / @jax.pmap
+        def name_of(n):
+            if isinstance(n, ast.Name):
+                return n.id
+            if isinstance(n, ast.Attribute):
+                return n.attr
+            return None
+
+        if name_of(dec) in ("jit", "pmap"):
+            return True
+        if isinstance(dec, ast.Call):
+            if name_of(dec.func) in ("jit", "pmap"):
+                return True
+            if name_of(dec.func) == "partial" and dec.args and \
+                    name_of(dec.args[0]) in ("jit", "pmap"):
+                return True
+        return False
+
+    def visit_Call(self, node):
+        # lax.while_loop(cond, body, ..), jit(fn), shard_map(fn, ..):
+        # any function-valued argument becomes a device fn
+        fname = None
+        if isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+        if fname in _LAX_HOF or fname in ("jit",):
+            candidates = list(node.args) + \
+                [kw.value for kw in node.keywords]
+            for arg in candidates:
+                if isinstance(arg, ast.Lambda):
+                    self.device_fns.add(arg)
+                elif isinstance(arg, ast.Name) and \
+                        arg.id in self._local_defs:
+                    self.device_fns.add(self._local_defs[arg.id])
+        self.generic_visit(node)
+
+
+def _expand_nested(fns: set) -> set:
+    """A function defined inside a device fn is device too."""
+    out = set(fns)
+    for fn in fns:
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                out.add(sub)
+    return out
+
+
+class _ModuleLinter:
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.violations: list[Violation] = []
+
+    def flag(self, rule: str, node, message: str):
+        if not _pragma_allows(self.lines, node.lineno, rule):
+            self.violations.append(
+                Violation(rule, self.relpath, message, line=node.lineno))
+
+    # -- module-wide rules -------------------------------------------
+    def _collect_aliases(self) -> dict:
+        """Local name -> canonical dotted module for `import X [as Y]`
+        (so `import time as t; t.time()` still matches)."""
+        aliases = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        aliases[alias.asname] = alias.name
+                    else:
+                        # `import os.path` binds the ROOT name `os`
+                        root = alias.name.split(".")[0]
+                        aliases[root] = root
+        # default spellings always resolve to themselves
+        for canon in ("time", "datetime", "os", "random", "numpy"):
+            aliases.setdefault(canon, canon)
+        aliases.setdefault("np", "numpy")
+        return aliases
+
+    @staticmethod
+    def _dotted(node):
+        """Flatten a Name/Attribute chain to its dotted parts, or None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return parts[::-1]
+
+    def lint_global(self):
+        aliases = self._collect_aliases()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "random":
+                        self.flag("py-random", node,
+                                  "stdlib random is seed-order dependent; "
+                                  "use core/rng.py threefry streams")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                root = mod.split(".")[0]
+                if root == "random":
+                    self.flag("py-random", node,
+                              "stdlib random is seed-order dependent; "
+                              "use core/rng.py threefry streams")
+                elif mod == "numpy.random" or (
+                        root == "numpy" and any(
+                            a.name == "random" for a in node.names)):
+                    self.flag("np-random", node,
+                              "numpy.random is a sequential host RNG; "
+                              "use core/rng.py threefry streams")
+                elif mod in _WALL_CLOCK_FROM and any(
+                        a.name in _WALL_CLOCK_FROM[mod]
+                        for a in node.names):
+                    self.flag("wall-clock", node,
+                              f"wall-clock import from {mod} — "
+                              f"simulation state must come from sim "
+                              f"time")
+            elif isinstance(node, ast.Attribute):
+                parts = self._dotted(node)
+                if parts is None:
+                    continue
+                # resolve `import X as Y` on the leading name
+                canon = aliases.get(parts[0], parts[0]).split(".") \
+                    + parts[1:]
+                dotted = ".".join(canon)
+                if canon[0] == "random":
+                    self.flag("py-random", node,
+                              f"{dotted}: stdlib random is seed-order "
+                              f"dependent")
+                elif canon[0] == "numpy" and "random" in canon[1:-1]:
+                    self.flag("np-random", node,
+                              f"{dotted}: sequential host RNG; use "
+                              f"core/rng.py threefry streams")
+                elif (canon[-2], canon[-1]) in _WALL_CLOCK_ATTRS and \
+                        canon[0] in ("time", "datetime", "os"):
+                    self.flag("wall-clock", node,
+                              f"{dotted}: wall-clock read — simulation "
+                              f"state must come from sim time")
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if _is_set_expr(it):
+                    self.flag("set-iter", it if hasattr(it, "lineno")
+                              else node,
+                              "iterating a set: unordered — sort first "
+                              "if order can reach simulation state")
+
+    # -- device-path rules -------------------------------------------
+    def lint_device(self):
+        finder = _DeviceFnFinder()
+        finder.visit(self.tree)
+        # lint only OUTERMOST device fns: each one's walk already
+        # covers its nested defs (a while_loop body inside a jitted fn
+        # must not be reported twice)
+        nested_in_other = set()
+        for fn in finder.device_fns:
+            nested_in_other |= _expand_nested({fn}) - {fn}
+        for fn in finder.device_fns:
+            if fn not in nested_in_other:
+                self._lint_device_fn(fn)
+
+    def _lint_device_fn(self, fn):
+        local_names = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_names.add(tgt.id)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(node.target, ast.Name):
+                    local_names.add(node.target.id)
+        if hasattr(fn, "args"):
+            for a in getattr(fn.args, "args", []):
+                local_names.add(a.arg)
+
+        for node in ast.walk(fn):
+            # skip nodes that belong to nested non-device defs: all
+            # nested defs ARE device here (by _expand_nested), so no
+            # skipping is needed
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                self.flag("host-mutation", node,
+                          "global/nonlocal write inside a traced body "
+                          "runs at trace time only — stale on cached "
+                          "executions")
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        self.flag("tracer-leak", node,
+                                  f"attribute write .{tgt.attr} inside "
+                                  f"a traced body leaks trace-time "
+                                  f"state onto a host object")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                owner = node.func.value
+                attr = node.func.attr
+                if isinstance(owner, ast.Name) and \
+                        owner.id in ("np", "numpy") and \
+                        attr not in _NP_TRACE_SAFE:
+                    self.flag("np-in-jit", node,
+                              f"np.{attr} inside a traced body: host "
+                              f"numpy cannot consume tracers — use jnp")
+                elif attr in ("append", "extend", "add", "update",
+                              "setdefault", "insert") and \
+                        isinstance(owner, ast.Name) and \
+                        owner.id not in local_names:
+                    self.flag("host-mutation", node,
+                              f"{owner.id}.{attr}(..) mutates a closure "
+                              f"object at trace time only — stale on "
+                              f"cached executions")
+
+
+def iter_py_files(repo_root: str, subdir: str = "shadow_tpu"):
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(repo_root, subdir)):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", "lib"))
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def check(repo_root: str, paths=None) -> list:
+    violations: list[Violation] = []
+    files = paths if paths is not None else iter_py_files(repo_root)
+    for path in files:
+        rel = os.path.relpath(path, repo_root)
+        with open(path) as fh:
+            source = fh.read()
+        try:
+            linter = _ModuleLinter(rel, source)
+        except SyntaxError as exc:
+            violations.append(Violation(
+                "parse-error", rel, str(exc), line=exc.lineno or 0))
+            continue
+        linter.lint_global()
+        linter.lint_device()
+        violations.extend(linter.violations)
+    return violations

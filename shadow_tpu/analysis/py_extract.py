@@ -1,0 +1,299 @@
+"""AST extractors over the Python twin modules.
+
+No twin module is imported (passes 1-2 must run without JAX, and
+importing ops/ pulls heavy deps); everything is read from the AST:
+
+- module-level (and class-level) integer/tuple constants;
+- the column schema a span codec *consumes* — every
+  `np.frombuffer(d[key], dtype)` reached from `_to_arrays`, including
+  reads routed through local helpers (`f`, `pk`) and loops over
+  constant tuples;
+- the column key set a codec *produces* — every `out[key] = ...`
+  reached from `_from_arrays`, including the `ring()` helper.
+
+The mini-interpreter only evaluates what the codecs actually use:
+string/int/tuple/dict literals, f-strings, name lookups, tuple
+concatenation, and `DICT[var]` subscripts.  Anything else evaluates to
+None and the read is reported as unresolvable — the contract test
+fails closed instead of silently under-checking.
+"""
+
+from __future__ import annotations
+
+import ast
+
+_NP_NAMES = {"np", "numpy", "jnp"}
+_DTYPE_NAMES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+                "uint32", "uint64", "float32", "float64", "bool_"}
+
+
+class _Unresolved(Exception):
+    pass
+
+
+def _const_eval(node, env):
+    """Evaluate the literal-ish subset the twin modules use."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise _Unresolved(node.id)
+    if isinstance(node, ast.Tuple):
+        return tuple(_const_eval(e, env) for e in node.elts)
+    if isinstance(node, ast.List):
+        return [_const_eval(e, env) for e in node.elts]
+    if isinstance(node, ast.Dict):
+        return {_const_eval(k, env): _const_eval(v, env)
+                for k, v in zip(node.keys, node.values)}
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                parts.append(str(_const_eval(v.value, env)))
+            else:
+                raise _Unresolved(ast.dump(v))
+        return "".join(parts)
+    if isinstance(node, ast.Attribute):
+        # np.int64 and friends evaluate to the dtype name string
+        if isinstance(node.value, ast.Name) and \
+                node.value.id in _NP_NAMES and node.attr in _DTYPE_NAMES:
+            return node.attr
+        raise _Unresolved(ast.dump(node))
+    if isinstance(node, ast.Subscript):
+        container = _const_eval(node.value, env)
+        key = _const_eval(node.slice, env)
+        try:
+            return container[key]
+        except (KeyError, IndexError, TypeError) as exc:
+            raise _Unresolved(f"subscript: {exc}") from exc
+    if isinstance(node, ast.BinOp):
+        left = _const_eval(node.left, env)
+        right = _const_eval(node.right, env)
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+            if isinstance(node.op, ast.LShift):
+                return left << right
+            if isinstance(node.op, ast.RShift):
+                return left >> right
+            if isinstance(node.op, ast.BitOr):
+                return left | right
+            if isinstance(node.op, ast.BitAnd):
+                return left & right
+            if isinstance(node.op, ast.BitXor):
+                return left ^ right
+        except (TypeError, ValueError, ZeroDivisionError) as exc:
+            raise _Unresolved(f"binop: {exc}") from exc
+        raise _Unresolved(ast.dump(node))
+    if isinstance(node, ast.UnaryOp):
+        v = _const_eval(node.operand, env)
+        try:
+            if isinstance(node.op, ast.USub):
+                return -v
+            if isinstance(node.op, ast.Invert):
+                return ~v
+        except TypeError as exc:
+            raise _Unresolved(f"unaryop: {exc}") from exc
+        raise _Unresolved(ast.dump(node))
+    raise _Unresolved(ast.dump(node))
+
+
+def module_env(tree: ast.Module) -> dict:
+    """Module-level constants (ints, strings, tuples, dicts)."""
+    env: dict = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            try:
+                env[stmt.targets[0].id] = _const_eval(stmt.value, env)
+            except _Unresolved:
+                pass
+    return env
+
+
+def extract_constants(path: str) -> dict:
+    """Module-level and class-level integer/tuple constants.
+
+    Class attributes are keyed "ClassName.attr".
+    """
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    env = module_env(tree)
+    out = {k: v for k, v in env.items()
+           if isinstance(v, (int, tuple)) and not isinstance(v, bool)}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, ast.Assign) and \
+                        len(sub.targets) == 1 and \
+                        isinstance(sub.targets[0], ast.Name):
+                    try:
+                        v = _const_eval(sub.value, env)
+                    except _Unresolved:
+                        continue
+                    if isinstance(v, int) and not isinstance(v, bool):
+                        out[f"{stmt.name}.{sub.targets[0].id}"] = v
+    return out
+
+
+def _find_method(tree: ast.Module, method: str):
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == method:
+                    return sub
+    raise KeyError(f"method {method} not found")
+
+
+class _CodecScanner:
+    """Symbolically executes a codec method far enough to see every
+    d[key] read (np.frombuffer) and every out[key] write."""
+
+    MAX_DEPTH = 8
+
+    def __init__(self, module_env_: dict):
+        self.env0 = module_env_
+        self.consumed: dict = {}     # key -> dtype name (or None)
+        self.produced: set = set()
+        self.unresolved: list = []   # (lineno, what)
+
+    # -- helpers -----------------------------------------------------
+    def _ev(self, node, env):
+        try:
+            return _const_eval(node, {**self.env0, **env})
+        except _Unresolved:
+            return None
+
+    def _record_read(self, key_node, dtype_node, env, lineno):
+        key = self._ev(key_node, env)
+        if not isinstance(key, str):
+            self.unresolved.append((lineno, "column key"))
+            return
+        dt = self._ev(dtype_node, env) if dtype_node is not None else None
+        self.consumed[key] = dt if isinstance(dt, str) else None
+
+    # -- execution ---------------------------------------------------
+    def run(self, method_node, depth=0, env=None, funcs=None):
+        self.exec_stmts(method_node.body, env or {}, funcs or {}, depth)
+
+    def exec_stmts(self, stmts, env, funcs, depth):
+        if depth > self.MAX_DEPTH:
+            return
+        for stmt in stmts:
+            if isinstance(stmt, ast.FunctionDef):
+                funcs = {**funcs, stmt.name: stmt}
+            elif isinstance(stmt, ast.For):
+                self._exec_for(stmt, env, funcs, depth)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self.scan_calls(stmt.test, env, funcs, depth)
+                self.exec_stmts(stmt.body, env, funcs, depth)
+                self.exec_stmts(stmt.orelse, env, funcs, depth)
+            else:
+                self.scan_calls(stmt, env, funcs, depth)
+                self._scan_out_writes(stmt, env)
+        return funcs
+
+    def _lenient_tuple(self, node, env):
+        """Evaluate a tuple display elementwise; runtime-only elements
+        (shape caps etc.) become None instead of poisoning the whole
+        iterable — the string keys are what the contract needs."""
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return self._ev(node, env)
+        items = []
+        for el in node.elts:
+            if isinstance(el, (ast.Tuple, ast.List)):
+                items.append(tuple(self._ev(sub, env) for sub in el.elts))
+            else:
+                items.append(self._ev(el, env))
+        return tuple(items)
+
+    def _exec_for(self, stmt, env, funcs, depth):
+        items = self._lenient_tuple(stmt.iter, env)
+        if not isinstance(items, (tuple, list)):
+            # not a constant iterable: still scan the body once with
+            # the loop variable unbound so nested reads surface as
+            # unresolved rather than vanishing
+            self.exec_stmts(stmt.body, env, funcs, depth)
+            return
+        for item in items:
+            bound = dict(env)
+            if isinstance(stmt.target, ast.Name):
+                bound[stmt.target.id] = item
+            elif isinstance(stmt.target, ast.Tuple):
+                for tgt, val in zip(stmt.target.elts, item):
+                    if isinstance(tgt, ast.Name):
+                        bound[tgt.id] = val
+            self.exec_stmts(stmt.body, bound, funcs, depth)
+
+    def _scan_out_writes(self, stmt, env):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "out":
+                        key = self._ev(tgt.slice, env)
+                        if isinstance(key, str):
+                            self.produced.add(key)
+                        else:
+                            self.unresolved.append(
+                                (node.lineno, "out[] key"))
+
+    def scan_calls(self, stmt, env, funcs, depth):
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            # np.frombuffer(d[key], dtype=...) / (d[key], np.int64)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "frombuffer" and node.args and \
+                    isinstance(node.args[0], ast.Subscript) and \
+                    isinstance(node.args[0].value, ast.Name) and \
+                    node.args[0].value.id == "d":
+                dtype_node = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dtype_node = kw.value
+                self._record_read(node.args[0].slice, dtype_node, env,
+                                  node.lineno)
+            # calls into local helper functions: symbolic descent
+            elif isinstance(node.func, ast.Name) and node.func.id in funcs:
+                fn = funcs[node.func.id]
+                bound = dict(env)
+                params = [a.arg for a in fn.args.args]
+                defaults = fn.args.defaults
+                for name, dflt in zip(params[len(params) - len(defaults):],
+                                      defaults):
+                    bound[name] = self._ev(dflt, env)
+                for name, arg in zip(params, node.args):
+                    bound[name] = self._ev(arg, env)
+                for kw in node.keywords:
+                    if kw.arg:
+                        bound[kw.arg] = self._ev(kw.value, env)
+                self.exec_stmts(fn.body, bound, funcs, depth + 1)
+
+
+def extract_consumed_schema(path: str, method: str = "_to_arrays"):
+    """(consumed {key: dtype-or-None}, unresolved [(line, what)])."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    scanner = _CodecScanner(module_env(tree))
+    scanner.run(_find_method(tree, method))
+    return scanner.consumed, scanner.unresolved
+
+
+def extract_produced_keys(path: str, method: str = "_from_arrays"):
+    """(produced key set, unresolved [(line, what)])."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    scanner = _CodecScanner(module_env(tree))
+    scanner.run(_find_method(tree, method))
+    return scanner.produced, scanner.unresolved

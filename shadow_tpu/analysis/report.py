@@ -1,0 +1,34 @@
+"""Violation record + report formatting shared by all lint passes."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Violation:
+    rule: str          # e.g. "twin-constant", "soa-layout", "wall-clock"
+    file: str          # repo-relative path the violation anchors to
+    message: str
+    line: int = 0      # 1-based; 0 when the finding is not line-anchored
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class PassResult:
+    name: str
+    violations: list = field(default_factory=list)
+
+
+def format_report(violations, counts=None) -> str:
+    lines = [v.render() for v in violations]
+    n = len(violations)
+    if counts:
+        per = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        lines.append(f"shadow-lint: {n} violation(s) ({per})")
+    else:
+        lines.append(f"shadow-lint: {n} violation(s)")
+    return "\n".join(lines)

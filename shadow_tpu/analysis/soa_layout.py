@@ -1,0 +1,125 @@
+"""Pass 2: SoA layout contract check.
+
+For every device-span family the C++ engine exports a dict of packed
+column bytes and later imports the Python codec's dict back.  Four
+schemas must stay in lockstep:
+
+    span_export_*  (C++ writer)  ==  _to_arrays    (Python reader)
+    span_import_*  (C++ reader)  ==  _from_arrays  (Python writer)
+
+and the C++ reader's per-column dtype must match the C++ writer's.
+Any unread exported column (dead device-link traffic), phantom read
+(KeyError at runtime), or dtype skew (silent reinterpretation of raw
+bytes!) is flagged here, statically, instead of surfacing as a span
+abort or a byte-mismatch at runtime.
+
+To register a new device-span family: add a row to FAMILIES naming the
+C++ export/import functions and the Python codec module; the codec
+must expose `_to_arrays` / `_from_arrays` methods using the
+`np.frombuffer(d[key], dtype)` / `out[key] = ...` idioms.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shadow_tpu.analysis import cpp_extract, py_extract
+from shadow_tpu.analysis.report import Violation
+
+CPP = "native/netplane.cpp"
+
+FAMILIES = [
+    {
+        "name": "phold",
+        "export_fn": "eng_span_export_phold",
+        "import_fn": "eng_span_import_phold",
+        "codec": "shadow_tpu/ops/phold_span.py",
+        # extraction-sanity floor: fewer keys than this means the
+        # extractor lost the function, not that the schema shrank
+        "min_columns": 60,
+    },
+    {
+        "name": "tcp",
+        "export_fn": "eng_span_export_tcp",
+        "import_fn": "eng_span_import_tcp",
+        "codec": "shadow_tpu/ops/tcp_span.py",
+        "min_columns": 120,
+    },
+]
+
+
+def check(repo_root: str, cpp_text: str | None = None) -> list:
+    if cpp_text is None:
+        with open(os.path.join(repo_root, CPP)) as fh:
+            cpp_text = fh.read()
+
+    violations: list[Violation] = []
+    for fam in FAMILIES:
+        name = fam["name"]
+        codec = fam["codec"]
+        codec_path = os.path.join(repo_root, codec)
+        try:
+            exported = cpp_extract.extract_export_layout(
+                cpp_text, fam["export_fn"])
+            imported = cpp_extract.extract_import_layout(
+                cpp_text, fam["import_fn"])
+        except KeyError as exc:
+            violations.append(Violation(
+                "soa-layout", CPP, f"[{name}] {exc.args[0]}"))
+            continue
+        consumed, unres_c = py_extract.extract_consumed_schema(codec_path)
+        produced, unres_p = py_extract.extract_produced_keys(codec_path)
+
+        if len(exported) < fam["min_columns"]:
+            violations.append(Violation(
+                "soa-layout", CPP,
+                f"[{name}] export extractor found only {len(exported)} "
+                f"columns (< {fam['min_columns']}); unrecognized idiom?"))
+        for line, what in unres_c + unres_p:
+            violations.append(Violation(
+                "soa-layout", codec,
+                f"[{name}] unresolvable {what} (the contract cannot "
+                f"see this read/write)", line=line))
+
+        # export -> _to_arrays
+        for key in sorted(set(exported) - set(consumed)):
+            violations.append(Violation(
+                "soa-layout", CPP,
+                f"[{name}] exported column {key!r} is never consumed "
+                f"by {codec} _to_arrays (dead device-link traffic)"))
+        for key in sorted(set(consumed) - set(exported)):
+            violations.append(Violation(
+                "soa-layout", codec,
+                f"[{name}] _to_arrays reads column {key!r} that "
+                f"{fam['export_fn']} never exports (KeyError at span "
+                f"time)"))
+        for key in sorted(set(exported) & set(consumed)):
+            if consumed[key] is not None and consumed[key] != exported[key]:
+                violations.append(Violation(
+                    "soa-layout", codec,
+                    f"[{name}] column {key!r} decoded as "
+                    f"{consumed[key]} but exported as {exported[key]} "
+                    f"(byte reinterpretation)"))
+
+        # _from_arrays -> import
+        for key in sorted(set(imported) - set(produced)):
+            violations.append(Violation(
+                "soa-layout", codec,
+                f"[{name}] {fam['import_fn']} requires column {key!r} "
+                f"that _from_arrays never produces (import failure)"))
+        for key in sorted(set(produced) - set(imported)):
+            violations.append(Violation(
+                "soa-layout", codec,
+                f"[{name}] _from_arrays produces column {key!r} that "
+                f"{fam['import_fn']} never reads (dead device-link "
+                f"traffic)"))
+
+        # C++ import dtype vs C++ export dtype (same byte layout end
+        # to end; only meaningful for columns both sides touch)
+        for key in sorted(set(imported) & set(exported)):
+            if imported[key] != exported[key]:
+                violations.append(Violation(
+                    "soa-layout", CPP,
+                    f"[{name}] column {key!r} exported as "
+                    f"{exported[key]} but imported as {imported[key]}"))
+    return violations

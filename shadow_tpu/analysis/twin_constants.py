@@ -1,0 +1,260 @@
+"""Pass 1: twin-constant extraction & cross-check.
+
+Every constant that exists both in native/netplane.cpp and in a Python
+module is a silent-divergence hazard: the engine and the device kernels
+would disagree byte-for-byte and the mismatch only surfaces minutes
+into a differential gate.  This pass extracts the C++ side (regex, no
+compiler) and the Python side (AST, no import) and diffs them.
+
+The contract table below is the registry.  To add a new device-span
+family's constants: add (cpp_name, [(py_module, py_name), ..]) rows —
+the checker fails on missing names on either side, so a half-registered
+twin cannot pass silently.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shadow_tpu.analysis import cpp_extract, py_extract
+from shadow_tpu.analysis.report import Violation
+
+CPP = "native/netplane.cpp"
+
+_CONN = "shadow_tpu/tcp/connection.py"
+_TCPS = "shadow_tpu/ops/tcp_span.py"
+_PHLD = "shadow_tpu/ops/phold_span.py"
+_CODEL = "shadow_tpu/net/codel.py"
+_BUCKET = "shadow_tpu/net/token_bucket.py"
+_STATUS = "shadow_tpu/host/status.py"
+_PACKET = "shadow_tpu/net/packet.py"
+_STCP = "shadow_tpu/host/socket_tcp.py"
+_SUDP = "shadow_tpu/host/socket_udp.py"
+_RNG = "shadow_tpu/core/rng.py"
+_PLANE = "shadow_tpu/native/plane.py"
+
+# cpp_name -> [(python module, python name)]
+CONTRACTS = [
+    # TCP engine constants (connection.py is the object twin, tcp_span
+    # the SoA kernel twin)
+    ("MSS", [(_CONN, "MSS"), (_TCPS, "MSS")]),
+    ("MAX_WINDOW", [(_CONN, "MAX_WINDOW"), (_TCPS, "MAX_WINDOW")]),
+    ("WMEM_MAX", [(_CONN, "WMEM_MAX"), (_TCPS, "WMEM_MAX")]),
+    ("RMEM_MAX", [(_CONN, "RMEM_MAX"), (_TCPS, "RMEM_MAX")]),
+    ("RMEM_CEILING", [(_CONN, "RMEM_CEILING")]),
+    ("MAX_SACK_BLOCKS", [(_CONN, "MAX_SACK_BLOCKS")]),
+    ("INIT_RTO_NS", [(_CONN, "INIT_RTO_NS")]),
+    ("MIN_RTO_NS", [(_CONN, "MIN_RTO_NS"), (_TCPS, "MIN_RTO_NS")]),
+    ("MAX_RTO_NS", [(_CONN, "MAX_RTO_NS"), (_TCPS, "MAX_RTO_NS")]),
+    ("TIME_WAIT_NS", [(_CONN, "TIME_WAIT_NS")]),
+    ("DUPACK_THRESHOLD", [(_CONN, "DUPACK_THRESHOLD")]),
+    ("DELACK_NS", [(_CONN, "DELACK_NS"), (_TCPS, "DELACK_NS")]),
+    # TCP states (netplane enum ST_* mirrors connection.py's module
+    # constants by order)
+    ("ST_CLOSED", [(_CONN, "CLOSED")]),
+    ("ST_LISTEN", [(_CONN, "LISTEN")]),
+    ("ST_SYN_SENT", [(_CONN, "SYN_SENT")]),
+    ("ST_SYN_RECEIVED", [(_CONN, "SYN_RECEIVED")]),
+    ("ST_ESTABLISHED", [(_CONN, "ESTABLISHED")]),
+    ("ST_FIN_WAIT_1", [(_CONN, "FIN_WAIT_1")]),
+    ("ST_FIN_WAIT_2", [(_CONN, "FIN_WAIT_2")]),
+    ("ST_CLOSING", [(_CONN, "CLOSING")]),
+    ("ST_TIME_WAIT", [(_CONN, "TIME_WAIT")]),
+    ("ST_CLOSE_WAIT", [(_CONN, "CLOSE_WAIT")]),
+    ("ST_LAST_ACK", [(_CONN, "LAST_ACK")]),
+    # TCP header flags
+    ("F_FIN", [(_PACKET, "TcpFlags.FIN"), (_TCPS, "F_FIN")]),
+    ("F_SYN", [(_PACKET, "TcpFlags.SYN"), (_TCPS, "F_SYN")]),
+    ("F_RST", [(_PACKET, "TcpFlags.RST"), (_TCPS, "F_RST")]),
+    ("F_PSH", [(_PACKET, "TcpFlags.PSH"), (_TCPS, "F_PSH")]),
+    ("F_ACK", [(_PACKET, "TcpFlags.ACK"), (_TCPS, "F_ACK")]),
+    # wire-size constants
+    ("PROTO_TCP", [(_PACKET, "PROTO_TCP")]),
+    ("PROTO_UDP", [(_PACKET, "PROTO_UDP")]),
+    ("MTU", [(_PACKET, "MTU"), (_TCPS, "MTU"), (_PHLD, "MTU")]),
+    ("IPV4_HDR", [(_PACKET, "IPV4_HEADER_SIZE")]),
+    ("UDP_HDR", [(_PACKET, "UDP_HEADER_SIZE")]),
+    ("TCP_HDR", [(_PACKET, "TCP_HEADER_SIZE")]),
+    # CoDel / token bucket (router twins)
+    ("CODEL_TARGET_NS", [(_CODEL, "TARGET_NS"),
+                         (_TCPS, "CODEL_TARGET_NS"),
+                         (_PHLD, "CODEL_TARGET_NS")]),
+    ("CODEL_INTERVAL_NS", [(_CODEL, "INTERVAL_NS")]),
+    ("CODEL_HARD_LIMIT", [(_CODEL, "HARD_LIMIT"),
+                          (_TCPS, "CODEL_HARD_LIMIT"),
+                          (_PHLD, "CODEL_HARD_LIMIT")]),
+    ("REFILL_INTERVAL_NS", [(_BUCKET, "REFILL_INTERVAL_NS"),
+                            (_TCPS, "REFILL_NS"), (_PHLD, "REFILL_NS")]),
+    # ephemeral port range
+    ("EPHEMERAL_LO", [(_STCP, "EPHEMERAL_LO"), (_SUDP, "EPHEMERAL_LO")]),
+    ("EPHEMERAL_HI", [(_STCP, "EPHEMERAL_HI"), (_SUDP, "EPHEMERAL_HI")]),
+    # status bits
+    ("S_ACTIVE", [(_STATUS, "S_ACTIVE")]),
+    ("S_READABLE", [(_STATUS, "S_READABLE"), (_TCPS, "S_READABLE"),
+                    (_PHLD, "S_READABLE")]),
+    ("S_WRITABLE", [(_STATUS, "S_WRITABLE"), (_TCPS, "S_WRITABLE"),
+                    (_PHLD, "S_WRITABLE")]),
+    ("S_CLOSED", [(_STATUS, "S_CLOSED")]),
+    # timer-heap entry kinds
+    ("TK_RELAY", [(_TCPS, "TK_RELAY"), (_PHLD, "TK_RELAY")]),
+    ("TK_TCP", [(_TCPS, "TK_TCP")]),
+    ("TK_APP", [(_TCPS, "TK_APP"), (_PHLD, "TK_APP")]),
+    ("TK_APP_TIMEOUT", [(_PHLD, "TK_APP_TIMEOUT")]),
+    # engine-app syscall slots
+    ("ASYS_SEND", [(_TCPS, "ASYS_SEND")]),
+    ("ASYS_RECV", [(_TCPS, "ASYS_RECV")]),
+    ("ASYS_SENDTO", [(_PHLD, "ASYS_SENDTO")]),
+    ("ASYS_RECVFROM", [(_PHLD, "ASYS_RECVFROM")]),
+    ("ASYS_NANOSLEEP", [(_PHLD, "ASYS_NANOSLEEP")]),
+    ("ASYS_N", [(_TCPS, "ASYS_N"), (_PHLD, "ASYS_N")]),
+    # trace record kinds
+    ("TRACE_SND", [(_TCPS, "TR_SND"), (_PHLD, "TR_SND")]),
+    ("TRACE_DRP", [(_TCPS, "TR_DRP"), (_PHLD, "TR_DRP")]),
+    ("TRACE_RCV", [(_TCPS, "TR_RCV"), (_PHLD, "TR_RCV")]),
+    # threefry parity word + engine park sentinel
+    ("TF_PARITY", [(_RNG, "_PARITY")]),
+    ("R_BLOCK", [(_PLANE, "R_BLOCK")]),
+]
+
+# C++ int arrays <-> Python tuples (threefry rotation schedules)
+ARRAY_CONTRACTS = [
+    ("rot_a", _RNG, "_ROT_A"),
+    ("rot_b", _RNG, "_ROT_B"),
+]
+
+# Python RSN_* codes <-> index into the C++ REASONS string table
+REASON_CONTRACTS = [
+    (_TCPS, "RSN_CODEL", "codel"),
+    (_TCPS, "RSN_RTRLIMIT", "rtr-limit"),
+    (_TCPS, "RSN_LOSS", "inet-loss"),
+    (_TCPS, "RSN_UNREACH", "unreachable"),
+    (_PHLD, "RSN_NONE", ""),
+    (_PHLD, "RSN_RCVBUF", "rcvbuf-full"),
+    (_PHLD, "RSN_NOSOCK", "no-socket"),
+    (_PHLD, "RSN_NOROUTE", "no-route"),
+    (_PHLD, "RSN_LOSS", "inet-loss"),
+    (_PHLD, "RSN_UNREACH", "unreachable"),
+]
+
+# Python constants derived from several C++ constants
+DERIVED_CONTRACTS = [
+    (_TCPS, "TCP_TOTAL_HDR",
+     lambda C, P: C["IPV4_HDR"] + C["TCP_HDR"], "IPV4_HDR + TCP_HDR"),
+    (_PHLD, "PKT_SIZE",
+     lambda C, P: P["PAYLOAD_LEN"] + C["UDP_HDR"] + C["IPV4_HDR"],
+     "PAYLOAD_LEN + UDP_HDR + IPV4_HDR"),
+]
+
+
+def check(repo_root: str, cpp_text: str | None = None) -> list:
+    """Diff the C++ constants against every registered Python twin."""
+    if cpp_text is None:
+        with open(os.path.join(repo_root, CPP)) as fh:
+            cpp_text = fh.read()
+    consts = cpp_extract.extract_constants(cpp_text)
+    arrays = cpp_extract.extract_int_arrays(cpp_text)
+    strings = cpp_extract.extract_string_arrays(cpp_text)
+
+    violations: list[Violation] = []
+    py_cache: dict = {}
+
+    def py_consts(mod):
+        if mod not in py_cache:
+            py_cache[mod] = py_extract.extract_constants(
+                os.path.join(repo_root, mod))
+        return py_cache[mod]
+
+    for cpp_name, twins in CONTRACTS:
+        if cpp_name not in consts:
+            violations.append(Violation(
+                "twin-constant", CPP,
+                f"C++ constant {cpp_name} not found by the extractor "
+                f"(renamed or removed? update analysis/twin_constants.py)"))
+            continue
+        for mod, py_name in twins:
+            pv = py_consts(mod).get(py_name)
+            if pv is None:
+                violations.append(Violation(
+                    "twin-constant", mod,
+                    f"missing twin {py_name} for C++ {cpp_name}"))
+            elif pv != consts[cpp_name]:
+                violations.append(Violation(
+                    "twin-constant", mod,
+                    f"{py_name} = {pv} but C++ {cpp_name} = "
+                    f"{consts[cpp_name]}"))
+
+    for cpp_name, mod, py_name in ARRAY_CONTRACTS:
+        cv = arrays.get(cpp_name)
+        pv = py_consts(mod).get(py_name)
+        if cv is None:
+            violations.append(Violation(
+                "twin-constant", CPP, f"C++ array {cpp_name} not found"))
+        elif pv is None:
+            violations.append(Violation(
+                "twin-constant", mod,
+                f"missing twin {py_name} for C++ array {cpp_name}"))
+        elif tuple(pv) != cv:
+            violations.append(Violation(
+                "twin-constant", mod,
+                f"{py_name} = {pv} but C++ {cpp_name} = {cv}"))
+
+    # REASONS tables: every definition must agree, and each Python
+    # RSN_* code must index its reason string
+    reasons = strings.get("REASONS", [])
+    if not reasons:
+        violations.append(Violation(
+            "twin-constant", CPP, "C++ REASONS table not found"))
+    else:
+        if any(r != reasons[0] for r in reasons[1:]):
+            violations.append(Violation(
+                "twin-constant", CPP,
+                "the span_import REASONS tables disagree with each other"))
+        table = reasons[0]
+        for mod, py_name, reason in REASON_CONTRACTS:
+            pv = py_consts(mod).get(py_name)
+            if pv is None:
+                violations.append(Violation(
+                    "twin-constant", mod,
+                    f"missing reason code {py_name}"))
+                continue
+            if reason not in table:
+                violations.append(Violation(
+                    "twin-constant", CPP,
+                    f"reason string {reason!r} (for {py_name}) not in "
+                    f"REASONS"))
+            elif table.index(reason) != pv:
+                violations.append(Violation(
+                    "twin-constant", mod,
+                    f"{py_name} = {pv} but C++ REASONS[{py_name}] is at "
+                    f"index {table.index(reason)}"))
+
+    # ASYS_NAMES order must mirror the ASYS_* enum
+    asys_names = strings.get("ASYS_NAMES", [])
+    if asys_names:
+        table = asys_names[0]
+        for name, val in consts.items():
+            if name.startswith("ASYS_") and name != "ASYS_N":
+                want = name[len("ASYS_"):].lower()
+                if val >= len(table) or table[val] != want:
+                    violations.append(Violation(
+                        "twin-constant", CPP,
+                        f"ASYS_NAMES[{val}] != {want!r} for enum {name}"))
+
+    for mod, py_name, fn, desc in DERIVED_CONTRACTS:
+        pv = py_consts(mod).get(py_name)
+        try:
+            want = fn(consts, py_consts(mod))
+        except KeyError as exc:
+            violations.append(Violation(
+                "twin-constant", CPP,
+                f"derived contract {py_name}: missing input {exc}"))
+            continue
+        if pv is None:
+            violations.append(Violation(
+                "twin-constant", mod, f"missing derived twin {py_name}"))
+        elif pv != want:
+            violations.append(Violation(
+                "twin-constant", mod,
+                f"{py_name} = {pv} but {desc} = {want}"))
+
+    return violations

@@ -489,9 +489,9 @@ class Manager:
             # each host's event execution.  Serial-only measurement keeps
             # the numbers meaningful (threads share the GIL).
             for h in self.hosts:
-                t0 = time.perf_counter_ns()
+                t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] perf diagnostics only
                 h.execute(until)
-                h.perf_exec_ns += time.perf_counter_ns() - t0
+                h.perf_exec_ns += time.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] perf diagnostics only
             return
         if self._pool is None:
             if self.plane is not None:
@@ -554,7 +554,7 @@ class Manager:
         progress = self.config.general.progress
         heartbeat = self.config.general.heartbeat_interval_ns
         next_heartbeat = heartbeat
-        wall_start = time.perf_counter()
+        wall_start = time.perf_counter()  # shadow-lint: allow[wall-clock] heartbeat/progress display
         status = None
         heartbeat_lines = progress
         from shadow_tpu.utils.shadow_log import LOG
@@ -688,7 +688,7 @@ class Manager:
                                             sys.stderr)
                         next_heartbeat = busy_end + heartbeat
                     if status is not None:
-                        wall = time.perf_counter()
+                        wall = time.perf_counter()  # shadow-lint: allow[wall-clock] status-bar redraw throttle
                         if wall >= next_status_wall:
                             status.update(busy_end)
                             next_status_wall = wall + status_throttle
@@ -713,12 +713,12 @@ class Manager:
                         # XLA compile (tens of seconds on a slow
                         # backend), so only long runs earn it — the
                         # same 1%-of-wall budget the route model uses.
-                        elapsed = time.perf_counter() - wall_start
+                        elapsed = time.perf_counter() - wall_start  # shadow-lint: allow[wall-clock] device-probe budget; both routes byte-identical
                         use_dev = (dev_probe_countdown <= 0
                                    and elapsed * 0.01 >= 5.0)
                 dev_retry_soon = False
                 if use_dev:
-                    t0 = time.perf_counter_ns()
+                    t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                     res, runner = self._device_span(start, stop, limit,
                                                     max_rounds)
                     if res is not None and res[0] == 0:
@@ -733,7 +733,7 @@ class Manager:
                             # and re-measure warm on the next attempt.
                             dev_probe_countdown = 0
                         else:
-                            dt = time.perf_counter_ns() - t0
+                            dt = time.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                             per = dt / max(res[0], 1)
                             dev_ns_round = per if dev_ns_round is None \
                                 else 0.7 * dev_ns_round + 0.3 * per
@@ -762,7 +762,7 @@ class Manager:
                 elif dev_span_on:
                     dev_probe_countdown -= 1
 
-                t0 = time.perf_counter_ns()
+                t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                 res = self.plane.engine.run_span(
                     start, stop, limit, self.runahead.get(),
                     int(self.runahead.dynamic),
@@ -774,7 +774,7 @@ class Manager:
                 else:
                     rounds = res[0]
                     if rounds:
-                        per = (time.perf_counter_ns() - t0) / rounds
+                        per = (time.perf_counter_ns() - t0) / rounds  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                         cpp_ns_round = per if cpp_ns_round is None \
                             else 0.7 * cpp_ns_round + 0.3 * per
                         start = account_span(res)
@@ -793,7 +793,7 @@ class Manager:
                 self._log_heartbeat(window_end, stop, wall_start, sys.stderr)
                 next_heartbeat = window_end + heartbeat
             if status is not None:
-                wall = time.perf_counter()
+                wall = time.perf_counter()  # shadow-lint: allow[wall-clock] status-bar redraw throttle
                 if wall >= next_status_wall:  # throttle redraws
                     status.update(window_end)
                     next_status_wall = wall + status_throttle
@@ -928,7 +928,7 @@ class Manager:
         """Progress + resource heartbeat (manager.rs:679-721; the format
         is load-bearing for tornettools-style downstream parsing in the
         reference, so keep it stable once published)."""
-        wall = time.perf_counter() - wall_start
+        wall = time.perf_counter() - wall_start  # shadow-lint: allow[wall-clock] heartbeat wall-time display
         pct = 100.0 * sim_now / stop if stop else 100.0
         for h in self.hosts:
             h.merge_native_counters()

@@ -73,6 +73,7 @@ def threefry2x32_jax(k0, k1, c0, c1):
 STREAM_PACKET_LOSS = 1
 STREAM_HOST = 2  # per-host general-purpose stream (ports, auxv, jitter)
 STREAM_JITTER = 3
+STREAM_EXAMPLE_BATCH = 101  # synthetic dry-run inputs (parallel/round_step)
 
 
 def mix_key(seed: int, stream: int):

@@ -145,10 +145,10 @@ class MemoryManager:
     def read(self, addr: int, n: int) -> bytes:
         if n <= 0:
             return b""
-        t0 = _walltime.perf_counter_ns()
+        t0 = _walltime.perf_counter_ns()  # shadow-lint: allow[wall-clock] memcopy perf counters
         data = os.pread(self._fd, n, addr)
         cls = MemoryManager
-        cls.total_read_ns += _walltime.perf_counter_ns() - t0
+        cls.total_read_ns += _walltime.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] memcopy perf counters
         cls.total_read_bytes += len(data)
         cls.total_calls += 1
         if len(data) != n:
@@ -164,10 +164,10 @@ class MemoryManager:
     def write(self, addr: int, data: bytes) -> None:
         if not data:
             return
-        t0 = _walltime.perf_counter_ns()
+        t0 = _walltime.perf_counter_ns()  # shadow-lint: allow[wall-clock] memcopy perf counters
         r = os.pwrite(self._fd, data, addr)
         cls = MemoryManager
-        cls.total_write_ns += _walltime.perf_counter_ns() - t0
+        cls.total_write_ns += _walltime.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] memcopy perf counters
         cls.total_write_bytes += len(data)
         cls.total_calls += 1
         if r != len(data):
@@ -907,8 +907,8 @@ class ManagedThread:
             self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
             if _pidfd_wait(self.process.native_pid, 0, 10.0) is None:
                 # No pidfd support: fall back to the timed slice poll.
-                deadline = _walltime.monotonic() + 10.0
-                while _walltime.monotonic() < deadline:
+                deadline = _walltime.monotonic() + 10.0  # shadow-lint: allow[wall-clock] real-OS process-death wait
+                while _walltime.monotonic() < deadline:  # shadow-lint: allow[wall-clock] real-OS process-death wait
                     if self._poll_death(host):
                         return False
                     _walltime.sleep(0.001)
@@ -1320,8 +1320,8 @@ class ManagedThread:
         # waits with /proc state checks instead of busy-polling.
         path = (f"/proc/{self.process.native_pid}/task/"
                 f"{self.native_tid}/stat")
-        deadline = _walltime.monotonic() + 5.0
-        while _walltime.monotonic() < deadline:
+        deadline = _walltime.monotonic() + 5.0  # shadow-lint: allow[wall-clock] real-OS thread-death wait
+        while _walltime.monotonic() < deadline:  # shadow-lint: allow[wall-clock] real-OS thread-death wait
             try:
                 with open(path) as f:
                     stat = f.read()

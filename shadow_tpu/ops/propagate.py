@@ -135,7 +135,7 @@ class DeviceRouteModel:
     def __init__(self, min_device_batch: int, kind: str = "single"):
         import time as _time
         self.min_device_batch = min_device_batch
-        self._t_start_ns = _time.perf_counter_ns()
+        self._t_start_ns = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
         self.probe_spent_ns = 0.0
         # Dispatch kind for the process-wide floor: a sharded SPMD
         # step's time (all_to_all included) is not comparable to a
@@ -316,7 +316,7 @@ class DeviceRouteModel:
         platform yet) counts as free: the first probe must happen or
         the model can never learn."""
         import time as _time
-        elapsed = _time.perf_counter_ns() - self._t_start_ns
+        elapsed = _time.perf_counter_ns() - self._t_start_ns  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
         budget = elapsed * self.PROBE_BUDGET_FRAC
         return self.probe_spent_ns + (expected_ns or 0.0) <= budget
 
@@ -528,7 +528,7 @@ class TpuPropagator:
         eng = self.engine
         b = _bucket(n)
         self._last_engine_n = n
-        t0 = _time.perf_counter_ns()
+        t0 = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
         route = self.route.decide(n, b)
         if route == ROUTE_DEVICE and self._probe_pending:
             # An in-flight probe shares the device/tunnel: a critical-
@@ -538,7 +538,7 @@ class TpuPropagator:
             route = ROUTE_HOST
         if route == ROUTE_DEVICE:
             md, ml, exports = self._engine_device_round(n, b)
-            self.route.record_device(b, _time.perf_counter_ns() - t0, n)
+            self.route.record_device(b, _time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
             self.rounds_device += 1
             self.packets_device += n
         else:
@@ -557,7 +557,7 @@ class TpuPropagator:
                      np.frombuffer(ts_b, np.int64),
                      np.frombuffer(ctl_b, np.bool_)), n, b)
             _nf, md, ml, exports = eng.finish_round(self.window_end)
-            self.route.record_host(_time.perf_counter_ns() - t0, n)
+            self.route.record_host(_time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
         self.rounds_dispatched += 1
         if exports is not None:
             self._deliver_exports(exports)
@@ -597,13 +597,13 @@ class TpuPropagator:
                 padded = [pad(c) for c in cols]
                 valid = np.concatenate([np.ones(n, bool),
                                         np.zeros(b - n, bool)])
-                t0 = _time.perf_counter_ns()
+                t0 = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                 out = kernel(*padded, valid, jnp.int64(window_end),
                              jnp.int64(bootstrap_end))
                 jax.block_until_ready(out)
                 # record_device debits the probe budget (compiles and
                 # losing dispatches both count as measurement spend).
-                route.record_device(b, _time.perf_counter_ns() - t0, n)
+                route.record_device(b, _time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                 self.probes_async += 1
             except Exception:
                 pass  # a failed probe just leaves the bucket unmeasured
@@ -672,14 +672,14 @@ class TpuPropagator:
 
         n = hi - lo
         b = _bucket(n)
-        t0 = _time.perf_counter_ns()
+        t0 = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
         route = self.route.decide(n, b)
         if route == ROUTE_DEVICE and self._probe_pending:
             route = ROUTE_HOST  # don't serialize behind the probe
         if route == ROUTE_DEVICE:
             deliver, keep, reachable, lossy, min_deliver, min_latency = \
                 self._compute_device(lo, hi, b)
-            self.route.record_device(b, _time.perf_counter_ns() - t0, n)
+            self.route.record_device(b, _time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
             self.rounds_device += 1
             self.packets_device += n
         else:
@@ -688,7 +688,7 @@ class TpuPropagator:
                 self._submit_probe((sn, dn, sh, ps, ts, ctl), n, b)
             deliver, keep, reachable, lossy, min_deliver, min_latency = \
                 self._compute_host(lo, hi)
-            self.route.record_host(_time.perf_counter_ns() - t0, n)
+            self.route.record_host(_time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
         self.rounds_dispatched += 1
 
         # Scatter (outbox order => per-source event order is preserved).

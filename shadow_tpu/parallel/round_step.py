@@ -25,7 +25,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from shadow_tpu.core.rng import threefry2x32_jax
+from shadow_tpu.core.rng import (STREAM_EXAMPLE_BATCH, mix_key,
+                                 threefry2x32_jax, threefry2x32_np)
 from shadow_tpu.core.simtime import TIME_NEVER
 
 _I64_MAX = (1 << 63) - 1
@@ -143,20 +144,31 @@ def build_sharded_round_step(mesh, latency_ns: np.ndarray,
     return jax.jit(fn)
 
 
+def _counter_ints(seed: int, field: int, shape, hi: int) -> np.ndarray:
+    """Deterministic integers in [0, hi): counter-based threefry keyed
+    by (seed, field, flat index) — same shared-RNG family the
+    simulation uses, no sequential draw-order dependence."""
+    k0, k1 = mix_key(seed, STREAM_EXAMPLE_BATCH)
+    n = int(np.prod(shape))
+    b0, _ = threefry2x32_np(np.uint32(k0), np.uint32(k1),
+                            np.arange(n, dtype=np.uint32),
+                            np.uint32(field))
+    return (b0.astype(np.uint64) % np.uint64(hi)).reshape(shape)
+
+
 def make_example_batch(n_shards: int, hosts_per_shard: int,
                        batch_per_shard: int, num_nodes: int, seed: int = 0):
     """Tiny synthetic per-shard packet batches for dry-runs/tests."""
-    rng = np.random.RandomState(seed)
     S, B, H = n_shards, batch_per_shard, hosts_per_shard
     total_hosts = S * H
-    src_host = rng.randint(0, total_hosts, size=(S, B)).astype(np.int64)
-    dst_host = rng.randint(0, total_hosts, size=(S, B)).astype(np.int64)
+    src_host = _counter_ints(seed, 0, (S, B), total_hosts).astype(np.int64)
+    dst_host = _counter_ints(seed, 1, (S, B), total_hosts).astype(np.int64)
     return {
         "src_node": (src_host % num_nodes).astype(np.int32),
         "dst_node": (dst_host % num_nodes).astype(np.int32),
         "dst_shard": (dst_host // H).astype(np.int32),
         "src_host": src_host,
-        "pkt_seq": rng.randint(0, 1 << 31, size=(S, B)).astype(np.uint32),
+        "pkt_seq": _counter_ints(seed, 2, (S, B), 1 << 31).astype(np.uint32),
         "t_send": np.full((S, B), 1_000_000_000, dtype=np.int64),
         "is_ctl": np.zeros((S, B), dtype=bool),
         "valid": np.ones((S, B), dtype=bool),

@@ -1,0 +1,66 @@
+"""Twin-contract & determinism lint CLI.
+
+    python -m shadow_tpu.tools.lint [--pass twin,layout,det] [--json]
+
+Runs the shadow_tpu/analysis/ passes (docs/LINT.md) and exits non-zero
+on any violation.  Pure parsing — no JAX, no engine import — so it is
+cheap enough to gate every test run and benchmark recording.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+PASSES = ("twin", "layout", "det")
+
+
+def repo_root() -> str:
+    """shadow_tpu/tools/lint.py -> the repo checkout root."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run(passes=PASSES, root: str | None = None):
+    from shadow_tpu.analysis import run_all
+
+    return run_all(root or repo_root(), passes=passes)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shadow_tpu.tools.lint", description=__doc__)
+    ap.add_argument("--pass", dest="passes", default=",".join(PASSES),
+                    help="comma-separated subset of: twin,layout,det")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    bad = [p for p in passes if p not in PASSES]
+    if bad:
+        print(f"unknown pass(es): {', '.join(bad)}", file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()  # shadow-lint: allow[wall-clock] CLI timing
+    violations, counts = run(passes)
+    dt = time.perf_counter() - t0  # shadow-lint: allow[wall-clock] CLI timing
+
+    if args.json:
+        print(json.dumps({
+            "violations": [vars(v) for v in violations],
+            "counts": counts,
+            "seconds": round(dt, 3),
+        }))
+    else:
+        from shadow_tpu.analysis import format_report
+        print(format_report(violations, counts))
+        print(f"({', '.join(passes)} in {dt:.2f}s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

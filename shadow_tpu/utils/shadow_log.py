@@ -35,7 +35,7 @@ class ShadowLogger:
         self.flush_every = flush_every
         self._buf: list[str] = []
         self._warned: set[str] = set()
-        self._t0 = _walltime.monotonic()
+        self._t0 = _walltime.monotonic()  # shadow-lint: allow[wall-clock] log timestamps only
 
     def set_level(self, level: str) -> None:
         self.level = _LEVELS.get(level, 2)
@@ -48,7 +48,7 @@ class ShadowLogger:
         lvl = _LEVELS.get(level, 2)
         if lvl > self.level:
             return
-        wall = _walltime.monotonic() - self._t0
+        wall = _walltime.monotonic() - self._t0  # shadow-lint: allow[wall-clock] log timestamps only
         ctx = f" [{host}]" if host else ""
         self._buf.append(f"{wall:09.6f} [{level}] {_fmt_sim(sim_ns)}"
                          f"{ctx} {msg}\n")

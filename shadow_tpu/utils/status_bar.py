@@ -18,10 +18,10 @@ class StatusPrinter:
     def __init__(self, stop_time_ns: int, out=None):
         self.stop = max(stop_time_ns, 1)
         self.out = out if out is not None else sys.stderr
-        self.wall_start = time.perf_counter()
+        self.wall_start = time.perf_counter()  # shadow-lint: allow[wall-clock] display only
 
     def update(self, sim_now_ns: int) -> None:
-        wall = time.perf_counter() - self.wall_start
+        wall = time.perf_counter() - self.wall_start  # shadow-lint: allow[wall-clock] display only
         pct = 100.0 * sim_now_ns / self.stop
         rate = (sim_now_ns / 1e9) / wall if wall > 0 else 0.0
         print(f"[shadow-tpu] {pct:5.1f}% — simulated {sim_now_ns / 1e9:.3f}s "
@@ -38,7 +38,7 @@ class StatusBar(StatusPrinter):
     WIDTH = 30
 
     def update(self, sim_now_ns: int) -> None:
-        wall = time.perf_counter() - self.wall_start
+        wall = time.perf_counter() - self.wall_start  # shadow-lint: allow[wall-clock] display only
         frac = min(sim_now_ns / self.stop, 1.0)
         filled = int(frac * self.WIDTH)
         bar = "=" * filled + ">" + " " * (self.WIDTH - filled)

@@ -1,0 +1,84 @@
+"""Mutation self-test: the twin-contract gate actually bites.
+
+Perturbs one twin constant and one SoA column (in-memory, via the
+extractor API's cpp_text injection — the tree is never touched) and
+asserts the corresponding pass fails.  A lint gate that cannot detect
+an injected drift is worse than none: it certifies clean trees it
+never checked.
+"""
+
+import os
+
+import pytest
+
+from shadow_tpu.analysis import soa_layout, twin_constants
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def cpp_text():
+    with open(os.path.join(ROOT, "native", "netplane.cpp")) as fh:
+        return fh.read()
+
+
+def _mutate(text: str, old: str, new: str) -> str:
+    assert text.count(old) == 1, f"mutation anchor not unique: {old!r}"
+    return text.replace(old, new)
+
+
+def test_constant_value_drift_is_caught(cpp_text):
+    mutated = _mutate(cpp_text, "constexpr int MSS = 1460;",
+                      "constexpr int MSS = 1461;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("MSS" in x.message and "1461" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_constant_removal_is_caught(cpp_text):
+    mutated = _mutate(cpp_text, "constexpr int64_t DELACK_NS",
+                      "constexpr int64_t DELACK2_NS")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any(x.message.startswith("C++ constant DELACK_NS")
+               for x in v), [x.render() for x in v]
+
+
+def test_enum_reorder_is_caught(cpp_text):
+    # swapping two TCP states shifts every later enum value
+    mutated = _mutate(cpp_text, "ST_ESTABLISHED,\n  ST_FIN_WAIT_1",
+                      "ST_FIN_WAIT_1,\n  ST_ESTABLISHED")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("ESTABLISHED" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_column_rename_is_caught(cpp_text):
+    mutated = _mutate(cpp_text, 'put("c_cwnd", bytes_vec(c_cwnd));',
+                      'put("c_cwndx", bytes_vec(c_cwnd));')
+    v = soa_layout.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    # both directions fire: a dead exported column and a phantom read
+    assert any("'c_cwndx'" in m and "never consumed" in m for m in msgs), msgs
+    assert any("'c_cwnd'" in m and "never exports" in m for m in msgs), msgs
+
+
+def test_column_dtype_drift_is_caught(cpp_text):
+    mutated = _mutate(cpp_text,
+                      "std::vector<int64_t> cq_enq(H * C, 0);",
+                      "std::vector<int32_t> cq_enq(H * C, 0);")
+    v = soa_layout.check(ROOT, cpp_text=mutated)
+    assert any("'cq_enq'" in x.message and "int32" in x.message
+               for x in v), [x.render() for x in v]
+
+
+def test_import_column_loss_is_caught(cpp_text):
+    # import stops reading a column the codec produces
+    mutated = _mutate(
+        cpp_text,
+        'const int64_t *c_cwnd = col<int64_t>(d, "c_cwnd", CC, &ok);',
+        'const int64_t *c_cwnd = col<int64_t>(d, "c_cwndx", CC, &ok);')
+    v = soa_layout.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("'c_cwndx'" in m and "never produces" in m for m in msgs), msgs
