@@ -1481,6 +1481,13 @@ thread_local std::vector<RoundOut> *tl_round_outbox = nullptr;
 struct Engine {
   PacketStore store;
   std::vector<std::unique_ptr<HostPlane>> hosts;
+  /* Host-state mutation epoch: every Python entry point that can
+   * change simulation state increments it.  The device-span runners
+   * key their resident (on-device) state on it — a span may reuse
+   * last import's arrays without re-export only while the epoch is
+   * unchanged; any other engine call makes the resident copy stale
+   * and forces a fresh export (ops/phold_span.py try_span). */
+  uint64_t state_epoch = 0;
   StableVec<std::unique_ptr<SocketN>> socks;  // token -> socket
   StableVec<AppN> apps;                       // engine-resident apps
   int dbg_port = -1;  // SHADOWTPU_TCPDBG, resolved once at construction
@@ -3107,17 +3114,28 @@ struct Engine {
     int64_t next_start;       // next global min event time (or never)
     int64_t busy_end = 0;     // window_end of the last completed round
     int64_t runahead;         // final (dynamically lowered) width
+    /* engine->object-path deliveries produced by the LAST completed
+     * round (mixed sims): the span stops there and the caller
+     * delivers them Python-side at their recorded times. */
+    std::vector<std::array<int64_t, 5>> exports;
   };
 
   bool span_eligible() {
-    /* EVERY slot of the shared snapshot must be an engine host: a
-     * mixed sim (object-path hosts) would make run_span touch null
-     * hosts and silently drop engine->object exports. */
-    if ((Py_ssize_t)hosts.size() != (Py_ssize_t)nt_len) return false;
-    for (auto &up : hosts) {
-      HostPlane *hp = up.get();
-      if (hp == nullptr || hp->has_py_socks || !hp->rng_native)
-        return false;
+    /* Every ENGINE host in the shared snapshot must be callback-free
+     * (no Python-owned sockets, native rng).  Slots WITHOUT an engine
+     * host (object path: pcap capture, strace, the CPU model) are
+     * tolerated as long as the caller's py-work flags cover them:
+     * run_span stops before any window touches a flagged host, and an
+     * engine->object export ends the span at the producing round so
+     * the manager can deliver it Python-side (span_exports below) —
+     * nothing is silently dropped. */
+    for (int64_t i = 0; i < nt_len; i++) {
+      HostPlane *hp = plane((int)i);
+      if (hp == nullptr) {
+        if (pw == nullptr || i >= pw_len || !pw[i]) return false;
+        continue;
+      }
+      if (hp->has_py_socks || !hp->rng_native) return false;
     }
     return true;
   }
@@ -3149,15 +3167,21 @@ struct Engine {
       }
       ids.clear();
       for (int64_t i = 0; i < nt_len; i++)
-        if (nt[i] < window_end) ids.push_back((uint32_t)i);
+        if (nt[i] < window_end && plane((int)i) != nullptr)
+          ids.push_back((uint32_t)i);
       if (devcap_probe) devcap_count_round(ids.data(), (int64_t)ids.size());
       run_hosts_mt(ids.data(), (int64_t)ids.size(), window_end, nthreads);
       FinishResult f = finish_round(window_end);
       r.packets += f.n;
       if (f.n > 0) r.busy_rounds++;
-      /* exports are impossible in a pure span (every destination is a
+      /* In a PURE span exports are impossible (every destination is a
        * plane host); a callback would have required a Python-owned
-       * socket, excluded by span_eligible.  in_error still unwinds. */
+       * socket, excluded by span_eligible.  In a MIXED sim an engine
+       * host can address an object-path host: collect the exports and
+       * END the span at this round boundary — their delivery times
+       * are >= this window_end, so handing them to Python here keeps
+       * the event order identical to the per-round path.  in_error
+       * still unwinds. */
       if (dynamic_runahead && f.min_latency > 0 &&
           f.min_latency < r.runahead)
         r.runahead = f.min_latency;
@@ -3170,6 +3194,10 @@ struct Engine {
         if (nt[i] < best) best = nt[i];
       start = best;
       r.next_start = best;
+      if (!f.exports.empty()) {
+        r.exports = std::move(f.exports);
+        break;
+      }
       if (in_error) break;
       if (best >= limit) break;
     }
@@ -4238,6 +4266,7 @@ PyObject *format_trace_text(const TraceRec &r) {
 }
 
 static PyObject *eng_add_host(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid, qdisc_rr;
   unsigned int ip;
   long long up, down, mtu;
@@ -4266,6 +4295,7 @@ static PyObject *eng_add_host(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_set_callbacks(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   PyObject *ev, *rng;
   if (!PyArg_ParseTuple(args, "OO", &ev, &rng)) return nullptr;
   Py_XINCREF(ev);
@@ -4278,6 +4308,7 @@ static PyObject *eng_set_callbacks(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_set_tracing(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid, flag;
   if (!PyArg_ParseTuple(args, "ip", &hid, &flag)) return nullptr;
   self->eng->plane(hid)->tracing = flag;
@@ -4285,12 +4316,14 @@ static PyObject *eng_set_tracing(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_next_event_seq(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
   return PyLong_FromUnsignedLongLong(self->eng->plane(hid)->event_seq++);
 }
 
 static PyObject *eng_next_packet_seq(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
   return PyLong_FromUnsignedLongLong(self->eng->plane(hid)->packet_seq++);
@@ -4326,6 +4359,7 @@ static PyObject *eng_peek_next(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_run_until(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid, lk, lsrc;
   long long lt, until;
   unsigned long long lseq;
@@ -4338,6 +4372,7 @@ static PyObject *eng_run_until(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_set_host_rng(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   unsigned int k0, k1;
   unsigned long long counter;
@@ -4352,12 +4387,14 @@ static PyObject *eng_set_host_rng(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_rng_next(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
   return PyLong_FromUnsignedLongLong(self->eng->rng_u64(hid));
 }
 
 static PyObject *eng_run_hosts(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   Py_buffer ids;
   long long until;
   if (!PyArg_ParseTuple(args, "y*L", &ids, &until)) return nullptr;
@@ -4707,6 +4744,7 @@ static const T *col(PyObject *d, const char *k, size_t need,
 }
 
 static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* (dict, I, T, R, S, C, P, traces_or_None) -> None.  Overwrites the
    * engine's phold state with the device span's result; trace records
    * append to the owning hosts.  Only called after a CLEAN device
@@ -5587,6 +5625,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* (dict, I, T, CQ, RT, RA, OP, traces_or_None) -> None.  Overwrites
    * the engine's tgen-TCP state with the device span's result.  Only
    * called after a CLEAN device span. */
@@ -5984,6 +6023,7 @@ static PyObject *eng_devcap_counters(EngineObj *self, PyObject *) {
 }
 
 static PyObject *eng_run_span(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* (start, stop, limit, runahead, dynamic, max_rounds, nthreads) ->
    * (rounds, packets, next_start, busy_end, runahead) or None when the
    * simulation is not span-eligible (some host can fire callbacks —
@@ -6003,13 +6043,29 @@ static PyObject *eng_run_span(EngineObj *self, PyObject *args) {
                   nthreads);
   Py_END_ALLOW_THREADS
   CHECK_CB(self);
-  return Py_BuildValue("LLLLLL", (long long)r.rounds,
+  PyObject *exports;
+  if (r.exports.empty()) {
+    exports = Py_None;
+    Py_INCREF(exports);
+  } else {
+    exports = PyList_New((Py_ssize_t)r.exports.size());
+    for (size_t i = 0; i < r.exports.size(); i++) {
+      const auto &x = r.exports[i];
+      PyList_SET_ITEM(exports, (Py_ssize_t)i,
+                      Py_BuildValue("KLKLL", (unsigned long long)x[0],
+                                    (long long)x[1],
+                                    (unsigned long long)x[2],
+                                    (long long)x[3], (long long)x[4]));
+    }
+  }
+  return Py_BuildValue("LLLLLLN", (long long)r.rounds,
                        (long long)r.busy_rounds, (long long)r.packets,
                        (long long)r.next_start, (long long)r.busy_end,
-                       (long long)r.runahead);
+                       (long long)r.runahead, exports);
 }
 
 static PyObject *eng_run_hosts_mt(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* (ids u32[], until, nthreads) -> stop.  Callback-free hosts run on
    * OS threads with the GIL released; the rest run serially under the
    * GIL afterwards.  stop < 0: all done; else an index into `ids`
@@ -6048,6 +6104,7 @@ static PyObject *eng_run_hosts_mt(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_push_inbox(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid, src;
   long long time;
   unsigned long long seq, pkt;
@@ -6058,6 +6115,7 @@ static PyObject *eng_push_inbox(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_set_routing(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* (host_node int32[H], ips uint32[H], lat int64[N*N], thr int64[N*N],
    *  n_nodes, key0, key1, bootstrap_end, time_never) */
   Py_buffer hn, ips, lat, thr;
@@ -6091,6 +6149,7 @@ static PyObject *eng_set_routing(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_set_nt(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   PyObject *arr;
   if (!PyArg_ParseTuple(args, "O", &arr)) return nullptr;
   Engine *e = self->eng;
@@ -6108,6 +6167,7 @@ static PyObject *eng_set_nt(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_set_py_work(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   PyObject *arr;
   if (!PyArg_ParseTuple(args, "O", &arr)) return nullptr;
   Engine *e = self->eng;
@@ -6145,6 +6205,7 @@ static PyObject *finish_result_to_py(Engine::FinishResult &&r) {
 }
 
 static PyObject *eng_finish_round(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   long long window_end;
   if (!PyArg_ParseTuple(args, "L", &window_end)) return nullptr;
   return finish_result_to_py(self->eng->finish_round(window_end));
@@ -6155,6 +6216,7 @@ static PyObject *eng_round_size(EngineObj *self, PyObject *) {
 }
 
 static PyObject *eng_export_round(EngineObj *self, PyObject *) {
+  self->eng->state_epoch++;
   /* Columns for the device kernel: (src_node i32, dst_node i32,
    * dst_host i32, src_host i64, pkt_seq u32, t_send i64, is_ctl u8) as
    * bytes.  dst_host lets the sharded backend compute destination
@@ -6186,6 +6248,7 @@ static PyObject *eng_export_round(EngineObj *self, PyObject *) {
 }
 
 static PyObject *eng_scatter_round(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* Device-path scatter: decisions computed by the jax kernel
    * (bit-identical to finish_round's own math); the engine applies
    * deliveries/drops from the provided arrays. */
@@ -6228,6 +6291,7 @@ static PyObject *eng_scatter_round(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_app_spawn(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid, kind, sat, rat;
   long long a, b, c, d, e, sb, rb, now;
   Py_buffer peers{};
@@ -6273,6 +6337,7 @@ static PyObject *eng_app_status(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_app_kill(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int idx, sig;
   long long now;
   if (!PyArg_ParseTuple(args, "iiL", &idx, &sig, &now)) return nullptr;
@@ -6286,6 +6351,7 @@ static PyObject *eng_app_kill(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_app_stop(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int idx;
   if (!PyArg_ParseTuple(args, "i", &idx)) return nullptr;
   if (idx < 0 || (size_t)idx >= self->eng->apps.size()) {
@@ -6312,6 +6378,7 @@ static PyObject *eng_app_threads(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_advance_clocks(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* End-of-simulation: pin every host's clock to the canonical end
    * instant so teardown emissions timestamp identically across
    * schedulers and planes. */
@@ -6323,6 +6390,7 @@ static PyObject *eng_advance_clocks(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_app_teardown(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int idx;
   long long now;
   if (!PyArg_ParseTuple(args, "iL", &idx, &now)) return nullptr;
@@ -6336,6 +6404,7 @@ static PyObject *eng_app_teardown(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_app_continue(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int idx;
   long long now;
   if (!PyArg_ParseTuple(args, "iL", &idx, &now)) return nullptr;
@@ -6363,6 +6432,7 @@ static PyObject *eng_app_syscalls(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_fire(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   long long now;
   if (!PyArg_ParseTuple(args, "iL", &hid, &now)) return nullptr;
@@ -6372,6 +6442,7 @@ static PyObject *eng_fire(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_deliver(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   unsigned long long id;
   long long now;
@@ -6382,6 +6453,7 @@ static PyObject *eng_deliver(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_take_outgoing(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
   HostPlane *hp = self->eng->plane(hid);
@@ -6401,6 +6473,7 @@ static PyObject *eng_take_outgoing(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_socket(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid, sat, rat;
   long long sb, rb;
   if (!PyArg_ParseTuple(args, "iLLpp", &hid, &sb, &rb, &sat, &rat))
@@ -6410,6 +6483,7 @@ static PyObject *eng_tcp_socket(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_udp_socket(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   long long sb, rb;
   if (!PyArg_ParseTuple(args, "iLL", &hid, &sb, &rb)) return nullptr;
@@ -6418,6 +6492,7 @@ static PyObject *eng_udp_socket(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_sock_bind(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok, ip;
   int port;
   if (!PyArg_ParseTuple(args, "IIi", &tok, &ip, &port)) return nullptr;
@@ -6429,6 +6504,7 @@ static PyObject *eng_sock_bind(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_listen(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   int backlog;
   if (!PyArg_ParseTuple(args, "Ii", &tok, &backlog)) return nullptr;
@@ -6437,6 +6513,7 @@ static PyObject *eng_tcp_listen(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_connect(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok, ip;
   int port;
   long long now;
@@ -6451,6 +6528,7 @@ static PyObject *eng_tcp_connect(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_accept(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   long long now;
   if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
@@ -6462,6 +6540,7 @@ static PyObject *eng_tcp_accept(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_sendto(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   Py_buffer data;
   long long now;
@@ -6477,6 +6556,7 @@ static PyObject *eng_tcp_sendto(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_recv(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   long long bufsize, now;
   int peek;
@@ -6493,6 +6573,7 @@ static PyObject *eng_tcp_recv(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_shutdown(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   long long now;
   if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
@@ -6504,6 +6585,7 @@ static PyObject *eng_tcp_shutdown(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_sock_close(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   long long now;
   if (!PyArg_ParseTuple(args, "IL", &tok, &now)) return nullptr;
@@ -6520,6 +6602,7 @@ static PyObject *eng_sock_close(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_udp_sendto(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok, dst_ip;
   Py_buffer data;
   int has_dst, dst_port;
@@ -6539,6 +6622,7 @@ static PyObject *eng_udp_sendto(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_udp_recvfrom(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   long long bufsize;
   int peek;
@@ -6556,6 +6640,7 @@ static PyObject *eng_udp_recvfrom(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_udp_connect(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok, ip;
   int port;
   if (!PyArg_ParseTuple(args, "IIi", &tok, &ip, &port)) return nullptr;
@@ -6567,6 +6652,7 @@ static PyObject *eng_udp_connect(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_udp_push_reply(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok, src_ip;
   Py_buffer data;
   int src_port;
@@ -6585,6 +6671,7 @@ static PyObject *eng_udp_push_reply(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_sock_set(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   const char *name;
   int value;
@@ -6602,6 +6689,7 @@ static PyObject *eng_sock_set(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_set_nodelay(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   int value;
   long long now;
@@ -6625,6 +6713,7 @@ static PyObject *eng_tcp_set_nodelay(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_tcp_bufs(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned int tok;
   if (!PyArg_ParseTuple(args, "I", &tok)) return nullptr;
   TcpSocketN *t = self->eng->tcp(tok);
@@ -6683,6 +6772,7 @@ static PyObject *eng_tcp_info(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_drop_packet(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   unsigned long long id;
   const char *reason;
@@ -6699,6 +6789,7 @@ static PyObject *eng_drop_packet(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_free_packet(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   unsigned long long id;
   if (!PyArg_ParseTuple(args, "K", &id)) return nullptr;
   self->eng->store.free_pkt(id);
@@ -6735,6 +6826,7 @@ static PyObject *eng_packet_fields(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_intern_packet(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int src_host, proto, src_port, dst_port;
   unsigned long long seq;
   unsigned int src_ip, dst_ip;
@@ -6786,6 +6878,7 @@ static PyObject *eng_intern_packet(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_trace_entries(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
   HostPlane *hp = self->eng->plane(hid);
@@ -6816,6 +6909,7 @@ static PyObject *eng_mt_stats(EngineObj *self, PyObject *) {
 }
 
 static PyObject *eng_set_pcap(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   int hid, ifidx, flag;
   if (!PyArg_ParseTuple(args, "iip", &hid, &ifidx, &flag)) return nullptr;
   self->eng->plane(hid)->pcap_on[ifidx & 1] = flag;
@@ -6823,6 +6917,7 @@ static PyObject *eng_set_pcap(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_pcap_take(EngineObj *self, PyObject *args) {
+  self->eng->state_epoch++;
   /* Drain this host's pcap records: list of (iface, t, src_host,
    * pkt_seq, proto, sip, sport, dip, dport, payload, tcp|None) where
    * tcp = (seq, ack, flags, window). */
@@ -6855,6 +6950,13 @@ static PyObject *eng_pcap_take(EngineObj *self, PyObject *args) {
   }
   hp->pcap_log.clear();
   return out;
+}
+
+static PyObject *eng_state_epoch(EngineObj *self, PyObject *) {
+  /* Read-only: the host-state mutation epoch the device-span
+   * residency protocol keys on (see Engine::state_epoch). */
+  return PyLong_FromUnsignedLongLong(
+      (unsigned long long)self->eng->state_epoch);
 }
 
 static PyMethodDef eng_methods[] = {
@@ -6942,6 +7044,7 @@ static PyMethodDef eng_methods[] = {
     {"intern_packet", (PyCFunction)eng_intern_packet, METH_VARARGS, nullptr},
     {"trace_entries", (PyCFunction)eng_trace_entries, METH_VARARGS, nullptr},
     {"counters", (PyCFunction)eng_counters, METH_VARARGS, nullptr},
+    {"state_epoch", (PyCFunction)eng_state_epoch, METH_NOARGS, nullptr},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -164,6 +164,7 @@ class _CodecScanner:
         self.env0 = module_env_
         self.consumed: dict = {}     # key -> dtype name (or None)
         self.produced: set = set()
+        self.state_written: set = set()  # st[...] keys (_to_arrays)
         self.unresolved: list = []   # (lineno, what)
 
     # -- helpers -----------------------------------------------------
@@ -240,13 +241,15 @@ class _CodecScanner:
                 for tgt in node.targets:
                     if isinstance(tgt, ast.Subscript) and \
                             isinstance(tgt.value, ast.Name) and \
-                            tgt.value.id == "out":
+                            tgt.value.id in ("out", "st"):
+                        which = tgt.value.id
                         key = self._ev(tgt.slice, env)
                         if isinstance(key, str):
-                            self.produced.add(key)
+                            (self.produced if which == "out"
+                             else self.state_written).add(key)
                         else:
                             self.unresolved.append(
-                                (node.lineno, "out[] key"))
+                                (node.lineno, f"{which}[] key"))
 
     def scan_calls(self, stmt, env, funcs, depth):
         for node in ast.walk(stmt):
@@ -297,3 +300,38 @@ def extract_produced_keys(path: str, method: str = "_from_arrays"):
     scanner = _CodecScanner(module_env(tree))
     scanner.run(_find_method(tree, method))
     return scanner.produced, scanner.unresolved
+
+
+def extract_state_keys(path: str, method: str = "_to_arrays"):
+    """(state keys the codec writes via st[...], unresolved): the SoA
+    column set the residency protocol must classify."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    scanner = _CodecScanner(module_env(tree))
+    scanner.run(_find_method(tree, method))
+    return scanner.state_written, scanner.unresolved
+
+
+def extract_residency_sets(path: str) -> dict:
+    """Module-level RESIDENT_* frozensets (the dirty-column export
+    protocol's classification tables), evaluated with only the
+    module's own literal constants in scope."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    env = module_env(tree)
+    out: dict = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id.startswith("RESIDENT_"):
+            try:
+                expr = compile(ast.Expression(stmt.value), path, "eval")
+                # constants merged into globals: comprehensions open a
+                # new scope that cannot see eval() locals
+                val = eval(expr, {"__builtins__": {},
+                                  "frozenset": frozenset, **env})
+            except Exception:
+                continue
+            if isinstance(val, frozenset):
+                out[stmt.targets[0].id] = val
+    return out

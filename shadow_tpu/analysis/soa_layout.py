@@ -122,4 +122,54 @@ def check(repo_root: str, cpp_text: str | None = None) -> list:
                     "soa-layout", CPP,
                     f"[{name}] column {key!r} exported as "
                     f"{exported[key]} but imported as {imported[key]}"))
+
+        # Residency classification (the dirty-column export protocol,
+        # ISSUE 3): every SoA state column the codec materializes must
+        # be classified CARRIED / STATIC / DERIVED in the module's
+        # RESIDENT_* tables — a column added to the export without a
+        # classification entry would otherwise be reused across
+        # device-resident spans with unreviewed dirtiness semantics.
+        state_keys, unres_s = py_extract.extract_state_keys(codec_path)
+        for line, what in unres_s:
+            violations.append(Violation(
+                "soa-layout", codec,
+                f"[{name}] unresolvable {what} (the residency "
+                f"classification cannot see this column)", line=line))
+        sets_ = py_extract.extract_residency_sets(codec_path)
+        missing_tables = [t for t in ("RESIDENT_STATIC",
+                                      "RESIDENT_DERIVED",
+                                      "RESIDENT_CARRIED")
+                          if t not in sets_]
+        if missing_tables:
+            violations.append(Violation(
+                "soa-layout", codec,
+                f"[{name}] residency table(s) missing/unparseable: "
+                f"{', '.join(missing_tables)}"))
+        else:
+            r_static = sets_["RESIDENT_STATIC"]
+            r_derived = sets_["RESIDENT_DERIVED"]
+            r_carried = sets_["RESIDENT_CARRIED"]
+            for a, b in (("STATIC", "DERIVED"), ("STATIC", "CARRIED"),
+                         ("DERIVED", "CARRIED")):
+                dup = sets_[f"RESIDENT_{a}"] & sets_[f"RESIDENT_{b}"]
+                if dup:
+                    violations.append(Violation(
+                        "soa-layout", codec,
+                        f"[{name}] column(s) {sorted(dup)} in both "
+                        f"RESIDENT_{a} and RESIDENT_{b}"))
+            public = {k for k in state_keys if not k.startswith("_")}
+            for key in sorted(public - r_static - r_derived
+                              - r_carried):
+                violations.append(Violation(
+                    "soa-layout", codec,
+                    f"[{name}] state column {key!r} has no residency "
+                    f"class (dirty-column protocol): add it to "
+                    f"RESIDENT_CARRIED / _STATIC / _DERIVED"))
+            # DERIVED entries may be kernel-side registers the codec
+            # never materializes; STATIC/CARRIED must exist.
+            for key in sorted((r_static | r_carried) - public):
+                violations.append(Violation(
+                    "soa-layout", codec,
+                    f"[{name}] residency entry {key!r} names a column "
+                    f"the codec no longer produces (stale entry)"))
     return violations

@@ -39,6 +39,7 @@ class SimSummary:
     end_time_ns: int = 0
     busy_end_ns: int = 0  # window end of the last round that ran events
     rounds: int = 0
+    span_rounds: int = 0  # of which: served inside C++/device spans
     events: int = 0
     packets_sent: int = 0
     packets_recv: int = 0
@@ -619,7 +620,17 @@ class Manager:
         cpp_ns_round = None   # EWMA wall ns/round, C++ spans
         dev_probe_countdown = 0
         dev_aborts_row = 0
-        all_plane = all(h.plane is not None for h in self.hosts)
+        deliver_exports = None  # lazy import (mixed-sim spans only)
+        # Speculative multi-window sizing: how many conservative
+        # windows one device dispatch may batch.  The kernel's
+        # transactional abort marker is the rollback — an aborted
+        # span costs one dispatch and imports nothing — so the router
+        # can speculate: double the batch while spans run clean,
+        # shrink hard on an abort.  Residency (ops/phold_span.py)
+        # makes the re-dispatch after a short span nearly free, so
+        # starting small costs little and caps the price of a wrong
+        # runahead/domain prediction.
+        dev_span_K = 32
         from shadow_tpu.core.simtime import TIME_NEVER
         while start is not None and start < stop:
             span_now = span_ok and \
@@ -627,26 +638,25 @@ class Manager:
                 self.propagator.span_gate()
             py_limit = None
             if span_now and self._py_work.any():
-                # Python-side work pending somewhere.  When EVERY host
-                # is engine-resident the flags are transient (heap
-                # tasks like spawns/shutdowns), and spans may still
-                # serve the stretch UP TO the earliest window that
-                # could touch one: a window [s, s+ra) with
-                # s <= py_min - ra keeps window_end <= py_min, so the
-                # Python event can never fall inside a C++-served
-                # window (dynamic runahead only shrinks).  In a MIXED
-                # sim an object-path host is py-flagged permanently
-                # and can RECEIVE from engine hosts in any window
-                # (exports the span cannot deliver) — no spans there.
-                if not all_plane:
+                # Python-side work pending somewhere — transient heap
+                # tasks (spawns/shutdowns) on engine hosts, or
+                # PERMANENT object-path hosts (pcap/strace/CPU-model)
+                # in a mixed sim.  Either way spans may still serve
+                # the stretch UP TO the earliest window that could
+                # touch one: a window [s, s+ra) with s <= py_min - ra
+                # keeps window_end <= py_min, so the Python event can
+                # never fall inside a C++-served window (dynamic
+                # runahead only shrinks).  An object-path host can
+                # also RECEIVE from engine hosts mid-span; the engine
+                # then ENDS the span at the producing round and hands
+                # the exports back (run_span span-exports), delivered
+                # below — event order stays identical to per-round.
+                py_min = int(self._nt[self._py_work].min())
+                ra = self.runahead.get()
+                if start > py_min - ra:
                     span_now = False
                 else:
-                    py_min = int(self._nt[self._py_work].min())
-                    ra = self.runahead.get()
-                    if start > py_min - ra:
-                        span_now = False
-                    else:
-                        py_limit = py_min - ra + 1
+                    py_limit = py_min - ra + 1
             if span_now:
                 limit = stop
                 if heartbeat_lines:
@@ -666,6 +676,7 @@ class Manager:
                     rounds, busy_rounds, pkts, next_start, busy_end, \
                         ra = res
                     summary.rounds += rounds
+                    summary.span_rounds += rounds
                     summary.busy_end_ns = busy_end
                     self.runahead.sync_from_span(ra)
                     prop = self.propagator
@@ -719,8 +730,9 @@ class Manager:
                 dev_retry_soon = False
                 if use_dev:
                     t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
-                    res, runner = self._device_span(start, stop, limit,
-                                                    max_rounds)
+                    res, runner = self._device_span(
+                        start, stop, limit,
+                        min(max_rounds, dev_span_K))
                     if res is not None and res[0] == 0:
                         # Zero progress (e.g. heartbeat boundary due
                         # now): benign — the C++/per-round path below
@@ -728,6 +740,7 @@ class Manager:
                         res = ZERO_PROGRESS
                     if res is not None and res is not ZERO_PROGRESS:
                         dev_aborts_row = 0
+                        dev_span_K = min(dev_span_K * 2, max_rounds)
                         if runner.last_was_cold:
                             # Compile-tainted wall: discard the sample
                             # and re-measure warm on the next attempt.
@@ -753,8 +766,11 @@ class Manager:
                         # instead of once per sim.
                         dev_retry_soon = True
                     elif res is None:
-                        # abort or transient over-caps: back off, and
-                        # give up only after repeated failures
+                        # abort or transient over-caps: the rollback
+                        # path — shrink the speculative window batch,
+                        # back off, and give up only after repeated
+                        # failures
+                        dev_span_K = max(16, dev_span_K // 4)
                         dev_aborts_row += 1
                         dev_probe_countdown = 16 * dev_aborts_row
                         if dev_aborts_row >= 3:
@@ -772,12 +788,29 @@ class Manager:
                 if res is None:
                     span_ok = False  # callback-capable host: per-round
                 else:
+                    exports = res[6]
+                    res = res[:6]
+                    if exports:
+                        # Mixed sim: the span stopped at the round
+                        # that addressed an object-path host; deliver
+                        # those packets Python-side at their recorded
+                        # times (>= that round's window_end).
+                        if deliver_exports is None:
+                            from shadow_tpu.ops.propagate import \
+                                deliver_engine_exports as deliver_exports
+                        deliver_exports(self.hosts, exports)
                     rounds = res[0]
                     if rounds:
                         per = (time.perf_counter_ns() - t0) / rounds  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                         cpp_ns_round = per if cpp_ns_round is None \
                             else 0.7 * cpp_ns_round + 0.3 * per
                         start = account_span(res)
+                        if exports:
+                            # the deliveries lowered object-host slots
+                            nxt = self._min_next_event()
+                            if nxt is not None and (start is None
+                                                    or nxt < start):
+                                start = nxt
                         continue
                     # rounds == 0 (e.g. heartbeat boundary due now):
                     # fall through to one per-round iteration.
@@ -1022,6 +1055,7 @@ class Manager:
         # only on bench stderr.
         prop = self.propagator
         dispatch = {
+            "span_rounds": summary.span_rounds,
             "rounds_dispatched": getattr(prop, "rounds_dispatched", 0),
             "packets_batched": getattr(prop, "packets_batched", 0),
             "rounds_device": getattr(prop, "rounds_device", 0),
@@ -1035,9 +1069,13 @@ class Manager:
                 dispatch[f"device_span_{family}"] = {
                     "spans": runner.spans,
                     "rounds": runner.rounds,
+                    "micro_iters": getattr(runner, "micro_iters", 0),
                     "aborts": runner.aborts,
                     "ineligible": runner.ineligible,
                     "transient_or_over_caps": runner.over_caps,
+                    "resident_hits": getattr(runner,
+                                             "resident_hits", 0),
+                    "stale_drops": getattr(runner, "stale_drops", 0),
                 }
         stats = {
             "end_time_ns": summary.end_time_ns,

@@ -1,0 +1,40 @@
+"""Shared device-placement helpers for the span-runner twins.
+
+Both device-span families (ops/phold_span.py, ops/tcp_span.py) cache
+their static SoA columns as committed device arrays and, when a
+sharded mesh is attached, commit every span input with host-major
+columns sharded on the "hosts" axis.  The placement law is identical
+for both runners, so it lives here once; the runners mix it in and
+provide `self.mesh` and `self._H`.
+"""
+
+from __future__ import annotations
+
+
+class SpanMeshMixin:
+    """Device placement for span inputs: `mesh` (optional
+    jax.sharding.Mesh with a "hosts" axis) and `_H` (host count)
+    come from the concrete runner."""
+
+    def _put_static(self, jax, v):
+        if self.mesh is None:
+            return jax.device_put(v)
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = (PartitionSpec("hosts")
+                if getattr(v, "ndim", 0) >= 1 and v.shape[0] == self._H
+                else PartitionSpec())
+        return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+    def _mesh_put(self, st):
+        """Commit every span input to the device mesh: host-major
+        columns shard on the hosts axis, everything else replicates.
+        Already-committed arrays (the static cache) pass through."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        shard = NamedSharding(self.mesh, PartitionSpec("hosts"))
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        H = self._H
+        return {k: jax.device_put(
+                    v, shard if (getattr(v, "ndim", 0) >= 1
+                                 and v.shape[0] == H) else repl)
+                for k, v in st.items()}

@@ -31,6 +31,7 @@ import numpy as np
 
 from shadow_tpu.core.rng import STREAM_PACKET_LOSS, mix_key, threefry2x32_jax
 from shadow_tpu.core.simtime import TIME_NEVER
+from shadow_tpu.ops.span_mesh import SpanMeshMixin
 
 I64_MAX = np.int64(1 << 62)
 SEQ_HALF = np.int64(1 << 31)
@@ -109,8 +110,60 @@ AB_STRUCT = 4
 
 _FN_CACHE: dict = {}
 
+# ---- Residency classification (the dirty-column export protocol) ----
+# Same protocol as ops/phold_span.py: every state key the codec
+# (_to_arrays) produces falls in exactly one class, and analysis
+# pass 2 fails scripts/lint when an export column is missing here.
+# CARRIED: the span's device output is the next input while the
+# engine's state_epoch is unchanged.  STATIC: per-sim constants
+# (connection identity, negotiated options, buckets) — cached at the
+# first export.  DERIVED: device-local chain registers every fresh
+# export re-initializes; reattaching the same init is by construction
+# identical to the export path.
+RESIDENT_STATIC = frozenset({
+    "bw_up", "bw_down", "eth_ip",
+    "r1_refill", "r1_cap", "r1_unlimited",
+    "r2_refill", "r2_cap", "r2_unlimited",
+    "c_host", "c_role", "c_lip", "c_lport", "c_pip", "c_pport",
+    "c_iss", "c_irs", "c_wsoff", "c_ourws", "c_peerws", "c_effmss",
+    "c_nodelay", "c_congmss", "c_sat", "c_rat", "c_atotal",
+})
+RESIDENT_DERIVED = frozenset(
+    {"cont", "then", "ret", "cur", "eflag", "parkp", "had_holes",
+     "park_ctr", "cd_chain", "cd_sniff", "_n_conns"}
+    | {f"ar_{kk}" for kk in PK_KEYS})
+# CARRIED: the span's own device output is the next input (all
+# ring/heap columns plus the mutable scalars).  Ring packet
+# columns follow PK_KEYS so a header-field addition classifies
+# itself; every scalar column is listed explicitly so adding an
+# export column without classifying it fails scripts/lint.
+RESIDENT_CARRIED = frozenset(
+    {
+     "app_sys", "c_agot", "c_atcopied", "c_atlast", "c_atspace",
+     "c_await", "c_awaitseq", "c_cwnd", "c_delackdl", "c_dupacks",
+     "c_fastrec", "c_persistdl", "c_persistiv", "c_queued",
+     "c_rblen", "c_rbmax", "c_rcvnxt", "c_recover", "c_rto",
+     "c_rtobackoff", "c_rtodl", "c_rttvar", "c_rtxcount",
+     "c_sackskip", "c_sblen", "c_sbmax", "c_segsrecv",
+     "c_segssent", "c_sndnxt", "c_snduna", "c_sndwnd", "c_srtt",
+     "c_ssa", "c_ssthresh", "c_status", "c_tmrdl", "c_tsrecent",
+     "c_wakep", "codel_bytes", "codel_count", "codel_drop_next",
+     "codel_dropped", "codel_dropping", "codel_first_above",
+     "codel_last_count", "cq_enq", "cq_len", "cq_pos",
+     "eth_brecv", "eth_bsent", "eth_precv", "eth_psent",
+     "event_seq", "events_run", "ib_len", "ib_pos", "ib_seq",
+     "ib_src", "ib_time", "now", "op_len", "op_pos", "packet_seq",
+     "pkts_dropped", "pkts_recv", "pkts_sent", "r1_bal",
+     "r1_next", "r1_pending", "r1_pk_valid", "r2_bal", "r2_next",
+     "r2_pending", "r2_pk_valid", "ra_plen", "ra_seq", "ra_valid",
+     "rtx_len", "rtx_plen", "rtx_pos", "rtx_rtxed", "rtx_sacked",
+     "rtx_sent", "rtx_seq", "th_kind", "th_seq", "th_tgt",
+     "th_time", "th_valid"}
+    | {f"{p}_{kk}" for p in ('cq', 'ib', 'op', 'r1_pk', 'r2_pk')
+       for kk in PK_KEYS})
 
-class TcpSpanRunner:
+
+class TcpSpanRunner(SpanMeshMixin):
     """Builds and drives the jitted multi-round device loop for the
     tgen steady-stream TCP family.  One instance per Manager."""
 
@@ -164,6 +217,16 @@ class TcpSpanRunner:
         # serve the whole sim and the device would never get a shot).
         self.last_transient = False
         self.mesh = None  # optional jax.sharding.Mesh ("hosts" axis)
+        # Fused micro-op dispatch (default); False rebuilds the
+        # one-micro-op-per-iteration reference schedule.
+        self.fused = True
+        self.micro_iters = 0  # while-iterations across all spans
+        # Device-resident state between dispatches (phold_span twin).
+        self._res_st = None
+        self._res_token = None
+        self._static_cols = None
+        self.resident_hits = 0
+        self.stale_drops = 0
 
     def _caps(self):
         return (self.CAP_I, self.CAP_T, self.CAP_CQ, self.CAP_RT,
@@ -404,7 +467,7 @@ class TcpSpanRunner:
 
     def _cached_build(self):
         key = (self._H, self._CC, self._caps(), self.cap_out,
-               self.cap_tr, self.tracing)
+               self.cap_tr, self.tracing, self.fused)
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build()
@@ -420,6 +483,7 @@ class TcpSpanRunner:
         O = self.cap_out
         TR = self.cap_tr
         tracing = self.tracing
+        fused = self.fused    # static: fused vs reference dispatch
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)
         COOB = jnp.int32(CC + 1)
@@ -1614,18 +1678,54 @@ class TcpSpanRunner:
 
         def micro_iter(carry):
             st, window_end, iters = carry
-            cont0 = st["cont"]
-            st = op_relay1(st, cont0 == C_R1)
-            st = op_relay2(st, cont0 == C_R2)
-            st = op_tcpin(st, cont0 == C_TCPIN)
-            st = op_drain(st, cont0 == C_DRAIN)
-            st = op_ackdata(st, cont0 == C_ACKDATA)
-            st = op_push(st, cont0 == C_PUSH)
-            st = op_flush(st, cont0 == C_FLUSH)
-            st = op_arm(st, cont0 == C_ARM)
-            st = op_app(st, cont0 == C_APP)
-            st = op_tmr(st, cont0 == C_TMR)
-            st = op_pop_event(st, cont0 == C_IDLE, window_end)
+            if fused:
+                # Fused dispatch (phold_span twin): ops consume the
+                # LIVE continuation in dataflow order — a delivered
+                # segment's whole chain (pop -> codel drain -> tcpin
+                # -> reassembly -> ack decision -> push -> flush ->
+                # inet-out -> arm) runs inside ONE while-iteration.
+                # Per-host micro-op order is untouched (each stage
+                # still advances exactly one micro-op for its lanes),
+                # and hosts are independent within a round, so the
+                # compressed schedule is state-identical; the
+                # outbox/trace interleave it changes is erased by the
+                # downstream canonical sorts (inbox lexsort,
+                # Host.trace_lines).  Each stage is guarded by an
+                # any-lane-active cond so XLA skips the vectorized
+                # body of stages nobody occupies this iteration.
+                def guard(st, mask, fn):
+                    return jax.lax.cond(mask.any(), fn,
+                                        lambda s, _m: s, st, mask)
+
+                st = op_pop_event(st, st["cont"] == C_IDLE, window_end)
+                st = guard(st, st["cont"] == C_TMR, op_tmr)
+                st = guard(st, st["cont"] == C_APP, op_app)
+                st = guard(st, st["cont"] == C_R2, op_relay2)
+                st = guard(st, st["cont"] == C_TCPIN, op_tcpin)
+                for _ in range(2):
+                    st = guard(st, st["cont"] == C_DRAIN, op_drain)
+                st = guard(st, st["cont"] == C_ACKDATA, op_ackdata)
+                st = guard(st, st["cont"] == C_PUSH, op_push)
+                st = guard(st, st["cont"] == C_FLUSH, op_flush)
+                for _ in range(2):
+                    st = guard(st, st["cont"] == C_R1, op_relay1)
+                st = guard(st, st["cont"] == C_ARM, op_arm)
+            else:
+                # Reference (unfused) schedule: snapshot — one
+                # micro-op per host per iteration.  Kept as the
+                # differential comparator for the fused path.
+                cont0 = st["cont"]
+                st = op_relay1(st, cont0 == C_R1)
+                st = op_relay2(st, cont0 == C_R2)
+                st = op_tcpin(st, cont0 == C_TCPIN)
+                st = op_drain(st, cont0 == C_DRAIN)
+                st = op_ackdata(st, cont0 == C_ACKDATA)
+                st = op_push(st, cont0 == C_PUSH)
+                st = op_flush(st, cont0 == C_FLUSH)
+                st = op_arm(st, cont0 == C_ARM)
+                st = op_app(st, cont0 == C_APP)
+                st = op_tmr(st, cont0 == C_TMR)
+                st = op_pop_event(st, cont0 == C_IDLE, window_end)
             # Per-round runaway valve: a legitimate hot round is a few
             # thousand micro-iterations; a continuation-cycle bug must
             # abort in minutes, not hours (each iteration is a full
@@ -1745,15 +1845,15 @@ class TcpSpanRunner:
 
         def round_cond(carry):
             (st, start, runahead, rounds, busy_rounds, packets,
-             busy_end, stop, limit, max_rounds) = carry
+             busy_end, stop, limit, max_rounds, iters) = carry
             return ((rounds < max_rounds) & (start < limit)
                     & (start < stop) & (st["abort_code"] == 0))
 
         def round_body(carry):
             (st, start, runahead, rounds, busy_rounds, packets,
-             busy_end, stop, limit, max_rounds) = carry
+             busy_end, stop, limit, max_rounds, iters) = carry
             window_end = jnp.minimum(start + runahead, stop)
-            st, _we, _it = jax.lax.while_loop(
+            st, _we, it = jax.lax.while_loop(
                 micro_cond, micro_iter,
                 (st, window_end, jnp.int64(0)))
             st, n_out, min_lat = propagate(st, window_end)
@@ -1765,8 +1865,11 @@ class TcpSpanRunner:
             return (st, start, runahead, rounds + 1,
                     busy_rounds + (n_out > 0).astype(jnp.int64),
                     packets + n_out, window_end, stop, limit,
-                    max_rounds)
+                    max_rounds, iters + it)
 
+        # Donation is OFF pending a toolchain fix (see phold_span
+        # _build: donated executables + the persistent compilation
+        # cache corrupt the heap on cache-hit runs).
         @jax.jit
         def run(st, lat, thr, node, ips_sorted, ips_perm, k0, k1,
                 bootstrap_end, start, stop, limit, runahead,
@@ -1820,25 +1923,25 @@ class TcpSpanRunner:
             carry = (st, jnp.int64(start), jnp.int64(runahead),
                      jnp.int64(0), jnp.int64(0), jnp.int64(0),
                      jnp.int64(start), jnp.int64(stop),
-                     jnp.int64(limit), jnp.int64(max_rounds))
+                     jnp.int64(limit), jnp.int64(max_rounds),
+                     jnp.int64(0))
             (st, start, runahead, rounds, busy_rounds, packets,
-             busy_end, _s, _l, _m) = jax.lax.while_loop(
+             busy_end, _s, _l, _m, iters) = jax.lax.while_loop(
                 round_cond, round_body, carry)
-            # Only mutated columns go back over the device link.
-            drop = {"c_host", "c_role", "c_lip", "c_lport", "c_pip",
-                    "c_pport", "c_iss", "c_irs", "c_wsoff", "c_ourws",
-                    "c_peerws", "c_effmss", "c_nodelay", "c_congmss",
-                    "c_sat", "c_rat", "c_atotal",
-                    "bw_up", "bw_down", "eth_ip",
-                    "cont", "then", "ret", "cur", "eflag", "parkp",
-                    "had_holes", "park_ctr", "cd_chain", "cd_sniff",
-                    "r1_refill", "r1_cap", "r1_unlimited",
-                    "r2_refill", "r2_cap", "r2_unlimited"}
-            drop |= {f"ar_{kk}" for kk in PK_KEYS}
+            # Only mutated columns go back over the device link: the
+            # residency tables ARE the drop set (statics the host
+            # already has, deriveds the next input re-derives), so a
+            # column added to either class stays off the link without
+            # touching this site.  The `_`-prefix filter below covers
+            # `_n_conns`.
+            drop = RESIDENT_STATIC | RESIDENT_DERIVED
+            # the span-local outbox was fully consumed by propagate
+            drop |= {"out_n", "out_src", "out_dst", "out_seq", "out_t"}
+            drop |= {f"out_{kk}" for kk in PK_KEYS}
             st = {k: v for k, v in st.items()
                   if not k.startswith("_") and k not in drop}
             return (st, start, runahead, rounds, busy_rounds, packets,
-                    busy_end)
+                    busy_end, iters)
 
         return run
 
@@ -1846,45 +1949,103 @@ class TcpSpanRunner:
     # Driver
     # ------------------------------------------------------------------
 
+    def _export_state(self):
+        """Fresh engine export -> state dict, or the int/None
+        eligibility verdict passed through from span_export_tcp."""
+        d = self.engine.span_export_tcp(*self._caps())
+        if d is None or isinstance(d, int):
+            return d
+        st = self._to_arrays(d)  # also sets self._CC
+        # Cache the static config as committed device arrays
+        # (phold_span twin): paid once per export, reused by every
+        # later dispatch — fresh or resident — without re-paying the
+        # host->device transfer.  _n_conns stays a host int.
+        import jax
+        self._static_cols = {
+            k: self._put_static(jax, st[k]) for k in RESIDENT_STATIC}
+        st.update(self._static_cols)
+        self._static_cols["_n_conns"] = st["_n_conns"]
+        return st
+
+    def _resident_input(self):
+        """Rebuild the span input from the resident device output
+        (phold_span twin): static columns reattach from the cache;
+        the device-local chain registers re-initialize exactly as
+        every fresh export initializes them."""
+        H = self._H
+        st = {k: v for k, v in self._res_st.items()
+              if k not in ("abort_code", "abort_site")
+              and not k.startswith("tr_")}
+        st.update(self._static_cols)
+        n = self._static_cols["_n_conns"]
+        for k in ("cont", "then", "ret"):
+            st[k] = np.full(H, C_IDLE, np.int32)
+        st["cur"] = np.full(H, -1, np.int32)
+        for k in ("eflag", "parkp", "had_holes"):
+            st[k] = np.zeros(H, np.int32)
+        for kk in PK_KEYS:
+            st[f"ar_{kk}"] = np.zeros(H, PK_DTYPES[kk])
+        # Device-side scatter-max (phold twin uses jnp.maximum): both
+        # operands already live on device, so an np rebuild would pay
+        # a blocking device->host sync per resident hit.
+        import jax.numpy as jnp
+        st["park_ctr"] = (
+            jnp.zeros(H, jnp.int64)
+            .at[self._static_cols["c_host"][:n]]
+            .max(st["c_awaitseq"][:n] + 1))
+        return st
+
     def try_span(self, start: int, stop: int, limit: int,
                  runahead: int, dynamic: bool,
                  max_rounds: int | None = None):
         """Export -> device span -> import.  Returns (rounds,
         busy_rounds, packets, next_start, busy_end, runahead) or None
-        when ineligible / transiently out of domain / aborted."""
+        when ineligible / transiently out of domain / aborted.
+
+        Residency (phold_span twin): while the engine's state_epoch
+        is unchanged since our last import, the previous span's
+        device-resident output is reused and the export+conversion
+        leg of the dispatch is skipped; any other engine call forces
+        a fresh export."""
         self.last_transient = False
-        d = self.engine.span_export_tcp(*self._caps())
-        if d is None:
-            self.ineligible += 1
-            return None
-        if isinstance(d, int):
-            # transiently outside the steady-stream domain (handshake,
-            # close, over-caps): the router retries soon
-            self.over_caps += 1
-            self.last_transient = True
-            return None
-        st = self._to_arrays(d)  # also sets self._CC
-        n_conns = st.pop("_n_conns")
+        eng_epoch = self.engine.state_epoch()
+        resident = (self._res_st is not None
+                    and self._res_token == eng_epoch)
+        if self._res_st is not None and not resident:
+            self.stale_drops += 1
+            self._res_st = None
+        if resident:
+            self.resident_hits += 1
+            st = self._resident_input()
+            self._res_st = None  # consumed by this dispatch
+        else:
+            st = self._export_state()
+            if st is None:
+                self.ineligible += 1
+                return None
+            if isinstance(st, int):
+                # transiently outside the steady-stream domain
+                # (handshake, close, over-caps): the router retries
+                # soon
+                self.over_caps += 1
+                self.last_transient = True
+                return None
+        st = dict(st)
+        st.pop("_n_conns", None)
+        n_conns = self._static_cols["_n_conns"]
         import os
         import sys
         import time as _time
         dbg = os.environ.get("SHADOWTPU_TCPSPAN_DBG")
         if dbg:
             print(f"[tcp_span] export ok: {n_conns} conns, "
-                  f"CC={self._CC}, start={start}", file=sys.stderr,
+                  f"CC={self._CC}, start={start}, "
+                  f"resident={resident}", file=sys.stderr,
                   flush=True)
             _t0 = _time.perf_counter()  # shadow-lint: allow[wall-clock] debug span timing
         self._fn = self._cached_build()
         if self.mesh is not None:
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec
-            shard = NamedSharding(self.mesh, PartitionSpec("hosts"))
-            repl = NamedSharding(self.mesh, PartitionSpec())
-            H = self._H
-            st = {k: jax.device_put(
-                      v, shard if (getattr(v, "ndim", 0) >= 1
-                                   and v.shape[0] == H) else repl)
-                  for k, v in st.items()}
+            st = self._mesh_put(st)
         # Clamp span length: the flat trace buffer accumulates across
         # the whole span, and TCP rounds carry ~100x phold's traffic.
         mr = self.MAX_ROUNDS if max_rounds is None \
@@ -1897,7 +2058,7 @@ class TcpSpanRunner:
                 np.int64(self.bootstrap_end),
                 start, stop, limit, runahead, mr)
             (st_out, next_start, ra, rounds, busy_rounds, packets,
-             busy_end) = out
+             busy_end, span_iters) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
             code = int(st_np["abort_code"])
             if dbg:
@@ -1909,8 +2070,39 @@ class TcpSpanRunner:
             if code == 0:
                 break
             if code & AB_STRUCT:
+                # Hard abort regardless of residency (and before any
+                # re-export the next statement would discard — a
+                # domain-drifted re-export here would misaccount the
+                # structural abort as transient and keep the router
+                # re-probing a broken kernel); the consumed resident
+                # carry was already cleared above.
                 self.aborts += 1
                 return None
+            if resident:
+                # Treat the resident carry as consumed by the
+                # aborted dispatch (it will be again once donation
+                # returns); the engine — kept authoritative by the
+                # per-span imports — re-exports the same state.
+                # Abort accounting follows the fresh-dispatch
+                # convention: a capacity grow that then succeeds
+                # counts zero.
+                resident = False
+                st = self._export_state()
+                if st is None:
+                    self.ineligible += 1
+                    return None
+                if isinstance(st, int):
+                    # the state drifted out of the steady-stream
+                    # domain (handshake/close): retry-soon, not a
+                    # hard abort, or the router would disable the
+                    # family after three domain excursions
+                    self.over_caps += 1
+                    self.last_transient = True
+                    return None
+                st = dict(st)
+                st.pop("_n_conns", None)
+                if self.mesh is not None:
+                    st = self._mesh_put(st)
             if code & AB_TRACE:
                 self.cap_tr *= 4
             if code & AB_OUT:
@@ -1920,6 +2112,10 @@ class TcpSpanRunner:
             self.aborts += 1
             return None
         if int(rounds) == 0:
+            # The untouched carry stays resident (the output is the
+            # identical state).
+            self._res_st = st_out
+            self._res_token = self.engine.state_epoch()
             return (0, 0, 0, int(start), int(start), int(runahead))
         traces = None
         if self.tracing:
@@ -1950,10 +2146,15 @@ class TcpSpanRunner:
         st_np["_n_conns"] = n_conns
         back = self._from_arrays(st_np)
         self.engine.span_import_tcp(back, *self._caps(), traces)
+        # Record AFTER the import's own epoch bump: the resident copy
+        # is valid exactly until anything else touches the engine.
+        self._res_st = st_out
+        self._res_token = self.engine.state_epoch()
         self.last_was_cold = not self.compiled
         self.compiled = True
         self.spans += 1
         self.rounds += int(rounds)
+        self.micro_iters += int(span_iters)
         ra_out = int(ra) if dynamic else runahead
         return (int(rounds), int(busy_rounds), int(packets),
                 int(next_start), int(busy_end), ra_out)

@@ -130,21 +130,41 @@ def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
             f"hosts:\n" + "\n".join(host_blocks) + "\n")
 
 
+def phold_args(i: int, names: list[str], n_init: int,
+               mean_delay_ns: int,
+               peers_per_host: int | None = None) -> list[str]:
+    """One PHOLD LP's argv — the single source of the peer law
+    (next-k ring neighbors, full mesh by default) and the phold arg
+    layout, shared by phold_yaml and the bench dict builders."""
+    n = len(names)
+    if peers_per_host is not None:
+        k = min(peers_per_host, n - 1)
+        peers = [names[(i + 1 + j) % n] for j in range(k)]
+    else:
+        peers = [p for p in names if p != names[i]]
+    return ["7000", str(i), str(n_init), str(mean_delay_ns)] + peers
+
+
 def phold_yaml(n_hosts: int, n_init: int = 3,
                mean_delay_ns: int = 20_000_000, stop_time: str = "2s",
                seed: int = 13, scheduler: str = "serial",
                device_spans: str | None = None,
-               bandwidth: str = "1 Gbit", latency: str = "5 ms") -> str:
+               bandwidth: str = "1 Gbit", latency: str = "5 ms",
+               peers_per_host: int | None = None) -> str:
     """Classic PHOLD (ref: src/test/phold): every host one LP bouncing
-    messages to pseudo-random peers after pseudo-exponential holds."""
+    messages to pseudo-random peers after pseudo-exponential holds.
+    peers_per_host bounds each LP's peer list to its next-k ring
+    neighbors (full mesh by default) — above ~10k LPs a full n^2 peer
+    matrix no longer fits anything."""
     names = [f"lp{i:04d}" for i in range(n_hosts)]
     blocks = []
     for i, name in enumerate(names):
-        peers = " ".join(p for p in names if p != name)
+        args = " ".join(phold_args(i, names, n_init, mean_delay_ns,
+                                   peers_per_host))
         blocks.append(
             f"  {name}:\n    network_node_id: 0\n    processes:\n"
-            f'      - {{ path: phold, args: "7000 {i} {n_init} '
-            f'{mean_delay_ns} {peers}", start_time: 100ms, '
+            f'      - {{ path: phold, args: "{args}", '
+            f"start_time: 100ms, "
             f"expected_final_state: running }}")
     exp = [f"  scheduler: {scheduler}"]
     if device_spans is not None:

@@ -82,3 +82,40 @@ def test_import_column_loss_is_caught(cpp_text):
     v = soa_layout.check(ROOT, cpp_text=mutated)
     msgs = [x.message for x in v]
     assert any("'c_cwndx'" in m and "never produces" in m for m in msgs), msgs
+
+
+def test_unclassified_residency_column_is_caught(tmp_path, monkeypatch):
+    """Dirty-column protocol: a state column added to the codec
+    without a RESIDENT_* classification entry must fail pass 2."""
+    path = os.path.join(ROOT, "shadow_tpu", "ops", "phold_span.py")
+    with open(path) as fh:
+        src = fh.read()
+    mutated = _mutate(
+        src, '        st["out_first"] = np.zeros(H, np.int32)',
+        '        st["out_first"] = np.zeros(H, np.int32)\n'
+        '        st["rogue_col"] = np.zeros(H, np.int32)')
+    mpath = tmp_path / "phold_span.py"
+    mpath.write_text(mutated)
+    monkeypatch.setitem(soa_layout.FAMILIES[0], "codec", str(mpath))
+    v = soa_layout.check(ROOT)
+    assert any("rogue_col" in x.message and "residency" in x.message
+               for x in v), [x.message for x in v]
+
+
+def test_stale_residency_entry_is_caught(tmp_path, monkeypatch):
+    """The reverse direction: a classification entry naming a column
+    the codec no longer produces must fail pass 2."""
+    path = os.path.join(ROOT, "shadow_tpu", "ops", "phold_span.py")
+    with open(path) as fh:
+        src = fh.read()
+    # drop the column from the codec but leave it classified
+    mutated = _mutate(
+        src,
+        '"packet_seq", "recv_bytes",\n                  "recv_max"',
+        '"packet_seq",\n                  "recv_max"')
+    mpath = tmp_path / "phold_span.py"
+    mpath.write_text(mutated)
+    monkeypatch.setitem(soa_layout.FAMILIES[0], "codec", str(mpath))
+    v = soa_layout.check(ROOT)
+    assert any("recv_bytes" in x.message for x in v), \
+        [x.message for x in v]

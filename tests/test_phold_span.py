@@ -236,3 +236,132 @@ graph [ node [ id 0 host_bandwidth_down "400 Kbit" host_bandwidth_up "10 Mbit" ]
     assert m_ser.trace_lines() == m_dev.trace_lines()
     assert _hist(m_ser) == _hist(m_dev)
     assert _stdout(m_ser) == _stdout(m_dev)
+
+
+def test_fused_vs_unfused_differential():
+    """The fused dispatcher (ops chained on the live continuation,
+    any-active cond guards) against the reference one-micro-op-per-
+    iteration schedule: same seed, byte-identical traces, histograms,
+    and counters.  Residency must actually engage on the fused side
+    (multiple adaptive-K spans reuse the donated device carry)."""
+    kw = dict(n_hosts=6, n_init=2, stop="1s")
+
+    def run_with(fused):
+        from shadow_tpu.core.manager import Manager
+        m = Manager(phold_cfg("tpu", device_spans="force", **kw))
+        m._dev_span = m.make_dev_span_runner()
+        m._dev_span.fused = fused
+        s = m.run()
+        return m, s
+
+    m_f, s_f = run_with(True)
+    m_u, s_u = run_with(False)
+    for m, s in ((m_f, s_f), (m_u, s_u)):
+        r = m._dev_span
+        assert r.spans > 0 and r.aborts == 0, (r.spans, r.aborts)
+    assert m_f._dev_span.micro_iters < m_u._dev_span.micro_iters, \
+        "fused dispatch did not reduce while-loop trip count"
+    assert m_f._dev_span.resident_hits > 0, \
+        "residency never engaged across adaptive-K spans"
+    assert m_f.trace_lines() == m_u.trace_lines()
+    assert _hist(m_f) == _hist(m_u)
+    assert _counters(s_f) == _counters(s_u)
+
+
+def test_residency_stale_reuse_refused():
+    """The dirty-state gate: after ANY engine mutation between spans,
+    the resident device copy must be refused (stale_drops) and a
+    fresh export taken — never silently reused."""
+    from shadow_tpu.core.manager import Manager
+    m = Manager(phold_cfg("tpu", device_spans="force", n_hosts=6,
+                          n_init=2, stop="1s"))
+    s = m.run()
+    r = m._dev_span
+    assert r.spans > 0 and r.resident_hits > 0
+    assert r._res_st is not None
+    # any mutating engine entry point moves the epoch off the
+    # recorded residency token (end-of-run teardown already did;
+    # every further mutation keeps it moving)
+    e0 = m.plane.engine.state_epoch()
+    m.plane.engine.set_tracing(0, True)
+    assert m.plane.engine.state_epoch() != e0
+    assert m.plane.engine.state_epoch() != r._res_token
+    stale0 = r.stale_drops
+    # a zero-length span attempt must drop the stale copy and
+    # re-export instead of reusing it
+    end = s.end_time_ns
+    res = r.try_span(end, end, end, 1, False)
+    assert res is not None and res[0] == 0
+    assert r.stale_drops == stale0 + 1
+
+
+def mixed_cfg(scheduler: str, n: int = 24, n_obj: int = 3,
+              sparse_obj: bool = True, cross: bool = False,
+              seed: int = 13):
+    """n-host PHOLD with n_obj OBJECT-PATH hosts (per-host
+    native_dataplane off — the pcap/CPU-model shape) among engine
+    hosts.  sparse_obj gives the object hosts a 40x longer mean delay;
+    cross=True lets engine hosts address object hosts (engine->object
+    span exports)."""
+    names = [f"lp{i:03d}" for i in range(n)]
+    obj = set(names[:n_obj])
+    hosts = {}
+    for i, name in enumerate(names):
+        if cross:
+            peers = [p for p in names if p != name]
+        elif name in obj:
+            peers = [p for p in sorted(obj) if p != name]
+        else:
+            peers = [p for p in names if p != name and p not in obj]
+        mean = "800000000" if (sparse_obj and name in obj) \
+            else "20000000"
+        hosts[name] = {
+            "network_node_id": 0,
+            "processes": [{
+                "path": "phold",
+                "args": ["7000", str(i), "2", mean] + peers,
+                "start_time": "100ms",
+                "expected_final_state": "running",
+            }],
+        }
+        if name in obj:
+            hosts[name]["native_dataplane"] = False
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "2s", "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "5 ms" ] ]"""}},
+        "experimental": {"scheduler": scheduler},
+        "hosts": hosts})
+    return cfg
+
+
+def test_mixed_object_hosts_span_coverage():
+    """The all-plane span cliff, lifted: a handful of object-path
+    hosts (the pcap/CPU-model shape) among engine hosts no longer
+    disables C++ spans — the span limit caps at the earliest
+    object-host window instead.  Byte-identical to serial with >=50%
+    of rounds still served inside spans."""
+    m_ser, s_ser = run_simulation(mixed_cfg("serial"))
+    m_tpu, s_tpu = run_simulation(mixed_cfg("tpu"))
+    assert s_ser.ok and s_tpu.ok
+    assert sorted(m_ser.trace_lines()) == sorted(m_tpu.trace_lines())
+    assert _hist(m_ser) == _hist(m_tpu)
+    assert _counters(s_ser) == _counters(s_tpu)
+    assert s_tpu.span_rounds * 2 >= s_tpu.rounds, \
+        f"span coverage {s_tpu.span_rounds}/{s_tpu.rounds} < 50%"
+
+
+def test_mixed_object_hosts_span_exports():
+    """Engine hosts addressing an object-path host mid-span: the span
+    must stop at the producing round and hand the packets back for
+    Python-side delivery (run_span span-exports) — byte-identical to
+    serial, nothing silently dropped."""
+    kw = dict(sparse_obj=False, cross=True, seed=29)
+    m_ser, s_ser = run_simulation(mixed_cfg("serial", **kw))
+    m_tpu, s_tpu = run_simulation(mixed_cfg("tpu", **kw))
+    assert s_ser.ok and s_tpu.ok
+    assert s_tpu.span_rounds > 0, "spans never ran in the mixed sim"
+    assert sorted(m_ser.trace_lines()) == sorted(m_tpu.trace_lines())
+    assert _hist(m_ser) == _hist(m_tpu)
+    assert _counters(s_ser) == _counters(s_tpu)

@@ -189,3 +189,53 @@ def test_tcp_device_span_lossy_retransmit():
     assert m_ser.trace_lines() == mgr.trace_lines()
     assert _hist(m_ser) == _hist(mgr)
     assert s_ser.packets_dropped == s_dev.packets_dropped
+
+
+@pytest.mark.slow
+def test_tcp_fused_vs_unfused_differential():
+    """The fused TCP dispatcher (segment chains run inside one
+    while-iteration, any-active cond guards) against the reference
+    one-micro-op-per-iteration schedule: same seed, byte-identical
+    traces/histograms/counters, and a strictly lower trip count.
+    Slow: two variants of the big TCP kernel compile."""
+    def run_with(fused):
+        mgr = Manager(stream_cfg("tpu", loss=0.01,
+                                 device_spans="force"))
+        _require_plane(mgr)
+        mgr._dev_span_tcp = mgr.make_tcp_span_runner()
+        mgr._dev_span_tcp.fused = fused
+        s = mgr.run()
+        return mgr, s
+
+    m_f, s_f = run_with(True)
+    m_u, s_u = run_with(False)
+    for m in (m_f, m_u):
+        r = m._dev_span_tcp
+        assert r is not None and r.spans > 0, \
+            (getattr(r, "aborts", 0), getattr(r, "over_caps", 0))
+    assert m_f._dev_span_tcp.micro_iters < \
+        m_u._dev_span_tcp.micro_iters, \
+        "fused dispatch did not reduce while-loop trip count"
+    assert m_f.trace_lines() == m_u.trace_lines()
+    assert _hist(m_f) == _hist(m_u)
+    assert s_f.events == s_u.events
+    assert s_f.packets_dropped == s_u.packets_dropped
+
+
+def test_tcp_residency_classification_complete():
+    """Dirty-column unit gate, codec side: every state key the TCP
+    SoA codec produces is classified CARRIED / STATIC / DERIVED, and
+    the classes are disjoint (the lint's pass-2 cross-check enforces
+    the same protocol against the C++ export — this is the fast
+    in-process floor)."""
+    from shadow_tpu.ops import phold_span, tcp_span
+    for mod in (tcp_span, phold_span):
+        static = mod.RESIDENT_STATIC
+        derived = mod.RESIDENT_DERIVED
+        carried = mod.RESIDENT_CARRIED
+        assert not (static & derived), mod.__name__
+        # the dangerous overlap: a carried column also in STATIC
+        # would have the stale static cache silently overwrite the
+        # carried device value in _resident_input
+        assert not (static & carried), mod.__name__
+        assert not (derived & carried), mod.__name__
