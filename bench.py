@@ -185,11 +185,23 @@ graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
         "hosts": hosts})
 
 
+# Observations from the most recent run_once call: per-phase wall
+# breakdown (flight recorder wall channel) + the device-eligibility
+# histogram — recorded into the headline JSON and printed as one-line
+# summaries (ISSUE 4 satellite).
+LAST_RUN: dict = {}
+
+
 def run_once(build, scheduler: str, report_routes: str | None = None,
              devcap: bool = False):
     from shadow_tpu.core.manager import Manager
 
-    manager = Manager(build(scheduler))
+    cfg = build(scheduler)
+    # Wall-channel-only recording: phase walls per rung at a few
+    # perf_counter reads per dispatch; the sim-time event stream stays
+    # off so recorded rungs measure the simulator, not the recorder.
+    cfg.experimental.flight_recorder = "wall"
+    manager = Manager(cfg)
     for h in manager.hosts:
         h.set_tracing(False)
     if devcap and manager.plane is not None:
@@ -201,6 +213,12 @@ def run_once(build, scheduler: str, report_routes: str | None = None,
     t0 = time.perf_counter()
     summary = manager.run()
     wall = time.perf_counter() - t0
+    LAST_RUN.clear()
+    LAST_RUN.update({
+        "scheduler": scheduler,
+        "phases_s": manager.flight.wall.totals(),
+        "eligibility": manager.audit.as_dict(),
+    })
     if report_routes is not None:
         print(f"bench[{report_routes}]: {route_split(manager)}",
               file=sys.stderr)
@@ -723,6 +741,21 @@ def main() -> None:
         tpu_walls.append(wT)
         if tpu_wall is None or wT < tpu_wall:
             tpu_summary, tpu_wall = sT, wT
+    # Phase breakdown + eligibility histogram of the last recorded tpu
+    # trial (flight recorder wall channel; ISSUE 4) — one line each in
+    # the lint-preflight style, and recorded in the headline JSON.
+    tpu_obs = dict(LAST_RUN)
+    phases = tpu_obs.get("phases_s", {})
+    print("phases: " + (" | ".join(
+        f"{k} {v}s" for k, v in sorted(phases.items(),
+                                       key=lambda kv: -kv[1]))
+        or "n/a"), file=sys.stderr)
+    elig = tpu_obs.get("eligibility", {})
+    etot = sum(elig.values()) or 1
+    print("eligibility: " + (", ".join(
+        f"{k} {v} ({100.0 * v / etot:.0f}%)"
+        for k, v in sorted(elig.items(), key=lambda kv: -kv[1]))
+        or "n/a"), file=sys.stderr)
     # Device-capability probe on a SEPARATE, non-recorded run: the
     # per-round domain scan costs ~1% at 10k hosts and must not taint
     # any trial that feeds the recorded walls/spread.
@@ -804,6 +837,12 @@ def main() -> None:
         "engine_baseline_trials": spread(baseE_walls),
         # Standing scale rung: >=100k hosts on the engine span path.
         "scale_100k": scale_100k,
+        # Flight-recorder wall channel of the last recorded tpu trial:
+        # where a dispatch's wall goes (export/convert/compile/execute/
+        # import/barrier/host-loop/engine-span, seconds) and the
+        # device-eligibility histogram (one reason per round).
+        "phases_s": phases,
+        "eligibility": elig,
     }), flush=True)
 
     # Auxiliary rungs (stderr only).  A failure must not cost the

@@ -140,6 +140,60 @@ constexpr int TRACE_SND = 0;
 constexpr int TRACE_DRP = 1;
 constexpr int TRACE_RCV = 2;
 
+/* Flight recorder (shadow_tpu/trace/events.py is the Python twin;
+ * analysis pass 1 diffs the enums and the record size).  The engine
+ * keeps a fixed-record ring of per-round milestones while spans run;
+ * the manager drains it through flight_take alongside the span-export
+ * path and re-stamps the refined eligibility reason. */
+constexpr int FLIGHT_REC_BYTES = 32;
+
+/* flight event kinds */
+enum { FR_ROUND = 0, FR_SPAN_START, FR_SPAN_COMMIT, FR_SPAN_ABORT, FR_N };
+
+/* device-eligibility reason codes: one per conservative round */
+enum {
+  EL_DEVICE_SPAN = 0, EL_ENGINE_SPAN, EL_ENGINE_ROUTED, EL_ENGINE_COLD,
+  EL_ENGINE_ABORT, EL_ENGINE_TRANSIENT, EL_ENGINE_FAMILY, EL_ENGINE_OFF,
+  EL_ENGINE_PYLIMIT, EL_ROUND_BOUNDARY, EL_ROUND_OUTBOX, EL_ROUND_GATE,
+  EL_ROUND_CALLBACK, EL_ROUND_FORCED, EL_ROUND_SCHED, EL_OBJ_PCAP,
+  EL_OBJ_CPU, EL_OBJ_PYTASK, EL_OBJ_OTHER, EL_N,
+};
+
+/* Order mirrors the EL_* enum (and trace/events.py EL_NAMES). */
+static const char *EL_NAMES[EL_N] = {
+    "device-span",
+    "engine-span",
+    "engine-span:routed",
+    "engine-span:cold-budget",
+    "engine-span:abort-rollback",
+    "engine-span:transient",
+    "engine-span:ineligible-family",
+    "engine-span:device-off",
+    "engine-span:py-limit",
+    "per-round:boundary",
+    "per-round:outbox",
+    "per-round:span-gate",
+    "per-round:callback-host",
+    "per-round:forced-device",
+    "per-round:scheduler",
+    "object-path:pcap",
+    "object-path:cpu-model",
+    "object-path:py-task",
+    "object-path:other",
+};
+
+/* Fixed flight record; layout twinned byte-for-byte with
+ * trace/events.py REC ("<qiiqq"). */
+struct FlightRec {
+  int64_t t;       // simulated ns
+  int32_t kind;    // FR_*
+  int32_t a;       // FR_ROUND: eligibility reason
+  int64_t b;       // FR_ROUND: packets propagated
+  int64_t c;       // FR_ROUND: window start ns
+};
+static_assert(sizeof(FlightRec) == FLIGHT_REC_BYTES,
+              "flight record layout drifted from trace/events.py");
+
 /* engine -> Python callback kinds */
 constexpr int CB_STATUS = 0;       // (tok, set_mask, clear_mask)
 constexpr int CB_CHILD_BORN = 1;   // (listener_tok, child_tok)
@@ -1490,6 +1544,34 @@ struct Engine {
   uint64_t state_epoch = 0;
   StableVec<std::unique_ptr<SocketN>> socks;  // token -> socket
   StableVec<AppN> apps;                       // engine-resident apps
+
+  /* Fixed-record flight ring (set_flight / flight_take): per-round
+   * milestones recorded while run_span iterates, drained by the
+   * manager right after each span alongside the span-export path.
+   * Off by default — a disabled recorder costs one branch per round.
+   * A full ring overwrites the oldest record and counts the loss;
+   * the overwrite point is a function of the event sequence alone,
+   * so a capped stream stays deterministic.  Neither recording nor
+   * draining mutates simulation state (state_epoch untouched: the
+   * device-span residency protocol must survive a drain). */
+  std::vector<FlightRec> flight_ring;
+  size_t flight_head = 0, flight_len = 0;
+  uint64_t flight_dropped = 0;
+  bool flight_on = false;
+
+  void flight_push(int64_t t, int32_t kind, int32_t a, int64_t b,
+                   int64_t c) {
+    if (!flight_on || flight_ring.empty()) return;
+    size_t cap = flight_ring.size();
+    if (flight_len == cap) {
+      flight_ring[flight_head] = {t, kind, a, b, c};
+      flight_head = (flight_head + 1) % cap;
+      flight_dropped++;
+      return;
+    }
+    flight_ring[(flight_head + flight_len) % cap] = {t, kind, a, b, c};
+    flight_len++;
+  }
   int dbg_port = -1;  // SHADOWTPU_TCPDBG, resolved once at construction
   Engine() {
     const char *dp = getenv("SHADOWTPU_TCPDBG");
@@ -3185,6 +3267,10 @@ struct Engine {
       if (dynamic_runahead && f.min_latency > 0 &&
           f.min_latency < r.runahead)
         r.runahead = f.min_latency;
+      if (flight_on)
+        /* Default reason EL_ENGINE_SPAN; the manager re-stamps its
+         * refined sub-reason (routed/cold/abort/...) on drain. */
+        flight_push(window_end, FR_ROUND, EL_ENGINE_SPAN, f.n, start);
       r.rounds++;
       r.busy_end = window_end;
       /* Barrier: push_inbox already lowered destination nt slots, so
@@ -6959,6 +7045,38 @@ static PyObject *eng_state_epoch(EngineObj *self, PyObject *) {
       (unsigned long long)self->eng->state_epoch);
 }
 
+static PyObject *eng_set_flight(EngineObj *self, PyObject *args) {
+  /* Enable/disable the flight ring.  Deliberately NOT an epoch bump:
+   * recording observes state, it never mutates it, and bumping would
+   * spuriously invalidate device-resident span carries. */
+  int on;
+  long long cap = 1 << 16;
+  if (!PyArg_ParseTuple(args, "i|L", &on, &cap)) return nullptr;
+  Engine *e = self->eng;
+  e->flight_on = on != 0;
+  e->flight_ring.assign(on && cap > 0 ? (size_t)cap : 0, FlightRec{});
+  e->flight_head = e->flight_len = 0;
+  e->flight_dropped = 0;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_flight_take(EngineObj *self, PyObject *) {
+  /* Drain the ring in record order -> (packed bytes, n_overwritten).
+   * The byte layout is exactly trace/events.py REC. */
+  Engine *e = self->eng;
+  size_t n = e->flight_len, cap = e->flight_ring.size();
+  PyObject *buf = PyBytes_FromStringAndSize(
+      nullptr, (Py_ssize_t)(n * sizeof(FlightRec)));
+  if (!buf) return nullptr;
+  FlightRec *out = (FlightRec *)PyBytes_AS_STRING(buf);
+  for (size_t i = 0; i < n; i++)
+    out[i] = e->flight_ring[(e->flight_head + i) % cap];
+  unsigned long long dropped = e->flight_dropped;
+  e->flight_head = e->flight_len = 0;
+  e->flight_dropped = 0;
+  return Py_BuildValue("(NK)", buf, dropped);
+}
+
 static PyMethodDef eng_methods[] = {
     {"add_host", (PyCFunction)eng_add_host, METH_VARARGS, nullptr},
     {"set_callbacks", (PyCFunction)eng_set_callbacks, METH_VARARGS, nullptr},
@@ -7045,6 +7163,8 @@ static PyMethodDef eng_methods[] = {
     {"trace_entries", (PyCFunction)eng_trace_entries, METH_VARARGS, nullptr},
     {"counters", (PyCFunction)eng_counters, METH_VARARGS, nullptr},
     {"state_epoch", (PyCFunction)eng_state_epoch, METH_NOARGS, nullptr},
+    {"set_flight", (PyCFunction)eng_set_flight, METH_VARARGS, nullptr},
+    {"flight_take", (PyCFunction)eng_flight_take, METH_NOARGS, nullptr},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -7098,5 +7218,15 @@ PyMODINIT_FUNC PyInit__netplane(void) {
   PyModule_AddIntConstant(m, "ST_ESTABLISHED", ST_ESTABLISHED);
   PyModule_AddIntConstant(m, "ST_CLOSED", ST_CLOSED);
   PyModule_AddIntConstant(m, "ST_TIME_WAIT", ST_TIME_WAIT);
+  PyModule_AddIntConstant(m, "FR_ROUND", FR_ROUND);
+  PyModule_AddIntConstant(m, "FR_SPAN_START", FR_SPAN_START);
+  PyModule_AddIntConstant(m, "FR_SPAN_COMMIT", FR_SPAN_COMMIT);
+  PyModule_AddIntConstant(m, "FR_SPAN_ABORT", FR_SPAN_ABORT);
+  PyModule_AddIntConstant(m, "FLIGHT_REC_BYTES", FLIGHT_REC_BYTES);
+  PyObject *reasons = PyTuple_New(EL_N);
+  if (!reasons) return nullptr;
+  for (int i = 0; i < EL_N; i++)
+    PyTuple_SET_ITEM(reasons, i, PyUnicode_FromString(EL_NAMES[i]));
+  PyModule_AddObject(m, "FLIGHT_REASONS", reasons);
   return m;
 }

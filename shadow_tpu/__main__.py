@@ -31,6 +31,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--strace-logging-mode",
                    choices=["off", "standard", "deterministic"],
                    help="per-process syscall logs")
+    p.add_argument("--flight-recorder", choices=["off", "wall", "on"],
+                   help="deterministic flight recorder "
+                        "(docs/OBSERVABILITY.md): 'on' records the "
+                        "sim-time event stream + wall phases into the "
+                        "data dir, 'wall' phases only")
     p.add_argument("--show-build-info", action="store_true")
     return p
 
@@ -80,6 +85,8 @@ def main(argv=None) -> int:
         config.general.progress = True
     if args.strace_logging_mode is not None:
         config.experimental.strace_logging_mode = args.strace_logging_mode
+    if args.flight_recorder is not None:
+        config.experimental.flight_recorder = args.flight_recorder
 
     manager, summary = run_simulation(config, write_data=True)
     if summary.plugin_errors:

@@ -23,6 +23,10 @@ Rules (catalogue + rationale in docs/LINT.md):
   np-in-jit      np.* calls inside a jitted/traced body where jnp is
                  required (host math on traced values breaks tracing
                  or silently constant-folds)
+  sim-channel    wall-clock reads inside the flight recorder's
+                 sim-time channel (class SimChannel, trace/recorder):
+                 the channel is DEFINED to be byte-identical across
+                 runs, so this rule has NO pragma escape (fail closed)
 
 "Jitted/traced bodies" = functions decorated with jit/jax.jit/
 partial(jax.jit, ..), functions passed to lax.while_loop/scan/cond/
@@ -38,7 +42,7 @@ import re
 from shadow_tpu.analysis.report import Violation
 
 RULES = ("py-random", "np-random", "wall-clock", "set-iter",
-         "host-mutation", "tracer-leak", "np-in-jit")
+         "host-mutation", "tracer-leak", "np-in-jit", "sim-channel")
 
 _PRAGMA = re.compile(
     r"#\s*shadow-lint:\s*allow\[([\w\-,\s]+)\]\s*(\S.*)?$")
@@ -202,6 +206,15 @@ class _ModuleLinter:
         parts.append(node.id)
         return parts[::-1]
 
+    @staticmethod
+    def _is_wall_clock(canon: list) -> bool:
+        """THE wall-clock predicate over a canonicalized dotted chain —
+        shared by the `wall-clock` and `sim-channel` rules so a new
+        pattern added here protects both."""
+        return (len(canon) >= 2
+                and (canon[-2], canon[-1]) in _WALL_CLOCK_ATTRS
+                and canon[0] in ("time", "datetime", "os"))
+
     def lint_global(self):
         aliases = self._collect_aliases()
         for node in ast.walk(self.tree):
@@ -247,8 +260,7 @@ class _ModuleLinter:
                     self.flag("np-random", node,
                               f"{dotted}: sequential host RNG; use "
                               f"core/rng.py threefry streams")
-                elif (canon[-2], canon[-1]) in _WALL_CLOCK_ATTRS and \
-                        canon[0] in ("time", "datetime", "os"):
+                elif self._is_wall_clock(canon):
                     self.flag("wall-clock", node,
                               f"{dotted}: wall-clock read — simulation "
                               f"state must come from sim time")
@@ -259,6 +271,48 @@ class _ModuleLinter:
                               else node,
                               "iterating a set: unordered — sort first "
                               "if order can reach simulation state")
+
+    # -- sim-time trace channel --------------------------------------
+    def lint_sim_channel(self):
+        """Any wall-clock read inside a `class SimChannel` body is a
+        violation with NO pragma escape: the sim-time channel's
+        byte-identity contract (docs/OBSERVABILITY.md) admits no
+        sanctioned exception — profiling belongs in WallChannel."""
+        channels = [cls for cls in ast.walk(self.tree)
+                    if isinstance(cls, ast.ClassDef)
+                    and cls.name == "SimChannel"]
+        if not channels:
+            return
+        aliases = self._collect_aliases()
+        # bare names bound by `from time import perf_counter` etc.
+        wall_from: set = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod in _WALL_CLOCK_FROM:
+                    for a in node.names:
+                        if a.name in _WALL_CLOCK_FROM[mod]:
+                            wall_from.add(a.asname or a.name)
+        for cls in channels:
+            for node in ast.walk(cls):
+                hit = None
+                if isinstance(node, ast.Attribute):
+                    parts = self._dotted(node)
+                    if parts is not None:
+                        canon = aliases.get(
+                            parts[0], parts[0]).split(".") + parts[1:]
+                        if self._is_wall_clock(canon):
+                            hit = ".".join(canon)
+                elif isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in wall_from:
+                    hit = node.id
+                if hit is not None:
+                    self.violations.append(Violation(
+                        "sim-channel", self.relpath,
+                        f"{hit}: wall-clock read inside the sim-time "
+                        f"trace channel (byte-identity contract; no "
+                        f"pragma escape)", line=node.lineno))
 
     # -- device-path rules -------------------------------------------
     def lint_device(self):
@@ -349,5 +403,6 @@ def check(repo_root: str, paths=None) -> list:
             continue
         linter.lint_global()
         linter.lint_device()
+        linter.lint_sim_channel()
         violations.extend(linter.violations)
     return violations

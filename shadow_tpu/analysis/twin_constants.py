@@ -32,6 +32,7 @@ _STCP = "shadow_tpu/host/socket_tcp.py"
 _SUDP = "shadow_tpu/host/socket_udp.py"
 _RNG = "shadow_tpu/core/rng.py"
 _PLANE = "shadow_tpu/native/plane.py"
+_TREV = "shadow_tpu/trace/events.py"
 
 # cpp_name -> [(python module, python name)]
 CONTRACTS = [
@@ -114,7 +115,43 @@ CONTRACTS = [
     # threefry parity word + engine park sentinel
     ("TF_PARITY", [(_RNG, "_PARITY")]),
     ("R_BLOCK", [(_PLANE, "R_BLOCK")]),
+    # flight-recorder record layout + event kinds (trace/events.py;
+    # the engine's FlightRec ring must stay byte-compatible with the
+    # Python REC struct)
+    ("FLIGHT_REC_BYTES", [(_TREV, "FLIGHT_REC_BYTES")]),
+    ("FR_ROUND", [(_TREV, "FR_ROUND")]),
+    ("FR_SPAN_START", [(_TREV, "FR_SPAN_START")]),
+    ("FR_SPAN_COMMIT", [(_TREV, "FR_SPAN_COMMIT")]),
+    ("FR_SPAN_ABORT", [(_TREV, "FR_SPAN_ABORT")]),
+    ("FR_N", [(_TREV, "FR_N")]),
+    # device-eligibility reason codes (one per conservative round)
+    ("EL_DEVICE_SPAN", [(_TREV, "EL_DEVICE_SPAN")]),
+    ("EL_ENGINE_SPAN", [(_TREV, "EL_ENGINE_SPAN")]),
+    ("EL_ENGINE_ROUTED", [(_TREV, "EL_ENGINE_ROUTED")]),
+    ("EL_ENGINE_COLD", [(_TREV, "EL_ENGINE_COLD")]),
+    ("EL_ENGINE_ABORT", [(_TREV, "EL_ENGINE_ABORT")]),
+    ("EL_ENGINE_TRANSIENT", [(_TREV, "EL_ENGINE_TRANSIENT")]),
+    ("EL_ENGINE_FAMILY", [(_TREV, "EL_ENGINE_FAMILY")]),
+    ("EL_ENGINE_OFF", [(_TREV, "EL_ENGINE_OFF")]),
+    ("EL_ENGINE_PYLIMIT", [(_TREV, "EL_ENGINE_PYLIMIT")]),
+    ("EL_ROUND_BOUNDARY", [(_TREV, "EL_ROUND_BOUNDARY")]),
+    ("EL_ROUND_OUTBOX", [(_TREV, "EL_ROUND_OUTBOX")]),
+    ("EL_ROUND_GATE", [(_TREV, "EL_ROUND_GATE")]),
+    ("EL_ROUND_CALLBACK", [(_TREV, "EL_ROUND_CALLBACK")]),
+    ("EL_ROUND_FORCED", [(_TREV, "EL_ROUND_FORCED")]),
+    ("EL_ROUND_SCHED", [(_TREV, "EL_ROUND_SCHED")]),
+    ("EL_OBJ_PCAP", [(_TREV, "EL_OBJ_PCAP")]),
+    ("EL_OBJ_CPU", [(_TREV, "EL_OBJ_CPU")]),
+    ("EL_OBJ_PYTASK", [(_TREV, "EL_OBJ_PYTASK")]),
+    ("EL_OBJ_OTHER", [(_TREV, "EL_OBJ_OTHER")]),
+    ("EL_N", [(_TREV, "EL_N")]),
 ]
+
+# Trace enum prefixes that may never gain an UNREGISTERED member: any
+# FR_*/EL_* constant found in the C++ engine must have a CONTRACTS row
+# (and with it a Python twin), so extending the flight-record layout
+# without updating trace/events.py fails closed.
+TRACE_ENUM_PREFIXES = ("FR_", "EL_")
 
 # C++ int arrays <-> Python tuples (threefry rotation schedules)
 ARRAY_CONTRACTS = [
@@ -227,6 +264,44 @@ def check(repo_root: str, cpp_text: str | None = None) -> list:
                     "twin-constant", mod,
                     f"{py_name} = {pv} but C++ REASONS[{py_name}] is at "
                     f"index {table.index(reason)}"))
+
+    # Trace enums are fail-closed: an FR_*/EL_* member added to the
+    # C++ engine without a registered Python twin is itself a
+    # violation (a half-registered flight-record layout must not pass).
+    registered = {name for name, _twins in CONTRACTS}
+    for name in sorted(consts):
+        if name.startswith(TRACE_ENUM_PREFIXES) \
+                and name not in registered:
+            violations.append(Violation(
+                "twin-constant", CPP,
+                f"trace enum {name} has no contract row (register it "
+                f"in analysis/twin_constants.py with a "
+                f"trace/events.py twin)"))
+
+    # EL_NAMES: the reason-string table must mirror the EL_* enum
+    # order on BOTH sides (the eligibility report and the Chrome
+    # export render through it).
+    el_names = strings.get("EL_NAMES", [])
+    py_el = py_consts(_TREV).get("EL_NAMES")
+    if not el_names:
+        violations.append(Violation(
+            "twin-constant", CPP, "C++ EL_NAMES table not found"))
+    elif py_el is None:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            "missing EL_NAMES twin for the C++ reason table"))
+    elif tuple(py_el) != el_names[0]:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            f"EL_NAMES = {tuple(py_el)} but C++ EL_NAMES = "
+            f"{el_names[0]}"))
+    else:
+        n = consts.get("EL_N")
+        if n is not None and len(el_names[0]) != n:
+            violations.append(Violation(
+                "twin-constant", CPP,
+                f"EL_NAMES has {len(el_names[0])} entries but "
+                f"EL_N = {n}"))
 
     # ASYS_NAMES order must mirror the ASYS_* enum
     asys_names = strings.get("ASYS_NAMES", [])

@@ -138,6 +138,14 @@ class ExperimentalConfig:
     # throughput and routes; "force" always takes the device when
     # eligible (parity gates, demonstrations); "off" disables.
     tpu_device_spans: str = "auto"
+    # Deterministic flight recorder (shadow_tpu/trace/,
+    # docs/OBSERVABILITY.md): "on" records both channels (sim-time
+    # event stream + wall-time phases -> flight-sim.bin /
+    # flight-wall.json in the data dir), "wall" records phase timings
+    # only (what bench.py uses), "off" records nothing.  The
+    # device-eligibility audit and the metrics registry run regardless
+    # (cheap counters, always in sim-stats.json).
+    flight_recorder: str = "off"
     # Pin worker threads to distinct CPUs (ref: affinity.c, on by
     # default; docs/parallel_sims.md reports ~3x cost when off).
     use_cpu_pinning: bool = True
@@ -221,6 +229,7 @@ class ConfigOptions:
                 "tpu_exchange_capacity": e.tpu_exchange_capacity,
                 "native_dataplane": e.native_dataplane,
                 "tpu_device_spans": e.tpu_device_spans,
+                "flight_recorder": e.flight_recorder,
                 "openssl_crypto_noop": e.openssl_crypto_noop,
                 "use_cpu_pinning": e.use_cpu_pinning,
                 "use_perf_timers": e.use_perf_timers,
@@ -355,6 +364,9 @@ class ConfigOptions:
                 ("tpu_device_spans", "tpu_device_spans",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
+                ("flight_recorder", "flight_recorder",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
                 ("openssl_crypto_noop", "openssl_crypto_noop", bool),
                 ("use_perf_timers", "use_perf_timers", bool),
@@ -367,6 +379,11 @@ class ConfigOptions:
         if experimental.interface_qdisc not in QDISC_MODES:
             raise ValueError(f"unknown interface_qdisc "
                              f"{experimental.interface_qdisc!r}")
+        if experimental.flight_recorder not in ("off", "wall", "on"):
+            raise ValueError(
+                f"unknown flight_recorder "
+                f"{experimental.flight_recorder!r}; expected one of "
+                f"('off', 'wall', 'on')")
 
         hosts_raw = raw.get("hosts", {}) or {}
         if not hosts_raw:

@@ -309,6 +309,28 @@ class Manager:
         else:
             self._pool = None
 
+        # Observability (shadow_tpu/trace/, docs/OBSERVABILITY.md).
+        # The metrics registry and the device-eligibility audit are
+        # ALWAYS on (integer adds per round/span — they feed
+        # sim-stats.json's metrics block); the flight recorder's
+        # channels are opt-in: "on" records the deterministic sim-time
+        # event stream plus wall phases, "wall" phases only.
+        from shadow_tpu.trace.audit import EligibilityAudit
+        from shadow_tpu.trace.metrics import MetricsRegistry
+        self.metrics = MetricsRegistry()
+        self.audit = EligibilityAudit()
+        self.flight = None
+        fr_mode = config.experimental.flight_recorder
+        if fr_mode in ("on", "wall"):
+            from shadow_tpu.trace.recorder import FlightRecorder
+            self.flight = FlightRecorder(sim=(fr_mode == "on"))
+            if self.flight.sim is not None and self.plane is not None:
+                # Engine-side fixed-record ring: per-round milestones
+                # inside C++ spans, drained after each span.
+                self.plane.engine.set_flight(1)
+            # Wall-phase hook for the per-round dispatch path.
+            self.propagator.wall = self.flight.wall
+
     # ------------------------------------------------------------------
 
     def _schedule_spawn(self, host: Host, index: int, pcfg) -> None:
@@ -439,6 +461,26 @@ class Manager:
         from shadow_tpu.core.simtime import TIME_NEVER
         best = int(self._nt.min())
         return None if best >= TIME_NEVER else best
+
+    def _object_block_reason(self, py_min: int) -> int:
+        """Eligibility audit: classify WHY the earliest-due
+        Python-side host keeps this round off the span path —
+        permanent object-path hosts by cause (CPU model, pcap under
+        per-host engine opt-out, other config), engine hosts carrying
+        transient Python work (spawn/shutdown heap tasks) as py-task.
+        `py_min` is the caller's already-computed minimum over the
+        py-flagged slots, so this is one boolean scan on the (rare)
+        blocked path, not a fresh int64 argmin."""
+        from shadow_tpu.trace import events as trev
+        idx = np.flatnonzero(self._py_work & (self._nt == py_min))
+        h = self.hosts[int(idx[0])]
+        if h.plane is not None:
+            return trev.EL_OBJ_PYTASK
+        if h.cpu is not None:
+            return trev.EL_OBJ_CPU
+        if self.config.hosts[h.name].pcap_enabled:
+            return trev.EL_OBJ_PCAP
+        return trev.EL_OBJ_OTHER
 
     def _active_hosts(self, until: int) -> list:
         """Hosts whose `execute(until)` would do work per the shared
@@ -632,10 +674,43 @@ class Manager:
         # runahead/domain prediction.
         dev_span_K = 32
         from shadow_tpu.core.simtime import TIME_NEVER
+        from shadow_tpu.trace import events as trev
+        # Device-eligibility audit state: every conservative round is
+        # credited EXACTLY ONE trev.EL_* reason code (account_span for
+        # span-served rounds, the per-round tail for the rest), so the
+        # attribution report always sums to summary.rounds.
+        audit = self.audit
+        flight = self.flight
+        fr_sim = flight.sim if flight is not None else None
+        fr_wall = flight.wall if flight is not None else None
+        # Why the per-round path would run when spans are statically
+        # unavailable (refined at runtime when span_ok drops).
+        if self.config.experimental.scheduler != "tpu" \
+                or self.plane is None or device_barrier \
+                or self._perf_timers:
+            per_round_static = trev.EL_ROUND_SCHED
+        elif route is None or route.min_device_batch <= 0:
+            per_round_static = trev.EL_ROUND_FORCED
+        else:
+            per_round_static = trev.EL_ROUND_SCHED
+        # Why device spans are off when they are (refined when the
+        # router disables them at runtime).
+        dev_off_reason = (trev.EL_ENGINE_OFF
+                          if dev_mode not in ("auto", "force", "on")
+                          else trev.EL_ENGINE_FAMILY)
         while start is not None and start < stop:
-            span_now = span_ok and \
-                not getattr(self.propagator, "_outbox", None) and \
-                self.propagator.span_gate()
+            round_reason = per_round_static
+            if span_ok:
+                if getattr(self.propagator, "_outbox", None):
+                    span_now = False
+                    round_reason = trev.EL_ROUND_OUTBOX
+                elif not self.propagator.span_gate():
+                    span_now = False
+                    round_reason = trev.EL_ROUND_GATE
+                else:
+                    span_now = True
+            else:
+                span_now = False
             py_limit = None
             if span_now and self._py_work.any():
                 # Python-side work pending somewhere — transient heap
@@ -655,6 +730,9 @@ class Manager:
                 ra = self.runahead.get()
                 if start > py_min - ra:
                     span_now = False
+                    # A Python-side host is due this round: attribute
+                    # it (pcap / cpu-model / transient py-task / ...).
+                    round_reason = self._object_block_reason(py_min)
                 else:
                     py_limit = py_min - ra + 1
             if span_now:
@@ -669,15 +747,31 @@ class Manager:
                 # buffer a whole sim).
                 max_rounds = 64 if self._pcap_engine else 1024
 
-                def account_span(res, device=False):
+                def account_span(res, reason, device=False,
+                                 family=trev.FAM_CPP):
                     """Book one completed span (C++ or device) and
                     advance the loop.  Returns the next window start
                     (None = simulation drained)."""
                     rounds, busy_rounds, pkts, next_start, busy_end, \
                         ra = res
+                    base_round = summary.rounds
                     summary.rounds += rounds
                     summary.span_rounds += rounds
                     summary.busy_end_ns = busy_end
+                    audit.add(reason, rounds)
+                    if fr_sim is not None:
+                        fr_sim.event(start, trev.FR_SPAN_START, family,
+                                     0, base_round)
+                        if not device:
+                            # Engine per-round records (window_end,
+                            # packets, window start) drained through
+                            # the span-export path; re-stamped with
+                            # the refined eligibility reason.
+                            fr_sim.extend_engine(
+                                *self.plane.engine.flight_take(),
+                                reason=reason)
+                        fr_sim.event(busy_end, trev.FR_SPAN_COMMIT,
+                                     family, pkts, rounds)
                     self.runahead.sync_from_span(ra)
                     prop = self.propagator
                     # Audit split counts dispatches the way the
@@ -713,12 +807,21 @@ class Manager:
                 # C++ span protects those via the shared pw flags; the
                 # device import cannot).
                 use_dev = False
+                # Reason the rounds below land in a C++ span instead
+                # of a device span (the audit's engine-span:* split).
+                if py_limit is not None:
+                    span_reason = trev.EL_ENGINE_PYLIMIT
+                elif not dev_span_on:
+                    span_reason = dev_off_reason
+                else:
+                    span_reason = trev.EL_ENGINE_COLD
                 if dev_span_on and py_limit is None:
                     if dev_mode in ("force", "on"):
                         use_dev = True
                     elif dev_ns_round is not None \
                             and cpp_ns_round is not None:
                         use_dev = dev_ns_round < cpp_ns_round
+                        span_reason = trev.EL_ENGINE_ROUTED
                     elif dev_ns_round is None:
                         # Unmeasured: probing pays the device loop's
                         # XLA compile (tens of seconds on a slow
@@ -733,6 +836,9 @@ class Manager:
                     res, runner = self._device_span(
                         start, stop, limit,
                         min(max_rounds, dev_span_K))
+                    family = (trev.FAM_TCP
+                              if runner is self._dev_span_tcp
+                              else trev.FAM_PHOLD)
                     if res is not None and res[0] == 0:
                         # Zero progress (e.g. heartbeat boundary due
                         # now): benign — the C++/per-round path below
@@ -751,11 +857,14 @@ class Manager:
                             dev_ns_round = per if dev_ns_round is None \
                                 else 0.7 * dev_ns_round + 0.3 * per
                             dev_probe_countdown = 16
-                        start = account_span(res, device=True)
+                        start = account_span(res, trev.EL_DEVICE_SPAN,
+                                             device=True, family=family)
                         continue
                     if res is None and (runner is None
                                         or runner.ineligible):
                         dev_span_on = False  # no device-span family fits
+                        dev_off_reason = trev.EL_ENGINE_FAMILY
+                        span_reason = trev.EL_ENGINE_FAMILY
                     elif res is None and getattr(runner,
                                                  "last_transient",
                                                  False):
@@ -765,16 +874,24 @@ class Manager:
                         # device is re-probed within a few windows
                         # instead of once per sim.
                         dev_retry_soon = True
+                        span_reason = trev.EL_ENGINE_TRANSIENT
                     elif res is None:
                         # abort or transient over-caps: the rollback
                         # path — shrink the speculative window batch,
                         # back off, and give up only after repeated
                         # failures
+                        span_reason = trev.EL_ENGINE_ABORT
+                        if fr_sim is not None:
+                            fr_sim.event(
+                                start, trev.FR_SPAN_ABORT, family,
+                                getattr(runner, "last_abort_code", 0),
+                                0)
                         dev_span_K = max(16, dev_span_K // 4)
                         dev_aborts_row += 1
                         dev_probe_countdown = 16 * dev_aborts_row
                         if dev_aborts_row >= 3:
                             dev_span_on = False
+                            dev_off_reason = trev.EL_ENGINE_ABORT
                 elif dev_span_on:
                     dev_probe_countdown -= 1
 
@@ -787,6 +904,8 @@ class Manager:
                     self._mt_threads)
                 if res is None:
                     span_ok = False  # callback-capable host: per-round
+                    per_round_static = trev.EL_ROUND_CALLBACK
+                    round_reason = per_round_static
                 else:
                     exports = res[6]
                     res = res[:6]
@@ -801,10 +920,13 @@ class Manager:
                         deliver_exports(self.hosts, exports)
                     rounds = res[0]
                     if rounds:
-                        per = (time.perf_counter_ns() - t0) / rounds  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
+                        dt = time.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
+                        per = dt / rounds
                         cpp_ns_round = per if cpp_ns_round is None \
                             else 0.7 * cpp_ns_round + 0.3 * per
-                        start = account_span(res)
+                        if fr_wall is not None:
+                            fr_wall.add("engine-span", dt, t0)
+                        start = account_span(res, span_reason)
                         if exports:
                             # the deliveries lowered object-host slots
                             nxt = self._min_next_event()
@@ -814,10 +936,27 @@ class Manager:
                         continue
                     # rounds == 0 (e.g. heartbeat boundary due now):
                     # fall through to one per-round iteration.
+                    round_reason = trev.EL_ROUND_BOUNDARY
             window_end = min(start + self.runahead.get(), stop)
             self.propagator.begin_round(start, window_end)
-            self._run_hosts(window_end)
-            inflight_min = self.propagator.finish_round()
+            if flight is not None:
+                pk0 = getattr(self.propagator, "packets_batched", 0)
+                t0 = fr_wall.now()
+                self._run_hosts(window_end)
+                t1 = fr_wall.now()
+                fr_wall.add("host-loop", t1 - t0, t0)
+                inflight_min = self.propagator.finish_round()
+                t2 = fr_wall.now()
+                fr_wall.add("propagate", t2 - t1, t1)
+                if fr_sim is not None:
+                    fr_sim.event(
+                        window_end, trev.FR_ROUND, round_reason,
+                        getattr(self.propagator, "packets_batched",
+                                0) - pk0, start)
+            else:
+                self._run_hosts(window_end)
+                inflight_min = self.propagator.finish_round()
+            audit.add(round_reason, 1)
             if self._pcap_engine:
                 self._drain_engine_pcap()  # stream, don't buffer a sim
             summary.rounds += 1
@@ -914,7 +1053,7 @@ class Manager:
         arguments are derived, for every family — the multichip dryrun
         reuses these factories and attaches a device mesh)."""
         tracing = any(h.tracing_enabled for h in self.hosts)
-        return cls(
+        runner = cls(
             self.plane.engine, self.graph.latency_ns,
             self.loss_thresholds,
             np.ascontiguousarray(
@@ -923,6 +1062,9 @@ class Manager:
                                  dtype=np.uint32),
             self.config.general.seed,
             self.config.general.bootstrap_end_time_ns, tracing)
+        if self.flight is not None:
+            runner.wall = self.flight.wall  # dispatch phase profiling
+        return runner
 
     def make_dev_span_runner(self):
         from shadow_tpu.ops.phold_span import PholdSpanRunner
@@ -1052,7 +1194,10 @@ class Manager:
         # Span/device dispatch counters (VERDICT r5 weak #5): router
         # regressions — EWMA flapping, always-aborting device spans,
         # a family stuck ineligible — are visible per RUN here, not
-        # only on bench stderr.
+        # only on bench stderr.  The block lives in the metrics
+        # registry's WALL channel: it measures the scheduler, not the
+        # simulation, so the determinism gate strips it structurally
+        # (metrics.wall) instead of via a hand-maintained regex list.
         prop = self.propagator
         dispatch = {
             "span_rounds": summary.span_rounds,
@@ -1077,6 +1222,22 @@ class Manager:
                                              "resident_hits", 0),
                     "stale_drops": getattr(runner, "stale_drops", 0),
                 }
+        reg = self.metrics
+        reg.ingest("dispatch", dispatch, channel="wall")
+        # One reason code per conservative round (trace/audit.py);
+        # tools/trace renders this as the attribution report.
+        reg.ingest("eligibility", self.audit.as_dict(), channel="wall")
+        if self.flight is not None:
+            reg.ingest("phases",
+                       {name: ns for name, (ns, _c) in
+                        self.flight.wall.phases.items()},
+                       channel="wall")
+            sim = self.flight.sim
+            reg.gauge("flight.sim_records", channel="sim").set(
+                sim.records if sim is not None else 0)
+            reg.gauge("flight.sim_dropped", channel="sim").set(
+                sim.dropped if sim is not None else 0)
+            self.flight.write(base)
         stats = {
             "end_time_ns": summary.end_time_ns,
             "rounds": summary.rounds,
@@ -1086,7 +1247,7 @@ class Manager:
             "packets_dropped": summary.packets_dropped,
             "syscalls": summary.syscalls,
             "syscalls_by_name": syscall_hist,
-            "dispatch": dispatch,
+            "metrics": reg.as_stats(),
             "objects": object_counter.snapshot(),
             "hosts": {h.name: dict(h.counters) for h in self.hosts},
         }

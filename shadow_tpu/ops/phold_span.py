@@ -201,6 +201,15 @@ class PholdSpanRunner(SpanMeshMixin):
         self.resident_hits = 0
         self.stale_drops = 0
         self.micro_iters = 0  # while-iterations across all spans
+        self.last_abort_code = 0  # AB_* bits of the last abort
+        # Flight-recorder wall channel (trace/recorder.WallChannel)
+        # or None: per-dispatch phase walls (export / convert /
+        # compile / execute / import).  Never the sim channel — a
+        # dispatch's wall time is profiling, not simulation state.
+        # _timed_fns: built-fn ids already dispatched once, so the
+        # compile-vs-execute split survives capacity-regrow rebuilds.
+        self.wall = None
+        self._timed_fns: set = set()
 
     # ------------------------------------------------------------------
     # Export bytes <-> numpy state
@@ -1447,9 +1456,14 @@ class PholdSpanRunner(SpanMeshMixin):
     def _export_state(self):
         """Fresh engine export -> state dict, or the int/None
         eligibility verdict passed through from span_export_phold."""
+        w = self.wall
+        t0 = w.now() if w is not None else 0
         d = self.engine.span_export_phold(
             self.CAP_I, self.CAP_T, self.CAP_R, self.CAP_S,
             self.CAP_C, self.CAP_P)
+        if w is not None:
+            t1 = w.now()
+            w.add("export", t1 - t0, t0)
         if d is None or isinstance(d, int):
             return d
         st = self._to_arrays(d)  # also sets self.family/_pay
@@ -1462,6 +1476,9 @@ class PholdSpanRunner(SpanMeshMixin):
         self._static_cols = {
             k: self._put_static(jax, st[k]) for k in RESIDENT_STATIC}
         st.update(self._static_cols)
+        if w is not None:
+            t2 = w.now()
+            w.add("convert", t2 - t1, t1)
         return st
 
     def _resident_input(self):
@@ -1522,7 +1539,10 @@ class PholdSpanRunner(SpanMeshMixin):
         if self.mesh is not None:
             st = self._mesh_put(st)
         mr = self.MAX_ROUNDS if max_rounds is None else max_rounds
+        w = self.wall
         for _grow in range(4):
+            t0 = w.now() if w is not None else 0
+            fresh_fn = id(self._fn) not in self._timed_fns
             out = self._fn(
                 st, self._lat, self._thr, self._node,
                 self._ips_sorted, self._ips_perm,
@@ -1533,9 +1553,19 @@ class PholdSpanRunner(SpanMeshMixin):
              busy_end, span_iters) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
             code = int(st_np["abort_code"])
+            if w is not None:
+                # The first dispatch THROUGH A GIVEN BUILT FN pays
+                # trace+XLA compile (capacity regrows rebuild the fn
+                # and recompile): credit those separately so
+                # "execute" stays the steady state (the np.asarray
+                # forced device completion).
+                self._timed_fns.add(id(self._fn))
+                w.add("compile" if fresh_fn else "execute",
+                      w.now() - t0, t0)
             if code == 0:
                 break
             if code & AB_STRUCT:
+                self.last_abort_code = code
                 # Hard abort regardless of residency (and before any
                 # re-export the next statement would discard); the
                 # consumed resident carry was already cleared above.
@@ -1571,6 +1601,7 @@ class PholdSpanRunner(SpanMeshMixin):
             self._fn = self._cached_build(
                 self._static_cols["peers"].shape[1])
         else:
+            self.last_abort_code = code
             self.aborts += 1
             return None
         if int(rounds) == 0:
@@ -1607,10 +1638,13 @@ class PholdSpanRunner(SpanMeshMixin):
                 "owner": st_np["tr_owner"][:n].astype(
                     np.int32).tobytes(),
             }
+        t0 = w.now() if w is not None else 0
         back = self._from_arrays(st_np)
         self.engine.span_import_phold(
             back, self.CAP_I, self.CAP_T, self.CAP_R, self.CAP_S,
             self.CAP_C, self.CAP_P, traces)
+        if w is not None:
+            w.add("import", w.now() - t0, t0)
         # The import itself bumps the epoch; record it AFTER, so the
         # resident copy is valid exactly until anything else touches
         # the engine.

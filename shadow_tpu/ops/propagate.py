@@ -464,6 +464,9 @@ class TpuPropagator:
         # (src_host_obj, dst_host_obj, evt_seq, packet_or_native_id,
         #  pkt_seq, t_send, is_ctl)
         self._outbox: list = []
+        # Flight-recorder wall channel (trace/recorder.WallChannel) or
+        # None: per-round dispatch phase walls — profiling only.
+        self.wall = None
         self.rounds_dispatched = 0
         self.packets_batched = 0
         # Auditability (VERDICT r3): how much propagation actually ran
@@ -538,7 +541,10 @@ class TpuPropagator:
             route = ROUTE_HOST
         if route == ROUTE_DEVICE:
             md, ml, exports = self._engine_device_round(n, b)
-            self.route.record_device(b, _time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
+            dt = _time.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
+            self.route.record_device(b, dt, n)
+            if self.wall is not None:
+                self.wall.add("propagate-device", dt, t0)
             self.rounds_device += 1
             self.packets_device += n
         else:
@@ -557,7 +563,10 @@ class TpuPropagator:
                      np.frombuffer(ts_b, np.int64),
                      np.frombuffer(ctl_b, np.bool_)), n, b)
             _nf, md, ml, exports = eng.finish_round(self.window_end)
-            self.route.record_host(_time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
+            dt = _time.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
+            self.route.record_host(dt, n)
+            if self.wall is not None:
+                self.wall.add("propagate-host", dt, t0)
         self.rounds_dispatched += 1
         if exports is not None:
             self._deliver_exports(exports)

@@ -221,12 +221,20 @@ class TcpSpanRunner(SpanMeshMixin):
         # one-micro-op-per-iteration reference schedule.
         self.fused = True
         self.micro_iters = 0  # while-iterations across all spans
+        self.last_abort_code = 0  # AB_* bits of the last abort
         # Device-resident state between dispatches (phold_span twin).
         self._res_st = None
         self._res_token = None
         self._static_cols = None
         self.resident_hits = 0
         self.stale_drops = 0
+        # Flight-recorder wall channel (trace/recorder.WallChannel)
+        # or None: per-dispatch phase walls (export / convert /
+        # compile / execute / import) — profiling only.  _timed_fns:
+        # built-fn ids already dispatched once, so the compile-vs-
+        # execute split survives capacity-regrow rebuilds.
+        self.wall = None
+        self._timed_fns: set = set()
 
     def _caps(self):
         return (self.CAP_I, self.CAP_T, self.CAP_CQ, self.CAP_RT,
@@ -1952,7 +1960,12 @@ class TcpSpanRunner(SpanMeshMixin):
     def _export_state(self):
         """Fresh engine export -> state dict, or the int/None
         eligibility verdict passed through from span_export_tcp."""
+        w = self.wall
+        t0 = w.now() if w is not None else 0
         d = self.engine.span_export_tcp(*self._caps())
+        if w is not None:
+            t1 = w.now()
+            w.add("export", t1 - t0, t0)
         if d is None or isinstance(d, int):
             return d
         st = self._to_arrays(d)  # also sets self._CC
@@ -1965,6 +1978,9 @@ class TcpSpanRunner(SpanMeshMixin):
             k: self._put_static(jax, st[k]) for k in RESIDENT_STATIC}
         st.update(self._static_cols)
         self._static_cols["_n_conns"] = st["_n_conns"]
+        if w is not None:
+            t2 = w.now()
+            w.add("convert", t2 - t1, t1)
         return st
 
     def _resident_input(self):
@@ -2050,7 +2066,10 @@ class TcpSpanRunner(SpanMeshMixin):
         # the whole span, and TCP rounds carry ~100x phold's traffic.
         mr = self.MAX_ROUNDS if max_rounds is None \
             else min(max_rounds, self.MAX_ROUNDS)
+        w = self.wall
         for _grow in range(4):
+            _tw = w.now() if w is not None else 0
+            fresh_fn = id(self._fn) not in self._timed_fns
             out = self._fn(
                 st, self._lat, self._thr, self._node,
                 self._ips_sorted, self._ips_perm,
@@ -2061,6 +2080,12 @@ class TcpSpanRunner(SpanMeshMixin):
              busy_end, span_iters) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
             code = int(st_np["abort_code"])
+            if w is not None:
+                # First dispatch through a given built fn pays
+                # trace+XLA compile (capacity regrows rebuild it).
+                self._timed_fns.add(id(self._fn))
+                w.add("compile" if fresh_fn else "execute",
+                      w.now() - _tw, _tw)
             if dbg:
                 print(f"[tcp_span] span done in "
                       f"{_time.perf_counter() - _t0:.1f}s: "  # shadow-lint: allow[wall-clock] debug span timing
@@ -2070,6 +2095,7 @@ class TcpSpanRunner(SpanMeshMixin):
             if code == 0:
                 break
             if code & AB_STRUCT:
+                self.last_abort_code = code
                 # Hard abort regardless of residency (and before any
                 # re-export the next statement would discard — a
                 # domain-drifted re-export here would misaccount the
@@ -2109,6 +2135,7 @@ class TcpSpanRunner(SpanMeshMixin):
                 self.cap_out *= 4
             self._fn = self._cached_build()
         else:
+            self.last_abort_code = code
             self.aborts += 1
             return None
         if int(rounds) == 0:
@@ -2144,8 +2171,11 @@ class TcpSpanRunner(SpanMeshMixin):
                     np.int32).tobytes(),
             }
         st_np["_n_conns"] = n_conns
+        _tw = w.now() if w is not None else 0
         back = self._from_arrays(st_np)
         self.engine.span_import_tcp(back, *self._caps(), traces)
+        if w is not None:
+            w.add("import", w.now() - _tw, _tw)
         # Record AFTER the import's own epoch bump: the resident copy
         # is valid exactly until anything else touches the engine.
         self._res_st = st_out

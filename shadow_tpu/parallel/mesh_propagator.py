@@ -100,7 +100,11 @@ class MeshPropagator:
         # chunk shape compiled inside the timed region (the model keys
         # its own guard on the ROUND bucket, which differs).
         self._step_compiled: set[int] = set()
-        # Observability (mirrors TpuPropagator's counters).
+        # Observability (mirrors TpuPropagator's counters).  `wall` is
+        # the flight recorder's wall channel (or None): the SPMD
+        # step's dispatch+sync is the conservative barrier, recorded
+        # as the "barrier" phase.
+        self.wall = None
         self.rounds_dispatched = 0
         self.packets_batched = 0
         self.packets_exchanged = 0
@@ -275,12 +279,18 @@ class MeshPropagator:
                 ctl[s, :m] = is_ctl[c]
                 valid[s, :m] = True
 
+            _w = self.wall
+            _tw = _w.now() if _w is not None else 0
             out = self.step(sn, dn, ds, sh, ps, ts, ctl, valid, hne,
                             np.int64(self.window_end),
                             np.int64(self.bootstrap_end))
             (deliver, keep, overflow, reachable, lossy, _recv_idx,
              _recv_time, barrier_min, min_latency) = \
                 (np.asarray(o) for o in out)
+            if _w is not None:
+                # The asarray reads block on the all_to_all exchange:
+                # this IS the conservative barrier wait.
+                _w.add("barrier", _w.now() - _tw, _tw)
             self.rounds_dispatched += 1
             self.rounds_device += 1
             self.packets_device += sum(len(c) for c in chunks)
@@ -338,12 +348,18 @@ class MeshPropagator:
             is_ctl[s, :n] = ctl
             valid[s, :n] = True
 
+        _w = self.wall
+        _t0 = _w.now() if _w is not None else 0
         out = self.step(src_node, dst_node, dst_shard, src_host, pkt_seq,
                         t_send, is_ctl, valid, hne,
                         np.int64(self.window_end),
                         np.int64(self.bootstrap_end))
         (deliver, keep, overflow, reachable, lossy, recv_idx, recv_time,
          barrier_min, min_latency) = (np.asarray(o) for o in out)
+        if _w is not None:
+            # The asarray reads block on the all_to_all exchange: this
+            # IS the conservative barrier wait.
+            _w.add("barrier", _w.now() - _t0, _t0)
         self.rounds_dispatched += 1
         self.rounds_device += 1
         self.packets_device += sum(len(ob) for ob in outboxes)

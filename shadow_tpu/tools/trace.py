@@ -1,0 +1,211 @@
+"""Flight-recorder CLI: summarize, attribute, export.
+
+    python -m shadow_tpu.tools.trace DATA_DIR            # summarize
+    python -m shadow_tpu.tools.trace DATA_DIR --chrome out.json
+    python -m shadow_tpu.tools.trace --run sim.yaml      # run + summarize
+    python -m shadow_tpu.tools.trace --smoke [--hosts N] # CI smoke
+
+Reads the artifacts a flight-recorded run leaves in its data
+directory (`sim-stats.json`, `flight-sim.bin`, `flight-wall.json` —
+docs/OBSERVABILITY.md) and prints:
+
+- the sim-time channel summary (records, spans by family, aborts),
+- the device-eligibility attribution report (one reason code per
+  conservative round; the counts always sum to the round total),
+- the wall-time phase breakdown (export/convert/compile/execute/
+  import/barrier/host-loop),
+
+and exports Chrome trace-event JSON (--chrome) that loads in Perfetto
+with rounds, spans, and phases as nested slices.
+
+`--run` executes a config with the flight recorder forced on and then
+summarizes its data directory.  `--smoke` builds a small tgen TCP
+tier (tools/netgen), runs it traced, and exits non-zero unless the
+summary renders and the eligibility report accounts for 100% of
+rounds — the `./setup trace` target.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(data_dir: str):
+    stats_path = os.path.join(data_dir, "sim-stats.json")
+    if not os.path.exists(stats_path):
+        raise FileNotFoundError(
+            f"{stats_path} not found — not a simulation data dir?")
+    with open(stats_path) as f:
+        stats = json.load(f)
+    sim_bytes = b""
+    sim_path = os.path.join(data_dir, "flight-sim.bin")
+    if os.path.exists(sim_path):
+        with open(sim_path, "rb") as f:
+            sim_bytes = f.read()
+    wall = None
+    wall_path = os.path.join(data_dir, "flight-wall.json")
+    if os.path.exists(wall_path):
+        with open(wall_path) as f:
+            wall = json.load(f)
+    return stats, sim_bytes, wall
+
+
+def summarize(data_dir: str, chrome_out: str | None = None,
+              out=sys.stdout) -> bool:
+    """Print the trace summary + eligibility report; write the Chrome
+    export when asked.  Returns True when the eligibility counts
+    account for 100% of rounds."""
+    from shadow_tpu.trace.audit import render_report
+    from shadow_tpu.trace.events import (FLIGHT_REC_BYTES, FR_ROUND,
+                                         FR_SPAN_ABORT, FR_SPAN_COMMIT,
+                                         FR_SPAN_START, iter_records)
+
+    stats, sim_bytes, wall = _load(data_dir)
+    rounds = stats.get("rounds", 0)
+    metrics = stats.get("metrics", {})
+    elig = metrics.get("wall", {}).get("eligibility", {})
+
+    print(f"trace summary for {data_dir}", file=out)
+    print(f"  rounds {rounds}, packets {stats.get('packets_sent', 0)}, "
+          f"events {stats.get('events', 0)}, sim end "
+          f"{stats.get('end_time_ns', 0) / 1e9:.3f}s", file=out)
+
+    if sim_bytes:
+        kinds = {FR_ROUND: 0, FR_SPAN_START: 0, FR_SPAN_COMMIT: 0,
+                 FR_SPAN_ABORT: 0}
+        span_rounds = 0
+        for _t, kind, _a, _b, c in iter_records(sim_bytes):
+            kinds[kind] = kinds.get(kind, 0) + 1
+            if kind == FR_SPAN_COMMIT:
+                span_rounds += c
+        n_recs = len(sim_bytes) // FLIGHT_REC_BYTES
+        print(f"  sim-time channel: {n_recs} records "
+              f"({kinds[FR_ROUND]} round, {kinds[FR_SPAN_COMMIT]} span "
+              f"commits covering {span_rounds} rounds, "
+              f"{kinds[FR_SPAN_ABORT]} aborts)", file=out)
+    else:
+        print("  sim-time channel: absent (run with "
+              "experimental.flight_recorder: on)", file=out)
+
+    ok = bool(elig) and sum(elig.values()) == rounds
+    if elig:
+        print(render_report(elig, rounds), file=out)
+    else:
+        print("  (no eligibility block in sim-stats.json — pre-trace "
+              "artifact?)", file=out)
+
+    phases = metrics.get("wall", {}).get("phases")
+    if phases:
+        print("wall-time phases:", file=out)
+        for name, ns in sorted(phases.items(), key=lambda kv: -kv[1]):
+            print(f"  {name:<16} {ns / 1e9:10.3f}s", file=out)
+
+    if chrome_out is not None:
+        from shadow_tpu.trace.chrome import chrome_trace
+        doc = chrome_trace(sim_bytes, wall)
+        with open(chrome_out, "w") as f:
+            json.dump(doc, f)
+        print(f"chrome trace: {chrome_out} "
+              f"({len(doc['traceEvents'])} events — load in Perfetto "
+              f"or chrome://tracing)", file=out)
+    return ok
+
+
+def run_config(config_path: str, data_dir: str | None = None) -> str:
+    """Run a YAML config with the flight recorder forced on; returns
+    the data directory."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+
+    config = ConfigOptions.from_file(config_path)
+    config.experimental.flight_recorder = "on"
+    if data_dir is not None:
+        config.general.data_directory = data_dir
+    _manager, summary = run_simulation(config, write_data=True)
+    if not summary.ok:
+        for err in summary.plugin_errors:
+            print(f"[trace] plugin error: {err}", file=sys.stderr)
+    return config.general.data_directory
+
+
+def smoke(n_hosts: int) -> int:
+    """50-host traced tgen TCP tier: summary + eligibility must
+    render and account for every round (the ./setup trace target)."""
+    import tempfile
+
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools.netgen import tcp_stream_yaml
+
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "trace-smoke")
+        # Default nbytes keeps every client mid-stream at stop_time
+        # (the generator's expected_final_state is `running`).
+        text = tcp_stream_yaml(n_hosts, loss=0.005, stop_time="2s",
+                               seed=11, scheduler="tpu")
+        config = ConfigOptions.from_yaml_text(text)
+        config.experimental.flight_recorder = "on"
+        config.general.data_directory = base
+        _manager, summary = run_simulation(config, write_data=True)
+        if not summary.ok:
+            print(f"trace smoke: sim failed: {summary.plugin_errors}",
+                  file=sys.stderr)
+            return 1
+        chrome_out = os.path.join(base, "chrome-trace.json")
+        ok = summarize(base, chrome_out=chrome_out)
+        if not ok:
+            print("trace smoke: eligibility report did not account "
+                  "for all rounds", file=sys.stderr)
+            return 1
+        with open(chrome_out) as f:
+            doc = json.load(f)
+        slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        if not slices:
+            print("trace smoke: chrome export has no slices",
+                  file=sys.stderr)
+            return 1
+    print(f"trace smoke: ok ({n_hosts} hosts, {summary.rounds} rounds "
+          f"fully attributed)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shadow_tpu.tools.trace",
+                                 description=__doc__)
+    ap.add_argument("data_dir", nargs="?",
+                    help="data directory of a flight-recorded run")
+    ap.add_argument("--run", metavar="CONFIG",
+                    help="run this YAML config with the flight "
+                         "recorder on, then summarize")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="write Chrome trace-event JSON (Perfetto)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the 50-host traced smoke sim and exit "
+                         "nonzero unless the report renders")
+    ap.add_argument("--hosts", type=int, default=50,
+                    help="host count for --smoke (default 50)")
+    args = ap.parse_args(argv)
+
+    from shadow_tpu.utils.platform import honor_platform_env
+    honor_platform_env()
+
+    if args.smoke:
+        return smoke(args.hosts)
+    if args.run is not None:
+        data_dir = run_config(args.run, args.data_dir)
+    elif args.data_dir is not None:
+        data_dir = args.data_dir
+    else:
+        ap.print_usage(sys.stderr)
+        print("trace: a data directory, --run, or --smoke is required",
+              file=sys.stderr)
+        return 2
+    ok = summarize(data_dir, chrome_out=args.chrome)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""Deterministic flight recorder & performance observatory.
+
+Two strictly separated channels (docs/OBSERVABILITY.md):
+
+- **sim-time channel** (`recorder.SimChannel`): fixed-size binary
+  records stamped with simulated nanoseconds and round index — span
+  start/abort/commit, per-round scheduler decisions with their
+  device-eligibility reason code, packet-plane milestones.  The
+  channel is byte-identical across runs of the same config whenever
+  span/dispatch routing is deterministic (serial schedulers,
+  `tpu_device_spans: off`/`force`; the determinism gate diffs the
+  written `flight-sim.bin` artifact on its serial leg).  Under
+  wall-clock-driven AUTO routing the channel faithfully records the
+  routes actually taken — simulation STATE stays byte-identical
+  either way; only the decision log may differ.  The channel itself
+  MUST NOT read wall clocks: analysis pass 3 fails any wall-clock
+  read inside `SimChannel`, pragma or not.
+
+- **wall-time channel** (`recorder.WallChannel`): per-phase wall
+  timings (host loop, SoA export, dtype conversion, XLA compile vs
+  execute, import, barrier wait) and per-dispatch telemetry.  Pure
+  profiling: the determinism gate strips it.
+
+The record layout and the event/reason enums are twinned with
+`native/netplane.cpp` (the engine's fixed-record ring buffer, drained
+per round through the span-export path) and registered in analysis
+pass 1 — enum drift fails `scripts/lint` before it can corrupt a
+trace.
+
+`metrics.MetricsRegistry` is the single sink for counters/gauges/
+histograms (it replaces the hand-built `sim-stats.json` dispatch
+block), and `audit.EligibilityAudit` assigns every conservative round
+exactly one reason code so "why is this round not on the device?" is
+a one-command report: `python -m shadow_tpu.tools.trace`.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.trace.audit import EligibilityAudit
+from shadow_tpu.trace.metrics import MetricsRegistry
+from shadow_tpu.trace.recorder import FlightRecorder
+
+__all__ = ["EligibilityAudit", "FlightRecorder", "MetricsRegistry"]

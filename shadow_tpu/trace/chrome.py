@@ -1,0 +1,89 @@
+"""Chrome trace-event JSON export (loads in Perfetto / about:tracing).
+
+Sim-time channel: rounds and spans as nested slices on one track
+(spans open with "B"/close with "E"; each round is a complete "X"
+slice inside its span; device-span aborts are instants).  Timestamps
+are simulated microseconds — the timeline IS the simulation.
+
+Wall-time channel: per-phase slices on a second "process" with real
+(relative) microseconds — where a dispatch's wall time went.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.trace.events import (EL_NAMES, FAM_NAMES, FR_ROUND,
+                                     FR_SPAN_ABORT, FR_SPAN_COMMIT,
+                                     FR_SPAN_START, iter_records)
+
+PID_SIM = 1
+PID_WALL = 2
+
+
+def _meta(pid: int, tid: int, what: str, name: str) -> dict:
+    return {"ph": "M", "pid": pid, "tid": tid, "name": what,
+            "args": {"name": name}}
+
+
+def chrome_trace(sim_bytes: bytes, wall: dict | None = None) -> dict:
+    """Build the trace-event JSON object from the raw channel data.
+
+    `sim_bytes` is flight-sim.bin's content; `wall` is the parsed
+    flight-wall.json dict (or None)."""
+    ev: list[dict] = [
+        _meta(PID_SIM, 0, "process_name", "sim-time (simulated µs)"),
+        _meta(PID_SIM, 1, "thread_name", "rounds & spans"),
+    ]
+    open_spans = 0
+    round_idx = 0
+    span_rounds_seen = 0  # FR_ROUND records inside the open span
+    for t, kind, a, b, c in iter_records(sim_bytes):
+        us = t / 1e3
+        if kind == FR_SPAN_START:
+            fam = FAM_NAMES[a] if 0 <= a < len(FAM_NAMES) else str(a)
+            ev.append({"ph": "B", "pid": PID_SIM, "tid": 1, "ts": us,
+                       "name": f"span:{fam}",
+                       "args": {"round": c}})
+            open_spans += 1
+            span_rounds_seen = 0
+        elif kind == FR_SPAN_COMMIT:
+            if open_spans:
+                ev.append({"ph": "E", "pid": PID_SIM, "tid": 1,
+                           "ts": us,
+                           "args": {"rounds": c, "packets": b}})
+                open_spans -= 1
+            # Engine spans already advanced round_idx via their
+            # drained per-round records; device spans carry none, so
+            # only the uncovered remainder advances the counter here.
+            round_idx += max(c - span_rounds_seen, 0)
+            span_rounds_seen = 0
+        elif kind == FR_SPAN_ABORT:
+            fam = FAM_NAMES[a] if 0 <= a < len(FAM_NAMES) else str(a)
+            ev.append({"ph": "i", "pid": PID_SIM, "tid": 1, "ts": us,
+                       "s": "t", "name": f"abort:{fam}",
+                       "args": {"code": b}})
+        elif kind == FR_ROUND:
+            reason = EL_NAMES[a] if 0 <= a < len(EL_NAMES) else str(a)
+            start_us = c / 1e3
+            ev.append({"ph": "X", "pid": PID_SIM, "tid": 1,
+                       "ts": start_us,
+                       "dur": max(us - start_us, 0.001),
+                       "name": f"round {round_idx}",
+                       "args": {"reason": reason, "packets": b}})
+            round_idx += 1
+            if open_spans:
+                span_rounds_seen += 1
+    # Unbalanced opens (a trace cut mid-span) get synthetic closes so
+    # viewers never see a dangling "B".
+    last_us = ev[-1].get("ts", 0) if ev else 0
+    for _ in range(open_spans):
+        ev.append({"ph": "E", "pid": PID_SIM, "tid": 1, "ts": last_us})
+
+    if wall and wall.get("events"):
+        ev.append(_meta(PID_WALL, 0, "process_name",
+                        "wall-time (profiling µs)"))
+        ev.append(_meta(PID_WALL, 1, "thread_name", "phases"))
+        for t0, dur, name in wall["events"]:
+            ev.append({"ph": "X", "pid": PID_WALL, "tid": 1,
+                       "ts": t0 / 1e3, "dur": max(dur / 1e3, 0.001),
+                       "name": name})
+    return {"traceEvents": ev, "displayTimeUnit": "ms"}

@@ -1,0 +1,109 @@
+"""Flight-recorder record layout and event/reason enums.
+
+Every constant here is a TWIN of the same definition in
+native/netplane.cpp (the engine's fixed-record flight ring); analysis
+pass 1 diffs both sides through the contract registry
+(shadow_tpu/analysis/twin_constants.py), so a drifted value or a
+reordered reason table fails `scripts/lint` in seconds instead of
+silently corrupting traces.
+
+Record layout (FLIGHT_REC_BYTES, little-endian, no padding):
+
+    int64  t       simulated nanoseconds of the event
+    int32  kind    FR_* event kind
+    int32  a       kind-specific: eligibility reason (FR_ROUND),
+                   span family (FR_SPAN_*)
+    int64  b       kind-specific: packets (FR_ROUND/FR_SPAN_COMMIT),
+                   abort code (FR_SPAN_ABORT)
+    int64  c       kind-specific: window start ns (FR_ROUND),
+                   round index (FR_SPAN_START), rounds (FR_SPAN_COMMIT)
+"""
+
+from __future__ import annotations
+
+import struct
+
+FLIGHT_REC_BYTES = 32
+
+# Event kinds (C++ twin: the FR_* enum in netplane.cpp).
+FR_ROUND = 0        # one conservative round executed
+FR_SPAN_START = 1   # multi-round span entered (engine or device)
+FR_SPAN_COMMIT = 2  # span committed: rounds/packets imported
+FR_SPAN_ABORT = 3   # device span aborted (transactional rollback)
+FR_N = 4
+
+# Span families (Python-side only: the engine records no span events —
+# the manager orchestrates spans and stamps these itself).
+FAM_CPP = 0     # C++ engine run_span
+FAM_PHOLD = 1   # device-resident PHOLD/udp-mesh family
+FAM_TCP = 2     # device-resident TCP steady-stream family
+FAM_NAMES = ("engine", "device-phold", "device-tcp")
+
+# Device-eligibility reason codes (C++ twin: EL_* enum + EL_NAMES
+# string table in netplane.cpp).  Every conservative round is assigned
+# EXACTLY ONE of these by the manager's round loop; the audit report
+# (tools/trace) therefore always sums to the total round count.
+EL_DEVICE_SPAN = 0        # stepped inside a device-resident span
+EL_ENGINE_SPAN = 1        # stepped inside a C++ engine span
+EL_ENGINE_ROUTED = 2      # C++ span: EWMA measured it faster
+EL_ENGINE_COLD = 3        # C++ span: device compile budget not earned
+EL_ENGINE_ABORT = 4       # C++ span: device span aborted (rollback)
+EL_ENGINE_TRANSIENT = 5   # C++ span: device family transiently out
+EL_ENGINE_FAMILY = 6      # C++ span: no device-span family fits
+EL_ENGINE_OFF = 7         # C++ span: tpu_device_spans=off
+EL_ENGINE_PYLIMIT = 8     # C++ span capped before an object host
+EL_ROUND_BOUNDARY = 9     # per-round: heartbeat/limit boundary
+EL_ROUND_OUTBOX = 10      # per-round: object-path outbox pending
+EL_ROUND_GATE = 11        # per-round: route model holds the device
+EL_ROUND_CALLBACK = 12    # per-round: callback-capable host present
+EL_ROUND_FORCED = 13      # per-round: forced-device audit mode
+EL_ROUND_SCHED = 14       # per-round: non-span scheduler
+EL_OBJ_PCAP = 15          # object-path host due now: pcap capture
+EL_OBJ_CPU = 16           # object-path host due now: CPU model
+EL_OBJ_PYTASK = 17        # engine host with transient Python work
+EL_OBJ_OTHER = 18         # object-path host due now: other config
+EL_N = 19
+
+# Order must mirror the EL_* values above AND the C++ EL_NAMES table
+# (pass 1 checks both directions).
+EL_NAMES = (
+    "device-span",
+    "engine-span",
+    "engine-span:routed",
+    "engine-span:cold-budget",
+    "engine-span:abort-rollback",
+    "engine-span:transient",
+    "engine-span:ineligible-family",
+    "engine-span:device-off",
+    "engine-span:py-limit",
+    "per-round:boundary",
+    "per-round:outbox",
+    "per-round:span-gate",
+    "per-round:callback-host",
+    "per-round:forced-device",
+    "per-round:scheduler",
+    "object-path:pcap",
+    "object-path:cpu-model",
+    "object-path:py-task",
+    "object-path:other",
+)
+assert len(EL_NAMES) == EL_N
+assert len(FAM_NAMES) == FAM_TCP + 1
+
+REC = struct.Struct("<qiiqq")
+assert REC.size == FLIGHT_REC_BYTES
+
+# numpy structured dtype for bulk decode (field order == REC).
+REC_DTYPE = [("t", "<i8"), ("kind", "<i4"), ("a", "<i4"),
+             ("b", "<i8"), ("c", "<i8")]
+
+
+def pack(t: int, kind: int, a: int, b: int, c: int) -> bytes:
+    return REC.pack(t, kind, a, b, c)
+
+
+def iter_records(buf: bytes):
+    """Yield (t, kind, a, b, c) tuples from a packed record stream."""
+    for off in range(0, len(buf) - len(buf) % FLIGHT_REC_BYTES,
+                     FLIGHT_REC_BYTES):
+        yield REC.unpack_from(buf, off)

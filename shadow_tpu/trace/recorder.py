@@ -1,0 +1,140 @@
+"""The flight recorder: one sim-time channel + one wall-time channel.
+
+The two channels never mix.  `SimChannel` is stamped exclusively with
+simulated nanoseconds and round indices — analysis pass 3 forbids any
+wall-clock read inside the class, with no pragma escape — so the
+written `flight-sim.bin` is byte-identical across runs whenever the
+recorded DECISIONS are deterministic (serial schedulers, pinned
+device-span routing); under wall-clock-driven auto routing it
+faithfully logs the routes taken while simulation state stays
+byte-identical regardless.  `WallChannel` is the profiling side:
+per-phase wall aggregates plus a bounded per-instance event list for
+the Chrome trace export; the determinism gate strips its artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from shadow_tpu.trace.events import FR_ROUND, REC, REC_DTYPE
+
+
+class SimChannel:
+    """Deterministic event stream (simulated time only).
+
+    Records are appended pre-packed (events.REC) so the in-memory
+    representation IS the artifact: `to_bytes()` is a join, and two
+    identical simulations produce identical byte streams.  A capacity
+    cap drops (and counts) the tail instead of growing without bound —
+    the drop point is a function of the event sequence alone, so a
+    capped stream is still deterministic.
+    """
+
+    def __init__(self, cap: int = 1 << 22):
+        self._chunks: list[bytes] = []
+        self._cap = cap
+        self.records = 0
+        self.dropped = 0
+
+    def event(self, t: int, kind: int, a: int, b: int, c: int) -> None:
+        if self.records >= self._cap:
+            self.dropped += 1
+            return
+        self._chunks.append(REC.pack(int(t), kind, int(a), int(b),
+                                     int(c)))
+        self.records += 1
+
+    def extend_engine(self, buf: bytes, engine_dropped: int,
+                      reason: int) -> None:
+        """Append a drained engine flight-ring buffer (fixed records,
+        layout twinned with FlightRec in netplane.cpp), re-stamping
+        the manager's refined eligibility reason onto the engine's
+        generic per-round records."""
+        if not buf:
+            self.dropped += int(engine_dropped)
+            return
+        arr = np.frombuffer(bytearray(buf), dtype=REC_DTYPE)
+        rounds = arr["kind"] == FR_ROUND
+        arr["a"][rounds] = reason
+        n = len(arr)
+        if self.records + n > self._cap:
+            keep = max(self._cap - self.records, 0)
+            self.dropped += n - keep
+            arr = arr[:keep]
+            n = keep
+        if n:
+            self._chunks.append(arr.tobytes())
+            self.records += n
+        self.dropped += int(engine_dropped)
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self._chunks)
+
+
+class WallChannel:
+    """Wall-clock phase profiling: per-phase aggregate totals plus a
+    bounded (t0, duration, name) event list for slice rendering."""
+
+    def __init__(self, max_events: int = 200_000):
+        self.phases: dict[str, list] = {}  # name -> [total_ns, count]
+        self.events: list = []             # (t0_rel_ns, dur_ns, name)
+        self.dropped_events = 0
+        self._max_events = max_events
+        self._epoch = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] wall-time channel epoch
+
+    def now(self) -> int:
+        return time.perf_counter_ns()  # shadow-lint: allow[wall-clock] wall-time channel is the profiling side
+
+    def add(self, name: str, dur_ns: int, t0_ns: int | None = None
+            ) -> None:
+        slot = self.phases.get(name)
+        if slot is None:
+            slot = self.phases[name] = [0, 0]
+        slot[0] += int(dur_ns)
+        slot[1] += 1
+        if t0_ns is not None:
+            if len(self.events) < self._max_events:
+                self.events.append((int(t0_ns) - self._epoch,
+                                    int(dur_ns), name))
+            else:
+                self.dropped_events += 1
+
+    def totals(self) -> dict:
+        """name -> total seconds (rounded), for one-line summaries."""
+        return {name: round(ns / 1e9, 3)
+                for name, (ns, _cnt) in sorted(self.phases.items())}
+
+    def as_dict(self) -> dict:
+        return {
+            "phases": {name: {"ns": ns, "count": cnt}
+                       for name, (ns, cnt) in sorted(
+                           self.phases.items())},
+            "events": [list(e) for e in self.events],
+            "dropped_events": self.dropped_events,
+        }
+
+
+class FlightRecorder:
+    """Bundle of the two channels plus the artifact writer.
+
+    `sim=False` builds a wall-only recorder (phase profiling without
+    the event stream) — what bench.py uses so recorded rungs carry the
+    per-phase breakdown without paying for event capture."""
+
+    SIM_FILE = "flight-sim.bin"
+    WALL_FILE = "flight-wall.json"
+
+    def __init__(self, sim: bool = True, sim_cap: int = 1 << 22):
+        self.sim = SimChannel(sim_cap) if sim else None
+        self.wall = WallChannel()
+
+    def write(self, data_dir: str) -> None:
+        if self.sim is not None:
+            with open(os.path.join(data_dir, self.SIM_FILE), "wb") as f:
+                f.write(self.sim.to_bytes())
+        with open(os.path.join(data_dir, self.WALL_FILE), "w") as f:
+            json.dump(self.wall.as_dict(), f, indent=1)

@@ -26,6 +26,7 @@ network:
 experimental:
   scheduler: {scheduler}
   strace_logging_mode: deterministic
+  flight_recorder: "{flight}"
 hosts:
   alice:
     network_node_id: 0
@@ -40,13 +41,13 @@ hosts:
 
 
 def run_sim(tmp_path, name, scheduler, parallelism=1,
-            want_manager=False):
+            want_manager=False, flight="off"):
     from shadow_tpu.core.config import ConfigOptions
     from shadow_tpu.core.manager import run_simulation
 
     data = str(tmp_path / name)
     cfg = ConfigOptions.from_yaml_text(
-        CONFIG.format(data=data, scheduler=scheduler))
+        CONFIG.format(data=data, scheduler=scheduler, flight=flight))
     cfg.general.parallelism = parallelism
     manager, summary = run_simulation(cfg, write_data=True)
     assert summary.ok, summary.plugin_errors
@@ -54,6 +55,7 @@ def run_sim(tmp_path, name, scheduler, parallelism=1,
 
 
 def collect(dirpath):
+    import json
     import re
     out = {}
     for root, _, files in os.walk(dirpath):
@@ -63,13 +65,20 @@ def collect(dirpath):
             with open(p, "rb") as f:
                 data = f.read()
             if fn == "sim-stats.json":
-                # The dispatch block is scheduler TELEMETRY (span vs
-                # device vs per-round split) — it measures the
-                # scheduler, so the cross-scheduler gate must not
-                # byte-diff it.  Simulation state stays covered.
-                data = re.sub(rb'"dispatch": \{.*?\n  \},?',
-                              b'"dispatch": "<normalized>",', data,
-                              flags=re.S)
+                # Structural normalization via the metrics registry's
+                # channel split: metrics.wall is scheduler/routing/
+                # profiling TELEMETRY (dispatch split, eligibility
+                # histogram, phase walls) and is stripped wholesale;
+                # metrics.sim and everything else — including the
+                # flight recorder's sim-channel artifact below — is
+                # byte-diffed.  No hand-maintained normalize list.
+                stats = json.loads(data)
+                stats.get("metrics", {}).pop("wall", None)
+                data = json.dumps(stats, indent=2,
+                                  sort_keys=True).encode()
+            if fn == "flight-wall.json":
+                # The wall-time channel is profiling by definition.
+                data = b"<wall-channel: normalized>"
             if fn == "processed-config.yaml":
                 # Runs legitimately differ only in output path and (for
                 # the cross-scheduler gate) the scheduler knob itself;
@@ -85,8 +94,14 @@ def collect(dirpath):
 
 
 def test_two_runs_byte_identical(tmp_path):
-    a = collect(run_sim(tmp_path, "run1", "serial"))
-    b = collect(run_sim(tmp_path, "run2", "serial"))
+    # Flight recorder ON for the same-scheduler gate: the sim-time
+    # channel (flight-sim.bin) is byte-diffed alongside traces/pcaps
+    # on the gate's real tgen/pcap/strace workload.  The wall channel
+    # is normalized by collect().  (The cross-scheduler gate below
+    # keeps it off: scheduling DECISIONS legitimately differ between
+    # schedulers, and that is exactly what the sim channel records.)
+    a = collect(run_sim(tmp_path, "run1", "serial", flight="on"))
+    b = collect(run_sim(tmp_path, "run2", "serial", flight="on"))
     assert a.keys() == b.keys()
     for rel in a:
         assert a[rel] == b[rel], f"{rel} differs between identical runs"
@@ -94,6 +109,7 @@ def test_two_runs_byte_identical(tmp_path):
     assert any(r.endswith(".strace") for r in a)
     assert any(r.endswith(".pcap") for r in a)
     assert "packet-trace.txt" in a
+    assert a["flight-sim.bin"], "sim channel recorded nothing"
 
 
 def test_parallel_and_tpu_schedulers_byte_identical(tmp_path):
@@ -110,7 +126,8 @@ def test_parallel_and_tpu_schedulers_byte_identical(tmp_path):
 def test_cli_end_to_end(tmp_path):
     cfg_path = tmp_path / "sim.yaml"
     data = tmp_path / "cli-data"
-    cfg_path.write_text(CONFIG.format(data=data, scheduler="serial"))
+    cfg_path.write_text(CONFIG.format(data=data, scheduler="serial",
+                                      flight="off"))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     result = subprocess.run(
         [sys.executable, "-m", "shadow_tpu", str(cfg_path), "--progress"],
@@ -126,7 +143,8 @@ def test_cli_end_to_end(tmp_path):
 def test_cli_reports_plugin_errors(tmp_path):
     cfg_path = tmp_path / "sim.yaml"
     data = tmp_path / "bad-data"
-    text = CONFIG.format(data=data, scheduler="serial").replace(
+    text = CONFIG.format(data=data, scheduler="serial",
+                         flight="off").replace(
         "path: tgen-server", "path: no-such-app")
     cfg_path.write_text(text)
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -42,7 +42,9 @@ def test_forced_device_dispatch_block(tmp_path):
     with open(os.path.join(str(tmp_path / "data"),
                            "sim-stats.json")) as f:
         stats = json.load(f)
-    d = stats["dispatch"]
+    # The dispatch block migrated into the metrics registry's wall
+    # channel (scheduler telemetry; the det gate strips metrics.wall).
+    d = stats["metrics"]["wall"]["dispatch"]
     # the run really propagated traffic...
     assert d["rounds_dispatched"] > 0
     assert d["packets_batched"] > 0
@@ -55,3 +57,8 @@ def test_forced_device_dispatch_block(tmp_path):
     assert d["span_rounds"] == 0, d
     prop = manager.propagator
     assert prop.rounds_device == d["rounds_device"]
+    # Eligibility audit: forced-device mode must attribute every
+    # round, and the counts must sum to the round total.
+    elig = stats["metrics"]["wall"]["eligibility"]
+    assert sum(elig.values()) == stats["rounds"], elig
+    assert elig.get("per-round:forced-device", 0) > 0, elig

@@ -119,3 +119,47 @@ def test_stale_residency_entry_is_caught(tmp_path, monkeypatch):
     v = soa_layout.check(ROOT)
     assert any("recv_bytes" in x.message for x in v), \
         [x.message for x in v]
+
+
+def test_trace_record_layout_drift_is_caught(cpp_text):
+    """Flight-record layout drift (ISSUE 4): a resized record would
+    desynchronize the engine ring from trace/events.py REC."""
+    mutated = _mutate(cpp_text, "constexpr int FLIGHT_REC_BYTES = 32;",
+                      "constexpr int FLIGHT_REC_BYTES = 40;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("FLIGHT_REC_BYTES" in x.message and "40" in x.message
+               for x in v), [x.render() for x in v]
+
+
+def test_trace_event_enum_reorder_is_caught(cpp_text):
+    """Swapping two FR_* members shifts every later value — the
+    implicit-increment extraction must surface the drift."""
+    mutated = _mutate(
+        cpp_text, "FR_ROUND = 0, FR_SPAN_START, FR_SPAN_COMMIT",
+        "FR_ROUND = 0, FR_SPAN_COMMIT, FR_SPAN_START")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("FR_SPAN" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_unregistered_trace_enum_fails_closed(cpp_text):
+    """A new EL_* reason added engine-side without a contract row (and
+    a Python twin) must fail the pass, not silently under-check."""
+    mutated = _mutate(cpp_text, "EL_OBJ_OTHER, EL_N,",
+                      "EL_OBJ_OTHER, EL_ROGUE, EL_N,")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("EL_ROGUE" in m and "no contract row" in m
+               for m in msgs), msgs
+
+
+def test_trace_reason_table_reorder_is_caught(cpp_text):
+    """Reordering EL_NAMES alone (enum untouched) must be caught by
+    the string-table twin check."""
+    mutated = _mutate(
+        cpp_text,
+        '"engine-span:routed",\n    "engine-span:cold-budget",',
+        '"engine-span:cold-budget",\n    "engine-span:routed",')
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("EL_NAMES" in x.message for x in v), \
+        [x.render() for x in v]

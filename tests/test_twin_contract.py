@@ -29,7 +29,8 @@ def test_cpp_constant_extraction_nonempty(cpp_text):
     for name in ("MSS", "MIN_RTO_NS", "MAX_RTO_NS", "DELACK_NS",
                  "WMEM_MAX", "RMEM_MAX", "CODEL_TARGET_NS",
                  "CODEL_HARD_LIMIT", "REFILL_INTERVAL_NS", "S_CLOSED",
-                 "ST_LAST_ACK", "TK_APP_TIMEOUT", "ASYS_N", "TF_PARITY"):
+                 "ST_LAST_ACK", "TK_APP_TIMEOUT", "ASYS_N", "TF_PARITY",
+                 "FLIGHT_REC_BYTES", "FR_SPAN_COMMIT", "EL_N"):
         assert name in consts, name
     assert len(consts) > 60
     assert consts["MSS"] == 1460
