@@ -213,14 +213,29 @@ def run_once(build, scheduler: str, report_routes: str | None = None,
     t0 = time.perf_counter()
     summary = manager.run()
     wall = time.perf_counter() - t0
+    # Sim-netstat drop attribution + TCP stream totals (ISSUE 5): the
+    # per-cause counters are always on, so every rung carries its
+    # `drops` block without paying for the telemetry channel.
+    net = manager.netstat_summary()
+    tcp = net.get("tcp") or {}
+    segs = tcp.get("segments_sent", 0)
+    rtx_rate = (tcp.get("retransmits", 0) / segs) if segs else 0.0
     LAST_RUN.clear()
     LAST_RUN.update({
         "scheduler": scheduler,
         "phases_s": manager.flight.wall.totals(),
         "eligibility": manager.audit.as_dict(),
+        "drops": net["drops"],
+        "retransmit_rate": round(rtx_rate, 6),
     })
     if report_routes is not None:
         print(f"bench[{report_routes}]: {route_split(manager)}",
+              file=sys.stderr)
+        drops_s = ", ".join(f"{k} {v}" for k, v in sorted(
+            net["drops"].items(), key=lambda kv: -kv[1])) or "none"
+        print(f"drops: {drops_s} | retransmit rate "
+              f"{100.0 * rtx_rate:.3f}% "
+              f"({tcp.get('retransmits', 0)}/{segs} segments)",
               file=sys.stderr)
     if devcap and manager.plane is not None:
         rt, rf, steps, ok = manager.plane.engine.devcap_counters()
@@ -843,6 +858,11 @@ def main() -> None:
         # device-eligibility histogram (one reason per round).
         "phases_s": phases,
         "eligibility": elig,
+        # Sim-netstat (ISSUE 5): per-cause drop counts of the last
+        # recorded tpu trial (conservation-checked: wire causes sum
+        # to packets_dropped) and the TCP retransmit-rate figure.
+        "drops": tpu_obs.get("drops", {}),
+        "retransmit_rate": tpu_obs.get("retransmit_rate", 0.0),
     }), flush=True)
 
     # Auxiliary rungs (stderr only).  A failure must not cost the

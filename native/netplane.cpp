@@ -194,6 +194,79 @@ struct FlightRec {
 static_assert(sizeof(FlightRec) == FLIGHT_REC_BYTES,
               "flight record layout drifted from trace/events.py");
 
+/* Sim-netstat (trace/events.py + trace/netstat.py are the Python
+ * twins; analysis pass 1 diffs the enum, the name table and the
+ * record size).  Packet-drop attribution: every trace_drop maps its
+ * reason string to exactly one TEL_* cause (tel_cause_of), so the
+ * per-host cause counters provably sum to pkts_dropped.  Causes
+ * below TEL_WIRE_N count in pkts_dropped; the two TCP receiver
+ * discards (the packet was delivered, its payload refused — it
+ * retransmits later) sit outside that sum. */
+enum {
+  TEL_CODEL = 0, TEL_RTR_LIMIT, TEL_LOSS_EDGE, TEL_UNREACHABLE,
+  TEL_NO_ROUTE, TEL_NO_SOCKET, TEL_TCP_STATE, TEL_BACKLOG_FULL,
+  TEL_UDP_FILTER, TEL_RECVBUF_FULL, TEL_BUCKET_DEFER,
+  TEL_REASM_FULL, TEL_RECVWIN_TRUNC, TEL_N,
+};
+constexpr int TEL_WIRE_N = 11;
+
+/* Order mirrors the TEL_* enum (and trace/events.py TEL_NAMES). */
+static const char *TEL_NAMES[TEL_N] = {
+    "codel",
+    "router-queue",
+    "loss-edge",
+    "unreachable",
+    "no-route",
+    "no-socket",
+    "tcp-state",
+    "backlog-full",
+    "udp-filter",
+    "recv-buffer-full",
+    "bucket-defer-overflow",
+    "reassembly-full",
+    "recv-window-trunc",
+};
+
+/* Drop-reason string -> TEL_* cause (trace/events.py TEL_BY_REASON
+ * twin).  -1 = unmapped; the caller counts it as unattributed, which
+ * the conservation gate rejects — a new drop site without a mapping
+ * fails tier-1, not a release. */
+inline int tel_cause_of(const char *reason) {
+  struct Ent { const char *r; int c; };
+  static const Ent tbl[] = {
+      {"codel", TEL_CODEL},
+      {"rtr-limit", TEL_RTR_LIMIT},
+      {"inet-loss", TEL_LOSS_EDGE},
+      {"unreachable", TEL_UNREACHABLE},
+      {"no-route", TEL_NO_ROUTE},
+      {"no-socket", TEL_NO_SOCKET},
+      {"tcp-closed", TEL_TCP_STATE},
+      {"tcp-stray", TEL_TCP_STATE},
+      {"tcp-dup-syn", TEL_TCP_STATE},
+      {"accept-backlog-full", TEL_BACKLOG_FULL},
+      {"udp-connected-filter", TEL_UDP_FILTER},
+      {"rcvbuf-full", TEL_RECVBUF_FULL},
+  };
+  for (const Ent &e : tbl)
+    if (std::strcmp(reason, e.r) == 0) return e.c;
+  return -1;
+}
+
+/* Per-connection telemetry record; layout twinned byte-for-byte with
+ * trace/events.py TEL_REC ("<qiHHIi9q"). */
+constexpr int TEL_REC_BYTES = 96;
+struct TelRec {
+  int64_t t;        // simulated ns (sampled round's window end)
+  int32_t host;
+  uint16_t lport, rport;
+  uint32_t rip;
+  int32_t state;    // ST_* (connection.py twin values)
+  int64_t cwnd, ssthresh, srtt, rto, rto_backoff, sndbuf, rcvbuf,
+      retransmits, sacks;
+};
+static_assert(sizeof(TelRec) == TEL_REC_BYTES,
+              "telemetry record layout drifted from trace/events.py");
+
 /* engine -> Python callback kinds */
 constexpr int CB_STATUS = 0;       // (tok, set_mask, clear_mask)
 constexpr int CB_CHILD_BORN = 1;   // (listener_tok, child_tok)
@@ -498,6 +571,10 @@ struct TcpConn {
 
   int64_t retransmit_count = 0, segments_sent = 0, segments_received = 0,
           sacked_skip_count = 0;
+  /* Receiver discards (sim-netstat TEL_REASM_FULL / TEL_RECVWIN_TRUNC;
+   * connection.py twins).  tcp_push_in folds the per-call delta into
+   * the host's drop-cause counters — the conn has no host backref. */
+  int64_t reasm_discards = 0, rcvwin_trunc = 0;
 
   TcpConn(uint32_t iss_, int64_t recv_max, int64_t send_max,
           int64_t window_ceiling /* -1 = use recv_max */)
@@ -961,6 +1038,8 @@ struct TcpConn {
     if (seq != rcv_nxt) {
       if (seq_sub(seq, rcv_nxt) < recv_buf_max)
         reassembly.emplace(seq, *payload);  // setdefault: keep first
+      else
+        reasm_discards++;  // beyond the window: receiver discard
       emit_ack(now);
       return;
     }
@@ -984,6 +1063,8 @@ struct TcpConn {
       recv_buf.append(payload.substr(0, (size_t)take));
       rcv_nxt = seq_add(rcv_nxt, take);
     }
+    if ((int64_t)payload.size() > std::max(take, (int64_t)0))
+      rcvwin_trunc++;  // unacked tail: the sender retransmits it
   }
 
   void on_fin(const TcpHdrN &hdr, const std::string &payload, int64_t now) {
@@ -1424,6 +1505,12 @@ struct HostPlane {
   int64_t pkts_sent = 0, pkts_recv = 0, pkts_dropped = 0;
   int64_t events_run = 0;
   int64_t app_sys[ASYS_N] = {0};  // engine-app syscall counters
+  /* Sim-netstat drop attribution: one TEL_* cause per trace_drop
+   * (wire causes sum to pkts_dropped) plus the TCP receiver-discard
+   * deltas folded in by tcp_push_in.  Unattributed = a reason string
+   * with no tel_cause_of mapping; the conservation gate rejects it. */
+  int64_t drop_causes[TEL_N] = {0};
+  int64_t drop_unattributed = 0;
 
   void tpush(TimerEnt e) {
     theap.push_back(e);
@@ -1572,6 +1659,57 @@ struct Engine {
     flight_ring[(flight_head + flight_len) % cap] = {t, kind, a, b, c};
     flight_len++;
   }
+
+  /* Sim-netstat telemetry ring (set_netstat / netstat_take): fixed
+   * TelRec records sampling every live TCP connection's control state
+   * at conservative-round boundaries.  run_span fills it per round;
+   * the per-round path samples through eng_netstat_sample.  Same
+   * contract as the flight ring: no state_epoch bump (observation,
+   * never mutation), full ring overwrites the oldest record and
+   * counts the loss deterministically. */
+  std::vector<TelRec> tel_ring;
+  size_t tel_head = 0, tel_len = 0;
+  uint64_t tel_dropped = 0;
+  bool tel_on = false;
+  int64_t tel_interval = 1;
+
+  void tel_push(const TelRec &r) {
+    if (tel_ring.empty()) return;
+    size_t cap = tel_ring.size();
+    if (tel_len == cap) {
+      tel_ring[tel_head] = r;
+      tel_head = (tel_head + 1) % cap;
+      tel_dropped++;
+      return;
+    }
+    tel_ring[(tel_head + tel_len) % cap] = r;
+    tel_len++;
+  }
+
+  /* Grow the ring to hold `extra` more records (linearized).  A C++
+   * span drains only at COMMIT, so an overwrite mid-span would lose
+   * the OLDEST records while the object path keeps them — breaking
+   * the cross-path byte-identity contract.  The channel's Python-side
+   * cap (drop-newest, applied identically to every producer) is the
+   * single truncation point instead. */
+  void tel_reserve(size_t extra) {
+    size_t need = tel_len + extra;
+    if (need <= tel_ring.size()) return;
+    std::vector<TelRec> lin(need * 2);
+    for (size_t i = 0; i < tel_len; i++)
+      lin[i] = tel_ring[(tel_head + i) % tel_ring.size()];
+    tel_ring = std::move(lin);
+    tel_head = 0;
+  }
+
+  /* One sampled round: the stateless grid-crossing rule (trace/
+   * netstat.py `sampled` and the device kernel's round_body guard are
+   * the twins — the sampled-round set must be path-independent), then
+   * every live connection in canonical (host, lport, rport, rip)
+   * order.  CLOSED conns are dead and LISTEN conns carry no transfer
+   * state; everything else samples. */
+  void tel_sample_round(int64_t start, int64_t window_end);
+
   int dbg_port = -1;  // SHADOWTPU_TCPDBG, resolved once at construction
   Engine() {
     const char *dp = getenv("SHADOWTPU_TCPDBG");
@@ -1725,6 +1863,9 @@ struct Engine {
   void trace_drop(HostPlane *hp, const PacketN *p, const char *reason,
                   int64_t at_time) {
     hp->pkts_dropped++;
+    int cause = tel_cause_of(reason);
+    if (cause >= 0) hp->drop_causes[cause]++;
+    else hp->drop_unattributed++;
     trace_packet(hp, TRACE_DRP, p, reason, at_time);
   }
   void trace_rcv(HostPlane *hp, const PacketN *p, int64_t now) {
@@ -3271,6 +3412,9 @@ struct Engine {
         /* Default reason EL_ENGINE_SPAN; the manager re-stamps its
          * refined sub-reason (routed/cold/abort/...) on drain. */
         flight_push(window_end, FR_ROUND, EL_ENGINE_SPAN, f.n, start);
+      /* Sim-netstat: per-connection samples at the round boundary,
+       * drained by the manager after the span (netstat_take). */
+      tel_sample_round(start, window_end);
       r.rounds++;
       r.busy_end = window_end;
       /* Barrier: push_inbox already lowered destination nt slots, so
@@ -3914,7 +4058,10 @@ struct Engine {
       trace_drop(hp, p, "tcp-closed", now);
       return false;
     }
+    int64_t reasm0 = c->reasm_discards, trunc0 = c->rcvwin_trunc;
     c->on_packet(p->tcp, p->payload, now);
+    hp->drop_causes[TEL_REASM_FULL] += c->reasm_discards - reasm0;
+    hp->drop_causes[TEL_RECVWIN_TRUNC] += c->rcvwin_trunc - trunc0;
     if (s->send_autotune && c->srtt > 0) autotune_send(hp, s);
     tcp_flush(hp, s, tok, now);
     tcp_update_status(s);
@@ -4319,6 +4466,46 @@ struct Engine {
   }
 };
 
+void Engine::tel_sample_round(int64_t start, int64_t window_end) {
+  if (!tel_on || tel_ring.empty()) return;
+  int64_t iv = tel_interval > 0 ? tel_interval : 1;
+  if (start / iv == window_end / iv) return;
+  std::vector<TelRec> recs;
+  for (size_t tok = 0; tok < socks.size(); tok++) {
+    SocketN *raw = socks[tok].get();
+    if (!raw || raw->proto != PROTO_TCP) continue;
+    TcpSocketN *s = static_cast<TcpSocketN *>(raw);
+    TcpConn *c = s->conn.get();
+    if (!c || c->state == ST_CLOSED || c->state == ST_LISTEN) continue;
+    TelRec r;
+    r.t = window_end;
+    r.host = raw->host;
+    r.lport = (uint16_t)s->local_port;
+    r.rport = (uint16_t)s->peer_port;
+    r.rip = s->peer_ip;
+    r.state = c->state;
+    r.cwnd = c->cwnd;
+    r.ssthresh = c->ssthresh;
+    r.srtt = c->srtt;
+    r.rto = c->rto;
+    r.rto_backoff = c->rto_backoff;
+    r.sndbuf = c->send_buf.len;
+    r.rcvbuf = c->recv_buf.len;
+    r.retransmits = c->retransmit_count;
+    r.sacks = c->sacked_skip_count;
+    recs.push_back(r);
+  }
+  std::sort(recs.begin(), recs.end(),
+            [](const TelRec &a, const TelRec &b) {
+              if (a.host != b.host) return a.host < b.host;
+              if (a.lport != b.lport) return a.lport < b.lport;
+              if (a.rport != b.rport) return a.rport < b.rport;
+              return a.rip < b.rip;
+            });
+  tel_reserve(recs.size());
+  for (const TelRec &r : recs) tel_push(r);
+}
+
 /* ================= CPython bindings =============================== */
 
 struct EngineObj {
@@ -4569,6 +4756,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   std::vector<uint32_t> peers(H * P, 0);
   std::vector<int64_t> app_sys(H * ASYS_N), pkts_sent(H), pkts_recv(H),
       pkts_dropped(H), events_run(H);
+  std::vector<int64_t> drop_causes(H * (size_t)TEL_N);
   std::vector<int64_t> eth_psent(H), eth_precv(H), eth_bsent(H),
       eth_brecv(H);
 
@@ -4701,6 +4889,8 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     pkts_sent[h] = hp->pkts_sent;
     pkts_recv[h] = hp->pkts_recv;
     pkts_dropped[h] = hp->pkts_dropped;
+    for (int j = 0; j < TEL_N; j++)
+      drop_causes[h * (size_t)TEL_N + j] = hp->drop_causes[j];
     events_run[h] = hp->events_run;
     eth_psent[h] = hp->eth.packets_sent;
     eth_precv[h] = hp->eth.packets_received;
@@ -4803,6 +4993,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   put("pkts_sent", bytes_vec(pkts_sent));
   put("pkts_recv", bytes_vec(pkts_recv));
   put("pkts_dropped", bytes_vec(pkts_dropped));
+  put("drop_causes", bytes_vec(drop_causes));
   put("events_run", bytes_vec(events_run));
   put("eth_psent", bytes_vec(eth_psent));
   put("eth_precv", bytes_vec(eth_precv));
@@ -4936,6 +5127,8 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
   const int64_t *pkts_sent = col<int64_t>(d, "pkts_sent", H, &ok);
   const int64_t *pkts_recv = col<int64_t>(d, "pkts_recv", H, &ok);
   const int64_t *pkts_dropped = col<int64_t>(d, "pkts_dropped", H, &ok);
+  const int64_t *drop_causes =
+      col<int64_t>(d, "drop_causes", H * (size_t)TEL_N, &ok);
   const int64_t *events_run = col<int64_t>(d, "events_run", H, &ok);
   const int64_t *eth_psent = col<int64_t>(d, "eth_psent", H, &ok);
   const int64_t *eth_precv = col<int64_t>(d, "eth_precv", H, &ok);
@@ -5122,6 +5315,8 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     hp->pkts_sent = pkts_sent[h];
     hp->pkts_recv = pkts_recv[h];
     hp->pkts_dropped = pkts_dropped[h];
+    for (int j = 0; j < TEL_N; j++)
+      hp->drop_causes[j] = drop_causes[h * (size_t)TEL_N + j];
     hp->events_run = events_run[h];
     hp->eth.packets_sent = eth_psent[h];
     hp->eth.packets_received = eth_precv[h];
@@ -5374,6 +5569,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   }
   std::vector<int64_t> app_sys(H * ASYS_N), pkts_sent(H), pkts_recv(H),
       pkts_dropped(H), events_run(H);
+  std::vector<int64_t> drop_causes(H * (size_t)TEL_N);
   std::vector<int64_t> eth_psent(H), eth_precv(H), eth_bsent(H),
       eth_brecv(H);
 
@@ -5455,6 +5651,8 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     pkts_sent[h] = hp->pkts_sent;
     pkts_recv[h] = hp->pkts_recv;
     pkts_dropped[h] = hp->pkts_dropped;
+    for (int j = 0; j < TEL_N; j++)
+      drop_causes[h * (size_t)TEL_N + j] = hp->drop_causes[j];
     events_run[h] = hp->events_run;
     eth_psent[h] = hp->eth.packets_sent;
     eth_precv[h] = hp->eth.packets_received;
@@ -5633,6 +5831,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("pkts_sent", bytes_vec(pkts_sent));
   put("pkts_recv", bytes_vec(pkts_recv));
   put("pkts_dropped", bytes_vec(pkts_dropped));
+  put("drop_causes", bytes_vec(drop_causes));
   put("events_run", bytes_vec(events_run));
   put("eth_psent", bytes_vec(eth_psent));
   put("eth_precv", bytes_vec(eth_precv));
@@ -5777,6 +5976,8 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
   const int64_t *pkts_sent = col<int64_t>(d, "pkts_sent", H, &ok);
   const int64_t *pkts_recv = col<int64_t>(d, "pkts_recv", H, &ok);
   const int64_t *pkts_dropped = col<int64_t>(d, "pkts_dropped", H, &ok);
+  const int64_t *drop_causes =
+      col<int64_t>(d, "drop_causes", H * (size_t)TEL_N, &ok);
   const int64_t *events_run = col<int64_t>(d, "events_run", H, &ok);
   const int64_t *eth_psent = col<int64_t>(d, "eth_psent", H, &ok);
   const int64_t *eth_precv = col<int64_t>(d, "eth_precv", H, &ok);
@@ -5940,6 +6141,8 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
     hp->pkts_sent = pkts_sent[h];
     hp->pkts_recv = pkts_recv[h];
     hp->pkts_dropped = pkts_dropped[h];
+    for (int j = 0; j < TEL_N; j++)
+      hp->drop_causes[j] = drop_causes[h * (size_t)TEL_N + j];
     hp->events_run = events_run[h];
     hp->eth.packets_sent = eth_psent[h];
     hp->eth.packets_received = eth_precv[h];
@@ -7060,6 +7263,96 @@ static PyObject *eng_set_flight(EngineObj *self, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+static PyObject *eng_set_netstat(EngineObj *self, PyObject *args) {
+  /* Enable/disable the sim-netstat telemetry ring.  Like set_flight,
+   * deliberately NOT an epoch bump: sampling observes state, never
+   * mutates it, and bumping would spuriously invalidate device-
+   * resident span carries. */
+  int on;
+  long long interval = 0;
+  /* Initial capacity only: tel_sample_round grows the ring to one
+   * span's worth of records on demand (a fixed cap would overwrite
+   * the oldest mid-span and break cross-path byte-identity). */
+  long long cap = 1 << 12;
+  if (!PyArg_ParseTuple(args, "i|LL", &on, &interval, &cap))
+    return nullptr;
+  Engine *e = self->eng;
+  e->tel_on = on != 0;
+  e->tel_interval = interval > 0 ? interval : 1;
+  e->tel_ring.assign(on && cap > 0 ? (size_t)cap : 0, TelRec{});
+  e->tel_head = e->tel_len = 0;
+  e->tel_dropped = 0;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_netstat_sample(EngineObj *self, PyObject *args) {
+  /* Per-round path: sample one conservative round [start, window_end)
+   * (the engine applies the same grid-crossing rule run_span uses).
+   * No epoch bump — observation only. */
+  long long start, window_end;
+  if (!PyArg_ParseTuple(args, "LL", &start, &window_end)) return nullptr;
+  self->eng->tel_sample_round(start, window_end);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_netstat_take(EngineObj *self, PyObject *) {
+  /* Drain the ring in record order -> (packed bytes, n_overwritten).
+   * The byte layout is exactly trace/events.py TEL_REC. */
+  Engine *e = self->eng;
+  size_t n = e->tel_len, cap = e->tel_ring.size();
+  PyObject *buf = PyBytes_FromStringAndSize(
+      nullptr, (Py_ssize_t)(n * sizeof(TelRec)));
+  if (!buf) return nullptr;
+  TelRec *out = (TelRec *)PyBytes_AS_STRING(buf);
+  for (size_t i = 0; i < n; i++)
+    out[i] = e->tel_ring[(e->tel_head + i) % cap];
+  unsigned long long dropped = e->tel_dropped;
+  e->tel_head = e->tel_len = 0;
+  e->tel_dropped = 0;
+  return Py_BuildValue("(NK)", buf, dropped);
+}
+
+static PyObject *eng_drop_causes(EngineObj *self, PyObject *args) {
+  /* Per-host drop-cause counters -> TEL_N-tuple + unattributed tail
+   * (Host.merge_native_counters folds the deltas). */
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  PyObject *t = PyTuple_New(TEL_N + 1);
+  if (!t) return nullptr;
+  for (int i = 0; i < TEL_N; i++)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(hp->drop_causes[i]));
+  PyTuple_SET_ITEM(t, TEL_N,
+                   PyLong_FromLongLong(hp->drop_unattributed));
+  return t;
+}
+
+static PyObject *eng_netstat_totals(EngineObj *self, PyObject *) {
+  /* Aggregate TCP stream counters over every live connection (bench's
+   * retransmit-rate figure; not part of any byte-diffed artifact). */
+  Engine *e = self->eng;
+  long long segs_sent = 0, segs_recv = 0, rtx = 0, sacks = 0,
+            reasm = 0, trunc = 0, conns = 0;
+  for (size_t tok = 0; tok < e->socks.size(); tok++) {
+    SocketN *raw = e->socks[tok].get();
+    if (!raw || raw->proto != PROTO_TCP) continue;
+    TcpConn *c = static_cast<TcpSocketN *>(raw)->conn.get();
+    if (!c) continue;
+    conns++;
+    segs_sent += c->segments_sent;
+    segs_recv += c->segments_received;
+    rtx += c->retransmit_count;
+    sacks += c->sacked_skip_count;
+    reasm += c->reasm_discards;
+    trunc += c->rcvwin_trunc;
+  }
+  return Py_BuildValue(
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L}", "conns", conns, "segments_sent",
+      segs_sent, "segments_received", segs_recv, "retransmits", rtx,
+      "sacked_skips", sacks, "reasm_discards", reasm, "rcvwin_trunc",
+      trunc);
+}
+
 static PyObject *eng_flight_take(EngineObj *self, PyObject *) {
   /* Drain the ring in record order -> (packed bytes, n_overwritten).
    * The byte layout is exactly trace/events.py REC. */
@@ -7165,6 +7458,13 @@ static PyMethodDef eng_methods[] = {
     {"state_epoch", (PyCFunction)eng_state_epoch, METH_NOARGS, nullptr},
     {"set_flight", (PyCFunction)eng_set_flight, METH_VARARGS, nullptr},
     {"flight_take", (PyCFunction)eng_flight_take, METH_NOARGS, nullptr},
+    {"set_netstat", (PyCFunction)eng_set_netstat, METH_VARARGS, nullptr},
+    {"netstat_sample", (PyCFunction)eng_netstat_sample, METH_VARARGS,
+     nullptr},
+    {"netstat_take", (PyCFunction)eng_netstat_take, METH_NOARGS, nullptr},
+    {"netstat_totals", (PyCFunction)eng_netstat_totals, METH_NOARGS,
+     nullptr},
+    {"drop_causes", (PyCFunction)eng_drop_causes, METH_VARARGS, nullptr},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -7228,5 +7528,12 @@ PyMODINIT_FUNC PyInit__netplane(void) {
   for (int i = 0; i < EL_N; i++)
     PyTuple_SET_ITEM(reasons, i, PyUnicode_FromString(EL_NAMES[i]));
   PyModule_AddObject(m, "FLIGHT_REASONS", reasons);
+  PyModule_AddIntConstant(m, "TEL_REC_BYTES", TEL_REC_BYTES);
+  PyModule_AddIntConstant(m, "TEL_WIRE_N", TEL_WIRE_N);
+  PyObject *causes = PyTuple_New(TEL_N);
+  if (!causes) return nullptr;
+  for (int i = 0; i < TEL_N; i++)
+    PyTuple_SET_ITEM(causes, i, PyUnicode_FromString(TEL_NAMES[i]));
+  PyModule_AddObject(m, "TEL_CAUSES", causes);
   return m;
 }

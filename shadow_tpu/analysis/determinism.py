@@ -274,13 +274,15 @@ class _ModuleLinter:
 
     # -- sim-time trace channel --------------------------------------
     def lint_sim_channel(self):
-        """Any wall-clock read inside a `class SimChannel` body is a
-        violation with NO pragma escape: the sim-time channel's
-        byte-identity contract (docs/OBSERVABILITY.md) admits no
-        sanctioned exception — profiling belongs in WallChannel."""
+        """Any wall-clock read inside a sim-time channel class body
+        (`SimChannel`, the flight recorder's event stream, or
+        `NetstatChannel`, the sim-netstat telemetry stream) is a
+        violation with NO pragma escape: both channels' byte-identity
+        contracts (docs/OBSERVABILITY.md) admit no sanctioned
+        exception — profiling belongs in WallChannel."""
         channels = [cls for cls in ast.walk(self.tree)
                     if isinstance(cls, ast.ClassDef)
-                    and cls.name == "SimChannel"]
+                    and cls.name in ("SimChannel", "NetstatChannel")]
         if not channels:
             return
         aliases = self._collect_aliases()

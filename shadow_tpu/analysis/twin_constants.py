@@ -145,13 +145,47 @@ CONTRACTS = [
     ("EL_OBJ_PYTASK", [(_TREV, "EL_OBJ_PYTASK")]),
     ("EL_OBJ_OTHER", [(_TREV, "EL_OBJ_OTHER")]),
     ("EL_N", [(_TREV, "EL_N")]),
+    # Sim-netstat drop-cause codes + the per-connection telemetry
+    # record layout (both device-span kernels carry the causes they
+    # can attribute, so enum drift would corrupt the conservation
+    # counters byte-for-byte).
+    ("TEL_CODEL", [(_TREV, "TEL_CODEL"), (_TCPS, "TEL_CODEL"),
+                   (_PHLD, "TEL_CODEL")]),
+    ("TEL_RTR_LIMIT", [(_TREV, "TEL_RTR_LIMIT"),
+                       (_TCPS, "TEL_RTR_LIMIT"),
+                       (_PHLD, "TEL_RTR_LIMIT")]),
+    ("TEL_LOSS_EDGE", [(_TREV, "TEL_LOSS_EDGE"),
+                       (_TCPS, "TEL_LOSS_EDGE"),
+                       (_PHLD, "TEL_LOSS_EDGE")]),
+    ("TEL_UNREACHABLE", [(_TREV, "TEL_UNREACHABLE"),
+                         (_TCPS, "TEL_UNREACHABLE"),
+                         (_PHLD, "TEL_UNREACHABLE")]),
+    ("TEL_NO_ROUTE", [(_TREV, "TEL_NO_ROUTE"),
+                      (_PHLD, "TEL_NO_ROUTE")]),
+    ("TEL_NO_SOCKET", [(_TREV, "TEL_NO_SOCKET"),
+                       (_PHLD, "TEL_NO_SOCKET")]),
+    ("TEL_TCP_STATE", [(_TREV, "TEL_TCP_STATE")]),
+    ("TEL_BACKLOG_FULL", [(_TREV, "TEL_BACKLOG_FULL")]),
+    ("TEL_UDP_FILTER", [(_TREV, "TEL_UDP_FILTER")]),
+    ("TEL_RECVBUF_FULL", [(_TREV, "TEL_RECVBUF_FULL"),
+                          (_PHLD, "TEL_RECVBUF_FULL")]),
+    ("TEL_BUCKET_DEFER", [(_TREV, "TEL_BUCKET_DEFER")]),
+    ("TEL_REASM_FULL", [(_TREV, "TEL_REASM_FULL"),
+                        (_TCPS, "TEL_REASM_FULL")]),
+    ("TEL_RECVWIN_TRUNC", [(_TREV, "TEL_RECVWIN_TRUNC"),
+                           (_TCPS, "TEL_RECVWIN_TRUNC")]),
+    ("TEL_WIRE_N", [(_TREV, "TEL_WIRE_N")]),
+    ("TEL_N", [(_TREV, "TEL_N"), (_TCPS, "TEL_N"),
+               (_PHLD, "TEL_N")]),
+    ("TEL_REC_BYTES", [(_TREV, "TEL_REC_BYTES")]),
 ]
 
 # Trace enum prefixes that may never gain an UNREGISTERED member: any
-# FR_*/EL_* constant found in the C++ engine must have a CONTRACTS row
-# (and with it a Python twin), so extending the flight-record layout
-# without updating trace/events.py fails closed.
-TRACE_ENUM_PREFIXES = ("FR_", "EL_")
+# FR_*/EL_*/TEL_* constant found in the C++ engine must have a
+# CONTRACTS row (and with it a Python twin), so extending the
+# flight-record layout or the drop-cause table without updating
+# trace/events.py fails closed.
+TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_")
 
 # C++ int arrays <-> Python tuples (threefry rotation schedules)
 ARRAY_CONTRACTS = [
@@ -302,6 +336,31 @@ def check(repo_root: str, cpp_text: str | None = None) -> list:
                 "twin-constant", CPP,
                 f"EL_NAMES has {len(el_names[0])} entries but "
                 f"EL_N = {n}"))
+
+    # TEL_NAMES: the drop-cause string table must mirror the TEL_*
+    # enum order on BOTH sides (the attribution report and the
+    # conservation gate render through it).
+    tel_names = strings.get("TEL_NAMES", [])
+    py_tel = py_consts(_TREV).get("TEL_NAMES")
+    if not tel_names:
+        violations.append(Violation(
+            "twin-constant", CPP, "C++ TEL_NAMES table not found"))
+    elif py_tel is None:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            "missing TEL_NAMES twin for the C++ cause table"))
+    elif tuple(py_tel) != tel_names[0]:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            f"TEL_NAMES = {tuple(py_tel)} but C++ TEL_NAMES = "
+            f"{tel_names[0]}"))
+    else:
+        n = consts.get("TEL_N")
+        if n is not None and len(tel_names[0]) != n:
+            violations.append(Violation(
+                "twin-constant", CPP,
+                f"TEL_NAMES has {len(tel_names[0])} entries but "
+                f"TEL_N = {n}"))
 
     # ASYS_NAMES order must mirror the ASYS_* enum
     asys_names = strings.get("ASYS_NAMES", [])

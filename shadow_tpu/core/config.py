@@ -146,6 +146,23 @@ class ExperimentalConfig:
     # device-eligibility audit and the metrics registry run regardless
     # (cheap counters, always in sim-stats.json).
     flight_recorder: str = "off"
+    # Sim-netstat (docs/OBSERVABILITY.md "sim-netstat"): "on" records
+    # the deterministic per-connection TCP telemetry channel
+    # (telemetry-sim.bin: cwnd/ssthresh/srtt/RTO/buffers/retransmits
+    # per connection per sampled round, byte-identical across runs AND
+    # across the three execution paths).  The packet-drop attribution
+    # counters (metrics.sim.netstat.drops) run regardless — cheap
+    # integer adds, always in sim-stats.json.
+    sim_netstat: str = "off"
+    # Sim-netstat sampling grid in simulated ns: a conservative round
+    # [start, end) emits samples iff it crosses a grid boundary
+    # (start // interval != end // interval).  0 = every round.
+    netstat_interval_ns: int = 0
+    # Max conservative rounds a C++ engine span may buffer between
+    # pcap drains when engine-side capture is active (was hard-coded;
+    # per-round streams must not buffer a whole sim).  The effective
+    # value is recorded in metrics.wall.dispatch.pcap_span_cap.
+    pcap_span_cap: int = 64
     # Pin worker threads to distinct CPUs (ref: affinity.c, on by
     # default; docs/parallel_sims.md reports ~3x cost when off).
     use_cpu_pinning: bool = True
@@ -230,6 +247,9 @@ class ConfigOptions:
                 "native_dataplane": e.native_dataplane,
                 "tpu_device_spans": e.tpu_device_spans,
                 "flight_recorder": e.flight_recorder,
+                "sim_netstat": e.sim_netstat,
+                "netstat_interval": _ns(e.netstat_interval_ns),
+                "pcap_span_cap": e.pcap_span_cap,
                 "openssl_crypto_noop": e.openssl_crypto_noop,
                 "use_cpu_pinning": e.use_cpu_pinning,
                 "use_perf_timers": e.use_perf_timers,
@@ -367,6 +387,12 @@ class ConfigOptions:
                 ("flight_recorder", "flight_recorder",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
+                ("sim_netstat", "sim_netstat",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
+                ("netstat_interval", "netstat_interval_ns",
+                 units.parse_time_ns),
+                ("pcap_span_cap", "pcap_span_cap", int),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
                 ("openssl_crypto_noop", "openssl_crypto_noop", bool),
                 ("use_perf_timers", "use_perf_timers", bool),
@@ -384,6 +410,12 @@ class ConfigOptions:
                 f"unknown flight_recorder "
                 f"{experimental.flight_recorder!r}; expected one of "
                 f"('off', 'wall', 'on')")
+        if experimental.sim_netstat not in ("off", "on"):
+            raise ValueError(
+                f"unknown sim_netstat {experimental.sim_netstat!r}; "
+                f"expected one of ('off', 'on')")
+        if experimental.pcap_span_cap < 1:
+            raise ValueError("pcap_span_cap must be >= 1")
 
         hosts_raw = raw.get("hosts", {}) or {}
         if not hosts_raw:

@@ -330,6 +330,22 @@ class Manager:
                 self.plane.engine.set_flight(1)
             # Wall-phase hook for the per-round dispatch path.
             self.propagator.wall = self.flight.wall
+        # Sim-netstat (trace/netstat.py): the deterministic
+        # per-connection TCP telemetry channel.  Drop-cause ATTRIBUTION
+        # is always on (Host.trace_drop / the engine's trace_drop map
+        # every drop to one TEL_* cause); the sample channel is opt-in.
+        self.netstat = None
+        if config.experimental.sim_netstat == "on":
+            from shadow_tpu.trace.netstat import NetstatChannel
+            self.netstat = NetstatChannel(
+                config.experimental.netstat_interval_ns)
+            if self.plane is not None:
+                # Engine-side fixed-record telemetry ring: per-round
+                # connection samples inside C++ spans and on the
+                # per-round path, drained alongside the span exports.
+                self.plane.engine.set_netstat(
+                    1, max(int(config.experimental.netstat_interval_ns),
+                           1))
 
     # ------------------------------------------------------------------
 
@@ -683,6 +699,7 @@ class Manager:
         flight = self.flight
         fr_sim = flight.sim if flight is not None else None
         fr_wall = flight.wall if flight is not None else None
+        netstat = self.netstat
         # Why the per-round path would run when spans are statically
         # unavailable (refined at runtime when span_ok drops).
         if self.config.experimental.scheduler != "tpu" \
@@ -742,10 +759,12 @@ class Manager:
                 if py_limit is not None:
                     limit = min(limit, py_limit)
                 # With engine-side pcap, cap the span so capture
-                # buffers hold at most ~64 rounds of packets before
-                # the drain below (per-round streams; spans must not
-                # buffer a whole sim).
-                max_rounds = 64 if self._pcap_engine else 1024
+                # buffers hold at most pcap_span_cap rounds of packets
+                # before the drain below (per-round streams; spans
+                # must not buffer a whole sim).
+                max_rounds = (
+                    self.config.experimental.pcap_span_cap
+                    if self._pcap_engine else 1024)
 
                 def account_span(res, reason, device=False,
                                  family=trev.FAM_CPP):
@@ -772,6 +791,12 @@ class Manager:
                                 reason=reason)
                         fr_sim.event(busy_end, trev.FR_SPAN_COMMIT,
                                      family, pkts, rounds)
+                    if netstat is not None and not device:
+                        # Per-connection samples the C++ span recorded
+                        # at its round boundaries (device spans append
+                        # theirs in the runner, at span commit).
+                        netstat.extend(
+                            *self.plane.engine.netstat_take())
                     self.runahead.sync_from_span(ra)
                     prop = self.propagator
                     # Audit split counts dispatches the way the
@@ -956,6 +981,19 @@ class Manager:
             else:
                 self._run_hosts(window_end)
                 inflight_min = self.propagator.finish_round()
+            if netstat is not None and netstat.sampled(start,
+                                                       window_end):
+                # Sim-netstat at the round boundary: engine-plane
+                # connections sample through the C++ ring (canonical
+                # host/port order); object-plane connections sample
+                # here.  Homogeneous sims — what the cross-path
+                # parity gates compare — emit one globally
+                # host-sorted block per round either way.
+                if self.plane is not None:
+                    eng = self.plane.engine
+                    eng.netstat_sample(start, window_end)
+                    netstat.extend(*eng.netstat_take())
+                netstat.sample_object_hosts(self.hosts, window_end)
             audit.add(round_reason, 1)
             if self._pcap_engine:
                 self._drain_engine_pcap()  # stream, don't buffer a sim
@@ -1048,6 +1086,57 @@ class Manager:
                 w_eth.close()
         return summary
 
+    def drop_cause_totals(self) -> dict:
+        """Packet-drop attribution summed over hosts: cause-name ->
+        count (nonzero causes only; `unattributed` = drops whose
+        reason has no TEL_* mapping — the conservation gate rejects
+        any).  Engine counters merge through the hosts' incremental
+        delta discipline, so this is safe mid-run and at the end."""
+        from shadow_tpu.trace.events import TEL_N, TEL_NAMES
+        causes = [0] * TEL_N
+        unattributed = 0
+        for h in self.hosts:
+            h.merge_native_counters()
+            for i in range(TEL_N):
+                causes[i] += h.drop_causes[i]
+            unattributed += h.drop_unattributed
+        out = {TEL_NAMES[i]: causes[i] for i in range(TEL_N)
+               if causes[i]}
+        if unattributed:
+            out["unattributed"] = unattributed
+        return out
+
+    def netstat_summary(self) -> dict:
+        """bench.py's `drops` block: per-cause drop counts plus TCP
+        stream totals (segments / retransmits) for the retransmit-rate
+        figure.  Wall-side reporting only — never byte-diffed."""
+        out = {"drops": self.drop_cause_totals()}
+        if self.plane is not None:
+            out["tcp"] = self.plane.engine.netstat_totals()
+        else:
+            totals = {"conns": 0, "segments_sent": 0,
+                      "segments_received": 0, "retransmits": 0,
+                      "sacked_skips": 0, "reasm_discards": 0,
+                      "rcvwin_trunc": 0}
+            from shadow_tpu.trace.netstat import iter_host_tcp_sockets
+            for h in self.hosts:
+                if not h.net_built():
+                    continue
+                for s in iter_host_tcp_sockets(h):
+                    conn = s.conn
+                    if conn is None:
+                        continue
+                    totals["conns"] += 1
+                    totals["segments_sent"] += conn.segments_sent
+                    totals["segments_received"] += \
+                        conn.segments_received
+                    totals["retransmits"] += conn.retransmit_count
+                    totals["sacked_skips"] += conn.sacked_skip_count
+                    totals["reasm_discards"] += conn.reasm_discards
+                    totals["rcvwin_trunc"] += conn.rcvwin_trunc
+            out["tcp"] = totals
+        return out
+
     def _make_span_runner(self, cls):
         """Shared device-span runner construction (the ONE place the
         arguments are derived, for every family — the multichip dryrun
@@ -1064,6 +1153,11 @@ class Manager:
             self.config.general.bootstrap_end_time_ns, tracing)
         if self.flight is not None:
             runner.wall = self.flight.wall  # dispatch phase profiling
+        if self.netstat is not None:
+            # Device spans buffer per-round connection samples in the
+            # kernel and append them at span commit (tcp_span only;
+            # the phold family has no TCP connections to sample).
+            runner.netstat = self.netstat
         return runner
 
     def make_dev_span_runner(self):
@@ -1205,6 +1299,11 @@ class Manager:
             "packets_batched": getattr(prop, "packets_batched", 0),
             "rounds_device": getattr(prop, "rounds_device", 0),
             "packets_device": getattr(prop, "packets_device", 0),
+            # Effective engine-pcap span cap (the experimental.
+            # pcap_span_cap knob; 1024 = no engine-pcap capture, the
+            # generic clamp applied).
+            "pcap_span_cap": (self.config.experimental.pcap_span_cap
+                              if self._pcap_engine else 1024),
         }
         for family, runner in (("phold", getattr(self, "_dev_span",
                                                  None)),
@@ -1224,6 +1323,21 @@ class Manager:
                 }
         reg = self.metrics
         reg.ingest("dispatch", dispatch, channel="wall")
+        # Sim-netstat drop attribution (always on): one TEL_* cause
+        # per drop on every execution path, so these counters are
+        # deterministic AND path-identical — they live in the SIM
+        # channel and the determinism gate byte-diffs them.  The
+        # conservation contract (docs/PARITY.md): wire causes sum to
+        # packets_dropped; the two TCP receiver discards sit outside
+        # (their packets were delivered, only payload was refused).
+        reg.ingest("netstat.drops", self.drop_cause_totals(),
+                   channel="sim")
+        if self.netstat is not None:
+            reg.gauge("netstat.records", channel="sim").set(
+                self.netstat.records)
+            reg.gauge("netstat.dropped", channel="sim").set(
+                self.netstat.dropped)
+            self.netstat.write(base)
         # One reason code per conservative round (trace/audit.py);
         # tools/trace renders this as the attribution report.
         reg.ingest("eligibility", self.audit.as_dict(), channel="wall")

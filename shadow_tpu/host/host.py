@@ -24,6 +24,7 @@ from shadow_tpu.net.packet import PROTO_TCP
 from shadow_tpu.net.relay import Relay
 from shadow_tpu.net.router import Router
 from shadow_tpu.net.token_bucket import TokenBucket
+from shadow_tpu.trace.events import TEL_BY_REASON, TEL_N
 
 # Canonical trace kinds, in tiebreak order: a packet sent and dropped at
 # the same instant sorts SND before DRP.
@@ -113,6 +114,14 @@ class Host:
         # Counters for sim-stats (sim_stats.rs).
         self.counters = {"events": 0, "packets_sent": 0, "packets_recv": 0,
                          "packets_dropped": 0, "syscalls": 0}
+        # Sim-netstat drop attribution (trace/events.py TEL_*; the
+        # netplane HostPlane::drop_causes twin): every trace_drop maps
+        # its reason to exactly one cause, so the wire causes sum to
+        # counters["packets_dropped"].  Unattributed = a reason with no
+        # TEL_BY_REASON entry; the conservation gate rejects it.
+        self.drop_causes = [0] * TEL_N
+        self.drop_unattributed = 0
+        self._native_causes_merged = (0,) * (TEL_N + 1)
         # Per-syscall-name histogram (sim_stats.rs syscall counts; merged
         # into sim-stats.json by the manager).
         self.syscall_counts: dict[str, int] = {}
@@ -397,6 +406,11 @@ class Host:
         instant after the round has moved on; canonical sorting makes the
         resulting trace identical to the scalar path's."""
         self.counters["packets_dropped"] += 1
+        cause = TEL_BY_REASON.get(reason)
+        if cause is not None:
+            self.drop_causes[cause] += 1
+        else:
+            self.drop_unattributed += 1
         self.trace_packet(TRACE_DRP, packet, reason, at_time=at_time)
 
     def trace_snd(self, packet) -> None:
@@ -420,6 +434,14 @@ class Host:
         # Python wrapper path counts its own.
         self.counters["events"] += ev - pe
         self._native_merged = (sent, recv, dropped, ev)
+        # Engine drop-cause counters (same delta discipline; the tuple
+        # carries TEL_N causes + the unattributed tail).
+        causes = self.plane.engine.drop_causes(self.id)
+        prev = self._native_causes_merged
+        for i in range(TEL_N):
+            self.drop_causes[i] += causes[i] - prev[i]
+        self.drop_unattributed += causes[TEL_N] - prev[TEL_N]
+        self._native_causes_merged = tuple(causes)
         # Engine-app syscalls (counted C++-side at the exact points the
         # Python dispatch would) fold into the same histograms.
         app_sys = self.plane.engine.app_syscalls(self.id)

@@ -20,6 +20,7 @@ from shadow_tpu.host.status import (S_ACTIVE, S_CLOSED, S_READABLE,
 from shadow_tpu.net import packet as pkt
 from shadow_tpu.net.graph import LOCALHOST_IP
 from shadow_tpu.tcp import connection as tcpc
+from shadow_tpu.trace.events import TEL_REASM_FULL, TEL_RECVWIN_TRUNC
 
 INADDR_ANY = 0
 EPHEMERAL_LO = 32_768
@@ -297,7 +298,14 @@ class TcpSocket(StatusOwner):
         if conn is None:
             host.trace_drop(packet, "tcp-closed")
             return False
+        reasm0, trunc0 = conn.reasm_discards, conn.rcvwin_trunc
         conn.on_packet(packet.tcp, packet.payload, host.now())
+        # Sim-netstat receiver discards (netplane.cpp tcp_push_in
+        # twin): fold the per-packet delta into the host's drop-cause
+        # counters — the connection has no host backref.
+        host.drop_causes[TEL_REASM_FULL] += conn.reasm_discards - reasm0
+        host.drop_causes[TEL_RECVWIN_TRUNC] += \
+            conn.rcvwin_trunc - trunc0
         if self.send_autotune and conn.srtt > 0:
             # ACK processing updated cwnd/RTT: grow the send buffer to
             # keep the congestion window fed (tcp.c autotune-on-ack).

@@ -88,6 +88,15 @@ class NetworkInterface:
             s = self._assoc.get((proto, self.ip, local_port, 0, 0))
         return s
 
+    def associated_sockets(self, proto: int | None = None):
+        """Every associated socket, in association-key order (the
+        sim-netstat walker re-sorts by connection identity, but a
+        deterministic base order keeps dict-insertion history out of
+        the stream)."""
+        for key in sorted(self._assoc):
+            if proto is None or key[0] == proto:
+                yield self._assoc[key]
+
     # ------------------------------------------------------------------
     # Send path (interface.rs:57-119, queuing.rs NetworkQueue)
     # ------------------------------------------------------------------

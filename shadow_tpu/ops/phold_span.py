@@ -77,6 +77,19 @@ RSN_NOROUTE = 5
 RSN_LOSS = 6
 RSN_UNREACH = 7
 
+# Sim-netstat drop-cause slots touched by this kernel (netplane.cpp
+# TEL_* twins; the per-host (H, TEL_N) `drop_causes` column round-
+# trips through the span codec so the engine's attribution counters
+# stay authoritative across device spans).
+TEL_CODEL = 0
+TEL_RTR_LIMIT = 1
+TEL_LOSS_EDGE = 2
+TEL_UNREACHABLE = 3
+TEL_NO_ROUTE = 4
+TEL_NO_SOCKET = 5
+TEL_RECVBUF_FULL = 9
+TEL_N = 13
+
 PK_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
 
 # Abort reason bits: trace/outbox overflows are capacity problems the
@@ -119,7 +132,7 @@ RESIDENT_DERIVED = frozenset({
 RESIDENT_CARRIED = frozenset(
     {
      "app_pkts_dropped", "app_pkts_recv", "app_pkts_sent",
-     "app_sys", "codel_bytes", "codel_count", "codel_drop_next",
+     "app_sys", "codel_bytes", "drop_causes", "codel_count", "codel_drop_next",
      "codel_dropped", "codel_dropping", "codel_first_above",
      "codel_last_count", "cq_enq", "cq_len", "cq_pos",
      "eth_brecv", "eth_bsent", "eth_precv", "eth_psent",
@@ -234,6 +247,7 @@ class PholdSpanRunner(SpanMeshMixin):
         st["app_pkts_sent"] = f("pkts_sent", np.int64)
         st["app_pkts_recv"] = f("pkts_recv", np.int64)
         st["app_pkts_dropped"] = f("pkts_dropped", np.int64)
+        st["drop_causes"] = f("drop_causes", np.int64, (H, TEL_N))
         for k in ("events_run", "eth_psent", "eth_precv", "eth_bsent",
                   "eth_brecv"):
             st[k] = f(k, np.int64)
@@ -351,6 +365,8 @@ class PholdSpanRunner(SpanMeshMixin):
         out["pkts_sent"] = npv("app_pkts_sent").astype(np.int64).tobytes()
         out["pkts_recv"] = npv("app_pkts_recv").astype(np.int64).tobytes()
         out["pkts_dropped"] = npv("app_pkts_dropped").astype(
+            np.int64).tobytes()
+        out["drop_causes"] = npv("drop_causes").astype(
             np.int64).tobytes()
         for k in ("events_run", "eth_psent", "eth_precv", "eth_bsent",
                   "eth_brecv"):
@@ -701,6 +717,8 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["app_pkts_dropped"] = jnp.where(
                     codel_drop, st["app_pkts_dropped"] + 1,
                     st["app_pkts_dropped"])
+                st["drop_causes"] = st["drop_causes"].at[
+                    mrows(codel_drop), TEL_CODEL].add(1, mode="drop")
                 st = tr_append(st, codel_drop, now, TR_DRP, pk, 1)
                 st = dict(st)
                 # dropped lanes stay in the drain (next micro-op
@@ -736,6 +754,8 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["app_pkts_dropped"] = jnp.where(
                     miss, st["app_pkts_dropped"] + 1,
                     st["app_pkts_dropped"])
+                st["drop_causes"] = st["drop_causes"].at[
+                    mrows(miss), TEL_NO_ROUTE].add(1, mode="drop")
                 st = tr_append(st, miss, now, TR_DRP, pk, RSN_NOROUTE)
                 hit = fwd & found
                 st, sq = draw_seq(st, hit)
@@ -757,6 +777,8 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["app_pkts_dropped"] = jnp.where(
                     wrong, st["app_pkts_dropped"] + 1,
                     st["app_pkts_dropped"])
+                st["drop_causes"] = st["drop_causes"].at[
+                    mrows(wrong), TEL_NO_SOCKET].add(1, mode="drop")
                 st = tr_append(st, wrong, now, TR_DRP, pk, RSN_NOSOCK)
                 st = dict(st)
                 deliver = fwd & ~wrong
@@ -765,6 +787,8 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["app_pkts_dropped"] = jnp.where(
                     full, st["app_pkts_dropped"] + 1,
                     st["app_pkts_dropped"])
+                st["drop_causes"] = st["drop_causes"].at[
+                    mrows(full), TEL_RECVBUF_FULL].add(1, mode="drop")
                 st = tr_append(st, full, now, TR_DRP, pk, RSN_RCVBUF)
                 st = dict(st)
                 good = deliver & ~full
@@ -1120,6 +1144,8 @@ class PholdSpanRunner(SpanMeshMixin):
             st["app_pkts_dropped"] = jnp.where(
                 limit_full, st["app_pkts_dropped"] + 1,
                 st["app_pkts_dropped"])
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(limit_full), TEL_RTR_LIMIT].add(1, mode="drop")
             pk_arr = {kk: st[f"ib_{kk}"][hidx, safe] for kk in PK_KEYS}
             st = tr_append(st, limit_full, et, TR_DRP, pk_arr, 2)
             st = dict(st)
@@ -1266,10 +1292,14 @@ class PholdSpanRunner(SpanMeshMixin):
             keep = valid & reachable & ~lossy
             min_lat = jnp.min(jnp.where(keep, latency, I64_MAX))
             st = dict(st)
-            for miss, rsn in ((valid & ~reachable, RSN_UNREACH),
-                              (valid & reachable & lossy, RSN_LOSS)):
+            for miss, rsn, tel in (
+                    (valid & ~reachable, RSN_UNREACH, TEL_UNREACHABLE),
+                    (valid & reachable & lossy, RSN_LOSS,
+                     TEL_LOSS_EDGE)):
                 st["app_pkts_dropped"] = st["app_pkts_dropped"].at[
                     jnp.where(miss, src, OOB)].add(1, mode="drop")
+                st["drop_causes"] = st["drop_causes"].at[
+                    jnp.where(miss, src, OOB), tel].add(1, mode="drop")
                 if tracing:
                     nt_ = st["tr_n"]
                     rank = jnp.cumsum(miss) - 1

@@ -88,6 +88,27 @@ RSN_RTRLIMIT = 2
 RSN_LOSS = 6
 RSN_UNREACH = 7
 
+# Sim-netstat drop-cause slots touched by this kernel (netplane.cpp
+# TEL_* twins; registered in analysis pass 1).  The per-host
+# (H, TEL_N) `drop_causes` column round-trips through the span codec
+# so the engine's counters stay authoritative across device spans.
+TEL_CODEL = 0
+TEL_RTR_LIMIT = 1
+TEL_LOSS_EDGE = 2
+TEL_UNREACHABLE = 3
+TEL_REASM_FULL = 11
+TEL_RECVWIN_TRUNC = 12
+TEL_N = 13
+
+# Telemetry sample fields (trace/events.py TEL_REC order after the
+# identity header) -> the SoA column each samples.
+TEL_FIELDS = (("cwnd", "c_cwnd"), ("ssthresh", "c_ssthresh"),
+              ("srtt", "c_srtt"), ("rto", "c_rto"),
+              ("backoff", "c_rtobackoff"), ("sndbuf", "c_sblen"),
+              ("rcvbuf", "c_rblen"), ("rtx", "c_rtxcount"),
+              ("sacks", "c_sackskip"))
+ST_ESTABLISHED = 4  # every in-domain connection's state
+
 # Packet columns: routing identity + the TCP header.
 ROUTE_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
 TCP_KEYS = ("tseq", "tack", "tflags", "twin", "tsv", "tse", "plen",
@@ -149,6 +170,7 @@ RESIDENT_CARRIED = frozenset(
      "c_ssa", "c_ssthresh", "c_status", "c_tmrdl", "c_tsrecent",
      "c_wakep", "codel_bytes", "codel_count", "codel_drop_next",
      "codel_dropped", "codel_dropping", "codel_first_above",
+     "drop_causes",
      "codel_last_count", "cq_enq", "cq_len", "cq_pos",
      "eth_brecv", "eth_bsent", "eth_precv", "eth_psent",
      "event_seq", "events_run", "ib_len", "ib_pos", "ib_seq",
@@ -180,6 +202,12 @@ class TcpSpanRunner(SpanMeshMixin):
     CAP_RA = 256   # reassembly (an early hole strands ~a window)
     CAP_OP = 256   # socket egress ring
     MAX_ROUNDS = 256
+    # Sim-netstat: per-round telemetry rows buffered on device.  Spans
+    # are clamped to TEL_ROWS rounds while the channel records, so the
+    # (TEL_ROWS, CC) sample buffers can never overflow (sampled rounds
+    # <= rounds <= TEL_ROWS) — a silent skip would break cross-path
+    # byte-parity.
+    TEL_ROWS = 64
 
     def __init__(self, engine, latency_ns, thresholds, host_node,
                  host_ips, seed, bootstrap_end, tracing: bool):
@@ -235,6 +263,12 @@ class TcpSpanRunner(SpanMeshMixin):
         # execute split survives capacity-regrow rebuilds.
         self.wall = None
         self._timed_fns: set = set()
+        # Sim-netstat channel (trace/netstat.NetstatChannel) or None:
+        # the kernel buffers per-round per-connection samples on
+        # device (round_body), and the driver packs them into TEL_REC
+        # records in the canonical (host, lport, rport, rip) order.
+        self.netstat = None
+        self._tel_ident = None  # (host, lport, rport, rip, perm, n)
 
     def _caps(self):
         return (self.CAP_I, self.CAP_T, self.CAP_CQ, self.CAP_RT,
@@ -302,6 +336,7 @@ class TcpSpanRunner(SpanMeshMixin):
         st["th_valid"] = (np.arange(T)[None, :]
                           < f("th_len", np.int32)[:, None])
         st["app_sys"] = f("app_sys", np.int64, (H, ASYS_N))
+        st["drop_causes"] = f("drop_causes", np.int64, (H, TEL_N))
 
         # conn-major
         for k, dt in (("c_host", np.int32), ("c_lport", np.int32),
@@ -451,6 +486,8 @@ class TcpSpanRunner(SpanMeshMixin):
                     npv(f"r{r}_pk_{kk}").astype(
                         PK_DTYPES[kk])).tobytes()
         out["app_sys"] = npv("app_sys").astype(np.int64).tobytes()
+        out["drop_causes"] = npv("drop_causes").astype(
+            np.int64).tobytes()
         for k, dt in (("c_snduna", np.uint32), ("c_sndnxt", np.uint32),
                       ("c_rcvnxt", np.uint32), ("c_recover", np.uint32),
                       ("c_status", np.uint32), ("c_await", np.uint32)):
@@ -473,9 +510,16 @@ class TcpSpanRunner(SpanMeshMixin):
     # The jitted multi-round step
     # ------------------------------------------------------------------
 
+    def _netstat_params(self):
+        """(enabled, interval_ns>=1) — static for the built kernel."""
+        if self.netstat is None:
+            return (False, 1)
+        return (True, max(int(self.netstat.interval_ns), 1))
+
     def _cached_build(self):
         key = (self._H, self._CC, self._caps(), self.cap_out,
-               self.cap_tr, self.tracing, self.fused)
+               self.cap_tr, self.tracing, self.fused,
+               self._netstat_params())
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build()
@@ -492,6 +536,8 @@ class TcpSpanRunner(SpanMeshMixin):
         TR = self.cap_tr
         tracing = self.tracing
         fused = self.fused    # static: fused vs reference dispatch
+        netstat, tel_iv = self._netstat_params()
+        TELR = self.TEL_ROWS
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)
         COOB = jnp.int32(CC + 1)
@@ -946,6 +992,8 @@ class TcpSpanRunner(SpanMeshMixin):
             st["pkts_dropped"] = jnp.where(
                 codel_drop, st["pkts_dropped"] + 1,
                 st["pkts_dropped"])
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(codel_drop), TEL_CODEL].add(1, mode="drop")
             st = tr_append(st, codel_drop, now, TR_DRP, pk, RSN_CODEL)
             st = dict(st)
             pop = pop & ~codel_drop
@@ -1243,9 +1291,15 @@ class TcpSpanRunner(SpanMeshMixin):
             rav = st["ra_valid"][cur]
             ras = st["ra_seq"][cur]
             exists = (rav & (ras == eff_seq[:, None])).any(axis=1)
-            store_it = future \
-                & (s_sub(eff_seq, cg(st, "c_rcvnxt"))
-                   < cg(st, "c_rbmax")) & ~exists
+            in_win = s_sub(eff_seq, cg(st, "c_rcvnxt")) \
+                < cg(st, "c_rbmax")
+            store_it = future & in_win & ~exists
+            # beyond the reassembly window: receiver discard
+            # (connection.py reasm_discards / TEL_REASM_FULL twins)
+            st = dict(st)
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(future & ~in_win), TEL_REASM_FULL].add(
+                1, mode="drop")
             free = jnp.argmin(rav, axis=1)
             ra_over = store_it & rav.all(axis=1)
             st = mark_abort(st, ra_over.any(), AB_STRUCT, 8)
@@ -1268,6 +1322,12 @@ class TcpSpanRunner(SpanMeshMixin):
             space = cg(st, "c_rbmax") - cg(st, "c_rblen")
             take = jnp.minimum(space, eff_len)
             take = jnp.maximum(take, 0)
+            # in-order bytes past the receive buffer: unacked tail,
+            # the sender retransmits (TcpConn::deliver twin)
+            st = dict(st)
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(inord & (eff_len > take)),
+                TEL_RECVWIN_TRUNC].add(1, mode="drop")
             st = cset(st, inord,
                       c_rblen=cg(st, "c_rblen")
                       + jnp.where(inord, take, 0),
@@ -1296,6 +1356,10 @@ class TcpSpanRunner(SpanMeshMixin):
                                        axis=1)[:, 0]
             space = cg(st, "c_rbmax") - cg(st, "c_rblen")
             take = jnp.clip(jnp.minimum(space, s_i64(plen)), 0, None)
+            st = dict(st)
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(has & (s_i64(plen) > take)),
+                TEL_RECVWIN_TRUNC].add(1, mode="drop")
             st = cset(st, has,
                       c_rblen=cg(st, "c_rblen")
                       + jnp.where(has, take, 0),
@@ -1631,6 +1695,8 @@ class TcpSpanRunner(SpanMeshMixin):
             st["pkts_dropped"] = jnp.where(
                 limit_full, st["pkts_dropped"] + 1,
                 st["pkts_dropped"])
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(limit_full), TEL_RTR_LIMIT].add(1, mode="drop")
             st = tr_append(st, limit_full, et, TR_DRP, pk_arr,
                            RSN_RTRLIMIT)
             st = dict(st)
@@ -1771,10 +1837,14 @@ class TcpSpanRunner(SpanMeshMixin):
             keep = valid & reachable & ~lossy
             min_lat = jnp.min(jnp.where(keep, latency, I64_MAX))
             st = dict(st)
-            for miss, rsn in ((valid & ~reachable, RSN_UNREACH),
-                              (valid & reachable & lossy, RSN_LOSS)):
+            for miss, rsn, tel in (
+                    (valid & ~reachable, RSN_UNREACH, TEL_UNREACHABLE),
+                    (valid & reachable & lossy, RSN_LOSS,
+                     TEL_LOSS_EDGE)):
                 st["pkts_dropped"] = st["pkts_dropped"].at[
                     jnp.where(miss, src, OOB)].add(1, mode="drop")
+                st["drop_causes"] = st["drop_causes"].at[
+                    jnp.where(miss, src, OOB), tel].add(1, mode="drop")
                 if tracing:
                     nt_ = st["tr_n"]
                     rank = jnp.cumsum(miss) - 1
@@ -1865,6 +1935,22 @@ class TcpSpanRunner(SpanMeshMixin):
                 micro_cond, micro_iter,
                 (st, window_end, jnp.int64(0)))
             st, n_out, min_lat = propagate(st, window_end)
+            if netstat:
+                # Sim-netstat sample at the round boundary: the same
+                # stateless grid-crossing rule as the engine's
+                # tel_sample_round and the object path — the sampled-
+                # round set is path-independent by construction.
+                do = (start // np.int64(tel_iv)
+                      != window_end // np.int64(tel_iv))
+                row = jnp.where(do, st["tel_n"],
+                                jnp.int32(TELR + 8))
+                st = dict(st)
+                st["tel_t"] = st["tel_t"].at[row].set(
+                    window_end, mode="drop")
+                for name, srccol in TEL_FIELDS:
+                    st[f"tel_{name}"] = st[f"tel_{name}"].at[row].set(
+                        st[srccol].astype(jnp.int64), mode="drop")
+                st["tel_n"] = st["tel_n"] + do.astype(jnp.int32)
             runahead = jnp.where(
                 (min_lat > 0) & (min_lat < runahead), min_lat,
                 runahead)
@@ -1913,6 +1999,12 @@ class TcpSpanRunner(SpanMeshMixin):
             st["out_t"] = jnp.zeros(O, jnp.int64)
             for kk in PK_KEYS:
                 st[f"out_{kk}"] = jnp.zeros(O, PK_DTYPES[kk])
+            if netstat:
+                st["tel_n"] = jnp.int32(0)
+                st["tel_t"] = jnp.zeros(TELR, jnp.int64)
+                for name, _src in TEL_FIELDS:
+                    st[f"tel_{name}"] = jnp.zeros((TELR, CC),
+                                                  jnp.int64)
             if tracing:
                 st["tr_n"] = jnp.int64(0)
                 for k, dt in (("tr_t", jnp.int64),
@@ -1969,6 +2061,17 @@ class TcpSpanRunner(SpanMeshMixin):
         if d is None or isinstance(d, int):
             return d
         st = self._to_arrays(d)  # also sets self._CC
+        if self.netstat is not None:
+            # Telemetry identity + canonical order, captured while the
+            # static columns are still host-side numpy.
+            n = st["_n_conns"]
+            host = st["c_host"][:n].astype(np.int32)
+            lport = st["c_lport"][:n].astype(np.uint16)
+            rport = st["c_pport"][:n].astype(np.uint16)
+            rip = st["c_pip"][:n].astype(np.uint32)
+            perm = np.lexsort((rip, rport, lport, host))
+            self._tel_ident = (host[perm], lport[perm], rport[perm],
+                               rip[perm], perm, n)
         # Cache the static config as committed device arrays
         # (phold_span twin): paid once per export, reused by every
         # later dispatch — fresh or resident — without re-paying the
@@ -1991,7 +2094,8 @@ class TcpSpanRunner(SpanMeshMixin):
         H = self._H
         st = {k: v for k, v in self._res_st.items()
               if k not in ("abort_code", "abort_site")
-              and not k.startswith("tr_")}
+              and not k.startswith("tr_")
+              and not k.startswith("tel_")}
         st.update(self._static_cols)
         n = self._static_cols["_n_conns"]
         for k in ("cont", "then", "ret"):
@@ -2010,6 +2114,30 @@ class TcpSpanRunner(SpanMeshMixin):
             .at[self._static_cols["c_host"][:n]]
             .max(st["c_awaitseq"][:n] + 1))
         return st
+
+    def _emit_netstat(self, st_np) -> None:
+        """Pack the span's device-sampled telemetry rows into TEL_REC
+        records — per sampled round, connections in the canonical
+        (host, lport, rport, rip) order — and append them to the
+        channel.  Byte-identical to the engine ring's records for the
+        same rounds (the cross-path parity gate's device leg)."""
+        if self.netstat is None or self._tel_ident is None:
+            return
+        tn = int(st_np.get("tel_n", 0))
+        host, lport, rport, rip, perm, n = self._tel_ident
+        if tn == 0 or n == 0:
+            return
+        from shadow_tpu.trace.events import TEL_DTYPE
+        arr = np.zeros(tn * n, dtype=np.dtype(TEL_DTYPE))
+        arr["t"] = np.repeat(st_np["tel_t"][:tn].astype(np.int64), n)
+        arr["host"] = np.tile(host, tn)
+        arr["lport"] = np.tile(lport, tn)
+        arr["rport"] = np.tile(rport, tn)
+        arr["rip"] = np.tile(rip, tn)
+        arr["state"] = ST_ESTABLISHED
+        for name, _src in TEL_FIELDS:
+            arr[name] = st_np[f"tel_{name}"][:tn][:, perm].reshape(-1)
+        self.netstat.extend(arr.tobytes())
 
     def try_span(self, start: int, stop: int, limit: int,
                  runahead: int, dynamic: bool,
@@ -2066,6 +2194,11 @@ class TcpSpanRunner(SpanMeshMixin):
         # the whole span, and TCP rounds carry ~100x phold's traffic.
         mr = self.MAX_ROUNDS if max_rounds is None \
             else min(max_rounds, self.MAX_ROUNDS)
+        if self.netstat is not None:
+            # Sampled rounds <= rounds <= TEL_ROWS: the device-side
+            # telemetry buffers can never overflow (a silent skip
+            # would break cross-path byte-parity).
+            mr = min(mr, self.TEL_ROWS)
         w = self.wall
         for _grow in range(4):
             _tw = w.now() if w is not None else 0
@@ -2172,8 +2305,12 @@ class TcpSpanRunner(SpanMeshMixin):
             }
         st_np["_n_conns"] = n_conns
         _tw = w.now() if w is not None else 0
-        back = self._from_arrays(st_np)
+        # tel_* sample buffers are span-local output, not engine state.
+        back = self._from_arrays(
+            {k: v for k, v in st_np.items()
+             if not k.startswith("tel_")})
         self.engine.span_import_tcp(back, *self._caps(), traces)
+        self._emit_netstat(st_np)
         if w is not None:
             w.add("import", w.now() - _tw, _tw)
         # Record AFTER the import's own epoch bump: the resident copy

@@ -238,6 +238,13 @@ class TcpConnection:
         self.segments_sent = 0
         self.segments_received = 0
         self.sacked_skip_count = 0  # retransmissions avoided via SACK
+        # Receiver discards (sim-netstat TEL_REASM_FULL /
+        # TEL_RECVWIN_TRUNC; netplane.cpp twins): payload the receiver
+        # refused — a segment beyond the reassembly window, or in-order
+        # bytes past the receive buffer.  The socket layer folds the
+        # per-packet delta into the host's drop-cause counters.
+        self.reasm_discards = 0
+        self.rcvwin_trunc = 0
 
     # Congestion variables live on the algorithm object; these views
     # keep call sites and tests readable.
@@ -773,6 +780,8 @@ class TcpConnection:
             # Future segment: stash (bounded by the advertised window).
             if seq_sub(seq, self.rcv_nxt) < self.recv_buf_max:
                 self.reassembly.setdefault(seq, payload)
+            else:
+                self.reasm_discards += 1  # beyond the window: discard
             self._emit_ack(now)  # dupack → sender fast-retransmits
             return
         # In-order: deliver, then drain any contiguous stashed segments.
@@ -792,6 +801,8 @@ class TcpConnection:
             self.recv_buf.append(take)
             self.recv_buf_len += len(take)
             self.rcv_nxt = seq_add(self.rcv_nxt, len(take))
+        if len(payload) > len(take):
+            self.rcvwin_trunc += 1
         # Bytes beyond buffer space are NOT acked; the shrunken advertised
         # window tells the sender to back off and retransmit later.
 

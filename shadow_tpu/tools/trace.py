@@ -1,9 +1,18 @@
-"""Flight-recorder CLI: summarize, attribute, export.
+"""Flight-recorder & sim-netstat CLI: summarize, attribute, export.
 
     python -m shadow_tpu.tools.trace DATA_DIR            # summarize
     python -m shadow_tpu.tools.trace DATA_DIR --chrome out.json
+    python -m shadow_tpu.tools.trace net DATA_DIR        # TCP report
+    python -m shadow_tpu.tools.trace explain DATA_DIR    # remediation
     python -m shadow_tpu.tools.trace --run sim.yaml      # run + summarize
     python -m shadow_tpu.tools.trace --smoke [--hosts N] # CI smoke
+
+`net` prints the sim-netstat report: the drop-attribution table with
+its conservation check (per-cause counters must sum to the sim's
+packets_dropped) and a top-N per-connection table (retransmits, final
+srtt/cwnd, buffer peaks) from telemetry-sim.bin.  `explain` maps the
+eligibility audit's top blockers to concrete remediation hints (which
+hosts force the object path and why, which knobs re-enable spans).
 
 Reads the artifacts a flight-recorded run leaves in its data
 directory (`sim-stats.json`, `flight-sim.bin`, `flight-wall.json` —
@@ -50,20 +59,27 @@ def _load(data_dir: str):
     if os.path.exists(wall_path):
         with open(wall_path) as f:
             wall = json.load(f)
-    return stats, sim_bytes, wall
+    tel_bytes = b""
+    tel_path = os.path.join(data_dir, "telemetry-sim.bin")
+    if os.path.exists(tel_path):
+        with open(tel_path, "rb") as f:
+            tel_bytes = f.read()
+    return stats, sim_bytes, wall, tel_bytes
 
 
 def summarize(data_dir: str, chrome_out: str | None = None,
-              out=sys.stdout) -> bool:
+              out=None) -> bool:
     """Print the trace summary + eligibility report; write the Chrome
     export when asked.  Returns True when the eligibility counts
     account for 100% of rounds."""
+    if out is None:
+        out = sys.stdout  # resolved at call time (pytest capsys swaps it)
     from shadow_tpu.trace.audit import render_report
     from shadow_tpu.trace.events import (FLIGHT_REC_BYTES, FR_ROUND,
                                          FR_SPAN_ABORT, FR_SPAN_COMMIT,
                                          FR_SPAN_START, iter_records)
 
-    stats, sim_bytes, wall = _load(data_dir)
+    stats, sim_bytes, wall, tel_bytes = _load(data_dir)
     rounds = stats.get("rounds", 0)
     metrics = stats.get("metrics", {})
     elig = metrics.get("wall", {}).get("eligibility", {})
@@ -105,13 +121,188 @@ def summarize(data_dir: str, chrome_out: str | None = None,
 
     if chrome_out is not None:
         from shadow_tpu.trace.chrome import chrome_trace
-        doc = chrome_trace(sim_bytes, wall)
+        doc = chrome_trace(sim_bytes, wall, tel_bytes)
         with open(chrome_out, "w") as f:
             json.dump(doc, f)
         print(f"chrome trace: {chrome_out} "
               f"({len(doc['traceEvents'])} events — load in Perfetto "
               f"or chrome://tracing)", file=out)
     return ok
+
+
+def drop_report(stats: dict, out=None) -> bool:
+    """The drop-attribution table + conservation check.  Returns True
+    when every wire drop is attributed and the causes sum exactly to
+    packets_dropped."""
+    if out is None:
+        out = sys.stdout
+    from shadow_tpu.trace.events import TEL_NAMES, TEL_WIRE_N
+
+    drops = stats.get("metrics", {}).get("sim", {}).get(
+        "netstat", {}).get("drops", {})
+    total = stats.get("packets_dropped", 0)
+    wire = set(TEL_NAMES[:TEL_WIRE_N])
+    print("packet-drop attribution (one cause per drop):", file=out)
+    wire_sum = 0
+    width = max([len(k) for k in drops] + [16])
+    for name, n in sorted(drops.items(), key=lambda kv: -kv[1]):
+        kind = "wire" if name in wire else (
+            "tcp-discard" if name != "unattributed" else "GAP")
+        print(f"  {name:<{width}}  {n:>10}  [{kind}]", file=out)
+        if name in wire:
+            wire_sum += n
+    ok = wire_sum == total and "unattributed" not in drops
+    if ok:
+        print(f"  {'total (wire)':<{width}}  {wire_sum:>10}  "
+              f"== packets_dropped ({total}): conserved", file=out)
+    else:
+        print(f"  total (wire) {wire_sum} != packets_dropped {total} "
+              f"— ATTRIBUTION GAP", file=out)
+    return ok
+
+
+def net_report(data_dir: str, top_n: int = 10, out=None) -> bool:
+    """`trace net`: drop attribution + the top-N connection table
+    from telemetry-sim.bin.  Returns the conservation verdict."""
+    if out is None:
+        out = sys.stdout
+    from shadow_tpu.net.graph import format_ip
+    from shadow_tpu.trace.events import TEL_REC_BYTES
+    from shadow_tpu.trace.netstat import (group_by_conn,
+                                          top_by_retransmits)
+
+    stats, _sim, _wall, tel_bytes = _load(data_dir)
+    ok = drop_report(stats, out=out)
+
+    if not tel_bytes:
+        print("sim-netstat channel: absent (run with "
+              "experimental.sim_netstat: on)", file=out)
+        return ok
+    by_conn = group_by_conn(tel_bytes)
+    n_recs = len(tel_bytes) // TEL_REC_BYTES
+    print(f"sim-netstat: {n_recs} samples over {len(by_conn)} "
+          f"connections", file=out)
+    ranked = top_by_retransmits(by_conn, top_n)
+    print(f"top {len(ranked)} connections by retransmits:", file=out)
+    print(f"  {'connection':<32} {'rtx':>6} {'sack':>5} "
+          f"{'srtt ms':>8} {'cwnd kB':>8} {'sndbuf':>8} "
+          f"{'rcvbuf':>8}", file=out)
+    for key in ranked:
+        host, lport, rport, rip = key
+        recs = by_conn[key]
+        last = recs[-1]
+        name = f"h{host}:{lport}->{format_ip(rip)}:{rport}"
+        print(f"  {name:<32} {last[13]:>6} {last[14]:>5} "
+              f"{last[8] / 1e6:>8.2f} {last[6] / 1024:>8.1f} "
+              f"{max(r[11] for r in recs):>8} "
+              f"{max(r[12] for r in recs):>8}", file=out)
+    return ok
+
+
+# Eligibility-blocker remediation hints (`trace explain`), keyed by
+# the EL_NAMES the audit reports.  {hosts} interpolates the offending
+# host list where the processed config identifies one.
+_EXPLAIN = {
+    "object-path:pcap": (
+        "pcap capture pins these hosts to the Python object path: "
+        "{hosts}.  Disable pcap_enabled on them (or accept per-round "
+        "spans capped at experimental.pcap_span_cap).",),
+    "object-path:cpu-model": (
+        "the host CPU model (experimental.host_cpu_threshold) forces "
+        "the object path: {hosts}.  Unset it to let these hosts join "
+        "engine/device spans.",),
+    "object-path:py-task": (
+        "engine hosts briefly carried Python-side work (process "
+        "spawn/shutdown tasks); normal at sim start and end.",),
+    "object-path:other": (
+        "a host config (e.g. strace_logging_mode) keeps these hosts "
+        "off the native plane: {hosts}.",),
+    "engine-span:device-off": (
+        "device spans are disabled (experimental.tpu_device_spans: "
+        "off); set it to auto or force.",),
+    "engine-span:ineligible-family": (
+        "no device-span family fits this sim's shape — the PHOLD "
+        "family needs pure udp-mesh/phold apps, the TCP family needs "
+        "the tgen steady-stream tier (netgen.tcp_stream_yaml).",),
+    "engine-span:transient": (
+        "the sim was transiently outside the TCP family's modelled "
+        "domain (handshake/close stretches); steady-state rounds "
+        "still reach the device.",),
+    "engine-span:abort-rollback": (
+        "device spans aborted (capacity or domain); see dispatch."
+        "device_span_*.aborts and grow the runner caps if persistent.",),
+    "engine-span:cold-budget": (
+        "the device compile budget was not yet earned (1% of wall); "
+        "longer runs probe and route automatically.",),
+    "engine-span:routed": (
+        "the router measured the C++ span faster than the device at "
+        "this scale — expected on small sims or CPU backends.",),
+    "engine-span:py-limit": (
+        "spans were capped before windows could touch an object-path "
+        "host; reduce object-path hosts to lengthen spans.",),
+    "per-round:forced-device": (
+        "forced-device audit mode (tpu_min_device_batch <= 0) runs "
+        "every round through the jitted kernel by design.",),
+    "per-round:scheduler": (
+        "this scheduler has no span path; use scheduler: tpu for "
+        "engine/device spans.",),
+    "per-round:callback-host": (
+        "a host can fire Python callbacks mid-event (Python-owned "
+        "sockets), which excludes the whole sim from C++ spans.",),
+}
+
+
+def explain_report(data_dir: str, out=None) -> bool:
+    """`trace explain`: top eligibility blockers -> remediation."""
+    if out is None:
+        out = sys.stdout
+    stats, _sim, _wall, _tel = _load(data_dir)
+    elig = stats.get("metrics", {}).get("wall", {}).get(
+        "eligibility", {})
+    rounds = stats.get("rounds", 0)
+    if not elig:
+        print("no eligibility block in sim-stats.json (pre-trace "
+              "artifact?)", file=out)
+        return False
+
+    # Offending hosts per object-path cause, from the processed
+    # config written next to sim-stats.json.
+    pcap_hosts, cpu_hosts, other_hosts = [], [], []
+    cfg_path = os.path.join(data_dir, "processed-config.yaml")
+    if os.path.exists(cfg_path):
+        import yaml
+        with open(cfg_path) as f:
+            cfg = yaml.safe_load(f) or {}
+        for name, h in sorted((cfg.get("hosts") or {}).items()):
+            if (h or {}).get("pcap_enabled"):
+                pcap_hosts.append(name)
+        if (cfg.get("experimental") or {}).get("host_cpu_threshold"):
+            cpu_hosts = sorted((cfg.get("hosts") or {}).keys())
+    hosts_of = {"object-path:pcap": pcap_hosts,
+                "object-path:cpu-model": cpu_hosts,
+                "object-path:other": other_hosts}
+
+    device = elig.get("device-span", 0)
+    print(f"device-span coverage: {device}/{rounds} rounds; top "
+          f"blockers and remediation:", file=out)
+    shown = 0
+    for name, n in sorted(elig.items(), key=lambda kv: -kv[1]):
+        if name == "device-span":
+            continue
+        hint = _EXPLAIN.get(name)
+        hosts = ", ".join(hosts_of.get(name, [])[:8]) or "(see config)"
+        text = (hint[0].format(hosts=hosts) if hint
+                else "no registered remediation for this reason.")
+        pct = 100.0 * n / rounds if rounds else 0.0
+        print(f"  {name} — {n} rounds ({pct:.1f}%)", file=out)
+        print(f"      {text}", file=out)
+        shown += 1
+        if shown >= 6:
+            break
+    if not shown:
+        print("  (every round ran on the device — nothing to "
+              "remediate)", file=out)
+    return True
 
 
 def run_config(config_path: str, data_dir: str | None = None) -> str:
@@ -133,7 +324,9 @@ def run_config(config_path: str, data_dir: str | None = None) -> str:
 
 def smoke(n_hosts: int) -> int:
     """50-host traced tgen TCP tier: summary + eligibility must
-    render and account for every round (the ./setup trace target)."""
+    render and account for every round, the drop-cause counters must
+    conserve, and the Chrome export must carry a non-empty
+    per-connection counter track (the ./setup trace target)."""
     import tempfile
 
     from shadow_tpu.core.config import ConfigOptions
@@ -148,6 +341,7 @@ def smoke(n_hosts: int) -> int:
                                seed=11, scheduler="tpu")
         config = ConfigOptions.from_yaml_text(text)
         config.experimental.flight_recorder = "on"
+        config.experimental.sim_netstat = "on"
         config.general.data_directory = base
         _manager, summary = run_simulation(config, write_data=True)
         if not summary.ok:
@@ -160,6 +354,11 @@ def smoke(n_hosts: int) -> int:
             print("trace smoke: eligibility report did not account "
                   "for all rounds", file=sys.stderr)
             return 1
+        if not net_report(base):
+            print("trace smoke: drop-cause counters do not conserve",
+                  file=sys.stderr)
+            return 1
+        explain_report(base)
         with open(chrome_out) as f:
             doc = json.load(f)
         slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
@@ -167,12 +366,39 @@ def smoke(n_hosts: int) -> int:
             print("trace smoke: chrome export has no slices",
                   file=sys.stderr)
             return 1
+        counters = [e for e in doc["traceEvents"]
+                    if e.get("ph") == "C"]
+        if not counters:
+            print("trace smoke: chrome export has no sim-netstat "
+                  "counter track", file=sys.stderr)
+            return 1
     print(f"trace smoke: ok ({n_hosts} hosts, {summary.rounds} rounds "
-          f"fully attributed)")
+          f"fully attributed, drops conserved, "
+          f"{len(counters)} counter events)")
     return 0
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] in ("net", "explain"):
+        # Subcommands: `trace net DATA_DIR [--top N]`,
+        #              `trace explain DATA_DIR`.
+        sub = argparse.ArgumentParser(
+            prog=f"shadow_tpu.tools.trace {argv[0]}")
+        sub.add_argument("data_dir")
+        if argv[0] == "net":
+            sub.add_argument("--top", type=int, default=10,
+                             help="connections in the report "
+                                  "(default 10)")
+        sargs = sub.parse_args(argv[1:])
+        from shadow_tpu.utils.platform import honor_platform_env
+        honor_platform_env()
+        if argv[0] == "net":
+            return 0 if net_report(sargs.data_dir,
+                                   top_n=sargs.top) else 1
+        return 0 if explain_report(sargs.data_dir) else 1
+
     ap = argparse.ArgumentParser(prog="shadow_tpu.tools.trace",
                                  description=__doc__)
     ap.add_argument("data_dir", nargs="?",

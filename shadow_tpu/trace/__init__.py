@@ -38,6 +38,8 @@ from __future__ import annotations
 
 from shadow_tpu.trace.audit import EligibilityAudit
 from shadow_tpu.trace.metrics import MetricsRegistry
+from shadow_tpu.trace.netstat import NetstatChannel
 from shadow_tpu.trace.recorder import FlightRecorder
 
-__all__ = ["EligibilityAudit", "FlightRecorder", "MetricsRegistry"]
+__all__ = ["EligibilityAudit", "FlightRecorder", "MetricsRegistry",
+           "NetstatChannel"]

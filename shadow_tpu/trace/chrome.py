@@ -5,6 +5,11 @@ Sim-time channel: rounds and spans as nested slices on one track
 slice inside its span; device-span aborts are instants).  Timestamps
 are simulated microseconds — the timeline IS the simulation.
 
+Sim-netstat channel: per-connection COUNTER tracks ("C" events) on a
+third process — cwnd/ssthresh, srtt, buffer occupancy and cumulative
+retransmits per sampled round, capped to the top connections by
+retransmit count so a 10k-host export stays loadable.
+
 Wall-time channel: per-phase slices on a second "process" with real
 (relative) microseconds — where a dispatch's wall time went.
 """
@@ -17,6 +22,44 @@ from shadow_tpu.trace.events import (EL_NAMES, FAM_NAMES, FR_ROUND,
 
 PID_SIM = 1
 PID_WALL = 2
+PID_NETSTAT = 3
+
+# Counter tracks per exported connection: (track suffix, args built
+# from a TEL_REC tuple — see trace/events.py for the field order).
+NETSTAT_TRACKS = (
+    # ssthresh is elided while still at its "infinite" pre-loss value
+    # (RFC 6928 slow start) — plotting 2^31 would flatten the track.
+    ("cwnd", lambda r: {"cwnd": r[6]}
+     | ({"ssthresh": r[7]} if r[7] < (1 << 30) else {})),
+    ("srtt-ms", lambda r: {"srtt": r[8] / 1e6}),
+    ("buffers", lambda r: {"sndbuf": r[11], "rcvbuf": r[12]}),
+    ("retransmits", lambda r: {"rtx": r[13], "sack-skips": r[14]}),
+)
+
+
+def netstat_events(tel_bytes: bytes, top_n: int = 16) -> list:
+    """Per-connection counter events from telemetry-sim.bin.  Keeps
+    the top_n connections by final retransmit count (ties broken by
+    connection key, so the selection is deterministic — the same
+    ranking `tools/trace net` prints)."""
+    from shadow_tpu.net.graph import format_ip
+    from shadow_tpu.trace.netstat import (group_by_conn,
+                                          top_by_retransmits)
+
+    by_conn = group_by_conn(tel_bytes)
+    ranked = top_by_retransmits(by_conn, top_n)
+    ev: list = [_meta(PID_NETSTAT, 0, "process_name",
+                      "sim-netstat (per-connection TCP)")]
+    for key in ranked:
+        host, lport, rport, rip = key
+        name = f"h{host}:{lport}->{format_ip(rip)}:{rport}"
+        for suffix, args_of in NETSTAT_TRACKS:
+            for rec in by_conn[key]:
+                ev.append({"ph": "C", "pid": PID_NETSTAT, "tid": 0,
+                           "ts": rec[0] / 1e3,
+                           "name": f"{name} {suffix}",
+                           "args": args_of(rec)})
+    return ev
 
 
 def _meta(pid: int, tid: int, what: str, name: str) -> dict:
@@ -24,11 +67,13 @@ def _meta(pid: int, tid: int, what: str, name: str) -> dict:
             "args": {"name": name}}
 
 
-def chrome_trace(sim_bytes: bytes, wall: dict | None = None) -> dict:
+def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
+                 tel_bytes: bytes = b"") -> dict:
     """Build the trace-event JSON object from the raw channel data.
 
     `sim_bytes` is flight-sim.bin's content; `wall` is the parsed
-    flight-wall.json dict (or None)."""
+    flight-wall.json dict (or None); `tel_bytes` is
+    telemetry-sim.bin's content (per-connection counter tracks)."""
     ev: list[dict] = [
         _meta(PID_SIM, 0, "process_name", "sim-time (simulated µs)"),
         _meta(PID_SIM, 1, "thread_name", "rounds & spans"),
@@ -77,6 +122,9 @@ def chrome_trace(sim_bytes: bytes, wall: dict | None = None) -> dict:
     last_us = ev[-1].get("ts", 0) if ev else 0
     for _ in range(open_spans):
         ev.append({"ph": "E", "pid": PID_SIM, "tid": 1, "ts": last_us})
+
+    if tel_bytes:
+        ev.extend(netstat_events(tel_bytes))
 
     if wall and wall.get("events"):
         ev.append(_meta(PID_WALL, 0, "process_name",

@@ -90,6 +90,99 @@ EL_NAMES = (
 assert len(EL_NAMES) == EL_N
 assert len(FAM_NAMES) == FAM_TCP + 1
 
+# ---------------------------------------------------------------------
+# Sim-netstat: packet-drop attribution causes + the per-connection TCP
+# telemetry record (C++ twins: the TEL_* enum, TEL_NAMES table and
+# TelRec struct in netplane.cpp; registered fail-closed in analysis
+# pass 1 like FR_*/EL_*).  Every packet drop — on the object path, the
+# C++ engine path and the device-span path alike — is attributed to
+# EXACTLY ONE cause code, so the per-cause counters provably sum to
+# the sim's packets_dropped total (docs/PARITY.md conservation table).
+TEL_CODEL = 0          # CoDel AQM control-law drop
+TEL_RTR_LIMIT = 1      # router inbound queue hard limit
+TEL_LOSS_EDGE = 2      # random loss on a graph edge (inet-loss)
+TEL_UNREACHABLE = 3    # no path in the latency matrix
+TEL_NO_ROUTE = 4       # destination IP resolves to no host
+TEL_NO_SOCKET = 5      # no association listens on the 4-tuple
+TEL_TCP_STATE = 6      # tcp-closed / tcp-stray / tcp-dup-syn
+TEL_BACKLOG_FULL = 7   # listener accept backlog full
+TEL_UDP_FILTER = 8     # connected-UDP source filter
+TEL_RECVBUF_FULL = 9   # UDP receive queue full
+TEL_BUCKET_DEFER = 10  # token-bucket defer-queue overflow (the relay
+#                        parks exactly one packet and the bucket always
+#                        admits >= 1 MTU, so this is structurally 0 —
+#                        kept so a future bounded defer queue cannot
+#                        drop unattributed)
+TEL_WIRE_N = 11        # causes above count in packets_dropped
+# TCP receiver discards: the packet itself was delivered (counted
+# received, not dropped) but the receiver discarded payload — these
+# retransmit later, so they sit OUTSIDE the packets_dropped sum.
+TEL_REASM_FULL = 11    # out-of-window segment not stashed
+TEL_RECVWIN_TRUNC = 12 # in-order bytes beyond the receive buffer
+TEL_N = 13
+
+# Order mirrors the TEL_* values above AND the C++ TEL_NAMES table
+# (pass 1 checks both directions).
+TEL_NAMES = (
+    "codel",
+    "router-queue",
+    "loss-edge",
+    "unreachable",
+    "no-route",
+    "no-socket",
+    "tcp-state",
+    "backlog-full",
+    "udp-filter",
+    "recv-buffer-full",
+    "bucket-defer-overflow",
+    "reassembly-full",
+    "recv-window-trunc",
+)
+assert len(TEL_NAMES) == TEL_N
+assert TEL_WIRE_N == TEL_REASM_FULL
+
+# Drop-reason string -> cause code (C++ twin: tel_cause_of).  An
+# unmapped reason is counted as `unattributed`, which the conservation
+# gate (tests/test_netstat.py) rejects — adding a drop site without a
+# cause mapping fails the next tier-1 run, not a release.
+TEL_BY_REASON = {
+    "codel": TEL_CODEL,
+    "rtr-limit": TEL_RTR_LIMIT,
+    "inet-loss": TEL_LOSS_EDGE,
+    "unreachable": TEL_UNREACHABLE,
+    "no-route": TEL_NO_ROUTE,
+    "no-socket": TEL_NO_SOCKET,
+    "tcp-closed": TEL_TCP_STATE,
+    "tcp-stray": TEL_TCP_STATE,
+    "tcp-dup-syn": TEL_TCP_STATE,
+    "accept-backlog-full": TEL_BACKLOG_FULL,
+    "udp-connected-filter": TEL_UDP_FILTER,
+    "rcvbuf-full": TEL_RECVBUF_FULL,
+}
+
+# Per-connection telemetry record (TEL_REC_BYTES, little-endian, no
+# padding; C++ twin: struct TelRec):
+#
+#     int64   t          simulated ns (the sampled round's window end)
+#     int32   host       host id
+#     uint16  lport      connection identity: local port,
+#     uint16  rport        peer port,
+#     uint32  rip          peer IP (the local IP is the host's)
+#     int32   state      TCP state (connection.py constants)
+#     int64[9]           cwnd, ssthresh, srtt, rto, rto_backoff,
+#                        send-buffer bytes, recv-buffer bytes,
+#                        retransmits, SACK-skipped retransmits
+TEL_REC_BYTES = 96
+TEL_REC = struct.Struct("<qiHHIi9q")
+assert TEL_REC.size == TEL_REC_BYTES
+
+# numpy structured dtype for bulk encode/decode (field order == TEL_REC).
+TEL_DTYPE = [("t", "<i8"), ("host", "<i4"), ("lport", "<u2"),
+             ("rport", "<u2"), ("rip", "<u4"), ("state", "<i4"),
+             ("cwnd", "<i8"), ("ssthresh", "<i8"), ("srtt", "<i8"),
+             ("rto", "<i8"), ("backoff", "<i8"), ("sndbuf", "<i8"),
+             ("rcvbuf", "<i8"), ("rtx", "<i8"), ("sacks", "<i8")]
+
 REC = struct.Struct("<qiiqq")
 assert REC.size == FLIGHT_REC_BYTES
 

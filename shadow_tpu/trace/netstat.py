@@ -1,0 +1,159 @@
+"""Sim-netstat: the deterministic per-connection TCP telemetry channel.
+
+A second sim-time channel next to the flight recorder's event stream
+(docs/OBSERVABILITY.md "sim-netstat"): fixed 96-byte records
+(trace/events.py TEL_REC, twinned with netplane.cpp's TelRec) sampling
+every live TCP connection's control state — cwnd, ssthresh, srtt, RTO
++ backoff, send/recv buffer occupancy, retransmit and SACK counts — at
+conservative-round boundaries.  Records are keyed by simulated time
+and connection identity only, so the written `telemetry-sim.bin` is
+byte-diffed by the determinism gate exactly like `flight-sim.bin`,
+and the three execution paths (Python object path, C++ engine,
+device span) must produce identical streams for identical sims.
+
+Sampling cadence is the STATELESS grid-crossing rule, identical on
+all three paths: a round [start, window_end) emits samples iff
+`start // interval != window_end // interval` (interval 0/1 = every
+round).  Both boundaries are path-independent, so the sampled-round
+set — and with it the channel — is path-independent by construction.
+
+Within a sampled round, records are ordered by (host, local port,
+peer port, peer IP); the engine ring, the device-span driver and the
+object-path walker below all emit that order.  In mixed sims the
+engine plane's records precede the object plane's (homogeneous runs —
+what the parity gates compare — are globally host-sorted either way);
+object-path hosts are not sampled inside C++ spans (they have no
+events there, so their connection state is unchanged).
+
+Like `SimChannel`, this class must never read wall clocks: analysis
+pass 3's `sim-channel` rule covers it with no pragma escape.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shadow_tpu.trace.events import TEL_REC, TEL_REC_BYTES
+
+# Connection states excluded from sampling (tcp/connection.py values;
+# a CLOSED conn is dead, a LISTEN conn has no transfer state).
+_CLOSED = 0
+_LISTEN = 1
+
+
+def sampled(start: int, window_end: int, interval_ns: int) -> bool:
+    """The grid-crossing rule (C++ twin: Engine::tel_sample_round;
+    device twin: the round_body guard in ops/tcp_span.py)."""
+    iv = interval_ns if interval_ns > 0 else 1
+    return start // iv != window_end // iv
+
+
+class NetstatChannel:
+    """Deterministic per-connection sample stream (simulated time
+    only).  Records append pre-packed so the in-memory representation
+    IS the artifact; a capacity cap drops (and counts) the tail at a
+    point that is a function of the record sequence alone."""
+
+    FILE = "telemetry-sim.bin"
+
+    def __init__(self, interval_ns: int = 0, cap: int = 1 << 22):
+        self.interval_ns = int(interval_ns)
+        self._chunks: list[bytes] = []
+        self._cap = cap
+        self.records = 0
+        self.dropped = 0
+
+    def sampled(self, start: int, window_end: int) -> bool:
+        return sampled(start, window_end, self.interval_ns)
+
+    def record(self, t: int, host: int, lport: int, rport: int,
+               rip: int, conn) -> None:
+        """One object-path connection sample (tcp/connection.py)."""
+        if self.records >= self._cap:
+            self.dropped += 1
+            return
+        self._chunks.append(TEL_REC.pack(
+            int(t), host, lport, rport, rip, conn.state,
+            conn.cong.cwnd, conn.cong.ssthresh, conn.srtt, conn.rto,
+            conn._rto_backoff, conn.send_buf_len, conn.recv_buf_len,
+            conn.retransmit_count, conn.sacked_skip_count))
+        self.records += 1
+
+    def extend(self, buf: bytes, producer_dropped: int = 0) -> None:
+        """Append pre-packed records (an engine `netstat_take` drain
+        or a device-span driver's batch)."""
+        n = len(buf) // TEL_REC_BYTES
+        if self.records + n > self._cap:
+            keep = max(self._cap - self.records, 0)
+            self.dropped += n - keep
+            buf = buf[:keep * TEL_REC_BYTES]
+            n = keep
+        if n:
+            self._chunks.append(bytes(buf))
+            self.records += n
+        self.dropped += int(producer_dropped)
+
+    def sample_object_hosts(self, hosts, t: int) -> None:
+        """Sample every object-path host's live TCP connections.
+        Hosts on the native plane are skipped — their connections
+        live engine-side and the engine ring samples them."""
+        for h in hosts:
+            if h.plane is not None or not h.net_built():
+                continue
+            rows = []
+            for s in iter_host_tcp_sockets(h):
+                conn = s.conn
+                if conn is None or conn.state in (_CLOSED, _LISTEN):
+                    continue
+                if s.local is None or s.peer is None:
+                    continue
+                rows.append((s.local[1], s.peer[1], s.peer[0], conn))
+            rows.sort(key=lambda r: r[:3])
+            for lport, rport, rip, conn in rows:
+                self.record(t, h.id, lport, rport, rip, conn)
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self._chunks)
+
+    def write(self, data_dir: str) -> None:
+        with open(os.path.join(data_dir, self.FILE), "wb") as f:
+            f.write(self.to_bytes())
+
+
+def iter_records(buf: bytes):
+    """Yield (t, host, lport, rport, rip, state, cwnd, ssthresh,
+    srtt, rto, backoff, sndbuf, rcvbuf, rtx, sacks) tuples."""
+    for off in range(0, len(buf) - len(buf) % TEL_REC_BYTES,
+                     TEL_REC_BYTES):
+        yield TEL_REC.unpack_from(buf, off)
+
+
+def iter_host_tcp_sockets(host):
+    """Every TCP socket associated on a host, deduped across its
+    interfaces (wildcard binds associate on both lo and eth0) — THE
+    'live sockets of a host' walk shared by the telemetry sampler and
+    the manager's stream-totals summary, so the two can never disagree
+    about which sockets exist."""
+    seen: dict = {}
+    for iface in (host.lo, host.eth0):
+        for s in iface.associated_sockets():
+            if getattr(s, "conn", None) is not None \
+                    or getattr(s, "listening", False):
+                seen[id(s)] = s
+    return seen.values()
+
+
+def group_by_conn(tel_bytes: bytes) -> dict:
+    """Telemetry records grouped by connection identity:
+    (host, lport, rport, rip) -> [records in time order]."""
+    by_conn: dict = {}
+    for rec in iter_records(tel_bytes):
+        by_conn.setdefault(rec[1:5], []).append(rec)
+    return by_conn
+
+
+def top_by_retransmits(by_conn: dict, n: int) -> list:
+    """The top-n connection keys by FINAL retransmit count, ties
+    broken by connection key — the one deterministic ranking the CLI
+    report and the Chrome counter-track export both render."""
+    return sorted(by_conn, key=lambda k: (-by_conn[k][-1][13], k))[:n]

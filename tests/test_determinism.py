@@ -27,6 +27,7 @@ experimental:
   scheduler: {scheduler}
   strace_logging_mode: deterministic
   flight_recorder: "{flight}"
+  sim_netstat: "on"
 hosts:
   alice:
     network_node_id: 0
@@ -110,6 +111,31 @@ def test_two_runs_byte_identical(tmp_path):
     assert any(r.endswith(".pcap") for r in a)
     assert "packet-trace.txt" in a
     assert a["flight-sim.bin"], "sim channel recorded nothing"
+    assert a["telemetry-sim.bin"], "sim-netstat recorded nothing"
+
+
+def test_netstat_identical_across_schedulers(tmp_path):
+    """Sim-netstat is keyed by sim time and connection identity only,
+    so — unlike the flight recorder's decision log — the telemetry
+    stream must be byte-identical across SCHEDULERS too: the serial
+    object path, the threaded object path and the tpu scheduler's C++
+    engine all sample the same connections at the same round
+    boundaries.  This is the tier-1 leg of the cross-path parity
+    claim (the forced-device leg lives in tests/test_netstat.py)."""
+    datas = {
+        "serial": run_sim(tmp_path, "ns-ser", "serial"),
+        "thread_per_core": run_sim(tmp_path, "ns-thr",
+                                   "thread_per_core", parallelism=2),
+        "tpu": run_sim(tmp_path, "ns-tpu", "tpu"),
+    }
+    blobs = {}
+    for label, data in datas.items():
+        with open(os.path.join(data, "telemetry-sim.bin"), "rb") as f:
+            blobs[label] = f.read()
+    assert blobs["serial"], "no telemetry recorded"
+    for label in ("thread_per_core", "tpu"):
+        assert blobs[label] == blobs["serial"], \
+            f"telemetry-sim.bin diverged on {label}"
 
 
 def test_parallel_and_tpu_schedulers_byte_identical(tmp_path):

@@ -54,6 +54,38 @@ def test_enum_reorder_is_caught(cpp_text):
         [x.render() for x in v]
 
 
+def test_tel_enum_drift_is_caught(cpp_text):
+    # swapping two drop causes shifts their values: the trace/events
+    # twins (and the phold kernel's slots) must flag both
+    mutated = _mutate(cpp_text, "TEL_NO_ROUTE, TEL_NO_SOCKET,",
+                      "TEL_NO_SOCKET, TEL_NO_ROUTE,")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("TEL_NO_ROUTE" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_tel_cause_table_reorder_is_caught(cpp_text):
+    # reordering TEL_NAMES without touching the enum desynchronizes
+    # the attribution report's labels from the counters
+    mutated = _mutate(cpp_text,
+                      '    "loss-edge",\n    "unreachable",',
+                      '    "unreachable",\n    "loss-edge",')
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("TEL_NAMES" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_unregistered_tel_constant_is_caught(cpp_text):
+    # a new TEL_* member with no contract row must fail closed — a
+    # half-registered drop cause could never conserve
+    mutated = _mutate(cpp_text, "constexpr int TEL_WIRE_N = 11;",
+                      "constexpr int TEL_WIRE_N = 11;\n"
+                      "constexpr int TEL_BOGUS = 99;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("TEL_BOGUS" in x.message for x in v), \
+        [x.render() for x in v]
+
+
 def test_column_rename_is_caught(cpp_text):
     mutated = _mutate(cpp_text, 'put("c_cwnd", bytes_vec(c_cwnd));',
                       'put("c_cwndx", bytes_vec(c_cwnd));')
