@@ -136,6 +136,34 @@ static char g_ia32cap[80] = "";
 /* Custom pseudo-syscall (ref shadow_syscalls.rs shadow_yield). */
 #define SHADOWTPU_SYS_YIELD 0x53544001L
 
+/* Syscall-observatory disposition codes (docs/OBSERVABILITY.md
+ * "syscall observatory"): the manager credits every dispatched
+ * syscall EXACTLY ONE of these; the shim owns SC_SHIM — syscalls it
+ * answers locally (the time family, served from the shared sim clock)
+ * count into the per-channel sc_local word so the manager can credit
+ * them without a round trip.  Twinned in shadow_tpu/trace/events.py
+ * and registered fail-closed in analysis pass 1: an SC_* member added
+ * here without a contract row fails scripts/lint. */
+enum {
+    SC_SERVICED = 0,  /* emulated by the simulated kernel (done/error) */
+    SC_PARKED = 1,    /* parked on a SyscallCondition, re-run on wake  */
+    SC_NATIVE = 2,    /* natively injected (DO_NATIVE / exit paths)    */
+    SC_SHIM = 3,      /* answered shim-side, no round trip             */
+    SC_PROTO = 4,     /* IPC protocol error ended the conversation     */
+    SC_N = 5,
+    /* Fixed record size of the manager's syscalls-sim.bin channel
+     * (trace/events.py SC_REC).  The shim emits no records itself;
+     * the constant lives here so record-size drift on either side
+     * fails the twin gate, like FLIGHT_REC_BYTES in netplane.cpp. */
+    SC_REC_BYTES = 40,
+    /* Manager-side layout twin: shadow_tpu/host/shim_abi.py
+     * CHAN_SC_LOCAL (pinned to the real struct just below). */
+    SC_CHAN_LOCAL_OFF = 280,
+};
+_Static_assert(__builtin_offsetof(ipc_chan_t, sc_local) ==
+               SC_CHAN_LOCAL_OFF,
+               "sc_local offset drifted from shim_abi.py CHAN_SC_LOCAL");
+
 #define raw shadowtpu_raw_syscall
 
 static void install_preemption(void);
@@ -713,6 +741,13 @@ static long shim_emulated_syscall(long n, const long args[6]) {
     long ret;
     g_in_shim++;
     if (shim_try_local(n, args, &ret)) {
+        /* SC_SHIM sequence counter: answered without a round trip;
+         * the manager drains sc_local at its next event on this
+         * channel (a cloned thread increments only once its channel
+         * is bound — before that it has no manager conversation to
+         * drain through either). */
+        if (g_chan)
+            g_chan->sc_local++;
         if (++g_local_time_count % LOCAL_TIME_FORWARD_EVERY != 0) {
             g_in_shim--;
             return ret;

@@ -33,7 +33,7 @@ typedef _Atomic uint64_t ipc_atomic_u64;
 #endif
 
 #define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
-#define SHIM_IPC_VERSION 6u
+#define SHIM_IPC_VERSION 7u
 
 /* Slot status values; the status word doubles as the futex word. */
 enum {
@@ -119,7 +119,16 @@ typedef struct {
      * messages, read-and-cleared by the manager at the next event —
      * the alternating slot protocol orders the accesses. */
     uint64_t   unapplied_ns;
-    uint8_t    _pad[320 - 2 * 72 - 8 * (CLONE_NREGS + 2)];
+    /* Syscall observatory (docs/OBSERVABILITY.md): count of syscalls
+     * this thread's shim answered locally — the time family, served
+     * from the shared sim clock without a round trip — since the
+     * manager last drained the counter.  Written by the shim between
+     * messages, read-and-cleared by the manager at the next event on
+     * this channel; the alternating slot protocol orders the accesses
+     * exactly as it does for unapplied_ns.  Drains credit the
+     * SC_SHIM disposition (the SC_* enum in shim.c / trace/events.py). */
+    uint64_t   sc_local;
+    uint8_t    _pad[320 - 2 * 72 - 8 * (CLONE_NREGS + 3)];
 } ipc_chan_t;               /* 320 bytes */
 
 #define IPC_N_CHANS    64
@@ -174,6 +183,7 @@ typedef struct {
 #define IPC_CHAN_TO_SHIM   72
 #define IPC_CHAN_CLONE_REGS (2 * 72)
 #define IPC_CHAN_UNAPPLIED (2 * 72 + 8 * (CLONE_NREGS + 1))
+#define IPC_CHAN_SC_LOCAL  (2 * 72 + 8 * (CLONE_NREGS + 2))
 #define IPC_SLOT_EV_OFF    8
 
 #ifdef __cplusplus

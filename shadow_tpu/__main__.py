@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/OBSERVABILITY.md): 'on' records the "
                         "sim-time event stream + wall phases into the "
                         "data dir, 'wall' phases only")
+    p.add_argument("--syscall-observatory", choices=["off", "wall", "on"],
+                   help="per-syscall telemetry for managed processes "
+                        "(docs/OBSERVABILITY.md): 'on' records the "
+                        "deterministic syscalls-sim.bin channel + the "
+                        "wall-time IPC profile, 'wall' the profile only")
     p.add_argument("--show-build-info", action="store_true")
     return p
 
@@ -87,6 +92,8 @@ def main(argv=None) -> int:
         config.experimental.strace_logging_mode = args.strace_logging_mode
     if args.flight_recorder is not None:
         config.experimental.flight_recorder = args.flight_recorder
+    if args.syscall_observatory is not None:
+        config.experimental.syscall_observatory = args.syscall_observatory
 
     manager, summary = run_simulation(config, write_data=True)
     if summary.plugin_errors:

@@ -23,10 +23,12 @@ Rules (catalogue + rationale in docs/LINT.md):
   np-in-jit      np.* calls inside a jitted/traced body where jnp is
                  required (host math on traced values breaks tracing
                  or silently constant-folds)
-  sim-channel    wall-clock reads inside the flight recorder's
-                 sim-time channel (class SimChannel, trace/recorder):
-                 the channel is DEFINED to be byte-identical across
-                 runs, so this rule has NO pragma escape (fail closed)
+  sim-channel    wall-clock reads inside a sim-time trace channel
+                 (SimChannel in trace/recorder, NetstatChannel in
+                 trace/netstat, SyscallChannel/HostSyscallLog in
+                 trace/sctrace): the channels are DEFINED to be
+                 byte-identical across runs, so this rule has NO
+                 pragma escape (fail closed)
 
 "Jitted/traced bodies" = functions decorated with jit/jax.jit/
 partial(jax.jit, ..), functions passed to lax.while_loop/scan/cond/
@@ -275,14 +277,18 @@ class _ModuleLinter:
     # -- sim-time trace channel --------------------------------------
     def lint_sim_channel(self):
         """Any wall-clock read inside a sim-time channel class body
-        (`SimChannel`, the flight recorder's event stream, or
-        `NetstatChannel`, the sim-netstat telemetry stream) is a
-        violation with NO pragma escape: both channels' byte-identity
-        contracts (docs/OBSERVABILITY.md) admit no sanctioned
-        exception — profiling belongs in WallChannel."""
+        (`SimChannel`, the flight recorder's event stream;
+        `NetstatChannel`, the sim-netstat telemetry stream; or
+        `SyscallChannel`/`HostSyscallLog`, the syscall observatory's
+        record stream) is a violation with NO pragma escape: the
+        channels' byte-identity contracts (docs/OBSERVABILITY.md)
+        admit no sanctioned exception — profiling belongs in
+        WallChannel / HostScWall."""
         channels = [cls for cls in ast.walk(self.tree)
                     if isinstance(cls, ast.ClassDef)
-                    and cls.name in ("SimChannel", "NetstatChannel")]
+                    and cls.name in ("SimChannel", "NetstatChannel",
+                                     "SyscallChannel",
+                                     "HostSyscallLog")]
         if not channels:
             return
         aliases = self._collect_aliases()

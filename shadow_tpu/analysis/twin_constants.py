@@ -20,6 +20,7 @@ from shadow_tpu.analysis import cpp_extract, py_extract
 from shadow_tpu.analysis.report import Violation
 
 CPP = "native/netplane.cpp"
+SHIM = "native/shim.c"
 
 _CONN = "shadow_tpu/tcp/connection.py"
 _TCPS = "shadow_tpu/ops/tcp_span.py"
@@ -187,6 +188,28 @@ CONTRACTS = [
 # trace/events.py fails closed.
 TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_")
 
+# Shim-side contracts (native/shim.c — the syscall observatory's SC_*
+# disposition enum, its record-size pin, and the IPC-layout offset of
+# the shim's SC_SHIM sequence counter).  Same fail-closed discipline
+# as the netplane contracts: SHIM_TRACE_PREFIXES members without a
+# row are violations.
+_SABI = "shadow_tpu/host/shim_abi.py"
+SHIM_CONTRACTS = [
+    ("SC_SERVICED", [(_TREV, "SC_SERVICED")]),
+    ("SC_PARKED", [(_TREV, "SC_PARKED")]),
+    ("SC_NATIVE", [(_TREV, "SC_NATIVE")]),
+    ("SC_SHIM", [(_TREV, "SC_SHIM")]),
+    ("SC_PROTO", [(_TREV, "SC_PROTO")]),
+    ("SC_N", [(_TREV, "SC_N")]),
+    ("SC_REC_BYTES", [(_TREV, "SC_REC_BYTES")]),
+    # The per-channel counter offset: shim.c pins the literal to the
+    # real struct with a _Static_assert; this row pins the manager's
+    # mmap offset to the same literal — so the three-way agreement
+    # (struct, shim constant, Python offset) is airtight.
+    ("SC_CHAN_LOCAL_OFF", [(_SABI, "CHAN_SC_LOCAL")]),
+]
+SHIM_TRACE_PREFIXES = ("SC_",)
+
 # C++ int arrays <-> Python tuples (threefry rotation schedules)
 ARRAY_CONTRACTS = [
     ("rot_a", _RNG, "_ROT_A"),
@@ -217,7 +240,32 @@ DERIVED_CONTRACTS = [
 ]
 
 
-def check(repo_root: str, cpp_text: str | None = None) -> list:
+def _diff_contracts(consts: dict, contracts: list, src: str,
+                    py_consts, violations: list) -> None:
+    """Diff one extracted C constant table against its contract rows
+    (shared by the netplane and shim sides)."""
+    for cpp_name, twins in contracts:
+        if cpp_name not in consts:
+            violations.append(Violation(
+                "twin-constant", src,
+                f"C++ constant {cpp_name} not found by the extractor "
+                f"(renamed or removed? update analysis/twin_constants.py)"))
+            continue
+        for mod, py_name in twins:
+            pv = py_consts(mod).get(py_name)
+            if pv is None:
+                violations.append(Violation(
+                    "twin-constant", mod,
+                    f"missing twin {py_name} for C++ {cpp_name}"))
+            elif pv != consts[cpp_name]:
+                violations.append(Violation(
+                    "twin-constant", mod,
+                    f"{py_name} = {pv} but C++ {cpp_name} = "
+                    f"{consts[cpp_name]}"))
+
+
+def check(repo_root: str, cpp_text: str | None = None,
+          shim_text: str | None = None) -> list:
     """Diff the C++ constants against every registered Python twin."""
     if cpp_text is None:
         with open(os.path.join(repo_root, CPP)) as fh:
@@ -235,24 +283,26 @@ def check(repo_root: str, cpp_text: str | None = None) -> list:
                 os.path.join(repo_root, mod))
         return py_cache[mod]
 
-    for cpp_name, twins in CONTRACTS:
-        if cpp_name not in consts:
+    _diff_contracts(consts, CONTRACTS, CPP, py_consts, violations)
+
+    # Shim-side constants (native/shim.c): the same extractor family
+    # works — shim.c declares its twin-relevant constants as anonymous
+    # enums, exactly like the engine.
+    if shim_text is None:
+        with open(os.path.join(repo_root, SHIM)) as fh:
+            shim_text = fh.read()
+    shim_consts = cpp_extract.extract_constants(shim_text)
+    _diff_contracts(shim_consts, SHIM_CONTRACTS, SHIM, py_consts,
+                    violations)
+    shim_registered = {name for name, _twins in SHIM_CONTRACTS}
+    for name in sorted(shim_consts):
+        if name.startswith(SHIM_TRACE_PREFIXES) \
+                and name not in shim_registered:
             violations.append(Violation(
-                "twin-constant", CPP,
-                f"C++ constant {cpp_name} not found by the extractor "
-                f"(renamed or removed? update analysis/twin_constants.py)"))
-            continue
-        for mod, py_name in twins:
-            pv = py_consts(mod).get(py_name)
-            if pv is None:
-                violations.append(Violation(
-                    "twin-constant", mod,
-                    f"missing twin {py_name} for C++ {cpp_name}"))
-            elif pv != consts[cpp_name]:
-                violations.append(Violation(
-                    "twin-constant", mod,
-                    f"{py_name} = {pv} but C++ {cpp_name} = "
-                    f"{consts[cpp_name]}"))
+                "twin-constant", SHIM,
+                f"trace enum {name} has no contract row (register it "
+                f"in analysis/twin_constants.py with a "
+                f"trace/events.py twin)"))
 
     for cpp_name, mod, py_name in ARRAY_CONTRACTS:
         cv = arrays.get(cpp_name)

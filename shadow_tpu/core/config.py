@@ -158,6 +158,16 @@ class ExperimentalConfig:
     # [start, end) emits samples iff it crosses a grid boundary
     # (start // interval != end // interval).  0 = every round.
     netstat_interval_ns: int = 0
+    # Syscall observatory (docs/OBSERVABILITY.md "syscall
+    # observatory"): "on" records the deterministic per-syscall
+    # sim-time channel (syscalls-sim.bin: one fixed record per
+    # managed-process syscall dispatch, byte-identical across runs and
+    # schedulers) AND the wall-time IPC round-trip profile
+    # (metrics.wall.ipc.*); "wall" records the wall profile only —
+    # what bench's managed rung uses.  The SC_* disposition counters
+    # (metrics.sim.syscalls.dispositions) run regardless — cheap
+    # integer adds, like drop attribution.
+    syscall_observatory: str = "off"
     # Max conservative rounds a C++ engine span may buffer between
     # pcap drains when engine-side capture is active (was hard-coded;
     # per-round streams must not buffer a whole sim).  The effective
@@ -249,6 +259,7 @@ class ConfigOptions:
                 "flight_recorder": e.flight_recorder,
                 "sim_netstat": e.sim_netstat,
                 "netstat_interval": _ns(e.netstat_interval_ns),
+                "syscall_observatory": e.syscall_observatory,
                 "pcap_span_cap": e.pcap_span_cap,
                 "openssl_crypto_noop": e.openssl_crypto_noop,
                 "use_cpu_pinning": e.use_cpu_pinning,
@@ -392,6 +403,9 @@ class ConfigOptions:
                  else str(v)),
                 ("netstat_interval", "netstat_interval_ns",
                  units.parse_time_ns),
+                ("syscall_observatory", "syscall_observatory",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
                 ("pcap_span_cap", "pcap_span_cap", int),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
                 ("openssl_crypto_noop", "openssl_crypto_noop", bool),
@@ -414,6 +428,11 @@ class ConfigOptions:
             raise ValueError(
                 f"unknown sim_netstat {experimental.sim_netstat!r}; "
                 f"expected one of ('off', 'on')")
+        if experimental.syscall_observatory not in ("off", "wall", "on"):
+            raise ValueError(
+                f"unknown syscall_observatory "
+                f"{experimental.syscall_observatory!r}; expected one of "
+                f"('off', 'wall', 'on')")
         if experimental.pcap_span_cap < 1:
             raise ValueError("pcap_span_cap must be >= 1")
 

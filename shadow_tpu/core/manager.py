@@ -346,6 +346,16 @@ class Manager:
                 self.plane.engine.set_netstat(
                     1, max(int(config.experimental.netstat_interval_ns),
                            1))
+        # Syscall observatory (trace/sctrace.py, docs/OBSERVABILITY.md
+        # "syscall observatory"): SC_* disposition counters are ALWAYS
+        # on (Host.sc_disp integer adds, like drop attribution); the
+        # wall-time IPC round-trip profile and the per-syscall
+        # sim-time record channel are opt-in.
+        self.sctrace = None
+        if config.experimental.syscall_observatory in ("wall", "on"):
+            from shadow_tpu.trace.sctrace import SyscallObservatory
+            self.sctrace = SyscallObservatory(
+                config.experimental.syscall_observatory, self.hosts)
 
     # ------------------------------------------------------------------
 
@@ -970,6 +980,14 @@ class Manager:
                 self._run_hosts(window_end)
                 t1 = fr_wall.now()
                 fr_wall.add("host-loop", t1 - t0, t0)
+                if self.sctrace is not None:
+                    # Per-round managed-host phase wall: the slice of
+                    # host-loop this round spent in the syscall seam
+                    # (IPC wait + dispatch + resume), as its own
+                    # flight-recorder phase.
+                    d = self.sctrace.round_phase_delta()
+                    if d:
+                        fr_wall.add("syscall-service", d)
                 inflight_min = self.propagator.finish_round()
                 t2 = fr_wall.now()
                 fr_wall.add("propagate", t2 - t1, t1)
@@ -1136,6 +1154,21 @@ class Manager:
                     totals["rcvwin_trunc"] += conn.rcvwin_trunc
             out["tcp"] = totals
         return out
+
+    def sc_disposition_totals(self) -> dict:
+        """Syscall-observatory dispositions summed over hosts:
+        SC name -> count (nonzero only).  Always available — the
+        counters are on regardless of experimental.syscall_observatory
+        — and deterministic (they count Python-dispatched syscalls,
+        which the cross-scheduler parity contract pins; engine-resident
+        apps dispatch C++-side and sit outside this accounting)."""
+        from shadow_tpu.trace.events import SC_N, SC_NAMES
+        totals = [0] * SC_N
+        for h in self.hosts:
+            for i in range(SC_N):
+                totals[i] += h.sc_disp[i]
+        return {SC_NAMES[i]: totals[i] for i in range(SC_N)
+                if totals[i]}
 
     def _make_span_runner(self, cls):
         """Shared device-span runner construction (the ONE place the
@@ -1338,6 +1371,17 @@ class Manager:
             reg.gauge("netstat.dropped", channel="sim").set(
                 self.netstat.dropped)
             self.netstat.write(base)
+        # Syscall observatory: disposition counters are always on and
+        # live in the SIM channel (deterministic per config; the gate
+        # byte-diffs them — engine-resident apps dispatch C++-side and
+        # are documented outside this accounting).  The wall-time IPC
+        # profile and the record channel only exist when the knob is
+        # wall/on.
+        reg.ingest("syscalls.dispositions", self.sc_disposition_totals(),
+                   channel="sim")
+        if self.sctrace is not None:
+            self.sctrace.ingest_metrics(reg)
+            self.sctrace.write(base)
         # One reason code per conservative round (trace/audit.py);
         # tools/trace renders this as the attribution report.
         reg.ingest("eligibility", self.audit.as_dict(), channel="wall")

@@ -24,7 +24,7 @@ from shadow_tpu.net.packet import PROTO_TCP
 from shadow_tpu.net.relay import Relay
 from shadow_tpu.net.router import Router
 from shadow_tpu.net.token_bucket import TokenBucket
-from shadow_tpu.trace.events import TEL_BY_REASON, TEL_N
+from shadow_tpu.trace.events import SC_N, TEL_BY_REASON, TEL_N
 
 # Canonical trace kinds, in tiebreak order: a packet sent and dropped at
 # the same instant sorts SND before DRP.
@@ -125,6 +125,19 @@ class Host:
         # Per-syscall-name histogram (sim_stats.rs syscall counts; merged
         # into sim-stats.json by the manager).
         self.syscall_counts: dict[str, int] = {}
+        # Syscall-observatory dispositions (trace/events.py SC_*):
+        # every Python-dispatched syscall — managed-ABI and internal-app
+        # alike — credited exactly one code; always on (integer adds,
+        # like drop attribution).  Engine-resident apps dispatch
+        # C++-side and sit outside this accounting.
+        self.sc_disp = [0] * SC_N
+        # Set by the manager when experimental.syscall_observatory is
+        # wall/on: the per-host wall profile (trace/sctrace.HostScWall)
+        # and — mode "on" — this host's slice of the per-syscall
+        # sim-time record channel (HostSyscallLog).  Both are touched
+        # only by the thread executing this host's events.
+        self.sc_wall = None
+        self.sc_log = None
         # perf_timers feature (perf_timer.rs): cumulative wall ns spent
         # executing this host's events; filled by the manager when
         # experimental.use_perf_timers is on.

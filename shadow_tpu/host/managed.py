@@ -47,6 +47,7 @@ from shadow_tpu.host.shim_abi import (ChannelClosed, ChannelTimeout, IpcBlock,
                                       EV_SYSCALL_COMPLETE_FDXFER,
                                       EV_SYSCALL_DO_NATIVE, EV_XFER_DONE)
 from shadow_tpu.host.syscalls_native import syscall_name
+from shadow_tpu.trace import events as trev
 
 # The unblocked-syscall CPU-latency model (ref configuration.rs:464-480
 # — ~1us per syscall, applied in batches by parking the thread, which
@@ -612,6 +613,16 @@ class ManagedThread:
         self._sigwait_set = 0          # rt_sigtimedwait watch set
         self._sigwait_got = None
         self._sigwait_info = (0, 0, 0)
+        # Syscall observatory: wall ns the manager spent blocked in
+        # the IPC recv that delivered the event currently being
+        # serviced (attributed to that syscall's round trip), the
+        # snapshot taken at dispatch entry (nested sub-protocol recvs
+        # accrue past it and are carved OUT of the resume leg so the
+        # wait/dispatch/resume split stays disjoint), and the outcome
+        # a handshake sub-protocol reports back to _service.
+        self._sc_wait_ns = 0
+        self._sc_pre_wait = 0
+        self._sc_out = (0, 0)
 
     # -- latency model ------------------------------------------------
 
@@ -623,21 +634,48 @@ class ManagedThread:
 
     def _recv(self, host):
         """Next shim event, or None if the child died."""
-        while True:
-            try:
-                ev = self.chan.recv_from_shim(timeout_ns=_DEATH_POLL_NS)
-                # Native-I/O latency the shim accrued since its last
-                # event; flows into the standard unapplied-CPU model.
-                ns = self.chan.take_unapplied_ns()
-                if ns:
-                    self.add_cpu_latency(ns)
-                return ev
-            except ChannelTimeout:
-                if self._poll_death(host):
+        sw = host.sc_wall
+        t0 = sw.now() if sw is not None else 0
+        try:
+            while True:
+                try:
+                    ev = self.chan.recv_from_shim(
+                        timeout_ns=_DEATH_POLL_NS)
+                    # Native-I/O latency the shim accrued since its last
+                    # event; flows into the standard unapplied-CPU model.
+                    ns = self.chan.take_unapplied_ns()
+                    if ns:
+                        self.add_cpu_latency(ns)
+                    # Syscall observatory: locally-answered time reads
+                    # the shim counted since its last event (SC_SHIM —
+                    # no round trip; the slot protocol orders the read
+                    # like take_unapplied_ns).  The drain point is a
+                    # function of the event sequence alone, so the
+                    # count — and any record — is deterministic.
+                    n = self.chan.take_local_count()
+                    if n:
+                        host.sc_disp[trev.SC_SHIM] += n
+                        log = host.sc_log
+                        if log is not None:
+                            t = host.now()
+                            log.rec(t, t, host.id, self.process.pid,
+                                    self.tid, -1, trev.RC_OK,
+                                    trev.SC_SHIM, n)
+                    return ev
+                except ChannelTimeout:
+                    if self._poll_death(host):
+                        return None
+                except ChannelClosed:
+                    self._poll_death(host, blocking=True)
                     return None
-            except ChannelClosed:
-                self._poll_death(host, blocking=True)
-                return None
+        finally:
+            if sw is not None:
+                # Accumulate (don't assign): nested receives inside a
+                # dispatch's sub-protocol (clone/fork handshakes, the
+                # fd-transfer dance) fold into the trip that consumes
+                # the accumulator, instead of clobbering the wait the
+                # original syscall event already paid.
+                self._sc_wait_ns += sw.now() - t0
 
     def _poll_death(self, host, blocking: bool = False) -> bool:
         pid = self.process.native_pid
@@ -844,12 +882,51 @@ class ManagedThread:
         self.state = ST_BLOCKED
         condition.arm(host, self._wakeup)
 
+    def _sc_note(self, host, t_enter: int, num: int, disp: int,
+                 rclass: int, t_exit: int | None = None) -> None:
+        """Credit this dispatch its single SC_* disposition (always-on
+        counters) and append the per-syscall record when the syscall
+        observatory's sim channel is recording.  One call per dispatch
+        — the conservation contract the `trace sys` report checks
+        against strace line counts."""
+        host.sc_disp[disp] += 1
+        log = host.sc_log
+        if log is not None:
+            log.rec(t_enter,
+                    t_exit if t_exit is not None else host.now(),
+                    host.id, self.process.pid, self.tid, num, rclass,
+                    disp)
+
+    def _sc_trip(self, sw, num: int, w0: int, w1: int) -> None:
+        """Feed the wall profile one round trip: the recv wait that
+        delivered this event + dispatch + everything after dispatch
+        (strace, signal delivery, response send).  Nested sub-protocol
+        waits (clone/fork handshakes, the fd-transfer dance) accrued
+        past the dispatch-entry snapshot sit inside [w1, now]; carve
+        them out of the resume leg so the three legs stay disjoint.
+        No-op when the observatory is off — the single guard for
+        every branch."""
+        if sw is None:
+            return
+        nested = self._sc_wait_ns - self._sc_pre_wait
+        sw.trip(syscall_name(num), self._sc_wait_ns, w1 - w0,
+                max(sw.now() - w1 - nested, 0))
+        self._sc_wait_ns = 0
+
     def _service(self, host, num: int, args, restarted: bool) -> bool:
         """Dispatch one syscall; returns True to keep pumping events."""
         handler = host.syscall_handler_native
         host.count_syscall(syscall_name(num))
         process = self.process
+        sc_t0 = host.now()
+        sw = host.sc_wall
+        w0 = w1 = 0
+        if sw is not None:
+            w0 = sw.now()
+            self._sc_pre_wait = self._sc_wait_ns
         result = handler.dispatch(host, process, self, num, args, restarted)
+        if sw is not None:
+            w1 = sw.now()
         if process.strace_mode is not None:
             from shadow_tpu.host import strace
             process.strace_write(strace.format_native_call(
@@ -858,17 +935,31 @@ class ManagedThread:
         kind = result[0]
 
         if kind == "block":
+            self._sc_note(host, sc_t0, num, trev.SC_PARKED,
+                          trev.RC_NONE)
+            self._sc_trip(sw, num, w0, w1)
             self._park(host, result[1], num, args)
             return False
 
-        if kind == "clone":
-            return self._do_clone(host, result[1], result[2])
-
-        if kind == "fork":
-            return self._do_fork(host)
-
-        if kind == "execve":
-            return self._do_execve(host, result[1], result[2], result[3])
+        if kind in ("clone", "fork", "execve"):
+            # The handshake sub-protocols report their real outcome
+            # through _sc_out (set before each completion send); a
+            # conversation that dies mid-dance keeps the SC_PROTO
+            # default — the record is noted AFTER the dance, and the
+            # trip too (its nested channel waits accumulated into
+            # _sc_wait_ns and the dance is this round trip's resume
+            # cost).
+            self._sc_out = (trev.SC_PROTO, trev.RC_NONE)
+            if kind == "clone":
+                keep = self._do_clone(host, result[1], result[2])
+            elif kind == "fork":
+                keep = self._do_fork(host)
+            else:
+                keep = self._do_execve(host, result[1], result[2],
+                                       result[3])
+            self._sc_note(host, sc_t0, num, *self._sc_out)
+            self._sc_trip(sw, num, w0, w1)
+            return keep
 
         if kind == "thread_exit":
             # A secondary thread exiting (SYS_exit with siblings alive):
@@ -876,6 +967,9 @@ class ManagedThread:
             # CLONE_CHILD_CLEARTID contract against OUR futex table so a
             # pthread_join blocked in the emulated FUTEX_WAIT wakes.
             code = result[1]
+            self._sc_note(host, sc_t0, num, trev.SC_NATIVE,
+                          trev.RC_NATIVE)
+            self._sc_trip(sw, num, w0, w1)
             self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
             if not self._await_native_thread_gone():
                 # Delivering the CLEARTID wake while ctid may still be
@@ -904,6 +998,9 @@ class ManagedThread:
             # exit_group run, then reap synchronously.  The wait is
             # event-driven (poll on the process pidfd), not a
             # wall-clock slice loop.
+            self._sc_note(host, sc_t0, num, trev.SC_NATIVE,
+                          trev.RC_NATIVE)
+            self._sc_trip(sw, num, w0, w1)
             self.chan.send_to_shim(EV_SYSCALL_DO_NATIVE)
             if _pidfd_wait(self.process.native_pid, 0, 10.0) is None:
                 # No pidfd support: fall back to the timed slice poll.
@@ -922,22 +1019,32 @@ class ManagedThread:
             # dance (sendmsg on the xfer socket + shim collection)
             # before the ordinary completion below.
             if not self._do_fdxfer(host, *result[2:]):
+                # Receiver died mid-dance: the dispatch happened (and
+                # strace logged it) but no response ever lands.
+                self._sc_note(host, sc_t0, num, trev.SC_PROTO,
+                              trev.RC_NONE)
+                self._sc_trip(sw, num, w0, w1)
                 return False
             kind, result = "done", ("done", result[1])
 
         if kind == "native":
             rv_kind, rv_val = EV_SYSCALL_DO_NATIVE, 0
+            sc_disp, sc_rc = trev.SC_NATIVE, trev.RC_NATIVE
         elif kind == "done":
             rv_kind, rv_val = EV_SYSCALL_COMPLETE, int(result[1] or 0)
+            sc_disp, sc_rc = trev.SC_SERVICED, trev.RC_OK
         elif kind == "error":
             err = result[1]
             rv_kind, rv_val = EV_SYSCALL_COMPLETE, -int(err.errno or 22)
+            sc_disp, sc_rc = trev.SC_SERVICED, trev.RC_ERR
         else:  # pragma: no cover
             raise AssertionError(f"bad dispatch result {result!r}")
 
         # The dispatch may have terminated this very process (a
         # self-directed fatal signal): the channel is gone, stop pumping.
         if self.state == ST_EXITED or process.exited:
+            self._sc_note(host, sc_t0, num, sc_disp, sc_rc)
+            self._sc_trip(sw, num, w0, w1)
             return False
 
         # Response point: emulated signals are delivered before the
@@ -948,10 +1055,12 @@ class ManagedThread:
                 restore, self._suspend_restore = self._suspend_restore, None
             r = self._deliver_signals(
                 host, ("resp", rv_kind, rv_val, restore))
-            if r == "sent":
-                return True
-            if r == "dead":
-                return False
+            if r in ("sent", "dead"):
+                # The response rides the parked continuation (or never
+                # lands at all): the dispatch itself is complete.
+                self._sc_note(host, sc_t0, num, sc_disp, sc_rc)
+                self._sc_trip(sw, num, w0, w1)
+                return r == "sent"
             if restore is not None:
                 # rt_sigsuspend with every pending signal consumed as
                 # ignored (disposition flipped while blocked): no handler
@@ -959,6 +1068,9 @@ class ManagedThread:
                 # temporary mask — re-park instead of returning EINTR,
                 # and keep the saved mask for the eventual real wakeup.
                 from shadow_tpu.core import simtime
+                self._sc_note(host, sc_t0, num, trev.SC_PARKED,
+                              trev.RC_NONE)
+                self._sc_trip(sw, num, w0, w1)
                 self._suspend_restore = restore
                 self._park(host, SyscallCondition(
                     timeout_at=simtime.TIME_NEVER - 1), num, args)
@@ -974,11 +1086,22 @@ class ManagedThread:
             self._pending_response = (rv_kind, rv_val)
             apply_at = host.now() + self._unapplied_ns
             self._unapplied_ns = 0
+            # The response lands at apply_at, not now: the record's
+            # exit stamp carries the deferred instant (deterministic —
+            # both addends are simulated values).
+            self._sc_note(host, sc_t0, num, sc_disp, sc_rc,
+                          t_exit=apply_at)
+            self._sc_trip(sw, num, w0, w1)
             host.schedule_task_at(apply_at,
                                   TaskRef("cpu-latency", self.resume))
             return False
 
-        return self._send_response_or_park(host, rv_kind, rv_val)
+        self._sc_note(host, sc_t0, num, sc_disp, sc_rc)
+        keep = self._send_response_or_park(host, rv_kind, rv_val)
+        # Trip AFTER the send so the resume leg includes the response
+        # publish + futex wake.
+        self._sc_trip(sw, num, w0, w1)
+        return keep
 
     def _send_response_or_park(self, host, rv_kind, rv_val) -> bool:
         """Send a syscall response — unless the process stopped while
@@ -1003,6 +1126,7 @@ class ManagedThread:
         thread birth is a deterministic simulation event."""
         idx = self.block.alloc_channel()
         if idx is None:
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -11)  # EAGAIN
             return True
         self.chan.send_to_shim(EV_CLONE_RES, idx)
@@ -1016,6 +1140,7 @@ class ManagedThread:
         child_tid = int(child_tid)
         if child_tid < 0:
             self.block.free_channel(idx)
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, child_tid)
             return True
         process = self.process
@@ -1029,6 +1154,7 @@ class ManagedThread:
         process.threads.append(child)
         host.schedule_task_at(host.now(), TaskRef("thread-start",
                                                   child.resume))
+        self._sc_out = (trev.SC_SERVICED, trev.RC_OK)
         self.chan.send_to_shim(EV_SYSCALL_COMPLETE, child_tid)
         return True
 
@@ -1118,6 +1244,7 @@ class ManagedThread:
             ipc = IpcBlock(ipc_path)
         except OSError:
             host.processes.pop(child.pid, None)
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -11)  # EAGAIN
             return True
         ipc.set_sim_time(host.now())
@@ -1147,6 +1274,7 @@ class ManagedThread:
         native_pid = int(native_pid)
         if native_pid < 0:
             abort_fork()
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, native_pid)
             return True
 
@@ -1197,6 +1325,7 @@ class ManagedThread:
         child.threads.append(thread)
         host.schedule_task_at(host.now(), TaskRef("fork-start",
                                                   thread.resume))
+        self._sc_out = (trev.SC_SERVICED, trev.RC_OK)
         self.chan.send_to_shim(EV_SYSCALL_COMPLETE, child.pid)
         return True
 
@@ -1234,14 +1363,17 @@ class ManagedThread:
         else:
             resolved = path
         if not resolved or not os.path.exists(resolved):
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.ENOENT)
             return True
         if not os.access(resolved, os.X_OK):
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.EACCES)
             return True
         if _elf_missing_interp(resolved):
             # Static ELF: the shim cannot ride into it (see
             # _elf_missing_interp); refuse like a bad format.
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -_errno.ENOEXEC)
             return True
 
@@ -1262,6 +1394,7 @@ class ManagedThread:
                 code = _errno.E2BIG
             else:
                 code = _errno.ENOEXEC
+            self._sc_out = (trev.SC_SERVICED, trev.RC_ERR)
             self.chan.send_to_shim(EV_SYSCALL_COMPLETE, -code)
             return True
 
@@ -1303,6 +1436,7 @@ class ManagedThread:
         new_thread.sig_mask = self.sig_mask  # exec preserves the mask
         host.schedule_task_at(host.now(), TaskRef("exec-start",
                                                   new_thread.resume))
+        self._sc_out = (trev.SC_SERVICED, trev.RC_OK)
         return False  # the old image's pump ends here
 
     def _await_native_thread_gone(self) -> bool:
@@ -1346,6 +1480,11 @@ class ManagedThread:
             self.resume(host)
 
     def _protocol_error(self, host, why: str) -> None:
+        # Observatory note: dispositions are credited strictly at
+        # dispatch level (exactly one per dispatch — the conservation
+        # contract); a dispatch whose conversation dies mid-service is
+        # credited SC_PROTO by its _service branch, and teardown here
+        # adds nothing on top.
         self.process.stderr += (
             f"[shadow-tpu] managed IPC protocol error: {why}\n").encode()
         try:

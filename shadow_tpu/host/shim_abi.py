@@ -23,7 +23,7 @@ import struct
 
 # --- constants mirrored from native/shim_ipc.h ---------------------
 MAGIC = 0x53545055
-VERSION = 6
+VERSION = 7
 FILE_SIZE = 24576
 
 N_CHANS = 64
@@ -32,6 +32,11 @@ CHAN_STRIDE = 320
 CHAN_TO_SHADOW = 0
 CHAN_TO_SHIM = 72
 CHAN_UNAPPLIED = 2 * 72 + 8 * 16  # after clone_regs[15] + clone_chan_idx
+# Shim-side SC_SHIM sequence counter (syscall observatory): locally-
+# answered time syscalls since the last drain.  C twin: SC_CHAN_LOCAL_OFF
+# in native/shim.c (static_assert-pinned to the struct; analysis pass 1
+# diffs the two values).
+CHAN_SC_LOCAL = 2 * 72 + 8 * 17
 PATH_MAX = 160
 
 SLOT_EMPTY = 0
@@ -103,7 +108,8 @@ class ChannelTimeout(Exception):
 class Channel:
     """One thread's request/response slot pair inside an IpcBlock."""
 
-    __slots__ = ("block", "index", "_to_shadow", "_to_shim", "_unapplied")
+    __slots__ = ("block", "index", "_to_shadow", "_to_shim", "_unapplied",
+                 "_sc_local")
 
     def __init__(self, block: "IpcBlock", index: int):
         self.block = block
@@ -112,6 +118,7 @@ class Channel:
         self._to_shadow = base + CHAN_TO_SHADOW
         self._to_shim = base + CHAN_TO_SHIM
         self._unapplied = base + CHAN_UNAPPLIED
+        self._sc_local = base + CHAN_SC_LOCAL
 
     def send_to_shim(self, kind: int, num: int = 0,
                      args: tuple = (0, 0, 0, 0, 0, 0)) -> None:
@@ -158,6 +165,16 @@ class Channel:
         if ns:
             struct.pack_into("<Q", mm, self._unapplied, 0)
         return ns
+
+    def take_local_count(self) -> int:
+        """Drain the count of syscalls the shim answered locally (the
+        time family; SC_SHIM disposition) since the last drain — same
+        slot-protocol ordering argument as take_unapplied_ns."""
+        mm = self.block._mm
+        (n,) = struct.unpack_from("<Q", mm, self._sc_local)
+        if n:
+            struct.pack_into("<Q", mm, self._sc_local, 0)
+        return n
 
     def mark_closed(self) -> None:
         """Wake the shim thread with CLOSED on both slots."""

@@ -21,6 +21,7 @@ from shadow_tpu.host.condition import SyscallCondition
 from shadow_tpu.host.socket_udp import UdpSocket
 from shadow_tpu.host.status import S_READABLE, S_WRITABLE
 from shadow_tpu.net import graph as netgraph
+from shadow_tpu.trace.events import SC_PARKED, SC_SERVICED
 
 
 def _done(value=None):
@@ -62,17 +63,38 @@ class SyscallHandler:
     def dispatch(self, host, process, thread, call, restarted: bool):
         name = call[0]
         handler = getattr(self, "sys_" + name, None)
+        # Syscall observatory: the internal-app seam mirrors the
+        # managed-ABI one — disposition counters always on (including
+        # the ENOSYS path, so disposition totals stay equal to the
+        # dispatch count), wall-time dispatch profiling when
+        # host.sc_wall is attached (internal apps have no IPC
+        # wait/resume legs; the record channel covers managed
+        # processes only, docs/OBSERVABILITY.md).
+        sw = host.sc_wall
+        t0 = sw.now() if sw is not None else 0
         if handler is None:
-            return _error(errno.ENOSYS, f"unknown syscall {name!r}")
-        try:
-            return handler(host, process, thread, restarted, *call[1:])
-        except BlockingIOError as e:
-            # Raised by socket internals; translated to block/error by the
-            # specific handlers — reaching here means nonblocking mode.
-            return _error(e.errno or errno.EWOULDBLOCK, str(e))
-        except OSError as e:
-            return _error(e.errno if e.errno is not None else errno.EINVAL,
-                          str(e))
+            result = _error(errno.ENOSYS, f"unknown syscall {name!r}")
+        else:
+            try:
+                result = handler(host, process, thread, restarted,
+                                 *call[1:])
+            except BlockingIOError as e:
+                # Raised by socket internals; translated to block/error
+                # by the specific handlers — reaching here means
+                # nonblocking mode.
+                result = _error(e.errno or errno.EWOULDBLOCK, str(e))
+            except OSError as e:
+                result = _error(
+                    e.errno if e.errno is not None else errno.EINVAL,
+                    str(e))
+        host.sc_disp[SC_PARKED if result[0] == "block"
+                     else SC_SERVICED] += 1
+        if sw is not None:
+            # ipc=False + an app: family namespace — internal
+            # dispatches must not pollute the managed round-trip stats
+            # or share histograms with same-named ABI syscalls.
+            sw.trip("app:" + name, 0, sw.now() - t0, 0, ipc=False)
+        return result
 
     # ------------------------------------------------------------------
     # Sockets

@@ -64,7 +64,12 @@ def _load(data_dir: str):
     if os.path.exists(tel_path):
         with open(tel_path, "rb") as f:
             tel_bytes = f.read()
-    return stats, sim_bytes, wall, tel_bytes
+    sc_bytes = b""
+    sc_path = os.path.join(data_dir, "syscalls-sim.bin")
+    if os.path.exists(sc_path):
+        with open(sc_path, "rb") as f:
+            sc_bytes = f.read()
+    return stats, sim_bytes, wall, tel_bytes, sc_bytes
 
 
 def summarize(data_dir: str, chrome_out: str | None = None,
@@ -79,7 +84,7 @@ def summarize(data_dir: str, chrome_out: str | None = None,
                                          FR_SPAN_ABORT, FR_SPAN_COMMIT,
                                          FR_SPAN_START, iter_records)
 
-    stats, sim_bytes, wall, tel_bytes = _load(data_dir)
+    stats, sim_bytes, wall, tel_bytes, sc_bytes = _load(data_dir)
     rounds = stats.get("rounds", 0)
     metrics = stats.get("metrics", {})
     elig = metrics.get("wall", {}).get("eligibility", {})
@@ -121,7 +126,7 @@ def summarize(data_dir: str, chrome_out: str | None = None,
 
     if chrome_out is not None:
         from shadow_tpu.trace.chrome import chrome_trace
-        doc = chrome_trace(sim_bytes, wall, tel_bytes)
+        doc = chrome_trace(sim_bytes, wall, tel_bytes, sc_bytes)
         with open(chrome_out, "w") as f:
             json.dump(doc, f)
         print(f"chrome trace: {chrome_out} "
@@ -171,7 +176,7 @@ def net_report(data_dir: str, top_n: int = 10, out=None) -> bool:
     from shadow_tpu.trace.netstat import (group_by_conn,
                                           top_by_retransmits)
 
-    stats, _sim, _wall, tel_bytes = _load(data_dir)
+    stats, _sim, _wall, tel_bytes, _sc = _load(data_dir)
     ok = drop_report(stats, out=out)
 
     if not tel_bytes:
@@ -196,6 +201,168 @@ def net_report(data_dir: str, top_n: int = 10, out=None) -> bool:
               f"{last[8] / 1e6:>8.2f} {last[6] / 1024:>8.1f} "
               f"{max(r[11] for r in recs):>8} "
               f"{max(r[12] for r in recs):>8}", file=out)
+    return ok
+
+
+def _processed_config(data_dir: str) -> dict:
+    """The processed-config.yaml next to sim-stats.json ({} when
+    absent) — the ONE parse every report shares."""
+    cfg_path = os.path.join(data_dir, "processed-config.yaml")
+    if not os.path.exists(cfg_path):
+        return {}
+    import yaml
+    with open(cfg_path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def _host_names(cfg: dict) -> list:
+    """Host-id -> name mapping: host ids follow sorted-name order
+    (core/manager.py builds hosts that way), so the processed config's
+    sorted host keys ARE the id order."""
+    return sorted((cfg.get("hosts") or {}).keys())
+
+
+def _strace_line_counts(data_dir: str, names: list) -> dict:
+    """(host_id, pid) -> strace line count, from the per-process
+    .strace files (named <proc>.<pid>.strace in each host dir)."""
+    out: dict = {}
+    for host_id, name in enumerate(names):
+        hdir = os.path.join(data_dir, "hosts", name)
+        if not os.path.isdir(hdir):
+            continue
+        for fn in os.listdir(hdir):
+            if not fn.endswith(".strace"):
+                continue
+            try:
+                pid = int(fn[:-len(".strace")].rsplit(".", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            with open(os.path.join(hdir, fn), "rb") as f:
+                out[(host_id, pid)] = f.read().count(b"\n")
+    return out
+
+
+def sys_report(data_dir: str, top_n: int = 10, out=None) -> bool:
+    """`trace sys`: the syscall-observatory report — disposition table
+    with conservation, top syscalls by count and wall, and the IPC
+    round-trip wall breakdown.  Returns False on a conservation gap
+    (a record with an out-of-range disposition, or a managed process
+    whose dispatch-record count disagrees with its strace line count)."""
+    if out is None:
+        out = sys.stdout
+    from shadow_tpu.host.syscalls_native import syscall_name
+    from shadow_tpu.trace.events import SC_N, SC_SHIM, iter_sc_records
+
+    stats, _sim, _wall, _tel, sc_bytes = _load(data_dir)
+    metrics = stats.get("metrics", {})
+    disp = metrics.get("sim", {}).get("syscalls", {}).get(
+        "dispositions", {})
+
+    print("syscall observatory (one SC_* disposition per dispatch):",
+          file=out)
+    if disp:
+        width = max(len(k) for k in disp)
+        for name, n in sorted(disp.items(), key=lambda kv: -kv[1]):
+            print(f"  {name:<{width}}  {n:>10}", file=out)
+    else:
+        print("  (no Python-dispatched syscalls — engine-resident "
+              "apps sit outside this accounting)", file=out)
+
+    ok = True
+    if not sc_bytes:
+        print("syscall channel: absent (run with "
+              "experimental.syscall_observatory: on)", file=out)
+    else:
+        # Per-record accounting: counts by syscall number + per-process
+        # dispatch counts for the strace cross-check.
+        by_sysno: dict = {}
+        by_proc: dict = {}
+        shim_total = 0
+        bad_disp = 0
+        n_recs = 0
+        for rec in iter_sc_records(sc_bytes):
+            n_recs += 1
+            _t0, _t1, host, pid, _tid, sysno, _rc, d, aux = rec
+            if not 0 <= d < SC_N:
+                bad_disp += 1
+            if d == SC_SHIM:
+                shim_total += aux
+            if sysno >= 0:
+                by_sysno[sysno] = by_sysno.get(sysno, 0) + 1
+                by_proc[(host, pid)] = by_proc.get((host, pid), 0) + 1
+        print(f"syscall channel: {n_recs} records "
+              f"({sum(by_sysno.values())} dispatches, {shim_total} "
+              f"shim-handled time reads)", file=out)
+        if bad_disp:
+            ok = False
+            print(f"  {bad_disp} record(s) with out-of-range "
+                  f"disposition — CONSERVATION GAP", file=out)
+
+        # Wall per family (metrics.wall.ipc) joined onto the counts.
+        fams = metrics.get("wall", {}).get("ipc", {}).get("families",
+                                                          {})
+        ranked = sorted(by_sysno.items(),
+                        key=lambda kv: (-kv[1], kv[0]))[:top_n]
+        print(f"top {len(ranked)} syscalls by count:", file=out)
+        print(f"  {'syscall':<18} {'count':>8} {'wall ms':>9} "
+              f"{'p50 us':>8} {'p99 us':>8}", file=out)
+        for sysno, cnt in ranked:
+            name = syscall_name(sysno)
+            f = fams.get(name, {})
+            print(f"  {name:<18} {cnt:>8} "
+                  f"{f.get('total_ns', 0) / 1e6:>9.2f} "
+                  f"{f.get('p50_ns', 0) / 1e3:>8.1f} "
+                  f"{f.get('p99_ns', 0) / 1e3:>8.1f}", file=out)
+        if fams:
+            by_wall = sorted(fams.items(),
+                             key=lambda kv: -kv[1]["total_ns"])[:top_n]
+            print(f"top {len(by_wall)} syscalls by wall:", file=out)
+            for name, f in by_wall:
+                print(f"  {name:<18} {f['count']:>8} "
+                      f"{f['total_ns'] / 1e6:>9.2f} "
+                      f"{f['p50_ns'] / 1e3:>8.1f} "
+                      f"{f['p99_ns'] / 1e3:>8.1f}", file=out)
+
+        # Strace cross-check: one strace line per dispatch, so each
+        # managed process's dispatch-record count must equal its
+        # .strace line count (when strace logging was on).  A capped
+        # channel (metrics.sim.syscalls.dropped > 0) legitimately
+        # undercounts — report the truncation instead of a false gap.
+        chan_dropped = metrics.get("sim", {}).get("syscalls", {}).get(
+            "dropped", 0)
+        if chan_dropped:
+            print(f"strace cross-check: skipped — channel truncated "
+                  f"({chan_dropped} records dropped at the per-host "
+                  f"cap)", file=out)
+        else:
+            straces = _strace_line_counts(
+                data_dir, _host_names(_processed_config(data_dir)))
+            checked = mismatched = 0
+            for key, n in sorted(by_proc.items()):
+                want = straces.get(key)
+                if want is None:
+                    continue
+                checked += 1
+                if n != want:
+                    mismatched += 1
+                    ok = False
+                    print(f"  h{key[0]} pid{key[1]}: {n} dispatch "
+                          f"records != {want} strace lines — "
+                          f"CONSERVATION GAP", file=out)
+            if checked:
+                print(f"strace cross-check: {checked} process(es), "
+                      f"{'all consistent' if not mismatched else f'{mismatched} mismatched'}",
+                      file=out)
+
+    ipc = metrics.get("wall", {}).get("ipc", {})
+    if ipc:
+        mc = ipc.get("memcopy", {})
+        print(f"ipc round trips: {ipc.get('round_trips', 0)} | wall "
+              f"wait {ipc.get('wait_ns', 0) / 1e9:.3f}s, dispatch "
+              f"{ipc.get('dispatch_ns', 0) / 1e9:.3f}s, resume "
+              f"{ipc.get('resume_ns', 0) / 1e9:.3f}s, memcopy "
+              f"{(mc.get('read_ns', 0) + mc.get('write_ns', 0)) / 1e9:.3f}s "
+              f"({mc.get('calls', 0)} copies)", file=out)
     return ok
 
 
@@ -252,11 +419,61 @@ _EXPLAIN = {
 }
 
 
+def _managed_blockers(data_dir: str, sc_bytes: bytes, out) -> None:
+    """Join the eligibility audit with the syscall channel: when
+    managed processes keep rounds off the span path (their hosts carry
+    Python-side work every round they run), name the offending
+    host/process and its LAST blocking syscall — the wake-up the
+    batching work of ROADMAP item 2 must amortize."""
+    from shadow_tpu.host.syscalls_native import syscall_name
+    from shadow_tpu.trace.events import SC_PARKED, iter_sc_records
+
+    # One parse of the processed config yields both the id->name order
+    # and the managed-host set.
+    cfg = _processed_config(data_dir)
+    names = _host_names(cfg)
+    managed_hosts = set()
+    for name in names:
+        h = (cfg.get("hosts") or {}).get(name) or {}
+        for p in h.get("processes", []) or []:
+            # Managed processes are configured by filesystem path
+            # (core/manager._schedule_spawn's dispatch rule).
+            if "/" in str(p.get("path", "")):
+                managed_hosts.add(name)
+    if not managed_hosts:
+        return
+    if not sc_bytes:
+        print(f"  managed hosts present ({len(managed_hosts)}): run "
+              f"with experimental.syscall_observatory: on to see each "
+              f"host's last blocking syscall here.", file=out)
+        return
+    last_park: dict = {}  # host_id -> (t, pid, tid, sysno)
+    for rec in iter_sc_records(sc_bytes):
+        t0, _t1, host, pid, tid, sysno, _rc, disp, _aux = rec
+        if disp == SC_PARKED and sysno >= 0:
+            last_park[host] = (t0, pid, tid, sysno)
+    print(f"  managed hosts holding rounds on the Python path "
+          f"({len(managed_hosts)}):", file=out)
+    shown = 0
+    for name in sorted(managed_hosts):
+        host_id = names.index(name) if name in names else -1
+        park = last_park.get(host_id)
+        if park is None:
+            print(f"    {name}: no blocking syscall recorded", file=out)
+        else:
+            t, pid, tid, sysno = park
+            print(f"    {name}: pid {pid} tid {tid} last blocked in "
+                  f"{syscall_name(sysno)} at {t / 1e9:.3f}s", file=out)
+        shown += 1
+        if shown >= 8:
+            break
+
+
 def explain_report(data_dir: str, out=None) -> bool:
     """`trace explain`: top eligibility blockers -> remediation."""
     if out is None:
         out = sys.stdout
-    stats, _sim, _wall, _tel = _load(data_dir)
+    stats, _sim, _wall, _tel, sc_bytes = _load(data_dir)
     elig = stats.get("metrics", {}).get("wall", {}).get(
         "eligibility", {})
     rounds = stats.get("rounds", 0)
@@ -268,16 +485,12 @@ def explain_report(data_dir: str, out=None) -> bool:
     # Offending hosts per object-path cause, from the processed
     # config written next to sim-stats.json.
     pcap_hosts, cpu_hosts, other_hosts = [], [], []
-    cfg_path = os.path.join(data_dir, "processed-config.yaml")
-    if os.path.exists(cfg_path):
-        import yaml
-        with open(cfg_path) as f:
-            cfg = yaml.safe_load(f) or {}
-        for name, h in sorted((cfg.get("hosts") or {}).items()):
-            if (h or {}).get("pcap_enabled"):
-                pcap_hosts.append(name)
-        if (cfg.get("experimental") or {}).get("host_cpu_threshold"):
-            cpu_hosts = sorted((cfg.get("hosts") or {}).keys())
+    cfg = _processed_config(data_dir)
+    for name, h in sorted((cfg.get("hosts") or {}).items()):
+        if (h or {}).get("pcap_enabled"):
+            pcap_hosts.append(name)
+    if (cfg.get("experimental") or {}).get("host_cpu_threshold"):
+        cpu_hosts = _host_names(cfg)
     hosts_of = {"object-path:pcap": pcap_hosts,
                 "object-path:cpu-model": cpu_hosts,
                 "object-path:other": other_hosts}
@@ -286,6 +499,7 @@ def explain_report(data_dir: str, out=None) -> bool:
     print(f"device-span coverage: {device}/{rounds} rounds; top "
           f"blockers and remediation:", file=out)
     shown = 0
+    managed_shown = False
     for name, n in sorted(elig.items(), key=lambda kv: -kv[1]):
         if name == "device-span":
             continue
@@ -296,6 +510,13 @@ def explain_report(data_dir: str, out=None) -> bool:
         pct = 100.0 * n / rounds if rounds else 0.0
         print(f"  {name} — {n} rounds ({pct:.1f}%)", file=out)
         print(f"      {text}", file=out)
+        if not managed_shown and name in (
+                "object-path:other", "object-path:py-task",
+                "per-round:callback-host", "per-round:scheduler"):
+            # These are the reasons managed processes cause: join the
+            # audit with the syscall channel and name the offenders.
+            _managed_blockers(data_dir, sc_bytes, out)
+            managed_shown = True
         shown += 1
         if shown >= 6:
             break
@@ -322,11 +543,78 @@ def run_config(config_path: str, data_dir: str | None = None) -> str:
     return config.general.data_directory
 
 
+def smoke_managed() -> int:
+    """Managed-process smoke leg: one real C binary under the shim
+    with the syscall observatory on — disposition conservation must
+    hold (trace sys exits ok) and the Chrome export must carry a
+    non-empty per-process syscall counter track.  Skips cleanly when
+    no C toolchain is available."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+
+    if shutil.which("cc") is None:
+        print("trace smoke: managed leg skipped (no C toolchain)",
+              file=sys.stderr)
+        return 0
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "tests",
+        "plugins", "sleep_time.c")
+    with tempfile.TemporaryDirectory() as td:
+        exe = os.path.join(td, "sleep_time")
+        subprocess.run(["cc", "-O1", "-o", exe, src], check=True)
+        base = os.path.join(td, "managed-smoke")
+        config = ConfigOptions.from_yaml_text(f"""
+general: {{ stop_time: 5s, seed: 3, data_directory: "{base}" }}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" ] ]
+experimental:
+  strace_logging_mode: deterministic
+  syscall_observatory: "on"
+  flight_recorder: "on"
+hosts:
+  h0:
+    network_node_id: 0
+    processes:
+      - {{ path: {exe}, start_time: 1s }}
+""")
+        _manager, summary = run_simulation(config, write_data=True)
+        if not summary.ok:
+            print(f"trace smoke: managed sim failed: "
+                  f"{summary.plugin_errors}", file=sys.stderr)
+            return 1
+        if not sys_report(base):
+            print("trace smoke: syscall dispositions do not conserve",
+                  file=sys.stderr)
+            return 1
+        from shadow_tpu.trace.chrome import PID_SYSCALL, chrome_trace
+        _stats, sim_bytes, wall, _tel, sc_bytes = _load(base)
+        doc = chrome_trace(sim_bytes, wall, b"", sc_bytes)
+        counters = [e for e in doc["traceEvents"]
+                    if e.get("ph") == "C" and e.get("pid") == PID_SYSCALL]
+        if not counters:
+            print("trace smoke: chrome export has no per-process "
+                  "syscall counter track", file=sys.stderr)
+            return 1
+    print(f"trace smoke: managed leg ok (dispositions conserved, "
+          f"{len(counters)} syscall counter events)")
+    return 0
+
+
 def smoke(n_hosts: int) -> int:
     """50-host traced tgen TCP tier: summary + eligibility must
     render and account for every round, the drop-cause counters must
     conserve, and the Chrome export must carry a non-empty
-    per-connection counter track (the ./setup trace target)."""
+    per-connection counter track (the ./setup trace target).  A
+    managed-process leg (one real binary under the shim, syscall
+    observatory on) rides along when a C toolchain is available."""
     import tempfile
 
     from shadow_tpu.core.config import ConfigOptions
@@ -375,27 +663,30 @@ def smoke(n_hosts: int) -> int:
     print(f"trace smoke: ok ({n_hosts} hosts, {summary.rounds} rounds "
           f"fully attributed, drops conserved, "
           f"{len(counters)} counter events)")
-    return 0
+    return smoke_managed()
 
 
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("net", "explain"):
+    if argv and argv[0] in ("net", "explain", "sys"):
         # Subcommands: `trace net DATA_DIR [--top N]`,
+        #              `trace sys DATA_DIR [--top N]`,
         #              `trace explain DATA_DIR`.
         sub = argparse.ArgumentParser(
             prog=f"shadow_tpu.tools.trace {argv[0]}")
         sub.add_argument("data_dir")
-        if argv[0] == "net":
+        if argv[0] in ("net", "sys"):
             sub.add_argument("--top", type=int, default=10,
-                             help="connections in the report "
-                                  "(default 10)")
+                             help="rows in the report (default 10)")
         sargs = sub.parse_args(argv[1:])
         from shadow_tpu.utils.platform import honor_platform_env
         honor_platform_env()
         if argv[0] == "net":
             return 0 if net_report(sargs.data_dir,
+                                   top_n=sargs.top) else 1
+        if argv[0] == "sys":
+            return 0 if sys_report(sargs.data_dir,
                                    top_n=sargs.top) else 1
         return 0 if explain_report(sargs.data_dir) else 1
 

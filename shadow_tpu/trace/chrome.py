@@ -23,6 +23,7 @@ from shadow_tpu.trace.events import (EL_NAMES, FAM_NAMES, FR_ROUND,
 PID_SIM = 1
 PID_WALL = 2
 PID_NETSTAT = 3
+PID_SYSCALL = 4
 
 # Counter tracks per exported connection: (track suffix, args built
 # from a TEL_REC tuple — see trace/events.py for the field order).
@@ -67,13 +68,64 @@ def _meta(pid: int, tid: int, what: str, name: str) -> dict:
             "args": {"name": name}}
 
 
+def syscall_events(sc_bytes: bytes, top_n: int = 16) -> list:
+    """Per-process syscall slices + counter tracks from
+    syscalls-sim.bin (the syscall observatory's record channel).
+
+    One thread track per (host, pid) — capped to the top_n processes
+    by record count (ties broken by key, so the selection is
+    deterministic — same precedent as the netstat counter tracks),
+    tids assigned in sorted key order.  Each track carries an "X"
+    slice per dispatch record (sim µs, duration = the record's
+    entry->exit span) and a cumulative per-process syscall counter
+    ("C" events; shim-handled batches bump it by their drained
+    count)."""
+    from shadow_tpu.host.syscalls_native import syscall_name
+    from shadow_tpu.trace.events import (SC_NAMES, SC_SHIM,
+                                         iter_sc_records)
+
+    by_proc: dict = {}
+    for rec in iter_sc_records(sc_bytes):
+        by_proc.setdefault((rec[2], rec[3]), []).append(rec)
+    ev: list = []
+    if not by_proc:
+        return ev
+    keep = sorted(sorted(by_proc,
+                         key=lambda k: (-len(by_proc[k]), k))[:top_n])
+    ev.append(_meta(PID_SYSCALL, 0, "process_name",
+                    f"syscall observatory (top {len(keep)} of "
+                    f"{len(by_proc)} processes)"))
+    for tid, key in enumerate(keep, start=1):
+        host, pid = key
+        ev.append(_meta(PID_SYSCALL, tid, "thread_name",
+                        f"h{host} pid{pid}"))
+        count = 0
+        for (t0, t1, _h, _p, rtid, sysno, _rc, disp, aux) in \
+                by_proc[key]:
+            count += aux if disp == SC_SHIM else 1
+            if sysno >= 0:
+                ev.append({"ph": "X", "pid": PID_SYSCALL, "tid": tid,
+                           "ts": t0 / 1e3,
+                           "dur": max((t1 - t0) / 1e3, 0.001),
+                           "name": syscall_name(sysno),
+                           "args": {"disposition": SC_NAMES[disp],
+                                    "tid": rtid}})
+            ev.append({"ph": "C", "pid": PID_SYSCALL, "tid": tid,
+                       "ts": t1 / 1e3,
+                       "name": f"h{host} pid{pid} syscalls",
+                       "args": {"count": count}})
+    return ev
+
+
 def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
-                 tel_bytes: bytes = b"") -> dict:
+                 tel_bytes: bytes = b"", sc_bytes: bytes = b"") -> dict:
     """Build the trace-event JSON object from the raw channel data.
 
     `sim_bytes` is flight-sim.bin's content; `wall` is the parsed
     flight-wall.json dict (or None); `tel_bytes` is
-    telemetry-sim.bin's content (per-connection counter tracks)."""
+    telemetry-sim.bin's content (per-connection counter tracks);
+    `sc_bytes` is syscalls-sim.bin's content (per-process syscall
+    slices + counter tracks)."""
     ev: list[dict] = [
         _meta(PID_SIM, 0, "process_name", "sim-time (simulated µs)"),
         _meta(PID_SIM, 1, "thread_name", "rounds & spans"),
@@ -125,6 +177,9 @@ def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
 
     if tel_bytes:
         ev.extend(netstat_events(tel_bytes))
+
+    if sc_bytes:
+        ev.extend(syscall_events(sc_bytes))
 
     if wall and wall.get("events"):
         ev.append(_meta(PID_WALL, 0, "process_name",

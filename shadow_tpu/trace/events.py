@@ -183,6 +183,77 @@ TEL_DTYPE = [("t", "<i8"), ("host", "<i4"), ("lport", "<u2"),
              ("rto", "<i8"), ("backoff", "<i8"), ("sndbuf", "<i8"),
              ("rcvbuf", "<i8"), ("rtx", "<i8"), ("sacks", "<i8")]
 
+# ---------------------------------------------------------------------
+# Syscall observatory (docs/OBSERVABILITY.md "syscall observatory"):
+# per-syscall disposition codes + the fixed per-syscall record of the
+# third sim-time channel (`syscalls-sim.bin`).  The SC_* enum's C twin
+# lives in native/shim.c — the shim side of the interposition stack,
+# which owns the SC_SHIM sequence counter (locally-answered time reads
+# counted into the IPC block without a round trip) — and is registered
+# fail-closed in analysis pass 1 exactly like FR_*/EL_*/TEL_*.  Every
+# Python-dispatched syscall (managed-process ABI dispatch AND internal-
+# app dispatch) is credited EXACTLY ONE code, so the disposition
+# counters cross-check against per-process strace line counts
+# (tools/trace `sys`).  Engine-resident apps dispatch C++-side and sit
+# outside this accounting (their counts merge into syscalls_by_name).
+SC_SERVICED = 0   # emulated by the simulated kernel (done / error)
+SC_PARKED = 1     # parked on a SyscallCondition (re-dispatched on wake)
+SC_NATIVE = 2     # natively injected (DO_NATIVE / exit short-circuits)
+SC_SHIM = 3       # answered shim-side (time family), no round trip
+SC_PROTO = 4      # IPC protocol error ended the conversation
+SC_N = 5
+
+# Order must mirror the SC_* values above (and the C enum in shim.c).
+SC_NAMES = (
+    "serviced",
+    "parked-on-condition",
+    "natively-injected",
+    "shim-handled",
+    "protocol-error",
+)
+assert len(SC_NAMES) == SC_N
+
+# Result classes (Python-side only, like FAM_*): what the dispatch
+# returned, orthogonal to HOW the call was routed.
+RC_OK = 0      # completed with a non-error value
+RC_ERR = 1     # completed with -errno
+RC_NATIVE = 2  # executed natively; the manager never saw the value
+RC_NONE = 3    # no result this dispatch (parked / protocol error)
+RC_NAMES = ("ok", "error", "native", "none")
+
+# Per-syscall record (SC_REC_BYTES, little-endian, no padding; the
+# size constant is twinned with SC_REC_BYTES in native/shim.c):
+#
+#     int64  t_enter   simulated ns at dispatch
+#     int64  t_exit    simulated ns when the response lands (equal to
+#                      t_enter unless CPU latency deferred the answer)
+#     int32  host      host id
+#     int32  pid       emulated pid
+#     int32  tid       emulated tid
+#     int32  sysno     x86-64 syscall number; -1 for SC_SHIM batches
+#                      (no single dispatch behind them)
+#     int16  rclass    RC_* result class
+#     int16  disp      SC_* disposition (exactly one per record)
+#     int32  aux       SC_SHIM: locally-answered call count drained
+#                      from the shim counter; 0 otherwise
+SC_REC_BYTES = 40
+SC_REC = struct.Struct("<qqiiiihhi")
+assert SC_REC.size == SC_REC_BYTES
+
+# numpy structured dtype for bulk decode (field order == SC_REC).
+SC_DTYPE = [("t_enter", "<i8"), ("t_exit", "<i8"), ("host", "<i4"),
+            ("pid", "<i4"), ("tid", "<i4"), ("sysno", "<i4"),
+            ("rclass", "<i2"), ("disp", "<i2"), ("aux", "<i4")]
+
+
+def iter_sc_records(buf: bytes):
+    """Yield (t_enter, t_exit, host, pid, tid, sysno, rclass, disp,
+    aux) tuples from a packed syscall-record stream."""
+    for off in range(0, len(buf) - len(buf) % SC_REC_BYTES,
+                     SC_REC_BYTES):
+        yield SC_REC.unpack_from(buf, off)
+
+
 REC = struct.Struct("<qiiqq")
 assert REC.size == FLIGHT_REC_BYTES
 

@@ -1,0 +1,280 @@
+"""Syscall observatory: per-syscall telemetry for managed processes.
+
+Third sim-time channel next to the flight recorder and sim-netstat
+(docs/OBSERVABILITY.md "syscall observatory"), plus the wall-time side
+that answers ROADMAP item 2's question — what does one syscall round
+trip (shim futex wake -> Python service -> resume) actually cost, and
+where does the wall go?
+
+**Sim-time channel** (`SyscallChannel`, `syscalls-sim.bin`): fixed
+40-byte records (trace/events.py SC_REC, size twinned with shim.c's
+SC_REC_BYTES) — one per managed-process syscall DISPATCH, stamped with
+sim entry/exit time, host/pid/tid, the raw syscall number, a result
+class and exactly one SC_* disposition.  Records buffer PER HOST: a
+host is single-threaded by construction, so its record order is its
+(scheduler-independent, deterministic) event execution order, and the
+written artifact is the host-id-ordered concatenation — byte-identical
+across runs AND across serial / thread_per_core / tpu schedulers.
+Like the other sim channels this code must never read wall clocks
+(analysis pass 3's sim-channel rule covers SyscallChannel and
+HostSyscallLog with no pragma escape).
+
+**Wall-time side** (`HostScWall` per host, merged by
+`SyscallObservatory`): every round trip's wall cost attributed to
+ipc-wait (blocked in the futex channel recv) vs dispatch (the
+simulated kernel) vs resume (strace/signals/response send), plus
+per-syscall-family totals and log-scale histograms for p50/p99.  The
+memory-manager copy component is reported from the MemoryManager
+aggregate counters (a subset of dispatch).  Everything lands in
+`metrics.wall.ipc.*`; the per-round managed-host delta feeds the
+flight recorder's `syscall-service` phase.
+
+The disposition COUNTERS (Host.sc_disp) are always on — integer adds,
+like drop attribution — and surface in `metrics.sim.syscalls.*`; this
+module's channels are the opt-in part
+(`experimental.syscall_observatory: off | wall | on`).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from shadow_tpu.trace.events import SC_REC, SC_REC_BYTES
+
+# Log-scale wall histogram: bucket i covers [256 << i, 256 << (i+1))
+# ns; bucket 0 also absorbs everything below 256 ns and the top bucket
+# everything above ~34 s.  28 integers per family — cheap enough to
+# keep per syscall name.
+N_BUCKETS = 28
+_BASE_SHIFT = 8  # 256 ns
+
+
+def bucket_of(ns: int) -> int:
+    b = max(int(ns), 1).bit_length() - 1 - _BASE_SHIFT
+    if b < 0:
+        return 0
+    return b if b < N_BUCKETS else N_BUCKETS - 1
+
+
+def percentile_ns(buckets, q: float) -> int:
+    """Approximate q-quantile (0..1) from a log-bucket histogram: the
+    geometric midpoint of the bucket holding the q-th sample."""
+    total = sum(buckets)
+    if not total:
+        return 0
+    want = q * total
+    seen = 0
+    for i, n in enumerate(buckets):
+        seen += n
+        if seen >= want:
+            lo = 1 << (_BASE_SHIFT + i)
+            return int(lo * 1.5)
+    return 1 << (_BASE_SHIFT + N_BUCKETS)
+
+
+class HostSyscallLog:
+    """One host's slice of the sim-time syscall channel.  Appended
+    only by the thread executing this host's events; capacity-capped
+    at a point that is a function of the record sequence alone, so a
+    capped stream is still deterministic."""
+
+    __slots__ = ("chunks", "records", "dropped", "_cap")
+
+    def __init__(self, cap: int):
+        self.chunks: list[bytes] = []
+        self.records = 0
+        self.dropped = 0
+        self._cap = cap
+
+    def rec(self, t_enter: int, t_exit: int, host: int, pid: int,
+            tid: int, sysno: int, rclass: int, disp: int,
+            aux: int = 0) -> None:
+        if self.records >= self._cap:
+            self.dropped += 1
+            return
+        self.chunks.append(SC_REC.pack(
+            int(t_enter), int(t_exit), host, pid, tid, sysno,
+            rclass, disp, aux))
+        self.records += 1
+
+
+class SyscallChannel:
+    """Deterministic per-syscall record stream (simulated time only).
+
+    Owns the per-host logs; `collect()` concatenates them in host-id
+    order — the canonical artifact order (per-host order is event
+    execution order, which the cross-scheduler parity contract already
+    pins)."""
+
+    FILE = "syscalls-sim.bin"
+
+    def __init__(self, cap_per_host: int = 1 << 20):
+        self._cap = cap_per_host
+        self._logs: list[HostSyscallLog] = []
+
+    def host_log(self) -> HostSyscallLog:
+        log = HostSyscallLog(self._cap)
+        self._logs.append(log)
+        return log
+
+    @property
+    def records(self) -> int:
+        return sum(log.records for log in self._logs)
+
+    @property
+    def dropped(self) -> int:
+        return sum(log.dropped for log in self._logs)
+
+    def to_bytes(self) -> bytes:
+        # _logs is appended in host-build order == host-id order.
+        return b"".join(b"".join(log.chunks) for log in self._logs)
+
+    def write(self, data_dir: str) -> None:
+        with open(os.path.join(data_dir, self.FILE), "wb") as f:
+            f.write(self.to_bytes())
+
+
+class HostScWall:
+    """Per-host wall-clock profile of the syscall seam.  Host-serial
+    (only the thread executing the host's events touches it); the
+    observatory merges across hosts at report time."""
+
+    __slots__ = ("families", "wait_ns", "dispatch_ns", "resume_ns",
+                 "trips", "app_dispatches", "app_dispatch_ns",
+                 "_active", "_registered")
+
+    def __init__(self, active_set: set):
+        self.families: dict[str, list] = {}  # name -> [count, ns, buckets]
+        self.wait_ns = 0
+        self.dispatch_ns = 0
+        self.resume_ns = 0
+        self.trips = 0
+        # Internal-app dispatches (no IPC legs) accounted apart so
+        # `ipc.round_trips`/`wait_ns` measure ONLY managed round trips
+        # — the number ROADMAP item 2's batching must amortize.
+        self.app_dispatches = 0
+        self.app_dispatch_ns = 0
+        self._active = active_set
+        self._registered = False
+
+    @staticmethod
+    def now() -> int:
+        return time.perf_counter_ns()  # shadow-lint: allow[wall-clock] syscall-observatory wall side
+
+    def trip(self, name: str, wait_ns: int, dispatch_ns: int,
+             resume_ns: int, ipc: bool = True) -> None:
+        if not self._registered:
+            self._registered = True
+            self._active.add(self)  # GIL-atomic; iterated between rounds
+        if ipc:
+            self.wait_ns += wait_ns
+            self.dispatch_ns += dispatch_ns
+            self.resume_ns += resume_ns
+            self.trips += 1
+        else:
+            self.app_dispatches += 1
+            self.app_dispatch_ns += dispatch_ns
+        fam = self.families.get(name)
+        if fam is None:
+            fam = self.families[name] = [0, 0, [0] * N_BUCKETS]
+        total = wait_ns + dispatch_ns + resume_ns
+        fam[0] += 1
+        fam[1] += total
+        fam[2][bucket_of(total)] += 1
+
+
+class SyscallObservatory:
+    """Bundle: mode, the opt-in channels, per-host wall profiles, and
+    the metrics/artifact writers the manager calls."""
+
+    def __init__(self, mode: str, hosts):
+        assert mode in ("wall", "on")
+        self.mode = mode
+        self.channel = SyscallChannel() if mode == "on" else None
+        self.active: set[HostScWall] = set()
+        for h in hosts:
+            h.sc_wall = HostScWall(self.active)
+            if self.channel is not None:
+                h.sc_log = self.channel.host_log()
+        # MemoryManager counters are process-global and cumulative
+        # (prior sims in the same interpreter included): snapshot the
+        # baseline so this run's copy cost reports as a delta.
+        self._mem_base = self._mem_totals()
+        self._round_snap = 0
+
+    @staticmethod
+    def _mem_totals() -> tuple:
+        from shadow_tpu.host.managed import MemoryManager as MM
+        return (MM.total_read_ns, MM.total_write_ns,
+                MM.total_read_bytes, MM.total_write_bytes, MM.total_calls)
+
+    def memcopy_delta(self) -> dict:
+        now = self._mem_totals()
+        base = self._mem_base
+        return {"read_ns": now[0] - base[0], "write_ns": now[1] - base[1],
+                "read_bytes": now[2] - base[2],
+                "write_bytes": now[3] - base[3],
+                "calls": now[4] - base[4]}
+
+    def round_phase_delta(self) -> int:
+        """Wall ns spent in the syscall seam since the last call —
+        the flight recorder's per-round `syscall-service` phase.
+        Called between rounds (host threads quiesced)."""
+        total = 0
+        for w in self.active:
+            total += (w.wait_ns + w.dispatch_ns + w.resume_ns
+                      + w.app_dispatch_ns)
+        delta = total - self._round_snap
+        self._round_snap = total
+        return delta
+
+    def merged_families(self) -> dict:
+        """name -> [count, total_ns, buckets] merged across hosts."""
+        out: dict[str, list] = {}
+        for w in self.active:
+            for name, (cnt, ns, buckets) in w.families.items():
+                slot = out.get(name)
+                if slot is None:
+                    out[name] = [cnt, ns, list(buckets)]
+                else:
+                    slot[0] += cnt
+                    slot[1] += ns
+                    for i, n in enumerate(buckets):
+                        slot[2][i] += n
+        return out
+
+    def wall_summary(self) -> dict:
+        """The `metrics.wall.ipc` block: phase totals, memcopy delta,
+        and per-family count/total/p50/p99."""
+        wait = dispatch = resume = trips = 0
+        app_n = app_ns = 0
+        for w in self.active:
+            wait += w.wait_ns
+            dispatch += w.dispatch_ns
+            resume += w.resume_ns
+            trips += w.trips
+            app_n += w.app_dispatches
+            app_ns += w.app_dispatch_ns
+        fams = {}
+        for name, (cnt, ns, buckets) in sorted(self.merged_families()
+                                               .items()):
+            fams[name] = {"count": cnt, "total_ns": ns,
+                          "p50_ns": percentile_ns(buckets, 0.50),
+                          "p99_ns": percentile_ns(buckets, 0.99)}
+        return {"round_trips": trips, "wait_ns": wait,
+                "dispatch_ns": dispatch, "resume_ns": resume,
+                "app_dispatches": app_n, "app_dispatch_ns": app_ns,
+                "memcopy": self.memcopy_delta(), "families": fams}
+
+    def ingest_metrics(self, reg) -> None:
+        reg.ingest("ipc", self.wall_summary(), channel="wall")
+        if self.channel is not None:
+            reg.gauge("syscalls.records", channel="sim").set(
+                self.channel.records)
+            reg.gauge("syscalls.dropped", channel="sim").set(
+                self.channel.dropped)
+
+    def write(self, data_dir: str) -> None:
+        if self.channel is not None:
+            self.channel.write(data_dir)

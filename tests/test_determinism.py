@@ -138,6 +138,58 @@ def test_netstat_identical_across_schedulers(tmp_path):
             f"telemetry-sim.bin diverged on {label}"
 
 
+def test_syscall_channel_identical_across_schedulers(tmp_path):
+    """Syscall observatory (ISSUE 7): records are keyed by sim time,
+    process identity and the host-serial dispatch order — all
+    scheduler-independent — so syscalls-sim.bin must be byte-identical
+    across the serial object path, the threaded object path and the
+    tpu scheduler on a managed (real-binary) workload.  This is the
+    managed-gate leg of the cross-scheduler parity claim."""
+    import shutil
+    import subprocess
+    if shutil.which("cc") is None:
+        pytest.skip("no C toolchain for the shim")
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+
+    exe = str(tmp_path / "sleep_time")
+    subprocess.run(
+        ["cc", "-O1", "-o", exe,
+         os.path.join(REPO_ROOT, "tests", "plugins", "sleep_time.c")],
+        check=True)
+
+    def run(name, scheduler):
+        cfg = ConfigOptions.from_dict({
+            "general": {"stop_time": "6s", "seed": 9,
+                        "data_directory": str(tmp_path / name)},
+            "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "10 ms" ] ]"""}},
+            "experimental": {"scheduler": scheduler,
+                             "strace_logging_mode": "deterministic",
+                             "syscall_observatory": "on"},
+            "hosts": {
+                "ha": {"network_node_id": 0, "processes": [
+                    {"path": exe, "start_time": "1s"}]},
+                "hb": {"network_node_id": 0, "processes": [
+                    {"path": exe, "start_time": "2s"}]},
+            }})
+        cfg.general.parallelism = 2
+        _m, s = run_simulation(cfg, write_data=True)
+        assert s.ok, s.plugin_errors[:3]
+        return (tmp_path / name / "syscalls-sim.bin").read_bytes()
+
+    blobs = {
+        "serial": run("sc-ser", "serial"),
+        "thread_per_core": run("sc-thr", "thread_per_core"),
+        "tpu": run("sc-tpu", "tpu"),
+    }
+    assert blobs["serial"], "no syscall records recorded"
+    for label in ("thread_per_core", "tpu"):
+        assert blobs[label] == blobs["serial"], \
+            f"syscalls-sim.bin diverged on {label}"
+
+
 def test_parallel_and_tpu_schedulers_byte_identical(tmp_path):
     base = collect(run_sim(tmp_path, "base", "serial"))
     threads = collect(run_sim(tmp_path, "thr", "thread_per_core",

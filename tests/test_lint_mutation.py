@@ -24,6 +24,12 @@ def cpp_text():
         return fh.read()
 
 
+@pytest.fixture(scope="module")
+def shim_text():
+    with open(os.path.join(ROOT, "native", "shim.c")) as fh:
+        return fh.read()
+
+
 def _mutate(text: str, old: str, new: str) -> str:
     assert text.count(old) == 1, f"mutation anchor not unique: {old!r}"
     return text.replace(old, new)
@@ -195,3 +201,59 @@ def test_trace_reason_table_reorder_is_caught(cpp_text):
     v = twin_constants.check(ROOT, cpp_text=mutated)
     assert any("EL_NAMES" in x.message for x in v), \
         [x.render() for x in v]
+
+
+def test_sc_enum_drift_is_caught(shim_text):
+    """Syscall-observatory disposition drift (ISSUE 7): swapping two
+    SC_* members in the shim shifts their values — every trace/events
+    twin must flag."""
+    mutated = _mutate(shim_text, "SC_PARKED = 1,", "SC_PARKED = 2,")
+    mutated = _mutate(mutated, "SC_NATIVE = 2,", "SC_NATIVE = 1,")
+    v = twin_constants.check(ROOT, shim_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("SC_PARKED" in m for m in msgs), msgs
+    assert any("SC_NATIVE" in m for m in msgs), msgs
+
+
+def test_sc_record_size_drift_is_caught(shim_text):
+    """A resized syscall record would desynchronize syscalls-sim.bin
+    from trace/events.py SC_REC — the size pin must flag."""
+    mutated = _mutate(shim_text, "SC_REC_BYTES = 40,",
+                      "SC_REC_BYTES = 48,")
+    v = twin_constants.check(ROOT, shim_text=mutated)
+    assert any("SC_REC_BYTES" in x.message and "48" in x.message
+               for x in v), [x.render() for x in v]
+
+
+def test_sc_ipc_layout_drift_is_caught(shim_text):
+    """Moving the shim's sc_local counter without updating the
+    manager's mmap offset (shim_abi.CHAN_SC_LOCAL) would silently
+    read garbage — the layout twin must flag.  (In a real build the
+    _Static_assert catches the struct side too.)"""
+    mutated = _mutate(shim_text, "SC_CHAN_LOCAL_OFF = 280,",
+                      "SC_CHAN_LOCAL_OFF = 288,")
+    v = twin_constants.check(ROOT, shim_text=mutated)
+    assert any("SC_CHAN_LOCAL_OFF" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_unregistered_sc_constant_fails_closed(shim_text):
+    """A new SC_* member added shim-side without a contract row (and
+    a trace/events.py twin) must fail the pass."""
+    mutated = _mutate(shim_text, "SC_N = 5,",
+                      "SC_N = 5,\n    SC_ROGUE = 99,")
+    v = twin_constants.check(ROOT, shim_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("SC_ROGUE" in m and "no contract row" in m
+               for m in msgs), msgs
+
+
+def test_sc_constant_removal_is_caught(shim_text):
+    """Renaming an SC_* member away breaks the contract row — the
+    extractor-miss direction must also fail."""
+    mutated = _mutate(shim_text, "SC_SHIM = 3,", "SC_SHIMX = 3,")
+    v = twin_constants.check(ROOT, shim_text=mutated)
+    msgs = [x.message for x in v]
+    assert any(m.startswith("C++ constant SC_SHIM") for m in msgs), msgs
+    assert any("SC_SHIMX" in m and "no contract row" in m
+               for m in msgs), msgs

@@ -1,0 +1,266 @@
+"""Tier-1 gates for the syscall observatory (ISSUE 7).
+
+- SC record round-trip (Python SC_REC layout self-consistency; the
+  shim-side twins are checked by analysis pass 1 + the shim's own
+  _Static_assert),
+- two-run byte-identity of syscalls-sim.bin under
+  strace_logging_mode: deterministic,
+- disposition conservation on a fork/exec + signals workload
+  (reusing tests/plugins/): every dispatch record carries exactly one
+  in-range SC_* code and per-process dispatch-record counts equal
+  strace line counts,
+- the shim-handled (SC_SHIM) sequence counter actually drains,
+- CLI smoke (`trace sys` renders and returns ok),
+- observatory off leaves no artifacts and no wall metrics.
+
+The cross-scheduler byte-identity leg lives in
+tests/test_determinism.py (test_syscall_channel_identical_across_
+schedulers).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+from shadow_tpu.trace import events as trev
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+needs_cc = pytest.mark.skipif(shutil.which("cc") is None,
+                              reason="no C toolchain for the shim")
+
+
+@pytest.fixture(scope="module")
+def binaries(tmp_path_factory):
+    if shutil.which("cc") is None:
+        pytest.skip("no C toolchain for the shim")
+    out_dir = tmp_path_factory.mktemp("sc-plugins")
+    paths = {}
+    for name in ("fork_exec", "signal_self", "sleep_time"):
+        src = os.path.join(PLUGIN_DIR, name + ".c")
+        out = os.path.join(out_dir, name)
+        subprocess.run(["cc", "-O1", "-o", out, src], check=True)
+        paths[name] = out
+    return paths
+
+
+def observatory_cfg(binaries, data_dir, observatory="on",
+                    scheduler="thread_per_core", strace="deterministic",
+                    seed=5):
+    """fork/exec + signals + time-polling workload: three real C
+    binaries on two hosts (fork_exec exercises fork/execve/waitpid,
+    signal_self exercises handler delivery + EINTR'd nanosleep,
+    sleep_time exercises parked nanosleep + shim-handled time reads)."""
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": "8s", "seed": seed,
+                    "data_directory": str(data_dir)},
+        "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "10 ms" ] ]"""}},
+        "experimental": {"scheduler": scheduler,
+                         "strace_logging_mode": strace,
+                         "syscall_observatory": observatory},
+        "hosts": {
+            "ha": {"network_node_id": 0, "processes": [
+                {"path": binaries["fork_exec"], "start_time": "1s",
+                 "expected_final_state": "exited 0"},
+                {"path": binaries["sleep_time"], "start_time": "1s",
+                 "expected_final_state": "exited 0"}]},
+            "hb": {"network_node_id": 0, "processes": [
+                {"path": binaries["signal_self"], "start_time": "1s",
+                 "expected_final_state": "exited 0"}]},
+        }})
+
+
+def test_sc_record_pack_roundtrip():
+    recs = [(1_000_000_000, 1_000_020_000, 3, 1000, 1001, 35,
+             trev.RC_OK, trev.SC_SERVICED, 0),
+            (2**60, 2**60, 0, 1000, 1000, -1, trev.RC_OK,
+             trev.SC_SHIM, 17)]
+    buf = b"".join(trev.SC_REC.pack(*r) for r in recs)
+    assert len(buf) == 2 * trev.SC_REC_BYTES
+    assert list(trev.iter_sc_records(buf)) == recs
+    assert len(trev.SC_NAMES) == trev.SC_N
+
+
+@needs_cc
+def test_two_run_byte_identity_and_conservation(binaries, tmp_path):
+    datas = []
+    managers = []
+    for name in ("run1", "run2"):
+        m, s = run_simulation(
+            observatory_cfg(binaries, tmp_path / name),
+            write_data=True)
+        assert s.ok, s.plugin_errors[:3]
+        managers.append(m)
+        with open(tmp_path / name / "syscalls-sim.bin", "rb") as f:
+            datas.append(f.read())
+    assert datas[0], "syscall channel recorded nothing"
+    assert datas[0] == datas[1], "syscalls-sim.bin diverged"
+
+    # Disposition conservation: every record's code in range, exactly
+    # one per record by construction; the always-on counters agree
+    # with the channel's dispatch + shim-batch content.
+    recs = list(trev.iter_sc_records(datas[0]))
+    by_disp = {}
+    per_proc = {}
+    shim_from_recs = 0
+    for (t0, t1, host, pid, _tid, sysno, rc, disp, aux) in recs:
+        assert 0 <= disp < trev.SC_N
+        assert 0 <= rc < len(trev.RC_NAMES)
+        assert t1 >= t0
+        by_disp[disp] = by_disp.get(disp, 0) + 1
+        if disp == trev.SC_SHIM:
+            assert sysno == -1 and aux > 0
+            shim_from_recs += aux
+        if sysno >= 0:
+            per_proc[(host, pid)] = per_proc.get((host, pid), 0) + 1
+    totals = managers[0].sc_disposition_totals()
+    assert totals.get("shim-handled", 0) == shim_from_recs
+    assert shim_from_recs > 0, "no shim-handled time reads counted"
+    # Exactly one disposition per dispatch: the non-shim disposition
+    # sum equals the syscalls counter (count_syscall fires once per
+    # dispatch on both Python seams; SC_SHIM calls never reach it).
+    s = managers[0]
+    assert sum(totals.values()) - shim_from_recs == sum(
+        h.counters["syscalls"] for h in s.hosts)
+    # fork_exec parks in waitpid, sleep_time in nanosleep
+    assert by_disp.get(trev.SC_PARKED, 0) > 0
+    assert by_disp.get(trev.SC_SERVICED, 0) > 0
+    assert by_disp.get(trev.SC_NATIVE, 0) > 0
+    assert trev.SC_PROTO not in by_disp
+
+    # Strace cross-check: one strace line per dispatch record.
+    names = sorted(("ha", "hb"))
+    for (host_id, pid), n in sorted(per_proc.items()):
+        hdir = tmp_path / "run1" / "hosts" / names[host_id]
+        match = [f for f in os.listdir(hdir)
+                 if f.endswith(f".{pid}.strace")]
+        assert match, (host_id, pid, os.listdir(hdir))
+        lines = (hdir / match[0]).read_bytes().count(b"\n")
+        assert lines == n, (match[0], lines, n)
+
+    # sim-stats carries the channel gauges + dispositions in the SIM
+    # (byte-diffed) metrics channel.
+    stats = json.loads((tmp_path / "run1" / "sim-stats.json")
+                       .read_text())
+    sc = stats["metrics"]["sim"]["syscalls"]
+    assert sc["records"] == len(recs)
+    assert sc["dispositions"] == totals
+    # wall-side IPC profile exists and covers every dispatch
+    ipc = stats["metrics"]["wall"]["ipc"]
+    assert ipc["round_trips"] >= sum(
+        n for (h, p), n in per_proc.items())
+    assert ipc["wait_ns"] > 0 and ipc["dispatch_ns"] > 0
+    assert ipc["families"], "no per-family wall histograms"
+    fam = next(iter(ipc["families"].values()))
+    assert fam["p99_ns"] >= fam["p50_ns"] > 0
+
+
+@needs_cc
+def test_trace_sys_cli(binaries, tmp_path, capsys):
+    from shadow_tpu.tools import trace as trace_cli
+
+    m, s = run_simulation(observatory_cfg(binaries, tmp_path / "cli"),
+                          write_data=True)
+    assert s.ok, s.plugin_errors[:3]
+    rc = trace_cli.main(["sys", str(tmp_path / "cli")])
+    printed = capsys.readouterr().out
+    assert rc == 0, printed
+    assert "syscall observatory" in printed
+    assert "top" in printed and "by count" in printed
+    assert "all consistent" in printed
+    assert "ipc round trips" in printed
+    # a seeded corruption must flip the verdict: truncate one record
+    # so a process's dispatch count no longer matches its strace
+    bin_path = tmp_path / "cli" / "syscalls-sim.bin"
+    buf = bin_path.read_bytes()
+    bin_path.write_bytes(buf[:-trev.SC_REC_BYTES])
+    rc = trace_cli.main(["sys", str(tmp_path / "cli")])
+    capsys.readouterr()
+    assert rc == 1
+
+
+@needs_cc
+def test_chrome_export_has_syscall_tracks(binaries, tmp_path):
+    from shadow_tpu.trace.chrome import PID_SYSCALL, chrome_trace
+
+    m, s = run_simulation(observatory_cfg(binaries, tmp_path / "ch"),
+                          write_data=True)
+    assert s.ok
+    sc_bytes = (tmp_path / "ch" / "syscalls-sim.bin").read_bytes()
+    doc = json.loads(json.dumps(chrome_trace(b"", None, b"", sc_bytes)))
+    ev = doc["traceEvents"]
+    slices = [e for e in ev if e.get("ph") == "X"
+              and e.get("pid") == PID_SYSCALL]
+    counters = [e for e in ev if e.get("ph") == "C"
+                and e.get("pid") == PID_SYSCALL]
+    assert slices and counters
+    assert all("disposition" in e["args"] for e in slices)
+    # one thread track per (host, pid): fork_exec's children appear
+    tids = {e["tid"] for e in slices}
+    assert len(tids) >= 3, tids
+    # counter is cumulative per process (non-decreasing per tid)
+    by_tid = {}
+    for e in counters:
+        prev = by_tid.get(e["tid"], 0)
+        assert e["args"]["count"] >= prev
+        by_tid[e["tid"]] = e["args"]["count"]
+
+
+@needs_cc
+def test_observatory_off_leaves_no_artifacts(binaries, tmp_path):
+    m, s = run_simulation(
+        observatory_cfg(binaries, tmp_path / "off", observatory="off"),
+        write_data=True)
+    assert s.ok, s.plugin_errors[:3]
+    assert not (tmp_path / "off" / "syscalls-sim.bin").exists()
+    stats = json.loads((tmp_path / "off" / "sim-stats.json")
+                       .read_text())
+    # no wall-side IPC block, no record gauges ...
+    assert "ipc" not in stats["metrics"]["wall"]
+    assert "records" not in stats["metrics"]["sim"].get("syscalls", {})
+    # ... but the always-on disposition counters are present and
+    # identical to what the recording run counts.
+    disp = stats["metrics"]["sim"]["syscalls"]["dispositions"]
+    assert disp.get("serviced", 0) > 0
+    assert disp.get("shim-handled", 0) > 0
+    assert m.sc_disposition_totals() == disp
+
+
+@needs_cc
+def test_internal_apps_count_dispositions(tmp_path):
+    """The internal-app dispatch seam (host/syscalls.py) credits the
+    same always-on counters: a pure-Python tgen pair counts serviced
+    + parked dispatches with no managed process anywhere."""
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "3s", "seed": 4,
+                    "data_directory": str(tmp_path / "int")},
+        "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "10 ms" ] ]"""}},
+        "experimental": {"scheduler": "serial"},
+        "hosts": {
+            "srv": {"network_node_id": 0, "processes": [
+                {"path": "tgen-server", "args": ["80"],
+                 "expected_final_state": "running"}]},
+            "cli": {"network_node_id": 0, "processes": [
+                {"path": "tgen-client",
+                 "args": ["srv", "80", "20000", "1"],
+                 "start_time": "100ms"}]},
+        }})
+    m, s = run_simulation(cfg, write_data=True)
+    assert s.ok
+    totals = m.sc_disposition_totals()
+    assert totals.get("serviced", 0) > 0
+    assert totals.get("parked-on-condition", 0) > 0
+    assert "shim-handled" not in totals
+    # dispatch-count identity: dispositions over the Python seams sum
+    # to the syscalls counter (every count_syscall'd dispatch credits
+    # exactly one code on this all-internal workload)
+    assert sum(totals.values()) == s.syscalls

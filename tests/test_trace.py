@@ -218,7 +218,10 @@ def test_flight_off_leaves_no_artifacts(tmp_path):
                        .read_text())
     elig = stats["metrics"]["wall"]["eligibility"]
     assert sum(elig.values()) == stats["rounds"]
-    assert stats["metrics"]["sim"] == {}
+    # no flight gauges with the recorder off (the always-on counter
+    # families — netstat drops, syscall dispositions — may appear in
+    # metrics.sim depending on the workload's execution path)
+    assert "flight" not in stats["metrics"]["sim"]
 
 
 def test_trace_cli_summarize_and_chrome(tmp_path, capsys):
