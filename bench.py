@@ -220,6 +220,11 @@ def run_once(build, scheduler: str, report_routes: str | None = None,
     tcp = net.get("tcp") or {}
     segs = tcp.get("segments_sent", 0)
     rtx_rate = (tcp.get("retransmits", 0) / segs) if segs else 0.0
+    # Fabric observatory (ISSUE 8): the conservation counters are
+    # always on, so every rung carries its `fabric` block (peak queue
+    # depth, hottest-link utilization, FCT percentiles where TCP
+    # flows exist) without paying for the sample channel.
+    fabric = manager.fabric_summary(summary.busy_end_ns)
     LAST_RUN.clear()
     LAST_RUN.update({
         "scheduler": scheduler,
@@ -227,6 +232,7 @@ def run_once(build, scheduler: str, report_routes: str | None = None,
         "eligibility": manager.audit.as_dict(),
         "drops": net["drops"],
         "retransmit_rate": round(rtx_rate, 6),
+        "fabric": fabric,
     })
     if report_routes is not None:
         print(f"bench[{report_routes}]: {route_split(manager)}",
@@ -236,6 +242,16 @@ def run_once(build, scheduler: str, report_routes: str | None = None,
         print(f"drops: {drops_s} | retransmit rate "
               f"{100.0 * rtx_rate:.3f}% "
               f"({tcp.get('retransmits', 0)}/{segs} segments)",
+              file=sys.stderr)
+        fct = fabric.get("fct", {})
+        fct_s = (f" | fct p50 {fct['p50_ns'] / 1e6:.1f}ms p99 "
+                 f"{fct['p99_ns'] / 1e6:.1f}ms p999 "
+                 f"{fct['p999_ns'] / 1e6:.1f}ms ({fct['flows']} flows)"
+                 if fct else "")
+        print(f"fabric: peak queue {fabric['peak_queue_depth']}, "
+              f"link util {100.0 * fabric['link_utilization']:.1f}%, "
+              f"refill stalls {fabric['refill_stalls']}, "
+              f"conservation {fabric['conservation']}{fct_s}",
               file=sys.stderr)
     if devcap and manager.plane is not None:
         rt, rf, steps, ok = manager.plane.engine.devcap_counters()
@@ -627,6 +643,43 @@ def managed_rung() -> dict | None:
         }
 
 
+def incast_rung() -> dict | None:
+    """N->1 fan-in smoke (netgen.incast_yaml; ISSUE 8): queue buildup
+    at the sink's inbound CoDel queue with the byte-conservation gate
+    enforced, recorded in the headline JSON with peak queue depth and
+    the FCT percentiles.  Engine path, seconds of wall — safe ahead
+    of the headline print."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import Manager
+    from shadow_tpu.tools.netgen import incast_yaml
+
+    cfg = ConfigOptions.from_yaml_text(
+        incast_yaml(32, scheduler="tpu"))
+    cfg.experimental.flight_recorder = "wall"
+    manager = Manager(cfg)
+    for h in manager.hosts:
+        h.set_tracing(False)
+    t0 = time.perf_counter()
+    summary = manager.run()
+    wall = time.perf_counter() - t0
+    assert summary.ok, summary.plugin_errors[:3]
+    fabric = manager.fabric_summary(summary.busy_end_ns)
+    if fabric["conservation"] != "ok":
+        raise AssertionError(
+            f"incast byte conservation violated: "
+            f"{fabric['conservation']}")
+    fct = fabric.get("fct", {})
+    print(f"bench[incast-32]: {summary.packets_sent} packets in "
+          f"{wall:.1f}s wall, peak queue "
+          f"{fabric['peak_queue_depth']}, "
+          f"fct p50/p99/p999 {fct.get('p50_ns', 0) / 1e6:.0f}/"
+          f"{fct.get('p99_ns', 0) / 1e6:.0f}/"
+          f"{fct.get('p999_ns', 0) / 1e6:.0f} ms, conservation ok",
+          file=sys.stderr)
+    return {"fan_in": 32, "wall_s": round(wall, 3),
+            "packets": summary.packets_sent, "fabric": fabric}
+
+
 def scale_100k_rung() -> dict | None:
     """Standing >=100k-host scale rung (engine path): 100k PHOLD LPs
     with ring peer lists stepped through C++ multi-round spans — the
@@ -868,6 +921,14 @@ def main() -> None:
         print(f"bench[scale-100k]: failed: {e}", file=sys.stderr)
         scale_100k = None
 
+    # Incast fan-in smoke with the fabric conservation gate (ISSUE 8),
+    # recorded in the headline JSON (engine path, no tunnel risk).
+    try:
+        incast = incast_rung()
+    except Exception as e:  # noqa: BLE001 — never cost the headline
+        print(f"bench[incast-32]: failed: {e}", file=sys.stderr)
+        incast = None
+
     # Managed-process emulator rung (real binaries under the shim) —
     # recorded in the headline JSON with syscalls_per_sec, the SC_*
     # disposition histogram and the IPC wall breakdown (ISSUE 7 /
@@ -937,6 +998,12 @@ def main() -> None:
         # to packets_dropped) and the TCP retransmit-rate figure.
         "drops": tpu_obs.get("drops", {}),
         "retransmit_rate": tpu_obs.get("retransmit_rate", 0.0),
+        # Fabric observatory (ISSUE 8): peak queue depth, hottest-link
+        # utilization, refill stalls and FCT percentiles of the last
+        # recorded tpu trial (always-on counters), plus the incast
+        # fan-in rung with its conservation gate.
+        "fabric": tpu_obs.get("fabric", {}),
+        "incast": incast,
     }), flush=True)
 
     # Auxiliary rungs (stderr only).  A failure must not cost the

@@ -267,6 +267,49 @@ struct TelRec {
 static_assert(sizeof(TelRec) == TEL_REC_BYTES,
               "telemetry record layout drifted from trace/events.py");
 
+/* Fabric observatory (trace/events.py + trace/fabricstat.py are the
+ * Python twins; analysis pass 1 registers every FB_ / FCT_ constant
+ * fail-closed).  FB_ACT_* is the activity mask: a host's queues are
+ * sampled in a round iff any bit is set — a pure function of
+ * simulation state, so the sampled set is path-independent. */
+constexpr int FB_ACT_CODEL = 1;   /* router inbound CoDel non-empty */
+constexpr int FB_ACT_TB_OUT = 2;  /* inet-out relay parked on refill */
+constexpr int FB_ACT_TB_IN = 4;   /* inet-in relay parked on refill */
+constexpr int FB_ACT_LINK = 8;    /* eth link ever forwarded */
+
+/* Per-queue sample record; layout twinned byte-for-byte with
+ * trace/events.py FB_REC ("<qii14q"). */
+constexpr int FB_REC_BYTES = 128;
+struct FabRec {
+  int64_t t;        // simulated ns (sampled round's window end)
+  int32_t host;
+  int32_t flags;    // FB_ACT_* mask (why this host sampled)
+  int64_t qdepth, qbytes, sojourn, qenq, qdrops, qmarks;
+  int64_t r1_bal, r1_stalls, r2_bal, r2_stalls;
+  int64_t psent, bsent, precv, brecv;
+};
+static_assert(sizeof(FabRec) == FB_REC_BYTES,
+              "fabric record layout drifted from trace/events.py");
+
+/* Flow-lifecycle flags + record (trace/events.py FCT_F_* / FCT_REC
+ * twins).  HostPlane::fct_log holds these for connections torn down
+ * before the artifact is written; the manager merges them with the
+ * still-associated sweep and sorts globally, so emission order can
+ * never reach the bytes. */
+constexpr int FCT_F_COMPLETE = 1; /* conn reached CLOSED */
+constexpr int FCT_F_RECEIVER = 2; /* received more than it sent */
+constexpr int FCT_REC_BYTES = 56;
+struct FctRec {
+  int64_t t_first, t_last;  // first/last data byte (-1: none)
+  int32_t host;
+  uint16_t lport, rport;
+  uint32_t rip;
+  int32_t flags;            // FCT_F_* bits
+  int64_t bytes_in, bytes_out, rtx;
+};
+static_assert(sizeof(FctRec) == FCT_REC_BYTES,
+              "flow record layout drifted from trace/events.py");
+
 /* engine -> Python callback kinds */
 constexpr int CB_STATUS = 0;       // (tok, set_mask, clear_mask)
 constexpr int CB_CHILD_BORN = 1;   // (listener_tok, child_tok)
@@ -575,6 +618,19 @@ struct TcpConn {
    * connection.py twins).  tcp_push_in folds the per-call delta into
    * the host's drop-cause counters — the conn has no host backref. */
   int64_t reasm_discards = 0, rcvwin_trunc = 0;
+  /* Fabric-observatory flow lifecycle (connection.py fct_* twins):
+   * first/last ns any payload byte was FIRST-sent or delivered in
+   * order on this endpoint, plus the byte counts.  Retransmissions
+   * touch neither — fct_bytes_out is the flow size. */
+  int64_t fct_first = -1, fct_last = -1;
+  int64_t fct_bytes_in = 0, fct_bytes_out = 0;
+
+  void fct_touch(int64_t nbytes, int64_t now, bool inbound) {
+    if (fct_first < 0) fct_first = now;
+    fct_last = now;
+    if (inbound) fct_bytes_in += nbytes;
+    else fct_bytes_out += nbytes;
+  }
 
   TcpConn(uint32_t iss_, int64_t recv_max, int64_t send_max,
           int64_t window_ceiling /* -1 = use recv_max */)
@@ -700,6 +756,7 @@ struct TcpConn {
     std::string chunk = send_buf.take(1);
     emit(F_ACK | F_PSH, snd_nxt, chunk, now, /*track=*/true);
     snd_nxt = seq_add(snd_nxt, 1);
+    fct_touch(1, now, /*inbound=*/false);
     persist_interval = std::min(
         persist_interval > 0 ? persist_interval * 2 : rto, MAX_RTO_NS);
     persist_deadline = now + persist_interval;
@@ -1044,6 +1101,7 @@ struct TcpConn {
       return;
     }
     bool had_holes = !reassembly.empty();
+    uint32_t rcv0 = rcv_nxt;
     deliver(*payload);
     for (auto it = reassembly.find(rcv_nxt); it != reassembly.end();
          it = reassembly.find(rcv_nxt)) {
@@ -1051,6 +1109,11 @@ struct TcpConn {
       reassembly.erase(it);
       deliver(chunk);
     }
+    /* Fabric-observatory flow lifecycle: the rcv_nxt advance IS the
+     * in-order delivered byte count (before the FIN consumes its
+     * sequence slot below) — connection.py _on_data twin. */
+    int64_t fct_delivered = seq_sub(rcv_nxt, rcv0);
+    if (fct_delivered > 0) fct_touch(fct_delivered, now, /*inbound=*/true);
     if (pending_fin_seq >= 0 && (uint32_t)pending_fin_seq == rcv_nxt)
       process_fin(now);
     ack_data(now, had_holes);
@@ -1125,6 +1188,7 @@ struct TcpConn {
       int64_t n = (int64_t)chunk.size();
       emit(F_ACK | F_PSH, snd_nxt, chunk, now, /*track=*/true);
       snd_nxt = seq_add(snd_nxt, n);
+      fct_touch(n, now, /*inbound=*/false);
     }
     if (snd_wnd == 0 && send_buf.len > 0 && rtx.empty() &&
         persist_deadline < 0 &&
@@ -1261,6 +1325,15 @@ struct TokenBucketN {
     *when = next_refill;
     return false;
   }
+  /* Read-only balance at `now` (token_bucket.py peek_balance twin):
+   * the fabric observatory samples through this — sampling a virgin
+   * bucket must not anchor its refill clock (the sim must be
+   * byte-identical with the channel on or off). */
+  int64_t peek_balance(int64_t now) const {
+    if (next_refill == 0 || now < next_refill) return balance;
+    int64_t k = 1 + (now - next_refill) / refill_interval;
+    return std::min(capacity, balance + k * refill_size);
+  }
 };
 
 /* ---------------- CoDel (net/codel.py) ---------------------------- */
@@ -1275,15 +1348,29 @@ struct CoDelN {
   int64_t count = 0, last_count = 0;
   int64_t first_above = 0, drop_next = 0;
   int64_t dropped_count = 0;
+  /* Fabric-observatory counters (net/codel.py twins; conservation:
+   * enqueued == forwarded + dropped + still-queued, packets AND
+   * bytes).  `enqueued` counts push ATTEMPTS — hard-limit refusals
+   * included, with the refusal on the dropped side.  `marked` is the
+   * ECN-ready slot: 0 on every path until DCTCP lands. */
+  int64_t enq_pkts = 0, enq_bytes = 0, drop_bytes = 0, peak_depth = 0,
+          marked = 0;
 
   static int64_t control_time(int64_t t, int64_t count) {
     return t + ((CODEL_INTERVAL_NS << 16) / isqrt64(count << 32));
   }
   /* push returns false only at the hard limit (caller drops+traces) */
   bool push(uint64_t id, int64_t size, int64_t now) {
-    if (q.size() >= CODEL_HARD_LIMIT) { dropped_count++; return false; }
+    enq_pkts++;
+    enq_bytes += size;
+    if (q.size() >= CODEL_HARD_LIMIT) {
+      dropped_count++;
+      drop_bytes += size;
+      return false;
+    }
     q.emplace_back(id, now);
     bytes += size;
+    if ((int64_t)q.size() > peak_depth) peak_depth = (int64_t)q.size();
     return true;
   }
   /* dequeue_raw: returns pkt id or UINT64_MAX; *ok = drop-state flag */
@@ -1426,6 +1513,14 @@ struct RelayN {
   uint64_t pending = UINT64_MAX;  // parked packet id
   TokenBucketN bucket;            // unlimited for loopback
   int src;                        // 0: lo iface, 1: eth iface, 2: router
+  /* Fabric-observatory counters (net/relay.py twins): packets
+   * parked waiting for a bucket refill, and packets/bytes actually
+   * forwarded to the destination device.  The inet-in relay's
+   * forwarded counters are the CoDel queue's "delivered" side of the
+   * byte-conservation invariant (eth packets_received also counts
+   * self-addressed traffic that never crossed the router queue). */
+  int64_t stalls = 0;
+  int64_t fwd_pkts = 0, fwd_bytes = 0;
 };
 
 /* ---------------- per-host plane ---------------------------------- */
@@ -1511,6 +1606,11 @@ struct HostPlane {
    * with no tel_cause_of mapping; the conservation gate rejects it. */
   int64_t drop_causes[TEL_N] = {0};
   int64_t drop_unattributed = 0;
+  /* Fabric-observatory flow lifecycle (Host.fct_log twin): FctRec
+   * rows of connections torn down before the artifact was written.
+   * Host-serial appends (teardown runs inside this host's events), so
+   * run_hosts_mt needs no lock here. */
+  std::vector<FctRec> fct_log;
 
   void tpush(TimerEnt e) {
     theap.push_back(e);
@@ -1709,6 +1809,47 @@ struct Engine {
    * order.  CLOSED conns are dead and LISTEN conns carry no transfer
    * state; everything else samples. */
   void tel_sample_round(int64_t start, int64_t window_end);
+
+  /* Fabric-observatory ring (set_fabric / fabric_take): fixed FabRec
+   * records sampling every ACTIVE host queue at conservative-round
+   * boundaries.  run_span fills it per round; the per-round path
+   * samples through eng_fabric_sample.  Same contract as the tel
+   * ring: no state_epoch bump (observation, never mutation), and the
+   * Python-side channel cap is the single truncation point. */
+  std::vector<FabRec> fab_ring;
+  size_t fab_head = 0, fab_len = 0;
+  uint64_t fab_dropped = 0;
+  bool fab_on = false;
+  int64_t fab_interval = 1;
+
+  void fab_push(const FabRec &r) {
+    if (fab_ring.empty()) return;
+    size_t cap = fab_ring.size();
+    if (fab_len == cap) {
+      fab_ring[fab_head] = r;
+      fab_head = (fab_head + 1) % cap;
+      fab_dropped++;
+      return;
+    }
+    fab_ring[(fab_head + fab_len) % cap] = r;
+    fab_len++;
+  }
+
+  void fab_reserve(size_t extra) {
+    size_t need = fab_len + extra;
+    if (need <= fab_ring.size()) return;
+    std::vector<FabRec> lin(need * 2);
+    for (size_t i = 0; i < fab_len; i++)
+      lin[i] = fab_ring[(fab_head + i) % fab_ring.size()];
+    fab_ring = std::move(lin);
+    fab_head = 0;
+  }
+
+  /* One sampled round: the same stateless grid-crossing rule as
+   * tel_sample_round (trace/fabricstat.py `sampled` and the device
+   * kernels' round_body guards are the twins), then every ACTIVE
+   * plane host in ascending host-id order. */
+  void fab_sample_round(int64_t start, int64_t window_end);
 
   int dbg_port = -1;  // SHADOWTPU_TCPDBG, resolved once at construction
   Engine() {
@@ -2076,12 +2217,15 @@ struct Engine {
       if (!r.bucket.unlimited) {
         int64_t when = 0;
         if (!r.bucket.try_remove(p->total_size(), now, &when)) {
+          r.stalls++;
           r.pending = id;
           r.state = RELAY_PENDING;
           hp->tpush({when, hp->event_seq++, TK_RELAY, (uint32_t)ridx});
           return;
         }
       }
+      r.fwd_pkts++;
+      r.fwd_bytes += p->total_size();
       int dev = packet_device(hp, p->dst_ip);
       device_push(hp, dev, id, now);
     }
@@ -2099,6 +2243,7 @@ struct Engine {
       } else {
         while (now >= c.drop_next && c.dropping) {
           c.dropped_count++;
+          c.drop_bytes += store.get(id)->total_size();
           trace_drop(hp, store.get(id), "codel", now);
           store.free_pkt(id);
           c.count++;
@@ -2111,6 +2256,7 @@ struct Engine {
     } else if (ok && (now - c.drop_next < CODEL_INTERVAL_NS ||
                       now - c.first_above >= CODEL_INTERVAL_NS)) {
       c.dropped_count++;
+      c.drop_bytes += store.get(id)->total_size();
       trace_drop(hp, store.get(id), "codel", now);
       store.free_pkt(id);
       id = c.dequeue_raw(now, store, &ok);
@@ -3415,6 +3561,9 @@ struct Engine {
       /* Sim-netstat: per-connection samples at the round boundary,
        * drained by the manager after the span (netstat_take). */
       tel_sample_round(start, window_end);
+      /* Fabric observatory: per-queue samples at the same boundary,
+       * drained by the manager after the span (fabric_take). */
+      fab_sample_round(start, window_end);
       r.rounds++;
       r.busy_end = window_end;
       /* Barrier: push_inbox already lowered destination nt slots, so
@@ -3984,7 +4133,35 @@ struct Engine {
     return ifc.port_use.count(((uint32_t)proto << 16) | (uint32_t)port) > 0;
   }
 
+  /* One endpoint's FctRec from a live connection, or false when the
+   * flow never carried payload (trace/fabricstat.py flow_row twin). */
+  static bool fct_row(int host, const SocketN *s, const TcpConn *c,
+                      FctRec *out) {
+    if (c->fct_first < 0) return false;
+    int flags = 0;
+    if (c->state == ST_CLOSED) flags |= FCT_F_COMPLETE;
+    if (c->fct_bytes_in > c->fct_bytes_out) flags |= FCT_F_RECEIVER;
+    *out = {c->fct_first, c->fct_last, host, (uint16_t)s->local_port,
+            (uint16_t)s->peer_port, s->peer_ip, flags,
+            c->fct_bytes_in, c->fct_bytes_out, c->retransmit_count};
+    return true;
+  }
+
   void tcp_teardown(HostPlane *hp, SocketN *s, uint32_t tok) {
+    /* Fabric-observatory flow lifecycle: teardown is the one event
+     * after which the association walk can no longer find this
+     * connection, so its FCT record is logged here
+     * (socket_tcp._teardown twin).  Still-associated flows are swept
+     * by fct_flows when the artifact is written. */
+    {
+      TcpSocketN *t0 = dynamic_cast<TcpSocketN *>(s);
+      if (t0 && t0->conn && s->ifaces_mask && s->has_local &&
+          s->has_peer) {
+        FctRec r;
+        if (fct_row(s->host, s, t0->conn.get(), &r))
+          hp->fct_log.push_back(r);
+      }
+    }
     /* socket_tcp._teardown */
     for (int i = 0; i < 2; i++) {
       if (!(s->ifaces_mask & (1 << i))) continue;
@@ -4506,6 +4683,47 @@ void Engine::tel_sample_round(int64_t start, int64_t window_end) {
   for (const TelRec &r : recs) tel_push(r);
 }
 
+void Engine::fab_sample_round(int64_t start, int64_t window_end) {
+  if (!fab_on || fab_ring.empty()) return;
+  int64_t iv = fab_interval > 0 ? fab_interval : 1;
+  if (start / iv == window_end / iv) return;
+  std::vector<FabRec> recs;
+  for (size_t h = 0; h < hosts.size(); h++) {
+    HostPlane *hp = hosts[h].get();
+    if (hp == nullptr) continue;
+    CoDelN &c = hp->codel;
+    RelayN &r1 = hp->relays[1], &r2 = hp->relays[2];
+    int flags = 0;
+    if (!c.q.empty()) flags |= FB_ACT_CODEL;
+    if (r1.state == RELAY_PENDING) flags |= FB_ACT_TB_OUT;
+    if (r2.state == RELAY_PENDING) flags |= FB_ACT_TB_IN;
+    if (hp->eth.packets_sent + hp->eth.packets_received > 0)
+      flags |= FB_ACT_LINK;
+    if (!flags) continue;
+    FabRec r;
+    r.t = window_end;
+    r.host = (int32_t)h;
+    r.flags = flags;
+    r.qdepth = (int64_t)c.q.size();
+    r.qbytes = c.bytes;
+    r.sojourn = c.q.empty() ? 0 : window_end - c.q.front().second;
+    r.qenq = c.enq_pkts;
+    r.qdrops = c.dropped_count;
+    r.qmarks = c.marked;
+    r.r1_bal = r1.bucket.unlimited ? -1 : r1.bucket.peek_balance(window_end);
+    r.r1_stalls = r1.stalls;
+    r.r2_bal = r2.bucket.unlimited ? -1 : r2.bucket.peek_balance(window_end);
+    r.r2_stalls = r2.stalls;
+    r.psent = hp->eth.packets_sent;
+    r.bsent = hp->eth.bytes_sent;
+    r.precv = hp->eth.packets_received;
+    r.brecv = hp->eth.bytes_received;
+    recs.push_back(r);
+  }
+  fab_reserve(recs.size());
+  for (const FabRec &r : recs) fab_push(r);
+}
+
 /* ================= CPython bindings =============================== */
 
 struct EngineObj {
@@ -4731,10 +4949,12 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   std::vector<uint8_t> th_kind(H * T, 0), th_tgt(H * T, 0);
   std::vector<int64_t> codel_bytes(H), codel_count(H),
       codel_last_count(H), codel_first_above(H), codel_drop_next(H),
-      codel_dropped(H);
+      codel_dropped(H), codel_enq_pkts(H), codel_enq_bytes(H),
+      codel_drop_bytes(H), codel_peak(H);
   std::vector<uint8_t> codel_dropping(H);
   std::vector<uint8_t> r_pending[3], r_unlimited[3], r_pk_valid[3];
-  std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3];
+  std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3],
+      r_stalls[3], r_fwd_pkts[3], r_fwd_bytes[3];
   for (int r = 1; r <= 2; r++) {
     r_pending[r].assign(H, 0);
     r_unlimited[r].assign(H, 0);
@@ -4743,6 +4963,9 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     r_next[r].assign(H, 0);
     r_refill[r].assign(H, 0);
     r_cap[r].assign(H, 0);
+    r_stalls[r].assign(H, 0);
+    r_fwd_pkts[r].assign(H, 0);
+    r_fwd_bytes[r].assign(H, 0);
   }
   std::vector<uint8_t> m_state(H), m_wakep(H), s_state(H), s_wakep(H),
       s_exited(H), m_exited(H), m_partdone(H), s_partdone(H),
@@ -4807,6 +5030,10 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     codel_first_above[h] = hp->codel.first_above;
     codel_drop_next[h] = hp->codel.drop_next;
     codel_dropped[h] = hp->codel.dropped_count;
+    codel_enq_pkts[h] = hp->codel.enq_pkts;
+    codel_enq_bytes[h] = hp->codel.enq_bytes;
+    codel_drop_bytes[h] = hp->codel.drop_bytes;
+    codel_peak[h] = hp->codel.peak_depth;
     for (int r = 1; r <= 2; r++) {
       RelayN &rl = hp->relays[r];
       r_pending[r][h] = rl.state == RELAY_PENDING ? 1 : 0;
@@ -4815,6 +5042,9 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
       r_next[r][h] = rl.bucket.next_refill;
       r_refill[r][h] = rl.bucket.refill_size;
       r_cap[r][h] = rl.bucket.capacity;
+      r_stalls[r][h] = rl.stalls;
+      r_fwd_pkts[r][h] = rl.fwd_pkts;
+      r_fwd_bytes[r][h] = rl.fwd_bytes;
       Engine::PkCols &pc = r == 1 ? r1pk : r2pk;
       if (rl.pending != UINT64_MAX) {
         r_pk_valid[r][h] = 1;
@@ -4937,6 +5167,10 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   put("codel_first_above", bytes_vec(codel_first_above));
   put("codel_drop_next", bytes_vec(codel_drop_next));
   put("codel_dropped", bytes_vec(codel_dropped));
+  put("codel_enq_pkts", bytes_vec(codel_enq_pkts));
+  put("codel_enq_bytes", bytes_vec(codel_enq_bytes));
+  put("codel_drop_bytes", bytes_vec(codel_drop_bytes));
+  put("codel_peak", bytes_vec(codel_peak));
   for (int r = 1; r <= 2; r++) {
     std::string p = r == 1 ? "r1" : "r2";
     put((p + "_pending").c_str(), bytes_vec(r_pending[r]));
@@ -4945,6 +5179,9 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     put((p + "_next").c_str(), bytes_vec(r_next[r]));
     put((p + "_refill").c_str(), bytes_vec(r_refill[r]));
     put((p + "_cap").c_str(), bytes_vec(r_cap[r]));
+    put((p + "_stalls").c_str(), bytes_vec(r_stalls[r]));
+    put((p + "_fwd_pkts").c_str(), bytes_vec(r_fwd_pkts[r]));
+    put((p + "_fwd_bytes").c_str(), bytes_vec(r_fwd_bytes[r]));
     put((p + "_pk_valid").c_str(), bytes_vec(r_pk_valid[r]));
     put_pk((p + "_pk").c_str(), r == 1 ? r1pk : r2pk);
   }
@@ -5085,15 +5322,28 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
       col<int64_t>(d, "codel_drop_next", H, &ok);
   const int64_t *codel_dropped =
       col<int64_t>(d, "codel_dropped", H, &ok);
+  const int64_t *codel_enq_pkts =
+      col<int64_t>(d, "codel_enq_pkts", H, &ok);
+  const int64_t *codel_enq_bytes =
+      col<int64_t>(d, "codel_enq_bytes", H, &ok);
+  const int64_t *codel_drop_bytes =
+      col<int64_t>(d, "codel_drop_bytes", H, &ok);
+  const int64_t *codel_peak = col<int64_t>(d, "codel_peak", H, &ok);
   const uint8_t *r_pending[3] = {nullptr, nullptr, nullptr};
   const uint8_t *r_pk_valid[3] = {nullptr, nullptr, nullptr};
-  const int64_t *r_bal[3], *r_next[3];
+  const int64_t *r_bal[3], *r_next[3], *r_stalls[3], *r_fwd_pkts[3],
+      *r_fwd_bytes[3];
   for (int r = 1; r <= 2; r++) {
     std::string p = r == 1 ? "r1" : "r2";
     r_pending[r] = col<uint8_t>(d, (p + "_pending").c_str(), H, &ok);
     r_pk_valid[r] = col<uint8_t>(d, (p + "_pk_valid").c_str(), H, &ok);
     r_bal[r] = col<int64_t>(d, (p + "_bal").c_str(), H, &ok);
     r_next[r] = col<int64_t>(d, (p + "_next").c_str(), H, &ok);
+    r_stalls[r] = col<int64_t>(d, (p + "_stalls").c_str(), H, &ok);
+    r_fwd_pkts[r] =
+        col<int64_t>(d, (p + "_fwd_pkts").c_str(), H, &ok);
+    r_fwd_bytes[r] =
+        col<int64_t>(d, (p + "_fwd_bytes").c_str(), H, &ok);
   }
   const int64_t *ib_time = col<int64_t>(d, "ib_time", H * I, &ok);
   const int32_t *ib_src = col<int32_t>(d, "ib_src", H * I, &ok);
@@ -5210,11 +5460,18 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     hp->codel.first_above = codel_first_above[h];
     hp->codel.drop_next = codel_drop_next[h];
     hp->codel.dropped_count = codel_dropped[h];
+    hp->codel.enq_pkts = codel_enq_pkts[h];
+    hp->codel.enq_bytes = codel_enq_bytes[h];
+    hp->codel.drop_bytes = codel_drop_bytes[h];
+    hp->codel.peak_depth = codel_peak[h];
     for (int r = 1; r <= 2; r++) {
       RelayN &rl = hp->relays[r];
       rl.state = r_pending[r][h] ? RELAY_PENDING : RELAY_IDLE;
       rl.bucket.balance = r_bal[r][h];
       rl.bucket.next_refill = r_next[r][h];
+      rl.stalls = r_stalls[r][h];
+      rl.fwd_pkts = r_fwd_pkts[r][h];
+      rl.fwd_bytes = r_fwd_bytes[r][h];
       if (r_pk_valid[r][h])
         rl.pending = mk(r == 1 ? r1pk : r2pk, h);
     }
@@ -5554,10 +5811,12 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   std::vector<int32_t> th_tgt(H * (size_t)T, 0);
   std::vector<int64_t> codel_bytes(H), codel_count(H),
       codel_last_count(H), codel_first_above(H), codel_drop_next(H),
-      codel_dropped(H);
+      codel_dropped(H), codel_enq_pkts(H), codel_enq_bytes(H),
+      codel_drop_bytes(H), codel_peak(H);
   std::vector<uint8_t> codel_dropping(H);
   std::vector<uint8_t> r_pending[3], r_unlimited[3], r_pk_valid[3];
-  std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3];
+  std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3],
+      r_stalls[3], r_fwd_pkts[3], r_fwd_bytes[3];
   for (int ri = 1; ri <= 2; ri++) {
     r_pending[ri].assign(H, 0);
     r_unlimited[ri].assign(H, 0);
@@ -5566,6 +5825,9 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     r_next[ri].assign(H, 0);
     r_refill[ri].assign(H, 0);
     r_cap[ri].assign(H, 0);
+    r_stalls[ri].assign(H, 0);
+    r_fwd_pkts[ri].assign(H, 0);
+    r_fwd_bytes[ri].assign(H, 0);
   }
   std::vector<int64_t> app_sys(H * ASYS_N), pkts_sent(H), pkts_recv(H),
       pkts_dropped(H), events_run(H);
@@ -5597,6 +5859,10 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     codel_first_above[h] = hp->codel.first_above;
     codel_drop_next[h] = hp->codel.drop_next;
     codel_dropped[h] = hp->codel.dropped_count;
+    codel_enq_pkts[h] = hp->codel.enq_pkts;
+    codel_enq_bytes[h] = hp->codel.enq_bytes;
+    codel_drop_bytes[h] = hp->codel.drop_bytes;
+    codel_peak[h] = hp->codel.peak_depth;
     for (int ri = 1; ri <= 2; ri++) {
       RelayN &rl = hp->relays[ri];
       r_pending[ri][h] = rl.state == RELAY_PENDING ? 1 : 0;
@@ -5605,6 +5871,9 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
       r_next[ri][h] = rl.bucket.next_refill;
       r_refill[ri][h] = rl.bucket.refill_size;
       r_cap[ri][h] = rl.bucket.capacity;
+      r_stalls[ri][h] = rl.stalls;
+      r_fwd_pkts[ri][h] = rl.fwd_pkts;
+      r_fwd_bytes[ri][h] = rl.fwd_bytes;
       TPkCols &pc = ri == 1 ? r1pk : r2pk;
       if (rl.pending != UINT64_MAX) {
         r_pk_valid[ri][h] = 1;
@@ -5678,7 +5947,8 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
       c_segsrecv(CC, 0), c_rtxcount(CC, 0), c_sackskip(CC, 0),
       c_tmrdl(CC, -1), c_atcopied(CC, 0), c_atspace(CC, 0),
       c_atlast(CC, 0), c_awaitseq(CC, 0), c_agot(CC, 0),
-      c_atotal(CC, 0);
+      c_atotal(CC, 0), c_fbyte(CC, -1), c_lbyte(CC, -1),
+      c_bin(CC, 0), c_bout(CC, 0);
   std::vector<int32_t> rtx_len(CC, 0), ra_len(CC, 0), op_len(CC, 0);
   std::vector<uint32_t> rtx_seq(CC * (size_t)RT, 0),
       ra_seq(CC * (size_t)RA, 0);
@@ -5734,6 +6004,10 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     c_segsrecv[j] = c->segments_received;
     c_rtxcount[j] = c->retransmit_count;
     c_sackskip[j] = c->sacked_skip_count;
+    c_fbyte[j] = c->fct_first;
+    c_lbyte[j] = c->fct_last;
+    c_bin[j] = c->fct_bytes_in;
+    c_bout[j] = c->fct_bytes_out;
     c_tmrdl[j] = s->timer_deadline;
     c_status[j] = s->status;
     c_queued[j] = s->queued[1] ? 1 : 0;
@@ -5806,6 +6080,10 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("codel_first_above", bytes_vec(codel_first_above));
   put("codel_drop_next", bytes_vec(codel_drop_next));
   put("codel_dropped", bytes_vec(codel_dropped));
+  put("codel_enq_pkts", bytes_vec(codel_enq_pkts));
+  put("codel_enq_bytes", bytes_vec(codel_enq_bytes));
+  put("codel_drop_bytes", bytes_vec(codel_drop_bytes));
+  put("codel_peak", bytes_vec(codel_peak));
   for (int ri = 1; ri <= 2; ri++) {
     std::string p = ri == 1 ? "r1" : "r2";
     put((p + "_pending").c_str(), bytes_vec(r_pending[ri]));
@@ -5814,6 +6092,9 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     put((p + "_next").c_str(), bytes_vec(r_next[ri]));
     put((p + "_refill").c_str(), bytes_vec(r_refill[ri]));
     put((p + "_cap").c_str(), bytes_vec(r_cap[ri]));
+    put((p + "_stalls").c_str(), bytes_vec(r_stalls[ri]));
+    put((p + "_fwd_pkts").c_str(), bytes_vec(r_fwd_pkts[ri]));
+    put((p + "_fwd_bytes").c_str(), bytes_vec(r_fwd_bytes[ri]));
     put((p + "_pk_valid").c_str(), bytes_vec(r_pk_valid[ri]));
     put_tpk(d, (p + "_pk").c_str(), ri == 1 ? r1pk : r2pk, &ok);
   }
@@ -5891,6 +6172,10 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("c_wakep", bytes_vec(c_wakep));
   put("c_agot", bytes_vec(c_agot));
   put("c_atotal", bytes_vec(c_atotal));
+  put("c_fbyte", bytes_vec(c_fbyte));
+  put("c_lbyte", bytes_vec(c_lbyte));
+  put("c_bin", bytes_vec(c_bin));
+  put("c_bout", bytes_vec(c_bout));
   put("rtx_len", bytes_vec(rtx_len));
   put("rtx_seq", bytes_vec(rtx_seq));
   put("rtx_plen", bytes_vec(rtx_plen));
@@ -5955,15 +6240,28 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
       col<int64_t>(d, "codel_drop_next", H, &ok);
   const int64_t *codel_dropped =
       col<int64_t>(d, "codel_dropped", H, &ok);
+  const int64_t *codel_enq_pkts =
+      col<int64_t>(d, "codel_enq_pkts", H, &ok);
+  const int64_t *codel_enq_bytes =
+      col<int64_t>(d, "codel_enq_bytes", H, &ok);
+  const int64_t *codel_drop_bytes =
+      col<int64_t>(d, "codel_drop_bytes", H, &ok);
+  const int64_t *codel_peak = col<int64_t>(d, "codel_peak", H, &ok);
   const uint8_t *r_pending[3] = {nullptr, nullptr, nullptr};
   const uint8_t *r_pk_valid[3] = {nullptr, nullptr, nullptr};
-  const int64_t *r_bal[3], *r_next[3];
+  const int64_t *r_bal[3], *r_next[3], *r_stalls[3], *r_fwd_pkts[3],
+      *r_fwd_bytes[3];
   for (int ri = 1; ri <= 2; ri++) {
     std::string p = ri == 1 ? "r1" : "r2";
     r_pending[ri] = col<uint8_t>(d, (p + "_pending").c_str(), H, &ok);
     r_pk_valid[ri] = col<uint8_t>(d, (p + "_pk_valid").c_str(), H, &ok);
     r_bal[ri] = col<int64_t>(d, (p + "_bal").c_str(), H, &ok);
     r_next[ri] = col<int64_t>(d, (p + "_next").c_str(), H, &ok);
+    r_stalls[ri] = col<int64_t>(d, (p + "_stalls").c_str(), H, &ok);
+    r_fwd_pkts[ri] =
+        col<int64_t>(d, (p + "_fwd_pkts").c_str(), H, &ok);
+    r_fwd_bytes[ri] =
+        col<int64_t>(d, (p + "_fwd_bytes").c_str(), H, &ok);
   }
   const int64_t *ib_time = col<int64_t>(d, "ib_time", H * (size_t)I, &ok);
   const int32_t *ib_src = col<int32_t>(d, "ib_src", H * (size_t)I, &ok);
@@ -6020,6 +6318,10 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
   const int64_t *c_awaitseq = col<int64_t>(d, "c_awaitseq", CC, &ok);
   const uint8_t *c_wakep = col<uint8_t>(d, "c_wakep", CC, &ok);
   const int64_t *c_agot = col<int64_t>(d, "c_agot", CC, &ok);
+  const int64_t *c_fbyte = col<int64_t>(d, "c_fbyte", CC, &ok);
+  const int64_t *c_lbyte = col<int64_t>(d, "c_lbyte", CC, &ok);
+  const int64_t *c_bin = col<int64_t>(d, "c_bin", CC, &ok);
+  const int64_t *c_bout = col<int64_t>(d, "c_bout", CC, &ok);
   const int32_t *rtx_len = col<int32_t>(d, "rtx_len", CC, &ok);
   const uint32_t *rtx_seq =
       col<uint32_t>(d, "rtx_seq", CC * (size_t)RT, &ok);
@@ -6110,11 +6412,18 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
     hp->codel.first_above = codel_first_above[h];
     hp->codel.drop_next = codel_drop_next[h];
     hp->codel.dropped_count = codel_dropped[h];
+    hp->codel.enq_pkts = codel_enq_pkts[h];
+    hp->codel.enq_bytes = codel_enq_bytes[h];
+    hp->codel.drop_bytes = codel_drop_bytes[h];
+    hp->codel.peak_depth = codel_peak[h];
     for (int ri = 1; ri <= 2; ri++) {
       RelayN &rl = hp->relays[ri];
       rl.state = r_pending[ri][h] ? RELAY_PENDING : RELAY_IDLE;
       rl.bucket.balance = r_bal[ri][h];
       rl.bucket.next_refill = r_next[ri][h];
+      rl.stalls = r_stalls[ri][h];
+      rl.fwd_pkts = r_fwd_pkts[ri][h];
+      rl.fwd_bytes = r_fwd_bytes[ri][h];
       if (r_pk_valid[ri][h])
         rl.pending = mk(ri == 1 ? r1pk : r2pk, h);
     }
@@ -6194,6 +6503,10 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
     c->segments_received = c_segsrecv[j];
     c->retransmit_count = c_rtxcount[j];
     c->sacked_skip_count = c_sackskip[j];
+    c->fct_first = c_fbyte[j];
+    c->fct_last = c_lbyte[j];
+    c->fct_bytes_in = c_bin[j];
+    c->fct_bytes_out = c_bout[j];
     c->rtx.clear();
     for (int32_t k = 0; k < rtx_len[j]; k++) {
       size_t kk = j * (size_t)RT + (size_t)k;
@@ -7312,6 +7625,125 @@ static PyObject *eng_netstat_take(EngineObj *self, PyObject *) {
   return Py_BuildValue("(NK)", buf, dropped);
 }
 
+static PyObject *eng_set_fabric(EngineObj *self, PyObject *args) {
+  /* Enable/disable the fabric-observatory ring.  Like set_netstat,
+   * deliberately NOT an epoch bump: sampling observes state, never
+   * mutates it. */
+  int on;
+  long long interval = 0;
+  long long cap = 1 << 12;
+  if (!PyArg_ParseTuple(args, "i|LL", &on, &interval, &cap))
+    return nullptr;
+  Engine *e = self->eng;
+  e->fab_on = on != 0;
+  e->fab_interval = interval > 0 ? interval : 1;
+  e->fab_ring.assign(on && cap > 0 ? (size_t)cap : 0, FabRec{});
+  e->fab_head = e->fab_len = 0;
+  e->fab_dropped = 0;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_fabric_sample(EngineObj *self, PyObject *args) {
+  /* Per-round path twin of eng_netstat_sample (grid-crossing rule
+   * applied engine-side; observation only, no epoch bump). */
+  long long start, window_end;
+  if (!PyArg_ParseTuple(args, "LL", &start, &window_end)) return nullptr;
+  self->eng->fab_sample_round(start, window_end);
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_fabric_take(EngineObj *self, PyObject *) {
+  /* Drain the ring in record order -> (packed bytes, n_overwritten).
+   * The byte layout is exactly trace/events.py FB_REC. */
+  Engine *e = self->eng;
+  size_t n = e->fab_len, cap = e->fab_ring.size();
+  PyObject *buf = PyBytes_FromStringAndSize(
+      nullptr, (Py_ssize_t)(n * sizeof(FabRec)));
+  if (!buf) return nullptr;
+  FabRec *out = (FabRec *)PyBytes_AS_STRING(buf);
+  for (size_t i = 0; i < n; i++)
+    out[i] = e->fab_ring[(e->fab_head + i) % cap];
+  unsigned long long dropped = e->fab_dropped;
+  e->fab_head = e->fab_len = 0;
+  e->fab_dropped = 0;
+  return Py_BuildValue("(NK)", buf, dropped);
+}
+
+static PyObject *eng_fct_flows(EngineObj *self, PyObject *) {
+  /* Every engine-side flow row: the per-host teardown logs plus the
+   * still-associated sweep (ifaces_mask != 0 — the twin of the
+   * Python association walk, so torn-down conns are never counted
+   * twice).  Returns a list of FCT_REC field tuples; the manager
+   * merges, sorts and packs. */
+  Engine *e = self->eng;
+  PyObject *out = PyList_New(0);
+  if (!out) return nullptr;
+  auto append = [&](const FctRec &r) -> bool {
+    PyObject *t = Py_BuildValue("(LLiHHIiLLL)", (long long)r.t_first,
+                                (long long)r.t_last, r.host, r.lport,
+                                r.rport, r.rip, r.flags,
+                                (long long)r.bytes_in,
+                                (long long)r.bytes_out,
+                                (long long)r.rtx);
+    if (!t) return false;
+    int rc = PyList_Append(out, t);
+    Py_DECREF(t);
+    return rc == 0;
+  };
+  for (auto &hpu : e->hosts) {
+    HostPlane *hp = hpu.get();
+    if (!hp) continue;
+    for (const FctRec &r : hp->fct_log)
+      if (!append(r)) { Py_DECREF(out); return nullptr; }
+  }
+  for (size_t tok = 0; tok < e->socks.size(); tok++) {
+    SocketN *raw = e->socks[tok].get();
+    if (!raw || raw->proto != PROTO_TCP || !raw->ifaces_mask ||
+        !raw->has_local || !raw->has_peer)
+      continue;
+    TcpConn *c = static_cast<TcpSocketN *>(raw)->conn.get();
+    if (!c) continue;
+    FctRec r;
+    if (Engine::fct_row(raw->host, raw, c, &r))
+      if (!append(r)) { Py_DECREF(out); return nullptr; }
+  }
+  return out;
+}
+
+static PyObject *eng_fabric_counters(EngineObj *self, PyObject *args) {
+  /* One plane host's fabric counter tuple (the manager's conservation
+   * sweep + bench summary; trace/fabricstat.py host_fabric_counters
+   * is the field-order twin): (enq_pkts, enq_bytes, fwd_pkts,
+   * fwd_bytes, drop_pkts, drop_bytes, marked, qdepth, qbytes,
+   * peak_depth, r1_stalls, r2_stalls, psent, bsent, precv, brecv,
+   * parked_pkts, parked_bytes).  The parked terms are the inet-in
+   * relay's one in-flight packet (popped from CoDel, awaiting a
+   * bucket refill) — the conservation sweep must not count it as
+   * lost. */
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  Engine *e = self->eng;
+  HostPlane *hp = e->plane(hid);
+  if (hp == nullptr) Py_RETURN_NONE;
+  CoDelN &c = hp->codel;
+  long long parked_pkts = 0, parked_bytes = 0;
+  if (hp->relays[2].pending != UINT64_MAX) {
+    parked_pkts = 1;
+    parked_bytes = e->store.get(hp->relays[2].pending)->total_size();
+  }
+  return Py_BuildValue(
+      "(LLLLLLLLLLLLLLLLLL)", (long long)c.enq_pkts,
+      (long long)c.enq_bytes, (long long)hp->relays[2].fwd_pkts,
+      (long long)hp->relays[2].fwd_bytes, (long long)c.dropped_count,
+      (long long)c.drop_bytes, (long long)c.marked,
+      (long long)c.q.size(), (long long)c.bytes,
+      (long long)c.peak_depth, (long long)hp->relays[1].stalls,
+      (long long)hp->relays[2].stalls, (long long)hp->eth.packets_sent,
+      (long long)hp->eth.bytes_sent,
+      (long long)hp->eth.packets_received,
+      (long long)hp->eth.bytes_received, parked_pkts, parked_bytes);
+}
+
 static PyObject *eng_drop_causes(EngineObj *self, PyObject *args) {
   /* Per-host drop-cause counters -> TEL_N-tuple + unattributed tail
    * (Host.merge_native_counters folds the deltas). */
@@ -7462,6 +7894,13 @@ static PyMethodDef eng_methods[] = {
     {"netstat_sample", (PyCFunction)eng_netstat_sample, METH_VARARGS,
      nullptr},
     {"netstat_take", (PyCFunction)eng_netstat_take, METH_NOARGS, nullptr},
+    {"set_fabric", (PyCFunction)eng_set_fabric, METH_VARARGS, nullptr},
+    {"fabric_sample", (PyCFunction)eng_fabric_sample, METH_VARARGS,
+     nullptr},
+    {"fabric_take", (PyCFunction)eng_fabric_take, METH_NOARGS, nullptr},
+    {"fct_flows", (PyCFunction)eng_fct_flows, METH_NOARGS, nullptr},
+    {"fabric_counters", (PyCFunction)eng_fabric_counters, METH_VARARGS,
+     nullptr},
     {"netstat_totals", (PyCFunction)eng_netstat_totals, METH_NOARGS,
      nullptr},
     {"drop_causes", (PyCFunction)eng_drop_causes, METH_VARARGS, nullptr},

@@ -287,6 +287,8 @@ class _ModuleLinter:
         channels = [cls for cls in ast.walk(self.tree)
                     if isinstance(cls, ast.ClassDef)
                     and cls.name in ("SimChannel", "NetstatChannel",
+                                     "FabricChannel",
+                                     "FixedRecordChannel",
                                      "SyscallChannel",
                                      "HostSyscallLog")]
         if not channels:

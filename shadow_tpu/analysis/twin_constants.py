@@ -179,6 +179,25 @@ CONTRACTS = [
     ("TEL_N", [(_TREV, "TEL_N"), (_TCPS, "TEL_N"),
                (_PHLD, "TEL_N")]),
     ("TEL_REC_BYTES", [(_TREV, "TEL_REC_BYTES")]),
+    # Fabric observatory: the FB_ACT_* activity mask (both device-span
+    # kernels compute it per round, so bit drift would silently change
+    # which hosts sample), the FCT_F_* flow flags, and both record
+    # sizes (the engine's FabRec ring and FctRec flow log must stay
+    # byte-compatible with the Python structs).
+    ("FB_ACT_CODEL", [(_TREV, "FB_ACT_CODEL"), (_TCPS, "FB_ACT_CODEL"),
+                      (_PHLD, "FB_ACT_CODEL")]),
+    ("FB_ACT_TB_OUT", [(_TREV, "FB_ACT_TB_OUT"),
+                       (_TCPS, "FB_ACT_TB_OUT"),
+                       (_PHLD, "FB_ACT_TB_OUT")]),
+    ("FB_ACT_TB_IN", [(_TREV, "FB_ACT_TB_IN"),
+                      (_TCPS, "FB_ACT_TB_IN"),
+                      (_PHLD, "FB_ACT_TB_IN")]),
+    ("FB_ACT_LINK", [(_TREV, "FB_ACT_LINK"), (_TCPS, "FB_ACT_LINK"),
+                     (_PHLD, "FB_ACT_LINK")]),
+    ("FB_REC_BYTES", [(_TREV, "FB_REC_BYTES")]),
+    ("FCT_F_COMPLETE", [(_TREV, "FCT_F_COMPLETE")]),
+    ("FCT_F_RECEIVER", [(_TREV, "FCT_F_RECEIVER")]),
+    ("FCT_REC_BYTES", [(_TREV, "FCT_REC_BYTES")]),
 ]
 
 # Trace enum prefixes that may never gain an UNREGISTERED member: any
@@ -186,7 +205,7 @@ CONTRACTS = [
 # CONTRACTS row (and with it a Python twin), so extending the
 # flight-record layout or the drop-cause table without updating
 # trace/events.py fails closed.
-TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_")
+TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_", "FB_", "FCT_")
 
 # Shim-side contracts (native/shim.c — the syscall observatory's SC_*
 # disposition enum, its record-size pin, and the IPC-layout offset of

@@ -158,6 +158,25 @@ class ExperimentalConfig:
     # [start, end) emits samples iff it crosses a grid boundary
     # (start // interval != end // interval).  0 = every round.
     netstat_interval_ns: int = 0
+    # Fabric observatory (docs/OBSERVABILITY.md "Fabric
+    # observatory"): "on" records the deterministic per-link queue
+    # telemetry + flow-completion-time channel (fabric-sim.bin: CoDel
+    # depth/sojourn/drop counters, token-bucket occupancy and refill
+    # stalls, per-link bytes/packets per active host per sampled
+    # round, plus per-flow lifecycle records — byte-identical across
+    # runs AND across the three execution paths).  The conservation
+    # counters (metrics.sim.fabric.*: bytes/packets enqueued ==
+    # delivered + dropped + queued per interface) run regardless —
+    # cheap integer adds, like drop attribution.
+    sim_fabricstat: str = "off"
+    # Fabric-observatory sampling grid in simulated ns (the same
+    # grid-crossing rule as netstat_interval).  0 = every round.
+    fabricstat_interval_ns: int = 0
+    # Top-N cap shared by every Chrome per-entity counter-track
+    # family (per-connection sim-netstat tracks, per-process syscall
+    # tracks, per-link fabric tracks): exports stay loadable at 10k
+    # hosts.  Was hard-coded per exporter.
+    chrome_top_n: int = 16
     # Syscall observatory (docs/OBSERVABILITY.md "syscall
     # observatory"): "on" records the deterministic per-syscall
     # sim-time channel (syscalls-sim.bin: one fixed record per
@@ -259,6 +278,9 @@ class ConfigOptions:
                 "flight_recorder": e.flight_recorder,
                 "sim_netstat": e.sim_netstat,
                 "netstat_interval": _ns(e.netstat_interval_ns),
+                "sim_fabricstat": e.sim_fabricstat,
+                "fabricstat_interval": _ns(e.fabricstat_interval_ns),
+                "chrome_top_n": e.chrome_top_n,
                 "syscall_observatory": e.syscall_observatory,
                 "pcap_span_cap": e.pcap_span_cap,
                 "openssl_crypto_noop": e.openssl_crypto_noop,
@@ -403,6 +425,12 @@ class ConfigOptions:
                  else str(v)),
                 ("netstat_interval", "netstat_interval_ns",
                  units.parse_time_ns),
+                ("sim_fabricstat", "sim_fabricstat",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
+                ("fabricstat_interval", "fabricstat_interval_ns",
+                 units.parse_time_ns),
+                ("chrome_top_n", "chrome_top_n", int),
                 ("syscall_observatory", "syscall_observatory",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
@@ -428,6 +456,13 @@ class ConfigOptions:
             raise ValueError(
                 f"unknown sim_netstat {experimental.sim_netstat!r}; "
                 f"expected one of ('off', 'on')")
+        if experimental.sim_fabricstat not in ("off", "on"):
+            raise ValueError(
+                f"unknown sim_fabricstat "
+                f"{experimental.sim_fabricstat!r}; "
+                f"expected one of ('off', 'on')")
+        if experimental.chrome_top_n < 1:
+            raise ValueError("chrome_top_n must be >= 1")
         if experimental.syscall_observatory not in ("off", "wall", "on"):
             raise ValueError(
                 f"unknown syscall_observatory "

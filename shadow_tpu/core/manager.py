@@ -346,6 +346,24 @@ class Manager:
                 self.plane.engine.set_netstat(
                     1, max(int(config.experimental.netstat_interval_ns),
                            1))
+        # Fabric observatory (trace/fabricstat.py): the deterministic
+        # per-link queue telemetry + flow-completion-time channel.
+        # The conservation COUNTERS (CoDel enqueue/forward/drop, relay
+        # stalls, flow lifecycle) are always on — integer adds like
+        # drop attribution; the sample channel is opt-in.
+        self.fabric = None
+        if config.experimental.sim_fabricstat == "on":
+            from shadow_tpu.trace.fabricstat import FabricChannel
+            self.fabric = FabricChannel(
+                config.experimental.fabricstat_interval_ns)
+            if self.plane is not None:
+                # Engine-side fixed-record ring: per-round queue
+                # samples inside C++ spans and on the per-round path,
+                # drained alongside the span exports.
+                self.plane.engine.set_fabric(
+                    1,
+                    max(int(config.experimental.fabricstat_interval_ns),
+                        1))
         # Syscall observatory (trace/sctrace.py, docs/OBSERVABILITY.md
         # "syscall observatory"): SC_* disposition counters are ALWAYS
         # on (Host.sc_disp integer adds, like drop attribution); the
@@ -710,6 +728,7 @@ class Manager:
         fr_sim = flight.sim if flight is not None else None
         fr_wall = flight.wall if flight is not None else None
         netstat = self.netstat
+        fabric = self.fabric
         # Why the per-round path would run when spans are statically
         # unavailable (refined at runtime when span_ok drops).
         if self.config.experimental.scheduler != "tpu" \
@@ -807,6 +826,10 @@ class Manager:
                         # theirs in the runner, at span commit).
                         netstat.extend(
                             *self.plane.engine.netstat_take())
+                    if fabric is not None and not device:
+                        # Per-queue samples, same drain discipline.
+                        fabric.extend(
+                            *self.plane.engine.fabric_take())
                     self.runahead.sync_from_span(ra)
                     prop = self.propagator
                     # Audit split counts dispatches the way the
@@ -1012,6 +1035,16 @@ class Manager:
                     eng.netstat_sample(start, window_end)
                     netstat.extend(*eng.netstat_take())
                 netstat.sample_object_hosts(self.hosts, window_end)
+            if fabric is not None and fabric.sampled(start,
+                                                     window_end):
+                # Fabric observatory at the same boundary, same
+                # engine-block-then-object-block discipline (both in
+                # ascending host-id order).
+                if self.plane is not None:
+                    eng = self.plane.engine
+                    eng.fabric_sample(start, window_end)
+                    fabric.extend(*eng.fabric_take())
+                fabric.sample_object_hosts(self.hosts, window_end)
             audit.add(round_reason, 1)
             if self._pcap_engine:
                 self._drain_engine_pcap()  # stream, don't buffer a sim
@@ -1155,6 +1188,118 @@ class Manager:
             out["tcp"] = totals
         return out
 
+    def _fabric_host_counters(self, h) -> tuple | None:
+        """One host's fabric counter tuple (trace/fabricstat.py
+        host_fabric_counters field order), from whichever path owns
+        its queues; None when the host never built a net plane."""
+        if h.plane is not None:
+            return self.plane.engine.fabric_counters(h.id)
+        if not h.net_built():
+            return None
+        from shadow_tpu.trace.fabricstat import host_fabric_counters
+        return host_fabric_counters(h)
+
+    def _fabric_sweep(self) -> tuple:
+        """ONE walk over every host's fabric counters: the
+        conservation ledger plus the hottest link's bits-sent/bw_up
+        ratio (link-seconds of uplink traffic — fabric_summary
+        divides by the sim duration for the utilization fraction).
+        For every host: CoDel packets/bytes enqueued must equal
+        forwarded + dropped + still-queued + relay-parked, and the
+        drop count must reconcile against the TEL_CODEL +
+        TEL_RTR_LIMIT attribution causes."""
+        from shadow_tpu.trace.events import TEL_CODEL, TEL_RTR_LIMIT
+        totals = {"enqueued_pkts": 0, "enqueued_bytes": 0,
+                  "delivered_pkts": 0, "delivered_bytes": 0,
+                  "dropped_pkts": 0, "dropped_bytes": 0,
+                  "marked_pkts": 0, "queued_pkts": 0,
+                  "queued_bytes": 0, "peak_queue_depth": 0,
+                  "refill_stalls": 0, "violations": 0}
+        max_link_s = 0.0
+        for h in self.hosts:
+            c = self._fabric_host_counters(h)
+            if c is None:
+                continue
+            (enq_p, enq_b, fwd_p, fwd_b, drop_p, drop_b, marked,
+             depth, qbytes, peak, r1s, r2s, _ps, bsent, _pr, _br,
+             park_p, park_b) = c
+            h.merge_native_counters()
+            totals["enqueued_pkts"] += enq_p
+            totals["enqueued_bytes"] += enq_b
+            totals["delivered_pkts"] += fwd_p
+            totals["delivered_bytes"] += fwd_b
+            totals["dropped_pkts"] += drop_p
+            totals["dropped_bytes"] += drop_b
+            totals["marked_pkts"] += marked
+            # a relay-parked packet is still inside the fabric:
+            # report it on the queued side of the ledger
+            totals["queued_pkts"] += depth + park_p
+            totals["queued_bytes"] += qbytes + park_b
+            totals["refill_stalls"] += r1s + r2s
+            totals["peak_queue_depth"] = max(
+                totals["peak_queue_depth"], peak)
+            if h.bw_up_bits:
+                max_link_s = max(max_link_s,
+                                 bsent * 8 / h.bw_up_bits)
+            attributed = (h.drop_causes[TEL_CODEL]
+                          + h.drop_causes[TEL_RTR_LIMIT])
+            if enq_p != fwd_p + drop_p + depth + park_p \
+                    or enq_b != fwd_b + drop_b + qbytes + park_b \
+                    or drop_p != attributed:
+                totals["violations"] += 1
+        return totals, max_link_s
+
+    def fabric_conservation(self) -> dict:
+        """The conservation ledger (always available — the counters
+        are on regardless of experimental.sim_fabricstat); the det
+        gate and the incast smoke reject violations != 0."""
+        return self._fabric_sweep()[0]
+
+    def collect_fct_rows(self) -> list:
+        """Every flow-lifecycle row in the sim: the per-host teardown
+        logs plus the still-associated sweep, from both planes.  The
+        caller (FabricChannel.write / the fct table) sorts."""
+        rows: list = []
+        if self.plane is not None:
+            rows.extend(tuple(r) for r in self.plane.engine.fct_flows())
+        from shadow_tpu.trace.fabricstat import object_host_flow_rows
+        for h in self.hosts:
+            if h.plane is None and h.net_built():
+                rows.extend(object_host_flow_rows(h))
+        return rows
+
+    def fabric_summary(self, end_time_ns: int) -> dict:
+        """bench.py's `fabric` block: conservation totals + peak queue
+        depth, the hottest link's utilization fraction, and FCT
+        percentiles where TCP flows exist.  Wall-side reporting only —
+        the deterministic counters it renders live in
+        metrics.sim.fabric."""
+        cons, max_link_s = self._fabric_sweep()
+        dur_s = end_time_ns / 1e9
+        util = max_link_s / dur_s if dur_s > 0 else 0.0
+        out = {
+            "peak_queue_depth": cons["peak_queue_depth"],
+            "refill_stalls": cons["refill_stalls"],
+            "link_utilization": round(util, 4),
+            "conservation": ("ok" if cons["violations"] == 0
+                             else f"{cons['violations']} violations"),
+        }
+        # One aggregate FCT row over every flow (bench headline);
+        # per-class detail stays in `trace fct`.  receiver_rows is the
+        # shared de-dup rule: one record per flow, receiver vantage.
+        from shadow_tpu.trace.fabricstat import (percentile,
+                                                 receiver_rows)
+        durs = sorted(r[1] - r[0]
+                      for r in receiver_rows(self.collect_fct_rows()))
+        if durs:
+            out["fct"] = {
+                "flows": len(durs),
+                "p50_ns": percentile(durs, 500),
+                "p99_ns": percentile(durs, 990),
+                "p999_ns": percentile(durs, 999),
+            }
+        return out
+
     def sc_disposition_totals(self) -> dict:
         """Syscall-observatory dispositions summed over hosts:
         SC name -> count (nonzero only).  Always available — the
@@ -1191,6 +1336,10 @@ class Manager:
             # kernel and append them at span commit (tcp_span only;
             # the phold family has no TCP connections to sample).
             runner.netstat = self.netstat
+        if self.fabric is not None:
+            # Both families buffer per-round queue samples in the
+            # kernel and append them at span commit.
+            runner.fabric = self.fabric
         return runner
 
     def make_dev_span_runner(self):
@@ -1371,6 +1520,22 @@ class Manager:
             reg.gauge("netstat.dropped", channel="sim").set(
                 self.netstat.dropped)
             self.netstat.write(base)
+        # Fabric observatory: the conservation counters are always on
+        # and live in the SIM channel (deterministic AND
+        # path-identical — the gate byte-diffs them; `violations`
+        # nonzero means an interface lost bytes the TEL_* causes
+        # cannot explain, which the det gate and the incast smoke
+        # reject).  The sample channel and the flow records only
+        # exist when the knob is on.
+        reg.ingest("fabric", self.fabric_conservation(), channel="sim")
+        if self.fabric is not None:
+            reg.gauge("fabric.records", channel="sim").set(
+                self.fabric.records)
+            reg.gauge("fabric.dropped", channel="sim").set(
+                self.fabric.dropped)
+            fct_rows = self.collect_fct_rows()
+            reg.gauge("fabric.flows", channel="sim").set(len(fct_rows))
+            self.fabric.write(base, fct_rows)
         # Syscall observatory: disposition counters are always on and
         # live in the SIM channel (deterministic per config; the gate
         # byte-diffs them — engine-resident apps dispatch C++-side and

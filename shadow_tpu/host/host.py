@@ -122,6 +122,11 @@ class Host:
         self.drop_causes = [0] * TEL_N
         self.drop_unattributed = 0
         self._native_causes_merged = (0,) * (TEL_N + 1)
+        # Fabric-observatory flow lifecycle (trace/fabricstat.py):
+        # FCT_REC field tuples of connections torn down before the
+        # artifact was written (netplane.cpp HostPlane::fct_log twin).
+        # Always on — appends happen only at connection teardown.
+        self.fct_log: list = []
         # Per-syscall-name histogram (sim_stats.rs syscall counts; merged
         # into sim-stats.json by the manager).
         self.syscall_counts: dict[str, int] = {}

@@ -251,6 +251,18 @@ class TcpSocket(StatusOwner):
         self.adjust_status(host, S_CLOSED, S_ACTIVE)
 
     def _teardown(self, host) -> None:
+        # Fabric-observatory flow lifecycle: teardown is the one event
+        # after which the association walk can no longer find this
+        # connection, so its FCT record is logged here (netplane.cpp
+        # tcp_teardown twin).  Still-associated flows are swept when
+        # the artifact is written; dataless flows leave no record.
+        if self._ifaces and self.conn is not None \
+                and self.local is not None and self.peer is not None:
+            from shadow_tpu.trace.fabricstat import flow_row
+            row = flow_row(host.id, self.local[1], self.peer[1],
+                           self.peer[0], self.conn)
+            if row is not None:
+                host.fct_log.append(row)
         for iface in self._ifaces:
             if self.local is not None:
                 if self.peer is not None:

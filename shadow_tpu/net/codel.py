@@ -27,7 +27,9 @@ def _control_time(first_above_time: int, count: int) -> int:
 
 class CoDelQueue:
     __slots__ = ("_q", "_bytes", "_dropping", "_count", "_last_count",
-                 "_first_above_time", "_drop_next", "dropped_count")
+                 "_first_above_time", "_drop_next", "dropped_count",
+                 "enqueued_count", "enqueued_bytes", "dropped_bytes",
+                 "peak_depth", "marked_count")
 
     def __init__(self):
         self._q: deque = deque()  # (packet, enqueue_time_ns)
@@ -38,23 +40,46 @@ class CoDelQueue:
         self._first_above_time = 0
         self._drop_next = 0
         self.dropped_count = 0
+        # Fabric-observatory counters (netplane.cpp CoDelN twins; the
+        # conservation invariant is enqueued == forwarded + dropped +
+        # still-queued, in both packets and bytes).  `enqueued` counts
+        # push ATTEMPTS — hard-limit refusals included — so the
+        # invariant holds with the refusal on the dropped side.
+        self.enqueued_count = 0
+        self.enqueued_bytes = 0
+        self.dropped_bytes = 0
+        self.peak_depth = 0
+        # ECN-ready: CoDel marks instead of drops once DCTCP lands
+        # (ROADMAP item 3); until then the counter stays 0 on every
+        # path, and the fabric channel already carries the slot.
+        self.marked_count = 0
 
     def __len__(self):
         return len(self._q)
 
+    def peek_entry(self):
+        """Head (packet, enqueue_time_ns) pair or None — the fabric
+        sampler's head-of-queue sojourn reading."""
+        return self._q[0] if self._q else None
+
     def _drop(self, packet, on_drop) -> None:
         packet.record(pkt.ST_ROUTER_DROPPED)
         self.dropped_count += 1
+        self.dropped_bytes += packet.total_size()
         if on_drop is not None:
             on_drop(packet)
 
     def push(self, packet, now: int, on_drop=None) -> bool:
         """Returns False (and drops) only at the hard limit."""
+        self.enqueued_count += 1
+        self.enqueued_bytes += packet.total_size()
         if len(self._q) >= HARD_LIMIT:
             self._drop(packet, on_drop)
             return False
         self._q.append((packet, now))
         self._bytes += packet.total_size()
+        if len(self._q) > self.peak_depth:
+            self.peak_depth = len(self._q)
         packet.record(pkt.ST_ROUTER_ENQUEUED)
         return True
 

@@ -22,7 +22,9 @@ _PENDING = 1
 
 
 class Relay:
-    __slots__ = ("name", "_bucket", "_state", "_pending_packet", "_pop_fn")
+    __slots__ = ("name", "_bucket", "_state", "_pending_packet",
+                 "_pop_fn", "stalls", "forwarded_pkts",
+                 "forwarded_bytes")
 
     def __init__(self, name: str, pop_fn, bucket: Optional[TokenBucket]):
         """`pop_fn(host, now)` pops the next packet from the source device;
@@ -32,6 +34,15 @@ class Relay:
         self._state = _IDLE
         self._pending_packet = None  # popped but not yet conforming
         self._pop_fn = pop_fn
+        # Fabric-observatory counters (netplane.cpp RelayN twins):
+        # packets parked waiting for a bucket refill (the "refill
+        # stall" series FB_REC samples), and packets/bytes actually
+        # forwarded — the inet-in relay's forwarded counters are the
+        # CoDel queue's "delivered" side of the byte-conservation
+        # invariant.
+        self.stalls = 0
+        self.forwarded_pkts = 0
+        self.forwarded_bytes = 0
 
     def notify(self, host) -> None:
         """Source device has packets; start forwarding unless a wakeup is
@@ -59,6 +70,7 @@ class Relay:
                     packet.total_size(), now)
                 if not ok:
                     # Park the packet and self-reschedule at refill time.
+                    self.stalls += 1
                     packet.record(pkt.ST_RELAY_CACHED)
                     self._pending_packet = packet
                     self._state = _PENDING
@@ -68,5 +80,7 @@ class Relay:
                         TaskRef(f"relay-{self.name}", self._wakeup))
                     return
             packet.record(pkt.ST_RELAY_FORWARDED)
+            self.forwarded_pkts += 1
+            self.forwarded_bytes += packet.total_size()
             dst = host.get_packet_device(packet.dst_ip)
             dst.push(host, packet)

@@ -54,3 +54,17 @@ class TokenBucket:
     def balance_at(self, now: int) -> int:
         self._advance(now)
         return self._balance
+
+    def peek_balance(self, now: int) -> int:
+        """Read-only balance at `now`: the value _advance(now) WOULD
+        leave, without mutating.  The fabric observatory samples
+        through this — sampling a virgin bucket must not anchor its
+        refill clock (the sim must be byte-identical with the channel
+        on or off).  Twins: netplane.cpp TokenBucketN::peek_balance
+        and the device kernels' bucket_peek."""
+        if self._next_refill_time == 0 or now < self._next_refill_time:
+            return self._balance
+        intervals = 1 + (now - self._next_refill_time) \
+            // self.refill_interval_ns
+        return min(self.capacity,
+                   self._balance + intervals * self.refill_size)

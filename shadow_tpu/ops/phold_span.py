@@ -90,6 +90,13 @@ TEL_NO_SOCKET = 5
 TEL_RECVBUF_FULL = 9
 TEL_N = 13
 
+# Fabric-observatory activity mask (netplane.cpp FB_ACT_* twins;
+# registered in analysis pass 1).
+FB_ACT_CODEL = 1
+FB_ACT_TB_OUT = 2
+FB_ACT_TB_IN = 4
+FB_ACT_LINK = 8
+
 PK_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
 
 # Abort reason bits: trace/outbox overflows are capacity problems the
@@ -134,6 +141,9 @@ RESIDENT_CARRIED = frozenset(
      "app_pkts_dropped", "app_pkts_recv", "app_pkts_sent",
      "app_sys", "codel_bytes", "drop_causes", "codel_count", "codel_drop_next",
      "codel_dropped", "codel_dropping", "codel_first_above",
+     "codel_enq_pkts", "codel_enq_bytes", "codel_drop_bytes",
+     "codel_peak", "r1_stalls", "r2_stalls",
+     "r1_fwd_pkts", "r1_fwd_bytes", "r2_fwd_pkts", "r2_fwd_bytes",
      "codel_last_count", "cq_enq", "cq_len", "cq_pos",
      "eth_brecv", "eth_bsent", "eth_precv", "eth_psent",
      "event_seq", "events_run", "ib_len", "ib_pos", "ib_seq",
@@ -165,6 +175,10 @@ class PholdSpanRunner(SpanMeshMixin):
     CAP_C = 2048  # CoDel ring (covers the engine's 1000-entry hard limit)
     CAP_P = 4096  # peers
     MAX_ROUNDS = 256
+    # Fabric observatory: per-round queue-sample rows buffered on
+    # device; spans clamp to FAB_ROWS rounds while the channel
+    # records so the (FAB_ROWS, H) buffers can never overflow.
+    FAB_ROWS = 64
 
     def __init__(self, engine, latency_ns, thresholds, host_node,
                  host_ips, seed, bootstrap_end, tracing: bool):
@@ -223,6 +237,12 @@ class PholdSpanRunner(SpanMeshMixin):
         # compile-vs-execute split survives capacity-regrow rebuilds.
         self.wall = None
         self._timed_fns: set = set()
+        # Fabric-observatory channel (trace/fabricstat.FabricChannel)
+        # or None: round_body buffers per-round per-host queue
+        # samples; the driver packs ACTIVE hosts into FB_REC records
+        # at span commit (the phold family has no TCP connections, so
+        # no netstat/FCT side here).
+        self.fabric = None
 
     # ------------------------------------------------------------------
     # Export bytes <-> numpy state
@@ -269,7 +289,9 @@ class PholdSpanRunner(SpanMeshMixin):
         st["codel_dropping"] = f("codel_dropping", np.uint8).astype(
             np.int32)
         st["codel_first_above"] = f("codel_first_above", np.int64)
-        for k in ("codel_count", "codel_last_count", "codel_drop_next"):
+        for k in ("codel_count", "codel_last_count", "codel_drop_next",
+                  "codel_enq_pkts", "codel_enq_bytes",
+                  "codel_drop_bytes", "codel_peak"):
             st[k] = f(k, np.int64)
         st["m_port"] = f("m_port", np.int32)
         st["n_peers"] = f("n_peers", np.int32)
@@ -297,7 +319,8 @@ class PholdSpanRunner(SpanMeshMixin):
                 np.int32)
             st[f"r{r}_unlimited"] = f(f"r{r}_unlimited",
                                       np.uint8).astype(np.int32)
-            for k in ("bal", "next", "refill", "cap"):
+            for k in ("bal", "next", "refill", "cap", "stalls",
+                      "fwd_pkts", "fwd_bytes"):
                 st[f"r{r}_{k}"] = f(f"r{r}_{k}", np.int64)
             st[f"r{r}_pk_valid"] = f(f"r{r}_pk_valid",
                                      np.uint8).astype(np.int32)
@@ -359,7 +382,9 @@ class PholdSpanRunner(SpanMeshMixin):
         for k in ("now", "event_seq", "packet_seq", "recv_bytes",
                   "send_bytes", "codel_bytes", "codel_count",
                   "codel_last_count", "codel_first_above",
-                  "codel_drop_next", "codel_dropped", "m_waitseq",
+                  "codel_drop_next", "codel_dropped",
+                  "codel_enq_pkts", "codel_enq_bytes",
+                  "codel_drop_bytes", "codel_peak", "m_waitseq",
                   "m_gotn", "s_waitseq", "s_senti", "s_exit_time"):
             out[k] = npv(k).astype(np.int64).tobytes()
         out["pkts_sent"] = npv("app_pkts_sent").astype(np.int64).tobytes()
@@ -390,6 +415,12 @@ class PholdSpanRunner(SpanMeshMixin):
                 np.int64).tobytes()
             out[f"r{r}_next"] = npv(f"r{r}_next").astype(
                 np.int64).tobytes()
+            out[f"r{r}_stalls"] = npv(f"r{r}_stalls").astype(
+                np.int64).tobytes()
+            out[f"r{r}_fwd_pkts"] = npv(f"r{r}_fwd_pkts").astype(
+                np.int64).tobytes()
+            out[f"r{r}_fwd_bytes"] = npv(f"r{r}_fwd_bytes").astype(
+                np.int64).tobytes()
             for kk in PK_KEYS:
                 out[f"r{r}_pk_{kk}"] = np.ascontiguousarray(
                     npv(f"r{r}_pk_{kk}")).tobytes()
@@ -401,10 +432,17 @@ class PholdSpanRunner(SpanMeshMixin):
     # The jitted multi-round step
     # ------------------------------------------------------------------
 
+    def _fabric_params(self):
+        """(enabled, interval_ns>=1) — static for the built kernel."""
+        if self.fabric is None:
+            return (False, 1)
+        return (True, max(int(self.fabric.interval_ns), 1))
+
     def _cached_build(self, P: int):
         key = (self._H, P, self._lat.shape, self.CAP_I, self.CAP_T,
                self.CAP_R, self.CAP_S, self.CAP_C, self.cap_out,
-               self.cap_tr, self.tracing, self.family, self.fused)
+               self.cap_tr, self.tracing, self.family, self.fused,
+               self._fabric_params())
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build(P)
@@ -422,6 +460,8 @@ class PholdSpanRunner(SpanMeshMixin):
         tracing = self.tracing
         family = self.family  # static: compiled per family
         fused = self.fused    # static: fused vs reference dispatch
+        fabric, fab_iv = self._fabric_params()
+        FABR = self.FAB_ROWS
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)  # mode="drop" sink for masked-out lanes
 
@@ -714,6 +754,9 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["codel_dropped"] = jnp.where(
                     codel_drop, st["codel_dropped"] + 1,
                     st["codel_dropped"])
+                st["codel_drop_bytes"] = jnp.where(
+                    codel_drop, st["codel_drop_bytes"] + st["_psize"],
+                    st["codel_drop_bytes"])
                 st["app_pkts_dropped"] = jnp.where(
                     codel_drop, st["app_pkts_dropped"] + 1,
                     st["app_pkts_dropped"])
@@ -729,6 +772,7 @@ class PholdSpanRunner(SpanMeshMixin):
             st, ok, when = bucket_try(st, r, now, has_pkt)
             throttled = has_pkt & ~ok
             st = dict(st)
+            st[f"r{r}_stalls"] = st[f"r{r}_stalls"] + throttled
             st[f"r{r}_pending"] = jnp.where(throttled, 1,
                                             st[f"r{r}_pending"])
             st[f"r{r}_pk_valid"] = jnp.where(throttled, 1,
@@ -741,6 +785,9 @@ class PholdSpanRunner(SpanMeshMixin):
             st = dict(st)
 
             fwd = has_pkt & ok
+            st[f"r{r}_fwd_pkts"] = st[f"r{r}_fwd_pkts"] + fwd
+            st[f"r{r}_fwd_bytes"] = st[f"r{r}_fwd_bytes"] \
+                + jnp.where(fwd, st["_psize"], jnp.int64(0))
             if r == 1:
                 # device_push(dev=2): cross-host send into the outbox
                 dslot = jnp.minimum(
@@ -1136,11 +1183,19 @@ class PholdSpanRunner(SpanMeshMixin):
             # with an rtr-limit breadcrumb (run_until twin).
             arr = due & pick_ib
             st["ib_pos"] = jnp.where(arr, pos + 1, pos)
+            st["codel_enq_pkts"] = jnp.where(
+                arr, st["codel_enq_pkts"] + 1, st["codel_enq_pkts"])
+            st["codel_enq_bytes"] = jnp.where(
+                arr, st["codel_enq_bytes"] + st["_psize"],
+                st["codel_enq_bytes"])
             limit_full = arr & (st["cq_len"] - st["cq_pos"]
                                 >= CODEL_HARD_LIMIT)
             st["codel_dropped"] = jnp.where(
                 limit_full, st["codel_dropped"] + 1,
                 st["codel_dropped"])
+            st["codel_drop_bytes"] = jnp.where(
+                limit_full, st["codel_drop_bytes"] + st["_psize"],
+                st["codel_drop_bytes"])
             st["app_pkts_dropped"] = jnp.where(
                 limit_full, st["app_pkts_dropped"] + 1,
                 st["app_pkts_dropped"])
@@ -1162,6 +1217,12 @@ class PholdSpanRunner(SpanMeshMixin):
                 et, mode="drop")
             st["cq_len"] = jnp.where(arr, st["cq_len"] + 1,
                                      st["cq_len"])
+            st["codel_peak"] = jnp.maximum(
+                st["codel_peak"],
+                jnp.where(arr,
+                          (st["cq_len"] - st["cq_pos"]).astype(
+                              jnp.int64),
+                          jnp.int64(0)))
             st["codel_bytes"] = jnp.where(
                 arr, st["codel_bytes"] + st["_psize"], st["codel_bytes"])
             go2 = arr & (st["r2_pending"] == 0)
@@ -1394,6 +1455,59 @@ class PholdSpanRunner(SpanMeshMixin):
                 micro_cond, micro_iter,
                 (st, window_end, jnp.int64(0)))
             st, n_out, min_lat = propagate(st, window_end)
+            if fabric:
+                # Fabric observatory at the round boundary: same
+                # grid-crossing rule as the engine's fab_sample_round
+                # and the object path (trace/fabricstat.py).
+                do = (start // np.int64(fab_iv)
+                      != window_end // np.int64(fab_iv))
+                row = jnp.where(do, st["fab_n"],
+                                jnp.int32(FABR + 8))
+                depth = (st["cq_len"] - st["cq_pos"]).astype(
+                    jnp.int64)
+                flags = (jnp.where(depth > 0, FB_ACT_CODEL, 0)
+                         | jnp.where(st["r1_pending"] == 1,
+                                     FB_ACT_TB_OUT, 0)
+                         | jnp.where(st["r2_pending"] == 1,
+                                     FB_ACT_TB_IN, 0)
+                         | jnp.where(st["eth_psent"]
+                                     + st["eth_precv"] > 0,
+                                     FB_ACT_LINK, 0))
+                head = st["cq_enq"][hidx, st["cq_pos"] % C]
+                sojourn = jnp.where(depth > 0, window_end - head,
+                                    jnp.int64(0))
+
+                def bucket_peek(r):
+                    nr = st[f"r{r}_next"]
+                    bal = st[f"r{r}_bal"]
+                    k = 1 + (window_end - nr) // np.int64(REFILL_NS)
+                    adv = jnp.minimum(st[f"r{r}_cap"],
+                                      bal + k * st[f"r{r}_refill"])
+                    return jnp.where((nr == 0) | (window_end < nr),
+                                     bal, adv)
+
+                st = dict(st)
+                st["fab_t"] = st["fab_t"].at[row].set(
+                    window_end, mode="drop")
+                st["fab_flags"] = st["fab_flags"].at[row].set(
+                    flags.astype(jnp.int32), mode="drop")
+                for name, val in (
+                        ("qdepth", depth),
+                        ("qbytes", st["codel_bytes"]),
+                        ("sojourn", sojourn),
+                        ("qenq", st["codel_enq_pkts"]),
+                        ("qdrops", st["codel_dropped"]),
+                        ("r1_bal", bucket_peek(1)),
+                        ("r1_stalls", st["r1_stalls"]),
+                        ("r2_bal", bucket_peek(2)),
+                        ("r2_stalls", st["r2_stalls"]),
+                        ("psent", st["eth_psent"]),
+                        ("bsent", st["eth_bsent"]),
+                        ("precv", st["eth_precv"]),
+                        ("brecv", st["eth_brecv"])):
+                    st[f"fab_{name}"] = st[f"fab_{name}"].at[
+                        row].set(val.astype(jnp.int64), mode="drop")
+                st["fab_n"] = st["fab_n"] + do.astype(jnp.int32)
             runahead = jnp.where(
                 (min_lat > 0) & (min_lat < runahead), min_lat,
                 runahead)
@@ -1452,6 +1566,16 @@ class PholdSpanRunner(SpanMeshMixin):
                               ("tr_reason", jnp.int32),
                               ("tr_owner", jnp.int32)):
                     st[k] = jnp.zeros(TR, dt)
+            if fabric:
+                st["fab_n"] = jnp.int32(0)
+                st["fab_t"] = jnp.zeros(FABR, jnp.int64)
+                st["fab_flags"] = jnp.zeros((FABR, H), jnp.int32)
+                for name in ("qdepth", "qbytes", "sojourn", "qenq",
+                             "qdrops", "r1_bal", "r1_stalls",
+                             "r2_bal", "r2_stalls", "psent", "bsent",
+                             "precv", "brecv"):
+                    st[f"fab_{name}"] = jnp.zeros((FABR, H),
+                                                  jnp.int64)
 
             carry = (st, jnp.int64(start), jnp.int64(runahead),
                      jnp.int64(0), jnp.int64(0), jnp.int64(0),
@@ -1519,7 +1643,8 @@ class PholdSpanRunner(SpanMeshMixin):
         boundary: all continuations idle, drains quiescent)."""
         import jax.numpy as jnp
         st = {k: v for k, v in self._res_st.items()
-              if k != "abort_code" and not k.startswith("tr_")}
+              if k != "abort_code" and not k.startswith("tr_")
+              and not k.startswith("fab_")}
         st.update(self._static_cols)
         z = np.zeros(self._H, np.int32)
         for k in ("cont", "then", "out_first", "cd_chain", "cd_sniff"):
@@ -1569,6 +1694,11 @@ class PholdSpanRunner(SpanMeshMixin):
         if self.mesh is not None:
             st = self._mesh_put(st)
         mr = self.MAX_ROUNDS if max_rounds is None else max_rounds
+        if self.fabric is not None:
+            # Sampled rounds <= rounds <= FAB_ROWS: the device-side
+            # sample buffers can never overflow (a silent skip would
+            # break cross-path byte-parity).
+            mr = min(mr, self.FAB_ROWS)
         w = self.wall
         for _grow in range(4):
             t0 = w.now() if w is not None else 0
@@ -1669,10 +1799,16 @@ class PholdSpanRunner(SpanMeshMixin):
                     np.int32).tobytes(),
             }
         t0 = w.now() if w is not None else 0
-        back = self._from_arrays(st_np)
+        # fab_* sample buffers are span-local output, not engine state.
+        back = self._from_arrays(
+            {k: v for k, v in st_np.items()
+             if not k.startswith("fab_")})
         self.engine.span_import_phold(
             back, self.CAP_I, self.CAP_T, self.CAP_R, self.CAP_S,
             self.CAP_C, self.CAP_P, traces)
+        if self.fabric is not None:
+            from shadow_tpu.trace.fabricstat import emit_device_rows
+            emit_device_rows(self.fabric, st_np, self._H)
         if w is not None:
             w.add("import", w.now() - t0, t0)
         # The import itself bumps the epoch; record it AFTER, so the

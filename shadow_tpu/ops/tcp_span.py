@@ -100,6 +100,14 @@ TEL_REASM_FULL = 11
 TEL_RECVWIN_TRUNC = 12
 TEL_N = 13
 
+# Fabric-observatory activity mask (netplane.cpp FB_ACT_* twins;
+# registered in analysis pass 1): a host's queues are sampled in a
+# round iff any bit is set.
+FB_ACT_CODEL = 1
+FB_ACT_TB_OUT = 2
+FB_ACT_TB_IN = 4
+FB_ACT_LINK = 8
+
 # Telemetry sample fields (trace/events.py TEL_REC order after the
 # identity header) -> the SoA column each samples.
 TEL_FIELDS = (("cwnd", "c_cwnd"), ("ssthresh", "c_ssthresh"),
@@ -168,16 +176,22 @@ RESIDENT_CARRIED = frozenset(
      "c_sackskip", "c_sblen", "c_sbmax", "c_segsrecv",
      "c_segssent", "c_sndnxt", "c_snduna", "c_sndwnd", "c_srtt",
      "c_ssa", "c_ssthresh", "c_status", "c_tmrdl", "c_tsrecent",
-     "c_wakep", "codel_bytes", "codel_count", "codel_drop_next",
+     "c_wakep", "c_fbyte", "c_lbyte", "c_bin", "c_bout",
+     "codel_bytes", "codel_count", "codel_drop_next",
      "codel_dropped", "codel_dropping", "codel_first_above",
-     "drop_causes",
+     "codel_enq_pkts", "codel_enq_bytes", "codel_drop_bytes",
+     "codel_peak", "drop_causes",
      "codel_last_count", "cq_enq", "cq_len", "cq_pos",
      "eth_brecv", "eth_bsent", "eth_precv", "eth_psent",
      "event_seq", "events_run", "ib_len", "ib_pos", "ib_seq",
      "ib_src", "ib_time", "now", "op_len", "op_pos", "packet_seq",
      "pkts_dropped", "pkts_recv", "pkts_sent", "r1_bal",
-     "r1_next", "r1_pending", "r1_pk_valid", "r2_bal", "r2_next",
-     "r2_pending", "r2_pk_valid", "ra_plen", "ra_seq", "ra_valid",
+     "r1_next", "r1_pending", "r1_pk_valid", "r1_stalls",
+     "r1_fwd_pkts", "r1_fwd_bytes",
+     "r2_bal", "r2_next",
+     "r2_pending", "r2_pk_valid", "r2_stalls",
+     "r2_fwd_pkts", "r2_fwd_bytes",
+     "ra_plen", "ra_seq", "ra_valid",
      "rtx_len", "rtx_plen", "rtx_pos", "rtx_rtxed", "rtx_sacked",
      "rtx_sent", "rtx_seq", "th_kind", "th_seq", "th_tgt",
      "th_time", "th_valid"}
@@ -208,6 +222,10 @@ class TcpSpanRunner(SpanMeshMixin):
     # <= rounds <= TEL_ROWS) — a silent skip would break cross-path
     # byte-parity.
     TEL_ROWS = 64
+    # Fabric observatory: per-round queue-sample rows buffered on
+    # device; spans clamp to FAB_ROWS rounds while the channel
+    # records (same overflow-proof rule as TEL_ROWS).
+    FAB_ROWS = 64
 
     def __init__(self, engine, latency_ns, thresholds, host_node,
                  host_ips, seed, bootstrap_end, tracing: bool):
@@ -269,6 +287,11 @@ class TcpSpanRunner(SpanMeshMixin):
         # records in the canonical (host, lport, rport, rip) order.
         self.netstat = None
         self._tel_ident = None  # (host, lport, rport, rip, perm, n)
+        # Fabric-observatory channel (trace/fabricstat.FabricChannel)
+        # or None: round_body buffers per-round per-host queue samples
+        # on device; the driver packs the ACTIVE hosts into FB_REC
+        # records at span commit.
+        self.fabric = None
 
     def _caps(self):
         return (self.CAP_I, self.CAP_T, self.CAP_CQ, self.CAP_RT,
@@ -304,9 +327,10 @@ class TcpSpanRunner(SpanMeshMixin):
         for k in ("now", "event_seq", "packet_seq", "bw_up", "bw_down",
                   "codel_bytes", "codel_count", "codel_last_count",
                   "codel_first_above", "codel_drop_next",
-                  "codel_dropped", "pkts_sent", "pkts_recv",
-                  "pkts_dropped", "events_run", "eth_psent",
-                  "eth_precv", "eth_bsent", "eth_brecv"):
+                  "codel_dropped", "codel_enq_pkts", "codel_enq_bytes",
+                  "codel_drop_bytes", "codel_peak", "pkts_sent",
+                  "pkts_recv", "pkts_dropped", "events_run",
+                  "eth_psent", "eth_precv", "eth_bsent", "eth_brecv"):
             st[k] = f(k, np.int64)
         st["eth_ip"] = f("eth_ip", np.uint32)
         st["codel_dropping"] = f("codel_dropping", np.uint8).astype(
@@ -319,7 +343,8 @@ class TcpSpanRunner(SpanMeshMixin):
                 np.int32)
             st[f"r{r}_unlimited"] = f(f"r{r}_unlimited",
                                       np.uint8).astype(np.int32)
-            for k in ("bal", "next", "refill", "cap"):
+            for k in ("bal", "next", "refill", "cap", "stalls",
+                      "fwd_pkts", "fwd_bytes"):
                 st[f"r{r}_{k}"] = f(f"r{r}_{k}", np.int64)
             st[f"r{r}_pk_valid"] = f(f"r{r}_pk_valid",
                                      np.uint8).astype(np.int32)
@@ -359,7 +384,8 @@ class TcpSpanRunner(SpanMeshMixin):
                   "c_rttvar", "c_rto", "c_rtodl", "c_tsrecent",
                   "c_segssent", "c_segsrecv", "c_rtxcount",
                   "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
-                  "c_atlast", "c_awaitseq", "c_agot", "c_atotal"):
+                  "c_atlast", "c_awaitseq", "c_agot", "c_atotal",
+                  "c_fbyte", "c_lbyte", "c_bin", "c_bout"):
             st[k] = f(k, np.int64)
         st["rtx_len"] = f("rtx_len", np.int32)
         st["rtx_seq"] = f("rtx_seq", np.uint32, (CC, RT))
@@ -466,9 +492,10 @@ class TcpSpanRunner(SpanMeshMixin):
         for k in ("now", "event_seq", "packet_seq", "codel_bytes",
                   "codel_count", "codel_last_count",
                   "codel_first_above", "codel_drop_next",
-                  "codel_dropped", "pkts_sent", "pkts_recv",
-                  "pkts_dropped", "events_run", "eth_psent",
-                  "eth_precv", "eth_bsent", "eth_brecv"):
+                  "codel_dropped", "codel_enq_pkts", "codel_enq_bytes",
+                  "codel_drop_bytes", "codel_peak", "pkts_sent",
+                  "pkts_recv", "pkts_dropped", "events_run",
+                  "eth_psent", "eth_precv", "eth_bsent", "eth_brecv"):
             out[k] = npv(k).astype(np.int64).tobytes()
         out["codel_dropping"] = npv("codel_dropping").astype(
             np.uint8).tobytes()
@@ -480,6 +507,12 @@ class TcpSpanRunner(SpanMeshMixin):
             out[f"r{r}_bal"] = npv(f"r{r}_bal").astype(
                 np.int64).tobytes()
             out[f"r{r}_next"] = npv(f"r{r}_next").astype(
+                np.int64).tobytes()
+            out[f"r{r}_stalls"] = npv(f"r{r}_stalls").astype(
+                np.int64).tobytes()
+            out[f"r{r}_fwd_pkts"] = npv(f"r{r}_fwd_pkts").astype(
+                np.int64).tobytes()
+            out[f"r{r}_fwd_bytes"] = npv(f"r{r}_fwd_bytes").astype(
                 np.int64).tobytes()
             for kk in PK_KEYS:
                 out[f"r{r}_pk_{kk}"] = np.ascontiguousarray(
@@ -498,7 +531,8 @@ class TcpSpanRunner(SpanMeshMixin):
                   "c_rttvar", "c_rto", "c_rtodl", "c_tsrecent",
                   "c_segssent", "c_segsrecv", "c_rtxcount",
                   "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
-                  "c_atlast", "c_awaitseq", "c_agot"):
+                  "c_atlast", "c_awaitseq", "c_agot",
+                  "c_fbyte", "c_lbyte", "c_bin", "c_bout"):
             out[k] = npv(k).astype(np.int64).tobytes()
         for k in ("c_ssa", "c_dupacks", "c_rtobackoff"):
             out[k] = npv(k).astype(np.int32).tobytes()
@@ -516,10 +550,16 @@ class TcpSpanRunner(SpanMeshMixin):
             return (False, 1)
         return (True, max(int(self.netstat.interval_ns), 1))
 
+    def _fabric_params(self):
+        """(enabled, interval_ns>=1) — static for the built kernel."""
+        if self.fabric is None:
+            return (False, 1)
+        return (True, max(int(self.fabric.interval_ns), 1))
+
     def _cached_build(self):
         key = (self._H, self._CC, self._caps(), self.cap_out,
                self.cap_tr, self.tracing, self.fused,
-               self._netstat_params())
+               self._netstat_params(), self._fabric_params())
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build()
@@ -538,6 +578,8 @@ class TcpSpanRunner(SpanMeshMixin):
         fused = self.fused    # static: fused vs reference dispatch
         netstat, tel_iv = self._netstat_params()
         TELR = self.TEL_ROWS
+        fabric, fab_iv = self._fabric_params()
+        FABR = self.FAB_ROWS
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)
         COOB = jnp.int32(CC + 1)
@@ -618,6 +660,21 @@ class TcpSpanRunner(SpanMeshMixin):
             for key, v in vals.items():
                 st[key] = st[key].at[rows].set(v, mode="drop")
             return st
+
+        def fct_touch(st, mask, nbytes, inbound):
+            """Flow-lifecycle update (connection.py _fct_touch twin):
+            first/last data-byte stamps plus the byte counter, on the
+            masked lanes' cur conns."""
+            now = st["now"]
+            fb = cg(st, "c_fbyte")
+            key = "c_bin" if inbound else "c_bout"
+            vals = {
+                "c_fbyte": jnp.where(mask & (fb < 0), now, fb),
+                "c_lbyte": jnp.where(mask, now, cg(st, "c_lbyte")),
+                key: cg(st, key) + jnp.where(mask, nbytes,
+                                             jnp.int64(0)),
+            }
+            return cset(st, mask, **vals)
 
         # -------- trace / outbox appends (flat buffers) --------------
 
@@ -860,6 +917,7 @@ class TcpSpanRunner(SpanMeshMixin):
             st, ok, when = bucket_try(st, 1, now, has_pkt, size)
             throttled = has_pkt & ~ok
             st = dict(st)
+            st["r1_stalls"] = st["r1_stalls"] + throttled
             st["r1_pending"] = jnp.where(throttled, 1,
                                          st["r1_pending"])
             st["r1_pk_valid"] = jnp.where(throttled, 1,
@@ -873,6 +931,9 @@ class TcpSpanRunner(SpanMeshMixin):
             st = dict(st)
 
             fwd = has_pkt & ok
+            st["r1_fwd_pkts"] = st["r1_fwd_pkts"] + fwd
+            st["r1_fwd_bytes"] = st["r1_fwd_bytes"] \
+                + jnp.where(fwd, size, jnp.int64(0))
             # device_push(dev=2): dst must be a remote engine host
             dslot = jnp.minimum(
                 jnp.searchsorted(st["_ips_sorted"], pk["dip"]), H - 1)
@@ -989,6 +1050,9 @@ class TcpSpanRunner(SpanMeshMixin):
             st["codel_dropped"] = jnp.where(
                 codel_drop, st["codel_dropped"] + 1,
                 st["codel_dropped"])
+            st["codel_drop_bytes"] = jnp.where(
+                codel_drop, st["codel_drop_bytes"] + size,
+                st["codel_drop_bytes"])
             st["pkts_dropped"] = jnp.where(
                 codel_drop, st["pkts_dropped"] + 1,
                 st["pkts_dropped"])
@@ -1002,6 +1066,7 @@ class TcpSpanRunner(SpanMeshMixin):
             st, ok, when = bucket_try(st, 2, now, has_pkt, size)
             throttled = has_pkt & ~ok
             st = dict(st)
+            st["r2_stalls"] = st["r2_stalls"] + throttled
             st["r2_pending"] = jnp.where(throttled, 1,
                                          st["r2_pending"])
             st["r2_pk_valid"] = jnp.where(throttled, 1,
@@ -1015,6 +1080,9 @@ class TcpSpanRunner(SpanMeshMixin):
             st = dict(st)
 
             fwd = has_pkt & ok
+            st["r2_fwd_pkts"] = st["r2_fwd_pkts"] + fwd
+            st["r2_fwd_bytes"] = st["r2_fwd_bytes"] \
+                + jnp.where(fwd, size, jnp.int64(0))
             # iface_receive: eth counters, then the association match
             st["eth_precv"] = jnp.where(fwd, st["eth_precv"] + 1,
                                         st["eth_precv"])
@@ -1334,6 +1402,8 @@ class TcpSpanRunner(SpanMeshMixin):
                       c_rcvnxt=jnp.where(
                           inord, s_add(cg(st, "c_rcvnxt"), take),
                           cg(st, "c_rcvnxt")))
+            st = fct_touch(st, inord & (take > 0), take,
+                           inbound=True)
             # ---- continuation ----
             st = dict(st)
             nxt = jnp.where(
@@ -1366,6 +1436,7 @@ class TcpSpanRunner(SpanMeshMixin):
                       c_rcvnxt=jnp.where(
                           has, s_add(cg(st, "c_rcvnxt"), take),
                           cg(st, "c_rcvnxt")))
+            st = fct_touch(st, has & (take > 0), take, inbound=True)
             st = dict(st)
             rr = crows(st, has)
             st["ra_valid"] = st["ra_valid"].at[rr, slot].set(
@@ -1415,6 +1486,7 @@ class TcpSpanRunner(SpanMeshMixin):
                       c_sndnxt=jnp.where(
                           do, s_add(cg(st, "c_sndnxt"), chunk),
                           cg(st, "c_sndnxt")))
+            st = fct_touch(st, do, chunk, inbound=False)
             stop = mask & ~do
             # zero-window persist arming
             cur = jnp.clip(st["cur"], 0, CC - 1)
@@ -1619,6 +1691,8 @@ class TcpSpanRunner(SpanMeshMixin):
                           probe, s_add(cg(st, "c_sndnxt"),
                                        jnp.int64(1)),
                           cg(st, "c_sndnxt")))
+            st = fct_touch(st, probe, jnp.ones(H, jnp.int64),
+                           inbound=False)
             niv = jnp.minimum(
                 jnp.where(cg(st, "c_persistiv") > 0,
                           2 * cg(st, "c_persistiv"),
@@ -1687,11 +1761,19 @@ class TcpSpanRunner(SpanMeshMixin):
             pk_arr = {kk: st[f"ib_{kk}"][hidx, safe]
                       for kk in PK_KEYS}
             size = s_i64(pk_arr["plen"]) + TCP_TOTAL_HDR
+            st["codel_enq_pkts"] = jnp.where(
+                arr, st["codel_enq_pkts"] + 1, st["codel_enq_pkts"])
+            st["codel_enq_bytes"] = jnp.where(
+                arr, st["codel_enq_bytes"] + size,
+                st["codel_enq_bytes"])
             limit_full = arr & (st["cq_len"] - st["cq_pos"]
                                 >= CODEL_HARD_LIMIT)
             st["codel_dropped"] = jnp.where(
                 limit_full, st["codel_dropped"] + 1,
                 st["codel_dropped"])
+            st["codel_drop_bytes"] = jnp.where(
+                limit_full, st["codel_drop_bytes"] + size,
+                st["codel_drop_bytes"])
             st["pkts_dropped"] = jnp.where(
                 limit_full, st["pkts_dropped"] + 1,
                 st["pkts_dropped"])
@@ -1713,6 +1795,10 @@ class TcpSpanRunner(SpanMeshMixin):
                 et, mode="drop")
             st["cq_len"] = jnp.where(arr, st["cq_len"] + 1,
                                      st["cq_len"])
+            st["codel_peak"] = jnp.maximum(
+                st["codel_peak"],
+                jnp.where(arr, s_i64(st["cq_len"] - st["cq_pos"]),
+                          jnp.int64(0)))
             st["codel_bytes"] = jnp.where(
                 arr, st["codel_bytes"] + size, st["codel_bytes"])
             go2 = arr & (st["r2_pending"] == 0)
@@ -1951,6 +2037,59 @@ class TcpSpanRunner(SpanMeshMixin):
                     st[f"tel_{name}"] = st[f"tel_{name}"].at[row].set(
                         st[srccol].astype(jnp.int64), mode="drop")
                 st["tel_n"] = st["tel_n"] + do.astype(jnp.int32)
+            if fabric:
+                # Fabric observatory at the round boundary: same
+                # grid-crossing rule as the engine's fab_sample_round
+                # and the object path; the activity mask is computed
+                # per host and the driver filters inactive rows.
+                do = (start // np.int64(fab_iv)
+                      != window_end // np.int64(fab_iv))
+                row = jnp.where(do, st["fab_n"],
+                                jnp.int32(FABR + 8))
+                depth = s_i64(st["cq_len"] - st["cq_pos"])
+                flags = (jnp.where(depth > 0, FB_ACT_CODEL, 0)
+                         | jnp.where(st["r1_pending"] == 1,
+                                     FB_ACT_TB_OUT, 0)
+                         | jnp.where(st["r2_pending"] == 1,
+                                     FB_ACT_TB_IN, 0)
+                         | jnp.where(st["eth_psent"]
+                                     + st["eth_precv"] > 0,
+                                     FB_ACT_LINK, 0))
+                head = st["cq_enq"][hidx, st["cq_pos"] % CQ]
+                sojourn = jnp.where(depth > 0, window_end - head,
+                                    jnp.int64(0))
+
+                def bucket_peek(r):
+                    nr = st[f"r{r}_next"]
+                    bal = st[f"r{r}_bal"]
+                    k = 1 + (window_end - nr) // np.int64(REFILL_NS)
+                    adv = jnp.minimum(st[f"r{r}_cap"],
+                                      bal + k * st[f"r{r}_refill"])
+                    return jnp.where((nr == 0) | (window_end < nr),
+                                     bal, adv)
+
+                st = dict(st)
+                st["fab_t"] = st["fab_t"].at[row].set(
+                    window_end, mode="drop")
+                st["fab_flags"] = st["fab_flags"].at[row].set(
+                    flags.astype(jnp.int32), mode="drop")
+                for name, val in (
+                        ("qdepth", depth),
+                        ("qbytes", st["codel_bytes"]),
+                        ("sojourn", sojourn),
+                        ("qenq", st["codel_enq_pkts"]),
+                        ("qdrops", st["codel_dropped"]),
+                        ("r1_bal", bucket_peek(1)),
+                        ("r1_stalls", s_i64(st["r1_stalls"])),
+                        ("r2_bal", bucket_peek(2)),
+                        ("r2_stalls", s_i64(st["r2_stalls"])),
+                        ("psent", st["eth_psent"]),
+                        ("bsent", st["eth_bsent"]),
+                        ("precv", st["eth_precv"]),
+                        ("brecv", st["eth_brecv"])):
+                    st[f"fab_{name}"] = st[f"fab_{name}"].at[
+                        row].set(val.astype(jnp.int64), mode="drop")
+                st["fab_n"] = st["fab_n"] + do.astype(jnp.int32)
             runahead = jnp.where(
                 (min_lat > 0) & (min_lat < runahead), min_lat,
                 runahead)
@@ -2004,6 +2143,16 @@ class TcpSpanRunner(SpanMeshMixin):
                 st["tel_t"] = jnp.zeros(TELR, jnp.int64)
                 for name, _src in TEL_FIELDS:
                     st[f"tel_{name}"] = jnp.zeros((TELR, CC),
+                                                  jnp.int64)
+            if fabric:
+                st["fab_n"] = jnp.int32(0)
+                st["fab_t"] = jnp.zeros(FABR, jnp.int64)
+                st["fab_flags"] = jnp.zeros((FABR, H), jnp.int32)
+                for name in ("qdepth", "qbytes", "sojourn", "qenq",
+                             "qdrops", "r1_bal", "r1_stalls",
+                             "r2_bal", "r2_stalls", "psent", "bsent",
+                             "precv", "brecv"):
+                    st[f"fab_{name}"] = jnp.zeros((FABR, H),
                                                   jnp.int64)
             if tracing:
                 st["tr_n"] = jnp.int64(0)
@@ -2095,7 +2244,8 @@ class TcpSpanRunner(SpanMeshMixin):
         st = {k: v for k, v in self._res_st.items()
               if k not in ("abort_code", "abort_site")
               and not k.startswith("tr_")
-              and not k.startswith("tel_")}
+              and not k.startswith("tel_")
+              and not k.startswith("fab_")}
         st.update(self._static_cols)
         n = self._static_cols["_n_conns"]
         for k in ("cont", "then", "ret"):
@@ -2138,6 +2288,14 @@ class TcpSpanRunner(SpanMeshMixin):
         for name, _src in TEL_FIELDS:
             arr[name] = st_np[f"tel_{name}"][:tn][:, perm].reshape(-1)
         self.netstat.extend(arr.tobytes())
+
+    def _emit_fabric(self, st_np) -> None:
+        """Pack the span's device-sampled queue rows into FB_REC
+        records — per sampled round, ACTIVE hosts in ascending id
+        order — and append them to the channel.  Byte-identical to
+        the engine ring's records for the same rounds."""
+        from shadow_tpu.trace.fabricstat import emit_device_rows
+        emit_device_rows(self.fabric, st_np, self._H)
 
     def try_span(self, start: int, stop: int, limit: int,
                  runahead: int, dynamic: bool,
@@ -2199,6 +2357,8 @@ class TcpSpanRunner(SpanMeshMixin):
             # telemetry buffers can never overflow (a silent skip
             # would break cross-path byte-parity).
             mr = min(mr, self.TEL_ROWS)
+        if self.fabric is not None:
+            mr = min(mr, self.FAB_ROWS)  # same overflow-proof clamp
         w = self.wall
         for _grow in range(4):
             _tw = w.now() if w is not None else 0
@@ -2305,12 +2465,15 @@ class TcpSpanRunner(SpanMeshMixin):
             }
         st_np["_n_conns"] = n_conns
         _tw = w.now() if w is not None else 0
-        # tel_* sample buffers are span-local output, not engine state.
+        # tel_*/fab_* sample buffers are span-local output, not
+        # engine state.
         back = self._from_arrays(
             {k: v for k, v in st_np.items()
-             if not k.startswith("tel_")})
+             if not k.startswith("tel_")
+             and not k.startswith("fab_")})
         self.engine.span_import_tcp(back, *self._caps(), traces)
         self._emit_netstat(st_np)
+        self._emit_fabric(st_np)
         if w is not None:
             w.add("import", w.now() - _tw, _tw)
         # Record AFTER the import's own epoch bump: the resident copy

@@ -245,6 +245,24 @@ class TcpConnection:
         # per-packet delta into the host's drop-cause counters.
         self.reasm_discards = 0
         self.rcvwin_trunc = 0
+        # Fabric-observatory flow lifecycle (netplane.cpp TcpConn
+        # twins; trace/fabricstat.py packs them into FCT_REC records):
+        # first/last simulated ns any payload byte was FIRST-sent or
+        # delivered in order on this endpoint, plus the byte counts.
+        # Retransmissions touch neither — bytes_out is the flow size.
+        self.fct_first = -1
+        self.fct_last = -1
+        self.fct_bytes_in = 0
+        self.fct_bytes_out = 0
+
+    def _fct_touch(self, nbytes: int, now: int, inbound: bool) -> None:
+        if self.fct_first < 0:
+            self.fct_first = now
+        self.fct_last = now
+        if inbound:
+            self.fct_bytes_in += nbytes
+        else:
+            self.fct_bytes_out += nbytes
 
     # Congestion variables live on the algorithm object; these views
     # keep call sites and tests readable.
@@ -402,6 +420,7 @@ class TcpConnection:
         self._emit(TcpFlags.ACK | TcpFlags.PSH, seq=self.snd_nxt,
                    payload=chunk, now=now, track=True)
         self.snd_nxt = seq_add(self.snd_nxt, 1)
+        self._fct_touch(1, now, inbound=False)
         self._persist_interval = min(self._persist_interval * 2
                                      or self.rto, MAX_RTO_NS)
         self._persist_deadline = now + self._persist_interval
@@ -786,9 +805,16 @@ class TcpConnection:
             return
         # In-order: deliver, then drain any contiguous stashed segments.
         had_holes = bool(self.reassembly)
+        rcv0 = self.rcv_nxt
         self._deliver(payload)
         while self.rcv_nxt in self.reassembly:
             self._deliver(self.reassembly.pop(self.rcv_nxt))
+        # Fabric-observatory flow lifecycle: the rcv_nxt advance IS the
+        # in-order delivered byte count (computed before any FIN
+        # consumes its sequence slot below).
+        delivered = seq_sub(self.rcv_nxt, rcv0)
+        if delivered > 0:
+            self._fct_touch(delivered, now, inbound=True)
         # An out-of-order FIN becomes processable once the gap fills.
         if self.pending_fin_seq == self.rcv_nxt:
             self._process_fin(now)
@@ -876,6 +902,7 @@ class TcpConnection:
             self._emit(TcpFlags.ACK | TcpFlags.PSH, seq=self.snd_nxt,
                        payload=chunk, now=now, track=True)
             self.snd_nxt = seq_add(self.snd_nxt, len(chunk))
+            self._fct_touch(len(chunk), now, inbound=False)
         if self.snd_wnd == 0 and self.send_buf and not self.rtx \
                 and self._persist_deadline is None \
                 and self.state in (ESTABLISHED, CLOSE_WAIT, FIN_WAIT_1):

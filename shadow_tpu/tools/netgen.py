@@ -260,6 +260,57 @@ def tcp_stream_yaml(n_hosts: int, n_servers: int | None = None,
             f"hosts:\n" + "\n".join(blocks) + "\n")
 
 
+def incast_yaml(fan_in: int, nbytes: int = 500_000,
+                server_bw: str = "20 Mbit", client_bw: str = "100 Mbit",
+                latency: str = "2 ms", stop_time: str = "3s",
+                seed: int = 17, scheduler: str = "serial",
+                device_spans: str | None = None) -> str:
+    """Minimal N->1 fan-in (incast): ONE sink host runs `fan_in`
+    tgen-client downloads — one from each of `fan_in` source servers —
+    all opened at the SAME instant, with the sink's downlink as the
+    shared bottleneck.  The N response streams converge on the sink's
+    inbound router queue: the canonical queue-buildup smoke for the
+    fabric observatory (CoDel depth climbs, head sojourn crosses the
+    5 ms target, the control law drops, and every drop must reconcile
+    in the byte-conservation sweep).  The full datacenter scenario
+    pack stays ROADMAP item 3; this is just the stressor the fabric
+    channel's conservation gate runs against
+    (tests/test_fabricstat.py, `trace fabric`)."""
+    gml_lines = ["graph [ directed 0",
+                 f'  node [ id 0 host_bandwidth_down "{server_bw}" '
+                 f'host_bandwidth_up "{server_bw}" ]',
+                 f'  node [ id 1 host_bandwidth_down "{client_bw}" '
+                 f'host_bandwidth_up "{client_bw}" ]',
+                 f'  edge [ source 0 target 0 latency "{latency}" ]',
+                 f'  edge [ source 1 target 1 latency "{latency}" ]',
+                 f'  edge [ source 0 target 1 latency "{latency}" ]',
+                 "]"]
+    gml = "\n".join(gml_lines)
+    sink_procs = []
+    for i in range(fan_in):
+        sink_procs.append(
+            f'      - {{ path: tgen-client, '
+            f'args: [src{i:03d}, "8080", "{nbytes}", "1"], '
+            f"start_time: 100ms, expected_final_state: any }}")
+    blocks = ["  sink:\n    network_node_id: 0\n    processes:\n"
+              + "\n".join(sink_procs)]
+    for i in range(fan_in):
+        blocks.append(
+            f"  src{i:03d}:\n    network_node_id: 1\n    processes:\n"
+            f'      - {{ path: tgen-server, args: ["8080"], '
+            f"expected_final_state: running }}")
+    exp = [f"  scheduler: {scheduler}",
+           "  socket_send_autotune: false",
+           "  socket_recv_autotune: false"]
+    if device_spans is not None:
+        exp.append(f"  tpu_device_spans: {device_spans}")
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+            f"network:\n  graph:\n    type: gml\n    inline: |\n"
+            f"{_indent(gml, '      ')}\n"
+            f"experimental:\n" + "\n".join(exp) + "\n"
+            f"hosts:\n" + "\n".join(blocks) + "\n")
+
+
 def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
                    nbytes: int = 100_000, count: int = 1,
                    stop_time: str = "60s", seed: int = 1,

@@ -3,9 +3,18 @@
     python -m shadow_tpu.tools.trace DATA_DIR            # summarize
     python -m shadow_tpu.tools.trace DATA_DIR --chrome out.json
     python -m shadow_tpu.tools.trace net DATA_DIR        # TCP report
+    python -m shadow_tpu.tools.trace fabric DATA_DIR     # queue report
+    python -m shadow_tpu.tools.trace fct DATA_DIR        # FCT table
     python -m shadow_tpu.tools.trace explain DATA_DIR    # remediation
     python -m shadow_tpu.tools.trace --run sim.yaml      # run + summarize
     python -m shadow_tpu.tools.trace --smoke [--hosts N] # CI smoke
+
+`fabric` prints the fabric-observatory report: per-link utilization,
+the queue-depth table (top links by peak sampled CoDel depth, with
+sojourn/drop/stall series) and the byte-conservation verdict
+(per-interface bytes enqueued == delivered + dropped + queued, drops
+reconciled against the TEL_* causes).  `fct` prints the
+flow-completion-time percentile table per flow class (service port).
 
 `net` prints the sim-netstat report: the drop-attribution table with
 its conservation check (per-cause counters must sum to the sim's
@@ -69,7 +78,12 @@ def _load(data_dir: str):
     if os.path.exists(sc_path):
         with open(sc_path, "rb") as f:
             sc_bytes = f.read()
-    return stats, sim_bytes, wall, tel_bytes, sc_bytes
+    fab_bytes = b""
+    fab_path = os.path.join(data_dir, "fabric-sim.bin")
+    if os.path.exists(fab_path):
+        with open(fab_path, "rb") as f:
+            fab_bytes = f.read()
+    return stats, sim_bytes, wall, tel_bytes, sc_bytes, fab_bytes
 
 
 def summarize(data_dir: str, chrome_out: str | None = None,
@@ -84,7 +98,8 @@ def summarize(data_dir: str, chrome_out: str | None = None,
                                          FR_SPAN_ABORT, FR_SPAN_COMMIT,
                                          FR_SPAN_START, iter_records)
 
-    stats, sim_bytes, wall, tel_bytes, sc_bytes = _load(data_dir)
+    stats, sim_bytes, wall, tel_bytes, sc_bytes, fab_bytes = \
+        _load(data_dir)
     rounds = stats.get("rounds", 0)
     metrics = stats.get("metrics", {})
     elig = metrics.get("wall", {}).get("eligibility", {})
@@ -126,7 +141,13 @@ def summarize(data_dir: str, chrome_out: str | None = None,
 
     if chrome_out is not None:
         from shadow_tpu.trace.chrome import chrome_trace
-        doc = chrome_trace(sim_bytes, wall, tel_bytes, sc_bytes)
+        from shadow_tpu.trace.events import split_fabric
+        fb = b""
+        if fab_bytes:
+            fb, _fct = split_fabric(fab_bytes)
+        top_n = _chrome_top_n(data_dir)
+        doc = chrome_trace(sim_bytes, wall, tel_bytes, sc_bytes, fb,
+                           top_n)
         with open(chrome_out, "w") as f:
             json.dump(doc, f)
         print(f"chrome trace: {chrome_out} "
@@ -176,7 +197,7 @@ def net_report(data_dir: str, top_n: int = 10, out=None) -> bool:
     from shadow_tpu.trace.netstat import (group_by_conn,
                                           top_by_retransmits)
 
-    stats, _sim, _wall, tel_bytes, _sc = _load(data_dir)
+    stats, _sim, _wall, tel_bytes, _sc, _fab = _load(data_dir)
     ok = drop_report(stats, out=out)
 
     if not tel_bytes:
@@ -202,6 +223,143 @@ def net_report(data_dir: str, top_n: int = 10, out=None) -> bool:
               f"{max(r[11] for r in recs):>8} "
               f"{max(r[12] for r in recs):>8}", file=out)
     return ok
+
+
+def _chrome_top_n(data_dir: str) -> int:
+    """The experimental.chrome_top_n knob from the processed config
+    (shared by every per-entity counter-track family)."""
+    from shadow_tpu.trace.chrome import DEFAULT_TOP_N
+    exp = _processed_config(data_dir).get("experimental") or {}
+    try:
+        return max(int(exp.get("chrome_top_n", DEFAULT_TOP_N)), 1)
+    except (TypeError, ValueError):
+        return DEFAULT_TOP_N
+
+
+def fabric_report(data_dir: str, top_n: int = 10, out=None) -> bool:
+    """`trace fabric`: per-link utilization + queue-depth table +
+    the byte-conservation verdict.  Returns False on a conservation
+    violation (the gate's exit code)."""
+    if out is None:
+        out = sys.stdout
+    from shadow_tpu.trace.events import iter_fb_records, split_fabric
+    from shadow_tpu.trace.fabricstat import (group_by_host,
+                                             top_by_peak_depth)
+
+    stats, _sim, _wall, _tel, _sc, fab_bytes = _load(data_dir)
+    fab = stats.get("metrics", {}).get("sim", {}).get("fabric", {})
+    viol = fab.get("violations")
+    print("fabric observatory (per-interface byte conservation):",
+          file=out)
+    for key in ("enqueued_pkts", "delivered_pkts", "dropped_pkts",
+                "queued_pkts", "enqueued_bytes", "delivered_bytes",
+                "dropped_bytes", "queued_bytes", "peak_queue_depth",
+                "refill_stalls", "marked_pkts"):
+        if key in fab:
+            print(f"  {key:<18} {fab[key]:>14}", file=out)
+    ok = viol == 0
+    if viol is None:
+        print("  (no fabric block in sim-stats.json — pre-fabric "
+              "artifact?)", file=out)
+        ok = False
+    elif ok:
+        print("  conservation: enqueued == delivered + dropped + "
+              "queued on every interface, drops reconciled against "
+              "the TEL_* causes", file=out)
+    else:
+        print(f"  conservation: {viol} interface(s) VIOLATED — bytes "
+              f"lost outside the attributed drop causes", file=out)
+
+    if not fab_bytes:
+        print("fabric channel: absent (run with "
+              "experimental.sim_fabricstat: on)", file=out)
+        return ok
+    fb, _fct = split_fabric(fab_bytes)
+    by_host = group_by_host(fb)
+    n_recs = sum(len(v) for v in by_host.values())
+    print(f"fabric channel: {n_recs} samples over {len(by_host)} "
+          f"links", file=out)
+    # sim duration for the utilization column (end of the last sample)
+    end_ns = max((r[0] for r in iter_fb_records(fb)), default=0)
+    ranked = top_by_peak_depth(by_host, top_n)
+    print(f"top {len(ranked)} links by peak queue depth:", file=out)
+    print(f"  {'link':<8} {'peak q':>7} {'max soj ms':>11} "
+          f"{'drops':>7} {'stalls':>7} {'util %':>7}", file=out)
+    cfg = _processed_config(data_dir)
+    names = _host_names(cfg)
+    bw_up = _host_bw_table(cfg, names)
+    for host in ranked:
+        recs = by_host[host]
+        last = recs[-1]
+        peak = max(r[3] for r in recs)
+        soj = max(r[5] for r in recs) / 1e6
+        stalls = last[10] + last[12]
+        bw = bw_up[host] if 0 <= host < len(bw_up) else 0
+        util = (f"{100.0 * last[14] * 8 / (bw * end_ns / 1e9):7.1f}"
+                if end_ns and bw else f"{'-':>7}")
+        label = names[host] if 0 <= host < len(names) else f"h{host}"
+        print(f"  {label:<8.8} {peak:>7} {soj:>11.2f} "
+              f"{last[7]:>7} {stalls:>7} {util}", file=out)
+    return ok
+
+
+def _host_bw_table(cfg: dict, names: list) -> list:
+    """Host-id -> uplink bits/s from the processed config: the
+    per-host override when present, else the graph node's
+    host_bandwidth_up (the common case — every canonical generator
+    sets bandwidth in the GML).  One GML parse for the whole table;
+    0 when unresolvable (the utilization column then reads '-')."""
+    node_bw: dict = {}
+    gspec = (cfg.get("network") or {}).get("graph") or {}
+    inline = gspec.get("inline")
+    if gspec.get("type") == "gml" and inline:
+        try:
+            from shadow_tpu.net.graph import NetworkGraph
+            g = NetworkGraph.from_gml(inline)
+            node_bw = {gml_id: node.bandwidth_up_bits or 0
+                       for gml_id, node in g.by_gml_id.items()}
+        except Exception:  # noqa: BLE001 — report-only fallback
+            node_bw = {}
+    out = []
+    hosts = cfg.get("hosts") or {}
+    for name in names:
+        h = hosts.get(name) or {}
+        out.append(int(h.get("bandwidth_up")
+                       or node_bw.get(h.get("network_node_id"), 0)))
+    return out
+
+
+def fct_report(data_dir: str, out=None) -> bool:
+    """`trace fct`: the flow-completion-time percentile table per
+    flow class (service port).  Returns True when flow records
+    exist."""
+    if out is None:
+        out = sys.stdout
+    from shadow_tpu.trace.events import iter_fct_records, split_fabric
+    from shadow_tpu.trace.fabricstat import fct_table
+
+    _stats, _sim, _wall, _tel, _sc, fab_bytes = _load(data_dir)
+    if not fab_bytes:
+        print("fabric channel: absent (run with "
+              "experimental.sim_fabricstat: on)", file=out)
+        return False
+    _fb, fct_bytes = split_fabric(fab_bytes)
+    rows = list(iter_fct_records(fct_bytes))
+    table = fct_table(rows)
+    if not table:
+        print("no flow records (no TCP payload moved)", file=out)
+        return False
+    print(f"flow completion times ({len(rows)} endpoint records):",
+          file=out)
+    print(f"  {'class':>6} {'flows':>6} {'done':>5} {'MB':>9} "
+          f"{'p50 ms':>9} {'p99 ms':>9} {'p999 ms':>9}", file=out)
+    for cls, ent in table.items():
+        print(f"  {cls:>6} {ent['flows']:>6} {ent['complete']:>5} "
+              f"{ent['bytes'] / 1e6:>9.2f} "
+              f"{ent['p50_ns'] / 1e6:>9.2f} "
+              f"{ent['p99_ns'] / 1e6:>9.2f} "
+              f"{ent['p999_ns'] / 1e6:>9.2f}", file=out)
+    return True
 
 
 def _processed_config(data_dir: str) -> dict:
@@ -253,7 +411,7 @@ def sys_report(data_dir: str, top_n: int = 10, out=None) -> bool:
     from shadow_tpu.host.syscalls_native import syscall_name
     from shadow_tpu.trace.events import SC_N, SC_SHIM, iter_sc_records
 
-    stats, _sim, _wall, _tel, sc_bytes = _load(data_dir)
+    stats, _sim, _wall, _tel, sc_bytes, _fab = _load(data_dir)
     metrics = stats.get("metrics", {})
     disp = metrics.get("sim", {}).get("syscalls", {}).get(
         "dispositions", {})
@@ -413,6 +571,10 @@ _EXPLAIN = {
     "per-round:scheduler": (
         "this scheduler has no span path; use scheduler: tpu for "
         "engine/device spans.",),
+    "per-round:outbox": (
+        "object-path packets were pending in the propagator outbox at "
+        "the round boundary; the fabric observatory names the hottest "
+        "queue below when its channel was on.",),
     "per-round:callback-host": (
         "a host can fire Python callbacks mid-event (Python-owned "
         "sockets), which excludes the whole sim from C++ spans.",),
@@ -469,11 +631,34 @@ def _managed_blockers(data_dir: str, sc_bytes: bytes, out) -> None:
             break
 
 
+def _hottest_queue(data_dir: str, fab_bytes: bytes, out) -> None:
+    """Join the eligibility audit with the fabric channel: when rounds
+    stall on outbox pressure, name the link whose router queue peaked
+    hottest (depth and head sojourn) — the congestion point to debug
+    first."""
+    from shadow_tpu.trace.events import split_fabric
+    from shadow_tpu.trace.fabricstat import (group_by_host,
+                                             top_by_peak_depth)
+    fb, _fct = split_fabric(fab_bytes)
+    by_host = group_by_host(fb)
+    ranked = top_by_peak_depth(by_host, 1)
+    if not ranked:
+        return
+    host = ranked[0]
+    recs = by_host[host]
+    peak = max(r[3] for r in recs)
+    soj = max(r[5] for r in recs) / 1e6
+    names = _host_names(_processed_config(data_dir))
+    label = names[host] if 0 <= host < len(names) else f"h{host}"
+    print(f"  hottest queue: {label} (router inbound peaked at "
+          f"{peak} packets, {soj:.2f} ms head sojourn)", file=out)
+
+
 def explain_report(data_dir: str, out=None) -> bool:
     """`trace explain`: top eligibility blockers -> remediation."""
     if out is None:
         out = sys.stdout
-    stats, _sim, _wall, _tel, sc_bytes = _load(data_dir)
+    stats, _sim, _wall, _tel, sc_bytes, fab_bytes = _load(data_dir)
     elig = stats.get("metrics", {}).get("wall", {}).get(
         "eligibility", {})
     rounds = stats.get("rounds", 0)
@@ -517,6 +702,10 @@ def explain_report(data_dir: str, out=None) -> bool:
             # audit with the syscall channel and name the offenders.
             _managed_blockers(data_dir, sc_bytes, out)
             managed_shown = True
+        if name == "per-round:outbox" and fab_bytes:
+            # Rounds stalled on outbox pressure: name the hottest
+            # queue (audit join with the fabric channel).
+            _hottest_queue(data_dir, fab_bytes, out)
         shown += 1
         if shown >= 6:
             break
@@ -595,7 +784,7 @@ hosts:
                   file=sys.stderr)
             return 1
         from shadow_tpu.trace.chrome import PID_SYSCALL, chrome_trace
-        _stats, sim_bytes, wall, _tel, sc_bytes = _load(base)
+        _stats, sim_bytes, wall, _tel, sc_bytes, _fab = _load(base)
         doc = chrome_trace(sim_bytes, wall, b"", sc_bytes)
         counters = [e for e in doc["traceEvents"]
                     if e.get("ph") == "C" and e.get("pid") == PID_SYSCALL]
@@ -630,6 +819,7 @@ def smoke(n_hosts: int) -> int:
         config = ConfigOptions.from_yaml_text(text)
         config.experimental.flight_recorder = "on"
         config.experimental.sim_netstat = "on"
+        config.experimental.sim_fabricstat = "on"
         config.general.data_directory = base
         _manager, summary = run_simulation(config, write_data=True)
         if not summary.ok:
@@ -646,6 +836,11 @@ def smoke(n_hosts: int) -> int:
             print("trace smoke: drop-cause counters do not conserve",
                   file=sys.stderr)
             return 1
+        if not fabric_report(base):
+            print("trace smoke: fabric byte-conservation violated",
+                  file=sys.stderr)
+            return 1
+        fct_report(base)
         explain_report(base)
         with open(chrome_out) as f:
             doc = json.load(f)
@@ -660,6 +855,14 @@ def smoke(n_hosts: int) -> int:
             print("trace smoke: chrome export has no sim-netstat "
                   "counter track", file=sys.stderr)
             return 1
+        from shadow_tpu.trace.chrome import PID_FABRIC
+        fab_counters = [e for e in doc["traceEvents"]
+                        if e.get("ph") == "C"
+                        and e.get("pid") == PID_FABRIC]
+        if not fab_counters:
+            print("trace smoke: chrome export has no per-link fabric "
+                  "counter track", file=sys.stderr)
+            return 1
     print(f"trace smoke: ok ({n_hosts} hosts, {summary.rounds} rounds "
           f"fully attributed, drops conserved, "
           f"{len(counters)} counter events)")
@@ -669,14 +872,16 @@ def smoke(n_hosts: int) -> int:
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("net", "explain", "sys"):
+    if argv and argv[0] in ("net", "explain", "sys", "fabric", "fct"):
         # Subcommands: `trace net DATA_DIR [--top N]`,
         #              `trace sys DATA_DIR [--top N]`,
+        #              `trace fabric DATA_DIR [--top N]`,
+        #              `trace fct DATA_DIR`,
         #              `trace explain DATA_DIR`.
         sub = argparse.ArgumentParser(
             prog=f"shadow_tpu.tools.trace {argv[0]}")
         sub.add_argument("data_dir")
-        if argv[0] in ("net", "sys"):
+        if argv[0] in ("net", "sys", "fabric"):
             sub.add_argument("--top", type=int, default=10,
                              help="rows in the report (default 10)")
         sargs = sub.parse_args(argv[1:])
@@ -688,6 +893,11 @@ def main(argv=None) -> int:
         if argv[0] == "sys":
             return 0 if sys_report(sargs.data_dir,
                                    top_n=sargs.top) else 1
+        if argv[0] == "fabric":
+            return 0 if fabric_report(sargs.data_dir,
+                                      top_n=sargs.top) else 1
+        if argv[0] == "fct":
+            return 0 if fct_report(sargs.data_dir) else 1
         return 0 if explain_report(sargs.data_dir) else 1
 
     ap = argparse.ArgumentParser(prog="shadow_tpu.tools.trace",

@@ -24,6 +24,11 @@ PID_SIM = 1
 PID_WALL = 2
 PID_NETSTAT = 3
 PID_SYSCALL = 4
+PID_FABRIC = 5
+
+# Default per-entity counter-track cap; the CLI overrides it from the
+# experimental.chrome_top_n knob (one knob for every track family).
+DEFAULT_TOP_N = 16
 
 # Counter tracks per exported connection: (track suffix, args built
 # from a TEL_REC tuple — see trace/events.py for the field order).
@@ -38,7 +43,7 @@ NETSTAT_TRACKS = (
 )
 
 
-def netstat_events(tel_bytes: bytes, top_n: int = 16) -> list:
+def netstat_events(tel_bytes: bytes, top_n: int = DEFAULT_TOP_N) -> list:
     """Per-connection counter events from telemetry-sim.bin.  Keeps
     the top_n connections by final retransmit count (ties broken by
     connection key, so the selection is deterministic — the same
@@ -68,7 +73,42 @@ def _meta(pid: int, tid: int, what: str, name: str) -> dict:
             "args": {"name": name}}
 
 
-def syscall_events(sc_bytes: bytes, top_n: int = 16) -> list:
+def fabric_events(fab_bytes: bytes, top_n: int = DEFAULT_TOP_N) -> list:
+    """Per-link counter tracks from fabric-sim.bin's FB section:
+    CoDel depth + head sojourn, token-bucket balances and the
+    cumulative link packet counters, for the top_n hosts by peak
+    sampled queue depth (ties broken by host id — the same ranking
+    `tools/trace fabric` prints)."""
+    from shadow_tpu.trace.fabricstat import (group_by_host,
+                                             top_by_peak_depth)
+
+    by_host = group_by_host(fab_bytes)
+    ranked = top_by_peak_depth(by_host, top_n)
+    ev: list = []
+    if not ranked:
+        return ev
+    ev.append(_meta(PID_FABRIC, 0, "process_name",
+                    f"fabric observatory (top {len(ranked)} of "
+                    f"{len(by_host)} links)"))
+    for host in ranked:
+        for rec in by_host[host]:
+            ts = rec[0] / 1e3
+            ev.append({"ph": "C", "pid": PID_FABRIC, "tid": 0,
+                       "ts": ts, "name": f"h{host} queue",
+                       "args": {"depth": rec[3],
+                                "sojourn-ms": rec[5] / 1e6}})
+            ev.append({"ph": "C", "pid": PID_FABRIC, "tid": 0,
+                       "ts": ts, "name": f"h{host} bucket",
+                       "args": {"out-bal": max(rec[9], 0),
+                                "in-bal": max(rec[11], 0)}})
+            ev.append({"ph": "C", "pid": PID_FABRIC, "tid": 0,
+                       "ts": ts, "name": f"h{host} link",
+                       "args": {"pkts-out": rec[13],
+                                "pkts-in": rec[15]}})
+    return ev
+
+
+def syscall_events(sc_bytes: bytes, top_n: int = DEFAULT_TOP_N) -> list:
     """Per-process syscall slices + counter tracks from
     syscalls-sim.bin (the syscall observatory's record channel).
 
@@ -118,14 +158,18 @@ def syscall_events(sc_bytes: bytes, top_n: int = 16) -> list:
 
 
 def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
-                 tel_bytes: bytes = b"", sc_bytes: bytes = b"") -> dict:
+                 tel_bytes: bytes = b"", sc_bytes: bytes = b"",
+                 fab_bytes: bytes = b"",
+                 top_n: int = DEFAULT_TOP_N) -> dict:
     """Build the trace-event JSON object from the raw channel data.
 
     `sim_bytes` is flight-sim.bin's content; `wall` is the parsed
     flight-wall.json dict (or None); `tel_bytes` is
     telemetry-sim.bin's content (per-connection counter tracks);
     `sc_bytes` is syscalls-sim.bin's content (per-process syscall
-    slices + counter tracks)."""
+    slices + counter tracks); `fab_bytes` is fabric-sim.bin's FB
+    section (per-link counter tracks).  `top_n` caps every per-entity
+    track family (the experimental.chrome_top_n knob)."""
     ev: list[dict] = [
         _meta(PID_SIM, 0, "process_name", "sim-time (simulated µs)"),
         _meta(PID_SIM, 1, "thread_name", "rounds & spans"),
@@ -176,10 +220,13 @@ def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
         ev.append({"ph": "E", "pid": PID_SIM, "tid": 1, "ts": last_us})
 
     if tel_bytes:
-        ev.extend(netstat_events(tel_bytes))
+        ev.extend(netstat_events(tel_bytes, top_n))
 
     if sc_bytes:
-        ev.extend(syscall_events(sc_bytes))
+        ev.extend(syscall_events(sc_bytes, top_n))
+
+    if fab_bytes:
+        ev.extend(fabric_events(fab_bytes, top_n))
 
     if wall and wall.get("events"):
         ev.append(_meta(PID_WALL, 0, "process_name",

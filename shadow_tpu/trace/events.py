@@ -254,6 +254,122 @@ def iter_sc_records(buf: bytes):
         yield SC_REC.unpack_from(buf, off)
 
 
+# ---------------------------------------------------------------------
+# Fabric observatory (docs/OBSERVABILITY.md "Fabric observatory"): the
+# FOURTH sim-time channel (`fabric-sim.bin`).  Two record families in
+# one artifact behind a small counted header (FAB_HDR): per-queue
+# samples (FB_REC) at conservative-round boundaries, then per-flow
+# lifecycle records (FCT_REC) from which `trace fct` derives
+# flow-completion-time percentiles.  The FB_*/FCT_* constants are
+# twinned with native/netplane.cpp and registered fail-closed in
+# analysis pass 1 exactly like FR_*/EL_*/TEL_*.
+#
+# Activity flags (one bit per queue class; a host is sampled in a
+# round iff any bit is set — the rule is a pure function of simulation
+# state, so the sampled set is path-independent):
+FB_ACT_CODEL = 1    # router inbound CoDel queue non-empty
+FB_ACT_TB_OUT = 2   # inet-out token-bucket relay parked on a refill
+FB_ACT_TB_IN = 4    # inet-in token-bucket relay parked on a refill
+FB_ACT_LINK = 8     # the eth link has ever forwarded a packet
+
+# Per-queue sample record (FB_REC_BYTES, little-endian, no padding;
+# C++ twin: struct FabRec):
+#
+#     int64   t         simulated ns (the sampled round's window end)
+#     int32   host      host id
+#     int32   flags     FB_ACT_* activity mask (why this host sampled)
+#     int64[14]         qdepth (CoDel packets), qbytes, sojourn
+#                       (head-of-queue wait ns), qenq (cumulative push
+#                       attempts), qdrops (cumulative CoDel+hard-limit
+#                       drops), qmarks (cumulative ECN marks — 0 until
+#                       DCTCP lands, the slot is ECN-ready),
+#                       r1_bal / r1_stalls (inet-out bucket balance at
+#                       the boundary / cumulative refill stalls),
+#                       r2_bal / r2_stalls (inet-in twin),
+#                       psent / bsent / precv / brecv (cumulative
+#                       per-link eth packets/bytes forwarded)
+FB_REC_BYTES = 128
+FB_REC = struct.Struct("<qii14q")
+assert FB_REC.size == FB_REC_BYTES
+
+# numpy structured dtype for bulk encode/decode (field order == FB_REC).
+FB_DTYPE = [("t", "<i8"), ("host", "<i4"), ("flags", "<i4"),
+            ("qdepth", "<i8"), ("qbytes", "<i8"), ("sojourn", "<i8"),
+            ("qenq", "<i8"), ("qdrops", "<i8"), ("qmarks", "<i8"),
+            ("r1_bal", "<i8"), ("r1_stalls", "<i8"),
+            ("r2_bal", "<i8"), ("r2_stalls", "<i8"),
+            ("psent", "<i8"), ("bsent", "<i8"), ("precv", "<i8"),
+            ("brecv", "<i8")]
+
+# Flow-lifecycle flags (C++ twin: the FCT_F_* enum in netplane.cpp).
+FCT_F_COMPLETE = 1  # connection reached CLOSED before the artifact
+FCT_F_RECEIVER = 2  # this endpoint received more than it sent
+
+# Per-flow lifecycle record (FCT_REC_BYTES, little-endian, no padding;
+# C++ twin: struct FctRec — the engine's per-host flow log entry):
+#
+#     int64   t_first    first data byte sent or delivered (-1: none)
+#     int64   t_last     last data byte sent or delivered
+#     int32   host       host id
+#     uint16  lport      flow identity: local port,
+#     uint16  rport        peer port,
+#     uint32  rip          peer IP (the local IP is the host's)
+#     int32   flags      FCT_F_* bits
+#     int64[3]           bytes_in (payload delivered in order),
+#                        bytes_out (payload first-transmitted),
+#                        retransmits
+FCT_REC_BYTES = 56
+FCT_REC = struct.Struct("<qqiHHIi3q")
+assert FCT_REC.size == FCT_REC_BYTES
+
+# numpy structured dtype for bulk decode (field order == FCT_REC).
+FCT_DTYPE = [("t_first", "<i8"), ("t_last", "<i8"), ("host", "<i4"),
+             ("lport", "<u2"), ("rport", "<u2"), ("rip", "<u4"),
+             ("flags", "<i4"), ("bytes_in", "<i8"),
+             ("bytes_out", "<i8"), ("rtx", "<i8")]
+
+# fabric-sim.bin layout: FAB_HDR, then fb_records FB_RECs, then
+# fct_records FCT_RECs.  The header is Python-side only (the manager
+# packs the artifact from every producer), so it has no C++ twin.
+FAB_MAGIC = 0x46425354  # "FBST"
+FAB_VERSION = 1
+FAB_HDR = struct.Struct("<IIQQ")  # magic, version, fb_n, fct_n
+FAB_HDR_BYTES = 24
+assert FAB_HDR.size == FAB_HDR_BYTES
+
+
+def split_fabric(buf: bytes) -> tuple[bytes, bytes]:
+    """fabric-sim.bin content -> (fb_bytes, fct_bytes); raises
+    ValueError on a malformed header or truncated sections."""
+    if len(buf) < FAB_HDR_BYTES:
+        raise ValueError("fabric artifact shorter than its header")
+    magic, version, fb_n, fct_n = FAB_HDR.unpack_from(buf, 0)
+    if magic != FAB_MAGIC or version != FAB_VERSION:
+        raise ValueError(f"bad fabric header {magic:#x} v{version}")
+    fb_end = FAB_HDR_BYTES + fb_n * FB_REC_BYTES
+    fct_end = fb_end + fct_n * FCT_REC_BYTES
+    if len(buf) < fct_end:
+        raise ValueError("fabric artifact truncated")
+    return buf[FAB_HDR_BYTES:fb_end], buf[fb_end:fct_end]
+
+
+def iter_fb_records(fb_bytes: bytes):
+    """Yield (t, host, flags, qdepth, qbytes, sojourn, qenq, qdrops,
+    qmarks, r1_bal, r1_stalls, r2_bal, r2_stalls, psent, bsent, precv,
+    brecv) tuples from a packed FB_REC stream."""
+    for off in range(0, len(fb_bytes) - len(fb_bytes) % FB_REC_BYTES,
+                     FB_REC_BYTES):
+        yield FB_REC.unpack_from(fb_bytes, off)
+
+
+def iter_fct_records(fct_bytes: bytes):
+    """Yield (t_first, t_last, host, lport, rport, rip, flags,
+    bytes_in, bytes_out, rtx) tuples from a packed FCT_REC stream."""
+    for off in range(0, len(fct_bytes) - len(fct_bytes) % FCT_REC_BYTES,
+                     FCT_REC_BYTES):
+        yield FCT_REC.unpack_from(fct_bytes, off)
+
+
 REC = struct.Struct("<qiiqq")
 assert REC.size == FLIGHT_REC_BYTES
 

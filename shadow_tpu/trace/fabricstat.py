@@ -1,0 +1,296 @@
+"""Fabric observatory: the deterministic per-link queue telemetry and
+flow-completion-time channel.
+
+The FOURTH sim-time channel next to the flight recorder, sim-netstat
+and the syscall observatory (docs/OBSERVABILITY.md "Fabric
+observatory").  Two record families share one artifact
+(`fabric-sim.bin`, trace/events.py FAB_HDR framing):
+
+- **FB_REC** queue samples: every ACTIVE interface/router queue at
+  conservative-round boundaries — CoDel depth/bytes/head-sojourn plus
+  its cumulative enqueue/drop/mark counters, both token-bucket relays'
+  balance and refill-stall counts, and the eth link's cumulative
+  packets/bytes forwarded.  A host is active iff any FB_ACT_* bit is
+  set; the rule is a pure function of simulation state, so the sampled
+  set is path-independent.
+- **FCT_REC** flow lifecycle records: one per TCP endpoint that ever
+  carried payload — first/last data byte, in/out byte counts and
+  retransmits — logged at connection teardown and swept from the
+  still-associated remainder when the artifact is written, then
+  globally sorted by flow identity so emission order can never leak
+  into the bytes.
+
+Sampling cadence is the same STATELESS grid-crossing rule sim-netstat
+uses (`start // interval != window_end // interval`); both boundaries
+are path-independent, so the sampled-round set is too.  The engine
+ring (netplane.cpp fab_sample_round), the device-span buffers
+(ops/tcp_span.py / ops/phold_span.py round_body) and the object-path
+walker below all emit records in ascending host-id order within a
+round, so `fabric-sim.bin` is byte-diffed by the determinism gate AND
+byte-identical across serial/thread_per_core/tpu and the forced-device
+differential.
+
+Like `SimChannel`, this class must never read wall clocks: analysis
+pass 3's `sim-channel` rule covers it with no pragma escape.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shadow_tpu.trace.events import (FAB_HDR, FAB_MAGIC, FAB_VERSION,
+                                     FB_ACT_CODEL, FB_ACT_LINK,
+                                     FB_ACT_TB_IN, FB_ACT_TB_OUT,
+                                     FB_REC, FB_REC_BYTES, FCT_F_COMPLETE,
+                                     FCT_F_RECEIVER, FCT_REC)
+from shadow_tpu.trace.recorder import FixedRecordChannel
+
+# tcp/connection.py state values (a CLOSED conn is a completed flow).
+_CLOSED = 0
+
+# Relay pending state (net/relay.py _PENDING twin value).
+_RELAY_PENDING = 1
+
+
+def host_queue_sample(host, t: int) -> tuple | None:
+    """One object-path host's FB_REC field tuple at sim time `t`, or
+    None when no FB_ACT_* bit is set.  THE single reading of the
+    active rule and the queue fields on the object path — the
+    conservation sweep reuses it so the two can never disagree."""
+    codel = host.router._inbound
+    r1 = host.relay_inet_out
+    r2 = host.relay_inet_in
+    eth = host.eth0
+    flags = 0
+    depth = len(codel)
+    if depth > 0:
+        flags |= FB_ACT_CODEL
+    if r1._state == _RELAY_PENDING:
+        flags |= FB_ACT_TB_OUT
+    if r2._state == _RELAY_PENDING:
+        flags |= FB_ACT_TB_IN
+    if eth.packets_sent + eth.packets_received > 0:
+        flags |= FB_ACT_LINK
+    if not flags:
+        return None
+    head = codel.peek_entry()
+    sojourn = (t - head[1]) if head is not None else 0
+    return (t, host.id, flags, depth, codel._bytes, sojourn,
+            codel.enqueued_count, codel.dropped_count,
+            codel.marked_count,
+            r1._bucket.peek_balance(t) if r1._bucket is not None else -1,
+            r1.stalls,
+            r2._bucket.peek_balance(t) if r2._bucket is not None else -1,
+            r2.stalls,
+            eth.packets_sent, eth.bytes_sent,
+            eth.packets_received, eth.bytes_received)
+
+
+def host_fabric_counters(host) -> tuple:
+    """One object-path host's fabric counter tuple, field-for-field
+    the engine's `fabric_counters(hid)`: (enq_pkts, enq_bytes,
+    fwd_pkts, fwd_bytes, drop_pkts, drop_bytes, marked, qdepth,
+    qbytes, peak_depth, r1_stalls, r2_stalls, psent, bsent, precv,
+    brecv, parked_pkts, parked_bytes)."""
+    codel = host.router._inbound
+    eth = host.eth0
+    r2 = host.relay_inet_in
+    parked = r2._pending_packet
+    return (codel.enqueued_count, codel.enqueued_bytes,
+            r2.forwarded_pkts, r2.forwarded_bytes,
+            codel.dropped_count, codel.dropped_bytes,
+            codel.marked_count, len(codel), codel._bytes,
+            codel.peak_depth, host.relay_inet_out.stalls,
+            r2.stalls, eth.packets_sent,
+            eth.bytes_sent, eth.packets_received, eth.bytes_received,
+            1 if parked is not None else 0,
+            parked.total_size() if parked is not None else 0)
+
+
+class FabricChannel(FixedRecordChannel):
+    """Deterministic per-queue sample stream (simulated time only;
+    trace/recorder.FixedRecordChannel carries the shared cap/extend
+    machinery).  Flow records are NOT streamed — the manager sweeps
+    them once at artifact-write time (write takes the flow rows)."""
+
+    FILE = "fabric-sim.bin"
+    REC_SIZE = FB_REC_BYTES
+
+    def record(self, fields: tuple) -> None:
+        """One pre-assembled FB_REC field tuple (host_queue_sample)."""
+        if self.records >= self._cap:
+            self.dropped += 1
+            return
+        self._chunks.append(FB_REC.pack(*fields))
+        self.records += 1
+
+    def sample_object_hosts(self, hosts, t: int) -> None:
+        """Sample every active object-path host's queues.  Hosts on
+        the native plane are skipped — their queues live engine-side
+        and the engine ring samples them.  `hosts` is the manager's
+        id-ordered list, so emission order is ascending host id."""
+        for h in hosts:
+            if h.plane is not None or not h.net_built():
+                continue
+            fields = host_queue_sample(h, t)
+            if fields is not None:
+                self.record(fields)
+
+    def write(self, data_dir: str, flow_rows: list) -> None:
+        """Write the framed artifact: header, FB section, then the
+        flow records sorted by their full field tuple (flow identity
+        first) — emission order can never reach the bytes."""
+        fb = self.to_bytes()
+        rows = sorted(flow_rows)
+        fct = b"".join(FCT_REC.pack(*r) for r in rows)
+        hdr = FAB_HDR.pack(FAB_MAGIC, FAB_VERSION,
+                           len(fb) // FB_REC_BYTES, len(rows))
+        with open(os.path.join(data_dir, self.FILE), "wb") as f:
+            f.write(hdr + fb + fct)
+
+
+def flow_row(host_id: int, lport: int, rport: int, rip: int,
+             conn) -> tuple | None:
+    """One endpoint's FCT_REC field tuple from a (live or torn-down)
+    object-path connection, or None when the flow never carried
+    payload.  Field order == trace/events.py FCT_REC; the C++ twin is
+    Engine::fct_row."""
+    if conn.fct_first < 0:
+        return None
+    flags = 0
+    if conn.state == _CLOSED:
+        flags |= FCT_F_COMPLETE
+    if conn.fct_bytes_in > conn.fct_bytes_out:
+        flags |= FCT_F_RECEIVER
+    return (conn.fct_first, conn.fct_last, host_id, lport, rport, rip,
+            flags, conn.fct_bytes_in, conn.fct_bytes_out,
+            conn.retransmit_count)
+
+
+def object_host_flow_rows(host) -> list:
+    """All of one object-path host's flow rows: the teardown log plus
+    every still-associated connection with payload history (the twin
+    of the engine's fct_flows sweep)."""
+    from shadow_tpu.trace.netstat import iter_host_tcp_sockets
+    rows = list(host.fct_log)
+    for s in iter_host_tcp_sockets(host):
+        conn = s.conn
+        if conn is None or s.local is None or s.peer is None:
+            continue
+        row = flow_row(host.id, s.local[1], s.peer[1], s.peer[0], conn)
+        if row is not None:
+            rows.append(row)
+    return rows
+
+
+def emit_device_rows(channel, st_np, n_hosts: int) -> None:
+    """Pack a device span's buffered fabric rows (fab_* output arrays
+    from ops/tcp_span.py or ops/phold_span.py) into FB_REC records and
+    append them to `channel`.  Per sampled round, ACTIVE hosts
+    (flags != 0) in ascending host-id order — byte-identical to the
+    engine ring's records for the same rounds.  `qmarks` is packed as
+    0: the kernels carry no ECN-mark column until DCTCP lands."""
+    if channel is None:
+        return
+    import numpy as np
+
+    from shadow_tpu.trace.events import FB_DTYPE
+    fn = int(st_np.get("fab_n", 0))
+    if fn == 0:
+        return
+    flags = np.asarray(st_np["fab_flags"][:fn], dtype=np.int32)
+    sel = flags.reshape(-1) != 0
+    count = int(sel.sum())
+    if count == 0:
+        return
+    arr = np.zeros(count, dtype=np.dtype(FB_DTYPE))
+    arr["t"] = np.repeat(np.asarray(st_np["fab_t"][:fn],
+                                    dtype=np.int64), n_hosts)[sel]
+    arr["host"] = np.tile(np.arange(n_hosts, dtype=np.int32), fn)[sel]
+    arr["flags"] = flags.reshape(-1)[sel]
+    for name in ("qdepth", "qbytes", "sojourn", "qenq", "qdrops",
+                 "r1_bal", "r1_stalls", "r2_bal", "r2_stalls",
+                 "psent", "bsent", "precv", "brecv"):
+        arr[name] = np.asarray(st_np[f"fab_{name}"][:fn],
+                               dtype=np.int64).reshape(-1)[sel]
+    channel.extend(arr.tobytes())
+
+
+# ---------------------------------------------------------------------
+# Report helpers (tools/trace `fabric` / `fct`, the Chrome export and
+# bench.py share these so every surface renders the same numbers).
+# ---------------------------------------------------------------------
+
+def group_by_host(fb_bytes: bytes) -> dict:
+    """FB records grouped by host id -> [records in time order]."""
+    from shadow_tpu.trace.events import iter_fb_records
+    by_host: dict = {}
+    for rec in iter_fb_records(fb_bytes):
+        by_host.setdefault(rec[1], []).append(rec)
+    return by_host
+
+
+def top_by_peak_depth(by_host: dict, n: int) -> list:
+    """Top-n host ids by peak sampled CoDel depth, ties broken by host
+    id — the one deterministic ranking the CLI table and the Chrome
+    per-link counter tracks both render."""
+    return sorted(by_host,
+                  key=lambda h: (-max(r[3] for r in by_host[h]), h))[:n]
+
+
+def percentile(sorted_vals: list, permille: int) -> int:
+    """Nearest-rank percentile (ceil(p*n)-1) over a pre-sorted list,
+    in integer arithmetic (permille: 500 = p50, 990 = p99, 999 =
+    p999) — deterministic, and the tail percentiles of small samples
+    resolve to the max instead of collapsing onto the median."""
+    n = len(sorted_vals)
+    if not n:
+        return 0
+    idx = max((permille * n + 999) // 1000 - 1, 0)
+    return sorted_vals[min(idx, n - 1)]
+
+
+def receiver_rows(fct_rows) -> list:
+    """The per-FLOW view of an endpoint-record list: the RECEIVER
+    endpoint of every flow (the canonical FCT vantage — first byte
+    leaves the sender, last byte reaches the receiver), falling back
+    to the whole list when no receiver records exist (one-sided
+    traffic).  Both simulated endpoints of a flow leave a record, so
+    counting records would double every flow; this is THE one
+    de-duplication rule `trace fct`, bench's fabric block and the
+    tests share."""
+    rows = [r for r in fct_rows if r[0] >= 0]
+    recv = [r for r in rows if r[6] & FCT_F_RECEIVER]
+    return recv if recv else rows
+
+
+def fct_table(fct_rows) -> dict:
+    """Flow-completion-time percentiles per flow class.  A flow's
+    class is its service port (the smaller of the two ports — the
+    well-known side); every column — count, completions, bytes AND
+    the percentiles — is computed over the same receiver-endpoint
+    population (receiver_rows), so one flow counts once.  Returns
+    {class_port: {"flows", "complete", "bytes", "p50_ns", "p99_ns",
+    "p999_ns"}}."""
+    by_class: dict = {}
+    for (t0, t1, _host, lport, rport, _rip, flags, bin_, bout,
+         _rtx) in receiver_rows(fct_rows):
+        cls = min(lport, rport)
+        ent = by_class.setdefault(cls, {"durs": [], "complete": 0,
+                                        "bytes": 0})
+        ent["durs"].append(t1 - t0)
+        if flags & FCT_F_COMPLETE:
+            ent["complete"] += 1
+        ent["bytes"] += max(bin_, bout)
+    out: dict = {}
+    for cls, ent in sorted(by_class.items()):
+        durs = sorted(ent["durs"])
+        out[cls] = {
+            "flows": len(durs),
+            "complete": ent["complete"],
+            "bytes": ent["bytes"],
+            "p50_ns": percentile(durs, 500),
+            "p99_ns": percentile(durs, 990),
+            "p999_ns": percentile(durs, 999),
+        }
+    return out

@@ -34,37 +34,26 @@ from __future__ import annotations
 import os
 
 from shadow_tpu.trace.events import TEL_REC, TEL_REC_BYTES
+from shadow_tpu.trace.recorder import FixedRecordChannel, grid_sampled
 
 # Connection states excluded from sampling (tcp/connection.py values;
 # a CLOSED conn is dead, a LISTEN conn has no transfer state).
 _CLOSED = 0
 _LISTEN = 1
 
-
-def sampled(start: int, window_end: int, interval_ns: int) -> bool:
-    """The grid-crossing rule (C++ twin: Engine::tel_sample_round;
-    device twin: the round_body guard in ops/tcp_span.py)."""
-    iv = interval_ns if interval_ns > 0 else 1
-    return start // iv != window_end // iv
+# The grid-crossing rule (kept importable here — this module anchors
+# the twin documentation; trace/recorder.grid_sampled is the one
+# implementation every channel shares).
+sampled = grid_sampled
 
 
-class NetstatChannel:
+class NetstatChannel(FixedRecordChannel):
     """Deterministic per-connection sample stream (simulated time
-    only).  Records append pre-packed so the in-memory representation
-    IS the artifact; a capacity cap drops (and counts) the tail at a
-    point that is a function of the record sequence alone."""
+    only; trace/recorder.FixedRecordChannel carries the shared
+    cap/extend machinery)."""
 
     FILE = "telemetry-sim.bin"
-
-    def __init__(self, interval_ns: int = 0, cap: int = 1 << 22):
-        self.interval_ns = int(interval_ns)
-        self._chunks: list[bytes] = []
-        self._cap = cap
-        self.records = 0
-        self.dropped = 0
-
-    def sampled(self, start: int, window_end: int) -> bool:
-        return sampled(start, window_end, self.interval_ns)
+    REC_SIZE = TEL_REC_BYTES
 
     def record(self, t: int, host: int, lport: int, rport: int,
                rip: int, conn) -> None:
@@ -78,20 +67,6 @@ class NetstatChannel:
             conn._rto_backoff, conn.send_buf_len, conn.recv_buf_len,
             conn.retransmit_count, conn.sacked_skip_count))
         self.records += 1
-
-    def extend(self, buf: bytes, producer_dropped: int = 0) -> None:
-        """Append pre-packed records (an engine `netstat_take` drain
-        or a device-span driver's batch)."""
-        n = len(buf) // TEL_REC_BYTES
-        if self.records + n > self._cap:
-            keep = max(self._cap - self.records, 0)
-            self.dropped += n - keep
-            buf = buf[:keep * TEL_REC_BYTES]
-            n = keep
-        if n:
-            self._chunks.append(bytes(buf))
-            self.records += n
-        self.dropped += int(producer_dropped)
 
     def sample_object_hosts(self, hosts, t: int) -> None:
         """Sample every object-path host's live TCP connections.
@@ -111,9 +86,6 @@ class NetstatChannel:
             rows.sort(key=lambda r: r[:3])
             for lport, rport, rip, conn in rows:
                 self.record(t, h.id, lport, rport, rip, conn)
-
-    def to_bytes(self) -> bytes:
-        return b"".join(self._chunks)
 
     def write(self, data_dir: str) -> None:
         with open(os.path.join(data_dir, self.FILE), "wb") as f:

@@ -75,6 +75,62 @@ class SimChannel:
         return b"".join(self._chunks)
 
 
+def grid_sampled(start: int, window_end: int,
+                 interval_ns: int) -> bool:
+    """The stateless grid-crossing sampling rule every
+    interval-sampled channel shares: a round [start, window_end)
+    samples iff it crosses a grid boundary.  C++ twins:
+    Engine::tel_sample_round / fab_sample_round; device twins: the
+    round_body guards in ops/tcp_span.py and ops/phold_span.py.
+    Both boundaries are path-independent, so the sampled-round set —
+    and with it each channel — is path-independent by construction."""
+    iv = interval_ns if interval_ns > 0 else 1
+    return start // iv != window_end // iv
+
+
+class FixedRecordChannel:
+    """Shared machinery of the interval-sampled fixed-record sim-time
+    channels (sim-netstat's NetstatChannel, the fabric observatory's
+    FabricChannel): records append pre-packed so the in-memory
+    representation IS the artifact, and a capacity cap drops (and
+    counts) the tail at a point that is a function of the record
+    sequence alone — a capped stream is still deterministic.
+    Subclasses pin REC_SIZE (the fixed record width) and FILE, and
+    add their own record()/sample walkers.  Like SimChannel, no
+    subclass may read wall clocks (analysis pass 3's `sim-channel`
+    rule, no pragma escape)."""
+
+    REC_SIZE = 1  # subclass: bytes per fixed record
+    FILE = ""
+
+    def __init__(self, interval_ns: int = 0, cap: int = 1 << 22):
+        self.interval_ns = int(interval_ns)
+        self._chunks: list[bytes] = []
+        self._cap = cap
+        self.records = 0
+        self.dropped = 0
+
+    def sampled(self, start: int, window_end: int) -> bool:
+        return grid_sampled(start, window_end, self.interval_ns)
+
+    def extend(self, buf: bytes, producer_dropped: int = 0) -> None:
+        """Append pre-packed records (an engine ring drain or a
+        device-span driver's batch)."""
+        n = len(buf) // self.REC_SIZE
+        if self.records + n > self._cap:
+            keep = max(self._cap - self.records, 0)
+            self.dropped += n - keep
+            buf = buf[:keep * self.REC_SIZE]
+            n = keep
+        if n:
+            self._chunks.append(bytes(buf))
+            self.records += n
+        self.dropped += int(producer_dropped)
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self._chunks)
+
+
 class WallChannel:
     """Wall-clock phase profiling: per-phase aggregate totals plus a
     bounded (t0, duration, name) event list for slice rendering."""

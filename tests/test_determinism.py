@@ -28,6 +28,7 @@ experimental:
   strace_logging_mode: deterministic
   flight_recorder: "{flight}"
   sim_netstat: "on"
+  sim_fabricstat: "on"
 hosts:
   alice:
     network_node_id: 0
@@ -112,6 +113,7 @@ def test_two_runs_byte_identical(tmp_path):
     assert "packet-trace.txt" in a
     assert a["flight-sim.bin"], "sim channel recorded nothing"
     assert a["telemetry-sim.bin"], "sim-netstat recorded nothing"
+    assert a["fabric-sim.bin"], "fabric observatory recorded nothing"
 
 
 def test_netstat_identical_across_schedulers(tmp_path):
@@ -136,6 +138,32 @@ def test_netstat_identical_across_schedulers(tmp_path):
     for label in ("thread_per_core", "tpu"):
         assert blobs[label] == blobs["serial"], \
             f"telemetry-sim.bin diverged on {label}"
+
+
+def test_fabricstat_identical_across_schedulers(tmp_path):
+    """The fabric observatory is keyed by sim time and host identity
+    only — the active rule, the queue counters and the flow records
+    are all pure functions of simulation state — so fabric-sim.bin
+    must be byte-identical across SCHEDULERS: the serial object path,
+    the threaded object path and the tpu scheduler's C++ engine all
+    sample the same queues at the same round boundaries.  This is the
+    tier-1 leg of the cross-path parity claim (the forced-device leg
+    lives in tests/test_fabricstat.py)."""
+    datas = {
+        "serial": run_sim(tmp_path, "fb-ser", "serial"),
+        "thread_per_core": run_sim(tmp_path, "fb-thr",
+                                   "thread_per_core", parallelism=2),
+        "tpu": run_sim(tmp_path, "fb-tpu", "tpu"),
+    }
+    blobs = {}
+    for label, data in datas.items():
+        with open(os.path.join(data, "fabric-sim.bin"), "rb") as f:
+            blobs[label] = f.read()
+    from shadow_tpu.trace.events import FAB_HDR_BYTES
+    assert len(blobs["serial"]) > FAB_HDR_BYTES, "no fabric records"
+    for label in ("thread_per_core", "tpu"):
+        assert blobs[label] == blobs["serial"], \
+            f"fabric-sim.bin diverged on {label}"
 
 
 def test_syscall_channel_identical_across_schedulers(tmp_path):

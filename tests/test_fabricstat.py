@@ -1,0 +1,312 @@
+"""Fabric-observatory gates: per-interface byte conservation, queue
+telemetry byte-parity across execution paths, flow-lifecycle records,
+and the CLI reports.
+
+The conservation contract (docs/PARITY.md): for every host's inbound
+router queue, packets/bytes enqueued == forwarded + dropped +
+still-queued (+ the relay's one parked packet), with the drop count
+reconciling against the TEL_CODEL + TEL_RTR_LIMIT attribution causes
+— on every execution path.  The sample channel is keyed by sim time
+and host identity only, so two runs — and the object path, the C++
+engine, and the forced device span — must produce byte-identical
+`fabric-sim.bin` artifacts.  (The serial/thread/tpu cross-scheduler
+leg lives in tests/test_determinism.py.)
+"""
+
+import json
+import os
+
+import pytest
+
+from shadow_tpu.trace import events as trev
+from shadow_tpu.trace.fabricstat import FabricChannel, fct_table
+
+
+def _stream_cfg(scheduler, n_hosts=8, loss=0.02, stop="1s",
+                device_spans=None, fabric="on", interval=0):
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.tools.netgen import tcp_stream_yaml
+    cfg = ConfigOptions.from_yaml_text(tcp_stream_yaml(
+        n_hosts, nbytes=50_000_000, loss=loss, stop_time=stop,
+        seed=11, scheduler=scheduler, device_spans=device_spans))
+    cfg.experimental.sim_fabricstat = fabric
+    cfg.experimental.fabricstat_interval_ns = interval
+    return cfg
+
+
+def _incast_cfg(scheduler, fan_in=12, fabric="on"):
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.tools.netgen import incast_yaml
+    cfg = ConfigOptions.from_yaml_text(
+        incast_yaml(fan_in, scheduler=scheduler))
+    cfg.experimental.sim_fabricstat = fabric
+    return cfg
+
+
+def _run(tmp_path, name, cfg):
+    from shadow_tpu.core.manager import run_simulation
+    cfg.general.data_directory = str(tmp_path / name)
+    manager, summary = run_simulation(cfg, write_data=True)
+    assert summary.ok, summary.plugin_errors
+    with open(tmp_path / name / "sim-stats.json") as f:
+        stats = json.load(f)
+    fab = b""
+    fab_path = tmp_path / name / "fabric-sim.bin"
+    if fab_path.exists():
+        fab = fab_path.read_bytes()
+    return manager, stats, fab
+
+
+def _assert_conserved(stats):
+    fab = stats["metrics"]["sim"]["fabric"]
+    assert fab["violations"] == 0, fab
+    assert fab["enqueued_pkts"] == (fab["delivered_pkts"]
+                                    + fab["dropped_pkts"]
+                                    + fab["queued_pkts"]), fab
+    assert fab["enqueued_bytes"] >= (fab["delivered_bytes"]
+                                     + fab["dropped_bytes"]
+                                     + fab["queued_bytes"]), fab
+    return fab
+
+
+# ---------------------------------------------------------------------
+# Unit: record layouts, framing, sampling, cap
+# ---------------------------------------------------------------------
+
+def test_record_round_trip(tmp_path):
+    import struct
+    fields = (1_000_000, 7, trev.FB_ACT_CODEL | trev.FB_ACT_LINK,
+              42, 63_000, 6_500_000, 1000, 12, 0, 2500, 3, -1, 9,
+              500, 750_000, 480, 720_000)
+    flow = (100, 900, 7, 8080, 40001, 0x0B000001,
+            trev.FCT_F_COMPLETE | trev.FCT_F_RECEIVER, 150_000, 11, 2)
+    ch = FabricChannel(0)
+    ch.record(fields)
+    assert len(ch.to_bytes()) == trev.FB_REC_BYTES
+    # the framed artifact round-trips both record families, and the
+    # writer sorts flow rows so emission order never reaches the bytes
+    ch.write(str(tmp_path), [flow, flow[:1] + (50,) + flow[2:]])
+    blob = (tmp_path / FabricChannel.FILE).read_bytes()
+    fb2, fct2 = trev.split_fabric(blob)
+    assert list(trev.iter_fb_records(fb2)) == [fields]
+    flows = list(trev.iter_fct_records(fct2))
+    assert flows == sorted([flow, flow[:1] + (50,) + flow[2:]])
+    # malformed framing is rejected, not misparsed
+    with pytest.raises(ValueError):
+        trev.split_fabric(b"\x00" * 8)
+    with pytest.raises(ValueError):
+        trev.split_fabric(struct.pack("<IIQQ", 1, 1, 4, 0))
+
+
+def test_channel_cap_is_deterministic():
+    fields = (0, 0, 1) + (0,) * 14
+    ch = FabricChannel(0, cap=2)
+    for _ in range(4):
+        ch.record(fields)
+    assert ch.records == 2 and ch.dropped == 2
+    assert len(ch.to_bytes()) == 2 * trev.FB_REC_BYTES
+
+
+def test_fct_table_percentiles():
+    # two flows in class 80, receiver records; integer percentiles
+    rows = [
+        (0, 100, 1, 50_000, 80, 9, trev.FCT_F_RECEIVER, 1000, 10, 0),
+        (0, 300, 2, 50_001, 80, 9,
+         trev.FCT_F_RECEIVER | trev.FCT_F_COMPLETE, 2000, 10, 1),
+        (-1, -1, 3, 50_002, 80, 9, 0, 0, 0, 0),  # dataless: skipped
+    ]
+    table = fct_table(rows)
+    assert list(table) == [80]
+    ent = table[80]
+    assert ent["flows"] == 2 and ent["complete"] == 1
+    assert ent["p50_ns"] == 100 and ent["p99_ns"] == 300
+    assert ent["p999_ns"] == 300
+
+
+# ---------------------------------------------------------------------
+# Conservation + parity sims
+# ---------------------------------------------------------------------
+
+def test_two_run_byte_identity_and_flows(tmp_path):
+    """Lossy 8-host stream tier on the object path: the artifact is
+    non-empty, framed, byte-identical across two runs, and carries
+    one flow record per TCP endpoint that moved payload."""
+    _m, stats, fab = _run(tmp_path, "a", _stream_cfg("serial"))
+    _assert_conserved(stats)
+    assert fab
+    fb, fct = trev.split_fabric(fab)
+    assert fb and len(fb) % trev.FB_REC_BYTES == 0
+    assert fct and len(fct) % trev.FCT_REC_BYTES == 0
+    # every client/handler endpoint that carried payload left a record
+    assert stats["metrics"]["sim"]["fabric"]["flows"] \
+        == len(fct) // trev.FCT_REC_BYTES
+    _m2, stats2, fab2 = _run(tmp_path, "b", _stream_cfg("serial"))
+    assert fab == fab2
+    assert stats["metrics"]["sim"]["fabric"] == \
+        stats2["metrics"]["sim"]["fabric"]
+
+
+def test_engine_path_matches_object_path(tmp_path):
+    """C++ engine (spans + per-round) vs pure-Python object path:
+    byte-identical fabric artifact, identical conservation block."""
+    _ms, stats_s, fab_s = _run(tmp_path, "ser", _stream_cfg("serial"))
+    m_e, stats_e, fab_e = _run(tmp_path, "eng",
+                               _stream_cfg("tpu", device_spans="off"))
+    if m_e.plane is None:
+        pytest.skip("native plane unavailable (no C++ toolchain)")
+    _assert_conserved(stats_e)
+    assert fab_s == fab_e
+    assert stats_s["metrics"]["sim"]["fabric"] == \
+        stats_e["metrics"]["sim"]["fabric"]
+
+
+def test_incast_conservation_under_drops(tmp_path):
+    """The N->1 fan-in smoke (netgen.incast_yaml): the sink's inbound
+    CoDel queue actually builds (deep queue, long sojourn, control-law
+    drops) and conservation still holds exactly, with every drop
+    reconciled against the TEL_* causes."""
+    m, stats, fab = _run(tmp_path, "incast", _incast_cfg("serial"))
+    f = _assert_conserved(stats)
+    assert f["peak_queue_depth"] > 50, f
+    assert f["dropped_pkts"] > 0, "incast built no congestion drops"
+    drops = m.drop_cause_totals()
+    assert drops.get("codel", 0) + drops.get("router-queue", 0) \
+        == f["dropped_pkts"], (drops, f)
+    # the channel saw the buildup: some sample crossed the 5ms target
+    fb, _fct = trev.split_fabric(fab)
+    assert max(r[5] for r in trev.iter_fb_records(fb)) > 5_000_000
+
+
+def test_observatory_off_leaves_no_artifacts(tmp_path):
+    _m, stats, fab = _run(tmp_path, "off",
+                          _stream_cfg("serial", fabric="off"))
+    assert fab == b""
+    assert not os.path.exists(tmp_path / "off" / "fabric-sim.bin")
+    # the conservation counters are ALWAYS on, channel or not
+    f = _assert_conserved(stats)
+    assert "records" not in f  # channel gauges only exist when on
+
+
+def test_interval_thins_the_stream(tmp_path):
+    _m, _stats, fab = _run(tmp_path, "fine", _stream_cfg("serial"))
+    _m2, _stats2, fab2 = _run(
+        tmp_path, "coarse",
+        _stream_cfg("serial", interval=100_000_000))
+    fb, _ = trev.split_fabric(fab)
+    fb2, _ = trev.split_fabric(fab2)
+    assert 0 < len(fb2) < len(fb)
+
+
+@pytest.mark.slow
+def test_device_span_matches_object_path(tmp_path):
+    """The tentpole differential gate: forced TCP device spans on the
+    lossy 8-host tier produce the same fabric bytes — queue samples
+    from the SoA columns inside the while_loop — and the same
+    conservation block as the serial object path."""
+    _ms, stats_s, fab_s = _run(
+        tmp_path, "ser", _stream_cfg("serial", stop="2s"))
+    m_d, stats_d, fab_d = _run(
+        tmp_path, "dev",
+        _stream_cfg("tpu", stop="2s", device_spans="force"))
+    if m_d.plane is None:
+        pytest.skip("native plane unavailable (no C++ toolchain)")
+    runner = m_d._dev_span_tcp
+    assert runner is not None and runner.rounds > 0, \
+        "no rounds ran on the device — the gate proved nothing"
+    _assert_conserved(stats_d)
+    assert fab_s == fab_d
+    assert stats_s["metrics"]["sim"]["fabric"] == \
+        stats_d["metrics"]["sim"]["fabric"]
+
+
+@pytest.mark.slow
+def test_phold_device_span_matches_object_path(tmp_path):
+    """The PHOLD/udp-mesh family's fabric leg: forced device spans on
+    the paced 8-host mesh buffer the same per-round queue samples as
+    the serial object path (the phold kernel has no TCP state, so
+    this exercises the queue/relay columns alone)."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.tools.netgen import mesh_family_yaml
+
+    def cfg(sched, dev=None):
+        c = ConfigOptions.from_yaml_text(mesh_family_yaml(
+            8, stop_time="8s", scheduler=sched, device_spans=dev))
+        c.experimental.sim_fabricstat = "on"
+        return c
+
+    _ms, stats_s, fab_s = _run(tmp_path, "ser", cfg("serial"))
+    m_d, stats_d, fab_d = _run(tmp_path, "dev",
+                               cfg("tpu", dev="force"))
+    if m_d.plane is None:
+        pytest.skip("native plane unavailable (no C++ toolchain)")
+    runner = m_d._dev_span
+    assert runner is not None and runner.rounds > 0, \
+        "no rounds ran on the device — the gate proved nothing"
+    _assert_conserved(stats_d)
+    assert fab_s == fab_d
+    assert stats_s["metrics"]["sim"]["fabric"] == \
+        stats_d["metrics"]["sim"]["fabric"]
+
+
+# ---------------------------------------------------------------------
+# CLI + Chrome export
+# ---------------------------------------------------------------------
+
+def test_fabric_and_fct_reports(tmp_path, capsys):
+    from shadow_tpu.tools import trace as trace_cli
+    _m, _stats, _fab = _run(tmp_path, "cli", _incast_cfg("serial"))
+    data_dir = str(tmp_path / "cli")
+    assert trace_cli.main(["fabric", data_dir]) == 0
+    out = capsys.readouterr().out
+    assert "conservation" in out and "peak queue depth" in out
+    assert "sink" in out  # the hottest link is named
+    assert trace_cli.main(["fct", data_dir]) == 0
+    out = capsys.readouterr().out
+    assert "p99" in out and "8080" in out
+
+
+def test_explain_names_hottest_queue(tmp_path, capsys):
+    """`trace explain` joins the audit with the fabric channel when
+    rounds stalled on outbox pressure (exercised directly through the
+    helper — outbox stalls need a mixed device sim)."""
+    from shadow_tpu.tools import trace as trace_cli
+    _m, _stats, fab = _run(tmp_path, "hq", _incast_cfg("serial"))
+    import io
+    out = io.StringIO()
+    trace_cli._hottest_queue(str(tmp_path / "hq"), fab, out)
+    text = out.getvalue()
+    assert "hottest queue" in text and "sink" in text
+
+
+def test_chrome_per_link_tracks_and_top_n(tmp_path):
+    from shadow_tpu.trace.chrome import PID_FABRIC, chrome_trace
+    _m, _stats, fab = _run(tmp_path, "chrome", _incast_cfg("serial"))
+    fb, _fct = trev.split_fabric(fab)
+    doc = chrome_trace(b"", None, b"", b"", fb, top_n=3)
+    counters = [e for e in doc["traceEvents"]
+                if e.get("ph") == "C" and e.get("pid") == PID_FABRIC]
+    assert counters, "no per-link counter events"
+    links = {e["name"].split()[0] for e in counters}
+    assert len(links) <= 3  # the promoted chrome_top_n cap bites
+    for e in counters[:50]:
+        assert e["args"] and all(
+            isinstance(v, (int, float)) for v in e["args"].values())
+
+
+def test_chrome_top_n_knob_round_trips(tmp_path):
+    """experimental.chrome_top_n: parses from YAML, reaches the
+    processed config, and the CLI reads it back."""
+    import yaml
+
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.tools.trace import _chrome_top_n
+    cfg = _stream_cfg("serial", fabric="off")
+    assert cfg.experimental.chrome_top_n == 16  # default
+    cfg.experimental.chrome_top_n = 5
+    _m, _stats, _fab = _run(tmp_path, "topn", cfg)
+    with open(tmp_path / "topn" / "processed-config.yaml") as f:
+        processed = yaml.safe_load(f)
+    assert processed["experimental"]["chrome_top_n"] == 5
+    assert ConfigOptions.from_yaml_text(
+        yaml.safe_dump(processed)).experimental.chrome_top_n == 5
+    assert _chrome_top_n(str(tmp_path / "topn")) == 5

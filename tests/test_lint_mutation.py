@@ -203,6 +203,70 @@ def test_trace_reason_table_reorder_is_caught(cpp_text):
         [x.render() for x in v]
 
 
+def test_fb_flag_drift_is_caught(cpp_text):
+    """Fabric-observatory activity-mask drift (ISSUE 8): changing an
+    FB_ACT_* bit would silently change which hosts sample — every
+    twin (trace/events + both device kernels) must flag."""
+    mutated = _mutate(cpp_text, "constexpr int FB_ACT_TB_OUT = 2;",
+                      "constexpr int FB_ACT_TB_OUT = 16;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert sum("FB_ACT_TB_OUT" in m for m in msgs) >= 3, msgs
+
+
+def test_fb_record_size_drift_is_caught(cpp_text):
+    """A resized fabric record would desynchronize the engine ring
+    from trace/events.py FB_REC — the size pin must flag (FCT_REC is
+    pinned the same way)."""
+    mutated = _mutate(cpp_text, "constexpr int FB_REC_BYTES = 128;",
+                      "constexpr int FB_REC_BYTES = 136;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("FB_REC_BYTES" in x.message and "136" in x.message
+               for x in v), [x.render() for x in v]
+    mutated = _mutate(cpp_text, "constexpr int FCT_REC_BYTES = 56;",
+                      "constexpr int FCT_REC_BYTES = 64;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("FCT_REC_BYTES" in x.message and "64" in x.message
+               for x in v), [x.render() for x in v]
+
+
+def test_unregistered_fb_constant_fails_closed(cpp_text):
+    """A new FB_*/FCT_* member added engine-side without a contract
+    row (and a Python twin) must fail the pass, not silently
+    under-check."""
+    mutated = _mutate(cpp_text, "constexpr int FB_ACT_LINK = 8;",
+                      "constexpr int FB_ACT_LINK = 8;\n"
+                      "constexpr int FB_ROGUE = 99;\n"
+                      "constexpr int FCT_ROGUE = 98;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("FB_ROGUE" in m and "no contract row" in m
+               for m in msgs), msgs
+    assert any("FCT_ROGUE" in m and "no contract row" in m
+               for m in msgs), msgs
+
+
+def test_fabric_column_rename_is_caught(cpp_text):
+    """The fabric counters ride the span codecs: renaming an export
+    column must fail pass 2 in both directions (dead export + phantom
+    read), exactly like the pre-existing columns."""
+    mutated = _mutate(cpp_text,
+                      'put("codel_enq_bytes", bytes_vec(codel_enq_bytes));\n'
+                      '  put("codel_drop_bytes", bytes_vec(codel_drop_bytes));\n'
+                      '  put("codel_peak", bytes_vec(codel_peak));\n'
+                      '  for (int ri = 1; ri <= 2; ri++) {',
+                      'put("codel_enq_bytesx", bytes_vec(codel_enq_bytes));\n'
+                      '  put("codel_drop_bytes", bytes_vec(codel_drop_bytes));\n'
+                      '  put("codel_peak", bytes_vec(codel_peak));\n'
+                      '  for (int ri = 1; ri <= 2; ri++) {')
+    v = soa_layout.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("'codel_enq_bytesx'" in m and "never consumed" in m
+               for m in msgs), msgs
+    assert any("'codel_enq_bytes'" in m and "never exports" in m
+               for m in msgs), msgs
+
+
 def test_sc_enum_drift_is_caught(shim_text):
     """Syscall-observatory disposition drift (ISSUE 7): swapping two
     SC_* members in the shim shifts their values — every trace/events
