@@ -680,6 +680,107 @@ def incast_rung() -> dict | None:
             "packets": summary.packets_sent, "fabric": fabric}
 
 
+def resume_10k_rung() -> dict | None:
+    """Standing checkpoint/resume rung (ISSUE 9, docs/CHECKPOINT.md):
+    snapshot the 10k Tor-class tgen rung mid-run (5 of 10 sim-s),
+    resume it, and byte-compare the determinism-gated artifacts of the
+    resumed run against the straight run — REFUSING to record numbers
+    if the gate fails.  Records snapshot-write wall, archive size,
+    restore (resume-to-first-round) wall, and the wall seconds the
+    warm start saves vs re-paying the ramp."""
+    import json as _json
+    import re
+    import shutil
+    import tempfile
+
+    from shadow_tpu.core.config import CheckpointConfig
+    from shadow_tpu.core.manager import Manager
+    from shadow_tpu.ckpt.restore import resume_manager
+
+    td = tempfile.mkdtemp(prefix="bench-resume10k-")
+
+    def build(sub, snapdir):
+        cfg = config_10k("tpu", data_dir=os.path.join(td, sub))
+        cfg.checkpoint = CheckpointConfig(
+            at_ns=[SIM_SECONDS_10K * 1_000_000_000 // 2],
+            directory=os.path.join(td, snapdir))
+        return cfg
+
+    def gated(data_dir):
+        out = {}
+        for fn in ("packet-trace.txt", "sim-stats.json"):
+            with open(os.path.join(data_dir, fn), "rb") as f:
+                data = f.read()
+            if fn == "sim-stats.json":
+                stats = _json.loads(data)
+                stats.get("metrics", {}).pop("wall", None)
+                data = _json.dumps(stats, sort_keys=True).encode()
+                data = re.sub(rb'"directory": "[^"]*"', b'"<n>"', data)
+            out[fn] = data
+        return out
+
+    try:
+        mgr = Manager(build("straight", "snaps"))
+        if mgr.plane is None:
+            print("bench[resume-10k]: skipped (no native engine)",
+                  file=sys.stderr)
+            return None
+        t0 = time.perf_counter()
+        s = mgr.run()
+        straight_wall = time.perf_counter() - t0
+        if not s.ok:
+            raise RuntimeError(f"straight run failed: "
+                               f"{s.plugin_errors[:2]}")
+        mgr.write_data_dir(s)
+        snap = mgr.ckpt_last_path
+        snap_wall = mgr.ckpt_write_wall_s
+        snap_bytes = os.path.getsize(snap)
+
+        t0 = time.perf_counter()
+        mgr2 = resume_manager(build("resumed", "snaps2"), snap)
+        restore_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        s2 = mgr2.run()
+        resume_run_wall = time.perf_counter() - t0
+        if not s2.ok:
+            raise RuntimeError(f"resumed run failed: "
+                               f"{s2.plugin_errors[:2]}")
+        mgr2.write_data_dir(s2)
+
+        a = gated(os.path.join(td, "straight"))
+        b = gated(os.path.join(td, "resumed"))
+        bad = [fn for fn in a if a[fn] != b[fn]]
+        if bad:
+            # The whole point of the rung: never record perf numbers
+            # for a resume that is not byte-identical.
+            raise RuntimeError(f"byte-identity gate FAILED on {bad} — "
+                               f"refusing to record")
+        # Honest ramp accounting: the straight run paid the snapshot
+        # write too, so the warm start saves (sim wall of the first
+        # half) minus (restore + remainder) — negative when the
+        # remaining workload is smaller than the restore cost, which
+        # is exactly what an operator needs to know.
+        sim_wall = straight_wall - snap_wall
+        ramp_saved = sim_wall - (restore_wall + resume_run_wall)
+        print(f"bench[resume-10k]: snapshot {snap_bytes / 1e6:.1f} MB "
+              f"in {snap_wall:.2f}s at sim {SIM_SECONDS_10K / 2:.0f}s; "
+              f"restore {restore_wall:.2f}s + remainder "
+              f"{resume_run_wall:.1f}s vs straight {sim_wall:.1f}s "
+              f"sim wall (warm start saves {ramp_saved:.1f}s); "
+              f"byte-identity gate ok", file=sys.stderr)
+        return {
+            "snapshot_write_wall_s": round(snap_wall, 3),
+            "snapshot_bytes": snap_bytes,
+            "restore_wall_s": round(restore_wall, 3),
+            "resumed_run_wall_s": round(resume_run_wall, 3),
+            "straight_run_wall_s": round(sim_wall, 3),
+            "ramp_saved_wall_s": round(ramp_saved, 3),
+            "byte_identity": "ok",
+        }
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def scale_100k_rung() -> dict | None:
     """Standing >=100k-host scale rung (engine path): 100k PHOLD LPs
     with ring peer lists stepped through C++ multi-round spans — the
@@ -929,6 +1030,15 @@ def main() -> None:
         print(f"bench[incast-32]: failed: {e}", file=sys.stderr)
         incast = None
 
+    # Checkpoint/resume rung (ISSUE 9): snapshot the 10k rung mid-run,
+    # resume, byte-compare — numbers recorded only when the identity
+    # gate holds (engine path, no tunnel risk).
+    try:
+        resume_10k = resume_10k_rung()
+    except Exception as e:  # noqa: BLE001 — never cost the headline
+        print(f"bench[resume-10k]: failed: {e}", file=sys.stderr)
+        resume_10k = None
+
     # Managed-process emulator rung (real binaries under the shim) —
     # recorded in the headline JSON with syscalls_per_sec, the SC_*
     # disposition histogram and the IPC wall breakdown (ISSUE 7 /
@@ -1004,6 +1114,11 @@ def main() -> None:
         # fan-in rung with its conservation gate.
         "fabric": tpu_obs.get("fabric", {}),
         "incast": incast,
+        # Checkpoint/resume (ISSUE 9): snapshot size + write wall,
+        # restore wall and the wall saved by warm-starting past the
+        # 10k rung's first half — recorded ONLY when the resumed run
+        # is byte-identical to the straight run.
+        "resume_10k": resume_10k,
     }), flush=True)
 
     # Auxiliary rungs (stderr only).  A failure must not cost the

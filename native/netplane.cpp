@@ -147,8 +147,33 @@ constexpr int TRACE_RCV = 2;
  * path and re-stamps the refined eligibility reason. */
 constexpr int FLIGHT_REC_BYTES = 32;
 
-/* flight event kinds */
-enum { FR_ROUND = 0, FR_SPAN_START, FR_SPAN_COMMIT, FR_SPAN_ABORT, FR_N };
+/* flight event kinds.  The FR_FAULT_* members are the deterministic
+ * fault-injection records (docs/CHECKPOINT.md): the manager's round
+ * loop — the ONE fault choke point — stamps them at the round boundary
+ * where each configured fault applies (a = host id).  The engine never
+ * emits them itself; the enum lives here because the FR_* namespace is
+ * twinned with trace/events.py and registered fail-closed in analysis
+ * pass 1. */
+enum { FR_ROUND = 0, FR_SPAN_START, FR_SPAN_COMMIT, FR_SPAN_ABORT,
+       FR_FAULT_KILL, FR_FAULT_RESTORE, FR_FAULT_LINK_DOWN,
+       FR_FAULT_LINK_UP, FR_FAULT_BLACKHOLE, FR_FAULT_CLEAR, FR_N };
+
+/* Checkpoint plane-blob framing (shadow_tpu/ckpt/format.py is the
+ * Python twin; analysis pass 1 registers every CK_* constant
+ * fail-closed).  plane_export writes:
+ *   [CK_PLANE_MAGIC u32][CK_PLANE_VERSION u32][n_frames u32][pad u32]
+ *   [state_epoch u64]                          (CK_PLANE_HDR_BYTES)
+ * one global frame, then one frame per engine host, each framed as
+ *   [host id u32 (0xFFFFFFFF = the global frame)][byte length u64]
+ *                                               (CK_FRAME_HDR_BYTES)
+ * Import and export share ONE field-visitor per struct (ck_visit
+ * overloads below), so the two directions cannot drift from each
+ * other; cross-build drift is caught by the version gate. */
+constexpr uint32_t CK_PLANE_MAGIC = 0x53544350;  /* "STCP" */
+constexpr uint32_t CK_PLANE_VERSION = 1;
+constexpr int CK_PLANE_HDR_BYTES = 24;
+constexpr int CK_FRAME_HDR_BYTES = 12;
+constexpr uint32_t CK_GLOBAL_FRAME = 0xFFFFFFFFu;
 
 /* device-eligibility reason codes: one per conservative round */
 enum {
@@ -206,9 +231,10 @@ enum {
   TEL_CODEL = 0, TEL_RTR_LIMIT, TEL_LOSS_EDGE, TEL_UNREACHABLE,
   TEL_NO_ROUTE, TEL_NO_SOCKET, TEL_TCP_STATE, TEL_BACKLOG_FULL,
   TEL_UDP_FILTER, TEL_RECVBUF_FULL, TEL_BUCKET_DEFER,
+  TEL_HOST_DOWN, TEL_LINK_DOWN,
   TEL_REASM_FULL, TEL_RECVWIN_TRUNC, TEL_N,
 };
-constexpr int TEL_WIRE_N = 11;
+constexpr int TEL_WIRE_N = 13;
 
 /* Order mirrors the TEL_* enum (and trace/events.py TEL_NAMES). */
 static const char *TEL_NAMES[TEL_N] = {
@@ -223,6 +249,8 @@ static const char *TEL_NAMES[TEL_N] = {
     "udp-filter",
     "recv-buffer-full",
     "bucket-defer-overflow",
+    "host-down",
+    "link-down",
     "reassembly-full",
     "recv-window-trunc",
 };
@@ -246,6 +274,8 @@ inline int tel_cause_of(const char *reason) {
       {"accept-backlog-full", TEL_BACKLOG_FULL},
       {"udp-connected-filter", TEL_UDP_FILTER},
       {"rcvbuf-full", TEL_RECVBUF_FULL},
+      {"host-down", TEL_HOST_DOWN},
+      {"link-down", TEL_LINK_DOWN},
   };
   for (const Ent &e : tbl)
     if (std::strcmp(reason, e.r) == 0) return e.c;
@@ -1566,6 +1596,16 @@ struct HostPlane {
   uint64_t rng_counter = 0;
   bool rng_native = false;
   int64_t now = 0;
+  /* Fault-injection state (docs/CHECKPOINT.md; set_host_fault): a
+   * DOWN host consumes no events — packet arrivals drop with the
+   * TEL_HOST_DOWN cause at their recorded arrival instant (times are
+   * path-independent, so the drop set is identical on every
+   * scheduler) and its timers discard silently; LINK_DOWN drops both
+   * directions at the NIC (arrivals like blackhole, sends at the
+   * router-egress instant, both TEL_LINK_DOWN); BLACKHOLE drops
+   * arrivals only — the host still runs and sends.  Python twin:
+   * Host.down / link_down / blackhole in host/host.py. */
+  bool down = false, link_down = false, blackhole = false;
   IfaceN lo, eth;
   CoDelN codel;
   RelayN relays[3];  // 0 loopback, 1 inet-out, 2 inet-in
@@ -1701,6 +1741,223 @@ constexpr int APP_SERVER = 0, APP_CLIENT = 1, APP_HANDLER = 2,
 constexpr int CL_CONNECTING = 1, CL_RECV = 3;
 /* handler states */
 constexpr int H_REQ = 0, H_SEND = 1, H_DRAIN = 2;
+
+/* ---------------- checkpoint archives ----------------------------- */
+/* One field-visitor per struct serves BOTH directions (CkW writes,
+ * CkR reads): export and import share the single field list, so the
+ * two sides cannot drift from each other — the 4-side hazard the span
+ * codecs need analysis pass 2 for is structurally absent here.  All
+ * scalars are written as raw little-endian PODs (the engine only
+ * targets little-endian hosts; the Python side re-checks the magic).
+ * Containers write a u64 count then elements; maps write entries in
+ * sorted key order so two snapshots of identical simulations are
+ * byte-identical (ckpt `diff` relies on this). */
+
+struct CkW {
+  static constexpr bool loading = false;
+  std::string buf;
+  bool ok = true;
+  void raw(const void *p, size_t n) { buf.append((const char *)p, n); }
+  template <typename T> void num(T &v) { raw(&v, sizeof v); }
+  void str(std::string &s) {
+    uint64_t n = s.size();
+    num(n);
+    raw(s.data(), n);
+  }
+};
+
+struct CkR {
+  static constexpr bool loading = true;
+  const uint8_t *p, *end;
+  bool ok = true;
+  CkR(const uint8_t *b, size_t n) : p(b), end(b + n) {}
+  void raw(void *d, size_t n) {
+    if ((size_t)(end - p) < n) {
+      ok = false;
+      std::memset(d, 0, n);
+      return;
+    }
+    std::memcpy(d, p, n);
+    p += n;
+  }
+  template <typename T> void num(T &v) { raw(&v, sizeof v); }
+  void str(std::string &s) {
+    uint64_t n = 0;
+    num(n);
+    if (!ok || (size_t)(end - p) < n) {
+      ok = false;
+      s.clear();
+      return;
+    }
+    s.assign((const char *)p, (size_t)n);
+    p += n;
+  }
+};
+
+/* u64 container-count helper: write size / read-and-return.  On load
+ * the count is bounded by the frame's remaining bytes (every element
+ * serializes at least one byte), so a corrupt count — the CRC only
+ * guards accidental damage — fails the frame instead of driving a
+ * huge allocation. */
+template <class Ar, class C>
+uint64_t ck_count(Ar &a, C &c) {
+  uint64_t n = (uint64_t)c.size();
+  a.num(n);
+  if constexpr (Ar::loading) {
+    if (n > (uint64_t)(a.end - a.p)) {
+      a.ok = false;
+      return 0;
+    }
+  }
+  return n;
+}
+
+template <class Ar> void ck_visit(Ar &a, TcpHdrN &h) {
+  a.num(h.seq); a.num(h.ack); a.num(h.flags); a.num(h.window);
+  a.num(h.wscale); a.num(h.mss); a.num(h.n_sacks);
+  if constexpr (Ar::loading) {
+    /* a corrupt count must never survive into the live header:
+     * mark_sacked iterates n_sacks over the 3-slot array */
+    if (h.n_sacks < 0 || h.n_sacks > MAX_SACK_BLOCKS) {
+      a.ok = false;
+      h.n_sacks = 0;
+    }
+  }
+  /* only the valid blocks: slots past n_sacks are never written by
+   * sack_blocks and would serialize indeterminate memory */
+  for (int i = 0; i < MAX_SACK_BLOCKS; i++) {
+    if (i < h.n_sacks) {
+      a.num(h.sacks[i].start);
+      a.num(h.sacks[i].end);
+    } else if constexpr (Ar::loading) {
+      h.sacks[i] = SackBlock{0, 0};
+    }
+  }
+  a.num(h.ts_val); a.num(h.ts_ecr);
+}
+
+/* PacketN minus live/gen (handles are re-allocated on import). */
+template <class Ar> void ck_visit(Ar &a, PacketN &p) {
+  a.num(p.src_host); a.num(p.seq); a.num(p.proto);
+  a.num(p.src_ip); a.num(p.dst_ip);
+  a.num(p.src_port); a.num(p.dst_port);
+  a.str(p.payload);
+  a.num(p.has_tcp);
+  ck_visit(a, p.tcp);
+  a.num(p.priority);
+}
+
+template <class Ar> void ck_visit(Ar &a, TokenBucketN &b) {
+  a.num(b.capacity); a.num(b.refill_size); a.num(b.refill_interval);
+  a.num(b.balance); a.num(b.next_refill); a.num(b.unlimited);
+}
+
+template <class Ar> void ck_visit(Ar &a, ByteDeque &d) {
+  /* Chunk boundaries are semantics-invariant (take/peek cross them
+   * transparently): serialize as one string, restore as one chunk. */
+  if constexpr (Ar::loading) {
+    std::string s;
+    a.str(s);
+    d.chunks.clear();
+    d.len = 0;
+    if (!s.empty()) d.append(std::move(s));
+  } else {
+    std::string s;
+    for (const auto &c : d.chunks) s += c;
+    a.str(s);
+  }
+}
+
+template <class Ar> void ck_visit(Ar &a, RtxSeg &s) {
+  a.num(s.seq); a.str(s.payload); a.num(s.is_fin);
+  a.num(s.sent_at); a.num(s.retransmitted); a.num(s.sacked);
+}
+
+template <class Ar> void ck_visit(Ar &a, FctRec &r) {
+  a.num(r.t_first); a.num(r.t_last); a.num(r.host);
+  a.num(r.lport); a.num(r.rport); a.num(r.rip); a.num(r.flags);
+  a.num(r.bytes_in); a.num(r.bytes_out); a.num(r.rtx);
+}
+
+template <class Ar> void ck_visit(Ar &a, TcpConn &c) {
+  a.num(c.state); a.num(c.iss); a.num(c.wscale_offer);
+  a.num(c.snd_una); a.num(c.snd_nxt); a.num(c.snd_wnd);
+  ck_visit(a, c.send_buf);
+  a.num(c.send_buf_max); a.num(c.snd_fin_pending); a.num(c.fin_seq);
+  uint64_t n = ck_count(a, c.rtx);
+  if constexpr (Ar::loading) c.rtx.resize((size_t)n);
+  for (auto &seg : c.rtx) ck_visit(a, seg);
+  a.num(c.irs); a.num(c.rcv_nxt);
+  ck_visit(a, c.recv_buf);
+  a.num(c.recv_buf_max);
+  if constexpr (Ar::loading) {
+    uint64_t m = ck_count(a, c.reassembly);
+    c.reassembly.clear();
+    for (uint64_t i = 0; i < m && a.ok; i++) {
+      uint32_t k = 0;
+      std::string v;
+      a.num(k);
+      a.str(v);
+      c.reassembly.emplace(k, std::move(v));
+    }
+  } else {
+    ck_count(a, c.reassembly);
+    std::vector<uint32_t> keys;
+    keys.reserve(c.reassembly.size());
+    for (auto &kv : c.reassembly) keys.push_back(kv.first);
+    std::sort(keys.begin(), keys.end());
+    for (uint32_t k : keys) {
+      a.num(k);
+      a.str(c.reassembly.at(k));
+    }
+  }
+  a.num(c.peer_fin_seq); a.num(c.pending_fin_seq);
+  a.num(c.our_wscale); a.num(c.peer_wscale); a.num(c.eff_mss);
+  a.num(c.delayed_ack); a.num(c.nagle); a.num(c.nodelay);
+  a.num(c.delack_deadline); a.num(c.segs_since_ack);
+  a.num(c.persist_deadline); a.num(c.persist_interval);
+  a.num(c.cong_mss); a.num(c.cwnd); a.num(c.ssthresh);
+  a.num(c.dupacks); a.num(c.in_fast_recovery); a.num(c.recover);
+  a.num(c.srtt); a.num(c.rttvar); a.num(c.rto);
+  a.num(c.rto_deadline); a.num(c.time_wait_deadline);
+  a.num(c.ts_recent); a.num(c.rto_backoff);
+  n = ck_count(a, c.outbox);
+  if constexpr (Ar::loading) c.outbox.resize((size_t)n);
+  for (auto &seg : c.outbox) {
+    ck_visit(a, seg.hdr);
+    a.str(seg.payload);
+  }
+  a.str(c.error);
+  a.num(c.syn_retries);
+  a.num(c.retransmit_count); a.num(c.segments_sent);
+  a.num(c.segments_received); a.num(c.sacked_skip_count);
+  a.num(c.reasm_discards); a.num(c.rcvwin_trunc);
+  a.num(c.fct_first); a.num(c.fct_last);
+  a.num(c.fct_bytes_in); a.num(c.fct_bytes_out);
+}
+
+template <class Ar> void ck_visit(Ar &a, AppN &ap) {
+  a.num(ap.kind); a.num(ap.hid); a.num(ap.state);
+  a.num(ap.wait_mask); a.num(ap.wake_pending);
+  a.num(ap.exited); a.num(ap.exit_code); a.num(ap.exit_time);
+  a.num(ap.sock);  /* old token; caller remaps */
+  a.num(ap.send_buf); a.num(ap.recv_buf); a.num(ap.sat); a.num(ap.rat);
+  a.num(ap.port); a.num(ap.dst_ip); a.num(ap.dst_port);
+  a.num(ap.nbytes); a.num(ap.count); a.num(ap.xfer_i);
+  a.num(ap.got); a.num(ap.t0);
+  a.str(ap.req);
+  a.num(ap.resp_n); a.num(ap.sent);
+  a.num(ap.size); a.num(ap.interval); a.num(ap.expect);
+  a.num(ap.sent_i); a.num(ap.got_n);
+  uint64_t n = ck_count(a, ap.peers);
+  if constexpr (Ar::loading) ap.peers.resize((size_t)n);
+  for (auto &ip : ap.peers) a.num(ip);
+  a.num(ap.mesh_peer);  /* old app index; caller remaps */
+  a.num(ap.part_done); a.num(ap.stopped); a.num(ap.stop_wake);
+  a.num(ap.stop_seq); a.num(ap.wait_seq);
+  a.num(ap.lcg); a.num(ap.phold_target);
+  a.str(ap.out);
+}
 
 /* ---------------- engine ------------------------------------------ */
 
@@ -2031,6 +2288,16 @@ struct Engine {
        * round's batched propagation phase (finish_round). */
       hp->pkts_sent++;
       PacketN *p = store.get(id);
+      if (hp->link_down) {
+        /* NIC link down: the send dies at the egress instant, BEFORE
+         * the event-seq draw — the same position as the no-route
+         * drop, so the seq stream matches the Python propagator's
+         * (which checks link_down before drawing).  docs/CHECKPOINT.md
+         * fault semantics. */
+        trace_drop(hp, p, "link-down", now);
+        store.free_pkt(id);
+        return;
+      }
       auto it = ip_to_host.find(p->dst_ip);
       if (it == ip_to_host.end()) {
         trace_drop(hp, p, "no-route", now);
@@ -2278,6 +2545,13 @@ struct Engine {
     PacketN *p = store.get(id);
     if (!p) return;
     hp->now = now;
+    if (hp->down || hp->link_down || hp->blackhole) {
+      /* Mixed-plane arrival (object-path origin): same fault drop as
+       * the run_until inbox pop — one semantics on every path. */
+      trace_drop(hp, p, hp->down ? "host-down" : "link-down", now);
+      store.free_pkt(id);
+      return;
+    }
     if (!hp->codel.push(id, p->total_size(), now)) {
       trace_drop(hp, p, "rtr-limit", now);
       store.free_pkt(id);
@@ -2291,6 +2565,7 @@ struct Engine {
     HostPlane *hp = plane(hid);
     if (hp->theap.empty()) return;
     hp->now = now;
+    if (hp->down) { hp->tpop(); return; }  // dead host: timers discard
     TimerEnt e = hp->tpop();
     if (e.kind == TK_RELAY) {
       RelayN &r = hp->relays[e.target];
@@ -2351,13 +2626,24 @@ struct Engine {
         InboxEnt i = hp->ipop();
         PacketN *p = store.get(i.pkt);
         if (p) {
-          if (!hp->codel.push(i.pkt, p->total_size(), et)) {
+          if (hp->down || hp->link_down || hp->blackhole) {
+            /* Fault semantics: arrivals at a dead/blackholed NIC drop
+             * at their (path-independent) arrival instant — never
+             * touching the CoDel ledger, so fabric conservation stays
+             * exact (the packet never entered any queue). */
+            trace_drop(hp, p, hp->down ? "host-down" : "link-down", et);
+            store.free_pkt(i.pkt);
+          } else if (!hp->codel.push(i.pkt, p->total_size(), et)) {
             trace_drop(hp, p, "rtr-limit", et);
             store.free_pkt(i.pkt);
           } else {
             relay_notify(hp, 2, et);
           }
         }
+      } else if (hp->down) {
+        /* A dead host's timers (relay refills, TCP deadlines, app
+         * wakes) discard silently: its kernel state is frozen. */
+        hp->tpop();
       } else {
         TimerEnt e = hp->tpop();
         if (e.kind == TK_RELAY) {
@@ -3621,6 +3907,740 @@ struct Engine {
     }
     round_outbox.clear();
     return r;
+  }
+
+  /* ====== checkpoint: full-plane export / import =================
+   * The mutable engine state of every plane host, serialized through
+   * the shared ck_visit field visitors (one list per struct serves
+   * both directions).  Static state — routing matrices, callbacks,
+   * config-derived host parameters — is NOT serialized: restore
+   * rebuilds a fresh Manager from config first, then imports this
+   * blob over it.  Packets serialize INLINE at their single owning
+   * reference (codel queue, relay pending, socket queues, inbox), so
+   * each host frame is self-contained and single-host import (the
+   * host_restore fault) allocates fresh handles with no global remap.
+   * Socket tokens and app indices are remapped per host on import;
+   * neither value is observable (heap tiebreaks never compare them,
+   * every walker re-sorts by simulation identity). */
+
+  struct CkHostCtx {
+    std::unordered_map<int64_t, int64_t> tokmap;  /* old tok -> new */
+    std::unordered_map<int64_t, int64_t> appmap;  /* old idx -> new */
+    std::vector<uint32_t> new_toks;
+    std::vector<int64_t> new_apps;
+    int64_t floor = -1;  /* >=0: bump restored event times up to it */
+  };
+
+  /* Inline single-owner packet reference. */
+  template <class Ar> void ck_pkt(Ar &a, uint64_t &id) {
+    uint8_t have;
+    if constexpr (Ar::loading) {
+      a.num(have);
+      if (!have) { id = UINT64_MAX; return; }
+      id = store.alloc();
+      ck_visit(a, *store.get(id));
+    } else {
+      have = id != UINT64_MAX && store.get(id) ? 1 : 0;
+      a.num(have);
+      if (have) ck_visit(a, *store.get(id));
+    }
+  }
+
+  template <class Ar> void ck_pkt_deque(Ar &a, std::deque<uint64_t> &q) {
+    uint64_t n = ck_count(a, q);
+    if constexpr (Ar::loading) q.assign((size_t)n, UINT64_MAX);
+    for (auto &id : q) ck_pkt(a, id);
+  }
+
+  template <class Ar> void ck_sock_base(Ar &a, SocketN &s) {
+    a.num(s.has_local); a.num(s.local_ip); a.num(s.local_port);
+    a.num(s.has_peer); a.num(s.peer_ip); a.num(s.peer_port);
+    a.num(s.reuseaddr); a.num(s.nonblocking); a.num(s.status);
+    a.num(s.ifaces_mask); a.num(s.queued[0]); a.num(s.queued[1]);
+    a.num(s.app_owner);  /* old app index; fixed up after the app pass */
+  }
+
+  template <class Ar> void ck_sock_tcp(Ar &a, TcpSocketN &t) {
+    a.num(t.nodelay); a.num(t.send_buf_max); a.num(t.recv_buf_max);
+    a.num(t.send_autotune); a.num(t.recv_autotune);
+    a.num(t.at_bytes_copied); a.num(t.at_space); a.num(t.at_last_adjust);
+    a.num(t.iface);
+    uint8_t has_conn;
+    if constexpr (Ar::loading) {
+      a.num(has_conn);
+      if (has_conn) {
+        t.conn = std::make_unique<TcpConn>(0u, t.recv_buf_max,
+                                           t.send_buf_max, -1);
+        ck_visit(a, *t.conn);
+      } else {
+        t.conn.reset();
+      }
+    } else {
+      has_conn = t.conn ? 1 : 0;
+      a.num(has_conn);
+      if (has_conn) ck_visit(a, *t.conn);
+    }
+    a.num(t.listening); a.num(t.backlog);
+    uint64_t n = ck_count(a, t.accept_q);
+    if constexpr (Ar::loading) t.accept_q.assign((size_t)n, 0);
+    for (auto &c : t.accept_q) a.num(c);  /* old toks; fixed up below */
+    a.num(t.listener);                    /* old tok; fixed up below */
+    a.num(t.accept_queued); a.num(t.delivered); a.num(t.app_closed);
+    ck_pkt_deque(a, t.out_packets[0]);
+    ck_pkt_deque(a, t.out_packets[1]);
+    a.num(t.timer_deadline);
+  }
+
+  template <class Ar> void ck_sock_udp(Ar &a, UdpSocketN &u) {
+    ck_pkt_deque(a, u.send_q[0]);
+    ck_pkt_deque(a, u.send_q[1]);
+    a.num(u.send_bytes); a.num(u.send_max);
+    ck_pkt_deque(a, u.recv_q);
+    a.num(u.recv_bytes); a.num(u.recv_max);
+    a.num(u.drops_full_recv);
+  }
+
+  template <class Ar> void ck_iface(Ar &a, IfaceN &ifc, CkHostCtx &cx,
+                                    std::string *err) {
+    a.num(ifc.packets_sent); a.num(ifc.packets_received);
+    a.num(ifc.bytes_sent); a.num(ifc.bytes_received);
+    if constexpr (Ar::loading) {
+      uint64_t n = 0;
+      a.num(n);
+      ifc.assoc.clear();
+      for (uint64_t i = 0; i < n && a.ok; i++) {
+        AssocKey k{};
+        int64_t tok = 0;
+        a.num(k.ip); a.num(k.peer_ip); a.num(k.port);
+        a.num(k.peer_port); a.num(k.proto);
+        a.num(tok);
+        auto it = cx.tokmap.find(tok);
+        if (it == cx.tokmap.end()) {
+          *err = "assoc references an unknown socket";
+          a.ok = false;
+          return;
+        }
+        ifc.assoc.emplace(k, (uint32_t)it->second);
+      }
+      a.num(n);
+      ifc.port_use.clear();
+      for (uint64_t i = 0; i < n && a.ok; i++) {
+        uint32_t k = 0;
+        int v = 0;
+        a.num(k); a.num(v);
+        ifc.port_use.emplace(k, v);
+      }
+      a.num(n);
+      if (n > (uint64_t)(a.end - a.p)) { a.ok = false; return; }
+      ifc.send_heap.assign((size_t)n, {0, 0});
+      for (auto &e : ifc.send_heap) {
+        int64_t tok = 0;
+        a.num(e.first);
+        a.num(tok);
+        auto it = cx.tokmap.find(tok);
+        if (it == cx.tokmap.end()) { a.ok = false; return; }
+        e.second = (uint32_t)it->second;
+      }
+      a.num(n);
+      if (n > (uint64_t)(a.end - a.p)) { a.ok = false; return; }
+      ifc.send_ready.assign((size_t)n, 0);
+      for (auto &tokref : ifc.send_ready) {
+        int64_t tok = 0;
+        a.num(tok);
+        auto it = cx.tokmap.find(tok);
+        if (it == cx.tokmap.end()) { a.ok = false; return; }
+        tokref = (uint32_t)it->second;
+      }
+    } else {
+      /* maps in sorted key order: snapshots of identical sims are
+       * byte-identical (ckpt diff depends on this) */
+      uint64_t n = ck_count(a, ifc.assoc);
+      (void)n;
+      std::vector<AssocKey> keys;
+      keys.reserve(ifc.assoc.size());
+      for (auto &kv : ifc.assoc) keys.push_back(kv.first);
+      std::sort(keys.begin(), keys.end(),
+                [](const AssocKey &x, const AssocKey &y) {
+                  return std::tie(x.ip, x.peer_ip, x.port, x.peer_port,
+                                  x.proto) <
+                         std::tie(y.ip, y.peer_ip, y.port, y.peer_port,
+                                  y.proto);
+                });
+      for (auto &k : keys) {
+        AssocKey kk = k;
+        int64_t tok = (int64_t)ifc.assoc.at(k);
+        a.num(kk.ip); a.num(kk.peer_ip); a.num(kk.port);
+        a.num(kk.peer_port); a.num(kk.proto);
+        a.num(tok);
+      }
+      ck_count(a, ifc.port_use);
+      std::vector<uint32_t> pkeys;
+      pkeys.reserve(ifc.port_use.size());
+      for (auto &kv : ifc.port_use) pkeys.push_back(kv.first);
+      std::sort(pkeys.begin(), pkeys.end());
+      for (uint32_t k : pkeys) {
+        uint32_t kk = k;
+        int v = ifc.port_use.at(k);
+        a.num(kk); a.num(v);
+      }
+      ck_count(a, ifc.send_heap);
+      for (auto &e : ifc.send_heap) {
+        int64_t tok = (int64_t)e.second;
+        a.num(e.first);
+        a.num(tok);
+      }
+      ck_count(a, ifc.send_ready);
+      for (auto tok : ifc.send_ready) {
+        int64_t t = (int64_t)tok;
+        a.num(t);
+      }
+    }
+  }
+
+  template <class Ar> void ck_codel(Ar &a, CoDelN &c) {
+    uint64_t n = ck_count(a, c.q);
+    if constexpr (Ar::loading) c.q.assign((size_t)n, {UINT64_MAX, 0});
+    for (auto &e : c.q) {
+      a.num(e.second);  /* enqueue time */
+      ck_pkt(a, e.first);
+    }
+    a.num(c.bytes); a.num(c.dropping); a.num(c.count);
+    a.num(c.last_count); a.num(c.first_above); a.num(c.drop_next);
+    a.num(c.dropped_count);
+    a.num(c.enq_pkts); a.num(c.enq_bytes); a.num(c.drop_bytes);
+    a.num(c.peak_depth); a.num(c.marked);
+  }
+
+  template <class Ar> void ck_relay(Ar &a, RelayN &r) {
+    a.num(r.state);
+    ck_pkt(a, r.pending);
+    ck_visit(a, r.bucket);
+    a.num(r.stalls); a.num(r.fwd_pkts); a.num(r.fwd_bytes);
+  }
+
+  /* One host's complete mutable state.  The import side allocates
+   * fresh socket tokens / app indices / packet handles and remaps
+   * every intra-host reference; cross-host references do not exist
+   * (packets carry value identity, not handles). */
+  template <class Ar>
+  void ck_host_body(Ar &a, int hid, CkHostCtx &cx, std::string *err) {
+    HostPlane *hp = plane(hid);
+    uint32_t eth = hp->eth_ip;
+    a.num(eth);
+    if constexpr (Ar::loading) {
+      if (eth != hp->eth_ip) {
+        *err = "snapshot host ip does not match the rebuilt config";
+        a.ok = false;
+        return;
+      }
+    }
+    a.num(hp->qdisc); a.num(hp->bw_up_bits); a.num(hp->bw_down_bits);
+    a.num(hp->event_seq); a.num(hp->packet_seq);
+    a.num(hp->rng_k0); a.num(hp->rng_k1); a.num(hp->rng_counter);
+    a.num(hp->rng_native);
+    a.num(hp->now); a.num(hp->tracing);
+    a.num(hp->down); a.num(hp->link_down); a.num(hp->blackhole);
+    a.num(hp->has_py_socks);
+    a.num(hp->pkts_sent); a.num(hp->pkts_recv); a.num(hp->pkts_dropped);
+    a.num(hp->events_run);
+    for (int i = 0; i < ASYS_N; i++) a.num(hp->app_sys[i]);
+    for (int i = 0; i < TEL_N; i++) a.num(hp->drop_causes[i]);
+    a.num(hp->drop_unattributed);
+
+    /* sockets (ascending token order) */
+    if constexpr (Ar::loading) {
+      uint64_t n = 0;
+      a.num(n);
+      for (uint64_t i = 0; i < n && a.ok; i++) {
+        uint8_t kind = 0;
+        int64_t old = 0;
+        a.num(kind);
+        a.num(old);
+        uint32_t nt2 = kind == 0 ? new_tcp(hid, 0, 0, true, true)
+                                 : new_udp(hid, 0, 0);
+        cx.tokmap[old] = nt2;
+        cx.new_toks.push_back(nt2);
+        SocketN *s = sock(nt2);
+        ck_sock_base(a, *s);
+        if (kind == 0) ck_sock_tcp(a, *static_cast<TcpSocketN *>(s));
+        else ck_sock_udp(a, *static_cast<UdpSocketN *>(s));
+      }
+    } else {
+      std::vector<uint32_t> toks;
+      for (size_t t = 0; t < socks.size(); t++)
+        if (socks[t] != nullptr && socks[t]->host == hid)
+          toks.push_back((uint32_t)t);
+      uint64_t n = toks.size();
+      a.num(n);
+      for (uint32_t tok : toks) {
+        SocketN *s = socks[tok].get();
+        uint8_t kind = s->proto == PROTO_TCP ? 0 : 1;
+        int64_t old = (int64_t)tok;
+        a.num(kind);
+        a.num(old);
+        ck_sock_base(a, *s);
+        if (kind == 0) ck_sock_tcp(a, *static_cast<TcpSocketN *>(s));
+        else ck_sock_udp(a, *static_cast<UdpSocketN *>(s));
+      }
+    }
+
+    /* engine-resident apps (ascending index order) */
+    if constexpr (Ar::loading) {
+      uint64_t n = 0;
+      a.num(n);
+      for (uint64_t i = 0; i < n && a.ok; i++) {
+        int64_t old = 0;
+        a.num(old);
+        int64_t ni = (int64_t)apps.append();
+        cx.appmap[old] = ni;
+        cx.new_apps.push_back(ni);
+        ck_visit(a, apps[(size_t)ni]);
+        apps[(size_t)ni].hid = hid;
+      }
+    } else {
+      std::vector<int64_t> idxs;
+      for (size_t i = 0; i < apps.size(); i++)
+        if (apps[i].hid == hid) idxs.push_back((int64_t)i);
+      uint64_t n = idxs.size();
+      a.num(n);
+      for (int64_t idx : idxs) {
+        int64_t old = idx;
+        a.num(old);
+        ck_visit(a, apps[(size_t)idx]);
+      }
+    }
+
+    /* intra-host reference fixups (import only) */
+    if constexpr (Ar::loading) {
+      auto map_tok = [&](int64_t old, int64_t *out2) {
+        auto it = cx.tokmap.find(old);
+        if (it == cx.tokmap.end()) return false;
+        *out2 = it->second;
+        return true;
+      };
+      auto map_app = [&](int64_t old, int64_t *out2) {
+        auto it = cx.appmap.find(old);
+        if (it == cx.appmap.end()) return false;
+        *out2 = it->second;
+        return true;
+      };
+      for (uint32_t t : cx.new_toks) {
+        SocketN *s = sock(t);
+        int64_t m;
+        if (s->app_owner >= 0) {
+          if (!map_app(s->app_owner, &m)) { a.ok = false; break; }
+          s->app_owner = (int32_t)m;
+        }
+        TcpSocketN *ts = s->proto == PROTO_TCP
+                             ? static_cast<TcpSocketN *>(s) : nullptr;
+        if (ts == nullptr) continue;
+        for (auto &c : ts->accept_q) {
+          if (!map_tok((int64_t)c, &m)) { a.ok = false; break; }
+          c = (uint32_t)m;
+        }
+        if (ts->listener >= 0) {
+          if (!map_tok(ts->listener, &m)) { a.ok = false; break; }
+          ts->listener = (int32_t)m;
+        }
+      }
+      for (int64_t ai : cx.new_apps) {
+        AppN &ap = apps[(size_t)ai];
+        int64_t m;
+        if (ap.sock >= 0) {
+          if (!map_tok(ap.sock, &m)) { a.ok = false; break; }
+          ap.sock = m;
+        }
+        if (ap.mesh_peer >= 0) {
+          if (!map_app(ap.mesh_peer, &m)) { a.ok = false; break; }
+          ap.mesh_peer = (int32_t)m;
+        }
+      }
+      if (!a.ok && err->empty())
+        *err = "snapshot holds a dangling socket/app reference";
+    }
+
+    ck_iface(a, hp->lo, cx, err);
+    ck_iface(a, hp->eth, cx, err);
+    ck_codel(a, hp->codel);
+    for (int i = 0; i < 3; i++) ck_relay(a, hp->relays[i]);
+
+    /* Timer heap + inbox.  The heap ARRAY layout depends on push
+     * order, which wall-dependent propagation routing may vary
+     * between byte-identical simulations — while pop order is fixed
+     * by the (total-order) comparators regardless of layout.  So the
+     * canonical serialized form is the SORTED sequence; import
+     * re-heapifies, and every later pop is identical. */
+    {
+      if constexpr (!Ar::loading) {
+        std::sort(hp->theap.begin(), hp->theap.end(),
+                  [](const TimerEnt &x, const TimerEnt &y) {
+                    return std::tie(x.time, x.seq) <
+                           std::tie(y.time, y.seq);
+                  });
+      }
+      uint64_t n = ck_count(a, hp->theap);
+      if constexpr (Ar::loading) hp->theap.assign((size_t)n, TimerEnt{});
+      for (auto &e : hp->theap) {
+        a.num(e.time); a.num(e.seq); a.num(e.kind);
+        int64_t tgt = (int64_t)e.target;
+        a.num(tgt);
+        if constexpr (Ar::loading) {
+          if (e.kind == TK_TCP) {
+            auto it = cx.tokmap.find(tgt);
+            if (it == cx.tokmap.end()) { a.ok = false; break; }
+            tgt = it->second;
+          } else if (e.kind == TK_APP || e.kind == TK_APP_TIMEOUT) {
+            auto it = cx.appmap.find(tgt);
+            if (it == cx.appmap.end()) { a.ok = false; break; }
+            tgt = it->second;
+          }
+          e.target = (uint32_t)tgt;
+        }
+      }
+      if constexpr (!Ar::loading) {
+        std::make_heap(hp->theap.begin(), hp->theap.end(), TimerLess());
+        std::sort(hp->inbox.begin(), hp->inbox.end(),
+                  [](const InboxEnt &x, const InboxEnt &y) {
+                    return std::tie(x.time, x.src_host, x.seq) <
+                           std::tie(y.time, y.src_host, y.seq);
+                  });
+      }
+      n = ck_count(a, hp->inbox);
+      if constexpr (Ar::loading) hp->inbox.assign((size_t)n, InboxEnt{});
+      for (auto &e : hp->inbox) {
+        a.num(e.time); a.num(e.src_host); a.num(e.seq);
+        ck_pkt(a, e.pkt);
+      }
+      std::make_heap(hp->theap.begin(), hp->theap.end(), TimerLess());
+      std::make_heap(hp->inbox.begin(), hp->inbox.end(), InboxLess());
+    }
+
+    /* canonical packet trace (the determinism gate's byte-diff
+     * target: a resumed run must reproduce the full history) */
+    {
+      uint64_t n = ck_count(a, hp->trace);
+      if constexpr (Ar::loading) hp->trace.assign((size_t)n, TraceRec{});
+      for (auto &r : hp->trace) {
+        a.num(r.time); a.num(r.kind); a.num(r.src_host);
+        a.num(r.pkt_seq); a.num(r.proto);
+        a.num(r.src_ip); a.num(r.dst_ip);
+        a.num(r.src_port); a.num(r.dst_port); a.num(r.len);
+        if constexpr (Ar::loading) {
+          std::string e;
+          a.str(e);
+          r.extra = intern_reason(e);
+        } else {
+          std::string e(r.extra);
+          a.str(e);
+        }
+      }
+      n = ck_count(a, hp->fct_log);
+      if constexpr (Ar::loading) hp->fct_log.assign((size_t)n, FctRec{});
+      for (auto &r : hp->fct_log) ck_visit(a, r);
+    }
+
+    if constexpr (Ar::loading) {
+      if (cx.floor >= 0) {
+        /* host_restore fault: the restored host re-enters the live
+         * simulation at the current round boundary — past-due event
+         * times bump to it (relative (time, seq) order is preserved:
+         * bumped entries tie on time and keep their seq order). */
+        if (hp->now < cx.floor) hp->now = cx.floor;
+        for (auto &e : hp->theap)
+          if (e.time < cx.floor) e.time = cx.floor;
+        std::make_heap(hp->theap.begin(), hp->theap.end(), TimerLess());
+        for (auto &e : hp->inbox)
+          if (e.time < cx.floor) e.time = cx.floor;
+        std::make_heap(hp->inbox.begin(), hp->inbox.end(), InboxLess());
+      }
+      if (nt != nullptr && hid < nt_len) {
+        int64_t best = INT64_MAX;
+        if (!hp->inbox.empty()) best = hp->inbox.front().time;
+        if (!hp->theap.empty() && hp->theap.front().time < best)
+          best = hp->theap.front().time;
+        nt[hid] = best;
+      }
+    }
+  }
+
+  /* Export-eligibility gate: the engine must sit at a drained
+   * conservative-round boundary. */
+  bool ck_exportable(std::string *why) {
+    if (!round_outbox.empty()) {
+      *why = "round outbox not drained (not at a round boundary)";
+      return false;
+    }
+    if (flight_len || tel_len || fab_len) {
+      *why = "trace rings not drained (snapshot after the span drain)";
+      return false;
+    }
+    for (auto &hp : hosts) {
+      if (!hp) continue;
+      if (hp->pcap_on[0] || hp->pcap_on[1] || !hp->pcap_log.empty()) {
+        *why = "engine pcap capture active (checkpoint refuses pcap)";
+        return false;
+      }
+      if (!hp->outgoing.empty()) {
+        *why = "legacy outgoing queue not drained";
+        return false;
+      }
+      if (hp->has_py_socks) {
+        *why = "python-owned sockets on an engine host";
+        return false;
+      }
+    }
+    return true;
+  }
+
+  bool plane_export_blob(std::string *out, std::string *err) {
+    if (!ck_exportable(err)) return false;
+    uint32_t n_frames = 1;  /* the global frame */
+    for (auto &hp : hosts)
+      if (hp) n_frames++;
+    uint32_t pad = 0;
+    out->append((const char *)&CK_PLANE_MAGIC, 4);
+    out->append((const char *)&CK_PLANE_VERSION, 4);
+    out->append((const char *)&n_frames, 4);
+    out->append((const char *)&pad, 4);
+    /* NOT the live state_epoch: the epoch counts ENTRY CALLS, which
+     * wall-dependent routing (device vs host propagation) varies
+     * between byte-identical simulations — and snapshots of identical
+     * sims must be byte-identical.  Import just bumps the live epoch
+     * (any bump invalidates device-span residency). */
+    uint64_t epoch = 0;
+    out->append((const char *)&epoch, 8);
+    auto frame = [&](uint32_t id, const std::string &payload) {
+      uint64_t n = payload.size();
+      out->append((const char *)&id, 4);
+      out->append((const char *)&n, 8);
+      out->append(payload);
+    };
+    {
+      CkW g;
+      int64_t sp = stop_park_counter.load(std::memory_order_relaxed);
+      int64_t wp = wait_park_counter.load(std::memory_order_relaxed);
+      g.num(sp); g.num(wp);
+      g.num(flight_dropped); g.num(tel_dropped); g.num(fab_dropped);
+      frame(CK_GLOBAL_FRAME, g.buf);
+    }
+    for (size_t hid = 0; hid < hosts.size(); hid++) {
+      if (!hosts[hid]) continue;
+      CkW w;
+      CkHostCtx cx;
+      ck_host_body(w, (int)hid, cx, err);
+      if (!w.ok) return false;
+      frame((uint32_t)hid, w.buf);
+    }
+    return true;
+  }
+
+  bool ck_parse_frames(const uint8_t *buf, size_t len,
+                       std::vector<std::pair<uint32_t,
+                                             std::pair<const uint8_t *,
+                                                       size_t>>> *frames,
+                       uint64_t *epoch, std::string *err) {
+    if (len < (size_t)CK_PLANE_HDR_BYTES) {
+      *err = "plane blob shorter than its header";
+      return false;
+    }
+    uint32_t magic, version, n_frames;
+    std::memcpy(&magic, buf, 4);
+    std::memcpy(&version, buf + 4, 4);
+    std::memcpy(&n_frames, buf + 8, 4);
+    std::memcpy(epoch, buf + 16, 8);
+    if (magic != CK_PLANE_MAGIC) {
+      *err = "bad plane-blob magic";
+      return false;
+    }
+    if (version != CK_PLANE_VERSION) {
+      *err = "plane-blob layout version mismatch (snapshot written by "
+             "a different engine build)";
+      return false;
+    }
+    size_t off = CK_PLANE_HDR_BYTES;
+    for (uint32_t i = 0; i < n_frames; i++) {
+      if (len - off < (size_t)CK_FRAME_HDR_BYTES) {
+        *err = "truncated plane blob";
+        return false;
+      }
+      uint32_t id;
+      uint64_t n;
+      std::memcpy(&id, buf + off, 4);
+      std::memcpy(&n, buf + off + 4, 8);
+      off += CK_FRAME_HDR_BYTES;
+      if (len - off < n) {
+        *err = "truncated plane frame";
+        return false;
+      }
+      frames->push_back({id, {buf + off, (size_t)n}});
+      off += (size_t)n;
+    }
+    if (off != len) {
+      *err = "trailing bytes after the last plane frame";
+      return false;
+    }
+    return true;
+  }
+
+  void ck_read_global(CkR &r) {
+    int64_t sp = 0, wp = 0;
+    r.num(sp); r.num(wp);
+    stop_park_counter.store(sp, std::memory_order_relaxed);
+    wait_park_counter.store(wp, std::memory_order_relaxed);
+    r.num(flight_dropped); r.num(tel_dropped); r.num(fab_dropped);
+  }
+
+  /* Reset one host's plane to post-add_host freshness, releasing every
+   * packet handle it owns and neutralizing its (global-table) sockets
+   * and apps — the preamble of a single-host import. */
+  void host_neutralize(int hid) {
+    HostPlane *hp = plane(hid);
+    for (auto &e : hp->codel.q) store.free_pkt(e.first);
+    for (int i = 0; i < 3; i++)
+      if (hp->relays[i].pending != UINT64_MAX)
+        store.free_pkt(hp->relays[i].pending);
+    for (auto &e : hp->inbox) store.free_pkt(e.pkt);
+    for (uint64_t id : hp->outgoing) store.free_pkt(id);
+    for (size_t t = 0; t < socks.size(); t++) {
+      SocketN *s = socks[t].get();
+      if (s == nullptr || s->host != hid) continue;
+      if (s->proto == PROTO_TCP) {
+        TcpSocketN *ts = static_cast<TcpSocketN *>(s);
+        for (int i = 0; i < 2; i++) {
+          for (uint64_t id : ts->out_packets[i]) store.free_pkt(id);
+          ts->out_packets[i].clear();
+        }
+        ts->conn.reset();
+        ts->accept_q.clear();
+        ts->listening = false;
+        ts->listener = -1;
+      } else {
+        UdpSocketN *us = static_cast<UdpSocketN *>(s);
+        for (int i = 0; i < 2; i++) {
+          for (uint64_t id : us->send_q[i]) store.free_pkt(id);
+          us->send_q[i].clear();
+        }
+        for (uint64_t id : us->recv_q) store.free_pkt(id);
+        us->recv_q.clear();
+        us->send_bytes = us->recv_bytes = 0;
+      }
+      s->status = S_CLOSED;
+      s->app_owner = -2;
+      s->ifaces_mask = 0;
+      s->queued[0] = s->queued[1] = false;
+    }
+    for (size_t i = 0; i < apps.size(); i++) {
+      AppN &ap = apps[i];
+      if (ap.hid != hid) continue;
+      ap.exited = true;
+      ap.wait_mask = 0;
+      ap.wake_pending = false;
+      ap.sock = -1;
+      ap.mesh_peer = -1;
+    }
+    uint32_t ip = hp->eth_ip;
+    int qdisc = hp->qdisc;
+    int64_t up = hp->bw_up_bits, down = hp->bw_down_bits;
+    hosts[hid] = std::make_unique<HostPlane>();
+    hp = hosts[hid].get();
+    hp->id = hid;
+    hp->eth_ip = ip;
+    hp->qdisc = qdisc;
+    hp->bw_up_bits = up;
+    hp->bw_down_bits = down;
+    hp->lo.ip = LOCALHOST_IP;
+    hp->lo.idx = 0;
+    hp->eth.ip = ip;
+    hp->eth.idx = 1;
+    hp->relays[0].src = 0;
+    hp->relays[1].src = 1;
+    hp->relays[1].bucket.config_for_bandwidth(up, MTU);
+    hp->relays[2].src = 2;
+    hp->relays[2].bucket.config_for_bandwidth(down, MTU);
+  }
+
+  bool plane_import_blob(const uint8_t *buf, size_t len,
+                         std::vector<std::pair<int64_t, int64_t>> *appmap,
+                         std::string *err) {
+    std::vector<std::pair<uint32_t,
+                          std::pair<const uint8_t *, size_t>>> frames;
+    uint64_t epoch = 0;
+    if (!ck_parse_frames(buf, len, &frames, &epoch, err)) return false;
+    size_t host_frames = 0;
+    for (auto &f : frames)
+      if (f.first != CK_GLOBAL_FRAME) host_frames++;
+    size_t live = 0;
+    for (auto &hp : hosts)
+      if (hp) live++;
+    if (host_frames != live) {
+      *err = "snapshot host set does not match the rebuilt config";
+      return false;
+    }
+    for (auto &f : frames) {
+      CkR r(f.second.first, f.second.second);
+      if (f.first == CK_GLOBAL_FRAME) {
+        ck_read_global(r);
+      } else {
+        if (plane((int)f.first) == nullptr) {
+          *err = "snapshot frame for a host that is not on the plane";
+          return false;
+        }
+        host_neutralize((int)f.first);
+        CkHostCtx cx;
+        ck_host_body(r, (int)f.first, cx, err);
+        /* Old->new app-index pairs so the Python-side process proxies
+         * can re-point (tokens regroup per host on import). */
+        for (auto &kv : cx.appmap)
+          appmap->push_back({kv.first, kv.second});
+      }
+      if (!r.ok) {
+        if (err->empty()) *err = "corrupt plane frame";
+        return false;
+      }
+      if (r.p != r.end) {
+        *err = "plane frame has trailing bytes (field-list drift?)";
+        return false;
+      }
+    }
+    (void)epoch;
+    state_epoch++;
+    return true;
+  }
+
+  bool host_import_blob(const uint8_t *buf, size_t len, int hid,
+                        int64_t floor,
+                        std::vector<std::pair<int64_t, int64_t>> *appmap,
+                        std::string *err) {
+    std::vector<std::pair<uint32_t,
+                          std::pair<const uint8_t *, size_t>>> frames;
+    uint64_t epoch = 0;
+    if (!ck_parse_frames(buf, len, &frames, &epoch, err)) return false;
+    for (auto &f : frames) {
+      if (f.first != (uint32_t)hid) continue;
+      if (plane(hid) == nullptr) {
+        *err = "host is not on the engine plane";
+        return false;
+      }
+      host_neutralize(hid);
+      CkR r(f.second.first, f.second.second);
+      CkHostCtx cx;
+      cx.floor = floor;
+      ck_host_body(r, hid, cx, err);
+      if (!r.ok) {
+        if (err->empty()) *err = "corrupt plane frame";
+        return false;
+      }
+      if (r.p != r.end) {
+        *err = "plane frame has trailing bytes (field-list drift?)";
+        return false;
+      }
+      for (auto &kv : cx.appmap)
+        appmap->push_back({kv.first, kv.second});
+      state_epoch++;
+      return true;
+    }
+    *err = "snapshot holds no frame for this host";
+    return false;
   }
 
   /* ====== PHOLD device-span state export / import ================
@@ -7744,6 +8764,115 @@ static PyObject *eng_fabric_counters(EngineObj *self, PyObject *args) {
       (long long)hp->eth.bytes_received, parked_pkts, parked_bytes);
 }
 
+static PyObject *eng_set_host_fault(EngineObj *self, PyObject *args) {
+  /* Fault choke point (docs/CHECKPOINT.md): the manager applies the
+   * configured fault schedule at round boundaries by flipping these
+   * per-host flags; the data-plane drop semantics live in
+   * run_until/deliver/device_push. */
+  self->eng->state_epoch++;
+  int hid, down, link_down, blackhole;
+  if (!PyArg_ParseTuple(args, "ippp", &hid, &down, &link_down,
+                        &blackhole))
+    return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  if (hp == nullptr) {
+    PyErr_SetString(PyExc_IndexError, "bad host id");
+    return nullptr;
+  }
+  hp->down = down;
+  hp->link_down = link_down;
+  hp->blackhole = blackhole;
+  Py_RETURN_NONE;
+}
+
+static PyObject *eng_plane_export(EngineObj *self, PyObject *) {
+  /* Read-only (like netstat_take): no state_epoch bump, so device-span
+   * residency survives a snapshot. */
+  std::string out, err;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS
+  ok = self->eng->plane_export_blob(&out, &err);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    PyErr_SetString(PyExc_RuntimeError, err.c_str());
+    return nullptr;
+  }
+  return PyBytes_FromStringAndSize(out.data(), (Py_ssize_t)out.size());
+}
+
+static PyObject *eng_plane_import(EngineObj *self, PyObject *args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  std::string err;
+  std::vector<std::pair<int64_t, int64_t>> appmap;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS
+  ok = self->eng->plane_import_blob((const uint8_t *)buf.buf,
+                                    (size_t)buf.len, &appmap, &err);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&buf);
+  if (!ok) {
+    PyErr_SetString(PyExc_ValueError, err.c_str());
+    return nullptr;
+  }
+  /* {old app index -> new app index} for the process proxies. */
+  PyObject *d = PyDict_New();
+  if (!d) return nullptr;
+  for (auto &kv : appmap) {
+    PyObject *k = PyLong_FromLongLong((long long)kv.first);
+    PyObject *v = PyLong_FromLongLong((long long)kv.second);
+    if (!k || !v || PyDict_SetItem(d, k, v) < 0) {
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(k);
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+static PyObject *eng_host_import(EngineObj *self, PyObject *args) {
+  /* Single-host restore (the host_restore fault): re-imports one
+   * host's frame from a full plane blob, bumping past-due event times
+   * to `floor`.  Returns {old app index -> new app index} so the
+   * Python-side process proxies can re-point. */
+  Py_buffer buf;
+  int hid;
+  long long floor;
+  if (!PyArg_ParseTuple(args, "y*iL", &buf, &hid, &floor))
+    return nullptr;
+  std::string err;
+  std::vector<std::pair<int64_t, int64_t>> appmap;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS
+  ok = self->eng->host_import_blob((const uint8_t *)buf.buf,
+                                   (size_t)buf.len, hid, floor,
+                                   &appmap, &err);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&buf);
+  if (!ok) {
+    PyErr_SetString(PyExc_ValueError, err.c_str());
+    return nullptr;
+  }
+  PyObject *d = PyDict_New();
+  if (!d) return nullptr;
+  for (auto &kv : appmap) {
+    PyObject *k = PyLong_FromLongLong((long long)kv.first);
+    PyObject *v = PyLong_FromLongLong((long long)kv.second);
+    if (!k || !v || PyDict_SetItem(d, k, v) < 0) {
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(k);
+    Py_DECREF(v);
+  }
+  return d;
+}
+
 static PyObject *eng_drop_causes(EngineObj *self, PyObject *args) {
   /* Per-host drop-cause counters -> TEL_N-tuple + unattributed tail
    * (Host.merge_native_counters folds the deltas). */
@@ -7904,6 +9033,11 @@ static PyMethodDef eng_methods[] = {
     {"netstat_totals", (PyCFunction)eng_netstat_totals, METH_NOARGS,
      nullptr},
     {"drop_causes", (PyCFunction)eng_drop_causes, METH_VARARGS, nullptr},
+    {"set_host_fault", (PyCFunction)eng_set_host_fault, METH_VARARGS,
+     nullptr},
+    {"plane_export", (PyCFunction)eng_plane_export, METH_NOARGS, nullptr},
+    {"plane_import", (PyCFunction)eng_plane_import, METH_VARARGS, nullptr},
+    {"host_import", (PyCFunction)eng_host_import, METH_VARARGS, nullptr},
     {nullptr, nullptr, 0, nullptr},
 };
 

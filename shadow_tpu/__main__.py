@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/OBSERVABILITY.md): 'on' records the "
                         "deterministic syscalls-sim.bin channel + the "
                         "wall-time IPC profile, 'wall' the profile only")
+    p.add_argument("--resume", metavar="SNAPSHOT",
+                   help="resume from a checkpoint archive written by a "
+                        "`checkpoint:` config block (docs/CHECKPOINT.md); "
+                        "the config must match the snapshotted run")
     p.add_argument("--show-build-info", action="store_true")
     return p
 
@@ -67,7 +71,7 @@ def main(argv=None) -> int:
     honor_platform_env()
 
     from shadow_tpu.core.config import ConfigOptions
-    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.core.manager import resume_simulation, run_simulation
     from shadow_tpu.utils import units
 
     try:
@@ -95,7 +99,16 @@ def main(argv=None) -> int:
     if args.syscall_observatory is not None:
         config.experimental.syscall_observatory = args.syscall_observatory
 
-    manager, summary = run_simulation(config, write_data=True)
+    if args.resume is not None:
+        from shadow_tpu.ckpt.format import CkptError
+        try:
+            manager, summary = resume_simulation(config, args.resume,
+                                                 write_data=True)
+        except CkptError as e:
+            print(f"[shadow-tpu] resume failed: {e}", file=sys.stderr)
+            return 1
+    else:
+        manager, summary = run_simulation(config, write_data=True)
     if summary.plugin_errors:
         for err in summary.plugin_errors:
             print(f"[shadow-tpu] plugin error: {err}", file=sys.stderr)

@@ -34,6 +34,7 @@ _SUDP = "shadow_tpu/host/socket_udp.py"
 _RNG = "shadow_tpu/core/rng.py"
 _PLANE = "shadow_tpu/native/plane.py"
 _TREV = "shadow_tpu/trace/events.py"
+_CKPT = "shadow_tpu/ckpt/format.py"
 
 # cpp_name -> [(python module, python name)]
 CONTRACTS = [
@@ -124,6 +125,15 @@ CONTRACTS = [
     ("FR_SPAN_START", [(_TREV, "FR_SPAN_START")]),
     ("FR_SPAN_COMMIT", [(_TREV, "FR_SPAN_COMMIT")]),
     ("FR_SPAN_ABORT", [(_TREV, "FR_SPAN_ABORT")]),
+    # Fault-injection records (docs/CHECKPOINT.md): stamped by the
+    # manager's round-loop choke point; the enum lives in the engine
+    # because the FR_* namespace is fail-closed there.
+    ("FR_FAULT_KILL", [(_TREV, "FR_FAULT_KILL")]),
+    ("FR_FAULT_RESTORE", [(_TREV, "FR_FAULT_RESTORE")]),
+    ("FR_FAULT_LINK_DOWN", [(_TREV, "FR_FAULT_LINK_DOWN")]),
+    ("FR_FAULT_LINK_UP", [(_TREV, "FR_FAULT_LINK_UP")]),
+    ("FR_FAULT_BLACKHOLE", [(_TREV, "FR_FAULT_BLACKHOLE")]),
+    ("FR_FAULT_CLEAR", [(_TREV, "FR_FAULT_CLEAR")]),
     ("FR_N", [(_TREV, "FR_N")]),
     # device-eligibility reason codes (one per conservative round)
     ("EL_DEVICE_SPAN", [(_TREV, "EL_DEVICE_SPAN")]),
@@ -171,6 +181,8 @@ CONTRACTS = [
     ("TEL_RECVBUF_FULL", [(_TREV, "TEL_RECVBUF_FULL"),
                           (_PHLD, "TEL_RECVBUF_FULL")]),
     ("TEL_BUCKET_DEFER", [(_TREV, "TEL_BUCKET_DEFER")]),
+    ("TEL_HOST_DOWN", [(_TREV, "TEL_HOST_DOWN")]),
+    ("TEL_LINK_DOWN", [(_TREV, "TEL_LINK_DOWN")]),
     ("TEL_REASM_FULL", [(_TREV, "TEL_REASM_FULL"),
                         (_TCPS, "TEL_REASM_FULL")]),
     ("TEL_RECVWIN_TRUNC", [(_TREV, "TEL_RECVWIN_TRUNC"),
@@ -198,6 +210,15 @@ CONTRACTS = [
     ("FCT_F_COMPLETE", [(_TREV, "FCT_F_COMPLETE")]),
     ("FCT_F_RECEIVER", [(_TREV, "FCT_F_RECEIVER")]),
     ("FCT_REC_BYTES", [(_TREV, "FCT_REC_BYTES")]),
+    # Checkpoint plane-blob framing (shadow_tpu/ckpt/format.py is the
+    # Python twin — it parses the engine's plane blob for `ckpt info`
+    # / `ckpt diff`, so a silently drifted header would misparse every
+    # snapshot).  The CK_ prefix is fail-closed like FR_*/EL_*/TEL_*.
+    ("CK_PLANE_MAGIC", [(_CKPT, "CK_PLANE_MAGIC")]),
+    ("CK_PLANE_VERSION", [(_CKPT, "CK_PLANE_VERSION")]),
+    ("CK_PLANE_HDR_BYTES", [(_CKPT, "CK_PLANE_HDR_BYTES")]),
+    ("CK_FRAME_HDR_BYTES", [(_CKPT, "CK_FRAME_HDR_BYTES")]),
+    ("CK_GLOBAL_FRAME", [(_CKPT, "CK_GLOBAL_FRAME")]),
 ]
 
 # Trace enum prefixes that may never gain an UNREGISTERED member: any
@@ -205,7 +226,7 @@ CONTRACTS = [
 # CONTRACTS row (and with it a Python twin), so extending the
 # flight-record layout or the drop-cause table without updating
 # trace/events.py fails closed.
-TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_", "FB_", "FCT_")
+TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_", "FB_", "FCT_", "CK_")
 
 # Shim-side contracts (native/shim.c — the syscall observatory's SC_*
 # disposition enum, its record-size pin, and the IPC-layout offset of

@@ -1,0 +1,28 @@
+"""Deterministic checkpoint/resume + fault injection (docs/CHECKPOINT.md).
+
+Snapshot a running simulation at a conservative-round boundary into one
+versioned archive; resume reconstructs a Manager mid-run whose continued
+artifacts — packet traces, the four sim-time channels, sim-stats — are
+byte-level continuations of a straight run.  The fault-injection harness
+(host_kill / host_restore / link_down / nic_blackhole) rides the same
+round-boundary choke point in the manager's loop.
+"""
+
+from shadow_tpu.ckpt.format import (CK_SEC_FAULTS, CK_SEC_HOSTS,
+                                    CK_SEC_META, CK_SEC_NAMES,
+                                    CK_SEC_PLANE, CK_SEC_RNG,
+                                    CK_SEC_TRACE, CK_VERSION, CkptError,
+                                    read_archive, read_meta,
+                                    section_table, write_archive)
+from shadow_tpu.ckpt.restore import (config_digest, restore_host,
+                                     resume_manager)
+from shadow_tpu.ckpt.snapshot import (checkpoint_domain_error,
+                                      write_snapshot)
+
+__all__ = [
+    "CK_SEC_FAULTS", "CK_SEC_HOSTS", "CK_SEC_META", "CK_SEC_NAMES",
+    "CK_SEC_PLANE", "CK_SEC_RNG", "CK_SEC_TRACE", "CK_VERSION",
+    "CkptError", "checkpoint_domain_error", "config_digest",
+    "read_archive", "read_meta", "restore_host", "resume_manager",
+    "section_table", "write_archive", "write_snapshot",
+]

@@ -1,0 +1,191 @@
+"""Checkpoint archive container + the engine plane-blob framing twins.
+
+One snapshot is ONE file::
+
+    CK_HDR    magic, layout version, section count, flags
+    n x CK_SEC_HDR   section id, crc32(payload), payload length
+    payloads concatenated in table order
+
+Sections are fixed-purpose (CK_SEC_*); `ckpt diff` compares two
+archives section by section and names the first differing one, `ckpt
+verify` re-checksums every payload and gates on the layout version.
+Snapshots of byte-identical simulations are byte-identical files: every
+producer serializes maps in sorted order and nothing wall-clock-derived
+enters the archive.
+
+The CK_PLANE_* constants at the bottom are TWINS of the same
+definitions in native/netplane.cpp (the engine's plane_export blob
+framing); analysis pass 1 registers the whole CK_ prefix fail-closed,
+so a drifted header constant fails `scripts/lint` instead of silently
+misparsing every snapshot.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+CK_MAGIC = 0x5354434B  # "STCK"
+CK_VERSION = 1
+
+CK_HDR = struct.Struct("<IIII")  # magic, version, n_sections, flags
+CK_HDR_BYTES = 16
+assert CK_HDR.size == CK_HDR_BYTES
+
+CK_SEC_HDR = struct.Struct("<IIQ")  # section id, crc32, byte length
+CK_SEC_HDR_BYTES = 16
+assert CK_SEC_HDR.size == CK_SEC_HDR_BYTES
+
+# Section ids (one purpose each; unknown ids are rejected on read so a
+# future layout change must bump CK_VERSION).
+CK_SEC_META = 1    # json: round/time/summary scalars + config digest
+CK_SEC_HOSTS = 2   # pickle: the complete Python-side host object state
+CK_SEC_PLANE = 3   # engine plane blob (netplane.cpp plane_export)
+CK_SEC_TRACE = 4   # pickle: sim-time channel continuations + audit
+CK_SEC_RNG = 5     # packed (host id u32, rng counter u64) rows
+CK_SEC_FAULTS = 6  # json: per-host fault flags + schedule cursor
+
+CK_SEC_NAMES = {
+    CK_SEC_META: "meta",
+    CK_SEC_HOSTS: "hosts",
+    CK_SEC_PLANE: "plane",
+    CK_SEC_TRACE: "trace",
+    CK_SEC_RNG: "rng",
+    CK_SEC_FAULTS: "faults",
+}
+
+CK_RNG_ROW = struct.Struct("<IQ")
+
+# ---------------------------------------------------------------------
+# Engine plane-blob framing (C++ twins: the CK_* constexprs in
+# native/netplane.cpp; registered fail-closed in analysis pass 1).
+# plane_export writes [magic, version, n_frames, pad, state_epoch],
+# then per-frame [id u32][length u64] — id CK_GLOBAL_FRAME for the one
+# engine-global frame, else the host id.
+CK_PLANE_MAGIC = 0x53544350  # "STCP"
+CK_PLANE_VERSION = 1
+CK_PLANE_HDR_BYTES = 24
+CK_FRAME_HDR_BYTES = 12
+CK_GLOBAL_FRAME = 0xFFFFFFFF
+
+CK_PLANE_HDR = struct.Struct("<IIIIQ")
+assert CK_PLANE_HDR.size == CK_PLANE_HDR_BYTES
+CK_FRAME_HDR = struct.Struct("<IQ")
+assert CK_FRAME_HDR.size == CK_FRAME_HDR_BYTES
+
+
+class CkptError(RuntimeError):
+    """Any checkpoint/resume failure with a user-actionable message."""
+
+
+def write_archive(path: str, sections: dict[int, bytes]) -> None:
+    """Write one snapshot archive; sections keyed by CK_SEC_* id,
+    emitted in ascending id order (deterministic bytes)."""
+    ids = sorted(sections)
+    blob = bytearray()
+    blob += CK_HDR.pack(CK_MAGIC, CK_VERSION, len(ids), 0)
+    for sid in ids:
+        payload = sections[sid]
+        blob += CK_SEC_HDR.pack(sid, zlib.crc32(payload) & 0xFFFFFFFF,
+                                len(payload))
+    for sid in ids:
+        blob += sections[sid]
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+
+def section_table(path: str) -> list[tuple[int, int, int]]:
+    """[(section id, crc32, length)] in file order; validates the
+    header (magic + layout version) but reads no payloads."""
+    with open(path, "rb") as f:
+        hdr = f.read(CK_HDR_BYTES)
+        if len(hdr) < CK_HDR_BYTES:
+            raise CkptError(f"{path}: shorter than a snapshot header")
+        magic, version, n, _flags = CK_HDR.unpack(hdr)
+        if magic != CK_MAGIC:
+            raise CkptError(f"{path}: not a shadow-tpu snapshot "
+                            f"(magic {magic:#x})")
+        if version != CK_VERSION:
+            raise CkptError(
+                f"{path}: snapshot layout version {version} != "
+                f"supported {CK_VERSION} (written by a different "
+                f"build; re-snapshot or use that build to resume)")
+        out = []
+        for _ in range(n):
+            sh = f.read(CK_SEC_HDR_BYTES)
+            if len(sh) < CK_SEC_HDR_BYTES:
+                raise CkptError(f"{path}: truncated section table")
+            out.append(CK_SEC_HDR.unpack(sh))
+    return out
+
+
+def read_archive(path: str, verify: bool = True) -> dict[int, bytes]:
+    """Section id -> payload bytes; checksums verified unless told
+    otherwise (ckpt `verify` reports per-section instead of raising)."""
+    table = section_table(path)
+    out: dict[int, bytes] = {}
+    off = CK_HDR_BYTES + CK_SEC_HDR_BYTES * len(table)
+    with open(path, "rb") as f:
+        f.seek(off)
+        for sid, crc, length in table:
+            if sid in out:
+                raise CkptError(f"{path}: duplicate section {sid}")
+            if sid not in CK_SEC_NAMES:
+                raise CkptError(f"{path}: unknown section id {sid} "
+                                f"(newer layout?)")
+            payload = f.read(length)
+            if len(payload) != length:
+                raise CkptError(f"{path}: truncated section "
+                                f"{CK_SEC_NAMES[sid]}")
+            if verify and (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise CkptError(f"{path}: checksum mismatch in section "
+                                f"{CK_SEC_NAMES[sid]} (corrupt file)")
+            out[sid] = payload
+        if f.read(1):
+            raise CkptError(f"{path}: trailing bytes after the last "
+                            f"section")
+    return out
+
+
+def read_meta(path: str) -> dict:
+    """Just the meta section (ckpt `info` fast path)."""
+    import json
+    return json.loads(read_archive(path)[CK_SEC_META].decode())
+
+
+def parse_plane_frames(blob: bytes) -> tuple[int, dict[int, bytes]]:
+    """Engine plane blob -> (state_epoch, {host id -> frame bytes});
+    the global frame lands under CK_GLOBAL_FRAME."""
+    if len(blob) < CK_PLANE_HDR_BYTES:
+        raise CkptError("plane section shorter than its header")
+    magic, version, n_frames, _pad, epoch = CK_PLANE_HDR.unpack_from(
+        blob, 0)
+    if magic != CK_PLANE_MAGIC:
+        raise CkptError(f"plane section magic {magic:#x} != expected")
+    if version != CK_PLANE_VERSION:
+        raise CkptError(f"plane layout version {version} != "
+                        f"{CK_PLANE_VERSION}")
+    frames: dict[int, bytes] = {}
+    off = CK_PLANE_HDR_BYTES
+    for _ in range(n_frames):
+        if len(blob) - off < CK_FRAME_HDR_BYTES:
+            raise CkptError("truncated plane frame table")
+        fid, length = CK_FRAME_HDR.unpack_from(blob, off)
+        off += CK_FRAME_HDR_BYTES
+        if len(blob) - off < length:
+            raise CkptError("truncated plane frame")
+        frames[fid] = blob[off:off + length]
+        off += length
+    if off != len(blob):
+        raise CkptError("trailing bytes after the last plane frame")
+    return epoch, frames
+
+
+def pack_rng_rows(rows: list[tuple[int, int]]) -> bytes:
+    return b"".join(CK_RNG_ROW.pack(hid, ctr) for hid, ctr in rows)
+
+
+def iter_rng_rows(buf: bytes):
+    for off in range(0, len(buf) - len(buf) % CK_RNG_ROW.size,
+                     CK_RNG_ROW.size):
+        yield CK_RNG_ROW.unpack_from(buf, off)

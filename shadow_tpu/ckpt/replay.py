@@ -1,0 +1,109 @@
+"""Rebuild live app-generator frames from their syscall transcripts.
+
+Internal apps are Python generators driven through the syscall seam
+(host/process.py) — a suspended generator frame cannot be pickled.
+But the apps are written "like the C apps they stand in for": their
+only inputs are the values the seam feeds back at each yield.  So a
+thread's execution is a pure function of (app factory, argv, fed-value
+sequence), and replaying the recorded sequence into a FRESH generator
+reconstructs the exact suspension point — the record/replay trick rr
+uses for real processes, applied at the syscall seam.
+
+Recording (host/process.py Thread.resume, on when a `checkpoint:`
+block is configured) logs one entry per generator interaction:
+  (LOG_START,)        — first next()
+  (LOG_SEND, value)   — result fed into gen.send
+  (LOG_THROW, exc)    — OSError thrown into gen.throw
+Replay feeds them back verbatim; the values yielded BY the generator
+during replay are ignored except for `spawn_thread` yields, whose
+factory callables are harvested to rebuild child threads (the recorded
+send value of a spawn is the child's tid — the join key).
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.ckpt.format import CkptError
+
+LOG_START = 0
+LOG_SEND = 1
+LOG_THROW = 2
+
+
+def _replay_one(gen, log, factories: dict):
+    """Feed a recorded transcript into a fresh generator.  Returns
+    (gen, terminated): `terminated` when the generator finished or
+    raised during replay (an exited thread's natural end)."""
+    call = None
+    try:
+        for entry in log:
+            kind = entry[0]
+            if kind == LOG_START:
+                call = next(gen)
+            elif kind == LOG_SEND:
+                if (isinstance(call, tuple) and call
+                        and call[0] == "spawn_thread"):
+                    # The recorded result of a spawn IS the child tid:
+                    # harvest the factory for that thread's rebuild.
+                    factories[entry[1]] = call[1]
+                call = gen.send(entry[1])
+            else:
+                call = gen.throw(entry[1])
+    except StopIteration:
+        return gen, True
+    except BaseException:
+        # The final recorded feed made the app raise (thread crash /
+        # ProcessExit): exactly how the original execution ended.
+        return gen, True
+    return gen, False
+
+
+def rebuild_process(process) -> None:
+    """Re-attach generator frames to every thread of one internal-app
+    process after unpickling (threads are walked in spawn = tid order,
+    so a parent's replay always harvests a child's factory before the
+    child rebuilds)."""
+    from shadow_tpu.host import apps as app_registry
+    from shadow_tpu.host.process import ST_EXITED
+
+    factories: dict = {}
+    for i, t in enumerate(process.threads):
+        if t.gen is not None:
+            continue
+        if i == 0:
+            path = getattr(process, "app_path", None)
+            factory = app_registry.lookup(path) if path else None
+            if factory is None:
+                raise CkptError(
+                    f"cannot rebuild {process.name}: app "
+                    f"{path!r} is not in the internal-app registry")
+            gen = factory(process, process.argv)
+        else:
+            f = factories.pop(t.tid, None)
+            if f is None:
+                raise CkptError(
+                    f"cannot rebuild {process.name} tid {t.tid}: no "
+                    f"spawn_thread record in any parent transcript")
+            gen = f() if callable(f) else f
+        gen, terminated = _replay_one(gen, t.log or [], factories)
+        if t.state == ST_EXITED and not terminated:
+            # Killed mid-suspension (signal teardown): park the frame
+            # closed, exactly as Thread._exit left the original.
+            gen.close()
+        elif t.state != ST_EXITED and terminated:
+            raise CkptError(
+                f"replay diverged for {process.name} tid {t.tid}: "
+                f"transcript ended the generator but the thread was "
+                f"recorded live (non-deterministic app?)")
+        t.gen = gen
+
+
+def rebuild_hosts(hosts) -> None:
+    """Replay pass over every object-path host's internal-app
+    processes (engine hosts carry no generator state)."""
+    from shadow_tpu.host.process import Process
+    for h in hosts:
+        if h.plane is not None:
+            continue
+        for proc in h.processes.values():
+            if type(proc) is Process:
+                rebuild_process(proc)

@@ -1,0 +1,291 @@
+"""Resume a Manager mid-run from a snapshot archive.
+
+Restore is rebuild-then-overwrite: a fresh Manager is constructed from
+the (digest-checked) config — hosts, routing matrices, engine plane,
+propagator, channels all in their start-of-run shape — and the
+snapshot's mutable state is imported over it: the engine via
+plane_import (netplane.cpp), the Python object graph via the pickled
+hosts list (generator frames rebuilt by ckpt/replay.py), the trace
+channels/audit/object-counters from the trace section.  The round loop
+then continues from `meta.next_start_ns`; every byte-diffed artifact
+is a continuation of the straight run's (the tier-1 gate in
+tests/test_ckpt.py is the proof).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pickle
+
+from shadow_tpu.ckpt import format as ck
+from shadow_tpu.ckpt.format import CkptError
+
+# Config keys with no bearing on simulation bytes: two runs differing
+# only here may share snapshots (the scheduler/path split is checked
+# separately via meta.engine, with a clearer error than a hash).
+_DIGEST_SKIP_GENERAL = ("data_directory", "progress", "log_level",
+                        "parallelism", "heartbeat_interval")
+_DIGEST_SKIP_EXPERIMENTAL = (
+    "scheduler", "use_cpu_pinning", "native_dataplane",
+    "tpu_device_spans", "tpu_min_device_batch",
+    "tpu_max_packets_per_round", "tpu_shards", "tpu_exchange_capacity",
+    "pcap_span_cap", "chrome_top_n", "report_errors_to_stderr",
+    "tpu_donate_buffers",
+)
+
+
+def config_digest(config) -> str:
+    """Hash of the simulation-semantic slice of the processed config:
+    a snapshot resumes only under a config that would have produced
+    the same simulation bytes (path/wall knobs excluded)."""
+    d = config.to_processed_dict()
+    g = d.get("general", {})
+    for k in _DIGEST_SKIP_GENERAL:
+        g.pop(k, None)
+    e = d.get("experimental", {})
+    for k in _DIGEST_SKIP_EXPERIMENTAL:
+        e.pop(k, None)
+    # Future checkpoint schedules may differ freely; the FAULT schedule
+    # is semantic (it shapes simulation bytes) and stays in the hash.
+    d.pop("checkpoint", None)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True).encode()).hexdigest()
+
+
+def _load_channel(ch, state) -> None:
+    data, records, dropped = state
+    ch._chunks = [data] if data else []
+    ch.records = records
+    ch.dropped = dropped
+
+
+def _restore_trace(manager, tr: dict) -> None:
+    from shadow_tpu.utils import object_counter
+    if len(tr["audit"]) != len(manager.audit.counts):
+        raise CkptError("snapshot audit table width differs "
+                        "(EL_* reason set changed between builds)")
+    manager.audit.counts[:] = tr["audit"]
+    alloc, dealloc = tr["objects"]
+    with object_counter._lock:
+        object_counter._alloc.clear()
+        object_counter._alloc.update(alloc)
+        object_counter._dealloc.clear()
+        object_counter._dealloc.update(dealloc)
+
+    def channel_or_raise(obj, name):
+        if obj is None:
+            raise CkptError(
+                f"snapshot carries {name} channel state but the "
+                f"resumed config does not enable it — keep the "
+                f"observability knobs identical to resume")
+        return obj
+
+    if "flight_sim" in tr:
+        flight = manager.flight
+        sim = flight.sim if flight is not None else None
+        _load_channel(channel_or_raise(sim, "flight-recorder sim"),
+                      tr["flight_sim"])
+    if "netstat" in tr:
+        _load_channel(channel_or_raise(manager.netstat, "sim-netstat"),
+                      tr["netstat"])
+    if "fabric" in tr:
+        _load_channel(channel_or_raise(manager.fabric, "fabric"),
+                      tr["fabric"])
+    if "sctrace" in tr:
+        sct = manager.sctrace
+        chan = sct.channel if sct is not None else None
+        chan = channel_or_raise(chan, "syscall")
+        if len(chan._logs) != len(tr["sctrace"]):
+            raise CkptError("snapshot syscall-log count differs from "
+                            "the rebuilt host set")
+        for log, (data, records, dropped) in zip(chan._logs,
+                                                 tr["sctrace"]):
+            log.chunks = [data] if data else []
+            log.records = records
+            log.dropped = dropped
+
+
+def _rewire(manager, h, fresh, appmap: dict) -> None:
+    """Re-attach the manager-owned references a pickled Host
+    deliberately drops (Host.__getstate__), using the fresh twin the
+    rebuilt Manager made for the same id."""
+    h.dns = manager.dns
+    h.syscall_handler = manager.syscall_handler
+    h.syscall_handler_native = manager.syscall_handler_native
+    h.data_path = fresh.data_path
+    h.strace_mode = getattr(fresh, "strace_mode", None)
+    h._send_packet_fn = manager.propagator.send
+    if fresh.plane is not None:
+        h.plane = fresh.plane
+        h.rng.attach_engine(fresh.plane.engine, h.id)
+        for proc in h.processes.values():
+            old = getattr(proc, "app_idx", None)
+            if old is not None:
+                try:
+                    proc.app_idx = appmap[old]
+                except KeyError:
+                    raise CkptError(
+                        f"{h.name}/{proc.name}: engine app {old} "
+                        f"missing from the imported plane") from None
+    if manager.sctrace is not None:
+        h.sc_wall = fresh.sc_wall
+        h.sc_log = fresh.sc_log
+    # In-flight cross-host deliveries were snapshotted in the locked
+    # inbox staging deque; fold them into the heap now so the resumed
+    # _init_next_times sees them (live runs maintain the shared
+    # next-event slot incrementally instead).
+    h.drain_inbox()
+
+
+def _check_meta(config, meta: dict, want_engine: bool) -> None:
+    if meta["ck_version"] != ck.CK_VERSION:
+        raise CkptError(f"snapshot meta version {meta['ck_version']} "
+                        f"!= supported {ck.CK_VERSION}")
+    digest = config_digest(config)
+    if digest != meta["config_digest"]:
+        raise CkptError(
+            "config does not match the snapshot (simulation-semantic "
+            "options differ — seed, topology, hosts, buffers, or the "
+            "fault schedule changed since the snapshot was written)")
+    if want_engine != meta["engine"]:
+        took = "engine" if meta["engine"] else "object"
+        need = ("scheduler: tpu (or engine-backed thread_per_core)"
+                if meta["engine"] else
+                "an object-path scheduler (serial / thread_per_core)")
+        raise CkptError(
+            f"snapshot was taken on the {took} path; resume it with "
+            f"{need} — cross-plane state conversion is not supported")
+
+
+def resume_manager(config, path: str):
+    """Rebuild a Manager from `config` and restore the snapshot at
+    `path` over it.  The returned manager's run() continues the
+    simulation from the snapshot boundary."""
+    from shadow_tpu.ckpt import replay
+    from shadow_tpu.core.manager import Manager
+
+    sections = ck.read_archive(path)
+    meta = json.loads(sections[ck.CK_SEC_META].decode())
+    manager = Manager(config)
+    _check_meta(config, meta, manager.plane is not None)
+    if len(manager.hosts) != meta["n_hosts"]:
+        raise CkptError(f"snapshot has {meta['n_hosts']} hosts, "
+                        f"config builds {len(manager.hosts)}")
+
+    appmap: dict = {}
+    if manager.plane is not None:
+        appmap = manager.plane.engine.plane_import(
+            sections[ck.CK_SEC_PLANE])
+
+    hosts = pickle.loads(sections[ck.CK_SEC_HOSTS])
+    if len(hosts) != len(manager.hosts):
+        raise CkptError("snapshot host list does not match the config")
+    for h in hosts:
+        fresh = manager.hosts[h.id]
+        if fresh.name != h.name:
+            raise CkptError(f"host order mismatch: {fresh.name!r} vs "
+                            f"snapshot {h.name!r}")
+        _rewire(manager, h, fresh, appmap)
+        manager.hosts[h.id] = h
+    replay.rebuild_hosts(manager.hosts)
+
+    _restore_trace(manager, pickle.loads(sections[ck.CK_SEC_TRACE]))
+
+    # The RNG and fault sections are what `ckpt diff` renders; the
+    # authoritative copies travel in the host pickle / plane blob.
+    # Cross-check them so the two representations can never silently
+    # disagree (a mismatch means a corrupt or hand-edited archive).
+    rng_rows = dict(ck.iter_rng_rows(sections[ck.CK_SEC_RNG]))
+    for h in manager.hosts:
+        if h.plane is None and rng_rows.get(h.id) != h.rng._counter:
+            raise CkptError(
+                f"rng section disagrees with host {h.name!r} state "
+                f"({rng_rows.get(h.id)} vs {h.rng._counter}) — "
+                f"corrupt archive")
+    faults = json.loads(sections[ck.CK_SEC_FAULTS].decode())
+    for hid_s, flags in faults.get("hosts", {}).items():
+        h = manager.hosts[int(hid_s)]
+        live = [bool(getattr(h, "down", False)),
+                bool(getattr(h, "link_down", False)),
+                bool(getattr(h, "blackhole", False))]
+        if live != list(flags):
+            raise CkptError(
+                f"fault section disagrees with host {h.name!r} "
+                f"state — corrupt archive")
+    manager._faults_applied = int(faults.get("applied", 0))
+    manager.runahead._value = max(1, int(meta["runahead_ns"]))
+    manager._resume = {
+        "rounds": meta["rounds"],
+        "span_rounds": meta["span_rounds"],
+        "busy_end_ns": meta["busy_end_ns"],
+        "next_start_ns": meta["next_start_ns"],
+        "live": meta.get("live", {}),
+        "path": path,
+    }
+    return manager
+
+
+def restore_host(manager, path: str, host_id: int, at: int) -> None:
+    """The host_restore fault: mid-run, re-import ONE host's state
+    from a snapshot taken earlier in this run (both planes), bumping
+    its past-due event times to the current boundary `at`.  The
+    host's counters and trace roll back to snapshot values with it —
+    the semantics of a node recovering from its last backup."""
+    from shadow_tpu.ckpt import replay
+    from shadow_tpu.host.process import Process
+
+    sections = ck.read_archive(path)
+    meta = json.loads(sections[ck.CK_SEC_META].decode())
+    _check_meta(manager.config, meta, manager.plane is not None)
+
+    cur = manager.hosts[host_id]
+    appmap: dict = {}
+    if cur.plane is not None:
+        appmap = manager.plane.engine.host_import(
+            sections[ck.CK_SEC_PLANE], host_id, at)
+
+    hosts = pickle.loads(sections[ck.CK_SEC_HOSTS])
+    h = hosts[host_id]
+    if h.id != host_id:
+        raise CkptError("snapshot host list is not id-ordered")
+    _rewire(manager, h, cur, appmap)
+    if h.plane is None:
+        # Object path: bump past-due Python event times to the
+        # boundary (stable: bumped events tie on time and keep their
+        # (kind, src, seq) order), then rebuild generator frames.
+        import heapq
+        heap = h.queue._heap
+        bumped = [(max(t, at), k, s, q, ev) for (t, k, s, q, ev)
+                  in heap]
+        for (t, k, s, q, ev) in bumped:
+            ev.time = t
+        heapq.heapify(bumped)
+        h.queue._heap = bumped
+        h.queue._last_popped_time = 0
+        from shadow_tpu.core.simtime import TIME_NEVER
+        with h._inbox_lock:
+            for ev in h._inbox:
+                if ev.time < at:
+                    ev.time = at
+            h._inbox_min = min((ev.time for ev in h._inbox),
+                               default=TIME_NEVER)
+        if h._now < at:
+            h._now = at
+        for proc in h.processes.values():
+            if type(proc) is Process:
+                replay.rebuild_process(proc)
+    manager.hosts[host_id] = h
+    # Drop back into the live scheduling structures.
+    h._nt_list = manager._nt if len(manager._nt) else None
+    h._py_work_arr = (manager._py_work
+                      if getattr(manager, "_py_work", None) is not None
+                      and h.plane is not None else None)
+    if h._nt_list is not None:
+        h._update_nt_slot()
+    # The restored flags govern; mirror them engine-side.
+    if h.plane is not None:
+        manager.plane.engine.set_host_fault(
+            host_id, bool(getattr(h, "down", False)),
+            bool(getattr(h, "link_down", False)),
+            bool(getattr(h, "blackhole", False)))

@@ -1,0 +1,186 @@
+"""Snapshot a running simulation at a conservative-round boundary.
+
+The manager's round loop calls `write_snapshot` at its boundary choke
+point (core/manager.py); everything here is a read-only walk over
+simulation state.  What goes in (docs/CHECKPOINT.md "what is
+captured"): sim clock + round counters, every host's complete object
+state (the Python object graph, with syscall transcripts standing in
+for live generator frames — ckpt/replay.py), the C++ engine plane
+(netplane.cpp plane_export), threefry RNG stream positions, the
+event/inbox queues, the four sim-time trace channels' accumulated
+bytes + counters, the eligibility audit, the object-lifecycle
+counters, and the fault-schedule cursor.  Wall-side state (EWMAs,
+phase walls, heartbeat cadence) is deliberately NOT captured — it is
+stripped by the determinism gate and re-measured on resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+from shadow_tpu.ckpt import format as ck
+
+
+def checkpoint_domain_error(manager) -> str | None:
+    """Why this simulation cannot be snapshotted (None = it can).
+    The checkpoint domain is pure-sim hosts: engine hosts running
+    engine-resident apps, and object-path hosts running internal
+    (Python) apps under syscall-transcript recording.  Everything
+    else is refused with a clear reason rather than silently dropped."""
+    from shadow_tpu.host.engine_app import EngineAppProcess
+    from shadow_tpu.host.managed import ManagedProcess
+    exp = manager.config.experimental
+    if exp.strace_logging_mode != "off":
+        return ("strace logging is enabled: strace files stream to "
+                "disk and cannot be resumed byte-identically "
+                "(disable strace_logging_mode to checkpoint)")
+    if exp.use_perf_timers:
+        return "use_perf_timers is wall-clock state; disable it to checkpoint"
+    if exp.tpu_shards > 1:
+        return ("the sharded mesh backend is not in the checkpoint "
+                "domain yet (tpu_shards must be 1)")
+    for name, hcfg in manager.config.hosts.items():
+        if hcfg.pcap_enabled:
+            return (f"host {name!r} captures pcap: capture files are "
+                    f"append-only and cannot be resumed "
+                    f"byte-identically (disable pcap to checkpoint)")
+    for host in manager.hosts:
+        for proc in host.processes.values():
+            if isinstance(proc, ManagedProcess):
+                return (f"{host.name}/{proc.name} is a managed (real-"
+                        f"binary) process: native memory cannot be "
+                        f"snapshotted — checkpointing covers pure-sim "
+                        f"hosts only (docs/CHECKPOINT.md)")
+        if host.plane is not None:
+            if host._nsocks:
+                return (f"host {host.name!r} runs a Python process "
+                        f"over engine sockets; move it off the plane "
+                        f"(native_dataplane: false) or run it "
+                        f"engine-resident to checkpoint")
+            for proc in host.processes.values():
+                if not isinstance(proc, EngineAppProcess):
+                    return (f"{host.name}/{proc.name}: only engine-"
+                            f"resident apps are snapshottable on "
+                            f"plane hosts")
+        else:
+            for proc in host.processes.values():
+                for t in getattr(proc, "threads", ()):
+                    from shadow_tpu.host.process import ST_EXITED
+                    if t.state != ST_EXITED and t.log is None:
+                        return (f"{host.name}/{proc.name}: live app "
+                                f"thread without a syscall transcript "
+                                f"— checkpointing must be enabled "
+                                f"from simulation start (a "
+                                f"`checkpoint:` config block turns "
+                                f"recording on)")
+    return None
+
+
+def _trace_state(manager) -> dict:
+    """The sim-time channels' continuation state: accumulated bytes +
+    record/drop counters, plus the always-on audit and the
+    object-lifecycle counters (both land in byte-diffed sim-stats)."""
+    from shadow_tpu.utils import object_counter
+    out: dict = {
+        "audit": list(manager.audit.counts),
+        "objects": (dict(object_counter._alloc),
+                    dict(object_counter._dealloc)),
+    }
+    flight = manager.flight
+    if flight is not None and flight.sim is not None:
+        s = flight.sim
+        out["flight_sim"] = (s.to_bytes(), s.records, s.dropped)
+    for name in ("netstat", "fabric"):
+        ch = getattr(manager, name)
+        if ch is not None:
+            out[name] = (ch.to_bytes(), ch.records, ch.dropped)
+    sct = manager.sctrace
+    if sct is not None and sct.channel is not None:
+        out["sctrace"] = [(b"".join(log.chunks), log.records,
+                           log.dropped) for log in sct.channel._logs]
+    return out
+
+
+def _fault_state(manager) -> dict:
+    return {
+        "applied": getattr(manager, "_faults_applied", 0),
+        "hosts": {h.id: [bool(getattr(h, "down", False)),
+                         bool(getattr(h, "link_down", False)),
+                         bool(getattr(h, "blackhole", False))]
+                  for h in manager.hosts
+                  if getattr(h, "down", False)
+                  or getattr(h, "link_down", False)
+                  or getattr(h, "blackhole", False)},
+    }
+
+
+def write_snapshot(manager, summary, next_start: int, path: str,
+                   live: dict | None = None) -> dict:
+    """Serialize the simulation at the current round boundary into
+    `path`.  `summary` is the in-progress SimSummary (round counters);
+    `next_start` the boundary's next window start; `live` carries the
+    deterministic router counters (dev_span_K ladder) the resumed loop
+    re-seeds.  Returns the meta dict."""
+    from shadow_tpu.ckpt.restore import config_digest
+    err = checkpoint_domain_error(manager)
+    if err is not None:
+        raise ck.CkptError(f"cannot snapshot: {err}")
+    if getattr(manager.propagator, "_outbox", None):
+        raise ck.CkptError("cannot snapshot: propagator outbox not "
+                           "drained at this boundary")
+    sections: dict[int, bytes] = {}
+
+    engine = None
+    if manager.plane is not None:
+        engine = manager.plane.engine
+        sections[ck.CK_SEC_PLANE] = engine.plane_export()
+
+    try:
+        sections[ck.CK_SEC_HOSTS] = pickle.dumps(manager.hosts,
+                                                 protocol=4)
+    except Exception as e:
+        raise ck.CkptError(
+            f"cannot snapshot: host state holds an unserializable "
+            f"object ({e!r}) — epoll/futex waiters and other "
+            f"managed-process machinery are outside the checkpoint "
+            f"domain (docs/CHECKPOINT.md)") from e
+
+    sections[ck.CK_SEC_RNG] = ck.pack_rng_rows(
+        [(h.id, h.rng._counter) for h in manager.hosts
+         if h.plane is None])
+    sections[ck.CK_SEC_TRACE] = pickle.dumps(_trace_state(manager),
+                                             protocol=4)
+    sections[ck.CK_SEC_FAULTS] = json.dumps(
+        _fault_state(manager), sort_keys=True).encode()
+
+    meta = {
+        "ck_version": ck.CK_VERSION,
+        "config_digest": config_digest(manager.config),
+        "seed": manager.config.general.seed,
+        "stop_time_ns": manager.config.general.stop_time_ns,
+        "n_hosts": len(manager.hosts),
+        "engine": manager.plane is not None,
+        "rounds": summary.rounds,
+        "span_rounds": summary.span_rounds,
+        "busy_end_ns": summary.busy_end_ns,
+        "next_start_ns": int(next_start),
+        "runahead_ns": manager.runahead.get(),
+        "faults_applied": getattr(manager, "_faults_applied", 0),
+        "live": dict(live or {}),
+        "channels": {
+            "flight_recorder":
+                manager.config.experimental.flight_recorder,
+            "sim_netstat": manager.config.experimental.sim_netstat,
+            "sim_fabricstat":
+                manager.config.experimental.sim_fabricstat,
+            "syscall_observatory":
+                manager.config.experimental.syscall_observatory,
+        },
+    }
+    sections[ck.CK_SEC_META] = json.dumps(meta, sort_keys=True).encode()
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    ck.write_archive(path, sections)
+    return meta

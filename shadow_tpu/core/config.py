@@ -81,6 +81,32 @@ class NetworkConfig:
 
 
 @dataclass
+class CheckpointConfig:
+    """`checkpoint:` block (docs/CHECKPOINT.md): snapshot the
+    simulation at the first conservative-round boundary at or after
+    each listed time.  Presence of the block also turns on syscall-
+    transcript recording for internal apps (the object path's
+    generator frames resume through replay)."""
+    at_ns: list[int] = field(default_factory=list)
+    directory: str | None = None  # default: <data_directory>/ckpt
+
+
+FAULT_ACTIONS = ("host_kill", "host_restore", "link_down", "link_up",
+                 "nic_blackhole", "nic_clear")
+
+
+@dataclass
+class FaultConfig:
+    """One `faults:` entry: applied deterministically at the first
+    round boundary at or after `at` through the manager's single
+    fault choke point (docs/CHECKPOINT.md "Fault injection")."""
+    at_ns: int
+    action: str       # one of FAULT_ACTIONS
+    host: str         # target host name
+    snapshot: str | None = None  # host_restore: archive path
+
+
+@dataclass
 class ExperimentalConfig:
     scheduler: str = "thread_per_core"
     runahead_ns: int | None = None  # None = auto (graph min latency)
@@ -138,6 +164,16 @@ class ExperimentalConfig:
     # throughput and routes; "force" always takes the device when
     # eligible (parity gates, demonstrations); "off" disables.
     tpu_device_spans: str = "auto"
+    # Device-span carry donation (donate_argnums=0: XLA reuses the
+    # resident carry's buffers in place).  OFF by default: a donated
+    # executable loaded back from the PERSISTENT XLA compilation cache
+    # corrupts the glibc heap on deserialization-hit runs (BASELINE.md
+    # round 6, reproduced with MALLOC_CHECK_ on the CPU backend).  "on"
+    # re-lands donation behind a compile-cache-safe guard: the span
+    # runners donate ONLY when no persistent compilation cache is
+    # configured (jax_compilation_cache_dir unset), and fall back to
+    # undonated dispatch otherwise — never the corrupting combination.
+    tpu_donate_buffers: str = "off"
     # Deterministic flight recorder (shadow_tpu/trace/,
     # docs/OBSERVABILITY.md): "on" records both channels (sim-time
     # event stream + wall-time phases -> flight-sim.bin /
@@ -217,6 +253,8 @@ class ConfigOptions:
     network: NetworkConfig
     experimental: ExperimentalConfig
     hosts: dict[str, HostConfig]
+    checkpoint: CheckpointConfig | None = None
+    faults: list[FaultConfig] = field(default_factory=list)
 
     def to_processed_dict(self) -> dict:
         """The fully-resolved options as a re-loadable YAML structure —
@@ -275,6 +313,7 @@ class ConfigOptions:
                 "tpu_exchange_capacity": e.tpu_exchange_capacity,
                 "native_dataplane": e.native_dataplane,
                 "tpu_device_spans": e.tpu_device_spans,
+                "tpu_donate_buffers": e.tpu_donate_buffers,
                 "flight_recorder": e.flight_recorder,
                 "sim_netstat": e.sim_netstat,
                 "netstat_interval": _ns(e.netstat_interval_ns),
@@ -290,6 +329,18 @@ class ConfigOptions:
             },
             "hosts": {},
         }
+        if self.checkpoint is not None:
+            out["checkpoint"] = {
+                "at": [_ns(t) for t in self.checkpoint.at_ns],
+                "directory": self.checkpoint.directory,
+            }
+        if self.faults:
+            out["faults"] = [{
+                "at": _ns(f.at_ns),
+                "action": f.action,
+                "host": f.host,
+                "snapshot": f.snapshot,
+            } for f in self.faults]
         for name in sorted(self.hosts):
             h = self.hosts[name]
             procs = []
@@ -344,7 +395,8 @@ class ConfigOptions:
     def from_dict(cls, raw: dict, base_dir: str = ".") -> "ConfigOptions":
         raw = {k: v for k, v in raw.items() if not str(k).startswith("x-")}
         unknown = set(raw) - {"general", "network", "experimental",
-                              "hosts", "host_option_defaults"}
+                              "hosts", "host_option_defaults",
+                              "checkpoint", "faults"}
         if unknown:
             raise ValueError(f"unknown config sections: {sorted(unknown)}")
 
@@ -417,6 +469,9 @@ class ConfigOptions:
                 ("tpu_device_spans", "tpu_device_spans",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
+                ("tpu_donate_buffers", "tpu_donate_buffers",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
                 ("flight_recorder", "flight_recorder",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
@@ -470,6 +525,11 @@ class ConfigOptions:
                 f"('off', 'wall', 'on')")
         if experimental.pcap_span_cap < 1:
             raise ValueError("pcap_span_cap must be >= 1")
+        if experimental.tpu_donate_buffers not in ("off", "on"):
+            raise ValueError(
+                f"unknown tpu_donate_buffers "
+                f"{experimental.tpu_donate_buffers!r}; "
+                f"expected one of ('off', 'on')")
 
         hosts_raw = raw.get("hosts", {}) or {}
         if not hosts_raw:
@@ -538,8 +598,56 @@ class ConfigOptions:
                     h.get("native_dataplane",
                           opt.get("native_dataplane", True))),
             )
+        checkpoint = None
+        ck_raw = raw.get("checkpoint")
+        if ck_raw is not None:
+            if not isinstance(ck_raw, dict):
+                raise ValueError("checkpoint: must be a mapping")
+            ck_unknown = set(ck_raw) - {"at", "directory"}
+            if ck_unknown:
+                raise ValueError(f"checkpoint: unknown key(s) "
+                                 f"{sorted(ck_unknown)}")
+            ats = ck_raw.get("at", [])
+            if not isinstance(ats, list):
+                ats = [ats]
+            checkpoint = CheckpointConfig(
+                at_ns=sorted(units.parse_time_ns(t) for t in ats),
+                directory=(str(ck_raw["directory"])
+                           if ck_raw.get("directory") is not None
+                           else None))
+
+        faults: list[FaultConfig] = []
+        for i, f in enumerate(raw.get("faults") or []):
+            if not isinstance(f, dict):
+                raise ValueError(f"faults[{i}]: must be a mapping")
+            f_unknown = set(f) - {"at", "action", "host", "snapshot"}
+            if f_unknown:
+                raise ValueError(f"faults[{i}]: unknown key(s) "
+                                 f"{sorted(f_unknown)}")
+            action = str(_require(f, "action", f"faults[{i}]"))
+            if action not in FAULT_ACTIONS:
+                raise ValueError(f"faults[{i}]: unknown action "
+                                 f"{action!r}; expected one of "
+                                 f"{FAULT_ACTIONS}")
+            host = str(_require(f, "host", f"faults[{i}]"))
+            if host not in hosts:
+                raise ValueError(f"faults[{i}]: unknown host {host!r}")
+            snapshot = f.get("snapshot")
+            if action == "host_restore" and not snapshot:
+                raise ValueError(f"faults[{i}]: host_restore needs a "
+                                 f"`snapshot` archive path")
+            faults.append(FaultConfig(
+                at_ns=units.parse_time_ns(_require(f, "at",
+                                                   f"faults[{i}]")),
+                action=action, host=host,
+                snapshot=str(snapshot) if snapshot else None))
+        # Deterministic application order: (time, config index) — the
+        # manager's choke point pops them in this order.
+        faults.sort(key=lambda fc: fc.at_ns)
+
         return cls(general=general, network=network,
-                   experimental=experimental, hosts=hosts)
+                   experimental=experimental, hosts=hosts,
+                   checkpoint=checkpoint, faults=faults)
 
 
 def _require(mapping: dict, key: str, where: str):

@@ -87,6 +87,105 @@ class Runahead:
 ZERO_PROGRESS = object()
 
 
+class SpawnTask:
+    """Picklable process-spawn task (one per configured process).
+
+    Everything it needs rides the host (dns, syscall handlers, strace
+    mode, the engine plane) or its own ProcessConfig, so a PENDING
+    spawn survives a checkpoint: the pickled event queue carries this
+    object, not a closure over the Manager (docs/CHECKPOINT.md)."""
+
+    __slots__ = ("pcfg", "index")
+
+    def __init__(self, pcfg, index: int):
+        self.pcfg = pcfg
+        self.index = index
+
+    def __call__(self, h) -> None:
+        pcfg = self.pcfg
+        strace_mode = h.strace_mode
+        # Engine-resident tgen apps: when the host lives on the
+        # native plane and nothing needs the Python process
+        # machinery (no strace), the whole app/syscall/TCP path
+        # runs in C++ with a byte-identical packet trace
+        # (host/engine_app.py) — including default-disposition
+        # signal delivery for shutdown_time configs.
+        if h.plane is not None and strace_mode is None:
+            from shadow_tpu.host.engine_app import (EngineAppProcess,
+                                                    engine_app_args)
+            spec = engine_app_args(pcfg, h, h.dns)
+            if spec is not None:
+                kind, a, b, c, d, e = spec[:6]
+                extra = spec[6:]  # e.g. the udp-mesh peer buffer
+                sh = h.syscall_handler
+                process = EngineAppProcess(
+                    h, f"{pcfg.path}.{self.index}",
+                    expected_final_state=pcfg.expected_final_state)
+                process.spawn_tag = self.index
+                process.app_idx = h.plane.engine.app_spawn(
+                    h.id, kind, a, b, c, d, e, sh.send_buf,
+                    sh.recv_buf, int(sh.send_autotune),
+                    int(sh.recv_autotune), h.now(), *extra)
+                return
+        factory = app_registry.lookup(pcfg.path)
+        if factory is None and "/" in pcfg.path:
+            # An explicit filesystem path: a real Linux binary, run
+            # under the interposition stack (preload shim + seccomp
+            # over the shmem IPC channel; host/managed.py).  Bare
+            # names never fall through to $PATH — a typo'd internal-
+            # app name must not execute some unrelated host program.
+            from shadow_tpu.host.managed import ManagedProcess
+            base = os.path.basename(pcfg.path)
+            process = ManagedProcess(
+                h, f"{base}.{self.index}",
+                [pcfg.path] + list(pcfg.args),
+                pcfg.environment,
+                expected_final_state=pcfg.expected_final_state,
+                work_dir=h.data_path)
+            process.strace_mode = strace_mode
+            process.spawn_tag = self.index
+            process.start_native(h, pcfg.path)
+            return
+        if factory is None:
+            process = Process(h, f"{pcfg.path}.{self.index}", pcfg.args,
+                              pcfg.environment,
+                              expected_final_state=pcfg.
+                              expected_final_state)
+            process.strace_mode = strace_mode
+            process.spawn_tag = self.index
+            process.stderr += (f"[shadow-tpu] unknown app "
+                               f"{pcfg.path!r}\n").encode()
+            process.exited = True
+            process.exit_code = 127
+            return
+        process = Process(h, f"{pcfg.path}.{self.index}", pcfg.args,
+                          pcfg.environment,
+                          expected_final_state=pcfg.expected_final_state)
+        process.strace_mode = strace_mode
+        process.spawn_tag = self.index
+        process.app_path = pcfg.path  # checkpoint replay rebuild key
+        process.start(h, factory(process, pcfg.args))
+
+
+class ShutdownTask:
+    """Picklable shutdown-signal task: delivers the configured signal
+    to every process its paired SpawnTask created (matched by
+    spawn_tag — no shared closure list, so a pickled pending shutdown
+    still finds processes restored from a snapshot)."""
+
+    __slots__ = ("index", "signal")
+
+    def __init__(self, index: int, signal: int):
+        self.index = index
+        self.signal = signal
+
+    def __call__(self, h) -> None:
+        for proc in list(h.processes.values()):
+            if getattr(proc, "spawn_tag", None) == self.index \
+                    and not proc.exited:
+                proc.raise_signal(h, self.signal)
+
+
 class Manager:
     def __init__(self, config: ConfigOptions):
         from shadow_tpu.utils import object_counter
@@ -166,10 +265,32 @@ class Manager:
             host.syscall_handler_native = self.syscall_handler_native
             host.data_path = os.path.join(config.general.data_directory,
                                           "hosts", name)
+            host.strace_mode = (
+                None if config.experimental.strace_logging_mode == "off"
+                else config.experimental.strace_logging_mode)
+            # A configured `checkpoint:` block turns on syscall-
+            # transcript recording (ckpt/replay.py): the object path's
+            # generator frames resume through replay, so recording
+            # must cover the whole run.
+            host.ckpt_record = config.checkpoint is not None
             self.dns.register(host_id, ip, name)
             self.hosts.append(host)
             for i, pcfg in enumerate(hcfg.processes):
                 self._schedule_spawn(host, i, pcfg)
+        self._host_by_name = {h.name: h.id for h in self.hosts}
+        # Fault-schedule cursor: how many `faults:` entries have been
+        # applied (restored by ckpt resume so a resumed run re-applies
+        # only the remainder).
+        self._faults_applied = 0
+        if config.faults and config.experimental.tpu_shards > 1:
+            # The sharded mesh propagator has no fault choke points
+            # (its exchange kernel would silently ignore link_down),
+            # so a schedule there would break the cross-scheduler
+            # determinism contract instead of erroring.
+            raise ValueError(
+                "faults: schedules are not supported with "
+                "tpu_shards > 1 (the sharded exchange carries no "
+                "fault mask; docs/CHECKPOINT.md)")
 
         # Loss thresholds as an integer matrix: one float->int conversion
         # at build time, shared verbatim by scalar and batched backends.
@@ -378,78 +499,12 @@ class Manager:
     # ------------------------------------------------------------------
 
     def _schedule_spawn(self, host: Host, index: int, pcfg) -> None:
-        spawned: list = []  # shared between the spawn and shutdown tasks
-
-        strace_mode = self.config.experimental.strace_logging_mode
-        if strace_mode == "off":
-            strace_mode = None
-
-        def spawn(h, _pcfg=pcfg):
-            # Engine-resident tgen apps: when the host lives on the
-            # native plane and nothing needs the Python process
-            # machinery (no strace), the whole app/syscall/TCP path
-            # runs in C++ with a byte-identical packet trace
-            # (host/engine_app.py) — including default-disposition
-            # signal delivery (terminate / stop / continue) for
-            # shutdown_time configs and kill(2) from co-resident
-            # processes.
-            if h.plane is not None and strace_mode is None:
-                from shadow_tpu.host.engine_app import (EngineAppProcess,
-                                                        engine_app_args)
-                spec = engine_app_args(_pcfg, h, self.dns)
-                if spec is not None:
-                    kind, a, b, c, d, e = spec[:6]
-                    extra = spec[6:]  # e.g. the udp-mesh peer buffer
-                    sh = self.syscall_handler
-                    process = EngineAppProcess(
-                        h, f"{_pcfg.path}.{index}",
-                        expected_final_state=_pcfg.expected_final_state)
-                    spawned.append(process)
-                    process.app_idx = h.plane.engine.app_spawn(
-                        h.id, kind, a, b, c, d, e, sh.send_buf,
-                        sh.recv_buf, int(sh.send_autotune),
-                        int(sh.recv_autotune), h.now(), *extra)
-                    return
-            factory = app_registry.lookup(_pcfg.path)
-            if factory is None and "/" in _pcfg.path:
-                # An explicit filesystem path: a real Linux binary, run
-                # under the interposition stack (preload shim + seccomp
-                # over the shmem IPC channel; host/managed.py).  Bare
-                # names never fall through to $PATH — a typo'd internal-
-                # app name must not execute some unrelated host program.
-                from shadow_tpu.host.managed import ManagedProcess
-                base = os.path.basename(_pcfg.path)
-                process = ManagedProcess(
-                    h, f"{base}.{index}",
-                    [_pcfg.path] + list(_pcfg.args),
-                    _pcfg.environment,
-                    expected_final_state=_pcfg.expected_final_state,
-                    work_dir=h.data_path)
-                process.strace_mode = strace_mode
-                spawned.append(process)
-                process.start_native(h, _pcfg.path)
-                return
-            if factory is None:
-                process = Process(h, f"{_pcfg.path}.{index}", _pcfg.args,
-                                  _pcfg.environment,
-                                  expected_final_state=_pcfg.
-                                  expected_final_state)
-                process.strace_mode = strace_mode
-                spawned.append(process)
-                process.stderr += (f"[shadow-tpu] unknown app "
-                                   f"{_pcfg.path!r}\n").encode()
-                process.exited = True
-                process.exit_code = 127
-                return
-            process = Process(h, f"{_pcfg.path}.{index}", _pcfg.args,
-                              _pcfg.environment,
-                              expected_final_state=_pcfg.expected_final_state)
-            process.strace_mode = strace_mode
-            spawned.append(process)
-            process.start(h, factory(process, _pcfg.args))
-
+        # SpawnTask/ShutdownTask are module-level picklable callables
+        # (a checkpoint carries pending spawns inside the pickled
+        # event queue; a closure over the Manager could not resume).
         from shadow_tpu.core.event import TaskRef
-        host.schedule_task_at(pcfg.start_time_ns, TaskRef("spawn", spawn))
+        host.schedule_task_at(pcfg.start_time_ns,
+                              TaskRef("spawn", SpawnTask(pcfg, index)))
         if pcfg.shutdown_time_ns is not None:
             # Deliver the configured shutdown signal through the emulated
             # signal path (ref: configuration.rs host process spec) — a
@@ -457,13 +512,9 @@ class Manager:
             # disposition terminates.
             from shadow_tpu.host.signals import parse_signal
             shutdown_sig = parse_signal(pcfg.shutdown_signal or "SIGTERM")
-
-            def shutdown(h):
-                for proc in spawned:
-                    if not proc.exited:
-                        proc.raise_signal(h, shutdown_sig)
-            host.schedule_task_at(pcfg.shutdown_time_ns,
-                                  TaskRef("shutdown", shutdown))
+            host.schedule_task_at(
+                pcfg.shutdown_time_ns,
+                TaskRef("shutdown", ShutdownTask(index, shutdown_sig)))
 
     # ------------------------------------------------------------------
     # The round loop (manager.rs:415-501)
@@ -744,7 +795,89 @@ class Manager:
         dev_off_reason = (trev.EL_ENGINE_OFF
                           if dev_mode not in ("auto", "force", "on")
                           else trev.EL_ENGINE_FAMILY)
+        # -------- checkpoint/resume + fault injection ----------------
+        # (shadow_tpu/ckpt/, docs/CHECKPOINT.md.)  Resume: seed the
+        # round counters and the deterministic router ladder from the
+        # snapshot, and cross-check the rebuilt state's next-event time
+        # against the recorded boundary.  Boundary ops: one sorted list
+        # of (time, kind, index) entries — faults before snapshots at
+        # equal times, each applied at the FIRST round boundary at or
+        # after its time through this single choke point.  Spans cap
+        # their `limit` at the next op so no op ever lands mid-span.
+        resume = getattr(self, "_resume", None)
+        ckpts_done: list = []
+        if resume is not None:
+            summary.rounds = resume["rounds"]
+            summary.span_rounds = resume["span_rounds"]
+            summary.busy_end_ns = resume["busy_end_ns"]
+            if start != resume["next_start_ns"]:
+                from shadow_tpu.ckpt.format import CkptError
+                raise CkptError(
+                    f"resume integrity check failed: rebuilt next-event "
+                    f"time {start} != snapshot boundary "
+                    f"{resume['next_start_ns']}")
+            live = resume.get("live", {})
+            dev_span_K = int(live.get("dev_span_K", dev_span_K))
+            dev_aborts_row = int(live.get("dev_aborts_row",
+                                          dev_aborts_row))
+            ckpts_done = list(live.get("ckpts_done", []))
+        if self.config.faults:
+            # Fault schedules disable device-resident spans: the SoA
+            # kernels carry no down-host mask, and the C++ engine +
+            # object path implement the (byte-identical) semantics.
+            dev_span_on = False
+            dev_off_reason = trev.EL_ENGINE_FAMILY
+        boundary_ops: list = []
+        ck_cfg = self.config.checkpoint
+        ck_dir = None
+        if ck_cfg is not None:
+            ck_dir = ck_cfg.directory or os.path.join(
+                self.config.general.data_directory, "ckpt")
+            for t in ck_cfg.at_ns:
+                if t not in ckpts_done:
+                    boundary_ops.append((t, 1, t))
+        for fi in range(self._faults_applied, len(self.config.faults)):
+            boundary_ops.append((self.config.faults[fi].at_ns, 0, fi))
+        boundary_ops.sort()
+
+        def apply_boundary_ops(at):
+            """Apply every due op at this round boundary; returns the
+            (possibly re-read) loop start."""
+            nonlocal dev_span_K, dev_aborts_row
+            while boundary_ops and at >= boundary_ops[0][0]:
+                _t, kind, idx = boundary_ops.pop(0)
+                if kind == 0:
+                    self._apply_fault(self.config.faults[idx], at,
+                                      fr_sim)
+                    self._faults_applied = idx + 1
+                    continue
+                if getattr(self.propagator, "_outbox", None):
+                    # Device per-round path mid-drain: defer the
+                    # snapshot one boundary (the outbox empties next
+                    # finish_round).
+                    boundary_ops.insert(0, (at + 1, 1, idx))
+                    boundary_ops.sort()
+                    break
+                from shadow_tpu.ckpt.snapshot import write_snapshot
+                path = os.path.join(ck_dir, f"ckpt-{idx}.stck")
+                ckpts_done.append(idx)
+                t0 = time.perf_counter()  # shadow-lint: allow[wall-clock] snapshot-write wall telemetry (bench[resume-10k])
+                write_snapshot(
+                    self, summary, at, path,
+                    live={"dev_span_K": dev_span_K,
+                          "dev_aborts_row": dev_aborts_row,
+                          "ckpts_done": list(ckpts_done)})
+                self.ckpt_write_wall_s = time.perf_counter() - t0  # shadow-lint: allow[wall-clock] snapshot-write wall telemetry (bench[resume-10k])
+                self.ckpt_last_path = path
+                from shadow_tpu.utils.shadow_log import LOG
+                LOG.info(f"checkpoint written: {path} (round "
+                         f"{summary.rounds}, sim {at / 1e9:.6f}s, "
+                         f"{self.ckpt_write_wall_s:.2f}s wall)")
+            return at
+
         while start is not None and start < stop:
+            if boundary_ops and start >= boundary_ops[0][0]:
+                start = apply_boundary_ops(start)
             round_reason = per_round_static
             if span_ok:
                 if getattr(self.propagator, "_outbox", None):
@@ -787,6 +920,13 @@ class Manager:
                     limit = min(limit, next_heartbeat)
                 if py_limit is not None:
                     limit = min(limit, py_limit)
+                if boundary_ops:
+                    # Checkpoint/fault ops apply at round boundaries
+                    # only: cap the span so the loop regains control
+                    # at (or before) the next op's time.  `limit`
+                    # never changes window sequencing, so traces are
+                    # unaffected.
+                    limit = min(limit, boundary_ops[0][0])
                 # With engine-side pcap, cap the span so capture
                 # buffers hold at most pcap_span_cap rounds of packets
                 # before the drain below (per-round streams; spans
@@ -1080,6 +1220,11 @@ class Manager:
             summary.packets_recv += h.counters["packets_recv"]
             summary.packets_dropped += h.counters["packets_dropped"]
             summary.syscalls += h.counters["syscalls"]
+            if h.down:
+                # A killed host's processes died with it: their
+                # expected_final_state is unjudgeable (the fault is
+                # the configured outcome, not a plugin error).
+                continue
             for proc in h.processes.values():
                 if not proc.matches_expected_final_state():
                     state = (f"exited {proc.exit_code}" if proc.exited
@@ -1329,6 +1474,11 @@ class Manager:
                                  dtype=np.uint32),
             self.config.general.seed,
             self.config.general.bootstrap_end_time_ns, tracing)
+        # Carry donation (experimental.tpu_donate_buffers): re-landed
+        # behind the compile-cache-safe guard in ops/span_mesh.py
+        # (BASELINE.md r6 documents the corrupting combination).
+        runner.donate = \
+            self.config.experimental.tpu_donate_buffers == "on"
         if self.flight is not None:
             runner.wall = self.flight.wall  # dispatch phase profiling
         if self.netstat is not None:
@@ -1373,6 +1523,50 @@ class Manager:
         if tcp.ineligible:
             return None, tcp
         return tcp.try_span(*args), tcp
+
+    def _apply_fault(self, f, at: int, fr_sim) -> None:
+        """Apply one `faults:` entry at round boundary `at` — the ONE
+        choke point (docs/CHECKPOINT.md): flip the host's fault flags
+        on both planes and stamp the FR_FAULT_* flight record.  The
+        drop semantics live in the data planes (Host.execute /
+        netplane.cpp run_until/deliver/device_push), keyed on these
+        flags, so every scheduler applies identical behavior."""
+        from shadow_tpu.trace import events as trev
+        hid = self._host_by_name[f.host]
+        host = self.hosts[hid]
+        kind = {
+            "host_kill": trev.FR_FAULT_KILL,
+            "host_restore": trev.FR_FAULT_RESTORE,
+            "link_down": trev.FR_FAULT_LINK_DOWN,
+            "link_up": trev.FR_FAULT_LINK_UP,
+            "nic_blackhole": trev.FR_FAULT_BLACKHOLE,
+            "nic_clear": trev.FR_FAULT_CLEAR,
+        }[f.action]
+        if f.action == "host_kill":
+            host.down = True
+        elif f.action == "link_down":
+            host.link_down = True
+        elif f.action == "link_up":
+            host.link_down = False
+        elif f.action == "nic_blackhole":
+            host.blackhole = True
+        elif f.action == "nic_clear":
+            host.blackhole = False
+        elif f.action == "host_restore":
+            from shadow_tpu.ckpt.restore import restore_host
+            restore_host(self, f.snapshot, hid, at)
+            host = self.hosts[hid]  # replaced by the restore
+        if host.plane is not None and f.action != "host_restore":
+            # restore_host mirrors its own flags; direct faults mirror
+            # here so the engine data plane drops identically.
+            self.plane.engine.set_host_fault(
+                hid, bool(host.down), bool(host.link_down),
+                bool(host.blackhole))
+        if fr_sim is not None:
+            fr_sim.event(at, kind, hid, 0, 0)
+        from shadow_tpu.utils.shadow_log import LOG
+        LOG.info(f"fault applied: {f.action} {f.host} at sim "
+                 f"{at / 1e9:.6f}s")
 
     def _log_heartbeat(self, sim_now: int, stop: int, wall_start: float,
                        out) -> None:
@@ -1686,6 +1880,20 @@ def _rss_kb() -> int:
 def run_simulation(config: ConfigOptions, write_data: bool = False):
     """run_shadow equivalent (src/main/shadow.rs:30)."""
     manager = Manager(config)
+    summary = manager.run()
+    if write_data:
+        manager.write_data_dir(summary)
+    return manager, summary
+
+
+def resume_simulation(config: ConfigOptions, snapshot: str,
+                      write_data: bool = False):
+    """Resume a snapshotted simulation mid-run (shadow_tpu/ckpt/,
+    docs/CHECKPOINT.md): rebuild the Manager from config, restore the
+    archive over it, and continue the round loop — every byte-diffed
+    artifact is a continuation of the straight run's."""
+    from shadow_tpu.ckpt.restore import resume_manager
+    manager = resume_manager(config, snapshot)
     summary = manager.run()
     if write_data:
         manager.write_data_dir(summary)

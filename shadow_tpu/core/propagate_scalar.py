@@ -61,6 +61,13 @@ class ScalarPropagator:
         return m
 
     def send(self, src_host, packet) -> None:
+        if src_host.link_down:
+            # NIC link down (docs/CHECKPOINT.md faults): the send dies
+            # at the egress instant, BEFORE the event-seq draw — the
+            # same position as the no-route drop, matching the C++
+            # twin (netplane.cpp device_push).
+            src_host.trace_drop(packet, "link-down")
+            return
         now = src_host.now()
         dst_id = self.dns.host_id_for_ip(packet.dst_ip)
         if dst_id is None:

@@ -155,6 +155,17 @@ class HostRng:
         here on it owns the stream position."""
         self._engine = engine
 
+    def __getstate__(self):
+        # Checkpoint (shadow_tpu/ckpt/): the engine delegate is
+        # re-attached on restore; an engine-owned stream's position
+        # travels in the plane blob, an object-path stream's in
+        # _counter here.
+        return (self._k0, self._k1, self._host_id, self._counter)
+
+    def __setstate__(self, state):
+        self._k0, self._k1, self._host_id, self._counter = state
+        self._engine = None
+
     def next_u64(self) -> int:
         if self._engine is not None:
             return self._engine.rng_next(self._host_id)

@@ -35,6 +35,22 @@ _TRACE_NAMES = {TRACE_SND: "SND", TRACE_DRP: "DRP", TRACE_RCV: "RCV"}
 
 
 class Host:
+    # Fault-injection state (docs/CHECKPOINT.md; netplane.cpp HostPlane
+    # twins): a DOWN host consumes no events — packet arrivals drop
+    # with the host-down cause at their recorded (path-independent)
+    # arrival instant, local tasks/timers discard silently.  LINK_DOWN
+    # drops both directions at the NIC, BLACKHOLE arrivals only.
+    # Class-level defaults so snapshots from older archives and
+    # direct constructions behave (flags flip per instance).
+    down = False
+    link_down = False
+    blackhole = False
+    # Syscall-transcript recording for internal-app threads (set by the
+    # manager when a `checkpoint:` block is configured; ckpt/replay.py
+    # rebuilds generator frames from the transcripts on resume).
+    ckpt_record = False
+    strace_mode = None  # set by the manager at build
+
     def __init__(self, host_id: int, name: str, ip: int, node_index: int,
                  seed: int, bw_down_bits: int, bw_up_bits: int,
                  qdisc: str = "fifo", mtu: int = 1500):
@@ -193,10 +209,17 @@ class Host:
         return "lo" in self.__dict__
 
     def _build_net_plane(self) -> None:
-        qdisc, mtu = self._net_qdisc, self._net_mtu
+        qdisc = self._net_qdisc
         self.lo = NetworkInterface(LOCALHOST_IP, "lo", qdisc)
         self.eth0 = NetworkInterface(self.ip, "eth0", qdisc)
         self.router = Router()
+        self._build_relays()
+
+    def _build_relays(self) -> None:
+        """The three relays hold pop-closures over the interfaces, so
+        they are rebuilt (not unpickled) on checkpoint restore —
+        __setstate__ re-applies their mutable state afterwards."""
+        mtu = self._net_mtu
         self.relay_loopback = Relay(
             "lo", lambda host, now: self.lo.pop_packet(host, now), None)
         self.relay_inet_out = Relay(
@@ -236,13 +259,26 @@ class Host:
             self._execute_native(until)
             return
         self.drain_inbox()
+        if self.down:
+            self._execute_down(until)
+            return
         q = self.queue
         cpu = self.cpu
+        nic_dead = self.link_down or self.blackhole
         while True:
             t = q.peek_time()
             if t is None or t >= until:
                 break
             ev = q.pop()
+            if nic_dead and ev.kind == KIND_PACKET:
+                # NIC fault: the arrival dies at its recorded instant
+                # (engine twin: the run_until inbox-pop check) — it
+                # never enters any queue ledger, so fabric
+                # conservation stays exact.
+                self._now = ev.time
+                self.counters["events"] += 1
+                self.trace_drop(ev.data, "link-down", at_time=ev.time)
+                continue
             if cpu is not None:
                 # CPU-model push-back (cpu.rs + host.rs:760-777): while
                 # the modeled CPU is saturated, events slip forward.
@@ -266,6 +302,24 @@ class Host:
                 cpu.add_delay(self.cpu_event_cost_ns)
         self._update_nt_slot()
 
+    def _execute_down(self, until: int) -> None:
+        """A killed host's round: drain every due event as a drop
+        (packets -> host-down attribution at the event's recorded
+        instant) or a silent discard (tasks/timers — its kernel state
+        is frozen).  Event counting matches the engine twin
+        (run_until's down branch) so sim-stats agree across paths."""
+        q = self.queue
+        while True:
+            t = q.peek_time()
+            if t is None or t >= until:
+                break
+            ev = q.pop()
+            self._now = ev.time
+            self.counters["events"] += 1
+            if ev.kind == KIND_PACKET:
+                self.trace_drop(ev.data, "host-down", at_time=ev.time)
+        self._update_nt_slot()
+
     def _execute_native(self, until: int) -> None:
         """Round execution with the native plane: the engine runs whole
         batches of its own events (inbox packet arrivals + relay/TCP
@@ -282,6 +336,28 @@ class Host:
         hid = self.id
         run_until = eng.run_until
         n_total = 0
+        if self.down:
+            # Dead plane host: engine-side events drain as drops inside
+            # run_until's down branch; Python-side events drain here
+            # (packets attribute host-down, tasks discard).  Drops
+            # generate no new events, so one engine pass suffices.
+            n, last = run_until(hid, until, 1, 0, 0, until)
+            n_total += n
+            if n and last > self._now:
+                self._now = last
+            while heap and heap[0][0] < until:
+                ev = q.pop()
+                self._now = ev.time
+                n_total += 1
+                if ev.kind == KIND_PACKET:
+                    if type(ev.data) is int:
+                        eng.deliver(hid, ev.data, ev.time)
+                    else:
+                        self.trace_drop(ev.data, "host-down",
+                                        at_time=ev.time)
+            self.counters["events"] += n_total
+            self._update_nt_slot()
+            return
         while True:
             if heap:
                 lt, lk, lsrc, lseq = heap[0][:4]
@@ -489,3 +565,56 @@ class Host:
         for time, kind, src, seq, text in sorted(entries):
             out.append(f"{time} {self.name} {text}")
         return out
+
+    # ------------------------------------------------------------------
+    # Checkpoint serialization (shadow_tpu/ckpt/, docs/CHECKPOINT.md)
+    # ------------------------------------------------------------------
+
+    # Manager-owned / unpicklable references a snapshot deliberately
+    # drops; ckpt/restore._rewire re-attaches them on resume.
+    _CKPT_SKIP = ("_inbox_lock", "_nt_list", "_py_work_arr",
+                  "_send_packet_fn", "_send_native_fn", "plane", "dns",
+                  "syscall_handler", "syscall_handler_native",
+                  "sc_wall", "sc_log",
+                  # run-local output path: snapshots must not embed the
+                  # data directory (identical sims -> identical bytes)
+                  "data_path")
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        for k in Host._CKPT_SKIP:
+            d.pop(k, None)
+        if "lo" in d:
+            # The relays hold pop-closures over the interfaces: strip
+            # them to their mutable state; __setstate__ rebuilds the
+            # closures and re-applies it.
+            d["_relay_state"] = tuple(
+                r.ckpt_state() for r in (self.relay_loopback,
+                                         self.relay_inet_out,
+                                         self.relay_inet_in))
+            for k in ("relay_loopback", "relay_inet_out",
+                      "relay_inet_in"):
+                d.pop(k, None)
+        return d
+
+    def __setstate__(self, d):
+        relay_state = d.pop("_relay_state", None)
+        self.__dict__.update(d)
+        self._inbox_lock = threading.Lock()
+        self._nt_list = None
+        self._py_work_arr = None
+        self._send_packet_fn = None
+        self._send_native_fn = None
+        self.plane = None
+        self.dns = None
+        self.syscall_handler = None
+        self.syscall_handler_native = None
+        self.sc_wall = None
+        self.sc_log = None
+        self.data_path = None
+        if relay_state is not None:
+            self._build_relays()
+            for relay, state in zip((self.relay_loopback,
+                                     self.relay_inet_out,
+                                     self.relay_inet_in), relay_state):
+                relay.ckpt_restore(state)

@@ -44,6 +44,12 @@ class Thread:
         self.last_condition = None
         self.sig_mask = 0
         self.sig_pending: set[int] = set()
+        # Syscall transcript (shadow_tpu/ckpt/replay.py): every value
+        # fed INTO the generator, recorded so a checkpoint can rebuild
+        # the (unpicklable) suspended frame by replay.  None = not
+        # recording (no `checkpoint:` block configured).
+        self.log = ([] if getattr(process.host, "ckpt_record", False)
+                    else None)
 
     def resume(self, host) -> None:
         """Drive the app generator until it blocks or exits
@@ -57,6 +63,7 @@ class Thread:
             return
         self.state = ST_RUNNABLE
         process = self.process
+        log = self.log
         while True:
             if self._pending_call is not None:
                 call, restarted = self._pending_call, True
@@ -65,11 +72,17 @@ class Thread:
                 try:
                     if self._pending_throw is not None:
                         exc, self._pending_throw = self._pending_throw, None
+                        if log is not None:
+                            log.append((2, exc))  # ckpt/replay LOG_THROW
                         call = self.gen.throw(exc)
                     elif not self._started:
                         self._started = True
+                        if log is not None:
+                            log.append((0,))      # ckpt/replay LOG_START
                         call = next(self.gen)
                     else:
+                        if log is not None:
+                            log.append((1, self._pending_send))  # LOG_SEND
                         call, self._pending_send = (
                             self.gen.send(self._pending_send), None)
                 except StopIteration as si:
@@ -133,6 +146,14 @@ class Thread:
         self.gen.close()
         self.process.thread_exited(host, self, code)
 
+    def __getstate__(self):
+        # Generator frames cannot be pickled: the checkpoint carries
+        # the syscall transcript instead and ckpt/replay.py rebuilds
+        # the frame on restore.
+        d = dict(self.__dict__)
+        d["gen"] = None
+        return d
+
 
 class Process:
     def __init__(self, host, name: str, argv: list[str],
@@ -184,6 +205,18 @@ class Process:
         self._strace_file = None
         self.expected_final_state = expected_final_state
         self.fds = host_descriptor_table()
+        # Internal-app registry path (set by the spawn task): the
+        # checkpoint replay rebuilds the main thread's generator via
+        # app_registry.lookup(app_path)(process, argv).
+        self.app_path: str | None = None
+
+    def __getstate__(self):
+        # The streamed strace file handle is process-local wall state;
+        # strace configs are refused by the checkpoint domain check, so
+        # dropping the handle here only covers direct constructions.
+        d = dict(self.__dict__)
+        d["_strace_file"] = None
+        return d
 
     def strace_write(self, data: bytes) -> None:
         if self._strace_file is None:

@@ -48,6 +48,35 @@ class NetworkInterface:
         self.bytes_received = 0
 
     # ------------------------------------------------------------------
+    # Checkpoint serialization (shadow_tpu/ckpt/): the send structures
+    # carry id(socket) heap tiebreaks (never consulted — packet
+    # priorities are unique) and a membership set whose iteration
+    # order is address-derived.  Both would make snapshot bytes differ
+    # between identical runs, so the pickle form canonicalizes them:
+    # tiebreaks become heap-array indices, the set becomes a list in
+    # deterministic (heap-array + round-robin) order.
+    # ------------------------------------------------------------------
+
+    def __getstate__(self):
+        d = {k: getattr(self, k) for k in self.__slots__
+             if hasattr(self, k)}
+        d["_send_heap"] = [(prio, i, sock) for i, (prio, _t, sock)
+                           in enumerate(self._send_heap)]
+        queued = []
+        for sock in [s for (_p, _t, s) in self._send_heap] + \
+                list(self._send_ready):
+            if sock in self._queued and sock not in queued:
+                queued.append(sock)
+        d["_queued"] = queued
+        return d
+
+    def __setstate__(self, d):
+        queued = d.pop("_queued")
+        for k, v in d.items():
+            setattr(self, k, v)
+        self._queued = set(queued)
+
+    # ------------------------------------------------------------------
     # Associations (namespace.rs: bind-time registration)
     # ------------------------------------------------------------------
 

@@ -44,6 +44,22 @@ class Relay:
         self.forwarded_pkts = 0
         self.forwarded_bytes = 0
 
+    def ckpt_state(self) -> tuple:
+        """Mutable state for a checkpoint (shadow_tpu/ckpt/): the
+        relay object itself is NOT pickled — its pop-closure binds the
+        owning host — so Host.__setstate__ rebuilds the relay and
+        re-applies this tuple."""
+        b = self._bucket
+        bucket = None if b is None else (b._balance, b._next_refill_time)
+        return (self._state, self._pending_packet, bucket, self.stalls,
+                self.forwarded_pkts, self.forwarded_bytes)
+
+    def ckpt_restore(self, state: tuple) -> None:
+        (self._state, self._pending_packet, bucket, self.stalls,
+         self.forwarded_pkts, self.forwarded_bytes) = state
+        if bucket is not None and self._bucket is not None:
+            self._bucket._balance, self._bucket._next_refill_time = bucket
+
     def notify(self, host) -> None:
         """Source device has packets; start forwarding unless a wakeup is
         already scheduled (in which case that wakeup will drain us)."""

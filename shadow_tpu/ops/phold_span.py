@@ -88,7 +88,7 @@ TEL_UNREACHABLE = 3
 TEL_NO_ROUTE = 4
 TEL_NO_SOCKET = 5
 TEL_RECVBUF_FULL = 9
-TEL_N = 13
+TEL_N = 15
 
 # Fabric-observatory activity mask (netplane.cpp FB_ACT_* twins;
 # registered in analysis pass 1).
@@ -1518,16 +1518,15 @@ class PholdSpanRunner(SpanMeshMixin):
                     packets + n_out, window_end, stop, limit,
                     max_rounds, iters + it)
 
-        # NOTE on donation: donate_argnums=0 (in-place reuse of the
-        # resident carry) measurably works, but a donated executable
+        # Donation (donate_argnums=0: in-place reuse of the resident
+        # carry) is gated by experimental.tpu_donate_buffers behind
+        # span_mesh.donation_cache_safe(): a donated executable
         # round-tripped through the persistent XLA compilation cache
         # (JAX_COMPILATION_CACHE_DIR, which bench.py relies on to
         # amortize this kernel's multi-second compile) corrupts the
         # glibc heap on deserialization-hit runs — reproduced on the
-        # CPU backend with MALLOC_CHECK_ (BASELINE.md round 6).
-        # Donation stays off until the toolchain fix; residency still
-        # removes the export+conversion leg, which dominates.
-        @jax.jit
+        # CPU backend with MALLOC_CHECK_ (BASELINE.md round 6) — so
+        # the guard refuses exactly that combination.
         def run(st, lat, thr, node, ips_sorted, ips_perm, k0, k1,
                 bootstrap_end, pay, start, stop, limit, runahead,
                 max_rounds):
@@ -1601,7 +1600,7 @@ class PholdSpanRunner(SpanMeshMixin):
             return (st, start, runahead, rounds, busy_rounds, packets,
                     busy_end, iters)
 
-        return run
+        return self._span_jit(jax, run)
 
     # ------------------------------------------------------------------
     # Driver
@@ -1731,14 +1730,15 @@ class PholdSpanRunner(SpanMeshMixin):
                 # consumed resident carry was already cleared above.
                 self.aborts += 1
                 return None
-            if resident:
-                # Treat the resident carry as consumed by the
-                # aborted dispatch (it will be again once donation
-                # returns); the engine — kept authoritative by the
-                # per-span imports — re-exports the same state.
-                # Abort accounting follows the fresh-dispatch
-                # convention: a capacity grow that then succeeds
-                # counts zero.
+            if resident or self.donate_active():
+                # The resident carry was consumed by the aborted
+                # dispatch — and under donation the FRESH input's
+                # buffers were donated to it too, so either way the
+                # retry needs new arrays; the engine — kept
+                # authoritative by the per-span imports — re-exports
+                # the same state.  Abort accounting follows the
+                # fresh-dispatch convention: a capacity grow that
+                # then succeeds counts zero.
                 resident = False
                 st = self._export_state()
                 if st is None:

@@ -487,6 +487,11 @@ class TpuPropagator:
         self.window_end = window_end
 
     def send(self, src_host, packet) -> None:
+        if src_host.link_down:
+            # NIC link down: egress drop before the event-seq draw
+            # (scalar/engine twins check at the same position).
+            src_host.trace_drop(packet, "link-down")
+            return
         dst_id = self.dns.host_id_for_ip(packet.dst_ip)
         if dst_id is None:
             src_host.trace_drop(packet, "no-route")

@@ -10,11 +10,58 @@ provide `self.mesh` and `self._H`.
 
 from __future__ import annotations
 
+import os
+import sys
+
+_donate_warned = False
+
+
+def donation_cache_safe() -> bool:
+    """The compile-cache-safe donation guard (BASELINE.md round 6):
+    a donated executable loaded back from the PERSISTENT XLA
+    compilation cache corrupts the glibc heap on deserialization-hit
+    runs, so `experimental.tpu_donate_buffers: on` donates ONLY when
+    no persistent cache is configured — never the corrupting
+    combination.  Checked once per kernel build (the cache dir is
+    process-static in practice)."""
+    global _donate_warned
+    import jax
+    cache_dir = (getattr(jax.config, "jax_compilation_cache_dir", None)
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+    if cache_dir:
+        if not _donate_warned:
+            _donate_warned = True
+            print("[shadow-tpu] tpu_donate_buffers=on ignored: a "
+                  "persistent XLA compilation cache is configured "
+                  f"({cache_dir!r}) and donated executables corrupt "
+                  "the heap on cache-hit runs (BASELINE.md r6)",
+                  file=sys.stderr)
+        return False
+    return True
+
 
 class SpanMeshMixin:
     """Device placement for span inputs: `mesh` (optional
     jax.sharding.Mesh with a "hosts" axis) and `_H` (host count)
     come from the concrete runner."""
+
+    # experimental.tpu_donate_buffers (set by the manager's runner
+    # factory): the jitted span loop donates its carry (argnums 0) so
+    # XLA reuses the resident buffers in place — behind the
+    # cache-safe guard above.
+    donate = False
+
+    def _span_jit(self, jax, run):
+        """jit the span loop, donating the carry when allowed."""
+        if self.donate and donation_cache_safe():
+            return jax.jit(run, donate_argnums=(0,))
+        return jax.jit(run)
+
+    def donate_active(self) -> bool:
+        """Whether the built span fn donates its carry — the
+        capacity-abort retry path must re-materialize the input then
+        (a donated buffer cannot be dispatched twice)."""
+        return self.donate and donation_cache_safe()
 
     def _put_static(self, jax, v):
         if self.mesh is None:

@@ -96,9 +96,9 @@ TEL_CODEL = 0
 TEL_RTR_LIMIT = 1
 TEL_LOSS_EDGE = 2
 TEL_UNREACHABLE = 3
-TEL_REASM_FULL = 11
-TEL_RECVWIN_TRUNC = 12
-TEL_N = 13
+TEL_REASM_FULL = 13
+TEL_RECVWIN_TRUNC = 14
+TEL_N = 15
 
 # Fabric-observatory activity mask (netplane.cpp FB_ACT_* twins;
 # registered in analysis pass 1): a host's queues are sampled in a
@@ -2100,10 +2100,11 @@ class TcpSpanRunner(SpanMeshMixin):
                     packets + n_out, window_end, stop, limit,
                     max_rounds, iters + it)
 
-        # Donation is OFF pending a toolchain fix (see phold_span
-        # _build: donated executables + the persistent compilation
-        # cache corrupt the heap on cache-hit runs).
-        @jax.jit
+        # Donation is gated by experimental.tpu_donate_buffers behind
+        # the compile-cache-safe guard (span_mesh.donation_cache_safe;
+        # BASELINE.md r6: donated executables + the persistent
+        # compilation cache corrupt the heap on cache-hit runs, so
+        # that exact combination is refused).
         def run(st, lat, thr, node, ips_sorted, ips_perm, k0, k1,
                 bootstrap_end, start, stop, limit, runahead,
                 max_rounds):
@@ -2192,7 +2193,7 @@ class TcpSpanRunner(SpanMeshMixin):
             return (st, start, runahead, rounds, busy_rounds, packets,
                     busy_end, iters)
 
-        return run
+        return self._span_jit(jax, run)
 
     # ------------------------------------------------------------------
     # Driver
@@ -2397,14 +2398,15 @@ class TcpSpanRunner(SpanMeshMixin):
                 # carry was already cleared above.
                 self.aborts += 1
                 return None
-            if resident:
-                # Treat the resident carry as consumed by the
-                # aborted dispatch (it will be again once donation
-                # returns); the engine — kept authoritative by the
-                # per-span imports — re-exports the same state.
-                # Abort accounting follows the fresh-dispatch
-                # convention: a capacity grow that then succeeds
-                # counts zero.
+            if resident or self.donate_active():
+                # The resident carry was consumed by the aborted
+                # dispatch — and under donation the FRESH input's
+                # buffers were donated to it too, so either way the
+                # retry needs new arrays; the engine — kept
+                # authoritative by the per-span imports — re-exports
+                # the same state.  Abort accounting follows the
+                # fresh-dispatch convention: a capacity grow that
+                # then succeeds counts zero.
                 resident = False
                 st = self._export_state()
                 if st is None:

@@ -1,0 +1,259 @@
+"""Checkpoint-archive CLI (docs/CHECKPOINT.md).
+
+    python -m shadow_tpu.tools.ckpt info   SNAPSHOT
+    python -m shadow_tpu.tools.ckpt verify SNAPSHOT
+    python -m shadow_tpu.tools.ckpt diff   SNAPSHOT_A SNAPSHOT_B
+    python -m shadow_tpu.tools.ckpt --smoke [--hosts N]
+
+`info` prints the snapshot's round/sim-time/host-count plus the
+section table (sizes + checksums); `verify` re-checksums every section
+and gates on the layout version; `diff` compares two snapshots section
+by section and names the first differing section — drilling into the
+engine plane blob to name the first differing HOST frame.  `--smoke`
+(the ./setup ckpt target) runs a 50-host tgen sim, snapshots it
+mid-run, resumes, and byte-compares every determinism-gated artifact
+of the resumed run against the straight run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import zlib
+
+from shadow_tpu.ckpt import format as ck
+
+
+def info(path: str) -> int:
+    meta = ck.read_meta(path)
+    table = ck.section_table(path)
+    print(f"{path}:")
+    print(f"  layout version : {ck.CK_VERSION}")
+    print(f"  round          : {meta['rounds']} "
+          f"(span rounds {meta['span_rounds']})")
+    print(f"  sim time       : {meta['next_start_ns'] / 1e9:.6f} s "
+          f"(busy end {meta['busy_end_ns'] / 1e9:.6f} s)")
+    print(f"  hosts          : {meta['n_hosts']} "
+          f"({'engine' if meta['engine'] else 'object'} path)")
+    print(f"  seed           : {meta['seed']}")
+    print(f"  runahead       : {meta['runahead_ns']} ns")
+    print(f"  faults applied : {meta['faults_applied']}")
+    print(f"  config digest  : {meta['config_digest'][:16]}…")
+    print("  sections:")
+    for sid, crc, length in table:
+        name = ck.CK_SEC_NAMES.get(sid, f"#{sid}")
+        print(f"    {name:<8} {length:>12} B  crc32 {crc:08x}")
+    sections = ck.read_archive(path)
+    if ck.CK_SEC_PLANE in sections:
+        _epoch, frames = ck.parse_plane_frames(
+            sections[ck.CK_SEC_PLANE])
+        n_hosts = sum(1 for fid in frames if fid != ck.CK_GLOBAL_FRAME)
+        print(f"  engine plane   : {n_hosts} host frame(s)")
+    return 0
+
+
+def verify(path: str) -> int:
+    table = ck.section_table(path)  # magic + layout-version gate
+    bad = 0
+    off = ck.CK_HDR_BYTES + ck.CK_SEC_HDR_BYTES * len(table)
+    with open(path, "rb") as f:
+        f.seek(off)
+        for sid, crc, length in table:
+            payload = f.read(length)
+            name = ck.CK_SEC_NAMES.get(sid, f"#{sid}")
+            if len(payload) != length:
+                print(f"  {name}: TRUNCATED ({len(payload)}/{length} B)")
+                bad += 1
+                continue
+            actual = zlib.crc32(payload) & 0xFFFFFFFF
+            if actual != crc:
+                print(f"  {name}: CHECKSUM MISMATCH "
+                      f"({actual:08x} != {crc:08x})")
+                bad += 1
+            else:
+                print(f"  {name}: ok ({length} B)")
+    # The plane blob carries its own (engine-build) layout version.
+    if not bad:
+        sections = ck.read_archive(path)
+        if ck.CK_SEC_PLANE in sections:
+            try:
+                ck.parse_plane_frames(sections[ck.CK_SEC_PLANE])
+            except ck.CkptError as e:
+                print(f"  plane: {e}")
+                bad += 1
+    print("verify:", "FAIL" if bad else "ok")
+    return 1 if bad else 0
+
+
+def diff(path_a: str, path_b: str) -> int:
+    sa = ck.read_archive(path_a)
+    sb = ck.read_archive(path_b)
+    first = None
+    for sid in sorted(set(sa) | set(sb)):
+        name = ck.CK_SEC_NAMES.get(sid, f"#{sid}")
+        a, b = sa.get(sid), sb.get(sid)
+        if a == b:
+            print(f"  {name}: identical "
+                  f"({len(a) if a is not None else 0} B)")
+            continue
+        if a is None or b is None:
+            print(f"  {name}: only in "
+                  f"{path_a if b is None else path_b}")
+        elif sid == ck.CK_SEC_PLANE:
+            ea, fa = ck.parse_plane_frames(a)
+            eb, fb = ck.parse_plane_frames(b)
+            hosts = sorted(
+                fid for fid in set(fa) | set(fb)
+                if fa.get(fid) != fb.get(fid))
+            named = ["global" if h == ck.CK_GLOBAL_FRAME else f"host {h}"
+                     for h in hosts[:8]]
+            extra = f" (+{len(hosts) - 8} more)" if len(hosts) > 8 else ""
+            print(f"  {name}: DIFFERS — first differing frame(s): "
+                  f"{', '.join(named)}{extra}"
+                  + (f"; state epoch {ea} vs {eb}" if ea != eb else ""))
+        elif sid == ck.CK_SEC_META:
+            ma, mb = json.loads(a.decode()), json.loads(b.decode())
+            keys = sorted(k for k in set(ma) | set(mb)
+                          if ma.get(k) != mb.get(k))
+            print(f"  {name}: DIFFERS — keys: {', '.join(keys)}")
+        else:
+            n = next((i for i, (x, y) in enumerate(zip(a, b))
+                      if x != y), min(len(a), len(b)))
+            print(f"  {name}: DIFFERS ({len(a)} vs {len(b)} B, "
+                  f"first difference at byte {n})")
+        if first is None:
+            first = name
+    if first is None:
+        print("diff: identical")
+        return 0
+    print(f"diff: first differing section: {first}")
+    return 1
+
+
+def _collect(dirpath: str) -> dict:
+    """Determinism-gate artifact collection (tests/test_determinism.py
+    collect() semantics: metrics.wall and the wall channel stripped,
+    volatile processed-config lines normalized)."""
+    import re
+    out = {}
+    for root, _, files in os.walk(dirpath):
+        for fn in files:
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, dirpath)
+            with open(p, "rb") as f:
+                data = f.read()
+            if fn == "sim-stats.json":
+                stats = json.loads(data)
+                stats.get("metrics", {}).pop("wall", None)
+                data = json.dumps(stats, indent=2,
+                                  sort_keys=True).encode()
+            if fn == "flight-wall.json":
+                data = b"<wall>"
+            if fn == "processed-config.yaml":
+                data = re.sub(rb"data_directory: .*", b"<n>", data)
+                data = re.sub(rb"directory: .*", b"<n>", data)
+            out[rel] = data
+    return out
+
+
+def smoke(n_hosts: int) -> int:
+    """50-host run -> snapshot -> resume -> byte-compare (the
+    ./setup ckpt target): every determinism-gated artifact of the
+    resumed run must equal the straight run's."""
+    import tempfile
+
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import resume_simulation, run_simulation
+    from shadow_tpu.tools.netgen import tcp_stream_yaml
+
+    with tempfile.TemporaryDirectory() as td:
+        text = tcp_stream_yaml(n_hosts, loss=0.005, stop_time="2s",
+                               seed=11, scheduler="tpu")
+
+        def cfg(sub, snapdir):
+            config = ConfigOptions.from_yaml_text(text)
+            config.general.data_directory = os.path.join(td, sub)
+            config.experimental.sim_netstat = "on"
+            config.experimental.sim_fabricstat = "on"
+            from shadow_tpu.core.config import CheckpointConfig
+            config.checkpoint = CheckpointConfig(
+                at_ns=[1_000_000_000],
+                directory=os.path.join(td, snapdir))
+            return config
+
+        _m, s = run_simulation(cfg("straight", "snaps"),
+                               write_data=True)
+        if not s.ok:
+            print(f"ckpt smoke: sim failed: {s.plugin_errors[:3]}",
+                  file=sys.stderr)
+            return 1
+        snap = os.path.join(td, "snaps", "ckpt-1000000000.stck")
+        if not os.path.exists(snap):
+            print("ckpt smoke: no snapshot written", file=sys.stderr)
+            return 1
+        if info(snap) != 0 or verify(snap) != 0:
+            return 1
+        _m2, s2 = resume_simulation(cfg("resumed", "snaps2"), snap,
+                                    write_data=True)
+        if not s2.ok:
+            print(f"ckpt smoke: resume failed: {s2.plugin_errors[:3]}",
+                  file=sys.stderr)
+            return 1
+        a = _collect(os.path.join(td, "straight"))
+        b = _collect(os.path.join(td, "resumed"))
+        bad = [rel for rel in sorted(set(a) | set(b))
+               if a.get(rel) != b.get(rel)]
+        if bad:
+            print(f"ckpt smoke: resumed artifacts diverged: {bad}",
+                  file=sys.stderr)
+            return 1
+        # The resumed snapshot schedule was already consumed: the
+        # second run writes none (documented: times <= the resume
+        # point are skipped).
+    print(f"ckpt smoke: ok ({n_hosts} hosts, snapshot at round "
+          f"boundary >= 1s, resume byte-identical across "
+          f"{len(a)} artifacts)")
+    return 0
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] in ("info", "verify", "diff"):
+        sub = argparse.ArgumentParser(
+            prog=f"shadow_tpu.tools.ckpt {argv[0]}")
+        sub.add_argument("snapshot")
+        if argv[0] == "diff":
+            sub.add_argument("snapshot_b")
+        sargs = sub.parse_args(argv[1:])
+        try:
+            if argv[0] == "info":
+                return info(sargs.snapshot)
+            if argv[0] == "verify":
+                return verify(sargs.snapshot)
+            return diff(sargs.snapshot, sargs.snapshot_b)
+        except ck.CkptError as e:
+            print(f"ckpt: {e}", file=sys.stderr)
+            return 1
+    ap = argparse.ArgumentParser(prog="shadow_tpu.tools.ckpt",
+                                 description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the 50-host snapshot/resume smoke and "
+                         "exit nonzero unless artifacts byte-match")
+    ap.add_argument("--hosts", type=int, default=50,
+                    help="host count for --smoke (default 50)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        from shadow_tpu.utils.platform import honor_platform_env
+        honor_platform_env()
+        return smoke(args.hosts)
+    ap.print_usage(sys.stderr)
+    print("ckpt: a subcommand (info/verify/diff) or --smoke is "
+          "required", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

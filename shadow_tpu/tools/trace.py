@@ -118,10 +118,14 @@ def summarize(data_dir: str, chrome_out: str | None = None,
             if kind == FR_SPAN_COMMIT:
                 span_rounds += c
         n_recs = len(sim_bytes) // FLIGHT_REC_BYTES
+        from shadow_tpu.trace.events import FR_FAULT_CLEAR, FR_FAULT_KILL
+        n_faults = sum(n for k, n in kinds.items()
+                       if FR_FAULT_KILL <= k <= FR_FAULT_CLEAR)
+        fault_s = f", {n_faults} fault injections" if n_faults else ""
         print(f"  sim-time channel: {n_recs} records "
               f"({kinds[FR_ROUND]} round, {kinds[FR_SPAN_COMMIT]} span "
               f"commits covering {span_rounds} rounds, "
-              f"{kinds[FR_SPAN_ABORT]} aborts)", file=out)
+              f"{kinds[FR_SPAN_ABORT]} aborts{fault_s})", file=out)
     else:
         print("  sim-time channel: absent (run with "
               "experimental.flight_recorder: on)", file=out)

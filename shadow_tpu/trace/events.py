@@ -25,12 +25,22 @@ import struct
 
 FLIGHT_REC_BYTES = 32
 
-# Event kinds (C++ twin: the FR_* enum in netplane.cpp).
+# Event kinds (C++ twin: the FR_* enum in netplane.cpp).  The
+# FR_FAULT_* kinds are the deterministic fault-injection records
+# (docs/CHECKPOINT.md): stamped by the manager's round loop — the ONE
+# fault choke point — at the round boundary where each configured
+# fault applies, with `a` = the target host id.
 FR_ROUND = 0        # one conservative round executed
 FR_SPAN_START = 1   # multi-round span entered (engine or device)
 FR_SPAN_COMMIT = 2  # span committed: rounds/packets imported
 FR_SPAN_ABORT = 3   # device span aborted (transactional rollback)
-FR_N = 4
+FR_FAULT_KILL = 4       # host_kill applied (a = host id)
+FR_FAULT_RESTORE = 5    # host_restore-from-snapshot applied
+FR_FAULT_LINK_DOWN = 6  # link_down applied
+FR_FAULT_LINK_UP = 7    # link_up applied
+FR_FAULT_BLACKHOLE = 8  # nic_blackhole applied
+FR_FAULT_CLEAR = 9      # nic_clear applied
+FR_N = 10
 
 # Span families (Python-side only: the engine records no span events —
 # the manager orchestrates spans and stamps these itself).
@@ -113,13 +123,21 @@ TEL_BUCKET_DEFER = 10  # token-bucket defer-queue overflow (the relay
 #                        admits >= 1 MTU, so this is structurally 0 —
 #                        kept so a future bounded defer queue cannot
 #                        drop unattributed)
-TEL_WIRE_N = 11        # causes above count in packets_dropped
+# Fault injection (docs/CHECKPOINT.md): packets that die because a
+# configured fault took their endpoint away.  HOST_DOWN = the
+# destination host was killed (arrivals drop at their recorded,
+# path-independent arrival instant; conservation stays exact because
+# the packet never entered any queue ledger); LINK_DOWN = a NIC-level
+# fault (link_down both directions, nic_blackhole inbound only).
+TEL_HOST_DOWN = 11     # arrival at a killed host
+TEL_LINK_DOWN = 12     # NIC link down / blackholed
+TEL_WIRE_N = 13        # causes above count in packets_dropped
 # TCP receiver discards: the packet itself was delivered (counted
 # received, not dropped) but the receiver discarded payload — these
 # retransmit later, so they sit OUTSIDE the packets_dropped sum.
-TEL_REASM_FULL = 11    # out-of-window segment not stashed
-TEL_RECVWIN_TRUNC = 12 # in-order bytes beyond the receive buffer
-TEL_N = 13
+TEL_REASM_FULL = 13    # out-of-window segment not stashed
+TEL_RECVWIN_TRUNC = 14 # in-order bytes beyond the receive buffer
+TEL_N = 15
 
 # Order mirrors the TEL_* values above AND the C++ TEL_NAMES table
 # (pass 1 checks both directions).
@@ -135,6 +153,8 @@ TEL_NAMES = (
     "udp-filter",
     "recv-buffer-full",
     "bucket-defer-overflow",
+    "host-down",
+    "link-down",
     "reassembly-full",
     "recv-window-trunc",
 )
@@ -158,6 +178,8 @@ TEL_BY_REASON = {
     "accept-backlog-full": TEL_BACKLOG_FULL,
     "udp-connected-filter": TEL_UDP_FILTER,
     "rcvbuf-full": TEL_RECVBUF_FULL,
+    "host-down": TEL_HOST_DOWN,
+    "link-down": TEL_LINK_DOWN,
 }
 
 # Per-connection telemetry record (TEL_REC_BYTES, little-endian, no

@@ -1,0 +1,559 @@
+"""Checkpoint/resume + fault-injection gates (shadow_tpu/ckpt/,
+docs/CHECKPOINT.md).
+
+The acceptance contract: a run snapshotted mid-run and resumed must
+produce BYTE-IDENTICAL determinism-gated artifacts — packet traces,
+the four sim-time channels, sim-stats — to the straight run, on every
+execution path; and a configured fault (host_kill & co) must apply
+deterministically across runs and schedulers with every dropped packet
+attributed to the new TEL_HOST_DOWN / TEL_LINK_DOWN causes and
+conservation exact.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GML = """
+graph [ directed 0
+  node [ id 0 host_bandwidth_down "50 Mbit" host_bandwidth_up "50 Mbit" ]
+  node [ id 1 host_bandwidth_down "20 Mbit" host_bandwidth_up "20 Mbit" ]
+  edge [ source 0 target 1 latency "25 ms" packet_loss 0.03 ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+]
+"""
+
+
+def small_config(data, scheduler, ckpt_dir=None, at="1050ms",
+                 faults=None, device_spans=None):
+    """Two-host tgen transfer over a lossy 25ms edge; the 1050ms
+    snapshot point lands mid-transfer (handshake done, rtx/reassembly
+    live)."""
+    from shadow_tpu.core.config import ConfigOptions
+    d = {
+        "general": {"stop_time": "15s", "seed": 42,
+                    "data_directory": str(data), "parallelism": 2},
+        "network": {"graph": {"type": "gml", "inline": GML}},
+        "experimental": {"scheduler": scheduler,
+                         "flight_recorder": "on",
+                         "sim_netstat": "on",
+                         "sim_fabricstat": "on",
+                         "syscall_observatory": "on"},
+        "hosts": {
+            "alice": {"network_node_id": 0, "processes": [
+                {"path": "tgen-client",
+                 "args": ["bob", "80", "150000", "2"],
+                 "start_time": "1s"}]},
+            "bob": {"network_node_id": 1, "processes": [
+                {"path": "tgen-server", "args": ["80"],
+                 "expected_final_state": "running"}]},
+        },
+    }
+    if ckpt_dir is not None:
+        d["checkpoint"] = {"at": [at], "directory": str(ckpt_dir)}
+    if faults is not None:
+        d["faults"] = faults
+    if device_spans is not None:
+        d["experimental"]["tpu_device_spans"] = device_spans
+    return ConfigOptions.from_dict(d)
+
+
+def collect(dirpath):
+    """Determinism-gate artifact collection (test_determinism.py
+    semantics): wall channels stripped, volatile config lines
+    normalized — everything else byte-diffed."""
+    out = {}
+    for root, _, files in os.walk(str(dirpath)):
+        for fn in files:
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, str(dirpath))
+            with open(p, "rb") as f:
+                data = f.read()
+            if fn == "sim-stats.json":
+                stats = json.loads(data)
+                stats.get("metrics", {}).pop("wall", None)
+                data = json.dumps(stats, indent=2,
+                                  sort_keys=True).encode()
+            if fn == "flight-wall.json":
+                data = b"<wall-channel: normalized>"
+            if fn == "processed-config.yaml":
+                data = re.sub(rb"data_directory: .*", b"<n>", data)
+                data = re.sub(rb"directory: .*", b"<n>", data)
+            out[rel] = data
+    return out
+
+
+def run_straight_and_resumed(tmp_path, scheduler, at="1050ms",
+                             device_spans=None):
+    """One checkpointed straight run + one resumed run; returns their
+    collected artifact dicts + the snapshot path."""
+    from shadow_tpu.core.manager import (resume_simulation,
+                                         run_simulation)
+    snapdir = tmp_path / f"snaps-{scheduler}"
+    cfg = small_config(tmp_path / f"straight-{scheduler}", scheduler,
+                       ckpt_dir=snapdir, at=at,
+                       device_spans=device_spans)
+    _m, s = run_simulation(cfg, write_data=True)
+    assert s.ok, s.plugin_errors
+    from shadow_tpu.utils.units import parse_time_ns
+    snap = str(snapdir / f"ckpt-{parse_time_ns(at)}.stck")
+    assert os.path.exists(snap), "no snapshot written"
+    cfg2 = small_config(tmp_path / f"resumed-{scheduler}", scheduler,
+                        ckpt_dir=tmp_path / "snaps2", at=at,
+                        device_spans=device_spans)
+    _m2, s2 = resume_simulation(cfg2, snap, write_data=True)
+    assert s2.ok, s2.plugin_errors
+    a = collect(tmp_path / f"straight-{scheduler}")
+    b = collect(tmp_path / f"resumed-{scheduler}")
+    return a, b, snap
+
+
+@pytest.mark.parametrize("scheduler",
+                         ["serial", "thread_per_core", "tpu"])
+def test_resume_byte_identical(tmp_path, scheduler):
+    """THE acceptance gate, per scheduler: resume-vs-straight byte
+    identity on the packet trace, all four sim-time channels
+    (flight/telemetry/syscall/fabric) and sim-stats.  serial and
+    thread_per_core exercise the object path (generator frames rebuilt
+    by transcript replay); tpu the C++ engine plane_export/import."""
+    a, b, _snap = run_straight_and_resumed(tmp_path, scheduler)
+    assert a.keys() == b.keys(), (sorted(a), sorted(b))
+    for rel in sorted(a):
+        assert a[rel] == b[rel], \
+            f"{rel} diverged between straight and resumed runs"
+    # The gate actually covered the interesting artifacts.
+    for rel in ("packet-trace.txt", "flight-sim.bin",
+                "telemetry-sim.bin", "fabric-sim.bin",
+                "sim-stats.json"):
+        assert rel in a and a[rel], f"{rel} missing/empty"
+
+
+def test_snapshot_round_trip_object_vs_engine(tmp_path):
+    """Snapshot/restore round-trips on BOTH paths, and two identical
+    runs write byte-identical snapshot archives (maps serialize
+    sorted; nothing wall-clock-derived enters the file) — the
+    property `ckpt diff` relies on."""
+    from shadow_tpu.core.manager import run_simulation
+    for scheduler in ("serial", "tpu"):
+        blobs = []
+        for trial in ("a", "b"):
+            snapdir = tmp_path / f"rt-{scheduler}-{trial}"
+            cfg = small_config(tmp_path / f"rtd-{scheduler}-{trial}",
+                               scheduler, ckpt_dir=snapdir)
+            _m, s = run_simulation(cfg, write_data=False)
+            assert s.ok
+            snap = snapdir / "ckpt-1050000000.stck"
+            blobs.append(snap.read_bytes())
+        assert blobs[0] == blobs[1], \
+            f"{scheduler}: snapshot archives differ between runs"
+
+
+def test_cross_scheduler_resume_within_object_path(tmp_path):
+    """A snapshot taken under serial resumes under thread_per_core
+    (same object plane) byte-identically — scheduling is not part of
+    the snapshotted state."""
+    from shadow_tpu.core.manager import (resume_simulation,
+                                         run_simulation)
+    snapdir = tmp_path / "snaps"
+    cfg = small_config(tmp_path / "ser", "serial", ckpt_dir=snapdir)
+    _m, s = run_simulation(cfg, write_data=True)
+    assert s.ok
+    snap = str(snapdir / "ckpt-1050000000.stck")
+    cfg2 = small_config(tmp_path / "thr", "thread_per_core",
+                        ckpt_dir=tmp_path / "s2")
+    _m2, s2 = resume_simulation(cfg2, snap, write_data=True)
+    assert s2.ok, s2.plugin_errors
+    a = collect(tmp_path / "ser")
+    b = collect(tmp_path / "thr")
+    for rel in ("packet-trace.txt", "telemetry-sim.bin",
+                "fabric-sim.bin", "syscalls-sim.bin"):
+        assert a[rel] == b[rel], f"{rel} diverged across schedulers"
+
+
+def test_managed_process_config_rejected(tmp_path):
+    """Managed (real-binary) processes are outside the checkpoint
+    domain: the snapshot must refuse with a clear error, not write a
+    partial archive."""
+    from shadow_tpu.ckpt.format import CkptError
+    from shadow_tpu.ckpt.snapshot import write_snapshot
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import Manager, SimSummary
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "2s",
+                    "data_directory": str(tmp_path / "d")},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "hosts": {"h0": {"network_node_id": 0, "processes": [
+            {"path": "/bin/true", "expected_final_state": "any"}]}},
+    })
+    manager = Manager(cfg)
+    # Force the spawn so a ManagedProcess exists (no run needed).
+    from shadow_tpu.host.managed import ManagedProcess
+
+    class _Fake(ManagedProcess):
+        def __init__(self, host):
+            host.processes[9999] = self
+            self.name = "fake"
+    _Fake(manager.hosts[0])
+    with pytest.raises(CkptError, match="managed"):
+        write_snapshot(manager, SimSummary(), 0,
+                       str(tmp_path / "x.stck"))
+
+
+def test_version_mismatch_rejected(tmp_path):
+    """An archive written under a different layout version must be
+    refused with an actionable error."""
+    import struct
+
+    from shadow_tpu.ckpt import format as ck
+    from shadow_tpu.core.manager import run_simulation
+    snapdir = tmp_path / "snaps"
+    cfg = small_config(tmp_path / "d", "serial", ckpt_dir=snapdir)
+    _m, s = run_simulation(cfg, write_data=False)
+    assert s.ok
+    snap = snapdir / "ckpt-1050000000.stck"
+    blob = bytearray(snap.read_bytes())
+    magic, version, n, flags = ck.CK_HDR.unpack_from(blob, 0)
+    ck.CK_HDR.pack_into(blob, 0, magic, version + 1, n, flags)
+    bad = tmp_path / "bad.stck"
+    bad.write_bytes(bytes(blob))
+    with pytest.raises(ck.CkptError, match="layout version"):
+        ck.read_archive(str(bad))
+    # ckpt verify gates on it too
+    from shadow_tpu.tools import ckpt as ckpt_cli
+    assert ckpt_cli.main(["verify", str(bad)]) == 1
+    # and a corrupted payload fails verify without crashing
+    blob2 = bytearray(snap.read_bytes())
+    blob2[-1] ^= 0xFF
+    bad2 = tmp_path / "bad2.stck"
+    bad2.write_bytes(bytes(blob2))
+    assert ckpt_cli.main(["verify", str(bad2)]) == 1
+
+
+def test_digest_mismatch_rejected(tmp_path):
+    """Resuming under a semantically different config (seed changed)
+    must be refused."""
+    from shadow_tpu.ckpt.format import CkptError
+    from shadow_tpu.core.manager import (resume_simulation,
+                                         run_simulation)
+    snapdir = tmp_path / "snaps"
+    cfg = small_config(tmp_path / "d", "serial", ckpt_dir=snapdir)
+    _m, s = run_simulation(cfg, write_data=False)
+    assert s.ok
+    cfg2 = small_config(tmp_path / "d2", "serial")
+    cfg2.general.seed = 43
+    with pytest.raises(CkptError, match="does not match"):
+        resume_simulation(cfg2, str(snapdir / "ckpt-1050000000.stck"))
+
+
+def test_ckpt_cli_info_and_diff(tmp_path, capsys):
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools import ckpt as ckpt_cli
+    for name, at in (("s1", "1050ms"), ("s2", "1100ms")):
+        cfg = small_config(tmp_path / name, "serial",
+                           ckpt_dir=tmp_path / f"{name}-snaps", at=at)
+        _m, s = run_simulation(cfg, write_data=False)
+        assert s.ok
+    a = str(tmp_path / "s1-snaps" / "ckpt-1050000000.stck")
+    b = str(tmp_path / "s2-snaps" / "ckpt-1100000000.stck")
+    assert ckpt_cli.main(["info", a]) == 0
+    out = capsys.readouterr().out
+    assert "hosts" in out and "object path" in out
+    assert ckpt_cli.main(["verify", a]) == 0
+    capsys.readouterr()
+    assert ckpt_cli.main(["diff", a, a]) == 0
+    assert "identical" in capsys.readouterr().out
+    assert ckpt_cli.main(["diff", a, b]) == 1
+    out = capsys.readouterr().out
+    assert "DIFFERS" in out and "first differing section" in out
+
+
+# ---------------------------------------------------------------------
+# Fault injection
+
+
+def fault_config(data, scheduler, faults):
+    from shadow_tpu.core.config import ConfigOptions
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": "4s", "seed": 7,
+                    "data_directory": str(data), "parallelism": 2},
+        "network": {"graph": {"type": "gml", "inline": GML}},
+        "experimental": {"scheduler": scheduler,
+                         "flight_recorder": "on",
+                         "sim_netstat": "on", "sim_fabricstat": "on"},
+        "faults": faults,
+        "hosts": {
+            "alice": {"network_node_id": 0, "processes": [
+                {"path": "udp-flood",
+                 "args": ["bob", "90", "2000", "400", "1000000"],
+                 "start_time": "1s",
+                 "expected_final_state": "any"}]},
+            "bob": {"network_node_id": 1, "processes": [
+                {"path": "udp-sink", "args": ["90"],
+                 "expected_final_state": "running"}]},
+        }})
+
+
+KILL_BOB = [{"at": "1500ms", "action": "host_kill", "host": "bob"}]
+
+
+def test_host_kill_deterministic_across_runs_and_schedulers(tmp_path):
+    """A host-kill at a fixed sim time applies at the same round
+    boundary on every scheduler: two runs AND all three schedulers
+    produce byte-identical traces/channels, every in-flight packet to
+    the dead host is TEL_HOST_DOWN-attributed, and conservation is
+    exact."""
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.trace.events import TEL_HOST_DOWN
+    blobs = {}
+    for scheduler in ("serial", "thread_per_core", "tpu"):
+        for trial in ("a", "b"):
+            data = tmp_path / f"{scheduler}-{trial}"
+            m, s = run_simulation(
+                fault_config(data, scheduler, KILL_BOB),
+                write_data=True)
+            assert s.ok, s.plugin_errors
+            drops = m.drop_cause_totals()
+            assert drops.get("host-down", 0) > 100, drops
+            assert "unattributed" not in drops
+            # conservation: wire causes sum to packets_dropped
+            assert sum(h.drop_causes[TEL_HOST_DOWN]
+                       for h in m.hosts) == drops["host-down"]
+            cons = m.fabric_conservation()
+            assert cons["violations"] == 0, cons
+            blob = {}
+            for fn in ("packet-trace.txt", "telemetry-sim.bin",
+                       "fabric-sim.bin"):
+                blob[fn] = (data / fn).read_bytes()
+            blobs[(scheduler, trial)] = blob
+    base = blobs[("serial", "a")]
+    for key, blob in blobs.items():
+        for fn, data in base.items():
+            assert blob[fn] == data, f"{fn} diverged on {key}"
+    # the kill actually shows in the flight record
+    from shadow_tpu.trace.events import FR_FAULT_KILL, iter_records
+    recs = list(iter_records(
+        (tmp_path / "serial-a" / "flight-sim.bin").read_bytes()))
+    kills = [r for r in recs if r[1] == FR_FAULT_KILL]
+    assert len(kills) == 1 and kills[0][2] == 1  # host id of bob
+
+
+def test_link_down_up_and_blackhole(tmp_path):
+    """link_down kills both directions (sends die at egress, arrivals
+    at the NIC) until link_up; nic_blackhole only swallows arrivals.
+    All drops attribute to TEL_LINK_DOWN and the sim stays
+    conservation-exact and deterministic."""
+    from shadow_tpu.core.manager import run_simulation
+    faults = [
+        {"at": "1200ms", "action": "link_down", "host": "bob"},
+        {"at": "1800ms", "action": "link_up", "host": "bob"},
+        {"at": "2400ms", "action": "nic_blackhole", "host": "bob"},
+        {"at": "2800ms", "action": "nic_clear", "host": "bob"},
+    ]
+    totals = []
+    for scheduler in ("serial", "tpu"):
+        m, s = run_simulation(
+            fault_config(tmp_path / scheduler, scheduler, faults),
+            write_data=True)
+        assert s.ok, s.plugin_errors
+        drops = m.drop_cause_totals()
+        assert drops.get("link-down", 0) > 100, drops
+        assert "unattributed" not in drops
+        assert m.fabric_conservation()["violations"] == 0
+        totals.append((drops.get("link-down"),
+                       (tmp_path / scheduler /
+                        "packet-trace.txt").read_bytes()))
+    assert totals[0] == totals[1], "link faults diverged across paths"
+
+
+def test_host_restore_from_snapshot(tmp_path):
+    """The recovery arc: snapshot mid-run, kill a host, then restore
+    it from the snapshot — deterministic across runs, and the restored
+    host actually serves traffic again (its state rolled back to the
+    snapshot, counters included — the semantics of recovering from a
+    backup)."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+
+    def build(data):
+        snapdir = str(tmp_path / "snaps")
+        return ConfigOptions.from_dict({
+            "general": {"stop_time": "4s", "seed": 7,
+                        "data_directory": str(data)},
+            "network": {"graph": {"type": "gml", "inline": GML}},
+            "experimental": {"scheduler": "serial",
+                             "flight_recorder": "on"},
+            "checkpoint": {"at": ["1200ms"], "directory": snapdir},
+            "faults": [
+                {"at": "1500ms", "action": "host_kill", "host": "bob"},
+                {"at": "2000ms", "action": "host_restore",
+                 "host": "bob",
+                 "snapshot": os.path.join(snapdir,
+                                          "ckpt-1200000000.stck")},
+            ],
+            "hosts": {
+                "alice": {"network_node_id": 0, "processes": [
+                    {"path": "udp-flood",
+                     "args": ["bob", "90", "2000", "400", "1000000"],
+                     "start_time": "1s",
+                     "expected_final_state": "any"}]},
+                "bob": {"network_node_id": 1, "processes": [
+                    {"path": "udp-sink", "args": ["90"],
+                     "expected_final_state": "running"}]},
+            }})
+
+    m1, s1 = run_simulation(build(tmp_path / "r1"), write_data=True)
+    assert s1.ok, s1.plugin_errors
+    m2, s2 = run_simulation(build(tmp_path / "r2"), write_data=True)
+    assert s2.ok, s2.plugin_errors
+    a = (tmp_path / "r1" / "packet-trace.txt").read_bytes()
+    b = (tmp_path / "r2" / "packet-trace.txt").read_bytes()
+    assert a == b, "host_restore runs diverged"
+    # The restore rolls the host's state — counters and trace included
+    # — back to the snapshot (reimage-from-backup semantics,
+    # docs/CHECKPOINT.md): the outage window shows as a gap in bob's
+    # receive record, and traffic resumes after the restore.
+    rcv_ts = [int(ln.split()[0]) for ln in a.decode().splitlines()
+              if " bob RCV " in ln]
+    assert any(t > 2_100_000_000 for t in rcv_ts), \
+        "restored host never received traffic"
+    # (exclusive upper bound: the snapshot's in-flight packets bump to
+    # the restore boundary and legitimately deliver AT t=2s)
+    assert not [t for t in rcv_ts
+                if 1_500_000_000 < t < 2_000_000_000], \
+        "dead host received traffic during the outage"
+    assert m1.fabric_conservation()["violations"] == 0
+    from shadow_tpu.trace.events import (FR_FAULT_KILL,
+                                         FR_FAULT_RESTORE,
+                                         iter_records)
+    recs = list(iter_records(
+        (tmp_path / "r1" / "flight-sim.bin").read_bytes()))
+    assert any(r[1] == FR_FAULT_KILL for r in recs)
+    assert any(r[1] == FR_FAULT_RESTORE for r in recs)
+
+
+# ---------------------------------------------------------------------
+# The 1k-host engine-path acceptance gate (tier-1; skips without the
+# native engine).
+
+
+def test_resume_1k_host_tgen_engine_path(tmp_path):
+    """ISSUE 9 acceptance: a 1k-host tgen run on the C++ engine path,
+    snapshotted mid-run, resumes byte-identically on every
+    determinism-gated artifact (flight/telemetry/syscall/fabric
+    channels + sim-stats + packet trace)."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import (Manager, resume_simulation,
+                                         run_simulation)
+    from shadow_tpu.tools.netgen import tgen_tier_yaml
+
+    # Client starts stagger over 1-6s (netgen), so stop at 8s lets
+    # every transfer finish; the 3s snapshot point is mid-ramp with
+    # hundreds of connections live.
+    text = tgen_tier_yaml(1000, nbytes=20_000, count=1,
+                          stop_time="8s", seed=5, scheduler="tpu")
+
+    def cfg(sub, snapdir):
+        c = ConfigOptions.from_yaml_text(text)
+        c.general.data_directory = str(tmp_path / sub)
+        c.experimental.flight_recorder = "on"
+        c.experimental.sim_netstat = "on"
+        c.experimental.sim_fabricstat = "on"
+        from shadow_tpu.core.config import CheckpointConfig
+        c.checkpoint = CheckpointConfig(
+            at_ns=[3_000_000_000], directory=str(tmp_path / snapdir))
+        return c
+
+    probe = Manager(cfg("probe", "p-snaps"))
+    if probe.plane is None:
+        pytest.skip("native engine unavailable: engine path "
+                    "unexercised")
+    _m, s = run_simulation(cfg("straight", "snaps"), write_data=True)
+    assert s.ok, s.plugin_errors[:3]
+    snap = str(tmp_path / "snaps" / "ckpt-3000000000.stck")
+    assert os.path.exists(snap)
+    _m2, s2 = resume_simulation(cfg("resumed", "snaps2"), snap,
+                                write_data=True)
+    assert s2.ok, s2.plugin_errors[:3]
+    a = collect(tmp_path / "straight")
+    b = collect(tmp_path / "resumed")
+    assert a.keys() == b.keys()
+    for rel in sorted(a):
+        assert a[rel] == b[rel], f"{rel} diverged (1k engine resume)"
+    for rel in ("flight-sim.bin", "telemetry-sim.bin",
+                "fabric-sim.bin", "packet-trace.txt"):
+        assert a[rel], f"{rel} empty"
+
+
+# ---------------------------------------------------------------------
+# Forced-device span resume legs (slow: XLA compiles on CPU take
+# minutes) — both device-span families.
+
+
+@pytest.mark.slow
+def test_resume_forced_device_tcp_span(tmp_path):
+    from shadow_tpu.core.config import CheckpointConfig, ConfigOptions
+    from shadow_tpu.core.manager import (resume_simulation,
+                                         run_simulation)
+    from shadow_tpu.tools.netgen import tcp_stream_yaml
+    text = tcp_stream_yaml(8, loss=0.01, stop_time="3s", seed=11,
+                           scheduler="tpu", device_spans="force")
+
+    def cfg(sub, snapdir):
+        c = ConfigOptions.from_yaml_text(text)
+        c.general.data_directory = str(tmp_path / sub)
+        c.experimental.sim_netstat = "on"
+        c.experimental.sim_fabricstat = "on"
+        c.checkpoint = CheckpointConfig(
+            at_ns=[1_500_000_000], directory=str(tmp_path / snapdir))
+        return c
+
+    m, s = run_simulation(cfg("straight", "snaps"), write_data=True)
+    assert s.ok, s.plugin_errors[:3]
+    runner = getattr(m, "_dev_span_tcp", None)
+    if runner is None or not runner.rounds:
+        pytest.skip("device spans unexercised on this backend")
+    snap = str(tmp_path / "snaps" / "ckpt-1500000000.stck")
+    _m2, s2 = resume_simulation(cfg("resumed", "s2"), snap,
+                                write_data=True)
+    assert s2.ok, s2.plugin_errors[:3]
+    a = collect(tmp_path / "straight")
+    b = collect(tmp_path / "resumed")
+    for rel in ("packet-trace.txt", "telemetry-sim.bin",
+                "fabric-sim.bin", "sim-stats.json"):
+        assert a[rel] == b[rel], f"{rel} diverged (forced-device tcp)"
+
+
+@pytest.mark.slow
+def test_resume_forced_device_phold_span(tmp_path):
+    from shadow_tpu.core.config import CheckpointConfig, ConfigOptions
+    from shadow_tpu.core.manager import (resume_simulation,
+                                         run_simulation)
+    from shadow_tpu.tools.netgen import phold_yaml
+    text = phold_yaml(8, n_init=3, stop_time="3s",
+                      scheduler="tpu", device_spans="force")
+
+    def cfg(sub, snapdir):
+        c = ConfigOptions.from_yaml_text(text)
+        c.general.data_directory = str(tmp_path / sub)
+        c.checkpoint = CheckpointConfig(
+            at_ns=[1_500_000_000], directory=str(tmp_path / snapdir))
+        return c
+
+    m, s = run_simulation(cfg("straight", "snaps"), write_data=True)
+    assert s.ok, s.plugin_errors[:3]
+    runner = getattr(m, "_dev_span", None)
+    if runner is None or not runner.rounds:
+        pytest.skip("device spans unexercised on this backend")
+    snap = str(tmp_path / "snaps" / "ckpt-1500000000.stck")
+    _m2, s2 = resume_simulation(cfg("resumed", "s2"), snap,
+                                write_data=True)
+    assert s2.ok, s2.plugin_errors[:3]
+    a = collect(tmp_path / "straight")
+    b = collect(tmp_path / "resumed")
+    for rel in ("packet-trace.txt", "sim-stats.json"):
+        assert a[rel] == b[rel], f"{rel} diverged (forced-device phold)"

@@ -84,8 +84,8 @@ def test_tel_cause_table_reorder_is_caught(cpp_text):
 def test_unregistered_tel_constant_is_caught(cpp_text):
     # a new TEL_* member with no contract row must fail closed — a
     # half-registered drop cause could never conserve
-    mutated = _mutate(cpp_text, "constexpr int TEL_WIRE_N = 11;",
-                      "constexpr int TEL_WIRE_N = 11;\n"
+    mutated = _mutate(cpp_text, "constexpr int TEL_WIRE_N = 13;",
+                      "constexpr int TEL_WIRE_N = 13;\n"
                       "constexpr int TEL_BOGUS = 99;")
     v = twin_constants.check(ROOT, cpp_text=mutated)
     assert any("TEL_BOGUS" in x.message for x in v), \
@@ -321,3 +321,66 @@ def test_sc_constant_removal_is_caught(shim_text):
     assert any(m.startswith("C++ constant SC_SHIM") for m in msgs), msgs
     assert any("SC_SHIMX" in m and "no contract row" in m
                for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------
+# Checkpoint framing constants (CK_*; shadow_tpu/ckpt/format.py twins,
+# docs/CHECKPOINT.md).  The plane blob's header constants must never
+# drift silently: a mismatched magic/version/header-size would misparse
+# every snapshot — or worse, accept one written by a different build.
+
+
+def test_ck_layout_version_drift_is_caught(cpp_text):
+    mutated = _mutate(cpp_text,
+                      "constexpr uint32_t CK_PLANE_VERSION = 1;",
+                      "constexpr uint32_t CK_PLANE_VERSION = 2;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("CK_PLANE_VERSION" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_ck_section_size_drift_is_caught(cpp_text):
+    """Frame-header width drift (the 'section size' of the plane
+    blob's framing) must be flagged against the Python parser twin."""
+    mutated = _mutate(cpp_text,
+                      "constexpr int CK_FRAME_HDR_BYTES = 12;",
+                      "constexpr int CK_FRAME_HDR_BYTES = 16;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("CK_FRAME_HDR_BYTES" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_unregistered_ck_constant_fails_closed(cpp_text):
+    """A new CK_* constant without a contract row (and a ckpt/format.py
+    twin) must fail the pass — the prefix is fail-closed like
+    FR_*/EL_*/TEL_*."""
+    mutated = _mutate(cpp_text,
+                      "constexpr uint32_t CK_GLOBAL_FRAME",
+                      "constexpr uint32_t CK_ROGUE = 7;\n"
+                      "constexpr uint32_t CK_GLOBAL_FRAME")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("CK_ROGUE" in m and "no contract row" in m
+               for m in msgs), msgs
+
+
+def test_fault_flight_kind_drift_is_caught(cpp_text):
+    """FR_FAULT_* ride the fail-closed FR_ namespace: reordering the
+    fault kinds must be flagged against trace/events.py."""
+    mutated = _mutate(cpp_text,
+                      "FR_FAULT_KILL, FR_FAULT_RESTORE,",
+                      "FR_FAULT_RESTORE, FR_FAULT_KILL,")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("FR_FAULT" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_tel_host_down_drift_is_caught(cpp_text):
+    """The fault drop causes sit mid-enum: swapping them shifts the
+    cause codes and must be flagged against every TEL_* twin."""
+    mutated = _mutate(cpp_text, "TEL_HOST_DOWN, TEL_LINK_DOWN,",
+                      "TEL_LINK_DOWN, TEL_HOST_DOWN,")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("TEL_HOST_DOWN" in x.message or
+               "TEL_LINK_DOWN" in x.message for x in v), \
+        [x.render() for x in v]
