@@ -251,6 +251,7 @@ def run_once(build, scheduler: str, report_routes: str | None = None,
         print(f"fabric: peak queue {fabric['peak_queue_depth']}, "
               f"link util {100.0 * fabric['link_utilization']:.1f}%, "
               f"refill stalls {fabric['refill_stalls']}, "
+              f"marks {fabric.get('marked_pkts', 0)}, "
               f"conservation {fabric['conservation']}{fct_s}",
               file=sys.stderr)
     if devcap and manager.plane is not None:
@@ -643,18 +644,24 @@ def managed_rung() -> dict | None:
         }
 
 
-def incast_rung() -> dict | None:
+def incast_rung(tcp: dict | None = None,
+                label: str = "incast-32",
+                nbytes: int = 500_000,
+                stop_time: str = "3s") -> dict | None:
     """N->1 fan-in smoke (netgen.incast_yaml; ISSUE 8): queue buildup
     at the sink's inbound CoDel queue with the byte-conservation gate
     enforced, recorded in the headline JSON with peak queue depth and
-    the FCT percentiles.  Engine path, seconds of wall — safe ahead
-    of the headline print."""
+    the FCT percentiles.  `tcp` threads the per-host congestion
+    controller through (ISSUE 10: the incast-ecn rung runs this under
+    {"cc": "dctcp", "ecn": "on"}).  Engine path, seconds of wall —
+    safe ahead of the headline print."""
     from shadow_tpu.core.config import ConfigOptions
     from shadow_tpu.core.manager import Manager
     from shadow_tpu.tools.netgen import incast_yaml
 
     cfg = ConfigOptions.from_yaml_text(
-        incast_yaml(32, scheduler="tpu"))
+        incast_yaml(32, nbytes=nbytes, stop_time=stop_time,
+                    scheduler="tpu", tcp=tcp))
     cfg.experimental.flight_recorder = "wall"
     manager = Manager(cfg)
     for h in manager.hosts:
@@ -669,15 +676,64 @@ def incast_rung() -> dict | None:
             f"incast byte conservation violated: "
             f"{fabric['conservation']}")
     fct = fabric.get("fct", {})
-    print(f"bench[incast-32]: {summary.packets_sent} packets in "
+    print(f"bench[{label}]: {summary.packets_sent} packets in "
           f"{wall:.1f}s wall, peak queue "
           f"{fabric['peak_queue_depth']}, "
+          f"marks {fabric.get('marked_pkts', 0)}, "
           f"fct p50/p99/p999 {fct.get('p50_ns', 0) / 1e6:.0f}/"
           f"{fct.get('p99_ns', 0) / 1e6:.0f}/"
           f"{fct.get('p999_ns', 0) / 1e6:.0f} ms, conservation ok",
           file=sys.stderr)
     return {"fan_in": 32, "wall_s": round(wall, 3),
             "packets": summary.packets_sent, "fabric": fabric}
+
+
+def incast_ecn_rung() -> dict | None:
+    """Standing DCTCP rung (ISSUE 10): a COMPLETION-SIZED 32->1
+    incast (100 KB responses — every flow finishes inside the run, so
+    FCT measures the fan-in tail, not the bottleneck's bandwidth) run
+    twice, drop-based reno vs `tcp: {cc: dctcp, ecn: on}`, and the
+    two FCT p99s recorded side by side in the headline JSON.  CE
+    marks must be NONZERO on the dctcp leg (the marking law fired)
+    and conservation must hold exactly on both runs (incast_rung
+    refuses to return numbers otherwise) — the claim DCTCP exists to
+    make, congestion signaled by marks instead of drops cuts the
+    fan-in tail, as a measured number."""
+    drop = incast_rung(label="incast-ecn-32/drop-based",
+                       nbytes=100_000, stop_time="4s")
+    ecn = incast_rung(tcp={"cc": "dctcp", "ecn": "on"},
+                      label="incast-ecn-32/dctcp",
+                      nbytes=100_000, stop_time="4s")
+    if drop is None or ecn is None:
+        return None
+    marks = ecn["fabric"].get("marked_pkts", 0)
+    if marks <= 0:
+        raise AssertionError("incast-ecn: DCTCP marking law never "
+                             "fired (marks == 0)")
+    p99_drop = drop["fabric"].get("fct", {}).get("p99_ns", 0)
+    p99_ecn = ecn["fabric"].get("fct", {}).get("p99_ns", 0)
+    out = {
+        "fan_in": 32,
+        "nbytes": 100_000,
+        "wall_s": round(drop["wall_s"] + ecn["wall_s"], 3),
+        "marks": marks,
+        "mark_causes": ecn["fabric"].get("marks", {}),
+        "fct_p99_ns_dctcp": p99_ecn,
+        "fct_p99_ns_drop_based": p99_drop,
+        "peak_queue_dctcp": ecn["fabric"]["peak_queue_depth"],
+        "peak_queue_drop_based": drop["fabric"]["peak_queue_depth"],
+        "fabric": ecn["fabric"],
+    }
+    if p99_drop and p99_ecn:
+        out["p99_speedup"] = round(p99_drop / p99_ecn, 3)
+        print(f"bench[incast-ecn-32]: fct p99 "
+              f"{p99_ecn / 1e6:.0f} ms dctcp vs "
+              f"{p99_drop / 1e6:.0f} ms drop-based "
+              f"({out['p99_speedup']}x), peak queue "
+              f"{out['peak_queue_dctcp']} vs "
+              f"{out['peak_queue_drop_based']}, marks {marks}",
+              file=sys.stderr)
+    return out
 
 
 def resume_10k_rung() -> dict | None:
@@ -1030,6 +1086,15 @@ def main() -> None:
         print(f"bench[incast-32]: failed: {e}", file=sys.stderr)
         incast = None
 
+    # DCTCP incast rung (ISSUE 10): the same fan-in under
+    # `tcp: {cc: dctcp, ecn: on}` — marks must fire, conservation
+    # must hold, FCT p99 recorded next to the drop-based figure.
+    try:
+        incast_ecn = incast_ecn_rung()
+    except Exception as e:  # noqa: BLE001 — never cost the headline
+        print(f"bench[incast-ecn-32]: failed: {e}", file=sys.stderr)
+        incast_ecn = None
+
     # Checkpoint/resume rung (ISSUE 9): snapshot the 10k rung mid-run,
     # resume, byte-compare — numbers recorded only when the identity
     # gate holds (engine path, no tunnel risk).
@@ -1114,6 +1179,10 @@ def main() -> None:
         # fan-in rung with its conservation gate.
         "fabric": tpu_obs.get("fabric", {}),
         "incast": incast,
+        # DCTCP/ECN (ISSUE 10): the incast fan-in re-run under
+        # cc=dctcp — nonzero marks, exact conservation, and the FCT
+        # p99 next to the drop-based rung's.
+        "incast_ecn": incast_ecn,
         # Checkpoint/resume (ISSUE 9): snapshot size + write wall,
         # restore wall and the wall saved by warm-starting past the
         # 10k rung's first half — recorded ONLY when the resumed run

@@ -127,6 +127,33 @@ constexpr int F_SYN = 0x02;
 constexpr int F_RST = 0x04;
 constexpr int F_PSH = 0x08;
 constexpr int F_ACK = 0x10;
+constexpr int F_ECE = 0x40;
+constexpr int F_CWR = 0x80;
+
+/* ECN / DCTCP (net/packet.py, tcp/connection.py, net/codel.py twins;
+ * registered fail-closed in analysis pass 1).  ECN_* are the IP-header
+ * codepoints PacketN.ecn carries; the DCTCP_* fixed-point family keeps
+ * the alpha EWMA bit-identical across Python/C++/JAX; MARK_* attribute
+ * every CE rewrite to exactly one threshold leg (mark-cause counters
+ * sum to CoDelN::marked). */
+constexpr int ECN_ECT0 = 2;
+constexpr int ECN_CE = 3;
+constexpr int64_t DCTCP_SHIFT = 10;
+constexpr int64_t DCTCP_G_SHIFT = 4;
+constexpr int64_t DCTCP_MAX_ALPHA = 1024;
+constexpr int64_t DCTCP_K_PKTS = 20;
+constexpr int64_t DCTCP_K_BYTES = 30000;
+constexpr int CC_RENO = 0;
+constexpr int CC_DCTCP = 1;
+enum { MARK_THRESH_PKTS = 0, MARK_THRESH_BYTES, MARK_N };
+
+/* Order mirrors the MARK_* enum (and trace/events.py MARK_NAMES).
+ * Consumed by analysis pass 1's string-table cross-check (text-level),
+ * not by engine code — hence maybe_unused. */
+[[maybe_unused]] static const char *MARK_NAMES[MARK_N] = {
+    "dctcp-k-pkts",
+    "dctcp-k-bytes",
+};
 
 /* connection.py states */
 enum {
@@ -170,7 +197,9 @@ enum { FR_ROUND = 0, FR_SPAN_START, FR_SPAN_COMMIT, FR_SPAN_ABORT,
  * overloads below), so the two directions cannot drift from each
  * other; cross-build drift is caught by the version gate. */
 constexpr uint32_t CK_PLANE_MAGIC = 0x53544350;  /* "STCP" */
-constexpr uint32_t CK_PLANE_VERSION = 1;
+/* v2: ECN/DCTCP — PacketN.ecn, TcpConn ECN+dctcp fields, per-host
+ * mark_causes and the tcp_cc/tcp_ecn config mirror entered the blob. */
+constexpr uint32_t CK_PLANE_VERSION = 2;
 constexpr int CK_PLANE_HDR_BYTES = 24;
 constexpr int CK_FRAME_HDR_BYTES = 12;
 constexpr uint32_t CK_GLOBAL_FRAME = 0xFFFFFFFFu;
@@ -426,6 +455,10 @@ struct PacketN {
   bool has_tcp = false;
   TcpHdrN tcp;
   int64_t priority = 0;
+  /* IP ECN codepoint (net/packet.py Packet.ecn twin): ECN_ECT0 on
+   * ECN-capable data segments, rewritten to ECN_CE by the marking
+   * law, 0 (not-ECT) otherwise. */
+  int32_t ecn = 0;
   uint32_t gen = 0;          // generation for stale-handle detection
   bool live = false;
 
@@ -486,6 +519,7 @@ struct PacketStore {
     if (p->payload.capacity() > 4096) p->payload.shrink_to_fit();
     p->has_tcp = false;
     p->tcp = TcpHdrN{};
+    p->ecn = 0;
     std::lock_guard<std::mutex> g(mu);
     free_list.push_back((uint32_t)id);
   }
@@ -622,14 +656,32 @@ struct TcpConn {
   int64_t persist_deadline = -1;
   int64_t persist_interval = 0;
 
-  /* reno (connection.py RenoCongestion inlined — the only in-tree
-   * algorithm, same as the twin's registry) */
+  /* reno (connection.py RenoCongestion inlined) / dctcp (connection.py
+   * DctcpCongestion twin) behind the cc switch — the same two
+   * algorithms as the twin's registry. */
+  int cc = CC_RENO;
   int cong_mss = MSS;
   int64_t cwnd = 10 * MSS;
   int64_t ssthresh = (1LL << 31) - 1;
   int dupacks = 0;
   bool in_fast_recovery = false;
   uint32_t recover;
+
+  /* ECN (RFC 3168; connection.py twins): ecn_on is the per-host
+   * config wish, ecn_active the handshake-negotiated result.  The
+   * receiver latches ece_latch on a CE arrival and echoes ECE until a
+   * CWR; the sender reacts to ECE at most once per window
+   * (ecn_cwr_end) and announces the cut with CWR on its next fresh
+   * data segment (cwr_pending).  DCTCP alpha is fixed-point scaled by
+   * 2**DCTCP_SHIFT so Python/C++/JAX agree bit-for-bit. */
+  bool ecn_on = false;
+  bool ecn_active = false;
+  bool ece_latch = false;
+  bool cwr_pending = false;
+  uint32_t ecn_cwr_end;
+  int64_t dctcp_alpha = DCTCP_MAX_ALPHA;
+  int64_t dctcp_ce = 0, dctcp_tot = 0;
+  uint32_t dctcp_wend;
 
   /* RTT via RFC 7323 timestamps (connection.py twin): every acked
    * segment samples, suppressed during RTO backoff (Karn). */
@@ -669,13 +721,25 @@ struct TcpConn {
             window_ceiling >= 0 ? window_ceiling : recv_max)),
         snd_una(iss_), snd_nxt(iss_),
         send_buf_max(send_max), recv_buf_max(recv_max),
-        recover(iss_) {}
+        recover(iss_), ecn_cwr_end(iss_), dctcp_wend(iss_) {}
+
+  /* Per-host `tcp:` config applied at conn birth (socket_tcp.py
+   * passes congestion=/ecn= into TcpConnection at the same points). */
+  void set_tcp_opts(int cc_, bool ecn) {
+    cc = cc_;
+    ecn_on = ecn;
+  }
 
   /* -- reno ops -- */
   void cong_reinit(int mss) {
     cong_mss = mss;
     cwnd = 10LL * mss;
     ssthresh = (1LL << 31) - 1;
+    /* connection.py rebuilds the whole cc object at negotiation:
+     * dctcp state restarts with it (nothing acked yet). */
+    dctcp_alpha = DCTCP_MAX_ALPHA;
+    dctcp_ce = dctcp_tot = 0;
+    dctcp_wend = iss;
   }
   void cong_on_new_ack(int64_t acked) {
     if (cwnd < ssthresh) cwnd += std::min(acked, (int64_t)2 * cong_mss);
@@ -695,7 +759,9 @@ struct TcpConn {
   /* -- app-side API -- */
   void open_active(int64_t now) {
     state = ST_SYN_SENT;
-    emit(F_SYN, iss, "", now, /*track=*/true, /*is_fin=*/false, MSS,
+    int flags = F_SYN;
+    if (ecn_on) flags |= F_ECE | F_CWR;  /* ECN-setup SYN (RFC 3168) */
+    emit(flags, iss, "", now, /*track=*/true, /*is_fin=*/false, MSS,
          wscale_offer);
     snd_nxt = seq_add(iss, 1);
   }
@@ -780,11 +846,23 @@ struct TcpConn {
     if (rto_deadline >= 0 && now >= rto_deadline) on_rto(now);
   }
 
+  /* Flags for a FRESH data segment: ACK|PSH plus the one-shot CWR
+   * announcing a pending ECN window cut (connection.py _data_flags
+   * twin — never on retransmissions). */
+  int data_flags() {
+    int flags = F_ACK | F_PSH;
+    if (ecn_active && cwr_pending) {
+      flags |= F_CWR;
+      cwr_pending = false;
+    }
+    return flags;
+  }
+
   void on_persist(int64_t now) {
     persist_deadline = -1;
     if (snd_wnd > 0 || send_buf.len == 0 || !rtx.empty()) return;
     std::string chunk = send_buf.take(1);
-    emit(F_ACK | F_PSH, snd_nxt, chunk, now, /*track=*/true);
+    emit(data_flags(), snd_nxt, chunk, now, /*track=*/true);
     snd_nxt = seq_add(snd_nxt, 1);
     fct_touch(1, now, /*inbound=*/false);
     persist_interval = std::min(
@@ -818,10 +896,16 @@ struct TcpConn {
 
   /* -- packet ingress -- */
   void on_packet(const TcpHdrN &hdr, const std::string &payload,
-                 int64_t now) {
+                 int64_t now, int ecn = 0) {
     segments_received++;
     if (state == ST_CLOSED) return;
     if (hdr.flags & F_RST) { on_rst(); return; }
+    /* RFC 3168 receiver: CWR ends the echo episode, a CE-marked
+     * arrival (re)starts it — in that order (connection.py twin). */
+    if (ecn_active) {
+      if (hdr.flags & F_CWR) ece_latch = false;
+      if (ecn == ECN_CE) ece_latch = true;
+    }
     /* RFC 7323 timestamp processing on EVERY segment (ref
      * tcp.c:2356-2358 + the RFC's TS.Recent update rule: only a
      * segment covering the last ack point may update the echo value,
@@ -877,6 +961,8 @@ struct TcpConn {
     rcv_nxt = seq_add(hdr.seq, 1);
     if (hdr.ts_val) ts_recent = hdr.ts_val;  // echo in the SYN-ACK
     snd_wnd = hdr.window;
+    /* ECN-setup SYN (RFC 3168 6.1.1): accept iff we want ECN too. */
+    ecn_active = ecn_on && (hdr.flags & (F_ECE | F_CWR)) == (F_ECE | F_CWR);
     negotiate_options(hdr);
     state = ST_SYN_RECEIVED;
     emit_synack(now);
@@ -895,7 +981,9 @@ struct TcpConn {
   }
 
   void emit_synack(int64_t now) {
-    emit(F_SYN | F_ACK, iss, "", now, /*track=*/(snd_nxt == iss),
+    int flags = F_SYN | F_ACK;
+    if (ecn_active) flags |= F_ECE;  /* ECN-setup SYN-ACK */
+    emit(flags, iss, "", now, /*track=*/(snd_nxt == iss),
          /*is_fin=*/false, MSS, our_wscale ? wscale_offer : -1);
   }
 
@@ -914,6 +1002,8 @@ struct TcpConn {
       if (hdr.ts_val) ts_recent = hdr.ts_val;
       snd_una = hdr.ack;
       snd_wnd = hdr.window;
+      /* ECN-setup SYN-ACK carries ECE without CWR (RFC 3168 6.1.1). */
+      ecn_active = ecn_on && (hdr.flags & F_ECE) && !(hdr.flags & F_CWR);
       negotiate_options(hdr);
       clear_acked();
       state = ST_ESTABLISHED;
@@ -951,8 +1041,43 @@ struct TcpConn {
       persist_interval = 0;
     }
     if (hdr.n_sacks) mark_sacked(hdr);
+    /* ECN sender side (RFC 3168 6.1.2 + RFC 8257 3.3), BEFORE the
+     * new-ack/dupack dispatch so snd_una still holds the pre-ack
+     * value (connection.py _on_ack twin — the exact same sequence, so
+     * the fixed-point arithmetic is bit-identical on every path). */
+    bool ecn_reduced = false;
+    if (ecn_active) {
+      bool ece = (hdr.flags & F_ECE) != 0;
+      if (cc == CC_DCTCP && seq_lt(snd_una, ack)) {
+        int64_t acked = seq_sub(ack, snd_una);
+        dctcp_tot += acked;
+        if (ece) dctcp_ce += acked;
+        if (seq_lt(dctcp_wend, ack)) {
+          dctcp_alpha = std::min(
+              DCTCP_MAX_ALPHA,
+              dctcp_alpha - (dctcp_alpha >> DCTCP_G_SHIFT) +
+                  (dctcp_ce << (DCTCP_SHIFT - DCTCP_G_SHIFT)) /
+                      std::max(dctcp_tot, (int64_t)1));
+          dctcp_ce = dctcp_tot = 0;
+          dctcp_wend = snd_nxt;
+        }
+      }
+      if (ece && !in_fast_recovery && seq_lt(ecn_cwr_end, ack)) {
+        if (cc == CC_DCTCP) {
+          cwnd = std::max(cwnd - ((cwnd * dctcp_alpha) >> (DCTCP_SHIFT + 1)),
+                          (int64_t)2 * cong_mss);
+          ssthresh = cwnd;
+        } else {
+          ssthresh = std::max(flight() / 2, (int64_t)2 * cong_mss);
+          cwnd = ssthresh;
+        }
+        ecn_cwr_end = snd_nxt;
+        cwr_pending = true;
+        ecn_reduced = true;
+      }
+    }
     if (seq_lt(snd_una, ack)) {
-      handle_new_ack(ack, now);
+      handle_new_ack(ack, now, ecn_reduced);
     } else if (ack == snd_una && !rtx.empty() && is_pure_ack &&
                !window_changed) {
       handle_dupack(now);
@@ -962,7 +1087,8 @@ struct TcpConn {
     push_data(now);
   }
 
-  void handle_new_ack(uint32_t ack, int64_t now) {
+  void handle_new_ack(uint32_t ack, int64_t now,
+                      bool ecn_reduced = false) {
     int64_t acked = seq_sub(ack, snd_una);
     snd_una = ack;
     dupacks = 0;
@@ -979,7 +1105,8 @@ struct TcpConn {
       } else {
         retransmit_one(now);
       }
-    } else {
+    } else if (!ecn_reduced) {
+      /* the ack that triggered the ECN cut must not also grow cwnd */
       cong_on_new_ack(acked);
     }
     rto_deadline = rtx.empty() ? -1 : now + rto;
@@ -1216,7 +1343,7 @@ struct TcpConn {
       std::string chunk = send_buf.take(budget);
       if (chunk.empty()) break;
       int64_t n = (int64_t)chunk.size();
-      emit(F_ACK | F_PSH, snd_nxt, chunk, now, /*track=*/true);
+      emit(data_flags(), snd_nxt, chunk, now, /*track=*/true);
       snd_nxt = seq_add(snd_nxt, n);
       fct_touch(n, now, /*inbound=*/false);
     }
@@ -1249,16 +1376,21 @@ struct TcpConn {
     if (is_fin) {
       flags |= F_FIN;
     } else if (payload.empty() && seq == iss) {
+      /* retransmitted SYN/SYN-ACK re-carries the ECN-setup flags */
       flags = F_SYN;
       mss_opt = MSS;
       ws_opt = wscale_offer;
+      if (ecn_on) flags |= F_ECE | F_CWR;
       if (state == ST_SYN_RECEIVED) {
         flags = F_SYN | F_ACK;
+        if (ecn_active) flags |= F_ECE;
         ws_opt = our_wscale ? wscale_offer : -1;
       }
     } else if (!payload.empty()) {
       flags |= F_PSH;
     }
+    if (ece_latch && !(flags & F_SYN))
+      flags |= F_ECE;  /* echo until CWR (RFC 3168 6.1.3) */
     OutSeg seg;
     seg.hdr.seq = seq;
     seg.hdr.ack = rcv_nxt;
@@ -1281,6 +1413,8 @@ struct TcpConn {
   void emit(int flags, uint32_t seq, const std::string &payload, int64_t now,
             bool track = false, bool is_fin = false, int mss_opt = -1,
             int ws_opt = -1) {
+    if (ece_latch && !(flags & F_SYN))
+      flags |= F_ECE;  /* echo until CWR (RFC 3168 6.1.3) */
     OutSeg seg;
     seg.hdr.seq = seq;
     seg.hdr.ack = (flags & F_ACK) ? rcv_nxt : 0;
@@ -1315,7 +1449,7 @@ struct TcpConn {
     OutSeg seg;
     seg.hdr.seq = snd_nxt;
     seg.hdr.ack = rcv_nxt;
-    seg.hdr.flags = F_ACK;
+    seg.hdr.flags = F_ACK | (ece_latch ? F_ECE : 0);
     seg.hdr.window = wire_window(F_ACK);
     sack_blocks(seg.hdr);
     seg.hdr.ts_val = now + 1;
@@ -1381,22 +1515,39 @@ struct CoDelN {
   /* Fabric-observatory counters (net/codel.py twins; conservation:
    * enqueued == forwarded + dropped + still-queued, packets AND
    * bytes).  `enqueued` counts push ATTEMPTS — hard-limit refusals
-   * included, with the refusal on the dropped side.  `marked` is the
-   * ECN-ready slot: 0 on every path until DCTCP lands. */
+   * included, with the refusal on the dropped side.  `marked` counts
+   * CE rewrites by the DCTCP-K threshold law in push(); a marked
+   * packet still forwards, so it sits on the delivered side. */
   int64_t enq_pkts = 0, enq_bytes = 0, drop_bytes = 0, peak_depth = 0,
           marked = 0;
 
   static int64_t control_time(int64_t t, int64_t count) {
     return t + ((CODEL_INTERVAL_NS << 16) / isqrt64(count << 32));
   }
-  /* push returns false only at the hard limit (caller drops+traces) */
-  bool push(uint64_t id, int64_t size, int64_t now) {
+  /* push returns false only at the hard limit (caller drops+traces).
+   * An ECT(0) packet that clears the hard limit but meets the DCTCP-K
+   * instantaneous threshold — checked against the queue state BEFORE
+   * this packet enqueues, packets leg first — is rewritten to CE and
+   * enqueued normally; the caller's mark_causes gets the leg
+   * (net/codel.py push twin). */
+  bool push(uint64_t id, PacketN *p, int64_t now, int64_t *mark_causes) {
+    int64_t size = p->total_size();
     enq_pkts++;
     enq_bytes += size;
     if (q.size() >= CODEL_HARD_LIMIT) {
       dropped_count++;
       drop_bytes += size;
       return false;
+    }
+    if (p->ecn == ECN_ECT0) {
+      int cause = -1;
+      if ((int64_t)q.size() >= DCTCP_K_PKTS) cause = MARK_THRESH_PKTS;
+      else if (bytes >= DCTCP_K_BYTES) cause = MARK_THRESH_BYTES;
+      if (cause >= 0) {
+        p->ecn = ECN_CE;
+        marked++;
+        mark_causes[cause]++;
+      }
     }
     q.emplace_back(id, now);
     bytes += size;
@@ -1646,6 +1797,14 @@ struct HostPlane {
    * with no tel_cause_of mapping; the conservation gate rejects it. */
   int64_t drop_causes[TEL_N] = {0};
   int64_t drop_unattributed = 0;
+  /* ECN mark attribution (Host.mark_causes twin): one MARK_* cause
+   * per CE rewrite by this host's router queue; sums to
+   * codel.marked. */
+  int64_t mark_causes[MARK_N] = {0};
+  /* Per-host `tcp:` config (set_host_tcp): applied to every TcpConn
+   * born on this host. */
+  int tcp_cc = CC_RENO;
+  bool tcp_ecn = false;
   /* Fabric-observatory flow lifecycle (Host.fct_log twin): FctRec
    * rows of connections torn down before the artifact was written.
    * Host-serial appends (teardown runs inside this host's events), so
@@ -1845,6 +2004,7 @@ template <class Ar> void ck_visit(Ar &a, PacketN &p) {
   a.num(p.has_tcp);
   ck_visit(a, p.tcp);
   a.num(p.priority);
+  a.num(p.ecn);
 }
 
 template <class Ar> void ck_visit(Ar &a, TokenBucketN &b) {
@@ -1934,6 +2094,10 @@ template <class Ar> void ck_visit(Ar &a, TcpConn &c) {
   a.num(c.reasm_discards); a.num(c.rcvwin_trunc);
   a.num(c.fct_first); a.num(c.fct_last);
   a.num(c.fct_bytes_in); a.num(c.fct_bytes_out);
+  a.num(c.cc); a.num(c.ecn_on); a.num(c.ecn_active);
+  a.num(c.ece_latch); a.num(c.cwr_pending); a.num(c.ecn_cwr_end);
+  a.num(c.dctcp_alpha); a.num(c.dctcp_ce); a.num(c.dctcp_tot);
+  a.num(c.dctcp_wend);
 }
 
 template <class Ar> void ck_visit(Ar &a, AppN &ap) {
@@ -2552,7 +2716,7 @@ struct Engine {
       store.free_pkt(id);
       return;
     }
-    if (!hp->codel.push(id, p->total_size(), now)) {
+    if (!hp->codel.push(id, p, now, hp->mark_causes)) {
       trace_drop(hp, p, "rtr-limit", now);
       store.free_pkt(id);
       return;
@@ -2633,7 +2797,7 @@ struct Engine {
              * exact (the packet never entered any queue). */
             trace_drop(hp, p, hp->down ? "host-down" : "link-down", et);
             store.free_pkt(i.pkt);
-          } else if (!hp->codel.push(i.pkt, p->total_size(), et)) {
+          } else if (!hp->codel.push(i.pkt, p, et, hp->mark_causes)) {
             trace_drop(hp, p, "rtr-limit", et);
             store.free_pkt(i.pkt);
           } else {
@@ -4146,6 +4310,8 @@ struct Engine {
     for (int i = 0; i < ASYS_N; i++) a.num(hp->app_sys[i]);
     for (int i = 0; i < TEL_N; i++) a.num(hp->drop_causes[i]);
     a.num(hp->drop_unattributed);
+    for (int i = 0; i < MARK_N; i++) a.num(hp->mark_causes[i]);
+    a.num(hp->tcp_cc); a.num(hp->tcp_ecn);
 
     /* sockets (ascending token order) */
     if constexpr (Ar::loading) {
@@ -5075,6 +5241,10 @@ struct Engine {
       p->payload = std::move(seg.payload);
       p->has_tcp = true;
       p->tcp = seg.hdr;
+      /* ECN-capable transport: data segments carry ECT(0) so a
+       * congested queue can mark instead of drop (socket_tcp._flush
+       * twin rule: ecn_active AND payload). */
+      p->ecn = (c->ecn_active && !p->payload.empty()) ? ECN_ECT0 : 0;
       p->priority = (int64_t)pseq;
       s->out_packets[s->iface].push_back(id);
       emitted = true;
@@ -5256,7 +5426,7 @@ struct Engine {
       return false;
     }
     int64_t reasm0 = c->reasm_discards, trunc0 = c->rcvwin_trunc;
-    c->on_packet(p->tcp, p->payload, now);
+    c->on_packet(p->tcp, p->payload, now, p->ecn);
     hp->drop_causes[TEL_REASM_FULL] += c->reasm_discards - reasm0;
     hp->drop_causes[TEL_RECVWIN_TRUNC] += c->rcvwin_trunc - trunc0;
     if (s->send_autotune && c->srtt > 0) autotune_send(hp, s);
@@ -5309,6 +5479,7 @@ struct Engine {
     child->conn = std::make_unique<TcpConn>(
         iss, s->recv_buf_max, s->send_buf_max,
         s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
+    child->conn->set_tcp_opts(hp->tcp_cc, hp->tcp_ecn);
     if (dbg_port >= 0 && dbg_port == child->local_port)
       child->conn->dbg = true;
     child->conn->nodelay = s->nodelay;
@@ -5479,6 +5650,7 @@ struct Engine {
     s->conn = std::make_unique<TcpConn>(
         iss, s->recv_buf_max, s->send_buf_max,
         s->recv_autotune ? RMEM_CEILING : (int64_t)-1);
+    s->conn->set_tcp_opts(hp->tcp_cc, hp->tcp_ecn);
     if (dbg_port >= 0 && dbg_port == s->local_port) s->conn->dbg = true;
     s->conn->nodelay = s->nodelay;
     s->conn->open_active(now);
@@ -5970,7 +6142,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   std::vector<int64_t> codel_bytes(H), codel_count(H),
       codel_last_count(H), codel_first_above(H), codel_drop_next(H),
       codel_dropped(H), codel_enq_pkts(H), codel_enq_bytes(H),
-      codel_drop_bytes(H), codel_peak(H);
+      codel_drop_bytes(H), codel_peak(H), codel_marked(H);
   std::vector<uint8_t> codel_dropping(H);
   std::vector<uint8_t> r_pending[3], r_unlimited[3], r_pk_valid[3];
   std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3],
@@ -6054,6 +6226,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     codel_enq_bytes[h] = hp->codel.enq_bytes;
     codel_drop_bytes[h] = hp->codel.drop_bytes;
     codel_peak[h] = hp->codel.peak_depth;
+    codel_marked[h] = hp->codel.marked;
     for (int r = 1; r <= 2; r++) {
       RelayN &rl = hp->relays[r];
       r_pending[r][h] = rl.state == RELAY_PENDING ? 1 : 0;
@@ -6191,6 +6364,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   put("codel_enq_bytes", bytes_vec(codel_enq_bytes));
   put("codel_drop_bytes", bytes_vec(codel_drop_bytes));
   put("codel_peak", bytes_vec(codel_peak));
+  put("codel_marked", bytes_vec(codel_marked));
   for (int r = 1; r <= 2; r++) {
     std::string p = r == 1 ? "r1" : "r2";
     put((p + "_pending").c_str(), bytes_vec(r_pending[r]));
@@ -6349,6 +6523,8 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
   const int64_t *codel_drop_bytes =
       col<int64_t>(d, "codel_drop_bytes", H, &ok);
   const int64_t *codel_peak = col<int64_t>(d, "codel_peak", H, &ok);
+  const int64_t *codel_marked =
+      col<int64_t>(d, "codel_marked", H, &ok);
   const uint8_t *r_pending[3] = {nullptr, nullptr, nullptr};
   const uint8_t *r_pk_valid[3] = {nullptr, nullptr, nullptr};
   const int64_t *r_bal[3], *r_next[3], *r_stalls[3], *r_fwd_pkts[3],
@@ -6484,6 +6660,7 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
     hp->codel.enq_bytes = codel_enq_bytes[h];
     hp->codel.drop_bytes = codel_drop_bytes[h];
     hp->codel.peak_depth = codel_peak[h];
+    hp->codel.marked = codel_marked[h];
     for (int r = 1; r <= 2; r++) {
       RelayN &rl = hp->relays[r];
       rl.state = r_pending[r][h] ? RELAY_PENDING : RELAY_IDLE;
@@ -6661,7 +6838,7 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
  * state machine interprets.  Payloads are uniform 'D' bytes in the
  * modelled domain, so plen reconstructs contents. */
 struct TPkCols {
-  std::vector<int32_t> srchost, sport, dport, tflags, plen, nsk;
+  std::vector<int32_t> srchost, sport, dport, tflags, plen, nsk, ecn;
   std::vector<int64_t> pseq, twin, tsv, tse;
   std::vector<uint32_t> sip, dip, tseq, tack;
   std::vector<uint32_t> sk[6];  // sack block starts/ends, 3 pairs
@@ -6681,6 +6858,7 @@ struct TPkCols {
     tse.push_back(p->tcp.ts_ecr);
     plen.push_back((int32_t)p->payload.size());
     nsk.push_back(p->tcp.n_sacks);
+    ecn.push_back(p->ecn);
     for (int i = 0; i < 3; i++) {
       sk[2 * i].push_back(i < p->tcp.n_sacks ? p->tcp.sacks[i].start : 0);
       sk[2 * i + 1].push_back(i < p->tcp.n_sacks ? p->tcp.sacks[i].end
@@ -6702,6 +6880,7 @@ struct TPkCols {
     tse.push_back(0);
     plen.push_back(0);
     nsk.push_back(0);
+    ecn.push_back(0);
     for (int i = 0; i < 6; i++) sk[i].push_back(0);
   }
   void pad(size_t upto) {
@@ -6732,13 +6911,14 @@ static void put_tpk(PyObject *d, const char *prefix, TPkCols &c,
   put(p + "_tse", bytes_vec(c.tse));
   put(p + "_plen", bytes_vec(c.plen));
   put(p + "_nsk", bytes_vec(c.nsk));
+  put(p + "_ecn", bytes_vec(c.ecn));
   for (int i = 0; i < 6; i++)
     put(p + "_" + TPK_SK[i], bytes_vec(c.sk[i]));
 }
 
 /* Typed reader for import (mirrors put_tpk). */
 struct TPkIn {
-  const int32_t *srchost, *sport, *dport, *tflags, *plen, *nsk;
+  const int32_t *srchost, *sport, *dport, *tflags, *plen, *nsk, *ecn;
   const int64_t *pseq, *twin, *tsv, *tse;
   const uint32_t *sip, *dip, *tseq, *tack;
   const uint32_t *sk[6];
@@ -6762,6 +6942,7 @@ static TPkIn get_tpk(PyObject *d, const char *prefix, size_t n,
   c.tse = col<int64_t>(d, (p + "_tse").c_str(), n, ok);
   c.plen = col<int32_t>(d, (p + "_plen").c_str(), n, ok);
   c.nsk = col<int32_t>(d, (p + "_nsk").c_str(), n, ok);
+  c.ecn = col<int32_t>(d, (p + "_ecn").c_str(), n, ok);
   for (int i = 0; i < 6; i++)
     c.sk[i] = col<uint32_t>(d, (p + "_" + TPK_SK[i]).c_str(), n, ok);
   return c;
@@ -6832,7 +7013,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   std::vector<int64_t> codel_bytes(H), codel_count(H),
       codel_last_count(H), codel_first_above(H), codel_drop_next(H),
       codel_dropped(H), codel_enq_pkts(H), codel_enq_bytes(H),
-      codel_drop_bytes(H), codel_peak(H);
+      codel_drop_bytes(H), codel_peak(H), codel_marked(H);
   std::vector<uint8_t> codel_dropping(H);
   std::vector<uint8_t> r_pending[3], r_unlimited[3], r_pk_valid[3];
   std::vector<int64_t> r_bal[3], r_next[3], r_refill[3], r_cap[3],
@@ -6852,6 +7033,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   std::vector<int64_t> app_sys(H * ASYS_N), pkts_sent(H), pkts_recv(H),
       pkts_dropped(H), events_run(H);
   std::vector<int64_t> drop_causes(H * (size_t)TEL_N);
+  std::vector<int64_t> mark_causes(H * (size_t)MARK_N);
   std::vector<int64_t> eth_psent(H), eth_precv(H), eth_bsent(H),
       eth_brecv(H);
 
@@ -6883,6 +7065,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     codel_enq_bytes[h] = hp->codel.enq_bytes;
     codel_drop_bytes[h] = hp->codel.drop_bytes;
     codel_peak[h] = hp->codel.peak_depth;
+    codel_marked[h] = hp->codel.marked;
     for (int ri = 1; ri <= 2; ri++) {
       RelayN &rl = hp->relays[ri];
       r_pending[ri][h] = rl.state == RELAY_PENDING ? 1 : 0;
@@ -6942,6 +7125,8 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     pkts_dropped[h] = hp->pkts_dropped;
     for (int j = 0; j < TEL_N; j++)
       drop_causes[h * (size_t)TEL_N + j] = hp->drop_causes[j];
+    for (int j = 0; j < MARK_N; j++)
+      mark_causes[h * (size_t)MARK_N + j] = hp->mark_causes[j];
     events_run[h] = hp->events_run;
     eth_psent[h] = hp->eth.packets_sent;
     eth_precv[h] = hp->eth.packets_received;
@@ -6969,6 +7154,10 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
       c_atlast(CC, 0), c_awaitseq(CC, 0), c_agot(CC, 0),
       c_atotal(CC, 0), c_fbyte(CC, -1), c_lbyte(CC, -1),
       c_bin(CC, 0), c_bout(CC, 0);
+  std::vector<uint8_t> c_ecnact(CC, 0), c_ece(CC, 0), c_cwrp(CC, 0);
+  std::vector<int32_t> c_cc(CC, 0);
+  std::vector<uint32_t> c_cwrend(CC, 0), c_dwend(CC, 0);
+  std::vector<int64_t> c_alpha(CC, 0), c_ceack(CC, 0), c_totack(CC, 0);
   std::vector<int32_t> rtx_len(CC, 0), ra_len(CC, 0), op_len(CC, 0);
   std::vector<uint32_t> rtx_seq(CC * (size_t)RT, 0),
       ra_seq(CC * (size_t)RA, 0);
@@ -7028,6 +7217,15 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     c_lbyte[j] = c->fct_last;
     c_bin[j] = c->fct_bytes_in;
     c_bout[j] = c->fct_bytes_out;
+    c_ecnact[j] = c->ecn_active ? 1 : 0;
+    c_cc[j] = c->cc;
+    c_ece[j] = c->ece_latch ? 1 : 0;
+    c_cwrp[j] = c->cwr_pending ? 1 : 0;
+    c_cwrend[j] = c->ecn_cwr_end;
+    c_alpha[j] = c->dctcp_alpha;
+    c_ceack[j] = c->dctcp_ce;
+    c_totack[j] = c->dctcp_tot;
+    c_dwend[j] = c->dctcp_wend;
     c_tmrdl[j] = s->timer_deadline;
     c_status[j] = s->status;
     c_queued[j] = s->queued[1] ? 1 : 0;
@@ -7104,6 +7302,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("codel_enq_bytes", bytes_vec(codel_enq_bytes));
   put("codel_drop_bytes", bytes_vec(codel_drop_bytes));
   put("codel_peak", bytes_vec(codel_peak));
+  put("codel_marked", bytes_vec(codel_marked));
   for (int ri = 1; ri <= 2; ri++) {
     std::string p = ri == 1 ? "r1" : "r2";
     put((p + "_pending").c_str(), bytes_vec(r_pending[ri]));
@@ -7133,6 +7332,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("pkts_recv", bytes_vec(pkts_recv));
   put("pkts_dropped", bytes_vec(pkts_dropped));
   put("drop_causes", bytes_vec(drop_causes));
+  put("mark_causes", bytes_vec(mark_causes));
   put("events_run", bytes_vec(events_run));
   put("eth_psent", bytes_vec(eth_psent));
   put("eth_precv", bytes_vec(eth_precv));
@@ -7196,6 +7396,15 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("c_lbyte", bytes_vec(c_lbyte));
   put("c_bin", bytes_vec(c_bin));
   put("c_bout", bytes_vec(c_bout));
+  put("c_ecnact", bytes_vec(c_ecnact));
+  put("c_cc", bytes_vec(c_cc));
+  put("c_ece", bytes_vec(c_ece));
+  put("c_cwrp", bytes_vec(c_cwrp));
+  put("c_cwrend", bytes_vec(c_cwrend));
+  put("c_alpha", bytes_vec(c_alpha));
+  put("c_ceack", bytes_vec(c_ceack));
+  put("c_totack", bytes_vec(c_totack));
+  put("c_dwend", bytes_vec(c_dwend));
   put("rtx_len", bytes_vec(rtx_len));
   put("rtx_seq", bytes_vec(rtx_seq));
   put("rtx_plen", bytes_vec(rtx_plen));
@@ -7267,6 +7476,8 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
   const int64_t *codel_drop_bytes =
       col<int64_t>(d, "codel_drop_bytes", H, &ok);
   const int64_t *codel_peak = col<int64_t>(d, "codel_peak", H, &ok);
+  const int64_t *codel_marked =
+      col<int64_t>(d, "codel_marked", H, &ok);
   const uint8_t *r_pending[3] = {nullptr, nullptr, nullptr};
   const uint8_t *r_pk_valid[3] = {nullptr, nullptr, nullptr};
   const int64_t *r_bal[3], *r_next[3], *r_stalls[3], *r_fwd_pkts[3],
@@ -7296,6 +7507,8 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
   const int64_t *pkts_dropped = col<int64_t>(d, "pkts_dropped", H, &ok);
   const int64_t *drop_causes =
       col<int64_t>(d, "drop_causes", H * (size_t)TEL_N, &ok);
+  const int64_t *mark_causes =
+      col<int64_t>(d, "mark_causes", H * (size_t)MARK_N, &ok);
   const int64_t *events_run = col<int64_t>(d, "events_run", H, &ok);
   const int64_t *eth_psent = col<int64_t>(d, "eth_psent", H, &ok);
   const int64_t *eth_precv = col<int64_t>(d, "eth_precv", H, &ok);
@@ -7342,6 +7555,13 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
   const int64_t *c_lbyte = col<int64_t>(d, "c_lbyte", CC, &ok);
   const int64_t *c_bin = col<int64_t>(d, "c_bin", CC, &ok);
   const int64_t *c_bout = col<int64_t>(d, "c_bout", CC, &ok);
+  const uint8_t *c_ece = col<uint8_t>(d, "c_ece", CC, &ok);
+  const uint8_t *c_cwrp = col<uint8_t>(d, "c_cwrp", CC, &ok);
+  const uint32_t *c_cwrend = col<uint32_t>(d, "c_cwrend", CC, &ok);
+  const int64_t *c_alpha = col<int64_t>(d, "c_alpha", CC, &ok);
+  const int64_t *c_ceack = col<int64_t>(d, "c_ceack", CC, &ok);
+  const int64_t *c_totack = col<int64_t>(d, "c_totack", CC, &ok);
+  const uint32_t *c_dwend = col<uint32_t>(d, "c_dwend", CC, &ok);
   const int32_t *rtx_len = col<int32_t>(d, "rtx_len", CC, &ok);
   const uint32_t *rtx_seq =
       col<uint32_t>(d, "rtx_seq", CC * (size_t)RT, &ok);
@@ -7400,6 +7620,7 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
       p->tcp.sacks[i].start = c.sk[2 * i][j];
       p->tcp.sacks[i].end = c.sk[2 * i + 1][j];
     }
+    p->ecn = c.ecn[j];
     p->priority = c.pseq[j];
     return id;
   };
@@ -7436,6 +7657,7 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
     hp->codel.enq_bytes = codel_enq_bytes[h];
     hp->codel.drop_bytes = codel_drop_bytes[h];
     hp->codel.peak_depth = codel_peak[h];
+    hp->codel.marked = codel_marked[h];
     for (int ri = 1; ri <= 2; ri++) {
       RelayN &rl = hp->relays[ri];
       rl.state = r_pending[ri][h] ? RELAY_PENDING : RELAY_IDLE;
@@ -7472,6 +7694,8 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
     hp->pkts_dropped = pkts_dropped[h];
     for (int j = 0; j < TEL_N; j++)
       hp->drop_causes[j] = drop_causes[h * (size_t)TEL_N + j];
+    for (int j = 0; j < MARK_N; j++)
+      hp->mark_causes[j] = mark_causes[h * (size_t)MARK_N + j];
     hp->events_run = events_run[h];
     hp->eth.packets_sent = eth_psent[h];
     hp->eth.packets_received = eth_precv[h];
@@ -7527,6 +7751,13 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
     c->fct_last = c_lbyte[j];
     c->fct_bytes_in = c_bin[j];
     c->fct_bytes_out = c_bout[j];
+    c->ece_latch = c_ece[j] != 0;
+    c->cwr_pending = c_cwrp[j] != 0;
+    c->ecn_cwr_end = c_cwrend[j];
+    c->dctcp_alpha = c_alpha[j];
+    c->dctcp_ce = c_ceack[j];
+    c->dctcp_tot = c_totack[j];
+    c->dctcp_wend = c_dwend[j];
     c->rtx.clear();
     for (int32_t k = 0; k < rtx_len[j]; k++) {
       size_t kk = j * (size_t)RT + (size_t)k;
@@ -8439,24 +8670,24 @@ static PyObject *eng_packet_fields(EngineObj *self, PyObject *args) {
     tcp = Py_None;
     Py_INCREF(tcp);
   }
-  return Py_BuildValue("iKiIiIiy#N", p->src_host,
+  return Py_BuildValue("iKiIiIiy#iN", p->src_host,
                        (unsigned long long)p->seq, p->proto,
                        (unsigned int)p->src_ip, p->src_port,
                        (unsigned int)p->dst_ip, p->dst_port,
                        p->payload.data(), (Py_ssize_t)p->payload.size(),
-                       tcp);
+                       (int)p->ecn, tcp);
 }
 
 static PyObject *eng_intern_packet(EngineObj *self, PyObject *args) {
   self->eng->state_epoch++;
-  int src_host, proto, src_port, dst_port;
+  int src_host, proto, src_port, dst_port, ecn;
   unsigned long long seq;
   unsigned int src_ip, dst_ip;
   Py_buffer payload;
   PyObject *tcp;
-  if (!PyArg_ParseTuple(args, "iKiIiIiy*O", &src_host, &seq, &proto,
+  if (!PyArg_ParseTuple(args, "iKiIiIiy*iO", &src_host, &seq, &proto,
                         &src_ip, &src_port, &dst_ip, &dst_port, &payload,
-                        &tcp))
+                        &ecn, &tcp))
     return nullptr;
   Engine *e = self->eng;
   uint64_t id = e->store.alloc();
@@ -8470,6 +8701,7 @@ static PyObject *eng_intern_packet(EngineObj *self, PyObject *args) {
   p->dst_port = dst_port;
   p->payload.assign((const char *)payload.buf, (size_t)payload.len);
   PyBuffer_Release(&payload);
+  p->ecn = ecn;  /* ECT/CE survives the cross-plane seam */
   if (tcp != Py_None) {
     p->has_tcp = true;
     long long window, ts_val, ts_ecr;
@@ -8873,6 +9105,31 @@ static PyObject *eng_host_import(EngineObj *self, PyObject *args) {
   return d;
 }
 
+static PyObject *eng_mark_causes(EngineObj *self, PyObject *args) {
+  /* Per-host ECN mark-cause counters -> MARK_N-tuple
+   * (Host.merge_native_counters folds the deltas; MARK_NAMES indexes
+   * the table the reports render). */
+  int hid;
+  if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  PyObject *t = PyTuple_New(MARK_N);
+  if (!t) return nullptr;
+  for (int i = 0; i < MARK_N; i++)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(hp->mark_causes[i]));
+  return t;
+}
+
+static PyObject *eng_set_host_tcp(EngineObj *self, PyObject *args) {
+  /* (hid, cc, ecn): the per-host `tcp:` config block — every TcpConn
+   * born on this host inherits it (native/plane.py add_host). */
+  int hid, cc, ecn;
+  if (!PyArg_ParseTuple(args, "iii", &hid, &cc, &ecn)) return nullptr;
+  HostPlane *hp = self->eng->plane(hid);
+  hp->tcp_cc = cc == CC_DCTCP ? CC_DCTCP : CC_RENO;
+  hp->tcp_ecn = ecn != 0;
+  Py_RETURN_NONE;
+}
+
 static PyObject *eng_drop_causes(EngineObj *self, PyObject *args) {
   /* Per-host drop-cause counters -> TEL_N-tuple + unattributed tail
    * (Host.merge_native_counters folds the deltas). */
@@ -9033,6 +9290,9 @@ static PyMethodDef eng_methods[] = {
     {"netstat_totals", (PyCFunction)eng_netstat_totals, METH_NOARGS,
      nullptr},
     {"drop_causes", (PyCFunction)eng_drop_causes, METH_VARARGS, nullptr},
+    {"mark_causes", (PyCFunction)eng_mark_causes, METH_VARARGS, nullptr},
+    {"set_host_tcp", (PyCFunction)eng_set_host_tcp, METH_VARARGS,
+     nullptr},
     {"set_host_fault", (PyCFunction)eng_set_host_fault, METH_VARARGS,
      nullptr},
     {"plane_export", (PyCFunction)eng_plane_export, METH_NOARGS, nullptr},

@@ -71,6 +71,33 @@ CONTRACTS = [
     ("F_RST", [(_PACKET, "TcpFlags.RST"), (_TCPS, "F_RST")]),
     ("F_PSH", [(_PACKET, "TcpFlags.PSH"), (_TCPS, "F_PSH")]),
     ("F_ACK", [(_PACKET, "TcpFlags.ACK"), (_TCPS, "F_ACK")]),
+    ("F_ECE", [(_PACKET, "TcpFlags.ECE"), (_TCPS, "F_ECE")]),
+    ("F_CWR", [(_PACKET, "TcpFlags.CWR"), (_TCPS, "F_CWR")]),
+    # ECN / DCTCP family (docs/PARITY.md "DCTCP / ECN"): the IP-header
+    # codepoints, the fixed-point alpha parameters, the DCTCP-K
+    # marking thresholds, the congestion-controller ids and the
+    # MARK_* attribution causes — all fail-closed (an unregistered
+    # member with any of these prefixes is itself a violation), so K
+    # drift / alpha-shift drift / flag-bit swap between the three
+    # implementations fails `scripts/lint`, not a differential gate.
+    ("ECN_ECT0", [(_PACKET, "ECN_ECT0"), (_TCPS, "ECN_ECT0")]),
+    ("ECN_CE", [(_PACKET, "ECN_CE"), (_TCPS, "ECN_CE")]),
+    ("DCTCP_SHIFT", [(_CONN, "DCTCP_SHIFT"), (_TCPS, "DCTCP_SHIFT")]),
+    ("DCTCP_G_SHIFT", [(_CONN, "DCTCP_G_SHIFT"),
+                       (_TCPS, "DCTCP_G_SHIFT")]),
+    ("DCTCP_MAX_ALPHA", [(_CONN, "DCTCP_MAX_ALPHA"),
+                         (_TCPS, "DCTCP_MAX_ALPHA")]),
+    ("DCTCP_K_PKTS", [(_CODEL, "DCTCP_K_PKTS"),
+                      (_TCPS, "DCTCP_K_PKTS")]),
+    ("DCTCP_K_BYTES", [(_CODEL, "DCTCP_K_BYTES"),
+                       (_TCPS, "DCTCP_K_BYTES")]),
+    ("CC_RENO", [(_CONN, "CC_RENO")]),
+    ("CC_DCTCP", [(_CONN, "CC_DCTCP"), (_TCPS, "CC_DCTCP")]),
+    ("MARK_THRESH_PKTS", [(_TREV, "MARK_THRESH_PKTS"),
+                          (_TCPS, "MARK_THRESH_PKTS")]),
+    ("MARK_THRESH_BYTES", [(_TREV, "MARK_THRESH_BYTES"),
+                           (_TCPS, "MARK_THRESH_BYTES")]),
+    ("MARK_N", [(_TREV, "MARK_N"), (_TCPS, "MARK_N")]),
     # wire-size constants
     ("PROTO_TCP", [(_PACKET, "PROTO_TCP")]),
     ("PROTO_UDP", [(_PACKET, "PROTO_UDP")]),
@@ -226,7 +253,8 @@ CONTRACTS = [
 # CONTRACTS row (and with it a Python twin), so extending the
 # flight-record layout or the drop-cause table without updating
 # trace/events.py fails closed.
-TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_", "FB_", "FCT_", "CK_")
+TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_", "FB_", "FCT_", "CK_",
+                       "MARK_", "DCTCP_", "ECN_", "CC_")
 
 # Shim-side contracts (native/shim.c — the syscall observatory's SC_*
 # disposition enum, its record-size pin, and the IPC-layout offset of
@@ -451,6 +479,31 @@ def check(repo_root: str, cpp_text: str | None = None,
                 "twin-constant", CPP,
                 f"TEL_NAMES has {len(tel_names[0])} entries but "
                 f"TEL_N = {n}"))
+
+    # MARK_NAMES: the mark-cause string table must mirror the MARK_*
+    # enum order on BOTH sides (the fabric ledger and `trace fabric`
+    # render through it).
+    mark_names = strings.get("MARK_NAMES", [])
+    py_mark = py_consts(_TREV).get("MARK_NAMES")
+    if not mark_names:
+        violations.append(Violation(
+            "twin-constant", CPP, "C++ MARK_NAMES table not found"))
+    elif py_mark is None:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            "missing MARK_NAMES twin for the C++ cause table"))
+    elif tuple(py_mark) != mark_names[0]:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            f"MARK_NAMES = {tuple(py_mark)} but C++ MARK_NAMES = "
+            f"{mark_names[0]}"))
+    else:
+        n = consts.get("MARK_N")
+        if n is not None and len(mark_names[0]) != n:
+            violations.append(Violation(
+                "twin-constant", CPP,
+                f"MARK_NAMES has {len(mark_names[0])} entries but "
+                f"MARK_N = {n}"))
 
     # ASYS_NAMES order must mirror the ASYS_* enum
     asys_names = strings.get("ASYS_NAMES", [])

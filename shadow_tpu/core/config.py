@@ -54,6 +54,13 @@ class HostConfig:
     # object path (debugging aid; traces are byte-identical either way
     # — the cross-plane interop gates are the proof).
     native_dataplane: bool = True
+    # Per-host TCP stack (`tcp: {cc: reno|dctcp, ecn: on|off}`): the
+    # congestion controller every connection on this host runs, and
+    # whether its handshakes offer/accept ECN.  DCTCP without ECN is
+    # plain reno-shaped (no echo ever arrives), so the loader warns by
+    # rejecting that combination.
+    tcp_cc: str = "reno"
+    tcp_ecn: bool = False
 
 
 @dataclass
@@ -363,6 +370,8 @@ class ConfigOptions:
                 "pcap_enabled": h.pcap_enabled,
                 "pcap_capture_size": h.pcap_capture_size,
                 "native_dataplane": h.native_dataplane,
+                "tcp": {"cc": h.tcp_cc,
+                        "ecn": "on" if h.tcp_ecn else "off"},
                 "processes": procs,
             }
 
@@ -539,7 +548,7 @@ class ConfigOptions:
         # host_options block.  Only implemented options are accepted —
         # a typo'd or unsupported key must fail, not silently no-op.
         _HOST_OPTION_KEYS = {"pcap_enabled", "pcap_capture_size",
-                             "native_dataplane"}
+                             "native_dataplane", "tcp"}
 
         def _host_options(section: str, d: dict) -> dict:
             unknown = set(d) - _HOST_OPTION_KEYS
@@ -548,9 +557,40 @@ class ConfigOptions:
                                  f"{sorted(unknown)}")
             return d
 
+        def _tcp_block(section: str, d) -> tuple[str, bool]:
+            """One `tcp:` block -> (cc, ecn).  YAML 1.1 reads bare
+            on/off as booleans, so both spellings are accepted."""
+            if not isinstance(d, dict):
+                raise ValueError(f"{section}.tcp: must be a mapping")
+            unknown = set(d) - {"cc", "ecn"}
+            if unknown:
+                raise ValueError(f"{section}.tcp: unknown key(s) "
+                                 f"{sorted(unknown)}")
+            cc = str(d.get("cc", "reno"))
+            if cc not in ("reno", "dctcp"):
+                raise ValueError(f"{section}.tcp.cc: expected one of "
+                                 f"('reno', 'dctcp'), got {cc!r}")
+            ecn = d.get("ecn", False)
+            if isinstance(ecn, str):
+                if ecn not in ("on", "off"):
+                    raise ValueError(f"{section}.tcp.ecn: expected "
+                                     f"'on' or 'off', got {ecn!r}")
+                ecn = ecn == "on"
+            ecn = bool(ecn)
+            if cc == "dctcp" and not ecn:
+                raise ValueError(
+                    f"{section}.tcp: cc=dctcp requires ecn=on (without "
+                    f"an echo the controller degenerates to reno)")
+            return cc, ecn
+
         defaults_raw = _host_options(
             "host_option_defaults",
             raw.get("host_option_defaults", {}) or {})
+        if "tcp" in defaults_raw:
+            # Validate the default block eagerly with its own section
+            # label — a bad default must fail loudly even when every
+            # host overrides it.
+            _tcp_block("host_option_defaults", defaults_raw["tcp"])
 
         hosts = {}
         for name, h in hosts_raw.items():
@@ -578,6 +618,9 @@ class ConfigOptions:
                 ))
             bw_down = h.get("bandwidth_down")
             bw_up = h.get("bandwidth_up")
+            tcp_raw = h.get("tcp", opt.get("tcp"))
+            tcp_cc, tcp_ecn = (("reno", False) if tcp_raw is None
+                               else _tcp_block(f"hosts.{name}", tcp_raw))
             hosts[str(name)] = HostConfig(
                 name=str(name),
                 network_node_id=int(_require(h, "network_node_id",
@@ -597,6 +640,8 @@ class ConfigOptions:
                 native_dataplane=bool(
                     h.get("native_dataplane",
                           opt.get("native_dataplane", True))),
+                tcp_cc=tcp_cc,
+                tcp_ecn=tcp_ecn,
             )
         checkpoint = None
         ck_raw = raw.get("checkpoint")

@@ -237,6 +237,8 @@ class Manager:
                                  "(host or graph node must provide it)")
             host = Host(host_id, name, ip, node.index, seed, bw_down, bw_up,
                         qdisc=config.experimental.interface_qdisc)
+            host.tcp_cc = hcfg.tcp_cc
+            host.tcp_ecn = hcfg.tcp_ecn
             if config.experimental.host_cpu_threshold_ns is not None:
                 from shadow_tpu.host.cpu import Cpu
                 host.cpu = Cpu(
@@ -1353,13 +1355,15 @@ class Manager:
         forwarded + dropped + still-queued + relay-parked, and the
         drop count must reconcile against the TEL_CODEL +
         TEL_RTR_LIMIT attribution causes."""
-        from shadow_tpu.trace.events import TEL_CODEL, TEL_RTR_LIMIT
+        from shadow_tpu.trace.events import (MARK_N, MARK_NAMES,
+                                             TEL_CODEL, TEL_RTR_LIMIT)
         totals = {"enqueued_pkts": 0, "enqueued_bytes": 0,
                   "delivered_pkts": 0, "delivered_bytes": 0,
                   "dropped_pkts": 0, "dropped_bytes": 0,
                   "marked_pkts": 0, "queued_pkts": 0,
                   "queued_bytes": 0, "peak_queue_depth": 0,
                   "refill_stalls": 0, "violations": 0}
+        mark_causes = [0] * MARK_N
         max_link_s = 0.0
         for h in self.hosts:
             c = self._fabric_host_counters(h)
@@ -1383,15 +1387,31 @@ class Manager:
             totals["refill_stalls"] += r1s + r2s
             totals["peak_queue_depth"] = max(
                 totals["peak_queue_depth"], peak)
+            for i in range(MARK_N):
+                mark_causes[i] += h.mark_causes[i]
             if h.bw_up_bits:
                 max_link_s = max(max_link_s,
                                  bsent * 8 / h.bw_up_bits)
             attributed = (h.drop_causes[TEL_CODEL]
                           + h.drop_causes[TEL_RTR_LIMIT])
+            # A marked packet is forwarded-with-mark: it stays on the
+            # delivered/queued side, NEVER the dropped side — so the
+            # byte identity is untouched by marking, and the marks
+            # themselves must reconcile against the MARK_* attribution
+            # (one cause per CE rewrite) and fit inside the accepted
+            # population (each accepted packet marks at most once; a
+            # marked packet may STILL be sojourn-dropped later by the
+            # CoDel control law, so marks are bounded by enqueued —
+            # not by enqueued minus dropped).
+            marks_attributed = sum(h.mark_causes)
             if enq_p != fwd_p + drop_p + depth + park_p \
                     or enq_b != fwd_b + drop_b + qbytes + park_b \
-                    or drop_p != attributed:
+                    or drop_p != attributed \
+                    or marked != marks_attributed \
+                    or marked > enq_p:
                 totals["violations"] += 1
+        totals["marks"] = {MARK_NAMES[i]: mark_causes[i]
+                          for i in range(MARK_N) if mark_causes[i]}
         return totals, max_link_s
 
     def fabric_conservation(self) -> dict:
@@ -1425,6 +1445,8 @@ class Manager:
         out = {
             "peak_queue_depth": cons["peak_queue_depth"],
             "refill_stalls": cons["refill_stalls"],
+            "marked_pkts": cons["marked_pkts"],
+            "marks": cons["marks"],
             "link_utilization": round(util, 4),
             "conservation": ("ok" if cons["violations"] == 0
                              else f"{cons['violations']} violations"),

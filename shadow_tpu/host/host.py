@@ -24,7 +24,7 @@ from shadow_tpu.net.packet import PROTO_TCP
 from shadow_tpu.net.relay import Relay
 from shadow_tpu.net.router import Router
 from shadow_tpu.net.token_bucket import TokenBucket
-from shadow_tpu.trace.events import SC_N, TEL_BY_REASON, TEL_N
+from shadow_tpu.trace.events import MARK_N, SC_N, TEL_BY_REASON, TEL_N
 
 # Canonical trace kinds, in tiebreak order: a packet sent and dropped at
 # the same instant sorts SND before DRP.
@@ -50,6 +50,11 @@ class Host:
     # rebuilds generator frames from the transcripts on resume).
     ckpt_record = False
     strace_mode = None  # set by the manager at build
+    # Per-host TCP stack options (`tcp: {cc, ecn}` config block; the
+    # manager overrides at build).  Class-level defaults so direct
+    # constructions and older snapshots get the reno/no-ECN stack.
+    tcp_cc = "reno"
+    tcp_ecn = False
 
     def __init__(self, host_id: int, name: str, ip: int, node_index: int,
                  seed: int, bw_down_bits: int, bw_up_bits: int,
@@ -138,6 +143,12 @@ class Host:
         self.drop_causes = [0] * TEL_N
         self.drop_unattributed = 0
         self._native_causes_merged = (0,) * (TEL_N + 1)
+        # ECN mark attribution (trace/events.py MARK_*; the netplane
+        # HostPlane::mark_causes twin): every CE rewrite by this
+        # host's router queue credits exactly one cause, so the
+        # per-cause counters sum to the queue's marked_count.
+        self.mark_causes = [0] * MARK_N
+        self._native_marks_merged = (0,) * MARK_N
         # Fabric-observatory flow lifecycle (trace/fabricstat.py):
         # FCT_REC field tuples of connections torn down before the
         # artifact was written (netplane.cpp HostPlane::fct_log twin).
@@ -507,6 +518,12 @@ class Host:
             self.drop_unattributed += 1
         self.trace_packet(TRACE_DRP, packet, reason, at_time=at_time)
 
+    def count_mark(self, cause: int) -> None:
+        """One CE mark by this host's router queue, attributed to the
+        MARK_* threshold leg that fired (router.route_incoming_packet
+        passes this as the CoDel push's on_mark)."""
+        self.mark_causes[cause] += 1
+
     def trace_snd(self, packet) -> None:
         self.trace_packet(TRACE_SND, packet)
 
@@ -536,6 +553,12 @@ class Host:
             self.drop_causes[i] += causes[i] - prev[i]
         self.drop_unattributed += causes[TEL_N] - prev[TEL_N]
         self._native_causes_merged = tuple(causes)
+        # ECN mark-cause counters (same delta discipline).
+        marks = self.plane.engine.mark_causes(self.id)
+        prev = self._native_marks_merged
+        for i in range(MARK_N):
+            self.mark_causes[i] += marks[i] - prev[i]
+        self._native_marks_merged = tuple(marks)
         # Engine-app syscalls (counted C++-side at the exact points the
         # Python dispatch would) fold into the same histograms.
         app_sys = self.plane.engine.app_syscalls(self.id)

@@ -45,6 +45,11 @@ class TcpSocket(StatusOwner):
         self.reuseaddr = False        # SO_REUSEADDR, bind-time semantics
         self._send_buf_max = send_buf
         self._recv_buf_max = recv_buf
+        # Per-host TCP stack options (`tcp: {cc, ecn}` config block),
+        # captured at socket birth so every connection this socket —
+        # or its accept children — creates runs the host's stack.
+        self._tcp_cc = getattr(host, "tcp_cc", "reno")
+        self._tcp_ecn = getattr(host, "tcp_ecn", False)
         # Dynamic buffer sizing (ref tcp.c _tcp_autotune*Buffer):
         # grow-only, clamped to the bandwidth-delay product.
         self.send_autotune = send_autotune
@@ -151,6 +156,7 @@ class TcpSocket(StatusOwner):
         self.conn = tcpc.TcpConnection(
             iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
             send_buf_max=self._send_buf_max,
+            congestion=self._tcp_cc, ecn=self._tcp_ecn,
             window_ceiling=(tcpc.RMEM_CEILING if self.recv_autotune
                             else None))
         self.conn.nodelay = self.nodelay
@@ -311,7 +317,8 @@ class TcpSocket(StatusOwner):
             host.trace_drop(packet, "tcp-closed")
             return False
         reasm0, trunc0 = conn.reasm_discards, conn.rcvwin_trunc
-        conn.on_packet(packet.tcp, packet.payload, host.now())
+        conn.on_packet(packet.tcp, packet.payload, host.now(),
+                       ecn=packet.ecn)
         # Sim-netstat receiver discards (netplane.cpp tcp_push_in
         # twin): fold the per-packet delta into the host's drop-cause
         # counters — the connection has no host backref.
@@ -358,6 +365,7 @@ class TcpSocket(StatusOwner):
         child.conn = tcpc.TcpConnection(
             iss=host.rng.next_u32(), recv_buf_max=self._recv_buf_max,
             send_buf_max=self._send_buf_max,
+            congestion=self._tcp_cc, ecn=self._tcp_ecn,
             window_ceiling=(tcpc.RMEM_CEILING if self.recv_autotune
                             else None))
         child.nodelay = self.nodelay
@@ -439,6 +447,12 @@ class TcpSocket(StatusOwner):
                            self.local[1], self.peer[0], self.peer[1],
                            payload=payload, tcp=hdr)
             p.priority = seq
+            # ECN-capable transport: data segments carry ECT(0) so a
+            # congested queue can mark instead of drop; control
+            # segments stay not-ECT (RFC 3168 6.1.1 + the empty-
+            # control loss exemption's sibling rule).
+            if conn.ecn_active and payload:
+                p.ecn = pkt.ECN_ECT0
             self._out_packets[iface.name].append(p)
             emitted = True
         if emitted:

@@ -148,6 +148,12 @@ class NativePlane:
     def add_host(self, host, qdisc_rr: bool, mtu: int = 1500) -> None:
         self.engine.add_host(host.id, host.ip, host.bw_up_bits,
                              host.bw_down_bits, qdisc_rr, mtu)
+        # Per-host TCP stack options (`tcp:` config block): every
+        # engine-side connection on this host — app-owned or proxied —
+        # inherits them at TcpConn birth.
+        self.engine.set_host_tcp(
+            host.id, 1 if host.tcp_cc == "dctcp" else 0,
+            1 if host.tcp_ecn else 0)
         host.plane = self
         # Move the host RNG stream engine-side (native threefry): the
         # engine draws locally instead of calling back into Python per

@@ -13,10 +13,22 @@ from collections import deque
 from math import isqrt
 
 from shadow_tpu.net import packet as pkt
+from shadow_tpu.trace.events import MARK_THRESH_BYTES, MARK_THRESH_PKTS
 
 TARGET_NS = 5_000_000       # 5 ms acceptable standing delay
 INTERVAL_NS = 100_000_000   # 100 ms sliding window
 HARD_LIMIT = 1000           # max queued packets (codel_queue.rs limit)
+
+# DCTCP instantaneous marking threshold K (RFC 8257 4.1; netplane.cpp
+# CoDelN twins): an ECT(0) packet arriving while the queue already
+# holds >= K packets — or >= K bytes — is marked CE instead of waiting
+# for the CoDel control law to drop it.  Both legs are checked against
+# the queue state BEFORE this packet enqueues, packets first (the
+# attributed MARK_* cause records which leg fired).  ~20 full-MTU
+# packets ~= 30 KB, so the two legs agree for bulk traffic and the
+# bytes leg catches many-small-segment fan-in.
+DCTCP_K_PKTS = 20
+DCTCP_K_BYTES = 30_000
 
 
 def _control_time(first_above_time: int, count: int) -> int:
@@ -49,9 +61,10 @@ class CoDelQueue:
         self.enqueued_bytes = 0
         self.dropped_bytes = 0
         self.peak_depth = 0
-        # ECN-ready: CoDel marks instead of drops once DCTCP lands
-        # (ROADMAP item 3); until then the counter stays 0 on every
-        # path, and the fabric channel already carries the slot.
+        # ECN marks: CE rewrites by the DCTCP-K instantaneous
+        # threshold law in push() — a marked packet still FORWARDS, so
+        # it sits on the delivered side of the conservation invariant
+        # (the fabric channel's qmarks series samples this counter).
         self.marked_count = 0
 
     def __len__(self):
@@ -69,13 +82,29 @@ class CoDelQueue:
         if on_drop is not None:
             on_drop(packet)
 
-    def push(self, packet, now: int, on_drop=None) -> bool:
-        """Returns False (and drops) only at the hard limit."""
+    def push(self, packet, now: int, on_drop=None, on_mark=None) -> bool:
+        """Returns False (and drops) only at the hard limit.  An
+        ECN-capable (ECT) packet that clears the hard limit but meets
+        the DCTCP-K instantaneous threshold is marked CE and enqueued
+        normally; `on_mark(cause)` attributes the mark to the MARK_*
+        leg that fired (trace/events.py) — cause-only, so the router
+        can pass the host's bound counter method directly."""
         self.enqueued_count += 1
         self.enqueued_bytes += packet.total_size()
         if len(self._q) >= HARD_LIMIT:
             self._drop(packet, on_drop)
             return False
+        if packet.ecn == pkt.ECN_ECT0:
+            cause = -1
+            if len(self._q) >= DCTCP_K_PKTS:
+                cause = MARK_THRESH_PKTS
+            elif self._bytes >= DCTCP_K_BYTES:
+                cause = MARK_THRESH_BYTES
+            if cause >= 0:
+                packet.ecn = pkt.ECN_CE
+                self.marked_count += 1
+                if on_mark is not None:
+                    on_mark(cause)
         self._q.append((packet, now))
         self._bytes += packet.total_size()
         if len(self._q) > self.peak_depth:

@@ -27,6 +27,13 @@ IPV4_HEADER_SIZE = 20
 UDP_HEADER_SIZE = 8
 TCP_HEADER_SIZE = 20
 
+# IP-header ECN codepoints (RFC 3168 sec. 5; netplane.cpp twins).
+# Only the two values the stack uses are modeled: a sender stamps
+# ECT(0) on ECN-capable data segments, a congested queue rewrites it
+# to CE instead of dropping.  Not-ECT is the zero default.
+ECN_ECT0 = 2
+ECN_CE = 3
+
 # Lifecycle breadcrumbs (subset of packet.rs PacketStatus).
 ST_CREATED = "snd_created"
 ST_SENT_TO_ROUTER = "snd_to_router"
@@ -46,6 +53,10 @@ class TcpFlags:
     RST = 0x04
     PSH = 0x08
     URG = 0x20
+    # RFC 3168 ECN bits: ECE echoes congestion back to the sender,
+    # CWR acknowledges the echo (netplane.cpp F_ECE/F_CWR twins).
+    ECE = 0x40
+    CWR = 0x80
 
 
 class TcpHeader:
@@ -83,7 +94,7 @@ def set_status_tracing(enabled: bool) -> None:
 class Packet:
     __slots__ = ("src_host_id", "seq", "protocol", "src_ip", "src_port",
                  "dst_ip", "dst_port", "payload", "tcp", "priority",
-                 "statuses", "arrival_time", "_total_size")
+                 "statuses", "arrival_time", "ecn", "_total_size")
 
     def __init__(self, src_host_id: int, seq: int, protocol: int,
                  src_ip: int, src_port: int, dst_ip: int, dst_port: int,
@@ -100,6 +111,10 @@ class Packet:
         self.priority = 0       # FIFO stamp assigned at interface enqueue
         self.statuses = None
         self.arrival_time = 0   # set by the propagation phase
+        # IP ECN codepoint: ECN_ECT0 on ECN-capable data segments
+        # (stamped by the sending socket), rewritten to ECN_CE by a
+        # congested queue's marking law, 0 (not-ECT) otherwise.
+        self.ecn = 0
         # Hot-path cache: headers and payload never change after
         # construction, and total_size() is called several times per
         # packet in the queue/relay path.

@@ -27,7 +27,8 @@ class Router:
         """Called by the scheduler when a cross-host packet arrives at this
         host (Host::execute packet branch, host.rs:783-786)."""
         if self._inbound.push(packet, host.now(),
-                              lambda p: host.trace_drop(p, "rtr-limit")):
+                              lambda p: host.trace_drop(p, "rtr-limit"),
+                              host.count_mark):
             host.notify_router_has_packets()
 
     def pop_inbound(self, host, now: int):

@@ -142,7 +142,7 @@ RESIDENT_CARRIED = frozenset(
      "app_sys", "codel_bytes", "drop_causes", "codel_count", "codel_drop_next",
      "codel_dropped", "codel_dropping", "codel_first_above",
      "codel_enq_pkts", "codel_enq_bytes", "codel_drop_bytes",
-     "codel_peak", "r1_stalls", "r2_stalls",
+     "codel_peak", "codel_marked", "r1_stalls", "r2_stalls",
      "r1_fwd_pkts", "r1_fwd_bytes", "r2_fwd_pkts", "r2_fwd_bytes",
      "codel_last_count", "cq_enq", "cq_len", "cq_pos",
      "eth_brecv", "eth_bsent", "eth_precv", "eth_psent",
@@ -291,7 +291,7 @@ class PholdSpanRunner(SpanMeshMixin):
         st["codel_first_above"] = f("codel_first_above", np.int64)
         for k in ("codel_count", "codel_last_count", "codel_drop_next",
                   "codel_enq_pkts", "codel_enq_bytes",
-                  "codel_drop_bytes", "codel_peak"):
+                  "codel_drop_bytes", "codel_peak", "codel_marked"):
             st[k] = f(k, np.int64)
         st["m_port"] = f("m_port", np.int32)
         st["n_peers"] = f("n_peers", np.int32)
@@ -384,7 +384,8 @@ class PholdSpanRunner(SpanMeshMixin):
                   "codel_last_count", "codel_first_above",
                   "codel_drop_next", "codel_dropped",
                   "codel_enq_pkts", "codel_enq_bytes",
-                  "codel_drop_bytes", "codel_peak", "m_waitseq",
+                  "codel_drop_bytes", "codel_peak", "codel_marked",
+                  "m_waitseq",
                   "m_gotn", "s_waitseq", "s_senti", "s_exit_time"):
             out[k] = npv(k).astype(np.int64).tobytes()
         out["pkts_sent"] = npv("app_pkts_sent").astype(np.int64).tobytes()
@@ -1190,6 +1191,11 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["codel_enq_bytes"])
             limit_full = arr & (st["cq_len"] - st["cq_pos"]
                                 >= CODEL_HARD_LIMIT)
+            # DCTCP-K marking law (net/codel.py push twin): fires only
+            # for ECT(0) arrivals.  This family's packets are UDP —
+            # never ECN-capable — so the law is provably inert here;
+            # the codel_marked counter still rides the codec so the
+            # fabric channel's qmarks series samples the live value.
             st["codel_dropped"] = jnp.where(
                 limit_full, st["codel_dropped"] + 1,
                 st["codel_dropped"])
@@ -1497,6 +1503,7 @@ class PholdSpanRunner(SpanMeshMixin):
                         ("sojourn", sojourn),
                         ("qenq", st["codel_enq_pkts"]),
                         ("qdrops", st["codel_dropped"]),
+                        ("qmarks", st["codel_marked"]),
                         ("r1_bal", bucket_peek(1)),
                         ("r1_stalls", st["r1_stalls"]),
                         ("r2_bal", bucket_peek(2)),
@@ -1570,7 +1577,7 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["fab_t"] = jnp.zeros(FABR, jnp.int64)
                 st["fab_flags"] = jnp.zeros((FABR, H), jnp.int32)
                 for name in ("qdepth", "qbytes", "sojourn", "qenq",
-                             "qdrops", "r1_bal", "r1_stalls",
+                             "qdrops", "qmarks", "r1_bal", "r1_stalls",
                              "r2_bal", "r2_stalls", "psent", "bsent",
                              "precv", "brecv"):
                     st[f"fab_{name}"] = jnp.zeros((FABR, H),

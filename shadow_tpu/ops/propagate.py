@@ -44,7 +44,7 @@ def _export_native_packet(plane, pkt_id: int):
     """Materialize an engine packet as a Python Packet (mixed-plane
     delivery to an object-path host) and free the native slot."""
     (src_host, seq, proto, src_ip, sport, dst_ip, dport, payload,
-     tcp) = plane.engine.packet_fields(pkt_id)
+     ecn, tcp) = plane.engine.packet_fields(pkt_id)
     hdr = None
     if tcp is not None:
         tseq, ack, flags, window, wscale, mss, sacks, ts_val, \
@@ -57,6 +57,7 @@ def _export_native_packet(plane, pkt_id: int):
     p = pktmod.Packet(src_host, seq, proto, src_ip, sport, dst_ip, dport,
                       payload=payload, tcp=hdr)
     p.priority = seq
+    p.ecn = ecn  # ECT/CE survives the cross-plane seam
     plane.engine.free_packet(pkt_id)
     return p
 
@@ -73,7 +74,7 @@ def _intern_python_packet(plane, p) -> int:
                h.timestamp or 0, h.timestamp_echo or 0)
     return plane.engine.intern_packet(
         p.src_host_id, p.seq, p.protocol, p.src_ip, p.src_port, p.dst_ip,
-        p.dst_port, p.payload, tcp)
+        p.dst_port, p.payload, p.ecn, tcp)
 
 
 def _bucket(n: int) -> int:

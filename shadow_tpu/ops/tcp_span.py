@@ -66,7 +66,25 @@ F_SYN = 0x02
 F_RST = 0x04
 F_PSH = 0x08
 F_ACK = 0x10
+F_ECE = 0x40
+F_CWR = 0x80
 MSS = 1460
+
+# ECN / DCTCP (net/packet.py, tcp/connection.py, net/codel.py twins;
+# registered fail-closed in analysis pass 1).  The alpha EWMA is
+# fixed-point (scaled by 2**DCTCP_SHIFT) so this kernel, the C++
+# engine and the Python object path compute bit-identical values.
+ECN_ECT0 = 2
+ECN_CE = 3
+DCTCP_SHIFT = 10
+DCTCP_G_SHIFT = 4
+DCTCP_MAX_ALPHA = 1024
+DCTCP_K_PKTS = 20
+DCTCP_K_BYTES = 30_000
+CC_DCTCP = 1
+MARK_THRESH_PKTS = 0
+MARK_THRESH_BYTES = 1
+MARK_N = 2
 MAX_WINDOW = 65_535
 TCP_TOTAL_HDR = 40  # IPv4 20 + TCP 20; options are not size-modelled
 MIN_RTO_NS = 200_000_000
@@ -117,10 +135,12 @@ TEL_FIELDS = (("cwnd", "c_cwnd"), ("ssthresh", "c_ssthresh"),
               ("sacks", "c_sackskip"))
 ST_ESTABLISHED = 4  # every in-domain connection's state
 
-# Packet columns: routing identity + the TCP header.
+# Packet columns: routing identity + the TCP header + the IP ECN
+# codepoint (the queues' marking law rewrites it in flight).
 ROUTE_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
 TCP_KEYS = ("tseq", "tack", "tflags", "twin", "tsv", "tse", "plen",
-            "nsk", "sk0s", "sk0e", "sk1s", "sk1e", "sk2s", "sk2e")
+            "nsk", "sk0s", "sk0e", "sk1s", "sk1e", "sk2s", "sk2e",
+            "ecn")
 PK_KEYS = ROUTE_KEYS + TCP_KEYS
 PK_DTYPES = {
     "srchost": np.int32, "pseq": np.int64, "sip": np.uint32,
@@ -130,6 +150,7 @@ PK_DTYPES = {
     "plen": np.int32, "nsk": np.int32,
     "sk0s": np.uint32, "sk0e": np.uint32, "sk1s": np.uint32,
     "sk1e": np.uint32, "sk2s": np.uint32, "sk2e": np.uint32,
+    "ecn": np.int32,
 }
 
 # Abort reason bits (phold_span twin semantics).
@@ -156,6 +177,7 @@ RESIDENT_STATIC = frozenset({
     "c_host", "c_role", "c_lip", "c_lport", "c_pip", "c_pport",
     "c_iss", "c_irs", "c_wsoff", "c_ourws", "c_peerws", "c_effmss",
     "c_nodelay", "c_congmss", "c_sat", "c_rat", "c_atotal",
+    "c_ecnact", "c_cc",
 })
 RESIDENT_DERIVED = frozenset(
     {"cont", "then", "ret", "cur", "eflag", "parkp", "had_holes",
@@ -177,10 +199,12 @@ RESIDENT_CARRIED = frozenset(
      "c_segssent", "c_sndnxt", "c_snduna", "c_sndwnd", "c_srtt",
      "c_ssa", "c_ssthresh", "c_status", "c_tmrdl", "c_tsrecent",
      "c_wakep", "c_fbyte", "c_lbyte", "c_bin", "c_bout",
+     "c_ece", "c_cwrp", "c_cwrend", "c_alpha", "c_ceack",
+     "c_totack", "c_dwend",
      "codel_bytes", "codel_count", "codel_drop_next",
      "codel_dropped", "codel_dropping", "codel_first_above",
      "codel_enq_pkts", "codel_enq_bytes", "codel_drop_bytes",
-     "codel_peak", "drop_causes",
+     "codel_peak", "codel_marked", "drop_causes", "mark_causes",
      "codel_last_count", "cq_enq", "cq_len", "cq_pos",
      "eth_brecv", "eth_bsent", "eth_precv", "eth_psent",
      "event_seq", "events_run", "ib_len", "ib_pos", "ib_seq",
@@ -328,7 +352,8 @@ class TcpSpanRunner(SpanMeshMixin):
                   "codel_bytes", "codel_count", "codel_last_count",
                   "codel_first_above", "codel_drop_next",
                   "codel_dropped", "codel_enq_pkts", "codel_enq_bytes",
-                  "codel_drop_bytes", "codel_peak", "pkts_sent",
+                  "codel_drop_bytes", "codel_peak", "codel_marked",
+                  "pkts_sent",
                   "pkts_recv", "pkts_dropped", "events_run",
                   "eth_psent", "eth_precv", "eth_bsent", "eth_brecv"):
             st[k] = f(k, np.int64)
@@ -362,6 +387,7 @@ class TcpSpanRunner(SpanMeshMixin):
                           < f("th_len", np.int32)[:, None])
         st["app_sys"] = f("app_sys", np.int64, (H, ASYS_N))
         st["drop_causes"] = f("drop_causes", np.int64, (H, TEL_N))
+        st["mark_causes"] = f("mark_causes", np.int64, (H, MARK_N))
 
         # conn-major
         for k, dt in (("c_host", np.int32), ("c_lport", np.int32),
@@ -369,14 +395,16 @@ class TcpSpanRunner(SpanMeshMixin):
                       ("c_peerws", np.int32), ("c_effmss", np.int32),
                       ("c_wsoff", np.int32), ("c_ssa", np.int32),
                       ("c_congmss", np.int32), ("c_dupacks", np.int32),
-                      ("c_rtobackoff", np.int32)):
+                      ("c_rtobackoff", np.int32), ("c_cc", np.int32)):
             st[k] = f(k, dt)
         for k in ("c_lip", "c_pip", "c_iss", "c_irs", "c_snduna",
-                  "c_sndnxt", "c_rcvnxt", "c_recover", "c_status"):
+                  "c_sndnxt", "c_rcvnxt", "c_recover", "c_status",
+                  "c_cwrend", "c_dwend"):
             st[k] = f(k, np.uint32)
         st["c_await"] = f("c_await", np.uint32)
         for k in ("c_role", "c_nodelay", "c_fastrec", "c_queued",
-                  "c_sat", "c_rat", "c_wakep"):
+                  "c_sat", "c_rat", "c_wakep", "c_ecnact", "c_ece",
+                  "c_cwrp"):
             st[k] = f(k, np.uint8).astype(np.int32)
         for k in ("c_sndwnd", "c_sblen", "c_sbmax", "c_rblen",
                   "c_rbmax", "c_delackdl", "c_persistdl",
@@ -385,7 +413,8 @@ class TcpSpanRunner(SpanMeshMixin):
                   "c_segssent", "c_segsrecv", "c_rtxcount",
                   "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
                   "c_atlast", "c_awaitseq", "c_agot", "c_atotal",
-                  "c_fbyte", "c_lbyte", "c_bin", "c_bout"):
+                  "c_fbyte", "c_lbyte", "c_bin", "c_bout",
+                  "c_alpha", "c_ceack", "c_totack"):
             st[k] = f(k, np.int64)
         st["rtx_len"] = f("rtx_len", np.int32)
         st["rtx_seq"] = f("rtx_seq", np.uint32, (CC, RT))
@@ -493,7 +522,8 @@ class TcpSpanRunner(SpanMeshMixin):
                   "codel_count", "codel_last_count",
                   "codel_first_above", "codel_drop_next",
                   "codel_dropped", "codel_enq_pkts", "codel_enq_bytes",
-                  "codel_drop_bytes", "codel_peak", "pkts_sent",
+                  "codel_drop_bytes", "codel_peak", "codel_marked",
+                  "pkts_sent",
                   "pkts_recv", "pkts_dropped", "events_run",
                   "eth_psent", "eth_precv", "eth_bsent", "eth_brecv"):
             out[k] = npv(k).astype(np.int64).tobytes()
@@ -521,9 +551,12 @@ class TcpSpanRunner(SpanMeshMixin):
         out["app_sys"] = npv("app_sys").astype(np.int64).tobytes()
         out["drop_causes"] = npv("drop_causes").astype(
             np.int64).tobytes()
+        out["mark_causes"] = npv("mark_causes").astype(
+            np.int64).tobytes()
         for k, dt in (("c_snduna", np.uint32), ("c_sndnxt", np.uint32),
                       ("c_rcvnxt", np.uint32), ("c_recover", np.uint32),
-                      ("c_status", np.uint32), ("c_await", np.uint32)):
+                      ("c_status", np.uint32), ("c_await", np.uint32),
+                      ("c_cwrend", np.uint32), ("c_dwend", np.uint32)):
             out[k] = npv(k).astype(dt).tobytes()
         for k in ("c_sndwnd", "c_sblen", "c_sbmax", "c_rblen",
                   "c_rbmax", "c_delackdl", "c_persistdl",
@@ -532,11 +565,13 @@ class TcpSpanRunner(SpanMeshMixin):
                   "c_segssent", "c_segsrecv", "c_rtxcount",
                   "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
                   "c_atlast", "c_awaitseq", "c_agot",
-                  "c_fbyte", "c_lbyte", "c_bin", "c_bout"):
+                  "c_fbyte", "c_lbyte", "c_bin", "c_bout",
+                  "c_alpha", "c_ceack", "c_totack"):
             out[k] = npv(k).astype(np.int64).tobytes()
         for k in ("c_ssa", "c_dupacks", "c_rtobackoff"):
             out[k] = npv(k).astype(np.int32).tobytes()
-        for k in ("c_fastrec", "c_queued", "c_wakep"):
+        for k in ("c_fastrec", "c_queued", "c_wakep", "c_ece",
+                  "c_cwrp"):
             out[k] = npv(k).astype(np.uint8).tobytes()
         return out
 
@@ -761,11 +796,16 @@ class TcpSpanRunner(SpanMeshMixin):
                 mask, jnp.int64(0), cg(st, "c_tsrecent")))
             return st, tse
 
-        def emit(st, mask, tseq, plen, flags, with_sacks, track):
+        def emit(st, mask, tseq, plen, flags, with_sacks, track,
+                 fresh=False):
             """One segment from each masked lane's cur conn into its
             egress ring — the outbox+flush collapse: emission order IS
             flush order, so pseq assignment at emission is identical.
-            All in-domain emissions carry ACK (note_ack_sent)."""
+            All in-domain emissions carry ACK (note_ack_sent).
+            ECN: the receiver latch echoes ECE on every segment
+            (connection.py _emit twin — in-domain segments never carry
+            SYN), `fresh` data consumes a pending one-shot CWR
+            (_data_flags twin), and ECN-active data carries ECT(0)."""
             now = st["now"]
             win = wire_window(st)
             if with_sacks:
@@ -775,6 +815,17 @@ class TcpSpanRunner(SpanMeshMixin):
                 nsk = jnp.zeros(H, jnp.int32)
                 s0 = e0 = s1 = e1 = s2 = e2 = z
             st, tse = take_ts_echo(st, mask)
+            fl = jnp.full(H, flags, jnp.int32) \
+                | jnp.where(cg(st, "c_ece") == 1, jnp.int32(F_ECE),
+                            jnp.int32(0))
+            if fresh:
+                do_cwr = mask & (plen > 0) & (cg(st, "c_cwrp") == 1) \
+                    & (cg(st, "c_ecnact") == 1)
+                fl = fl | jnp.where(do_cwr, jnp.int32(F_CWR),
+                                    jnp.int32(0))
+                st = cset(st, do_cwr, c_cwrp=jnp.int32(0))
+            ecn = jnp.where((cg(st, "c_ecnact") == 1) & (plen > 0),
+                            jnp.int32(ECN_ECT0), jnp.int32(0))
             pseq = st["packet_seq"]
             st = dict(st)
             st["packet_seq"] = jnp.where(mask, pseq + 1, pseq)
@@ -789,11 +840,11 @@ class TcpSpanRunner(SpanMeshMixin):
                     "sip": cg(st, "c_lip"), "sport": cg(st, "c_lport"),
                     "dip": cg(st, "c_pip"), "dport": cg(st, "c_pport"),
                     "tseq": tseq, "tack": cg(st, "c_rcvnxt"),
-                    "tflags": jnp.full(H, flags, jnp.int32),
+                    "tflags": fl,
                     "twin": win, "tsv": now + 1, "tse": tse,
                     "plen": plen.astype(jnp.int32), "nsk": nsk,
                     "sk0s": s0, "sk0e": e0, "sk1s": s1, "sk1e": e1,
-                    "sk2s": s2, "sk2e": e2}
+                    "sk2s": s2, "sk2e": e2, "ecn": ecn}
             for kk in PK_KEYS:
                 st[f"op_{kk}"] = st[f"op_{kk}"].at[rows, tail].set(
                     vals[kk], mode="drop")
@@ -1235,6 +1286,14 @@ class TcpSpanRunner(SpanMeshMixin):
             bad |= mask & s_lt(cg(st, "c_sndnxt"), pk["tack"])
             st = mark_abort(st, bad.any(), AB_STRUCT, 7)
             st = dict(st)
+            # RFC 3168 receiver (connection.py on_packet twin): CWR
+            # ends the echo episode, a CE-marked arrival (re)starts
+            # it — in that order.
+            ecnact = cg(st, "c_ecnact") == 1
+            cwr_in = mask & ecnact & ((pk["tflags"] & F_CWR) != 0)
+            st = cset(st, cwr_in, c_ece=jnp.int32(0))
+            ce_in = mask & ecnact & (pk["ecn"] == ECN_CE)
+            st = cset(st, ce_in, c_ece=jnp.int32(1))
             # RFC 7323 ts_recent update (covering the ack point)
             span = jnp.maximum(plen, 1)
             upd = mask & (pk["tsv"] != 0) \
@@ -1279,6 +1338,48 @@ class TcpSpanRunner(SpanMeshMixin):
             st = cset(st, have_sack,
                       c_sackskip=cg(st, "c_sackskip")
                       + newly.sum(axis=1))
+            # ECN sender side (connection.py _on_ack twin, the same
+            # position: after the SACK marks, before the new-ack/
+            # dupack dispatch — snd_una still pre-ack).
+            ece_fl = mask & ecnact & ((pk["tflags"] & F_ECE) != 0)
+            new_ack0 = mask & s_lt(cg(st, "c_snduna"), pk["tack"])
+            acked0 = s_sub(pk["tack"], cg(st, "c_snduna"))
+            is_d = cg(st, "c_cc") == CC_DCTCP
+            acc = new_ack0 & ecnact & is_d
+            st = cset(st, acc,
+                      c_totack=cg(st, "c_totack")
+                      + jnp.where(acc, acked0, jnp.int64(0)),
+                      c_ceack=cg(st, "c_ceack")
+                      + jnp.where(acc & ece_fl, acked0, jnp.int64(0)))
+            # window boundary: fold the echo fraction into alpha
+            # (fixed-point EWMA — reads the just-accumulated counters)
+            wb = acc & s_lt(cg(st, "c_dwend"), pk["tack"])
+            alpha = cg(st, "c_alpha")
+            nalpha = jnp.minimum(
+                jnp.int64(DCTCP_MAX_ALPHA),
+                alpha - (alpha >> DCTCP_G_SHIFT)
+                + (cg(st, "c_ceack") << (DCTCP_SHIFT - DCTCP_G_SHIFT))
+                // jnp.maximum(cg(st, "c_totack"), 1))
+            st = cset(st, wb, c_alpha=nalpha, c_ceack=jnp.int64(0),
+                      c_totack=jnp.int64(0),
+                      c_dwend=cg(st, "c_sndnxt"))
+            # one cut per window; CWR announces it on fresh data
+            red = ece_fl & (cg(st, "c_fastrec") == 0) \
+                & s_lt(cg(st, "c_cwrend"), pk["tack"])
+            mss_e = s_i64(cg(st, "c_congmss"))
+            flight0 = s_sub(cg(st, "c_sndnxt"), cg(st, "c_snduna"))
+            cw0 = cg(st, "c_cwnd")
+            r_cw = jnp.maximum(flight0 // 2, 2 * mss_e)
+            d_cw = jnp.maximum(
+                cw0 - ((cw0 * cg(st, "c_alpha")) >> (DCTCP_SHIFT + 1)),
+                2 * mss_e)
+            ncw = jnp.where(is_d, d_cw, r_cw)
+            st = cset(st, red,
+                      c_cwnd=jnp.where(red, ncw, cw0),
+                      c_ssthresh=jnp.where(red, ncw,
+                                           cg(st, "c_ssthresh")),
+                      c_cwrend=cg(st, "c_sndnxt"),
+                      c_cwrp=jnp.int32(1))
             # new ack / dupack
             rtx_nonempty = (st["rtx_len"][jnp.clip(st["cur"], 0,
                                                    CC - 1)]
@@ -1309,8 +1410,9 @@ class TcpSpanRunner(SpanMeshMixin):
                       c_cwnd=cg(st, "c_ssthresh"))
             partial = in_rec & ~rec_exit
             st = retransmit_one(st, partial)
-            # reno on_new_ack (not in recovery)
-            plain = new_ack & ~in_rec
+            # reno on_new_ack (not in recovery; an ack that just
+            # triggered the ECN cut must not also grow the window)
+            plain = new_ack & ~in_rec & ~red
             mss_c = s_i64(cg(st, "c_congmss"))
             cwnd = cg(st, "c_cwnd")
             ss = plain & (cwnd < cg(st, "c_ssthresh"))
@@ -1479,7 +1581,8 @@ class TcpSpanRunner(SpanMeshMixin):
             chunk = jnp.minimum(cg(st, "c_sblen"), budget)
             do = can & ~nagle_hold & (chunk > 0)
             st = emit(st, do, cg(st, "c_sndnxt"), chunk,
-                      F_ACK | F_PSH, with_sacks=False, track=True)
+                      F_ACK | F_PSH, with_sacks=False, track=True,
+                      fresh=True)
             st = cset(st, do,
                       c_sblen=cg(st, "c_sblen")
                       - jnp.where(do, chunk, 0),
@@ -1683,7 +1786,7 @@ class TcpSpanRunner(SpanMeshMixin):
                 & (cg(st, "c_sblen") > 0) & ~rtx_ne
             st = emit(st, probe, cg(st, "c_sndnxt"),
                       jnp.ones(H, jnp.int64), F_ACK | F_PSH,
-                      with_sacks=False, track=True)
+                      with_sacks=False, track=True, fresh=True)
             st = cset(st, probe,
                       c_sblen=cg(st, "c_sblen")
                       - jnp.where(probe, 1, 0),
@@ -1786,6 +1889,25 @@ class TcpSpanRunner(SpanMeshMixin):
             st = mark_abort(st, (arr & (st["cq_len"] - st["cq_pos"]
                                         >= CQ - 1)).any(), AB_STRUCT, 11)
             st = dict(st)
+            # DCTCP-K instantaneous marking law (net/codel.py push /
+            # netplane CoDelN::push twins): an ECT(0) arrival meeting
+            # the threshold — queue state BEFORE this enqueue, packets
+            # leg first — is rewritten to CE and enqueued normally.
+            depth = s_i64(st["cq_len"] - st["cq_pos"])
+            ect = arr & (pk_arr["ecn"] == ECN_ECT0)
+            mark_p = ect & (depth >= DCTCP_K_PKTS)
+            mark_b = ect & ~mark_p \
+                & (st["codel_bytes"] >= DCTCP_K_BYTES)
+            mark = mark_p | mark_b
+            st["codel_marked"] = jnp.where(
+                mark, st["codel_marked"] + 1, st["codel_marked"])
+            st["mark_causes"] = st["mark_causes"].at[
+                mrows(mark_p), MARK_THRESH_PKTS].add(1, mode="drop")
+            st["mark_causes"] = st["mark_causes"].at[
+                mrows(mark_b), MARK_THRESH_BYTES].add(1, mode="drop")
+            pk_arr = dict(pk_arr)
+            pk_arr["ecn"] = jnp.where(mark, jnp.int32(ECN_CE),
+                                      pk_arr["ecn"])
             tail = st["cq_len"] % CQ
             rows = mrows(arr)
             for kk in PK_KEYS:
@@ -2079,6 +2201,7 @@ class TcpSpanRunner(SpanMeshMixin):
                         ("sojourn", sojourn),
                         ("qenq", st["codel_enq_pkts"]),
                         ("qdrops", st["codel_dropped"]),
+                        ("qmarks", st["codel_marked"]),
                         ("r1_bal", bucket_peek(1)),
                         ("r1_stalls", s_i64(st["r1_stalls"])),
                         ("r2_bal", bucket_peek(2)),
@@ -2150,9 +2273,9 @@ class TcpSpanRunner(SpanMeshMixin):
                 st["fab_t"] = jnp.zeros(FABR, jnp.int64)
                 st["fab_flags"] = jnp.zeros((FABR, H), jnp.int32)
                 for name in ("qdepth", "qbytes", "sojourn", "qenq",
-                             "qdrops", "r1_bal", "r1_stalls",
-                             "r2_bal", "r2_stalls", "psent", "bsent",
-                             "precv", "brecv"):
+                             "qdrops", "qmarks", "r1_bal",
+                             "r1_stalls", "r2_bal", "r2_stalls",
+                             "psent", "bsent", "precv", "brecv"):
                     st[f"fab_{name}"] = jnp.zeros((FABR, H),
                                                   jnp.int64)
             if tracing:

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 from collections import deque
 
-from shadow_tpu.net.packet import TcpFlags, TcpHeader
+from shadow_tpu.net.packet import ECN_CE, TcpFlags, TcpHeader
 
 # States (ref: src/lib/tcp/src/states.rs explicit state types).
 CLOSED = 0
@@ -92,6 +92,22 @@ TIME_WAIT_NS = 60_000_000_000   # 2 * MSL with MSL=30s
 DUPACK_THRESHOLD = 3
 DELACK_NS = 40_000_000          # Linux TCP_DELACK_MIN
 
+# DCTCP (RFC 8257, Linux tcp_dctcp.c shape; netplane.cpp twins).  All
+# fixed-point so Python/C++/JAX compute the identical alpha: alpha is
+# scaled by 2**DCTCP_SHIFT, the EWMA gain g is 1/2**DCTCP_G_SHIFT
+# (Linux dctcp_shift_g default), and the per-window update is
+#   alpha = min(MAX, alpha - (alpha >> G_SHIFT)
+#               + (ce_bytes << (SHIFT - G_SHIFT)) // max(tot_bytes, 1))
+# with the cwnd reduction on a congestion echo
+#   cwnd = max(cwnd - ((cwnd * alpha) >> (SHIFT + 1)), 2 * mss).
+DCTCP_SHIFT = 10
+DCTCP_G_SHIFT = 4
+DCTCP_MAX_ALPHA = 1024          # == 1 << DCTCP_SHIFT (alpha == 1.0)
+# Congestion-controller ids (per-host `tcp: {cc: ...}` config; the SoA
+# kernel's static c_cc column and the engine's TcpConn::cc use these).
+CC_RENO = 0
+CC_DCTCP = 1
+
 _SEQ_MOD = 1 << 32
 
 
@@ -132,8 +148,38 @@ class RenoCongestion:
         self.ssthresh = max(flight // 2, 2 * self.mss)
         self.cwnd = self.mss
 
+    def on_ecn_reduce(self, flight: int) -> None:
+        """RFC 3168 6.1.2 congestion response to ECE: same multiplica-
+        tive decrease as a fast retransmit, but nothing retransmits."""
+        self.ssthresh = max(flight // 2, 2 * self.mss)
+        self.cwnd = self.ssthresh
 
-CONGESTION_ALGOS = {"reno": RenoCongestion}
+
+class DctcpCongestion(RenoCongestion):
+    """DCTCP (RFC 8257): reno growth, but the ECE response scales the
+    cwnd cut by alpha — the EWMA fraction of acked bytes that carried a
+    congestion echo — instead of halving.  All state is integer
+    fixed-point (alpha scaled by 2**DCTCP_SHIFT) so the engine's
+    TcpConn and the device kernel's conn columns compute bit-identical
+    values.  The window accounting (win_end in sequence space) lives
+    here too; the owning connection feeds it from _on_ack."""
+
+    name = "dctcp"
+
+    def __init__(self, mss: int = MSS):
+        super().__init__(mss)
+        self.alpha = DCTCP_MAX_ALPHA  # start fully conservative
+        self.ce_acked = 0             # echo-marked bytes this window
+        self.tot_acked = 0            # all acked bytes this window
+        self.win_end = 0              # seq: conn sets to iss at birth
+
+    def on_ecn_reduce(self, flight: int) -> None:
+        cut = (self.cwnd * self.alpha) >> (DCTCP_SHIFT + 1)
+        self.cwnd = max(self.cwnd - cut, 2 * self.mss)
+        self.ssthresh = self.cwnd
+
+
+CONGESTION_ALGOS = {"reno": RenoCongestion, "dctcp": DctcpCongestion}
 
 
 def seq_add(a: int, b: int) -> int:
@@ -161,7 +207,7 @@ class TcpConnection:
     def __init__(self, iss: int, recv_buf_max: int = 174_760,
                  send_buf_max: int = 131_072, congestion: str = "reno",
                  delayed_ack: bool = True, nagle: bool = True,
-                 window_ceiling: int | None = None):
+                 window_ceiling: int | None = None, ecn: bool = False):
         self.state = CLOSED
         self.iss = iss % _SEQ_MOD
         # SYN-time scale choice covers the largest window the receive
@@ -214,6 +260,22 @@ class TcpConnection:
         self.dupacks = 0
         self.in_fast_recovery = False
         self.recover = self.iss
+
+        # ECN (RFC 3168; netplane.cpp TcpConn twins).  `ecn_on` is the
+        # per-host config wish; `ecn_active` is negotiated at the
+        # handshake (ECN-setup SYN carries ECE|CWR, the SYN-ACK
+        # answers with ECE).  The receiver latches `ece_latch` on a
+        # CE-marked arrival and echoes ECE on every ACK until a CWR
+        # arrives; the sender reacts to ECE at most once per window
+        # (`ecn_cwr_end`) and announces the cut with CWR on its next
+        # fresh data segment (`cwr_pending`).
+        self.ecn_on = bool(ecn)
+        self.ecn_active = False
+        self.ece_latch = False
+        self.cwr_pending = False
+        self.ecn_cwr_end = self.iss
+        if isinstance(self.cong, DctcpCongestion):
+            self.cong.win_end = self.iss
 
         # RTT/RTO (integer ns, RFC 6298 + RFC 7323 timestamps).  Every
         # segment carries its send time; the receiver echoes the last
@@ -281,10 +343,14 @@ class TcpConnection:
     def open_active(self, now: int) -> None:
         """connect(): emit SYN (states.rs Init->SynSent). The SYN offers
         our MSS and window-scale options (RFC 7323: the scale only
-        activates if the peer's SYN offers one too)."""
+        activates if the peer's SYN offers one too), and — with ecn_on
+        — the RFC 3168 ECN-setup flags ECE|CWR."""
         assert self.state == CLOSED
         self.state = SYN_SENT
-        self._emit(TcpFlags.SYN, seq=self.iss, payload=b"", now=now,
+        flags = TcpFlags.SYN
+        if self.ecn_on:
+            flags |= TcpFlags.ECE | TcpFlags.CWR
+        self._emit(flags, seq=self.iss, payload=b"", now=now,
                    track=True, mss=MSS, window_scale=self._wscale_offer)
         self.snd_nxt = seq_add(self.iss, 1)
 
@@ -417,7 +483,7 @@ class TcpConnection:
         if self.snd_wnd > 0 or not self.send_buf or self.rtx:
             return
         chunk = self._take_from_send_buf(1)
-        self._emit(TcpFlags.ACK | TcpFlags.PSH, seq=self.snd_nxt,
+        self._emit(self._data_flags(), seq=self.snd_nxt,
                    payload=chunk, now=now, track=True)
         self.snd_nxt = seq_add(self.snd_nxt, 1)
         self._fct_touch(1, now, inbound=False)
@@ -460,13 +526,24 @@ class TcpConnection:
     # Packet ingress
     # ------------------------------------------------------------------
 
-    def on_packet(self, hdr: TcpHeader, payload: bytes, now: int) -> None:
+    def on_packet(self, hdr: TcpHeader, payload: bytes, now: int,
+                  ecn: int = 0) -> None:
         self.segments_received += 1
         if self.state == CLOSED:
             return
         if hdr.flags & TcpFlags.RST:
             self._on_rst(hdr)
             return
+        # RFC 3168 receiver: a CWR ends the echo episode, a CE-marked
+        # arrival (re)starts it — in that order, so a segment carrying
+        # both leaves the latch set.  `ecn` is the packet's IP-header
+        # codepoint (the socket layer threads it through; the queues
+        # rewrote ECT(0) to CE when the marking law fired).
+        if self.ecn_active:
+            if hdr.flags & TcpFlags.CWR:
+                self.ece_latch = False
+            if ecn == ECN_CE:
+                self.ece_latch = True
         # RFC 7323 timestamp processing on EVERY segment (ref
         # tcp.c:2356-2358, plus the TS.Recent update rule the RFC adds:
         # only a segment covering the last ack point may update the
@@ -542,6 +619,10 @@ class TcpConnection:
         if hdr.timestamp:
             self._ts_recent = hdr.timestamp  # SYN's value: echo in SYN-ACK
         self.snd_wnd = hdr.window
+        # ECN-setup SYN (RFC 3168 6.1.1): accept iff we want ECN too.
+        self.ecn_active = self.ecn_on and (
+            hdr.flags & (TcpFlags.ECE | TcpFlags.CWR)
+        ) == (TcpFlags.ECE | TcpFlags.CWR)
         self._negotiate_options(hdr)
         self.state = SYN_RECEIVED
         self._emit_synack(now)
@@ -554,12 +635,17 @@ class TcpConnection:
             # congestion state so IW10/ssthresh are sized for the real
             # MSS rather than the 1460-byte default.
             self.cong = type(self.cong)(mss=self.eff_mss)
+            if isinstance(self.cong, DctcpCongestion):
+                self.cong.win_end = self.iss  # nothing acked yet
         if hdr.window_scale is not None:
             self.our_wscale = self._wscale_offer
             self.peer_wscale = min(hdr.window_scale, 14)
 
     def _emit_synack(self, now: int) -> None:
-        self._emit(TcpFlags.SYN | TcpFlags.ACK, seq=self.iss, payload=b"",
+        flags = TcpFlags.SYN | TcpFlags.ACK
+        if self.ecn_active:
+            flags |= TcpFlags.ECE  # ECN-setup SYN-ACK (RFC 3168 6.1.1)
+        self._emit(flags, seq=self.iss, payload=b"",
                    now=now, track=(self.snd_nxt == self.iss), mss=MSS,
                    window_scale=(self._wscale_offer if self.our_wscale
                                  else None))
@@ -581,6 +667,11 @@ class TcpConnection:
                 self._ts_recent = hdr.timestamp
             self.snd_una = hdr.ack
             self.snd_wnd = hdr.window
+            # ECN-setup SYN-ACK carries ECE without CWR (RFC 3168
+            # 6.1.1); anything else leaves the connection not-ECT.
+            self.ecn_active = self.ecn_on \
+                and bool(hdr.flags & TcpFlags.ECE) \
+                and not (hdr.flags & TcpFlags.CWR)
             self._negotiate_options(hdr)
             self._clear_acked()
             self.state = ESTABLISHED
@@ -626,8 +717,41 @@ class TcpConnection:
             self._persist_interval = 0
         if hdr.sack_blocks:
             self._mark_sacked(hdr.sack_blocks)
+        # ECN sender side (RFC 3168 6.1.2 + RFC 8257 3.3), BEFORE the
+        # new-ack/dupack dispatch so snd_una still holds the pre-ack
+        # value — the C++ TcpConn and the SoA kernel mirror this exact
+        # sequence so the arithmetic is bit-identical on every path.
+        ecn_reduced = False
+        if self.ecn_active:
+            ece = bool(hdr.flags & TcpFlags.ECE)
+            if isinstance(self.cong, DctcpCongestion) \
+                    and seq_lt(self.snd_una, ack):
+                c = self.cong
+                acked = seq_sub(ack, self.snd_una)
+                c.tot_acked += acked
+                if ece:
+                    c.ce_acked += acked
+                if seq_lt(c.win_end, ack):
+                    # Window boundary: fold this window's echo fraction
+                    # into alpha (fixed-point EWMA, gain 1/2**G_SHIFT).
+                    c.alpha = min(
+                        DCTCP_MAX_ALPHA,
+                        c.alpha - (c.alpha >> DCTCP_G_SHIFT)
+                        + (c.ce_acked << (DCTCP_SHIFT - DCTCP_G_SHIFT))
+                        // max(c.tot_acked, 1))
+                    c.ce_acked = 0
+                    c.tot_acked = 0
+                    c.win_end = self.snd_nxt
+            if ece and not self.in_fast_recovery \
+                    and seq_lt(self.ecn_cwr_end, ack):
+                # At most one cut per window; announce it with CWR on
+                # the next fresh data segment.
+                self.cong.on_ecn_reduce(self._flight())
+                self.ecn_cwr_end = self.snd_nxt
+                self.cwr_pending = True
+                ecn_reduced = True
         if seq_lt(self.snd_una, ack):
-            self._handle_new_ack(ack, now)
+            self._handle_new_ack(ack, now, ecn_reduced=ecn_reduced)
         elif ack == self.snd_una and self.rtx and is_pure_ack \
                 and not window_changed:
             # RFC 5681: only payload-free, window-unchanged acks count as
@@ -640,7 +764,8 @@ class TcpConnection:
         self._advance_close_states(now)
         self._push_data(now)
 
-    def _handle_new_ack(self, ack: int, now: int) -> None:
+    def _handle_new_ack(self, ack: int, now: int,
+                        ecn_reduced: bool = False) -> None:
         acked = seq_sub(ack, self.snd_una)
         self.snd_una = ack
         self.dupacks = 0
@@ -660,7 +785,9 @@ class TcpConnection:
             else:
                 # Partial ack: retransmit next hole immediately.
                 self._retransmit_one(now)
-        else:
+        elif not ecn_reduced:
+            # An ack that just triggered the ECN cut must not also
+            # grow the window it shrank.
             self.cong.on_new_ack(acked)
         # RTO restart (RFC 6298 5.3).
         self.rto_deadline = (now + self.rto) if self.rtx else None
@@ -899,7 +1026,7 @@ class TcpConnection:
             chunk = self._take_from_send_buf(budget)
             if not chunk:
                 break
-            self._emit(TcpFlags.ACK | TcpFlags.PSH, seq=self.snd_nxt,
+            self._emit(self._data_flags(), seq=self.snd_nxt,
                        payload=chunk, now=now, track=True)
             self.snd_nxt = seq_add(self.snd_nxt, len(chunk))
             self._fct_touch(len(chunk), now, inbound=False)
@@ -930,6 +1057,16 @@ class TcpConnection:
         self.send_buf_len -= len(out)
         return bytes(out)
 
+    def _data_flags(self) -> int:
+        """Flags for a FRESH data segment: ACK|PSH, plus the one-shot
+        CWR announcing a pending ECN window cut (RFC 3168 6.1.2 —
+        never on retransmissions)."""
+        flags = TcpFlags.ACK | TcpFlags.PSH
+        if self.ecn_active and self.cwr_pending:
+            flags |= TcpFlags.CWR
+            self.cwr_pending = False
+        return flags
+
     def _transmit_segment(self, seq: int, payload: bytes, is_fin: bool,
                           now: int) -> None:
         """Retransmission path only — fresh segments go through _emit.
@@ -943,17 +1080,23 @@ class TcpConnection:
             flags |= TcpFlags.FIN
         elif payload == b"" and seq == self.iss:
             # Retransmitted SYN / SYN-ACK must carry the same options as
-            # the original, else a lost SYN-ACK leaves the two sides
-            # disagreeing about window scaling.
+            # the original — window scaling AND the ECN-setup flags —
+            # else a lost SYN-ACK leaves the two sides disagreeing.
             flags = TcpFlags.SYN
             mss = MSS
             window_scale = self._wscale_offer
+            if self.ecn_on:
+                flags |= TcpFlags.ECE | TcpFlags.CWR
             if self.state == SYN_RECEIVED:
                 flags = TcpFlags.SYN | TcpFlags.ACK
+                if self.ecn_active:
+                    flags |= TcpFlags.ECE
                 window_scale = (self._wscale_offer if self.our_wscale
                                 else None)
         elif payload:
             flags |= TcpFlags.PSH
+        if self.ece_latch and not (flags & TcpFlags.SYN):
+            flags |= TcpFlags.ECE  # echo until CWR (RFC 3168 6.1.3)
         self.outbox.append((TcpHeader(
             seq=seq, ack=self.rcv_nxt, flags=flags,
             window=self._wire_window(flags), mss=mss,
@@ -975,6 +1118,8 @@ class TcpConnection:
               track: bool = False, is_fin: bool = False,
               mss: int | None = None,
               window_scale: int | None = None) -> None:
+        if self.ece_latch and not (flags & TcpFlags.SYN):
+            flags |= TcpFlags.ECE  # echo until CWR (RFC 3168 6.1.3)
         ack = self.rcv_nxt if (flags & TcpFlags.ACK) else 0
         self.outbox.append((TcpHeader(
             seq=seq, ack=ack, flags=flags, window=self._wire_window(flags),
@@ -996,8 +1141,11 @@ class TcpConnection:
         self._delack_deadline = None
 
     def _emit_ack(self, now: int) -> None:
+        flags = TcpFlags.ACK
+        if self.ece_latch:
+            flags |= TcpFlags.ECE  # echo until CWR (RFC 3168 6.1.3)
         self.outbox.append((TcpHeader(
-            seq=self.snd_nxt, ack=self.rcv_nxt, flags=TcpFlags.ACK,
+            seq=self.snd_nxt, ack=self.rcv_nxt, flags=flags,
             window=self._wire_window(TcpFlags.ACK),
             sack_blocks=self._sack_blocks(),
             timestamp=now + 1,

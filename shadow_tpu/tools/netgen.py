@@ -80,6 +80,20 @@ def _indent(text: str, pad: str) -> str:
     return "\n".join(pad + line for line in text.splitlines())
 
 
+def _tcp_line(tcp: dict | None) -> str:
+    """One per-host `tcp:` block line (or nothing): every TCP
+    generator threads this through so any workload can run under
+    either congestion controller — e.g. tcp={"cc": "dctcp",
+    "ecn": "on"}."""
+    if not tcp:
+        return ""
+    cc = tcp.get("cc", "reno")
+    ecn = tcp.get("ecn", "off")
+    if isinstance(ecn, bool):
+        ecn = "on" if ecn else "off"
+    return f"    tcp: {{ cc: {cc}, ecn: {ecn} }}\n"
+
+
 def udp_mesh_yaml(n_hosts: int, n_nodes: int = 8, floods_per_host: int = 3,
                   count: int = 6, size: int = 600, stop_time: str = "10s",
                   seed: int = 1, scheduler: str = "serial",
@@ -218,7 +232,8 @@ def tcp_stream_yaml(n_hosts: int, n_servers: int | None = None,
                     latency: str = "10 ms", bw_down: str = "50 Mbit",
                     bw_up: str = "50 Mbit", stop_time: str = "4s",
                     seed: int = 11, scheduler: str = "serial",
-                    device_spans: str | None = None) -> str:
+                    device_spans: str | None = None,
+                    tcp: dict | None = None) -> str:
     """Fixed-connection TCP streaming tier: every client opens ONE
     connection (count=1, synchronized starts, no accept churn) and the
     transfer is sized to still be streaming at stop_time — so after the
@@ -235,16 +250,17 @@ def tcp_stream_yaml(n_hosts: int, n_servers: int | None = None,
     gml = (f'graph [ node [ id 0 host_bandwidth_down "{bw_down}" '
            f'host_bandwidth_up "{bw_up}" ] '
            f'edge [ source 0 target 0 latency "{latency}"{loss_s} ] ]')
+    tl = _tcp_line(tcp)
     blocks = []
     for name in names:
         blocks.append(
-            f"  {name}:\n    network_node_id: 0\n    processes:\n"
+            f"  {name}:\n    network_node_id: 0\n{tl}    processes:\n"
             f'      - {{ path: tgen-server, args: ["8080"], '
             f"expected_final_state: running }}")
     for i in range(n_hosts - n_servers):
         server = names[i % n_servers]
         blocks.append(
-            f"  cli{i:04d}:\n    network_node_id: 0\n    processes:\n"
+            f"  cli{i:04d}:\n    network_node_id: 0\n{tl}    processes:\n"
             f'      - {{ path: tgen-client, '
             f'args: [{server}, "8080", "{nbytes}", "1"], '
             f"start_time: 100ms, expected_final_state: running }}")
@@ -264,7 +280,8 @@ def incast_yaml(fan_in: int, nbytes: int = 500_000,
                 server_bw: str = "20 Mbit", client_bw: str = "100 Mbit",
                 latency: str = "2 ms", stop_time: str = "3s",
                 seed: int = 17, scheduler: str = "serial",
-                device_spans: str | None = None) -> str:
+                device_spans: str | None = None,
+                tcp: dict | None = None) -> str:
     """Minimal N->1 fan-in (incast): ONE sink host runs `fan_in`
     tgen-client downloads — one from each of `fan_in` source servers —
     all opened at the SAME instant, with the sink's downlink as the
@@ -272,10 +289,13 @@ def incast_yaml(fan_in: int, nbytes: int = 500_000,
     inbound router queue: the canonical queue-buildup smoke for the
     fabric observatory (CoDel depth climbs, head sojourn crosses the
     5 ms target, the control law drops, and every drop must reconcile
-    in the byte-conservation sweep).  The full datacenter scenario
-    pack stays ROADMAP item 3; this is just the stressor the fabric
-    channel's conservation gate runs against
-    (tests/test_fabricstat.py, `trace fabric`)."""
+    in the byte-conservation sweep).  Thread tcp={"cc": "dctcp",
+    "ecn": "on"} and the sink's queue MARKS instead: the
+    `bench[incast-ecn-32]` rung runs exactly that side by side with
+    this drop-based shape.  The rest of the datacenter pack lives in
+    leaf_spine_yaml / rpc_burst_yaml below; this remains the stressor
+    the fabric channel's conservation gate runs against
+    (tests/test_fabricstat.py, tests/test_dctcp.py, `trace fabric`)."""
     gml_lines = ["graph [ directed 0",
                  f'  node [ id 0 host_bandwidth_down "{server_bw}" '
                  f'host_bandwidth_up "{server_bw}" ]',
@@ -292,11 +312,12 @@ def incast_yaml(fan_in: int, nbytes: int = 500_000,
             f'      - {{ path: tgen-client, '
             f'args: [src{i:03d}, "8080", "{nbytes}", "1"], '
             f"start_time: 100ms, expected_final_state: any }}")
-    blocks = ["  sink:\n    network_node_id: 0\n    processes:\n"
+    tl = _tcp_line(tcp)
+    blocks = [f"  sink:\n    network_node_id: 0\n{tl}    processes:\n"
               + "\n".join(sink_procs)]
     for i in range(fan_in):
         blocks.append(
-            f"  src{i:03d}:\n    network_node_id: 1\n    processes:\n"
+            f"  src{i:03d}:\n    network_node_id: 1\n{tl}    processes:\n"
             f'      - {{ path: tgen-server, args: ["8080"], '
             f"expected_final_state: running }}")
     exp = [f"  scheduler: {scheduler}",
@@ -317,7 +338,8 @@ def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
                    scheduler: str = "serial",
                    experimental_extra: dict | None = None,
                    n_core: int = 4, n_mid: int = 8,
-                   n_leaf: int = 40) -> str:
+                   n_leaf: int = 40,
+                   tcp: dict | None = None) -> str:
     """BASELINE config 3: tgen-style TCP transfers on the 3-tier graph.
     Servers live on mid-tier nodes; clients on leaves download
     `count` x `nbytes` from a deterministic server choice."""
@@ -329,10 +351,11 @@ def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
         exp_lines.append(f"  {k}: {v}")
     blocks = []
     server_names = [f"server{i:03d}" for i in range(n_servers)]
+    tl = _tcp_line(tcp)
     for i, name in enumerate(server_names):
         blocks.append(
             f"  {name}:\n    network_node_id: {n_core + (i % n_mid)}\n"
-            f"    processes:\n"
+            f"{tl}    processes:\n"
             f'      - {{ path: tgen-server, args: ["8080"], '
             f'expected_final_state: running }}')
     n_clients = n_hosts - n_servers
@@ -343,7 +366,7 @@ def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
         start_ms = 1000 + (i * 37) % 5000
         blocks.append(
             f"  {name}:\n    network_node_id: {node}\n"
-            f"    processes:\n"
+            f"{tl}    processes:\n"
             f'      - {{ path: tgen-client, '
             f'args: [{server}, "8080", "{nbytes}", "{count}"], '
             f'start_time: {start_ms} ms }}')
@@ -351,4 +374,162 @@ def tgen_tier_yaml(n_hosts: int, n_servers: int | None = None,
             f"network:\n  graph:\n    type: gml\n    inline: |\n"
             f"{_indent(gml, '      ')}\n"
             f"experimental:\n" + "\n".join(exp_lines) + "\n"
+            f"hosts:\n" + "\n".join(blocks) + "\n")
+
+
+def leaf_spine_gml(n_leaf: int = 4, n_spine: int = 2,
+                   spine_latency_us: int = 40,
+                   rack_latency_us: int = 10,
+                   leaf_bw: str = "1 Gbit",
+                   spine_bw: str = "10 Gbit") -> str:
+    """k-ary leaf-spine fabric on the existing graph/router layers:
+    spine nodes first, then leaf (ToR) nodes, every leaf uplinked to
+    every spine.  ECMP is modeled the way a hashed fabric behaves
+    under shortest-path routing: each leaf->spine uplink's latency is
+    perturbed by a small deterministic per-(leaf, spine) hash (sub-
+    microsecond scale), so Dijkstra resolves each leaf PAIR onto the
+    hash-minimal spine — flows spread across spines exactly like a
+    5-tuple hash spreads them, and the choice is config-deterministic
+    on every path.  Hosts attach to leaf nodes only."""
+    lines = ["graph [ directed 0"]
+    spines = list(range(n_spine))
+    leaves = [n_spine + i for i in range(n_leaf)]
+    for s in spines:
+        lines.append(f'  node [ id {s} host_bandwidth_down "{spine_bw}" '
+                     f'host_bandwidth_up "{spine_bw}" ]')
+    for lf in leaves:
+        lines.append(f'  node [ id {lf} host_bandwidth_down "{leaf_bw}" '
+                     f'host_bandwidth_up "{leaf_bw}" ]')
+    for lf in leaves:
+        # intra-rack hop (host -> ToR -> host)
+        lines.append(f'  edge [ source {lf} target {lf} '
+                     f'latency "{rack_latency_us} us" ]')
+    for i, lf in enumerate(leaves):
+        for s in spines:
+            # ECMP hash perturbation: 100 ns granularity, < 1 us total
+            jitter = (i * 131 + s * 241) % 8
+            lat_ns = spine_latency_us * 1000 + jitter * 100
+            lines.append(f'  edge [ source {lf} target {s} '
+                         f'latency "{lat_ns} ns" ]')
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def leaf_spine_yaml(n_leaf: int = 4, hosts_per_leaf: int = 4,
+                    n_spine: int = 2, nbytes: int = 1_000_000,
+                    count: int = 2, leaf_bw: str = "1 Gbit",
+                    stop_time: str = "5s", seed: int = 23,
+                    scheduler: str = "serial",
+                    device_spans: str | None = None,
+                    tcp: dict | None = None) -> str:
+    """Cross-rack traffic on the ECMP-hashed leaf-spine fabric: the
+    first host of every rack runs a tgen-server, every other host
+    downloads from a deterministically-chosen server in a DIFFERENT
+    rack — all flows cross the spine, so per-pair spine selection (the
+    hash-perturbed shortest path) and the receiving racks' inbound
+    queues carry the load.  Thread tcp={"cc": "dctcp", "ecn": "on"}
+    to run the fabric under DCTCP."""
+    if n_leaf < 2:
+        raise ValueError("leaf_spine_yaml needs n_leaf >= 2 (every "
+                         "client downloads cross-rack)")
+    gml = leaf_spine_gml(n_leaf=n_leaf, n_spine=n_spine,
+                         leaf_bw=leaf_bw)
+    tl = _tcp_line(tcp)
+    blocks = []
+    for leaf in range(n_leaf):
+        node = n_spine + leaf
+        for i in range(hosts_per_leaf):
+            name = f"r{leaf:02d}h{i:02d}"
+            if i == 0:
+                blocks.append(
+                    f"  {name}:\n    network_node_id: {node}\n"
+                    f"{tl}    processes:\n"
+                    f'      - {{ path: tgen-server, args: ["8080"], '
+                    f"expected_final_state: running }}")
+            else:
+                peer_leaf = (leaf + i) % n_leaf
+                if peer_leaf == leaf:
+                    peer_leaf = (leaf + 1) % n_leaf
+                server = f"r{peer_leaf:02d}h00"
+                start_ms = 100 + ((leaf * 37 + i * 13) % 50)
+                blocks.append(
+                    f"  {name}:\n    network_node_id: {node}\n"
+                    f"{tl}    processes:\n"
+                    f'      - {{ path: tgen-client, '
+                    f'args: [{server}, "8080", "{nbytes}", "{count}"], '
+                    f"start_time: {start_ms} ms, "
+                    f"expected_final_state: any }}")
+    exp = [f"  scheduler: {scheduler}",
+           "  socket_send_autotune: false",
+           "  socket_recv_autotune: false"]
+    if device_spans is not None:
+        exp.append(f"  tpu_device_spans: {device_spans}")
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+            f"network:\n  graph:\n    type: gml\n    inline: |\n"
+            f"{_indent(gml, '      ')}\n"
+            f"experimental:\n" + "\n".join(exp) + "\n"
+            f"hosts:\n" + "\n".join(blocks) + "\n")
+
+
+def rpc_burst_yaml(n_clients: int = 8, n_servers: int = 2,
+                   nbytes: int = 20_000, bursts: int = 4,
+                   burst_interval_ms: int = 250, count: int = 4,
+                   server_bw: str = "50 Mbit",
+                   client_bw: str = "100 Mbit",
+                   latency: str = "1 ms", stop_time: str = "3s",
+                   seed: int = 31, scheduler: str = "serial",
+                   device_spans: str | None = None,
+                   tcp: dict | None = None) -> str:
+    """Open-loop bursty request/response traffic: every client host
+    runs one tgen-client PROCESS PER BURST — process b starts at the
+    b-th burst instant regardless of whether earlier transfers
+    finished (that is what makes the load open-loop rather than a
+    closed request loop), and each process issues `count` short
+    `nbytes` responses back-to-back.  Whole bursts land on the
+    servers' downlinks at the same instant, so the per-burst queue
+    excursions — and, under tcp={"cc": "dctcp", "ecn": "on"}, the
+    CE-mark episodes — are sharply separated in the fabric channel."""
+    gml_lines = ["graph [ directed 0",
+                 f'  node [ id 0 host_bandwidth_down "{server_bw}" '
+                 f'host_bandwidth_up "{server_bw}" ]',
+                 f'  node [ id 1 host_bandwidth_down "{client_bw}" '
+                 f'host_bandwidth_up "{client_bw}" ]',
+                 f'  edge [ source 0 target 0 latency "{latency}" ]',
+                 f'  edge [ source 1 target 1 latency "{latency}" ]',
+                 f'  edge [ source 0 target 1 latency "{latency}" ]',
+                 "]"]
+    gml = "\n".join(gml_lines)
+    tl = _tcp_line(tcp)
+    blocks = []
+    for s in range(n_servers):
+        blocks.append(
+            f"  rpcsrv{s:02d}:\n    network_node_id: 0\n"
+            f"{tl}    processes:\n"
+            f'      - {{ path: tgen-server, args: ["8080"], '
+            f"expected_final_state: running }}")
+    for c in range(n_clients):
+        server = f"rpcsrv{c % n_servers:02d}"
+        procs = []
+        for b in range(bursts):
+            # sub-ms stagger inside a burst keeps ISS draws ordered
+            # but the burst's flows land within one RTT of each other
+            start_ms = 100 + b * burst_interval_ms
+            start_us = (c * 73) % 500
+            procs.append(
+                f'      - {{ path: tgen-client, '
+                f'args: [{server}, "8080", "{nbytes}", "{count}"], '
+                f"start_time: {start_ms * 1000 + start_us} us, "
+                f"expected_final_state: any }}")
+        blocks.append(
+            f"  rpccli{c:03d}:\n    network_node_id: 1\n"
+            f"{tl}    processes:\n" + "\n".join(procs))
+    exp = [f"  scheduler: {scheduler}",
+           "  socket_send_autotune: false",
+           "  socket_recv_autotune: false"]
+    if device_spans is not None:
+        exp.append(f"  tpu_device_spans: {device_spans}")
+    return (f"general: {{ stop_time: {stop_time}, seed: {seed} }}\n"
+            f"network:\n  graph:\n    type: gml\n    inline: |\n"
+            f"{_indent(gml, '      ')}\n"
+            f"experimental:\n" + "\n".join(exp) + "\n"
             f"hosts:\n" + "\n".join(blocks) + "\n")

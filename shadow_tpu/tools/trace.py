@@ -261,6 +261,9 @@ def fabric_report(data_dir: str, top_n: int = 10, out=None) -> bool:
                 "refill_stalls", "marked_pkts"):
         if key in fab:
             print(f"  {key:<18} {fab[key]:>14}", file=out)
+    marks = fab.get("marks") or {}
+    for cause, n in sorted(marks.items()):
+        print(f"    mark:{cause:<12} {n:>14}", file=out)
     ok = viol == 0
     if viol is None:
         print("  (no fabric block in sim-stats.json — pre-fabric "
@@ -288,7 +291,8 @@ def fabric_report(data_dir: str, top_n: int = 10, out=None) -> bool:
     ranked = top_by_peak_depth(by_host, top_n)
     print(f"top {len(ranked)} links by peak queue depth:", file=out)
     print(f"  {'link':<8} {'peak q':>7} {'max soj ms':>11} "
-          f"{'drops':>7} {'stalls':>7} {'util %':>7}", file=out)
+          f"{'drops':>7} {'marks':>7} {'stalls':>7} {'util %':>7}",
+          file=out)
     cfg = _processed_config(data_dir)
     names = _host_names(cfg)
     bw_up = _host_bw_table(cfg, names)
@@ -303,7 +307,8 @@ def fabric_report(data_dir: str, top_n: int = 10, out=None) -> bool:
                 if end_ns and bw else f"{'-':>7}")
         label = names[host] if 0 <= host < len(names) else f"h{host}"
         print(f"  {label:<8.8} {peak:>7} {soj:>11.2f} "
-              f"{last[7]:>7} {stalls:>7} {util}", file=out)
+              f"{last[7]:>7} {last[8]:>7} {stalls:>7} {util}",
+              file=out)
     return ok
 
 

@@ -182,6 +182,25 @@ TEL_BY_REASON = {
     "link-down": TEL_LINK_DOWN,
 }
 
+# ECN mark attribution (C++ twins: the MARK_* enum + MARK_NAMES table
+# in netplane.cpp; registered fail-closed in analysis pass 1 like
+# TEL_*).  Every CE rewrite by a queue's marking law is attributed to
+# EXACTLY ONE cause — the leg of the DCTCP-K instantaneous threshold
+# that fired (packets checked first) — so the per-cause counters
+# provably sum to the fabric ledger's marked_pkts total.  Marked
+# packets still FORWARD: they sit on the delivered side of the
+# byte-conservation invariant, never the dropped side.
+MARK_THRESH_PKTS = 0   # queue depth >= DCTCP_K_PKTS at enqueue
+MARK_THRESH_BYTES = 1  # queued bytes >= DCTCP_K_BYTES at enqueue
+MARK_N = 2
+
+# Order mirrors the MARK_* values above AND the C++ MARK_NAMES table.
+MARK_NAMES = (
+    "dctcp-k-pkts",
+    "dctcp-k-bytes",
+)
+assert len(MARK_NAMES) == MARK_N
+
 # Per-connection telemetry record (TEL_REC_BYTES, little-endian, no
 # padding; C++ twin: struct TelRec):
 #
@@ -303,8 +322,9 @@ FB_ACT_LINK = 8     # the eth link has ever forwarded a packet
 #     int64[14]         qdepth (CoDel packets), qbytes, sojourn
 #                       (head-of-queue wait ns), qenq (cumulative push
 #                       attempts), qdrops (cumulative CoDel+hard-limit
-#                       drops), qmarks (cumulative ECN marks — 0 until
-#                       DCTCP lands, the slot is ECN-ready),
+#                       drops), qmarks (cumulative CE marks by the
+#                       DCTCP-K threshold law — live on all three
+#                       paths; by-cause split in the MARK_* counters),
 #                       r1_bal / r1_stalls (inet-out bucket balance at
 #                       the boundary / cumulative refill stalls),
 #                       r2_bal / r2_stalls (inet-in twin),

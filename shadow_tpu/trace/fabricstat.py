@@ -188,8 +188,9 @@ def emit_device_rows(channel, st_np, n_hosts: int) -> None:
     from ops/tcp_span.py or ops/phold_span.py) into FB_REC records and
     append them to `channel`.  Per sampled round, ACTIVE hosts
     (flags != 0) in ascending host-id order — byte-identical to the
-    engine ring's records for the same rounds.  `qmarks` is packed as
-    0: the kernels carry no ECN-mark column until DCTCP lands."""
+    engine ring's records for the same rounds.  `qmarks` samples the
+    kernels' live codel_marked column (the DCTCP-K marking law runs
+    inside each span's enqueue micro-op)."""
     if channel is None:
         return
     import numpy as np
@@ -209,8 +210,8 @@ def emit_device_rows(channel, st_np, n_hosts: int) -> None:
     arr["host"] = np.tile(np.arange(n_hosts, dtype=np.int32), fn)[sel]
     arr["flags"] = flags.reshape(-1)[sel]
     for name in ("qdepth", "qbytes", "sojourn", "qenq", "qdrops",
-                 "r1_bal", "r1_stalls", "r2_bal", "r2_stalls",
-                 "psent", "bsent", "precv", "brecv"):
+                 "qmarks", "r1_bal", "r1_stalls", "r2_bal",
+                 "r2_stalls", "psent", "bsent", "precv", "brecv"):
         arr[name] = np.asarray(st_np[f"fab_{name}"][:fn],
                                dtype=np.int64).reshape(-1)[sel]
     channel.extend(arr.tobytes())

@@ -1,0 +1,371 @@
+"""DCTCP / ECN congestion-control subsystem gates (ISSUE 10;
+docs/PARITY.md "DCTCP / ECN").
+
+Three layers:
+- sans-I/O unit gates on the RFC 3168 echo state machine and the
+  DCTCP fixed-point alpha EWMA (pure `tcp/connection.py`, no sim);
+- cross-SCHEDULER byte-identity of `fabric-sim.bin` /
+  `telemetry-sim.bin` / the packet trace on a `cc: dctcp` incast —
+  the serial object path, the threaded object path and the tpu
+  scheduler's C++ engine must mark the same packets CE and react
+  identically, with nonzero marks and exact drop+mark conservation;
+- (slow) the forced-device TCP span differential with the ECN columns
+  live: marking decided INSIDE the device loop, byte-identical to
+  serial.
+"""
+
+import os
+
+import pytest
+
+from shadow_tpu.net.packet import ECN_CE, ECN_ECT0, TcpFlags
+from shadow_tpu.tcp import connection as tc
+
+TCP_DCTCP = {"cc": "dctcp", "ecn": "on"}
+
+
+# ---------------------------------------------------------------------
+# sans-I/O unit gates
+# ---------------------------------------------------------------------
+
+def _handshake(a_kw=None, b_kw=None):
+    """Active opener `a` <-> passive `b`, fully established."""
+    a = tc.TcpConnection(iss=1000, **(a_kw or {}))
+    b = tc.TcpConnection(iss=5000, **(b_kw or {}))
+    a.open_active(0)
+    syn, _ = a.outbox.popleft()
+    b.accept_syn(syn, 0)
+    _shuttle(b, a, 0)
+    _shuttle(a, b, 0)
+    assert a.state == tc.ESTABLISHED and b.state == tc.ESTABLISHED
+    return a, b
+
+
+def _shuttle(src, dst, now, mark=False):
+    """Deliver src's outbox to dst, stamping the IP ECN codepoint the
+    way the socket layer + a marking queue would."""
+    n = 0
+    while src.outbox:
+        hdr, payload = src.outbox.popleft()
+        ecn = ECN_ECT0 if (src.ecn_active and payload) else 0
+        if ecn and mark:
+            ecn = ECN_CE
+        dst.on_packet(hdr, payload, now, ecn=ecn)
+        n += 1
+    return n
+
+
+def test_ecn_negotiation():
+    """ECN-setup SYN carries ECE|CWR, the SYN-ACK answers with bare
+    ECE, and the capability activates only when BOTH ends opt in."""
+    a = tc.TcpConnection(iss=1, ecn=True)
+    a.open_active(0)
+    syn, _ = a.outbox[0]
+    assert syn.flags & TcpFlags.ECE and syn.flags & TcpFlags.CWR
+    a, b = _handshake({"ecn": True}, {"ecn": True})
+    assert a.ecn_active and b.ecn_active
+    for akw, bkw in (({"ecn": True}, {}), ({}, {"ecn": True}), ({}, {})):
+        a, b = _handshake(akw, bkw)
+        assert not a.ecn_active and not b.ecn_active
+
+
+def test_rfc3168_echo_and_single_reduction():
+    """CE latches ECE on every ACK until CWR; the sender cuts cwnd at
+    most once per window and announces it with CWR on fresh data."""
+    a, b = _handshake({"ecn": True, "congestion": "reno"},
+                      {"ecn": True, "congestion": "reno"})
+    cw0 = a.cwnd
+    a.write(b"D" * 8192, 100)
+    _shuttle(a, b, 200, mark=True)   # every data segment CE-marked
+    assert b.ece_latch
+    # b's acks carry ECE; a reduces once and schedules CWR
+    acks = list(b.outbox)
+    assert all(h.flags & TcpFlags.ECE for h, _ in acks)
+    # deliver the ECE acks one by one: ssthresh moves exactly once
+    # (every ack's number sits inside the one cwr_end episode)
+    cuts, prev_ss = 0, a.ssthresh
+    while b.outbox:
+        hdr, p = b.outbox.popleft()
+        a.on_packet(hdr, p, 200)
+        if a.ssthresh != prev_ss:
+            cuts, prev_ss = cuts + 1, a.ssthresh
+    assert cuts == 1, "exactly one reduction per window"
+    assert a.ssthresh < cw0, "ECE must cut the window"
+    a.write(b"D" * 1460, 300)
+    sent = list(a.outbox)
+    assert any(h.flags & TcpFlags.CWR for h, _ in sent), \
+        "first fresh data after the cut must carry CWR"
+    _shuttle(a, b, 400)
+    # CWR cleared the receiver's latch: unmarked data -> clean acks
+    assert not b.ece_latch
+    a.write(b"D" * 1460, 500)
+    _shuttle(a, b, 600)
+    assert not b.ece_latch
+    assert all(not (h.flags & TcpFlags.ECE) for h, _ in b.outbox)
+
+
+def test_ecn_off_ignores_marks():
+    """A non-negotiated connection never echoes or reacts — CE on the
+    wire (misconfigured middlebox) is inert."""
+    a, b = _handshake({}, {})
+    cw0 = a.cwnd
+    a.write(b"D" * 4096, 100)
+    while a.outbox:
+        hdr, payload = a.outbox.popleft()
+        b.on_packet(hdr, payload, 200, ecn=ECN_CE)
+    assert not b.ece_latch
+    _shuttle(b, a, 200)
+    assert a.cwnd >= cw0
+
+
+def test_dctcp_alpha_fixed_point():
+    """The alpha EWMA recurrence, bit-for-bit: the same integer
+    arithmetic the C++ engine and the device kernel run (a drifted
+    shift is also caught by analysis pass 1's twin check)."""
+    c = tc.DctcpCongestion()
+    assert c.alpha == tc.DCTCP_MAX_ALPHA
+    # fully-marked window keeps alpha at MAX
+    alpha = c.alpha
+    for ce, tot, want in (
+            (1000, 1000, 1024),  # all marked: stays saturated
+            (0, 1000, 960),      # clean window: decays by 1/16
+            (0, 1000, 900),      # 960 - 60
+            (500, 1000, 876)):   # 900 - 56 + (500<<6)//1000 = 876
+        alpha = min(tc.DCTCP_MAX_ALPHA,
+                    alpha - (alpha >> tc.DCTCP_G_SHIFT)
+                    + (ce << (tc.DCTCP_SHIFT - tc.DCTCP_G_SHIFT))
+                    // max(tot, 1))
+        assert alpha == int(want), (ce, tot, alpha)
+    # the reduction scales by alpha/2 with a 2*MSS floor
+    c.alpha = 512  # 0.5
+    c.cwnd = 100_000
+    c.on_ecn_reduce(flight=0)
+    assert c.cwnd == 100_000 - (100_000 * 512 >> 11) == 75_000
+    c.cwnd = 1000
+    c.on_ecn_reduce(flight=0)
+    assert c.cwnd == 2 * c.mss
+
+
+def test_dctcp_sender_counts_marked_bytes():
+    """End-to-end alpha on a live pair: marked data -> ECE-echoing
+    acks -> the sender's window accounting reduces alpha's distance
+    from the observed mark fraction."""
+    a, b = _handshake({"ecn": True, "congestion": "dctcp"},
+                      {"ecn": True, "congestion": "dctcp"})
+    assert isinstance(a.cong, tc.DctcpCongestion)
+    a.write(b"D" * 4096, 100)
+    _shuttle(a, b, 200, mark=True)
+    _shuttle(b, a, 300)
+    # everything acked carried an echo: alpha stays saturated and the
+    # cut used it
+    assert a.cong.alpha == tc.DCTCP_MAX_ALPHA
+    assert a.cwr_pending or a.ecn_cwr_end != a.iss
+
+
+# ---------------------------------------------------------------------
+# cross-scheduler byte-identity (the tier-1 acceptance leg)
+# ---------------------------------------------------------------------
+
+def _run_incast(tmp_path, name, scheduler, tcp, parallelism=1):
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools.netgen import incast_yaml
+
+    data = str(tmp_path / name)
+    text = incast_yaml(8, nbytes=300_000, stop_time="1500ms",
+                       scheduler=scheduler, tcp=tcp)
+    text = text.replace(
+        "experimental:",
+        "experimental:\n  sim_netstat: \"on\"\n"
+        "  sim_fabricstat: \"on\"")
+    cfg = ConfigOptions.from_yaml_text(text)
+    cfg.general.data_directory = data
+    cfg.general.parallelism = parallelism
+    manager, summary = run_simulation(cfg, write_data=True)
+    assert summary.ok, summary.plugin_errors
+    return data, manager
+
+
+def test_dctcp_identical_across_schedulers(tmp_path):
+    """With `cc: dctcp` on the incast fan-in, the marking law and the
+    alpha reaction are pure functions of simulation state: marks are
+    NONZERO and `fabric-sim.bin` / `telemetry-sim.bin` / the packet
+    trace are byte-identical across the serial object path, the
+    threaded object path and the tpu scheduler's C++ engine, with
+    drop+mark conservation exact on each."""
+    datas = {}
+    managers = {}
+    for sched, par in (("serial", 1), ("thread_per_core", 2),
+                       ("tpu", 1)):
+        datas[sched], managers[sched] = _run_incast(
+            tmp_path, f"dc-{sched}", sched, TCP_DCTCP,
+            parallelism=par)
+    blobs = {}
+    for sched, data in datas.items():
+        b = {}
+        for fn in ("fabric-sim.bin", "telemetry-sim.bin",
+                   "packet-trace.txt"):
+            with open(os.path.join(data, fn), "rb") as f:
+                b[fn] = f.read()
+        blobs[sched] = b
+    cons0 = managers["serial"].fabric_conservation()
+    assert cons0["marked_pkts"] > 0, "marking law never fired"
+    assert cons0["marks"], "marks not attributed to a MARK_* cause"
+    for sched in ("thread_per_core", "tpu"):
+        for fn, ref in blobs["serial"].items():
+            assert blobs[sched][fn] == ref, \
+                f"{fn} diverged on {sched}"
+        cons = managers[sched].fabric_conservation()
+        assert cons == cons0, f"conservation ledger diverged on {sched}"
+    assert cons0["violations"] == 0
+
+
+def test_dctcp_mixed_plane_identical(tmp_path):
+    """Cross-plane seam gate: with one host pinned to the pure-Python
+    object path inside a tpu-scheduled sim, the ECN codepoint must
+    survive the engine<->object packet conversion in BOTH directions
+    (ops/propagate.py packet_fields/intern_packet) — the mixed run's
+    packet trace and conservation ledger stay identical to the
+    all-serial reference.  (fabric-sim.bin is NOT compared here: a
+    pinned object host subdivides conservative windows differently,
+    which changes the sampling CADENCE — a pre-existing mixed-plane
+    property independent of ECN, observed with cc: reno too.  A lost
+    ECN codepoint would diverge the packet trace itself: the receiver
+    would never echo, the sender never cut, marks never reconcile.)"""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools.netgen import incast_yaml
+
+    def run(name, sched, pin_sink):
+        data = str(tmp_path / name)
+        text = incast_yaml(6, nbytes=250_000, stop_time="1200ms",
+                           scheduler=sched, tcp=TCP_DCTCP)
+        text = text.replace(
+            "experimental:",
+            "experimental:\n  sim_fabricstat: \"on\"")
+        if pin_sink:
+            # the sink — the marking queue's owner — on the object
+            # path, every source on the engine
+            text = text.replace(
+                "  sink:\n    network_node_id: 0\n",
+                "  sink:\n    network_node_id: 0\n"
+                "    native_dataplane: false\n")
+        cfg = ConfigOptions.from_yaml_text(text)
+        cfg.general.data_directory = data
+        manager, summary = run_simulation(cfg, write_data=True)
+        assert summary.ok, summary.plugin_errors
+        return data, manager
+
+    d_ser, m_ser = run("mx-ser", "serial", False)
+    d_mix, m_mix = run("mx-mix", "tpu", True)
+    with open(os.path.join(d_ser, "packet-trace.txt"), "rb") as f:
+        ref = f.read()
+    with open(os.path.join(d_mix, "packet-trace.txt"), "rb") as f:
+        assert f.read() == ref, \
+            "packet trace diverged on the mixed plane"
+    cons = m_ser.fabric_conservation()
+    assert cons["marked_pkts"] > 0
+    assert m_mix.fabric_conservation() == cons
+
+
+def test_reno_ecn_marks_and_conserves(tmp_path):
+    """reno+ECN (cc: reno, ecn: on) also marks and conserves — the
+    echo machinery is controller-independent."""
+    _data, mgr = _run_incast(tmp_path, "re-ser", "serial",
+                             {"cc": "reno", "ecn": "on"})
+    cons = mgr.fabric_conservation()
+    assert cons["marked_pkts"] > 0
+    assert cons["violations"] == 0
+
+
+def test_config_tcp_block_validation():
+    """`tcp:` block parsing: spellings, refusals, round-trip."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.tools.netgen import incast_yaml
+
+    cfg = ConfigOptions.from_yaml_text(
+        incast_yaml(2, tcp=TCP_DCTCP))
+    for h in cfg.hosts.values():
+        assert h.tcp_cc == "dctcp" and h.tcp_ecn is True
+    # processed-config round trip preserves the block
+    import yaml
+    text = yaml.safe_dump(cfg.to_processed_dict())
+    cfg2 = ConfigOptions.from_yaml_text(text)
+    for h in cfg2.hosts.values():
+        assert h.tcp_cc == "dctcp" and h.tcp_ecn is True
+    # dctcp without ecn is refused (degenerates to reno silently)
+    with pytest.raises(ValueError, match="requires ecn"):
+        ConfigOptions.from_yaml_text(
+            incast_yaml(2, tcp={"cc": "dctcp", "ecn": "off"}))
+    # unknown keys / values fail loudly
+    with pytest.raises(ValueError, match="tcp.cc"):
+        ConfigOptions.from_yaml_text(
+            incast_yaml(2, tcp={"cc": "cubic", "ecn": "on"}))
+
+
+def test_datacenter_generators_run(tmp_path):
+    """The scenario pack: the ECMP-hashed leaf-spine fabric and the
+    open-loop RPC burst generator both run under DCTCP with exact
+    conservation (leaf-spine cross-rack fan-in actually marks)."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools.netgen import leaf_spine_yaml, rpc_burst_yaml
+
+    cfg = ConfigOptions.from_yaml_text(leaf_spine_yaml(
+        n_leaf=4, hosts_per_leaf=3, stop_time="2s",
+        scheduler="serial", tcp=TCP_DCTCP))
+    mgr, summary = run_simulation(cfg)
+    assert summary.ok, summary.plugin_errors
+    cons = mgr.fabric_conservation()
+    assert cons["violations"] == 0
+    assert cons["marked_pkts"] > 0, \
+        "cross-rack fan-in never met the marking threshold"
+    fct = mgr.fabric_summary(cfg.general.stop_time_ns).get("fct")
+    assert fct and fct["flows"] > 0 and fct["p99_ns"] >= fct["p50_ns"]
+
+    cfg = ConfigOptions.from_yaml_text(rpc_burst_yaml(
+        n_clients=4, n_servers=2, bursts=2, stop_time="1500ms",
+        scheduler="serial", tcp=TCP_DCTCP))
+    mgr, summary = run_simulation(cfg)
+    assert summary.ok, summary.plugin_errors
+    assert mgr.fabric_conservation()["violations"] == 0
+
+
+# ---------------------------------------------------------------------
+# forced-device differential (slow)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_dctcp_forced_device_differential():
+    """The TCP span family with the ECN columns live: marking decided
+    INSIDE the device loop's enqueue micro-op, ECE/CWR and the alpha
+    EWMA stepped in the kernel — byte-identical traces and an
+    identical conservation ledger vs the serial object path, with
+    most rounds on device and nonzero marks."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import Manager, run_simulation
+    from shadow_tpu.tools.netgen import incast_yaml
+
+    def cfg(sched, dev=None):
+        return ConfigOptions.from_yaml_text(incast_yaml(
+            8, nbytes=2_000_000, stop_time="2s", seed=17,
+            scheduler=sched, device_spans=dev, tcp=TCP_DCTCP))
+
+    m_ser, s_ser = run_simulation(cfg("serial"))
+    assert s_ser.ok, s_ser.plugin_errors
+    mgr = Manager(cfg("tpu", dev="force"))
+    if mgr.plane is None:
+        pytest.skip("native plane unavailable (no C++ toolchain)")
+    s_dev = mgr.run()
+    assert s_dev.ok, s_dev.plugin_errors
+    r = mgr._dev_span_tcp
+    assert r is not None and r.spans > 0, \
+        (getattr(r, "aborts", 0), getattr(r, "over_caps", 0))
+    assert r.rounds * 2 >= s_dev.rounds, \
+        f"only {r.rounds}/{s_dev.rounds} rounds on device"
+    assert m_ser.trace_lines() == mgr.trace_lines()
+    cons_ser = m_ser.fabric_conservation()
+    cons_dev = mgr.fabric_conservation()
+    assert cons_ser == cons_dev
+    assert cons_ser["marked_pkts"] > 0
+    assert cons_ser["violations"] == 0

@@ -384,3 +384,63 @@ def test_tel_host_down_drift_is_caught(cpp_text):
     assert any("TEL_HOST_DOWN" in x.message or
                "TEL_LINK_DOWN" in x.message for x in v), \
         [x.render() for x in v]
+
+
+def test_dctcp_k_drift_is_caught(cpp_text):
+    # a drifted marking threshold silently desynchronizes which
+    # packets the three paths mark CE
+    mutated = _mutate(cpp_text, "constexpr int64_t DCTCP_K_PKTS = 20;",
+                      "constexpr int64_t DCTCP_K_PKTS = 21;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("DCTCP_K_PKTS" in x.message and "21" in x.message
+               for x in v), [x.render() for x in v]
+
+
+def test_dctcp_alpha_shift_drift_is_caught(cpp_text):
+    # the alpha EWMA is fixed-point: a shifted gain changes every
+    # cwnd reduction bit-for-bit
+    mutated = _mutate(cpp_text,
+                      "constexpr int64_t DCTCP_G_SHIFT = 4;",
+                      "constexpr int64_t DCTCP_G_SHIFT = 5;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("DCTCP_G_SHIFT" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_ecn_flag_bit_swap_is_caught(cpp_text):
+    # swapping ECE/CWR bit values flips negotiation and echo on one
+    # side only
+    mutated = _mutate(cpp_text,
+                      "constexpr int F_ECE = 0x40;\n"
+                      "constexpr int F_CWR = 0x80;",
+                      "constexpr int F_ECE = 0x80;\n"
+                      "constexpr int F_CWR = 0x40;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("F_ECE" in x.message for x in v), \
+        [x.render() for x in v]
+    assert any("F_CWR" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_unregistered_mark_cause_fails_closed(cpp_text):
+    # extending the MARK_* attribution without registering the twin
+    # must be a violation in itself
+    mutated = _mutate(cpp_text,
+                      "enum { MARK_THRESH_PKTS = 0, MARK_THRESH_BYTES,"
+                      " MARK_N };",
+                      "enum { MARK_THRESH_PKTS = 0, MARK_THRESH_BYTES,"
+                      " MARK_CODEL_LAW, MARK_N };")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("MARK_CODEL_LAW" in x.message and "no contract row"
+               in x.message for x in v), [x.render() for x in v]
+
+
+def test_mark_name_table_reorder_is_caught(cpp_text):
+    # reordering MARK_NAMES without touching the enum desynchronizes
+    # the fabric ledger's labels from the counters
+    mutated = _mutate(cpp_text,
+                      '    "dctcp-k-pkts",\n    "dctcp-k-bytes",',
+                      '    "dctcp-k-bytes",\n    "dctcp-k-pkts",')
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("MARK_NAMES" in x.message for x in v), \
+        [x.render() for x in v]
