@@ -210,7 +210,8 @@ enum {
   EL_ENGINE_ABORT, EL_ENGINE_TRANSIENT, EL_ENGINE_FAMILY, EL_ENGINE_OFF,
   EL_ENGINE_PYLIMIT, EL_ROUND_BOUNDARY, EL_ROUND_OUTBOX, EL_ROUND_GATE,
   EL_ROUND_CALLBACK, EL_ROUND_FORCED, EL_ROUND_SCHED, EL_OBJ_PCAP,
-  EL_OBJ_CPU, EL_OBJ_PYTASK, EL_OBJ_OTHER, EL_N,
+  EL_OBJ_CPU, EL_OBJ_PYTASK, EL_OBJ_OTHER, EL_DEVICE_SHARDED,
+  EL_ENGINE_EXCHANGE, EL_ENGINE_UNSHARDED, EL_N,
 };
 
 /* Order mirrors the EL_* enum (and trace/events.py EL_NAMES). */
@@ -234,6 +235,9 @@ static const char *EL_NAMES[EL_N] = {
     "object-path:cpu-model",
     "object-path:py-task",
     "object-path:other",
+    "device-span:sharded",
+    "engine-span:exchange-capacity",
+    "engine-span:shard-unaligned",
 };
 
 /* Fixed flight record; layout twinned byte-for-byte with

@@ -182,6 +182,9 @@ CONTRACTS = [
     ("EL_OBJ_CPU", [(_TREV, "EL_OBJ_CPU")]),
     ("EL_OBJ_PYTASK", [(_TREV, "EL_OBJ_PYTASK")]),
     ("EL_OBJ_OTHER", [(_TREV, "EL_OBJ_OTHER")]),
+    ("EL_DEVICE_SHARDED", [(_TREV, "EL_DEVICE_SHARDED")]),
+    ("EL_ENGINE_EXCHANGE", [(_TREV, "EL_ENGINE_EXCHANGE")]),
+    ("EL_ENGINE_UNSHARDED", [(_TREV, "EL_ENGINE_UNSHARDED")]),
     ("EL_N", [(_TREV, "EL_N")]),
     # Sim-netstat drop-cause codes + the per-connection telemetry
     # record layout (both device-span kernels carry the causes they

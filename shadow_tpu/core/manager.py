@@ -733,8 +733,12 @@ class Manager:
         # thread_per_core baseline keeps the reference's per-round
         # architecture (manager.rs:415-501).
         route = getattr(self.propagator, "route", None)
+        # Spans serve the sharded mesh backend too (ISSUE 11: sharded
+        # device spans are the default routed path for tpu_shards > 1
+        # — the per-round mesh exchange covers only the residue), so
+        # `device_barrier` no longer disables them.
         span_ok = (self.config.experimental.scheduler == "tpu"
-                   and self.plane is not None and not device_barrier
+                   and self.plane is not None
                    and not self._perf_timers
                    # Forced-device mode (min_device_batch<=0) is the
                    # parity/audit path: every round must go through the
@@ -785,8 +789,7 @@ class Manager:
         # Why the per-round path would run when spans are statically
         # unavailable (refined at runtime when span_ok drops).
         if self.config.experimental.scheduler != "tpu" \
-                or self.plane is None or device_barrier \
-                or self._perf_timers:
+                or self.plane is None or self._perf_timers:
             per_round_static = trev.EL_ROUND_SCHED
         elif route is None or route.min_device_batch <= 0:
             per_round_static = trev.EL_ROUND_FORCED
@@ -797,6 +800,14 @@ class Manager:
         dev_off_reason = (trev.EL_ENGINE_OFF
                           if dev_mode not in ("auto", "force", "on")
                           else trev.EL_ENGINE_FAMILY)
+        if dev_span_on and device_barrier \
+                and len(self.hosts) % getattr(
+                    self.propagator, "n_shards", 1) != 0:
+            # Sharded placement law (ops/span_mesh.py): the host axis
+            # must divide the mesh.  C++ spans still serve; the audit
+            # names the shard-routing decision.
+            dev_span_on = False
+            dev_off_reason = trev.EL_ENGINE_UNSHARDED
         # -------- checkpoint/resume + fault injection ----------------
         # (shadow_tpu/ckpt/, docs/CHECKPOINT.md.)  Resume: seed the
         # round counters and the deterministic router ladder from the
@@ -1057,8 +1068,12 @@ class Manager:
                             dev_ns_round = per if dev_ns_round is None \
                                 else 0.7 * dev_ns_round + 0.3 * per
                             dev_probe_countdown = 16
-                        start = account_span(res, trev.EL_DEVICE_SPAN,
-                                             device=True, family=family)
+                        start = account_span(
+                            res,
+                            trev.EL_DEVICE_SHARDED
+                            if getattr(runner, "mesh", None) is not None
+                            else trev.EL_DEVICE_SPAN,
+                            device=True, family=family)
                         continue
                     if res is None and (runner is None
                                         or runner.ineligible):
@@ -1079,8 +1094,16 @@ class Manager:
                         # abort or transient over-caps: the rollback
                         # path — shrink the speculative window batch,
                         # back off, and give up only after repeated
-                        # failures
-                        span_reason = trev.EL_ENGINE_ABORT
+                        # failures.  An exchange-capacity abort (the
+                        # sharded hop kept overflowing after the
+                        # driver's in-place growth) is attributed
+                        # separately: it names a shard-routing limit,
+                        # not a domain departure.
+                        from shadow_tpu.ops.phold_span import AB_EXCH
+                        span_reason = (
+                            trev.EL_ENGINE_EXCHANGE
+                            if getattr(runner, "last_abort_code", 0)
+                            & AB_EXCH else trev.EL_ENGINE_ABORT)
                         if fr_sim is not None:
                             fr_sim.event(
                                 start, trev.FR_SPAN_ABORT, family,
@@ -1501,6 +1524,20 @@ class Manager:
         # (BASELINE.md r6 documents the corrupting combination).
         runner.donate = \
             self.config.experimental.tpu_donate_buffers == "on"
+        # Sharded device spans (ISSUE 11): under tpu_shards > 1 the
+        # runners inherit the mesh propagator's device mesh, so whole
+        # conservative windows iterate on device with the host axis
+        # sharded and the cross-shard exchange inside the while_loop
+        # — the default routed path, not a dryrun-only seam.  The
+        # placement law requires H % shards == 0 (the router
+        # attributes EL_ENGINE_UNSHARDED otherwise and never builds a
+        # mesh-less sharded kernel).
+        mesh = getattr(self.propagator, "mesh", None)
+        if mesh is not None \
+                and len(self.hosts) % mesh.devices.size == 0:
+            runner.mesh = mesh
+            runner.exchange_cap = \
+                self.config.experimental.tpu_exchange_capacity
         if self.flight is not None:
             runner.wall = self.flight.wall  # dispatch phase profiling
         if self.netstat is not None:
@@ -1703,6 +1740,16 @@ class Manager:
             "pcap_span_cap": (self.config.experimental.pcap_span_cap
                               if self._pcap_engine else 1024),
         }
+        if getattr(prop, "n_shards", 1) > 1:
+            # Sharded per-round path: the on-device exchange's packet
+            # split and its wall (the all_to_all dispatch+sync leg),
+            # credited here so bench's headline JSON shows where the
+            # sharded rounds' wall goes (ISSUE 11 satellite).
+            dispatch["shards"] = prop.n_shards
+            dispatch["packets_exchanged"] = prop.packets_exchanged
+            dispatch["packets_overflowed"] = prop.packets_overflowed
+            dispatch["exchange_wall_s"] = round(
+                getattr(prop, "exchange_wall_ns", 0) / 1e9, 6)
         for family, runner in (("phold", getattr(self, "_dev_span",
                                                  None)),
                                ("tcp", getattr(self, "_dev_span_tcp",
@@ -1718,6 +1765,14 @@ class Manager:
                     "resident_hits": getattr(runner,
                                              "resident_hits", 0),
                     "stale_drops": getattr(runner, "stale_drops", 0),
+                    # Sharded span placement (ISSUE 11): mesh width
+                    # the kernels built for, the live exchange
+                    # capacity, and how often AB_EXCH grew it.
+                    "shards": getattr(runner, "n_shards", 1),
+                    "exchange_cap": getattr(runner, "exchange_cap",
+                                            0),
+                    "exchange_grows": getattr(runner, "exch_grows",
+                                              0),
                 }
         reg = self.metrics
         reg.ingest("dispatch", dispatch, channel="wall")

@@ -102,9 +102,13 @@ PK_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
 # Abort reason bits: trace/outbox overflows are capacity problems the
 # driver fixes by growing the buffer and retrying; structural bits mean
 # the state left the modelled domain (fall back to the C++ path).
+# AB_EXCH: the sharded cross-shard exchange overflowed its per-shard
+# capacity — attributed (EL_ENGINE_EXCHANGE when spans fall back) and
+# grown like the other capacity bits, never silently truncated.
 AB_TRACE = 1
 AB_OUT = 2
 AB_STRUCT = 4
+AB_EXCH = 8
 
 
 # Compiled step cache: repeated sims of the same shape (bench trials,
@@ -443,7 +447,7 @@ class PholdSpanRunner(SpanMeshMixin):
         key = (self._H, P, self._lat.shape, self.CAP_I, self.CAP_T,
                self.CAP_R, self.CAP_S, self.CAP_C, self.cap_out,
                self.cap_tr, self.tracing, self.family, self.fused,
-               self._fabric_params())
+               self._fabric_params(), self.mesh, self.exchange_cap)
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build(P)
@@ -461,6 +465,9 @@ class PholdSpanRunner(SpanMeshMixin):
         tracing = self.tracing
         family = self.family  # static: compiled per family
         fused = self.fused    # static: fused vs reference dispatch
+        n_shards = self.n_shards  # static: mesh width (1 = unsharded)
+        exchange = (self._build_exchange(jax, jnp)
+                    if n_shards > 1 else None)
         fabric, fab_iv = self._fabric_params()
         FABR = self.FAB_ROWS
         hidx = jnp.arange(H, dtype=jnp.int32)
@@ -1407,30 +1414,54 @@ class PholdSpanRunner(SpanMeshMixin):
             ib_src = compact(st["ib_src"], 0)
             ib_seq = compact(st["ib_seq"], I64_MAX)
             ib_pk = {kk: compact(st[f"ib_{kk}"], 0) for kk in PK_KEYS}
-            # stable per-destination rank in outbox order
-            seg = jnp.where(keep, dst, H)
-            order = jnp.argsort(seg.astype(jnp.int64) * (O + 1)
-                                + jnp.arange(O))
-            sseg = seg[order]
-            rank0 = jnp.arange(O) - jnp.searchsorted(sseg, sseg,
-                                                     side="left")
-            rank = jnp.zeros(O, jnp.int32).at[order].set(
-                rank0.astype(jnp.int32))
-            slot = rem[jnp.minimum(seg, H - 1)] + rank
-            ok_slot = keep & (slot < I - 1)
-            st = mark_abort(st, (keep & (slot >= I - 1)).any(),
-                            AB_STRUCT)
-            st = dict(st)
-            rows = jnp.where(ok_slot, dst, OOB)
             new = {"srchost": src, "pseq": st["out_pseq"],
                    "sip": st["out_sip"], "sport": st["out_sport"],
                    "dip": st["out_dip"], "dport": st["out_dport"]}
-            ib_time = ib_time.at[rows, slot].set(deliver, mode="drop")
-            ib_src = ib_src.at[rows, slot].set(src, mode="drop")
-            ib_seq = ib_seq.at[rows, slot].set(st["out_seq"],
-                                               mode="drop")
+            d_dst, d_time, d_src, d_seq = dst, deliver, src, \
+                st["out_seq"]
+            d_pk, d_keep, DN = new, keep, O
+            if n_shards > 1:
+                # On-device cross-shard exchange (ISSUE 11): kept
+                # packets hop to their destination shard through the
+                # capacity-bounded staging law in span_mesh.py before
+                # the shard-local inbox scatter below.  Overflow is
+                # an AB_EXCH abort, and the delivered multiset is
+                # unchanged on a clean run, so the post-scatter inbox
+                # lexsort (time, src, seq — a strict total order)
+                # makes the hop invisible to the packet trace.
+                stage, SE = exchange
+                hs = H // n_shards
+                cols = {"dst": (dst, H), "time": (deliver, I64_MAX),
+                        "src": (src, 0), "seq": (st["out_seq"],
+                                                 I64_MAX)}
+                cols.update({kk: (new[kk], 0) for kk in PK_KEYS})
+                ex, over = stage(keep, dst // hs, cols)
+                st = mark_abort(st, over.any(), AB_EXCH)
+                st = dict(st)
+                d_dst, d_time = ex["dst"], ex["time"]
+                d_src, d_seq = ex["src"], ex["seq"]
+                d_pk = {kk: ex[kk] for kk in PK_KEYS}
+                d_keep, DN = ex["dst"] < H, SE
+            # stable per-destination rank in delivery order
+            seg = jnp.where(d_keep, d_dst, H)
+            order = jnp.argsort(seg.astype(jnp.int64) * (DN + 1)
+                                + jnp.arange(DN))
+            sseg = seg[order]
+            rank0 = jnp.arange(DN) - jnp.searchsorted(sseg, sseg,
+                                                      side="left")
+            rank = jnp.zeros(DN, jnp.int32).at[order].set(
+                rank0.astype(jnp.int32))
+            slot = rem[jnp.minimum(seg, H - 1)] + rank
+            ok_slot = d_keep & (slot < I - 1)
+            st = mark_abort(st, (d_keep & (slot >= I - 1)).any(),
+                            AB_STRUCT)
+            st = dict(st)
+            rows = jnp.where(ok_slot, d_dst, OOB)
+            ib_time = ib_time.at[rows, slot].set(d_time, mode="drop")
+            ib_src = ib_src.at[rows, slot].set(d_src, mode="drop")
+            ib_seq = ib_seq.at[rows, slot].set(d_seq, mode="drop")
             for kk in PK_KEYS:
-                ib_pk[kk] = ib_pk[kk].at[rows, slot].set(new[kk],
+                ib_pk[kk] = ib_pk[kk].at[rows, slot].set(d_pk[kk],
                                                          mode="drop")
             add = jnp.zeros(H, jnp.int32).at[rows].add(1, mode="drop")
             sort_idx = jnp.lexsort((ib_seq, ib_src, ib_time), axis=1)
@@ -1758,13 +1789,20 @@ class PholdSpanRunner(SpanMeshMixin):
                     return None
                 if self.mesh is not None:
                     st = self._mesh_put(st)
-            # Trace/outbox overflow: a capacity problem, not a domain
-            # problem — grow the buffer and re-run the span (the input
-            # state was never mutated; export is read-only).
+            # Trace/outbox/exchange overflow: a capacity problem, not
+            # a domain problem — grow the buffer and re-run the span
+            # (the input state was never mutated; export is read-only,
+            # and the retry re-applies mesh sharding above).
             if code & AB_TRACE:
                 self.cap_tr *= 4
             if code & AB_OUT:
                 self.cap_out *= 4
+            if code & AB_EXCH:
+                # Grow from the EFFECTIVE capacity (the kernel builds
+                # with E = max(exchange_cap, 8)), so a tiny configured
+                # capacity cannot waste a retry on an identical shape.
+                self.exchange_cap = max(self.exchange_cap, 8) * 4
+                self.exch_grows += 1
             self._fn = self._cached_build(
                 self._static_cols["peers"].shape[1])
         else:

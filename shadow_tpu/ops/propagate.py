@@ -327,6 +327,16 @@ class DeviceRouteModel:
         inline, exactly the pre-round-5 behavior."""
         return self.decide(n, b) != ROUTE_HOST
 
+    def device_measured_winning(self, n: int) -> bool:
+        """Has this model MEASURED the device beating the host path at
+        round size n?  The propagators' span gate: a measured-winning
+        accelerator must keep getting per-round dispatches instead of
+        being silently preempted by the host twin."""
+        if not n or self.host_ns_per_pkt is None:
+            return False
+        dev = self._dev_ns_by_bucket.get(_bucket(n))
+        return dev is not None and dev <= self.host_ns_per_pkt * n
+
     def record_device(self, b: int, dt_ns: float, n: int,
                       fresh_compile: bool | None = None) -> None:
         """Record a measured device dispatch.  A dispatch that paid a
@@ -635,16 +645,10 @@ class TpuPropagator:
     def span_gate(self) -> bool:
         """May the Manager serve the next rounds with the C++ span loop?
         False when the route model has MEASURED the device winning at
-        the typical engine-round size — a measured-winning accelerator
-        must keep getting per-round dispatches, not be silently
-        preempted by the host twin.  (Probes stay reachable because
+        the typical engine-round size.  (Probes stay reachable because
         spawn-phase and post-span rounds still run per-round.)"""
-        n = self._last_engine_n
-        route = self.route
-        if not n or route.host_ns_per_pkt is None:
-            return True
-        dev = route._dev_ns_by_bucket.get(_bucket(n))
-        return dev is None or dev > route.host_ns_per_pkt * n
+        return not self.route.device_measured_winning(
+            self._last_engine_n)
 
     def close(self) -> None:
         """Stop accepting probes; an in-flight one runs out on its

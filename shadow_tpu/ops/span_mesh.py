@@ -45,6 +45,23 @@ class SpanMeshMixin:
     jax.sharding.Mesh with a "hosts" axis) and `_H` (host count)
     come from the concrete runner."""
 
+    # Cross-shard exchange capacity (per destination shard per span
+    # round) when a mesh with >1 devices is attached: seeded from
+    # experimental.tpu_exchange_capacity by the manager's runner
+    # factory, grown transactionally on an AB_EXCH abort (exchange
+    # overflow is an attributed capacity abort, never truncation).
+    exchange_cap = 1 << 12
+    exch_grows = 0
+
+    @property
+    def n_shards(self) -> int:
+        """Mesh width the kernel builds for (1 = unsharded).  The
+        placement law requires H % n_shards == 0 — the manager never
+        attaches a mesh to an unaligned host axis."""
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.devices.size)
+
     # experimental.tpu_donate_buffers (set by the manager's runner
     # factory): the jitted span loop donates its carry (argnums 0) so
     # XLA reuses the resident buffers in place — behind the
@@ -71,6 +88,46 @@ class SpanMeshMixin:
                 if getattr(v, "ndim", 0) >= 1 and v.shape[0] == self._H
                 else PartitionSpec())
         return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+    def _build_exchange(self, jax, jnp):
+        """The sharded span kernels' cross-shard exchange law (ISSUE
+        11 tentpole), shared by both families.  Kept outbox packets
+        route to their destination shard through a fixed-capacity
+        staging buffer — the slot law is round_step.py's (stable
+        cumulative rank per destination shard, capacity E slots per
+        shard pair) — and the staged block is sharding-constrained to
+        the hosts axis so the partitioner lowers the hop to the
+        cross-shard collective (the `lax.all_to_all` of the per-round
+        mesh path, in the GSPMD idiom the span while_loop runs in).
+        Overflow never truncates: the caller marks AB_EXCH and the
+        driver grows `exchange_cap` and retries transactionally.
+
+        Returns (stage, SE): `stage(keep, dst_shard, cols)` maps
+        {name: (values[N], fill)} to ({name: staged[SE]}, over[N]).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = NamedSharding(self.mesh, PartitionSpec("hosts"))
+        S = self.n_shards
+        E = max(int(self.exchange_cap), 8)
+        SE = S * E
+
+        def stage(keep, dst_shard, cols):
+            onehot = (dst_shard[None, :]
+                      == jnp.arange(S)[:, None]) & keep
+            rank = jnp.cumsum(onehot, axis=1) - 1
+            slot = jnp.take_along_axis(
+                rank, dst_shard[None, :], axis=0)[0]
+            fits = keep & (slot < E)
+            over = keep & ~fits
+            flat = jnp.where(fits, dst_shard * E + slot, SE)
+            out = {}
+            for name, (v, fill) in cols.items():
+                buf = jnp.full(SE, fill, v.dtype).at[flat].set(
+                    v, mode="drop")
+                out[name] = jax.lax.with_sharding_constraint(
+                    buf.reshape(S, E), spec).reshape(SE)
+            return out, over
+        return stage, SE
 
     def _mesh_put(self, st):
         """Commit every span input to the device mesh: host-major

@@ -153,10 +153,13 @@ PK_DTYPES = {
     "ecn": np.int32,
 }
 
-# Abort reason bits (phold_span twin semantics).
+# Abort reason bits (phold_span twin semantics; AB_EXCH = the sharded
+# cross-shard exchange overflowed its per-shard capacity — grown and
+# retried like the other capacity bits, never silently truncated).
 AB_TRACE = 1
 AB_OUT = 2
 AB_STRUCT = 4
+AB_EXCH = 8
 
 _FN_CACHE: dict = {}
 
@@ -594,7 +597,8 @@ class TcpSpanRunner(SpanMeshMixin):
     def _cached_build(self):
         key = (self._H, self._CC, self._caps(), self.cap_out,
                self.cap_tr, self.tracing, self.fused,
-               self._netstat_params(), self._fabric_params())
+               self._netstat_params(), self._fabric_params(),
+               self.mesh, self.exchange_cap)
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build()
@@ -611,6 +615,9 @@ class TcpSpanRunner(SpanMeshMixin):
         TR = self.cap_tr
         tracing = self.tracing
         fused = self.fused    # static: fused vs reference dispatch
+        n_shards = self.n_shards  # static: mesh width (1 = unsharded)
+        exchange = (self._build_exchange(jax, jnp)
+                    if n_shards > 1 else None)
         netstat, tel_iv = self._netstat_params()
         TELR = self.TEL_ROWS
         fabric, fab_iv = self._fabric_params()
@@ -2093,27 +2100,52 @@ class TcpSpanRunner(SpanMeshMixin):
             ib_pk = {kk: compact(st[f"ib_{kk}"],
                                  np.zeros((), PK_DTYPES[kk]))
                      for kk in PK_KEYS}
-            seg = jnp.where(keep, dst, H)
-            order = jnp.argsort(seg.astype(jnp.int64) * (O + 1)
-                                + jnp.arange(O))
+            d_dst, d_time, d_src, d_seq = dst, deliver, src, \
+                st["out_seq"]
+            d_pk = {kk: st[f"out_{kk}"] for kk in PK_KEYS}
+            d_keep, DN = keep, O
+            if n_shards > 1:
+                # On-device cross-shard exchange (phold_span twin;
+                # ISSUE 11): capacity-bounded per-destination-shard
+                # staging (span_mesh.py law) ahead of the shard-local
+                # inbox scatter; AB_EXCH on overflow, and the inbox
+                # lexsort (time, src, seq — strict total order) makes
+                # a clean hop invisible to the packet trace.
+                stage, SE = exchange
+                hs = H // n_shards
+                cols = {"dst": (dst, H), "time": (deliver, I64_MAX),
+                        "src": (src, 0), "seq": (st["out_seq"],
+                                                 I64_MAX)}
+                cols.update({kk: (st[f"out_{kk}"],
+                                  np.zeros((), PK_DTYPES[kk])[()])
+                             for kk in PK_KEYS})
+                ex, over = stage(keep, dst // hs, cols)
+                st = mark_abort(st, over.any(), AB_EXCH, 15)
+                st = dict(st)
+                d_dst, d_time = ex["dst"], ex["time"]
+                d_src, d_seq = ex["src"], ex["seq"]
+                d_pk = {kk: ex[kk] for kk in PK_KEYS}
+                d_keep, DN = ex["dst"] < H, SE
+            seg = jnp.where(d_keep, d_dst, H)
+            order = jnp.argsort(seg.astype(jnp.int64) * (DN + 1)
+                                + jnp.arange(DN))
             sseg = seg[order]
-            rank0 = jnp.arange(O) - jnp.searchsorted(sseg, sseg,
-                                                     side="left")
-            rank = jnp.zeros(O, jnp.int32).at[order].set(
+            rank0 = jnp.arange(DN) - jnp.searchsorted(sseg, sseg,
+                                                      side="left")
+            rank = jnp.zeros(DN, jnp.int32).at[order].set(
                 rank0.astype(jnp.int32))
             slot = rem[jnp.minimum(seg, H - 1)] + rank
-            ok_slot = keep & (slot < I - 1)
-            st = mark_abort(st, (keep & (slot >= I - 1)).any(),
+            ok_slot = d_keep & (slot < I - 1)
+            st = mark_abort(st, (d_keep & (slot >= I - 1)).any(),
                             AB_STRUCT, 14)
             st = dict(st)
-            rows = jnp.where(ok_slot, dst, OOB)
-            ib_time = ib_time.at[rows, slot].set(deliver, mode="drop")
-            ib_src = ib_src.at[rows, slot].set(src, mode="drop")
-            ib_seq = ib_seq.at[rows, slot].set(st["out_seq"],
-                                               mode="drop")
+            rows = jnp.where(ok_slot, d_dst, OOB)
+            ib_time = ib_time.at[rows, slot].set(d_time, mode="drop")
+            ib_src = ib_src.at[rows, slot].set(d_src, mode="drop")
+            ib_seq = ib_seq.at[rows, slot].set(d_seq, mode="drop")
             for kk in PK_KEYS:
-                ib_pk[kk] = ib_pk[kk].at[rows, slot].set(
-                    st[f"out_{kk}"], mode="drop")
+                ib_pk[kk] = ib_pk[kk].at[rows, slot].set(d_pk[kk],
+                                                         mode="drop")
             add = jnp.zeros(H, jnp.int32).at[rows].add(1, mode="drop")
             sort_idx = jnp.lexsort((ib_seq, ib_src, ib_time), axis=1)
             take = jnp.take_along_axis
@@ -2551,6 +2583,14 @@ class TcpSpanRunner(SpanMeshMixin):
                 self.cap_tr *= 4
             if code & AB_OUT:
                 self.cap_out *= 4
+            if code & AB_EXCH:
+                # Exchange overflow: grow the per-shard capacity and
+                # retry (the retry re-applied mesh sharding above).
+                # Grow from the EFFECTIVE capacity (the kernel builds
+                # with E = max(exchange_cap, 8)), so a tiny configured
+                # capacity cannot waste a retry on an identical shape.
+                self.exchange_cap = max(self.exchange_cap, 8) * 4
+                self.exch_grows += 1
             self._fn = self._cached_build()
         else:
             self.last_abort_code = code

@@ -113,6 +113,31 @@ class MeshPropagator:
         # Auditability (VERDICT r3): accelerator vs host dispatch split.
         self.rounds_device = 0
         self.packets_device = 0
+        # Always-on exchange wall (ns): the sharded step's dispatch +
+        # barrier sync per round, credited to metrics.wall.dispatch
+        # (ISSUE 11 satellite) independent of the flight recorder.
+        self.exchange_wall_ns = 0
+        # Last engine round size, for the span gate (TpuPropagator
+        # twin): a measured-winning device keeps per-round dispatches.
+        self._last_engine_n = 0
+
+    @property
+    def _outbox(self):
+        """Truthy iff any shard outbox holds undelivered packets —
+        the manager's span/checkpoint boundary checks read this the
+        same way they read TpuPropagator's flat outbox."""
+        for ob in self._outboxes:
+            if ob:
+                return ob
+        return None
+
+    def span_gate(self) -> bool:
+        """May the manager serve the next rounds with the C++ span
+        loop? (TpuPropagator twin.)  False when the route model has
+        MEASURED the sharded device step winning at the typical
+        engine-round size."""
+        return not self.route.device_measured_winning(
+            self._last_engine_n)
 
     # ------------------------------------------------------------------
 
@@ -220,6 +245,7 @@ class MeshPropagator:
         import time as _time
 
         eng = self.engine
+        self._last_engine_n = n
         nb = _bucket(n)
         t0 = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
         if not self.route.use_device(n, nb):
@@ -281,12 +307,14 @@ class MeshPropagator:
 
             _w = self.wall
             _tw = _w.now() if _w is not None else 0
+            _tx = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] exchange-wall telemetry (metrics.wall.dispatch)
             out = self.step(sn, dn, ds, sh, ps, ts, ctl, valid, hne,
                             np.int64(self.window_end),
                             np.int64(self.bootstrap_end))
             (deliver, keep, overflow, reachable, lossy, _recv_idx,
              _recv_time, barrier_min, min_latency) = \
                 (np.asarray(o) for o in out)
+            self.exchange_wall_ns += _time.perf_counter_ns() - _tx  # shadow-lint: allow[wall-clock] exchange-wall telemetry (metrics.wall.dispatch)
             if _w is not None:
                 # The asarray reads block on the all_to_all exchange:
                 # this IS the conservative barrier wait.
@@ -350,12 +378,15 @@ class MeshPropagator:
 
         _w = self.wall
         _t0 = _w.now() if _w is not None else 0
+        import time as _time
+        _tx = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] exchange-wall telemetry (metrics.wall.dispatch)
         out = self.step(src_node, dst_node, dst_shard, src_host, pkt_seq,
                         t_send, is_ctl, valid, hne,
                         np.int64(self.window_end),
                         np.int64(self.bootstrap_end))
         (deliver, keep, overflow, reachable, lossy, recv_idx, recv_time,
          barrier_min, min_latency) = (np.asarray(o) for o in out)
+        self.exchange_wall_ns += _time.perf_counter_ns() - _tx  # shadow-lint: allow[wall-clock] exchange-wall telemetry (metrics.wall.dispatch)
         if _w is not None:
             # The asarray reads block on the all_to_all exchange: this
             # IS the conservative barrier wait.

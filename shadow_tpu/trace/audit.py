@@ -12,8 +12,9 @@ one-command attribution report:
 
 from __future__ import annotations
 
-from shadow_tpu.trace.events import (EL_DEVICE_SPAN, EL_ENGINE_SPAN,
-                                     EL_N, EL_NAMES)
+from shadow_tpu.trace.events import (EL_DEVICE_SHARDED, EL_DEVICE_SPAN,
+                                     EL_ENGINE_EXCHANGE, EL_ENGINE_SPAN,
+                                     EL_ENGINE_UNSHARDED, EL_N, EL_NAMES)
 
 
 class EligibilityAudit:
@@ -31,11 +32,14 @@ class EligibilityAudit:
         return {EL_NAMES[i]: c for i, c in enumerate(self.counts) if c}
 
     def device_rounds(self) -> int:
-        return self.counts[EL_DEVICE_SPAN]
+        return (self.counts[EL_DEVICE_SPAN]
+                + self.counts[EL_DEVICE_SHARDED])
 
     def span_rounds(self) -> int:
-        return (self.counts[EL_DEVICE_SPAN]
-                + sum(self.counts[EL_ENGINE_SPAN:EL_ENGINE_SPAN + 8]))
+        return (self.device_rounds()
+                + sum(self.counts[EL_ENGINE_SPAN:EL_ENGINE_SPAN + 8])
+                + self.counts[EL_ENGINE_EXCHANGE]
+                + self.counts[EL_ENGINE_UNSHARDED])
 
 
 def render_report(counts: dict, total_rounds: int) -> str:

@@ -72,7 +72,12 @@ EL_OBJ_PCAP = 15          # object-path host due now: pcap capture
 EL_OBJ_CPU = 16           # object-path host due now: CPU model
 EL_OBJ_PYTASK = 17        # engine host with transient Python work
 EL_OBJ_OTHER = 18         # object-path host due now: other config
-EL_N = 19
+# Shard-routing sub-reasons (tpu_shards > 1, ISSUE 11): why rounds
+# did or did not land inside a MESH-SHARDED device span.
+EL_DEVICE_SHARDED = 19    # stepped inside a sharded device span
+EL_ENGINE_EXCHANGE = 20   # C++ span: sharded exchange over capacity
+EL_ENGINE_UNSHARDED = 21  # C++ span: host axis % tpu_shards != 0
+EL_N = 22
 
 # Order must mirror the EL_* values above AND the C++ EL_NAMES table
 # (pass 1 checks both directions).
@@ -96,6 +101,9 @@ EL_NAMES = (
     "object-path:cpu-model",
     "object-path:py-task",
     "object-path:other",
+    "device-span:sharded",
+    "engine-span:exchange-capacity",
+    "engine-span:shard-unaligned",
 )
 assert len(EL_NAMES) == EL_N
 assert len(FAM_NAMES) == FAM_TCP + 1

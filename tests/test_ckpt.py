@@ -30,7 +30,7 @@ graph [ directed 0
 
 
 def small_config(data, scheduler, ckpt_dir=None, at="1050ms",
-                 faults=None, device_spans=None):
+                 faults=None, device_spans=None, shards=None):
     """Two-host tgen transfer over a lossy 25ms edge; the 1050ms
     snapshot point lands mid-transfer (handshake done, rtx/reassembly
     live)."""
@@ -60,6 +60,8 @@ def small_config(data, scheduler, ckpt_dir=None, at="1050ms",
         d["faults"] = faults
     if device_spans is not None:
         d["experimental"]["tpu_device_spans"] = device_spans
+    if shards is not None:
+        d["experimental"]["tpu_shards"] = shards
     return ConfigOptions.from_dict(d)
 
 
@@ -89,7 +91,7 @@ def collect(dirpath):
 
 
 def run_straight_and_resumed(tmp_path, scheduler, at="1050ms",
-                             device_spans=None):
+                             device_spans=None, shards=None):
     """One checkpointed straight run + one resumed run; returns their
     collected artifact dicts + the snapshot path."""
     from shadow_tpu.core.manager import (resume_simulation,
@@ -97,7 +99,7 @@ def run_straight_and_resumed(tmp_path, scheduler, at="1050ms",
     snapdir = tmp_path / f"snaps-{scheduler}"
     cfg = small_config(tmp_path / f"straight-{scheduler}", scheduler,
                        ckpt_dir=snapdir, at=at,
-                       device_spans=device_spans)
+                       device_spans=device_spans, shards=shards)
     _m, s = run_simulation(cfg, write_data=True)
     assert s.ok, s.plugin_errors
     from shadow_tpu.utils.units import parse_time_ns
@@ -105,7 +107,7 @@ def run_straight_and_resumed(tmp_path, scheduler, at="1050ms",
     assert os.path.exists(snap), "no snapshot written"
     cfg2 = small_config(tmp_path / f"resumed-{scheduler}", scheduler,
                         ckpt_dir=tmp_path / "snaps2", at=at,
-                        device_spans=device_spans)
+                        device_spans=device_spans, shards=shards)
     _m2, s2 = resume_simulation(cfg2, snap, write_data=True)
     assert s2.ok, s2.plugin_errors
     a = collect(tmp_path / f"straight-{scheduler}")
@@ -173,6 +175,45 @@ def test_cross_scheduler_resume_within_object_path(tmp_path):
     for rel in ("packet-trace.txt", "telemetry-sim.bin",
                 "fabric-sim.bin", "syscalls-sim.bin"):
         assert a[rel] == b[rel], f"{rel} diverged across schedulers"
+
+
+def test_sharded_resume_identity(tmp_path):
+    """ISSUE 11 gate: the sharded mesh backend (`tpu_shards > 1`) is
+    in the checkpoint domain.  (a) a tpu_shards=2 run snapshotted and
+    resumed sharded is byte-identical on every determinism-gated
+    artifact; (b) the SAME config snapshotted single-shard resumes
+    under tpu_shards=2 with identical path-independent artifacts —
+    shard layout never reaches the archive bytes (host-major canonical
+    order), so one snapshot serves any mesh width."""
+    from shadow_tpu.core.manager import (resume_simulation,
+                                         run_simulation)
+    a, b, _snap = run_straight_and_resumed(tmp_path, "tpu", shards=2)
+    assert a.keys() == b.keys(), (sorted(a), sorted(b))
+    for rel in sorted(a):
+        assert a[rel] == b[rel], \
+            f"{rel} diverged between sharded straight and resumed runs"
+    for rel in ("packet-trace.txt", "flight-sim.bin",
+                "telemetry-sim.bin", "fabric-sim.bin",
+                "sim-stats.json"):
+        assert rel in a and a[rel], f"{rel} missing/empty"
+
+    # (b) resume across shard counts: single-shard archive, sharded
+    # continuation.  Only path-independent artifacts compare (the
+    # flight channel records per-path routing decisions).
+    snapdir = tmp_path / "snaps-single"
+    cfg = small_config(tmp_path / "single", "tpu", ckpt_dir=snapdir)
+    _m, s = run_simulation(cfg, write_data=True)
+    assert s.ok, s.plugin_errors
+    snap = str(snapdir / "ckpt-1050000000.stck")
+    cfg2 = small_config(tmp_path / "resharded", "tpu",
+                        ckpt_dir=tmp_path / "snaps-re", shards=2)
+    _m2, s2 = resume_simulation(cfg2, snap, write_data=True)
+    assert s2.ok, s2.plugin_errors
+    a = collect(tmp_path / "single")
+    b = collect(tmp_path / "resharded")
+    for rel in ("packet-trace.txt", "telemetry-sim.bin",
+                "fabric-sim.bin", "syscalls-sim.bin"):
+        assert a[rel] == b[rel], f"{rel} diverged across shard counts"
 
 
 def test_managed_process_config_rejected(tmp_path):

@@ -183,8 +183,8 @@ def test_trace_event_enum_reorder_is_caught(cpp_text):
 def test_unregistered_trace_enum_fails_closed(cpp_text):
     """A new EL_* reason added engine-side without a contract row (and
     a Python twin) must fail the pass, not silently under-check."""
-    mutated = _mutate(cpp_text, "EL_OBJ_OTHER, EL_N,",
-                      "EL_OBJ_OTHER, EL_ROGUE, EL_N,")
+    mutated = _mutate(cpp_text, "EL_ENGINE_UNSHARDED, EL_N,",
+                      "EL_ENGINE_UNSHARDED, EL_ROGUE, EL_N,")
     v = twin_constants.check(ROOT, cpp_text=mutated)
     msgs = [x.message for x in v]
     assert any("EL_ROGUE" in m and "no contract row" in m
@@ -443,4 +443,27 @@ def test_mark_name_table_reorder_is_caught(cpp_text):
                       '    "dctcp-k-bytes",\n    "dctcp-k-pkts",')
     v = twin_constants.check(ROOT, cpp_text=mutated)
     assert any("MARK_NAMES" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_el_shard_name_table_drift_is_caught(cpp_text):
+    # the new shard-routing reason strings (ISSUE 11) must stay in
+    # lockstep with trace/events.py EL_NAMES — the eligibility report
+    # and the sharded bench rungs render through them
+    mutated = _mutate(cpp_text, '    "device-span:sharded",',
+                      '    "device-span-sharded",')
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("EL_NAMES" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_el_shard_enum_drift_is_caught(cpp_text):
+    # renaming a shard-routing EL code desynchronizes the audit's
+    # attribution (missing registered twin + unregistered EL_ member,
+    # both fail-closed)
+    mutated = _mutate(cpp_text,
+                      "EL_ENGINE_EXCHANGE, EL_ENGINE_UNSHARDED, EL_N",
+                      "EL_ENGINE_EXCHANGE2, EL_ENGINE_UNSHARDED, EL_N")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("EL_ENGINE_EXCHANGE" in x.message for x in v), \
         [x.render() for x in v]
