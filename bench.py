@@ -1034,6 +1034,97 @@ def incast_ecn_rung() -> dict | None:
     return out
 
 
+def sweep_incast_rung() -> dict | None:
+    """Standing sweep-fleet rung (ISSUE 12): a small incast campaign
+    (fan-in x offered load x cc) run through the full subsystem —
+    subprocess points, byte-stable dataset, tail curves — then the
+    surrogate trained on the SMALL fan-ins and evaluated on the
+    held-out fan-in 16 fabric.  REFUSES to record on dataset-identity
+    failure (one point re-run must byte-match its first run) or any
+    conservation failure (the aggregator raises) — the numbers below
+    exist only behind those gates.  Errors are recorded honestly,
+    large or not."""
+    import shutil
+    import tempfile
+
+    from shadow_tpu.sweep import dataset, runner
+    from shadow_tpu.sweep import spec as spec_mod
+    from shadow_tpu.surrogate import features as feat_mod
+    from shadow_tpu.surrogate import train as train_mod
+
+    spec = {
+        "name": "sweep-incast", "scenario": "incast",
+        "base": {"nbytes": 100_000, "stop_time": "2s"},
+        "axes": {"fan_in": [4, 8, 16], "load": [0.5, 1.0],
+                 "cc": ["reno", "dctcp"]},
+        "time_limit_s": 300,
+        # 1 ms link-sample grid: the per-link queue series thins ~10x
+        # with no effect on determinism (the grid rule is
+        # path-independent) — the dataset stays MBs, not tens of.
+        "link_interval_ms": 1,
+    }
+    td = tempfile.mkdtemp(prefix="bench-sweep")
+    try:
+        t0 = time.perf_counter()
+        runner.run_campaign(spec, td)
+        ds = dataset.aggregate(spec, td)  # conservation gate inside
+        campaign_wall = time.perf_counter() - t0
+
+        # Dataset-identity gate: re-run the first point into a fresh
+        # directory and byte-compare its fabric channel.  The task
+        # dict comes from the SAME recipe the campaign used
+        # (runner.point_task), so the gate always compares
+        # identically-configured runs.
+        p0 = spec_mod.expand(spec)[0]
+        td2 = os.path.join(td, "identity-rerun")
+        os.makedirs(os.path.join(td2, p0["point_id"]), exist_ok=True)
+        runner._run_sub(
+            runner.point_task(spec, p0,
+                              os.path.join(td2, p0["point_id"])),
+            os.path.join(td2, "task.json"),
+            os.path.join(td2, "log.txt"), spec["time_limit_s"])
+        a = open(os.path.join(td, p0["point_id"],
+                              "fabric-sim.bin"), "rb").read()
+        b = open(os.path.join(td2, p0["point_id"],
+                              "fabric-sim.bin"), "rb").read()
+        if a != b:
+            raise AssertionError(
+                "sweep-incast: point re-run produced different "
+                "fabric bytes — dataset identity broken, refusing "
+                "to record")
+
+        # Surrogate: train on fan-in {4, 8}, evaluate on the held-out
+        # fan-in 16 fabrics (never trained on).
+        samples = feat_mod.build_samples(ds)
+        tr, held = train_mod.split_samples(samples, "fan_in", 16)
+        t0 = time.perf_counter()
+        params, hist = train_mod.train(tr, seed=1, steps=250)
+        train_wall = time.perf_counter() - t0
+        table = train_mod.error_table(params, held)
+        print(f"bench[sweep-incast]: {len(samples)} points "
+              f"({campaign_wall:.1f}s campaign), surrogate loss "
+              f"{hist[0]:.3f}->{hist[-1]:.3f} ({train_wall:.1f}s), "
+              f"held-out fan-in 16 rel err p50/p99/p999 "
+              f"{table['mean_rel_err_p50']:.1%}/"
+              f"{table['mean_rel_err_p99']:.1%}/"
+              f"{table['mean_rel_err_p999']:.1%}, identity ok",
+              file=sys.stderr)
+        return {
+            "points": len(samples),
+            "campaign_wall_s": round(campaign_wall, 1),
+            "train_wall_s": round(train_wall, 1),
+            "dataset_bytes": len(ds.to_bytes()),
+            "tail_curves": ds.meta["tail_curves"],
+            "surrogate_loss_first": round(hist[0], 4),
+            "surrogate_loss_last": round(hist[-1], 4),
+            "surrogate_error_table": table,
+            "held_out": "fan_in>=16",
+            "identity": "ok",
+        }
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def resume_10k_rung() -> dict | None:
     """Standing checkpoint/resume rung (ISSUE 9, docs/CHECKPOINT.md):
     snapshot the 10k Tor-class tgen rung mid-run (5 of 10 sim-s),
@@ -1393,6 +1484,15 @@ def main() -> None:
         print(f"bench[incast-ecn-32]: failed: {e}", file=sys.stderr)
         incast_ecn = None
 
+    # Sweep fleet + surrogate rung (ISSUE 12): a small incast
+    # campaign through the whole subsystem — identity-gated dataset,
+    # tail curves, surrogate error table on the held-out fan-in.
+    try:
+        sweep_incast = sweep_incast_rung()
+    except Exception as e:  # noqa: BLE001 — never cost the headline
+        print(f"bench[sweep-incast]: failed: {e}", file=sys.stderr)
+        sweep_incast = None
+
     # Checkpoint/resume rung (ISSUE 9): snapshot the 10k rung mid-run,
     # resume, byte-compare — numbers recorded only when the identity
     # gate holds (engine path, no tunnel risk).
@@ -1503,6 +1603,12 @@ def main() -> None:
         # cc=dctcp — nonzero marks, exact conservation, and the FCT
         # p99 next to the drop-based rung's.
         "incast_ecn": incast_ecn,
+        # Sweep fleet + learned surrogate (ISSUE 12): tail curves
+        # (p50/p99/p999 vs offered load per fan-in x cc) and the
+        # surrogate-vs-simulator per-quantile error table on the
+        # held-out fan-in 16 fabric — recorded ONLY behind the
+        # dataset-identity and conservation gates.
+        "sweep_incast": sweep_incast,
         # Checkpoint/resume (ISSUE 9): snapshot size + write wall,
         # restore wall and the wall saved by warm-starting past the
         # 10k rung's first half — recorded ONLY when the resumed run

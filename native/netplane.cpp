@@ -199,7 +199,7 @@ enum { FR_ROUND = 0, FR_SPAN_START, FR_SPAN_COMMIT, FR_SPAN_ABORT,
 constexpr uint32_t CK_PLANE_MAGIC = 0x53544350;  /* "STCP" */
 /* v2: ECN/DCTCP — PacketN.ecn, TcpConn ECN+dctcp fields, per-host
  * mark_causes and the tcp_cc/tcp_ecn config mirror entered the blob. */
-constexpr uint32_t CK_PLANE_VERSION = 2;
+constexpr uint32_t CK_PLANE_VERSION = 3;
 constexpr int CK_PLANE_HDR_BYTES = 24;
 constexpr int CK_FRAME_HDR_BYTES = 12;
 constexpr uint32_t CK_GLOBAL_FRAME = 0xFFFFFFFFu;
@@ -316,8 +316,10 @@ inline int tel_cause_of(const char *reason) {
 }
 
 /* Per-connection telemetry record; layout twinned byte-for-byte with
- * trace/events.py TEL_REC ("<qiHHIi9q"). */
-constexpr int TEL_REC_BYTES = 96;
+ * trace/events.py TEL_REC ("<qiHHIi10q").  `marks` is the endpoint's
+ * cumulative observed CE arrivals (TcpConn::ce_seen) — the per-flow
+ * mark-rate telemetry. */
+constexpr int TEL_REC_BYTES = 104;
 struct TelRec {
   int64_t t;        // simulated ns (sampled round's window end)
   int32_t host;
@@ -325,7 +327,7 @@ struct TelRec {
   uint32_t rip;
   int32_t state;    // ST_* (connection.py twin values)
   int64_t cwnd, ssthresh, srtt, rto, rto_backoff, sndbuf, rcvbuf,
-      retransmits, sacks;
+      retransmits, sacks, marks;
 };
 static_assert(sizeof(TelRec) == TEL_REC_BYTES,
               "telemetry record layout drifted from trace/events.py");
@@ -361,14 +363,14 @@ static_assert(sizeof(FabRec) == FB_REC_BYTES,
  * never reach the bytes. */
 constexpr int FCT_F_COMPLETE = 1; /* conn reached CLOSED */
 constexpr int FCT_F_RECEIVER = 2; /* received more than it sent */
-constexpr int FCT_REC_BYTES = 56;
+constexpr int FCT_REC_BYTES = 64;
 struct FctRec {
   int64_t t_first, t_last;  // first/last data byte (-1: none)
   int32_t host;
   uint16_t lport, rport;
   uint32_t rip;
   int32_t flags;            // FCT_F_* bits
-  int64_t bytes_in, bytes_out, rtx;
+  int64_t bytes_in, bytes_out, rtx, marks;
 };
 static_assert(sizeof(FctRec) == FCT_REC_BYTES,
               "flow record layout drifted from trace/events.py");
@@ -710,6 +712,10 @@ struct TcpConn {
    * touch neither — fct_bytes_out is the flow size. */
   int64_t fct_first = -1, fct_last = -1;
   int64_t fct_bytes_in = 0, fct_bytes_out = 0;
+  /* Per-flow mark-rate telemetry (connection.py ce_seen twin):
+   * cumulative CE-marked arrivals this endpoint observed, counted
+   * exactly where the RFC 3168 receiver latches ECE. */
+  int64_t ce_seen = 0;
 
   void fct_touch(int64_t nbytes, int64_t now, bool inbound) {
     if (fct_first < 0) fct_first = now;
@@ -908,7 +914,7 @@ struct TcpConn {
      * arrival (re)starts it — in that order (connection.py twin). */
     if (ecn_active) {
       if (hdr.flags & F_CWR) ece_latch = false;
-      if (ecn == ECN_CE) ece_latch = true;
+      if (ecn == ECN_CE) { ece_latch = true; ce_seen++; }
     }
     /* RFC 7323 timestamp processing on EVERY segment (ref
      * tcp.c:2356-2358 + the RFC's TS.Recent update rule: only a
@@ -1534,7 +1540,12 @@ struct CoDelN {
    * this packet enqueues, packets leg first — is rewritten to CE and
    * enqueued normally; the caller's mark_causes gets the leg
    * (net/codel.py push twin). */
-  bool push(uint64_t id, PacketN *p, int64_t now, int64_t *mark_causes) {
+  /* K is a parameter (experimental.dctcp_k_pkts/_bytes via the
+   * engine-global set_dctcp_k — the sweep subsystem's congestion
+   * axis); the DCTCP_K_* constants stay the twinned defaults. */
+  bool push(uint64_t id, PacketN *p, int64_t now, int64_t *mark_causes,
+            int64_t k_pkts = DCTCP_K_PKTS,
+            int64_t k_bytes = DCTCP_K_BYTES) {
     int64_t size = p->total_size();
     enq_pkts++;
     enq_bytes += size;
@@ -1545,8 +1556,8 @@ struct CoDelN {
     }
     if (p->ecn == ECN_ECT0) {
       int cause = -1;
-      if ((int64_t)q.size() >= DCTCP_K_PKTS) cause = MARK_THRESH_PKTS;
-      else if (bytes >= DCTCP_K_BYTES) cause = MARK_THRESH_BYTES;
+      if ((int64_t)q.size() >= k_pkts) cause = MARK_THRESH_PKTS;
+      else if (bytes >= k_bytes) cause = MARK_THRESH_BYTES;
       if (cause >= 0) {
         p->ecn = ECN_CE;
         marked++;
@@ -2041,6 +2052,7 @@ template <class Ar> void ck_visit(Ar &a, FctRec &r) {
   a.num(r.t_first); a.num(r.t_last); a.num(r.host);
   a.num(r.lport); a.num(r.rport); a.num(r.rip); a.num(r.flags);
   a.num(r.bytes_in); a.num(r.bytes_out); a.num(r.rtx);
+  a.num(r.marks);
 }
 
 template <class Ar> void ck_visit(Ar &a, TcpConn &c) {
@@ -2101,7 +2113,7 @@ template <class Ar> void ck_visit(Ar &a, TcpConn &c) {
   a.num(c.cc); a.num(c.ecn_on); a.num(c.ecn_active);
   a.num(c.ece_latch); a.num(c.cwr_pending); a.num(c.ecn_cwr_end);
   a.num(c.dctcp_alpha); a.num(c.dctcp_ce); a.num(c.dctcp_tot);
-  a.num(c.dctcp_wend);
+  a.num(c.dctcp_wend); a.num(c.ce_seen);
 }
 
 template <class Ar> void ck_visit(Ar &a, AppN &ap) {
@@ -2195,6 +2207,13 @@ struct Engine {
   std::vector<TelRec> tel_ring;
   size_t tel_head = 0, tel_len = 0;
   uint64_t tel_dropped = 0;
+  /* DCTCP-K marking threshold (experimental.dctcp_k_pkts/_bytes via
+   * set_dctcp_k).  Config, not state: never enters the checkpoint
+   * plane blob, so a forked archive (tools/ckpt fork) resumes under
+   * the VARIANT config's K. */
+  int64_t dctcp_k_pkts = DCTCP_K_PKTS;
+  int64_t dctcp_k_bytes = DCTCP_K_BYTES;
+
   bool tel_on = false;
   int64_t tel_interval = 1;
 
@@ -2720,7 +2739,8 @@ struct Engine {
       store.free_pkt(id);
       return;
     }
-    if (!hp->codel.push(id, p, now, hp->mark_causes)) {
+    if (!hp->codel.push(id, p, now, hp->mark_causes, dctcp_k_pkts,
+                        dctcp_k_bytes)) {
       trace_drop(hp, p, "rtr-limit", now);
       store.free_pkt(id);
       return;
@@ -2801,7 +2821,8 @@ struct Engine {
              * exact (the packet never entered any queue). */
             trace_drop(hp, p, hp->down ? "host-down" : "link-down", et);
             store.free_pkt(i.pkt);
-          } else if (!hp->codel.push(i.pkt, p, et, hp->mark_causes)) {
+          } else if (!hp->codel.push(i.pkt, p, et, hp->mark_causes,
+                                     dctcp_k_pkts, dctcp_k_bytes)) {
             trace_drop(hp, p, "rtr-limit", et);
             store.free_pkt(i.pkt);
           } else {
@@ -5337,7 +5358,8 @@ struct Engine {
     if (c->fct_bytes_in > c->fct_bytes_out) flags |= FCT_F_RECEIVER;
     *out = {c->fct_first, c->fct_last, host, (uint16_t)s->local_port,
             (uint16_t)s->peer_port, s->peer_ip, flags,
-            c->fct_bytes_in, c->fct_bytes_out, c->retransmit_count};
+            c->fct_bytes_in, c->fct_bytes_out, c->retransmit_count,
+            c->ce_seen};
     return true;
   }
 
@@ -5866,6 +5888,7 @@ void Engine::tel_sample_round(int64_t start, int64_t window_end) {
     r.rcvbuf = c->recv_buf.len;
     r.retransmits = c->retransmit_count;
     r.sacks = c->sacked_skip_count;
+    r.marks = c->ce_seen;
     recs.push_back(r);
   }
   std::sort(recs.begin(), recs.end(),
@@ -7161,7 +7184,8 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   std::vector<uint8_t> c_ecnact(CC, 0), c_ece(CC, 0), c_cwrp(CC, 0);
   std::vector<int32_t> c_cc(CC, 0);
   std::vector<uint32_t> c_cwrend(CC, 0), c_dwend(CC, 0);
-  std::vector<int64_t> c_alpha(CC, 0), c_ceack(CC, 0), c_totack(CC, 0);
+  std::vector<int64_t> c_alpha(CC, 0), c_ceack(CC, 0), c_totack(CC, 0),
+      c_ceseen(CC, 0);
   std::vector<int32_t> rtx_len(CC, 0), ra_len(CC, 0), op_len(CC, 0);
   std::vector<uint32_t> rtx_seq(CC * (size_t)RT, 0),
       ra_seq(CC * (size_t)RA, 0);
@@ -7230,6 +7254,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
     c_ceack[j] = c->dctcp_ce;
     c_totack[j] = c->dctcp_tot;
     c_dwend[j] = c->dctcp_wend;
+    c_ceseen[j] = c->ce_seen;
     c_tmrdl[j] = s->timer_deadline;
     c_status[j] = s->status;
     c_queued[j] = s->queued[1] ? 1 : 0;
@@ -7409,6 +7434,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put("c_ceack", bytes_vec(c_ceack));
   put("c_totack", bytes_vec(c_totack));
   put("c_dwend", bytes_vec(c_dwend));
+  put("c_ceseen", bytes_vec(c_ceseen));
   put("rtx_len", bytes_vec(rtx_len));
   put("rtx_seq", bytes_vec(rtx_seq));
   put("rtx_plen", bytes_vec(rtx_plen));
@@ -7566,6 +7592,7 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
   const int64_t *c_ceack = col<int64_t>(d, "c_ceack", CC, &ok);
   const int64_t *c_totack = col<int64_t>(d, "c_totack", CC, &ok);
   const uint32_t *c_dwend = col<uint32_t>(d, "c_dwend", CC, &ok);
+  const int64_t *c_ceseen = col<int64_t>(d, "c_ceseen", CC, &ok);
   const int32_t *rtx_len = col<int32_t>(d, "rtx_len", CC, &ok);
   const uint32_t *rtx_seq =
       col<uint32_t>(d, "rtx_seq", CC * (size_t)RT, &ok);
@@ -7762,6 +7789,7 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
     c->dctcp_ce = c_ceack[j];
     c->dctcp_tot = c_totack[j];
     c->dctcp_wend = c_dwend[j];
+    c->ce_seen = c_ceseen[j];
     c->rtx.clear();
     for (int32_t k = 0; k < rtx_len[j]; k++) {
       size_t kk = j * (size_t)RT + (size_t)k;
@@ -8832,6 +8860,21 @@ static PyObject *eng_set_flight(EngineObj *self, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+static PyObject *eng_set_dctcp_k(EngineObj *self, PyObject *args) {
+  /* Engine-global DCTCP-K marking threshold (config, not state: no
+   * epoch bump — the marking law reads it at enqueue time, and the
+   * device kernels carry their own closure constants). */
+  long long k_pkts, k_bytes;
+  if (!PyArg_ParseTuple(args, "LL", &k_pkts, &k_bytes)) return nullptr;
+  if (k_pkts < 1 || k_bytes < 1) {
+    PyErr_SetString(PyExc_ValueError, "dctcp_k values must be >= 1");
+    return nullptr;
+  }
+  self->eng->dctcp_k_pkts = k_pkts;
+  self->eng->dctcp_k_bytes = k_bytes;
+  Py_RETURN_NONE;
+}
+
 static PyObject *eng_set_netstat(EngineObj *self, PyObject *args) {
   /* Enable/disable the sim-netstat telemetry ring.  Like set_flight,
    * deliberately NOT an epoch bump: sampling observes state, never
@@ -8935,12 +8978,13 @@ static PyObject *eng_fct_flows(EngineObj *self, PyObject *) {
   PyObject *out = PyList_New(0);
   if (!out) return nullptr;
   auto append = [&](const FctRec &r) -> bool {
-    PyObject *t = Py_BuildValue("(LLiHHIiLLL)", (long long)r.t_first,
+    PyObject *t = Py_BuildValue("(LLiHHIiLLLL)", (long long)r.t_first,
                                 (long long)r.t_last, r.host, r.lport,
                                 r.rport, r.rip, r.flags,
                                 (long long)r.bytes_in,
                                 (long long)r.bytes_out,
-                                (long long)r.rtx);
+                                (long long)r.rtx,
+                                (long long)r.marks);
     if (!t) return false;
     int rc = PyList_Append(out, t);
     Py_DECREF(t);
@@ -9281,6 +9325,7 @@ static PyMethodDef eng_methods[] = {
     {"set_flight", (PyCFunction)eng_set_flight, METH_VARARGS, nullptr},
     {"flight_take", (PyCFunction)eng_flight_take, METH_NOARGS, nullptr},
     {"set_netstat", (PyCFunction)eng_set_netstat, METH_VARARGS, nullptr},
+    {"set_dctcp_k", (PyCFunction)eng_set_dctcp_k, METH_VARARGS, nullptr},
     {"netstat_sample", (PyCFunction)eng_netstat_sample, METH_VARARGS,
      nullptr},
     {"netstat_take", (PyCFunction)eng_netstat_take, METH_NOARGS, nullptr},

@@ -1,0 +1,137 @@
+"""`tools/ckpt fork`: clone one post-ramp snapshot into N
+config-variant resume points (docs/CHECKPOINT.md "Fork", docs/SWEEP.md
+"Warm starts").
+
+A snapshot resumes only under a config whose simulation-semantic
+digest matches (ckpt/restore.config_digest) — the right default, but
+it forbids exactly the thing a sim farm wants: snapshot ONCE past
+ramp, then resume N parameter variants from the same warm state
+(ROADMAP item 5).  Fork is the explicit, allowlisted escape hatch: it
+re-stamps the archive's config digest for a variant config that
+differs from the snapshot's ONLY in FORK-SAFE knobs — options that
+shape FUTURE simulation behavior but are never encoded in snapshotted
+state, so the archive's bytes mean exactly the same thing under the
+variant:
+
+- ``experimental.dctcp_k_pkts`` / ``dctcp_k_bytes``: the marking law
+  reads K at enqueue time from config (engine-global / host attr /
+  kernel closure — never serialized), so a forked archive marks under
+  the variant's K from the first post-fork round.
+- ``general.stop_time``: nothing in the archive depends on when the
+  sim will END (the snapshot predates it); the fork refuses a variant
+  whose stop_time is not strictly after the snapshot boundary.
+
+Everything else is refused with the offending key paths named.  In
+particular per-host ``tcp: {cc, ecn}`` changes are refused with their
+own message: cc/ECN state is baked into every live connection in the
+archive (c_cc, alpha, latches), so a cc variant is NOT byte-compatible
+— run that point cold.
+
+The forked file is a byte-faithful clone except for the meta section
+(new config digest), so `ckpt verify` passes and resume applies every
+gate it normally would.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from shadow_tpu.ckpt import format as ck
+from shadow_tpu.ckpt.format import CkptError
+from shadow_tpu.ckpt.restore import (_DIGEST_SKIP_EXPERIMENTAL,
+                                     _DIGEST_SKIP_GENERAL,
+                                     config_digest)
+
+# The fork-safe allowlist (see module docstring).  Keys already
+# excluded from the digest (_DIGEST_SKIP_*, the checkpoint schedule)
+# may differ freely — they were never part of the compatibility
+# contract to begin with.
+FORK_SAFE_GENERAL = ("stop_time",)
+FORK_SAFE_EXPERIMENTAL = ("dctcp_k_pkts", "dctcp_k_bytes")
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k in sorted(d):
+        v = d[k]
+        path = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, path + "."))
+        else:
+            out[path] = v
+    return out
+
+
+def fork_diff(base_config, variant_config) -> list[str]:
+    """The key paths where the two processed configs differ, with the
+    digest-irrelevant keys (skip lists + checkpoint schedule) already
+    removed.  Empty list = identical digests."""
+    def semantic(config):
+        d = config.to_processed_dict()
+        g = d.get("general", {})
+        for k in _DIGEST_SKIP_GENERAL:
+            g.pop(k, None)
+        e = d.get("experimental", {})
+        for k in _DIGEST_SKIP_EXPERIMENTAL:
+            e.pop(k, None)
+        d.pop("checkpoint", None)
+        return _flatten(d)
+
+    a, b = semantic(base_config), semantic(variant_config)
+    return sorted(p for p in set(a) | set(b) if a.get(p) != b.get(p))
+
+
+def check_fork_compatible(base_config, variant_config) -> list[str]:
+    """Raise CkptError unless the variant differs from the base only
+    in fork-safe knobs; returns the (possibly empty) list of differing
+    fork-safe key paths."""
+    allowed = {f"general.{k}" for k in FORK_SAFE_GENERAL} \
+        | {f"experimental.{k}" for k in FORK_SAFE_EXPERIMENTAL}
+    diffs = fork_diff(base_config, variant_config)
+    bad = [p for p in diffs if p not in allowed]
+    if bad:
+        tcp_bad = [p for p in bad
+                   if p.startswith("hosts.") and ".tcp" in p]
+        if tcp_bad:
+            raise CkptError(
+                f"fork refused: per-host tcp (cc/ecn) changes are not "
+                f"byte-compatible — cc state (alpha, latches, c_cc) "
+                f"is baked into every live connection in the archive; "
+                f"run that variant cold ({', '.join(tcp_bad[:4])})")
+        raise CkptError(
+            f"fork refused: variant config differs outside the "
+            f"fork-safe knobs ({', '.join(bad[:6])}"
+            f"{', …' if len(bad) > 6 else ''}); fork-safe: "
+            f"{', '.join(sorted(allowed))}")
+    return diffs
+
+
+def fork_archive(snapshot_path: str, base_config, variant_config,
+                 out_path: str) -> list[str]:
+    """Clone `snapshot_path` (taken under `base_config`) into a resume
+    point for `variant_config`.  Returns the forked key paths.  The
+    output archive is identical except for meta.config_digest."""
+    sections = ck.read_archive(snapshot_path)
+    meta = json.loads(sections[ck.CK_SEC_META].decode())
+    base_digest = config_digest(base_config)
+    if meta["config_digest"] != base_digest:
+        raise CkptError(
+            f"{snapshot_path}: snapshot was not taken under the given "
+            f"base config (digest mismatch) — fork needs the ORIGINAL "
+            f"config to prove the variant differs only in fork-safe "
+            f"knobs")
+    diffs = check_fork_compatible(base_config, variant_config)
+    stop_ns = variant_config.general.stop_time_ns
+    if stop_ns and stop_ns <= meta["next_start_ns"]:
+        raise CkptError(
+            f"fork refused: variant stop_time ({stop_ns} ns) is not "
+            f"after the snapshot boundary ({meta['next_start_ns']} "
+            f"ns) — nothing would run")
+    meta["config_digest"] = config_digest(variant_config)
+    meta["forked_from"] = os.path.basename(snapshot_path)
+    meta["forked_keys"] = diffs
+    sections = dict(sections)
+    sections[ck.CK_SEC_META] = json.dumps(meta, sort_keys=True).encode()
+    ck.write_archive(out_path, sections)
+    return diffs

@@ -63,7 +63,7 @@ CK_RNG_ROW = struct.Struct("<IQ")
 # then per-frame [id u32][length u64] — id CK_GLOBAL_FRAME for the one
 # engine-global frame, else the host id.
 CK_PLANE_MAGIC = 0x53544350  # "STCP"
-CK_PLANE_VERSION = 2
+CK_PLANE_VERSION = 3
 CK_PLANE_HDR_BYTES = 24
 CK_FRAME_HDR_BYTES = 12
 CK_GLOBAL_FRAME = 0xFFFFFFFF

@@ -113,6 +113,11 @@ def _rewire(manager, h, fresh, appmap: dict) -> None:
     h.dns = manager.dns
     h.syscall_handler = manager.syscall_handler
     h.syscall_handler_native = manager.syscall_handler_native
+    # DCTCP-K is config, not state: the RESUMED config's values govern
+    # (the seam tools/ckpt fork relies on — a forked archive resumes
+    # under the variant's K from the first post-fork round).
+    h.dctcp_k_pkts = fresh.dctcp_k_pkts
+    h.dctcp_k_bytes = fresh.dctcp_k_bytes
     h.data_path = fresh.data_path
     h.strace_mode = getattr(fresh, "strace_mode", None)
     h._send_packet_fn = manager.propagator.send

@@ -235,6 +235,17 @@ class ExperimentalConfig:
     # per-round streams must not buffer a whole sim).  The effective
     # value is recorded in metrics.wall.dispatch.pcap_span_cap.
     pcap_span_cap: int = 64
+    # DCTCP instantaneous marking threshold K (RFC 8257 4.1), the
+    # sweep subsystem's primary congestion-control axis
+    # (docs/SWEEP.md): an ECT(0) packet arriving while the router
+    # queue already holds >= dctcp_k_pkts packets — or >= dctcp_k_bytes
+    # bytes — is rewritten CE.  Defaults are the net/codel.py /
+    # netplane.cpp twin constants (20 pkts / 30000 B); the knob is
+    # SIMULATION-SEMANTIC (in the checkpoint config digest) but
+    # fork-safe (tools/ckpt fork may rewrite it: K shapes future
+    # marking only, never the meaning of snapshotted state).
+    dctcp_k_pkts: int = 20
+    dctcp_k_bytes: int = 30_000
     # Pin worker threads to distinct CPUs (ref: affinity.c, on by
     # default; docs/parallel_sims.md reports ~3x cost when off).
     use_cpu_pinning: bool = True
@@ -329,6 +340,8 @@ class ConfigOptions:
                 "chrome_top_n": e.chrome_top_n,
                 "syscall_observatory": e.syscall_observatory,
                 "pcap_span_cap": e.pcap_span_cap,
+                "dctcp_k_pkts": e.dctcp_k_pkts,
+                "dctcp_k_bytes": e.dctcp_k_bytes,
                 "openssl_crypto_noop": e.openssl_crypto_noop,
                 "use_cpu_pinning": e.use_cpu_pinning,
                 "use_perf_timers": e.use_perf_timers,
@@ -499,6 +512,8 @@ class ConfigOptions:
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
                 ("pcap_span_cap", "pcap_span_cap", int),
+                ("dctcp_k_pkts", "dctcp_k_pkts", int),
+                ("dctcp_k_bytes", "dctcp_k_bytes", units.parse_bytes),
                 ("use_cpu_pinning", "use_cpu_pinning", bool),
                 ("openssl_crypto_noop", "openssl_crypto_noop", bool),
                 ("use_perf_timers", "use_perf_timers", bool),
@@ -534,6 +549,10 @@ class ConfigOptions:
                 f"('off', 'wall', 'on')")
         if experimental.pcap_span_cap < 1:
             raise ValueError("pcap_span_cap must be >= 1")
+        if experimental.dctcp_k_pkts < 1:
+            raise ValueError("dctcp_k_pkts must be >= 1")
+        if experimental.dctcp_k_bytes < 1:
+            raise ValueError("dctcp_k_bytes must be >= 1")
         if experimental.tpu_donate_buffers not in ("off", "on"):
             raise ValueError(
                 f"unknown tpu_donate_buffers "

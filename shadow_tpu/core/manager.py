@@ -239,6 +239,13 @@ class Manager:
                         qdisc=config.experimental.interface_qdisc)
             host.tcp_cc = hcfg.tcp_cc
             host.tcp_ecn = hcfg.tcp_ecn
+            # DCTCP-K marking threshold (sim-global experimental knob;
+            # the sweep subsystem's congestion axis).  Instance attrs
+            # so the router's object-path marking law reads the
+            # configured value; ckpt restore re-applies the RESUMED
+            # config's values over the pickled ones.
+            host.dctcp_k_pkts = config.experimental.dctcp_k_pkts
+            host.dctcp_k_bytes = config.experimental.dctcp_k_bytes
             if config.experimental.host_cpu_threshold_ns is not None:
                 from shadow_tpu.host.cpu import Cpu
                 host.cpu = Cpu(
@@ -335,6 +342,12 @@ class Manager:
                     if host.cpu is None and \
                             config.hosts[host.name].native_dataplane:
                         self.plane.add_host(host, qdisc_rr)
+                # Engine-global DCTCP-K (CoDelN::push reads it): set
+                # from config — never snapshotted, so a forked archive
+                # resumes under the VARIANT's K (tools/ckpt fork).
+                self.plane.engine.set_dctcp_k(
+                    config.experimental.dctcp_k_pkts,
+                    config.experimental.dctcp_k_bytes)
             elif native_mode == "on":
                 raise RuntimeError(
                     f"native_dataplane=on but the engine is unavailable: "
@@ -1524,6 +1537,11 @@ class Manager:
         # (BASELINE.md r6 documents the corrupting combination).
         runner.donate = \
             self.config.experimental.tpu_donate_buffers == "on"
+        # DCTCP-K marking threshold: compile-time closure constants of
+        # the jitted kernels (config-constant per Manager; part of the
+        # kernel cache key).
+        runner.dctcp_k = (self.config.experimental.dctcp_k_pkts,
+                          self.config.experimental.dctcp_k_bytes)
         # Sharded device spans (ISSUE 11): under tpu_shards > 1 the
         # runners inherit the mesh propagator's device mesh, so whole
         # conservative windows iterate on device with the host axis

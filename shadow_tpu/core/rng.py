@@ -74,6 +74,8 @@ STREAM_PACKET_LOSS = 1
 STREAM_HOST = 2  # per-host general-purpose stream (ports, auxv, jitter)
 STREAM_JITTER = 3
 STREAM_EXAMPLE_BATCH = 101  # synthetic dry-run inputs (parallel/round_step)
+STREAM_RPC_SIZE = 102  # heavy-tailed RPC sizes (tools/netgen rpc_burst)
+STREAM_SURROGATE = 103  # GNN parameter init (surrogate/model.py)
 
 
 def mix_key(seed: int, stream: int):

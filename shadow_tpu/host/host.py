@@ -55,6 +55,12 @@ class Host:
     # constructions and older snapshots get the reno/no-ECN stack.
     tcp_cc = "reno"
     tcp_ecn = False
+    # DCTCP marking threshold (experimental.dctcp_k_pkts/_bytes; the
+    # manager overrides at build and ckpt restore re-applies the
+    # RESUMED config's values — K is config, not snapshotted state, so
+    # `tools/ckpt fork` can sweep it from one warm archive).
+    dctcp_k_pkts = 20
+    dctcp_k_bytes = 30_000
 
     def __init__(self, host_id: int, name: str, ip: int, node_index: int,
                  seed: int, bw_down_bits: int, bw_up_bits: int,

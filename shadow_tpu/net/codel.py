@@ -82,13 +82,18 @@ class CoDelQueue:
         if on_drop is not None:
             on_drop(packet)
 
-    def push(self, packet, now: int, on_drop=None, on_mark=None) -> bool:
+    def push(self, packet, now: int, on_drop=None, on_mark=None,
+             k_pkts: int = DCTCP_K_PKTS,
+             k_bytes: int = DCTCP_K_BYTES) -> bool:
         """Returns False (and drops) only at the hard limit.  An
         ECN-capable (ECT) packet that clears the hard limit but meets
         the DCTCP-K instantaneous threshold is marked CE and enqueued
         normally; `on_mark(cause)` attributes the mark to the MARK_*
         leg that fired (trace/events.py) — cause-only, so the router
-        can pass the host's bound counter method directly."""
+        can pass the host's bound counter method directly.  K is a
+        parameter (experimental.dctcp_k_pkts/_bytes — the sweep
+        subsystem's congestion axis); the module constants stay the
+        twinned defaults."""
         self.enqueued_count += 1
         self.enqueued_bytes += packet.total_size()
         if len(self._q) >= HARD_LIMIT:
@@ -96,9 +101,9 @@ class CoDelQueue:
             return False
         if packet.ecn == pkt.ECN_ECT0:
             cause = -1
-            if len(self._q) >= DCTCP_K_PKTS:
+            if len(self._q) >= k_pkts:
                 cause = MARK_THRESH_PKTS
-            elif self._bytes >= DCTCP_K_BYTES:
+            elif self._bytes >= k_bytes:
                 cause = MARK_THRESH_BYTES
             if cause >= 0:
                 packet.ecn = pkt.ECN_CE

@@ -28,7 +28,9 @@ class Router:
         host (Host::execute packet branch, host.rs:783-786)."""
         if self._inbound.push(packet, host.now(),
                               lambda p: host.trace_drop(p, "rtr-limit"),
-                              host.count_mark):
+                              host.count_mark,
+                              k_pkts=host.dctcp_k_pkts,
+                              k_bytes=host.dctcp_k_bytes):
             host.notify_router_has_packets()
 
     def pop_inbound(self, host, now: int):

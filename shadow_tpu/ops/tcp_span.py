@@ -132,7 +132,7 @@ TEL_FIELDS = (("cwnd", "c_cwnd"), ("ssthresh", "c_ssthresh"),
               ("srtt", "c_srtt"), ("rto", "c_rto"),
               ("backoff", "c_rtobackoff"), ("sndbuf", "c_sblen"),
               ("rcvbuf", "c_rblen"), ("rtx", "c_rtxcount"),
-              ("sacks", "c_sackskip"))
+              ("sacks", "c_sackskip"), ("marks", "c_ceseen"))
 ST_ESTABLISHED = 4  # every in-domain connection's state
 
 # Packet columns: routing identity + the TCP header + the IP ECN
@@ -203,7 +203,7 @@ RESIDENT_CARRIED = frozenset(
      "c_ssa", "c_ssthresh", "c_status", "c_tmrdl", "c_tsrecent",
      "c_wakep", "c_fbyte", "c_lbyte", "c_bin", "c_bout",
      "c_ece", "c_cwrp", "c_cwrend", "c_alpha", "c_ceack",
-     "c_totack", "c_dwend",
+     "c_totack", "c_dwend", "c_ceseen",
      "codel_bytes", "codel_count", "codel_drop_next",
      "codel_dropped", "codel_dropping", "codel_first_above",
      "codel_enq_pkts", "codel_enq_bytes", "codel_drop_bytes",
@@ -319,6 +319,9 @@ class TcpSpanRunner(SpanMeshMixin):
         # on device; the driver packs the ACTIVE hosts into FB_REC
         # records at span commit.
         self.fabric = None
+        # DCTCP-K marking threshold (experimental.dctcp_k_pkts/_bytes;
+        # the manager overrides) — static kernel closure constants.
+        self.dctcp_k = (DCTCP_K_PKTS, DCTCP_K_BYTES)
 
     def _caps(self):
         return (self.CAP_I, self.CAP_T, self.CAP_CQ, self.CAP_RT,
@@ -417,7 +420,7 @@ class TcpSpanRunner(SpanMeshMixin):
                   "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
                   "c_atlast", "c_awaitseq", "c_agot", "c_atotal",
                   "c_fbyte", "c_lbyte", "c_bin", "c_bout",
-                  "c_alpha", "c_ceack", "c_totack"):
+                  "c_alpha", "c_ceack", "c_totack", "c_ceseen"):
             st[k] = f(k, np.int64)
         st["rtx_len"] = f("rtx_len", np.int32)
         st["rtx_seq"] = f("rtx_seq", np.uint32, (CC, RT))
@@ -569,7 +572,7 @@ class TcpSpanRunner(SpanMeshMixin):
                   "c_sackskip", "c_tmrdl", "c_atcopied", "c_atspace",
                   "c_atlast", "c_awaitseq", "c_agot",
                   "c_fbyte", "c_lbyte", "c_bin", "c_bout",
-                  "c_alpha", "c_ceack", "c_totack"):
+                  "c_alpha", "c_ceack", "c_totack", "c_ceseen"):
             out[k] = npv(k).astype(np.int64).tobytes()
         for k in ("c_ssa", "c_dupacks", "c_rtobackoff"):
             out[k] = npv(k).astype(np.int32).tobytes()
@@ -598,7 +601,7 @@ class TcpSpanRunner(SpanMeshMixin):
         key = (self._H, self._CC, self._caps(), self.cap_out,
                self.cap_tr, self.tracing, self.fused,
                self._netstat_params(), self._fabric_params(),
-               self.mesh, self.exchange_cap)
+               self.dctcp_k, self.mesh, self.exchange_cap)
         fn = _FN_CACHE.get(key)
         if fn is None:
             fn = _FN_CACHE[key] = self._build()
@@ -622,6 +625,9 @@ class TcpSpanRunner(SpanMeshMixin):
         TELR = self.TEL_ROWS
         fabric, fab_iv = self._fabric_params()
         FABR = self.FAB_ROWS
+        # DCTCP-K marking threshold: static closure constants (config-
+        # constant per Manager; part of the _FN_CACHE key).
+        k_pkts, k_bytes = self.dctcp_k
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)
         COOB = jnp.int32(CC + 1)
@@ -1300,7 +1306,8 @@ class TcpSpanRunner(SpanMeshMixin):
             cwr_in = mask & ecnact & ((pk["tflags"] & F_CWR) != 0)
             st = cset(st, cwr_in, c_ece=jnp.int32(0))
             ce_in = mask & ecnact & (pk["ecn"] == ECN_CE)
-            st = cset(st, ce_in, c_ece=jnp.int32(1))
+            st = cset(st, ce_in, c_ece=jnp.int32(1),
+                      c_ceseen=cg(st, "c_ceseen") + 1)
             # RFC 7323 ts_recent update (covering the ack point)
             span = jnp.maximum(plen, 1)
             upd = mask & (pk["tsv"] != 0) \
@@ -1902,9 +1909,9 @@ class TcpSpanRunner(SpanMeshMixin):
             # leg first — is rewritten to CE and enqueued normally.
             depth = s_i64(st["cq_len"] - st["cq_pos"])
             ect = arr & (pk_arr["ecn"] == ECN_ECT0)
-            mark_p = ect & (depth >= DCTCP_K_PKTS)
+            mark_p = ect & (depth >= k_pkts)
             mark_b = ect & ~mark_p \
-                & (st["codel_bytes"] >= DCTCP_K_BYTES)
+                & (st["codel_bytes"] >= k_bytes)
             mark = mark_p | mark_b
             st["codel_marked"] = jnp.where(
                 mark, st["codel_marked"] + 1, st["codel_marked"])

@@ -1,0 +1,14 @@
+"""Learned fabric surrogate (docs/SWEEP.md "Surrogate").
+
+A small pure-JAX message-passing GNN in the RouteNet shape
+(arXiv 1910.01508): link-state and flow-state embeddings coupled along
+flow paths derived from each campaign point's topology, trained on
+sweep datasets to predict per-flow FCT and per-link peak queue depth,
+validated against held-out simulated fabrics.
+
+- features.py — dataset -> per-point graph samples (paths via
+  deterministic Dijkstra over the recorded topology)
+- model.py    — the GNN: counter-based threefry init, forward pass
+- train.py    — hand-rolled Adam loop, held-out split, the
+  surrogate-vs-simulator per-quantile error table
+"""

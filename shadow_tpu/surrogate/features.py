@@ -1,0 +1,157 @@
+"""Dataset -> per-point graph samples for the surrogate.
+
+Entities follow RouteNet: LINKS are the directed edges of the point's
+recorded topology (both directions of every undirected GML edge,
+self-edges included — they are the intra-node host hop), FLOWS are
+the dataset's receiver-vantage FCT rows, each carrying the sequence
+of links its path crosses.  Paths come from a deterministic Dijkstra
+(integer latency weights, lowest-index tie-break) over the SAME
+topology the simulator routed on, so the surrogate sees the routing
+the fabric actually used.
+
+Per-link supervision: the peak sampled CoDel depth of the hosts at
+the link's destination node (the inbound queue the link feeds); links
+whose destination node was never sampled are masked out of the loss.
+
+All features are plain float32 numpy — the model consumes them as-is.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+
+import numpy as np
+
+from shadow_tpu.trace.events import iter_fb_records
+
+
+def directed_links(topo: dict) -> list[tuple[int, int, int]]:
+    """[(src node, dst node, latency_ns)] — both directions of every
+    recorded edge, sorted; the link index space of one sample."""
+    links = set()
+    for u, v, lat in topo["edges"]:
+        links.add((u, v, lat))
+        links.add((v, u, lat))
+    return sorted(links)
+
+
+def shortest_path(links: list, n_nodes: int, src: int,
+                  dst: int) -> list[int]:
+    """Link-index sequence of the lowest-latency src->dst node path
+    (Dijkstra, lowest-node-index tie-break — deterministic).  A
+    same-node flow takes the node's self-edge."""
+    if src == dst:
+        for i, (u, v, _lat) in enumerate(links):
+            if u == src and v == src:
+                return [i]
+        return []
+    adj: dict = {}
+    for i, (u, v, lat) in enumerate(links):
+        if u != v:
+            adj.setdefault(u, []).append((v, lat, i))
+    dist = {src: 0}
+    prev: dict = {}
+    heap = [(0, src)]
+    while heap:
+        d, u = heapq.heappop(heap)
+        if u == dst:
+            break
+        if d > dist.get(u, 1 << 62):
+            continue
+        for v, lat, i in sorted(adj.get(u, [])):
+            nd = d + lat
+            if nd < dist.get(v, 1 << 62):
+                dist[v] = nd
+                prev[v] = (u, i)
+                heapq.heappush(heap, (nd, v))
+    if dst not in prev and dst != src:
+        return []
+    path = []
+    node = dst
+    while node != src:
+        u, i = prev[node]
+        path.append(i)
+        node = u
+    return path[::-1]
+
+
+def build_samples(ds) -> list[dict]:
+    """One sample dict per dataset point:
+
+    link_feats (L, 3)  log10 bw_down, log10 latency, is-self-edge
+    flow_feats (F, 6)  log10 flow bytes, cc, dctcp_k/20, load,
+                       log10 fan-in width, path length
+    pairs      (P, 2)  (flow index, link index) path membership
+    flow_t     (F,)    target: log10 FCT seconds... (log10 FCT ns)
+    link_t     (L,)    target: log10(1 + peak CoDel depth at the
+                       link's destination node)
+    link_mask  (L,)    1 where the target is observed
+    """
+    samples = []
+    for idx, pm in enumerate(ds.meta["points"]):
+        topo = pm["topo"]
+        feats = pm["features"]
+        links = directed_links(topo)
+        n_nodes = len(topo["nodes"])
+        bw = {n["index"]: max(n["bw_down"], 1)
+              for n in topo["nodes"]}
+        link_feats = np.array(
+            [[math.log10(bw[v]), math.log10(max(lat, 1)),
+              1.0 if u == v else 0.0]
+             for u, v, lat in links], dtype=np.float32)
+        host_node = {int(h): n for h, n in topo["hosts"].items()}
+        ip_host = {int(ip): h for ip, h in topo["host_ips"].items()}
+
+        # Per-node peak sampled queue depth (FB records are per host).
+        node_peak = {}
+        for rec in iter_fb_records(ds.link_blobs[idx]):
+            node = host_node.get(rec[1])
+            if node is None:
+                continue
+            node_peak[node] = max(node_peak.get(node, 0), rec[3])
+        link_t = np.array(
+            [math.log10(1 + node_peak.get(v, 0)) for _u, v, _l
+             in links], dtype=np.float32)
+        link_mask = np.array(
+            [1.0 if v in node_peak else 0.0 for _u, v, _l in links],
+            dtype=np.float32)
+
+        flow_feats, flow_t, pairs = [], [], []
+        path_cache: dict = {}
+        width = max(feats["fan_in"], feats["n_leaf"], 1)
+        for row in ds.point_flows(idx):
+            (t0, t1, host, _lp, _rp, rip, _flags, bin_, bout, _rtx,
+             _marks) = row
+            dst_node = host_node[host]
+            peer = ip_host.get(rip)
+            src_node = (host_node[peer] if peer is not None
+                        else dst_node)
+            key = (src_node, dst_node)
+            if key not in path_cache:
+                path_cache[key] = shortest_path(links, n_nodes,
+                                                src_node, dst_node)
+            path = path_cache[key]
+            fi = len(flow_feats)
+            flow_feats.append([
+                math.log10(max(bin_, bout, 1)),
+                1.0 if feats["cc"] == "dctcp" else 0.0,
+                feats["dctcp_k"] / 20.0,
+                feats["load"],
+                math.log10(width + 1),
+                float(len(path)),
+            ])
+            flow_t.append(math.log10(max(t1 - t0, 1)))
+            pairs.extend((fi, li) for li in path)
+        samples.append({
+            "point_id": pm["point_id"],
+            "features": feats,
+            "link_feats": link_feats,
+            "flow_feats": np.array(flow_feats, dtype=np.float32),
+            "pairs": (np.array(pairs, dtype=np.int32)
+                      if pairs else np.zeros((0, 2), np.int32)),
+            "flow_t": np.array(flow_t, dtype=np.float32),
+            "link_t": link_t,
+            "link_mask": link_mask,
+        })
+    return samples

@@ -1,0 +1,122 @@
+"""The fabric surrogate: a small pure-JAX message-passing GNN in the
+RouteNet shape (arXiv 1910.01508).
+
+Two entity sets — links and flows — carry hidden states.  T rounds of
+coupled updates: every flow aggregates the states of the links on its
+path and updates; every link aggregates the states of the flows
+crossing it and updates.  Readout MLPs map the final states to
+per-flow log10 FCT and per-link log10(1 + peak queue depth).
+
+Initialization is COUNTER-BASED threefry (core/rng.py — the repo's
+one RNG), each parameter tensor filled from its own counter range, so
+`init_params(seed)` is bit-reproducible with no global RNG state and
+training runs are deterministic end to end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu.core.rng import (STREAM_SURROGATE, mix_key,
+                                 threefry2x32_np)
+
+HIDDEN = 32
+T_STEPS = 4
+LINK_IN = 3   # features.py link_feats width
+FLOW_IN = 6   # features.py flow_feats width
+
+
+def _fill(seed: int, tensor_idx: int, shape: tuple,
+          scale: float) -> np.ndarray:
+    """Deterministic uniform(-scale, scale) tensor from threefry
+    counters (tensor_idx, element_idx) — order-free, so two inits of
+    the same seed agree bit-for-bit."""
+    n = int(np.prod(shape))
+    k0, k1 = mix_key(seed, STREAM_SURROGATE)
+    b0, _b1 = threefry2x32_np(
+        np.uint32(k0), np.uint32(k1),
+        np.full(n, tensor_idx, np.uint32),
+        np.arange(n, dtype=np.uint32))
+    u = b0.astype(np.float64) / float(1 << 32)  # [0, 1)
+    return ((u * 2.0 - 1.0) * scale).astype(np.float32).reshape(shape)
+
+
+def _dense(seed, idx, n_in, n_out):
+    scale = float(np.sqrt(6.0 / (n_in + n_out)))
+    return {"w": _fill(seed, idx, (n_in, n_out), scale),
+            "b": np.zeros(n_out, np.float32)}
+
+
+def init_params(seed: int) -> dict:
+    """All model parameters as a {name: {w, b}} pytree of numpy
+    arrays (JAX consumes them as-is)."""
+    H = HIDDEN
+    return {
+        "link_embed": _dense(seed, 1, LINK_IN, H),
+        "flow_embed": _dense(seed, 2, FLOW_IN, H),
+        "flow_upd": _dense(seed, 3, 2 * H, H),
+        "link_upd": _dense(seed, 4, 2 * H, H),
+        "flow_out1": _dense(seed, 5, H, H),
+        "flow_out2": _dense(seed, 6, H, 1),
+        "link_out1": _dense(seed, 7, H, H),
+        "link_out2": _dense(seed, 8, H, 1),
+    }
+
+
+def forward(params: dict, sample: dict):
+    """(flow_pred (F,), link_pred (L,)) for one point sample.  Pure
+    jnp; jit-compiled per sample shape by the caller."""
+    import jax.numpy as jnp
+
+    def dense(p, x):
+        return x @ p["w"] + p["b"]
+
+    lf = jnp.asarray(sample["link_feats"])
+    ff = jnp.asarray(sample["flow_feats"])
+    pairs = jnp.asarray(sample["pairs"])
+    L = lf.shape[0]
+    F = ff.shape[0]
+    fi, li = pairs[:, 0], pairs[:, 1]
+    link_h = jnp.tanh(dense(params["link_embed"], lf))
+    flow_h = jnp.tanh(dense(params["flow_embed"], ff))
+    for _ in range(T_STEPS):
+        # flow reads its path's link states (sum-aggregated) …
+        m_f = jnp.zeros((F, HIDDEN)).at[fi].add(link_h[li])
+        flow_h = jnp.tanh(dense(params["flow_upd"],
+                                jnp.concatenate([flow_h, m_f], 1)))
+        # … then each link reads the flows crossing it.
+        m_l = jnp.zeros((L, HIDDEN)).at[li].add(flow_h[fi])
+        link_h = jnp.tanh(dense(params["link_upd"],
+                                jnp.concatenate([link_h, m_l], 1)))
+    flow_pred = dense(params["flow_out2"],
+                      jnp.tanh(dense(params["flow_out1"],
+                                     flow_h)))[:, 0]
+    link_pred = dense(params["link_out2"],
+                      jnp.tanh(dense(params["link_out1"],
+                                     link_h)))[:, 0]
+    return flow_pred, link_pred
+
+
+def save(path: str, params: dict, meta: dict) -> None:
+    """Flat .npz (numpy's container): parameters under
+    '<layer>.<w|b>', the training metadata as a JSON sidecar
+    string."""
+    import json
+    flat = {f"{k}.{kk}": v for k, v in params.items()
+            for kk, v in v.items()}
+    flat["__meta__"] = np.frombuffer(
+        json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8)
+    np.savez(path, **flat)
+
+
+def load(path: str):
+    import json
+    z = np.load(path)
+    meta = json.loads(bytes(z["__meta__"]).decode())
+    params: dict = {}
+    for k in z.files:
+        if k == "__meta__":
+            continue
+        layer, kk = k.rsplit(".", 1)
+        params.setdefault(layer, {})[kk] = z[k]
+    return params, meta

@@ -1,0 +1,158 @@
+"""Surrogate training + held-out-fabric validation.
+
+Hand-rolled Adam (no optimizer dependency) over the summed per-point
+loss: flow-FCT MSE in log10 space plus the masked per-link
+peak-queue MSE.  Everything is deterministic: threefry init, fixed
+sample order (the dataset's matrix order), full-batch gradients.
+
+The VALIDATION PROTOCOL is held-out-fabric (docs/SWEEP.md): the
+holdout predicate names a feature and a threshold — e.g.
+("fan_in", 16) trains on every point with fan_in < 16 and evaluates
+on fan_in >= 16; ("n_leaf", 16) is the leaf-spine size split.  The
+error table reports, per held-out point, the relative error of the
+PREDICTED FCT quantiles against the simulator's (quantiles taken
+over each point's flow population — the tail numbers the sweep
+exists to measure), plus the peak-queue relative error.  Honest by
+construction: the table is computed fresh from the held-out samples
+every time and recorded even when the errors are embarrassing.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from shadow_tpu.surrogate import model as model_mod
+from shadow_tpu.trace.fabricstat import percentile
+
+QUANTILES = (("p50", 500), ("p99", 990), ("p999", 999))
+
+
+def split_samples(samples: list, holdout_feature: str,
+                  holdout_min) -> tuple[list, list]:
+    """(train, held_out): a sample is held out iff its point's
+    `holdout_feature` is >= holdout_min (equality included — the
+    held-out fabric is never trained on).  Only NUMERIC features
+    split; a string feature (cc, scenario, size_law) is refused with
+    the valid names listed."""
+    train, held = [], []
+    for s in samples:
+        v = s["features"].get(holdout_feature, 0)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            numeric = sorted(k for k, fv in s["features"].items()
+                             if isinstance(fv, (int, float))
+                             and not isinstance(fv, bool))
+            raise ValueError(
+                f"holdout feature {holdout_feature!r} is not "
+                f"numeric (value {v!r}); numeric features: "
+                f"{numeric}")
+        (held if v >= holdout_min else train).append(s)
+    return train, held
+
+
+ARRAY_KEYS = ("link_feats", "flow_feats", "pairs", "flow_t",
+              "link_t", "link_mask")
+
+
+def _arrays(sample: dict) -> dict:
+    """The jit-traceable slice of a sample (the id/feature strings
+    stay outside the traced pytree)."""
+    return {k: sample[k] for k in ARRAY_KEYS}
+
+
+def _loss_fn(params, arrs):
+    import jax.numpy as jnp
+    flow_pred, link_pred = model_mod.forward(params, arrs)
+    fl = jnp.mean((flow_pred - jnp.asarray(arrs["flow_t"])) ** 2)
+    mask = jnp.asarray(arrs["link_mask"])
+    ll = jnp.sum(mask * (link_pred
+                         - jnp.asarray(arrs["link_t"])) ** 2) \
+        / jnp.maximum(mask.sum(), 1.0)
+    return fl + 0.5 * ll
+
+
+def train(samples: list, seed: int = 1, steps: int = 300,
+          lr: float = 3e-3,
+          log=None) -> tuple[dict, list]:
+    """Adam over the summed per-sample loss.  Returns (params,
+    loss_history) — the history is what the loss-decreases smoke
+    gate asserts on."""
+    import jax
+    import jax.numpy as jnp
+
+    if not samples:
+        raise ValueError("no training samples (is the holdout "
+                         "predicate eating the whole campaign?)")
+    params = jax.tree_util.tree_map(jnp.asarray,
+                                    model_mod.init_params(seed))
+    grad_fn = jax.jit(jax.value_and_grad(_loss_fn))
+    m = jax.tree_util.tree_map(jnp.zeros_like, params)
+    v = jax.tree_util.tree_map(jnp.zeros_like, params)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    history = []
+    for step in range(1, steps + 1):
+        total = 0.0
+        grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        for s in samples:
+            loss, g = grad_fn(params, _arrays(s))
+            total += float(loss)
+            grads = jax.tree_util.tree_map(jnp.add, grads, g)
+        m = jax.tree_util.tree_map(
+            lambda mm, gg: b1 * mm + (1 - b1) * gg, m, grads)
+        v = jax.tree_util.tree_map(
+            lambda vv, gg: b2 * vv + (1 - b2) * gg * gg, v, grads)
+        scale = lr * math.sqrt(1 - b2 ** step) / (1 - b1 ** step)
+        params = jax.tree_util.tree_map(
+            lambda p, mm, vv: p - scale * mm / (jnp.sqrt(vv) + eps),
+            params, m, v)
+        history.append(total / len(samples))
+        if log is not None and (step % 50 == 0 or step == 1):
+            log(f"surrogate: step {step:>4} loss {history[-1]:.4f}")
+    params = jax.tree_util.tree_map(np.asarray, params)
+    return params, history
+
+
+def predict(params: dict, sample: dict):
+    """(flow FCT ns predictions, per-link peak-depth predictions) in
+    LINEAR units."""
+    flow_pred, link_pred = model_mod.forward(params, sample)
+    fct_ns = np.power(10.0, np.asarray(flow_pred)).astype(np.float64)
+    peak = np.power(10.0, np.asarray(link_pred)) - 1.0
+    return fct_ns, np.maximum(peak, 0.0)
+
+
+def error_table(params: dict, held_out: list) -> dict:
+    """The surrogate-vs-simulator table `bench[sweep-*]` records: per
+    held-out point, relative error of each predicted FCT quantile
+    (over the point's flows) and of the predicted peak queue depth;
+    plus the mean absolute relative error per quantile."""
+    rows = []
+    for s in held_out:
+        pred_ns, pred_peak = predict(params, s)
+        sim_ns = np.power(10.0, s["flow_t"].astype(np.float64))
+        row = {"point_id": s["point_id"],
+               "flows": int(len(sim_ns))}
+        for name, permille in QUANTILES:
+            sim_q = percentile(sorted(sim_ns.tolist()), permille)
+            pred_q = percentile(sorted(pred_ns.tolist()), permille)
+            row[f"sim_{name}_ns"] = int(sim_q)
+            row[f"pred_{name}_ns"] = int(pred_q)
+            row[f"rel_err_{name}"] = round(
+                abs(pred_q - sim_q) / max(sim_q, 1), 4)
+        mask = s["link_mask"] > 0
+        if mask.any():
+            sim_peak = float(np.max(
+                np.power(10.0, s["link_t"][mask]) - 1.0))
+            pk = float(np.max(pred_peak[mask]))
+            row["sim_peak_queue"] = round(sim_peak, 1)
+            row["pred_peak_queue"] = round(pk, 1)
+            row["rel_err_peak"] = round(
+                abs(pk - sim_peak) / max(sim_peak, 1.0), 4)
+        rows.append(row)
+    out = {"points": rows}
+    for name, _p in QUANTILES:
+        errs = [r[f"rel_err_{name}"] for r in rows]
+        out[f"mean_rel_err_{name}"] = (round(sum(errs) / len(errs), 4)
+                                       if errs else None)
+    return out

@@ -1,0 +1,10 @@
+"""Experiment-fleet subsystem: declarative tail-latency campaigns
+(docs/SWEEP.md).
+
+- spec.py     — campaign spec -> deterministic run matrix
+- runner.py   — identity-safe subprocess execution, optional
+                warm-start forking on the checkpoint substrate
+- dataset.py  — per-point artifacts -> ONE canonical byte-stable
+                dataset + tail-curve tables
+- point.py    — the per-point subprocess entry
+"""

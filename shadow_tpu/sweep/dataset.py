@@ -1,0 +1,224 @@
+"""Campaign dataset: every point's deterministic channels pulled into
+ONE canonical, byte-stable artifact, plus tail-curve tables.
+
+File layout (`<name>.swds`)::
+
+    DS_HDR    magic "SWDS", version, meta/flows/links byte lengths
+    meta      sorted-key compact JSON: the normalized spec, the
+              ordered per-point table (config features, topology,
+              counts, conservation verdicts, tail-curve tables)
+    flows     per point, concatenated FCT_REC records — the RECEIVER
+              vantage rows (trace/fabricstat.receiver_rows: one row
+              per flow), sorted by full field tuple
+    links     per point, concatenated FB_REC records (the per-link
+              queue series, already canonically ordered)
+
+Everything that reaches the bytes is either a deterministic channel
+or a sorted-key JSON of spec-derived values, so the same spec always
+yields the same file (tests/test_sweep.py runs a 2-point campaign
+twice and byte-compares).  Wall times, logs, and subprocess output
+never enter.
+
+Aggregation is fail-closed: a missing channel, a conservation
+violation, a dataset/channel flow-count mismatch, or a quantile
+inversion (p50 > p99 etc.) raises DatasetError — `bench[sweep-*]`
+refuses to record on exactly these errors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+from shadow_tpu.sweep import spec as spec_mod
+from shadow_tpu.trace.events import (FCT_REC, FCT_REC_BYTES,
+                                     FB_REC_BYTES, iter_fct_records,
+                                     split_fabric)
+from shadow_tpu.trace.fabricstat import percentile, receiver_rows
+
+DS_MAGIC = 0x53445753  # "SWDS"
+DS_VERSION = 1
+DS_HDR = struct.Struct("<IIQQQ")
+DS_HDR_BYTES = 32
+assert DS_HDR.size == DS_HDR_BYTES
+
+
+class DatasetError(RuntimeError):
+    """Any aggregation failure (missing channel, conservation or
+    identity violation) — campaigns fail loudly, never silently
+    under-collect."""
+
+
+def _point_quantiles(durs: list) -> dict:
+    durs = sorted(durs)
+    q = {"p50_ns": percentile(durs, 500),
+         "p99_ns": percentile(durs, 990),
+         "p999_ns": percentile(durs, 999)}
+    if not (q["p50_ns"] <= q["p99_ns"] <= q["p999_ns"]):
+        raise DatasetError(f"quantile inversion: {q}")
+    return q
+
+
+def tail_curves(points_meta: list) -> list:
+    """p50/p99/p999 FCT vs offered load, one curve per combination of
+    every non-load feature (the spec's other axes + seed), ordered by
+    curve key then load.  `p99_monotone_frac` is the fraction of
+    adjacent load steps where p99 does not decrease — recorded
+    honestly (queueing says it should mostly rise; the number says
+    whether it did)."""
+    curves: dict = {}
+    for pm in points_meta:
+        f = pm["features"]
+        key = json.dumps(
+            {k: v for k, v in sorted(f.items()) if k != "load"
+             and k != "nbytes"},
+            sort_keys=True)
+        curves.setdefault(key, []).append(
+            (f["load"],
+             {"load": f["load"], "flows": pm["counts"]["flows"],
+              **pm["quantiles"]}))
+    out = []
+    for key in sorted(curves):
+        rows = [r for _load, r in sorted(curves[key],
+                                         key=lambda lr: lr[0])]
+        steps = [(a["p99_ns"], b["p99_ns"])
+                 for a, b in zip(rows, rows[1:])]
+        frac = (sum(1 for a, b in steps if b >= a) / len(steps)
+                if steps else 1.0)
+        out.append({"key": json.loads(key), "rows": rows,
+                    "p99_monotone_frac": round(frac, 4)})
+    return out
+
+
+def aggregate(spec: dict, out_dir: str) -> "Dataset":
+    """Read every point directory under `out_dir` (the runner's
+    manifest order == the spec's matrix order) into a Dataset."""
+    spec = spec_mod.validate_spec(spec)
+    points = spec_mod.expand(spec)
+    manifest_path = os.path.join(out_dir, "manifest.json")
+    warm: dict = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            warm = {pid: ent.get("warm_started", False)
+                    for pid, ent in
+                    json.load(f)["points"].items()}
+    metas: list = []
+    flow_blobs: list = []
+    link_blobs: list = []
+    for p in points:
+        pdir = os.path.join(out_dir, p["point_id"])
+        fab_path = os.path.join(pdir, "fabric-sim.bin")
+        pj_path = os.path.join(pdir, "point.json")
+        topo_path = os.path.join(pdir, "topo.json")
+        missing = [os.path.basename(f) for f in
+                   (fab_path, pj_path, topo_path)
+                   if not os.path.exists(f)]
+        if missing:
+            raise DatasetError(f"{p['point_id']}: missing "
+                               f"{', '.join(missing)} under {pdir}")
+        with open(pj_path) as f:
+            pj = json.load(f)
+        if pj.get("conservation") != "ok":
+            raise DatasetError(f"{p['point_id']}: fabric conservation "
+                               f"violated: {pj.get('conservation')}")
+        with open(fab_path, "rb") as f:
+            fb_bytes, fct_bytes = split_fabric(f.read())
+        endpoint_rows = list(iter_fct_records(fct_bytes))
+        flows = sorted(receiver_rows(endpoint_rows))
+        # THE aggregator conservation gate: the dataset's flow count
+        # must equal the FCT channel's receiver-vantage row count AND
+        # the summary the point subprocess recorded from live state.
+        if len(flows) != pj.get("flows", -1):
+            raise DatasetError(
+                f"{p['point_id']}: dataset flow count {len(flows)} "
+                f"!= point summary {pj.get('flows')}")
+        with open(topo_path) as f:
+            topo = json.load(f)
+        durs = [r[1] - r[0] for r in flows]
+        if not durs:
+            raise DatasetError(f"{p['point_id']}: no flows carried "
+                               f"payload — nothing to learn from")
+        metas.append({
+            "point_id": p["point_id"],
+            "seed": p["seed"],
+            "axes": p["axes"],
+            "features": spec_mod.point_features(spec, p),
+            "topo": topo,
+            "counts": {"flows": len(flows),
+                       "endpoints": len(endpoint_rows),
+                       "links": len(fb_bytes) // FB_REC_BYTES},
+            "quantiles": _point_quantiles(durs),
+            "marked_pkts": pj.get("marked_pkts", 0),
+            "peak_queue_depth": pj.get("peak_queue_depth", 0),
+            "warm_started": warm.get(p["point_id"], False),
+        })
+        flow_blobs.append(b"".join(FCT_REC.pack(*r) for r in flows))
+        link_blobs.append(fb_bytes)
+    meta = {
+        "version": DS_VERSION,
+        "name": spec["name"],
+        "spec": spec,
+        "points": metas,
+        "tail_curves": tail_curves(metas),
+    }
+    return Dataset(meta, flow_blobs, link_blobs)
+
+
+class Dataset:
+    """One aggregated campaign: `meta` (the JSON dict above) plus the
+    per-point packed record blobs, in matrix order."""
+
+    def __init__(self, meta: dict, flow_blobs: list,
+                 link_blobs: list):
+        self.meta = meta
+        self.flow_blobs = flow_blobs
+        self.link_blobs = link_blobs
+
+    def to_bytes(self) -> bytes:
+        mb = json.dumps(self.meta, sort_keys=True,
+                        separators=(",", ":")).encode()
+        fb = b"".join(self.flow_blobs)
+        lb = b"".join(self.link_blobs)
+        return DS_HDR.pack(DS_MAGIC, DS_VERSION, len(mb), len(fb),
+                           len(lb)) + mb + fb + lb
+
+    def write(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.to_bytes())
+
+    def point_flows(self, idx: int):
+        """Point idx's flow rows as FCT field tuples."""
+        return list(iter_fct_records(self.flow_blobs[idx]))
+
+
+def load(path: str) -> Dataset:
+    with open(path, "rb") as f:
+        buf = f.read()
+    if len(buf) < DS_HDR_BYTES:
+        raise DatasetError(f"{path}: shorter than a dataset header")
+    magic, version, mlen, flen, llen = DS_HDR.unpack_from(buf, 0)
+    if magic != DS_MAGIC:
+        raise DatasetError(f"{path}: not a sweep dataset "
+                           f"(magic {magic:#x})")
+    if version != DS_VERSION:
+        raise DatasetError(f"{path}: dataset version {version} != "
+                           f"supported {DS_VERSION}")
+    if len(buf) != DS_HDR_BYTES + mlen + flen + llen:
+        raise DatasetError(f"{path}: truncated dataset")
+    meta = json.loads(buf[DS_HDR_BYTES:DS_HDR_BYTES + mlen].decode())
+    flows = buf[DS_HDR_BYTES + mlen:DS_HDR_BYTES + mlen + flen]
+    links = buf[DS_HDR_BYTES + mlen + flen:]
+    flow_blobs, link_blobs = [], []
+    fo = lo = 0
+    for pm in meta["points"]:
+        fn = pm["counts"]["flows"] * FCT_REC_BYTES
+        ln = pm["counts"]["links"] * FB_REC_BYTES
+        flow_blobs.append(flows[fo:fo + fn])
+        link_blobs.append(links[lo:lo + ln])
+        fo += fn
+        lo += ln
+    if fo != len(flows) or lo != len(links):
+        raise DatasetError(f"{path}: record sections disagree with "
+                           f"the meta counts")
+    return Dataset(meta, flow_blobs, link_blobs)

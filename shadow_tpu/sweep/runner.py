@@ -1,0 +1,198 @@
+"""Campaign runner: execute the expanded run matrix in identity-safe
+subprocesses, optionally warm-starting fork groups from a shared
+post-ramp checkpoint.
+
+Cold path (the default): one `python -m shadow_tpu.sweep.point`
+subprocess per point, each with its own data directory and the spec's
+per-point wall limit.
+
+Warm path (`warm_start: {at_ms: N}`): points are grouped by their
+fork-group key (sweep/spec.expand — everything but the fork-safe
+axes).  Each group runs ONE ramp subprocess (the group's first point,
+with a checkpoint scheduled at the warm-start instant), the snapshot
+is forked per point via ckpt/fork.fork_archive (digest re-stamped for
+the point's dctcp_k variant), and each point's subprocess RESUMES its
+forked archive.  Warm-started variants share the ramp's bytes by
+construction — the dataset records `warm_started` so nobody mistakes
+a forked point for a cold run of the same config.
+
+Determinism: subprocess stdout/stderr and wall times go to
+`run.json`-adjacent logs, never into the dataset; the dataset reads
+only the deterministic channels.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+from shadow_tpu.sweep import spec as spec_mod
+
+
+class PointFailure(RuntimeError):
+    """A campaign point exited nonzero / timed out; the campaign
+    fails loudly rather than aggregating a hole."""
+
+
+def _point_env() -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _run_sub(task: dict, task_path: str, log_path: str,
+             time_limit_s: float) -> None:
+    with open(task_path, "w") as f:
+        json.dump(task, f)
+    with open(log_path, "w") as log:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "shadow_tpu.sweep.point",
+                 task_path],
+                stdout=log, stderr=subprocess.STDOUT,
+                env=_point_env(), timeout=time_limit_s,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))
+        except subprocess.TimeoutExpired:
+            raise PointFailure(
+                f"{os.path.basename(task_path)}: exceeded the "
+                f"per-point time limit ({time_limit_s}s) — see "
+                f"{log_path}") from None
+    if proc.returncode != 0:
+        tail = open(log_path).read()[-800:]
+        raise PointFailure(
+            f"{os.path.basename(task_path)}: exit "
+            f"{proc.returncode}\n{tail}")
+
+
+def point_task(spec: dict, point: dict, data_dir: str) -> dict:
+    """THE task-dict recipe for one campaign point — run_campaign and
+    bench's identity re-run both build through here, so the two can
+    never drift into comparing differently-configured runs."""
+    return {
+        "yaml": spec_mod.point_yaml(spec, point),
+        "data_dir": data_dir,
+        "experimental": spec_mod.point_experimental(spec, point),
+        "link_interval_ms": spec_mod.validate_spec(
+            spec)["link_interval_ms"],
+    }
+
+
+# Sim-time headroom the warm-start ramp runs past its checkpoint
+# instant: the snapshot lands at the first conservative-round boundary
+# >= at_ms, so the ramp needs a little room after it — but nothing
+# like the full scenario stop_time (the ramp is overhead; variants do
+# the real running).
+RAMP_HEADROOM_NS = 100_000_000
+
+
+def _scenario_stop_ns(spec: dict) -> int:
+    """The campaign's sim stop time in ns (spec.base or the netgen
+    scenario default) — the warm-start gate needs it to refuse a ramp
+    at/after the end."""
+    from shadow_tpu.utils import units
+    defaults = {"incast": "3s", "rpc_burst": "3s", "leaf_spine": "5s"}
+    stop = spec["base"].get("stop_time",
+                            defaults[spec["scenario"]])
+    return units.parse_time_ns(stop)
+
+
+def run_campaign(spec: dict, out_dir: str,
+                 log=lambda msg: print(msg, file=sys.stderr)) -> dict:
+    """Execute every point of `spec` under `out_dir` (one
+    subdirectory per point, `<point_id>/`).  Returns the manifest
+    {point_id: {dir, warm_started, group}} in matrix order.  Any
+    point failure raises PointFailure — no partial datasets."""
+    spec = spec_mod.validate_spec(spec)
+    points = spec_mod.expand(spec)
+    os.makedirs(out_dir, exist_ok=True)
+    warm = spec["warm_start"]
+    manifest: dict = {}
+    groups: dict = {}
+    for p in points:
+        groups.setdefault(p["group"], []).append(p)
+
+    if warm is not None:
+        ramp_ns = warm["at_ms"] * 1_000_000
+        stop_ns = _scenario_stop_ns(spec)
+        if ramp_ns >= stop_ns:
+            raise spec_mod.SpecError(
+                f"warm_start.at_ms ({warm['at_ms']} ms) is not "
+                f"before the scenario stop_time "
+                f"({stop_ns // 1_000_000} ms)")
+
+    for gname, gpoints in groups.items():
+        snap = None
+        ramp_task = None
+        if warm is not None:
+            # ONE ramp per fork group: the group's first point's
+            # scenario config with the group-base experimental
+            # values, checkpointed at the warm-start boundary and
+            # STOPPED just past it (the full stop_time is the
+            # variants' job; stop_time is fork-safe, so the truncated
+            # ramp archive forks to full-length variants).
+            ramp_ns = warm["at_ms"] * 1_000_000
+            ramp_dir = os.path.join(out_dir, f"ramp.{gname}")
+            os.makedirs(ramp_dir, exist_ok=True)
+            log(f"sweep: ramp [{gname}] -> checkpoint at "
+                f"{warm['at_ms']} ms")
+            ramp_task = point_task(spec, gpoints[0], ramp_dir)
+            ramp_task["checkpoint"] = {"at_ns": [ramp_ns],
+                                       "directory": ramp_dir}
+            ramp_task["stop_time_ns"] = min(
+                _scenario_stop_ns(spec), ramp_ns + RAMP_HEADROOM_NS)
+            _run_sub(ramp_task,
+                     os.path.join(ramp_dir, "task.json"),
+                     os.path.join(ramp_dir, "log.txt"),
+                     spec["time_limit_s"])
+            snap = os.path.join(ramp_dir, f"ckpt-{ramp_ns}.stck")
+            if not os.path.exists(snap):
+                raise PointFailure(
+                    f"ramp [{gname}] wrote no snapshot at "
+                    f"{warm['at_ms']} ms (boundary never reached "
+                    f"before stop_time?)")
+
+        for p in gpoints:
+            pdir = os.path.join(out_dir, p["point_id"])
+            os.makedirs(pdir, exist_ok=True)
+            task = point_task(spec, p, pdir)
+            if snap is not None:
+                task["resume_from"] = _fork_for_point(
+                    ramp_task, task, snap, pdir)
+            log(f"sweep: point {p['point_id']}"
+                + (" (warm)" if snap is not None else ""))
+            _run_sub(task, os.path.join(pdir, "task.json"),
+                     os.path.join(pdir, "log.txt"),
+                     spec["time_limit_s"])
+            manifest[p["point_id"]] = {
+                "dir": pdir, "group": p["group"],
+                "warm_started": snap is not None,
+            }
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump({"spec": spec, "points": manifest}, f,
+                  sort_keys=True, indent=1)
+    return manifest
+
+
+def _fork_for_point(ramp_task, task, snap, pdir) -> str:
+    """Fork the group snapshot into this point's variant archive (the
+    base point resumes its own digest through the same seam, so every
+    group member takes the identical code path).  Both configs are
+    built through sweep/point.build_config from the TASK dicts the
+    subprocesses actually ran — the digest the fork re-stamps is
+    byte-for-byte the digest the resuming subprocess checks."""
+    from shadow_tpu.ckpt.fork import fork_archive
+    from shadow_tpu.sweep.point import build_config
+
+    def cfg(t):
+        c = build_config(t["yaml"], t["experimental"],
+                         t["link_interval_ms"])
+        if t.get("stop_time_ns"):
+            c.general.stop_time_ns = int(t["stop_time_ns"])
+        return c
+
+    out = os.path.join(pdir, "warm.stck")
+    fork_archive(snap, cfg(ramp_task), cfg(task), out)
+    return out

@@ -1,0 +1,250 @@
+"""Campaign spec: a declarative axes-product description of a
+tail-latency sweep, expanded into a DETERMINISTIC run matrix.
+
+A spec is a plain dict (YAML-friendly; tools/sweep reads either):
+
+    name: incast-k-sweep          # required, [a-z0-9-]+
+    scenario: incast              # incast | rpc_burst | leaf_spine
+    seeds: [17]                   # optional; default: the scenario's
+    base: {fan_in: 8, nbytes: 200000, stop_time: "2s"}   # optional
+    axes:                         # optional; each value is a list
+      load: [0.5, 1.0]            # scales offered bytes (nbytes)
+      fan_in: [4, 8, 16]          # fan-in width (see _AXES)
+      dctcp_k: [10, 20]           # marking threshold K, packets
+      cc: [reno, dctcp]           # congestion controller
+      size_law: [fixed, pareto]   # rpc_burst only
+    time_limit_s: 120             # per-point subprocess wall limit
+    warm_start: {at_ms: 500}      # optional: fork-from-ramp points
+    link_interval_ms: 0           # fabric sampling grid (0 = every
+                                  # round)
+
+Expansion is pure: axes iterate in sorted-name order, values in spec
+order, seeds outermost — so the same spec ALWAYS yields the same
+ordered point list, and with it the same dataset bytes (the two-run
+byte-identity gate in tests/test_sweep.py).  Every invalid axis,
+value, or scenario/axis pairing is refused at expansion time with the
+offending key named — a campaign must never discover a bad point an
+hour in.
+"""
+
+from __future__ import annotations
+
+import re
+
+# Axis registry: name -> (validator, scenarios it applies to).
+# `load` multiplies the scenario's offered bytes; `fan_in` maps to the
+# scenario's width knob (incast fan_in / rpc_burst n_clients /
+# leaf_spine hosts_per_leaf); `n_leaf` is the leaf-spine fabric SIZE
+# (the held-out-fabric validation axis); `dctcp_k` sets
+# experimental.dctcp_k_pkts with dctcp_k_bytes scaled at MTU (1500 B)
+# per packet — the fork-safe warm-start axis.
+_ALL = ("incast", "rpc_burst", "leaf_spine")
+
+
+def _pos_num(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and v > 0
+
+
+def _pos_int(v):
+    return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+
+AXES = {
+    "load": (_pos_num, _ALL),
+    "fan_in": (_pos_int, _ALL),
+    "n_leaf": (lambda v: _pos_int(v) and v >= 2, ("leaf_spine",)),
+    "dctcp_k": (_pos_int, _ALL),
+    "cc": (lambda v: v in ("reno", "dctcp"), _ALL),
+    "size_law": (lambda v: v in ("fixed", "pareto", "lognormal"),
+                 ("rpc_burst",)),
+}
+
+# The fork-safe axes (ckpt/fork.py FORK_SAFE_*): points differing only
+# here share a warm-start ramp; any other axis forces a cold start.
+FORK_SAFE_AXES = frozenset({"dctcp_k"})
+
+# Per-scenario defaults mirroring the netgen signatures: seed, the
+# offered-bytes base the `load` axis scales, and the fan-in WIDTH the
+# scenario runs when neither axes nor base set one — point_features
+# must record the width the simulator actually uses, never 0.
+SCENARIO_DEFAULTS = {
+    "incast": {"seed": 17, "nbytes": 500_000, "width": 8,
+               "n_leaf": 0},
+    "rpc_burst": {"seed": 31, "nbytes": 20_000, "width": 8,
+                  "n_leaf": 0},
+    "leaf_spine": {"seed": 23, "nbytes": 1_000_000, "width": 4,
+                   "n_leaf": 4},
+}
+
+_SPEC_KEYS = {"name", "scenario", "seeds", "base", "axes",
+              "time_limit_s", "warm_start", "link_interval_ms"}
+
+
+class SpecError(ValueError):
+    """Any campaign-spec validation failure, with the offending key
+    named."""
+
+
+def validate_spec(spec: dict) -> dict:
+    """Normalized copy of `spec` (defaults filled) or SpecError."""
+    if not isinstance(spec, dict):
+        raise SpecError("campaign spec must be a mapping")
+    unknown = set(spec) - _SPEC_KEYS
+    if unknown:
+        raise SpecError(f"unknown spec key(s) {sorted(unknown)}")
+    name = spec.get("name")
+    if not isinstance(name, str) or not re.fullmatch(r"[a-z0-9-]+",
+                                                     name):
+        raise SpecError(f"spec.name must match [a-z0-9-]+, got "
+                        f"{name!r}")
+    scenario = spec.get("scenario")
+    if scenario not in SCENARIO_DEFAULTS:
+        raise SpecError(f"spec.scenario must be one of "
+                        f"{sorted(SCENARIO_DEFAULTS)}, got "
+                        f"{scenario!r}")
+    seeds = spec.get("seeds", [SCENARIO_DEFAULTS[scenario]["seed"]])
+    if not isinstance(seeds, list) or not seeds \
+            or not all(_pos_int(s) for s in seeds):
+        raise SpecError(f"spec.seeds must be a non-empty list of "
+                        f"positive ints, got {seeds!r}")
+    base = spec.get("base", {})
+    if not isinstance(base, dict):
+        raise SpecError("spec.base must be a mapping of scenario "
+                        "keyword arguments")
+    axes = spec.get("axes", {})
+    if not isinstance(axes, dict):
+        raise SpecError("spec.axes must be a mapping axis -> [values]")
+    for axis, values in axes.items():
+        if axis not in AXES:
+            raise SpecError(f"unknown axis {axis!r}; known: "
+                            f"{sorted(AXES)}")
+        check, scenarios = AXES[axis]
+        if scenario not in scenarios:
+            raise SpecError(f"axis {axis!r} does not apply to "
+                            f"scenario {scenario!r} (only "
+                            f"{list(scenarios)})")
+        if not isinstance(values, list) or not values:
+            raise SpecError(f"axis {axis!r} needs a non-empty value "
+                            f"list")
+        bad = [v for v in values if not check(v)]
+        if bad:
+            raise SpecError(f"axis {axis!r}: invalid value(s) {bad}")
+        if len(set(map(repr, values))) != len(values):
+            raise SpecError(f"axis {axis!r}: duplicate values")
+    tl = spec.get("time_limit_s", 300)
+    if not _pos_num(tl):
+        raise SpecError(f"spec.time_limit_s must be > 0, got {tl!r}")
+    warm = spec.get("warm_start")
+    if warm is not None:
+        if not isinstance(warm, dict) or set(warm) != {"at_ms"} \
+                or not _pos_int(warm["at_ms"]):
+            raise SpecError("spec.warm_start must be {at_ms: "
+                            "<positive int>}")
+    li = spec.get("link_interval_ms", 0)
+    if not isinstance(li, int) or isinstance(li, bool) or li < 0:
+        raise SpecError(f"spec.link_interval_ms must be an int >= 0, "
+                        f"got {li!r}")
+    return {"name": name, "scenario": scenario, "seeds": list(seeds),
+            "base": dict(base), "axes": {k: list(v) for k, v
+                                         in sorted(axes.items())},
+            "time_limit_s": tl, "warm_start": warm,
+            "link_interval_ms": li}
+
+
+def expand(spec: dict) -> list[dict]:
+    """The deterministic run matrix: one dict per point, ordered
+    seeds-outermost then axes in sorted-name order (values in spec
+    order).  Each point carries its stable `point_id`, the axis
+    assignment, the seed, and its warm-start GROUP key (points
+    differing only in fork-safe axes share a ramp)."""
+    spec = validate_spec(spec)
+    axes = spec["axes"]
+    names = sorted(axes)
+    points: list[dict] = []
+    combos: list[dict] = [{}]
+    for axis in names:
+        combos = [dict(c, **{axis: v}) for c in combos
+                  for v in axes[axis]]
+    for seed in spec["seeds"]:
+        for combo in combos:
+            ident = [f"s{seed}"] + [
+                f"{a}-{str(combo[a]).replace('.', 'p')}"
+                for a in names]
+            group = [f"s{seed}"] + [
+                f"{a}-{str(combo[a]).replace('.', 'p')}"
+                for a in names if a not in FORK_SAFE_AXES]
+            points.append({
+                "point_id": f"p{len(points):04d}." + ".".join(ident),
+                "seed": seed,
+                "axes": dict(combo),
+                "group": ".".join(group) or "all",
+            })
+    return points
+
+
+def point_features(spec: dict, point: dict) -> dict:
+    """The config-feature dict the dataset records per point (and the
+    surrogate featurizer consumes): every axis resolved to its
+    effective value, defaults filled — sorted-key JSON of this is part
+    of the dataset bytes."""
+    spec = validate_spec(spec)
+    ax = point["axes"]
+    base = spec["base"]
+    nbytes = base.get("nbytes",
+                      SCENARIO_DEFAULTS[spec["scenario"]]["nbytes"])
+    defaults = SCENARIO_DEFAULTS[spec["scenario"]]
+    width_base = base.get("fan_in", base.get("hosts_per_leaf",
+                                             base.get("n_clients",
+                                                      0)))
+    return {
+        "scenario": spec["scenario"],
+        "seed": point["seed"],
+        "load": float(ax.get("load", 1.0)),
+        "nbytes": int(round(nbytes * float(ax.get("load", 1.0)))),
+        "fan_in": int(ax.get("fan_in",
+                             width_base or defaults["width"])),
+        "n_leaf": int(ax.get("n_leaf", base.get("n_leaf",
+                                                defaults["n_leaf"]))),
+        "dctcp_k": int(ax.get("dctcp_k", 20)),
+        "cc": str(ax.get("cc", (base.get("tcp") or {}).get("cc",
+                                                           "reno"))),
+        "size_law": str(ax.get("size_law",
+                               base.get("size_law") or "fixed")),
+    }
+
+
+def point_yaml(spec: dict, point: dict) -> str:
+    """The point's full simulation config YAML (netgen scenario text;
+    experimental overrides ride separately in point_experimental so
+    the warm-start fork sees a clean base/variant split)."""
+    from shadow_tpu.tools import netgen
+    spec = validate_spec(spec)
+    feats = point_features(spec, point)
+    base = dict(spec["base"])
+    base.pop("tcp", None)
+    base["seed"] = point["seed"]
+    base["nbytes"] = feats["nbytes"]
+    tcp = ({"cc": "dctcp", "ecn": "on"} if feats["cc"] == "dctcp"
+           else (spec["base"].get("tcp") or None))
+    scenario = spec["scenario"]
+    if scenario == "incast":
+        base.pop("fan_in", None)
+        return netgen.incast_yaml(feats["fan_in"], tcp=tcp, **base)
+    if scenario == "rpc_burst":
+        law = feats["size_law"]
+        base["size_law"] = None if law == "fixed" else law
+        base["n_clients"] = feats["fan_in"]
+        return netgen.rpc_burst_yaml(tcp=tcp, **base)
+    base["hosts_per_leaf"] = feats["fan_in"]
+    base["n_leaf"] = feats["n_leaf"]
+    return netgen.leaf_spine_yaml(tcp=tcp, **base)
+
+
+def point_experimental(spec: dict, point: dict) -> dict:
+    """Experimental-section overrides for the point (applied on top
+    of the scenario YAML by the point subprocess AND by the fork
+    variant builder): the DCTCP-K axis, packets leg as given, bytes
+    leg scaled at one MTU per packet."""
+    k = int(point["axes"].get("dctcp_k", 20))
+    return {"dctcp_k_pkts": k, "dctcp_k_bytes": k * 1500}

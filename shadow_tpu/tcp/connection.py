@@ -316,6 +316,12 @@ class TcpConnection:
         self.fct_last = -1
         self.fct_bytes_in = 0
         self.fct_bytes_out = 0
+        # Per-flow ECN mark-rate telemetry (netplane.cpp TcpConn twin;
+        # the `marks` column of both TEL_REC and FCT_REC): cumulative
+        # CE-marked arrivals this endpoint OBSERVED — counted exactly
+        # where the RFC 3168 receiver latches ECE, so all three
+        # execution paths agree byte-for-byte.
+        self.ce_seen = 0
 
     def _fct_touch(self, nbytes: int, now: int, inbound: bool) -> None:
         if self.fct_first < 0:
@@ -544,6 +550,7 @@ class TcpConnection:
                 self.ece_latch = False
             if ecn == ECN_CE:
                 self.ece_latch = True
+                self.ce_seen += 1
         # RFC 7323 timestamp processing on EVERY segment (ref
         # tcp.c:2356-2358, plus the TS.Recent update rule the RFC adds:
         # only a segment covering the last ack point may update the

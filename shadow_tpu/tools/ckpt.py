@@ -3,13 +3,19 @@
     python -m shadow_tpu.tools.ckpt info   SNAPSHOT
     python -m shadow_tpu.tools.ckpt verify SNAPSHOT
     python -m shadow_tpu.tools.ckpt diff   SNAPSHOT_A SNAPSHOT_B
+    python -m shadow_tpu.tools.ckpt fork   SNAPSHOT BASE.yaml \
+        VARIANT.yaml [VARIANT2.yaml ...] [--out-dir DIR]
     python -m shadow_tpu.tools.ckpt --smoke [--hosts N]
 
 `info` prints the snapshot's round/sim-time/host-count plus the
 section table (sizes + checksums); `verify` re-checksums every section
 and gates on the layout version; `diff` compares two snapshots section
 by section and names the first differing section — drilling into the
-engine plane blob to name the first differing HOST frame.  `--smoke`
+engine plane blob to name the first differing HOST frame.  `fork`
+clones one post-ramp snapshot into N config-variant resume points
+(ckpt/fork.py: variants may differ only in the fork-safe knobs —
+swept DCTCP-K, stop_time — with a clear refusal otherwise; the warm-
+start seam the sweep runner uses, docs/SWEEP.md).  `--smoke`
 (the ./setup ckpt target) runs a 50-host tgen sim, snapshots it
 mid-run, resumes, and byte-compares every determinism-gated artifact
 of the resumed run against the straight run.
@@ -132,6 +138,25 @@ def diff(path_a: str, path_b: str) -> int:
     return 1
 
 
+def fork(snapshot: str, base_yaml: str, variant_yamls: list[str],
+         out_dir: str) -> int:
+    """`ckpt fork`: one forked archive per variant config, named
+    <variant stem>.stck in `out_dir`."""
+    from shadow_tpu.ckpt.fork import fork_archive
+    from shadow_tpu.core.config import ConfigOptions
+
+    base = ConfigOptions.from_file(base_yaml)
+    os.makedirs(out_dir, exist_ok=True)
+    for vy in variant_yamls:
+        variant = ConfigOptions.from_file(vy)
+        stem = os.path.splitext(os.path.basename(vy))[0]
+        out = os.path.join(out_dir, f"{stem}.stck")
+        keys = fork_archive(snapshot, base, variant, out)
+        print(f"forked {out}: "
+              + (", ".join(keys) if keys else "identical config"))
+    return 0
+
+
 def _collect(dirpath: str) -> dict:
     """Determinism-gate artifact collection (tests/test_determinism.py
     collect() semantics: metrics.wall and the wall channel stripped,
@@ -221,18 +246,25 @@ def smoke(n_hosts: int) -> int:
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("info", "verify", "diff"):
+    if argv and argv[0] in ("info", "verify", "diff", "fork"):
         sub = argparse.ArgumentParser(
             prog=f"shadow_tpu.tools.ckpt {argv[0]}")
         sub.add_argument("snapshot")
         if argv[0] == "diff":
             sub.add_argument("snapshot_b")
+        if argv[0] == "fork":
+            sub.add_argument("base_yaml")
+            sub.add_argument("variant_yamls", nargs="+")
+            sub.add_argument("--out-dir", default=".")
         sargs = sub.parse_args(argv[1:])
         try:
             if argv[0] == "info":
                 return info(sargs.snapshot)
             if argv[0] == "verify":
                 return verify(sargs.snapshot)
+            if argv[0] == "fork":
+                return fork(sargs.snapshot, sargs.base_yaml,
+                            sargs.variant_yamls, sargs.out_dir)
             return diff(sargs.snapshot, sargs.snapshot_b)
         except ck.CkptError as e:
             print(f"ckpt: {e}", file=sys.stderr)

@@ -471,6 +471,75 @@ def leaf_spine_yaml(n_leaf: int = 4, hosts_per_leaf: int = 4,
             f"hosts:\n" + "\n".join(blocks) + "\n")
 
 
+def rpc_sizes(seed: int, n_clients: int, bursts: int, nbytes: int,
+              size_law: str | None, size_shape: float = 1.5,
+              size_sigma: float = 1.0,
+              size_cap_factor: int = 20) -> list[list[int]]:
+    """Deterministic per-(client, burst) RPC response sizes.
+
+    `size_law=None` is the fixed-size legacy shape (every transfer
+    exactly `nbytes`).  The heavy-tailed laws of arXiv 2205.01234's
+    tail-estimation regimes draw from counter-based threefry keyed by
+    (seed, client, burst) — order-independent, so two generator calls
+    (and two campaign runs) produce byte-identical configs:
+
+    - "pareto": Pareto(alpha=size_shape, xm scaled so the MEAN stays
+      `nbytes`); requires alpha > 1 or the mean diverges — refused.
+    - "lognormal": LogNormal(sigma=size_sigma, mu chosen so the MEAN
+      stays `nbytes`); requires sigma > 0 — refused.
+
+    Draws clamp to [1, size_cap_factor * nbytes] so one astronomical
+    tail sample cannot unbound a sweep point's runtime; the clamp is
+    part of the documented law (docs/SWEEP.md)."""
+    import math
+
+    from shadow_tpu.core.rng import (STREAM_RPC_SIZE, mix_key,
+                                     threefry2x32_py)
+    if size_law is None:
+        return [[nbytes] * bursts for _ in range(n_clients)]
+    if size_law not in ("pareto", "lognormal"):
+        raise ValueError(f"unknown size_law {size_law!r}; expected "
+                         f"'pareto' or 'lognormal' (or None for "
+                         f"fixed sizes)")
+    if size_law == "pareto" and not size_shape > 1.0:
+        raise ValueError(f"pareto size_shape must be > 1 (finite "
+                         f"mean), got {size_shape}")
+    if size_law == "lognormal" and not size_sigma > 0.0:
+        raise ValueError(f"lognormal size_sigma must be > 0, "
+                         f"got {size_sigma}")
+    k0, k1 = mix_key(seed, STREAM_RPC_SIZE)
+    cap = max(size_cap_factor * nbytes, 1)
+
+    def u01(c0: int, c1: int) -> float:
+        b0, b1 = threefry2x32_py(k0, k1, c0 & 0xFFFFFFFF,
+                                 c1 & 0xFFFFFFFF)
+        # top 53 bits -> (0, 1]: never exactly 0, so logs/powers are
+        # finite
+        return ((((b1 << 32) | b0) >> 11) + 1) * (2.0 ** -53)
+
+    out: list[list[int]] = []
+    for c in range(n_clients):
+        row = []
+        for b in range(bursts):
+            if size_law == "pareto":
+                # mean = alpha * xm / (alpha - 1) == nbytes
+                xm = nbytes * (size_shape - 1.0) / size_shape
+                size = xm * u01(c, b) ** (-1.0 / size_shape)
+            else:
+                # mean = exp(mu + sigma^2/2) == nbytes; Box-Muller on
+                # two independent counters (burst index split even/odd
+                # keeps the pair disjoint from other draws)
+                u1 = u01(c, 2 * bursts + 2 * b)
+                u2 = u01(c, 2 * bursts + 2 * b + 1)
+                z = math.sqrt(-2.0 * math.log(u1)) \
+                    * math.cos(2.0 * math.pi * u2)
+                mu = math.log(nbytes) - size_sigma * size_sigma / 2.0
+                size = math.exp(mu + size_sigma * z)
+            row.append(max(1, min(int(size), cap)))
+        out.append(row)
+    return out
+
+
 def rpc_burst_yaml(n_clients: int = 8, n_servers: int = 2,
                    nbytes: int = 20_000, bursts: int = 4,
                    burst_interval_ms: int = 250, count: int = 4,
@@ -479,7 +548,10 @@ def rpc_burst_yaml(n_clients: int = 8, n_servers: int = 2,
                    latency: str = "1 ms", stop_time: str = "3s",
                    seed: int = 31, scheduler: str = "serial",
                    device_spans: str | None = None,
-                   tcp: dict | None = None) -> str:
+                   tcp: dict | None = None,
+                   size_law: str | None = None,
+                   size_shape: float = 1.5,
+                   size_sigma: float = 1.0) -> str:
     """Open-loop bursty request/response traffic: every client host
     runs one tgen-client PROCESS PER BURST — process b starts at the
     b-th burst instant regardless of whether earlier transfers
@@ -488,7 +560,14 @@ def rpc_burst_yaml(n_clients: int = 8, n_servers: int = 2,
     `nbytes` responses back-to-back.  Whole bursts land on the
     servers' downlinks at the same instant, so the per-burst queue
     excursions — and, under tcp={"cc": "dctcp", "ecn": "on"}, the
-    CE-mark episodes — are sharply separated in the fabric channel."""
+    CE-mark episodes — are sharply separated in the fabric channel.
+
+    `size_law` switches the per-burst response size from fixed
+    `nbytes` to the heavy-tailed laws of arXiv 2205.01234 (see
+    rpc_sizes: "pareto" / "lognormal", mean preserved at `nbytes`,
+    threefry-deterministic per (client, burst))."""
+    sizes = rpc_sizes(seed, n_clients, bursts, nbytes, size_law,
+                      size_shape, size_sigma)
     gml_lines = ["graph [ directed 0",
                  f'  node [ id 0 host_bandwidth_down "{server_bw}" '
                  f'host_bandwidth_up "{server_bw}" ]',
@@ -517,7 +596,8 @@ def rpc_burst_yaml(n_clients: int = 8, n_servers: int = 2,
             start_us = (c * 73) % 500
             procs.append(
                 f'      - {{ path: tgen-client, '
-                f'args: [{server}, "8080", "{nbytes}", "{count}"], '
+                f'args: [{server}, "8080", "{sizes[c][b]}", '
+                f'"{count}"], '
                 f"start_time: {start_ms * 1000 + start_us} us, "
                 f"expected_final_state: any }}")
         blocks.append(

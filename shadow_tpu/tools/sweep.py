@@ -1,0 +1,161 @@
+"""Sweep-campaign CLI (docs/SWEEP.md).
+
+    python -m shadow_tpu.tools.sweep expand SPEC.yaml
+    python -m shadow_tpu.tools.sweep run    SPEC.yaml --out DIR
+    python -m shadow_tpu.tools.sweep report DATASET.swds
+    python -m shadow_tpu.tools.sweep --smoke
+
+`expand` prints the deterministic run matrix without executing;
+`run` executes every point in identity-safe subprocesses (warm-
+starting fork groups when the spec asks), aggregates the channels
+into `DIR/<name>.swds`, and prints the tail-curve tables; `report`
+re-renders a dataset's curves and verdicts.  `--smoke` (the
+./setup sweep target) runs a 2-point micro-campaign TWICE into
+temporary directories, byte-compares the two datasets, checks the
+aggregator's conservation verdict, and exits nonzero on any
+difference — the zero-cost standing proof that campaign bytes depend
+only on the spec.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_spec(path: str) -> dict:
+    import yaml
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def print_curves(meta: dict, out=None) -> None:
+    out = out or sys.stdout
+    print(f"campaign {meta['name']}: {len(meta['points'])} points",
+          file=out)
+    for curve in meta["tail_curves"]:
+        key = {k: v for k, v in curve["key"].items()
+               if v not in (0, "fixed") or k == "cc"}
+        print(f"  curve {json.dumps(key, sort_keys=True)} "
+              f"(p99 monotone {curve['p99_monotone_frac']:.0%}):",
+              file=out)
+        print(f"    {'load':>6} {'flows':>6} {'p50 ms':>9} "
+              f"{'p99 ms':>9} {'p999 ms':>9}", file=out)
+        for r in curve["rows"]:
+            print(f"    {r['load']:>6} {r['flows']:>6} "
+                  f"{r['p50_ns'] / 1e6:>9.2f} "
+                  f"{r['p99_ns'] / 1e6:>9.2f} "
+                  f"{r['p999_ns'] / 1e6:>9.2f}", file=out)
+
+
+def cmd_expand(spec_path: str) -> int:
+    from shadow_tpu.sweep import spec as spec_mod
+    spec = spec_mod.validate_spec(_load_spec(spec_path))
+    points = spec_mod.expand(spec)
+    print(f"{spec['name']}: {len(points)} point(s), scenario "
+          f"{spec['scenario']}, seeds {spec['seeds']}")
+    for p in points:
+        print(f"  {p['point_id']}  group={p['group']}")
+    return 0
+
+
+def cmd_run(spec_path: str, out_dir: str) -> int:
+    from shadow_tpu.sweep import dataset, runner
+    from shadow_tpu.sweep import spec as spec_mod
+    spec = spec_mod.validate_spec(_load_spec(spec_path))
+    runner.run_campaign(spec, out_dir)
+    ds = dataset.aggregate(spec, out_dir)
+    path = os.path.join(out_dir, f"{spec['name']}.swds")
+    ds.write(path)
+    print(f"dataset: {path} ({os.path.getsize(path)} bytes)")
+    print_curves(ds.meta)
+    return 0
+
+
+def cmd_report(path: str) -> int:
+    from shadow_tpu.sweep import dataset
+    ds = dataset.load(path)
+    print_curves(ds.meta)
+    warm = sum(1 for p in ds.meta["points"] if p["warm_started"])
+    print(f"  flows {sum(p['counts']['flows'] for p in ds.meta['points'])}, "
+          f"link samples "
+          f"{sum(p['counts']['links'] for p in ds.meta['points'])}, "
+          f"warm-started points {warm}")
+    return 0
+
+
+SMOKE_SPEC = {
+    "name": "smoke", "scenario": "incast",
+    "base": {"nbytes": 40_000, "stop_time": "800ms", "fan_in": 2},
+    "axes": {"fan_in": [2, 3]},
+    "time_limit_s": 240,
+}
+
+
+def smoke() -> int:
+    """2-point micro-campaign run twice -> byte-identical datasets +
+    aggregator conservation verdict (the ./setup sweep target)."""
+    import tempfile
+
+    from shadow_tpu.sweep import dataset, runner
+    blobs = []
+    with tempfile.TemporaryDirectory() as td:
+        for tag in ("a", "b"):
+            out = os.path.join(td, tag)
+            runner.run_campaign(SMOKE_SPEC, out,
+                                log=lambda m: None)
+            ds = dataset.aggregate(SMOKE_SPEC, out)
+            blobs.append(ds.to_bytes())
+    if blobs[0] != blobs[1]:
+        print("sweep smoke: two identical campaigns produced "
+              "DIFFERENT dataset bytes", file=sys.stderr)
+        return 1
+    flows = sum(p["counts"]["flows"] for p in ds.meta["points"])
+    print(f"sweep smoke: ok (2-point campaign byte-identical across "
+          f"two runs, {len(blobs[0])} dataset bytes, {flows} flows, "
+          f"conservation ok)")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] in ("expand", "run", "report"):
+        sub = argparse.ArgumentParser(
+            prog=f"shadow_tpu.tools.sweep {argv[0]}")
+        if argv[0] == "report":
+            sub.add_argument("dataset")
+        else:
+            sub.add_argument("spec")
+        if argv[0] == "run":
+            sub.add_argument("--out", required=True)
+        sargs = sub.parse_args(argv[1:])
+        from shadow_tpu.sweep.dataset import DatasetError
+        from shadow_tpu.sweep.runner import PointFailure
+        from shadow_tpu.sweep.spec import SpecError
+        try:
+            if argv[0] == "expand":
+                return cmd_expand(sargs.spec)
+            if argv[0] == "run":
+                return cmd_run(sargs.spec, sargs.out)
+            return cmd_report(sargs.dataset)
+        except (SpecError, PointFailure, DatasetError) as e:
+            print(f"sweep: {e}", file=sys.stderr)
+            return 1
+    ap = argparse.ArgumentParser(prog="shadow_tpu.tools.sweep",
+                                 description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="2-point micro-campaign byte-identity + "
+                         "conservation smoke")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    ap.print_usage(sys.stderr)
+    print("sweep: a subcommand (expand/run/report) or --smoke is "
+          "required", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -215,7 +215,7 @@ def net_report(data_dir: str, top_n: int = 10, out=None) -> bool:
     ranked = top_by_retransmits(by_conn, top_n)
     print(f"top {len(ranked)} connections by retransmits:", file=out)
     print(f"  {'connection':<32} {'rtx':>6} {'sack':>5} "
-          f"{'srtt ms':>8} {'cwnd kB':>8} {'sndbuf':>8} "
+          f"{'marks':>6} {'srtt ms':>8} {'cwnd kB':>8} {'sndbuf':>8} "
           f"{'rcvbuf':>8}", file=out)
     for key in ranked:
         host, lport, rport, rip = key
@@ -223,6 +223,7 @@ def net_report(data_dir: str, top_n: int = 10, out=None) -> bool:
         last = recs[-1]
         name = f"h{host}:{lport}->{format_ip(rip)}:{rport}"
         print(f"  {name:<32} {last[13]:>6} {last[14]:>5} "
+              f"{last[15]:>6} "
               f"{last[8] / 1e6:>8.2f} {last[6] / 1024:>8.1f} "
               f"{max(r[11] for r in recs):>8} "
               f"{max(r[12] for r in recs):>8}", file=out)
@@ -361,10 +362,12 @@ def fct_report(data_dir: str, out=None) -> bool:
     print(f"flow completion times ({len(rows)} endpoint records):",
           file=out)
     print(f"  {'class':>6} {'flows':>6} {'done':>5} {'MB':>9} "
+          f"{'marks':>7} {'mk/1k':>6} "
           f"{'p50 ms':>9} {'p99 ms':>9} {'p999 ms':>9}", file=out)
     for cls, ent in table.items():
         print(f"  {cls:>6} {ent['flows']:>6} {ent['complete']:>5} "
               f"{ent['bytes'] / 1e6:>9.2f} "
+              f"{ent['marks']:>7} {ent['mark_permille']:>6} "
               f"{ent['p50_ns'] / 1e6:>9.2f} "
               f"{ent['p99_ns'] / 1e6:>9.2f} "
               f"{ent['p999_ns'] / 1e6:>9.2f}", file=out)

@@ -218,11 +218,15 @@ assert len(MARK_NAMES) == MARK_N
 #     uint16  rport        peer port,
 #     uint32  rip          peer IP (the local IP is the host's)
 #     int32   state      TCP state (connection.py constants)
-#     int64[9]           cwnd, ssthresh, srtt, rto, rto_backoff,
+#     int64[10]          cwnd, ssthresh, srtt, rto, rto_backoff,
 #                        send-buffer bytes, recv-buffer bytes,
-#                        retransmits, SACK-skipped retransmits
-TEL_REC_BYTES = 96
-TEL_REC = struct.Struct("<qiHHIi9q")
+#                        retransmits, SACK-skipped retransmits,
+#                        marks (cumulative CE-marked arrivals this
+#                        endpoint OBSERVED — TcpConnection.ce_seen;
+#                        the per-flow mark-rate telemetry the sweep
+#                        dataset and `trace fct` report)
+TEL_REC_BYTES = 104
+TEL_REC = struct.Struct("<qiHHIi10q")
 assert TEL_REC.size == TEL_REC_BYTES
 
 # numpy structured dtype for bulk encode/decode (field order == TEL_REC).
@@ -230,7 +234,8 @@ TEL_DTYPE = [("t", "<i8"), ("host", "<i4"), ("lport", "<u2"),
              ("rport", "<u2"), ("rip", "<u4"), ("state", "<i4"),
              ("cwnd", "<i8"), ("ssthresh", "<i8"), ("srtt", "<i8"),
              ("rto", "<i8"), ("backoff", "<i8"), ("sndbuf", "<i8"),
-             ("rcvbuf", "<i8"), ("rtx", "<i8"), ("sacks", "<i8")]
+             ("rcvbuf", "<i8"), ("rtx", "<i8"), ("sacks", "<i8"),
+             ("marks", "<i8")]
 
 # ---------------------------------------------------------------------
 # Syscall observatory (docs/OBSERVABILITY.md "syscall observatory"):
@@ -365,18 +370,21 @@ FCT_F_RECEIVER = 2  # this endpoint received more than it sent
 #     uint16  rport        peer port,
 #     uint32  rip          peer IP (the local IP is the host's)
 #     int32   flags      FCT_F_* bits
-#     int64[3]           bytes_in (payload delivered in order),
+#     int64[4]           bytes_in (payload delivered in order),
 #                        bytes_out (payload first-transmitted),
-#                        retransmits
-FCT_REC_BYTES = 56
-FCT_REC = struct.Struct("<qqiHHIi3q")
+#                        retransmits,
+#                        marks (cumulative CE-marked arrivals this
+#                        endpoint observed — ce_seen at teardown/sweep;
+#                        marks/segment is the flow's mark rate)
+FCT_REC_BYTES = 64
+FCT_REC = struct.Struct("<qqiHHIi4q")
 assert FCT_REC.size == FCT_REC_BYTES
 
 # numpy structured dtype for bulk decode (field order == FCT_REC).
 FCT_DTYPE = [("t_first", "<i8"), ("t_last", "<i8"), ("host", "<i4"),
              ("lport", "<u2"), ("rport", "<u2"), ("rip", "<u4"),
              ("flags", "<i4"), ("bytes_in", "<i8"),
-             ("bytes_out", "<i8"), ("rtx", "<i8")]
+             ("bytes_out", "<i8"), ("rtx", "<i8"), ("marks", "<i8")]
 
 # fabric-sim.bin layout: FAB_HDR, then fb_records FB_RECs, then
 # fct_records FCT_RECs.  The header is Python-side only (the manager
@@ -414,7 +422,8 @@ def iter_fb_records(fb_bytes: bytes):
 
 def iter_fct_records(fct_bytes: bytes):
     """Yield (t_first, t_last, host, lport, rport, rip, flags,
-    bytes_in, bytes_out, rtx) tuples from a packed FCT_REC stream."""
+    bytes_in, bytes_out, rtx, marks) tuples from a packed FCT_REC
+    stream."""
     for off in range(0, len(fct_bytes) - len(fct_bytes) % FCT_REC_BYTES,
                      FCT_REC_BYTES):
         yield FCT_REC.unpack_from(fct_bytes, off)

@@ -164,7 +164,7 @@ def flow_row(host_id: int, lport: int, rport: int, rip: int,
         flags |= FCT_F_RECEIVER
     return (conn.fct_first, conn.fct_last, host_id, lport, rport, rip,
             flags, conn.fct_bytes_in, conn.fct_bytes_out,
-            conn.retransmit_count)
+            conn.retransmit_count, conn.ce_seen)
 
 
 def object_host_flow_rows(host) -> list:
@@ -271,18 +271,25 @@ def fct_table(fct_rows) -> dict:
     well-known side); every column — count, completions, bytes AND
     the percentiles — is computed over the same receiver-endpoint
     population (receiver_rows), so one flow counts once.  Returns
-    {class_port: {"flows", "complete", "bytes", "p50_ns", "p99_ns",
-    "p999_ns"}}."""
+    {class_port: {"flows", "complete", "bytes", "marks",
+    "mark_permille", "p50_ns", "p99_ns", "p999_ns"}} —
+    `mark_permille` is CE-marked arrivals per 1000 received segments
+    (segments estimated at one MSS each), the per-flow mark-rate view
+    ROADMAP item 4 asks for."""
+    from shadow_tpu.tcp.connection import MSS
     by_class: dict = {}
     for (t0, t1, _host, lport, rport, _rip, flags, bin_, bout,
-         _rtx) in receiver_rows(fct_rows):
+         _rtx, marks) in receiver_rows(fct_rows):
         cls = min(lport, rport)
         ent = by_class.setdefault(cls, {"durs": [], "complete": 0,
-                                        "bytes": 0})
+                                        "bytes": 0, "marks": 0,
+                                        "segs": 0})
         ent["durs"].append(t1 - t0)
         if flags & FCT_F_COMPLETE:
             ent["complete"] += 1
         ent["bytes"] += max(bin_, bout)
+        ent["marks"] += marks
+        ent["segs"] += max((max(bin_, bout) + MSS - 1) // MSS, 1)
     out: dict = {}
     for cls, ent in sorted(by_class.items()):
         durs = sorted(ent["durs"])
@@ -290,6 +297,8 @@ def fct_table(fct_rows) -> dict:
             "flows": len(durs),
             "complete": ent["complete"],
             "bytes": ent["bytes"],
+            "marks": ent["marks"],
+            "mark_permille": (ent["marks"] * 1000) // ent["segs"],
             "p50_ns": percentile(durs, 500),
             "p99_ns": percentile(durs, 990),
             "p999_ns": percentile(durs, 999),

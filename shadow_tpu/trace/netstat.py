@@ -65,7 +65,8 @@ class NetstatChannel(FixedRecordChannel):
             int(t), host, lport, rport, rip, conn.state,
             conn.cong.cwnd, conn.cong.ssthresh, conn.srtt, conn.rto,
             conn._rto_backoff, conn.send_buf_len, conn.recv_buf_len,
-            conn.retransmit_count, conn.sacked_skip_count))
+            conn.retransmit_count, conn.sacked_skip_count,
+            conn.ce_seen))
         self.records += 1
 
     def sample_object_hosts(self, hosts, t: int) -> None:
@@ -94,7 +95,7 @@ class NetstatChannel(FixedRecordChannel):
 
 def iter_records(buf: bytes):
     """Yield (t, host, lport, rport, rip, state, cwnd, ssthresh,
-    srtt, rto, backoff, sndbuf, rcvbuf, rtx, sacks) tuples."""
+    srtt, rto, backoff, sndbuf, rcvbuf, rtx, sacks, marks) tuples."""
     for off in range(0, len(buf) - len(buf) % TEL_REC_BYTES,
                      TEL_REC_BYTES):
         yield TEL_REC.unpack_from(buf, off)

@@ -79,7 +79,8 @@ def test_record_round_trip(tmp_path):
               42, 63_000, 6_500_000, 1000, 12, 0, 2500, 3, -1, 9,
               500, 750_000, 480, 720_000)
     flow = (100, 900, 7, 8080, 40001, 0x0B000001,
-            trev.FCT_F_COMPLETE | trev.FCT_F_RECEIVER, 150_000, 11, 2)
+            trev.FCT_F_COMPLETE | trev.FCT_F_RECEIVER, 150_000, 11, 2,
+            5)
     ch = FabricChannel(0)
     ch.record(fields)
     assert len(ch.to_bytes()) == trev.FB_REC_BYTES
@@ -110,10 +111,11 @@ def test_channel_cap_is_deterministic():
 def test_fct_table_percentiles():
     # two flows in class 80, receiver records; integer percentiles
     rows = [
-        (0, 100, 1, 50_000, 80, 9, trev.FCT_F_RECEIVER, 1000, 10, 0),
+        (0, 100, 1, 50_000, 80, 9, trev.FCT_F_RECEIVER, 1000, 10, 0,
+         3),
         (0, 300, 2, 50_001, 80, 9,
-         trev.FCT_F_RECEIVER | trev.FCT_F_COMPLETE, 2000, 10, 1),
-        (-1, -1, 3, 50_002, 80, 9, 0, 0, 0, 0),  # dataless: skipped
+         trev.FCT_F_RECEIVER | trev.FCT_F_COMPLETE, 2000, 10, 1, 1),
+        (-1, -1, 3, 50_002, 80, 9, 0, 0, 0, 0, 0),  # dataless: skip
     ]
     table = fct_table(rows)
     assert list(table) == [80]
@@ -121,6 +123,9 @@ def test_fct_table_percentiles():
     assert ent["flows"] == 2 and ent["complete"] == 1
     assert ent["p50_ns"] == 100 and ent["p99_ns"] == 300
     assert ent["p999_ns"] == 300
+    # per-flow mark-rate telemetry: 1000 B = 1 MSS segment, 2000 B =
+    # 2, so 4 marks over 3 estimated segments = 1333 permille
+    assert ent["marks"] == 4 and ent["mark_permille"] == 1333
 
 
 # ---------------------------------------------------------------------

@@ -169,6 +169,29 @@ def test_trace_record_layout_drift_is_caught(cpp_text):
                for x in v), [x.render() for x in v]
 
 
+def test_tel_record_size_drift_is_caught(cpp_text):
+    """The telemetry record grew to 104 B for the per-flow `marks`
+    column (ISSUE 12); a drifted size — e.g. a field added on one
+    side only — must flag, exactly like the other record pins."""
+    mutated = _mutate(cpp_text, "constexpr int TEL_REC_BYTES = 104;",
+                      "constexpr int TEL_REC_BYTES = 112;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("TEL_REC_BYTES" in x.message and "112" in x.message
+               for x in v), [x.render() for x in v]
+
+
+def test_ceseen_codec_column_rename_is_caught(cpp_text):
+    """The c_ceseen span-codec column (per-flow mark telemetry) is
+    4-side checked by pass 2: renaming the export put() must fail
+    the import/export cross-check."""
+    from shadow_tpu.analysis import soa_layout
+    mutated = _mutate(cpp_text, 'put("c_ceseen", bytes_vec(c_ceseen));',
+                      'put("c_seen", bytes_vec(c_ceseen));')
+    v = soa_layout.check(ROOT, cpp_text=mutated)
+    assert any("c_ceseen" in x.message or "c_seen" in x.message
+               for x in v), [x.render() for x in v]
+
+
 def test_trace_event_enum_reorder_is_caught(cpp_text):
     """Swapping two FR_* members shifts every later value — the
     implicit-increment extraction must surface the drift."""
@@ -223,10 +246,10 @@ def test_fb_record_size_drift_is_caught(cpp_text):
     v = twin_constants.check(ROOT, cpp_text=mutated)
     assert any("FB_REC_BYTES" in x.message and "136" in x.message
                for x in v), [x.render() for x in v]
-    mutated = _mutate(cpp_text, "constexpr int FCT_REC_BYTES = 56;",
-                      "constexpr int FCT_REC_BYTES = 64;")
+    mutated = _mutate(cpp_text, "constexpr int FCT_REC_BYTES = 64;",
+                      "constexpr int FCT_REC_BYTES = 72;")
     v = twin_constants.check(ROOT, cpp_text=mutated)
-    assert any("FCT_REC_BYTES" in x.message and "64" in x.message
+    assert any("FCT_REC_BYTES" in x.message and "72" in x.message
                for x in v), [x.render() for x in v]
 
 
@@ -254,10 +277,12 @@ def test_fabric_column_rename_is_caught(cpp_text):
                       'put("codel_enq_bytes", bytes_vec(codel_enq_bytes));\n'
                       '  put("codel_drop_bytes", bytes_vec(codel_drop_bytes));\n'
                       '  put("codel_peak", bytes_vec(codel_peak));\n'
+                      '  put("codel_marked", bytes_vec(codel_marked));\n'
                       '  for (int ri = 1; ri <= 2; ri++) {',
                       'put("codel_enq_bytesx", bytes_vec(codel_enq_bytes));\n'
                       '  put("codel_drop_bytes", bytes_vec(codel_drop_bytes));\n'
                       '  put("codel_peak", bytes_vec(codel_peak));\n'
+                      '  put("codel_marked", bytes_vec(codel_marked));\n'
                       '  for (int ri = 1; ri <= 2; ri++) {')
     v = soa_layout.check(ROOT, cpp_text=mutated)
     msgs = [x.message for x in v]
@@ -332,8 +357,8 @@ def test_sc_constant_removal_is_caught(shim_text):
 
 def test_ck_layout_version_drift_is_caught(cpp_text):
     mutated = _mutate(cpp_text,
-                      "constexpr uint32_t CK_PLANE_VERSION = 1;",
-                      "constexpr uint32_t CK_PLANE_VERSION = 2;")
+                      "constexpr uint32_t CK_PLANE_VERSION = 3;",
+                      "constexpr uint32_t CK_PLANE_VERSION = 4;")
     v = twin_constants.check(ROOT, cpp_text=mutated)
     assert any("CK_PLANE_VERSION" in x.message for x in v), \
         [x.render() for x in v]

@@ -86,6 +86,7 @@ def test_record_round_trip():
         recv_buf_len = 512
         retransmit_count = 3
         sacked_skip_count = 7
+        ce_seen = 11
 
     ch = NetstatChannel(0)
     ch.record(1_000_000, 5, 8080, 40001, 0x0B000001, FakeConn())
@@ -94,7 +95,7 @@ def test_record_round_trip():
     (rec,) = list(iter_records(buf))
     assert rec == (1_000_000, 5, 8080, 40001, 0x0B000001, 4, 14600,
                    (1 << 31) - 1, 25_000_000, 200_000_000, 2, 4096,
-                   512, 3, 7)
+                   512, 3, 7, 11)
 
 
 def test_sampling_rule():
@@ -113,7 +114,7 @@ def test_channel_cap_is_deterministic():
         state = 4
         srtt = rto = _rto_backoff = 0
         send_buf_len = recv_buf_len = 0
-        retransmit_count = sacked_skip_count = 0
+        retransmit_count = sacked_skip_count = ce_seen = 0
 
         class cong:
             cwnd = ssthresh = 0
