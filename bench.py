@@ -845,7 +845,6 @@ def managed_rung() -> dict | None:
     syscalls_per_sec and the (always-on) disposition histogram come
     from the recorded run.  Returns the headline-JSON fragment."""
     import shutil
-    import subprocess
     import tempfile
     if shutil.which("cc") is None:
         print("bench[managed-128]: skipped (no C toolchain)",
@@ -859,19 +858,18 @@ def managed_rung() -> dict | None:
         print(f"bench[managed-128]: skipped ({e})", file=sys.stderr)
         return None
     with tempfile.TemporaryDirectory() as td:
-        bins = {}
-        for name in ("udp_echo_server", "udp_echo_client"):
-            src = os.path.join(tms.PLUGIN_DIR, name + ".c")
-            out = os.path.join(td, name)
-            subprocess.run(["cc", "-O1", "-o", out, src], check=True)
-            bins[name] = out
+        from shadow_tpu.tools.netgen import compile_echo_binaries
+        bins = compile_echo_binaries(td)
         from shadow_tpu.core.manager import run_simulation
 
-        def run_managed(scheduler, native, observatory="off"):
+        def run_managed(scheduler, native, observatory="off",
+                        svc=None):
             cfg = tms.scale_config(bins)
             cfg.experimental.scheduler = scheduler
             cfg.experimental.native_dataplane = native
             cfg.experimental.syscall_observatory = observatory
+            if svc is not None:
+                cfg.experimental.syscall_service_plane = svc
             t0 = time.perf_counter()
             manager, summary = run_simulation(cfg)
             return manager, summary, time.perf_counter() - t0
@@ -886,8 +884,15 @@ def managed_rung() -> dict | None:
         # wall goes (IPC wait vs dispatch vs resume vs memcopy).
         m_obs, s_obs, wall_obs = run_managed("thread_per_core", "on",
                                              observatory="wall")
+        # Service-plane comparator (ISSUE 13): the recorded rung runs
+        # with the plane on its default (auto); one svc=off run shows
+        # what the host-affine drain is worth — on oversubscribed
+        # boxes the stealing pool can enter a futex-thrash mode the
+        # plane avoids, so the ratio is the honest spread, not noise.
+        _msvc, ssvc, wall_svc_off = run_managed(
+            "thread_per_core", "on", svc="off")
         n_procs = sum(len(h.processes) for h in manager.hosts)
-        ok = summary.ok and sb.ok and s_obs.ok
+        ok = summary.ok and sb.ok and s_obs.ok and ssvc.ok
         sim_s = summary.busy_end_ns / 1e9
         syscalls_per_sec = summary.syscalls / wall if wall > 0 else 0.0
         disp = manager.sc_disposition_totals()
@@ -938,8 +943,86 @@ def managed_rung() -> dict | None:
             },
             "observatory_off_wall_s": round(wall, 3),
             "observatory_wall_wall_s": round(wall_obs, 3),
+            # Syscall service plane (ISSUE 13): wall of the same
+            # workload with the plane forced off, and the resulting
+            # ratio (>1 = the plane helped).
+            "svc_off_wall_s": round(wall_svc_off, 3),
+            "svc_speedup": round(wall_svc_off / wall, 3)
+            if wall > 0 else 0.0,
+            "svc": (manager.svc.wall_summary()
+                    if manager.svc is not None else None),
             "ok": ok,
         }
+
+
+def _managed_fleet_config(bins, n_procs: int, seed: int = 3,
+                          stop_time: str = "30s"):
+    """N-process managed-fleet config (the managed-1k/10k rungs;
+    shared generator with `./setup managed`)."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.tools.netgen import managed_fleet_yaml
+    return ConfigOptions.from_yaml_text(managed_fleet_yaml(
+        bins["udp_echo_server"], bins["udp_echo_client"], n_procs,
+        stop_time=stop_time, seed=seed))
+
+
+def managed_scale_rung(n_procs: int, label: str,
+                       record_outcome: bool = False) -> dict | None:
+    """`bench[managed-1k]` standing rung / `managed-10k` stretch
+    (ISSUE 13, ROADMAP item 2): n_procs REAL binaries under the shim
+    with the syscall service plane on its default (auto), recording
+    sim-s/wall-s + syscalls_per_sec.  With record_outcome the rung
+    never raises — the outcome string (EMFILE at spawn, timeout,
+    MemoryError…) IS the record, like the 1M stretch; the try covers
+    the compile step AND the tempdir teardown, because a run that
+    exhausted fds can make either fail and that failure mode must
+    land in the record, not crash the bench."""
+    import tempfile
+
+    from shadow_tpu.tools.netgen import compile_echo_binaries
+    frag: dict = {"processes": n_procs}
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            bins = compile_echo_binaries(td)
+            if bins is None:
+                print(f"bench[{label}]: skipped (no C toolchain)",
+                      file=sys.stderr)
+                return None
+            from shadow_tpu.core.manager import run_simulation
+            cfg = _managed_fleet_config(bins, n_procs)
+            cfg.experimental.scheduler = "thread_per_core"
+            cfg.experimental.native_dataplane = "on"
+            t0 = time.perf_counter()
+            manager, summary = run_simulation(cfg)
+            wall = time.perf_counter() - t0
+            sim_s = summary.busy_end_ns / 1e9
+            frag.update({
+                "outcome": "ok" if summary.ok else
+                           f"plugin errors: "
+                           f"{summary.plugin_errors[:2]}",
+                "sim_s_per_wall_s": round(sim_s / wall, 3),
+                "wall_s": round(wall, 1),
+                "syscalls": summary.syscalls,
+                "syscalls_per_sec": round(summary.syscalls / wall)
+                if wall > 0 else 0,
+                "svc": (manager.svc.wall_summary()
+                        if manager.svc is not None else None),
+            })
+            print(f"bench[{label}]: {n_procs} real processes, "
+                  f"{summary.syscalls} syscalls "
+                  f"({frag['syscalls_per_sec']:,}/s), "
+                  f"{frag['sim_s_per_wall_s']} sim-s/wall-s "
+                  f"({wall:.1f}s wall), outcome {frag['outcome']}",
+                  file=sys.stderr)
+            if not summary.ok and not record_outcome:
+                raise RuntimeError(frag["outcome"])
+    except Exception as e:  # noqa: BLE001 — the outcome IS the record
+        if not record_outcome:
+            raise
+        frag["outcome"] = f"{type(e).__name__}: {e}"[:300]
+        print(f"bench[{label}]: outcome recorded honestly: "
+              f"{frag['outcome']}", file=sys.stderr)
+    return frag
 
 
 def incast_rung(tcp: dict | None = None,
@@ -1525,6 +1608,19 @@ def main() -> None:
         managed_128 = None
         managed_failed = True
 
+    # Managed scale-out rungs (ISSUE 13 / ROADMAP item 2): the
+    # STANDING 1k-process rung (failure fails the bench exit code)
+    # and the 10k stretch whose outcome — fd exhaustion, spawn storm,
+    # timeout — is recorded honestly like the 1M-host stretch.
+    try:
+        managed_1k = managed_scale_rung(1000, "managed-1k")
+    except Exception as e:  # noqa: BLE001 — never cost the headline
+        print(f"bench[managed-1k]: failed: {e}", file=sys.stderr)
+        managed_1k = None
+        managed_failed = True
+    managed_10k = managed_scale_rung(10_000, "managed-10k",
+                                     record_outcome=True)
+
     # The event-driven loop stops touching hosts once events drain; the
     # metric credits only the span that actually ran rounds (an idle
     # tail up to stop_time is free for every scheduler).
@@ -1582,6 +1678,12 @@ def main() -> None:
         # histogram (always-on counters) and the IPC round-trip wall
         # breakdown from the wall-profiled companion run (ISSUE 7).
         "managed_128": managed_128,
+        # Managed scale-out (ISSUE 13): the standing 1k-process rung
+        # (sim-s/wall-s + syscalls_per_sec under the syscall service
+        # plane) and the 10k stretch with its outcome recorded
+        # honestly.
+        "managed_1k": managed_1k,
+        "managed_10k": managed_10k,
         # Flight-recorder wall channel of the last recorded tpu trial:
         # where a dispatch's wall goes (export/convert/compile/execute/
         # import/barrier/host-loop/engine-span, seconds) and the

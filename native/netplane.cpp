@@ -211,7 +211,7 @@ enum {
   EL_ENGINE_PYLIMIT, EL_ROUND_BOUNDARY, EL_ROUND_OUTBOX, EL_ROUND_GATE,
   EL_ROUND_CALLBACK, EL_ROUND_FORCED, EL_ROUND_SCHED, EL_OBJ_PCAP,
   EL_OBJ_CPU, EL_OBJ_PYTASK, EL_OBJ_OTHER, EL_DEVICE_SHARDED,
-  EL_ENGINE_EXCHANGE, EL_ENGINE_UNSHARDED, EL_N,
+  EL_ENGINE_EXCHANGE, EL_ENGINE_UNSHARDED, EL_SVC_QUIESCENT, EL_N,
 };
 
 /* Order mirrors the EL_* enum (and trace/events.py EL_NAMES). */
@@ -238,6 +238,7 @@ static const char *EL_NAMES[EL_N] = {
     "device-span:sharded",
     "engine-span:exchange-capacity",
     "engine-span:shard-unaligned",
+    "engine-span:managed-quiescent",
 };
 
 /* Fixed flight record; layout twinned byte-for-byte with
@@ -3972,14 +3973,23 @@ struct Engine {
      * run_span stops before any window touches a flagged host, and an
      * engine->object export ends the span at the producing round so
      * the manager can deliver it Python-side (span_exports below) —
-     * nothing is silently dropped. */
+     * nothing is silently dropped.  Callback-CAPABLE engine hosts
+     * (Python-owned sockets — the managed-process shape — or a
+     * Python rng) get the same tolerance when the manager PINS their
+     * py-work flag (the syscall service plane's quiescence gate):
+     * run_span never executes a pinned host, so no callback can fire
+     * mid-span, and a packet addressed to one only lowers its nt slot
+     * via push_inbox — the touch check then ends the span before the
+     * window that would execute it. */
     for (int64_t i = 0; i < nt_len; i++) {
       HostPlane *hp = plane((int)i);
+      bool covered = pw != nullptr && i < pw_len && pw[i];
       if (hp == nullptr) {
-        if (pw == nullptr || i >= pw_len || !pw[i]) return false;
+        if (!covered) return false;
         continue;
       }
-      if (hp->has_py_socks || !hp->rng_native) return false;
+      if ((hp->has_py_socks || !hp->rng_native) && !covered)
+        return false;
     }
     return true;
   }

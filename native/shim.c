@@ -159,10 +159,26 @@ enum {
     /* Manager-side layout twin: shadow_tpu/host/shim_abi.py
      * CHAN_SC_LOCAL (pinned to the real struct just below). */
     SC_CHAN_LOCAL_OFF = 280,
+    /* Syscall service plane (IPC protocol v8): header offset of the
+     * manager-written svc_flags word.  Twin of shim_abi.py OFF_SVC;
+     * pinned to the real struct just below, so the three-way
+     * agreement (struct, shim constant, Python offset) is airtight
+     * exactly like SC_CHAN_LOCAL_OFF. */
+    SC_SVC_FLAGS_OFF = 528,
+    /* Bounded spin budget before a response FUTEX_WAIT while the
+     * manager's service plane advertises active draining
+     * (svc_flags & SHIM_SVC_ACTIVE): short enough that a fleet of
+     * spinning managed processes cannot oversubscribe the box, long
+     * enough to catch a fast emulated answer without the sleep/wake
+     * round trip.  (Shim-local tuning knob, not an SC_* contract.) */
+    SVC_SPIN_ITERS = 4096,
 };
 _Static_assert(__builtin_offsetof(ipc_chan_t, sc_local) ==
                SC_CHAN_LOCAL_OFF,
                "sc_local offset drifted from shim_abi.py CHAN_SC_LOCAL");
+_Static_assert(__builtin_offsetof(shim_ipc_t, svc_flags) ==
+               SC_SVC_FLAGS_OFF,
+               "svc_flags offset drifted from shim_abi.py OFF_SVC");
 
 #define raw shadowtpu_raw_syscall
 
@@ -216,14 +232,33 @@ static void slot_send(ipc_slot_t *slot, const shim_event_t *ev) {
 
 static void slot_recv(ipc_slot_t *slot, shim_event_t *out) {
     uint32_t st = __atomic_load_n((uint32_t *)&slot->status, __ATOMIC_ACQUIRE);
+    /* Syscall service plane (IPC v8): while the manager advertises an
+     * actively-draining service plane, spin briefly before parking —
+     * a fast emulated answer then skips the futex sleep/wake pair
+     * entirely.  The budget is small (SC_SVC_SPIN pause iterations)
+     * so a fleet of waiting managed processes cannot oversubscribe
+     * the machine; correctness never depends on the flag. */
+    if (st != SLOT_READY && st != SLOT_CLOSED && g_ipc != 0 &&
+        (__atomic_load_n((uint32_t *)&g_ipc->svc_flags, __ATOMIC_ACQUIRE) &
+         SHIM_SVC_ACTIVE)) {
+        for (int i = 0; i < SVC_SPIN_ITERS; i++) {
+            __builtin_ia32_pause();
+            st = __atomic_load_n((uint32_t *)&slot->status,
+                                 __ATOMIC_ACQUIRE);
+            if (st == SLOT_READY || st == SLOT_CLOSED)
+                break;
+        }
+    }
     while (st != SLOT_READY) {
         if (st == SLOT_CLOSED)
             shim_die("[shadow-tpu shim] manager closed the channel\n");
         st = futex_wait_word(&slot->status, st);
     }
     memcpy(out, &slot->ev, sizeof(*out));
+    /* IPC v8: no FUTEX_WAKE after the EMPTY flip — the alternating
+     * protocol means the manager never waits for EMPTY (its send
+     * asserts it), so the wake was one wasted syscall per message. */
     __atomic_store_n((uint32_t *)&slot->status, SLOT_EMPTY, __ATOMIC_RELEASE);
-    futex_wake_word(&slot->status);
 }
 
 /* ---------------------------------------------------------------- */

@@ -33,7 +33,19 @@ typedef _Atomic uint64_t ipc_atomic_u64;
 #endif
 
 #define SHIM_IPC_MAGIC   0x53545055u /* "STPU" */
-#define SHIM_IPC_VERSION 7u
+/* v8: the syscall service plane (docs/OBSERVABILITY.md "Syscall
+ * service plane").  Two protocol changes ride the bump: (1) consumers
+ * no longer FUTEX_WAKE after flipping a slot back to EMPTY — the
+ * alternating protocol means NO ONE ever waits for EMPTY (both
+ * senders assert it), so those were one wasted futex syscall per
+ * message in each direction; (2) a new svc_flags header word lets the
+ * manager advertise that its service plane is actively draining, so
+ * the shim spins briefly before parking in FUTEX_WAIT for a response
+ * (catching fast emulated answers without a sleep/wake pair). */
+#define SHIM_IPC_VERSION 8u
+
+/* svc_flags bits (manager-written; shim read-only). */
+#define SHIM_SVC_ACTIVE 1u /* service plane draining: spin-then-wait */
 
 /* Slot status values; the status word doubles as the futex word. */
 enum {
@@ -161,7 +173,12 @@ typedef struct {
     char fork_path[IPC_PATH_MAX];
     /* LD_PRELOAD value to re-export across execve. */
     char preload_path[IPC_PATH_MAX];
-    uint8_t _hdr_pad[IPC_CHANS_OFF - 48 - 3 * IPC_PATH_MAX];
+    /* Syscall service plane (v8): SHIM_SVC_* bits, written by the
+     * manager when its service plane drains this process's channels.
+     * Advisory — the shim reads it to pick spin-then-wait over an
+     * immediate FUTEX_WAIT; correctness never depends on it. */
+    ipc_atomic_u32 svc_flags;
+    uint8_t _hdr_pad[IPC_CHANS_OFF - 48 - 3 * IPC_PATH_MAX - 4];
     ipc_chan_t chans[IPC_N_CHANS];
 } shim_ipc_t;
 
@@ -178,6 +195,7 @@ typedef struct {
 #define IPC_OFF_SELF_PATH  48
 #define IPC_OFF_FORK_PATH  (48 + IPC_PATH_MAX)
 #define IPC_OFF_PRELOAD    (48 + 2 * IPC_PATH_MAX)
+#define IPC_OFF_SVC_FLAGS  (48 + 3 * IPC_PATH_MAX)
 #define IPC_CHAN_STRIDE    320
 #define IPC_CHAN_TO_SHADOW 0
 #define IPC_CHAN_TO_SHIM   72
@@ -198,6 +216,8 @@ _Static_assert(sizeof(ipc_chan_t) == IPC_CHAN_STRIDE, "ipc_chan_t layout");
 _Static_assert(sizeof(shim_ipc_t) <= SHIM_IPC_FILE_SIZE, "fits in file");
 _Static_assert(__builtin_offsetof(shim_ipc_t, chans) == IPC_CHANS_OFF,
                "header layout");
+_Static_assert(__builtin_offsetof(shim_ipc_t, svc_flags) ==
+               IPC_OFF_SVC_FLAGS, "svc_flags offset");
 #endif
 
 #endif /* SHADOWTPU_SHIM_IPC_H */

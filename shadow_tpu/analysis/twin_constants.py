@@ -185,6 +185,7 @@ CONTRACTS = [
     ("EL_DEVICE_SHARDED", [(_TREV, "EL_DEVICE_SHARDED")]),
     ("EL_ENGINE_EXCHANGE", [(_TREV, "EL_ENGINE_EXCHANGE")]),
     ("EL_ENGINE_UNSHARDED", [(_TREV, "EL_ENGINE_UNSHARDED")]),
+    ("EL_SVC_QUIESCENT", [(_TREV, "EL_SVC_QUIESCENT")]),
     ("EL_N", [(_TREV, "EL_N")]),
     # Sim-netstat drop-cause codes + the per-connection telemetry
     # record layout (both device-span kernels carry the causes they
@@ -278,6 +279,9 @@ SHIM_CONTRACTS = [
     # mmap offset to the same literal — so the three-way agreement
     # (struct, shim constant, Python offset) is airtight.
     ("SC_CHAN_LOCAL_OFF", [(_SABI, "CHAN_SC_LOCAL")]),
+    # Syscall service plane (IPC v8): the manager-written svc_flags
+    # header word, pinned the same three-way way.
+    ("SC_SVC_FLAGS_OFF", [(_SABI, "OFF_SVC")]),
 ]
 SHIM_TRACE_PREFIXES = ("SC_",)
 

@@ -49,6 +49,15 @@ from shadow_tpu.ckpt.restore import (_DIGEST_SKIP_EXPERIMENTAL,
 # contract to begin with.
 FORK_SAFE_GENERAL = ("stop_time",)
 FORK_SAFE_EXPERIMENTAL = ("dctcp_k_pkts", "dctcp_k_bytes")
+# `faults:` schedules are fork-safe with two structural conditions
+# checked in fork_archive (ROADMAP item 5 — fault-variant fleets from
+# one warm snapshot): (1) the prefix the snapshot already APPLIED must
+# be preserved verbatim — the archive's fault cursor indexes into the
+# variant's schedule, and the per-host fault flags in the archive mean
+# "these ops happened"; (2) every other op must land strictly AFTER
+# the fork boundary — an op at or before it could never apply (the
+# round loop is already past) and would silently diverge from what
+# the archive claims, so it is refused instead.
 
 
 def _flatten(d: dict, prefix: str = "") -> dict:
@@ -89,7 +98,11 @@ def check_fork_compatible(base_config, variant_config) -> list[str]:
     allowed = {f"general.{k}" for k in FORK_SAFE_GENERAL} \
         | {f"experimental.{k}" for k in FORK_SAFE_EXPERIMENTAL}
     diffs = fork_diff(base_config, variant_config)
-    bad = [p for p in diffs if p not in allowed]
+    # faults: the whole schedule flattens under the "faults" prefix
+    # (a list — _flatten keeps it one leaf); structural validity is
+    # checked against the archive in fork_archive.
+    bad = [p for p in diffs
+           if p not in allowed and p.split(".")[0] != "faults"]
     if bad:
         tcp_bad = [p for p in bad
                    if p.startswith("hosts.") and ".tcp" in p]
@@ -105,6 +118,42 @@ def check_fork_compatible(base_config, variant_config) -> list[str]:
             f"{', …' if len(bad) > 6 else ''}); fork-safe: "
             f"{', '.join(sorted(allowed))}")
     return diffs
+
+
+def _check_fault_fork(base_config, variant_config, meta: dict) -> None:
+    """Structural validity of a fault-schedule fork against the
+    archive (see the FORK_SAFE comment): applied prefix preserved,
+    every other op strictly after the fork boundary."""
+    applied = int(meta.get("faults_applied", 0))
+    boundary = int(meta["next_start_ns"])
+    base = list(base_config.faults or ())
+    variant = list(variant_config.faults or ())
+    if len(variant) < applied:
+        raise CkptError(
+            f"fork refused: the snapshot already applied {applied} "
+            f"fault op(s) but the variant schedule has only "
+            f"{len(variant)} — the applied prefix must be preserved")
+
+    def row(f):
+        return (f.at_ns, f.action, f.host,
+                getattr(f, "snapshot", None))
+
+    for i in range(applied):
+        if row(variant[i]) != row(base[i]):
+            raise CkptError(
+                f"fork refused: fault op {i} was already applied by "
+                f"the snapshot and must be preserved verbatim in the "
+                f"variant (the archive's fault flags and cursor mean "
+                f"exactly those ops happened)")
+    for i in range(applied, len(variant)):
+        if variant[i].at_ns <= boundary:
+            raise CkptError(
+                f"fork refused: variant fault op {i} "
+                f"({variant[i].action} {variant[i].host} at "
+                f"{variant[i].at_ns} ns) is at or before the fork "
+                f"boundary ({boundary} ns) — the resumed round loop "
+                f"is already past it, so it could never apply; "
+                f"schedule fault variants strictly after the boundary")
 
 
 def fork_archive(snapshot_path: str, base_config, variant_config,
@@ -128,6 +177,8 @@ def fork_archive(snapshot_path: str, base_config, variant_config,
             f"fork refused: variant stop_time ({stop_ns} ns) is not "
             f"after the snapshot boundary ({meta['next_start_ns']} "
             f"ns) — nothing would run")
+    if any(p.split(".")[0] == "faults" for p in diffs):
+        _check_fault_fork(base_config, variant_config, meta)
     meta["config_digest"] = config_digest(variant_config)
     meta["forked_from"] = os.path.basename(snapshot_path)
     meta["forked_keys"] = diffs

@@ -44,6 +44,9 @@ CK_SEC_PLANE = 3   # engine plane blob (netplane.cpp plane_export)
 CK_SEC_TRACE = 4   # pickle: sim-time channel continuations + audit
 CK_SEC_RNG = 5     # packed (host id u32, rng counter u64) rows
 CK_SEC_FAULTS = 6  # json: per-host fault flags + schedule cursor
+CK_SEC_MANAGED = 7  # pickle: managed-process restart records
+#                     (ckpt/managed.py — final-state-checked restart
+#                     semantics; hosts section carries tombstones)
 
 CK_SEC_NAMES = {
     CK_SEC_META: "meta",
@@ -52,6 +55,7 @@ CK_SEC_NAMES = {
     CK_SEC_TRACE: "trace",
     CK_SEC_RNG: "rng",
     CK_SEC_FAULTS: "faults",
+    CK_SEC_MANAGED: "managed",
 }
 
 CK_RNG_ROW = struct.Struct("<IQ")

@@ -1,0 +1,342 @@
+"""Managed-process checkpointing: restart records + tombstone pickling.
+
+A managed (real-binary) process cannot be snapshotted mid-flight — its
+native memory, seccomp state and IPC block live in the OS, not the
+simulation.  What CAN be captured, and what a sim farm actually needs
+for long-running managed fleets (ROADMAP item 2), is **final-state-
+checked restart semantics**: the archive records each managed
+process's argv/env/expected_final_state (plus its host's syscall-
+channel position for `ckpt info`); resume restarts the binary FRESH at
+the snapshot boundary and the run is gated on the recorded expected
+final state.  Resumed managed runs therefore carry **no byte-
+continuation contract** — the restarted binary re-runs its life — but
+two resumes of the same archive are byte-identical to each other
+(gated in tests/test_svc.py), and everything non-managed in the sim
+still resumes exactly as before.
+
+Mechanics: `write_snapshot` pickles the host graph through
+`SnapshotPickler`, whose reducer_override replaces every managed-
+owned object — the ManagedProcess/ManagedThread pair, the IPC block
+and memory manager, the process's fd-table files (TCP backlog
+children included) and any condition whose wakeup or disarm hook
+belongs to managed machinery — with a `ManagedTombstone`.  Tombstones
+absorb attribute lookups (a pickled bound method of a managed thread
+loads as a no-op callable) so unpickling never trips; `purge
+_tombstones` then sweeps them out of the restored host (processes,
+event heap, interface associations, send queues) before anything runs.
+The straight run is never mutated — snapshotting stays a read-only
+walk.
+
+Refusals (clear, at snapshot time): a LIVE managed process created by
+fork (no spawn_tag) cannot be restart-checked — its lifecycle belongs
+to the parent's rerun, which would duplicate it; snapshot before the
+fork or after the child exits.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import types
+
+from shadow_tpu.ckpt.format import CkptError
+
+
+def _tombstone_noop(*_args, **_kwargs):
+    return None
+
+
+class ManagedTombstone:
+    """Placeholder a managed-owned object pickles into.  Attribute
+    lookups return a no-op callable so bound-method pickles (getattr
+    at load time) and defensive getattr probes never raise; calling
+    the tombstone itself is also a no-op."""
+
+    __slots__ = ()
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return _tombstone_noop
+
+    def __call__(self, *args, **kwargs):
+        return None
+
+    def __reduce__(self):
+        return (ManagedTombstone, ())
+
+
+def _is_tomb(obj) -> bool:
+    return isinstance(obj, ManagedTombstone) or obj is _tombstone_noop
+
+
+def _managed_types():
+    from shadow_tpu.host.futex import FutexTable
+    from shadow_tpu.host.managed import (ManagedProcess, ManagedThread,
+                                         MemoryManager)
+    from shadow_tpu.host.shim_abi import Channel, IpcBlock
+    return (ManagedProcess, ManagedThread, MemoryManager, IpcBlock,
+            Channel, FutexTable)
+
+
+def _condition_types():
+    from shadow_tpu.host.condition import (ManualCondition,
+                                           MultiSyscallCondition,
+                                           SyscallCondition)
+    return (SyscallCondition, ManualCondition, MultiSyscallCondition)
+
+
+class SnapshotPickler(pickle.Pickler):
+    """Pickler that strips managed-owned objects to tombstones.
+
+    `owned_ids` is the id() set of the managed processes' fd-table
+    objects (collect_managed builds it); type-based rules catch the
+    managed machinery itself and any condition wired to it."""
+
+    def __init__(self, file, owned_ids: set, protocol: int = 4):
+        super().__init__(file, protocol)
+        self._owned = owned_ids
+        self._mtypes = _managed_types()
+        self._ctypes = _condition_types()
+
+    def reducer_override(self, obj):
+        if isinstance(obj, self._mtypes) or id(obj) in self._owned:
+            return (ManagedTombstone, ())
+        if isinstance(obj, self._ctypes):
+            # A condition is managed-owned when its wakeup resolves to
+            # managed machinery, or when it carries an on_disarm hook
+            # (a closure — only the managed futex/fd paths set one).
+            wf = getattr(obj, "_wakeup_fn", None)
+            owner = getattr(wf, "__self__", None)
+            if isinstance(owner, self._mtypes) or id(owner) in self._owned:
+                return (ManagedTombstone, ())
+            if getattr(obj, "on_disarm", None) is not None:
+                return (ManagedTombstone, ())
+        if isinstance(obj, types.MethodType):
+            owner = obj.__self__
+            if isinstance(owner, self._mtypes) or id(owner) in self._owned:
+                return (ManagedTombstone, ())
+        return NotImplemented
+
+
+def dumps_hosts(hosts, owned_ids: set) -> bytes:
+    buf = io.BytesIO()
+    SnapshotPickler(buf, owned_ids).dump(hosts)
+    return buf.getvalue()
+
+
+def managed_domain_error(manager) -> str | None:
+    """Why this sim's managed processes cannot be restart-checked
+    (None = they can).  Only LIVE fork children refuse: a restarted
+    parent re-runs its whole lifecycle, forks included, so a live
+    child snapshotted alongside would be duplicated and its final
+    state unattributable."""
+    from shadow_tpu.host.managed import ManagedProcess
+    for host in manager.hosts:
+        for proc in host.processes.values():
+            if not isinstance(proc, ManagedProcess) or proc.exited:
+                continue
+            if getattr(proc, "spawn_tag", None) is None:
+                return (f"{host.name}/{proc.name} is a live managed "
+                        f"process created by fork: restart semantics "
+                        f"re-run the parent (which re-forks), so a "
+                        f"forked child cannot be restart-checked — "
+                        f"snapshot before the fork or after the child "
+                        f"exits (docs/CHECKPOINT.md)")
+    return None
+
+
+def collect_managed(manager) -> tuple[list, set]:
+    """(restart records, managed-owned object id set).  Records are
+    built in (host id, pid) order so byte-identical sims write
+    byte-identical archives; the id set feeds SnapshotPickler.
+    Read-only — the live run continues untouched except for
+    collect_output's incremental fold (idempotent, offsets only)."""
+    from shadow_tpu.host.managed import ManagedProcess
+    records: list = []
+    owned: set = set()
+    for host in manager.hosts:
+        for pid in sorted(host.processes):
+            proc = host.processes[pid]
+            if not isinstance(proc, ManagedProcess):
+                continue
+            for table in (proc.fds, getattr(proc, "fds_low", None)):
+                if table is None:
+                    continue
+                for _fd, f in table.items():
+                    owned.add(id(f))
+                    # TCP listeners hold not-yet-accepted children the
+                    # interface may also reference by 4-tuple.
+                    for child in getattr(f, "_accept_q", ()):
+                        owned.add(id(child))
+            if proc.exited:
+                proc.collect_output()
+            sc_log = getattr(host, "sc_log", None)
+            records.append({
+                "host_id": host.id,
+                "pid": pid,
+                "name": proc.name,
+                "spawn_tag": getattr(proc, "spawn_tag", None),
+                "argv": list(proc.argv),
+                "env": dict(proc.env),
+                "expected_final_state": proc.expected_final_state,
+                "work_dir": proc.work_dir,
+                "exited": bool(proc.exited),
+                "exit_code": proc.exit_code,
+                "term_signal": proc.term_signal,
+                "stdout": bytes(proc.stdout) if proc.exited else b"",
+                "stderr": bytes(proc.stderr) if proc.exited else b"",
+                # Syscall-channel position at the boundary (`ckpt
+                # info`): records this host had emitted so far.
+                "sc_records": sc_log.records if sc_log is not None
+                              else 0,
+            })
+    return records, owned
+
+
+def _orphan_packet(host, p) -> bool:
+    """True when `p` (an inbound packet at this host) resolves to no
+    association on either interface — after the tombstone sweep that
+    means it is stale traffic of the previous managed life.  The
+    restart happens AFTER the purge, so a restarted binary re-binding
+    the same well-known port can never be matched here."""
+    for iface in (host.lo, host.eth0):
+        if iface.lookup(p.protocol, p.dst_port, p.src_ip,
+                        p.src_port) is not None:
+            return False
+    return True
+
+
+def purge_tombstones(host) -> None:
+    """Sweep tombstones out of one restored host: dead processes,
+    event-heap tasks whose callable collapsed to a no-op, interface
+    associations and send queues of stripped sockets — and then the
+    previous life's TRAFFIC.  Stale packets must not reach a
+    restarted binary that re-binds the same port (a pre-snapshot ping
+    delivered to the fresh server would eat its budget), so after the
+    association sweep every packet that no longer resolves to a
+    receiver is purged: in-flight heap/inbox deliveries silently
+    (they sit in no ledger yet), router-queued and relay-parked ones
+    as attributed CoDel drops so the fabric conservation invariant
+    (enqueued == forwarded + dropped + queued + parked) stays exact."""
+    import heapq
+
+    from shadow_tpu.core.event import KIND_PACKET
+    for pid in [p for p, proc in host.processes.items()
+                if _is_tomb(proc)]:
+        del host.processes[pid]
+    heap = host.queue._heap
+    kept = [row for row in heap
+            if not (hasattr(row[4].data, "fn")
+                    and _is_tomb(row[4].data.fn))]
+    if len(kept) != len(heap):
+        heapq.heapify(kept)
+        host.queue._heap = kept
+    if not host.net_built():
+        return
+    for iface in (host.lo, host.eth0):
+        for key in [k for k, s in iface._assoc.items() if _is_tomb(s)]:
+            iface.disassociate(key[0], key[2], key[3], key[4])
+        iface._queued = {s for s in iface._queued if not _is_tomb(s)}
+        iface._send_heap = [row for row in iface._send_heap
+                            if not _is_tomb(row[2])]
+        heapq.heapify(iface._send_heap)
+        iface._send_ready = type(iface._send_ready)(
+            s for s in iface._send_ready if not _is_tomb(s))
+    # Stale in-flight deliveries (cross-host packets not yet executed):
+    # not in any queue ledger — delete silently.
+    heap = host.queue._heap
+    kept = [row for row in heap
+            if not (row[4].kind == KIND_PACKET
+                    and type(row[4].data) is not int
+                    and _orphan_packet(host, row[4].data))]
+    if len(kept) != len(heap):
+        heapq.heapify(kept)
+        host.queue._heap = kept
+    host._inbox = type(host._inbox)(
+        ev for ev in host._inbox
+        if not (ev.kind == KIND_PACKET and type(ev.data) is not int
+                and _orphan_packet(host, ev.data)))
+    # Router-queued stale packets: drop through the CoDel counters +
+    # the codel TEL cause so drop attribution and the per-interface
+    # byte ledger reconcile exactly.
+    codel = host.router._inbound
+    kept_q, stale = [], []
+    for entry in codel._q:
+        (stale if _orphan_packet(host, entry[0])
+         else kept_q).append(entry)
+    if stale:
+        codel._q = type(codel._q)(kept_q)
+        for p, _t in stale:
+            codel._bytes -= p.total_size()
+            codel._drop(p, lambda pk: host.trace_drop(pk, "codel"))
+    # Relay-parked packet (popped from the queue, waiting on a bucket
+    # refill): per the ledger it is still "inside" — parked-1,
+    # dropped+1 balances.
+    relay = host.relay_inet_in
+    parked = relay._pending_packet
+    if parked is not None and _orphan_packet(host, parked):
+        relay._pending_packet = None
+        codel._drop(parked, lambda pk: host.trace_drop(pk, "codel"))
+
+
+class _RestartTask:
+    """Scheduled at the resume boundary: build a fresh ManagedProcess
+    from the restart record and spawn the binary."""
+
+    __slots__ = ("rec",)
+
+    def __init__(self, rec: dict):
+        self.rec = rec
+
+    def __call__(self, host) -> None:
+        from shadow_tpu.host.managed import ManagedProcess
+        rec = self.rec
+        # Output goes under the RESUMED run's data directory (_rewire
+        # re-points host.data_path exactly like every other artifact);
+        # the recorded work_dir is only the fallback for hosts with no
+        # data dir — writing into the snapshot-time path would clobber
+        # the straight run's tree, or crash where it is unwritable.
+        proc = ManagedProcess(
+            host, rec["name"], list(rec["argv"]), dict(rec["env"]),
+            expected_final_state=rec["expected_final_state"],
+            work_dir=getattr(host, "data_path", None)
+            or rec["work_dir"])
+        proc.strace_mode = host.strace_mode
+        if rec["spawn_tag"] is not None:
+            proc.spawn_tag = rec["spawn_tag"]
+        proc.start_native(host, rec["argv"][0] if rec["argv"] else None)
+
+
+def restore_managed(manager, records: list, at: int) -> None:
+    """Re-create the managed fleet on a resumed manager: exited
+    processes come back as final-state husks (their recorded output
+    and exit code, judged by the normal expected-final-state sweep);
+    live ones restart fresh at the boundary `at`, gated on the
+    recorded expected final state."""
+    from shadow_tpu.core.event import TaskRef
+    from shadow_tpu.host.process import Process
+    for rec in records:
+        host = manager.hosts[rec["host_id"]]
+        if rec["exited"]:
+            husk = Process(host, rec["name"], list(rec["argv"]),
+                           dict(rec["env"]),
+                           expected_final_state=rec
+                           ["expected_final_state"])
+            # Re-key under the recorded pid: register_process handed
+            # out a fresh one, but the husk IS the old process.
+            del host.processes[husk.pid]
+            host._next_pid -= 1
+            husk.pid = husk.pgid = husk.sid = rec["pid"]
+            host.processes[rec["pid"]] = husk
+            husk.exited = True
+            husk.exit_code = rec["exit_code"]
+            husk.term_signal = rec["term_signal"]
+            husk.stdout = bytearray(rec["stdout"])
+            husk.stderr = bytearray(rec["stderr"])
+            if rec["spawn_tag"] is not None:
+                husk.spawn_tag = rec["spawn_tag"]
+            continue
+        host.schedule_task_at(max(at, host._now),
+                              TaskRef("managed-restart",
+                                      _RestartTask(rec)))

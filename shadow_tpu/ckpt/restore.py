@@ -32,6 +32,10 @@ _DIGEST_SKIP_EXPERIMENTAL = (
     "tpu_max_packets_per_round", "tpu_shards", "tpu_exchange_capacity",
     "pcap_span_cap", "chrome_top_n", "report_errors_to_stderr",
     "tpu_donate_buffers",
+    # Syscall service plane: a wall-side scheduling knob (byte
+    # identity holds on and off — tests/test_svc.py) and the waitpid
+    # safety-net poll slice, which never reaches simulation bytes.
+    "syscall_service_plane", "managed_death_poll",
 )
 
 
@@ -118,6 +122,15 @@ def _rewire(manager, h, fresh, appmap: dict) -> None:
     # under the variant's K from the first post-fork round).
     h.dctcp_k_pkts = fresh.dctcp_k_pkts
     h.dctcp_k_bytes = fresh.dctcp_k_bytes
+    # Same rule for the service-plane knobs (all digest-skipped, so a
+    # resume may legitimately change them): the waitpid safety-net
+    # poll slice and the svc advertisement come from the RESUMED
+    # config, not the archive — otherwise the pickled values would
+    # silently override while metrics.wall.ipc reported the new ones.
+    h.death_poll_ns = fresh.death_poll_ns
+    h.svc_managed = fresh.svc_managed
+    h.py_pinned = fresh.py_pinned
+    h.svc_active = getattr(fresh, "svc_active", False)
     h.data_path = fresh.data_path
     h.strace_mode = getattr(fresh, "strace_mode", None)
     h._send_packet_fn = manager.propagator.send
@@ -183,6 +196,10 @@ def resume_manager(config, path: str):
         appmap = manager.plane.engine.plane_import(
             sections[ck.CK_SEC_PLANE])
 
+    managed_records = None
+    if ck.CK_SEC_MANAGED in sections:
+        managed_records = pickle.loads(sections[ck.CK_SEC_MANAGED])
+
     hosts = pickle.loads(sections[ck.CK_SEC_HOSTS])
     if len(hosts) != len(manager.hosts):
         raise CkptError("snapshot host list does not match the config")
@@ -191,6 +208,13 @@ def resume_manager(config, path: str):
         if fresh.name != h.name:
             raise CkptError(f"host order mismatch: {fresh.name!r} vs "
                             f"snapshot {h.name!r}")
+        if managed_records is not None:
+            # Managed restart semantics: sweep the tombstoned managed
+            # machinery out BEFORE anything walks the host (processes,
+            # no-op heap tasks, dead socket associations) — the
+            # restart records below re-create the fleet.
+            from shadow_tpu.ckpt.managed import purge_tombstones
+            purge_tombstones(h)
         _rewire(manager, h, fresh, appmap)
         manager.hosts[h.id] = h
     replay.rebuild_hosts(manager.hosts)
@@ -220,6 +244,15 @@ def resume_manager(config, path: str):
                 f"state — corrupt archive")
     manager._faults_applied = int(faults.get("applied", 0))
     manager.runahead._value = max(1, int(meta["runahead_ns"]))
+    if managed_records is not None:
+        # Restart the managed fleet at the boundary: exited processes
+        # come back as final-state husks, live ones respawn fresh and
+        # the run is gated on their recorded expected final state
+        # (no byte-continuation contract for managed traffic —
+        # docs/CHECKPOINT.md "Managed processes").
+        from shadow_tpu.ckpt.managed import restore_managed
+        restore_managed(manager, managed_records,
+                        meta["next_start_ns"])
     manager._resume = {
         "rounds": meta["rounds"],
         "span_rounds": meta["span_rounds"],
@@ -243,6 +276,11 @@ def restore_host(manager, path: str, host_id: int, at: int) -> None:
     sections = ck.read_archive(path)
     meta = json.loads(sections[ck.CK_SEC_META].decode())
     _check_meta(manager.config, meta, manager.plane is not None)
+    if meta.get("managed"):
+        raise CkptError(
+            "host_restore from a snapshot carrying managed restart "
+            "records is not supported — a managed process cannot be "
+            "re-imaged mid-run (docs/CHECKPOINT.md)")
 
     cur = manager.hosts[host_id]
     appmap: dict = {}

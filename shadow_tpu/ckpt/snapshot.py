@@ -51,13 +51,16 @@ def checkpoint_domain_error(manager) -> str | None:
             return (f"host {name!r} captures pcap: capture files are "
                     f"append-only and cannot be resumed "
                     f"byte-identically (disable pcap to checkpoint)")
+    # Managed (real-binary) processes snapshot under final-state-
+    # checked RESTART semantics (ckpt/managed.py): restart records +
+    # tombstoned runtime state, resumed runs gated on expected final
+    # state instead of byte continuation.  Only live fork children
+    # refuse (their lifecycle belongs to the parent's rerun).
+    from shadow_tpu.ckpt.managed import managed_domain_error
+    err = managed_domain_error(manager)
+    if err is not None:
+        return err
     for host in manager.hosts:
-        for proc in host.processes.values():
-            if isinstance(proc, ManagedProcess):
-                return (f"{host.name}/{proc.name} is a managed (real-"
-                        f"binary) process: native memory cannot be "
-                        f"snapshotted — checkpointing covers pure-sim "
-                        f"hosts only (docs/CHECKPOINT.md)")
         if host.plane is not None:
             if host._nsocks:
                 return (f"host {host.name!r} runs a Python process "
@@ -71,6 +74,8 @@ def checkpoint_domain_error(manager) -> str | None:
                             f"plane hosts")
         else:
             for proc in host.processes.values():
+                if isinstance(proc, ManagedProcess):
+                    continue  # restart records, not transcripts
                 for t in getattr(proc, "threads", ()):
                     from shadow_tpu.host.process import ST_EXITED
                     if t.state != ST_EXITED and t.log is None:
@@ -142,9 +147,21 @@ def write_snapshot(manager, summary, next_start: int, path: str,
         engine = manager.plane.engine
         sections[ck.CK_SEC_PLANE] = engine.plane_export()
 
+    # Managed processes: build restart records and pickle the host
+    # graph through the tombstone-stripping pickler (ckpt/managed.py)
+    # — read-only over the live run either way.
+    from shadow_tpu.ckpt.managed import collect_managed, dumps_hosts
+    managed_records, owned_ids = collect_managed(manager)
+    if managed_records:
+        sections[ck.CK_SEC_MANAGED] = pickle.dumps(managed_records,
+                                                   protocol=4)
     try:
-        sections[ck.CK_SEC_HOSTS] = pickle.dumps(manager.hosts,
-                                                 protocol=4)
+        if owned_ids or managed_records:
+            sections[ck.CK_SEC_HOSTS] = dumps_hosts(manager.hosts,
+                                                    owned_ids)
+        else:
+            sections[ck.CK_SEC_HOSTS] = pickle.dumps(manager.hosts,
+                                                     protocol=4)
     except Exception as e:
         raise ck.CkptError(
             f"cannot snapshot: host state holds an unserializable "
@@ -167,6 +184,11 @@ def write_snapshot(manager, summary, next_start: int, path: str,
         "stop_time_ns": manager.config.general.stop_time_ns,
         "n_hosts": len(manager.hosts),
         "engine": manager.plane is not None,
+        # Managed restart records in the archive (0 = pure-sim
+        # snapshot with the full byte-continuation contract; >0 =
+        # resume restarts these binaries fresh under final-state
+        # gating, docs/CHECKPOINT.md "Managed processes").
+        "managed": len(managed_records),
         "rounds": summary.rounds,
         "span_rounds": summary.span_rounds,
         "busy_end_ns": summary.busy_end_ns,

@@ -230,6 +230,25 @@ class ExperimentalConfig:
     # (metrics.sim.syscalls.dispositions) run regardless — cheap
     # integer adds, like drop attribution.
     syscall_observatory: str = "off"
+    # Syscall service plane (docs/OBSERVABILITY.md "Syscall service
+    # plane", ROADMAP item 2): per conservative round, every managed
+    # host's due servicing work is drained by a host-affine worker
+    # pool instead of the scheduler's serial host walk — each host
+    # stays on one worker group so per-host event order (and the
+    # byte-identical syscalls-sim.bin channel) is preserved, while
+    # the futex waits of independent hosts' round trips overlap.
+    # "auto" enables it whenever managed (real-binary) processes are
+    # configured and more than one worker is available; "on" forces
+    # it; "off" keeps the scheduler's own host walk.  Byte identity
+    # holds in every mode (gated in tests/test_svc.py).
+    syscall_service_plane: str = "auto"
+    # Channel-wait slice between waitpid safety-net polls while a
+    # managed thread blocks in its IPC recv.  Child death is normally
+    # detected by the ChildWatcher closing the IPC block; this poll is
+    # only the fallback, so it can be long without costing latency.
+    # Wall-side only (never reaches simulation bytes); the effective
+    # value is surfaced in metrics.wall.ipc.death_poll_ns.
+    managed_death_poll_ns: int = 2_000_000_000
     # Max conservative rounds a C++ engine span may buffer between
     # pcap drains when engine-side capture is active (was hard-coded;
     # per-round streams must not buffer a whole sim).  The effective
@@ -339,6 +358,8 @@ class ConfigOptions:
                 "fabricstat_interval": _ns(e.fabricstat_interval_ns),
                 "chrome_top_n": e.chrome_top_n,
                 "syscall_observatory": e.syscall_observatory,
+                "syscall_service_plane": e.syscall_service_plane,
+                "managed_death_poll": _ns(e.managed_death_poll_ns),
                 "pcap_span_cap": e.pcap_span_cap,
                 "dctcp_k_pkts": e.dctcp_k_pkts,
                 "dctcp_k_bytes": e.dctcp_k_bytes,
@@ -511,6 +532,11 @@ class ConfigOptions:
                 ("syscall_observatory", "syscall_observatory",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
+                ("syscall_service_plane", "syscall_service_plane",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
+                ("managed_death_poll", "managed_death_poll_ns",
+                 units.parse_time_ns),
                 ("pcap_span_cap", "pcap_span_cap", int),
                 ("dctcp_k_pkts", "dctcp_k_pkts", int),
                 ("dctcp_k_bytes", "dctcp_k_bytes", units.parse_bytes),
@@ -547,6 +573,16 @@ class ConfigOptions:
                 f"unknown syscall_observatory "
                 f"{experimental.syscall_observatory!r}; expected one of "
                 f"('off', 'wall', 'on')")
+        if experimental.syscall_service_plane not in ("off", "auto",
+                                                      "on"):
+            raise ValueError(
+                f"unknown syscall_service_plane "
+                f"{experimental.syscall_service_plane!r}; expected one "
+                f"of ('off', 'auto', 'on')")
+        if experimental.managed_death_poll_ns < 1_000_000:
+            raise ValueError(
+                "managed_death_poll must be >= 1ms (it is the waitpid "
+                "safety-net poll slice, not a latency knob)")
         if experimental.pcap_span_cap < 1:
             raise ValueError("pcap_span_cap must be >= 1")
         if experimental.dctcp_k_pkts < 1:

@@ -263,6 +263,11 @@ class Manager:
                     config.experimental.native_preemption_sim_interval_ns
             host.max_unapplied_ns = \
                 config.experimental.max_unapplied_cpu_latency_ns
+            # Waitpid safety-net poll slice for managed-thread IPC
+            # recvs (was hard-coded; surfaced in
+            # metrics.wall.ipc.death_poll_ns).
+            host.death_poll_ns = \
+                config.experimental.managed_death_poll_ns
             host.crypto_noop = crypto_noop_path  # lib path or None
             bw = config.experimental.native_file_io_bandwidth_bps
             if config.general.model_unblocked_syscall_latency and bw > 0:
@@ -318,6 +323,47 @@ class Manager:
         threaded = sched in ("thread_per_core", "thread_per_host")
         self._per_host_tasks = sched == "thread_per_host"
         self._nt: list = []          # shared per-host next-event snapshot
+
+        # ---- syscall service plane (shadow_tpu/svc/, docs/
+        # OBSERVABILITY.md "Syscall service plane") ------------------
+        # Managed (real-binary) hosts are known from config: a process
+        # configured by filesystem path that no internal-app factory
+        # claims runs under the interposition stack (SpawnTask's
+        # dispatch rule).  They are flagged up front — svc_managed
+        # routes their round servicing to the host-affine worker pool;
+        # py_pinned keeps their py-work slot permanently True so the
+        # engine's span loop stops before any window that would touch
+        # one (the quiescence gate's safety argument, netplane.cpp
+        # span_eligible).
+        managed_hosts = []
+        for host in self.hosts:
+            hcfg = config.hosts[host.name]
+            if any("/" in pcfg.path
+                   and app_registry.lookup(pcfg.path) is None
+                   for pcfg in hcfg.processes):
+                host.svc_managed = True
+                host.py_pinned = True
+                managed_hosts.append(host)
+            else:
+                host.svc_managed = False
+        svc_mode = config.experimental.syscall_service_plane
+        # parallelism 0 = auto (num cores), matching the schedulers.
+        svc_workers = config.general.parallelism or os.cpu_count() or 1
+        svc_workers = max(1, int(svc_workers))
+        svc_on = (bool(managed_hosts)
+                  and not config.experimental.use_perf_timers
+                  and (svc_mode == "on"
+                       or (svc_mode == "auto" and svc_workers > 1)))
+        self.svc = None
+        if svc_on:
+            from shadow_tpu.svc import SyscallServicePlane
+            self.svc = SyscallServicePlane(
+                max(1, min(svc_workers, len(managed_hosts))))
+            for host in managed_hosts:
+                # Advertised to the shim via the IPC v8 svc_flags
+                # header word (spin-then-wait for responses).
+                host.svc_active = True
+        self._managed_mask = None  # built in _init_next_times
 
         # Native (C++) data plane: the performance path behind
         # scheduler=tpu.  Per-host opt-out keeps pcap capture and the
@@ -394,9 +440,14 @@ class Manager:
                 min_device_batch=config.experimental.tpu_min_device_batch,
                 runahead=self.runahead)
         else:
+            # The service plane executes managed hosts concurrently
+            # even under scheduler=serial, so the propagator's
+            # min-inflight reduction must take its threaded (locked)
+            # form whenever the plane is active.
             self.propagator = ScalarPropagator(
                 self.hosts, self.dns, graph.latency_ns, thr, seed,
-                config.general.bootstrap_end_time_ns, threaded=threaded,
+                config.general.bootstrap_end_time_ns,
+                threaded=threaded or self.svc is not None,
                 runahead=self.runahead)
         for host in self.hosts:
             host._send_packet_fn = self.propagator.send
@@ -509,7 +560,8 @@ class Manager:
         if config.experimental.syscall_observatory in ("wall", "on"):
             from shadow_tpu.trace.sctrace import SyscallObservatory
             self.sctrace = SyscallObservatory(
-                config.experimental.syscall_observatory, self.hosts)
+                config.experimental.syscall_observatory, self.hosts,
+                death_poll_ns=config.experimental.managed_death_poll_ns)
 
     # ------------------------------------------------------------------
 
@@ -554,12 +606,21 @@ class Manager:
         # real heap/inbox state and maintain the slot incrementally
         # (schedule/deliver set it, execute-end recomputes it).
         pw = np.ones(len(self.hosts), dtype=bool)
+        mng = np.zeros(len(self.hosts), dtype=bool)
+        any_mng = False
         for h in self.hosts:
             h._nt_list = nt
             if h.plane is not None:
                 h._py_work_arr = pw
-                pw[h.id] = bool(h.queue._heap) or bool(h._inbox)
+                # py_pinned (managed hosts): the slot never recomputes
+                # to False — the quiescence gate's safety net.
+                pw[h.id] = bool(h.queue._heap) or bool(h._inbox) \
+                    or h.py_pinned
+            if getattr(h, "svc_managed", False):
+                mng[h.id] = True
+                any_mng = True
         self._py_work = pw
+        self._managed_mask = mng if any_mng else None
         if self.plane is not None:
             self.plane.engine.set_nt(nt)
             # Span loop safety: the engine must know which hosts carry
@@ -597,9 +658,14 @@ class Manager:
         snapshot (which inbox deliveries and engine pushes keep
         current).  At scale most hosts are idle most rounds; skipping
         them is a pure win because the barrier already covers in-flight
-        packets via the propagator's finish_round min."""
+        packets via the propagator's finish_round min.  With the
+        syscall service plane active, managed hosts are excluded —
+        they drain concurrently on the plane's worker pool."""
         hosts = self.hosts
-        return [hosts[i] for i in np.flatnonzero(self._nt < until)]
+        mask = self._nt < until
+        if self.svc is not None and self._managed_mask is not None:
+            mask &= ~self._managed_mask
+        return [hosts[i] for i in np.flatnonzero(mask)]
 
     def _run_engine_batch(self, until: int, nthreads: int) -> list:
         """Engine fast path: hosts whose pending work is entirely
@@ -612,6 +678,9 @@ class Manager:
         was ~10% of the round loop."""
         eng = self.plane.engine
         mask = self._nt < until
+        if self.svc is not None and self._managed_mask is not None:
+            # Managed hosts drain on the service plane's worker pool.
+            mask = mask & ~self._managed_mask
         fast = np.flatnonzero(mask & ~self._py_work)
         slow = np.flatnonzero(mask & self._py_work)
         if fast.size:
@@ -637,6 +706,25 @@ class Manager:
                                dport, payload, tcp)
 
     def _run_hosts(self, until: int) -> None:
+        svc_join = None
+        if self.svc is not None and self._managed_mask is not None:
+            # Syscall service plane: this round's due managed hosts
+            # drain on the host-affine worker pool, OVERLAPPING the
+            # scheduler's walk of everyone else below — the futex
+            # waits of independent hosts' syscall round trips no
+            # longer serialize.  Joined before returning, so the
+            # propagation barrier still sees every send.
+            due = np.flatnonzero((self._nt < until) & self._managed_mask)
+            if due.size:
+                svc_join = self.svc.dispatch(
+                    [self.hosts[i] for i in due.tolist()], until)
+        try:
+            self._run_hosts_inner(until)
+        finally:
+            if svc_join is not None:
+                svc_join()
+
+    def _run_hosts_inner(self, until: int) -> None:
         if self._perf_timers:
             # perf_timers feature (perf_timer.rs; host.rs:680-688): time
             # each host's event execution.  Serial-only measurement keeps
@@ -917,6 +1005,7 @@ class Manager:
             else:
                 span_now = False
             py_limit = None
+            py_quiescent = False
             if span_now and self._py_work.any():
                 # Python-side work pending somewhere — transient heap
                 # tasks (spawns/shutdowns) on engine hosts, or
@@ -940,6 +1029,17 @@ class Manager:
                     round_reason = self._object_block_reason(py_min)
                 else:
                     py_limit = py_min - ra + 1
+                    # Quiescence gate (syscall service plane): when
+                    # the EARLIEST Python-side work belongs entirely
+                    # to managed hosts — every managed process parked
+                    # on a condition with no expiry before py_min —
+                    # the span rounds below are managed-quiescent
+                    # coverage, attributed under their own EL_* code.
+                    if self._managed_mask is not None:
+                        idx = np.flatnonzero(self._py_work
+                                             & (self._nt == py_min))
+                        py_quiescent = bool(idx.size) and bool(
+                            self._managed_mask[idx].all())
             if span_now:
                 limit = stop
                 if heartbeat_lines:
@@ -1034,7 +1134,8 @@ class Manager:
                 # Reason the rounds below land in a C++ span instead
                 # of a device span (the audit's engine-span:* split).
                 if py_limit is not None:
-                    span_reason = trev.EL_ENGINE_PYLIMIT
+                    span_reason = (trev.EL_SVC_QUIESCENT if py_quiescent
+                                   else trev.EL_ENGINE_PYLIMIT)
                 elif not dev_span_on:
                     span_reason = dev_off_reason
                 else:
@@ -1272,6 +1373,8 @@ class Manager:
                         f"{proc.expected_final_state!r}, got {state!r}")
         if self._pool is not None:
             self._pool.shutdown()
+        if self.svc is not None:
+            self.svc.shutdown()
         closer = getattr(self.propagator, "close", None)
         if closer is not None:
             closer()  # stop async route probes; never blocks
@@ -1794,6 +1897,10 @@ class Manager:
                 }
         reg = self.metrics
         reg.ingest("dispatch", dispatch, channel="wall")
+        if self.svc is not None:
+            # Syscall service plane: worker count + host-rounds
+            # drained (wall-side scheduling telemetry, like dispatch).
+            reg.ingest("svc", self.svc.wall_summary(), channel="wall")
         # Sim-netstat drop attribution (always on): one TEL_* cause
         # per drop on every execution path, so these counters are
         # deterministic AND path-identical — they live in the SIM

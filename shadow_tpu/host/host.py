@@ -133,6 +133,13 @@ class Host:
         # Python-side work (heap entries / undrained inbox) and so must
         # skip the engine-only fast path.
         self._py_work_arr = None
+        # Permanently pinned py-work flag (syscall service plane's
+        # quiescence gate): a managed-process host's packets always
+        # need Python-side servicing, so its slot must never recompute
+        # to False — the engine's span loop relies on the flag to stop
+        # before any window that would touch this host (netplane.cpp
+        # span_eligible).
+        self.py_pinned = False
 
         # Canonical packet trace: (time, kind, src_host, pkt_seq, text).
         self.trace_entries: list = []
@@ -422,9 +429,11 @@ class Host:
                     # concurrent deliverer sets the flag True under it,
                     # and an unlocked False store here could land last
                     # and strand the delivered event on the engine-only
-                    # fast path.
+                    # fast path.  A pinned host (managed processes)
+                    # never recomputes to False.
                     self._py_work_arr[self.id] = \
-                        bool(self.queue._heap) or bool(self._inbox)
+                        bool(self.queue._heap) or bool(self._inbox) \
+                        or self.py_pinned
 
     def next_event_time(self):
         t = self.queue.peek_time()
@@ -629,6 +638,7 @@ class Host:
     def __setstate__(self, d):
         relay_state = d.pop("_relay_state", None)
         self.__dict__.update(d)
+        self.__dict__.setdefault("py_pinned", False)
         self._inbox_lock = threading.Lock()
         self._nt_list = None
         self._py_work_arr = None

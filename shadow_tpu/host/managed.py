@@ -58,7 +58,9 @@ from shadow_tpu.trace import events as trev
 # Channel-wait slice between waitpid fallback polls.  Child death is
 # normally detected by the ChildWatcher thread closing the IPC block
 # (child_watcher.py); this poll is only a safety net, so it can be
-# long without costing latency.
+# long without costing latency.  The default; the effective value is
+# the experimental.managed_death_poll knob (Host.death_poll_ns,
+# surfaced in metrics.wall.ipc.death_poll_ns).
 _DEATH_POLL_NS = 2_000_000_000
 
 # Reserved native fd for the manager<->process transfer socket (native
@@ -292,6 +294,11 @@ class ManagedProcess(Process):
         ipc.set_sim_time(host.now())
         ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
         ipc.set_self_path(ipc_path)
+        if getattr(host, "svc_active", False):
+            # Syscall service plane (IPC v8): tell the shim to spin
+            # briefly before parking for responses — advisory only.
+            from shadow_tpu.host.shim_abi import SVC_ACTIVE
+            ipc.set_svc_flags(SVC_ACTIVE)
 
         env = dict(env)
         # Prepend the shim exactly once (an exec'd app passes through
@@ -640,7 +647,8 @@ class ManagedThread:
             while True:
                 try:
                     ev = self.chan.recv_from_shim(
-                        timeout_ns=_DEATH_POLL_NS)
+                        timeout_ns=getattr(host, "death_poll_ns",
+                                           _DEATH_POLL_NS))
                     # Native-I/O latency the shim accrued since its last
                     # event; flows into the standard unapplied-CPU model.
                     ns = self.chan.take_unapplied_ns()
@@ -1250,6 +1258,9 @@ class ManagedThread:
         ipc.set_sim_time(host.now())
         ipc.set_auxv_random(host.rng.next_u64(), host.rng.next_u64())
         ipc.set_self_path(ipc_path)
+        if getattr(host, "svc_active", False):
+            from shadow_tpu.host.shim_abi import SVC_ACTIVE
+            ipc.set_svc_flags(SVC_ACTIVE)
         preload = getattr(parent, "_preload", "")
         if preload:
             ipc.set_preload(preload)

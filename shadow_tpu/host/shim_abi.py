@@ -23,7 +23,10 @@ import struct
 
 # --- constants mirrored from native/shim_ipc.h ---------------------
 MAGIC = 0x53545055
-VERSION = 7
+# v8: syscall service plane — svc_flags header word (OFF_SVC below)
+# and the consumer-side FUTEX_WAKE dropped from both directions (the
+# alternating protocol means no one ever waits for an EMPTY slot).
+VERSION = 8
 FILE_SIZE = 24576
 
 N_CHANS = 64
@@ -66,6 +69,12 @@ OFF_SIGSEGV = 32
 OFF_SELF_PATH = 48
 OFF_FORK_PATH = 48 + PATH_MAX
 OFF_PRELOAD = 48 + 2 * PATH_MAX
+# Syscall service plane (IPC v8): manager-written advisory flags the
+# shim reads to pick spin-then-wait for responses.  C twin:
+# SC_SVC_FLAGS_OFF in native/shim.c (static_assert-pinned to the
+# struct; analysis pass 1 diffs the two values).
+OFF_SVC = 48 + 3 * PATH_MAX
+SVC_ACTIVE = 1  # SHIM_SVC_ACTIVE
 SLOT_EV_OFF = 8
 EV_STRUCT = struct.Struct("<II7q")  # kind, pad, num, args[6]
 
@@ -140,8 +149,10 @@ class Channel:
             if st == SLOT_READY:
                 kind, _pad, num, *args = EV_STRUCT.unpack_from(
                     blk._mm, off + SLOT_EV_OFF)
+                # IPC v8: no wake after the EMPTY flip — the shim's
+                # send asserts EMPTY instead of waiting for it, so the
+                # wake was one wasted futex syscall per event.
                 blk._store_u32(off, SLOT_EMPTY)
-                _futex_wake(blk._addr + off)
                 return kind, num, args
             if st == SLOT_CLOSED:
                 raise ChannelClosed
@@ -253,6 +264,13 @@ class IpcBlock:
         chaining fault handler (the shim owns the native SIGSEGV slot
         for rdtsc emulation)."""
         struct.pack_into("<QQ", self._mm, OFF_SIGSEGV, handler, flags)
+
+    def set_svc_flags(self, flags: int) -> None:
+        """Advertise service-plane state to the shim (IPC v8): with
+        SVC_ACTIVE set the shim spins briefly before parking in
+        FUTEX_WAIT for a response.  Advisory only — byte identity
+        never depends on it."""
+        struct.pack_into("<I", self._mm, OFF_SVC, flags)
 
     # -- teardown ---------------------------------------------------
 

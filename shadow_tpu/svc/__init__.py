@@ -1,0 +1,7 @@
+"""Syscall service plane (docs/OBSERVABILITY.md "Syscall service
+plane"): batched, host-affine servicing of managed-process syscalls —
+ROADMAP item 2's engine.  See svc/plane.py."""
+
+from shadow_tpu.svc.plane import SyscallServicePlane
+
+__all__ = ["SyscallServicePlane"]

@@ -1,0 +1,93 @@
+"""Syscall service plane: batched managed-process servicing.
+
+The syscall observatory (PR 6) measured where `bench[managed-128]`'s
+wall goes: one futex wait/dispatch/resume round trip per syscall, run
+from the scheduler's SERIAL per-host walk — while host A's native
+process computes between syscalls, every other managed host waits its
+turn.  This plane lifts managed-host servicing out of that walk into a
+host-affine worker pool, the same shape as Laminar's move of TCP
+protocol work off the per-connection hot path into parallel engines
+(PAPERS.md, arXiv 2504.19058): batch the control plane, keep wakeups
+off the hot path.
+
+Determinism argument (the whole design hangs on it):
+
+- A conservative round's hosts are independent by construction — the
+  window is narrower than the minimum cross-host latency, so nothing
+  one host does inside the window can reach another host inside the
+  same window.  Executing them concurrently is exactly what the
+  thread_per_core scheduler already proves byte-safe.
+- Per-host event order is untouched: each host's whole
+  ``execute(until)`` runs as one unit on one worker group (hosts are
+  assigned by ``host.id % workers`` — host-affine, stable for the
+  run), so the host-serial syscall dispatch order — and with it the
+  byte-identical ``syscalls-sim.bin`` channel — is preserved.
+- Cross-host effects go through the propagator's ``send`` and the
+  destination inbox, both thread-safe (the manager arms the scalar
+  propagator's threaded mode whenever this plane is active).
+
+The wall win: workers blocked in the IPC futex recv release the GIL
+(the wait is a raw libc syscall), so N managed hosts' round trips
+overlap instead of serializing — and the v8 IPC protocol rev this PR
+ships (shim_ipc.h) drops the consumer-side FUTEX_WAKE from both
+directions and lets the shim spin briefly for fast answers while the
+plane advertises itself via the svc_flags header word.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+class SyscallServicePlane:
+    """Host-affine worker pool draining managed hosts' due servicing
+    work each conservative round.
+
+    ``dispatch(hosts, until)`` partitions the round's due managed
+    hosts into ``workers`` affinity groups (``host.id % workers``,
+    each group in ascending host id) and returns a join callable; the
+    manager runs the rest of the round's hosts while the groups drain,
+    then joins before the propagation barrier."""
+
+    def __init__(self, workers: int):
+        assert workers >= 1
+        self.workers = workers
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers,
+            thread_name_prefix="svc-worker")
+        # Wall-side telemetry for metrics.wall.svc.
+        self.rounds = 0          # rounds with >= 1 managed host due
+        self.hosts_serviced = 0  # host-rounds drained by the pool
+
+    @staticmethod
+    def _run_group(group, until: int) -> None:
+        for h in group:
+            h.execute(until)
+
+    def dispatch(self, hosts, until: int):
+        """Start draining `hosts` (due managed hosts, ascending id);
+        returns a 0-arg join callable that re-raises the first worker
+        exception.  An empty host list returns a no-op join."""
+        if not hosts:
+            return lambda: None
+        self.rounds += 1
+        self.hosts_serviced += len(hosts)
+        n = self.workers
+        groups = [[] for _ in range(n)]
+        for h in hosts:  # ascending id in, ascending id per group out
+            groups[h.id % n].append(h)
+        futures = [self._pool.submit(self._run_group, g, until)
+                   for g in groups if g]
+
+        def join():
+            for f in futures:
+                f.result()  # re-raise worker exceptions in round order
+        return join
+
+    def wall_summary(self) -> dict:
+        """The metrics.wall.svc block."""
+        return {"workers": self.workers, "rounds": self.rounds,
+                "hosts_serviced": self.hosts_serviced}
+
+    def shutdown(self) -> None:
+        self._pool.shutdown()

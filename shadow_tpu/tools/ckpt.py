@@ -46,6 +46,10 @@ def info(path: str) -> int:
     print(f"  seed           : {meta['seed']}")
     print(f"  runahead       : {meta['runahead_ns']} ns")
     print(f"  faults applied : {meta['faults_applied']}")
+    if meta.get("managed"):
+        print(f"  managed        : {meta['managed']} restart "
+              f"record(s) — resume restarts these binaries fresh "
+              f"under final-state gating")
     print(f"  config digest  : {meta['config_digest'][:16]}…")
     print("  sections:")
     for sid, crc, length in table:
@@ -243,6 +247,75 @@ def smoke(n_hosts: int) -> int:
     return 0
 
 
+def smoke_managed(n_procs: int) -> int:
+    """Managed-fleet restart smoke (the ./setup managed target):
+    `n_procs` REAL binaries under the shim -> snapshot mid-activity ->
+    restart-resume -> final-state gate (docs/CHECKPOINT.md "Managed
+    processes").  The resumed run carries no byte-continuation
+    contract (the binaries re-run), but two resumes of the same
+    archive must agree byte-for-byte — both are asserted here."""
+    import shutil
+    import tempfile
+
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import resume_simulation, run_simulation
+
+    if shutil.which("cc") is None:
+        print("managed smoke: skipped (no C toolchain for the shim)",
+              file=sys.stderr)
+        return 0
+    with tempfile.TemporaryDirectory() as td:
+        # Shared fleet generator + binary builder (bench's
+        # managed-1k/10k rungs use them too): per-server echo budgets
+        # and explicit server IPs stay correct at ANY n_procs.
+        from shadow_tpu.core.config import CheckpointConfig
+        from shadow_tpu.tools.netgen import (compile_echo_binaries,
+                                             managed_fleet_yaml)
+        bins = compile_echo_binaries(td)
+        text = managed_fleet_yaml(bins["udp_echo_server"],
+                                  bins["udp_echo_client"], n_procs,
+                                  stop_time="20s", seed=7)
+
+        def cfg(sub):
+            config = ConfigOptions.from_yaml_text(text)
+            config.general.data_directory = os.path.join(td, sub)
+            # Boundary mid-activity: clients start at 2s, pings take
+            # ~20 ms RTT each, so 2030 ms lands inside the exchange.
+            config.checkpoint = CheckpointConfig(
+                at_ns=[2_030_000_000],
+                directory=os.path.join(td, "snaps"))
+            return config
+
+        m, s = run_simulation(cfg("straight"))
+        snap = getattr(m, "ckpt_last_path", None)
+        if not s.ok or snap is None:
+            print(f"managed smoke: straight run failed "
+                  f"(ok={s.ok}, snapshot={snap}, "
+                  f"{s.plugin_errors[:3]})", file=sys.stderr)
+            return 1
+        if info(snap) != 0 or verify(snap) != 0:
+            return 1
+        m2, s2 = resume_simulation(cfg("resumed"), snap)
+        if not s2.ok:
+            print(f"managed smoke: restart-resume failed the final-"
+                  f"state gate: {s2.plugin_errors[:3]}",
+                  file=sys.stderr)
+            return 1
+        m3, s3 = resume_simulation(cfg("resumed2"), snap)
+        if not s3.ok or m2.trace_lines() != m3.trace_lines():
+            print("managed smoke: two resumes of the same archive "
+                  "diverged", file=sys.stderr)
+            return 1
+        restarted = sum(
+            1 for h in m2.hosts for p in h.processes.values()
+            if p.exited and p.exit_code == 0)
+    print(f"managed smoke: ok ({n_procs} real binaries, snapshot "
+          f"mid-activity, restart-resume passed the final-state gate "
+          f"with {restarted} clean exits, resume-vs-resume "
+          f"byte-identical)")
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -276,7 +349,14 @@ def main(argv=None) -> int:
                          "exit nonzero unless artifacts byte-match")
     ap.add_argument("--hosts", type=int, default=50,
                     help="host count for --smoke (default 50)")
+    ap.add_argument("--smoke-managed", type=int, metavar="N",
+                    help="run the managed-fleet restart smoke with N "
+                         "real binaries (the ./setup managed target)")
     args = ap.parse_args(argv)
+    if args.smoke_managed:
+        from shadow_tpu.utils.platform import honor_platform_env
+        honor_platform_env()
+        return smoke_managed(args.smoke_managed)
     if args.smoke:
         from shadow_tpu.utils.platform import honor_platform_env
         honor_platform_env()

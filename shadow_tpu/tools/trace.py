@@ -590,15 +590,22 @@ _EXPLAIN = {
     "per-round:callback-host": (
         "a host can fire Python callbacks mid-event (Python-owned "
         "sockets), which excludes the whole sim from C++ spans.",),
+    "engine-span:managed-quiescent": (
+        "the syscall service plane's quiescence gate served these "
+        "rounds inside engine spans while every managed process sat "
+        "parked — this is span COVERAGE, not a blocker.",),
 }
 
 
-def _managed_blockers(data_dir: str, sc_bytes: bytes, out) -> None:
+def _managed_blockers(data_dir: str, sc_bytes: bytes, out,
+                      elig: dict | None = None,
+                      rounds: int = 0) -> None:
     """Join the eligibility audit with the syscall channel: when
     managed processes keep rounds off the span path (their hosts carry
-    Python-side work every round they run), name the offending
-    host/process and its LAST blocking syscall — the wake-up the
-    batching work of ROADMAP item 2 must amortize."""
+    Python-side work every round they run), print the quiescence
+    fraction (rounds the service plane's gate DID route into spans),
+    the top blocking syscalls preventing further span coverage, and
+    each host's last blocking syscall."""
     from shadow_tpu.host.syscalls_native import syscall_name
     from shadow_tpu.trace.events import SC_PARKED, iter_sc_records
 
@@ -616,16 +623,31 @@ def _managed_blockers(data_dir: str, sc_bytes: bytes, out) -> None:
                 managed_hosts.add(name)
     if not managed_hosts:
         return
+    if elig and rounds:
+        # Quiescence fraction: rounds the service plane's gate turned
+        # into engine-span coverage while every managed process sat
+        # parked (the EL_SVC_QUIESCENT attribution).
+        q = elig.get("engine-span:managed-quiescent", 0)
+        print(f"  managed quiescence: {q}/{rounds} rounds "
+              f"({100.0 * q / rounds:.1f}%) served inside engine "
+              f"spans while the managed fleet was parked", file=out)
     if not sc_bytes:
         print(f"  managed hosts present ({len(managed_hosts)}): run "
               f"with experimental.syscall_observatory: on to see each "
               f"host's last blocking syscall here.", file=out)
         return
     last_park: dict = {}  # host_id -> (t, pid, tid, sysno)
+    park_by_sysno: dict = {}  # sysno -> park count
     for rec in iter_sc_records(sc_bytes):
         t0, _t1, host, pid, tid, sysno, _rc, disp, _aux = rec
         if disp == SC_PARKED and sysno >= 0:
             last_park[host] = (t0, pid, tid, sysno)
+            park_by_sysno[sysno] = park_by_sysno.get(sysno, 0) + 1
+    if park_by_sysno:
+        top = sorted(park_by_sysno.items(), key=lambda kv: -kv[1])[:5]
+        print("  top blocking syscalls preventing span coverage: "
+              + ", ".join(f"{syscall_name(n)} ({c} parks)"
+                          for n, c in top), file=out)
     print(f"  managed hosts holding rounds on the Python path "
           f"({len(managed_hosts)}):", file=out)
     shown = 0
@@ -709,10 +731,14 @@ def explain_report(data_dir: str, out=None) -> bool:
         print(f"      {text}", file=out)
         if not managed_shown and name in (
                 "object-path:other", "object-path:py-task",
-                "per-round:callback-host", "per-round:scheduler"):
+                "per-round:callback-host", "per-round:scheduler",
+                "engine-span:py-limit",
+                "engine-span:managed-quiescent"):
             # These are the reasons managed processes cause: join the
-            # audit with the syscall channel and name the offenders.
-            _managed_blockers(data_dir, sc_bytes, out)
+            # audit with the syscall channel, print the quiescence
+            # fraction and name the offenders.
+            _managed_blockers(data_dir, sc_bytes, out, elig=elig,
+                              rounds=rounds)
             managed_shown = True
         if name == "per-round:outbox" and fab_bytes:
             # Rounds stalled on outbox pressure: name the hottest

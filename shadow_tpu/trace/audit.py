@@ -14,7 +14,8 @@ from __future__ import annotations
 
 from shadow_tpu.trace.events import (EL_DEVICE_SHARDED, EL_DEVICE_SPAN,
                                      EL_ENGINE_EXCHANGE, EL_ENGINE_SPAN,
-                                     EL_ENGINE_UNSHARDED, EL_N, EL_NAMES)
+                                     EL_ENGINE_UNSHARDED, EL_N, EL_NAMES,
+                                     EL_SVC_QUIESCENT)
 
 
 class EligibilityAudit:
@@ -39,7 +40,8 @@ class EligibilityAudit:
         return (self.device_rounds()
                 + sum(self.counts[EL_ENGINE_SPAN:EL_ENGINE_SPAN + 8])
                 + self.counts[EL_ENGINE_EXCHANGE]
-                + self.counts[EL_ENGINE_UNSHARDED])
+                + self.counts[EL_ENGINE_UNSHARDED]
+                + self.counts[EL_SVC_QUIESCENT])
 
 
 def render_report(counts: dict, total_rounds: int) -> str:

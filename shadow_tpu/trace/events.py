@@ -77,7 +77,12 @@ EL_OBJ_OTHER = 18         # object-path host due now: other config
 EL_DEVICE_SHARDED = 19    # stepped inside a sharded device span
 EL_ENGINE_EXCHANGE = 20   # C++ span: sharded exchange over capacity
 EL_ENGINE_UNSHARDED = 21  # C++ span: host axis % tpu_shards != 0
-EL_N = 22
+# Syscall service plane (ISSUE 13): rounds served inside a C++ span
+# while every managed process sat parked on a condition with no
+# expiry inside the window — the quiescence gate turned the managed
+# hosts' park state into span coverage instead of per-round servicing.
+EL_SVC_QUIESCENT = 22     # C++ span: managed hosts quiescent
+EL_N = 23
 
 # Order must mirror the EL_* values above AND the C++ EL_NAMES table
 # (pass 1 checks both directions).
@@ -104,6 +109,7 @@ EL_NAMES = (
     "device-span:sharded",
     "engine-span:exchange-capacity",
     "engine-span:shard-unaligned",
+    "engine-span:managed-quiescent",
 )
 assert len(EL_NAMES) == EL_N
 assert len(FAM_NAMES) == FAM_TCP + 1

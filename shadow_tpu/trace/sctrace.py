@@ -188,9 +188,14 @@ class SyscallObservatory:
     """Bundle: mode, the opt-in channels, per-host wall profiles, and
     the metrics/artifact writers the manager calls."""
 
-    def __init__(self, mode: str, hosts):
+    def __init__(self, mode: str, hosts, death_poll_ns: int = 0):
         assert mode in ("wall", "on")
         self.mode = mode
+        # Effective waitpid safety-net poll slice (the
+        # experimental.managed_death_poll knob) — reported in
+        # metrics.wall.ipc so the configured value is visible next to
+        # the waits it bounds.
+        self.death_poll_ns = death_poll_ns
         self.channel = SyscallChannel() if mode == "on" else None
         self.active: set[HostScWall] = set()
         for h in hosts:
@@ -265,6 +270,7 @@ class SyscallObservatory:
         return {"round_trips": trips, "wait_ns": wait,
                 "dispatch_ns": dispatch, "resume_ns": resume,
                 "app_dispatches": app_n, "app_dispatch_ns": app_ns,
+                "death_poll_ns": self.death_poll_ns,
                 "memcopy": self.memcopy_delta(), "families": fams}
 
     def ingest_metrics(self, reg) -> None:

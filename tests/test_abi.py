@@ -63,6 +63,8 @@ def test_layout_constants_match():
     assert shim_abi.OFF_SELF_PATH == d["IPC_OFF_SELF_PATH"]
     assert shim_abi.OFF_FORK_PATH == d["IPC_OFF_FORK_PATH"]
     assert shim_abi.OFF_PRELOAD == d["IPC_OFF_PRELOAD"]
+    assert shim_abi.OFF_SVC == d["IPC_OFF_SVC_FLAGS"]
+    assert shim_abi.SVC_ACTIVE == d["SHIM_SVC_ACTIVE"]
     assert shim_abi.PATH_MAX == d["IPC_PATH_MAX"]
 
 

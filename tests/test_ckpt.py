@@ -216,10 +216,11 @@ def test_sharded_resume_identity(tmp_path):
         assert a[rel] == b[rel], f"{rel} diverged across shard counts"
 
 
-def test_managed_process_config_rejected(tmp_path):
-    """Managed (real-binary) processes are outside the checkpoint
-    domain: the snapshot must refuse with a clear error, not write a
-    partial archive."""
+def test_managed_fork_child_rejected(tmp_path):
+    """Managed processes snapshot under restart semantics (ISSUE 13,
+    ckpt/managed.py), but a LIVE fork child has no restart identity —
+    the parent's rerun would duplicate it — so the snapshot must
+    refuse with a clear error, not write a partial archive."""
     from shadow_tpu.ckpt.format import CkptError
     from shadow_tpu.ckpt.snapshot import write_snapshot
     from shadow_tpu.core.config import ConfigOptions
@@ -232,15 +233,17 @@ def test_managed_process_config_rejected(tmp_path):
             {"path": "/bin/true", "expected_final_state": "any"}]}},
     })
     manager = Manager(cfg)
-    # Force the spawn so a ManagedProcess exists (no run needed).
+    # Shape of a live fork child: a ManagedProcess with no spawn_tag
+    # (SpawnTask stamps config-spawned processes; _do_fork does not).
     from shadow_tpu.host.managed import ManagedProcess
 
     class _Fake(ManagedProcess):
         def __init__(self, host):
             host.processes[9999] = self
-            self.name = "fake"
+            self.name = "fake.f"
+            self.exited = False
     _Fake(manager.hosts[0])
-    with pytest.raises(CkptError, match="managed"):
+    with pytest.raises(CkptError, match="fork"):
         write_snapshot(manager, SimSummary(), 0,
                        str(tmp_path / "x.stck"))
 

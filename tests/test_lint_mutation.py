@@ -326,6 +326,18 @@ def test_sc_ipc_layout_drift_is_caught(shim_text):
         [x.render() for x in v]
 
 
+def test_svc_flags_offset_drift_is_caught(shim_text):
+    """Moving the v8 svc_flags header word without updating the
+    manager's mmap offset (shim_abi.OFF_SVC) would make the service-
+    plane advertisement write into header padding — the layout twin
+    must flag (ISSUE 13)."""
+    mutated = _mutate(shim_text, "SC_SVC_FLAGS_OFF = 528,",
+                      "SC_SVC_FLAGS_OFF = 532,")
+    v = twin_constants.check(ROOT, shim_text=mutated)
+    assert any("SC_SVC_FLAGS_OFF" in x.message for x in v), \
+        [x.render() for x in v]
+
+
 def test_unregistered_sc_constant_fails_closed(shim_text):
     """A new SC_* member added shim-side without a contract row (and
     a trace/events.py twin) must fail the pass."""

@@ -1,0 +1,275 @@
+"""Syscall service plane (ISSUE 13, shadow_tpu/svc/): scheduler
+byte-identity at parallelism 8 with the plane on AND off, the
+quiescence gate's span coverage on a mixed managed+engine sim, the
+managed-checkpoint restart-resume gates, and the fault-schedule
+fork-safety refusals.
+
+The byte-identity gate is the load-bearing one: the service plane
+executes managed hosts concurrently even under scheduler=serial, so
+`syscalls-sim.bin` (host-serial dispatch order) and `flight-sim.bin`
+must be byte-identical across serial / thread_per_core / tpu AND
+across service-plane on/off — the per-host event order argument of
+svc/plane.py, made checkable."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLUGIN_DIR = os.path.join(REPO_ROOT, "tests", "plugins")
+
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C toolchain for the shim")
+
+
+@pytest.fixture(scope="module")
+def sleep_bin(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("plugins") / "sleep_time")
+    subprocess.run(["cc", "-O1", "-o", out,
+                    os.path.join(PLUGIN_DIR, "sleep_time.c")],
+                   check=True)
+    return out
+
+
+def _managed_cfg(sleep_bin, datadir, scheduler, svc, n_hosts=8,
+                 parallelism=8):
+    from shadow_tpu.core.config import ConfigOptions
+    hosts = {
+        f"h{i:02d}": {"network_node_id": 0, "processes": [
+            {"path": sleep_bin, "start_time": f"{1 + i % 3}s"}]}
+        for i in range(n_hosts)}
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "6s", "seed": 21,
+                    "data_directory": str(datadir)},
+        "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "10 ms" ] ]"""}},
+        "experimental": {"scheduler": scheduler,
+                         "native_dataplane": "off",
+                         "flight_recorder": "on",
+                         "syscall_observatory": "on",
+                         "syscall_service_plane": svc},
+        "hosts": hosts})
+    cfg.general.parallelism = parallelism
+    return cfg
+
+
+def test_service_plane_byte_identity_parallelism_8(sleep_bin, tmp_path):
+    """syscalls-sim.bin AND flight-sim.bin byte-identical across the
+    three schedulers at parallelism 8, service plane on and off (the
+    prior managed gates stop at parallelism 4 and predate the
+    plane)."""
+    from shadow_tpu.core.manager import run_simulation
+
+    def run(name, scheduler, svc):
+        d = tmp_path / name
+        _m, s = run_simulation(
+            _managed_cfg(sleep_bin, d, scheduler, svc),
+            write_data=True)
+        assert s.ok, s.plugin_errors[:3]
+        return ((d / "syscalls-sim.bin").read_bytes(),
+                (d / "flight-sim.bin").read_bytes())
+
+    ref = run("ser-off", "serial", "off")
+    assert ref[0] and ref[1], "empty channels recorded"
+    for name, scheduler, svc in (("ser-on", "serial", "on"),
+                                 ("tpc-on", "thread_per_core", "on"),
+                                 ("tpc-off", "thread_per_core", "off"),
+                                 ("tpu-on", "tpu", "on")):
+        got = run(name, scheduler, svc)
+        assert got[0] == ref[0], f"syscalls-sim.bin diverged on {name}"
+        assert got[1] == ref[1], f"flight-sim.bin diverged on {name}"
+
+
+def test_quiescence_gate_spans_mixed_sim(sleep_bin, tmp_path):
+    """A managed host parked on a no-expiry-in-window condition must
+    not hold engine traffic off the span path: the quiescence gate
+    routes those rounds into C++ spans under the
+    engine-span:managed-quiescent reason, the audit still sums to
+    rounds, and the trace stays byte-identical to the serial
+    scheduler's."""
+    from shadow_tpu.native import plane as native_plane
+    if not native_plane.native_available():
+        pytest.skip("netplane engine unavailable")
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools.netgen import phold_yaml
+
+    def cfg(scheduler):
+        text = phold_yaml(6, stop_time="3500ms", seed=17,
+                          scheduler=scheduler)
+        text += (f"  mgd00:\n    network_node_id: 0\n    processes:\n"
+                 f"      - {{ path: {sleep_bin}, start_time: 500ms }}\n")
+        return ConfigOptions.from_yaml_text(text)
+
+    m, s = run_simulation(cfg("tpu"))
+    assert s.ok, s.plugin_errors[:3]
+    counts = m.audit.as_dict()
+    assert m.audit.total() == s.rounds, counts
+    assert counts.get("engine-span:managed-quiescent", 0) > 0, counts
+    assert s.span_rounds > 0
+    m2, s2 = run_simulation(cfg("serial"))
+    assert s2.ok
+    assert m.trace_lines() == m2.trace_lines()
+
+
+def test_managed_ckpt_restart_resume(tmp_path):
+    """Managed-fleet snapshot -> restart-resume under final-state
+    gating (the lifted refusal), with resume-vs-resume byte identity
+    (the only byte contract managed resumes carry)."""
+    from shadow_tpu.ckpt.format import read_meta
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import (resume_simulation,
+                                         run_simulation)
+    bins = {}
+    for name in ("udp_echo_server", "udp_echo_client"):
+        out = str(tmp_path / name)
+        subprocess.run(["cc", "-O1", "-o", out,
+                        os.path.join(PLUGIN_DIR, name + ".c")],
+                       check=True)
+        bins[name] = out
+
+    def cfg(sub):
+        blocks = [f"""
+  srv0:
+    network_node_id: 0
+    processes:
+      - path: {bins['udp_echo_server']}
+        args: "9000 9"
+        start_time: 1s"""]
+        for i in range(3):
+            blocks.append(f"""
+  cli{i}:
+    network_node_id: 0
+    processes:
+      - path: {bins['udp_echo_client']}
+        args: "11.0.0.4 9000 3 64"
+        start_time: 2s""")
+        return ConfigOptions.from_yaml_text(f"""
+general:
+  stop_time: 20s
+  seed: 5
+  data_directory: {tmp_path / sub}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" ] ]
+checkpoint:
+  at: ["2030 ms"]
+  directory: {tmp_path / 'snaps'}
+hosts:{''.join(blocks)}
+""")
+
+    m, s = run_simulation(cfg("straight"))
+    assert s.ok, s.plugin_errors[:3]
+    snap = m.ckpt_last_path
+    assert read_meta(snap)["managed"] == 4  # all 4 were live
+    m2, s2 = resume_simulation(cfg("resumed"), snap)
+    assert s2.ok, s2.plugin_errors[:3]
+    procs = [p for h in m2.hosts for p in h.processes.values()]
+    assert len(procs) == 4
+    assert all(p.exited and p.exit_code == 0 for p in procs)
+    m3, s3 = resume_simulation(cfg("resumed2"), snap)
+    assert s3.ok
+    assert m2.trace_lines() == m3.trace_lines()
+
+
+def _fault_cfg(tmp_path, faults=""):
+    from shadow_tpu.core.config import ConfigOptions
+    return ConfigOptions.from_yaml_text(f"""
+general: {{ stop_time: 4s, seed: 3 }}
+network:
+  graph: {{ type: 1_gbit_switch }}
+hosts:
+  a: {{ network_node_id: 0 }}
+  b: {{ network_node_id: 0 }}{faults}
+""")
+
+
+def test_fork_faults_allowed_and_refused(tmp_path):
+    """`tools/ckpt fork` fork-safety for `faults:` schedules (ROADMAP
+    item 5): variants whose new ops land strictly after the boundary
+    pass; ops at/before the boundary and applied-prefix rewrites are
+    refused with their own messages."""
+    from shadow_tpu.ckpt.fork import (_check_fault_fork,
+                                      check_fork_compatible)
+    from shadow_tpu.ckpt.format import CkptError
+
+    base = _fault_cfg(tmp_path, """
+faults:
+  - { at: 1s, action: link_down, host: a }""")
+    variant = _fault_cfg(tmp_path, """
+faults:
+  - { at: 1s, action: link_down, host: a }
+  - { at: 3s, action: link_up, host: a }""")
+    # Config-level gate: fault diffs are allowlisted.
+    assert any(p.startswith("faults")
+               for p in check_fork_compatible(base, variant))
+    meta = {"faults_applied": 1, "next_start_ns": 2_000_000_000}
+    _check_fault_fork(base, variant, meta)  # ok: new op after boundary
+
+    early = _fault_cfg(tmp_path, """
+faults:
+  - { at: 1s, action: link_down, host: a }
+  - { at: 1500ms, action: link_up, host: a }""")
+    with pytest.raises(CkptError, match="at or before the fork "
+                                        "boundary"):
+        _check_fault_fork(base, early, meta)
+
+    rewritten = _fault_cfg(tmp_path, """
+faults:
+  - { at: 1s, action: link_down, host: b }
+  - { at: 3s, action: link_up, host: b }""")
+    with pytest.raises(CkptError, match="already applied"):
+        _check_fault_fork(base, rewritten, meta)
+
+    dropped = _fault_cfg(tmp_path)
+    with pytest.raises(CkptError, match="applied prefix"):
+        _check_fault_fork(base, dropped,
+                          {"faults_applied": 1,
+                           "next_start_ns": 2_000_000_000})
+
+    # Non-fault diffs still refuse exactly as before.
+    other = _fault_cfg(tmp_path)
+    other.general.seed = 99
+    with pytest.raises(CkptError, match="outside the fork-safe"):
+        check_fork_compatible(base, other)
+
+
+def test_death_poll_knob_and_svc_config():
+    """experimental.managed_death_poll / syscall_service_plane parse,
+    validate and surface (the death-poll slice reaches Host and the
+    metrics.wall.ipc block)."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import Manager
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "1s"},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"managed_death_poll": "500 ms",
+                         "syscall_observatory": "wall",
+                         "syscall_service_plane": "off"},
+        "hosts": {"h0": {"network_node_id": 0}}})
+    assert cfg.experimental.managed_death_poll_ns == 500_000_000
+    m = Manager(cfg)
+    assert m.hosts[0].death_poll_ns == 500_000_000
+    assert m.sctrace.wall_summary()["death_poll_ns"] == 500_000_000
+    assert m.svc is None  # knob off
+    d = cfg.to_processed_dict()
+    assert d["experimental"]["syscall_service_plane"] == "off"
+    with pytest.raises(ValueError, match="managed_death_poll"):
+        ConfigOptions.from_dict({
+            "general": {"stop_time": "1s"},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "experimental": {"managed_death_poll": "10 us"},
+            "hosts": {"h0": {"network_node_id": 0}}})
+    with pytest.raises(ValueError, match="syscall_service_plane"):
+        ConfigOptions.from_dict({
+            "general": {"stop_time": "1s"},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "experimental": {"syscall_service_plane": "maybe"},
+            "hosts": {"h0": {"network_node_id": 0}}})
