@@ -955,6 +955,128 @@ def managed_rung() -> dict | None:
         }
 
 
+def chaos_managed_rung() -> dict | None:
+    """`bench[chaos-managed-128]` (docs/ROBUSTNESS.md): a managed-128
+    fleet with an INJECTED mid-run segfault and a hung binary, run
+    under `on_failure: quarantine` with the hang watchdog armed.  The
+    rung REFUSES to record unless (a) the run completes end to end
+    with no sim abort and no plugin error, (b) drop-cause
+    conservation is exact, and (c) re-running with the recorded fault
+    ledger supplied as a `faults:` schedule is byte-identical (packet
+    trace, drop attribution, syscall dispositions, ledger)."""
+    import shutil
+    import subprocess
+    import tempfile
+    if shutil.which("cc") is None:
+        print("bench[chaos-managed-128]: skipped (no C toolchain)",
+              file=sys.stderr)
+        return None
+    plug_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tests", "plugins")
+    with tempfile.TemporaryDirectory() as td:
+        from shadow_tpu.tools.netgen import compile_echo_binaries
+        bins = compile_echo_binaries(td)
+        chaos_bins = {}
+        for name in ("crash_mid", "hang_forever"):
+            out = os.path.join(td, name)
+            subprocess.run(
+                ["cc", "-O1", "-o", out,
+                 os.path.join(plug_dir, name + ".c")], check=True)
+            chaos_bins[name] = out
+        from shadow_tpu.core.config import (FaultConfig, HostConfig,
+                                            ProcessConfig)
+        from shadow_tpu.core.manager import run_simulation
+
+        def chaos_cfg(faults=None):
+            cfg = _managed_fleet_config(bins, 128, stop_time="20s")
+            cfg.experimental.scheduler = "thread_per_core"
+            cfg.experimental.native_dataplane = "on"
+            cfg.experimental.managed_watchdog_ns = 2_000_000_000
+            # Dedicated chaos hosts (the echo fleet's own servers
+            # count exact echo budgets, so killing a fleet member
+            # would strand an innocent peer into a plugin error) plus
+            # a background internal-app pinger pair that keeps round
+            # boundaries alive well past the failure instants — a
+            # quarantine needs a next boundary to land on.
+            cfg.hosts["zbg0"] = HostConfig(
+                name="zbg0", network_node_id=0, processes=[
+                    ProcessConfig(path="udp-echo-server",
+                                  args=["9100"],
+                                  start_time_ns=1_000_000_000,
+                                  expected_final_state="running")])
+            cfg.hosts["zbg1"] = HostConfig(
+                name="zbg1", network_node_id=0, processes=[
+                    ProcessConfig(path="udp-pinger",
+                                  args=["zbg0", "9100", "600"],
+                                  start_time_ns=2_000_000_000,
+                                  expected_final_state="exited 0")])
+            for i, binary in ((0, "crash_mid"), (1, "hang_forever")):
+                # Each chaos host also streams pings so its death
+                # leaves in-flight traffic to drop host-down.
+                cfg.hosts[f"zchaos{i}"] = HostConfig(
+                    name=f"zchaos{i}", network_node_id=0, processes=[
+                        ProcessConfig(path="udp-pinger",
+                                      args=["zbg0", "9100", "600"],
+                                      start_time_ns=2_000_000_000,
+                                      expected_final_state="any"),
+                        ProcessConfig(path=chaos_bins[binary],
+                                      start_time_ns=5_000_000_000,
+                                      expected_final_state="exited 0",
+                                      on_failure="quarantine")])
+            if faults:
+                cfg.faults = [
+                    FaultConfig(at_ns=int(op["at"].split()[0]),
+                                action="quarantine", host=op["host"])
+                    for op in faults]
+            return cfg
+
+        t0 = time.perf_counter()
+        m1, s1 = run_simulation(chaos_cfg())
+        wall = time.perf_counter() - t0
+        led1 = m1.containment.ledger()
+        drops1 = m1.drop_cause_totals()
+        conserved = ("unattributed" not in drops1
+                     and sum(drops1.values()) == s1.packets_dropped)
+        causes = sorted(e["cause"] for e in led1["events"])
+        if not s1.ok or not conserved or len(led1["ops"]) != 2 \
+                or drops1.get("host-down", 0) < 1 \
+                or causes != ["binary-death", "hang-watchdog"]:
+            print(f"bench[chaos-managed-128]: REFUSED to record "
+                  f"(ok={s1.ok}, conserved={conserved}, "
+                  f"ops={led1['ops']}, causes={causes})",
+                  file=sys.stderr)
+            return {"outcome": "refused", "ok": False}
+        m2, s2 = run_simulation(chaos_cfg(faults=led1["ops"]))
+        led2 = m2.containment.ledger()
+        identical = (m1.trace_lines() == m2.trace_lines()
+                     and drops1 == m2.drop_cause_totals()
+                     and m1.sc_disposition_totals()
+                     == m2.sc_disposition_totals()
+                     and led1["ops"] == led2["ops"])
+        if not identical or not s2.ok:
+            print("bench[chaos-managed-128]: REFUSED to record "
+                  "(ledger replay NOT byte-identical)",
+                  file=sys.stderr)
+            return {"outcome": "replay-divergence", "ok": False}
+        frag = {
+            "outcome": "ok",
+            "ok": True,
+            "processes": sum(len(h.processes) for h in m1.hosts),
+            "quarantines": len(led1["ops"]),
+            "causes": causes,
+            "drop_causes": drops1,
+            "sim_s_per_wall_s": round(s1.busy_end_ns / 1e9 / wall, 3),
+            "wall_s": round(wall, 1),
+            "ledger_replay": "byte-identical",
+        }
+        print(f"bench[chaos-managed-128]: crash+hang contained "
+              f"({causes}), {frag['quarantines']} quarantines, "
+              f"drop conservation exact, ledger replay "
+              f"byte-identical, {frag['sim_s_per_wall_s']} "
+              f"sim-s/wall-s ({wall:.1f}s wall)", file=sys.stderr)
+        return frag
+
+
 def _managed_fleet_config(bins, n_procs: int, seed: int = 3,
                           stop_time: str = "30s"):
     """N-process managed-fleet config (the managed-1k/10k rungs;
@@ -1621,6 +1743,20 @@ def main() -> None:
     managed_10k = managed_scale_rung(10_000, "managed-10k",
                                      record_outcome=True)
 
+    # Chaos rung (docs/ROBUSTNESS.md): injected crash+hang during a
+    # managed run — refuses to record unless the ledger replay is
+    # byte-identical and drop-cause conservation is exact.  A refusal
+    # fails the bench exit code like the standing managed rungs.
+    try:
+        chaos_128 = chaos_managed_rung()
+        if chaos_128 is not None and not chaos_128.get("ok"):
+            managed_failed = True
+    except Exception as e:  # noqa: BLE001 — never cost the headline
+        print(f"bench[chaos-managed-128]: failed: {e}",
+              file=sys.stderr)
+        chaos_128 = None
+        managed_failed = True
+
     # The event-driven loop stops touching hosts once events drain; the
     # metric credits only the span that actually ran rounds (an idle
     # tail up to stop_time is free for every scheduler).
@@ -1684,6 +1820,7 @@ def main() -> None:
         # honestly.
         "managed_1k": managed_1k,
         "managed_10k": managed_10k,
+        "chaos_managed_128": chaos_128,
         # Flight-recorder wall channel of the last recorded tpu trial:
         # where a dispatch's wall goes (export/convert/compile/execute/
         # import/barrier/host-loop/engine-span, seconds) and the

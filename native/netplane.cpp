@@ -183,7 +183,8 @@ constexpr int FLIGHT_REC_BYTES = 32;
  * pass 1. */
 enum { FR_ROUND = 0, FR_SPAN_START, FR_SPAN_COMMIT, FR_SPAN_ABORT,
        FR_FAULT_KILL, FR_FAULT_RESTORE, FR_FAULT_LINK_DOWN,
-       FR_FAULT_LINK_UP, FR_FAULT_BLACKHOLE, FR_FAULT_CLEAR, FR_N };
+       FR_FAULT_LINK_UP, FR_FAULT_BLACKHOLE, FR_FAULT_CLEAR,
+       FR_FAULT_QUARANTINE, FR_N };
 
 /* Checkpoint plane-blob framing (shadow_tpu/ckpt/format.py is the
  * Python twin; analysis pass 1 registers every CK_* constant
@@ -6198,7 +6199,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   }
   std::vector<uint8_t> m_state(H), m_wakep(H), s_state(H), s_wakep(H),
       s_exited(H), m_exited(H), m_partdone(H), s_partdone(H),
-      sock_closed(H);
+      sock_closed(H), h_fault(H);
   std::vector<int64_t> m_exit_time(H);
   std::vector<uint32_t> m_waitmask(H), s_waitmask(H), m_lcg(H),
       m_target(H), s_target(H);
@@ -6322,6 +6323,12 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
     m_partdone[h] = m.part_done ? 1 : 0;
     s_partdone[h] = s.part_done ? 1 : 0;
     sock_closed[h] = (u->status & S_CLOSED) ? 1 : 0;
+    /* Down-host fault mask (docs/ROBUSTNESS.md): bit0 down, bit1
+     * link_down, bit2 blackhole — constant within a span (faults
+     * apply only at round boundaries, which cap span `limit`). */
+    h_fault[h] = (uint8_t)((hp->down ? 1 : 0) |
+                           (hp->link_down ? 2 : 0) |
+                           (hp->blackhole ? 4 : 0));
     m_wakep[h] = m.wake_pending ? 1 : 0;
     m_waitmask[h] = m.wait_mask;
     m_waitseq[h] = m.wait_seq;
@@ -6449,6 +6456,7 @@ static PyObject *eng_span_export_phold(EngineObj *self, PyObject *args) {
   put("m_partdone", bytes_vec(m_partdone));
   put("s_partdone", bytes_vec(s_partdone));
   put("sock_closed", bytes_vec(sock_closed));
+  put("h_fault", bytes_vec(h_fault));
   {
     std::vector<uint8_t> fam(1, (uint8_t)sh.family);
     std::vector<int64_t> ps(1, sh.pay_size);
@@ -6606,6 +6614,11 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
   const uint8_t *s_partdone = col<uint8_t>(d, "s_partdone", H, &ok);
   const uint8_t *sock_closed = col<uint8_t>(d, "sock_closed", H, &ok);
   const uint8_t *out_first = col<uint8_t>(d, "out_first", H, &ok);
+  /* h_fault is read-only in the kernel (faults flip only at round
+   * boundaries, through set_host_fault) — consumed for the 4-side
+   * schema check, never applied back. */
+  const uint8_t *h_fault = col<uint8_t>(d, "h_fault", H, &ok);
+  (void)h_fault;
   const int64_t *app_sys = col<int64_t>(d, "app_sys", H * ASYS_N, &ok);
   const int64_t *pkts_sent = col<int64_t>(d, "pkts_sent", H, &ok);
   const int64_t *pkts_recv = col<int64_t>(d, "pkts_recv", H, &ok);
@@ -6835,7 +6848,9 @@ static PyObject *eng_span_import_phold(EngineObj *self, PyObject *args) {
                                     "no-route",
                                     "inet-loss",
                                     "unreachable",
-                                    "udp-connected-filter"};
+                                    "udp-connected-filter",
+                                    "host-down",
+                                    "link-down"};
     PyObject *tn = PyDict_GetItemString(traces, "n");
     if (tn == nullptr) {
       PyErr_SetString(PyExc_ValueError, "span import: traces missing n");
@@ -7038,6 +7053,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   /* ---- host-major ---- */
   std::vector<int64_t> now(H), event_seq(H), packet_seq(H);
   std::vector<uint32_t> eth_ip(H);
+  std::vector<uint8_t> h_fault(H);
   std::vector<int64_t> bw_up(H), bw_down(H);
   std::vector<int32_t> cq_len(H), ib_len(H), th_len(H);
   TPkCols cq, ib, r1pk, r2pk;
@@ -7092,6 +7108,11 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
       cq.pad((h + 1) * (size_t)CQ);
     }
     codel_bytes[h] = hp->codel.bytes;
+    /* Down-host fault mask (docs/ROBUSTNESS.md): bit0 down, bit1
+     * link_down, bit2 blackhole — constant within a span. */
+    h_fault[h] = (uint8_t)((hp->down ? 1 : 0) |
+                           (hp->link_down ? 2 : 0) |
+                           (hp->blackhole ? 4 : 0));
     codel_dropping[h] = hp->codel.dropping ? 1 : 0;
     codel_count[h] = hp->codel.count;
     codel_last_count[h] = hp->codel.last_count;
@@ -7331,6 +7352,7 @@ static PyObject *eng_span_export_tcp(EngineObj *self, PyObject *args) {
   put_tpk(d, "cq", cq, &ok);
   put("cq_enq", bytes_vec(cq_enq));
   put("codel_bytes", bytes_vec(codel_bytes));
+  put("h_fault", bytes_vec(h_fault));
   put("codel_dropping", bytes_vec(codel_dropping));
   put("codel_count", bytes_vec(codel_count));
   put("codel_last_count", bytes_vec(codel_last_count));
@@ -7500,6 +7522,11 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
   const int64_t *codel_bytes = col<int64_t>(d, "codel_bytes", H, &ok);
   const uint8_t *codel_dropping =
       col<uint8_t>(d, "codel_dropping", H, &ok);
+  /* h_fault is read-only in the kernel (faults flip only at round
+   * boundaries, through set_host_fault) — consumed for the 4-side
+   * schema check, never applied back. */
+  const uint8_t *h_fault = col<uint8_t>(d, "h_fault", H, &ok);
+  (void)h_fault;
   const int64_t *codel_count = col<int64_t>(d, "codel_count", H, &ok);
   const int64_t *codel_last_count =
       col<int64_t>(d, "codel_last_count", H, &ok);
@@ -7868,7 +7895,9 @@ static PyObject *eng_span_import_tcp(EngineObj *self, PyObject *args) {
                                     "no-route",
                                     "inet-loss",
                                     "unreachable",
-                                    "udp-connected-filter"};
+                                    "udp-connected-filter",
+                                    "host-down",
+                                    "link-down"};
     PyObject *tn = PyDict_GetItemString(traces, "n");
     if (tn == nullptr) {
       PyErr_SetString(PyExc_ValueError, "span import: traces missing n");

@@ -161,6 +161,7 @@ CONTRACTS = [
     ("FR_FAULT_LINK_UP", [(_TREV, "FR_FAULT_LINK_UP")]),
     ("FR_FAULT_BLACKHOLE", [(_TREV, "FR_FAULT_BLACKHOLE")]),
     ("FR_FAULT_CLEAR", [(_TREV, "FR_FAULT_CLEAR")]),
+    ("FR_FAULT_QUARANTINE", [(_TREV, "FR_FAULT_QUARANTINE")]),
     ("FR_N", [(_TREV, "FR_N")]),
     # device-eligibility reason codes (one per conservative round)
     ("EL_DEVICE_SPAN", [(_TREV, "EL_DEVICE_SPAN")]),
@@ -212,8 +213,15 @@ CONTRACTS = [
     ("TEL_RECVBUF_FULL", [(_TREV, "TEL_RECVBUF_FULL"),
                           (_PHLD, "TEL_RECVBUF_FULL")]),
     ("TEL_BUCKET_DEFER", [(_TREV, "TEL_BUCKET_DEFER")]),
-    ("TEL_HOST_DOWN", [(_TREV, "TEL_HOST_DOWN")]),
-    ("TEL_LINK_DOWN", [(_TREV, "TEL_LINK_DOWN")]),
+    # Down-host fault masks (docs/ROBUSTNESS.md): both device-span
+    # kernels attribute fault drops to these causes, so slot drift
+    # would silently mis-attribute device-span fault rounds.
+    ("TEL_HOST_DOWN", [(_TREV, "TEL_HOST_DOWN"),
+                       (_TCPS, "TEL_HOST_DOWN"),
+                       (_PHLD, "TEL_HOST_DOWN")]),
+    ("TEL_LINK_DOWN", [(_TREV, "TEL_LINK_DOWN"),
+                       (_TCPS, "TEL_LINK_DOWN"),
+                       (_PHLD, "TEL_LINK_DOWN")]),
     ("TEL_REASM_FULL", [(_TREV, "TEL_REASM_FULL"),
                         (_TCPS, "TEL_REASM_FULL")]),
     ("TEL_RECVWIN_TRUNC", [(_TREV, "TEL_RECVWIN_TRUNC"),
@@ -297,12 +305,16 @@ REASON_CONTRACTS = [
     (_TCPS, "RSN_RTRLIMIT", "rtr-limit"),
     (_TCPS, "RSN_LOSS", "inet-loss"),
     (_TCPS, "RSN_UNREACH", "unreachable"),
+    (_TCPS, "RSN_HOSTDOWN", "host-down"),
+    (_TCPS, "RSN_LINKDOWN", "link-down"),
     (_PHLD, "RSN_NONE", ""),
     (_PHLD, "RSN_RCVBUF", "rcvbuf-full"),
     (_PHLD, "RSN_NOSOCK", "no-socket"),
     (_PHLD, "RSN_NOROUTE", "no-route"),
     (_PHLD, "RSN_LOSS", "inet-loss"),
     (_PHLD, "RSN_UNREACH", "unreachable"),
+    (_PHLD, "RSN_HOSTDOWN", "host-down"),
+    (_PHLD, "RSN_LINKDOWN", "link-down"),
 ]
 
 # Python constants derived from several C++ constants

@@ -36,6 +36,11 @@ _DIGEST_SKIP_EXPERIMENTAL = (
     # identity holds on and off — tests/test_svc.py) and the waitpid
     # safety-net poll slice, which never reaches simulation bytes.
     "syscall_service_plane", "managed_death_poll",
+    # Failure-containment wall knobs (docs/ROBUSTNESS.md): the hang
+    # watchdog and the spawn stagger shape WALL behavior only — a
+    # contained failure's sim-side effects are pinned by the fault
+    # ledger, never by these.
+    "managed_watchdog", "managed_spawn_stagger",
 )
 
 
@@ -130,6 +135,11 @@ def _rewire(manager, h, fresh, appmap: dict) -> None:
     h.death_poll_ns = fresh.death_poll_ns
     h.svc_managed = fresh.svc_managed
     h.py_pinned = fresh.py_pinned
+    # Failure containment (docs/ROBUSTNESS.md): the plane and the
+    # wall-only spawn stagger are manager-owned / wall-side — the
+    # RESUMING config's values govern.
+    h.containment = getattr(fresh, "containment", None)
+    h.spawn_stagger_ns = getattr(fresh, "spawn_stagger_ns", 0)
     h.svc_active = getattr(fresh, "svc_active", False)
     h.data_path = fresh.data_path
     h.strace_mode = getattr(fresh, "strace_mode", None)

@@ -29,6 +29,9 @@ SCHEDULERS = ("thread_per_core", "thread_per_host", "serial", "tpu")
 QDISC_MODES = ("fifo", "round_robin")
 
 
+ON_FAILURE_POLICIES = ("abort", "quarantine", "restart")
+
+
 @dataclass
 class ProcessConfig:
     path: str
@@ -38,6 +41,20 @@ class ProcessConfig:
     shutdown_time_ns: int | None = None
     shutdown_signal: str = "SIGTERM"
     expected_final_state: Any = "exited 0"
+    # Failure containment policy (docs/ROBUSTNESS.md): what the sim
+    # does when this process fails against its expected final state —
+    # unexpected binary death, a hang past the wall watchdog, or a
+    # spawn failure after the bounded EAGAIN/ENOMEM retries.
+    #   abort       keep today's semantics: record a plugin error (the
+    #               run completes but summary.ok is False).
+    #   quarantine  contain the failure: the host is killed (host_kill
+    #               machinery, FR_FAULT_QUARANTINE attribution) at the
+    #               next conservative-round boundary and the action is
+    #               appended to the fault ledger.
+    #   restart     re-spawn the binary at the failure instant, up to
+    #               restart_budget times; exhaustion quarantines.
+    on_failure: str = "abort"
+    restart_budget: int = 2
 
 
 @dataclass
@@ -99,7 +116,7 @@ class CheckpointConfig:
 
 
 FAULT_ACTIONS = ("host_kill", "host_restore", "link_down", "link_up",
-                 "nic_blackhole", "nic_clear")
+                 "nic_blackhole", "nic_clear", "quarantine")
 
 
 @dataclass
@@ -249,6 +266,23 @@ class ExperimentalConfig:
     # Wall-side only (never reaches simulation bytes); the effective
     # value is surfaced in metrics.wall.ipc.death_poll_ns.
     managed_death_poll_ns: int = 2_000_000_000
+    # Wall-time hang watchdog for managed processes
+    # (docs/ROBUSTNESS.md): a managed thread that produces no IPC
+    # event for this much WALL time while its native process is still
+    # alive (e.g. spinning in userspace without syscalls) is treated
+    # as hung — the native process is SIGKILLed and the process's
+    # on_failure containment policy engages at the deterministic sim
+    # instant the host was servicing.  0 disables (the default: a
+    # parked-on-condition process is NOT hung, and the watchdog only
+    # guards the raw IPC recv).  Wall-only, digest-skipped.
+    managed_watchdog_ns: int = 0
+    # Spawn-storm taming (ROADMAP item 2): minimum WALL-time gap
+    # between successive managed posix_spawns.  A 10k-binary fleet
+    # spawning in one round thrashes the kernel (fork+LD_BIND_NOW
+    # relocation storms); staggering trades a little wall latency for
+    # a stable spawn rate.  0 disables.  Wall-only, digest-skipped —
+    # simulation bytes are identical at any stagger.
+    managed_spawn_stagger_ns: int = 0
     # Max conservative rounds a C++ engine span may buffer between
     # pcap drains when engine-side capture is active (was hard-coded;
     # per-round streams must not buffer a whole sim).  The effective
@@ -360,6 +394,8 @@ class ConfigOptions:
                 "syscall_observatory": e.syscall_observatory,
                 "syscall_service_plane": e.syscall_service_plane,
                 "managed_death_poll": _ns(e.managed_death_poll_ns),
+                "managed_watchdog": _ns(e.managed_watchdog_ns),
+                "managed_spawn_stagger": _ns(e.managed_spawn_stagger_ns),
                 "pcap_span_cap": e.pcap_span_cap,
                 "dctcp_k_pkts": e.dctcp_k_pkts,
                 "dctcp_k_bytes": e.dctcp_k_bytes,
@@ -394,6 +430,8 @@ class ConfigOptions:
                     "shutdown_time": _ns(p.shutdown_time_ns),
                     "shutdown_signal": p.shutdown_signal,
                     "expected_final_state": p.expected_final_state,
+                    "on_failure": p.on_failure,
+                    "restart_budget": p.restart_budget,
                 })
             out["hosts"][name] = {
                 "network_node_id": h.network_node_id,
@@ -537,6 +575,10 @@ class ConfigOptions:
                  else str(v)),
                 ("managed_death_poll", "managed_death_poll_ns",
                  units.parse_time_ns),
+                ("managed_watchdog", "managed_watchdog_ns",
+                 units.parse_time_ns),
+                ("managed_spawn_stagger", "managed_spawn_stagger_ns",
+                 units.parse_time_ns),
                 ("pcap_span_cap", "pcap_span_cap", int),
                 ("dctcp_k_pkts", "dctcp_k_pkts", int),
                 ("dctcp_k_bytes", "dctcp_k_bytes", units.parse_bytes),
@@ -583,6 +625,14 @@ class ConfigOptions:
             raise ValueError(
                 "managed_death_poll must be >= 1ms (it is the waitpid "
                 "safety-net poll slice, not a latency knob)")
+        if experimental.managed_watchdog_ns < 0 or \
+                0 < experimental.managed_watchdog_ns < 100_000_000:
+            raise ValueError(
+                "managed_watchdog must be 0 (off) or >= 100ms — a "
+                "shorter wall watchdog would kill healthy processes "
+                "mid-compute")
+        if experimental.managed_spawn_stagger_ns < 0:
+            raise ValueError("managed_spawn_stagger must be >= 0")
         if experimental.pcap_span_cap < 1:
             raise ValueError("pcap_span_cap must be >= 1")
         if experimental.dctcp_k_pkts < 1:
@@ -658,6 +708,17 @@ class ConfigOptions:
                 args = p.get("args", [])
                 if isinstance(args, str):
                     args = shlex.split(args)
+                on_failure = str(p.get("on_failure", "abort"))
+                if on_failure not in ON_FAILURE_POLICIES:
+                    raise ValueError(
+                        f"hosts.{name}.processes[{len(procs)}]: unknown "
+                        f"on_failure {on_failure!r}; expected one of "
+                        f"{ON_FAILURE_POLICIES}")
+                restart_budget = int(p.get("restart_budget", 2))
+                if restart_budget < 1:
+                    raise ValueError(
+                        f"hosts.{name}.processes[{len(procs)}]: "
+                        f"restart_budget must be >= 1")
                 procs.append(ProcessConfig(
                     path=str(_require(p, "path", f"hosts.{name}.processes")),
                     args=[str(a) for a in args],
@@ -670,6 +731,8 @@ class ConfigOptions:
                     expected_final_state=_validate_final_state(
                         p.get("expected_final_state", "exited 0"),
                         f"hosts.{name}.processes[{len(procs)}]"),
+                    on_failure=on_failure,
+                    restart_budget=restart_budget,
                 ))
             bw_down = h.get("bandwidth_down")
             bw_up = h.get("bandwidth_up")

@@ -144,6 +144,12 @@ class SpawnTask:
                 work_dir=h.data_path)
             process.strace_mode = strace_mode
             process.spawn_tag = self.index
+            # Failure-containment policy (docs/ROBUSTNESS.md): the
+            # pcfg rides along so a `restart` policy can re-run this
+            # very SpawnTask at the failure instant.
+            process.on_failure = pcfg.on_failure
+            process.restart_budget = pcfg.restart_budget
+            process._pcfg = pcfg
             process.start_native(h, pcfg.path)
             return
         if factory is None:
@@ -296,15 +302,12 @@ class Manager:
         # applied (restored by ckpt resume so a resumed run re-applies
         # only the remainder).
         self._faults_applied = 0
-        if config.faults and config.experimental.tpu_shards > 1:
-            # The sharded mesh propagator has no fault choke points
-            # (its exchange kernel would silently ignore link_down),
-            # so a schedule there would break the cross-scheduler
-            # determinism contract instead of erroring.
-            raise ValueError(
-                "faults: schedules are not supported with "
-                "tpu_shards > 1 (the sharded exchange carries no "
-                "fault mask; docs/CHECKPOINT.md)")
+        # tpu_shards > 1 fault refusal LIFTED (docs/ROBUSTNESS.md):
+        # the mesh propagator's send carries the link_down egress twin,
+        # arrivals drop at their path-independent instants via the
+        # inbox-pop checks on every plane, and both device-span
+        # kernels thread the per-host fault mask (h_fault) through
+        # their 4-side-checked codecs.
 
         # Loss thresholds as an integer matrix: one float->int conversion
         # at build time, shared verbatim by scalar and batched backends.
@@ -346,6 +349,37 @@ class Manager:
                 managed_hosts.append(host)
             else:
                 host.svc_managed = False
+        # ---- failure containment plane (svc/containment.py,
+        # docs/ROBUSTNESS.md) ----------------------------------------
+        # Built whenever managed processes are configured: it owns the
+        # hang watchdog, the per-process on_failure policies' pending
+        # quarantines, and the fault ledger.  Resource preflight runs
+        # first — a fleet that cannot fit the fd table or /dev/shm
+        # must fail (or warn, under an all-quarantine fleet) before
+        # the first spawn, naming the exact limit to raise.
+        self.containment = None
+        if managed_hosts:
+            from shadow_tpu.svc.containment import (ContainmentPlane,
+                                                    preflight_managed)
+            # The ONE managed-process predicate is the SpawnTask
+            # dispatch rule applied above to flag managed_hosts;
+            # collect the matching pcfgs once so preflight sizing and
+            # the warn-only gate cannot drift from what spawns.
+            managed_pcfgs = [
+                pcfg for host in managed_hosts
+                for pcfg in config.hosts[host.name].processes
+                if "/" in pcfg.path
+                and app_registry.lookup(pcfg.path) is None]
+            preflight_managed(
+                len(managed_pcfgs),
+                warn_only=all(p.on_failure == "quarantine"
+                              for p in managed_pcfgs))
+            self.containment = ContainmentPlane(
+                watchdog_ns=config.experimental.managed_watchdog_ns)
+            for host in managed_hosts:
+                host.containment = self.containment
+                host.spawn_stagger_ns = \
+                    config.experimental.managed_spawn_stagger_ns
         svc_mode = config.experimental.syscall_service_plane
         # parallelism 0 = auto (num cores), matching the schedulers.
         svc_workers = config.general.parallelism or os.cpu_count() or 1
@@ -935,12 +969,12 @@ class Manager:
             dev_aborts_row = int(live.get("dev_aborts_row",
                                           dev_aborts_row))
             ckpts_done = list(live.get("ckpts_done", []))
-        if self.config.faults:
-            # Fault schedules disable device-resident spans: the SoA
-            # kernels carry no down-host mask, and the C++ engine +
-            # object path implement the (byte-identical) semantics.
-            dev_span_on = False
-            dev_off_reason = trev.EL_ENGINE_FAMILY
+        # Fault schedules KEEP device-resident spans (docs/
+        # ROBUSTNESS.md): both SoA kernels carry the per-host fault
+        # mask (h_fault, 4-side-checked through the span codecs) with
+        # run_until-twin drop semantics, faults apply only at round
+        # boundaries (which cap span `limit`), and set_host_fault
+        # bumps state_epoch so resident state re-exports the flags.
         boundary_ops: list = []
         ck_cfg = self.config.checkpoint
         ck_dir = None
@@ -992,6 +1026,16 @@ class Manager:
         while start is not None and start < stop:
             if boundary_ops and start >= boundary_ops[0][0]:
                 start = apply_boundary_ops(start)
+            if self.containment is not None \
+                    and self.containment.has_pending:
+                # Containment quarantines apply at the SAME choke
+                # point as scheduled faults — the round boundary —
+                # after any due scheduled ops, so a ledger replay's
+                # `faults:` quarantine (applied above) dedups the
+                # containment trigger and the flight bytes agree
+                # (docs/ROBUSTNESS.md).
+                for hid, _cause in self.containment.take_pending():
+                    self._apply_quarantine(hid, start, fr_sim)
             round_reason = per_round_static
             if span_ok:
                 if getattr(self.propagator, "_outbox", None):
@@ -1348,6 +1392,12 @@ class Manager:
                     nxt = inflight_min
                 start = nxt
         summary.end_time_ns = min(start, stop) if start is not None else stop
+        if self.containment is not None:
+            # The round loop is over: end-of-run forced teardown of
+            # still-running binaries must not read as failures, and a
+            # quarantine still pending here has no round boundary left
+            # to land on (its process is already marked contained).
+            self.containment.active = False
         if status is not None:
             status.finish(summary.end_time_ns)
 
@@ -1365,6 +1415,11 @@ class Manager:
                 # the configured outcome, not a plugin error).
                 continue
             for proc in h.processes.values():
+                if getattr(proc, "contained", None):
+                    # The failure was contained (quarantine applied /
+                    # restart consumed it) — the fault ledger is the
+                    # record, not a plugin error (docs/ROBUSTNESS.md).
+                    continue
                 if not proc.matches_expected_final_state():
                     state = (f"exited {proc.exit_code}" if proc.exited
                              else "running")
@@ -1721,8 +1776,22 @@ class Manager:
             "link_up": trev.FR_FAULT_LINK_UP,
             "nic_blackhole": trev.FR_FAULT_BLACKHOLE,
             "nic_clear": trev.FR_FAULT_CLEAR,
+            "quarantine": trev.FR_FAULT_QUARANTINE,
         }[f.action]
-        if f.action == "host_kill":
+        if f.action == "quarantine":
+            # host_kill semantics with containment attribution.
+            # IDEMPOTENT: a replayed ledger op landing at the same
+            # boundary as the (re-triggered) containment quarantine
+            # applies exactly once — whichever fires first records,
+            # the other is a silent no-op, so flight/ledger bytes
+            # agree between the original and the replay
+            # (docs/ROBUSTNESS.md).
+            if host.down:
+                return
+            host.down = True
+            if self.containment is not None:
+                self.containment.record_op(at, host.name)
+        elif f.action == "host_kill":
             host.down = True
         elif f.action == "link_down":
             host.link_down = True
@@ -1747,6 +1816,18 @@ class Manager:
         from shadow_tpu.utils.shadow_log import LOG
         LOG.info(f"fault applied: {f.action} {f.host} at sim "
                  f"{at / 1e9:.6f}s")
+
+    def _apply_quarantine(self, hid: int, at: int, fr_sim) -> None:
+        """Apply one containment-triggered quarantine at round
+        boundary `at` through the SAME choke point a replayed
+        `faults:` quarantine takes (_apply_fault: host_kill machinery,
+        FR_FAULT_QUARANTINE, ledger record_op, idempotent on an
+        already-down host) — one implementation, so the ledger-replay
+        byte-identity contract cannot drift between the two paths."""
+        from shadow_tpu.core.config import FaultConfig
+        self._apply_fault(
+            FaultConfig(at_ns=at, action="quarantine",
+                        host=self.hosts[hid].name), at, fr_sim)
 
     def _log_heartbeat(self, sim_now: int, stop: int, wall_start: float,
                        out) -> None:
@@ -1943,6 +2024,18 @@ class Manager:
         if self.sctrace is not None:
             self.sctrace.ingest_metrics(reg)
             self.sctrace.write(base)
+        # Fault ledger (svc/containment.py, docs/ROBUSTNESS.md): the
+        # containment plane's record of every containment action.
+        # `ops` is a ready-to-paste `faults:` schedule (the replay
+        # contract); `events` carries causes.  Deterministic content —
+        # sim-time stamps and canonical sort only.
+        if self.containment is not None:
+            ledger = self.containment.ledger()
+            with open(os.path.join(base, "fault-ledger.json"),
+                      "w") as f:
+                json.dump(ledger, f, indent=1, sort_keys=True)
+            reg.gauge("containment.quarantines", channel="sim").set(
+                len(ledger["ops"]))
         # One reason code per conservative round (trace/audit.py);
         # tools/trace renders this as the attribution report.
         reg.ingest("eligibility", self.audit.as_dict(), channel="wall")

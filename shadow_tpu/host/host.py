@@ -61,6 +61,11 @@ class Host:
     # `tools/ckpt fork` can sweep it from one warm archive).
     dctcp_k_pkts = 20
     dctcp_k_bytes = 30_000
+    # Failure containment plane (svc/containment.py): set by the
+    # manager on hosts carrying managed processes; None everywhere
+    # else.  The spawn stagger is its wall-only companion knob.
+    containment = None
+    spawn_stagger_ns = 0
 
     def __init__(self, host_id: int, name: str, ip: int, node_index: int,
                  seed: int, bw_down_bits: int, bw_up_bits: int,
@@ -616,7 +621,11 @@ class Host:
                   "sc_wall", "sc_log",
                   # run-local output path: snapshots must not embed the
                   # data directory (identical sims -> identical bytes)
-                  "data_path")
+                  "data_path",
+                  # failure-containment plane + wall-only spawn knob:
+                  # manager-owned / wall-side — restore rewires from
+                  # the RESUMING config (docs/ROBUSTNESS.md)
+                  "containment", "spawn_stagger_ns")
 
     def __getstate__(self):
         d = dict(self.__dict__)
@@ -651,6 +660,8 @@ class Host:
         self.sc_wall = None
         self.sc_log = None
         self.data_path = None
+        self.containment = None
+        self.spawn_stagger_ns = 0
         if relay_state is not None:
             self._build_relays()
             for relay, state in zip((self.relay_loopback,

@@ -371,12 +371,27 @@ class ManagedProcess(Process):
         file_actions.append((os.POSIX_SPAWN_DUP2,
                              self._xfer_child_end.fileno(), XFER_FD))
         argv = list(argv) if argv else [resolved]
-        try:
-            pid = os.posix_spawn(resolved, argv, env,
-                                 file_actions=file_actions)
-        except OSError:
-            ipc.close()
-            raise
+        # Spawn-storm taming (docs/ROBUSTNESS.md): wall-only stagger
+        # between successive managed spawns, then bounded retry on
+        # transient kernel pressure — EAGAIN (fork budget) and ENOMEM
+        # ride a short backoff before the containment policy engages.
+        from shadow_tpu.svc.containment import (SPAWN_BACKOFF_S,
+                                                SPAWN_GATE,
+                                                SPAWN_RETRIES)
+        import errno as _errno
+        SPAWN_GATE.wait(getattr(host, "spawn_stagger_ns", 0))
+        for attempt in range(SPAWN_RETRIES + 1):
+            try:
+                pid = os.posix_spawn(resolved, argv, env,
+                                     file_actions=file_actions)
+                break
+            except OSError as e:
+                if e.errno in (_errno.EAGAIN, _errno.ENOMEM) \
+                        and attempt < SPAWN_RETRIES:
+                    _walltime.sleep(SPAWN_BACKOFF_S * (1 << attempt))  # shadow-lint: allow[wall-clock] bounded posix_spawn retry backoff
+                    continue
+                ipc.close()
+                raise
         # Commit: replace identity state only after the spawn succeeded.
         # The cached pidfd (native-fd SCM_RIGHTS pulls) refers to the
         # OLD native process — drop it or every post-exec pull fails.
@@ -400,19 +415,29 @@ class ManagedProcess(Process):
         self.threads.append(thread)
         return thread
 
+    def _spawn_failed(self, host, why: str) -> None:
+        """Spawn failure (missing/static binary, posix_spawn error
+        after the bounded retries): a plugin error under `abort`, a
+        contained quarantine under `quarantine`/`restart` (a spawn
+        that would not start cannot be healed by restarting —
+        docs/ROBUSTNESS.md)."""
+        from shadow_tpu.svc.containment import CAUSE_SPAWN
+        self.stderr += f"[shadow-tpu] {why}\n".encode()
+        self.exited = True
+        self.exit_code = 127
+        cont = getattr(host, "containment", None)
+        if cont is not None and not self.matches_expected_final_state():
+            cont.process_failed(host, self, CAUSE_SPAWN, why)
+
     def start_native(self, host, exe_path: str | None = None) -> None:
         exe = exe_path or (self.argv[0] if self.argv else None)
         resolved = shutil.which(exe) if exe and "/" not in exe else exe
         if not resolved or not os.path.exists(resolved):
-            self.stderr += f"[shadow-tpu] no such binary: {exe!r}\n".encode()
-            self.exited = True
-            self.exit_code = 127
+            self._spawn_failed(host, f"no such binary: {exe!r}")
             return
         if _elf_missing_interp(resolved):
-            self.stderr += (f"[shadow-tpu] '{resolved}' is not a "
-                            f"dynamically linked ELF\n").encode()
-            self.exited = True
-            self.exit_code = 127
+            self._spawn_failed(host, f"'{resolved}' is not a "
+                                     f"dynamically linked ELF")
             return
         os.makedirs(self.work_dir, exist_ok=True)
         self._stdout_path = os.path.join(self.work_dir,
@@ -424,11 +449,9 @@ class ManagedProcess(Process):
                                        self.env, truncate_output=True)
         except (RuntimeError, OSError, ValueError) as e:
             # No toolchain / build / spawn failure / oversized preload:
-            # a plugin error, not a sim crash (the run completes and
-            # reports it).
-            self.stderr += f"[shadow-tpu] {e}\n".encode()
-            self.exited = True
-            self.exit_code = 127
+            # a plugin error (or a contained one), not a sim crash —
+            # the run completes and reports it.
+            self._spawn_failed(host, str(e))
             return
         thread.resume(host)
 
@@ -640,15 +663,41 @@ class ManagedThread:
     # -- channel helpers ----------------------------------------------
 
     def _recv(self, host):
-        """Next shim event, or None if the child died."""
+        """Next shim event, or None if the child died.
+
+        Hang watchdog (docs/ROBUSTNESS.md): with
+        `experimental.managed_watchdog` set, a thread that produces no
+        IPC event for that much WALL time while its native process is
+        alive (userspace spin, a DO_NATIVE syscall that never returns)
+        is killed; the death then resolves through the normal path at
+        the DETERMINISTIC sim instant this host was servicing, and the
+        process's on_failure policy engages."""
         sw = host.sc_wall
         t0 = sw.now() if sw is not None else 0
+        cont = getattr(host, "containment", None)
+        wd_ns = cont.watchdog_ns if cont is not None else 0
+        wd_deadline = (_walltime.monotonic() + wd_ns / 1e9  # shadow-lint: allow[wall-clock] hang-watchdog deadline (wall-only knob)
+                       if wd_ns > 0 else None)
         try:
             while True:
+                slice_ns = getattr(host, "death_poll_ns",
+                                   _DEATH_POLL_NS)
+                if wd_deadline is not None:
+                    left = wd_deadline - _walltime.monotonic()  # shadow-lint: allow[wall-clock] hang-watchdog deadline (wall-only knob)
+                    if left <= 0 and not self._poll_death(host):
+                        # Hung: kill the native process; the next
+                        # iteration resolves the death (channel close
+                        # or waitpid) and _finish engages containment.
+                        wd_deadline = None
+                        if cont is not None:
+                            cont.hang_kill(host, self)
+                        continue
+                    if left > 0:
+                        slice_ns = min(slice_ns,
+                                       max(int(left * 1e9), 1_000_000))
                 try:
                     ev = self.chan.recv_from_shim(
-                        timeout_ns=getattr(host, "death_poll_ns",
-                                           _DEATH_POLL_NS))
+                        timeout_ns=slice_ns)
                     # Native-I/O latency the shim accrued since its last
                     # event; flows into the standard unapplied-CPU model.
                     ns = self.chan.take_unapplied_ns()
@@ -1525,6 +1574,19 @@ class ManagedThread:
             process.mem.close()
         process.collect_output()
         process.thread_exited(host, self, code)
+        # Failure containment (docs/ROBUSTNESS.md): an UNEXPECTED
+        # death — the process's recorded final state fails its
+        # expectation — engages the per-process on_failure policy at
+        # this deterministic sim instant.  Expected exits (and the
+        # `abort` policy) change nothing.
+        cont = getattr(host, "containment", None)
+        if cont is not None and process.exited \
+                and not process.matches_expected_final_state():
+            from shadow_tpu.svc.containment import CAUSE_DEATH
+            state = (f"signaled {process.term_signal}"
+                     if process.term_signal is not None
+                     else f"exited {process.exit_code}")
+            cont.process_failed(host, process, CAUSE_DEATH, state)
 
     def teardown(self) -> None:
         """Close the whole process's IPC block (idempotent)."""

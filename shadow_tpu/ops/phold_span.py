@@ -76,6 +76,8 @@ RSN_NOSOCK = 4
 RSN_NOROUTE = 5
 RSN_LOSS = 6
 RSN_UNREACH = 7
+RSN_HOSTDOWN = 9
+RSN_LINKDOWN = 10
 
 # Sim-netstat drop-cause slots touched by this kernel (netplane.cpp
 # TEL_* twins; the per-host (H, TEL_N) `drop_causes` column round-
@@ -88,6 +90,8 @@ TEL_UNREACHABLE = 3
 TEL_NO_ROUTE = 4
 TEL_NO_SOCKET = 5
 TEL_RECVBUF_FULL = 9
+TEL_HOST_DOWN = 11
+TEL_LINK_DOWN = 12
 TEL_N = 15
 
 # Fabric-observatory activity mask (netplane.cpp FB_ACT_* twins;
@@ -160,7 +164,7 @@ RESIDENT_CARRIED = frozenset(
      "s_senti", "s_state", "s_target", "s_waitmask", "s_waitseq",
      "s_wakep", "send_bytes", "sock_closed", "sq_len", "sq_pos",
      "status", "th_kind", "th_seq", "th_tgt", "th_time",
-     "th_valid"}
+     "th_valid", "h_fault"}
     | {f"{p}_{kk}" for p in ('rq', 'sq', 'cq', 'ib', 'r1_pk', 'r2_pk')
        for kk in PK_KEYS})
 
@@ -282,6 +286,11 @@ class PholdSpanRunner(SpanMeshMixin):
                   "s_exited", "m_exited", "m_partdone", "s_partdone",
                   "sock_closed"):
             st[k] = f(k, np.uint8).astype(np.int32)
+        # Down-host fault mask (docs/ROBUSTNESS.md): bit0 down, bit1
+        # link_down, bit2 blackhole.  Constant within a span (faults
+        # apply only at round boundaries, which cap span `limit`);
+        # CARRIED so resident reuse keeps the engine's live flags.
+        st["h_fault"] = f("h_fault", np.uint8).astype(np.int32)
         st["m_exit_time"] = f("m_exit_time", np.int64)
         st["out_first"] = np.zeros(H, np.int32)
         st["cd_chain"] = np.zeros(H, np.int32)
@@ -407,7 +416,7 @@ class PholdSpanRunner(SpanMeshMixin):
         for k in ("queued", "m_state", "m_wakep", "s_state", "s_wakep",
                   "s_exited", "codel_dropping", "m_exited",
                   "m_partdone", "s_partdone", "sock_closed",
-                  "out_first"):
+                  "out_first", "h_fault"):
             out[k] = npv(k).astype(np.uint8).tobytes()
         out["m_exit_time"] = npv("m_exit_time").astype(
             np.int64).tobytes()
@@ -805,6 +814,19 @@ class PholdSpanRunner(SpanMeshMixin):
                 dst = st["_ips_perm"][dslot]
                 st["app_pkts_sent"] = jnp.where(
                     fwd, st["app_pkts_sent"] + 1, st["app_pkts_sent"])
+                # NIC link down (device_push twin): the send dies at
+                # the egress instant, BEFORE the event-seq draw — the
+                # same position as the no-route drop.
+                linkdn = fwd & ((st["h_fault"] & 2) != 0)
+                st["app_pkts_dropped"] = jnp.where(
+                    linkdn, st["app_pkts_dropped"] + 1,
+                    st["app_pkts_dropped"])
+                st["drop_causes"] = st["drop_causes"].at[
+                    mrows(linkdn), TEL_LINK_DOWN].add(1, mode="drop")
+                st = tr_append(st, linkdn, now, TR_DRP, pk,
+                               RSN_LINKDOWN)
+                st = dict(st)
+                fwd = fwd & ~linkdn
                 miss = fwd & ~found
                 st["app_pkts_dropped"] = jnp.where(
                     miss, st["app_pkts_dropped"] + 1,
@@ -1186,11 +1208,36 @@ class PholdSpanRunner(SpanMeshMixin):
             st["events_run"] = jnp.where(due, st["events_run"] + 1,
                                          st["events_run"])
 
+            # Down-host fault mask (docs/ROBUSTNESS.md; run_until
+            # twin): arrivals at a dead/link-down/blackholed host die
+            # at their recorded (path-independent) arrival instant —
+            # never touching the CoDel ledger; a dead host's timers
+            # discard silently.  The mask is constant within a span.
+            h_down = (st["h_fault"] & 1) != 0
+            nic_dead = st["h_fault"] != 0
+
             # arrival: inbox -> codel -> relay 2.  At the engine's
             # hard limit CoDelN::push refuses and the arrival drops
             # with an rtr-limit breadcrumb (run_until twin).
             arr = due & pick_ib
             st["ib_pos"] = jnp.where(arr, pos + 1, pos)
+            pk_arr = {kk: st[f"ib_{kk}"][hidx, safe] for kk in PK_KEYS}
+            arr_f = arr & nic_dead
+            st["app_pkts_dropped"] = jnp.where(
+                arr_f, st["app_pkts_dropped"] + 1,
+                st["app_pkts_dropped"])
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(arr_f & h_down), TEL_HOST_DOWN].add(
+                1, mode="drop")
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(arr_f & ~h_down), TEL_LINK_DOWN].add(
+                1, mode="drop")
+            st = tr_append(st, arr_f & h_down, et, TR_DRP, pk_arr,
+                           RSN_HOSTDOWN)
+            st = tr_append(st, arr_f & ~h_down, et, TR_DRP, pk_arr,
+                           RSN_LINKDOWN)
+            st = dict(st)
+            arr = arr & ~nic_dead
             st["codel_enq_pkts"] = jnp.where(
                 arr, st["codel_enq_pkts"] + 1, st["codel_enq_pkts"])
             st["codel_enq_bytes"] = jnp.where(
@@ -1214,7 +1261,6 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["app_pkts_dropped"])
             st["drop_causes"] = st["drop_causes"].at[
                 mrows(limit_full), TEL_RTR_LIMIT].add(1, mode="drop")
-            pk_arr = {kk: st[f"ib_{kk}"][hidx, safe] for kk in PK_KEYS}
             st = tr_append(st, limit_full, et, TR_DRP, pk_arr, 2)
             st = dict(st)
             arr = arr & ~limit_full
@@ -1246,6 +1292,9 @@ class PholdSpanRunner(SpanMeshMixin):
             tim = due & ~pick_ib
             st["th_valid"] = st["th_valid"].at[mrows(tim), tslot].set(
                 False, mode="drop")
+            # A dead host's timers discard silently (run_until's down
+            # branch: tpop only — no seq draw, no relay/app effects).
+            tim = tim & ~h_down
             is_relay = tim & (tkind == TK_RELAY)
             for r in (1, 2):
                 rw = is_relay & (ttgt == r)

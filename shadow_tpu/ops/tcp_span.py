@@ -105,6 +105,8 @@ RSN_CODEL = 1
 RSN_RTRLIMIT = 2
 RSN_LOSS = 6
 RSN_UNREACH = 7
+RSN_HOSTDOWN = 9
+RSN_LINKDOWN = 10
 
 # Sim-netstat drop-cause slots touched by this kernel (netplane.cpp
 # TEL_* twins; registered in analysis pass 1).  The per-host
@@ -114,6 +116,8 @@ TEL_CODEL = 0
 TEL_RTR_LIMIT = 1
 TEL_LOSS_EDGE = 2
 TEL_UNREACHABLE = 3
+TEL_HOST_DOWN = 11
+TEL_LINK_DOWN = 12
 TEL_REASM_FULL = 13
 TEL_RECVWIN_TRUNC = 14
 TEL_N = 15
@@ -221,7 +225,7 @@ RESIDENT_CARRIED = frozenset(
      "ra_plen", "ra_seq", "ra_valid",
      "rtx_len", "rtx_plen", "rtx_pos", "rtx_rtxed", "rtx_sacked",
      "rtx_sent", "rtx_seq", "th_kind", "th_seq", "th_tgt",
-     "th_time", "th_valid"}
+     "th_time", "th_valid", "h_fault"}
     | {f"{p}_{kk}" for p in ('cq', 'ib', 'op', 'r1_pk', 'r2_pk')
        for kk in PK_KEYS})
 
@@ -364,6 +368,11 @@ class TcpSpanRunner(SpanMeshMixin):
                   "eth_psent", "eth_precv", "eth_bsent", "eth_brecv"):
             st[k] = f(k, np.int64)
         st["eth_ip"] = f("eth_ip", np.uint32)
+        # Down-host fault mask (docs/ROBUSTNESS.md): bit0 down, bit1
+        # link_down, bit2 blackhole.  Constant within a span (faults
+        # apply only at round boundaries, which cap span `limit`);
+        # CARRIED so resident reuse keeps the engine's live flags.
+        st["h_fault"] = f("h_fault", np.uint8).astype(np.int32)
         st["codel_dropping"] = f("codel_dropping", np.uint8).astype(
             np.int32)
         st["cq_len"] = f("cq_len", np.int32)
@@ -535,6 +544,7 @@ class TcpSpanRunner(SpanMeshMixin):
             out[k] = npv(k).astype(np.int64).tobytes()
         out["codel_dropping"] = npv("codel_dropping").astype(
             np.uint8).tobytes()
+        out["h_fault"] = npv("h_fault").astype(np.uint8).tobytes()
         for r in (1, 2):
             out[f"r{r}_pending"] = npv(f"r{r}_pending").astype(
                 np.uint8).tobytes()
@@ -998,6 +1008,19 @@ class TcpSpanRunner(SpanMeshMixin):
             st["r1_fwd_pkts"] = st["r1_fwd_pkts"] + fwd
             st["r1_fwd_bytes"] = st["r1_fwd_bytes"] \
                 + jnp.where(fwd, size, jnp.int64(0))
+            st["pkts_sent"] = jnp.where(fwd, st["pkts_sent"] + 1,
+                                        st["pkts_sent"])
+            # NIC link down (device_push twin): the send dies at the
+            # egress instant, BEFORE the dst lookup and the event-seq
+            # draw (docs/ROBUSTNESS.md).
+            linkdn = fwd & ((st["h_fault"] & 2) != 0)
+            st["pkts_dropped"] = jnp.where(
+                linkdn, st["pkts_dropped"] + 1, st["pkts_dropped"])
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(linkdn), TEL_LINK_DOWN].add(1, mode="drop")
+            st = tr_append(st, linkdn, now, TR_DRP, pk, RSN_LINKDOWN)
+            st = dict(st)
+            fwd = fwd & ~linkdn
             # device_push(dev=2): dst must be a remote engine host
             dslot = jnp.minimum(
                 jnp.searchsorted(st["_ips_sorted"], pk["dip"]), H - 1)
@@ -1006,8 +1029,6 @@ class TcpSpanRunner(SpanMeshMixin):
             bad = fwd & (~found | (dst == hidx))
             st = mark_abort(st, bad.any(), AB_STRUCT, 4)
             st = dict(st)
-            st["pkts_sent"] = jnp.where(fwd, st["pkts_sent"] + 1,
-                                        st["pkts_sent"])
             hit = fwd & found
             st, sq = draw_seq(st, hit)
             cols = {"out_src": hidx, "out_dst": dst, "out_seq": sq,
@@ -1872,12 +1893,34 @@ class TcpSpanRunner(SpanMeshMixin):
             st["now"] = jnp.where(due, et, st["now"])
             st["events_run"] = jnp.where(due, st["events_run"] + 1,
                                          st["events_run"])
+            # Down-host fault mask (docs/ROBUSTNESS.md; run_until
+            # twin): arrivals at a dead/link-down/blackholed host die
+            # at their recorded arrival instant, never touching the
+            # CoDel ledger; a dead host's timers discard silently.
+            h_down = (st["h_fault"] & 1) != 0
+            nic_dead = st["h_fault"] != 0
+
             # arrival: inbox -> codel -> relay 2
             arr = due & pick_ib
             st["ib_pos"] = jnp.where(arr, pos + 1, pos)
             pk_arr = {kk: st[f"ib_{kk}"][hidx, safe]
                       for kk in PK_KEYS}
             size = s_i64(pk_arr["plen"]) + TCP_TOTAL_HDR
+            arr_f = arr & nic_dead
+            st["pkts_dropped"] = jnp.where(
+                arr_f, st["pkts_dropped"] + 1, st["pkts_dropped"])
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(arr_f & h_down), TEL_HOST_DOWN].add(
+                1, mode="drop")
+            st["drop_causes"] = st["drop_causes"].at[
+                mrows(arr_f & ~h_down), TEL_LINK_DOWN].add(
+                1, mode="drop")
+            st = tr_append(st, arr_f & h_down, et, TR_DRP, pk_arr,
+                           RSN_HOSTDOWN)
+            st = tr_append(st, arr_f & ~h_down, et, TR_DRP, pk_arr,
+                           RSN_LINKDOWN)
+            st = dict(st)
+            arr = arr & ~nic_dead
             st["codel_enq_pkts"] = jnp.where(
                 arr, st["codel_enq_pkts"] + 1, st["codel_enq_pkts"])
             st["codel_enq_bytes"] = jnp.where(
@@ -1945,6 +1988,9 @@ class TcpSpanRunner(SpanMeshMixin):
             tim = due & ~pick_ib
             st["th_valid"] = st["th_valid"].at[mrows(tim), tslot].set(
                 False, mode="drop")
+            # A dead host's timers discard silently (run_until's down
+            # branch: tpop only — no relay/TCP/app effects).
+            tim = tim & ~h_down
             is_relay = tim & (tkind == TK_RELAY)
             for r in (1, 2):
                 rw = is_relay & (ttgt == r)

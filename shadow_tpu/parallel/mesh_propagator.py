@@ -145,6 +145,13 @@ class MeshPropagator:
         self.window_end = window_end
 
     def send(self, src_host, packet) -> None:
+        if src_host.link_down:
+            # NIC link down (docs/ROBUSTNESS.md): egress drop before
+            # the event-seq draw — the same position as the scalar /
+            # single-shard / engine twins, so the seq stream (and with
+            # it the packet trace) is shard-layout-independent.
+            src_host.trace_drop(packet, "link-down")
+            return
         dst_id = self.dns.host_id_for_ip(packet.dst_ip)
         if dst_id is None:
             src_host.trace_drop(packet, "no-route")

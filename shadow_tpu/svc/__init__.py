@@ -2,6 +2,7 @@
 plane"): batched, host-affine servicing of managed-process syscalls —
 ROADMAP item 2's engine.  See svc/plane.py."""
 
+from shadow_tpu.svc.containment import ContainmentPlane
 from shadow_tpu.svc.plane import SyscallServicePlane
 
-__all__ = ["SyscallServicePlane"]
+__all__ = ["SyscallServicePlane", "ContainmentPlane"]

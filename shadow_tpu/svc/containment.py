@@ -1,0 +1,258 @@
+"""Failure containment plane (docs/ROBUSTNESS.md).
+
+Shadow's value is that real, unmodified binaries run inside a
+deterministic simulation — but wall-side failures (a segfaulting
+binary, a userspace spin that never syscalls, a posix_spawn that loses
+a race against the kernel's fork budget) are events the simulation
+does not own.  This plane converts each of them into a deterministic,
+attributed SIM-side outcome instead of a crashed or poisoned run:
+
+ - **Triggers** (host/managed.py seams): unexpected binary death
+   (final state mismatch at process exit), the wall-time hang
+   watchdog (`experimental.managed_watchdog`), and spawn failure
+   after the bounded EAGAIN/ENOMEM retries.
+ - **Policy** (per-process `on_failure: abort|quarantine|restart`):
+   `abort` keeps the historical plugin-error semantics; `restart`
+   re-spawns the binary at the failure instant up to
+   `restart_budget` times; `quarantine` — and restart exhaustion —
+   kills the whole host (the PR 8 `host_kill` machinery, host-down
+   drop attribution) at the NEXT conservative-round boundary.
+ - **Ledger**: every containment action is recorded.  The `ops`
+   section (at_ns/action/host) is exactly a `faults:` schedule;
+   re-running with it supplied reproduces the run byte-identically
+   when the underlying failure is deterministic (the honest
+   determinism contract for nondeterministic wall events —
+   docs/ROBUSTNESS.md spells out the limits).
+
+Determinism argument: a failure is DETECTED at a simulated instant
+(the host-serial event being serviced when the manager notices — a
+pure function of the binary's behavior, not of wall time), and every
+containment EFFECT applies either at that instant (restart respawn)
+or at the next round boundary (quarantine), both pure functions of
+sim state.  Wall time decides only *whether* the watchdog fires —
+never *where* its effects land.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _walltime
+
+# Bounded posix_spawn retry on transient kernel pressure
+# (EAGAIN/ENOMEM): wall-side only, engaged before the containment
+# policy.  4 attempts spanning ~150ms of backoff rides out a
+# same-round spawn storm without stalling a genuinely broken host.
+SPAWN_RETRIES = 3
+SPAWN_BACKOFF_S = 0.01  # doubles per attempt: 10/20/40 ms
+
+# Causes (ledger `events` entries; deterministic strings).
+CAUSE_DEATH = "binary-death"
+CAUSE_HANG = "hang-watchdog"
+CAUSE_SPAWN = "spawn-failure"
+CAUSE_BUDGET = "restart-exhausted"
+
+
+class _SpawnGate:
+    """Wall-time spawn stagger (experimental.managed_spawn_stagger):
+    successive managed posix_spawns across the whole run keep at least
+    `stagger_ns` of wall distance, so a 10k-binary fleet spawning in
+    one round becomes a bounded-rate stream instead of a fork storm.
+    Wall-only: simulation bytes are identical at any stagger."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0.0
+
+    def wait(self, stagger_ns: int) -> None:
+        if stagger_ns <= 0:
+            return
+        with self._lock:
+            now = _walltime.monotonic()  # shadow-lint: allow[wall-clock] spawn-stagger pacing (wall-only knob)
+            wait_s = self._next - now
+            self._next = max(self._next, now) + stagger_ns / 1e9
+        if wait_s > 0:
+            _walltime.sleep(wait_s)  # shadow-lint: allow[wall-clock] spawn-stagger pacing (wall-only knob)
+
+
+SPAWN_GATE = _SpawnGate()
+
+
+class ContainmentPlane:
+    """Owned by the Manager when managed (real-binary) processes are
+    configured; hosts reach it via ``host.containment``.  Thread-safe:
+    triggers fire from svc-plane workers and scheduler threads."""
+
+    def __init__(self, watchdog_ns: int = 0):
+        self.watchdog_ns = int(watchdog_ns)
+        self._lock = threading.Lock()
+        # host id -> first cause; applied (and cleared) by the round
+        # loop at the next conservative-round boundary.
+        self._pending: dict[int, str] = {}
+        # (host_id, spawn_tag) -> restarts consumed.
+        self._restarts: dict[tuple, int] = {}
+        # Ledger: `ops` are the replayable quarantine applications
+        # (appended by the manager's apply path, in application
+        # order); `events` are every containment trigger/action with
+        # its cause (appended here, canonically sorted at write).
+        self.ops: list[dict] = []
+        self._events: list[dict] = []
+        # The round loop is live: containment triggers outside it
+        # (end-of-run forced teardown) must not engage.
+        self.active = True
+
+    # -- trigger side (managed.py seams) ------------------------------
+
+    @property
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    def _note(self, at_ns: int, host, process, cause: str,
+              action: str, detail: str) -> None:
+        with self._lock:
+            self._events.append({
+                "at_ns": int(at_ns), "host": host.name,
+                "host_id": host.id, "process": process.name,
+                "cause": cause, "action": action, "detail": detail,
+            })
+
+    def process_failed(self, host, process, cause: str,
+                       detail: str = "") -> bool:
+        """A managed process failed against its expected final state.
+        Returns True when the failure was CONTAINED.  The actual
+        suppression contract is `process.contained` (set here, read
+        by the manager's final accounting) — the return value is
+        informational only."""
+        policy = getattr(process, "on_failure", "abort")
+        if not self.active or policy == "abort":
+            return False
+        if getattr(process, "_hang_killed", False):
+            cause = CAUSE_HANG
+        process.contained = cause
+        at = host.now()
+        tag = getattr(process, "spawn_tag", None)
+        pcfg = getattr(process, "_pcfg", None)
+        if policy == "restart" and cause != CAUSE_SPAWN \
+                and tag is not None and pcfg is not None:
+            key = (host.id, tag)
+            with self._lock:
+                used = self._restarts.get(key, 0)
+                budget_left = used < int(
+                    getattr(process, "restart_budget", 0))
+                if budget_left:
+                    self._restarts[key] = used + 1
+            if budget_left:
+                from shadow_tpu.core.event import TaskRef
+                from shadow_tpu.core.manager import SpawnTask
+                self._note(at, host, process, cause, "restart", detail)
+                host.schedule_task_at(
+                    at, TaskRef("containment-restart",
+                                SpawnTask(pcfg, tag)))
+                return True
+            cause = CAUSE_BUDGET
+            process.contained = cause
+        self._note(at, host, process, cause, "quarantine", detail)
+        with self._lock:
+            self._pending.setdefault(host.id, cause)
+        return True
+
+    def hang_kill(self, host, thread) -> bool:
+        """Watchdog expiry on a managed thread's IPC recv: SIGKILL the
+        native process so the recv resolves through the normal death
+        path (which re-enters process_failed with the hang cause).
+        Returns True when a kill was issued."""
+        import os
+        import signal
+        process = thread.process
+        if not self.active or process.exited or \
+                process.native_pid is None:
+            return False
+        process._hang_killed = True
+        try:
+            os.kill(process.native_pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        return True
+
+    # -- apply side (the manager's round loop) ------------------------
+
+    def take_pending(self) -> list[tuple[int, str]]:
+        """Due quarantines in ascending host-id order (deterministic
+        application order at the boundary)."""
+        with self._lock:
+            out = sorted(self._pending.items())
+            self._pending.clear()
+        return out
+
+    def record_op(self, at_ns: int, host_name: str) -> None:
+        """One APPLIED quarantine (containment-triggered or a replayed
+        `faults:` op) — the replayable ledger section."""
+        with self._lock:
+            self.ops.append({"at": f"{int(at_ns)} ns",
+                             "action": "quarantine",
+                             "host": host_name})
+
+    def ledger(self) -> dict:
+        """The fault-ledger artifact: `ops` in application order
+        (already deterministic), `events` canonically sorted — worker
+        threads may interleave appends across hosts."""
+        with self._lock:
+            events = sorted(self._events,
+                            key=lambda e: (e["at_ns"], e["host_id"],
+                                           e["process"], e["cause"]))
+            return {"ops": list(self.ops), "events": events}
+
+
+def preflight_managed(n_processes: int, warn_only: bool,
+                      log=None) -> None:
+    """Resource preflight for large managed fleets: size the fd table
+    and /dev/shm against the configured fleet BEFORE spawning.  Each
+    managed process costs the manager ~8 fds (IPC block, /proc/pid/mem,
+    transfer socketpair, stdio redirect files, pidfd) and one ~600 KiB
+    /dev/shm IPC block.  Failing fast with the exact limit to raise
+    beats 9k successful spawns followed by EMFILE mid-run.  Under an
+    all-quarantine fleet (warn_only) a breach degrades to containment,
+    so warn instead of refusing."""
+    import os
+    import resource
+    import warnings
+
+    problems = []
+    fds_needed = 8 * n_processes + 256
+    try:
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except (ValueError, OSError):  # pragma: no cover
+        soft = None
+    if soft is not None and soft < fds_needed:
+        problems.append(
+            f"fd table: RLIMIT_NOFILE soft limit is {soft} but "
+            f"{n_processes} managed processes need ~{fds_needed} "
+            f"(raise it: `ulimit -n {fds_needed}` or "
+            f"LimitNOFILE in the service unit)")
+    # IpcBlock is ~a few hundred KiB of shared memory per process;
+    # budget 1 MiB each for headroom.
+    shm_needed = n_processes * (1 << 20)
+    try:
+        st = os.statvfs("/dev/shm")
+        shm_free = st.f_bavail * st.f_frsize
+    except OSError:  # pragma: no cover
+        shm_free = None
+    if shm_free is not None and shm_free < shm_needed:
+        problems.append(
+            f"/dev/shm: {shm_free // (1 << 20)} MiB free but "
+            f"{n_processes} managed processes need "
+            f"~{shm_needed // (1 << 20)} MiB of IPC blocks (remount: "
+            f"`mount -o remount,size={2 * shm_needed // (1 << 20)}M "
+            f"/dev/shm`)")
+    if not problems:
+        return
+    msg = ("managed-fleet resource preflight: "
+           + "; ".join(problems))
+    if warn_only:
+        warnings.warn(msg + " — continuing because every managed "
+                      "process runs under on_failure: quarantine")
+        if log is not None:
+            log(msg)
+    else:
+        raise RuntimeError(
+            msg + " (or set on_failure: quarantine on every managed "
+            "process to degrade instead of failing)")

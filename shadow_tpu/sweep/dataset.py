@@ -98,15 +98,30 @@ def aggregate(spec: dict, out_dir: str) -> "Dataset":
     points = spec_mod.expand(spec)
     manifest_path = os.path.join(out_dir, "manifest.json")
     warm: dict = {}
+    man_points: dict = {}
     if os.path.exists(manifest_path):
         with open(manifest_path) as f:
+            man_points = json.load(f)["points"]
             warm = {pid: ent.get("warm_started", False)
-                    for pid, ent in
-                    json.load(f)["points"].items()}
+                    for pid, ent in man_points.items()}
     metas: list = []
     flow_blobs: list = []
     link_blobs: list = []
+    failed_points: list = []
     for p in points:
+        # Self-healing fleet (docs/ROBUSTNESS.md): a point the runner
+        # recorded as FAILED is listed honestly in the dataset
+        # metadata — a partial-but-honest dataset, never a silent
+        # hole (an unrecorded missing point still fails below).
+        ent = man_points.get(p["point_id"], {})
+        if ent.get("status") == "failed":
+            failed_points.append({
+                "point_id": p["point_id"],
+                "seed": p["seed"],
+                "axes": p["axes"],
+                "error": ent.get("error", ""),
+            })
+            continue
         pdir = os.path.join(out_dir, p["point_id"])
         fab_path = os.path.join(pdir, "fabric-sim.bin")
         pj_path = os.path.join(pdir, "point.json")
@@ -155,11 +170,16 @@ def aggregate(spec: dict, out_dir: str) -> "Dataset":
         })
         flow_blobs.append(b"".join(FCT_REC.pack(*r) for r in flows))
         link_blobs.append(fb_bytes)
+    if not metas:
+        raise DatasetError(
+            "every campaign point failed — nothing to aggregate "
+            f"({len(failed_points)} failures recorded)")
     meta = {
         "version": DS_VERSION,
         "name": spec["name"],
         "spec": spec,
         "points": metas,
+        "failed_points": failed_points,
         "tail_curves": tail_curves(metas),
     }
     return Dataset(meta, flow_blobs, link_blobs)

@@ -32,8 +32,18 @@ from shadow_tpu.sweep import spec as spec_mod
 
 
 class PointFailure(RuntimeError):
-    """A campaign point exited nonzero / timed out; the campaign
-    fails loudly rather than aggregating a hole."""
+    """A campaign point exited nonzero / timed out past its retry
+    budget AND the campaign's max_failed_points allowance; the
+    campaign fails loudly rather than aggregating a hole.  Within the
+    allowance, failed points are recorded honestly in the manifest
+    (and from there in the `.swds` metadata) instead."""
+
+
+# Wall backoff between per-point retry attempts (docs/ROBUSTNESS.md
+# "Self-healing sweeps"): transient failures — an OOM-killed
+# subprocess, a wall-limit near-miss on a loaded box — deserve a
+# breather; deterministic failures fail every attempt identically.
+RETRY_BACKOFF_S = 2.0
 
 
 def _point_env() -> dict:
@@ -99,17 +109,85 @@ def _scenario_stop_ns(spec: dict) -> int:
     return units.parse_time_ns(stop)
 
 
+def _write_manifest(out_dir: str, spec: dict, manifest: dict) -> None:
+    """Persisted INCREMENTALLY after every point so a killed campaign
+    resumes from exactly what completed (`tools/sweep run --resume`)."""
+    failed = sorted(pid for pid, ent in manifest.items()
+                    if ent.get("status") == "failed")
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump({"spec": spec, "points": manifest,
+                   "failed_points": failed}, f,
+                  sort_keys=True, indent=1)
+
+
+def _attempt_point(task: dict, pdir: str, time_limit_s: float,
+                   retries: int, log) -> tuple[bool, str, int]:
+    """Run one point with the retry budget: (ok, error, attempts).
+    The completion marker (`complete.json`) is written only after a
+    clean exit — `--resume` trusts the marker, never a half-written
+    data dir.  A stale marker from an EARLIER run is removed first,
+    so a point that fails now cannot be mistaken for complete by a
+    later resume."""
+    import time as _walltime
+    try:
+        os.remove(os.path.join(pdir, "complete.json"))
+    except OSError:
+        pass
+    err = ""
+    for attempt in range(retries + 1):
+        if attempt:
+            log(f"sweep: retry {attempt}/{retries} "
+                f"{os.path.basename(pdir)}")
+            _walltime.sleep(RETRY_BACKOFF_S * attempt)  # shadow-lint: allow[wall-clock] per-point retry backoff (wall-side fleet control)
+        try:
+            _run_sub(task, os.path.join(pdir, "task.json"),
+                     os.path.join(pdir, "log.txt"), time_limit_s)
+        except PointFailure as e:
+            err = str(e)
+            continue
+        with open(os.path.join(pdir, "complete.json"), "w") as f:
+            json.dump({"attempts": attempt + 1}, f)
+        return True, "", attempt + 1
+    return False, err, retries + 1
+
+
 def run_campaign(spec: dict, out_dir: str,
-                 log=lambda msg: print(msg, file=sys.stderr)) -> dict:
+                 log=lambda msg: print(msg, file=sys.stderr),
+                 resume: bool = False) -> dict:
     """Execute every point of `spec` under `out_dir` (one
     subdirectory per point, `<point_id>/`).  Returns the manifest
-    {point_id: {dir, warm_started, group}} in matrix order.  Any
-    point failure raises PointFailure — no partial datasets."""
+    points mapping {point_id: {dir, warm_started, group, status,
+    attempts}} in matrix order.
+
+    Self-healing (docs/ROBUSTNESS.md): each point retries up to
+    `spec.retries` times with bounded backoff; a point that still
+    fails is RECORDED (status "failed" + the error) rather than
+    aborting, until more than `spec.max_failed_points` have failed —
+    then PointFailure aborts the campaign.  With `resume=True`,
+    points whose completion marker exists are skipped, so a killed or
+    partially-failed campaign re-runs only the missing work."""
     spec = spec_mod.validate_spec(spec)
     points = spec_mod.expand(spec)
     os.makedirs(out_dir, exist_ok=True)
+    if resume:
+        # point_ids encode only seed+axes: a changed `base`/`scenario`
+        # would silently reuse data generated under the OLD spec.
+        # The manifest stores the spec it ran with — refuse a resume
+        # under a different one.
+        man_path = os.path.join(out_dir, "manifest.json")
+        if os.path.exists(man_path):
+            with open(man_path) as f:
+                stored = json.load(f).get("spec")
+            if stored is not None and stored != spec:
+                raise PointFailure(
+                    f"--resume refused: {out_dir} was run under a "
+                    f"DIFFERENT spec (point ids encode only "
+                    f"seed+axes, so completed points would be reused "
+                    f"under the wrong base config) — use a fresh "
+                    f"--out directory")
     warm = spec["warm_start"]
     manifest: dict = {}
+    failed = 0
     groups: dict = {}
     for p in points:
         groups.setdefault(p["group"], []).append(p)
@@ -123,9 +201,45 @@ def run_campaign(spec: dict, out_dir: str,
                 f"before the scenario stop_time "
                 f"({stop_ns // 1_000_000} ms)")
 
+    def record_failure(p, pdir, err, attempts) -> None:
+        nonlocal failed
+        failed += 1
+        manifest[p["point_id"]] = {
+            "dir": pdir, "group": p["group"],
+            "warm_started": warm is not None,
+            "status": "failed", "error": err[-800:],
+            # 0 = the point itself never ran (its ramp failed).
+            "attempts": attempts,
+        }
+        _write_manifest(out_dir, spec, manifest)
+        if failed > spec["max_failed_points"]:
+            raise PointFailure(
+                f"{p['point_id']}: {err}\n(campaign aborted: "
+                f"{failed} failed points exceeds max_failed_points="
+                f"{spec['max_failed_points']})")
+        log(f"sweep: point {p['point_id']} FAILED "
+            f"({failed}/{spec['max_failed_points']} budget) — "
+            f"recorded, campaign continues")
+
     for gname, gpoints in groups.items():
         snap = None
         ramp_task = None
+        pending = []
+        for p in gpoints:
+            pdir = os.path.join(out_dir, p["point_id"])
+            if resume and os.path.exists(
+                    os.path.join(pdir, "complete.json")):
+                log(f"sweep: point {p['point_id']} already complete "
+                    f"(resume) — skipped")
+                manifest[p["point_id"]] = {
+                    "dir": pdir, "group": p["group"],
+                    "warm_started": warm is not None,
+                    "status": "ok", "attempts": 0,
+                }
+                continue
+            pending.append(p)
+        if not pending:
+            continue
         if warm is not None:
             # ONE ramp per fork group: the group's first point's
             # scenario config with the group-base experimental
@@ -136,25 +250,41 @@ def run_campaign(spec: dict, out_dir: str,
             ramp_ns = warm["at_ms"] * 1_000_000
             ramp_dir = os.path.join(out_dir, f"ramp.{gname}")
             os.makedirs(ramp_dir, exist_ok=True)
-            log(f"sweep: ramp [{gname}] -> checkpoint at "
-                f"{warm['at_ms']} ms")
             ramp_task = point_task(spec, gpoints[0], ramp_dir)
             ramp_task["checkpoint"] = {"at_ns": [ramp_ns],
                                        "directory": ramp_dir}
             ramp_task["stop_time_ns"] = min(
                 _scenario_stop_ns(spec), ramp_ns + RAMP_HEADROOM_NS)
-            _run_sub(ramp_task,
-                     os.path.join(ramp_dir, "task.json"),
-                     os.path.join(ramp_dir, "log.txt"),
-                     spec["time_limit_s"])
             snap = os.path.join(ramp_dir, f"ckpt-{ramp_ns}.stck")
-            if not os.path.exists(snap):
-                raise PointFailure(
-                    f"ramp [{gname}] wrote no snapshot at "
-                    f"{warm['at_ms']} ms (boundary never reached "
-                    f"before stop_time?)")
+            if resume and os.path.exists(snap) and os.path.exists(
+                    os.path.join(ramp_dir, "complete.json")):
+                # The ramp is the expensive part warm-start exists to
+                # amortize: a completed ramp's snapshot is reused.
+                log(f"sweep: ramp [{gname}] already complete "
+                    f"(resume) — snapshot reused")
+                ok, err = True, ""
+            else:
+                log(f"sweep: ramp [{gname}] -> checkpoint at "
+                    f"{warm['at_ms']} ms")
+                ok, err, _n = _attempt_point(
+                    ramp_task, ramp_dir, spec["time_limit_s"],
+                    spec["retries"], log)
+                if ok and not os.path.exists(snap):
+                    ok, err = False, (
+                        f"ramp [{gname}] wrote no snapshot at "
+                        f"{warm['at_ms']} ms (boundary never reached "
+                        f"before stop_time?)")
+            if not ok:
+                # A dead ramp takes its whole fork group with it —
+                # every pending member fails against the budget
+                # (attempts 0: the points themselves never ran).
+                for p in pending:
+                    pdir = os.path.join(out_dir, p["point_id"])
+                    os.makedirs(pdir, exist_ok=True)
+                    record_failure(p, pdir, f"ramp failed: {err}", 0)
+                continue
 
-        for p in gpoints:
+        for p in pending:
             pdir = os.path.join(out_dir, p["point_id"])
             os.makedirs(pdir, exist_ok=True)
             task = point_task(spec, p, pdir)
@@ -163,16 +293,19 @@ def run_campaign(spec: dict, out_dir: str,
                     ramp_task, task, snap, pdir)
             log(f"sweep: point {p['point_id']}"
                 + (" (warm)" if snap is not None else ""))
-            _run_sub(task, os.path.join(pdir, "task.json"),
-                     os.path.join(pdir, "log.txt"),
-                     spec["time_limit_s"])
+            ok, err, attempts = _attempt_point(
+                task, pdir, spec["time_limit_s"], spec["retries"],
+                log)
+            if not ok:
+                record_failure(p, pdir, err, attempts)
+                continue
             manifest[p["point_id"]] = {
                 "dir": pdir, "group": p["group"],
                 "warm_started": snap is not None,
+                "status": "ok", "attempts": attempts,
             }
-    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
-        json.dump({"spec": spec, "points": manifest}, f,
-                  sort_keys=True, indent=1)
+            _write_manifest(out_dir, spec, manifest)
+    _write_manifest(out_dir, spec, manifest)
     return manifest
 
 

@@ -78,7 +78,8 @@ SCENARIO_DEFAULTS = {
 }
 
 _SPEC_KEYS = {"name", "scenario", "seeds", "base", "axes",
-              "time_limit_s", "warm_start", "link_interval_ms"}
+              "time_limit_s", "warm_start", "link_interval_ms",
+              "retries", "max_failed_points"}
 
 
 class SpecError(ValueError):
@@ -145,11 +146,25 @@ def validate_spec(spec: dict) -> dict:
     if not isinstance(li, int) or isinstance(li, bool) or li < 0:
         raise SpecError(f"spec.link_interval_ms must be an int >= 0, "
                         f"got {li!r}")
+    # Self-healing fleet knobs (docs/ROBUSTNESS.md "Self-healing
+    # sweeps"): per-point retry count with bounded backoff, and how
+    # many points may FAIL outright before the campaign aborts —
+    # failed points land in the dataset's metadata, never as holes.
+    retries = spec.get("retries", 1)
+    if not isinstance(retries, int) or isinstance(retries, bool) \
+            or retries < 0:
+        raise SpecError(f"spec.retries must be an int >= 0, got "
+                        f"{retries!r}")
+    mfp = spec.get("max_failed_points", 0)
+    if not isinstance(mfp, int) or isinstance(mfp, bool) or mfp < 0:
+        raise SpecError(f"spec.max_failed_points must be an int >= 0, "
+                        f"got {mfp!r}")
     return {"name": name, "scenario": scenario, "seeds": list(seeds),
             "base": dict(base), "axes": {k: list(v) for k, v
                                          in sorted(axes.items())},
             "time_limit_s": tl, "warm_start": warm,
-            "link_interval_ms": li}
+            "link_interval_ms": li, "retries": retries,
+            "max_failed_points": mfp}
 
 
 def expand(spec: dict) -> list[dict]:

@@ -61,16 +61,19 @@ def cmd_expand(spec_path: str) -> int:
     return 0
 
 
-def cmd_run(spec_path: str, out_dir: str) -> int:
+def cmd_run(spec_path: str, out_dir: str, resume: bool = False) -> int:
     from shadow_tpu.sweep import dataset, runner
     from shadow_tpu.sweep import spec as spec_mod
     spec = spec_mod.validate_spec(_load_spec(spec_path))
-    runner.run_campaign(spec, out_dir)
+    runner.run_campaign(spec, out_dir, resume=resume)
     ds = dataset.aggregate(spec, out_dir)
     path = os.path.join(out_dir, f"{spec['name']}.swds")
     ds.write(path)
     print(f"dataset: {path} ({os.path.getsize(path)} bytes)")
     print_curves(ds.meta)
+    for fp in ds.meta.get("failed_points", []):
+        print(f"  FAILED point {fp['point_id']}: "
+              f"{fp['error'].splitlines()[0] if fp['error'] else '?'}")
     return 0
 
 
@@ -83,6 +86,13 @@ def cmd_report(path: str) -> int:
           f"link samples "
           f"{sum(p['counts']['links'] for p in ds.meta['points'])}, "
           f"warm-started points {warm}")
+    failed = ds.meta.get("failed_points", [])
+    if failed:
+        print(f"  FAILED points ({len(failed)} — recorded honestly, "
+              f"docs/ROBUSTNESS.md):")
+        for fp in failed:
+            first = fp["error"].splitlines()[0] if fp["error"] else "?"
+            print(f"    {fp['point_id']}: {first}")
     return 0
 
 
@@ -130,6 +140,10 @@ def main(argv=None) -> int:
             sub.add_argument("spec")
         if argv[0] == "run":
             sub.add_argument("--out", required=True)
+            sub.add_argument(
+                "--resume", action="store_true",
+                help="skip points whose completion marker exists "
+                     "(re-run only missing/failed points)")
         sargs = sub.parse_args(argv[1:])
         from shadow_tpu.sweep.dataset import DatasetError
         from shadow_tpu.sweep.runner import PointFailure
@@ -138,7 +152,8 @@ def main(argv=None) -> int:
             if argv[0] == "expand":
                 return cmd_expand(sargs.spec)
             if argv[0] == "run":
-                return cmd_run(sargs.spec, sargs.out)
+                return cmd_run(sargs.spec, sargs.out,
+                               resume=sargs.resume)
             return cmd_report(sargs.dataset)
         except (SpecError, PointFailure, DatasetError) as e:
             print(f"sweep: {e}", file=sys.stderr)

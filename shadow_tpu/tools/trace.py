@@ -118,9 +118,10 @@ def summarize(data_dir: str, chrome_out: str | None = None,
             if kind == FR_SPAN_COMMIT:
                 span_rounds += c
         n_recs = len(sim_bytes) // FLIGHT_REC_BYTES
-        from shadow_tpu.trace.events import FR_FAULT_CLEAR, FR_FAULT_KILL
+        from shadow_tpu.trace.events import (FR_FAULT_KILL,
+                                             FR_FAULT_QUARANTINE)
         n_faults = sum(n for k, n in kinds.items()
-                       if FR_FAULT_KILL <= k <= FR_FAULT_CLEAR)
+                       if FR_FAULT_KILL <= k <= FR_FAULT_QUARANTINE)
         fault_s = f", {n_faults} fault injections" if n_faults else ""
         print(f"  sim-time channel: {n_recs} records "
               f"({kinds[FR_ROUND]} round, {kinds[FR_SPAN_COMMIT]} span "

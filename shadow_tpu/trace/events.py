@@ -40,7 +40,13 @@ FR_FAULT_LINK_DOWN = 6  # link_down applied
 FR_FAULT_LINK_UP = 7    # link_up applied
 FR_FAULT_BLACKHOLE = 8  # nic_blackhole applied
 FR_FAULT_CLEAR = 9      # nic_clear applied
-FR_N = 10
+FR_FAULT_QUARANTINE = 10  # containment quarantine applied (a = host
+#                           id) — a wall-side failure (binary death,
+#                           hang watchdog, spawn failure) resolved
+#                           into host_kill semantics at a round
+#                           boundary, or a replayed ledger/faults
+#                           `quarantine` op (docs/ROBUSTNESS.md)
+FR_N = 11
 
 # Span families (Python-side only: the engine records no span events —
 # the manager orchestrates spans and stamps these itself).

@@ -1,0 +1,19 @@
+/* Containment test plugin (docs/ROBUSTNESS.md): do a little honest
+ * work at deterministic sim instants, then segfault mid-stream.  The
+ * crash point is a pure function of the program (after the second
+ * simulated sleep), so the sim instant at which the manager observes
+ * the death is deterministic — the ledger-replay byte-identity gate
+ * relies on that. */
+#include <stdio.h>
+#include <time.h>
+
+int main(void) {
+    struct timespec req = {0, 200000000}; /* 200 ms simulated */
+    nanosleep(&req, NULL);
+    printf("crash_mid: alive\n");
+    fflush(stdout);
+    nanosleep(&req, NULL);
+    volatile int *p = 0;
+    *p = 42; /* SIGSEGV */
+    return 0;
+}

@@ -1,0 +1,26 @@
+/* Containment test plugin (docs/ROBUSTNESS.md): fail on the FIRST
+ * run, succeed on the second — the restart policy's healing case.
+ * State rides a marker file at argv[1] (an absolute path the test
+ * owns; the native process inherits the MANAGER's cwd, so a relative
+ * path would pollute whatever directory the test runner started in). */
+#include <stdio.h>
+#include <time.h>
+
+int main(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <marker-path>\n", argv[0]);
+        return 2;
+    }
+    struct timespec req = {0, 100000000}; /* 100 ms simulated */
+    nanosleep(&req, NULL);
+    FILE *f = fopen(argv[1], "r");
+    if (f == NULL) {
+        f = fopen(argv[1], "w");
+        if (f) fclose(f);
+        fprintf(stderr, "fail_once: first run, failing\n");
+        return 3;
+    }
+    fclose(f);
+    printf("fail_once: healed\n");
+    return 0;
+}

@@ -1,0 +1,19 @@
+/* Containment test plugin (docs/ROBUSTNESS.md): park forever in
+ * userspace with NO syscalls after announcing itself.  Without the
+ * hang watchdog this wall-hangs the manager's IPC recv; with
+ * experimental.managed_watchdog set, the containment plane SIGKILLs
+ * the process and the death resolves at the deterministic sim instant
+ * of its last syscall. */
+#include <stdio.h>
+#include <time.h>
+
+int main(void) {
+    struct timespec req = {0, 100000000}; /* 100 ms simulated */
+    nanosleep(&req, NULL);
+    printf("hang_forever: parking\n");
+    fflush(stdout);
+    volatile unsigned long acc = 1;
+    for (;;)
+        acc = acc * 2862933555777941757UL + 3037000493UL;
+    return (int)acc;
+}

@@ -1,0 +1,327 @@
+"""Failure containment plane (docs/ROBUSTNESS.md).
+
+Deterministic crash/hang/spawn-fail plugin binaries prove that
+wall-side failures resolve into deterministic, attributed sim-side
+outcomes: quarantine at the next conservative-round boundary with
+FR_FAULT_QUARANTINE / host-down drop attribution, capped deterministic
+restart budgets, and the fault-ledger replay contract — re-running
+with the recorded ledger supplied as a `faults:` schedule reproduces
+the run byte-identically.
+"""
+
+import json
+import os
+import shutil
+import struct
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import run_simulation
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C toolchain")
+
+
+@pytest.fixture(scope="module")
+def plugin(tmp_path_factory):
+    out_dir = tmp_path_factory.mktemp("plugins")
+
+    def build(name: str) -> str:
+        src = os.path.join(PLUGIN_DIR, name + ".c")
+        out = os.path.join(out_dir, name)
+        if not os.path.exists(out):
+            subprocess.run(["cc", "-O1", "-o", out, src], check=True)
+        return out
+
+    return build
+
+
+# A UDP echo pair keeps real traffic in flight so a quarantine has
+# sim-visible effects (host-down drops), plus one failing binary on
+# the server host.  `{fail_proc}` is the injection site; `{faults}`
+# the replay site.
+PAIR_YAML = """
+general:
+  stop_time: 12s
+  seed: 1
+  data_directory: {data}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+      ]
+experimental:
+  scheduler: {scheduler}
+{experimental}
+hosts:
+  client:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+      - path: {client}
+        args: ["11.0.0.2", "9000", "200", "1000"]
+        start_time: 2s
+        expected_final_state: any
+  server:
+    network_node_id: 0
+    ip_addr: 11.0.0.2
+    processes:
+      - path: {server}
+        args: ["9000", "200"]
+        start_time: 1s
+        expected_final_state: any
+{fail_proc}
+{faults}
+"""
+
+
+def pair_cfg(plugin, data, fail_proc="", faults="", scheduler="serial",
+             experimental=""):
+    return ConfigOptions.from_yaml_text(PAIR_YAML.format(
+        data=data, client=plugin("udp_echo_client"),
+        server=plugin("udp_echo_server"), fail_proc=fail_proc,
+        faults=faults, scheduler=scheduler, experimental=experimental))
+
+
+def _sim_channels(manager, summary):
+    """The byte-diffed determinism surface for the replay gates."""
+    return (manager.trace_lines(), manager.drop_cause_totals(),
+            manager.sc_disposition_totals())
+
+
+def test_crash_quarantine_end_to_end(plugin, tmp_path):
+    """A mid-stream segfault under on_failure: quarantine completes
+    the run (no sim abort, no plugin error), kills the host at the
+    next round boundary, attributes the containment in the ledger,
+    and keeps drop-cause conservation exact."""
+    fail = f"""
+      - path: {plugin('crash_mid')}
+        start_time: 2500ms
+        on_failure: quarantine"""
+    cfg = pair_cfg(plugin, tmp_path, fail_proc=fail)
+    cfg.experimental.flight_recorder = "on"
+    manager, summary = run_simulation(cfg, write_data=True)
+    assert summary.ok, summary.plugin_errors
+    server = next(h for h in manager.hosts if h.name == "server")
+    assert server.down
+    led = manager.containment.ledger()
+    assert len(led["ops"]) == 1 and led["ops"][0]["host"] == "server"
+    assert [e["cause"] for e in led["events"]] == ["binary-death"]
+    # Drop-cause conservation: every drop attributed, host-down live.
+    drops = manager.drop_cause_totals()
+    assert "unattributed" not in drops
+    assert drops.get("host-down", 0) >= 1
+    assert sum(drops.values()) == summary.packets_dropped
+    # The ledger artifact is on disk and FR_FAULT_QUARANTINE is in the
+    # flight channel.
+    disk = json.load(open(os.path.join(tmp_path, "fault-ledger.json")))
+    assert disk["ops"] == led["ops"]
+    from shadow_tpu.trace.events import REC, FR_FAULT_QUARANTINE
+    blob = open(os.path.join(tmp_path, "flight-sim.bin"), "rb").read()
+    assert len(blob) % REC.size == 0
+    kinds = [REC.unpack_from(blob, o)[1]
+             for o in range(0, len(blob), REC.size)]
+    assert FR_FAULT_QUARANTINE in kinds
+
+
+def test_ledger_replay_byte_identity(plugin, tmp_path):
+    """THE containment determinism contract: re-running with the
+    recorded ledger ops supplied as a `faults:` schedule reproduces
+    the deterministic artifacts byte-identically — and the replay's
+    own ledger matches (the scheduled op and the re-triggered
+    containment dedup to one application)."""
+    fail = f"""
+      - path: {plugin('crash_mid')}
+        start_time: 2500ms
+        on_failure: quarantine"""
+    m1, s1 = run_simulation(pair_cfg(plugin, tmp_path / "a",
+                                     fail_proc=fail))
+    assert s1.ok
+    led1 = m1.containment.ledger()
+    assert len(led1["ops"]) == 1
+    op = led1["ops"][0]
+    faults = ("faults:\n"
+              f"  - {{at: {op['at']}, action: quarantine, "
+              f"host: {op['host']}}}")
+    m2, s2 = run_simulation(pair_cfg(plugin, tmp_path / "b",
+                                     fail_proc=fail, faults=faults))
+    assert s2.ok
+    assert _sim_channels(m1, s1) == _sim_channels(m2, s2)
+    led2 = m2.containment.ledger()
+    assert led1["ops"] == led2["ops"]
+    assert led1["events"] == led2["events"]
+
+
+def test_crash_containment_identical_across_schedulers(plugin,
+                                                       tmp_path):
+    """The containment trigger instant and the quarantine boundary
+    are pure functions of sim state: serial and tpu agree byte-wise
+    on the traces, the drop attribution, and the ledger."""
+    fail = f"""
+      - path: {plugin('crash_mid')}
+        start_time: 2500ms
+        on_failure: quarantine"""
+    runs = {}
+    for sched in ("serial", "thread_per_core", "tpu"):
+        m, s = run_simulation(pair_cfg(plugin, tmp_path / sched,
+                                       fail_proc=fail,
+                                       scheduler=sched))
+        assert s.ok, s.plugin_errors
+        runs[sched] = (_sim_channels(m, s),
+                       m.containment.ledger())
+    assert runs["serial"] == runs["thread_per_core"] == runs["tpu"]
+
+
+def test_restart_budget_exhaustion(plugin, tmp_path):
+    """A deterministically-crashing binary under on_failure: restart
+    consumes its whole budget (one respawn per crash, at the crash
+    instant), then quarantines."""
+    fail = f"""
+      - path: {plugin('crash_mid')}
+        start_time: 2500ms
+        on_failure: restart
+        restart_budget: 2"""
+    m, s = run_simulation(pair_cfg(plugin, tmp_path, fail_proc=fail))
+    assert s.ok, s.plugin_errors
+    led = m.containment.ledger()
+    actions = [(e["cause"], e["action"]) for e in led["events"]]
+    assert actions == [("binary-death", "restart"),
+                       ("binary-death", "restart"),
+                       ("restart-exhausted", "quarantine")]
+    assert len(led["ops"]) == 1
+    server = next(h for h in m.hosts if h.name == "server")
+    assert server.down
+    # Each restart re-ran the binary: 1 original + 2 restarts.
+    crashers = [p for p in server.processes.values()
+                if p.name.startswith("crash_mid")]
+    assert len(crashers) == 3
+
+
+def test_restart_heals_transient_failure(plugin, tmp_path):
+    """fail_once exits 3 on its first run and 0 after: one restart
+    heals it — no quarantine, host stays up, run is clean."""
+    fail = f"""
+      - path: {plugin('fail_once')}
+        args: ["{tmp_path}/fail_once.marker"]
+        start_time: 2500ms
+        on_failure: restart
+        restart_budget: 2"""
+    m, s = run_simulation(pair_cfg(plugin, tmp_path, fail_proc=fail))
+    assert s.ok, s.plugin_errors
+    led = m.containment.ledger()
+    assert [e["action"] for e in led["events"]] == ["restart"]
+    assert led["ops"] == []
+    server = next(h for h in m.hosts if h.name == "server")
+    assert not server.down
+    healed = [p for p in server.processes.values()
+              if p.name.startswith("fail_once")
+              and p.exited and p.exit_code == 0]
+    assert len(healed) == 1
+
+
+def test_hang_watchdog_quarantine(plugin, tmp_path):
+    """hang_forever parks in userspace with no syscalls: without the
+    watchdog this would wall-hang the IPC recv forever; with it, the
+    process is killed and the containment policy engages at the
+    deterministic sim instant of its last syscall."""
+    fail = f"""
+      - path: {plugin('hang_forever')}
+        start_time: 2500ms
+        on_failure: quarantine"""
+    m, s = run_simulation(pair_cfg(
+        plugin, tmp_path, fail_proc=fail,
+        experimental="  managed_watchdog: 1s"))
+    assert s.ok, s.plugin_errors
+    led = m.containment.ledger()
+    assert [e["cause"] for e in led["events"]] == ["hang-watchdog"]
+    assert len(led["ops"]) == 1
+    assert next(h for h in m.hosts if h.name == "server").down
+
+
+def test_hang_watchdog_abort_policy(plugin, tmp_path):
+    """Under the default abort policy the watchdog still unwedges the
+    sim (the alternative is a wall-hang), but the failure is an
+    honest plugin error, not a contained one."""
+    fail = f"""
+      - path: {plugin('hang_forever')}
+        start_time: 2500ms"""
+    m, s = run_simulation(pair_cfg(
+        plugin, tmp_path, fail_proc=fail,
+        experimental="  managed_watchdog: 1s"))
+    assert not s.ok
+    assert any("hang_forever" in e for e in s.plugin_errors)
+    assert m.containment.ledger()["ops"] == []
+    assert not next(h for h in m.hosts if h.name == "server").down
+
+
+def test_spawn_failure_policies(plugin, tmp_path):
+    """ENOENT argv: under abort it is a plugin error (exit 127, the
+    historical semantics); under quarantine the host is contained."""
+    for policy, ok in (("abort", False), ("quarantine", True)):
+        fail = f"""
+      - path: /nonexistent/dir/not-a-binary
+        start_time: 2500ms
+        on_failure: {policy}"""
+        m, s = run_simulation(pair_cfg(plugin,
+                                       tmp_path / policy,
+                                       fail_proc=fail))
+        assert s.ok is ok, (policy, s.plugin_errors)
+        led = m.containment.ledger()
+        if ok:
+            assert [e["cause"] for e in led["events"]] == \
+                ["spawn-failure"]
+            assert len(led["ops"]) == 1
+        else:
+            assert led["events"] == []
+
+
+def test_config_validation():
+    from shadow_tpu.core.config import ON_FAILURE_POLICIES
+    assert set(ON_FAILURE_POLICIES) == {"abort", "quarantine",
+                                        "restart"}
+    bad = """
+general: {stop_time: 1s}
+network:
+  graph: {type: gml, inline: 'graph [ node [ id 0 host_bandwidth_down "1 Mbit" host_bandwidth_up "1 Mbit" ] edge [ source 0 target 0 latency "1 ms" ] ]'}
+hosts:
+  a:
+    network_node_id: 0
+    processes:
+      - {path: /bin/true, on_failure: explode}
+"""
+    with pytest.raises(ValueError, match="on_failure"):
+        ConfigOptions.from_yaml_text(bad)
+    with pytest.raises(ValueError, match="managed_watchdog"):
+        ConfigOptions.from_yaml_text(bad.replace(
+            "      - {path: /bin/true, on_failure: explode}",
+            "      - {path: /bin/true}").replace(
+            "hosts:",
+            "experimental: {managed_watchdog: 5ms}\nhosts:"))
+
+
+def test_preflight_names_the_limit(monkeypatch):
+    """The resource preflight fails fast naming the exact rlimit when
+    the configured fleet cannot fit, and degrades to a warning under
+    an all-quarantine fleet."""
+    import resource
+
+    from shadow_tpu.svc.containment import preflight_managed
+    real = resource.getrlimit
+
+    def tiny(which):
+        if which == resource.RLIMIT_NOFILE:
+            return (64, 64)
+        return real(which)
+
+    monkeypatch.setattr(resource, "getrlimit", tiny)
+    with pytest.raises(RuntimeError, match="RLIMIT_NOFILE"):
+        preflight_managed(1000, warn_only=False)
+    with pytest.warns(UserWarning, match="RLIMIT_NOFILE"):
+        preflight_managed(1000, warn_only=True)

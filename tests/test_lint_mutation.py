@@ -30,8 +30,9 @@ def shim_text():
         return fh.read()
 
 
-def _mutate(text: str, old: str, new: str) -> str:
-    assert text.count(old) == 1, f"mutation anchor not unique: {old!r}"
+def _mutate(text: str, old: str, new: str, count: int = 1) -> str:
+    assert text.count(old) == count, \
+        f"mutation anchor count != {count}: {old!r}"
     return text.replace(old, new)
 
 
@@ -503,4 +504,34 @@ def test_el_shard_enum_drift_is_caught(cpp_text):
                       "EL_ENGINE_EXCHANGE2, EL_ENGINE_UNSHARDED, EL_N")
     v = twin_constants.check(ROOT, cpp_text=mutated)
     assert any("EL_ENGINE_EXCHANGE" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_h_fault_column_rename_is_caught(cpp_text):
+    """The down-host fault mask (docs/ROBUSTNESS.md) rides the
+    4-side-checked span codecs: renaming the export column must fire
+    both directions (dead export + phantom codec read) — in BOTH
+    device-span families, which each export it once."""
+    mutated = _mutate(cpp_text,
+                      'put("h_fault", bytes_vec(h_fault));',
+                      'put("h_faultx", bytes_vec(h_fault));',
+                      count=2)
+    v = soa_layout.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert any("'h_faultx'" in m and "never consumed" in m
+               for m in msgs), msgs
+    assert any("'h_fault'" in m and "never exports" in m
+               for m in msgs), msgs
+
+
+def test_quarantine_flight_kind_drift_is_caught(cpp_text):
+    """FR_FAULT_QUARANTINE is the containment plane's attribution
+    record: dropping it from the C++ enum must be flagged against the
+    trace/events.py twin (fail-closed FR_ namespace)."""
+    mutated = _mutate(cpp_text,
+                      "FR_FAULT_QUARANTINE, FR_N }",
+                      "FR_N }")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("FR_FAULT_QUARANTINE" in x.message or
+               "FR_N" in x.message for x in v), \
         [x.render() for x in v]

@@ -120,6 +120,41 @@ def test_phold_device_span_burst_with_loss():
     assert _hist(m_ser) == _hist(m_dev)
 
 
+def test_phold_device_span_faults_byte_identical():
+    """Down-host fault mask (docs/ROBUSTNESS.md): a faults: schedule
+    — host_kill + link_down/link_up — KEEPS device spans (the refusal
+    is lifted; h_fault rides the 4-side-checked codec) and stays
+    byte-identical to the serial object path, arrivals to down hosts
+    dropping at their recorded instants with host-down attribution."""
+    def with_faults(cfg):
+        from shadow_tpu.core.config import FaultConfig
+        names = sorted(cfg.hosts)
+        cfg.faults = [
+            FaultConfig(at_ns=600_000_000, action="link_down",
+                        host=names[2]),
+            FaultConfig(at_ns=800_000_000, action="host_kill",
+                        host=names[3]),
+            FaultConfig(at_ns=1_200_000_000, action="link_up",
+                        host=names[2]),
+        ]
+        return cfg
+
+    m_ser, s_ser = run_simulation(with_faults(phold_cfg("serial")))
+    m_dev, s_dev = run_simulation(with_faults(
+        phold_cfg("tpu", device_spans="force")))
+    r = m_dev._dev_span
+    assert r.spans > 0 and r.aborts == 0, (r.spans, r.aborts)
+    # Fault rounds served ON DEVICE, not per-round fallback.
+    counts = m_dev.audit.as_dict()
+    assert counts.get("device-span", 0) > 0, counts
+    assert m_ser.trace_lines() == m_dev.trace_lines()
+    drops = m_ser.drop_cause_totals()
+    assert drops.get("host-down", 0) > 0
+    assert drops.get("link-down", 0) > 0
+    assert drops == m_dev.drop_cause_totals()
+    assert _counters(s_ser) == _counters(s_dev)
+
+
 def test_non_span_sim_disables_device_spans_cleanly():
     """A sim that fits NO device-span family (udp-flood/sink — not
     phold-shaped, not tgen-TCP) under scheduler=tpu with device spans

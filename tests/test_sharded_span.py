@@ -60,6 +60,53 @@ def test_sharded_phold_span_byte_identity():
         "sharded phold span diverged from serial"
 
 
+def test_sharded_span_faults_byte_identity():
+    """Faults on a tpu_shards > 1 config (docs/ROBUSTNESS.md): the
+    refusal is LIFTED — the schedule runs through sharded device
+    spans (down-host mask live in the kernel, packets to down hosts
+    dropped at their path-independent arrival instants after the
+    cross-shard exchange) byte-identical to the serial single-shard
+    path, with no per-round fallback for fault rounds."""
+    from shadow_tpu.core.config import FaultConfig
+
+    def with_faults(cfg):
+        names = sorted(cfg.hosts)
+        cfg.faults = [
+            FaultConfig(at_ns=300_000_000, action="link_down",
+                        host=names[5]),
+            FaultConfig(at_ns=400_000_000, action="host_kill",
+                        host=names[3]),
+            FaultConfig(at_ns=700_000_000, action="link_up",
+                        host=names[5]),
+        ]
+        return cfg
+
+    text = lambda sched, ds=None: phold_yaml(  # noqa: E731
+        16, n_init=3, mean_delay_ns=20_000_000, stop_time="1s",
+        seed=13, scheduler=sched, device_spans=ds)
+    cfg0 = with_faults(ConfigOptions.from_yaml_text(text("serial")))
+    m0 = Manager(cfg0)
+    s0 = m0.run()
+    cfg1 = with_faults(ConfigOptions.from_yaml_text(
+        text("tpu", "force")))
+    cfg1.experimental.tpu_shards = 8
+    m1 = Manager(cfg1)
+    s1 = m1.run()
+    r = m1._dev_span
+    assert r is not None and r.mesh is not None and r.n_shards == 8
+    assert r.spans > 0 and r.aborts == 0, (r.spans, r.aborts)
+    counts = audit_counts(m1)
+    assert counts.get("device-span:sharded", 0) > 0, counts
+    assert m0.trace_lines() == m1.trace_lines(), \
+        "sharded fault run diverged from serial"
+    drops = m0.drop_cause_totals()
+    assert drops.get("host-down", 0) > 0
+    assert drops.get("link-down", 0) > 0
+    assert drops == m1.drop_cause_totals()
+    assert (s0.events, s0.packets_sent, s0.packets_dropped) == \
+        (s1.events, s1.packets_sent, s1.packets_dropped)
+
+
 def test_sharded_udp_mesh_exchange_capacity_pressure():
     """udp-mesh family under tpu_exchange_capacity=1: every span's
     first dispatch overflows the cross-shard hop, the kernel marks

@@ -347,3 +347,97 @@ def test_warm_started_point_end_to_end(tmp_path):
               for p in ds.meta["points"]}
     assert marked[5] >= marked[20]
     assert marked[5] > 0
+
+
+# ---------------------------------------------------------------------
+# Self-healing fleet (docs/ROBUSTNESS.md "Self-healing sweeps")
+# ---------------------------------------------------------------------
+
+def test_self_healing_spec_validation():
+    ok = spec_mod.validate_spec(dict(MICRO_SPEC, retries=2,
+                                     max_failed_points=1))
+    assert ok["retries"] == 2 and ok["max_failed_points"] == 1
+    # defaults
+    base = spec_mod.validate_spec(MICRO_SPEC)
+    assert base["retries"] == 1 and base["max_failed_points"] == 0
+    with pytest.raises(spec_mod.SpecError, match="retries"):
+        spec_mod.validate_spec(dict(MICRO_SPEC, retries=-1))
+    with pytest.raises(spec_mod.SpecError, match="max_failed_points"):
+        spec_mod.validate_spec(dict(MICRO_SPEC,
+                                    max_failed_points=True))
+
+
+def test_failed_point_recorded_then_resume_heals(tmp_path,
+                                                 monkeypatch):
+    """One point forced to fail: the campaign completes (budget 1),
+    the manifest and the .swds dataset record the failure honestly,
+    and `--resume` re-runs ONLY the missing point to a full dataset
+    byte-identical to an untouched campaign's."""
+    spec = dict(MICRO_SPEC, retries=0, max_failed_points=1)
+    points = spec_mod.expand(spec)
+    victim = points[1]["point_id"]
+    real_run_sub = runner_mod._run_sub
+    ran: list = []
+
+    def sabotaged(task, task_path, log_path, tl):
+        ran.append(os.path.basename(os.path.dirname(task_path)))
+        if victim in task_path:
+            raise runner_mod.PointFailure("injected failure")
+        return real_run_sub(task, task_path, log_path, tl)
+
+    monkeypatch.setattr(runner_mod, "_run_sub", sabotaged)
+    out = str(tmp_path / "camp")
+    manifest = runner_mod.run_campaign(spec, out, log=lambda m: None)
+    assert manifest[victim]["status"] == "failed"
+    assert "injected failure" in manifest[victim]["error"]
+    disk = json.load(open(os.path.join(out, "manifest.json")))
+    assert disk["failed_points"] == [victim]
+    # Partial-but-honest dataset: the failed point is metadata, not a
+    # hole.
+    ds = ds_mod.aggregate(spec, out)
+    assert [fp["point_id"] for fp in ds.meta["failed_points"]] == \
+        [victim]
+    assert len(ds.meta["points"]) == len(points) - 1
+
+    # Resume with the sabotage lifted: only the victim re-runs.
+    monkeypatch.setattr(runner_mod, "_run_sub", real_run_sub)
+    ran_before = list(ran)
+    manifest2 = runner_mod.run_campaign(spec, out, log=lambda m: None,
+                                        resume=True)
+    assert ran == ran_before  # the patched recorder saw nothing new
+    assert all(ent["status"] == "ok" for ent in manifest2.values())
+    ds2 = ds_mod.aggregate(spec, out)
+    assert ds2.meta["failed_points"] == []
+    assert len(ds2.meta["points"]) == len(points)
+    # The healed dataset is byte-identical to a clean campaign's
+    # (identity-safe subprocesses: bytes depend only on the spec).
+    clean = str(tmp_path / "clean")
+    runner_mod.run_campaign(spec, clean, log=lambda m: None)
+    assert ds2.to_bytes() == ds_mod.aggregate(spec, clean).to_bytes()
+
+
+def test_max_failed_points_budget_aborts(tmp_path, monkeypatch):
+    """Failures past the budget abort the campaign loudly."""
+    spec = dict(MICRO_SPEC, retries=0, max_failed_points=0)
+
+    def always_fail(task, task_path, log_path, tl):
+        raise runner_mod.PointFailure("boom")
+
+    monkeypatch.setattr(runner_mod, "_run_sub", always_fail)
+    with pytest.raises(runner_mod.PointFailure,
+                       match="max_failed_points"):
+        runner_mod.run_campaign(spec, str(tmp_path / "camp"),
+                                log=lambda m: None)
+
+
+def test_all_points_failed_aggregate_refuses(tmp_path, monkeypatch):
+    spec = dict(MICRO_SPEC, retries=0, max_failed_points=10)
+
+    def always_fail(task, task_path, log_path, tl):
+        raise runner_mod.PointFailure("boom")
+
+    monkeypatch.setattr(runner_mod, "_run_sub", always_fail)
+    out = str(tmp_path / "camp")
+    runner_mod.run_campaign(spec, out, log=lambda m: None)
+    with pytest.raises(ds_mod.DatasetError, match="every campaign"):
+        ds_mod.aggregate(spec, out)

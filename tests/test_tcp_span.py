@@ -192,6 +192,45 @@ def test_tcp_device_span_lossy_retransmit():
 
 
 @pytest.mark.slow
+def test_tcp_device_span_faults_byte_identical():
+    """Down-host fault mask in the TCP family (docs/ROBUSTNESS.md):
+    host_kill + link_down/link_up mid-stream keep device spans
+    (refusal lifted) and stay byte-identical to serial — frozen
+    connections' arrivals drop host-down at their recorded instants,
+    a link-down sender's egress drops before the seq draw, and the
+    peer's RTO machinery reacts identically on both paths."""
+    def with_faults(cfg):
+        from shadow_tpu.core.config import FaultConfig
+        names = sorted(cfg.hosts)
+        cfg.faults = [
+            FaultConfig(at_ns=700_000_000, action="link_down",
+                        host=names[5]),
+            FaultConfig(at_ns=900_000_000, action="host_kill",
+                        host=names[2]),
+            FaultConfig(at_ns=1_500_000_000, action="link_up",
+                        host=names[5]),
+        ]
+        return cfg
+
+    m_ser, s_ser = run_simulation(with_faults(
+        stream_cfg("serial", loss=0.0)))
+    mgr = Manager(with_faults(
+        stream_cfg("tpu", loss=0.0, device_spans="force")))
+    _require_plane(mgr)
+    s_dev = mgr.run()
+    r = mgr._dev_span_tcp
+    assert r is not None and r.spans > 0, \
+        (f"device span never ran under faults (aborts="
+         f"{getattr(r, 'aborts', 0)})")
+    assert m_ser.trace_lines() == mgr.trace_lines()
+    drops = m_ser.drop_cause_totals()
+    assert drops.get("host-down", 0) > 0
+    assert drops.get("link-down", 0) > 0
+    assert drops == mgr.drop_cause_totals()
+    assert s_ser.events == s_dev.events
+
+
+@pytest.mark.slow
 def test_tcp_fused_vs_unfused_differential():
     """The fused TCP dispatcher (segment chains run inside one
     while-iteration, any-active cond guards) against the reference
