@@ -304,13 +304,69 @@ def run_best(build, scheduler: str, trials: int = 2,
     return best_summary, best_wall
 
 
-def phold_rung() -> None:
+def _kern_rung_block(manager, runner):
+    """Per-rung device-kernel attribution (ISSUE 15): the per-stage
+    occupancy + attributed us/host/round table from the run's
+    KernChannel, with the fires-vs-micro_iters conservation verdict.
+    Returns (block dict, conserved bool) — a rung whose kernel
+    channel fails conservation REFUSES to contribute to the
+    crossover fit."""
+    from shadow_tpu.trace.events import FAM_PHOLD
+    from shadow_tpu.trace.kernstat import (DISPATCH_KEYS, attribution,
+                                           check_conservation,
+                                           family_totals,
+                                           family_warm_wall_s)
+    if manager.kern is None:
+        return None, True
+    ks = manager.kern.to_bytes()
+    key = DISPATCH_KEYS[FAM_PHOLD]
+    dispatch = {
+        f"device_span_{key}": {
+            "micro_iters": getattr(runner, "micro_iters", 0),
+            "dispatch_wall_s": getattr(runner, "device_wall_ns", 0)
+            / 1e9,
+        },
+        "fn_cache": {key: {
+            "build_wall_s": getattr(runner, "fn_cache_build_ns", 0)
+            / 1e9,
+        }},
+    }
+    ok, problems = check_conservation(ks, dispatch,
+                                      manager.kern.dropped)
+    ent = family_totals(ks).get(FAM_PHOLD)
+    if ent is None:
+        return {"conservation": "no-records"}, False
+    # Attribute the WARM wall (build wall subtracted) — the same
+    # family_warm_wall_s rule `trace kern` renders, so the headline
+    # JSON and the CLI agree on the identical artifact.
+    att = attribution(ent, family_warm_wall_s(dispatch, FAM_PHOLD))
+    block = {
+        "conservation": "ok" if ok else
+        f"VIOLATED: {problems[0] if problems else '?'}",
+        "spans": ent["spans"],
+        "micro_iters": ent["trips"],
+        "occupancy_permille": {s: row["occupancy_permille"]
+                               for s, row in att.items()},
+        "us_per_host_round": {s: row["us_per_host_round"]
+                              for s, row in att.items()},
+    }
+    return block, ok
+
+
+def phold_rung() -> dict:
     """PHOLD scaling ladder (1k/8k/64k LPs): the device-resident
     multi-round loop (ops/phold_span.py, fused dispatch + donated
     resident carries) vs the C++ span path at every scale, with the
     per-dispatch floor, per-round walls, residency hit rate, and a
     rounds-per-dispatch x host-count crossover estimate — the
-    device-vs-engine routing question as a modelled number."""
+    device-vs-engine routing question as a modelled number.  Forced
+    runs carry the device-kernel observatory (ISSUE 15): every
+    recorded rung gets the per-stage occupancy + attributed
+    us/host/round breakdown next to its wall, the crossover fit gets
+    the attribution next to the fitted slope, and a rung whose kernel
+    channel fails the fires-vs-micro_iters conservation check is
+    REFUSED (recorded as such, excluded from the fit).  Returns the
+    headline-JSON fragment."""
     from shadow_tpu.core.config import ConfigOptions
     from shadow_tpu.core.manager import Manager
     from shadow_tpu.tools.netgen import phold_yaml
@@ -321,7 +377,12 @@ def phold_rung() -> None:
                           stop_time=stop, seed=13, scheduler="tpu",
                           device_spans=device_spans,
                           peers_per_host=peers)
-        manager = Manager(ConfigOptions.from_yaml_text(text))
+        cfg = ConfigOptions.from_yaml_text(text)
+        if device_spans == "force":
+            # Device-kernel observatory on every forced rung: the
+            # per-stage breakdown is the rung's attribution record.
+            cfg.experimental.kernel_observatory = "on"
+        manager = Manager(cfg)
         if device_spans == "force" and caps:
             runner = manager.make_dev_span_runner()
             for k, v in caps.items():
@@ -355,6 +416,8 @@ def phold_rung() -> None:
         ("8k", 8192, "0.3s", 1, 50_000_000, 64, None, False),
         ("64k", 65536, "0.15s", 1, 20_000_000, 16, ring_caps, True),
     ]
+    frag: dict = {"rungs": {}}
+    refused = False
     rows = []
     for tag, n, stop, n_init, mean, peers, caps, fit in ladder:
         # comparator pinned to the engine path: "auto" could probe
@@ -387,8 +450,30 @@ def phold_rung() -> None:
             continue
         dev_round_ms = 1e3 * w / max(r.rounds, 1)
         cpp_round_ms = 1e3 * w_cpp / max(s_cpp.rounds, 1)
+        kern_block, conserved = _kern_rung_block(m, r)
+        if not conserved:
+            # The kernel channel's conservation check failed: refuse
+            # to record this rung in the fit (the refusal IS the
+            # record) and fail the rung set.
+            refused = True
+            print(f"bench[phold-{tag}]: REFUSED — kernel-channel "
+                  f"conservation failed "
+                  f"({(kern_block or {}).get('conservation')})",
+                  file=sys.stderr)
+            frag["rungs"][tag] = {"outcome": "refused-conservation",
+                                  "kern": kern_block}
+            continue
         if fit:
             rows.append((n, dev_round_ms, cpp_round_ms))
+        frag["rungs"][tag] = {
+            "hosts": n,
+            "dev_ms_per_round": round(dev_round_ms, 3),
+            "cpp_ms_per_round": round(cpp_round_ms, 3),
+            "device_rounds": r.rounds,
+            "warm_wall_s": round(w, 2),
+            "fit": fit,
+            "kern": kern_block,
+        }
         print(f"bench[phold-{tag}]: {s.packets_sent} messages; device "
               f"{r.rounds}/{s.rounds} rounds "
               f"({r.spans} dispatches, {r.resident_hits} resident, "
@@ -398,7 +483,16 @@ def phold_rung() -> None:
               f"{1e3 * w / r.spans:.0f} ms]; C++ span path "
               f"{s_cpp.packets_sent} msgs in {w_cpp:.1f}s "
               f"[{cpp_round_ms:.2f} ms/round]", file=sys.stderr)
+        if kern_block:
+            occ = kern_block.get("occupancy_permille", {})
+            tops = ", ".join(
+                f"{s} {v / 10:.1f}%" for s, v in sorted(
+                    occ.items(), key=lambda kv: -kv[1])[:4])
+            print(f"bench[phold-{tag}]: stage occupancy {tops}; "
+                  f"conservation {kern_block['conservation']}",
+                  file=sys.stderr)
 
+    frag["refused"] = refused
     if len(rows) >= 2:
         # Linear per-round cost model c(H) = a + b*H from the
         # shape-pinned fit rungs (identical peers/n_init/mean/caps,
@@ -411,8 +505,24 @@ def phold_rung() -> None:
         b_cpp = (c1 - c0) / (h1 - h0)
         a_dev = d0 - b_dev * h0
         a_cpp = c0 - b_cpp * h0
+        # The attributed per-stage breakdown of the LARGEST fit rung
+        # sits next to the fitted slope in the headline JSON: the
+        # overlap/pallas work (ROADMAP item 3) gets a before/after
+        # per stage, not just one number.
+        big = next((frag["rungs"][t] for t in ("64k", "1k-ring")
+                    if t in frag["rungs"]
+                    and frag["rungs"][t].get("hosts") == h1), None)
+        frag["crossover"] = {
+            "dev_us_per_host": round(1e3 * b_dev, 3),
+            "cpp_us_per_host": round(1e3 * b_cpp, 3),
+            "dev_floor_ms": round(a_dev, 3),
+            "cpp_floor_ms": round(a_cpp, 3),
+            "stage_us_per_host_round": (big or {}).get(
+                "kern", {}).get("us_per_host_round", {}),
+        }
         if b_dev < b_cpp:
             hx = (a_dev - a_cpp) / (b_cpp - b_dev)
+            frag["crossover"]["modelled_crossover_hosts"] = round(hx)
             print(f"bench[phold-crossover]: device per-round slope "
                   f"{1e3 * b_dev:.2f} us/host vs C++ "
                   f"{1e3 * b_cpp:.2f} us/host -> modelled crossover "
@@ -435,10 +545,12 @@ def phold_rung() -> None:
         from test_phold_span import mesh_cfg
     except ImportError as e:
         print(f"bench[mesh-dev]: skipped ({e})", file=sys.stderr)
-        return
+        return frag
     def run_mesh():
         t0 = time.perf_counter()
-        mgr = Manager(mesh_cfg("tpu", n=24, device_spans="force"))
+        cfg = mesh_cfg("tpu", n=24, device_spans="force")
+        cfg.experimental.kernel_observatory = "on"
+        mgr = Manager(cfg)
         for h in mgr.hosts:
             h.set_tracing(False)
         sm = mgr.run()
@@ -451,11 +563,28 @@ def phold_rung() -> None:
     w = w_warm
     r = mgr._dev_span
     share = 100.0 * r.rounds / max(sm.rounds, 1)
+    kern_block, conserved = _kern_rung_block(mgr, r)
+    if not conserved:
+        frag["refused"] = True
+        frag["rungs"]["mesh-dev"] = {
+            "outcome": "refused-conservation", "kern": kern_block}
+        print(f"bench[mesh-dev]: REFUSED — kernel-channel "
+              f"conservation failed "
+              f"({(kern_block or {}).get('conservation')})",
+              file=sys.stderr)
+        return frag
+    frag["rungs"]["mesh-dev"] = {
+        "hosts": 24,
+        "device_rounds": r.rounds,
+        "warm_wall_s": round(w, 2),
+        "kern": kern_block,
+    }
     print(f"bench[mesh-dev]: 24-host udp-mesh, {sm.packets_sent} "
           f"packets; device multi-round {r.rounds}/{sm.rounds} rounds "
           f"on device ({share:.0f}%, {r.spans} dispatches, "
           f"{r.resident_hits} resident, aborts {r.aborts}) in "
           f"{w:.1f}s warm / {w_cold:.1f}s cold", file=sys.stderr)
+    return frag
 
 
 def tcp_dev_rung() -> None:
@@ -1707,6 +1836,19 @@ def main() -> None:
         print(f"bench[resume-10k]: failed: {e}", file=sys.stderr)
         resume_10k = None
 
+    # Device-span crossover ladder (ISSUE 15): the shape-pinned
+    # 1k-ring/8k/64k + mesh-dev rungs with the device-kernel
+    # observatory on — per-stage occupancy and attributed
+    # us/host/round recorded next to the fitted slope in the headline
+    # JSON.  A rung whose kernel channel fails the
+    # fires-vs-micro_iters conservation check refuses to record and
+    # fails the exit code below.
+    try:
+        phold_ladder = phold_rung()
+    except Exception as e:  # noqa: BLE001 — never cost the headline
+        print(f"bench[phold-ladder]: failed: {e}", file=sys.stderr)
+        phold_ladder = None
+
     # Sharded rungs (ISSUE 11): the 1/2/4/8 shard-count scaling curve
     # for the 10k rung, the STANDING sharded 100k rung, the leaf-spine
     # rack rung and the 1M-host stretch — each in its own subprocess
@@ -1853,6 +1995,11 @@ def main() -> None:
         # 10k rung's first half — recorded ONLY when the resumed run
         # is byte-identical to the straight run.
         "resume_10k": resume_10k,
+        # Device-kernel observatory (ISSUE 15): the crossover ladder
+        # with per-stage occupancy + attributed us/host/round per
+        # rung, the fitted slopes, and the attribution of the
+        # largest fit rung next to them — conservation-gated.
+        "phold_ladder": phold_ladder,
     }), flush=True)
 
     # Auxiliary rungs (stderr only).  A failure must not cost the
@@ -1877,8 +2024,12 @@ def main() -> None:
                        ("leaf_spine_sharded", leaf_spine_sharded)):
         if sharded_bad(frag):
             failed.append(name)
-    for rung in (phold_rung,      # ISSUE 3: fused device ladder
-                 mixed_pcap_rung,  # ISSUE 3: all-plane cliff lifted
+    # The crossover ladder now records in the headline JSON (ISSUE
+    # 15); a kernel-channel conservation refusal fails the exit code
+    # like the sharded identity gates.
+    if phold_ladder is None or phold_ladder.get("refused"):
+        failed.append("phold_ladder")
+    for rung in (mixed_pcap_rung,  # ISSUE 3: all-plane cliff lifted
                  tcp_dev_rung):   # ISSUE 1: TCP device-span family
         # (managed_rung moved ahead of the headline JSON — its
         # syscalls_per_sec/disposition/IPC numbers are recorded there.)

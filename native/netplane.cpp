@@ -377,6 +377,46 @@ struct FctRec {
 static_assert(sizeof(FctRec) == FCT_REC_BYTES,
               "flow record layout drifted from trace/events.py");
 
+/* Device-kernel observatory (trace/events.py KS_* / trace/kernstat.py
+ * are the Python twins; docs/OBSERVABILITY.md "Device-kernel
+ * observatory").  The stages execute in the JAX span kernels
+ * (ops/phold_span.py, ops/tcp_span.py), not here — the enum lives in
+ * the engine because this is the fail-closed registry analysis pass 1
+ * scans: a stage added to a kernel without a registered twin, a
+ * drifted value, or a reordered KS_NAMES table fails `scripts/lint`.
+ * The engine itself never emits KS records (and nothing here bumps
+ * state_epoch, so span residency survives the observatory). */
+constexpr int KS_POP = 0;        /* arrival/timer event pop */
+constexpr int KS_STEP = 1;       /* app stepper */
+constexpr int KS_CODEL = 2;      /* router-inbound CoDel drain (r2) */
+constexpr int KS_ON_PACKET = 3;  /* TCP on_packet (tcp family) */
+constexpr int KS_REASM = 4;      /* TCP reassembly drain */
+constexpr int KS_ACK = 5;        /* TCP ack_data decision */
+constexpr int KS_PUSH = 6;       /* TCP push_data segmentation */
+constexpr int KS_FLUSH = 7;      /* TCP flush notify decision */
+constexpr int KS_INET_OUT = 8;   /* inet-out relay drain (r1) */
+constexpr int KS_ARM = 9;        /* timer-arm / status tail */
+constexpr int KS_TIMERS = 10;    /* timer handling */
+constexpr int KS_EXCHANGE = 11;  /* sharded cross-shard staging hop */
+constexpr int KS_N = 12;
+constexpr int KS_REC_BYTES = 224; /* trace/events.py KS_REC "<qiiqq24q" */
+
+/* Order mirrors the KS_* enum (and trace/events.py KS_NAMES). */
+[[maybe_unused]] static const char *KS_NAMES[KS_N] = {
+    "pop",
+    "step",
+    "codel",
+    "on-packet",
+    "reassembly",
+    "ack",
+    "push",
+    "flush",
+    "inet-out",
+    "arm",
+    "timers",
+    "exchange",
+};
+
 /* engine -> Python callback kinds */
 constexpr int CB_STATUS = 0;       // (tok, set_mask, clear_mask)
 constexpr int CB_CHILD_BORN = 1;   // (listener_tok, child_tok)

@@ -24,7 +24,8 @@ Rules (catalogue + rationale in docs/LINT.md):
                  required (host math on traced values breaks tracing
                  or silently constant-folds)
   sim-channel    wall-clock reads inside a sim-time trace channel
-                 (SimChannel in trace/recorder, NetstatChannel in
+                 (SimChannel in trace/recorder, KernChannel in
+                 trace/kernstat, NetstatChannel in
                  trace/netstat, SyscallChannel/HostSyscallLog in
                  trace/sctrace): the channels are DEFINED to be
                  byte-identical across runs, so this rule has NO
@@ -278,7 +279,9 @@ class _ModuleLinter:
     def lint_sim_channel(self):
         """Any wall-clock read inside a sim-time channel class body
         (`SimChannel`, the flight recorder's event stream;
-        `NetstatChannel`, the sim-netstat telemetry stream; or
+        `NetstatChannel`, the sim-netstat telemetry stream;
+        `FabricChannel`/`KernChannel`, the fabric and device-kernel
+        observatories; or
         `SyscallChannel`/`HostSyscallLog`, the syscall observatory's
         record stream) is a violation with NO pragma escape: the
         channels' byte-identity contracts (docs/OBSERVABILITY.md)
@@ -288,6 +291,7 @@ class _ModuleLinter:
                     if isinstance(cls, ast.ClassDef)
                     and cls.name in ("SimChannel", "NetstatChannel",
                                      "FabricChannel",
+                                     "KernChannel",
                                      "FixedRecordChannel",
                                      "SyscallChannel",
                                      "HostSyscallLog")]

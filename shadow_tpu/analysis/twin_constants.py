@@ -249,6 +249,36 @@ CONTRACTS = [
     ("FCT_F_COMPLETE", [(_TREV, "FCT_F_COMPLETE")]),
     ("FCT_F_RECEIVER", [(_TREV, "FCT_F_RECEIVER")]),
     ("FCT_REC_BYTES", [(_TREV, "FCT_REC_BYTES")]),
+    # Device-kernel observatory stage slots (docs/OBSERVABILITY.md
+    # "Device-kernel observatory"): the stages execute in the JAX span
+    # kernels, but netplane.cpp is the fail-closed registry — a stage
+    # slot drifting between trace/events.py and either kernel would
+    # silently mis-attribute every occupancy table, so the KS_ prefix
+    # is fail-closed like FR_*/EL_*/TEL_*.  Per-kernel rows list only
+    # the stages that family occupies (the phold family has no TCP
+    # pipeline stages).
+    ("KS_POP", [(_TREV, "KS_POP"), (_TCPS, "KS_POP"),
+                (_PHLD, "KS_POP")]),
+    ("KS_STEP", [(_TREV, "KS_STEP"), (_TCPS, "KS_STEP"),
+                 (_PHLD, "KS_STEP")]),
+    ("KS_CODEL", [(_TREV, "KS_CODEL"), (_TCPS, "KS_CODEL"),
+                  (_PHLD, "KS_CODEL")]),
+    ("KS_ON_PACKET", [(_TREV, "KS_ON_PACKET"),
+                      (_TCPS, "KS_ON_PACKET")]),
+    ("KS_REASM", [(_TREV, "KS_REASM"), (_TCPS, "KS_REASM")]),
+    ("KS_ACK", [(_TREV, "KS_ACK"), (_TCPS, "KS_ACK")]),
+    ("KS_PUSH", [(_TREV, "KS_PUSH"), (_TCPS, "KS_PUSH")]),
+    ("KS_FLUSH", [(_TREV, "KS_FLUSH"), (_TCPS, "KS_FLUSH")]),
+    ("KS_INET_OUT", [(_TREV, "KS_INET_OUT"), (_TCPS, "KS_INET_OUT"),
+                     (_PHLD, "KS_INET_OUT")]),
+    ("KS_ARM", [(_TREV, "KS_ARM"), (_TCPS, "KS_ARM"),
+                (_PHLD, "KS_ARM")]),
+    ("KS_TIMERS", [(_TREV, "KS_TIMERS"), (_TCPS, "KS_TIMERS"),
+                   (_PHLD, "KS_TIMERS")]),
+    ("KS_EXCHANGE", [(_TREV, "KS_EXCHANGE"), (_TCPS, "KS_EXCHANGE"),
+                     (_PHLD, "KS_EXCHANGE")]),
+    ("KS_N", [(_TREV, "KS_N"), (_TCPS, "KS_N"), (_PHLD, "KS_N")]),
+    ("KS_REC_BYTES", [(_TREV, "KS_REC_BYTES")]),
     # Checkpoint plane-blob framing (shadow_tpu/ckpt/format.py is the
     # Python twin — it parses the engine's plane blob for `ckpt info`
     # / `ckpt diff`, so a silently drifted header would misparse every
@@ -266,7 +296,7 @@ CONTRACTS = [
 # flight-record layout or the drop-cause table without updating
 # trace/events.py fails closed.
 TRACE_ENUM_PREFIXES = ("FR_", "EL_", "TEL_", "FB_", "FCT_", "CK_",
-                       "MARK_", "DCTCP_", "ECN_", "CC_")
+                       "MARK_", "DCTCP_", "ECN_", "CC_", "KS_")
 
 # Shim-side contracts (native/shim.c — the syscall observatory's SC_*
 # disposition enum, its record-size pin, and the IPC-layout offset of
@@ -523,6 +553,31 @@ def check(repo_root: str, cpp_text: str | None = None,
                 "twin-constant", CPP,
                 f"MARK_NAMES has {len(mark_names[0])} entries but "
                 f"MARK_N = {n}"))
+
+    # KS_NAMES: the kernel-stage string table must mirror the KS_*
+    # enum order on BOTH sides (`trace kern`, the Chrome export and
+    # bench's crossover attribution render through it).
+    ks_names = strings.get("KS_NAMES", [])
+    py_ks = py_consts(_TREV).get("KS_NAMES")
+    if not ks_names:
+        violations.append(Violation(
+            "twin-constant", CPP, "C++ KS_NAMES table not found"))
+    elif py_ks is None:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            "missing KS_NAMES twin for the C++ stage table"))
+    elif tuple(py_ks) != ks_names[0]:
+        violations.append(Violation(
+            "twin-constant", _TREV,
+            f"KS_NAMES = {tuple(py_ks)} but C++ KS_NAMES = "
+            f"{ks_names[0]}"))
+    else:
+        n = consts.get("KS_N")
+        if n is not None and len(ks_names[0]) != n:
+            violations.append(Violation(
+                "twin-constant", CPP,
+                f"KS_NAMES has {len(ks_names[0])} entries but "
+                f"KS_N = {n}"))
 
     # ASYS_NAMES order must mirror the ASYS_* enum
     asys_names = strings.get("ASYS_NAMES", [])

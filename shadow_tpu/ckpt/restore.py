@@ -101,6 +101,9 @@ def _restore_trace(manager, tr: dict) -> None:
     if "fabric" in tr:
         _load_channel(channel_or_raise(manager.fabric, "fabric"),
                       tr["fabric"])
+    if "kern" in tr:
+        _load_channel(channel_or_raise(manager.kern, "device-kernel"),
+                      tr["kern"])
     if "sctrace" in tr:
         sct = manager.sctrace
         chan = sct.channel if sct is not None else None

@@ -102,7 +102,7 @@ def _trace_state(manager) -> dict:
     if flight is not None and flight.sim is not None:
         s = flight.sim
         out["flight_sim"] = (s.to_bytes(), s.records, s.dropped)
-    for name in ("netstat", "fabric"):
+    for name in ("netstat", "fabric", "kern"):
         ch = getattr(manager, name)
         if ch is not None:
             out[name] = (ch.to_bytes(), ch.records, ch.dropped)
@@ -204,6 +204,8 @@ def write_snapshot(manager, summary, next_start: int, path: str,
                 manager.config.experimental.sim_fabricstat,
             "syscall_observatory":
                 manager.config.experimental.syscall_observatory,
+            "kernel_observatory":
+                manager.config.experimental.kernel_observatory,
         },
     }
     sections[ck.CK_SEC_META] = json.dumps(meta, sort_keys=True).encode()

@@ -247,6 +247,21 @@ class ExperimentalConfig:
     # (metrics.sim.syscalls.dispositions) run regardless — cheap
     # integer adds, like drop attribution.
     syscall_observatory: str = "off"
+    # Device-kernel observatory (docs/OBSERVABILITY.md "Device-kernel
+    # observatory"): "on" records the FIFTH deterministic sim-time
+    # channel (kernel-sim.bin: one KS_REC per committed device span —
+    # per-micro-op-stage fire counts and active-lane sums threaded
+    # through both span kernels' while_loop carries; occupancy =
+    # lanes / (hosts x trips), trips reconcile exactly against the
+    # dispatch split's micro_iters) AND the wall-side dispatch
+    # attribution; "wall" records the wall side only: explicit
+    # _FN_CACHE hit/miss/build-wall accounting, per-kernel
+    # Compiled.cost_analysis() flops/bytes via the AOT dispatch path,
+    # export/import codec byte volume and the speculative-window
+    # rollback ledger (metrics.wall.dispatch.*).  "off" records
+    # neither; the fn_cache/rollback counters still accumulate (cheap
+    # integer adds) and surface in metrics.wall.dispatch.
+    kernel_observatory: str = "off"
     # Syscall service plane (docs/OBSERVABILITY.md "Syscall service
     # plane", ROADMAP item 2): per conservative round, every managed
     # host's due servicing work is drained by a host-affine worker
@@ -392,6 +407,7 @@ class ConfigOptions:
                 "fabricstat_interval": _ns(e.fabricstat_interval_ns),
                 "chrome_top_n": e.chrome_top_n,
                 "syscall_observatory": e.syscall_observatory,
+                "kernel_observatory": e.kernel_observatory,
                 "syscall_service_plane": e.syscall_service_plane,
                 "managed_death_poll": _ns(e.managed_death_poll_ns),
                 "managed_watchdog": _ns(e.managed_watchdog_ns),
@@ -570,6 +586,9 @@ class ConfigOptions:
                 ("syscall_observatory", "syscall_observatory",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
+                ("kernel_observatory", "kernel_observatory",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
                 ("syscall_service_plane", "syscall_service_plane",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
@@ -614,6 +633,11 @@ class ConfigOptions:
             raise ValueError(
                 f"unknown syscall_observatory "
                 f"{experimental.syscall_observatory!r}; expected one of "
+                f"('off', 'wall', 'on')")
+        if experimental.kernel_observatory not in ("off", "wall", "on"):
+            raise ValueError(
+                f"unknown kernel_observatory "
+                f"{experimental.kernel_observatory!r}; expected one of "
                 f"('off', 'wall', 'on')")
         if experimental.syscall_service_plane not in ("off", "auto",
                                                       "on"):

@@ -585,6 +585,16 @@ class Manager:
                     1,
                     max(int(config.experimental.fabricstat_interval_ns),
                         1))
+        # Device-kernel observatory (trace/kernstat.py,
+        # docs/OBSERVABILITY.md "Device-kernel observatory"): "on"
+        # records the per-committed-span stage-counter channel
+        # (kernel-sim.bin); "wall"/"on" enable the wall-side dispatch
+        # attribution in the span runners (fn-cache accounting, AOT
+        # cost_analysis, codec byte volume, rollback ledger).
+        self.kern = None
+        if config.experimental.kernel_observatory == "on":
+            from shadow_tpu.trace.kernstat import KernChannel
+            self.kern = KernChannel()
         # Syscall observatory (trace/sctrace.py, docs/OBSERVABILITY.md
         # "syscall observatory"): SC_* disposition counters are ALWAYS
         # on (Host.sc_disp integer adds, like drop attribution); the
@@ -1725,6 +1735,14 @@ class Manager:
             # Both families buffer per-round queue samples in the
             # kernel and append them at span commit.
             runner.fabric = self.fabric
+        if self.kern is not None:
+            # Both families thread per-stage fire/lane counters
+            # through the while_loop carry and record one KS_REC per
+            # committed span.
+            runner.kern = self.kern
+        if self.config.experimental.kernel_observatory in ("wall",
+                                                           "on"):
+            runner.kern_wall = True
         return runner
 
     def make_dev_span_runner(self):
@@ -1952,6 +1970,7 @@ class Manager:
             dispatch["packets_overflowed"] = prop.packets_overflowed
             dispatch["exchange_wall_s"] = round(
                 getattr(prop, "exchange_wall_ns", 0) / 1e9, 6)
+        fn_cache = {}
         for family, runner in (("phold", getattr(self, "_dev_span",
                                                  None)),
                                ("tcp", getattr(self, "_dev_span_tcp",
@@ -1975,7 +1994,44 @@ class Manager:
                                             0),
                     "exchange_grows": getattr(runner, "exch_grows",
                                               0),
+                    # Device-kernel observatory wall side (ISSUE 15):
+                    # dispatch wall, the speculative-window rollback
+                    # ledger (aborted dispatch wall + forced
+                    # re-exports + stepped-then-discarded rounds, by
+                    # abort kind) and the codec byte volume per
+                    # direction.  All wall-channel: the det gate
+                    # strips them structurally.
+                    "dispatch_wall_s": round(
+                        getattr(runner, "device_wall_ns", 0) / 1e9, 6),
+                    "rolled_back_rounds": getattr(
+                        runner, "rolled_back_rounds", 0),
+                    "rollback_wall_s": round(
+                        getattr(runner, "rollback_wall_ns", 0) / 1e9,
+                        6),
+                    "rollback_reexport_wall_s": round(
+                        getattr(runner, "rollback_reexport_ns", 0)
+                        / 1e9, 6),
+                    "abort_kinds": dict(runner.abort_kind_counts()),
+                    "export_bytes": getattr(runner, "export_bytes", 0),
+                    "import_bytes": getattr(runner, "import_bytes", 0),
                 }
+                if getattr(runner, "kernel_costs", None):
+                    # Compiled.cost_analysis() per AOT-built kernel
+                    # (kernel_observatory wall/on, unsharded).
+                    dispatch[f"device_span_{family}"][
+                        "kernel_costs"] = list(runner.kernel_costs)
+                fn_cache[family] = {
+                    "hits": getattr(runner, "fn_cache_hits", 0),
+                    "misses": getattr(runner, "fn_cache_misses", 0),
+                    "build_wall_s": round(
+                        getattr(runner, "fn_cache_build_ns", 0) / 1e9,
+                        6),
+                }
+        if fn_cache:
+            # Explicit _FN_CACHE accounting (was the _timed_fns
+            # compile-vs-execute heuristic): hits/misses/build wall
+            # per span family, shared via ops/span_mesh.py.
+            dispatch["fn_cache"] = fn_cache
         reg = self.metrics
         reg.ingest("dispatch", dispatch, channel="wall")
         if self.svc is not None:
@@ -2013,6 +2069,17 @@ class Manager:
             fct_rows = self.collect_fct_rows()
             reg.gauge("fabric.flows", channel="sim").set(len(fct_rows))
             self.fabric.write(base, fct_rows)
+        # Device-kernel observatory: one KS_REC per committed device
+        # span; record/drop counts live in the SIM channel (the gate
+        # byte-diffs them) and the artifact is byte-diffed like every
+        # sim channel.  A run with no device spans writes an empty
+        # artifact — scheduler-identical by construction.
+        if self.kern is not None:
+            reg.gauge("kern.records", channel="sim").set(
+                self.kern.records)
+            reg.gauge("kern.dropped", channel="sim").set(
+                self.kern.dropped)
+            self.kern.write(base)
         # Syscall observatory: disposition counters are always on and
         # live in the SIM channel (deterministic per config; the gate
         # byte-diffs them — engine-resident apps dispatch C++-side and

@@ -31,6 +31,8 @@ machine with no data-dependent Python control flow inside jit.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from shadow_tpu.core.rng import STREAM_PACKET_LOSS, mix_key, threefry2x32_jax
@@ -101,6 +103,21 @@ FB_ACT_TB_OUT = 2
 FB_ACT_TB_IN = 4
 FB_ACT_LINK = 8
 
+# Device-kernel observatory stage slots this family occupies
+# (netplane.cpp KS_* twins, registered fail-closed in analysis
+# pass 1; docs/OBSERVABILITY.md "Device-kernel observatory").  The
+# kernel threads a (KS_N,) fire-count and active-lane-sum pair
+# through the while_loop carry; the driver packs one KS_REC per
+# committed span.
+KS_POP = 0
+KS_STEP = 1
+KS_CODEL = 2
+KS_INET_OUT = 8
+KS_ARM = 9
+KS_TIMERS = 10
+KS_EXCHANGE = 11
+KS_N = 12
+
 PK_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
 
 # Abort reason bits: trace/outbox overflows are capacity problems the
@@ -109,10 +126,10 @@ PK_KEYS = ("srchost", "pseq", "sip", "sport", "dip", "dport")
 # AB_EXCH: the sharded cross-shard exchange overflowed its per-shard
 # capacity — attributed (EL_ENGINE_EXCHANGE when spans fall back) and
 # grown like the other capacity bits, never silently truncated.
-AB_TRACE = 1
-AB_OUT = 2
-AB_STRUCT = 4
-AB_EXCH = 8
+# The values are ops/span_mesh.py's canonical set (one definition for
+# both families — the mixin's abort-kind classifier depends on it).
+from shadow_tpu.ops.span_mesh import (AB_EXCH, AB_OUT,  # noqa: E402
+                                      AB_STRUCT, AB_TRACE)
 
 
 # Compiled step cache: repeated sims of the same shape (bench trials,
@@ -456,11 +473,9 @@ class PholdSpanRunner(SpanMeshMixin):
         key = (self._H, P, self._lat.shape, self.CAP_I, self.CAP_T,
                self.CAP_R, self.CAP_S, self.CAP_C, self.cap_out,
                self.cap_tr, self.tracing, self.family, self.fused,
-               self._fabric_params(), self.mesh, self.exchange_cap)
-        fn = _FN_CACHE.get(key)
-        if fn is None:
-            fn = _FN_CACHE[key] = self._build(P)
-        return fn
+               self._fabric_params(), self.kern is not None,
+               self.mesh, self.exchange_cap)
+        return self._cache_fn(_FN_CACHE, key, lambda: self._build(P))
 
     def _build(self, P: int):
         import jax
@@ -479,6 +494,7 @@ class PholdSpanRunner(SpanMeshMixin):
                     if n_shards > 1 else None)
         fabric, fab_iv = self._fabric_params()
         FABR = self.FAB_ROWS
+        kern = self.kern is not None  # static: stage counters on
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)  # mode="drop" sink for masked-out lanes
 
@@ -492,6 +508,35 @@ class PholdSpanRunner(SpanMeshMixin):
             st["abort_code"] = st["abort_code"] | jnp.where(
                 cond, jnp.int32(bit), jnp.int32(0))
             return st
+
+        def ks_count(st, code, mask):
+            """Device-kernel observatory: credit one stage with this
+            iteration's active lanes (fires += any-lane, lanes +=
+            popcount).  Pure counters in the carry — never touches
+            simulation state, so the forced-device differentials hold
+            with the observatory on."""
+            if not kern:
+                return st
+            st = dict(st)
+            n = mask.sum().astype(jnp.int64)
+            st["ks_lanes"] = st["ks_lanes"].at[code].add(n)
+            st["ks_fires"] = st["ks_fires"].at[code].add(
+                (n > 0).astype(jnp.int64))
+            return st
+
+        def ks_count_pop(st, mask, window_end):
+            """The pop stage's counters, split from op_pop_event's own
+            law (same ib-vs-timer pick rule): all due lanes fire the
+            pop stage; timer pops additionally fire `timers` — this
+            family handles them inline in the pop micro-op."""
+            if not kern:
+                return st
+            ib_t, th_t = next_event_time(st)
+            due = mask & (jnp.minimum(ib_t, th_t) < window_end)
+            pick_ib = jnp.where(ib_t != th_t, ib_t < th_t,
+                                ib_t < I64_MAX)
+            st = ks_count(st, KS_POP, due)
+            return ks_count(st, KS_TIMERS, due & ~pick_ib)
 
         def th_push(st, mask, time, seq, kind, tgt):
             free = jnp.argmin(st["th_valid"], axis=1)
@@ -1351,26 +1396,33 @@ class PholdSpanRunner(SpanMeshMixin):
                 # when no host sits in that continuation (the common
                 # case — chains concentrate activity in 2-3 stages per
                 # iteration).
-                def guard(st, mask, fn):
+                def guard(st, mask, fn, code=None):
+                    st = ks_count(st, code, mask) \
+                        if code is not None else st
                     return jax.lax.cond(mask.any(), fn,
                                         lambda s, _m: s, st, mask)
 
+                st = ks_count_pop(st, st["cont"] == C_IDLE,
+                                  window_end)
                 st = op_pop_event(st, st["cont"] == C_IDLE, window_end)
                 st = guard(st, st["cont"] == C_M_STEP,
-                           lambda s, m: op_step(s, m, False))
+                           lambda s, m: op_step(s, m, False), KS_STEP)
                 st = guard(st, st["cont"] == C_S_STEP,
-                           lambda s, m: op_step(s, m, True))
+                           lambda s, m: op_step(s, m, True), KS_STEP)
                 # Two relay passes per iteration: the second pass lets
                 # a drain that just emptied its source take the
                 # exhausted-exit in the same iteration (streaming
                 # senders then sustain one datagram per iteration).
                 for _ in range(2):
                     st = guard(st, st["cont"] == C_R1,
-                               lambda s, m: op_relay(s, 1, m))
+                               lambda s, m: op_relay(s, 1, m),
+                               KS_INET_OUT)
                     st = guard(st, st["cont"] == C_R2,
-                               lambda s, m: op_relay(s, 2, m))
+                               lambda s, m: op_relay(s, 2, m),
+                               KS_CODEL)
                 st = guard(st, (st["cont"] == C_M_RECV)
-                           | (st["cont"] == C_S_POST), op_stage2)
+                           | (st["cont"] == C_S_POST), op_stage2,
+                           KS_ARM)
             else:
                 # Reference (unfused) schedule: snapshot — each host
                 # advances ONE micro-op per iteration (a host another
@@ -1378,12 +1430,21 @@ class PholdSpanRunner(SpanMeshMixin):
                 # engine's one-op-at-a-time per host order.  Kept as
                 # the differential comparator for the fused path.
                 cont0 = st["cont"]
+                st = ks_count(st, KS_INET_OUT, cont0 == C_R1)
+                st = ks_count(st, KS_CODEL, cont0 == C_R2)
+                st = ks_count(st, KS_STEP, (cont0 == C_M_STEP)
+                              | (cont0 == C_S_STEP))
+                st = ks_count(st, KS_ARM, (cont0 == C_M_RECV)
+                              | (cont0 == C_S_POST))
                 st = op_relay(st, 1, cont0 == C_R1)
                 st = op_relay(st, 2, cont0 == C_R2)
                 st = op_step(st, cont0 == C_M_STEP, False)
                 st = op_step(st, cont0 == C_S_STEP, True)
                 st = op_stage2(st, (cont0 == C_M_RECV)
                                | (cont0 == C_S_POST))
+                # Counted against the state op_pop_event will actually
+                # read (earlier ops may have armed timers).
+                st = ks_count_pop(st, cont0 == C_IDLE, window_end)
                 st = op_pop_event(st, cont0 == C_IDLE, window_end)
             st = mark_abort(st, iters > (np.int64(1) << 22), AB_STRUCT)
             return st, window_end, iters + 1
@@ -1485,6 +1546,10 @@ class PholdSpanRunner(SpanMeshMixin):
                                                  I64_MAX)}
                 cols.update({kk: (new[kk], 0) for kk in PK_KEYS})
                 ex, over = stage(keep, dst // hs, cols)
+                # Observatory: the exchange is a per-ROUND stage —
+                # lanes are packets staged through the cross-shard
+                # hop, fires bounded by rounds (not trips).
+                st = ks_count(st, KS_EXCHANGE, keep)
                 st = mark_abort(st, over.any(), AB_EXCH)
                 st = dict(st)
                 d_dst, d_time = ex["dst"], ex["time"]
@@ -1662,6 +1727,11 @@ class PholdSpanRunner(SpanMeshMixin):
                              "precv", "brecv"):
                     st[f"fab_{name}"] = jnp.zeros((FABR, H),
                                                   jnp.int64)
+            if kern:
+                # Span-local stage counters (KS_REC fires/lanes) —
+                # output only, never engine state.
+                st["ks_fires"] = jnp.zeros(KS_N, jnp.int64)
+                st["ks_lanes"] = jnp.zeros(KS_N, jnp.int64)
 
             carry = (st, jnp.int64(start), jnp.int64(runahead),
                      jnp.int64(0), jnp.int64(0), jnp.int64(0),
@@ -1706,6 +1776,10 @@ class PholdSpanRunner(SpanMeshMixin):
             w.add("export", t1 - t0, t0)
         if d is None or isinstance(d, int):
             return d
+        # Codec byte volume, engine -> host (dispatch attribution).
+        self.export_bytes += sum(
+            len(v) for v in d.values()
+            if isinstance(v, (bytes, bytearray, memoryview)))
         st = self._to_arrays(d)  # also sets self.family/_pay
         # Cache the static config as committed device arrays: the
         # host->device transfer of the largest columns (peers is
@@ -1730,7 +1804,8 @@ class PholdSpanRunner(SpanMeshMixin):
         import jax.numpy as jnp
         st = {k: v for k, v in self._res_st.items()
               if k != "abort_code" and not k.startswith("tr_")
-              and not k.startswith("fab_")}
+              and not k.startswith("fab_")
+              and not k.startswith("ks_")}
         st.update(self._static_cols)
         z = np.zeros(self._H, np.int32)
         for k in ("cont", "then", "out_first", "cd_chain", "cd_sniff"):
@@ -1787,9 +1862,10 @@ class PholdSpanRunner(SpanMeshMixin):
             mr = min(mr, self.FAB_ROWS)
         w = self.wall
         for _grow in range(4):
-            t0 = w.now() if w is not None else 0
+            t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
             fresh_fn = id(self._fn) not in self._timed_fns
-            out = self._fn(
+            out = self._span_call(
+                self._fn,
                 st, self._lat, self._thr, self._node,
                 self._ips_sorted, self._ips_perm,
                 np.uint32(self._k[0]), np.uint32(self._k[1]),
@@ -1799,17 +1875,26 @@ class PholdSpanRunner(SpanMeshMixin):
              busy_end, span_iters) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
             code = int(st_np["abort_code"])
+            # The first dispatch THROUGH A GIVEN BUILT FN pays
+            # trace+XLA compile (capacity regrows rebuild the fn and
+            # recompile): credit those separately so "execute" stays
+            # the steady state (the np.asarray forced device
+            # completion).  The same split feeds the explicit
+            # fn_cache accounting (metrics.wall.dispatch.fn_cache).
+            dt = time.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+            self._timed_fns.add(id(self._fn))
+            self.device_wall_ns += dt
+            if fresh_fn:
+                self._credit_build(self._fn, dt)
             if w is not None:
-                # The first dispatch THROUGH A GIVEN BUILT FN pays
-                # trace+XLA compile (capacity regrows rebuild the fn
-                # and recompile): credit those separately so
-                # "execute" stays the steady state (the np.asarray
-                # forced device completion).
-                self._timed_fns.add(id(self._fn))
-                w.add("compile" if fresh_fn else "execute",
-                      w.now() - t0, t0)
+                w.add("compile" if fresh_fn else "execute", dt, t0)
             if code == 0:
                 break
+            # Speculative-window waste: the aborted dispatch's wall
+            # and its stepped-then-discarded rounds roll back unused.
+            self.rollback_wall_ns += dt
+            self.rolled_back_rounds += int(rounds)
+            self._note_abort_kind(code)
             if code & AB_STRUCT:
                 self.last_abort_code = code
                 # Hard abort regardless of residency (and before any
@@ -1827,7 +1912,10 @@ class PholdSpanRunner(SpanMeshMixin):
                 # fresh-dispatch convention: a capacity grow that
                 # then succeeds counts zero.
                 resident = False
+                _tr = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
                 st = self._export_state()
+                self.rollback_reexport_ns += \
+                    time.perf_counter_ns() - _tr  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
                 if st is None:
                     # structurally no longer phold-shaped
                     self.ineligible += 1
@@ -1893,16 +1981,29 @@ class PholdSpanRunner(SpanMeshMixin):
                     np.int32).tobytes(),
             }
         t0 = w.now() if w is not None else 0
-        # fab_* sample buffers are span-local output, not engine state.
+        # fab_*/ks_* sample buffers are span-local output, not engine
+        # state.
         back = self._from_arrays(
             {k: v for k, v in st_np.items()
-             if not k.startswith("fab_")})
+             if not k.startswith("fab_")
+             and not k.startswith("ks_")})
+        # Codec byte volume, host -> engine (dispatch attribution).
+        self.import_bytes += sum(
+            len(v) for v in back.values()
+            if isinstance(v, (bytes, bytearray, memoryview)))
         self.engine.span_import_phold(
             back, self.CAP_I, self.CAP_T, self.CAP_R, self.CAP_S,
             self.CAP_C, self.CAP_P, traces)
         if self.fabric is not None:
             from shadow_tpu.trace.fabricstat import emit_device_rows
             emit_device_rows(self.fabric, st_np, self._H)
+        if self.kern is not None:
+            # One KS_REC per committed span (aborted spans rolled
+            # back above and recorded nothing — the conservation law).
+            from shadow_tpu.trace.events import FAM_PHOLD
+            self.kern.record_span(
+                int(start), FAM_PHOLD, self._H, int(rounds),
+                int(span_iters), st_np["ks_fires"], st_np["ks_lanes"])
         if w is not None:
             w.add("import", w.now() - t0, t0)
         # The import itself bumps the epoch; record it AFTER, so the

@@ -12,8 +12,29 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 _donate_warned = False
+
+# Abort reason bits — ONE canonical set for both span families (the
+# kernels re-export these as module constants; core/manager imports
+# AB_EXCH for exchange-capacity attribution).  Trace/outbox overflows
+# are capacity problems the driver fixes by growing the buffer and
+# retrying; AB_STRUCT means the state left the modelled domain (fall
+# back to the C++ path); AB_EXCH is the sharded cross-shard exchange
+# overflowing its per-shard capacity — grown and retried, never
+# silently truncated.
+AB_TRACE = 1
+AB_OUT = 2
+AB_STRUCT = 4
+AB_EXCH = 8
+
+# AOT-compiled span executables, keyed on the _FN_CACHE entry's
+# identity (the caches never evict, so id() is stable): one XLA
+# compile per built kernel across every Manager in the process —
+# the same warm-run property as the jit call cache.  Each value is
+# (jax.stages.Compiled, cost_analysis summary dict).
+_AOT_CACHE: dict = {}
 
 
 def donation_cache_safe() -> bool:
@@ -67,6 +88,125 @@ class SpanMeshMixin:
     # XLA reuses the resident buffers in place — behind the
     # cache-safe guard above.
     donate = False
+
+    # ---- Device-kernel observatory (docs/OBSERVABILITY.md) ----------
+    # `kern` is the sim-time KernChannel (or None) the driver records
+    # one KS_REC into per committed span; `kern_wall` enables the
+    # wall-side dispatch attribution (explicit _FN_CACHE accounting,
+    # AOT cost_analysis, export/import byte volume) — both set by the
+    # manager's runner factory from experimental.kernel_observatory.
+    # The integer counters below are class attributes that become
+    # instance attributes on first `+=` (the exchange_cap pattern):
+    # they live in metrics.wall.dispatch, never in simulation bytes.
+    kern = None
+    kern_wall = False
+    fn_cache_hits = 0        # _FN_CACHE served an already-built fn
+    fn_cache_misses = 0      # a fresh kernel build (trace pending)
+    fn_cache_build_ns = 0    # wall of each missed fn's FIRST dispatch
+    #                          (where jit pays trace + XLA compile)
+    device_wall_ns = 0       # wall of every span dispatch, all fates
+    rollback_wall_ns = 0     # wall of dispatches that ABORTED (the
+    #                          speculative window rolled back unused)
+    rollback_reexport_ns = 0  # wall of re-exports an abort forced
+    rolled_back_rounds = 0   # rounds stepped then discarded by aborts
+    export_bytes = 0         # codec bytes engine -> host, cumulative
+    import_bytes = 0         # codec bytes host -> engine, cumulative
+    _aot = None              # fn ids whose cost this runner logged
+    _aot_off = False         # AOT path disabled after a failure
+    kernel_costs = None      # Compiled.cost_analysis() per built fn
+
+    def _cache_fn(self, cache: dict, key, build):
+        """THE _FN_CACHE lookup both runners use: explicit hit/miss
+        accounting instead of the old compile-vs-execute guessing
+        (`metrics.wall.dispatch.fn_cache`).  The build wall lands in
+        fn_cache_build_ns at the missed fn's first dispatch — jit
+        defers trace+compile to the call, so the insert itself is
+        free."""
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = build()
+            self.fn_cache_misses += 1
+            self.__dict__.setdefault("_built_fns", set()).add(id(fn))
+        else:
+            self.fn_cache_hits += 1
+        return fn
+
+    def _credit_build(self, fn, dt_ns: int) -> None:
+        """Credit a first dispatch's wall to fn_cache.build_wall_s
+        ONLY when this runner actually built the fn — a cache-served
+        kernel's first (warm) dispatch is not a build."""
+        if id(fn) in self.__dict__.get("_built_fns", ()):
+            self.fn_cache_build_ns += dt_ns
+
+    def abort_kind_counts(self) -> dict:
+        """Lazily-created {kind: count} of abort codes seen by this
+        runner (struct / exchange-capacity / capacity) — what `trace
+        explain` names when rollback waste dominates."""
+        d = self.__dict__.get("_abort_kinds")
+        if d is None:
+            d = self.__dict__["_abort_kinds"] = {}
+        return d
+
+    def _note_abort_kind(self, code: int) -> None:
+        """Classify one aborted dispatch as exactly ONE kind —
+        priority struct > exchange-capacity > capacity (a code can
+        carry several bits; counting per bit would make kind counts
+        exceed aborted dispatches and skew `trace explain`'s
+        dominant-abort ranking).  The AB_* bits are this module's
+        canonical constants, re-exported by both kernels."""
+        kinds = self.abort_kind_counts()
+        if code & AB_STRUCT:
+            kind = "struct"
+        elif code & AB_EXCH:
+            kind = "exchange-capacity"
+        else:
+            kind = "capacity"
+        kinds[kind] = kinds.get(kind, 0) + 1
+
+    def _span_call(self, fn, *args):
+        """Dispatch the built span fn.  Under the observatory's wall
+        mode (unsharded only — AOT lowering pins input shardings) the
+        first dispatch per built fn goes through the explicit AOT path
+        (trace -> lower -> compile), so the build wall splits into its
+        trace and XLA-compile legs and `Compiled.cost_analysis()`
+        yields real flops/bytes per cached kernel instead of a
+        heuristic.  The Compiled is cached GLOBALLY alongside the
+        _FN_CACHE entry (keyed on the cached fn's identity, which the
+        never-evicting cache pins) so a later Manager's runner reuses
+        it exactly like the jit call cache — warm runs stay warm.
+        Any AOT failure falls back to plain jit dispatch permanently —
+        attribution degrades, correctness never."""
+        if not self.kern_wall or self.mesh is not None \
+                or self._aot_off:
+            return fn(*args)
+        if self._aot is None:
+            self._aot = set()   # fn ids whose cost this runner logged
+            self.kernel_costs = []
+        ent = _AOT_CACHE.get(id(fn))
+        if ent is None:
+            try:
+                t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+                lowered = fn.lower(*args)
+                t1 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+                comp = lowered.compile()
+                t2 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+                cost = comp.cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0] if cost else {}
+                ent = _AOT_CACHE[id(fn)] = (comp, {
+                    "flops": float(cost.get("flops", 0.0)),
+                    "bytes_accessed": float(
+                        cost.get("bytes accessed", 0.0)),
+                    "trace_wall_s": round((t1 - t0) / 1e9, 3),
+                    "compile_wall_s": round((t2 - t1) / 1e9, 3),
+                })
+            except Exception:
+                self._aot_off = True
+                return fn(*args)
+        if id(fn) not in self._aot:
+            self._aot.add(id(fn))
+            self.kernel_costs.append(dict(ent[1]))
+        return ent[0](*args)
 
     def _span_jit(self, jax, run):
         """jit the span loop, donating the carry when allowed."""

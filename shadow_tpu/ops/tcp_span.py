@@ -130,6 +130,23 @@ FB_ACT_TB_OUT = 2
 FB_ACT_TB_IN = 4
 FB_ACT_LINK = 8
 
+# Device-kernel observatory stage slots this family occupies
+# (netplane.cpp KS_* twins, registered fail-closed in analysis
+# pass 1; docs/OBSERVABILITY.md "Device-kernel observatory").
+KS_POP = 0
+KS_STEP = 1
+KS_CODEL = 2
+KS_ON_PACKET = 3
+KS_REASM = 4
+KS_ACK = 5
+KS_PUSH = 6
+KS_FLUSH = 7
+KS_INET_OUT = 8
+KS_ARM = 9
+KS_TIMERS = 10
+KS_EXCHANGE = 11
+KS_N = 12
+
 # Telemetry sample fields (trace/events.py TEL_REC order after the
 # identity header) -> the SoA column each samples.
 TEL_FIELDS = (("cwnd", "c_cwnd"), ("ssthresh", "c_ssthresh"),
@@ -160,10 +177,10 @@ PK_DTYPES = {
 # Abort reason bits (phold_span twin semantics; AB_EXCH = the sharded
 # cross-shard exchange overflowed its per-shard capacity — grown and
 # retried like the other capacity bits, never silently truncated).
-AB_TRACE = 1
-AB_OUT = 2
-AB_STRUCT = 4
-AB_EXCH = 8
+# The values are ops/span_mesh.py's canonical set (one definition for
+# both families — the mixin's abort-kind classifier depends on it).
+from shadow_tpu.ops.span_mesh import (AB_EXCH, AB_OUT,  # noqa: E402
+                                      AB_STRUCT, AB_TRACE)
 
 _FN_CACHE: dict = {}
 
@@ -611,11 +628,9 @@ class TcpSpanRunner(SpanMeshMixin):
         key = (self._H, self._CC, self._caps(), self.cap_out,
                self.cap_tr, self.tracing, self.fused,
                self._netstat_params(), self._fabric_params(),
+               self.kern is not None,
                self.dctcp_k, self.mesh, self.exchange_cap)
-        fn = _FN_CACHE.get(key)
-        if fn is None:
-            fn = _FN_CACHE[key] = self._build()
-        return fn
+        return self._cache_fn(_FN_CACHE, key, self._build)
 
     def _build(self):
         import jax
@@ -635,6 +650,7 @@ class TcpSpanRunner(SpanMeshMixin):
         TELR = self.TEL_ROWS
         fabric, fab_iv = self._fabric_params()
         FABR = self.FAB_ROWS
+        kern = self.kern is not None  # static: stage counters on
         # DCTCP-K marking threshold: static closure constants (config-
         # constant per Manager; part of the _FN_CACHE key).
         k_pkts, k_bytes = self.dctcp_k
@@ -670,6 +686,29 @@ class TcpSpanRunner(SpanMeshMixin):
                 hit & (st["abort_site"] == 0), jnp.int32(site),
                 st["abort_site"])
             return st
+
+        def ks_count(st, code, mask):
+            """Device-kernel observatory (phold_span twin): credit one
+            stage with this iteration's active lanes.  Pure counters
+            in the carry — never simulation state."""
+            if not kern:
+                return st
+            st = dict(st)
+            n = mask.sum().astype(jnp.int64)
+            st["ks_lanes"] = st["ks_lanes"].at[code].add(n)
+            st["ks_fires"] = st["ks_fires"].at[code].add(
+                (n > 0).astype(jnp.int64))
+            return st
+
+        def ks_count_pop(st, mask, window_end):
+            """All due lanes fire the pop stage (timer HANDLING is
+            counted at its handler stage — op_tmr/op_app/relays run
+            in the same fused iteration)."""
+            if not kern:
+                return st
+            ib_t, th_t = next_event_time(st)
+            due = mask & (jnp.minimum(ib_t, th_t) < window_end)
+            return ks_count(st, KS_POP, due)
 
         def draw_seq(st, mask):
             v = st["event_seq"]
@@ -2035,28 +2074,48 @@ class TcpSpanRunner(SpanMeshMixin):
                 # Host.trace_lines).  Each stage is guarded by an
                 # any-lane-active cond so XLA skips the vectorized
                 # body of stages nobody occupies this iteration.
-                def guard(st, mask, fn):
+                def guard(st, mask, fn, code=None):
+                    st = ks_count(st, code, mask) \
+                        if code is not None else st
                     return jax.lax.cond(mask.any(), fn,
                                         lambda s, _m: s, st, mask)
 
+                st = ks_count_pop(st, st["cont"] == C_IDLE,
+                                  window_end)
                 st = op_pop_event(st, st["cont"] == C_IDLE, window_end)
-                st = guard(st, st["cont"] == C_TMR, op_tmr)
-                st = guard(st, st["cont"] == C_APP, op_app)
-                st = guard(st, st["cont"] == C_R2, op_relay2)
-                st = guard(st, st["cont"] == C_TCPIN, op_tcpin)
+                st = guard(st, st["cont"] == C_TMR, op_tmr, KS_TIMERS)
+                st = guard(st, st["cont"] == C_APP, op_app, KS_STEP)
+                st = guard(st, st["cont"] == C_R2, op_relay2,
+                           KS_CODEL)
+                st = guard(st, st["cont"] == C_TCPIN, op_tcpin,
+                           KS_ON_PACKET)
                 for _ in range(2):
-                    st = guard(st, st["cont"] == C_DRAIN, op_drain)
-                st = guard(st, st["cont"] == C_ACKDATA, op_ackdata)
-                st = guard(st, st["cont"] == C_PUSH, op_push)
-                st = guard(st, st["cont"] == C_FLUSH, op_flush)
+                    st = guard(st, st["cont"] == C_DRAIN, op_drain,
+                               KS_REASM)
+                st = guard(st, st["cont"] == C_ACKDATA, op_ackdata,
+                           KS_ACK)
+                st = guard(st, st["cont"] == C_PUSH, op_push, KS_PUSH)
+                st = guard(st, st["cont"] == C_FLUSH, op_flush,
+                           KS_FLUSH)
                 for _ in range(2):
-                    st = guard(st, st["cont"] == C_R1, op_relay1)
-                st = guard(st, st["cont"] == C_ARM, op_arm)
+                    st = guard(st, st["cont"] == C_R1, op_relay1,
+                               KS_INET_OUT)
+                st = guard(st, st["cont"] == C_ARM, op_arm, KS_ARM)
             else:
                 # Reference (unfused) schedule: snapshot — one
                 # micro-op per host per iteration.  Kept as the
                 # differential comparator for the fused path.
                 cont0 = st["cont"]
+                st = ks_count(st, KS_INET_OUT, cont0 == C_R1)
+                st = ks_count(st, KS_CODEL, cont0 == C_R2)
+                st = ks_count(st, KS_ON_PACKET, cont0 == C_TCPIN)
+                st = ks_count(st, KS_REASM, cont0 == C_DRAIN)
+                st = ks_count(st, KS_ACK, cont0 == C_ACKDATA)
+                st = ks_count(st, KS_PUSH, cont0 == C_PUSH)
+                st = ks_count(st, KS_FLUSH, cont0 == C_FLUSH)
+                st = ks_count(st, KS_ARM, cont0 == C_ARM)
+                st = ks_count(st, KS_STEP, cont0 == C_APP)
+                st = ks_count(st, KS_TIMERS, cont0 == C_TMR)
                 st = op_relay1(st, cont0 == C_R1)
                 st = op_relay2(st, cont0 == C_R2)
                 st = op_tcpin(st, cont0 == C_TCPIN)
@@ -2067,6 +2126,8 @@ class TcpSpanRunner(SpanMeshMixin):
                 st = op_arm(st, cont0 == C_ARM)
                 st = op_app(st, cont0 == C_APP)
                 st = op_tmr(st, cont0 == C_TMR)
+                # Counted against the state op_pop_event will read.
+                st = ks_count_pop(st, cont0 == C_IDLE, window_end)
                 st = op_pop_event(st, cont0 == C_IDLE, window_end)
             # Per-round runaway valve: a legitimate hot round is a few
             # thousand micro-iterations; a continuation-cycle bug must
@@ -2173,6 +2234,9 @@ class TcpSpanRunner(SpanMeshMixin):
                                   np.zeros((), PK_DTYPES[kk])[()])
                              for kk in PK_KEYS})
                 ex, over = stage(keep, dst // hs, cols)
+                # Observatory: exchange is a per-ROUND stage — lanes
+                # are packets staged, fires bounded by rounds.
+                st = ks_count(st, KS_EXCHANGE, keep)
                 st = mark_abort(st, over.any(), AB_EXCH, 15)
                 st = dict(st)
                 d_dst, d_time = ex["dst"], ex["time"]
@@ -2363,6 +2427,11 @@ class TcpSpanRunner(SpanMeshMixin):
                              "psent", "bsent", "precv", "brecv"):
                     st[f"fab_{name}"] = jnp.zeros((FABR, H),
                                                   jnp.int64)
+            if kern:
+                # Span-local stage counters (KS_REC fires/lanes) —
+                # output only, never engine state.
+                st["ks_fires"] = jnp.zeros(KS_N, jnp.int64)
+                st["ks_lanes"] = jnp.zeros(KS_N, jnp.int64)
             if tracing:
                 st["tr_n"] = jnp.int64(0)
                 for k, dt in (("tr_t", jnp.int64),
@@ -2418,6 +2487,10 @@ class TcpSpanRunner(SpanMeshMixin):
             w.add("export", t1 - t0, t0)
         if d is None or isinstance(d, int):
             return d
+        # Codec byte volume, engine -> host (dispatch attribution).
+        self.export_bytes += sum(
+            len(v) for v in d.values()
+            if isinstance(v, (bytes, bytearray, memoryview)))
         st = self._to_arrays(d)  # also sets self._CC
         if self.netstat is not None:
             # Telemetry identity + canonical order, captured while the
@@ -2454,7 +2527,8 @@ class TcpSpanRunner(SpanMeshMixin):
               if k not in ("abort_code", "abort_site")
               and not k.startswith("tr_")
               and not k.startswith("tel_")
-              and not k.startswith("fab_")}
+              and not k.startswith("fab_")
+              and not k.startswith("ks_")}
         st.update(self._static_cols)
         n = self._static_cols["_n_conns"]
         for k in ("cont", "then", "ret"):
@@ -2570,9 +2644,10 @@ class TcpSpanRunner(SpanMeshMixin):
             mr = min(mr, self.FAB_ROWS)  # same overflow-proof clamp
         w = self.wall
         for _grow in range(4):
-            _tw = w.now() if w is not None else 0
+            _tw = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
             fresh_fn = id(self._fn) not in self._timed_fns
-            out = self._fn(
+            out = self._span_call(
+                self._fn,
                 st, self._lat, self._thr, self._node,
                 self._ips_sorted, self._ips_perm,
                 np.uint32(self._k[0]), np.uint32(self._k[1]),
@@ -2582,12 +2657,23 @@ class TcpSpanRunner(SpanMeshMixin):
              busy_end, span_iters) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
             code = int(st_np["abort_code"])
+            # First dispatch through a given built fn pays trace+XLA
+            # compile (capacity regrows rebuild it); the split feeds
+            # the explicit fn_cache accounting
+            # (metrics.wall.dispatch.fn_cache).
+            _dt = _time.perf_counter_ns() - _tw  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+            self._timed_fns.add(id(self._fn))
+            self.device_wall_ns += _dt
+            if fresh_fn:
+                self._credit_build(self._fn, _dt)
             if w is not None:
-                # First dispatch through a given built fn pays
-                # trace+XLA compile (capacity regrows rebuild it).
-                self._timed_fns.add(id(self._fn))
-                w.add("compile" if fresh_fn else "execute",
-                      w.now() - _tw, _tw)
+                w.add("compile" if fresh_fn else "execute", _dt, _tw)
+            if code != 0:
+                # Speculative-window waste: an aborted dispatch's
+                # wall and its stepped rounds roll back unused.
+                self.rollback_wall_ns += _dt
+                self.rolled_back_rounds += int(rounds)
+                self._note_abort_kind(code)
             if dbg:
                 print(f"[tcp_span] span done in "
                       f"{_time.perf_counter() - _t0:.1f}s: "  # shadow-lint: allow[wall-clock] debug span timing
@@ -2616,7 +2702,10 @@ class TcpSpanRunner(SpanMeshMixin):
                 # fresh-dispatch convention: a capacity grow that
                 # then succeeds counts zero.
                 resident = False
+                _tr = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
                 st = self._export_state()
+                self.rollback_reexport_ns += \
+                    _time.perf_counter_ns() - _tr  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
                 if st is None:
                     self.ineligible += 1
                     return None
@@ -2683,15 +2772,27 @@ class TcpSpanRunner(SpanMeshMixin):
             }
         st_np["_n_conns"] = n_conns
         _tw = w.now() if w is not None else 0
-        # tel_*/fab_* sample buffers are span-local output, not
+        # tel_*/fab_*/ks_* sample buffers are span-local output, not
         # engine state.
         back = self._from_arrays(
             {k: v for k, v in st_np.items()
              if not k.startswith("tel_")
-             and not k.startswith("fab_")})
+             and not k.startswith("fab_")
+             and not k.startswith("ks_")})
+        # Codec byte volume, host -> engine (dispatch attribution).
+        self.import_bytes += sum(
+            len(v) for v in back.values()
+            if isinstance(v, (bytes, bytearray, memoryview)))
         self.engine.span_import_tcp(back, *self._caps(), traces)
         self._emit_netstat(st_np)
         self._emit_fabric(st_np)
+        if self.kern is not None:
+            # One KS_REC per committed span (aborted spans rolled
+            # back and recorded nothing — the conservation law).
+            from shadow_tpu.trace.events import FAM_TCP
+            self.kern.record_span(
+                int(start), FAM_TCP, self._H, int(rounds),
+                int(span_iters), st_np["ks_fires"], st_np["ks_lanes"])
         if w is not None:
             w.add("import", w.now() - _tw, _tw)
         # Record AFTER the import's own epoch bump: the resident copy

@@ -5,9 +5,19 @@
     python -m shadow_tpu.tools.trace net DATA_DIR        # TCP report
     python -m shadow_tpu.tools.trace fabric DATA_DIR     # queue report
     python -m shadow_tpu.tools.trace fct DATA_DIR        # FCT table
+    python -m shadow_tpu.tools.trace kern DATA_DIR       # stage report
     python -m shadow_tpu.tools.trace explain DATA_DIR    # remediation
     python -m shadow_tpu.tools.trace --run sim.yaml      # run + summarize
     python -m shadow_tpu.tools.trace --smoke [--hosts N] # CI smoke
+
+`kern` prints the device-kernel observatory report
+(docs/OBSERVABILITY.md "Device-kernel observatory"): per span family,
+the per-stage table — fires, active-lane sums, occupancy and the
+estimated share of the measured device us/host/round — plus the
+fires-vs-micro_iters conservation verdict and a crossover-attribution
+verdict naming the stages that dominate the fitted device slope.  The
+whole report reproduces from the artifact (`kernel-sim.bin`) plus
+sim-stats.json alone.
 
 `fabric` prints the fabric-observatory report: per-link utilization,
 the queue-depth table (top links by peak sampled CoDel depth, with
@@ -144,6 +154,14 @@ def summarize(data_dir: str, chrome_out: str | None = None,
         for name, ns in sorted(phases.items(), key=lambda kv: -kv[1]):
             print(f"  {name:<16} {ns / 1e9:10.3f}s", file=out)
 
+    ks_bytes = _kern_bytes(data_dir)
+    if ks_bytes:
+        from shadow_tpu.trace.events import KS_REC_BYTES
+        print(f"  device-kernel observatory: "
+              f"{len(ks_bytes) // KS_REC_BYTES} committed-span "
+              f"records (`trace kern` for the per-stage table)",
+              file=out)
+
     if chrome_out is not None:
         from shadow_tpu.trace.chrome import chrome_trace
         from shadow_tpu.trace.events import split_fabric
@@ -152,7 +170,7 @@ def summarize(data_dir: str, chrome_out: str | None = None,
             fb, _fct = split_fabric(fab_bytes)
         top_n = _chrome_top_n(data_dir)
         doc = chrome_trace(sim_bytes, wall, tel_bytes, sc_bytes, fb,
-                           top_n)
+                           top_n, ks_bytes=ks_bytes)
         with open(chrome_out, "w") as f:
             json.dump(doc, f)
         print(f"chrome trace: {chrome_out} "
@@ -373,6 +391,80 @@ def fct_report(data_dir: str, out=None) -> bool:
               f"{ent['p99_ns'] / 1e6:>9.2f} "
               f"{ent['p999_ns'] / 1e6:>9.2f}", file=out)
     return True
+
+
+def _kern_bytes(data_dir: str) -> bytes:
+    """kernel-sim.bin's content (b"" when the observatory was off)."""
+    path = os.path.join(data_dir, "kernel-sim.bin")
+    if not os.path.exists(path):
+        return b""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def kern_report(data_dir: str, out=None) -> bool:
+    """`trace kern`: the device-kernel observatory report — per-stage
+    fires/lanes/occupancy table with the attributed share of each
+    family's measured device slope, the fires-vs-micro_iters
+    conservation verdict, and a crossover-attribution verdict.
+    Everything derives from kernel-sim.bin + sim-stats.json alone.
+    Returns the conservation verdict (the gate's exit code)."""
+    if out is None:
+        out = sys.stdout
+    from shadow_tpu.trace.kernstat import (attribution,
+                                           check_conservation,
+                                           family_label, family_totals,
+                                           family_warm_wall_s,
+                                           low_occupancy_stages,
+                                           render_table)
+
+    stats, _sim, _wall, _tel, _sc, _fab = _load(data_dir)
+    ks_bytes = _kern_bytes(data_dir)
+    if not ks_bytes:
+        print("device-kernel observatory: no records (run with "
+              "experimental.kernel_observatory: on and a device-"
+              "routed workload — e.g. tpu_device_spans: force)",
+              file=out)
+        # Vacuously conserved: zero committed spans, zero records.
+        return True
+    dispatch = stats.get("metrics", {}).get("wall", {}).get(
+        "dispatch", {})
+    render_table(ks_bytes, dispatch, out=out)
+    dropped = stats.get("metrics", {}).get("sim", {}).get(
+        "kern", {}).get("dropped", 0)
+    ok, problems = check_conservation(ks_bytes, dispatch, dropped)
+    if ok:
+        print("conservation: committed trips reconcile exactly "
+              "against dispatch micro_iters", file=out)
+    else:
+        print("conservation: VIOLATED", file=out)
+        for p in problems[:8]:
+            print(f"  {p}", file=out)
+    # Crossover-attribution verdict: which stages own the device
+    # slope the crossover ladder fits (ROADMAP item 3's per-stage
+    # before/after).
+    for family, ent in sorted(family_totals(ks_bytes).items()):
+        wall_s = family_warm_wall_s(dispatch, family)
+        att = attribution(ent, wall_s)
+        ranked = sorted(att.items(),
+                        key=lambda kv: -kv[1]["share_permille"])[:3]
+        if not ranked:
+            continue
+        hr = ent["hosts"] * ent["rounds"]
+        slope = wall_s * 1e6 / hr if hr else 0.0
+        tops = ", ".join(
+            f"{sname} ({row['share_permille'] / 10:.0f}% ~ "
+            f"{row['us_per_host_round']:.2f} us)"
+            for sname, row in ranked)
+        print(f"crossover attribution [{family_label(family)}]: "
+              f"warm slope {slope:.2f} us/host/round; dominated by "
+              f"{tops}", file=out)
+        low = [sname for sname, _occ in low_occupancy_stages(ent)]
+        if low:
+            print(f"  low-occupancy stages (<5% of lane slots): "
+                  f"{', '.join(low)} — vector width mostly burns "
+                  f"masked-out lanes there", file=out)
+    return ok
 
 
 def _processed_config(data_dir: str) -> dict:
@@ -689,6 +781,64 @@ def _hottest_queue(data_dir: str, fab_bytes: bytes, out) -> None:
           f"{peak} packets, {soj:.2f} ms head sojourn)", file=out)
 
 
+def _kern_hints(data_dir: str, stats: dict, out) -> None:
+    """Device-kernel observatory joins for `trace explain`:
+
+    - speculative-window waste — when the rollback ledger (aborted
+      dispatch wall + forced re-exports) exceeds ~10% of a family's
+      device dispatch wall, name the dominant abort kind and the
+      remediation;
+    - low lane occupancy — on a device-routed run, name the stages
+      whose occupancy sits under ~5% and the likeliest config
+      remediation (tiny dev_span_K keeps spans short and lanes idle;
+      a mixed-family fleet splits lanes across kernels)."""
+    from shadow_tpu.trace.kernstat import DISPATCH_KEYS
+    dispatch = stats.get("metrics", {}).get("wall", {}).get(
+        "dispatch", {})
+    for fam in DISPATCH_KEYS.values():
+        d = dispatch.get(f"device_span_{fam}") or {}
+        wall = float(d.get("dispatch_wall_s", 0.0))
+        waste = float(d.get("rollback_wall_s", 0.0)) \
+            + float(d.get("rollback_reexport_wall_s", 0.0))
+        if wall > 0 and waste > 0.1 * wall:
+            kinds = d.get("abort_kinds") or {}
+            top = max(kinds, key=kinds.get) if kinds else "abort"
+            label = {"struct": "AB_STRUCT (domain departure)",
+                     "exchange-capacity": "AB_EXCH (exchange "
+                     "capacity)"}.get(top, f"capacity ({top})")
+            print(f"  speculative-window waste [{fam}]: "
+                  f"{100.0 * waste / wall:.0f}% of the device "
+                  f"dispatch wall rolled back unused "
+                  f"({d.get('rolled_back_rounds', 0)} rounds; "
+                  f"dominant abort: {label}).  Shrink the "
+                  f"speculation pressure (smaller initial dev_span_K)"
+                  f" or pre-size the aborting capacity "
+                  f"(tpu_exchange_capacity / ring caps) so spans "
+                  f"commit first try.", file=out)
+    ks_bytes = _kern_bytes(data_dir)
+    if not ks_bytes:
+        return
+    from shadow_tpu.trace.kernstat import (family_label,
+                                           family_totals,
+                                           low_occupancy_stages)
+    for family, ent in sorted(family_totals(ks_bytes).items()):
+        low = low_occupancy_stages(ent)
+        if not low:
+            continue
+        worst = min(low, key=lambda kv: kv[1])
+        spans = max(ent["spans"], 1)
+        fam = family_label(family)
+        print(f"  low lane occupancy [{fam}]: stage "
+              f"'{worst[0]}' ran at {worst[1] / 10:.1f}% of its "
+              f"{ent['hosts']}-lane width "
+              f"({len(low)} stage(s) under 5%).  Likeliest "
+              f"remediations: larger spans amortize idle iterations "
+              f"(rounds/span is {ent['rounds'] // spans} — a tiny "
+              f"dev_span_K or frequent boundaries keeps it low), or "
+              f"the fleet mixes families so each kernel sees only "
+              f"part of the host axis.", file=out)
+
+
 def explain_report(data_dir: str, out=None) -> bool:
     """`trace explain`: top eligibility blockers -> remediation."""
     if out is None:
@@ -751,6 +901,10 @@ def explain_report(data_dir: str, out=None) -> bool:
     if not shown:
         print("  (every round ran on the device — nothing to "
               "remediate)", file=out)
+    # Device-kernel observatory joins (ISSUE 15): speculative-window
+    # waste + low lane occupancy, from the dispatch ledger and
+    # kernel-sim.bin.
+    _kern_hints(data_dir, stats, out)
     return True
 
 
@@ -836,6 +990,55 @@ hosts:
     return 0
 
 
+def smoke_kern() -> int:
+    """Device-kernel observatory smoke leg: an 8-host PHOLD fleet
+    with forced device spans and the observatory on — the per-stage
+    counters must conserve against micro_iters (`trace kern` exits
+    ok, with a non-empty table) and the Chrome export must carry a
+    non-empty per-stage counter track."""
+    import tempfile
+
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.core.manager import run_simulation
+    from shadow_tpu.tools.netgen import phold_yaml
+
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "kern-smoke")
+        text = phold_yaml(8, n_init=2, mean_delay_ns=20_000_000,
+                          stop_time="1s", seed=13, scheduler="tpu",
+                          device_spans="force")
+        config = ConfigOptions.from_yaml_text(text)
+        config.experimental.kernel_observatory = "on"
+        config.experimental.flight_recorder = "on"
+        config.general.data_directory = base
+        _manager, summary = run_simulation(config, write_data=True)
+        if not summary.ok:
+            print(f"trace smoke: kern sim failed: "
+                  f"{summary.plugin_errors}", file=sys.stderr)
+            return 1
+        ks = _kern_bytes(base)
+        if not ks:
+            print("trace smoke: kernel observatory recorded nothing "
+                  "(device spans never committed?)", file=sys.stderr)
+            return 1
+        if not kern_report(base):
+            print("trace smoke: kernel-channel conservation violated",
+                  file=sys.stderr)
+            return 1
+        from shadow_tpu.trace.chrome import PID_KERN, chrome_trace
+        _stats, sim_bytes, wall, _tel, _sc, _fab = _load(base)
+        doc = chrome_trace(sim_bytes, wall, ks_bytes=ks)
+        counters = [e for e in doc["traceEvents"]
+                    if e.get("ph") == "C" and e.get("pid") == PID_KERN]
+        if not counters:
+            print("trace smoke: chrome export has no per-stage kernel "
+                  "counter track", file=sys.stderr)
+            return 1
+    print(f"trace smoke: kern leg ok (fires conserve, "
+          f"{len(counters)} stage counter events)")
+    return 0
+
+
 def smoke(n_hosts: int) -> int:
     """50-host traced tgen TCP tier: summary + eligibility must
     render and account for every round, the drop-cause counters must
@@ -905,17 +1108,22 @@ def smoke(n_hosts: int) -> int:
     print(f"trace smoke: ok ({n_hosts} hosts, {summary.rounds} rounds "
           f"fully attributed, drops conserved, "
           f"{len(counters)} counter events)")
+    rc = smoke_kern()
+    if rc:
+        return rc
     return smoke_managed()
 
 
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("net", "explain", "sys", "fabric", "fct"):
+    if argv and argv[0] in ("net", "explain", "sys", "fabric", "fct",
+                            "kern"):
         # Subcommands: `trace net DATA_DIR [--top N]`,
         #              `trace sys DATA_DIR [--top N]`,
         #              `trace fabric DATA_DIR [--top N]`,
         #              `trace fct DATA_DIR`,
+        #              `trace kern DATA_DIR`,
         #              `trace explain DATA_DIR`.
         sub = argparse.ArgumentParser(
             prog=f"shadow_tpu.tools.trace {argv[0]}")
@@ -937,6 +1145,8 @@ def main(argv=None) -> int:
                                       top_n=sargs.top) else 1
         if argv[0] == "fct":
             return 0 if fct_report(sargs.data_dir) else 1
+        if argv[0] == "kern":
+            return 0 if kern_report(sargs.data_dir) else 1
         return 0 if explain_report(sargs.data_dir) else 1
 
     ap = argparse.ArgumentParser(prog="shadow_tpu.tools.trace",

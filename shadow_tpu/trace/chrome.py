@@ -25,6 +25,7 @@ PID_WALL = 2
 PID_NETSTAT = 3
 PID_SYSCALL = 4
 PID_FABRIC = 5
+PID_KERN = 6
 
 # Default per-entity counter-track cap; the CLI overrides it from the
 # experimental.chrome_top_n knob (one knob for every track family).
@@ -108,6 +109,43 @@ def fabric_events(fab_bytes: bytes, top_n: int = DEFAULT_TOP_N) -> list:
     return ev
 
 
+def kern_events(ks_bytes: bytes) -> list:
+    """Per-stage counter tracks from kernel-sim.bin (the device-kernel
+    observatory): one "C" event per committed span per occupied
+    stage, at the span's entry time — active lanes plus occupancy in
+    permille, so Perfetto plots each stage's lane utilization across
+    the run.  Record count is already bounded (one per committed
+    span), so no top-N cap applies."""
+    from shadow_tpu.trace.events import (KS_EXCHANGE, KS_NAMES,
+                                         iter_ks_records)
+
+    ev: list = []
+    seen = False
+    for t, family, hosts, rounds, trips, fires, lanes in \
+            iter_ks_records(ks_bytes):
+        if not seen:
+            ev.append(_meta(PID_KERN, 0, "process_name",
+                            "device-kernel observatory (per-stage "
+                            "lane occupancy)"))
+            seen = True
+        fam = FAM_NAMES[family] if 0 <= family < len(FAM_NAMES) \
+            else str(family)
+        ts = t / 1e3
+        slots = max(hosts * trips, 1)
+        for i, name in enumerate(KS_NAMES):
+            if fires[i] == 0 and lanes[i] == 0:
+                continue
+            args = {"lanes": lanes[i]}
+            if i != KS_EXCHANGE:
+                # exchange is a per-round stage (lanes = packets
+                # staged): the lane-occupancy law does not apply.
+                args["occupancy-permille"] = (lanes[i] * 1000) // slots
+            ev.append({"ph": "C", "pid": PID_KERN, "tid": 0,
+                       "ts": ts, "name": f"{fam} {name}",
+                       "args": args})
+    return ev
+
+
 def syscall_events(sc_bytes: bytes, top_n: int = DEFAULT_TOP_N) -> list:
     """Per-process syscall slices + counter tracks from
     syscalls-sim.bin (the syscall observatory's record channel).
@@ -160,7 +198,8 @@ def syscall_events(sc_bytes: bytes, top_n: int = DEFAULT_TOP_N) -> list:
 def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
                  tel_bytes: bytes = b"", sc_bytes: bytes = b"",
                  fab_bytes: bytes = b"",
-                 top_n: int = DEFAULT_TOP_N) -> dict:
+                 top_n: int = DEFAULT_TOP_N,
+                 ks_bytes: bytes = b"") -> dict:
     """Build the trace-event JSON object from the raw channel data.
 
     `sim_bytes` is flight-sim.bin's content; `wall` is the parsed
@@ -168,8 +207,10 @@ def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
     telemetry-sim.bin's content (per-connection counter tracks);
     `sc_bytes` is syscalls-sim.bin's content (per-process syscall
     slices + counter tracks); `fab_bytes` is fabric-sim.bin's FB
-    section (per-link counter tracks).  `top_n` caps every per-entity
-    track family (the experimental.chrome_top_n knob)."""
+    section (per-link counter tracks); `ks_bytes` is kernel-sim.bin's
+    content (per-stage lane-occupancy counter tracks).  `top_n` caps
+    every per-entity track family (the experimental.chrome_top_n
+    knob)."""
     ev: list[dict] = [
         _meta(PID_SIM, 0, "process_name", "sim-time (simulated µs)"),
         _meta(PID_SIM, 1, "thread_name", "rounds & spans"),
@@ -227,6 +268,9 @@ def chrome_trace(sim_bytes: bytes, wall: dict | None = None,
 
     if fab_bytes:
         ev.extend(fabric_events(fab_bytes, top_n))
+
+    if ks_bytes:
+        ev.extend(kern_events(ks_bytes))
 
     if wall and wall.get("events"):
         ev.append(_meta(PID_WALL, 0, "process_name",

@@ -441,6 +441,93 @@ def iter_fct_records(fct_bytes: bytes):
         yield FCT_REC.unpack_from(fct_bytes, off)
 
 
+# ---------------------------------------------------------------------
+# Device-kernel observatory (docs/OBSERVABILITY.md "Device-kernel
+# observatory"): the FIFTH sim-time channel (`kernel-sim.bin`).  One
+# fixed KS_REC record per COMMITTED device span, carrying a per-stage
+# counter block threaded through the span kernels' `lax.while_loop`
+# carry: for every fused micro-op stage a FIRE count (micro-iterations
+# in which >= 1 lane ran the stage) and an ACTIVE-LANE sum (lanes
+# occupying the stage, summed over iterations).  Occupancy is
+# lanes / (hosts x trips); the conservation law is that the per-family
+# sum of `trips` over committed records equals the dispatch split's
+# `micro_iters` counter exactly (aborted spans roll back and record
+# nothing).  The KS_* enum and the KS_NAMES table are twinned with
+# native/netplane.cpp — the authoritative fail-closed registry pass 1
+# scans, even though the stages execute in the JAX kernels — so stage
+# drift or a reordered name table fails `scripts/lint`.
+#
+# Stage semantics (both families unless noted):
+#   pop         arrival/timer event pop (all due lanes)
+#   step        app stepper (phold op_step M/S; tcp op_app)
+#   codel       router-inbound CoDel drain (the r2 relay)
+#   on-packet   TCP on_packet header processing (tcp only)
+#   reassembly  TCP reassembly drain (tcp only)
+#   ack         TCP ack_data decision (tcp only)
+#   push        TCP push_data segmentation (tcp only)
+#   flush       TCP flush notify decision (tcp only)
+#   inet-out    inet-out relay drain (the r1 relay)
+#   arm         timer-arm / status tail (phold op_stage2; tcp op_arm)
+#   timers      timer handling (phold: inline timer pops; tcp: op_tmr)
+#   exchange    sharded cross-shard staging hop (per round, lanes =
+#               packets staged — a per-round stage, not a micro-op)
+KS_POP = 0
+KS_STEP = 1
+KS_CODEL = 2
+KS_ON_PACKET = 3
+KS_REASM = 4
+KS_ACK = 5
+KS_PUSH = 6
+KS_FLUSH = 7
+KS_INET_OUT = 8
+KS_ARM = 9
+KS_TIMERS = 10
+KS_EXCHANGE = 11
+KS_N = 12
+
+# Order mirrors the KS_* values above AND the C++ KS_NAMES table
+# (pass 1 checks both directions).
+KS_NAMES = (
+    "pop",
+    "step",
+    "codel",
+    "on-packet",
+    "reassembly",
+    "ack",
+    "push",
+    "flush",
+    "inet-out",
+    "arm",
+    "timers",
+    "exchange",
+)
+assert len(KS_NAMES) == KS_N
+
+# Per-committed-span record (KS_REC_BYTES, little-endian, no padding;
+# the size constant is twinned with native/netplane.cpp):
+#
+#     int64   t          span entry window start (simulated ns)
+#     int32   family     FAM_* span family (phold covers udp-mesh too)
+#     int32   hosts      H — the kernel's host-lane width
+#     int64   rounds     conservative rounds committed by this span
+#     int64   trips      micro-loop while-iterations in this span
+#     int64[KS_N]        fires per stage
+#     int64[KS_N]        active-lane sums per stage
+KS_REC_BYTES = 224
+KS_REC = struct.Struct("<qiiqq24q")
+assert KS_REC.size == KS_REC_BYTES
+
+
+def iter_ks_records(buf: bytes):
+    """Yield (t, family, hosts, rounds, trips, fires_tuple,
+    lanes_tuple) from a packed KS_REC stream."""
+    for off in range(0, len(buf) - len(buf) % KS_REC_BYTES,
+                     KS_REC_BYTES):
+        rec = KS_REC.unpack_from(buf, off)
+        yield (rec[0], rec[1], rec[2], rec[3], rec[4],
+               rec[5:5 + KS_N], rec[5 + KS_N:5 + 2 * KS_N])
+
+
 REC = struct.Struct("<qiiqq")
 assert REC.size == FLIGHT_REC_BYTES
 

@@ -1,0 +1,269 @@
+"""Device-kernel observatory: per-micro-op firing & lane-occupancy
+telemetry for the span kernels.
+
+The FIFTH sim-time channel next to the flight recorder, sim-netstat,
+the syscall observatory and the fabric observatory
+(docs/OBSERVABILITY.md "Device-kernel observatory").  One fixed
+KS_REC record per COMMITTED device span (`kernel-sim.bin`): both span
+families (ops/phold_span.py, ops/tcp_span.py) thread a per-stage
+counter block — a fire count and an active-lane sum per fused
+micro-op stage — through the `lax.while_loop` carry, and the driver
+packs one record at span commit.  Aborted spans roll back and record
+nothing, so the channel obeys an exact conservation law: per family,
+the sum of `trips` over committed records equals the dispatch split's
+`micro_iters` counter, and every micro-op stage's fire count is
+bounded by its per-iteration pass count (at most 2 — the relay/drain
+double pass) times the trips.  The per-round exchange stage is
+bounded by `rounds` instead.
+
+Records append in span-commit order — the manager's round loop is the
+single producer — so under pinned device routing (the forced-device
+differential gates, `tpu_device_spans: force`) the artifact is
+byte-identical across runs; rounds served off the device leave no
+records, so a run with no device spans writes an empty artifact on
+every scheduler.  The determinism gate byte-diffs the file like every
+other sim channel.
+
+Occupancy is `lanes / (hosts x trips)` — the fraction of the kernel's
+host-lane slots a stage actually used over the span — the number the
+crossover attack (ROADMAP item 3) needs per stage: a stage with 1%
+occupancy burns 99% of its vectorized width on masked-out lanes.
+
+Like `SimChannel`, this class must never read wall clocks: analysis
+pass 3's `sim-channel` rule covers it with no pragma escape.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shadow_tpu.trace.events import (FAM_NAMES, FAM_PHOLD, FAM_TCP,
+                                     KS_EXCHANGE, KS_N, KS_NAMES,
+                                     KS_REC, KS_REC_BYTES,
+                                     iter_ks_records)
+from shadow_tpu.trace.recorder import FixedRecordChannel
+
+# Max per-iteration passes any micro-op stage takes in the fused
+# dispatch (the relay/reassembly double pass); the fires bound the
+# conservation check enforces per record.
+STAGE_MAX_PASSES = 2
+
+# Occupancy threshold (permille) below which a stage reads as
+# "mostly masked-out lanes" — the one value `trace kern`, the
+# `trace explain` hint and the tests share.
+LOW_OCCUPANCY_PERMILLE = 50
+
+# Span family -> the runner key the dispatch split / fn_cache blocks
+# use (derived from the FAM_* codes so a new family cannot drift the
+# renderers silently; `family_label` is the human name).
+DISPATCH_KEYS = {FAM_PHOLD: "phold", FAM_TCP: "tcp"}
+
+
+def family_label(family: int) -> str:
+    return FAM_NAMES[family] if 0 <= family < len(FAM_NAMES) \
+        else str(family)
+
+
+class KernChannel(FixedRecordChannel):
+    """Deterministic per-span stage-counter stream (simulated time
+    only; trace/recorder.FixedRecordChannel carries the shared
+    cap/extend machinery)."""
+
+    FILE = "kernel-sim.bin"
+    REC_SIZE = KS_REC_BYTES
+
+    def record_span(self, t: int, family: int, hosts: int,
+                    rounds: int, trips: int, fires, lanes) -> None:
+        """One committed span's counter block (fires/lanes are KS_N
+        int sequences straight from the kernel output arrays)."""
+        if self.records >= self._cap:
+            self.dropped += 1
+            return
+        self._chunks.append(KS_REC.pack(
+            int(t), int(family), int(hosts), int(rounds), int(trips),
+            *(int(x) for x in fires), *(int(x) for x in lanes)))
+        self.records += 1
+
+    def write(self, data_dir: str) -> None:
+        with open(os.path.join(data_dir, self.FILE), "wb") as f:
+            f.write(self.to_bytes())
+
+
+# ---------------------------------------------------------------------
+# Report helpers (tools/trace `kern`, the Chrome export, bench's
+# crossover ladder and the tests share these so every surface renders
+# — and gates — the same numbers).
+# ---------------------------------------------------------------------
+
+def family_totals(ks_bytes: bytes) -> dict:
+    """Aggregate the record stream per span family: {family: {"spans",
+    "rounds", "trips", "hosts", "fires"[KS_N], "lanes"[KS_N]}}.
+    `hosts` is the kernel's lane width (constant per family — one
+    runner per Manager)."""
+    out: dict = {}
+    for t, family, hosts, rounds, trips, fires, lanes in \
+            iter_ks_records(ks_bytes):
+        ent = out.setdefault(family, {
+            "spans": 0, "rounds": 0, "trips": 0, "hosts": hosts,
+            "fires": [0] * KS_N, "lanes": [0] * KS_N})
+        ent["spans"] += 1
+        ent["rounds"] += rounds
+        ent["trips"] += trips
+        ent["hosts"] = max(ent["hosts"], hosts)
+        for i in range(KS_N):
+            ent["fires"][i] += fires[i]
+            ent["lanes"][i] += lanes[i]
+    return out
+
+
+def occupancy_permille(ent: dict, stage: int) -> int:
+    """A stage's lane occupancy in permille: active-lane-iterations
+    over the total lane slots (hosts x trips) the span loop offered.
+    Integer arithmetic — deterministic on every surface.  Returns -1
+    for the exchange stage: it is a per-ROUND hop whose lanes count
+    packets staged, not lane slots — running it through the micro-op
+    occupancy law would read as false lane waste (every renderer and
+    the low-occupancy hint skip negatives)."""
+    if stage == KS_EXCHANGE:
+        return -1
+    slots = ent["hosts"] * ent["trips"]
+    if slots <= 0:
+        return 0
+    return (ent["lanes"][stage] * 1000) // slots
+
+
+def low_occupancy_stages(ent: dict) -> list:
+    """[(stage name, occupancy permille)] for every MICRO-OP stage
+    that fired but used under LOW_OCCUPANCY_PERMILLE of its lane
+    slots — THE shared rule behind `trace kern`'s verdict line and
+    `trace explain`'s remediation hint."""
+    out = []
+    for i in range(KS_N):
+        occ = occupancy_permille(ent, i)
+        if ent["fires"][i] > 0 and 0 <= occ < LOW_OCCUPANCY_PERMILLE:
+            out.append((KS_NAMES[i], occ))
+    return out
+
+
+def attribution(ent: dict, dispatch_wall_s: float) -> dict:
+    """Per-stage cost attribution for one family: {stage_name:
+    {"fires", "lanes", "occupancy_permille", "share_permille",
+    "us_per_host_round"}}.  The share model attributes the measured
+    device dispatch wall proportionally to each stage's active-lane
+    sum (lane-iterations are the unit of vectorized work the kernels
+    execute), so the per-stage `us_per_host_round` columns sum to the
+    fitted device slope — the before/after per stage the overlap and
+    lane-parallel kernel work (ROADMAP item 3) needs."""
+    total_lanes = sum(ent["lanes"]) or 1
+    hr = ent["hosts"] * ent["rounds"]
+    slope_us = (dispatch_wall_s * 1e6 / hr) if hr > 0 else 0.0
+    out: dict = {}
+    for i in range(KS_N):
+        if ent["fires"][i] == 0 and ent["lanes"][i] == 0:
+            continue
+        share = ent["lanes"][i] * 1000 // total_lanes
+        out[KS_NAMES[i]] = {
+            "fires": ent["fires"][i],
+            "lanes": ent["lanes"][i],
+            "occupancy_permille": occupancy_permille(ent, i),
+            "share_permille": share,
+            "us_per_host_round": round(
+                slope_us * ent["lanes"][i] / total_lanes, 4),
+        }
+    return out
+
+
+def family_warm_wall_s(dispatch: dict, family: int) -> float:
+    """A family's WARM device dispatch wall from the dispatch split:
+    total dispatch wall minus the fn-cache build wall (the first
+    dispatch of each built kernel pays trace+XLA compile — attribution
+    wants the steady state, not the compiler)."""
+    key = DISPATCH_KEYS.get(family)
+    if key is None:
+        return 0.0
+    block = dispatch.get(f"device_span_{key}") or {}
+    wall = float(block.get("dispatch_wall_s", 0.0))
+    build = float((dispatch.get("fn_cache") or {}).get(
+        key, {}).get("build_wall_s", 0.0))
+    return max(wall - build, 0.0)
+
+
+def check_conservation(ks_bytes: bytes, dispatch: dict,
+                       channel_dropped: int = 0) -> tuple[bool, list]:
+    """The channel's conservation law against the dispatch split
+    (metrics.wall.dispatch of sim-stats.json): per family, committed
+    trips sum EXACTLY to the runner's micro_iters counter, and every
+    record's per-stage fires stay inside the pass bound.  Returns
+    (ok, [human-readable problem lines]); a capped channel (dropped
+    records) skips the exact-sum leg honestly instead of reporting a
+    false gap."""
+    problems: list = []
+    fam_key = {f: f"device_span_{k}" for f, k in DISPATCH_KEYS.items()}
+    totals = family_totals(ks_bytes)
+    for t, family, hosts, rounds, trips, fires, lanes in \
+            iter_ks_records(ks_bytes):
+        for i in range(KS_N):
+            bound = rounds if i == KS_EXCHANGE \
+                else STAGE_MAX_PASSES * trips
+            if fires[i] > bound:
+                problems.append(
+                    f"span@{t}: stage {KS_NAMES[i]} fires {fires[i]} "
+                    f"> bound {bound}")
+            if lanes[i] > fires[i] * max(hosts, 1) \
+                    and i != KS_EXCHANGE:
+                problems.append(
+                    f"span@{t}: stage {KS_NAMES[i]} lanes {lanes[i]} "
+                    f"exceed fires x hosts")
+    for family, ent in sorted(totals.items()):
+        key = fam_key.get(family)
+        block = dispatch.get(key) if key else None
+        if block is None:
+            problems.append(
+                f"family {family_label(family)}: no {key} dispatch "
+                f"block to reconcile against")
+            continue
+        micro = int(block.get("micro_iters", 0))
+        if channel_dropped == 0 and ent["trips"] != micro:
+            problems.append(
+                f"family {family_label(family)}: committed trips "
+                f"{ent['trips']} != dispatch micro_iters {micro}")
+        if channel_dropped and ent["trips"] > micro:
+            problems.append(
+                f"family {family_label(family)}: committed trips "
+                f"{ent['trips']} exceed dispatch micro_iters {micro} "
+                f"(capped channel may undercount, never overcount)")
+    return (not problems, problems)
+
+
+def render_table(ks_bytes: bytes, dispatch: dict, out=None) -> None:
+    """The per-stage table `tools/trace kern` prints: fires, lanes,
+    occupancy and the attributed share of each family's measured
+    device slope."""
+    import sys
+    if out is None:
+        out = sys.stdout
+    for family, ent in sorted(family_totals(ks_bytes).items()):
+        wall_s = family_warm_wall_s(dispatch, family)
+        hr = ent["hosts"] * ent["rounds"]
+        slope = wall_s * 1e6 / hr if hr else 0.0
+        print(f"family {family_label(family)}: {ent['spans']} spans, "
+              f"{ent['rounds']} rounds, {ent['trips']} micro-iters, "
+              f"{ent['hosts']} lanes/stage"
+              + (f", warm slope {slope:.2f} us/host/round"
+                 if slope else ""), file=out)
+        print(f"  {'stage':<12} {'fires':>10} {'lanes':>12} "
+              f"{'occ %':>7} {'share %':>8} {'us/host/rnd':>12}",
+              file=out)
+        att = attribution(ent, wall_s)
+        for sname in KS_NAMES:
+            row = att.get(sname)
+            if row is None:
+                continue
+            # exchange is a per-round stage: lane occupancy does not
+            # apply (occupancy_permille returns -1 there).
+            occ = row["occupancy_permille"]
+            occ_s = f"{occ / 10:>7.1f}" if occ >= 0 else f"{'—':>7}"
+            print(f"  {sname:<12} {row['fires']:>10} "
+                  f"{row['lanes']:>12} {occ_s} "
+                  f"{row['share_permille'] / 10:>8.1f} "
+                  f"{row['us_per_host_round']:>12.4f}", file=out)

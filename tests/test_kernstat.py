@@ -1,0 +1,275 @@
+"""Device-kernel observatory gates (ISSUE 15, trace/kernstat.py).
+
+The FIFTH sim-time channel: one KS_REC per committed device span with
+per-stage fire counts and active-lane sums threaded through both span
+kernels' while_loop carries.  The contracts gated here:
+
+- record round-trip (KS_REC pack/iter);
+- `kernel-sim.bin` byte-identical across two runs under pinned
+  routing (tpu_device_spans: force);
+- byte-identical across serial/thread_per_core/tpu — rounds served
+  off the device leave no records, so a workload with no device spans
+  writes the SAME (empty) artifact on every scheduler, and the
+  channel can never capture scheduler-dependent bytes;
+- conservation: committed trips sum EXACTLY to the dispatch split's
+  micro_iters counter, per-stage fires stay inside the pass bound;
+- observatory off leaves no artifact;
+- the explicit fn-cache accounting replaces the compile-vs-execute
+  guessing (metrics.wall.dispatch.fn_cache);
+- CLI + Chrome surfaces render from the artifact alone.
+
+Slow legs force the device path for the TCP family and the sharded
+8-way phold mesh (exchange is just another stage).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_phold_span import phold_cfg  # noqa: E402
+
+from shadow_tpu.core.manager import run_simulation  # noqa: E402
+
+
+def _run(tmp, name, scheduler, device_spans=None, kern="on",
+         shards=None):
+    cfg = phold_cfg(scheduler, device_spans=device_spans)
+    cfg.experimental.kernel_observatory = kern
+    cfg.experimental.flight_recorder = "on"
+    if shards is not None:
+        cfg.experimental.tpu_shards = shards
+    base = str(tmp / name)
+    cfg.general.data_directory = base
+    _m, s = run_simulation(cfg, write_data=True)
+    assert s.ok, s.plugin_errors
+    return base
+
+
+def _read(base, fn="kernel-sim.bin"):
+    with open(os.path.join(base, fn), "rb") as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def forced_runs(tmp_path_factory):
+    """Two identical forced-device runs + the three-scheduler sweep,
+    shared by every gate below (one kernel compile per module)."""
+    tmp = tmp_path_factory.mktemp("kern")
+    a = _run(tmp, "run-a", "tpu", device_spans="force")
+    b = _run(tmp, "run-b", "tpu", device_spans="force")
+    return tmp, a, b
+
+
+def test_ks_rec_roundtrip():
+    from shadow_tpu.trace.events import (KS_N, KS_REC, KS_REC_BYTES,
+                                         iter_ks_records)
+    fires = tuple(range(10, 10 + KS_N))
+    lanes = tuple(range(100, 100 + KS_N))
+    buf = KS_REC.pack(123456789, 1, 8, 42, 777, *fires, *lanes)
+    assert len(buf) == KS_REC_BYTES
+    recs = list(iter_ks_records(buf * 3))
+    assert len(recs) == 3
+    t, fam, hosts, rounds, trips, f, l = recs[0]
+    assert (t, fam, hosts, rounds, trips) == (123456789, 1, 8, 42, 777)
+    assert f == fires and l == lanes
+
+
+def test_two_run_byte_identity(forced_runs):
+    """Under pinned device routing the channel is a pure function of
+    the simulation: two runs, identical bytes, non-empty."""
+    _tmp, a, b = forced_runs
+    ka, kb = _read(a), _read(b)
+    assert ka, "kernel observatory recorded nothing"
+    assert ka == kb, "kernel-sim.bin differs between identical runs"
+
+
+def test_conservation_against_micro_iters(forced_runs):
+    """The conservation law: per family, committed trips sum EXACTLY
+    to the dispatch split's micro_iters counter, every micro-op
+    stage's fires stay inside the pass bound, and occupancy is a
+    valid fraction of the lane slots."""
+    from shadow_tpu.trace.events import KS_NAMES
+    from shadow_tpu.trace.kernstat import (check_conservation,
+                                           family_totals,
+                                           occupancy_permille)
+    _tmp, a, _b = forced_runs
+    ks = _read(a)
+    stats = json.load(open(os.path.join(a, "sim-stats.json")))
+    dispatch = stats["metrics"]["wall"]["dispatch"]
+    micro = dispatch["device_span_phold"]["micro_iters"]
+    assert micro > 0
+    ok, problems = check_conservation(ks, dispatch)
+    assert ok, problems
+    tots = family_totals(ks)
+    ent = tots[1]  # FAM_PHOLD
+    assert ent["trips"] == micro
+    # The pop stage fires every while-iteration with a due lane; the
+    # relay stages fire at most twice per iteration.
+    for i, name in enumerate(KS_NAMES):
+        if name == "exchange":
+            # Per-round stage: lane-occupancy law does not apply
+            # (occupancy_permille returns the renderers' skip value).
+            assert ent["fires"][i] <= ent["rounds"]
+            assert occupancy_permille(ent, i) == -1
+        else:
+            assert ent["fires"][i] <= 2 * ent["trips"], name
+            assert 0 <= occupancy_permille(ent, i) <= 2000
+    # The family actually exercises its stages.
+    by_name = dict(zip(KS_NAMES, ent["fires"]))
+    for stage in ("pop", "step", "codel", "inet-out", "timers"):
+        assert by_name[stage] > 0, by_name
+
+
+def test_identical_across_schedulers(tmp_path):
+    """Device spans exist only under the tpu scheduler; rounds served
+    anywhere else leave no records.  The artifact must therefore be
+    byte-identical — the same empty record stream — across
+    serial/thread_per_core/tpu for a workload whose rounds never
+    route to the device, proving no scheduler-dependent bytes can
+    leak into the channel.  (Content identity under device routing is
+    the two-run + forced-differential pair above.)"""
+    blobs = {}
+    for label, sched in (("serial", "serial"),
+                         ("tpc", "thread_per_core"),
+                         ("tpu", "tpu")):
+        base = _run(tmp_path, f"xs-{label}", sched)
+        blobs[label] = _read(base)
+    assert blobs["serial"] == blobs["tpc"] == blobs["tpu"]
+    assert blobs["serial"] == b""  # no device spans -> no records
+
+
+def test_observatory_off_leaves_no_artifact(tmp_path):
+    base = _run(tmp_path, "off", "serial", kern="off")
+    assert not os.path.exists(os.path.join(base, "kernel-sim.bin"))
+    stats = json.load(open(os.path.join(base, "sim-stats.json")))
+    assert "kern" not in stats["metrics"]["sim"]
+
+
+def test_fn_cache_accounting(forced_runs):
+    """The explicit _FN_CACHE accounting (satellite): the first run
+    built the kernel (a miss with build wall), dispatches after the
+    first are hits, and the block lands in
+    metrics.wall.dispatch.fn_cache."""
+    _tmp, a, b = forced_runs
+    fa = json.load(open(os.path.join(a, "sim-stats.json")))[
+        "metrics"]["wall"]["dispatch"]["fn_cache"]["phold"]
+    assert fa["misses"] >= 1
+    assert fa["build_wall_s"] > 0
+    fb = json.load(open(os.path.join(b, "sim-stats.json")))[
+        "metrics"]["wall"]["dispatch"]["fn_cache"]["phold"]
+    # Run B reuses the process-wide cache: hits only, no build wall.
+    assert fb["misses"] == 0 and fb["hits"] >= 1
+    assert fb["build_wall_s"] == 0
+
+
+def test_dispatch_attribution_fields(forced_runs):
+    """The wall-side dispatch attribution (speculative-window ledger +
+    codec byte volume) rides metrics.wall.dispatch.device_span_*."""
+    _tmp, a, _b = forced_runs
+    d = json.load(open(os.path.join(a, "sim-stats.json")))[
+        "metrics"]["wall"]["dispatch"]["device_span_phold"]
+    assert d["dispatch_wall_s"] > 0
+    assert d["export_bytes"] > 0 and d["import_bytes"] > 0
+    # Clean forced run: nothing rolled back.
+    assert d["rolled_back_rounds"] == 0
+    # (metrics ingest drops empty dicts, so a clean run has no
+    # abort_kinds subtree at all.)
+    assert d.get("abort_kinds", {}) == {}
+    # AOT cost analysis captured per built kernel (wall side).
+    costs = d.get("kernel_costs", [])
+    assert costs and costs[0]["flops"] > 0
+
+
+def test_cli_and_chrome(forced_runs, capsys):
+    """`trace kern` reproduces the attribution from the artifact
+    alone and returns the conservation verdict; the Chrome export
+    carries a per-stage counter track."""
+    from shadow_tpu.tools.trace import explain_report, kern_report
+    _tmp, a, _b = forced_runs
+    assert kern_report(a) is True
+    out = capsys.readouterr().out
+    assert "conservation" in out and "pop" in out
+    assert "crossover attribution" in out
+    # explain renders (kern hints are data-dependent; must not crash).
+    assert explain_report(a) is True
+    from shadow_tpu.trace.chrome import PID_KERN, chrome_trace
+    doc = chrome_trace(_read(a, "flight-sim.bin"), None,
+                       ks_bytes=_read(a))
+    kc = [e for e in doc["traceEvents"]
+          if e.get("ph") == "C" and e.get("pid") == PID_KERN]
+    assert kc, "no per-stage kernel counter track"
+    names = {e["name"] for e in kc}
+    assert any("pop" in n for n in names)
+
+
+def test_ckpt_digest_covers_kernel_observatory():
+    """kernel_observatory is channel state in snapshots (like
+    sim_netstat/sim_fabricstat), so it stays in the config digest —
+    a resume must keep the observability knobs identical."""
+    from shadow_tpu.ckpt.restore import config_digest
+    c1 = phold_cfg("serial")
+    c2 = phold_cfg("serial")
+    c2.experimental.kernel_observatory = "on"
+    assert config_digest(c1) != config_digest(c2)
+
+
+@pytest.mark.slow
+def test_sharded_kern_exchange_stage(tmp_path):
+    """Sharded 8-way phold spans: the cross-shard exchange is just
+    another stage — it fires with staged-packet lanes, conservation
+    still reconciles, and two sharded runs are byte-identical."""
+    from shadow_tpu.trace.events import KS_EXCHANGE
+    from shadow_tpu.trace.kernstat import (check_conservation,
+                                           family_totals)
+    a = _run(tmp_path, "sh-a", "tpu", device_spans="force", shards=8)
+    b = _run(tmp_path, "sh-b", "tpu", device_spans="force", shards=8)
+    ka, kb = _read(a), _read(b)
+    assert ka and ka == kb
+    stats = json.load(open(os.path.join(a, "sim-stats.json")))
+    dispatch = stats["metrics"]["wall"]["dispatch"]
+    ok, problems = check_conservation(ka, dispatch)
+    assert ok, problems
+    ent = family_totals(ka)[1]
+    assert ent["fires"][KS_EXCHANGE] > 0
+    assert ent["lanes"][KS_EXCHANGE] > 0
+
+
+@pytest.mark.slow
+def test_tcp_forced_device_kern(tmp_path):
+    """TCP family forced-device leg: the TCP pipeline stages
+    (on-packet/reassembly/ack/push/flush) fire, trips reconcile
+    against the tcp dispatch split, and two runs are byte-identical."""
+    from shadow_tpu.core.config import ConfigOptions
+    from shadow_tpu.tools.netgen import tcp_stream_yaml
+    from shadow_tpu.trace.events import FAM_TCP, KS_NAMES
+    from shadow_tpu.trace.kernstat import (check_conservation,
+                                           family_totals)
+
+    def run(name):
+        cfg = ConfigOptions.from_yaml_text(tcp_stream_yaml(
+            16, nbytes=50_000_000, loss=0.0, stop_time="2s",
+            seed=11, scheduler="tpu", device_spans="force"))
+        cfg.experimental.kernel_observatory = "on"
+        base = str(tmp_path / name)
+        cfg.general.data_directory = base
+        _m, s = run_simulation(cfg, write_data=True)
+        assert s.ok, s.plugin_errors
+        return base
+
+    a = run("tcp-a")
+    b = run("tcp-b")
+    ka, kb = _read(a), _read(b)
+    assert ka and ka == kb
+    stats = json.load(open(os.path.join(a, "sim-stats.json")))
+    dispatch = stats["metrics"]["wall"]["dispatch"]
+    ok, problems = check_conservation(ka, dispatch)
+    assert ok, problems
+    ent = family_totals(ka)[FAM_TCP]
+    by_name = dict(zip(KS_NAMES, ent["fires"]))
+    for stage in ("pop", "on-packet", "reassembly", "ack", "push",
+                  "flush", "inet-out"):
+        assert by_name[stage] > 0, by_name

@@ -535,3 +535,47 @@ def test_quarantine_flight_kind_drift_is_caught(cpp_text):
     assert any("FR_FAULT_QUARANTINE" in x.message or
                "FR_N" in x.message for x in v), \
         [x.render() for x in v]
+
+
+def test_ks_enum_drift_is_caught(cpp_text):
+    """Device-kernel observatory (ISSUE 15): a drifted stage slot in
+    the C++ registry must flag against every twin — trace/events.py
+    AND both span kernels, which each pin the slots they occupy."""
+    mutated = _mutate(cpp_text, "constexpr int KS_CODEL = 2;",
+                      "constexpr int KS_CODEL = 3;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    msgs = [x.message for x in v]
+    assert sum("KS_CODEL" in m for m in msgs) >= 3, msgs
+
+
+def test_ks_record_size_drift_is_caught(cpp_text):
+    """KS_REC grows only with a coordinated trace/events.py struct
+    change; a one-sided size bump must fail the pass."""
+    mutated = _mutate(cpp_text, "constexpr int KS_REC_BYTES = 224;",
+                      "constexpr int KS_REC_BYTES = 232;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("KS_REC_BYTES" in x.message for x in v), \
+        [x.render() for x in v]
+
+
+def test_unregistered_ks_constant_fails_closed(cpp_text):
+    """A new KS_* stage added to the registry without a contract row
+    (and a trace/events.py twin) must fail the pass, not silently
+    under-check."""
+    mutated = _mutate(cpp_text, "constexpr int KS_REC_BYTES = 224;",
+                      "constexpr int KS_REC_BYTES = 224;\n"
+                      "constexpr int KS_ROGUE = 99;")
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("KS_ROGUE" in x.message and "no contract row"
+               in x.message for x in v), [x.render() for x in v]
+
+
+def test_ks_stage_name_table_reorder_is_caught(cpp_text):
+    """KS_NAMES renders every occupancy table; a reordered entry must
+    flag against the trace/events.py string-table twin."""
+    mutated = _mutate(cpp_text,
+                      '    "pop",\n    "step",\n    "codel",',
+                      '    "step",\n    "pop",\n    "codel",')
+    v = twin_constants.check(ROOT, cpp_text=mutated)
+    assert any("KS_NAMES" in x.message for x in v), \
+        [x.render() for x in v]
