@@ -403,6 +403,25 @@ def phold_rung() -> dict:
     # (ring-16), while the display rungs keep their historical
     # workload shapes for cross-round comparability (the 1k rung is
     # the r5 141.0 s full-mesh comparator).
+    def overlap_identity_pregate() -> bool:
+        """Byte-identity pre-gate for the overlapped pipeline
+        (ISSUE 16): two fully-traced runs at a small ladder shape,
+        span_overlap on vs off, trace lines compared exactly.  The
+        ladder's warm walls are only honest perf numbers if the
+        double buffer provably changes NO simulation byte — a failed
+        gate refuses every rung ("refused-identity")."""
+        def traced(overlap: bool):
+            text = phold_yaml(512, n_init=1, mean_delay_ns=20_000_000,
+                              stop_time="0.3s", seed=13,
+                              scheduler="tpu", device_spans="force",
+                              peers_per_host=16)
+            cfg = ConfigOptions.from_yaml_text(text)
+            cfg.experimental.span_overlap = "on" if overlap else "off"
+            mgr = Manager(cfg)
+            mgr.run()
+            return mgr.trace_lines()
+        return traced(True) == traced(False)
+
     ring_caps = dict(CAP_I=32, CAP_T=16, CAP_R=64, CAP_S=64,
                      CAP_C=256, CAP_P=16)
     ladder = [
@@ -419,6 +438,16 @@ def phold_rung() -> dict:
     frag: dict = {"rungs": {}}
     refused = False
     rows = []
+    if not overlap_identity_pregate():
+        print("bench[phold-ladder]: REFUSED — overlap byte-identity "
+              "pre-gate failed (span_overlap on vs off traces "
+              "diverge); no rung records", file=sys.stderr)
+        for tag, *_rest in ladder:
+            frag["rungs"][tag] = {"outcome": "refused-identity"}
+        frag["refused"] = True
+        frag["overlap_identity"] = "FAILED"
+        return frag
+    frag["overlap_identity"] = "byte-identical"
     for tag, n, stop, n_init, mean, peers, caps, fit in ladder:
         # comparator pinned to the engine path: "auto" could probe
         # the device mid-run with default caps at these host counts
@@ -465,6 +494,10 @@ def phold_rung() -> dict:
             continue
         if fit:
             rows.append((n, dev_round_ms, cpp_round_ms))
+        # The overlapped-pipeline block (ISSUE 16): the honest
+        # record of whether the double buffer hid the host work at
+        # this rung — device_idle_frac is the acceptance number.
+        ov = r.overlap_summary()
         frag["rungs"][tag] = {
             "hosts": n,
             "dev_ms_per_round": round(dev_round_ms, 3),
@@ -473,6 +506,13 @@ def phold_rung() -> dict:
             "warm_wall_s": round(w, 2),
             "fit": fit,
             "kern": kern_block,
+            "overlap": {
+                "in_flight_windows": ov["windows"],
+                "landed": ov["hits"],
+                "refusals": ov["refusals"],
+                "device_idle_frac": ov["device_idle_frac"],
+                "host_idle_frac": ov["host_idle_frac"],
+            },
         }
         print(f"bench[phold-{tag}]: {s.packets_sent} messages; device "
               f"{r.rounds}/{s.rounds} rounds "
@@ -482,7 +522,11 @@ def phold_rung() -> dict:
               f"[{dev_round_ms:.1f} ms/round, per-dispatch floor "
               f"{1e3 * w / r.spans:.0f} ms]; C++ span path "
               f"{s_cpp.packets_sent} msgs in {w_cpp:.1f}s "
-              f"[{cpp_round_ms:.2f} ms/round]", file=sys.stderr)
+              f"[{cpp_round_ms:.2f} ms/round]; overlap "
+              f"{ov['windows']} windows / {ov['hits']} landed, "
+              f"device idle {100.0 * ov['device_idle_frac']:.0f}%, "
+              f"host idle {100.0 * ov['host_idle_frac']:.0f}%",
+              file=sys.stderr)
         if kern_block:
             occ = kern_block.get("occupancy_permille", {})
             tops = ", ".join(

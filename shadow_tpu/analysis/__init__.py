@@ -15,7 +15,8 @@ package catches that drift in seconds, before the differential gates
   and produce exactly those columns with the same dtypes;
 - pass 3 (`determinism`): AST lint over shadow_tpu/ for
   nondeterminism hazards (wall clocks, unseeded RNGs, set iteration,
-  host mutation inside jitted bodies, np-vs-jnp confusion).
+  host mutation inside jitted bodies, np-vs-jnp confusion, engine
+  mutation while an async span dispatch is in flight).
 
 Passes 1-2 need no JAX (pure parsing); the whole run is a tier-1 gate
 (tests/test_twin_contract.py) and a CLI: `python -m shadow_tpu.tools.lint`

@@ -394,3 +394,35 @@ def extract_import_layout(text: str, func: str) -> dict:
                 for sfx, dt in schema:
                     layout[p + m.group(1) + sfx] = dt
     return layout
+
+
+# ---------------------------------------------------------------------------
+# Engine-mutator extraction (state_epoch-bumping entry points)
+# ---------------------------------------------------------------------------
+
+_METHOD_ENTRY = re.compile(
+    r'\{\s*"(\w+)"\s*,\s*\(PyCFunction\)\s*(\w+)', re.S)
+
+
+def extract_epoch_mutators(text: str) -> set:
+    """Python-visible engine method names whose C wrapper bumps
+    state_epoch — the contract list the `async-hazard` lint rule
+    (analysis pass 3) holds against an open in-flight span window.
+
+    Scans the PyMethodDef table (`eng_methods[]`-style entries,
+    `{"name", (PyCFunction)eng_name, ...}`) and keeps every entry
+    whose wrapper body contains a `state_epoch++` / `state_epoch +=`
+    bump.  Fail-closed like the other extractors: an unrecognized
+    table idiom yields a missing method, which the contract test
+    notices — never a silently shorter mutator list."""
+    text = strip_comments(text)
+    mutators = set()
+    for m in _METHOD_ENTRY.finditer(text):
+        pyname, cfunc = m.group(1), m.group(2)
+        try:
+            body = function_body(text, cfunc)
+        except KeyError:
+            continue
+        if re.search(r"\bstate_epoch\s*(?:\+\+|\+=)", body):
+            mutators.add(pyname)
+    return mutators

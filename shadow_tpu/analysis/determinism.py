@@ -30,6 +30,15 @@ Rules (catalogue + rationale in docs/LINT.md):
                  trace/sctrace): the channels are DEFINED to be
                  byte-identical across runs, so this rule has NO
                  pragma escape (fail closed)
+  async-hazard   an engine-mutating call (state_epoch-bumping entry
+                 point, extracted from native/netplane.cpp's method
+                 table) while an async span dispatch (`_span_call`)
+                 is in flight — before the window is forced
+                 (np.asarray / .block_until_ready) or published
+                 through the in-flight guard (`_inflight` /
+                 `_commit_spec`, ops/span_mesh.py).  A mutation in
+                 that gap rebases the window on state the landing
+                 check can no longer see (ISSUE 16)
 
 "Jitted/traced bodies" = functions decorated with jit/jax.jit/
 partial(jax.jit, ..), functions passed to lax.while_loop/scan/cond/
@@ -45,7 +54,8 @@ import re
 from shadow_tpu.analysis.report import Violation
 
 RULES = ("py-random", "np-random", "wall-clock", "set-iter",
-         "host-mutation", "tracer-leak", "np-in-jit", "sim-channel")
+         "host-mutation", "tracer-leak", "np-in-jit", "sim-channel",
+         "async-hazard")
 
 _PRAGMA = re.compile(
     r"#\s*shadow-lint:\s*allow\[([\w\-,\s]+)\]\s*(\S.*)?$")
@@ -392,6 +402,100 @@ class _ModuleLinter:
                               f"cached executions")
 
 
+    # -- async dispatch hazards (ISSUE 16) ---------------------------
+    def lint_async(self, mutators: set):
+        """No engine-mutating call while an async span dispatch is in
+        flight.  A "window" opens at a `._span_call(..)` invocation
+        (the raw jitted dispatch, ops/span_mesh.py) and closes at the
+        first of:
+
+          * a force — `np.asarray(..)` or `.block_until_ready()`;
+          * publication through the in-flight guard — an assignment
+            to a `*_inflight*` attribute or a `._commit_spec(..)`
+            call (the guard stamps `state_epoch` at publication, so
+            later mutations are caught at landing).
+
+        Between open and close, a call to any `state_epoch`-bumping
+        engine entry point (the pass-1 contract list, extracted from
+        native/netplane.cpp's method table) rebases the window on
+        state no landing check can see — flagged.  The scan is
+        per-function in source order; nested defs get their own
+        windows."""
+        if not mutators:
+            return
+        fns = [n for n in ast.walk(self.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in fns:
+            self._lint_async_fn(fn, mutators)
+
+    def _lint_async_fn(self, fn, mutators: set):
+        events = []
+
+        def classify(node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                owner = self._dotted(node.func.value) or []
+                if attr == "_span_call":
+                    events.append((node.lineno, node.col_offset,
+                                   "open", node, attr))
+                elif attr in ("block_until_ready", "_commit_spec") or \
+                        (attr == "asarray"
+                         and owner[:1] in (["np"], ["numpy"])):
+                    events.append((node.lineno, node.col_offset,
+                                   "close", node, attr))
+                elif attr in mutators and \
+                        owner[-1:] in (["engine"], ["eng"]):
+                    events.append((node.lineno, node.col_offset,
+                                   "mutate", node, attr))
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Attribute) and \
+                                "_inflight" in sub.attr:
+                            events.append((node.lineno, node.col_offset,
+                                           "close", node, sub.attr))
+
+        def walk_own(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # separate window scope
+                classify(child)
+                walk_own(child)
+
+        walk_own(fn)
+        events.sort(key=lambda e: (e[0], e[1]))
+        open_at = None
+        for _ln, _col, kind, node, attr in events:
+            if kind == "open":
+                open_at = node.lineno
+            elif kind == "close":
+                open_at = None
+            elif open_at is not None:
+                self.flag("async-hazard", node,
+                          f"engine.{attr}(..) while the span dispatched "
+                          f"at line {open_at} is in flight — force it "
+                          f"(np.asarray / block_until_ready) or publish "
+                          f"it through the in-flight guard "
+                          f"(_commit_spec) first")
+
+
+def epoch_mutators(repo_root: str) -> set:
+    """The async-hazard contract list: every C++ engine entry point
+    that bumps `state_epoch`, extracted from native/netplane.cpp's
+    method table.  Empty set (rule inert) when the native source is
+    absent — the extractor, not a hand list, is the source of truth."""
+    path = os.path.join(repo_root, "native", "netplane.cpp")
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError:
+        return set()
+    from shadow_tpu.analysis.cpp_extract import extract_epoch_mutators
+    return extract_epoch_mutators(text)
+
+
 def iter_py_files(repo_root: str, subdir: str = "shadow_tpu"):
     for dirpath, dirnames, filenames in os.walk(
             os.path.join(repo_root, subdir)):
@@ -405,6 +509,7 @@ def iter_py_files(repo_root: str, subdir: str = "shadow_tpu"):
 def check(repo_root: str, paths=None) -> list:
     violations: list[Violation] = []
     files = paths if paths is not None else iter_py_files(repo_root)
+    mutators = epoch_mutators(repo_root)
     for path in files:
         rel = os.path.relpath(path, repo_root)
         with open(path) as fh:
@@ -418,5 +523,6 @@ def check(repo_root: str, paths=None) -> list:
         linter.lint_global()
         linter.lint_device()
         linter.lint_sim_channel()
+        linter.lint_async(mutators)
         violations.extend(linter.violations)
     return violations

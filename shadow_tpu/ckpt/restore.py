@@ -41,6 +41,12 @@ _DIGEST_SKIP_EXPERIMENTAL = (
     # contained failure's sim-side effects are pinned by the fault
     # ledger, never by these.
     "managed_watchdog", "managed_spawn_stagger",
+    # Overlapped span pipeline (ISSUE 16): dispatch scheduling and
+    # window-sizing knobs are wall-side routing only — byte identity
+    # on/off is gated in tests/test_overlap.py, and the pallas queue
+    # kernels are integer-exact twins of the inline lax forms.
+    "span_overlap", "pallas_queue_kernels",
+    "dev_span_k_init", "dev_span_k_floor", "dev_span_k_shrink",
 )
 
 

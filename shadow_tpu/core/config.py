@@ -198,6 +198,36 @@ class ExperimentalConfig:
     # configured (jax_compilation_cache_dir unset), and fall back to
     # undonated dispatch otherwise — never the corrupting combination.
     tpu_donate_buffers: str = "off"
+    # Overlapped span pipeline (docs/OBSERVABILITY.md "Overlapped
+    # pipeline"): "on" double-buffers the device-span dispatch — after
+    # a window commits, the NEXT speculative window is dispatched
+    # asynchronously (jax async dispatch, no block) and the host-side
+    # import/codec/service work for the committed window runs while
+    # the device executes.  The in-flight record carries the window
+    # bounds and the pre-dispatch engine state_epoch; on landing it
+    # commits only if the bounds match and the epoch is unchanged —
+    # any drift refuses the window (discarded unimported), so all five
+    # sim channels stay byte-identical by construction.  "off" keeps
+    # the strictly serial dispatch.  Wall-side only; digest-skipped.
+    span_overlap: str = "auto"
+    # Lane-parallel queue-scan kernels (ops/pallas_queues.py): "on"
+    # routes the token-bucket refill/conformance scan and the CoDel
+    # head classification of both span families through pallas
+    # kernels (interpret mode on the CPU backend, so tier-1 still
+    # runs them); "off" keeps the inline lax forms.  Integer-exact
+    # either way — byte identity is gated, not assumed.
+    pallas_queue_kernels: str = "off"
+    # Speculative-window heuristics for the device-span router
+    # (core/manager.py), promoted from hard-coded constants:
+    # the starting window in rounds...
+    dev_span_k_init: int = 32
+    # ...the floor the window never shrinks below after an abort...
+    dev_span_k_floor: int = 16
+    # ...and the divisor applied on each abort (the 2x growth cap on
+    # clean commits stays fixed).  All three are wall-side routing
+    # only (never reach simulation bytes) and digest-skipped; the
+    # effective values surface in metrics.wall.dispatch.
+    dev_span_k_shrink: int = 4
     # Deterministic flight recorder (shadow_tpu/trace/,
     # docs/OBSERVABILITY.md): "on" records both channels (sim-time
     # event stream + wall-time phases -> flight-sim.bin /
@@ -400,6 +430,11 @@ class ConfigOptions:
                 "native_dataplane": e.native_dataplane,
                 "tpu_device_spans": e.tpu_device_spans,
                 "tpu_donate_buffers": e.tpu_donate_buffers,
+                "span_overlap": e.span_overlap,
+                "pallas_queue_kernels": e.pallas_queue_kernels,
+                "dev_span_k_init": e.dev_span_k_init,
+                "dev_span_k_floor": e.dev_span_k_floor,
+                "dev_span_k_shrink": e.dev_span_k_shrink,
                 "flight_recorder": e.flight_recorder,
                 "sim_netstat": e.sim_netstat,
                 "netstat_interval": _ns(e.netstat_interval_ns),
@@ -569,6 +604,15 @@ class ConfigOptions:
                 ("tpu_donate_buffers", "tpu_donate_buffers",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
+                ("span_overlap", "span_overlap",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
+                ("pallas_queue_kernels", "pallas_queue_kernels",
+                 lambda v: ("on" if v else "off") if isinstance(v, bool)
+                 else str(v)),
+                ("dev_span_k_init", "dev_span_k_init", int),
+                ("dev_span_k_floor", "dev_span_k_floor", int),
+                ("dev_span_k_shrink", "dev_span_k_shrink", int),
                 ("flight_recorder", "flight_recorder",
                  lambda v: ("on" if v else "off") if isinstance(v, bool)
                  else str(v)),
@@ -668,6 +712,22 @@ class ConfigOptions:
                 f"unknown tpu_donate_buffers "
                 f"{experimental.tpu_donate_buffers!r}; "
                 f"expected one of ('off', 'on')")
+        if experimental.span_overlap not in ("off", "on", "auto"):
+            raise ValueError(
+                f"unknown span_overlap "
+                f"{experimental.span_overlap!r}; "
+                f"expected one of ('off', 'on', 'auto')")
+        if experimental.pallas_queue_kernels not in ("off", "on"):
+            raise ValueError(
+                f"unknown pallas_queue_kernels "
+                f"{experimental.pallas_queue_kernels!r}; "
+                f"expected one of ('off', 'on')")
+        if experimental.dev_span_k_init < 1:
+            raise ValueError("dev_span_k_init must be >= 1")
+        if experimental.dev_span_k_floor < 1:
+            raise ValueError("dev_span_k_floor must be >= 1")
+        if experimental.dev_span_k_shrink < 1:
+            raise ValueError("dev_span_k_shrink must be >= 1")
 
         hosts_raw = raw.get("hosts", {}) or {}
         if not hosts_raw:

@@ -917,8 +917,19 @@ class Manager:
         # shrink hard on an abort.  Residency (ops/phold_span.py)
         # makes the re-dispatch after a short span nearly free, so
         # starting small costs little and caps the price of a wrong
-        # runahead/domain prediction.
-        dev_span_K = 32
+        # runahead/domain prediction.  The start/floor/shrink
+        # heuristics are config knobs (experimental.dev_span_k_*,
+        # digest-skipped — wall-side routing only); the 2x growth cap
+        # stays fixed.
+        dev_span_K = self.config.experimental.dev_span_k_init
+        dev_k_floor = self.config.experimental.dev_span_k_floor
+        dev_k_shrink = self.config.experimental.dev_span_k_shrink
+        # Overlapped span pipeline (ISSUE 16): when on, every device
+        # span dispatch also carries the NEXT window's speculative
+        # max-rounds (the post-commit doubling, computed up front so
+        # the in-flight record's params match the next dispatch), and
+        # the runner double-buffers asynchronously.
+        overlap_on = self._span_overlap_on()
         from shadow_tpu.core.simtime import TIME_NEVER
         from shadow_tpu.trace import events as trev
         # Device-eligibility audit state: every conservative round is
@@ -1214,7 +1225,9 @@ class Manager:
                     t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
                     res, runner = self._device_span(
                         start, stop, limit,
-                        min(max_rounds, dev_span_K))
+                        min(max_rounds, dev_span_K),
+                        spec_mr=(min(dev_span_K * 2, max_rounds)
+                                 if overlap_on else 0))
                     family = (trev.FAM_TCP
                               if runner is self._dev_span_tcp
                               else trev.FAM_PHOLD)
@@ -1277,7 +1290,8 @@ class Manager:
                                 start, trev.FR_SPAN_ABORT, family,
                                 getattr(runner, "last_abort_code", 0),
                                 0)
-                        dev_span_K = max(16, dev_span_K // 4)
+                        dev_span_K = max(dev_k_floor,
+                                         dev_span_K // dev_k_shrink)
                         dev_aborts_row += 1
                         dev_probe_countdown = 16 * dev_aborts_row
                         if dev_aborts_row >= 3:
@@ -1743,7 +1757,30 @@ class Manager:
         if self.config.experimental.kernel_observatory in ("wall",
                                                            "on"):
             runner.kern_wall = True
+        # Overlapped span pipeline + lane-parallel queue kernels
+        # (ISSUE 16): both static per Manager; pallas_queues is part
+        # of the kernel cache key, overlap only gates the driver.
+        runner.overlap = self._span_overlap_on()
+        runner.pallas_queues = \
+            self.config.experimental.pallas_queue_kernels == "on"
         return runner
+
+    def _span_overlap_on(self) -> bool:
+        """Resolve `experimental.span_overlap` to the driver gate.
+
+        `auto` speculates only on a real accelerator backend: there
+        the device executes the in-flight window asynchronously while
+        the host drains/converts, which is the whole point.  On the
+        CPU backend the "device" is the same cores the host work
+        needs, so a speculative window can never hide behind host
+        work — it only adds compute (same reasoning that routes the
+        pallas kernels through interpret mode there).  Bytes are
+        identical either way; this is wall-side routing only."""
+        mode = self.config.experimental.span_overlap
+        if mode == "auto":
+            import jax
+            return jax.default_backend() != "cpu"
+        return mode == "on"
 
     def make_dev_span_runner(self):
         from shadow_tpu.ops.phold_span import PholdSpanRunner
@@ -1754,19 +1791,20 @@ class Manager:
         return self._make_span_runner(TcpSpanRunner)
 
     def _device_span(self, start: int, stop: int, limit: int,
-                     max_rounds: int):
+                     max_rounds: int, spec_mr: int = 0):
         """Attempt one device-resident multi-round span, routing
         between the PHOLD/udp-mesh family and the TCP steady-stream
         family.  Returns (result, runner); result None = ineligible /
         transient / aborted (the engine state is untouched either way
-        — transactional)."""
+        — transactional).  `spec_mr > 0` lets a clean commit dispatch
+        the next window's speculative async dispatch (ISSUE 16)."""
         args = (start, stop, limit, self.runahead.get(),
                 self.runahead.dynamic, max_rounds)
         if self._dev_span is None:
             self._dev_span = self.make_dev_span_runner()
         phold = self._dev_span
         if not phold.ineligible:
-            res = phold.try_span(*args)
+            res = phold.try_span(*args, spec_mr=spec_mr)
             if res is not None or not phold.ineligible:
                 return res, phold
         # permanently not phold-shaped: the TCP family
@@ -1775,7 +1813,7 @@ class Manager:
         tcp = self._dev_span_tcp
         if tcp.ineligible:
             return None, tcp
-        return tcp.try_span(*args), tcp
+        return tcp.try_span(*args, spec_mr=spec_mr), tcp
 
     def _apply_fault(self, f, at: int, fr_sim) -> None:
         """Apply one `faults:` entry at round boundary `at` — the ONE
@@ -1959,6 +1997,18 @@ class Manager:
             # generic clamp applied).
             "pcap_span_cap": (self.config.experimental.pcap_span_cap
                               if self._pcap_engine else 1024),
+            # Overlapped span pipeline (ISSUE 16): the effective knob
+            # values the router ran with (the dev_span_k_* heuristics
+            # and the overlap/pallas modes) — wall-side routing
+            # telemetry, like pcap_span_cap.
+            "span_overlap": self.config.experimental.span_overlap,
+            "pallas_queue_kernels":
+                self.config.experimental.pallas_queue_kernels,
+            "dev_span_k": {
+                "init": self.config.experimental.dev_span_k_init,
+                "floor": self.config.experimental.dev_span_k_floor,
+                "shrink": self.config.experimental.dev_span_k_shrink,
+            },
         }
         if getattr(prop, "n_shards", 1) > 1:
             # Sharded per-round path: the on-device exchange's packet
@@ -2014,6 +2064,12 @@ class Manager:
                     "abort_kinds": dict(runner.abort_kind_counts()),
                     "export_bytes": getattr(runner, "export_bytes", 0),
                     "import_bytes": getattr(runner, "import_bytes", 0),
+                    # Overlap counters (ISSUE 16): speculative windows
+                    # dispatched/landed/refused and the host/device
+                    # idle walls of the landed pipe — what `trace
+                    # kern`'s overlap report and bench's per-rung
+                    # overlap block read.
+                    "overlap": runner.overlap_summary(),
                 }
                 if getattr(runner, "kernel_costs", None):
                     # Compiled.cost_analysis() per AOT-built kernel

@@ -474,7 +474,7 @@ class PholdSpanRunner(SpanMeshMixin):
                self.CAP_R, self.CAP_S, self.CAP_C, self.cap_out,
                self.cap_tr, self.tracing, self.family, self.fused,
                self._fabric_params(), self.kern is not None,
-               self.mesh, self.exchange_cap)
+               self.mesh, self.exchange_cap, self.pallas_queues)
         return self._cache_fn(_FN_CACHE, key, lambda: self._build(P))
 
     def _build(self, P: int):
@@ -497,6 +497,17 @@ class PholdSpanRunner(SpanMeshMixin):
         kern = self.kern is not None  # static: stage counters on
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)  # mode="drop" sink for masked-out lanes
+
+        # Lane-parallel queue-scan kernels (ISSUE 16): the bucket and
+        # CoDel-head laws live in ops/pallas_queues.py — the lax
+        # reference inline, or its pallas twin when the knob is on
+        # (unsharded only: the GSPMD partitioner owns the sharded
+        # while_loop body).  Static, so part of the _FN_CACHE key.
+        from shadow_tpu.ops import pallas_queues as plq
+        pq = self.pallas_queues and n_shards == 1
+        bucket_step = plq.make_bucket_step(jax, jnp, H, REFILL_NS, pq)
+        codel_head = plq.make_codel_head(jax, jnp, H, CODEL_TARGET_NS,
+                                         MTU, pq)
 
         def mrows(mask):
             return jnp.where(mask, hidx, OOB)
@@ -644,21 +655,9 @@ class PholdSpanRunner(SpanMeshMixin):
         def bucket_try(st, r, now, mask):
             bal = st[f"r{r}_bal"]
             nxt = st[f"r{r}_next"]
-            refill = st[f"r{r}_refill"]
-            cap = st[f"r{r}_cap"]
-            unlimited = st[f"r{r}_unlimited"] == 1
-            first = nxt == 0
-            k = jnp.maximum(np.int64(0),
-                            1 + (now - nxt) // np.int64(REFILL_NS))
-            do_ref = ~first & (now >= nxt)
-            bal2 = jnp.where(do_ref, jnp.minimum(cap, bal + k * refill),
-                             bal)
-            nxt2 = jnp.where(first, now + np.int64(REFILL_NS),
-                             jnp.where(do_ref,
-                                       nxt + k * np.int64(REFILL_NS),
-                                       nxt))
-            ok = unlimited | (st["_psize"] <= bal2)
-            bal3 = jnp.where(~unlimited & ok, bal2 - st["_psize"], bal2)
+            bal3, nxt2, ok = bucket_step(
+                bal, nxt, st[f"r{r}_refill"], st[f"r{r}_cap"],
+                st[f"r{r}_unlimited"] == 1, st["_psize"], now)
             st = dict(st)
             st[f"r{r}_bal"] = jnp.where(mask, bal3, bal)
             st[f"r{r}_next"] = jnp.where(mask, nxt2, nxt)
@@ -721,17 +720,11 @@ class PholdSpanRunner(SpanMeshMixin):
                 st["codel_bytes"] = jnp.where(
                     pop, st["codel_bytes"] - st["_psize"],
                     st["codel_bytes"])
-                # dequeue_raw's ok/first_above law
-                sojourn = now - enq
-                quiet = pop & ((sojourn < CODEL_TARGET_NS)
-                               | (st["codel_bytes"] <= MTU))
-                above = pop & ~quiet
-                arm = above & (st["codel_first_above"] == 0)
-                cok = above & ~arm & (now >= st["codel_first_above"])
-                st["codel_first_above"] = jnp.where(
-                    quiet | none, 0,
-                    jnp.where(arm, now + np.int64(100_000_000),
-                              st["codel_first_above"]))
+                # dequeue_raw's ok/first_above law (pallas_queues)
+                quiet, above, arm, cok, fa_new = codel_head(
+                    pop, none, now, enq, st["codel_bytes"],
+                    st["codel_first_above"])
+                st["codel_first_above"] = fa_new
                 st["codel_dropping"] = jnp.where(none, 0,
                                                  st["codel_dropping"])
                 st["cd_chain"] = jnp.where(none, 0, st["cd_chain"])
@@ -1814,9 +1807,21 @@ class PholdSpanRunner(SpanMeshMixin):
                                      st["s_waitseq"]) + 1
         return st
 
+    def _clamp_mr(self, mr: int | None) -> int:
+        """The effective max-rounds law for one dispatch — shared by
+        the normal and the speculative path so an in-flight window's
+        recorded params land against the same clamp."""
+        mr = self.MAX_ROUNDS if mr is None else mr
+        if self.fabric is not None:
+            # Sampled rounds <= rounds <= FAB_ROWS: the device-side
+            # sample buffers can never overflow (a silent skip would
+            # break cross-path byte-parity).
+            mr = min(mr, self.FAB_ROWS)
+        return mr
+
     def try_span(self, start: int, stop: int, limit: int,
                  runahead: int, dynamic: bool,
-                 max_rounds: int | None = None):
+                 max_rounds: int | None = None, spec_mr: int = 0):
         """Export -> device span -> import.  Returns (rounds,
         busy_rounds, packets, next_start, busy_end, runahead) or None
         when ineligible / zero-progress / aborted.
@@ -1826,51 +1831,68 @@ class PholdSpanRunner(SpanMeshMixin):
         the previous span's device-resident output is reused directly
         and the export+conversion leg of the dispatch tunnel is
         skipped; ANY other engine call in between makes the resident
-        copy stale and forces a fresh export (never silent reuse)."""
-        eng_epoch = self.engine.state_epoch()
-        resident = (self._res_st is not None
-                    and self._res_token == eng_epoch)
-        if self._res_st is not None and not resident:
-            self.stale_drops += 1
-            self._res_st = None
-        if resident:
-            self.resident_hits += 1
-            st = self._resident_input()
-            self._res_st = None  # consumed by this dispatch
+        copy stale and forces a fresh export (never silent reuse).
+
+        Overlap (ISSUE 16): with `spec_mr > 0` and span_overlap on, a
+        clean commit dispatches window K+1 asynchronously (max
+        `spec_mr` rounds) before the host-side import work runs; the
+        NEXT try_span lands it through _take_inflight iff the window
+        params match and the engine epoch is unchanged — otherwise
+        the unforced record is discarded unimported (SpanMeshMixin)."""
+        mr = self._clamp_mr(max_rounds)
+        landed = self._take_inflight(
+            (int(start), int(stop), int(limit), int(runahead),
+             bool(dynamic), mr))
+        if landed is not None:
+            # The speculative dispatch consumed the resident carry's
+            # arrays as its input; an abort retry must re-export.
+            resident = True
         else:
-            st = self._export_state()
-            if st is None:
-                # structurally not a phold sim — permanent for this run
-                self.ineligible += 1
-                return None
-            if isinstance(st, int):
-                # transiently beyond the ring caps (burst): retry later
-                self.over_caps += 1
-                return None
-        # Re-resolve per span (a dict lookup when nothing changed) so
-        # a runner.fused toggle between spans takes effect — the tcp
-        # twin does the same.
-        self._fn = self._cached_build(
-            self._static_cols["peers"].shape[1])
-        if self.mesh is not None:
-            st = self._mesh_put(st)
-        mr = self.MAX_ROUNDS if max_rounds is None else max_rounds
-        if self.fabric is not None:
-            # Sampled rounds <= rounds <= FAB_ROWS: the device-side
-            # sample buffers can never overflow (a silent skip would
-            # break cross-path byte-parity).
-            mr = min(mr, self.FAB_ROWS)
+            eng_epoch = self.engine.state_epoch()
+            resident = (self._res_st is not None
+                        and self._res_token == eng_epoch)
+            if self._res_st is not None and not resident:
+                self.stale_drops += 1
+                self._res_st = None
+            if resident:
+                self.resident_hits += 1
+                st = self._resident_input()
+                self._res_st = None  # consumed by this dispatch
+            else:
+                st = self._export_state()
+                if st is None:
+                    # structurally not a phold sim — permanent for
+                    # this run
+                    self.ineligible += 1
+                    return None
+                if isinstance(st, int):
+                    # transiently beyond the ring caps (burst): retry
+                    # later
+                    self.over_caps += 1
+                    return None
+            # Re-resolve per span (a dict lookup when nothing
+            # changed) so a runner.fused toggle between spans takes
+            # effect — the tcp twin does the same.
+            self._fn = self._cached_build(
+                self._static_cols["peers"].shape[1])
+            if self.mesh is not None:
+                st = self._mesh_put(st)
         w = self.wall
         for _grow in range(4):
             t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
-            fresh_fn = id(self._fn) not in self._timed_fns
-            out = self._span_call(
-                self._fn,
-                st, self._lat, self._thr, self._node,
-                self._ips_sorted, self._ips_perm,
-                np.uint32(self._k[0]), np.uint32(self._k[1]),
-                np.int64(self.bootstrap_end), np.int64(self._pay),
-                start, stop, limit, runahead, mr)
+            spec_rec, landed = landed, None
+            if spec_rec is not None:
+                fresh_fn = False
+                out = spec_rec["out"]
+            else:
+                fresh_fn = id(self._fn) not in self._timed_fns
+                out = self._span_call(
+                    self._fn,
+                    st, self._lat, self._thr, self._node,
+                    self._ips_sorted, self._ips_perm,
+                    np.uint32(self._k[0]), np.uint32(self._k[1]),
+                    np.int64(self.bootstrap_end), np.int64(self._pay),
+                    start, stop, limit, runahead, mr)
             (st_out, next_start, ra, rounds, busy_rounds, packets,
              busy_end, span_iters) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
@@ -1884,10 +1906,20 @@ class PholdSpanRunner(SpanMeshMixin):
             dt = time.perf_counter_ns() - t0  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
             self._timed_fns.add(id(self._fn))
             self.device_wall_ns += dt
-            if fresh_fn:
-                self._credit_build(self._fn, dt)
-            if w is not None:
-                w.add("compile" if fresh_fn else "execute", dt, t0)
+            if spec_rec is not None:
+                # A landed window's force wait is host idle (the
+                # device was already running); its dispatch->force
+                # wall is the pipe the idle fractions divide by.
+                self.overlap_wait_ns += dt
+                self.overlap_pipe_ns += \
+                    time.perf_counter_ns() - spec_rec["t_disp"]  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+                if w is not None:
+                    w.add("overlap-land", dt, t0)
+            else:
+                if fresh_fn:
+                    self._credit_build(self._fn, dt)
+                if w is not None:
+                    w.add("compile" if fresh_fn else "execute", dt, t0)
             if code == 0:
                 break
             # Speculative-window waste: the aborted dispatch's wall
@@ -1955,6 +1987,21 @@ class PholdSpanRunner(SpanMeshMixin):
             self._res_st = st_out
             self._res_token = self.engine.state_epoch()
             return (0, 0, 0, int(start), int(start), int(runahead))
+        # Overlap: dispatch window K+1 asynchronously NOW, so the
+        # device executes it while the host does this window's codec
+        # conversion + engine import below.  Donation is excluded (a
+        # donated carry cannot serve as both resident state and the
+        # speculative input).  The record is committed (epoch-stamped
+        # and published) only after the import below bumped the
+        # epoch — the async-hazard lint rule holds this window open.
+        ra_out = int(ra) if dynamic else int(runahead)
+        spec = None
+        if self.overlap and spec_mr > 0 and not self.donate_active() \
+                and int(next_start) < int(stop) \
+                and int(next_start) < int(limit):
+            spec = self._speculate(st_out, int(next_start), int(stop),
+                                   int(limit), ra_out, dynamic,
+                                   spec_mr)
         traces = None
         if self.tracing:
             n = int(st_np["tr_n"])
@@ -2016,6 +2063,41 @@ class PholdSpanRunner(SpanMeshMixin):
         self.spans += 1
         self.rounds += int(rounds)
         self.micro_iters += int(span_iters)
-        ra_out = int(ra) if dynamic else runahead
+        if spec is not None:
+            self._commit_spec(spec)
         return (int(rounds), int(busy_rounds), int(packets),
                 int(next_start), int(busy_end), ra_out)
+
+    def _speculate(self, st_out, start, stop, limit, runahead,
+                   dynamic, spec_mr):
+        """Async double-buffered dispatch of window K+1 (ISSUE 16):
+        rebuild the span input from the just-committed device output
+        (the residency law — _resident_input — so no export touches
+        the engine) and dispatch WITHOUT forcing; jax async dispatch
+        returns unforced device arrays and XLA executes them on its
+        own threads while the caller runs the host-side import.  The
+        returned record is a Future in all but name; SpanMeshMixin
+        owns its commit/land/refuse protocol."""
+        mr = self._clamp_mr(spec_mr)
+        saved = self._res_st
+        self._res_st = st_out
+        st = self._resident_input()
+        self._res_st = saved
+        if self.mesh is not None:
+            st = self._mesh_put(st)
+        w = self.wall
+        t0 = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+        out = self._span_call(
+            self._fn,
+            st, self._lat, self._thr, self._node,
+            self._ips_sorted, self._ips_perm,
+            np.uint32(self._k[0]), np.uint32(self._k[1]),
+            np.int64(self.bootstrap_end), np.int64(self._pay),
+            start, stop, limit, runahead, mr)
+        self.overlap_windows += 1
+        if w is not None:
+            w.add("dispatch",
+                  time.perf_counter_ns() - t0, t0)  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+        return self._speculate_record(
+            out, t0, (start, stop, limit, runahead, bool(dynamic),
+                      mr))

@@ -115,6 +115,103 @@ class SpanMeshMixin:
     _aot_off = False         # AOT path disabled after a failure
     kernel_costs = None      # Compiled.cost_analysis() per built fn
 
+    # ---- Overlapped span pipeline (ISSUE 16) ------------------------
+    # `overlap` (experimental.span_overlap, set by the manager's
+    # runner factory) double-buffers dispatch: after a clean commit
+    # the driver dispatches the NEXT speculative window asynchronously
+    # (jax async dispatch — unforced device arrays) and records it in
+    # `_inflight` together with the window params and the post-import
+    # engine state_epoch; the host-side import/codec/service work for
+    # the committed window then runs while the device executes.  The
+    # next try_span LANDS the record iff the params match exactly and
+    # the epoch has not moved — any drift refuses the window (the
+    # record is discarded UNIMPORTED, so nothing speculative ever
+    # reaches engine bytes: byte identity by construction).
+    # `pallas_queues` (experimental.pallas_queue_kernels) routes the
+    # token-bucket/CoDel scans through ops/pallas_queues.py.
+    overlap = False
+    pallas_queues = False
+    _inflight = None         # {"out", "t_disp", "params", "epoch",
+    #                          "t_flush", "ready_at_flush"} or None
+    overlap_windows = 0      # speculative windows dispatched
+    overlap_hits = 0         # ...landed and consumed
+    overlap_refusals = 0     # ...refused (params/epoch mismatch)
+    overlap_stale = 0        # refusals caused by state_epoch drift
+    overlap_wait_ns = 0      # HOST idle: wall blocked forcing a
+    #                          landed window (device still running)
+    overlap_idle_ns = 0      # DEVICE idle (lower bound): flush->land
+    #                          gap, counted only when the window was
+    #                          already ready at flush time
+    overlap_pipe_ns = 0      # dispatch->force wall of landed windows
+
+    def _speculate_record(self, out, t_disp, params):
+        """The Future-shaped in-flight record: unforced device arrays
+        plus everything the landing check needs.  `epoch` is stamped
+        at _commit_spec time (AFTER the committed window's import
+        bumped it) — the async-hazard lint rule (analysis pass 3)
+        enforces that no engine mutator runs between dispatch and
+        that commit point."""
+        return {"out": out, "t_disp": t_disp, "params": params,
+                "epoch": None, "t_flush": 0, "ready_at_flush": False}
+
+    def _commit_spec(self, spec) -> None:
+        """Commit point of an async dispatch: stamp the engine epoch
+        (all host-side work for the committed window has run; any
+        LATER engine mutation invalidates the record at landing) and
+        probe — without blocking — whether the device already
+        finished, so the flush->land gap can be attributed as device
+        idle honestly (ready_at_flush False keeps it a lower bound)."""
+        spec["epoch"] = self.engine.state_epoch()
+        try:
+            spec["ready_at_flush"] = bool(
+                spec["out"][0]["abort_code"].is_ready())
+        except Exception:
+            spec["ready_at_flush"] = False
+        spec["t_flush"] = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+        self._inflight = spec
+
+    def _take_inflight(self, params):
+        """Land (or refuse) the in-flight window for this try_span
+        call.  Returns the record on a hit, None otherwise; ALWAYS
+        clears `_inflight` — a refused window is discarded unimported
+        (the committed resident state still serves the normal path,
+        so refusal costs one dispatch, never correctness)."""
+        spec, self._inflight = self._inflight, None
+        if spec is None:
+            return None
+        if spec["params"] != params:
+            self.overlap_refusals += 1
+            return None
+        if self.engine.state_epoch() != spec["epoch"]:
+            self.overlap_refusals += 1
+            self.overlap_stale += 1
+            return None
+        self.overlap_hits += 1
+        # A landed window is residency-served: its input was rebuilt
+        # from the resident device output at speculate time, and no
+        # export ran — the residency counter keeps meaning
+        # "dispatches served without an engine export".
+        self.resident_hits += 1
+        if spec["ready_at_flush"]:
+            now = time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+            self.overlap_idle_ns += now - spec["t_flush"]
+        return spec
+
+    def overlap_summary(self) -> dict:
+        """The per-family `overlap` block in metrics.wall.dispatch."""
+        pipe = max(self.overlap_pipe_ns, 1)
+        return {
+            "windows": self.overlap_windows,
+            "hits": self.overlap_hits,
+            "refusals": self.overlap_refusals,
+            "stale_refusals": self.overlap_stale,
+            "host_idle_wall_s": round(self.overlap_wait_ns / 1e9, 3),
+            "device_idle_wall_s": round(self.overlap_idle_ns / 1e9, 3),
+            "pipe_wall_s": round(self.overlap_pipe_ns / 1e9, 3),
+            "host_idle_frac": round(self.overlap_wait_ns / pipe, 4),
+            "device_idle_frac": round(self.overlap_idle_ns / pipe, 4),
+        }
+
     def _cache_fn(self, cache: dict, key, build):
         """THE _FN_CACHE lookup both runners use: explicit hit/miss
         accounting instead of the old compile-vs-execute guessing

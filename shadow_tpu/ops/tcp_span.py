@@ -629,7 +629,8 @@ class TcpSpanRunner(SpanMeshMixin):
                self.cap_tr, self.tracing, self.fused,
                self._netstat_params(), self._fabric_params(),
                self.kern is not None,
-               self.dctcp_k, self.mesh, self.exchange_cap)
+               self.dctcp_k, self.mesh, self.exchange_cap,
+               self.pallas_queues)
         return self._cache_fn(_FN_CACHE, key, self._build)
 
     def _build(self):
@@ -657,6 +658,16 @@ class TcpSpanRunner(SpanMeshMixin):
         hidx = jnp.arange(H, dtype=jnp.int32)
         OOB = jnp.int32(H + 1)
         COOB = jnp.int32(CC + 1)
+
+        # Lane-parallel queue-scan kernels (ISSUE 16, phold_span
+        # twin): shared bucket/CoDel-head laws from pallas_queues —
+        # inline lax reference, or the pallas twin when the knob is
+        # on (unsharded only).  Static: part of the _FN_CACHE key.
+        from shadow_tpu.ops import pallas_queues as plq
+        pq = self.pallas_queues and n_shards == 1
+        bucket_step = plq.make_bucket_step(jax, jnp, H, REFILL_NS, pq)
+        codel_head = plq.make_codel_head(jax, jnp, H, CODEL_TARGET_NS,
+                                         MTU, pq)
 
         def mrows(mask):
             return jnp.where(mask, hidx, OOB)
@@ -951,21 +962,9 @@ class TcpSpanRunner(SpanMeshMixin):
         def bucket_try(st, r, now, mask, size):
             bal = st[f"r{r}_bal"]
             nxt = st[f"r{r}_next"]
-            refill = st[f"r{r}_refill"]
-            cap = st[f"r{r}_cap"]
-            unlimited = st[f"r{r}_unlimited"] == 1
-            first = nxt == 0
-            k = jnp.maximum(np.int64(0),
-                            1 + (now - nxt) // np.int64(REFILL_NS))
-            do_ref = ~first & (now >= nxt)
-            bal2 = jnp.where(do_ref, jnp.minimum(cap, bal + k * refill),
-                             bal)
-            nxt2 = jnp.where(first, now + np.int64(REFILL_NS),
-                             jnp.where(do_ref,
-                                       nxt + k * np.int64(REFILL_NS),
-                                       nxt))
-            ok = unlimited | (size <= bal2)
-            bal3 = jnp.where(~unlimited & ok, bal2 - size, bal2)
+            bal3, nxt2, ok = bucket_step(
+                bal, nxt, st[f"r{r}_refill"], st[f"r{r}_cap"],
+                st[f"r{r}_unlimited"] == 1, size, now)
             st = dict(st)
             st[f"r{r}_bal"] = jnp.where(mask, bal3, bal)
             st[f"r{r}_next"] = jnp.where(mask, nxt2, nxt)
@@ -1103,17 +1102,11 @@ class TcpSpanRunner(SpanMeshMixin):
                                      st["cq_pos"])
             st["codel_bytes"] = jnp.where(
                 pop, st["codel_bytes"] - size, st["codel_bytes"])
-            # dequeue_raw's ok/first_above law (netplane codel_pop)
-            sojourn = now - enq
-            quiet = pop & ((sojourn < CODEL_TARGET_NS)
-                           | (st["codel_bytes"] <= MTU))
-            above = pop & ~quiet
-            arm = above & (st["codel_first_above"] == 0)
-            cok = above & ~arm & (now >= st["codel_first_above"])
-            st["codel_first_above"] = jnp.where(
-                quiet | none, 0,
-                jnp.where(arm, now + np.int64(100_000_000),
-                          st["codel_first_above"]))
+            # dequeue_raw's ok/first_above law (pallas_queues)
+            quiet, above, arm, cok, fa_new = codel_head(
+                pop, none, now, enq, st["codel_bytes"],
+                st["codel_first_above"])
+            st["codel_first_above"] = fa_new
             st["codel_dropping"] = jnp.where(none, 0,
                                              st["codel_dropping"])
             st["cd_chain"] = jnp.where(none, 0, st["cd_chain"])
@@ -2580,9 +2573,27 @@ class TcpSpanRunner(SpanMeshMixin):
         from shadow_tpu.trace.fabricstat import emit_device_rows
         emit_device_rows(self.fabric, st_np, self._H)
 
+    def _clamp_mr(self, mr: int | None) -> int:
+        """The effective max-rounds law for one dispatch (phold_span
+        twin) — shared by the normal and the speculative path so an
+        in-flight window's recorded params land against the same
+        clamp.  Clamp span length: the flat trace buffer accumulates
+        across the whole span, and TCP rounds carry ~100x phold's
+        traffic."""
+        mr = self.MAX_ROUNDS if mr is None \
+            else min(mr, self.MAX_ROUNDS)
+        if self.netstat is not None:
+            # Sampled rounds <= rounds <= TEL_ROWS: the device-side
+            # telemetry buffers can never overflow (a silent skip
+            # would break cross-path byte-parity).
+            mr = min(mr, self.TEL_ROWS)
+        if self.fabric is not None:
+            mr = min(mr, self.FAB_ROWS)  # same overflow-proof clamp
+        return mr
+
     def try_span(self, start: int, stop: int, limit: int,
                  runahead: int, dynamic: bool,
-                 max_rounds: int | None = None):
+                 max_rounds: int | None = None, spec_mr: int = 0):
         """Export -> device span -> import.  Returns (rounds,
         busy_rounds, packets, next_start, busy_end, runahead) or None
         when ineligible / transiently out of domain / aborted.
@@ -2591,68 +2602,79 @@ class TcpSpanRunner(SpanMeshMixin):
         is unchanged since our last import, the previous span's
         device-resident output is reused and the export+conversion
         leg of the dispatch is skipped; any other engine call forces
-        a fresh export."""
+        a fresh export.
+
+        Overlap (ISSUE 16, phold_span twin): with `spec_mr > 0` and
+        span_overlap on, a clean commit dispatches window K+1
+        asynchronously before the host-side import runs; the NEXT
+        try_span lands it through _take_inflight iff the params match
+        and the engine epoch is unchanged."""
         self.last_transient = False
-        eng_epoch = self.engine.state_epoch()
-        resident = (self._res_st is not None
-                    and self._res_token == eng_epoch)
-        if self._res_st is not None and not resident:
-            self.stale_drops += 1
-            self._res_st = None
-        if resident:
-            self.resident_hits += 1
-            st = self._resident_input()
-            self._res_st = None  # consumed by this dispatch
-        else:
-            st = self._export_state()
-            if st is None:
-                self.ineligible += 1
-                return None
-            if isinstance(st, int):
-                # transiently outside the steady-stream domain
-                # (handshake, close, over-caps): the router retries
-                # soon
-                self.over_caps += 1
-                self.last_transient = True
-                return None
-        st = dict(st)
-        st.pop("_n_conns", None)
-        n_conns = self._static_cols["_n_conns"]
         import os
         import sys
         import time as _time
         dbg = os.environ.get("SHADOWTPU_TCPSPAN_DBG")
         if dbg:
-            print(f"[tcp_span] export ok: {n_conns} conns, "
-                  f"CC={self._CC}, start={start}, "
-                  f"resident={resident}", file=sys.stderr,
-                  flush=True)
             _t0 = _time.perf_counter()  # shadow-lint: allow[wall-clock] debug span timing
-        self._fn = self._cached_build()
-        if self.mesh is not None:
-            st = self._mesh_put(st)
-        # Clamp span length: the flat trace buffer accumulates across
-        # the whole span, and TCP rounds carry ~100x phold's traffic.
-        mr = self.MAX_ROUNDS if max_rounds is None \
-            else min(max_rounds, self.MAX_ROUNDS)
-        if self.netstat is not None:
-            # Sampled rounds <= rounds <= TEL_ROWS: the device-side
-            # telemetry buffers can never overflow (a silent skip
-            # would break cross-path byte-parity).
-            mr = min(mr, self.TEL_ROWS)
-        if self.fabric is not None:
-            mr = min(mr, self.FAB_ROWS)  # same overflow-proof clamp
+        mr = self._clamp_mr(max_rounds)
+        landed = self._take_inflight(
+            (int(start), int(stop), int(limit), int(runahead),
+             bool(dynamic), mr))
+        if landed is not None:
+            # The speculative dispatch consumed the resident carry's
+            # arrays as its input; an abort retry must re-export.
+            resident = True
+            n_conns = self._static_cols["_n_conns"]
+        else:
+            eng_epoch = self.engine.state_epoch()
+            resident = (self._res_st is not None
+                        and self._res_token == eng_epoch)
+            if self._res_st is not None and not resident:
+                self.stale_drops += 1
+                self._res_st = None
+            if resident:
+                self.resident_hits += 1
+                st = self._resident_input()
+                self._res_st = None  # consumed by this dispatch
+            else:
+                st = self._export_state()
+                if st is None:
+                    self.ineligible += 1
+                    return None
+                if isinstance(st, int):
+                    # transiently outside the steady-stream domain
+                    # (handshake, close, over-caps): the router
+                    # retries soon
+                    self.over_caps += 1
+                    self.last_transient = True
+                    return None
+            st = dict(st)
+            st.pop("_n_conns", None)
+            n_conns = self._static_cols["_n_conns"]
+            if dbg:
+                print(f"[tcp_span] export ok: {n_conns} conns, "
+                      f"CC={self._CC}, start={start}, "
+                      f"resident={resident}", file=sys.stderr,
+                      flush=True)
+            self._fn = self._cached_build()
+            if self.mesh is not None:
+                st = self._mesh_put(st)
         w = self.wall
         for _grow in range(4):
             _tw = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
-            fresh_fn = id(self._fn) not in self._timed_fns
-            out = self._span_call(
-                self._fn,
-                st, self._lat, self._thr, self._node,
-                self._ips_sorted, self._ips_perm,
-                np.uint32(self._k[0]), np.uint32(self._k[1]),
-                np.int64(self.bootstrap_end),
-                start, stop, limit, runahead, mr)
+            spec_rec, landed = landed, None
+            if spec_rec is not None:
+                fresh_fn = False
+                out = spec_rec["out"]
+            else:
+                fresh_fn = id(self._fn) not in self._timed_fns
+                out = self._span_call(
+                    self._fn,
+                    st, self._lat, self._thr, self._node,
+                    self._ips_sorted, self._ips_perm,
+                    np.uint32(self._k[0]), np.uint32(self._k[1]),
+                    np.int64(self.bootstrap_end),
+                    start, stop, limit, runahead, mr)
             (st_out, next_start, ra, rounds, busy_rounds, packets,
              busy_end, span_iters) = out
             st_np = {k: np.asarray(v) for k, v in st_out.items()}
@@ -2664,10 +2686,21 @@ class TcpSpanRunner(SpanMeshMixin):
             _dt = _time.perf_counter_ns() - _tw  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
             self._timed_fns.add(id(self._fn))
             self.device_wall_ns += _dt
-            if fresh_fn:
-                self._credit_build(self._fn, _dt)
-            if w is not None:
-                w.add("compile" if fresh_fn else "execute", _dt, _tw)
+            if spec_rec is not None:
+                # A landed window's force wait is host idle (the
+                # device was already running); its dispatch->force
+                # wall is the pipe the idle fractions divide by.
+                self.overlap_wait_ns += _dt
+                self.overlap_pipe_ns += \
+                    _time.perf_counter_ns() - spec_rec["t_disp"]  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+                if w is not None:
+                    w.add("overlap-land", _dt, _tw)
+            else:
+                if fresh_fn:
+                    self._credit_build(self._fn, _dt)
+                if w is not None:
+                    w.add("compile" if fresh_fn else "execute",
+                          _dt, _tw)
             if code != 0:
                 # Speculative-window waste: an aborted dispatch's
                 # wall and its stepped rounds roll back unused.
@@ -2744,6 +2777,19 @@ class TcpSpanRunner(SpanMeshMixin):
             self._res_st = st_out
             self._res_token = self.engine.state_epoch()
             return (0, 0, 0, int(start), int(start), int(runahead))
+        # Overlap (phold_span twin): dispatch window K+1
+        # asynchronously NOW, so the device executes it while the
+        # host does this window's codec conversion + engine import
+        # below.  Committed (epoch-stamped and published) only after
+        # the import below bumped the epoch.
+        ra_out = int(ra) if dynamic else int(runahead)
+        spec = None
+        if self.overlap and spec_mr > 0 and not self.donate_active() \
+                and int(next_start) < int(stop) \
+                and int(next_start) < int(limit):
+            spec = self._speculate(st_out, int(next_start), int(stop),
+                                   int(limit), ra_out, dynamic,
+                                   spec_mr)
         traces = None
         if self.tracing:
             n = int(st_np["tr_n"])
@@ -2804,6 +2850,42 @@ class TcpSpanRunner(SpanMeshMixin):
         self.spans += 1
         self.rounds += int(rounds)
         self.micro_iters += int(span_iters)
-        ra_out = int(ra) if dynamic else runahead
+        if spec is not None:
+            self._commit_spec(spec)
         return (int(rounds), int(busy_rounds), int(packets),
                 int(next_start), int(busy_end), ra_out)
+
+    def _speculate(self, st_out, start, stop, limit, runahead,
+                   dynamic, spec_mr):
+        """Async double-buffered dispatch of window K+1 (phold_span
+        twin): rebuild the span input from the just-committed device
+        output via the residency law and dispatch WITHOUT forcing —
+        XLA executes on its own threads while the caller runs the
+        host-side import.  SpanMeshMixin owns the record's
+        commit/land/refuse protocol."""
+        import time as _time
+        mr = self._clamp_mr(spec_mr)
+        saved = self._res_st
+        self._res_st = st_out
+        st = self._resident_input()
+        self._res_st = saved
+        st = dict(st)
+        st.pop("_n_conns", None)
+        if self.mesh is not None:
+            st = self._mesh_put(st)
+        w = self.wall
+        t0 = _time.perf_counter_ns()  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+        out = self._span_call(
+            self._fn,
+            st, self._lat, self._thr, self._node,
+            self._ips_sorted, self._ips_perm,
+            np.uint32(self._k[0]), np.uint32(self._k[1]),
+            np.int64(self.bootstrap_end),
+            start, stop, limit, runahead, mr)
+        self.overlap_windows += 1
+        if w is not None:
+            w.add("dispatch",
+                  _time.perf_counter_ns() - t0, t0)  # shadow-lint: allow[wall-clock] dispatch attribution (metrics.wall)
+        return self._speculate_record(
+            out, t0, (start, stop, limit, runahead, bool(dynamic),
+                      mr))

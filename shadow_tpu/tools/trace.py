@@ -464,6 +464,26 @@ def kern_report(data_dir: str, out=None) -> bool:
             print(f"  low-occupancy stages (<5% of lane slots): "
                   f"{', '.join(low)} — vector width mostly burns "
                   f"masked-out lanes there", file=out)
+    # Overlapped-pipeline report (ISSUE 16): per-family device-idle /
+    # host-idle fractions over the async dispatch window — the
+    # measured answer to "did the double buffer actually hide the
+    # host work".
+    for key in sorted(dispatch):
+        if not key.startswith("device_span_"):
+            continue
+        ov = (dispatch.get(key) or {}).get("overlap") or {}
+        if not ov.get("windows"):
+            continue
+        fam = key[len("device_span_"):]
+        print(f"overlap [{fam}]: {ov['windows']} speculative "
+              f"window(s) dispatched, {ov.get('hits', 0)} landed, "
+              f"{ov.get('refusals', 0)} refused "
+              f"({ov.get('stale_refusals', 0)} stale); device idle "
+              f"{100.0 * float(ov.get('device_idle_frac', 0.0)):.0f}%,"
+              f" host idle "
+              f"{100.0 * float(ov.get('host_idle_frac', 0.0)):.0f}% "
+              f"of the {ov.get('pipe_wall_s', 0.0):.3f}s pipelined "
+              f"wall", file=out)
     return ok
 
 
@@ -788,6 +808,11 @@ def _kern_hints(data_dir: str, stats: dict, out) -> None:
       dispatch wall + forced re-exports) exceeds ~10% of a family's
       device dispatch wall, name the dominant abort kind and the
       remediation;
+    - overlap stall — when the overlapped pipeline's measured
+      device-idle fraction exceeds 25%, the double buffer is not
+      hiding the host work: point at the svc plane drains and span
+      codec wall that must fit inside the in-flight window
+      (ISSUE 16);
     - low lane occupancy — on a device-routed run, name the stages
       whose occupancy sits under ~5% and the likeliest config
       remediation (tiny dev_span_K keeps spans short and lanes idle;
@@ -815,6 +840,17 @@ def _kern_hints(data_dir: str, stats: dict, out) -> None:
                   f" or pre-size the aborting capacity "
                   f"(tpu_exchange_capacity / ring caps) so spans "
                   f"commit first try.", file=out)
+        ov = d.get("overlap") or {}
+        if ov.get("windows") and \
+                float(ov.get("device_idle_frac", 0.0)) > 0.25:
+            print(f"  overlap stall [{fam}]: device idle "
+                  f"{100.0 * float(ov['device_idle_frac']):.0f}% of "
+                  f"the pipelined wall — pipeline not overlapping — "
+                  f"check svc plane workers / codec wall (the host-"
+                  f"side drains and span codec conversion must fit "
+                  f"inside the in-flight window), or raise "
+                  f"dev_span_k_init so each window is long enough to "
+                  f"hide the host work.", file=out)
     ks_bytes = _kern_bytes(data_dir)
     if not ks_bytes:
         return

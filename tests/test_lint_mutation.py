@@ -579,3 +579,31 @@ def test_ks_stage_name_table_reorder_is_caught(cpp_text):
     v = twin_constants.check(ROOT, cpp_text=mutated)
     assert any("KS_NAMES" in x.message for x in v), \
         [x.render() for x in v]
+
+
+def test_async_hazard_bites_on_real_dispatch_loop(tmp_path):
+    """Pass-3 async-hazard (ISSUE 16), real-tree mutation: an engine
+    mutation slipped between the grow loop's raw `_span_call` dispatch
+    and its np.asarray force in ops/phold_span.py must flag — the
+    window's basis would drift with no landing check to catch it."""
+    from shadow_tpu.analysis import determinism
+    path = os.path.join(ROOT, "shadow_tpu", "ops", "phold_span.py")
+    with open(path) as fh:
+        src = fh.read()
+    anchor = ("            (st_out, next_start, ra, rounds, "
+              "busy_rounds, packets,\n"
+              "             busy_end, span_iters) = out\n")
+    mutated = _mutate(
+        src, anchor,
+        "            self.engine.run_until(0)\n" + anchor)
+    mpath = tmp_path / "phold_span.py"
+    mpath.write_text(mutated)
+    v = determinism.check(ROOT, paths=[str(mpath)])
+    hits = [x for x in v if x.rule == "async-hazard"]
+    assert any("run_until" in x.message for x in hits), \
+        [x.render() for x in v]
+    # the unmutated tree is clean — the in-flight guard publication
+    # (_commit_spec) and the forces close every window
+    clean = determinism.check(ROOT, paths=[path])
+    assert all(x.rule != "async-hazard" for x in clean), \
+        [x.render() for x in clean]

@@ -1,0 +1,279 @@
+"""Overlapped span pipeline gates (ISSUE 16).
+
+The double-buffered dispatch (ops/span_mesh.py `_speculate` /
+`_commit_spec` / `_take_inflight`, driven from the manager's router)
+overlaps window K+1's device execution with window K's host-side
+import work — and the contract is that it changes NOTHING about the
+simulation: all five sim channels byte-identical with the overlap on
+or off, across schedulers, with forced rollbacks mid-pipeline, and
+with the pallas queue-scan kernels swapped in for the inline lax
+forms.  A speculative window whose basis drifted (params or
+state_epoch) must be REFUSED at landing, never silently reused.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import ConfigOptions
+from shadow_tpu.core.manager import Manager, run_simulation
+
+
+def phold_cfg(scheduler: str, n_hosts: int = 8, n_init: int = 3,
+              mean: str = "20000000", stop: str = "1s", seed: int = 13,
+              device_spans: str | None = None,
+              overlap: str | None = None,
+              pallas: str | None = None):
+    names = [f"lp{i:03d}" for i in range(n_hosts)]
+    hosts = {}
+    for i, name in enumerate(names):
+        peers = [p for p in names if p != name]
+        hosts[name] = {
+            "network_node_id": 0,
+            "processes": [{
+                "path": "phold",
+                "args": ["7000", str(i), str(n_init), mean] + peers,
+                "start_time": "100ms",
+                "expected_final_state": "running",
+            }],
+        }
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": """
+graph [ node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "5 ms" ] ]"""}},
+        "experimental": {"scheduler": scheduler},
+        "hosts": hosts})
+    if device_spans is not None:
+        cfg.experimental.tpu_device_spans = device_spans
+    if overlap is not None:
+        cfg.experimental.span_overlap = overlap
+    if pallas is not None:
+        cfg.experimental.pallas_queue_kernels = pallas
+    return cfg
+
+
+def _hist(m):
+    out = {}
+    for h in m.hosts:
+        h.merge_native_counters()
+        for k, v in h.syscall_counts.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _counters(s):
+    return (s.events, s.packets_sent, s.packets_recv,
+            s.packets_dropped, s.syscalls)
+
+
+def test_overlap_on_off_byte_identity_across_schedulers():
+    """The tentpole gate: span_overlap on vs off vs the serial and
+    thread_per_core references — traces, syscall histograms, and
+    counters identical, with the pipeline provably engaged on the
+    overlap-on run (speculative windows dispatched AND landed)."""
+    m_ser, s_ser = run_simulation(phold_cfg("serial"))
+    m_tpc, s_tpc = run_simulation(phold_cfg("thread_per_core"))
+    m_on, s_on = run_simulation(
+        phold_cfg("tpu", device_spans="force", overlap="on"))
+    m_off, s_off = run_simulation(
+        phold_cfg("tpu", device_spans="force", overlap="off"))
+    assert s_ser.ok and s_tpc.ok and s_on.ok and s_off.ok
+    r_on, r_off = m_on._dev_span, m_off._dev_span
+    assert r_on.spans > 0 and r_off.spans > 0
+    assert r_on.overlap_windows > 0 and r_on.overlap_hits > 0, \
+        (r_on.overlap_windows, r_on.overlap_hits, r_on.overlap_refusals)
+    assert r_off.overlap_windows == 0, "overlap=off still speculated"
+    ref = m_ser.trace_lines()
+    assert ref == m_tpc.trace_lines()
+    assert ref == m_on.trace_lines()
+    assert ref == m_off.trace_lines()
+    assert _hist(m_ser) == _hist(m_on) == _hist(m_off)
+    assert _counters(s_ser) == _counters(s_on) == _counters(s_off)
+    # the telemetry summary is well-formed (bench + trace kern read it)
+    ov = r_on.overlap_summary()
+    assert ov["windows"] == r_on.overlap_windows
+    assert ov["hits"] == r_on.overlap_hits
+    assert 0.0 <= ov["device_idle_frac"] and 0.0 <= ov["host_idle_frac"]
+
+
+def test_overlap_forced_rollback_commits_cleanly():
+    """Rollback mid-pipeline: under-sized ring caps force AB_* aborts
+    while speculative windows are in flight — the grow/retry loop must
+    discard the stale window (refusal, not a landing) and the sim
+    stays byte-identical to serial."""
+    kw = dict(n_hosts=8, n_init=12, mean="500000", stop="300ms")
+    m_ser, s_ser = run_simulation(phold_cfg("serial", **kw))
+    m = Manager(phold_cfg("tpu", device_spans="force", overlap="on",
+                          **kw))
+    m._dev_span = r = m.make_dev_span_runner()
+    # Under-sized trace buffer for this hot workload: dispatches mark
+    # AB_TRACE and the grow/retry loop regrows it x4 while the
+    # pipeline runs — small enough that steady-state spans overflow
+    # it, large enough that one grow recovers.  (A grow that then
+    # succeeds counts zero in `aborts` by design — the rollback
+    # ledger is the observable.)  4096 regrows to exactly the default
+    # 16384, so the post-grow kernel shares the suite-wide compile.
+    r.cap_tr = 4096
+    s = m.run()
+    assert s.ok
+    assert r.spans > 0
+    assert r.rollback_wall_ns > 0 and r.rolled_back_rounds > 0, \
+        "caps never forced a rollback — the gate tested nothing"
+    assert r.cap_tr > 4096, "cap_tr never regrew"
+    assert r.overlap_windows > 0 and r.overlap_hits > 0, \
+        (r.overlap_windows, r.overlap_hits, r.overlap_refusals)
+    assert r.overlap_windows > 0
+    assert m_ser.trace_lines() == m.trace_lines()
+    assert _hist(m_ser) == _hist(m)
+    assert _counters(s_ser) == _counters(s)
+
+
+def test_overlap_stale_epoch_refused():
+    """The commit-or-rollback law at unit level: a landed in-flight
+    record is served only when BOTH the window params match and the
+    engine epoch is exactly the one stamped at commit.  Param drift
+    refuses; epoch drift refuses AND counts stale; the refused record
+    is discarded (never half-landed)."""
+    # Same H=8 full-mesh shape as the on/off gate above, so the span
+    # kernel compile is shared within the pytest process.
+    m = Manager(phold_cfg("tpu", device_spans="force", n_init=2,
+                          stop="1s"))
+    s = m.run()
+    r = m._dev_span
+    assert s.ok and r.spans > 0
+    params = (1, 2, 3, 4, False, 8)
+
+    def seed(epoch):
+        rec = r._speculate_record("sentinel-out", 0, params)
+        rec["epoch"] = epoch
+        r._inflight = rec
+        return rec
+
+    # clean landing: params + epoch both match
+    rec = seed(m.plane.engine.state_epoch())
+    hits0, ref0, stale0 = (r.overlap_hits, r.overlap_refusals,
+                           r.overlap_stale)
+    assert r._take_inflight(params) is rec
+    assert r._inflight is None
+    assert r.overlap_hits == hits0 + 1
+    # param drift: refused, NOT stale
+    seed(m.plane.engine.state_epoch())
+    assert r._take_inflight((1, 2, 3, 4, False, 16)) is None
+    assert r._inflight is None, "refused record must be discarded"
+    assert r.overlap_refusals == ref0 + 1
+    assert r.overlap_stale == stale0
+    # epoch drift: any engine mutation between commit and landing
+    seed(m.plane.engine.state_epoch())
+    m.plane.engine.set_tracing(0, True)  # bumps state_epoch
+    assert r._take_inflight(params) is None
+    assert r._inflight is None
+    assert r.overlap_refusals == ref0 + 2
+    assert r.overlap_stale == stale0 + 1
+
+
+@pytest.mark.slow
+def test_pallas_queue_kernels_byte_identity():
+    """Second leg: the pallas queue-scan kernels (interpret mode on
+    the CPU backend) swapped in for the inline lax forms — the whole
+    sim stays byte-identical, and the runner provably took the pallas
+    build.  Slow tier: this compiles a second full span kernel (the
+    pallas build has its own cache key); the tier-1 pallas gate is
+    the exact differential below."""
+    kw = dict(n_hosts=6, n_init=8, mean="1000000", stop="500ms")
+    m_ser, s_ser = run_simulation(phold_cfg("serial", **kw))
+    m_pl, s_pl = run_simulation(
+        phold_cfg("tpu", device_spans="force", pallas="on", **kw))
+    assert s_ser.ok and s_pl.ok
+    r = m_pl._dev_span
+    assert r.pallas_queues is True
+    assert r.spans > 0 and r.aborts == 0
+    assert m_ser.trace_lines() == m_pl.trace_lines()
+    assert _hist(m_ser) == _hist(m_pl)
+    assert _counters(s_ser) == _counters(s_pl)
+
+
+def test_pallas_kernels_differential_vs_lax_reference():
+    """Exact-equality differential for both queue laws: the pallas
+    twin (interpret mode) against the lax reference on adversarial
+    integer inputs — first-touch buckets (nxt == 0), lapsed multi-
+    interval refills, exact-balance debits, unlimited lanes; CoDel
+    quiet/above/arm/control-ok lanes straddling the target and the
+    MTU standing-queue escape."""
+    import jax
+    import jax.numpy as jnp
+    from shadow_tpu.ops import pallas_queues as plq
+    from shadow_tpu.ops.phold_span import (CODEL_TARGET_NS, MTU,
+                                           REFILL_NS)
+    rng = np.random.default_rng(7)
+    H = 64
+    i64 = np.int64
+
+    now = i64(3_000_000_000) + rng.integers(0, 10**9, H, dtype=i64)
+    bal = rng.integers(0, 10_000, H, dtype=i64)
+    nxt = np.where(rng.random(H) < 0.25, i64(0),
+                   now + rng.integers(-5 * REFILL_NS, 5 * REFILL_NS,
+                                      H, dtype=i64))
+    refill = rng.integers(1, 4_000, H, dtype=i64)
+    cap = rng.integers(1, 20_000, H, dtype=i64)
+    unlimited = rng.random(H) < 0.3
+    size = rng.integers(0, 3_000, H, dtype=i64)
+    size[:4] = bal[:4]  # exact-balance conformance edge
+
+    ref = plq.make_bucket_step(jax, jnp, H, REFILL_NS, False)
+    pal = plq.make_bucket_step(jax, jnp, H, REFILL_NS, True)
+    a = ref(bal, nxt, refill, cap, unlimited, size, now)
+    b = pal(bal, nxt, refill, cap, unlimited, size, now)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    pop = rng.random(H) < 0.7
+    none = ~pop & (rng.random(H) < 0.5)
+    enq = now - rng.integers(0, 3 * CODEL_TARGET_NS, H, dtype=i64)
+    bytes_after = rng.integers(0, 4 * MTU, H, dtype=i64)
+    bytes_after[:4] = MTU  # standing-queue escape boundary
+    first_above = np.where(
+        rng.random(H) < 0.4, i64(0),
+        now + rng.integers(-10**8, 10**8, H, dtype=i64))
+
+    ref_h = plq.make_codel_head(jax, jnp, H, CODEL_TARGET_NS, MTU,
+                                False)
+    pal_h = plq.make_codel_head(jax, jnp, H, CODEL_TARGET_NS, MTU,
+                                True)
+    a = ref_h(pop, none, now, enq, bytes_after, first_above)
+    b = pal_h(pop, none, now, enq, bytes_after, first_above)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sharded_overlap_byte_identity():
+    """Sharded-8 coverage: the overlapped pipeline over a tpu_shards=8
+    span mesh (virtual 8-device CPU mesh, conftest) stays
+    byte-identical to the overlap-off sharded run and to serial."""
+    from shadow_tpu.tools.netgen import phold_yaml
+    # Same 16-host/8-shard shape as tests/test_sharded_span.py, so
+    # the (expensive) sharded span compile is shared within the
+    # pytest process; stop_time is a runtime operand, not a compile
+    # key, so the shorter horizon only trims execution.
+    text = lambda sched, ds=None: phold_yaml(  # noqa: E731
+        16, n_init=3, mean_delay_ns=20_000_000, stop_time="300ms",
+        seed=13, scheduler=sched, device_spans=ds)
+
+    def run_sharded(overlap):
+        cfg = ConfigOptions.from_yaml_text(text("tpu", "force"))
+        cfg.experimental.tpu_shards = 8
+        cfg.experimental.span_overlap = overlap
+        m = Manager(cfg)
+        s = m.run()
+        return m, s
+
+    m0, s0 = run_simulation(ConfigOptions.from_yaml_text(
+        text("serial")))
+    m_on, s_on = run_sharded("on")
+    m_off, s_off = run_sharded("off")
+    assert s0.ok and s_on.ok and s_off.ok
+    r = m_on._dev_span
+    assert r.mesh is not None and r.n_shards == 8
+    assert r.spans > 0
+    assert m0.trace_lines() == m_on.trace_lines()
+    assert m0.trace_lines() == m_off.trace_lines()
+    assert _counters(s_on) == _counters(s_off)

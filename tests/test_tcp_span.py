@@ -59,7 +59,7 @@ class _RoundTripStub:
         self.transient = 0
 
     def try_span(self, start, stop, limit, runahead, dynamic,
-                 max_rounds):
+                 max_rounds, spec_mr=0):
         d = self.eng.span_export_tcp(*CAPS)
         if d is None or isinstance(d, int):
             self.transient += 1

@@ -147,6 +147,76 @@ def test_device_fn_by_keyword_and_dotted_imports(tmp_path):
     assert "wall-clock" in rules, [x.render() for x in v]
 
 
+def test_async_hazard_rule_fires_and_guards_escape(tmp_path):
+    """Pass-3 async-hazard (ISSUE 16): an engine mutation while a raw
+    `_span_call` dispatch is in flight flags; forcing the window first
+    (np.asarray / block_until_ready) or publishing it through the
+    in-flight guard (`_inflight` / `_commit_spec`) closes it."""
+    mod = tmp_path / "async_mod.py"
+    mod.write_text(
+        "import numpy as np\n"
+        "class Runner:\n"
+        "    def hazardous(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self.engine.run_until(10)\n"          # line 5: flags
+        "        return np.asarray(out[0])\n"
+        "    def forced_first(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        host = np.asarray(out[0])\n"
+        "        self.engine.run_until(10)\n"          # closed: clean
+        "        return host\n"
+        "    def blocked_first(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        out[0].block_until_ready()\n"
+        "        self.engine.deliver(1)\n"             # closed: clean
+        "    def guarded(self, st, rec):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self._inflight = rec\n"
+        "        self.engine.span_import_phold(out)\n"  # guarded: clean
+        "    def committed(self, st, spec):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self._commit_spec(spec)\n"
+        "        self.engine.deliver(1)\n"             # guarded: clean
+        "    def reader(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        n = self.engine.state_epoch()\n"       # not a mutator
+        "        return np.asarray(out[0]), n\n")
+    # repo_root must be the real ROOT: the mutator contract list is
+    # extracted from native/netplane.cpp
+    v = determinism.check(ROOT, paths=[str(mod)])
+    hits = [x for x in v if x.rule == "async-hazard"]
+    assert [x.line for x in hits] == [5], [x.render() for x in v]
+    assert "run_until" in hits[0].message
+    # with no native source the rule is inert, not crashing
+    assert determinism.check(str(tmp_path), paths=[str(mod)]) == [] or \
+        all(x.rule != "async-hazard"
+            for x in determinism.check(str(tmp_path), paths=[str(mod)]))
+    # pragma escape works like every reason-carrying rule
+    esc = tmp_path / "esc.py"
+    esc.write_text(
+        "class R:\n"
+        "    def f(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self.engine.run_until(1)"
+        "  # shadow-lint: allow[async-hazard] test escape\n")
+    v = determinism.check(ROOT, paths=[str(esc)])
+    assert all(x.rule != "async-hazard" for x in v), \
+        [x.render() for x in v]
+
+
+def test_epoch_mutator_extraction_complete():
+    """The async-hazard contract list comes from the C++ method table,
+    not a hand list: the span entry points and the classic mutators
+    must all be present."""
+    muts = determinism.epoch_mutators(ROOT)
+    assert {"run_until", "run_span", "span_import_phold",
+            "span_import_tcp", "deliver", "fire"} <= muts, sorted(muts)
+    assert len(muts) >= 40
+    # read-only entry points must NOT be in the list: flagging
+    # state_epoch() itself would outlaw the guard's own stamp
+    assert "state_epoch" not in muts
+
+
 def test_broken_constant_reports_not_crashes(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("TABLE = {'a': 1}\nX = TABLE['typo']\nY = 1 + 'no'\n")
