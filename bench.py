@@ -1702,18 +1702,27 @@ def mixed_pcap_rung() -> None:
 
 
 def lint_preflight() -> None:
-    """One-line twin-contract gate: a benchmark artifact recorded from
-    a tree with twin drift would compare a C++ engine against a Python
-    kernel that no longer computes the same thing."""
+    """One-line lint gate, all four analysis passes: a benchmark
+    artifact recorded from a tree with twin drift would compare a C++
+    engine against a Python kernel that no longer computes the same
+    thing, and one recorded with an epoch/ownership/knob violation
+    (pass 4) could be measuring stale-residency reuse.  The preflight
+    wall is reported so the passes provably stay under the lint
+    budget (<30 s, tests/test_twin_contract.py)."""
+    import time
     from shadow_tpu.analysis import run_all
-    violations, _ = run_all(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()  # shadow-lint: allow[wall-clock] preflight timing
+    violations, counts = run_all(
+        os.path.dirname(os.path.abspath(__file__)))
+    dt = time.perf_counter() - t0  # shadow-lint: allow[wall-clock] preflight timing
     if violations:
         print(f"lint: FAIL ({len(violations)} violation(s); "
               f"run scripts/lint)", file=sys.stderr)
         for v in violations[:10]:
             print(f"  {v.render()}", file=sys.stderr)
         sys.exit(1)
-    print("lint: ok", file=sys.stderr)
+    print(f"lint: ok ({', '.join(counts)} in {dt:.2f}s)",
+          file=sys.stderr)
 
 
 def main() -> None:

@@ -4818,6 +4818,10 @@ struct Engine {
       *err = "snapshot host set does not match the rebuilt config";
       return false;
     }
+    /* Bump BEFORE the mutating walk: every failure path below exits
+     * after ck_read_global/host_neutralize have already rewritten
+     * state, and a stale-epoch device span must not land on it. */
+    state_epoch++;
     for (auto &f : frames) {
       CkR r(f.second.first, f.second.second);
       if (f.first == CK_GLOBAL_FRAME) {
@@ -4845,7 +4849,6 @@ struct Engine {
       }
     }
     (void)epoch;
-    state_epoch++;
     return true;
   }
 
@@ -4857,6 +4860,13 @@ struct Engine {
                           std::pair<const uint8_t *, size_t>>> frames;
     uint64_t epoch = 0;
     if (!ck_parse_frames(buf, len, &frames, &epoch, err)) return false;
+    /* Bump BEFORE the mutating walk (same law as plane_import_blob):
+     * the corrupt-frame failure paths below exit after
+     * host_neutralize has already rewritten the host, and a
+     * stale-epoch device span must not land on it.  A bump on the
+     * no-frame path is a spurious invalidation, never a stale reuse —
+     * the conservative direction. */
+    state_epoch++;
     for (auto &f : frames) {
       if (f.first != (uint32_t)hid) continue;
       if (plane(hid) == nullptr) {
@@ -4878,7 +4888,6 @@ struct Engine {
       }
       for (auto &kv : cx.appmap)
         appmap->push_back({kv.first, kv.second});
-      state_epoch++;
       return true;
     }
     *err = "snapshot holds no frame for this host";
@@ -8843,7 +8852,10 @@ static PyObject *eng_intern_packet(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_trace_entries(EngineObj *self, PyObject *args) {
-  self->eng->state_epoch++;
+  /* Read-only: formats this host's trace ring without draining it.
+   * No state_epoch bump (same law as set_flight/netstat_take) — trace
+   * state is not simulation state, and bumping would spuriously
+   * invalidate device-resident span carries. */
   int hid;
   if (!PyArg_ParseTuple(args, "i", &hid)) return nullptr;
   HostPlane *hp = self->eng->plane(hid);
@@ -8882,8 +8894,11 @@ static PyObject *eng_set_pcap(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_pcap_take(EngineObj *self, PyObject *args) {
-  self->eng->state_epoch++;
-  /* Drain this host's pcap records: list of (iface, t, src_host,
+  /* Channel drain (same contract as flight_take/netstat_take): clears
+   * TRACE state, not SIMULATION state, so no state_epoch bump — the
+   * pcap span drains every round and a bump here would defeat
+   * device-span residency entirely.
+   * Drain this host's pcap records: list of (iface, t, src_host,
    * pkt_seq, proto, sip, sport, dip, dport, payload, tcp|None) where
    * tcp = (seq, ack, flags, window). */
   int hid;
@@ -8940,9 +8955,12 @@ static PyObject *eng_set_flight(EngineObj *self, PyObject *args) {
 }
 
 static PyObject *eng_set_dctcp_k(EngineObj *self, PyObject *args) {
-  /* Engine-global DCTCP-K marking threshold (config, not state: no
-   * epoch bump — the marking law reads it at enqueue time, and the
-   * device kernels carry their own closure constants). */
+  /* Engine-global DCTCP-K marking threshold.  This IS an epoch bump:
+   * the device kernels bake K into their jitted closures
+   * (ops/tcp_span.py), so a resident carry compiled against the old K
+   * would keep marking by the stale threshold if it were allowed to
+   * land after a mid-run change. */
+  self->eng->state_epoch++;
   long long k_pkts, k_bytes;
   if (!PyArg_ParseTuple(args, "LL", &k_pkts, &k_bytes)) return nullptr;
   if (k_pkts < 1 || k_bytes < 1) {
@@ -9248,7 +9266,10 @@ static PyObject *eng_mark_causes(EngineObj *self, PyObject *args) {
 
 static PyObject *eng_set_host_tcp(EngineObj *self, PyObject *args) {
   /* (hid, cc, ecn): the per-host `tcp:` config block — every TcpConn
-   * born on this host inherits it (native/plane.py add_host). */
+   * born on this host inherits it (native/plane.py add_host).  Epoch
+   * bump: future connections behave differently, so a device-resident
+   * TCP carry speculated before the change must not land. */
+  self->eng->state_epoch++;
   int hid, cc, ecn;
   if (!PyArg_ParseTuple(args, "iii", &hid, &cc, &ecn)) return nullptr;
   HostPlane *hp = self->eng->plane(hid);

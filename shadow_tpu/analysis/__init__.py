@@ -16,11 +16,19 @@ package catches that drift in seconds, before the differential gates
 - pass 3 (`determinism`): AST lint over shadow_tpu/ for
   nondeterminism hazards (wall clocks, unseeded RNGs, set iteration,
   host mutation inside jitted bodies, np-vs-jnp confusion, engine
-  mutation while an async span dispatch is in flight).
+  mutation while an async span dispatch is in flight);
+- pass 4 (`effects`): cross-layer effect & ownership audit — every
+  engine entry point classified mutator (bumps state_epoch on every
+  mutating path) or observer (never bumps), worker-thread writes to
+  shared state outside the host-affine ownership law, writes inside
+  an open speculative-dispatch window, and the experimental-knob
+  registry (validated + documented + digest-classified, cross-checked
+  against ckpt/restore.py).
 
-Passes 1-2 need no JAX (pure parsing); the whole run is a tier-1 gate
-(tests/test_twin_contract.py) and a CLI: `python -m shadow_tpu.tools.lint`
-or `scripts/lint`.  Rule catalogue and pragma syntax: docs/LINT.md.
+No pass needs JAX (pure parsing); the whole run is a tier-1 gate
+(tests/test_twin_contract.py, tests/test_effects.py) and a CLI:
+`python -m shadow_tpu.tools.lint` or `scripts/lint`.  Rule catalogue
+and pragma syntax: docs/LINT.md.
 """
 
 from __future__ import annotations
@@ -30,9 +38,10 @@ from shadow_tpu.analysis.report import Violation, format_report
 __all__ = ["Violation", "format_report", "run_all"]
 
 
-def run_all(repo_root: str, passes=("twin", "layout", "det")):
+def run_all(repo_root: str, passes=("twin", "layout", "det", "effects")):
     """Run the requested passes; returns (violations, per-pass counts)."""
-    from shadow_tpu.analysis import determinism, soa_layout, twin_constants
+    from shadow_tpu.analysis import (determinism, effects, soa_layout,
+                                     twin_constants)
 
     violations: list[Violation] = []
     counts: dict[str, int] = {}
@@ -47,5 +56,9 @@ def run_all(repo_root: str, passes=("twin", "layout", "det")):
     if "det" in passes:
         v = determinism.check(repo_root)
         counts["det"] = len(v)
+        violations += v
+    if "effects" in passes:
+        v = effects.check(repo_root)
+        counts["effects"] = len(v)
         violations += v
     return violations, counts

@@ -21,6 +21,7 @@ fail closed (missing name / missing column), not open.
 
 from __future__ import annotations
 
+import functools
 import re
 
 # C++ element type -> numpy dtype name used by the Python codecs.
@@ -403,26 +404,129 @@ def extract_import_layout(text: str, func: str) -> dict:
 _METHOD_ENTRY = re.compile(
     r'\{\s*"(\w+)"\s*,\s*\(PyCFunction\)\s*(\w+)', re.S)
 
+_BUMP = re.compile(r"\bstate_epoch\s*(?:\+\+|\+=)")
+_CALLEE = re.compile(r"\b(\w+)\s*\(")
+
+# identifiers the callee scan must never treat as delegated helpers
+_NOT_CALLEES = frozenset({
+    "if", "for", "while", "switch", "return", "sizeof", "catch",
+    "defined", "assert", "static_cast", "reinterpret_cast",
+    "const_cast",
+})
+
+
+def extract_method_table(text: str, *, _stripped: bool = False) -> dict:
+    """{python method name -> C wrapper function name} from the
+    PyMethodDef table (`{"name", (PyCFunction)eng_name, ...}`)."""
+    if not _stripped:
+        text = strip_comments(text)
+    return {m.group(1): m.group(2)
+            for m in _METHOD_ENTRY.finditer(text)}
+
+
+def _bump_depths(body: str):
+    """Brace depth of every state_epoch bump inside `body` (0 =
+    statement level of the function itself, i.e. on every path)."""
+    return [body.count("{", 0, m.start()) - body.count("}", 0, m.start())
+            for m in _BUMP.finditer(body)]
+
+
+_DEF_SITE = re.compile(r"\b(\w+)\s*\([^;{)]*\)\s*\{")
+
+
+def _def_index(text: str) -> dict:
+    """{name -> open-brace index} of the FIRST `name(..) {` site per
+    name — one pass, so the per-callee body lookups in
+    classify_epoch_effect don't re-scan the whole engine source.
+    Matches function_body's first-definition semantics exactly."""
+    index: dict = {}
+    for m in _DEF_SITE.finditer(text):
+        index.setdefault(m.group(1), m.end() - 1)
+    return index
+
+
+def _body_of(text: str, name: str, cache: dict):
+    if name not in cache:
+        index = cache.get(_DEF_INDEX_KEY)
+        if index is None:
+            index = cache[_DEF_INDEX_KEY] = _def_index(text)
+        pos = index.get(name)
+        cache[name] = None if pos is None else _balanced(text, pos,
+                                                         "{", "}")
+    return cache[name]
+
+
+_DEF_INDEX_KEY = object()
+
+
+def classify_epoch_effect(text: str, cfunc: str, cache: dict) -> dict:
+    """How (and whether) the wrapper `cfunc` bumps state_epoch.
+
+    Returns {"bump": kind, "via": helper-name-or-None} where kind is
+    - "unconditional": a bump at brace depth 0 of the wrapper body, or
+      at depth 0 of a directly-called helper's body (the blob-import
+      wrappers delegate their bump to *_import_blob);
+    - "conditional": bumps exist but only inside nested braces — NOT
+      good enough for a declared mutator (some control path mutates
+      without invalidating device residency);
+    - "none": no bump anywhere reachable at depth <= 1;
+    - "missing": the wrapper body itself was not found (fail closed).
+    The callee walk is deliberately depth-1 only: the engine's idiom
+    is wrapper-level bumps plus at most one delegated helper, and a
+    deeper search would start crediting bumps through unrelated
+    control flow the brace scan cannot vouch for.
+    """
+    body = _body_of(text, cfunc, cache)
+    if body is None:
+        return {"bump": "missing", "via": None}
+    depths = _bump_depths(body)
+    if depths:
+        return {"bump": "unconditional" if 0 in depths else "conditional",
+                "via": None}
+    best = None
+    for cm in _CALLEE.finditer(body):
+        name = cm.group(1)
+        if name == cfunc or name in _NOT_CALLEES:
+            continue
+        cb = _body_of(text, name, cache)
+        if cb is None:
+            continue
+        cd = _bump_depths(cb)
+        if not cd:
+            continue
+        if 0 in cd:
+            return {"bump": "unconditional", "via": name}
+        best = {"bump": "conditional", "via": name}
+    return best or {"bump": "none", "via": None}
+
+
+@functools.lru_cache(maxsize=4)
+def extract_epoch_effects(text: str) -> dict:
+    """{python method name -> classify_epoch_effect result + "cfunc"}
+    for every exported engine entry point — the raw material of
+    analysis pass 4a (effects.py) and of `extract_epoch_mutators`.
+    Memoized on the text: pass 3 (async-hazard mutator list), pass 4a
+    and bench's preflight all consume one computation per source.
+    Callers must not mutate the returned dicts."""
+    text = strip_comments(text)
+    cache: dict = {}
+    out = {}
+    for pyname, cfunc in extract_method_table(text, _stripped=True).items():
+        eff = classify_epoch_effect(text, cfunc, cache)
+        eff["cfunc"] = cfunc
+        out[pyname] = eff
+    return out
+
 
 def extract_epoch_mutators(text: str) -> set:
     """Python-visible engine method names whose C wrapper bumps
-    state_epoch — the contract list the `async-hazard` lint rule
-    (analysis pass 3) holds against an open in-flight span window.
+    state_epoch — directly or via a depth-1 delegated helper (the
+    blob-import wrappers) — the single source of truth consumed by
+    BOTH the `async-hazard` lint rule (analysis pass 3) and the
+    engine effect audit (pass 4a), so the two can never drift.
 
-    Scans the PyMethodDef table (`eng_methods[]`-style entries,
-    `{"name", (PyCFunction)eng_name, ...}`) and keeps every entry
-    whose wrapper body contains a `state_epoch++` / `state_epoch +=`
-    bump.  Fail-closed like the other extractors: an unrecognized
+    Fail-closed like the other extractors: an unrecognized method-
     table idiom yields a missing method, which the contract test
     notices — never a silently shorter mutator list."""
-    text = strip_comments(text)
-    mutators = set()
-    for m in _METHOD_ENTRY.finditer(text):
-        pyname, cfunc = m.group(1), m.group(2)
-        try:
-            body = function_body(text, cfunc)
-        except KeyError:
-            continue
-        if re.search(r"\bstate_epoch\s*(?:\+\+|\+=)", body):
-            mutators.add(pyname)
-    return mutators
+    return {name for name, eff in extract_epoch_effects(text).items()
+            if eff["bump"] in ("unconditional", "conditional")}

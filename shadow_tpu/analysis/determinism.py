@@ -483,9 +483,14 @@ class _ModuleLinter:
 
 def epoch_mutators(repo_root: str) -> set:
     """The async-hazard contract list: every C++ engine entry point
-    that bumps `state_epoch`, extracted from native/netplane.cpp's
-    method table.  Empty set (rule inert) when the native source is
-    absent — the extractor, not a hand list, is the source of truth."""
+    that bumps `state_epoch` (directly or via a depth-1 delegated
+    helper), extracted from native/netplane.cpp's method table.  Empty
+    set (rule inert) when the native source is absent — the extractor,
+    not a hand list, is the source of truth, and pass 4a's engine
+    effect audit (analysis/effects.py) consumes the SAME extraction
+    (`cpp_extract.extract_epoch_effects`, memoized) and cross-checks
+    this set against its declared mutator registry, so the two views
+    can never drift."""
     path = os.path.join(repo_root, "native", "netplane.cpp")
     try:
         with open(path) as fh:

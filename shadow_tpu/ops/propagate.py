@@ -629,11 +629,11 @@ class TpuPropagator:
                 # record_device debits the probe budget (compiles and
                 # losing dispatches both count as measurement spend).
                 route.record_device(b, _time.perf_counter_ns() - t0, n)  # shadow-lint: allow[wall-clock] route pacing; both routes byte-identical
-                self.probes_async += 1
+                self.probes_async += 1  # shadow-lint: allow[svc-ownership] single probe thread (pending-flag gate); wall metric only
             except Exception:
                 pass  # a failed probe just leaves the bucket unmeasured
             finally:
-                self._probe_pending = False
+                self._probe_pending = False  # shadow-lint: allow[svc-ownership] the flag handoff IS the protocol: set before spawn, cleared only here
 
         import threading
         # A daemon thread, not an executor: concurrent.futures joins

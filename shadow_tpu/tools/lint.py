@@ -1,10 +1,19 @@
-"""Twin-contract & determinism lint CLI.
+"""Twin-contract, determinism & effects lint CLI.
 
-    python -m shadow_tpu.tools.lint [--pass twin,layout,det] [--json]
+    python -m shadow_tpu.tools.lint [--pass twin,layout,det,effects]
+                                    [--json]
 
 Runs the shadow_tpu/analysis/ passes (docs/LINT.md) and exits non-zero
 on any violation.  Pure parsing — no JAX, no engine import — so it is
 cheap enough to gate every test run and benchmark recording.
+
+`--pass` also accepts the pass numbers (`--pass 4`, `--pass 1,3`):
+1 = twin, 2 = layout, 3 = det, 4 = effects.
+
+Exit-code contract (CI and bench's preflight key on it):
+    0  every requested pass ran clean
+    1  at least one violation (all reported, on stdout or in --json)
+    2  usage error (unknown pass name/number); nothing was linted
 """
 
 from __future__ import annotations
@@ -15,7 +24,11 @@ import os
 import sys
 import time
 
-PASSES = ("twin", "layout", "det")
+PASSES = ("twin", "layout", "det", "effects")
+
+# numeric aliases: the docs and the ISSUE tracker talk about the
+# passes by number, so `--pass 4` must mean the effects pass
+_NUMERIC = {str(i + 1): name for i, name in enumerate(PASSES)}
 
 
 def repo_root() -> str:
@@ -34,12 +47,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="shadow_tpu.tools.lint", description=__doc__)
     ap.add_argument("--pass", dest="passes", default=",".join(PASSES),
-                    help="comma-separated subset of: twin,layout,det")
+                    help="comma-separated subset of: twin,layout,det,"
+                         "effects (or numbers 1-4)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
 
-    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    passes = tuple(_NUMERIC.get(p.strip(), p.strip())
+                   for p in args.passes.split(",") if p.strip())
     bad = [p for p in passes if p not in PASSES]
     if bad:
         print(f"unknown pass(es): {', '.join(bad)}", file=sys.stderr)

@@ -1,0 +1,346 @@
+"""Tier-1 gates for analysis pass 4 (effects.py, docs/LINT.md).
+
+Three kinds of coverage, all fast (no JAX, no Manager):
+
+- the clean-tree gate: the real tree passes 4a/4b/4c with zero
+  violations, inside the lint wall budget;
+- pragma semantics for the ownership rules (reason required, bare
+  pragma does not suppress);
+- mutation self-tests: every rule family is fed a perturbed in-memory
+  surface (cpp_text / config_text / restore_text / docs_text /
+  fixture modules) and must bite — no rule lands without its
+  counter-mutation.
+
+The runtime leg (bare engine, skipped when the native build is
+unavailable) pins the epoch-discipline fixes pass 4a surfaced:
+observers must not bump `state_epoch`, the reclassified mutators
+must, and the blob imports must bump even on mutating failure paths.
+"""
+
+import os
+import time
+
+import pytest
+
+from shadow_tpu.analysis import effects
+from shadow_tpu.analysis import determinism
+from shadow_tpu.tools import lint as lint_cli
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cpp_text():
+    with open(os.path.join(ROOT, "native", "netplane.cpp")) as fh:
+        return fh.read()
+
+
+@pytest.fixture(scope="module")
+def config_text():
+    with open(os.path.join(ROOT, "shadow_tpu", "core",
+                           "config.py")) as fh:
+        return fh.read()
+
+
+@pytest.fixture(scope="module")
+def restore_text():
+    with open(os.path.join(ROOT, "shadow_tpu", "ckpt",
+                           "restore.py")) as fh:
+        return fh.read()
+
+
+def _mutate(text: str, old: str, new: str, count: int = 1) -> str:
+    """Assert the anchor is present exactly `count` times, then swap —
+    a silent zero-hit mutation would make the self-test vacuous."""
+    assert text.count(old) == count, \
+        f"mutation anchor {old!r} found {text.count(old)}x, want {count}"
+    return text.replace(old, new)
+
+
+# ---------------------------------------------------------------------------
+# clean tree
+# ---------------------------------------------------------------------------
+
+def test_effects_pass_clean_and_fast():
+    t0 = time.perf_counter()  # shadow-lint: allow[wall-clock] test timing
+    v = effects.check(ROOT)
+    dt = time.perf_counter() - t0  # shadow-lint: allow[wall-clock] ditto
+    assert [x.render() for x in v] == []
+    assert dt < 30.0, f"pass 4 took {dt:.1f}s (budget 30s)"
+
+
+def test_registry_covers_exactly_the_method_table(cpp_text):
+    """90-entry audit: ENTRY_EFFECTS and the method table are the same
+    name set, and the declared mutators equal the extracted
+    async-hazard list (one extraction, no drift possible)."""
+    from shadow_tpu.analysis import cpp_extract
+    table = cpp_extract.extract_method_table(cpp_text)
+    assert set(effects.ENTRY_EFFECTS) == set(table)
+    assert effects.MUTATORS == determinism.epoch_mutators(ROOT)
+    assert not (effects.MUTATORS & effects.OBSERVERS)
+    # the channel drains the residency protocol depends on staying
+    # observers (netplane.cpp's set_flight comment is the law)
+    assert {"flight_take", "netstat_take", "fabric_take", "pcap_take",
+            "trace_entries", "plane_export",
+            "state_epoch"} <= effects.OBSERVERS
+
+
+def test_cli_numeric_pass_selection(capsys):
+    assert lint_cli.main(["--pass", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "effects" in out
+    assert lint_cli.main(["--pass", "1,effects"]) == 0
+    # exit-code contract: unknown pass is a usage error (2), not a lint
+    # failure (1)
+    assert lint_cli.main(["--pass", "5"]) == 2
+    assert lint_cli.main(["--pass", "4", "--json"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    import json
+    rep = json.loads(out)
+    assert rep["violations"] == [] and set(rep["counts"]) == {"effects"}
+
+
+# ---------------------------------------------------------------------------
+# 4a mutation self-tests
+# ---------------------------------------------------------------------------
+
+def test_unclassified_entry_point_bites(cpp_text):
+    """A brand-new exported method without an ENTRY_EFFECTS row fails
+    closed (and the orphaned row reports stale)."""
+    mutated = _mutate(cpp_text,
+                      '"state_epoch", (PyCFunction)eng_state_epoch',
+                      '"state_epoch2", (PyCFunction)eng_state_epoch')
+    rules = {v.rule for v in
+             effects.check_engine_effects(ROOT, cpp_text=mutated)}
+    assert "effect-unclassified" in rules
+    assert "effect-stale" in rules
+
+
+def test_mutator_missing_bump_bites(cpp_text):
+    mutated = _mutate(
+        cpp_text,
+        "eng_deliver(EngineObj *self, PyObject *args) {\n"
+        "  self->eng->state_epoch++;",
+        "eng_deliver(EngineObj *self, PyObject *args) {")
+    v = effects.check_engine_effects(ROOT, cpp_text=mutated)
+    hits = [x for x in v if x.rule == "effect-mutator-bump"]
+    assert len(hits) == 1 and "`deliver`" in hits[0].message
+    assert "never bumps" in hits[0].message
+
+
+def test_mutator_conditional_bump_bites(cpp_text):
+    """A bump that only some control path reaches is NOT mutator
+    discipline — the brace-depth scan refuses it."""
+    mutated = _mutate(
+        cpp_text,
+        "eng_deliver(EngineObj *self, PyObject *args) {\n"
+        "  self->eng->state_epoch++;",
+        "eng_deliver(EngineObj *self, PyObject *args) {\n"
+        "  if (args) { self->eng->state_epoch++; }")
+    v = effects.check_engine_effects(ROOT, cpp_text=mutated)
+    hits = [x for x in v if x.rule == "effect-mutator-bump"]
+    assert len(hits) == 1 and "`deliver`" in hits[0].message
+    assert "nested braces" in hits[0].message
+
+
+def test_observer_gaining_bump_bites(cpp_text):
+    mutated = _mutate(
+        cpp_text,
+        "eng_counters(EngineObj *self, PyObject *args) {\n",
+        "eng_counters(EngineObj *self, PyObject *args) {\n"
+        "  self->eng->state_epoch++;\n")
+    v = effects.check_engine_effects(ROOT, cpp_text=mutated)
+    hits = [x for x in v if x.rule == "effect-observer-bump"]
+    assert len(hits) == 1 and "`counters`" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# 4b fixtures: ownership rules fire, locks and pragmas escape
+# ---------------------------------------------------------------------------
+
+def test_svc_ownership_fires_and_lock_escapes(tmp_path):
+    mod = tmp_path / "workers.py"
+    mod.write_text(
+        "import threading\n"
+        "class Pool:\n"
+        "    def dispatch(self, grp):\n"
+        "        self._pool.submit(self._run_group, grp)\n"
+        "        t = threading.Thread(target=self._bg)\n"
+        "        self.rounds += 1\n"          # caller thread: fine
+        "    def _run_group(self, grp):\n"
+        "        for h in grp:\n"
+        "            h.execute()\n"           # param call: fine
+        "        self.done = True\n"          # line 10: flags
+        "    def _bg(self):\n"
+        "        self._helper()\n"
+        "    def _helper(self):\n"
+        "        local = []\n"
+        "        local.append(1)\n"           # local: fine
+        "        with self._lock:\n"
+        "            self.seen.add(3)\n"      # lock-guarded: fine
+        "        self.seen.add(4)\n")         # line 18: flags
+    v = effects.check_thread_ownership(ROOT, paths=[str(mod)])
+    assert sorted((x.rule, x.line) for x in v) == \
+        [("svc-ownership", 10), ("svc-ownership", 18)], \
+        [x.render() for x in v]
+
+
+def test_svc_ownership_pragma_needs_reason(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def go(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        self.flag = True  "
+        "# shadow-lint: allow[svc-ownership] single worker by design\n")
+    assert effects.check_thread_ownership(ROOT, paths=[str(good)]) == []
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def go(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        self.flag = True  # shadow-lint: allow[svc-ownership]\n")
+    v = effects.check_thread_ownership(ROOT, paths=[str(bare)])
+    assert [x.rule for x in v] == ["svc-ownership"]
+
+
+def test_overlap_window_rule_fires_and_closes(tmp_path):
+    mod = tmp_path / "windows.py"
+    mod.write_text(
+        "import numpy as np\n"
+        "class Runner:\n"
+        "    def hazardous(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self.plane.rounds = 1\n"       # line 5: flags
+        "        return np.asarray(out[0])\n"
+        "    def forced_first(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        host = np.asarray(out[0])\n"
+        "        self.plane.rounds = 1\n"       # closed: clean
+        "        return host\n"
+        "    def published(self, st, rec):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self._inflight = rec\n"
+        "        self.mgr.stats.append(1)\n"    # closed: clean
+        "    def committed(self, st, spec):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self._commit_spec(spec)\n"
+        "        self.mgr.stats.append(1)\n"    # closed: clean
+        "    def shallow(self, st):\n"
+        "        out = self._span_call(self._fn, st)\n"
+        "        self.spans = 1\n"              # own counter: clean
+        "        return np.asarray(out[0])\n")
+    v = effects.check_thread_ownership(ROOT, paths=[str(mod)])
+    assert [(x.rule, x.line) for x in v] == [("overlap-window", 5)], \
+        [x.render() for x in v]
+    assert "self.plane.rounds" in v[0].message
+
+
+# ---------------------------------------------------------------------------
+# 4c mutation self-tests
+# ---------------------------------------------------------------------------
+
+def test_unregistered_knob_bites(config_text):
+    mutated = _mutate(config_text,
+                      '"chrome_top_n": e.chrome_top_n,',
+                      '"chrome_top_m": e.chrome_top_n,')
+    v = effects.check_knob_registry(ROOT, config_text=mutated)
+    rules = {x.rule for x in v}
+    # the renamed knob is unregistered, unloadable and undocumented;
+    # the orphaned registry row reports stale
+    assert {"knob-unregistered", "knob-unloadable", "knob-undocumented",
+            "knob-stale"} <= rules
+    assert any("chrome_top_m" in x.message for x in v)
+
+
+def test_digest_tuple_drift_bites(restore_text):
+    mutated = _mutate(restore_text, '"pcap_span_cap", ', "")
+    v = effects.check_knob_registry(ROOT, restore_text=mutated)
+    hits = [x for x in v if x.rule == "knob-digest-drift"]
+    assert len(hits) == 1
+    assert "pcap_span_cap" in hits[0].message
+    assert "only in KNOB_DIGEST" in hits[0].message
+
+
+def test_wall_knob_in_sim_channel_bites(tmp_path):
+    ch = tmp_path / "chan.py"
+    ch.write_text(
+        "class SimChannel:\n"
+        "    pass\n"
+        "class MyChannel(SimChannel):\n"
+        "    def push(self, rec):\n"
+        "        if self.cfg.managed_death_poll_ns:\n"   # line 5
+        "            return\n"
+        "class NotAChannel:\n"
+        "    def fine(self):\n"
+        "        return self.cfg.managed_death_poll_ns\n")
+    v = effects.check_knob_registry(ROOT, channel_paths=[str(ch)])
+    hits = [x for x in v if x.rule == "knob-wall-in-channel"]
+    assert len(hits) == 1 and hits[0].line == 5, \
+        [x.render() for x in v]
+
+
+def test_undocumented_knob_bites():
+    docs = ("## `experimental`\n"
+            "| Key | Default | Meaning |\n"
+            "|---|---|---|\n"
+            "| `scheduler` | `tpu` | row |\n")
+    v = effects.check_knob_registry(ROOT, docs_text=docs)
+    undoc = {x.message.split("`")[1] for x in v
+             if x.rule == "knob-undocumented"}
+    assert "tpu_device_spans" in undoc     # the knob PR 5 forgot
+    assert "scheduler" not in undoc
+    # suffix shorthand rows (`_sim_interval`) must keep documenting
+    docs += ("| `native_preemption_native_interval` / `_sim_interval` "
+             "| `10 ms` | row |\n")
+    v = effects.check_knob_registry(ROOT, docs_text=docs)
+    undoc = {x.message.split("`")[1] for x in v
+             if x.rule == "knob-undocumented"}
+    assert "native_preemption_sim_interval" not in undoc
+
+
+# ---------------------------------------------------------------------------
+# runtime leg: the epoch-discipline fixes, on the live engine
+# ---------------------------------------------------------------------------
+
+from shadow_tpu.native.plane import load_netplane, native_available  # noqa: E402
+
+
+@pytest.mark.skipif(not native_available(),
+                    reason="netplane engine unavailable")
+def test_epoch_discipline_on_live_engine():
+    """The pass-4a reclassifications, empirically: observers leave the
+    epoch alone, the two knob setters now bump, and the blob imports
+    bump even when the import FAILS after mutating state (the hoisted
+    bump — the old code returned false without invalidating)."""
+    mod = load_netplane()
+    eng = mod.Engine()
+    eng.add_host(0, 0x0A000001, 10**9, 10**9, 0, 1500)
+
+    e0 = eng.state_epoch()
+    eng.trace_entries(0)
+    eng.pcap_take(0)
+    blob = eng.plane_export()
+    assert eng.state_epoch() == e0, \
+        "observer drains/export must not bump state_epoch"
+
+    eng.set_dctcp_k(21, 31000)
+    assert eng.state_epoch() == e0 + 1, "set_dctcp_k must bump"
+    eng.set_host_tcp(0, 0, 0)
+    assert eng.state_epoch() == e0 + 2, "set_host_tcp must bump"
+
+    e1 = eng.state_epoch()
+    eng.plane_import(blob)
+    assert eng.state_epoch() > e1, "plane_import must bump"
+
+    # failing single-host import: no frame for host 7 in the blob —
+    # the hoisted bump still invalidates (conservative direction)
+    e2 = eng.state_epoch()
+    with pytest.raises(ValueError):
+        eng.host_import(blob, 7, 0)
+    assert eng.state_epoch() > e2, \
+        "failed host_import must still bump (state may be neutralized)"

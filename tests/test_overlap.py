@@ -169,6 +169,21 @@ def test_overlap_stale_epoch_refused():
     assert r._inflight is None
     assert r.overlap_refusals == ref0 + 2
     assert r.overlap_stale == stale0 + 1
+    # set_dctcp_k was misclassified config-not-state until the pass-4
+    # effect audit (docs/LINT.md "Pass 4"): the device kernels bake K
+    # into their closures, so a mid-run change MUST refuse the window
+    seed(m.plane.engine.state_epoch())
+    m.plane.engine.set_dctcp_k(21, 31000)  # bumps state_epoch now
+    assert r._take_inflight(params) is None
+    assert r.overlap_stale == stale0 + 2
+    m.plane.engine.set_dctcp_k(20, 30000)  # restore the default
+    # observer drains between commit and landing must NOT refuse:
+    # trace_entries/pcap_take read TRACE state, not SIMULATION state
+    rec = seed(m.plane.engine.state_epoch())
+    m.plane.engine.trace_entries(0)
+    m.plane.engine.pcap_take(0)
+    assert r._take_inflight(params) is rec, \
+        "observer drains spuriously invalidated the in-flight window"
 
 
 @pytest.mark.slow

@@ -250,5 +250,5 @@ def test_full_lint_clean_and_fast():
     violations, counts = run_all(ROOT)
     dt = time.perf_counter() - t0  # shadow-lint: allow[wall-clock] ditto
     assert [v.render() for v in violations] == []
-    assert set(counts) == {"twin", "layout", "det"}
+    assert set(counts) == {"twin", "layout", "det", "effects"}
     assert dt < 30.0, f"lint took {dt:.1f}s (budget 30s)"
